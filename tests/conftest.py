@@ -1,0 +1,14 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh (no TPU needed).
+
+Must run before any jax import, hence env mutation at conftest import time.
+The driver's dryrun_multichip uses the same mechanism.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

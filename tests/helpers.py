@@ -1,0 +1,47 @@
+"""Shared test helpers: build/instantiate/run in one line.
+
+This is our analog of the reference's SpecTest callback seam
+(/root/reference/test/spec/spectest.h:62-90): `run_wasm` drives any engine
+through the same load->validate->instantiate->invoke staging, so parity
+suites can swap engines underneath unchanged tests.
+"""
+
+from __future__ import annotations
+
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.executor import Executor
+from wasmedge_tpu.loader import Loader
+from wasmedge_tpu.runtime.store import StoreManager
+from wasmedge_tpu.utils.builder import ModuleBuilder
+from wasmedge_tpu.validator import Validator
+
+
+def load_validate(data: bytes, conf: Configure | None = None):
+    conf = conf or Configure()
+    return Validator(conf).validate(Loader(conf).parse_module(data))
+
+
+def instantiate(data: bytes, conf: Configure | None = None, imports=None):
+    conf = conf or Configure()
+    mod = load_validate(data, conf)
+    store = StoreManager()
+    ex = Executor(conf)
+    if imports:
+        for obj in imports:
+            ex.register_import_object(store, obj)
+    inst = ex.instantiate(store, mod)
+    return ex, store, inst
+
+
+def run_wasm(data: bytes, func: str, args=(), conf: Configure | None = None,
+             imports=None):
+    ex, store, inst = instantiate(data, conf, imports)
+    fi = inst.find_func(func)
+    assert fi is not None, f"export {func} not found"
+    return ex.invoke(store, fi, list(args))
+
+
+def single_func(params, results, locals_, body, export="f") -> bytes:
+    b = ModuleBuilder()
+    b.add_function(params, results, locals_, body, export=export)
+    return b.build()

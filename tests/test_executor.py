@@ -1,0 +1,340 @@
+"""Scalar engine integration tests: control flow, calls, locals/globals,
+memory, tables, traps — the reference's test/executor + test/spec role for
+the core proposal, with modules built programmatically."""
+
+import pytest
+
+from wasmedge_tpu.common.errors import ErrCode, TrapError
+from wasmedge_tpu.runtime.hostfunc import ImportObject
+from wasmedge_tpu.utils.builder import ModuleBuilder
+from tests.helpers import run_wasm, single_func, instantiate
+
+
+class TestControl:
+    def test_fib(self):
+        from wasmedge_tpu.models import build_fib
+        data = build_fib()
+        assert run_wasm(data, "fib", [10]) == [55]
+        assert run_wasm(data, "fib", [20]) == [6765]
+
+    def test_fac_i64(self):
+        from wasmedge_tpu.models import build_fac
+        assert run_wasm(build_fac(), "fac", [12]) == [479001600]
+        assert run_wasm(build_fac(), "fac", [20]) == [2432902008176640000]
+
+    def test_loop_sum(self):
+        from wasmedge_tpu.models import build_loop_sum
+        assert run_wasm(build_loop_sum(), "loop_sum", [100]) == [4950]
+
+    def test_block_br_values(self):
+        # br carrying a value out of nested blocks
+        data = single_func([], ["i32"], [], [
+            ("block", "i32"),
+            ("block", None),
+            ("i32.const", 7), ("br", 1),
+            "end",
+            ("i32.const", 99),
+            "end",
+        ])
+        assert run_wasm(data, "f") == [7]
+
+    def test_loop_with_params(self):
+        # multi-value: loop with a parameter (needs a type index blocktype)
+        b = ModuleBuilder()
+        ti = b.add_type(["i32"], ["i32"])
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0),
+            ("loop", ti),
+            # param on stack: if > 0, decrement and continue
+            ("local.set", 0),
+            ("local.get", 0), ("i32.const", 0), "i32.gt_s",
+            ("if", None),
+            ("local.get", 0), ("i32.const", 1), "i32.sub", ("br", 1),
+            "end",
+            ("local.get", 0),
+            "end",
+        ], export="f")
+        assert run_wasm(b.build(), "f", [5]) == [0]
+
+    def test_br_table(self):
+        data = single_func(["i32"], ["i32"], [], [
+            ("block", None), ("block", None), ("block", None),
+            ("local.get", 0), ("br_table", [0, 1], 2),
+            "end", ("i32.const", 10), "return",
+            "end", ("i32.const", 20), "return",
+            "end", ("i32.const", 30),
+        ])
+        assert run_wasm(data, "f", [0]) == [10]
+        assert run_wasm(data, "f", [1]) == [20]
+        assert run_wasm(data, "f", [2]) == [30]
+        assert run_wasm(data, "f", [100]) == [30]
+
+    def test_select(self):
+        data = single_func(["i32"], ["i32"], [], [
+            ("i32.const", 111), ("i32.const", 222), ("local.get", 0), "select",
+        ])
+        assert run_wasm(data, "f", [1]) == [111]
+        assert run_wasm(data, "f", [0]) == [222]
+
+    def test_unreachable_trap(self):
+        with pytest.raises(TrapError) as e:
+            run_wasm(single_func([], [], [], ["unreachable"]), "f")
+        assert e.value.code == ErrCode.Unreachable
+
+    def test_multivalue_return(self):
+        data = single_func(["i32"], ["i32", "i32"], [], [
+            ("local.get", 0), ("i32.const", 1), "i32.add",
+            ("local.get", 0), ("i32.const", 2), "i32.add",
+        ])
+        assert run_wasm(data, "f", [10]) == [11, 12]
+
+    def test_call_stack_exhaustion(self):
+        b = ModuleBuilder()
+        b.add_function([], [], [], [("call", 0)], export="f")
+        with pytest.raises(TrapError) as e:
+            run_wasm(b.build(), "f")
+        assert e.value.code == ErrCode.CallStackExhausted
+
+
+class TestCallIndirect:
+    def _mod(self):
+        b = ModuleBuilder()
+        add = b.add_function(["i32", "i32"], ["i32"], [],
+                             [("local.get", 0), ("local.get", 1), "i32.add"])
+        sub = b.add_function(["i32", "i32"], ["i32"], [],
+                             [("local.get", 0), ("local.get", 1), "i32.sub"])
+        other = b.add_function([], [], [], [])
+        b.add_table("funcref", 4)
+        b.add_active_elem(0, [("i32.const", 0)], [add, sub, other])
+        ti = b.add_type(["i32", "i32"], ["i32"])
+        b.add_function(["i32", "i32", "i32"], ["i32"], [], [
+            ("local.get", 1), ("local.get", 2),
+            ("local.get", 0), ("call_indirect", ti, 0),
+        ], export="dispatch")
+        return b.build()
+
+    def test_dispatch(self):
+        data = self._mod()
+        assert run_wasm(data, "dispatch", [0, 30, 12]) == [42]
+        assert run_wasm(data, "dispatch", [1, 30, 12]) == [18]
+
+    def test_sig_mismatch(self):
+        with pytest.raises(TrapError) as e:
+            run_wasm(self._mod(), "dispatch", [2, 0, 0])
+        assert e.value.code == ErrCode.IndirectCallTypeMismatch
+
+    def test_uninitialized(self):
+        with pytest.raises(TrapError) as e:
+            run_wasm(self._mod(), "dispatch", [3, 0, 0])
+        assert e.value.code == ErrCode.UninitializedElement
+
+    def test_undefined(self):
+        with pytest.raises(TrapError) as e:
+            run_wasm(self._mod(), "dispatch", [100, 0, 0])
+        assert e.value.code == ErrCode.UndefinedElement
+
+
+class TestGlobals:
+    def test_global_get_set(self):
+        b = ModuleBuilder()
+        b.add_global("i32", True, [("i32.const", 10)])
+        b.add_function([], ["i32"], [], [
+            ("global.get", 0), ("i32.const", 5), "i32.add", ("global.set", 0),
+            ("global.get", 0),
+        ], export="f")
+        assert run_wasm(b.build(), "f") == [15]
+
+    def test_imported_global_in_init(self):
+        from wasmedge_tpu.runtime.instance import GlobalInstance
+        from wasmedge_tpu.loader.ast import GlobalType
+        from wasmedge_tpu.common.types import ValType
+        imp = ImportObject("env")
+        imp.add_global("base", GlobalInstance(GlobalType(ValType.I32, False), 100))
+        b = ModuleBuilder()
+        b.import_global("env", "base", "i32", mutable=False)
+        b.add_global("i32", False, [("global.get", 0)])
+        b.add_function([], ["i32"], [], [("global.get", 1)], export="f")
+        assert run_wasm(b.build(), "f", imports=[imp]) == [100]
+
+
+class TestMemory:
+    def test_load_store(self):
+        b = ModuleBuilder()
+        b.add_memory(1)
+        b.add_function(["i32", "i32"], ["i32"], [], [
+            ("local.get", 0), ("local.get", 1), ("i32.store", 2, 0),
+            ("local.get", 0), ("i32.load", 2, 0),
+        ], export="f")
+        assert run_wasm(b.build(), "f", [100, -123]) == [-123]
+
+    def test_subword_and_offset(self):
+        b = ModuleBuilder()
+        b.add_memory(1)
+        b.add_function([], ["i32", "i32", "i64"], [], [
+            ("i32.const", 8), ("i32.const", 0x80FF), ("i32.store", 2, 0),
+            ("i32.const", 8), ("i32.load8_s", 0, 0),    # -1
+            ("i32.const", 8), ("i32.load8_u", 0, 1),    # 0x80
+            ("i32.const", 0), ("i64.load32_u", 2, 8),   # 0x80FF via offset
+        ], export="f")
+        assert run_wasm(b.build(), "f") == [-1, 0x80, 0x80FF]
+
+    def test_oob_trap(self):
+        b = ModuleBuilder()
+        b.add_memory(1)
+        b.add_function([], ["i32"], [], [
+            ("i32.const", 65533), ("i32.load", 2, 0),
+        ], export="f")
+        with pytest.raises(TrapError) as e:
+            run_wasm(b.build(), "f")
+        assert e.value.code == ErrCode.MemoryOutOfBounds
+
+    def test_grow_and_size(self):
+        b = ModuleBuilder()
+        b.add_memory(1, 3)
+        b.add_function([], ["i32", "i32", "i32", "i32"], [], [
+            "memory.size",
+            ("i32.const", 1), "memory.grow",
+            ("i32.const", 5), "memory.grow",  # beyond max -> -1
+            "memory.size",
+        ], export="f")
+        assert run_wasm(b.build(), "f") == [1, 1, -1, 2]
+
+    def test_active_data_init(self):
+        b = ModuleBuilder()
+        b.add_memory(1)
+        b.add_active_data(0, [("i32.const", 4)], b"\x2a\x00\x00\x00")
+        b.add_function([], ["i32"], [], [
+            ("i32.const", 4), ("i32.load", 2, 0),
+        ], export="f")
+        assert run_wasm(b.build(), "f") == [42]
+
+    def test_bulk_fill_copy(self):
+        b = ModuleBuilder()
+        b.add_memory(1)
+        b.add_function([], ["i32"], [], [
+            ("i32.const", 0), ("i32.const", 0xAB), ("i32.const", 8), "memory.fill",
+            ("i32.const", 16), ("i32.const", 0), ("i32.const", 4), "memory.copy",
+            ("i32.const", 16), ("i32.load", 2, 0),
+        ], export="f")
+        assert run_wasm(b.build(), "f") == [-0x54545455]  # 0xABABABAB signed
+
+    def test_memory_init_passive(self):
+        b = ModuleBuilder()
+        b.add_memory(1)
+        b.data_count = 1
+        b.add_passive_data(b"\x01\x02\x03\x04")
+        b.add_function([], ["i32"], [], [
+            ("i32.const", 20), ("i32.const", 1), ("i32.const", 2), ("memory.init", 0),
+            ("i32.const", 20), ("i32.load16_u", 0, 0),
+        ], export="f")
+        assert run_wasm(b.build(), "f") == [0x0302]
+
+
+class TestHostFuncs:
+    def test_host_call(self):
+        seen = []
+
+        def logger(mem, x):
+            seen.append(x)
+            return x * 2
+
+        imp = ImportObject("env")
+        imp.add_py_func("double", logger, ["i32"], ["i32"])
+        b = ModuleBuilder()
+        f = b.import_func("env", "double", ["i32"], ["i32"])
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), ("call", f),
+            ("local.get", 0), ("call", f),
+            "i32.add",
+        ], export="f")
+        assert run_wasm(b.build(), "f", [21], imports=[imp]) == [84]
+        assert seen == [21, 21]
+
+    def test_host_memory_access(self):
+        def peek(mem, addr):
+            return mem.load(addr, 4, False)
+
+        imp = ImportObject("env")
+        imp.add_py_func("peek", peek, ["i32"], ["i32"])
+        b = ModuleBuilder()
+        f = b.import_func("env", "peek", ["i32"], ["i32"])
+        b.add_memory(1)
+        b.add_function([], ["i32"], [], [
+            ("i32.const", 12), ("i32.const", 777), ("i32.store", 2, 0),
+            ("i32.const", 12), ("call", f),
+        ], export="f")
+        assert run_wasm(b.build(), "f", imports=[imp]) == [777]
+
+    def test_unknown_import(self):
+        from wasmedge_tpu.common.errors import InstantiationError
+        b = ModuleBuilder()
+        b.import_func("nosuch", "fn", [], [])
+        b.add_function([], [], [], [], export="f")
+        with pytest.raises(InstantiationError):
+            instantiate(b.build())
+
+
+class TestCrossModule:
+    def test_import_func_from_registered_module(self):
+        from wasmedge_tpu.common.configure import Configure
+        from wasmedge_tpu.executor import Executor
+        from wasmedge_tpu.loader import Loader
+        from wasmedge_tpu.runtime.store import StoreManager
+        from wasmedge_tpu.validator import Validator
+
+        conf = Configure()
+        store = StoreManager()
+        ex = Executor(conf)
+
+        lib = ModuleBuilder()
+        lib.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), ("i32.const", 3), "i32.mul",
+        ], export="triple")
+        libmod = Validator(conf).validate(Loader(conf).parse_module(lib.build()))
+        ex.register_module(store, libmod, "lib")
+
+        app = ModuleBuilder()
+        f = app.import_func("lib", "triple", ["i32"], ["i32"])
+        app.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), ("call", f), ("call", f),
+        ], export="nine_x")
+        appmod = Validator(conf).validate(Loader(conf).parse_module(app.build()))
+        inst = ex.instantiate(store, appmod)
+        assert ex.invoke(store, inst.find_func("nine_x"), [7]) == [63]
+
+
+class TestStartAndStats:
+    def test_start_function(self):
+        b = ModuleBuilder()
+        b.add_global("i32", True, [("i32.const", 0)])
+        s = b.add_function([], [], [], [("i32.const", 99), ("global.set", 0)])
+        b.set_start(s)
+        b.add_function([], ["i32"], [], [("global.get", 0)], export="f")
+        assert run_wasm(b.build(), "f") == [99]
+
+    def test_statistics_and_gas(self):
+        from wasmedge_tpu.common.configure import Configure
+        from wasmedge_tpu.common.statistics import Statistics
+        from wasmedge_tpu.executor import Executor
+        from wasmedge_tpu.loader import Loader
+        from wasmedge_tpu.runtime.store import StoreManager
+        from wasmedge_tpu.validator import Validator
+        from wasmedge_tpu.models import build_fib
+
+        conf = Configure()
+        conf.statistics.instr_counting = True
+        conf.statistics.cost_measuring = True
+        stat = Statistics(conf)
+        mod = Validator(conf).validate(Loader(conf).parse_module(build_fib()))
+        store = StoreManager()
+        ex = Executor(conf, stat)
+        inst = ex.instantiate(store, mod)
+        ex.invoke(store, inst.find_func("fib"), [10])
+        assert stat.instr_count > 100
+        # gas limit enforcement
+        stat2 = Statistics(conf)
+        stat2.set_cost_limit(50)
+        ex2 = Executor(conf, stat2)
+        with pytest.raises(TrapError) as e:
+            ex2.invoke(store, inst.find_func("fib"), [15])
+        assert e.value.code == ErrCode.CostLimitExceeded

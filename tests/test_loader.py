@@ -1,0 +1,227 @@
+"""Loader unit tests — byte-level decode with handcrafted binaries, the
+reference's test/loader pattern (sectionTest.cpp, filemgrTest.cpp,
+instructionTest.cpp)."""
+
+import pytest
+
+from wasmedge_tpu.common.errors import ErrCode, LoadError
+from wasmedge_tpu.common.opcodes import Op, name_of
+from wasmedge_tpu.common.types import ValType
+from wasmedge_tpu.loader import Loader
+from wasmedge_tpu.loader.filemgr import FileMgr
+from wasmedge_tpu.utils.builder import ModuleBuilder, uleb, sleb
+
+
+class TestFileMgr:
+    def test_uleb_basic(self):
+        assert FileMgr(b"\x00").read_u32() == 0
+        assert FileMgr(b"\x7f").read_u32() == 127
+        assert FileMgr(b"\x80\x01").read_u32() == 128
+        assert FileMgr(b"\xff\xff\xff\xff\x0f").read_u32() == 0xFFFFFFFF
+
+    def test_uleb_too_long(self):
+        with pytest.raises(LoadError) as e:
+            FileMgr(b"\xff\xff\xff\xff\xff\x0f").read_u32()
+        assert e.value.code == ErrCode.IntegerTooLong
+
+    def test_uleb_unused_bits(self):
+        # 5th byte may only contribute 4 bits for u32
+        with pytest.raises(LoadError) as e:
+            FileMgr(b"\xff\xff\xff\xff\x1f").read_u32()
+        assert e.value.code == ErrCode.IntegerTooLarge
+
+    def test_sleb_basic(self):
+        assert FileMgr(b"\x00").read_s32() == 0
+        assert FileMgr(b"\x7f").read_s32() == -1
+        assert FileMgr(b"\x40").read_s32() == -64
+        assert FileMgr(b"\xc0\x00").read_s32() == 64
+        assert FileMgr(sleb(-(2**31))).read_s32() == -(2**31)
+        assert FileMgr(sleb(2**31 - 1)).read_s32() == 2**31 - 1
+
+    def test_sleb_sign_bits(self):
+        # -2^31 encoded, then corrupt final byte sign-extension
+        with pytest.raises(LoadError):
+            FileMgr(b"\xff\xff\xff\xff\x4f").read_s32()
+
+    def test_sleb64_roundtrip(self):
+        for v in (0, 1, -1, 2**62, -(2**63), 2**63 - 1, 123456789012345):
+            assert FileMgr(sleb(v)).read_s64() == v
+
+    def test_truncated(self):
+        with pytest.raises(LoadError) as e:
+            FileMgr(b"\x80").read_u32()
+        assert e.value.code == ErrCode.UnexpectedEnd
+
+    def test_name_utf8(self):
+        fm = FileMgr(uleb(2) + b"\xc3\xa9")
+        assert fm.read_name() == "é"
+        with pytest.raises(LoadError) as e:
+            FileMgr(uleb(1) + b"\xff").read_name()
+        assert e.value.code == ErrCode.MalformedUTF8
+
+
+class TestHeaders:
+    def test_bad_magic(self):
+        with pytest.raises(LoadError) as e:
+            Loader().parse_module(b"\x00msa\x01\x00\x00\x00")
+        assert e.value.code == ErrCode.MalformedMagic
+
+    def test_bad_version(self):
+        with pytest.raises(LoadError) as e:
+            Loader().parse_module(b"\x00asm\x02\x00\x00\x00")
+        assert e.value.code == ErrCode.MalformedVersion
+
+    def test_empty_module(self):
+        mod = Loader().parse_module(b"\x00asm\x01\x00\x00\x00")
+        assert mod.types == [] and mod.functions == []
+
+    def test_section_out_of_order(self):
+        # function section (3) before type section (1)
+        raw = b"\x00asm\x01\x00\x00\x00" + b"\x03\x02\x01\x00" + b"\x01\x04\x01\x60\x00\x00"
+        with pytest.raises(LoadError) as e:
+            Loader().parse_module(raw)
+        assert e.value.code == ErrCode.JunkSection
+
+    def test_section_size_mismatch(self):
+        # type section claims 5 bytes but content is 4
+        raw = b"\x00asm\x01\x00\x00\x00" + b"\x01\x05\x01\x60\x00\x00"
+        with pytest.raises(LoadError):
+            Loader().parse_module(raw)
+
+    def test_func_code_mismatch(self):
+        b = ModuleBuilder()
+        b.add_function([], [], [], [])
+        raw = bytearray(b.build())
+        # strip the code section (last section) entirely
+        # find code section: id 10
+        i = 8
+        while i < len(raw):
+            sid = raw[i]
+            size = raw[i + 1]
+            if sid == 10:
+                del raw[i:]
+                break
+            i += 2 + size
+        with pytest.raises(LoadError) as e:
+            Loader().parse_module(bytes(raw))
+        assert e.value.code == ErrCode.IncompatibleFuncCode
+
+
+class TestSections:
+    def test_type_section(self):
+        b = ModuleBuilder()
+        b.add_type(["i32", "i64"], ["f32"])
+        mod = Loader().parse_module(b.build())
+        assert mod.types[0].params == (ValType.I32, ValType.I64)
+        assert mod.types[0].results == (ValType.F32,)
+
+    def test_import_section(self):
+        b = ModuleBuilder()
+        b.import_func("env", "f", ["i32"], [])
+        b.import_memory("env", "m", 1, 4)
+        b.import_global("env", "g", "i64", mutable=True)
+        b.import_table("env", "t", "funcref", 2, 10)
+        mod = Loader().parse_module(b.build())
+        assert len(mod.imports) == 4
+        assert mod.imports[0].kind == 0
+        assert mod.imports[1].memory_type.limit.max == 4
+        assert mod.imports[2].global_type.mutable
+        assert mod.imports[3].table_type.limit.min == 2
+
+    def test_memory_global_export_start(self):
+        b = ModuleBuilder()
+        b.add_memory(2, 8, export="mem")
+        b.add_global("i32", True, [("i32.const", 41)], export="g")
+        f = b.add_function([], [], [], [])
+        b.set_start(f)
+        mod = Loader().parse_module(b.build())
+        assert mod.memories[0].limit.min == 2
+        assert mod.globals[0].type.mutable
+        assert mod.start == f
+        assert {e.name for e in mod.exports} == {"mem", "g"}
+
+    def test_elem_and_data(self):
+        b = ModuleBuilder()
+        b.add_table("funcref", 4)
+        f = b.add_function([], [], [], [])
+        b.add_active_elem(0, [("i32.const", 1)], [f])
+        b.add_memory(1)
+        b.add_active_data(0, [("i32.const", 0)], b"hello")
+        b.data_count = 1
+        mod = Loader().parse_module(b.build())
+        assert mod.elements[0].mode == 0
+        assert len(mod.elements[0].init_exprs) == 1
+        assert mod.datas[0].data == b"hello"
+
+    def test_custom_section_anywhere(self):
+        raw = b"\x00asm\x01\x00\x00\x00" + b"\x00\x05\x04name" + b"\x01\x04\x01\x60\x00\x00"
+        mod = Loader().parse_module(raw)
+        assert mod.customs[0].name == "name"
+
+
+class TestInstructions:
+    def test_jump_precompute(self):
+        b = ModuleBuilder()
+        b.add_function([], [], [], [
+            ("block", None), ("block", None), ("br", 1), "end", "end",
+        ])
+        mod = Loader().parse_module(b.build())
+        body = mod.codes[0].body
+        names = [name_of(i.op) for i in body]
+        assert names == ["block", "block", "br", "end", "end", "end"]
+        assert body[0].jump_end == 4
+        assert body[1].jump_end == 2
+
+    def test_if_else_jumps(self):
+        b = ModuleBuilder()
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), ("if", "i32"), ("i32.const", 1),
+            "else", ("i32.const", 2), "end",
+        ])
+        mod = Loader().parse_module(b.build())
+        body = mod.codes[0].body
+        if_i = 1
+        assert name_of(body[if_i].op) == "if"
+        assert body[if_i].jump_else == 2
+        assert body[if_i].jump_end == 4
+
+    def test_illegal_opcode(self):
+        # handcrafted: one void function whose body is [0x27 (illegal), end]
+        raw = (b"\x00asm\x01\x00\x00\x00"
+               b"\x01\x04\x01\x60\x00\x00"
+               b"\x03\x02\x01\x00"
+               b"\x0a\x05\x01\x03\x00\x27\x0b")
+        with pytest.raises(LoadError) as e:
+            Loader().parse_module(raw)
+        assert e.value.code == ErrCode.IllegalOpCode
+
+    def test_proposal_gating(self):
+        from wasmedge_tpu.common.configure import Configure, Proposal
+        b = ModuleBuilder()
+        b.add_function(["i32"], ["i32"], [], [("local.get", 0), "i32.extend8_s"])
+        conf = Configure()
+        conf.remove_proposal(Proposal.SignExtensionOperators)
+        with pytest.raises(LoadError) as e:
+            Loader(conf).parse_module(b.build())
+        assert e.value.code == ErrCode.IllegalOpCode
+        # default conf allows it
+        Loader().parse_module(b.build())
+
+    def test_br_table_decode(self):
+        b = ModuleBuilder()
+        b.add_function(["i32"], [], [], [
+            ("block", None), ("block", None),
+            ("local.get", 0), ("br_table", [0, 1], 1),
+            "end", "end",
+        ])
+        mod = Loader().parse_module(b.build())
+        bt = [i for i in mod.codes[0].body if name_of(i.op) == "br_table"][0]
+        assert bt.targets == [0, 1] and bt.target_idx == 1
+
+    def test_const_immediates(self):
+        b = ModuleBuilder()
+        b.add_function([], ["f64"], [], [("f64.const", 3.14159)])
+        mod = Loader().parse_module(b.build())
+        import struct
+        bits = mod.codes[0].body[0].imm
+        assert struct.unpack("<d", struct.pack("<Q", bits))[0] == pytest.approx(3.14159)

@@ -1,0 +1,218 @@
+"""Numeric-semantics tests: table-driven exactness checks for the scalar
+oracle — div/rem traps, shift/rotate, clz/ctz, float NaN policy, rounding,
+trunc bounds, conversions (the reference's *.ipp coverage)."""
+
+import math
+import struct
+
+import pytest
+
+from wasmedge_tpu.common.errors import ErrCode, TrapError
+from tests.helpers import run_wasm, single_func
+
+
+def op1(op, ty_in, ty_out, x):
+    data = single_func([ty_in], [ty_out], [], [("local.get", 0), op])
+    return run_wasm(data, "f", [x])[0]
+
+
+def op2(op, ty, x, y, ty_out=None):
+    data = single_func([ty, ty], [ty_out or ty], [],
+                       [("local.get", 0), ("local.get", 1), op])
+    return run_wasm(data, "f", [x, y])[0]
+
+
+def f32bits(x):
+    return struct.unpack("<I", struct.pack("<f", x))[0]
+
+
+def f64bits(x):
+    return struct.unpack("<Q", struct.pack("<d", x))[0]
+
+
+class TestI32:
+    def test_add_wrap(self):
+        assert op2("i32.add", "i32", 2**31 - 1, 1) == -(2**31)
+
+    def test_mul_wrap(self):
+        masked = (0x12345678 * 0x9ABCDEF0) & 0xFFFFFFFF
+        expect = masked - 2**32 if masked >= 2**31 else masked
+        assert op2("i32.mul", "i32", 0x12345678, 0x9ABCDEF0 - 2**32) == expect
+
+    def test_div_s_trunc(self):
+        assert op2("i32.div_s", "i32", -7, 2) == -3
+        assert op2("i32.div_s", "i32", 7, -2) == -3
+
+    def test_div_by_zero(self):
+        with pytest.raises(TrapError) as e:
+            op2("i32.div_u", "i32", 1, 0)
+        assert e.value.code == ErrCode.DivideByZero
+
+    def test_div_overflow(self):
+        with pytest.raises(TrapError) as e:
+            op2("i32.div_s", "i32", -(2**31), -1)
+        assert e.value.code == ErrCode.IntegerOverflow
+
+    def test_rem_s(self):
+        assert op2("i32.rem_s", "i32", -7, 2) == -1
+        assert op2("i32.rem_s", "i32", 7, -2) == 1
+        assert op2("i32.rem_s", "i32", -(2**31), -1) == 0
+
+    def test_shifts(self):
+        assert op2("i32.shl", "i32", 1, 33) == 2  # count mod 32
+        assert op2("i32.shr_s", "i32", -8, 1) == -4
+        assert op2("i32.shr_u", "i32", -8, 1) == 0x7FFFFFFC
+        assert op2("i32.rotl", "i32", 0x80000001 - 2**32, 1) == 3
+        assert op2("i32.rotr", "i32", 3, 1) == 0x80000001 - 2**32
+
+    def test_clz_ctz_popcnt(self):
+        assert op1("i32.clz", "i32", "i32", 0) == 32
+        assert op1("i32.clz", "i32", "i32", 1) == 31
+        assert op1("i32.ctz", "i32", "i32", 0) == 32
+        assert op1("i32.ctz", "i32", "i32", 8) == 3
+        assert op1("i32.popcnt", "i32", "i32", -1) == 32
+
+    def test_cmp_signed_unsigned(self):
+        assert op2("i32.lt_s", "i32", -1, 0) == 1
+        assert op2("i32.lt_u", "i32", -1, 0) == 0  # 0xFFFFFFFF > 0
+
+    def test_extend8_s(self):
+        assert op1("i32.extend8_s", "i32", "i32", 0x80) == -128
+        assert op1("i32.extend16_s", "i32", "i32", 0x8000) == -32768
+
+
+class TestI64:
+    def test_add_wrap(self):
+        assert op2("i64.add", "i64", 2**63 - 1, 1) == -(2**63)
+
+    def test_mul(self):
+        assert op2("i64.mul", "i64", 0x123456789ABCDEF, 0x100000001) == \
+            ((0x123456789ABCDEF * 0x100000001) & (2**64 - 1)) - 2**64
+
+    def test_div_rem(self):
+        assert op2("i64.div_s", "i64", -(10**18), 7) == -(10**18 // 7)
+        with pytest.raises(TrapError):
+            op2("i64.div_s", "i64", -(2**63), -1)
+        assert op2("i64.rem_s", "i64", -(2**63), -1) == 0
+
+    def test_clz(self):
+        assert op1("i64.clz", "i64", "i64", 0) == 64
+        assert op1("i64.clz", "i64", "i64", 2**40) == 23
+
+    def test_extend32_s(self):
+        assert op1("i64.extend32_s", "i64", "i64", 0x80000000) == -(2**31)
+
+
+class TestF32:
+    def test_add(self):
+        assert op2("f32.add", "f32", 1.5, 2.25) == 3.75
+
+    def test_rounding_f32(self):
+        # 16777217 not representable in f32: correct rounding check
+        r = op2("f32.add", "f32", 16777216.0, 1.0)
+        assert float(r) == 16777216.0
+
+    def test_nan_canonical(self):
+        r = op2("f32.div", "f32", 0.0, 0.0)
+        assert math.isnan(float(r))
+
+    def test_min_max_zeros(self):
+        # min(-0, +0) must be -0
+        r = op2("f32.min", "f32", -0.0, 0.0)
+        assert math.copysign(1, float(r)) == -1
+        r = op2("f32.max", "f32", -0.0, 0.0)
+        assert math.copysign(1, float(r)) == 1
+
+    def test_min_nan(self):
+        r = op2("f32.min", "f32", float("nan"), 1.0)
+        assert math.isnan(float(r))
+
+    def test_abs_neg_preserve_payload(self):
+        # abs/neg are bit-level: NaN payload preserved
+        b = single_func([], ["i32"], [], [
+            ("f32.const", 0xFFC00001), "f32.abs", "i32.reinterpret_f32",
+        ])
+        assert run_wasm(b, "f")[0] == 0x7FC00001
+
+    def test_nearest_half_even(self):
+        assert float(op1("f32.nearest", "f32", "f32", 2.5)) == 2.0
+        assert float(op1("f32.nearest", "f32", "f32", 3.5)) == 4.0
+        assert float(op1("f32.nearest", "f32", "f32", -0.5)) == 0.0
+
+    def test_sqrt_neg(self):
+        assert math.isnan(float(op1("f32.sqrt", "f32", "f32", -1.0)))
+
+    def test_copysign(self):
+        assert float(op2("f32.copysign", "f32", 3.0, -1.0)) == -3.0
+
+
+class TestF64:
+    def test_div(self):
+        assert float(op2("f64.div", "f64", 1.0, 3.0)) == 1.0 / 3.0
+
+    def test_trunc_floor_ceil(self):
+        assert float(op1("f64.trunc", "f64", "f64", -1.7)) == -1.0
+        assert float(op1("f64.floor", "f64", "f64", -1.2)) == -2.0
+        assert float(op1("f64.ceil", "f64", "f64", 1.2)) == 2.0
+
+
+class TestConversions:
+    def test_trunc_in_range(self):
+        assert op1("i32.trunc_f32_s", "f32", "i32", -2.9) == -2
+        assert op1("i32.trunc_f64_u", "f64", "i32", 4294967295.0) == -1
+
+    def test_trunc_nan_traps(self):
+        with pytest.raises(TrapError) as e:
+            op1("i32.trunc_f32_s", "f32", "i32", float("nan"))
+        assert e.value.code == ErrCode.InvalidConvToInt
+
+    def test_trunc_overflow_traps(self):
+        with pytest.raises(TrapError) as e:
+            op1("i32.trunc_f32_s", "f32", "i32", 2147483648.0)
+        assert e.value.code == ErrCode.IntegerOverflow
+        with pytest.raises(TrapError):
+            op1("i32.trunc_f64_s", "f64", "i32", -2147483649.0)
+        # boundary allowed
+        assert op1("i32.trunc_f64_s", "f64", "i32", -2147483648.0) == -(2**31)
+
+    def test_trunc_sat(self):
+        assert op1("i32.trunc_sat_f32_s", "f32", "i32", float("nan")) == 0
+        assert op1("i32.trunc_sat_f32_s", "f32", "i32", 1e10) == 2**31 - 1
+        assert op1("i32.trunc_sat_f32_s", "f32", "i32", -1e10) == -(2**31)
+        assert op1("i32.trunc_sat_f32_u", "f32", "i32", -5.0) == 0
+
+    def test_i64_trunc_f64(self):
+        assert op1("i64.trunc_f64_s", "f64", "i64", -9e15) == -9000000000000000
+        with pytest.raises(TrapError):
+            op1("i64.trunc_f64_s", "f64", "i64", 9.3e18)
+
+    def test_convert(self):
+        assert float(op1("f64.convert_i32_s", "i32", "f64", -42)) == -42.0
+        assert float(op1("f64.convert_i32_u", "i32", "f64", -1)) == 4294967295.0
+        assert float(op1("f32.convert_i32_s", "i32", "f32", 16777217)) == 16777216.0
+
+    def test_convert_i64_u_to_f64(self):
+        # 2^64 - 1 rounds to 2^64
+        assert float(op1("f64.convert_i64_u", "i64", "f64", -1)) == 2.0**64
+
+    def test_i64_to_f32_correct_rounding(self):
+        # 2^53 + 2^29 + 1: a via-f64 conversion double-rounds down to 2^53;
+        # the correctly-rounded single conversion gives 2^53 + 2^30.
+        v = (1 << 53) + (1 << 29) + 1
+        got = op1("f32.convert_i64_s", "i64", "f32", v)
+        assert float(got) == float((1 << 53) + (1 << 30))
+        assert float(got) != struct.unpack("<f", struct.pack("<f", float(v)))[0]
+
+    def test_wrap_extend(self):
+        assert op1("i32.wrap_i64", "i64", "i32", 0x1_FFFF_FFFF) == -1
+        assert op1("i64.extend_i32_s", "i32", "i64", -5) == -5
+        assert op1("i64.extend_i32_u", "i32", "i64", -5) == 0xFFFFFFFB
+
+    def test_demote_promote(self):
+        assert float(op1("f32.demote_f64", "f64", "f32", 1.0000000001)) == 1.0
+        assert float(op1("f64.promote_f32", "f32", "f64", 0.5)) == 0.5
+
+    def test_reinterpret(self):
+        assert op1("i32.reinterpret_f32", "f32", "i32", 1.0) == 0x3F800000
+        got = op1("f64.reinterpret_i64", "i64", "f64", f64bits(2.5) - 2**64)
+        assert float(got) == 2.5

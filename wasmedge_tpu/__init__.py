@@ -1,0 +1,25 @@
+"""tpu-wasm: a TPU-native WebAssembly runtime with WasmEdge's capabilities.
+
+Pipeline (mirrors the reference's Load -> Validate -> Instantiate -> Execute
+staging, /root/reference/include/vm/vm.h:241):
+
+  loader    : bytes -> AST (flat, branch-annotated instructions)
+  validator : type-check + lowering to a dense SoA bytecode image
+  executor  : scalar reference engine (oracle) over the lowered image
+  batch     : SIMT lockstep JAX/Pallas engine, thousands of lanes per chip
+  host      : WASI + process host modules (device lanes trap out to CPU)
+  vm        : VM facade + Configure-driven engine selection
+"""
+
+__version__ = "0.1.0"
+
+from wasmedge_tpu.common.configure import Configure, EngineKind
+from wasmedge_tpu.common.errors import ErrCode, TrapError, WasmError
+
+__all__ = [
+    "Configure",
+    "EngineKind",
+    "ErrCode",
+    "TrapError",
+    "WasmError",
+]

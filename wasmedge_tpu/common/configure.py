@@ -1,0 +1,115 @@
+"""Configure: proposals, host registrations, engine selection, runtime knobs.
+
+Mirrors the reference Configure (/root/reference/include/common/configure.h:
+173-260): a proposal bitset with the same defaults, host-registration set,
+and sub-configs. The TPU-native addition is `EngineKind` — the engine-switch
+seam the north star requires (interpreter / batch TPU / native scalar),
+playing the role of the reference's interpreter/AOT selection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+
+class Proposal(enum.Enum):
+    ImportExportMutGlobals = "mutable-globals"
+    NonTrapFloatToIntConversions = "nontrap-f2i"
+    SignExtensionOperators = "sign-extension"
+    MultiValue = "multi-value"
+    BulkMemoryOperations = "bulk-memory"
+    ReferenceTypes = "reference-types"
+    SIMD = "simd"
+    TailCall = "tail-call"
+    MultiMemories = "multi-memories"
+    Annotations = "annotations"
+    Memory64 = "memory64"
+    ExceptionHandling = "exception-handling"
+    Threads = "threads"
+    FunctionReferences = "function-references"
+
+    @property
+    def gate_name(self) -> str:
+        return self.value
+
+
+# Defaults match the reference (configure.h:175-183).
+DEFAULT_PROPOSALS = frozenset(
+    {
+        Proposal.ImportExportMutGlobals,
+        Proposal.NonTrapFloatToIntConversions,
+        Proposal.SignExtensionOperators,
+        Proposal.MultiValue,
+        Proposal.BulkMemoryOperations,
+        Proposal.ReferenceTypes,
+        Proposal.SIMD,
+    }
+)
+
+
+class HostRegistration(enum.Enum):
+    Wasi = "wasi"
+    WasmEdgeProcess = "wasmedge_process"
+
+
+class EngineKind(enum.Enum):
+    SCALAR = "scalar"  # Python reference interpreter (oracle)
+    NATIVE = "native"  # C++ scalar engine over the lowered image
+    TPU_BATCH = "tpu_batch"  # SIMT lockstep JAX/Pallas engine
+    AUTO = "auto"  # batch when module is batchable, else native/scalar
+
+
+@dataclasses.dataclass
+class RuntimeConfigure:
+    max_memory_pages: int = 65536
+    max_call_depth: int = 2048
+    max_value_stack: int = 65536
+
+
+@dataclasses.dataclass
+class StatisticsConfigure:
+    instr_counting: bool = False
+    cost_measuring: bool = False
+    time_measuring: bool = False
+    cost_limit: int = (1 << 64) - 1
+
+
+@dataclasses.dataclass
+class BatchConfigure:
+    """Knobs for the tpu_batch engine (no analog in the reference)."""
+
+    lanes: int = 4096  # instances per chip
+    value_stack_depth: int = 1024  # 64-bit slots per lane
+    call_stack_depth: int = 512  # frames per lane
+    memory_pages_per_lane: int = 1  # 64 KiB pages of linear memory per lane
+    steps_per_launch: int = 1024  # device steps per host-loop iteration
+    fuel_per_launch: Optional[int] = None  # per-lane fuel budget (gas analog)
+    uniform: bool = True  # converged-lane fast path (scalar PC dispatch)
+    interpret: bool = False  # run Pallas kernels in interpreter mode
+
+
+@dataclasses.dataclass
+class Configure:
+    proposals: set = dataclasses.field(default_factory=lambda: set(DEFAULT_PROPOSALS))
+    host_registrations: set = dataclasses.field(default_factory=set)
+    engine: EngineKind = EngineKind.AUTO
+    runtime: RuntimeConfigure = dataclasses.field(default_factory=RuntimeConfigure)
+    statistics: StatisticsConfigure = dataclasses.field(default_factory=StatisticsConfigure)
+    batch: BatchConfigure = dataclasses.field(default_factory=BatchConfigure)
+
+    def add_proposal(self, p: Proposal) -> "Configure":
+        self.proposals.add(p)
+        return self
+
+    def remove_proposal(self, p: Proposal) -> "Configure":
+        self.proposals.discard(p)
+        return self
+
+    def has_proposal(self, p: Proposal) -> bool:
+        return p in self.proposals
+
+    def proposal_gates(self) -> frozenset:
+        """Set of gate-name strings for loader/validator opcode gating."""
+        return frozenset(p.gate_name for p in self.proposals)

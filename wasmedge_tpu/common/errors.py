@@ -1,0 +1,150 @@
+"""Error codes and exception model.
+
+Mirrors the reference's ErrCode enum and Expect<T> discipline
+(/root/reference/include/common/enum.inc:573-749, include/common/errcode.h):
+every failure carries a stable ErrCode plus a human message. In Python we
+raise; the C API layer converts exceptions back to codes. Trap codes double
+as the per-lane trap values the batch engine stores in device state.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ErrCode(enum.IntEnum):
+    Success = 0x00
+    Terminated = 0x01  # stopped by user / StopToken
+
+    # Load phase
+    IllegalPath = 0x20
+    ReadError = 0x21
+    UnexpectedEnd = 0x22
+    MalformedMagic = 0x23
+    MalformedVersion = 0x24
+    MalformedSection = 0x25
+    SectionSizeMismatch = 0x26
+    LengthOutOfBounds = 0x27
+    JunkSection = 0x28
+    IncompatibleFuncCode = 0x29
+    IncompatibleDataCount = 0x2A
+    DataCountRequired = 0x2B
+    MalformedImportKind = 0x2C
+    MalformedExportKind = 0x2D
+    ExpectedZeroByte = 0x2E
+    InvalidMut = 0x2F
+    TooManyLocals = 0x30
+    MalformedValType = 0x31
+    MalformedElemType = 0x32
+    MalformedRefType = 0x33
+    MalformedUTF8 = 0x34
+    IntegerTooLarge = 0x35
+    IntegerTooLong = 0x36
+    IllegalOpCode = 0x37
+    IllegalGrammar = 0x38
+
+    # Validation phase
+    InvalidAlignment = 0x40
+    TypeCheckFailed = 0x41
+    InvalidLabelIdx = 0x42
+    InvalidLocalIdx = 0x43
+    InvalidFuncTypeIdx = 0x44
+    InvalidFuncIdx = 0x45
+    InvalidTableIdx = 0x46
+    InvalidMemoryIdx = 0x47
+    InvalidGlobalIdx = 0x48
+    InvalidElemIdx = 0x49
+    InvalidDataIdx = 0x4A
+    InvalidRefIdx = 0x4B
+    ConstExprRequired = 0x4C
+    DupExportName = 0x4D
+    ImmutableGlobal = 0x4E
+    InvalidResultArity = 0x4F
+    MultiTables = 0x50
+    MultiMemories = 0x51
+    InvalidLimit = 0x52
+    InvalidMemPages = 0x53
+    InvalidStartFunc = 0x54
+    InvalidLaneIdx = 0x55
+
+    # Instantiation phase
+    ModuleNameConflict = 0x60
+    IncompatibleImportType = 0x61
+    UnknownImport = 0x62
+    DataSegDoesNotFit = 0x63
+    ElemSegDoesNotFit = 0x64
+
+    # Execution phase (trap codes — these live in device lane state too)
+    WrongInstanceAddress = 0x80
+    WrongInstanceIndex = 0x81
+    InstrTypeMismatch = 0x82
+    FuncSigMismatch = 0x83
+    DivideByZero = 0x84
+    IntegerOverflow = 0x85
+    InvalidConvToInt = 0x86
+    TableOutOfBounds = 0x87
+    MemoryOutOfBounds = 0x88
+    Unreachable = 0x89
+    UninitializedElement = 0x8A
+    UndefinedElement = 0x8B
+    IndirectCallTypeMismatch = 0x8C
+    HostFuncFailed = 0x8D
+    RefTypeMismatch = 0x8E
+    UnalignedAtomicAccess = 0x8F
+    CallStackExhausted = 0x90
+    StackOverflow = 0x91
+    CostLimitExceeded = 0x92  # gas / fuel exhausted
+    WrongVMWorkflow = 0x93
+    FuncNotFound = 0x94
+    ExecutionFailed = 0x95
+    NotValidated = 0x96
+
+
+# Spec-test-compatible trap messages (the conformance harness matches these,
+# reference: lib/common/errinfo.cpp + test/spec/spectest.cpp:150-210).
+TRAP_MESSAGES = {
+    ErrCode.DivideByZero: "integer divide by zero",
+    ErrCode.IntegerOverflow: "integer overflow",
+    ErrCode.InvalidConvToInt: "invalid conversion to integer",
+    ErrCode.TableOutOfBounds: "out of bounds table access",
+    ErrCode.MemoryOutOfBounds: "out of bounds memory access",
+    ErrCode.Unreachable: "unreachable",
+    ErrCode.UninitializedElement: "uninitialized element",
+    ErrCode.UndefinedElement: "undefined element",
+    ErrCode.IndirectCallTypeMismatch: "indirect call type mismatch",
+    ErrCode.CallStackExhausted: "call stack exhausted",
+    ErrCode.CostLimitExceeded: "cost limit exceeded",
+    ErrCode.FuncSigMismatch: "function signature mismatch",
+}
+
+
+class WasmError(Exception):
+    """Base for all phase errors; carries an ErrCode."""
+
+    def __init__(self, code: ErrCode, msg: str = "", offset: int | None = None):
+        self.code = ErrCode(code)
+        self.offset = offset
+        text = msg or TRAP_MESSAGES.get(self.code, self.code.name)
+        if offset is not None:
+            text = f"{text} (at byte offset 0x{offset:x})"
+        super().__init__(text)
+
+
+class LoadError(WasmError):
+    pass
+
+
+class ValidationError(WasmError):
+    pass
+
+
+class InstantiationError(WasmError):
+    pass
+
+
+class TrapError(WasmError):
+    """Runtime trap: unwinds execution, maps 1:1 to a per-lane trap code."""
+
+
+def trap(code: ErrCode, msg: str = ""):
+    raise TrapError(code, msg)

@@ -1,0 +1,290 @@
+"""WebAssembly opcode table — single source of truth.
+
+Mirrors the reference's X-macro enum table (/root/reference/include/common/
+enum.inc:54-541) but as a declarative Python table carrying, per opcode:
+
+  name      canonical spec name ("i32.add")
+  page      opcode page: 0 = 1-byte, 0xFC = saturating/bulk page, 0xFD = SIMD
+  code      opcode byte (or LEB sub-opcode for 0xFC/0xFD pages)
+  imm       immediate kind consumed by the loader
+  sig       value signature "pops->pushes" for plain (non-control) ops,
+            using i=i32 I=i64 f=f32 F=f64 V=v128 r=funcref e=externref;
+            None for ops whose typing needs context (control/var/mem idx ops).
+  proposal  gating proposal name or None for MVP
+
+The dense integer id of each opcode (its index in OPCODES) is what the
+lowering stage and both engines use; the wire (page, code) pair only exists
+in the loader.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+
+class OpInfo(NamedTuple):
+    name: str
+    page: int
+    code: int
+    imm: str  # none|blocktype|labelidx|brtable|funcidx|typeidx_tableidx|
+    #           localidx|globalidx|tableidx|tableidx2|elemidx_tableidx|
+    #           refnull|select_t|memarg|memidx|dataidx_memidx|memidx2|
+    #           dataidx|elemidx|i32|i64|f32|f64|funcref
+    sig: Optional[str]
+    proposal: Optional[str] = None
+
+
+def _op(name, page, code, imm="none", sig=None, proposal=None):
+    return OpInfo(name, page, code, imm, sig, proposal)
+
+
+# fmt: off
+_TABLE = [
+    # ---- control (typing handled specially by the validator) ----
+    _op("unreachable",        0, 0x00),
+    _op("nop",                0, 0x01),
+    _op("block",              0, 0x02, "blocktype"),
+    _op("loop",               0, 0x03, "blocktype"),
+    _op("if",                 0, 0x04, "blocktype"),
+    _op("else",               0, 0x05),
+    _op("end",                0, 0x0B),
+    _op("br",                 0, 0x0C, "labelidx"),
+    _op("br_if",              0, 0x0D, "labelidx"),
+    _op("br_table",           0, 0x0E, "brtable"),
+    _op("return",             0, 0x0F),
+    _op("call",               0, 0x10, "funcidx"),
+    _op("call_indirect",      0, 0x11, "typeidx_tableidx"),
+    _op("return_call",        0, 0x12, "funcidx", proposal="tail-call"),
+    _op("return_call_indirect", 0, 0x13, "typeidx_tableidx", proposal="tail-call"),
+    # ---- reference types ----
+    _op("ref.null",           0, 0xD0, "refnull"),
+    _op("ref.is_null",        0, 0xD1),
+    _op("ref.func",           0, 0xD2, "funcidx"),
+    # ---- parametric ----
+    _op("drop",               0, 0x1A),
+    _op("select",             0, 0x1B),
+    _op("select_t",           0, 0x1C, "select_t"),
+    # ---- variable ----
+    _op("local.get",          0, 0x20, "localidx"),
+    _op("local.set",          0, 0x21, "localidx"),
+    _op("local.tee",          0, 0x22, "localidx"),
+    _op("global.get",         0, 0x23, "globalidx"),
+    _op("global.set",         0, 0x24, "globalidx"),
+    # ---- table ----
+    _op("table.get",          0, 0x25, "tableidx"),
+    _op("table.set",          0, 0x26, "tableidx"),
+    # ---- memory ----
+    _op("i32.load",           0, 0x28, "memarg", "i->i"),
+    _op("i64.load",           0, 0x29, "memarg", "i->I"),
+    _op("f32.load",           0, 0x2A, "memarg", "i->f"),
+    _op("f64.load",           0, 0x2B, "memarg", "i->F"),
+    _op("i32.load8_s",        0, 0x2C, "memarg", "i->i"),
+    _op("i32.load8_u",        0, 0x2D, "memarg", "i->i"),
+    _op("i32.load16_s",       0, 0x2E, "memarg", "i->i"),
+    _op("i32.load16_u",       0, 0x2F, "memarg", "i->i"),
+    _op("i64.load8_s",        0, 0x30, "memarg", "i->I"),
+    _op("i64.load8_u",        0, 0x31, "memarg", "i->I"),
+    _op("i64.load16_s",       0, 0x32, "memarg", "i->I"),
+    _op("i64.load16_u",       0, 0x33, "memarg", "i->I"),
+    _op("i64.load32_s",       0, 0x34, "memarg", "i->I"),
+    _op("i64.load32_u",       0, 0x35, "memarg", "i->I"),
+    _op("i32.store",          0, 0x36, "memarg", "ii->"),
+    _op("i64.store",          0, 0x37, "memarg", "iI->"),
+    _op("f32.store",          0, 0x38, "memarg", "if->"),
+    _op("f64.store",          0, 0x39, "memarg", "iF->"),
+    _op("i32.store8",         0, 0x3A, "memarg", "ii->"),
+    _op("i32.store16",        0, 0x3B, "memarg", "ii->"),
+    _op("i64.store8",         0, 0x3C, "memarg", "iI->"),
+    _op("i64.store16",        0, 0x3D, "memarg", "iI->"),
+    _op("i64.store32",        0, 0x3E, "memarg", "iI->"),
+    _op("memory.size",        0, 0x3F, "memidx", "->i"),
+    _op("memory.grow",        0, 0x40, "memidx", "i->i"),
+    # ---- const ----
+    _op("i32.const",          0, 0x41, "i32", "->i"),
+    _op("i64.const",          0, 0x42, "i64", "->I"),
+    _op("f32.const",          0, 0x43, "f32", "->f"),
+    _op("f64.const",          0, 0x44, "f64", "->F"),
+    # ---- i32 compare ----
+    _op("i32.eqz",            0, 0x45, "none", "i->i"),
+    _op("i32.eq",             0, 0x46, "none", "ii->i"),
+    _op("i32.ne",             0, 0x47, "none", "ii->i"),
+    _op("i32.lt_s",           0, 0x48, "none", "ii->i"),
+    _op("i32.lt_u",           0, 0x49, "none", "ii->i"),
+    _op("i32.gt_s",           0, 0x4A, "none", "ii->i"),
+    _op("i32.gt_u",           0, 0x4B, "none", "ii->i"),
+    _op("i32.le_s",           0, 0x4C, "none", "ii->i"),
+    _op("i32.le_u",           0, 0x4D, "none", "ii->i"),
+    _op("i32.ge_s",           0, 0x4E, "none", "ii->i"),
+    _op("i32.ge_u",           0, 0x4F, "none", "ii->i"),
+    # ---- i64 compare ----
+    _op("i64.eqz",            0, 0x50, "none", "I->i"),
+    _op("i64.eq",             0, 0x51, "none", "II->i"),
+    _op("i64.ne",             0, 0x52, "none", "II->i"),
+    _op("i64.lt_s",           0, 0x53, "none", "II->i"),
+    _op("i64.lt_u",           0, 0x54, "none", "II->i"),
+    _op("i64.gt_s",           0, 0x55, "none", "II->i"),
+    _op("i64.gt_u",           0, 0x56, "none", "II->i"),
+    _op("i64.le_s",           0, 0x57, "none", "II->i"),
+    _op("i64.le_u",           0, 0x58, "none", "II->i"),
+    _op("i64.ge_s",           0, 0x59, "none", "II->i"),
+    _op("i64.ge_u",           0, 0x5A, "none", "II->i"),
+    # ---- f32 compare ----
+    _op("f32.eq",             0, 0x5B, "none", "ff->i"),
+    _op("f32.ne",             0, 0x5C, "none", "ff->i"),
+    _op("f32.lt",             0, 0x5D, "none", "ff->i"),
+    _op("f32.gt",             0, 0x5E, "none", "ff->i"),
+    _op("f32.le",             0, 0x5F, "none", "ff->i"),
+    _op("f32.ge",             0, 0x60, "none", "ff->i"),
+    # ---- f64 compare ----
+    _op("f64.eq",             0, 0x61, "none", "FF->i"),
+    _op("f64.ne",             0, 0x62, "none", "FF->i"),
+    _op("f64.lt",             0, 0x63, "none", "FF->i"),
+    _op("f64.gt",             0, 0x64, "none", "FF->i"),
+    _op("f64.le",             0, 0x65, "none", "FF->i"),
+    _op("f64.ge",             0, 0x66, "none", "FF->i"),
+    # ---- i32 numeric ----
+    _op("i32.clz",            0, 0x67, "none", "i->i"),
+    _op("i32.ctz",            0, 0x68, "none", "i->i"),
+    _op("i32.popcnt",         0, 0x69, "none", "i->i"),
+    _op("i32.add",            0, 0x6A, "none", "ii->i"),
+    _op("i32.sub",            0, 0x6B, "none", "ii->i"),
+    _op("i32.mul",            0, 0x6C, "none", "ii->i"),
+    _op("i32.div_s",          0, 0x6D, "none", "ii->i"),
+    _op("i32.div_u",          0, 0x6E, "none", "ii->i"),
+    _op("i32.rem_s",          0, 0x6F, "none", "ii->i"),
+    _op("i32.rem_u",          0, 0x70, "none", "ii->i"),
+    _op("i32.and",            0, 0x71, "none", "ii->i"),
+    _op("i32.or",             0, 0x72, "none", "ii->i"),
+    _op("i32.xor",            0, 0x73, "none", "ii->i"),
+    _op("i32.shl",            0, 0x74, "none", "ii->i"),
+    _op("i32.shr_s",          0, 0x75, "none", "ii->i"),
+    _op("i32.shr_u",          0, 0x76, "none", "ii->i"),
+    _op("i32.rotl",           0, 0x77, "none", "ii->i"),
+    _op("i32.rotr",           0, 0x78, "none", "ii->i"),
+    # ---- i64 numeric ----
+    _op("i64.clz",            0, 0x79, "none", "I->I"),
+    _op("i64.ctz",            0, 0x7A, "none", "I->I"),
+    _op("i64.popcnt",         0, 0x7B, "none", "I->I"),
+    _op("i64.add",            0, 0x7C, "none", "II->I"),
+    _op("i64.sub",            0, 0x7D, "none", "II->I"),
+    _op("i64.mul",            0, 0x7E, "none", "II->I"),
+    _op("i64.div_s",          0, 0x7F, "none", "II->I"),
+    _op("i64.div_u",          0, 0x80, "none", "II->I"),
+    _op("i64.rem_s",          0, 0x81, "none", "II->I"),
+    _op("i64.rem_u",          0, 0x82, "none", "II->I"),
+    _op("i64.and",            0, 0x83, "none", "II->I"),
+    _op("i64.or",             0, 0x84, "none", "II->I"),
+    _op("i64.xor",            0, 0x85, "none", "II->I"),
+    _op("i64.shl",            0, 0x86, "none", "II->I"),
+    _op("i64.shr_s",          0, 0x87, "none", "II->I"),
+    _op("i64.shr_u",          0, 0x88, "none", "II->I"),
+    _op("i64.rotl",           0, 0x89, "none", "II->I"),
+    _op("i64.rotr",           0, 0x8A, "none", "II->I"),
+    # ---- f32 numeric ----
+    _op("f32.abs",            0, 0x8B, "none", "f->f"),
+    _op("f32.neg",            0, 0x8C, "none", "f->f"),
+    _op("f32.ceil",           0, 0x8D, "none", "f->f"),
+    _op("f32.floor",          0, 0x8E, "none", "f->f"),
+    _op("f32.trunc",          0, 0x8F, "none", "f->f"),
+    _op("f32.nearest",        0, 0x90, "none", "f->f"),
+    _op("f32.sqrt",           0, 0x91, "none", "f->f"),
+    _op("f32.add",            0, 0x92, "none", "ff->f"),
+    _op("f32.sub",            0, 0x93, "none", "ff->f"),
+    _op("f32.mul",            0, 0x94, "none", "ff->f"),
+    _op("f32.div",            0, 0x95, "none", "ff->f"),
+    _op("f32.min",            0, 0x96, "none", "ff->f"),
+    _op("f32.max",            0, 0x97, "none", "ff->f"),
+    _op("f32.copysign",       0, 0x98, "none", "ff->f"),
+    # ---- f64 numeric ----
+    _op("f64.abs",            0, 0x99, "none", "F->F"),
+    _op("f64.neg",            0, 0x9A, "none", "F->F"),
+    _op("f64.ceil",           0, 0x9B, "none", "F->F"),
+    _op("f64.floor",          0, 0x9C, "none", "F->F"),
+    _op("f64.trunc",          0, 0x9D, "none", "F->F"),
+    _op("f64.nearest",        0, 0x9E, "none", "F->F"),
+    _op("f64.sqrt",           0, 0x9F, "none", "F->F"),
+    _op("f64.add",            0, 0xA0, "none", "FF->F"),
+    _op("f64.sub",            0, 0xA1, "none", "FF->F"),
+    _op("f64.mul",            0, 0xA2, "none", "FF->F"),
+    _op("f64.div",            0, 0xA3, "none", "FF->F"),
+    _op("f64.min",            0, 0xA4, "none", "FF->F"),
+    _op("f64.max",            0, 0xA5, "none", "FF->F"),
+    _op("f64.copysign",       0, 0xA6, "none", "FF->F"),
+    # ---- conversions ----
+    _op("i32.wrap_i64",       0, 0xA7, "none", "I->i"),
+    _op("i32.trunc_f32_s",    0, 0xA8, "none", "f->i"),
+    _op("i32.trunc_f32_u",    0, 0xA9, "none", "f->i"),
+    _op("i32.trunc_f64_s",    0, 0xAA, "none", "F->i"),
+    _op("i32.trunc_f64_u",    0, 0xAB, "none", "F->i"),
+    _op("i64.extend_i32_s",   0, 0xAC, "none", "i->I"),
+    _op("i64.extend_i32_u",   0, 0xAD, "none", "i->I"),
+    _op("i64.trunc_f32_s",    0, 0xAE, "none", "f->I"),
+    _op("i64.trunc_f32_u",    0, 0xAF, "none", "f->I"),
+    _op("i64.trunc_f64_s",    0, 0xB0, "none", "F->I"),
+    _op("i64.trunc_f64_u",    0, 0xB1, "none", "F->I"),
+    _op("f32.convert_i32_s",  0, 0xB2, "none", "i->f"),
+    _op("f32.convert_i32_u",  0, 0xB3, "none", "i->f"),
+    _op("f32.convert_i64_s",  0, 0xB4, "none", "I->f"),
+    _op("f32.convert_i64_u",  0, 0xB5, "none", "I->f"),
+    _op("f32.demote_f64",     0, 0xB6, "none", "F->f"),
+    _op("f64.convert_i32_s",  0, 0xB7, "none", "i->F"),
+    _op("f64.convert_i32_u",  0, 0xB8, "none", "i->F"),
+    _op("f64.convert_i64_s",  0, 0xB9, "none", "I->F"),
+    _op("f64.convert_i64_u",  0, 0xBA, "none", "I->F"),
+    _op("f64.promote_f32",    0, 0xBB, "none", "f->F"),
+    _op("i32.reinterpret_f32", 0, 0xBC, "none", "f->i"),
+    _op("i64.reinterpret_f64", 0, 0xBD, "none", "F->I"),
+    _op("f32.reinterpret_i32", 0, 0xBE, "none", "i->f"),
+    _op("f64.reinterpret_i64", 0, 0xBF, "none", "I->F"),
+    # ---- sign extension (proposal on by default, like the reference) ----
+    _op("i32.extend8_s",      0, 0xC0, "none", "i->i", "sign-extension"),
+    _op("i32.extend16_s",     0, 0xC1, "none", "i->i", "sign-extension"),
+    _op("i64.extend8_s",      0, 0xC2, "none", "I->I", "sign-extension"),
+    _op("i64.extend16_s",     0, 0xC3, "none", "I->I", "sign-extension"),
+    _op("i64.extend32_s",     0, 0xC4, "none", "I->I", "sign-extension"),
+    # ---- 0xFC page: non-trapping float->int ----
+    _op("i32.trunc_sat_f32_s", 0xFC, 0, "none", "f->i", "nontrap-f2i"),
+    _op("i32.trunc_sat_f32_u", 0xFC, 1, "none", "f->i", "nontrap-f2i"),
+    _op("i32.trunc_sat_f64_s", 0xFC, 2, "none", "F->i", "nontrap-f2i"),
+    _op("i32.trunc_sat_f64_u", 0xFC, 3, "none", "F->i", "nontrap-f2i"),
+    _op("i64.trunc_sat_f32_s", 0xFC, 4, "none", "f->I", "nontrap-f2i"),
+    _op("i64.trunc_sat_f32_u", 0xFC, 5, "none", "f->I", "nontrap-f2i"),
+    _op("i64.trunc_sat_f64_s", 0xFC, 6, "none", "F->I", "nontrap-f2i"),
+    _op("i64.trunc_sat_f64_u", 0xFC, 7, "none", "F->I", "nontrap-f2i"),
+    # ---- 0xFC page: bulk memory ----
+    _op("memory.init",        0xFC, 8,  "dataidx_memidx", "iii->", "bulk-memory"),
+    _op("data.drop",          0xFC, 9,  "dataidx", "->", "bulk-memory"),
+    _op("memory.copy",        0xFC, 10, "memidx2", "iii->", "bulk-memory"),
+    _op("memory.fill",        0xFC, 11, "memidx", "iii->", "bulk-memory"),
+    _op("table.init",         0xFC, 12, "elemidx_tableidx", "iii->", "bulk-memory"),
+    _op("elem.drop",          0xFC, 13, "elemidx", "->", "bulk-memory"),
+    _op("table.copy",         0xFC, 14, "tableidx2", "iii->", "bulk-memory"),
+    _op("table.grow",         0xFC, 15, "tableidx", None, "reference-types"),
+    _op("table.size",         0xFC, 16, "tableidx", "->i", "reference-types"),
+    _op("table.fill",         0xFC, 17, "tableidx", None, "reference-types"),
+]
+# fmt: on
+
+OPCODES: tuple = tuple(_TABLE)
+
+# Dense id assignment: index in OPCODES.
+NAME_TO_ID = {info.name: i for i, info in enumerate(OPCODES)}
+WIRE_TO_ID = {(info.page, info.code): i for i, info in enumerate(OPCODES)}
+
+
+class Op:
+    """Dense opcode ids as attributes: Op.i32_add etc."""
+
+
+for _i, _info in enumerate(OPCODES):
+    setattr(Op, _info.name.replace(".", "_"), _i)
+
+NUM_OPCODES = len(OPCODES)
+
+
+def name_of(op_id: int) -> str:
+    return OPCODES[op_id].name
+
+
+def info_of(op_id: int) -> OpInfo:
+    return OPCODES[op_id]

@@ -1,0 +1,3 @@
+from wasmedge_tpu.executor.executor import Executor
+
+__all__ = ["Executor"]

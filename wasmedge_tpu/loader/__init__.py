@@ -1,0 +1,3 @@
+from wasmedge_tpu.loader.loader import Loader
+
+__all__ = ["Loader"]

@@ -1,0 +1,215 @@
+"""AST node definitions.
+
+Mirrors the reference AST (/root/reference/include/ast/): a Module with the
+13 section kinds, and — the critical design point SURVEY.md §2.2 calls out —
+a *flat post-decode instruction* list per function body: `block`/`loop`/`if`
+carry relative jump distances precomputed at decode time (reference:
+lib/loader/ast/instruction.cpp:38-96), so no later stage ever re-scans for
+`end`.
+
+Instruction is a small record: dense opcode id + immediate fields. The
+validator lowers these further into SoA arrays (see validator/lowering).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from wasmedge_tpu.common.types import ValType
+
+
+@dataclasses.dataclass
+class FunctionType:
+    params: Tuple[ValType, ...]
+    results: Tuple[ValType, ...]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, FunctionType)
+            and self.params == other.params
+            and self.results == other.results
+        )
+
+    def __hash__(self):
+        return hash((self.params, self.results))
+
+
+@dataclasses.dataclass
+class Limit:
+    min: int
+    max: Optional[int] = None
+    shared: bool = False
+
+
+@dataclasses.dataclass
+class TableType:
+    ref_type: ValType
+    limit: Limit
+
+
+@dataclasses.dataclass
+class MemoryType:
+    limit: Limit
+
+
+@dataclasses.dataclass
+class GlobalType:
+    val_type: ValType
+    mutable: bool
+
+
+@dataclasses.dataclass
+class Instruction:
+    """Flat decoded instruction (reference: include/ast/instruction.h:27-274).
+
+    Immediate fields by kind:
+      block/loop/if : block_type (int typeidx | ValType | None), jump_end,
+                      jump_else (if only) — relative distances, set at decode
+      br/br_if      : target_idx (label depth); jump descriptor filled by
+                      the validator
+      br_table      : targets list + default, descriptors by validator
+      call          : target_idx = funcidx
+      call_indirect : target_idx = typeidx, source_idx = tableidx
+      local/global/table ops: target_idx (+ source_idx for table.copy/init)
+      memory ops    : mem_align, mem_offset, target_idx/source_idx mem/data idx
+      const         : imm = raw bit pattern (int)
+      ref.null      : ref_type
+      select_t      : val_types list
+    """
+
+    op: int  # dense opcode id (common.opcodes)
+    offset: int = 0  # byte offset in the original binary (error reporting)
+    block_type: object = None
+    jump_end: int = 0
+    jump_else: int = 0
+    target_idx: int = 0
+    source_idx: int = 0
+    mem_align: int = 0
+    mem_offset: int = 0
+    imm: int = 0
+    targets: Optional[List[int]] = None
+    ref_type: Optional[ValType] = None
+    val_types: Optional[List[ValType]] = None
+
+
+@dataclasses.dataclass
+class ImportDesc:
+    module: str
+    name: str
+    kind: int  # 0 func, 1 table, 2 mem, 3 global
+    type_idx: int = 0  # for funcs
+    table_type: Optional[TableType] = None
+    memory_type: Optional[MemoryType] = None
+    global_type: Optional[GlobalType] = None
+
+
+@dataclasses.dataclass
+class ExportDesc:
+    name: str
+    kind: int  # 0 func, 1 table, 2 mem, 3 global
+    index: int
+
+
+@dataclasses.dataclass
+class GlobalSegment:
+    type: GlobalType
+    init: List[Instruction]
+
+
+@dataclasses.dataclass
+class ElementSegment:
+    mode: int  # 0 active, 1 passive, 2 declarative
+    table_idx: int
+    offset: Optional[List[Instruction]]  # const expr for active
+    ref_type: ValType
+    init_exprs: List[List[Instruction]]  # one const expr per element
+
+
+@dataclasses.dataclass
+class DataSegment:
+    mode: int  # 0 active, 1 passive
+    memory_idx: int
+    offset: Optional[List[Instruction]]
+    data: bytes
+
+
+@dataclasses.dataclass
+class CodeSegment:
+    locals: List[Tuple[int, ValType]]  # (count, type) runs
+    body: List[Instruction]
+    size: int = 0
+
+
+@dataclasses.dataclass
+class CustomSection:
+    name: str
+    data: bytes
+
+
+@dataclasses.dataclass
+class Module:
+    types: List[FunctionType] = dataclasses.field(default_factory=list)
+    imports: List[ImportDesc] = dataclasses.field(default_factory=list)
+    functions: List[int] = dataclasses.field(default_factory=list)  # typeidx
+    tables: List[TableType] = dataclasses.field(default_factory=list)
+    memories: List[MemoryType] = dataclasses.field(default_factory=list)
+    globals: List[GlobalSegment] = dataclasses.field(default_factory=list)
+    exports: List[ExportDesc] = dataclasses.field(default_factory=list)
+    start: Optional[int] = None
+    elements: List[ElementSegment] = dataclasses.field(default_factory=list)
+    codes: List[CodeSegment] = dataclasses.field(default_factory=list)
+    datas: List[DataSegment] = dataclasses.field(default_factory=list)
+    data_count: Optional[int] = None
+    customs: List[CustomSection] = dataclasses.field(default_factory=list)
+    validated: bool = False
+    lowered: object = None  # LoweredModule attached by the validator
+
+    # -- import accessors (reference: include/ast/module.h import counting) --
+    # Imports are immutable after loading, so the kind-filtered views are
+    # cached (validation calls func_type_of per call-site).
+    _imported_funcs_cache: object = None
+
+    def imported_funcs(self) -> List[ImportDesc]:
+        if self._imported_funcs_cache is None:
+            self._imported_funcs_cache = [im for im in self.imports if im.kind == 0]
+        return self._imported_funcs_cache
+
+    def imported_tables(self) -> List[ImportDesc]:
+        return [im for im in self.imports if im.kind == 1]
+
+    def imported_memories(self) -> List[ImportDesc]:
+        return [im for im in self.imports if im.kind == 2]
+
+    def imported_globals(self) -> List[ImportDesc]:
+        return [im for im in self.imports if im.kind == 3]
+
+    @property
+    def num_imported_funcs(self) -> int:
+        return len(self.imported_funcs())
+
+    def func_type_of(self, func_idx: int) -> FunctionType:
+        """FunctionType for a function index (imports first, then local)."""
+        nimp = self.num_imported_funcs
+        if func_idx < nimp:
+            return self.types[self.imported_funcs()[func_idx].type_idx]
+        return self.types[self.functions[func_idx - nimp]]
+
+    @property
+    def total_funcs(self) -> int:
+        return self.num_imported_funcs + len(self.functions)
+
+    def all_table_types(self) -> List[TableType]:
+        return [im.table_type for im in self.imported_tables()] + self.tables
+
+    def all_memory_types(self) -> List[MemoryType]:
+        return [im.memory_type for im in self.imported_memories()] + self.memories
+
+    def all_global_types(self) -> List[GlobalType]:
+        return [im.global_type for im in self.imported_globals()] + [
+            g.type for g in self.globals
+        ]
+
+    @property
+    def num_imported_globals(self) -> int:
+        return len(self.imported_globals())

@@ -1,0 +1,134 @@
+"""Byte/LEB128 reader over an in-memory buffer.
+
+Mirrors the reference FileMgr (/root/reference/include/loader/filemgr.h:31-60,
+lib/loader/filemgr.cpp): offset-tracked reads with strict LEB128 validation
+(IntegerTooLong for over-length encodings, IntegerTooLarge for unused-bit
+violations, UnexpectedEnd on truncation) so malformed-module spec tests get
+the same error classes.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from wasmedge_tpu.common.errors import ErrCode, LoadError
+
+
+class FileMgr:
+    __slots__ = ("data", "pos", "end")
+
+    def __init__(self, data: bytes, start: int = 0, end: int | None = None):
+        self.data = data
+        self.pos = start
+        self.end = len(data) if end is None else end
+
+    def remaining(self) -> int:
+        return self.end - self.pos
+
+    def at_end(self) -> bool:
+        return self.pos >= self.end
+
+    def _need(self, n: int):
+        if self.pos + n > self.end:
+            raise LoadError(ErrCode.UnexpectedEnd, offset=self.pos)
+
+    def read_byte(self) -> int:
+        self._need(1)
+        b = self.data[self.pos]
+        self.pos += 1
+        return b
+
+    def peek_byte(self) -> int:
+        self._need(1)
+        return self.data[self.pos]
+
+    def read_bytes(self, n: int) -> bytes:
+        if n < 0:
+            raise LoadError(ErrCode.LengthOutOfBounds, offset=self.pos)
+        self._need(n)
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def read_u32_raw(self) -> int:
+        self._need(4)
+        (v,) = struct.unpack_from("<I", self.data, self.pos)
+        self.pos += 4
+        return v
+
+    def _read_uleb(self, max_bits: int) -> int:
+        result = 0
+        shift = 0
+        max_bytes = (max_bits + 6) // 7
+        for i in range(max_bytes):
+            b = self.read_byte()
+            result |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                # Unused bits in the final byte must be zero.
+                if i == max_bytes - 1:
+                    unused = 7 - (max_bits - 7 * (max_bytes - 1))
+                    if unused > 0 and (b & 0x7F) >> (7 - unused):
+                        raise LoadError(ErrCode.IntegerTooLarge, offset=self.pos - 1)
+                return result
+            shift += 7
+        raise LoadError(ErrCode.IntegerTooLong, offset=self.pos - 1)
+
+    def _read_sleb(self, max_bits: int) -> int:
+        result = 0
+        shift = 0
+        max_bytes = (max_bits + 6) // 7
+        for i in range(max_bytes):
+            b = self.read_byte()
+            result |= (b & 0x7F) << shift
+            shift += 7
+            if not (b & 0x80):
+                if i == max_bytes - 1:
+                    # Final byte: sign bits beyond max_bits must agree.
+                    used = max_bits - 7 * (max_bytes - 1)
+                    payload = b & 0x7F
+                    sign_bit = (payload >> (used - 1)) & 1
+                    mask = (0x7F >> used) << used
+                    high = payload & mask
+                    if sign_bit and high != mask:
+                        raise LoadError(ErrCode.IntegerTooLarge, offset=self.pos - 1)
+                    if not sign_bit and high != 0:
+                        raise LoadError(ErrCode.IntegerTooLarge, offset=self.pos - 1)
+                if b & 0x40:
+                    result |= -(1 << shift)
+                return result
+        raise LoadError(ErrCode.IntegerTooLong, offset=self.pos - 1)
+
+    def read_u32(self) -> int:
+        return self._read_uleb(32)
+
+    def read_u64(self) -> int:
+        return self._read_uleb(64)
+
+    def read_s32(self) -> int:
+        return self._read_sleb(32)
+
+    def read_s33(self) -> int:
+        return self._read_sleb(33)
+
+    def read_s64(self) -> int:
+        return self._read_sleb(64)
+
+    def read_f32_bits(self) -> int:
+        self._need(4)
+        (v,) = struct.unpack_from("<I", self.data, self.pos)
+        self.pos += 4
+        return v
+
+    def read_f64_bits(self) -> int:
+        self._need(8)
+        (v,) = struct.unpack_from("<Q", self.data, self.pos)
+        self.pos += 8
+        return v
+
+    def read_name(self) -> str:
+        n = self.read_u32()
+        raw = self.read_bytes(n)
+        try:
+            return raw.decode("utf-8", errors="strict")
+        except UnicodeDecodeError:
+            raise LoadError(ErrCode.MalformedUTF8, offset=self.pos)

@@ -1,0 +1,374 @@
+"""Binary .wasm -> AST loader.
+
+Mirrors the reference Loader pipeline (/root/reference/lib/loader/
+loader.cpp:64-135 header dispatch; lib/loader/ast/*.cpp section loaders).
+Decodes all 13 section kinds, validates section ordering and size
+cross-checks, applies proposal gating per opcode/type at load time
+(reference: loader.cpp:167-216), and precomputes block jump distances via a
+block stack during instruction decode (lib/loader/ast/instruction.cpp:38-96).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.common.errors import ErrCode, LoadError
+from wasmedge_tpu.common.opcodes import OPCODES, WIRE_TO_ID, Op
+from wasmedge_tpu.common.types import ValType
+from wasmedge_tpu.loader import ast
+from wasmedge_tpu.loader.filemgr import FileMgr
+
+MAGIC = b"\x00asm"
+VERSION = b"\x01\x00\x00\x00"
+
+_NUM_TYPES = {0x7F: ValType.I32, 0x7E: ValType.I64, 0x7D: ValType.F32, 0x7C: ValType.F64}
+_REF_TYPES = {0x70: ValType.FuncRef, 0x6F: ValType.ExternRef}
+
+# Section ids in required order (custom sections may appear anywhere).
+_SECTION_ORDER = [1, 2, 3, 4, 5, 6, 7, 8, 9, 12, 10, 11]
+
+
+class Loader:
+    def __init__(self, conf: Optional[Configure] = None):
+        self.conf = conf or Configure()
+        self.gates = self.conf.proposal_gates()
+
+    # -- public entry ------------------------------------------------------
+    def parse_module(self, data: bytes) -> ast.Module:
+        fm = FileMgr(data)
+        if fm.read_bytes(4) != MAGIC:
+            raise LoadError(ErrCode.MalformedMagic, offset=0)
+        if fm.read_bytes(4) != VERSION:
+            raise LoadError(ErrCode.MalformedVersion, offset=4)
+        mod = ast.Module()
+        last_order = -1
+        code_count_seen = 0
+        while not fm.at_end():
+            sec_id = fm.read_byte()
+            sec_size = fm.read_u32()
+            if sec_size > fm.remaining():
+                raise LoadError(ErrCode.LengthOutOfBounds, offset=fm.pos)
+            sec_end = fm.pos + sec_size
+            sub = FileMgr(fm.data, fm.pos, sec_end)
+            if sec_id == 0:
+                name = sub.read_name()
+                mod.customs.append(ast.CustomSection(name, sub.data[sub.pos : sec_end]))
+            else:
+                if sec_id not in _SECTION_ORDER:
+                    raise LoadError(ErrCode.MalformedSection, offset=fm.pos)
+                order = _SECTION_ORDER.index(sec_id)
+                if order <= last_order:
+                    raise LoadError(ErrCode.JunkSection, offset=fm.pos)
+                last_order = order
+                self._load_section(sec_id, sub, mod)
+                if sub.pos != sec_end:
+                    raise LoadError(ErrCode.SectionSizeMismatch, offset=sub.pos)
+                if sec_id == 10:
+                    code_count_seen = len(mod.codes)
+            fm.pos = sec_end
+        if len(mod.functions) != code_count_seen:
+            raise LoadError(ErrCode.IncompatibleFuncCode, offset=fm.pos)
+        if mod.data_count is not None and mod.data_count != len(mod.datas):
+            raise LoadError(ErrCode.IncompatibleDataCount, offset=fm.pos)
+        return mod
+
+    def parse_file(self, path: str) -> ast.Module:
+        with open(path, "rb") as f:
+            return self.parse_module(f.read())
+
+    # -- sections ----------------------------------------------------------
+    def _load_section(self, sec_id: int, fm: FileMgr, mod: ast.Module):
+        if sec_id == 1:
+            mod.types = [self._load_functype(fm) for _ in range(fm.read_u32())]
+        elif sec_id == 2:
+            mod.imports = [self._load_import(fm) for _ in range(fm.read_u32())]
+        elif sec_id == 3:
+            mod.functions = [fm.read_u32() for _ in range(fm.read_u32())]
+        elif sec_id == 4:
+            mod.tables = [self._load_tabletype(fm) for _ in range(fm.read_u32())]
+        elif sec_id == 5:
+            mod.memories = [ast.MemoryType(self._load_limit(fm)) for _ in range(fm.read_u32())]
+        elif sec_id == 6:
+            mod.globals = [
+                ast.GlobalSegment(self._load_globaltype(fm), self._load_expr(fm))
+                for _ in range(fm.read_u32())
+            ]
+        elif sec_id == 7:
+            mod.exports = [
+                self._load_export(fm) for _ in range(fm.read_u32())
+            ]
+        elif sec_id == 8:
+            mod.start = fm.read_u32()
+        elif sec_id == 9:
+            mod.elements = [self._load_elem(fm) for _ in range(fm.read_u32())]
+        elif sec_id == 10:
+            mod.codes = [self._load_code(fm) for _ in range(fm.read_u32())]
+        elif sec_id == 11:
+            mod.datas = [self._load_data(fm) for _ in range(fm.read_u32())]
+        elif sec_id == 12:
+            if "bulk-memory" not in self.gates and "reference-types" not in self.gates:
+                raise LoadError(ErrCode.MalformedSection, offset=fm.pos)
+            mod.data_count = fm.read_u32()
+
+    def _load_valtype(self, fm: FileMgr) -> ValType:
+        b = fm.read_byte()
+        if b in _NUM_TYPES:
+            return _NUM_TYPES[b]
+        if b == 0x7B:
+            if "simd" not in self.gates:
+                raise LoadError(ErrCode.MalformedValType, offset=fm.pos)
+            return ValType.V128
+        if b in _REF_TYPES:
+            if b == 0x6F and "reference-types" not in self.gates:
+                raise LoadError(ErrCode.MalformedValType, offset=fm.pos)
+            return _REF_TYPES[b]
+        raise LoadError(ErrCode.MalformedValType, offset=fm.pos)
+
+    def _load_reftype(self, fm: FileMgr) -> ValType:
+        b = fm.read_byte()
+        if b not in _REF_TYPES:
+            raise LoadError(ErrCode.MalformedRefType, offset=fm.pos)
+        if b == 0x6F and "reference-types" not in self.gates:
+            raise LoadError(ErrCode.MalformedRefType, offset=fm.pos)
+        return _REF_TYPES[b]
+
+    def _load_functype(self, fm: FileMgr) -> ast.FunctionType:
+        if fm.read_byte() != 0x60:
+            raise LoadError(ErrCode.IllegalGrammar, offset=fm.pos)
+        params = tuple(self._load_valtype(fm) for _ in range(fm.read_u32()))
+        results = tuple(self._load_valtype(fm) for _ in range(fm.read_u32()))
+        if len(results) > 1 and "multi-value" not in self.gates:
+            raise LoadError(ErrCode.InvalidResultArity, offset=fm.pos)
+        return ast.FunctionType(params, results)
+
+    def _load_limit(self, fm: FileMgr) -> ast.Limit:
+        flag = fm.read_byte()
+        if flag not in (0x00, 0x01):
+            raise LoadError(ErrCode.IntegerTooLarge, offset=fm.pos)
+        mn = fm.read_u32()
+        mx = fm.read_u32() if flag == 0x01 else None
+        if mx is not None and mx < mn:
+            raise LoadError(ErrCode.InvalidLimit, offset=fm.pos)
+        return ast.Limit(mn, mx)
+
+    def _load_tabletype(self, fm: FileMgr) -> ast.TableType:
+        rt = self._load_reftype(fm)
+        return ast.TableType(rt, self._load_limit(fm))
+
+    def _load_globaltype(self, fm: FileMgr) -> ast.GlobalType:
+        vt = self._load_valtype(fm)
+        mut = fm.read_byte()
+        if mut not in (0, 1):
+            raise LoadError(ErrCode.InvalidMut, offset=fm.pos)
+        return ast.GlobalType(vt, bool(mut))
+
+    def _load_import(self, fm: FileMgr) -> ast.ImportDesc:
+        module = fm.read_name()
+        name = fm.read_name()
+        kind = fm.read_byte()
+        im = ast.ImportDesc(module, name, kind)
+        if kind == 0:
+            im.type_idx = fm.read_u32()
+        elif kind == 1:
+            im.table_type = self._load_tabletype(fm)
+        elif kind == 2:
+            im.memory_type = ast.MemoryType(self._load_limit(fm))
+        elif kind == 3:
+            im.global_type = self._load_globaltype(fm)
+        else:
+            raise LoadError(ErrCode.MalformedImportKind, offset=fm.pos)
+        return im
+
+    def _load_export(self, fm: FileMgr) -> ast.ExportDesc:
+        name = fm.read_name()
+        kind = fm.read_byte()
+        if kind > 3:
+            raise LoadError(ErrCode.MalformedExportKind, offset=fm.pos)
+        return ast.ExportDesc(name, kind, fm.read_u32())
+
+    def _load_elem(self, fm: FileMgr) -> ast.ElementSegment:
+        flags = fm.read_u32()
+        if flags > 7:
+            raise LoadError(ErrCode.IllegalGrammar, offset=fm.pos)
+        if flags != 0 and "bulk-memory" not in self.gates and "reference-types" not in self.gates:
+            raise LoadError(ErrCode.IllegalGrammar, offset=fm.pos)
+        mode = 0 if flags in (0, 2, 4, 6) else (2 if flags in (3, 7) else 1)
+        table_idx = fm.read_u32() if flags in (2, 6) else 0
+        offset = self._load_expr(fm) if mode == 0 else None
+        ref_type = ValType.FuncRef
+        init_exprs: List[List[ast.Instruction]] = []
+        if flags in (0, 1, 2, 3):
+            if flags != 0:
+                ek = fm.read_byte()  # elemkind, must be 0x00 (funcref)
+                if ek != 0x00:
+                    raise LoadError(ErrCode.MalformedElemType, offset=fm.pos)
+            for _ in range(fm.read_u32()):
+                fi = fm.read_u32()
+                init_exprs.append(
+                    [
+                        ast.Instruction(Op.ref_func, target_idx=fi),
+                        ast.Instruction(Op.end),
+                    ]
+                )
+        else:  # 4..7: element expressions
+            if flags != 4:
+                ref_type = self._load_reftype(fm)
+            for _ in range(fm.read_u32()):
+                init_exprs.append(self._load_expr(fm))
+        return ast.ElementSegment(mode, table_idx, offset, ref_type, init_exprs)
+
+    def _load_data(self, fm: FileMgr) -> ast.DataSegment:
+        flags = fm.read_u32()
+        if flags > 2:
+            raise LoadError(ErrCode.IllegalGrammar, offset=fm.pos)
+        if flags > 0 and "bulk-memory" not in self.gates:
+            # reference gates any nonzero check byte (segment.cpp:309-314)
+            raise LoadError(ErrCode.ExpectedZeroByte, offset=fm.pos)
+        mode = 1 if flags == 1 else 0
+        mem_idx = fm.read_u32() if flags == 2 else 0
+        offset = self._load_expr(fm) if mode == 0 else None
+        data = fm.read_bytes(fm.read_u32())
+        return ast.DataSegment(mode, mem_idx, offset, data)
+
+    def _load_code(self, fm: FileMgr) -> ast.CodeSegment:
+        size = fm.read_u32()
+        body_end = fm.pos + size
+        if body_end > fm.end:
+            raise LoadError(ErrCode.LengthOutOfBounds, offset=fm.pos)
+        sub = FileMgr(fm.data, fm.pos, body_end)
+        locals_: List = []
+        total = 0
+        for _ in range(sub.read_u32()):
+            count = sub.read_u32()
+            vt = self._load_valtype(sub)
+            total += count
+            if total > 0x07FFFFFF:
+                raise LoadError(ErrCode.TooManyLocals, offset=sub.pos)
+            locals_.append((count, vt))
+        body = self._load_instr_seq(sub)
+        if sub.pos != body_end:
+            raise LoadError(ErrCode.SectionSizeMismatch, offset=sub.pos)
+        fm.pos = body_end
+        return ast.CodeSegment(locals_, body, size)
+
+    # -- expressions / instructions ---------------------------------------
+    def _load_expr(self, fm: FileMgr) -> List[ast.Instruction]:
+        return self._load_instr_seq(fm)
+
+    def _read_opcode(self, fm: FileMgr) -> int:
+        off = fm.pos
+        b = fm.read_byte()
+        if b in (0xFC, 0xFD):
+            sub = fm.read_u32()
+            key = (b, sub)
+        else:
+            key = (0, b)
+        op_id = WIRE_TO_ID.get(key)
+        if op_id is None:
+            raise LoadError(ErrCode.IllegalOpCode, offset=off)
+        info = OPCODES[op_id]
+        if info.proposal is not None and info.proposal not in self.gates:
+            raise LoadError(ErrCode.IllegalOpCode, offset=off)
+        return op_id
+
+    def _load_instr_seq(self, fm: FileMgr) -> List[ast.Instruction]:
+        """Decode until the matching final `end`, precomputing jump_end /
+        jump_else for block/loop/if via a block stack (reference:
+        lib/loader/ast/instruction.cpp:38-96)."""
+        instrs: List[ast.Instruction] = []
+        block_stack: List[int] = []  # indices of open block/loop/if
+        while True:
+            off = fm.pos
+            op_id = self._read_opcode(fm)
+            instr = self._decode_immediates(op_id, fm, off)
+            idx = len(instrs)
+            instrs.append(instr)
+            name = OPCODES[op_id].name
+            if name in ("block", "loop", "if"):
+                block_stack.append(idx)
+            elif name == "else":
+                if not block_stack:
+                    raise LoadError(ErrCode.IllegalGrammar, offset=off)
+                opener = instrs[block_stack[-1]]
+                if OPCODES[opener.op].name != "if" or opener.jump_else:
+                    raise LoadError(ErrCode.IllegalGrammar, offset=off)
+                opener.jump_else = idx - block_stack[-1]
+            elif name == "end":
+                if not block_stack:
+                    return instrs  # function/expr-terminating end
+                opener_idx = block_stack.pop()
+                instrs[opener_idx].jump_end = idx - opener_idx
+
+    def _decode_immediates(self, op_id: int, fm: FileMgr, off: int) -> ast.Instruction:
+        info = OPCODES[op_id]
+        ins = ast.Instruction(op_id, offset=off)
+        imm = info.imm
+        if imm == "none":
+            pass
+        elif imm == "blocktype":
+            b = fm.peek_byte()
+            if b == 0x40:
+                fm.read_byte()
+                ins.block_type = None  # empty
+            elif b in _NUM_TYPES or b in _REF_TYPES or b == 0x7B:
+                ins.block_type = self._load_valtype(fm)
+            else:
+                v = fm.read_s33()
+                if v < 0:
+                    raise LoadError(ErrCode.MalformedValType, offset=fm.pos)
+                ins.block_type = int(v)  # type index
+        elif imm in ("labelidx", "funcidx", "localidx", "globalidx", "tableidx",
+                     "dataidx", "elemidx"):
+            ins.target_idx = fm.read_u32()
+        elif imm == "brtable":
+            n = fm.read_u32()
+            ins.targets = [fm.read_u32() for _ in range(n)]
+            ins.target_idx = fm.read_u32()  # default label
+        elif imm == "typeidx_tableidx":
+            ins.target_idx = fm.read_u32()
+            if "reference-types" in self.gates:
+                ins.source_idx = fm.read_u32()
+            else:
+                b = fm.read_byte()
+                if b != 0x00:
+                    raise LoadError(ErrCode.ExpectedZeroByte, offset=fm.pos)
+                ins.source_idx = 0
+        elif imm == "tableidx2":  # table.copy: dst, src
+            ins.target_idx = fm.read_u32()
+            ins.source_idx = fm.read_u32()
+        elif imm == "elemidx_tableidx":  # table.init: elem, table
+            ins.target_idx = fm.read_u32()
+            ins.source_idx = fm.read_u32()
+        elif imm == "dataidx_memidx":  # memory.init
+            ins.target_idx = fm.read_u32()
+            b = fm.read_byte()
+            if b != 0x00:
+                raise LoadError(ErrCode.ExpectedZeroByte, offset=fm.pos)
+        elif imm == "memidx":
+            b = fm.read_byte()
+            if b != 0x00:
+                raise LoadError(ErrCode.ExpectedZeroByte, offset=fm.pos)
+        elif imm == "memidx2":
+            for _ in range(2):
+                if fm.read_byte() != 0x00:
+                    raise LoadError(ErrCode.ExpectedZeroByte, offset=fm.pos)
+        elif imm == "memarg":
+            ins.mem_align = fm.read_u32()
+            ins.mem_offset = fm.read_u32()
+        elif imm == "i32":
+            ins.imm = fm.read_s32() & 0xFFFFFFFF
+        elif imm == "i64":
+            ins.imm = fm.read_s64() & 0xFFFFFFFFFFFFFFFF
+        elif imm == "f32":
+            ins.imm = fm.read_f32_bits()
+        elif imm == "f64":
+            ins.imm = fm.read_f64_bits()
+        elif imm == "refnull":
+            ins.ref_type = self._load_reftype(fm)
+        elif imm == "select_t":
+            n = fm.read_u32()
+            ins.val_types = [self._load_valtype(fm) for _ in range(n)]
+        else:
+            raise LoadError(ErrCode.IllegalGrammar, offset=off)
+        return ins

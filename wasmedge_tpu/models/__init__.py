@@ -1,0 +1,23 @@
+"""Example wasm workload corpus (the reference ships fibonacci/factorial wat
+examples, /root/reference/tools/wasmedge/examples/). Built programmatically
+via utils.builder since the image has no wat2wasm and copying reference
+bytes is off-limits. These are the benchmark workloads from BASELINE.md:
+fib (config 1), a CoreMark-style integer/memory kernel (config 2 analog),
+plus small modules exercising each subsystem.
+"""
+
+from wasmedge_tpu.models.programs import (
+    build_coremark_kernel,
+    build_fac,
+    build_fib,
+    build_loop_sum,
+    build_memory_workload,
+)
+
+__all__ = [
+    "build_fib",
+    "build_fac",
+    "build_loop_sum",
+    "build_memory_workload",
+    "build_coremark_kernel",
+]

@@ -1,0 +1,142 @@
+"""Benchmark/workload module builders."""
+
+from __future__ import annotations
+
+from wasmedge_tpu.utils.builder import ModuleBuilder
+
+
+def build_fib() -> bytes:
+    """Recursive fib(n) — BASELINE config 1: i32 numeric + call/br only."""
+    b = ModuleBuilder()
+    b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("i32.const", 2), "i32.lt_s",
+        ("if", "i32"),
+        ("local.get", 0),
+        "else",
+        ("local.get", 0), ("i32.const", 1), "i32.sub", ("call", 0),
+        ("local.get", 0), ("i32.const", 2), "i32.sub", ("call", 0),
+        "i32.add",
+        "end",
+    ], export="fib")
+    return b.build()
+
+
+def build_fac() -> bytes:
+    """Recursive factorial over i64 (reference example: fac(12))."""
+    b = ModuleBuilder()
+    b.add_function(["i64"], ["i64"], [], [
+        ("local.get", 0), ("i64.const", 1), "i64.le_s",
+        ("if", "i64"),
+        ("i64.const", 1),
+        "else",
+        ("local.get", 0),
+        ("local.get", 0), ("i64.const", 1), "i64.sub", ("call", 0),
+        "i64.mul",
+        "end",
+    ], export="fac")
+    return b.build()
+
+
+def build_loop_sum() -> bytes:
+    """sum(0..n) via a loop — pure-branch workload, no calls."""
+    b = ModuleBuilder()
+    b.add_function(["i32"], ["i32"], ["i32", "i32"], [
+        ("block", None),
+        ("loop", None),
+        ("local.get", 1), ("local.get", 0), "i32.ge_u", ("br_if", 1),
+        ("local.get", 2), ("local.get", 1), "i32.add", ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        ("br", 0),
+        "end",
+        "end",
+        ("local.get", 2),
+    ], export="loop_sum")
+    return b.build()
+
+
+def build_memory_workload() -> bytes:
+    """Write-then-checksum over linear memory (config 2 memory traffic)."""
+    b = ModuleBuilder()
+    b.add_memory(1, 16)
+    # store n words of i*2654435761 then xor-reduce
+    b.add_function(["i32"], ["i32"], ["i32", "i32"], [
+        ("block", None),
+        ("loop", None),
+        ("local.get", 1), ("local.get", 0), "i32.ge_u", ("br_if", 1),
+        ("local.get", 1), ("i32.const", 4), "i32.mul",
+        ("local.get", 1), ("i32.const", 0x9E3779B1 - 2**32), "i32.mul",
+        ("i32.store", 2, 0),
+        ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        ("br", 0),
+        "end",
+        "end",
+        ("i32.const", 0), ("local.set", 1),
+        ("block", None),
+        ("loop", None),
+        ("local.get", 1), ("local.get", 0), "i32.ge_u", ("br_if", 1),
+        ("local.get", 2),
+        ("local.get", 1), ("i32.const", 4), "i32.mul", ("i32.load", 2, 0),
+        "i32.xor", ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        ("br", 0),
+        "end",
+        "end",
+        ("local.get", 2),
+    ], export="mem_checksum")
+    return b.build()
+
+
+def build_coremark_kernel() -> bytes:
+    """CoreMark-flavored kernel: list-free core mix of matrix-multiply-ish
+    integer MACs, state-machine branches, and CRC over linear memory.
+    Not the full CoreMark (no libc), but the same op mix — the config-2
+    stand-in until a wasm32 CoreMark binary is available offline."""
+    b = ModuleBuilder()
+    b.add_memory(1, 16)
+
+    # crc16 step: crc = (crc >> 1) ^ (0xA001 if (crc^bit)&1 else 0)
+    crc8 = b.add_function(["i32", "i32"], ["i32"], ["i32"], [
+        # for 8 bits
+        ("block", None),
+        ("loop", None),
+        ("local.get", 2), ("i32.const", 8), "i32.ge_u", ("br_if", 1),
+        ("local.get", 1), ("local.get", 0), "i32.xor", ("i32.const", 1), "i32.and",
+        ("if", None),
+        ("local.get", 1), ("i32.const", 1), "i32.shr_u",
+        ("i32.const", 0xA001), "i32.xor", ("local.set", 1),
+        "else",
+        ("local.get", 1), ("i32.const", 1), "i32.shr_u", ("local.set", 1),
+        "end",
+        ("local.get", 0), ("i32.const", 1), "i32.shr_u", ("local.set", 0),
+        ("local.get", 2), ("i32.const", 1), "i32.add", ("local.set", 2),
+        ("br", 0),
+        "end",
+        "end",
+        ("local.get", 1),
+    ])
+
+    # matrix-ish MAC over memory words + state machine + crc
+    b.add_function(["i32"], ["i32"], ["i32", "i32", "i32", "i32"], [
+        # locals: 0=n 1=i 2=acc 3=state 4=crc
+        ("i32.const", 0xFFFF), ("local.set", 4),
+        ("block", None),
+        ("loop", None),
+        ("local.get", 1), ("local.get", 0), "i32.ge_u", ("br_if", 1),
+        # acc += (i*3) * (i+7)  (MAC)
+        ("local.get", 2),
+        ("local.get", 1), ("i32.const", 3), "i32.mul",
+        ("local.get", 1), ("i32.const", 7), "i32.add",
+        "i32.mul", "i32.add", ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        # state-machine dispatch on acc low bits: all arms continue the loop
+        ("local.get", 2), ("i32.const", 7), "i32.and",
+        ("br_table", [0, 0, 0], 0),
+        "end",
+        "end",
+        # store acc, crc it
+        ("i32.const", 0), ("local.get", 2), ("i32.store", 2, 0),
+        ("local.get", 2), ("i32.const", 0xFF), "i32.and",
+        ("local.get", 4), ("call", crc8), ("local.set", 4),
+        ("local.get", 4), ("local.get", 2), "i32.xor",
+    ], export="coremark")
+    return b.build()

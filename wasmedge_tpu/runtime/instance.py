@@ -1,0 +1,191 @@
+"""Runtime instances: module, function, memory, table, global, elem, data.
+
+Mirrors the reference's header-only runtime instances
+(/root/reference/include/runtime/instance/*.h). Differences driven by the
+TPU design:
+
+  - values are raw 64-bit cells (ints), never tagged at runtime
+  - references are store-interned handles (0 = null), because device lanes
+    can only hold numbers
+  - MemoryInstance is a bytearray with software bounds checks (the
+    reference's guard-page trick, lib/system/allocator.cpp:60-97, has no
+    TPU analog — SURVEY.md §5.2), and exposes a numpy view so the batch
+    engine can scatter/gather lane memories wholesale
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from wasmedge_tpu.common.errors import ErrCode, TrapError
+from wasmedge_tpu.common.types import PAGE_SIZE, ValType
+from wasmedge_tpu.loader import ast
+
+
+class MemoryInstance:
+    """Linear memory (reference: include/runtime/instance/memory.h:34-332)."""
+
+    def __init__(self, mtype: ast.MemoryType, page_limit: int = 65536):
+        self.min = mtype.limit.min
+        self.max = mtype.limit.max
+        self.page_limit = page_limit
+        self.data = bytearray(self.min * PAGE_SIZE)
+
+    @property
+    def pages(self) -> int:
+        return len(self.data) // PAGE_SIZE
+
+    def check_bounds(self, off: int, length: int):
+        if off + length > len(self.data):
+            raise TrapError(ErrCode.MemoryOutOfBounds)
+
+    def grow(self, delta: int) -> int:
+        old = self.pages
+        new = old + delta
+        limit = self.page_limit
+        if self.max is not None:
+            limit = min(limit, self.max)
+        if delta < 0 or new > limit or new > 65536:
+            return -1
+        self.data.extend(bytes(delta * PAGE_SIZE))
+        return old
+
+    # -- typed access (little-endian) --------------------------------------
+    def load(self, off: int, nbytes: int, signed: bool) -> int:
+        self.check_bounds(off, nbytes)
+        v = int.from_bytes(self.data[off : off + nbytes], "little", signed=signed)
+        return v
+
+    def store(self, off: int, nbytes: int, value: int):
+        self.check_bounds(off, nbytes)
+        self.data[off : off + nbytes] = (value & ((1 << (8 * nbytes)) - 1)).to_bytes(
+            nbytes, "little"
+        )
+
+    def load_bytes(self, off: int, n: int) -> bytes:
+        self.check_bounds(off, n)
+        return bytes(self.data[off : off + n])
+
+    def store_bytes(self, off: int, data: bytes):
+        self.check_bounds(off, len(data))
+        self.data[off : off + len(data)] = data
+
+    def as_numpy(self) -> np.ndarray:
+        return np.frombuffer(self.data, dtype=np.uint8)
+
+
+class TableInstance:
+    """Reference table (reference: include/runtime/instance/table.h)."""
+
+    def __init__(self, ttype: ast.TableType):
+        self.ref_type = ttype.ref_type
+        self.min = ttype.limit.min
+        self.max = ttype.limit.max
+        self.refs: List[int] = [0] * self.min  # store-interned handles, 0=null
+
+    @property
+    def size(self) -> int:
+        return len(self.refs)
+
+    def get(self, idx: int) -> int:
+        if idx >= len(self.refs):
+            raise TrapError(ErrCode.TableOutOfBounds)
+        return self.refs[idx]
+
+    def set(self, idx: int, ref: int):
+        if idx >= len(self.refs):
+            raise TrapError(ErrCode.TableOutOfBounds)
+        self.refs[idx] = ref
+
+    def grow(self, delta: int, init_ref: int) -> int:
+        old = len(self.refs)
+        new = old + delta
+        if delta < 0 or (self.max is not None and new > self.max) or new >= 2**32:
+            return -1
+        self.refs.extend([init_ref] * delta)
+        return old
+
+
+class GlobalInstance:
+    def __init__(self, gtype: ast.GlobalType, value: int = 0):
+        self.type = gtype
+        self.value = value  # raw 64-bit cell
+
+
+class ElementInstance:
+    """Passive element segment storage; clear() on elem.drop."""
+
+    def __init__(self, ref_type: ValType, refs: List[int]):
+        self.ref_type = ref_type
+        self.refs = refs
+
+    def clear(self):
+        self.refs = []
+
+
+class DataInstance:
+    def __init__(self, data: bytes):
+        self.data = data
+
+    def clear(self):
+        self.data = b""
+
+
+class FunctionInstance:
+    """Function: wasm (lowered image + meta) or host.
+
+    The reference's 3-way variant (interpreted/AOT/host, include/runtime/
+    instance/function.h:110-140) becomes kind tags; the batch engine is an
+    execution *strategy* over the same wasm kind rather than a new kind.
+    """
+
+    __slots__ = ("kind", "module", "func_idx", "host", "functype")
+
+    def __init__(self, kind: str, functype: ast.FunctionType,
+                 module: "ModuleInstance" = None, func_idx: int = -1, host=None):
+        self.kind = kind  # "wasm" | "host"
+        self.functype = functype
+        self.module = module
+        self.func_idx = func_idx
+        self.host = host
+
+    @property
+    def meta(self):
+        return self.module.lowered.funcs[self.func_idx]
+
+
+class ModuleInstance:
+    """Per-module runtime state (reference: include/runtime/instance/
+    module.h:37-345)."""
+
+    def __init__(self, name: str, mod: ast.Module):
+        self.name = name
+        self.ast = mod
+        self.lowered = mod.lowered
+        self.funcs: List[FunctionInstance] = []
+        self.tables: List[TableInstance] = []
+        self.memories: List[MemoryInstance] = []
+        self.globals: List[GlobalInstance] = []
+        self.elems: List[ElementInstance] = []
+        self.datas: List[DataInstance] = []
+        self.exports: Dict[str, tuple] = {}  # name -> (kind, index)
+        self.start: Optional[int] = None
+
+    def export_instance(self, name: str):
+        if name not in self.exports:
+            return None
+        kind, idx = self.exports[name]
+        pool = [self.funcs, self.tables, self.memories, self.globals][kind]
+        return pool[idx]
+
+    def find_func(self, name: str) -> Optional[FunctionInstance]:
+        ex = self.exports.get(name)
+        if ex and ex[0] == 0:
+            return self.funcs[ex[1]]
+        return None
+
+    def func_names(self) -> List[str]:
+        return [n for n, (k, _) in self.exports.items() if k == 0]

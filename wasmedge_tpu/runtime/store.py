@@ -1,0 +1,68 @@
+"""StoreManager: owns all instances, named + anonymous modules, ref interning.
+
+Mirrors the reference StoreManager (/root/reference/include/runtime/
+storemgr.h:54-343): named-module map, active (anonymous) module = last
+instantiated, reset semantics that keep registered modules. The TPU-driven
+addition is the funcref intern table: device lanes hold numeric handles, so
+every FunctionInstance that can flow through a table/ref gets a dense id
+(0 = null), shared across modules.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from wasmedge_tpu.runtime.instance import FunctionInstance, ModuleInstance
+
+
+class StoreManager:
+    def __init__(self):
+        self.named: Dict[str, ModuleInstance] = {}
+        self.anonymous: List[ModuleInstance] = []
+        self._ref_pool: List[object] = []  # handle-1 -> FunctionInstance/extern
+        self._ref_ids: Dict[int, int] = {}  # id(obj) -> handle
+
+    # -- modules -----------------------------------------------------------
+    def register_named(self, inst: ModuleInstance):
+        self.named[inst.name] = inst
+
+    def push_anonymous(self, inst: ModuleInstance):
+        self.anonymous.append(inst)
+
+    def get_active_module(self) -> Optional[ModuleInstance]:
+        return self.anonymous[-1] if self.anonymous else None
+
+    def find_module(self, name: str) -> Optional[ModuleInstance]:
+        return self.named.get(name)
+
+    def module_names(self) -> List[str]:
+        return list(self.named.keys())
+
+    def reset(self, keep_registered: bool = True):
+        self.anonymous.clear()
+        if not keep_registered:
+            self.named.clear()
+            self._ref_pool.clear()
+            self._ref_ids.clear()
+
+    # -- reference interning ----------------------------------------------
+    def intern_ref(self, obj) -> int:
+        """Object -> numeric handle (>=1); 0 is the null reference."""
+        if obj is None:
+            return 0
+        key = id(obj)
+        h = self._ref_ids.get(key)
+        if h is None:
+            self._ref_pool.append(obj)
+            h = len(self._ref_pool)
+            self._ref_ids[key] = h
+        return h
+
+    def deref(self, handle: int):
+        if handle == 0:
+            return None
+        return self._ref_pool[handle - 1]
+
+    def deref_func(self, handle: int) -> Optional[FunctionInstance]:
+        obj = self.deref(handle)
+        return obj if isinstance(obj, FunctionInstance) else None

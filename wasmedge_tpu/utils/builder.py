@@ -1,0 +1,304 @@
+"""Programmatic WebAssembly binary encoder.
+
+The reference's loader tests drive byte-level decode with handcrafted
+binaries (/root/reference/test/loader/*Test.cpp). We generalize that into a
+small module builder: tests and example workloads construct modules as
+instruction tuples ("i32.add",) / ("i32.const", 5) and get spec-conformant
+binary bytes back. This is also how the models/ example corpus is produced
+(no network access for wat2wasm, and copying reference bytes is off-limits).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, List, Optional, Sequence, Tuple, Union
+
+from wasmedge_tpu.common.opcodes import NAME_TO_ID, OPCODES
+from wasmedge_tpu.common.types import ValType
+
+InstrLike = Union[Tuple, str]
+
+_VALTYPE_BYTE = {
+    "i32": 0x7F, "i64": 0x7E, "f32": 0x7D, "f64": 0x7C,
+    "v128": 0x7B, "funcref": 0x70, "externref": 0x6F,
+    ValType.I32: 0x7F, ValType.I64: 0x7E, ValType.F32: 0x7D, ValType.F64: 0x7C,
+    ValType.V128: 0x7B, ValType.FuncRef: 0x70, ValType.ExternRef: 0x6F,
+}
+
+
+def uleb(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def sleb(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        done = (v == 0 and not (b & 0x40)) or (v == -1 and (b & 0x40))
+        out.append(b if done else b | 0x80)
+        if done:
+            return bytes(out)
+
+
+def _vt(t) -> int:
+    return _VALTYPE_BYTE[t]
+
+
+def encode_instr(ins: InstrLike) -> bytes:
+    if isinstance(ins, str):
+        ins = (ins,)
+    name, *args = ins
+    op_id = NAME_TO_ID.get(name)
+    if op_id is None:
+        raise KeyError(f"unknown opcode {name!r}")
+    info = OPCODES[op_id]
+    out = bytearray()
+    if info.page == 0:
+        out.append(info.code)
+    else:
+        out.append(info.page)
+        out += uleb(info.code)
+    imm = info.imm
+    if imm == "none":
+        pass
+    elif imm == "blocktype":
+        bt = args[0] if args else None
+        if bt is None or bt == "void":
+            out.append(0x40)
+        elif isinstance(bt, int) and not isinstance(bt, ValType):
+            out += sleb(bt)  # type index
+        else:
+            out.append(_vt(bt))
+    elif imm in ("labelidx", "funcidx", "localidx", "globalidx", "tableidx",
+                 "dataidx", "elemidx"):
+        out += uleb(args[0])
+    elif imm == "brtable":
+        targets, default = args
+        out += uleb(len(targets))
+        for t in targets:
+            out += uleb(t)
+        out += uleb(default)
+    elif imm == "typeidx_tableidx":
+        out += uleb(args[0])
+        out += uleb(args[1] if len(args) > 1 else 0)
+    elif imm in ("tableidx2", "elemidx_tableidx"):
+        out += uleb(args[0])
+        out += uleb(args[1] if len(args) > 1 else 0)
+    elif imm == "dataidx_memidx":
+        out += uleb(args[0])
+        out.append(0x00)
+    elif imm == "memidx":
+        out.append(0x00)
+    elif imm == "memidx2":
+        out += b"\x00\x00"
+    elif imm == "memarg":
+        align = args[0] if args else 0
+        offset = args[1] if len(args) > 1 else 0
+        out += uleb(align)
+        out += uleb(offset)
+    elif imm == "i32":
+        out += sleb(args[0] if args[0] < 2**31 else args[0] - 2**32)
+    elif imm == "i64":
+        out += sleb(args[0] if args[0] < 2**63 else args[0] - 2**64)
+    elif imm == "f32":
+        out += struct.pack("<f", args[0]) if isinstance(args[0], float) else struct.pack("<I", args[0])
+    elif imm == "f64":
+        out += struct.pack("<d", args[0]) if isinstance(args[0], float) else struct.pack("<Q", args[0])
+    elif imm == "refnull":
+        out.append(_vt(args[0]))
+    elif imm == "select_t":
+        out += uleb(len(args[0]))
+        for t in args[0]:
+            out.append(_vt(t))
+    else:
+        raise ValueError(f"unhandled immediate kind {imm}")
+    return bytes(out)
+
+
+def encode_expr(instrs: Iterable[InstrLike]) -> bytes:
+    out = bytearray()
+    for ins in instrs:
+        out += encode_instr(ins)
+    out += encode_instr("end")
+    return bytes(out)
+
+
+class ModuleBuilder:
+    def __init__(self):
+        self.types: List[Tuple[tuple, tuple]] = []
+        self.imports: List[bytes] = []
+        self.num_imported_funcs = 0
+        self.funcs: List[Tuple[int, list, list]] = []  # (typeidx, locals, body)
+        self.tables: List[bytes] = []
+        self.memories: List[bytes] = []
+        self.globals: List[bytes] = []
+        self.exports: List[bytes] = []
+        self.start: Optional[int] = None
+        self.elems: List[bytes] = []
+        self.datas: List[bytes] = []
+        self.data_count: Optional[int] = None
+
+    # -- types -------------------------------------------------------------
+    def add_type(self, params: Sequence, results: Sequence) -> int:
+        key = (tuple(params), tuple(results))
+        for i, t in enumerate(self.types):
+            if t == key:
+                return i
+        self.types.append(key)
+        return len(self.types) - 1
+
+    # -- imports -----------------------------------------------------------
+    def import_func(self, module: str, name: str, params, results) -> int:
+        ti = self.add_type(params, results)
+        enc = self._name(module) + self._name(name) + b"\x00" + uleb(ti)
+        self.imports.append(enc)
+        idx = self.num_imported_funcs
+        self.num_imported_funcs += 1
+        return idx
+
+    def import_memory(self, module: str, name: str, min_pages: int, max_pages=None):
+        self.imports.append(
+            self._name(module) + self._name(name) + b"\x02" + self._limit(min_pages, max_pages)
+        )
+
+    def import_global(self, module: str, name: str, vt, mutable=False):
+        self.imports.append(
+            self._name(module) + self._name(name) + b"\x03"
+            + bytes([_vt(vt), 1 if mutable else 0])
+        )
+
+    def import_table(self, module: str, name: str, reftype, mn, mx=None):
+        self.imports.append(
+            self._name(module) + self._name(name) + b"\x01"
+            + bytes([_vt(reftype)]) + self._limit(mn, mx)
+        )
+
+    # -- definitions -------------------------------------------------------
+    def add_function(self, params, results, locals_, body, export: Optional[str] = None) -> int:
+        """locals_: list of ValType-likes (one per local); body: instr tuples
+        WITHOUT the final end (added automatically)."""
+        ti = self.add_type(params, results)
+        self.funcs.append((ti, list(locals_), list(body)))
+        idx = self.num_imported_funcs + len(self.funcs) - 1
+        if export:
+            self.export_func(export, idx)
+        return idx
+
+    def add_table(self, reftype="funcref", mn=0, mx=None):
+        self.tables.append(bytes([_vt(reftype)]) + self._limit(mn, mx))
+        return len(self.tables) - 1
+
+    def add_memory(self, min_pages=1, max_pages=None, export: Optional[str] = None):
+        self.memories.append(self._limit(min_pages, max_pages))
+        idx = len(self.memories) - 1
+        if export:
+            self.exports.append(self._name(export) + b"\x02" + uleb(idx))
+        return idx
+
+    def add_global(self, vt, mutable: bool, init_instrs, export: Optional[str] = None):
+        enc = bytes([_vt(vt), 1 if mutable else 0]) + encode_expr(init_instrs)
+        self.globals.append(enc)
+        idx = len(self.globals) - 1
+        if export:
+            self.exports.append(self._name(export) + b"\x03" + uleb(idx))
+        return idx
+
+    def export_func(self, name: str, idx: int):
+        self.exports.append(self._name(name) + b"\x00" + uleb(idx))
+
+    def set_start(self, idx: int):
+        self.start = idx
+
+    def add_active_elem(self, table_idx: int, offset_instrs, func_indices):
+        enc = uleb(0) + encode_expr(offset_instrs) + uleb(len(func_indices))
+        for fi in func_indices:
+            enc += uleb(fi)
+        self.elems.append(enc)
+
+    def add_passive_elem(self, func_indices):
+        enc = uleb(1) + b"\x00" + uleb(len(func_indices))
+        for fi in func_indices:
+            enc += uleb(fi)
+        self.elems.append(enc)
+
+    def add_active_data(self, mem_idx: int, offset_instrs, data: bytes):
+        self.datas.append(uleb(0) + encode_expr(offset_instrs) + uleb(len(data)) + data)
+
+    def add_passive_data(self, data: bytes):
+        self.datas.append(uleb(1) + uleb(len(data)) + data)
+
+    # -- encoding ----------------------------------------------------------
+    @staticmethod
+    def _name(s: str) -> bytes:
+        raw = s.encode("utf-8")
+        return uleb(len(raw)) + raw
+
+    @staticmethod
+    def _limit(mn: int, mx=None) -> bytes:
+        if mx is None:
+            return b"\x00" + uleb(mn)
+        return b"\x01" + uleb(mn) + uleb(mx)
+
+    @staticmethod
+    def _section(sec_id: int, payload: bytes) -> bytes:
+        return bytes([sec_id]) + uleb(len(payload)) + payload
+
+    @staticmethod
+    def _vec(items: List[bytes]) -> bytes:
+        return uleb(len(items)) + b"".join(items)
+
+    def build(self) -> bytes:
+        out = bytearray(b"\x00asm\x01\x00\x00\x00")
+        if self.types:
+            enc = []
+            for params, results in self.types:
+                e = b"\x60" + uleb(len(params)) + bytes(_vt(p) for p in params)
+                e += uleb(len(results)) + bytes(_vt(r) for r in results)
+                enc.append(e)
+            out += self._section(1, self._vec(enc))
+        if self.imports:
+            out += self._section(2, self._vec(self.imports))
+        if self.funcs:
+            out += self._section(3, self._vec([uleb(ti) for ti, _, _ in self.funcs]))
+        if self.tables:
+            out += self._section(4, self._vec(self.tables))
+        if self.memories:
+            out += self._section(5, self._vec(self.memories))
+        if self.globals:
+            out += self._section(6, self._vec(self.globals))
+        if self.exports:
+            out += self._section(7, self._vec(self.exports))
+        if self.start is not None:
+            out += self._section(8, uleb(self.start))
+        if self.elems:
+            out += self._section(9, self._vec(self.elems))
+        if self.data_count is not None:
+            out += self._section(12, uleb(self.data_count))
+        if self.funcs:
+            bodies = []
+            for _, locals_, body in self.funcs:
+                # run-length encode locals
+                runs: List[Tuple[int, object]] = []
+                for lt in locals_:
+                    if runs and runs[-1][1] == lt:
+                        runs[-1] = (runs[-1][0] + 1, lt)
+                    else:
+                        runs.append((1, lt))
+                enc = uleb(len(runs))
+                for count, lt in runs:
+                    enc += uleb(count) + bytes([_vt(lt)])
+                enc += encode_expr(body)
+                bodies.append(uleb(len(enc)) + enc)
+            out += self._section(10, self._vec(bodies))
+        if self.datas:
+            out += self._section(11, self._vec(self.datas))
+        return bytes(out)

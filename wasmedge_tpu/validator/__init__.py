@@ -1,0 +1,3 @@
+from wasmedge_tpu.validator.validator import Validator
+
+__all__ = ["Validator"]

@@ -1,0 +1,497 @@
+"""FormChecker: the spec validation algorithm fused with bytecode lowering.
+
+Mirrors the reference FormChecker (/root/reference/lib/validator/
+formchecker.cpp:1-1438) including its key design move: validation *is* the
+lowering pass (SURVEY.md §2.4). Where the reference writes absolute stack
+offsets and jump descriptors back into the AST, we emit a fresh dense SoA
+image (validator/image.py) with structured control compiled to absolute-PC
+branches carrying {keep, pop_to} descriptors.
+
+The type-checking core is the canonical algorithm from the spec appendix:
+an abstract value stack (with Unknown for unreachable polymorphism) plus a
+control-frame stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from wasmedge_tpu.common.errors import ErrCode, ValidationError
+from wasmedge_tpu.common.opcodes import OPCODES, Op
+from wasmedge_tpu.common.types import SIG_CHAR_TO_VALTYPE, ValType
+from wasmedge_tpu.loader import ast
+from wasmedge_tpu.validator.image import LOP_BR, LOP_BRNZ, LOP_BRZ, FuncMeta, LoweredModule
+
+Unknown = None  # polymorphic stack slot
+
+
+@dataclasses.dataclass
+class CtrlFrame:
+    kind: str  # func | block | loop | if | else
+    start_types: Tuple[ValType, ...]
+    end_types: Tuple[ValType, ...]
+    height: int  # operand height at entry, below params
+    unreachable: bool = False
+    start_pc: int = 0  # loop: branch target
+    brz_site: int = -1  # if: BRZ emit index awaiting patch
+    else_br_site: int = -1  # if: BR at end of then-branch
+    patch_sites: list = dataclasses.field(default_factory=list)
+
+    @property
+    def label_types(self) -> Tuple[ValType, ...]:
+        return self.start_types if self.kind == "loop" else self.end_types
+
+
+def _access_width(name: str) -> int:
+    """Natural byte width of a load/store opcode from its name."""
+    base = name.split(".")[0]
+    suffix = name.split(".")[1]
+    for tag, w in (("8", 1), ("16", 2), ("32", 4)):
+        if f"load{tag}" in suffix or f"store{tag}" in suffix:
+            return w
+    return {"i32": 4, "f32": 4, "i64": 8, "f64": 8, "v128": 16}[base]
+
+
+class FormChecker:
+    def __init__(self, module: ast.Module, image: LoweredModule, gates: frozenset,
+                 declared_funcs: frozenset):
+        self.mod = module
+        self.image = image
+        self.gates = gates
+        self.declared_funcs = declared_funcs
+        self.vals: List[Optional[ValType]] = []
+        self.ctrls: List[CtrlFrame] = []
+        self.locals: List[ValType] = []
+        self.returns: Tuple[ValType, ...] = ()
+        self.max_height = 0
+
+    # ---- abstract stacks -------------------------------------------------
+    def _err(self, code=ErrCode.TypeCheckFailed, msg=""):
+        raise ValidationError(code, msg)
+
+    def push_val(self, t):
+        self.vals.append(t)
+        if len(self.vals) > self.max_height:
+            self.max_height = len(self.vals)
+
+    def pop_val(self, expect=Unknown):
+        frame = self.ctrls[-1]
+        if len(self.vals) == frame.height:
+            if frame.unreachable:
+                return expect
+            self._err(msg="type mismatch: stack underflow")
+        got = self.vals.pop()
+        if got is Unknown:
+            return expect
+        if expect is not Unknown and got != expect:
+            self._err(msg=f"type mismatch: expected {expect}, got {got}")
+        return got
+
+    def push_vals(self, ts):
+        for t in ts:
+            self.push_val(t)
+
+    def pop_vals(self, ts):
+        out = []
+        for t in reversed(ts):
+            out.append(self.pop_val(t))
+        return out[::-1]
+
+    def push_ctrl(self, kind, start_types, end_types, **kw):
+        frame = CtrlFrame(kind, tuple(start_types), tuple(end_types),
+                          len(self.vals), **kw)
+        self.ctrls.append(frame)
+        self.push_vals(start_types)
+        return frame
+
+    def pop_ctrl(self) -> CtrlFrame:
+        if not self.ctrls:
+            self._err(msg="unbalanced control")
+        frame = self.ctrls[-1]
+        self.pop_vals(frame.end_types)
+        if len(self.vals) != frame.height:
+            self._err(msg="type mismatch: values remain on stack at end of block")
+        self.ctrls.pop()
+        return frame
+
+    def set_unreachable(self):
+        frame = self.ctrls[-1]
+        del self.vals[frame.height:]
+        frame.unreachable = True
+
+    def label(self, depth: int) -> CtrlFrame:
+        if depth >= len(self.ctrls):
+            self._err(ErrCode.InvalidLabelIdx, f"unknown label {depth}")
+        return self.ctrls[-1 - depth]
+
+    # ---- block types -----------------------------------------------------
+    def _block_type(self, bt) -> Tuple[Tuple[ValType, ...], Tuple[ValType, ...]]:
+        if bt is None:
+            return (), ()
+        if isinstance(bt, ValType):
+            return (), (bt,)
+        if not (0 <= bt < len(self.mod.types)):
+            self._err(ErrCode.InvalidFuncTypeIdx, f"type index {bt}")
+        ft = self.mod.types[bt]
+        return ft.params, ft.results
+
+    # ---- branch emission -------------------------------------------------
+    def _branch_descriptor(self, frame: CtrlFrame) -> Tuple[int, int]:
+        return len(frame.label_types), frame.height
+
+    def _emit_branch(self, lop: int, frame: CtrlFrame):
+        keep, pop_to = self._branch_descriptor(frame)
+        site = self.image.emit(lop, 0, keep, pop_to)
+        if frame.kind == "loop":
+            self.image.patch_target(site, frame.start_pc)
+        else:
+            frame.patch_sites.append(("code", site))
+        return site
+
+    # ---- main ------------------------------------------------------------
+    def run(self, func_idx: int, code: ast.CodeSegment) -> FuncMeta:
+        mod = self.mod
+        ftype = mod.func_type_of(func_idx)
+        self.locals = list(ftype.params)
+        for count, vt in code.locals:
+            self.locals.extend([vt] * count)
+        self.returns = tuple(ftype.results)
+        self.vals = []
+        self.ctrls = []
+        self.max_height = 0
+        entry_pc = self.image.code_len
+        self.push_ctrl("func", (), self.returns)
+        for ins in code.body:
+            self.check_instr(ins)
+        if self.ctrls:
+            self._err(msg="function body missing final end")
+        meta = FuncMeta(
+            type_idx=(mod.imported_funcs()[func_idx].type_idx
+                      if func_idx < mod.num_imported_funcs
+                      else mod.functions[func_idx - mod.num_imported_funcs]),
+            nparams=len(ftype.params),
+            nresults=len(ftype.results),
+            nlocals=len(self.locals),
+            entry_pc=entry_pc,
+            end_pc=self.image.code_len - 1,
+            max_height=self.max_height,
+            local_types=tuple(self.locals),
+        )
+        return meta
+
+    def check_instr(self, ins: ast.Instruction):  # noqa: C901
+        info = OPCODES[ins.op]
+        name = info.name
+        im = self.image
+
+        # Generic plain ops: signature-driven.
+        if info.sig is not None and info.imm in ("none", "i32", "i64", "f32", "f64"):
+            pops, pushes = info.sig.split("->")
+            for ch in reversed(pops):
+                self.pop_val(SIG_CHAR_TO_VALTYPE[ch])
+            for ch in pushes:
+                self.push_val(SIG_CHAR_TO_VALTYPE[ch])
+            im.emit(ins.op, imm=ins.imm)
+            return
+
+        # Memory plain ops.
+        if info.imm == "memarg":
+            self._check_mem(0)
+            width = _access_width(name)
+            if (1 << ins.mem_align) > width:
+                self._err(ErrCode.InvalidAlignment,
+                          f"alignment 2**{ins.mem_align} > natural {width}")
+            pops, pushes = info.sig.split("->")
+            for ch in reversed(pops):
+                self.pop_val(SIG_CHAR_TO_VALTYPE[ch])
+            for ch in pushes:
+                self.push_val(SIG_CHAR_TO_VALTYPE[ch])
+            im.emit(ins.op, a=ins.mem_align, imm=ins.mem_offset)
+            return
+
+        if name == "memory.size":
+            self._check_mem(0)
+            self.push_val(ValType.I32)
+            im.emit(ins.op)
+            return
+        if name == "memory.grow":
+            self._check_mem(0)
+            self.pop_val(ValType.I32)
+            self.push_val(ValType.I32)
+            im.emit(ins.op)
+            return
+
+        # Control.
+        if name == "unreachable":
+            im.emit(ins.op)
+            self.set_unreachable()
+            return
+        if name == "nop":
+            return
+        if name in ("block", "loop"):
+            ins_t, outs_t = self._block_type(ins.block_type)
+            self.pop_vals(ins_t)
+            self.push_ctrl(name, ins_t, outs_t, start_pc=im.code_len)
+            return
+        if name == "if":
+            ins_t, outs_t = self._block_type(ins.block_type)
+            self.pop_val(ValType.I32)
+            self.pop_vals(ins_t)
+            site = im.emit(LOP_BRZ)
+            self.push_ctrl("if", ins_t, outs_t, brz_site=site)
+            return
+        if name == "else":
+            frame = self.ctrls[-1] if self.ctrls else None
+            if frame is None or frame.kind != "if":
+                self._err(msg="else without if")
+            frame = self.pop_ctrl()
+            # terminate then-branch with a jump to end
+            br_site = im.emit(LOP_BR, 0, len(frame.end_types), frame.height)
+            # BRZ of the if now lands at the start of the else code
+            im.patch_target(frame.brz_site, im.code_len)
+            nf = self.push_ctrl("else", frame.start_types, frame.end_types)
+            nf.patch_sites = frame.patch_sites
+            nf.patch_sites.append(("code", br_site))
+            return
+        if name == "end":
+            frame = self.pop_ctrl()
+            if frame.kind == "if":
+                # no else: param types must equal result types
+                if frame.start_types != frame.end_types:
+                    self._err(msg="if without else must have matching types")
+                im.patch_target(frame.brz_site, im.code_len)
+            for kind, site in frame.patch_sites:
+                if kind == "code":
+                    im.patch_target(site, im.code_len)
+                else:
+                    im.patch_brtable_target(site, im.code_len)
+            self.push_vals(frame.end_types)
+            if frame.kind == "func":
+                im.emit(Op.__dict__["return"], b=len(self.returns))
+            return
+        if name == "br":
+            frame = self.label(ins.target_idx)
+            self.pop_vals(frame.label_types)
+            self._emit_branch(LOP_BR, frame)
+            self.set_unreachable()
+            return
+        if name == "br_if":
+            frame = self.label(ins.target_idx)
+            self.pop_val(ValType.I32)
+            self.pop_vals(frame.label_types)
+            self._emit_branch(LOP_BRNZ, frame)
+            self.push_vals(frame.label_types)
+            return
+        if name == "br_table":
+            self.pop_val(ValType.I32)
+            default = self.label(ins.target_idx)
+            arity = len(default.label_types)
+            entries = []
+            for t in ins.targets:
+                frame = self.label(t)
+                if len(frame.label_types) != arity:
+                    self._err(msg="br_table arity mismatch")
+                # each target type-checks against the popped values
+                popped = self.pop_vals(frame.label_types)
+                self.push_vals(popped)
+                entries.append(frame)
+            self.pop_vals(default.label_types)
+            first_entry = None
+            for frame in entries + [default]:
+                keep, pop_to = self._branch_descriptor(frame)
+                ei = self.image.emit_brtable_entry(0, keep, pop_to)
+                if first_entry is None:
+                    first_entry = ei
+                if frame.kind == "loop":
+                    self.image.patch_brtable_target(ei, frame.start_pc)
+                else:
+                    frame.patch_sites.append(("bt", ei))
+            im.emit(Op.br_table, first_entry, len(ins.targets))
+            self.set_unreachable()
+            return
+        if name == "return":
+            self.pop_vals(self.returns)
+            im.emit(Op.__dict__["return"], b=len(self.returns))
+            self.set_unreachable()
+            return
+        if name in ("call", "return_call"):
+            if ins.target_idx >= self.mod.total_funcs:
+                self._err(ErrCode.InvalidFuncIdx, f"function index {ins.target_idx}")
+            ftype = self.mod.func_type_of(ins.target_idx)
+            self.pop_vals(ftype.params)
+            if name == "call":
+                self.push_vals(ftype.results)
+                im.emit(Op.call, a=ins.target_idx)
+            else:
+                if tuple(ftype.results) != self.returns:
+                    self._err(msg="tail-call result type mismatch")
+                im.emit(Op.return_call, a=ins.target_idx)
+                self.set_unreachable()
+            return
+        if name in ("call_indirect", "return_call_indirect"):
+            tables = self.mod.all_table_types()
+            if ins.source_idx >= len(tables):
+                self._err(ErrCode.InvalidTableIdx, f"table index {ins.source_idx}")
+            if tables[ins.source_idx].ref_type != ValType.FuncRef:
+                self._err(msg="call_indirect table must be funcref")
+            if ins.target_idx >= len(self.mod.types):
+                self._err(ErrCode.InvalidFuncTypeIdx, f"type index {ins.target_idx}")
+            ftype = self.mod.types[ins.target_idx]
+            self.pop_val(ValType.I32)
+            self.pop_vals(ftype.params)
+            if name == "call_indirect":
+                self.push_vals(ftype.results)
+                im.emit(Op.call_indirect, a=ins.target_idx, b=ins.source_idx)
+            else:
+                if tuple(ftype.results) != self.returns:
+                    self._err(msg="tail-call result type mismatch")
+                im.emit(Op.return_call_indirect, a=ins.target_idx, b=ins.source_idx)
+                self.set_unreachable()
+            return
+
+        # Parametric.
+        if name == "drop":
+            self.pop_val()
+            im.emit(Op.drop)
+            return
+        if name in ("select", "select_t"):
+            self.pop_val(ValType.I32)
+            if name == "select_t":
+                if not ins.val_types or len(ins.val_types) != 1:
+                    self._err(ErrCode.InvalidResultArity, "select_t arity")
+                t = ins.val_types[0]
+                self.pop_val(t)
+                self.pop_val(t)
+                self.push_val(t)
+            else:
+                t1 = self.pop_val()
+                t2 = self.pop_val()
+                for t in (t1, t2):
+                    if t is not Unknown and t.is_ref:
+                        self._err(msg="select on reference type requires select_t")
+                if t1 is not Unknown and t2 is not Unknown and t1 != t2:
+                    self._err(msg="select type mismatch")
+                self.push_val(t1 if t1 is not Unknown else t2)
+            im.emit(Op.select)
+            return
+
+        # Variables.
+        if name in ("local.get", "local.set", "local.tee"):
+            if ins.target_idx >= len(self.locals):
+                self._err(ErrCode.InvalidLocalIdx, f"local index {ins.target_idx}")
+            t = self.locals[ins.target_idx]
+            if name == "local.get":
+                self.push_val(t)
+            elif name == "local.set":
+                self.pop_val(t)
+            else:
+                self.pop_val(t)
+                self.push_val(t)
+            im.emit(ins.op, a=ins.target_idx)
+            return
+        if name in ("global.get", "global.set"):
+            gts = self.mod.all_global_types()
+            if ins.target_idx >= len(gts):
+                self._err(ErrCode.InvalidGlobalIdx, f"global index {ins.target_idx}")
+            gt = gts[ins.target_idx]
+            if name == "global.get":
+                self.push_val(gt.val_type)
+            else:
+                if not gt.mutable:
+                    self._err(ErrCode.ImmutableGlobal, "global.set of const global")
+                self.pop_val(gt.val_type)
+            im.emit(ins.op, a=ins.target_idx)
+            return
+
+        # References.
+        if name == "ref.null":
+            self.push_val(ins.ref_type)
+            im.emit(ins.op)
+            return
+        if name == "ref.is_null":
+            t = self.pop_val()
+            if t is not Unknown and not t.is_ref:
+                self._err(msg="ref.is_null on non-reference")
+            self.push_val(ValType.I32)
+            im.emit(ins.op)
+            return
+        if name == "ref.func":
+            if ins.target_idx >= self.mod.total_funcs:
+                self._err(ErrCode.InvalidFuncIdx, f"function index {ins.target_idx}")
+            if ins.target_idx not in self.declared_funcs:
+                self._err(ErrCode.InvalidRefIdx, "undeclared function reference")
+            self.push_val(ValType.FuncRef)
+            im.emit(ins.op, a=ins.target_idx)
+            return
+
+        # Tables.
+        if name in ("table.get", "table.set", "table.size", "table.grow",
+                    "table.fill", "table.copy", "table.init"):
+            tables = self.mod.all_table_types()
+            if ins.target_idx >= len(tables) and name != "table.init":
+                self._err(ErrCode.InvalidTableIdx, f"table index {ins.target_idx}")
+            if name == "table.get":
+                self.pop_val(ValType.I32)
+                self.push_val(tables[ins.target_idx].ref_type)
+            elif name == "table.set":
+                self.pop_val(tables[ins.target_idx].ref_type)
+                self.pop_val(ValType.I32)
+            elif name == "table.size":
+                self.push_val(ValType.I32)
+            elif name == "table.grow":
+                self.pop_val(ValType.I32)
+                self.pop_val(tables[ins.target_idx].ref_type)
+                self.push_val(ValType.I32)
+            elif name == "table.fill":
+                self.pop_val(ValType.I32)
+                self.pop_val(tables[ins.target_idx].ref_type)
+                self.pop_val(ValType.I32)
+            elif name == "table.copy":
+                if ins.source_idx >= len(tables):
+                    self._err(ErrCode.InvalidTableIdx, f"table index {ins.source_idx}")
+                if tables[ins.target_idx].ref_type != tables[ins.source_idx].ref_type:
+                    self._err(msg="table.copy type mismatch")
+                for _ in range(3):
+                    self.pop_val(ValType.I32)
+            elif name == "table.init":
+                if ins.source_idx >= len(tables):
+                    self._err(ErrCode.InvalidTableIdx, f"table index {ins.source_idx}")
+                if ins.target_idx >= len(self.mod.elements):
+                    self._err(ErrCode.InvalidElemIdx, f"elem index {ins.target_idx}")
+                if self.mod.elements[ins.target_idx].ref_type != tables[ins.source_idx].ref_type:
+                    self._err(msg="table.init type mismatch")
+                for _ in range(3):
+                    self.pop_val(ValType.I32)
+            im.emit(ins.op, a=ins.target_idx, b=ins.source_idx)
+            return
+        if name == "elem.drop":
+            if ins.target_idx >= len(self.mod.elements):
+                self._err(ErrCode.InvalidElemIdx, f"elem index {ins.target_idx}")
+            im.emit(ins.op, a=ins.target_idx)
+            return
+
+        # Bulk memory.
+        if name in ("memory.init", "data.drop"):
+            if self.mod.data_count is None:
+                self._err(ErrCode.DataCountRequired, "data count section required")
+            if ins.target_idx >= self.mod.data_count:
+                self._err(ErrCode.InvalidDataIdx, f"data index {ins.target_idx}")
+            if name == "memory.init":
+                self._check_mem(0)
+                for _ in range(3):
+                    self.pop_val(ValType.I32)
+            im.emit(ins.op, a=ins.target_idx)
+            return
+        if name in ("memory.copy", "memory.fill"):
+            self._check_mem(0)
+            for _ in range(3):
+                self.pop_val(ValType.I32)
+            im.emit(ins.op)
+            return
+
+        raise ValidationError(ErrCode.TypeCheckFailed, f"unhandled opcode {name}")
+
+    def _check_mem(self, idx: int):
+        if idx >= len(self.mod.all_memory_types()):
+            self._err(ErrCode.InvalidMemoryIdx, f"memory index {idx}")

@@ -1,0 +1,142 @@
+"""LoweredModule: the dense SoA bytecode image.
+
+This is the TPU-first replacement for the reference's annotated AST. The
+reference's validator already *mutates* the AST into an O(1)-dispatch form
+(absolute stack offsets + jump descriptors, /root/reference/lib/validator/
+formchecker.cpp:383-468,664); we go one step further and emit a flat
+struct-of-arrays image — opcode/a/b/c int32 planes plus a 64-bit immediate
+plane — indexed by a single program counter. Structured control flow is
+*compiled away*:
+
+  block/loop/end -> nothing (branch targets resolved to absolute PCs)
+  if             -> BRZ  (branch if zero)  a=target_pc
+  else           -> BR   a=end_pc b=keep c=pop_to
+  br             -> BR   a=target_pc b=keep c=pop_to
+  br_if          -> BRNZ a=target_pc b=keep c=pop_to
+  br_table       -> entries in a side table of (target_pc, keep, pop_to)
+  final end      -> return
+
+Branch semantics at runtime: keep the top `b` operand values, cut the
+operand stack back to height `c` (relative to the frame's operand base),
+re-push the kept values, set pc = a. Calls/locals are frame-pointer
+relative; per-function `max_height` lets engines bounds-check the whole
+frame once at call entry.
+
+Both the scalar oracle, the C++ native engine, and the TPU batch engine
+execute this same image — parity is defined over it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from wasmedge_tpu.common.opcodes import NUM_OPCODES, name_of
+from wasmedge_tpu.common.types import ValType
+
+# Lowered-only pseudo-opcodes, appended after the wasm opcode id space.
+LOP_BR = NUM_OPCODES + 0
+LOP_BRZ = NUM_OPCODES + 1
+LOP_BRNZ = NUM_OPCODES + 2
+NUM_LOPS = NUM_OPCODES + 3
+
+_LOP_NAMES = {LOP_BR: "lop.br", LOP_BRZ: "lop.brz", LOP_BRNZ: "lop.brnz"}
+
+
+def lop_name(op: int) -> str:
+    return _LOP_NAMES.get(op) or name_of(op)
+
+
+@dataclasses.dataclass
+class FuncMeta:
+    type_idx: int
+    nparams: int
+    nresults: int
+    nlocals: int  # params + declared locals
+    entry_pc: int = -1  # -1 for imported functions
+    end_pc: int = -1
+    max_height: int = 0  # max operand-stack depth above locals
+    local_types: tuple = ()
+    is_import: bool = False
+    import_module: str = ""
+    import_name: str = ""
+
+
+class LoweredModule:
+    """Flat SoA code image for one module + per-function metadata."""
+
+    def __init__(self):
+        self.op: List[int] = []
+        self.a: List[int] = []
+        self.b: List[int] = []
+        self.c: List[int] = []
+        self.imm: List[int] = []
+        self.br_table: List[int] = []  # flattened (target_pc, keep, pop_to)
+        self.funcs: List[FuncMeta] = []
+        self.func_of_pc: Optional[np.ndarray] = None
+        self._np = None
+
+    # -- emission (used by the validator) ---------------------------------
+    def emit(self, op: int, a: int = 0, b: int = 0, c: int = 0, imm: int = 0) -> int:
+        idx = len(self.op)
+        self.op.append(op)
+        self.a.append(a)
+        self.b.append(b)
+        self.c.append(c)
+        self.imm.append(imm)
+        return idx
+
+    def emit_brtable_entry(self, target_pc: int, keep: int, pop_to: int) -> int:
+        idx = len(self.br_table) // 3
+        self.br_table.extend((target_pc, keep, pop_to))
+        return idx
+
+    def patch_target(self, code_idx: int, target_pc: int):
+        self.a[code_idx] = target_pc
+
+    def patch_brtable_target(self, entry_idx: int, target_pc: int):
+        self.br_table[entry_idx * 3] = target_pc
+
+    @property
+    def code_len(self) -> int:
+        return len(self.op)
+
+    # -- finalize to numpy -------------------------------------------------
+    def finalize(self):
+        i64 = []
+        for v in self.imm:
+            i64.append(v - (1 << 64) if v >= (1 << 63) else v)
+        self._np = {
+            "op": np.asarray(self.op, dtype=np.int32),
+            "a": np.asarray(self.a, dtype=np.int32),
+            "b": np.asarray(self.b, dtype=np.int32),
+            "c": np.asarray(self.c, dtype=np.int32),
+            "imm": np.asarray(i64, dtype=np.int64),
+            "br_table": np.asarray(self.br_table or [0, 0, 0], dtype=np.int32).reshape(-1, 3),
+        }
+        fop = np.zeros(max(self.code_len, 1), dtype=np.int32)
+        for fi, fn in enumerate(self.funcs):
+            if fn.entry_pc >= 0:
+                fop[fn.entry_pc : fn.end_pc + 1] = fi
+        self.func_of_pc = fop
+        return self
+
+    @property
+    def arrays(self) -> dict:
+        if self._np is None:
+            self.finalize()
+        return self._np
+
+    # -- debugging ---------------------------------------------------------
+    def disasm(self, start: int = 0, end: Optional[int] = None) -> str:
+        end = self.code_len if end is None else end
+        lines = []
+        for pc in range(start, end):
+            lines.append(
+                f"{pc:6d}: {lop_name(self.op[pc]):24s}"
+                f" a={self.a[pc]:<6d} b={self.b[pc]:<4d} c={self.c[pc]:<4d}"
+                f" imm={self.imm[pc]}"
+            )
+        return "\n".join(lines)

@@ -1,0 +1,186 @@
+"""Module validator: section walk + per-function FormChecker lowering.
+
+Mirrors the reference Validator (/root/reference/lib/validator/
+validator.cpp:1-580): limits checks, import/export descriptors, segment
+const-exprs, start function, and function bodies. On success attaches the
+finalized LoweredModule image to the AST module (`mod.lowered`) and marks it
+validated — the executor refuses unvalidated modules like the reference's
+AOT compiler does (lib/aot/compiler.cpp:4482-4485).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from wasmedge_tpu.common.configure import Configure, Proposal
+from wasmedge_tpu.common.errors import ErrCode, ValidationError
+from wasmedge_tpu.common.opcodes import OPCODES, Op
+from wasmedge_tpu.common.types import MAX_MEMORY_PAGES, ValType
+from wasmedge_tpu.loader import ast
+from wasmedge_tpu.validator.formchecker import FormChecker
+from wasmedge_tpu.validator.image import FuncMeta, LoweredModule
+
+_CONST_OPS = {Op.i32_const, Op.i64_const, Op.f32_const, Op.f64_const,
+              Op.ref_null, Op.ref_func, Op.global_get}
+
+
+class Validator:
+    def __init__(self, conf: Optional[Configure] = None):
+        self.conf = conf or Configure()
+        self.gates = self.conf.proposal_gates()
+
+    def validate(self, mod: ast.Module) -> ast.Module:
+        if len(mod.functions) != len(mod.codes):
+            raise ValidationError(ErrCode.IncompatibleFuncCode)
+
+        # Index-space sanity for imports.
+        for im in mod.imports:
+            if im.kind == 0 and im.type_idx >= len(mod.types):
+                raise ValidationError(ErrCode.InvalidFuncTypeIdx,
+                                      f"import type index {im.type_idx}")
+            if im.kind == 3 and im.global_type.mutable and \
+                    not self.conf.has_proposal(Proposal.ImportExportMutGlobals):
+                raise ValidationError(ErrCode.InvalidMut, "mutable global import")
+
+        for ti in mod.functions:
+            if ti >= len(mod.types):
+                raise ValidationError(ErrCode.InvalidFuncTypeIdx, f"func type index {ti}")
+
+        # Tables/memories: count limits per proposals.
+        tables = mod.all_table_types()
+        if len(tables) > 1 and not self.conf.has_proposal(Proposal.ReferenceTypes):
+            raise ValidationError(ErrCode.MultiTables)
+        memories = mod.all_memory_types()
+        if len(memories) > 1 and not self.conf.has_proposal(Proposal.MultiMemories):
+            raise ValidationError(ErrCode.MultiMemories)
+        max_pages = min(MAX_MEMORY_PAGES, self.conf.runtime.max_memory_pages)
+        for mt in memories:
+            if mt.limit.min > max_pages or (mt.limit.max or 0) > max_pages:
+                raise ValidationError(ErrCode.InvalidMemPages)
+            if mt.limit.max is not None and mt.limit.max < mt.limit.min:
+                raise ValidationError(ErrCode.InvalidLimit)
+        for tt in tables:
+            if tt.limit.max is not None and tt.limit.max < tt.limit.min:
+                raise ValidationError(ErrCode.InvalidLimit)
+
+        # Declared function references (for ref.func validity): functions
+        # mentioned in elem segments, global inits, exports, or start.
+        declared = set()
+        for eseg in mod.elements:
+            for expr in eseg.init_exprs:
+                for ins in expr:
+                    if ins.op == Op.ref_func:
+                        declared.add(ins.target_idx)
+        for gseg in mod.globals:
+            for ins in gseg.init:
+                if ins.op == Op.ref_func:
+                    declared.add(ins.target_idx)
+        for ex in mod.exports:
+            if ex.kind == 0:
+                declared.add(ex.index)
+        declared_funcs = frozenset(declared)
+
+        # Globals: init exprs may only reference previously-defined
+        # (imported) immutable globals.
+        imported_globals = [im.global_type for im in mod.imported_globals()]
+        for gseg in mod.globals:
+            self._check_const_expr(mod, gseg.init, gseg.type.val_type,
+                                   imported_globals, mod.total_funcs)
+
+        # Exports: unique names, valid indices.
+        seen = set()
+        for ex in mod.exports:
+            if ex.name in seen:
+                raise ValidationError(ErrCode.DupExportName, ex.name)
+            seen.add(ex.name)
+            counts = [mod.total_funcs, len(tables), len(memories),
+                      len(mod.all_global_types())]
+            if ex.index >= counts[ex.kind]:
+                raise ValidationError(
+                    [ErrCode.InvalidFuncIdx, ErrCode.InvalidTableIdx,
+                     ErrCode.InvalidMemoryIdx, ErrCode.InvalidGlobalIdx][ex.kind],
+                    f"export {ex.name}")
+
+        # Element segments.
+        for eseg in mod.elements:
+            if eseg.mode == 0:
+                if eseg.table_idx >= len(tables):
+                    raise ValidationError(ErrCode.InvalidTableIdx)
+                if tables[eseg.table_idx].ref_type != eseg.ref_type:
+                    raise ValidationError(ErrCode.TypeCheckFailed,
+                                          "elem segment type mismatch")
+                self._check_const_expr(mod, eseg.offset, ValType.I32,
+                                       imported_globals, mod.total_funcs)
+            for expr in eseg.init_exprs:
+                self._check_const_expr(mod, expr, eseg.ref_type,
+                                       imported_globals, mod.total_funcs)
+
+        # Data segments.
+        for dseg in mod.datas:
+            if dseg.mode == 0:
+                if dseg.memory_idx >= len(memories):
+                    raise ValidationError(ErrCode.InvalidMemoryIdx)
+                self._check_const_expr(mod, dseg.offset, ValType.I32,
+                                       imported_globals, mod.total_funcs)
+
+        # Start function: () -> ().
+        if mod.start is not None:
+            if mod.start >= mod.total_funcs:
+                raise ValidationError(ErrCode.InvalidFuncIdx, "start")
+            ft = mod.func_type_of(mod.start)
+            if ft.params or ft.results:
+                raise ValidationError(ErrCode.InvalidStartFunc)
+
+        # Function bodies -> lowered image.
+        image = LoweredModule()
+        for i, imf in enumerate(mod.imported_funcs()):
+            ft = mod.types[imf.type_idx]
+            image.funcs.append(FuncMeta(
+                type_idx=imf.type_idx, nparams=len(ft.params),
+                nresults=len(ft.results), nlocals=len(ft.params),
+                is_import=True, import_module=imf.module, import_name=imf.name,
+            ))
+        nimp = mod.num_imported_funcs
+        for li, code in enumerate(mod.codes):
+            checker = FormChecker(mod, image, self.gates, declared_funcs)
+            meta = checker.run(nimp + li, code)
+            image.funcs.append(meta)
+        mod.lowered = image.finalize()
+        mod.validated = True
+        return mod
+
+    # -- const expressions -------------------------------------------------
+    def _check_const_expr(self, mod: ast.Module, expr: List[ast.Instruction],
+                          expect: ValType, imported_globals, total_funcs: int):
+        stack: List[ValType] = []
+        if not expr or expr[-1].op != Op.end:
+            raise ValidationError(ErrCode.ConstExprRequired, "missing end")
+        for ins in expr[:-1]:
+            if ins.op not in _CONST_OPS:
+                raise ValidationError(ErrCode.ConstExprRequired,
+                                      f"non-constant op {OPCODES[ins.op].name}")
+            if ins.op == Op.i32_const:
+                stack.append(ValType.I32)
+            elif ins.op == Op.i64_const:
+                stack.append(ValType.I64)
+            elif ins.op == Op.f32_const:
+                stack.append(ValType.F32)
+            elif ins.op == Op.f64_const:
+                stack.append(ValType.F64)
+            elif ins.op == Op.ref_null:
+                stack.append(ins.ref_type)
+            elif ins.op == Op.ref_func:
+                if ins.target_idx >= total_funcs:
+                    raise ValidationError(ErrCode.InvalidFuncIdx, "ref.func")
+                stack.append(ValType.FuncRef)
+            elif ins.op == Op.global_get:
+                if ins.target_idx >= len(imported_globals):
+                    raise ValidationError(ErrCode.InvalidGlobalIdx,
+                                          "const expr global.get must be imported")
+                gt = imported_globals[ins.target_idx]
+                if gt.mutable:
+                    raise ValidationError(ErrCode.ConstExprRequired,
+                                          "const expr global.get of mutable global")
+                stack.append(gt.val_type)
+        if len(stack) != 1 or stack[0] != expect:
+            raise ValidationError(ErrCode.TypeCheckFailed, "const expr type mismatch")
