@@ -6,9 +6,18 @@ The driver's dryrun_multichip uses the same mechanism.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, not setdefault: the session env pins JAX_PLATFORMS to the TPU
+# plugin, but tests must be deterministic IEEE CPU (the TPU flushes f32
+# denormals to zero — a documented batch-engine divergence, see
+# wasmedge_tpu/batch/__init__.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin ignores JAX_PLATFORMS; only the config knob wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

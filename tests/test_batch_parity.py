@@ -1,0 +1,423 @@
+"""Batch-engine parity suite: the tpu_batch engine must be bit-exact with
+the scalar oracle, lane by lane — values AND trap codes.
+
+This is the conformance centerpiece SURVEY.md §4 calls for: the same
+modules run through both engines via the same staging, so the batch engine
+is tested by the exact corpus that tests the oracle. One mega-module with a
+function per opcode keeps it to a single XLA compile.
+"""
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.common.errors import TrapError
+from wasmedge_tpu.common.opcodes import OPCODES
+from wasmedge_tpu.batch.image import _UNSUPPORTED_NAMES, _UNSUPPORTED_PREFIXES
+from wasmedge_tpu.utils.builder import ModuleBuilder
+from tests.helpers import instantiate
+
+# -- edge-case input vectors by signature char ------------------------------
+I32_EDGES = [0, 1, 2, -1, -2, 0x7FFFFFFF, -0x80000000, 0x12345678,
+             -0x12345678, 31, 32, 33, 0xFFFF]
+I64_EDGES = [0, 1, -1, 2**63 - 1, -(2**63), 0x123456789ABCDEF,
+             -0x123456789ABCDEF, 63, 64, 2**32, -(2**32), 0xFFFFFFFF]
+F32_EDGES_BITS = [
+    0x00000000, 0x80000000,  # +-0
+    0x3F800000, 0xBF800000,  # +-1
+    0x3FC00000,              # 1.5
+    0x7F800000, 0xFF800000,  # +-inf
+    0x7FC00000, 0xFFC00001,  # nans
+    0x00000001,              # denormal
+    0x4F000000,              # 2^31 (f32)
+    0x4EFFFFFF,              # just under 2^31
+    0xCF000000,              # -2^31
+    0x42280000,              # 42.0
+]
+
+_EDGES = {"i": I32_EDGES, "I": I64_EDGES, "f": F32_EDGES_BITS}
+
+# f32 ops that are bitwise or integer-domain in the batch engine stay exact
+# for denormal inputs even on FTZ hardware; arithmetic ops flush subnormals
+# on XLA CPU/TPU (documented divergence), so the denormal edge is excluded.
+_DENORMAL_SAFE = {
+    "f32.eq", "f32.ne", "f32.lt", "f32.gt", "f32.le", "f32.ge",
+    "f32.min", "f32.max", "f32.abs", "f32.neg", "f32.copysign",
+    "i32.reinterpret_f32", "f32.reinterpret_i32",
+}
+_DENORMAL_BITS = {0x00000001}
+
+
+def _cells(ch, vals):
+    if ch == "i":
+        return [v & 0xFFFFFFFF for v in vals]
+    if ch == "I":
+        return [v & 0xFFFFFFFFFFFFFFFF for v in vals]
+    return list(vals)  # f32 bit patterns already
+
+
+def _batch_supported(name: str) -> bool:
+    if any(name.startswith(p) for p in _UNSUPPORTED_PREFIXES):
+        return False
+    return name not in _UNSUPPORTED_NAMES
+
+
+def _plain_ops():
+    """All no-immediate ops with a value signature the batch engine takes."""
+    out = []
+    for info in OPCODES:
+        if info.imm != "none" or info.sig is None:
+            continue
+        if not _batch_supported(info.name):
+            continue
+        pops, pushes = info.sig.split("->")
+        if any(c not in "iIf" for c in pops + pushes):
+            continue
+        out.append((info.name, pops, pushes))
+    return out
+
+
+_SIG_STR = {"i": "i32", "I": "i64", "f": "f32"}
+
+
+@pytest.fixture(scope="module")
+def parity_rig():
+    """One module with a function per op; instantiated for both engines."""
+    b = ModuleBuilder()
+    ops = _plain_ops()
+    for name, pops, pushes in ops:
+        params = [_SIG_STR[c] for c in pops]
+        results = [_SIG_STR[c] for c in pushes]
+        body = [("local.get", i) for i in range(len(params))] + [name]
+        b.add_function(params, results, [], body, export=name)
+    ex, store, inst = instantiate(b.build())
+    from wasmedge_tpu.batch import BatchEngine
+    return ops, ex, store, inst, {}
+
+
+def _lane_inputs(pops, name=""):
+    """Cartesian edge-case grid over the op's parameter types."""
+    if not pops:
+        return [[]]
+    cols = []
+    for c in pops:
+        vals = _EDGES[c]
+        if c == "f" and name not in _DENORMAL_SAFE:
+            vals = [v for v in vals if v not in _DENORMAL_BITS]
+        cols.append(_cells(c, vals))
+    if len(cols) == 1:
+        return [[v] for v in cols[0]]
+    grid = []
+    for a in cols[0]:
+        for bb in cols[1]:
+            grid.append([a, bb])
+    return grid
+
+
+def test_opcode_parity(parity_rig):
+    from wasmedge_tpu.batch import BatchEngine
+
+    ops, ex, store, inst, _ = parity_rig
+    # group runs by arity so lane counts match within one engine instance
+    failures = []
+    eng_cache = {}
+    for name, pops, pushes in ops:
+        lanes_in = _lane_inputs(pops, name)
+        L = len(lanes_in)
+        # scalar oracle per lane
+        want_vals, want_traps = [], []
+        fi = inst.find_func(name)
+        for args in lanes_in:
+            try:
+                out = ex.invoke_raw(store, fi, list(args))
+                want_vals.append(out[0] if out else 0)
+                want_traps.append(-1)
+            except TrapError as e:
+                want_vals.append(None)
+                want_traps.append(int(e.code))
+        # batch engine: one run, L lanes
+        if L not in eng_cache:
+            eng_cache[L] = BatchEngine(inst, store=store, lanes=L)
+        eng = eng_cache[L]
+        args_cols = []
+        for i in range(len(pops)):
+            args_cols.append(np.array([lanes_in[k][i] for k in range(L)],
+                                      dtype=np.uint64).astype(np.int64))
+        res = eng.run(name, args_cols, max_steps=4000)
+        got_trap = res.trap
+        got = res.results[0] if res.results else np.zeros(L, np.int64)
+        for k in range(L):
+            wt = want_traps[k]
+            gt = int(got_trap[k])
+            if wt != gt:
+                failures.append(
+                    f"{name} lane {k} args={lanes_in[k]}: trap {wt} vs {gt}")
+                continue
+            if wt == -1:
+                wv = want_vals[k] & 0xFFFFFFFFFFFFFFFF
+                gv = int(got[k]) & 0xFFFFFFFFFFFFFFFF
+                if wv != gv:
+                    failures.append(
+                        f"{name} lane {k} args={[hex(a) for a in lanes_in[k]]}:"
+                        f" {wv:#x} vs {gv:#x}")
+    assert not failures, "\n".join(failures[:40]) + f"\n({len(failures)} total)"
+
+
+class TestProgramParity:
+    def _compare(self, data, func, arg_lanes, max_steps=2_000_000, conf=None):
+        from wasmedge_tpu.batch import BatchEngine
+
+        # fresh instance per scalar lane: batch lanes are share-nothing, so
+        # the oracle must not leak global/memory state across lanes
+        want_vals, want_traps = [], []
+        for a in arg_lanes:
+            ex, store, inst = instantiate(data, conf)
+            fi = inst.find_func(func)
+            try:
+                out = ex.invoke_raw(store, fi, [a & 0xFFFFFFFFFFFFFFFF])
+                want_vals.append(out[0] if out else 0)
+                want_traps.append(-1)
+            except TrapError as e:
+                want_vals.append(None)
+                want_traps.append(int(e.code))
+        # fresh instance for batch (scalar run may have mutated memory)
+        ex2, store2, inst2 = instantiate(data, conf)
+        eng = BatchEngine(inst2, store=store2, lanes=len(arg_lanes),
+                          conf=conf)
+        res = eng.run(func, [np.asarray(arg_lanes, np.int64)],
+                      max_steps=max_steps)
+        for k in range(len(arg_lanes)):
+            assert int(res.trap[k]) == want_traps[k], f"lane {k} trap"
+            if want_traps[k] == -1:
+                got = int(res.results[0][k]) & 0xFFFFFFFFFFFFFFFF
+                want = want_vals[k] & 0xFFFFFFFFFFFFFFFF
+                assert got == want, f"lane {k}: {want:#x} != {got:#x}"
+
+    def test_fib_divergent(self):
+        from wasmedge_tpu.models import build_fib
+        self._compare(build_fib(), "fib", list(range(16)))
+
+    def test_fac_i64(self):
+        from wasmedge_tpu.models import build_fac
+        self._compare(build_fac(), "fac", list(range(1, 21)))
+
+    def test_loop_sum(self):
+        from wasmedge_tpu.models import build_loop_sum
+        self._compare(build_loop_sum(), "loop_sum", [0, 1, 7, 100, 1000])
+
+    def test_memory_workload(self):
+        from wasmedge_tpu.models import build_memory_workload
+        self._compare(build_memory_workload(), "mem_checksum",
+                      [0, 1, 5, 64, 1000])
+
+    def test_coremark_kernel(self):
+        from wasmedge_tpu.models import build_coremark_kernel
+        self._compare(build_coremark_kernel(), "coremark", [1, 10, 100, 500])
+
+    def test_br_table(self):
+        b = ModuleBuilder()
+        b.add_function(["i32"], ["i32"], [], [
+            ("block", None), ("block", None), ("block", None),
+            ("local.get", 0), ("br_table", [0, 1], 2),
+            "end", ("i32.const", 10), "return",
+            "end", ("i32.const", 20), "return",
+            "end", ("i32.const", 30),
+        ], export="f")
+        self._compare(b.build(), "f", [0, 1, 2, 3, 100, -1])
+
+    def test_call_indirect(self):
+        b = ModuleBuilder()
+        add = b.add_function(["i32", "i32"], ["i32"], [],
+                             [("local.get", 0), ("local.get", 1), "i32.add"])
+        sub = b.add_function(["i32", "i32"], ["i32"], [],
+                             [("local.get", 0), ("local.get", 1), "i32.sub"])
+        voidf = b.add_function([], [], [], [])
+        b.add_table("funcref", 5)
+        b.add_active_elem(0, [("i32.const", 0)], [add, sub, voidf])
+        ti = b.add_type(["i32", "i32"], ["i32"])
+        b.add_function(["i32"], ["i32"], [], [
+            ("i32.const", 30), ("i32.const", 12),
+            ("local.get", 0), ("call_indirect", ti, 0),
+        ], export="dispatch")
+        # lanes: ok, ok, sig mismatch, null, undefined
+        self._compare(b.build(), "dispatch", [0, 1, 2, 3, 99])
+
+    def test_globals_and_memory(self):
+        b = ModuleBuilder()
+        b.add_memory(1, 2)
+        b.add_global("i64", True, [("i64.const", 7)])
+        b.add_function(["i32"], ["i64"], [], [
+            ("global.get", 0),
+            ("local.get", 0), ("local.get", 0), ("i32.store", 2, 0),
+            ("local.get", 0), ("i64.load32_u", 2, 0),
+            "i64.add", ("global.set", 0),
+            ("global.get", 0),
+        ], export="f")
+        self._compare(b.build(), "f", [0, 4, 100, 65532, 65533])
+
+    def test_memory_grow_and_size(self):
+        from wasmedge_tpu.common.configure import Configure
+        conf = Configure()
+        conf.batch.memory_pages_per_lane = 3
+        b = ModuleBuilder()
+        b.add_memory(1, 3)
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), "memory.grow", "drop",
+            "memory.size",
+            ("i32.const", 16), "i32.mul",
+            ("local.get", 0), "memory.grow",
+            "i32.add",
+        ], export="f")
+        self._compare(b.build(), "f", [0, 1, 2, 5], conf=conf)
+
+    def test_trap_isolation(self):
+        # one lane traps mid-run; others must complete unaffected
+        b = ModuleBuilder()
+        b.add_function(["i32"], ["i32"], [], [
+            ("i32.const", 100), ("local.get", 0), "i32.div_s",
+        ], export="f")
+        self._compare(b.build(), "f", [1, 2, 0, 5, -1])
+
+    def test_unreachable_and_oob(self):
+        b = ModuleBuilder()
+        b.add_memory(1, 1)
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), ("i32.load", 2, 0),
+        ], export="f")
+        self._compare(b.build(), "f", [0, 65532, 65533, 70000, -4])
+
+    def test_deep_recursion_exhaustion(self):
+        from wasmedge_tpu.common.configure import Configure
+        conf = Configure()
+        conf.runtime.max_call_depth = 64
+        conf.batch.call_stack_depth = 64
+        b = ModuleBuilder()
+        # count down, recursing; lane with big n exhausts the call stack
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), ("i32.const", 0), "i32.le_s",
+            ("if", "i32"),
+            ("i32.const", 0),
+            "else",
+            ("local.get", 0), ("i32.const", 1), "i32.sub", ("call", 0),
+            ("i32.const", 1), "i32.add",
+            "end",
+        ], export="f")
+        self._compare(b.build(), "f", [0, 10, 63, 64, 200], conf=conf)
+
+    def test_fuel_limit(self):
+        from wasmedge_tpu.common.configure import Configure
+        from wasmedge_tpu.batch import BatchEngine
+        from wasmedge_tpu.models import build_fib
+        from wasmedge_tpu.common.errors import ErrCode
+
+        conf = Configure()
+        conf.batch.fuel_per_launch = 500
+        ex, store, inst = instantiate(build_fib())
+        eng = BatchEngine(inst, store=store, lanes=4, conf=conf)
+        res = eng.run("fib", [np.array([1, 5, 20, 25], np.int64)])
+        assert int(res.trap[0]) == -1  # cheap lane finishes
+        assert int(res.trap[2]) == int(ErrCode.CostLimitExceeded)
+        assert int(res.trap[3]) == int(ErrCode.CostLimitExceeded)
+
+
+class TestUniformEngine:
+    """Converged fast path must agree with the scalar oracle, and its
+    divergence handoff to SIMT must be seamless (same final results)."""
+
+    def _compare_uniform(self, data, func, arg_lanes, conf=None,
+                         expect_fallback=None, max_steps=2_000_000):
+        from wasmedge_tpu.batch import UniformBatchEngine
+
+        want_vals, want_traps = [], []
+        for a in arg_lanes:
+            ex, store, inst = instantiate(data, conf)
+            fi = inst.find_func(func)
+            try:
+                out = ex.invoke_raw(store, fi, [a & 0xFFFFFFFFFFFFFFFF])
+                want_vals.append(out[0] if out else 0)
+                want_traps.append(-1)
+            except Exception as e:
+                want_vals.append(None)
+                want_traps.append(int(e.code))
+        ex2, store2, inst2 = instantiate(data, conf)
+        eng = UniformBatchEngine(inst2, store=store2, lanes=len(arg_lanes),
+                                 conf=conf)
+        res = eng.run(func, [np.asarray(arg_lanes, np.int64)],
+                      max_steps=max_steps)
+        if expect_fallback is not None:
+            assert eng.fell_back_to_simt == expect_fallback
+        for k in range(len(arg_lanes)):
+            assert int(res.trap[k]) == want_traps[k], \
+                f"lane {k} trap {want_traps[k]} vs {int(res.trap[k])}"
+            if want_traps[k] == -1:
+                got = int(res.results[0][k]) & 0xFFFFFFFFFFFFFFFF
+                want = want_vals[k] & 0xFFFFFFFFFFFFFFFF
+                assert got == want, f"lane {k}: {want:#x} != {got:#x}"
+
+    def test_converged_fib(self):
+        from wasmedge_tpu.models import build_fib
+        self._compare_uniform(build_fib(), "fib", [13] * 8,
+                              expect_fallback=False)
+
+    def test_divergent_fib_falls_back(self):
+        from wasmedge_tpu.models import build_fib
+        self._compare_uniform(build_fib(), "fib", list(range(10)),
+                              expect_fallback=True)
+
+    def test_converged_memory_workload(self):
+        from wasmedge_tpu.models import build_memory_workload
+        self._compare_uniform(build_memory_workload(), "mem_checksum",
+                              [64] * 4, expect_fallback=False)
+
+    def test_converged_i64_fac(self):
+        from wasmedge_tpu.models import build_fac
+        self._compare_uniform(build_fac(), "fac", [15] * 4,
+                              expect_fallback=False)
+
+    def test_uniform_trap_all_lanes(self):
+        b = ModuleBuilder()
+        b.add_function(["i32"], ["i32"], [], [
+            ("i32.const", 1), ("local.get", 0), "i32.div_u",
+        ], export="f")
+        self._compare_uniform(b.build(), "f", [0, 0, 0], expect_fallback=False)
+
+    def test_partial_trap_diverges(self):
+        b = ModuleBuilder()
+        b.add_function(["i32"], ["i32"], [], [
+            ("i32.const", 100), ("local.get", 0), "i32.div_s",
+        ], export="f")
+        self._compare_uniform(b.build(), "f", [2, 0, 5], expect_fallback=True)
+
+    def test_partial_oob_diverges(self):
+        b = ModuleBuilder()
+        b.add_memory(1, 1)
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), ("i32.load", 2, 0),
+        ], export="f")
+        self._compare_uniform(b.build(), "f", [0, 70000, 8],
+                              expect_fallback=True)
+
+    def test_memory_grow_no_declared_max(self):
+        # no-max memory: growth ceiling = memory_pages_per_lane knob
+        from wasmedge_tpu.common.configure import Configure
+        conf = Configure()
+        conf.batch.memory_pages_per_lane = 4
+        conf.runtime.max_memory_pages = 4  # align the scalar oracle's limit
+        b = ModuleBuilder()
+        b.add_memory(1)  # no max
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), "memory.grow", "drop", "memory.size",
+        ], export="f")
+        self._compare_uniform(b.build(), "f", [1, 1], conf=conf,
+                              expect_fallback=False)
+
+    def test_engine_factory(self):
+        from wasmedge_tpu.batch import make_engine, UniformBatchEngine, BatchEngine
+        from wasmedge_tpu.common.configure import Configure
+        from wasmedge_tpu.models import build_fib
+
+        ex, store, inst = instantiate(build_fib())
+        conf = Configure()
+        assert isinstance(make_engine(inst, store, conf, lanes=2),
+                          UniformBatchEngine)
+        conf.batch.uniform = False
+        assert isinstance(make_engine(inst, store, conf, lanes=2), BatchEngine)

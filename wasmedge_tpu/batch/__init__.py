@@ -1,0 +1,41 @@
+"""tpu_batch engine: thousands of Wasm instances in SIMT lockstep on TPU.
+
+This is the component the north star mandates (BASELINE.json): the
+reference's `Executor::execute` dispatch loop (/root/reference/lib/executor/
+engine/engine.cpp:68-1641) re-imagined as a vectorized lane machine. Each
+TPU lane holds one instance's {pc, sp, fp, operand stack, call stack, linear
+memory} as struct-of-arrays in HBM; every step fetches each lane's
+instruction and executes all opcode-class handlers under lane masks
+(divergence-safe SIMT), with traps recorded per lane instead of unwinding.
+
+Values are two int32 planes (lo, hi): i32/f32 live in lo, i64 spans both —
+the TPU-native layout (no 64-bit emulation tax on 32-bit ops, f32 via
+bitcast). f64 and a few rare conversions are feature-gated: modules using
+them fall back to the scalar/native engine via the Configure engine seam.
+
+Known divergence on real TPU hardware: the TPU VPU flushes f32 subnormals
+to zero, so float workloads touching denormals differ from IEEE in the last
+ulp-range; integer workloads (the headline benches) are bit-exact. The
+parity suite runs on the CPU backend where XLA is IEEE-strict; a softfloat
+rare-path for denormals is planned (tracked in SURVEY.md §7 hard part (b)).
+"""
+
+from wasmedge_tpu.batch.engine import BatchEngine, BatchResult
+from wasmedge_tpu.batch.image import DeviceImage, batchability
+from wasmedge_tpu.batch.uniform import UniformBatchEngine
+
+
+def make_engine(inst, store=None, conf=None, lanes=None, mesh=None):
+    """Engine-selection seam: uniform fast path (with SIMT fallback) when
+    Configure.batch.uniform is set, plain SIMT otherwise."""
+    from wasmedge_tpu.common.configure import Configure
+
+    conf = conf or Configure()
+    if conf.batch.uniform:
+        return UniformBatchEngine(inst, store=store, conf=conf, lanes=lanes,
+                                  mesh=mesh)
+    return BatchEngine(inst, store=store, conf=conf, lanes=lanes, mesh=mesh)
+
+
+__all__ = ["BatchEngine", "BatchResult", "DeviceImage", "batchability",
+           "UniformBatchEngine", "make_engine"]

@@ -1,0 +1,862 @@
+"""BatchEngine: the SIMT lockstep interpreter (pure JAX/XLA version).
+
+One `step()` advances every lane by one instruction: fetch each lane's
+(class, sub, operands) from the device image tables, run every class
+handler vectorized under lane masks, and merge the candidate state updates
+with `where`-selects. Divergent control flow needs no special casing — a
+lane's pc simply differs; traps park a lane (trap != 0) without unwinding,
+the host harvests results when all lanes halt.
+
+This is the moral replacement of the reference's dispatch loop
+(/root/reference/lib/executor/engine/engine.cpp:68-1641): the `switch`
+becomes masked class handlers, `StackManager` becomes [depth, lanes] int32
+planes, MemoryInstance becomes a [words, lanes] plane with software bounds
+checks, Statistics/StopToken become per-lane retired/fuel counters
+(SURVEY.md §2.10, §5.1-5.3).
+
+State layout is depth-major ([depth, lanes]) so converged lanes hit
+dynamic-slice-friendly rows and the lane dim vectorizes on the VPU; the
+pallas kernel (batch/pallas_engine.py) consumes the same layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from wasmedge_tpu.common.configure import BatchConfigure
+from wasmedge_tpu.common.errors import ErrCode
+from wasmedge_tpu.batch.image import (
+    ALU1_SUB,
+    ALU2_F32_BASE,
+    ALU2_I32_BASE,
+    ALU2_I64_BASE,
+    CLS_ALU1,
+    CLS_ALU2,
+    CLS_BR,
+    CLS_BR_TABLE,
+    CLS_BRNZ,
+    CLS_BRZ,
+    CLS_CALL,
+    CLS_CALL_INDIRECT,
+    CLS_CONST,
+    CLS_DROP,
+    CLS_GLOBAL_GET,
+    CLS_GLOBAL_SET,
+    CLS_LOAD,
+    CLS_LOCAL_GET,
+    CLS_LOCAL_SET,
+    CLS_LOCAL_TEE,
+    CLS_MEMGROW,
+    CLS_MEMSIZE,
+    CLS_RETURN,
+    CLS_SELECT,
+    CLS_STORE,
+    CLS_TRAP,
+    TRAP_DONE,
+    DeviceImage,
+    _F32_BIN,
+    _I32_BIN,
+)
+
+_PAGE_WORDS = 65536 // 4
+
+
+class BatchState(NamedTuple):
+    pc: object
+    sp: object
+    fp: object
+    opbase: object
+    call_depth: object
+    trap: object
+    retired: object
+    fuel: object
+    mem_pages: object
+    stack_lo: object
+    stack_hi: object
+    fr_ret_pc: object
+    fr_fp: object
+    fr_opbase: object
+    glob_lo: object
+    glob_hi: object
+    mem: object
+
+
+@dataclasses.dataclass
+class BatchResult:
+    results: List[np.ndarray]  # one [lanes] int64 raw-cell array per result
+    # trap[k]: TRAP_DONE (-1) = finished, >0 = ErrCode trap, 0 = lane was
+    # STILL RUNNING when max_steps ran out — its results slot is garbage;
+    # check `completed` before consuming results.
+    trap: np.ndarray
+    retired: np.ndarray  # [lanes] instructions retired
+    steps: int  # lockstep iterations executed
+
+    @property
+    def completed(self) -> np.ndarray:
+        """Mask of lanes that finished normally (results valid)."""
+        return self.trap == TRAP_DONE
+
+
+def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
+    """Build the jittable single-step function closed over image constants."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    I32 = jnp.int32
+    D = cfg.value_stack_depth
+    CD = cfg.call_stack_depth
+    lane_iota = jnp.arange(lanes, dtype=I32)
+
+    cls_t = jnp.asarray(img.cls)
+    sub_t = jnp.asarray(img.sub)
+    a_t = jnp.asarray(img.a)
+    b_t = jnp.asarray(img.b)
+    c_t = jnp.asarray(img.c)
+    ilo_t = jnp.asarray(img.imm_lo)
+    ihi_t = jnp.asarray(img.imm_hi)
+    brt_t = jnp.asarray(img.br_table)  # [n, 3]
+    f_entry = jnp.asarray(img.f_entry)
+    f_nparams = jnp.asarray(img.f_nparams)
+    f_nlocals = jnp.asarray(img.f_nlocals)
+    f_frame_top = jnp.asarray(img.f_frame_top)
+    f_type = jnp.asarray(img.f_type)
+    table0 = jnp.asarray(img.table0)
+    mem_words_total = img.mem_pages_max * _PAGE_WORDS if img.mem_pages_max else 1
+    fuel_enabled = cfg.fuel_per_launch is not None
+
+    # ALU sub ids
+    S_I32 = {n: ALU2_I32_BASE + i for i, n in enumerate(_I32_BIN)}
+    S_I64 = {n: ALU2_I64_BASE + i for i, n in enumerate(_I32_BIN)}
+    S_F32 = {n: ALU2_F32_BASE + i for i, n in enumerate(_F32_BIN)}
+    A1 = ALU1_SUB
+
+    def gat(plane, idx):
+        """plane [D?, lanes] gathered at per-lane row idx -> [lanes]."""
+        idx = jnp.clip(idx, 0, plane.shape[0] - 1)
+        return jnp.take_along_axis(plane, idx[None, :], axis=0)[0]
+
+    def scat(plane, idx, vals, mask):
+        idx = jnp.clip(idx, 0, plane.shape[0] - 1)
+        cur = jnp.take_along_axis(plane, idx[None, :], axis=0)[0]
+        new = jnp.where(mask, vals, cur)
+        return plane.at[idx, lane_iota].set(new)
+
+    def sel_chain(sub, pairs, default):
+        out = default
+        for sid, val in pairs:
+            out = jnp.where(sub == sid, val, out)
+        return out
+
+    b2i = lo_ops.b2i
+    u_lt = lo_ops.u_lt
+
+    def step(st: BatchState) -> BatchState:
+        active = st.trap == 0
+        pc = jnp.clip(st.pc, 0, img.code_len - 1)
+        cls = cls_t[pc]
+        sub = sub_t[pc]
+        a = a_t[pc]
+        b = b_t[pc]
+        c = c_t[pc]
+        ilo = ilo_t[pc]
+        ihi = ihi_t[pc]
+        sp, fp, opbase = st.sp, st.fp, st.opbase
+
+        # ---- operand prefetch (top 3 cells + addressed local/global) ----
+        v0_lo = gat(st.stack_lo, sp - 1)
+        v0_hi = gat(st.stack_hi, sp - 1)
+        v1_lo = gat(st.stack_lo, sp - 2)
+        v1_hi = gat(st.stack_hi, sp - 2)
+        v2_lo = gat(st.stack_lo, sp - 3)
+        v2_hi = gat(st.stack_hi, sp - 3)
+        loc_lo = gat(st.stack_lo, fp + a)
+        loc_hi = gat(st.stack_hi, fp + a)
+        ng = st.glob_lo.shape[0]
+        gidx = jnp.clip(a, 0, ng - 1)
+        g_lo = jnp.take_along_axis(st.glob_lo, gidx[None, :], axis=0)[0]
+        g_hi = jnp.take_along_axis(st.glob_hi, gidx[None, :], axis=0)[0]
+
+        is_cls = [cls == k for k in range(23)]
+        trap = st.trap
+
+        # =================== ALU2 ===================
+        x_lo, x_hi = v1_lo, v1_hi  # first operand
+        y_lo, y_hi = v0_lo, v0_hi  # second operand
+        sh32 = y_lo & 31
+        div_guard = jnp.where(y_lo == 0, jnp.int32(1), y_lo)
+        q32 = lax.div(x_lo, div_guard)
+        r32 = lax.rem(x_lo, div_guard)
+        # unsigned 32-bit div via f64-free route: use i64-pair division only
+        # for i64; for u32 use bit trick through uint32 dtype
+        xu = x_lo.astype(jnp.uint32)
+        yu = jnp.where(y_lo == 0, jnp.uint32(1), y_lo.astype(jnp.uint32))
+        qu32 = lax.div(xu, yu).astype(I32)
+        ru32 = lax.rem(xu, yu).astype(I32)
+
+        i32_pairs = [
+            (S_I32["add"], x_lo + y_lo),
+            (S_I32["sub"], x_lo - y_lo),
+            (S_I32["mul"], x_lo * y_lo),
+            (S_I32["div_s"], q32),
+            (S_I32["div_u"], qu32),
+            (S_I32["rem_s"], r32),
+            (S_I32["rem_u"], ru32),
+            (S_I32["and"], x_lo & y_lo),
+            (S_I32["or"], x_lo | y_lo),
+            (S_I32["xor"], x_lo ^ y_lo),
+            (S_I32["shl"], lax.shift_left(x_lo, sh32)),
+            (S_I32["shr_s"], lax.shift_right_arithmetic(x_lo, sh32)),
+            (S_I32["shr_u"], lax.shift_right_logical(x_lo, sh32)),
+            (S_I32["rotl"], lo_ops.rotl32(x_lo, y_lo)),
+            (S_I32["rotr"], lo_ops.rotl32(x_lo, (32 - (y_lo & 31)) & 31)),
+            (S_I32["eq"], b2i(x_lo == y_lo)),
+            (S_I32["ne"], b2i(x_lo != y_lo)),
+            (S_I32["lt_s"], b2i(x_lo < y_lo)),
+            (S_I32["lt_u"], b2i(u_lt(x_lo, y_lo))),
+            (S_I32["gt_s"], b2i(x_lo > y_lo)),
+            (S_I32["gt_u"], b2i(u_lt(y_lo, x_lo))),
+            (S_I32["le_s"], b2i(x_lo <= y_lo)),
+            (S_I32["le_u"], b2i(lo_ops.u_le(x_lo, y_lo))),
+            (S_I32["ge_s"], b2i(x_lo >= y_lo)),
+            (S_I32["ge_u"], b2i(lo_ops.u_le(y_lo, x_lo))),
+        ]
+
+        add64 = lo_ops.add64(x_lo, x_hi, y_lo, y_hi)
+        sub64 = lo_ops.sub64(x_lo, x_hi, y_lo, y_hi)
+        mul64 = lo_ops.mul64(x_lo, x_hi, y_lo, y_hi)
+        sh64 = y_lo & 63
+        shl64 = lo_ops.shl64(x_lo, x_hi, sh64)
+        shrs64 = lo_ops.shr64_s(x_lo, x_hi, sh64)
+        shru64 = lo_ops.shr64_u(x_lo, x_hi, sh64)
+        rotl64 = lo_ops.rotl64(x_lo, x_hi, sh64)
+        rotr64 = lo_ops.rotr64(x_lo, x_hi, sh64)
+        eq64 = lo_ops.eq64(x_lo, x_hi, y_lo, y_hi)
+        lts64 = lo_ops.lt64_s(x_lo, x_hi, y_lo, y_hi)
+        ltu64 = lo_ops.lt64_u(x_lo, x_hi, y_lo, y_hi)
+        gts64 = lo_ops.lt64_s(y_lo, y_hi, x_lo, x_hi)
+        gtu64 = lo_ops.lt64_u(y_lo, y_hi, x_lo, x_hi)
+
+        i64_pairs = [
+            (S_I64["add"], add64),
+            (S_I64["sub"], sub64),
+            (S_I64["mul"], mul64),
+            (S_I64["and"], (x_lo & y_lo, x_hi & y_hi)),
+            (S_I64["or"], (x_lo | y_lo, x_hi | y_hi)),
+            (S_I64["xor"], (x_lo ^ y_lo, x_hi ^ y_hi)),
+            (S_I64["shl"], shl64),
+            (S_I64["shr_s"], shrs64),
+            (S_I64["shr_u"], shru64),
+            (S_I64["rotl"], rotl64),
+            (S_I64["rotr"], rotr64),
+        ]
+        i64_cmp_pairs = [
+            (S_I64["eq"], b2i(eq64)),
+            (S_I64["ne"], b2i(~eq64)),
+            (S_I64["lt_s"], b2i(lts64)),
+            (S_I64["lt_u"], b2i(ltu64)),
+            (S_I64["gt_s"], b2i(gts64)),
+            (S_I64["gt_u"], b2i(gtu64)),
+            (S_I64["le_s"], b2i(~gts64)),
+            (S_I64["le_u"], b2i(~gtu64)),
+            (S_I64["ge_s"], b2i(~lts64)),
+            (S_I64["ge_u"], b2i(~ltu64)),
+        ]
+
+        # rare i64 div/rem under an any-lane conditional (64-iteration loop)
+        is_alu2 = is_cls[CLS_ALU2]
+        rare_divs = is_alu2 & (
+            (sub == S_I64["div_s"]) | (sub == S_I64["div_u"])
+            | (sub == S_I64["rem_s"]) | (sub == S_I64["rem_u"]))
+
+        def rare_compute(_):
+            glo = jnp.where((y_lo | y_hi) == 0, jnp.int32(1), y_lo)
+            ghi = jnp.where((y_lo | y_hi) == 0, jnp.int32(0), y_hi)
+            qlo, qhi, rlo, rhi = lo_ops.divmod64_u(x_lo, x_hi, glo, ghi)
+            sqlo, sqhi, srlo, srhi = lo_ops.div64_s(x_lo, x_hi, glo, ghi)
+            dlo = sel_chain(sub, [
+                (S_I64["div_s"], sqlo), (S_I64["div_u"], qlo),
+                (S_I64["rem_s"], srlo), (S_I64["rem_u"], rlo)], x_lo)
+            dhi = sel_chain(sub, [
+                (S_I64["div_s"], sqhi), (S_I64["div_u"], qhi),
+                (S_I64["rem_s"], srhi), (S_I64["rem_u"], rhi)], x_hi)
+            return dlo, dhi
+
+        rare_lo, rare_hi = lax.cond(
+            jnp.any(rare_divs & active), rare_compute,
+            lambda _: (x_lo, x_hi), operand=None)
+
+        # f32
+        fx = lo_ops.to_f32(x_lo)
+        fy = lo_ops.to_f32(y_lo)
+        fadd = lo_ops.canon32(lo_ops.from_f32(fx + fy))
+        fsub = lo_ops.canon32(lo_ops.from_f32(fx - fy))
+        fmul = lo_ops.canon32(lo_ops.from_f32(fx * fy))
+        fdiv = lo_ops.canon32(lo_ops.from_f32(fx / fy))
+        f32_pairs = [
+            (S_F32["add"], fadd), (S_F32["sub"], fsub),
+            (S_F32["mul"], fmul), (S_F32["div"], fdiv),
+            (S_F32["min"], lo_ops.f32_min(x_lo, y_lo)),
+            (S_F32["max"], lo_ops.f32_max(x_lo, y_lo)),
+            (S_F32["copysign"],
+             (x_lo & jnp.int32(0x7FFFFFFF)) | (y_lo & lo_ops._SIGN)),
+        ]
+        # comparisons in the integer domain: exact under hardware FTZ
+        feq = lo_ops.f32_cmp_eq(x_lo, y_lo)
+        flt = lo_ops.f32_cmp_lt(x_lo, y_lo)
+        fgt = lo_ops.f32_cmp_lt(y_lo, x_lo)
+        fnan = lo_ops.is_nan32(x_lo) | lo_ops.is_nan32(y_lo)
+        f32_pairs += [
+            (S_F32["eq"], b2i(feq)), (S_F32["ne"], b2i(~feq)),
+            (S_F32["lt"], b2i(flt)), (S_F32["gt"], b2i(fgt)),
+            (S_F32["le"], b2i((flt | feq) & ~fnan)),
+            (S_F32["ge"], b2i((fgt | feq) & ~fnan)),
+        ]
+
+        alu2_lo = sel_chain(sub, i32_pairs + i64_cmp_pairs + f32_pairs
+                            + [(s, v[0]) for s, v in i64_pairs], jnp.int32(0))
+        alu2_hi = sel_chain(sub, [(s, v[1]) for s, v in i64_pairs], jnp.int32(0))
+        alu2_lo = jnp.where(rare_divs, rare_lo, alu2_lo)
+        alu2_hi = jnp.where(rare_divs, rare_hi, alu2_hi)
+
+        # ALU2 traps: i32/i64 division
+        div_i32 = is_alu2 & ((sub == S_I32["div_s"]) | (sub == S_I32["div_u"])
+                             | (sub == S_I32["rem_s"]) | (sub == S_I32["rem_u"]))
+        div_by_zero = (div_i32 & (y_lo == 0)) | (rare_divs & ((y_lo | y_hi) == 0))
+        int_min32 = x_lo == jnp.int32(-0x80000000)
+        ovf32 = is_alu2 & (sub == S_I32["div_s"]) & int_min32 & (y_lo == -1)
+        int_min64 = (x_lo == 0) & (x_hi == jnp.int32(-0x80000000))
+        ovf64 = rare_divs & (sub == S_I64["div_s"]) & int_min64 & \
+            (y_lo == -1) & (y_hi == -1)
+        alu2_trap = jnp.where(div_by_zero, int(ErrCode.DivideByZero), 0)
+        alu2_trap = jnp.where(ovf32 | ovf64, int(ErrCode.IntegerOverflow),
+                              alu2_trap)
+
+        # =================== ALU1 ===================
+        w_lo, w_hi = v0_lo, v0_hi
+        fw = lo_ops.to_f32(w_lo)
+        ext8 = lax.shift_right_arithmetic(lax.shift_left(w_lo, 24), 24)
+        ext16 = lax.shift_right_arithmetic(lax.shift_left(w_lo, 16), 16)
+        sign_w = lax.shift_right_arithmetic(w_lo, 31)
+        # f32 -> i32 trunc with trap/sat handling
+        tr = jnp.where(fw < 0, lax.ceil(fw), lax.floor(fw))
+        # bit-domain NaN test: exact under hardware FTZ, same as uniform.py
+        nan_w = lo_ops.is_nan32(w_lo)
+        in_s = (tr >= jnp.float32(-2147483648.0)) & (tr <= jnp.float32(2147483520.0))
+        # 2147483520 = largest f32 below 2^31
+        trunc_s_val = jnp.where(in_s & ~nan_w, tr, jnp.float32(0)).astype(I32)
+        in_u = (tr >= 0) & (tr <= jnp.float32(4294967040.0))
+        tr_u_shift = jnp.where(in_u & ~nan_w, tr, jnp.float32(0))
+        trunc_u_val = jnp.where(
+            tr_u_shift >= jnp.float32(2147483648.0),
+            (tr_u_shift - jnp.float32(4294967296.0)).astype(I32),
+            tr_u_shift.astype(I32))
+        sat_s = jnp.where(nan_w, 0, jnp.where(
+            tr < jnp.float32(-2147483648.0), jnp.int32(-0x80000000), jnp.where(
+                tr > jnp.float32(2147483520.0), jnp.int32(0x7FFFFFFF),
+                trunc_s_val)))
+        sat_u = jnp.where(nan_w | (tr < 0), 0, jnp.where(
+            tr > jnp.float32(4294967040.0), jnp.int32(-1), trunc_u_val))
+        # i32 -> f32 converts
+        cvt_s = lo_ops.from_f32(w_lo.astype(jnp.float32))
+        cvt_u = lo_ops.from_f32(w_lo.astype(jnp.uint32).astype(jnp.float32))
+
+        alu1_pairs_lo = [
+            (A1["i32.clz"], lax.clz(w_lo)),
+            (A1["i32.ctz"], lo_ops.ctz32(w_lo)),
+            (A1["i32.popcnt"], lax.population_count(w_lo)),
+            (A1["i32.eqz"], b2i(w_lo == 0)),
+            (A1["i32.extend8_s"], ext8),
+            (A1["i32.extend16_s"], ext16),
+            (A1["i64.clz"], lo_ops.clz64(w_lo, w_hi)),
+            (A1["i64.ctz"], lo_ops.ctz64(w_lo, w_hi)),
+            (A1["i64.popcnt"], lo_ops.popcnt64(w_lo, w_hi)),
+            (A1["i64.eqz"], b2i((w_lo | w_hi) == 0)),
+            (A1["i64.extend8_s"], ext8),
+            (A1["i64.extend16_s"], ext16),
+            (A1["i64.extend32_s"], w_lo),
+            (A1["f32.abs"], w_lo & jnp.int32(0x7FFFFFFF)),
+            (A1["f32.neg"], w_lo ^ lo_ops._SIGN),
+            (A1["f32.ceil"], lo_ops.canon32(lo_ops.from_f32(lax.ceil(fw)))),
+            (A1["f32.floor"], lo_ops.canon32(lo_ops.from_f32(lax.floor(fw)))),
+            (A1["f32.trunc"], lo_ops.f32_trunc(w_lo)),
+            (A1["f32.nearest"], lo_ops.f32_nearest(w_lo)),
+            (A1["f32.sqrt"], lo_ops.canon32(lo_ops.from_f32(lax.sqrt(fw)))),
+            (A1["i32.wrap_i64"], w_lo),
+            (A1["i64.extend_i32_s"], w_lo),
+            (A1["i64.extend_i32_u"], w_lo),
+            (A1["i32.trunc_f32_s"], trunc_s_val),
+            (A1["i32.trunc_f32_u"], trunc_u_val),
+            (A1["i32.trunc_sat_f32_s"], sat_s),
+            (A1["i32.trunc_sat_f32_u"], sat_u),
+            (A1["f32.convert_i32_s"], cvt_s),
+            (A1["f32.convert_i32_u"], cvt_u),
+            (A1["i32.reinterpret_f32"], w_lo),
+            (A1["f32.reinterpret_i32"], w_lo),
+            (A1["ref.is_null"], b2i((w_lo | w_hi) == 0)),
+        ]
+        alu1_pairs_hi = [
+            (A1["i64.clz"], jnp.int32(0)),
+            (A1["i64.ctz"], jnp.int32(0)),
+            (A1["i64.popcnt"], jnp.int32(0)),
+            (A1["i64.extend8_s"], lax.shift_right_arithmetic(ext8, 31)),
+            (A1["i64.extend16_s"], lax.shift_right_arithmetic(ext16, 31)),
+            (A1["i64.extend32_s"], sign_w),
+            (A1["i64.extend_i32_s"], sign_w),
+            (A1["i64.extend_i32_u"], jnp.int32(0)),
+        ]
+        alu1_lo = sel_chain(sub, alu1_pairs_lo, w_lo)
+        alu1_hi = sel_chain(sub, alu1_pairs_hi, jnp.int32(0))
+        is_alu1 = is_cls[CLS_ALU1]
+        trunc_traps = is_alu1 & (
+            ((sub == A1["i32.trunc_f32_s"]) & (nan_w | ~in_s))
+            | ((sub == A1["i32.trunc_f32_u"]) & (nan_w | ~in_u)))
+        alu1_trap = jnp.where(
+            trunc_traps & nan_w, int(ErrCode.InvalidConvToInt),
+            jnp.where(trunc_traps, int(ErrCode.IntegerOverflow), 0))
+
+        # =================== memory ===================
+        is_load = is_cls[CLS_LOAD]
+        is_store = is_cls[CLS_STORE]
+        addr_base = jnp.where(is_store, v1_lo, v0_lo)
+        ea = addr_base + a  # u32 wrap
+        ea_carry = u_lt(ea, addr_base) | u_lt(ea, a)
+        nbytes = b
+        mem_bytes = st.mem_pages * jnp.int32(65536)
+        end = ea + nbytes
+        mem_oob = ea_carry | u_lt(end, ea) | u_lt(mem_bytes, end)
+        widx = lax.shift_right_logical(ea, 2)
+        shB = (ea & 3) * 8
+        mw0 = gat(st.mem, widx)
+        mw1 = gat(st.mem, widx + 1)
+        mw2 = gat(st.mem, widx + 2)
+        inv_sh = (32 - shB) & 31
+        hi_or = jnp.where(shB == 0, 0, -1)
+        raw_lo = lax.shift_right_logical(mw0, shB) | \
+            (lax.shift_left(mw1, inv_sh) & hi_or)
+        raw_hi = lax.shift_right_logical(mw1, shB) | \
+            (lax.shift_left(mw2, inv_sh) & hi_or)
+        signed = (c & 1) != 0
+        is64 = (c & 2) != 0
+        b1 = nbytes == 1
+        b2 = nbytes == 2
+        b4 = nbytes == 4
+        lraw = jnp.where(b1, raw_lo & 0xFF,
+                         jnp.where(b2, raw_lo & 0xFFFF, raw_lo))
+        lsext = jnp.where(
+            b1, lax.shift_right_arithmetic(lax.shift_left(raw_lo, 24), 24),
+            jnp.where(b2, lax.shift_right_arithmetic(lax.shift_left(raw_lo, 16), 16),
+                      raw_lo))
+        load_lo = jnp.where(signed, lsext, lraw)
+        load_hi = jnp.where(
+            is64,
+            jnp.where(nbytes == 8, raw_hi,
+                      jnp.where(signed, lax.shift_right_arithmetic(load_lo, 31), 0)),
+            jnp.int32(0))
+
+        # stores: build 3-word write masks and values
+        full_m_lo = jnp.where(b1, 0xFF, jnp.where(b2, 0xFFFF, jnp.int32(-1)))
+        full_m_hi = jnp.where(nbytes == 8, jnp.int32(-1), 0)
+        sm0, sm1 = lo_ops.shl64(full_m_lo, full_m_hi, shB)
+        sm2 = jnp.where(shB == 0, 0,
+                        lo_ops.shr64_u(full_m_lo, full_m_hi, 64 - shB)[0])
+        sv0, sv1 = lo_ops.shl64(v0_lo, v0_hi, shB)
+        sv2 = jnp.where(shB == 0, 0,
+                        lo_ops.shr64_u(v0_lo, v0_hi, 64 - shB)[0])
+        nw0 = (mw0 & ~sm0) | (sv0 & sm0)
+        nw1 = (mw1 & ~sm1) | (sv1 & sm1)
+        nw2 = (mw2 & ~sm2) | (sv2 & sm2)
+        store_ok = active & is_store & ~mem_oob
+        mem_plane = st.mem
+        mem_plane = scat(mem_plane, widx, nw0, store_ok & (sm0 != 0))
+        mem_plane = scat(mem_plane, widx + 1, nw1, store_ok & (sm1 != 0))
+        mem_plane = scat(mem_plane, widx + 2, nw2, store_ok & (sm2 != 0))
+
+        is_grow = is_cls[CLS_MEMGROW]
+        grow_delta = v0_lo
+        grow_ok = ~u_lt(jnp.int32(img.mem_pages_max), st.mem_pages + grow_delta) \
+            & (grow_delta >= 0) & ((st.mem_pages + grow_delta) >= st.mem_pages)
+        grow_res = jnp.where(grow_ok, st.mem_pages, jnp.int32(-1))
+        new_mem_pages = jnp.where(active & is_grow & grow_ok,
+                                  st.mem_pages + grow_delta, st.mem_pages)
+
+        # =================== branches ===================
+        is_br = is_cls[CLS_BR]
+        is_brz = is_cls[CLS_BRZ]
+        is_brnz = is_cls[CLS_BRNZ]
+        is_brt = is_cls[CLS_BR_TABLE]
+        cond_zero = v0_lo == 0
+        brnz_taken = is_brnz & ~cond_zero
+        bt_i = jnp.where(u_lt(b, v0_lo), b, v0_lo)  # unsigned clamp to default
+        bt_entry = jnp.clip(a + bt_i, 0, brt_t.shape[0] - 1)
+        bt_tgt = brt_t[bt_entry, 0]
+        bt_keep = brt_t[bt_entry, 1]
+        bt_pop = brt_t[bt_entry, 2]
+
+        # =================== call / return ===================
+        is_call = is_cls[CLS_CALL]
+        is_calli = is_cls[CLS_CALL_INDIRECT]
+        is_callany = is_call | is_calli
+        tsize = table0.shape[0]
+        ti = jnp.clip(v0_lo, 0, tsize - 1)
+        t_h = table0[ti]
+        ti_oob = is_calli & (u_lt(jnp.int32(tsize - 1), v0_lo) | (v0_lo < 0))
+        ti_null = is_calli & ~ti_oob & (t_h == 0)
+        callee = jnp.where(is_calli, jnp.clip(t_h - 1, 0, f_entry.shape[0] - 1),
+                           jnp.clip(a, 0, f_entry.shape[0] - 1))
+        sig_bad = is_calli & ~ti_oob & ~ti_null & (f_type[callee] != a)
+        c_entry = f_entry[callee]
+        c_nparams = f_nparams[callee]
+        c_nlocals = f_nlocals[callee]
+        c_frame_top = f_frame_top[callee]
+        sp_eff = jnp.where(is_calli, sp - 1, sp)
+        fp_new = sp_eff - c_nparams
+        opbase_new = fp_new + c_nlocals
+        # CD-1, not CD: the scalar engine's entry sentinel frame counts
+        # toward max_call_depth, so nesting capacity is depth-1 calls
+        depth_ovf = is_callany & (st.call_depth >= CD - 1)
+        stack_ovf = is_callany & (fp_new + c_frame_top > D)
+        call_trap = jnp.where(ti_oob, int(ErrCode.UndefinedElement), 0)
+        call_trap = jnp.where(ti_null, int(ErrCode.UninitializedElement), call_trap)
+        call_trap = jnp.where(sig_bad, int(ErrCode.IndirectCallTypeMismatch), call_trap)
+        call_trap = jnp.where(depth_ovf, int(ErrCode.CallStackExhausted), call_trap)
+        call_trap = jnp.where(stack_ovf, int(ErrCode.StackOverflow), call_trap)
+        call_ok = active & is_callany & (call_trap == 0)
+
+        # frame push
+        fr_ret_pc = scat(st.fr_ret_pc, st.call_depth, pc + 1, call_ok)
+        fr_fp = scat(st.fr_fp, st.call_depth, fp, call_ok)
+        fr_opbase = scat(st.fr_opbase, st.call_depth, opbase, call_ok)
+
+        # return
+        is_ret = is_cls[CLS_RETURN]
+        ret_done = is_ret & (st.call_depth == 0)
+        rd = jnp.clip(st.call_depth - 1, 0, CD - 1)
+        r_pc = gat(st.fr_ret_pc, rd)
+        r_fp = gat(st.fr_fp, rd)
+        r_opbase = gat(st.fr_opbase, rd)
+        nres = b  # CLS_RETURN carries result count in b
+
+        # =================== merge: stack top write ===================
+        is_const = is_cls[CLS_CONST]
+        is_lget = is_cls[CLS_LOCAL_GET]
+        is_gget = is_cls[CLS_GLOBAL_GET]
+        is_msize = is_cls[CLS_MEMSIZE]
+        is_sel = is_cls[CLS_SELECT]
+        sel_lo = jnp.where(cond_zero, v1_lo, v2_lo)
+        sel_hi = jnp.where(cond_zero, v1_hi, v2_hi)
+
+        wpos = sp  # default for push-class
+        wlo = ilo
+        whi = ihi
+        does_write = is_const
+        for m, pos, lo_v, hi_v in (
+            (is_lget, sp, loc_lo, loc_hi),
+            (is_gget, sp, g_lo, g_hi),
+            (is_msize, sp, st.mem_pages, jnp.zeros_like(st.mem_pages)),
+            (is_alu1, sp - 1, alu1_lo, alu1_hi),
+            (is_grow, sp - 1, grow_res, jnp.zeros_like(grow_res)),
+            (is_load & ~mem_oob, sp - 1, load_lo, load_hi),
+            (is_alu2, sp - 2, alu2_lo, alu2_hi),
+            (is_sel, sp - 3, sel_lo, sel_hi),
+            (is_br & (b == 1), opbase + c, v0_lo, v0_hi),
+            (brnz_taken & (b == 1), opbase + c, v1_lo, v1_hi),
+            (is_brt & (bt_keep == 1), opbase + bt_pop, v1_lo, v1_hi),
+            (is_ret & (nres == 1), fp, v0_lo, v0_hi),
+        ):
+            wpos = jnp.where(m, pos, wpos)
+            wlo = jnp.where(m, lo_v, wlo)
+            whi = jnp.where(m, hi_v, whi)
+            does_write = does_write | m
+
+        wmask = active & does_write & (trap == 0)
+        stack_lo = scat(st.stack_lo, wpos, wlo, wmask)
+        stack_hi = scat(st.stack_hi, wpos, whi, wmask)
+
+        # locals write (set/tee)
+        is_lset = is_cls[CLS_LOCAL_SET]
+        is_ltee = is_cls[CLS_LOCAL_TEE]
+        lmask = active & (is_lset | is_ltee)
+        stack_lo = scat(stack_lo, fp + a, v0_lo, lmask)
+        stack_hi = scat(stack_hi, fp + a, v0_hi, lmask)
+
+        # zero callee locals beyond params (static unrolled window)
+        for k in range(img.max_local_zeros):
+            zpos = fp_new + c_nparams + k
+            zmask = call_ok & (k < (c_nlocals - c_nparams))
+            stack_lo = scat(stack_lo, zpos, jnp.zeros_like(v0_lo), zmask)
+            stack_hi = scat(stack_hi, zpos, jnp.zeros_like(v0_hi), zmask)
+
+        # globals write
+        is_gset = is_cls[CLS_GLOBAL_SET]
+        gmask = active & is_gset
+        gcur_lo = jnp.take_along_axis(st.glob_lo, gidx[None, :], axis=0)[0]
+        gcur_hi = jnp.take_along_axis(st.glob_hi, gidx[None, :], axis=0)[0]
+        glob_lo = st.glob_lo.at[gidx, lane_iota].set(
+            jnp.where(gmask, v0_lo, gcur_lo))
+        glob_hi = st.glob_hi.at[gidx, lane_iota].set(
+            jnp.where(gmask, v0_hi, gcur_hi))
+
+        # =================== merge: sp / pc / frames ===================
+        new_sp = sp
+        for m, v in (
+            (is_const | is_lget | is_gget | is_msize, sp + 1),
+            (is_cls[CLS_DROP] | is_lset | is_gset | is_alu2 | is_brz
+             | (is_brnz & cond_zero), sp - 1),
+            (is_cls[CLS_STORE] | is_sel, sp - 2),
+            (is_br, opbase + c + b),
+            (brnz_taken, opbase + c + b),
+            (is_brt, opbase + bt_pop + bt_keep),
+            (is_ret, fp + nres),
+            (call_ok, opbase_new),
+        ):
+            new_sp = jnp.where(m, v, new_sp)
+
+        new_pc = pc + 1
+        new_pc = jnp.where(is_br, a, new_pc)
+        new_pc = jnp.where(is_brz & cond_zero, a, new_pc)
+        new_pc = jnp.where(brnz_taken, a, new_pc)
+        new_pc = jnp.where(is_brt, bt_tgt, new_pc)
+        new_pc = jnp.where(call_ok, c_entry, new_pc)
+        new_pc = jnp.where(is_ret & ~ret_done, r_pc, new_pc)
+
+        new_fp = jnp.where(call_ok, fp_new, fp)
+        new_fp = jnp.where(is_ret & ~ret_done, r_fp, new_fp)
+        new_opbase = jnp.where(call_ok, opbase_new, opbase)
+        new_opbase = jnp.where(is_ret & ~ret_done, r_opbase, new_opbase)
+        new_depth = st.call_depth + jnp.where(call_ok, 1, 0) \
+            - jnp.where(active & is_ret & ~ret_done, 1, 0)
+
+        # =================== traps / fuel / retire ===================
+        new_trap = trap
+        for m, code in (
+            (is_cls[CLS_TRAP], a),
+            (alu2_trap != 0, alu2_trap),
+            (alu1_trap != 0, alu1_trap),
+            ((is_load | is_store) & mem_oob,
+             jnp.int32(int(ErrCode.MemoryOutOfBounds))),
+            (is_callany & (call_trap != 0), call_trap),
+            (ret_done, jnp.int32(TRAP_DONE)),
+        ):
+            new_trap = jnp.where(active & m, code, new_trap)
+
+        new_retired = st.retired + b2i(active)
+        if fuel_enabled:
+            new_fuel = st.fuel - b2i(active)
+            new_trap = jnp.where(active & (new_fuel <= 0) & (new_trap == 0),
+                                 int(ErrCode.CostLimitExceeded), new_trap)
+        else:
+            new_fuel = st.fuel
+
+        # lanes that trapped THIS step keep their pre-step control state
+        halted_now = active & (new_trap != 0)
+        new_pc = jnp.where(halted_now, pc, new_pc)
+        keep = ~halted_now & active
+        return BatchState(
+            pc=jnp.where(keep, new_pc, st.pc),
+            sp=jnp.where(keep, new_sp, jnp.where(ret_done, fp + nres, st.sp)),
+            fp=jnp.where(keep, new_fp, st.fp),
+            opbase=jnp.where(keep, new_opbase, st.opbase),
+            call_depth=jnp.where(keep, new_depth, st.call_depth),
+            trap=new_trap,
+            retired=new_retired,
+            fuel=new_fuel,
+            mem_pages=new_mem_pages,
+            stack_lo=stack_lo,
+            stack_hi=stack_hi,
+            fr_ret_pc=fr_ret_pc,
+            fr_fp=fr_fp,
+            fr_opbase=fr_opbase,
+            glob_lo=glob_lo,
+            glob_hi=glob_hi,
+            mem=mem_plane,
+        )
+
+    return step
+
+
+class BatchEngine:
+    """Runs one module's exported function over N lanes in lockstep.
+
+    Engine-facing analog of Executor::invoke for the tpu_batch engine
+    (SURVEY.md §2.10): construct from an instantiated module, call run()
+    with per-lane argument arrays.
+    """
+
+    def __init__(self, inst, store=None, conf=None, lanes: Optional[int] = None,
+                 mesh=None):
+        from wasmedge_tpu.common.configure import Configure
+        from wasmedge_tpu.batch.image import batchability, build_device_image
+
+        self.mesh = mesh  # lane-sharded multi-chip execution (parallel/mesh.py)
+        self.conf = conf or Configure()
+        cfg = self.conf.batch
+        self.cfg = cfg
+        self.lanes = lanes or cfg.lanes
+        self.inst = inst
+        reason = batchability(inst.lowered)
+        if reason is not None:
+            raise ValueError(f"module not batchable: {reason}")
+        self.img = build_device_image(
+            inst.lowered, memories=inst.memories, globals_=inst.globals,
+            table0=self._table_snapshot(inst, store), mod=inst.ast)
+        # Static per-lane memory ceiling: the declared max clamped by the
+        # Configure knob (scalar analog: MemoryInstance.grow page_limit).
+        # A module with no declared max (mem_pages_max == 0) gets the knob
+        # value — growth beyond memory_pages_per_lane returns -1, which is
+        # the one place batch semantics are knob-dependent (static HBM
+        # allocation; set the knob >= the workload's peak for parity).
+        if self.img.mem_pages_max > 0 or self.img.mem_pages_init > 0:
+            declared = self.img.mem_pages_max \
+                if self.img.mem_pages_max > 0 else cfg.memory_pages_per_lane
+            self.img.mem_pages_max = max(
+                self.img.mem_pages_init,
+                min(declared, cfg.memory_pages_per_lane))
+        self._step = None
+        self._run_chunk = None
+
+    @staticmethod
+    def _table_snapshot(inst, store):
+        """Table image: store-interned handles -> funcidx+1 (0 = null).
+
+        Cross-module refs are unresolvable on device; batchability() already
+        gates modules whose tables could contain them (no table mutation,
+        active elems only reference local funcs)."""
+        if not inst.tables:
+            return None
+        func_index = {id(f): i for i, f in enumerate(inst.funcs)}
+        refs = []
+        for h in inst.tables[0].refs:
+            if h == 0:
+                refs.append(0)
+                continue
+            fi = store.deref_func(h) if store is not None else None
+            idx = func_index.get(id(fi)) if fi is not None else None
+            if idx is None:
+                raise ValueError("table entry references a non-local function; "
+                                 "module not batchable")
+            refs.append(idx + 1)
+        return refs
+
+    # -- execution ---------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        step = _make_step(self.img, self.cfg, self.lanes)
+        chunk = self.cfg.steps_per_launch
+
+        def run_chunk(state):
+            def cond(carry):
+                i, s = carry
+                return (i < chunk) & jnp.any(s.trap == 0)
+
+            def body(carry):
+                i, s = carry
+                return i + 1, step(s)
+
+            i, state = lax.while_loop(cond, body, (jnp.int32(0), state))
+            return i, state
+
+        if self.mesh is not None:
+            from wasmedge_tpu.parallel.mesh import state_shardings
+
+            probe = self.initial_state(0, [])
+            shardings = state_shardings(self.mesh, probe)
+            self._run_chunk = jax.jit(
+                run_chunk, in_shardings=(shardings,),
+                out_shardings=(None, shardings), donate_argnums=0)
+        else:
+            self._run_chunk = jax.jit(run_chunk, donate_argnums=0)
+        self._step = step
+
+    def initial_state(self, func_idx: int, args_lanes: List[np.ndarray]):
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        L = self.lanes
+        img = self.img
+        meta = self.inst.lowered.funcs[func_idx]
+        D = cfg.value_stack_depth
+        CD = cfg.call_stack_depth
+        stack_lo = np.zeros((D, L), np.int32)
+        stack_hi = np.zeros((D, L), np.int32)
+        for i, arg in enumerate(args_lanes):
+            arr = np.asarray(arg, dtype=np.int64)
+            if arr.ndim == 0:
+                arr = np.full(L, arr, np.int64)
+            if arr.shape != (L,):
+                raise ValueError(
+                    f"arg {i}: expected shape ({L},) (one value per lane) "
+                    f"or a scalar, got {arr.shape}")
+            stack_lo[i] = (arr & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+            stack_hi[i] = ((arr >> 32) & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+        ng = img.globals_lo.shape[0]
+        mem_words = max(img.mem_pages_max * _PAGE_WORDS, 1)
+        mem = np.zeros((mem_words, L), np.int32)
+        if img.mem_init.shape[0] > 1 or img.mem_pages_init:
+            mem[: img.mem_init.shape[0]] = img.mem_init[:, None]
+        fuel0 = cfg.fuel_per_launch if cfg.fuel_per_launch is not None else 0
+        return BatchState(
+            pc=jnp.full((L,), meta.entry_pc, jnp.int32),
+            sp=jnp.full((L,), meta.nlocals + 0, jnp.int32),
+            fp=jnp.zeros((L,), jnp.int32),
+            opbase=jnp.full((L,), meta.nlocals, jnp.int32),
+            call_depth=jnp.zeros((L,), jnp.int32),
+            trap=jnp.zeros((L,), jnp.int32),
+            retired=jnp.zeros((L,), jnp.int32),
+            fuel=jnp.full((L,), fuel0, jnp.int32),
+            mem_pages=jnp.full((L,), img.mem_pages_init, jnp.int32),
+            stack_lo=jnp.asarray(stack_lo),
+            stack_hi=jnp.asarray(stack_hi),
+            fr_ret_pc=jnp.zeros((CD, L), jnp.int32),
+            fr_fp=jnp.zeros((CD, L), jnp.int32),
+            fr_opbase=jnp.zeros((CD, L), jnp.int32),
+            glob_lo=jnp.asarray(np.repeat(img.globals_lo[:, None], L, axis=1)),
+            glob_hi=jnp.asarray(np.repeat(img.globals_hi[:, None], L, axis=1)),
+            mem=jnp.asarray(mem),
+        )
+
+    def run(self, func_name: str, args_lanes: List[np.ndarray],
+            max_steps: int = 10_000_000) -> BatchResult:
+        ex = self.inst.exports.get(func_name)
+        if ex is None or ex[0] != 0:
+            raise KeyError(f"no exported function {func_name}")
+        func_idx = ex[1]
+        if self._run_chunk is None:
+            self._build()
+        state = self.initial_state(func_idx, args_lanes)
+        if self.mesh is not None:
+            from wasmedge_tpu.parallel.mesh import shard_batch_state
+
+            state = shard_batch_state(state, self.mesh)
+        total = 0
+        while total < max_steps:
+            done_steps, state = self._run_chunk(state)
+            total += int(done_steps)
+            trap_host = np.asarray(state.trap)
+            if not (trap_host == 0).any():
+                break
+            if int(done_steps) == 0:
+                break
+        nres = int(self.inst.lowered.funcs[func_idx].nresults)
+        stack_lo = np.asarray(state.stack_lo)
+        stack_hi = np.asarray(state.stack_hi)
+        fp = np.asarray(state.fp)
+        results = []
+        for r in range(nres):
+            lo = stack_lo[r].view(np.uint32).astype(np.uint64)
+            hi = stack_hi[r].view(np.uint32).astype(np.uint64)
+            results.append((lo | (hi << np.uint64(32))).view(np.int64))
+        return BatchResult(
+            results=results,
+            trap=np.asarray(state.trap),
+            retired=np.asarray(state.retired),
+            steps=total,
+        )

@@ -1,0 +1,354 @@
+"""DeviceImage: lowered module + instance snapshot -> device-resident tables.
+
+The batch engine does not interpret the 180-op lowered ISA directly; at
+image-build time every instruction is re-encoded as (class, sub, a, b, c,
+imm_lo, imm_hi) where `class` selects one of ~20 vectorized SIMT handlers
+and `sub` selects within a handler's fused select tree (e.g. ALU2 sub 0 =
+i32.add). This is the two-level dispatch SURVEY.md §7 predicts the 439-op
+switch must become to fit a TPU kernel.
+
+`batchability()` is the feature gate: modules using ops outside the batch
+subset (f64 arithmetic, i64<->f32 conversions, table mutation, bulk memory,
+multi-value arities > 1, host calls) report a reason and fall back to the
+scalar/native engine through the Configure seam — the same graceful
+degradation the reference uses when an AOT section mismatches
+(/root/reference/lib/loader/ast/module.cpp:279-326).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from wasmedge_tpu.common.errors import ErrCode
+from wasmedge_tpu.common.opcodes import NAME_TO_ID, Op, name_of
+from wasmedge_tpu.common.types import PAGE_SIZE
+from wasmedge_tpu.validator.image import LOP_BR, LOP_BRNZ, LOP_BRZ, LoweredModule
+
+# -- opcode classes ---------------------------------------------------------
+CLS_NOP = 0
+CLS_CONST = 1
+CLS_LOCAL_GET = 2
+CLS_LOCAL_SET = 3
+CLS_LOCAL_TEE = 4
+CLS_GLOBAL_GET = 5
+CLS_GLOBAL_SET = 6
+CLS_ALU1 = 7
+CLS_ALU2 = 8
+CLS_SELECT = 9
+CLS_DROP = 10
+CLS_BR = 11
+CLS_BRZ = 12
+CLS_BRNZ = 13
+CLS_BR_TABLE = 14
+CLS_RETURN = 15
+CLS_CALL = 16
+CLS_CALL_INDIRECT = 17
+CLS_LOAD = 18
+CLS_STORE = 19
+CLS_MEMSIZE = 20
+CLS_MEMGROW = 21
+CLS_TRAP = 22
+NUM_CLASSES = 23
+
+# -- ALU2 sub-ops (binary: pop2 push1) --------------------------------------
+_I32_BIN = ["add", "sub", "mul", "div_s", "div_u", "rem_s", "rem_u", "and",
+            "or", "xor", "shl", "shr_s", "shr_u", "rotl", "rotr",
+            "eq", "ne", "lt_s", "lt_u", "gt_s", "gt_u", "le_s", "le_u",
+            "ge_s", "ge_u"]
+_F32_BIN = ["add", "sub", "mul", "div", "min", "max", "copysign",
+            "eq", "ne", "lt", "gt", "le", "ge"]
+
+ALU2_I32_BASE = 0
+ALU2_I64_BASE = len(_I32_BIN)           # 25
+ALU2_F32_BASE = 2 * len(_I32_BIN)       # 50
+NUM_ALU2 = ALU2_F32_BASE + len(_F32_BIN)  # 63
+
+# i64 div/rem are "rare" subs: executed under an any-lane cond (64-iter loop)
+RARE_ALU2_SUBS = tuple(ALU2_I64_BASE + _I32_BIN.index(n)
+                       for n in ("div_s", "div_u", "rem_s", "rem_u"))
+
+# -- ALU1 sub-ops (unary: pop1 push1) ---------------------------------------
+_ALU1 = [
+    "i32.clz", "i32.ctz", "i32.popcnt", "i32.eqz",
+    "i32.extend8_s", "i32.extend16_s",
+    "i64.clz", "i64.ctz", "i64.popcnt", "i64.eqz",
+    "i64.extend8_s", "i64.extend16_s", "i64.extend32_s",
+    "f32.abs", "f32.neg", "f32.ceil", "f32.floor", "f32.trunc",
+    "f32.nearest", "f32.sqrt",
+    "i32.wrap_i64", "i64.extend_i32_s", "i64.extend_i32_u",
+    "i32.trunc_f32_s", "i32.trunc_f32_u",
+    "i32.trunc_sat_f32_s", "i32.trunc_sat_f32_u",
+    "f32.convert_i32_s", "f32.convert_i32_u",
+    "i32.reinterpret_f32", "f32.reinterpret_i32",
+    "ref.is_null",
+]
+ALU1_SUB = {n: i for i, n in enumerate(_ALU1)}
+NUM_ALU1 = len(_ALU1)
+
+# -- loads/stores -----------------------------------------------------------
+_LOADS = {
+    "i32.load": (4, 0, 0), "i64.load": (8, 0, 1), "f32.load": (4, 0, 0),
+    "f64.load": (8, 0, 1),
+    "i32.load8_s": (1, 1, 0), "i32.load8_u": (1, 0, 0),
+    "i32.load16_s": (2, 1, 0), "i32.load16_u": (2, 0, 0),
+    "i64.load8_s": (1, 1, 1), "i64.load8_u": (1, 0, 1),
+    "i64.load16_s": (2, 1, 1), "i64.load16_u": (2, 0, 1),
+    "i64.load32_s": (4, 1, 1), "i64.load32_u": (4, 0, 1),
+}
+_STORES = {
+    "i32.store": 4, "i64.store": 8, "f32.store": 4, "f64.store": 8,
+    "i32.store8": 1, "i32.store16": 2,
+    "i64.store8": 1, "i64.store16": 2, "i64.store32": 4,
+}
+
+# Ops outside the batch subset (v1). Modules containing them in *reachable
+# batched code* fall back to the scalar engine.
+_UNSUPPORTED_PREFIXES = ("f64.",)
+_UNSUPPORTED_NAMES = {
+    "i64.trunc_f32_s", "i64.trunc_f32_u", "i64.trunc_f64_s", "i64.trunc_f64_u",
+    "i32.trunc_f64_s", "i32.trunc_f64_u",
+    "i64.trunc_sat_f32_s", "i64.trunc_sat_f32_u",
+    "i64.trunc_sat_f64_s", "i64.trunc_sat_f64_u",
+    "i32.trunc_sat_f64_s", "i32.trunc_sat_f64_u",
+    "f32.convert_i64_s", "f32.convert_i64_u",
+    "f64.convert_i32_s", "f64.convert_i32_u",
+    "f64.convert_i64_s", "f64.convert_i64_u",
+    "f32.demote_f64", "f64.promote_f32",
+    "i64.reinterpret_f64", "f64.reinterpret_i64",
+    "table.get", "table.set", "table.size", "table.grow", "table.fill",
+    "table.copy", "table.init", "elem.drop",
+    "memory.init", "memory.copy", "memory.fill", "data.drop",
+    "ref.func",
+    "return_call", "return_call_indirect",
+}
+
+TRAP_DONE = -1  # lane finished normally (trap plane sentinel)
+TRAP_HOSTCALL = -2  # lane waiting on a host outcall
+
+
+
+
+def _i32(v: int) -> np.int32:
+    """Wrap an unsigned value into int32 two's complement."""
+    v &= 0xFFFFFFFF
+    return np.int32(v - (1 << 32) if v >= (1 << 31) else v)
+
+
+def batchability(image: LoweredModule) -> Optional[str]:
+    """None if the module image can run on the batch engine, else reason."""
+    for fn in image.funcs:
+        if fn.is_import:
+            return f"host/imported function {fn.import_module}.{fn.import_name}"
+        if fn.nresults > 1:
+            return "multi-value results"
+    for pc in range(image.code_len):
+        op = image.op[pc]
+        if op in (LOP_BR, LOP_BRZ, LOP_BRNZ):
+            if image.b[pc] > 1:
+                return "multi-value branch arity"
+            continue
+        name = name_of(op)
+        if name == "br_table":
+            base, n = image.a[pc], image.b[pc]
+            for e in range(n + 1):
+                if image.br_table[(base + e) * 3 + 1] > 1:
+                    return "multi-value branch arity"
+            continue
+        if name == "return" and image.b[pc] > 1:
+            return "multi-value results"
+        if any(name.startswith(p) for p in _UNSUPPORTED_PREFIXES):
+            return f"unsupported op {name}"
+        if name in _UNSUPPORTED_NAMES:
+            return f"unsupported op {name}"
+    return None
+
+
+@dataclasses.dataclass
+class DeviceImage:
+    """Numpy-side image; the engine moves these to device once per module."""
+
+    # per-pc planes [code_len]
+    cls: np.ndarray
+    sub: np.ndarray
+    a: np.ndarray
+    b: np.ndarray
+    c: np.ndarray
+    imm_lo: np.ndarray
+    imm_hi: np.ndarray
+    br_table: np.ndarray  # [n_entries, 3]
+    # per-function planes [n_funcs]
+    f_entry: np.ndarray
+    f_nparams: np.ndarray
+    f_nlocals: np.ndarray
+    f_nresults: np.ndarray
+    f_frame_top: np.ndarray  # nlocals + max_height: stack room a frame needs
+    f_type: np.ndarray  # dense functype id for call_indirect checks
+    # instance snapshot
+    table0: np.ndarray  # [table_size] funcidx+1, 0=null
+    globals_lo: np.ndarray
+    globals_hi: np.ndarray
+    mem_init: np.ndarray  # [mem_words] int32 initial memory content
+    mem_pages_init: int
+    mem_pages_max: int
+    max_local_zeros: int  # max (nlocals - nparams) over funcs
+    code_len: int
+
+
+def build_device_image(image: LoweredModule, memories=None, globals_=None,
+                       table0=None, mod=None) -> DeviceImage:
+    n = image.code_len
+    cls = np.zeros(n, np.int32)
+    sub = np.zeros(n, np.int32)
+    a = np.zeros(n, np.int32)
+    b = np.zeros(n, np.int32)
+    c = np.zeros(n, np.int32)
+    imm_lo = np.zeros(n, np.int32)
+    imm_hi = np.zeros(n, np.int32)
+
+    # Dense structural functype ids, shared by function table and
+    # call_indirect immediates (typecheck is id equality on device).
+    type_ids = {}
+
+    def _dense_type(type_idx: int) -> int:
+        key = type_idx
+        if mod is not None:
+            ft = mod.types[type_idx]
+            key = (ft.params, ft.results)
+        return type_ids.setdefault(key, len(type_ids))
+
+    i32_bin = {NAME_TO_ID[f"i32.{s}"]: ALU2_I32_BASE + i
+               for i, s in enumerate(_I32_BIN)}
+    i64_bin = {NAME_TO_ID[f"i64.{s}"]: ALU2_I64_BASE + i
+               for i, s in enumerate(_I32_BIN)}
+    f32_bin = {NAME_TO_ID[f"f32.{s}"]: ALU2_F32_BASE + i
+               for i, s in enumerate(_F32_BIN)}
+    alu1 = {NAME_TO_ID[nm]: s for nm, s in ALU1_SUB.items()}
+    loads = {NAME_TO_ID[nm]: v for nm, v in _LOADS.items()}
+    stores = {NAME_TO_ID[nm]: v for nm, v in _STORES.items()}
+    consts = {Op.i32_const, Op.i64_const, Op.f32_const, Op.f64_const}
+    op_return = NAME_TO_ID["return"]
+
+    for pc in range(n):
+        op = image.op[pc]
+        ia, ib, ic, imm = image.a[pc], image.b[pc], image.c[pc], image.imm[pc]
+        if op == LOP_BR:
+            cls[pc], a[pc], b[pc], c[pc] = CLS_BR, ia, ib, ic
+        elif op == LOP_BRZ:
+            cls[pc], a[pc] = CLS_BRZ, ia
+        elif op == LOP_BRNZ:
+            cls[pc], a[pc], b[pc], c[pc] = CLS_BRNZ, ia, ib, ic
+        elif op == Op.br_table:
+            cls[pc], a[pc], b[pc] = CLS_BR_TABLE, ia, ib
+        elif op == op_return:
+            cls[pc], b[pc] = CLS_RETURN, ib
+        elif op == Op.call:
+            cls[pc], a[pc] = CLS_CALL, ia
+        elif op == Op.call_indirect:
+            cls[pc], a[pc], b[pc] = CLS_CALL_INDIRECT, _dense_type(ia), ib
+        elif op in consts:
+            cls[pc] = CLS_CONST
+            imm_lo[pc] = _i32(imm)
+            imm_hi[pc] = _i32(imm >> 32)
+        elif op == Op.ref_null:
+            cls[pc] = CLS_CONST
+        elif op == Op.local_get:
+            cls[pc], a[pc] = CLS_LOCAL_GET, ia
+        elif op == Op.local_set:
+            cls[pc], a[pc] = CLS_LOCAL_SET, ia
+        elif op == Op.local_tee:
+            cls[pc], a[pc] = CLS_LOCAL_TEE, ia
+        elif op == Op.global_get:
+            cls[pc], a[pc] = CLS_GLOBAL_GET, ia
+        elif op == Op.global_set:
+            cls[pc], a[pc] = CLS_GLOBAL_SET, ia
+        elif op in i32_bin:
+            cls[pc], sub[pc] = CLS_ALU2, i32_bin[op]
+        elif op in i64_bin:
+            cls[pc], sub[pc] = CLS_ALU2, i64_bin[op]
+        elif op in f32_bin:
+            cls[pc], sub[pc] = CLS_ALU2, f32_bin[op]
+        elif op in alu1:
+            cls[pc], sub[pc] = CLS_ALU1, alu1[op]
+        elif op in loads:
+            nbytes, signed, is64 = loads[op]
+            cls[pc] = CLS_LOAD
+            a[pc] = _i32(imm)  # static offset
+            b[pc] = nbytes
+            c[pc] = signed | (is64 << 1)
+        elif op in stores:
+            cls[pc] = CLS_STORE
+            a[pc] = _i32(imm)
+            b[pc] = stores[op]
+        elif op == Op.memory_size:
+            cls[pc] = CLS_MEMSIZE
+        elif op == Op.memory_grow:
+            cls[pc] = CLS_MEMGROW
+        elif op == Op.select:
+            cls[pc] = CLS_SELECT
+        elif op == Op.drop:
+            cls[pc] = CLS_DROP
+        elif op == Op.nop:
+            cls[pc] = CLS_NOP
+        elif op == Op.unreachable:
+            cls[pc], a[pc] = CLS_TRAP, int(ErrCode.Unreachable)
+        else:
+            # batchability() should have rejected; encode a trap as backstop
+            cls[pc], a[pc] = CLS_TRAP, int(ErrCode.ExecutionFailed)
+
+    nf = len(image.funcs)
+    f_entry = np.zeros(nf, np.int32)
+    f_nparams = np.zeros(nf, np.int32)
+    f_nlocals = np.zeros(nf, np.int32)
+    f_nresults = np.zeros(nf, np.int32)
+    f_frame_top = np.zeros(nf, np.int32)
+    f_type = np.zeros(nf, np.int32)
+    max_zeros = 0
+    for i, fn in enumerate(image.funcs):
+        f_entry[i] = fn.entry_pc
+        f_nparams[i] = fn.nparams
+        f_nlocals[i] = fn.nlocals
+        f_nresults[i] = fn.nresults
+        f_frame_top[i] = fn.nlocals + fn.max_height
+        f_type[i] = _dense_type(fn.type_idx)
+        max_zeros = max(max_zeros, fn.nlocals - fn.nparams)
+
+    # instance snapshots (table0: [size] of funcidx+1, 0 = null)
+    if table0 is None:
+        table0 = np.zeros(1, np.int32)
+    else:
+        table0 = np.asarray(table0, np.int32)
+
+    ng = len(globals_) if globals_ else 0
+    g_lo = np.zeros(max(ng, 1), np.int32)
+    g_hi = np.zeros(max(ng, 1), np.int32)
+    for i in range(ng):
+        v = globals_[i].value
+        g_lo[i] = _i32(v)
+        g_hi[i] = _i32(v >> 32)
+
+    if memories:
+        m = memories[0]
+        raw = np.frombuffer(bytes(m.data), dtype=np.uint8)
+        pad = (-len(raw)) % 4
+        if pad:
+            raw = np.concatenate([raw, np.zeros(pad, np.uint8)])
+        mem_init = raw.view(np.int32).astype(np.int32)
+        pages_init = m.pages
+        pages_max = m.max if m.max is not None else 0  # 0 = no declared max
+    else:
+        mem_init = np.zeros(1, np.int32)
+        pages_init = 0
+        pages_max = 0
+
+    return DeviceImage(
+        cls=cls, sub=sub, a=a, b=b, c=c, imm_lo=imm_lo, imm_hi=imm_hi,
+        br_table=image.arrays["br_table"],
+        f_entry=f_entry, f_nparams=f_nparams, f_nlocals=f_nlocals,
+        f_nresults=f_nresults, f_frame_top=f_frame_top, f_type=f_type,
+        table0=table0, globals_lo=g_lo, globals_hi=g_hi,
+        mem_init=mem_init, mem_pages_init=pages_init, mem_pages_max=pages_max,
+        max_local_zeros=max_zeros, code_len=n,
+    )

@@ -1,0 +1,305 @@
+"""Vectorized lane-level value operations for the batch engine.
+
+Value encoding: each 64-bit wasm cell is two int32 planes (lo, hi).
+i32/f32 use lo only (hi kept zero for i32 results to keep cells canonical);
+i64/f64-bits span both. All functions here are elementwise over [lanes]
+arrays and shape-polymorphic — the pallas kernel reuses them unchanged.
+
+Semantics match executor/numeric.py bit-for-bit (the parity tests in
+tests/test_batch_parity.py enforce this lane-by-lane).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+I32 = jnp.int32
+_SIGN = jnp.int32(-0x80000000)  # 0x80000000 as int32
+
+
+def u_lt(a, b):
+    """Unsigned < on int32 planes via sign-bias trick."""
+    return (a ^ _SIGN) < (b ^ _SIGN)
+
+
+def u_le(a, b):
+    return (a ^ _SIGN) <= (b ^ _SIGN)
+
+
+def b2i(x):
+    return x.astype(I32)
+
+
+def to_f32(lo):
+    return lax.bitcast_convert_type(lo, jnp.float32)
+
+
+def from_f32(f):
+    return lax.bitcast_convert_type(f, jnp.int32)
+
+
+F32_CANON_NAN = jnp.int32(0x7FC00000)
+
+
+def canon32(bits):
+    """Canonicalize NaN bit patterns (policy shared with the oracle)."""
+    exp_all = (bits & jnp.int32(0x7F800000)) == jnp.int32(0x7F800000)
+    frac = (bits & jnp.int32(0x007FFFFF)) != 0
+    return jnp.where(exp_all & frac, F32_CANON_NAN, bits)
+
+
+# ---------------------------------------------------------------------------
+# i32 scalar-plane ops
+# ---------------------------------------------------------------------------
+
+def shamt32(b):
+    return b & 31
+
+
+def rotl32(a, n):
+    n = n & 31
+    return lax.shift_left(a, n) | lax.shift_right_logical(a, (32 - n) & 31) & \
+        jnp.where(n == 0, 0, -1)
+
+
+def clz32(v):
+    return lax.clz(v)
+
+
+def ctz32(v):
+    # popcount((v & -v) - 1); v==0 -> popcount(-1) = 32
+    return lax.population_count((v & -v) - 1)
+
+
+# ---------------------------------------------------------------------------
+# i64 pair-plane ops
+# ---------------------------------------------------------------------------
+
+def add64(alo, ahi, blo, bhi):
+    lo = alo + blo
+    carry = b2i(u_lt(lo, alo))
+    return lo, ahi + bhi + carry
+
+
+def sub64(alo, ahi, blo, bhi):
+    lo = alo - blo
+    borrow = b2i(u_lt(alo, blo))
+    return lo, ahi - bhi - borrow
+
+
+def _umul32_wide(a, b):
+    """32x32 -> 64 unsigned multiply on int32 planes via 16-bit halves."""
+    a0 = a & 0xFFFF
+    a1 = lax.shift_right_logical(a, 16)
+    b0 = b & 0xFFFF
+    b1 = lax.shift_right_logical(b, 16)
+    ll = a0 * b0                      # <= 2^32-2^17+1, wraps fine in i32? no: fits 32 bits unsigned
+    lh = a0 * b1
+    hl = a1 * b0
+    hh = a1 * b1
+    # low = ll + ((lh + hl) << 16); compute with carries
+    mid = lh + hl                     # may wrap past 2^32: detect
+    mid_carry = b2i(u_lt(mid, lh))    # wrapped -> add 2^32 at bit 48 => hh += 2^16
+    lo = ll + lax.shift_left(mid, 16)
+    lo_carry = b2i(u_lt(lo, ll))
+    hi = hh + lax.shift_right_logical(mid, 16) + lax.shift_left(mid_carry, 16) + lo_carry
+    return lo, hi
+
+
+def mul64(alo, ahi, blo, bhi):
+    lo, hi = _umul32_wide(alo, blo)
+    hi = hi + alo * bhi + ahi * blo
+    return lo, hi
+
+
+def neg64(lo, hi):
+    nlo = -lo
+    nhi = ~hi + b2i(lo == 0)
+    return nlo, nhi
+
+
+def shl64(lo, hi, n):
+    n = n & 63
+    big = n >= 32
+    ns = n & 31
+    # n < 32 case
+    lo_s = lax.shift_left(lo, ns)
+    hi_s = lax.shift_left(hi, ns) | jnp.where(
+        ns == 0, 0, lax.shift_right_logical(lo, (32 - ns) & 31))
+    # n >= 32 case
+    hi_b = lax.shift_left(lo, ns)
+    return jnp.where(big, 0, lo_s), jnp.where(big, hi_b, hi_s)
+
+
+def shr64_u(lo, hi, n):
+    n = n & 63
+    big = n >= 32
+    ns = n & 31
+    lo_s = lax.shift_right_logical(lo, ns) | jnp.where(
+        ns == 0, 0, lax.shift_left(hi, (32 - ns) & 31))
+    hi_s = lax.shift_right_logical(hi, ns)
+    lo_b = lax.shift_right_logical(hi, ns)
+    return jnp.where(big, lo_b, lo_s), jnp.where(big, 0, hi_s)
+
+
+def shr64_s(lo, hi, n):
+    n = n & 63
+    big = n >= 32
+    ns = n & 31
+    lo_s = lax.shift_right_logical(lo, ns) | jnp.where(
+        ns == 0, 0, lax.shift_left(hi, (32 - ns) & 31))
+    hi_s = lax.shift_right_arithmetic(hi, ns)
+    lo_b = lax.shift_right_arithmetic(hi, ns)
+    sign = lax.shift_right_arithmetic(hi, 31)
+    return jnp.where(big, lo_b, lo_s), jnp.where(big, sign, hi_s)
+
+
+def rotl64(lo, hi, n):
+    n = n & 63
+    l1, h1 = shl64(lo, hi, n)
+    l2, h2 = shr64_u(lo, hi, (64 - n) & 63)
+    nz = n != 0
+    return l1 | jnp.where(nz, l2, 0), h1 | jnp.where(nz, h2, 0)
+
+
+def rotr64(lo, hi, n):
+    return rotl64(lo, hi, (64 - (n & 63)) & 63)
+
+
+def clz64(lo, hi):
+    return jnp.where(hi == 0, 32 + lax.clz(lo), lax.clz(hi))
+
+
+def ctz64(lo, hi):
+    return jnp.where(lo == 0, 32 + ctz32(hi), ctz32(lo))
+
+
+def popcnt64(lo, hi):
+    return lax.population_count(lo) + lax.population_count(hi)
+
+
+def eq64(alo, ahi, blo, bhi):
+    return (alo == blo) & (ahi == bhi)
+
+
+def lt64_s(alo, ahi, blo, bhi):
+    return (ahi < bhi) | ((ahi == bhi) & u_lt(alo, blo))
+
+
+def lt64_u(alo, ahi, blo, bhi):
+    return u_lt(ahi, bhi) | ((ahi == bhi) & u_lt(alo, blo))
+
+
+# -- unsigned 64-bit divide: restoring long division, 64 fixed iterations --
+def divmod64_u(nlo, nhi, dlo, dhi):
+    """Returns (qlo, qhi, rlo, rhi); divisor 0 must be guarded by caller."""
+
+    def body(i, st):
+        qlo, qhi, rlo, rhi = st
+        bit_idx = 63 - i
+        # r = (r << 1) | bit(n, bit_idx)
+        nbit = jnp.where(
+            bit_idx >= 32,
+            lax.shift_right_logical(nhi, bit_idx - 32) & 1,
+            lax.shift_right_logical(nlo, bit_idx & 31) & 1,
+        )
+        rlo2, rhi2 = shl64(rlo, rhi, jnp.int32(1))
+        rlo2 = rlo2 | nbit
+        ge = ~lt64_u(rlo2, rhi2, dlo, dhi)  # r >= d
+        slo, shi = sub64(rlo2, rhi2, dlo, dhi)
+        rlo3 = jnp.where(ge, slo, rlo2)
+        rhi3 = jnp.where(ge, shi, rhi2)
+        qbit = b2i(ge)
+        qlo2 = jnp.where(bit_idx < 32, qlo | lax.shift_left(qbit, bit_idx & 31), qlo)
+        qhi2 = jnp.where(bit_idx >= 32, qhi | lax.shift_left(qbit, (bit_idx - 32) & 31), qhi)
+        return qlo2, qhi2, rlo3, rhi3
+
+    z = jnp.zeros_like(nlo)
+    return lax.fori_loop(0, 64, body, (z, z, z, z))
+
+
+def div64_s(nlo, nhi, dlo, dhi):
+    nneg = nhi < 0
+    dneg = dhi < 0
+    anlo, anhi = neg64(nlo, nhi)
+    ulo = jnp.where(nneg, anlo, nlo)
+    uhi = jnp.where(nneg, anhi, nhi)
+    adlo, adhi = neg64(dlo, dhi)
+    vlo = jnp.where(dneg, adlo, dlo)
+    vhi = jnp.where(dneg, adhi, dhi)
+    qlo, qhi, rlo, rhi = divmod64_u(ulo, uhi, vlo, vhi)
+    qneg = nneg != dneg
+    nqlo, nqhi = neg64(qlo, qhi)
+    nrlo, nrhi = neg64(rlo, rhi)
+    return (
+        jnp.where(qneg, nqlo, qlo), jnp.where(qneg, nqhi, qhi),
+        jnp.where(nneg, nrlo, rlo), jnp.where(nneg, nrhi, rhi),
+    )
+
+
+# ---------------------------------------------------------------------------
+# f32 ops with wasm semantics
+# ---------------------------------------------------------------------------
+
+def is_nan32(bits):
+    """NaN test on raw bits — immune to hardware denormal flushing."""
+    return ((bits & jnp.int32(0x7F800000)) == jnp.int32(0x7F800000)) & \
+        ((bits & jnp.int32(0x007FFFFF)) != 0)
+
+
+def f32_key(bits):
+    """Order-preserving int32 key for f32 bits (excluding NaN): float a < b
+    iff key(a) < key(b) as signed ints. -0 maps with +0; denormals compare
+    exactly even on FTZ hardware (TPU flushes subnormals, so comparisons go
+    through the integer domain — SURVEY.md §7 hard part (b))."""
+    z = jnp.where(bits == _SIGN, 0, bits)  # -0 -> +0
+    return z ^ (lax.shift_right_arithmetic(z, 31) & jnp.int32(0x7FFFFFFF))
+
+
+def f32_cmp_eq(a_bits, b_bits):
+    nan = is_nan32(a_bits) | is_nan32(b_bits)
+    za = jnp.where(a_bits == _SIGN, 0, a_bits)
+    zb = jnp.where(b_bits == _SIGN, 0, b_bits)
+    return (za == zb) & ~nan
+
+
+def f32_cmp_lt(a_bits, b_bits):
+    nan = is_nan32(a_bits) | is_nan32(b_bits)
+    return (f32_key(a_bits) < f32_key(b_bits)) & ~nan
+
+
+def f32_min(a_bits, b_bits):
+    nan = is_nan32(a_bits) | is_nan32(b_bits)
+    both_zero = ((a_bits | b_bits) & jnp.int32(0x7FFFFFFF)) == 0
+    zero_pick = a_bits | b_bits  # -0 if either is -0
+    r = jnp.where(f32_key(a_bits) < f32_key(b_bits), a_bits, b_bits)
+    r = jnp.where(both_zero, zero_pick, r)
+    return jnp.where(nan, F32_CANON_NAN, r)
+
+
+def f32_max(a_bits, b_bits):
+    nan = is_nan32(a_bits) | is_nan32(b_bits)
+    both_zero = ((a_bits | b_bits) & jnp.int32(0x7FFFFFFF)) == 0
+    zero_pick = a_bits & b_bits  # +0 unless both are -0
+    r = jnp.where(f32_key(a_bits) > f32_key(b_bits), a_bits, b_bits)
+    r = jnp.where(both_zero, zero_pick, r)
+    return jnp.where(nan, F32_CANON_NAN, r)
+
+
+def f32_nearest(a_bits):
+    f = to_f32(a_bits)
+    r = lax.round(f, lax.RoundingMethod.TO_NEAREST_EVEN)
+    bits = from_f32(r)
+    # |f| < 0.5 rounds to a zero that must keep f's sign
+    bits = jnp.where(r == 0.0, bits | (a_bits & _SIGN), bits)
+    return canon32(bits)
+
+
+def f32_trunc(a_bits):
+    f = to_f32(a_bits)
+    r = jnp.where(f < 0, lax.ceil(f), lax.floor(f))
+    bits = from_f32(r)
+    # trunc of -0.x must be -0
+    return canon32(jnp.where(r == 0.0, bits | (a_bits & _SIGN), bits))

@@ -1,0 +1,878 @@
+"""Uniform-mode engine: converged-lane lockstep with scalar control state.
+
+When every lane runs the same module from the same entry with data that
+resolves branches identically (BASELINE config 1/2: N copies of fib(30) /
+CoreMark), pc/sp/fp/call_depth are lane-uniform. This engine keeps them as
+*scalars*: instruction fetch is a scalar table read, dispatch is a scalar
+`lax.switch` (one handler per step, not all handlers masked), and every
+stack/memory access is a `dynamic_slice` / `dynamic_update_slice` row op of
+[lanes] elements — the access pattern the TPU loves, no gathers at all.
+
+Divergence (a data-dependent branch or trap disagreeing across lanes) is
+detected on-device; the engine stops with `diverged=1` and the host falls
+back to the SIMT engine (batch/engine.py), which shares the same state
+layout. This is the PC-voting design from SURVEY.md §7 step 4 with vote =
+"all lanes agree or bail".
+
+Per-lane *data* still diverges freely (different args are fine as long as
+branches resolve the same way); per-lane traps are only divergence when
+they differ across lanes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from wasmedge_tpu.common.errors import ErrCode
+from wasmedge_tpu.batch.image import (
+    ALU1_SUB,
+    CLS_ALU1,
+    CLS_ALU2,
+    CLS_BR,
+    CLS_BR_TABLE,
+    CLS_BRNZ,
+    CLS_BRZ,
+    CLS_CALL,
+    CLS_CALL_INDIRECT,
+    CLS_CONST,
+    CLS_DROP,
+    CLS_GLOBAL_GET,
+    CLS_GLOBAL_SET,
+    CLS_LOAD,
+    CLS_LOCAL_GET,
+    CLS_LOCAL_SET,
+    CLS_LOCAL_TEE,
+    CLS_MEMGROW,
+    CLS_MEMSIZE,
+    CLS_NOP,
+    CLS_RETURN,
+    CLS_SELECT,
+    CLS_STORE,
+    CLS_TRAP,
+    NUM_CLASSES,
+    TRAP_DONE,
+    DeviceImage,
+    _F32_BIN,
+    _I32_BIN,
+    ALU2_I32_BASE,
+    ALU2_I64_BASE,
+    ALU2_F32_BASE,
+)
+
+
+class UniformState(NamedTuple):
+    # scalar (lane-uniform) control
+    pc: object
+    sp: object
+    fp: object
+    opbase: object
+    call_depth: object
+    status: object  # 0 running, 1 done, 2 diverged->SIMT, >2 trap code+16
+    steps: object
+    mem_pages: object
+    # vector data planes
+    stack_lo: object  # [D, L]
+    stack_hi: object
+    fr_ret_pc: object  # [CD] scalar frames! (uniform control)
+    fr_fp: object
+    fr_opbase: object
+    glob_lo: object  # [NG, L]
+    glob_hi: object
+    mem: object  # [W, L]
+    trap: object  # [L] per-lane pending trap (uniform or lane diverges)
+
+
+ST_RUNNING = 0
+ST_DONE = 1
+ST_DIVERGED = 2
+ST_TRAPPED_BASE = 16  # status = 16 + ErrCode when ALL lanes trap identically
+
+
+def make_uniform_step(img: DeviceImage, cfg, lanes: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    I32 = jnp.int32
+    D = cfg.value_stack_depth
+    CD = cfg.call_stack_depth
+
+    cls_t = jnp.asarray(img.cls)
+    sub_t = jnp.asarray(img.sub)
+    a_t = jnp.asarray(img.a)
+    b_t = jnp.asarray(img.b)
+    c_t = jnp.asarray(img.c)
+    ilo_t = jnp.asarray(img.imm_lo)
+    ihi_t = jnp.asarray(img.imm_hi)
+    brt_t = jnp.asarray(img.br_table)
+    f_entry = jnp.asarray(img.f_entry)
+    f_nparams = jnp.asarray(img.f_nparams)
+    f_nlocals = jnp.asarray(img.f_nlocals)
+    f_frame_top = jnp.asarray(img.f_frame_top)
+    f_type = jnp.asarray(img.f_type)
+    table0 = jnp.asarray(img.table0)
+
+    S_I32 = {n: ALU2_I32_BASE + i for i, n in enumerate(_I32_BIN)}
+    S_I64 = {n: ALU2_I64_BASE + i for i, n in enumerate(_I32_BIN)}
+    S_F32 = {n: ALU2_F32_BASE + i for i, n in enumerate(_F32_BIN)}
+    A1 = ALU1_SUB
+    b2i = lo_ops.b2i
+    u_lt = lo_ops.u_lt
+
+    def row(plane, i):
+        """plane[i] via dynamic_slice (scalar i) -> [L]."""
+        i = jnp.clip(i, 0, plane.shape[0] - 1)
+        return lax.dynamic_slice_in_dim(plane, i, 1, 0)[0]
+
+    def setrow(plane, i, vals):
+        i = jnp.clip(i, 0, plane.shape[0] - 1)
+        return lax.dynamic_update_slice_in_dim(plane, vals[None, :], i, 0)
+
+    def sget(arr, i):
+        i = jnp.clip(i, 0, arr.shape[0] - 1)
+        return lax.dynamic_slice_in_dim(arr, i, 1, 0)[0]
+
+    def sset(arr, i, v):
+        i = jnp.clip(i, 0, arr.shape[0] - 1)
+        return lax.dynamic_update_slice_in_dim(arr, v[None], i, 0)
+
+    def halt(st, status):
+        return st._replace(status=status)
+
+    # ---------------- class handlers (each: (st, fetch) -> st) -----------
+    # fetch = (sub, a, b, c, ilo, ihi) scalars
+
+    def h_nop(st, f):
+        return st._replace(pc=st.pc + 1)
+
+    def h_const(st, f):
+        sub, a, b, c, ilo, ihi = f
+        sl = setrow(st.stack_lo, st.sp, jnp.full((lanes,), ilo, I32))
+        sh = setrow(st.stack_hi, st.sp, jnp.full((lanes,), ihi, I32))
+        return st._replace(pc=st.pc + 1, sp=st.sp + 1, stack_lo=sl, stack_hi=sh)
+
+    def h_local_get(st, f):
+        sub, a, b, c, ilo, ihi = f
+        vl = row(st.stack_lo, st.fp + a)
+        vh = row(st.stack_hi, st.fp + a)
+        sl = setrow(st.stack_lo, st.sp, vl)
+        sh = setrow(st.stack_hi, st.sp, vh)
+        return st._replace(pc=st.pc + 1, sp=st.sp + 1, stack_lo=sl, stack_hi=sh)
+
+    def h_local_set(st, f):
+        sub, a, b, c, ilo, ihi = f
+        vl = row(st.stack_lo, st.sp - 1)
+        vh = row(st.stack_hi, st.sp - 1)
+        sl = setrow(st.stack_lo, st.fp + a, vl)
+        sh = setrow(st.stack_hi, st.fp + a, vh)
+        return st._replace(pc=st.pc + 1, sp=st.sp - 1, stack_lo=sl, stack_hi=sh)
+
+    def h_local_tee(st, f):
+        sub, a, b, c, ilo, ihi = f
+        vl = row(st.stack_lo, st.sp - 1)
+        vh = row(st.stack_hi, st.sp - 1)
+        sl = setrow(st.stack_lo, st.fp + a, vl)
+        sh = setrow(st.stack_hi, st.fp + a, vh)
+        return st._replace(pc=st.pc + 1, stack_lo=sl, stack_hi=sh)
+
+    def h_global_get(st, f):
+        sub, a, b, c, ilo, ihi = f
+        vl = row(st.glob_lo, a)
+        vh = row(st.glob_hi, a)
+        sl = setrow(st.stack_lo, st.sp, vl)
+        sh = setrow(st.stack_hi, st.sp, vh)
+        return st._replace(pc=st.pc + 1, sp=st.sp + 1, stack_lo=sl, stack_hi=sh)
+
+    def h_global_set(st, f):
+        sub, a, b, c, ilo, ihi = f
+        vl = row(st.stack_lo, st.sp - 1)
+        vh = row(st.stack_hi, st.sp - 1)
+        gl = setrow(st.glob_lo, a, vl)
+        gh = setrow(st.glob_hi, a, vh)
+        return st._replace(pc=st.pc + 1, sp=st.sp - 1, glob_lo=gl, glob_hi=gh)
+
+    def h_drop(st, f):
+        return st._replace(pc=st.pc + 1, sp=st.sp - 1)
+
+    def h_select(st, f):
+        cond = row(st.stack_lo, st.sp - 1)
+        v1l = row(st.stack_lo, st.sp - 2)
+        v1h = row(st.stack_hi, st.sp - 2)
+        v2l = row(st.stack_lo, st.sp - 3)
+        v2h = row(st.stack_hi, st.sp - 3)
+        rl = jnp.where(cond == 0, v1l, v2l)
+        rh = jnp.where(cond == 0, v1h, v2h)
+        sl = setrow(st.stack_lo, st.sp - 3, rl)
+        sh = setrow(st.stack_hi, st.sp - 3, rh)
+        return st._replace(pc=st.pc + 1, sp=st.sp - 2, stack_lo=sl, stack_hi=sh)
+
+    def _alu_result(sub, xl, xh, yl, yh):
+        """Scalar-sub select over vector operands; only the i64 div/rem go
+        through the iterative path (under scalar switch they cost nothing
+        unless fetched)."""
+        sh32 = yl & 31
+        dg = jnp.where(yl == 0, jnp.int32(1), yl)
+        xu = xl.astype(jnp.uint32)
+        yu = jnp.where(yl == 0, jnp.uint32(1), yl.astype(jnp.uint32))
+        fx = lo_ops.to_f32(xl)
+        fy = lo_ops.to_f32(yl)
+        feq = lo_ops.f32_cmp_eq(xl, yl)
+        flt = lo_ops.f32_cmp_lt(xl, yl)
+        fgt = lo_ops.f32_cmp_lt(yl, xl)
+        fnan = lo_ops.is_nan32(xl) | lo_ops.is_nan32(yl)
+        sh64 = yl & 63
+        z = jnp.zeros_like(xl)
+
+        def pair64(fn):
+            return lambda: fn(xl, xh, yl, yh)
+
+        def rare_div(kind):
+            def run():
+                glo = jnp.where((yl | yh) == 0, jnp.int32(1), yl)
+                ghi = jnp.where((yl | yh) == 0, jnp.int32(0), yh)
+                if kind.endswith("_u"):
+                    qlo, qhi, rlo, rhi = lo_ops.divmod64_u(xl, xh, glo, ghi)
+                    return (qlo, qhi) if kind.startswith("div") else (rlo, rhi)
+                qlo, qhi, rlo, rhi = lo_ops.div64_s(xl, xh, glo, ghi)
+                return (qlo, qhi) if kind.startswith("div") else (rlo, rhi)
+            return run
+
+        branches = {}
+        branches[S_I32["add"]] = lambda: (xl + yl, z)
+        branches[S_I32["sub"]] = lambda: (xl - yl, z)
+        branches[S_I32["mul"]] = lambda: (xl * yl, z)
+        branches[S_I32["div_s"]] = lambda: (lax.div(xl, dg), z)
+        branches[S_I32["div_u"]] = lambda: (lax.div(xu, yu).astype(I32), z)
+        branches[S_I32["rem_s"]] = lambda: (lax.rem(xl, dg), z)
+        branches[S_I32["rem_u"]] = lambda: (lax.rem(xu, yu).astype(I32), z)
+        branches[S_I32["and"]] = lambda: (xl & yl, z)
+        branches[S_I32["or"]] = lambda: (xl | yl, z)
+        branches[S_I32["xor"]] = lambda: (xl ^ yl, z)
+        branches[S_I32["shl"]] = lambda: (lax.shift_left(xl, sh32), z)
+        branches[S_I32["shr_s"]] = lambda: (lax.shift_right_arithmetic(xl, sh32), z)
+        branches[S_I32["shr_u"]] = lambda: (lax.shift_right_logical(xl, sh32), z)
+        branches[S_I32["rotl"]] = lambda: (lo_ops.rotl32(xl, yl), z)
+        branches[S_I32["rotr"]] = lambda: (lo_ops.rotl32(xl, (32 - (yl & 31)) & 31), z)
+        for nm, fn in (("eq", lambda: b2i(xl == yl)), ("ne", lambda: b2i(xl != yl)),
+                       ("lt_s", lambda: b2i(xl < yl)), ("lt_u", lambda: b2i(u_lt(xl, yl))),
+                       ("gt_s", lambda: b2i(xl > yl)), ("gt_u", lambda: b2i(u_lt(yl, xl))),
+                       ("le_s", lambda: b2i(xl <= yl)), ("le_u", lambda: b2i(lo_ops.u_le(xl, yl))),
+                       ("ge_s", lambda: b2i(xl >= yl)), ("ge_u", lambda: b2i(lo_ops.u_le(yl, xl)))):
+            branches[S_I32[nm]] = (lambda fn=fn: (fn(), z))
+        branches[S_I64["add"]] = pair64(lo_ops.add64)
+        branches[S_I64["sub"]] = pair64(lo_ops.sub64)
+        branches[S_I64["mul"]] = pair64(lo_ops.mul64)
+        branches[S_I64["div_s"]] = rare_div("div_s")
+        branches[S_I64["div_u"]] = rare_div("div_u")
+        branches[S_I64["rem_s"]] = rare_div("rem_s")
+        branches[S_I64["rem_u"]] = rare_div("rem_u")
+        branches[S_I64["and"]] = lambda: (xl & yl, xh & yh)
+        branches[S_I64["or"]] = lambda: (xl | yl, xh | yh)
+        branches[S_I64["xor"]] = lambda: (xl ^ yl, xh ^ yh)
+        branches[S_I64["shl"]] = lambda: lo_ops.shl64(xl, xh, sh64)
+        branches[S_I64["shr_s"]] = lambda: lo_ops.shr64_s(xl, xh, sh64)
+        branches[S_I64["shr_u"]] = lambda: lo_ops.shr64_u(xl, xh, sh64)
+        branches[S_I64["rotl"]] = lambda: lo_ops.rotl64(xl, xh, sh64)
+        branches[S_I64["rotr"]] = lambda: lo_ops.rotr64(xl, xh, sh64)
+        eq64 = lambda: lo_ops.eq64(xl, xh, yl, yh)
+        lts = lambda: lo_ops.lt64_s(xl, xh, yl, yh)
+        ltu = lambda: lo_ops.lt64_u(xl, xh, yl, yh)
+        gts = lambda: lo_ops.lt64_s(yl, yh, xl, xh)
+        gtu = lambda: lo_ops.lt64_u(yl, yh, xl, xh)
+        branches[S_I64["eq"]] = lambda: (b2i(eq64()), z)
+        branches[S_I64["ne"]] = lambda: (b2i(~eq64()), z)
+        branches[S_I64["lt_s"]] = lambda: (b2i(lts()), z)
+        branches[S_I64["lt_u"]] = lambda: (b2i(ltu()), z)
+        branches[S_I64["gt_s"]] = lambda: (b2i(gts()), z)
+        branches[S_I64["gt_u"]] = lambda: (b2i(gtu()), z)
+        branches[S_I64["le_s"]] = lambda: (b2i(~gts()), z)
+        branches[S_I64["le_u"]] = lambda: (b2i(~gtu()), z)
+        branches[S_I64["ge_s"]] = lambda: (b2i(~lts()), z)
+        branches[S_I64["ge_u"]] = lambda: (b2i(~ltu()), z)
+        branches[S_F32["add"]] = lambda: (lo_ops.canon32(lo_ops.from_f32(fx + fy)), z)
+        branches[S_F32["sub"]] = lambda: (lo_ops.canon32(lo_ops.from_f32(fx - fy)), z)
+        branches[S_F32["mul"]] = lambda: (lo_ops.canon32(lo_ops.from_f32(fx * fy)), z)
+        branches[S_F32["div"]] = lambda: (lo_ops.canon32(lo_ops.from_f32(fx / fy)), z)
+        branches[S_F32["min"]] = lambda: (lo_ops.f32_min(xl, yl), z)
+        branches[S_F32["max"]] = lambda: (lo_ops.f32_max(xl, yl), z)
+        branches[S_F32["copysign"]] = lambda: (
+            (xl & jnp.int32(0x7FFFFFFF)) | (yl & lo_ops._SIGN), z)
+        branches[S_F32["eq"]] = lambda: (b2i(feq), z)
+        branches[S_F32["ne"]] = lambda: (b2i(~feq), z)
+        branches[S_F32["lt"]] = lambda: (b2i(flt), z)
+        branches[S_F32["gt"]] = lambda: (b2i(fgt), z)
+        branches[S_F32["le"]] = lambda: (b2i((flt | feq) & ~fnan), z)
+        branches[S_F32["ge"]] = lambda: (b2i((fgt | feq) & ~fnan), z)
+
+        n_subs = max(branches) + 1
+        fns = [branches.get(i, lambda: (xl, xh)) for i in range(n_subs)]
+        return lax.switch(jnp.clip(sub, 0, n_subs - 1), fns)
+
+    def h_alu2(st, f):
+        sub, a, b, c, ilo, ihi = f
+        xl = row(st.stack_lo, st.sp - 2)
+        xh = row(st.stack_hi, st.sp - 2)
+        yl = row(st.stack_lo, st.sp - 1)
+        yh = row(st.stack_hi, st.sp - 1)
+        rl, rh = _alu_result(sub, xl, xh, yl, yh)
+        # div-by-zero / overflow traps (uniform check later via trap plane)
+        is_div32 = (sub == S_I32["div_s"]) | (sub == S_I32["div_u"]) | \
+            (sub == S_I32["rem_s"]) | (sub == S_I32["rem_u"])
+        is_div64 = (sub == S_I64["div_s"]) | (sub == S_I64["div_u"]) | \
+            (sub == S_I64["rem_s"]) | (sub == S_I64["rem_u"])
+        dz = (is_div32 & (yl == 0)) | (is_div64 & ((yl | yh) == 0))
+        ovf = ((sub == S_I32["div_s"]) & (xl == jnp.int32(-0x80000000)) & (yl == -1)) | \
+              ((sub == S_I64["div_s"]) & (xl == 0) & (xh == jnp.int32(-0x80000000))
+               & (yl == -1) & (yh == -1))
+        lane_trap = jnp.where(dz, int(ErrCode.DivideByZero),
+                              jnp.where(ovf, int(ErrCode.IntegerOverflow), 0))
+        sl = setrow(st.stack_lo, st.sp - 2, rl)
+        sh = setrow(st.stack_hi, st.sp - 2, rh)
+        return st._replace(pc=st.pc + 1, sp=st.sp - 1, stack_lo=sl, stack_hi=sh,
+                           trap=jnp.where(lane_trap != 0, lane_trap, st.trap))
+
+    def h_alu1(st, f):
+        sub, a, b, c, ilo, ihi = f
+        wl = row(st.stack_lo, st.sp - 1)
+        wh = row(st.stack_hi, st.sp - 1)
+        fwv = lo_ops.to_f32(wl)
+        ext8 = lax.shift_right_arithmetic(lax.shift_left(wl, 24), 24)
+        ext16 = lax.shift_right_arithmetic(lax.shift_left(wl, 16), 16)
+        signw = lax.shift_right_arithmetic(wl, 31)
+        tr = jnp.where(fwv < 0, lax.ceil(fwv), lax.floor(fwv))
+        nanw = lo_ops.is_nan32(wl)
+        in_s = (tr >= jnp.float32(-2147483648.0)) & (tr <= jnp.float32(2147483520.0))
+        in_u = (tr >= 0) & (tr <= jnp.float32(4294967040.0))
+        tr_s = jnp.where(in_s & ~nanw, tr, jnp.float32(0)).astype(I32)
+        tru_shift = jnp.where(in_u & ~nanw, tr, jnp.float32(0))
+        tr_u = jnp.where(tru_shift >= jnp.float32(2147483648.0),
+                         (tru_shift - jnp.float32(4294967296.0)).astype(I32),
+                         tru_shift.astype(I32))
+        z = jnp.zeros_like(wl)
+        branches = {
+            A1["i32.clz"]: lambda: (lax.clz(wl), z),
+            A1["i32.ctz"]: lambda: (lo_ops.ctz32(wl), z),
+            A1["i32.popcnt"]: lambda: (lax.population_count(wl), z),
+            A1["i32.eqz"]: lambda: (b2i(wl == 0), z),
+            A1["i32.extend8_s"]: lambda: (ext8, z),
+            A1["i32.extend16_s"]: lambda: (ext16, z),
+            A1["i64.clz"]: lambda: (lo_ops.clz64(wl, wh), z),
+            A1["i64.ctz"]: lambda: (lo_ops.ctz64(wl, wh), z),
+            A1["i64.popcnt"]: lambda: (lo_ops.popcnt64(wl, wh), z),
+            A1["i64.eqz"]: lambda: (b2i((wl | wh) == 0), z),
+            A1["i64.extend8_s"]: lambda: (ext8, lax.shift_right_arithmetic(ext8, 31)),
+            A1["i64.extend16_s"]: lambda: (ext16, lax.shift_right_arithmetic(ext16, 31)),
+            A1["i64.extend32_s"]: lambda: (wl, signw),
+            A1["f32.abs"]: lambda: (wl & jnp.int32(0x7FFFFFFF), z),
+            A1["f32.neg"]: lambda: (wl ^ lo_ops._SIGN, z),
+            A1["f32.ceil"]: lambda: (lo_ops.canon32(lo_ops.from_f32(lax.ceil(fwv))), z),
+            A1["f32.floor"]: lambda: (lo_ops.canon32(lo_ops.from_f32(lax.floor(fwv))), z),
+            A1["f32.trunc"]: lambda: (lo_ops.f32_trunc(wl), z),
+            A1["f32.nearest"]: lambda: (lo_ops.f32_nearest(wl), z),
+            A1["f32.sqrt"]: lambda: (lo_ops.canon32(lo_ops.from_f32(lax.sqrt(fwv))), z),
+            A1["i32.wrap_i64"]: lambda: (wl, z),
+            A1["i64.extend_i32_s"]: lambda: (wl, signw),
+            A1["i64.extend_i32_u"]: lambda: (wl, z),
+            A1["i32.trunc_f32_s"]: lambda: (tr_s, z),
+            A1["i32.trunc_f32_u"]: lambda: (tr_u, z),
+            A1["i32.trunc_sat_f32_s"]: lambda: (
+                jnp.where(nanw, 0, jnp.where(tr < jnp.float32(-2147483648.0),
+                                             jnp.int32(-0x80000000),
+                                             jnp.where(tr > jnp.float32(2147483520.0),
+                                                       jnp.int32(0x7FFFFFFF), tr_s))), z),
+            A1["i32.trunc_sat_f32_u"]: lambda: (
+                jnp.where(nanw | (tr < 0), 0,
+                          jnp.where(tr > jnp.float32(4294967040.0),
+                                    jnp.int32(-1), tr_u)), z),
+            A1["f32.convert_i32_s"]: lambda: (lo_ops.from_f32(wl.astype(jnp.float32)), z),
+            A1["f32.convert_i32_u"]: lambda: (
+                lo_ops.from_f32(wl.astype(jnp.uint32).astype(jnp.float32)), z),
+            A1["i32.reinterpret_f32"]: lambda: (wl, z),
+            A1["f32.reinterpret_i32"]: lambda: (wl, z),
+            A1["ref.is_null"]: lambda: (b2i((wl | wh) == 0), z),
+        }
+        n_subs = max(branches) + 1
+        fns = [branches.get(i, lambda: (wl, wh)) for i in range(n_subs)]
+        rl, rh = lax.switch(jnp.clip(sub, 0, n_subs - 1), fns)
+        trap_s = (sub == A1["i32.trunc_f32_s"]) & (nanw | ~in_s)
+        trap_u = (sub == A1["i32.trunc_f32_u"]) & (nanw | ~in_u)
+        lane_trap = jnp.where((trap_s | trap_u) & nanw, int(ErrCode.InvalidConvToInt),
+                              jnp.where(trap_s | trap_u, int(ErrCode.IntegerOverflow), 0))
+        sl = setrow(st.stack_lo, st.sp - 1, rl)
+        sh = setrow(st.stack_hi, st.sp - 1, rh)
+        return st._replace(pc=st.pc + 1, stack_lo=sl, stack_hi=sh,
+                           trap=jnp.where(lane_trap != 0, lane_trap, st.trap))
+
+    def h_br(st, f):
+        sub, a, b, c, ilo, ihi = f
+        vl = row(st.stack_lo, st.sp - 1)
+        vh = row(st.stack_hi, st.sp - 1)
+        tgt_sp = st.opbase + c
+        sl = jnp.where(b == 1, setrow(st.stack_lo, tgt_sp, vl), st.stack_lo)
+        sh = jnp.where(b == 1, setrow(st.stack_hi, tgt_sp, vh), st.stack_hi)
+        return st._replace(pc=a, sp=tgt_sp + b, stack_lo=sl, stack_hi=sh)
+
+    def h_brz(st, f):
+        sub, a, b, c, ilo, ihi = f
+        cond = row(st.stack_lo, st.sp - 1)
+        taken = cond == 0
+        return _uniform_branch(st, f, taken, a, keep=0, cut=False)
+
+    def h_brnz(st, f):
+        sub, a, b, c, ilo, ihi = f
+        cond = row(st.stack_lo, st.sp - 1)
+        taken = cond != 0
+        return _uniform_branch(st, f, taken, a, keep=b, cut=True)
+
+    def _uniform_branch(st, f, taken_vec, target, keep, cut):
+        sub, a, b, c, ilo, ihi = f
+        t0 = taken_vec[0]
+        agree = jnp.all(taken_vec == t0)
+        # kept value sits just below the popped condition
+        vl = row(st.stack_lo, st.sp - 2)
+        vh = row(st.stack_hi, st.sp - 2)
+        sp_pop = st.sp - 1
+
+        def take(st):
+            if cut:
+                tgt_sp = st.opbase + c
+                sl = jnp.where(keep == 1, setrow(st.stack_lo, tgt_sp, vl),
+                               st.stack_lo)
+                sh = jnp.where(keep == 1, setrow(st.stack_hi, tgt_sp, vh),
+                               st.stack_hi)
+                return st._replace(pc=target, sp=tgt_sp + keep,
+                                   stack_lo=sl, stack_hi=sh)
+            return st._replace(pc=target, sp=sp_pop)
+
+        def fall(st):
+            return st._replace(pc=st.pc + 1, sp=sp_pop)
+
+        new_st = lax.cond(t0, take, fall, st)
+        return lax.cond(agree, lambda s: s,
+                        lambda s: halt(st, jnp.int32(ST_DIVERGED)), new_st)
+
+    def h_br_table(st, f):
+        sub, a, b, c, ilo, ihi = f
+        idx = row(st.stack_lo, st.sp - 1)
+        i0 = idx[0]
+        agree = jnp.all(idx == i0)
+        ii = jnp.where(u_lt(b, i0), b, i0)
+        e = jnp.clip(a + ii, 0, brt_t.shape[0] - 1)
+        tgt = brt_t[e, 0]
+        keep = brt_t[e, 1]
+        pop_to = brt_t[e, 2]
+        vl = row(st.stack_lo, st.sp - 2)
+        vh = row(st.stack_hi, st.sp - 2)
+        tgt_sp = st.opbase + pop_to
+        sl = jnp.where(keep == 1, setrow(st.stack_lo, tgt_sp, vl), st.stack_lo)
+        sh = jnp.where(keep == 1, setrow(st.stack_hi, tgt_sp, vh), st.stack_hi)
+        new_st = st._replace(pc=tgt, sp=tgt_sp + keep, stack_lo=sl, stack_hi=sh)
+        return lax.cond(agree, lambda s: s,
+                        lambda s: halt(st, jnp.int32(ST_DIVERGED)), new_st)
+
+    def h_return(st, f):
+        sub, a, b, c, ilo, ihi = f
+        vl = row(st.stack_lo, st.sp - 1)
+        vh = row(st.stack_hi, st.sp - 1)
+        sl = jnp.where(b == 1, setrow(st.stack_lo, st.fp, vl), st.stack_lo)
+        sh = jnp.where(b == 1, setrow(st.stack_hi, st.fp, vh), st.stack_hi)
+        done = st.call_depth == 0
+        rd = jnp.clip(st.call_depth - 1, 0, CD - 1)
+        r_pc = sget(st.fr_ret_pc, rd)
+        r_fp = sget(st.fr_fp, rd)
+        r_ob = sget(st.fr_opbase, rd)
+        new_sp = st.fp + b
+        st2 = st._replace(stack_lo=sl, stack_hi=sh, sp=new_sp)
+        return lax.cond(
+            done,
+            lambda s: s._replace(status=jnp.int32(ST_DONE)),
+            lambda s: s._replace(pc=r_pc, fp=r_fp, opbase=r_ob,
+                                 call_depth=s.call_depth - 1),
+            st2)
+
+    def h_call(st, f):
+        sub, a, b, c, ilo, ihi = f
+        return _do_call(st, a, st.sp)
+
+    def h_call_indirect(st, f):
+        sub, a, b, c, ilo, ihi = f
+        idx = row(st.stack_lo, st.sp - 1)
+        i0 = idx[0]
+        agree = jnp.all(idx == i0)
+        tsize = table0.shape[0]
+        oob = u_lt(jnp.int32(tsize - 1), i0) | (i0 < 0)
+        h = table0[jnp.clip(i0, 0, tsize - 1)]
+        null = h == 0
+        callee = jnp.clip(h - 1, 0, f_entry.shape[0] - 1)
+        sig_bad = f_type[callee] != a
+
+        def bad(st):
+            code = jnp.where(oob, int(ErrCode.UndefinedElement),
+                             jnp.where(null, int(ErrCode.UninitializedElement),
+                                       int(ErrCode.IndirectCallTypeMismatch)))
+            return st._replace(trap=jnp.full((lanes,), code, I32),
+                               status=jnp.int32(ST_TRAPPED_BASE) + code)
+
+        def good(st):
+            return _do_call(st._replace(sp=st.sp - 1), callee, st.sp - 1)
+
+        new_st = lax.cond(oob | null | sig_bad, bad, good, st)
+        return lax.cond(agree, lambda s: s,
+                        lambda s: halt(st, jnp.int32(ST_DIVERGED)), new_st)
+
+    def _do_call(st, callee, sp_eff):
+        callee = jnp.clip(callee, 0, f_entry.shape[0] - 1)
+        nargs = sget(f_nparams, callee)
+        nloc = sget(f_nlocals, callee)
+        ftop = sget(f_frame_top, callee)
+        fp_new = sp_eff - nargs
+        ob_new = fp_new + nloc
+        ovf = (st.call_depth >= CD - 1) | (fp_new + ftop > D)
+
+        def trap(st):
+            code = jnp.where(st.call_depth >= CD - 1,
+                             int(ErrCode.CallStackExhausted),
+                             int(ErrCode.StackOverflow))
+            return st._replace(trap=jnp.full((lanes,), code, I32),
+                               status=jnp.int32(ST_TRAPPED_BASE) + code)
+
+        def go(st):
+            frp = sset(st.fr_ret_pc, st.call_depth, st.pc + 1)
+            frf = sset(st.fr_fp, st.call_depth, st.fp)
+            fro = sset(st.fr_opbase, st.call_depth, st.opbase)
+            sl, sh = st.stack_lo, st.stack_hi
+            zrow = jnp.zeros((lanes,), I32)
+            for k in range(img.max_local_zeros):
+                do = k < (nloc - nargs)
+                sl = jnp.where(do, setrow(sl, fp_new + nargs + k, zrow), sl)
+                sh = jnp.where(do, setrow(sh, fp_new + nargs + k, zrow), sh)
+            return st._replace(pc=sget(f_entry, callee), fp=fp_new,
+                               opbase=ob_new, sp=ob_new, call_depth=st.call_depth + 1,
+                               fr_ret_pc=frp, fr_fp=frf, fr_opbase=fro,
+                               stack_lo=sl, stack_hi=sh)
+
+        return lax.cond(ovf, trap, go, st)
+
+    def h_load(st, f):
+        sub, a, b, c, ilo, ihi = f
+        addr = row(st.stack_lo, st.sp - 1)
+        ea = addr + a
+        carry = u_lt(ea, addr) | u_lt(ea, jnp.full((lanes,), a, I32))
+        mem_bytes = st.mem_pages * jnp.int32(65536)
+        end = ea + b
+        oob = carry | u_lt(end, ea) | u_lt(jnp.full((lanes,), mem_bytes, I32), end)
+        widx = lax.shift_right_logical(ea, 2)
+        shB = (ea & 3) * 8
+        # per-lane word gather — addresses diverge, but memory rows are
+        # lane-major so this is a [W, L] gather; uniform-address fast path
+        # would need address agreement, data usually differs
+        mw0 = _mem_gather(st.mem, widx)
+        mw1 = _mem_gather(st.mem, widx + 1)
+        mw2 = _mem_gather(st.mem, widx + 2)
+        inv = (32 - shB) & 31
+        hi_or = jnp.where(shB == 0, 0, -1)
+        raw_lo = lax.shift_right_logical(mw0, shB) | (lax.shift_left(mw1, inv) & hi_or)
+        raw_hi = lax.shift_right_logical(mw1, shB) | (lax.shift_left(mw2, inv) & hi_or)
+        signed = (c & 1) != 0
+        is64 = (c & 2) != 0
+        b1 = b == 1
+        b2_ = b == 2
+        lraw = jnp.where(b1, raw_lo & 0xFF, jnp.where(b2_, raw_lo & 0xFFFF, raw_lo))
+        lsext = jnp.where(b1, lax.shift_right_arithmetic(lax.shift_left(raw_lo, 24), 24),
+                          jnp.where(b2_, lax.shift_right_arithmetic(lax.shift_left(raw_lo, 16), 16),
+                                    raw_lo))
+        ll = jnp.where(signed, lsext, lraw)
+        lh = jnp.where(is64, jnp.where(b == 8, raw_hi,
+                                       jnp.where(signed, lax.shift_right_arithmetic(ll, 31), 0)),
+                       jnp.int32(0))
+        any_oob = jnp.any(oob)
+        sl = setrow(st.stack_lo, st.sp - 1, ll)
+        sh = setrow(st.stack_hi, st.sp - 1, lh)
+        new_st = st._replace(pc=st.pc + 1, stack_lo=sl, stack_hi=sh)
+        return lax.cond(
+            any_oob,
+            lambda s: s._replace(
+                trap=jnp.where(oob, int(ErrCode.MemoryOutOfBounds), s.trap),
+                status=jnp.int32(ST_DIVERGED)),
+            lambda s: s, new_st)
+
+    def _mem_gather(mem, widx):
+        import jax.numpy as jnp
+        widx = jnp.clip(widx, 0, mem.shape[0] - 1)
+        return jnp.take_along_axis(mem, widx[None, :], axis=0)[0]
+
+    def h_store(st, f):
+        sub, a, b, c, ilo, ihi = f
+        vl = row(st.stack_lo, st.sp - 1)
+        vh = row(st.stack_hi, st.sp - 1)
+        addr = row(st.stack_lo, st.sp - 2)
+        ea = addr + a
+        carry = u_lt(ea, addr) | u_lt(ea, jnp.full((lanes,), a, I32))
+        mem_bytes = st.mem_pages * jnp.int32(65536)
+        end = ea + b
+        oob = carry | u_lt(end, ea) | u_lt(jnp.full((lanes,), mem_bytes, I32), end)
+        widx = lax.shift_right_logical(ea, 2)
+        shB = (ea & 3) * 8
+        b1 = b == 1
+        b2_ = b == 2
+        full_lo = jnp.where(b1, 0xFF, jnp.where(b2_, 0xFFFF, jnp.int32(-1)))
+        full_hi = jnp.where(b == 8, jnp.int32(-1), 0)
+        full_lo = jnp.broadcast_to(full_lo, (lanes,))
+        full_hi = jnp.broadcast_to(full_hi, (lanes,))
+        sm0, sm1 = lo_ops.shl64(full_lo, full_hi, shB)
+        sm2 = jnp.where(shB == 0, 0, lo_ops.shr64_u(full_lo, full_hi, 64 - shB)[0])
+        sv0, sv1 = lo_ops.shl64(vl, vh, shB)
+        sv2 = jnp.where(shB == 0, 0, lo_ops.shr64_u(vl, vh, 64 - shB)[0])
+        mem = st.mem
+        mem = _mem_rmw(mem, widx, sm0, sv0, ~oob)
+        mem = _mem_rmw(mem, widx + 1, sm1, sv1, ~oob)
+        mem = _mem_rmw(mem, widx + 2, sm2, sv2, ~oob)
+        any_oob = jnp.any(oob)
+        new_st = st._replace(pc=st.pc + 1, sp=st.sp - 2, mem=mem)
+        return lax.cond(
+            any_oob,
+            lambda s: s._replace(
+                trap=jnp.where(oob, int(ErrCode.MemoryOutOfBounds), s.trap),
+                status=jnp.int32(ST_DIVERGED)),
+            lambda s: s, new_st)
+
+    def _mem_rmw(mem, widx, m, v, ok):
+        import jax.numpy as jnp
+        lane_iota = jnp.arange(lanes, dtype=jnp.int32)
+        widx = jnp.clip(widx, 0, mem.shape[0] - 1)
+        cur = jnp.take_along_axis(mem, widx[None, :], axis=0)[0]
+        new = jnp.where(ok & (m != 0), (cur & ~m) | (v & m), cur)
+        return mem.at[widx, lane_iota].set(new)
+
+    def h_memsize(st, f):
+        sl = setrow(st.stack_lo, st.sp, jnp.full((lanes,), st.mem_pages, I32))
+        sh = setrow(st.stack_hi, st.sp, jnp.zeros((lanes,), I32))
+        return st._replace(pc=st.pc + 1, sp=st.sp + 1, stack_lo=sl, stack_hi=sh)
+
+    def h_memgrow(st, f):
+        delta_v = row(st.stack_lo, st.sp - 1)
+        d0 = delta_v[0]
+        agree = jnp.all(delta_v == d0)
+        ok = (d0 >= 0) & ((st.mem_pages + d0) <= img.mem_pages_max) & \
+            ((st.mem_pages + d0) >= st.mem_pages)
+        res = jnp.where(ok, st.mem_pages, jnp.int32(-1))
+        sl = setrow(st.stack_lo, st.sp - 1, jnp.full((lanes,), res, I32))
+        sh = setrow(st.stack_hi, st.sp - 1, jnp.zeros((lanes,), I32))
+        new_st = st._replace(pc=st.pc + 1, stack_lo=sl, stack_hi=sh,
+                             mem_pages=jnp.where(ok, st.mem_pages + d0, st.mem_pages))
+        return lax.cond(agree, lambda s: s,
+                        lambda s: halt(st, jnp.int32(ST_DIVERGED)), new_st)
+
+    def h_trap(st, f):
+        sub, a, b, c, ilo, ihi = f
+        return st._replace(trap=jnp.full((lanes,), a, I32),
+                           status=jnp.int32(ST_TRAPPED_BASE) + a)
+
+    handlers = [None] * NUM_CLASSES
+    handlers[CLS_NOP] = h_nop
+    handlers[CLS_CONST] = h_const
+    handlers[CLS_LOCAL_GET] = h_local_get
+    handlers[CLS_LOCAL_SET] = h_local_set
+    handlers[CLS_LOCAL_TEE] = h_local_tee
+    handlers[CLS_GLOBAL_GET] = h_global_get
+    handlers[CLS_GLOBAL_SET] = h_global_set
+    handlers[CLS_ALU1] = h_alu1
+    handlers[CLS_ALU2] = h_alu2
+    handlers[CLS_SELECT] = h_select
+    handlers[CLS_DROP] = h_drop
+    handlers[CLS_BR] = h_br
+    handlers[CLS_BRZ] = h_brz
+    handlers[CLS_BRNZ] = h_brnz
+    handlers[CLS_BR_TABLE] = h_br_table
+    handlers[CLS_RETURN] = h_return
+    handlers[CLS_CALL] = h_call
+    handlers[CLS_CALL_INDIRECT] = h_call_indirect
+    handlers[CLS_LOAD] = h_load
+    handlers[CLS_STORE] = h_store
+    handlers[CLS_MEMSIZE] = h_memsize
+    handlers[CLS_MEMGROW] = h_memgrow
+    handlers[CLS_TRAP] = h_trap
+
+    def step(st: UniformState) -> UniformState:
+        pc = jnp.clip(st.pc, 0, img.code_len - 1)
+        fetch = (sub_t[pc], a_t[pc], b_t[pc], c_t[pc], ilo_t[pc], ihi_t[pc])
+        cls = cls_t[pc]
+        new_st = lax.switch(cls, [
+            (lambda s, f=fetch, h=h: h(s, f)) for h in handlers
+        ], st)
+        # per-lane trap divergence check: if some (not all) lanes trapped in
+        # an ALU, bail to SIMT; if all trapped identically, halt with code
+        t = new_st.trap
+        t0 = t[0]
+        all_same = jnp.all(t == t0)
+        any_trap = jnp.any(t != 0)
+
+        def resolve(s):
+            return lax.cond(
+                all_same & (t0 != 0),
+                lambda s: s._replace(status=jnp.int32(ST_TRAPPED_BASE) + t0),
+                lambda s: lax.cond(
+                    any_trap & (s.status == ST_RUNNING),
+                    lambda s: s._replace(status=jnp.int32(ST_DIVERGED)),
+                    lambda s: s, s),
+                s)
+
+        new_st = resolve(new_st)
+        return new_st._replace(steps=new_st.steps + 1)
+
+    return step
+
+
+class UniformBatchEngine:
+    """Converged-lane engine with automatic SIMT fallback on divergence.
+
+    Chooses the fast path (scalar control, dynamic-slice stack rows) while
+    lanes agree on control flow; hands the state over to the general SIMT
+    engine (batch/engine.py) the moment they don't. This is the AUTO engine
+    behavior for replicated workloads (BASELINE configs 1-2)."""
+
+    def __init__(self, inst, store=None, conf=None, lanes=None, mesh=None):
+        from wasmedge_tpu.batch.engine import BatchEngine
+
+        self.simt = BatchEngine(inst, store=store, conf=conf, lanes=lanes,
+                                mesh=mesh)
+        self.inst = inst
+        self.cfg = self.simt.cfg
+        self.lanes = self.simt.lanes
+        self.img = self.simt.img
+        self._uchunk = None
+
+    def _build_uniform(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        step = make_uniform_step(self.img, self.cfg, self.lanes)
+        chunk = self.cfg.steps_per_launch
+
+        def run_chunk(st):
+            def cond(carry):
+                i, s = carry
+                return (i < chunk) & (s.status == ST_RUNNING)
+
+            def body(carry):
+                i, s = carry
+                return i + 1, step(s)
+
+            _, st = lax.while_loop(cond, body, (jnp.int32(0), st))
+            return st
+
+        self._uchunk = jax.jit(run_chunk, donate_argnums=0)
+
+    def _initial_uniform_state(self, func_idx, args_lanes):
+        import jax.numpy as jnp
+
+        base = self.simt.initial_state(func_idx, args_lanes)
+        CD = self.cfg.call_stack_depth
+        return UniformState(
+            pc=base.pc[0], sp=base.sp[0], fp=jnp.int32(0),
+            opbase=base.opbase[0], call_depth=jnp.int32(0),
+            status=jnp.int32(ST_RUNNING), steps=jnp.int32(0),
+            mem_pages=base.mem_pages[0],
+            stack_lo=base.stack_lo, stack_hi=base.stack_hi,
+            fr_ret_pc=jnp.zeros((CD,), jnp.int32),
+            fr_fp=jnp.zeros((CD,), jnp.int32),
+            fr_opbase=jnp.zeros((CD,), jnp.int32),
+            glob_lo=base.glob_lo, glob_hi=base.glob_hi,
+            mem=base.mem, trap=base.trap,
+        )
+
+    def _to_simt_state(self, ust: "UniformState"):
+        import jax.numpy as jnp
+
+        from wasmedge_tpu.batch.engine import BatchState
+
+        L = self.lanes
+        full = lambda v: jnp.full((L,), v, jnp.int32)
+        status = int(ust.status)
+        trap = ust.trap
+        if status == ST_DONE:
+            trap = jnp.full((L,), TRAP_DONE, jnp.int32)
+        elif status >= ST_TRAPPED_BASE:
+            trap = jnp.where(trap == 0, jnp.int32(status - ST_TRAPPED_BASE), trap)
+        cfg = self.cfg
+        fuel0 = cfg.fuel_per_launch if cfg.fuel_per_launch is not None else 0
+        return BatchState(
+            pc=full(ust.pc), sp=full(ust.sp), fp=full(ust.fp),
+            opbase=full(ust.opbase), call_depth=full(ust.call_depth),
+            trap=trap, retired=full(ust.steps),
+            fuel=full(max(fuel0 - int(ust.steps), 1) if fuel0 else 0),
+            mem_pages=full(ust.mem_pages),
+            stack_lo=ust.stack_lo, stack_hi=ust.stack_hi,
+            fr_ret_pc=jnp.broadcast_to(ust.fr_ret_pc[:, None],
+                                       (cfg.call_stack_depth, L)),
+            fr_fp=jnp.broadcast_to(ust.fr_fp[:, None],
+                                   (cfg.call_stack_depth, L)),
+            fr_opbase=jnp.broadcast_to(ust.fr_opbase[:, None],
+                                       (cfg.call_stack_depth, L)),
+            glob_lo=ust.glob_lo, glob_hi=ust.glob_hi, mem=ust.mem,
+        )
+
+    def run(self, func_name, args_lanes, max_steps: int = 10_000_000):
+        import numpy as np
+
+        from wasmedge_tpu.batch.engine import BatchResult
+
+        ex = self.inst.exports.get(func_name)
+        if ex is None or ex[0] != 0:
+            raise KeyError(f"no exported function {func_name}")
+        func_idx = ex[1]
+        if self.cfg.fuel_per_launch is not None or self.simt.mesh is not None:
+            # fuel accounting and mesh sharding live in the SIMT engine
+            return self.simt.run(func_name, args_lanes, max_steps)
+        if self._uchunk is None:
+            self._build_uniform()
+        ust = self._initial_uniform_state(func_idx, args_lanes)
+        fell_back = False
+        while int(ust.steps) < max_steps:
+            ust = self._uchunk(ust)
+            status = int(ust.status)
+            if status == ST_RUNNING:
+                continue
+            if status == ST_DIVERGED:
+                fell_back = True
+            break
+        self.fell_back_to_simt = fell_back
+        if fell_back:
+            # migrate to SIMT and finish there
+            if self.simt._run_chunk is None:
+                self.simt._build()
+            state = self._to_simt_state(ust)
+            total = int(ust.steps)
+            while total < max_steps:
+                done, state = self.simt._run_chunk(state)
+                total += int(done)
+                if not (np.asarray(state.trap) == 0).any():
+                    break
+                if int(done) == 0:
+                    break
+            return self._result_from_simt(func_idx, state, total)
+        # uniform completion
+        state = self._to_simt_state(ust)
+        return self._result_from_simt(func_idx, state, int(ust.steps))
+
+    def _result_from_simt(self, func_idx, state, steps):
+        import numpy as np
+
+        from wasmedge_tpu.batch.engine import BatchResult
+
+        nres = int(self.inst.lowered.funcs[func_idx].nresults)
+        stack_lo = np.asarray(state.stack_lo)
+        stack_hi = np.asarray(state.stack_hi)
+        results = []
+        for r in range(nres):
+            lo = stack_lo[r].view(np.uint32).astype(np.uint64)
+            hi = stack_hi[r].view(np.uint32).astype(np.uint64)
+            results.append((lo | (hi << np.uint64(32))).view(np.int64))
+        return BatchResult(results=results, trap=np.asarray(state.trap),
+                           retired=np.asarray(state.retired), steps=steps)

@@ -1,0 +1,7 @@
+from wasmedge_tpu.parallel.mesh import (
+    lane_mesh,
+    shard_batch_state,
+    state_shardings,
+)
+
+__all__ = ["lane_mesh", "shard_batch_state", "state_shardings"]

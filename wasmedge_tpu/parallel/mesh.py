@@ -1,0 +1,53 @@
+"""Multi-chip scaling: shard the lane dimension over a device Mesh.
+
+Wasm instances are share-nothing (SURVEY.md §2.10): the batch engine's lane
+axis is embarrassingly parallel, so multi-chip execution is pure SPMD data
+parallelism — state arrays sharded on their lane (last) dimension, code/
+function tables replicated, zero collectives in steady state. ICI/DCN is
+used only to scatter module images and gather results, replacing the
+reference's (nonexistent) need for a NCCL-style collective backend.
+
+Implementation is idiomatic pjit: NamedSharding annotations on the state
+pytree + jit; XLA SPMD-partitions the step. Device-local work is identical
+to the single-chip engine, so scaling is linear in chips.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def lane_mesh(n_devices: Optional[int] = None, devices=None):
+    """1-D mesh over the 'lanes' axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), axis_names=("lanes",))
+
+
+def state_shardings(mesh, state):
+    """NamedSharding pytree for a BatchState: lane dim (last) sharded."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def spec_for(x):
+        nd = getattr(x, "ndim", 0)
+        if nd == 0:
+            return NamedSharding(mesh, P())
+        spec = [None] * (nd - 1) + ["lanes"]
+        return NamedSharding(mesh, P(*spec))
+
+    import jax
+    return jax.tree_util.tree_map(spec_for, state)
+
+
+def shard_batch_state(state, mesh):
+    """Place a host-built BatchState onto the mesh, lane-sharded."""
+    import jax
+
+    return jax.device_put(state, state_shardings(mesh, state))
