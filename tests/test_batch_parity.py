@@ -335,7 +335,7 @@ class TestUniformEngine:
                 out = ex.invoke_raw(store, fi, [a & 0xFFFFFFFFFFFFFFFF])
                 want_vals.append(out[0] if out else 0)
                 want_traps.append(-1)
-            except Exception as e:
+            except TrapError as e:
                 want_vals.append(None)
                 want_traps.append(int(e.code))
         ex2, store2, inst2 = instantiate(data, conf)
@@ -408,6 +408,20 @@ class TestUniformEngine:
             ("local.get", 0), "memory.grow", "drop", "memory.size",
         ], export="f")
         self._compare_uniform(b.build(), "f", [1, 1], conf=conf,
+                              expect_fallback=False)
+
+    def test_memory_grow_from_zero_min(self):
+        # (memory 0) with no max: grow must still succeed up to the knob
+        from wasmedge_tpu.common.configure import Configure
+        conf = Configure()
+        conf.batch.memory_pages_per_lane = 4
+        conf.runtime.max_memory_pages = 4
+        b = ModuleBuilder()
+        b.add_memory(0)  # min 0, no max
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), "memory.grow", "drop", "memory.size",
+        ], export="f")
+        self._compare_uniform(b.build(), "f", [2, 2], conf=conf,
                               expect_fallback=False)
 
     def test_engine_factory(self):

@@ -127,7 +127,6 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
     f_frame_top = jnp.asarray(img.f_frame_top)
     f_type = jnp.asarray(img.f_type)
     table0 = jnp.asarray(img.table0)
-    mem_words_total = img.mem_pages_max * _PAGE_WORDS if img.mem_pages_max else 1
     fuel_enabled = cfg.fuel_per_launch is not None
 
     # ALU sub ids
@@ -711,7 +710,7 @@ class BatchEngine:
         # value — growth beyond memory_pages_per_lane returns -1, which is
         # the one place batch semantics are knob-dependent (static HBM
         # allocation; set the knob >= the workload's peak for parity).
-        if self.img.mem_pages_max > 0 or self.img.mem_pages_init > 0:
+        if self.img.has_memory:
             declared = self.img.mem_pages_max \
                 if self.img.mem_pages_max > 0 else cfg.memory_pages_per_lane
             self.img.mem_pages_max = max(

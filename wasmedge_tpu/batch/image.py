@@ -193,6 +193,7 @@ class DeviceImage:
     mem_init: np.ndarray  # [mem_words] int32 initial memory content
     mem_pages_init: int
     mem_pages_max: int
+    has_memory: bool
     max_local_zeros: int  # max (nlocals - nparams) over funcs
     code_len: int
 
@@ -350,5 +351,6 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         f_nresults=f_nresults, f_frame_top=f_frame_top, f_type=f_type,
         table0=table0, globals_lo=g_lo, globals_hi=g_hi,
         mem_init=mem_init, mem_pages_init=pages_init, mem_pages_max=pages_max,
+        has_memory=bool(memories),
         max_local_zeros=max_zeros, code_len=n,
     )

@@ -11,7 +11,6 @@ tests/test_batch_parity.py enforce this lane-by-lane).
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

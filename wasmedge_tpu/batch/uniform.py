@@ -21,8 +21,7 @@ they differ across lanes.
 
 from __future__ import annotations
 
-import dataclasses
-from typing import List, NamedTuple, Optional
+from typing import NamedTuple
 
 import numpy as np
 
@@ -723,7 +722,10 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
                 s)
 
         new_st = resolve(new_st)
-        return new_st._replace(steps=new_st.steps + 1)
+        # A divergence handoff rewinds to the pre-step state: the SIMT engine
+        # re-executes that instruction, so it must not count as a step here.
+        counted = jnp.where(new_st.status == ST_DIVERGED, 0, 1)
+        return new_st._replace(steps=new_st.steps + counted)
 
     return step
 
