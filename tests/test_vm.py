@@ -1,0 +1,131 @@
+"""VM façade: stage machine, registration, async + stop.
+
+Mirrors the reference's VM workflow coverage (test/api/APIVMCoreTest.cpp +
+test/thread/ThreadTest.cpp:167-330 for async-cancel semantics).
+"""
+
+import time
+
+import pytest
+
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.common.errors import ErrCode, TrapError, WasmError
+from wasmedge_tpu.models import build_fib
+from wasmedge_tpu.utils.builder import ModuleBuilder
+from wasmedge_tpu.vm import VM, VMStage
+
+
+def test_staged_pipeline():
+    vm = VM()
+    assert vm.stage == VMStage.Inited
+    vm.load_wasm(build_fib())
+    assert vm.stage == VMStage.Loaded
+    vm.validate()
+    assert vm.stage == VMStage.Validated
+    vm.instantiate()
+    assert vm.stage == VMStage.Instantiated
+    assert vm.execute("fib", [10]) == [55]
+
+
+def test_wrong_workflow_rejected():
+    vm = VM()
+    with pytest.raises(WasmError) as e:
+        vm.validate()
+    assert e.value.code == ErrCode.WrongVMWorkflow
+    vm.load_wasm(build_fib())
+    with pytest.raises(WasmError) as e:
+        vm.instantiate()  # skipped validate
+    assert e.value.code == ErrCode.WrongVMWorkflow
+
+
+def test_run_wasm_file_one_shot():
+    assert VM().run_wasm_file(build_fib(), "fib", [12]) == [144]
+
+
+def test_register_module_and_cross_call():
+    b = ModuleBuilder()
+    b.add_function(["i32", "i32"], ["i32"], [], [
+        ("local.get", 0), ("local.get", 1), "i32.add",
+    ], export="add")
+    vm = VM()
+    vm.register_module("math", b.build())
+
+    main = ModuleBuilder()
+    main.import_func("math", "add", ["i32", "i32"], ["i32"])
+    main.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("i32.const", 100), ("call", 0),
+    ], export="plus100")
+    out = vm.run_wasm_file(main.build(), "plus100", [5])
+    assert out == [105]
+    # registered module stays callable by name
+    assert vm.execute("add", [2, 3], module_name="math") == [5]
+
+
+def test_function_list():
+    vm = VM().load_wasm(build_fib()).validate().instantiate()
+    fl = vm.get_function_list()
+    assert len(fl) == 1
+    name, ft = fl[0]
+    assert name == "fib"
+    assert len(ft.params) == 1 and len(ft.results) == 1
+
+
+def test_async_execute():
+    vm = VM().load_wasm(build_fib()).validate().instantiate()
+    h = vm.async_execute("fib", [15])
+    assert h.get() == [610]
+    assert h.done()
+
+
+def test_async_cancel_interrupts_infinite_loop():
+    b = ModuleBuilder()
+    b.add_function([], [], [], [
+        ("loop", None), ("br", 0), "end",
+    ], export="spin")
+    vm = VM().load_wasm(b.build()).validate().instantiate()
+    h = vm.async_execute("spin")
+    assert not h.wait_for(0.05)
+    h.cancel()
+    with pytest.raises(TrapError) as e:
+        h.get()
+    assert e.value.code == ErrCode.Terminated
+
+
+def test_stale_stop_does_not_poison_next_run():
+    vm = VM()
+    vm.run_wasm_file(build_fib(), "fib", [10])
+    vm.stop()  # lands after completion; must be a no-op for future runs
+    assert vm.execute("fib", [10]) == [55]
+
+
+def test_cancel_is_per_handle():
+    b = ModuleBuilder()
+    b.add_function([], [], [], [("loop", None), ("br", 0), "end"], export="spin")
+    vm = VM().load_wasm(b.build()).validate().instantiate()
+    h1 = vm.async_execute("spin")
+    h2 = vm.async_execute("spin")
+    h1.cancel()
+    assert h1.wait_for(1.0)
+    assert not h2.done()
+    h2.cancel()
+    assert h2.wait_for(1.0)
+
+
+def test_execute_batch_via_vm():
+    import numpy as np
+
+    vm = VM().load_wasm(build_fib()).validate().instantiate()
+    res = vm.execute_batch("fib", [np.full(8, 10, np.int64)], lanes=8)
+    assert res.completed.all()
+    assert (np.asarray(res.results[0]) == 55).all()
+
+
+def test_cleanup_keeps_registered():
+    b = ModuleBuilder()
+    b.add_function([], ["i32"], [], [("i32.const", 7)], export="seven")
+    vm = VM()
+    vm.register_module("k", b.build())
+    vm.run_wasm_file(build_fib(), "fib", [5])
+    vm.cleanup()
+    assert vm.stage == VMStage.Inited
+    assert vm.execute("seven", [], module_name="k") == [7]

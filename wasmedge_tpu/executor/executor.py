@@ -11,6 +11,7 @@ reference's interpreter/AOT seam (include/runtime/instance/function.h).
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Sequence
 
 from wasmedge_tpu.common.configure import Configure, EngineKind
@@ -48,11 +49,40 @@ def _limits_match(provided_min, provided_max, required_min, required_max) -> boo
     return True
 
 
+class StopToken:
+    """Interruption token polled at calls/branches (reference:
+    include/executor/executor.h:637, lib/executor/helper.cpp:24,184).
+    Truthiness is the poll, so the engine's `if thread.stop_token:` works
+    unchanged whether it holds a plain bool or this shared token. One token
+    per execution: a stale stop() cannot poison later runs, and cancelling
+    one async handle does not terminate its siblings."""
+
+    __slots__ = ("_flag",)
+
+    def __init__(self):
+        self._flag = False
+
+    def stop(self):
+        self._flag = True
+
+    def __bool__(self) -> bool:
+        return self._flag
+
+
 class Executor:
     def __init__(self, conf: Optional[Configure] = None,
                  stat: Optional[Statistics] = None):
         self.conf = conf or Configure()
         self.stat = stat
+        self._active_tokens: set = set()
+        self._token_lock = threading.Lock()
+
+    def stop(self):
+        """Interrupt every execution currently in flight (reference:
+        Executor::stop; here fan-out because tokens are per-execution)."""
+        with self._token_lock:
+            for t in self._active_tokens:
+                t.stop()
 
     # ------------------------------------------------------------------
     # Instantiation
@@ -218,7 +248,7 @@ class Executor:
     # Invocation
     # ------------------------------------------------------------------
     def invoke(self, store: StoreManager, fi: FunctionInstance,
-               args: Sequence = ()) -> list:
+               args: Sequence = (), stop_token: Optional[StopToken] = None) -> list:
         """Typed invoke (reference: executor.cpp:87-97). Arg *count* is
         checked; values are numerically coerced to the declared param types
         (Python args are untagged, unlike the reference's WasmEdge_Value)."""
@@ -227,16 +257,23 @@ class Executor:
             raise TrapError(ErrCode.FuncSigMismatch,
                             f"expected {len(ft.params)} args, got {len(args)}")
         raw = [typed_to_bits(t, v) for t, v in zip(ft.params, args)]
-        out = self.invoke_raw(store, fi, raw)
+        out = self.invoke_raw(store, fi, raw, stop_token)
         return [bits_to_typed(t, v) for t, v in zip(ft.results, out)]
 
     def invoke_raw(self, store: StoreManager, fi: FunctionInstance,
-                   raw_args: List[int]) -> List[int]:
+                   raw_args: List[int],
+                   stop_token: Optional[StopToken] = None) -> List[int]:
         if self.stat is not None:
             self.stat.start_wasm()
+        token = stop_token if stop_token is not None else StopToken()
+        with self._token_lock:
+            self._active_tokens.add(token)
         thread = scalar_engine.Thread(store, self.conf, self.stat)
+        thread.stop_token = token
         try:
             return scalar_engine.run_function(thread, fi, raw_args)
         finally:
+            with self._token_lock:
+                self._active_tokens.discard(token)
             if self.stat is not None:
                 self.stat.stop_wasm()

@@ -1,0 +1,4 @@
+from wasmedge_tpu.vm.vm import VM, VMStage
+from wasmedge_tpu.vm.async_ import Async
+
+__all__ = ["VM", "VMStage", "Async"]

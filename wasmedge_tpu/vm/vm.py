@@ -1,0 +1,224 @@
+"""VM façade: stage machine + Configure-driven host modules and engines.
+
+Mirrors the reference VM (/root/reference/include/vm/vm.h:42-268,
+lib/vm/vm.cpp:1-369): a {Inited, Loaded, Validated, Instantiated} stage
+machine over loader/validator/executor/store, auto-registration of WASI and
+process host modules per Configure, one-shot `run_wasm_file`, named-module
+registration, export enumeration, and async execution with stop().
+
+The TPU addition is `execute_batch` — the same staged pipeline, but
+execution fans the instantiated module out over thousands of device lanes
+via the tpu_batch engine (the engine-switch seam the reference implements
+with the interpreter/AOT FunctionInstance variant).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from wasmedge_tpu.common.configure import Configure, HostRegistration
+from wasmedge_tpu.common.errors import ErrCode, WasmError
+from wasmedge_tpu.common.statistics import Statistics
+from wasmedge_tpu.executor.executor import Executor, StopToken
+from wasmedge_tpu.loader import ast
+from wasmedge_tpu.loader.loader import Loader
+from wasmedge_tpu.runtime.hostfunc import ImportObject
+from wasmedge_tpu.runtime.instance import FunctionInstance, ModuleInstance
+from wasmedge_tpu.runtime.store import StoreManager
+from wasmedge_tpu.validator.validator import Validator
+from wasmedge_tpu.vm.async_ import Async
+
+Source = Union[str, bytes, bytearray, ast.Module]
+
+
+class VMStage(enum.Enum):
+    """reference: include/vm/vm.h:241"""
+
+    Inited = 0
+    Loaded = 1
+    Validated = 2
+    Instantiated = 3
+
+
+class VM:
+    def __init__(self, conf: Optional[Configure] = None,
+                 store: Optional[StoreManager] = None):
+        self.conf = conf or Configure()
+        self.store = store if store is not None else StoreManager()
+        self.stat = Statistics(self.conf)
+        self.loader = Loader(self.conf)
+        self.validator = Validator(self.conf)
+        self.executor = Executor(self.conf, self.stat)
+        self.stage = VMStage.Inited
+        self._mod: Optional[ast.Module] = None
+        self._active: Optional[ModuleInstance] = None
+        self._host_modules: Dict[HostRegistration, ImportObject] = {}
+        self._lock = threading.RLock()  # reference: shared_mutex, vm.h:251
+        self._init_host_modules()
+
+    # -- host modules (reference: lib/vm/vm.cpp:28-42) ---------------------
+    def _init_host_modules(self):
+        if HostRegistration.Wasi in self.conf.host_registrations:
+            from wasmedge_tpu.host.wasi import WasiModule
+
+            wasi = WasiModule()
+            self._host_modules[HostRegistration.Wasi] = wasi
+            self.executor.register_import_object(self.store, wasi)
+        if HostRegistration.WasmEdgeProcess in self.conf.host_registrations:
+            from wasmedge_tpu.host.process import WasmEdgeProcessModule
+
+            proc = WasmEdgeProcessModule()
+            self._host_modules[HostRegistration.WasmEdgeProcess] = proc
+            self.executor.register_import_object(self.store, proc)
+
+    def get_import_module(self, reg: HostRegistration) -> Optional[ImportObject]:
+        return self._host_modules.get(reg)
+
+    @property
+    def wasi_module(self):
+        return self._host_modules.get(HostRegistration.Wasi)
+
+    # -- staged pipeline ---------------------------------------------------
+    def _parse(self, source: Source) -> ast.Module:
+        if isinstance(source, ast.Module):
+            return source
+        if isinstance(source, (bytes, bytearray)):
+            return self.loader.parse_module(bytes(source))
+        return self.loader.parse_file(source)
+
+    def load_wasm(self, source: Source) -> "VM":
+        with self._lock:
+            self._mod = self._parse(source)
+            self.stage = VMStage.Loaded
+        return self
+
+    def validate(self) -> "VM":
+        with self._lock:
+            if self.stage != VMStage.Loaded:
+                raise WasmError(ErrCode.WrongVMWorkflow, "expected Loaded stage")
+            self.validator.validate(self._mod)
+            self.stage = VMStage.Validated
+        return self
+
+    def instantiate(self) -> "VM":
+        with self._lock:
+            if self.stage != VMStage.Validated:
+                raise WasmError(ErrCode.WrongVMWorkflow, "expected Validated stage")
+            self._active = self.executor.instantiate(self.store, self._mod)
+            self.stage = VMStage.Instantiated
+        return self
+
+    # -- registration (reference: vm.cpp:46-95) ----------------------------
+    def register_module(self, name: str, source: Source) -> ModuleInstance:
+        """Load+validate+instantiate under a module name for later imports.
+        Resets the stage machine like the reference (vm.cpp:46-50)."""
+        with self._lock:
+            mod = self._parse(source)
+            self.validator.validate(mod)
+            inst = self.executor.register_module(self.store, mod, name)
+            self.stage = VMStage.Inited
+            return inst
+
+    def register_import_object(self, impobj: ImportObject) -> ModuleInstance:
+        with self._lock:
+            inst = self.executor.register_import_object(self.store, impobj)
+            self.stage = VMStage.Inited
+            return inst
+
+    # -- execution ---------------------------------------------------------
+    def _find_function(self, func_name: str,
+                       module_name: Optional[str] = None) -> FunctionInstance:
+        if module_name is None:
+            inst = self._active
+            if inst is None or self.stage != VMStage.Instantiated:
+                raise WasmError(ErrCode.WrongVMWorkflow, "no instantiated module")
+        else:
+            inst = self.store.find_module(module_name)
+            if inst is None:
+                raise WasmError(ErrCode.WrongInstanceAddress,
+                                f"unknown module {module_name!r}")
+        ex = inst.exports.get(func_name)
+        if ex is None or ex[0] != 0:
+            raise WasmError(ErrCode.FuncNotFound, func_name)
+        return inst.funcs[ex[1]]
+
+    def execute(self, func_name: str, args: Sequence = (),
+                module_name: Optional[str] = None, _stop_token=None) -> list:
+        # Resolve under the lock (stage/store may be mutated concurrently);
+        # run the interpreter outside it so executions proceed in parallel
+        # and cancel/stop never blocks (reference shared_mutex semantics).
+        with self._lock:
+            fi = self._find_function(func_name, module_name)
+        return self.executor.invoke(self.store, fi, args, _stop_token)
+
+    def run_wasm_file(self, source: Source, func_name: str,
+                      args: Sequence = (), _stop_token=None) -> list:
+        """One-shot load+validate+instantiate+execute (vm.cpp:131-155)."""
+        with self._lock:
+            self.load_wasm(source)
+            self.validate()
+            self.instantiate()
+            fi = self._find_function(func_name)
+        return self.executor.invoke(self.store, fi, args, _stop_token)
+
+    def execute_batch(self, func_name: str, args_lanes: Sequence,
+                      lanes: Optional[int] = None, mesh=None,
+                      max_steps: int = 10_000_000):
+        """Run the instantiated module's export over N device lanes in SIMT
+        lockstep (the tpu_batch engine, SURVEY.md §2.10) and return the
+        BatchResult (per-lane results/trap/retired arrays)."""
+        from wasmedge_tpu.batch.engine import BatchEngine
+
+        with self._lock:
+            if self._active is None or self.stage != VMStage.Instantiated:
+                raise WasmError(ErrCode.WrongVMWorkflow, "no instantiated module")
+            inst = self._active
+        eng = BatchEngine(inst, store=self.store, conf=self.conf,
+                          lanes=lanes, mesh=mesh)
+        return eng.run(func_name, list(args_lanes), max_steps=max_steps)
+
+    # -- async + interruption (reference: vm.cpp asyncExecute + stop) ------
+    def stop(self):
+        self.executor.stop()
+
+    def async_execute(self, func_name: str, args: Sequence = (),
+                      module_name: Optional[str] = None) -> Async:
+        token = StopToken()
+        return Async(lambda: self.execute(func_name, args, module_name,
+                                          _stop_token=token),
+                     stop_fn=token.stop)
+
+    def async_run_wasm_file(self, source: Source, func_name: str,
+                            args: Sequence = ()) -> Async:
+        token = StopToken()
+        return Async(lambda: self.run_wasm_file(source, func_name, args,
+                                                _stop_token=token),
+                     stop_fn=token.stop)
+
+    # -- introspection (reference: vm.cpp:343-358) -------------------------
+    def get_function_list(self) -> List[Tuple[str, ast.FunctionType]]:
+        if self._active is None:
+            return []
+        out = []
+        for name, (kind, idx) in self._active.exports.items():
+            if kind == 0:
+                out.append((name, self._active.funcs[idx].functype))
+        return out
+
+    @property
+    def active_module(self) -> Optional[ModuleInstance]:
+        return self._active
+
+    def statistics(self) -> Statistics:
+        return self.stat
+
+    # -- cleanup (reference: VM::cleanup) ----------------------------------
+    def cleanup(self):
+        with self._lock:
+            self._mod = None
+            self._active = None
+            self.store.reset(keep_registered=True)
+            self.stat.reset()
+            self.stage = VMStage.Inited
