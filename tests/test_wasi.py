@@ -1,0 +1,405 @@
+"""WASI preview1 host functions, driven directly with a synthetic memory.
+
+Mirrors the reference's unit strategy (test/host/wasi/wasi.cpp:1-1603:
+hostfuncs called with a hand-built MemoryInstance) plus loopback socket
+integration (test/host/socket/wasi_socket.cpp) and an end-to-end wasm
+module printing through fd_write.
+"""
+
+import os
+import struct
+import socket
+import threading
+
+import pytest
+
+from wasmedge_tpu.common.configure import Configure, HostRegistration
+from wasmedge_tpu.host.wasi import WasiExit, WasiModule
+from wasmedge_tpu.host.wasi.wasi_abi import (
+    Errno,
+    Oflags,
+    Rights,
+    Whence,
+)
+from wasmedge_tpu.loader.ast import Limit, MemoryType
+from wasmedge_tpu.runtime.instance import MemoryInstance
+from wasmedge_tpu.utils.builder import ModuleBuilder
+from wasmedge_tpu.vm import VM
+
+
+def make_mem(pages=1):
+    return MemoryInstance(MemoryType(Limit(pages, pages)))
+
+
+def call(wasi, name, mem, *args):
+    hf = wasi.funcs[name]
+    raw = [a & 0xFFFFFFFFFFFFFFFF for a in args]
+    out = hf.run(mem, raw)
+    return out[0] if out else None
+
+
+# ---------------------------------------------------------------------------
+# args / environ / clock / random
+# ---------------------------------------------------------------------------
+def test_args_roundtrip():
+    wasi = WasiModule()
+    wasi.init_wasi(prog_name="prog", args=["a", "bc"])
+    mem = make_mem()
+    assert call(wasi, "args_sizes_get", mem, 0, 8) == Errno.SUCCESS
+    assert mem.load(0, 4, False) == 3
+    assert mem.load(8, 4, False) == len(b"prog\0a\0bc\0")
+    assert call(wasi, "args_get", mem, 16, 64) == Errno.SUCCESS
+    buf = mem.load_bytes(64, 10)
+    assert buf == b"prog\0a\0bc\0"
+    # argv pointers
+    p0 = mem.load(16, 4, False)
+    p1 = mem.load(20, 4, False)
+    assert (p0, p1) == (64, 69)
+
+
+def test_environ_roundtrip():
+    wasi = WasiModule()
+    wasi.init_wasi(envs=["A=1", "LONG=xyz"])
+    mem = make_mem()
+    assert call(wasi, "environ_sizes_get", mem, 0, 4) == Errno.SUCCESS
+    assert mem.load(0, 4, False) == 2
+    assert call(wasi, "environ_get", mem, 8, 32) == Errno.SUCCESS
+    assert mem.load_bytes(32, 4) == b"A=1\0"
+
+
+def test_clock_and_random():
+    wasi = WasiModule()
+    mem = make_mem()
+    assert call(wasi, "clock_time_get", mem, 0, 0, 0) == Errno.SUCCESS
+    t1 = mem.load(0, 8, False)
+    assert t1 > 1_600_000_000 * 10**9  # after 2020, realtime
+    assert call(wasi, "clock_res_get", mem, 1, 8) == Errno.SUCCESS
+    assert call(wasi, "clock_time_get", mem, 99, 0, 0) == Errno.INVAL
+    assert call(wasi, "random_get", mem, 100, 16) == Errno.SUCCESS
+    assert mem.load_bytes(100, 16) != bytes(16)
+
+
+# ---------------------------------------------------------------------------
+# fd + path family over a preopened tmpdir
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def wasi_tmp(tmp_path):
+    wasi = WasiModule()
+    wasi.init_wasi(dirs=[f"/:{tmp_path}"])
+    return wasi, tmp_path
+
+
+def _store_str(mem, off, s):
+    raw = s.encode()
+    mem.store_bytes(off, raw)
+    return off, len(raw)
+
+
+def _iovec(mem, iov_off, buf_off, data=None, length=None):
+    if data is not None:
+        mem.store_bytes(buf_off, data)
+        length = len(data)
+    mem.store(iov_off, 4, buf_off)
+    mem.store(iov_off + 4, 4, length)
+
+
+def test_prestat(wasi_tmp):
+    wasi, _ = wasi_tmp
+    mem = make_mem()
+    assert call(wasi, "fd_prestat_get", mem, 3, 0) == Errno.SUCCESS
+    tag = mem.load(0, 1, False)
+    nlen = mem.load(4, 4, False)
+    assert tag == 0 and nlen == 1
+    assert call(wasi, "fd_prestat_dir_name", mem, 3, 16, nlen) == Errno.SUCCESS
+    assert mem.load_bytes(16, 1) == b"/"
+    assert call(wasi, "fd_prestat_get", mem, 0, 0) == Errno.BADF
+
+
+def _open(wasi, mem, dirfd, path, oflags=0, rights=None, fdflags=0):
+    p, plen = _store_str(mem, 1024, path)
+    if rights is None:
+        rights = Rights.FILE_BASE | Rights.DIR_BASE
+    err = call(wasi, "path_open", mem, dirfd, 1, p, plen, oflags,
+               rights, rights, fdflags, 2048)
+    return err, mem.load(2048, 4, False)
+
+
+def test_file_write_read_seek(wasi_tmp):
+    wasi, tmp = wasi_tmp
+    mem = make_mem()
+    err, fd = _open(wasi, mem, 3, "hello.txt", Oflags.CREAT)
+    assert err == Errno.SUCCESS
+    _iovec(mem, 64, 128, b"hello wasi")
+    assert call(wasi, "fd_write", mem, fd, 64, 1, 0) == Errno.SUCCESS
+    assert mem.load(0, 4, False) == 10
+    assert (tmp / "hello.txt").read_bytes() == b"hello wasi"
+    # seek to 6, read 4
+    assert call(wasi, "fd_seek", mem, fd, 6, Whence.SET, 8) == Errno.SUCCESS
+    assert mem.load(8, 8, False) == 6
+    _iovec(mem, 64, 256, length=4)
+    assert call(wasi, "fd_read", mem, fd, 64, 1, 0) == Errno.SUCCESS
+    assert mem.load(0, 4, False) == 4
+    assert mem.load_bytes(256, 4) == b"wasi"
+    # tell
+    assert call(wasi, "fd_tell", mem, fd, 16) == Errno.SUCCESS
+    assert mem.load(16, 8, False) == 10
+    # pread at 0
+    _iovec(mem, 64, 300, length=5)
+    assert call(wasi, "fd_pread", mem, fd, 64, 1, 0, 0) == Errno.SUCCESS
+    assert mem.load_bytes(300, 5) == b"hello"
+    # filestat
+    assert call(wasi, "fd_filestat_get", mem, fd, 512) == Errno.SUCCESS
+    assert mem.load(512 + 32, 8, False) == 10  # size
+    assert call(wasi, "fd_close", mem, fd) == Errno.SUCCESS
+    assert call(wasi, "fd_close", mem, fd) == Errno.BADF
+
+
+def test_rights_enforced(wasi_tmp):
+    wasi, tmp = wasi_tmp
+    (tmp / "ro.txt").write_bytes(b"x")
+    mem = make_mem()
+    err, fd = _open(wasi, mem, 3, "ro.txt", 0, rights=Rights.FD_READ)
+    assert err == Errno.SUCCESS
+    _iovec(mem, 64, 128, b"nope")
+    assert call(wasi, "fd_write", mem, fd, 64, 1, 0) == Errno.NOTCAPABLE
+    # requesting rights beyond the dir's inheriting set is refused
+    err, _ = _open(wasi, mem, 3, "ro.txt", 0, rights=1 << 40)
+    assert err == Errno.NOTCAPABLE
+
+
+def test_sandbox_escape_blocked(wasi_tmp):
+    wasi, tmp = wasi_tmp
+    mem = make_mem()
+    err, _ = _open(wasi, mem, 3, "../outside", Oflags.CREAT)
+    assert err == Errno.NOTCAPABLE
+    # symlink pointing outside is refused
+    os.symlink("/etc", tmp / "evil")
+    err, _ = _open(wasi, mem, 3, "evil/passwd")
+    assert err == Errno.NOTCAPABLE
+
+
+def test_dirs_and_rename(wasi_tmp):
+    wasi, tmp = wasi_tmp
+    mem = make_mem()
+    p, plen = _store_str(mem, 1024, "sub")
+    assert call(wasi, "path_create_directory", mem, 3, p, plen) == Errno.SUCCESS
+    assert (tmp / "sub").is_dir()
+    (tmp / "f1").write_bytes(b"data")
+    o, olen = _store_str(mem, 1100, "f1")
+    n, nlen = _store_str(mem, 1200, "sub/f2")
+    assert call(wasi, "path_rename", mem, 3, o, olen, 3, n, nlen) == Errno.SUCCESS
+    assert (tmp / "sub" / "f2").read_bytes() == b"data"
+    # path_filestat_get
+    assert call(wasi, "path_filestat_get", mem, 3, 1, n, nlen, 512) == Errno.SUCCESS
+    assert mem.load(512 + 16, 1, False) == 4  # REGULAR_FILE
+    # unlink + rmdir
+    assert call(wasi, "path_unlink_file", mem, 3, n, nlen) == Errno.SUCCESS
+    assert call(wasi, "path_remove_directory", mem, 3, p, plen) == Errno.SUCCESS
+    assert not (tmp / "sub").exists()
+
+
+def test_readdir(wasi_tmp):
+    wasi, tmp = wasi_tmp
+    (tmp / "aa").write_bytes(b"")
+    (tmp / "bb").write_bytes(b"")
+    mem = make_mem()
+    err, fd = _open(wasi, mem, 3, ".", Oflags.DIRECTORY)
+    assert err == Errno.SUCCESS
+    assert call(wasi, "fd_readdir", mem, fd, 0, 512, 0, 600) == Errno.SUCCESS
+    used = mem.load(600, 4, False)
+    blob = mem.load_bytes(0, used)
+    names = []
+    off = 0
+    while off < used:
+        namlen = struct.unpack_from("<I", blob, off + 16)[0]
+        names.append(blob[off + 24:off + 24 + namlen].decode())
+        off += 24 + namlen
+    assert names == [".", "..", "aa", "bb"]
+
+
+def test_symlink_readlink(wasi_tmp):
+    wasi, tmp = wasi_tmp
+    mem = make_mem()
+    o, olen = _store_str(mem, 1024, "target")
+    n, nlen = _store_str(mem, 1100, "link")
+    assert call(wasi, "path_symlink", mem, o, olen, 3, n, nlen) == Errno.SUCCESS
+    assert call(wasi, "path_readlink", mem, 3, n, nlen, 0, 64, 600) == Errno.SUCCESS
+    used = mem.load(600, 4, False)
+    assert mem.load_bytes(0, used) == b"target"
+
+
+def test_trailing_dotdot_within_sandbox_allowed(wasi_tmp):
+    wasi, tmp = wasi_tmp
+    (tmp / "sub").mkdir()
+    mem = make_mem()
+    p, plen = _store_str(mem, 1024, "sub/..")
+    assert call(wasi, "path_filestat_get", mem, 3, 1, p, plen, 512) == Errno.SUCCESS
+    assert mem.load(512 + 16, 1, False) == 3  # DIRECTORY (the preopen root)
+
+
+def test_bad_guest_pointer_is_efault(wasi_tmp):
+    wasi, _ = wasi_tmp
+    mem = make_mem()
+    # iovec pointing past the 64KiB page
+    _iovec(mem, 64, 128, length=8)
+    mem.store(64, 4, 0xFFFF0)  # buf beyond memory
+    assert call(wasi, "fd_read", mem, 0, 64, 1, 0) == Errno.FAULT
+    assert call(wasi, "random_get", mem, 0, 0xFFFFFFFF) == Errno.FAULT
+
+
+def test_process_env_not_inherited(monkeypatch):
+    from wasmedge_tpu.host.process import WasmEdgeProcessModule
+
+    monkeypatch.setenv("LEAKY_SECRET", "s3cret")
+    proc = WasmEdgeProcessModule(allowed_cmds=["env"])
+    mem = make_mem()
+
+    def pc(name, *args):
+        hf = proc.funcs[name]
+        out = hf.run(mem, list(args))
+        return out[0] if out else None
+
+    mem.store_bytes(0, b"env")
+    pc("wasmedge_process_set_prog_name", 0, 3)
+    assert pc("wasmedge_process_run") == 0
+    n = pc("wasmedge_process_get_stdout_len")
+    pc("wasmedge_process_get_stdout", 100)
+    assert b"LEAKY_SECRET" not in mem.load_bytes(100, n)
+
+
+def test_proc_exit():
+    wasi = WasiModule()
+    mem = make_mem()
+    with pytest.raises(WasiExit) as e:
+        call(wasi, "proc_exit", mem, 42)
+    assert e.value.code == 42
+    assert wasi.exit_code == 42
+
+
+# ---------------------------------------------------------------------------
+# sockets: loopback TCP echo through the wasi socket extension
+# ---------------------------------------------------------------------------
+def test_socket_loopback_echo():
+    wasi = WasiModule()
+    mem = make_mem()
+
+    # server socket via wasi: open/bind/listen
+    assert call(wasi, "sock_open", mem, 0, 1, 0) == Errno.SUCCESS  # INET4 STREAM
+    sfd = mem.load(0, 4, False)
+    # address buffer: {buf=32, len=4}, 0.0.0.0
+    mem.store(16, 4, 32)
+    mem.store(20, 4, 4)
+    mem.store_bytes(32, socket.inet_aton("127.0.0.1"))
+    assert call(wasi, "sock_bind", mem, sfd, 16, 0) == Errno.SUCCESS
+    assert call(wasi, "sock_listen", mem, sfd, 4) == Errno.SUCCESS
+    # discover bound port
+    assert call(wasi, "sock_getlocaladdr", mem, sfd, 16, 48, 52) == Errno.SUCCESS
+    port = mem.load(52, 4, False)
+    assert port > 0
+
+    # plain-python client connects and echoes
+    def client():
+        c = socket.create_connection(("127.0.0.1", port), timeout=5)
+        data = c.recv(16)
+        c.sendall(data.upper())
+        c.close()
+
+    t = threading.Thread(target=client)
+    t.start()
+
+    assert call(wasi, "sock_accept", mem, sfd, 60) == Errno.SUCCESS
+    cfd = mem.load(60, 4, False)
+    # send "ping" via iovec at 64 -> buf 128
+    _iovec(mem, 64, 128, b"ping")
+    assert call(wasi, "sock_send", mem, cfd, 64, 1, 0, 72) == Errno.SUCCESS
+    assert mem.load(72, 4, False) == 4
+    _iovec(mem, 64, 256, length=4)
+    assert call(wasi, "sock_recv", mem, cfd, 64, 1, 0, 72, 76) == Errno.SUCCESS
+    assert mem.load_bytes(256, 4) == b"PING"
+    assert call(wasi, "sock_shutdown", mem, cfd, 3) == Errno.SUCCESS
+    assert call(wasi, "fd_close", mem, cfd) == Errno.SUCCESS
+    assert call(wasi, "fd_close", mem, sfd) == Errno.SUCCESS
+    t.join()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: wasm module printing through fd_write via the VM
+# ---------------------------------------------------------------------------
+def _hello_wasm():
+    b = ModuleBuilder()
+    b.import_func("wasi_snapshot_preview1", "fd_write",
+                  ["i32", "i32", "i32", "i32"], ["i32"])
+    b.add_memory(1, 1, export="memory")
+    b.add_active_data(0, [("i32.const", 8)], b"hello, wasi\n")
+    # iovec at 0: buf=8 len=12
+    b.add_function([], ["i32"], [], [
+        ("i32.const", 0), ("i32.const", 8), "i32.store",
+        ("i32.const", 4), ("i32.const", 12), "i32.store",
+        ("i32.const", 1),   # fd: stdout
+        ("i32.const", 0),   # iovs
+        ("i32.const", 1),   # iovs_len
+        ("i32.const", 24),  # nwritten ptr
+        ("call", 0),
+    ], export="_start")
+    return b.build()
+
+
+def test_hello_via_vm(tmp_path):
+    conf = Configure()
+    conf.host_registrations.add(HostRegistration.Wasi)
+    vm = VM(conf)
+    # Redirect guest stdout (fd 1) into a pipe so the test can capture it.
+    r, w = os.pipe()
+    vm.wasi_module.env.fds[1].os_fd = w
+    out = vm.run_wasm_file(_hello_wasm(), "_start")
+    os.close(w)
+    assert out == [Errno.SUCCESS]
+    assert os.read(r, 64) == b"hello, wasi\n"
+    os.close(r)
+    nwritten = vm.active_module.memories[0].load(24, 4, False)
+    assert nwritten == 12
+
+
+def test_wasi_exit_code_via_vm():
+    conf = Configure()
+    conf.host_registrations.add(HostRegistration.Wasi)
+    b = ModuleBuilder()
+    b.import_func("wasi_snapshot_preview1", "proc_exit", ["i32"], [])
+    b.add_function([], [], [], [("i32.const", 7), ("call", 0)], export="_start")
+    vm = VM(conf)
+    with pytest.raises(WasiExit):
+        vm.run_wasm_file(b.build(), "_start")
+    assert vm.wasi_module.exit_code == 7
+
+
+# ---------------------------------------------------------------------------
+# wasmedge_process module
+# ---------------------------------------------------------------------------
+def test_process_module_allowlist():
+    from wasmedge_tpu.host.process import WasmEdgeProcessModule
+
+    proc = WasmEdgeProcessModule(allowed_cmds=["echo"])
+    mem = make_mem()
+
+    def pc(name, *args):
+        hf = proc.funcs[name]
+        out = hf.run(mem, list(args))
+        return out[0] if out else None
+
+    mem.store_bytes(0, b"echo")
+    pc("wasmedge_process_set_prog_name", 0, 4)
+    mem.store_bytes(8, b"hi")
+    pc("wasmedge_process_add_arg", 8, 2)
+    pc("wasmedge_process_set_timeout", 5000)
+    assert pc("wasmedge_process_run") == 0
+    assert pc("wasmedge_process_get_exit_code") == 0
+    n = pc("wasmedge_process_get_stdout_len")
+    assert n == 3
+    pc("wasmedge_process_get_stdout", 100)
+    assert mem.load_bytes(100, n) == b"hi\n"
+
+    # denied command
+    mem.store_bytes(0, b"rm")
+    pc("wasmedge_process_set_prog_name", 0, 2)
+    assert pc("wasmedge_process_run") == 0xFFFFFFFF
+    assert pc("wasmedge_process_get_stderr_len") > 0

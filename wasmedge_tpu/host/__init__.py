@@ -1,0 +1,6 @@
+"""Host modules: WASI preview1 + wasmedge_process.
+
+Mirrors the reference's lib/host/ tree. Host functions serve both engines:
+the scalar engine calls them inline (helper.cpp:35-97 analog) and the batch
+engine reaches them through the device->host outcall buffer (SURVEY.md §5.8).
+"""

@@ -1,0 +1,144 @@
+"""wasmedge_process host module: sandboxed subprocess execution.
+
+Mirrors /root/reference/lib/host/wasmedge_process/{processmodule.cpp:15-35,
+processfunc.cpp:1-343} and processenv.h:15-41: staged command construction
+(set_prog_name/add_arg/add_env/add_stdin/set_timeout), run with an
+allow-list policy (AllowedCmd / AllowedAll), and exit-code/stdout/stderr
+retrieval.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import List, Optional, Set
+
+from wasmedge_tpu.runtime.hostfunc import HostFunctionBase, ImportObject
+
+MASK32 = 0xFFFFFFFF
+
+
+class ProcessEnviron:
+    """reference: include/host/wasmedge_process/processenv.h:15-41"""
+
+    TIMEOUT_CODE = 0xFFFFFFFF  # reference: ExpectedLifeTime exceeded marker
+
+    def __init__(self):
+        self.name: str = ""
+        self.args: List[str] = []
+        self.envs: dict = {}
+        self.stdin: bytes = b""
+        self.timeout_ms: int = 10_000  # reference default DEFAULT_TIMEOUT
+        self.exit_code: int = 0
+        self.stdout: bytes = b""
+        self.stderr: bytes = b""
+        self.allowed_cmds: Set[str] = set()
+        self.allowed_all: bool = False
+
+    def reset_staging(self):
+        self.name = ""
+        self.args = []
+        self.envs = {}
+        self.stdin = b""
+        self.timeout_ms = 10_000
+
+
+class _ProcFn(HostFunctionBase):
+    def __init__(self, name, params, results, fn):
+        super().__init__(params, results, name=name)
+        self._fn = fn
+
+    def body(self, mem, *args):
+        return self._fn(mem, *args)
+
+
+class WasmEdgeProcessModule(ImportObject):
+    MODULE_NAME = "wasmedge_process"
+
+    def __init__(self, allowed_cmds: Optional[List[str]] = None,
+                 allow_all: bool = False):
+        super().__init__(self.MODULE_NAME)
+        self.env = ProcessEnviron()
+        self.env.allowed_cmds = set(allowed_cmds or [])
+        self.env.allowed_all = allow_all
+        e = self.env
+
+        def set_prog_name(mem, ptr, ln):
+            e.name = mem.load_bytes(ptr & MASK32, ln & MASK32).decode()
+
+        def add_arg(mem, ptr, ln):
+            e.args.append(mem.load_bytes(ptr & MASK32, ln & MASK32).decode())
+
+        def add_env(mem, nptr, nlen, vptr, vlen):
+            key = mem.load_bytes(nptr & MASK32, nlen & MASK32).decode()
+            val = mem.load_bytes(vptr & MASK32, vlen & MASK32).decode()
+            e.envs[key] = val
+
+        def add_stdin(mem, ptr, ln):
+            e.stdin += mem.load_bytes(ptr & MASK32, ln & MASK32)
+
+        def set_timeout(mem, ms):
+            e.timeout_ms = ms & MASK32
+
+        def run(mem):
+            # Allow-list policy (reference: processfunc.cpp run policy).
+            if not e.allowed_all and e.name not in e.allowed_cmds:
+                e.stdout = b""
+                e.stderr = (f"Permission denied: command \"{e.name}\" is not "
+                            f"in the white list. Please use --allow-command="
+                            f"{e.name} or --allow-command-all to config it."
+                            ).encode()
+                e.exit_code = 0xFFFFFFFF
+                e.reset_staging()
+                return -1 & MASK32
+            try:
+                # env is always the staged dict — an empty dict means an
+                # empty child environment, never host-environ inheritance
+                # (the reference builds envp solely from staged entries).
+                cp = subprocess.run(
+                    [e.name] + e.args, input=e.stdin, env=e.envs,
+                    capture_output=True, timeout=e.timeout_ms / 1000.0)
+                e.exit_code = cp.returncode & MASK32
+                e.stdout, e.stderr = cp.stdout, cp.stderr
+            except subprocess.TimeoutExpired as te:
+                e.exit_code = ProcessEnviron.TIMEOUT_CODE
+                e.stdout = te.stdout or b""
+                e.stderr = te.stderr or b""
+            except OSError as ex:
+                e.exit_code = 0xFFFFFFFF
+                e.stdout = b""
+                e.stderr = str(ex).encode()
+            e.reset_staging()
+            return e.exit_code
+
+        def get_exit_code(mem):
+            return e.exit_code
+
+        def get_stdout_len(mem):
+            return len(e.stdout)
+
+        def get_stdout(mem, ptr):
+            mem.store_bytes(ptr & MASK32, e.stdout)
+
+        def get_stderr_len(mem):
+            return len(e.stderr)
+
+        def get_stderr(mem, ptr):
+            mem.store_bytes(ptr & MASK32, e.stderr)
+
+        for name, params, results, fn in [
+            ("wasmedge_process_set_prog_name", ["i32", "i32"], [], set_prog_name),
+            ("wasmedge_process_add_arg", ["i32", "i32"], [], add_arg),
+            ("wasmedge_process_add_env", ["i32"] * 4, [], add_env),
+            ("wasmedge_process_add_stdin", ["i32", "i32"], [], add_stdin),
+            ("wasmedge_process_set_timeout", ["i32"], [], set_timeout),
+            ("wasmedge_process_run", [], ["i32"], run),
+            ("wasmedge_process_get_exit_code", [], ["i32"], get_exit_code),
+            ("wasmedge_process_get_stdout_len", [], ["i32"], get_stdout_len),
+            ("wasmedge_process_get_stdout", ["i32"], [], get_stdout),
+            ("wasmedge_process_get_stderr_len", [], ["i32"], get_stderr_len),
+            ("wasmedge_process_get_stderr", ["i32"], [], get_stderr),
+        ]:
+            self.add_func(name, _ProcFn(name, params, results, fn))
+
+
+__all__ = ["WasmEdgeProcessModule", "ProcessEnviron"]
