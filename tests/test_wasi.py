@@ -267,6 +267,77 @@ def test_process_env_not_inherited(monkeypatch):
     assert b"LEAKY_SECRET" not in mem.load_bytes(100, n)
 
 
+def test_invalid_utf8_path_is_ilseq(wasi_tmp):
+    wasi, _ = wasi_tmp
+    mem = make_mem()
+    mem.store_bytes(1024, b"\xff\xfe")
+    assert call(wasi, "path_create_directory", mem, 3, 1024, 2) == Errno.ILSEQ
+
+
+def test_readdir_huge_cookie_no_crash(wasi_tmp):
+    wasi, _ = wasi_tmp
+    mem = make_mem()
+    err, fd = _open(wasi, mem, 3, ".", Oflags.DIRECTORY)
+    assert err == Errno.SUCCESS
+    # cookie 2^64-2 arrives as a signed -2 through marshaling; must not
+    # index backwards or crash — just reports an empty tail
+    assert call(wasi, "fd_readdir", mem, fd, 0, 512,
+                0xFFFFFFFFFFFFFFFE, 600) == Errno.SUCCESS
+    assert mem.load(600, 4, False) == 0
+
+
+def test_poll_bad_fd_reports_badf():
+    wasi = WasiModule()
+    mem = make_mem()
+    # one FD_READ subscription on a closed fd, no clock
+    mem.store(0, 8, 0xABCD)       # userdata
+    mem.store(8, 1, 1)            # tag FD_READ
+    mem.store(16, 4, 99)          # bad fd
+    assert call(wasi, "poll_oneoff", mem, 0, 128, 1, 256) == Errno.SUCCESS
+    assert mem.load(256, 4, False) == 1
+    assert mem.load(128, 8, False) == 0xABCD
+    assert mem.load(136, 2, False) == Errno.BADF
+
+
+def test_aot_section_does_not_bypass_structural_validation():
+    from wasmedge_tpu import aot
+    from wasmedge_tpu.common.errors import ValidationError, WasmError
+    from wasmedge_tpu.loader.loader import Loader
+    from wasmedge_tpu.validator.validator import Validator
+
+    # module exporting a func index that doesn't exist
+    b = ModuleBuilder()
+    b.add_function([], ["i32"], [], [("i32.const", 1)])
+    b.exports.append(b._name("ghost") + b"\x00" + bytes([9]))
+    bad = b.build()
+    # craft a "valid-looking" aot section over the bad module bytes
+    good_img = aot.serialize_image(
+        Validator().validate(Loader().parse_module(
+            ModuleBuilder().build() if False else _hello_or_simple())).lowered)
+    import hashlib as _h
+    import struct as _s
+
+    body = _s.pack("<I", aot.AOT_VERSION) + _h.sha256(bad).digest() + good_img
+    name = aot.SECTION_NAME.encode()
+    content = bytes([len(name)]) + name + body
+    art = bad + b"\x00" + _uleb_len(len(content)) + content
+    mod = Loader().parse_module(art)
+    with pytest.raises((ValidationError, WasmError)):
+        Validator().validate(mod)
+
+
+def _hello_or_simple():
+    b = ModuleBuilder()
+    b.add_function([], ["i32"], [], [("i32.const", 1)], export="one")
+    return b.build()
+
+
+def _uleb_len(v):
+    from wasmedge_tpu.utils.builder import uleb
+
+    return uleb(v)
+
+
 def test_proc_exit():
     wasi = WasiModule()
     mem = make_mem()

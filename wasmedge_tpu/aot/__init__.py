@@ -1,0 +1,155 @@
+"""AOT analog: precompiled lowered-image artifacts ("universal twasm").
+
+The reference AOT path (/root/reference/lib/aot/compiler.cpp) compiles
+wasm to native code and appends it as a custom AOT section to the original
+binary ("universal wasm", compiler.cpp:4270), with a content-addressed
+cache (lib/aot/cache.cpp:36-61) and graceful fallback to the interpreter
+when the section doesn't match (lib/loader/ast/module.cpp:279-326).
+
+Our engines execute the validator's dense SoA image, so the TPU-native
+"compiled artifact" is that image, serialized. compile_module() appends it
+as a `tpu.aot` custom section over the original bytes; attach_precompiled()
+verifies version + content hash and short-circuits validation on load,
+falling back silently on any mismatch. XLA specialization of hot functions
+builds on top of this image (wasmedge_tpu/aot/xla_compile.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from wasmedge_tpu.validator.image import FuncMeta, LoweredModule
+
+SECTION_NAME = "tpu.aot"
+AOT_VERSION = 1  # reference analog: AOT::kBinaryVersion
+
+
+def _uleb(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | 0x80 if v else b)
+        if not v:
+            return bytes(out)
+
+
+def serialize_image(img: LoweredModule) -> bytes:
+    """LoweredModule -> bytes (json func metadata + npz code planes)."""
+    arrays = img.arrays
+    meta = {
+        "version": AOT_VERSION,
+        "funcs": [
+            {
+                "type_idx": f.type_idx, "nparams": f.nparams,
+                "nresults": f.nresults, "nlocals": f.nlocals,
+                "entry_pc": f.entry_pc, "end_pc": f.end_pc,
+                "max_height": f.max_height,
+                "local_types": [int(t) for t in f.local_types],
+                "is_import": f.is_import,
+                "import_module": f.import_module,
+                "import_name": f.import_name,
+            }
+            for f in img.funcs
+        ],
+    }
+    mjson = json.dumps(meta).encode()
+    bio = io.BytesIO()
+    np.savez(bio, **arrays)
+    blob = bio.getvalue()
+    return struct.pack("<II", len(mjson), len(blob)) + mjson + blob
+
+
+def deserialize_image(data: bytes) -> LoweredModule:
+    mlen, blen = struct.unpack_from("<II", data, 0)
+    meta = json.loads(data[8 : 8 + mlen].decode())
+    if meta["version"] != AOT_VERSION:
+        raise ValueError("aot image version mismatch")
+    bio = io.BytesIO(data[8 + mlen : 8 + mlen + blen])
+    arrays = dict(np.load(bio))
+    img = LoweredModule()
+    img.op = arrays["op"].tolist()
+    img.a = arrays["a"].tolist()
+    img.b = arrays["b"].tolist()
+    img.c = arrays["c"].tolist()
+    img.imm = [int(v) for v in arrays["imm"].astype(np.uint64)]
+    img.br_table = arrays["br_table"].reshape(-1).tolist()
+    for f in meta["funcs"]:
+        img.funcs.append(FuncMeta(
+            type_idx=f["type_idx"], nparams=f["nparams"],
+            nresults=f["nresults"], nlocals=f["nlocals"],
+            entry_pc=f["entry_pc"], end_pc=f["end_pc"],
+            max_height=f["max_height"],
+            local_types=tuple(f["local_types"]),
+            is_import=f["is_import"], import_module=f["import_module"],
+            import_name=f["import_name"]))
+    img.finalize()
+    return img
+
+
+def compile_module(wasm_bytes: bytes, conf=None) -> bytes:
+    """wasm -> universal twasm: original bytes + tpu.aot custom section
+    (reference: outputWasmLibrary, compiler.cpp:4270)."""
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.loader.loader import Loader
+    from wasmedge_tpu.validator.validator import Validator
+
+    conf = conf or Configure()
+    mod = Validator(conf).validate(Loader(conf).parse_module(wasm_bytes))
+    payload = serialize_image(mod.lowered)
+    digest = hashlib.sha256(wasm_bytes).digest()
+    body = struct.pack("<I", AOT_VERSION) + digest + payload
+    name = SECTION_NAME.encode()
+    content = _uleb(len(name)) + name + body
+    section = b"\x00" + _uleb(len(content)) + content
+    return wasm_bytes + section
+
+
+def extract_precompiled(wasm_bytes: bytes, custom_sections) -> Optional[bytes]:
+    """Return the serialized image iff a tpu.aot section matches the hash
+    of the bytes that precede it; None -> interpreter path (the reference's
+    fallback seam, module.cpp:279-326)."""
+    for name, data, start in custom_sections:
+        if name != SECTION_NAME or len(data) < 36:
+            continue
+        (version,) = struct.unpack_from("<I", data, 0)
+        if version != AOT_VERSION:
+            continue
+        digest = data[4:36]
+        if hashlib.sha256(wasm_bytes[:start]).digest() != digest:
+            continue
+        return data[36:]
+    return None
+
+
+# -- content-addressed cache (reference: lib/aot/cache.cpp:36-61) -----------
+def cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "wasmedge_tpu")
+
+
+def cache_path(wasm_bytes: bytes) -> str:
+    return os.path.join(cache_dir(), hashlib.sha256(wasm_bytes).hexdigest()
+                        + ".twasm")
+
+
+def compile_cached(wasm_bytes: bytes, conf=None) -> bytes:
+    path = cache_path(wasm_bytes)
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return f.read()
+    out = compile_module(wasm_bytes, conf)
+    os.makedirs(cache_dir(), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(out)
+    os.replace(tmp, path)
+    return out

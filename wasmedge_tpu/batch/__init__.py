@@ -25,6 +25,19 @@ from wasmedge_tpu.batch.image import DeviceImage, batchability
 from wasmedge_tpu.batch.uniform import UniformBatchEngine
 
 
+def ensure_jax_backend():
+    """Initialize the JAX backend, falling back to CPU when the configured
+    platform (e.g. a TPU plugin named by JAX_PLATFORMS) is unavailable in
+    this process — keeps the CLI/batch path usable off-accelerator."""
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+
+
 def make_engine(inst, store=None, conf=None, lanes=None, mesh=None):
     """Engine-selection seam: uniform fast path (with SIMT fallback) when
     Configure.batch.uniform is set, plain SIMT otherwise."""

@@ -744,6 +744,9 @@ class BatchEngine:
 
     # -- execution ---------------------------------------------------------
     def _build(self):
+        from wasmedge_tpu.batch import ensure_jax_backend
+
+        ensure_jax_backend()
         import jax
         import jax.numpy as jnp
         from jax import lax

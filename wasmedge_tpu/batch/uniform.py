@@ -750,6 +750,9 @@ class UniformBatchEngine:
         self._uchunk = None
 
     def _build_uniform(self):
+        from wasmedge_tpu.batch import ensure_jax_backend
+
+        ensure_jax_backend()
         import jax
         import jax.numpy as jnp
         from jax import lax

@@ -1,0 +1,274 @@
+"""CLI: the `wasmedge` / `wasmedgec` tool analogs.
+
+Mirrors /root/reference/tools/wasmedge/wasmedger.cpp:22-360 (runner:
+command mode runs _start with WASI exit code; reactor mode calls an
+exported function with typed argv) and wasmedgec.cpp:20-200 (compiler:
+load -> validate -> emit universal artifact). TPU additions: `--batch N`
+runs the export over N SIMT device lanes, `--engine` picks the execution
+engine.
+
+Usage:
+  python -m wasmedge_tpu.cli run [options] app.wasm [args...]
+  python -m wasmedge_tpu.cli compile [options] in.wasm out.twasm
+  python -m wasmedge_tpu.cli app.wasm [args...]        # implicit run
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from wasmedge_tpu.common.configure import (
+    Configure,
+    EngineKind,
+    HostRegistration,
+    Proposal,
+)
+from wasmedge_tpu.common.errors import WasmError
+from wasmedge_tpu.common.types import ValType
+from wasmedge_tpu.host.wasi.environ import WasiExit
+from wasmedge_tpu.utils.po import ArgumentParser, ListOpt, Option, Toggle
+
+
+def _runner_parser() -> ArgumentParser:
+    p = ArgumentParser("wasmedge-tpu run",
+                       "run a WebAssembly file (command or reactor mode)")
+    p.add_option("reactor", Toggle("enable reactor mode: call an exported fn "
+                                   "with typed argv"))
+    p.add_option("dir", ListOpt("bind guest:host directory (preopen)",
+                                "guest_path:host_path"))
+    p.add_option("env", ListOpt("environment variable NAME=VALUE", "env"))
+    p.add_option(["enable-instruction-count"],
+                 Toggle("enable instruction counting statistics"))
+    p.add_option(["enable-gas-measuring"], Toggle("enable gas metering"))
+    p.add_option(["enable-time-measuring"], Toggle("enable time measuring"))
+    p.add_option(["enable-all-statistics"], Toggle("enable all statistics"))
+    p.add_option(["gas-limit"], Option("gas limit (cost units)", "n", typ=int))
+    p.add_option(["memory-page-limit"],
+                 Option("page limit of linear memory", "n", typ=int))
+    p.add_option(["time-limit"],
+                 Option("time limit in milliseconds (async+cancel)", "ms",
+                        typ=int))
+    p.add_option(["allow-command"],
+                 ListOpt("allow a command for wasmedge_process", "cmd"))
+    p.add_option(["allow-command-all"],
+                 Toggle("allow all commands for wasmedge_process"))
+    p.add_option(["disable-bulk-memory"], Toggle("disable bulk-memory ops"))
+    p.add_option(["disable-reference-types"], Toggle("disable ref types"))
+    p.add_option(["disable-simd"], Toggle("disable 128-bit SIMD"))
+    p.add_option(["disable-sign-extension"], Toggle("disable sign-ext ops"))
+    p.add_option(["enable-tail-call"], Toggle("enable tail-call proposal"))
+    p.add_option(["enable-multi-memory"], Toggle("enable multi memories"))
+    p.add_option(["batch"],
+                 Option("run over N SIMT device lanes (tpu_batch engine)",
+                        "lanes", typ=int))
+    p.add_option(["engine"],
+                 Option("execution engine: scalar|native|tpu_batch|auto",
+                        "kind", default="auto"))
+    p.add_positional("wasm_file", "WebAssembly file to run")
+    return p
+
+
+def _build_conf(p: ArgumentParser) -> Configure:
+    conf = Configure()
+    conf.host_registrations.add(HostRegistration.Wasi)
+    if p._opts["allow-command"].value or p._opts["allow-command-all"].value:
+        conf.host_registrations.add(HostRegistration.WasmEdgeProcess)
+    if p._opts["disable-bulk-memory"].value:
+        conf.remove_proposal(Proposal.BulkMemoryOperations)
+    if p._opts["disable-reference-types"].value:
+        conf.remove_proposal(Proposal.ReferenceTypes)
+    if p._opts["disable-simd"].value:
+        conf.remove_proposal(Proposal.SIMD)
+    if p._opts["disable-sign-extension"].value:
+        conf.remove_proposal(Proposal.SignExtensionOperators)
+    if p._opts["enable-tail-call"].value:
+        conf.add_proposal(Proposal.TailCall)
+    if p._opts["enable-multi-memory"].value:
+        conf.add_proposal(Proposal.MultiMemories)
+    st = conf.statistics
+    if p._opts["enable-all-statistics"].value:
+        st.instr_counting = st.cost_measuring = st.time_measuring = True
+    if p._opts["enable-instruction-count"].value:
+        st.instr_counting = True
+    if p._opts["enable-gas-measuring"].value:
+        st.cost_measuring = True
+    if p._opts["enable-time-measuring"].value:
+        st.time_measuring = True
+    if p._opts["gas-limit"].seen:
+        st.cost_measuring = True
+        st.cost_limit = p._opts["gas-limit"].value
+    if p._opts["memory-page-limit"].seen:
+        conf.runtime.max_memory_pages = p._opts["memory-page-limit"].value
+    try:
+        conf.engine = EngineKind(p._opts["engine"].value)
+    except ValueError:
+        raise ValueError(
+            f"invalid --engine {p._opts['engine'].value!r} "
+            f"(choose from {[e.value for e in EngineKind]})")
+    return conf
+
+
+def _parse_typed_args(functype, raw: List[str]) -> list:
+    out = []
+    for t, s in zip(functype.params, raw):
+        if t in (ValType.I32, ValType.I64):
+            out.append(int(s, 0))
+        elif t in (ValType.F32, ValType.F64):
+            out.append(float(s))
+        else:
+            out.append(int(s, 0))
+    return out
+
+
+def run_command(argv: List[str], out=None, err=None) -> int:
+    out = out or sys.stdout
+    err = err or sys.stderr
+    p = _runner_parser()
+    try:
+        if not p.parse(argv, out):
+            return 0
+        conf = _build_conf(p)
+    except ValueError as e:
+        err.write(f"wasmedge-tpu: {e}\n")
+        return 2
+    path = p.positional_values[0]
+    prog_args = p.rest
+
+    from wasmedge_tpu.vm import VM
+
+    vm = VM(conf)
+    if vm.wasi_module is not None:
+        vm.wasi_module.init_wasi(dirs=p._opts["dir"].value, prog_name=path,
+                                 args=prog_args, envs=p._opts["env"].value)
+    proc = vm.get_import_module(HostRegistration.WasmEdgeProcess)
+    if proc is not None:
+        proc.env.allowed_cmds = set(p._opts["allow-command"].value)
+        proc.env.allowed_all = p._opts["allow-command-all"].value
+
+    reactor = p._opts["reactor"].value
+    batch_lanes = p._opts["batch"].value
+    time_limit_ms = p._opts["time-limit"].value
+
+    try:
+        vm.load_wasm(path)
+        vm.validate()
+        vm.instantiate()
+    except WasmError as e:
+        err.write(f"wasmedge-tpu: load failed: {e}\n")
+        return 1
+
+    def invoke(fn_name: str, args: list) -> Optional[list]:
+        if time_limit_ms is not None:
+            h = vm.async_execute(fn_name, args)
+            if not h.wait_for(time_limit_ms / 1000.0):
+                h.cancel()
+            return h.get()
+        return vm.execute(fn_name, args)
+
+    try:
+        if reactor:
+            # reactor mode (wasmedger.cpp:239-359): _initialize then func
+            if not prog_args:
+                err.write("wasmedge-tpu: reactor mode needs a function name\n")
+                return 2
+            fn_name, fn_args = prog_args[0], prog_args[1:]
+            if vm.active_module.find_func("_initialize") is not None:
+                vm.execute("_initialize")
+            fi = vm.active_module.find_func(fn_name)
+            if fi is None:
+                err.write(f"wasmedge-tpu: function {fn_name!r} not found\n")
+                return 1
+            if batch_lanes:
+                import numpy as np
+
+                res = vm.execute_batch(
+                    fn_name,
+                    [np.full(batch_lanes, int(a, 0), np.int64)
+                     for a in fn_args], lanes=batch_lanes)
+                out.write(f"{[int(r[0]) for r in res.results]}"
+                          f" ({int(res.completed.sum())}/{batch_lanes} lanes"
+                          f" completed, {int(res.retired.sum())} instrs)\n")
+            else:
+                rets = invoke(fn_name, _parse_typed_args(fi.functype, fn_args))
+                out.write(f"{rets}\n" if rets else "[]\n")
+        else:
+            # command mode: run _start, exit code from WASI
+            invoke("_start", [])
+        code = vm.wasi_module.exit_code if vm.wasi_module else 0
+    except WasiExit as e:
+        code = e.code
+    except WasmError as e:
+        err.write(f"wasmedge-tpu: {e}\n")
+        return 1
+    finally:
+        stat = vm.statistics()
+        if stat.instr_counting or stat.cost_measuring or stat.time_measuring:
+            err.write(f"statistics: {stat.dump()}\n")
+    return code
+
+
+def compile_command(argv: List[str], out=None, err=None) -> int:
+    out = out or sys.stdout
+    err = err or sys.stderr
+    p = ArgumentParser("wasmedge-tpu compile",
+                       "precompile wasm to a universal twasm artifact")
+    p.add_option("dump", Toggle("dump the lowered image disassembly"))
+    p.add_option(["no-cache"], Toggle("bypass the content-addressed cache"))
+    p.add_positional("in_wasm", "input wasm file")
+    p.add_positional("out_wasm", "output artifact", required=False)
+    try:
+        if not p.parse(argv, out):
+            return 0
+    except ValueError as e:
+        err.write(f"wasmedge-tpu: {e}\n")
+        return 2
+
+    from wasmedge_tpu import aot
+
+    with open(p.positional_values[0], "rb") as f:
+        data = f.read()
+    try:
+        artifact = (aot.compile_module(data) if p._opts["no-cache"].value
+                    else aot.compile_cached(data))
+    except WasmError as e:
+        err.write(f"wasmedge-tpu: compile failed: {e}\n")
+        return 1
+    if p._opts["dump"].value:
+        from wasmedge_tpu.loader.loader import Loader
+        from wasmedge_tpu.validator.validator import Validator
+
+        mod = Validator().validate(Loader().parse_module(artifact))
+        out.write(mod.lowered.disasm() + "\n")
+    if len(p.positional_values) > 1:
+        with open(p.positional_values[1], "wb") as f:
+            f.write(artifact)
+        out.write(f"written: {p.positional_values[1]} "
+                  f"({len(artifact)} bytes)\n")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        sys.stdout.write(
+            "usage: wasmedge-tpu [run|compile|version] ...\n"
+            "  run      run a wasm file (default when first arg is a file)\n"
+            "  compile  precompile to a universal twasm artifact\n"
+            "  version  print version\n")
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "run":
+        return run_command(rest)
+    if cmd == "compile":
+        return compile_command(rest)
+    if cmd == "version":
+        import wasmedge_tpu
+
+        sys.stdout.write(f"wasmedge-tpu {wasmedge_tpu.__version__}\n")
+        return 0
+    return run_command(argv)  # implicit run: wasmedge-tpu app.wasm ...
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,7 +71,10 @@ def _read_iovs(mem, iovs_ptr: int, iovs_len: int) -> List[Tuple[int, int]]:
 
 def _load_str(mem, ptr: int, ln: int) -> str:
     raw = mem.load_bytes(ptr & MASK32, ln & MASK32)
-    return raw.decode("utf-8", errors="strict")
+    try:
+        return raw.decode("utf-8", errors="strict")
+    except UnicodeDecodeError:
+        raise WasiError(Errno.ILSEQ)  # non-UTF-8 guest path
 
 
 # ---------------------------------------------------------------------------
@@ -226,14 +229,15 @@ def fd_fdstat_set_flags(env: WasiEnviron, mem, fd, flags):
                  | Fdflags.RSYNC | Fdflags.SYNC):
         return Errno.INVAL
     e.fdflags = flags
-    if e.kind == "file":
-        try:
-            cur = os.get_blocking(e.os_fd)
-            want_blocking = not (flags & Fdflags.NONBLOCK)
-            if cur != want_blocking:
+    want_blocking = not (flags & Fdflags.NONBLOCK)
+    try:
+        if e.kind == "socket":
+            e.sock.setblocking(want_blocking)
+        elif e.kind == "file":
+            if os.get_blocking(e.os_fd) != want_blocking:
                 os.set_blocking(e.os_fd, want_blocking)
-        except OSError as ex:
-            return from_oserror(ex)
+    except OSError as ex:
+        return from_oserror(ex)
     return Errno.SUCCESS
 
 
@@ -413,8 +417,9 @@ def fd_readdir(env: WasiEnviron, mem, fd, buf, buf_len, cookie, bufused_ptr):
         return from_oserror(ex)
     buf &= MASK32
     buf_len &= MASK32
+    cookie &= (1 << 64) - 1  # marshaled signed; dirent cookies are u64
     used = 0
-    for idx in range(cookie, len(names)):
+    for idx in range(min(cookie, len(names)), len(names)):
         name = names[idx]
         raw = name.encode()
         full = os.path.join(e.host_path, name)
@@ -738,17 +743,27 @@ def poll_oneoff(env: WasiEnviron, mem, in_ptr, out_ptr, nsubs, nevents_ptr):
 
     rlist, wlist = [], []
     fd_map = {}
+    immediate = []  # events for invalid fds, delivered without waiting
     for s in subs:
         if s[0] != "fd":
             continue
         _, userdata, tag, fd = s
         try:
             e = env.get_fd(fd, Rights.POLL_FD_READWRITE)
-        except WasiError:
+        except WasiError as werr:
+            immediate.append(abi.pack_event(userdata, werr.errno, tag))
             continue
         osfd = e.sock.fileno() if e.sock is not None else e.os_fd
         fd_map[osfd] = (userdata, tag, e)
         (rlist if tag == abi.Eventtype.FD_READ else wlist).append(osfd)
+
+    if immediate:
+        # A bad subscription resolves the poll immediately (spec: event
+        # carries the errno; do not sleep on the other subscriptions).
+        for i, ev in enumerate(immediate):
+            mem.store_bytes(out_ptr + i * abi.EVENT_SIZE, ev)
+        mem.store(nevents_ptr & MASK32, 4, len(immediate))
+        return Errno.SUCCESS
 
     timeout_s = None if deadline is None else deadline / 1e9
     if rlist or wlist:
@@ -943,8 +958,12 @@ def sock_recv_from(env: WasiEnviron, mem, fd, ri_data, ri_data_len,
     except OSError as ex:
         return from_oserror(ex)
     if addr is not None:
-        fam = socket.AF_INET6 if ":" in addr[0] else socket.AF_INET
-        _write_wasi_address(mem, address_ptr, socket.inet_pton(fam, addr[0]))
+        try:
+            host = addr[0].split("%", 1)[0]  # strip ipv6 zone id
+            fam = socket.AF_INET6 if ":" in host else socket.AF_INET
+            _write_wasi_address(mem, address_ptr, socket.inet_pton(fam, host))
+        except OSError:
+            pass  # unparseable peer address: deliver data without it
     mem.store(ro_datalen_ptr & MASK32, 4, total)
     mem.store(ro_flags_ptr & MASK32, 2, 0)
     return Errno.SUCCESS

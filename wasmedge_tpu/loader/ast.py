@@ -145,6 +145,8 @@ class CodeSegment:
 class CustomSection:
     name: str
     data: bytes
+    start: int = -1  # byte offset of the section header in the binary
+    #                  (lets the AOT layer hash the bytes that precede it)
 
 
 @dataclasses.dataclass
@@ -164,6 +166,7 @@ class Module:
     customs: List[CustomSection] = dataclasses.field(default_factory=list)
     validated: bool = False
     lowered: object = None  # LoweredModule attached by the validator
+    source_bytes: bytes = b""  # original binary (AOT-section hash check)
 
     # -- import accessors (reference: include/ast/module.h import counting) --
     # Imports are immutable after loading, so the kind-filtered views are

@@ -45,6 +45,7 @@ class Loader:
         last_order = -1
         code_count_seen = 0
         while not fm.at_end():
+            sec_start = fm.pos
             sec_id = fm.read_byte()
             sec_size = fm.read_u32()
             if sec_size > fm.remaining():
@@ -53,7 +54,8 @@ class Loader:
             sub = FileMgr(fm.data, fm.pos, sec_end)
             if sec_id == 0:
                 name = sub.read_name()
-                mod.customs.append(ast.CustomSection(name, sub.data[sub.pos : sec_end]))
+                mod.customs.append(ast.CustomSection(
+                    name, sub.data[sub.pos : sec_end], start=sec_start))
             else:
                 if sec_id not in _SECTION_ORDER:
                     raise LoadError(ErrCode.MalformedSection, offset=fm.pos)
@@ -71,6 +73,7 @@ class Loader:
             raise LoadError(ErrCode.IncompatibleFuncCode, offset=fm.pos)
         if mod.data_count is not None and mod.data_count != len(mod.datas):
             raise LoadError(ErrCode.IncompatibleDataCount, offset=fm.pos)
+        mod.source_bytes = data
         return mod
 
     def parse_file(self, path: str) -> ast.Module:

@@ -1,0 +1,175 @@
+"""PO: typed program-option parser.
+
+Mirrors the reference's include/po/ component (argument_parser.h:1-523,
+parser.h, option.h, list.h, subcommand.h): typed Option<T>, ListOpt,
+Toggle, positional arguments, nested SubCommands, and automatic help —
+value-oriented (parse() returns False after printing help, like the
+reference's HelpOption short-circuit).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Dict, List, Optional
+
+
+class Option:
+    """Single-valued typed option (reference: PO::Option<T>)."""
+
+    def __init__(self, desc: str = "", meta: str = "value",
+                 default=None, typ: Callable = str):
+        self.desc = desc
+        self.meta = meta
+        self.value = default
+        self.typ = typ
+        self.seen = False
+
+    def feed(self, raw: str):
+        self.value = self.typ(raw)
+        self.seen = True
+
+    @property
+    def takes_value(self) -> bool:
+        return True
+
+
+class ListOpt:
+    """Repeatable option accumulating values (reference: PO::List<T>)."""
+
+    def __init__(self, desc: str = "", meta: str = "value", typ: Callable = str):
+        self.desc = desc
+        self.meta = meta
+        self.value: List = []
+        self.typ = typ
+        self.seen = False
+
+    def feed(self, raw: str):
+        self.value.append(self.typ(raw))
+        self.seen = True
+
+    @property
+    def takes_value(self) -> bool:
+        return True
+
+
+class Toggle:
+    """Boolean flag (reference: PO::Option<PO::Toggle>)."""
+
+    def __init__(self, desc: str = ""):
+        self.desc = desc
+        self.value = False
+        self.seen = False
+
+    def feed(self, raw: Optional[str] = None):
+        self.value = True
+        self.seen = True
+
+    @property
+    def takes_value(self) -> bool:
+        return False
+
+
+class ArgumentParser:
+    """reference: PO::ArgumentParser (include/po/argument_parser.h)."""
+
+    def __init__(self, prog: str = "", desc: str = ""):
+        self.prog = prog
+        self.desc = desc
+        self._opts: Dict[str, object] = {}
+        self._order: List[tuple] = []  # (names, opt)
+        self._positionals: List[tuple] = []  # (name, desc, required)
+        self.positional_values: List[str] = []
+        self.rest: List[str] = []  # everything after the positionals
+        self._subcommands: Dict[str, "ArgumentParser"] = {}
+        self.selected_subcommand: Optional[str] = None
+
+    # -- construction ------------------------------------------------------
+    def add_option(self, names, opt) -> "ArgumentParser":
+        if isinstance(names, str):
+            names = [names]
+        for n in names:
+            self._opts[n] = opt
+        self._order.append((names, opt))
+        return self
+
+    def add_positional(self, name: str, desc: str = "",
+                       required: bool = True) -> "ArgumentParser":
+        self._positionals.append((name, desc, required))
+        return self
+
+    def sub_command(self, name: str, desc: str = "") -> "ArgumentParser":
+        sub = ArgumentParser(prog=f"{self.prog} {name}", desc=desc)
+        self._subcommands[name] = sub
+        return sub
+
+    # -- parsing -----------------------------------------------------------
+    def parse(self, argv: List[str], out=sys.stdout) -> bool:
+        """Returns False when help was requested (caller should exit 0);
+        raises ValueError on malformed input."""
+        i = 0
+        npos = 0
+        while i < len(argv):
+            arg = argv[i]
+            if npos == 0 and not self.positional_values \
+                    and arg in self._subcommands:
+                self.selected_subcommand = arg
+                return self._subcommands[arg].parse(argv[i + 1:], out)
+            if arg in ("-h", "--help"):
+                out.write(self.help_text())
+                return False
+            if arg.startswith("--") and len(arg) > 2:
+                name, eq, val = arg[2:].partition("=")
+                opt = self._opts.get(name)
+                if opt is None:
+                    raise ValueError(f"unknown option --{name}")
+                if opt.takes_value:
+                    if eq:
+                        opt.feed(val)
+                    else:
+                        i += 1
+                        if i >= len(argv):
+                            raise ValueError(f"--{name} needs a value")
+                        opt.feed(argv[i])
+                else:
+                    if eq:
+                        raise ValueError(f"--{name} takes no value")
+                    opt.feed()
+            else:
+                if npos < len(self._positionals):
+                    self.positional_values.append(arg)
+                    npos += 1
+                    if npos == len(self._positionals):
+                        # everything after the last positional is payload
+                        self.rest = list(argv[i + 1:])
+                        return True
+                else:
+                    self.rest.append(arg)
+            i += 1
+        missing = [n for (n, _, req) in self._positionals[npos:] if req]
+        if missing:
+            raise ValueError(f"missing required argument: {missing[0]}")
+        return True
+
+    # -- help --------------------------------------------------------------
+    def help_text(self) -> str:
+        lines = []
+        pos = " ".join(
+            (f"<{n}>" if req else f"[{n}]") for n, _, req in self._positionals)
+        sub = " | ".join(self._subcommands) if self._subcommands else ""
+        usage = f"usage: {self.prog or 'prog'}"
+        if sub:
+            usage += f" [{sub}]"
+        usage += f" [options] {pos}".rstrip()
+        lines.append(usage)
+        if self.desc:
+            lines.append(f"  {self.desc}")
+        if self._order:
+            lines.append("options:")
+            for names, opt in self._order:
+                flag = ", ".join(f"--{n}" for n in names)
+                if opt.takes_value:
+                    flag += f" <{opt.meta}>"
+                lines.append(f"  {flag:44s} {opt.desc}")
+        for name, subp in self._subcommands.items():
+            lines.append(f"subcommand {name}: {subp.desc}")
+        return "\n".join(lines) + "\n"

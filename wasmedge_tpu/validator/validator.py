@@ -131,6 +131,28 @@ class Validator:
             if ft.params or ft.results:
                 raise ValidationError(ErrCode.InvalidStartFunc)
 
+        # Precompiled fast path: a matching tpu.aot custom section carries
+        # the lowered image the body pass below would produce, so per-body
+        # type proving + lowering is skipped. Structural validation above
+        # always runs — like the reference, which validates the module even
+        # when an AOT section supplies the code (lib/loader/ast/
+        # module.cpp:275-327, graceful fallback on mismatch).
+        if mod.lowered is None and mod.customs and mod.source_bytes:
+            from wasmedge_tpu import aot
+
+            payload = aot.extract_precompiled(
+                mod.source_bytes,
+                [(c.name, c.data, c.start) for c in mod.customs])
+            if payload is not None:
+                try:
+                    img = aot.deserialize_image(payload)
+                    if len(img.funcs) == mod.total_funcs:
+                        mod.lowered = img
+                        mod.validated = True
+                        return mod
+                except Exception:
+                    pass  # fall through to full body validation
+
         # Function bodies -> lowered image.
         image = LoweredModule()
         for i, imf in enumerate(mod.imported_funcs()):
