@@ -1,0 +1,437 @@
+"""SIMD v128: loader/validator/scalar-engine coverage of the 0xFD page.
+
+Mirrors the reference's SIMD spec-test coverage (test/spec proposal dirs,
+engine.cpp v128 block). Values cross the API as 128-bit ints; lane math
+is recomputed independently here (struct/numpy) and compared bit-exactly.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.common.configure import Configure, Proposal
+from wasmedge_tpu.common.errors import (
+    ErrCode,
+    LoadError,
+    TrapError,
+    ValidationError,
+)
+from tests.helpers import load_validate, run_wasm, single_func
+from wasmedge_tpu.utils.builder import ModuleBuilder
+
+
+def vi(fmt, *vals):
+    """Pack lanes little-endian into a 128-bit int. fmt like '16b','8h',
+    '4i','2q','4f','2d'."""
+    n = int(fmt[:-1])
+    code = fmt[-1]
+    return int.from_bytes(struct.pack(f"<{n}{code}", *vals), "little")
+
+
+def lanes_of(v, fmt):
+    n = int(fmt[:-1])
+    code = fmt[-1]
+    return list(struct.unpack(f"<{n}{code}", int(v).to_bytes(16, "little")))
+
+
+def run1(body, result="v128", params=(), args=(), locals_=()):
+    data = single_func(list(params), [result], list(locals_), list(body))
+    return run_wasm(data, "f", list(args))[0]
+
+
+# ---------------------------------------------------------------------------
+# const / splat / lanes
+# ---------------------------------------------------------------------------
+def test_v128_const_roundtrip():
+    k = vi("4i", 1, -2, 3, -4)
+    assert run1([("v128.const", k)]) == k
+
+
+def test_splats():
+    assert lanes_of(run1([("i32.const", 7), "i8x16.splat"]), "16b") == [7] * 16
+    assert lanes_of(run1([("i32.const", -300), "i16x8.splat"]), "8h") == [-300] * 8
+    assert lanes_of(run1([("i32.const", 123456), "i32x4.splat"]), "4i") == [123456] * 4
+    assert lanes_of(run1([("i64.const", 2**40), "i64x2.splat"]), "2q") == [2**40] * 2
+    assert lanes_of(run1([("f32.const", 1.5), "f32x4.splat"]), "4f") == [1.5] * 4
+    assert lanes_of(run1([("f64.const", -2.25), "f64x2.splat"]), "2d") == [-2.25] * 2
+
+
+def test_extract_replace():
+    k = vi("16b", *range(-8, 8))
+    assert run_wasm(single_func([], ["i32"], [], [
+        ("v128.const", k), ("i8x16.extract_lane_s", 0)]), "f")[0] == -8
+    assert run_wasm(single_func([], ["i32"], [], [
+        ("v128.const", k), ("i8x16.extract_lane_u", 0)]), "f")[0] == 0xF8
+    got = run1([("v128.const", k), ("i32.const", 99), ("i8x16.replace_lane", 3)])
+    exp = lanes_of(k, "16b")
+    exp[3] = 99
+    assert lanes_of(got, "16b") == exp
+    # i64x2 + f64x2
+    k2 = vi("2q", 10, -20)
+    assert run_wasm(single_func([], ["i64"], [], [
+        ("v128.const", k2), ("i64x2.extract_lane", 1)]), "f")[0] == -20
+    kf = vi("2d", 1.5, 2.5)
+    assert run_wasm(single_func([], ["f64"], [], [
+        ("v128.const", kf), ("f64x2.extract_lane", 1)]), "f")[0] == 2.5
+
+
+def test_shuffle_swizzle():
+    a = vi("16b", *range(16))
+    b = vi("16b", *range(16, 32))
+    got = run1([("v128.const", a), ("v128.const", b),
+                ("i8x16.shuffle", list(range(8)) + list(range(16, 24)))])
+    assert lanes_of(got, "16b") == list(range(8)) + list(range(16, 24))
+    # swizzle: out-of-range -> 0
+    idx = vi("16b", 0, 2, 4, 6, 8, 10, 12, 14, 16, 31, 1, 1, 1, 1, 1, 127 - 128)
+    got = run1([("v128.const", a), ("v128.const", idx), "i8x16.swizzle"])
+    assert lanes_of(got, "16b") == [0, 2, 4, 6, 8, 10, 12, 14, 0, 0, 1, 1, 1, 1, 1, 0]
+
+
+# ---------------------------------------------------------------------------
+# integer arithmetic
+# ---------------------------------------------------------------------------
+def test_int_add_sub_wrap():
+    a = vi("4i", 2**31 - 1, -5, 100, 0)
+    b = vi("4i", 1, 5, -100, 0)
+    assert lanes_of(run1([("v128.const", a), ("v128.const", b), "i32x4.add"]),
+                    "4i") == [-(2**31), 0, 0, 0]
+    assert lanes_of(run1([("v128.const", a), ("v128.const", b), "i32x4.sub"]),
+                    "4i") == [2**31 - 2, -10, 200, 0]
+
+
+def test_sat_arith():
+    a = vi("16b", 120, -120, 100, 0, *[0] * 12)
+    b = vi("16b", 20, -20, 100, 0, *[0] * 12)
+    assert lanes_of(run1([("v128.const", a), ("v128.const", b),
+                          "i8x16.add_sat_s"]), "16b")[:3] == [127, -128, 127]
+    au = vi("16b", -1, 10, 0, 0, *[0] * 12)  # 255 unsigned
+    bu = vi("16b", 1, -1, 0, 0, *[0] * 12)
+    assert lanes_of(run1([("v128.const", au), ("v128.const", bu),
+                          "i8x16.add_sat_u"]), "16b")[:2] == [-1, -1]  # 255 sat
+    # lane0: 1-255 saturates to 0; lane1: 255-10 = 245 (=-11 signed view)
+    assert lanes_of(run1([("v128.const", bu), ("v128.const", au),
+                          "i8x16.sub_sat_u"]), "16b")[:2] == [0, -11]
+
+
+def test_mul_min_max_avgr():
+    a = vi("8h", 1000, -1000, 7, 0, 1, 2, 3, 4)
+    b = vi("8h", 100, 100, -7, 0, 1, 2, 3, 4)
+    assert lanes_of(run1([("v128.const", a), ("v128.const", b), "i16x8.mul"]),
+                    "8h")[:3] == [-31072, 31072, -49]  # wrap mod 2^16
+    assert lanes_of(run1([("v128.const", a), ("v128.const", b), "i16x8.min_s"]),
+                    "8h")[:3] == [100, -1000, -7]
+    assert lanes_of(run1([("v128.const", a), ("v128.const", b), "i16x8.max_u"]),
+                    "8h")[:3] == [1000, -1000, -7]  # unsigned view
+    x = vi("16b", 1, 2, 3, 4, *[0] * 12)
+    y = vi("16b", 2, 3, 4, 5, *[0] * 12)
+    assert lanes_of(run1([("v128.const", x), ("v128.const", y),
+                          "i8x16.avgr_u"]), "16b")[:4] == [2, 3, 4, 5]
+
+
+def test_abs_neg_popcnt():
+    a = vi("4i", -5, 5, -(2**31), 0)
+    assert lanes_of(run1([("v128.const", a), "i32x4.abs"]), "4i") == \
+        [5, 5, -(2**31), 0]  # INT_MIN stays (wraps)
+    assert lanes_of(run1([("v128.const", a), "i32x4.neg"]), "4i") == \
+        [5, -5, -(2**31), 0]
+    p = vi("16b", 0, 1, 3, 7, 15, 31, 63, 127, -1, 0, 0, 0, 0, 0, 0, 0)
+    assert lanes_of(run1([("v128.const", p), "i8x16.popcnt"]), "16b")[:9] == \
+        [0, 1, 2, 3, 4, 5, 6, 7, 8]
+
+
+def test_shifts():
+    a = vi("4i", 1, -8, 2**30, 5)
+    assert lanes_of(run1([("v128.const", a), ("i32.const", 2), "i32x4.shl"]),
+                    "4i") == [4, -32, 0, 20]
+    assert lanes_of(run1([("v128.const", a), ("i32.const", 1), "i32x4.shr_s"]),
+                    "4i") == [0, -4, 2**29, 2]
+    assert lanes_of(run1([("v128.const", a), ("i32.const", 1), "i32x4.shr_u"]),
+                    "4i") == [0, 2**31 - 4, 2**29, 2]
+    # shift amount mod lane width (i8: 8)
+    assert lanes_of(run1([("v128.const", vi("16b", *[1] * 16)),
+                          ("i32.const", 9), "i8x16.shl"]), "16b") == [2] * 16
+
+
+def test_compares_and_reductions():
+    a = vi("4i", 1, 2, 3, 4)
+    b = vi("4i", 1, 5, 2, 4)
+    eq = run1([("v128.const", a), ("v128.const", b), "i32x4.eq"])
+    assert lanes_of(eq, "4i") == [-1, 0, 0, -1]
+    lt = run1([("v128.const", a), ("v128.const", b), "i32x4.lt_s"])
+    assert lanes_of(lt, "4i") == [0, -1, 0, 0]
+    r = run_wasm(single_func([], ["i32"], [], [
+        ("v128.const", a), "i32x4.all_true"]), "f")[0]
+    assert r == 1
+    r = run_wasm(single_func([], ["i32"], [], [
+        ("v128.const", vi("4i", 1, 0, 1, 1)), "i32x4.all_true"]), "f")[0]
+    assert r == 0
+    r = run_wasm(single_func([], ["i32"], [], [
+        ("v128.const", vi("4i", -1, 1, -3, 7)), "i32x4.bitmask"]), "f")[0]
+    assert r == 0b0101
+    r = run_wasm(single_func([], ["i32"], [], [
+        ("v128.const", 0), "v128.any_true"]), "f")[0]
+    assert r == 0
+
+
+def test_bitwise():
+    a = vi("2q", 0xF0F0, 0x1234)
+    b = vi("2q", 0x0FF0, 0xFFFF)
+    assert lanes_of(run1([("v128.const", a), ("v128.const", b), "v128.and"]),
+                    "2q") == [0x00F0, 0x1234]
+    assert lanes_of(run1([("v128.const", a), ("v128.const", b), "v128.andnot"]),
+                    "2q") == [0xF000, 0]
+    got = run1([("v128.const", a), ("v128.const", b), ("v128.const", vi("2q", -1, 0)),
+                "v128.bitselect"])
+    assert lanes_of(got, "2q") == [0xF0F0, 0xFFFF]
+    assert lanes_of(run1([("v128.const", a), "v128.not"]), "2q") == \
+        [~0xF0F0, ~0x1234]
+
+
+# ---------------------------------------------------------------------------
+# narrow / extend / extmul / pairwise / q15 / dot
+# ---------------------------------------------------------------------------
+def test_narrow():
+    a = vi("8h", 300, -300, 100, -100, 0, 127, -128, 1)
+    b = vi("8h", 1000, -1000, 5, 6, 7, 8, 9, 10)
+    s = run1([("v128.const", a), ("v128.const", b), "i8x16.narrow_i16x8_s"])
+    assert lanes_of(s, "16b") == [127, -128, 100, -100, 0, 127, -128, 1,
+                                  127, -128, 5, 6, 7, 8, 9, 10]
+    u = run1([("v128.const", a), ("v128.const", b), "i8x16.narrow_i16x8_u"])
+    assert lanes_of(u, "16b") == [-1, 0, 100, 0, 0, 127, 0, 1,
+                                  -1, 0, 5, 6, 7, 8, 9, 10]  # 255 = -1 signed view
+
+
+def test_extend_extmul():
+    a = vi("16b", *range(-8, 8))
+    lo = run1([("v128.const", a), "i16x8.extend_low_i8x16_s"])
+    assert lanes_of(lo, "8h") == list(range(-8, 0))
+    hi = run1([("v128.const", a), "i16x8.extend_high_i8x16_u"])
+    assert lanes_of(hi, "8h") == list(range(0, 8))
+    b = vi("16b", *[3] * 16)
+    m = run1([("v128.const", a), ("v128.const", b), "i16x8.extmul_low_i8x16_s"])
+    assert lanes_of(m, "8h") == [x * 3 for x in range(-8, 0)]
+
+
+def test_extadd_q15_dot():
+    a = vi("16b", *range(16))
+    got = run1([("v128.const", a), "i16x8.extadd_pairwise_i8x16_s"])
+    assert lanes_of(got, "8h") == [1, 5, 9, 13, 17, 21, 25, 29]
+    x = vi("8h", 16384, -16384, 32767, 100, 0, 0, 0, 0)
+    y = vi("8h", 16384, 16384, 32767, 200, 0, 0, 0, 0)
+    got = run1([("v128.const", x), ("v128.const", y), "i16x8.q15mulr_sat_s"])
+    assert lanes_of(got, "8h")[:4] == [8192, -8192, 32766, 1]
+    d = run1([("v128.const", vi("8h", 1, 2, 3, 4, 5, 6, 7, 8)),
+              ("v128.const", vi("8h", 10, 20, 30, 40, 50, 60, 70, 80)),
+              "i32x4.dot_i16x8_s"])
+    assert lanes_of(d, "4i") == [1 * 10 + 2 * 20, 3 * 30 + 4 * 40,
+                                 5 * 50 + 6 * 60, 7 * 70 + 8 * 80]
+
+
+# ---------------------------------------------------------------------------
+# floats
+# ---------------------------------------------------------------------------
+def test_float_arith_and_nan_canon():
+    a = vi("4f", 1.5, -2.0, float("inf"), 0.0)
+    b = vi("4f", 2.5, 4.0, float("-inf"), 0.0)
+    s = run1([("v128.const", a), ("v128.const", b), "f32x4.add"])
+    ls = lanes_of(s, "4f")
+    assert ls[0] == 4.0 and ls[1] == 2.0 and np.isnan(ls[2]) and ls[3] == 0.0
+    # inf + -inf -> canonical NaN bits
+    bits = (int(s) >> 64) & 0xFFFFFFFF
+    assert bits == 0x7FC00000
+
+
+def test_float_minmax_zero_signs():
+    nz = struct.unpack("<I", struct.pack("<f", -0.0))[0]
+    pz = 0
+    a = vi("4f", -0.0, 0.0, 1.0, 5.0)
+    b = vi("4f", 0.0, -0.0, 2.0, 3.0)
+    mn = lanes_of(run1([("v128.const", a), ("v128.const", b), "f32x4.min"]), "4f")
+    assert struct.pack("<f", mn[0]) == struct.pack("<f", -0.0)
+    mx = lanes_of(run1([("v128.const", a), ("v128.const", b), "f32x4.max"]), "4f")
+    assert struct.pack("<f", mx[0]) == struct.pack("<f", 0.0)
+    assert mn[2:] == [1.0, 3.0] and mx[2:] == [2.0, 5.0]
+    # pmin/pmax: b<a / a<b select, -0.0 == 0.0 so no swap
+    pm = lanes_of(run1([("v128.const", a), ("v128.const", b), "f32x4.pmin"]), "4f")
+    assert struct.pack("<f", pm[0]) == struct.pack("<f", -0.0)  # a kept
+
+
+def test_float_rounding_sqrt():
+    a = vi("4f", 1.5, 2.5, -1.5, 4.0)
+    assert lanes_of(run1([("v128.const", a), "f32x4.nearest"]), "4f") == \
+        [2.0, 2.0, -2.0, 4.0]
+    assert lanes_of(run1([("v128.const", a), "f32x4.floor"]), "4f") == \
+        [1.0, 2.0, -2.0, 4.0]
+    assert lanes_of(run1([("v128.const", vi("4f", 4.0, 9.0, 2.0, 0.0)),
+                          "f32x4.sqrt"]), "4f")[:2] == [2.0, 3.0]
+    d = vi("2d", 2.5, -2.5)
+    assert lanes_of(run1([("v128.const", d), "f64x2.nearest"]), "2d") == \
+        [2.0, -2.0]
+
+
+def test_float_compares():
+    a = vi("4f", 1.0, float("nan"), 3.0, 4.0)
+    b = vi("4f", 1.0, 1.0, 2.0, 5.0)
+    eq = lanes_of(run1([("v128.const", a), ("v128.const", b), "f32x4.eq"]), "4i")
+    assert eq == [-1, 0, 0, 0]
+    ne = lanes_of(run1([("v128.const", a), ("v128.const", b), "f32x4.ne"]), "4i")
+    assert ne == [0, -1, -1, -1]
+
+
+# ---------------------------------------------------------------------------
+# conversions
+# ---------------------------------------------------------------------------
+def test_trunc_sat_and_convert():
+    a = vi("4f", 1.9, -1.9, 3e9, float("nan"))
+    s = lanes_of(run1([("v128.const", a), "i32x4.trunc_sat_f32x4_s"]), "4i")
+    assert s == [1, -1, 2**31 - 1, 0]
+    u = lanes_of(run1([("v128.const", a), "i32x4.trunc_sat_f32x4_u"]), "4i")
+    assert u == [1, 0, 3000000000 - 2**32, 0]
+    c = lanes_of(run1([("v128.const", vi("4i", -1, 2, 3, 2**31 - 1)),
+                       "f32x4.convert_i32x4_s"]), "4f")
+    assert c[0] == -1.0 and c[1] == 2.0
+    cu = lanes_of(run1([("v128.const", vi("4i", -1, 0, 0, 0)),
+                        "f32x4.convert_i32x4_u"]), "4f")
+    assert cu[0] == np.float32(2**32 - 1)
+
+
+def test_demote_promote_zero():
+    d = vi("2d", 1.5, 2.5)
+    f = lanes_of(run1([("v128.const", d), "f32x4.demote_f64x2_zero"]), "4f")
+    assert f == [1.5, 2.5, 0.0, 0.0]
+    f32 = vi("4f", 1.5, -2.5, 99.0, 99.0)
+    p = lanes_of(run1([("v128.const", f32), "f64x2.promote_low_f32x4"]), "2d")
+    assert p == [1.5, -2.5]
+    z = lanes_of(run1([("v128.const", vi("2d", 1.9, -5e12)),
+                       "i32x4.trunc_sat_f64x2_s_zero"]), "4i")
+    assert z == [1, -(2**31), 0, 0]
+    cl = lanes_of(run1([("v128.const", vi("4i", -7, 8, 1, 1)),
+                        "f64x2.convert_low_i32x4_s"]), "2d")
+    assert cl == [-7.0, 8.0]
+
+
+# ---------------------------------------------------------------------------
+# memory
+# ---------------------------------------------------------------------------
+def _mem_mod(body, result="v128", data=None):
+    b = ModuleBuilder()
+    b.add_memory(1, 1)
+    if data:
+        b.add_active_data(0, [("i32.const", 0)], data)
+    b.add_function([], [result], [], body, export="f")
+    return b.build()
+
+
+def test_v128_load_store():
+    data = bytes(range(16))
+    got = run_wasm(_mem_mod([("i32.const", 0), ("v128.load", 0, 0)],
+                            data=data), "f")[0]
+    assert got == int.from_bytes(data, "little")
+    # store then load back at offset 32
+    got = run_wasm(_mem_mod([
+        ("i32.const", 32), ("v128.const", vi("4i", 1, 2, 3, 4)),
+        ("v128.store", 0, 0),
+        ("i32.const", 32), ("v128.load", 0, 0)]), "f")[0]
+    assert lanes_of(got, "4i") == [1, 2, 3, 4]
+
+
+def test_v128_ext_splat_zero_loads():
+    data = struct.pack("<8b", -1, 2, -3, 4, -5, 6, -7, 8)
+    got = run_wasm(_mem_mod([("i32.const", 0), ("v128.load8x8_s", 0, 0)],
+                            data=data), "f")[0]
+    assert lanes_of(got, "8h") == [-1, 2, -3, 4, -5, 6, -7, 8]
+    got = run_wasm(_mem_mod([("i32.const", 0), ("v128.load8x8_u", 0, 0)],
+                            data=data), "f")[0]
+    assert lanes_of(got, "8h") == [255, 2, 253, 4, 251, 6, 249, 8]
+    got = run_wasm(_mem_mod([("i32.const", 0), ("v128.load32_splat", 0, 0)],
+                            data=b"\x01\x02\x03\x04"), "f")[0]
+    assert lanes_of(got, "4i") == [0x04030201] * 4
+    got = run_wasm(_mem_mod([("i32.const", 0), ("v128.load64_zero", 0, 0)],
+                            data=b"\xff" * 8), "f")[0]
+    assert lanes_of(got, "2q") == [-1, 0]
+
+
+def test_v128_lane_memory():
+    got = run_wasm(_mem_mod([
+        ("i32.const", 0),
+        ("v128.const", vi("4i", 9, 9, 9, 9)),
+        ("v128.load32_lane", 0, 0, 2)], data=b"\x2a\x00\x00\x00"), "f")[0]
+    assert lanes_of(got, "4i") == [9, 9, 42, 9]
+    got = run_wasm(_mem_mod([
+        ("i32.const", 8),
+        ("v128.const", vi("2q", 0x1122334455667788, -1)),
+        ("v128.store64_lane", 0, 0, 0),
+        ("i32.const", 0), ("v128.load", 0, 0)]), "f")[0]
+    assert lanes_of(got, "2q")[1] == 0x1122334455667788
+
+
+def test_v128_load_oob_traps():
+    with pytest.raises(TrapError) as e:
+        run_wasm(_mem_mod([("i32.const", 65535), ("v128.load", 0, 0)]), "f")
+    assert e.value.code == ErrCode.MemoryOutOfBounds
+
+
+# ---------------------------------------------------------------------------
+# validation / gating
+# ---------------------------------------------------------------------------
+def test_bad_lane_index_rejected():
+    data = single_func([], ["i32"], [], [
+        ("v128.const", 0), ("i8x16.extract_lane_s", 16)])
+    with pytest.raises(ValidationError) as e:
+        load_validate(data)
+    assert e.value.code == ErrCode.InvalidLaneIdx
+
+
+def test_bad_shuffle_mask_rejected():
+    data = single_func([], ["v128"], [], [
+        ("v128.const", 0), ("v128.const", 0), ("i8x16.shuffle", [32] + [0] * 15)])
+    with pytest.raises(ValidationError):
+        load_validate(data)
+
+
+def test_simd_alignment_over_natural_rejected():
+    data = _mem_mod([("i32.const", 0), ("v128.load", 5, 0)])
+    with pytest.raises(ValidationError) as e:
+        load_validate(data)
+    assert e.value.code == ErrCode.InvalidAlignment
+
+
+def test_simd_disabled_proposal():
+    conf = Configure()
+    conf.remove_proposal(Proposal.SIMD)
+    # v128 in a signature is refused as a malformed type under the gate
+    data = single_func([], ["v128"], [], [("v128.const", 1)])
+    with pytest.raises(LoadError) as e:
+        load_validate(data, conf)
+    assert e.value.code == ErrCode.MalformedValType
+    # and 0xFD-page opcodes are refused at decode
+    data = single_func([], ["i32"], [], [
+        ("v128.const", 1), ("i32x4.extract_lane", 0)])
+    with pytest.raises(LoadError) as e:
+        load_validate(data, conf)
+    assert e.value.code == ErrCode.IllegalOpCode
+
+
+def test_type_mismatch_v128():
+    data = single_func([], ["i32"], [], [("v128.const", 1)])
+    with pytest.raises(ValidationError):
+        load_validate(data)
+
+
+def test_v128_local_and_select():
+    got = run1([
+        ("v128.const", vi("4i", 1, 2, 3, 4)), ("local.set", 0),
+        ("local.get", 0), ("local.get", 0), "i32x4.add",
+    ], locals_=["v128"])
+    assert lanes_of(got, "4i") == [2, 4, 6, 8]
+
+
+def test_aot_artifact_with_simd():
+    from wasmedge_tpu import aot
+
+    data = single_func([], ["v128"], [], [
+        ("v128.const", vi("4i", 5, 6, 7, 8)),
+        ("v128.const", vi("4i", 1, 1, 1, 1)), "i32x4.add"])
+    art = aot.compile_module(data)
+    assert lanes_of(run_wasm(art, "f")[0], "4i") == [6, 7, 8, 9]

@@ -81,6 +81,10 @@ def deserialize_image(data: bytes) -> LoweredModule:
     img.c = arrays["c"].tolist()
     img.imm = [int(v) for v in arrays["imm"].astype(np.uint64)]
     img.br_table = arrays["br_table"].reshape(-1).tolist()
+    if "v128_lo" in arrays:
+        img.v128 = [int(lo) | (int(hi) << 64)
+                    for lo, hi in zip(arrays["v128_lo"].tolist(),
+                                      arrays["v128_hi"].tolist())]
     for f in meta["funcs"]:
         img.funcs.append(FuncMeta(
             type_idx=f["type_idx"], nparams=f["nparams"],

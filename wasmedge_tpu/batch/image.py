@@ -106,7 +106,8 @@ _STORES = {
 
 # Ops outside the batch subset (v1). Modules containing them in *reachable
 # batched code* fall back to the scalar engine.
-_UNSUPPORTED_PREFIXES = ("f64.",)
+_UNSUPPORTED_PREFIXES = ("f64.", "v128.", "i8x16.", "i16x8.", "i32x4.",
+                         "i64x2.", "f32x4.", "f64x2.")
 _UNSUPPORTED_NAMES = {
     "i64.trunc_f32_s", "i64.trunc_f32_u", "i64.trunc_f64_s", "i64.trunc_f64_u",
     "i32.trunc_f64_s", "i32.trunc_f64_u",

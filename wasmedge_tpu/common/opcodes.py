@@ -263,6 +263,270 @@ _TABLE = [
     _op("table.size",         0xFC, 16, "tableidx", "->i", "reference-types"),
     _op("table.fill",         0xFC, 17, "tableidx", None, "reference-types"),
 ]
+
+
+def _simd(name, code, imm="none", sig=None):
+    return OpInfo(name, 0xFD, code, imm, sig, "simd")
+
+
+# 0xFD page: the full 128-bit SIMD proposal (236 ops), same set the
+# reference enables by default (enum.inc SIMD block; proposal gate
+# configure.h:175-183).
+_TABLE += [
+    # loads/stores
+    _simd("v128.load",            0x00, "memarg", "i->V"),
+    _simd("v128.load8x8_s",       0x01, "memarg", "i->V"),
+    _simd("v128.load8x8_u",       0x02, "memarg", "i->V"),
+    _simd("v128.load16x4_s",      0x03, "memarg", "i->V"),
+    _simd("v128.load16x4_u",      0x04, "memarg", "i->V"),
+    _simd("v128.load32x2_s",      0x05, "memarg", "i->V"),
+    _simd("v128.load32x2_u",      0x06, "memarg", "i->V"),
+    _simd("v128.load8_splat",     0x07, "memarg", "i->V"),
+    _simd("v128.load16_splat",    0x08, "memarg", "i->V"),
+    _simd("v128.load32_splat",    0x09, "memarg", "i->V"),
+    _simd("v128.load64_splat",    0x0A, "memarg", "i->V"),
+    _simd("v128.store",           0x0B, "memarg", "iV->"),
+    _simd("v128.const",           0x0C, "v128const", "->V"),
+    _simd("i8x16.shuffle",        0x0D, "shuffle", "VV->V"),
+    _simd("i8x16.swizzle",        0x0E, "none", "VV->V"),
+    # splats
+    _simd("i8x16.splat",          0x0F, "none", "i->V"),
+    _simd("i16x8.splat",          0x10, "none", "i->V"),
+    _simd("i32x4.splat",          0x11, "none", "i->V"),
+    _simd("i64x2.splat",          0x12, "none", "I->V"),
+    _simd("f32x4.splat",          0x13, "none", "f->V"),
+    _simd("f64x2.splat",          0x14, "none", "F->V"),
+    # lane access
+    _simd("i8x16.extract_lane_s", 0x15, "lane", "V->i"),
+    _simd("i8x16.extract_lane_u", 0x16, "lane", "V->i"),
+    _simd("i8x16.replace_lane",   0x17, "lane", "Vi->V"),
+    _simd("i16x8.extract_lane_s", 0x18, "lane", "V->i"),
+    _simd("i16x8.extract_lane_u", 0x19, "lane", "V->i"),
+    _simd("i16x8.replace_lane",   0x1A, "lane", "Vi->V"),
+    _simd("i32x4.extract_lane",   0x1B, "lane", "V->i"),
+    _simd("i32x4.replace_lane",   0x1C, "lane", "Vi->V"),
+    _simd("i64x2.extract_lane",   0x1D, "lane", "V->I"),
+    _simd("i64x2.replace_lane",   0x1E, "lane", "VI->V"),
+    _simd("f32x4.extract_lane",   0x1F, "lane", "V->f"),
+    _simd("f32x4.replace_lane",   0x20, "lane", "Vf->V"),
+    _simd("f64x2.extract_lane",   0x21, "lane", "V->F"),
+    _simd("f64x2.replace_lane",   0x22, "lane", "VF->V"),
+    # i8x16 compares
+    _simd("i8x16.eq",   0x23, "none", "VV->V"),
+    _simd("i8x16.ne",   0x24, "none", "VV->V"),
+    _simd("i8x16.lt_s", 0x25, "none", "VV->V"),
+    _simd("i8x16.lt_u", 0x26, "none", "VV->V"),
+    _simd("i8x16.gt_s", 0x27, "none", "VV->V"),
+    _simd("i8x16.gt_u", 0x28, "none", "VV->V"),
+    _simd("i8x16.le_s", 0x29, "none", "VV->V"),
+    _simd("i8x16.le_u", 0x2A, "none", "VV->V"),
+    _simd("i8x16.ge_s", 0x2B, "none", "VV->V"),
+    _simd("i8x16.ge_u", 0x2C, "none", "VV->V"),
+    # i16x8 compares
+    _simd("i16x8.eq",   0x2D, "none", "VV->V"),
+    _simd("i16x8.ne",   0x2E, "none", "VV->V"),
+    _simd("i16x8.lt_s", 0x2F, "none", "VV->V"),
+    _simd("i16x8.lt_u", 0x30, "none", "VV->V"),
+    _simd("i16x8.gt_s", 0x31, "none", "VV->V"),
+    _simd("i16x8.gt_u", 0x32, "none", "VV->V"),
+    _simd("i16x8.le_s", 0x33, "none", "VV->V"),
+    _simd("i16x8.le_u", 0x34, "none", "VV->V"),
+    _simd("i16x8.ge_s", 0x35, "none", "VV->V"),
+    _simd("i16x8.ge_u", 0x36, "none", "VV->V"),
+    # i32x4 compares
+    _simd("i32x4.eq",   0x37, "none", "VV->V"),
+    _simd("i32x4.ne",   0x38, "none", "VV->V"),
+    _simd("i32x4.lt_s", 0x39, "none", "VV->V"),
+    _simd("i32x4.lt_u", 0x3A, "none", "VV->V"),
+    _simd("i32x4.gt_s", 0x3B, "none", "VV->V"),
+    _simd("i32x4.gt_u", 0x3C, "none", "VV->V"),
+    _simd("i32x4.le_s", 0x3D, "none", "VV->V"),
+    _simd("i32x4.le_u", 0x3E, "none", "VV->V"),
+    _simd("i32x4.ge_s", 0x3F, "none", "VV->V"),
+    _simd("i32x4.ge_u", 0x40, "none", "VV->V"),
+    # f32x4 compares
+    _simd("f32x4.eq", 0x41, "none", "VV->V"),
+    _simd("f32x4.ne", 0x42, "none", "VV->V"),
+    _simd("f32x4.lt", 0x43, "none", "VV->V"),
+    _simd("f32x4.gt", 0x44, "none", "VV->V"),
+    _simd("f32x4.le", 0x45, "none", "VV->V"),
+    _simd("f32x4.ge", 0x46, "none", "VV->V"),
+    # f64x2 compares
+    _simd("f64x2.eq", 0x47, "none", "VV->V"),
+    _simd("f64x2.ne", 0x48, "none", "VV->V"),
+    _simd("f64x2.lt", 0x49, "none", "VV->V"),
+    _simd("f64x2.gt", 0x4A, "none", "VV->V"),
+    _simd("f64x2.le", 0x4B, "none", "VV->V"),
+    _simd("f64x2.ge", 0x4C, "none", "VV->V"),
+    # bitwise
+    _simd("v128.not",       0x4D, "none", "V->V"),
+    _simd("v128.and",       0x4E, "none", "VV->V"),
+    _simd("v128.andnot",    0x4F, "none", "VV->V"),
+    _simd("v128.or",        0x50, "none", "VV->V"),
+    _simd("v128.xor",       0x51, "none", "VV->V"),
+    _simd("v128.bitselect", 0x52, "none", "VVV->V"),
+    _simd("v128.any_true",  0x53, "none", "V->i"),
+    # lane memory
+    _simd("v128.load8_lane",   0x54, "memarg_lane", "iV->V"),
+    _simd("v128.load16_lane",  0x55, "memarg_lane", "iV->V"),
+    _simd("v128.load32_lane",  0x56, "memarg_lane", "iV->V"),
+    _simd("v128.load64_lane",  0x57, "memarg_lane", "iV->V"),
+    _simd("v128.store8_lane",  0x58, "memarg_lane", "iV->"),
+    _simd("v128.store16_lane", 0x59, "memarg_lane", "iV->"),
+    _simd("v128.store32_lane", 0x5A, "memarg_lane", "iV->"),
+    _simd("v128.store64_lane", 0x5B, "memarg_lane", "iV->"),
+    _simd("v128.load32_zero",  0x5C, "memarg", "i->V"),
+    _simd("v128.load64_zero",  0x5D, "memarg", "i->V"),
+    _simd("f32x4.demote_f64x2_zero",  0x5E, "none", "V->V"),
+    _simd("f64x2.promote_low_f32x4",  0x5F, "none", "V->V"),
+    # i8x16 arith
+    _simd("i8x16.abs",            0x60, "none", "V->V"),
+    _simd("i8x16.neg",            0x61, "none", "V->V"),
+    _simd("i8x16.popcnt",         0x62, "none", "V->V"),
+    _simd("i8x16.all_true",       0x63, "none", "V->i"),
+    _simd("i8x16.bitmask",        0x64, "none", "V->i"),
+    _simd("i8x16.narrow_i16x8_s", 0x65, "none", "VV->V"),
+    _simd("i8x16.narrow_i16x8_u", 0x66, "none", "VV->V"),
+    _simd("f32x4.ceil",           0x67, "none", "V->V"),
+    _simd("f32x4.floor",          0x68, "none", "V->V"),
+    _simd("f32x4.trunc",          0x69, "none", "V->V"),
+    _simd("f32x4.nearest",        0x6A, "none", "V->V"),
+    _simd("i8x16.shl",            0x6B, "none", "Vi->V"),
+    _simd("i8x16.shr_s",          0x6C, "none", "Vi->V"),
+    _simd("i8x16.shr_u",          0x6D, "none", "Vi->V"),
+    _simd("i8x16.add",            0x6E, "none", "VV->V"),
+    _simd("i8x16.add_sat_s",      0x6F, "none", "VV->V"),
+    _simd("i8x16.add_sat_u",      0x70, "none", "VV->V"),
+    _simd("i8x16.sub",            0x71, "none", "VV->V"),
+    _simd("i8x16.sub_sat_s",      0x72, "none", "VV->V"),
+    _simd("i8x16.sub_sat_u",      0x73, "none", "VV->V"),
+    _simd("f64x2.ceil",           0x74, "none", "V->V"),
+    _simd("f64x2.floor",          0x75, "none", "V->V"),
+    _simd("i8x16.min_s",          0x76, "none", "VV->V"),
+    _simd("i8x16.min_u",          0x77, "none", "VV->V"),
+    _simd("i8x16.max_s",          0x78, "none", "VV->V"),
+    _simd("i8x16.max_u",          0x79, "none", "VV->V"),
+    _simd("f64x2.trunc",          0x7A, "none", "V->V"),
+    _simd("i8x16.avgr_u",         0x7B, "none", "VV->V"),
+    _simd("i16x8.extadd_pairwise_i8x16_s", 0x7C, "none", "V->V"),
+    _simd("i16x8.extadd_pairwise_i8x16_u", 0x7D, "none", "V->V"),
+    _simd("i32x4.extadd_pairwise_i16x8_s", 0x7E, "none", "V->V"),
+    _simd("i32x4.extadd_pairwise_i16x8_u", 0x7F, "none", "V->V"),
+    # i16x8 arith
+    _simd("i16x8.abs",                0x80, "none", "V->V"),
+    _simd("i16x8.neg",                0x81, "none", "V->V"),
+    _simd("i16x8.q15mulr_sat_s",      0x82, "none", "VV->V"),
+    _simd("i16x8.all_true",           0x83, "none", "V->i"),
+    _simd("i16x8.bitmask",            0x84, "none", "V->i"),
+    _simd("i16x8.narrow_i32x4_s",     0x85, "none", "VV->V"),
+    _simd("i16x8.narrow_i32x4_u",     0x86, "none", "VV->V"),
+    _simd("i16x8.extend_low_i8x16_s", 0x87, "none", "V->V"),
+    _simd("i16x8.extend_high_i8x16_s", 0x88, "none", "V->V"),
+    _simd("i16x8.extend_low_i8x16_u", 0x89, "none", "V->V"),
+    _simd("i16x8.extend_high_i8x16_u", 0x8A, "none", "V->V"),
+    _simd("i16x8.shl",                0x8B, "none", "Vi->V"),
+    _simd("i16x8.shr_s",              0x8C, "none", "Vi->V"),
+    _simd("i16x8.shr_u",              0x8D, "none", "Vi->V"),
+    _simd("i16x8.add",                0x8E, "none", "VV->V"),
+    _simd("i16x8.add_sat_s",          0x8F, "none", "VV->V"),
+    _simd("i16x8.add_sat_u",          0x90, "none", "VV->V"),
+    _simd("i16x8.sub",                0x91, "none", "VV->V"),
+    _simd("i16x8.sub_sat_s",          0x92, "none", "VV->V"),
+    _simd("i16x8.sub_sat_u",          0x93, "none", "VV->V"),
+    _simd("f64x2.nearest",            0x94, "none", "V->V"),
+    _simd("i16x8.mul",                0x95, "none", "VV->V"),
+    _simd("i16x8.min_s",              0x96, "none", "VV->V"),
+    _simd("i16x8.min_u",              0x97, "none", "VV->V"),
+    _simd("i16x8.max_s",              0x98, "none", "VV->V"),
+    _simd("i16x8.max_u",              0x99, "none", "VV->V"),
+    _simd("i16x8.avgr_u",             0x9B, "none", "VV->V"),
+    _simd("i16x8.extmul_low_i8x16_s", 0x9C, "none", "VV->V"),
+    _simd("i16x8.extmul_high_i8x16_s", 0x9D, "none", "VV->V"),
+    _simd("i16x8.extmul_low_i8x16_u", 0x9E, "none", "VV->V"),
+    _simd("i16x8.extmul_high_i8x16_u", 0x9F, "none", "VV->V"),
+    # i32x4 arith
+    _simd("i32x4.abs",                0xA0, "none", "V->V"),
+    _simd("i32x4.neg",                0xA1, "none", "V->V"),
+    _simd("i32x4.all_true",           0xA3, "none", "V->i"),
+    _simd("i32x4.bitmask",            0xA4, "none", "V->i"),
+    _simd("i32x4.extend_low_i16x8_s", 0xA7, "none", "V->V"),
+    _simd("i32x4.extend_high_i16x8_s", 0xA8, "none", "V->V"),
+    _simd("i32x4.extend_low_i16x8_u", 0xA9, "none", "V->V"),
+    _simd("i32x4.extend_high_i16x8_u", 0xAA, "none", "V->V"),
+    _simd("i32x4.shl",                0xAB, "none", "Vi->V"),
+    _simd("i32x4.shr_s",              0xAC, "none", "Vi->V"),
+    _simd("i32x4.shr_u",              0xAD, "none", "Vi->V"),
+    _simd("i32x4.add",                0xAE, "none", "VV->V"),
+    _simd("i32x4.sub",                0xB1, "none", "VV->V"),
+    _simd("i32x4.mul",                0xB5, "none", "VV->V"),
+    _simd("i32x4.min_s",              0xB6, "none", "VV->V"),
+    _simd("i32x4.min_u",              0xB7, "none", "VV->V"),
+    _simd("i32x4.max_s",              0xB8, "none", "VV->V"),
+    _simd("i32x4.max_u",              0xB9, "none", "VV->V"),
+    _simd("i32x4.dot_i16x8_s",        0xBA, "none", "VV->V"),
+    _simd("i32x4.extmul_low_i16x8_s", 0xBC, "none", "VV->V"),
+    _simd("i32x4.extmul_high_i16x8_s", 0xBD, "none", "VV->V"),
+    _simd("i32x4.extmul_low_i16x8_u", 0xBE, "none", "VV->V"),
+    _simd("i32x4.extmul_high_i16x8_u", 0xBF, "none", "VV->V"),
+    # i64x2 arith
+    _simd("i64x2.abs",                0xC0, "none", "V->V"),
+    _simd("i64x2.neg",                0xC1, "none", "V->V"),
+    _simd("i64x2.all_true",           0xC3, "none", "V->i"),
+    _simd("i64x2.bitmask",            0xC4, "none", "V->i"),
+    _simd("i64x2.extend_low_i32x4_s", 0xC7, "none", "V->V"),
+    _simd("i64x2.extend_high_i32x4_s", 0xC8, "none", "V->V"),
+    _simd("i64x2.extend_low_i32x4_u", 0xC9, "none", "V->V"),
+    _simd("i64x2.extend_high_i32x4_u", 0xCA, "none", "V->V"),
+    _simd("i64x2.shl",                0xCB, "none", "Vi->V"),
+    _simd("i64x2.shr_s",              0xCC, "none", "Vi->V"),
+    _simd("i64x2.shr_u",              0xCD, "none", "Vi->V"),
+    _simd("i64x2.add",                0xCE, "none", "VV->V"),
+    _simd("i64x2.sub",                0xD1, "none", "VV->V"),
+    _simd("i64x2.mul",                0xD5, "none", "VV->V"),
+    _simd("i64x2.eq",                 0xD6, "none", "VV->V"),
+    _simd("i64x2.ne",                 0xD7, "none", "VV->V"),
+    _simd("i64x2.lt_s",               0xD8, "none", "VV->V"),
+    _simd("i64x2.gt_s",               0xD9, "none", "VV->V"),
+    _simd("i64x2.le_s",               0xDA, "none", "VV->V"),
+    _simd("i64x2.ge_s",               0xDB, "none", "VV->V"),
+    _simd("i64x2.extmul_low_i32x4_s", 0xDC, "none", "VV->V"),
+    _simd("i64x2.extmul_high_i32x4_s", 0xDD, "none", "VV->V"),
+    _simd("i64x2.extmul_low_i32x4_u", 0xDE, "none", "VV->V"),
+    _simd("i64x2.extmul_high_i32x4_u", 0xDF, "none", "VV->V"),
+    # f32x4 arith
+    _simd("f32x4.abs",  0xE0, "none", "V->V"),
+    _simd("f32x4.neg",  0xE1, "none", "V->V"),
+    _simd("f32x4.sqrt", 0xE3, "none", "V->V"),
+    _simd("f32x4.add",  0xE4, "none", "VV->V"),
+    _simd("f32x4.sub",  0xE5, "none", "VV->V"),
+    _simd("f32x4.mul",  0xE6, "none", "VV->V"),
+    _simd("f32x4.div",  0xE7, "none", "VV->V"),
+    _simd("f32x4.min",  0xE8, "none", "VV->V"),
+    _simd("f32x4.max",  0xE9, "none", "VV->V"),
+    _simd("f32x4.pmin", 0xEA, "none", "VV->V"),
+    _simd("f32x4.pmax", 0xEB, "none", "VV->V"),
+    # f64x2 arith
+    _simd("f64x2.abs",  0xEC, "none", "V->V"),
+    _simd("f64x2.neg",  0xED, "none", "V->V"),
+    _simd("f64x2.sqrt", 0xEF, "none", "V->V"),
+    _simd("f64x2.add",  0xF0, "none", "VV->V"),
+    _simd("f64x2.sub",  0xF1, "none", "VV->V"),
+    _simd("f64x2.mul",  0xF2, "none", "VV->V"),
+    _simd("f64x2.div",  0xF3, "none", "VV->V"),
+    _simd("f64x2.min",  0xF4, "none", "VV->V"),
+    _simd("f64x2.max",  0xF5, "none", "VV->V"),
+    _simd("f64x2.pmin", 0xF6, "none", "VV->V"),
+    _simd("f64x2.pmax", 0xF7, "none", "VV->V"),
+    # conversions
+    _simd("i32x4.trunc_sat_f32x4_s",      0xF8, "none", "V->V"),
+    _simd("i32x4.trunc_sat_f32x4_u",      0xF9, "none", "V->V"),
+    _simd("f32x4.convert_i32x4_s",        0xFA, "none", "V->V"),
+    _simd("f32x4.convert_i32x4_u",        0xFB, "none", "V->V"),
+    _simd("i32x4.trunc_sat_f64x2_s_zero", 0xFC, "none", "V->V"),
+    _simd("i32x4.trunc_sat_f64x2_u_zero", 0xFD, "none", "V->V"),
+    _simd("f64x2.convert_low_i32x4_s",    0xFE, "none", "V->V"),
+    _simd("f64x2.convert_low_i32x4_u",    0xFF, "none", "V->V"),
+]
 # fmt: on
 
 OPCODES: tuple = tuple(_TABLE)

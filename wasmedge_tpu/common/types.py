@@ -115,7 +115,7 @@ def is_arithmetic_nan64(bits: int) -> bool:
 
 
 def typed_to_bits(ty: ValType, v) -> int:
-    """Typed Python/numpy value -> raw 64-bit cell."""
+    """Typed Python/numpy value -> raw cell (64-bit; v128 is 128-bit)."""
     if ty == ValType.I32:
         return int(v) & MASK32
     if ty == ValType.I64:
@@ -124,13 +124,15 @@ def typed_to_bits(ty: ValType, v) -> int:
         return f32_to_bits(v)
     if ty == ValType.F64:
         return f64_to_bits(v)
+    if ty == ValType.V128:
+        return int(v) & ((1 << 128) - 1)
     if ty.is_ref:
         return int(v) & MASK64
     raise ValueError(f"unsupported type {ty}")
 
 
 def bits_to_typed(ty: ValType, b: int):
-    """Raw 64-bit cell -> typed value (ints are signed, floats numpy)."""
+    """Raw cell -> typed value (ints are signed, floats numpy, v128 raw)."""
     if ty == ValType.I32:
         return s32(b)
     if ty == ValType.I64:
@@ -139,6 +141,8 @@ def bits_to_typed(ty: ValType, b: int):
         return bits_to_f32(b)
     if ty == ValType.F64:
         return bits_to_f64(b)
+    if ty == ValType.V128:
+        return b & ((1 << 128) - 1)
     if ty.is_ref:
         return b & MASK64
     raise ValueError(f"unsupported type {ty}")

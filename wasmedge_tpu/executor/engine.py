@@ -21,10 +21,12 @@ from wasmedge_tpu.common.errors import ErrCode, TrapError, trap
 from wasmedge_tpu.common.opcodes import Op
 from wasmedge_tpu.common.types import MASK32, MASK64, s32
 from wasmedge_tpu.executor.numeric import HANDLERS
+from wasmedge_tpu.executor import simd as _simd
 from wasmedge_tpu.runtime.instance import FunctionInstance, ModuleInstance
 from wasmedge_tpu.validator.image import LOP_BR, LOP_BRNZ, LOP_BRZ
 
 OP_RETURN = Op.__dict__["return"]
+MASK128 = (1 << 128) - 1
 
 # Load/store op metadata: op -> (nbytes, signed, result mask)
 _LOAD_INFO = {
@@ -40,6 +42,42 @@ _STORE_INFO = {
     Op.i32_store: 4, Op.i64_store: 8, Op.f32_store: 4, Op.f64_store: 8,
     Op.i32_store8: 1, Op.i32_store16: 2,
     Op.i64_store8: 1, Op.i64_store16: 2, Op.i64_store32: 4,
+}
+
+# SIMD wide loads: op -> (src lane bytes, lane count, signed) for NxM loads
+_SIMD_EXT_LOAD = {
+    Op.v128_load8x8_s: (1, 8, True), Op.v128_load8x8_u: (1, 8, False),
+    Op.v128_load16x4_s: (2, 4, True), Op.v128_load16x4_u: (2, 4, False),
+    Op.v128_load32x2_s: (4, 2, True), Op.v128_load32x2_u: (4, 2, False),
+}
+_SIMD_SPLAT_LOAD = {
+    Op.v128_load8_splat: 1, Op.v128_load16_splat: 2,
+    Op.v128_load32_splat: 4, Op.v128_load64_splat: 8,
+}
+_SIMD_ZERO_LOAD = {Op.v128_load32_zero: 4, Op.v128_load64_zero: 8}
+_SIMD_LANE_LOAD = {
+    Op.v128_load8_lane: 1, Op.v128_load16_lane: 2,
+    Op.v128_load32_lane: 4, Op.v128_load64_lane: 8,
+}
+_SIMD_LANE_STORE = {
+    Op.v128_store8_lane: 1, Op.v128_store16_lane: 2,
+    Op.v128_store32_lane: 4, Op.v128_store64_lane: 8,
+}
+# lane access: op -> (shape, signed, result mask or None for v128 result)
+_SIMD_EXTRACT = {
+    Op.i8x16_extract_lane_s: ("i8x16", True, MASK32),
+    Op.i8x16_extract_lane_u: ("i8x16", False, MASK32),
+    Op.i16x8_extract_lane_s: ("i16x8", True, MASK32),
+    Op.i16x8_extract_lane_u: ("i16x8", False, MASK32),
+    Op.i32x4_extract_lane: ("i32x4", False, MASK32),
+    Op.i64x2_extract_lane: ("i64x2", False, MASK64),
+    Op.f32x4_extract_lane: ("f32x4", False, MASK32),
+    Op.f64x2_extract_lane: ("f64x2", False, MASK64),
+}
+_SIMD_REPLACE = {
+    Op.i8x16_replace_lane: "i8x16", Op.i16x8_replace_lane: "i16x8",
+    Op.i32x4_replace_lane: "i32x4", Op.i64x2_replace_lane: "i64x2",
+    Op.f32x4_replace_lane: "f32x4", Op.f64x2_replace_lane: "f64x2",
 }
 
 
@@ -90,6 +128,7 @@ def _run_wasm(thread: Thread, fi: FunctionInstance, args: List[int]) -> List[int
     cc = image.c
     imm = image.imm
     brt = image.br_table
+    v128c = image.v128
     funcs = module.funcs
     memories = module.memories
     globals_ = module.globals
@@ -175,6 +214,7 @@ def _run_wasm(thread: Thread, fi: FunctionInstance, args: List[int]) -> List[int
                 image = module.lowered
                 ops, aa, bb, cc, imm = image.op, image.a, image.b, image.c, image.imm
                 brt = image.br_table
+                v128c = image.v128
                 funcs, memories = module.funcs, module.memories
                 globals_, tables = module.globals, module.tables
                 elems, datas = module.elems, module.datas
@@ -234,6 +274,7 @@ def _run_wasm(thread: Thread, fi: FunctionInstance, args: List[int]) -> List[int
                         image = module.lowered
                         ops, aa, bb, cc, imm = image.op, image.a, image.b, image.c, image.imm
                         brt = image.br_table
+                        v128c = image.v128
                         funcs, memories = module.funcs, module.memories
                         globals_, tables = module.globals, module.tables
                         elems, datas = module.elems, module.datas
@@ -261,6 +302,7 @@ def _run_wasm(thread: Thread, fi: FunctionInstance, args: List[int]) -> List[int
                     image = module.lowered
                     ops, aa, bb, cc, imm = image.op, image.a, image.b, image.c, image.imm
                     brt = image.br_table
+                    v128c = image.v128
                     funcs, memories = module.funcs, module.memories
                     globals_, tables = module.globals, module.tables
                     elems, datas = module.elems, module.datas
@@ -384,6 +426,66 @@ def _run_wasm(thread: Thread, fi: FunctionInstance, args: List[int]) -> List[int
             pc += 1
         elif op == Op.elem_drop:
             elems[aa[pc]].clear()
+            pc += 1
+        elif op == Op.v128_const:
+            st.append(v128c[aa[pc]])
+            pc += 1
+        elif op == Op.i8x16_shuffle:
+            b = st.pop()
+            st[-1] = _simd.shuffle(st[-1], b, v128c[aa[pc]])
+            pc += 1
+        elif op in _SIMD_EXTRACT:
+            shape, signed, mask = _SIMD_EXTRACT[op]
+            st[-1] = _simd.extract_lane(st[-1], shape, aa[pc], signed) & mask
+            pc += 1
+        elif op in _SIMD_REPLACE:
+            x = st.pop()
+            st[-1] = _simd.replace_lane(st[-1], _SIMD_REPLACE[op], aa[pc], x)
+            pc += 1
+        elif op == Op.v128_load:
+            addr = (st[-1] & MASK32) + (imm[pc] & MASK64)
+            st[-1] = memories[0].load(addr, 16, False)
+            pc += 1
+        elif op == Op.v128_store:
+            v = st.pop()
+            addr = (st.pop() & MASK32) + (imm[pc] & MASK64)
+            memories[0].store(addr, 16, v & MASK128)
+            pc += 1
+        elif op in _SIMD_EXT_LOAD:
+            wbytes, nl, signed = _SIMD_EXT_LOAD[op]
+            addr = (st[-1] & MASK32) + (imm[pc] & MASK64)
+            raw = memories[0].load_bytes(addr, wbytes * nl)
+            vals = [int.from_bytes(raw[k * wbytes:(k + 1) * wbytes],
+                                   "little", signed=signed)
+                    for k in range(nl)]
+            st[-1] = _simd.pack(vals, (16 // nl) * 8)
+            pc += 1
+        elif op in _SIMD_SPLAT_LOAD:
+            wbytes = _SIMD_SPLAT_LOAD[op]
+            addr = (st[-1] & MASK32) + (imm[pc] & MASK64)
+            x = memories[0].load(addr, wbytes, False)
+            st[-1] = _simd.pack([x] * (16 // wbytes), wbytes * 8)
+            pc += 1
+        elif op in _SIMD_ZERO_LOAD:
+            wbytes = _SIMD_ZERO_LOAD[op]
+            addr = (st[-1] & MASK32) + (imm[pc] & MASK64)
+            st[-1] = memories[0].load(addr, wbytes, False)
+            pc += 1
+        elif op in _SIMD_LANE_LOAD:
+            wbytes = _SIMD_LANE_LOAD[op]
+            v = st.pop()
+            addr = (st.pop() & MASK32) + (imm[pc] & MASK64)
+            x = memories[0].load(addr, wbytes, False)
+            shape = {1: "i8x16", 2: "i16x8", 4: "i32x4", 8: "i64x2"}[wbytes]
+            st.append(_simd.replace_lane(v, shape, aa[pc], x))
+            pc += 1
+        elif op in _SIMD_LANE_STORE:
+            wbytes = _SIMD_LANE_STORE[op]
+            v = st.pop()
+            addr = (st.pop() & MASK32) + (imm[pc] & MASK64)
+            shape = {1: "i8x16", 2: "i16x8", 4: "i32x4", 8: "i64x2"}[wbytes]
+            memories[0].store(addr, wbytes,
+                              _simd.extract_lane(v, shape, aa[pc], False))
             pc += 1
         elif op == Op.nop:
             pc += 1

@@ -359,6 +359,16 @@ class Loader:
         elif imm == "memarg":
             ins.mem_align = fm.read_u32()
             ins.mem_offset = fm.read_u32()
+        elif imm == "memarg_lane":
+            ins.mem_align = fm.read_u32()
+            ins.mem_offset = fm.read_u32()
+            ins.target_idx = fm.read_byte()  # lane index
+        elif imm == "lane":
+            ins.target_idx = fm.read_byte()
+        elif imm == "v128const":
+            ins.imm = int.from_bytes(fm.read_bytes(16), "little")
+        elif imm == "shuffle":
+            ins.imm = int.from_bytes(fm.read_bytes(16), "little")
         elif imm == "i32":
             ins.imm = fm.read_s32() & 0xFFFFFFFF
         elif imm == "i64":

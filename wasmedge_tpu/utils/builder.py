@@ -105,6 +105,18 @@ def encode_instr(ins: InstrLike) -> bytes:
         offset = args[1] if len(args) > 1 else 0
         out += uleb(align)
         out += uleb(offset)
+    elif imm == "memarg_lane":  # (align, offset, lane)
+        out += uleb(args[0] if args else 0)
+        out += uleb(args[1] if len(args) > 1 else 0)
+        out.append(args[2] if len(args) > 2 else 0)
+    elif imm == "lane":
+        out.append(args[0])
+    elif imm == "v128const":  # one 128-bit int or 16 bytes
+        v = args[0]
+        out += v if isinstance(v, (bytes, bytearray)) \
+            else int(v).to_bytes(16, "little")
+    elif imm == "shuffle":  # 16 lane indices
+        out += bytes(args[0])
     elif imm == "i32":
         out += sleb(args[0] if args[0] < 2**31 else args[0] - 2**32)
     elif imm == "i64":

@@ -45,12 +45,32 @@ class CtrlFrame:
 
 def _access_width(name: str) -> int:
     """Natural byte width of a load/store opcode from its name."""
-    base = name.split(".")[0]
-    suffix = name.split(".")[1]
+    base, suffix = name.split(".")
+    if base == "v128" and suffix not in ("load", "store"):
+        # v128.loadNxM_* / loadN_splat / loadN_zero / loadN_lane /
+        # storeN_lane: N is the per-element bit width; NxM loads move
+        # N/8*M = 8 bytes total.
+        digits = ""
+        for ch in suffix[len("load"):] if suffix.startswith("load") \
+                else suffix[len("store"):]:
+            if ch.isdigit():
+                digits += ch
+            else:
+                break
+        n = int(digits)
+        if "x" in suffix:
+            return 8  # 8x8 / 16x4 / 32x2 all read 64 bits
+        return n // 8
     for tag, w in (("8", 1), ("16", 2), ("32", 4)):
         if f"load{tag}" in suffix or f"store{tag}" in suffix:
             return w
     return {"i32": 4, "f32": 4, "i64": 8, "f64": 8, "v128": 16}[base]
+
+
+def _lane_count(name: str) -> int:
+    """Lane count of a shaped SIMD op: i8x16.* -> 16, f64x2.* -> 2."""
+    shape = name.split(".")[0]
+    return int(shape.split("x")[1])
 
 
 class FormChecker:
@@ -193,6 +213,48 @@ class FormChecker:
             for ch in pushes:
                 self.push_val(SIG_CHAR_TO_VALTYPE[ch])
             im.emit(ins.op, imm=ins.imm)
+            return
+
+        # SIMD immediates (all sig-driven; lane/mask bounds checked here).
+        if info.imm == "v128const":
+            self.push_val(ValType.V128)
+            im.emit(ins.op, a=im.emit_v128(ins.imm))
+            return
+        if info.imm == "shuffle":
+            mask = ins.imm
+            for k in range(16):
+                if ((mask >> (8 * k)) & 0xFF) >= 32:
+                    self._err(ErrCode.InvalidLaneIdx,
+                              f"shuffle lane {(mask >> (8 * k)) & 0xFF}")
+            self.pop_val(ValType.V128)
+            self.pop_val(ValType.V128)
+            self.push_val(ValType.V128)
+            im.emit(ins.op, a=im.emit_v128(mask))
+            return
+        if info.imm == "lane":
+            if ins.target_idx >= _lane_count(name):
+                self._err(ErrCode.InvalidLaneIdx, f"lane {ins.target_idx}")
+            pops, pushes = info.sig.split("->")
+            for ch in reversed(pops):
+                self.pop_val(SIG_CHAR_TO_VALTYPE[ch])
+            for ch in pushes:
+                self.push_val(SIG_CHAR_TO_VALTYPE[ch])
+            im.emit(ins.op, a=ins.target_idx)
+            return
+        if info.imm == "memarg_lane":
+            self._check_mem(0)
+            width = _access_width(name)
+            if (1 << ins.mem_align) > width:
+                self._err(ErrCode.InvalidAlignment,
+                          f"alignment 2**{ins.mem_align} > natural {width}")
+            if ins.target_idx >= 16 // width:
+                self._err(ErrCode.InvalidLaneIdx, f"lane {ins.target_idx}")
+            pops, pushes = info.sig.split("->")
+            for ch in reversed(pops):
+                self.pop_val(SIG_CHAR_TO_VALTYPE[ch])
+            for ch in pushes:
+                self.push_val(SIG_CHAR_TO_VALTYPE[ch])
+            im.emit(ins.op, a=ins.target_idx, imm=ins.mem_offset)
             return
 
         # Memory plain ops.

@@ -74,6 +74,7 @@ class LoweredModule:
         self.c: List[int] = []
         self.imm: List[int] = []
         self.br_table: List[int] = []  # flattened (target_pc, keep, pop_to)
+        self.v128: List[int] = []  # 128-bit consts + shuffle masks, by index
         self.funcs: List[FuncMeta] = []
         self.func_of_pc: Optional[np.ndarray] = None
         self._np = None
@@ -87,6 +88,12 @@ class LoweredModule:
         self.c.append(c)
         self.imm.append(imm)
         return idx
+
+    def emit_v128(self, value: int) -> int:
+        """Intern a 128-bit constant; returns its index (the a-operand of
+        v128.const / i8x16.shuffle — the imm plane is only 64-bit)."""
+        self.v128.append(value & ((1 << 128) - 1))
+        return len(self.v128) - 1
 
     def emit_brtable_entry(self, target_pc: int, keep: int, pop_to: int) -> int:
         idx = len(self.br_table) // 3
@@ -115,6 +122,10 @@ class LoweredModule:
             "c": np.asarray(self.c, dtype=np.int32),
             "imm": np.asarray(i64, dtype=np.int64),
             "br_table": np.asarray(self.br_table or [0, 0, 0], dtype=np.int32).reshape(-1, 3),
+            "v128_lo": np.asarray([v & ((1 << 64) - 1) for v in self.v128]
+                                  or [0], dtype=np.uint64),
+            "v128_hi": np.asarray([v >> 64 for v in self.v128] or [0],
+                                  dtype=np.uint64),
         }
         fop = np.zeros(max(self.code_len, 1), dtype=np.int32)
         for fi, fn in enumerate(self.funcs):
