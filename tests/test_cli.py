@@ -95,6 +95,89 @@ def test_artifact_tamper_falls_back():
     assert payload is None
 
 
+def _artifact_with_image(wasm: bytes, img) -> bytes:
+    """Build a universal twasm whose tpu.aot section carries `img` with a
+    *correct* content hash — the attack verify_image() must stop."""
+    import hashlib
+    import struct
+
+    payload = aot.serialize_image(img)
+    digest = hashlib.sha256(wasm).digest()
+    body = struct.pack("<I", aot.AOT_VERSION) + digest + payload
+    name = aot.SECTION_NAME.encode()
+    content = aot._uleb(len(name)) + name + body
+    return wasm + b"\x00" + aot._uleb(len(content)) + content
+
+
+def _validated_fib():
+    conf = Configure()
+    wasm = build_fib()
+    mod = Validator(conf).validate(Loader(conf).parse_module(wasm))
+    return wasm, mod
+
+
+def test_verify_image_accepts_honest_image():
+    wasm, mod = _validated_fib()
+    img = aot.deserialize_image(aot.serialize_image(mod.lowered))
+    aot.verify_image(img, mod)  # must not raise
+
+
+@pytest.mark.parametrize("tamper", ["local", "branch", "call", "underflow",
+                                    "neg_keep", "trunc_imm", "float_meta"])
+def test_verify_image_rejects_tampered(tamper):
+    from wasmedge_tpu.common.opcodes import NAME_TO_ID
+    from wasmedge_tpu.validator.image import LOP_BR, LOP_BRNZ, LOP_BRZ
+
+    wasm, mod = _validated_fib()
+    img = aot.deserialize_image(aot.serialize_image(mod.lowered))
+    if tamper == "local":
+        pc = img.op.index(NAME_TO_ID["local.get"])
+        img.a[pc] = 999  # cross-frame read
+    elif tamper == "branch":
+        pc = next(i for i, o in enumerate(img.op) if o in (LOP_BRZ, LOP_BRNZ))
+        img.a[pc] = img.code_len + 17  # jump out of the code image
+    elif tamper == "call":
+        pc = img.op.index(NAME_TO_ID["call"])
+        img.a[pc] = 55  # nonexistent function
+    elif tamper == "underflow":
+        pc = img.op.index(NAME_TO_ID["local.get"])
+        img.op[pc] = NAME_TO_ID["drop"]  # stack underflow at entry
+    elif tamper == "neg_keep":
+        # negative keep makes every height inequality vacuously pass while
+        # the engine's slice semantics leave the stack taller than verified
+        pc = next(i for i, o in enumerate(img.op) if o == LOP_BR)
+        img.b[pc] = -2
+        img.c[pc] = 4
+    elif tamper == "trunc_imm":
+        img.imm = img.imm[:-3]  # plane shorter than the code image
+    elif tamper == "float_meta":
+        img.funcs[0].nparams = float(img.funcs[0].nparams)
+    img.finalize()
+    with pytest.raises(ValueError):
+        aot.verify_image(img, mod)
+
+
+def test_malicious_embedded_image_falls_back_to_validation():
+    from wasmedge_tpu.common.opcodes import NAME_TO_ID
+
+    wasm, mod0 = _validated_fib()
+    bad = aot.deserialize_image(aot.serialize_image(mod0.lowered))
+    pc = bad.op.index(NAME_TO_ID["local.get"])
+    bad.a[pc] = 999
+    bad.finalize()
+    art = _artifact_with_image(wasm, bad)
+
+    conf = Configure()
+    mod = Validator(conf).validate(Loader(conf).parse_module(art))
+    # full body validation must have produced the honest lowering,
+    # not the crafted image
+    assert mod.validated
+    assert mod.lowered.a[pc] != 999
+    from tests.helpers import run_wasm
+
+    assert run_wasm(art, "fib", [10]) == [55]
+
+
 def test_cache(tmp_path, monkeypatch):
     monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
     wasm = build_fib()

@@ -474,3 +474,52 @@ def test_process_module_allowlist():
     pc("wasmedge_process_set_prog_name", 0, 2)
     assert pc("wasmedge_process_run") == 0xFFFFFFFF
     assert pc("wasmedge_process_get_stderr_len") > 0
+
+
+# ---------------------------------------------------------------------------
+# guest-controlled iovec lengths must be bounds-checked before recv
+# ---------------------------------------------------------------------------
+def test_sock_recv_huge_iovec_faults():
+    import socket as _socket
+
+    from wasmedge_tpu.host.wasi.environ import FdEntry
+    from wasmedge_tpu.host.wasi.wasi_abi import Rights as R
+
+    wasi = WasiModule()
+    mem = make_mem()
+    a, b = _socket.socketpair()
+    try:
+        rights = R.SOCK_RECV | R.FD_READ
+        fd = wasi.env.insert_entry(FdEntry("socket", sock=a,
+                                           rights_base=rights,
+                                           rights_inheriting=rights))
+        b.send(b"data")
+        # iovec at 64: buf=128, len=0xFFFFF000 (~4 GiB) — far past memory
+        mem.store(64, 4, 128)
+        mem.store(68, 4, 0xFFFFF000)
+        assert call(wasi, "sock_recv", mem, fd, 64, 1, 0, 72, 76) == Errno.FAULT
+        assert call(wasi, "sock_recv_from", mem, fd, 64, 1, 200, 0, 72, 76) \
+            == Errno.FAULT
+    finally:
+        a.close()
+        b.close()
+
+
+def test_poll_oneoff_bad_clock_is_per_subscription():
+    wasi = WasiModule()
+    mem = make_mem()
+    from wasmedge_tpu.host.wasi import wasi_abi as abi
+
+    # subscription 0: invalid clock id 99
+    base = 0
+    mem.store(base, 8, 0xAB)               # userdata
+    mem.store(base + 8, 1, abi.Eventtype.CLOCK)
+    mem.store(base + 16, 4, 99)            # bad clock id
+    mem.store(base + 24, 8, 1000)          # timeout
+    mem.store(base + 40, 2, 0)
+    out = 256
+    assert call(wasi, "poll_oneoff", mem, 0, out, 1, 512) == Errno.SUCCESS
+    assert mem.load(512, 4, False) == 1    # one event delivered
+    assert mem.load(out, 8, False) == 0xAB  # userdata echoed
+    assert mem.load(out + 8, 2, False) == Errno.INVAL  # per-event errno
+    assert mem.load(out + 10, 1, False) == abi.Eventtype.CLOCK

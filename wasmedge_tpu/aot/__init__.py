@@ -10,8 +10,8 @@ Our engines execute the validator's dense SoA image, so the TPU-native
 "compiled artifact" is that image, serialized. compile_module() appends it
 as a `tpu.aot` custom section over the original bytes; attach_precompiled()
 verifies version + content hash and short-circuits validation on load,
-falling back silently on any mismatch. XLA specialization of hot functions
-builds on top of this image (wasmedge_tpu/aot/xla_compile.py).
+falling back silently on any mismatch; verify_image() structurally proves
+an embedded image safe before the engines will execute it.
 """
 
 from __future__ import annotations
@@ -25,7 +25,13 @@ from typing import Optional
 
 import numpy as np
 
-from wasmedge_tpu.validator.image import FuncMeta, LoweredModule
+from wasmedge_tpu.validator.image import (
+    LOP_BR,
+    LOP_BRNZ,
+    LOP_BRZ,
+    FuncMeta,
+    LoweredModule,
+)
 
 SECTION_NAME = "tpu.aot"
 AOT_VERSION = 1  # reference analog: AOT::kBinaryVersion
@@ -131,6 +137,224 @@ def extract_precompiled(wasm_bytes: bytes, custom_sections) -> Optional[bytes]:
             continue
         return data[36:]
     return None
+
+
+def verify_image(img: LoweredModule, mod) -> None:
+    """Structural verifier for a deserialized lowered image.
+
+    The tpu.aot section rides inside attacker-controlled bytes, so an
+    embedded image must never be trusted to index stacks/globals/functions
+    out of bounds (the engines do unchecked `st[fp+a]`-style access by
+    design). This proves, per function, that every reachable pc has a
+    consistent operand-stack height within [0, max_height], every branch
+    target stays inside the function, and every index operand is in range —
+    the same guarantees the FormChecker lowering pass establishes when it
+    builds the image itself. Raises ValueError on any violation; the
+    validator then falls back to full body validation (the reference's
+    graceful AOT-mismatch fallback, lib/loader/ast/module.cpp:279-326).
+    """
+    from wasmedge_tpu.common.opcodes import NAME_TO_ID, OPCODES, Op
+
+    nfuncs = len(img.funcs)
+    if nfuncs != mod.total_funcs:
+        raise ValueError("func count mismatch")
+    code_len = img.code_len
+    # cross-plane consistency: every plane deserialized independently from
+    # the untrusted npz must cover the whole code image
+    if not (len(img.a) == len(img.b) == len(img.c) == len(img.imm)
+            == code_len) or len(img.br_table) % 3 != 0:
+        raise ValueError("aot image verify: plane length mismatch")
+    for fn in img.funcs:
+        for v in (fn.type_idx, fn.nparams, fn.nresults, fn.nlocals,
+                  fn.entry_pc, fn.end_pc, fn.max_height):
+            if type(v) is not int:
+                raise ValueError("aot image verify: non-int func metadata")
+    brt = img.br_table
+    n_brt = len(brt) // 3
+    ntypes = len(mod.types)
+    nglobals = len(mod.all_global_types())
+    ntables = len(mod.all_table_types())
+    nmems = len(mod.all_memory_types())
+    nelems = len(mod.elements)
+    ndatas = len(mod.datas)
+    nv128 = len(img.v128)
+    op_return = NAME_TO_ID["return"]
+
+    def fail(msg):
+        raise ValueError(f"aot image verify: {msg}")
+
+    nimp = mod.num_imported_funcs
+    for fi, fn in enumerate(img.funcs):
+        ft = mod.func_type_of(fi)
+        if fn.nparams != len(ft.params) or fn.nresults != len(ft.results):
+            fail(f"func {fi} signature mismatch")
+        if fi < nimp:
+            if not fn.is_import:
+                fail(f"func {fi} should be an import")
+            continue
+        if fn.is_import:
+            fail(f"func {fi} should not be an import")
+        if fn.nlocals < fn.nparams or fn.nlocals > (1 << 20):
+            fail(f"func {fi} bad nlocals")
+        if fn.max_height < 0 or fn.max_height > (1 << 20):
+            fail(f"func {fi} bad max_height")
+        if not (0 <= fn.entry_pc <= fn.end_pc < code_len):
+            fail(f"func {fi} pc range out of bounds")
+
+    for fi in range(nimp, nfuncs):
+        fn = img.funcs[fi]
+        lo, hi = fn.entry_pc, fn.end_pc
+        heights = {fn.entry_pc: 0}
+        work = [fn.entry_pc]
+
+        def flow(pc, h):
+            if not (lo <= pc <= hi):
+                fail(f"func {fi} pc {pc} escapes function body")
+            if h < 0 or h > fn.max_height:
+                fail(f"func {fi} pc {pc} height {h} out of [0,{fn.max_height}]")
+            prev = heights.get(pc)
+            if prev is None:
+                heights[pc] = h
+                work.append(pc)
+            elif prev != h:
+                fail(f"func {fi} pc {pc} inconsistent heights {prev}/{h}")
+
+        while work:
+            pc = work.pop()
+            h = heights[pc]
+            op, a, b, c = img.op[pc], img.a[pc], img.b[pc], img.c[pc]
+
+            if op == LOP_BR:
+                if b < 0 or h < b or c < 0 or c > h - b:
+                    fail(f"func {fi} pc {pc} bad br keep/pop")
+                flow(a, c + b)
+                continue
+            if op == LOP_BRZ:
+                if h < 1:
+                    fail(f"func {fi} pc {pc} brz underflow")
+                flow(a, h - 1)
+                flow(pc + 1, h - 1)
+                continue
+            if op == LOP_BRNZ:
+                if b < 0 or h < 1 + b or c < 0 or c > h - 1 - b:
+                    fail(f"func {fi} pc {pc} bad br_if keep/pop")
+                flow(a, c + b)
+                flow(pc + 1, h - 1)
+                continue
+            if 0 <= op < len(OPCODES):
+                name = OPCODES[op].name
+                sig = OPCODES[op].sig
+            else:
+                fail(f"func {fi} pc {pc} unknown op {op}")
+            if name == "br_table":
+                if h < 1:
+                    fail(f"func {fi} pc {pc} br_table underflow")
+                if a < 0 or b < 0 or a + b + 1 > n_brt:
+                    fail(f"func {fi} pc {pc} br_table entries out of range")
+                for e in range(a, a + b + 1):
+                    tgt, keep, pop_to = brt[e * 3], brt[e * 3 + 1], brt[e * 3 + 2]
+                    if keep < 0 or h - 1 < keep or pop_to < 0 \
+                            or pop_to > h - 1 - keep:
+                        fail(f"func {fi} pc {pc} bad br_table keep/pop")
+                    flow(tgt, pop_to + keep)
+                continue
+            if op == op_return:
+                if b != fn.nresults or h < b:
+                    fail(f"func {fi} pc {pc} bad return arity")
+                continue
+            if name in ("call", "return_call"):
+                if not (0 <= a < nfuncs):
+                    fail(f"func {fi} pc {pc} call target out of range")
+                cm = img.funcs[a]
+                if h < cm.nparams:
+                    fail(f"func {fi} pc {pc} call underflow")
+                if name == "return_call":
+                    if cm.nresults != fn.nresults:
+                        fail(f"func {fi} pc {pc} tail-call result mismatch")
+                    continue
+                flow(pc + 1, h - cm.nparams + cm.nresults)
+                continue
+            if name in ("call_indirect", "return_call_indirect"):
+                if not (0 <= a < ntypes) or not (0 <= b < ntables):
+                    fail(f"func {fi} pc {pc} call_indirect indices")
+                ft = mod.types[a]
+                if h < 1 + len(ft.params):
+                    fail(f"func {fi} pc {pc} call_indirect underflow")
+                if name == "return_call_indirect":
+                    if len(ft.results) != fn.nresults:
+                        fail(f"func {fi} pc {pc} tail-call result mismatch")
+                    continue
+                flow(pc + 1, h - 1 - len(ft.params) + len(ft.results))
+                continue
+            if name == "unreachable":
+                continue
+
+            # index-operand checks for non-control ops
+            if name in ("local.get", "local.set", "local.tee"):
+                if not (0 <= a < fn.nlocals):
+                    fail(f"func {fi} pc {pc} local index out of range")
+            elif name in ("global.get", "global.set"):
+                if not (0 <= a < nglobals):
+                    fail(f"func {fi} pc {pc} global index out of range")
+            elif name == "ref.func":
+                if not (0 <= a < nfuncs):
+                    fail(f"func {fi} pc {pc} ref.func out of range")
+            elif name in ("table.get", "table.set", "table.size", "table.grow",
+                          "table.fill"):
+                if not (0 <= a < ntables):
+                    fail(f"func {fi} pc {pc} table index out of range")
+            elif name == "table.copy":
+                if not (0 <= a < ntables and 0 <= b < ntables):
+                    fail(f"func {fi} pc {pc} table index out of range")
+            elif name == "table.init":
+                if not (0 <= a < nelems and 0 <= b < ntables):
+                    fail(f"func {fi} pc {pc} table.init indices")
+            elif name == "elem.drop":
+                if not (0 <= a < nelems):
+                    fail(f"func {fi} pc {pc} elem index out of range")
+            elif name in ("memory.init", "data.drop"):
+                if not (0 <= a < ndatas):
+                    fail(f"func {fi} pc {pc} data index out of range")
+            elif name in ("v128.const", "i8x16.shuffle"):
+                if not (0 <= a < nv128):
+                    fail(f"func {fi} pc {pc} v128 const index out of range")
+            if OPCODES[op].imm in ("memarg", "memidx", "memidx2",
+                                   "dataidx_memidx") and nmems < 1:
+                fail(f"func {fi} pc {pc} memory op without memory")
+
+            delta = _STACK_EFFECTS.get(name)
+            if delta is None:
+                if sig is None:
+                    fail(f"func {fi} pc {pc} unverifiable op {name}")
+                npop, npush = (len(s) for s in sig.split("->"))
+            else:
+                npop, npush = delta
+            if h < npop:
+                fail(f"func {fi} pc {pc} operand underflow ({name})")
+            flow(pc + 1, h - npop + npush)
+
+
+# (pops, pushes) for sig-less ops the verifier accepts.
+_STACK_EFFECTS = {
+    "nop": (0, 0),
+    "drop": (1, 0),
+    "select": (3, 1),
+    "select_t": (3, 1),
+    "ref.null": (0, 1),
+    "ref.is_null": (1, 1),
+    "ref.func": (0, 1),
+    "local.get": (0, 1),
+    "local.set": (1, 0),
+    "local.tee": (1, 1),
+    "global.get": (0, 1),
+    "global.set": (1, 0),
+    "table.get": (1, 1),
+    "table.set": (2, 0),
+    "table.grow": (2, 1),
+    "table.fill": (3, 0),
+    "table.copy": (3, 0),
+    "table.init": (3, 0),
+}
 
 
 # -- content-addressed cache (reference: lib/aot/cache.cpp:36-61) -----------

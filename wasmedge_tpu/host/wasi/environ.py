@@ -181,6 +181,16 @@ class WasiEnviron:
         Every intermediate symlink is resolved and re-checked against the
         sandbox root, so `a/../../x` and absolute/rooted symlinks cannot
         break out.
+
+        Known limitation (TOCTOU): the walk is check-then-use over string
+        paths — a component swapped for a symlink between this check and
+        the caller's open() can escape the preopen. The reference walks
+        with per-component openat()-style fds (lib/host/wasi/vinode.cpp);
+        matching that here needs os.open(O_NOFOLLOW|O_DIRECTORY) dir_fd
+        plumbing through every caller. Single-tenant CLI use (trusted
+        host filesystem, untrusted guest) is unaffected; do not rely on
+        this sandbox against an adversary that can mutate the preopened
+        tree concurrently.
         """
         if dirfd_entry.host_path is None:
             raise WasiError(Errno.NOTDIR)

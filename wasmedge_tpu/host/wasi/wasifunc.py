@@ -730,20 +730,30 @@ def poll_oneoff(env: WasiEnviron, mem, in_ptr, out_ptr, nsubs, nevents_ptr):
 
     now_mono = _t.monotonic_ns()
     deadline = None
+    immediate = []  # events for invalid subscriptions, delivered without waiting
     for s in subs:
         if s[0] != "clock":
             continue
-        _, _, clock_id, timeout, flags = s
-        if flags & abi.Subclockflags.ABSTIME:
-            base_now = env.clock_time(clock_id)
-            rel = max(0, timeout - base_now)
-        else:
-            rel = timeout
+        _, userdata, clock_id, timeout, flags = s
+        # A bad clock id fails only this subscription (per-event errno),
+        # not the whole call. Relative waits are computed in the
+        # subscription's own clock domain (ABSTIME: deadline minus that
+        # clock's current reading).
+        try:
+            if flags & abi.Subclockflags.ABSTIME:
+                base_now = env.clock_time(clock_id)
+                rel = max(0, timeout - base_now)
+            else:
+                env.clock_time(clock_id)  # validate the clock id
+                rel = timeout
+        except WasiError as werr:
+            immediate.append(abi.pack_event(userdata, werr.errno,
+                                            abi.Eventtype.CLOCK))
+            continue
         deadline = rel if deadline is None else min(deadline, rel)
 
     rlist, wlist = [], []
     fd_map = {}
-    immediate = []  # events for invalid fds, delivered without waiting
     for s in subs:
         if s[0] != "fd":
             continue
@@ -920,6 +930,10 @@ def sock_recv(env: WasiEnviron, mem, fd, ri_data, ri_data_len, ri_flags,
     if e.sock is None:
         return Errno.NOTSOCK
     vecs = _read_iovs(mem, ri_data & MASK32, ri_data_len & MASK32)
+    # Validate every target iovec before any recv: the guest-controlled
+    # length otherwise sizes a host allocation (mirrors _do_read).
+    for buf, ln in vecs:
+        mem.check_bounds(buf, ln)
     total = 0
     try:
         for buf, ln in vecs:
@@ -945,6 +959,8 @@ def sock_recv_from(env: WasiEnviron, mem, fd, ri_data, ri_data_len,
     if e.sock is None:
         return Errno.NOTSOCK
     vecs = _read_iovs(mem, ri_data & MASK32, ri_data_len & MASK32)
+    for buf, ln in vecs:
+        mem.check_bounds(buf, ln)
     total = 0
     addr = None
     try:

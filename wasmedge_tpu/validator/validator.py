@@ -146,10 +146,14 @@ class Validator:
             if payload is not None:
                 try:
                     img = aot.deserialize_image(payload)
-                    if len(img.funcs) == mod.total_funcs:
-                        mod.lowered = img
-                        mod.validated = True
-                        return mod
+                    # The section rides inside untrusted bytes: structurally
+                    # verify every pc/branch target, index operand, and
+                    # stack-height invariant before trusting it (the engines
+                    # do unchecked indexed access by design).
+                    aot.verify_image(img, mod)
+                    mod.lowered = img
+                    mod.validated = True
+                    return mod
                 except Exception:
                     pass  # fall through to full body validation
 
