@@ -11,11 +11,15 @@ tests/test_batch_parity.py enforce this lane-by-lane).
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 from jax import lax
 
 I32 = jnp.int32
-_SIGN = jnp.int32(-0x80000000)  # 0x80000000 as int32
+# Host-side (numpy) scalars, not device arrays: pallas kernels trace these
+# functions and cannot capture concrete jax Arrays as closure constants.
+_SIGN = np.int32(-0x80000000)  # 0x80000000 as int32
 
 
 def u_lt(a, b):
@@ -39,7 +43,7 @@ def from_f32(f):
     return lax.bitcast_convert_type(f, jnp.int32)
 
 
-F32_CANON_NAN = jnp.int32(0x7FC00000)
+F32_CANON_NAN = np.int32(0x7FC00000)
 
 
 def canon32(bits):

@@ -1,0 +1,1389 @@
+"""Pallas warp-interpreter: the on-device Wasm dispatch loop.
+
+This is the engine SURVEY.md §7 step 4 calls the north star: the moral
+equivalent of the reference's `while (PC != PCEnd) switch (opcode)` hot loop
+(/root/reference/lib/executor/engine/engine.cpp:68-1641), rebuilt as a TPU
+kernel.  The whole fetch→decode→execute loop runs *inside one Pallas kernel
+launch*: code tables live in SMEM (scalar memory), lane state (value stacks,
+globals, linear memory, trap plane) lives in VMEM refs that handlers mutate
+in place, and control state (pc/sp/fp/...) is a scalar `lax.while_loop`
+carry.  One launch retires up to `steps_per_launch` instructions for every
+lane with zero host round-trips, which is what removes the ~400µs/step
+dispatch overhead the pure-XLA engines pay (every XLA step re-threads
+multi-MB state through a conditional).
+
+Execution model (same as batch/uniform.py): lanes are *converged* within a
+lane block — pc/sp/fp/call_depth are block-uniform scalars; per-lane data
+diverges freely.  The lane axis is tiled into grid blocks so that large
+per-lane linear memories still fit VMEM (e.g. 64 KiB/lane × 128 lanes);
+different blocks may take different control paths (each grid program runs
+its own dispatch loop).  A data-dependent branch (or per-lane trap or
+memory fault) that disagrees *within* a block stops that block with
+status=DIVERGED and the host hands the whole batch to the general SIMT
+engine (batch/engine.py).  Handlers that bail on divergence do so *before*
+any ref mutation, so the handed-over state re-executes the divergent
+instruction exactly like uniform.py's functional rewind.
+
+Memory: per-lane linear memory is a word-major [W, lanes] VMEM ref.  Loads
+and stores take a *uniform-address fast path* (row dynamic-slice — converged
+code almost always computes identical addresses in every lane) and, when the
+memory is small enough, fall back to a masked compare-reduce gather/scatter
+over the whole [W, block] array for divergent addresses.
+
+Dispatch is a single flat `lax.switch` over *densely renumbered* handler
+ids: only the handlers a module actually uses are compiled into its kernel,
+so small modules get small, fast-compiling kernels.  Kernels are cached by
+(used-handler set, state geometry); modules sharing both share a compile.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+from wasmedge_tpu.common.errors import ErrCode
+from wasmedge_tpu.batch.image import (
+    ALU1_SUB,
+    ALU2_F32_BASE,
+    ALU2_I32_BASE,
+    ALU2_I64_BASE,
+    CLS_ALU1,
+    CLS_ALU2,
+    CLS_BR,
+    CLS_BR_TABLE,
+    CLS_BRNZ,
+    CLS_BRZ,
+    CLS_CALL,
+    CLS_CALL_INDIRECT,
+    CLS_CONST,
+    CLS_DROP,
+    CLS_GLOBAL_GET,
+    CLS_GLOBAL_SET,
+    CLS_LOAD,
+    CLS_LOCAL_GET,
+    CLS_LOCAL_SET,
+    CLS_LOCAL_TEE,
+    CLS_MEMGROW,
+    CLS_MEMSIZE,
+    CLS_NOP,
+    CLS_RETURN,
+    CLS_SELECT,
+    CLS_STORE,
+    CLS_TRAP,
+    DeviceImage,
+    TRAP_DONE,
+    _F32_BIN,
+    _I32_BIN,
+)
+
+# ---------------------------------------------------------------------------
+# Flat handler-id space (before per-module dense renumbering)
+# ---------------------------------------------------------------------------
+H_NOP = 0
+H_CONST = 1
+H_LOCAL_GET = 2
+H_LOCAL_SET = 3
+H_LOCAL_TEE = 4
+H_GLOBAL_GET = 5
+H_GLOBAL_SET = 6
+H_DROP = 7
+H_SELECT = 8
+H_BR = 9
+H_BRZ = 10
+H_BRNZ = 11
+H_BR_TABLE = 12
+H_RETURN = 13
+H_CALL = 14
+H_CALL_INDIRECT = 15
+H_MEMSIZE = 16
+H_MEMGROW = 17
+H_TRAP = 18
+H_LOAD = 19
+H_STORE = 20
+H_ALU2_BASE = 21                      # + sub (63 subs)
+H_ALU1_BASE = H_ALU2_BASE + 63        # + sub (32 subs)
+NUM_HANDLERS = H_ALU1_BASE + 32
+
+_CLS_TO_HID = {
+    CLS_NOP: H_NOP, CLS_CONST: H_CONST, CLS_LOCAL_GET: H_LOCAL_GET,
+    CLS_LOCAL_SET: H_LOCAL_SET, CLS_LOCAL_TEE: H_LOCAL_TEE,
+    CLS_GLOBAL_GET: H_GLOBAL_GET, CLS_GLOBAL_SET: H_GLOBAL_SET,
+    CLS_DROP: H_DROP, CLS_SELECT: H_SELECT, CLS_BR: H_BR, CLS_BRZ: H_BRZ,
+    CLS_BRNZ: H_BRNZ, CLS_BR_TABLE: H_BR_TABLE, CLS_RETURN: H_RETURN,
+    CLS_CALL: H_CALL, CLS_CALL_INDIRECT: H_CALL_INDIRECT,
+    CLS_MEMSIZE: H_MEMSIZE, CLS_MEMGROW: H_MEMGROW, CLS_TRAP: H_TRAP,
+    CLS_LOAD: H_LOAD, CLS_STORE: H_STORE,
+}
+
+# status values (shared with batch/uniform.py)
+ST_RUNNING = 0
+ST_DONE = 1
+ST_DIVERGED = 2
+ST_TRAPPED_BASE = 16
+
+_PAGE_WORDS = 65536 // 4
+
+# ctrl row layout (SMEM, int32[nblk, 16])
+_C_PC, _C_SP, _C_FP, _C_OB, _C_CD, _C_STATUS, _C_PAGES, _C_CHUNK = range(8)
+_C_STEPS = 8
+
+
+def merge_block_status_into_trap(trap_v: np.ndarray, ctrl: np.ndarray,
+                                 Lblk: int) -> np.ndarray:
+    """Fold per-block exit status into the per-lane trap plane:
+    DONE blocks -> TRAP_DONE sentinel, trapped blocks -> their code on
+    lanes that have no more specific per-lane code yet."""
+    for b in range(ctrl.shape[0]):
+        status = int(ctrl[b, _C_STATUS])
+        sl = slice(b * Lblk, (b + 1) * Lblk)
+        if status == ST_DONE:
+            trap_v[sl] = TRAP_DONE
+        elif status >= ST_TRAPPED_BASE:
+            seg = trap_v[sl]
+            seg[seg == 0] = status - ST_TRAPPED_BASE
+            trap_v[sl] = seg
+    return trap_v
+
+
+def decode_result_rows(stack_lo: np.ndarray, stack_hi: np.ndarray,
+                       nres: int):
+    """Reassemble 64-bit result cells from the lo/hi int32 planes."""
+    results = []
+    for r in range(nres):
+        lo = stack_lo[r].view(np.uint32).astype(np.uint64)
+        hi = stack_hi[r].view(np.uint32).astype(np.uint64)
+        results.append((lo | (hi << np.uint64(32))).view(np.int64))
+    return results
+
+
+def hid_plane(img: DeviceImage) -> np.ndarray:
+    """Per-pc flat handler id from the (class, sub) encoding."""
+    hid = np.zeros(img.code_len, np.int32)
+    for pc in range(img.code_len):
+        c = int(img.cls[pc])
+        if c == CLS_ALU2:
+            hid[pc] = H_ALU2_BASE + int(img.sub[pc])
+        elif c == CLS_ALU1:
+            hid[pc] = H_ALU1_BASE + int(img.sub[pc])
+        else:
+            hid[pc] = _CLS_TO_HID[c]
+    return hid
+
+
+def _alu2_fns(lo_ops, jnp, lax):
+    """sub -> (xl, xh, yl, yh) -> (rl, rh); indexed by ALU2 sub id.
+
+    Semantics mirror batch/uniform.py:_alu_result, which mirrors the
+    reference's binary_numeric.ipp kernels."""
+    I32 = jnp.int32
+    b2i = lo_ops.b2i
+    u_lt = lo_ops.u_lt
+
+    def z_of(x):
+        return jnp.zeros_like(x)
+
+    fns = {}
+
+    def i32op(name, fn):
+        fns[ALU2_I32_BASE + _I32_BIN.index(name)] = fn
+
+    def i64op(name, fn):
+        fns[ALU2_I64_BASE + _I32_BIN.index(name)] = fn
+
+    def f32op(name, fn):
+        fns[ALU2_F32_BASE + _F32_BIN.index(name)] = fn
+
+    i32op("add", lambda xl, xh, yl, yh: (xl + yl, z_of(xl)))
+    i32op("sub", lambda xl, xh, yl, yh: (xl - yl, z_of(xl)))
+    i32op("mul", lambda xl, xh, yl, yh: (xl * yl, z_of(xl)))
+    i32op("div_s", lambda xl, xh, yl, yh: (
+        lax.div(xl, jnp.where(yl == 0, I32(1), yl)), z_of(xl)))
+    i32op("div_u", lambda xl, xh, yl, yh: (
+        lax.div(xl.astype(jnp.uint32),
+                jnp.where(yl == 0, I32(1), yl).astype(jnp.uint32)).astype(I32),
+        z_of(xl)))
+    i32op("rem_s", lambda xl, xh, yl, yh: (
+        lax.rem(xl, jnp.where(yl == 0, I32(1), yl)), z_of(xl)))
+    i32op("rem_u", lambda xl, xh, yl, yh: (
+        lax.rem(xl.astype(jnp.uint32),
+                jnp.where(yl == 0, I32(1), yl).astype(jnp.uint32)).astype(I32),
+        z_of(xl)))
+    i32op("and", lambda xl, xh, yl, yh: (xl & yl, z_of(xl)))
+    i32op("or", lambda xl, xh, yl, yh: (xl | yl, z_of(xl)))
+    i32op("xor", lambda xl, xh, yl, yh: (xl ^ yl, z_of(xl)))
+    i32op("shl", lambda xl, xh, yl, yh: (lax.shift_left(xl, yl & 31), z_of(xl)))
+    i32op("shr_s", lambda xl, xh, yl, yh: (
+        lax.shift_right_arithmetic(xl, yl & 31), z_of(xl)))
+    i32op("shr_u", lambda xl, xh, yl, yh: (
+        lax.shift_right_logical(xl, yl & 31), z_of(xl)))
+    i32op("rotl", lambda xl, xh, yl, yh: (lo_ops.rotl32(xl, yl), z_of(xl)))
+    i32op("rotr", lambda xl, xh, yl, yh: (
+        lo_ops.rotl32(xl, (32 - (yl & 31)) & 31), z_of(xl)))
+    i32op("eq", lambda xl, xh, yl, yh: (b2i(xl == yl), z_of(xl)))
+    i32op("ne", lambda xl, xh, yl, yh: (b2i(xl != yl), z_of(xl)))
+    i32op("lt_s", lambda xl, xh, yl, yh: (b2i(xl < yl), z_of(xl)))
+    i32op("lt_u", lambda xl, xh, yl, yh: (b2i(u_lt(xl, yl)), z_of(xl)))
+    i32op("gt_s", lambda xl, xh, yl, yh: (b2i(xl > yl), z_of(xl)))
+    i32op("gt_u", lambda xl, xh, yl, yh: (b2i(u_lt(yl, xl)), z_of(xl)))
+    i32op("le_s", lambda xl, xh, yl, yh: (b2i(xl <= yl), z_of(xl)))
+    i32op("le_u", lambda xl, xh, yl, yh: (b2i(lo_ops.u_le(xl, yl)), z_of(xl)))
+    i32op("ge_s", lambda xl, xh, yl, yh: (b2i(xl >= yl), z_of(xl)))
+    i32op("ge_u", lambda xl, xh, yl, yh: (b2i(lo_ops.u_le(yl, xl)), z_of(xl)))
+
+    i64op("add", lambda xl, xh, yl, yh: lo_ops.add64(xl, xh, yl, yh))
+    i64op("sub", lambda xl, xh, yl, yh: lo_ops.sub64(xl, xh, yl, yh))
+    i64op("mul", lambda xl, xh, yl, yh: lo_ops.mul64(xl, xh, yl, yh))
+
+    def div64(kind):
+        def fn(xl, xh, yl, yh):
+            glo = jnp.where((yl | yh) == 0, I32(1), yl)
+            ghi = jnp.where((yl | yh) == 0, I32(0), yh)
+            if kind.endswith("_u"):
+                qlo, qhi, rlo, rhi = lo_ops.divmod64_u(xl, xh, glo, ghi)
+            else:
+                qlo, qhi, rlo, rhi = lo_ops.div64_s(xl, xh, glo, ghi)
+            return (qlo, qhi) if kind.startswith("div") else (rlo, rhi)
+        return fn
+
+    for kind in ("div_s", "div_u", "rem_s", "rem_u"):
+        i64op(kind, div64(kind))
+    i64op("and", lambda xl, xh, yl, yh: (xl & yl, xh & yh))
+    i64op("or", lambda xl, xh, yl, yh: (xl | yl, xh | yh))
+    i64op("xor", lambda xl, xh, yl, yh: (xl ^ yl, xh ^ yh))
+    i64op("shl", lambda xl, xh, yl, yh: lo_ops.shl64(xl, xh, yl & 63))
+    i64op("shr_s", lambda xl, xh, yl, yh: lo_ops.shr64_s(xl, xh, yl & 63))
+    i64op("shr_u", lambda xl, xh, yl, yh: lo_ops.shr64_u(xl, xh, yl & 63))
+    i64op("rotl", lambda xl, xh, yl, yh: lo_ops.rotl64(xl, xh, yl & 63))
+    i64op("rotr", lambda xl, xh, yl, yh: lo_ops.rotr64(xl, xh, yl & 63))
+    i64op("eq", lambda xl, xh, yl, yh: (b2i(lo_ops.eq64(xl, xh, yl, yh)), z_of(xl)))
+    i64op("ne", lambda xl, xh, yl, yh: (b2i(~lo_ops.eq64(xl, xh, yl, yh)), z_of(xl)))
+    i64op("lt_s", lambda xl, xh, yl, yh: (b2i(lo_ops.lt64_s(xl, xh, yl, yh)), z_of(xl)))
+    i64op("lt_u", lambda xl, xh, yl, yh: (b2i(lo_ops.lt64_u(xl, xh, yl, yh)), z_of(xl)))
+    i64op("gt_s", lambda xl, xh, yl, yh: (b2i(lo_ops.lt64_s(yl, yh, xl, xh)), z_of(xl)))
+    i64op("gt_u", lambda xl, xh, yl, yh: (b2i(lo_ops.lt64_u(yl, yh, xl, xh)), z_of(xl)))
+    i64op("le_s", lambda xl, xh, yl, yh: (b2i(~lo_ops.lt64_s(yl, yh, xl, xh)), z_of(xl)))
+    i64op("le_u", lambda xl, xh, yl, yh: (b2i(~lo_ops.lt64_u(yl, yh, xl, xh)), z_of(xl)))
+    i64op("ge_s", lambda xl, xh, yl, yh: (b2i(~lo_ops.lt64_s(xl, xh, yl, yh)), z_of(xl)))
+    i64op("ge_u", lambda xl, xh, yl, yh: (b2i(~lo_ops.lt64_u(xl, xh, yl, yh)), z_of(xl)))
+
+    def fbin(op):
+        def fn(xl, xh, yl, yh):
+            fx, fy = lo_ops.to_f32(xl), lo_ops.to_f32(yl)
+            return (lo_ops.canon32(lo_ops.from_f32(op(fx, fy))), z_of(xl))
+        return fn
+
+    f32op("add", fbin(lambda a, b: a + b))
+    f32op("sub", fbin(lambda a, b: a - b))
+    f32op("mul", fbin(lambda a, b: a * b))
+    f32op("div", fbin(lambda a, b: a / b))
+    f32op("min", lambda xl, xh, yl, yh: (lo_ops.f32_min(xl, yl), z_of(xl)))
+    f32op("max", lambda xl, xh, yl, yh: (lo_ops.f32_max(xl, yl), z_of(xl)))
+    f32op("copysign", lambda xl, xh, yl, yh: (
+        (xl & jnp.int32(0x7FFFFFFF)) | (yl & lo_ops._SIGN), z_of(xl)))
+
+    def fcmp(which):
+        def fn(xl, xh, yl, yh):
+            feq = lo_ops.f32_cmp_eq(xl, yl)
+            flt = lo_ops.f32_cmp_lt(xl, yl)
+            fgt = lo_ops.f32_cmp_lt(yl, xl)
+            fnan = lo_ops.is_nan32(xl) | lo_ops.is_nan32(yl)
+            v = {"eq": feq, "ne": ~feq, "lt": flt, "gt": fgt,
+                 "le": (flt | feq) & ~fnan, "ge": (fgt | feq) & ~fnan}[which]
+            return (b2i(v), z_of(xl))
+        return fn
+
+    for which in ("eq", "ne", "lt", "gt", "le", "ge"):
+        f32op(which, fcmp(which))
+    return fns
+
+
+def _alu1_fns(lo_ops, jnp, lax):
+    """sub -> (wl, wh) -> (rl, rh); indexed by ALU1 sub id."""
+    I32 = jnp.int32
+    b2i = lo_ops.b2i
+    A1 = ALU1_SUB
+
+    def z_of(x):
+        return jnp.zeros_like(x)
+
+    def sext8(wl):
+        return lax.shift_right_arithmetic(lax.shift_left(wl, 24), 24)
+
+    def sext16(wl):
+        return lax.shift_right_arithmetic(lax.shift_left(wl, 16), 16)
+
+    def trunc_core(wl):
+        fw = lo_ops.to_f32(wl)
+        return jnp.where(fw < 0, lax.ceil(fw), lax.floor(fw))
+
+    def trunc_s(wl):
+        tr = trunc_core(wl)
+        nan = lo_ops.is_nan32(wl)
+        in_s = (tr >= jnp.float32(-2147483648.0)) & \
+            (tr <= jnp.float32(2147483520.0))
+        return jnp.where(in_s & ~nan, tr, jnp.float32(0)).astype(I32)
+
+    def trunc_u(wl):
+        tr = trunc_core(wl)
+        nan = lo_ops.is_nan32(wl)
+        in_u = (tr >= 0) & (tr <= jnp.float32(4294967040.0))
+        t = jnp.where(in_u & ~nan, tr, jnp.float32(0))
+        return jnp.where(t >= jnp.float32(2147483648.0),
+                         (t - jnp.float32(4294967296.0)).astype(I32),
+                         t.astype(I32))
+
+    def sat_s(wl):
+        tr = trunc_core(wl)
+        nan = lo_ops.is_nan32(wl)
+        return jnp.where(
+            nan, 0,
+            jnp.where(tr < jnp.float32(-2147483648.0), jnp.int32(-0x80000000),
+                      jnp.where(tr > jnp.float32(2147483520.0),
+                                jnp.int32(0x7FFFFFFF), trunc_s(wl))))
+
+    def sat_u(wl):
+        tr = trunc_core(wl)
+        nan = lo_ops.is_nan32(wl)
+        return jnp.where(nan | (tr < 0), 0,
+                         jnp.where(tr > jnp.float32(4294967040.0),
+                                   jnp.int32(-1), trunc_u(wl)))
+
+    return {
+        A1["i32.clz"]: lambda wl, wh: (lax.clz(wl), z_of(wl)),
+        A1["i32.ctz"]: lambda wl, wh: (lo_ops.ctz32(wl), z_of(wl)),
+        A1["i32.popcnt"]: lambda wl, wh: (lax.population_count(wl), z_of(wl)),
+        A1["i32.eqz"]: lambda wl, wh: (b2i(wl == 0), z_of(wl)),
+        A1["i32.extend8_s"]: lambda wl, wh: (sext8(wl), z_of(wl)),
+        A1["i32.extend16_s"]: lambda wl, wh: (sext16(wl), z_of(wl)),
+        A1["i64.clz"]: lambda wl, wh: (lo_ops.clz64(wl, wh), z_of(wl)),
+        A1["i64.ctz"]: lambda wl, wh: (lo_ops.ctz64(wl, wh), z_of(wl)),
+        A1["i64.popcnt"]: lambda wl, wh: (lo_ops.popcnt64(wl, wh), z_of(wl)),
+        A1["i64.eqz"]: lambda wl, wh: (b2i((wl | wh) == 0), z_of(wl)),
+        A1["i64.extend8_s"]: lambda wl, wh: (
+            sext8(wl), lax.shift_right_arithmetic(sext8(wl), 31)),
+        A1["i64.extend16_s"]: lambda wl, wh: (
+            sext16(wl), lax.shift_right_arithmetic(sext16(wl), 31)),
+        A1["i64.extend32_s"]: lambda wl, wh: (
+            wl, lax.shift_right_arithmetic(wl, 31)),
+        A1["f32.abs"]: lambda wl, wh: (wl & jnp.int32(0x7FFFFFFF), z_of(wl)),
+        A1["f32.neg"]: lambda wl, wh: (wl ^ lo_ops._SIGN, z_of(wl)),
+        A1["f32.ceil"]: lambda wl, wh: (
+            lo_ops.canon32(lo_ops.from_f32(lax.ceil(lo_ops.to_f32(wl)))),
+            z_of(wl)),
+        A1["f32.floor"]: lambda wl, wh: (
+            lo_ops.canon32(lo_ops.from_f32(lax.floor(lo_ops.to_f32(wl)))),
+            z_of(wl)),
+        A1["f32.trunc"]: lambda wl, wh: (lo_ops.f32_trunc(wl), z_of(wl)),
+        A1["f32.nearest"]: lambda wl, wh: (lo_ops.f32_nearest(wl), z_of(wl)),
+        A1["f32.sqrt"]: lambda wl, wh: (
+            lo_ops.canon32(lo_ops.from_f32(lax.sqrt(lo_ops.to_f32(wl)))),
+            z_of(wl)),
+        A1["i32.wrap_i64"]: lambda wl, wh: (wl, z_of(wl)),
+        A1["i64.extend_i32_s"]: lambda wl, wh: (
+            wl, lax.shift_right_arithmetic(wl, 31)),
+        A1["i64.extend_i32_u"]: lambda wl, wh: (wl, z_of(wl)),
+        A1["i32.trunc_f32_s"]: lambda wl, wh: (trunc_s(wl), z_of(wl)),
+        A1["i32.trunc_f32_u"]: lambda wl, wh: (trunc_u(wl), z_of(wl)),
+        A1["i32.trunc_sat_f32_s"]: lambda wl, wh: (sat_s(wl), z_of(wl)),
+        A1["i32.trunc_sat_f32_u"]: lambda wl, wh: (sat_u(wl), z_of(wl)),
+        A1["f32.convert_i32_s"]: lambda wl, wh: (
+            lo_ops.from_f32(wl.astype(jnp.float32)), z_of(wl)),
+        A1["f32.convert_i32_u"]: lambda wl, wh: (
+            lo_ops.from_f32(wl.astype(jnp.uint32).astype(jnp.float32)),
+            z_of(wl)),
+        A1["i32.reinterpret_f32"]: lambda wl, wh: (wl, z_of(wl)),
+        A1["f32.reinterpret_i32"]: lambda wl, wh: (wl, z_of(wl)),
+        A1["ref.is_null"]: lambda wl, wh: (b2i((wl | wh) == 0), z_of(wl)),
+    }
+
+
+# ALU2 subs that can trap (div/rem)
+_DIV32_SUBS = {ALU2_I32_BASE + _I32_BIN.index(n) for n in
+               ("div_s", "div_u", "rem_s", "rem_u")}
+_DIV64_SUBS = {ALU2_I64_BASE + _I32_BIN.index(n) for n in
+               ("div_s", "div_u", "rem_s", "rem_u")}
+_DIVS_SUBS = {ALU2_I32_BASE + _I32_BIN.index("div_s"),
+              ALU2_I64_BASE + _I32_BIN.index("div_s")}
+# ALU1 subs that can trap (non-sat float->int truncation)
+_TRUNC_TRAP_SUBS = {ALU1_SUB["i32.trunc_f32_s"], ALU1_SUB["i32.trunc_f32_u"]}
+
+
+@functools.lru_cache(maxsize=64)
+def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
+                  Lblk: int, NG: int, code_len: int, nf: int, tsize: int,
+                  max_local_zeros: int, mem_pages_cap: int,
+                  gatherable: bool, interpret: bool):
+    """Compile the chunk-runner for one kernel geometry.
+
+    Returns a jitted callable over
+      (hid, a, b, c, ilo, ihi, fent, fnpar, fnloc, ftop, ftyp, brt, tbl,
+       ctrl, frames, stack_lo, stack_hi, glob_lo, glob_hi, mem, trap)
+    yielding (ctrl, frames, stack_lo, stack_hi, glob_lo, glob_hi, mem,
+    trap); the VMEM planes are aliased in-place."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    I32 = jnp.int32
+    u_lt = lo_ops.u_lt
+    alu2 = _alu2_fns(lo_ops, jnp, lax)
+    alu1 = _alu1_fns(lo_ops, jnp, lax)
+    nblk = L // Lblk
+    NGp = max(NG, 1)
+    # Divergent-address memory ops scan memory in row chunks so the scan
+    # temporaries stay bounded (~512 KiB) instead of materializing a full
+    # [W, Lblk] iota next to the state.
+    GR = W
+    while GR > 8 and GR * Lblk * 4 > 512 * 1024:
+        GR //= 2
+    while GR > 8 and W % GR != 0:
+        GR //= 2
+    GATHER_CHUNKS = W // GR if W % GR == 0 else 0
+
+    def kernel(hid_r, a_r, b_r, c_r, ilo_r, ihi_r,
+               fent_r, fnpar_r, fnloc_r, ftop_r, ftyp_r, brt_r, tbl_r,
+               ctrl_r, frames_in,
+               s_lo_in, s_hi_in, g_lo_in, g_hi_in, mem_in, trap_in,
+               ctrl_out, frames_out,
+               s_lo_out, s_hi_out, g_lo_out, g_hi_out, mem_out, trap_out,
+               slo, shi, glo, ghi, memr, trapr, sems):
+        blk = pl.program_id(0)
+        lo = blk * Lblk
+
+        # State planes live in HBM (pl.ANY); the working copy is VMEM
+        # scratch, DMA'd in per lane block and DMA'd back at the end.
+        # Keeping VMEM usage at 1x state size (no separate input/output
+        # windows, no automatic double buffering) is what lets a
+        # memory-free module run all lanes in a single block.
+        def dma(i, src, dst):
+            return pltpu.make_async_copy(src, dst, sems.at[i])
+
+        ins = [dma(0, s_lo_in.at[:, pl.ds(lo, Lblk)], slo),
+               dma(1, s_hi_in.at[:, pl.ds(lo, Lblk)], shi),
+               dma(2, g_lo_in.at[:, pl.ds(lo, Lblk)], glo),
+               dma(3, g_hi_in.at[:, pl.ds(lo, Lblk)], ghi),
+               dma(4, mem_in.at[:, pl.ds(lo, Lblk)], memr),
+               dma(5, trap_in.at[:, pl.ds(lo, Lblk)], trapr)]
+        for c in ins:
+            c.start()
+        for c in ins:
+            c.wait()
+
+        # frames: whole-array SMEM refs [nblk, 3, CD]; each grid program
+        # copies and mutates only its own block's rows.
+        def cp_frame(i, _):
+            frames_out[blk, 0, i] = frames_in[blk, 0, i]
+            frames_out[blk, 1, i] = frames_in[blk, 1, i]
+            frames_out[blk, 2, i] = frames_in[blk, 2, i]
+            return 0
+
+        lax.fori_loop(0, CD, cp_frame, 0)
+
+        chunk = ctrl_r[blk, _C_CHUNK]
+
+        def full(v):
+            return jnp.full((1, Lblk), v, I32)
+
+        def srow(ref, i):
+            return ref[pl.ds(i, 1), :]
+
+        def wrow(ref, i, v):
+            ref[pl.ds(i, 1), :] = v
+
+        def scal(vec):
+            return vec[0, 0]
+
+        def allsame(vec, s):
+            return jnp.all(vec == s)
+
+        # carry: (steps, pc, sp, fp, ob, cd, pages, status)
+        def keep(c, **kw):
+            d = dict(steps=c[0], pc=c[1], sp=c[2], fp=c[3], ob=c[4],
+                     cd=c[5], pages=c[6], status=c[7])
+            d.update(kw)
+            return (d["steps"], d["pc"], d["sp"], d["fp"], d["ob"],
+                    d["cd"], d["pages"], d["status"])
+
+        # ------------------- handlers ---------------------------------
+        def h_nop(c):
+            return keep(c, pc=c[1] + 1)
+
+        def h_const(c):
+            pc, sp = c[1], c[2]
+            wrow(slo, sp, full(ilo_r[pc]))
+            wrow(shi, sp, full(ihi_r[pc]))
+            return keep(c, pc=pc + 1, sp=sp + 1)
+
+        def h_local_get(c):
+            pc, sp, fp = c[1], c[2], c[3]
+            src = fp + a_r[pc]
+            wrow(slo, sp, srow(slo, src))
+            wrow(shi, sp, srow(shi, src))
+            return keep(c, pc=pc + 1, sp=sp + 1)
+
+        def h_local_set(c):
+            pc, sp, fp = c[1], c[2], c[3]
+            dst = fp + a_r[pc]
+            wrow(slo, dst, srow(slo, sp - 1))
+            wrow(shi, dst, srow(shi, sp - 1))
+            return keep(c, pc=pc + 1, sp=sp - 1)
+
+        def h_local_tee(c):
+            pc, sp, fp = c[1], c[2], c[3]
+            dst = fp + a_r[pc]
+            wrow(slo, dst, srow(slo, sp - 1))
+            wrow(shi, dst, srow(shi, sp - 1))
+            return keep(c, pc=pc + 1)
+
+        def h_global_get(c):
+            pc, sp = c[1], c[2]
+            g = a_r[pc]
+            wrow(slo, sp, srow(glo, g))
+            wrow(shi, sp, srow(ghi, g))
+            return keep(c, pc=pc + 1, sp=sp + 1)
+
+        def h_global_set(c):
+            pc, sp = c[1], c[2]
+            g = a_r[pc]
+            wrow(glo, g, srow(slo, sp - 1))
+            wrow(ghi, g, srow(shi, sp - 1))
+            return keep(c, pc=pc + 1, sp=sp - 1)
+
+        def h_drop(c):
+            return keep(c, pc=c[1] + 1, sp=c[2] - 1)
+
+        def h_select(c):
+            pc, sp = c[1], c[2]
+            cond = srow(slo, sp - 1)
+            v1l, v1h = srow(slo, sp - 2), srow(shi, sp - 2)
+            v2l, v2h = srow(slo, sp - 3), srow(shi, sp - 3)
+            wrow(slo, sp - 3, jnp.where(cond == 0, v1l, v2l))
+            wrow(shi, sp - 3, jnp.where(cond == 0, v1h, v2h))
+            return keep(c, pc=pc + 1, sp=sp - 2)
+
+        def h_br(c):
+            pc, sp, ob = c[1], c[2], c[4]
+            tgt, nkeep, pop_to = a_r[pc], b_r[pc], c_r[pc]
+            tgt_sp = ob + pop_to
+
+            @pl.when(nkeep == 1)
+            def _():
+                wrow(slo, tgt_sp, srow(slo, sp - 1))
+                wrow(shi, tgt_sp, srow(shi, sp - 1))
+
+            return keep(c, pc=tgt, sp=tgt_sp + nkeep)
+
+        def h_brz(c):
+            pc, sp = c[1], c[2]
+            cond = srow(slo, sp - 1)
+            t0 = scal(cond)
+            agree = allsame(cond, t0)
+            new_pc = jnp.where(t0 == 0, a_r[pc], pc + 1)
+            return lax.cond(
+                agree,
+                lambda: keep(c, pc=new_pc, sp=sp - 1),
+                lambda: keep(c, status=I32(ST_DIVERGED)))
+
+        def h_brnz(c):
+            pc, sp, ob = c[1], c[2], c[4]
+            cond = srow(slo, sp - 1)
+            t0 = scal(cond)
+            agree = allsame(cond, t0)
+            tgt, nkeep, pop_to = a_r[pc], b_r[pc], c_r[pc]
+            tgt_sp = ob + pop_to
+            taken = t0 != 0
+
+            @pl.when(agree & taken & (nkeep == 1))
+            def _():
+                wrow(slo, tgt_sp, srow(slo, sp - 2))
+                wrow(shi, tgt_sp, srow(shi, sp - 2))
+
+            return lax.cond(
+                agree,
+                lambda: lax.cond(
+                    taken,
+                    lambda: keep(c, pc=tgt, sp=tgt_sp + nkeep),
+                    lambda: keep(c, pc=pc + 1, sp=sp - 1)),
+                lambda: keep(c, status=I32(ST_DIVERGED)))
+
+        def h_br_table(c):
+            pc, sp, ob = c[1], c[2], c[4]
+            idx = srow(slo, sp - 1)
+            i0 = scal(idx)
+            agree = allsame(idx, i0)
+            base, n = a_r[pc], b_r[pc]
+            ii = jnp.where(u_lt(n, i0), n, i0)
+            e = (base + ii) * 3
+            tgt, nkeep, pop_to = brt_r[e], brt_r[e + 1], brt_r[e + 2]
+            tgt_sp = ob + pop_to
+
+            @pl.when(agree & (nkeep == 1))
+            def _():
+                wrow(slo, tgt_sp, srow(slo, sp - 2))
+                wrow(shi, tgt_sp, srow(shi, sp - 2))
+
+            return lax.cond(
+                agree,
+                lambda: keep(c, pc=tgt, sp=tgt_sp + nkeep),
+                lambda: keep(c, status=I32(ST_DIVERGED)))
+
+        def h_return(c):
+            pc, sp, fp, cd = c[1], c[2], c[3], c[5]
+            nres = b_r[pc]
+
+            @pl.when(nres == 1)
+            def _():
+                wrow(slo, fp, srow(slo, sp - 1))
+                wrow(shi, fp, srow(shi, sp - 1))
+
+            new_sp = fp + nres
+            rd = jnp.clip(cd - 1, 0, CD - 1)
+            return lax.cond(
+                cd == 0,
+                lambda: keep(c, sp=new_sp, status=I32(ST_DONE)),
+                lambda: keep(c, pc=frames_out[blk, 0, rd], sp=new_sp,
+                             fp=frames_out[blk, 1, rd],
+                             ob=frames_out[blk, 2, rd], cd=cd - 1))
+
+        def _do_call(c, callee, sp_eff):
+            pc, fp, ob, cd = c[1], c[3], c[4], c[5]
+            nargs = fnpar_r[callee]
+            nloc = fnloc_r[callee]
+            ftop = ftop_r[callee]
+            fp_new = sp_eff - nargs
+            ob_new = fp_new + nloc
+            ovf = (cd >= CD - 1) | (fp_new + ftop > D)
+
+            def trap_fn():
+                code = jnp.where(cd >= CD - 1,
+                                 I32(int(ErrCode.CallStackExhausted)),
+                                 I32(int(ErrCode.StackOverflow)))
+                trapr[0, :] = jnp.full((Lblk,), code, I32)
+                return keep(c, status=I32(ST_TRAPPED_BASE) + code)
+
+            def go_fn():
+                slot = jnp.clip(cd, 0, CD - 1)
+                frames_out[blk, 0, slot] = pc + 1
+                frames_out[blk, 1, slot] = fp
+                frames_out[blk, 2, slot] = ob
+                zrow = jnp.zeros((1, Lblk), I32)
+                for k in range(max_local_zeros):
+                    @pl.when(k < (nloc - nargs))
+                    def _(k=k):
+                        wrow(slo, fp_new + nargs + k, zrow)
+                        wrow(shi, fp_new + nargs + k, zrow)
+                return keep(c, pc=fent_r[callee], sp=ob_new, fp=fp_new,
+                            ob=ob_new, cd=cd + 1)
+
+            return lax.cond(ovf, trap_fn, go_fn)
+
+        def h_call(c):
+            return _do_call(c, a_r[c[1]], c[2])
+
+        def h_call_indirect(c):
+            pc, sp = c[1], c[2]
+            idx = srow(slo, sp - 1)
+            i0 = scal(idx)
+            agree = allsame(idx, i0)
+            oob = u_lt(I32(tsize - 1), i0) | (i0 < 0)
+            h = tbl_r[jnp.clip(i0, 0, tsize - 1)]
+            null = h == 0
+            callee = jnp.clip(h - 1, 0, nf - 1)
+            sig_bad = ftyp_r[callee] != a_r[pc]
+
+            def bad():
+                code = jnp.where(
+                    oob, I32(int(ErrCode.UndefinedElement)),
+                    jnp.where(null, I32(int(ErrCode.UninitializedElement)),
+                              I32(int(ErrCode.IndirectCallTypeMismatch))))
+                trapr[0, :] = jnp.full((Lblk,), code, I32)
+                return keep(c, status=I32(ST_TRAPPED_BASE) + code)
+
+            return lax.cond(
+                agree,
+                lambda: lax.cond(
+                    oob | null | sig_bad, bad,
+                    lambda: _do_call(keep(c, sp=sp - 1), callee, sp - 1)),
+                lambda: keep(c, status=I32(ST_DIVERGED)))
+
+        def h_memsize(c):
+            pc, sp, pages = c[1], c[2], c[6]
+            wrow(slo, sp, full(pages))
+            wrow(shi, sp, full(0))
+            return keep(c, pc=pc + 1, sp=sp + 1)
+
+        def h_memgrow(c):
+            pc, sp, pages = c[1], c[2], c[6]
+            delta = srow(slo, sp - 1)
+            d0 = scal(delta)
+            agree = allsame(delta, d0)
+            ok = (d0 >= 0) & ((pages + d0) <= mem_pages_cap) & \
+                ((pages + d0) >= pages)
+            res = jnp.where(ok, pages, I32(-1))
+
+            @pl.when(agree)
+            def _():
+                wrow(slo, sp - 1, full(res))
+                wrow(shi, sp - 1, full(0))
+
+            return lax.cond(
+                agree,
+                lambda: keep(c, pc=pc + 1,
+                             pages=jnp.where(ok, pages + d0, pages)),
+                lambda: keep(c, status=I32(ST_DIVERGED)))
+
+        def h_trap(c):
+            code = a_r[c[1]]
+            trapr[0, :] = jnp.full((Lblk,), code, I32)
+            return keep(c, status=I32(ST_TRAPPED_BASE) + code)
+
+        # ---- memory access ------------------------------------------
+        def _gather_word(widx):
+            """Per-lane word gather from [W, Lblk] by chunked
+            compare-reduce: exactly one iota row matches each lane's
+            index, so the running sum collapses to that lane's word."""
+            def chunk(i, acc):
+                base = i * GR
+                rows = memr[pl.ds(base, GR), :]
+                wi = base + jax.lax.broadcasted_iota(I32, (GR, Lblk), 0)
+                return acc + jnp.sum(jnp.where(wi == widx, rows, 0),
+                                     axis=0, keepdims=True)
+
+            return lax.fori_loop(0, GATHER_CHUNKS, chunk,
+                                 jnp.zeros((1, Lblk), I32))
+
+        def h_load(c):
+            pc, sp, pages = c[1], c[2], c[6]
+            off, nbytes, flags = a_r[pc], b_r[pc], c_r[pc]
+            addr = srow(slo, sp - 1)
+            ea = addr + off
+            carry_ = u_lt(ea, addr) | u_lt(ea, full(off))
+            mem_bytes = pages * I32(65536)
+            end = ea + nbytes
+            oob = carry_ | u_lt(end, ea) | u_lt(full(mem_bytes), end)
+            widx = jnp.clip(lax.shift_right_logical(ea, 2), 0, W - 1)
+            shB = (ea & 3) * 8
+            u0 = scal(widx)
+            uni = allsame(widx, u0) & allsame(shB, scal(shB))
+            commit = jnp.bool_(True) if gatherable else uni
+
+            def rows_uniform():
+                u = jnp.clip(u0, 0, W - 1)
+                return (srow(memr, u),
+                        srow(memr, jnp.clip(u + 1, 0, W - 1)),
+                        srow(memr, jnp.clip(u + 2, 0, W - 1)))
+
+            if gatherable:
+                def rows_divergent():
+                    return (_gather_word(widx),
+                            _gather_word(jnp.clip(widx + 1, 0, W - 1)),
+                            _gather_word(jnp.clip(widx + 2, 0, W - 1)))
+
+                mw0, mw1, mw2 = lax.cond(uni, rows_uniform, rows_divergent)
+            else:
+                mw0, mw1, mw2 = rows_uniform()
+
+            inv = (32 - shB) & 31
+            hi_or = jnp.where(shB == 0, 0, -1)
+            raw_lo = lax.shift_right_logical(mw0, shB) | \
+                (lax.shift_left(mw1, inv) & hi_or)
+            raw_hi = lax.shift_right_logical(mw1, shB) | \
+                (lax.shift_left(mw2, inv) & hi_or)
+            signed = (flags & 1) != 0
+            is64 = (flags & 2) != 0
+            b1 = nbytes == 1
+            b2_ = nbytes == 2
+            lraw = jnp.where(b1, raw_lo & 0xFF,
+                             jnp.where(b2_, raw_lo & 0xFFFF, raw_lo))
+            lsext = jnp.where(
+                b1,
+                lax.shift_right_arithmetic(lax.shift_left(raw_lo, 24), 24),
+                jnp.where(
+                    b2_,
+                    lax.shift_right_arithmetic(lax.shift_left(raw_lo, 16), 16),
+                    raw_lo))
+            ll = jnp.where(signed, lsext, lraw)
+            lh = jnp.where(
+                is64,
+                jnp.where(nbytes == 8, raw_hi,
+                          jnp.where(signed,
+                                    lax.shift_right_arithmetic(ll, 31),
+                                    full(0))),
+                full(0))
+            any_oob = jnp.any(oob)
+
+            @pl.when(commit)
+            def _():
+                wrow(slo, sp - 1, ll)
+                wrow(shi, sp - 1, lh)
+
+                @pl.when(any_oob)
+                def _():
+                    trapr[0, :] = jnp.where(
+                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
+                        trapr[0, :])
+
+            return lax.cond(
+                commit,
+                lambda: lax.cond(
+                    any_oob,
+                    lambda: keep(c, pc=pc + 1, status=I32(ST_DIVERGED)),
+                    lambda: keep(c, pc=pc + 1)),
+                lambda: keep(c, status=I32(ST_DIVERGED)))
+
+        def h_store(c):
+            pc, sp, pages = c[1], c[2], c[6]
+            off, nbytes = a_r[pc], b_r[pc]
+            vl, vh = srow(slo, sp - 1), srow(shi, sp - 1)
+            addr = srow(slo, sp - 2)
+            ea = addr + off
+            carry_ = u_lt(ea, addr) | u_lt(ea, full(off))
+            mem_bytes = pages * I32(65536)
+            end = ea + nbytes
+            oob = carry_ | u_lt(end, ea) | u_lt(full(mem_bytes), end)
+            ok = ~oob
+            widx = jnp.clip(lax.shift_right_logical(ea, 2), 0, W - 1)
+            shB = (ea & 3) * 8
+            b1 = nbytes == 1
+            b2_ = nbytes == 2
+            full_lo = jnp.where(b1, 0xFF, jnp.where(b2_, 0xFFFF, I32(-1)))
+            full_hi = jnp.where(nbytes == 8, I32(-1), 0)
+            full_lo = jnp.broadcast_to(full_lo, (1, Lblk))
+            full_hi = jnp.broadcast_to(full_hi, (1, Lblk))
+            sm0, sm1 = lo_ops.shl64(full_lo, full_hi, shB)
+            sm2 = jnp.where(shB == 0, 0,
+                            lo_ops.shr64_u(full_lo, full_hi, 64 - shB)[0])
+            sv0, sv1 = lo_ops.shl64(vl, vh, shB)
+            sv2 = jnp.where(shB == 0, 0, lo_ops.shr64_u(vl, vh, 64 - shB)[0])
+            u0 = scal(widx)
+            uni = allsame(widx, u0) & allsame(shB, scal(shB))
+            commit = jnp.bool_(True) if gatherable else uni
+            any_oob = jnp.any(oob)
+
+            def rmw_uniform():
+                for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
+                                            (sm2, sv2))):
+                    w = jnp.clip(u0 + k, 0, W - 1)
+
+                    @pl.when(jnp.any(m != 0))
+                    def _(m=m, v=v, w=w):
+                        cur = srow(memr, w)
+                        wrow(memr, w,
+                             jnp.where(ok & (m != 0), (cur & ~m) | (v & m),
+                                       cur))
+
+            if gatherable:
+                def rmw_divergent():
+                    for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
+                                                (sm2, sv2))):
+                        wk = jnp.clip(widx + k, 0, W - 1)
+
+                        def chunk(i, _, m=m, v=v, wk=wk):
+                            base = i * GR
+                            rows = memr[pl.ds(base, GR), :]
+                            wi = base + jax.lax.broadcasted_iota(
+                                I32, (GR, Lblk), 0)
+                            hit = (wi == wk) & (ok & (m != 0))
+                            memr[pl.ds(base, GR), :] = jnp.where(
+                                hit, (rows & ~m) | (v & m), rows)
+                            return 0
+
+                        lax.fori_loop(0, GATHER_CHUNKS, chunk, 0)
+
+                lax.cond(uni, rmw_uniform, rmw_divergent)
+            else:
+                @pl.when(uni)
+                def _():
+                    rmw_uniform()
+
+            @pl.when(commit & any_oob)
+            def _():
+                trapr[0, :] = jnp.where(
+                    oob[0], I32(int(ErrCode.MemoryOutOfBounds)), trapr[0, :])
+
+            return lax.cond(
+                commit,
+                lambda: lax.cond(
+                    any_oob,
+                    lambda: keep(c, pc=pc + 1, sp=sp - 2,
+                                 status=I32(ST_DIVERGED)),
+                    lambda: keep(c, pc=pc + 1, sp=sp - 2)),
+                lambda: keep(c, status=I32(ST_DIVERGED)))
+
+        def mk_alu2(sub):
+            fn = alu2[sub]
+            can_trap = sub in _DIV32_SUBS or sub in _DIV64_SUBS
+
+            def h(c):
+                pc, sp = c[1], c[2]
+                xl, xh = srow(slo, sp - 2), srow(shi, sp - 2)
+                yl, yh = srow(slo, sp - 1), srow(shi, sp - 1)
+                rl, rh = fn(xl, xh, yl, yh)
+                wrow(slo, sp - 2, rl)
+                wrow(shi, sp - 2, rh)
+                if not can_trap:
+                    return keep(c, pc=pc + 1, sp=sp - 1)
+                if sub in _DIV32_SUBS:
+                    dz = yl == 0
+                    ovf = (xl == jnp.int32(-0x80000000)) & (yl == -1) \
+                        if sub in _DIVS_SUBS else jnp.zeros_like(dz)
+                else:
+                    dz = (yl | yh) == 0
+                    ovf = ((xl == 0) & (xh == jnp.int32(-0x80000000)) &
+                           (yl == -1) & (yh == -1)) \
+                        if sub in _DIVS_SUBS else jnp.zeros_like(dz)
+                bad = dz | ovf
+                any_bad = jnp.any(bad)
+                kind = jnp.where(dz, I32(1), jnp.where(ovf, I32(2), I32(0)))
+                k0 = scal(kind)
+                code0 = jnp.where(k0 == 1, I32(int(ErrCode.DivideByZero)),
+                                  I32(int(ErrCode.IntegerOverflow)))
+
+                @pl.when(any_bad)
+                def _():
+                    codes = jnp.where(dz[0], I32(int(ErrCode.DivideByZero)),
+                                      I32(int(ErrCode.IntegerOverflow)))
+                    trapr[0, :] = jnp.where(bad[0], codes, trapr[0, :])
+
+                return lax.cond(
+                    any_bad,
+                    lambda: lax.cond(
+                        jnp.all(bad) & allsame(kind, k0),
+                        lambda: keep(c, status=I32(ST_TRAPPED_BASE) + code0),
+                        lambda: keep(c, pc=pc + 1, sp=sp - 1,
+                                     status=I32(ST_DIVERGED))),
+                    lambda: keep(c, pc=pc + 1, sp=sp - 1))
+            return h
+
+        def mk_alu1(sub):
+            fn = alu1[sub]
+            can_trap = sub in _TRUNC_TRAP_SUBS
+
+            def h(c):
+                pc, sp = c[1], c[2]
+                wl, wh = srow(slo, sp - 1), srow(shi, sp - 1)
+                rl, rh = fn(wl, wh)
+                wrow(slo, sp - 1, rl)
+                wrow(shi, sp - 1, rh)
+                if not can_trap:
+                    return keep(c, pc=pc + 1)
+                fw = lo_ops.to_f32(wl)
+                tr = jnp.where(fw < 0, lax.ceil(fw), lax.floor(fw))
+                nan = lo_ops.is_nan32(wl)
+                if sub == ALU1_SUB["i32.trunc_f32_s"]:
+                    inr = (tr >= jnp.float32(-2147483648.0)) & \
+                        (tr <= jnp.float32(2147483520.0))
+                else:
+                    inr = (tr >= 0) & (tr <= jnp.float32(4294967040.0))
+                bad = nan | ~inr
+                any_bad = jnp.any(bad)
+                kind = jnp.where(nan, I32(1), jnp.where(~inr, I32(2), I32(0)))
+                k0 = scal(kind)
+                code0 = jnp.where(k0 == 1, I32(int(ErrCode.InvalidConvToInt)),
+                                  I32(int(ErrCode.IntegerOverflow)))
+
+                @pl.when(any_bad)
+                def _():
+                    codes = jnp.where(nan[0],
+                                      I32(int(ErrCode.InvalidConvToInt)),
+                                      I32(int(ErrCode.IntegerOverflow)))
+                    trapr[0, :] = jnp.where(bad[0], codes, trapr[0, :])
+
+                return lax.cond(
+                    any_bad,
+                    lambda: lax.cond(
+                        jnp.all(bad) & allsame(kind, k0),
+                        lambda: keep(c, status=I32(ST_TRAPPED_BASE) + code0),
+                        lambda: keep(c, pc=pc + 1,
+                                     status=I32(ST_DIVERGED))),
+                    lambda: keep(c, pc=pc + 1))
+            return h
+
+        base_handlers = {
+            H_NOP: h_nop, H_CONST: h_const, H_LOCAL_GET: h_local_get,
+            H_LOCAL_SET: h_local_set, H_LOCAL_TEE: h_local_tee,
+            H_GLOBAL_GET: h_global_get, H_GLOBAL_SET: h_global_set,
+            H_DROP: h_drop, H_SELECT: h_select, H_BR: h_br, H_BRZ: h_brz,
+            H_BRNZ: h_brnz, H_BR_TABLE: h_br_table, H_RETURN: h_return,
+            H_CALL: h_call, H_CALL_INDIRECT: h_call_indirect,
+            H_MEMSIZE: h_memsize, H_MEMGROW: h_memgrow, H_TRAP: h_trap,
+            H_LOAD: h_load, H_STORE: h_store,
+        }
+
+        def handler_for(hid):
+            if hid >= H_ALU1_BASE:
+                return mk_alu1(hid - H_ALU1_BASE)
+            if hid >= H_ALU2_BASE:
+                return mk_alu2(hid - H_ALU2_BASE)
+            return base_handlers[hid]
+
+        handlers = [handler_for(h) for h in used_hids]
+
+        def cond(c):
+            return (c[0] < chunk) & (c[7] == ST_RUNNING)
+
+        def body(c):
+            pc = jnp.clip(c[1], 0, code_len - 1)
+            nc = lax.switch(hid_r[pc], handlers, c)
+            # divergence rewinds the step count (the next engine re-runs it)
+            counted = jnp.where(nc[7] == I32(ST_DIVERGED), I32(0), I32(1))
+            return (nc[0] + counted,) + nc[1:]
+
+        init = (I32(0), ctrl_r[blk, _C_PC], ctrl_r[blk, _C_SP],
+                ctrl_r[blk, _C_FP], ctrl_r[blk, _C_OB], ctrl_r[blk, _C_CD],
+                ctrl_r[blk, _C_PAGES], ctrl_r[blk, _C_STATUS])
+        steps, pc, sp, fp, ob, cd, pages, status = \
+            lax.while_loop(cond, body, init)
+        ctrl_out[blk, _C_PC] = pc
+        ctrl_out[blk, _C_SP] = sp
+        ctrl_out[blk, _C_FP] = fp
+        ctrl_out[blk, _C_OB] = ob
+        ctrl_out[blk, _C_CD] = cd
+        ctrl_out[blk, _C_STATUS] = status
+        ctrl_out[blk, _C_PAGES] = pages
+        ctrl_out[blk, _C_CHUNK] = chunk
+        ctrl_out[blk, _C_STEPS] = steps
+
+        outs = [dma(0, slo, s_lo_out.at[:, pl.ds(lo, Lblk)]),
+                dma(1, shi, s_hi_out.at[:, pl.ds(lo, Lblk)]),
+                dma(2, glo, g_lo_out.at[:, pl.ds(lo, Lblk)]),
+                dma(3, ghi, g_hi_out.at[:, pl.ds(lo, Lblk)]),
+                dma(4, memr, mem_out.at[:, pl.ds(lo, Lblk)]),
+                dma(5, trapr, trap_out.at[:, pl.ds(lo, Lblk)])]
+        for c in outs:
+            c.start()
+        for c in outs:
+            c.wait()
+
+    def aspec():
+        return pl.BlockSpec(memory_space=pl.ANY)
+
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=14,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),      # frames_in
+            aspec(), aspec(),                           # stacks (HBM)
+            aspec(), aspec(),                           # globals (HBM)
+            aspec(), aspec(),                           # mem, trap (HBM)
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),      # ctrl_out
+            pl.BlockSpec(memory_space=pltpu.SMEM),      # frames_out
+            aspec(), aspec(), aspec(), aspec(), aspec(), aspec(),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((D, Lblk), jnp.int32),           # slo
+            pltpu.VMEM((D, Lblk), jnp.int32),           # shi
+            pltpu.VMEM((NGp, Lblk), jnp.int32),         # glo
+            pltpu.VMEM((NGp, Lblk), jnp.int32),         # ghi
+            pltpu.VMEM((W, Lblk), jnp.int32),           # memr
+            pltpu.VMEM((1, Lblk), jnp.int32),           # trapr
+            pltpu.SemaphoreType.DMA((6,)),              # sems
+        ],
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid_spec=spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((nblk, 16), jnp.int32),    # ctrl
+            jax.ShapeDtypeStruct((nblk, 3, CD), jnp.int32),  # frames
+            jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_lo
+            jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_hi
+            jax.ShapeDtypeStruct((NGp, L), jnp.int32),      # glob_lo
+            jax.ShapeDtypeStruct((NGp, L), jnp.int32),      # glob_hi
+            jax.ShapeDtypeStruct((W, L), jnp.int32),        # mem
+            jax.ShapeDtypeStruct((1, L), jnp.int32),        # trap
+        ],
+        # inputs 15..20 (after 14 prefetch args + frames_in) alias outs 2..7
+        input_output_aliases={15: 2, 16: 3, 17: 4, 18: 5, 19: 6, 20: 7},
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )
+    return jax.jit(fn, donate_argnums=(15, 16, 17, 18, 19, 20))
+
+
+class PallasUniformEngine:
+    """Block-converged engine running the dispatch loop on-device.
+
+    Wraps the SIMT engine for divergence fallback exactly like
+    UniformBatchEngine; the difference is the converged fast path runs as a
+    Pallas kernel (one launch per `steps_per_launch` instructions) instead
+    of per-step XLA, and convergence is only required within a lane block."""
+
+    # geometry knobs (state must fit VMEM; ~16 MiB/core on v5e)
+    MAX_CODE_LEN = 16384       # SMEM budget for the 7 code planes
+    # Per-block VMEM scratch budget (1x state size: state planes stay in
+    # HBM and are DMA'd into scratch per lane block; ~2 MiB headroom is
+    # left for gather-chunk temporaries and compiler spill).
+    VMEM_BUDGET_BYTES = 9 * 1024 * 1024
+    # Divergent-address loads/stores scan the whole [W, Lblk] memory block
+    # (compare-reduce); cap that scan's size, not W alone — one wasm page
+    # is already 16384 words.
+    MAX_GATHER_ELEMS = 4 * 1024 * 1024
+    MIN_LANE_BLOCK = 128
+
+    def __init__(self, inst, store=None, conf=None, lanes=None, mesh=None,
+                 interpret=None, simt=None):
+        from wasmedge_tpu.batch.engine import BatchEngine
+
+        self.simt = simt if simt is not None else BatchEngine(
+            inst, store=store, conf=conf, lanes=lanes, mesh=mesh)
+        self.inst = inst
+        self.cfg = self.simt.cfg
+        self.lanes = self.simt.lanes
+        self.img = self.simt.img
+        self.interpret = interpret
+        self._fn = None
+        self._tables = None
+        self.fell_back_to_simt = False
+        self.ineligible_reason = self._eligibility()
+
+    # -- geometry / eligibility -------------------------------------------
+    def _depths(self):
+        # The configured depths are honored exactly — same trap thresholds
+        # as the XLA engines' _do_call; _lane_block gates whether they fit
+        # VMEM (ineligible -> XLA fallback), never silently shrinks them.
+        return self.cfg.value_stack_depth, self.cfg.call_stack_depth
+
+    def _mem_words(self):
+        img = self.img
+        if not img.has_memory:
+            return 1
+        return max(img.mem_pages_max, img.mem_pages_init, 1) * _PAGE_WORDS
+
+    def _lane_block(self) -> Optional[int]:
+        """Largest power-of-two lane block whose state fits the budget."""
+        D, CD = self._depths()
+        W = self._mem_words()
+        NGp = max(self.img.globals_lo.shape[0], 1)
+        per_lane = 4 * (2 * D + 2 * NGp + W + 1)
+        blk = self.lanes
+        while blk > self.MIN_LANE_BLOCK and (
+                blk * per_lane > self.VMEM_BUDGET_BYTES
+                or self.lanes % blk != 0):
+            blk //= 2
+        if blk * per_lane > self.VMEM_BUDGET_BYTES or self.lanes % blk != 0:
+            return None
+        return blk
+
+    def _eligibility(self) -> Optional[str]:
+        img = self.img
+        if img.code_len > self.MAX_CODE_LEN:
+            return f"code too large for SMEM ({img.code_len} instrs)"
+        if self.simt.mesh is not None:
+            return "mesh sharding handled by SIMT engine"
+        if self.cfg.fuel_per_launch is not None:
+            return "fuel accounting handled by SIMT engine"
+        if self._lane_block() is None:
+            return (f"state too large for VMEM "
+                    f"({self._mem_words()} mem words/lane)")
+        return None
+
+    @property
+    def eligible(self) -> bool:
+        return self.ineligible_reason is None
+
+    # -- build ------------------------------------------------------------
+    def _build(self):
+        from wasmedge_tpu.batch import ensure_jax_backend
+
+        ensure_jax_backend()
+        import jax
+        import jax.numpy as jnp
+
+        img = self.img
+        interpret = self.interpret
+        if interpret is None:
+            interpret = jax.default_backend() == "cpu"
+        hid = hid_plane(img)
+        used = tuple(sorted(set(int(h) for h in hid)))
+        dense = {h: i for i, h in enumerate(used)}
+        hid_dense = np.asarray([dense[int(h)] for h in hid], np.int32)
+        D, CD = self._depths()
+        W = self._mem_words()
+        NG = img.globals_lo.shape[0]
+        Lblk = self._lane_block()
+        pages_cap = min(max(img.mem_pages_max, img.mem_pages_init),
+                        W // _PAGE_WORDS) if img.has_memory else 0
+        self._geom = (D, CD, W, Lblk)
+        self._fn = _build_kernel(
+            used, D, CD, W, self.lanes, Lblk, NG, img.code_len,
+            len(img.f_entry), img.table0.shape[0],
+            img.max_local_zeros, pages_cap,
+            W * Lblk <= self.MAX_GATHER_ELEMS, interpret)
+        self._tables = tuple(jnp.asarray(t) for t in (
+            hid_dense, img.a, img.b, img.c, img.imm_lo, img.imm_hi,
+            img.f_entry, img.f_nparams, img.f_nlocals, img.f_frame_top,
+            img.f_type, img.br_table.reshape(-1), img.table0))
+
+    # -- state ------------------------------------------------------------
+    def _initial_state(self, func_idx, args_lanes):
+        import jax.numpy as jnp
+
+        img = self.img
+        L = self.lanes
+        D, CD, W, Lblk = self._geom
+        nblk = L // Lblk
+        meta = self.inst.lowered.funcs[func_idx]
+        stack_lo = np.zeros((D, L), np.int32)
+        stack_hi = np.zeros((D, L), np.int32)
+        for i, arg in enumerate(args_lanes):
+            arr = np.asarray(arg, dtype=np.int64)
+            if arr.ndim == 0:
+                arr = np.full(L, arr, np.int64)
+            if arr.shape != (L,):
+                raise ValueError(
+                    f"arg {i}: expected shape ({L},) or scalar, "
+                    f"got {arr.shape}")
+            stack_lo[i] = (arr & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+            stack_hi[i] = ((arr >> 32) & 0xFFFFFFFF).astype(
+                np.uint32).view(np.int32)
+        NGp = max(img.globals_lo.shape[0], 1)
+        glo = np.zeros((NGp, L), np.int32)
+        ghi = np.zeros((NGp, L), np.int32)
+        if img.globals_lo.shape[0]:
+            glo[:img.globals_lo.shape[0]] = img.globals_lo[:, None]
+            ghi[:img.globals_hi.shape[0]] = img.globals_hi[:, None]
+        mem = np.zeros((W, L), np.int32)
+        if img.mem_init.shape[0] > 1 or img.mem_pages_init:
+            n = min(img.mem_init.shape[0], W)
+            mem[:n] = img.mem_init[:n, None]
+        ctrl = np.zeros((nblk, 16), np.int32)
+        ctrl[:, _C_PC] = meta.entry_pc
+        ctrl[:, _C_SP] = meta.nlocals
+        ctrl[:, _C_OB] = meta.nlocals
+        ctrl[:, _C_PAGES] = img.mem_pages_init
+        ctrl[:, _C_CHUNK] = self.cfg.steps_per_launch
+        return [jnp.asarray(ctrl), jnp.zeros((nblk, 3, CD), jnp.int32),
+                jnp.asarray(stack_lo), jnp.asarray(stack_hi),
+                jnp.asarray(glo), jnp.asarray(ghi),
+                jnp.asarray(mem), jnp.zeros((1, L), jnp.int32)]
+
+    def _to_simt_state(self, state, steps_per_block):
+        """Expand per-block scalars to the SIMT engine's per-lane layout."""
+        import jax.numpy as jnp
+
+        from wasmedge_tpu.batch.engine import BatchState
+
+        cfg = self.cfg
+        L = self.lanes
+        D, CD, W, Lblk = self._geom
+        ctrl = np.asarray(state[0])
+        frames = np.asarray(state[1])
+        nblk = ctrl.shape[0]
+        D_s, CD_s = cfg.value_stack_depth, cfg.call_stack_depth
+
+        def pad_rows(x, target):
+            x = np.asarray(x)
+            if x.shape[0] >= target:
+                return x[:target]
+            return np.concatenate(
+                [x, np.zeros((target - x.shape[0], L), x.dtype)], axis=0)
+
+        def lanes_of(col):
+            return np.repeat(ctrl[:, col].astype(np.int32), Lblk)
+
+        trap_v = merge_block_status_into_trap(
+            np.asarray(state[7])[0].copy(), ctrl, Lblk)
+        fr = np.zeros((3, CD_s, L), np.int32)
+        ncd = min(CD, CD_s)
+        for b in range(nblk):
+            fr[:, :ncd, b * Lblk:(b + 1) * Lblk] = \
+                frames[b][:, :ncd, None]
+        fuel0 = cfg.fuel_per_launch if cfg.fuel_per_launch is not None else 0
+        retired = np.repeat(np.asarray(steps_per_block, np.int64), Lblk)
+        return BatchState(
+            pc=jnp.asarray(lanes_of(_C_PC)), sp=jnp.asarray(lanes_of(_C_SP)),
+            fp=jnp.asarray(lanes_of(_C_FP)),
+            opbase=jnp.asarray(lanes_of(_C_OB)),
+            call_depth=jnp.asarray(lanes_of(_C_CD)),
+            trap=jnp.asarray(trap_v),
+            retired=jnp.asarray(retired.astype(np.int32)),
+            fuel=jnp.asarray(
+                np.maximum(fuel0 - retired, 1).astype(np.int32)
+                if fuel0 else np.zeros(L, np.int32)),
+            mem_pages=jnp.asarray(lanes_of(_C_PAGES)),
+            stack_lo=jnp.asarray(pad_rows(state[2], D_s)),
+            stack_hi=jnp.asarray(pad_rows(state[3], D_s)),
+            fr_ret_pc=jnp.asarray(fr[0]), fr_fp=jnp.asarray(fr[1]),
+            fr_opbase=jnp.asarray(fr[2]),
+            glob_lo=jnp.asarray(np.asarray(state[4])),
+            glob_hi=jnp.asarray(np.asarray(state[5])),
+            mem=jnp.asarray(np.asarray(state[6])),
+        )
+
+    # -- run --------------------------------------------------------------
+    def run(self, func_name: str, args_lanes: List,
+            max_steps: int = 10_000_000):
+        ex = self.inst.exports.get(func_name)
+        if ex is None or ex[0] != 0:
+            raise KeyError(f"no exported function {func_name}")
+        func_idx = ex[1]
+        if not self.eligible:
+            return self.simt.run(func_name, args_lanes, max_steps)
+        if self._fn is None:
+            self._build()
+        state = self._initial_state(func_idx, args_lanes)
+        nblk = state[0].shape[0]
+        steps_per_block = np.zeros(nblk, np.int64)
+        self.fell_back_to_simt = False
+        while True:
+            out = self._fn(*self._tables, state[0], state[1], *state[2:])
+            state = list(out)
+            ctrl_np = np.asarray(state[0])
+            steps_per_block += ctrl_np[:, _C_STEPS].astype(np.int64)
+            statuses = ctrl_np[:, _C_STATUS]
+            if (statuses == ST_RUNNING).any() and \
+                    int(steps_per_block.max()) < max_steps:
+                continue
+            break
+        total = int(steps_per_block.max())
+        if (statuses == ST_DIVERGED).any():
+            self.fell_back_to_simt = True
+            if self.simt._run_chunk is None:
+                self.simt._build()
+            simt_state = self._to_simt_state(state, steps_per_block)
+            while total < max_steps:
+                done, simt_state = self.simt._run_chunk(simt_state)
+                total += int(done)
+                if not (np.asarray(simt_state.trap) == 0).any():
+                    break
+                if int(done) == 0:
+                    break
+            return self._result(func_idx, simt_state, total)
+        # Fast path: pull only the result rows and the trap plane off the
+        # device (full-state readback is reserved for the divergence
+        # handoff; device->host bandwidth is the expensive resource here).
+        return self._result_fast(func_idx, state, ctrl_np, steps_per_block)
+
+    def _result_fast(self, func_idx, state, ctrl, steps_per_block):
+        from wasmedge_tpu.batch.engine import BatchResult
+
+        D, CD, W, Lblk = self._geom
+        nres = int(self.inst.lowered.funcs[func_idx].nresults)
+        stack_lo = np.asarray(state[2][:max(nres, 1)])
+        stack_hi = np.asarray(state[3][:max(nres, 1)])
+        trap_v = merge_block_status_into_trap(
+            np.asarray(state[7])[0].copy(), ctrl, Lblk)
+        results = decode_result_rows(stack_lo, stack_hi, nres)
+        retired = np.repeat(steps_per_block, Lblk).astype(np.int64)
+        return BatchResult(results=results, trap=trap_v,
+                           retired=retired,
+                           steps=int(steps_per_block.max()))
+
+    def _result(self, func_idx, state, steps):
+        from wasmedge_tpu.batch.engine import BatchResult
+
+        nres = int(self.inst.lowered.funcs[func_idx].nresults)
+        results = decode_result_rows(np.asarray(state.stack_lo),
+                                     np.asarray(state.stack_hi), nres)
+        return BatchResult(results=results, trap=np.asarray(state.trap),
+                           retired=np.asarray(state.retired), steps=steps)

@@ -748,6 +748,28 @@ class UniformBatchEngine:
         self.lanes = self.simt.lanes
         self.img = self.simt.img
         self._uchunk = None
+        self.pallas = self._pick_pallas(inst, store, conf)
+
+    def _pick_pallas(self, inst, store, conf):
+        """The on-device Pallas dispatch loop is the fast path whenever the
+        backend is TPU and the module fits the kernel geometry; the
+        per-step XLA path below remains the CPU/testing vehicle and the
+        fallback for oversized modules (conf.batch.use_pallas overrides)."""
+        use = self.cfg.use_pallas
+        if use is None:
+            from wasmedge_tpu.batch import ensure_jax_backend
+
+            ensure_jax_backend()
+            import jax
+
+            use = jax.default_backend() == "tpu"
+        if not use:
+            return None
+        from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
+
+        eng = PallasUniformEngine(inst, conf=conf, simt=self.simt,
+                                  interpret=self.cfg.interpret or None)
+        return eng if eng.eligible else None
 
     def _build_uniform(self):
         from wasmedge_tpu.batch import ensure_jax_backend
@@ -832,6 +854,10 @@ class UniformBatchEngine:
         if ex is None or ex[0] != 0:
             raise KeyError(f"no exported function {func_name}")
         func_idx = ex[1]
+        if self.pallas is not None:
+            res = self.pallas.run(func_name, args_lanes, max_steps)
+            self.fell_back_to_simt = self.pallas.fell_back_to_simt
+            return res
         if self.cfg.fuel_per_launch is not None or self.simt.mesh is not None:
             # fuel accounting and mesh sharding live in the SIMT engine
             return self.simt.run(func_name, args_lanes, max_steps)

@@ -88,6 +88,10 @@ class BatchConfigure:
     fuel_per_launch: Optional[int] = None  # per-lane fuel budget (gas analog)
     uniform: bool = True  # converged-lane fast path (scalar PC dispatch)
     interpret: bool = False  # run Pallas kernels in interpreter mode
+    # Pallas warp-interpreter selection: None = auto (on whenever the
+    # backend is TPU and the module fits the kernel's geometry), True =
+    # force (interpret-mode on CPU), False = always per-step XLA.
+    use_pallas: Optional[bool] = None
 
 
 @dataclasses.dataclass

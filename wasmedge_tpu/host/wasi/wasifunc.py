@@ -60,6 +60,11 @@ def _mem_required(mem):
 
 
 def _read_iovs(mem, iovs_ptr: int, iovs_len: int) -> List[Tuple[int, int]]:
+    # Bound the iovec *array* before materializing it: the count is
+    # guest-controlled and the per-entry address wrap (& MASK32) would
+    # otherwise let a huge count spin the host unboundedly.  The reference
+    # validates the full iovs span up front (wasifunc.cpp getIOVS).
+    mem.check_bounds(iovs_ptr, 8 * iovs_len)
     out = []
     for k in range(iovs_len):
         base = (iovs_ptr + 8 * k) & MASK32
