@@ -432,8 +432,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
     I32 = jnp.int32
     u_lt = lo_ops.u_lt
-    alu2 = _alu2_fns(lo_ops, jnp, lax)
-    alu1 = _alu1_fns(lo_ops, jnp, lax)
+    alu2 = lo_ops.alu2_fns()
+    alu1 = lo_ops.alu1_fns()
     nblk = L // Lblk
     NGp = max(NG, 1)
     # Divergent-address memory ops scan memory in row chunks so the scan

@@ -210,106 +210,20 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
         sh = setrow(st.stack_hi, st.sp - 3, rh)
         return st._replace(pc=st.pc + 1, sp=st.sp - 2, stack_lo=sl, stack_hi=sh)
 
+    A2 = lo_ops.alu2_fns()
+
     def _alu_result(sub, xl, xh, yl, yh):
-        """Scalar-sub select over vector operands; only the i64 div/rem go
-        through the iterative path (under scalar switch they cost nothing
-        unless fetched)."""
-        sh32 = yl & 31
-        dg = jnp.where(yl == 0, jnp.int32(1), yl)
-        xu = xl.astype(jnp.uint32)
-        yu = jnp.where(yl == 0, jnp.uint32(1), yl.astype(jnp.uint32))
-        fx = lo_ops.to_f32(xl)
-        fy = lo_ops.to_f32(yl)
-        feq = lo_ops.f32_cmp_eq(xl, yl)
-        flt = lo_ops.f32_cmp_lt(xl, yl)
-        fgt = lo_ops.f32_cmp_lt(yl, xl)
-        fnan = lo_ops.is_nan32(xl) | lo_ops.is_nan32(yl)
-        sh64 = yl & 63
-        z = jnp.zeros_like(xl)
+        """Scalar-sub dispatch over the shared ALU table (laneops.alu2_fns,
+        the single source of ALU semantics for all batch engines)."""
+        n_subs = max(A2) + 1
 
-        def pair64(fn):
-            return lambda: fn(xl, xh, yl, yh)
+        def mk(i):
+            f = A2.get(i)
+            if f is None:
+                return lambda: (xl, xh)
+            return lambda: f(xl, xh, yl, yh)
 
-        def rare_div(kind):
-            def run():
-                glo = jnp.where((yl | yh) == 0, jnp.int32(1), yl)
-                ghi = jnp.where((yl | yh) == 0, jnp.int32(0), yh)
-                if kind.endswith("_u"):
-                    qlo, qhi, rlo, rhi = lo_ops.divmod64_u(xl, xh, glo, ghi)
-                    return (qlo, qhi) if kind.startswith("div") else (rlo, rhi)
-                qlo, qhi, rlo, rhi = lo_ops.div64_s(xl, xh, glo, ghi)
-                return (qlo, qhi) if kind.startswith("div") else (rlo, rhi)
-            return run
-
-        branches = {}
-        branches[S_I32["add"]] = lambda: (xl + yl, z)
-        branches[S_I32["sub"]] = lambda: (xl - yl, z)
-        branches[S_I32["mul"]] = lambda: (xl * yl, z)
-        branches[S_I32["div_s"]] = lambda: (lax.div(xl, dg), z)
-        branches[S_I32["div_u"]] = lambda: (lax.div(xu, yu).astype(I32), z)
-        branches[S_I32["rem_s"]] = lambda: (lax.rem(xl, dg), z)
-        branches[S_I32["rem_u"]] = lambda: (lax.rem(xu, yu).astype(I32), z)
-        branches[S_I32["and"]] = lambda: (xl & yl, z)
-        branches[S_I32["or"]] = lambda: (xl | yl, z)
-        branches[S_I32["xor"]] = lambda: (xl ^ yl, z)
-        branches[S_I32["shl"]] = lambda: (lax.shift_left(xl, sh32), z)
-        branches[S_I32["shr_s"]] = lambda: (lax.shift_right_arithmetic(xl, sh32), z)
-        branches[S_I32["shr_u"]] = lambda: (lax.shift_right_logical(xl, sh32), z)
-        branches[S_I32["rotl"]] = lambda: (lo_ops.rotl32(xl, yl), z)
-        branches[S_I32["rotr"]] = lambda: (lo_ops.rotl32(xl, (32 - (yl & 31)) & 31), z)
-        for nm, fn in (("eq", lambda: b2i(xl == yl)), ("ne", lambda: b2i(xl != yl)),
-                       ("lt_s", lambda: b2i(xl < yl)), ("lt_u", lambda: b2i(u_lt(xl, yl))),
-                       ("gt_s", lambda: b2i(xl > yl)), ("gt_u", lambda: b2i(u_lt(yl, xl))),
-                       ("le_s", lambda: b2i(xl <= yl)), ("le_u", lambda: b2i(lo_ops.u_le(xl, yl))),
-                       ("ge_s", lambda: b2i(xl >= yl)), ("ge_u", lambda: b2i(lo_ops.u_le(yl, xl)))):
-            branches[S_I32[nm]] = (lambda fn=fn: (fn(), z))
-        branches[S_I64["add"]] = pair64(lo_ops.add64)
-        branches[S_I64["sub"]] = pair64(lo_ops.sub64)
-        branches[S_I64["mul"]] = pair64(lo_ops.mul64)
-        branches[S_I64["div_s"]] = rare_div("div_s")
-        branches[S_I64["div_u"]] = rare_div("div_u")
-        branches[S_I64["rem_s"]] = rare_div("rem_s")
-        branches[S_I64["rem_u"]] = rare_div("rem_u")
-        branches[S_I64["and"]] = lambda: (xl & yl, xh & yh)
-        branches[S_I64["or"]] = lambda: (xl | yl, xh | yh)
-        branches[S_I64["xor"]] = lambda: (xl ^ yl, xh ^ yh)
-        branches[S_I64["shl"]] = lambda: lo_ops.shl64(xl, xh, sh64)
-        branches[S_I64["shr_s"]] = lambda: lo_ops.shr64_s(xl, xh, sh64)
-        branches[S_I64["shr_u"]] = lambda: lo_ops.shr64_u(xl, xh, sh64)
-        branches[S_I64["rotl"]] = lambda: lo_ops.rotl64(xl, xh, sh64)
-        branches[S_I64["rotr"]] = lambda: lo_ops.rotr64(xl, xh, sh64)
-        eq64 = lambda: lo_ops.eq64(xl, xh, yl, yh)
-        lts = lambda: lo_ops.lt64_s(xl, xh, yl, yh)
-        ltu = lambda: lo_ops.lt64_u(xl, xh, yl, yh)
-        gts = lambda: lo_ops.lt64_s(yl, yh, xl, xh)
-        gtu = lambda: lo_ops.lt64_u(yl, yh, xl, xh)
-        branches[S_I64["eq"]] = lambda: (b2i(eq64()), z)
-        branches[S_I64["ne"]] = lambda: (b2i(~eq64()), z)
-        branches[S_I64["lt_s"]] = lambda: (b2i(lts()), z)
-        branches[S_I64["lt_u"]] = lambda: (b2i(ltu()), z)
-        branches[S_I64["gt_s"]] = lambda: (b2i(gts()), z)
-        branches[S_I64["gt_u"]] = lambda: (b2i(gtu()), z)
-        branches[S_I64["le_s"]] = lambda: (b2i(~gts()), z)
-        branches[S_I64["le_u"]] = lambda: (b2i(~gtu()), z)
-        branches[S_I64["ge_s"]] = lambda: (b2i(~lts()), z)
-        branches[S_I64["ge_u"]] = lambda: (b2i(~ltu()), z)
-        branches[S_F32["add"]] = lambda: (lo_ops.canon32(lo_ops.from_f32(fx + fy)), z)
-        branches[S_F32["sub"]] = lambda: (lo_ops.canon32(lo_ops.from_f32(fx - fy)), z)
-        branches[S_F32["mul"]] = lambda: (lo_ops.canon32(lo_ops.from_f32(fx * fy)), z)
-        branches[S_F32["div"]] = lambda: (lo_ops.canon32(lo_ops.from_f32(fx / fy)), z)
-        branches[S_F32["min"]] = lambda: (lo_ops.f32_min(xl, yl), z)
-        branches[S_F32["max"]] = lambda: (lo_ops.f32_max(xl, yl), z)
-        branches[S_F32["copysign"]] = lambda: (
-            (xl & jnp.int32(0x7FFFFFFF)) | (yl & lo_ops._SIGN), z)
-        branches[S_F32["eq"]] = lambda: (b2i(feq), z)
-        branches[S_F32["ne"]] = lambda: (b2i(~feq), z)
-        branches[S_F32["lt"]] = lambda: (b2i(flt), z)
-        branches[S_F32["gt"]] = lambda: (b2i(fgt), z)
-        branches[S_F32["le"]] = lambda: (b2i((flt | feq) & ~fnan), z)
-        branches[S_F32["ge"]] = lambda: (b2i((fgt | feq) & ~fnan), z)
-
-        n_subs = max(branches) + 1
-        fns = [branches.get(i, lambda: (xl, xh)) for i in range(n_subs)]
+        fns = [mk(i) for i in range(n_subs)]
         return lax.switch(jnp.clip(sub, 0, n_subs - 1), fns)
 
     def h_alu2(st, f):
@@ -335,68 +249,26 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
         return st._replace(pc=st.pc + 1, sp=st.sp - 1, stack_lo=sl, stack_hi=sh,
                            trap=jnp.where(lane_trap != 0, lane_trap, st.trap))
 
+    A1F = lo_ops.alu1_fns()
+
     def h_alu1(st, f):
         sub, a, b, c, ilo, ihi = f
         wl = row(st.stack_lo, st.sp - 1)
         wh = row(st.stack_hi, st.sp - 1)
         fwv = lo_ops.to_f32(wl)
-        ext8 = lax.shift_right_arithmetic(lax.shift_left(wl, 24), 24)
-        ext16 = lax.shift_right_arithmetic(lax.shift_left(wl, 16), 16)
-        signw = lax.shift_right_arithmetic(wl, 31)
         tr = jnp.where(fwv < 0, lax.ceil(fwv), lax.floor(fwv))
         nanw = lo_ops.is_nan32(wl)
         in_s = (tr >= jnp.float32(-2147483648.0)) & (tr <= jnp.float32(2147483520.0))
         in_u = (tr >= 0) & (tr <= jnp.float32(4294967040.0))
-        tr_s = jnp.where(in_s & ~nanw, tr, jnp.float32(0)).astype(I32)
-        tru_shift = jnp.where(in_u & ~nanw, tr, jnp.float32(0))
-        tr_u = jnp.where(tru_shift >= jnp.float32(2147483648.0),
-                         (tru_shift - jnp.float32(4294967296.0)).astype(I32),
-                         tru_shift.astype(I32))
-        z = jnp.zeros_like(wl)
-        branches = {
-            A1["i32.clz"]: lambda: (lax.clz(wl), z),
-            A1["i32.ctz"]: lambda: (lo_ops.ctz32(wl), z),
-            A1["i32.popcnt"]: lambda: (lax.population_count(wl), z),
-            A1["i32.eqz"]: lambda: (b2i(wl == 0), z),
-            A1["i32.extend8_s"]: lambda: (ext8, z),
-            A1["i32.extend16_s"]: lambda: (ext16, z),
-            A1["i64.clz"]: lambda: (lo_ops.clz64(wl, wh), z),
-            A1["i64.ctz"]: lambda: (lo_ops.ctz64(wl, wh), z),
-            A1["i64.popcnt"]: lambda: (lo_ops.popcnt64(wl, wh), z),
-            A1["i64.eqz"]: lambda: (b2i((wl | wh) == 0), z),
-            A1["i64.extend8_s"]: lambda: (ext8, lax.shift_right_arithmetic(ext8, 31)),
-            A1["i64.extend16_s"]: lambda: (ext16, lax.shift_right_arithmetic(ext16, 31)),
-            A1["i64.extend32_s"]: lambda: (wl, signw),
-            A1["f32.abs"]: lambda: (wl & jnp.int32(0x7FFFFFFF), z),
-            A1["f32.neg"]: lambda: (wl ^ lo_ops._SIGN, z),
-            A1["f32.ceil"]: lambda: (lo_ops.canon32(lo_ops.from_f32(lax.ceil(fwv))), z),
-            A1["f32.floor"]: lambda: (lo_ops.canon32(lo_ops.from_f32(lax.floor(fwv))), z),
-            A1["f32.trunc"]: lambda: (lo_ops.f32_trunc(wl), z),
-            A1["f32.nearest"]: lambda: (lo_ops.f32_nearest(wl), z),
-            A1["f32.sqrt"]: lambda: (lo_ops.canon32(lo_ops.from_f32(lax.sqrt(fwv))), z),
-            A1["i32.wrap_i64"]: lambda: (wl, z),
-            A1["i64.extend_i32_s"]: lambda: (wl, signw),
-            A1["i64.extend_i32_u"]: lambda: (wl, z),
-            A1["i32.trunc_f32_s"]: lambda: (tr_s, z),
-            A1["i32.trunc_f32_u"]: lambda: (tr_u, z),
-            A1["i32.trunc_sat_f32_s"]: lambda: (
-                jnp.where(nanw, 0, jnp.where(tr < jnp.float32(-2147483648.0),
-                                             jnp.int32(-0x80000000),
-                                             jnp.where(tr > jnp.float32(2147483520.0),
-                                                       jnp.int32(0x7FFFFFFF), tr_s))), z),
-            A1["i32.trunc_sat_f32_u"]: lambda: (
-                jnp.where(nanw | (tr < 0), 0,
-                          jnp.where(tr > jnp.float32(4294967040.0),
-                                    jnp.int32(-1), tr_u)), z),
-            A1["f32.convert_i32_s"]: lambda: (lo_ops.from_f32(wl.astype(jnp.float32)), z),
-            A1["f32.convert_i32_u"]: lambda: (
-                lo_ops.from_f32(wl.astype(jnp.uint32).astype(jnp.float32)), z),
-            A1["i32.reinterpret_f32"]: lambda: (wl, z),
-            A1["f32.reinterpret_i32"]: lambda: (wl, z),
-            A1["ref.is_null"]: lambda: (b2i((wl | wh) == 0), z),
-        }
-        n_subs = max(branches) + 1
-        fns = [branches.get(i, lambda: (wl, wh)) for i in range(n_subs)]
+        n_subs = max(A1F) + 1
+
+        def mk(i):
+            f1 = A1F.get(i)
+            if f1 is None:
+                return lambda: (wl, wh)
+            return lambda: f1(wl, wh)
+
+        fns = [mk(i) for i in range(n_subs)]
         rl, rh = lax.switch(jnp.clip(sub, 0, n_subs - 1), fns)
         trap_s = (sub == A1["i32.trunc_f32_s"]) & (nanw | ~in_s)
         trap_u = (sub == A1["i32.trunc_f32_u"]) & (nanw | ~in_u)

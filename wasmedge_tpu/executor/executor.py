@@ -57,13 +57,17 @@ class StopToken:
     per execution: a stale stop() cannot poison later runs, and cancelling
     one async handle does not terminate its siblings."""
 
-    __slots__ = ("_flag",)
+    __slots__ = ("_flag", "native_cell")
 
     def __init__(self):
         self._flag = False
+        self.native_cell = None  # int32[1] polled by the native engine
 
     def stop(self):
         self._flag = True
+        cell = self.native_cell
+        if cell is not None:
+            cell[0] = 1
 
     def __bool__(self) -> bool:
         return self._flag
@@ -268,12 +272,53 @@ class Executor:
         token = stop_token if stop_token is not None else StopToken()
         with self._token_lock:
             self._active_tokens.add(token)
-        thread = scalar_engine.Thread(store, self.conf, self.stat)
-        thread.stop_token = token
         try:
+            if self.conf.engine == EngineKind.NATIVE and fi.kind == "wasm":
+                out = self._invoke_native(store, fi, raw_args, token)
+                if out is not None:
+                    return out
+            thread = scalar_engine.Thread(store, self.conf, self.stat)
+            thread.stop_token = token
             return scalar_engine.run_function(thread, fi, raw_args)
         finally:
             with self._token_lock:
                 self._active_tokens.discard(token)
             if self.stat is not None:
                 self.stat.stop_wasm()
+
+    def _invoke_native(self, store, fi, raw_args, token):
+        """EngineKind.NATIVE: run on the C++ engine when the module is
+        eligible; None = fall back to the Python engine (graceful
+        degradation, like the reference's AOT-section fallback at
+        lib/loader/ast/module.cpp:279-326).  The NativeModule is cached on
+        the module instance."""
+        inst = fi.module
+        nm = getattr(inst, "_native_module", None)
+        if nm is None:
+            try:
+                from wasmedge_tpu import native
+
+                nm = native.module_for(inst, store)
+            except Exception:
+                nm = False  # toolchain unavailable; remember that
+            inst._native_module = nm
+        if nm is False or not nm.eligible:
+            self.native_fallback_reason = (
+                nm.reason if nm else "native engine unavailable")
+            return None
+        import numpy as np
+
+        cell = np.zeros(1, np.int32)
+        if token:  # a stop() that raced ahead of cell attachment
+            cell[0] = 1
+        token.native_cell = cell
+        try:
+            out, retired = nm.invoke(
+                fi.func_idx, raw_args,
+                max_call_depth=self.conf.runtime.max_call_depth,
+                stop_cell=cell)
+        finally:
+            token.native_cell = None
+        if self.stat is not None and self.stat.instr_counting:
+            self.stat.inc_instr(retired)
+        return out
