@@ -1,0 +1,31 @@
+;; malformed binaries (load phase) and invalid modules (validation phase)
+(assert_malformed (module binary "") "unexpected end")
+(assert_malformed (module binary "\00asm") "unexpected end")
+(assert_malformed (module binary "\01asm\01\00\00\00") "magic header not detected")
+(assert_malformed (module binary "\00asm\02\00\00\00") "unknown binary version")
+;; truncated section payload
+(assert_malformed (module binary "\00asm\01\00\00\00\01\05\01") "unexpected end")
+;; function/code count mismatch
+(assert_malformed
+  (module binary
+    "\00asm\01\00\00\00"
+    "\01\04\01\60\00\00"      ;; type ()->()
+    "\03\02\01\00")           ;; func section: 1 func, no code section
+  "function and code section have inconsistent lengths")
+
+(assert_invalid (module (func (result i32))) "type mismatch")
+(assert_invalid (module (func (result i32) (i64.const 1))) "type mismatch")
+(assert_invalid (module (func (i32.add (i32.const 1)))) "type mismatch")
+(assert_invalid (module (func (drop (i32.const 1)) (drop))) "type mismatch")
+(assert_invalid (module (func (local.get 0))) "unknown local")
+(assert_invalid (module (func (param i32) (local.get 1))) "unknown local")
+(assert_invalid (module (func (br 1))) "unknown label")
+(assert_invalid (module (func (result i32) (block (result i32) (br 0)))) "type mismatch")
+(assert_invalid
+  (module (global $g i32 (i32.const 1))
+          (func (global.set $g (i32.const 2))))
+  "global is immutable")
+(assert_invalid (module (func (i32.load (i32.const 0)))) "unknown memory")
+(assert_invalid (module (memory 1) (func (i32.load (f32.const 0) ))) "type mismatch")
+(assert_invalid (module (func (call 5))) "unknown function")
+(assert_invalid (module (func (unreachable) (i64.add)) (memory 1)) "")

@@ -172,231 +172,6 @@ def hid_plane(img: DeviceImage) -> np.ndarray:
     return hid
 
 
-def _alu2_fns(lo_ops, jnp, lax):
-    """sub -> (xl, xh, yl, yh) -> (rl, rh); indexed by ALU2 sub id.
-
-    Semantics mirror batch/uniform.py:_alu_result, which mirrors the
-    reference's binary_numeric.ipp kernels."""
-    I32 = jnp.int32
-    b2i = lo_ops.b2i
-    u_lt = lo_ops.u_lt
-
-    def z_of(x):
-        return jnp.zeros_like(x)
-
-    fns = {}
-
-    def i32op(name, fn):
-        fns[ALU2_I32_BASE + _I32_BIN.index(name)] = fn
-
-    def i64op(name, fn):
-        fns[ALU2_I64_BASE + _I32_BIN.index(name)] = fn
-
-    def f32op(name, fn):
-        fns[ALU2_F32_BASE + _F32_BIN.index(name)] = fn
-
-    i32op("add", lambda xl, xh, yl, yh: (xl + yl, z_of(xl)))
-    i32op("sub", lambda xl, xh, yl, yh: (xl - yl, z_of(xl)))
-    i32op("mul", lambda xl, xh, yl, yh: (xl * yl, z_of(xl)))
-    i32op("div_s", lambda xl, xh, yl, yh: (
-        lax.div(xl, jnp.where(yl == 0, I32(1), yl)), z_of(xl)))
-    i32op("div_u", lambda xl, xh, yl, yh: (
-        lax.div(xl.astype(jnp.uint32),
-                jnp.where(yl == 0, I32(1), yl).astype(jnp.uint32)).astype(I32),
-        z_of(xl)))
-    i32op("rem_s", lambda xl, xh, yl, yh: (
-        lax.rem(xl, jnp.where(yl == 0, I32(1), yl)), z_of(xl)))
-    i32op("rem_u", lambda xl, xh, yl, yh: (
-        lax.rem(xl.astype(jnp.uint32),
-                jnp.where(yl == 0, I32(1), yl).astype(jnp.uint32)).astype(I32),
-        z_of(xl)))
-    i32op("and", lambda xl, xh, yl, yh: (xl & yl, z_of(xl)))
-    i32op("or", lambda xl, xh, yl, yh: (xl | yl, z_of(xl)))
-    i32op("xor", lambda xl, xh, yl, yh: (xl ^ yl, z_of(xl)))
-    i32op("shl", lambda xl, xh, yl, yh: (lax.shift_left(xl, yl & 31), z_of(xl)))
-    i32op("shr_s", lambda xl, xh, yl, yh: (
-        lax.shift_right_arithmetic(xl, yl & 31), z_of(xl)))
-    i32op("shr_u", lambda xl, xh, yl, yh: (
-        lax.shift_right_logical(xl, yl & 31), z_of(xl)))
-    i32op("rotl", lambda xl, xh, yl, yh: (lo_ops.rotl32(xl, yl), z_of(xl)))
-    i32op("rotr", lambda xl, xh, yl, yh: (
-        lo_ops.rotl32(xl, (32 - (yl & 31)) & 31), z_of(xl)))
-    i32op("eq", lambda xl, xh, yl, yh: (b2i(xl == yl), z_of(xl)))
-    i32op("ne", lambda xl, xh, yl, yh: (b2i(xl != yl), z_of(xl)))
-    i32op("lt_s", lambda xl, xh, yl, yh: (b2i(xl < yl), z_of(xl)))
-    i32op("lt_u", lambda xl, xh, yl, yh: (b2i(u_lt(xl, yl)), z_of(xl)))
-    i32op("gt_s", lambda xl, xh, yl, yh: (b2i(xl > yl), z_of(xl)))
-    i32op("gt_u", lambda xl, xh, yl, yh: (b2i(u_lt(yl, xl)), z_of(xl)))
-    i32op("le_s", lambda xl, xh, yl, yh: (b2i(xl <= yl), z_of(xl)))
-    i32op("le_u", lambda xl, xh, yl, yh: (b2i(lo_ops.u_le(xl, yl)), z_of(xl)))
-    i32op("ge_s", lambda xl, xh, yl, yh: (b2i(xl >= yl), z_of(xl)))
-    i32op("ge_u", lambda xl, xh, yl, yh: (b2i(lo_ops.u_le(yl, xl)), z_of(xl)))
-
-    i64op("add", lambda xl, xh, yl, yh: lo_ops.add64(xl, xh, yl, yh))
-    i64op("sub", lambda xl, xh, yl, yh: lo_ops.sub64(xl, xh, yl, yh))
-    i64op("mul", lambda xl, xh, yl, yh: lo_ops.mul64(xl, xh, yl, yh))
-
-    def div64(kind):
-        def fn(xl, xh, yl, yh):
-            glo = jnp.where((yl | yh) == 0, I32(1), yl)
-            ghi = jnp.where((yl | yh) == 0, I32(0), yh)
-            if kind.endswith("_u"):
-                qlo, qhi, rlo, rhi = lo_ops.divmod64_u(xl, xh, glo, ghi)
-            else:
-                qlo, qhi, rlo, rhi = lo_ops.div64_s(xl, xh, glo, ghi)
-            return (qlo, qhi) if kind.startswith("div") else (rlo, rhi)
-        return fn
-
-    for kind in ("div_s", "div_u", "rem_s", "rem_u"):
-        i64op(kind, div64(kind))
-    i64op("and", lambda xl, xh, yl, yh: (xl & yl, xh & yh))
-    i64op("or", lambda xl, xh, yl, yh: (xl | yl, xh | yh))
-    i64op("xor", lambda xl, xh, yl, yh: (xl ^ yl, xh ^ yh))
-    i64op("shl", lambda xl, xh, yl, yh: lo_ops.shl64(xl, xh, yl & 63))
-    i64op("shr_s", lambda xl, xh, yl, yh: lo_ops.shr64_s(xl, xh, yl & 63))
-    i64op("shr_u", lambda xl, xh, yl, yh: lo_ops.shr64_u(xl, xh, yl & 63))
-    i64op("rotl", lambda xl, xh, yl, yh: lo_ops.rotl64(xl, xh, yl & 63))
-    i64op("rotr", lambda xl, xh, yl, yh: lo_ops.rotr64(xl, xh, yl & 63))
-    i64op("eq", lambda xl, xh, yl, yh: (b2i(lo_ops.eq64(xl, xh, yl, yh)), z_of(xl)))
-    i64op("ne", lambda xl, xh, yl, yh: (b2i(~lo_ops.eq64(xl, xh, yl, yh)), z_of(xl)))
-    i64op("lt_s", lambda xl, xh, yl, yh: (b2i(lo_ops.lt64_s(xl, xh, yl, yh)), z_of(xl)))
-    i64op("lt_u", lambda xl, xh, yl, yh: (b2i(lo_ops.lt64_u(xl, xh, yl, yh)), z_of(xl)))
-    i64op("gt_s", lambda xl, xh, yl, yh: (b2i(lo_ops.lt64_s(yl, yh, xl, xh)), z_of(xl)))
-    i64op("gt_u", lambda xl, xh, yl, yh: (b2i(lo_ops.lt64_u(yl, yh, xl, xh)), z_of(xl)))
-    i64op("le_s", lambda xl, xh, yl, yh: (b2i(~lo_ops.lt64_s(yl, yh, xl, xh)), z_of(xl)))
-    i64op("le_u", lambda xl, xh, yl, yh: (b2i(~lo_ops.lt64_u(yl, yh, xl, xh)), z_of(xl)))
-    i64op("ge_s", lambda xl, xh, yl, yh: (b2i(~lo_ops.lt64_s(xl, xh, yl, yh)), z_of(xl)))
-    i64op("ge_u", lambda xl, xh, yl, yh: (b2i(~lo_ops.lt64_u(xl, xh, yl, yh)), z_of(xl)))
-
-    def fbin(op):
-        def fn(xl, xh, yl, yh):
-            fx, fy = lo_ops.to_f32(xl), lo_ops.to_f32(yl)
-            return (lo_ops.canon32(lo_ops.from_f32(op(fx, fy))), z_of(xl))
-        return fn
-
-    f32op("add", fbin(lambda a, b: a + b))
-    f32op("sub", fbin(lambda a, b: a - b))
-    f32op("mul", fbin(lambda a, b: a * b))
-    f32op("div", fbin(lambda a, b: a / b))
-    f32op("min", lambda xl, xh, yl, yh: (lo_ops.f32_min(xl, yl), z_of(xl)))
-    f32op("max", lambda xl, xh, yl, yh: (lo_ops.f32_max(xl, yl), z_of(xl)))
-    f32op("copysign", lambda xl, xh, yl, yh: (
-        (xl & jnp.int32(0x7FFFFFFF)) | (yl & lo_ops._SIGN), z_of(xl)))
-
-    def fcmp(which):
-        def fn(xl, xh, yl, yh):
-            feq = lo_ops.f32_cmp_eq(xl, yl)
-            flt = lo_ops.f32_cmp_lt(xl, yl)
-            fgt = lo_ops.f32_cmp_lt(yl, xl)
-            fnan = lo_ops.is_nan32(xl) | lo_ops.is_nan32(yl)
-            v = {"eq": feq, "ne": ~feq, "lt": flt, "gt": fgt,
-                 "le": (flt | feq) & ~fnan, "ge": (fgt | feq) & ~fnan}[which]
-            return (b2i(v), z_of(xl))
-        return fn
-
-    for which in ("eq", "ne", "lt", "gt", "le", "ge"):
-        f32op(which, fcmp(which))
-    return fns
-
-
-def _alu1_fns(lo_ops, jnp, lax):
-    """sub -> (wl, wh) -> (rl, rh); indexed by ALU1 sub id."""
-    I32 = jnp.int32
-    b2i = lo_ops.b2i
-    A1 = ALU1_SUB
-
-    def z_of(x):
-        return jnp.zeros_like(x)
-
-    def sext8(wl):
-        return lax.shift_right_arithmetic(lax.shift_left(wl, 24), 24)
-
-    def sext16(wl):
-        return lax.shift_right_arithmetic(lax.shift_left(wl, 16), 16)
-
-    def trunc_core(wl):
-        fw = lo_ops.to_f32(wl)
-        return jnp.where(fw < 0, lax.ceil(fw), lax.floor(fw))
-
-    def trunc_s(wl):
-        tr = trunc_core(wl)
-        nan = lo_ops.is_nan32(wl)
-        in_s = (tr >= jnp.float32(-2147483648.0)) & \
-            (tr <= jnp.float32(2147483520.0))
-        return jnp.where(in_s & ~nan, tr, jnp.float32(0)).astype(I32)
-
-    def trunc_u(wl):
-        tr = trunc_core(wl)
-        nan = lo_ops.is_nan32(wl)
-        in_u = (tr >= 0) & (tr <= jnp.float32(4294967040.0))
-        t = jnp.where(in_u & ~nan, tr, jnp.float32(0))
-        return jnp.where(t >= jnp.float32(2147483648.0),
-                         (t - jnp.float32(4294967296.0)).astype(I32),
-                         t.astype(I32))
-
-    def sat_s(wl):
-        tr = trunc_core(wl)
-        nan = lo_ops.is_nan32(wl)
-        return jnp.where(
-            nan, 0,
-            jnp.where(tr < jnp.float32(-2147483648.0), jnp.int32(-0x80000000),
-                      jnp.where(tr > jnp.float32(2147483520.0),
-                                jnp.int32(0x7FFFFFFF), trunc_s(wl))))
-
-    def sat_u(wl):
-        tr = trunc_core(wl)
-        nan = lo_ops.is_nan32(wl)
-        return jnp.where(nan | (tr < 0), 0,
-                         jnp.where(tr > jnp.float32(4294967040.0),
-                                   jnp.int32(-1), trunc_u(wl)))
-
-    return {
-        A1["i32.clz"]: lambda wl, wh: (lax.clz(wl), z_of(wl)),
-        A1["i32.ctz"]: lambda wl, wh: (lo_ops.ctz32(wl), z_of(wl)),
-        A1["i32.popcnt"]: lambda wl, wh: (lax.population_count(wl), z_of(wl)),
-        A1["i32.eqz"]: lambda wl, wh: (b2i(wl == 0), z_of(wl)),
-        A1["i32.extend8_s"]: lambda wl, wh: (sext8(wl), z_of(wl)),
-        A1["i32.extend16_s"]: lambda wl, wh: (sext16(wl), z_of(wl)),
-        A1["i64.clz"]: lambda wl, wh: (lo_ops.clz64(wl, wh), z_of(wl)),
-        A1["i64.ctz"]: lambda wl, wh: (lo_ops.ctz64(wl, wh), z_of(wl)),
-        A1["i64.popcnt"]: lambda wl, wh: (lo_ops.popcnt64(wl, wh), z_of(wl)),
-        A1["i64.eqz"]: lambda wl, wh: (b2i((wl | wh) == 0), z_of(wl)),
-        A1["i64.extend8_s"]: lambda wl, wh: (
-            sext8(wl), lax.shift_right_arithmetic(sext8(wl), 31)),
-        A1["i64.extend16_s"]: lambda wl, wh: (
-            sext16(wl), lax.shift_right_arithmetic(sext16(wl), 31)),
-        A1["i64.extend32_s"]: lambda wl, wh: (
-            wl, lax.shift_right_arithmetic(wl, 31)),
-        A1["f32.abs"]: lambda wl, wh: (wl & jnp.int32(0x7FFFFFFF), z_of(wl)),
-        A1["f32.neg"]: lambda wl, wh: (wl ^ lo_ops._SIGN, z_of(wl)),
-        A1["f32.ceil"]: lambda wl, wh: (
-            lo_ops.canon32(lo_ops.from_f32(lax.ceil(lo_ops.to_f32(wl)))),
-            z_of(wl)),
-        A1["f32.floor"]: lambda wl, wh: (
-            lo_ops.canon32(lo_ops.from_f32(lax.floor(lo_ops.to_f32(wl)))),
-            z_of(wl)),
-        A1["f32.trunc"]: lambda wl, wh: (lo_ops.f32_trunc(wl), z_of(wl)),
-        A1["f32.nearest"]: lambda wl, wh: (lo_ops.f32_nearest(wl), z_of(wl)),
-        A1["f32.sqrt"]: lambda wl, wh: (
-            lo_ops.canon32(lo_ops.from_f32(lax.sqrt(lo_ops.to_f32(wl)))),
-            z_of(wl)),
-        A1["i32.wrap_i64"]: lambda wl, wh: (wl, z_of(wl)),
-        A1["i64.extend_i32_s"]: lambda wl, wh: (
-            wl, lax.shift_right_arithmetic(wl, 31)),
-        A1["i64.extend_i32_u"]: lambda wl, wh: (wl, z_of(wl)),
-        A1["i32.trunc_f32_s"]: lambda wl, wh: (trunc_s(wl), z_of(wl)),
-        A1["i32.trunc_f32_u"]: lambda wl, wh: (trunc_u(wl), z_of(wl)),
-        A1["i32.trunc_sat_f32_s"]: lambda wl, wh: (sat_s(wl), z_of(wl)),
-        A1["i32.trunc_sat_f32_u"]: lambda wl, wh: (sat_u(wl), z_of(wl)),
-        A1["f32.convert_i32_s"]: lambda wl, wh: (
-            lo_ops.from_f32(wl.astype(jnp.float32)), z_of(wl)),
-        A1["f32.convert_i32_u"]: lambda wl, wh: (
-            lo_ops.from_f32(wl.astype(jnp.uint32).astype(jnp.float32)),
-            z_of(wl)),
-        A1["i32.reinterpret_f32"]: lambda wl, wh: (wl, z_of(wl)),
-        A1["f32.reinterpret_i32"]: lambda wl, wh: (wl, z_of(wl)),
-        A1["ref.is_null"]: lambda wl, wh: (b2i((wl | wh) == 0), z_of(wl)),
-    }
 
 
 # ALU2 subs that can trap (div/rem)

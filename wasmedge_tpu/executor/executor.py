@@ -80,6 +80,9 @@ class Executor:
         self.stat = stat
         self._active_tokens: set = set()
         self._token_lock = threading.Lock()
+        # why the last NATIVE-engine invoke fell back to the Python engine
+        # (None after a successful native run)
+        self.native_fallback_reason: Optional[str] = None
 
     def stop(self):
         """Interrupt every execution currently in flight (reference:
@@ -306,12 +309,16 @@ class Executor:
             self.native_fallback_reason = (
                 nm.reason if nm else "native engine unavailable")
             return None
+        self.native_fallback_reason = None
         import numpy as np
 
         cell = np.zeros(1, np.int32)
-        if token:  # a stop() that raced ahead of cell attachment
-            cell[0] = 1
+        # Attach first, THEN mirror the flag: a stop() that lands between
+        # the two writes either sees the cell (and sets it) or set _flag
+        # before our read — either way the loop observes it.
         token.native_cell = cell
+        if token:
+            cell[0] = 1
         try:
             out, retired = nm.invoke(
                 fi.func_idx, raw_args,

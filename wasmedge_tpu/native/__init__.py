@@ -160,6 +160,7 @@ class NativeModule:
     def __init__(self, inst, store=None):
         self.inst = inst
         self.reason: Optional[str] = None
+        self._membuf = None  # cached memory transfer buffer
         self._prep(inst, store)
 
     def _prep(self, inst, store):
@@ -288,12 +289,19 @@ class NativeModule:
             cur_pages = m.pages
             max_pages = m.page_limit if m.max is None \
                 else min(m.max, m.page_limit)
-            # np.zeros maps lazily (calloc) — a large declared max costs
-            # only the pages actually grown into.
-            buf = np.zeros(max_pages * 65536, np.uint8)
+            # Reuse one max-pages transfer buffer across invokes (np.zeros
+            # maps lazily via calloc, so the declared max costs only the
+            # pages actually touched).  m.data stays authoritative between
+            # calls: copy in before, copy back after.
+            buf = self._membuf
+            if buf is None or buf.shape[0] != max_pages * 65536:
+                buf = np.zeros(max_pages * 65536, np.uint8)
+                self._membuf = buf
             # copy (not frombuffer view): a live view would pin the
             # bytearray and make the post-run resize raise BufferError
-            buf[:len(m.data)] = np.frombuffer(bytes(m.data), np.uint8)
+            n = len(m.data)
+            buf[:n] = np.frombuffer(bytes(m.data), np.uint8)
+            buf[n:cur_pages * 65536] = 0
         else:
             cur_pages = 0
             max_pages = 0

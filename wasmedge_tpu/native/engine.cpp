@@ -455,9 +455,9 @@ extern "C" int32_t we_native_invoke(
         pc++;
         break;
       case OP_memory_grow: {
-        uint32_t delta = (uint32_t)POP();
-        uint32_t nw = (uint32_t)cur_pages + delta;
-        if (nw > (uint32_t)max_pages || nw > 65536u) {
+        uint64_t delta = (uint32_t)POP();
+        uint64_t nw = (uint64_t)(uint32_t)cur_pages + delta;  // no u32 wrap
+        if (nw > (uint64_t)(uint32_t)max_pages || nw > 65536u) {
           PUSH(u32c((uint32_t)-1));
         } else {
           PUSH((cell)(uint32_t)cur_pages);

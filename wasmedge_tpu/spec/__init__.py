@@ -1,0 +1,292 @@
+"""Spec conformance harness: wast scripts through the engine callback seam.
+
+This is the analog of the reference's SpecTest driver
+(/root/reference/test/spec/spectest.cpp:1-668, spectest.h:62-90): a script
+runner that owns command semantics (module/register/invoke/assert_*) and
+delegates every engine interaction to injectable callbacks, so any engine
+(Python oracle, native C++, a future batch harness) runs the same corpus
+by swapping the callbacks.  Assertions cover return values with NaN
+pattern classes (`nan:canonical` / `nan:arithmetic`, spectest.cpp:150-210),
+trap *messages* mapped from ErrCodes the way the reference maps them, and
+malformed/invalid module phase errors.
+
+The corpus itself lives in tests/spec/*.wast — authored for this project
+in the official testsuite's format (the official corpus is fetched over
+the network by the reference build and is not available in this image; the
+text front-end wasmedge_tpu/utils/wat.py can ingest it unchanged when it
+is).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+from wasmedge_tpu.common.configure import Configure, EngineKind
+from wasmedge_tpu.common.errors import (
+    ErrCode,
+    LoadError,
+    TrapError,
+    ValidationError,
+)
+from wasmedge_tpu.utils.wat import (
+    SExpr,
+    WastCommand,
+    WatError,
+    compile_module_fields,
+    parse_wast,
+)
+
+# ErrCode -> spec trap message (reference: test/spec/spectest.cpp maps the
+# same strings; WasmEdge's ErrCodeStr)
+TRAP_MESSAGES = {
+    ErrCode.DivideByZero: "integer divide by zero",
+    ErrCode.IntegerOverflow: "integer overflow",
+    ErrCode.InvalidConvToInt: "invalid conversion to integer",
+    ErrCode.MemoryOutOfBounds: "out of bounds memory access",
+    ErrCode.TableOutOfBounds: "out of bounds table access",
+    ErrCode.Unreachable: "unreachable",
+    ErrCode.UndefinedElement: "undefined element",
+    ErrCode.UninitializedElement: "uninitialized element",
+    ErrCode.IndirectCallTypeMismatch: "indirect call type mismatch",
+    ErrCode.CallStackExhausted: "call stack exhausted",
+    ErrCode.StackOverflow: "call stack exhausted",
+}
+
+F32_QUIET = 0x00400000
+F64_QUIET = 0x0008000000000000
+
+
+def _is_canonical_nan(bits: int, is32: bool) -> bool:
+    if is32:
+        return bits & 0x7FFFFFFF == 0x7FC00000
+    return bits & 0x7FFFFFFFFFFFFFFF == 0x7FF8000000000000
+
+
+def _is_arithmetic_nan(bits: int, is32: bool) -> bool:
+    if is32:
+        return (bits & 0x7F800000) == 0x7F800000 and bits & F32_QUIET
+    return (bits & 0x7FF0000000000000) == 0x7FF0000000000000 and \
+        bits & F64_QUIET
+
+
+@dataclasses.dataclass
+class SpecFailure:
+    script: str
+    index: int
+    kind: str
+    detail: str
+
+    def __str__(self):
+        return f"{self.script}[{self.index}] {self.kind}: {self.detail}"
+
+
+@dataclasses.dataclass
+class SpecReport:
+    passed: int = 0
+    failed: int = 0
+    skipped: int = 0
+    failures: List[SpecFailure] = dataclasses.field(default_factory=list)
+
+    def merge(self, other: "SpecReport"):
+        self.passed += other.passed
+        self.failed += other.failed
+        self.skipped += other.skipped
+        self.failures.extend(other.failures)
+
+
+class SpecTest:
+    """Callback-seam script runner (spectest.h:62-90 model).
+
+    Callbacks:
+      on_module(name, data)   compile+instantiate binary; returns handle
+      on_invoke(handle, field, raw_args) -> raw result cells
+      on_register(handle, as_name)
+    Raise LoadError/ValidationError/TrapError to signal phase failures.
+    """
+
+    def __init__(self, on_module: Callable, on_invoke: Callable,
+                 on_register: Optional[Callable] = None):
+        self.on_module = on_module
+        self.on_invoke = on_invoke
+        self.on_register = on_register
+
+    # -- value comparison -------------------------------------------------
+    @staticmethod
+    def _match_value(expected, got: int) -> bool:
+        ty, want = expected
+        if ty == "f32" and want == "nan:canonical":
+            return _is_canonical_nan(got & 0xFFFFFFFF, True)
+        if ty == "f32" and want == "nan:arithmetic":
+            return bool(_is_arithmetic_nan(got & 0xFFFFFFFF, True))
+        if ty == "f64" and want == "nan:canonical":
+            return _is_canonical_nan(got, False)
+        if ty == "f64" and want == "nan:arithmetic":
+            return bool(_is_arithmetic_nan(got, False))
+        if ty == "i32" or ty == "f32":
+            return (got & 0xFFFFFFFF) == want
+        return got == want
+
+    def run_script(self, src: str, script_name: str = "script") -> SpecReport:
+        rep = SpecReport()
+        try:
+            cmds = parse_wast(src)
+        except WatError as e:
+            rep.failed += 1
+            rep.failures.append(SpecFailure(script_name, -1, "parse",
+                                            str(e)))
+            return rep
+        current = None
+        named: Dict[str, object] = {}
+
+        def handle_of(mod):
+            return named[mod] if mod else current
+
+        for idx, cmd in enumerate(cmds):
+            try:
+                if cmd.kind in ("module", "module_binary", "module_quote"):
+                    if cmd.kind == "module":
+                        data = compile_module_fields(cmd.fields)
+                    elif cmd.kind == "module_quote":
+                        from wasmedge_tpu.utils.wat import parse_wat
+                        data = parse_wat(cmd.text)
+                    else:
+                        data = cmd.data
+                    current = self.on_module(cmd.name, data)
+                    if cmd.name:
+                        named[cmd.name] = current
+                    rep.passed += 1
+                elif cmd.kind == "register":
+                    if self.on_register is None:
+                        rep.skipped += 1
+                        continue
+                    self.on_register(handle_of(cmd.mod), cmd.as_name)
+                    rep.passed += 1
+                elif cmd.kind == "action":
+                    akind, mod, name, args = cmd.action
+                    self.on_invoke(handle_of(mod), name,
+                                   [a[1] for a in args])
+                    rep.passed += 1
+                elif cmd.kind == "assert_return":
+                    akind, mod, name, args = cmd.action
+                    got = self.on_invoke(handle_of(mod), name,
+                                         [a[1] for a in args])
+                    exp = cmd.expected
+                    ok = len(got) == len(exp) and all(
+                        self._match_value(e, g) for e, g in zip(exp, got))
+                    if ok:
+                        rep.passed += 1
+                    else:
+                        rep.failed += 1
+                        rep.failures.append(SpecFailure(
+                            script_name, idx, "assert_return",
+                            f"{name}{[a[1] for a in args]} -> "
+                            f"{[hex(g) for g in got]}, want "
+                            f"{[(e[0], e[1] if isinstance(e[1], str) else hex(e[1])) for e in exp]}"))
+                elif cmd.kind in ("assert_trap", "assert_exhaustion"):
+                    akind, mod, name, args = cmd.action
+                    try:
+                        self.on_invoke(handle_of(mod), name,
+                                       [a[1] for a in args])
+                        rep.failed += 1
+                        rep.failures.append(SpecFailure(
+                            script_name, idx, cmd.kind,
+                            f"{name} did not trap (want {cmd.message!r})"))
+                    except TrapError as te:
+                        msg = TRAP_MESSAGES.get(te.code, "")
+                        if not cmd.message or \
+                                msg.startswith(cmd.message) or \
+                                cmd.message.startswith(msg.split(" ")[0]):
+                            rep.passed += 1
+                        else:
+                            rep.failed += 1
+                            rep.failures.append(SpecFailure(
+                                script_name, idx, cmd.kind,
+                                f"{name} trapped {te.code!r} ({msg!r}), "
+                                f"want {cmd.message!r}"))
+                elif cmd.kind in ("assert_invalid", "assert_malformed",
+                                  "assert_unlinkable"):
+                    want = {"assert_invalid": ValidationError,
+                            "assert_malformed": LoadError,
+                            "assert_unlinkable": Exception}[cmd.kind]
+                    try:
+                        if cmd.form == "binary":
+                            data = cmd.data
+                        elif cmd.form == "quote":
+                            from wasmedge_tpu.utils.wat import parse_wat
+                            data = parse_wat(cmd.text)
+                        else:
+                            data = compile_module_fields(cmd.fields)
+                        self.on_module(None, data)
+                        rep.failed += 1
+                        rep.failures.append(SpecFailure(
+                            script_name, idx, cmd.kind,
+                            f"module accepted (want {cmd.message!r})"))
+                    except WatError:
+                        # text-level rejection satisfies malformed/invalid
+                        rep.passed += 1
+                    except want:
+                        rep.passed += 1
+                    except (LoadError, ValidationError) as e:
+                        # wrong phase
+                        rep.failed += 1
+                        rep.failures.append(SpecFailure(
+                            script_name, idx, cmd.kind,
+                            f"wrong phase: {type(e).__name__}: {e}"))
+                else:
+                    rep.skipped += 1
+            except Exception as e:  # noqa: BLE001 — each command isolated
+                rep.failed += 1
+                rep.failures.append(SpecFailure(
+                    script_name, idx, cmd.kind,
+                    f"{type(e).__name__}: {e}"))
+        return rep
+
+
+# ---------------------------------------------------------------------------
+# default callbacks: VM-engine staging (Loader->Validator->Executor)
+# ---------------------------------------------------------------------------
+
+
+def make_engine_callbacks(engine: EngineKind = EngineKind.SCALAR,
+                          conf: Optional[Configure] = None):
+    """Callbacks driving the standard staging with a chosen engine —
+    the ExecutorTest / AOTcoreTest pattern (test/executor/
+    ExecutorTest.cpp:40-116): same corpus, engine swapped underneath."""
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    conf = conf or Configure()
+    conf.engine = engine
+    store = StoreManager()
+    ex = Executor(conf)
+
+    def on_module(name, data):
+        mod = Validator(conf).validate(Loader(conf).parse_module(data))
+        inst = ex.instantiate(store, mod, name=name or "")
+        return inst
+
+    def on_invoke(inst, field, raw_args):
+        fi = inst.find_func(field)
+        if fi is None:
+            raise TrapError(ErrCode.FuncNotFound, f"no export {field}")
+        return ex.invoke_raw(store, fi, list(raw_args))
+
+    def on_register(inst, as_name):
+        inst.name = as_name
+        store.register_named(inst)
+
+    return SpecTest(on_module, on_invoke, on_register)
+
+
+def run_corpus(paths, engine: EngineKind = EngineKind.SCALAR) -> SpecReport:
+    """Run .wast files through the chosen engine; fresh store per script."""
+    total = SpecReport()
+    for path in paths:
+        st = make_engine_callbacks(engine)
+        with open(path) as f:
+            src = f.read()
+        total.merge(st.run_script(src, script_name=str(path)))
+    return total
