@@ -1,0 +1,209 @@
+"""Device→host outcall channel: batched host-function calls.
+
+BASELINE config 4's shape (WASI echo, batched) — modules importing host
+functions now run on the batch engines: lanes park at the HOSTCALL stub,
+the host drains them through the same runtime/hostfunc.py layer the
+scalar engine calls, and results/memory effects land back in the SoA
+state lane by lane (wasmedge_tpu/batch/hostcall.py; the reference analog
+is the AOT intrinsics escape, lib/executor/engine/proxy.cpp:45-71).
+"""
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.host.wasi import WasiModule
+from wasmedge_tpu.runtime.hostfunc import ImportObject, PyHostFunction
+from wasmedge_tpu.utils.builder import ModuleBuilder
+from tests.helpers import instantiate
+
+LANES = 8
+
+
+def make_batch(data, imports, conf=None, lanes=LANES, pallas=False):
+    conf = conf or Configure()
+    conf.batch.steps_per_launch = 10_000
+    ex, store, inst = instantiate(data, conf, imports=imports)
+    if pallas:
+        from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
+
+        eng = PallasUniformEngine(inst, store=store, conf=conf, lanes=lanes,
+                                  interpret=True)
+        assert eng.eligible, eng.ineligible_reason
+    else:
+        from wasmedge_tpu.batch import BatchEngine
+
+        eng = BatchEngine(inst, store=store, conf=conf, lanes=lanes)
+    return ex, store, inst, eng
+
+
+def _double_module():
+    b = ModuleBuilder()
+    b.import_func("env", "double", ["i32"], ["i32"])
+    b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("call", 0),
+        ("i32.const", 1), "i32.add",
+    ], export="f")
+    return b.build()
+
+
+def _host_double():
+    imp = ImportObject("env")
+    calls = []
+
+    def double(mem, x):
+        calls.append(x)
+        return x * 2
+
+    imp.add_func("double", PyHostFunction(double, ["i32"], ["i32"]))
+    return imp, calls
+
+
+@pytest.mark.parametrize("pallas", [False, True])
+def test_simple_hostcall_per_lane(pallas):
+    imp, calls = _host_double()
+    ex, store, inst, eng = make_batch(_double_module(), [imp], pallas=pallas)
+    args = np.arange(LANES, dtype=np.int64) * 10
+    res = eng.run("f", [args], max_steps=10_000)
+    assert (res.trap == -1).all()
+    assert (res.results[0] == args * 2 + 1).all()
+    assert sorted(calls) == sorted(args.tolist())
+
+
+def test_hostcall_memory_effects():
+    """Host writes into each lane's isolated linear memory."""
+    imp = ImportObject("env")
+
+    def poke(mem, addr, val):
+        mem.store(addr, 4, val & 0xFFFFFFFF)
+        return val + 1
+
+    imp.add_func("poke", PyHostFunction(poke, ["i32", "i32"], ["i32"]))
+    b = ModuleBuilder()
+    b.import_func("env", "poke", ["i32", "i32"], ["i32"])
+    b.add_memory(1, 1)
+    b.add_function(["i32"], ["i32"], [], [
+        ("i32.const", 64), ("local.get", 0), ("call", 0),
+        ("i32.const", 64), ("i32.load", 2, 0), "i32.add",
+    ], export="f")
+    ex, store, inst, eng = make_batch(b.build(), [imp])
+    vals = np.arange(LANES, dtype=np.int64) + 100
+    res = eng.run("f", [vals], max_steps=10_000)
+    assert (res.trap == -1).all()
+    # poke returns val+1; load returns val -> result = 2*val + 1
+    assert (res.results[0] == 2 * vals + 1).all()
+
+
+def test_hostcall_trap_propagates():
+    from wasmedge_tpu.common.errors import ErrCode, trap
+
+    imp = ImportObject("env")
+
+    def bad(mem, x):
+        if x == 3:
+            trap(ErrCode.ExecutionFailed)
+        return x
+
+    imp.add_func("id_or_trap", PyHostFunction(bad, ["i32"], ["i32"]))
+    b = ModuleBuilder()
+    b.import_func("env", "id_or_trap", ["i32"], ["i32"])
+    b.add_function(["i32"], ["i32"], [],
+                   [("local.get", 0), ("call", 0)], export="f")
+    ex, store, inst, eng = make_batch(b.build(), [imp])
+    args = np.arange(LANES, dtype=np.int64)
+    res = eng.run("f", [args], max_steps=10_000)
+    assert res.trap[3] == int(ErrCode.ExecutionFailed)
+    ok = [i for i in range(LANES) if i != 3]
+    assert (res.trap[ok] == -1).all()
+    assert (res.results[0][ok] == args[ok]).all()
+
+
+def test_wasi_echo_batched_matches_scalar(tmp_path):
+    """BASELINE config 4: WASI echo with --batch semantics.
+
+    Each lane writes its own memory's message via fd_write to a shared
+    capture file; the batch output must be the scalar instance's output
+    once per lane."""
+    b = ModuleBuilder()
+    b.import_func("wasi_snapshot_preview1", "fd_write",
+                  ["i32", "i32", "i32", "i32"], ["i32"])
+    b.add_memory(1, 1)
+    b.add_active_data(0, [("i32.const", 64)], b"hello from wasm\n")
+    b.add_function([], ["i32"], [], [
+        # iovec at 0: {buf=64, len=16}
+        ("i32.const", 0), ("i32.const", 64), ("i32.store", 2, 0),
+        ("i32.const", 4), ("i32.const", 16), ("i32.store", 2, 0),
+        ("i32.const", 1),   # fd: stdout
+        ("i32.const", 0),   # iovs
+        ("i32.const", 1),   # iovs_len
+        ("i32.const", 32),  # nwritten ptr
+        ("call", 0),
+    ], export="echo")
+    data = b.build()
+
+    # scalar reference output
+    scal_out = tmp_path / "scalar.out"
+    with open(scal_out, "w+b") as fh:
+        wasi = WasiModule()
+        wasi.init_wasi()
+        wasi.env.fds[1].os_fd = fh.fileno()  # capture guest stdout
+        ex, store, inst = instantiate(data, Configure(), imports=[wasi])
+        r = ex.invoke(store, inst.find_func("echo"), [])
+        assert r == [0]
+    expected = open(scal_out, "rb").read()
+    assert expected == b"hello from wasm\n"
+
+    batch_out = tmp_path / "batch.out"
+    with open(batch_out, "w+b") as fh:
+        wasi = WasiModule()
+        wasi.init_wasi()
+        wasi.env.fds[1].os_fd = fh.fileno()
+        ex, store, inst, eng = make_batch(data, [wasi])
+        res = eng.run("echo", [], max_steps=100_000)
+        assert (res.trap == -1).all()
+        assert (res.results[0] == 0).all()
+    assert open(batch_out, "rb").read() == expected * LANES
+
+
+def test_hostcall_loop_bounded_by_max_steps():
+    """A guest looping over host calls must stop at max_steps (pallas)."""
+    imp = ImportObject("env")
+    imp.add_func("h", PyHostFunction(lambda mem: None, [], []))
+    b = ModuleBuilder()
+    b.import_func("env", "h", [], [])
+    b.add_function([], [], [],
+                   [("loop", None), ("call", 0), ("br", 0), "end"],
+                   export="spin")
+    conf = Configure()
+    conf.batch.steps_per_launch = 50
+    ex, store, inst, eng = make_batch(b.build(), [imp], conf=conf,
+                                      pallas=True)
+    res = eng.run("spin", [], max_steps=400)
+    assert res.steps <= 500  # bounded, not hung
+
+
+def test_hostcall_mixed_traps_no_duplicate_calls():
+    """Served lanes' host calls must not re-run after a mixed-trap
+    handoff (side effects would double)."""
+    from wasmedge_tpu.common.errors import ErrCode, trap
+
+    calls = []
+    imp = ImportObject("env")
+
+    def bad(mem, x):
+        calls.append(x)
+        if x == 3:
+            trap(ErrCode.ExecutionFailed)
+        return x
+
+    imp.add_func("f", PyHostFunction(bad, ["i32"], ["i32"]))
+    b = ModuleBuilder()
+    b.import_func("env", "f", ["i32"], ["i32"])
+    b.add_function(["i32"], ["i32"], [],
+                   [("local.get", 0), ("call", 0)], export="g")
+    ex, store, inst, eng = make_batch(b.build(), [imp], pallas=True)
+    res = eng.run("g", [np.arange(LANES, dtype=np.int64)], max_steps=10_000)
+    assert sorted(calls) == list(range(LANES))
+    assert res.trap[3] == int(ErrCode.ExecutionFailed)
+    ok = [i for i in range(LANES) if i != 3]
+    assert (res.results[0][ok] == np.arange(LANES)[ok]).all()

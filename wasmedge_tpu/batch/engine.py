@@ -45,6 +45,7 @@ from wasmedge_tpu.batch.image import (
     CLS_DROP,
     CLS_GLOBAL_GET,
     CLS_GLOBAL_SET,
+    CLS_HOSTCALL,
     CLS_LOAD,
     CLS_LOCAL_GET,
     CLS_LOCAL_SET,
@@ -55,7 +56,9 @@ from wasmedge_tpu.batch.image import (
     CLS_SELECT,
     CLS_STORE,
     CLS_TRAP,
+    NUM_CLASSES,
     TRAP_DONE,
+    TRAP_HOSTCALL,
     DeviceImage,
     _F32_BIN,
     _I32_BIN,
@@ -181,7 +184,7 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         g_lo = jnp.take_along_axis(st.glob_lo, gidx[None, :], axis=0)[0]
         g_hi = jnp.take_along_axis(st.glob_hi, gidx[None, :], axis=0)[0]
 
-        is_cls = [cls == k for k in range(23)]
+        is_cls = [cls == k for k in range(NUM_CLASSES)]
         trap = st.trap
 
         # =================== ALU2 ===================
@@ -635,6 +638,8 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         new_trap = trap
         for m, code in (
             (is_cls[CLS_TRAP], a),
+            # park at the stub; the host outcall loop re-arms the lane
+            (is_cls[CLS_HOSTCALL], jnp.int32(TRAP_HOSTCALL)),
             (alu2_trap != 0, alu2_trap),
             (alu1_trap != 0, alu1_trap),
             ((is_load | is_store) & mem_oob,
@@ -698,7 +703,9 @@ class BatchEngine:
         self.cfg = cfg
         self.lanes = lanes or cfg.lanes
         self.inst = inst
-        reason = batchability(inst.lowered)
+        host_imports = {i for i, f in enumerate(inst.funcs)
+                        if getattr(f, "kind", None) == "host"}
+        reason = batchability(inst.lowered, host_imports=host_imports)
         if reason is not None:
             raise ValueError(f"module not batchable: {reason}")
         self.img = build_device_image(
@@ -838,15 +845,7 @@ class BatchEngine:
             from wasmedge_tpu.parallel.mesh import shard_batch_state
 
             state = shard_batch_state(state, self.mesh)
-        total = 0
-        while total < max_steps:
-            done_steps, state = self._run_chunk(state)
-            total += int(done_steps)
-            trap_host = np.asarray(state.trap)
-            if not (trap_host == 0).any():
-                break
-            if int(done_steps) == 0:
-                break
+        state, total = self.run_from_state(state, 0, max_steps)
         nres = int(self.inst.lowered.funcs[func_idx].nresults)
         stack_lo = np.asarray(state.stack_lo)
         stack_hi = np.asarray(state.stack_hi)
@@ -862,3 +861,24 @@ class BatchEngine:
             retired=np.asarray(state.retired),
             steps=total,
         )
+
+    def run_from_state(self, state, total: int, max_steps: int):
+        """Chunk loop from an arbitrary state (used directly and by the
+        uniform/pallas engines\' divergence handoff), serving host
+        outcalls between chunks (batch/hostcall.py)."""
+        from wasmedge_tpu.batch.hostcall import serve_batch_state
+
+        if self._run_chunk is None:
+            self._build()
+        while total < max_steps:
+            done_steps, state = self._run_chunk(state)
+            total += int(done_steps)
+            trap_host = np.asarray(state.trap)
+            if (trap_host == TRAP_HOSTCALL).any():
+                state = serve_batch_state(self, state)
+                continue
+            if not (trap_host == 0).any():
+                break
+            if int(done_steps) == 0:
+                break
+        return state, total

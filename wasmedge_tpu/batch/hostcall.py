@@ -1,0 +1,131 @@
+"""Device→host outcall channel: batched host-function (WASI) calls.
+
+This is the TPU-native analog of the reference's AOT intrinsics escape
+(/root/reference/lib/executor/engine/proxy.cpp:45-71) designed in
+SURVEY.md §5.8: a lane that calls an imported host function parks at a
+synthetic HOSTCALL stub (batch/image.py appends one per import) with its
+frame already pushed, the engine marks it waiting (TRAP_HOSTCALL in the
+trap plane / ST_HOSTCALL block status), and the host step-loop drains the
+waiting lanes through the ordinary Python host-function layer
+(runtime/hostfunc.py — the same WASI functions the scalar engine calls),
+writes results and memory effects back into the SoA state, and re-arms
+the lanes while the rest of the batch keeps stepping.
+
+Sandbox model: all lanes share the host module instances registered with
+the store (one WASI environ / fd table), like threads of one OS process;
+per-lane data (args, results, linear memory) is fully isolated.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from wasmedge_tpu.common.errors import ErrCode, TrapError
+from wasmedge_tpu.runtime.instance import MemoryInstance
+
+MASK32 = 0xFFFFFFFF
+
+
+class _LaneMemory(MemoryInstance):
+    """MemoryInstance view over one lane's column of the [W, lanes] plane."""
+
+    def __init__(self, data: bytearray, max_pages: Optional[int],
+                 page_limit: int):
+        # bypass MemoryInstance.__init__ (no ast.MemoryType at hand)
+        self.min = len(data) // 65536
+        self.max = max_pages
+        self.page_limit = page_limit
+        self.data = data
+
+
+def lane_memory_bytes(mem_plane: np.ndarray, lane: int, pages: int) -> bytearray:
+    """Extract one lane's linear memory as bytes (word-major plane)."""
+    col = np.ascontiguousarray(mem_plane[:, lane])
+    return bytearray(col.view(np.uint8)[: pages * 65536].tobytes())
+
+
+def store_lane_memory(mem_plane: np.ndarray, lane: int, data: bytearray):
+    nwords = (len(data) + 3) // 4
+    raw = np.frombuffer(bytes(data) + b"\x00" * (nwords * 4 - len(data)),
+                        dtype=np.int32)
+    mem_plane[:nwords, lane] = raw
+
+
+def serve_one(inst, import_idx: int, args_cells: List[int],
+              lane_mem: Optional[_LaneMemory]) -> Tuple[List[int], int]:
+    """Run one lane's host call. Returns (result_cells, trap_code)."""
+    fi = inst.funcs[import_idx]
+    if fi.kind != "host":
+        return [], int(ErrCode.ExecutionFailed)
+    try:
+        out = fi.host.run(lane_mem, list(args_cells))
+        return out, 0
+    except TrapError as te:
+        return [], int(te.code)
+
+
+def serve_batch_state(engine, state):
+    """Serve all TRAP_HOSTCALL lanes of a SIMT BatchState; returns the
+    updated state (device arrays refreshed only where touched)."""
+    import jax.numpy as jnp
+
+    from wasmedge_tpu.batch.image import TRAP_HOSTCALL
+
+    inst = engine.inst
+    img = engine.img
+    trap = np.asarray(state.trap)
+    waiting = np.nonzero(trap == TRAP_HOSTCALL)[0]
+    if waiting.size == 0:
+        return state
+    pc = np.asarray(state.pc)
+    fp = np.asarray(state.fp)
+    opbase = np.asarray(state.opbase)
+    sp = np.asarray(state.sp).copy()
+    pages = np.asarray(state.mem_pages)
+    stack_lo = np.asarray(state.stack_lo).copy()
+    stack_hi = np.asarray(state.stack_hi).copy()
+    has_mem = img.has_memory
+    mem_plane = np.asarray(state.mem).copy() if has_mem else None
+    new_trap = trap.copy()
+    new_pc = pc.copy()
+    max_pages = img.mem_pages_max if img.mem_pages_max > 0 else None
+
+    for lane in waiting:
+        k = int(img.a[pc[lane]])
+        fi = inst.funcs[k]
+        nargs = len(fi.functype.params)
+        base = int(fp[lane])
+        args = []
+        for i in range(nargs):
+            lo = int(np.uint32(stack_lo[base + i, lane]))
+            hi = int(np.uint32(stack_hi[base + i, lane]))
+            args.append(lo | (hi << 32))
+        lane_mem = None
+        if has_mem:
+            lane_mem = _LaneMemory(
+                lane_memory_bytes(mem_plane, lane, int(pages[lane])),
+                max_pages, int(pages[lane]))
+        out, code = serve_one(inst, k, args, lane_mem)
+        if code:
+            new_trap[lane] = code
+            continue
+        ob = int(opbase[lane])
+        for i, cell in enumerate(out):
+            stack_lo[ob + i, lane] = np.int32(np.uint32(cell & MASK32))
+            stack_hi[ob + i, lane] = np.int32(np.uint32((cell >> 32) & MASK32))
+        sp[lane] = ob + len(out)
+        if has_mem:
+            store_lane_memory(mem_plane, lane, lane_mem.data)
+        new_trap[lane] = 0
+        new_pc[lane] = pc[lane] + 1  # resume at the stub's RETURN
+
+    kw = dict(
+        pc=jnp.asarray(new_pc), sp=jnp.asarray(sp),
+        trap=jnp.asarray(new_trap),
+        stack_lo=jnp.asarray(stack_lo), stack_hi=jnp.asarray(stack_hi),
+    )
+    if has_mem:
+        kw["mem"] = jnp.asarray(mem_plane)
+    return state._replace(**kw)

@@ -51,7 +51,8 @@ CLS_STORE = 19
 CLS_MEMSIZE = 20
 CLS_MEMGROW = 21
 CLS_TRAP = 22
-NUM_CLASSES = 23
+CLS_HOSTCALL = 23  # synthetic stub: park lane for the host outcall channel
+NUM_CLASSES = 24
 
 # -- ALU2 sub-ops (binary: pop2 push1) --------------------------------------
 _I32_BIN = ["add", "sub", "mul", "div_s", "div_u", "rem_s", "rem_u", "and",
@@ -138,11 +139,18 @@ def _i32(v: int) -> np.int32:
     return np.int32(v - (1 << 32) if v >= (1 << 31) else v)
 
 
-def batchability(image: LoweredModule) -> Optional[str]:
-    """None if the module image can run on the batch engine, else reason."""
-    for fn in image.funcs:
+def batchability(image: LoweredModule,
+                 host_imports: Optional[set] = None) -> Optional[str]:
+    """None if the module image can run on the batch engine, else reason.
+
+    host_imports: func indices backed by host functions the engine can
+    serve through the outcall channel (batch/hostcall.py); imports outside
+    it (e.g. cross-module wasm imports) stay unbatchable."""
+    for idx, fn in enumerate(image.funcs):
         if fn.is_import:
-            return f"host/imported function {fn.import_module}.{fn.import_name}"
+            if host_imports is None or idx not in host_imports:
+                return (f"unservable imported function "
+                        f"{fn.import_module}.{fn.import_name}")
         if fn.nresults > 1:
             return "multi-value results"
     for pc in range(image.code_len):
@@ -201,7 +209,14 @@ class DeviceImage:
 
 def build_device_image(image: LoweredModule, memories=None, globals_=None,
                        table0=None, mod=None) -> DeviceImage:
-    n = image.code_len
+    # Imported (host) functions get a 2-instruction synthetic stub after
+    # the module code: HOSTCALL (parks the lane; the host writes results
+    # at the frame's operand base and re-arms at the next pc) followed by
+    # RETURN.  f_entry points imports at their stub, so CALL needs no
+    # special casing — the reference's 3-way enterFunction dispatch
+    # (helper.cpp:35-97) becomes one extra opcode class.
+    imports = [i for i, fn in enumerate(image.funcs) if fn.is_import]
+    n = image.code_len + 2 * len(imports)
     cls = np.zeros(n, np.int32)
     sub = np.zeros(n, np.int32)
     a = np.zeros(n, np.int32)
@@ -233,7 +248,16 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
     consts = {Op.i32_const, Op.i64_const, Op.f32_const, Op.f64_const}
     op_return = NAME_TO_ID["return"]
 
-    for pc in range(n):
+    stub_pc = {}
+    for si, k in enumerate(imports):
+        at = image.code_len + 2 * si
+        stub_pc[k] = at
+        cls[at] = CLS_HOSTCALL
+        a[at] = k
+        cls[at + 1] = CLS_RETURN
+        b[at + 1] = image.funcs[k].nresults
+
+    for pc in range(image.code_len):
         op = image.op[pc]
         ia, ib, ic, imm = image.a[pc], image.b[pc], image.c[pc], image.imm[pc]
         if op == LOP_BR:
@@ -309,6 +333,14 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
     f_type = np.zeros(nf, np.int32)
     max_zeros = 0
     for i, fn in enumerate(image.funcs):
+        if fn.is_import:
+            f_entry[i] = stub_pc[i]
+            f_nparams[i] = fn.nparams
+            f_nlocals[i] = fn.nparams
+            f_nresults[i] = fn.nresults
+            f_frame_top[i] = fn.nparams + max(fn.nresults, 1)
+            f_type[i] = _dense_type(fn.type_idx)
+            continue
         f_entry[i] = fn.entry_pc
         f_nparams[i] = fn.nparams
         f_nlocals[i] = fn.nlocals

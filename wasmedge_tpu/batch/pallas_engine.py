@@ -61,6 +61,7 @@ from wasmedge_tpu.batch.image import (
     CLS_DROP,
     CLS_GLOBAL_GET,
     CLS_GLOBAL_SET,
+    CLS_HOSTCALL,
     CLS_LOAD,
     CLS_LOCAL_GET,
     CLS_LOCAL_SET,
@@ -102,7 +103,8 @@ H_MEMGROW = 17
 H_TRAP = 18
 H_LOAD = 19
 H_STORE = 20
-H_ALU2_BASE = 21                      # + sub (63 subs)
+H_HOSTCALL = 21
+H_ALU2_BASE = 22                      # + sub (63 subs)
 H_ALU1_BASE = H_ALU2_BASE + 63        # + sub (32 subs)
 NUM_HANDLERS = H_ALU1_BASE + 32
 
@@ -114,13 +116,14 @@ _CLS_TO_HID = {
     CLS_BRNZ: H_BRNZ, CLS_BR_TABLE: H_BR_TABLE, CLS_RETURN: H_RETURN,
     CLS_CALL: H_CALL, CLS_CALL_INDIRECT: H_CALL_INDIRECT,
     CLS_MEMSIZE: H_MEMSIZE, CLS_MEMGROW: H_MEMGROW, CLS_TRAP: H_TRAP,
-    CLS_LOAD: H_LOAD, CLS_STORE: H_STORE,
+    CLS_LOAD: H_LOAD, CLS_STORE: H_STORE, CLS_HOSTCALL: H_HOSTCALL,
 }
 
 # status values (shared with batch/uniform.py)
 ST_RUNNING = 0
 ST_DONE = 1
 ST_DIVERGED = 2
+ST_HOSTCALL = 3  # block parked at a host outcall stub
 ST_TRAPPED_BASE = 16
 
 _PAGE_WORDS = 65536 // 4
@@ -518,6 +521,11 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             trapr[0, :] = jnp.full((Lblk,), code, I32)
             return keep(c, status=I32(ST_TRAPPED_BASE) + code)
 
+        def h_hostcall(c):
+            # park the block; the host serves every lane then re-arms at
+            # pc+1 (the stub RETURN) with sp = opbase + nresults
+            return keep(c, status=I32(ST_HOSTCALL))
+
         # ---- memory access ------------------------------------------
         def _gather_word(widx):
             """Per-lane word gather from [W, Lblk] by chunked
@@ -788,7 +796,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             H_BRNZ: h_brnz, H_BR_TABLE: h_br_table, H_RETURN: h_return,
             H_CALL: h_call, H_CALL_INDIRECT: h_call_indirect,
             H_MEMSIZE: h_memsize, H_MEMGROW: h_memgrow, H_TRAP: h_trap,
-            H_LOAD: h_load, H_STORE: h_store,
+            H_LOAD: h_load, H_STORE: h_store, H_HOSTCALL: h_hostcall,
         }
 
         def handler_for(hid):
@@ -1116,6 +1124,10 @@ class PallasUniformEngine:
             ctrl_np = np.asarray(state[0])
             steps_per_block += ctrl_np[:, _C_STEPS].astype(np.int64)
             statuses = ctrl_np[:, _C_STATUS]
+            if (statuses == ST_HOSTCALL).any() and \
+                    int(steps_per_block.max()) < max_steps:
+                state = self._serve_hostcalls(state, ctrl_np)
+                continue
             if (statuses == ST_RUNNING).any() and \
                     int(steps_per_block.max()) < max_steps:
                 continue
@@ -1123,16 +1135,9 @@ class PallasUniformEngine:
         total = int(steps_per_block.max())
         if (statuses == ST_DIVERGED).any():
             self.fell_back_to_simt = True
-            if self.simt._run_chunk is None:
-                self.simt._build()
             simt_state = self._to_simt_state(state, steps_per_block)
-            while total < max_steps:
-                done, simt_state = self.simt._run_chunk(simt_state)
-                total += int(done)
-                if not (np.asarray(simt_state.trap) == 0).any():
-                    break
-                if int(done) == 0:
-                    break
+            simt_state, total = self.simt.run_from_state(
+                simt_state, total, max_steps)
             return self._result(func_idx, simt_state, total)
         # Fast path: pull only the result rows and the trap plane off the
         # device (full-state readback is reserved for the divergence
@@ -1153,6 +1158,102 @@ class PallasUniformEngine:
         return BatchResult(results=results, trap=trap_v,
                            retired=retired,
                            steps=int(steps_per_block.max()))
+
+    def _serve_hostcalls(self, state, ctrl_np):
+        """Drain parked blocks through the host outcall channel
+        (batch/hostcall.py) and re-arm them."""
+        import jax.numpy as jnp
+
+        from wasmedge_tpu.batch.hostcall import (
+            _LaneMemory,
+            lane_memory_bytes,
+            serve_one,
+            store_lane_memory,
+        )
+
+        img = self.img
+        D, CD, W, Lblk = self._geom
+        ctrl = ctrl_np.copy()
+        blocks = np.nonzero(ctrl[:, _C_STATUS] == ST_HOSTCALL)[0]
+        has_mem = img.has_memory
+        mem_np = np.asarray(state[6]).copy() if has_mem else None
+        max_pages = img.mem_pages_max if img.mem_pages_max > 0 else None
+        slo, shi = state[2], state[3]
+        for b in blocks:
+            pc = int(ctrl[b, _C_PC])
+            k = int(img.a[pc])
+            fi = self.inst.funcs[k]
+            nargs = len(fi.functype.params)
+            fp = int(ctrl[b, _C_FP])
+            ob = int(ctrl[b, _C_OB])
+            lanes = range(b * Lblk, (b + 1) * Lblk)
+            args_lo = np.asarray(slo[fp:fp + nargs, b * Lblk:(b + 1) * Lblk])
+            args_hi = np.asarray(shi[fp:fp + nargs, b * Lblk:(b + 1) * Lblk])
+            nres = int(img.f_nresults[k])
+            res_lo = np.zeros((max(nres, 1), Lblk), np.int32)
+            res_hi = np.zeros((max(nres, 1), Lblk), np.int32)
+            trap_codes = np.zeros(Lblk, np.int32)
+            pages = int(ctrl[b, _C_PAGES])
+            for li, lane in enumerate(lanes):
+                args = []
+                for i in range(nargs):
+                    lo = int(np.uint32(args_lo[i, li]))
+                    hi = int(np.uint32(args_hi[i, li]))
+                    args.append(lo | (hi << 32))
+                lane_mem = None
+                if has_mem:
+                    lane_mem = _LaneMemory(
+                        lane_memory_bytes(mem_np, lane, pages),
+                        max_pages, pages)
+                out, code = serve_one(self.inst, k, args, lane_mem)
+                if code:
+                    trap_codes[li] = code
+                    continue
+                for i, cell in enumerate(out):
+                    res_lo[i, li] = np.int32(np.uint32(cell & 0xFFFFFFFF))
+                    res_hi[i, li] = np.int32(
+                        np.uint32((cell >> 32) & 0xFFFFFFFF))
+                if has_mem:
+                    store_lane_memory(mem_np, lane, lane_mem.data)
+            if trap_codes.any():
+                # Per-lane trap outcomes: record the codes, re-arm the
+                # block at pc+1 with the served lanes' results applied
+                # (their host calls MUST NOT re-run), then hand off to
+                # the SIMT engine, which masks dead lanes per-lane.
+                trap_plane = np.asarray(state[7]).copy()
+                seg = trap_plane[0, b * Lblk:(b + 1) * Lblk]
+                seg[:] = np.where(trap_codes != 0, trap_codes, seg)
+                trap_plane[0, b * Lblk:(b + 1) * Lblk] = seg
+                state[7] = jnp.asarray(trap_plane)
+                if (trap_codes != 0).all() and \
+                        len(set(trap_codes.tolist())) == 1:
+                    ctrl[b, _C_STATUS] = ST_TRAPPED_BASE + int(trap_codes[0])
+                    continue
+                if nres:
+                    state[2] = state[2].at[ob:ob + nres,
+                                           b * Lblk:(b + 1) * Lblk].set(
+                        jnp.asarray(res_lo[:nres]))
+                    state[3] = state[3].at[ob:ob + nres,
+                                           b * Lblk:(b + 1) * Lblk].set(
+                        jnp.asarray(res_hi[:nres]))
+                ctrl[b, _C_PC] = pc + 1
+                ctrl[b, _C_SP] = ob + nres
+                ctrl[b, _C_STATUS] = ST_DIVERGED
+                continue
+            if nres:
+                sl = jnp.asarray(res_lo[:nres])
+                sh = jnp.asarray(res_hi[:nres])
+                state[2] = state[2].at[ob:ob + nres,
+                                       b * Lblk:(b + 1) * Lblk].set(sl)
+                state[3] = state[3].at[ob:ob + nres,
+                                       b * Lblk:(b + 1) * Lblk].set(sh)
+            ctrl[b, _C_PC] = pc + 1
+            ctrl[b, _C_SP] = ob + nres
+            ctrl[b, _C_STATUS] = ST_RUNNING
+        if has_mem:
+            state[6] = jnp.asarray(mem_np)
+        state[0] = jnp.asarray(ctrl)
+        return state
 
     def _result(self, func_idx, state, steps):
         from wasmedge_tpu.batch.engine import BatchResult

@@ -40,6 +40,7 @@ from wasmedge_tpu.batch.image import (
     CLS_DROP,
     CLS_GLOBAL_GET,
     CLS_GLOBAL_SET,
+    CLS_HOSTCALL,
     CLS_LOAD,
     CLS_LOCAL_GET,
     CLS_LOCAL_SET,
@@ -544,7 +545,13 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
         return st._replace(trap=jnp.full((lanes,), a, I32),
                            status=jnp.int32(ST_TRAPPED_BASE) + a)
 
+    def h_hostcall(st, f):
+        # host outcalls are served by the SIMT engine\'s loop; hand off
+        # un-advanced so it re-executes the stub and parks the lanes
+        return halt(st, jnp.int32(ST_DIVERGED))
+
     handlers = [None] * NUM_CLASSES
+    handlers[CLS_HOSTCALL] = h_hostcall
     handlers[CLS_NOP] = h_nop
     handlers[CLS_CONST] = h_const
     handlers[CLS_LOCAL_GET] = h_local_get
@@ -747,18 +754,10 @@ class UniformBatchEngine:
             break
         self.fell_back_to_simt = fell_back
         if fell_back:
-            # migrate to SIMT and finish there
-            if self.simt._run_chunk is None:
-                self.simt._build()
+            # migrate to SIMT and finish there (incl. host outcalls)
             state = self._to_simt_state(ust)
-            total = int(ust.steps)
-            while total < max_steps:
-                done, state = self.simt._run_chunk(state)
-                total += int(done)
-                if not (np.asarray(state.trap) == 0).any():
-                    break
-                if int(done) == 0:
-                    break
+            state, total = self.simt.run_from_state(
+                state, int(ust.steps), max_steps)
             return self._result_from_simt(func_idx, state, total)
         # uniform completion
         state = self._to_simt_state(ust)
