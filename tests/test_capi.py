@@ -1,0 +1,287 @@
+"""Embedding-API suite: unit coverage + the spec corpus through the VM
+family — the reference's APIUnitTest + APIVMCoreTest pattern
+(test/api/APIUnitTest.cpp, APIVMCoreTest.cpp:1-244)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu import capi as C
+from wasmedge_tpu.models import build_fib
+from wasmedge_tpu.spec import SpecTest
+from wasmedge_tpu.common.errors import ErrCode, TrapError
+from wasmedge_tpu.utils.builder import ModuleBuilder
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+# ---------------------------------------------------------------------------
+# value / result / configure units
+# ---------------------------------------------------------------------------
+
+def test_value_roundtrips():
+    assert C.we_ValueGetI32(C.we_ValueGenI32(-5)) == -5
+    assert C.we_ValueGetI32(C.we_ValueGenI32(0x7FFFFFFF)) == 0x7FFFFFFF
+    assert C.we_ValueGetI64(C.we_ValueGenI64(-(2**63))) == -(2**63)
+    assert C.we_ValueGetF32(C.we_ValueGenF32(1.5)) == 1.5
+    assert C.we_ValueGetF64(C.we_ValueGenF64(-2.25)) == -2.25
+    v = C.we_ValueGenF32(float("nan"))
+    assert C.we_ValueGetF32(v) != C.we_ValueGetF32(v)  # NaN
+
+
+def test_wasi_host_registration_via_capi():
+    conf = C.we_ConfigureCreate()
+    C.we_ConfigureAddHostRegistration(conf, "wasi")
+    vm = C.we_VMCreate(conf)
+    assert vm.vm.wasi_module is not None
+    b = ModuleBuilder()
+    b.import_func("wasi_snapshot_preview1", "args_sizes_get",
+                  ["i32", "i32"], ["i32"])
+    b.add_memory(1, 1)
+    b.add_function([], ["i32"], [], [
+        ("i32.const", 0), ("i32.const", 8), ("call", 0),
+    ], export="f")
+    res, out = C.we_VMRunWasmFromBuffer(vm, b.build(), "f")
+    assert C.we_ResultOK(res)
+    assert C.we_ValueGetI32(out[0]) == 0  # Errno.SUCCESS
+
+
+def test_arity_mismatch_is_result():
+    vm = C.we_VMCreate()
+    res, out = C.we_VMRunWasmFromBuffer(vm, build_fib(), "fib", [])
+    assert not C.we_ResultOK(res)
+    assert C.we_ResultGetCode(res) == int(ErrCode.FuncSigMismatch)
+
+
+def test_missing_file_is_result():
+    vm = C.we_VMCreate()
+    res, out = C.we_VMRunWasmFromFile(vm, "/nonexistent/x.wasm", "f")
+    assert not C.we_ResultOK(res)
+    assert C.we_ResultGetCode(res) == int(ErrCode.IllegalPath)
+
+
+def test_configure_knobs():
+    conf = C.we_ConfigureCreate()
+    C.we_ConfigureAddProposal(conf, "tail-call")
+    assert C.we_ConfigureHasProposal(conf, "tail-call")
+    assert C.we_ConfigureHasProposal(conf, "simd")  # default-on
+    C.we_ConfigureRemoveProposal(conf, "tail-call")
+    assert not C.we_ConfigureHasProposal(conf, "tail-call")
+    C.we_ConfigureAddHostRegistration(conf, "wasi")
+    assert C.we_ConfigureHasHostRegistration(conf, "wasi")
+    C.we_ConfigureSetMaxMemoryPage(conf, 16)
+    assert C.we_ConfigureGetMaxMemoryPage(conf) == 16
+    C.we_ConfigureSetEngine(conf, "native")
+    assert C.we_ConfigureGetEngine(conf) == "native"
+    C.we_ConfigureStatisticsSetInstructionCounting(conf, True)
+    assert C.we_ConfigureStatisticsIsInstructionCounting(conf)
+
+
+# ---------------------------------------------------------------------------
+# staged pipeline (APIStepsCoreTest model)
+# ---------------------------------------------------------------------------
+
+def test_staged_pipeline():
+    conf = C.we_ConfigureCreate()
+    loader = C.we_LoaderCreate(conf)
+    res, mod = C.we_LoaderParseFromBuffer(loader, build_fib())
+    assert C.we_ResultOK(res)
+    assert C.we_ASTModuleListExports(mod) == [("fib", "func")]
+    validator = C.we_ValidatorCreate(conf)
+    assert C.we_ResultOK(C.we_ValidatorValidate(validator, mod))
+    store = C.we_StoreCreate()
+    ex = C.we_ExecutorCreate(conf)
+    res, inst = C.we_ExecutorInstantiate(ex, store, mod)
+    assert C.we_ResultOK(res)
+    fi = C.we_ModuleInstanceFindFunction(inst, "fib")
+    assert fi is not None
+    res, out = C.we_ExecutorInvoke(ex, store, fi, [C.we_ValueGenI32(10)])
+    assert C.we_ResultOK(res)
+    assert C.we_ValueGetI32(out[0]) == 55
+
+
+def test_malformed_module_result():
+    loader = C.we_LoaderCreate()
+    res, mod = C.we_LoaderParseFromBuffer(loader, b"\x00asm\x02\x00\x00\x00")
+    assert not C.we_ResultOK(res)
+    assert C.we_ResultGetCode(res) == int(ErrCode.MalformedVersion)
+    assert mod is None
+
+
+# ---------------------------------------------------------------------------
+# VM family
+# ---------------------------------------------------------------------------
+
+def test_vm_run_wasm():
+    vm = C.we_VMCreate()
+    res, out = C.we_VMRunWasmFromBuffer(vm, build_fib(), "fib",
+                                        [C.we_ValueGenI32(12)])
+    assert C.we_ResultOK(res)
+    assert C.we_ValueGetI32(out[0]) == 144
+    funcs = C.we_VMGetFunctionList(vm)
+    assert funcs[0][0] == "fib"
+    ft = C.we_VMGetFunctionType(vm, "fib")
+    assert len(ft.params) == 1 and len(ft.results) == 1
+
+
+def test_vm_trap_result():
+    b = ModuleBuilder()
+    b.add_function([], [], [], [("unreachable",)], export="boom")
+    vm = C.we_VMCreate()
+    res, out = C.we_VMRunWasmFromBuffer(vm, b.build(), "boom")
+    assert not C.we_ResultOK(res)
+    assert C.we_ResultGetCode(res) == int(ErrCode.Unreachable)
+
+
+def test_vm_register_and_imports():
+    lib = ModuleBuilder()
+    lib.add_function(["i32"], ["i32"], [],
+                     [("local.get", 0), ("i32.const", 2), "i32.mul"],
+                     export="double")
+    vm = C.we_VMCreate()
+    assert C.we_ResultOK(
+        C.we_VMRegisterModuleFromBuffer(vm, "lib", lib.build()))
+    res, out = C.we_VMExecuteRegistered(vm, "lib", "double",
+                                        [C.we_ValueGenI32(21)])
+    assert C.we_ResultOK(res)
+    assert C.we_ValueGetI32(out[0]) == 42
+
+    # host import object + wasm importing it
+    imp = C.we_ImportObjectCreate("env")
+    seen = []
+    C.we_ImportObjectAddFunction(imp, "note", ["i32"], ["i32"],
+                                 lambda mem, x: (seen.append(x), x + 1)[1])
+    assert C.we_ResultOK(C.we_VMRegisterModuleFromImport(vm, imp))
+    user = ModuleBuilder()
+    user.import_func("env", "note", ["i32"], ["i32"])
+    user.add_function(["i32"], ["i32"], [],
+                      [("local.get", 0), ("call", 0)], export="f")
+    res, out = C.we_VMRunWasmFromBuffer(vm, user.build(), "f",
+                                        [C.we_ValueGenI32(7)])
+    assert C.we_ResultOK(res)
+    assert C.we_ValueGetI32(out[0]) == 8
+    assert seen == [7]
+
+
+def test_vm_async_execute_and_cancel():
+    b = ModuleBuilder()
+    b.add_function([], [], [], [("loop",), ("br", 0), ("end",)],
+                   export="spin")
+    vm = C.we_VMCreate()
+    assert C.we_ResultOK(C.we_VMLoadWasmFromBuffer(vm, b.build()))
+    assert C.we_ResultOK(C.we_VMValidate(vm))
+    assert C.we_ResultOK(C.we_VMInstantiate(vm))
+    h = C.we_VMAsyncExecute(vm, "spin")
+    assert not C.we_AsyncWaitFor(h, 100)
+    C.we_AsyncCancel(h)
+    res, _ = C.we_AsyncGet(h)
+    assert C.we_ResultGetCode(res) == int(ErrCode.Terminated)
+
+
+def test_vm_statistics():
+    conf = C.we_ConfigureCreate()
+    C.we_ConfigureStatisticsSetInstructionCounting(conf, True)
+    vm = C.we_VMCreate(conf)
+    res, out = C.we_VMRunWasmFromBuffer(vm, build_fib(), "fib",
+                                        [C.we_ValueGenI32(10)])
+    assert C.we_ResultOK(res)
+    stat = C.we_VMGetStatisticsContext(vm)
+    assert C.we_StatisticsGetInstrCount(stat) > 100
+
+
+def test_memory_and_global_accessors():
+    b = ModuleBuilder()
+    b.add_memory(1, 2, export="mem")
+    b.add_global("i64", True, [("i64.const", -7)], export="g")
+    b.add_function([], [], [], [], export="noop")
+    vm = C.we_VMCreate()
+    res, _ = C.we_VMRunWasmFromBuffer(vm, b.build(), "noop")
+    assert C.we_ResultOK(res)
+    inst = vm.vm.active_module
+    mem = C.we_ModuleInstanceFindMemory(inst, "mem")
+    assert C.we_MemoryInstanceGetPageSize(mem) == 1
+    assert C.we_ResultOK(C.we_MemoryInstanceSetData(mem, 8, b"\xAA\xBB"))
+    res, data = C.we_MemoryInstanceGetData(mem, 8, 2)
+    assert data == b"\xAA\xBB"
+    assert C.we_ResultOK(C.we_MemoryInstanceGrowPage(mem, 1))
+    assert C.we_MemoryInstanceGetPageSize(mem) == 2
+    assert not C.we_ResultOK(C.we_MemoryInstanceGrowPage(mem, 10))
+    g = C.we_ModuleInstanceFindGlobal(inst, "g")
+    gv = C.we_GlobalInstanceGetValue(g)
+    assert gv.type == "i64"
+    assert C.we_ValueGetI64(gv) == -7
+
+
+def test_vm_batch_extension():
+    vm = C.we_VMCreate()
+    assert C.we_ResultOK(C.we_VMLoadWasmFromBuffer(vm, build_fib()))
+    assert C.we_ResultOK(C.we_VMValidate(vm))
+    assert C.we_ResultOK(C.we_VMInstantiate(vm))
+    res, batch = C.we_VMBatchExecute(
+        vm, "fib", [np.full(8, 10, np.int64)], lanes=8)
+    assert C.we_ResultOK(res)
+    assert (batch.results[0] == 55).all()
+
+
+# ---------------------------------------------------------------------------
+# the spec corpus through the capi VM family (APIVMCoreTest model)
+# ---------------------------------------------------------------------------
+
+def _capi_spec_callbacks():
+    vm = C.we_VMCreate()
+
+    def on_module(name, data):
+        if name:
+            res = C.we_VMRegisterModuleFromBuffer(vm, name.lstrip("$"), data)
+            _raise(res)
+            return ("named", name.lstrip("$"))
+        res = C.we_VMLoadWasmFromBuffer(vm, data)
+        _raise(res)
+        _raise(C.we_VMValidate(vm))
+        _raise(C.we_VMInstantiate(vm))
+        return ("active", None)
+
+    def _raise(res):
+        if not C.we_ResultOK(res):
+            code = ErrCode(C.we_ResultGetCode(res))
+            from wasmedge_tpu.common.errors import (
+                LoadError, ValidationError)
+            msg = C.we_ResultGetMessage(res)
+            if int(code) < 0x40:
+                raise LoadError(code, msg)
+            if int(code) < 0x80:
+                raise ValidationError(code, msg)
+            raise TrapError(code, msg)
+
+    def on_invoke(handle, field, raw_args):
+        kind, name = handle
+        params = [C.we_Value("i64", a) for a in raw_args]
+        if kind == "named":
+            res, out = C.we_VMExecuteRegistered(vm, name, field, params)
+        else:
+            res, out = C.we_VMExecute(vm, field, params)
+        _raise(res)
+        return [v.raw for v in out]
+
+    def on_register(handle, as_name):
+        # modules are registered at definition; wast `register` of the
+        # active module is not needed by our corpus
+        raise TrapError(ErrCode.FuncNotFound, "register unsupported in capi seam")
+
+    return SpecTest(on_module, on_invoke, on_register)
+
+
+def test_spec_corpus_through_capi():
+    corpus = sorted(glob.glob(os.path.join(HERE, "spec", "*.wast")))
+    assert corpus
+    total_passed = 0
+    for path in corpus:
+        st = _capi_spec_callbacks()
+        with open(path) as f:
+            rep = st.run_script(f.read(), os.path.basename(path))
+        detail = "\n".join(str(x) for x in rep.failures[:10])
+        assert rep.failed == 0, f"{path}: {rep.failed} failed\n{detail}"
+        total_passed += rep.passed
+    assert total_passed > 3000

@@ -1,0 +1,655 @@
+"""Embedding API: the C-API surface (WasmEdge_* families) for hosts.
+
+Mirrors /root/reference/include/api/wasmedge/wasmedge.h (235 exported
+functions, lib/api/wasmedge.cpp:1-2848) as a flat, C-style function
+surface: opaque contexts, `we_Result` codes instead of exceptions, and
+one function per operation, so an embedder (or a future real C binding
+via ctypes) programs against the same shapes the reference's embedders
+do.  Family coverage:
+
+  Value/Result/String      value pack/unpack, error codes
+  Configure*               proposals, host registrations, statistics,
+                           engine selection (the TPU extension knob)
+  Statistics*              instruction count / cost / rates
+  Loader/Validator/Executor  staged pipeline (APIStepsCoreTest model)
+  ASTModule*               import/export listings
+  Store*                   module/function lookup, listings
+  ModuleInstance/Function/Memory/Global/Table instance accessors
+  ImportObject*            host modules incl. WASI + wasmedge_process
+  VM*                      the façade incl. one-shot RunWasm and Async
+  Batch* (TPU extension)   lane-batched execution over the same VM
+
+The sibling test suite tests/test_capi.py drives the spec corpus through
+the VM family exactly like the reference's APIVMCoreTest
+(test/api/APIVMCoreTest.cpp:1-244).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from wasmedge_tpu.common.configure import (
+    Configure,
+    EngineKind,
+    HostRegistration,
+    Proposal,
+)
+from wasmedge_tpu.common.errors import (
+    ErrCode,
+    LoadError,
+    TrapError,
+    ValidationError,
+    WasmError,
+)
+from wasmedge_tpu.common.statistics import Statistics
+from wasmedge_tpu.common.types import (
+    MASK32,
+    MASK64,
+    bits_to_f32,
+    bits_to_f64,
+    f32_to_bits,
+    f64_to_bits,
+    s32,
+    s64,
+)
+
+# ---------------------------------------------------------------------------
+# Result (reference: WasmEdge_Result / ResultGetCode / ResultOK)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class we_Result:
+    code: int
+    message: str = ""
+
+
+we_Result_Success = we_Result(0, "success")
+
+
+def we_ResultOK(res: we_Result) -> bool:
+    return res.code == 0
+
+
+def we_ResultGetCode(res: we_Result) -> int:
+    return res.code
+
+
+def we_ResultGetMessage(res: we_Result) -> str:
+    return res.message
+
+
+def _wrap(fn: Callable) -> Tuple[we_Result, object]:
+    """Run fn; map engine exceptions onto Result codes (wasmedge.cpp's
+    wrap() idiom)."""
+    try:
+        return we_Result_Success, fn()
+    except (TrapError, LoadError, ValidationError, WasmError) as e:
+        return we_Result(int(e.code), str(e)), None
+    except KeyError as e:
+        return we_Result(int(ErrCode.FuncNotFound), str(e)), None
+    except OSError as e:
+        return we_Result(int(ErrCode.IllegalPath), str(e)), None
+
+
+# ---------------------------------------------------------------------------
+# Value (reference: WasmEdge_Value + ValueGen*/ValueGet*)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class we_Value:
+    type: str  # "i32" | "i64" | "f32" | "f64" | "v128" | "funcref" | "externref"
+    raw: int   # raw cell bits
+
+
+def we_ValueGenI32(v: int) -> we_Value:
+    return we_Value("i32", v & 0xFFFFFFFF)
+
+
+def we_ValueGenI64(v: int) -> we_Value:
+    return we_Value("i64", v & 0xFFFFFFFFFFFFFFFF)
+
+
+def we_ValueGenF32(v: float) -> we_Value:
+    return we_Value("f32", f32_to_bits(v))
+
+
+def we_ValueGenF64(v: float) -> we_Value:
+    return we_Value("f64", f64_to_bits(v))
+
+
+def we_ValueGenV128(v: int) -> we_Value:
+    return we_Value("v128", v & ((1 << 128) - 1))
+
+
+def we_ValueGetI32(v: we_Value) -> int:
+    return s32(v.raw & MASK32)
+
+
+def we_ValueGetI64(v: we_Value) -> int:
+    return s64(v.raw & MASK64)
+
+
+def we_ValueGetF32(v: we_Value) -> float:
+    return bits_to_f32(v.raw & MASK32)
+
+
+def we_ValueGetF64(v: we_Value) -> float:
+    return bits_to_f64(v.raw & MASK64)
+
+
+def _cells_to_values(types, cells) -> List[we_Value]:
+    out = []
+    for t, c in zip(types, cells):
+        name = getattr(t, "name", str(t)).lower()
+        out.append(we_Value(name if name in ("i32", "i64", "f32", "f64",
+                                             "v128") else "i64", int(c)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Configure (reference: WasmEdge_Configure* family)
+# ---------------------------------------------------------------------------
+
+
+def we_ConfigureCreate() -> Configure:
+    return Configure()
+
+
+def we_ConfigureDelete(conf: Configure) -> None:
+    pass  # Python GC
+
+
+def we_ConfigureAddProposal(conf: Configure, prop: str) -> None:
+    conf.proposals.add(Proposal(prop))
+
+
+def we_ConfigureRemoveProposal(conf: Configure, prop: str) -> None:
+    conf.proposals.discard(Proposal(prop))
+
+
+def we_ConfigureHasProposal(conf: Configure, prop: str) -> bool:
+    return Proposal(prop) in conf.proposals
+
+
+def we_ConfigureAddHostRegistration(conf: Configure, host: str) -> None:
+    conf.host_registrations.add(HostRegistration(host))
+
+
+def we_ConfigureRemoveHostRegistration(conf: Configure, host: str) -> None:
+    conf.host_registrations.discard(HostRegistration(host))
+
+
+def we_ConfigureHasHostRegistration(conf: Configure, host: str) -> bool:
+    return HostRegistration(host) in conf.host_registrations
+
+
+def we_ConfigureSetMaxMemoryPage(conf: Configure, pages: int) -> None:
+    conf.runtime.max_memory_pages = pages
+
+
+def we_ConfigureGetMaxMemoryPage(conf: Configure) -> int:
+    return conf.runtime.max_memory_pages
+
+
+def we_ConfigureSetEngine(conf: Configure, engine: str) -> None:
+    """TPU extension: scalar | native | tpu_batch | auto (the engine-switch
+    seam, SURVEY.md §5.6)."""
+    conf.engine = EngineKind(engine)
+
+
+def we_ConfigureGetEngine(conf: Configure) -> str:
+    return conf.engine.value
+
+
+def we_ConfigureStatisticsSetInstructionCounting(conf, on: bool) -> None:
+    conf.statistics.instr_counting = on
+
+
+def we_ConfigureStatisticsIsInstructionCounting(conf) -> bool:
+    return conf.statistics.instr_counting
+
+
+def we_ConfigureStatisticsSetCostMeasuring(conf, on: bool) -> None:
+    conf.statistics.cost_measuring = on
+
+
+def we_ConfigureStatisticsIsCostMeasuring(conf) -> bool:
+    return conf.statistics.cost_measuring
+
+
+def we_ConfigureStatisticsSetTimeMeasuring(conf, on: bool) -> None:
+    conf.statistics.time_measuring = on
+
+
+def we_ConfigureStatisticsIsTimeMeasuring(conf) -> bool:
+    return conf.statistics.time_measuring
+
+
+# ---------------------------------------------------------------------------
+# Statistics (reference: WasmEdge_Statistics* family)
+# ---------------------------------------------------------------------------
+
+
+def we_StatisticsCreate() -> Statistics:
+    return Statistics()
+
+
+def we_StatisticsDelete(stat) -> None:
+    pass
+
+
+def we_StatisticsGetInstrCount(stat: Statistics) -> int:
+    return stat.instr_count
+
+
+def we_StatisticsGetInstrPerSecond(stat: Statistics) -> float:
+    return stat.instr_per_second()
+
+
+def we_StatisticsGetTotalCost(stat: Statistics) -> int:
+    return stat.total_cost
+
+
+def we_StatisticsSetCostLimit(stat: Statistics, limit: int) -> None:
+    stat.cost_limit = limit
+
+
+# ---------------------------------------------------------------------------
+# Loader / Validator / Executor (staged pipeline; APIStepsCoreTest model)
+# ---------------------------------------------------------------------------
+
+
+def we_LoaderCreate(conf: Optional[Configure] = None):
+    from wasmedge_tpu.loader import Loader
+
+    return Loader(conf or Configure())
+
+
+def we_LoaderParseFromBuffer(loader, data: bytes):
+    return _wrap(lambda: loader.parse_module(data))
+
+
+def we_LoaderParseFromFile(loader, path: str):
+    def go():
+        with open(path, "rb") as f:
+            return loader.parse_module(f.read())
+    return _wrap(go)
+
+
+def we_ValidatorCreate(conf: Optional[Configure] = None):
+    from wasmedge_tpu.validator import Validator
+
+    return Validator(conf or Configure())
+
+
+def we_ValidatorValidate(validator, ast_mod):
+    return _wrap(lambda: validator.validate(ast_mod))[0]
+
+
+def we_ExecutorCreate(conf: Optional[Configure] = None, stat=None):
+    from wasmedge_tpu.executor import Executor
+
+    return Executor(conf or Configure(), stat=stat)
+
+
+def we_ExecutorInstantiate(executor, store, ast_mod):
+    return _wrap(lambda: executor.instantiate(store, ast_mod))
+
+
+def we_ExecutorRegisterModule(executor, store, ast_mod, name: str):
+    return _wrap(lambda: executor.register_module(store, ast_mod, name))[0]
+
+
+def we_ExecutorRegisterImport(executor, store, import_object):
+    return _wrap(
+        lambda: executor.register_import_object(store, import_object))[0]
+
+
+def we_ExecutorInvoke(executor, store, func_inst, params: Sequence[we_Value]):
+    def go():
+        if len(params) != len(func_inst.functype.params):
+            raise TrapError(ErrCode.FuncSigMismatch,
+                            f"expected {len(func_inst.functype.params)} "
+                            f"args, got {len(params)}")
+        return executor.invoke_raw(store, func_inst,
+                                   [p.raw for p in params])
+
+    res, out = _wrap(go)
+    if not we_ResultOK(res):
+        return res, []
+    return res, _cells_to_values(func_inst.functype.results, out)
+
+
+# ---------------------------------------------------------------------------
+# ASTModule listings (reference: WasmEdge_ASTModuleListExports/Imports)
+# ---------------------------------------------------------------------------
+
+
+def we_ASTModuleListImports(ast_mod) -> List[Tuple[str, str, str]]:
+    """[(module, name, kind)] — kind in func/table/memory/global."""
+    kinds = {0: "func", 1: "table", 2: "memory", 3: "global"}
+    return [(im.module, im.name, kinds.get(im.kind, "?"))
+            for im in ast_mod.imports]
+
+
+def we_ASTModuleListExports(ast_mod) -> List[Tuple[str, str]]:
+    kinds = {0: "func", 1: "table", 2: "memory", 3: "global"}
+    return [(ex.name, kinds.get(ex.kind, "?")) for ex in ast_mod.exports]
+
+
+# ---------------------------------------------------------------------------
+# Store (reference: WasmEdge_Store* family)
+# ---------------------------------------------------------------------------
+
+
+def we_StoreCreate():
+    from wasmedge_tpu.runtime.store import StoreManager
+
+    return StoreManager()
+
+
+def we_StoreDelete(store) -> None:
+    pass
+
+
+def we_StoreFindModule(store, name: str):
+    return store.find_module(name)
+
+
+def we_StoreListModule(store) -> List[str]:
+    return store.module_names()
+
+
+def we_StoreFindFunctionRegistered(store, mod_name: str, func_name: str):
+    mod = store.find_module(mod_name)
+    return mod.find_func(func_name) if mod is not None else None
+
+
+# ---------------------------------------------------------------------------
+# Instance accessors (reference: WasmEdge_ModuleInstance*/...Instance*)
+# ---------------------------------------------------------------------------
+
+
+def we_ModuleInstanceGetModuleName(inst) -> str:
+    return inst.name
+
+
+def we_ModuleInstanceFindFunction(inst, name: str):
+    return inst.find_func(name)
+
+
+def we_ModuleInstanceListFunction(inst) -> List[str]:
+    return [n for n, (kind, _) in inst.exports.items() if kind == 0]
+
+
+def we_ModuleInstanceFindMemory(inst, name: str):
+    ex = inst.exports.get(name)
+    return inst.memories[ex[1]] if ex and ex[0] == 2 else None
+
+
+def we_ModuleInstanceFindGlobal(inst, name: str):
+    ex = inst.exports.get(name)
+    return inst.globals[ex[1]] if ex and ex[0] == 3 else None
+
+
+def we_ModuleInstanceFindTable(inst, name: str):
+    ex = inst.exports.get(name)
+    return inst.tables[ex[1]] if ex and ex[0] == 1 else None
+
+
+def we_FunctionInstanceGetFunctionType(fi):
+    return fi.functype
+
+
+def we_MemoryInstanceGetPageSize(mem) -> int:
+    return mem.pages
+
+
+def we_MemoryInstanceGrowPage(mem, delta: int) -> we_Result:
+    old = mem.grow(delta)
+    return we_Result_Success if old >= 0 else \
+        we_Result(int(ErrCode.MemoryOutOfBounds), "grow failed")
+
+
+def we_MemoryInstanceGetData(mem, offset: int, length: int):
+    return _wrap(lambda: bytes(mem.load_bytes(offset, length)))
+
+
+def we_MemoryInstanceSetData(mem, offset: int, data: bytes) -> we_Result:
+    return _wrap(lambda: mem.store_bytes(offset, data))[0]
+
+
+def we_GlobalInstanceGetValue(g) -> we_Value:
+    return we_Value(g.type.val_type.name.lower(), g.value)
+
+
+def we_GlobalInstanceSetValue(g, v: we_Value) -> we_Result:
+    if hasattr(g.type, "mutable") and not g.type.mutable:
+        return we_Result(int(ErrCode.SetValueToConst),
+                         "global is immutable")
+    g.value = v.raw
+    return we_Result_Success
+
+
+def we_TableInstanceGetSize(t) -> int:
+    return t.size
+
+
+# ---------------------------------------------------------------------------
+# ImportObject (reference: WasmEdge_ImportObject* family)
+# ---------------------------------------------------------------------------
+
+
+def we_ImportObjectCreate(name: str):
+    from wasmedge_tpu.runtime.hostfunc import ImportObject
+
+    return ImportObject(name)
+
+
+def we_ImportObjectAddFunction(imp, name: str, params, results,
+                               fn: Callable) -> None:
+    """fn(mem, *typed_args) -> result(s); the HostFunc callback shape."""
+    from wasmedge_tpu.runtime.hostfunc import PyHostFunction
+
+    imp.add_func(name, PyHostFunction(fn, params, results))
+
+
+def we_ImportObjectCreateWASI(dirs=None, args=None, envs=None):
+    from wasmedge_tpu.host.wasi import WasiModule
+
+    w = WasiModule()
+    w.init_wasi(dirs=dirs, args=args, envs=envs)
+    return w
+
+
+def we_ImportObjectInitWASI(wasi, dirs=None, args=None, envs=None) -> None:
+    wasi.init_wasi(dirs=dirs, args=args, envs=envs)
+
+
+def we_ImportObjectWASIGetExitCode(wasi) -> int:
+    return wasi.exit_code
+
+
+def we_ImportObjectCreateWasmEdgeProcess(allowed_cmds=None, allow_all=False):
+    from wasmedge_tpu.host.process import WasmEdgeProcessModule
+
+    return WasmEdgeProcessModule(allowed_cmds=allowed_cmds,
+                                 allow_all=allow_all)
+
+
+# ---------------------------------------------------------------------------
+# VM (reference: WasmEdge_VM* family; include/vm/vm.h:42-268)
+# ---------------------------------------------------------------------------
+
+
+class _VMContext:
+    def __init__(self, conf: Optional[Configure], store):
+        from wasmedge_tpu.vm import VM
+
+        self.vm = VM(conf or Configure(), store=store)
+
+
+def we_VMCreate(conf: Optional[Configure] = None, store=None) -> _VMContext:
+    return _VMContext(conf, store)
+
+
+def we_VMDelete(ctx) -> None:
+    pass
+
+
+def we_VMGetStoreContext(ctx):
+    return ctx.vm.store
+
+
+def we_VMGetStatisticsContext(ctx):
+    return ctx.vm.statistics()
+
+
+def we_VMRegisterModuleFromBuffer(ctx, name: str, data: bytes) -> we_Result:
+    return _wrap(lambda: ctx.vm.register_module(name, data))[0]
+
+
+def we_VMRegisterModuleFromImport(ctx, import_object) -> we_Result:
+    return _wrap(lambda: ctx.vm.register_import_object(import_object))[0]
+
+
+def we_VMLoadWasmFromBuffer(ctx, data: bytes) -> we_Result:
+    return _wrap(lambda: ctx.vm.load_wasm(data))[0]
+
+
+def we_VMLoadWasmFromFile(ctx, path: str) -> we_Result:
+    def go():
+        with open(path, "rb") as f:
+            ctx.vm.load_wasm(f.read())
+    return _wrap(go)[0]
+
+
+def we_VMValidate(ctx) -> we_Result:
+    return _wrap(lambda: ctx.vm.validate())[0]
+
+
+def we_VMInstantiate(ctx) -> we_Result:
+    return _wrap(lambda: ctx.vm.instantiate())[0]
+
+
+def _typed_args(params: Sequence[we_Value]) -> List[int]:
+    return [p.raw for p in params]
+
+
+def _vm_exec_raw(ctx, func_name, raw_args, module_name=None):
+    vm = ctx.vm
+    with vm._lock:
+        fi = vm._find_function(func_name, module_name)
+    if len(raw_args) != len(fi.functype.params):
+        raise TrapError(ErrCode.FuncSigMismatch,
+                        f"expected {len(fi.functype.params)} args, "
+                        f"got {len(raw_args)}")
+    cells = vm.executor.invoke_raw(vm.store, fi, list(raw_args))
+    return fi.functype.results, cells
+
+
+def we_VMExecute(ctx, func_name: str, params: Sequence[we_Value] = ()):
+    res, out = _wrap(
+        lambda: _vm_exec_raw(ctx, func_name, _typed_args(params)))
+    if not we_ResultOK(res):
+        return res, []
+    types, cells = out
+    return res, _cells_to_values(types, cells)
+
+
+def we_VMExecuteRegistered(ctx, mod_name: str, func_name: str,
+                           params: Sequence[we_Value] = ()):
+    res, out = _wrap(lambda: _vm_exec_raw(
+        ctx, func_name, _typed_args(params), module_name=mod_name))
+    if not we_ResultOK(res):
+        return res, []
+    types, cells = out
+    return res, _cells_to_values(types, cells)
+
+
+def we_VMRunWasmFromBuffer(ctx, data: bytes, func_name: str,
+                           params: Sequence[we_Value] = ()):
+    r = we_VMLoadWasmFromBuffer(ctx, data)
+    if not we_ResultOK(r):
+        return r, []
+    r = we_VMValidate(ctx)
+    if not we_ResultOK(r):
+        return r, []
+    r = we_VMInstantiate(ctx)
+    if not we_ResultOK(r):
+        return r, []
+    return we_VMExecute(ctx, func_name, params)
+
+
+def we_VMRunWasmFromFile(ctx, path: str, func_name: str,
+                         params: Sequence[we_Value] = ()):
+    res, data = _wrap(lambda: open(path, "rb").read())
+    if not we_ResultOK(res):
+        return res, []
+    return we_VMRunWasmFromBuffer(ctx, data, func_name, params)
+
+
+def we_VMGetFunctionList(ctx) -> List[Tuple[str, object]]:
+    return ctx.vm.get_function_list()
+
+
+def we_VMGetFunctionType(ctx, func_name: str):
+    inst = ctx.vm.active_module
+    fi = inst.find_func(func_name) if inst else None
+    return fi.functype if fi else None
+
+
+def we_VMCleanup(ctx) -> None:
+    ctx.vm.cleanup()
+
+
+# -- async (reference: WasmEdge_VMAsync* + Async*; include/vm/async.h) ------
+
+
+def we_VMAsyncExecute(ctx, func_name: str, params: Sequence[we_Value] = ()):
+    return ctx.vm.async_execute(func_name, _typed_args(params))
+
+
+def we_AsyncWait(handle) -> None:
+    handle.wait()
+
+
+def we_AsyncWaitFor(handle, ms: int) -> bool:
+    return handle.wait_for(ms / 1000.0)
+
+
+def we_AsyncCancel(handle) -> None:
+    handle.cancel()
+
+
+def we_AsyncGet(handle):
+    """Returns (Result, typed python values) — the async path runs the
+    typed VM.execute (include/vm/async.h:25-105 model)."""
+    return _wrap(handle.get)
+
+
+# ---------------------------------------------------------------------------
+# Batch extension (TPU-native; no reference analog — the tpu_batch engine
+# behind the same embedding surface)
+# ---------------------------------------------------------------------------
+
+
+def we_VMBatchExecute(ctx, func_name: str, per_lane_args, lanes: int,
+                      max_steps: int = 10_000_000):
+    """Run the active module's export over `lanes` SIMT lanes.
+
+    per_lane_args: list of numpy int64 arrays (one per wasm param, one
+    value per lane).  Returns (Result, BatchResult)."""
+    def go():
+        from wasmedge_tpu.batch.uniform import UniformBatchEngine
+
+        inst = ctx.vm.active_module
+        if inst is None:
+            raise WasmError(ErrCode.WrongVMWorkflow, "no instantiated module")
+        eng = UniformBatchEngine(inst, store=ctx.vm.store, conf=ctx.vm.conf,
+                                 lanes=lanes)
+        return eng.run(func_name, list(per_lane_args), max_steps=max_steps)
+    return _wrap(go)
