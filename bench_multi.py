@@ -1,0 +1,74 @@
+"""BASELINE config 5 benchmark: heterogeneous serverless mix.
+
+4096 lanes split across four different tenant modules (fib, fac,
+loop_sum, coremark-kernel) executed concurrently in one batch via the
+multi-tenant engine (Pallas fast path when tenant lanes align to kernel
+blocks).  Prints one JSON line; the driver's headline metric stays in
+bench.py (config 1)."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.batch.multitenant import MultiTenantBatchEngine, Tenant
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.models import (
+        build_coremark_kernel, build_fac, build_fib, build_loop_sum)
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    conf = Configure()
+    conf.batch.steps_per_launch = 50_000_000
+    conf.batch.value_stack_depth = 256
+    conf.batch.call_stack_depth = 256
+
+    def inst_of(data):
+        mod = Validator(conf).validate(Loader(conf).parse_module(data))
+        store = StoreManager()
+        return Executor(conf).instantiate(store, mod), store
+
+    L = 1024
+    specs = [
+        (build_fib(), "fib", [np.full(L, 27, np.int64)]),
+        (build_fac(), "fac", [np.full(L, 20, np.int64)]),
+        (build_loop_sum(), "loop_sum", [np.full(L, 2_000_000, np.int64)]),
+        (build_coremark_kernel(), "coremark", [np.full(L, 4096, np.int64)]),
+    ]
+    tenants = []
+    for data, fn, args in specs:
+        inst, store = inst_of(data)
+        tenants.append(Tenant(
+            engine=BatchEngine(inst, store=store, conf=conf, lanes=L),
+            func_name=fn, args_lanes=args, lanes=L))
+    mt = MultiTenantBatchEngine(tenants, conf=conf)
+    # warmup/compile
+    mt.run_tenants(max_steps=2000)
+
+    mt2 = MultiTenantBatchEngine(tenants, conf=conf)
+    t0 = time.perf_counter()
+    res = mt2.run_tenants(max_steps=500_000_000)
+    dt = time.perf_counter() - t0
+    ok = all(r.completed.all() for r in res)
+    retired = float(sum(np.asarray(r.retired, np.float64).sum() for r in res))
+    agg = retired / dt
+    out = {"metric": "multitenant_mix4_wasm_ops_per_sec_x4096",
+           "value": round(agg, 1), "unit": "wasm_instr/s",
+           "ok": ok, "used_pallas": mt2.used_pallas,
+           "wall_s": round(dt, 2)}
+    print(json.dumps(out))
+    if not ok:
+        for i, r in enumerate(res):
+            print(f"# tenant {i}: traps {set(np.asarray(r.trap).tolist())}",
+                  file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -180,6 +180,21 @@ def test_vm_async_execute_and_cancel():
     assert C.we_ResultGetCode(res) == int(ErrCode.Terminated)
 
 
+def test_vm_async_f64_roundtrip():
+    """Raw float cells must survive the async (typed) path unchanged."""
+    b = ModuleBuilder()
+    b.add_function(["f64"], ["f64"], [],
+                   [("local.get", 0)], export="id")
+    vm = C.we_VMCreate()
+    assert C.we_ResultOK(C.we_VMLoadWasmFromBuffer(vm, b.build()))
+    assert C.we_ResultOK(C.we_VMValidate(vm))
+    assert C.we_ResultOK(C.we_VMInstantiate(vm))
+    h = C.we_VMAsyncExecute(vm, "id", [C.we_ValueGenF64(1.5)])
+    res, out = C.we_AsyncGet(h)
+    assert C.we_ResultOK(res)
+    assert C.we_ValueGetF64(out[0]) == 1.5
+
+
 def test_vm_statistics():
     conf = C.we_ConfigureCreate()
     C.we_ConfigureStatisticsSetInstructionCounting(conf, True)

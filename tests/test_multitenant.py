@@ -1,0 +1,149 @@
+"""Multi-tenant heterogeneous batching (BASELINE config 5's shape):
+different modules in one SIMT batch, per-lane results correct."""
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.batch.multitenant import run_mixed
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.common.errors import ErrCode
+from wasmedge_tpu.models import build_fac, build_fib, build_loop_sum
+from wasmedge_tpu.runtime.hostfunc import ImportObject, PyHostFunction
+from wasmedge_tpu.utils.builder import ModuleBuilder
+from tests.helpers import instantiate
+
+
+def _inst(data, conf=None, imports=None):
+    ex, store, inst = instantiate(data, conf or Configure(), imports=imports)
+    return inst, store
+
+
+def test_three_modules_one_batch():
+    conf = Configure()
+    conf.batch.steps_per_launch = 5000
+    fib_i, fib_s = _inst(build_fib())
+    fac_i, fac_s = _inst(build_fac())
+    sum_i, sum_s = _inst(build_loop_sum())
+    fib_args = np.array([5, 8, 10, 11], np.int64)
+    fac_args = np.array([3, 6, 10], np.int64)
+    sum_args = np.array([10, 100, 1000, 17, 4], np.int64)
+    res = run_mixed([
+        (fib_i, fib_s, "fib", [fib_args], 4),
+        (fac_i, fac_s, "fac", [fac_args], 3),
+        (sum_i, sum_s, "loop_sum", [sum_args], 5),
+    ], conf=conf, max_steps=500_000)
+    assert (res[0].trap == -1).all()
+    assert res[0].results[0].tolist() == [5, 21, 55, 89]
+    import math
+    assert res[1].results[0].tolist() == [6, 720, 3628800]
+    assert res[2].results[0].tolist() == [45, 4950, 499500, 136, 6]
+
+
+def test_mixed_globals_memory_and_tables():
+    """Tenants with clashing index spaces: globals, memories, indirect
+    calls through per-tenant tables."""
+    conf = Configure()
+    conf.batch.steps_per_launch = 5000
+
+    def module_a():
+        b = ModuleBuilder()
+        b.add_global("i32", True, [("i32.const", 1000)])
+        b.add_memory(1, 1)
+        f0 = b.add_function(["i32"], ["i32"], [],
+                            [("local.get", 0), ("i32.const", 3), "i32.mul"])
+        b.add_table("funcref", 2)
+        b.add_active_elem(0, [("i32.const", 0)], [f0])
+        ti = b.add_type(["i32"], ["i32"])
+        b.add_function(["i32"], ["i32"], [], [
+            # mem[8] = arg; g += arg; return table[0](arg) + g + mem[8]
+            ("i32.const", 8), ("local.get", 0), ("i32.store", 2, 0),
+            ("global.get", 0), ("local.get", 0), "i32.add",
+            ("global.set", 0),
+            ("local.get", 0), ("i32.const", 0), ("call_indirect", ti, 0),
+            ("global.get", 0), "i32.add",
+            ("i32.const", 8), ("i32.load", 2, 0), "i32.add",
+        ], export="go")
+        return b.build()
+
+    def module_b():
+        b = ModuleBuilder()
+        b.add_global("i32", True, [("i32.const", -5)])
+        f0 = b.add_function(["i32"], ["i32"], [],
+                            [("local.get", 0), ("i32.const", 7), "i32.add"])
+        b.add_table("funcref", 1)
+        b.add_active_elem(0, [("i32.const", 0)], [f0])
+        ti = b.add_type(["i32"], ["i32"])
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), ("i32.const", 0), ("call_indirect", ti, 0),
+            ("global.get", 0), "i32.add",
+        ], export="go")
+        return b.build()
+
+    a_i, a_s = _inst(module_a())
+    b_i, b_s = _inst(module_b())
+    a_args = np.array([1, 2, 3], np.int64)
+    b_args = np.array([10, 20], np.int64)
+    res = run_mixed([
+        (a_i, a_s, "go", [a_args], 3),
+        (b_i, b_s, "go", [b_args], 2),
+    ], conf=conf, max_steps=100_000)
+    # A: 3x + (1000 + x) + x = 1000 + 5x
+    assert res[0].results[0].tolist() == [1005, 1010, 1015]
+    # B: (x + 7) + (-5) = x + 2
+    assert res[1].results[0].tolist() == [12, 22]
+
+
+def test_mixed_with_hostcalls_and_traps():
+    conf = Configure()
+    conf.batch.steps_per_launch = 5000
+    imp = ImportObject("env")
+    imp.add_func("bump", PyHostFunction(lambda mem, x: x + 1,
+                                        ["i32"], ["i32"]))
+    hb = ModuleBuilder()
+    hb.import_func("env", "bump", ["i32"], ["i32"])
+    hb.add_function(["i32"], ["i32"], [],
+                    [("local.get", 0), ("call", 0)], export="f")
+    h_i, h_s = _inst(hb.build(), conf, imports=[imp])
+
+    tb = ModuleBuilder()
+    tb.add_function(["i32", "i32"], ["i32"], [],
+                    [("local.get", 0), ("local.get", 1), ("i32.div_s",)],
+                    export="div")
+    t_i, t_s = _inst(tb.build())
+
+    res = run_mixed([
+        (h_i, h_s, "f", [np.array([100, 200], np.int64)], 2),
+        (t_i, t_s, "div",
+         [np.array([10, 9, 8], np.int64), np.array([2, 0, 4], np.int64)], 3),
+    ], conf=conf, max_steps=100_000)
+    assert res[0].results[0].tolist() == [101, 201]
+    assert res[1].trap[1] == int(ErrCode.DivideByZero)
+    assert res[1].results[0][[0, 2]].tolist() == [5, 2]
+
+
+def test_pallas_multitenant_path():
+    """Tenant blocks through the Pallas kernel (interpret mode on CPU):
+    heterogeneous per-block entries, same results as the SIMT path."""
+    conf = Configure()
+    conf.batch.steps_per_launch = 5000
+    conf.batch.interpret = True
+    conf.batch.use_pallas = True
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.batch.multitenant import (
+        MultiTenantBatchEngine, Tenant)
+
+    fib_i, fib_s = _inst(build_fib())
+    fac_i, fac_s = _inst(build_fac())
+    tenants = [
+        Tenant(engine=BatchEngine(fib_i, store=fib_s, conf=conf, lanes=8),
+               func_name="fib", args_lanes=[np.full(8, 10, np.int64)],
+               lanes=8),
+        Tenant(engine=BatchEngine(fac_i, store=fac_s, conf=conf, lanes=8),
+               func_name="fac", args_lanes=[np.full(8, 10, np.int64)],
+               lanes=8),
+    ]
+    mt = MultiTenantBatchEngine(tenants, conf=conf)
+    res = mt.run_tenants(max_steps=200_000)
+    assert mt.used_pallas
+    assert (res[0].results[0] == 55).all()
+    assert (res[1].results[0] == 3628800).all()

@@ -504,10 +504,12 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         is_call = is_cls[CLS_CALL]
         is_calli = is_cls[CLS_CALL_INDIRECT]
         is_callany = is_call | is_calli
-        tsize = table0.shape[0]
-        ti = jnp.clip(v0_lo, 0, tsize - 1)
+        # per-instruction table window: b = size, c = base (multi-tenant
+        # concatenated tables)
+        ti = c + jnp.clip(v0_lo, 0, b - 1)
+        ti = jnp.clip(ti, 0, table0.shape[0] - 1)
         t_h = table0[ti]
-        ti_oob = is_calli & (u_lt(jnp.int32(tsize - 1), v0_lo) | (v0_lo < 0))
+        ti_oob = is_calli & (u_lt(b - 1, v0_lo) | (v0_lo < 0))
         ti_null = is_calli & ~ti_oob & (t_h == 0)
         callee = jnp.where(is_calli, jnp.clip(t_h - 1, 0, f_entry.shape[0] - 1),
                            jnp.clip(a, 0, f_entry.shape[0] - 1))
@@ -861,6 +863,11 @@ class BatchEngine:
             retired=np.asarray(state.retired),
             steps=total,
         )
+
+    def resolve_func(self, k: int):
+        """Concatenated-image func index -> FunctionInstance (overridden by
+        the multi-tenant engine, batch/multitenant.py)."""
+        return self.inst.funcs[k]
 
     def run_from_state(self, state, total: int, max_steps: int):
         """Chunk loop from an arbitrary state (used directly and by the

@@ -53,10 +53,9 @@ def store_lane_memory(mem_plane: np.ndarray, lane: int, data: bytearray):
     mem_plane[:nwords, lane] = raw
 
 
-def serve_one(inst, import_idx: int, args_cells: List[int],
+def serve_one(fi, args_cells: List[int],
               lane_mem: Optional[_LaneMemory]) -> Tuple[List[int], int]:
     """Run one lane's host call. Returns (result_cells, trap_code)."""
-    fi = inst.funcs[import_idx]
     if fi.kind != "host":
         return [], int(ErrCode.ExecutionFailed)
     try:
@@ -73,7 +72,6 @@ def serve_batch_state(engine, state):
 
     from wasmedge_tpu.batch.image import TRAP_HOSTCALL
 
-    inst = engine.inst
     img = engine.img
     trap = np.asarray(state.trap)
     waiting = np.nonzero(trap == TRAP_HOSTCALL)[0]
@@ -94,7 +92,7 @@ def serve_batch_state(engine, state):
 
     for lane in waiting:
         k = int(img.a[pc[lane]])
-        fi = inst.funcs[k]
+        fi = engine.resolve_func(k)
         nargs = len(fi.functype.params)
         base = int(fp[lane])
         args = []
@@ -107,7 +105,7 @@ def serve_batch_state(engine, state):
             lane_mem = _LaneMemory(
                 lane_memory_bytes(mem_plane, lane, int(pages[lane])),
                 max_pages, int(pages[lane]))
-        out, code = serve_one(inst, k, args, lane_mem)
+        out, code = serve_one(fi, args, lane_mem)
         if code:
             new_trap[lane] = code
             continue

@@ -236,6 +236,11 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
             key = (ft.params, ft.results)
         return type_ids.setdefault(key, len(type_ids))
 
+    if table0 is None:
+        table0 = np.zeros(1, np.int32)
+    else:
+        table0 = np.asarray(table0, np.int32)
+
     i32_bin = {NAME_TO_ID[f"i32.{s}"]: ALU2_I32_BASE + i
                for i, s in enumerate(_I32_BIN)}
     i64_bin = {NAME_TO_ID[f"i64.{s}"]: ALU2_I64_BASE + i
@@ -273,7 +278,12 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         elif op == Op.call:
             cls[pc], a[pc] = CLS_CALL, ia
         elif op == Op.call_indirect:
-            cls[pc], a[pc], b[pc] = CLS_CALL_INDIRECT, _dense_type(ia), ib
+            # a = dense type id, b = table size, c = table base offset —
+            # base/size in the instruction keep multi-tenant concatenated
+            # tables addressable per lane (batch/multitenant.py)
+            cls[pc], a[pc] = CLS_CALL_INDIRECT, _dense_type(ia)
+            b[pc] = len(table0)
+            c[pc] = 0
         elif op in consts:
             cls[pc] = CLS_CONST
             imm_lo[pc] = _i32(imm)
@@ -350,11 +360,6 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         max_zeros = max(max_zeros, fn.nlocals - fn.nparams)
 
     # instance snapshots (table0: [size] of funcidx+1, 0 = null)
-    if table0 is None:
-        table0 = np.zeros(1, np.int32)
-    else:
-        table0 = np.asarray(table0, np.int32)
-
     ng = len(globals_) if globals_ else 0
     g_lo = np.zeros(max(ng, 1), np.int32)
     g_hi = np.zeros(max(ng, 1), np.int32)

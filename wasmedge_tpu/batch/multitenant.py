@@ -1,0 +1,317 @@
+"""Multi-tenant heterogeneous batching: many modules, one lane batch.
+
+BASELINE config 5 (the serverless mix) and SURVEY.md §7 step 8: different
+tenants' modules run concurrently in one SIMT batch.  The design is pure
+image concatenation — every tenant's DeviceImage is appended into one
+super-image with its code/function/global/type/table/br-table index
+spaces rebased, and each lane's control state is initialized at its own
+tenant's entry pc.  The general SIMT engine is already per-lane-pc (its
+dispatch gathers per-lane instruction words), so heterogeneous execution
+needs no kernel changes; `call_indirect` reads its table window
+(size/base) from the instruction, so each tenant's indirect calls stay
+inside its own table.
+
+Sandbox model matches batch/hostcall.py: per-lane data (stack, memory,
+globals) is fully isolated per tenant; host modules are shared.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from wasmedge_tpu.batch.engine import BatchEngine, BatchResult, BatchState
+from wasmedge_tpu.batch.image import (
+    CLS_BR,
+    CLS_BR_TABLE,
+    CLS_BRNZ,
+    CLS_BRZ,
+    CLS_CALL,
+    CLS_CALL_INDIRECT,
+    CLS_GLOBAL_GET,
+    CLS_GLOBAL_SET,
+    CLS_HOSTCALL,
+    DeviceImage,
+)
+
+_PAGE_WORDS = 65536 // 4
+
+
+@dataclasses.dataclass
+class Tenant:
+    """One module's share of the batch."""
+
+    engine: BatchEngine      # per-module BatchEngine (provides the image)
+    func_name: str
+    args_lanes: List[np.ndarray]   # one array per param, [lanes] each
+    lanes: int
+
+    @property
+    def inst(self):
+        return self.engine.inst
+
+    @property
+    def img(self) -> DeviceImage:
+        return self.engine.img
+
+
+def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
+    """Concatenate tenant DeviceImages into one super-image.
+
+    Returns (image, bases) where bases[i] = dict of per-tenant index-space
+    offsets (pc/func/global/type/brt/table)."""
+    planes = {k: [] for k in ("cls", "sub", "a", "b", "c", "imm_lo",
+                              "imm_hi")}
+    f_parts = {k: [] for k in ("f_entry", "f_nparams", "f_nlocals",
+                               "f_nresults", "f_frame_top", "f_type")}
+    brt_parts = []
+    tbl_parts = []
+    g_lo_parts = []
+    g_hi_parts = []
+    bases = []
+    pc_b = fn_b = gl_b = ty_b = brt_b = tbl_b = 0
+    for t in tenants:
+        img = t.img
+        base = dict(pc=pc_b, func=fn_b, glob=gl_b, type=ty_b, brt=brt_b,
+                    table=tbl_b)
+        bases.append(base)
+        a = img.a.copy()
+        b = img.b.copy()
+        c = img.c.copy()
+        cls = img.cls
+        is_branch = (cls == CLS_BR) | (cls == CLS_BRZ) | (cls == CLS_BRNZ)
+        a[is_branch] += pc_b
+        a[cls == CLS_CALL] += fn_b
+        a[cls == CLS_HOSTCALL] += fn_b
+        a[(cls == CLS_GLOBAL_GET) | (cls == CLS_GLOBAL_SET)] += gl_b
+        is_ci = cls == CLS_CALL_INDIRECT
+        a[is_ci] += ty_b
+        c[is_ci] += tbl_b
+        a[cls == CLS_BR_TABLE] += brt_b
+        planes["cls"].append(cls)
+        planes["sub"].append(img.sub)
+        planes["a"].append(a)
+        planes["b"].append(b)
+        planes["c"].append(c)
+        planes["imm_lo"].append(img.imm_lo)
+        planes["imm_hi"].append(img.imm_hi)
+        brt = img.br_table.copy()
+        brt[:, 0] += pc_b
+        brt_parts.append(brt)
+        tbl = img.table0.copy()
+        tbl[tbl != 0] += fn_b
+        tbl_parts.append(tbl)
+        f_parts["f_entry"].append(img.f_entry + pc_b)
+        f_parts["f_nparams"].append(img.f_nparams)
+        f_parts["f_nlocals"].append(img.f_nlocals)
+        f_parts["f_nresults"].append(img.f_nresults)
+        f_parts["f_frame_top"].append(img.f_frame_top)
+        f_parts["f_type"].append(img.f_type + ty_b)
+        g_lo_parts.append(img.globals_lo)
+        g_hi_parts.append(img.globals_hi)
+        pc_b += img.code_len
+        fn_b += len(img.f_entry)
+        gl_b += img.globals_lo.shape[0]
+        ty_b += int(img.f_type.max(initial=0)) + 1
+        brt_b += img.br_table.shape[0]
+        tbl_b += img.table0.shape[0]
+
+    image = DeviceImage(
+        cls=np.concatenate(planes["cls"]),
+        sub=np.concatenate(planes["sub"]),
+        a=np.concatenate(planes["a"]),
+        b=np.concatenate(planes["b"]),
+        c=np.concatenate(planes["c"]),
+        imm_lo=np.concatenate(planes["imm_lo"]),
+        imm_hi=np.concatenate(planes["imm_hi"]),
+        br_table=np.concatenate(brt_parts, axis=0),
+        f_entry=np.concatenate(f_parts["f_entry"]),
+        f_nparams=np.concatenate(f_parts["f_nparams"]),
+        f_nlocals=np.concatenate(f_parts["f_nlocals"]),
+        f_nresults=np.concatenate(f_parts["f_nresults"]),
+        f_frame_top=np.concatenate(f_parts["f_frame_top"]),
+        f_type=np.concatenate(f_parts["f_type"]),
+        table0=np.concatenate(tbl_parts),
+        globals_lo=np.concatenate(g_lo_parts),
+        globals_hi=np.concatenate(g_hi_parts),
+        mem_init=np.zeros(1, np.int32),       # per-lane init in the engine
+        mem_pages_init=0,                     # per-lane (initial_state)
+        mem_pages_max=max((t.img.mem_pages_max for t in tenants
+                           if t.img.has_memory), default=0),
+        has_memory=any(t.img.has_memory for t in tenants),
+        max_local_zeros=max(t.img.max_local_zeros for t in tenants),
+        code_len=pc_b,
+    )
+    return image, bases
+
+
+class MultiTenantBatchEngine(BatchEngine):
+    """SIMT batch over the concatenation of several tenants' modules.
+
+    Built from per-module BatchEngines (so each tenant's image reflects
+    its own instance snapshot); lanes are assigned contiguously per
+    tenant in order."""
+
+    def __init__(self, tenants: Sequence[Tenant], conf=None):
+        from wasmedge_tpu.common.configure import Configure
+
+        if not tenants:
+            raise ValueError("no tenants")
+        self.tenants = list(tenants)
+        self.mesh = None
+        self.conf = conf or Configure()
+        self.cfg = self.conf.batch
+        self.lanes = sum(t.lanes for t in self.tenants)
+        self.inst = self.tenants[0].inst  # nresults fallback; see run()
+        self.img, self.bases = concat_images(self.tenants)
+        self._func_owner = []
+        for ti, t in enumerate(self.tenants):
+            self._func_owner.extend([ti] * len(t.img.f_entry))
+        self._step = None
+        self._run_chunk = None
+
+    # hostcall serve resolves concatenated func index -> tenant-local one
+    def resolve_func(self, k: int):
+        ti = self._func_owner[k]
+        return self.tenants[ti].inst.funcs[k - self.bases[ti]["func"]]
+
+    def initial_state(self, func_idx=None, args_lanes=None) -> BatchState:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        L = self.lanes
+        img = self.img
+        D = cfg.value_stack_depth
+        CD = cfg.call_stack_depth
+        stack_lo = np.zeros((D, L), np.int32)
+        stack_hi = np.zeros((D, L), np.int32)
+        pc = np.zeros(L, np.int32)
+        sp = np.zeros(L, np.int32)
+        opbase = np.zeros(L, np.int32)
+        pages = np.zeros(L, np.int32)
+        mem_words = max(img.mem_pages_max * _PAGE_WORDS, 1)
+        mem = np.zeros((mem_words, L), np.int32)
+        lane0 = 0
+        self._tenant_slices = []
+        self._tenant_funcidx = []
+        for ti, t in enumerate(self.tenants):
+            sl = slice(lane0, lane0 + t.lanes)
+            self._tenant_slices.append(sl)
+            ex = t.inst.exports.get(t.func_name)
+            if ex is None or ex[0] != 0:
+                raise KeyError(f"tenant {ti}: no export {t.func_name}")
+            fidx = ex[1] + self.bases[ti]["func"]
+            self._tenant_funcidx.append(fidx)
+            meta = t.inst.lowered.funcs[ex[1]]
+            pc[sl] = int(self.img.f_entry[fidx])
+            sp[sl] = meta.nlocals
+            opbase[sl] = meta.nlocals
+            for i, arg in enumerate(t.args_lanes):
+                arr = np.asarray(arg, np.int64)
+                if arr.ndim == 0:
+                    arr = np.full(t.lanes, arr, np.int64)
+                stack_lo[i, sl] = (arr & 0xFFFFFFFF).astype(
+                    np.uint32).view(np.int32)
+                stack_hi[i, sl] = ((arr >> 32) & 0xFFFFFFFF).astype(
+                    np.uint32).view(np.int32)
+            if t.img.has_memory:
+                pages[sl] = t.img.mem_pages_init
+                n = min(t.img.mem_init.shape[0], mem_words)
+                mem[:n, sl] = t.img.mem_init[:n, None]
+            lane0 += t.lanes
+        g_lo = np.repeat(img.globals_lo[:, None], L, axis=1)
+        g_hi = np.repeat(img.globals_hi[:, None], L, axis=1)
+        fuel0 = cfg.fuel_per_launch if cfg.fuel_per_launch is not None else 0
+        return BatchState(
+            pc=jnp.asarray(pc), sp=jnp.asarray(sp),
+            fp=jnp.zeros(L, jnp.int32), opbase=jnp.asarray(opbase),
+            call_depth=jnp.zeros(L, jnp.int32),
+            trap=jnp.zeros(L, jnp.int32), retired=jnp.zeros(L, jnp.int32),
+            fuel=jnp.full(L, fuel0, jnp.int32),
+            mem_pages=jnp.asarray(pages),
+            stack_lo=jnp.asarray(stack_lo), stack_hi=jnp.asarray(stack_hi),
+            fr_ret_pc=jnp.zeros((CD, L), jnp.int32),
+            fr_fp=jnp.zeros((CD, L), jnp.int32),
+            fr_opbase=jnp.zeros((CD, L), jnp.int32),
+            glob_lo=jnp.asarray(g_lo), glob_hi=jnp.asarray(g_hi),
+            mem=jnp.asarray(mem),
+        )
+
+    def _try_pallas(self):
+        """Pallas fast path when every tenant\'s lane count aligns to the
+        kernel\'s lane blocks (tenant blocks are block-uniform control,
+        which is exactly the kernel\'s convergence model)."""
+        from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
+
+        use = self.cfg.use_pallas
+        if use is None:
+            from wasmedge_tpu.batch import ensure_jax_backend
+
+            ensure_jax_backend()
+            import jax
+
+            use = jax.default_backend() == "tpu"
+        if not use and not self.cfg.interpret:
+            return None
+        eng = PallasUniformEngine(self.tenants[0].inst, conf=self.conf,
+                                  simt=self,
+                                  interpret=self.cfg.interpret or None)
+        eng._blk_cap = min(t.lanes for t in self.tenants)
+        eng.ineligible_reason = eng._eligibility()
+        if not eng.eligible:
+            return None
+        Lblk = eng._lane_block()
+        if Lblk is None or any(t.lanes % Lblk for t in self.tenants):
+            return None
+        return eng
+
+    def run_tenants(self, max_steps: int = 10_000_000) -> List[BatchResult]:
+        """Run the whole mixed batch; returns one BatchResult per tenant."""
+        state = self.initial_state()
+        total = 0
+        pallas = self._try_pallas()
+        self.used_pallas = pallas is not None
+        if pallas is not None:
+            state, steps_per_block, fell_back = pallas.run_blocks(
+                state, max_steps)
+            total = int(steps_per_block.max())
+            if fell_back or (np.asarray(state.trap) == 0).any():
+                state, total = self.run_from_state(state, total, max_steps)
+        else:
+            state, total = self.run_from_state(state, 0, max_steps)
+        stack_lo = np.asarray(state.stack_lo)
+        stack_hi = np.asarray(state.stack_hi)
+        trap = np.asarray(state.trap)
+        retired = np.asarray(state.retired)
+        out = []
+        for ti, t in enumerate(self.tenants):
+            sl = self._tenant_slices[ti]
+            ex = t.inst.exports[t.func_name]
+            nres = int(t.inst.lowered.funcs[ex[1]].nresults)
+            results = []
+            for r in range(nres):
+                lo = stack_lo[r, sl].view(np.uint32).astype(np.uint64)
+                hi = stack_hi[r, sl].view(np.uint32).astype(np.uint64)
+                results.append((lo | (hi << np.uint64(32))).view(np.int64))
+            out.append(BatchResult(results=results, trap=trap[sl],
+                                   retired=retired[sl], steps=total))
+        return out
+
+
+def run_mixed(specs, conf=None, max_steps: int = 10_000_000):
+    """Convenience: specs = [(inst, store, func_name, args_lanes, lanes)].
+
+    Builds per-module BatchEngines, concatenates, runs, returns one
+    BatchResult per tenant."""
+    from wasmedge_tpu.common.configure import Configure
+
+    conf = conf or Configure()
+    tenants = []
+    for inst, store, func_name, args_lanes, lanes in specs:
+        eng = BatchEngine(inst, store=store, conf=conf, lanes=lanes)
+        tenants.append(Tenant(engine=eng, func_name=func_name,
+                              args_lanes=list(args_lanes), lanes=lanes))
+    mt = MultiTenantBatchEngine(tenants, conf=conf)
+    return mt.run_tenants(max_steps=max_steps)

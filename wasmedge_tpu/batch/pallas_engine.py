@@ -469,8 +469,10 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             idx = srow(slo, sp - 1)
             i0 = scal(idx)
             agree = allsame(idx, i0)
-            oob = u_lt(I32(tsize - 1), i0) | (i0 < 0)
-            h = tbl_r[jnp.clip(i0, 0, tsize - 1)]
+            tb_size, tb_base = b_r[pc], c_r[pc]
+            oob = u_lt(tb_size - 1, i0) | (i0 < 0)
+            h = tbl_r[jnp.clip(tb_base + jnp.clip(i0, 0, tb_size - 1),
+                               0, tsize - 1)]
             null = h == 0
             callee = jnp.clip(h - 1, 0, nf - 1)
             sig_bad = ftyp_r[callee] != a_r[pc]
@@ -911,7 +913,6 @@ class PallasUniformEngine:
     # (compare-reduce); cap that scan's size, not W alone — one wasm page
     # is already 16384 words.
     MAX_GATHER_ELEMS = 4 * 1024 * 1024
-    MIN_LANE_BLOCK = 128
 
     def __init__(self, inst, store=None, conf=None, lanes=None, mesh=None,
                  interpret=None, simt=None):
@@ -926,6 +927,7 @@ class PallasUniformEngine:
         self.interpret = interpret
         self._fn = None
         self._tables = None
+        self._blk_cap = None  # lane-block ceiling (multi-tenant alignment)
         self.fell_back_to_simt = False
         self.ineligible_reason = self._eligibility()
 
@@ -949,11 +951,12 @@ class PallasUniformEngine:
         NGp = max(self.img.globals_lo.shape[0], 1)
         per_lane = 4 * (2 * D + 2 * NGp + W + 1)
         blk = self.lanes
-        while blk > self.MIN_LANE_BLOCK and (
-                blk * per_lane > self.VMEM_BUDGET_BYTES
-                or self.lanes % blk != 0):
+        cap = self._blk_cap or self.lanes
+        while blk > 1 and (blk * per_lane > self.VMEM_BUDGET_BYTES
+                           or self.lanes % blk != 0 or blk > cap):
             blk //= 2
-        if blk * per_lane > self.VMEM_BUDGET_BYTES or self.lanes % blk != 0:
+        if blk * per_lane > self.VMEM_BUDGET_BYTES or self.lanes % blk != 0 \
+                or blk > cap:
             return None
         return blk
 
@@ -1050,6 +1053,91 @@ class PallasUniformEngine:
                 jnp.asarray(glo), jnp.asarray(ghi),
                 jnp.asarray(mem), jnp.zeros((1, L), jnp.int32)]
 
+    def _from_simt_state(self, simt_state):
+        """Build pallas-geometry state from a block-uniform SIMT state
+        (every control scalar identical within each lane block) — the
+        multi-tenant entry path: tenants occupy whole blocks, so their
+        heterogeneous entries are per-block ctrl rows."""
+        import jax.numpy as jnp
+
+        D, CD, W, Lblk = self._geom
+        L = self.lanes
+        nblk = L // Lblk
+        pc = np.asarray(simt_state.pc)
+        sp = np.asarray(simt_state.sp)
+        fp = np.asarray(simt_state.fp)
+        ob = np.asarray(simt_state.opbase)
+        cd = np.asarray(simt_state.call_depth)
+        pages = np.asarray(simt_state.mem_pages)
+        if (cd != 0).any():
+            # the converter drops the SIMT frame planes; entering with
+            # live frames would corrupt the first return
+            raise ValueError("cannot enter the pallas engine mid-call "
+                            "(call_depth != 0)")
+        ctrl = np.zeros((nblk, 16), np.int32)
+        for b in range(nblk):
+            sl = slice(b * Lblk, (b + 1) * Lblk)
+            for col, vec in ((_C_PC, pc), (_C_SP, sp), (_C_FP, fp),
+                             (_C_OB, ob), (_C_CD, cd), (_C_PAGES, pages)):
+                seg = vec[sl]
+                if not (seg == seg[0]).all():
+                    raise ValueError(
+                        f"block {b} not control-uniform; cannot enter the "
+                        f"pallas engine")
+                ctrl[b, col] = seg[0]
+        ctrl[:, _C_CHUNK] = self.cfg.steps_per_launch
+        stack_lo = np.asarray(simt_state.stack_lo)[:D]
+        stack_hi = np.asarray(simt_state.stack_hi)[:D]
+        mem = np.asarray(simt_state.mem)
+        if mem.shape[0] < W:
+            mem = np.concatenate(
+                [mem, np.zeros((W - mem.shape[0], L), np.int32)], axis=0)
+        mem = mem[:W]
+        NGp = max(self.img.globals_lo.shape[0], 1)
+        glo = np.asarray(simt_state.glob_lo)
+        ghi = np.asarray(simt_state.glob_hi)
+        if glo.shape[0] < NGp:
+            pad = np.zeros((NGp - glo.shape[0], L), np.int32)
+            glo = np.concatenate([glo, pad], axis=0)
+            ghi = np.concatenate([ghi, pad], axis=0)
+        trap = np.asarray(simt_state.trap)[None, :]
+        return [jnp.asarray(ctrl), jnp.zeros((nblk, 3, CD), jnp.int32),
+                jnp.asarray(stack_lo), jnp.asarray(stack_hi),
+                jnp.asarray(glo[:NGp]), jnp.asarray(ghi[:NGp]),
+                jnp.asarray(mem), jnp.asarray(trap)]
+
+    def run_blocks(self, simt_state, max_steps: int = 10_000_000):
+        """Run from a block-uniform SIMT state; returns (simt_state,
+        steps_per_block, fell_back). Used by the multi-tenant engine."""
+        if self._fn is None:
+            self._build()
+        state = self._from_simt_state(simt_state)
+        state, steps_per_block, statuses = self._drive(state, max_steps)
+        fell_back = (statuses == ST_DIVERGED).any()
+        self.fell_back_to_simt = bool(fell_back)
+        return (self._to_simt_state(state, steps_per_block),
+                steps_per_block, bool(fell_back))
+
+    def _drive(self, state, max_steps):
+        """Launch loop: run chunks, serve host outcalls, stop when no
+        block is runnable or max_steps is reached."""
+        nblk = state[0].shape[0]
+        steps_per_block = np.zeros(nblk, np.int64)
+        while True:
+            out = self._fn(*self._tables, state[0], state[1], *state[2:])
+            state = list(out)
+            ctrl_np = np.asarray(state[0])
+            steps_per_block += ctrl_np[:, _C_STEPS].astype(np.int64)
+            statuses = ctrl_np[:, _C_STATUS]
+            if (statuses == ST_HOSTCALL).any() and \
+                    int(steps_per_block.max()) < max_steps:
+                state = self._serve_hostcalls(state, ctrl_np)
+                continue
+            if (statuses == ST_RUNNING).any() and \
+                    int(steps_per_block.max()) < max_steps:
+                continue
+            return state, steps_per_block, statuses
+
     def _to_simt_state(self, state, steps_per_block):
         """Expand per-block scalars to the SIMT engine's per-lane layout."""
         import jax.numpy as jnp
@@ -1115,23 +1203,8 @@ class PallasUniformEngine:
         if self._fn is None:
             self._build()
         state = self._initial_state(func_idx, args_lanes)
-        nblk = state[0].shape[0]
-        steps_per_block = np.zeros(nblk, np.int64)
         self.fell_back_to_simt = False
-        while True:
-            out = self._fn(*self._tables, state[0], state[1], *state[2:])
-            state = list(out)
-            ctrl_np = np.asarray(state[0])
-            steps_per_block += ctrl_np[:, _C_STEPS].astype(np.int64)
-            statuses = ctrl_np[:, _C_STATUS]
-            if (statuses == ST_HOSTCALL).any() and \
-                    int(steps_per_block.max()) < max_steps:
-                state = self._serve_hostcalls(state, ctrl_np)
-                continue
-            if (statuses == ST_RUNNING).any() and \
-                    int(steps_per_block.max()) < max_steps:
-                continue
-            break
+        state, steps_per_block, statuses = self._drive(state, max_steps)
         total = int(steps_per_block.max())
         if (statuses == ST_DIVERGED).any():
             self.fell_back_to_simt = True
@@ -1142,7 +1215,8 @@ class PallasUniformEngine:
         # Fast path: pull only the result rows and the trap plane off the
         # device (full-state readback is reserved for the divergence
         # handoff; device->host bandwidth is the expensive resource here).
-        return self._result_fast(func_idx, state, ctrl_np, steps_per_block)
+        return self._result_fast(func_idx, state,
+                                 np.asarray(state[0]), steps_per_block)
 
     def _result_fast(self, func_idx, state, ctrl, steps_per_block):
         from wasmedge_tpu.batch.engine import BatchResult
@@ -1182,7 +1256,7 @@ class PallasUniformEngine:
         for b in blocks:
             pc = int(ctrl[b, _C_PC])
             k = int(img.a[pc])
-            fi = self.inst.funcs[k]
+            fi = self.simt.resolve_func(k)
             nargs = len(fi.functype.params)
             fp = int(ctrl[b, _C_FP])
             ob = int(ctrl[b, _C_OB])
@@ -1205,7 +1279,7 @@ class PallasUniformEngine:
                     lane_mem = _LaneMemory(
                         lane_memory_bytes(mem_np, lane, pages),
                         max_pages, pages)
-                out, code = serve_one(self.inst, k, args, lane_mem)
+                out, code = serve_one(fi, args, lane_mem)
                 if code:
                     trap_codes[li] = code
                     continue

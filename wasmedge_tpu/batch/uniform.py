@@ -377,8 +377,8 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
         i0 = idx[0]
         agree = jnp.all(idx == i0)
         tsize = table0.shape[0]
-        oob = u_lt(jnp.int32(tsize - 1), i0) | (i0 < 0)
-        h = table0[jnp.clip(i0, 0, tsize - 1)]
+        oob = u_lt(b - 1, i0) | (i0 < 0)
+        h = table0[jnp.clip(c + jnp.clip(i0, 0, b - 1), 0, tsize - 1)]
         null = h == 0
         callee = jnp.clip(h - 1, 0, f_entry.shape[0] - 1)
         sig_bad = f_type[callee] != a

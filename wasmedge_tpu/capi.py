@@ -44,6 +44,8 @@ from wasmedge_tpu.common.errors import (
 )
 from wasmedge_tpu.common.statistics import Statistics
 from wasmedge_tpu.common.types import (
+    bits_to_typed,
+    typed_to_bits,
     MASK32,
     MASK64,
     bits_to_f32,
@@ -586,7 +588,11 @@ def we_VMRunWasmFromBuffer(ctx, data: bytes, func_name: str,
 
 def we_VMRunWasmFromFile(ctx, path: str, func_name: str,
                          params: Sequence[we_Value] = ()):
-    res, data = _wrap(lambda: open(path, "rb").read())
+    def read():
+        with open(path, "rb") as f:
+            return f.read()
+
+    res, data = _wrap(read)
     if not we_ResultOK(res):
         return res, []
     return we_VMRunWasmFromBuffer(ctx, data, func_name, params)
@@ -609,8 +615,25 @@ def we_VMCleanup(ctx) -> None:
 # -- async (reference: WasmEdge_VMAsync* + Async*; include/vm/async.h) ------
 
 
+class _AsyncHandle:
+    def __init__(self, inner, result_types):
+        self.inner = inner
+        self.result_types = result_types
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
 def we_VMAsyncExecute(ctx, func_name: str, params: Sequence[we_Value] = ()):
-    return ctx.vm.async_execute(func_name, _typed_args(params))
+    """The async path runs the typed VM.execute (include/vm/async.h model);
+    raw we_Value cells are decoded to typed values going in and re-encoded
+    coming out of we_AsyncGet."""
+    with ctx.vm._lock:
+        fi = ctx.vm._find_function(func_name)
+    typed = [bits_to_typed(t, p.raw)
+             for t, p in zip(fi.functype.params, params)]
+    return _AsyncHandle(ctx.vm.async_execute(func_name, typed),
+                        fi.functype.results)
 
 
 def we_AsyncWait(handle) -> None:
@@ -626,9 +649,12 @@ def we_AsyncCancel(handle) -> None:
 
 
 def we_AsyncGet(handle):
-    """Returns (Result, typed python values) — the async path runs the
-    typed VM.execute (include/vm/async.h:25-105 model)."""
-    return _wrap(handle.get)
+    res, out = _wrap(handle.inner.get)
+    if not we_ResultOK(res):
+        return res, []
+    cells = [typed_to_bits(t, v)
+             for t, v in zip(handle.result_types, out)]
+    return res, _cells_to_values(handle.result_types, cells)
 
 
 # ---------------------------------------------------------------------------
