@@ -1,0 +1,57 @@
+"""Structured ErrInfo records (reference: errinfo.h:1-299): failures
+carry typed context chains the CLI prints under the headline message."""
+
+import pytest
+
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.common.errinfo import (
+    InfoBoundary, InfoFile, InfoInstruction, InfoMismatch, format_records)
+from wasmedge_tpu.common.errors import ErrCode, LoadError, ValidationError
+from wasmedge_tpu.loader import Loader
+from wasmedge_tpu.validator import Validator
+from wasmedge_tpu.utils.builder import ModuleBuilder, uleb
+
+
+def test_loader_records_offset_and_section():
+    # type section whose functype param vector is truncated
+    data = (b"\x00asm\x01\x00\x00\x00"
+            b"\x01\x04\x01\x60\x02\x7f")  # 2 params declared, 1 present
+    with pytest.raises(LoadError) as ei:
+        Loader(Configure()).parse_module(data)
+    e = ei.value
+    assert e.records, "no ErrInfo records attached"
+    text = e.formatted()
+    assert "byte offset" in text
+    assert "section Type" in text
+
+
+def test_parse_file_records_filename(tmp_path):
+    p = tmp_path / "bad.wasm"
+    p.write_bytes(b"\x00asm\x02\x00\x00\x00")
+    with pytest.raises(LoadError) as ei:
+        Loader(Configure()).parse_file(str(p))
+    assert any(isinstance(r, InfoFile) for r in ei.value.records)
+    assert "bad.wasm" in ei.value.formatted()
+
+
+def test_validator_records_instruction_context():
+    b = ModuleBuilder()
+    b.add_function(["i32"], ["i32"], [],
+                   [("local.get", 0), ("i64.const", 1), "i32.add"],
+                   export="f")
+    mod = Loader(Configure()).parse_module(b.build())
+    with pytest.raises(ValidationError) as ei:
+        Validator(Configure()).validate(mod)
+    text = ei.value.formatted()
+    assert "in instruction i32.add" in text
+    assert "function 0" in text
+
+
+def test_record_rendering():
+    recs = [InfoInstruction("i32.load", pc=7),
+            InfoBoundary(0x10000, 4, 0xFFFF),
+            InfoMismatch("i32", "f64")]
+    out = format_records(recs)
+    assert "in instruction i32.load at pc 7" in out
+    assert "exceeds limit 0xffff" in out
+    assert "expected i32, got f64" in out

@@ -1,0 +1,122 @@
+"""Multi-chip lane sharding over the virtual 8-device CPU mesh.
+
+SURVEY.md §2.10 item 2 / VERDICT round-1 item 8: the mesh path must be
+exercised by pytest, not only by the driver's dryrun.  conftest.py forces
+`--xla_force_host_platform_device_count=8`, so these tests run the real
+pjit/NamedSharding machinery on 8 XLA devices."""
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.batch import BatchEngine
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.common.errors import ErrCode
+from wasmedge_tpu.models import build_fib, build_memory_workload
+from wasmedge_tpu.parallel.mesh import lane_mesh, shard_batch_state, state_shardings
+from wasmedge_tpu.utils.builder import ModuleBuilder
+from tests.helpers import instantiate
+
+
+def make_engine(data, lanes, n_devices=8, conf=None, imports=None):
+    import jax
+
+    assert len(jax.devices()) >= n_devices, "virtual device mesh missing"
+    conf = conf or Configure()
+    conf.batch.steps_per_launch = 4000
+    ex, store, inst = instantiate(data, conf, imports=imports)
+    mesh = lane_mesh(n_devices)
+    eng = BatchEngine(inst, store=store, conf=conf, lanes=lanes, mesh=mesh)
+    return ex, store, inst, eng
+
+
+def _fib(n):
+    return n if n < 2 else _fib(n - 1) + _fib(n - 2)
+
+
+def test_sharded_fib_4096_lanes_8_devices():
+    """The VERDICT-prescribed scale: 4096 lanes over 8 virtual devices."""
+    ex, store, inst, eng = make_engine(build_fib(), lanes=4096)
+    ns = (np.arange(4096) % 11).astype(np.int64)
+    res = eng.run("fib", [ns], max_steps=300_000)
+    assert (res.trap == -1).all()
+    expect = np.array([_fib(int(n)) for n in range(11)], np.int64)
+    assert (res.results[0] == expect[ns % 11]).all()
+
+
+def test_sharding_layout():
+    """State arrays really are lane-sharded across all 8 devices."""
+    import jax
+
+    ex, store, inst, eng = make_engine(build_fib(), lanes=64)
+    state = eng.initial_state(inst.exports["fib"][1],
+                              [np.zeros(64, np.int64)])
+    mesh = lane_mesh(8)
+    sharded = shard_batch_state(state, mesh)
+    shardings = state_shardings(mesh, state)
+    stack = sharded.stack_lo
+    assert len(stack.sharding.device_set) == 8
+    # lane (last) dim split 8 ways, row dim replicated
+    shard_shape = stack.sharding.shard_shape(stack.shape)
+    assert shard_shape == (stack.shape[0], stack.shape[1] // 8)
+
+
+def test_uneven_lane_count():
+    """Lanes not divisible by the device count still run correctly (the
+    engine pads or XLA handles the ragged shard)."""
+    for lanes in (24, 40):
+        ex, store, inst, eng = make_engine(build_fib(), lanes=lanes,
+                                           n_devices=8)
+        ns = (np.arange(lanes) % 9).astype(np.int64)
+        res = eng.run("fib", [ns], max_steps=100_000)
+        assert (res.trap == -1).all()
+        for lane in range(lanes):
+            assert res.results[0][lane] == _fib(int(ns[lane]))
+
+
+def test_mesh_with_fuel():
+    """Fuel accounting composes with lane sharding: exhausted lanes trap
+    with CostLimitExceeded while cheap lanes complete."""
+    conf = Configure()
+    conf.batch.fuel_per_launch = 2000
+    ex, store, inst, eng = make_engine(build_fib(), lanes=16, conf=conf)
+    ns = np.where(np.arange(16) % 2 == 0, 3, 16).astype(np.int64)
+    res = eng.run("fib", [ns], max_steps=100_000)
+    cheap = np.arange(16) % 2 == 0
+    assert (res.trap[cheap] == -1).all()
+    assert (res.trap[~cheap] == int(ErrCode.CostLimitExceeded)).all()
+
+
+def test_mesh_memory_and_traps():
+    """Per-lane memory planes shard on the lane dim; traps stay per-lane."""
+    b = ModuleBuilder()
+    b.add_memory(1, 1)
+    b.add_function(["i32", "i32"], ["i32"], [], [
+        ("local.get", 0), ("local.get", 1), ("i32.store", 2, 0),
+        ("local.get", 0), ("i32.load", 2, 0),
+    ], export="f")
+    ex, store, inst, eng = make_engine(b.build(), lanes=32)
+    addrs = (np.arange(32, dtype=np.int64) * 8) % 128
+    addrs[5] = 0x10000  # OOB lane
+    vals = np.arange(32, dtype=np.int64) * 3 + 1
+    res = eng.run("f", [addrs, vals], max_steps=10_000)
+    assert res.trap[5] == int(ErrCode.MemoryOutOfBounds)
+    ok = [i for i in range(32) if i != 5]
+    assert (res.results[0][ok] == vals[ok]).all()
+
+
+def test_mesh_hostcall_roundtrip():
+    """The device-to-host outcall channel works on sharded state."""
+    from wasmedge_tpu.runtime.hostfunc import ImportObject, PyHostFunction
+
+    imp = ImportObject("env")
+    imp.add_func("triple", PyHostFunction(lambda mem, x: x * 3,
+                                          ["i32"], ["i32"]))
+    b = ModuleBuilder()
+    b.import_func("env", "triple", ["i32"], ["i32"])
+    b.add_function(["i32"], ["i32"], [],
+                   [("local.get", 0), ("call", 0)], export="f")
+    ex, store, inst, eng = make_engine(b.build(), lanes=16, imports=[imp])
+    args = np.arange(16, dtype=np.int64)
+    res = eng.run("f", [args], max_steps=10_000)
+    assert (res.trap == -1).all()
+    assert (res.results[0] == args * 3).all()

@@ -155,7 +155,10 @@ def run_command(argv: List[str], out=None, err=None) -> int:
         vm.validate()
         vm.instantiate()
     except WasmError as e:
-        err.write(f"wasmedge-tpu: load failed: {e}\n")
+        err.write(f"wasmedge-tpu: load failed: {e.formatted()}\n")
+        return 1
+    except OSError as e:
+        err.write(f"wasmedge-tpu: cannot read {path}: {e}\n")
         return 1
 
     def invoke(fn_name: str, args: list) -> Optional[list]:

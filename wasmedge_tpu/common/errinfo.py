@@ -1,0 +1,125 @@
+"""Structured error-context records (ErrInfo).
+
+Mirrors the reference's ErrInfo record system
+(/root/reference/include/common/errinfo.h:1-299, lib/common/
+errinfo.cpp:1-274): a failure site attaches typed context records to the
+error as it unwinds — file, byte offset, AST node, instruction, type
+mismatch, boundary, proposal — and the CLI prints the chain under the
+headline message, so a loader failure reads like
+
+    wasmedge-tpu: load failed: malformed section id
+        loading failed at byte offset 0x27
+        while parsing section Code
+        in file "app.wasm"
+
+Records are plain dataclasses; `WasmError.with_info(...)` appends and
+returns the error (usable in a raise expression), `format_records`
+renders them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class InfoFile:
+    """errinfo.h InfoFile — which file was being processed."""
+
+    path: str
+
+    def __str__(self):
+        return f'in file "{self.path}"'
+
+
+@dataclasses.dataclass
+class InfoLoading:
+    """errinfo.h InfoLoading — byte offset the loader failed at."""
+
+    offset: int
+
+    def __str__(self):
+        return f"loading failed at byte offset 0x{self.offset:x}"
+
+
+@dataclasses.dataclass
+class InfoAST:
+    """errinfo.h InfoAST — which AST node was being parsed/checked."""
+
+    node: str
+
+    def __str__(self):
+        return f"while parsing {self.node}"
+
+
+@dataclasses.dataclass
+class InfoInstruction:
+    """errinfo.h InfoInstruction — opcode + offset/pc context."""
+
+    opcode: str
+    offset: Optional[int] = None
+    pc: Optional[int] = None
+
+    def __str__(self):
+        where = ""
+        if self.offset is not None:
+            where = f" at byte offset 0x{self.offset:x}"
+        elif self.pc is not None:
+            where = f" at pc {self.pc}"
+        return f"in instruction {self.opcode}{where}"
+
+
+@dataclasses.dataclass
+class InfoMismatch:
+    """errinfo.h InfoMismatch — expected vs got (types, arities, limits)."""
+
+    expected: str
+    got: str
+
+    def __str__(self):
+        return f"expected {self.expected}, got {self.got}"
+
+
+@dataclasses.dataclass
+class InfoBoundary:
+    """errinfo.h InfoBoundary — access range vs limit."""
+
+    offset: int
+    size: int
+    limit: int
+
+    def __str__(self):
+        return (f"accessing [0x{self.offset:x}, "
+                f"0x{self.offset + self.size:x}) exceeds limit "
+                f"0x{self.limit:x}")
+
+
+@dataclasses.dataclass
+class InfoProposal:
+    """errinfo.h InfoProposal — feature needs an off proposal."""
+
+    proposal: str
+
+    def __str__(self):
+        return f"needs the {self.proposal!r} proposal enabled"
+
+
+@dataclasses.dataclass
+class InfoLimit:
+    """errinfo.h InfoLimit — a declared limit is out of range."""
+
+    has_max: bool
+    min: int
+    max: Optional[int] = None
+
+    def __str__(self):
+        if self.has_max and self.max is not None:
+            return f"limit {{min {self.min}, max {self.max}}}"
+        return f"limit {{min {self.min}}}"
+
+
+def format_records(records: Sequence) -> str:
+    """Render a record chain, one indented line each (errinfo.cpp's
+    operator<< chain as printed by the reference CLI)."""
+    return "\n".join(f"    {r}" for r in records)

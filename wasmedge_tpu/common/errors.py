@@ -119,15 +119,34 @@ TRAP_MESSAGES = {
 
 
 class WasmError(Exception):
-    """Base for all phase errors; carries an ErrCode."""
+    """Base for all phase errors; carries an ErrCode and an ErrInfo record
+    chain (reference: include/common/errinfo.h:1-299 — context records
+    attached as the error unwinds, printed by the CLI)."""
 
     def __init__(self, code: ErrCode, msg: str = "", offset: int | None = None):
         self.code = ErrCode(code)
         self.offset = offset
+        self.records: list = []
         text = msg or TRAP_MESSAGES.get(self.code, self.code.name)
         if offset is not None:
             text = f"{text} (at byte offset 0x{offset:x})"
+            from wasmedge_tpu.common.errinfo import InfoLoading
+
+            self.records.append(InfoLoading(offset))
         super().__init__(text)
+
+    def with_info(self, *records) -> "WasmError":
+        """Append context records; returns self (usable in `raise`)."""
+        self.records.extend(records)
+        return self
+
+    def formatted(self) -> str:
+        from wasmedge_tpu.common.errinfo import format_records
+
+        text = str(self)
+        if self.records:
+            text += "\n" + format_records(self.records)
+        return text
 
 
 class LoadError(WasmError):

@@ -22,6 +22,12 @@ from wasmedge_tpu.loader.filemgr import FileMgr
 MAGIC = b"\x00asm"
 VERSION = b"\x01\x00\x00\x00"
 
+# section names for ErrInfo context records (errinfo.h InfoAST analog)
+_SECTION_NAMES = {0: "Custom", 1: "Type", 2: "Import", 3: "Function",
+                  4: "Table", 5: "Memory", 6: "Global", 7: "Export",
+                  8: "Start", 9: "Element", 10: "Code", 11: "Data",
+                  12: "DataCount"}
+
 _NUM_TYPES = {0x7F: ValType.I32, 0x7E: ValType.I64, 0x7D: ValType.F32, 0x7C: ValType.F64}
 _REF_TYPES = {0x70: ValType.FuncRef, 0x6F: ValType.ExternRef}
 
@@ -42,7 +48,7 @@ class Loader:
         if fm.read_bytes(4) != VERSION:
             raise LoadError(ErrCode.MalformedVersion, offset=4)
         mod = ast.Module()
-        last_order = -1
+        last_order = -1  # section ordering cursor
         code_count_seen = 0
         while not fm.at_end():
             sec_start = fm.pos
@@ -63,7 +69,13 @@ class Loader:
                 if order <= last_order:
                     raise LoadError(ErrCode.JunkSection, offset=fm.pos)
                 last_order = order
-                self._load_section(sec_id, sub, mod)
+                try:
+                    self._load_section(sec_id, sub, mod)
+                except LoadError as e:
+                    from wasmedge_tpu.common.errinfo import InfoAST
+
+                    raise e.with_info(InfoAST(
+                        f"section {_SECTION_NAMES.get(sec_id, sec_id)}"))
                 if sub.pos != sec_end:
                     raise LoadError(ErrCode.SectionSizeMismatch, offset=sub.pos)
                 if sec_id == 10:
@@ -77,8 +89,14 @@ class Loader:
         return mod
 
     def parse_file(self, path: str) -> ast.Module:
+        from wasmedge_tpu.common.errinfo import InfoFile
+
         with open(path, "rb") as f:
-            return self.parse_module(f.read())
+            data = f.read()
+        try:
+            return self.parse_module(data)
+        except LoadError as e:
+            raise e.with_info(InfoFile(path))
 
     # -- sections ----------------------------------------------------------
     def _load_section(self, sec_id: int, fm: FileMgr, mod: ast.Module):

@@ -183,7 +183,16 @@ class FormChecker:
         entry_pc = self.image.code_len
         self.push_ctrl("func", (), self.returns)
         for ins in code.body:
-            self.check_instr(ins)
+            try:
+                self.check_instr(ins)
+            except ValidationError as e:
+                from wasmedge_tpu.common.errinfo import InfoAST, InfoInstruction
+                from wasmedge_tpu.common.opcodes import name_of
+
+                raise e.with_info(
+                    InfoInstruction(name_of(ins.op),
+                                    offset=getattr(ins, "offset", None)),
+                    InfoAST(f"function {func_idx}"))
         if self.ctrls:
             self._err(msg="function body missing final end")
         meta = FuncMeta(
