@@ -34,7 +34,23 @@ F32_EDGES_BITS = [
     0x42280000,              # 42.0
 ]
 
-_EDGES = {"i": I32_EDGES, "I": I64_EDGES, "f": F32_EDGES_BITS}
+F64_EDGES_BITS = [
+    0x0000000000000000, 0x8000000000000000,   # +-0
+    0x3FF0000000000000, 0xBFF0000000000000,   # +-1
+    0x3FF8000000000000,                       # 1.5
+    0x7FF0000000000000, 0xFFF0000000000000,   # +-inf
+    0x7FF8000000000000, 0xFFF8000000000001,   # nans
+    0x0000000000000001,                       # min subnormal
+    0x43E0000000000000,                       # 2^63
+    0x43DFFFFFFFFFFFFF,                       # just under 2^63
+    0xC3E0000000000000,                       # -2^63
+    0x4045000000000000,                       # 42.0
+    0x3FB999999999999A,                       # 0.1
+    0x7FEFFFFFFFFFFFFF,                       # max finite
+]
+
+_EDGES = {"i": I32_EDGES, "I": I64_EDGES, "f": F32_EDGES_BITS,
+          "F": F64_EDGES_BITS}
 
 # f32 ops that are bitwise or integer-domain in the batch engine stay exact
 # for denormal inputs even on FTZ hardware; arithmetic ops flush subnormals
@@ -50,9 +66,9 @@ _DENORMAL_BITS = {0x00000001}
 def _cells(ch, vals):
     if ch == "i":
         return [v & 0xFFFFFFFF for v in vals]
-    if ch == "I":
+    if ch == "I" or ch == "F":
         return [v & 0xFFFFFFFFFFFFFFFF for v in vals]
-    return list(vals)  # f32 bit patterns already
+    return list(vals)  # f32/f64 bit patterns already
 
 
 def _batch_supported(name: str) -> bool:
@@ -70,13 +86,13 @@ def _plain_ops():
         if not _batch_supported(info.name):
             continue
         pops, pushes = info.sig.split("->")
-        if any(c not in "iIf" for c in pops + pushes):
+        if any(c not in "iIfF" for c in pops + pushes):
             continue
         out.append((info.name, pops, pushes))
     return out
 
 
-_SIG_STR = {"i": "i32", "I": "i64", "f": "f32"}
+_SIG_STR = {"i": "i32", "I": "i64", "f": "f32", "F": "f64"}
 
 
 @pytest.fixture(scope="module")

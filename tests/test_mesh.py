@@ -53,6 +53,9 @@ def test_sharding_layout():
     mesh = lane_mesh(8)
     sharded = shard_batch_state(state, mesh)
     shardings = state_shardings(mesh, state)
+    from jax.sharding import PartitionSpec as P
+    assert shardings.stack_lo.spec == P(None, "lanes")
+    assert shardings.pc.spec == P("lanes")
     stack = sharded.stack_lo
     assert len(stack.sharding.device_set) == 8
     # lane (last) dim split 8 ways, row dim replicated

@@ -1,0 +1,189 @@
+"""Softfloat binary64 differential suite: bit-exact vs numpy float64.
+
+Every op/edge tested against the host's IEEE doubles (an independent
+oracle): signed zeros, subnormals, infs, NaN canonicalization, RNE ties,
+and 20k random bit patterns biased toward interesting exponents.  The
+batch engines consume these kernels via laneops.alu2_fns/alu1_fns; the
+engine-level parity suite (test_batch_parity.py) separately pins them to
+the scalar oracle through the full pipeline.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from wasmedge_tpu.batch import softfloat as sf
+
+EDGES = np.array([
+    0.0, -0.0, 1.0, -1.0, 0.5, -0.5, 1.5, 2.0, 3.141592653589793,
+    1e308, -1e308, 1e-308, 5e-324, -5e-324, 2.2250738585072014e-308,
+    np.inf, -np.inf, np.nan, 1e16, 1e16 + 2, 0.1, 0.2, 1 / 3, 2.0**52,
+    2.0**53, 2.0**53 + 2.0, -2.0**52 - 0.5, 6.283185307179586, 1e-30,
+    -7.25e-12, 4503599627370495.5, 0.49999999999999994, 2.5, 3.5, -2.5,
+], np.float64)
+
+
+def bits_of(x):
+    b = np.asarray(x, np.float64).view(np.uint64)
+    return ((b & 0xFFFFFFFF).astype(np.uint32).view(np.int32),
+            (b >> 32).astype(np.uint32).view(np.int32))
+
+
+def u64(lo, hi):
+    return (np.asarray(lo).view(np.uint32).astype(np.uint64)
+            | (np.asarray(hi).view(np.uint32).astype(np.uint64)
+               << np.uint64(32)))
+
+
+def canon(x):
+    x = np.asarray(x, np.float64).copy()
+    b = x.view(np.uint64)
+    b[np.isnan(x)] = 0x7FF8000000000000
+    return b.view(np.float64)
+
+
+def rand_doubles(n, seed=42):
+    rng = np.random.default_rng(seed)
+    bits = rng.integers(0, 2**64, n, dtype=np.uint64)
+    mask = rng.random(n) < 0.3
+    e = rng.integers(1015, 1035, n, dtype=np.uint64) << 52
+    bits = np.where(mask, (bits & ~(np.uint64(0x7FF) << 52)) | e, bits)
+    return bits.view(np.float64)
+
+
+def pairs():
+    n = len(EDGES)
+    a = np.concatenate([np.repeat(EDGES, n), rand_doubles(20000)])
+    b = np.concatenate([np.tile(EDGES, n), rand_doubles(20000, seed=7)])
+    return a, b
+
+
+def check_bin(swfn, npfn):
+    a, b = pairs()
+    alo, ahi = bits_of(a)
+    blo, bhi = bits_of(b)
+    rlo, rhi = jax.jit(swfn)(alo, ahi, blo, bhi)
+    with np.errstate(all="ignore"):
+        want = canon(npfn(a, b)).view(np.uint64)
+    got = u64(rlo, rhi)
+    bad = got != want
+    assert not bad.any(), (
+        f"{a[bad][0]!r} op {b[bad][0]!r}: got 0x{got[bad][0]:016x} "
+        f"want 0x{want[bad][0]:016x}")
+
+
+def check_un(swfn, npfn, vals=None):
+    a = np.concatenate([EDGES, rand_doubles(20000)]) if vals is None else vals
+    alo, ahi = bits_of(a)
+    rlo, rhi = jax.jit(swfn)(alo, ahi)
+    with np.errstate(all="ignore"):
+        want = canon(npfn(a)).view(np.uint64)
+    got = u64(rlo, rhi)
+    bad = got != want
+    assert not bad.any(), (
+        f"op({a[bad][0]!r}): got 0x{got[bad][0]:016x} "
+        f"want 0x{want[bad][0]:016x}")
+
+
+def wasm_min(x, y):
+    out = np.where(np.isnan(x) | np.isnan(y), np.nan, np.minimum(x, y))
+    bz = (x == 0) & (y == 0)
+    neg = np.signbit(x) | np.signbit(y)
+    return np.where(bz & ~np.isnan(x) & ~np.isnan(y),
+                    np.where(neg, -0.0, 0.0), out)
+
+
+def wasm_max(x, y):
+    out = np.where(np.isnan(x) | np.isnan(y), np.nan, np.maximum(x, y))
+    bz = (x == 0) & (y == 0)
+    pos = ~np.signbit(x) | ~np.signbit(y)
+    return np.where(bz & ~np.isnan(x) & ~np.isnan(y),
+                    np.where(pos, 0.0, -0.0), out)
+
+
+def test_add():
+    check_bin(sf.f64_add, np.add)
+
+
+def test_sub():
+    check_bin(sf.f64_sub, np.subtract)
+
+
+def test_mul():
+    check_bin(sf.f64_mul, np.multiply)
+
+
+def test_div():
+    check_bin(sf.f64_div, np.divide)
+
+
+def test_min_max():
+    check_bin(sf.f64_min, wasm_min)
+    check_bin(sf.f64_max, wasm_max)
+
+
+def test_sqrt():
+    check_un(sf.f64_sqrt, np.sqrt)
+
+
+def test_roundings():
+    check_un(sf.f64_trunc, np.trunc)
+    check_un(sf.f64_floor, np.floor)
+    check_un(sf.f64_ceil, np.ceil)
+    check_un(sf.f64_nearest, np.rint)
+
+
+def test_int_conversions():
+    rng = np.random.default_rng(3)
+    iv = np.concatenate([
+        np.array([0, 1, -1, 2**63 - 1, -2**63, 2**52, 2**53, 2**53 + 1,
+                  2**62, -2**62 - 12345], np.int64),
+        rng.integers(-2**63, 2**63 - 1, 5000, dtype=np.int64)])
+    ilo = (iv & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    ihi = ((iv >> 32) & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    rl, rh = jax.jit(lambda a, b: sf.f64_from_i64(a, b, True))(ilo, ihi)
+    assert (u64(rl, rh) == iv.astype(np.float64).view(np.uint64)).all()
+    rl, rh = jax.jit(lambda a, b: sf.f64_from_i64(a, b, False))(ilo, ihi)
+    uv = iv.view(np.uint64)
+    assert (u64(rl, rh) == uv.astype(np.float64).view(np.uint64)).all()
+    r32 = jax.jit(lambda a, b: sf.f32_from_i64(a, b, True))(ilo, ihi)
+    assert (np.asarray(r32).view(np.uint32)
+            == iv.astype(np.float32).view(np.uint32)).all()
+
+
+def test_trunc_to_i64():
+    fv = np.concatenate([EDGES, rand_doubles(10000),
+                         np.array([2.0**63, -(2.0**63), 2.0**63 - 2048.0,
+                                   1.8446744073709552e19, -1.5])])
+    flo, fhi = bits_of(fv)
+    olo, ohi, ok_s, ok_u, nan = jax.jit(sf.f64_to_i64_trunc)(flo, fhi)
+    with np.errstate(all="ignore"):
+        tr = np.trunc(fv)
+        want_ok_s = ~np.isnan(fv) & (tr >= -2.0**63) & (tr < 2.0**63)
+        want_ok_u = ~np.isnan(fv) & (tr > -1.0) & (tr < 2.0**64)
+    assert (np.asarray(ok_s) == want_ok_s).all()
+    assert (np.asarray(ok_u) == want_ok_u).all()
+    got = u64(olo, ohi).view(np.int64)
+    sel = want_ok_s
+    assert (got[sel] == tr[sel].astype(np.int64)).all()
+
+
+def test_demote_promote():
+    fv = np.concatenate([EDGES, rand_doubles(10000)])
+    flo, fhi = bits_of(fv)
+    r32 = jax.jit(sf.f64_to_f32)(flo, fhi)
+    with np.errstate(all="ignore"):
+        want32 = fv.astype(np.float32)
+    want32 = np.where(np.isnan(want32), np.float32(np.nan),
+                      want32).view(np.uint32)
+    assert (np.asarray(r32).view(np.uint32) == want32).all()
+
+    rng = np.random.default_rng(9)
+    f32v = rng.integers(0, 2**32, 10000,
+                        dtype=np.uint64).astype(np.uint32).view(np.float32)
+    pl_, ph = jax.jit(sf.f32_to_f64)(f32v.view(np.int32))
+    with np.errstate(all="ignore"):
+        want = f32v.astype(np.float64)
+    want = np.where(np.isnan(want), np.nan, want).view(np.uint64)
+    assert (u64(pl_, ph) == want).all()

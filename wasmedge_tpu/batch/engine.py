@@ -44,6 +44,7 @@ from wasmedge_tpu.batch.image import (
     CLS_CONST,
     CLS_DROP,
     CLS_GLOBAL_GET,
+    ALU2_F64_BASE,
     CLS_GLOBAL_SET,
     CLS_HOSTCALL,
     CLS_LOAD,
@@ -58,6 +59,7 @@ from wasmedge_tpu.batch.image import (
     CLS_TRAP,
     NUM_CLASSES,
     TRAP_DONE,
+    _F64_BIN,
     TRAP_HOSTCALL,
     DeviceImage,
     _F32_BIN,
@@ -157,6 +159,16 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
 
     b2i = lo_ops.b2i
     u_lt = lo_ops.u_lt
+    used_alu2 = {int(sv) for sv, cv in zip(img.sub, img.cls)
+                 if cv == CLS_ALU2}
+    used_alu1 = {int(sv) for sv, cv in zip(img.sub, img.cls)
+                 if cv == CLS_ALU1}
+    _A2F = lo_ops.alu2_fns()
+    _A1F = lo_ops.alu1_fns()
+    _T1F = lo_ops.alu1_trap_fns()
+    _HEAVY_ALU2 = {ALU2_F64_BASE + _F64_BIN.index("div")}
+    from wasmedge_tpu.batch.image import ALU1_SUB as _A1S
+    _HEAVY_ALU1 = {_A1S["f64.sqrt"]}
 
     def step(st: BatchState) -> BatchState:
         active = st.trap == 0
@@ -326,6 +338,25 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         alu2_lo = jnp.where(rare_divs, rare_lo, alu2_lo)
         alu2_hi = jnp.where(rare_divs, rare_hi, alu2_hi)
 
+        # binary64 (softfloat) subs from the shared table, pruned to what
+        # this module's image actually uses so f64-free modules pay
+        # nothing; the iterative f64.div runs under an any-lane cond like
+        # the i64 divisions above
+        for sid in sorted(used_alu2 & set(_A2F)):
+            if sid < ALU2_F64_BASE:
+                continue
+            fn = _A2F[sid]
+            if sid in _HEAVY_ALU2:
+                m = is_alu2 & (sub == sid)
+                rl, rh = lax.cond(
+                    jnp.any(m & active),
+                    lambda fn=fn: fn(x_lo, x_hi, y_lo, y_hi),
+                    lambda: (x_lo, x_hi))
+            else:
+                rl, rh = fn(x_lo, x_hi, y_lo, y_hi)
+            alu2_lo = jnp.where(sub == sid, rl, alu2_lo)
+            alu2_hi = jnp.where(sub == sid, rh, alu2_hi)
+
         # ALU2 traps: i32/i64 division
         div_i32 = is_alu2 & ((sub == S_I32["div_s"]) | (sub == S_I32["div_u"])
                              | (sub == S_I32["rem_s"]) | (sub == S_I32["rem_u"]))
@@ -415,12 +446,30 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         alu1_lo = sel_chain(sub, alu1_pairs_lo, w_lo)
         alu1_hi = sel_chain(sub, alu1_pairs_hi, jnp.int32(0))
         is_alu1 = is_cls[CLS_ALU1]
-        trunc_traps = is_alu1 & (
-            ((sub == A1["i32.trunc_f32_s"]) & (nan_w | ~in_s))
-            | ((sub == A1["i32.trunc_f32_u"]) & (nan_w | ~in_u)))
-        alu1_trap = jnp.where(
-            trunc_traps & nan_w, int(ErrCode.InvalidConvToInt),
-            jnp.where(trunc_traps, int(ErrCode.IntegerOverflow), 0))
+        # subs beyond the hand-rolled chain (the f64/softfloat family and
+        # the i64<->float conversions) come from the shared table, pruned
+        # to the module's image
+        _handled = {sid for sid, _ in alu1_pairs_lo}
+        for sid in sorted(used_alu1 & set(_A1F)):
+            if sid in _handled:
+                continue
+            fn = _A1F[sid]
+            if sid in _HEAVY_ALU1:
+                m = is_alu1 & (sub == sid)
+                rl, rh = lax.cond(
+                    jnp.any(m & active),
+                    lambda fn=fn: fn(w_lo, w_hi),
+                    lambda: (w_lo, w_hi))
+            else:
+                rl, rh = fn(w_lo, w_hi)
+            alu1_lo = jnp.where(sub == sid, rl, alu1_lo)
+            alu1_hi = jnp.where(sub == sid, rh, alu1_hi)
+        # traps for every trapping truncation, from the shared table
+        alu1_trap = jnp.int32(0) * w_lo
+        for sid in sorted(used_alu1 & set(_T1F)):
+            bad, codes = _T1F[sid](w_lo, w_hi)
+            m = is_alu1 & (sub == sid) & bad
+            alu1_trap = jnp.where(m, codes, alu1_trap)
 
         # =================== memory ===================
         is_load = is_cls[CLS_LOAD]

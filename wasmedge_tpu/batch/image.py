@@ -61,11 +61,13 @@ _I32_BIN = ["add", "sub", "mul", "div_s", "div_u", "rem_s", "rem_u", "and",
             "ge_s", "ge_u"]
 _F32_BIN = ["add", "sub", "mul", "div", "min", "max", "copysign",
             "eq", "ne", "lt", "gt", "le", "ge"]
+_F64_BIN = list(_F32_BIN)  # same op set, softfloat binary64 kernels
 
 ALU2_I32_BASE = 0
 ALU2_I64_BASE = len(_I32_BIN)           # 25
 ALU2_F32_BASE = 2 * len(_I32_BIN)       # 50
-NUM_ALU2 = ALU2_F32_BASE + len(_F32_BIN)  # 63
+ALU2_F64_BASE = ALU2_F32_BASE + len(_F32_BIN)  # 63
+NUM_ALU2 = ALU2_F64_BASE + len(_F64_BIN)  # 76
 
 # i64 div/rem are "rare" subs: executed under an any-lane cond (64-iter loop)
 RARE_ALU2_SUBS = tuple(ALU2_I64_BASE + _I32_BIN.index(n)
@@ -85,6 +87,20 @@ _ALU1 = [
     "f32.convert_i32_s", "f32.convert_i32_u",
     "i32.reinterpret_f32", "f32.reinterpret_i32",
     "ref.is_null",
+    # binary64 (softfloat lo/hi-plane kernels, batch/softfloat.py)
+    "f64.abs", "f64.neg", "f64.ceil", "f64.floor", "f64.trunc",
+    "f64.nearest", "f64.sqrt",
+    "f32.demote_f64", "f64.promote_f32",
+    "i64.reinterpret_f64", "f64.reinterpret_i64",
+    "f64.convert_i32_s", "f64.convert_i32_u",
+    "f64.convert_i64_s", "f64.convert_i64_u",
+    "f32.convert_i64_s", "f32.convert_i64_u",
+    "i32.trunc_f64_s", "i32.trunc_f64_u",
+    "i64.trunc_f32_s", "i64.trunc_f32_u",
+    "i64.trunc_f64_s", "i64.trunc_f64_u",
+    "i32.trunc_sat_f64_s", "i32.trunc_sat_f64_u",
+    "i64.trunc_sat_f32_s", "i64.trunc_sat_f32_u",
+    "i64.trunc_sat_f64_s", "i64.trunc_sat_f64_u",
 ]
 ALU1_SUB = {n: i for i, n in enumerate(_ALU1)}
 NUM_ALU1 = len(_ALU1)
@@ -107,19 +123,9 @@ _STORES = {
 
 # Ops outside the batch subset (v1). Modules containing them in *reachable
 # batched code* fall back to the scalar engine.
-_UNSUPPORTED_PREFIXES = ("f64.", "v128.", "i8x16.", "i16x8.", "i32x4.",
+_UNSUPPORTED_PREFIXES = ("v128.", "i8x16.", "i16x8.", "i32x4.",
                          "i64x2.", "f32x4.", "f64x2.")
 _UNSUPPORTED_NAMES = {
-    "i64.trunc_f32_s", "i64.trunc_f32_u", "i64.trunc_f64_s", "i64.trunc_f64_u",
-    "i32.trunc_f64_s", "i32.trunc_f64_u",
-    "i64.trunc_sat_f32_s", "i64.trunc_sat_f32_u",
-    "i64.trunc_sat_f64_s", "i64.trunc_sat_f64_u",
-    "i32.trunc_sat_f64_s", "i32.trunc_sat_f64_u",
-    "f32.convert_i64_s", "f32.convert_i64_u",
-    "f64.convert_i32_s", "f64.convert_i32_u",
-    "f64.convert_i64_s", "f64.convert_i64_u",
-    "f32.demote_f64", "f64.promote_f32",
-    "i64.reinterpret_f64", "f64.reinterpret_i64",
     "table.get", "table.set", "table.size", "table.grow", "table.fill",
     "table.copy", "table.init", "elem.drop",
     "memory.init", "memory.copy", "memory.fill", "data.drop",
@@ -247,6 +253,8 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
                for i, s in enumerate(_I32_BIN)}
     f32_bin = {NAME_TO_ID[f"f32.{s}"]: ALU2_F32_BASE + i
                for i, s in enumerate(_F32_BIN)}
+    f64_bin = {NAME_TO_ID[f"f64.{s}"]: ALU2_F64_BASE + i
+               for i, s in enumerate(_F64_BIN)}
     alu1 = {NAME_TO_ID[nm]: s for nm, s in ALU1_SUB.items()}
     loads = {NAME_TO_ID[nm]: v for nm, v in _LOADS.items()}
     stores = {NAME_TO_ID[nm]: v for nm, v in _STORES.items()}
@@ -306,6 +314,8 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
             cls[pc], sub[pc] = CLS_ALU2, i64_bin[op]
         elif op in f32_bin:
             cls[pc], sub[pc] = CLS_ALU2, f32_bin[op]
+        elif op in f64_bin:
+            cls[pc], sub[pc] = CLS_ALU2, f64_bin[op]
         elif op in alu1:
             cls[pc], sub[pc] = CLS_ALU1, alu1[op]
         elif op in loads:

@@ -20,6 +20,8 @@ I32 = jnp.int32
 # Host-side (numpy) scalars, not device arrays: pallas kernels trace these
 # functions and cannot capture concrete jax Arrays as closure constants.
 _SIGN = np.int32(-0x80000000)  # 0x80000000 as int32
+_TRAP_INVALID_CONV = 0x86   # ErrCode.InvalidConvToInt
+_TRAP_INT_OVERFLOW = 0x85   # ErrCode.IntegerOverflow
 
 
 def u_lt(a, b):
@@ -316,8 +318,8 @@ def f32_trunc(a_bits):
 # Indexed by the ALU2/ALU1 sub ids from batch/image.py.
 # ---------------------------------------------------------------------------
 from wasmedge_tpu.batch.image import (  # noqa: E402
-    ALU1_SUB, ALU2_F32_BASE, ALU2_I32_BASE, ALU2_I64_BASE,
-    _F32_BIN, _I32_BIN)
+    ALU1_SUB, ALU2_F32_BASE, ALU2_F64_BASE, ALU2_I32_BASE, ALU2_I64_BASE,
+    _F32_BIN, _F64_BIN, _I32_BIN)
 
 
 def alu2_fns():
@@ -442,6 +444,34 @@ def alu2_fns():
 
     for which in ("eq", "ne", "lt", "gt", "le", "ge"):
         f32op(which, fcmp(which))
+
+    # binary64: softfloat kernels on the (lo, hi) planes
+    from wasmedge_tpu.batch import softfloat as sf
+
+    def f64op(name, fn):
+        fns[ALU2_F64_BASE + _F64_BIN.index(name)] = fn
+
+    f64op("add", lambda xl, xh, yl, yh: sf.f64_add(xl, xh, yl, yh))
+    f64op("sub", lambda xl, xh, yl, yh: sf.f64_sub(xl, xh, yl, yh))
+    f64op("mul", lambda xl, xh, yl, yh: sf.f64_mul(xl, xh, yl, yh))
+    f64op("div", lambda xl, xh, yl, yh: sf.f64_div(xl, xh, yl, yh))
+    f64op("min", lambda xl, xh, yl, yh: sf.f64_min(xl, xh, yl, yh))
+    f64op("max", lambda xl, xh, yl, yh: sf.f64_max(xl, xh, yl, yh))
+    f64op("copysign", lambda xl, xh, yl, yh: (
+        xl, (xh & jnp.int32(0x7FFFFFFF)) | (yh & _SIGN)))
+
+    def f64cmp(which):
+        def fn(xl, xh, yl, yh):
+            eqv = sf.f64_eq(xl, xh, yl, yh)
+            ltv = sf.f64_lt(xl, xh, yl, yh)
+            gtv = sf.f64_lt(yl, yh, xl, xh)
+            v = {"eq": eqv, "ne": ~eqv, "lt": ltv, "gt": gtv,
+                 "le": ltv | eqv, "ge": gtv | eqv}[which]
+            return (b2i(v), jnp.zeros_like(xl))
+        return fn
+
+    for which in ("eq", "ne", "lt", "gt", "le", "ge"):
+        f64op(which, f64cmp(which))
     return fns
 
 
@@ -496,7 +526,7 @@ def alu1_fns():
                          jnp.where(tr > jnp.float32(4294967040.0),
                                    jnp.int32(-1), trunc_u(wl)))
 
-    return {
+    fns = {
         A1["i32.clz"]: lambda wl, wh: (lax.clz(wl), z_of(wl)),
         A1["i32.ctz"]: lambda wl, wh: (ctz32(wl), z_of(wl)),
         A1["i32.popcnt"]: lambda wl, wh: (lax.population_count(wl), z_of(wl)),
@@ -543,4 +573,131 @@ def alu1_fns():
         A1["f32.reinterpret_i32"]: lambda wl, wh: (wl, z_of(wl)),
         A1["ref.is_null"]: lambda wl, wh: (b2i((wl | wh) == 0), z_of(wl)),
     }
+
+    from wasmedge_tpu.batch import softfloat as sf
+
+    fns.update({
+        A1["f64.abs"]: lambda wl, wh: (wl, wh & jnp.int32(0x7FFFFFFF)),
+        A1["f64.neg"]: lambda wl, wh: (wl, wh ^ _SIGN),
+        A1["f64.ceil"]: sf.f64_ceil,
+        A1["f64.floor"]: sf.f64_floor,
+        A1["f64.trunc"]: sf.f64_trunc,
+        A1["f64.nearest"]: sf.f64_nearest,
+        A1["f64.sqrt"]: sf.f64_sqrt,
+        A1["f32.demote_f64"]: lambda wl, wh: (sf.f64_to_f32(wl, wh),
+                                              jnp.zeros_like(wl)),
+        A1["f64.promote_f32"]: lambda wl, wh: sf.f32_to_f64(wl),
+        A1["i64.reinterpret_f64"]: lambda wl, wh: (wl, wh),
+        A1["f64.reinterpret_i64"]: lambda wl, wh: (wl, wh),
+        A1["f64.convert_i32_s"]: lambda wl, wh: sf.f64_from_i32(wl, True),
+        A1["f64.convert_i32_u"]: lambda wl, wh: sf.f64_from_i32(wl, False),
+        A1["f64.convert_i64_s"]: lambda wl, wh: sf.f64_from_i64(wl, wh, True),
+        A1["f64.convert_i64_u"]: lambda wl, wh: sf.f64_from_i64(wl, wh,
+                                                                False),
+        A1["f32.convert_i64_s"]: lambda wl, wh: (
+            sf.f32_from_i64(wl, wh, True), jnp.zeros_like(wl)),
+        A1["f32.convert_i64_u"]: lambda wl, wh: (
+            sf.f32_from_i64(wl, wh, False), jnp.zeros_like(wl)),
+    })
+
+    # float->int truncations, all via the exact f64 integer path (an f32
+    # operand promotes exactly first).  Non-sat variants return the
+    # in-range value (traps handled by alu1_trap_fns); sat variants clamp.
+    def trunc64(src32, to32, signed, sat):
+        def fn(wl, wh):
+            if src32:
+                vlo, vhi = sf.f32_to_f64(wl)
+            else:
+                vlo, vhi = wl, wh
+            olo, ohi, ok_s, ok_u, nan = sf.f64_to_i64_trunc(vlo, vhi)
+            neg = vhi < 0
+            if to32:
+                sgn = lax.shift_right_arithmetic(olo, 31)
+                fits_s = ok_s & (ohi == sgn)
+                fits_u = ok_u & (ohi == 0)
+                if not sat:
+                    # i32 result cells keep a zero hi plane
+                    return olo, jnp.zeros_like(olo)
+                if signed:
+                    r = jnp.where(nan, 0,
+                                  jnp.where(fits_s, olo,
+                                            jnp.where(neg,
+                                                      jnp.int32(-0x80000000),
+                                                      jnp.int32(0x7FFFFFFF))))
+                else:
+                    r = jnp.where(nan, 0,
+                                  jnp.where(fits_u, olo,
+                                            jnp.where(neg, jnp.int32(0),
+                                                      jnp.int32(-1))))
+                return r, jnp.zeros_like(olo)
+            if not sat:
+                return olo, ohi
+            if signed:
+                rlo = jnp.where(nan, 0,
+                                jnp.where(ok_s, olo,
+                                          jnp.where(neg, jnp.int32(0),
+                                                    jnp.int32(-1))))
+                rhi = jnp.where(nan, 0,
+                                jnp.where(ok_s, ohi,
+                                          jnp.where(neg, _SIGN,
+                                                    jnp.int32(0x7FFFFFFF))))
+            else:
+                rlo = jnp.where(nan, 0,
+                                jnp.where(ok_u, olo,
+                                          jnp.where(neg, jnp.int32(0),
+                                                    jnp.int32(-1))))
+                rhi = jnp.where(nan, 0,
+                                jnp.where(ok_u, ohi,
+                                          jnp.where(neg, jnp.int32(0),
+                                                    jnp.int32(-1))))
+            return rlo, rhi
+        return fn
+
+    for src32 in (True, False):
+        fsrc = "f32" if src32 else "f64"
+        for to32 in (True, False):
+            ity = "i32" if to32 else "i64"
+            for sgn in (True, False):
+                su = "s" if sgn else "u"
+                fns[A1[f"{ity}.trunc_{fsrc}_{su}"]] =                     trunc64(src32, to32, sgn, False)
+                fns[A1[f"{ity}.trunc_sat_{fsrc}_{su}"]] =                     trunc64(src32, to32, sgn, True)
+    return fns
+
+
+def alu1_trap_fns():
+    """Trap checks for the trapping ALU1 subs (non-sat float->int):
+    sub -> fn(wl, wh) -> (bad_mask, code_vec).  Shared by all batch
+    engines so trap semantics cannot diverge."""
+    from wasmedge_tpu.batch import softfloat as sf
+
+    A1 = ALU1_SUB
+    fns = {}
+
+    def mk(src32, to32, signed):
+        def fn(wl, wh):
+            if src32:
+                vlo, vhi = sf.f32_to_f64(wl)
+            else:
+                vlo, vhi = wl, wh
+            olo, ohi, ok_s, ok_u, nan = sf.f64_to_i64_trunc(vlo, vhi)
+            neg = vhi < 0
+            if to32:
+                sgn = lax.shift_right_arithmetic(olo, 31)
+                ok = (ok_s & (ohi == sgn)) if signed else                     (ok_u & (ohi == 0))
+            else:
+                ok = ok_s if signed else ok_u
+            bad = nan | ~ok
+            code = jnp.where(nan, jnp.int32(_TRAP_INVALID_CONV),
+                             jnp.int32(_TRAP_INT_OVERFLOW))
+            return bad, code
+        return fn
+
+    for src32 in (True, False):
+        fsrc = "f32" if src32 else "f64"
+        for to32 in (True, False):
+            ity = "i32" if to32 else "i64"
+            for sgn in (True, False):
+                su = "s" if sgn else "u"
+                fns[A1[f"{ity}.trunc_{fsrc}_{su}"]] = mk(src32, to32, sgn)
+    return fns
 

@@ -49,6 +49,8 @@ from wasmedge_tpu.batch.image import (
     ALU2_F32_BASE,
     ALU2_I32_BASE,
     ALU2_I64_BASE,
+    NUM_ALU1,
+    NUM_ALU2,
     CLS_ALU1,
     CLS_ALU2,
     CLS_BR,
@@ -104,9 +106,9 @@ H_TRAP = 18
 H_LOAD = 19
 H_STORE = 20
 H_HOSTCALL = 21
-H_ALU2_BASE = 22                      # + sub (63 subs)
-H_ALU1_BASE = H_ALU2_BASE + 63        # + sub (32 subs)
-NUM_HANDLERS = H_ALU1_BASE + 32
+H_ALU2_BASE = 22                      # + ALU2 sub id
+H_ALU1_BASE = H_ALU2_BASE + NUM_ALU2  # + ALU1 sub id
+NUM_HANDLERS = H_ALU1_BASE + NUM_ALU1
 
 _CLS_TO_HID = {
     CLS_NOP: H_NOP, CLS_CONST: H_CONST, CLS_LOCAL_GET: H_LOCAL_GET,
@@ -184,8 +186,7 @@ _DIV64_SUBS = {ALU2_I64_BASE + _I32_BIN.index(n) for n in
                ("div_s", "div_u", "rem_s", "rem_u")}
 _DIVS_SUBS = {ALU2_I32_BASE + _I32_BIN.index("div_s"),
               ALU2_I64_BASE + _I32_BIN.index("div_s")}
-# ALU1 subs that can trap (non-sat float->int truncation)
-_TRUNC_TRAP_SUBS = {ALU1_SUB["i32.trunc_f32_s"], ALU1_SUB["i32.trunc_f32_u"]}
+# trapping ALU1 subs come from the shared table (laneops.alu1_trap_fns)
 
 
 @functools.lru_cache(maxsize=64)
@@ -212,6 +213,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
     u_lt = lo_ops.u_lt
     alu2 = lo_ops.alu2_fns()
     alu1 = lo_ops.alu1_fns()
+    alu1_traps = lo_ops.alu1_trap_fns()
     nblk = L // Lblk
     NGp = max(NG, 1)
     # Divergent-address memory ops scan memory in row chunks so the scan
@@ -748,7 +750,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
         def mk_alu1(sub):
             fn = alu1[sub]
-            can_trap = sub in _TRUNC_TRAP_SUBS
+            trap_fn = alu1_traps.get(sub)
 
             def h(c):
                 pc, sp = c[1], c[2]
@@ -756,34 +758,20 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 rl, rh = fn(wl, wh)
                 wrow(slo, sp - 1, rl)
                 wrow(shi, sp - 1, rh)
-                if not can_trap:
+                if trap_fn is None:
                     return keep(c, pc=pc + 1)
-                fw = lo_ops.to_f32(wl)
-                tr = jnp.where(fw < 0, lax.ceil(fw), lax.floor(fw))
-                nan = lo_ops.is_nan32(wl)
-                if sub == ALU1_SUB["i32.trunc_f32_s"]:
-                    inr = (tr >= jnp.float32(-2147483648.0)) & \
-                        (tr <= jnp.float32(2147483520.0))
-                else:
-                    inr = (tr >= 0) & (tr <= jnp.float32(4294967040.0))
-                bad = nan | ~inr
+                bad, codes = trap_fn(wl, wh)
                 any_bad = jnp.any(bad)
-                kind = jnp.where(nan, I32(1), jnp.where(~inr, I32(2), I32(0)))
-                k0 = scal(kind)
-                code0 = jnp.where(k0 == 1, I32(int(ErrCode.InvalidConvToInt)),
-                                  I32(int(ErrCode.IntegerOverflow)))
+                code0 = scal(codes)
 
                 @pl.when(any_bad)
                 def _():
-                    codes = jnp.where(nan[0],
-                                      I32(int(ErrCode.InvalidConvToInt)),
-                                      I32(int(ErrCode.IntegerOverflow)))
-                    trapr[0, :] = jnp.where(bad[0], codes, trapr[0, :])
+                    trapr[0, :] = jnp.where(bad[0], codes[0], trapr[0, :])
 
                 return lax.cond(
                     any_bad,
                     lambda: lax.cond(
-                        jnp.all(bad) & allsame(kind, k0),
+                        jnp.all(bad) & allsame(codes, code0),
                         lambda: keep(c, status=I32(ST_TRAPPED_BASE) + code0),
                         lambda: keep(c, pc=pc + 1,
                                      status=I32(ST_DIVERGED))),

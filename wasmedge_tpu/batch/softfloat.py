@@ -1,0 +1,740 @@
+"""Software IEEE-754 binary64 on (lo, hi) int32 lane planes.
+
+The TPU has no f64 units and XLA's x64 emulation is not bit-exact, so the
+batch engines carry their own softfloat — the hard part SURVEY.md §7(b)
+predicted for bit-exact f64 on a 32-bit-lane ISA.  Every op is elementwise
+over [lanes]-shaped int32 (lo, hi) pairs built from the 64-bit integer
+helpers in laneops.py, with round-to-nearest-even, subnormals, signed
+zeros, and canonical-NaN outputs matching executor/numeric.py (which the
+parity suite pins to the reference's binary_numeric.ipp semantics).
+
+Representation notes: a binary64 is {sign s, biased exponent e[11],
+significand m[52]}.  Arithmetic runs in an internal window holding the
+53-bit significand shifted left 3 (guard/round/sticky in the low bits) —
+56 bits, comfortably inside the 64-bit (lo, hi) pair ops.  `_round_pack`
+is the single normalize+round+overflow/underflow path every op funnels
+through.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from wasmedge_tpu.batch import laneops as lo
+
+I32 = jnp.int32
+_EXP_MASK = np.int32(0x7FF00000)       # exponent bits in hi
+_MANT_HI_MASK = np.int32(0x000FFFFF)   # mantissa bits in hi
+_SIGN = np.int32(-0x80000000)
+CANON_HI = np.int32(0x7FF80000)        # canonical NaN (hi plane; lo = 0)
+
+
+def _i(v):
+    return jnp.int32(v)
+
+
+# -- field extraction -------------------------------------------------------
+
+def f64_sign(hi):
+    return lax.shift_right_logical(hi, 31)
+
+
+def f64_exp(hi):
+    return lax.shift_right_logical(hi & _EXP_MASK, 20)
+
+
+def f64_mant(vlo, vhi):
+    return vlo, vhi & _MANT_HI_MASK
+
+
+def is_nan(vlo, vhi):
+    e = f64_exp(vhi)
+    mlo, mhi = f64_mant(vlo, vhi)
+    return (e == 2047) & ((mlo | mhi) != 0)
+
+
+def is_inf(vlo, vhi):
+    e = f64_exp(vhi)
+    mlo, mhi = f64_mant(vlo, vhi)
+    return (e == 2047) & ((mlo | mhi) == 0)
+
+
+def is_zero(vlo, vhi):
+    return ((vhi & _i(0x7FFFFFFF)) | vlo) == 0
+
+
+def canon_nan(like_lo):
+    z = jnp.zeros_like(like_lo)
+    return z, jnp.full_like(like_lo, CANON_HI)
+
+
+def _inf(s, like_lo):
+    z = jnp.zeros_like(like_lo)
+    hi = jnp.where(s != 0, _i(0xFFF00000 - (1 << 32)), _i(0x7FF00000))
+    return z, hi
+
+
+def _zero(s, like_lo):
+    z = jnp.zeros_like(like_lo)
+    return z, jnp.where(s != 0, _SIGN, _i(0))
+
+
+def _sig53_norm(vlo, vhi):
+    """Significand normalized into [2^52, 2^53) with the matching biased
+    exponent (subnormals shifted up; exponent may go <= 0)."""
+    mlo, mhi, e = _sig53(vlo, vhi)
+    lead = lo.clz64(mlo, mhi) - _i(11)
+    sh = jnp.clip(lead, 0, 63)
+    nlo, nhi = lo.shl64(mlo, mhi, sh)
+    return nlo, nhi, e - lead
+
+
+def _sig53(vlo, vhi):
+    """53-bit significand with implicit bit (subnormals: no implicit bit),
+    plus the effective unbiased-ish exponent e' (subnormal -> 1)."""
+    e = f64_exp(vhi)
+    mlo, mhi = f64_mant(vlo, vhi)
+    norm = e != 0
+    mhi = jnp.where(norm, mhi | _i(0x00100000), mhi)
+    e_eff = jnp.where(norm, e, _i(1))
+    return mlo, mhi, e_eff
+
+
+# -- the rounding funnel ----------------------------------------------------
+
+def _round_pack(s, e, mlo, mhi, sticky):
+    """Pack sign/exponent/significand-window into binary64 with RNE.
+
+    (mlo, mhi) holds the candidate significand shifted left 3 (GRS in
+    bits [2:0]); it must satisfy m < 2^57.  e is the biased exponent the
+    MSB at bit 55 corresponds to; zero significand -> signed zero."""
+    # normalize: put MSB at bit 55
+    nz = (mlo | mhi) != 0
+    lead = lo.clz64(mlo, mhi)           # 0..64
+    shift = _i(8) - lead                # >0: right shift, <0: left shift
+    e = e + shift
+    # subnormal squeeze: if e <= 0, shift right extra (1 - e) and pin e=0
+    extra = jnp.where(e <= 0, _i(1) - e, _i(0))
+    shift = shift + extra
+    e = jnp.where(e <= 0, _i(0), e)
+    rsh = jnp.clip(shift, 0, 63)
+    lsh = jnp.clip(-shift, 0, 63)
+    # sticky collects bits shifted out on the right
+    lost_mask_lo, lost_mask_hi = lo.shl64(jnp.full_like(mlo, -1),
+                                          jnp.full_like(mlo, -1), rsh)
+    lost_lo = mlo & ~lost_mask_lo
+    lost_hi = mhi & ~lost_mask_hi
+    sticky = sticky | ((shift > 0) & ((lost_lo | lost_hi) != 0))
+    rlo, rhi = lo.shr64_u(mlo, mhi, rsh)
+    llo, lhi = lo.shl64(mlo, mhi, lsh)
+    mlo = jnp.where(shift >= 0, rlo, llo)
+    mhi = jnp.where(shift >= 0, rhi, lhi)
+    # round to nearest even: result = m >> 3, round bit = bit 2,
+    # sticky = bits [1:0] | accumulated sticky
+    rnd = lax.shift_right_logical(mlo, 2) & 1
+    low_sticky = ((mlo & 3) != 0) | sticky
+    lsb = lax.shift_right_logical(mlo, 3) & 1
+    inc = (rnd == 1) & (low_sticky | (lsb == 1))
+    mlo, mhi = lo.shr64_u(mlo, mhi, _i(3))
+    alo, ahi = lo.add64(mlo, mhi, b2i32(inc), jnp.zeros_like(mlo))
+    mlo, mhi = alo, ahi
+    # rounding may carry into bit 53 -> renormalize
+    carry = (mhi & _i(0x00200000)) != 0
+    clo, chi = lo.shr64_u(mlo, mhi, _i(1))
+    mlo = jnp.where(carry, clo, mlo)
+    mhi = jnp.where(carry, chi, mhi)
+    e = e + b2i32(carry)
+    # subnormal that rounded up into normal range
+    e = jnp.where((e == 0) & ((mhi & _i(0x00100000)) != 0), _i(1), e)
+    # overflow -> inf
+    inf_lo, inf_hi = _inf(s, mlo)
+    over = e >= 2047
+    # assemble
+    out_hi = (jnp.where(s != 0, _SIGN, _i(0))
+              | lax.shift_left(jnp.clip(e, 0, 2046), 20)
+              | (mhi & _MANT_HI_MASK))
+    out_lo = mlo
+    out_lo = jnp.where(over, inf_lo, out_lo)
+    out_hi = jnp.where(over, inf_hi, out_hi)
+    zlo, zhi = _zero(s, mlo)
+    out_lo = jnp.where(nz, out_lo, zlo)
+    out_hi = jnp.where(nz, out_hi, zhi)
+    return out_lo, out_hi
+
+
+def b2i32(b):
+    return b.astype(I32)
+
+
+# -- addition / subtraction -------------------------------------------------
+
+def f64_add(alo, ahi, blo, bhi):
+    return _addsub(alo, ahi, blo, bhi, False)
+
+
+def f64_sub(alo, ahi, blo, bhi):
+    return _addsub(alo, ahi, blo, bhi, True)
+
+
+def _addsub(alo, ahi, blo, bhi, negate_b):
+    sb_in = f64_sign(bhi) ^ (1 if negate_b else 0)
+    sa = f64_sign(ahi)
+    ea = f64_exp(ahi)
+    eb = f64_exp(bhi)
+    # significands in the  <<3 window
+    amlo, amhi, ea_eff = _sig53(alo, ahi)
+    bmlo, bmhi, eb_eff = _sig53(blo, bhi)
+    amlo, amhi = lo.shl64(amlo, amhi, _i(3))
+    bmlo, bmhi = lo.shl64(bmlo, bmhi, _i(3))
+    # order by (exponent, significand): big op absorbs small
+    swap = (eb_eff > ea_eff) | ((eb_eff == ea_eff) &
+                                lo.lt64_u(amlo, amhi, bmlo, bmhi))
+    s_big = jnp.where(swap, sb_in, sa)
+    s_sml = jnp.where(swap, sa, sb_in)
+    e_big = jnp.where(swap, eb_eff, ea_eff)
+    e_sml = jnp.where(swap, ea_eff, eb_eff)
+    big_lo = jnp.where(swap, bmlo, amlo)
+    big_hi = jnp.where(swap, bmhi, amhi)
+    sml_lo = jnp.where(swap, amlo, bmlo)
+    sml_hi = jnp.where(swap, amhi, bmhi)
+    # align small significand; beyond 60 bits it is pure sticky
+    d = jnp.clip(e_big - e_sml, 0, 63)
+    lost_mask_lo, lost_mask_hi = lo.shl64(jnp.full_like(big_lo, -1),
+                                          jnp.full_like(big_lo, -1), d)
+    sticky = ((sml_lo & ~lost_mask_lo) | (sml_hi & ~lost_mask_hi)) != 0
+    shl_lo, shl_hi = lo.shr64_u(sml_lo, sml_hi, d)
+    same_sign = s_big == s_sml
+    sum_lo, sum_hi = lo.add64(big_lo, big_hi, shl_lo, shl_hi)
+    # subtraction borrows one extra when nonzero bits were shifted out
+    # below the window (the true small operand was slightly larger)
+    dlo, dhi = lo.sub64(big_lo, big_hi, shl_lo, shl_hi)
+    slo_, shi_ = lo.sub64(dlo, dhi, b2i32(sticky), jnp.zeros_like(dlo))
+    mlo = jnp.where(same_sign, sum_lo, slo_)
+    mhi = jnp.where(same_sign, sum_hi, shi_)
+    # when subtracting with sticky, the "sticky" now means a 1 beyond the
+    # kept bits was borrowed: keep sticky set so RNE sees inexactness
+    res_lo, res_hi = _round_pack(s_big, e_big, mlo, mhi, sticky)
+    # exact cancel -> +0 (RNE mode), unless both were -
+    cancel = ((mlo | mhi) == 0) & ~sticky & ~same_sign
+    zlo, zhi = _zero(jnp.zeros_like(s_big), res_lo)
+    res_lo = jnp.where(cancel, zlo, res_lo)
+    res_hi = jnp.where(cancel, zhi, res_hi)
+    # specials
+    a_nan = is_nan(alo, ahi)
+    b_nan = is_nan(blo, bhi)
+    a_inf = is_inf(alo, ahi)
+    b_inf = is_inf(blo, bhi)
+    nlo, nhi = canon_nan(alo)
+    both_inf_opp = a_inf & b_inf & (sa != sb_in)
+    res_lo = jnp.where(a_inf, jnp.zeros_like(res_lo), res_lo)
+    res_hi = jnp.where(a_inf, _inf(sa, res_lo)[1], res_hi)
+    res_lo = jnp.where(b_inf & ~a_inf, jnp.zeros_like(res_lo), res_lo)
+    res_hi = jnp.where(b_inf & ~a_inf, _inf(sb_in, res_lo)[1], res_hi)
+    bad = a_nan | b_nan | both_inf_opp
+    res_lo = jnp.where(bad, nlo, res_lo)
+    res_hi = jnp.where(bad, nhi, res_hi)
+    return res_lo, res_hi
+
+
+# -- multiplication ---------------------------------------------------------
+
+def f64_mul(alo, ahi, blo, bhi):
+    sa = f64_sign(ahi)
+    sb = f64_sign(bhi)
+    s = sa ^ sb
+    amlo, amhi, ea = _sig53_norm(alo, ahi)
+    bmlo, bmhi, eb = _sig53_norm(blo, bhi)
+    # 53x53 -> 106-bit product via 32-bit limbs: a = a1*2^32 + a0
+    a0 = amlo
+    a1 = amhi
+    b0 = bmlo
+    b1 = bmhi
+    p00lo, p00hi = lo._umul32_wide(a0, b0)
+    p01lo, p01hi = lo._umul32_wide(a0, b1)
+    p10lo, p10hi = lo._umul32_wide(a1, b0)
+    p11lo, p11hi = lo._umul32_wide(a1, b1)
+    # accumulate limbs L0..L3 (32-bit each, with carries)
+    L0 = p00lo
+    c1lo, c1hi = lo.add64(p00hi, jnp.zeros_like(a0), p01lo,
+                          jnp.zeros_like(a0))
+    c1lo, c1hi = lo.add64(c1lo, c1hi, p10lo, jnp.zeros_like(a0))
+    L1 = c1lo
+    c2lo, c2hi = lo.add64(p01hi, jnp.zeros_like(a0), p10hi,
+                          jnp.zeros_like(a0))
+    c2lo, c2hi = lo.add64(c2lo, c2hi, p11lo, jnp.zeros_like(a0))
+    c2lo, c2hi = lo.add64(c2lo, c2hi, c1hi, jnp.zeros_like(a0))
+    L2 = c2lo
+    L3 = p11hi + c2hi
+    # product ~ 2^104..2^106.  Take the top into the <<3 window: the
+    # significand window wants the value at bits [55:0].  product bit 104
+    # (or 105) is the MSB; shift right by 104-55 = 49 keeping sticky.
+    # full product as two 64-bit halves: PH = L3:L2, PL = L1:L0
+    sticky = ((L0 | (L1 & _i(0x0003FFFF))) != 0)
+    # we need bits [105:50] -> take (PH << 14) | (PL >> 50)
+    ph_lo, ph_hi = L2, L3
+    pl_lo, pl_hi = L0, L1
+    w1lo, w1hi = lo.shl64(ph_lo, ph_hi, _i(14))
+    w2lo, w2hi = lo.shr64_u(pl_lo, pl_hi, _i(50))
+    mlo = w1lo | w2lo
+    mhi = w1hi | w2hi
+    e = ea + eb - _i(1023) + _i(1)  # window MSB at bit 55 ~ product bit 105
+    res_lo, res_hi = _round_pack(s, e, mlo, mhi, sticky)
+    # specials
+    a_nan = is_nan(alo, ahi)
+    b_nan = is_nan(blo, bhi)
+    a_inf = is_inf(alo, ahi)
+    b_inf = is_inf(blo, bhi)
+    a_z = is_zero(alo, ahi)
+    b_z = is_zero(blo, bhi)
+    ilo, ihi = _inf(s, res_lo)
+    zlo, zhi = _zero(s, res_lo)
+    res_lo = jnp.where((a_inf | b_inf), ilo, res_lo)
+    res_hi = jnp.where((a_inf | b_inf), ihi, res_hi)
+    res_lo = jnp.where((a_z | b_z), zlo, res_lo)
+    res_hi = jnp.where((a_z | b_z), zhi, res_hi)
+    nlo, nhi = canon_nan(alo)
+    bad = a_nan | b_nan | (a_inf & b_z) | (b_inf & a_z)
+    res_lo = jnp.where(bad, nlo, res_lo)
+    res_hi = jnp.where(bad, nhi, res_hi)
+    return res_lo, res_hi
+
+
+# -- division ---------------------------------------------------------------
+
+def f64_div(alo, ahi, blo, bhi):
+    sa = f64_sign(ahi)
+    sb = f64_sign(bhi)
+    s = sa ^ sb
+    amlo, amhi, ea = _sig53_norm(alo, ahi)
+    bmlo, bmhi, eb = _sig53_norm(blo, bhi)
+
+    # restoring long division: integer bit first (ma, mb in [2^52, 2^53)
+    # so the ratio is in (1/2, 2)), then 56 fraction bits keeping r < mb.
+    ge0 = ~lo.lt64_u(amlo, amhi, bmlo, bmhi)
+    d0lo, d0hi = lo.sub64(amlo, amhi, bmlo, bmhi)
+    rlo0 = jnp.where(ge0, d0lo, amlo)
+    rhi0 = jnp.where(ge0, d0hi, amhi)
+    z = jnp.zeros_like(alo)
+    q0 = b2i32(ge0)
+
+    def body(i, carry):
+        rlo, rhi, qlo, qhi = carry
+        rlo, rhi = lo.shl64(rlo, rhi, _i(1))
+        ge = ~lo.lt64_u(rlo, rhi, bmlo, bmhi)
+        slo_, shi_ = lo.sub64(rlo, rhi, bmlo, bmhi)
+        rlo = jnp.where(ge, slo_, rlo)
+        rhi = jnp.where(ge, shi_, rhi)
+        qlo, qhi = lo.shl64(qlo, qhi, _i(1))
+        qlo = qlo | b2i32(ge)
+        return rlo, rhi, qlo, qhi
+
+    rlo, rhi, qlo, qhi = lax.fori_loop(
+        0, 56, body, (rlo0, rhi0, q0, z))
+    sticky = (rlo | rhi) != 0
+    # q = floor(ma*2^56/mb) in [2^55, 2^57); v = q * 2^(ea-eb-56)
+    e = ea - eb + _i(1022)
+    res_lo, res_hi = _round_pack(s, e, qlo, qhi, sticky)
+    # specials
+    a_nan = is_nan(alo, ahi)
+    b_nan = is_nan(blo, bhi)
+    a_inf = is_inf(alo, ahi)
+    b_inf = is_inf(blo, bhi)
+    a_z = is_zero(alo, ahi)
+    b_z = is_zero(blo, bhi)
+    ilo, ihi = _inf(s, res_lo)
+    zlo, zhi = _zero(s, res_lo)
+    res_lo = jnp.where(a_inf | (b_z & ~a_z), ilo, res_lo)
+    res_hi = jnp.where(a_inf | (b_z & ~a_z), ihi, res_hi)
+    res_lo = jnp.where(b_inf | (a_z & ~b_z), zlo, res_lo)
+    res_hi = jnp.where(b_inf | (a_z & ~b_z), zhi, res_hi)
+    nlo, nhi = canon_nan(alo)
+    bad = a_nan | b_nan | (a_inf & b_inf) | (a_z & b_z)
+    res_lo = jnp.where(bad, nlo, res_lo)
+    res_hi = jnp.where(bad, nhi, res_hi)
+    return res_lo, res_hi
+
+
+# -- square root ------------------------------------------------------------
+
+def f64_sqrt(vlo, vhi):
+    s = f64_sign(vhi)
+    mlo, mhi, e = _sig53(vlo, vhi)
+    # normalize subnormals so the significand has its MSB at bit 52
+    lead = lo.clz64(mlo, mhi) - _i(11)   # extra left shifts needed
+    mlo, mhi = lo.shl64(mlo, mhi, jnp.clip(lead, 0, 63))
+    e = e - lead
+    eu = e - _i(1023)                    # unbiased
+    odd = (eu & 1) != 0
+    # radicand window: m << (5 or 6) so result has 56 bits (53+3 GRS):
+    # sqrt(m * 2^k) — make exponent even by an extra shift
+    rad_lo, rad_hi = lo.shl64(mlo, mhi, jnp.where(odd, _i(6), _i(5)))
+    e_half = jnp.where(odd, (eu - 1), eu)
+    e_res = lax.shift_right_arithmetic(e_half, 1) + _i(1023)
+
+    # bit-by-bit restoring sqrt ("remainder doubling"), unrolled in
+    # Python so every shift amount is static — traced-scalar shifts
+    # inside fori_loop trip Mosaic layout inference.
+    z = jnp.zeros_like(vlo)
+    rem_lo, rem_hi, q_lo, q_hi = z, z, z, z
+    for i in range(56):
+        sh = 57 - 2 * i              # bits [sh+1:sh] of rad; <0 once the
+        if sh >= 0:                  # radicand is exhausted (python-static)
+            b_lo, _bh = lo.shr64_u(rad_lo, rad_hi, sh)
+            two_bits = b_lo & 3
+        else:
+            two_bits = z
+        rem_lo, rem_hi = lo.shl64(rem_lo, rem_hi, _i(2))
+        rem_lo = rem_lo | two_bits
+        t_lo, t_hi = lo.shl64(q_lo, q_hi, _i(2))
+        t_lo = t_lo | 1
+        ge = ~lo.lt64_u(rem_lo, rem_hi, t_lo, t_hi)
+        s_lo, s_hi = lo.sub64(rem_lo, rem_hi, t_lo, t_hi)
+        rem_lo = jnp.where(ge, s_lo, rem_lo)
+        rem_hi = jnp.where(ge, s_hi, rem_hi)
+        q_lo, q_hi = lo.shl64(q_lo, q_hi, _i(1))
+        q_lo = q_lo | b2i32(ge)
+    sticky = (rem_lo | rem_hi) != 0
+    res_lo, res_hi = _round_pack(jnp.zeros_like(s), e_res, q_lo, q_hi,
+                                 sticky)
+    # specials: sqrt(-x) = nan (x != -0), sqrt(+-0) = +-0, sqrt(inf)=inf
+    v_nan = is_nan(vlo, vhi)
+    v_inf = is_inf(vlo, vhi)
+    v_z = is_zero(vlo, vhi)
+    neg = (s != 0) & ~v_z
+    nlo, nhi = canon_nan(vlo)
+    res_lo = jnp.where(v_inf & (s == 0), 0, res_lo)
+    res_hi = jnp.where(v_inf & (s == 0), _i(0x7FF00000), res_hi)
+    res_lo = jnp.where(v_z, vlo, res_lo)
+    res_hi = jnp.where(v_z, vhi, res_hi)
+    bad = v_nan | neg
+    res_lo = jnp.where(bad, nlo, res_lo)
+    res_hi = jnp.where(bad, nhi, res_hi)
+    return res_lo, res_hi
+
+
+# -- comparisons ------------------------------------------------------------
+
+def _cmp_key(vlo, vhi):
+    """Total-order key for finite comparison: flip for negatives."""
+    neg = vhi < 0
+    klo = jnp.where(neg, ~vlo, vlo)
+    khi = jnp.where(neg, ~vhi, vhi | _SIGN)
+    # +0/-0 equalize handled by callers (both map near the midpoint)
+    return klo, khi
+
+
+def f64_eq(alo, ahi, blo, bhi):
+    nan = is_nan(alo, ahi) | is_nan(blo, bhi)
+    both_zero = is_zero(alo, ahi) & is_zero(blo, bhi)
+    bit_eq = lo.eq64(alo, ahi, blo, bhi)
+    return ~nan & (bit_eq | both_zero)
+
+
+def f64_lt(alo, ahi, blo, bhi):
+    nan = is_nan(alo, ahi) | is_nan(blo, bhi)
+    both_zero = is_zero(alo, ahi) & is_zero(blo, bhi)
+    aklo, akhi = _cmp_key(alo, ahi)
+    bklo, bkhi = _cmp_key(blo, bhi)
+    return ~nan & ~both_zero & lo.lt64_u(aklo, akhi, bklo, bkhi)
+
+
+def f64_le(alo, ahi, blo, bhi):
+    return f64_lt(alo, ahi, blo, bhi) | f64_eq(alo, ahi, blo, bhi)
+
+
+def f64_min(alo, ahi, blo, bhi):
+    nan = is_nan(alo, ahi) | is_nan(blo, bhi)
+    nlo, nhi = canon_nan(alo)
+    eq = f64_eq(alo, ahi, blo, bhi)
+    # equal (incl. +-0): pick the sign-set one
+    sa = ahi < 0
+    lt_ab = f64_lt(alo, ahi, blo, bhi)
+    pick_a = (eq & sa) | (~eq & lt_ab)
+    rlo = jnp.where(pick_a, alo, blo)
+    rhi = jnp.where(pick_a, ahi, bhi)
+    return jnp.where(nan, nlo, rlo), jnp.where(nan, nhi, rhi)
+
+
+def f64_max(alo, ahi, blo, bhi):
+    nan = is_nan(alo, ahi) | is_nan(blo, bhi)
+    nlo, nhi = canon_nan(alo)
+    eq = f64_eq(alo, ahi, blo, bhi)
+    sa = ahi < 0
+    lt_ba = f64_lt(blo, bhi, alo, ahi)
+    pick_a = (eq & ~sa) | (~eq & lt_ba)
+    rlo = jnp.where(pick_a, alo, blo)
+    rhi = jnp.where(pick_a, ahi, bhi)
+    return jnp.where(nan, nlo, rlo), jnp.where(nan, nhi, rhi)
+
+
+# -- rounding to integral ---------------------------------------------------
+
+def _round_integral(vlo, vhi, mode):
+    """mode: 'trunc' | 'floor' | 'ceil' | 'nearest' (ties to even)."""
+    s = f64_sign(vhi)
+    e = f64_exp(vhi) - 1023           # unbiased
+    # |v| < 1: result is 0 or +-1 depending on mode
+    frac_bits = jnp.clip(_i(52) - e, 0, 63)
+    mask_lo, mask_hi = lo.shl64(jnp.full_like(vlo, -1),
+                                jnp.full_like(vlo, -1), frac_bits)
+    int_lo = vlo & mask_lo
+    int_hi = vhi & mask_hi
+    frac_nz = ((vlo & ~mask_lo) | (vhi & ~mask_hi)) != 0
+    big = e >= 52                      # already integral
+    # increment by one ULP-at-integer-scale
+    ulp_lo, ulp_hi = lo.shl64(jnp.ones_like(vlo), jnp.zeros_like(vlo),
+                              frac_bits)
+    inc_lo, inc_hi = lo.add64(int_lo, int_hi, ulp_lo, ulp_hi)
+    if mode == "trunc":
+        rlo, rhi = int_lo, int_hi
+    elif mode == "floor":
+        rlo = jnp.where(frac_nz & (s != 0), inc_lo, int_lo)
+        rhi = jnp.where(frac_nz & (s != 0), inc_hi, int_hi)
+    elif mode == "ceil":
+        rlo = jnp.where(frac_nz & (s == 0), inc_lo, int_lo)
+        rhi = jnp.where(frac_nz & (s == 0), inc_hi, int_hi)
+    else:  # nearest, ties to even
+        half_lo, half_hi = lo.shl64(jnp.ones_like(vlo),
+                                    jnp.zeros_like(vlo),
+                                    jnp.clip(frac_bits - 1, 0, 63))
+        frac_lo = vlo & ~mask_lo
+        frac_hi = vhi & ~mask_hi
+        gt_half = lo.lt64_u(half_lo, half_hi, frac_lo, frac_hi)
+        eq_half = lo.eq64(frac_lo, frac_hi, half_lo, half_hi) & \
+            (frac_bits > 0)
+        int_odd = (lo.shr64_u(int_lo, int_hi, frac_bits)[0] & 1) == 1
+        up = gt_half | (eq_half & int_odd)
+        rlo = jnp.where(frac_nz & up, inc_lo, int_lo)
+        rhi = jnp.where(frac_nz & up, inc_hi, int_hi)
+    # |v| < 1 handling: e < 0 -> int part is +-0; frac decides
+    ones_hi = _i(0x3FF00000)
+    lt1 = e < 0
+    nz = ~is_zero(vlo, vhi)
+    if mode == "trunc":
+        z_lo, z_hi = _zero(s, vlo)
+        rlo = jnp.where(lt1, z_lo, rlo)
+        rhi = jnp.where(lt1, z_hi, rhi)
+    elif mode == "floor":
+        z_lo, z_hi = _zero(s, vlo)
+        rlo = jnp.where(lt1, jnp.where((s != 0) & nz, _i(0), z_lo), rlo)
+        rhi = jnp.where(lt1, jnp.where((s != 0) & nz,
+                                       ones_hi | _SIGN, z_hi), rhi)
+    elif mode == "ceil":
+        z_lo, z_hi = _zero(s, vlo)
+        rlo = jnp.where(lt1, jnp.where((s == 0) & nz, _i(0), z_lo), rlo)
+        rhi = jnp.where(lt1, jnp.where((s == 0) & nz, ones_hi, z_hi), rhi)
+    else:
+        # nearest: |v| <= 0.5 -> +-0 ; 0.5 < |v| < 1 -> +-1
+        # (|v| == 0.5 ties to even = 0)
+        mag_hi = vhi & _i(0x7FFFFFFF)
+        gt_half_mag = (mag_hi > _i(0x3FE00000)) | \
+            ((mag_hi == _i(0x3FE00000)) & (vlo != 0))
+        z_lo, z_hi = _zero(s, vlo)
+        rlo = jnp.where(lt1, jnp.where(gt_half_mag, _i(0), z_lo), rlo)
+        rhi = jnp.where(lt1, jnp.where(
+            gt_half_mag,
+            jnp.where(s != 0, ones_hi | _SIGN, ones_hi), z_hi), rhi)
+    # specials passthrough (nan canonicalized, inf, zero)
+    passthru = big | is_inf(vlo, vhi) | is_zero(vlo, vhi)
+    rlo = jnp.where(passthru, vlo, rlo)
+    rhi = jnp.where(passthru, vhi, rhi)
+    nlo, nhi = canon_nan(vlo)
+    nan = is_nan(vlo, vhi)
+    return jnp.where(nan, nlo, rlo), jnp.where(nan, nhi, rhi)
+
+
+def f64_trunc(vlo, vhi):
+    return _round_integral(vlo, vhi, "trunc")
+
+
+def f64_floor(vlo, vhi):
+    return _round_integral(vlo, vhi, "floor")
+
+
+def f64_ceil(vlo, vhi):
+    return _round_integral(vlo, vhi, "ceil")
+
+
+def f64_nearest(vlo, vhi):
+    return _round_integral(vlo, vhi, "nearest")
+
+
+# -- conversions ------------------------------------------------------------
+
+def f64_from_i64(vlo, vhi, signed=True):
+    if signed:
+        s = (vhi < 0)
+        nlo, nhi = lo.neg64(vlo, vhi)
+        mlo = jnp.where(s, nlo, vlo)
+        mhi = jnp.where(s, nhi, vhi)
+    else:
+        s = jnp.zeros_like(vlo, dtype=bool)
+        mlo, mhi = vlo, vhi
+    # place value's MSB at window bit 55; magnitude < 2^64
+    lead = lo.clz64(mlo, mhi)
+    shift = _i(8) - lead
+    rsh = jnp.clip(shift, 0, 63)
+    lsh = jnp.clip(-shift, 0, 63)
+    lost_mask_lo, lost_mask_hi = lo.shl64(jnp.full_like(mlo, -1),
+                                          jnp.full_like(mlo, -1), rsh)
+    sticky = (shift > 0) & \
+        (((mlo & ~lost_mask_lo) | (mhi & ~lost_mask_hi)) != 0)
+    r_lo, r_hi = lo.shr64_u(mlo, mhi, rsh)
+    l_lo, l_hi = lo.shl64(mlo, mhi, lsh)
+    wlo = jnp.where(shift >= 0, r_lo, l_lo)
+    whi = jnp.where(shift >= 0, r_hi, l_hi)
+    return _round_pack(b2i32(s), _i(1023) + (_i(63) - lead), wlo, whi,
+                       sticky)
+
+
+def f64_from_i32(v, signed=True):
+    if signed:
+        hi = lax.shift_right_arithmetic(v, 31)
+    else:
+        hi = jnp.zeros_like(v)
+    return f64_from_i64(v, hi, signed=signed)
+
+
+def f64_to_i64_trunc(vlo, vhi):
+    """Truncate toward zero; returns (lo, hi, ok_signed, ok_unsigned,
+    is_nan) for the engines' trap/sat handling."""
+    s = f64_sign(vhi)
+    e = f64_exp(vhi) - 1023
+    mlo, mhi, _e_eff = _sig53(vlo, vhi)
+    # magnitude = m * 2^(e-52)
+    sh = e - _i(52)
+    l_lo, l_hi = lo.shl64(mlo, mhi, jnp.clip(sh, 0, 63))
+    r_lo, r_hi = lo.shr64_u(mlo, mhi, jnp.clip(-sh, 0, 63))
+    mag_lo = jnp.where(sh >= 0, l_lo, r_lo)
+    mag_hi = jnp.where(sh >= 0, l_hi, r_hi)
+    mag_lo = jnp.where(e < 0, 0, mag_lo)
+    mag_hi = jnp.where(e < 0, 0, mag_hi)
+    nan = is_nan(vlo, vhi)
+    inf = is_inf(vlo, vhi)
+    # signed range: -2^63 <= trunc(v) < 2^63 (exactly -2^63 allowed)
+    ok_s = ((e < 63) & ~nan & ~inf) | \
+        ((s != 0) & (e == 63) & (mag_lo == 0) & (mag_hi == _SIGN) & ~nan)
+    ok_u = (s == 0) & (e < 64) & ~nan & ~inf
+    ok_u = ok_u | (is_zero(vlo, vhi)) | ((s != 0) & (e < 0))  # -0.x -> 0
+    neg_lo, neg_hi = lo.neg64(mag_lo, mag_hi)
+    out_lo = jnp.where(s != 0, neg_lo, mag_lo)
+    out_hi = jnp.where(s != 0, neg_hi, mag_hi)
+    return out_lo, out_hi, ok_s, ok_u, nan
+
+
+def f64_to_f32(vlo, vhi):
+    """Demote with RNE; canonical NaN on NaN input (numeric.py policy)."""
+    s = f64_sign(vhi)
+    mlo, mhi, e_eff = _sig53(vlo, vhi)
+    # f32 window: 24-bit significand + GRS -> reuse _round_pack32 logic
+    # value = m53 * 2^(e-1075).  For f32: out_m24 with exponent bias 127.
+    # shift m53 right by 29-3 = 26 to get 24+3 bits
+    lost = (mlo & _i(0x03FFFFFF)) != 0
+    w_lo, w_hi = lo.shr64_u(mlo, mhi, _i(26))
+    w = w_lo  # fits in 30 bits
+    e32 = e_eff - _i(1023) + _i(127)
+    # subnormal squeeze for f32
+    extra = jnp.where(e32 <= 0, _i(1) - e32, _i(0))
+    extra = jnp.clip(extra, 0, 31)
+    lost = lost | ((w & (lax.shift_left(_i(1), extra) - 1)) != 0)
+    w = lax.shift_right_logical(w, extra)
+    e32 = jnp.where(e32 <= 0, _i(0), e32)
+    rnd = lax.shift_right_logical(w, 2) & 1
+    sticky2 = ((w & 3) != 0) | lost
+    lsb = lax.shift_right_logical(w, 3) & 1
+    inc = (rnd == 1) & (sticky2 | (lsb == 1))
+    m = lax.shift_right_logical(w, 3) + b2i32(inc)
+    carry = (m & _i(0x01000000)) != 0
+    m = jnp.where(carry, lax.shift_right_logical(m, 1), m)
+    e32 = e32 + b2i32(carry)
+    e32 = jnp.where((e32 == 0) & ((m & _i(0x00800000)) != 0), _i(1), e32)
+    over = e32 >= 255
+    out = (jnp.where(s != 0, _i(-0x80000000), _i(0))
+           | lax.shift_left(jnp.clip(e32, 0, 254), 23)
+           | (m & _i(0x007FFFFF)))
+    inf32 = jnp.where(s != 0, _i(0xFF800000 - (1 << 32)), _i(0x7F800000))
+    out = jnp.where(over, inf32, out)
+    zero32 = jnp.where(s != 0, _i(-0x80000000), _i(0))
+    out = jnp.where(is_zero(vlo, vhi), zero32, out)
+    out = jnp.where(is_inf(vlo, vhi), inf32, out)
+    out = jnp.where(is_nan(vlo, vhi), _i(0x7FC00000), out)
+    return out
+
+
+def f32_to_f64(v32):
+    """Promote (exact); canonical NaN on NaN input."""
+    s = lax.shift_right_logical(v32, 31)
+    e = lax.shift_right_logical(v32 & _i(0x7F800000), 23)
+    m = v32 & _i(0x007FFFFF)
+    # normals
+    e64 = e - _i(127) + _i(1023)
+    hi = (lax.shift_left(s, 31) | lax.shift_left(e64, 20)
+          | lax.shift_right_logical(m, 3))
+    lo_ = lax.shift_left(m & 7, 29)
+    # zero
+    hi = jnp.where((e == 0) & (m == 0), lax.shift_left(s, 31), hi)
+    lo_ = jnp.where((e == 0) & (m == 0), 0, lo_)
+    # subnormal f32: value = m * 2^-149 with MSB at bit p => normal
+    # binary64 with exponent (p - 149) + 1023 = p + 874
+    nz_sub = (e == 0) & (m != 0)
+    msb = _i(31) - lax.clz(jnp.where(nz_sub, m, _i(1)))
+    frac = lax.shift_left(m, jnp.clip(_i(23) - msb, 0, 31)) & _i(0x007FFFFF)
+    e_sub = msb + _i(874)
+    hi_sub = (lax.shift_left(s, 31) | lax.shift_left(e_sub, 20)
+              | lax.shift_right_logical(frac, 3))
+    lo_sub = lax.shift_left(frac & 7, 29)
+    hi = jnp.where(nz_sub, hi_sub, hi)
+    lo_ = jnp.where(nz_sub, lo_sub, lo_)
+    # inf / nan
+    is_inf32 = (e == 255) & (m == 0)
+    is_nan32v = (e == 255) & (m != 0)
+    hi = jnp.where(is_inf32, lax.shift_left(s, 31) | _i(0x7FF00000), hi)
+    lo_ = jnp.where(is_inf32, 0, lo_)
+    hi = jnp.where(is_nan32v, CANON_HI, hi)
+    lo_ = jnp.where(is_nan32v, 0, lo_)
+    return lo_, hi
+
+
+# -- f32 <- i64 (the other missing conversion family) -----------------------
+
+def f32_from_i64(vlo, vhi, signed=True):
+    """i64 -> f32 with single RNE rounding via the f64 path + demote is
+    WRONG (double rounding); round directly to 24 bits instead."""
+    if signed:
+        neg = vhi < 0
+        nlo, nhi = lo.neg64(vlo, vhi)
+        mlo = jnp.where(neg, nlo, vlo)
+        mhi = jnp.where(neg, nhi, vhi)
+        s = b2i32(neg)
+    else:
+        s = jnp.zeros_like(vlo)
+        mlo, mhi = vlo, vhi
+    zero = (mlo | mhi) == 0
+    lead = lo.clz64(mlo, mhi)
+    msb = _i(63) - lead
+    # bring MSB to bit 26 (24 significand + 2... use 24+3 GRS window at 26)
+    shift = msb - _i(26)
+    rsh = jnp.clip(shift, 0, 63)
+    lsh = jnp.clip(-shift, 0, 63)
+    lost_mask_lo, lost_mask_hi = lo.shl64(jnp.full_like(mlo, -1),
+                                          jnp.full_like(mlo, -1), rsh)
+    sticky = (shift > 0) & \
+        (((mlo & ~lost_mask_lo) | (mhi & ~lost_mask_hi)) != 0)
+    r_lo, _rhi = lo.shr64_u(mlo, mhi, rsh)
+    l_lo, _lhi = lo.shl64(mlo, mhi, lsh)
+    w = jnp.where(shift >= 0, r_lo, l_lo)   # 27-bit window
+    e32 = msb + _i(127)
+    rnd = lax.shift_right_logical(w, 2) & 1
+    sticky2 = ((w & 3) != 0) | sticky
+    lsb = lax.shift_right_logical(w, 3) & 1
+    inc = (rnd == 1) & (sticky2 | (lsb == 1))
+    m = lax.shift_right_logical(w, 3) + b2i32(inc)
+    carry = (m & _i(0x01000000)) != 0
+    m = jnp.where(carry, lax.shift_right_logical(m, 1), m)
+    e32 = e32 + b2i32(carry)
+    out = (lax.shift_left(s, 31) | lax.shift_left(e32, 23)
+           | (m & _i(0x007FFFFF)))
+    return jnp.where(zero, lax.shift_left(s, 31), out)

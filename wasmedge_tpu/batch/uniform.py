@@ -251,16 +251,12 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
                            trap=jnp.where(lane_trap != 0, lane_trap, st.trap))
 
     A1F = lo_ops.alu1_fns()
+    A1T = lo_ops.alu1_trap_fns()
 
     def h_alu1(st, f):
         sub, a, b, c, ilo, ihi = f
         wl = row(st.stack_lo, st.sp - 1)
         wh = row(st.stack_hi, st.sp - 1)
-        fwv = lo_ops.to_f32(wl)
-        tr = jnp.where(fwv < 0, lax.ceil(fwv), lax.floor(fwv))
-        nanw = lo_ops.is_nan32(wl)
-        in_s = (tr >= jnp.float32(-2147483648.0)) & (tr <= jnp.float32(2147483520.0))
-        in_u = (tr >= 0) & (tr <= jnp.float32(4294967040.0))
         n_subs = max(A1F) + 1
 
         def mk(i):
@@ -271,10 +267,16 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
 
         fns = [mk(i) for i in range(n_subs)]
         rl, rh = lax.switch(jnp.clip(sub, 0, n_subs - 1), fns)
-        trap_s = (sub == A1["i32.trunc_f32_s"]) & (nanw | ~in_s)
-        trap_u = (sub == A1["i32.trunc_f32_u"]) & (nanw | ~in_u)
-        lane_trap = jnp.where((trap_s | trap_u) & nanw, int(ErrCode.InvalidConvToInt),
-                              jnp.where(trap_s | trap_u, int(ErrCode.IntegerOverflow), 0))
+
+        def mk_trap(i):
+            t1 = A1T.get(i)
+            if t1 is None:
+                return lambda: (jnp.zeros_like(wl) != 0, jnp.zeros_like(wl))
+            return lambda: t1(wl, wh)
+
+        tfns = [mk_trap(i) for i in range(n_subs)]
+        bad, codes = lax.switch(jnp.clip(sub, 0, n_subs - 1), tfns)
+        lane_trap = jnp.where(bad, codes, jnp.int32(0))
         sl = setrow(st.stack_lo, st.sp - 1, rl)
         sh = setrow(st.stack_hi, st.sp - 1, rh)
         return st._replace(pc=st.pc + 1, stack_lo=sl, stack_hi=sh,

@@ -71,13 +71,14 @@ class Loader:
                 last_order = order
                 try:
                     self._load_section(sec_id, sub, mod)
+                    if sub.pos != sec_end:
+                        raise LoadError(ErrCode.SectionSizeMismatch,
+                                        offset=sub.pos)
                 except LoadError as e:
                     from wasmedge_tpu.common.errinfo import InfoAST
 
                     raise e.with_info(InfoAST(
                         f"section {_SECTION_NAMES.get(sec_id, sec_id)}"))
-                if sub.pos != sec_end:
-                    raise LoadError(ErrCode.SectionSizeMismatch, offset=sub.pos)
                 if sec_id == 10:
                     code_count_seen = len(mod.codes)
             fm.pos = sec_end
