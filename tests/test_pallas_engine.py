@@ -59,10 +59,14 @@ def check_parity(data, func, per_lane_args, max_steps=2_000_000,
             expect = scalar_call(s_ex, s_store, s_inst, func, lane_args)
             assert res.trap[lane] == -1, \
                 f"lane {lane}: batch trapped {res.trap[lane]}, scalar ok"
+            from wasmedge_tpu.common.types import typed_to_bits
+
+            rtypes = s_inst.find_func(func).functype.results
             for ri, val in enumerate(expect):
-                got = res.results[ri][lane]
-                assert got == np.int64(val), \
-                    f"lane {lane}: got {got}, scalar {val}"
+                got = int(res.results[ri][lane]) & ((1 << 64) - 1)
+                want = typed_to_bits(rtypes[ri], val)
+                assert got == want, \
+                    f"lane {lane}: got {got:#x}, scalar {want:#x} ({val})"
         except TrapError as te:
             assert res.trap[lane] == int(te.code), \
                 f"lane {lane}: batch trap {res.trap[lane]} != scalar {te.code}"
@@ -255,3 +259,61 @@ def test_steps_match_xla_uniform_engine():
     r2 = eng.run("fib", [np.full(LANES, 9, np.int64)], max_steps=200_000)
     assert r1.steps == r2.steps
     assert (np.asarray(r1.results[0]) == np.asarray(r2.results[0])).all()
+
+
+def test_bulk_memory_fill_and_copy():
+    """memory.fill/copy on the batch engines vs the scalar oracle,
+    including overlapping copies (memmove semantics) and per-lane args."""
+    b = ModuleBuilder()
+    b.add_memory(1, 1)
+    b.add_function(
+        ("i32", "i32", "i32"), ("i32",), (),
+        [("local.get", 0), ("local.get", 1), ("local.get", 2),
+         ("memory.fill",),
+         # copy [dst+2, dst+2+n) <- [dst, dst+n) (overlap forward)
+         ("local.get", 0), ("i32.const", 2), ("i32.add",),
+         ("local.get", 0), ("local.get", 2), ("memory.copy",),
+         # checksum a window
+         ("local.get", 0), ("i32.load", 0, 2),
+         ("local.get", 0), ("i32.load", 0, 6), ("i32.add",),
+         ("local.get", 0), ("i32.load8_u", 0, 11), ("i32.add",)],
+        export="f")
+    dsts = np.array([0, 8, 13, 100, 255, 1000, 4093, 64], np.int64)
+    vals = np.arange(LANES, dtype=np.int64) + 0xA0
+    ns = np.array([4, 9, 16, 3, 8, 32, 1, 64], np.int64)
+    eng, res = check_parity(b.build(), "f", [dsts, vals, ns])
+
+
+def test_bulk_memory_oob_lanes():
+    b = ModuleBuilder()
+    b.add_memory(1, 1)
+    b.add_function(("i32", "i32"), (), (),
+                   [("local.get", 0), ("i32.const", 0x5A),
+                    ("local.get", 1), ("memory.fill",)], export="f")
+    dsts = np.array([0, 0xFFF0, 0, 4, 8, 12, 16, 20], np.int64)
+    ns = np.array([4, 0x20, 0, 4, 4, 4, 4, 4], np.int64)  # lane 1 OOB
+    eng, res = check_parity(b.build(), "f", [dsts, ns])
+    assert res.trap[1] == int(ErrCode.MemoryOutOfBounds)
+
+
+def test_fill_stays_on_pallas_copy_falls_back():
+    b = ModuleBuilder()
+    b.add_memory(1, 1)
+    b.add_function(("i32",), ("i32",), (),
+                   [("i32.const", 16), ("local.get", 0), ("i32.const", 8),
+                    ("memory.fill",),
+                    ("i32.const", 16), ("i32.load", 0, 2)], export="fill")
+    eng, res = check_parity(b.build(), "fill",
+                            [np.full(LANES, 0x7F, np.int64)])
+    assert not eng.fell_back_to_simt
+
+    b2 = ModuleBuilder()
+    b2.add_memory(1, 1)
+    b2.add_function(("i32",), ("i32",), (),
+                    [("i32.const", 0), ("local.get", 0), ("i32.store", 2, 0),
+                     ("i32.const", 32), ("i32.const", 0), ("i32.const", 4),
+                     ("memory.copy",),
+                     ("i32.const", 32), ("i32.load", 0, 2)], export="cp")
+    eng2, res2 = check_parity(b2.build(), "cp",
+                              [np.full(LANES, 0xBEEF, np.int64)])
+    assert eng2.fell_back_to_simt  # copy hands off to SIMT

@@ -51,6 +51,8 @@ from wasmedge_tpu.batch.image import (
     CLS_LOCAL_GET,
     CLS_LOCAL_SET,
     CLS_LOCAL_TEE,
+    CLS_MEMCOPY,
+    CLS_MEMFILL,
     CLS_MEMGROW,
     CLS_MEMSIZE,
     CLS_RETURN,
@@ -528,6 +530,34 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         mem_plane = scat(mem_plane, widx + 1, nw1, store_ok & (sm1 != 0))
         mem_plane = scat(mem_plane, widx + 2, nw2, store_ok & (sm2 != 0))
 
+        # ------ bulk memory: fill / copy (full-plane masked ops, run
+        # under an any-lane conditional since they rewrite [W, lanes]) ---
+        is_fill = is_cls[CLS_MEMFILL]
+        is_copy = is_cls[CLS_MEMCOPY]
+        is_bulk = is_fill | is_copy
+        # operands (top of stack): fill = dst,val,n / copy = dst,src,n
+        bulk_n = v0_lo
+        bulk_b = v1_lo            # fill value / copy src
+        bulk_dst = v2_lo
+        mem_bytes_v = st.mem_pages * jnp.int32(65536)
+        bulk_end = bulk_dst + bulk_n
+        src_end = bulk_b + bulk_n
+        bulk_oob = is_bulk & active & (
+            u_lt(bulk_end, bulk_dst) | u_lt(mem_bytes_v, bulk_end)
+            | (is_copy & (u_lt(src_end, bulk_b)
+                          | u_lt(mem_bytes_v, src_end))))
+        bulk_go = is_bulk & active & ~bulk_oob & (bulk_n != 0)
+
+        uses_copy = bool((img.cls == CLS_MEMCOPY).any())
+
+        def run_bulk(mem_in):
+            return lo_ops.plane_fill_copy(
+                mem_in, bulk_dst, bulk_end, bulk_b, bulk_go,
+                copy_lanes=is_copy if uses_copy else None)
+
+        mem_plane = lax.cond(jnp.any(bulk_go), run_bulk,
+                             lambda m: m, mem_plane)
+
         is_grow = is_cls[CLS_MEMGROW]
         grow_delta = v0_lo
         grow_ok = ~u_lt(jnp.int32(img.mem_pages_max), st.mem_pages + grow_delta) \
@@ -662,6 +692,7 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             (is_cls[CLS_DROP] | is_lset | is_gset | is_alu2 | is_brz
              | (is_brnz & cond_zero), sp - 1),
             (is_cls[CLS_STORE] | is_sel, sp - 2),
+            (is_bulk, sp - 3),
             (is_br, opbase + c + b),
             (brnz_taken, opbase + c + b),
             (is_brt, opbase + bt_pop + bt_keep),
@@ -695,6 +726,7 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             (alu1_trap != 0, alu1_trap),
             ((is_load | is_store) & mem_oob,
              jnp.int32(int(ErrCode.MemoryOutOfBounds))),
+            (bulk_oob, jnp.int32(int(ErrCode.MemoryOutOfBounds))),
             (is_callany & (call_trap != 0), call_trap),
             (ret_done, jnp.int32(TRAP_DONE)),
         ):

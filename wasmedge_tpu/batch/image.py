@@ -52,7 +52,9 @@ CLS_MEMSIZE = 20
 CLS_MEMGROW = 21
 CLS_TRAP = 22
 CLS_HOSTCALL = 23  # synthetic stub: park lane for the host outcall channel
-NUM_CLASSES = 24
+CLS_MEMFILL = 24
+CLS_MEMCOPY = 25
+NUM_CLASSES = 26
 
 # -- ALU2 sub-ops (binary: pop2 push1) --------------------------------------
 _I32_BIN = ["add", "sub", "mul", "div_s", "div_u", "rem_s", "rem_u", "and",
@@ -128,7 +130,7 @@ _UNSUPPORTED_PREFIXES = ("v128.", "i8x16.", "i16x8.", "i32x4.",
 _UNSUPPORTED_NAMES = {
     "table.get", "table.set", "table.size", "table.grow", "table.fill",
     "table.copy", "table.init", "elem.drop",
-    "memory.init", "memory.copy", "memory.fill", "data.drop",
+    "memory.init", "data.drop",
     "ref.func",
     "return_call", "return_call_indirect",
 }
@@ -328,6 +330,10 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
             cls[pc] = CLS_STORE
             a[pc] = _i32(imm)
             b[pc] = stores[op]
+        elif op == Op.memory_fill:
+            cls[pc] = CLS_MEMFILL
+        elif op == Op.memory_copy:
+            cls[pc] = CLS_MEMCOPY
         elif op == Op.memory_size:
             cls[pc] = CLS_MEMSIZE
         elif op == Op.memory_grow:

@@ -664,6 +664,56 @@ def alu1_fns():
     return fns
 
 
+# byte-position write masks as signed int32 (0xFF << 24 wraps negative)
+BYTE_MASKS = (0xFF, 0xFF00, 0xFF0000, -0x1000000)
+
+
+def plane_fill_copy(mem, dst, end, src_or_val, go, copy_lanes=None):
+    """Masked bulk fill/copy over a word-major [W, lanes] memory plane.
+
+    dst/end/src_or_val/go are per-lane vectors (byte addresses; go gates
+    the write).  copy_lanes: None = every lane fills; a boolean vector =
+    lanes where the op is memory.copy (src_or_val is then the source
+    address).  Source reads come from the unmodified input plane, giving
+    memmove semantics for overlapping ranges.  Shared by the SIMT and
+    XLA-uniform engines (the Pallas kernel has a chunked in-kernel
+    variant)."""
+    W = mem.shape[0]
+    widx = jnp.arange(W, dtype=I32)[:, None]
+    byte0 = widx * 4
+    mask = jnp.zeros_like(mem)
+    for bpos in range(4):
+        ba = byte0 + bpos
+        inr = (~u_lt(ba, dst[None, :])) & u_lt(ba, end[None, :])
+        mask = mask | jnp.where(inr, jnp.int32(BYTE_MASKS[bpos]), 0)
+    fill_word = ((src_or_val & 0xFF) * jnp.int32(0x01010101))[None, :]
+    if copy_lanes is None:
+        new_word = jnp.broadcast_to(fill_word, mem.shape)
+    else:
+        delta = src_or_val - dst
+
+        def src_path(m):
+            src_addr0 = byte0 + delta[None, :]
+            # arithmetic shift: backward-overlap deltas make early word
+            # addresses negative and must round toward -inf
+            swi = lax.shift_right_arithmetic(src_addr0, 2)
+            shB = (src_addr0 & 3) * 8
+            s0 = jnp.take_along_axis(m, jnp.clip(swi, 0, W - 1), axis=0)
+            s1 = jnp.take_along_axis(m, jnp.clip(swi + 1, 0, W - 1),
+                                     axis=0)
+            inv = (32 - shB) & 31
+            hi_or = jnp.where(shB == 0, 0, -1)
+            return (lax.shift_right_logical(s0, shB)
+                    | (lax.shift_left(s1, inv) & hi_or))
+
+        # skip the two full-plane gathers when no lane copies this step
+        src_word = lax.cond(jnp.any(copy_lanes & go), src_path,
+                            lambda m: m, mem)
+        new_word = jnp.where(copy_lanes[None, :], src_word, fill_word)
+    write = (mask != 0) & go[None, :]
+    return jnp.where(write, (mem & ~mask) | (new_word & mask), mem)
+
+
 def alu1_trap_fns():
     """Trap checks for the trapping ALU1 subs (non-sat float->int):
     sub -> fn(wl, wh) -> (bad_mask, code_vec).  Shared by all batch

@@ -68,6 +68,8 @@ from wasmedge_tpu.batch.image import (
     CLS_LOCAL_GET,
     CLS_LOCAL_SET,
     CLS_LOCAL_TEE,
+    CLS_MEMCOPY,
+    CLS_MEMFILL,
     CLS_MEMGROW,
     CLS_MEMSIZE,
     CLS_NOP,
@@ -106,7 +108,9 @@ H_TRAP = 18
 H_LOAD = 19
 H_STORE = 20
 H_HOSTCALL = 21
-H_ALU2_BASE = 22                      # + ALU2 sub id
+H_MEMFILL = 22
+H_MEMCOPY = 23
+H_ALU2_BASE = 24                      # + ALU2 sub id
 H_ALU1_BASE = H_ALU2_BASE + NUM_ALU2  # + ALU1 sub id
 NUM_HANDLERS = H_ALU1_BASE + NUM_ALU1
 
@@ -119,6 +123,7 @@ _CLS_TO_HID = {
     CLS_CALL: H_CALL, CLS_CALL_INDIRECT: H_CALL_INDIRECT,
     CLS_MEMSIZE: H_MEMSIZE, CLS_MEMGROW: H_MEMGROW, CLS_TRAP: H_TRAP,
     CLS_LOAD: H_LOAD, CLS_STORE: H_STORE, CLS_HOSTCALL: H_HOSTCALL,
+    CLS_MEMFILL: H_MEMFILL, CLS_MEMCOPY: H_MEMCOPY,
 }
 
 # status values (shared with batch/uniform.py)
@@ -525,6 +530,53 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             trapr[0, :] = jnp.full((Lblk,), code, I32)
             return keep(c, status=I32(ST_TRAPPED_BASE) + code)
 
+        def h_memfill(c):
+            pc, sp, pages = c[1], c[2], c[6]
+            n = srow(slo, sp - 1)
+            val = srow(slo, sp - 2)
+            dst = srow(slo, sp - 3)
+            mem_bytes = pages * I32(65536)
+            end = dst + n
+            oob = u_lt(end, dst) | u_lt(full(mem_bytes), end)
+            go = (~oob) & (n != 0)
+            fill_word = (val & 0xFF) * I32(0x01010101)
+
+            def chunk(i, _):
+                base = i * GR
+                rows = memr[pl.ds(base, GR), :]
+                wi = base + jax.lax.broadcasted_iota(I32, (GR, Lblk), 0)
+                byte0 = wi * 4
+                mask = jnp.zeros_like(rows)
+                for bpos in range(4):
+                    ba = byte0 + bpos
+                    inr = (~u_lt(ba, dst)) & u_lt(ba, end)
+                    mask = mask | jnp.where(
+                        inr, jnp.int32(lo_ops.BYTE_MASKS[bpos]), 0)
+                write = (mask != 0) & go
+                memr[pl.ds(base, GR), :] = jnp.where(
+                    write, (rows & ~mask) | (fill_word & mask), rows)
+                return 0
+
+            lax.fori_loop(0, GATHER_CHUNKS, chunk, 0)
+            any_oob = jnp.any(oob)
+
+            @pl.when(any_oob)
+            def _():
+                trapr[0, :] = jnp.where(
+                    oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
+                    trapr[0, :])
+
+            return lax.cond(
+                any_oob,
+                lambda: keep(c, pc=pc + 1, sp=sp - 3,
+                             status=I32(ST_DIVERGED)),
+                lambda: keep(c, pc=pc + 1, sp=sp - 3))
+
+        def h_memcopy(c):
+            # per-lane byte gather is unavailable in-kernel; hand off
+            # un-advanced so the SIMT engine executes the copy
+            return keep(c, status=I32(ST_DIVERGED))
+
         def h_hostcall(c):
             # park the block; the host serves every lane then re-arms at
             # pc+1 (the stub RETURN) with sp = opbase + nresults
@@ -787,6 +839,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             H_CALL: h_call, H_CALL_INDIRECT: h_call_indirect,
             H_MEMSIZE: h_memsize, H_MEMGROW: h_memgrow, H_TRAP: h_trap,
             H_LOAD: h_load, H_STORE: h_store, H_HOSTCALL: h_hostcall,
+            H_MEMFILL: h_memfill, H_MEMCOPY: h_memcopy,
         }
 
         def handler_for(hid):

@@ -45,6 +45,8 @@ from wasmedge_tpu.batch.image import (
     CLS_LOCAL_GET,
     CLS_LOCAL_SET,
     CLS_LOCAL_TEE,
+    CLS_MEMCOPY,
+    CLS_MEMFILL,
     CLS_MEMGROW,
     CLS_MEMSIZE,
     CLS_NOP,
@@ -542,6 +544,35 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
         return lax.cond(agree, lambda s: s,
                         lambda s: halt(st, jnp.int32(ST_DIVERGED)), new_st)
 
+    def _bulk(st, is_copy):
+        n = row(st.stack_lo, st.sp - 1)
+        src_or_val = row(st.stack_lo, st.sp - 2)
+        dst = row(st.stack_lo, st.sp - 3)
+        mem_bytes = st.mem_pages * jnp.int32(65536)
+        end = dst + n
+        s_end = src_or_val + n
+        oob = u_lt(end, dst) | u_lt(mem_bytes, end)
+        if is_copy:
+            oob = oob | u_lt(s_end, src_or_val) | u_lt(mem_bytes, s_end)
+        go = ~oob & (n != 0)
+        copy_lanes = jnp.ones_like(dst, bool) if is_copy else None
+        mem = lo_ops.plane_fill_copy(st.mem, dst, end, src_or_val, go,
+                                     copy_lanes=copy_lanes)
+        any_oob = jnp.any(oob)
+        new_st = st._replace(pc=st.pc + 1, sp=st.sp - 3, mem=mem)
+        return lax.cond(
+            any_oob,
+            lambda s: s._replace(
+                trap=jnp.where(oob, int(ErrCode.MemoryOutOfBounds), s.trap),
+                status=jnp.int32(ST_DIVERGED)),
+            lambda s: s, new_st)
+
+    def h_memfill(st, f):
+        return _bulk(st, False)
+
+    def h_memcopy(st, f):
+        return _bulk(st, True)
+
     def h_trap(st, f):
         sub, a, b, c, ilo, ihi = f
         return st._replace(trap=jnp.full((lanes,), a, I32),
@@ -575,6 +606,8 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
     handlers[CLS_LOAD] = h_load
     handlers[CLS_STORE] = h_store
     handlers[CLS_MEMSIZE] = h_memsize
+    handlers[CLS_MEMFILL] = h_memfill
+    handlers[CLS_MEMCOPY] = h_memcopy
     handlers[CLS_MEMGROW] = h_memgrow
     handlers[CLS_TRAP] = h_trap
 
