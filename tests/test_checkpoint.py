@@ -1,0 +1,66 @@
+"""Checkpoint/resume of in-flight batches (SURVEY.md §5.4): a run
+interrupted at an arbitrary step boundary, saved, restored (optionally in
+a fresh engine) and continued must bit-match an uninterrupted run."""
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.batch import BatchEngine
+from wasmedge_tpu.batch.checkpoint import load, save
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.models import build_fib, build_memory_workload
+from tests.helpers import instantiate
+
+
+def make(data, lanes=16):
+    conf = Configure()
+    conf.batch.steps_per_launch = 100
+    ex, store, inst = instantiate(data, conf)
+    return BatchEngine(inst, store=store, conf=conf, lanes=lanes)
+
+
+def run_all(eng, func, args, max_steps=500_000):
+    state = eng.initial_state(eng.inst.exports[func][1], args)
+    state, total = eng.run_from_state(state, 0, max_steps)
+    return state, total
+
+
+def test_interrupt_resume_bitmatch(tmp_path):
+    args = [(np.arange(16) % 13).astype(np.int64)]
+    ref_eng = make(build_fib())
+    ref_state, ref_total = run_all(ref_eng, "fib", args)
+
+    eng = make(build_fib())
+    state = eng.initial_state(eng.inst.exports["fib"][1], args)
+    # run a slice, checkpoint mid-flight, drop everything
+    state, total = eng.run_from_state(state, 0, 700)
+    assert (np.asarray(state.trap) == 0).any()  # genuinely in-flight
+    ckpt = tmp_path / "batch.ckpt"
+    save(ckpt, eng, state, total)
+
+    # fresh engine from the same module; restore and finish
+    eng2 = make(build_fib())
+    state2, total2 = load(ckpt, eng2)
+    assert total2 == total
+    state2, total2 = eng2.run_from_state(state2, total2, 500_000)
+
+    for name in ("trap", "retired", "stack_lo", "stack_hi", "mem"):
+        a = np.asarray(getattr(ref_state, name))
+        b = np.asarray(getattr(state2, name))
+        assert (a == b).all(), f"{name} diverged after resume"
+    assert total2 == ref_total
+
+
+def test_checkpoint_refuses_wrong_image(tmp_path):
+    eng = make(build_fib())
+    state = eng.initial_state(eng.inst.exports["fib"][1],
+                              [np.full(16, 9, np.int64)])
+    state, total = eng.run_from_state(state, 0, 300)
+    ckpt = tmp_path / "c.ckpt"
+    save(ckpt, eng, state, total)
+    other = make(build_memory_workload())
+    with pytest.raises(ValueError, match="different module image"):
+        load(ckpt, other)
+    small = make(build_fib(), lanes=8)
+    with pytest.raises(ValueError, match="lanes"):
+        load(ckpt, small)

@@ -1,0 +1,81 @@
+"""Checkpoint/resume of batch execution state.
+
+SURVEY.md §5.4: the reference has no checkpointing (runs are short-lived),
+but the batch engine's fully-SoA state makes snapshotting thousands of
+in-flight instances a plain array save — the design the survey said was
+worth building in.  A checkpoint is an .npz of every BatchState plane plus
+a metadata record binding it to the module image (content hash) and the
+execution cursor (retired steps), so a resume onto a different image or a
+tampered file is refused rather than misexecuted.
+
+Flow: `state = engine.initial_state(...)`; drive it in slices with
+`engine.run_from_state(state, total, budget)`; `save(path, engine, state,
+total)` at any boundary; later `state, total = load(path, engine)` and
+keep driving.  Works for single-module and multi-tenant engines alike
+(the state layout is the same).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+from typing import Tuple
+
+import numpy as np
+
+from wasmedge_tpu.batch.engine import BatchEngine, BatchState
+
+FORMAT_VERSION = 1
+
+
+def image_fingerprint(img) -> str:
+    """Content hash over the device image's executable planes."""
+    h = hashlib.sha256()
+    for name in ("cls", "sub", "a", "b", "c", "imm_lo", "imm_hi",
+                 "br_table", "f_entry", "f_nparams", "f_nlocals",
+                 "f_nresults", "f_frame_top", "f_type", "table0"):
+        h.update(np.ascontiguousarray(getattr(img, name)).tobytes())
+    return h.hexdigest()
+
+
+def save(path, engine: BatchEngine, state: BatchState, total_steps: int):
+    """Snapshot an in-flight batch to `path` (.npz)."""
+    meta = {
+        "format": FORMAT_VERSION,
+        "image_sha256": image_fingerprint(engine.img),
+        "lanes": engine.lanes,
+        "total_steps": int(total_steps),
+    }
+    arrays = {f"state_{name}": np.asarray(getattr(state, name))
+              for name in state._fields}
+    buf = io.BytesIO()
+    np.savez_compressed(buf, meta=json.dumps(meta), **arrays)
+    data = buf.getvalue()
+    if hasattr(path, "write"):
+        path.write(data)
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:
+    """Restore a snapshot; refuses a checkpoint from a different module
+    image or lane geometry."""
+    import jax.numpy as jnp
+
+    with np.load(path if not hasattr(path, "read") else path,
+                 allow_pickle=False) as z:
+        meta = json.loads(str(z["meta"]))
+        if meta.get("format") != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint format {meta.get('format')}")
+        if meta["image_sha256"] != image_fingerprint(engine.img):
+            raise ValueError("checkpoint was taken from a different module "
+                             "image")
+        if meta["lanes"] != engine.lanes:
+            raise ValueError(f"checkpoint has {meta['lanes']} lanes, "
+                             f"engine has {engine.lanes}")
+        fields = {}
+        for name in BatchState._fields:
+            fields[name] = jnp.asarray(z[f"state_{name}"])
+    return BatchState(**fields), meta["total_steps"]

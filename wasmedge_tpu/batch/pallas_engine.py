@@ -973,6 +973,16 @@ class PallasUniformEngine:
         self.ineligible_reason = self._eligibility()
 
     # -- geometry / eligibility -------------------------------------------
+    def _interpret(self) -> bool:
+        if self.interpret is not None:
+            return self.interpret
+        from wasmedge_tpu.batch import ensure_jax_backend
+
+        ensure_jax_backend()
+        import jax
+
+        return jax.default_backend() == "cpu"
+
     def _depths(self):
         # The configured depths are honored exactly — same trap thresholds
         # as the XLA engines' _do_call; _lane_block gates whether they fit
@@ -991,13 +1001,16 @@ class PallasUniformEngine:
         W = self._mem_words()
         NGp = max(self.img.globals_lo.shape[0], 1)
         per_lane = 4 * (2 * D + 2 * NGp + W + 1)
+        # Mosaic requires lane-dim slices aligned to the 128-lane tiling;
+        # interpret mode (CPU tests) has no such constraint.
+        align = 1 if self._interpret() else 128
         blk = self.lanes
         cap = self._blk_cap or self.lanes
-        while blk > 1 and (blk * per_lane > self.VMEM_BUDGET_BYTES
-                           or self.lanes % blk != 0 or blk > cap):
+        while blk > align and (blk * per_lane > self.VMEM_BUDGET_BYTES
+                               or self.lanes % blk != 0 or blk > cap):
             blk //= 2
         if blk * per_lane > self.VMEM_BUDGET_BYTES or self.lanes % blk != 0 \
-                or blk > cap:
+                or blk > cap or blk % align != 0:
             return None
         return blk
 
@@ -1027,9 +1040,7 @@ class PallasUniformEngine:
         import jax.numpy as jnp
 
         img = self.img
-        interpret = self.interpret
-        if interpret is None:
-            interpret = jax.default_backend() == "cpu"
+        interpret = self._interpret()
         hid = hid_plane(img)
         used = tuple(sorted(set(int(h) for h in hid)))
         dense = {h: i for i, h in enumerate(used)}

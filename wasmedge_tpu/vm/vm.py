@@ -19,7 +19,7 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from wasmedge_tpu.common.configure import Configure, HostRegistration
-from wasmedge_tpu.common.errors import ErrCode, WasmError
+from wasmedge_tpu.common.errors import LoadError, ErrCode, WasmError
 from wasmedge_tpu.common.statistics import Statistics
 from wasmedge_tpu.executor.executor import Executor, StopToken
 from wasmedge_tpu.loader import ast
@@ -86,6 +86,17 @@ class VM:
             return source
         if isinstance(source, (bytes, bytearray)):
             return self.loader.parse_module(bytes(source))
+        if isinstance(source, str) and source.endswith(".wat"):
+            # text format through the built-in wat front-end
+            from wasmedge_tpu.utils.wat import WatError, parse_wat
+
+            with open(source) as f:
+                src = f.read()
+            try:
+                data = parse_wat(src)
+            except WatError as e:
+                raise LoadError(ErrCode.IllegalGrammar, f"wat: {e}")
+            return self.loader.parse_module(data)
         return self.loader.parse_file(source)
 
     def load_wasm(self, source: Source) -> "VM":
@@ -169,14 +180,16 @@ class VM:
         """Run the instantiated module's export over N device lanes in SIMT
         lockstep (the tpu_batch engine, SURVEY.md §2.10) and return the
         BatchResult (per-lane results/trap/retired arrays)."""
-        from wasmedge_tpu.batch.engine import BatchEngine
+        from wasmedge_tpu.batch.uniform import UniformBatchEngine
 
         with self._lock:
             if self._active is None or self.stage != VMStage.Instantiated:
                 raise WasmError(ErrCode.WrongVMWorkflow, "no instantiated module")
             inst = self._active
-        eng = BatchEngine(inst, store=self.store, conf=self.conf,
-                          lanes=lanes, mesh=mesh)
+        # the auto engine: Pallas warp-interpreter on TPU, XLA uniform on
+        # CPU, SIMT for divergence/fuel/mesh — all behind one run()
+        eng = UniformBatchEngine(inst, store=self.store, conf=self.conf,
+                                 lanes=lanes, mesh=mesh)
         return eng.run(func_name, list(args_lanes), max_steps=max_steps)
 
     # -- async + interruption (reference: vm.cpp asyncExecute + stop) ------
