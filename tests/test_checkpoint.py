@@ -64,3 +64,10 @@ def test_checkpoint_refuses_wrong_image(tmp_path):
     small = make(build_fib(), lanes=8)
     with pytest.raises(ValueError, match="lanes"):
         load(ckpt, small)
+    conf = Configure()
+    conf.batch.steps_per_launch = 100
+    conf.batch.value_stack_depth = 128
+    ex, store, inst = instantiate(build_fib(), conf)
+    other_geom = BatchEngine(inst, store=store, conf=conf, lanes=16)
+    with pytest.raises(ValueError, match="geometry"):
+        load(ckpt, other_geom)

@@ -41,11 +41,20 @@ def image_fingerprint(img) -> str:
 
 def save(path, engine: BatchEngine, state: BatchState, total_steps: int):
     """Snapshot an in-flight batch to `path` (.npz)."""
+    cfg = engine.cfg
     meta = {
         "format": FORMAT_VERSION,
         "image_sha256": image_fingerprint(engine.img),
         "lanes": engine.lanes,
         "total_steps": int(total_steps),
+        # trap thresholds / plane shapes depend on the engine geometry;
+        # a resume under different knobs would misexecute, so bind them
+        "geometry": {
+            "value_stack_depth": cfg.value_stack_depth,
+            "call_stack_depth": cfg.call_stack_depth,
+            "memory_pages_per_lane": cfg.memory_pages_per_lane,
+            "mem_pages_max": int(engine.img.mem_pages_max),
+        },
     }
     arrays = {f"state_{name}": np.asarray(getattr(state, name))
               for name in state._fields}
@@ -64,8 +73,7 @@ def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:
     image or lane geometry."""
     import jax.numpy as jnp
 
-    with np.load(path if not hasattr(path, "read") else path,
-                 allow_pickle=False) as z:
+    with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["meta"]))
         if meta.get("format") != FORMAT_VERSION:
             raise ValueError(f"unsupported checkpoint format {meta.get('format')}")
@@ -75,6 +83,17 @@ def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:
         if meta["lanes"] != engine.lanes:
             raise ValueError(f"checkpoint has {meta['lanes']} lanes, "
                              f"engine has {engine.lanes}")
+        cfg = engine.cfg
+        want_geom = {
+            "value_stack_depth": cfg.value_stack_depth,
+            "call_stack_depth": cfg.call_stack_depth,
+            "memory_pages_per_lane": cfg.memory_pages_per_lane,
+            "mem_pages_max": int(engine.img.mem_pages_max),
+        }
+        if meta.get("geometry") != want_geom:
+            raise ValueError(
+                f"checkpoint geometry {meta.get('geometry')} does not "
+                f"match the engine's {want_geom}")
         fields = {}
         for name in BatchState._fields:
             fields[name] = jnp.asarray(z[f"state_{name}"])

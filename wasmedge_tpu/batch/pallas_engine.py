@@ -112,7 +112,18 @@ H_MEMFILL = 22
 H_MEMCOPY = 23
 H_ALU2_BASE = 24                      # + ALU2 sub id
 H_ALU1_BASE = H_ALU2_BASE + NUM_ALU2  # + ALU1 sub id
-NUM_HANDLERS = H_ALU1_BASE + NUM_ALU1
+# superinstructions (pallas-only peephole fusion, see fuse_image):
+#   GCA: local.get a; const imm; alu2 sub   -> one dispatch, pc += 3
+#   GBR: local.get sub; br a,b,c            -> one dispatch
+#   GCB: local.get a; const imm; alu2 sub; brz b -> one dispatch
+#   A2R: alu2 sub; return(1 result)             -> one dispatch
+H_FUSE_GCA_BASE = H_ALU1_BASE + NUM_ALU1      # + ALU2 sub id
+H_FUSE_GCB_BASE = H_FUSE_GCA_BASE + NUM_ALU2  # + ALU2 sub id
+#   GCC: local.get a; const imm; alu2 sub; call b -> one dispatch
+H_FUSE_A2R_BASE = H_FUSE_GCB_BASE + NUM_ALU2  # + ALU2 sub id
+H_FUSE_GCC_BASE = H_FUSE_A2R_BASE + NUM_ALU2  # + ALU2 sub id
+H_FUSE_GBR = H_FUSE_GCC_BASE + NUM_ALU2
+NUM_HANDLERS = H_FUSE_GBR + 1
 
 _CLS_TO_HID = {
     CLS_NOP: H_NOP, CLS_CONST: H_CONST, CLS_LOCAL_GET: H_LOCAL_GET,
@@ -166,6 +177,86 @@ def decode_result_rows(stack_lo: np.ndarray, stack_hi: np.ndarray,
         hi = stack_hi[r].view(np.uint32).astype(np.uint64)
         results.append((lo | (hi << np.uint64(32))).view(np.int64))
     return results
+
+
+def fuse_image(hid, a, b, c, ilo, ihi, img):
+    """Peephole superinstruction fusion over the flat-hid planes.
+
+    The dominant dispatch patterns in call-heavy code are
+    `local.get; const; alu2` (operand setup + op) and `local.get; br`
+    (loop/return value shuffles).  Fusing them cuts dispatches and stack
+    row traffic (one read + one write instead of three of each).  Only
+    positions never targeted by a branch/call may be absorbed, and only
+    non-trapping alu2 subs fuse (div/rem keep their own trap handler).
+    Returns rewritten copies; the originals (and every other engine's
+    image) are untouched — this is a pallas-private encoding."""
+    n = img.code_len
+    targets = set(int(x) for x in img.f_entry)
+    for pc in range(n):
+        cl = int(img.cls[pc])
+        if cl in (CLS_BR, CLS_BRZ, CLS_BRNZ):
+            targets.add(int(img.a[pc]))
+    for e in range(img.br_table.shape[0]):
+        targets.add(int(img.br_table[e, 0]))
+    hid = hid.copy()
+    a = a.copy()
+    b = b.copy()
+    c = c.copy()
+    ilo = ilo.copy()
+    ihi = ihi.copy()
+    pc = 0
+    while pc < n - 1:
+        h0 = int(hid[pc])
+        absorb2 = pc + 1 not in targets
+        absorb3 = absorb2 and pc + 2 not in targets and pc + 2 < n
+        h1 = int(hid[pc + 1]) if absorb2 else -1
+        h2 = int(hid[pc + 2]) if absorb3 else -1
+        if h0 == H_LOCAL_GET and absorb3 and h1 == H_CONST and \
+                H_ALU2_BASE <= h2 < H_ALU2_BASE + NUM_ALU2:
+            sub = h2 - H_ALU2_BASE
+            if sub not in _DIV32_SUBS and sub not in _DIV64_SUBS:
+                ok4 = pc + 3 not in targets and pc + 3 < n
+                if ok4 and int(hid[pc + 3]) == H_BRZ:
+                    # quad: the compare feeds a brz; no stack writes at all
+                    hid[pc] = H_FUSE_GCB_BASE + sub
+                    ilo[pc] = ilo[pc + 1]
+                    ihi[pc] = ihi[pc + 1]
+                    b[pc] = a[pc + 3]        # brz target
+                    pc += 4
+                    continue
+                if ok4 and int(hid[pc + 3]) == H_CALL:
+                    # quad: computed value is the callee's argument
+                    hid[pc] = H_FUSE_GCC_BASE + sub
+                    ilo[pc] = ilo[pc + 1]
+                    ihi[pc] = ihi[pc + 1]
+                    b[pc] = a[pc + 3]        # callee index
+                    pc += 4
+                    continue
+                hid[pc] = H_FUSE_GCA_BASE + sub
+                # a keeps the local idx; imm moves up from the const
+                ilo[pc] = ilo[pc + 1]
+                ihi[pc] = ihi[pc + 1]
+                pc += 3
+                continue
+        if h0 == H_LOCAL_GET and absorb2 and h1 == H_BR:
+            hid[pc] = H_FUSE_GBR
+            b_, c_, a_ = int(b[pc + 1]), int(c[pc + 1]), int(a[pc + 1])
+            # ilo carries the local idx; a/b/c carry the branch
+            c[pc] = c_
+            b[pc] = b_
+            ilo[pc] = a[pc]
+            a[pc] = a_
+            pc += 2
+            continue
+        if H_ALU2_BASE <= h0 < H_ALU2_BASE + NUM_ALU2 and absorb2 and \
+                h1 == H_RETURN and int(b[pc + 1]) == 1:
+            sub = h0 - H_ALU2_BASE
+            if sub not in _DIV32_SUBS and sub not in _DIV64_SUBS:
+                hid[pc] = H_FUSE_A2R_BASE + sub
+                pc += 2
+                continue
+        pc += 1
+    return hid, a, b, c, ilo, ihi
 
 
 def hid_plane(img: DeviceImage) -> np.ndarray:
@@ -755,6 +846,90 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     lambda: keep(c, pc=pc + 1, sp=sp - 2)),
                 lambda: keep(c, status=I32(ST_DIVERGED)))
 
+        def mk_fuse_gca(sub):
+            fn = alu2[sub]
+
+            def h(c):
+                pc, sp, fp = c[1], c[2], c[3]
+                src = fp + a_r[pc]
+                xl, xh = srow(slo, src), srow(shi, src)
+                yl, yh = full(ilo_r[pc]), full(ihi_r[pc])
+                rl, rh = fn(xl, xh, yl, yh)
+                wrow(slo, sp, rl)
+                wrow(shi, sp, rh)
+                # retires 3 wasm instructions (the dispatch loop adds 1)
+                return keep(c, steps=c[0] + 2, pc=pc + 3, sp=sp + 1)
+            return h
+
+        def mk_fuse_gcb(sub):
+            fn = alu2[sub]
+
+            def h(c):
+                pc, sp, fp = c[1], c[2], c[3]
+                src = fp + a_r[pc]
+                xl, xh = srow(slo, src), srow(shi, src)
+                yl, yh = full(ilo_r[pc]), full(ihi_r[pc])
+                cond, _rh = fn(xl, xh, yl, yh)
+                t0 = scal(cond)
+                agree = allsame(cond, t0)
+                new_pc = jnp.where(t0 == 0, b_r[pc], pc + 4)
+                return lax.cond(
+                    agree,
+                    lambda: keep(c, steps=c[0] + 3, pc=new_pc),
+                    lambda: keep(c, status=I32(ST_DIVERGED)))
+            return h
+
+        def mk_fuse_a2r(sub):
+            fn = alu2[sub]
+
+            def h(c):
+                pc, sp, fp, cd = c[1], c[2], c[3], c[5]
+                xl, xh = srow(slo, sp - 2), srow(shi, sp - 2)
+                yl, yh = srow(slo, sp - 1), srow(shi, sp - 1)
+                rl, rh = fn(xl, xh, yl, yh)
+                wrow(slo, fp, rl)
+                wrow(shi, fp, rh)
+                new_sp = fp + 1
+                rd = jnp.clip(cd - 1, 0, CD - 1)
+                return lax.cond(
+                    cd == 0,
+                    lambda: keep(c, steps=c[0] + 1, sp=new_sp,
+                                 status=I32(ST_DONE)),
+                    lambda: keep(c, steps=c[0] + 1,
+                                 pc=frames_out[blk, 0, rd], sp=new_sp,
+                                 fp=frames_out[blk, 1, rd],
+                                 ob=frames_out[blk, 2, rd], cd=cd - 1))
+            return h
+
+        def mk_fuse_gcc(sub):
+            fn = alu2[sub]
+
+            def h(c):
+                pc, sp, fp = c[1], c[2], c[3]
+                src = fp + a_r[pc]
+                xl, xh = srow(slo, src), srow(shi, src)
+                yl, yh = full(ilo_r[pc]), full(ihi_r[pc])
+                rl, rh = fn(xl, xh, yl, yh)
+                wrow(slo, sp, rl)
+                wrow(shi, sp, rh)
+                # the fused call returns to pc+4
+                c2 = keep(c, steps=c[0] + 3, pc=pc + 3, sp=sp + 1)
+                return _do_call(c2, b_r[pc], sp + 1)
+            return h
+
+        def h_fuse_gbr(c):
+            pc, sp, fp, ob = c[1], c[2], c[3], c[4]
+            tgt, nkeep, pop_to = a_r[pc], b_r[pc], c_r[pc]
+            tgt_sp = ob + pop_to
+
+            @pl.when(nkeep == 1)
+            def _():
+                src = fp + ilo_r[pc]
+                wrow(slo, tgt_sp, srow(slo, src))
+                wrow(shi, tgt_sp, srow(shi, src))
+
+            return keep(c, steps=c[0] + 1, pc=tgt, sp=tgt_sp + nkeep)
+
         def mk_alu2(sub):
             fn = alu2[sub]
             can_trap = sub in _DIV32_SUBS or sub in _DIV64_SUBS
@@ -843,6 +1018,16 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         }
 
         def handler_for(hid):
+            if hid == H_FUSE_GBR:
+                return h_fuse_gbr
+            if hid >= H_FUSE_GCC_BASE:
+                return mk_fuse_gcc(hid - H_FUSE_GCC_BASE)
+            if hid >= H_FUSE_A2R_BASE:
+                return mk_fuse_a2r(hid - H_FUSE_A2R_BASE)
+            if hid >= H_FUSE_GCB_BASE:
+                return mk_fuse_gcb(hid - H_FUSE_GCB_BASE)
+            if hid >= H_FUSE_GCA_BASE:
+                return mk_fuse_gca(hid - H_FUSE_GCA_BASE)
             if hid >= H_ALU1_BASE:
                 return mk_alu1(hid - H_ALU1_BASE)
             if hid >= H_ALU2_BASE:
@@ -1006,11 +1191,14 @@ class PallasUniformEngine:
         align = 1 if self._interpret() else 128
         blk = self.lanes
         cap = self._blk_cap or self.lanes
-        while blk > align and (blk * per_lane > self.VMEM_BUDGET_BYTES
-                               or self.lanes % blk != 0 or blk > cap):
+
+        def bad(k):
+            return (k * per_lane > self.VMEM_BUDGET_BYTES
+                    or self.lanes % k != 0 or k > cap or k % align != 0)
+
+        while blk > align and bad(blk):
             blk //= 2
-        if blk * per_lane > self.VMEM_BUDGET_BYTES or self.lanes % blk != 0 \
-                or blk > cap or blk % align != 0:
+        if bad(blk):
             return None
         return blk
 
@@ -1042,6 +1230,10 @@ class PallasUniformEngine:
         img = self.img
         interpret = self._interpret()
         hid = hid_plane(img)
+        a_p, b_p, c_p = img.a, img.b, img.c
+        ilo_p, ihi_p = img.imm_lo, img.imm_hi
+        hid, a_p, b_p, c_p, ilo_p, ihi_p = fuse_image(
+            hid, a_p, b_p, c_p, ilo_p, ihi_p, img)
         used = tuple(sorted(set(int(h) for h in hid)))
         dense = {h: i for i, h in enumerate(used)}
         hid_dense = np.asarray([dense[int(h)] for h in hid], np.int32)
@@ -1058,7 +1250,7 @@ class PallasUniformEngine:
             img.max_local_zeros, pages_cap,
             W * Lblk <= self.MAX_GATHER_ELEMS, interpret)
         self._tables = tuple(jnp.asarray(t) for t in (
-            hid_dense, img.a, img.b, img.c, img.imm_lo, img.imm_hi,
+            hid_dense, a_p, b_p, c_p, ilo_p, ihi_p,
             img.f_entry, img.f_nparams, img.f_nlocals, img.f_frame_top,
             img.f_type, img.br_table.reshape(-1), img.table0))
 

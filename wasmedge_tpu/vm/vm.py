@@ -95,7 +95,7 @@ class VM:
             try:
                 data = parse_wat(src)
             except WatError as e:
-                raise LoadError(ErrCode.IllegalGrammar, f"wat: {e}")
+                raise LoadError(ErrCode.IllegalGrammar, f"wat: {e}") from e
             return self.loader.parse_module(data)
         return self.loader.parse_file(source)
 
