@@ -523,3 +523,164 @@ def test_poll_oneoff_bad_clock_is_per_subscription():
     assert mem.load(out, 8, False) == 0xAB  # userdata echoed
     assert mem.load(out + 8, 2, False) == Errno.INVAL  # per-event errno
     assert mem.load(out + 10, 1, False) == abi.Eventtype.CLOCK
+
+
+# ---------------------------------------------------------------------------
+# depth: readdir cookie walks, poll fd-readiness + clock ordering, socket
+# option/shutdown/dgram paths (reference: test/host/wasi/wasi.cpp breadth)
+# ---------------------------------------------------------------------------
+def test_readdir_cookie_walk_small_buffer(wasi_tmp):
+    """Enumerate a directory entry-by-entry with a buffer that fits only
+    one dirent per call, resuming from d_next each time."""
+    import os as _os
+
+    wasi, root = wasi_tmp
+    for name in ("aaa", "bb", "c"):
+        with open(_os.path.join(root, name), "w") as f:
+            f.write("x")
+    mem = make_mem()
+    err, fd = _open(wasi, mem, 3, ".", Oflags.DIRECTORY)
+    assert err == Errno.SUCCESS
+    seen = set()
+    cookie = 0
+    for _ in range(16):
+        # buffer barely fits one max-size entry
+        assert call(wasi, "fd_readdir", mem, fd, 0, 64, cookie,
+                    600) == Errno.SUCCESS
+        used = mem.load(600, 4, False)
+        if used == 0:
+            break
+        d_next = mem.load(0, 8, False)
+        namelen = mem.load(16, 4, False)
+        if 24 + namelen <= used:
+            nm = bytes(mem.load_bytes(24, namelen)).decode()
+            seen.add(nm)
+        if d_next == cookie:
+            break
+        cookie = d_next
+        if len(seen) >= 5:
+            break
+    assert {"aaa", "bb", "c"} <= seen
+
+
+def test_poll_oneoff_fd_ready_and_clock_ordering():
+    """A readable fd resolves the poll before a long clock subscription."""
+    import os as _os
+    import time as _t
+
+    r, w = _os.pipe()
+    _os.write(w, b"!")
+    wasi = WasiModule()
+    wasi.init_wasi()
+    from wasmedge_tpu.host.wasi.environ import FdEntry
+
+    guest_fd = 40
+    wasi.env.fds[guest_fd] = FdEntry("stdio", os_fd=r,
+                                     rights_base=Rights.FD_READ
+                                     | Rights.POLL_FD_READWRITE)
+    mem = make_mem()
+    # sub 0: clock 10s; sub 1: fd_read on the ready pipe
+    base = 0
+    mem.store(base + 8, 1, 0)           # tag CLOCK
+    mem.store(base + 16, 4, 1)          # monotonic
+    mem.store(base + 24, 8, 10_000_000_000)
+    from wasmedge_tpu.host.wasi import wasi_abi as abi
+
+    sub1 = base + abi.SUBSCRIPTION_SIZE
+    mem.store(sub1, 8, 0xBEEF)          # userdata
+    mem.store(sub1 + 8, 1, int(abi.Eventtype.FD_READ))
+    mem.store(sub1 + 16, 4, guest_fd)
+    t0 = _t.monotonic()
+    assert call(wasi, "poll_oneoff", mem, 0, 256, 2, 300) == Errno.SUCCESS
+    assert _t.monotonic() - t0 < 5.0    # did not sleep out the clock
+    nevents = mem.load(300, 4, False)
+    assert nevents >= 1
+    ud = mem.load(256, 8, False)
+    assert ud == 0xBEEF                 # the fd event, not the clock
+    _os.close(r)
+    _os.close(w)
+
+
+def test_poll_oneoff_pure_clock_sleeps():
+    import time as _t
+
+    wasi = WasiModule()
+    wasi.init_wasi()
+    mem = make_mem()
+    mem.store(0, 8, 0x11)
+    mem.store(8, 1, 0)                  # CLOCK
+    mem.store(16, 4, 1)                 # monotonic
+    mem.store(24, 8, 60_000_000)        # 60ms relative
+    t0 = _t.monotonic()
+    assert call(wasi, "poll_oneoff", mem, 0, 128, 1, 200) == Errno.SUCCESS
+    assert _t.monotonic() - t0 >= 0.05
+    assert mem.load(200, 4, False) == 1
+    assert mem.load(128, 8, False) == 0x11
+
+
+def test_socket_options_shutdown_and_errors():
+    wasi = WasiModule()
+    wasi.init_wasi()
+    mem = make_mem()
+    assert call(wasi, "sock_open", mem, 0, 1, 0) == Errno.SUCCESS
+    sfd = mem.load(0, 4, False)
+    # SO_REUSEADDR roundtrip (level SOL_SOCKET=0, name REUSEADDR=1)
+    mem.store(8, 4, 1)
+    assert call(wasi, "sock_setsockopt", mem, sfd, 0, 1, 8, 4) \
+        == Errno.SUCCESS
+    assert call(wasi, "sock_getsockopt", mem, sfd, 0, 1, 16, 20) \
+        == Errno.SUCCESS
+    # unknown option name -> NOPROTOOPT, not a crash
+    assert call(wasi, "sock_setsockopt", mem, sfd, 0, 99, 8, 4) \
+        == Errno.NOPROTOOPT
+    # bind via {buf, len} address indirection + listen + shutdown
+    mem.store(24, 4, 48)
+    mem.store(28, 4, 4)
+    mem.store_bytes(48, socket.inet_aton("127.0.0.1"))
+    assert call(wasi, "sock_bind", mem, sfd, 24, 0) == Errno.SUCCESS
+    assert call(wasi, "sock_listen", mem, sfd, 1) == Errno.SUCCESS
+    # operations on a non-socket fd report NOTSOCK/BADF
+    assert call(wasi, "sock_listen", mem, 0, 1) in (
+        Errno.NOTSOCK, Errno.BADF)
+    assert call(wasi, "sock_shutdown", mem, sfd, 3) == Errno.SUCCESS
+    assert call(wasi, "fd_close", mem, sfd) == Errno.SUCCESS
+    # shutdown after close: BADF
+    assert call(wasi, "sock_shutdown", mem, sfd, 3) == Errno.BADF
+
+
+def test_socket_dgram_sendto_recvfrom():
+    wasi = WasiModule()
+    wasi.init_wasi()
+    mem = make_mem()
+    assert call(wasi, "sock_open", mem, 0, 0, 0) == Errno.SUCCESS  # DGRAM
+    a = mem.load(0, 4, False)
+    assert call(wasi, "sock_open", mem, 0, 0, 4) == Errno.SUCCESS
+    b = mem.load(4, 4, False)
+    # bind b to 127.0.0.1:ephemeral via {buf,len} indirection
+    mem.store(24, 4, 48)
+    mem.store(28, 4, 4)
+    mem.store_bytes(48, socket.inet_aton("127.0.0.1"))
+    assert call(wasi, "sock_bind", mem, b, 24, 0) == Errno.SUCCESS
+    assert call(wasi, "sock_getlocaladdr", mem, b, 24, 60, 64) \
+        == Errno.SUCCESS
+    port = mem.load(64, 4, False)
+    assert port != 0
+    # a -> b datagram via sock_send_to
+    msg = b"dgram!"
+    mem.store_bytes(100, msg)
+    mem.store(80, 4, 100)
+    mem.store(84, 4, len(msg))
+    assert call(wasi, "sock_send_to", mem, a, 80, 1, 24, port, 0, 88) \
+        == Errno.SUCCESS
+    assert mem.load(88, 4, False) == len(msg)
+    mem.store(120, 4, 140)
+    mem.store(124, 4, 32)
+    # recv_from: (fd, iovs, iovs_len, addr_ptr, flags, nread, roflags)
+    mem.store(160, 4, 192)
+    mem.store(164, 4, 16)
+    assert call(wasi, "sock_recv_from", mem, b, 120, 1, 160, 0, 128,
+                132) == Errno.SUCCESS
+    got = bytes(mem.load_bytes(140, mem.load(128, 4, False)))
+    assert got == msg
+    call(wasi, "fd_close", mem, a)
+    call(wasi, "fd_close", mem, b)
