@@ -257,6 +257,17 @@ class TestProgramParity:
         # lanes: ok, ok, sig mismatch, null, undefined
         self._compare(b.build(), "dispatch", [0, 1, 2, 3, 99])
 
+    def test_call_indirect_empty_table(self):
+        # ADVICE r2: size-0 table made u_lt(b-1, v0) underflow so no index
+        # was ever UndefinedElement; every index must trap
+        b = ModuleBuilder()
+        b.add_table("funcref", 0)
+        ti = b.add_type([], ["i32"])
+        b.add_function(["i32"], ["i32"], [], [
+            ("local.get", 0), ("call_indirect", ti, 0),
+        ], export="dispatch")
+        self._compare(b.build(), "dispatch", [0, 1, -1, 99])
+
     def test_globals_and_memory(self):
         b = ModuleBuilder()
         b.add_memory(1, 2)

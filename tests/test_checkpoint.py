@@ -71,3 +71,38 @@ def test_checkpoint_refuses_wrong_image(tmp_path):
     other_geom = BatchEngine(inst, store=store, conf=conf, lanes=16)
     with pytest.raises(ValueError, match="geometry"):
         load(ckpt, other_geom)
+
+
+def test_checkpoint_refuses_corrupt_control_planes(tmp_path):
+    # ADVICE r2: the image hash proved provenance but the restored control
+    # planes were trusted verbatim — a crafted npz with wild pc/fp/sp
+    # wrap-indexed other frames' rows instead of being refused.
+    import io
+    import json
+
+    eng = make(build_fib())
+    state = eng.initial_state(eng.inst.exports["fib"][1],
+                              [np.full(16, 9, np.int64)])
+    state, total = eng.run_from_state(state, 0, 300)
+    ckpt = tmp_path / "c.ckpt"
+    save(ckpt, eng, state, total)
+
+    def tamper(plane, vals):
+        with np.load(ckpt, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files if k != "meta"}
+            meta = str(z["meta"])
+        bad = arrays[f"state_{plane}"].copy()
+        bad[..., 0] = vals
+        arrays[f"state_{plane}"] = bad
+        buf = io.BytesIO()
+        np.savez_compressed(buf, meta=meta, **arrays)
+        p = tmp_path / f"bad_{plane}.ckpt"
+        p.write_bytes(buf.getvalue())
+        return p
+
+    for plane, vals in (("pc", -1), ("pc", 10 ** 6), ("fp", -3),
+                        ("sp", 10 ** 6), ("call_depth", -1),
+                        ("mem_pages", 10 ** 6), ("trap", -77)):
+        fresh = make(build_fib())
+        with pytest.raises(ValueError, match="refused"):
+            load(tamper(plane, vals), fresh)

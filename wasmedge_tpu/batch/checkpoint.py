@@ -97,4 +97,57 @@ def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:
         fields = {}
         for name in BatchState._fields:
             fields[name] = jnp.asarray(z[f"state_{name}"])
+        _validate_planes(fields, engine)
     return BatchState(**fields), meta["total_steps"]
+
+
+def _validate_planes(fields, engine: BatchEngine):
+    """Refuse control planes a crafted npz could use to misexecute.
+
+    The image hash/geometry checks above prove provenance of the *code*;
+    this proves the restored *control state* stays inside it: device
+    gathers clip silently and host-side outcall serving does raw numpy
+    indexing with fp/opbase, so negative or oversized values would
+    wrap-index into other frames' rows instead of trapping."""
+    from wasmedge_tpu.batch.image import CLS_HOSTCALL, TRAP_HOSTCALL
+
+    cfg = engine.cfg
+    img = engine.img
+    D = cfg.value_stack_depth
+    CD = cfg.call_stack_depth
+    pc = np.asarray(fields["pc"])
+    sp = np.asarray(fields["sp"])
+    fp = np.asarray(fields["fp"])
+    ob = np.asarray(fields["opbase"])
+    cd = np.asarray(fields["call_depth"])
+    pages = np.asarray(fields["mem_pages"])
+    trap = np.asarray(fields["trap"])
+    # the TRAP_HOSTCALL sentinel re-enters host serving on resume: it is
+    # only legitimate when the lane really sits at a hostcall stub,
+    # otherwise a crafted file triggers a host call the code never made
+    at_stub = img.cls[np.clip(pc, 0, img.code_len - 1)] == CLS_HOSTCALL
+    checks = [
+        ("pc", (pc >= 0) & (pc < img.code_len)),
+        ("stack pointers", (fp >= 0) & (fp <= ob) & (ob <= sp) & (sp <= D)),
+        ("call_depth", (cd >= 0) & (cd <= CD)),
+        ("mem_pages", (pages >= 0) & (pages <= max(img.mem_pages_max, 0))),
+        ("trap", (trap >= TRAP_HOSTCALL) & (trap < 256)
+         & ((trap != TRAP_HOSTCALL) | at_stub)),
+    ]
+    # live call frames (rows < call_depth) feed RETURN's pc/fp/opbase pops
+    # and host-side numpy indexing verbatim — same exposure as the top row
+    live = np.arange(CD)[:, None] < cd[None, :]
+    fr_pc = np.asarray(fields["fr_ret_pc"])
+    fr_fp = np.asarray(fields["fr_fp"])
+    fr_ob = np.asarray(fields["fr_opbase"])
+    checks += [
+        ("frame ret_pc", ~live | ((fr_pc >= 0) & (fr_pc < img.code_len))),
+        ("frame fp/opbase", ~live | ((fr_fp >= 0) & (fr_fp <= fr_ob)
+                                     & (fr_ob <= D))),
+    ]
+    for name, ok in checks:
+        if not bool(np.all(ok)):
+            lane = int(np.argmin(np.all(ok, axis=0) if ok.ndim == 2 else ok))
+            raise ValueError(
+                f"checkpoint refused: {name} plane out of range "
+                f"(first bad lane {lane})")

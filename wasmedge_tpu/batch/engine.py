@@ -585,10 +585,12 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         is_callany = is_call | is_calli
         # per-instruction table window: b = size, c = base (multi-tenant
         # concatenated tables)
-        ti = c + jnp.clip(v0_lo, 0, b - 1)
+        ti = c + jnp.clip(v0_lo, 0, jnp.maximum(b - 1, 0))
         ti = jnp.clip(ti, 0, table0.shape[0] - 1)
         t_h = table0[ti]
-        ti_oob = is_calli & (u_lt(b - 1, v0_lo) | (v0_lo < 0))
+        # unsigned idx < size (never size-1 arithmetic: b == 0 — an empty
+        # table — must always be UndefinedElement, not an underflow)
+        ti_oob = is_calli & ~u_lt(v0_lo, b)
         ti_null = is_calli & ~ti_oob & (t_h == 0)
         callee = jnp.where(is_calli, jnp.clip(t_h - 1, 0, f_entry.shape[0] - 1),
                            jnp.clip(a, 0, f_entry.shape[0] - 1))
@@ -969,4 +971,10 @@ class BatchEngine:
                 break
             if int(done_steps) == 0:
                 break
+        # Never leak the internal TRAP_HOSTCALL sentinel to callers: if the
+        # step budget ran out with lanes parked at a stub, serve those
+        # pending calls once — the lanes come back as trap == 0 ("still
+        # running when max_steps ran out"), the documented semantic.
+        if (np.asarray(state.trap) == TRAP_HOSTCALL).any():
+            state = serve_batch_state(self, state)
         return state, total

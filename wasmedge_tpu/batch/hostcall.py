@@ -29,7 +29,13 @@ MASK32 = 0xFFFFFFFF
 
 
 class _LaneMemory(MemoryInstance):
-    """MemoryInstance view over one lane's column of the [W, lanes] plane."""
+    """MemoryInstance view over one lane's column of the [W, lanes] plane.
+
+    `page_limit` must be the plane's static capacity (img.mem_pages_max):
+    a host function growing memory mid-outcall then stays inside the
+    [W, lanes] allocation, and the serving loop writes the new page count
+    back into the state's mem_pages plane (`pages` is derived from the
+    bytearray length, so growth is visible to the caller)."""
 
     def __init__(self, data: bytearray, max_pages: Optional[int],
                  page_limit: int):
@@ -47,9 +53,9 @@ def lane_memory_bytes(mem_plane: np.ndarray, lane: int, pages: int) -> bytearray
 
 
 def store_lane_memory(mem_plane: np.ndarray, lane: int, data: bytearray):
-    nwords = (len(data) + 3) // 4
-    raw = np.frombuffer(bytes(data) + b"\x00" * (nwords * 4 - len(data)),
-                        dtype=np.int32)
+    nwords = min((len(data) + 3) // 4, mem_plane.shape[0])
+    raw = np.frombuffer(bytes(data) + b"\x00" * 3, dtype=np.int32,
+                        count=nwords)
     mem_plane[:nwords, lane] = raw
 
 
@@ -81,7 +87,7 @@ def serve_batch_state(engine, state):
     fp = np.asarray(state.fp)
     opbase = np.asarray(state.opbase)
     sp = np.asarray(state.sp).copy()
-    pages = np.asarray(state.mem_pages)
+    pages = np.asarray(state.mem_pages).copy()
     stack_lo = np.asarray(state.stack_lo).copy()
     stack_hi = np.asarray(state.stack_hi).copy()
     has_mem = img.has_memory
@@ -104,7 +110,7 @@ def serve_batch_state(engine, state):
         if has_mem:
             lane_mem = _LaneMemory(
                 lane_memory_bytes(mem_plane, lane, int(pages[lane])),
-                max_pages, int(pages[lane]))
+                max_pages, img.mem_pages_max)
         out, code = serve_one(fi, args, lane_mem)
         if code:
             new_trap[lane] = code
@@ -116,6 +122,7 @@ def serve_batch_state(engine, state):
         sp[lane] = ob + len(out)
         if has_mem:
             store_lane_memory(mem_plane, lane, lane_mem.data)
+            pages[lane] = lane_mem.pages  # host fn may have grown memory
         new_trap[lane] = 0
         new_pc[lane] = pc[lane] + 1  # resume at the stub's RETURN
 
@@ -126,4 +133,5 @@ def serve_batch_state(engine, state):
     )
     if has_mem:
         kw["mem"] = jnp.asarray(mem_plane)
+        kw["mem_pages"] = jnp.asarray(pages)
     return state._replace(**kw)

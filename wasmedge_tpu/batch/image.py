@@ -248,6 +248,12 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         table0 = np.zeros(1, np.int32)
     else:
         table0 = np.asarray(table0, np.int32)
+    # call_indirect's bounds check uses the instruction's `b` (true size);
+    # the array itself is padded so a declared-but-empty table still
+    # yields a gatherable plane (the padding slot is null and unreachable)
+    table_size = len(table0)
+    if table_size == 0:
+        table0 = np.zeros(1, np.int32)
 
     i32_bin = {NAME_TO_ID[f"i32.{s}"]: ALU2_I32_BASE + i
                for i, s in enumerate(_I32_BIN)}
@@ -292,7 +298,7 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
             # base/size in the instruction keep multi-tenant concatenated
             # tables addressable per lane (batch/multitenant.py)
             cls[pc], a[pc] = CLS_CALL_INDIRECT, _dense_type(ia)
-            b[pc] = len(table0)
+            b[pc] = table_size
             c[pc] = 0
         elif op in consts:
             cls[pc] = CLS_CONST

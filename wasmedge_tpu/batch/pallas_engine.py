@@ -568,8 +568,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             i0 = scal(idx)
             agree = allsame(idx, i0)
             tb_size, tb_base = b_r[pc], c_r[pc]
-            oob = u_lt(tb_size - 1, i0) | (i0 < 0)
-            h = tbl_r[jnp.clip(tb_base + jnp.clip(i0, 0, tb_size - 1),
+            oob = ~u_lt(i0, tb_size)  # unsigned; tb_size == 0 always oob
+            h = tbl_r[jnp.clip(tb_base + jnp.clip(i0, 0,
+                                                  jnp.maximum(tb_size - 1, 0)),
                                0, tsize - 1)]
             null = h == 0
             callee = jnp.clip(h - 1, 0, nf - 1)
@@ -1155,6 +1156,9 @@ class PallasUniformEngine:
         self._tables = None
         self._blk_cap = None  # lane-block ceiling (multi-tenant alignment)
         self.fell_back_to_simt = False
+        # per-lane page counts recorded when a host outcall grows memory
+        # (block ctrl keeps one uniform count; growth diverges the block)
+        self._pages_override = {}
         self.ineligible_reason = self._eligibility()
 
     # -- geometry / eligibility -------------------------------------------
@@ -1356,6 +1360,7 @@ class PallasUniformEngine:
         if self._fn is None:
             self._build()
         state = self._from_simt_state(simt_state)
+        self._pages_override = {}
         state, steps_per_block, statuses = self._drive(state, max_steps)
         fell_back = (statuses == ST_DIVERGED).any()
         self.fell_back_to_simt = bool(fell_back)
@@ -1406,6 +1411,10 @@ class PallasUniformEngine:
         def lanes_of(col):
             return np.repeat(ctrl[:, col].astype(np.int32), Lblk)
 
+        pages_v = lanes_of(_C_PAGES)
+        for b, arr in self._pages_override.items():
+            pages_v[b * Lblk:(b + 1) * Lblk] = arr
+
         trap_v = merge_block_status_into_trap(
             np.asarray(state[7])[0].copy(), ctrl, Lblk)
         fr = np.zeros((3, CD_s, L), np.int32)
@@ -1425,7 +1434,7 @@ class PallasUniformEngine:
             fuel=jnp.asarray(
                 np.maximum(fuel0 - retired, 1).astype(np.int32)
                 if fuel0 else np.zeros(L, np.int32)),
-            mem_pages=jnp.asarray(lanes_of(_C_PAGES)),
+            mem_pages=jnp.asarray(pages_v),
             stack_lo=jnp.asarray(pad_rows(state[2], D_s)),
             stack_hi=jnp.asarray(pad_rows(state[3], D_s)),
             fr_ret_pc=jnp.asarray(fr[0]), fr_fp=jnp.asarray(fr[1]),
@@ -1448,6 +1457,7 @@ class PallasUniformEngine:
             self._build()
         state = self._initial_state(func_idx, args_lanes)
         self.fell_back_to_simt = False
+        self._pages_override = {}
         state, steps_per_block, statuses = self._drive(state, max_steps)
         total = int(steps_per_block.max())
         if (statuses == ST_DIVERGED).any():
@@ -1512,6 +1522,7 @@ class PallasUniformEngine:
             res_hi = np.zeros((max(nres, 1), Lblk), np.int32)
             trap_codes = np.zeros(Lblk, np.int32)
             pages = int(ctrl[b, _C_PAGES])
+            new_pages = np.full(Lblk, pages, np.int32)
             for li, lane in enumerate(lanes):
                 args = []
                 for i in range(nargs):
@@ -1522,7 +1533,7 @@ class PallasUniformEngine:
                 if has_mem:
                     lane_mem = _LaneMemory(
                         lane_memory_bytes(mem_np, lane, pages),
-                        max_pages, pages)
+                        max_pages, img.mem_pages_max)
                 out, code = serve_one(fi, args, lane_mem)
                 if code:
                     trap_codes[li] = code
@@ -1533,16 +1544,21 @@ class PallasUniformEngine:
                         np.uint32((cell >> 32) & 0xFFFFFFFF))
                 if has_mem:
                     store_lane_memory(mem_np, lane, lane_mem.data)
-            if trap_codes.any():
-                # Per-lane trap outcomes: record the codes, re-arm the
-                # block at pc+1 with the served lanes' results applied
-                # (their host calls MUST NOT re-run), then hand off to
-                # the SIMT engine, which masks dead lanes per-lane.
+                    new_pages[li] = lane_mem.pages
+            grew = (new_pages != pages) & (trap_codes == 0)
+            if trap_codes.any() or grew.any():
+                # Per-lane outcomes (trap codes, or memory growth that
+                # diverges the block's single page count): record them,
+                # re-arm the block at pc+1 with the served lanes' results
+                # applied (their host calls MUST NOT re-run), then hand
+                # off to the SIMT engine, which is per-lane throughout.
                 trap_plane = np.asarray(state[7]).copy()
                 seg = trap_plane[0, b * Lblk:(b + 1) * Lblk]
                 seg[:] = np.where(trap_codes != 0, trap_codes, seg)
                 trap_plane[0, b * Lblk:(b + 1) * Lblk] = seg
                 state[7] = jnp.asarray(trap_plane)
+                if grew.any():
+                    self._pages_override[int(b)] = new_pages.copy()
                 if (trap_codes != 0).all() and \
                         len(set(trap_codes.tolist())) == 1:
                     ctrl[b, _C_STATUS] = ST_TRAPPED_BASE + int(trap_codes[0])

@@ -381,8 +381,9 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
         i0 = idx[0]
         agree = jnp.all(idx == i0)
         tsize = table0.shape[0]
-        oob = u_lt(b - 1, i0) | (i0 < 0)
-        h = table0[jnp.clip(c + jnp.clip(i0, 0, b - 1), 0, tsize - 1)]
+        oob = ~u_lt(i0, b)  # unsigned idx < size; b == 0 is always oob
+        h = table0[jnp.clip(c + jnp.clip(i0, 0, jnp.maximum(b - 1, 0)),
+                            0, tsize - 1)]
         null = h == 0
         callee = jnp.clip(h - 1, 0, f_entry.shape[0] - 1)
         sig_bad = f_type[callee] != a
@@ -677,7 +678,10 @@ class UniformBatchEngine:
             import jax
 
             use = jax.default_backend() == "tpu"
-        if not use:
+        # cfg.interpret=True is an opt-in to the Pallas interpret path even
+        # when use_pallas is unset/False (same knob semantics as
+        # MultiTenantBatchEngine._try_pallas)
+        if not use and not self.cfg.interpret:
             return None
         from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
 
