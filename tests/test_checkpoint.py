@@ -78,7 +78,6 @@ def test_checkpoint_refuses_corrupt_control_planes(tmp_path):
     # planes were trusted verbatim — a crafted npz with wild pc/fp/sp
     # wrap-indexed other frames' rows instead of being refused.
     import io
-    import json
 
     eng = make(build_fib())
     state = eng.initial_state(eng.inst.exports["fib"][1],

@@ -207,3 +207,40 @@ def test_hostcall_mixed_traps_no_duplicate_calls():
     assert res.trap[3] == int(ErrCode.ExecutionFailed)
     ok = [i for i in range(LANES) if i != 3]
     assert (res.results[0][ok] == np.arange(LANES)[ok]).all()
+
+
+def test_hostcall_grow_beyond_watermark_fails_cleanly():
+    """A host function growing memory past the pallas watermark plane
+    must get -1 (clean failure), never silent truncation of its writes
+    (the plane holds mem_pages_init pages; grown-page bytes would be
+    dropped by store_lane_memory)."""
+    from wasmedge_tpu.common.configure import Configure
+
+    imp = ImportObject("env")
+    grow_results = []
+
+    def grow_and_write(mem, _x):
+        r = mem.grow(1)
+        grow_results.append(r)
+        mem.store(64, 4, 0x1234)      # write within the existing page
+        if r >= 0:
+            mem.store(65536, 4, 0xABCD)   # write into the grown page
+        return 1 if r >= 0 else 0
+
+    imp.add_func("gw", PyHostFunction(grow_and_write, ["i32"], ["i32"]))
+    b = ModuleBuilder()
+    b.import_func("env", "gw", ["i32"], ["i32"])
+    b.add_memory(1, 3)   # declared max 3 > watermark capacity 1
+    b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("call", 0),
+        ("i32.const", 64), ("i32.load", 2, 0), "i32.add",
+    ], export="f")
+    conf = Configure()
+    conf.batch.memory_pages_per_lane = 3
+    ex, store, inst, eng = make_batch(b.build(), [imp], conf=conf,
+                                      pallas=True)
+    res = eng.run("f", [np.zeros(LANES, np.int64)], max_steps=10_000)
+    assert (res.trap == -1).all()
+    # grow failed cleanly on every lane; the in-page write survived
+    assert all(r == -1 for r in grow_results)
+    assert (res.results[0] == 0x1234).all()

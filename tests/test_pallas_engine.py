@@ -296,7 +296,7 @@ def test_bulk_memory_oob_lanes():
     assert res.trap[1] == int(ErrCode.MemoryOutOfBounds)
 
 
-def test_fill_stays_on_pallas_copy_falls_back():
+def test_fill_and_copy_stay_on_pallas():
     b = ModuleBuilder()
     b.add_memory(1, 1)
     b.add_function(("i32",), ("i32",), (),
@@ -316,4 +316,84 @@ def test_fill_stays_on_pallas_copy_falls_back():
                      ("i32.const", 32), ("i32.load", 0, 2)], export="cp")
     eng2, res2 = check_parity(b2.build(), "cp",
                               [np.full(LANES, 0xBEEF, np.int64)])
-    assert eng2.fell_back_to_simt  # copy hands off to SIMT
+    assert not eng2.fell_back_to_simt  # uniform-delta copy runs in-kernel
+
+
+def test_memcopy_unaligned_overlap_in_kernel():
+    # per-lane dst with a uniform (src - dst) delta, including overlapping
+    # forward and backward moves and sub-word byte shifts
+    for delta in (5, -5, 3, -3, 64, -64, 1, 0):
+        dsts = np.array([100 + k for k in range(LANES)], np.int64)
+        srcs = dsts + delta
+        ns = np.array([1, 2, 3, 4, 7, 9, 16, 31], np.int64)
+        b3 = ModuleBuilder()
+        b3.add_memory(1, 1)
+        body = []
+        for i in range(0, 128, 4):
+            body += [("i32.const", i),
+                     ("i32.const", (i * 0x01010101 + 0x0F1E2D3C) & 0x7FFFFFFF),
+                     ("i32.store", 2, 0)]
+        body += [("local.get", 0), ("local.get", 1), ("local.get", 2),
+                 ("memory.copy",),
+                 ("local.get", 0), ("i32.load", 0, 0)]
+        b3.add_function(("i32", "i32", "i32"), ("i32",), (), body,
+                        export="cp")
+        eng, res = check_parity(b3.build(), "cp", [dsts, srcs, ns])
+        assert not eng.fell_back_to_simt, f"delta {delta} fell back"
+
+
+def test_memcopy_divergent_delta_falls_back():
+    b = ModuleBuilder()
+    b.add_memory(1, 1)
+    b.add_function(("i32", "i32"), ("i32",), (),
+                   [("i32.const", 0), ("i32.const", 0x11223344),
+                    ("i32.store", 2, 0),
+                    ("i32.const", 64), ("i32.const", 0x55667788),
+                    ("i32.store", 2, 0),
+                    ("local.get", 0), ("local.get", 1), ("i32.const", 4),
+                    ("memory.copy",),
+                    ("local.get", 0), ("i32.load", 0, 2)], export="cp")
+    dsts = np.array([128, 128, 132, 132, 136, 140, 144, 148], np.int64)
+    srcs = np.array([0, 64, 0, 64, 0, 64, 0, 64], np.int64)  # mixed deltas
+    eng, res = check_parity(b.build(), "cp", [dsts, srcs])
+    assert eng.fell_back_to_simt
+
+
+def test_fuel_on_pallas_path():
+    # fuel metering now runs in the kernel carry: the block trap is
+    # CostLimitExceeded and the engine stays on the fast path
+    conf = Configure()
+    conf.batch.fuel_per_launch = 1000
+    ex, store, inst, eng = make_engine(build_fib(), conf=conf)
+    assert eng.eligible, eng.ineligible_reason
+    res = eng.run("fib", [np.full(LANES, 25, np.int64)], max_steps=500_000)
+    assert (res.trap == int(ErrCode.CostLimitExceeded)).all()
+
+    conf2 = Configure()
+    conf2.batch.fuel_per_launch = 10_000_000
+    ex, store, inst, eng2 = make_engine(build_fib(), conf=conf2)
+    res2 = eng2.run("fib", [np.full(LANES, 10, np.int64)],
+                    max_steps=500_000)
+    assert (res2.trap == -1).all()
+    s_ex, s_store, s_inst = instantiate(build_fib(), Configure())
+    expect = scalar_call(s_ex, s_store, s_inst, "fib", [10])
+    assert int(res2.results[0][0]) == expect[0]
+
+
+def test_memgrow_regrow_beyond_watermark():
+    # init 1 page, declared max 3: the watermark plane holds 1 page, so a
+    # legal grow to 2 pages must leave the kernel (ST_REGROW) and finish
+    # on the SIMT engine with the right result
+    conf = Configure()
+    conf.batch.memory_pages_per_lane = 3
+    b = ModuleBuilder()
+    b.add_memory(1, 3)
+    b.add_function((), ("i32",), (),
+                   [("i32.const", 1), ("memory.grow",), "drop",
+                    ("i32.const", 70000), ("i32.const", 0xCAFE),
+                    ("i32.store", 2, 0),
+                    ("i32.const", 70000), ("i32.load", 0, 2),
+                    "drop",
+                    ("memory.size",)], export="g")
+    eng, res = check_parity(b.build(), "g", [], conf=conf)
+    assert eng.fell_back_to_simt  # regrow handled by the big-plane engine

@@ -137,7 +137,10 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         globals_lo=np.concatenate(g_lo_parts),
         globals_hi=np.concatenate(g_hi_parts),
         mem_init=np.zeros(1, np.int32),       # per-lane init in the engine
-        mem_pages_init=0,                     # per-lane (initial_state)
+        # watermark sizing reads mem_pages_init; cover every tenant's
+        # initial pages (per-lane counts come from initial_state)
+        mem_pages_init=max((t.img.mem_pages_init for t in tenants
+                            if t.img.has_memory), default=0),
         mem_pages_max=max((t.img.mem_pages_max for t in tenants
                            if t.img.has_memory), default=0),
         has_memory=any(t.img.has_memory for t in tenants),

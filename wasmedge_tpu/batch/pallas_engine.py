@@ -142,13 +142,23 @@ ST_RUNNING = 0
 ST_DONE = 1
 ST_DIVERGED = 2
 ST_HOSTCALL = 3  # block parked at a host outcall stub
+# memory.grow needs more rows than the watermark-sized plane holds: the
+# grow is legal (<= declared max) but the kernel geometry is too small.
+# The block stops un-advanced; the host re-executes on an engine with a
+# bigger plane (SIMT today; a re-geometried kernel when the scheduler
+# learns to migrate).  Watermark sizing is SURVEY §5.7's design: the
+# plane covers *current* pages, not the declared max, so a module that
+# declares max=16 pages but touches one page keeps a VMEM-sized state.
+ST_REGROW = 4
 ST_TRAPPED_BASE = 16
 
 _PAGE_WORDS = 65536 // 4
+_FUEL_OFF = 0x7FFFFFFF  # fuel column value when gas metering is disabled
 
 # ctrl row layout (SMEM, int32[nblk, 16])
 _C_PC, _C_SP, _C_FP, _C_OB, _C_CD, _C_STATUS, _C_PAGES, _C_CHUNK = range(8)
 _C_STEPS = 8
+_C_FUEL = 9
 
 
 def merge_block_status_into_trap(trap_v: np.ndarray, ctrl: np.ndarray,
@@ -289,7 +299,7 @@ _DIVS_SUBS = {ALU2_I32_BASE + _I32_BIN.index("div_s"),
 def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                   Lblk: int, NG: int, code_len: int, nf: int, tsize: int,
                   max_local_zeros: int, mem_pages_cap: int,
-                  gatherable: bool, interpret: bool):
+                  mem_pages_hard: int, gatherable: bool, interpret: bool):
     """Compile the chunk-runner for one kernel geometry.
 
     Returns a jitted callable over
@@ -362,6 +372,14 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         lax.fori_loop(0, CD, cp_frame, 0)
 
         chunk = ctrl_r[blk, _C_CHUNK]
+        # per-block fuel (gas analog, block-uniform like all control state);
+        # _FUEL_OFF disables.  The loop stops at the fuel boundary and the
+        # post-loop check below converts exhaustion into CostLimitExceeded —
+        # same per-instruction decrement semantics as the SIMT engine's
+        # per-lane fuel plane (superinstructions may overshoot by their
+        # fused length, <= 3 wasm instructions).
+        fuel_in = ctrl_r[blk, _C_FUEL]
+        chunk_eff = jnp.minimum(chunk, fuel_in)
 
         def full(v):
             return jnp.full((1, Lblk), v, I32)
@@ -602,19 +620,26 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             delta = srow(slo, sp - 1)
             d0 = scal(delta)
             agree = allsame(delta, d0)
-            ok = (d0 >= 0) & ((pages + d0) <= mem_pages_cap) & \
+            legal = (d0 >= 0) & ((pages + d0) <= mem_pages_hard) & \
                 ((pages + d0) >= pages)
-            res = jnp.where(ok, pages, I32(-1))
+            # legal but beyond the watermark plane: stop un-advanced so the
+            # host re-executes on a bigger-plane engine (ST_REGROW)
+            fits = legal & ((pages + d0) <= mem_pages_cap)
+            res = jnp.where(legal, pages, I32(-1))
+            settled = fits | ~legal
 
-            @pl.when(agree)
+            @pl.when(agree & settled)
             def _():
                 wrow(slo, sp - 1, full(res))
                 wrow(shi, sp - 1, full(0))
 
             return lax.cond(
                 agree,
-                lambda: keep(c, pc=pc + 1,
-                             pages=jnp.where(ok, pages + d0, pages)),
+                lambda: lax.cond(
+                    settled,
+                    lambda: keep(c, pc=pc + 1,
+                                 pages=jnp.where(fits, pages + d0, pages)),
+                    lambda: keep(c, status=I32(ST_REGROW))),
                 lambda: keep(c, status=I32(ST_DIVERGED)))
 
         def h_trap(c):
@@ -665,9 +690,83 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 lambda: keep(c, pc=pc + 1, sp=sp - 3))
 
         def h_memcopy(c):
-            # per-lane byte gather is unavailable in-kernel; hand off
-            # un-advanced so the SIMT engine executes the copy
-            return keep(c, status=I32(ST_DIVERGED))
+            # In-kernel memmove when every lane agrees on (src - dst): the
+            # byte shift between source and destination is then a scalar,
+            # so each destination row is two shifted source rows under the
+            # same per-lane byte masks h_memfill uses.  Row order follows
+            # the copy direction (backward when dst > src) for overlap
+            # correctness — the same memmove discipline as the reference's
+            # std::memmove in runDataCopy.  Per-lane divergent deltas (one
+            # lane copying up, another down) hand off un-advanced.
+            pc, sp, pages = c[1], c[2], c[6]
+            n = srow(slo, sp - 1)
+            src = srow(slo, sp - 2)
+            dst = srow(slo, sp - 3)
+            mem_bytes = pages * I32(65536)
+            send = src + n
+            dend = dst + n
+            oob = u_lt(send, src) | u_lt(full(mem_bytes), send) | \
+                u_lt(dend, dst) | u_lt(full(mem_bytes), dend)
+            delta = src - dst
+            live = (~oob) & (n != 0)
+            # lanes with nothing to copy don't constrain the shift
+            d_eff = jnp.where(live, delta, I32(0x7FFFFFFF))
+            d0 = jnp.min(d_eff)
+            agree = jnp.all(jnp.where(live, delta, d0) == d0)
+            any_live = jnp.any(live)
+            d0 = jnp.where(any_live, d0, I32(0))
+
+            def go():
+                sm = d0 & 3
+                qv = lax.shift_right_arithmetic(d0 - sm, 2)
+                shB = sm * 8
+                inv = (32 - shB) & 31
+                hi_or = jnp.where(shB == 0, 0, -1)
+                dst_ok = jnp.where(live, dst, I32(0x7FFFFFFF))
+                dend_ok = jnp.where(live, dend, I32(0))
+                row_lo = lax.shift_right_logical(jnp.min(dst_ok), 2)
+                row_hi = lax.shift_right_logical(jnp.max(dend_ok) + 3, 2)
+                row_lo = jnp.minimum(row_lo, I32(W))
+                row_hi = jnp.minimum(row_hi, I32(W))
+                nrows = jnp.maximum(row_hi - row_lo, 0)
+                fwd = d0 >= 0
+
+                def body(i, _):
+                    r = jnp.where(fwd, row_lo + i, row_hi - 1 - i)
+                    m0 = srow(memr, jnp.clip(r + qv, 0, W - 1))
+                    m1 = srow(memr, jnp.clip(r + qv + 1, 0, W - 1))
+                    val = lax.shift_right_logical(m0, shB) | \
+                        (lax.shift_left(m1, inv) & hi_or)
+                    mask = jnp.zeros((1, Lblk), I32)
+                    for bpos in range(4):
+                        ba = full(r * 4 + bpos)
+                        inr = (~u_lt(ba, dst)) & u_lt(ba, dend)
+                        mask = mask | jnp.where(
+                            inr & live, jnp.int32(lo_ops.BYTE_MASKS[bpos]),
+                            0)
+                    old = srow(memr, jnp.clip(r, 0, W - 1))
+                    wrow(memr, jnp.clip(r, 0, W - 1),
+                         jnp.where(mask != 0, (old & ~mask) | (val & mask),
+                                   old))
+                    return 0
+
+                lax.fori_loop(0, nrows, body, 0)
+                any_oob = jnp.any(oob)
+
+                @pl.when(any_oob)
+                def _():
+                    trapr[0, :] = jnp.where(
+                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
+                        trapr[0, :])
+
+                return lax.cond(
+                    any_oob,
+                    lambda: keep(c, pc=pc + 1, sp=sp - 3,
+                                 status=I32(ST_DIVERGED)),
+                    lambda: keep(c, pc=pc + 1, sp=sp - 3))
+
+            return lax.cond(agree, go,
+                            lambda: keep(c, status=I32(ST_DIVERGED)))
 
         def h_hostcall(c):
             # park the block; the host serves every lane then re-arms at
@@ -1038,7 +1137,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         handlers = [handler_for(h) for h in used_hids]
 
         def cond(c):
-            return (c[0] < chunk) & (c[7] == ST_RUNNING)
+            return (c[0] < chunk_eff) & (c[7] == ST_RUNNING)
 
         def body(c):
             pc = jnp.clip(c[1], 0, code_len - 1)
@@ -1052,6 +1151,19 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 ctrl_r[blk, _C_PAGES], ctrl_r[blk, _C_STATUS])
         steps, pc, sp, fp, ob, cd, pages, status = \
             lax.while_loop(cond, body, init)
+        exhausted = (status == I32(ST_RUNNING)) & (steps >= fuel_in)
+        status = jnp.where(
+            exhausted,
+            I32(ST_TRAPPED_BASE) + I32(int(ErrCode.CostLimitExceeded)),
+            status)
+
+        @pl.when(exhausted)
+        def _():
+            trapr[0, :] = jnp.where(trapr[0, :] == 0,
+                                    I32(int(ErrCode.CostLimitExceeded)),
+                                    trapr[0, :])
+
+        ctrl_out[blk, _C_FUEL] = fuel_in - steps
         ctrl_out[blk, _C_PC] = pc
         ctrl_out[blk, _C_SP] = sp
         ctrl_out[blk, _C_FP] = fp
@@ -1179,10 +1291,15 @@ class PallasUniformEngine:
         return self.cfg.value_stack_depth, self.cfg.call_stack_depth
 
     def _mem_words(self):
+        # Watermark sizing (SURVEY §5.7): the VMEM plane covers *current*
+        # pages, not the declared max — a module declaring max=16 pages
+        # but touching one keeps a small state and a big lane block.
+        # memory.grow beyond this capacity (but within the declared max)
+        # raises ST_REGROW and the host re-executes on a bigger plane.
         img = self.img
         if not img.has_memory:
             return 1
-        return max(img.mem_pages_max, img.mem_pages_init, 1) * _PAGE_WORDS
+        return max(img.mem_pages_init, 1) * _PAGE_WORDS
 
     def _lane_block(self) -> Optional[int]:
         """Largest power-of-two lane block whose state fits the budget."""
@@ -1212,8 +1329,6 @@ class PallasUniformEngine:
             return f"code too large for SMEM ({img.code_len} instrs)"
         if self.simt.mesh is not None:
             return "mesh sharding handled by SIMT engine"
-        if self.cfg.fuel_per_launch is not None:
-            return "fuel accounting handled by SIMT engine"
         if self._lane_block() is None:
             return (f"state too large for VMEM "
                     f"({self._mem_words()} mem words/lane)")
@@ -1241,17 +1356,22 @@ class PallasUniformEngine:
         used = tuple(sorted(set(int(h) for h in hid)))
         dense = {h: i for i, h in enumerate(used)}
         hid_dense = np.asarray([dense[int(h)] for h in hid], np.int32)
+        # host-side view of the fused encoding: the block scheduler's
+        # divergence splitter evaluates the stopped instruction from these
+        self._np_fused = {"hid": hid, "a": a_p, "b": b_p, "c": c_p,
+                          "ilo": ilo_p, "ihi": ihi_p}
         D, CD = self._depths()
         W = self._mem_words()
         NG = img.globals_lo.shape[0]
         Lblk = self._lane_block()
-        pages_cap = min(max(img.mem_pages_max, img.mem_pages_init),
-                        W // _PAGE_WORDS) if img.has_memory else 0
+        pages_cap = W // _PAGE_WORDS if img.has_memory else 0
+        pages_hard = max(img.mem_pages_max, img.mem_pages_init) \
+            if img.has_memory else 0
         self._geom = (D, CD, W, Lblk)
         self._fn = _build_kernel(
             used, D, CD, W, self.lanes, Lblk, NG, img.code_len,
             len(img.f_entry), img.table0.shape[0],
-            img.max_local_zeros, pages_cap,
+            img.max_local_zeros, pages_cap, pages_hard,
             W * Lblk <= self.MAX_GATHER_ELEMS, interpret)
         self._tables = tuple(jnp.asarray(t) for t in (
             hid_dense, a_p, b_p, c_p, ilo_p, ihi_p,
@@ -1296,6 +1416,8 @@ class PallasUniformEngine:
         ctrl[:, _C_OB] = meta.nlocals
         ctrl[:, _C_PAGES] = img.mem_pages_init
         ctrl[:, _C_CHUNK] = self.cfg.steps_per_launch
+        fuel = self.cfg.fuel_per_launch
+        ctrl[:, _C_FUEL] = _FUEL_OFF if fuel is None else fuel
         return [jnp.asarray(ctrl), jnp.zeros((nblk, 3, CD), jnp.int32),
                 jnp.asarray(stack_lo), jnp.asarray(stack_hi),
                 jnp.asarray(glo), jnp.asarray(ghi),
@@ -1322,6 +1444,8 @@ class PallasUniformEngine:
             # live frames would corrupt the first return
             raise ValueError("cannot enter the pallas engine mid-call "
                             "(call_depth != 0)")
+        fuel_v = np.asarray(simt_state.fuel)
+        fuel_on = self.cfg.fuel_per_launch is not None
         ctrl = np.zeros((nblk, 16), np.int32)
         for b in range(nblk):
             sl = slice(b * Lblk, (b + 1) * Lblk)
@@ -1333,6 +1457,20 @@ class PallasUniformEngine:
                         f"block {b} not control-uniform; cannot enter the "
                         f"pallas engine")
                 ctrl[b, col] = seg[0]
+            if fuel_on:
+                seg = fuel_v[sl]
+                if not (seg == seg[0]).all():
+                    raise ValueError(
+                        f"block {b} fuel not uniform; cannot enter the "
+                        f"pallas engine")
+                ctrl[b, _C_FUEL] = seg[0]
+            else:
+                ctrl[b, _C_FUEL] = _FUEL_OFF
+        cap_pages = W // _PAGE_WORDS
+        if self.img.has_memory and (pages > cap_pages).any():
+            raise ValueError(
+                "state has grown beyond the watermark plane; cannot enter "
+                "the pallas engine")
         ctrl[:, _C_CHUNK] = self.cfg.steps_per_launch
         stack_lo = np.asarray(simt_state.stack_lo)[:D]
         stack_hi = np.asarray(simt_state.stack_hi)[:D]
@@ -1362,7 +1500,8 @@ class PallasUniformEngine:
         state = self._from_simt_state(simt_state)
         self._pages_override = {}
         state, steps_per_block, statuses = self._drive(state, max_steps)
-        fell_back = (statuses == ST_DIVERGED).any()
+        fell_back = ((statuses == ST_DIVERGED) |
+                     (statuses == ST_REGROW)).any()
         self.fell_back_to_simt = bool(fell_back)
         return (self._to_simt_state(state, steps_per_block),
                 steps_per_block, bool(fell_back))
@@ -1422,8 +1561,19 @@ class PallasUniformEngine:
         for b in range(nblk):
             fr[:, :ncd, b * Lblk:(b + 1) * Lblk] = \
                 frames[b][:, :ncd, None]
-        fuel0 = cfg.fuel_per_launch if cfg.fuel_per_launch is not None else 0
+        fuel_on = cfg.fuel_per_launch is not None
         retired = np.repeat(np.asarray(steps_per_block, np.int64), Lblk)
+        fuel_v = np.maximum(lanes_of(_C_FUEL), 0) if fuel_on \
+            else np.zeros(L, np.int32)
+        # The SIMT engine's plane is sized by the declared/effective max,
+        # not the watermark — pad rows so grow works over there.
+        mem_np = np.asarray(state[6])
+        simt_w = max(self.img.mem_pages_max * _PAGE_WORDS, 1) \
+            if self.img.has_memory else mem_np.shape[0]
+        if mem_np.shape[0] < simt_w:
+            mem_np = np.concatenate(
+                [mem_np, np.zeros((simt_w - mem_np.shape[0], L), np.int32)],
+                axis=0)
         return BatchState(
             pc=jnp.asarray(lanes_of(_C_PC)), sp=jnp.asarray(lanes_of(_C_SP)),
             fp=jnp.asarray(lanes_of(_C_FP)),
@@ -1431,9 +1581,7 @@ class PallasUniformEngine:
             call_depth=jnp.asarray(lanes_of(_C_CD)),
             trap=jnp.asarray(trap_v),
             retired=jnp.asarray(retired.astype(np.int32)),
-            fuel=jnp.asarray(
-                np.maximum(fuel0 - retired, 1).astype(np.int32)
-                if fuel0 else np.zeros(L, np.int32)),
+            fuel=jnp.asarray(fuel_v.astype(np.int32)),
             mem_pages=jnp.asarray(pages_v),
             stack_lo=jnp.asarray(pad_rows(state[2], D_s)),
             stack_hi=jnp.asarray(pad_rows(state[3], D_s)),
@@ -1441,7 +1589,7 @@ class PallasUniformEngine:
             fr_opbase=jnp.asarray(fr[2]),
             glob_lo=jnp.asarray(np.asarray(state[4])),
             glob_hi=jnp.asarray(np.asarray(state[5])),
-            mem=jnp.asarray(np.asarray(state[6])),
+            mem=jnp.asarray(mem_np),
         )
 
     # -- run --------------------------------------------------------------
@@ -1460,7 +1608,7 @@ class PallasUniformEngine:
         self._pages_override = {}
         state, steps_per_block, statuses = self._drive(state, max_steps)
         total = int(steps_per_block.max())
-        if (statuses == ST_DIVERGED).any():
+        if ((statuses == ST_DIVERGED) | (statuses == ST_REGROW)).any():
             self.fell_back_to_simt = True
             simt_state = self._to_simt_state(state, steps_per_block)
             simt_state, total = self.simt.run_from_state(
@@ -1505,7 +1653,14 @@ class PallasUniformEngine:
         blocks = np.nonzero(ctrl[:, _C_STATUS] == ST_HOSTCALL)[0]
         has_mem = img.has_memory
         mem_np = np.asarray(state[6]).copy() if has_mem else None
-        max_pages = img.mem_pages_max if img.mem_pages_max > 0 else None
+        # Cap host-side growth at the watermark plane's capacity: beyond
+        # it, store_lane_memory would silently truncate the host
+        # function's writes into the grown pages.  A host grow that needs
+        # more than the plane holds fails cleanly (-1) instead —
+        # spec-legal (memory.grow may fail nondeterministically).
+        plane_cap = (mem_np.shape[0] // _PAGE_WORDS) if has_mem else 0
+        max_pages = min(img.mem_pages_max, plane_cap) \
+            if img.mem_pages_max > 0 else (plane_cap or None)
         slo, shi = state[2], state[3]
         for b in blocks:
             pc = int(ctrl[b, _C_PC])
@@ -1533,7 +1688,7 @@ class PallasUniformEngine:
                 if has_mem:
                     lane_mem = _LaneMemory(
                         lane_memory_bytes(mem_np, lane, pages),
-                        max_pages, img.mem_pages_max)
+                        max_pages, plane_cap)
                 out, code = serve_one(fi, args, lane_mem)
                 if code:
                     trap_codes[li] = code
