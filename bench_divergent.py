@@ -1,0 +1,97 @@
+"""Divergent-input benchmark: fib(n) with n varying per lane (20..30).
+
+The round-2 verdict's acid test for divergence handling: the headline
+bench feeds every lane identical arguments (structural convergence), so
+this bench spreads n uniformly over 20..30 across 4096 lanes, shuffled,
+and measures aggregate retired-instruction throughput through the block
+scheduler (entry grouping packs same-n lanes into shared blocks; any
+residual straddle blocks split once at the first differing branch).
+
+Prints ONE JSON line like bench.py; vs_baseline uses the same
+50x-single-core north star.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+LANES = 4096
+N_LO, N_HI = 20, 30
+TARGET_MULTIPLE = 10.0   # VERDICT r2 bar: divergent bench >= 10x one core
+RECORDED_CPP_INTERP_OPS = 150e6
+
+
+def _fib(n):
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+def main():
+    from wasmedge_tpu.batch.uniform import UniformBatchEngine
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.models import build_fib
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    conf = Configure()
+    conf.batch.steps_per_launch = 50_000_000
+    conf.batch.value_stack_depth = 256
+    conf.batch.call_stack_depth = 256
+    mod = Validator(conf).validate(Loader(conf).parse_module(build_fib()))
+    store = StoreManager()
+    inst = Executor(conf).instantiate(store, mod)
+    eng = UniformBatchEngine(inst, store=store, conf=conf, lanes=LANES)
+
+    rng = np.random.default_rng(42)
+    ns = N_LO + (np.arange(LANES, dtype=np.int64) % (N_HI - N_LO + 1))
+    rng.shuffle(ns)
+
+    # warmup: same shape of divergence, small n, to compile all geometries
+    warm = ns - 14
+    eng.run("fib", [warm], max_steps=10_000_000)
+
+    t0 = time.perf_counter()
+    res = eng.run("fib", [ns], max_steps=2_000_000_000)
+    dt = time.perf_counter() - t0
+
+    ok = bool(res.completed.all())
+    expect = np.asarray([_fib(int(n)) for n in ns], np.int64)
+    correct = bool((np.asarray(res.results[0], np.int64) == expect).all())
+    total_retired = float(np.asarray(res.retired, np.float64).sum())
+    agg = total_retired / dt
+
+    try:
+        from wasmedge_tpu.native import scalar_fib_ops_per_sec
+
+        base_ops, base_src = float(scalar_fib_ops_per_sec(30)), \
+            "cpp-scalar-engine"
+    except Exception:
+        base_ops, base_src = RECORDED_CPP_INTERP_OPS, "recorded-estimate"
+    vs = agg / (TARGET_MULTIPLE * base_ops)
+
+    out = {
+        "metric": f"divergent_fib{N_LO}to{N_HI}_wasm_ops_per_sec_x{LANES}",
+        "value": round(agg, 1),
+        "unit": "wasm_instr/s",
+        "ok": ok and correct,
+        "vs_baseline": round(vs, 4),
+        "wall_s": round(dt, 2),
+    }
+    print(json.dumps(out))
+    pallas = getattr(eng, "pallas", None)
+    print(f"# splits={getattr(pallas, 'splits', '?')} "
+          f"fell_back={getattr(eng, 'fell_back_to_simt', '?')} "
+          f"baseline={base_ops:.3g} ({base_src}) target={TARGET_MULTIPLE}x",
+          file=sys.stderr)
+    if not (ok and correct):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

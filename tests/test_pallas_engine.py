@@ -80,11 +80,14 @@ def test_fib_uniform_stays_on_pallas():
     assert res.results[0][0] == 55
 
 
-def test_fib_divergent_args_fall_back():
-    # different n per lane -> control divergence -> SIMT finishes the run
+def test_fib_divergent_args_split_on_kernel():
+    # different n per lane -> control divergence -> the block scheduler
+    # splits blocks at the divergent branch and keeps everything on the
+    # Pallas kernel (no whole-batch SIMT abandonment)
     ns = np.array([3, 5, 8, 2, 9, 4, 7, 6], np.int64)
     eng, res = check_parity(build_fib(), "fib", [ns])
-    assert eng.fell_back_to_simt
+    assert not eng.fell_back_to_simt
+    assert eng.splits > 0
 
 
 def test_fac_i64_uniform():
@@ -127,7 +130,8 @@ def test_div_by_zero_some_lanes_diverges():
     divisors = np.array([0, 1, 2, 3, 0, 5, 6, 7], np.int64)
     eng, res = check_parity(b.build(), "f",
                             [np.full(LANES, 42, np.int64), divisors])
-    assert eng.fell_back_to_simt
+    # the scheduler peels the trapped lanes off; no SIMT pass needed
+    assert not eng.fell_back_to_simt
     assert res.trap[0] == int(ErrCode.DivideByZero)
     assert res.trap[1] == -1
 

@@ -1,0 +1,211 @@
+"""Block-scheduler suite: entry grouping, divergence splits, SIMT residue.
+
+The scheduler (batch/scheduler.py) is what turns the block-uniform Pallas
+kernel into a general engine: lanes with equal inputs share blocks, data
+divergence splits blocks at the stopped instruction, and only genuinely
+per-lane work lands on the SIMT engine.  Every case here checks
+bit-parity against the scalar oracle per lane AND asserts the scheduling
+outcome (stayed-on-kernel / split count / residue use) so regressions in
+either dimension are caught.
+"""
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.common.errors import ErrCode, TrapError
+from wasmedge_tpu.models import build_fib
+from wasmedge_tpu.utils.builder import ModuleBuilder
+from tests.helpers import instantiate
+
+LANES = 32
+
+
+def make_engine(data, lanes=LANES, chunk=50_000, conf=None):
+    from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
+
+    conf = conf or Configure()
+    conf.batch.steps_per_launch = chunk
+    ex, store, inst = instantiate(data, conf)
+    eng = PallasUniformEngine(inst, store=store, conf=conf, lanes=lanes,
+                              interpret=True)
+    assert eng.eligible, eng.ineligible_reason
+    return ex, store, inst, eng
+
+
+def run_and_check(data, func, per_lane_args, lanes=LANES,
+                  max_steps=2_000_000, conf=None):
+    ex, store, inst, eng = make_engine(data, lanes=lanes, conf=conf)
+    args = [np.asarray(a, np.int64) for a in per_lane_args]
+    res = eng.run(func, args, max_steps=max_steps)
+    for lane in range(lanes):
+        lane_args = [int(a[lane]) for a in args]
+        s_ex, s_store, s_inst = instantiate(data, conf or Configure())
+        try:
+            expect = s_ex.invoke(s_store, s_inst.find_func(func), lane_args)
+            assert res.trap[lane] == -1, \
+                f"lane {lane}: trap {res.trap[lane]}, expected result"
+            for r, e in zip(res.results, expect):
+                got = int(r[lane]) & 0xFFFFFFFFFFFFFFFF
+                want = int(e) & 0xFFFFFFFFFFFFFFFF
+                assert got == want, f"lane {lane}: {got:#x} != {want:#x}"
+        except TrapError as te:
+            assert res.trap[lane] == int(te.code), \
+                f"lane {lane}: trap {res.trap[lane]} != {te.code}"
+    return eng, res
+
+
+def test_entry_grouping_avoids_all_splits():
+    # two arg populations, each >= MIN_GROUP_LANES: the scheduler packs
+    # them into separate blocks, so no divergence ever occurs
+    ns = np.concatenate([np.full(LANES // 2, 12, np.int64),
+                         np.full(LANES // 2, 7, np.int64)])
+    rng = np.random.default_rng(7)
+    rng.shuffle(ns)
+    eng, res = run_and_check(build_fib(), "fib", [ns])
+    assert not eng.fell_back_to_simt
+    assert eng.splits == 0
+
+
+def test_many_groups_split_then_converge():
+    # more arg values than MIN_GROUP_LANES allows for clean grouping:
+    # straddle blocks split once at the first differing branch, then run
+    # converged
+    ns = (np.arange(LANES, dtype=np.int64) % 4) + 6
+    eng, res = run_and_check(build_fib(), "fib", [ns])
+    assert not eng.fell_back_to_simt
+
+
+def test_divergent_br_table_splits():
+    b = ModuleBuilder()
+    b.add_function(["i32"], ["i32"], [], [
+        ("block", None), ("block", None), ("block", None),
+        ("local.get", 0), ("br_table", [0, 1], 2),
+        "end", ("i32.const", 100), "return",
+        "end", ("i32.const", 200), "return",
+        "end", ("i32.const", 300),
+    ], export="f")
+    # 6 values -> median group size < MIN_GROUP_LANES: identity packing,
+    # so the br_table itself must diverge and split in-flight
+    sel = np.arange(LANES, dtype=np.int64) % 6
+    eng, res = run_and_check(b.build(), "f", [sel])
+    assert not eng.fell_back_to_simt
+    assert eng.splits > 0
+
+
+def test_divergent_call_indirect_with_traps():
+    b = ModuleBuilder()
+    f_add = b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("i32.const", 10), "i32.add"])
+    f_mul = b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("i32.const", 3), "i32.mul"])
+    f_other = b.add_function([], [], [], ["nop"])  # wrong signature
+    b.add_table("funcref", 5)
+    b.add_active_elem(0, [("i32.const", 0)], [f_add, f_mul])
+    b.add_active_elem(0, [("i32.const", 3)], [f_other])
+    ti = b.add_type(["i32"], ["i32"])
+    b.add_function(["i32", "i32"], ["i32"], [], [
+        ("local.get", 0), ("local.get", 1),
+        ("call_indirect", ti, 0),
+    ], export="f")
+    data = b.build()
+    # idx 0/1: ok; 2: uninitialized; 3: type mismatch; 9: undefined
+    idx = np.asarray([0, 1, 2, 3, 9, 0, 1, 0] * (LANES // 8), np.int64)
+    x = np.arange(LANES, dtype=np.int64)
+    eng, res = run_and_check(data, "f", [x, idx])
+    assert not eng.fell_back_to_simt
+    assert eng.splits > 0
+
+
+def test_divergent_memgrow_splits():
+    b = ModuleBuilder()
+    b.add_memory(1, 2)
+    b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("memory.grow",), "drop",
+        ("memory.size",),
+    ], export="g")
+    conf = Configure()
+    conf.batch.memory_pages_per_lane = 2
+    # 5 shattered delta groups (median < MIN_GROUP_LANES -> identity
+    # packing -> in-flight split); 0 succeeds in place, the rest exceed
+    # the declared max and fail with -1.  grow(1) would REGROW past the
+    # 1-page watermark plane — covered by the regrow test instead.
+    deltas = (np.arange(LANES, dtype=np.int64) % 5) * 100000
+    eng, res = run_and_check(b.build(), "g", [deltas], conf=conf)
+    assert not eng.fell_back_to_simt
+    assert eng.splits > 0
+
+
+def test_partial_div_by_zero_splits_traps():
+    b = ModuleBuilder()
+    b.add_function(["i32"], ["i32"], [], [
+        ("i32.const", 100), ("local.get", 0), "i32.div_u",
+    ], export="f")
+    divs = np.asarray([1, 2, 0, 4] * (LANES // 4), np.int64)
+    eng, res = run_and_check(b.build(), "f", [divs])
+    assert not eng.fell_back_to_simt
+    assert (res.trap[divs == 0] == int(ErrCode.DivideByZero)).all()
+    assert (res.trap[divs != 0] == -1).all()
+
+
+def test_simt_residue_isolated_to_bad_group():
+    # lane-divergent memory.copy deltas force those lanes to the SIMT
+    # residue; everything else must stay on the kernel and ALL lanes
+    # must still be bit-correct
+    b = ModuleBuilder()
+    b.add_memory(1, 1)
+    b.add_function(["i32", "i32"], ["i32"], [], [
+        ("i32.const", 0), ("i32.const", 0x11AA22BB), ("i32.store", 2, 0),
+        ("i32.const", 64), ("i32.const", 0x33CC44DD), ("i32.store", 2, 0),
+        ("local.get", 0), ("local.get", 1), ("i32.const", 4),
+        ("memory.copy",),
+        ("local.get", 0), ("i32.load", 0, 2),
+    ], export="f")
+    # per-lane-unique args force identity packing; the per-lane deltas
+    # then diverge inside the block and cannot be split (memory-data
+    # divergence), so those lanes finish on the SIMT residue
+    dst = 128 + np.arange(LANES, dtype=np.int64) * 8
+    src = np.where(np.arange(LANES) % 2 == 0, dst, 0)
+    eng, res = run_and_check(b.build(), "f", [dst, src])
+    assert eng.fell_back_to_simt  # residue ran
+
+
+def test_deep_split_cascade_recursion():
+    # a straddle block of two fib arg groups splits exactly where the
+    # recursion depths first disagree; afterwards both sides complete on
+    # the kernel with live call frames carried through the split
+    ns = np.concatenate([np.full(LANES - 4, 11, np.int64),
+                         np.full(4, 13, np.int64)])
+    eng, res = run_and_check(build_fib(), "fib", [ns])
+    assert not eng.fell_back_to_simt
+
+
+def test_max_steps_reports_running_lanes():
+    ns = np.full(LANES, 30, np.int64)
+    ex, store, inst, eng = make_engine(build_fib())
+    res = eng.run("fib", [ns], max_steps=1000)
+    assert (res.trap == 0).all()  # still running
+    assert not res.completed.any()
+
+
+def test_partial_trap_followed_by_branch_keeps_codes():
+    """Regression (r3 review): a div-by-zero stop advances control to a
+    branch; the splitter must peel the trapped lanes FIRST instead of
+    resolving the branch and carrying trap-coded lanes into RUNNING
+    children (which harvested them as successes)."""
+    b = ModuleBuilder()
+    b.add_function(["i32", "i32"], ["i32"], [], [
+        ("local.get", 0), ("local.get", 1), "i32.div_u",
+        ("if", "i32"),
+        ("i32.const", 111),
+        "else",
+        ("i32.const", 222),
+        "end",
+    ], export="f")
+    xs = np.full(LANES, 100, np.int64)
+    ys = np.asarray([5, 0, 200, 5, 0, 200, 5, 200] * (LANES // 8), np.int64)
+    eng, res = run_and_check(b.build(), "f", [xs, ys])
+    assert (res.trap[ys == 0] == int(ErrCode.DivideByZero)).all()
+    assert (res.trap[ys != 0] == -1).all()
+    assert (np.asarray(res.results[0])[ys == 5] == 111).all()
+    assert (np.asarray(res.results[0])[ys == 200] == 222).all()

@@ -778,7 +778,7 @@ class BatchEngine:
     """
 
     def __init__(self, inst, store=None, conf=None, lanes: Optional[int] = None,
-                 mesh=None):
+                 mesh=None, img=None):
         from wasmedge_tpu.common.configure import Configure
         from wasmedge_tpu.batch.image import batchability, build_device_image
 
@@ -788,6 +788,14 @@ class BatchEngine:
         self.cfg = cfg
         self.lanes = lanes or cfg.lanes
         self.inst = inst
+        self.store = store  # kept for re-deriving engines (scheduler)
+        if img is not None:
+            # share an already-built (and already-normalized) image — the
+            # scheduler derives width-variant engines from one module
+            self.img = img
+            self._step = None
+            self._run_chunk = None
+            return
         host_imports = {i for i, f in enumerate(inst.funcs)
                         if getattr(f, "kind", None) == "host"}
         reason = batchability(inst.lowered, host_imports=host_imports)

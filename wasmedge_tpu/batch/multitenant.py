@@ -246,17 +246,10 @@ class MultiTenantBatchEngine(BatchEngine):
         """Pallas fast path when every tenant\'s lane count aligns to the
         kernel\'s lane blocks (tenant blocks are block-uniform control,
         which is exactly the kernel\'s convergence model)."""
-        from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
+        from wasmedge_tpu.batch.pallas_engine import (
+            PallasUniformEngine, pallas_enabled)
 
-        use = self.cfg.use_pallas
-        if use is None:
-            from wasmedge_tpu.batch import ensure_jax_backend
-
-            ensure_jax_backend()
-            import jax
-
-            use = jax.default_backend() == "tpu"
-        if not use and not self.cfg.interpret:
+        if not pallas_enabled(self.cfg):
             return None
         eng = PallasUniformEngine(self.tenants[0].inst, conf=self.conf,
                                   simt=self,
@@ -270,8 +263,67 @@ class MultiTenantBatchEngine(BatchEngine):
             return None
         return eng
 
+    def _try_schedulers(self, max_steps):
+        """Per-tenant Pallas engines driven by interleaved block
+        schedulers.  Tenants are share-nothing, so each gets its OWN
+        kernel geometry (a memory-heavy tenant no longer drags
+        memory-free tenants' lane blocks down to its VMEM footprint) and
+        its own entry grouping.  Launches are asynchronous: while one
+        tenant's host side processes results, the others' kernels run —
+        the (module, PC)-bucket scheduling SURVEY §7 step 8 prescribes.
+        Returns {tenant_index: BlockScheduler} for the eligible tenants,
+        or None when the Pallas path is off."""
+        from wasmedge_tpu.batch.pallas_engine import (
+            PallasUniformEngine, pallas_enabled)
+        from wasmedge_tpu.batch.scheduler import BlockScheduler
+
+        if not pallas_enabled(self.cfg):
+            return None
+        scheds = {}
+        for ti, t in enumerate(self.tenants):
+            if t.engine.conf is self.conf:
+                # reuse the tenant's existing BatchEngine (its image is
+                # already built and normalized) as the SIMT side
+                eng = PallasUniformEngine(
+                    t.inst, simt=t.engine,
+                    interpret=self.cfg.interpret or None)
+            else:
+                # mismatched confs: THIS engine's knobs must govern the
+                # run (fuel, steps_per_launch, memory ceilings), so build
+                # a fresh SIMT side under self.conf
+                eng = PallasUniformEngine(
+                    t.inst, store=t.engine.store, conf=self.conf,
+                    lanes=t.lanes, interpret=self.cfg.interpret or None)
+            if not eng.eligible:
+                continue
+            scheds[ti] = BlockScheduler(eng, t.func_name,
+                                        list(t.args_lanes), max_steps)
+        return scheds or None
+
     def run_tenants(self, max_steps: int = 10_000_000) -> List[BatchResult]:
         """Run the whole mixed batch; returns one BatchResult per tenant."""
+        scheds = self._try_schedulers(max_steps)
+        if scheds is not None:
+            self.used_pallas = True
+            active = dict(scheds)
+            while active:
+                for s in active.values():
+                    s.launch()
+                done = [ti for ti, s in active.items() if not s.process()]
+                for ti in done:
+                    del active[ti]
+            for s in scheds.values():
+                s._run_simt_residue()
+            out = []
+            for ti, t in enumerate(self.tenants):
+                if ti in scheds:
+                    out.append(scheds[ti].result())
+                else:
+                    # ineligible tenant: its own SIMT engine, alone
+                    res = t.engine.run(t.func_name, list(t.args_lanes),
+                                       max_steps)
+                    out.append(res)
+            return out
         state = self.initial_state()
         total = 0
         pallas = self._try_pallas()

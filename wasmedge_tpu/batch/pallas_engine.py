@@ -122,7 +122,20 @@ H_FUSE_GCB_BASE = H_FUSE_GCA_BASE + NUM_ALU2  # + ALU2 sub id
 #   GCC: local.get a; const imm; alu2 sub; call b -> one dispatch
 H_FUSE_A2R_BASE = H_FUSE_GCB_BASE + NUM_ALU2  # + ALU2 sub id
 H_FUSE_GCC_BASE = H_FUSE_A2R_BASE + NUM_ALU2  # + ALU2 sub id
-H_FUSE_GBR = H_FUSE_GCC_BASE + NUM_ALU2
+# loop-body families (the hot patterns of counted loops; fields at fuse
+# time:  a/b/c keep the branch or dst operands, ilo/ihi carry local idxs
+# or the immediate):
+#   GCS:   local.get a; const ilo/ihi; alu2; local.set b   -> pc += 4
+#   GGA:   local.get a; local.get c; alu2                  -> pc += 3
+#   GGS:   local.get a; local.get c; alu2; local.set b     -> pc += 4
+#   GGBZ:  local.get ilo; local.get ihi; alu2; brz a       -> pc += 4
+#   GGBNZ: local.get ilo; local.get ihi; alu2; brnz a,b,c  -> pc += 4
+H_FUSE_GCS_BASE = H_FUSE_GCC_BASE + NUM_ALU2
+H_FUSE_GGA_BASE = H_FUSE_GCS_BASE + NUM_ALU2
+H_FUSE_GGS_BASE = H_FUSE_GGA_BASE + NUM_ALU2
+H_FUSE_GGBZ_BASE = H_FUSE_GGS_BASE + NUM_ALU2
+H_FUSE_GGBNZ_BASE = H_FUSE_GGBZ_BASE + NUM_ALU2
+H_FUSE_GBR = H_FUSE_GGBNZ_BASE + NUM_ALU2
 NUM_HANDLERS = H_FUSE_GBR + 1
 
 _CLS_TO_HID = {
@@ -226,7 +239,8 @@ def fuse_image(hid, a, b, c, ilo, ihi, img):
             sub = h2 - H_ALU2_BASE
             if sub not in _DIV32_SUBS and sub not in _DIV64_SUBS:
                 ok4 = pc + 3 not in targets and pc + 3 < n
-                if ok4 and int(hid[pc + 3]) == H_BRZ:
+                h3 = int(hid[pc + 3]) if ok4 else -1
+                if h3 == H_BRZ:
                     # quad: the compare feeds a brz; no stack writes at all
                     hid[pc] = H_FUSE_GCB_BASE + sub
                     ilo[pc] = ilo[pc + 1]
@@ -234,7 +248,7 @@ def fuse_image(hid, a, b, c, ilo, ihi, img):
                     b[pc] = a[pc + 3]        # brz target
                     pc += 4
                     continue
-                if ok4 and int(hid[pc + 3]) == H_CALL:
+                if h3 == H_CALL:
                     # quad: computed value is the callee's argument
                     hid[pc] = H_FUSE_GCC_BASE + sub
                     ilo[pc] = ilo[pc + 1]
@@ -242,10 +256,51 @@ def fuse_image(hid, a, b, c, ilo, ihi, img):
                     b[pc] = a[pc + 3]        # callee index
                     pc += 4
                     continue
+                if h3 == H_LOCAL_SET:
+                    # quad: local.set dst of the computed value
+                    hid[pc] = H_FUSE_GCS_BASE + sub
+                    ilo[pc] = ilo[pc + 1]
+                    ihi[pc] = ihi[pc + 1]
+                    b[pc] = a[pc + 3]        # dst local
+                    pc += 4
+                    continue
                 hid[pc] = H_FUSE_GCA_BASE + sub
                 # a keeps the local idx; imm moves up from the const
                 ilo[pc] = ilo[pc + 1]
                 ihi[pc] = ihi[pc + 1]
+                pc += 3
+                continue
+        if h0 == H_LOCAL_GET and absorb3 and h1 == H_LOCAL_GET and \
+                H_ALU2_BASE <= h2 < H_ALU2_BASE + NUM_ALU2:
+            sub = h2 - H_ALU2_BASE
+            if sub not in _DIV32_SUBS and sub not in _DIV64_SUBS:
+                ok4 = pc + 3 not in targets and pc + 3 < n
+                h3 = int(hid[pc + 3]) if ok4 else -1
+                src1, src2 = int(a[pc]), int(a[pc + 1])
+                if h3 == H_BRZ:
+                    hid[pc] = H_FUSE_GGBZ_BASE + sub
+                    a[pc] = a[pc + 3]        # brz target
+                    ilo[pc] = src1
+                    ihi[pc] = src2
+                    pc += 4
+                    continue
+                if h3 == H_BRNZ:
+                    hid[pc] = H_FUSE_GGBNZ_BASE + sub
+                    a[pc] = a[pc + 3]        # brnz target
+                    b[pc] = b[pc + 3]        # nkeep
+                    c[pc] = c[pc + 3]        # pop_to
+                    ilo[pc] = src1
+                    ihi[pc] = src2
+                    pc += 4
+                    continue
+                if h3 == H_LOCAL_SET:
+                    hid[pc] = H_FUSE_GGS_BASE + sub
+                    b[pc] = a[pc + 3]        # dst local
+                    c[pc] = src2
+                    pc += 4
+                    continue
+                hid[pc] = H_FUSE_GGA_BASE + sub
+                c[pc] = src2
                 pc += 3
                 continue
         if h0 == H_LOCAL_GET and absorb2 and h1 == H_BR:
@@ -1001,6 +1056,95 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                                  ob=frames_out[blk, 2, rd], cd=cd - 1))
             return h
 
+        def mk_fuse_gcs(sub):
+            fn = alu2[sub]
+
+            def h(c):
+                pc, sp, fp = c[1], c[2], c[3]
+                src = fp + a_r[pc]
+                xl, xh = srow(slo, src), srow(shi, src)
+                yl, yh = full(ilo_r[pc]), full(ihi_r[pc])
+                rl, rh = fn(xl, xh, yl, yh)
+                dst = fp + b_r[pc]
+                wrow(slo, dst, rl)
+                wrow(shi, dst, rh)
+                return keep(c, steps=c[0] + 3, pc=pc + 4)
+            return h
+
+        def mk_fuse_gga(sub):
+            fn = alu2[sub]
+
+            def h(c):
+                pc, sp, fp = c[1], c[2], c[3]
+                s1, s2 = fp + a_r[pc], fp + c_r[pc]
+                rl, rh = fn(srow(slo, s1), srow(shi, s1),
+                            srow(slo, s2), srow(shi, s2))
+                wrow(slo, sp, rl)
+                wrow(shi, sp, rh)
+                return keep(c, steps=c[0] + 2, pc=pc + 3, sp=sp + 1)
+            return h
+
+        def mk_fuse_ggs(sub):
+            fn = alu2[sub]
+
+            def h(c):
+                pc, sp, fp = c[1], c[2], c[3]
+                s1, s2 = fp + a_r[pc], fp + c_r[pc]
+                rl, rh = fn(srow(slo, s1), srow(shi, s1),
+                            srow(slo, s2), srow(shi, s2))
+                dst = fp + b_r[pc]
+                wrow(slo, dst, rl)
+                wrow(shi, dst, rh)
+                return keep(c, steps=c[0] + 3, pc=pc + 4)
+            return h
+
+        def mk_fuse_ggbz(sub):
+            fn = alu2[sub]
+
+            def h(c):
+                pc, sp, fp = c[1], c[2], c[3]
+                s1, s2 = fp + ilo_r[pc], fp + ihi_r[pc]
+                cond, _rh = fn(srow(slo, s1), srow(shi, s1),
+                               srow(slo, s2), srow(shi, s2))
+                t0 = scal(cond)
+                agree = allsame(cond, t0)
+                new_pc = jnp.where(t0 == 0, a_r[pc], pc + 4)
+                return lax.cond(
+                    agree,
+                    lambda: keep(c, steps=c[0] + 3, pc=new_pc),
+                    lambda: keep(c, status=I32(ST_DIVERGED)))
+            return h
+
+        def mk_fuse_ggbnz(sub):
+            fn = alu2[sub]
+
+            def h(c):
+                pc, sp, fp, ob = c[1], c[2], c[3], c[4]
+                s1, s2 = fp + ilo_r[pc], fp + ihi_r[pc]
+                cond, _rh = fn(srow(slo, s1), srow(shi, s1),
+                               srow(slo, s2), srow(shi, s2))
+                t0 = scal(cond)
+                agree = allsame(cond, t0)
+                tgt, nkeep, pop_to = a_r[pc], b_r[pc], c_r[pc]
+                tgt_sp = ob + pop_to
+                taken = t0 != 0
+
+                @pl.when(agree & taken & (nkeep == 1))
+                def _():
+                    # the would-be kept value sits at the pre-fusion top
+                    wrow(slo, tgt_sp, srow(slo, sp - 1))
+                    wrow(shi, tgt_sp, srow(shi, sp - 1))
+
+                return lax.cond(
+                    agree,
+                    lambda: lax.cond(
+                        taken,
+                        lambda: keep(c, steps=c[0] + 3, pc=tgt,
+                                     sp=tgt_sp + nkeep),
+                        lambda: keep(c, steps=c[0] + 3, pc=pc + 4)),
+                    lambda: keep(c, status=I32(ST_DIVERGED)))
+            return h
+
         def mk_fuse_gcc(sub):
             fn = alu2[sub]
 
@@ -1120,6 +1264,16 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def handler_for(hid):
             if hid == H_FUSE_GBR:
                 return h_fuse_gbr
+            if hid >= H_FUSE_GGBNZ_BASE:
+                return mk_fuse_ggbnz(hid - H_FUSE_GGBNZ_BASE)
+            if hid >= H_FUSE_GGBZ_BASE:
+                return mk_fuse_ggbz(hid - H_FUSE_GGBZ_BASE)
+            if hid >= H_FUSE_GGS_BASE:
+                return mk_fuse_ggs(hid - H_FUSE_GGS_BASE)
+            if hid >= H_FUSE_GGA_BASE:
+                return mk_fuse_gga(hid - H_FUSE_GGA_BASE)
+            if hid >= H_FUSE_GCS_BASE:
+                return mk_fuse_gcs(hid - H_FUSE_GCS_BASE)
             if hid >= H_FUSE_GCC_BASE:
                 return mk_fuse_gcc(hid - H_FUSE_GCC_BASE)
             if hid >= H_FUSE_A2R_BASE:
@@ -1142,8 +1296,10 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def body(c):
             pc = jnp.clip(c[1], 0, code_len - 1)
             nc = lax.switch(hid_r[pc], handlers, c)
-            # divergence rewinds the step count (the next engine re-runs it)
-            counted = jnp.where(nc[7] == I32(ST_DIVERGED), I32(0), I32(1))
+            # un-advanced stops rewind the step count (the next engine
+            # re-executes the instruction): divergence and regrow
+            counted = jnp.where((nc[7] == I32(ST_DIVERGED)) |
+                                (nc[7] == I32(ST_REGROW)), I32(0), I32(1))
             return (nc[0] + counted,) + nc[1:]
 
         init = (I32(0), ctrl_r[blk, _C_PC], ctrl_r[blk, _C_SP],
@@ -1163,7 +1319,10 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                                     I32(int(ErrCode.CostLimitExceeded)),
                                     trapr[0, :])
 
-        ctrl_out[blk, _C_FUEL] = fuel_in - steps
+        # the disabled-fuel sentinel must not drift down across launches
+        # (a >2^31-step run would spuriously exhaust it)
+        ctrl_out[blk, _C_FUEL] = jnp.where(fuel_in == I32(_FUEL_OFF),
+                                           fuel_in, fuel_in - steps)
         ctrl_out[blk, _C_PC] = pc
         ctrl_out[blk, _C_SP] = sp
         ctrl_out[blk, _C_FP] = fp
@@ -1234,6 +1393,22 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
     return jax.jit(fn, donate_argnums=(15, 16, 17, 18, 19, 20))
 
 
+def pallas_enabled(cfg) -> bool:
+    """One policy for whether the Pallas fast path is on: the explicit
+    `use_pallas` knob wins; unset means TPU-backend auto-detect; and
+    `interpret=True` opts in on CPU (tests).  Shared by the uniform and
+    multi-tenant engines so they can never disagree."""
+    use = cfg.use_pallas
+    if use is None:
+        from wasmedge_tpu.batch import ensure_jax_backend
+
+        ensure_jax_backend()
+        import jax
+
+        use = jax.default_backend() == "tpu"
+    return bool(use or cfg.interpret)
+
+
 class PallasUniformEngine:
     """Block-converged engine running the dispatch loop on-device.
 
@@ -1268,6 +1443,7 @@ class PallasUniformEngine:
         self._tables = None
         self._blk_cap = None  # lane-block ceiling (multi-tenant alignment)
         self.fell_back_to_simt = False
+        self.splits = 0  # block-scheduler split count from the last run()
         # per-lane page counts recorded when a host outcall grows memory
         # (block ctrl keeps one uniform count; growth diverges the block)
         self._pages_override = {}
@@ -1310,8 +1486,11 @@ class PallasUniformEngine:
         # Mosaic requires lane-dim slices aligned to the 128-lane tiling;
         # interpret mode (CPU tests) has no such constraint.
         align = 1 if self._interpret() else 128
-        blk = self.lanes
         cap = self._blk_cap or self.lanes
+        # start at the cap: the scheduler's lane totals need not be a
+        # power of two (nblk * Lblk with arbitrary nblk), so halving from
+        # self.lanes would walk past the intended block size
+        blk = min(self.lanes, cap)
 
         def bad(k):
             return (k * per_lane > self.VMEM_BUDGET_BYTES
@@ -1379,50 +1558,6 @@ class PallasUniformEngine:
             img.f_type, img.br_table.reshape(-1), img.table0))
 
     # -- state ------------------------------------------------------------
-    def _initial_state(self, func_idx, args_lanes):
-        import jax.numpy as jnp
-
-        img = self.img
-        L = self.lanes
-        D, CD, W, Lblk = self._geom
-        nblk = L // Lblk
-        meta = self.inst.lowered.funcs[func_idx]
-        stack_lo = np.zeros((D, L), np.int32)
-        stack_hi = np.zeros((D, L), np.int32)
-        for i, arg in enumerate(args_lanes):
-            arr = np.asarray(arg, dtype=np.int64)
-            if arr.ndim == 0:
-                arr = np.full(L, arr, np.int64)
-            if arr.shape != (L,):
-                raise ValueError(
-                    f"arg {i}: expected shape ({L},) or scalar, "
-                    f"got {arr.shape}")
-            stack_lo[i] = (arr & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
-            stack_hi[i] = ((arr >> 32) & 0xFFFFFFFF).astype(
-                np.uint32).view(np.int32)
-        NGp = max(img.globals_lo.shape[0], 1)
-        glo = np.zeros((NGp, L), np.int32)
-        ghi = np.zeros((NGp, L), np.int32)
-        if img.globals_lo.shape[0]:
-            glo[:img.globals_lo.shape[0]] = img.globals_lo[:, None]
-            ghi[:img.globals_hi.shape[0]] = img.globals_hi[:, None]
-        mem = np.zeros((W, L), np.int32)
-        if img.mem_init.shape[0] > 1 or img.mem_pages_init:
-            n = min(img.mem_init.shape[0], W)
-            mem[:n] = img.mem_init[:n, None]
-        ctrl = np.zeros((nblk, 16), np.int32)
-        ctrl[:, _C_PC] = meta.entry_pc
-        ctrl[:, _C_SP] = meta.nlocals
-        ctrl[:, _C_OB] = meta.nlocals
-        ctrl[:, _C_PAGES] = img.mem_pages_init
-        ctrl[:, _C_CHUNK] = self.cfg.steps_per_launch
-        fuel = self.cfg.fuel_per_launch
-        ctrl[:, _C_FUEL] = _FUEL_OFF if fuel is None else fuel
-        return [jnp.asarray(ctrl), jnp.zeros((nblk, 3, CD), jnp.int32),
-                jnp.asarray(stack_lo), jnp.asarray(stack_hi),
-                jnp.asarray(glo), jnp.asarray(ghi),
-                jnp.asarray(mem), jnp.zeros((1, L), jnp.int32)]
-
     def _from_simt_state(self, simt_state):
         """Build pallas-geometry state from a block-uniform SIMT state
         (every control scalar identical within each lane block) — the
@@ -1595,49 +1730,32 @@ class PallasUniformEngine:
     # -- run --------------------------------------------------------------
     def run(self, func_name: str, args_lanes: List,
             max_steps: int = 10_000_000):
+        """Run through the block scheduler (batch/scheduler.py): entry
+        grouping packs same-args lanes into the same blocks, data
+        divergence splits blocks instead of abandoning the kernel, and
+        only the genuinely per-lane residue finishes on SIMT."""
         ex = self.inst.exports.get(func_name)
         if ex is None or ex[0] != 0:
             raise KeyError(f"no exported function {func_name}")
-        func_idx = ex[1]
         if not self.eligible:
             return self.simt.run(func_name, args_lanes, max_steps)
-        if self._fn is None:
-            self._build()
-        state = self._initial_state(func_idx, args_lanes)
-        self.fell_back_to_simt = False
-        self._pages_override = {}
-        state, steps_per_block, statuses = self._drive(state, max_steps)
-        total = int(steps_per_block.max())
-        if ((statuses == ST_DIVERGED) | (statuses == ST_REGROW)).any():
-            self.fell_back_to_simt = True
-            simt_state = self._to_simt_state(state, steps_per_block)
-            simt_state, total = self.simt.run_from_state(
-                simt_state, total, max_steps)
-            return self._result(func_idx, simt_state, total)
-        # Fast path: pull only the result rows and the trap plane off the
-        # device (full-state readback is reserved for the divergence
-        # handoff; device->host bandwidth is the expensive resource here).
-        return self._result_fast(func_idx, state,
-                                 np.asarray(state[0]), steps_per_block)
+        from wasmedge_tpu.batch.scheduler import BlockScheduler
 
-    def _result_fast(self, func_idx, state, ctrl, steps_per_block):
-        from wasmedge_tpu.batch.engine import BatchResult
+        sched = BlockScheduler(self, func_name, args_lanes, max_steps)
+        sched.run()
+        self.fell_back_to_simt = sched.fell_back_to_simt
+        self.splits = sched.splits
+        return sched.result()
 
-        D, CD, W, Lblk = self._geom
-        nres = int(self.inst.lowered.funcs[func_idx].nresults)
-        stack_lo = np.asarray(state[2][:max(nres, 1)])
-        stack_hi = np.asarray(state[3][:max(nres, 1)])
-        trap_v = merge_block_status_into_trap(
-            np.asarray(state[7])[0].copy(), ctrl, Lblk)
-        results = decode_result_rows(stack_lo, stack_hi, nres)
-        retired = np.repeat(steps_per_block, Lblk).astype(np.int64)
-        return BatchResult(results=results, trap=trap_v,
-                           retired=retired,
-                           steps=int(steps_per_block.max()))
-
-    def _serve_hostcalls(self, state, ctrl_np):
+    def _serve_hostcalls(self, state, ctrl_np, valid_blocks=None):
         """Drain parked blocks through the host outcall channel
-        (batch/hostcall.py) and re-arm them."""
+        (batch/hostcall.py) and re-arm them.
+
+        valid_blocks: optional {block: bool[Lblk]} from the scheduler —
+        pad (clone) lanes are NOT served (a host function's side effects
+        must fire once per real instance, never for padding); their
+        result/memory/trap columns are copied from the block's first
+        valid lane (their clone source), which keeps them converged."""
         import jax.numpy as jnp
 
         from wasmedge_tpu.batch.hostcall import (
@@ -1678,7 +1796,10 @@ class PallasUniformEngine:
             trap_codes = np.zeros(Lblk, np.int32)
             pages = int(ctrl[b, _C_PAGES])
             new_pages = np.full(Lblk, pages, np.int32)
+            vmask = valid_blocks.get(int(b)) if valid_blocks else None
             for li, lane in enumerate(lanes):
+                if vmask is not None and not vmask[li]:
+                    continue  # pad lane: cloned from a real lane below
                 args = []
                 for i in range(nargs):
                     lo = int(np.uint32(args_lo[i, li]))
@@ -1700,6 +1821,16 @@ class PallasUniformEngine:
                 if has_mem:
                     store_lane_memory(mem_np, lane, lane_mem.data)
                     new_pages[li] = lane_mem.pages
+            if vmask is not None and not vmask.all():
+                src = int(np.argmax(vmask))  # first valid = clone source
+                src_lane = b * Lblk + src
+                for li in np.nonzero(~vmask)[0]:
+                    res_lo[:, li] = res_lo[:, src]
+                    res_hi[:, li] = res_hi[:, src]
+                    trap_codes[li] = trap_codes[src]
+                    new_pages[li] = new_pages[src]
+                    if has_mem:
+                        mem_np[:, b * Lblk + li] = mem_np[:, src_lane]
             grew = (new_pages != pages) & (trap_codes == 0)
             if trap_codes.any() or grew.any():
                 # Per-lane outcomes (trap codes, or memory growth that
@@ -1743,12 +1874,3 @@ class PallasUniformEngine:
             state[6] = jnp.asarray(mem_np)
         state[0] = jnp.asarray(ctrl)
         return state
-
-    def _result(self, func_idx, state, steps):
-        from wasmedge_tpu.batch.engine import BatchResult
-
-        nres = int(self.inst.lowered.funcs[func_idx].nresults)
-        results = decode_result_rows(np.asarray(state.stack_lo),
-                                     np.asarray(state.stack_hi), nres)
-        return BatchResult(results=results, trap=np.asarray(state.trap),
-                           retired=np.asarray(state.retired), steps=steps)

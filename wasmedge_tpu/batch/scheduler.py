@@ -1,0 +1,916 @@
+"""Block scheduler: divergence as a scheduling problem, not a kernel one.
+
+SURVEY.md §7 step 8 prescribes "batching by (module, PC) buckets;
+retire/refill lanes from a host queue" for heterogeneous/divergent
+execution.  This module is that scheduler.  The Pallas warp-interpreter
+(batch/pallas_engine.py) is deliberately *uniform* — every lane in a
+block shares one pc/sp/fp, which is what keeps its dispatch loop free of
+per-lane gathers (the TPU has no per-lane addressing across sublanes).
+Divergence is handled here, outside the kernel:
+
+- **Entry grouping**: lanes are sorted by their argument tuples before
+  packing into lane blocks, so lanes that will follow the same control
+  path (Wasm instances are deterministic share-nothing state machines)
+  land in the same block and never diverge at all.  Groups are padded to
+  whole blocks with cloned lanes; pads compute redundantly and are
+  dropped at harvest.
+- **Split on divergence**: when a block stops at a data-dependent branch
+  whose condition disagrees (status=DIVERGED), the splitter evaluates
+  that ONE instruction per lane on the host, partitions the lanes by
+  outcome, and installs each side as a new control-uniform block — the
+  moral equivalent of a GPU warp scheduler's divergence stack, with
+  re-packing explicit and amortized.  For fib(n) with mixed n this fires
+  once per mixed block; afterwards every block is converged forever.
+- **SIMT residue**: anything the splitter can't express (float-fused
+  branches, per-lane divergent memory addressing, growth beyond the
+  watermark plane) queues its lanes for one final pass on the
+  per-lane-pc SIMT engine; everything else keeps running on the kernel.
+
+The reference runs every instance on the same dispatch loop
+(/root/reference/lib/executor/engine/engine.cpp:68-1641) one thread at a
+time; here 'threads' are lane blocks and 'context switches' are block
+installs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import numpy as np
+
+from wasmedge_tpu.common.errors import ErrCode
+from wasmedge_tpu.batch.image import (
+    ALU2_I32_BASE,
+    ALU2_I64_BASE,
+    TRAP_DONE,
+    _I32_BIN,
+)
+from wasmedge_tpu.batch.pallas_engine import (
+    H_BR_TABLE,
+    H_BRNZ,
+    H_BRZ,
+    H_CALL_INDIRECT,
+    H_FUSE_GCB_BASE,
+    H_FUSE_GGBNZ_BASE,
+    H_FUSE_GGBZ_BASE,
+    H_MEMGROW,
+    NUM_ALU2,
+    ST_DIVERGED,
+    ST_DONE,
+    ST_HOSTCALL,
+    ST_REGROW,
+    ST_RUNNING,
+    ST_TRAPPED_BASE,
+    _C_CD,
+    _C_CHUNK,
+    _C_FP,
+    _C_FUEL,
+    _C_OB,
+    _C_PAGES,
+    _C_PC,
+    _C_SP,
+    _C_STATUS,
+    _C_STEPS,
+    _FUEL_OFF,
+    _PAGE_WORDS,
+    PallasUniformEngine,
+)
+
+# host-side block slot states
+_B_FREE = 0
+_B_LIVE = 1     # installed in the device state (any kernel status)
+
+_PLANE_IDX = {"slo": 2, "shi": 3, "glo": 4, "ghi": 5, "mem": 6, "trap": 7}
+
+
+def _u32(x):
+    return np.asarray(x).astype(np.int64) & 0xFFFFFFFF
+
+
+def _host_alu2(sub: int, xl, xh, yl, yh):
+    """Evaluate one integer ALU2 sub on int32 lo/hi column vectors.
+
+    Only the non-trapping integer families (what superinstruction fusion
+    admits) are supported; returns None for float subs — the caller then
+    routes the block to the SIMT residue.  Semantics mirror
+    batch/laneops.py's device kernels."""
+    names = _I32_BIN
+    if ALU2_I32_BASE <= sub < ALU2_I32_BASE + len(names):
+        name = names[sub - ALU2_I32_BASE]
+        xu, yu = _u32(xl), _u32(yl)
+        xs = xu.astype(np.uint32).view(np.int32).astype(np.int64)
+        ys = yu.astype(np.uint32).view(np.int32).astype(np.int64)
+        sh = yu & 31
+        ops = {
+            "add": lambda: xu + yu, "sub": lambda: xu - yu,
+            "mul": lambda: xu * yu,
+            "and": lambda: xu & yu, "or": lambda: xu | yu,
+            "xor": lambda: xu ^ yu,
+            "shl": lambda: xu << sh,
+            "shr_s": lambda: xs >> sh,
+            "shr_u": lambda: xu >> sh,
+            "rotl": lambda: (xu << sh) | (xu >> ((32 - sh) & 31)),
+            "rotr": lambda: (xu >> sh) | (xu << ((32 - sh) & 31)),
+            "eq": lambda: xu == yu, "ne": lambda: xu != yu,
+            "lt_s": lambda: xs < ys, "lt_u": lambda: xu < yu,
+            "gt_s": lambda: xs > ys, "gt_u": lambda: xu > yu,
+            "le_s": lambda: xs <= ys, "le_u": lambda: xu <= yu,
+            "ge_s": lambda: xs >= ys, "ge_u": lambda: xu >= yu,
+        }.get(name)
+        if ops is None:
+            return None
+        lo = (ops().astype(np.int64) & 0xFFFFFFFF).astype(
+            np.uint32).view(np.int32)
+        return lo, np.zeros_like(lo)
+    if ALU2_I64_BASE <= sub < ALU2_I64_BASE + len(names):
+        name = names[sub - ALU2_I64_BASE]
+        x = (_u32(xl) | (_u32(xh) << 32)).astype(np.uint64)
+        y = (_u32(yl) | (_u32(yh) << 32)).astype(np.uint64)
+        xs, ys = x.view(np.int64), y.view(np.int64)
+        sh = (y & np.uint64(63))
+        with np.errstate(over="ignore"):
+            ops = {
+                "add": lambda: x + y, "sub": lambda: x - y,
+                "mul": lambda: x * y,
+                "and": lambda: x & y, "or": lambda: x | y,
+                "xor": lambda: x ^ y,
+                "shl": lambda: x << sh,
+                "shr_s": lambda: (xs >> sh.astype(np.int64)).view(
+                    np.uint64),
+                "shr_u": lambda: x >> sh,
+                "rotl": lambda: (x << sh) |
+                (x >> ((np.uint64(64) - sh) & np.uint64(63))),
+                "rotr": lambda: (x >> sh) |
+                (x << ((np.uint64(64) - sh) & np.uint64(63))),
+                "eq": lambda: (x == y).astype(np.uint64),
+                "ne": lambda: (x != y).astype(np.uint64),
+                "lt_s": lambda: (xs < ys).astype(np.uint64),
+                "lt_u": lambda: (x < y).astype(np.uint64),
+                "gt_s": lambda: (xs > ys).astype(np.uint64),
+                "gt_u": lambda: (x > y).astype(np.uint64),
+                "le_s": lambda: (xs <= ys).astype(np.uint64),
+                "le_u": lambda: (x <= y).astype(np.uint64),
+                "ge_s": lambda: (xs >= ys).astype(np.uint64),
+                "ge_u": lambda: (x >= y).astype(np.uint64),
+            }.get(name)
+            if ops is None:
+                return None
+            v = ops().astype(np.uint64)
+        lo = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(np.int32)
+        hi = (v >> np.uint64(32)).astype(np.uint32).view(np.int32)
+        return lo, hi
+    return None
+
+
+@dataclasses.dataclass
+class _Pending:
+    """A control-uniform lane group waiting for a free block slot."""
+
+    ctrl: np.ndarray              # [16] int32
+    frames: np.ndarray            # [3, CD] int32
+    cols: Dict[str, np.ndarray]   # plane name -> [rows, n] columns
+    lane_ids: np.ndarray          # [n] original lane ids (no pads)
+    steps0: int = 0               # instructions already retired
+    pages: np.ndarray = None      # [n] per-lane page counts when a host
+    #                               outcall grew memory (else ctrl value)
+
+
+class BlockScheduler:
+    """Drives one module's batch through the Pallas kernel with entry
+    grouping, divergence splitting, and a SIMT residue pass."""
+
+    # don't pre-group when the median group is this small — the SIMT
+    # engine is the right tool for fully-heterogeneous inputs
+    MIN_GROUP_LANES = 8
+
+    def __init__(self, outer: PallasUniformEngine, func_name: str,
+                 args_lanes: List, max_steps: int):
+        self.outer = outer
+        self.inst = outer.inst
+        self.cfg = outer.cfg
+        self.func_name = func_name
+        self.max_steps = max_steps
+        self.lanes = outer.lanes
+        ex = self.inst.exports.get(func_name)
+        if ex is None or ex[0] != 0:
+            raise KeyError(f"no exported function {func_name}")
+        self.func_idx = ex[1]
+        self.nres = int(self.inst.lowered.funcs[self.func_idx].nresults)
+        self.args = []
+        for a in args_lanes:
+            arr = np.asarray(a, np.int64)
+            if arr.ndim == 0:
+                arr = np.full(self.lanes, arr, np.int64)
+            if arr.shape != (self.lanes,):
+                raise ValueError(
+                    f"arg: expected shape ({self.lanes},) or scalar, "
+                    f"got {arr.shape}")
+            self.args.append(arr)
+        # results in original lane order
+        self.res_lo = np.zeros((max(self.nres, 1), self.lanes), np.int32)
+        self.res_hi = np.zeros((max(self.nres, 1), self.lanes), np.int32)
+        self.trap = np.zeros(self.lanes, np.int32)
+        self.retired = np.zeros(self.lanes, np.int64)
+        self.fell_back_to_simt = False
+        self.splits = 0
+        self._plan()
+
+    # -- entry packing -----------------------------------------------------
+    def _plan(self):
+        """Choose (L_sched, Lblk), build the engine and the packed state."""
+        outer = self.outer
+        if self.args:
+            order = np.lexsort(tuple(self.args))
+            keys = np.stack(self.args, axis=0)[:, order]
+            starts = [0]
+            for i in range(1, self.lanes):
+                if not (keys[:, i] == keys[:, i - 1]).all():
+                    starts.append(i)
+            sizes = np.diff(starts + [self.lanes])
+        else:
+            order = np.arange(self.lanes)
+            sizes = np.array([self.lanes])
+        lblk_max = outer._lane_block()
+        align = 1 if outer._interpret() else 128
+        med = int(np.median(sizes))
+        if len(sizes) == 1 or med < self.MIN_GROUP_LANES:
+            # uniform batch (no grouping needed) or hopelessly shattered
+            # (grouping can't help): one geometry, identity packing
+            lblk = lblk_max
+            self.order = np.arange(self.lanes)
+            group_sizes = [self.lanes]
+        else:
+            # Smallest block covering the typical group: throughput is
+            # Lblk x step-rate and blocks serialize on the core, so a
+            # group split across two blocks runs its program twice.
+            # Padding a block out to the group size is free by comparison
+            # (pad lanes ride along in otherwise-idle vector lanes).
+            lblk = align
+            while lblk < med and lblk * 2 <= lblk_max:
+                lblk *= 2
+            self.order = order
+            group_sizes = [int(s) for s in sizes]
+        blocks: List[np.ndarray] = []   # each [lblk] lane ids (-1 = pad)
+        pos = 0
+        for g in group_sizes:
+            ids = self.order[pos:pos + g]
+            pos += g
+            for off in range(0, g, lblk):
+                chunk = ids[off:off + lblk].astype(np.int64)
+                if len(chunk) < lblk:
+                    chunk = np.concatenate(
+                        [chunk, np.full(lblk - len(chunk), -1, np.int64)])
+                blocks.append(chunk)
+        self.Lblk = lblk
+        self.nblk = len(blocks)
+        L = self.nblk * lblk
+        # splits that outgrow this budget route to SIMT instead of
+        # thrashing the host with block surgery
+        self.split_budget = 4 * self.nblk + 16
+        # internal engine at the scheduler's geometry, cached on the
+        # long-lived SIMT engine per (L, Lblk) so repeated run() calls
+        # reuse the image, the fused tables, and the jitted kernel
+        cache = getattr(outer.simt, "_sched_cache", None)
+        if cache is None:
+            cache = outer.simt._sched_cache = {}
+        eng = cache.get((L, lblk))
+        if eng is None:
+            from wasmedge_tpu.batch.engine import BatchEngine
+
+            simt = BatchEngine(self.inst, store=outer.simt.store,
+                               conf=outer.simt.conf, lanes=L,
+                               img=outer.img)
+            eng = PallasUniformEngine(self.inst, simt=simt,
+                                      interpret=outer.interpret)
+            eng._blk_cap = lblk
+            eng.ineligible_reason = eng._eligibility()
+            if not eng.eligible:
+                raise RuntimeError(
+                    f"scheduler geometry ineligible: "
+                    f"{eng.ineligible_reason}")
+            eng._build()
+            assert eng._geom[3] == lblk, (eng._geom, lblk)
+            cache[(L, lblk)] = eng
+        self.eng = eng
+        self.block_lanes = np.stack(blocks)  # [nblk, lblk]
+        self.block_state = np.full(self.nblk, _B_LIVE, np.int32)
+        self.block_steps = np.zeros(self.nblk, np.int64)
+        self._pending: List[_Pending] = []
+        self._simt_queue: List[_Pending] = []
+        self._build_initial_state()
+
+    def _build_initial_state(self):
+        import jax.numpy as jnp
+
+        eng = self.eng
+        img = eng.img
+        D, CD, W, Lblk = eng._geom
+        L = eng.lanes
+        meta = self.inst.lowered.funcs[self.func_idx]
+        # packed column -> original lane (pads clone their block's first
+        # valid lane so they run the same program)
+        flat = self.block_lanes.reshape(-1).copy()
+        for b in range(self.nblk):
+            seg = self.block_lanes[b]
+            first = seg[seg >= 0][0]
+            flat[b * Lblk:(b + 1) * Lblk][seg < 0] = first
+        stack_lo = np.zeros((D, L), np.int32)
+        stack_hi = np.zeros((D, L), np.int32)
+        for i, arg in enumerate(self.args):
+            vals = arg[flat]
+            stack_lo[i] = (vals & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+            stack_hi[i] = ((vals >> 32) & 0xFFFFFFFF).astype(
+                np.uint32).view(np.int32)
+        NGp = max(img.globals_lo.shape[0], 1)
+        glo = np.zeros((NGp, L), np.int32)
+        ghi = np.zeros((NGp, L), np.int32)
+        if img.globals_lo.shape[0]:
+            glo[:img.globals_lo.shape[0]] = img.globals_lo[:, None]
+            ghi[:img.globals_hi.shape[0]] = img.globals_hi[:, None]
+        mem = np.zeros((W, L), np.int32)
+        if img.mem_init.shape[0] > 1 or img.mem_pages_init:
+            n = min(img.mem_init.shape[0], W)
+            mem[:n] = img.mem_init[:n, None]
+        ctrl = np.zeros((self.nblk, 16), np.int32)
+        ctrl[:, _C_PC] = meta.entry_pc
+        ctrl[:, _C_SP] = meta.nlocals
+        ctrl[:, _C_OB] = meta.nlocals
+        ctrl[:, _C_PAGES] = img.mem_pages_init
+        ctrl[:, _C_CHUNK] = self.cfg.steps_per_launch
+        fuel = self.cfg.fuel_per_launch
+        ctrl[:, _C_FUEL] = _FUEL_OFF if fuel is None else fuel
+        self.state = [jnp.asarray(ctrl),
+                      jnp.zeros((self.nblk, 3, CD), jnp.int32),
+                      jnp.asarray(stack_lo), jnp.asarray(stack_hi),
+                      jnp.asarray(glo), jnp.asarray(ghi),
+                      jnp.asarray(mem), jnp.zeros((1, L), jnp.int32)]
+
+    # -- drive -------------------------------------------------------------
+    def run(self):
+        """Run to completion; fills result/trap/retired arrays."""
+        while True:
+            self.launch()
+            if not self.process():
+                break
+        self._run_simt_residue()
+
+    def launch(self):
+        """Dispatch one kernel round if any block is runnable.  The
+        dispatch is asynchronous (JAX): multiple schedulers' launches
+        pipeline on the device while hosts process results — the
+        latency-hiding seam the multi-tenant driver uses."""
+        ctrl_np = np.asarray(self.state[0])
+        live = self.block_state == _B_LIVE
+        runnable = live & (ctrl_np[:, _C_STATUS] == ST_RUNNING) & \
+            (self.block_steps < self.max_steps)
+        self._launched = bool(runnable.any())
+        if self._launched:
+            self._live_at_launch = live
+            out = self.eng._fn(*self.eng._tables, self.state[0],
+                               self.state[1], *self.state[2:])
+            self.state = list(out)
+
+    def process(self) -> bool:
+        """Sync on the launch (if any) and handle block statuses.
+        Returns False when the kernel side is finished (residue may
+        remain for _run_simt_residue)."""
+        ctrl_np = np.asarray(self.state[0])
+        if self._launched:
+            live = self._live_at_launch
+            new_steps = ctrl_np[:, _C_STEPS].astype(np.int64)
+            self.block_steps[live] += new_steps[live]
+            self._handle_statuses(ctrl_np)
+            return True
+        if self._handle_statuses(ctrl_np):
+            return True
+        # starved: pending children with no free slot go to SIMT
+        for p in self._pending:
+            self._simt_queue.append(p)
+        self._pending = []
+        return False
+
+    def _handle_statuses(self, ctrl_np) -> bool:
+        """Harvest/serve/split each live block by its status.  Returns
+        True if progress was made that could unblock another pass."""
+        progress = False
+        hostcall_blocks = []
+        for b in range(self.nblk):
+            if self.block_state[b] != _B_LIVE:
+                continue
+            status = int(ctrl_np[b, _C_STATUS])
+            if status == ST_RUNNING:
+                if self.block_steps[b] >= self.max_steps:
+                    self._harvest(b, ctrl_np, running=True)
+                    progress = True
+                continue
+            if status == ST_DONE or status >= ST_TRAPPED_BASE:
+                self._harvest(b, ctrl_np)
+                progress = True
+            elif status == ST_HOSTCALL:
+                hostcall_blocks.append(b)
+            elif status in (ST_DIVERGED, ST_REGROW):
+                self._split(b, ctrl_np, status)
+                progress = True
+        if hostcall_blocks:
+            valid = {b: self.block_lanes[b] >= 0 for b in hostcall_blocks}
+            self.state = self.eng._serve_hostcalls(
+                self.state, np.asarray(self.state[0]), valid_blocks=valid)
+            ctrl2 = np.asarray(self.state[0])
+            # serving may leave per-lane outcomes (ST_DIVERGED): split now
+            for b in hostcall_blocks:
+                st2 = int(ctrl2[b, _C_STATUS])
+                if st2 in (ST_DIVERGED, ST_REGROW):
+                    self._split(b, ctrl2, st2)
+                elif st2 == ST_DONE or st2 >= ST_TRAPPED_BASE:
+                    self._harvest(b, ctrl2)
+            progress = True
+        progress |= self._install_pending()
+        return progress
+
+    # -- harvest -----------------------------------------------------------
+    def _harvest(self, b: int, ctrl_np, running: bool = False):
+        Lblk = self.Lblk
+        lo = b * Lblk
+        ids = self.block_lanes[b]
+        valid = ids >= 0
+        vids = ids[valid].astype(np.int64)
+        status = int(ctrl_np[b, _C_STATUS])
+        trap_row = np.asarray(self.state[7][0, lo:lo + Lblk])
+        if running:
+            codes = trap_row.copy()  # 0 = still running
+        elif status == ST_DONE:
+            codes = np.full(Lblk, TRAP_DONE, np.int32)
+            if self.nres:
+                s_lo = np.asarray(self.state[2][:self.nres, lo:lo + Lblk])
+                s_hi = np.asarray(self.state[3][:self.nres, lo:lo + Lblk])
+                self.res_lo[:self.nres, vids] = s_lo[:, valid]
+                self.res_hi[:self.nres, vids] = s_hi[:, valid]
+        else:
+            code = status - ST_TRAPPED_BASE
+            codes = np.where(trap_row != 0, trap_row, code).astype(np.int32)
+        self.trap[vids] = codes[valid]
+        self.retired[vids] = self.block_steps[b]
+        self._free_block(b)
+
+    def _free_block(self, b: int):
+        """Park the slot so relaunches skip it."""
+        import jax.numpy as jnp
+
+        self.block_state[b] = _B_FREE
+        ctrl = np.array(self.state[0])
+        ctrl[b, _C_STATUS] = ST_DONE
+        self.state[0] = jnp.asarray(ctrl)
+
+    # -- split machinery ---------------------------------------------------
+    def _split(self, b: int, ctrl_np, status: int):
+        """Resolve a stopped block: evaluate the divergent instruction
+        per lane, partition lanes by outcome, install uniform children."""
+        eng = self.eng
+        ctrl = ctrl_np[b].copy()
+        frames = np.asarray(self.state[1][b])
+        pages_over = eng._pages_override.pop(b, None)
+        self.splits += 1
+        if status == ST_REGROW or self.splits > self.split_budget:
+            self._to_simt(b, ctrl, frames, pages_over)
+            return
+        pc = int(ctrl[_C_PC])
+        hid = int(eng._np_fused["hid"][pc])
+        if not self._try_resolve(b, ctrl, frames, hid, pc, pages_over):
+            self._to_simt(b, ctrl, frames, pages_over)
+
+    def _try_resolve(self, b, ctrl, frames, hid, pc, pages_over) -> bool:
+        """Dispatch on the stopped instruction.  Returns False when the
+        case must go to the SIMT residue."""
+        fused = self.eng._np_fused
+        sp = int(ctrl[_C_SP])
+        fp = int(ctrl[_C_FP])
+        ob = int(ctrl[_C_OB])
+        a = int(fused["a"][pc])
+        b_op = int(fused["b"][pc])
+        c_op = int(fused["c"][pc])
+        Lblk = self.Lblk
+        lo = b * Lblk
+        slo = np.asarray(self.state[2][:, lo:lo + Lblk])
+        shi = np.asarray(self.state[3][:, lo:lo + Lblk])
+        trap_row = np.asarray(self.state[7][0, lo:lo + Lblk])
+
+        # Advanced-with-per-lane-outcomes stops come FIRST, regardless of
+        # what instruction ctrl now points at: trap-partial sites (div/rem
+        # by zero, partial-OOB memory ops) and served hostcalls advance
+        # control uniformly and record per-lane trap codes / grown pages —
+        # the divergence IS those outcomes, not the next opcode.  Peel
+        # trapped lanes off; the rest resume RUNNING at the current ctrl.
+        # (Live blocks otherwise carry all-zero trap planes: every split
+        # hands children trap-free columns.)
+        if trap_row.any() or pages_over is not None:
+            keys = [trap_row.astype(np.int64)]
+            if pages_over is not None:
+                keys.append(pages_over.astype(np.int64))
+            children = []
+            for key, cols in self._partition(keys):
+                cc = ctrl.copy()
+                code = int(key[0])
+                cc[_C_STATUS] = (ST_TRAPPED_BASE + code) if code \
+                    else ST_RUNNING
+                if pages_over is not None:
+                    cc[_C_PAGES] = int(key[1])
+                children.append((cc, frames.copy(), cols, {}))
+            self._install_children(b, children)
+            return True
+
+        if hid == H_BRZ:
+            cond = _u32(slo[sp - 1])
+            children = []
+            for key, cols in self._partition([(cond == 0).astype(np.int64)]):
+                cc = ctrl.copy()
+                cc[_C_PC] = a if key[0] else pc + 1
+                cc[_C_SP] = sp - 1
+                cc[_C_STATUS] = ST_RUNNING
+                children.append((cc, frames.copy(), cols, {}))
+            self._install_children(b, children)
+            return True
+
+        if hid == H_BRNZ:
+            cond = _u32(slo[sp - 1])
+            tgt_sp = ob + c_op
+            children = []
+            for key, cols in self._partition([(cond != 0).astype(np.int64)]):
+                cc = ctrl.copy()
+                writes = {}
+                if key[0]:  # taken
+                    cc[_C_PC] = a
+                    cc[_C_SP] = tgt_sp + b_op
+                    if b_op == 1:
+                        writes[("stack", tgt_sp)] = (slo[sp - 2, cols],
+                                                     shi[sp - 2, cols])
+                else:
+                    cc[_C_PC] = pc + 1
+                    cc[_C_SP] = sp - 1
+                cc[_C_STATUS] = ST_RUNNING
+                children.append((cc, frames.copy(), cols, writes))
+            self._install_children(b, children)
+            return True
+
+        if hid == H_BR_TABLE:
+            idx = _u32(slo[sp - 1])
+            brt = self.eng.img.br_table
+            ii = np.minimum(idx, b_op)
+            children = []
+            for key, cols in self._partition([ii]):
+                e = a + int(key[0])
+                tgt, nkeep, pop_to = (int(brt[e, 0]), int(brt[e, 1]),
+                                     int(brt[e, 2]))
+                tgt_sp = ob + pop_to
+                cc = ctrl.copy()
+                cc[_C_PC] = tgt
+                cc[_C_SP] = tgt_sp + nkeep
+                cc[_C_STATUS] = ST_RUNNING
+                writes = {}
+                if nkeep == 1:
+                    writes[("stack", tgt_sp)] = (slo[sp - 2, cols],
+                                                 shi[sp - 2, cols])
+                children.append((cc, frames.copy(), cols, writes))
+            self._install_children(b, children)
+            return True
+
+        if H_FUSE_GCB_BASE <= hid < H_FUSE_GCB_BASE + NUM_ALU2:
+            sub = hid - H_FUSE_GCB_BASE
+            src = fp + a
+            imm_lo = np.full(Lblk, fused["ilo"][pc], np.int32)
+            imm_hi = np.full(Lblk, fused["ihi"][pc], np.int32)
+            res = _host_alu2(sub, slo[src], shi[src], imm_lo, imm_hi)
+            if res is None:
+                return False
+            cond = _u32(res[0])
+            children = []
+            for key, cols in self._partition([(cond == 0).astype(np.int64)]):
+                cc = ctrl.copy()
+                cc[_C_PC] = b_op if key[0] else pc + 4
+                cc[_C_STATUS] = ST_RUNNING
+                children.append((cc, frames.copy(), cols, {}))
+            self._install_children(b, children)
+            return True
+
+        if H_FUSE_GGBZ_BASE <= hid < H_FUSE_GGBNZ_BASE + NUM_ALU2:
+            nz = hid >= H_FUSE_GGBNZ_BASE
+            sub = hid - (H_FUSE_GGBNZ_BASE if nz else H_FUSE_GGBZ_BASE)
+            s1 = fp + int(fused["ilo"][pc])
+            s2 = fp + int(fused["ihi"][pc])
+            res = _host_alu2(sub, slo[s1], shi[s1], slo[s2], shi[s2])
+            if res is None:
+                return False
+            cond = _u32(res[0])
+            taken_key = (cond != 0) if nz else (cond == 0)
+            tgt_sp = ob + c_op
+            children = []
+            for key, cols in self._partition([taken_key.astype(np.int64)]):
+                cc = ctrl.copy()
+                writes = {}
+                if key[0]:  # taken
+                    cc[_C_PC] = a
+                    if nz:
+                        cc[_C_SP] = tgt_sp + b_op
+                        if b_op == 1:
+                            writes[("stack", tgt_sp)] = (slo[sp - 1, cols],
+                                                         shi[sp - 1, cols])
+                else:
+                    cc[_C_PC] = pc + 4
+                cc[_C_STATUS] = ST_RUNNING
+                children.append((cc, frames.copy(), cols, writes))
+            self._install_children(b, children)
+            return True
+
+        if hid == H_CALL_INDIRECT:
+            idx = _u32(slo[sp - 1])
+            tbl = self.eng.img.table0
+            children = []
+            for key, cols in self._partition([idx]):
+                i0 = int(key[0])
+                cc = ctrl.copy()
+                code = 0
+                if i0 >= b_op:
+                    code = int(ErrCode.UndefinedElement)
+                else:
+                    h = int(tbl[min(c_op + i0, len(tbl) - 1)])
+                    if h == 0:
+                        code = int(ErrCode.UninitializedElement)
+                    elif int(self.eng.img.f_type[h - 1]) != a:
+                        code = int(ErrCode.IndirectCallTypeMismatch)
+                if code:
+                    cc[_C_STATUS] = ST_TRAPPED_BASE + code
+                    children.append((cc, frames.copy(), cols, {}))
+                    continue
+                cc[_C_SP] = sp - 1
+                trip = self._host_call(cc, frames.copy(), h - 1, sp - 1, pc)
+                children.append((trip[0], trip[1], cols, trip[2]))
+            self._install_children(b, children)
+            return True
+
+        if hid == H_MEMGROW:
+            delta = slo[sp - 1].astype(np.int64)
+            img = self.eng.img
+            cap = self.eng._geom[2] // _PAGE_WORDS if img.has_memory else 0
+            hard = max(img.mem_pages_max, img.mem_pages_init) \
+                if img.has_memory else 0
+            pages = int(ctrl[_C_PAGES])
+            children = []
+            for key, cols in self._partition([delta]):
+                d = int(key[0])
+                legal = 0 <= d and pages + d <= hard
+                if legal and pages + d > cap:
+                    return False  # needs the big-plane engine
+                cc = ctrl.copy()
+                cc[_C_PC] = pc + 1
+                cc[_C_PAGES] = pages + d if legal else pages
+                cc[_C_STATUS] = ST_RUNNING
+                writes = {("stack", sp - 1): (
+                    np.full(len(cols), pages if legal else -1, np.int32),
+                    np.zeros(len(cols), np.int32))}
+                children.append((cc, frames.copy(), cols, writes))
+            self._install_children(b, children)
+            return True
+
+        # data-divergent loads/stores/copies (no trap codes, control not
+        # advanced) need per-lane memory addressing -> SIMT
+        return False
+
+    def _host_call(self, cc, frames, callee, sp_eff, pc):
+        """Apply _do_call semantics host-side for one uniform side."""
+        img = self.eng.img
+        D, CD = self.eng._geom[0], self.eng._geom[1]
+        nargs = int(img.f_nparams[callee])
+        nloc = int(img.f_nlocals[callee])
+        cd = int(cc[_C_CD])
+        fp_new = sp_eff - nargs
+        ob_new = fp_new + nloc
+        if cd >= CD - 1:
+            cc[_C_STATUS] = ST_TRAPPED_BASE + int(ErrCode.CallStackExhausted)
+            return cc, frames, {}
+        if fp_new + int(img.f_frame_top[callee]) > D:
+            cc[_C_STATUS] = ST_TRAPPED_BASE + int(ErrCode.StackOverflow)
+            return cc, frames, {}
+        frames[0, cd] = pc + 1
+        frames[1, cd] = int(cc[_C_FP])
+        frames[2, cd] = int(cc[_C_OB])
+        writes = {}
+        for k in range(nloc - nargs):
+            writes[("stack", fp_new + nargs + k)] = (0, 0)
+        cc[_C_PC] = int(img.f_entry[callee])
+        cc[_C_SP] = ob_new
+        cc[_C_FP] = fp_new
+        cc[_C_OB] = ob_new
+        cc[_C_CD] = cd + 1
+        cc[_C_STATUS] = ST_RUNNING
+        return cc, frames, writes
+
+    @staticmethod
+    def _partition(keys: List[np.ndarray]):
+        """Partition columns by key tuples, first-seen order.  Pads carry
+        their clone source's data, so they follow its side and stay
+        harmless clones there."""
+        out = []
+        seen = {}
+        for col in range(len(keys[0])):
+            key = tuple(int(k[col]) for k in keys)
+            if key in seen:
+                out[seen[key]][1].append(col)
+            else:
+                seen[key] = len(out)
+                out.append((key, [col]))
+        return [(k, np.asarray(c, np.int64)) for k, c in out]
+
+    def _install_children(self, b: int, children):
+        """Queue child groups; immediately-trapped ones harvest in place."""
+        ids = self.block_lanes[b]
+        steps0 = int(self.block_steps[b])
+        for (cc, fr, cols, writes) in children:
+            lane_ids = ids[cols]
+            sel = lane_ids >= 0
+            if not sel.any():
+                continue  # a pad-only side: drop it
+            st = int(cc[_C_STATUS])
+            if st >= ST_TRAPPED_BASE:
+                vids = lane_ids[sel].astype(np.int64)
+                self.trap[vids] = st - ST_TRAPPED_BASE
+                self.retired[vids] = steps0
+                continue
+            vcols = cols[sel]
+            child_cols = self._extract_cols(b, vcols, writes, sel)
+            cc[_C_CHUNK] = self.cfg.steps_per_launch
+            self._pending.append(_Pending(
+                ctrl=cc, frames=fr, cols=child_cols,
+                lane_ids=lane_ids[sel].astype(np.int64), steps0=steps0))
+        self._free_block(b)
+
+    def _extract_cols(self, b: int, cols, writes, sel=None):
+        """Pull a child's valid columns, applying the side's writes.
+
+        `writes` values are either (lo, hi) scalars or (lo, hi) arrays
+        indexed like the PRE-selection column list; `sel` maps them down
+        to the valid columns."""
+        Lblk = self.Lblk
+        lo = b * Lblk
+        out = {}
+        for name, idx in _PLANE_IDX.items():
+            out[name] = np.array(self.state[idx][:, lo + cols])
+        for key, val in writes.items():
+            row = key[1]
+            vlo, vhi = val
+            if np.ndim(vlo):
+                vlo = np.asarray(vlo)[sel] if sel is not None else vlo
+            if np.ndim(vhi):
+                vhi = np.asarray(vhi)[sel] if sel is not None else vhi
+            out["slo"][row] = vlo
+            out["shi"][row] = vhi
+        return out
+
+    def _install_pending(self) -> bool:
+        """Move queued children into free block slots."""
+        if not self._pending:
+            return False
+        free = [b for b in range(self.nblk)
+                if self.block_state[b] == _B_FREE]
+        if not free:
+            return False
+        import jax.numpy as jnp
+
+        ctrl = np.array(self.state[0])
+        frames = np.array(self.state[1])
+        planes = {i: np.array(self.state[i]) for i in range(2, 8)}
+        Lblk = self.Lblk
+        while self._pending and free:
+            p = self._pending.pop(0)
+            b = free.pop(0)
+            lo = b * Lblk
+            n = len(p.lane_ids)
+            # pad by cloning the first column
+            sel = np.concatenate(
+                [np.arange(n), np.zeros(max(Lblk - n, 0), np.int64)])
+            for name, i in _PLANE_IDX.items():
+                planes[i][:, lo:lo + Lblk] = p.cols[name][:, sel]
+            ctrl[b] = p.ctrl
+            frames[b] = p.frames
+            ids = np.full(Lblk, -1, np.int64)
+            ids[:n] = p.lane_ids
+            self.block_lanes[b] = ids
+            self.block_state[b] = _B_LIVE
+            self.block_steps[b] = p.steps0
+        self.state[0] = jnp.asarray(ctrl)
+        self.state[1] = jnp.asarray(frames)
+        for i in range(2, 8):
+            self.state[i] = jnp.asarray(planes[i])
+        return True
+
+    # -- SIMT residue ------------------------------------------------------
+    def _to_simt(self, b: int, ctrl, frames, pages_over=None):
+        """Queue a block's valid lanes for the final SIMT pass."""
+        ids = self.block_lanes[b]
+        vcols = np.nonzero(ids >= 0)[0]
+        cols = self._extract_cols(b, vcols, {})
+        self._simt_queue.append(_Pending(
+            ctrl=ctrl.copy(), frames=frames.copy(), cols=cols,
+            lane_ids=ids[vcols].astype(np.int64),
+            steps0=int(self.block_steps[b]),
+            pages=pages_over[vcols].astype(np.int32)
+            if pages_over is not None else None))
+        self._free_block(b)
+
+    def _run_simt_residue(self):
+        if not self._simt_queue:
+            return
+        import jax.numpy as jnp
+
+        from wasmedge_tpu.batch.engine import BatchState
+
+        self.fell_back_to_simt = True
+        simt = self.eng.simt
+        cfg = self.cfg
+        L = simt.lanes
+        D_s, CD_s = cfg.value_stack_depth, cfg.call_stack_depth
+        img = self.eng.img
+        simt_w = max(img.mem_pages_max * _PAGE_WORDS, 1) \
+            if img.has_memory else 1
+        NG = max(img.globals_lo.shape[0], 1)
+        pc = np.zeros(L, np.int32)
+        sp = np.zeros(L, np.int32)
+        fp = np.zeros(L, np.int32)
+        ob = np.zeros(L, np.int32)
+        cd = np.zeros(L, np.int32)
+        pages = np.zeros(L, np.int32)
+        fuel = np.zeros(L, np.int32)
+        trap = np.full(L, TRAP_DONE, np.int32)   # non-members: done
+        retired0 = np.zeros(L, np.int64)
+        s_lo = np.zeros((D_s, L), np.int32)
+        s_hi = np.zeros((D_s, L), np.int32)
+        g_lo = np.zeros((NG, L), np.int32)
+        g_hi = np.zeros((NG, L), np.int32)
+        mem = np.zeros((simt_w, L), np.int32)
+        frp = np.zeros((CD_s, L), np.int32)
+        frf = np.zeros((CD_s, L), np.int32)
+        fro = np.zeros((CD_s, L), np.int32)
+        members = []
+        for p in self._simt_queue:
+            n = len(p.lane_ids)
+            li = p.lane_ids
+            members.append(li)
+            pc[li] = p.ctrl[_C_PC]
+            sp[li] = p.ctrl[_C_SP]
+            fp[li] = p.ctrl[_C_FP]
+            ob[li] = p.ctrl[_C_OB]
+            cd[li] = p.ctrl[_C_CD]
+            pages[li] = p.ctrl[_C_PAGES] if p.pages is None else p.pages
+            if cfg.fuel_per_launch is not None:
+                fuel[li] = max(int(p.ctrl[_C_FUEL]), 0)
+            trap[li] = p.cols["trap"][0][:n]
+            retired0[li] = p.steps0
+            d = min(p.cols["slo"].shape[0], D_s)
+            s_lo[:d, li] = p.cols["slo"][:d, :n]
+            s_hi[:d, li] = p.cols["shi"][:d, :n]
+            g = min(p.cols["glo"].shape[0], NG)
+            g_lo[:g, li] = p.cols["glo"][:g, :n]
+            g_hi[:g, li] = p.cols["ghi"][:g, :n]
+            m = min(p.cols["mem"].shape[0], simt_w)
+            mem[:m, li] = p.cols["mem"][:m, :n]
+            ncd = min(p.frames.shape[1], CD_s)
+            frp[:ncd, li] = p.frames[0, :ncd, None]
+            frf[:ncd, li] = p.frames[1, :ncd, None]
+            fro[:ncd, li] = p.frames[2, :ncd, None]
+        state = BatchState(
+            pc=jnp.asarray(pc), sp=jnp.asarray(sp), fp=jnp.asarray(fp),
+            opbase=jnp.asarray(ob), call_depth=jnp.asarray(cd),
+            trap=jnp.asarray(trap),
+            retired=jnp.asarray(np.zeros(L, np.int32)),
+            fuel=jnp.asarray(fuel), mem_pages=jnp.asarray(pages),
+            stack_lo=jnp.asarray(s_lo), stack_hi=jnp.asarray(s_hi),
+            fr_ret_pc=jnp.asarray(frp), fr_fp=jnp.asarray(frf),
+            fr_opbase=jnp.asarray(fro),
+            glob_lo=jnp.asarray(g_lo), glob_hi=jnp.asarray(g_hi),
+            mem=jnp.asarray(mem))
+        # account for work already done on the kernel so the caller's
+        # max_steps bounds TOTAL execution, not each engine separately
+        # (coarse like the pre-scheduler handoff: the max over members)
+        total0 = max(int(p.steps0) for p in self._simt_queue)
+        state, total = simt.run_from_state(state, total0, self.max_steps)
+        self._residue_steps = int(total)
+        all_m = np.concatenate(members)
+        trap_f = np.asarray(state.trap)
+        ret_f = np.asarray(state.retired).astype(np.int64)
+        self.trap[all_m] = trap_f[all_m]
+        self.retired[all_m] = retired0[all_m] + ret_f[all_m]
+        if self.nres:
+            s_lo_f = np.asarray(state.stack_lo[:self.nres])
+            s_hi_f = np.asarray(state.stack_hi[:self.nres])
+            self.res_lo[:, all_m] = s_lo_f[:, all_m]
+            self.res_hi[:, all_m] = s_hi_f[:, all_m]
+
+    # -- result ------------------------------------------------------------
+    def result(self):
+        from wasmedge_tpu.batch.engine import BatchResult
+        from wasmedge_tpu.batch.pallas_engine import decode_result_rows
+
+        results = decode_result_rows(self.res_lo, self.res_hi, self.nres)
+        steps = max(int(self.block_steps.max(initial=0)),
+                    getattr(self, "_residue_steps", 0))
+        return BatchResult(results=results, trap=self.trap,
+                           retired=self.retired, steps=steps)

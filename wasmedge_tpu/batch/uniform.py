@@ -670,21 +670,11 @@ class UniformBatchEngine:
         backend is TPU and the module fits the kernel geometry; the
         per-step XLA path below remains the CPU/testing vehicle and the
         fallback for oversized modules (conf.batch.use_pallas overrides)."""
-        use = self.cfg.use_pallas
-        if use is None:
-            from wasmedge_tpu.batch import ensure_jax_backend
+        from wasmedge_tpu.batch.pallas_engine import (
+            PallasUniformEngine, pallas_enabled)
 
-            ensure_jax_backend()
-            import jax
-
-            use = jax.default_backend() == "tpu"
-        # cfg.interpret=True is an opt-in to the Pallas interpret path even
-        # when use_pallas is unset/False (same knob semantics as
-        # MultiTenantBatchEngine._try_pallas)
-        if not use and not self.cfg.interpret:
+        if not pallas_enabled(self.cfg):
             return None
-        from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
-
         eng = PallasUniformEngine(inst, conf=conf, simt=self.simt,
                                   interpret=self.cfg.interpret or None)
         return eng if eng.eligible else None
