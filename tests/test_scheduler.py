@@ -68,12 +68,13 @@ def test_entry_grouping_avoids_all_splits():
 
 
 def test_many_groups_split_then_converge():
-    # more arg values than MIN_GROUP_LANES allows for clean grouping:
-    # straddle blocks split once at the first differing branch, then run
-    # converged
-    ns = (np.arange(LANES, dtype=np.int64) % 4) + 6
+    # 7 shattered fib arg groups (median < MIN_GROUP_LANES -> identity
+    # packing): the block MUST diverge mid-recursion and split, carrying
+    # live call frames into the children, then run converged
+    ns = (np.arange(LANES, dtype=np.int64) % 7) + 4
     eng, res = run_and_check(build_fib(), "fib", [ns])
     assert not eng.fell_back_to_simt
+    assert eng.splits > 0
 
 
 def test_divergent_br_table_splits():
@@ -171,13 +172,14 @@ def test_simt_residue_isolated_to_bad_group():
 
 
 def test_deep_split_cascade_recursion():
-    # a straddle block of two fib arg groups splits exactly where the
-    # recursion depths first disagree; afterwards both sides complete on
-    # the kernel with live call frames carried through the split
-    ns = np.concatenate([np.full(LANES - 4, 11, np.int64),
-                         np.full(4, 13, np.int64)])
+    # shattered args force identity packing; lanes at different recursion
+    # depths split exactly where the depths first disagree; both sides
+    # complete on the kernel with live call frames carried through
+    ns = np.asarray([11, 13, 9, 12, 10, 14] * 6 or [], np.int64)[:LANES]
+    ns = np.concatenate([ns, np.full(LANES - len(ns), 8, np.int64)])
     eng, res = run_and_check(build_fib(), "fib", [ns])
     assert not eng.fell_back_to_simt
+    assert eng.splits > 0
 
 
 def test_max_steps_reports_running_lanes():

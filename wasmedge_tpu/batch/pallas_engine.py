@@ -712,6 +712,16 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             oob = u_lt(end, dst) | u_lt(full(mem_bytes), end)
             go = (~oob) & (n != 0)
             fill_word = (val & 0xFF) * I32(0x01010101)
+            # scan only the touched row window (a small fill must not pay
+            # a whole-plane pass)
+            dst_ok = jnp.where(go, dst, I32(0x7FFFFFFF))
+            end_ok = jnp.where(go, end, I32(0))
+            c_lo = jnp.clip(
+                lax.div(lax.shift_right_logical(jnp.min(dst_ok), 2),
+                        I32(GR)), 0, GATHER_CHUNKS)
+            c_hi = jnp.clip(
+                lax.div(lax.shift_right_logical(jnp.max(end_ok) + 3, 2)
+                        + I32(GR - 1), I32(GR)), 0, GATHER_CHUNKS)
 
             def chunk(i, _):
                 base = i * GR
@@ -729,7 +739,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     write, (rows & ~mask) | (fill_word & mask), rows)
                 return 0
 
-            lax.fori_loop(0, GATHER_CHUNKS, chunk, 0)
+            lax.fori_loop(c_lo, c_hi, chunk, 0)
             any_oob = jnp.any(oob)
 
             @pl.when(any_oob)
@@ -829,10 +839,21 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             return keep(c, status=I32(ST_HOSTCALL))
 
         # ---- memory access ------------------------------------------
-        def _gather_word(widx):
+        # NOTE predication discipline: `lax.cond` whose branches return
+        # vectors or mutate refs is DISCHARGED by pallas into
+        # execute-both-and-select — a "rare" divergent-gather branch
+        # would then run its whole-memory scan on every access.  All
+        # vector/ref work below therefore sits under `pl.when` (real
+        # Mosaic predicated blocks); only the scalar carry goes through
+        # lax.cond.
+
+        def _gather_word(widx, row_lo, row_hi):
             """Per-lane word gather from [W, Lblk] by chunked
-            compare-reduce: exactly one iota row matches each lane's
-            index, so the running sum collapses to that lane's word."""
+            compare-reduce over the touched row window only."""
+            c_lo = jnp.clip(lax.div(row_lo, I32(GR)), 0, GATHER_CHUNKS)
+            c_hi = jnp.clip(lax.div(row_hi + I32(GR - 1), I32(GR)),
+                            0, GATHER_CHUNKS)
+
             def chunk(i, acc):
                 base = i * GR
                 rows = memr[pl.ds(base, GR), :]
@@ -840,40 +861,12 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 return acc + jnp.sum(jnp.where(wi == widx, rows, 0),
                                      axis=0, keepdims=True)
 
-            return lax.fori_loop(0, GATHER_CHUNKS, chunk,
+            return lax.fori_loop(c_lo, c_hi, chunk,
                                  jnp.zeros((1, Lblk), I32))
 
-        def h_load(c):
-            pc, sp, pages = c[1], c[2], c[6]
-            off, nbytes, flags = a_r[pc], b_r[pc], c_r[pc]
-            addr = srow(slo, sp - 1)
-            ea = addr + off
-            carry_ = u_lt(ea, addr) | u_lt(ea, full(off))
-            mem_bytes = pages * I32(65536)
-            end = ea + nbytes
-            oob = carry_ | u_lt(end, ea) | u_lt(full(mem_bytes), end)
-            widx = jnp.clip(lax.shift_right_logical(ea, 2), 0, W - 1)
-            shB = (ea & 3) * 8
-            u0 = scal(widx)
-            uni = allsame(widx, u0) & allsame(shB, scal(shB))
-            commit = jnp.bool_(True) if gatherable else uni
-
-            def rows_uniform():
-                u = jnp.clip(u0, 0, W - 1)
-                return (srow(memr, u),
-                        srow(memr, jnp.clip(u + 1, 0, W - 1)),
-                        srow(memr, jnp.clip(u + 2, 0, W - 1)))
-
-            if gatherable:
-                def rows_divergent():
-                    return (_gather_word(widx),
-                            _gather_word(jnp.clip(widx + 1, 0, W - 1)),
-                            _gather_word(jnp.clip(widx + 2, 0, W - 1)))
-
-                mw0, mw1, mw2 = lax.cond(uni, rows_uniform, rows_divergent)
-            else:
-                mw0, mw1, mw2 = rows_uniform()
-
+        def _load_finish(c, mw0, mw1, mw2, shB, oob, any_oob):
+            pc, sp = c[1], c[2]
+            nbytes, flags = b_r[pc], c_r[pc]
             inv = (32 - shB) & 31
             hi_or = jnp.where(shB == 0, 0, -1)
             raw_lo = lax.shift_right_logical(mw0, shB) | \
@@ -891,7 +884,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 lax.shift_right_arithmetic(lax.shift_left(raw_lo, 24), 24),
                 jnp.where(
                     b2_,
-                    lax.shift_right_arithmetic(lax.shift_left(raw_lo, 16), 16),
+                    lax.shift_right_arithmetic(lax.shift_left(raw_lo, 16),
+                                               16),
                     raw_lo))
             ll = jnp.where(signed, lsext, lraw)
             lh = jnp.where(
@@ -901,18 +895,50 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                                     lax.shift_right_arithmetic(ll, 31),
                                     full(0))),
                 full(0))
+            wrow(slo, sp - 1, ll)
+            wrow(shi, sp - 1, lh)
+
+            @pl.when(any_oob)
+            def _():
+                trapr[0, :] = jnp.where(
+                    oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
+                    trapr[0, :])
+
+        def h_load(c):
+            pc, sp, pages = c[1], c[2], c[6]
+            off, nbytes = a_r[pc], b_r[pc]
+            addr = srow(slo, sp - 1)
+            ea = addr + off
+            carry_ = u_lt(ea, addr) | u_lt(ea, full(off))
+            mem_bytes = pages * I32(65536)
+            end = ea + nbytes
+            oob = carry_ | u_lt(end, ea) | u_lt(full(mem_bytes), end)
+            widx = jnp.clip(lax.shift_right_logical(ea, 2), 0, W - 1)
+            shB = (ea & 3) * 8
+            u0 = scal(widx)
+            uni = allsame(widx, u0) & allsame(shB, scal(shB))
+            commit = jnp.bool_(True) if gatherable else uni
             any_oob = jnp.any(oob)
 
-            @pl.when(commit)
+            @pl.when(uni)
             def _():
-                wrow(slo, sp - 1, ll)
-                wrow(shi, sp - 1, lh)
+                u = jnp.clip(u0, 0, W - 1)
+                _load_finish(c, srow(memr, u),
+                             srow(memr, jnp.clip(u + 1, 0, W - 1)),
+                             srow(memr, jnp.clip(u + 2, 0, W - 1)),
+                             shB, oob, any_oob)
 
-                @pl.when(any_oob)
+            if gatherable:
+                @pl.when(~uni)
                 def _():
-                    trapr[0, :] = jnp.where(
-                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
-                        trapr[0, :])
+                    r_lo = jnp.min(widx)
+                    r_hi = jnp.max(widx) + 3
+                    w1 = jnp.clip(widx + 1, 0, W - 1)
+                    w2 = jnp.clip(widx + 2, 0, W - 1)
+                    _load_finish(c, _gather_word(widx, r_lo, r_hi),
+                                 _gather_word(w1, r_lo, r_hi),
+                                 _gather_word(w2, r_lo, r_hi),
+                                 shB, oob, any_oob)
 
             return lax.cond(
                 commit,
@@ -951,7 +977,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             commit = jnp.bool_(True) if gatherable else uni
             any_oob = jnp.any(oob)
 
-            def rmw_uniform():
+            @pl.when(uni)
+            def _():
                 for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
                                             (sm2, sv2))):
                     w = jnp.clip(u0 + k, 0, W - 1)
@@ -964,7 +991,13 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                                        cur))
 
             if gatherable:
-                def rmw_divergent():
+                @pl.when(~uni)
+                def _():
+                    c_lo = jnp.clip(lax.div(jnp.min(widx), I32(GR)),
+                                    0, GATHER_CHUNKS)
+                    c_hi = jnp.clip(
+                        lax.div(jnp.max(widx) + I32(2 + GR), I32(GR)),
+                        0, GATHER_CHUNKS)
                     for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
                                                 (sm2, sv2))):
                         wk = jnp.clip(widx + k, 0, W - 1)
@@ -979,13 +1012,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                                 hit, (rows & ~m) | (v & m), rows)
                             return 0
 
-                        lax.fori_loop(0, GATHER_CHUNKS, chunk, 0)
-
-                lax.cond(uni, rmw_uniform, rmw_divergent)
-            else:
-                @pl.when(uni)
-                def _():
-                    rmw_uniform()
+                        lax.fori_loop(c_lo, c_hi, chunk, 0)
 
             @pl.when(commit & any_oob)
             def _():

@@ -163,6 +163,24 @@ def _host_alu2(sub: int, xl, xh, yl, yh):
     return None
 
 
+class _Rows:
+    """Lazy row-sliced view of a [rows, L] device plane: downloads one
+    row's block columns at a time, cached."""
+
+    def __init__(self, arr, lo: int, n: int):
+        self._arr, self._lo, self._n = arr, lo, n
+        self._c = {}
+
+    def __getitem__(self, key):
+        if isinstance(key, tuple):
+            row, cols = key
+            return self[row][cols]
+        r = int(key)
+        if r not in self._c:
+            self._c[r] = np.asarray(self._arr[r, self._lo:self._lo + self._n])
+        return self._c[r]
+
+
 @dataclasses.dataclass
 class _Pending:
     """A control-uniform lane group waiting for a free block slot."""
@@ -251,6 +269,16 @@ class BlockScheduler:
                 lblk *= 2
             self.order = order
             group_sizes = [int(s) for s in sizes]
+            # guard: per-group padding must not inflate the packed state
+            # unboundedly (hundreds of sub-align groups would each claim
+            # a full block of HBM planes and a serialized block slot) —
+            # past 2x the caller's lanes, identity packing + in-flight
+            # splitting degrades more gracefully
+            padded = sum(-(-g // lblk) * lblk for g in group_sizes)
+            if padded > 2 * self.lanes:
+                lblk = lblk_max
+                self.order = np.arange(self.lanes)
+                group_sizes = [self.lanes]
         blocks: List[np.ndarray] = []   # each [lblk] lane ids (-1 = pad)
         pos = 0
         for g in group_sizes:
@@ -298,9 +326,19 @@ class BlockScheduler:
         self.block_steps = np.zeros(self.nblk, np.int64)
         self._pending: List[_Pending] = []
         self._simt_queue: List[_Pending] = []
+        self._ctrl_cache = None
+        self._ctrl_dirty = False
+        self._frames_cache = None
+        self._frames_dirty = False
         self._build_initial_state()
 
     def _build_initial_state(self):
+        """Construct the packed state ON DEVICE.  Host->device bandwidth
+        is the scarce resource (the bench TPU sits behind a tunnel):
+        only the argument rows (nargs x L) and the module's memory init
+        image (<= W words) are uploaded; the big zero planes are
+        jnp.zeros and the per-lane broadcast of mem_init happens
+        device-side."""
         import jax.numpy as jnp
 
         eng = self.eng
@@ -315,23 +353,32 @@ class BlockScheduler:
             seg = self.block_lanes[b]
             first = seg[seg >= 0][0]
             flat[b * Lblk:(b + 1) * Lblk][seg < 0] = first
-        stack_lo = np.zeros((D, L), np.int32)
-        stack_hi = np.zeros((D, L), np.int32)
-        for i, arg in enumerate(self.args):
-            vals = arg[flat]
-            stack_lo[i] = (vals & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
-            stack_hi[i] = ((vals >> 32) & 0xFFFFFFFF).astype(
-                np.uint32).view(np.int32)
+        stack_lo = jnp.zeros((D, L), jnp.int32)
+        stack_hi = jnp.zeros((D, L), jnp.int32)
+        if self.args:
+            arg_m = np.stack([a[flat] for a in self.args])  # [nargs, L]
+            lo = (arg_m & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+            hi = ((arg_m >> 32) & 0xFFFFFFFF).astype(np.uint32).view(
+                np.int32)
+            stack_lo = stack_lo.at[:len(self.args)].set(jnp.asarray(lo))
+            stack_hi = stack_hi.at[:len(self.args)].set(jnp.asarray(hi))
         NGp = max(img.globals_lo.shape[0], 1)
-        glo = np.zeros((NGp, L), np.int32)
-        ghi = np.zeros((NGp, L), np.int32)
-        if img.globals_lo.shape[0]:
-            glo[:img.globals_lo.shape[0]] = img.globals_lo[:, None]
-            ghi[:img.globals_hi.shape[0]] = img.globals_hi[:, None]
-        mem = np.zeros((W, L), np.int32)
+        glo = jnp.zeros((NGp, L), jnp.int32)
+        ghi = jnp.zeros((NGp, L), jnp.int32)
+        ng = img.globals_lo.shape[0]
+        if ng:
+            glo = glo.at[:ng].set(
+                jnp.broadcast_to(jnp.asarray(img.globals_lo)[:, None],
+                                 (ng, L)))
+            ghi = ghi.at[:ng].set(
+                jnp.broadcast_to(jnp.asarray(img.globals_hi)[:, None],
+                                 (ng, L)))
+        mem = jnp.zeros((W, L), jnp.int32)
         if img.mem_init.shape[0] > 1 or img.mem_pages_init:
             n = min(img.mem_init.shape[0], W)
-            mem[:n] = img.mem_init[:n, None]
+            mem = mem.at[:n].set(
+                jnp.broadcast_to(jnp.asarray(img.mem_init[:n])[:, None],
+                                 (n, L)))
         ctrl = np.zeros((self.nblk, 16), np.int32)
         ctrl[:, _C_PC] = meta.entry_pc
         ctrl[:, _C_SP] = meta.nlocals
@@ -342,9 +389,8 @@ class BlockScheduler:
         ctrl[:, _C_FUEL] = _FUEL_OFF if fuel is None else fuel
         self.state = [jnp.asarray(ctrl),
                       jnp.zeros((self.nblk, 3, CD), jnp.int32),
-                      jnp.asarray(stack_lo), jnp.asarray(stack_hi),
-                      jnp.asarray(glo), jnp.asarray(ghi),
-                      jnp.asarray(mem), jnp.zeros((1, L), jnp.int32)]
+                      stack_lo, stack_hi, glo, ghi, mem,
+                      jnp.zeros((1, L), jnp.int32)]
 
     # -- drive -------------------------------------------------------------
     def run(self):
@@ -360,7 +406,15 @@ class BlockScheduler:
         dispatch is asynchronous (JAX): multiple schedulers' launches
         pipeline on the device while hosts process results — the
         latency-hiding seam the multi-tenant driver uses."""
-        ctrl_np = np.asarray(self.state[0])
+        import jax.numpy as jnp
+
+        ctrl_np = self._ctrl()
+        if self._ctrl_dirty:
+            self.state[0] = jnp.asarray(ctrl_np)
+            self._ctrl_dirty = False
+        if self._frames_dirty:
+            self.state[1] = jnp.asarray(self._frames_cache)
+            self._frames_dirty = False
         live = self.block_state == _B_LIVE
         runnable = live & (ctrl_np[:, _C_STATUS] == ST_RUNNING) & \
             (self.block_steps < self.max_steps)
@@ -370,12 +424,31 @@ class BlockScheduler:
             out = self.eng._fn(*self.eng._tables, self.state[0],
                                self.state[1], *self.state[2:])
             self.state = list(out)
+            self._ctrl_cache = None   # kernel wrote fresh ctrl/frames
+            self._frames_cache = None
+
+    def _ctrl(self) -> np.ndarray:
+        """Host mirror of the ctrl plane: ONE transfer per kernel round.
+        Every per-block interaction below reads/writes this mirror (tiny
+        transfers each pay the host link's full round-trip latency —
+        fatal over a tunneled TPU at ~100ms RTT)."""
+        if self._ctrl_cache is None:
+            self._ctrl_cache = np.array(self.state[0])
+            self._ctrl_dirty = False
+        return self._ctrl_cache
+
+    def _frames(self) -> np.ndarray:
+        """Host mirror of the frames plane (same discipline as _ctrl)."""
+        if self._frames_cache is None:
+            self._frames_cache = np.array(self.state[1])
+            self._frames_dirty = False
+        return self._frames_cache
 
     def process(self) -> bool:
         """Sync on the launch (if any) and handle block statuses.
         Returns False when the kernel side is finished (residue may
         remain for _run_simt_residue)."""
-        ctrl_np = np.asarray(self.state[0])
+        ctrl_np = self._ctrl()
         if self._launched:
             live = self._live_at_launch
             new_steps = ctrl_np[:, _C_STEPS].astype(np.int64)
@@ -395,28 +468,47 @@ class BlockScheduler:
         True if progress was made that could unblock another pass."""
         progress = False
         hostcall_blocks = []
+        # classify first so the downloads below batch into single
+        # transfers covering every block that needs them
+        harvests = []
+        splits = []
         for b in range(self.nblk):
             if self.block_state[b] != _B_LIVE:
                 continue
             status = int(ctrl_np[b, _C_STATUS])
             if status == ST_RUNNING:
                 if self.block_steps[b] >= self.max_steps:
-                    self._harvest(b, ctrl_np, running=True)
-                    progress = True
+                    harvests.append((b, True))
                 continue
             if status == ST_DONE or status >= ST_TRAPPED_BASE:
-                self._harvest(b, ctrl_np)
-                progress = True
+                harvests.append((b, False))
             elif status == ST_HOSTCALL:
                 hostcall_blocks.append(b)
             elif status in (ST_DIVERGED, ST_REGROW):
-                self._split(b, ctrl_np, status)
-                progress = True
+                splits.append((b, status))
+        if harvests or splits:
+            self._trap_full = np.asarray(self.state[7][0])
+            if self.nres and harvests:
+                self._res_lo_full = np.asarray(self.state[2][:self.nres])
+                self._res_hi_full = np.asarray(self.state[3][:self.nres])
+        for b, running in harvests:
+            self._harvest(b, ctrl_np, running=running)
+            progress = True
+        for b, status in splits:
+            self._split(b, ctrl_np, status)
+            progress = True
         if hostcall_blocks:
             valid = {b: self.block_lanes[b] >= 0 for b in hostcall_blocks}
+            import jax.numpy as jnp
+
+            if self._ctrl_dirty:
+                self.state[0] = jnp.asarray(ctrl_np)
+                self._ctrl_dirty = False
             self.state = self.eng._serve_hostcalls(
-                self.state, np.asarray(self.state[0]), valid_blocks=valid)
-            ctrl2 = np.asarray(self.state[0])
+                self.state, ctrl_np, valid_blocks=valid)
+            self._ctrl_cache = None
+            ctrl2 = self._ctrl()
+            self._trap_full = np.asarray(self.state[7][0])
             # serving may leave per-lane outcomes (ST_DIVERGED): split now
             for b in hostcall_blocks:
                 st2 = int(ctrl2[b, _C_STATUS])
@@ -436,16 +528,16 @@ class BlockScheduler:
         valid = ids >= 0
         vids = ids[valid].astype(np.int64)
         status = int(ctrl_np[b, _C_STATUS])
-        trap_row = np.asarray(self.state[7][0, lo:lo + Lblk])
+        trap_row = self._trap_full[lo:lo + Lblk]
         if running:
             codes = trap_row.copy()  # 0 = still running
         elif status == ST_DONE:
             codes = np.full(Lblk, TRAP_DONE, np.int32)
             if self.nres:
-                s_lo = np.asarray(self.state[2][:self.nres, lo:lo + Lblk])
-                s_hi = np.asarray(self.state[3][:self.nres, lo:lo + Lblk])
-                self.res_lo[:self.nres, vids] = s_lo[:, valid]
-                self.res_hi[:self.nres, vids] = s_hi[:, valid]
+                self.res_lo[:self.nres, vids] = \
+                    self._res_lo_full[:, lo:lo + Lblk][:, valid]
+                self.res_hi[:self.nres, vids] = \
+                    self._res_hi_full[:, lo:lo + Lblk][:, valid]
         else:
             code = status - ST_TRAPPED_BASE
             codes = np.where(trap_row != 0, trap_row, code).astype(np.int32)
@@ -454,13 +546,11 @@ class BlockScheduler:
         self._free_block(b)
 
     def _free_block(self, b: int):
-        """Park the slot so relaunches skip it."""
-        import jax.numpy as jnp
-
+        """Park the slot (host mirror only; uploaded before the next
+        launch)."""
         self.block_state[b] = _B_FREE
-        ctrl = np.array(self.state[0])
-        ctrl[b, _C_STATUS] = ST_DONE
-        self.state[0] = jnp.asarray(ctrl)
+        self._ctrl()[b, _C_STATUS] = ST_DONE
+        self._ctrl_dirty = True
 
     # -- split machinery ---------------------------------------------------
     def _split(self, b: int, ctrl_np, status: int):
@@ -468,7 +558,7 @@ class BlockScheduler:
         per lane, partition lanes by outcome, install uniform children."""
         eng = self.eng
         ctrl = ctrl_np[b].copy()
-        frames = np.asarray(self.state[1][b])
+        frames = self._frames()[b]
         pages_over = eng._pages_override.pop(b, None)
         self.splits += 1
         if status == ST_REGROW or self.splits > self.split_budget:
@@ -491,8 +581,10 @@ class BlockScheduler:
         c_op = int(fused["c"][pc])
         Lblk = self.Lblk
         lo = b * Lblk
-        slo = np.asarray(self.state[2][:, lo:lo + Lblk])
-        shi = np.asarray(self.state[3][:, lo:lo + Lblk])
+        # lazy per-row download: the resolver inspects only a handful of
+        # stack rows; whole-plane transfers would ride the slow host link
+        slo = _Rows(self.state[2], lo, Lblk)
+        shi = _Rows(self.state[3], lo, Lblk)
         trap_row = np.asarray(self.state[7][0, lo:lo + Lblk])
 
         # Advanced-with-per-lane-outcomes stops come FIRST, regardless of
@@ -744,16 +836,20 @@ class BlockScheduler:
         self._free_block(b)
 
     def _extract_cols(self, b: int, cols, writes, sel=None):
-        """Pull a child's valid columns, applying the side's writes.
+        """Snapshot a child's valid columns as DEVICE arrays (gathers —
+        no host transfer), applying the side's writes.
 
         `writes` values are either (lo, hi) scalars or (lo, hi) arrays
         indexed like the PRE-selection column list; `sel` maps them down
         to the valid columns."""
+        import jax.numpy as jnp
+
         Lblk = self.Lblk
         lo = b * Lblk
+        idx = jnp.asarray(lo + np.asarray(cols, np.int64))
         out = {}
-        for name, idx in _PLANE_IDX.items():
-            out[name] = np.array(self.state[idx][:, lo + cols])
+        for name, i in _PLANE_IDX.items():
+            out[name] = self.state[i][:, idx]
         for key, val in writes.items():
             row = key[1]
             vlo, vhi = val
@@ -761,12 +857,14 @@ class BlockScheduler:
                 vlo = np.asarray(vlo)[sel] if sel is not None else vlo
             if np.ndim(vhi):
                 vhi = np.asarray(vhi)[sel] if sel is not None else vhi
-            out["slo"][row] = vlo
-            out["shi"][row] = vhi
+            out["slo"] = out["slo"].at[row].set(jnp.asarray(vlo))
+            out["shi"] = out["shi"].at[row].set(jnp.asarray(vhi))
         return out
 
     def _install_pending(self) -> bool:
-        """Move queued children into free block slots."""
+        """Move queued children into free block slots.  Plane writes are
+        device-side column-block sets (the snapshots are device arrays),
+        so no state crosses the host link."""
         if not self._pending:
             return False
         free = [b for b in range(self.nblk)
@@ -775,9 +873,8 @@ class BlockScheduler:
             return False
         import jax.numpy as jnp
 
-        ctrl = np.array(self.state[0])
-        frames = np.array(self.state[1])
-        planes = {i: np.array(self.state[i]) for i in range(2, 8)}
+        ctrl = self._ctrl()
+        frames = self._frames()
         Lblk = self.Lblk
         while self._pending and free:
             p = self._pending.pop(0)
@@ -785,10 +882,11 @@ class BlockScheduler:
             lo = b * Lblk
             n = len(p.lane_ids)
             # pad by cloning the first column
-            sel = np.concatenate(
-                [np.arange(n), np.zeros(max(Lblk - n, 0), np.int64)])
+            sel = jnp.asarray(np.concatenate(
+                [np.arange(n), np.zeros(max(Lblk - n, 0), np.int64)]))
             for name, i in _PLANE_IDX.items():
-                planes[i][:, lo:lo + Lblk] = p.cols[name][:, sel]
+                self.state[i] = self.state[i].at[:, lo:lo + Lblk].set(
+                    p.cols[name][:, sel])
             ctrl[b] = p.ctrl
             frames[b] = p.frames
             ids = np.full(Lblk, -1, np.int64)
@@ -796,10 +894,8 @@ class BlockScheduler:
             self.block_lanes[b] = ids
             self.block_state[b] = _B_LIVE
             self.block_steps[b] = p.steps0
-        self.state[0] = jnp.asarray(ctrl)
-        self.state[1] = jnp.asarray(frames)
-        for i in range(2, 8):
-            self.state[i] = jnp.asarray(planes[i])
+            self._ctrl_dirty = True
+            self._frames_dirty = True
         return True
 
     # -- SIMT residue ------------------------------------------------------
