@@ -57,7 +57,8 @@ def save(path, engine: BatchEngine, state: BatchState, total_steps: int):
         },
     }
     arrays = {f"state_{name}": np.asarray(getattr(state, name))
-              for name in state._fields}
+              for name in state._fields
+              if getattr(state, name) is not None}
     buf = io.BytesIO()
     np.savez_compressed(buf, meta=json.dumps(meta), **arrays)
     data = buf.getvalue()
@@ -96,7 +97,9 @@ def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:
                 f"match the engine's {want_geom}")
         fields = {}
         for name in BatchState._fields:
-            fields[name] = jnp.asarray(z[f"state_{name}"])
+            key = f"state_{name}"
+            # optional planes (v128 extension) absent for non-SIMD images
+            fields[name] = jnp.asarray(z[key]) if key in z.files else None
         _validate_planes(fields, engine)
     return BatchState(**fields), meta["total_steps"]
 

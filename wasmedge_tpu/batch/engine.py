@@ -54,6 +54,18 @@ from wasmedge_tpu.batch.image import (
     CLS_MEMCOPY,
     CLS_MEMFILL,
     CLS_MEMGROW,
+    CLS_V1,
+    CLS_V2,
+    CLS_VBITSEL,
+    CLS_VCONST,
+    CLS_VEXTRACT,
+    CLS_VLOAD,
+    CLS_VREPLACE,
+    CLS_VSHIFT,
+    CLS_VSHUFFLE,
+    CLS_VSPLAT,
+    CLS_VSTORE,
+    CLS_VTEST,
     CLS_MEMSIZE,
     CLS_RETURN,
     CLS_SELECT,
@@ -89,6 +101,10 @@ class BatchState(NamedTuple):
     glob_lo: object
     glob_hi: object
     mem: object
+    # v128 extension planes (bits 64..127 of each cell) — present only
+    # for modules whose image uses SIMD (img.has_simd); None otherwise
+    stack_e2: object = None
+    stack_e3: object = None
 
 
 @dataclasses.dataclass
@@ -135,6 +151,23 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
     f_type = jnp.asarray(img.f_type)
     table0 = jnp.asarray(img.table0)
     fuel_enabled = cfg.fuel_per_launch is not None
+    HAS_SIMD = bool(getattr(img, "has_simd", False))
+    if HAS_SIMD:
+        from wasmedge_tpu.batch import simdops as sops
+
+        v128_t = jnp.asarray(img.v128)  # [n, 4]
+        used_of = lambda kls: {int(sv) for sv, cv in zip(img.sub, img.cls)
+                               if cv == kls}
+        used_v2 = used_of(CLS_V2)
+        used_v1 = used_of(CLS_V1)
+        used_vtest = used_of(CLS_VTEST)
+        used_vshift = used_of(CLS_VSHIFT)
+        used_vsplat = used_of(CLS_VSPLAT)
+        used_vextract = used_of(CLS_VEXTRACT)
+        used_vreplace = used_of(CLS_VREPLACE)
+        uses_vshuffle = bool((img.cls == CLS_VSHUFFLE).any())
+        uses_vmem = bool(((img.cls == CLS_VLOAD)
+                          | (img.cls == CLS_VSTORE)).any())
 
     # ALU sub ids
     S_I32 = {n: ALU2_I32_BASE + i for i, n in enumerate(_I32_BIN)}
@@ -193,6 +226,19 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         v2_hi = gat(st.stack_hi, sp - 3)
         loc_lo = gat(st.stack_lo, fp + a)
         loc_hi = gat(st.stack_hi, fp + a)
+        zl = jnp.zeros_like(v0_lo)
+        if HAS_SIMD:
+            v0_e2 = gat(st.stack_e2, sp - 1)
+            v0_e3 = gat(st.stack_e3, sp - 1)
+            v1_e2 = gat(st.stack_e2, sp - 2)
+            v1_e3 = gat(st.stack_e3, sp - 2)
+            v2_e2 = gat(st.stack_e2, sp - 3)
+            v2_e3 = gat(st.stack_e3, sp - 3)
+            loc_e2 = gat(st.stack_e2, fp + a)
+            loc_e3 = gat(st.stack_e3, fp + a)
+        else:
+            v0_e2 = v0_e3 = v1_e2 = v1_e3 = v2_e2 = v2_e3 = zl
+            loc_e2 = loc_e3 = zl
         ng = st.glob_lo.shape[0]
         gidx = jnp.clip(a, 0, ng - 1)
         g_lo = jnp.take_along_axis(st.glob_lo, gidx[None, :], axis=0)[0]
@@ -558,6 +604,116 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         mem_plane = lax.cond(jnp.any(bulk_go), run_bulk,
                              lambda m: m, mem_plane)
 
+        # =================== v128 (SIMD) ===================
+        # cells are 4 int32 planes; ops come from batch/simdops.py and
+        # compile only for the sub ids the module image actually uses
+        z4p = (zl, zl, zl, zl)
+        if HAS_SIMD:
+            is_vconst = is_cls[CLS_VCONST]
+            is_v2 = is_cls[CLS_V2]
+            is_v1 = is_cls[CLS_V1]
+            is_vtest = is_cls[CLS_VTEST]
+            is_vshift = is_cls[CLS_VSHIFT]
+            is_vsplat = is_cls[CLS_VSPLAT]
+            is_vextract = is_cls[CLS_VEXTRACT]
+            is_vreplace = is_cls[CLS_VREPLACE]
+            is_vshuffle = is_cls[CLS_VSHUFFLE]
+            is_vbitsel = is_cls[CLS_VBITSEL]
+            is_vload = is_cls[CLS_VLOAD]
+            is_vstore = is_cls[CLS_VSTORE]
+            x4 = (v1_lo, v1_hi, v1_e2, v1_e3)   # second-from-top cell
+            y4 = (v0_lo, v0_hi, v0_e2, v0_e3)   # top cell
+            w4 = (v2_lo, v2_hi, v2_e2, v2_e3)   # third-from-top cell
+
+            def vsel(used, mk_fn, *args):
+                acc = z4p
+                for sid in sorted(used):
+                    r = mk_fn(sid)(*args)
+                    m = sub == sid
+                    acc = tuple(jnp.where(m, rn, an)
+                                for rn, an in zip(r, acc))
+                return acc
+
+            v2_res = vsel(used_v2, sops.v2_fn, x4, y4)
+            v1_res = vsel(used_v1, sops.v1_fn, y4)
+            vshift_res = vsel(used_vshift,
+                              lambda s: sops.vshift_fn(s), x4, v0_lo)
+            vsplat_res = vsel(used_vsplat,
+                              lambda s: sops.vsplat_fn(s), v0_lo, v0_hi)
+            vrepl_res = vsel(used_vreplace,
+                             lambda s: (lambda xx, ll, hh, f=sops.
+                                        vreplace_dyn(s): f(xx, a, ll, hh)),
+                             x4, v0_lo, v0_hi)
+            vtest_res = zl
+            for sid in sorted(used_vtest):
+                r = sops.vtest_fn(sid)(y4)
+                vtest_res = jnp.where(sub == sid, r, vtest_res)
+            vex_lo, vex_hi = zl, zl
+            for sid in sorted(used_vextract):
+                rl, rh = sops.vextract_dyn(sid)(y4, a)
+                m = sub == sid
+                vex_lo = jnp.where(m, rl, vex_lo)
+                vex_hi = jnp.where(m, rh, vex_hi)
+            vcidx = jnp.clip(a, 0, v128_t.shape[0] - 1)
+            vconst_res = tuple(v128_t[vcidx, k] for k in range(4))
+            if uses_vshuffle:
+                m4 = tuple(v128_t[vcidx, k] for k in range(4))
+                vshuf_res = sops.vshuffle_dyn()(x4, y4, m4)
+            else:
+                vshuf_res = z4p
+            # bitselect: operands (v1, v2, mask) = (w4, x4, y4)
+            vbit_res = sops.vbitselect()(w4, x4, y4)
+
+            # ---- v128.load / v128.store (5-word shifted window) ----
+            # compiled only when the image contains them: the 5 gathers +
+            # 5 masked plane scatters are runtime-masked and XLA cannot
+            # dead-code-eliminate them otherwise
+            if uses_vmem:
+                vaddr = jnp.where(is_vstore, v1_lo, v0_lo)
+                vea = vaddr + a
+                vcarry = u_lt(vea, vaddr) | u_lt(vea, a)
+                vend = vea + 16
+                v_oob = vcarry | u_lt(vend, vea) | u_lt(mem_bytes, vend)
+                vwidx = lax.shift_right_logical(vea, 2)
+                vsh = (vea & 3) * 8
+                vinv = (32 - vsh) & 31
+                v_hi_or = jnp.where(vsh == 0, 0, -1)
+                vmw = [gat(st.mem, vwidx + k) for k in range(5)]
+                vload_res = tuple(
+                    lax.shift_right_logical(vmw[k], vsh)
+                    | (lax.shift_left(vmw[k + 1], vinv) & v_hi_or)
+                    for k in range(4))
+                # store masks/values across the 5-word window
+                vm = [lax.shift_left(jnp.int32(-1), vsh)] \
+                    + [jnp.int32(-1) * jnp.ones_like(zl)] * 3 \
+                    + [jnp.where(vsh == 0, 0,
+                                 ~lax.shift_left(jnp.int32(-1), vsh))]
+                sv = []
+                prev = zl
+                for k in range(4):
+                    sv.append(lax.shift_left(y4[k], vsh)
+                              | (lax.shift_right_logical(prev, vinv)
+                                 & v_hi_or))
+                    prev = y4[k]
+                sv.append(lax.shift_right_logical(prev, vinv) & v_hi_or)
+                vstore_ok = active & is_vstore & ~v_oob
+                for k in range(5):
+                    nw = (vmw[k] & ~vm[k]) | (sv[k] & vm[k])
+                    mem_plane = scat(mem_plane, vwidx + k, nw,
+                                     vstore_ok & (vm[k] != 0))
+            else:
+                vload_res = z4p
+                v_oob = jnp.zeros_like(cls == cls)
+        else:
+            is_vconst = is_v2 = is_v1 = is_vtest = is_vshift = \
+                is_vsplat = is_vextract = is_vreplace = is_vshuffle = \
+                is_vbitsel = is_vload = is_vstore = jnp.bool_(False) & \
+                (cls == cls)
+            v2_res = v1_res = vshift_res = vsplat_res = vrepl_res = \
+                vconst_res = vshuf_res = vbit_res = vload_res = z4p
+            vtest_res = vex_lo = vex_hi = zl
+            v_oob = jnp.zeros_like(cls == cls)
+
         is_grow = is_cls[CLS_MEMGROW]
         grow_delta = v0_lo
         grow_ok = ~u_lt(jnp.int32(img.mem_pages_max), st.mem_pages + grow_delta) \
@@ -636,32 +792,58 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         sel_lo = jnp.where(cond_zero, v1_lo, v2_lo)
         sel_hi = jnp.where(cond_zero, v1_hi, v2_hi)
 
+        sel_e2 = jnp.where(cond_zero, v1_e2, v2_e2)
+        sel_e3 = jnp.where(cond_zero, v1_e3, v2_e3)
         wpos = sp  # default for push-class
         wlo = ilo
         whi = ihi
+        we2 = zl
+        we3 = zl
         does_write = is_const
-        for m, pos, lo_v, hi_v in (
-            (is_lget, sp, loc_lo, loc_hi),
+        for entry in (
+            (is_lget, sp, loc_lo, loc_hi, loc_e2, loc_e3),
             (is_gget, sp, g_lo, g_hi),
             (is_msize, sp, st.mem_pages, jnp.zeros_like(st.mem_pages)),
             (is_alu1, sp - 1, alu1_lo, alu1_hi),
             (is_grow, sp - 1, grow_res, jnp.zeros_like(grow_res)),
             (is_load & ~mem_oob, sp - 1, load_lo, load_hi),
             (is_alu2, sp - 2, alu2_lo, alu2_hi),
-            (is_sel, sp - 3, sel_lo, sel_hi),
-            (is_br & (b == 1), opbase + c, v0_lo, v0_hi),
-            (brnz_taken & (b == 1), opbase + c, v1_lo, v1_hi),
-            (is_brt & (bt_keep == 1), opbase + bt_pop, v1_lo, v1_hi),
-            (is_ret & (nres == 1), fp, v0_lo, v0_hi),
+            (is_sel, sp - 3, sel_lo, sel_hi, sel_e2, sel_e3),
+            (is_br & (b == 1), opbase + c, v0_lo, v0_hi, v0_e2, v0_e3),
+            (brnz_taken & (b == 1), opbase + c, v1_lo, v1_hi,
+             v1_e2, v1_e3),
+            (is_brt & (bt_keep == 1), opbase + bt_pop, v1_lo, v1_hi,
+             v1_e2, v1_e3),
+            (is_ret & (nres == 1), fp, v0_lo, v0_hi, v0_e2, v0_e3),
+            (is_vconst, sp, *vconst_res),
+            (is_v2, sp - 2, *v2_res),
+            (is_vshift, sp - 2, *vshift_res),
+            (is_vshuffle, sp - 2, *vshuf_res),
+            (is_vreplace, sp - 2, *vrepl_res),
+            (is_v1, sp - 1, *v1_res),
+            (is_vsplat, sp - 1, *vsplat_res),
+            (is_vextract, sp - 1, vex_lo, vex_hi),
+            (is_vtest, sp - 1, vtest_res, zl),
+            (is_vbitsel, sp - 3, *vbit_res),
+            (is_vload & ~v_oob, sp - 1, *vload_res),
         ):
+            m, pos, lo_v, hi_v = entry[0], entry[1], entry[2], entry[3]
+            e2_v = entry[4] if len(entry) > 4 else zl
+            e3_v = entry[5] if len(entry) > 5 else zl
             wpos = jnp.where(m, pos, wpos)
             wlo = jnp.where(m, lo_v, wlo)
             whi = jnp.where(m, hi_v, whi)
+            if HAS_SIMD:
+                we2 = jnp.where(m, e2_v, we2)
+                we3 = jnp.where(m, e3_v, we3)
             does_write = does_write | m
 
         wmask = active & does_write & (trap == 0)
         stack_lo = scat(st.stack_lo, wpos, wlo, wmask)
         stack_hi = scat(st.stack_hi, wpos, whi, wmask)
+        if HAS_SIMD:
+            stack_e2 = scat(st.stack_e2, wpos, we2, wmask)
+            stack_e3 = scat(st.stack_e3, wpos, we3, wmask)
 
         # locals write (set/tee)
         is_lset = is_cls[CLS_LOCAL_SET]
@@ -669,6 +851,9 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         lmask = active & (is_lset | is_ltee)
         stack_lo = scat(stack_lo, fp + a, v0_lo, lmask)
         stack_hi = scat(stack_hi, fp + a, v0_hi, lmask)
+        if HAS_SIMD:
+            stack_e2 = scat(stack_e2, fp + a, v0_e2, lmask)
+            stack_e3 = scat(stack_e3, fp + a, v0_e3, lmask)
 
         # zero callee locals beyond params (static unrolled window)
         for k in range(img.max_local_zeros):
@@ -676,6 +861,12 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             zmask = call_ok & (k < (c_nlocals - c_nparams))
             stack_lo = scat(stack_lo, zpos, jnp.zeros_like(v0_lo), zmask)
             stack_hi = scat(stack_hi, zpos, jnp.zeros_like(v0_hi), zmask)
+            if HAS_SIMD:
+                stack_e2 = scat(stack_e2, zpos, zl, zmask)
+                stack_e3 = scat(stack_e3, zpos, zl, zmask)
+        if not HAS_SIMD:
+            stack_e2 = st.stack_e2
+            stack_e3 = st.stack_e3
 
         # globals write
         is_gset = is_cls[CLS_GLOBAL_SET]
@@ -690,10 +881,11 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         # =================== merge: sp / pc / frames ===================
         new_sp = sp
         for m, v in (
-            (is_const | is_lget | is_gget | is_msize, sp + 1),
+            (is_const | is_lget | is_gget | is_msize | is_vconst, sp + 1),
             (is_cls[CLS_DROP] | is_lset | is_gset | is_alu2 | is_brz
-             | (is_brnz & cond_zero), sp - 1),
-            (is_cls[CLS_STORE] | is_sel, sp - 2),
+             | (is_brnz & cond_zero) | is_v2 | is_vshift | is_vshuffle
+             | is_vreplace, sp - 1),
+            (is_cls[CLS_STORE] | is_sel | is_vstore | is_vbitsel, sp - 2),
             (is_bulk, sp - 3),
             (is_br, opbase + c + b),
             (brnz_taken, opbase + c + b),
@@ -727,6 +919,8 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             (alu2_trap != 0, alu2_trap),
             (alu1_trap != 0, alu1_trap),
             ((is_load | is_store) & mem_oob,
+             jnp.int32(int(ErrCode.MemoryOutOfBounds))),
+            ((is_vload | is_vstore) & v_oob,
              jnp.int32(int(ErrCode.MemoryOutOfBounds))),
             (bulk_oob, jnp.int32(int(ErrCode.MemoryOutOfBounds))),
             (is_callany & (call_trap != 0), call_trap),
@@ -764,6 +958,8 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             glob_lo=glob_lo,
             glob_hi=glob_hi,
             mem=mem_plane,
+            stack_e2=stack_e2,
+            stack_e3=stack_e3,
         )
 
     return step
@@ -816,6 +1012,15 @@ class BatchEngine:
             self.img.mem_pages_max = max(
                 self.img.mem_pages_init,
                 min(declared, cfg.memory_pages_per_lane))
+        # type-level checks run unconditionally: a module can carry
+        # v128-typed globals/signatures without any v128 OPCODE (pure
+        # moves), and the 2-plane cells would silently truncate them
+        from wasmedge_tpu.common.types import ValType
+
+        for g in inst.globals:
+            if g.type.val_type == ValType.V128:
+                raise ValueError(
+                    "module not batchable: v128-typed global")
         self._step = None
         self._run_chunk = None
 
@@ -923,6 +1128,8 @@ class BatchEngine:
             glob_lo=jnp.asarray(np.repeat(img.globals_lo[:, None], L, axis=1)),
             glob_hi=jnp.asarray(np.repeat(img.globals_hi[:, None], L, axis=1)),
             mem=jnp.asarray(mem),
+            stack_e2=jnp.zeros((D, L), jnp.int32) if img.has_simd else None,
+            stack_e3=jnp.zeros((D, L), jnp.int32) if img.has_simd else None,
         )
 
     def run(self, func_name: str, args_lanes: List[np.ndarray],
@@ -931,6 +1138,13 @@ class BatchEngine:
         if ex is None or ex[0] != 0:
             raise KeyError(f"no exported function {func_name}")
         func_idx = ex[1]
+        from wasmedge_tpu.common.types import ValType
+
+        ft = self.inst.funcs[func_idx].functype
+        if ValType.V128 in tuple(ft.params) + tuple(ft.results):
+            raise ValueError(
+                "batch entry functions cannot take or return v128 "
+                "(lane args are 64-bit cells)")
         if self._run_chunk is None:
             self._build()
         state = self.initial_state(func_idx, args_lanes)

@@ -54,7 +54,20 @@ CLS_TRAP = 22
 CLS_HOSTCALL = 23  # synthetic stub: park lane for the host outcall channel
 CLS_MEMFILL = 24
 CLS_MEMCOPY = 25
-NUM_CLASSES = 26
+# v128 (4x int32 planes per cell; op tables in batch/simdops.py)
+CLS_VCONST = 26    # a = v128 table idx -> push
+CLS_V2 = 27        # sub = V2_SUB id: pop2 push1
+CLS_V1 = 28        # sub = V1_SUB id: pop1 push1
+CLS_VTEST = 29     # sub = VTEST_SUB id: pop v128 push i32
+CLS_VSHIFT = 30    # sub = VSHIFT_SUB id: pop (v128, i32) push v128
+CLS_VSPLAT = 31    # sub = VSPLAT_SUB id: pop scalar push v128
+CLS_VEXTRACT = 32  # sub = VEXTRACT_SUB id, a = lane: pop v128 push scalar
+CLS_VREPLACE = 33  # sub = VREPLACE_SUB id, a = lane: pop2 push v128
+CLS_VSHUFFLE = 34  # a = v128 table idx (16-byte mask): pop2 push1
+CLS_VBITSEL = 35   # pop3 push1
+CLS_VLOAD = 36     # a = offset: pop addr push v128
+CLS_VSTORE = 37    # a = offset: pop (addr, v128)
+NUM_CLASSES = 38
 
 # -- ALU2 sub-ops (binary: pop2 push1) --------------------------------------
 _I32_BIN = ["add", "sub", "mul", "div_s", "div_u", "rem_s", "rem_u", "and",
@@ -123,8 +136,10 @@ _STORES = {
     "i64.store8": 1, "i64.store16": 2, "i64.store32": 4,
 }
 
-# Ops outside the batch subset (v1). Modules containing them in *reachable
-# batched code* fall back to the scalar engine.
+# Ops outside the batch subset. Modules containing them in *reachable
+# batched code* fall back to the scalar engine.  The integer v128
+# families are batchable (batch/simdops.py SUPPORTED_V128); the float
+# families and the widening/narrowing extensions still gate out.
 _UNSUPPORTED_PREFIXES = ("v128.", "i8x16.", "i16x8.", "i32x4.",
                          "i64x2.", "f32x4.", "f64x2.")
 _UNSUPPORTED_NAMES = {
@@ -177,7 +192,10 @@ def batchability(image: LoweredModule,
         if name == "return" and image.b[pc] > 1:
             return "multi-value results"
         if any(name.startswith(p) for p in _UNSUPPORTED_PREFIXES):
-            return f"unsupported op {name}"
+            from wasmedge_tpu.batch.simdops import SUPPORTED_V128
+
+            if name not in SUPPORTED_V128:
+                return f"unsupported op {name}"
         if name in _UNSUPPORTED_NAMES:
             return f"unsupported op {name}"
     return None
@@ -213,6 +231,9 @@ class DeviceImage:
     has_memory: bool
     max_local_zeros: int  # max (nlocals - nparams) over funcs
     code_len: int
+    # v128 constant/shuffle-mask table as 4 int32 planes [n, 4]
+    v128: np.ndarray = None
+    has_simd: bool = False
 
 
 def build_device_image(image: LoweredModule, memories=None, globals_=None,
@@ -254,6 +275,23 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
     table_size = len(table0)
     if table_size == 0:
         table0 = np.zeros(1, np.int32)
+
+    from wasmedge_tpu.batch.simdops import (
+        V1_SUB, V2_SUB, VEXTRACT_SUB, VREPLACE_SUB, VSHIFT_SUB,
+        VSPLAT_SUB, VTEST_SUB)
+
+    v2_ops = {NAME_TO_ID[n]: s for n, s in V2_SUB.items()}
+    v1_ops = {NAME_TO_ID[n]: s for n, s in V1_SUB.items()}
+    vtest_ops = {NAME_TO_ID[n]: s for n, s in VTEST_SUB.items()}
+    vshift_ops = {NAME_TO_ID[n]: s for n, s in VSHIFT_SUB.items()}
+    vsplat_ops = {NAME_TO_ID[n]: s for n, s in VSPLAT_SUB.items()}
+    vextract_ops = {NAME_TO_ID[n]: s for n, s in VEXTRACT_SUB.items()}
+    vreplace_ops = {NAME_TO_ID[n]: s for n, s in VREPLACE_SUB.items()}
+    op_vconst = NAME_TO_ID["v128.const"]
+    op_vshuffle = NAME_TO_ID["i8x16.shuffle"]
+    op_vbitsel = NAME_TO_ID["v128.bitselect"]
+    op_vload = NAME_TO_ID["v128.load"]
+    op_vstore = NAME_TO_ID["v128.store"]
 
     i32_bin = {NAME_TO_ID[f"i32.{s}"]: ALU2_I32_BASE + i
                for i, s in enumerate(_I32_BIN)}
@@ -336,6 +374,30 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
             cls[pc] = CLS_STORE
             a[pc] = _i32(imm)
             b[pc] = stores[op]
+        elif op == op_vconst:
+            cls[pc], a[pc] = CLS_VCONST, ia
+        elif op == op_vshuffle:
+            cls[pc], a[pc] = CLS_VSHUFFLE, ia
+        elif op == op_vbitsel:
+            cls[pc] = CLS_VBITSEL
+        elif op == op_vload:
+            cls[pc], a[pc] = CLS_VLOAD, _i32(imm)
+        elif op == op_vstore:
+            cls[pc], a[pc] = CLS_VSTORE, _i32(imm)
+        elif op in v2_ops:
+            cls[pc], sub[pc] = CLS_V2, v2_ops[op]
+        elif op in v1_ops:
+            cls[pc], sub[pc] = CLS_V1, v1_ops[op]
+        elif op in vtest_ops:
+            cls[pc], sub[pc] = CLS_VTEST, vtest_ops[op]
+        elif op in vshift_ops:
+            cls[pc], sub[pc] = CLS_VSHIFT, vshift_ops[op]
+        elif op in vsplat_ops:
+            cls[pc], sub[pc] = CLS_VSPLAT, vsplat_ops[op]
+        elif op in vextract_ops:
+            cls[pc], sub[pc], a[pc] = CLS_VEXTRACT, vextract_ops[op], ia
+        elif op in vreplace_ops:
+            cls[pc], sub[pc], a[pc] = CLS_VREPLACE, vreplace_ops[op], ia
         elif op == Op.memory_fill:
             cls[pc] = CLS_MEMFILL
         elif op == Op.memory_copy:
@@ -404,6 +466,16 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         pages_init = 0
         pages_max = 0
 
+    v_lo = image.arrays["v128_lo"]
+    v_hi = image.arrays["v128_hi"]
+    v128 = np.zeros((max(len(v_lo), 1), 4), np.int32)
+    for i in range(len(v_lo)):
+        v128[i, 0] = _i32(int(v_lo[i]))
+        v128[i, 1] = _i32(int(v_lo[i]) >> 32)
+        v128[i, 2] = _i32(int(v_hi[i]))
+        v128[i, 3] = _i32(int(v_hi[i]) >> 32)
+    has_simd = bool(((cls >= CLS_VCONST) & (cls <= CLS_VSTORE)).any())
+
     return DeviceImage(
         cls=cls, sub=sub, a=a, b=b, c=c, imm_lo=imm_lo, imm_hi=imm_hi,
         br_table=image.arrays["br_table"],
@@ -413,4 +485,5 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         mem_init=mem_init, mem_pages_init=pages_init, mem_pages_max=pages_max,
         has_memory=bool(memories),
         max_local_zeros=max_zeros, code_len=n,
+        v128=v128, has_simd=has_simd,
     )

@@ -62,8 +62,12 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
 
     Returns (image, bases) where bases[i] = dict of per-tenant index-space
     offsets (pc/func/global/type/brt/table)."""
+    from wasmedge_tpu.batch.image import CLS_VCONST, CLS_VSHUFFLE
+
     planes = {k: [] for k in ("cls", "sub", "a", "b", "c", "imm_lo",
                               "imm_hi")}
+    v128_parts = []
+    v128_b = 0
     f_parts = {k: [] for k in ("f_entry", "f_nparams", "f_nlocals",
                                "f_nresults", "f_frame_top", "f_type")}
     brt_parts = []
@@ -90,6 +94,7 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         a[is_ci] += ty_b
         c[is_ci] += tbl_b
         a[cls == CLS_BR_TABLE] += brt_b
+        a[(cls == CLS_VCONST) | (cls == CLS_VSHUFFLE)] += v128_b
         planes["cls"].append(cls)
         planes["sub"].append(img.sub)
         planes["a"].append(a)
@@ -111,6 +116,9 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         f_parts["f_type"].append(img.f_type + ty_b)
         g_lo_parts.append(img.globals_lo)
         g_hi_parts.append(img.globals_hi)
+        v128_parts.append(img.v128 if img.v128 is not None
+                          else np.zeros((1, 4), np.int32))
+        v128_b += v128_parts[-1].shape[0]
         pc_b += img.code_len
         fn_b += len(img.f_entry)
         gl_b += img.globals_lo.shape[0]
@@ -146,6 +154,8 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         has_memory=any(t.img.has_memory for t in tenants),
         max_local_zeros=max(t.img.max_local_zeros for t in tenants),
         code_len=pc_b,
+        v128=np.concatenate(v128_parts, axis=0),
+        has_simd=any(t.img.has_simd for t in tenants),
     )
     return image, bases
 
@@ -240,6 +250,8 @@ class MultiTenantBatchEngine(BatchEngine):
             fr_opbase=jnp.zeros((CD, L), jnp.int32),
             glob_lo=jnp.asarray(g_lo), glob_hi=jnp.asarray(g_hi),
             mem=jnp.asarray(mem),
+            stack_e2=jnp.zeros((D, L), jnp.int32) if img.has_simd else None,
+            stack_e3=jnp.zeros((D, L), jnp.int32) if img.has_simd else None,
         )
 
     def _try_pallas(self):

@@ -1535,6 +1535,8 @@ class PallasUniformEngine:
             return f"code too large for SMEM ({img.code_len} instrs)"
         if self.simt.mesh is not None:
             return "mesh sharding handled by SIMT engine"
+        if getattr(img, "has_simd", False):
+            return "v128 handled by SIMT engine"
         if self._lane_block() is None:
             return (f"state too large for VMEM "
                     f"({self._mem_words()} mem words/lane)")

@@ -585,7 +585,7 @@ class BlockScheduler:
         # stack rows; whole-plane transfers would ride the slow host link
         slo = _Rows(self.state[2], lo, Lblk)
         shi = _Rows(self.state[3], lo, Lblk)
-        trap_row = np.asarray(self.state[7][0, lo:lo + Lblk])
+        trap_row = self._trap_full[lo:lo + Lblk]
 
         # Advanced-with-per-lane-outcomes stops come FIRST, regardless of
         # what instruction ctrl now points at: trap-partial sites (div/rem

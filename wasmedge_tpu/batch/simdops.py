@@ -1,0 +1,570 @@
+"""v128 lane ops over 4x int32 planes — the batch engines' SIMD kernels.
+
+A v128 cell is four int32 plane words per lane (e0..e3, little-endian:
+e0 holds bytes 0-3).  Shapes i8x16/i16x8/i32x4 operate on each word
+independently; i64x2 pairs (e0,e1)/(e2,e3) and reuses the 64-bit pair
+kernels from batch/laneops.py.  Sub-byte shapes unpack each word into
+per-lane byte/half vectors, apply the op on full int32 arrays (the lane
+axis stays vectorized on the VPU), and repack — 16x the op count of a
+native byte ALU but branch-free and bit-exact, which is what the
+batched path needs (the reference's v128 section:
+/root/reference/lib/executor/engine/engine.cpp ~700-1610).
+
+Only the integer families are implemented; float f32x4/f64x2 arithmetic
+and the narrowing/widening/saturating-multiply extensions stay gated to
+the scalar engine (batch/image.py batchability)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+# ---------------------------------------------------------------------------
+# op name tables (ids = index; shared by image encoding and engines)
+# ---------------------------------------------------------------------------
+_ICMP = ["eq", "ne", "lt_s", "lt_u", "gt_s", "gt_u", "le_s", "le_u",
+         "ge_s", "ge_u"]
+_ICMP_S = ["eq", "ne", "lt_s", "gt_s", "le_s", "ge_s"]  # i64x2 set
+
+V2_NAMES: List[str] = (
+    ["v128.and", "v128.or", "v128.xor", "v128.andnot"]
+    + [f"i8x16.{n}" for n in
+       ["add", "sub", "add_sat_s", "add_sat_u", "sub_sat_s", "sub_sat_u",
+        "min_s", "min_u", "max_s", "max_u", "avgr_u", "swizzle"] + _ICMP]
+    + [f"i16x8.{n}" for n in
+       ["add", "sub", "mul", "add_sat_s", "add_sat_u", "sub_sat_s",
+        "sub_sat_u", "min_s", "min_u", "max_s", "max_u", "avgr_u"] + _ICMP]
+    + [f"i32x4.{n}" for n in
+       ["add", "sub", "mul", "min_s", "min_u", "max_s", "max_u"] + _ICMP]
+    + [f"i64x2.{n}" for n in ["add", "sub", "mul"] + _ICMP_S]
+)
+V1_NAMES: List[str] = (
+    ["v128.not", "i8x16.abs", "i8x16.neg", "i8x16.popcnt",
+     "i16x8.abs", "i16x8.neg", "i32x4.abs", "i32x4.neg",
+     "i64x2.abs", "i64x2.neg"]
+)
+VTEST_NAMES: List[str] = (
+    ["v128.any_true"]
+    + [f"{s}.all_true" for s in ("i8x16", "i16x8", "i32x4", "i64x2")]
+    + [f"{s}.bitmask" for s in ("i8x16", "i16x8", "i32x4", "i64x2")]
+)
+VSHIFT_NAMES: List[str] = [
+    f"{s}.{k}" for s in ("i8x16", "i16x8", "i32x4", "i64x2")
+    for k in ("shl", "shr_s", "shr_u")]
+VSPLAT_NAMES: List[str] = [f"{s}.splat" for s in
+                           ("i8x16", "i16x8", "i32x4", "i64x2")]
+VEXTRACT_NAMES: List[str] = [
+    "i8x16.extract_lane_s", "i8x16.extract_lane_u",
+    "i16x8.extract_lane_s", "i16x8.extract_lane_u",
+    "i32x4.extract_lane", "i64x2.extract_lane"]
+VREPLACE_NAMES: List[str] = [f"{s}.replace_lane" for s in
+                             ("i8x16", "i16x8", "i32x4", "i64x2")]
+
+V2_SUB = {n: i for i, n in enumerate(V2_NAMES)}
+V1_SUB = {n: i for i, n in enumerate(V1_NAMES)}
+VTEST_SUB = {n: i for i, n in enumerate(VTEST_NAMES)}
+VSHIFT_SUB = {n: i for i, n in enumerate(VSHIFT_NAMES)}
+VSPLAT_SUB = {n: i for i, n in enumerate(VSPLAT_NAMES)}
+VEXTRACT_SUB = {n: i for i, n in enumerate(VEXTRACT_NAMES)}
+VREPLACE_SUB = {n: i for i, n in enumerate(VREPLACE_NAMES)}
+
+SUPPORTED_V128 = (set(V2_NAMES) | set(V1_NAMES) | set(VTEST_NAMES)
+                  | set(VSHIFT_NAMES) | set(VSPLAT_NAMES)
+                  | set(VEXTRACT_NAMES) | set(VREPLACE_NAMES)
+                  | {"v128.const", "v128.load", "v128.store",
+                     "i8x16.shuffle", "v128.bitselect"})
+
+
+# ---------------------------------------------------------------------------
+# jnp kernels (imported lazily so the module stays importable without jax)
+# ---------------------------------------------------------------------------
+def _j():
+    import jax.numpy as jnp
+    from jax import lax
+
+    return jnp, lax
+
+
+def _bytes(w, signed):
+    """int32 word [L] -> list of 4 per-byte int32 arrays."""
+    jnp, lax = _j()
+    out = []
+    for k in range(4):
+        b = lax.shift_right_logical(w, 8 * k) & 0xFF
+        if signed:
+            b = lax.shift_right_arithmetic(
+                lax.shift_left(b, 24), 24)
+        out.append(b)
+    return out
+
+
+def _pack_bytes(bs):
+    jnp, lax = _j()
+    w = bs[0] & 0xFF
+    for k in range(1, 4):
+        w = w | lax.shift_left(bs[k] & 0xFF, 8 * k)
+    return w
+
+
+def _halves(w, signed):
+    jnp, lax = _j()
+    out = []
+    for k in range(2):
+        h = lax.shift_right_logical(w, 16 * k) & 0xFFFF
+        if signed:
+            h = lax.shift_right_arithmetic(lax.shift_left(h, 16), 16)
+        out.append(h)
+    return out
+
+
+def _pack_halves(hs):
+    jnp, lax = _j()
+    return (hs[0] & 0xFFFF) | lax.shift_left(hs[1] & 0xFFFF, 16)
+
+
+def _sat(x, lo, hi):
+    jnp, _ = _j()
+    return jnp.clip(x, lo, hi)
+
+
+def _elemwise(shape_w, signed, fn, x, y=None):
+    """Apply fn to per-element int32 arrays of one 32-bit word."""
+    if shape_w == 8:
+        xs = _bytes(x, signed)
+        ys = _bytes(y, signed) if y is not None else [None] * 4
+        return _pack_bytes([fn(a, b) for a, b in zip(xs, ys)])
+    if shape_w == 16:
+        xs = _halves(x, signed)
+        ys = _halves(y, signed) if y is not None else [None] * 2
+        return _pack_halves([fn(a, b) for a, b in zip(xs, ys)])
+    return fn(x, y)
+
+
+def _u32(x):
+    jnp, _ = _j()
+    return x.astype(jnp.uint32)
+
+
+def _b2m(cond, shape_w):
+    """bool -> all-ones element mask (int32 word context)."""
+    jnp, _ = _j()
+    ones = {8: 0xFF, 16: 0xFFFF, 32: -1}[shape_w]
+    return jnp.where(cond, jnp.int32(ones), jnp.int32(0))
+
+
+def _int_binop(name, shape_w):
+    """Return fn(a, b) over sign-appropriate element arrays, or None."""
+    jnp, lax = _j()
+    lim = {8: (-128, 127, 0, 255), 16: (-32768, 32767, 0, 65535)}
+
+    def u(v):
+        # _elemwise gives signed or unsigned depending on `signed` flag;
+        # unsigned ops request signed=False so values are already >= 0
+        return v
+
+    if name == "add":
+        return lambda a, b: a + b
+    if name == "sub":
+        return lambda a, b: a - b
+    if name == "mul":
+        return lambda a, b: a * b
+    if name in ("add_sat_s", "sub_sat_s"):
+        lo, hi = lim[shape_w][0], lim[shape_w][1]
+        op = (lambda a, b: a + b) if name.startswith("add") \
+            else (lambda a, b: a - b)
+        return lambda a, b: _sat(op(a, b), lo, hi)
+    if name in ("add_sat_u", "sub_sat_u"):
+        hi = lim[shape_w][3]
+        op = (lambda a, b: a + b) if name.startswith("add") \
+            else (lambda a, b: a - b)
+        return lambda a, b: _sat(op(a, b), 0, hi)
+    if name == "min_s" or name == "min_u":
+        return lambda a, b: jnp.minimum(a, b)
+    if name == "max_s" or name == "max_u":
+        return lambda a, b: jnp.maximum(a, b)
+    if name == "avgr_u":
+        return lambda a, b: lax.shift_right_logical(a + b + 1, 1)
+    if name in ("eq", "ne", "lt_s", "lt_u", "gt_s", "gt_u",
+                "le_s", "le_u", "ge_s", "ge_u"):
+        cmp = {"eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+               "lt_s": lambda a, b: a < b, "lt_u": lambda a, b: a < b,
+               "gt_s": lambda a, b: a > b, "gt_u": lambda a, b: a > b,
+               "le_s": lambda a, b: a <= b, "le_u": lambda a, b: a <= b,
+               "ge_s": lambda a, b: a >= b, "ge_u": lambda a, b: a >= b,
+               }[name]
+        return lambda a, b: _b2m(cmp(a, b), shape_w)
+    return None
+
+
+def _signedness(name: str) -> bool:
+    """Whether element extraction should sign-extend for this op."""
+    if name.endswith("_u") or name == "avgr_u":
+        return False
+    return True
+
+
+def v2_fn(sub: int):
+    """Binary v128 op: (x4, y4) -> r4 where x4/y4 are 4-plane tuples."""
+    jnp, lax = _j()
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    name = V2_NAMES[sub]
+    if name == "v128.and":
+        return lambda x, y: tuple(a & b for a, b in zip(x, y))
+    if name == "v128.or":
+        return lambda x, y: tuple(a | b for a, b in zip(x, y))
+    if name == "v128.xor":
+        return lambda x, y: tuple(a ^ b for a, b in zip(x, y))
+    if name == "v128.andnot":
+        return lambda x, y: tuple(a & ~b for a, b in zip(x, y))
+    if name == "i8x16.swizzle":
+        def swizzle(x, y):
+            # dest byte j = src byte s (s = selector byte j), 0 if s>=16
+            xb = [b for w in x for b in _bytes(w, False)]  # 16 src bytes
+            out = []
+            for wi in range(4):
+                sel = _bytes(y[wi], False)
+                obs = []
+                for s in sel:
+                    v = jnp.zeros_like(s)
+                    for j in range(16):
+                        v = jnp.where(s == j, xb[j], v)
+                    obs.append(v)
+                out.append(_pack_bytes(obs))
+            return tuple(out)
+        return swizzle
+    px, op = name.split(".", 1)
+    if px == "i64x2":
+        def pair(x, y, op=op):
+            r = []
+            for k in (0, 2):
+                xl, xh, yl, yh = x[k], x[k + 1], y[k], y[k + 1]
+                if op == "add":
+                    lo, hi = lo_ops.add64(xl, xh, yl, yh)
+                elif op == "sub":
+                    lo, hi = lo_ops.sub64(xl, xh, yl, yh)
+                elif op == "mul":
+                    lo, hi = lo_ops.mul64(xl, xh, yl, yh)
+                else:
+                    if op == "eq":
+                        c = lo_ops.eq64(xl, xh, yl, yh)
+                    elif op == "ne":
+                        c = ~lo_ops.eq64(xl, xh, yl, yh)
+                    elif op == "lt_s":
+                        c = lo_ops.lt64_s(xl, xh, yl, yh)
+                    elif op == "gt_s":
+                        c = lo_ops.lt64_s(yl, yh, xl, xh)
+                    elif op == "le_s":
+                        c = ~lo_ops.lt64_s(yl, yh, xl, xh)
+                    else:  # ge_s
+                        c = ~lo_ops.lt64_s(xl, xh, yl, yh)
+                    m = jnp.where(c, jnp.int32(-1), jnp.int32(0))
+                    lo, hi = m, m
+                r.extend((lo, hi))
+            return tuple(r)
+        return pair
+    shape_w = {"i8x16": 8, "i16x8": 16, "i32x4": 32}[px]
+    signed = _signedness(op)
+    if shape_w == 32:
+        fn32 = _int_binop(op, 32)
+        if op.endswith("_u"):
+            def u32op(x, y, op=op):
+                out = []
+                for a, b in zip(x, y):
+                    au, bu = _u32(a), _u32(b)
+                    if op in ("min_u", "max_u"):
+                        r = (jnp.minimum(au, bu) if op == "min_u"
+                             else jnp.maximum(au, bu)).astype(jnp.int32)
+                    else:
+                        cmp = {"lt_u": au < bu, "gt_u": au > bu,
+                               "le_u": au <= bu, "ge_u": au >= bu}[op]
+                        r = jnp.where(cmp, jnp.int32(-1), jnp.int32(0))
+                    out.append(r)
+                return tuple(out)
+            return u32op
+        return lambda x, y: tuple(
+            _elemwise(32, True, lambda a, b: fn32(a, b), a2, b2)
+            for a2, b2 in zip(x, y))
+    fn = _int_binop(op, shape_w)
+    return lambda x, y: tuple(
+        _elemwise(shape_w, signed, fn, a, b) for a, b in zip(x, y))
+
+
+def v1_fn(sub: int):
+    jnp, lax = _j()
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    name = V1_NAMES[sub]
+    if name == "v128.not":
+        return lambda x: tuple(~a for a in x)
+    if name == "i8x16.popcnt":
+        def pc(x):
+            out = []
+            for w in x:
+                bs = _bytes(w, False)
+                rs = []
+                for b in bs:
+                    v = b - (lax.shift_right_logical(b, 1) & 0x55)
+                    v = (v & 0x33) + (lax.shift_right_logical(v, 2) & 0x33)
+                    v = (v + lax.shift_right_logical(v, 4)) & 0x0F
+                    rs.append(v)
+                out.append(_pack_bytes(rs))
+            return tuple(out)
+        return pc
+    px, op = name.split(".", 1)
+    if px == "i64x2":
+        def pair(x, op=op):
+            r = []
+            for k in (0, 2):
+                xl, xh = x[k], x[k + 1]
+                nl, nh = lo_ops.sub64(jnp.zeros_like(xl),
+                                      jnp.zeros_like(xh), xl, xh)
+                if op == "neg":
+                    lo, hi = nl, nh
+                else:  # abs
+                    neg = xh < 0
+                    lo = jnp.where(neg, nl, xl)
+                    hi = jnp.where(neg, nh, xh)
+                r.extend((lo, hi))
+            return tuple(r)
+        return pair
+    shape_w = {"i8x16": 8, "i16x8": 16, "i32x4": 32}[px]
+
+    def fn(a, _b):
+        if op == "neg":
+            return -a
+        return jnp.abs(a)
+
+    return lambda x: tuple(_elemwise(shape_w, True, fn, a) for a in x)
+
+
+def vtest_fn(sub: int):
+    """v128 -> per-lane i32 scalar."""
+    jnp, lax = _j()
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    name = VTEST_NAMES[sub]
+    if name == "v128.any_true":
+        return lambda x: jnp.where(
+            (x[0] | x[1] | x[2] | x[3]) != 0, 1, 0).astype(jnp.int32)
+    px, op = name.split(".", 1)
+    if op == "all_true":
+        if px == "i64x2":
+            return lambda x: jnp.where(
+                ((x[0] | x[1]) != 0) & ((x[2] | x[3]) != 0),
+                1, 0).astype(jnp.int32)
+        shape_w = {"i8x16": 8, "i16x8": 16, "i32x4": 32}[px]
+
+        def all_true(x, shape_w=shape_w):
+            ok = None
+            for w in x:
+                if shape_w == 32:
+                    nz = w != 0
+                    ok = nz if ok is None else (ok & nz)
+                    continue
+                els = (_bytes(w, False) if shape_w == 8
+                       else _halves(w, False))
+                for e in els:
+                    nz = e != 0
+                    ok = nz if ok is None else (ok & nz)
+            return jnp.where(ok, 1, 0).astype(jnp.int32)
+        return all_true
+    # bitmask: top bit of each element, packed little-lane-first
+    if px == "i64x2":
+        return lambda x: (
+            lax.shift_right_logical(x[1], 31) & 1
+            | lax.shift_left(lax.shift_right_logical(x[3], 31) & 1, 1)
+        ).astype(jnp.int32)
+    shape_w = {"i8x16": 8, "i16x8": 16, "i32x4": 32}[px]
+
+    def bitmask(x, shape_w=shape_w):
+        acc = jnp.zeros_like(x[0])
+        lane = 0
+        for w in x:
+            if shape_w == 32:
+                acc = acc | lax.shift_left(
+                    lax.shift_right_logical(w, 31) & 1, lane)
+                lane += 1
+                continue
+            els = (_bytes(w, False) if shape_w == 8
+                   else _halves(w, False))
+            top = shape_w - 1
+            for e in els:
+                acc = acc | lax.shift_left(
+                    lax.shift_right_logical(e, top) & 1, lane)
+                lane += 1
+        return acc.astype(jnp.int32)
+    return bitmask
+
+
+def vshift_fn(sub: int):
+    """(v128, i32 shift) -> v128."""
+    jnp, lax = _j()
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    name = VSHIFT_NAMES[sub]
+    px, op = name.split(".", 1)
+    if px == "i64x2":
+        def sh64(x, n, op=op):
+            n = n & 63
+            r = []
+            for k in (0, 2):
+                if op == "shl":
+                    lo, hi = lo_ops.shl64(x[k], x[k + 1], n)
+                elif op == "shr_s":
+                    lo, hi = lo_ops.shr64_s(x[k], x[k + 1], n)
+                else:
+                    lo, hi = lo_ops.shr64_u(x[k], x[k + 1], n)
+                r.extend((lo, hi))
+            return tuple(r)
+        return sh64
+    shape_w = {"i8x16": 8, "i16x8": 16, "i32x4": 32}[px]
+    signed = op == "shr_s"
+
+    def sh(x, n, op=op, shape_w=shape_w, signed=signed):
+        n = n & (shape_w - 1)
+
+        def one(a, _b):
+            if op == "shl":
+                return lax.shift_left(a, n)
+            if op == "shr_s":
+                return lax.shift_right_arithmetic(a, n)
+            if shape_w == 32:
+                return lax.shift_right_logical(a, n)
+            return lax.shift_right_logical(a & ((1 << shape_w) - 1), n)
+        if shape_w == 32:
+            return tuple(one(a, None) for a in x)
+        return tuple(_elemwise(shape_w, signed, one, a) for a in x)
+    return sh
+
+
+def vsplat_fn(sub: int):
+    """(lo, hi scalar planes) -> v128 4-plane."""
+    jnp, lax = _j()
+
+    name = VSPLAT_NAMES[sub]
+    px = name.split(".", 1)[0]
+
+    def splat(lo, hi, px=px):
+        if px == "i8x16":
+            b = lo & 0xFF
+            w = b * jnp.int32(0x01010101)
+            return (w, w, w, w)
+        if px == "i16x8":
+            h = lo & 0xFFFF
+            w = h | lax.shift_left(h, 16)
+            return (w, w, w, w)
+        if px == "i32x4":
+            return (lo, lo, lo, lo)
+        return (lo, hi, lo, hi)
+    return splat
+
+
+def vbitselect():
+    def f(v1, v2, c):
+        return tuple((a & m) | (b & ~m) for a, b, m in zip(v1, v2, c))
+    return f
+
+
+# ---------------------------------------------------------------------------
+# dynamic variants: lane indices / masks as PER-LANE arrays (the SIMT
+# engine executes all lanes at once, each potentially at a different pc)
+# ---------------------------------------------------------------------------
+def vextract_dyn(sub: int):
+    """(x4, lane_arr) -> (lo, hi) with per-lane dynamic lane index."""
+    jnp, lax = _j()
+
+    name = VEXTRACT_NAMES[sub]
+    px = name.split(".", 1)[0]
+    signed = name.endswith("_s")
+
+    def ex(x, lane):
+        if px == "i8x16":
+            wi = lax.shift_right_logical(lane, 2)
+            w = x[0]
+            for k in range(1, 4):
+                w = jnp.where(wi == k, x[k], w)
+            b = lax.shift_right_logical(w, 8 * (lane & 3)) & 0xFF
+            if signed:
+                b = lax.shift_right_arithmetic(lax.shift_left(b, 24), 24)
+            return b, jnp.zeros_like(b)
+        if px == "i16x8":
+            wi = lax.shift_right_logical(lane, 1)
+            w = x[0]
+            for k in range(1, 4):
+                w = jnp.where(wi == k, x[k], w)
+            h = lax.shift_right_logical(w, 16 * (lane & 1)) & 0xFFFF
+            if signed:
+                h = lax.shift_right_arithmetic(lax.shift_left(h, 16), 16)
+            return h, jnp.zeros_like(h)
+        if px == "i32x4":
+            w = x[0]
+            for k in range(1, 4):
+                w = jnp.where(lane == k, x[k], w)
+            return w, jnp.zeros_like(w)
+        lo = jnp.where(lane == 0, x[0], x[2])
+        hi = jnp.where(lane == 0, x[1], x[3])
+        return lo, hi
+    return ex
+
+
+def vreplace_dyn(sub: int):
+    """(x4, lane_arr, lo, hi) -> x4 with per-lane dynamic lane index."""
+    jnp, lax = _j()
+
+    name = VREPLACE_NAMES[sub]
+    px = name.split(".", 1)[0]
+
+    def rp(x, lane, lo, hi):
+        out = []
+        if px == "i8x16":
+            wi = lax.shift_right_logical(lane, 2)
+            bmask = lax.shift_left(jnp.int32(0xFF), 8 * (lane & 3))
+            bval = lax.shift_left(lo & 0xFF, 8 * (lane & 3))
+            for k in range(4):
+                hit = wi == k
+                out.append(jnp.where(hit, (x[k] & ~bmask) | (bval & bmask),
+                                     x[k]))
+            return tuple(out)
+        if px == "i16x8":
+            wi = lax.shift_right_logical(lane, 1)
+            hmask = lax.shift_left(jnp.int32(0xFFFF), 16 * (lane & 1))
+            hval = lax.shift_left(lo & 0xFFFF, 16 * (lane & 1))
+            for k in range(4):
+                hit = wi == k
+                out.append(jnp.where(hit, (x[k] & ~hmask) | (hval & hmask),
+                                     x[k]))
+            return tuple(out)
+        if px == "i32x4":
+            for k in range(4):
+                out.append(jnp.where(lane == k, lo, x[k]))
+            return tuple(out)
+        for k in range(2):
+            out.append(jnp.where(lane == k, lo, x[2 * k]))
+            out.append(jnp.where(lane == k, hi, x[2 * k + 1]))
+        return (out[0], out[1], out[2], out[3])
+    return rp
+
+
+def vshuffle_dyn():
+    """(x4, y4, m4) -> shuffled v128; m4 = per-lane mask planes (each
+    selector byte in 0..31 selects from the 32 source bytes)."""
+    jnp, lax = _j()
+
+    def shuf(x, y, m):
+        src = []
+        for w in x:
+            src.extend(_bytes(w, False))
+        for w in y:
+            src.extend(_bytes(w, False))
+        out = []
+        for wi in range(4):
+            sel = _bytes(m[wi], False)
+            obs = []
+            for s in sel:
+                v = jnp.zeros_like(s)
+                for j in range(32):
+                    v = jnp.where(s == j, src[j], v)
+                obs.append(v)
+            out.append(_pack_bytes(obs))
+        return tuple(out)
+    return shuf

@@ -612,6 +612,17 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
     handlers[CLS_MEMGROW] = h_memgrow
     handlers[CLS_TRAP] = h_trap
 
+    # classes this converged engine does not execute (the v128 family
+    # lives on the SIMT engine's 4-plane cells): divergence-bail stubs.
+    # UniformBatchEngine.run routes has_simd modules to SIMT up front,
+    # so these fire only as a safety net.
+    def h_unsupported(st, f):
+        return halt(st, jnp.int32(ST_DIVERGED))
+
+    for k in range(NUM_CLASSES):
+        if handlers[k] is None:
+            handlers[k] = h_unsupported
+
     def step(st: UniformState) -> UniformState:
         pc = jnp.clip(st.pc, 0, img.code_len - 1)
         fetch = (sub_t[pc], a_t[pc], b_t[pc], c_t[pc], ilo_t[pc], ihi_t[pc])
@@ -766,8 +777,10 @@ class UniformBatchEngine:
             res = self.pallas.run(func_name, args_lanes, max_steps)
             self.fell_back_to_simt = self.pallas.fell_back_to_simt
             return res
-        if self.cfg.fuel_per_launch is not None or self.simt.mesh is not None:
-            # fuel accounting and mesh sharding live in the SIMT engine
+        if self.cfg.fuel_per_launch is not None or self.simt.mesh is not None \
+                or getattr(self.img, "has_simd", False):
+            # fuel accounting, mesh sharding, and v128 live in the SIMT
+            # engine (the converged single-pc path has no 4-plane cells)
             return self.simt.run(func_name, args_lanes, max_steps)
         if self._uchunk is None:
             self._build_uniform()
