@@ -36,6 +36,11 @@ def image_fingerprint(img) -> str:
                  "br_table", "f_entry", "f_nparams", "f_nlocals",
                  "f_nresults", "f_frame_top", "f_type", "table0"):
         h.update(np.ascontiguousarray(getattr(img, name)).tobytes())
+    if img.v128 is not None:
+        # v128 constants/shuffle masks are executable content too: two
+        # images identical in code planes but differing here must not
+        # share a fingerprint
+        h.update(np.ascontiguousarray(img.v128).tobytes())
     return h.hexdigest()
 
 

@@ -206,6 +206,8 @@ class MultiTenantBatchEngine(BatchEngine):
         pages = np.zeros(L, np.int32)
         mem_words = max(img.mem_pages_max * _PAGE_WORDS, 1)
         mem = np.zeros((mem_words, L), np.int32)
+        from wasmedge_tpu.common.types import ValType
+
         lane0 = 0
         self._tenant_slices = []
         self._tenant_funcidx = []
@@ -215,6 +217,11 @@ class MultiTenantBatchEngine(BatchEngine):
             ex = t.inst.exports.get(t.func_name)
             if ex is None or ex[0] != 0:
                 raise KeyError(f"tenant {ti}: no export {t.func_name}")
+            ft = t.inst.funcs[ex[1]].functype
+            if ValType.V128 in tuple(ft.params) + tuple(ft.results):
+                raise ValueError(
+                    f"tenant {ti}: batch entry functions cannot take or "
+                    f"return v128 (lane args are 64-bit cells)")
             fidx = ex[1] + self.bases[ti]["func"]
             self._tenant_funcidx.append(fidx)
             meta = t.inst.lowered.funcs[ex[1]]

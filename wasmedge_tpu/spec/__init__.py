@@ -290,3 +290,153 @@ def run_corpus(paths, engine: EngineKind = EngineKind.SCALAR) -> SpecReport:
             src = f.read()
         total.merge(st.run_script(src, script_name=str(path)))
     return total
+
+
+# ---------------------------------------------------------------------------
+# batched conformance: the corpus as a SIMT workload
+# ---------------------------------------------------------------------------
+def run_corpus_batched(paths, conf: Optional[Configure] = None
+                       ) -> SpecReport:
+    """Run the batchable subset of the corpus on the tpu_batch engine,
+    one assertion per LANE: every module's assert_return/assert_trap
+    commands against the same export are stacked into a lane batch and
+    executed in a single SIMT run, then checked per lane with the same
+    value/NaN/trap matching the scalar harness uses.  Modules that hold
+    cross-invoke state (memories, globals) or fall outside the batch
+    subset are skipped — they belong to the scalar/native runs.
+    """
+    import numpy as np
+
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    import copy
+
+    conf = copy.deepcopy(conf) if conf is not None else Configure()
+    conf.batch.steps_per_launch = 100_000
+    rep = SpecReport()
+    for path in paths:
+        if "subnormal" in str(path):
+            continue  # XLA flushes f32 subnormals; scalar/native cover it
+        with open(path) as f:
+            src = f.read()
+        try:
+            cmds = parse_wast(src)
+        except WatError as e:
+            rep.failed += 1
+            rep.failures.append(SpecFailure(str(path), -1, "parse", str(e)))
+            continue
+        # segment commands by module
+        module_cmds: List[tuple] = []   # (fields, [(idx, cmd)...])
+        cur: Optional[list] = None
+        for idx, cmd in enumerate(cmds):
+            if cmd.kind == "module":
+                cur = []
+                module_cmds.append((cmd.fields, cur))
+            elif cmd.kind in ("assert_return", "assert_trap") and \
+                    cur is not None and cmd.action[0] == "invoke":
+                cur.append((idx, cmd))
+            else:
+                rep.skipped += 1
+        for fields, asserts in module_cmds:
+            if not asserts:
+                continue
+            try:
+                data = compile_module_fields(fields)
+                mod = Validator(conf).validate(
+                    Loader(conf).parse_module(data))
+                store = StoreManager()
+                inst = Executor(conf).instantiate(store, mod)
+                if inst.memories or inst.globals:
+                    rep.skipped += len(asserts)
+                    continue
+                from wasmedge_tpu.batch import BatchEngine
+
+                by_field: Dict[str, list] = {}
+                for idx, cmd in asserts:
+                    by_field.setdefault(cmd.action[2], []).append(
+                        (idx, cmd))
+                lanes = max(len(v) for v in by_field.values())
+                eng = BatchEngine(inst, store=store, conf=conf,
+                                  lanes=lanes)
+            except (ValueError, LoadError, ValidationError) as e:
+                rep.skipped += len(asserts)
+                continue
+            for field, items in by_field.items():
+                fi = inst.find_func(field)
+                nargs = len(fi.functype.params)
+                args = np.zeros((max(nargs, 1), eng.lanes), np.int64)
+                for li in range(eng.lanes):
+                    idx, cmd = items[min(li, len(items) - 1)]
+                    for k, a in enumerate(cmd.action[3]):
+                        v = a[1]
+                        args[k, li] = v - 2**64 if v >= 2**63 else v
+                try:
+                    res = eng.run(field, [args[k] for k in range(nargs)],
+                                  max_steps=2_000_000)
+                except Exception as e:  # noqa: BLE001
+                    rep.failed += len(items)
+                    rep.failures.append(SpecFailure(
+                        str(path), items[0][0], "batch_run",
+                        f"{field}: {type(e).__name__}: {e}"))
+                    continue
+                for li, (idx, cmd) in enumerate(items):
+                    trap = int(res.trap[li])
+                    if cmd.kind == "assert_return":
+                        if trap != -1:
+                            rep.failed += 1
+                            rep.failures.append(SpecFailure(
+                                str(path), idx, "assert_return",
+                                f"{field} lane {li} trapped {trap}"))
+                            continue
+                        got = [int(r[li]) & (2**64 - 1)
+                               for r in res.results]
+                        exp = cmd.expected
+
+                        def match(e, g):
+                            if SpecTest._match_value(e, g):
+                                return True
+                            # documented batch-engine divergence: XLA
+                            # (TPU and CPU) flushes f32 subnormal
+                            # RESULTS to same-signed zero
+                            ty, want = e
+                            if ty == "f32" and isinstance(want, int):
+                                w = want & 0xFFFFFFFF
+                                g32 = g & 0xFFFFFFFF
+                                if (w & 0x7F800000) == 0 and \
+                                        (g32 & 0x7FFFFFFF) == 0 and \
+                                        (g32 >> 31) == (w >> 31):
+                                    return True
+                            return False
+
+                        ok = len(got) == len(exp) and all(
+                            match(e, g) for e, g in zip(exp, got))
+                        if ok:
+                            rep.passed += 1
+                        else:
+                            rep.failed += 1
+                            rep.failures.append(SpecFailure(
+                                str(path), idx, "assert_return",
+                                f"{field} lane {li} -> "
+                                f"{[hex(g) for g in got]}, want {exp}"))
+                    else:  # assert_trap
+                        if trap <= 0:
+                            rep.failed += 1
+                            rep.failures.append(SpecFailure(
+                                str(path), idx, "assert_trap",
+                                f"{field} lane {li} did not trap"))
+                            continue
+                        msg = TRAP_MESSAGES.get(ErrCode(trap), "")
+                        if not cmd.message or \
+                                msg.startswith(cmd.message) or \
+                                cmd.message.startswith(msg.split(" ")[0]):
+                            rep.passed += 1
+                        else:
+                            rep.failed += 1
+                            rep.failures.append(SpecFailure(
+                                str(path), idx, "assert_trap",
+                                f"{field} lane {li} trapped {msg!r}, "
+                                f"want {cmd.message!r}"))
+    return rep
