@@ -47,9 +47,38 @@ def _uleb(v: int) -> bytes:
             return bytes(out)
 
 
-def serialize_image(img: LoweredModule) -> bytes:
-    """LoweredModule -> bytes (json func metadata + npz code planes)."""
-    arrays = img.arrays
+def fused_planes_for(img: LoweredModule, mod):
+    """The Pallas engine's fused encoding (superinstruction hid/operand
+    planes — the `_build_kernel` cache-key planes), derived from the
+    lowered image and the module's DECLARED types/tables (mod is
+    required: dense type ids and the call_indirect table window are
+    derived from it, and the batch subset forbids table mutation, so the
+    declared minimum table size equals the live size).  Returns None
+    when the module is outside the batch subset."""
+    from wasmedge_tpu.batch.image import batchability, build_device_image
+    from wasmedge_tpu.batch.pallas_engine import fuse_image, hid_plane
+
+    host_imports = {i for i, f in enumerate(img.funcs) if f.is_import}
+    if batchability(img, host_imports=host_imports) is not None:
+        return None
+    tables = mod.all_table_types()
+    table0 = [0] * int(tables[0].limit.min) if tables else None
+    dimg = build_device_image(img, mod=mod, table0=table0)
+    hid = hid_plane(dimg)
+    hid, a, b, c, ilo, ihi = fuse_image(hid, dimg.a, dimg.b, dimg.c,
+                                        dimg.imm_lo, dimg.imm_hi, dimg)
+    return {"hid": hid, "a": a, "b": b, "c": c, "ilo": ilo, "ihi": ihi}
+
+
+def serialize_image(img: LoweredModule, mod=None) -> bytes:
+    """LoweredModule -> bytes (json func metadata + npz code planes +
+    the fused Pallas encoding when the module is batchable and the
+    declared module is available)."""
+    arrays = dict(img.arrays)
+    fused = fused_planes_for(img, mod) if mod is not None else None
+    if fused is not None:
+        for k, v in fused.items():
+            arrays[f"fz_{k}"] = v
     meta = {
         "version": AOT_VERSION,
         "funcs": [
@@ -91,6 +120,11 @@ def deserialize_image(data: bytes) -> LoweredModule:
         img.v128 = [int(lo) | (int(hi) << 64)
                     for lo, hi in zip(arrays["v128_lo"].tolist(),
                                       arrays["v128_hi"].tolist())]
+    if "fz_hid" in arrays:
+        # the persisted Pallas fused encoding; consumers verify it by
+        # regeneration before use (verify_fused)
+        img.fused = {k: arrays[f"fz_{k}"]
+                     for k in ("hid", "a", "b", "c", "ilo", "ihi")}
     for f in meta["funcs"]:
         img.funcs.append(FuncMeta(
             type_idx=f["type_idx"], nparams=f["nparams"],
@@ -104,6 +138,25 @@ def deserialize_image(data: bytes) -> LoweredModule:
     return img
 
 
+def verify_fused(img: LoweredModule, mod) -> bool:
+    """Verify a deserialized fused-plane section by exact regeneration
+    (mod required — the same declared module serialization used).
+
+    Regeneration is cheap (one linear pass) next to XLA compilation, so
+    the security story stays trivial: a tampered or stale fused section
+    can never influence execution — the engines use verified planes or
+    regenerate.  The heavyweight compiled artifact (the XLA executable)
+    is content-addressed in the persistent compilation cache
+    (batch.ensure_jax_backend), which a verified artifact keys into."""
+    fused = getattr(img, "fused", None)
+    if fused is None:
+        return False
+    regen = fused_planes_for(img, mod)
+    if regen is None:
+        return False
+    return all(np.array_equal(fused[k], regen[k]) for k in regen)
+
+
 def compile_module(wasm_bytes: bytes, conf=None) -> bytes:
     """wasm -> universal twasm: original bytes + tpu.aot custom section
     (reference: outputWasmLibrary, compiler.cpp:4270)."""
@@ -113,7 +166,7 @@ def compile_module(wasm_bytes: bytes, conf=None) -> bytes:
 
     conf = conf or Configure()
     mod = Validator(conf).validate(Loader(conf).parse_module(wasm_bytes))
-    payload = serialize_image(mod.lowered)
+    payload = serialize_image(mod.lowered, mod=mod)
     digest = hashlib.sha256(wasm_bytes).digest()
     body = struct.pack("<I", AOT_VERSION) + digest + payload
     name = SECTION_NAME.encode()

@@ -28,8 +28,32 @@ from wasmedge_tpu.batch.uniform import UniformBatchEngine
 def ensure_jax_backend():
     """Initialize the JAX backend, falling back to CPU when the configured
     platform (e.g. a TPU plugin named by JAX_PLATFORMS) is unavailable in
-    this process — keeps the CLI/batch path usable off-accelerator."""
+    this process — keeps the CLI/batch path usable off-accelerator.
+
+    Also enables the persistent XLA compilation cache (content-addressed
+    on-disk, like the reference's AOT cache lib/aot/cache.cpp:36-61):
+    a fresh process re-running a previously compiled kernel geometry
+    loads the compiled executable from disk instead of re-running
+    XLA/Mosaic.  Directory: $WASMEDGE_TPU_CACHE or
+    ~/.cache/wasmedge_tpu/xla; set WASMEDGE_TPU_CACHE=off to disable."""
+    import os
+
     import jax
+
+    cache_dir = os.environ.get("WASMEDGE_TPU_CACHE")
+    if cache_dir != "off":
+        if not cache_dir:
+            cache_dir = os.path.join(
+                os.path.expanduser("~"), ".cache", "wasmedge_tpu", "xla")
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.1)
+        except Exception:  # cache is an optimization, never a failure
+            pass
 
     try:
         jax.devices()

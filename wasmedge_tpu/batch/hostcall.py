@@ -11,9 +11,15 @@ waiting lanes through the ordinary Python host-function layer
 writes results and memory effects back into the SoA state, and re-arms
 the lanes while the rest of the batch keeps stepping.
 
-Sandbox model: all lanes share the host module instances registered with
-the store (one WASI environ / fd table), like threads of one OS process;
-per-lane data (args, results, linear memory) is fully isolated.
+Sandbox model: lanes of ONE engine share that engine's instance's host
+modules (one WASI environ / fd table), like threads of one OS process;
+per-lane data (args, results, linear memory) is fully isolated.  Tenants
+are stronger: each tenant instance carries its own registered host
+modules — its own WASI environ, preopens, and fd table (the per-VM
+WASI::Environ model, reference environ.h:38-1156) — and the multi-tenant
+scheduler serves every tenant's outcalls through its own instance, so
+tenant A can never reach tenant B's preopens
+(tests/test_multitenant.py::test_per_tenant_wasi_isolation).
 """
 
 from __future__ import annotations

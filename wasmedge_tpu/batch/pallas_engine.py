@@ -1471,6 +1471,9 @@ class PallasUniformEngine:
         self._blk_cap = None  # lane-block ceiling (multi-tenant alignment)
         self.fell_back_to_simt = False
         self.splits = 0  # block-scheduler split count from the last run()
+        # None = no tpu.aot fused section attached; set by _build when a
+        # loaded artifact carries one (True = matched regeneration)
+        self.aot_fused_verified = None
         # per-lane page counts recorded when a host outcall grows memory
         # (block ctrl keeps one uniform count; growth diverges the block)
         self._pages_override = {}
@@ -1561,6 +1564,16 @@ class PallasUniformEngine:
         ilo_p, ihi_p = img.imm_lo, img.imm_hi
         hid, a_p, b_p, c_p, ilo_p, ihi_p = fuse_image(
             hid, a_p, b_p, c_p, ilo_p, ihi_p, img)
+        # tpu.aot artifacts carry the fused encoding; cross-check it
+        # against regeneration (aot.verify_fused's model: a stale or
+        # tampered section is ignored, never executed)
+        attached = getattr(self.inst.lowered, "fused", None)
+        if attached is not None:
+            self.aot_fused_verified = (
+                len(attached["hid"]) == len(hid)
+                and all(np.array_equal(attached[k], v) for k, v in
+                        (("hid", hid), ("a", a_p), ("b", b_p),
+                         ("c", c_p), ("ilo", ilo_p), ("ihi", ihi_p))))
         used = tuple(sorted(set(int(h) for h in hid)))
         dense = {h: i for i, h in enumerate(used)}
         hid_dense = np.asarray([dense[int(h)] for h in hid], np.int32)
@@ -1774,6 +1787,7 @@ class PallasUniformEngine:
         sched.run()
         self.fell_back_to_simt = sched.fell_back_to_simt
         self.splits = sched.splits
+        self.aot_fused_verified = sched.eng.aot_fused_verified
         return sched.result()
 
     def _serve_hostcalls(self, state, ctrl_np, valid_blocks=None):

@@ -429,9 +429,10 @@ def run_corpus_batched(paths, conf: Optional[Configure] = None
                                 f"{field} lane {li} did not trap"))
                             continue
                         msg = TRAP_MESSAGES.get(ErrCode(trap), "")
-                        if not cmd.message or \
-                                msg.startswith(cmd.message) or \
-                                cmd.message.startswith(msg.split(" ")[0]):
+                        if not cmd.message or (msg and (
+                                msg.startswith(cmd.message)
+                                or cmd.message.startswith(
+                                    msg.split(" ")[0]))):
                             rep.passed += 1
                         else:
                             rep.failed += 1
