@@ -1,0 +1,19 @@
+/* Out-of-process C embedder: compile fib.wasm to twasm, run fib(24)
+ * through the shim, print the result.  Usage: example_fib fib.wasm */
+#include "wasmedge_tpu.h"
+#include <stdio.h>
+
+int main(int argc, char **argv) {
+    if (argc < 2) { fprintf(stderr, "usage: %s fib.wasm\n", argv[0]); return 2; }
+    if (we_init()) { fprintf(stderr, "init: %s\n", we_last_error()); return 1; }
+    printf("wasmedge_tpu %u.%u\n", we_version_major(), we_version_minor());
+    we_vm *vm = we_vm_create();
+    if (!vm) { fprintf(stderr, "vm: %s\n", we_last_error()); return 1; }
+    long long args[1] = {24}, results[1];
+    int n = we_vm_run_i64(vm, argv[1], "fib", args, 1, results, 1);
+    if (n < 0) { fprintf(stderr, "run: %s\n", we_last_error()); return 1; }
+    printf("fib(24) = %lld\n", results[0]);
+    we_vm_delete(vm);
+    we_shutdown();
+    return results[0] == 46368 ? 0 : 1;
+}

@@ -1,0 +1,139 @@
+/* CPython-embedding shim implementing wasmedge_tpu.h.
+ *
+ * One interpreter per process; every entry point grabs the GIL-less
+ * single-threaded happy path (call we_init first).  Mirrors how the
+ * reference's language bindings sit on its C API: this file is the only
+ * place that knows Python exists.
+ */
+#include "wasmedge_tpu.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdio.h>
+#include <string.h>
+#include <stdlib.h>
+
+static PyObject *g_capi = NULL;
+static char g_err[1024];
+
+static void set_err_from_py(void) {
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    if (value) {
+        PyObject *s = PyObject_Str(value);
+        if (s) {
+            snprintf(g_err, sizeof g_err, "%s", PyUnicode_AsUTF8(s));
+            Py_DECREF(s);
+        }
+    }
+    Py_XDECREF(type); Py_XDECREF(value); Py_XDECREF(tb);
+}
+
+const char *we_last_error(void) { return g_err; }
+
+int we_init(void) {
+    if (g_capi) return 0;
+    if (!Py_IsInitialized()) Py_Initialize();
+    const char *root = getenv("WASMEDGE_TPU_PYROOT");
+    if (root) {
+        PyObject *sys_path = PySys_GetObject("path");
+        PyObject *p = PyUnicode_FromString(root);
+        PyList_Insert(sys_path, 0, p);
+        Py_DECREF(p);
+    }
+    g_capi = PyImport_ImportModule("wasmedge_tpu.capi");
+    if (!g_capi) { set_err_from_py(); return -1; }
+    return 0;
+}
+
+void we_shutdown(void) {
+    Py_XDECREF(g_capi);
+    g_capi = NULL;
+}
+
+struct we_vm { PyObject *ctx; };
+
+we_vm *we_vm_create(void) {
+    if (we_init()) return NULL;
+    PyObject *ctx = PyObject_CallMethod(g_capi, "we_VMCreate", NULL);
+    if (!ctx) { set_err_from_py(); return NULL; }
+    we_vm *vm = (we_vm *)malloc(sizeof *vm);
+    vm->ctx = ctx;
+    return vm;
+}
+
+void we_vm_delete(we_vm *vm) {
+    if (!vm) return;
+    Py_XDECREF(vm->ctx);
+    free(vm);
+}
+
+int we_vm_run_i64(we_vm *vm, const char *wasm_path, const char *func,
+                  const long long *args, int nargs,
+                  long long *results, int max_results) {
+    PyObject *params = PyList_New(nargs);
+    for (int i = 0; i < nargs; i++) {
+        PyObject *v = PyObject_CallMethod(g_capi, "we_ValueGenI64", "L",
+                                          args[i]);
+        if (!v) { set_err_from_py(); Py_DECREF(params); return -1; }
+        PyList_SET_ITEM(params, i, v);
+    }
+    PyObject *pair = PyObject_CallMethod(
+        g_capi, "we_VMRunWasmFromFile", "OssO", vm->ctx, wasm_path, func,
+        params);
+    Py_DECREF(params);
+    if (!pair) { set_err_from_py(); return -1; }
+    PyObject *res = PyTuple_GetItem(pair, 0);
+    PyObject *vals = PyTuple_GetItem(pair, 1);
+    PyObject *ok = PyObject_CallMethod(g_capi, "we_ResultOK", "O", res);
+    if (!PyObject_IsTrue(ok)) {
+        PyObject *code = PyObject_CallMethod(g_capi, "we_ResultGetCode",
+                                             "O", res);
+        PyObject *msg = PyObject_CallMethod(g_capi, "we_ResultGetMessage",
+                                            "O", res);
+        snprintf(g_err, sizeof g_err, "%s", PyUnicode_AsUTF8(msg));
+        long c = PyLong_AsLong(code);
+        Py_DECREF(ok); Py_DECREF(code); Py_DECREF(msg); Py_DECREF(pair);
+        return c > 0 ? -(int)c : -1;
+    }
+    Py_DECREF(ok);
+    int n = (int)PyList_Size(vals);
+    for (int i = 0; i < n && i < max_results; i++) {
+        PyObject *cell = PyObject_CallMethod(
+            g_capi, "we_ValueGetI64", "O", PyList_GetItem(vals, i));
+        results[i] = PyLong_AsLongLong(cell);
+        Py_DECREF(cell);
+    }
+    Py_DECREF(pair);
+    return n;
+}
+
+int we_compile(const char *in_path, const char *out_path) {
+    if (we_init()) return -1;
+    PyObject *comp = PyObject_CallMethod(g_capi, "we_CompilerCreate", NULL);
+    if (!comp) { set_err_from_py(); return -1; }
+    PyObject *res = PyObject_CallMethod(g_capi, "we_CompilerCompile",
+                                        "Oss", comp, in_path, out_path);
+    Py_DECREF(comp);
+    if (!res) { set_err_from_py(); return -1; }
+    PyObject *ok = PyObject_CallMethod(g_capi, "we_ResultOK", "O", res);
+    int rc = PyObject_IsTrue(ok) ? 0 : -1;
+    Py_DECREF(ok); Py_DECREF(res);
+    return rc;
+}
+
+unsigned we_version_major(void) {
+    if (we_init()) return 0;
+    PyObject *v = PyObject_CallMethod(g_capi, "we_VersionGetMajor", NULL);
+    unsigned r = (unsigned)PyLong_AsUnsignedLong(v);
+    Py_DECREF(v);
+    return r;
+}
+
+unsigned we_version_minor(void) {
+    if (we_init()) return 0;
+    PyObject *v = PyObject_CallMethod(g_capi, "we_VersionGetMinor", NULL);
+    unsigned r = (unsigned)PyLong_AsUnsignedLong(v);
+    Py_DECREF(v);
+    return r;
+}

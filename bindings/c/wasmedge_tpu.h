@@ -1,0 +1,49 @@
+/* wasmedge_tpu C embedding interface.
+ *
+ * The moral analog of the reference's wasmedge.h for this framework
+ * (reference: /root/reference/include/api/wasmedge/wasmedge.h): a C host
+ * links against the shim (shim.c), which embeds CPython and drives the
+ * wasmedge_tpu.capi surface — the same way the reference's Rust bindings
+ * are an FFI layer over its C API (bindings/rust/wasmedge-sys).
+ *
+ * Build: cc -c shim.c $(python3-config --includes)
+ *        cc example_fib.c shim.o $(python3-config --embed --ldflags)
+ * Set WASMEDGE_TPU_PYROOT to the repo root if wasmedge_tpu is not on the
+ * default Python path.
+ */
+#ifndef WASMEDGE_TPU_H
+#define WASMEDGE_TPU_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct we_vm we_vm;
+
+/* Initialize the embedded runtime (idempotent). Returns 0 on success. */
+int we_init(void);
+void we_shutdown(void);
+
+we_vm *we_vm_create(void);
+void we_vm_delete(we_vm *vm);
+
+/* Run `func` from the wasm/twasm file with 64-bit integer arguments.
+ * Results are written to `results` (up to max_results cells).
+ * Returns the number of results, or a negative engine error code. */
+int we_vm_run_i64(we_vm *vm, const char *wasm_path, const char *func,
+                  const long long *args, int nargs,
+                  long long *results, int max_results);
+
+/* Compile wasm -> universal twasm (tpu.aot section). 0 on success. */
+int we_compile(const char *in_path, const char *out_path);
+
+/* Last error message (valid until the next call on the same thread). */
+const char *we_last_error(void);
+
+unsigned we_version_major(void);
+unsigned we_version_minor(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* WASMEDGE_TPU_H */

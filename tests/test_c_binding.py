@@ -1,0 +1,55 @@
+"""Out-of-process C embedding: build bindings/c's shim + example with the
+system C compiler and run fib through it — proving the embedding surface
+is usable from outside Python (the reference's bindings/rust analog)."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+CDIR = os.path.join(ROOT, "bindings", "c")
+
+
+def _python_config(flag):
+    exe = f"python{sys.version_info.major}.{sys.version_info.minor}-config"
+    cfg = shutil.which(exe) or shutil.which("python3-config")
+    if cfg is None:
+        pytest.skip("python3-config not available")
+    out = subprocess.run([cfg, flag], capture_output=True, text=True)
+    if out.returncode != 0:
+        pytest.skip(f"python3-config {flag} failed")
+    return out.stdout.split()
+
+
+def test_c_example_runs_fib(tmp_path):
+    cc = shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+    if cc is None:
+        pytest.skip("no C compiler")
+    includes = _python_config("--includes")
+    ldflags = _python_config("--ldflags")
+    embed = subprocess.run(
+        [shutil.which("python3-config") or "python3-config", "--embed",
+         "--ldflags"], capture_output=True, text=True)
+    if embed.returncode == 0:
+        ldflags = embed.stdout.split()
+    exe = tmp_path / "example_fib"
+    build = subprocess.run(
+        [cc, os.path.join(CDIR, "example_fib.c"),
+         os.path.join(CDIR, "shim.c"), "-I", CDIR, "-o", str(exe)]
+        + includes + ldflags,
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+
+    from wasmedge_tpu.models import build_fib
+
+    wasm = tmp_path / "fib.wasm"
+    wasm.write_bytes(build_fib())
+    env = dict(os.environ, WASMEDGE_TPU_PYROOT=ROOT)
+    run = subprocess.run([str(exe), str(wasm)], capture_output=True,
+                         text=True, env=env, timeout=300)
+    assert run.returncode == 0, (run.stdout, run.stderr)
+    assert "fib(24) = 46368" in run.stdout

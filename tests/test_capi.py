@@ -300,3 +300,113 @@ def test_spec_corpus_through_capi():
         assert rep.failed == 0, f"{path}: {rep.failed} failed\n{detail}"
         total_passed += rep.passed
     assert total_passed > 3000
+
+
+# ---------------------------------------------------------------------------
+# round-3 families: types, instance creation, ImportObjectAdd*, Compiler
+# ---------------------------------------------------------------------------
+
+def test_function_type_contexts():
+    ft = C.we_FunctionTypeCreate(["i32", "i64"], ["f64"])
+    assert C.we_FunctionTypeGetParametersLength(ft) == 2
+    assert C.we_FunctionTypeGetParameters(ft) == ["i32", "i64"]
+    assert C.we_FunctionTypeGetReturnsLength(ft) == 1
+    assert C.we_FunctionTypeGetReturns(ft) == ["f64"]
+    C.we_FunctionTypeDelete(ft)
+
+
+def test_table_memory_global_types_and_instances():
+    tt = C.we_TableTypeCreate("funcref", 4, 8)
+    assert C.we_TableTypeGetRefType(tt) == "funcref"
+    assert C.we_TableTypeGetLimit(tt) == (4, 8)
+    tab = C.we_TableInstanceCreate(tt)
+    assert C.we_TableInstanceGetSize(tab) == 4
+    res = C.we_TableInstanceSetData(tab, 2, 7)
+    assert C.we_ResultOK(res)
+    res, ref = C.we_TableInstanceGetData(tab, 2)
+    assert C.we_ResultOK(res) and ref == 7
+    res, _ = C.we_TableInstanceGetData(tab, 99)
+    assert not C.we_ResultOK(res)
+    assert C.we_ResultOK(C.we_TableInstanceGrow(tab, 2))
+    assert C.we_TableInstanceGetSize(tab) == 6
+
+    mt = C.we_MemoryTypeCreate(1, 2)
+    assert C.we_MemoryTypeGetLimit(mt) == (1, 2)
+    mem = C.we_MemoryInstanceCreate(mt)
+    assert C.we_MemoryInstanceGetPageSize(mem) == 1
+
+    gt = C.we_GlobalTypeCreate("i64", True)
+    assert C.we_GlobalTypeGetValType(gt) == "i64"
+    assert C.we_GlobalTypeGetMutability(gt)
+    g = C.we_GlobalInstanceCreate(gt, C.we_Value("i64", -5))
+    assert C.we_GlobalInstanceGetGlobalType(g).mutable
+
+
+def test_import_object_add_table_memory_global():
+    """A module importing a host table/memory/global through the
+    ImportObjectAdd* family (reference: ImportObjectAddTable etc.)."""
+    imp = C.we_ImportObjectCreate("env")
+    tab = C.we_TableInstanceCreate(C.we_TableTypeCreate("funcref", 2, 2))
+    mem = C.we_MemoryInstanceCreate(C.we_MemoryTypeCreate(1, 1))
+    glob = C.we_GlobalInstanceCreate(C.we_GlobalTypeCreate("i32", False),
+                                     C.we_Value("i32", 41))
+    C.we_ImportObjectAddTable(imp, "t", tab)
+    C.we_ImportObjectAddMemory(imp, "m", mem)
+    C.we_ImportObjectAddGlobal(imp, "g", glob)
+
+    b = ModuleBuilder()
+    b.import_table("env", "t", "funcref", 2, 2)
+    b.import_memory("env", "m", 1, 1)
+    b.import_global("env", "g", "i32", False)
+    b.add_function([], ["i32"], [], [
+        ("i32.const", 64), ("i32.const", 7), ("i32.store", 2, 0),
+        ("i32.const", 64), ("i32.load", 2, 0),
+        ("global.get", 0), "i32.add",
+    ], export="f")
+    vm = C.we_VMCreate()
+    assert C.we_ResultOK(C.we_VMRegisterModuleFromImport(vm, imp))
+    res, out = C.we_VMRunWasmFromBuffer(vm, b.build(), "f", [])
+    assert C.we_ResultOK(res), res
+    assert C.we_ValueGetI32(out[0]) == 48
+    # the host memory instance saw the guest's store
+    assert mem.load(64, 4, False) == 7
+
+
+def test_compiler_family(tmp_path):
+    from wasmedge_tpu.models import build_fib
+
+    src = tmp_path / "fib.wasm"
+    out = tmp_path / "fib.twasm"
+    src.write_bytes(build_fib())
+    comp = C.we_CompilerCreate()
+    res = C.we_CompilerCompile(comp, str(src), str(out))
+    assert C.we_ResultOK(res)
+    data = out.read_bytes()
+    assert b"tpu.aot" in data
+    # buffer variant round-trips and still runs through the VM
+    res, buf = C.we_CompilerCompileFromBuffer(comp, build_fib())
+    assert C.we_ResultOK(res)
+    vm = C.we_VMCreate()
+    res, outv = C.we_VMRunWasmFromBuffer(vm, bytes(buf), "fib",
+                                         [C.we_Value("i32", 12)])
+    assert C.we_ResultOK(res)
+    assert C.we_ValueGetI32(outv[0]) == 144
+    C.we_CompilerDelete(comp)
+
+
+def test_version_and_listings():
+    assert C.we_VersionGet().startswith("0.9.1")
+    assert C.we_VersionGetMajor() == 0
+    assert C.we_VersionGetMinor() == 9
+    b = ModuleBuilder()
+    b.add_memory(1, 1, export="m")
+    b.add_global("i32", False, [("i32.const", 3)], export="g")
+    b.add_function([], ["i32"], [], [("i32.const", 1)], export="f")
+    vm = C.we_VMCreate()
+    assert C.we_ResultOK(C.we_VMLoadWasmFromBuffer(vm, b.build()))
+    assert C.we_ResultOK(C.we_VMValidate(vm))
+    assert C.we_ResultOK(C.we_VMInstantiate(vm))
+    inst = C.we_VMGetActiveModule(vm)
+    assert C.we_ModuleInstanceListFunctionLength(inst) == 1
+    assert C.we_ModuleInstanceListMemory(inst) == ["m"]
+    assert C.we_ModuleInstanceListGlobal(inst) == ["g"]

@@ -1564,9 +1564,10 @@ class PallasUniformEngine:
         ilo_p, ihi_p = img.imm_lo, img.imm_hi
         hid, a_p, b_p, c_p, ilo_p, ihi_p = fuse_image(
             hid, a_p, b_p, c_p, ilo_p, ihi_p, img)
-        # tpu.aot artifacts carry the fused encoding; cross-check it
-        # against regeneration (aot.verify_fused's model: a stale or
-        # tampered section is ignored, never executed)
+        # tpu.aot artifacts carry the fused encoding.  Verification IS
+        # regeneration (cheap next to XLA compilation); once verified,
+        # the attached planes are the ones executed — a stale or
+        # tampered section is detected here and never runs.
         attached = getattr(self.inst.lowered, "fused", None)
         if attached is not None:
             self.aot_fused_verified = (
@@ -1574,6 +1575,10 @@ class PallasUniformEngine:
                 and all(np.array_equal(attached[k], v) for k, v in
                         (("hid", hid), ("a", a_p), ("b", b_p),
                          ("c", c_p), ("ilo", ilo_p), ("ihi", ihi_p))))
+            if self.aot_fused_verified:
+                hid, a_p, b_p, c_p, ilo_p, ihi_p = (
+                    attached["hid"], attached["a"], attached["b"],
+                    attached["c"], attached["ilo"], attached["ihi"])
         used = tuple(sorted(set(int(h) for h in hid)))
         dense = {h: i for i, h in enumerate(used)}
         hid_dense = np.asarray([dense[int(h)] for h in hid], np.int32)

@@ -679,3 +679,333 @@ def we_VMBatchExecute(ctx, func_name: str, per_lane_args, lanes: int,
                                  lanes=lanes)
         return eng.run(func_name, list(per_lane_args), max_steps=max_steps)
     return _wrap(go)
+
+
+# ---------------------------------------------------------------------------
+# Version (reference: WasmEdge_VersionGet*)
+# ---------------------------------------------------------------------------
+WE_VERSION = "0.9.1-tpu.3"  # tracks the reference release + our round
+
+
+def we_VersionGet() -> str:
+    return WE_VERSION
+
+
+def we_VersionGetMajor() -> int:
+    return int(WE_VERSION.split(".")[0])
+
+
+def we_VersionGetMinor() -> int:
+    return int(WE_VERSION.split(".")[1])
+
+
+def we_VersionGetPatch() -> int:
+    return int(WE_VERSION.split(".")[2].split("-")[0])
+
+
+# ---------------------------------------------------------------------------
+# Log (reference: WasmEdge_LogSetErrorLevel / LogSetDebugLevel)
+# ---------------------------------------------------------------------------
+def we_LogSetErrorLevel() -> None:
+    import logging
+
+    logging.getLogger("wasmedge_tpu").setLevel(logging.ERROR)
+
+
+def we_LogSetDebugLevel() -> None:
+    import logging
+
+    logging.getLogger("wasmedge_tpu").setLevel(logging.DEBUG)
+
+
+# ---------------------------------------------------------------------------
+# FunctionType / TableType / MemoryType / GlobalType contexts
+# (reference: WasmEdge_FunctionTypeCreate ... GlobalTypeGetMutability)
+# ---------------------------------------------------------------------------
+_VALTYPE_NAMES = {"i32": 0x7F, "i64": 0x7E, "f32": 0x7D, "f64": 0x7C,
+                  "v128": 0x7B, "funcref": 0x70, "externref": 0x6F}
+
+
+def _to_valtype(name):
+    from wasmedge_tpu.common.types import ValType
+
+    if isinstance(name, ValType):
+        return name
+    return ValType(_VALTYPE_NAMES[name])
+
+
+def we_FunctionTypeCreate(params: Sequence, results: Sequence):
+    from wasmedge_tpu.loader import ast
+
+    return ast.FunctionType(tuple(_to_valtype(p) for p in params),
+                            tuple(_to_valtype(r) for r in results))
+
+
+def we_FunctionTypeDelete(ft) -> None:
+    pass
+
+
+def we_FunctionTypeGetParametersLength(ft) -> int:
+    return len(ft.params)
+
+
+def we_FunctionTypeGetParameters(ft) -> list:
+    return [t.name.lower() for t in ft.params]
+
+
+def we_FunctionTypeGetReturnsLength(ft) -> int:
+    return len(ft.results)
+
+
+def we_FunctionTypeGetReturns(ft) -> list:
+    return [t.name.lower() for t in ft.results]
+
+
+def we_TableTypeCreate(ref_type: str, min_size: int,
+                       max_size: Optional[int] = None):
+    from wasmedge_tpu.loader import ast
+
+    return ast.TableType(_to_valtype(ref_type),
+                         ast.Limit(min_size, max_size))
+
+
+def we_TableTypeDelete(tt) -> None:
+    pass
+
+
+def we_TableTypeGetRefType(tt) -> str:
+    return tt.ref_type.name.lower()
+
+
+def we_TableTypeGetLimit(tt) -> Tuple[int, Optional[int]]:
+    return (tt.limit.min, tt.limit.max)
+
+
+def we_MemoryTypeCreate(min_pages: int, max_pages: Optional[int] = None):
+    from wasmedge_tpu.loader import ast
+
+    return ast.MemoryType(ast.Limit(min_pages, max_pages))
+
+
+def we_MemoryTypeDelete(mt) -> None:
+    pass
+
+
+def we_MemoryTypeGetLimit(mt) -> Tuple[int, Optional[int]]:
+    return (mt.limit.min, mt.limit.max)
+
+
+def we_GlobalTypeCreate(val_type: str, mutable: bool):
+    from wasmedge_tpu.loader import ast
+
+    return ast.GlobalType(_to_valtype(val_type), mutable)
+
+
+def we_GlobalTypeDelete(gt) -> None:
+    pass
+
+
+def we_GlobalTypeGetValType(gt) -> str:
+    return gt.val_type.name.lower()
+
+
+def we_GlobalTypeGetMutability(gt) -> bool:
+    return gt.mutable
+
+
+# ---------------------------------------------------------------------------
+# Instance creation (reference: WasmEdge_TableInstanceCreate etc.)
+# ---------------------------------------------------------------------------
+def we_TableInstanceCreate(tab_type):
+    from wasmedge_tpu.runtime.instance import TableInstance
+
+    return TableInstance(tab_type)
+
+
+def we_TableInstanceDelete(tab) -> None:
+    pass
+
+
+def we_TableInstanceGetTableType(tab):
+    from wasmedge_tpu.loader import ast
+
+    # current size, not the declared min: grow updates the type's min
+    # (reference TableInstance semantics)
+    return ast.TableType(tab.ref_type, ast.Limit(len(tab.refs), tab.max))
+
+
+def we_TableInstanceGetData(tab, idx: int):
+    if not (0 <= idx < len(tab.refs)):
+        return we_Result(int(ErrCode.TableOutOfBounds),
+                         "out of bounds table access"), 0
+    return we_Result_Success, tab.refs[idx]
+
+
+def we_TableInstanceSetData(tab, idx: int, ref: int):
+    if not (0 <= idx < len(tab.refs)):
+        return we_Result(int(ErrCode.TableOutOfBounds),
+                         "out of bounds table access")
+    tab.refs[idx] = ref
+    return we_Result_Success
+
+
+def we_TableInstanceGrow(tab, delta: int):
+    old = tab.grow(delta, 0)
+    if old < 0:
+        return we_Result(int(ErrCode.TableOutOfBounds),
+                         "out of bounds table access")
+    return we_Result_Success
+
+
+def we_MemoryInstanceCreate(mem_type):
+    from wasmedge_tpu.runtime.instance import MemoryInstance
+
+    return MemoryInstance(mem_type)
+
+
+def we_MemoryInstanceDelete(mem) -> None:
+    pass
+
+
+def we_MemoryInstanceGetMemoryType(mem):
+    from wasmedge_tpu.loader import ast
+
+    return ast.MemoryType(ast.Limit(mem.pages, mem.max))
+
+
+def we_GlobalInstanceCreate(glob_type, value: we_Value):
+    from wasmedge_tpu.runtime.instance import GlobalInstance
+
+    g = GlobalInstance(glob_type, value.raw)
+    return g
+
+
+def we_GlobalInstanceDelete(glob) -> None:
+    pass
+
+
+def we_GlobalInstanceGetGlobalType(glob):
+    return glob.type
+
+
+# ---------------------------------------------------------------------------
+# ImportObjectAdd{Table,Memory,Global}
+# (reference: WasmEdge_ImportObjectAddTable/AddMemory/AddGlobal)
+# ---------------------------------------------------------------------------
+def we_ImportObjectAddTable(imp, name: str, tab) -> None:
+    imp.add_table(name, tab)
+
+
+def we_ImportObjectAddMemory(imp, name: str, mem) -> None:
+    imp.add_memory(name, mem)
+
+
+def we_ImportObjectAddGlobal(imp, name: str, glob) -> None:
+    imp.add_global(name, glob)
+
+
+# ---------------------------------------------------------------------------
+# Compiler (reference: WasmEdge_CompilerCreate / CompilerCompile;
+# our artifact is universal twasm — original bytes + tpu.aot section
+# carrying the verified image and the fused Pallas encoding)
+# ---------------------------------------------------------------------------
+class _Compiler:
+    def __init__(self, conf: Optional[Configure]):
+        self.conf = conf or Configure()
+
+
+def we_CompilerCreate(conf: Optional[Configure] = None):
+    return _Compiler(conf)
+
+
+def we_CompilerDelete(compiler) -> None:
+    pass
+
+
+def we_CompilerCompile(compiler, in_path: str, out_path: str):
+    def go():
+        from wasmedge_tpu.aot import compile_module
+
+        with open(in_path, "rb") as f:
+            data = f.read()
+        out = compile_module(data, compiler.conf)
+        with open(out_path, "wb") as f:
+            f.write(out)
+    return _wrap(go)[0]
+
+
+def we_CompilerCompileFromBuffer(compiler, data: bytes):
+    def go():
+        from wasmedge_tpu.aot import compile_module
+
+        return compile_module(bytes(data), compiler.conf)
+    return _wrap(go)
+
+
+# ---------------------------------------------------------------------------
+# Extra instance/store/VM listings (reference: the List*/Get* remainder)
+# ---------------------------------------------------------------------------
+def we_ModuleInstanceListFunctionLength(inst) -> int:
+    return len(we_ModuleInstanceListFunction(inst))
+
+
+def we_ModuleInstanceListTable(inst) -> list:
+    return [n for n, (k, _) in inst.exports.items() if k == 1]
+
+
+def we_ModuleInstanceListTableLength(inst) -> int:
+    return len(we_ModuleInstanceListTable(inst))
+
+
+def we_ModuleInstanceListMemory(inst) -> list:
+    return [n for n, (k, _) in inst.exports.items() if k == 2]
+
+
+def we_ModuleInstanceListMemoryLength(inst) -> int:
+    return len(we_ModuleInstanceListMemory(inst))
+
+
+def we_ModuleInstanceListGlobal(inst) -> list:
+    return [n for n, (k, _) in inst.exports.items() if k == 3]
+
+
+def we_ModuleInstanceListGlobalLength(inst) -> int:
+    return len(we_ModuleInstanceListGlobal(inst))
+
+
+def we_StoreListModuleLength(store) -> int:
+    return len(we_StoreListModule(store))
+
+
+def we_FunctionInstanceGetName(fi) -> str:
+    return getattr(fi, "name", "") or ""
+
+
+def we_MemoryInstanceGetPageLimit(mem) -> int:
+    return mem.page_limit
+
+
+def we_StatisticsClear(stat: Statistics) -> None:
+    stat.reset()
+
+
+def we_StatisticsSetCostTable(stat: Statistics, table) -> None:
+    # pad/truncate to the engine's slot count (wasm opcodes + the
+    # lowered BR/BRZ/BRNZ pseudo-ops) so a reference-sized table can
+    # never index out of bounds mid-run
+    from wasmedge_tpu.common.statistics import _NUM_COST_SLOTS
+
+    t = list(table)[:_NUM_COST_SLOTS]
+    t += [1] * (_NUM_COST_SLOTS - len(t))
+    stat.cost_table = t
+
+
+def we_VMGetFunctionListLength(vm) -> int:
+    return len(we_VMGetFunctionList(vm))
+
+
+
+def we_VMGetActiveModule(ctx):
+    """The anonymous (last-instantiated) module instance
+    (reference: WasmEdge_VMGetActiveModule)."""
+    return ctx.vm.active_module
