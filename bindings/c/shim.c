@@ -85,15 +85,23 @@ int we_vm_run_i64(we_vm *vm, const char *wasm_path, const char *func,
     if (!pair) { set_err_from_py(); return -1; }
     PyObject *res = PyTuple_GetItem(pair, 0);
     PyObject *vals = PyTuple_GetItem(pair, 1);
+    if (!res || !vals) { set_err_from_py(); Py_DECREF(pair); return -1; }
     PyObject *ok = PyObject_CallMethod(g_capi, "we_ResultOK", "O", res);
+    if (!ok) { set_err_from_py(); Py_DECREF(pair); return -1; }
     if (!PyObject_IsTrue(ok)) {
+        long c = -1;
         PyObject *code = PyObject_CallMethod(g_capi, "we_ResultGetCode",
                                              "O", res);
         PyObject *msg = PyObject_CallMethod(g_capi, "we_ResultGetMessage",
                                             "O", res);
-        snprintf(g_err, sizeof g_err, "%s", PyUnicode_AsUTF8(msg));
-        long c = PyLong_AsLong(code);
-        Py_DECREF(ok); Py_DECREF(code); Py_DECREF(msg); Py_DECREF(pair);
+        if (msg) {
+            const char *m = PyUnicode_AsUTF8(msg);
+            snprintf(g_err, sizeof g_err, "%s", m ? m : "unknown error");
+        } else {
+            set_err_from_py();
+        }
+        if (code) c = PyLong_AsLong(code);
+        Py_DECREF(ok); Py_XDECREF(code); Py_XDECREF(msg); Py_DECREF(pair);
         return c > 0 ? -(int)c : -1;
     }
     Py_DECREF(ok);
@@ -101,6 +109,7 @@ int we_vm_run_i64(we_vm *vm, const char *wasm_path, const char *func,
     for (int i = 0; i < n && i < max_results; i++) {
         PyObject *cell = PyObject_CallMethod(
             g_capi, "we_ValueGetI64", "O", PyList_GetItem(vals, i));
+        if (!cell) { set_err_from_py(); Py_DECREF(pair); return -1; }
         results[i] = PyLong_AsLongLong(cell);
         Py_DECREF(cell);
     }

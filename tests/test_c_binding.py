@@ -14,14 +14,19 @@ ROOT = os.path.dirname(HERE)
 CDIR = os.path.join(ROOT, "bindings", "c")
 
 
-def _python_config(flag):
+def _config_tool():
     exe = f"python{sys.version_info.major}.{sys.version_info.minor}-config"
     cfg = shutil.which(exe) or shutil.which("python3-config")
     if cfg is None:
         pytest.skip("python3-config not available")
-    out = subprocess.run([cfg, flag], capture_output=True, text=True)
+    return cfg
+
+
+def _python_config(*flags):
+    out = subprocess.run([_config_tool(), *flags], capture_output=True,
+                         text=True)
     if out.returncode != 0:
-        pytest.skip(f"python3-config {flag} failed")
+        pytest.skip(f"python3-config {' '.join(flags)} failed")
     return out.stdout.split()
 
 
@@ -30,12 +35,10 @@ def test_c_example_runs_fib(tmp_path):
     if cc is None:
         pytest.skip("no C compiler")
     includes = _python_config("--includes")
-    ldflags = _python_config("--ldflags")
-    embed = subprocess.run(
-        [shutil.which("python3-config") or "python3-config", "--embed",
-         "--ldflags"], capture_output=True, text=True)
-    if embed.returncode == 0:
-        ldflags = embed.stdout.split()
+    embed = subprocess.run([_config_tool(), "--embed", "--ldflags"],
+                           capture_output=True, text=True)
+    ldflags = embed.stdout.split() if embed.returncode == 0 \
+        else _python_config("--ldflags")
     exe = tmp_path / "example_fib"
     build = subprocess.run(
         [cc, os.path.join(CDIR, "example_fib.c"),

@@ -1570,11 +1570,11 @@ class PallasUniformEngine:
         # tampered section is detected here and never runs.
         attached = getattr(self.inst.lowered, "fused", None)
         if attached is not None:
-            self.aot_fused_verified = (
-                len(attached["hid"]) == len(hid)
-                and all(np.array_equal(attached[k], v) for k, v in
-                        (("hid", hid), ("a", a_p), ("b", b_p),
-                         ("c", c_p), ("ilo", ilo_p), ("ihi", ihi_p))))
+            self.aot_fused_verified = all(
+                getattr(attached[k], "dtype", None) == v.dtype
+                and np.array_equal(attached[k], v)
+                for k, v in (("hid", hid), ("a", a_p), ("b", b_p),
+                             ("c", c_p), ("ilo", ilo_p), ("ihi", ihi_p)))
             if self.aot_fused_verified:
                 hid, a_p, b_p, c_p, ilo_p, ihi_p = (
                     attached["hid"], attached["a"], attached["b"],

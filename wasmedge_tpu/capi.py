@@ -722,16 +722,10 @@ def we_LogSetDebugLevel() -> None:
 # FunctionType / TableType / MemoryType / GlobalType contexts
 # (reference: WasmEdge_FunctionTypeCreate ... GlobalTypeGetMutability)
 # ---------------------------------------------------------------------------
-_VALTYPE_NAMES = {"i32": 0x7F, "i64": 0x7E, "f32": 0x7D, "f64": 0x7C,
-                  "v128": 0x7B, "funcref": 0x70, "externref": 0x6F}
-
-
 def _to_valtype(name):
-    from wasmedge_tpu.common.types import ValType
+    from wasmedge_tpu.common.types import to_valtype
 
-    if isinstance(name, ValType):
-        return name
-    return ValType(_VALTYPE_NAMES[name])
+    return to_valtype(name)
 
 
 def we_FunctionTypeCreate(params: Sequence, results: Sequence):

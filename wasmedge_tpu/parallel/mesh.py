@@ -51,3 +51,77 @@ def shard_batch_state(state, mesh):
     import jax
 
     return jax.device_put(state, state_shardings(mesh, state))
+
+
+def run_pallas_sharded(inst, store, conf, func_name, args_lanes,
+                       devices=None, max_steps: int = 10_000_000,
+                       interpret=None):
+    """Run the Pallas warp-interpreter sharded across devices.
+
+    Wasm instances are share-nothing, so multi-chip Pallas execution is
+    block-level SPMD: the lane batch splits into one sub-batch per
+    device, each device gets its OWN warp-interpreter engine (tables and
+    state committed to that device) driven by its own block scheduler,
+    and the host round-robins launch/process so all devices' kernels
+    execute concurrently while divergence handling and outcall service
+    interleave on the host — the same latency-hiding drive the
+    multi-tenant engine uses across tenants, here across chips.
+    Returns one merged BatchResult in original lane order.
+    """
+    import jax
+    import numpy as np
+
+    from wasmedge_tpu.batch.engine import BatchResult
+    from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
+    from wasmedge_tpu.batch.scheduler import BlockScheduler
+
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    args = [np.asarray(a, np.int64) for a in args_lanes]
+    lanes = next((a.shape[0] for a in args if a.ndim), None)
+    if lanes is None:
+        raise ValueError("run_pallas_sharded needs at least one per-lane "
+                         "(non-scalar) argument array to size the batch")
+    # scalar args broadcast to every lane, as in the single-device path
+    args = [a if a.ndim else np.full(lanes, a, np.int64) for a in args]
+    if lanes % n:
+        raise ValueError(f"{lanes} lanes not divisible by {n} devices")
+    per = lanes // n
+
+    scheds = []
+    for di, dev in enumerate(devices):
+        with jax.default_device(dev):
+            eng = PallasUniformEngine(inst, store=store, conf=conf,
+                                      lanes=per, interpret=interpret)
+            if not eng.eligible:
+                raise RuntimeError(
+                    f"pallas ineligible: {eng.ineligible_reason}")
+            sl = slice(di * per, (di + 1) * per)
+            scheds.append((dev, BlockScheduler(
+                eng, func_name, [a[sl] for a in args], max_steps)))
+
+    active = list(scheds)
+    while active:
+        for dev, s in active:
+            with jax.default_device(dev):
+                s.launch()
+        done = []
+        for dev, s in active:
+            with jax.default_device(dev):
+                if not s.process():
+                    done.append((dev, s))
+        for d in done:
+            active.remove(d)
+    for dev, s in scheds:
+        with jax.default_device(dev):
+            s._run_simt_residue()
+
+    results = [s.result() for _, s in scheds]
+    nres = len(results[0].results)
+    merged = BatchResult(
+        results=[np.concatenate([r.results[k] for r in results])
+                 for k in range(nres)],
+        trap=np.concatenate([r.trap for r in results]),
+        retired=np.concatenate([r.retired for r in results]),
+        steps=max(r.steps for r in results))
+    return merged
