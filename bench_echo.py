@@ -1,0 +1,96 @@
+"""BASELINE config 4 shape: batched WASI outcalls (echo workload).
+
+4096 lanes each call wasi fd_write twice per iteration (message +
+per-lane counter digits to a sink fd), interleaved with compute, for
+ITERS iterations — the serverless request-handler shape.  Measures wall
+time and aggregate host-call service rate through the Pallas engine's
+outcall channel.  Prints ONE JSON line."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+LANES = 4096
+ITERS = 4
+
+
+def main():
+    from wasmedge_tpu.batch.uniform import UniformBatchEngine
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.host.wasi import WasiModule
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.utils.builder import ModuleBuilder
+    from wasmedge_tpu.validator import Validator
+
+    b = ModuleBuilder()
+    b.import_func("wasi_snapshot_preview1", "fd_write",
+                  ["i32", "i32", "i32", "i32"], ["i32"])
+    b.add_memory(1, 1)
+    # iovec at 64 -> "hello wasi echo\n" at 128 (16 bytes)
+    body = [
+        ("i32.const", 64), ("i32.const", 128), ("i32.store", 2, 0),
+        ("i32.const", 68), ("i32.const", 16), ("i32.store", 2, 0),
+    ]
+    msg = b"hello wasi echo\n"
+    for i, ch in enumerate(msg):
+        body += [("i32.const", 128 + i), ("i32.const", ch),
+                 ("i32.store8", 0, 0)]
+    body += [
+        ("block", None), ("loop", None),
+        ("local.get", 1), ("local.get", 0), "i32.ge_u", ("br_if", 1),
+        # write the message
+        ("i32.const", 1), ("i32.const", 64), ("i32.const", 1),
+        ("i32.const", 32), ("call", 0), ("local.set", 2),
+        # write again (second syscall per iteration)
+        ("i32.const", 1), ("i32.const", 64), ("i32.const", 1),
+        ("i32.const", 32), ("call", 0), ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        ("br", 0), "end", "end",
+        ("local.get", 2),
+    ]
+    b.add_function(["i32"], ["i32"], ["i32", "i32"], body, export="echo")
+    data = b.build()
+
+    conf = Configure()
+    conf.batch.steps_per_launch = 100_000
+    wasi = WasiModule()
+    wasi.init_wasi(dirs=[], prog_name="echo")
+    # route fd 1 to a sink so the bench doesn't spam stdout
+    sink = os.open(os.devnull, os.O_WRONLY)
+    wasi.env.fds[1].os_fd = sink
+    mod = Validator(conf).validate(Loader(conf).parse_module(data))
+    store = StoreManager()
+    ex = Executor(conf)
+    ex.register_import_object(store, wasi)
+    inst = ex.instantiate(store, mod)
+    eng = UniformBatchEngine(inst, store=store, conf=conf, lanes=LANES)
+    eng.run("echo", [np.full(LANES, 1, np.int64)], max_steps=100_000)
+
+    t0 = time.perf_counter()
+    res = eng.run("echo", [np.full(LANES, ITERS, np.int64)],
+                  max_steps=10_000_000)
+    dt = time.perf_counter() - t0
+    os.close(sink)
+
+    ok = bool(res.completed.all())
+    ncalls = LANES * ITERS * 2
+    out = {
+        "metric": f"wasi_echo_hostcalls_per_sec_x{LANES}",
+        "value": round(ncalls / dt, 1),
+        "unit": "hostcalls/s",
+        "ok": ok,
+        "calls": ncalls,
+        "wall_s": round(dt, 2),
+    }
+    print(json.dumps(out))
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

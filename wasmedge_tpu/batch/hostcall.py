@@ -141,3 +141,157 @@ def serve_batch_state(engine, state):
         kw["mem"] = jnp.asarray(mem_plane)
         kw["mem_pages"] = jnp.asarray(pages)
     return state._replace(**kw)
+
+
+# ---------------------------------------------------------------------------
+# round-cached serving: vectorized memory views over the device plane
+# ---------------------------------------------------------------------------
+class PlaneMemoryCache:
+    """Row-chunked host cache over a device [W, lanes] memory plane for
+    one serve round.
+
+    The host link (a tunneled TPU pays ~100ms per transfer) must never
+    carry per-lane traffic: chunks of guest memory are downloaded for
+    ALL lanes at once (one transfer per touched 4 KiB window, however
+    many lanes read it), per-lane views slice columns out of the cached
+    slabs, and dirty chunks are written back in one device update per
+    chunk at flush.  A serve round that only READS guest memory (the
+    common WASI shape: fd_write, path_open, clock, random) uploads
+    nothing at all."""
+
+    CHUNK_ROWS = 1024  # 4 KiB of guest memory per chunk
+
+    def __init__(self, mem_dev):
+        self.dev = mem_dev
+        self.W = int(mem_dev.shape[0])
+        self.L = int(mem_dev.shape[1])
+        self._chunks = {}
+        self._dirty = set()
+        self._writes = {}  # lane -> [(off, n)] for pad-lane replay
+
+    def _chunk(self, ci: int) -> np.ndarray:
+        c = self._chunks.get(ci)
+        if c is None:
+            lo = ci * self.CHUNK_ROWS
+            hi = min(lo + self.CHUNK_ROWS, self.W)
+            c = np.array(self.dev[lo:hi, :])  # one all-lane download
+            self._chunks[ci] = c
+        return c
+
+    def read_bytes(self, lane: int, off: int, n: int) -> bytes:
+        if n == 0:
+            return b""
+        w0 = off // 4
+        w1 = (off + n - 1) // 4
+        words = np.empty(w1 - w0 + 1, np.int32)
+        w = w0
+        while w <= w1:
+            ci = w // self.CHUNK_ROWS
+            base = ci * self.CHUNK_ROWS
+            chunk = self._chunk(ci)
+            upto = min(w1 + 1, base + chunk.shape[0])
+            words[w - w0:upto - w0] = chunk[w - base:upto - base, lane]
+            w = upto
+        raw = words.tobytes()
+        start = off - w0 * 4
+        return raw[start:start + n]
+
+    def writes_of(self, lane: int):
+        """(off, n) write extents recorded for a lane this round."""
+        return list(self._writes.get(lane, ()))
+
+    def write_bytes(self, lane: int, off: int, data: bytes):
+        n = len(data)
+        if n == 0:
+            return
+        self._writes.setdefault(lane, []).append((off, n))
+        w0 = off // 4
+        w1 = (off + n - 1) // 4
+        cur = bytearray(self.read_bytes(lane, w0 * 4,
+                                        (w1 - w0 + 1) * 4))
+        start = off - w0 * 4
+        cur[start:start + n] = data
+        words = np.frombuffer(bytes(cur), dtype=np.int32)
+        w = w0
+        while w <= w1:
+            ci = w // self.CHUNK_ROWS
+            base = ci * self.CHUNK_ROWS
+            chunk = self._chunk(ci)
+            upto = min(w1 + 1, base + chunk.shape[0])
+            chunk[w - base:upto - base, lane] = words[w - w0:upto - w0]
+            self._dirty.add(ci)
+            w = upto
+
+    def flush(self):
+        """Apply dirty chunks device-side; returns the updated array."""
+        dev = self.dev
+        for ci in sorted(self._dirty):
+            lo = ci * self.CHUNK_ROWS
+            chunk = self._chunks[ci]
+            dev = dev.at[lo:lo + chunk.shape[0], :].set(chunk)
+        self._dirty.clear()
+        return dev
+
+
+class _CachedLaneMemory(MemoryInstance):
+    """MemoryInstance view over one lane's column of a PlaneMemoryCache.
+
+    Byte accesses hit the cache's all-lane slabs; `page_limit` is the
+    plane's row capacity, so in-place growth stays inside the
+    allocation (rows beyond the current page count are zero)."""
+
+    def __init__(self, cache: PlaneMemoryCache, lane: int, pages: int,
+                 max_pages: Optional[int], page_limit: int):
+        self._cache = cache
+        self._lane = lane
+        self._pages = pages
+        self.min = pages
+        self.max = max_pages
+        self.page_limit = page_limit
+
+    @property
+    def pages(self) -> int:
+        return self._pages
+
+    def _nbytes(self) -> int:
+        return self._pages * 65536
+
+    def check_bounds(self, off: int, length: int):
+        if off < 0 or off + length > self._nbytes():
+            raise TrapError(ErrCode.MemoryOutOfBounds)
+
+    def grow(self, delta: int) -> int:
+        old = self._pages
+        new = old + delta
+        limit = self.page_limit
+        if self.max is not None:
+            limit = min(limit, self.max)
+        if delta < 0 or new > limit or new > 65536:
+            return -1
+        self._pages = new
+        return old
+
+    def load(self, off: int, nbytes: int, signed: bool) -> int:
+        self.check_bounds(off, nbytes)
+        return int.from_bytes(
+            self._cache.read_bytes(self._lane, off, nbytes), "little",
+            signed=signed)
+
+    def store(self, off: int, nbytes: int, value: int):
+        self.check_bounds(off, nbytes)
+        self._cache.write_bytes(
+            self._lane, off,
+            (value & ((1 << (8 * nbytes)) - 1)).to_bytes(nbytes, "little"))
+
+    def load_bytes(self, off: int, n: int) -> bytes:
+        self.check_bounds(off, n)
+        return self._cache.read_bytes(self._lane, off, n)
+
+    def store_bytes(self, off: int, data: bytes):
+        self.check_bounds(off, len(data))
+        self._cache.write_bytes(self._lane, off, bytes(data))
+
+    def as_numpy(self) -> np.ndarray:
+        return np.frombuffer(
+            self._cache.read_bytes(self._lane, 0, self._nbytes()),
+            dtype=np.uint8)

@@ -1796,21 +1796,29 @@ class PallasUniformEngine:
         return sched.result()
 
     def _serve_hostcalls(self, state, ctrl_np, valid_blocks=None):
-        """Drain parked blocks through the host outcall channel
-        (batch/hostcall.py) and re-arm them.
+        """Drain parked blocks through the host outcall channel and
+        re-arm them.
+
+        Transfer discipline (the host link costs ~100ms per transfer on
+        a tunneled TPU): ONE stack-slab download covers every parked
+        block's argument rows, guest memory goes through a
+        PlaneMemoryCache whose 4 KiB row chunks are fetched for ALL
+        lanes at once and written back dirty-chunks-only, and result
+        rows go up as per-block device updates — per-lane data never
+        rides the link alone (the "vectorized memory views" serve,
+        SURVEY §5.8/§7(d)).
 
         valid_blocks: optional {block: bool[Lblk]} from the scheduler —
         pad (clone) lanes are NOT served (a host function's side effects
         must fire once per real instance, never for padding); their
-        result/memory/trap columns are copied from the block's first
-        valid lane (their clone source), which keeps them converged."""
+        result columns and memory writes are replayed from the block's
+        first valid lane (their clone source), keeping them converged."""
         import jax.numpy as jnp
 
         from wasmedge_tpu.batch.hostcall import (
-            _LaneMemory,
-            lane_memory_bytes,
+            PlaneMemoryCache,
+            _CachedLaneMemory,
             serve_one,
-            store_lane_memory,
         )
 
         img = self.img
@@ -1818,46 +1826,51 @@ class PallasUniformEngine:
         ctrl = ctrl_np.copy()
         blocks = np.nonzero(ctrl[:, _C_STATUS] == ST_HOSTCALL)[0]
         has_mem = img.has_memory
-        mem_np = np.asarray(state[6]).copy() if has_mem else None
-        # Cap host-side growth at the watermark plane's capacity: beyond
-        # it, store_lane_memory would silently truncate the host
-        # function's writes into the grown pages.  A host grow that needs
-        # more than the plane holds fails cleanly (-1) instead —
-        # spec-legal (memory.grow may fail nondeterministically).
-        plane_cap = (mem_np.shape[0] // _PAGE_WORDS) if has_mem else 0
-        max_pages = min(img.mem_pages_max, plane_cap) \
-            if img.mem_pages_max > 0 else (plane_cap or None)
-        slo, shi = state[2], state[3]
+        cache = PlaneMemoryCache(state[6]) if has_mem else None
+        plane_cap = (W // _PAGE_WORDS) if has_mem else 0
+        if img.mem_pages_max > 0:
+            max_pages = min(img.mem_pages_max, plane_cap)
+        else:
+            max_pages = plane_cap or None
+
+        metas = []
+        max_row = 0
         for b in blocks:
             pc = int(ctrl[b, _C_PC])
             k = int(img.a[pc])
             fi = self.simt.resolve_func(k)
             nargs = len(fi.functype.params)
-            fp = int(ctrl[b, _C_FP])
-            ob = int(ctrl[b, _C_OB])
-            lanes = range(b * Lblk, (b + 1) * Lblk)
-            args_lo = np.asarray(slo[fp:fp + nargs, b * Lblk:(b + 1) * Lblk])
-            args_hi = np.asarray(shi[fp:fp + nargs, b * Lblk:(b + 1) * Lblk])
+            metas.append((int(b), pc, k, fi, nargs,
+                          int(ctrl[b, _C_FP]), int(ctrl[b, _C_OB])))
+            max_row = max(max_row, int(ctrl[b, _C_FP]) + nargs)
+        # one slab download for every block's argument rows
+        slab_lo = np.asarray(state[2][:max_row]) if max_row else None
+        slab_hi = np.asarray(state[3][:max_row]) if max_row else None
+
+        for (b, pc, k, fi, nargs, fp, ob) in metas:
+            lo_col = b * Lblk
+            vmask = valid_blocks.get(b) if valid_blocks else None
             nres = int(img.f_nresults[k])
             res_lo = np.zeros((max(nres, 1), Lblk), np.int32)
             res_hi = np.zeros((max(nres, 1), Lblk), np.int32)
             trap_codes = np.zeros(Lblk, np.int32)
             pages = int(ctrl[b, _C_PAGES])
             new_pages = np.full(Lblk, pages, np.int32)
-            vmask = valid_blocks.get(int(b)) if valid_blocks else None
-            for li, lane in enumerate(lanes):
+            lane_mems = {}
+            for li in range(Lblk):
                 if vmask is not None and not vmask[li]:
-                    continue  # pad lane: cloned from a real lane below
+                    continue  # pad lane: replayed from its clone below
+                lane = lo_col + li
                 args = []
                 for i in range(nargs):
-                    lo = int(np.uint32(args_lo[i, li]))
-                    hi = int(np.uint32(args_hi[i, li]))
-                    args.append(lo | (hi << 32))
+                    a_lo = int(np.uint32(slab_lo[fp + i, lane]))
+                    a_hi = int(np.uint32(slab_hi[fp + i, lane]))
+                    args.append(a_lo | (a_hi << 32))
                 lane_mem = None
                 if has_mem:
-                    lane_mem = _LaneMemory(
-                        lane_memory_bytes(mem_np, lane, pages),
-                        max_pages, plane_cap)
+                    lane_mem = _CachedLaneMemory(cache, lane, pages,
+                                                 max_pages, plane_cap)
+                    lane_mems[li] = lane_mem
                 out, code = serve_one(fi, args, lane_mem)
                 if code:
                     trap_codes[li] = code
@@ -1867,58 +1880,61 @@ class PallasUniformEngine:
                     res_hi[i, li] = np.int32(
                         np.uint32((cell >> 32) & 0xFFFFFFFF))
                 if has_mem:
-                    store_lane_memory(mem_np, lane, lane_mem.data)
                     new_pages[li] = lane_mem.pages
             if vmask is not None and not vmask.all():
                 src = int(np.argmax(vmask))  # first valid = clone source
-                src_lane = b * Lblk + src
-                for li in np.nonzero(~vmask)[0]:
+                pads = np.nonzero(~vmask)[0]
+                for li in pads:
                     res_lo[:, li] = res_lo[:, src]
                     res_hi[:, li] = res_hi[:, src]
                     trap_codes[li] = trap_codes[src]
                     new_pages[li] = new_pages[src]
-                    if has_mem:
-                        mem_np[:, b * Lblk + li] = mem_np[:, src_lane]
+                if has_mem:
+                    # replay the clone source's memory writes onto pads
+                    for (off, n) in cache.writes_of(lo_col + src):
+                        data = cache.read_bytes(lo_col + src, off, n)
+                        for li in pads:
+                            cache.write_bytes(lo_col + int(li), off, data)
             grew = (new_pages != pages) & (trap_codes == 0)
             if trap_codes.any() or grew.any():
-                # Per-lane outcomes (trap codes, or memory growth that
-                # diverges the block's single page count): record them,
-                # re-arm the block at pc+1 with the served lanes' results
-                # applied (their host calls MUST NOT re-run), then hand
-                # off to the SIMT engine, which is per-lane throughout.
+                # Per-lane outcomes: record them, re-arm at pc+1 with the
+                # served lanes' results applied (their host calls MUST
+                # NOT re-run), then leave the block DIVERGED for the
+                # scheduler to partition per lane.
                 trap_plane = np.asarray(state[7]).copy()
-                seg = trap_plane[0, b * Lblk:(b + 1) * Lblk]
+                seg = trap_plane[0, lo_col:lo_col + Lblk]
                 seg[:] = np.where(trap_codes != 0, trap_codes, seg)
-                trap_plane[0, b * Lblk:(b + 1) * Lblk] = seg
+                trap_plane[0, lo_col:lo_col + Lblk] = seg
                 state[7] = jnp.asarray(trap_plane)
                 if grew.any():
-                    self._pages_override[int(b)] = new_pages.copy()
+                    self._pages_override[b] = new_pages.copy()
                 if (trap_codes != 0).all() and \
                         len(set(trap_codes.tolist())) == 1:
                     ctrl[b, _C_STATUS] = ST_TRAPPED_BASE + int(trap_codes[0])
                     continue
                 if nres:
                     state[2] = state[2].at[ob:ob + nres,
-                                           b * Lblk:(b + 1) * Lblk].set(
+                                           lo_col:lo_col + Lblk].set(
                         jnp.asarray(res_lo[:nres]))
                     state[3] = state[3].at[ob:ob + nres,
-                                           b * Lblk:(b + 1) * Lblk].set(
+                                           lo_col:lo_col + Lblk].set(
                         jnp.asarray(res_hi[:nres]))
                 ctrl[b, _C_PC] = pc + 1
                 ctrl[b, _C_SP] = ob + nres
                 ctrl[b, _C_STATUS] = ST_DIVERGED
                 continue
             if nres:
-                sl = jnp.asarray(res_lo[:nres])
-                sh = jnp.asarray(res_hi[:nres])
                 state[2] = state[2].at[ob:ob + nres,
-                                       b * Lblk:(b + 1) * Lblk].set(sl)
+                                       lo_col:lo_col + Lblk].set(
+                    jnp.asarray(res_lo[:nres]))
                 state[3] = state[3].at[ob:ob + nres,
-                                       b * Lblk:(b + 1) * Lblk].set(sh)
+                                       lo_col:lo_col + Lblk].set(
+                    jnp.asarray(res_hi[:nres]))
             ctrl[b, _C_PC] = pc + 1
             ctrl[b, _C_SP] = ob + nres
             ctrl[b, _C_STATUS] = ST_RUNNING
         if has_mem:
-            state[6] = jnp.asarray(mem_np)
+            state[6] = cache.flush()
         state[0] = jnp.asarray(ctrl)
         return state
+
