@@ -105,6 +105,16 @@ def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:
             key = f"state_{name}"
             # optional planes (v128 extension) absent for non-SIMD images
             fields[name] = jnp.asarray(z[key]) if key in z.files else None
+        if getattr(engine.img, "has_simd", False):
+            # no membership guard: if these planes are ever renamed this
+            # must fail loudly here, not silently skip the check
+            missing = [n for n in ("stack_e2", "stack_e3")
+                       if fields.get(n) is None]
+            if missing:
+                raise ValueError(
+                    "checkpoint refused: geometry mismatch — engine image "
+                    f"has v128 but checkpoint lacks planes {missing} "
+                    "(pre-SIMD checkpoint resumed against a SIMD image?)")
         _validate_planes(fields, engine)
     return BatchState(**fields), meta["total_steps"]
 

@@ -263,6 +263,15 @@ class _CachedLaneMemory(MemoryInstance):
     def grow(self, delta: int) -> int:
         old = self._pages
         new = old + delta
+        # KNOWN ENGINE DIVERGENCE: growth past the plane's row capacity
+        # (page_limit, watermark-sized = mem_pages_init) fails with -1
+        # here, while the same grow issued from *guest* code gets
+        # ST_REGROW and re-executes on a bigger-plane engine, and the
+        # SIMT/scalar engines succeed up to the declared max.  Spec-legal
+        # (memory.grow may fail at any size) and covered by
+        # tests/test_hostcall.py; routing host-driven growth through the
+        # ST_REGROW handoff would require parking the whole block
+        # mid-serve.  Revisit if a real WASI workload hits it.
         limit = self.page_limit
         if self.max is not None:
             limit = min(limit, self.max)

@@ -324,6 +324,31 @@ def fuse_image(hid, a, b, c, ilo, ihi, img):
     return hid, a, b, c, ilo, ihi
 
 
+# SMEM budget for the 7 code planes — the ONE code-size limit shared by
+# the engine (PallasUniformEngine.MAX_CODE_LEN) and the tpu.aot
+# serializer via pallas_image_eligibility's default.
+MAX_CODE_LEN = 16384
+
+
+def pallas_image_eligibility(img: DeviceImage,
+                             max_code_len: int = MAX_CODE_LEN
+                             ) -> Optional[str]:
+    """Static (lane-count-independent) Pallas eligibility of a device
+    image — the ONE source of truth shared by the engine, the scheduler
+    and the tpu.aot serializer, so those layers can never disagree about
+    what the kernel can execute.  Returns a reason string when the image
+    must stay on the SIMT engine, None when the Pallas kernel can run it.
+    Mirrors the reference's never-crash AOT fallback seam
+    (/root/reference/lib/loader/ast/module.cpp:279-326)."""
+    if img.code_len > max_code_len:
+        return f"code too large for SMEM ({img.code_len} instrs)"
+    unhandled = (set(np.unique(img.cls).tolist())
+                 - set(_CLS_TO_HID) - {CLS_ALU2, CLS_ALU1})
+    if unhandled:
+        return f"classes without Pallas handlers: {sorted(unhandled)}"
+    return None
+
+
 def hid_plane(img: DeviceImage) -> np.ndarray:
     """Per-pc flat handler id from the (class, sub) encoding."""
     hid = np.zeros(img.code_len, np.int32)
@@ -1445,7 +1470,7 @@ class PallasUniformEngine:
     of per-step XLA, and convergence is only required within a lane block."""
 
     # geometry knobs (state must fit VMEM; ~16 MiB/core on v5e)
-    MAX_CODE_LEN = 16384       # SMEM budget for the 7 code planes
+    MAX_CODE_LEN = MAX_CODE_LEN  # module-level constant, shared with aot
     # Per-block VMEM scratch budget (1x state size: state planes stay in
     # HBM and are DMA'd into scratch per lane block; ~2 MiB headroom is
     # left for gather-chunk temporaries and compiler spill).
@@ -1534,12 +1559,11 @@ class PallasUniformEngine:
 
     def _eligibility(self) -> Optional[str]:
         img = self.img
-        if img.code_len > self.MAX_CODE_LEN:
-            return f"code too large for SMEM ({img.code_len} instrs)"
+        reason = pallas_image_eligibility(img, self.MAX_CODE_LEN)
+        if reason is not None:
+            return reason
         if self.simt.mesh is not None:
             return "mesh sharding handled by SIMT engine"
-        if getattr(img, "has_simd", False):
-            return "v128 handled by SIMT engine"
         if self._lane_block() is None:
             return (f"state too large for VMEM "
                     f"({self._mem_words()} mem words/lane)")

@@ -241,11 +241,11 @@ class BlockScheduler:
         if self.args:
             order = np.lexsort(tuple(self.args))
             keys = np.stack(self.args, axis=0)[:, order]
-            starts = [0]
-            for i in range(1, self.lanes):
-                if not (keys[:, i] == keys[:, i - 1]).all():
-                    starts.append(i)
-            sizes = np.diff(starts + [self.lanes])
+            starts = np.concatenate((
+                [0],
+                np.flatnonzero(np.any(keys[:, 1:] != keys[:, :-1],
+                                      axis=0)) + 1))
+            sizes = np.diff(np.concatenate((starts, [self.lanes])))
         else:
             order = np.arange(self.lanes)
             sizes = np.array([self.lanes])

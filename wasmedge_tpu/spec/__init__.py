@@ -308,6 +308,7 @@ def run_corpus_batched(paths, conf: Optional[Configure] = None
     """
     import numpy as np
 
+    from wasmedge_tpu.batch import BatchEngine
     from wasmedge_tpu.executor import Executor
     from wasmedge_tpu.loader import Loader
     from wasmedge_tpu.runtime.store import StoreManager
@@ -353,8 +354,6 @@ def run_corpus_batched(paths, conf: Optional[Configure] = None
                 if inst.memories or inst.globals:
                     rep.skipped += len(asserts)
                     continue
-                from wasmedge_tpu.batch import BatchEngine
-
                 by_field: Dict[str, list] = {}
                 for idx, cmd in asserts:
                     by_field.setdefault(cmd.action[2], []).append(
@@ -362,8 +361,17 @@ def run_corpus_batched(paths, conf: Optional[Configure] = None
                 lanes = max(len(v) for v in by_field.values())
                 eng = BatchEngine(inst, store=store, conf=conf,
                                   lanes=lanes)
-            except (ValueError, LoadError, ValidationError) as e:
+            except (ValueError, LoadError, ValidationError):
                 rep.skipped += len(asserts)
+                continue
+            except Exception as e:  # noqa: BLE001
+                # a malformed corpus module must not sink the whole
+                # batched run: record it as a failure for its assertions
+                # (matching the broad except around eng.run)
+                rep.failed += len(asserts)
+                rep.failures.append(SpecFailure(
+                    str(path), asserts[0][0], "setup",
+                    f"module setup raised {type(e).__name__}: {e}"))
                 continue
             for field, items in by_field.items():
                 fi = inst.find_func(field)
