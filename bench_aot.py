@@ -1,0 +1,112 @@
+"""AOT warm-start measurement: process start -> first retired instruction.
+
+The reference loads AOT artifacts with dlopen
+(/root/reference/lib/loader/shared_library.cpp:52) — milliseconds.  Our
+tpu.aot artifact carries the lowered image + fused Pallas encoding;
+the XLA executable itself is content-addressed in the persistent
+compilation cache.  This script measures a FRESH PROCESS running
+fib(20)x4096 from a prebuilt artifact, with per-phase attribution
+(interpreter+jax import, backend init, engine build incl. kernel
+trace, compile/load, first launch), cold (empty cache) vs warm.
+
+Prints ONE JSON line (AOT_r04.json shape).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+CHILD = r"""
+import json, os, sys, time
+t0 = time.perf_counter()
+sys.path.insert(0, os.getcwd())
+import numpy as np
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.loader import Loader
+from wasmedge_tpu.validator import Validator
+from wasmedge_tpu.executor import Executor
+from wasmedge_tpu.runtime.store import StoreManager
+t_imp = time.perf_counter()
+from wasmedge_tpu.batch import ensure_jax_backend
+ensure_jax_backend()
+import jax
+jax.devices()
+t_dev = time.perf_counter()
+conf = Configure()
+conf.batch.steps_per_launch = 2_000_000
+conf.batch.value_stack_depth = 128
+conf.batch.call_stack_depth = 64
+with open(sys.argv[1], "rb") as f:
+    tw = f.read()
+mod = Validator(conf).validate(Loader(conf).parse_module(tw))
+st = StoreManager()
+inst = Executor(conf).instantiate(st, mod)
+t_load = time.perf_counter()
+from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
+eng = PallasUniformEngine(inst, store=st, conf=conf, lanes=4096)
+eng._build()
+t_build = time.perf_counter()
+res = eng.run("fib", [np.full(4096, 20, np.int64)], max_steps=50_000_000)
+t_run = time.perf_counter()
+ok = bool((np.asarray(res.results[0]) == 6765).all())
+print(json.dumps({
+    "ok": ok,
+    "import_s": round(t_imp - t0, 3),
+    "backend_init_s": round(t_dev - t_imp, 3),
+    "artifact_load_s": round(t_load - t_dev, 3),
+    "engine_build_s": round(t_build - t_load, 3),
+    "first_run_s": round(t_run - t_build, 3),
+    "total_s": round(t_run - t0, 3),
+}))
+"""
+
+
+def run_child(twasm_path):
+    t0 = time.perf_counter()
+    r = subprocess.run([sys.executable, "-c", CHILD, twasm_path],
+                       capture_output=True, text=True, timeout=900,
+                       cwd=os.path.dirname(os.path.abspath(__file__)))
+    wall = time.perf_counter() - t0
+    line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    if not line:
+        raise RuntimeError(f"child failed: {r.stderr[-2000:]}")
+    out = json.loads(line[-1])
+    out["process_wall_s"] = round(wall, 3)
+    return out
+
+
+def main():
+    import shutil
+
+    from wasmedge_tpu import aot
+    from wasmedge_tpu.models import build_fib
+
+    tw = aot.compile_module(build_fib())
+    path = "/tmp/fib.twasm"
+    with open(path, "wb") as f:
+        f.write(tw)
+    cache = os.path.expanduser("~/.cache/wasmedge_tpu_xla")
+    from wasmedge_tpu.batch import ensure_jax_backend  # cache dir source
+
+    shutil.rmtree(cache, ignore_errors=True)
+    cold = run_child(path)
+    warm = run_child(path)
+    out = {
+        "metric": "pallas_cold_start_seconds",
+        "cold": cold["process_wall_s"],
+        "warm_fresh_process": warm["process_wall_s"],
+        "unit": "s",
+        "cold_phases": cold,
+        "warm_phases": warm,
+        "note": "fib(20) x4096 from a tpu.aot artifact in a fresh "
+                "process; phases attribute the remaining warm time",
+    }
+    print(json.dumps(out))
+    with open("AOT_r04.json", "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""HBM-resident memory mode parity (interpret mode on CPU).
+
+Re-runs every memory-touching test from tests/test_pallas_engine.py with
+`cfg.batch.mem_hbm = True`, forcing the Pallas kernel's window-cache
+memory path (HBM-resident plane + 2-way VMEM window LRU) even at the
+tiny geometries pytest uses, where the auto rule would pick the
+VMEM-resident slab.  The kernel program is identical to the TPU one
+(minus Mosaic lowering), so window fills, write-backs, the
+single-resident-copy eviction rule, in-window divergent gathers and the
+beyond-window SIMT handoff are all exercised lane-exactly against the
+scalar oracle.
+"""
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.common.configure import Configure
+
+import tests.test_pallas_engine as tpe
+
+# every test in the base suite that drives linear memory (plus coremark,
+# whose single store exercises the store path after a long ALU run)
+_MEM_TESTS = sorted(
+    name for name in dir(tpe)
+    if name.startswith("test_") and any(
+        k in name for k in ("memory", "memcopy", "bulk", "coremark",
+                            "unaligned", "divergent_addresses",
+                            "memgrow", "fill"))
+)
+
+
+class _HbmConfigure(Configure):
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.batch.mem_hbm = True
+
+
+@pytest.fixture(autouse=True)
+def _force_hbm(monkeypatch):
+    monkeypatch.setattr(tpe, "Configure", _HbmConfigure)
+
+
+def test_collected_the_suite():
+    # if the base suite is refactored this file must not silently shrink
+    assert len(_MEM_TESTS) >= 10, _MEM_TESTS
+
+
+@pytest.mark.parametrize("name", _MEM_TESTS)
+def test_hbm_mode(name):
+    getattr(tpe, name)()
+
+
+def test_hbm_mode_engaged():
+    """The forced conf actually selects the window-cache kernel."""
+    from wasmedge_tpu.models import build_memory_workload
+
+    conf = _HbmConfigure()
+    conf.batch.steps_per_launch = 50_000
+    ex, store, inst, eng = tpe.make_engine(build_memory_workload(),
+                                           conf=conf)
+    assert eng._mem_mode() is True
+    res = eng.run("mem_checksum", [np.full(tpe.LANES, 200, np.int64)],
+                  max_steps=2_000_000)
+    assert bool(res.completed.all()) and not eng.fell_back_to_simt
+
+
+def test_hbm_window_boundary_stores():
+    """Stores that straddle the CW-row window boundary (i64 at the edge
+    of a 128-row window) are the alignment-slack case the fits check
+    guards; run a stride walk that crosses several window boundaries."""
+    b = tpe.ModuleBuilder()
+    b.add_memory(1, 2)
+    # sum = xor of i64 loads at addr = i*520 for i in 0..n  (crosses the
+    # 512-byte window every iteration, alternating ways)
+    b.add_function(["i32"], ["i64"], ["i32", "i64"], [
+        ("block", None),
+        ("loop", None),
+        ("local.get", 1), ("local.get", 0), "i32.ge_u", ("br_if", 1),
+        # store i64 pattern at i*520 + 6 (unaligned, spans 3 words)
+        ("local.get", 1), ("i32.const", 520), "i32.mul",
+        ("local.get", 1), ("i64.extend_i32_u",),
+        ("i64.const", 0x0123456789ABCDEF), "i64.xor",
+        ("i64.store", 3, 6),
+        # load it back and fold
+        ("local.get", 2),
+        ("local.get", 1), ("i32.const", 520), "i32.mul",
+        ("i64.load", 3, 6),
+        "i64.xor", ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        ("br", 0),
+        "end",
+        "end",
+        ("local.get", 2),
+    ], export="edgewalk")
+    conf = _HbmConfigure()
+    tpe.check_parity(b.build(), "edgewalk",
+                     [np.full(tpe.LANES, 60, np.int64)], conf=conf)

@@ -103,10 +103,80 @@ def main():
                                  [np.arange(L, dtype=np.int64)],
                                  imports=[imp])
 
+    # -- round-4 surfaces -------------------------------------------------
+    # HBM window-cache memory mode: stride walk crossing window
+    # boundaries with unaligned i64 stores (auto-selected at 256 lanes)
+    edge_wat = """(module (memory 1 2)
+      (func (export "f") (param i32) (result i64)
+        (local $i i32) (local $acc i64)
+        (block (loop
+          (br_if 1 (i32.ge_u (local.get $i) (local.get 0)))
+          (i64.store offset=6 (i32.mul (local.get $i) (i32.const 520))
+            (i64.xor (i64.extend_i32_u (local.get $i))
+                     (i64.const 81985529216486895)))
+          (local.set $acc (i64.xor (local.get $acc)
+            (i64.load offset=6 (i32.mul (local.get $i)
+                                        (i32.const 520)))))
+          (local.set $i (i32.add (local.get $i) (i32.const 1)))
+          (br 0)))
+        (local.get $acc)))"""
+    checks["hbm_window_walk"] = compare(parse_wat(edge_wat), "f",
+                                        [np.full(L, 100, np.int64)])
+    # optimistic rollback on partially-OOB loads (canary -> careful)
+    oob_wat = """(module (memory 1 1)
+      (func (export "f") (param i32) (result i32)
+        (i32.load (local.get 0))))"""
+    addrs = np.where(np.arange(L) % 7 == 3, 70000,
+                     (np.arange(L) * 8) % 60000).astype(np.int64)
+    checks["optimistic_partial_oob"] = compare(parse_wat(oob_wat), "f",
+                                               [addrs])
+    # SIMD on the batch path (integer + float families, SIMT fallback)
+    simd_wat = """(module
+      (func (export "f") (param i64 i64) (result i64) (local v128)
+        (local.set 2
+          (i32x4.add
+            (i16x8.mul (i64x2.splat (local.get 0))
+                       (i64x2.splat (local.get 1)))
+            (i8x16.sub (i64x2.splat (local.get 1))
+                       (i64x2.splat (local.get 0)))))
+        (i64.xor (i64x2.extract_lane 0 (local.get 2))
+                 (i64x2.extract_lane 1 (local.get 2)))))"""
+    xs = rng.integers(-2**62, 2**62, L).astype(np.int64)
+    ys = rng.integers(-2**62, 2**62, L).astype(np.int64)
+    checks["simd_int"] = compare(parse_wat(simd_wat), "f", [xs, ys],
+                                 max_steps=1_000_000)
+    simd_f_wat = """(module
+      (func (export "f") (param i64 i64) (result i64) (local v128)
+        (local.set 2
+          (f64x2.mul (f64x2.add (i64x2.splat (local.get 0))
+                                (i64x2.splat (local.get 1)))
+                     (v128.const f64x2 1.5 1.5)))
+        (i64x2.extract_lane 0 (local.get 2))))"""
+    fb = np.array([typed_to_bits(ValType.F64, float(x))
+                   for x in rng.uniform(-100, 100, L)],
+                  np.uint64).view(np.int64)
+    fb2 = np.array([typed_to_bits(ValType.F64, float(x))
+                    for x in rng.uniform(0.5, 8, L)],
+                   np.uint64).view(np.int64)
+    checks["simd_f64"] = compare(parse_wat(simd_f_wat), "f", [fb, fb2],
+                                 max_steps=1_000_000)
+    # bulk memory inside the kernel (fill + copy + checksum)
+    bulk_wat = """(module (memory 1 1)
+      (func (export "f") (param i32) (result i32)
+        (memory.fill (i32.const 256) (local.get 0) (i32.const 512))
+        (memory.copy (i32.const 1024) (i32.const 256) (i32.const 512))
+        (i32.add (i32.load (i32.const 1500))
+                 (i32.load (i32.const 300)))))"""
+    checks["bulk_fill_copy"] = compare(
+        parse_wat(bulk_wat), "f",
+        [(np.arange(L) % 251).astype(np.int64)])
+
     total_bad = sum(checks.values())
     out = {"platform": platform, "lanes_per_check": L,
            "mismatched_lanes": checks, "ok": total_bad == 0}
     print(json.dumps(out))
+    with open("TPU_PARITY_r04.json", "w") as f:
+        json.dump(out, f)
     sys.exit(0 if total_bad == 0 else 1)
 
 

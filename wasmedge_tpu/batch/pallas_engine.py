@@ -136,7 +136,14 @@ H_FUSE_GGS_BASE = H_FUSE_GGA_BASE + NUM_ALU2
 H_FUSE_GGBZ_BASE = H_FUSE_GGS_BASE + NUM_ALU2
 H_FUSE_GGBNZ_BASE = H_FUSE_GGBZ_BASE + NUM_ALU2
 H_FUSE_GBR = H_FUSE_GGBNZ_BASE + NUM_ALU2
-NUM_HANDLERS = H_FUSE_GBR + 1
+# width-specialized memory ops (appended so earlier ids stay stable):
+# plain 32/64-bit loads/stores skip the sub-word sign/width machinery —
+# the hot shapes in compiled code
+H_LOAD_W = H_FUSE_GBR + 1    # i32.load  (nbytes=4, no extension)
+H_LOAD_D = H_FUSE_GBR + 2    # i64.load  (nbytes=8)
+H_STORE_W = H_FUSE_GBR + 3   # i32.store / f32.store
+H_STORE_D = H_FUSE_GBR + 4   # i64.store / f64.store
+NUM_HANDLERS = H_STORE_D + 1
 
 _CLS_TO_HID = {
     CLS_NOP: H_NOP, CLS_CONST: H_CONST, CLS_LOCAL_GET: H_LOCAL_GET,
@@ -163,6 +170,9 @@ ST_HOSTCALL = 3  # block parked at a host outcall stub
 # plane covers *current* pages, not the declared max, so a module that
 # declares max=16 pages but touches one page keeps a VMEM-sized state.
 ST_REGROW = 4
+# optimistic-convergence rollback: the block was rewound to its last
+# validated snapshot; the driver re-runs it on the careful kernel
+ST_RECHECK = 5
 ST_TRAPPED_BASE = 16
 
 _PAGE_WORDS = 65536 // 4
@@ -358,6 +368,16 @@ def hid_plane(img: DeviceImage) -> np.ndarray:
             hid[pc] = H_ALU2_BASE + int(img.sub[pc])
         elif c == CLS_ALU1:
             hid[pc] = H_ALU1_BASE + int(img.sub[pc])
+        elif c == CLS_LOAD and int(img.b[pc]) == 4 \
+                and int(img.c[pc]) in (0, 2):
+            # i32.load / f32.load / i64.load32_u: lo = raw word, hi = 0
+            hid[pc] = H_LOAD_W
+        elif c == CLS_LOAD and int(img.b[pc]) == 8:
+            hid[pc] = H_LOAD_D
+        elif c == CLS_STORE and int(img.b[pc]) == 4:
+            hid[pc] = H_STORE_W
+        elif c == CLS_STORE and int(img.b[pc]) == 8:
+            hid[pc] = H_STORE_D
         else:
             hid[pc] = _CLS_TO_HID[c]
     return hid
@@ -379,14 +399,55 @@ _DIVS_SUBS = {ALU2_I32_BASE + _I32_BIN.index("div_s"),
 def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                   Lblk: int, NG: int, code_len: int, nf: int, tsize: int,
                   max_local_zeros: int, mem_pages_cap: int,
-                  mem_pages_hard: int, gatherable: bool, interpret: bool):
+                  mem_pages_hard: int, gatherable: bool, interpret: bool,
+                  mem_hbm: bool = False, CW: int = 0,
+                  optimistic: bool = False, snap_steps: int = 8192,
+                  shadow_full: bool = None):
     """Compile the chunk-runner for one kernel geometry.
 
     Returns a jitted callable over
       (hid, a, b, c, ilo, ihi, fent, fnpar, fnloc, ftop, ftyp, brt, tbl,
        ctrl, frames, stack_lo, stack_hi, glob_lo, glob_hi, mem, trap)
     yielding (ctrl, frames, stack_lo, stack_hi, glob_lo, glob_hi, mem,
-    trap); the VMEM planes are aliased in-place."""
+    trap); the VMEM planes are aliased in-place.
+
+    mem_hbm=True is the large-block memory mode: the [W, L] linear-memory
+    plane stays HBM-resident instead of being DMA'd wholesale into VMEM
+    scratch, and loads/stores go through a 2-way LRU *window cache* of CW
+    rows per way in VMEM.  Uniform-address accesses that hit a resident
+    window are direct row ops (the common case — converged code computes
+    identical addresses); misses write back the dirty victim way and DMA
+    a fresh CW-row window; per-lane address divergence that still fits
+    one window is served by compare-reduce inside the window.  This
+    removes the W-words-per-lane term from the VMEM budget, so a 1-page
+    module runs thousands of lanes per block instead of 128 — the
+    reference's guard-page slab redesign
+    (/root/reference/include/runtime/instance/memory.h:34-332) rebuilt a
+    second time around the HBM/VMEM split instead of virtual-memory
+    protection.  memory.fill streams aligned GR-row chunks through
+    scratch; memory.copy runs through the windows (single-window when
+    the whole src+dst span fits, way-per-region when src and dst are
+    ≥CW+8 rows apart, SIMT handoff for large overlapping moves).
+
+    optimistic=True is the *optimistic-convergence* mode, the engine's
+    core TPU perf move: every cross-lane agreement reduction (branch
+    conds, load/store address uniformity, trap uniformity — each a
+    vector→scalar sync costing ~Lblk-linear time in Mosaic, measured
+    ~1.7µs at Lblk=4096) is replaced by a lane-0 decision plus a pure
+    vector *canary* accumulation (canary |= lane ^ lane0).  The canary
+    is validated by ONE reduction per commit point: every `snap_steps`
+    dispatches, before any dirty-window writeback, and at kernel exit.
+    A clean validation writes a snapshot (stacks/globals/trap → shadow
+    HBM planes, frames/carry → SMEM); a dirty one rolls back to the
+    previous snapshot and exits with ST_RECHECK, and the driver re-runs
+    the block on the non-optimistic ("careful", optimistic=False)
+    kernel for one short chunk to reach the divergent instruction with
+    exact per-step semantics — the scheduler then splits as usual.
+    memory.fill/copy and in-window divergent addressing always exit to
+    the careful kernel.  Convergence validation thus costs O(1)
+    reductions per ~snap_steps instructions instead of O(1) per
+    instruction, which is what lets one TensorCore retire thousands of
+    converged lanes per dispatch at row-op cost."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -410,15 +471,34 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         GR //= 2
     while GR > 8 and W % GR != 0:
         GR //= 2
+    if mem_hbm:
+        # fill/copy chunks stage through the CW-row window scratch
+        while GR > 8 and GR > CW:
+            GR //= 2
     GATHER_CHUNKS = W // GR if W % GR == 0 else 0
 
     def kernel(hid_r, a_r, b_r, c_r, ilo_r, ihi_r,
                fent_r, fnpar_r, fnloc_r, ftop_r, ftyp_r, brt_r, tbl_r,
                ctrl_r, frames_in,
                s_lo_in, s_hi_in, g_lo_in, g_hi_in, mem_in, trap_in,
+               sh_slo_in, sh_shi_in, sh_glo_in, sh_ghi_in, sh_trap_in,
+               sh_mem_in,
                ctrl_out, frames_out,
                s_lo_out, s_hi_out, g_lo_out, g_hi_out, mem_out, trap_out,
-               slo, shi, glo, ghi, memr, trapr, sems):
+               sh_slo, sh_shi, sh_glo, sh_ghi, sh_trap, sh_mem,
+               *scr):
+        # sh_* are the rollback-snapshot shadow planes (HBM, aliased
+        # in/out, only touched in optimistic mode; degenerate [1, L]
+        # sh_mem when the memory plane is HBM-resident — the plane
+        # itself then already holds last-commit state).
+        if mem_hbm:
+            slo, shi, glo, ghi, mwin0, mwin1, trapr, sems = scr[:8]
+            memr = None
+        else:
+            slo, shi, glo, ghi, memr, trapr, sems = scr[:7]
+            mwin0 = mwin1 = None
+        if optimistic:
+            canr, flag, snapf, snapc = scr[-4:]
         blk = pl.program_id(0)
         lo = blk * Lblk
 
@@ -426,7 +506,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         # scratch, DMA'd in per lane block and DMA'd back at the end.
         # Keeping VMEM usage at 1x state size (no separate input/output
         # windows, no automatic double buffering) is what lets a
-        # memory-free module run all lanes in a single block.
+        # memory-free module run all lanes in a single block.  In
+        # mem_hbm mode the memory plane is NOT staged: handlers DMA
+        # CW-row windows of mem_out (aliased with mem_in) on demand.
         def dma(i, src, dst):
             return pltpu.make_async_copy(src, dst, sems.at[i])
 
@@ -434,8 +516,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                dma(1, s_hi_in.at[:, pl.ds(lo, Lblk)], shi),
                dma(2, g_lo_in.at[:, pl.ds(lo, Lblk)], glo),
                dma(3, g_hi_in.at[:, pl.ds(lo, Lblk)], ghi),
-               dma(4, mem_in.at[:, pl.ds(lo, Lblk)], memr),
                dma(5, trap_in.at[:, pl.ds(lo, Lblk)], trapr)]
+        if not mem_hbm:
+            ins.append(dma(4, mem_in.at[:, pl.ds(lo, Lblk)], memr))
         for c in ins:
             c.start()
         for c in ins:
@@ -476,13 +559,122 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def allsame(vec, s):
             return jnp.all(vec == s)
 
-        # carry: (steps, pc, sp, fp, ob, cd, pages, status)
+        # carry: (steps, pc, sp, fp, ob, cd, pages, status) — mem_hbm
+        # mode appends the window-cache fields (wb0, wd0, wb1, wd1, mru):
+        # per-way window base row / dirty flag + the MRU way for LRU
+        # victim choice.  optimistic mode appends ls (step count at the
+        # last snapshot).  Block-uniform scalars like the rest of ctrl.
+        _CARRY = ("steps", "pc", "sp", "fp", "ob", "cd", "pages", "status")
+        if mem_hbm:
+            _CARRY = _CARRY + ("wb0", "wd0", "wb1", "wd1", "mru")
+        if optimistic:
+            _CARRY = _CARRY + ("ls",)
+        IDX = {n: i for i, n in enumerate(_CARRY)}
+        NCARRY = len(_CARRY)
+
         def keep(c, **kw):
-            d = dict(steps=c[0], pc=c[1], sp=c[2], fp=c[3], ob=c[4],
-                     cd=c[5], pages=c[6], status=c[7])
+            d = dict(zip(_CARRY, c))
             d.update(kw)
-            return (d["steps"], d["pc"], d["sp"], d["fp"], d["ob"],
-                    d["cd"], d["pages"], d["status"])
+            return tuple(d[k] for k in _CARRY)
+
+        # ---- optimistic-convergence machinery -------------------------
+        # (see _build_kernel docstring) canr is the divergence canary;
+        # snapc/snapf/shadow planes hold the rollback point.
+        if optimistic:
+            SENT_W = I32(-(1 << 30))
+
+            def agree_i32(vec):
+                """lane-0 value decision; exact-mismatch canary."""
+                s = scal(vec)
+                canr[0, :] = canr[0, :] | (vec[0, :] ^ s)
+                return s
+
+            def agree_nz(vec):
+                """lane-0 zeroness decision (branch conditions agree when
+                their zeroness agrees, not their values)."""
+                s = scal(vec)
+                canr[0, :] = canr[0, :] | jnp.where(
+                    (vec[0, :] != 0) != (s != 0), I32(1), I32(0))
+                return s
+
+            def do_snapshot(c):
+                """Record the rollback point = the CURRENT (validated)
+                state: planes -> shadow HBM, live frames + carry ->
+                SMEM, canary reset."""
+                cps = [dma(0, slo, sh_slo.at[:, pl.ds(lo, Lblk)]),
+                       dma(1, shi, sh_shi.at[:, pl.ds(lo, Lblk)]),
+                       dma(2, glo, sh_glo.at[:, pl.ds(lo, Lblk)]),
+                       dma(3, ghi, sh_ghi.at[:, pl.ds(lo, Lblk)]),
+                       dma(5, trapr, sh_trap.at[:, pl.ds(lo, Lblk)])]
+                if not mem_hbm and W > 1:
+                    cps.append(dma(4, memr, sh_mem.at[:, pl.ds(lo, Lblk)]))
+                for cp_ in cps:
+                    cp_.start()
+                for cp_ in cps:
+                    cp_.wait()
+                cd_now = c[IDX["cd"]]
+
+                def cpf(i, _):
+                    for j in range(3):
+                        snapf[j, i] = frames_out[blk, j, i]
+                    return 0
+
+                lax.fori_loop(0, jnp.clip(cd_now, 0, CD), cpf, 0)
+                for k in range(NCARRY):
+                    snapc[k] = c[k]
+                canr[0, :] = jnp.zeros((Lblk,), I32)
+
+            def do_restore():
+                """Rewind to the last snapshot (inverse of do_snapshot)."""
+                cps = [dma(0, sh_slo.at[:, pl.ds(lo, Lblk)], slo),
+                       dma(1, sh_shi.at[:, pl.ds(lo, Lblk)], shi),
+                       dma(2, sh_glo.at[:, pl.ds(lo, Lblk)], glo),
+                       dma(3, sh_ghi.at[:, pl.ds(lo, Lblk)], ghi),
+                       dma(5, sh_trap.at[:, pl.ds(lo, Lblk)], trapr)]
+                if not mem_hbm and W > 1:
+                    cps.append(dma(4, sh_mem.at[:, pl.ds(lo, Lblk)], memr))
+                for cp_ in cps:
+                    cp_.start()
+                for cp_ in cps:
+                    cp_.wait()
+                cd_snap = snapc[IDX["cd"]]
+
+                def cpf(i, _):
+                    for j in range(3):
+                        frames_out[blk, j, i] = snapf[j, i]
+                    return 0
+
+                lax.fori_loop(0, jnp.clip(cd_snap, 0, CD), cpf, 0)
+                canr[0, :] = jnp.zeros((Lblk,), I32)
+
+            def rolled_carry():
+                """Post-restore carry: snapshot scalars, ST_RECHECK, and
+                (hbm) invalidated windows — their VMEM contents are
+                stale relative to the restored plane."""
+                vals = {n: snapc[i] for i, n in enumerate(_CARRY)}
+                vals["status"] = I32(ST_RECHECK)
+                if mem_hbm:
+                    vals["wb0"] = SENT_W
+                    vals["wd0"] = I32(0)
+                    vals["wb1"] = SENT_W
+                    vals["wd1"] = I32(0)
+                return tuple(vals[n] for n in _CARRY)
+
+            def _opt_bulk_exit(c):
+                """Ops the optimistic kernel defers to the careful one
+                (memory.fill/copy: per-lane ranged, reduction-heavy).
+                Validate; roll back if a stale decision is pending; exit
+                at this exact instruction with ST_RECHECK."""
+                flag[0] = jnp.any(canr[0, :] != 0).astype(jnp.int32)
+                dirty = flag[0] != 0
+
+                @pl.when(dirty)
+                def _():
+                    do_restore()
+
+                return lax.cond(
+                    dirty, rolled_carry,
+                    lambda: keep(c, status=I32(ST_RECHECK)))
 
         # ------------------- handlers ---------------------------------
         def h_nop(c):
@@ -556,6 +748,10 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def h_brz(c):
             pc, sp = c[1], c[2]
             cond = srow(slo, sp - 1)
+            if optimistic:
+                t0 = agree_nz(cond)
+                new_pc = jnp.where(t0 == 0, a_r[pc], pc + 1)
+                return keep(c, pc=new_pc, sp=sp - 1)
             t0 = scal(cond)
             agree = allsame(cond, t0)
             new_pc = jnp.where(t0 == 0, a_r[pc], pc + 1)
@@ -567,10 +763,23 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def h_brnz(c):
             pc, sp, ob = c[1], c[2], c[4]
             cond = srow(slo, sp - 1)
-            t0 = scal(cond)
-            agree = allsame(cond, t0)
             tgt, nkeep, pop_to = a_r[pc], b_r[pc], c_r[pc]
             tgt_sp = ob + pop_to
+            if optimistic:
+                t0 = agree_nz(cond)
+                taken = t0 != 0
+
+                @pl.when(taken & (nkeep == 1))
+                def _():
+                    wrow(slo, tgt_sp, srow(slo, sp - 2))
+                    wrow(shi, tgt_sp, srow(shi, sp - 2))
+
+                return lax.cond(
+                    taken,
+                    lambda: keep(c, pc=tgt, sp=tgt_sp + nkeep),
+                    lambda: keep(c, pc=pc + 1, sp=sp - 1))
+            t0 = scal(cond)
+            agree = allsame(cond, t0)
             taken = t0 != 0
 
             @pl.when(agree & taken & (nkeep == 1))
@@ -589,8 +798,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def h_br_table(c):
             pc, sp, ob = c[1], c[2], c[4]
             idx = srow(slo, sp - 1)
-            i0 = scal(idx)
-            agree = allsame(idx, i0)
+            i0 = agree_i32(idx) if optimistic else scal(idx)
+            agree = True if optimistic else allsame(idx, i0)
             base, n = a_r[pc], b_r[pc]
             ii = jnp.where(u_lt(n, i0), n, i0)
             e = (base + ii) * 3
@@ -663,8 +872,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def h_call_indirect(c):
             pc, sp = c[1], c[2]
             idx = srow(slo, sp - 1)
-            i0 = scal(idx)
-            agree = allsame(idx, i0)
+            i0 = agree_i32(idx) if optimistic else scal(idx)
+            agree = True if optimistic else allsame(idx, i0)
             tb_size, tb_base = b_r[pc], c_r[pc]
             oob = ~u_lt(i0, tb_size)  # unsigned; tb_size == 0 always oob
             h = tbl_r[jnp.clip(tb_base + jnp.clip(i0, 0,
@@ -698,8 +907,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def h_memgrow(c):
             pc, sp, pages = c[1], c[2], c[6]
             delta = srow(slo, sp - 1)
-            d0 = scal(delta)
-            agree = allsame(delta, d0)
+            d0 = agree_i32(delta) if optimistic else scal(delta)
+            agree = True if optimistic else allsame(delta, d0)
             legal = (d0 >= 0) & ((pages + d0) <= mem_pages_hard) & \
                 ((pages + d0) >= pages)
             # legal but beyond the watermark plane: stop un-advanced so the
@@ -728,6 +937,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             return keep(c, status=I32(ST_TRAPPED_BASE) + code)
 
         def h_memfill(c):
+            if optimistic:
+                return _opt_bulk_exit(c)
             pc, sp, pages = c[1], c[2], c[6]
             n = srow(slo, sp - 1)
             val = srow(slo, sp - 2)
@@ -780,6 +991,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 lambda: keep(c, pc=pc + 1, sp=sp - 3))
 
         def h_memcopy(c):
+            if optimistic:
+                return _opt_bulk_exit(c)
             # In-kernel memmove when every lane agrees on (src - dst): the
             # byte shift between source and destination is then a scalar,
             # so each destination row is two shifted source rows under the
@@ -938,6 +1151,21 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             mem_bytes = pages * I32(65536)
             end = ea + nbytes
             oob = carry_ | u_lt(end, ea) | u_lt(full(mem_bytes), end)
+            if optimistic:
+                # lane-0 address decision; the canary covers widx/shB/oob
+                # agreement at once (all derive from ea and scalars)
+                ea0 = agree_i32(ea)
+                oob0 = jnp.where(oob, I32(1), I32(0))[0, 0] != 0
+                u = jnp.clip(lax.shift_right_logical(ea0, 2), 0, W - 1)
+                shB0 = (ea0 & 3) * 8
+                _load_finish(c, srow(memr, u),
+                             srow(memr, jnp.minimum(u + 1, W - 1)),
+                             srow(memr, jnp.minimum(u + 2, W - 1)),
+                             shB0, oob, oob0)
+                return lax.cond(
+                    oob0,
+                    lambda: keep(c, pc=pc + 1, status=I32(ST_DIVERGED)),
+                    lambda: keep(c, pc=pc + 1))
             widx = jnp.clip(lax.shift_right_logical(ea, 2), 0, W - 1)
             shB = (ea & 3) * 8
             u0 = scal(widx)
@@ -984,6 +1212,45 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             end = ea + nbytes
             oob = carry_ | u_lt(end, ea) | u_lt(full(mem_bytes), end)
             ok = ~oob
+            if optimistic:
+                ea0 = agree_i32(ea)
+                oob0 = jnp.where(oob, I32(1), I32(0))[0, 0] != 0
+                u = jnp.clip(lax.shift_right_logical(ea0, 2), 0, W - 1)
+                shB0 = (ea0 & 3) * 8
+                b1 = nbytes == 1
+                b2_ = nbytes == 2
+                # scalar byte masks (address is block-uniform by
+                # assumption); value planes stay per-lane vectors
+                m_lo = jnp.where(b1, I32(0xFF),
+                                 jnp.where(b2_, I32(0xFFFF), I32(-1)))
+                m_hi = jnp.where(nbytes == 8, I32(-1), I32(0))
+                sm0, sm1 = lo_ops.shl64(m_lo, m_hi, shB0)
+                sm2 = jnp.where(shB0 == 0, 0,
+                                lo_ops.shr64_u(m_lo, m_hi, 64 - shB0)[0])
+                sv0, sv1 = lo_ops.shl64(vl, vh, shB0)
+                sv2 = jnp.where(shB0 == 0, 0,
+                                lo_ops.shr64_u(vl, vh, 64 - shB0)[0])
+                for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
+                                            (sm2, sv2))):
+                    w = jnp.minimum(u + k, W - 1)
+
+                    @pl.when(m != 0)
+                    def _(m=m, v=v, w=w):
+                        cur = srow(memr, w)
+                        wrow(memr, w,
+                             jnp.where(ok, (cur & ~m) | (v & m), cur))
+
+                @pl.when(oob0)
+                def _():
+                    trapr[0, :] = jnp.where(
+                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
+                        trapr[0, :])
+
+                return lax.cond(
+                    oob0,
+                    lambda: keep(c, pc=pc + 1, sp=sp - 2,
+                                 status=I32(ST_DIVERGED)),
+                    lambda: keep(c, pc=pc + 1, sp=sp - 2))
             widx = jnp.clip(lax.shift_right_logical(ea, 2), 0, W - 1)
             shB = (ea & 3) * 8
             b1 = nbytes == 1
@@ -1053,6 +1320,772 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     lambda: keep(c, pc=pc + 1, sp=sp - 2)),
                 lambda: keep(c, status=I32(ST_DIVERGED)))
 
+        # ---- mem_hbm mode: window-cached memory handlers --------------
+        # The memory plane stays HBM-resident; h_load/h_store/h_memfill/
+        # h_memcopy are shadowed below with window-cache versions.  The
+        # invariant maintained by _win_select is that at most ONE way
+        # holds any given plane row (a fetch overlapping the other way
+        # writes that way back and invalidates it first), so hit
+        # priority and flush order can never replay stale rows.
+        if mem_hbm:
+            SENT = I32(-(1 << 30))  # "window invalid" base sentinel
+
+            def a8(v):
+                # every HBM row offset here is 8-aligned by construction
+                # (window bases are align8'd; W, CW, GR are multiples of
+                # 8) but Mosaic needs the divisibility stated to slice
+                # the (8,128)-tiled HBM memref at a dynamic row
+                return pl.multiple_of(v, 8)
+
+            def _wb_way0(wb):
+                cp = dma(6, mwin0, mem_out.at[
+                    pl.ds(a8(jnp.clip(wb, 0, W - CW)), CW),
+                    pl.ds(lo, Lblk)])
+                cp.start()
+                cp.wait()
+
+            def _wb_way1(wb):
+                cp = dma(7, mwin1, mem_out.at[
+                    pl.ds(a8(jnp.clip(wb, 0, W - CW)), CW),
+                    pl.ds(lo, Lblk)])
+                cp.start()
+                cp.wait()
+
+            def _win_select(wfs, rlo, rhi, en):
+                """Make rows [rlo, rhi] resident in one way; returns
+                (way, wfs').  All DMAs are predicated on `en`; callers
+                must have checked (rhi - align8(rlo)) < CW."""
+                wb0, wd0, wb1, wd1, mru = wfs
+                hit0 = (rlo >= wb0) & (rhi < wb0 + CW)
+                hit1 = (rlo >= wb1) & (rhi < wb1 + CW)
+                nb = jnp.clip(rlo - lax.rem(rlo, 8), 0, W - CW)
+                miss = en & ~(hit0 | hit1)
+                vic1 = mru == 0
+                repl0 = miss & ~vic1
+                repl1 = miss & vic1
+                # the single-resident-copy invariant: evict the OTHER way
+                # when the incoming window overlaps it
+                ov0 = repl1 & (wb0 < nb + CW) & (nb < wb0 + CW)
+                ov1 = repl0 & (wb1 < nb + CW) & (nb < wb1 + CW)
+
+                @pl.when(ov0 & (wd0 != 0))
+                def _():
+                    _wb_way0(wb0)
+
+                @pl.when(ov1 & (wd1 != 0))
+                def _():
+                    _wb_way1(wb1)
+
+                @pl.when(repl0 & (wd0 != 0))
+                def _():
+                    _wb_way0(wb0)
+
+                @pl.when(repl0)
+                def _():
+                    cp = dma(6, mem_out.at[pl.ds(a8(nb), CW),
+                                           pl.ds(lo, Lblk)], mwin0)
+                    cp.start()
+                    cp.wait()
+
+                @pl.when(repl1 & (wd1 != 0))
+                def _():
+                    _wb_way1(wb1)
+
+                @pl.when(repl1)
+                def _():
+                    cp = dma(7, mem_out.at[pl.ds(a8(nb), CW),
+                                           pl.ds(lo, Lblk)], mwin1)
+                    cp.start()
+                    cp.wait()
+
+                wb0n = jnp.where(repl0, nb, jnp.where(ov0, SENT, wb0))
+                wd0n = jnp.where(repl0 | ov0, I32(0), wd0)
+                wb1n = jnp.where(repl1, nb, jnp.where(ov1, SENT, wb1))
+                wd1n = jnp.where(repl1 | ov1, I32(0), wd1)
+                way = jnp.where(hit0, I32(0),
+                                jnp.where(hit1, I32(1),
+                                          jnp.where(vic1, I32(1), I32(0))))
+                mrun = jnp.where(en, way, mru)
+                return way, (wb0n, wd0n, wb1n, wd1n, mrun)
+
+            def _win_flush(wfs):
+                """Write back both dirty ways and invalidate (used before
+                chunk-streaming ops that bypass the cache)."""
+                wb0, wd0, wb1, wd1, _ = wfs
+
+                @pl.when(wd0 != 0)
+                def _():
+                    _wb_way0(wb0)
+
+                @pl.when(wd1 != 0)
+                def _():
+                    _wb_way1(wb1)
+
+                return (SENT, I32(0), SENT, I32(0), I32(0))
+
+            def win_read_row(way, wfs, r):
+                i0 = jnp.clip(r - wfs[0], 0, CW - 1)
+                i1 = jnp.clip(r - wfs[2], 0, CW - 1)
+                return jnp.where(way == 0, mwin0[pl.ds(i0, 1), :],
+                                 mwin1[pl.ds(i1, 1), :])
+
+            def win_write_row(way, wfs, r, v):
+                @pl.when(way == 0)
+                def _():
+                    mwin0[pl.ds(jnp.clip(r - wfs[0], 0, CW - 1), 1), :] = v
+
+                @pl.when(way == 1)
+                def _():
+                    mwin1[pl.ds(jnp.clip(r - wfs[2], 0, CW - 1), 1), :] = v
+
+            def _win_gather(way, wfs, wk):
+                """Per-lane word gather from the selected resident way."""
+                base = jnp.where(way == 0, wfs[0], wfs[2])
+                rel = wk - base
+                wi = jax.lax.broadcasted_iota(I32, (CW, Lblk), 0)
+                rows = jnp.where(way == 0, mwin0[:, :], mwin1[:, :])
+                return jnp.sum(jnp.where(wi == rel, rows, 0),
+                               axis=0, keepdims=True)
+
+            def _wfs_of(c):
+                return (c[8], c[9], c[10], c[11], c[12])
+
+            def _keep_win(c, wfs, **kw):
+                return keep(c, wb0=wfs[0], wd0=wfs[1], wb1=wfs[2],
+                            wd1=wfs[3], mru=wfs[4], **kw)
+
+            def _opt_window(c, u, rhi):
+                """Optimistic scalar window select: resolve [u, rhi] to
+                a resident way with all decisions scalar.  A dirty
+                eviction is a commit point — validate the canary first,
+                roll back on a pending stale decision, snapshot
+                otherwise.  Returns (dirty, way, wfs') where wfs' has
+                the new window fields with mru=way; callers must gate
+                every ref mutation on ~dirty and return rolled_carry()
+                when dirty.
+
+                INVARIANT SYNC: the hit predicates, victim choice,
+                overlap eviction (single-resident-copy rule) and
+                wb/wd/mru update formulas here MUST match _win_select
+                above — the careful kernel runs that one against the
+                same window state this one leaves behind."""
+                wb0, wd0, wb1, wd1, mru = _wfs_of(c)
+                hit0 = (u >= wb0) & (rhi < wb0 + CW)
+                hit1 = (u >= wb1) & (rhi < wb1 + CW)
+                miss = ~(hit0 | hit1)
+                vic1 = mru == 0
+                nb = jnp.clip(u - lax.rem(u, 8), 0, W - CW)
+                ov0 = miss & vic1 & (wb0 < nb + CW) & (nb < wb0 + CW)
+                ov1 = miss & ~vic1 & (wb1 < nb + CW) & (nb < wb1 + CW)
+                repl0 = miss & ~vic1
+                repl1 = miss & vic1
+                needs_wb = (repl0 & (wd0 != 0)) | (repl1 & (wd1 != 0)) | \
+                    (ov0 & (wd0 != 0)) | (ov1 & (wd1 != 0))
+
+                @pl.when(needs_wb)
+                def _():
+                    flag[0] = jnp.any(canr[0, :] != 0).astype(jnp.int32)
+
+                dirty = needs_wb & (flag[0] != 0)
+                okp = ~dirty
+
+                @pl.when(dirty)
+                def _():
+                    do_restore()
+
+                # publish BOTH dirty ways before the snapshot so the HBM
+                # plane IS the snapshot's memory state — otherwise a
+                # later rollback would discard the non-victim way's
+                # validated stores (same discipline as the periodic
+                # commit in body())
+                @pl.when(needs_wb & okp & (wd0 != 0))
+                def _():
+                    _wb_way0(wb0)
+
+                @pl.when(needs_wb & okp & (wd1 != 0))
+                def _():
+                    _wb_way1(wb1)
+
+                @pl.when(needs_wb & okp)
+                def _():
+                    do_snapshot(c)
+
+                @pl.when(okp & repl0)
+                def _():
+                    cp = dma(6, mem_out.at[pl.ds(a8(nb), CW),
+                                           pl.ds(lo, Lblk)], mwin0)
+                    cp.start()
+                    cp.wait()
+
+                @pl.when(okp & repl1)
+                def _():
+                    cp = dma(7, mem_out.at[pl.ds(a8(nb), CW),
+                                           pl.ds(lo, Lblk)], mwin1)
+                    cp.start()
+                    cp.wait()
+
+                flushed = needs_wb & okp
+                wb0n = jnp.where(repl0, nb, jnp.where(ov0, SENT, wb0))
+                wd0n = jnp.where(flushed | repl0 | ov0, I32(0), wd0)
+                wb1n = jnp.where(repl1, nb, jnp.where(ov1, SENT, wb1))
+                wd1n = jnp.where(flushed | repl1 | ov1, I32(0), wd1)
+                way = jnp.where(hit0, I32(0),
+                                jnp.where(hit1, I32(1),
+                                          jnp.where(vic1, I32(1), I32(0))))
+                return dirty, flushed, way, \
+                    (wb0n, wd0n, wb1n, wd1n, way)
+
+            def _opt_ls_prolog(c, addr_row, nb_extra):
+                """Shared optimistic load/store address computation."""
+                pc, pages = c[1], c[6]
+                off, nbytes = a_r[pc], b_r[pc]
+                ea = addr_row + off
+                carry_ = u_lt(ea, addr_row) | u_lt(ea, full(off))
+                mem_bytes = pages * I32(65536)
+                end = ea + nbytes
+                oob = carry_ | u_lt(end, ea) | u_lt(full(mem_bytes), end)
+                ea0 = agree_i32(ea)
+                oob0 = jnp.where(oob, I32(1), I32(0))[0, 0] != 0
+                u = jnp.clip(lax.shift_right_logical(ea0, 2), 0, W - 1)
+                shB0 = (ea0 & 3) * 8
+                rhi = jnp.minimum(u + nb_extra, W - 1)
+                return oob, oob0, u, shB0, rhi, nbytes
+
+            def _opt_ls_scalar(c, addr_row, nbytes, want_rows):
+                """Reduction-free load/store prolog: the lane-0 address
+                plus a fully SCALAR bounds check (address agreement is
+                the optimistic assumption, so oob agreement follows;
+                lane mismatches go to the canary and roll back)."""
+                pc, pages = c[1], c[6]
+                off = a_r[pc]
+                ea = addr_row + off
+                ea0 = agree_i32(ea)
+                addr0 = ea0 - off
+                mem_bytes = pages * I32(65536)
+                end0 = ea0 + nbytes
+                oob0 = u_lt(ea0, addr0) | u_lt(ea0, off) | \
+                    u_lt(end0, ea0) | u_lt(mem_bytes, end0)
+                u = jnp.clip(lax.shift_right_logical(ea0, 2), 0, W - 1)
+                shB0 = (ea0 & 3) * 8
+                rhi = jnp.minimum(u + want_rows, W - 1)
+                return ea, oob0, u, shB0, rhi
+
+            def _opt_trap_oob(c, ea, nbytes, oob0):
+                """Per-lane OOB trap plane write, only materialized on
+                the (rare) lane-0-oob path."""
+                @pl.when(oob0)
+                def _():
+                    pages = c[6]
+                    addr = ea - a_r[c[1]]
+                    carry_ = u_lt(ea, addr) | u_lt(ea, full(a_r[c[1]]))
+                    end = ea + nbytes
+                    oob = carry_ | u_lt(end, ea) | \
+                        u_lt(full(pages * I32(65536)), end)
+                    trapr[0, :] = jnp.where(
+                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
+                        trapr[0, :])
+
+            def _mk_load_wd(is64):
+                nbytes = 8 if is64 else 4
+                want = 2 if is64 else 1
+
+                def h(c):
+                    pc, sp = c[1], c[2]
+                    ea, oob0, u, shB0, rhi = _opt_ls_scalar(
+                        c, srow(slo, sp - 1), nbytes, want)
+                    dirty, snapped, way, wfs2 = _opt_window(c, u, rhi)
+                    inv = (32 - shB0) & 31
+                    hi_or = jnp.where(shB0 == 0, 0, -1)
+
+                    @pl.when(~dirty)
+                    def _():
+                        m0 = win_read_row(way, wfs2, u)
+                        m1 = win_read_row(way, wfs2,
+                                          jnp.minimum(u + 1, W - 1))
+                        ll = lax.shift_right_logical(m0, shB0) | \
+                            (lax.shift_left(m1, inv) & hi_or)
+                        wrow(slo, sp - 1, ll)
+                        if is64:
+                            m2 = win_read_row(way, wfs2,
+                                              jnp.minimum(u + 2, W - 1))
+                            lh = lax.shift_right_logical(m1, shB0) | \
+                                (lax.shift_left(m2, inv) & hi_or)
+                            wrow(shi, sp - 1, lh)
+                        else:
+                            wrow(shi, sp - 1, jnp.zeros((1, Lblk), I32))
+                        _opt_trap_oob(c, ea, nbytes, oob0)
+
+                    c2 = _keep_win(
+                        c, wfs2,
+                        ls=jnp.where(snapped, c[0], c[IDX["ls"]]))
+                    return lax.cond(
+                        dirty, rolled_carry,
+                        lambda: lax.cond(
+                            oob0,
+                            lambda: keep(c2, pc=pc + 1,
+                                         status=I32(ST_DIVERGED)),
+                            lambda: keep(c2, pc=pc + 1)))
+                return h
+
+            def _mk_store_wd(is64):
+                nbytes = 8 if is64 else 4
+                want = 2 if is64 else 1
+
+                def h(c):
+                    pc, sp = c[1], c[2]
+                    vl, vh = srow(slo, sp - 1), srow(shi, sp - 1)
+                    ea, oob0, u, shB0, rhi = _opt_ls_scalar(
+                        c, srow(slo, sp - 2), nbytes, want)
+                    dirty, snapped, way, wfs2 = _opt_window(c, u, rhi)
+                    m_lo = I32(-1)
+                    m_hi = I32(-1) if is64 else I32(0)
+                    sm0, sm1 = lo_ops.shl64(m_lo, m_hi, shB0)
+                    sm2 = jnp.where(shB0 == 0, 0,
+                                    lo_ops.shr64_u(m_lo, m_hi,
+                                                   64 - shB0)[0])
+                    sv0, sv1 = lo_ops.shl64(vl, vh, shB0)
+                    sv2 = jnp.where(shB0 == 0, 0,
+                                    lo_ops.shr64_u(vl, vh, 64 - shB0)[0])
+
+                    @pl.when(~dirty & ~oob0)
+                    def _():
+                        # common path: no lane traps assumed — write
+                        # unmasked (a lane disagreeing on the address is
+                        # already canary-marked and will roll back)
+                        for k, (m, v) in enumerate(((sm0, sv0),
+                                                    (sm1, sv1),
+                                                    (sm2, sv2))):
+                            w = jnp.minimum(u + k, W - 1)
+
+                            @pl.when(m != 0)
+                            def _(m=m, v=v, w=w):
+                                cur = win_read_row(way, wfs2, w)
+                                win_write_row(way, wfs2, w,
+                                              (cur & ~m) | (v & m))
+
+                    _opt_trap_oob(c, ea, nbytes, oob0 & ~dirty)
+                    nwd0 = jnp.where(way == 0, I32(1), wfs2[1])
+                    nwd1 = jnp.where(way == 1, I32(1), wfs2[3])
+                    c2 = keep(c, wb0=wfs2[0], wd0=nwd0, wb1=wfs2[2],
+                              wd1=nwd1, mru=wfs2[4],
+                              ls=jnp.where(snapped, c[0], c[IDX["ls"]]))
+                    return lax.cond(
+                        dirty, rolled_carry,
+                        lambda: lax.cond(
+                            oob0,
+                            lambda: keep(c2, pc=pc + 1, sp=sp - 2,
+                                         status=I32(ST_DIVERGED)),
+                            lambda: keep(c2, pc=pc + 1, sp=sp - 2)))
+                return h
+
+            h_load_w = _mk_load_wd(False)
+            h_load_d = _mk_load_wd(True)
+            h_store_w = _mk_store_wd(False)
+            h_store_d = _mk_store_wd(True)
+
+            def h_load(c):
+                if optimistic:
+                    pc, sp = c[1], c[2]
+                    oob, oob0, u, shB0, rhi, _nb = _opt_ls_prolog(
+                        c, srow(slo, sp - 1), 2)
+                    dirty, snapped, way, wfs2 = _opt_window(c, u, rhi)
+
+                    @pl.when(~dirty)
+                    def _():
+                        _load_finish(
+                            c, win_read_row(way, wfs2, u),
+                            win_read_row(way, wfs2,
+                                         jnp.minimum(u + 1, W - 1)),
+                            win_read_row(way, wfs2,
+                                         jnp.minimum(u + 2, W - 1)),
+                            shB0, oob, oob0)
+
+                    c2 = _keep_win(
+                        c, wfs2,
+                        ls=jnp.where(snapped, c[0], c[IDX["ls"]]))
+                    return lax.cond(
+                        dirty, rolled_carry,
+                        lambda: lax.cond(
+                            oob0,
+                            lambda: keep(c2, pc=pc + 1,
+                                         status=I32(ST_DIVERGED)),
+                            lambda: keep(c2, pc=pc + 1)))
+                pc, sp, pages = c[1], c[2], c[6]
+                off, nbytes = a_r[pc], b_r[pc]
+                addr = srow(slo, sp - 1)
+                ea = addr + off
+                carry_ = u_lt(ea, addr) | u_lt(ea, full(off))
+                mem_bytes = pages * I32(65536)
+                end = ea + nbytes
+                oob = carry_ | u_lt(end, ea) | u_lt(full(mem_bytes), end)
+                widx = jnp.clip(lax.shift_right_logical(ea, 2), 0, W - 1)
+                shB = (ea & 3) * 8
+                rlo = jnp.min(widx)
+                rhi = jnp.minimum(jnp.max(widx) + 2, W - 1)
+                fits = (rhi - (rlo - lax.rem(rlo, 8))) < CW
+                any_oob = jnp.any(oob)
+                way, wfs = _win_select(_wfs_of(c), rlo, rhi, fits)
+                u0 = scal(widx)
+                uni = allsame(widx, u0) & allsame(shB, scal(shB))
+
+                @pl.when(fits & uni)
+                def _():
+                    _load_finish(
+                        c, win_read_row(way, wfs, u0),
+                        win_read_row(way, wfs, jnp.minimum(u0 + 1, W - 1)),
+                        win_read_row(way, wfs, jnp.minimum(u0 + 2, W - 1)),
+                        shB, oob, any_oob)
+
+                @pl.when(fits & ~uni)
+                def _():
+                    w1 = jnp.clip(widx + 1, 0, W - 1)
+                    w2 = jnp.clip(widx + 2, 0, W - 1)
+                    _load_finish(c, _win_gather(way, wfs, widx),
+                                 _win_gather(way, wfs, w1),
+                                 _win_gather(way, wfs, w2),
+                                 shB, oob, any_oob)
+
+                c = _keep_win(c, wfs)
+                return lax.cond(
+                    fits,
+                    lambda: lax.cond(
+                        any_oob,
+                        lambda: keep(c, pc=pc + 1, status=I32(ST_DIVERGED)),
+                        lambda: keep(c, pc=pc + 1)),
+                    lambda: keep(c, status=I32(ST_DIVERGED)))
+
+            def h_store(c):
+                if optimistic:
+                    pc, sp = c[1], c[2]
+                    vl, vh = srow(slo, sp - 1), srow(shi, sp - 1)
+                    oob, oob0, u, shB0, rhi, nbytes = _opt_ls_prolog(
+                        c, srow(slo, sp - 2), 2)
+                    ok = ~oob
+                    dirty, snapped, way, wfs2 = _opt_window(c, u, rhi)
+                    b1 = nbytes == 1
+                    b2_ = nbytes == 2
+                    m_lo = jnp.where(b1, I32(0xFF),
+                                     jnp.where(b2_, I32(0xFFFF), I32(-1)))
+                    m_hi = jnp.where(nbytes == 8, I32(-1), I32(0))
+                    sm0, sm1 = lo_ops.shl64(m_lo, m_hi, shB0)
+                    sm2 = jnp.where(shB0 == 0, 0,
+                                    lo_ops.shr64_u(m_lo, m_hi,
+                                                   64 - shB0)[0])
+                    sv0, sv1 = lo_ops.shl64(vl, vh, shB0)
+                    sv2 = jnp.where(shB0 == 0, 0,
+                                    lo_ops.shr64_u(vl, vh, 64 - shB0)[0])
+                    for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
+                                                (sm2, sv2))):
+                        w = jnp.minimum(u + k, W - 1)
+
+                        @pl.when(~dirty & (m != 0))
+                        def _(m=m, v=v, w=w):
+                            cur = win_read_row(way, wfs2, w)
+                            win_write_row(
+                                way, wfs2, w,
+                                jnp.where(ok, (cur & ~m) | (v & m), cur))
+
+                    @pl.when(~dirty & oob0)
+                    def _():
+                        trapr[0, :] = jnp.where(
+                            oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
+                            trapr[0, :])
+
+                    nwd0 = jnp.where(way == 0, I32(1), wfs2[1])
+                    nwd1 = jnp.where(way == 1, I32(1), wfs2[3])
+                    c2 = keep(c, wb0=wfs2[0], wd0=nwd0, wb1=wfs2[2],
+                              wd1=nwd1, mru=wfs2[4],
+                              ls=jnp.where(snapped, c[0], c[IDX["ls"]]))
+                    return lax.cond(
+                        dirty, rolled_carry,
+                        lambda: lax.cond(
+                            oob0,
+                            lambda: keep(c2, pc=pc + 1, sp=sp - 2,
+                                         status=I32(ST_DIVERGED)),
+                            lambda: keep(c2, pc=pc + 1, sp=sp - 2)))
+                pc, sp, pages = c[1], c[2], c[6]
+                off, nbytes = a_r[pc], b_r[pc]
+                vl, vh = srow(slo, sp - 1), srow(shi, sp - 1)
+                addr = srow(slo, sp - 2)
+                ea = addr + off
+                carry_ = u_lt(ea, addr) | u_lt(ea, full(off))
+                mem_bytes = pages * I32(65536)
+                end = ea + nbytes
+                oob = carry_ | u_lt(end, ea) | u_lt(full(mem_bytes), end)
+                ok = ~oob
+                widx = jnp.clip(lax.shift_right_logical(ea, 2), 0, W - 1)
+                shB = (ea & 3) * 8
+                b1 = nbytes == 1
+                b2_ = nbytes == 2
+                full_lo = jnp.where(b1, 0xFF,
+                                    jnp.where(b2_, 0xFFFF, I32(-1)))
+                full_hi = jnp.where(nbytes == 8, I32(-1), 0)
+                full_lo = jnp.broadcast_to(full_lo, (1, Lblk))
+                full_hi = jnp.broadcast_to(full_hi, (1, Lblk))
+                sm0, sm1 = lo_ops.shl64(full_lo, full_hi, shB)
+                sm2 = jnp.where(shB == 0, 0,
+                                lo_ops.shr64_u(full_lo, full_hi,
+                                               64 - shB)[0])
+                sv0, sv1 = lo_ops.shl64(vl, vh, shB)
+                sv2 = jnp.where(shB == 0, 0,
+                                lo_ops.shr64_u(vl, vh, 64 - shB)[0])
+                rlo = jnp.min(widx)
+                rhi = jnp.minimum(jnp.max(widx) + 2, W - 1)
+                fits = (rhi - (rlo - lax.rem(rlo, 8))) < CW
+                any_oob = jnp.any(oob)
+                way, wfs = _win_select(_wfs_of(c), rlo, rhi, fits)
+                u0 = scal(widx)
+                uni = allsame(widx, u0) & allsame(shB, scal(shB))
+
+                @pl.when(fits & uni)
+                def _():
+                    for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
+                                                (sm2, sv2))):
+                        w = jnp.minimum(u0 + k, W - 1)
+
+                        @pl.when(jnp.any(m != 0))
+                        def _(m=m, v=v, w=w):
+                            cur = win_read_row(way, wfs, w)
+                            win_write_row(
+                                way, wfs, w,
+                                jnp.where(ok & (m != 0),
+                                          (cur & ~m) | (v & m), cur))
+
+                @pl.when(fits & ~uni)
+                def _():
+                    base = jnp.where(way == 0, wfs[0], wfs[2])
+                    wi = jax.lax.broadcasted_iota(I32, (CW, Lblk), 0) + base
+                    for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
+                                                (sm2, sv2))):
+                        wk = jnp.clip(widx + k, 0, W - 1)
+                        hit = (wi == wk) & (ok & (m != 0))
+
+                        @pl.when(way == 0)
+                        def _(hit=hit, m=m, v=v):
+                            mwin0[:, :] = jnp.where(
+                                hit, (mwin0[:, :] & ~m) | (v & m),
+                                mwin0[:, :])
+
+                        @pl.when(way == 1)
+                        def _(hit=hit, m=m, v=v):
+                            mwin1[:, :] = jnp.where(
+                                hit, (mwin1[:, :] & ~m) | (v & m),
+                                mwin1[:, :])
+
+                nwd0 = jnp.where(fits & (way == 0), I32(1), wfs[1])
+                nwd1 = jnp.where(fits & (way == 1), I32(1), wfs[3])
+                c = keep(c, wb0=wfs[0], wd0=nwd0, wb1=wfs[2], wd1=nwd1,
+                         mru=wfs[4])
+
+                @pl.when(fits & any_oob)
+                def _():
+                    trapr[0, :] = jnp.where(
+                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
+                        trapr[0, :])
+
+                return lax.cond(
+                    fits,
+                    lambda: lax.cond(
+                        any_oob,
+                        lambda: keep(c, pc=pc + 1, sp=sp - 2,
+                                     status=I32(ST_DIVERGED)),
+                        lambda: keep(c, pc=pc + 1, sp=sp - 2)),
+                    lambda: keep(c, status=I32(ST_DIVERGED)))
+
+            def h_memfill(c):
+                if optimistic:
+                    return _opt_bulk_exit(c)
+                pc, sp, pages = c[1], c[2], c[6]
+                n = srow(slo, sp - 1)
+                val = srow(slo, sp - 2)
+                dst = srow(slo, sp - 3)
+                mem_bytes = pages * I32(65536)
+                end = dst + n
+                oob = u_lt(end, dst) | u_lt(full(mem_bytes), end)
+                go = (~oob) & (n != 0)
+                fill_word = (val & 0xFF) * I32(0x01010101)
+                dst_ok = jnp.where(go, dst, I32(0x7FFFFFFF))
+                end_ok = jnp.where(go, end, I32(0))
+                c_lo = jnp.clip(
+                    lax.div(lax.shift_right_logical(jnp.min(dst_ok), 2),
+                            I32(GR)), 0, GATHER_CHUNKS)
+                c_hi = jnp.clip(
+                    lax.div(lax.shift_right_logical(jnp.max(end_ok) + 3, 2)
+                            + I32(GR - 1), I32(GR)), 0, GATHER_CHUNKS)
+                # stream aligned GR-row chunks through scratch; the window
+                # cache is flushed+invalidated first so it cannot hold
+                # stale copies of the filled rows
+                wfs = _win_flush(_wfs_of(c))
+
+                def chunk(i, _):
+                    base = a8(i * GR)
+                    cin = dma(6,
+                              mem_out.at[pl.ds(base, GR), pl.ds(lo, Lblk)],
+                              mwin0.at[pl.ds(0, GR)])
+                    cin.start()
+                    cin.wait()
+                    rows = mwin0[pl.ds(0, GR), :]
+                    wi = base + jax.lax.broadcasted_iota(I32, (GR, Lblk), 0)
+                    byte0 = wi * 4
+                    mask = jnp.zeros_like(rows)
+                    for bpos in range(4):
+                        ba = byte0 + bpos
+                        inr = (~u_lt(ba, dst)) & u_lt(ba, end)
+                        mask = mask | jnp.where(
+                            inr, jnp.int32(lo_ops.BYTE_MASKS[bpos]), 0)
+                    write = (mask != 0) & go
+                    mwin0[pl.ds(0, GR), :] = jnp.where(
+                        write, (rows & ~mask) | (fill_word & mask), rows)
+                    cout = dma(6, mwin0.at[pl.ds(0, GR)],
+                               mem_out.at[pl.ds(base, GR), pl.ds(lo, Lblk)])
+                    cout.start()
+                    cout.wait()
+                    return 0
+
+                lax.fori_loop(c_lo, c_hi, chunk, 0)
+                any_oob = jnp.any(oob)
+
+                @pl.when(any_oob)
+                def _():
+                    trapr[0, :] = jnp.where(
+                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
+                        trapr[0, :])
+
+                c = _keep_win(c, wfs)
+                return lax.cond(
+                    any_oob,
+                    lambda: keep(c, pc=pc + 1, sp=sp - 3,
+                                 status=I32(ST_DIVERGED)),
+                    lambda: keep(c, pc=pc + 1, sp=sp - 3))
+
+            def h_memcopy(c):
+                if optimistic:
+                    return _opt_bulk_exit(c)
+                pc, sp, pages = c[1], c[2], c[6]
+                n = srow(slo, sp - 1)
+                src = srow(slo, sp - 2)
+                dst = srow(slo, sp - 3)
+                mem_bytes = pages * I32(65536)
+                send = src + n
+                dend = dst + n
+                oob = u_lt(send, src) | u_lt(full(mem_bytes), send) | \
+                    u_lt(dend, dst) | u_lt(full(mem_bytes), dend)
+                delta = src - dst
+                live = (~oob) & (n != 0)
+                d_eff = jnp.where(live, delta, I32(0x7FFFFFFF))
+                d0 = jnp.min(d_eff)
+                agree = jnp.all(jnp.where(live, delta, d0) == d0)
+                any_live = jnp.any(live)
+                d0 = jnp.where(any_live, d0, I32(0))
+                sm = d0 & 3
+                qv = lax.shift_right_arithmetic(d0 - sm, 2)
+                shB = sm * 8
+                inv = (32 - shB) & 31
+                hi_or = jnp.where(shB == 0, 0, -1)
+                dst_ok = jnp.where(live, dst, I32(0x7FFFFFFF))
+                dend_ok = jnp.where(live, dend, I32(0))
+                row_lo = lax.shift_right_logical(jnp.min(dst_ok), 2)
+                row_hi = lax.shift_right_logical(jnp.max(dend_ok) + 3, 2)
+                row_lo = jnp.minimum(row_lo, I32(W))
+                row_hi = jnp.minimum(row_hi, I32(W))
+                nrows = jnp.maximum(row_hi - row_lo, 0)
+                fwd = d0 >= 0
+                # whole src+dst span in one window / disjoint regions a
+                # way apart; large *overlapping* moves hand off to SIMT
+                lo_all = jnp.clip(jnp.minimum(row_lo, row_lo + qv),
+                                  0, W - 1)
+                hi_all = jnp.clip(jnp.maximum(row_hi, row_hi + qv + 1) - 1,
+                                  0, W - 1)
+                one_win = (hi_all - (lo_all - lax.rem(lo_all, 8))) < CW
+                disjoint = jnp.abs(qv) >= I32(CW + 8)
+                feasible = agree & (one_win | disjoint | (nrows == 0))
+
+                def row_mask(r):
+                    mask = jnp.zeros((1, Lblk), I32)
+                    for bpos in range(4):
+                        ba = full(r * 4 + bpos)
+                        inr = (~u_lt(ba, dst)) & u_lt(ba, dend)
+                        mask = mask | jnp.where(
+                            inr & live,
+                            jnp.int32(lo_ops.BYTE_MASKS[bpos]), 0)
+                    return mask
+
+                def shift_val(m0, m1):
+                    return lax.shift_right_logical(m0, shB) | \
+                        (lax.shift_left(m1, inv) & hi_or)
+
+                useA = agree & one_win & (nrows > 0)
+                wayA, wfsA = _win_select(_wfs_of(c), lo_all, hi_all, useA)
+
+                def bodyA(i, _):
+                    r = jnp.where(fwd, row_lo + i, row_hi - 1 - i)
+                    rc = jnp.clip(r, 0, W - 1)
+                    m0 = win_read_row(wayA, wfsA,
+                                      jnp.clip(r + qv, 0, W - 1))
+                    m1 = win_read_row(wayA, wfsA,
+                                      jnp.clip(r + qv + 1, 0, W - 1))
+                    val = shift_val(m0, m1)
+                    mask = row_mask(r)
+                    old = win_read_row(wayA, wfsA, rc)
+                    win_write_row(
+                        wayA, wfsA, rc,
+                        jnp.where(mask != 0, (old & ~mask) | (val & mask),
+                                  old))
+                    return 0
+
+                lax.fori_loop(0, jnp.where(useA, nrows, 0), bodyA, 0)
+                wfsA = (wfsA[0],
+                        jnp.where(useA & (wayA == 0), I32(1), wfsA[1]),
+                        wfsA[2],
+                        jnp.where(useA & (wayA == 1), I32(1), wfsA[3]),
+                        wfsA[4])
+
+                useB = agree & ~one_win & disjoint & (nrows > 0)
+
+                def bodyB(i, wfs):
+                    r = jnp.where(fwd, row_lo + i, row_hi - 1 - i)
+                    rs0 = jnp.clip(r + qv, 0, W - 1)
+                    rs1 = jnp.clip(r + qv + 1, 0, W - 1)
+                    ws, wfs = _win_select(wfs, jnp.minimum(rs0, rs1),
+                                          jnp.maximum(rs0, rs1),
+                                          jnp.bool_(True))
+                    m0 = win_read_row(ws, wfs, rs0)
+                    m1 = win_read_row(ws, wfs, rs1)
+                    val = shift_val(m0, m1)
+                    rc = jnp.clip(r, 0, W - 1)
+                    wd_, wfs = _win_select(wfs, rc, rc, jnp.bool_(True))
+                    mask = row_mask(r)
+                    old = win_read_row(wd_, wfs, rc)
+                    win_write_row(
+                        wd_, wfs, rc,
+                        jnp.where(mask != 0, (old & ~mask) | (val & mask),
+                                  old))
+                    return (wfs[0],
+                            jnp.where(wd_ == 0, I32(1), wfs[1]),
+                            wfs[2],
+                            jnp.where(wd_ == 1, I32(1), wfs[3]),
+                            wfs[4])
+
+                wfsB = lax.fori_loop(0, jnp.where(useB, nrows, 0), bodyB,
+                                     wfsA)
+                any_oob = jnp.any(oob)
+
+                @pl.when(feasible & any_oob)
+                def _():
+                    trapr[0, :] = jnp.where(
+                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
+                        trapr[0, :])
+
+                c = _keep_win(c, wfsB)
+                return lax.cond(
+                    feasible,
+                    lambda: lax.cond(
+                        any_oob,
+                        lambda: keep(c, pc=pc + 1, sp=sp - 3,
+                                     status=I32(ST_DIVERGED)),
+                        lambda: keep(c, pc=pc + 1, sp=sp - 3)),
+                    lambda: keep(c, status=I32(ST_DIVERGED)))
+
         def mk_fuse_gca(sub):
             fn = alu2[sub]
 
@@ -1077,6 +2110,10 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 xl, xh = srow(slo, src), srow(shi, src)
                 yl, yh = full(ilo_r[pc]), full(ihi_r[pc])
                 cond, _rh = fn(xl, xh, yl, yh)
+                if optimistic:
+                    t0 = agree_nz(cond)
+                    new_pc = jnp.where(t0 == 0, b_r[pc], pc + 4)
+                    return keep(c, steps=c[0] + 3, pc=new_pc)
                 t0 = scal(cond)
                 agree = allsame(cond, t0)
                 new_pc = jnp.where(t0 == 0, b_r[pc], pc + 4)
@@ -1158,6 +2195,10 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 s1, s2 = fp + ilo_r[pc], fp + ihi_r[pc]
                 cond, _rh = fn(srow(slo, s1), srow(shi, s1),
                                srow(slo, s2), srow(shi, s2))
+                if optimistic:
+                    t0 = agree_nz(cond)
+                    new_pc = jnp.where(t0 == 0, a_r[pc], pc + 4)
+                    return keep(c, steps=c[0] + 3, pc=new_pc)
                 t0 = scal(cond)
                 agree = allsame(cond, t0)
                 new_pc = jnp.where(t0 == 0, a_r[pc], pc + 4)
@@ -1175,8 +2216,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 s1, s2 = fp + ilo_r[pc], fp + ihi_r[pc]
                 cond, _rh = fn(srow(slo, s1), srow(shi, s1),
                                srow(slo, s2), srow(shi, s2))
-                t0 = scal(cond)
-                agree = allsame(cond, t0)
+                t0 = agree_nz(cond) if optimistic else scal(cond)
+                agree = True if optimistic else allsame(cond, t0)
                 tgt, nkeep, pop_to = a_r[pc], b_r[pc], c_r[pc]
                 tgt_sp = ob + pop_to
                 taken = t0 != 0
@@ -1249,8 +2290,25 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                            (yl == -1) & (yh == -1)) \
                         if sub in _DIVS_SUBS else jnp.zeros_like(dz)
                 bad = dz | ovf
-                any_bad = jnp.any(bad)
                 kind = jnp.where(dz, I32(1), jnp.where(ovf, I32(2), I32(0)))
+                if optimistic:
+                    k0 = agree_i32(kind)
+                    code0 = jnp.where(k0 == 1,
+                                      I32(int(ErrCode.DivideByZero)),
+                                      I32(int(ErrCode.IntegerOverflow)))
+
+                    @pl.when(k0 != 0)
+                    def _():
+                        codes = jnp.where(dz[0],
+                                          I32(int(ErrCode.DivideByZero)),
+                                          I32(int(ErrCode.IntegerOverflow)))
+                        trapr[0, :] = jnp.where(bad[0], codes, trapr[0, :])
+
+                    return lax.cond(
+                        k0 != 0,
+                        lambda: keep(c, status=I32(ST_TRAPPED_BASE) + code0),
+                        lambda: keep(c, pc=pc + 1, sp=sp - 1))
+                any_bad = jnp.any(bad)
                 k0 = scal(kind)
                 code0 = jnp.where(k0 == 1, I32(int(ErrCode.DivideByZero)),
                                   I32(int(ErrCode.IntegerOverflow)))
@@ -1284,6 +2342,20 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 if trap_fn is None:
                     return keep(c, pc=pc + 1)
                 bad, codes = trap_fn(wl, wh)
+                if optimistic:
+                    # one canary covers both badness and code agreement
+                    badk = jnp.where(bad, codes, 0)
+                    k0 = agree_i32(badk)
+
+                    @pl.when(k0 != 0)
+                    def _():
+                        trapr[0, :] = jnp.where(bad[0], codes[0],
+                                                trapr[0, :])
+
+                    return lax.cond(
+                        k0 != 0,
+                        lambda: keep(c, status=I32(ST_TRAPPED_BASE) + k0),
+                        lambda: keep(c, pc=pc + 1))
                 any_bad = jnp.any(bad)
                 code0 = scal(codes)
 
@@ -1314,6 +2386,14 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         }
 
         def handler_for(hid):
+            if hid in (H_LOAD_W, H_LOAD_D, H_STORE_W, H_STORE_D):
+                # width-specialized paths exist for the hbm+optimistic
+                # kernel; everywhere else they alias the generic ops
+                if mem_hbm and optimistic:
+                    return {H_LOAD_W: h_load_w, H_LOAD_D: h_load_d,
+                            H_STORE_W: h_store_w,
+                            H_STORE_D: h_store_d}[hid]
+                return h_load if hid in (H_LOAD_W, H_LOAD_D) else h_store
             if hid == H_FUSE_GBR:
                 return h_fuse_gbr
             if hid >= H_FUSE_GGBNZ_BASE:
@@ -1349,16 +2429,100 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             pc = jnp.clip(c[1], 0, code_len - 1)
             nc = lax.switch(hid_r[pc], handlers, c)
             # un-advanced stops rewind the step count (the next engine
-            # re-executes the instruction): divergence and regrow
+            # re-executes the instruction): divergence, regrow, and
+            # optimistic rollbacks (whose steps were already rewound)
             counted = jnp.where((nc[7] == I32(ST_DIVERGED)) |
-                                (nc[7] == I32(ST_REGROW)), I32(0), I32(1))
-            return (nc[0] + counted,) + nc[1:]
+                                (nc[7] == I32(ST_REGROW)) |
+                                (nc[7] == I32(ST_RECHECK)), I32(0), I32(1))
+            nc = (nc[0] + counted,) + nc[1:]
+            if not optimistic:
+                return nc
+            # periodic commit: one canary validation + snapshot per
+            # snap_steps dispatches (the whole point — per-step
+            # cross-lane reductions become per-interval)
+            due = ((nc[0] - nc[IDX["ls"]]) >= I32(snap_steps)) & \
+                (nc[7] == I32(ST_RUNNING))
+
+            @pl.when(due)
+            def _():
+                flag[0] = jnp.any(canr[0, :] != 0).astype(jnp.int32)
+
+            dirty = due & (flag[0] != 0)
+            clean = due & ~dirty
+
+            @pl.when(dirty)
+            def _():
+                do_restore()
+
+            if mem_hbm:
+                # publish dirty windows before the snapshot so the HBM
+                # plane IS the snapshot's memory state
+                @pl.when(clean & (nc[IDX["wd0"]] != 0))
+                def _():
+                    _wb_way0(nc[IDX["wb0"]])
+
+                @pl.when(clean & (nc[IDX["wd1"]] != 0))
+                def _():
+                    _wb_way1(nc[IDX["wb1"]])
+
+            @pl.when(clean)
+            def _():
+                do_snapshot(nc)
+
+            out = []
+            for i, name in enumerate(_CARRY):
+                v = nc[i]
+                if name == "ls":
+                    v = jnp.where(clean, nc[0], v)
+                elif mem_hbm and name in ("wd0", "wd1"):
+                    v = jnp.where(clean, I32(0), v)
+                out.append(v)
+            rolled = rolled_carry()
+            return tuple(jnp.where(dirty, r, v)
+                         for r, v in zip(rolled, out))
 
         init = (I32(0), ctrl_r[blk, _C_PC], ctrl_r[blk, _C_SP],
                 ctrl_r[blk, _C_FP], ctrl_r[blk, _C_OB], ctrl_r[blk, _C_CD],
                 ctrl_r[blk, _C_PAGES], ctrl_r[blk, _C_STATUS])
-        steps, pc, sp, fp, ob, cd, pages, status = \
-            lax.while_loop(cond, body, init)
+        if mem_hbm:
+            # window cache starts invalid each launch (host serving and
+            # SIMT handoffs mutate the HBM plane between launches)
+            init = init + (I32(-(1 << 30)), I32(0),
+                           I32(-(1 << 30)), I32(0), I32(0))
+        if optimistic:
+            init = init + (I32(0),)  # ls: last-snapshot step count
+            # entry state was validated at the previous exit: it IS the
+            # first rollback point
+            canr[0, :] = jnp.zeros((Lblk,), I32)
+            do_snapshot(init)
+        fin = lax.while_loop(cond, body, init)
+        if optimistic:
+            # exit validation: every path out of the loop (chunk/fuel
+            # exhaustion, DONE, trap, park, diverge) must not publish
+            # state built on an unvalidated lane-0 decision
+            flag[0] = jnp.any(canr[0, :] != 0).astype(jnp.int32)
+            pdirty = flag[0] != 0
+
+            @pl.when(pdirty)
+            def _():
+                do_restore()
+
+            rolledf = rolled_carry()
+            fin = tuple(jnp.where(pdirty, r, v)
+                        for r, v in zip(rolledf, fin))
+        steps, pc, sp, fp, ob, cd, pages, status = fin[:8]
+        if mem_hbm:
+            # commit dirty windows so the HBM plane is coherent for the
+            # host/SIMT on every exit path (done, parked, diverged)
+            wb0f, wd0f, wb1f, wd1f = fin[8], fin[9], fin[10], fin[11]
+
+            @pl.when(wd0f != 0)
+            def _():
+                _wb_way0(wb0f)
+
+            @pl.when(wd1f != 0)
+            def _():
+                _wb_way1(wb1f)
         exhausted = (status == I32(ST_RUNNING)) & (steps >= fuel_in)
         status = jnp.where(
             exhausted,
@@ -1389,8 +2553,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 dma(1, shi, s_hi_out.at[:, pl.ds(lo, Lblk)]),
                 dma(2, glo, g_lo_out.at[:, pl.ds(lo, Lblk)]),
                 dma(3, ghi, g_hi_out.at[:, pl.ds(lo, Lblk)]),
-                dma(4, memr, mem_out.at[:, pl.ds(lo, Lblk)]),
                 dma(5, trapr, trap_out.at[:, pl.ds(lo, Lblk)])]
+        if not mem_hbm:
+            outs.append(dma(4, memr, mem_out.at[:, pl.ds(lo, Lblk)]))
         for c in outs:
             c.start()
         for c in outs:
@@ -1399,6 +2564,17 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
     def aspec():
         return pl.BlockSpec(memory_space=pl.ANY)
 
+    # shadow (rollback) plane geometry: full-size whenever the ENGINE
+    # is optimistic (its careful recheck kernel shares the same state
+    # list, so both kernels must declare the same shadow shapes); a
+    # careful-only engine degenerates them to placeholders (no HBM
+    # doubling).
+    if shadow_full is None:
+        shadow_full = optimistic
+    SH_D = D if shadow_full else 1
+    SH_NG = NGp if shadow_full else 1
+    SH_L = L if shadow_full else 1
+    WSH = (W if (not mem_hbm and W > 1) else 1) if shadow_full else 1
     spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=14,
         grid=(nblk,),
@@ -1407,21 +2583,32 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             aspec(), aspec(),                           # stacks (HBM)
             aspec(), aspec(),                           # globals (HBM)
             aspec(), aspec(),                           # mem, trap (HBM)
+            aspec(), aspec(), aspec(), aspec(),         # shadows (HBM)
+            aspec(), aspec(),
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),      # ctrl_out
             pl.BlockSpec(memory_space=pltpu.SMEM),      # frames_out
             aspec(), aspec(), aspec(), aspec(), aspec(), aspec(),
+            aspec(), aspec(), aspec(), aspec(), aspec(), aspec(),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((D, Lblk), jnp.int32),           # slo
-            pltpu.VMEM((D, Lblk), jnp.int32),           # shi
-            pltpu.VMEM((NGp, Lblk), jnp.int32),         # glo
-            pltpu.VMEM((NGp, Lblk), jnp.int32),         # ghi
-            pltpu.VMEM((W, Lblk), jnp.int32),           # memr
-            pltpu.VMEM((1, Lblk), jnp.int32),           # trapr
-            pltpu.SemaphoreType.DMA((6,)),              # sems
-        ],
+        scratch_shapes=(
+            [pltpu.VMEM((D, Lblk), jnp.int32),          # slo
+             pltpu.VMEM((D, Lblk), jnp.int32),          # shi
+             pltpu.VMEM((NGp, Lblk), jnp.int32),        # glo
+             pltpu.VMEM((NGp, Lblk), jnp.int32)]        # ghi
+            + ([pltpu.VMEM((CW, Lblk), jnp.int32),      # mwin0 (way 0)
+                pltpu.VMEM((CW, Lblk), jnp.int32)]      # mwin1 (way 1)
+               if mem_hbm else
+               [pltpu.VMEM((W, Lblk), jnp.int32)])      # memr (resident)
+            + [pltpu.VMEM((1, Lblk), jnp.int32),        # trapr
+               pltpu.SemaphoreType.DMA((8,))]           # sems
+            + ([pltpu.VMEM((1, Lblk), jnp.int32),       # canr (canary)
+                pltpu.SMEM((2,), jnp.int32),            # flag
+                pltpu.SMEM((3, CD), jnp.int32),         # snapf (frames)
+                pltpu.SMEM((16,), jnp.int32)]           # snapc (carry)
+               if optimistic else [])
+        ),
     )
     fn = pl.pallas_call(
         kernel,
@@ -1435,14 +2622,23 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             jax.ShapeDtypeStruct((NGp, L), jnp.int32),      # glob_hi
             jax.ShapeDtypeStruct((W, L), jnp.int32),        # mem
             jax.ShapeDtypeStruct((1, L), jnp.int32),        # trap
+            jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_slo
+            jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_shi
+            jax.ShapeDtypeStruct((SH_NG, SH_L), jnp.int32),  # sh_glo
+            jax.ShapeDtypeStruct((SH_NG, SH_L), jnp.int32),  # sh_ghi
+            jax.ShapeDtypeStruct((1, SH_L), jnp.int32),      # sh_trap
+            jax.ShapeDtypeStruct((WSH, SH_L), jnp.int32),    # sh_mem
         ],
-        # inputs 15..20 (after 14 prefetch args + frames_in) alias outs 2..7
-        input_output_aliases={15: 2, 16: 3, 17: 4, 18: 5, 19: 6, 20: 7},
+        # inputs 15..26 (after 14 prefetch args + frames_in) alias
+        # outs 2..13
+        input_output_aliases={15: 2, 16: 3, 17: 4, 18: 5, 19: 6, 20: 7,
+                              21: 8, 22: 9, 23: 10, 24: 11, 25: 12,
+                              26: 13},
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )
-    return jax.jit(fn, donate_argnums=(15, 16, 17, 18, 19, 20))
+    return jax.jit(fn, donate_argnums=tuple(range(15, 27)))
 
 
 def pallas_enabled(cfg) -> bool:
@@ -1479,6 +2675,14 @@ class PallasUniformEngine:
     # (compare-reduce); cap that scan's size, not W alone — one wasm page
     # is already 16384 words.
     MAX_GATHER_ELEMS = 4 * 1024 * 1024
+    # Window-cache rows per way in mem_hbm mode (2 ways).  128 rows =
+    # 512 B of guest memory per lane per way; misses move CW×Lblk words
+    # over DMA, so sequential access amortizes one miss over ~CW rows.
+    HBM_WINDOW_ROWS = 128
+    # Optimistic-convergence commit interval: dispatches between canary
+    # validations/snapshots.  Bounds both the validation amortization
+    # and the worst-case replay a rollback hands the careful kernel.
+    SNAP_STEPS = 8192
 
     def __init__(self, inst, store=None, conf=None, lanes=None, mesh=None,
                  interpret=None, simt=None):
@@ -1491,11 +2695,15 @@ class PallasUniformEngine:
         self.lanes = self.simt.lanes
         self.img = self.simt.img
         self.interpret = interpret
+        opt = getattr(self.cfg, "optimistic", None)
+        self.optimistic = True if opt is None else bool(opt)
         self._fn = None
+        self._fn_careful_cache = None
         self._tables = None
         self._blk_cap = None  # lane-block ceiling (multi-tenant alignment)
         self.fell_back_to_simt = False
         self.splits = 0  # block-scheduler split count from the last run()
+        self.recheck_rounds = 0  # careful-kernel rounds (optimistic mode)
         # None = no tpu.aot fused section attached; set by _build when a
         # loaded artifact carries one (True = matched regeneration)
         self.aot_fused_verified = None
@@ -1532,12 +2740,14 @@ class PallasUniformEngine:
             return 1
         return max(img.mem_pages_init, 1) * _PAGE_WORDS
 
-    def _lane_block(self) -> Optional[int]:
-        """Largest power-of-two lane block whose state fits the budget."""
+    def _state_bytes_per_lane(self, mem_hbm: bool) -> int:
         D, CD = self._depths()
-        W = self._mem_words()
         NGp = max(self.img.globals_lo.shape[0], 1)
-        per_lane = 4 * (2 * D + 2 * NGp + W + 1)
+        memw = 2 * self.HBM_WINDOW_ROWS if mem_hbm else self._mem_words()
+        return 4 * (2 * D + 2 * NGp + memw + 1)
+
+    def _blk_for(self, per_lane: int) -> Optional[int]:
+        """Largest power-of-two lane block whose state fits the budget."""
         # Mosaic requires lane-dim slices aligned to the 128-lane tiling;
         # interpret mode (CPU tests) has no such constraint.
         align = 1 if self._interpret() else 128
@@ -1556,6 +2766,29 @@ class PallasUniformEngine:
         if bad(blk):
             return None
         return blk
+
+    def _mem_mode(self) -> bool:
+        """True when the kernel should keep the memory plane HBM-resident
+        behind the window cache (bigger lane blocks, DMA on window miss)
+        instead of staging the whole [W, Lblk] slab into VMEM scratch
+        (zero-latency access, 128-ish lane blocks).  Auto rule: pick HBM
+        whenever it strictly enlarges the lane block; cfg.mem_hbm forces
+        either way (tests, experiments)."""
+        if not self.img.has_memory:
+            return False
+        if self._mem_words() < self.HBM_WINDOW_ROWS:
+            return False
+        blk_hbm = self._blk_for(self._state_bytes_per_lane(True))
+        forced = getattr(self.cfg, "mem_hbm", None)
+        if forced is not None:
+            return bool(forced) and blk_hbm is not None
+        if blk_hbm is None:
+            return False
+        blk_res = self._blk_for(self._state_bytes_per_lane(False))
+        return blk_res is None or blk_hbm > blk_res
+
+    def _lane_block(self) -> Optional[int]:
+        return self._blk_for(self._state_bytes_per_lane(self._mem_mode()))
 
     def _eligibility(self) -> Optional[str]:
         img = self.img
@@ -1617,16 +2850,135 @@ class PallasUniformEngine:
         pages_cap = W // _PAGE_WORDS if img.has_memory else 0
         pages_hard = max(img.mem_pages_max, img.mem_pages_init) \
             if img.has_memory else 0
+        mem_hbm = self._mem_mode()
         self._geom = (D, CD, W, Lblk)
-        self._fn = _build_kernel(
+        self._kargs = (
             used, D, CD, W, self.lanes, Lblk, NG, img.code_len,
             len(img.f_entry), img.table0.shape[0],
             img.max_local_zeros, pages_cap, pages_hard,
-            W * Lblk <= self.MAX_GATHER_ELEMS, interpret)
+            (not mem_hbm) and W * Lblk <= self.MAX_GATHER_ELEMS,
+            interpret, mem_hbm,
+            self.HBM_WINDOW_ROWS if mem_hbm else 0)
         self._tables = tuple(jnp.asarray(t) for t in (
             hid_dense, a_p, b_p, c_p, ilo_p, ihi_p,
             img.f_entry, img.f_nparams, img.f_nlocals, img.f_frame_top,
             img.f_type, img.br_table.reshape(-1), img.table0))
+        self._fn = self._with_export_cache(
+            lambda: _build_kernel(*self._kargs,
+                                  optimistic=self.optimistic,
+                                  snap_steps=self.SNAP_STEPS,
+                                  shadow_full=self.optimistic))
+        self._fn_careful_cache = None if self.optimistic else self._fn
+
+    def _export_cache_key(self):
+        """Content key for the serialized compiled kernel: geometry +
+        fused-plane hash + backend + jax version (the reference keys its
+        AOT cache on the wasm bytes, lib/aot/cache.cpp:36-61; here the
+        kernel is a function of the fused encoding and geometry)."""
+        import hashlib
+
+        import jax
+
+        h = hashlib.sha256()
+        h.update(repr(self._kargs).encode())
+        h.update(repr((self.optimistic, self.SNAP_STEPS)).encode())
+        for k in ("hid", "a", "b", "c", "ilo", "ihi"):
+            h.update(np.ascontiguousarray(self._np_fused[k]).tobytes())
+        h.update(jax.__version__.encode())
+        h.update(jax.default_backend().encode())
+        return h.hexdigest()
+
+    def _with_export_cache(self, build):
+        """Warm-start path: persist the traced+lowered kernel via
+        jax.export so a fresh process skips Python/Pallas tracing (the
+        ~2s `engine_build` phase in AOT_r04.json); XLA's persistent
+        compilation cache already covers the compile itself.  Any
+        failure falls back to a plain build — the cache is an
+        optimization, never a correctness dependency."""
+        import os
+
+        if self._interpret():
+            return build()  # interpret mode: nothing worth persisting
+        try:
+            import jax
+            import jax.export as jexport
+
+            from wasmedge_tpu.aot import cache_dir
+
+            d = os.path.join(cache_dir(), "kexport")
+            path = os.path.join(d, self._export_cache_key() + ".bin")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    exp = jexport.deserialize(bytearray(f.read()))
+                return exp.call
+            fn = build()
+            specs = self._arg_specs()
+            exp = jexport.export(fn)(*specs)
+            os.makedirs(d, exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(exp.serialize())
+            os.replace(tmp, path)
+            return exp.call
+        except Exception:
+            return build()
+
+    def _arg_specs(self):
+        """ShapeDtypeStructs matching (tables..., ctrl, frames, state)."""
+        import jax
+
+        D, CD, W, Lblk = self._geom
+        L = self.lanes
+        nblk = L // Lblk
+        NGp = max(self.img.globals_lo.shape[0], 1)
+        mem_hbm = self._mem_mode()
+        wsh = (W if (not mem_hbm and W > 1) else 1) if self.optimistic \
+            else 1
+        i32 = jax.ShapeDtypeStruct
+        import numpy as _np
+
+        specs = [i32(t.shape, t.dtype) for t in self._tables]
+        specs += [i32((nblk, 16), _np.int32),
+                  i32((nblk, 3, CD), _np.int32),
+                  i32((D, L), _np.int32), i32((D, L), _np.int32),
+                  i32((NGp, L), _np.int32), i32((NGp, L), _np.int32),
+                  i32((W, L), _np.int32), i32((1, L), _np.int32)]
+        sh_l = L if self.optimistic else 1
+        sh_d = D if self.optimistic else 1
+        sh_ng = NGp if self.optimistic else 1
+        specs += [i32((sh_d, sh_l), _np.int32),
+                  i32((sh_d, sh_l), _np.int32),
+                  i32((sh_ng, sh_l), _np.int32),
+                  i32((sh_ng, sh_l), _np.int32),
+                  i32((1, sh_l), _np.int32), i32((wsh, sh_l), _np.int32)]
+        return specs
+
+    def _fn_careful(self):
+        """The non-optimistic kernel, compiled lazily on the first
+        ST_RECHECK (most runs never diverge and never pay the compile)."""
+        if self._fn_careful_cache is None:
+            self._fn_careful_cache = _build_kernel(
+                *self._kargs, optimistic=False,
+                snap_steps=self.SNAP_STEPS, shadow_full=self.optimistic)
+        return self._fn_careful_cache
+
+    def shadow_planes(self):
+        """Fresh rollback-shadow planes matching this geometry (appended
+        to the kernel state list; contents only matter intra-launch)."""
+        import jax.numpy as jnp
+
+        D, CD, W, Lblk = self._geom
+        z = jnp.zeros
+        if not self.optimistic:
+            # careful-only kernel: placeholder shadows
+            return [z((1, 1), jnp.int32) for _ in range(5)] + \
+                [z((1, 1), jnp.int32)]
+        L = self.lanes
+        NGp = max(self.img.globals_lo.shape[0], 1)
+        wsh = W if (not self._mem_mode() and W > 1) else 1
+        return [z((D, L), jnp.int32), z((D, L), jnp.int32),
+                z((NGp, L), jnp.int32), z((NGp, L), jnp.int32),
+                z((1, L), jnp.int32), z((wsh, L), jnp.int32)]
 
     # -- state ------------------------------------------------------------
     def _from_simt_state(self, simt_state):
@@ -1696,7 +3048,7 @@ class PallasUniformEngine:
         return [jnp.asarray(ctrl), jnp.zeros((nblk, 3, CD), jnp.int32),
                 jnp.asarray(stack_lo), jnp.asarray(stack_hi),
                 jnp.asarray(glo[:NGp]), jnp.asarray(ghi[:NGp]),
-                jnp.asarray(mem), jnp.asarray(trap)]
+                jnp.asarray(mem), jnp.asarray(trap)] + self.shadow_planes()
 
     def run_blocks(self, simt_state, max_steps: int = 10_000_000):
         """Run from a block-uniform SIMT state; returns (simt_state,
@@ -1723,6 +3075,10 @@ class PallasUniformEngine:
             ctrl_np = np.asarray(state[0])
             steps_per_block += ctrl_np[:, _C_STEPS].astype(np.int64)
             statuses = ctrl_np[:, _C_STATUS]
+            if (statuses == ST_RECHECK).any():
+                state, ctrl_np = self._run_recheck(state, ctrl_np)
+                steps_per_block += ctrl_np[:, _C_STEPS].astype(np.int64)
+                statuses = ctrl_np[:, _C_STATUS]
             if (statuses == ST_HOSTCALL).any() and \
                     int(steps_per_block.max()) < max_steps:
                 state = self._serve_hostcalls(state, ctrl_np)
@@ -1731,6 +3087,41 @@ class PallasUniformEngine:
                     int(steps_per_block.max()) < max_steps:
                 continue
             return state, steps_per_block, statuses
+
+    def careful_recheck(self, state, ctrl_np, recheck_mask):
+        """ONE recheck protocol for both drive paths (engine._drive and
+        BlockScheduler): re-run ST_RECHECK blocks on the careful kernel
+        for one short chunk.  An optimistic rollback rewound them to
+        their last validated snapshot; exact per-step checking reaches
+        the divergent instruction and stops there with the precise
+        status (DIVERGED/trap/...), after which normal handling
+        proceeds.  Non-recheck blocks get chunk=0 (zero steps, state
+        untouched).  Returns (state, ctrl_np) with saved chunk restored
+        and non-recheck step counts zeroed so callers' accounting is
+        exact."""
+        import jax.numpy as jnp
+
+        self.recheck_rounds += 1
+        ctrl = ctrl_np.copy()
+        saved_chunk = ctrl[:, _C_CHUNK].copy()
+        ctrl[:, _C_CHUNK] = np.where(recheck_mask, self.SNAP_STEPS + 64, 0)
+        ctrl[:, _C_STATUS] = np.where(recheck_mask, ST_RUNNING,
+                                      ctrl[:, _C_STATUS])
+        state[0] = jnp.asarray(ctrl)
+        out = self._fn_careful()(*self._tables, state[0], state[1],
+                                 *state[2:])
+        state = list(out)
+        ctrl = np.asarray(state[0]).copy()
+        ctrl[:, _C_CHUNK] = saved_chunk
+        # blocks that ran clean past the divergence window resume
+        # optimistic on the next launch
+        ctrl[:, _C_STEPS] = np.where(recheck_mask, ctrl[:, _C_STEPS], 0)
+        state[0] = jnp.asarray(ctrl)
+        return state, ctrl
+
+    def _run_recheck(self, state, ctrl_np):
+        recheck = ctrl_np[:, _C_STATUS] == ST_RECHECK
+        return self.careful_recheck(state, ctrl_np, recheck)
 
     def _to_simt_state(self, state, steps_per_block):
         """Expand per-block scalars to the SIMT engine's per-lane layout."""
@@ -1816,6 +3207,7 @@ class PallasUniformEngine:
         sched.run()
         self.fell_back_to_simt = sched.fell_back_to_simt
         self.splits = sched.splits
+        self.recheck_rounds = sched.eng.recheck_rounds
         self.aot_fused_verified = sched.eng.aot_fused_verified
         return sched.result()
 

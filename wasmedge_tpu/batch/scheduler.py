@@ -59,6 +59,7 @@ from wasmedge_tpu.batch.pallas_engine import (
     ST_DIVERGED,
     ST_DONE,
     ST_HOSTCALL,
+    ST_RECHECK,
     ST_REGROW,
     ST_RUNNING,
     ST_TRAPPED_BASE,
@@ -390,7 +391,7 @@ class BlockScheduler:
         self.state = [jnp.asarray(ctrl),
                       jnp.zeros((self.nblk, 3, CD), jnp.int32),
                       stack_lo, stack_hi, glo, ghi, mem,
-                      jnp.zeros((1, L), jnp.int32)]
+                      jnp.zeros((1, L), jnp.int32)] + eng.shadow_planes()
 
     # -- drive -------------------------------------------------------------
     def run(self):
@@ -453,6 +454,8 @@ class BlockScheduler:
             live = self._live_at_launch
             new_steps = ctrl_np[:, _C_STEPS].astype(np.int64)
             self.block_steps[live] += new_steps[live]
+            if (live & (ctrl_np[:, _C_STATUS] == ST_RECHECK)).any():
+                ctrl_np = self._run_recheck(live)
             self._handle_statuses(ctrl_np)
             return True
         if self._handle_statuses(ctrl_np):
@@ -462,6 +465,24 @@ class BlockScheduler:
             self._simt_queue.append(p)
         self._pending = []
         return False
+
+    def _run_recheck(self, live) -> np.ndarray:
+        """Re-run ST_RECHECK blocks on the careful kernel (synchronous)
+        via the engine's shared careful_recheck protocol, then stops
+        with the precise status which _handle_statuses splits/serves."""
+        import jax.numpy as jnp
+
+        recheck = live & (self._ctrl()[:, _C_STATUS] == ST_RECHECK)
+        if self._frames_dirty:
+            self.state[1] = jnp.asarray(self._frames_cache)
+            self._frames_dirty = False
+        self.state, ctrl = self.eng.careful_recheck(
+            self.state, self._ctrl(), recheck)
+        self.block_steps += ctrl[:, _C_STEPS].astype(np.int64)
+        self._ctrl_cache = ctrl
+        self._ctrl_dirty = False
+        self._frames_cache = None
+        return ctrl
 
     def _handle_statuses(self, ctrl_np) -> bool:
         """Harvest/serve/split each live block by its status.  Returns

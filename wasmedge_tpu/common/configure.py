@@ -92,6 +92,31 @@ class BatchConfigure:
     # backend is TPU and the module fits the kernel's geometry), True =
     # force (interpret-mode on CPU), False = always per-step XLA.
     use_pallas: Optional[bool] = None
+    # Pallas linear-memory placement: None = auto (HBM-resident plane +
+    # VMEM window cache whenever that enlarges the lane block), True/False
+    # force.  Only meaningful for modules with a memory.
+    mem_hbm: Optional[bool] = None
+    # Optimistic convergence (lane-0 decisions + canary validation at
+    # commit points instead of per-instruction cross-lane reductions).
+    # None = on; False forces the per-step-checked ("careful") kernel.
+    optimistic: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class CompilerConfigure:
+    """AOT-compiler knobs (reference: CompilerConfigure,
+    include/common/configure.h:28-106).  The optimization level and
+    native-output knobs are accepted for API parity; the tpu.aot
+    artifact path (wasmedge_tpu.aot) is the compiler they configure —
+    its universal artifact corresponds to OutputFormat "Universal", and
+    "Native" has no TPU analog (XLA owns native codegen), so setting it
+    is recorded but compile_module always emits universal twasm."""
+
+    optimization_level: str = "O3"   # O0|O1|O2|O3|Os|Oz
+    output_format: str = "Universal"  # Universal | Native
+    dump_ir: bool = False
+    generic_binary: bool = False
+    interruptible: bool = False
 
 
 @dataclasses.dataclass
@@ -102,6 +127,7 @@ class Configure:
     runtime: RuntimeConfigure = dataclasses.field(default_factory=RuntimeConfigure)
     statistics: StatisticsConfigure = dataclasses.field(default_factory=StatisticsConfigure)
     batch: BatchConfigure = dataclasses.field(default_factory=BatchConfigure)
+    compiler: CompilerConfigure = dataclasses.field(default_factory=CompilerConfigure)
 
     def add_proposal(self, p: Proposal) -> "Configure":
         self.proposals.add(p)

@@ -361,7 +361,11 @@ def scalar_fib_ops_per_sec(n: int) -> float:
         raise RuntimeError(f"fib not native-eligible: {nm.reason}")
     lib = _build_lib()
     func_idx = inst.exports["fib"][1]
-    ops = lib.we_native_selfbench(*nm._img_args(lib), func_idx, n)
+    # best of three: the baseline is "one dedicated CPU core"; taking
+    # the max keeps the denominator honest when the host is busy (a
+    # slow contended run would otherwise inflate every vs_baseline)
+    ops = max(lib.we_native_selfbench(*nm._img_args(lib), func_idx, n)
+              for _ in range(3))
     if ops <= 0:
         raise RuntimeError("native selfbench failed")
     return ops

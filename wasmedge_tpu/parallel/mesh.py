@@ -55,7 +55,7 @@ def shard_batch_state(state, mesh):
 
 def run_pallas_sharded(inst, store, conf, func_name, args_lanes,
                        devices=None, max_steps: int = 10_000_000,
-                       interpret=None):
+                       interpret=None, threaded: bool = True):
     """Run the Pallas warp-interpreter sharded across devices.
 
     Wasm instances are share-nothing, so multi-chip Pallas execution is
@@ -100,21 +100,48 @@ def run_pallas_sharded(inst, store, conf, func_name, args_lanes,
             scheds.append((dev, BlockScheduler(
                 eng, func_name, [a[sl] for a in args], max_steps)))
 
-    active = list(scheds)
-    while active:
-        for dev, s in active:
+    if threaded:
+        # one host thread per device: device kernels already overlap
+        # via async dispatch, threading additionally overlaps the
+        # HOST-side work (ctrl mirrors, outcall serving, divergence
+        # splitting) across devices — jax.default_device is
+        # thread-local, so each thread pins its own device
+        import threading
+
+        errs = []
+
+        def drive(dev, s):
+            try:
+                with jax.default_device(dev):
+                    s.run()   # includes the SIMT residue pass
+            except Exception as e:  # noqa: BLE001
+                errs.append((dev, e))
+
+        ts = [threading.Thread(target=drive, args=(dev, s), daemon=True)
+              for dev, s in scheds]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise RuntimeError(f"sharded drive failed: {errs[0][1]!r} "
+                               f"on {errs[0][0]}") from errs[0][1]
+    else:
+        active = list(scheds)
+        while active:
+            for dev, s in active:
+                with jax.default_device(dev):
+                    s.launch()
+            done = []
+            for dev, s in active:
+                with jax.default_device(dev):
+                    if not s.process():
+                        done.append((dev, s))
+            for d in done:
+                active.remove(d)
+        for dev, s in scheds:
             with jax.default_device(dev):
-                s.launch()
-        done = []
-        for dev, s in active:
-            with jax.default_device(dev):
-                if not s.process():
-                    done.append((dev, s))
-        for d in done:
-            active.remove(d)
-    for dev, s in scheds:
-        with jax.default_device(dev):
-            s._run_simt_residue()
+                s._run_simt_residue()
 
     results = [s.result() for _, s in scheds]
     nres = len(results[0].results)
