@@ -87,17 +87,112 @@ def rand_args(seed):
             rng.integers(-2**63, 2**63 - 1, LANES, np.int64)]
 
 
-def test_v2_family_parity():
-    bodies = [[("local.get", 2), ("local.get", 5), op] for op in V2_NAMES]
+# f32 arithmetic on the batch path inherits the scalar batch ALU's one
+# documented divergence: XLA flushes f32 subnormals (the spec corpus
+# likewise skips 'subnormal' files for the batched run).  Random 64-bit
+# patterns hit that, so these ops are parity-checked with normal-range
+# float inputs in test_float_family_parity instead.
+_F32_FTZ_SENSITIVE = {"f32x4.add", "f32x4.sub", "f32x4.mul", "f32x4.div",
+                      "f32x4.sqrt", "f32x4.demote_f64x2_zero"}
+
+
+# The family sweeps are CHUNKED: one module per ~20 ops.  A single
+# module chaining all ~230 ops makes one enormous XLA step function
+# (the f64 softfloat subgraphs alone are huge) whose compile dominates
+# the suite; smaller modules compile in seconds each.
+_CHUNK = 20
+
+
+def _chunks(names):
+    names = [n for n in names if n not in _F32_FTZ_SENSITIVE]
+    return [names[i:i + _CHUNK] for i in range(0, len(names), _CHUNK)]
+
+
+@pytest.mark.parametrize("ops", _chunks(V2_NAMES),
+                         ids=lambda c: c[0].replace(".", "_"))
+def test_v2_family_parity(ops):
+    bodies = [[("local.get", 2), ("local.get", 5), op] for op in ops]
     check_parity(build_sweep(bodies), rand_args(1))
 
 
-def test_v1_and_test_family_parity():
-    bodies = [[("local.get", 2), op] for op in V1_NAMES]
+@pytest.mark.parametrize("ops", _chunks(V1_NAMES),
+                         ids=lambda c: c[0].replace(".", "_"))
+def test_v1_family_parity(ops):
+    bodies = [[("local.get", 2), op] for op in ops]
+    check_parity(build_sweep(bodies), rand_args(2))
+
+
+def test_vtest_family_parity():
     # vtest produce i32: wrap into a splat so fold() sees a v128
-    bodies += [[("local.get", 2), op, "i32x4.splat"] for op in VTEST_NAMES]
+    bodies = [[("local.get", 2), op, "i32x4.splat"] for op in VTEST_NAMES]
     bodies += [[("local.get", 5), op, "i32x4.splat"] for op in VTEST_NAMES]
     check_parity(build_sweep(bodies), rand_args(2))
+
+
+def _float_args(seed, f64=False):
+    """i64 lane args packing normal-range floats (exponents near 1.0):
+    no subnormal inputs and no subnormal-producing products/sums."""
+    rng = np.random.default_rng(seed)
+    if f64:
+        vals = rng.uniform(-8.0, 8.0, LANES)
+        vals[vals == 0] = 1.5
+        return [np.asarray([np.float64(v).view(np.int64) for v in vals],
+                           np.int64)]
+    lo = np.asarray([np.float32(v).view(np.int32) for v in
+                     rng.uniform(-8.0, 8.0, LANES)], np.int64) & 0xFFFFFFFF
+    hi = np.asarray([np.float32(v).view(np.int32) for v in
+                     rng.uniform(0.1, 4.0, LANES)], np.int64) & 0xFFFFFFFF
+    return [lo | (hi << 32)]
+
+
+def build_float_sweep(op_bodies):
+    """Like build_sweep but v128 locals are built WITHOUT bit scrambling
+    (splat keeps the packed normal floats intact)."""
+    b = ModuleBuilder()
+    body = [
+        ("local.get", 0), "i64x2.splat", ("local.set", 2),
+        ("local.get", 1), "i64x2.splat", ("local.set", 5),
+    ]
+    for op_body in op_bodies:
+        body += fold(4, op_body)
+    body += [("local.get", 4)]
+    b.add_function(["i64", "i64"], ["i64"], ["v128", "v128", "i64", "v128"],
+                   body, export="f")
+    return b.build()
+
+
+def test_float_f32_family_parity():
+    """Every f32x4 op (incl. the FTZ-sensitive arithmetic) with
+    normal-range inputs, bit-exact against the scalar oracle."""
+    f32_v2 = [n for n in V2_NAMES if n.startswith("f32x4.")]
+    f32_v1 = [n for n in V1_NAMES if n.startswith("f32x4.")
+              and "convert" not in n and "demote" not in n]
+    bodies = [[("local.get", 2), ("local.get", 5), op]
+              for op in f32_v2]
+    bodies += [[("local.get", 2), op] for op in f32_v1]
+    bodies += [[("local.get", 2), "f64x2.promote_low_f32x4",
+                "f32x4.demote_f64x2_zero"]]
+    a32 = _float_args(11)[0]
+    b32 = _float_args(12)[0]
+    check_parity(build_float_sweep(bodies), [a32, b32])
+
+
+@pytest.mark.parametrize("half", [0, 1])
+def test_float_f64_family_parity(half):
+    f64_v2 = [n for n in V2_NAMES if n.startswith("f64x2.")]
+    f64_v1 = [n for n in V1_NAMES if n.startswith("f64x2.")
+              and "convert" not in n and "promote" not in n]
+    ops = (f64_v2 + f64_v1)
+    ops = ops[:len(ops) // 2] if half == 0 else ops[len(ops) // 2:]
+    bodies = []
+    for op in ops:
+        if op in {n for n in V2_NAMES}:
+            bodies.append([("local.get", 2), ("local.get", 5), op])
+        else:
+            bodies.append([("local.get", 2), op])
+    a64 = _float_args(13, f64=True)[0]
+    b64 = _float_args(14, f64=True)[0]
+    check_parity(build_float_sweep(bodies), [a64, b64])
 
 
 def test_shift_and_splat_family_parity():
@@ -109,6 +204,11 @@ def test_shift_and_splat_family_parity():
     for op in VSPLAT_NAMES:
         if op.startswith("i64x2"):
             bodies.append([("local.get", 0), op])
+        elif op.startswith("f64x2"):
+            bodies.append([("local.get", 0), "f64.reinterpret_i64", op])
+        elif op.startswith("f32x4"):
+            bodies.append([("local.get", 0), "i32.wrap_i64",
+                           "f32.reinterpret_i32", op])
         else:
             bodies.append([("local.get", 0), "i32.wrap_i64", op])
     check_parity(build_sweep(bodies), rand_args(3))

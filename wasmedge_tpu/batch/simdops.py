@@ -10,9 +10,14 @@ native byte ALU but branch-free and bit-exact, which is what the
 batched path needs (the reference's v128 section:
 /root/reference/lib/executor/engine/engine.cpp ~700-1610).
 
-Only the integer families are implemented; float f32x4/f64x2 arithmetic
-and the narrowing/widening/saturating-multiply extensions stay gated to
-the scalar engine (batch/image.py batchability)."""
+Float f32x4/f64x2 families reuse the scalar batch ALU kernels
+(laneops alu2/alu1: native float32 with canonical-NaN wrapping for f32,
+the bit-exact softfloat binary64 kernels for f64) applied per plane /
+per plane-pair, so vector float semantics are identical to the scalar
+batch path by construction.  The narrowing / widening / extended
+multiply / pairwise-add integer extensions operate on the packed words
+directly (reference v128 section:
+/root/reference/lib/executor/engine/engine.cpp ~700-1610)."""
 
 from __future__ import annotations
 
@@ -25,6 +30,10 @@ _ICMP = ["eq", "ne", "lt_s", "lt_u", "gt_s", "gt_u", "le_s", "le_u",
          "ge_s", "ge_u"]
 _ICMP_S = ["eq", "ne", "lt_s", "gt_s", "le_s", "ge_s"]  # i64x2 set
 
+_FBIN = ["add", "sub", "mul", "div", "min", "max", "pmin", "pmax",
+         "eq", "ne", "lt", "gt", "le", "ge"]
+_FUN = ["abs", "neg", "sqrt", "ceil", "floor", "trunc", "nearest"]
+
 V2_NAMES: List[str] = (
     ["v128.and", "v128.or", "v128.xor", "v128.andnot"]
     + [f"i8x16.{n}" for n in
@@ -36,11 +45,38 @@ V2_NAMES: List[str] = (
     + [f"i32x4.{n}" for n in
        ["add", "sub", "mul", "min_s", "min_u", "max_s", "max_u"] + _ICMP]
     + [f"i64x2.{n}" for n in ["add", "sub", "mul"] + _ICMP_S]
+    # appended families keep earlier sub ids stable:
+    + [f"f32x4.{n}" for n in _FBIN]
+    + [f"f64x2.{n}" for n in _FBIN]
+    + ["i8x16.narrow_i16x8_s", "i8x16.narrow_i16x8_u",
+       "i16x8.narrow_i32x4_s", "i16x8.narrow_i32x4_u",
+       "i16x8.q15mulr_sat_s", "i32x4.dot_i16x8_s"]
+    + [f"i16x8.extmul_{p}_i8x16_{s}"
+       for p in ("low", "high") for s in ("s", "u")]
+    + [f"i32x4.extmul_{p}_i16x8_{s}"
+       for p in ("low", "high") for s in ("s", "u")]
+    + [f"i64x2.extmul_{p}_i32x4_{s}"
+       for p in ("low", "high") for s in ("s", "u")]
 )
 V1_NAMES: List[str] = (
     ["v128.not", "i8x16.abs", "i8x16.neg", "i8x16.popcnt",
      "i16x8.abs", "i16x8.neg", "i32x4.abs", "i32x4.neg",
      "i64x2.abs", "i64x2.neg"]
+    + [f"f32x4.{n}" for n in _FUN]
+    + [f"f64x2.{n}" for n in _FUN]
+    + ["i32x4.trunc_sat_f32x4_s", "i32x4.trunc_sat_f32x4_u",
+       "f32x4.convert_i32x4_s", "f32x4.convert_i32x4_u",
+       "i32x4.trunc_sat_f64x2_s_zero", "i32x4.trunc_sat_f64x2_u_zero",
+       "f64x2.convert_low_i32x4_s", "f64x2.convert_low_i32x4_u",
+       "f32x4.demote_f64x2_zero", "f64x2.promote_low_f32x4"]
+    + [f"i16x8.extend_{p}_i8x16_{s}"
+       for p in ("low", "high") for s in ("s", "u")]
+    + [f"i32x4.extend_{p}_i16x8_{s}"
+       for p in ("low", "high") for s in ("s", "u")]
+    + [f"i64x2.extend_{p}_i32x4_{s}"
+       for p in ("low", "high") for s in ("s", "u")]
+    + ["i16x8.extadd_pairwise_i8x16_s", "i16x8.extadd_pairwise_i8x16_u",
+       "i32x4.extadd_pairwise_i16x8_s", "i32x4.extadd_pairwise_i16x8_u"]
 )
 VTEST_NAMES: List[str] = (
     ["v128.any_true"]
@@ -51,13 +87,16 @@ VSHIFT_NAMES: List[str] = [
     f"{s}.{k}" for s in ("i8x16", "i16x8", "i32x4", "i64x2")
     for k in ("shl", "shr_s", "shr_u")]
 VSPLAT_NAMES: List[str] = [f"{s}.splat" for s in
-                           ("i8x16", "i16x8", "i32x4", "i64x2")]
+                           ("i8x16", "i16x8", "i32x4", "i64x2",
+                            "f32x4", "f64x2")]
 VEXTRACT_NAMES: List[str] = [
     "i8x16.extract_lane_s", "i8x16.extract_lane_u",
     "i16x8.extract_lane_s", "i16x8.extract_lane_u",
-    "i32x4.extract_lane", "i64x2.extract_lane"]
+    "i32x4.extract_lane", "i64x2.extract_lane",
+    "f32x4.extract_lane", "f64x2.extract_lane"]
 VREPLACE_NAMES: List[str] = [f"{s}.replace_lane" for s in
-                             ("i8x16", "i16x8", "i32x4", "i64x2")]
+                             ("i8x16", "i16x8", "i32x4", "i64x2",
+                              "f32x4", "f64x2")]
 
 V2_SUB = {n: i for i, n in enumerate(V2_NAMES)}
 V1_SUB = {n: i for i, n in enumerate(V1_NAMES)}
@@ -202,12 +241,162 @@ def _signedness(name: str) -> bool:
     return True
 
 
+def _v2_float(px: str, op: str):
+    """f32x4/f64x2 binary ops, built on the scalar batch ALU kernels
+    (laneops alu2: canonical-NaN float32 for f32, softfloat binary64 for
+    f64) so vector float semantics equal the scalar batch path by
+    construction.  Comparisons widen the scalar 0/1 result to the
+    all-ones element mask v128 comparisons produce."""
+    jnp, lax = _j()
+    from wasmedge_tpu.batch import laneops as lo_ops
+    from wasmedge_tpu.batch.image import (
+        ALU2_F32_BASE, ALU2_F64_BASE, _F32_BIN, _F64_BIN)
+
+    alu2 = lo_ops.alu2_fns()
+    cmps = ("eq", "ne", "lt", "gt", "le", "ge")
+    if px == "f32x4":
+        base, bins = ALU2_F32_BASE, _F32_BIN
+        if op in ("pmin", "pmax"):
+            lt = alu2[base + bins.index("lt")]
+
+            def pm(x, y, op=op):
+                out = []
+                for a, b in zip(x, y):
+                    z = jnp.zeros_like(a)
+                    # pmin: b < a ? b : a; pmax: a < b ? b : a
+                    c, _ = lt(b, z, a, z) if op == "pmin" else lt(a, z, b, z)
+                    out.append(jnp.where(c != 0, b, a))
+                return tuple(out)
+            return pm
+        fn = alu2[base + bins.index(op)]
+        mask = op in cmps
+
+        def per_word(x, y):
+            out = []
+            for a, b in zip(x, y):
+                rl, _ = fn(a, jnp.zeros_like(a), b, jnp.zeros_like(b))
+                out.append(jnp.where(rl != 0, jnp.int32(-1), jnp.int32(0))
+                           if mask else rl)
+            return tuple(out)
+        return per_word
+    base, bins = ALU2_F64_BASE, _F64_BIN
+    if op in ("pmin", "pmax"):
+        lt = alu2[base + bins.index("lt")]
+
+        def pm64(x, y, op=op):
+            r = []
+            for k in (0, 2):
+                al, ah, bl, bh = x[k], x[k + 1], y[k], y[k + 1]
+                c, _ = (lt(bl, bh, al, ah) if op == "pmin"
+                        else lt(al, ah, bl, bh))
+                r.append(jnp.where(c != 0, bl, al))
+                r.append(jnp.where(c != 0, bh, ah))
+            return tuple(r)
+        return pm64
+    fn = alu2[base + bins.index(op)]
+    mask = op in cmps
+
+    def bin64(x, y):
+        r = []
+        for k in (0, 2):
+            rl, rh = fn(x[k], x[k + 1], y[k], y[k + 1])
+            if mask:
+                m = jnp.where(rl != 0, jnp.int32(-1), jnp.int32(0))
+                rl = rh = m
+            r.extend((rl, rh))
+        return tuple(r)
+    return bin64
+
+
+def _v2_intext(name: str):
+    """Narrowing / q15 / dot / extended-multiply integer extensions."""
+    jnp, lax = _j()
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    if name.startswith("i8x16.narrow_i16x8"):
+        lo_, hi_ = (-128, 127) if name.endswith("_s") else (0, 255)
+
+        def nar(x, y):
+            hs = [h for w in x for h in _halves(w, True)] + \
+                 [h for w in y for h in _halves(w, True)]
+            bs = [_sat(h, lo_, hi_) for h in hs]
+            return tuple(_pack_bytes(bs[4 * k:4 * k + 4]) for k in range(4))
+        return nar
+    if name.startswith("i16x8.narrow_i32x4"):
+        lo_, hi_ = (-32768, 32767) if name.endswith("_s") else (0, 65535)
+
+        def nar(x, y):
+            ws = [_sat(w, lo_, hi_) for w in list(x) + list(y)]
+            return tuple(_pack_halves([ws[2 * k], ws[2 * k + 1]])
+                         for k in range(4))
+        return nar
+    if name == "i16x8.q15mulr_sat_s":
+        def q15(x, y):
+            out = []
+            for a, b in zip(x, y):
+                rs = [_sat(lax.shift_right_arithmetic(p * q + 0x4000, 15),
+                           -32768, 32767)
+                      for p, q in zip(_halves(a, True), _halves(b, True))]
+                out.append(_pack_halves(rs))
+            return tuple(out)
+        return q15
+    if name == "i32x4.dot_i16x8_s":
+        def dot(x, y):
+            out = []
+            for a, b in zip(x, y):
+                ha, hb = _halves(a, True), _halves(b, True)
+                out.append(ha[0] * hb[0] + ha[1] * hb[1])
+            return tuple(out)
+        return dot
+    if ".extmul_" not in name:
+        return None
+    px, rest = name.split(".", 1)
+    parts = rest.split("_")          # extmul, low|high, <src>, s|u
+    low = parts[1] == "low"
+    signed = parts[-1] == "s"
+    if px == "i16x8":
+        def em(x, y):
+            xb = [b for w in x for b in _bytes(w, signed)]
+            yb = [b for w in y for b in _bytes(w, signed)]
+            sel = range(0, 8) if low else range(8, 16)
+            ps = [xb[i] * yb[i] for i in sel]
+            return tuple(_pack_halves([ps[2 * k], ps[2 * k + 1]])
+                         for k in range(4))
+        return em
+    if px == "i32x4":
+        def em(x, y):
+            xh = [h for w in x for h in _halves(w, signed)]
+            yh = [h for w in y for h in _halves(w, signed)]
+            sel = range(0, 4) if low else range(4, 8)
+            return tuple(xh[i] * yh[i] for i in sel)
+        return em
+
+    def em64(x, y):
+        idx = (0, 1) if low else (2, 3)
+        r = []
+        for i in idx:
+            a, b = x[i], y[i]
+            ah = (lax.shift_right_arithmetic(a, 31) if signed
+                  else jnp.zeros_like(a))
+            bh = (lax.shift_right_arithmetic(b, 31) if signed
+                  else jnp.zeros_like(b))
+            r.extend(lo_ops.mul64(a, ah, b, bh))
+        return tuple(r)
+    return em64
+
+
 def v2_fn(sub: int):
     """Binary v128 op: (x4, y4) -> r4 where x4/y4 are 4-plane tuples."""
     jnp, lax = _j()
     from wasmedge_tpu.batch import laneops as lo_ops
 
     name = V2_NAMES[sub]
+    px0 = name.split(".", 1)[0]
+    if px0 in ("f32x4", "f64x2"):
+        return _v2_float(px0, name.split(".", 1)[1])
+    ext = _v2_intext(name)
+    if ext is not None:
+        return ext
     if name == "v128.and":
         return lambda x, y: tuple(a & b for a, b in zip(x, y))
     if name == "v128.or":
@@ -289,6 +478,123 @@ def v2_fn(sub: int):
         _elemwise(shape_w, signed, fn, a, b) for a, b in zip(x, y))
 
 
+def _v1_special(name: str):
+    """Float unaries, float<->int conversions and the widening /
+    pairwise-add integer extensions (unary v128 ops)."""
+    jnp, lax = _j()
+    from wasmedge_tpu.batch import laneops as lo_ops
+    from wasmedge_tpu.batch.image import ALU1_SUB
+
+    alu1 = lo_ops.alu1_fns()
+
+    def a1(nm):
+        return alu1[ALU1_SUB[nm]]
+
+    px, op = name.split(".", 1)
+    if px == "f32x4" and op in _FUN:
+        fn = a1(f"f32.{op}")
+        return lambda x: tuple(fn(w, jnp.zeros_like(w))[0] for w in x)
+    if px == "f64x2" and op in _FUN:
+        fn = a1(f"f64.{op}")
+
+        def un64(x):
+            r = []
+            for k in (0, 2):
+                lo, hi = fn(x[k], x[k + 1])
+                r.extend((lo, hi))
+            return tuple(r)
+        return un64
+    per_word_cvt = {
+        "i32x4.trunc_sat_f32x4_s": "i32.trunc_sat_f32_s",
+        "i32x4.trunc_sat_f32x4_u": "i32.trunc_sat_f32_u",
+        "f32x4.convert_i32x4_s": "f32.convert_i32_s",
+        "f32x4.convert_i32x4_u": "f32.convert_i32_u",
+    }
+    if name in per_word_cvt:
+        fn = a1(per_word_cvt[name])
+        return lambda x: tuple(fn(w, jnp.zeros_like(w))[0] for w in x)
+    if name.startswith("i32x4.trunc_sat_f64x2"):
+        fn = a1("i32.trunc_sat_f64_s" if "_s_" in name
+                else "i32.trunc_sat_f64_u")
+
+        def ts(x):
+            r0, r1 = fn(x[0], x[1])[0], fn(x[2], x[3])[0]
+            z = jnp.zeros_like(r0)
+            return (r0, r1, z, z)
+        return ts
+    if name.startswith("f64x2.convert_low_i32x4"):
+        fn = a1("f64.convert_i32_s" if name.endswith("_s")
+                else "f64.convert_i32_u")
+
+        def cv(x):
+            l0, h0 = fn(x[0], jnp.zeros_like(x[0]))
+            l1, h1 = fn(x[1], jnp.zeros_like(x[1]))
+            return (l0, h0, l1, h1)
+        return cv
+    if name == "f32x4.demote_f64x2_zero":
+        fn = a1("f32.demote_f64")
+
+        def dm(x):
+            r0, r1 = fn(x[0], x[1])[0], fn(x[2], x[3])[0]
+            z = jnp.zeros_like(r0)
+            return (r0, r1, z, z)
+        return dm
+    if name == "f64x2.promote_low_f32x4":
+        fn = a1("f64.promote_f32")
+
+        def pr(x):
+            l0, h0 = fn(x[0], jnp.zeros_like(x[0]))
+            l1, h1 = fn(x[1], jnp.zeros_like(x[1]))
+            return (l0, h0, l1, h1)
+        return pr
+    if ".extend_" in name:
+        parts = op.split("_")        # extend, low|high, <src>, s|u
+        low = parts[1] == "low"
+        signed = parts[-1] == "s"
+        if px == "i16x8":
+            def ex(x):
+                bs = [b for w in x for b in _bytes(w, signed)]
+                sel = bs[0:8] if low else bs[8:16]
+                return tuple(_pack_halves([sel[2 * k], sel[2 * k + 1]])
+                             for k in range(4))
+            return ex
+        if px == "i32x4":
+            def ex(x):
+                hs = [h for w in x for h in _halves(w, signed)]
+                return tuple(hs[0:4] if low else hs[4:8])
+            return ex
+
+        def ex64(x):
+            idx = (0, 1) if low else (2, 3)
+            r = []
+            for i in idx:
+                w = x[i]
+                hi = (lax.shift_right_arithmetic(w, 31) if signed
+                      else jnp.zeros_like(w))
+                r.extend((w, hi))
+            return tuple(r)
+        return ex64
+    if ".extadd_pairwise_" in name:
+        signed = name.endswith("_s")
+        if px == "i16x8":
+            def ea(x):
+                out = []
+                for w in x:
+                    bs = _bytes(w, signed)
+                    out.append(_pack_halves([bs[0] + bs[1], bs[2] + bs[3]]))
+                return tuple(out)
+            return ea
+
+        def ea32(x):
+            out = []
+            for w in x:
+                hs = _halves(w, signed)
+                out.append(hs[0] + hs[1])
+            return tuple(out)
+        return ea32
+    return None
+
+
 def v1_fn(sub: int):
     jnp, lax = _j()
     from wasmedge_tpu.batch import laneops as lo_ops
@@ -296,6 +602,9 @@ def v1_fn(sub: int):
     name = V1_NAMES[sub]
     if name == "v128.not":
         return lambda x: tuple(~a for a in x)
+    special = _v1_special(name)
+    if special is not None:
+        return special
     if name == "i8x16.popcnt":
         def pc(x):
             out = []
@@ -453,9 +762,9 @@ def vsplat_fn(sub: int):
             h = lo & 0xFFFF
             w = h | lax.shift_left(h, 16)
             return (w, w, w, w)
-        if px == "i32x4":
+        if px in ("i32x4", "f32x4"):
             return (lo, lo, lo, lo)
-        return (lo, hi, lo, hi)
+        return (lo, hi, lo, hi)      # i64x2 / f64x2
     return splat
 
 
@@ -496,7 +805,7 @@ def vextract_dyn(sub: int):
             if signed:
                 h = lax.shift_right_arithmetic(lax.shift_left(h, 16), 16)
             return h, jnp.zeros_like(h)
-        if px == "i32x4":
+        if px in ("i32x4", "f32x4"):
             w = x[0]
             for k in range(1, 4):
                 w = jnp.where(lane == k, x[k], w)
@@ -534,7 +843,7 @@ def vreplace_dyn(sub: int):
                 out.append(jnp.where(hit, (x[k] & ~hmask) | (hval & hmask),
                                      x[k]))
             return tuple(out)
-        if px == "i32x4":
+        if px in ("i32x4", "f32x4"):
             for k in range(4):
                 out.append(jnp.where(lane == k, lo, x[k]))
             return tuple(out)
