@@ -1,0 +1,935 @@
+"""Generate the round-4 proposal corpus: SIMD, bulk memory, table ops,
+reference types and tail calls.
+
+Like _generate.py, every expected value is computed by the plain-Python
+oracle below — deliberately independent of any engine in this framework,
+mirroring how the official testsuite's expectations encode the spec's
+semantics directly (reference seam:
+/root/reference/test/spec/spectest.cpp:213-217).  Run
+`python tests/spec/_generate_r4.py` to regenerate simd.wast,
+bulk_memory.wast, table.wast, ref_types.wast and tail_call.wast in
+place; tests/test_spec.py runs them through every engine.
+
+SIMD coverage note: modules take i64 params and build v128 internally
+(splat / replace_lane) and fold results back to i64, so the same
+assertions also run on the batch engines, whose entry ABI is 64-bit
+lane cells.  Inputs for float ops are packed normal-range floats — the
+f32 subnormal-flush divergence of the XLA path is covered (and skipped)
+by f32_subnormal.wast, not here.
+"""
+
+import math
+import os
+import struct
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+MASK = {8: 0xFF, 16: 0xFFFF, 32: 0xFFFFFFFF, 64: (1 << 64) - 1}
+
+
+def u(v, w):
+    return v & MASK[w]
+
+
+def s(v, w):
+    v &= MASK[w]
+    return v - (1 << w) if v >= (1 << (w - 1)) else v
+
+
+def lanes(v, n, w):
+    return [(v >> (w * k)) & MASK[w] for k in range(n)]
+
+
+def pack(ls, w):
+    v = 0
+    for k, x in enumerate(ls):
+        v |= (x & MASK[w]) << (w * k)
+    return v
+
+
+# -- float lane helpers (struct gives exact IEEE binary32 rounding) ---------
+def f32b(x: float) -> int:
+    return struct.unpack("<I", struct.pack("<f", x))[0]
+
+
+def bf32(b: int) -> float:
+    return struct.unpack("<f", struct.pack("<I", b & MASK[32]))[0]
+
+
+def f64b(x: float) -> int:
+    return struct.unpack("<Q", struct.pack("<d", x))[0]
+
+
+def bf64(b: int) -> float:
+    return struct.unpack("<d", struct.pack("<Q", b & MASK[64]))[0]
+
+
+F32_CANON = 0x7FC00000
+F64_CANON = 0x7FF8000000000000
+
+
+def _fbin(op, a, b, w):
+    """One float lane op on bit patterns; canonical-NaN outputs (the
+    engines canonicalize arithmetic NaNs)."""
+    fa = bf32(a) if w == 32 else bf64(a)
+    fb = bf32(b) if w == 32 else bf64(b)
+    if op in ("eq", "ne", "lt", "gt", "le", "ge"):
+        r = {"eq": fa == fb, "ne": fa != fb, "lt": fa < fb,
+             "gt": fa > fb, "le": fa <= fb, "ge": fa >= fb}[op]
+        return MASK[w] if r else 0
+    if op == "pmin":
+        return b if fb < fa else a
+    if op == "pmax":
+        return b if fa < fb else a
+    if op == "min":
+        if math.isnan(fa) or math.isnan(fb):
+            return F32_CANON if w == 32 else F64_CANON
+        if fa == fb:  # ±0 ordering
+            sa = a >> (w - 1)
+            return a if sa else b
+        r = min(fa, fb)
+    elif op == "max":
+        if math.isnan(fa) or math.isnan(fb):
+            return F32_CANON if w == 32 else F64_CANON
+        if fa == fb:
+            sa = a >> (w - 1)
+            return b if sa else a
+        r = max(fa, fb)
+    else:
+        try:
+            r = {"add": fa + fb, "sub": fa - fb, "mul": fa * fb,
+                 "div": (fa / fb) if fb != 0 else (
+                     math.inf if fa > 0 else -math.inf) if fa != 0
+                 else math.nan}[op]
+        except OverflowError:
+            r = math.inf if (fa > 0) == (fb > 0) else -math.inf
+    if isinstance(r, float) and math.isnan(r):
+        return F32_CANON if w == 32 else F64_CANON
+    return f32b(r) if w == 32 else f64b(r)
+
+
+def _fun(op, a, w):
+    fa = bf32(a) if w == 32 else bf64(a)
+    if op == "abs":
+        return a & (MASK[w] >> 1)
+    if op == "neg":
+        return a ^ (1 << (w - 1))
+    if math.isnan(fa):
+        return F32_CANON if w == 32 else F64_CANON
+    if op == "sqrt":
+        r = math.sqrt(fa) if fa >= 0 else math.nan
+    elif op == "ceil":
+        r = math.ceil(fa) if math.isfinite(fa) else fa
+        r = math.copysign(r, fa) if r == 0 else r
+    elif op == "floor":
+        r = math.floor(fa) if math.isfinite(fa) else fa
+        r = math.copysign(r, fa) if r == 0 else r
+    elif op == "trunc":
+        r = math.trunc(fa) if math.isfinite(fa) else fa
+        r = math.copysign(r, fa) if r == 0 else r
+    else:  # nearest (round-half-even)
+        if math.isfinite(fa):
+            fl = math.floor(fa)
+            d = fa - fl
+            if d < 0.5:
+                r = fl
+            elif d > 0.5:
+                r = fl + 1
+            else:
+                r = fl if fl % 2 == 0 else fl + 1
+            r = math.copysign(r, fa) if r == 0 else float(r)
+        else:
+            r = fa
+    if isinstance(r, float) and math.isnan(r):
+        return F32_CANON if w == 32 else F64_CANON
+    return f32b(r) if w == 32 else f64b(r)
+
+
+# -- SIMD op oracle (v128 as 128-bit int) -----------------------------------
+def v_int_bin(op, shape_w, a, b):
+    n = 128 // shape_w
+    la, lb = lanes(a, n, shape_w), lanes(b, n, shape_w)
+    out = []
+    for x, y in zip(la, lb):
+        sx, sy = s(x, shape_w), s(y, shape_w)
+        hi_s = (1 << (shape_w - 1)) - 1
+        lo_s = -(1 << (shape_w - 1))
+        if op == "add":
+            r = x + y
+        elif op == "sub":
+            r = x - y
+        elif op == "mul":
+            r = x * y
+        elif op == "add_sat_s":
+            r = max(lo_s, min(hi_s, sx + sy))
+        elif op == "sub_sat_s":
+            r = max(lo_s, min(hi_s, sx - sy))
+        elif op == "add_sat_u":
+            r = min(MASK[shape_w], x + y)
+        elif op == "sub_sat_u":
+            r = max(0, x - y)
+        elif op == "min_s":
+            r = min(sx, sy)
+        elif op == "max_s":
+            r = max(sx, sy)
+        elif op == "min_u":
+            r = min(x, y)
+        elif op == "max_u":
+            r = max(x, y)
+        elif op == "avgr_u":
+            r = (x + y + 1) >> 1
+        elif op == "q15mulr_sat_s":
+            r = max(lo_s, min(hi_s, (sx * sy + 0x4000) >> 15))
+        elif op in ("eq", "ne", "lt_s", "lt_u", "gt_s", "gt_u",
+                    "le_s", "le_u", "ge_s", "ge_u"):
+            c = {"eq": x == y, "ne": x != y, "lt_s": sx < sy,
+                 "lt_u": x < y, "gt_s": sx > sy, "gt_u": x > y,
+                 "le_s": sx <= sy, "le_u": x <= y, "ge_s": sx >= sy,
+                 "ge_u": x >= y}[op]
+            r = MASK[shape_w] if c else 0
+        else:
+            raise KeyError(op)
+        out.append(u(r, shape_w))
+    return pack(out, shape_w)
+
+
+def v_oracle(name, a, b=None, imm=None):
+    """Evaluate one v128 op by name on 128-bit ints."""
+    if name == "v128.and":
+        return a & b
+    if name == "v128.or":
+        return a | b
+    if name == "v128.xor":
+        return a ^ b
+    if name == "v128.andnot":
+        return a & ~b & ((1 << 128) - 1)
+    if name == "v128.not":
+        return ~a & ((1 << 128) - 1)
+    if name == "v128.bitselect":
+        return (a & imm) | (b & ~imm & ((1 << 128) - 1))
+    if name == "v128.any_true":
+        return int(a != 0)
+    px, op = name.split(".", 1)
+    shapes = {"i8x16": 8, "i16x8": 16, "i32x4": 32, "i64x2": 64,
+              "f32x4": 32, "f64x2": 64}
+    w = shapes[px]
+    n = 128 // w
+    if px.startswith("f"):
+        if op in ("add", "sub", "mul", "div", "min", "max", "pmin",
+                  "pmax", "eq", "ne", "lt", "gt", "le", "ge"):
+            return pack([_fbin(op, x, y, w) for x, y in
+                         zip(lanes(a, n, w), lanes(b, n, w))], w)
+        if op in ("abs", "neg", "sqrt", "ceil", "floor", "trunc",
+                  "nearest"):
+            return pack([_fun(op, x, w) for x in lanes(a, n, w)], w)
+        if op == "splat":
+            return pack([a & MASK[w]] * n, w)
+        if op == "extract_lane":
+            return lanes(a, n, w)[imm]
+        if op == "replace_lane":
+            ls = lanes(a, n, w)
+            ls[imm] = b & MASK[w]
+            return pack(ls, w)
+        if op.startswith("convert_i32x4") or op.startswith(
+                "convert_low_i32x4"):
+            signed = op.endswith("_s")
+            src = lanes(a, 4, 32)[:n]
+            out = []
+            for x in src:
+                xv = s(x, 32) if signed else x
+                out.append(f32b(float(xv)) if w == 32 else f64b(float(xv)))
+            return pack(out, w)
+        if op == "demote_f64x2_zero":
+            return pack([f32b(bf64(x)) if not math.isnan(bf64(x))
+                         else F32_CANON for x in lanes(a, 2, 64)] + [0, 0],
+                        32)
+        if op == "promote_low_f32x4":
+            return pack([F64_CANON if math.isnan(bf32(x))
+                         else f64b(bf32(x)) for x in lanes(a, 4, 32)[:2]],
+                        64)
+        raise KeyError(name)
+    # integer shapes
+    if op == "splat":
+        return pack([a & MASK[w]] * n, w)
+    if op in ("extract_lane", "extract_lane_u"):
+        return lanes(a, n, w)[imm]
+    if op == "extract_lane_s":
+        return u(s(lanes(a, n, w)[imm], w), 64)
+    if op == "replace_lane":
+        ls = lanes(a, n, w)
+        ls[imm] = b & MASK[w]
+        return pack(ls, w)
+    if op in ("abs", "neg"):
+        out = []
+        for x in lanes(a, n, w):
+            sx = s(x, w)
+            out.append(u(-sx if (op == "neg" or sx < 0) else sx, w))
+        return pack(out, w)
+    if op == "popcnt":
+        return pack([bin(x).count("1") for x in lanes(a, n, w)], w)
+    if op == "all_true":
+        return int(all(x != 0 for x in lanes(a, n, w)))
+    if op == "bitmask":
+        m = 0
+        for k, x in enumerate(lanes(a, n, w)):
+            m |= (x >> (w - 1)) << k
+        return m
+    if op in ("shl", "shr_s", "shr_u"):
+        sh = (b % w)
+        out = []
+        for x in lanes(a, n, w):
+            if op == "shl":
+                out.append(u(x << sh, w))
+            elif op == "shr_u":
+                out.append(x >> sh)
+            else:
+                out.append(u(s(x, w) >> sh, w))
+        return pack(out, w)
+    if op == "swizzle":
+        xb = lanes(a, 16, 8)
+        sel = lanes(b, 16, 8)
+        return pack([xb[t] if t < 16 else 0 for t in sel], 8)
+    if op == "shuffle":
+        src = lanes(a, 16, 8) + lanes(b, 16, 8)
+        return pack([src[t] for t in imm], 8)
+    if op.startswith("narrow_"):
+        sw = w * 2
+        signed_out = op.endswith("_s")
+        lo_, hi_ = ((-(1 << (w - 1)), (1 << (w - 1)) - 1)
+                    if signed_out else (0, MASK[w]))
+        vals = [s(x, sw) for x in lanes(a, 128 // sw, sw)] + \
+               [s(x, sw) for x in lanes(b, 128 // sw, sw)]
+        return pack([u(max(lo_, min(hi_, v)), w) for v in vals], w)
+    if op.startswith("extend_"):
+        sw = w // 2
+        low = "_low_" in op
+        signed = op.endswith("_s")
+        src = lanes(a, 128 // sw, sw)
+        src = src[:n] if low else src[n:]
+        return pack([u(s(x, sw) if signed else x, w) for x in src], w)
+    if op.startswith("extadd_pairwise"):
+        sw = w // 2
+        signed = op.endswith("_s")
+        src = lanes(a, 128 // sw, sw)
+        if signed:
+            src = [s(x, sw) for x in src]
+        return pack([u(src[2 * k] + src[2 * k + 1], w) for k in range(n)],
+                    w)
+    if op.startswith("extmul_"):
+        sw = w // 2
+        low = "_low_" in op
+        signed = op.endswith("_s")
+        xa = lanes(a, 128 // sw, sw)
+        xb = lanes(b, 128 // sw, sw)
+        xa = xa[:n] if low else xa[n:]
+        xb = xb[:n] if low else xb[n:]
+        if signed:
+            xa = [s(x, sw) for x in xa]
+            xb = [s(x, sw) for x in xb]
+        return pack([u(x * y, w) for x, y in zip(xa, xb)], w)
+    if op == "dot_i16x8_s":
+        ha = [s(x, 16) for x in lanes(a, 8, 16)]
+        hb = [s(x, 16) for x in lanes(b, 8, 16)]
+        return pack([u(ha[2 * k] * hb[2 * k] + ha[2 * k + 1] *
+                       hb[2 * k + 1], 32) for k in range(4)], 32)
+    if op.startswith("trunc_sat_f32x4") or op.startswith(
+            "trunc_sat_f64x2"):
+        signed = "_s" in op.split("trunc_sat_")[1]
+        src_w = 32 if "f32x4" in op else 64
+        src = lanes(a, 128 // src_w, src_w)[:4 if src_w == 32 else 2]
+        lo_, hi_ = ((-(1 << 31), (1 << 31) - 1) if signed
+                    else (0, MASK[32]))
+        out = []
+        for x in src:
+            f = bf32(x) if src_w == 32 else bf64(x)
+            if math.isnan(f):
+                out.append(0)
+            else:
+                out.append(u(max(lo_, min(hi_, math.trunc(f))), 32))
+        while len(out) < 4:
+            out.append(0)
+        return pack(out, 32)
+    return v_int_bin(op, w, a, b)
+
+
+# -- wast emission ----------------------------------------------------------
+def i64c(v):
+    return f"(i64.const {s(v, 64)})"
+
+
+def i32c(v):
+    return f"(i32.const {s(v, 32)})"
+
+
+def fold128():
+    """v128 (on stack) -> i64: lane0 ^ 3*lane1."""
+    return ("(local.set 2) "
+            "(i64.xor (i64x2.extract_lane 0 (local.get 2)) "
+            "(i64.mul (i64x2.extract_lane 1 (local.get 2)) "
+            "(i64.const 3)))")
+
+
+def fold_py(v):
+    l0, l1 = lanes(v, 2, 64)
+    return u(l0 ^ u(l1 * 3, 64), 64)
+
+
+K1 = 0x9E3779B97F4A7C15
+K2 = 0xC2B2AE3D27D4EB4F
+
+
+def vec_a():
+    """wat expr building v128 local $a from i64 param 0 (scrambled)."""
+    return ("(i64x2.replace_lane 1 (i64x2.splat (local.get 0)) "
+            f"(i64.mul (local.get 0) (i64.const {s(K1, 64)})))")
+
+
+def vec_b():
+    return ("(i64x2.replace_lane 1 (i64x2.splat (local.get 1)) "
+            f"(i64.xor (local.get 1) (i64.const {s(K2, 64)})))")
+
+
+def vec_a_py(x):
+    return pack([u(x, 64), u(x * K1, 64)], 64)
+
+
+def vec_b_py(y):
+    return pack([u(y, 64), u(y ^ K2, 64)], 64)
+
+
+INT_PAIRS = [
+    (0, 0), (1, 2), (0xFFFFFFFFFFFFFFFF, 1),
+    (0x8000000000000000, 0x7FFFFFFFFFFFFFFF),
+    (0x0102030405060708, 0x1112131415161718),
+    (0x8081828384858687, 0x00FF00FF00FF00FF),
+    (0x7F80FF017FFF8000, 0x0101010101010101),
+    (0xDEADBEEFCAFEBABE, 0x0123456789ABCDEF),
+    (0x8000000180000001, 0xFFFFFFFE00000002),
+    (0x00007FFF00008000, 0xFFFF8000FFFF7FFF),
+    (0x55AA55AA55AA55AA, 0xAA55AA55AA55AA55),
+    (0x0000000100000002, 0x0000000300000004),
+]
+
+
+def float_pairs(w):
+    """i64 args packing two normal floats per arg."""
+    vals = [0.0, -0.0, 1.0, -1.5, 2.25, 100.5, -3.75, 0.5, 7.0, -2.0,
+            1234.5, -0.125]
+    out = []
+    if w == 32:
+        for i in range(0, len(vals) - 3, 2):
+            x = pack([f32b(vals[i]), f32b(vals[i + 1])], 32) & MASK[64]
+            y = pack([f32b(vals[i + 2]), f32b(vals[i + 3])], 32) & MASK[64]
+            out.append((x, y))
+        out.append((pack([f32b(0.0), f32b(-0.0)], 32),
+                    pack([f32b(-0.0), f32b(0.0)], 32)))
+    else:
+        for i in range(0, len(vals) - 1, 2):
+            out.append((f64b(vals[i]), f64b(vals[i + 1])))
+        out.append((f64b(0.0), f64b(-0.0)))
+    return out
+
+
+def gen_simd(path):
+    from wasmedge_tpu.batch.simdops import (
+        V1_NAMES, V2_NAMES, VSHIFT_NAMES, VTEST_NAMES)
+
+    # no memory: the whole module stays inside the batch engines'
+    # entry subset, so these assertions run on tpu_batch too (the v128
+    # load/store roundtrip lives in bulk_memory.wast instead)
+    mod = ["(module"]
+    asserts = []
+
+    def add_func(name, body):
+        mod.append(
+            f'  (func (export "{name}") (param i64 i64) (result i64)'
+            f" (local v128) {body})")
+
+    def scrambled(fn_name, apply_expr, oracle, pairs=INT_PAIRS,
+                  plain=False):
+        va, vb = ("(i64x2.splat (local.get 0))",
+                  "(i64x2.splat (local.get 1))") if plain \
+            else (vec_a(), vec_b())
+        add_func(fn_name, apply_expr(va, vb) + " " + fold128())
+        for x, y in pairs:
+            a = (pack([u(x, 64)] * 2, 64) if plain else vec_a_py(x))
+            b = (pack([u(y, 64)] * 2, 64) if plain else vec_b_py(y))
+            asserts.append(
+                f'(assert_return (invoke "{fn_name}" {i64c(x)} {i64c(y)})'
+                f" {i64c(fold_py(oracle(a, b)))})")
+
+    # binary families (ints scrambled, floats plain normal-range)
+    for name in V2_NAMES:
+        fn = name.replace(".", "_")
+        is_f = name.split(".")[0] in ("f32x4", "f64x2")
+        pairs = float_pairs(32 if name.startswith("f32x4") else 64) \
+            if is_f else INT_PAIRS
+        scrambled(fn, lambda va, vb, name=name: f"({name} {va} {vb})",
+                  lambda a, b, name=name: v_oracle(name, a, b),
+                  pairs=pairs, plain=is_f)
+    # unary families
+    for name in V1_NAMES:
+        fn = "u_" + name.replace(".", "_")
+        is_f = ("f32x4" in name or "f64x2" in name)
+        pairs = float_pairs(32 if "f32x4" in name else 64) \
+            if is_f else INT_PAIRS
+        scrambled(fn, lambda va, vb, name=name: f"({name} {va})",
+                  lambda a, b, name=name: v_oracle(name, a),
+                  pairs=pairs, plain=is_f)
+    # test/bitmask family -> i64 result via extend
+    for name in VTEST_NAMES:
+        fn = "t_" + name.replace(".", "_")
+        add_func(fn, f"(i64.extend_i32_u ({name} {vec_a()}))")
+        for x, y in INT_PAIRS:
+            asserts.append(
+                f'(assert_return (invoke "{fn}" {i64c(x)} {i64c(y)})'
+                f" {i64c(v_oracle(name, vec_a_py(x)))})")
+    # shifts: amount from param 1
+    for name in VSHIFT_NAMES:
+        fn = "s_" + name.replace(".", "_")
+        add_func(fn, f"({name} {vec_a()} "
+                     "(i32.wrap_i64 (local.get 1))) " + fold128())
+        for x, _ in INT_PAIRS[:8]:
+            for sh in (0, 1, 7, 13, 31, 63):
+                asserts.append(
+                    f'(assert_return (invoke "{fn}" {i64c(x)} '
+                    f"{i64c(sh)}) "
+                    f"{i64c(fold_py(v_oracle(name, vec_a_py(x), sh)))})")
+    # lane extract/replace at literal lanes + shuffle/swizzle/bitselect
+    for shape, nl in (("i8x16", 16), ("i16x8", 8), ("i32x4", 4),
+                      ("i64x2", 2)):
+        for lane in sorted({0, nl // 2, nl - 1}):
+            sfx = ("_s" if shape in ("i8x16", "i16x8") else "")
+            nm = f"{shape}.extract_lane{sfx}"
+            fn = f"x_{shape}_{lane}"
+            body = f"({nm} {lane} {vec_a()})"
+            if shape != "i64x2":
+                body = f"(i64.extend_i32_s {body})"
+            add_func(fn, body)
+            for x, y in INT_PAIRS[:6]:
+                want = v_oracle(nm, vec_a_py(x), imm=lane)
+                if shape != "i64x2":
+                    want = u(s(want, 64 if sfx else 32)
+                             if not sfx else want, 64)
+                asserts.append(
+                    f'(assert_return (invoke "{fn}" {i64c(x)} {i64c(y)})'
+                    f" {i64c(want)})")
+            rn = f"{shape}.replace_lane"
+            fn = f"r_{shape}_{lane}"
+            src = "(i32.wrap_i64 (local.get 1))" if shape != "i64x2" \
+                else "(local.get 1)"
+            add_func(fn, f"({rn} {lane} {vec_a()} {src}) " + fold128())
+            for x, y in INT_PAIRS[:6]:
+                want = v_oracle(rn, vec_a_py(x),
+                                u(y, 64 if shape == "i64x2" else 32),
+                                imm=lane)
+                asserts.append(
+                    f'(assert_return (invoke "{fn}" {i64c(x)} {i64c(y)})'
+                    f" {i64c(fold_py(want))})")
+    shuf = [0, 17, 2, 19, 4, 21, 6, 23, 8, 25, 10, 27, 12, 29, 14, 31]
+    add_func("shuffle", "(i8x16.shuffle " + " ".join(map(str, shuf)) +
+             f" {vec_a()} {vec_b()}) " + fold128())
+    add_func("bitsel", f"(v128.bitselect {vec_a()} {vec_b()} "
+             "(v128.const i64x2 0x00FF00FF00FF00FF "
+             "0xFFFF0000FFFF0000)) " + fold128())
+    mask = pack([0x00FF00FF00FF00FF, 0xFFFF0000FFFF0000], 64)
+    for x, y in INT_PAIRS:
+        a, b = vec_a_py(x), vec_b_py(y)
+        asserts.append(
+            f'(assert_return (invoke "shuffle" {i64c(x)} {i64c(y)}) '
+            f"{i64c(fold_py(v_oracle('i8x16.shuffle', a, b, imm=shuf)))})")
+        asserts.append(
+            f'(assert_return (invoke "bitsel" {i64c(x)} {i64c(y)}) '
+            f"{i64c(fold_py(v_oracle('v128.bitselect', a, b, imm=mask)))})")
+    mod.append(")")
+    _write(path, mod, asserts, "SIMD v128 semantics")
+
+
+def gen_bulk(path):
+    seg = bytes(range(1, 33))  # 32 bytes, passive
+    mem = bytearray(65536)
+    mod = [
+        "(module",
+        "  (memory 1)",
+        '  (data $p "' + "".join(f"\\{b:02x}" for b in seg) + '")',
+        '  (func (export "fill") (param i32 i32 i32)',
+        "    (memory.fill (local.get 0) (local.get 1) (local.get 2)))",
+        '  (func (export "copy") (param i32 i32 i32)',
+        "    (memory.copy (local.get 0) (local.get 1) (local.get 2)))",
+        '  (func (export "init") (param i32 i32 i32)',
+        "    (memory.init $p (local.get 0) (local.get 1) (local.get 2)))",
+        '  (func (export "drop") (data.drop $p))',
+        '  (func (export "ld8") (param i32) (result i32)',
+        "    (i32.load8_u (local.get 0)))",
+        '  (func (export "ld32") (param i32) (result i32)',
+        "    (i32.load (local.get 0)))",
+        '  (func (export "vmemrt") (param i64 i64) (result i64) '
+        "(local v128)",
+        "    (v128.store (i32.const 1024) (i64x2.replace_lane 1 "
+        "(i64x2.splat (local.get 0)) (local.get 1)))",
+        "    (v128.store offset=16 (i32.const 1024) "
+        "(v128.load (i32.const 1024)))",
+        "    (local.set 2 (v128.load offset=16 (i32.const 1024)))",
+        "    (i64.xor (i64x2.extract_lane 0 (local.get 2)) "
+        "(i64.mul (i64x2.extract_lane 1 (local.get 2)) (i64.const 3))))",
+        ")",
+    ]
+    asserts = []
+    for x, y in ((0, 0), (0x0123456789ABCDEF, 0xFEDCBA9876543210),
+                 ((1 << 64) - 1, 1), (0x55AA55AA55AA55AA, 0x8000000000000000)):
+        want = u(x ^ u(y * 3, 64), 64)
+        asserts.append(f'(assert_return (invoke "vmemrt" {i64c(x)} '
+                       f"{i64c(y)}) {i64c(want)})")
+
+    def fill(d, v, n):
+        asserts.append(f'(assert_return (invoke "fill" {i32c(d)} '
+                       f"{i32c(v)} {i32c(n)}))")
+        mem[d:d + n] = bytes([v & 0xFF]) * n
+
+    def copy(d, sr, n):
+        asserts.append(f'(assert_return (invoke "copy" {i32c(d)} '
+                       f"{i32c(sr)} {i32c(n)}))")
+        mem[d:d + n] = bytes(mem[sr:sr + n])
+
+    def init(d, sr, n):
+        asserts.append(f'(assert_return (invoke "init" {i32c(d)} '
+                       f"{i32c(sr)} {i32c(n)}))")
+        mem[d:d + n] = seg[sr:sr + n]
+
+    def check(addrs):
+        for a in addrs:
+            asserts.append(f'(assert_return (invoke "ld8" {i32c(a)}) '
+                           f"{i32c(mem[a])})")
+
+    fill(0, 0xAB, 64)
+    check([0, 1, 63, 64])
+    fill(100, 0x5A, 1)
+    fill(101, 0, 0)          # zero length is a no-op
+    check([99, 100, 101])
+    init(200, 0, 32)
+    check([200, 215, 231, 232])
+    init(300, 8, 8)
+    init(310, 31, 1)
+    init(311, 32, 0)         # at-end zero init ok
+    check([300, 307, 310, 311])
+    copy(400, 200, 32)       # disjoint
+    check([400, 431, 432])
+    copy(410, 400, 16)       # overlap forward (dst > src)
+    check(list(range(400, 434)))
+    copy(395, 400, 16)       # overlap backward
+    check(list(range(393, 418)))
+    fill(65530, 0x77, 6)     # fill to the very end
+    check([65530, 65535])
+    copy(0, 65520, 16)
+    check([0, 15, 16])
+    # traps: range past end (note: no partial writes observable after)
+    asserts.append('(assert_trap (invoke "fill" (i32.const 65530) '
+                   '(i32.const 1) (i32.const 7)) '
+                   '"out of bounds memory access")')
+    asserts.append('(assert_trap (invoke "copy" (i32.const 65530) '
+                   '(i32.const 0) (i32.const 7)) '
+                   '"out of bounds memory access")')
+    asserts.append('(assert_trap (invoke "copy" (i32.const 0) '
+                   '(i32.const 65530) (i32.const 7)) '
+                   '"out of bounds memory access")')
+    asserts.append('(assert_trap (invoke "init" (i32.const 0) '
+                   '(i32.const 0) (i32.const 33)) '
+                   '"out of bounds memory access")')
+    asserts.append('(assert_trap (invoke "init" (i32.const 65535) '
+                   '(i32.const 0) (i32.const 2)) '
+                   '"out of bounds memory access")')
+    # zero-length at boundary must NOT trap
+    asserts.append('(assert_return (invoke "fill" (i32.const 65536) '
+                   '(i32.const 0) (i32.const 0)))')
+    asserts.append('(assert_return (invoke "copy" (i32.const 65536) '
+                   '(i32.const 0) (i32.const 0)))')
+    # ...but one past it must
+    asserts.append('(assert_trap (invoke "fill" (i32.const 65537) '
+                   '(i32.const 0) (i32.const 0)) '
+                   '"out of bounds memory access")')
+    # after data.drop, init of n>0 traps, n=0 passes
+    asserts.append('(assert_return (invoke "drop"))')
+    asserts.append('(assert_return (invoke "drop"))')  # double drop ok
+    asserts.append('(assert_trap (invoke "init" (i32.const 0) '
+                   '(i32.const 0) (i32.const 1)) '
+                   '"out of bounds memory access")')
+    asserts.append('(assert_return (invoke "init" (i32.const 0) '
+                   '(i32.const 0) (i32.const 0)))')
+    check(list(range(0, 48)))
+    _write(path, mod, asserts, "bulk memory: fill/copy/init/drop")
+
+
+def gen_table(path):
+    funcs = [11, 22, 33, 44, 55]
+    mod = [
+        "(module",
+        "  (table $t 10 20 funcref)",
+        "  (table $u 4 funcref)",
+    ]
+    for i, v in enumerate(funcs):
+        mod.append(f"  (func $f{i} (result i32) (i32.const {v}))")
+    mod += [
+        "  (elem $e func $f0 $f1 $f2 $f3 $f4)",
+        "  (elem (table $t) (i32.const 0) $f0 $f1)",
+        '  (func (export "call") (param i32) (result i32)',
+        "    (call_indirect $t (result i32) (local.get 0)))",
+        '  (func (export "callu") (param i32) (result i32)',
+        "    (call_indirect $u (result i32) (local.get 0)))",
+        '  (func (export "size") (result i32) (table.size $t))',
+        '  (func (export "grow") (param i32) (result i32)',
+        "    (table.grow $t (ref.null func) (local.get 0)))",
+        '  (func (export "fillnull") (param i32 i32)',
+        "    (table.fill $t (local.get 0) (ref.null func) (local.get 1)))",
+        '  (func (export "fillf4") (param i32 i32)',
+        "    (table.fill $t (local.get 0) (ref.func $f4) (local.get 1)))",
+        '  (func (export "init") (param i32 i32 i32)',
+        "    (table.init $t $e (local.get 0) (local.get 1) (local.get 2)))",
+        '  (func (export "copy") (param i32 i32 i32)',
+        "    (table.copy $t $t (local.get 0) (local.get 1) (local.get 2)))",
+        '  (func (export "xcopy") (param i32 i32 i32)',
+        "    (table.copy $u $t (local.get 0) (local.get 1) (local.get 2)))",
+        '  (func (export "edrop") (elem.drop $e))',
+        '  (func (export "isnull") (param i32) (result i32)',
+        "    (ref.is_null (table.get $t (local.get 0))))",
+        '  (func (export "setget") (param i32 i32) (result i32)',
+        "    (table.set $t (local.get 0) (table.get $t (local.get 1)))",
+        "    (ref.is_null (table.get $t (local.get 0))))",
+        ")",
+    ]
+    # oracle model: table t (size 10, max 20) of func VALUES (None=null)
+    t = [11, 22] + [None] * 8
+    tu = [None] * 4
+    asserts = []
+
+    def call(i):
+        if i >= len(t):
+            asserts.append(f'(assert_trap (invoke "call" {i32c(i)}) '
+                           '"undefined element")')
+        elif t[i] is None:
+            asserts.append(f'(assert_trap (invoke "call" {i32c(i)}) '
+                           '"uninitialized element")')
+        else:
+            asserts.append(f'(assert_return (invoke "call" {i32c(i)}) '
+                           f"{i32c(t[i])})")
+
+    def sweep():
+        for i in (0, 1, 2, 5, 9, len(t), 25):
+            call(i)
+
+    sweep()
+    asserts.append(f'(assert_return (invoke "size") {i32c(len(t))})')
+    asserts.append(f'(assert_return (invoke "grow" (i32.const 4)) '
+                   f"{i32c(len(t))})")
+    t += [None] * 4
+    asserts.append(f'(assert_return (invoke "size") {i32c(len(t))})')
+    # grow beyond max fails with -1
+    asserts.append('(assert_return (invoke "grow" (i32.const 100)) '
+                   '(i32.const -1))')
+    asserts.append(f'(assert_return (invoke "init" (i32.const 4) '
+                   f"(i32.const 1) (i32.const 3)))")
+    t[4:7] = funcs[1:4]
+    sweep()
+    call(6)
+    asserts.append('(assert_return (invoke "fillf4" (i32.const 8) '
+                   '(i32.const 3)))')
+    t[8:11] = [funcs[4]] * 3
+    call(8)
+    call(10)
+    asserts.append('(assert_return (invoke "copy" (i32.const 11) '
+                   '(i32.const 4) (i32.const 3)))')
+    t[11:14] = t[4:7]
+    call(11)
+    call(13)
+    # overlapping copy backward
+    asserts.append('(assert_return (invoke "copy" (i32.const 3) '
+                   '(i32.const 4) (i32.const 4)))')
+    t[3:7] = t[4:8]
+    sweep()
+    # cross-table copy u <- t
+    asserts.append('(assert_return (invoke "xcopy" (i32.const 0) '
+                   '(i32.const 3) (i32.const 4)))')
+    tu[0:4] = t[3:7]
+    for i in range(4):
+        if tu[i] is None:
+            asserts.append(f'(assert_trap (invoke "callu" {i32c(i)}) '
+                           '"uninitialized element")')
+        else:
+            asserts.append(f'(assert_return (invoke "callu" {i32c(i)}) '
+                           f"{i32c(tu[i])})")
+    # fill with null then observe
+    asserts.append('(assert_return (invoke "fillnull" (i32.const 4) '
+                   '(i32.const 2)))')
+    t[4:6] = [None, None]
+    call(4)
+    call(5)
+    asserts.append('(assert_return (invoke "isnull" (i32.const 4)) '
+                   '(i32.const 1))')
+    asserts.append('(assert_return (invoke "isnull" (i32.const 3)) '
+                   '(i32.const 0))')
+    asserts.append('(assert_return (invoke "setget" (i32.const 9) '
+                   '(i32.const 3)) (i32.const 0))')
+    t[9] = t[3]
+    call(9)
+    # oob table ops trap
+    asserts.append('(assert_trap (invoke "fillnull" (i32.const 13) '
+                   '(i32.const 2)) "out of bounds table access")')
+    asserts.append('(assert_trap (invoke "copy" (i32.const 13) '
+                   '(i32.const 0) (i32.const 2)) '
+                   '"out of bounds table access")')
+    asserts.append('(assert_trap (invoke "init" (i32.const 0) '
+                   '(i32.const 4) (i32.const 2)) '
+                   '"out of bounds table access")')
+    # zero-length at boundary ok, past-boundary traps
+    asserts.append(f'(assert_return (invoke "fillnull" {i32c(len(t))} '
+                   '(i32.const 0)))')
+    asserts.append(f'(assert_trap (invoke "fillnull" {i32c(len(t) + 1)} '
+                   '(i32.const 0)) "out of bounds table access")')
+    # elem.drop then init traps (n>0), ok (n=0)
+    asserts.append('(assert_return (invoke "edrop"))')
+    asserts.append('(assert_return (invoke "edrop"))')
+    asserts.append('(assert_trap (invoke "init" (i32.const 0) '
+                   '(i32.const 0) (i32.const 1)) '
+                   '"out of bounds table access")')
+    asserts.append('(assert_return (invoke "init" (i32.const 0) '
+                   '(i32.const 0) (i32.const 0)))')
+    sweep()
+    _write(path, mod, asserts, "table mutation + call_indirect")
+
+
+def gen_ref_types(path):
+    mod = [
+        "(module",
+        "  (table $t 8 externref)",
+        # exported => declared, so ref.func $id is valid
+        '  (func $id (export "idf") (param i32) (result i32) '
+        "(local.get 0))",
+        '  (func (export "null_f") (result i32)',
+        "    (ref.is_null (ref.null func)))",
+        '  (func (export "null_e") (result i32)',
+        "    (ref.is_null (ref.null extern)))",
+        '  (func (export "fref") (result i32)',
+        "    (ref.is_null (ref.func $id)))",
+        '  (func (export "eset") (param i32 externref)',
+        "    (table.set $t (local.get 0) (local.get 1)))",
+        '  (func (export "eget") (param i32) (result externref)',
+        "    (table.get $t (local.get 0)))",
+        '  (func (export "eisnull") (param i32) (result i32)',
+        "    (ref.is_null (table.get $t (local.get 0))))",
+        '  (func (export "select_r") (param externref externref i32) '
+        "(result externref)",
+        "    (select (result externref) (local.get 0) (local.get 1) "
+        "(local.get 2)))",
+        ")",
+    ]
+    asserts = [
+        '(assert_return (invoke "null_f") (i32.const 1))',
+        '(assert_return (invoke "null_e") (i32.const 1))',
+        '(assert_return (invoke "fref") (i32.const 0))',
+    ]
+    for i in range(8):
+        asserts.append(f'(assert_return (invoke "eisnull" {i32c(i)}) '
+                       '(i32.const 1))')
+    # externref values flow through invoke as ref.extern handles
+    asserts.append('(assert_return (invoke "eset" (i32.const 3) '
+                   '(ref.extern 7)))')
+    asserts.append('(assert_return (invoke "eisnull" (i32.const 3)) '
+                   '(i32.const 0))')
+    asserts.append('(assert_return (invoke "eget" (i32.const 3)) '
+                   '(ref.extern 7))')
+    asserts.append('(assert_return (invoke "eget" (i32.const 4)) '
+                   '(ref.null))')
+    asserts.append('(assert_return (invoke "select_r" (ref.extern 5) '
+                   '(ref.extern 6) (i32.const 1)) (ref.extern 5))')
+    asserts.append('(assert_return (invoke "select_r" (ref.extern 5) '
+                   '(ref.extern 6) (i32.const 0)) (ref.extern 6))')
+    asserts.append('(assert_trap (invoke "eget" (i32.const 8)) '
+                   '"out of bounds table access")')
+    _write(path, mod, asserts, "reference types: null/func/extern refs")
+
+
+def gen_tail_call(path):
+    mod = [
+        "(module",
+        "  (table $t 2 funcref)",
+        '  (func $even (export "even") (param i64) (result i32)',
+        "    (if (result i32) (i64.eqz (local.get 0))",
+        "      (then (i32.const 1))",
+        "      (else (return_call $odd (i64.sub (local.get 0) "
+        "(i64.const 1))))))",
+        '  (func $odd (export "odd") (param i64) (result i32)',
+        "    (if (result i32) (i64.eqz (local.get 0))",
+        "      (then (i32.const 0))",
+        "      (else (return_call $even (i64.sub (local.get 0) "
+        "(i64.const 1))))))",
+        '  (func $count (export "count") (param i64 i64) (result i64)',
+        "    (if (result i64) (i64.eqz (local.get 0))",
+        "      (then (local.get 1))",
+        "      (else (return_call $count (i64.sub (local.get 0) "
+        "(i64.const 1)) (i64.add (local.get 1) (i64.const 1))))))",
+        '  (func $fac_acc (param i64 i64) (result i64)',
+        "    (if (result i64) (i64.eqz (local.get 0))",
+        "      (then (local.get 1))",
+        "      (else (return_call_indirect (param i64 i64) (result i64)",
+        "        (i64.sub (local.get 0) (i64.const 1))",
+        "        (i64.mul (local.get 0) (local.get 1))",
+        "        (i32.const 0)))))",
+        '  (func (export "fac") (param i64) (result i64)',
+        "    (return_call $fac_acc (local.get 0) (i64.const 1)))",
+        '  (func $burn (export "burn") (param i64) (result i64)',
+        "    (if (result i64) (i64.eqz (local.get 0))",
+        "      (then (i64.const 0))",
+        "      (else (i64.add (i64.const 1) (call $burn (i64.sub "
+        "(local.get 0) (i64.const 1)))))))",
+        "  (elem (i32.const 0) $fac_acc $count)",
+        ")",
+    ]
+    asserts = []
+    for n, want in ((0, 1), (1, 0), (7, 0), (100, 1), (100001, 0)):
+        asserts.append(f'(assert_return (invoke "even" {i64c(n)}) '
+                       f"{i32c(want)})")
+    # tail calls run in constant stack: 200k alternating frames
+    asserts.append('(assert_return (invoke "even" (i64.const 200000)) '
+                   '(i32.const 1))')
+    for n in (0, 1, 5, 50000):
+        asserts.append(f'(assert_return (invoke "count" {i64c(n)} '
+                       f"(i64.const 0)) {i64c(n)})")
+
+    def fac(n):
+        r = 1
+        for k in range(2, n + 1):
+            r = u(r * k, 64)
+        return r
+
+    for n in (0, 1, 5, 12, 25):
+        asserts.append(f'(assert_return (invoke "fac" {i64c(n)}) '
+                       f"{i64c(fac(n))})")
+    # ordinary deep recursion still exhausts the stack (contrast case)
+    asserts.append('(assert_exhaustion (invoke "burn" '
+                   '(i64.const 100000000)) "call stack exhausted")')
+    _write(path, mod, asserts, "tail calls: constant-stack recursion")
+
+
+def _write(path, mod_lines, asserts, title):
+    with open(path, "w") as f:
+        f.write(f";; {title} — generated by _generate_r4.py\n")
+        f.write(";; (independent oracle: plain Python arithmetic; "
+                "do not edit by hand)\n")
+        f.write("\n".join(mod_lines))
+        f.write("\n")
+        f.write("\n".join(asserts))
+        f.write("\n")
+    print(f"{os.path.basename(path)}: {len(asserts)} assertions")
+
+
+def main():
+    import sys
+    sys.path.insert(0, os.path.join(HERE, os.pardir, os.pardir))
+    gen_simd(os.path.join(HERE, "simd.wast"))
+    gen_bulk(os.path.join(HERE, "bulk_memory.wast"))
+    gen_table(os.path.join(HERE, "table.wast"))
+    gen_ref_types(os.path.join(HERE, "ref_types.wast"))
+    gen_tail_call(os.path.join(HERE, "tail_call.wast"))
+
+
+if __name__ == "__main__":
+    main()

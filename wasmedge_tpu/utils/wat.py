@@ -244,6 +244,20 @@ _IDX_IMM = {
     "memory.init": "data",
     "br": "label", "br_if": "label",
 }
+# ops with a single byte lane immediate (SIMD extract/replace)
+_LANE_IMM = {
+    f"{s}.{k}"
+    for s in ("i8x16", "i16x8")
+    for k in ("extract_lane_s", "extract_lane_u", "replace_lane")
+} | {
+    f"{s}.{k}"
+    for s in ("i32x4", "i64x2", "f32x4", "f64x2")
+    for k in ("extract_lane", "replace_lane")
+}
+
+_V128_SHAPES = {"i8x16": (16, 8), "i16x8": (8, 16), "i32x4": (4, 32),
+                "i64x2": (2, 64), "f32x4": (4, 32), "f64x2": (2, 64)}
+
 _MEM_OPS = {
     "i32.load": 2, "i64.load": 3, "f32.load": 2, "f64.load": 3,
     "i32.load8_s": 0, "i32.load8_u": 0, "i32.load16_s": 1,
@@ -541,7 +555,10 @@ class WatCompiler:
             self._pending_inline_elem = (self.n_tables, elems)
         else:
             mn = int(f[i])
-            mx = int(f[i + 1]) if i + 2 <= len(f) - 2 else None
+            # a second bare integer before the reftype is the max
+            mx = (int(f[i + 1])
+                  if i + 1 < len(f) and isinstance(f[i + 1], str)
+                  and f[i + 1].lstrip("-").isdigit() else None)
             rt = f[-1]
             self.b.add_table(rt, mn, mx)
         if name:
@@ -663,6 +680,24 @@ class WatCompiler:
         while i < len(items):
             i = self._instr(items, i, fn, labels, out)
 
+    def _v128_const(self, items, i):
+        """`v128.const <shape> <lane>...` -> (128-bit int, next index)."""
+        shape = items[i]
+        if shape not in _V128_SHAPES:
+            raise WatError(f"v128.const: bad shape {shape!r}")
+        n, w = _V128_SHAPES[shape]
+        i += 1
+        v = 0
+        for k in range(n):
+            if shape == "f32x4":
+                lane = parse_f32(items[i + k])
+            elif shape == "f64x2":
+                lane = parse_f64(items[i + k])
+            else:
+                lane = parse_int(items[i + k], w)
+            v |= (lane & ((1 << w) - 1)) << (w * k)
+        return v, i + n
+
     def _label_depth(self, tok, labels) -> int:
         if isinstance(tok, str) and tok.startswith("$"):
             for d, l in enumerate(reversed(labels)):
@@ -753,9 +788,17 @@ class WatCompiler:
         """One non-block instruction + its immediates from a token list."""
         op = items[i]
         i += 1
-        if op in ("unreachable", "nop", "return", "drop", "select",
+        if op in ("unreachable", "nop", "return", "drop",
                   "memory.size", "memory.grow", "memory.copy",
                   "memory.fill", "ref.is_null"):
+            out.append((op,))
+            return i
+        if op == "select":
+            if i < len(items) and isinstance(items[i], SExpr) and \
+                    items[i] and items[i][0] == "result":
+                # typed select (reference-types proposal)
+                out.append(("select_t", list(items[i][1:])))
+                return i + 1
             out.append((op,))
             return i
         if op == "i32.const":
@@ -771,7 +814,20 @@ class WatCompiler:
             out.append((op, parse_f64(items[i])))
             return i + 1
         if op == "ref.null":
-            out.append((op, items[i]))
+            ht = {"func": "funcref", "extern": "externref"}.get(
+                items[i], items[i])
+            out.append((op, ht))
+            return i + 1
+        if op == "v128.const":
+            v, i = self._v128_const(items, i)
+            out.append((op, v))
+            return i
+        if op == "i8x16.shuffle":
+            lanes = [parse_int(items[i + k], 32) & 0xFF for k in range(16)]
+            out.append((op, lanes))
+            return i + 16
+        if op in _LANE_IMM:
+            out.append((op, parse_int(items[i], 32)))
             return i + 1
         if op in _IDX_IMM:
             space = _IDX_IMM[op]
@@ -806,17 +862,41 @@ class WatCompiler:
                     (items[i].startswith("$") or items[i].isdigit()):
                 tbl = self._resolve(items[i], self.table_names)
                 i += 1
-            ti = None
+            tu = []
             while i < len(items) and isinstance(items[i], SExpr) and \
                     items[i] and items[i][0] in ("type", "param", "result"):
-                ti, _, _, _, _rest = self._split_typeuse(items[i:i + 1])
+                tu.append(items[i])
                 i += 1
-            if ti is None:
-                ti = self._intern_type((), ())
+            ti, _, _, _, _rest = self._split_typeuse(tu)
             out.append((op, ti, tbl))
             return i
-        if op in ("table.copy", "table.init"):
-            raise WatError(f"{op} unsupported in wat v1")
+        if op == "table.copy":
+            # (table.copy $dst $src) | bare = table 0 -> table 0
+            dst = src = 0
+            if i < len(items) and isinstance(items[i], str) and \
+                    (items[i].startswith("$") or items[i].isdigit()):
+                dst = self._resolve(items[i], self.table_names)
+                src = self._resolve(items[i + 1], self.table_names)
+                i += 2
+            out.append((op, dst, src))
+            return i
+        if op == "table.init":
+            # (table.init $t $e) | (table.init $e)
+            tbl, seg = 0, None
+            toks = []
+            while i < len(items) and isinstance(items[i], str) and \
+                    (items[i].startswith("$") or items[i].isdigit()):
+                toks.append(items[i])
+                i += 1
+            if len(toks) == 1:
+                seg = self._resolve(toks[0], self.elem_names)
+            elif len(toks) >= 2:
+                tbl = self._resolve(toks[0], self.table_names)
+                seg = self._resolve(toks[1], self.elem_names)
+            else:
+                raise WatError("table.init: missing element segment")
+            out.append((op, seg, tbl))
+            return i
         if op in _MEM_OPS:
             align = _MEM_OPS[op]
             offset = 0
@@ -897,6 +977,7 @@ class WatCompiler:
             got = self.b.add_type(list(params), list(results))
             if got != want:
                 raise WatError("duplicate (type) forms unsupported")
+        self._types_emitted = len(self.types)
         for fn in self.funcs:
             if fn.import_mod is not None:
                 tp, tr = self.types[fn.type_idx]
@@ -916,11 +997,22 @@ class WatCompiler:
             self._seq(fn.body, fn, [None], body)
             tp, tr = self.types[fn.type_idx]
             self.b.add_function(list(tp), list(tr), fn.locals, body)
+        # call_indirect typeuses interned during body compilation above
+        # extend self.types; replay the tail into the builder
+        for want in range(self._types_emitted, len(self.types)):
+            params, results = self.types[want]
+            got = self.b.add_type(list(params), list(results))
+            if got != want:
+                raise WatError("late type interning index skew")
         for kind, nm, idx in self.exports:
             enc = {"func": 0, "table": 1, "memory": 2, "global": 3}[kind]
             self.b.exports.append(self.b._name(nm) + bytes([enc]) + uleb(idx))
         if self.start_idx is not None:
             self.b.set_start(self.start_idx)
+        if self.b.datas:
+            # memory.init/data.drop validation needs the DataCount
+            # section; emitting it whenever data segments exist is legal
+            self.b.data_count = len(self.b.datas)
 
     def build(self) -> bytes:
         return self.b.build()
@@ -993,6 +1085,34 @@ def _parse_const(e: SExpr):
         return ("ref", 0)
     if op == "ref.extern":
         return ("ref", int(e[1]))
+    if op == "v128.const":
+        shape = e[1]
+        if shape not in _V128_SHAPES:
+            raise WatError(f"v128.const: bad shape {shape!r}")
+        n, w = _V128_SHAPES[shape]
+        lanes = list(e[2:2 + n])
+        if shape in ("f32x4", "f64x2") and any(
+                ln in ("nan:canonical", "nan:arithmetic") for ln in lanes):
+            # per-lane expected list for float shapes with NaN classes
+            vals = []
+            for ln in lanes:
+                if ln in ("nan:canonical", "nan:arithmetic"):
+                    vals.append(ln)
+                elif shape == "f32x4":
+                    vals.append(parse_f32(ln))
+                else:
+                    vals.append(parse_f64(ln))
+            return ("v128", (shape, vals))
+        v = 0
+        for k in range(n):
+            if shape == "f32x4":
+                lane = parse_f32(lanes[k])
+            elif shape == "f64x2":
+                lane = parse_f64(lanes[k])
+            else:
+                lane = parse_int(lanes[k], w)
+            v |= (lane & ((1 << w) - 1)) << (w * k)
+        return ("v128", v)
     raise WatError(f"bad const {op}")
 
 
