@@ -410,3 +410,307 @@ def test_version_and_listings():
     assert C.we_ModuleInstanceListFunctionLength(inst) == 1
     assert C.we_ModuleInstanceListMemory(inst) == ["m"]
     assert C.we_ModuleInstanceListGlobal(inst) == ["g"]
+
+
+# ---------------------------------------------------------------------------
+# round-4 parity families: String, ref Values, Compiler knobs,
+# Import/Export type contexts, Store find/list remainder, standalone
+# host FunctionInstance, memory pointers, VM ASTModule/async-run forms
+# (reference: wasmedge.h families; parity table in CAPI_PARITY.md)
+# ---------------------------------------------------------------------------
+
+def _fib_mod():
+    conf = C.we_ConfigureCreate()
+    loader = C.we_LoaderCreate(conf)
+    res, mod = C.we_LoaderParseFromBuffer(loader, build_fib())
+    assert C.we_ResultOK(res)
+    return conf, mod
+
+
+def test_string_family():
+    s = C.we_StringCreateByCString("hello")
+    assert C.we_StringIsEqual(s, C.we_StringWrap("hello"))
+    assert not C.we_StringIsEqual(s, C.we_StringCreateByCString("world"))
+    b = C.we_StringCreateByBuffer(b"hello world", 5)
+    assert C.we_StringIsEqual(s, b)
+    assert C.we_StringCopy(3, s) == "hel"
+    C.we_StringDelete(s)
+
+
+def test_result_constants():
+    assert C.we_ResultOK(C.we_Result_Success)
+    assert not C.we_ResultOK(C.we_Result_Terminate)
+    assert not C.we_ResultOK(C.we_Result_Fail)
+    assert C.we_ResultGetCode(C.we_Result_Terminate) == int(
+        ErrCode.Terminated)
+
+
+def test_ref_values():
+    st = C.we_StoreCreate()
+    null = C.we_ValueGenNullRef("funcref")
+    assert C.we_ValueIsNullRef(null)
+    fr = C.we_ValueGenFuncRef(7)
+    assert not C.we_ValueIsNullRef(fr)
+    assert C.we_ValueGetFuncRef(fr) == 7
+    obj = {"k": 1}
+    er = C.we_ValueGenExternRef(st, obj)
+    assert C.we_ValueGetExternRef(st, er) is obj
+    v = C.we_ValueGenV128((1 << 100) | 5)
+    assert C.we_ValueGetV128(v) == (1 << 100) | 5
+
+
+def test_compiler_configure_knobs():
+    conf = C.we_ConfigureCreate()
+    assert C.we_ConfigureCompilerGetOptimizationLevel(conf) == "O3"
+    C.we_ConfigureCompilerSetOptimizationLevel(conf, "Os")
+    assert C.we_ConfigureCompilerGetOptimizationLevel(conf) == "Os"
+    C.we_ConfigureCompilerSetOutputFormat(conf, "Native")
+    assert C.we_ConfigureCompilerGetOutputFormat(conf) == "Native"
+    for setter, getter in (
+            (C.we_ConfigureCompilerSetDumpIR,
+             C.we_ConfigureCompilerIsDumpIR),
+            (C.we_ConfigureCompilerSetGenericBinary,
+             C.we_ConfigureCompilerIsGenericBinary),
+            (C.we_ConfigureCompilerSetInterruptible,
+             C.we_ConfigureCompilerIsInterruptible)):
+        assert getter(conf) is False
+        setter(conf, True)
+        assert getter(conf) is True
+
+
+def test_import_export_type_contexts():
+    b = ModuleBuilder()
+    b.import_func("env", "h", ["i32"], ["i32"])
+    b.add_memory(1, 4)
+    b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("call", 0),
+    ], export="go")
+    conf = C.we_ConfigureCreate()
+    loader = C.we_LoaderCreate(conf)
+    _res, mod = C.we_LoaderParseFromBuffer(loader, b.build())
+    assert C.we_ASTModuleListImportsLength(mod) == 1
+    its = C.we_ASTModuleListImportTypes(mod)
+    it = its[0]
+    assert C.we_ImportTypeGetModuleName(it) == "env"
+    assert C.we_ImportTypeGetExternalName(it) == "h"
+    assert C.we_ImportTypeGetExternalType(it) == "func"
+    ft = C.we_ImportTypeGetFunctionType(it)
+    assert len(ft.params) == 1 and len(ft.results) == 1
+    assert C.we_ImportTypeGetTableType(it) is None
+    assert C.we_ASTModuleListExportsLength(mod) >= 1
+    ets = C.we_ASTModuleListExportTypes(mod)
+    go = [e for e in ets if C.we_ExportTypeGetExternalName(e) == "go"][0]
+    assert C.we_ExportTypeGetExternalType(go) == "func"
+    ft2 = C.we_ExportTypeGetFunctionType(go)
+    assert len(ft2.params) == 1
+    # tuple-compat iteration (pre-round-4 shape)
+    m, n, k = it
+    assert (m, n, k) == ("env", "h", "func")
+    C.we_ASTModuleDelete(mod)
+
+
+def test_limit_is_equal():
+    from wasmedge_tpu.loader.ast import Limit
+
+    assert C.we_LimitIsEqual(Limit(1, 4), Limit(1, 4))
+    assert not C.we_LimitIsEqual(Limit(1, 4), Limit(1, 5))
+    assert not C.we_LimitIsEqual(Limit(1, None), Limit(1, 4))
+
+
+def test_store_find_and_list_families():
+    b = ModuleBuilder()
+    b.add_memory(1, 2, export="mem")
+    b.add_global("i32", True, [("i32.const", 7)], export="g")
+    b.add_function([], ["i32"], [], [("i32.const", 3)], export="f")
+    data = b.build()
+    conf = C.we_ConfigureCreate()
+    vm = C.we_VMCreate(conf)
+    assert C.we_ResultOK(C.we_VMRegisterModuleFromBuffer(vm, "m", data))
+    res, _ = C.we_VMRunWasmFromBuffer(vm, data, "f")
+    assert C.we_ResultOK(res)
+    store = C.we_VMGetStoreContext(vm)
+    assert C.we_StoreGetActiveModule(store) is not None
+    assert C.we_StoreFindFunction(store, "f") is not None
+    assert C.we_StoreFindMemory(store, "mem") is not None
+    assert C.we_StoreFindGlobal(store, "g") is not None
+    assert C.we_StoreFindTable(store, "nope") is None
+    assert C.we_StoreListFunction(store) == ["f"]
+    assert C.we_StoreListFunctionLength(store) == 1
+    assert C.we_StoreListMemory(store) == ["mem"]
+    assert C.we_StoreListMemoryLength(store) == 1
+    assert C.we_StoreListGlobal(store) == ["g"]
+    assert C.we_StoreListGlobalLength(store) == 1
+    assert C.we_StoreListTable(store) == []
+    assert C.we_StoreListTableLength(store) == 0
+    # registered variants
+    assert C.we_StoreFindMemoryRegistered(store, "m", "mem") is not None
+    assert C.we_StoreFindGlobalRegistered(store, "m", "g") is not None
+    assert C.we_StoreFindTableRegistered(store, "m", "nope") is None
+    assert C.we_StoreListFunctionRegistered(store, "m") == ["f"]
+    assert C.we_StoreListFunctionRegisteredLength(store, "m") == 1
+    assert C.we_StoreListMemoryRegistered(store, "m") == ["mem"]
+    assert C.we_StoreListMemoryRegisteredLength(store, "m") == 1
+    assert C.we_StoreListGlobalRegisteredLength(store, "m") == 1
+    assert C.we_StoreListTableRegisteredLength(store, "m") == 0
+
+
+def test_function_instance_create_and_executor_invoke_registered():
+    ft = C.we_FunctionTypeCreate(["i32", "i32"], ["i32"])
+    seen = []
+
+    def host(data, mem, vals):
+        seen.append(data)
+        a = C.we_ValueGetI32(vals[0])
+        bb = C.we_ValueGetI32(vals[1])
+        return C.we_Result_Success, [C.we_ValueGenI32(a * bb)]
+
+    fi = C.we_FunctionInstanceCreate(ft, host, data="tok")
+    imp = C.we_ImportObjectCreate("env")
+    imp.add_func("mul", fi)
+    b = ModuleBuilder()
+    b.import_func("env", "mul", ["i32", "i32"], ["i32"])
+    b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("i32.const", 6), ("call", 0),
+    ], export="six_times")
+    conf = C.we_ConfigureCreate()
+    vm = C.we_VMCreate(conf)
+    assert C.we_ResultOK(C.we_VMRegisterModuleFromImport(vm, imp))
+    res, out = C.we_VMRunWasmFromBuffer(
+        vm, b.build(), "six_times", [C.we_ValueGenI32(7)])
+    assert C.we_ResultOK(res)
+    assert C.we_ValueGetI32(out[0]) == 42
+    assert seen == ["tok"]
+    # ExecutorInvokeRegistered against the named host module
+    ex = C.we_ExecutorCreate(conf)
+    store = C.we_VMGetStoreContext(vm)
+    res, out = C.we_ExecutorInvokeRegistered(
+        ex, store, "env", "mul",
+        [C.we_ValueGenI32(3), C.we_ValueGenI32(5)])
+    assert C.we_ResultOK(res)
+    assert C.we_ValueGetI32(out[0]) == 15
+
+
+def test_function_instance_create_binding():
+    ft = C.we_FunctionTypeCreate(["i32"], ["i32"])
+
+    def wrap(binding, data, mem, vals):
+        assert binding == "BIND" and data == "DATA"
+        return C.we_Result_Success, [
+            C.we_ValueGenI32(C.we_ValueGetI32(vals[0]) + 1)]
+
+    fi = C.we_FunctionInstanceCreateBinding(ft, wrap, binding="BIND",
+                                            data="DATA")
+    imp = C.we_ImportObjectCreate("env")
+    assert C.we_ImportObjectGetModuleName(imp) == "env"
+    imp.add_func("inc", fi)
+    b = ModuleBuilder()
+    b.import_func("env", "inc", ["i32"], ["i32"])
+    b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("call", 0)], export="f")
+    vm = C.we_VMCreate(C.we_ConfigureCreate())
+    C.we_VMRegisterModuleFromImport(vm, imp)
+    res, out = C.we_VMRunWasmFromBuffer(vm, b.build(), "f",
+                                        [C.we_ValueGenI32(41)])
+    assert C.we_ResultOK(res) and C.we_ValueGetI32(out[0]) == 42
+
+
+def test_memory_pointers():
+    b = ModuleBuilder()
+    b.add_memory(1, 2, export="mem")
+    b.add_function([], ["i32"], [], [
+        ("i32.const", 16), ("i32.load", 2, 0)], export="peek")
+    vm = C.we_VMCreate(C.we_ConfigureCreate())
+    res, _ = C.we_VMRunWasmFromBuffer(vm, b.build(), "peek")
+    assert C.we_ResultOK(res)
+    mem = C.we_StoreFindMemory(C.we_VMGetStoreContext(vm), "mem")
+    view = C.we_MemoryInstanceGetPointer(mem, 16, 4)
+    view[:4] = (1234567).to_bytes(4, "little")
+    res, out = C.we_VMExecute(vm, "peek")
+    assert C.we_ValueGetI32(out[0]) == 1234567
+    const = C.we_MemoryInstanceGetPointerConst(mem, 16, 4)
+    assert const == (1234567).to_bytes(4, "little")
+    with pytest.raises(TrapError):
+        C.we_MemoryInstanceGetPointer(mem, 65536 - 2, 4)
+
+
+def test_vm_astmodule_and_file_forms(tmp_path):
+    conf, mod = _fib_mod()
+    vm = C.we_VMCreate(conf)
+    res, out = C.we_VMRunWasmFromASTModule(vm, mod, "fib",
+                                           [C.we_ValueGenI32(10)])
+    assert C.we_ResultOK(res) and C.we_ValueGetI32(out[0]) == 55
+    # load-from-AST staged form
+    vm2 = C.we_VMCreate(C.we_ConfigureCreate())
+    assert C.we_ResultOK(C.we_VMLoadWasmFromASTModule(vm2, mod))
+    assert C.we_ResultOK(C.we_VMValidate(vm2))
+    assert C.we_ResultOK(C.we_VMInstantiate(vm2))
+    res, out = C.we_VMExecute(vm2, "fib", [C.we_ValueGenI32(9)])
+    assert C.we_ValueGetI32(out[0]) == 34
+    # register-from-AST / from-file
+    vm3 = C.we_VMCreate(C.we_ConfigureCreate())
+    assert C.we_ResultOK(C.we_VMRegisterModuleFromASTModule(vm3, "m", mod))
+    assert C.we_VMGetFunctionTypeRegistered(vm3, "m", "fib") is not None
+    assert C.we_VMGetFunctionTypeRegistered(vm3, "m", "nope") is None
+    p = tmp_path / "fib.wasm"
+    p.write_bytes(build_fib())
+    vm4 = C.we_VMCreate(C.we_ConfigureCreate())
+    assert C.we_ResultOK(C.we_VMRegisterModuleFromFile(vm4, "f", str(p)))
+    res, out = C.we_VMExecuteRegistered(vm4, "f", "fib",
+                                        [C.we_ValueGenI32(8)])
+    assert C.we_ResultOK(res) and C.we_ValueGetI32(out[0]) == 21
+
+
+def test_vm_async_run_family(tmp_path):
+    conf, mod = _fib_mod()
+    vm = C.we_VMCreate(conf)
+    h = C.we_VMAsyncRunWasmFromBuffer(vm, build_fib(), "fib",
+                                      [C.we_ValueGenI32(10)])
+    C.we_AsyncWait(h)
+    assert C.we_AsyncGetReturnsLength(h) == 1
+    res, out = C.we_AsyncGet(h)
+    assert C.we_ResultOK(res) and C.we_ValueGetI32(out[0]) == 55
+    C.we_AsyncDelete(h)
+    h = C.we_VMAsyncRunWasmFromASTModule(vm, mod, "fib",
+                                         [C.we_ValueGenI32(9)])
+    res, out = C.we_AsyncGet(h)
+    assert C.we_ValueGetI32(out[0]) == 34
+    p = tmp_path / "fib.wasm"
+    p.write_bytes(build_fib())
+    h = C.we_VMAsyncRunWasmFromFile(vm, str(p), "fib",
+                                    [C.we_ValueGenI32(8)])
+    res, out = C.we_AsyncGet(h)
+    assert C.we_ValueGetI32(out[0]) == 21
+    # registered async
+    vm2 = C.we_VMCreate(C.we_ConfigureCreate())
+    C.we_VMRegisterModuleFromBuffer(vm2, "m", build_fib())
+    h = C.we_VMAsyncExecuteRegistered(vm2, "m", "fib",
+                                      [C.we_ValueGenI32(7)])
+    res, out = C.we_AsyncGet(h)
+    assert C.we_ResultOK(res) and C.we_ValueGetI32(out[0]) == 13
+
+
+def test_vm_get_import_module_context():
+    conf = C.we_ConfigureCreate()
+    C.we_ConfigureAddHostRegistration(conf, "wasi")
+    vm = C.we_VMCreate(conf)
+    assert C.we_VMGetImportModuleContext(vm, "wasi") is not None
+    assert C.we_VMGetImportModuleContext(vm, "wasmedge_process") is None
+    C.we_LoaderDelete(None)
+    C.we_ValidatorDelete(None)
+    C.we_ExecutorDelete(None)
+    C.we_ImportObjectDelete(None)
+    C.we_FunctionInstanceDelete(None)
+
+
+def test_capi_parity_table_complete():
+    """Every reference export has a we_* counterpart (CAPI_PARITY.md is
+    generated from this same diff)."""
+    import re
+
+    hdr = open("/root/reference/include/api/wasmedge/wasmedge.h").read()
+    ref = set("we_" + m[len("WasmEdge_"):] for m in re.findall(
+        r"WasmEdge_[A-Za-z0-9_]+(?= *\()", hdr))
+    ref = {r for r in ref if not r.endswith("_t")}
+    have = set(dir(C))
+    missing = sorted(r for r in ref if r not in have)
+    assert not missing, missing

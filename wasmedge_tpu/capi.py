@@ -649,6 +649,13 @@ def we_AsyncCancel(handle) -> None:
 
 
 def we_AsyncGet(handle):
+    if not hasattr(handle, "inner"):
+        # async-run family handles: the task already yields the
+        # (we_Result, [we_Value]) pair
+        res, out = _wrap(handle.get)
+        if not we_ResultOK(res):
+            return res, []
+        return out
     res, out = _wrap(handle.inner.get)
     if not we_ResultOK(res):
         return res, []
@@ -1003,3 +1010,601 @@ def we_VMGetActiveModule(ctx):
     """The anonymous (last-instantiated) module instance
     (reference: WasmEdge_VMGetActiveModule)."""
     return ctx.vm.active_module
+
+
+# ---------------------------------------------------------------------------
+# String (reference: WasmEdge_String family, wasmedge.h WasmEdge_String*)
+# In C these manage ownership of char buffers; here we_String is a thin
+# immutable wrapper so embedders port against the same call shapes.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class we_String:
+    buf: str
+
+
+def we_StringCreateByCString(s: str) -> we_String:
+    return we_String(str(s))
+
+
+def we_StringCreateByBuffer(data, length: int) -> we_String:
+    if isinstance(data, (bytes, bytearray)):
+        return we_String(bytes(data[:length]).decode("utf-8", "replace"))
+    return we_String(str(data)[:length])
+
+
+def we_StringWrap(s: str, length: Optional[int] = None) -> we_String:
+    return we_String(s if length is None else s[:length])
+
+
+def we_StringIsEqual(a, b) -> bool:
+    sa = a.buf if isinstance(a, we_String) else str(a)
+    sb = b.buf if isinstance(b, we_String) else str(b)
+    return sa == sb
+
+
+def we_StringCopy(dst_len: int, s) -> str:
+    src = s.buf if isinstance(s, we_String) else str(s)
+    return src[:dst_len]
+
+
+def we_StringDelete(s) -> None:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Result constructors (reference: WasmEdge_Result / _Terminate / _Fail)
+# ---------------------------------------------------------------------------
+
+we_Result_Terminate = we_Result(int(ErrCode.Terminated), "terminated")
+we_Result_Fail = we_Result(int(ErrCode.ExecutionFailed), "generic runtime error")
+
+
+# ---------------------------------------------------------------------------
+# Reference values (reference: ValueGenFuncRef/ExternRef/NullRef family)
+# ---------------------------------------------------------------------------
+
+
+def we_ValueGenNullRef(ref_type: str = "funcref") -> we_Value:
+    return we_Value("funcref" if ref_type in ("funcref", "func")
+                    else "externref", 0)
+
+
+def we_ValueGenFuncRef(index: int) -> we_Value:
+    # handle encoding matches the engines' ref cells: 0 is null,
+    # index+1 is a live funcref
+    return we_Value("funcref", (int(index) + 1) & MASK64)
+
+
+def we_ValueGenExternRef(store, obj) -> we_Value:
+    """Extern refs intern the host object in the store (the reference
+    boxes a void*; the TPU engines need a 64-bit cell, storemgr
+    intern_ref provides it)."""
+    return we_Value("externref", store.intern_ref(obj) & MASK64)
+
+
+def we_ValueGetFuncRef(v: we_Value) -> Optional[int]:
+    return None if v.raw == 0 else int(v.raw) - 1
+
+
+def we_ValueGetExternRef(store, v: we_Value):
+    return store.deref(int(v.raw))
+
+
+def we_ValueIsNullRef(v: we_Value) -> bool:
+    return v.type in ("funcref", "externref") and v.raw == 0
+
+
+def we_ValueGetV128(v: we_Value) -> int:
+    return v.raw & ((1 << 128) - 1)
+
+
+# ---------------------------------------------------------------------------
+# Compiler knobs on Configure (reference: ConfigureCompiler* family,
+# include/common/configure.h:28-106); see CompilerConfigure for the
+# TPU-mapping caveats.
+# ---------------------------------------------------------------------------
+
+
+def we_ConfigureCompilerSetOptimizationLevel(conf: Configure,
+                                             level: str) -> None:
+    conf.compiler.optimization_level = level
+
+
+def we_ConfigureCompilerGetOptimizationLevel(conf: Configure) -> str:
+    return conf.compiler.optimization_level
+
+
+def we_ConfigureCompilerSetOutputFormat(conf: Configure, fmt: str) -> None:
+    conf.compiler.output_format = fmt
+
+
+def we_ConfigureCompilerGetOutputFormat(conf: Configure) -> str:
+    return conf.compiler.output_format
+
+
+def we_ConfigureCompilerSetDumpIR(conf: Configure, on: bool) -> None:
+    conf.compiler.dump_ir = bool(on)
+
+
+def we_ConfigureCompilerIsDumpIR(conf: Configure) -> bool:
+    return conf.compiler.dump_ir
+
+
+def we_ConfigureCompilerSetGenericBinary(conf: Configure, on: bool) -> None:
+    conf.compiler.generic_binary = bool(on)
+
+
+def we_ConfigureCompilerIsGenericBinary(conf: Configure) -> bool:
+    return conf.compiler.generic_binary
+
+
+def we_ConfigureCompilerSetInterruptible(conf: Configure, on: bool) -> None:
+    conf.compiler.interruptible = bool(on)
+
+
+def we_ConfigureCompilerIsInterruptible(conf: Configure) -> bool:
+    return conf.compiler.interruptible
+
+
+# ---------------------------------------------------------------------------
+# Import/Export type contexts (reference: WasmEdge_ImportTypeGet* /
+# ExportTypeGet* over contexts produced by ASTModuleListImports/Exports)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class we_ImportType:
+    module: str
+    name: str
+    kind: str          # func | table | memory | global
+    desc: object = dataclasses.field(repr=False, default=None)
+    ast_mod: object = dataclasses.field(repr=False, default=None)
+
+    # tuple-compat for embedders iterating the listing like the older
+    # (module, name, kind) shape
+    def __iter__(self):
+        return iter((self.module, self.name, self.kind))
+
+    def __getitem__(self, i):
+        return (self.module, self.name, self.kind)[i]
+
+
+@dataclasses.dataclass(frozen=True)
+class we_ExportType:
+    name: str
+    kind: str
+    index: int
+    ast_mod: object = dataclasses.field(repr=False, default=None)
+
+    def __iter__(self):
+        return iter((self.name, self.kind))
+
+    def __getitem__(self, i):
+        return (self.name, self.kind)[i]
+
+
+_KINDS = {0: "func", 1: "table", 2: "memory", 3: "global"}
+
+
+def we_ASTModuleListImportsLength(ast_mod) -> int:
+    return len(ast_mod.imports)
+
+
+def we_ASTModuleListImportTypes(ast_mod) -> List[we_ImportType]:
+    return [we_ImportType(im.module, im.name, _KINDS.get(im.kind, "?"),
+                          im, ast_mod)
+            for im in ast_mod.imports]
+
+
+def we_ASTModuleListExportsLength(ast_mod) -> int:
+    return len(ast_mod.exports)
+
+
+def we_ASTModuleListExportTypes(ast_mod) -> List[we_ExportType]:
+    return [we_ExportType(ex.name, _KINDS.get(ex.kind, "?"), ex.index,
+                          ast_mod)
+            for ex in ast_mod.exports]
+
+
+def we_ASTModuleDelete(ast_mod) -> None:
+    pass
+
+
+def we_ImportTypeGetModuleName(it: we_ImportType) -> str:
+    return it.module
+
+
+def we_ImportTypeGetExternalName(it: we_ImportType) -> str:
+    return it.name
+
+
+def we_ImportTypeGetExternalType(it: we_ImportType) -> str:
+    return it.kind
+
+
+def we_ImportTypeGetFunctionType(it: we_ImportType):
+    if it.kind != "func" or it.desc is None:
+        return None
+    return it.ast_mod.types[it.desc.type_idx]
+
+
+def we_ImportTypeGetTableType(it: we_ImportType):
+    return it.desc.table_type if it.kind == "table" and it.desc else None
+
+
+def we_ImportTypeGetMemoryType(it: we_ImportType):
+    return it.desc.memory_type if it.kind == "memory" and it.desc else None
+
+
+def we_ImportTypeGetGlobalType(it: we_ImportType):
+    return it.desc.global_type if it.kind == "global" and it.desc else None
+
+
+def _export_desc_type(et: we_ExportType, kind, pool_getter):
+    if et.kind != kind or et.ast_mod is None:
+        return None
+    return pool_getter(et.ast_mod)[et.index]
+
+
+def we_ExportTypeGetExternalName(et: we_ExportType) -> str:
+    return et.name
+
+
+def we_ExportTypeGetExternalType(et: we_ExportType) -> str:
+    return et.kind
+
+
+def we_ExportTypeGetFunctionType(et: we_ExportType):
+    if et.kind != "func" or et.ast_mod is None:
+        return None
+    m = et.ast_mod
+    return m.func_type_of(et.index)
+
+
+def we_ExportTypeGetTableType(et: we_ExportType):
+    return _export_desc_type(et, "table", lambda m: m.all_table_types())
+
+
+def we_ExportTypeGetMemoryType(et: we_ExportType):
+    return _export_desc_type(et, "memory", lambda m: m.all_memory_types())
+
+
+def we_ExportTypeGetGlobalType(et: we_ExportType):
+    return _export_desc_type(et, "global", lambda m: m.all_global_types())
+
+
+def we_LimitIsEqual(a, b) -> bool:
+    return (a.min == b.min and a.max == b.max
+            and getattr(a, "shared", False) == getattr(b, "shared", False))
+
+
+# ---------------------------------------------------------------------------
+# Store find/list remainder (reference: WasmEdge_StoreFind*/List* —
+# wasmedge.h Store family; active-module forms search the anonymous
+# module, Registered forms a named one, storemgr.h:199-218)
+# ---------------------------------------------------------------------------
+
+
+def _store_active(store):
+    return store.get_active_module()
+
+
+def we_StoreGetActiveModule(store):
+    return _store_active(store)
+
+
+def _find_in(inst, kind: str, name: str):
+    if inst is None:
+        return None
+    ex = inst.exports.get(name)
+    kinds = {"func": 0, "table": 1, "memory": 2, "global": 3}
+    if ex is None or ex[0] != kinds[kind]:
+        return None
+    return inst.export_instance(name)
+
+
+def we_StoreFindFunction(store, name: str):
+    return _find_in(_store_active(store), "func", name)
+
+
+def we_StoreFindTable(store, name: str):
+    return _find_in(_store_active(store), "table", name)
+
+
+def we_StoreFindMemory(store, name: str):
+    return _find_in(_store_active(store), "memory", name)
+
+
+def we_StoreFindGlobal(store, name: str):
+    return _find_in(_store_active(store), "global", name)
+
+
+def we_StoreFindTableRegistered(store, mod: str, name: str):
+    return _find_in(store.find_module(mod), "table", name)
+
+
+def we_StoreFindMemoryRegistered(store, mod: str, name: str):
+    return _find_in(store.find_module(mod), "memory", name)
+
+
+def we_StoreFindGlobalRegistered(store, mod: str, name: str):
+    return _find_in(store.find_module(mod), "global", name)
+
+
+def _list_exports(inst, kind: str) -> List[str]:
+    if inst is None:
+        return []
+    kinds = {"func": 0, "table": 1, "memory": 2, "global": 3}
+    return [n for n, (k, _i) in sorted(inst.exports.items())
+            if k == kinds[kind]]
+
+
+def we_StoreListFunction(store) -> List[str]:
+    return _list_exports(_store_active(store), "func")
+
+
+def we_StoreListFunctionLength(store) -> int:
+    return len(we_StoreListFunction(store))
+
+
+def we_StoreListFunctionRegistered(store, mod: str) -> List[str]:
+    return _list_exports(store.find_module(mod), "func")
+
+
+def we_StoreListFunctionRegisteredLength(store, mod: str) -> int:
+    return len(we_StoreListFunctionRegistered(store, mod))
+
+
+def we_StoreListTable(store) -> List[str]:
+    return _list_exports(_store_active(store), "table")
+
+
+def we_StoreListTableLength(store) -> int:
+    return len(we_StoreListTable(store))
+
+
+def we_StoreListTableRegistered(store, mod: str) -> List[str]:
+    return _list_exports(store.find_module(mod), "table")
+
+
+def we_StoreListTableRegisteredLength(store, mod: str) -> int:
+    return len(we_StoreListTableRegistered(store, mod))
+
+
+def we_StoreListMemory(store) -> List[str]:
+    return _list_exports(_store_active(store), "memory")
+
+
+def we_StoreListMemoryLength(store) -> int:
+    return len(we_StoreListMemory(store))
+
+
+def we_StoreListMemoryRegistered(store, mod: str) -> List[str]:
+    return _list_exports(store.find_module(mod), "memory")
+
+
+def we_StoreListMemoryRegisteredLength(store, mod: str) -> int:
+    return len(we_StoreListMemoryRegistered(store, mod))
+
+
+def we_StoreListGlobal(store) -> List[str]:
+    return _list_exports(_store_active(store), "global")
+
+
+def we_StoreListGlobalLength(store) -> int:
+    return len(we_StoreListGlobal(store))
+
+
+def we_StoreListGlobalRegistered(store, mod: str) -> List[str]:
+    return _list_exports(store.find_module(mod), "global")
+
+
+def we_StoreListGlobalRegisteredLength(store, mod: str) -> int:
+    return len(we_StoreListGlobalRegistered(store, mod))
+
+
+# ---------------------------------------------------------------------------
+# Standalone host FunctionInstance creation (reference:
+# WasmEdge_FunctionInstanceCreate / CreateBinding, wasmedge.h)
+# ---------------------------------------------------------------------------
+
+
+def we_FunctionInstanceCreate(func_type, host_fn, data=None, cost: int = 0):
+    """host_fn(data, mem, params: [we_Value]) -> (we_Result, [we_Value]);
+    the C callback ABI with the void* user-data slot made explicit."""
+    from wasmedge_tpu.runtime.hostfunc import PyHostFunction
+
+    params = list(func_type.params)
+    results = list(func_type.results)
+
+    def fn(mem, *typed_args):
+        vals = [we_Value(getattr(t, "name", str(t)).lower(),
+                         typed_to_bits(t, a))
+                for t, a in zip(func_type.params, typed_args)]
+        res, outs = host_fn(data, mem, vals)
+        if not we_ResultOK(res):
+            code = (ErrCode(res.code) if res.code in
+                    set(int(e) for e in ErrCode) else ErrCode.HostFuncError)
+            raise TrapError(code, res.message)
+        outs = outs or []
+        typed = tuple(bits_to_typed(t, o.raw & MASK64)
+                      for t, o in zip(func_type.results, outs))
+        return typed if len(typed) != 1 else typed[0]
+
+    return PyHostFunction(fn, params, results, cost=cost)
+
+
+def we_FunctionInstanceCreateBinding(func_type, wrap_fn, binding=None,
+                                     data=None, cost: int = 0):
+    """The reference's language-binding variant: wrap_fn receives the
+    binding token verbatim (bindings marshal through it)."""
+    def host_fn(d, mem, vals):
+        return wrap_fn(binding, d, mem, vals)
+
+    return we_FunctionInstanceCreate(func_type, host_fn, data, cost)
+
+
+def we_FunctionInstanceDelete(fi) -> None:
+    pass
+
+
+def we_MemoryInstanceGetPointer(mem, offset: int, length: int):
+    """Mutable view of guest memory (the reference hands out uint8_t*;
+    Python's analog is a writable memoryview over the backing bytes)."""
+    mem.check_bounds(offset, length)
+    return memoryview(mem.data)[offset:offset + length]
+
+
+def we_MemoryInstanceGetPointerConst(mem, offset: int, length: int):
+    mem.check_bounds(offset, length)
+    return bytes(mem.data[offset:offset + length])
+
+
+# ---------------------------------------------------------------------------
+# Pipeline deletes (contexts are GC'd; present for call-shape parity)
+# ---------------------------------------------------------------------------
+
+
+def we_LoaderDelete(loader) -> None:
+    pass
+
+
+def we_ValidatorDelete(validator) -> None:
+    pass
+
+
+def we_ExecutorDelete(executor) -> None:
+    pass
+
+
+def we_ExecutorInvokeRegistered(executor, store, mod_name: str,
+                                func_name: str,
+                                params: Sequence[we_Value]):
+    def go():
+        inst = store.find_module(mod_name)
+        if inst is None:
+            raise TrapError(ErrCode.WrongInstanceAddress, mod_name)
+        fi = inst.find_func(func_name)
+        if fi is None:
+            raise TrapError(ErrCode.FuncNotFound, func_name)
+        cells = executor.invoke_raw(store, fi, [p.raw for p in params])
+        return _cells_to_values(fi.functype.results, cells)
+
+    res, out = _wrap(go)
+    return res, (out or [])
+
+
+def we_ImportObjectDelete(imp) -> None:
+    pass
+
+
+def we_ImportObjectGetModuleName(imp) -> str:
+    return imp.name
+
+
+def we_ImportObjectInitWasmEdgeProcess(imp, allowed_cmds=None,
+                                       allow_all: bool = False) -> None:
+    imp.env.allowed_cmds = set(allowed_cmds or [])
+    imp.env.allowed_all = bool(allow_all)
+
+
+# ---------------------------------------------------------------------------
+# VM remainder: ASTModule/file forms + async-run family (reference:
+# WasmEdge_VMRunWasmFromASTModule, VMAsyncRunWasmFrom*, wasmedge.h;
+# async: include/vm/async.h:25-105)
+# ---------------------------------------------------------------------------
+
+
+def we_VMLoadWasmFromASTModule(ctx, ast_mod) -> we_Result:
+    return _wrap(lambda: ctx.vm.load_wasm(ast_mod))[0]
+
+
+def we_VMRunWasmFromASTModule(ctx, ast_mod, func_name: str,
+                              params: Sequence[we_Value] = ()):
+    res = we_VMLoadWasmFromASTModule(ctx, ast_mod)
+    if not we_ResultOK(res):
+        return res, []
+    res = we_VMValidate(ctx)
+    if not we_ResultOK(res):
+        return res, []
+    res = we_VMInstantiate(ctx)
+    if not we_ResultOK(res):
+        return res, []
+    return we_VMExecute(ctx, func_name, params)
+
+
+def we_VMRegisterModuleFromFile(ctx, name: str, path: str) -> we_Result:
+    def go():
+        with open(path, "rb") as f:
+            ctx.vm.register_module(name, f.read())
+    return _wrap(go)[0]
+
+
+def we_VMRegisterModuleFromASTModule(ctx, name: str, ast_mod) -> we_Result:
+    return _wrap(lambda: ctx.vm.register_module(name, ast_mod))[0]
+
+
+def we_VMGetFunctionTypeRegistered(ctx, mod_name: str, func_name: str):
+    inst = ctx.vm.store.find_module(mod_name)
+    fi = inst.find_func(func_name) if inst is not None else None
+    return None if fi is None else fi.functype
+
+
+def we_VMGetImportModuleContext(ctx, reg: str):
+    from wasmedge_tpu.common.configure import HostRegistration
+
+    key = {"wasi": HostRegistration.Wasi,
+           "wasmedge_process": HostRegistration.WasmEdgeProcess}.get(
+        str(reg).lower())
+    return None if key is None else ctx.vm.get_import_module(key)
+
+
+def _async_call(fn, ctx):
+    from wasmedge_tpu.vm.async_ import Async
+
+    return Async(fn, stop_fn=ctx.vm.stop)
+
+
+def we_VMAsyncExecuteRegistered(ctx, mod_name: str, func_name: str,
+                                params: Sequence[we_Value] = ()):
+    return _async_call(
+        lambda: we_VMExecuteRegistered(ctx, mod_name, func_name, params),
+        ctx)
+
+
+def we_VMAsyncRunWasmFromBuffer(ctx, data: bytes, func_name: str,
+                                params: Sequence[we_Value] = ()):
+    return _async_call(
+        lambda: we_VMRunWasmFromBuffer(ctx, data, func_name, params), ctx)
+
+
+def we_VMAsyncRunWasmFromFile(ctx, path: str, func_name: str,
+                              params: Sequence[we_Value] = ()):
+    return _async_call(
+        lambda: we_VMRunWasmFromFile(ctx, path, func_name, params), ctx)
+
+
+def we_VMAsyncRunWasmFromASTModule(ctx, ast_mod, func_name: str,
+                                   params: Sequence[we_Value] = ()):
+    return _async_call(
+        lambda: we_VMRunWasmFromASTModule(ctx, ast_mod, func_name, params),
+        ctx)
+
+
+def we_AsyncGetReturnsLength(handle) -> int:
+    if hasattr(handle, "result_types"):
+        # legacy we_VMAsyncExecute handles know their arity statically
+        return len(handle.result_types)
+    try:
+        out = handle.get()
+    except Exception:
+        return 0
+    if isinstance(out, tuple) and len(out) == 2:
+        return len(out[1])
+    return 0
+
+
+def we_AsyncDelete(handle) -> None:
+    pass
