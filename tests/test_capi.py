@@ -244,8 +244,8 @@ def test_vm_batch_extension():
 # the spec corpus through the capi VM family (APIVMCoreTest model)
 # ---------------------------------------------------------------------------
 
-def _capi_spec_callbacks():
-    vm = C.we_VMCreate()
+def _capi_spec_callbacks(conf=None):
+    vm = C.we_VMCreate(conf)
 
     def on_module(name, data):
         if name:
@@ -291,15 +291,19 @@ def _capi_spec_callbacks():
 def test_spec_corpus_through_capi():
     corpus = sorted(glob.glob(os.path.join(HERE, "spec", "*.wast")))
     assert corpus
+    from wasmedge_tpu.spec import _conf_for_file
+
     total_passed = 0
     for path in corpus:
-        st = _capi_spec_callbacks()
+        # per-file proposal gating, as run_corpus does (tail_call.wast
+        # needs the TailCall proposal enabled)
+        st = _capi_spec_callbacks(_conf_for_file(path))
         with open(path) as f:
             rep = st.run_script(f.read(), os.path.basename(path))
         detail = "\n".join(str(x) for x in rep.failures[:10])
         assert rep.failed == 0, f"{path}: {rep.failed} failed\n{detail}"
         total_passed += rep.passed
-    assert total_passed > 3000
+    assert total_passed > 9900
 
 
 # ---------------------------------------------------------------------------
