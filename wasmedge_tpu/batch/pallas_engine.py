@@ -2439,8 +2439,15 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 return nc
             # periodic commit: one canary validation + snapshot per
             # snap_steps dispatches (the whole point — per-step
-            # cross-lane reductions become per-interval)
-            due = ((nc[0] - nc[IDX["ls"]]) >= I32(snap_steps)) & \
+            # cross-lane reductions become per-interval).  The FIRST
+            # interval after launch is short: genuinely divergent blocks
+            # (mixed entries the scheduler could not group) diverge
+            # within a few hundred steps, and a short first window
+            # bounds the optimistic run-up their rollback discards.
+            interval = jnp.where(nc[IDX["ls"]] == 0,
+                                 I32(min(512, snap_steps)),
+                                 I32(snap_steps))
+            due = ((nc[0] - nc[IDX["ls"]]) >= interval) & \
                 (nc[7] == I32(ST_RUNNING))
 
             @pl.when(due)
@@ -2879,7 +2886,12 @@ class PallasUniformEngine:
 
         import jax
 
+        import inspect
+
         h = hashlib.sha256()
+        # the kernel SOURCE is part of the key: any edit to the kernel
+        # body must invalidate previously exported artifacts
+        h.update(inspect.getsource(_build_kernel).encode())
         h.update(repr(self._kargs).encode())
         h.update(repr((self.optimistic, self.SNAP_STEPS)).encode())
         for k in ("hid", "a", "b", "c", "ilo", "ihi"):
