@@ -2890,8 +2890,18 @@ class PallasUniformEngine:
 
         h = hashlib.sha256()
         # the kernel SOURCE is part of the key: any edit to the kernel
-        # body must invalidate previously exported artifacts
+        # body must invalidate previously exported artifacts.  The
+        # traced kernel also inlines helpers from sibling modules
+        # (laneops alu/shift/mul emulation, image opcode encodings,
+        # softfloat, simdops) — a semantic change there must invalidate
+        # too, so hash the whole modules, not just this file.
         h.update(inspect.getsource(_build_kernel).encode())
+        import wasmedge_tpu.batch.image as _image_mod
+        import wasmedge_tpu.batch.laneops as _laneops_mod
+        import wasmedge_tpu.batch.simdops as _simdops_mod
+        import wasmedge_tpu.batch.softfloat as _softfloat_mod
+        for _m in (_laneops_mod, _softfloat_mod, _simdops_mod, _image_mod):
+            h.update(inspect.getsource(_m).encode())
         h.update(repr(self._kargs).encode())
         h.update(repr((self.optimistic, self.SNAP_STEPS)).encode())
         for k in ("hid", "a", "b", "c", "ilo", "ihi"):

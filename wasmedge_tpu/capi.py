@@ -1426,9 +1426,14 @@ def we_FunctionInstanceCreate(func_type, host_fn, data=None, cost: int = 0):
         res, outs = host_fn(data, mem, vals)
         if not we_ResultOK(res):
             code = (ErrCode(res.code) if res.code in
-                    set(int(e) for e in ErrCode) else ErrCode.HostFuncError)
+                    set(int(e) for e in ErrCode) else ErrCode.HostFuncFailed)
             raise TrapError(code, res.message)
         outs = outs or []
+        if len(outs) != len(func_type.results):
+            # the reference treats a host function returning the wrong
+            # arity as a host-func failure, never a silent truncation
+            raise TrapError(ErrCode.HostFuncFailed,
+                            "host function result arity mismatch")
         typed = tuple(bits_to_typed(t, o.raw & MASK64)
                       for t, o in zip(func_type.results, outs))
         return typed if len(typed) != 1 else typed[0]

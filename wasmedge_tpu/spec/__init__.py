@@ -319,8 +319,9 @@ def _conf_for_file(path) -> Configure:
     (/root/reference/test/spec/spectest.cpp:213-217)."""
     from wasmedge_tpu.common.configure import Proposal
 
+    import os as _os
     conf = Configure()
-    name = str(path)
+    name = _os.path.basename(str(path))
     if "tail_call" in name:
         conf.add_proposal(Proposal.TailCall)
     if "multi_memory" in name:

@@ -873,11 +873,16 @@ class WatCompiler:
         if op == "table.copy":
             # (table.copy $dst $src) | bare = table 0 -> table 0
             dst = src = 0
-            if i < len(items) and isinstance(items[i], str) and \
+            toks = []
+            while i < len(items) and isinstance(items[i], str) and \
                     (items[i].startswith("$") or items[i].isdigit()):
-                dst = self._resolve(items[i], self.table_names)
-                src = self._resolve(items[i + 1], self.table_names)
-                i += 2
+                toks.append(items[i])
+                i += 1
+            if len(toks) == 2:
+                dst = self._resolve(toks[0], self.table_names)
+                src = self._resolve(toks[1], self.table_names)
+            elif toks:
+                raise WatError("table.copy expects 0 or 2 table indices")
             out.append((op, dst, src))
             return i
         if op == "table.init":
