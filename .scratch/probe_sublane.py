@@ -1,0 +1,89 @@
+"""Probe: per-dispatch cost of (1, Lblk) row ops vs (1, 8, C) slab ops.
+
+Simulates the warp-interpreter's inner loop access pattern: a
+lax.while_loop whose body reads two dynamic rows of a VMEM scratch
+plane, combines them, and writes one row back (the shape of every
+ALU2 handler).  Old layout: rows are (1, Lblk).  New layout: rows are
+(1, 8, C) slabs with C = Lblk // 8.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+D = 64
+LBLK = 4096
+C = LBLK // 8
+STEPS = 20000
+
+
+def build(kind):
+    if kind == "old":
+        shape = (D, LBLK)
+
+        def srow(ref, i):
+            return ref[pl.ds(i, 1), :]
+
+        def wrow(ref, i, v):
+            ref[pl.ds(i, 1), :] = v
+    else:
+        shape = (D, 8, C)
+
+        def srow(ref, i):
+            return ref[pl.ds(i, 1), :, :]
+
+        def wrow(ref, i, v):
+            ref[pl.ds(i, 1), :, :] = v
+
+    def kernel(x_ref, o_ref, scr, sem):
+        cp = pltpu.make_async_copy(x_ref, scr, sem)
+        cp.start()
+        cp.wait()
+
+        def body(c):
+            i, acc = c
+            a = srow(scr, i % (D - 2))
+            b = srow(scr, (i + 1) % (D - 2))
+            wrow(scr, D - 1, a + b ^ (a >> 1))
+            return (i + 1, acc + 1)
+
+        def cond(c):
+            return c[0] < STEPS
+
+        lax.while_loop(cond, body, (jnp.int32(0), jnp.int32(0)))
+        cp = pltpu.make_async_copy(scr, o_ref, sem)
+        cp.start()
+        cp.wait()
+
+    fn = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
+        scratch_shapes=[pltpu.VMEM(shape, jnp.int32),
+                        pltpu.SemaphoreType.DMA],
+    )
+    x = jnp.asarray(np.random.randint(0, 100, shape, np.int32))
+    return jax.jit(fn), x
+
+
+for kind in ("old", "new"):
+    try:
+        fn, x = build(kind)
+        r = fn(x)
+        r.block_until_ready()
+        t0 = time.perf_counter()
+        N = 5
+        for _ in range(N):
+            r = fn(x)
+        r.block_until_ready()
+        dt = (time.perf_counter() - t0) / N
+        print(f"{kind}: {dt*1e3:.2f} ms/launch, "
+              f"{dt/STEPS*1e9:.1f} ns/step, "
+              f"{STEPS*LBLK/dt/1e9:.2f} G lane-ops/s")
+    except Exception as e:
+        print(f"{kind}: FAILED {type(e).__name__}: {str(e)[:500]}")

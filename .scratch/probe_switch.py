@@ -1,0 +1,103 @@
+"""Probe 2: isolate what makes the warp-interpreter step cost 590ns.
+
+Adds, one at a time: SMEM-table-driven pc chain, lax.switch over N
+handlers, multi-row handlers (slo/shi pairs), carry width.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+D = 64
+LBLK = 4096
+STEPS = 200000
+CODE = 256
+
+
+def build(nhandlers, rows_per_handler, sub8):
+    C = LBLK // 8
+    shape = (D, 8, C) if sub8 else (D, LBLK)
+
+    def srow(ref, i):
+        return ref[pl.ds(i, 1)] if sub8 else ref[pl.ds(i, 1), :]
+
+    def wrow(ref, i, v):
+        if sub8:
+            ref[pl.ds(i, 1)] = v
+        else:
+            ref[pl.ds(i, 1), :] = v
+
+    def kernel(hid_r, a_r, x_ref, o_ref, slo, shi, sem):
+        for ref in (slo,):
+            cp = pltpu.make_async_copy(x_ref, ref, sem)
+            cp.start()
+            cp.wait()
+
+        def mk_handler(k):
+            def h(c):
+                steps, pc, sp = c
+                out_sp = (sp + 1) % (D - 2)
+                for r in range(rows_per_handler):
+                    a = srow(slo, (sp + k) % (D - 2))
+                    b = srow(shi, (sp + r) % (D - 2))
+                    wrow(slo, out_sp, a + b)
+                    wrow(shi, out_sp, a ^ b)
+                return (steps, a_r[pc], out_sp)
+            return h
+
+        handlers = [mk_handler(k) for k in range(nhandlers)]
+
+        def body(c):
+            steps, pc, sp = c
+            nc = lax.switch(hid_r[pc], handlers, c)
+            return (steps + 1, nc[1], nc[2])
+
+        def cond(c):
+            return c[0] < STEPS
+
+        lax.while_loop(cond, body, (jnp.int32(0), jnp.int32(0),
+                                    jnp.int32(0)))
+        cp = pltpu.make_async_copy(slo, o_ref, sem)
+        cp.start()
+        cp.wait()
+
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM(shape, jnp.int32),
+                        pltpu.VMEM(shape, jnp.int32),
+                        pltpu.SemaphoreType.DMA],
+    )
+    fn = pl.pallas_call(
+        kernel, grid_spec=spec,
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
+    )
+    hid = jnp.asarray(np.random.randint(0, nhandlers, CODE, np.int32))
+    a = jnp.asarray(np.random.randint(0, CODE, CODE, np.int32))
+    x = jnp.asarray(np.random.randint(0, 100, shape, np.int32))
+    return jax.jit(fn), (hid, a, x)
+
+
+for sub8 in (False, True):
+    for nh, rph in ((1, 1), (8, 1), (32, 1), (32, 2), (64, 2)):
+        try:
+            fn, args = build(nh, rph, sub8)
+            r = fn(*args)
+            r.block_until_ready()
+            t0 = time.perf_counter()
+            N = 3
+            for _ in range(N):
+                r = fn(*args)
+            r.block_until_ready()
+            dt = (time.perf_counter() - t0) / N
+            print(f"sub8={sub8} handlers={nh} rows={rph}: "
+                  f"{dt/STEPS*1e9:7.1f} ns/step")
+        except Exception as e:
+            print(f"sub8={sub8} handlers={nh} rows={rph}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:300]}")
