@@ -48,16 +48,20 @@ def _uleb(v: int) -> bytes:
 
 
 def fused_planes_for(img: LoweredModule, mod):
-    """The Pallas engine's fused encoding (superinstruction hid/operand
-    planes — the `_build_kernel` cache-key planes), derived from the
-    lowered image and the module's DECLARED types/tables (mod is
-    required: dense type ids and the call_indirect table window are
-    derived from it, and the batch subset forbids table mutation, so the
-    declared minimum table size equals the live size).  Returns None
-    when the module is outside the batch subset."""
+    """The Pallas engine's fused encoding: the block-fused hid plane
+    (fuse_blocks rewrites block-head slots to block-shape ids; operand
+    planes a/b/c/ilo/ihi stay the originals — handlers read immediates
+    at pc+offset) derived from the lowered image and the module's
+    DECLARED types/tables (mod is required: dense type ids and the
+    call_indirect table window are derived from it, and the batch
+    subset forbids table mutation, so the declared minimum table size
+    equals the live size).  Block SHAPES are not persisted: consumers
+    regenerate them (deterministically) with the hid plane they verify
+    against.  Returns None when the module is outside the batch
+    subset."""
     from wasmedge_tpu.batch.image import batchability, build_device_image
     from wasmedge_tpu.batch.pallas_engine import (
-        fuse_image,
+        fuse_blocks,
         hid_plane,
         pallas_image_eligibility,
     )
@@ -74,10 +78,9 @@ def fused_planes_for(img: LoweredModule, mod):
     # crash (VERDICT r3 weak #1)
     if pallas_image_eligibility(dimg) is not None:
         return None
-    hid = hid_plane(dimg)
-    hid, a, b, c, ilo, ihi = fuse_image(hid, dimg.a, dimg.b, dimg.c,
-                                        dimg.imm_lo, dimg.imm_hi, dimg)
-    return {"hid": hid, "a": a, "b": b, "c": c, "ilo": ilo, "ihi": ihi}
+    hid, _shapes = fuse_blocks(hid_plane(dimg), dimg)
+    return {"hid": hid, "a": dimg.a, "b": dimg.b, "c": dimg.c,
+            "ilo": dimg.imm_lo, "ihi": dimg.imm_hi}
 
 
 def serialize_image(img: LoweredModule, mod=None) -> bytes:

@@ -336,6 +336,148 @@ def fuse_image(hid, a, b, c, ilo, ihi, img):
     return hid, a, b, c, ilo, ihi
 
 
+# ---------------------------------------------------------------------------
+# Basic-block fusion
+# ---------------------------------------------------------------------------
+# The generalized successor of the peephole superinstructions above:
+# every maximal straight-line run of *pure* stack ops (const, local/
+# global traffic, drop/select, non-trapping alu) fuses into ONE handler
+# that keeps intermediate values in vector registers — dispatch cost
+# (measured ~150ns/dispatch: the lax.cond tree walk plus the VMEM
+# dependency chain between consecutive stack ops) is paid once per
+# block instead of once per instruction.  Any non-pure op (branch,
+# call, return, load/store, div/rem, memory.*, hostcall) is absorbed as
+# the block's TERMINAL: the handler flushes its virtual stack to the
+# VMEM rows the op expects and delegates to the op's ORIGINAL handler,
+# so branch/trap/park/divergence semantics are reused verbatim.
+#
+# Only the head slot's hid is rewritten; absorbed slots keep their
+# original hids and operand fields, so any pc remains independently
+# dispatchable — mid-block branch targets, SIMT-handoff resumptions and
+# hostcall re-arms execute the original per-op stream until the next
+# block head (every jump target starts a fresh block, so hot loop
+# bodies always re-enter fused).  A terminal that stops un-advanced
+# (divergence, regrow) leaves pc at the terminal's own slot where the
+# scheduler sees the ORIGINAL opcode and resolves it with the existing
+# split machinery.  This mirrors what the reference's threaded
+# interpreter gets from its compiler for free: straight-line runs with
+# values in registers (/root/reference/lib/executor/engine/
+# engine.cpp:68-1641).
+H_BLOCK_BASE = NUM_HANDLERS
+MAX_BLOCK_SHAPES = 96   # distinct block shapes compiled per kernel
+MAX_BLOCK_LEN = 24      # ops per block (incl. the terminal)
+
+
+def _trapping_alu1_subs():
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    return set(lo_ops.alu1_trap_fns().keys())
+
+
+def fuse_blocks(hid, img):
+    """Rewrite block-head hids to H_BLOCK_BASE + shape id.
+
+    Returns (hid', shapes) where shapes is a tuple of block shapes;
+    each shape is a tuple of op descriptors:
+
+      ("nop",) ("const",) ("drop",) ("select",) ("memsize",)
+      ("lget", k) ("lset", k) ("ltee", k)   k = local ORDINAL (first-
+      ("gget", k) ("gset", k)                occurrence rank, so blocks
+      ("alu2", sub) ("alu1", sub)            using different locals in
+      ("term", flat_hid)                     the same pattern share)
+
+    Immediates/indices are NOT in the shape (handlers read them from
+    the SMEM planes at pc+offset), except local/global ordinals, whose
+    equality structure decides value forwarding, and alu subs, which
+    pick the compute fn.  Deterministic: tpu.aot artifacts verify the
+    persisted hid plane by regeneration (aot/__init__.py)."""
+    n = img.code_len
+    targets = set(int(x) for x in img.f_entry)
+    for pc in range(n):
+        cl = int(img.cls[pc])
+        if cl in (CLS_BR, CLS_BRZ, CLS_BRNZ):
+            targets.add(int(img.a[pc]))
+    for e in range(img.br_table.shape[0]):
+        targets.add(int(img.br_table[e, 0]))
+    # call-return / hostcall-re-arm / trap-partial-resume addresses need
+    # no seeding: a non-pure op always ends its block, so the next block
+    # starts at its pc+1 anyway, and absorbed slots keep their original
+    # hids, so any resume pc stays independently dispatchable.
+
+    trap1 = _trapping_alu1_subs()
+
+    def pure_desc(pc, lmap, gmap):
+        """Descriptor if the op at pc is pure (fusible mid-block)."""
+        cl = int(img.cls[pc])
+        if cl == CLS_NOP:
+            return ("nop",)
+        if cl == CLS_CONST:
+            return ("const",)
+        if cl == CLS_DROP:
+            return ("drop",)
+        if cl == CLS_SELECT:
+            return ("select",)
+        if cl == CLS_MEMSIZE:
+            return ("memsize",)
+        if cl in (CLS_LOCAL_GET, CLS_LOCAL_SET, CLS_LOCAL_TEE):
+            k = lmap.setdefault(int(img.a[pc]), len(lmap))
+            return ({CLS_LOCAL_GET: "lget", CLS_LOCAL_SET: "lset",
+                     CLS_LOCAL_TEE: "ltee"}[cl], k)
+        if cl in (CLS_GLOBAL_GET, CLS_GLOBAL_SET):
+            k = gmap.setdefault(int(img.a[pc]), len(gmap))
+            return ("gget" if cl == CLS_GLOBAL_GET else "gset", k)
+        if cl == CLS_ALU2:
+            sub = int(img.sub[pc])
+            if sub in _DIV32_SUBS or sub in _DIV64_SUBS:
+                return None
+            return ("alu2", sub)
+        if cl == CLS_ALU1:
+            sub = int(img.sub[pc])
+            if sub in trap1:
+                return None
+            return ("alu1", sub)
+        return None
+
+    hid = hid.copy()
+    shapes = []
+    shape_ids = {}
+    pc = 0
+    while pc < n:
+        # scan a candidate block starting at pc
+        lmap, gmap = {}, {}
+        ops = []
+        j = pc
+        while (j < n and len(ops) < MAX_BLOCK_LEN - 1
+               and (j == pc or j not in targets)):
+            d = pure_desc(j, lmap, gmap)
+            if d is None:
+                break
+            ops.append(d)
+            j += 1
+        # absorb the stopping op as terminal unless the run stopped at
+        # a pure op (a jump-target boundary: that op starts its own
+        # block).  A non-pure terminal may itself be a jump target —
+        # direct jumps to it dispatch its untouched original hid.
+        term = None
+        if ops and j < n and pure_desc(j, {}, {}) is None:
+            term = ("term", int(hid[j]))
+            j += 1
+        total = len(ops) + (1 if term else 0)
+        shape = tuple(ops) + ((term,) if term else ())
+        if total >= 2 and (shape in shape_ids
+                           or len(shapes) < MAX_BLOCK_SHAPES):
+            sid = shape_ids.get(shape)
+            if sid is None:
+                sid = len(shapes)
+                shape_ids[shape] = sid
+                shapes.append(shape)
+            hid[pc] = H_BLOCK_BASE + sid
+            pc = j
+        else:
+            pc += 1
+    return hid, tuple(shapes)
+
+
 # SMEM budget for the 7 code planes — the ONE code-size limit shared by
 # the engine (PallasUniformEngine.MAX_CODE_LEN) and the tpu.aot
 # serializer via pallas_image_eligibility's default.
@@ -403,6 +545,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                   max_local_zeros: int, mem_pages_cap: int,
                   mem_pages_hard: int, gatherable: bool, interpret: bool,
                   mem_hbm: bool = False, CW: int = 0,
+                  block_shapes: tuple = (),
                   optimistic: bool = False, snap_steps: int = 8192,
                   shadow_full: bool = None):
     """Compile the chunk-runner for one kernel geometry.
@@ -541,8 +684,11 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         # _FUEL_OFF disables.  The loop stops at the fuel boundary and the
         # post-loop check below converts exhaustion into CostLimitExceeded —
         # same per-instruction decrement semantics as the SIMT engine's
-        # per-lane fuel plane (superinstructions may overshoot by their
-        # fused length, <= 3 wasm instructions).
+        # per-lane fuel plane.  Fused dispatches may overshoot the
+        # boundary by their block length (< MAX_BLOCK_LEN instructions);
+        # the kill itself is always delivered — only the exact stopping
+        # instruction is block-granular, like the reference's
+        # per-codeblock cost check (lib/executor/engine/engine.cpp).
         fuel_in = ctrl_r[blk, _C_FUEL]
         chunk_eff = jnp.minimum(chunk, fuel_in)
 
@@ -735,21 +881,45 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             wrow(shi, sp - 3, jnp.where(cond == 0, v1h, v2h))
             return keep(c, pc=pc + 1, sp=sp - 2)
 
-        def h_br(c):
+        def br_with(c, top1=None):
             pc, sp, ob = c[1], c[2], c[4]
             tgt, nkeep, pop_to = a_r[pc], b_r[pc], c_r[pc]
             tgt_sp = ob + pop_to
+            kept = top1 if top1 is not None else \
+                (srow(slo, sp - 1), srow(shi, sp - 1))
 
             @pl.when(nkeep == 1)
             def _():
-                wrow(slo, tgt_sp, srow(slo, sp - 1))
-                wrow(shi, tgt_sp, srow(shi, sp - 1))
+                wrow(slo, tgt_sp, kept[0])
+                wrow(shi, tgt_sp, kept[1])
 
             return keep(c, pc=tgt, sp=tgt_sp + nkeep)
 
-        def h_brz(c):
+        def h_br(c):
+            return br_with(c)
+
+        # The *_with cores take optional vreg views of the top one/two
+        # stack cells (top1 = value at sp-1, top2 = at sp-2, each a
+        # (lo, hi) pair).  Fused blocks pass values still held in
+        # vector registers, skipping the VMEM round trip between the
+        # producing op and the branch (~100ns of store-load dependency
+        # per block); the unfused h_* wrappers pass None and read rows.
+        # `spill` marks vreg-passed inputs that are NOT yet in their
+        # rows: careful-mode divergence bails write them back so the
+        # scheduler's split machinery sees the exact pre-op stack.
+        def _spill_tops(sp, top1, top2, spill):
+            if not spill:
+                return
+            if top1 is not None:
+                wrow(slo, sp - 1, top1[0])
+                wrow(shi, sp - 1, top1[1])
+            if top2 is not None:
+                wrow(slo, sp - 2, top2[0])
+                wrow(shi, sp - 2, top2[1])
+
+        def brz_with(c, top1=None, spill=False):
             pc, sp = c[1], c[2]
-            cond = srow(slo, sp - 1)
+            cond = top1[0] if top1 is not None else srow(slo, sp - 1)
             if optimistic:
                 t0 = agree_nz(cond)
                 new_pc = jnp.where(t0 == 0, a_r[pc], pc + 1)
@@ -757,14 +927,24 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             t0 = scal(cond)
             agree = allsame(cond, t0)
             new_pc = jnp.where(t0 == 0, a_r[pc], pc + 1)
+
+            def diverge():
+                _spill_tops(sp, top1, None, spill)
+                return keep(c, status=I32(ST_DIVERGED))
+
             return lax.cond(
                 agree,
                 lambda: keep(c, pc=new_pc, sp=sp - 1),
-                lambda: keep(c, status=I32(ST_DIVERGED)))
+                diverge)
 
-        def h_brnz(c):
+        def h_brz(c):
+            return brz_with(c)
+
+        def brnz_with(c, top1=None, top2=None, spill=False):
             pc, sp, ob = c[1], c[2], c[4]
-            cond = srow(slo, sp - 1)
+            cond = top1[0] if top1 is not None else srow(slo, sp - 1)
+            kept = top2 if top2 is not None else \
+                (srow(slo, sp - 2), srow(shi, sp - 2))
             tgt, nkeep, pop_to = a_r[pc], b_r[pc], c_r[pc]
             tgt_sp = ob + pop_to
             if optimistic:
@@ -773,8 +953,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(taken & (nkeep == 1))
                 def _():
-                    wrow(slo, tgt_sp, srow(slo, sp - 2))
-                    wrow(shi, tgt_sp, srow(shi, sp - 2))
+                    wrow(slo, tgt_sp, kept[0])
+                    wrow(shi, tgt_sp, kept[1])
 
                 return lax.cond(
                     taken,
@@ -786,8 +966,12 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
             @pl.when(agree & taken & (nkeep == 1))
             def _():
-                wrow(slo, tgt_sp, srow(slo, sp - 2))
-                wrow(shi, tgt_sp, srow(shi, sp - 2))
+                wrow(slo, tgt_sp, kept[0])
+                wrow(shi, tgt_sp, kept[1])
+
+            def diverge():
+                _spill_tops(sp, top1, top2, spill)
+                return keep(c, status=I32(ST_DIVERGED))
 
             return lax.cond(
                 agree,
@@ -795,11 +979,16 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     taken,
                     lambda: keep(c, pc=tgt, sp=tgt_sp + nkeep),
                     lambda: keep(c, pc=pc + 1, sp=sp - 1)),
-                lambda: keep(c, status=I32(ST_DIVERGED)))
+                diverge)
 
-        def h_br_table(c):
+        def h_brnz(c):
+            return brnz_with(c)
+
+        def br_table_with(c, top1=None, top2=None, spill=False):
             pc, sp, ob = c[1], c[2], c[4]
-            idx = srow(slo, sp - 1)
+            idx = top1[0] if top1 is not None else srow(slo, sp - 1)
+            kept = top2 if top2 is not None else \
+                (srow(slo, sp - 2), srow(shi, sp - 2))
             i0 = agree_i32(idx) if optimistic else scal(idx)
             agree = True if optimistic else allsame(idx, i0)
             base, n = a_r[pc], b_r[pc]
@@ -810,22 +999,31 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
             @pl.when(agree & (nkeep == 1))
             def _():
-                wrow(slo, tgt_sp, srow(slo, sp - 2))
-                wrow(shi, tgt_sp, srow(shi, sp - 2))
+                wrow(slo, tgt_sp, kept[0])
+                wrow(shi, tgt_sp, kept[1])
+
+            def diverge():
+                _spill_tops(sp, top1, top2, spill)
+                return keep(c, status=I32(ST_DIVERGED))
 
             return lax.cond(
                 agree,
                 lambda: keep(c, pc=tgt, sp=tgt_sp + nkeep),
-                lambda: keep(c, status=I32(ST_DIVERGED)))
+                diverge)
 
-        def h_return(c):
+        def h_br_table(c):
+            return br_table_with(c)
+
+        def return_with(c, top1=None):
             pc, sp, fp, cd = c[1], c[2], c[3], c[5]
             nres = b_r[pc]
+            res = top1 if top1 is not None else \
+                (srow(slo, sp - 1), srow(shi, sp - 1))
 
             @pl.when(nres == 1)
             def _():
-                wrow(slo, fp, srow(slo, sp - 1))
-                wrow(shi, fp, srow(shi, sp - 1))
+                wrow(slo, fp, res[0])
+                wrow(shi, fp, res[1])
 
             new_sp = fp + nres
             rd = jnp.clip(cd - 1, 0, CD - 1)
@@ -835,6 +1033,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 lambda: keep(c, pc=frames_out[blk, 0, rd], sp=new_sp,
                              fp=frames_out[blk, 1, rd],
                              ob=frames_out[blk, 2, rd], cd=cd - 1))
+
+        def h_return(c):
+            return return_with(c)
 
         def _do_call(c, callee, sp_eff):
             pc, fp, ob, cd = c[1], c[3], c[4], c[5]
@@ -871,9 +1072,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def h_call(c):
             return _do_call(c, a_r[c[1]], c[2])
 
-        def h_call_indirect(c):
+        def calli_with(c, top1=None, spill=False):
             pc, sp = c[1], c[2]
-            idx = srow(slo, sp - 1)
+            idx = top1[0] if top1 is not None else srow(slo, sp - 1)
             i0 = agree_i32(idx) if optimistic else scal(idx)
             agree = True if optimistic else allsame(idx, i0)
             tb_size, tb_base = b_r[pc], c_r[pc]
@@ -893,12 +1094,19 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 trapr[0, :] = jnp.full((Lblk,), code, I32)
                 return keep(c, status=I32(ST_TRAPPED_BASE) + code)
 
+            def diverge():
+                _spill_tops(sp, top1, None, spill)
+                return keep(c, status=I32(ST_DIVERGED))
+
             return lax.cond(
                 agree,
                 lambda: lax.cond(
                     oob | null | sig_bad, bad,
                     lambda: _do_call(keep(c, sp=sp - 1), callee, sp - 1)),
-                lambda: keep(c, status=I32(ST_DIVERGED)))
+                diverge)
+
+        def h_call_indirect(c):
+            return calli_with(c)
 
         def h_memsize(c):
             pc, sp, pages = c[1], c[2], c[6]
@@ -2375,6 +2583,139 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     lambda: keep(c, pc=pc + 1))
             return h
 
+        def mk_block(shape):
+            """Fused basic block: pure ops run with intermediates in
+            vregs (virtual stack resolved at trace time); local/global
+            writes commit immediately in op order; on reaching the
+            terminal the remaining virtual stack is flushed to its VMEM
+            rows and the terminal's ORIGINAL handler runs with
+            pc/sp positioned at its own slot — so every branch/trap/
+            park/divergence path behaves exactly as unfused, with the
+            committed prefix already retired."""
+            pure_ops = shape[:-1] if shape[-1][0] == "term" else shape
+            term = shape[-1] if shape[-1][0] == "term" else None
+            nops = len(pure_ops)
+
+            def h(c):
+                pc, sp0, fp = c[1], c[2], c[3]
+                vstack = []      # (lo, hi) vreg pairs above entry sp
+                state = {"nbelow": 0}
+                pend_l = {}      # local ordinal -> forwarded value
+                pend_g = {}
+
+                def vpop(discard=False):
+                    if vstack:
+                        return vstack.pop()
+                    k = state["nbelow"]
+                    state["nbelow"] = k + 1
+                    if discard:
+                        return None
+                    idx = sp0 - 1 - k
+                    return (srow(slo, idx), srow(shi, idx))
+
+                def vpeek():
+                    if vstack:
+                        return vstack[-1]
+                    idx = sp0 - 1 - state["nbelow"]
+                    return (srow(slo, idx), srow(shi, idx))
+
+                for j, op in enumerate(pure_ops):
+                    pcj = pc + j
+                    kind = op[0]
+                    if kind == "nop":
+                        pass
+                    elif kind == "const":
+                        vstack.append((full(ilo_r[pcj]), full(ihi_r[pcj])))
+                    elif kind == "lget":
+                        v = pend_l.get(op[1])
+                        if v is None:
+                            src = fp + a_r[pcj]
+                            v = (srow(slo, src), srow(shi, src))
+                        vstack.append(v)
+                    elif kind in ("lset", "ltee"):
+                        v = vpop() if kind == "lset" else vpeek()
+                        dst = fp + a_r[pcj]
+                        wrow(slo, dst, v[0])
+                        wrow(shi, dst, v[1])
+                        pend_l[op[1]] = v
+                    elif kind == "gget":
+                        v = pend_g.get(op[1])
+                        if v is None:
+                            g = a_r[pcj]
+                            v = (srow(glo, g), srow(ghi, g))
+                        vstack.append(v)
+                    elif kind == "gset":
+                        v = vpop()
+                        g = a_r[pcj]
+                        wrow(glo, g, v[0])
+                        wrow(ghi, g, v[1])
+                        pend_g[op[1]] = v
+                    elif kind == "drop":
+                        vpop(discard=True)
+                    elif kind == "select":
+                        cnd = vpop()
+                        x2 = vpop()
+                        x1 = vpop()
+                        z = cnd[0] == 0
+                        vstack.append((jnp.where(z, x2[0], x1[0]),
+                                       jnp.where(z, x2[1], x1[1])))
+                    elif kind == "memsize":
+                        vstack.append((full(c[6]), full(0)))
+                    elif kind == "alu2":
+                        y = vpop()
+                        x = vpop()
+                        vstack.append(alu2[op[1]](x[0], x[1], y[0], y[1]))
+                    elif kind == "alu1":
+                        x = vpop()
+                        vstack.append(alu1[op[1]](x[0], x[1]))
+
+                nbelow = state["nbelow"]
+                sp_t = sp0 + (len(vstack) - nbelow)
+                if term is None:
+                    for i, (vl, vh) in enumerate(vstack):
+                        wrow(slo, sp0 - nbelow + i, vl)
+                        wrow(shi, sp0 - nbelow + i, vh)
+                    return keep(c, steps=c[0] + nops - 1, pc=pc + nops,
+                                sp=sp_t)
+                # Branch-family terminals consume the top cells directly
+                # from vregs (no VMEM round trip between the producing
+                # op and the branch); deeper live values always flush.
+                # Values a specialized terminal consumes are NOT
+                # flushed on the happy path — the careful cores spill
+                # them on their divergence bail so the scheduler sees
+                # the exact pre-op stack.
+                t_hid = term[1]
+                # Only the cell the terminal POPS (or that dies with
+                # the unwind: return/br kept values) may skip its
+                # flush; a brnz fallthrough keeps sp-2 live, so deeper
+                # cells always flush even when also passed as vregs.
+                nvreg = 0
+                if t_hid in (H_BRZ, H_BRNZ, H_BR_TABLE, H_RETURN, H_BR,
+                             H_CALL_INDIRECT):
+                    nvreg = min(1, len(vstack))
+                for i, (vl, vh) in enumerate(vstack[:len(vstack) - nvreg]):
+                    wrow(slo, sp0 - nbelow + i, vl)
+                    wrow(shi, sp0 - nbelow + i, vh)
+                top1 = vstack[-1] if len(vstack) >= 1 else None
+                top2 = vstack[-2] if len(vstack) >= 2 else None
+                c2 = keep(c, steps=c[0] + nops, pc=pc + nops, sp=sp_t)
+                if t_hid == H_BRZ:
+                    return brz_with(c2, top1, spill=top1 is not None)
+                if t_hid == H_BRNZ:
+                    return brnz_with(c2, top1, top2,
+                                     spill=top1 is not None)
+                if t_hid == H_BR_TABLE:
+                    return br_table_with(c2, top1, top2,
+                                         spill=top1 is not None)
+                if t_hid == H_RETURN:
+                    return return_with(c2, top1)
+                if t_hid == H_BR:
+                    return br_with(c2, top1)
+                if t_hid == H_CALL_INDIRECT:
+                    return calli_with(c2, top1, spill=top1 is not None)
+                return handler_for(t_hid)(c2)
+            return h
+
         base_handlers = {
             H_NOP: h_nop, H_CONST: h_const, H_LOCAL_GET: h_local_get,
             H_LOCAL_SET: h_local_set, H_LOCAL_TEE: h_local_tee,
@@ -2388,6 +2729,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         }
 
         def handler_for(hid):
+            if hid >= H_BLOCK_BASE:
+                return mk_block(block_shapes[hid - H_BLOCK_BASE])
             if hid in (H_LOAD_W, H_LOAD_D, H_STORE_W, H_STORE_D):
                 # width-specialized paths exist for the hbm+optimistic
                 # kernel; everywhere else they alias the generic ops
@@ -2846,8 +3189,14 @@ class PallasUniformEngine:
         hid = hid_plane(img)
         a_p, b_p, c_p = img.a, img.b, img.c
         ilo_p, ihi_p = img.imm_lo, img.imm_hi
-        hid, a_p, b_p, c_p, ilo_p, ihi_p = fuse_image(
-            hid, a_p, b_p, c_p, ilo_p, ihi_p, img)
+        bf = getattr(self.cfg, "block_fusion", None)
+        self.block_fusion = True if bf is None else bool(bf)
+        if self.block_fusion:
+            hid, block_shapes = fuse_blocks(hid, img)
+        else:
+            block_shapes = ()
+            hid, a_p, b_p, c_p, ilo_p, ihi_p = fuse_image(
+                hid, a_p, b_p, c_p, ilo_p, ihi_p, img)
         # tpu.aot artifacts carry the fused encoding.  Verification IS
         # regeneration (cheap next to XLA compilation); once verified,
         # the attached planes are the ones executed — a stale or
@@ -2885,7 +3234,8 @@ class PallasUniformEngine:
             img.max_local_zeros, pages_cap, pages_hard,
             (not mem_hbm) and W * Lblk <= self.MAX_GATHER_ELEMS,
             interpret, mem_hbm,
-            self.HBM_WINDOW_ROWS if mem_hbm else 0)
+            self.HBM_WINDOW_ROWS if mem_hbm else 0,
+            block_shapes)
         self._tables = tuple(jnp.asarray(t) for t in (
             hid_dense, a_p, b_p, c_p, ilo_p, ihi_p,
             img.f_entry, img.f_nparams, img.f_nlocals, img.f_frame_top,
