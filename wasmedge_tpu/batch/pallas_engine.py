@@ -384,7 +384,18 @@ def fuse_blocks(hid, img):
       ("lget", k) ("lset", k) ("ltee", k)   k = local ORDINAL (first-
       ("gget", k) ("gset", k)                occurrence rank, so blocks
       ("alu2", sub) ("alu1", sub)            using different locals in
-      ("term", flat_hid)                     the same pattern share)
+      ("loadi", nbytes, flags)               the same pattern share)
+      ("storei", nbytes)
+      ("guardz",) ("guardnz",)
+      ("term", flat_hid)
+
+    loadi/storei are loads/stores fused INLINE (uniform-address fast
+    path; divergence/OOB bails un-advanced at the op's own slot).
+    guardz/guardnz are FORWARD branches absorbed mid-block: the block
+    speculates fallthrough and the taken path exits at the branch with
+    everything before it committed — loop back-edges (backward
+    targets) stay terminals so the common taken path pays nothing.
+    guardnz requires nkeep == 0 (no value move on the taken exit).
 
     Immediates/indices are NOT in the shape (handlers read them from
     the SMEM planes at pc+offset), except local/global ordinals, whose
@@ -436,6 +447,14 @@ def fuse_blocks(hid, img):
             if sub in trap1:
                 return None
             return ("alu1", sub)
+        if cl == CLS_LOAD:
+            return ("loadi", int(img.b[pc]), int(img.c[pc]))
+        if cl == CLS_STORE:
+            return ("storei", int(img.b[pc]))
+        if cl == CLS_BRZ and int(img.a[pc]) > pc:
+            return ("guardz",)
+        if cl == CLS_BRNZ and int(img.a[pc]) > pc and int(img.b[pc]) == 0:
+            return ("guardnz",)
         return None
 
     hid = hid.copy()
@@ -2585,135 +2604,413 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
         def mk_block(shape):
             """Fused basic block: pure ops run with intermediates in
-            vregs (virtual stack resolved at trace time); local/global
-            writes commit immediately in op order; on reaching the
-            terminal the remaining virtual stack is flushed to its VMEM
-            rows and the terminal's ORIGINAL handler runs with
-            pc/sp positioned at its own slot — so every branch/trap/
-            park/divergence path behaves exactly as unfused, with the
-            committed prefix already retired."""
-            pure_ops = shape[:-1] if shape[-1][0] == "term" else shape
+            vregs (virtual stack resolved at trace time); local/global/
+            memory writes commit immediately in op order.  Forward
+            branches absorbed as GUARDS speculate fallthrough — the
+            taken path exits through a lax.cond branch that flushes the
+            guard-point virtual stack, so nothing after the guard
+            commits.  Inline loads/stores take the uniform-address fast
+            path; address divergence (careful kernel) or a lane-0 OOB
+            bails un-advanced at the op's own slot with everything
+            before it committed, which is exactly the state the
+            scheduler's split machinery expects for the op's ORIGINAL
+            opcode.  The terminal (if any) runs via the *_with cores,
+            consuming the virtual-stack top directly from vregs."""
+            body_ops = shape[:-1] if shape[-1][0] == "term" else shape
             term = shape[-1] if shape[-1][0] == "term" else None
-            nops = len(pure_ops)
+            nops = len(body_ops)
 
             def h(c):
                 pc, sp0, fp = c[1], c[2], c[3]
-                vstack = []      # (lo, hi) vreg pairs above entry sp
-                state = {"nbelow": 0}
-                pend_l = {}      # local ordinal -> forwarded value
-                pend_g = {}
 
-                def vpop(discard=False):
-                    if vstack:
-                        return vstack.pop()
-                    k = state["nbelow"]
-                    state["nbelow"] = k + 1
-                    if discard:
-                        return None
-                    idx = sp0 - 1 - k
-                    return (srow(slo, idx), srow(shi, idx))
+                class VS:
+                    """Trace-time virtual stack (immutable snapshots:
+                    guard/bail closures capture the state at their
+                    point)."""
+                    __slots__ = ("items", "nbelow")
 
-                def vpeek():
-                    if vstack:
-                        return vstack[-1]
-                    idx = sp0 - 1 - state["nbelow"]
-                    return (srow(slo, idx), srow(shi, idx))
+                    def __init__(self, items=(), nbelow=0):
+                        self.items = tuple(items)
+                        self.nbelow = nbelow
 
-                for j, op in enumerate(pure_ops):
+                    def push(self, v):
+                        return VS(self.items + (v,), self.nbelow)
+
+                    def pop(self):
+                        if self.items:
+                            return self.items[-1], VS(self.items[:-1],
+                                                      self.nbelow)
+                        k = self.nbelow
+                        idx = sp0 - 1 - k
+                        return ((srow(slo, idx), srow(shi, idx)),
+                                VS((), k + 1))
+
+                    def drop1(self):
+                        if self.items:
+                            return VS(self.items[:-1], self.nbelow)
+                        return VS((), self.nbelow + 1)
+
+                    def peek(self):
+                        if self.items:
+                            return self.items[-1]
+                        idx = sp0 - 1 - self.nbelow
+                        return (srow(slo, idx), srow(shi, idx))
+
+                    def sp(self):
+                        return sp0 + (len(self.items) - self.nbelow)
+
+                    def flush(self, skip_top=0):
+                        base = sp0 - self.nbelow
+                        n = len(self.items) - skip_top
+                        for i in range(n):
+                            wrow(slo, base + i, self.items[i][0])
+                            wrow(shi, base + i, self.items[i][1])
+
+                def bail(cb, j, vs):
+                    """Un-advanced stop at op j: everything before j is
+                    committed; flush the virtual stack so VMEM holds
+                    the exact pre-op state, leave pc at the op's slot
+                    (original hid) for the scheduler/SIMT."""
+                    vs.flush()
+                    return keep(cb, steps=cb[0] + j, pc=pc + j,
+                                sp=vs.sp(), status=I32(ST_DIVERGED))
+
+                def emit(j, cb, vs, pend_l, pend_g):
+                    if j == nops:
+                        return finish(cb, vs)
                     pcj = pc + j
+                    op = body_ops[j]
                     kind = op[0]
                     if kind == "nop":
-                        pass
-                    elif kind == "const":
-                        vstack.append((full(ilo_r[pcj]), full(ihi_r[pcj])))
-                    elif kind == "lget":
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "const":
+                        vs = vs.push((full(ilo_r[pcj]), full(ihi_r[pcj])))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "lget":
                         v = pend_l.get(op[1])
                         if v is None:
                             src = fp + a_r[pcj]
                             v = (srow(slo, src), srow(shi, src))
-                        vstack.append(v)
-                    elif kind in ("lset", "ltee"):
-                        v = vpop() if kind == "lset" else vpeek()
+                        return emit(j + 1, cb, vs.push(v), pend_l, pend_g)
+                    if kind in ("lset", "ltee"):
+                        if kind == "lset":
+                            v, vs = vs.pop()
+                        else:
+                            v = vs.peek()
                         dst = fp + a_r[pcj]
                         wrow(slo, dst, v[0])
                         wrow(shi, dst, v[1])
-                        pend_l[op[1]] = v
-                    elif kind == "gget":
+                        return emit(j + 1, cb, vs,
+                                    {**pend_l, op[1]: v}, pend_g)
+                    if kind == "gget":
                         v = pend_g.get(op[1])
                         if v is None:
                             g = a_r[pcj]
                             v = (srow(glo, g), srow(ghi, g))
-                        vstack.append(v)
-                    elif kind == "gset":
-                        v = vpop()
+                        return emit(j + 1, cb, vs.push(v), pend_l, pend_g)
+                    if kind == "gset":
+                        v, vs = vs.pop()
                         g = a_r[pcj]
                         wrow(glo, g, v[0])
                         wrow(ghi, g, v[1])
-                        pend_g[op[1]] = v
-                    elif kind == "drop":
-                        vpop(discard=True)
-                    elif kind == "select":
-                        cnd = vpop()
-                        x2 = vpop()
-                        x1 = vpop()
+                        return emit(j + 1, cb, vs, pend_l,
+                                    {**pend_g, op[1]: v})
+                    if kind == "drop":
+                        return emit(j + 1, cb, vs.drop1(), pend_l, pend_g)
+                    if kind == "select":
+                        cnd, vs = vs.pop()
+                        x2, vs = vs.pop()
+                        x1, vs = vs.pop()
                         z = cnd[0] == 0
-                        vstack.append((jnp.where(z, x2[0], x1[0]),
-                                       jnp.where(z, x2[1], x1[1])))
-                    elif kind == "memsize":
-                        vstack.append((full(c[6]), full(0)))
-                    elif kind == "alu2":
-                        y = vpop()
-                        x = vpop()
-                        vstack.append(alu2[op[1]](x[0], x[1], y[0], y[1]))
-                    elif kind == "alu1":
-                        x = vpop()
-                        vstack.append(alu1[op[1]](x[0], x[1]))
+                        vs = vs.push((jnp.where(z, x2[0], x1[0]),
+                                      jnp.where(z, x2[1], x1[1])))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "memsize":
+                        vs = vs.push((full(cb[6]), full(0)))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "alu2":
+                        y, vs = vs.pop()
+                        x, vs = vs.pop()
+                        vs = vs.push(alu2[op[1]](x[0], x[1], y[0], y[1]))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "alu1":
+                        x, vs = vs.pop()
+                        vs = vs.push(alu1[op[1]](x[0], x[1]))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind in ("guardz", "guardnz"):
+                        return emit_guard(j, cb, vs, pend_l, pend_g)
+                    if kind == "loadi":
+                        return emit_load(j, cb, vs, pend_l, pend_g)
+                    if kind == "storei":
+                        return emit_store(j, cb, vs, pend_l, pend_g)
+                    raise AssertionError(f"unknown block op {kind}")
 
-                nbelow = state["nbelow"]
-                sp_t = sp0 + (len(vstack) - nbelow)
-                if term is None:
-                    for i, (vl, vh) in enumerate(vstack):
-                        wrow(slo, sp0 - nbelow + i, vl)
-                        wrow(shi, sp0 - nbelow + i, vh)
-                    return keep(c, steps=c[0] + nops - 1, pc=pc + nops,
-                                sp=sp_t)
-                # Branch-family terminals consume the top cells directly
-                # from vregs (no VMEM round trip between the producing
-                # op and the branch); deeper live values always flush.
-                # Values a specialized terminal consumes are NOT
-                # flushed on the happy path — the careful cores spill
-                # them on their divergence bail so the scheduler sees
-                # the exact pre-op stack.
-                t_hid = term[1]
-                # Only the cell the terminal POPS (or that dies with
-                # the unwind: return/br kept values) may skip its
-                # flush; a brnz fallthrough keeps sp-2 live, so deeper
-                # cells always flush even when also passed as vregs.
-                nvreg = 0
-                if t_hid in (H_BRZ, H_BRNZ, H_BR_TABLE, H_RETURN, H_BR,
-                             H_CALL_INDIRECT):
-                    nvreg = min(1, len(vstack))
-                for i, (vl, vh) in enumerate(vstack[:len(vstack) - nvreg]):
-                    wrow(slo, sp0 - nbelow + i, vl)
-                    wrow(shi, sp0 - nbelow + i, vh)
-                top1 = vstack[-1] if len(vstack) >= 1 else None
-                top2 = vstack[-2] if len(vstack) >= 2 else None
-                c2 = keep(c, steps=c[0] + nops, pc=pc + nops, sp=sp_t)
-                if t_hid == H_BRZ:
-                    return brz_with(c2, top1, spill=top1 is not None)
-                if t_hid == H_BRNZ:
-                    return brnz_with(c2, top1, top2,
-                                     spill=top1 is not None)
-                if t_hid == H_BR_TABLE:
-                    return br_table_with(c2, top1, top2,
+                def emit_guard(j, cb, vs, pend_l, pend_g):
+                    pcj = pc + j
+                    nz = body_ops[j][0] == "guardnz"
+                    vs_pre = vs           # incl. cond (careful bail)
+                    cond, vs = vs.pop()
+
+                    def exit_taken():
+                        vs.flush()
+                        # brz taken: sp = post-pop; brnz (nkeep==0)
+                        # taken: unwind to ob + pop_to
+                        tsp = (cb[4] + c_r[pcj]) if nz else vs.sp()
+                        return keep(cb, steps=cb[0] + j, pc=a_r[pcj],
+                                    sp=tsp)
+
+                    if optimistic:
+                        t0 = agree_nz(cond[0])
+                        taken = (t0 != 0) if nz else (t0 == 0)
+                        return lax.cond(
+                            taken, exit_taken,
+                            lambda: emit(j + 1, cb, vs, pend_l, pend_g))
+                    t0 = scal(cond[0])
+                    agree = allsame(cond[0], t0)
+                    taken = (t0 != 0) if nz else (t0 == 0)
+                    return lax.cond(
+                        agree & ~taken,
+                        lambda: emit(j + 1, cb, vs, pend_l, pend_g),
+                        lambda: lax.cond(
+                            agree, exit_taken,
+                            lambda: bail(cb, j, vs_pre)))
+
+                def _load_val(m0, m1, m2, shB, nbytes, flags):
+                    """Static-width load value extraction (the runtime
+                    where-chains of _load_finish specialized away)."""
+                    inv = (32 - shB) & 31
+                    hi_or = jnp.where(shB == 0, 0, -1)
+                    raw_lo = lax.shift_right_logical(m0, shB) | \
+                        (lax.shift_left(m1, inv) & hi_or)
+                    signed = (flags & 1) != 0
+                    is64 = (flags & 2) != 0
+                    if nbytes == 8:
+                        raw_hi = lax.shift_right_logical(m1, shB) | \
+                            (lax.shift_left(m2, inv) & hi_or)
+                        return raw_lo, raw_hi
+                    if nbytes == 4:
+                        ll = raw_lo
+                    elif nbytes == 2:
+                        ll = lax.shift_right_arithmetic(
+                            lax.shift_left(raw_lo, 16), 16) if signed \
+                            else raw_lo & 0xFFFF
+                    else:
+                        ll = lax.shift_right_arithmetic(
+                            lax.shift_left(raw_lo, 24), 24) if signed \
+                            else raw_lo & 0xFF
+                    if is64:
+                        lh = lax.shift_right_arithmetic(ll, 31) if signed \
+                            else jnp.zeros_like(ll)
+                    else:
+                        lh = jnp.zeros_like(ll)
+                    return ll, lh
+
+                def emit_load(j, cb, vs, pend_l, pend_g):
+                    pcj = pc + j
+                    nbytes, flags = body_ops[j][1], body_ops[j][2]
+                    want = 2 if nbytes == 8 else 1
+                    vs_pre = vs
+                    addr, vs = vs.pop()
+                    off = a_r[pcj]
+                    ea = addr[0] + off
+                    mem_bytes = cb[6] * I32(65536)
+                    if optimistic:
+                        ea0 = agree_i32(ea)
+                        addr0 = ea0 - off
+                        end0 = ea0 + nbytes
+                        oob0 = u_lt(ea0, addr0) | u_lt(ea0, off) | \
+                            u_lt(end0, ea0) | u_lt(mem_bytes, end0)
+                        u = jnp.clip(lax.shift_right_logical(ea0, 2),
+                                     0, W - 1)
+                        shB = (ea0 & 3) * 8
+                        if mem_hbm:
+                            rhi = jnp.minimum(u + want, W - 1)
+                            # _opt_window may SNAPSHOT (dirty-way
+                            # eviction): the snapshot must pair the
+                            # planes with a carry positioned at THIS
+                            # op — flush the pre-op virtual stack and
+                            # hand it a mid-block-consistent carry, so
+                            # a later rollback re-enters at pcj (an
+                            # absorbed slot with the original hid) and
+                            # never re-runs the committed prefix.
+                            vs_pre.flush()
+                            cb_snap = keep(cb, steps=cb[0] + j,
+                                           pc=pc + j, sp=vs_pre.sp())
+                            dirty, snapped, way, wfs2 = _opt_window(
+                                cb_snap, u, rhi)
+                            cb2 = _keep_win(
+                                cb, wfs2,
+                                ls=jnp.where(snapped, cb[0] + j,
+                                             cb[IDX["ls"]]))
+                            m0 = win_read_row(way, wfs2, u)
+                            m1 = win_read_row(way, wfs2,
+                                              jnp.minimum(u + 1, W - 1))
+                            m2 = win_read_row(way, wfs2,
+                                              jnp.minimum(u + 2, W - 1)) \
+                                if nbytes == 8 else None
+                            vs2 = vs.push(_load_val(m0, m1, m2, shB,
+                                                    nbytes, flags))
+                            return lax.cond(
+                                dirty, rolled_carry,
+                                lambda: lax.cond(
+                                    oob0,
+                                    lambda: bail(cb2, j, vs_pre),
+                                    lambda: emit(j + 1, cb2, vs2,
+                                                 pend_l, pend_g)))
+                        m0 = srow(memr, u)
+                        m1 = srow(memr, jnp.minimum(u + 1, W - 1))
+                        m2 = srow(memr, jnp.minimum(u + 2, W - 1)) \
+                            if nbytes == 8 else None
+                        vs2 = vs.push(_load_val(m0, m1, m2, shB,
+                                                nbytes, flags))
+                        return lax.cond(
+                            oob0,
+                            lambda: bail(cb, j, vs_pre),
+                            lambda: emit(j + 1, cb, vs2, pend_l, pend_g))
+                    # careful kernel: flush and delegate to the original
+                    # handler (keeps its divergent-address gather paths
+                    # and trap-partial semantics); execution continues
+                    # UNFUSED at pcj+1 until the next block head —
+                    # careful runs only on recheck rounds, so parity
+                    # beats speed here.
+                    return _delegate_mem(j, cb, vs_pre,
+                                         _load_flat_hid(nbytes, flags))
+
+                def _load_flat_hid(nbytes, flags):
+                    if nbytes == 4 and flags in (0, 2):
+                        return H_LOAD_W
+                    if nbytes == 8:
+                        return H_LOAD_D
+                    return H_LOAD
+
+                def _delegate_mem(j, cb, vs_pre, flat_hid):
+                    vs_pre.flush()
+                    c2 = keep(cb, steps=cb[0] + j, pc=pc + j,
+                              sp=vs_pre.sp())
+                    return handler_for(flat_hid)(c2)
+
+                def emit_store(j, cb, vs, pend_l, pend_g):
+                    pcj = pc + j
+                    nbytes = body_ops[j][1]
+                    want = 2 if nbytes == 8 else 1
+                    vs_pre = vs
+                    val, vs = vs.pop()
+                    addr, vs = vs.pop()
+                    off = a_r[pcj]
+                    ea = addr[0] + off
+                    mem_bytes = cb[6] * I32(65536)
+                    m_lo = I32(-1) if nbytes >= 4 else \
+                        I32(0xFF if nbytes == 1 else 0xFFFF)
+                    m_hi = I32(-1) if nbytes == 8 else I32(0)
+
+                    def masks_vals(shB):
+                        sm0, sm1 = lo_ops.shl64(m_lo, m_hi, shB)
+                        sm2 = jnp.where(shB == 0, 0,
+                                        lo_ops.shr64_u(m_lo, m_hi,
+                                                       64 - shB)[0])
+                        sv0, sv1 = lo_ops.shl64(val[0], val[1], shB)
+                        sv2 = jnp.where(shB == 0, 0,
+                                        lo_ops.shr64_u(val[0], val[1],
+                                                       64 - shB)[0])
+                        return ((sm0, sv0), (sm1, sv1), (sm2, sv2))
+
+                    if optimistic:
+                        ea0 = agree_i32(ea)
+                        addr0 = ea0 - off
+                        end0 = ea0 + nbytes
+                        oob0 = u_lt(ea0, addr0) | u_lt(ea0, off) | \
+                            u_lt(end0, ea0) | u_lt(mem_bytes, end0)
+                        u = jnp.clip(lax.shift_right_logical(ea0, 2),
+                                     0, W - 1)
+                        shB = (ea0 & 3) * 8
+                        if mem_hbm:
+                            rhi = jnp.minimum(u + want, W - 1)
+                            # snapshot-consistency: see emit_load
+                            vs_pre.flush()
+                            cb_snap = keep(cb, steps=cb[0] + j,
+                                           pc=pc + j, sp=vs_pre.sp())
+                            dirty, snapped, way, wfs2 = _opt_window(
+                                cb_snap, u, rhi)
+                            okw = ~dirty & ~oob0
+                            for k, (m, v) in enumerate(masks_vals(shB)):
+                                w = jnp.minimum(u + k, W - 1)
+
+                                @pl.when(okw & (m != 0))
+                                def _(m=m, v=v, w=w):
+                                    cur = win_read_row(way, wfs2, w)
+                                    win_write_row(way, wfs2, w,
+                                                  (cur & ~m) | (v & m))
+
+                            nwd0 = jnp.where(way == 0, I32(1), wfs2[1])
+                            nwd1 = jnp.where(way == 1, I32(1), wfs2[3])
+                            cb2 = keep(cb, wb0=wfs2[0], wd0=nwd0,
+                                       wb1=wfs2[2], wd1=nwd1, mru=wfs2[4],
+                                       ls=jnp.where(snapped, cb[0] + j,
+                                                    cb[IDX["ls"]]))
+                            return lax.cond(
+                                dirty, rolled_carry,
+                                lambda: lax.cond(
+                                    oob0,
+                                    lambda: bail(cb2, j, vs_pre),
+                                    lambda: emit(j + 1, cb2, vs,
+                                                 pend_l, pend_g)))
+                        for k, (m, v) in enumerate(masks_vals(shB)):
+                            w = jnp.minimum(u + k, W - 1)
+
+                            @pl.when(~oob0 & (m != 0))
+                            def _(m=m, v=v, w=w):
+                                cur = srow(memr, w)
+                                wrow(memr, w, (cur & ~m) | (v & m))
+
+                        return lax.cond(
+                            oob0,
+                            lambda: bail(cb, j, vs_pre),
+                            lambda: emit(j + 1, cb, vs, pend_l, pend_g))
+                    # careful kernel: flush + delegate (see emit_load)
+                    return _delegate_mem(
+                        j, cb, vs_pre,
+                        H_STORE_W if nbytes == 4 else
+                        H_STORE_D if nbytes == 8 else H_STORE)
+
+                def finish(cb, vs):
+                    sp_t = vs.sp()
+                    if term is None:
+                        vs.flush()
+                        return keep(cb, steps=cb[0] + nops - 1,
+                                    pc=pc + nops, sp=sp_t)
+                    t_hid = term[1]
+                    # Only the cell the terminal POPS (or that dies
+                    # with the unwind: return/br kept values) may skip
+                    # its flush; a brnz fallthrough keeps sp-2 live, so
+                    # deeper cells always flush even when also passed
+                    # as vregs.
+                    nvreg = 0
+                    if t_hid in (H_BRZ, H_BRNZ, H_BR_TABLE, H_RETURN,
+                                 H_BR, H_CALL_INDIRECT):
+                        nvreg = min(1, len(vs.items))
+                    vs.flush(skip_top=nvreg)
+                    top1 = vs.items[-1] if len(vs.items) >= 1 else None
+                    top2 = vs.items[-2] if len(vs.items) >= 2 else None
+                    c2 = keep(cb, steps=cb[0] + nops, pc=pc + nops,
+                              sp=sp_t)
+                    if t_hid == H_BRZ:
+                        return brz_with(c2, top1, spill=top1 is not None)
+                    if t_hid == H_BRNZ:
+                        return brnz_with(c2, top1, top2,
                                          spill=top1 is not None)
-                if t_hid == H_RETURN:
-                    return return_with(c2, top1)
-                if t_hid == H_BR:
-                    return br_with(c2, top1)
-                if t_hid == H_CALL_INDIRECT:
-                    return calli_with(c2, top1, spill=top1 is not None)
-                return handler_for(t_hid)(c2)
+                    if t_hid == H_BR_TABLE:
+                        return br_table_with(c2, top1, top2,
+                                             spill=top1 is not None)
+                    if t_hid == H_RETURN:
+                        return return_with(c2, top1)
+                    if t_hid == H_BR:
+                        return br_with(c2, top1)
+                    if t_hid == H_CALL_INDIRECT:
+                        return calli_with(c2, top1,
+                                          spill=top1 is not None)
+                    return handler_for(t_hid)(c2)
+
+                return emit(0, c, VS(), {}, {})
             return h
 
         base_handlers = {
@@ -3216,9 +3513,14 @@ class PallasUniformEngine:
         dense = {h: i for i, h in enumerate(used)}
         hid_dense = np.asarray([dense[int(h)] for h in hid], np.int32)
         # host-side view of the fused encoding: the block scheduler's
-        # divergence splitter evaluates the stopped instruction from these
+        # divergence splitter evaluates the stopped instruction from
+        # these.  _np_hid_orig is the UNfused plane: a block whose
+        # first op bails leaves pc at the head (hid = block id), but
+        # its operand fields are the original op's, so the splitter
+        # resolves it via the original opcode.
         self._np_fused = {"hid": hid, "a": a_p, "b": b_p, "c": c_p,
                           "ilo": ilo_p, "ihi": ihi_p}
+        self._np_hid_orig = hid_plane(img)
         D, CD = self._depths()
         W = self._mem_words()
         NG = img.globals_lo.shape[0]

@@ -54,6 +54,7 @@ from wasmedge_tpu.batch.pallas_engine import (
     H_FUSE_GCB_BASE,
     H_FUSE_GGBNZ_BASE,
     H_FUSE_GGBZ_BASE,
+    H_BLOCK_BASE,
     H_MEMGROW,
     NUM_ALU2,
     ST_DIVERGED,
@@ -587,6 +588,11 @@ class BlockScheduler:
             return
         pc = int(ctrl[_C_PC])
         hid = int(eng._np_fused["hid"][pc])
+        if hid >= H_BLOCK_BASE:
+            # stop at a fused block head (its first op bailed): the
+            # operand fields are the original op's, so resolve via the
+            # original opcode instead of demoting the lanes to SIMT
+            hid = int(eng._np_hid_orig[pc])
         if not self._try_resolve(b, ctrl, frames, hid, pc, pages_over):
             self._to_simt(b, ctrl, frames, pages_over)
 

@@ -54,22 +54,34 @@ def build_loop_sum() -> bytes:
     return b.build()
 
 
-def build_memory_workload() -> bytes:
-    """Write-then-checksum over linear memory (config 2 memory traffic)."""
+def build_memory_workload(passes: int = 1) -> bytes:
+    """Write-then-checksum over linear memory (config 2 memory traffic).
+
+    `passes` repeats the whole write+checksum cycle (same load/store mix,
+    more work per invocation) so benchmarks can amortize fixed host-link
+    round trips over enough device work to measure the engine rather
+    than the link."""
     b = ModuleBuilder()
     b.add_memory(1, 16)
-    # store n words of i*2654435761 then xor-reduce
-    b.add_function(["i32"], ["i32"], ["i32", "i32"], [
+    # locals: 0=n (param), 1=i, 2=acc, 3=pass counter
+    b.add_function(["i32"], ["i32"], ["i32", "i32", "i32"], [
+        ("i32.const", passes), ("local.set", 3),
+        ("block", None),
+        ("loop", None),
+        # store n words of i*2654435761
+        ("i32.const", 0), ("local.set", 1),
         ("block", None),
         ("loop", None),
         ("local.get", 1), ("local.get", 0), "i32.ge_u", ("br_if", 1),
         ("local.get", 1), ("i32.const", 4), "i32.mul",
         ("local.get", 1), ("i32.const", 0x9E3779B1 - 2**32), "i32.mul",
+        ("local.get", 3), ("i32.const", 1), "i32.sub", "i32.xor",
         ("i32.store", 2, 0),
         ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
         ("br", 0),
         "end",
         "end",
+        # xor-reduce them back
         ("i32.const", 0), ("local.set", 1),
         ("block", None),
         ("loop", None),
@@ -78,6 +90,11 @@ def build_memory_workload() -> bytes:
         ("local.get", 1), ("i32.const", 4), "i32.mul", ("i32.load", 2, 0),
         "i32.xor", ("local.set", 2),
         ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        ("br", 0),
+        "end",
+        "end",
+        ("local.get", 3), ("i32.const", 1), "i32.sub", ("local.tee", 3),
+        "i32.eqz", ("br_if", 1),
         ("br", 0),
         "end",
         "end",
