@@ -726,6 +726,19 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def allsame(vec, s):
             return jnp.all(vec == s)
 
+        def shifted_store_triples(m_lo, m_hi, vl, vh, shB):
+            """(mask, value) pairs for the up-to-3 words a (possibly
+            unaligned) store touches, shifted into word lanes.  The ONE
+            copy of this construction — scalar or vector masks/shifts
+            both broadcast through."""
+            sm0, sm1 = lo_ops.shl64(m_lo, m_hi, shB)
+            sm2 = jnp.where(shB == 0, 0,
+                            lo_ops.shr64_u(m_lo, m_hi, 64 - shB)[0])
+            sv0, sv1 = lo_ops.shl64(vl, vh, shB)
+            sv2 = jnp.where(shB == 0, 0,
+                            lo_ops.shr64_u(vl, vh, 64 - shB)[0])
+            return ((sm0, sv0), (sm1, sv1), (sm2, sv2))
+
         # carry: (steps, pc, sp, fp, ob, cd, pages, status) — mem_hbm
         # mode appends the window-cache fields (wb0, wd0, wb1, wd1, mru):
         # per-way window base row / dirty flag + the MRU way for LRU
@@ -755,6 +768,24 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 s = scal(vec)
                 canr[0, :] = canr[0, :] | (vec[0, :] ^ s)
                 return s
+
+            def opt_addr_prolog(ea, off, nbytes, pages):
+                """Lane-0 effective-address decision plus a fully
+                SCALAR bounds check (address agreement is the
+                optimistic assumption, so OOB agreement follows; lane
+                mismatches go to the canary and roll back).  The ONE
+                copy of this math, shared by the width-specialized
+                unfused handlers and the fused inline loads/stores.
+                Returns (ea0, oob0, word index u, bit shift shB)."""
+                ea0 = agree_i32(ea)
+                addr0 = ea0 - off
+                mem_bytes = pages * I32(65536)
+                end0 = ea0 + nbytes
+                oob0 = u_lt(ea0, addr0) | u_lt(ea0, off) | \
+                    u_lt(end0, ea0) | u_lt(mem_bytes, end0)
+                u = jnp.clip(lax.shift_right_logical(ea0, 2), 0, W - 1)
+                shB = (ea0 & 3) * 8
+                return ea0, oob0, u, shB
 
             def agree_nz(vec):
                 """lane-0 zeroness decision (branch conditions agree when
@@ -1453,14 +1484,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 m_lo = jnp.where(b1, I32(0xFF),
                                  jnp.where(b2_, I32(0xFFFF), I32(-1)))
                 m_hi = jnp.where(nbytes == 8, I32(-1), I32(0))
-                sm0, sm1 = lo_ops.shl64(m_lo, m_hi, shB0)
-                sm2 = jnp.where(shB0 == 0, 0,
-                                lo_ops.shr64_u(m_lo, m_hi, 64 - shB0)[0])
-                sv0, sv1 = lo_ops.shl64(vl, vh, shB0)
-                sv2 = jnp.where(shB0 == 0, 0,
-                                lo_ops.shr64_u(vl, vh, 64 - shB0)[0])
-                for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
-                                            (sm2, sv2))):
+                for k, (m, v) in enumerate(
+                        shifted_store_triples(m_lo, m_hi, vl, vh, shB0)):
                     w = jnp.minimum(u + k, W - 1)
 
                     @pl.when(m != 0)
@@ -1488,11 +1513,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             full_hi = jnp.where(nbytes == 8, I32(-1), 0)
             full_lo = jnp.broadcast_to(full_lo, (1, Lblk))
             full_hi = jnp.broadcast_to(full_hi, (1, Lblk))
-            sm0, sm1 = lo_ops.shl64(full_lo, full_hi, shB)
-            sm2 = jnp.where(shB == 0, 0,
-                            lo_ops.shr64_u(full_lo, full_hi, 64 - shB)[0])
-            sv0, sv1 = lo_ops.shl64(vl, vh, shB)
-            sv2 = jnp.where(shB == 0, 0, lo_ops.shr64_u(vl, vh, 64 - shB)[0])
+            ((sm0, sv0), (sm1, sv1), (sm2, sv2)) = shifted_store_triples(
+                full_lo, full_hi, vl, vh, shB)
             u0 = scal(widx)
             uni = allsame(widx, u0) & allsame(shB, scal(shB))
             commit = jnp.bool_(True) if gatherable else uni
@@ -1781,21 +1803,13 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 return oob, oob0, u, shB0, rhi, nbytes
 
             def _opt_ls_scalar(c, addr_row, nbytes, want_rows):
-                """Reduction-free load/store prolog: the lane-0 address
-                plus a fully SCALAR bounds check (address agreement is
-                the optimistic assumption, so oob agreement follows;
-                lane mismatches go to the canary and roll back)."""
+                """Reduction-free load/store prolog (opt_addr_prolog
+                plus the window row bound the hbm handlers need)."""
                 pc, pages = c[1], c[6]
                 off = a_r[pc]
                 ea = addr_row + off
-                ea0 = agree_i32(ea)
-                addr0 = ea0 - off
-                mem_bytes = pages * I32(65536)
-                end0 = ea0 + nbytes
-                oob0 = u_lt(ea0, addr0) | u_lt(ea0, off) | \
-                    u_lt(end0, ea0) | u_lt(mem_bytes, end0)
-                u = jnp.clip(lax.shift_right_logical(ea0, 2), 0, W - 1)
-                shB0 = (ea0 & 3) * 8
+                _ea0, oob0, u, shB0 = opt_addr_prolog(
+                    ea, off, nbytes, pages)
                 rhi = jnp.minimum(u + want_rows, W - 1)
                 return ea, oob0, u, shB0, rhi
 
@@ -1868,22 +1882,15 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     dirty, snapped, way, wfs2 = _opt_window(c, u, rhi)
                     m_lo = I32(-1)
                     m_hi = I32(-1) if is64 else I32(0)
-                    sm0, sm1 = lo_ops.shl64(m_lo, m_hi, shB0)
-                    sm2 = jnp.where(shB0 == 0, 0,
-                                    lo_ops.shr64_u(m_lo, m_hi,
-                                                   64 - shB0)[0])
-                    sv0, sv1 = lo_ops.shl64(vl, vh, shB0)
-                    sv2 = jnp.where(shB0 == 0, 0,
-                                    lo_ops.shr64_u(vl, vh, 64 - shB0)[0])
+                    triples = shifted_store_triples(m_lo, m_hi, vl, vh,
+                                                    shB0)
 
                     @pl.when(~dirty & ~oob0)
                     def _():
                         # common path: no lane traps assumed — write
                         # unmasked (a lane disagreeing on the address is
                         # already canary-marked and will roll back)
-                        for k, (m, v) in enumerate(((sm0, sv0),
-                                                    (sm1, sv1),
-                                                    (sm2, sv2))):
+                        for k, (m, v) in enumerate(triples):
                             w = jnp.minimum(u + k, W - 1)
 
                             @pl.when(m != 0)
@@ -1996,15 +2003,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     m_lo = jnp.where(b1, I32(0xFF),
                                      jnp.where(b2_, I32(0xFFFF), I32(-1)))
                     m_hi = jnp.where(nbytes == 8, I32(-1), I32(0))
-                    sm0, sm1 = lo_ops.shl64(m_lo, m_hi, shB0)
-                    sm2 = jnp.where(shB0 == 0, 0,
-                                    lo_ops.shr64_u(m_lo, m_hi,
-                                                   64 - shB0)[0])
-                    sv0, sv1 = lo_ops.shl64(vl, vh, shB0)
-                    sv2 = jnp.where(shB0 == 0, 0,
-                                    lo_ops.shr64_u(vl, vh, 64 - shB0)[0])
-                    for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
-                                                (sm2, sv2))):
+                    for k, (m, v) in enumerate(
+                            shifted_store_triples(m_lo, m_hi, vl, vh,
+                                                  shB0)):
                         w = jnp.minimum(u + k, W - 1)
 
                         @pl.when(~dirty & (m != 0))
@@ -2051,13 +2052,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 full_hi = jnp.where(nbytes == 8, I32(-1), 0)
                 full_lo = jnp.broadcast_to(full_lo, (1, Lblk))
                 full_hi = jnp.broadcast_to(full_hi, (1, Lblk))
-                sm0, sm1 = lo_ops.shl64(full_lo, full_hi, shB)
-                sm2 = jnp.where(shB == 0, 0,
-                                lo_ops.shr64_u(full_lo, full_hi,
-                                               64 - shB)[0])
-                sv0, sv1 = lo_ops.shl64(vl, vh, shB)
-                sv2 = jnp.where(shB == 0, 0,
-                                lo_ops.shr64_u(vl, vh, 64 - shB)[0])
+                ((sm0, sv0), (sm1, sv1), (sm2, sv2)) = \
+                    shifted_store_triples(full_lo, full_hi, vl, vh, shB)
                 rlo = jnp.min(widx)
                 rhi = jnp.minimum(jnp.max(widx) + 2, W - 1)
                 fits = (rhi - (rlo - lax.rem(rlo, 8))) < CW
@@ -2813,16 +2809,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     addr, vs = vs.pop()
                     off = a_r[pcj]
                     ea = addr[0] + off
-                    mem_bytes = cb[6] * I32(65536)
                     if optimistic:
-                        ea0 = agree_i32(ea)
-                        addr0 = ea0 - off
-                        end0 = ea0 + nbytes
-                        oob0 = u_lt(ea0, addr0) | u_lt(ea0, off) | \
-                            u_lt(end0, ea0) | u_lt(mem_bytes, end0)
-                        u = jnp.clip(lax.shift_right_logical(ea0, 2),
-                                     0, W - 1)
-                        shB = (ea0 & 3) * 8
+                        _ea0, oob0, u, shB = opt_addr_prolog(
+                            ea, off, nbytes, cb[6])
                         if mem_hbm:
                             rhi = jnp.minimum(u + want, W - 1)
                             # _opt_window may SNAPSHOT (dirty-way
@@ -2898,31 +2887,17 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     addr, vs = vs.pop()
                     off = a_r[pcj]
                     ea = addr[0] + off
-                    mem_bytes = cb[6] * I32(65536)
                     m_lo = I32(-1) if nbytes >= 4 else \
                         I32(0xFF if nbytes == 1 else 0xFFFF)
                     m_hi = I32(-1) if nbytes == 8 else I32(0)
 
                     def masks_vals(shB):
-                        sm0, sm1 = lo_ops.shl64(m_lo, m_hi, shB)
-                        sm2 = jnp.where(shB == 0, 0,
-                                        lo_ops.shr64_u(m_lo, m_hi,
-                                                       64 - shB)[0])
-                        sv0, sv1 = lo_ops.shl64(val[0], val[1], shB)
-                        sv2 = jnp.where(shB == 0, 0,
-                                        lo_ops.shr64_u(val[0], val[1],
-                                                       64 - shB)[0])
-                        return ((sm0, sv0), (sm1, sv1), (sm2, sv2))
+                        return shifted_store_triples(m_lo, m_hi,
+                                                     val[0], val[1], shB)
 
                     if optimistic:
-                        ea0 = agree_i32(ea)
-                        addr0 = ea0 - off
-                        end0 = ea0 + nbytes
-                        oob0 = u_lt(ea0, addr0) | u_lt(ea0, off) | \
-                            u_lt(end0, ea0) | u_lt(mem_bytes, end0)
-                        u = jnp.clip(lax.shift_right_logical(ea0, 2),
-                                     0, W - 1)
-                        shB = (ea0 & 3) * 8
+                        _ea0, oob0, u, shB = opt_addr_prolog(
+                            ea, off, nbytes, cb[6])
                         if mem_hbm:
                             rhi = jnp.minimum(u + want, W - 1)
                             # snapshot-consistency: see emit_load
