@@ -401,3 +401,79 @@ def test_memgrow_regrow_beyond_watermark():
                     ("memory.size",)], export="g")
     eng, res = check_parity(b.build(), "g", [], conf=conf)
     assert eng.fell_back_to_simt  # regrow handled by the big-plane engine
+
+
+def _simd_wat_module():
+    from wasmedge_tpu.utils.wat import parse_wat
+
+    return parse_wat("""
+(module
+  (memory 1)
+  (func (export "vmix") (param i32) (result i32)
+    (local $acc v128)
+    (local $i i32)
+    (local.set $acc (v128.const i32x4 1 2 3 4))
+    (block (loop
+      (br_if 1 (i32.ge_u (local.get $i) (local.get 0)))
+      (local.set $acc
+        (i32x4.add (local.get $acc) (i32x4.splat (local.get $i))))
+      (local.set $acc
+        (v128.xor (local.get $acc)
+                  (i8x16.shuffle 4 5 6 7 0 1 2 3 12 13 14 15 8 9 10 11
+                                 (local.get $acc) (local.get $acc))))
+      (local.set $i (i32.add (local.get $i) (i32.const 1)))
+      (br 0)))
+    ;; unaligned v128 store + load round-trip
+    (v128.store offset=3 (i32.const 64) (local.get $acc))
+    (local.set $acc (v128.load offset=3 (i32.const 64)))
+    (i32.add
+      (i32x4.extract_lane 1 (local.get $acc))
+      (i32.add
+        (i32x4.extract_lane 2
+          (v128.bitselect (local.get $acc)
+                          (v128.const i32x4 -1 -1 -1 -1)
+                          (v128.const i32x4 0xFF00FF00 0x00FF00FF
+                                            0xF0F0F0F0 0x0F0F0F0F)))
+        (i32x4.extract_lane 3 (local.get $acc))))))
+""")
+
+
+def test_v128_through_pallas_kernel():
+    # the v128 page runs IN the pallas kernel (handlers + 4-plane cells
+    # + unaligned v128 load/store through the memory machinery)
+    eng, res = check_parity(_simd_wat_module(), "vmix",
+                            [np.full(LANES, 9, np.int64)])
+    assert eng.eligible, eng.ineligible_reason
+    assert not eng.fell_back_to_simt
+
+
+def test_v128_divergent_lanes_recheck():
+    # divergent per-lane loop counts force optimistic rollback + careful
+    # recheck with v128 state riding the rollback shadow planes
+    args = np.array([3, 3, 9, 9, 15, 15, 21, 21], np.int64)[:LANES]
+    eng, res = check_parity(_simd_wat_module(), "vmix", [args])
+    assert eng.eligible, eng.ineligible_reason
+
+
+def test_v128_select_and_global_in_fused_block():
+    # regression: fused-block select over v128 cells and global.get
+    # feeding local.set must push full-width cells in simd modules
+    from wasmedge_tpu.utils.wat import parse_wat
+
+    wasm = parse_wat("""
+(module
+  (global $g (mut i32) (i32.const 7))
+  (func (export "f") (param i32) (result i32)
+    (local $v v128)
+    (local $x i32)
+    (local.set $v (v128.const i32x4 9 8 7 6))
+    (local.set $v (select (local.get $v)
+                          (v128.const i32x4 1 1 1 1)
+                          (local.get 0)))
+    (local.set $x (global.get $g))
+    (i32.add (local.get $x)
+             (i32x4.extract_lane 2 (local.get $v)))))
+""")
+    for arg in (0, 1):
+        eng, res = check_parity(wasm, "f", [np.full(LANES, arg, np.int64)])
+        assert eng.eligible, eng.ineligible_reason

@@ -79,6 +79,18 @@ from wasmedge_tpu.batch.image import (
     CLS_SELECT,
     CLS_STORE,
     CLS_TRAP,
+    CLS_V1,
+    CLS_V2,
+    CLS_VBITSEL,
+    CLS_VCONST,
+    CLS_VEXTRACT,
+    CLS_VLOAD,
+    CLS_VREPLACE,
+    CLS_VSHIFT,
+    CLS_VSHUFFLE,
+    CLS_VSPLAT,
+    CLS_VSTORE,
+    CLS_VTEST,
     DeviceImage,
     TRAP_DONE,
     _F32_BIN,
@@ -145,7 +157,32 @@ H_LOAD_W = H_FUSE_GBR + 1    # i32.load  (nbytes=4, no extension)
 H_LOAD_D = H_FUSE_GBR + 2    # i64.load  (nbytes=8)
 H_STORE_W = H_FUSE_GBR + 3   # i32.store / f32.store
 H_STORE_D = H_FUSE_GBR + 4   # i64.store / f64.store
-NUM_HANDLERS = H_STORE_D + 1
+# v128: cells are 4 int32 planes (lo, hi, e2, e3); op semantics come
+# from batch/simdops.py — the same fns the SIMT engine dispatches
+# (engine.py "v128 (SIMD)" section), here as per-sub handlers.  Dense
+# renumbering means a module compiles only the subs it uses.
+H_VCONST = H_STORE_D + 1
+H_VSHUFFLE = H_VCONST + 1
+H_VBITSEL = H_VSHUFFLE + 1
+H_VLOAD = H_VBITSEL + 1
+H_VSTORE = H_VLOAD + 1
+from wasmedge_tpu.batch.simdops import (   # noqa: E402
+    V1_NAMES,
+    V2_NAMES,
+    VEXTRACT_NAMES,
+    VREPLACE_NAMES,
+    VSHIFT_NAMES,
+    VSPLAT_NAMES,
+    VTEST_NAMES,
+)
+H_V2_BASE = H_VSTORE + 1
+H_V1_BASE = H_V2_BASE + len(V2_NAMES)
+H_VTEST_BASE = H_V1_BASE + len(V1_NAMES)
+H_VSHIFT_BASE = H_VTEST_BASE + len(VTEST_NAMES)
+H_VSPLAT_BASE = H_VSHIFT_BASE + len(VSHIFT_NAMES)
+H_VEXTRACT_BASE = H_VSPLAT_BASE + len(VSPLAT_NAMES)
+H_VREPLACE_BASE = H_VEXTRACT_BASE + len(VEXTRACT_NAMES)
+NUM_HANDLERS = H_VREPLACE_BASE + len(VREPLACE_NAMES)
 
 _CLS_TO_HID = {
     CLS_NOP: H_NOP, CLS_CONST: H_CONST, CLS_LOCAL_GET: H_LOCAL_GET,
@@ -157,6 +194,15 @@ _CLS_TO_HID = {
     CLS_MEMSIZE: H_MEMSIZE, CLS_MEMGROW: H_MEMGROW, CLS_TRAP: H_TRAP,
     CLS_LOAD: H_LOAD, CLS_STORE: H_STORE, CLS_HOSTCALL: H_HOSTCALL,
     CLS_MEMFILL: H_MEMFILL, CLS_MEMCOPY: H_MEMCOPY,
+    CLS_VCONST: H_VCONST, CLS_VSHUFFLE: H_VSHUFFLE,
+    CLS_VBITSEL: H_VBITSEL, CLS_VLOAD: H_VLOAD, CLS_VSTORE: H_VSTORE,
+}
+
+# sub-indexed v128 classes -> handler base id
+_VCLS_TO_BASE = {
+    CLS_V2: H_V2_BASE, CLS_V1: H_V1_BASE, CLS_VTEST: H_VTEST_BASE,
+    CLS_VSHIFT: H_VSHIFT_BASE, CLS_VSPLAT: H_VSPLAT_BASE,
+    CLS_VEXTRACT: H_VEXTRACT_BASE, CLS_VREPLACE: H_VREPLACE_BASE,
 }
 
 # status values (shared with batch/uniform.py)
@@ -447,6 +493,26 @@ def fuse_blocks(hid, img):
             if sub in trap1:
                 return None
             return ("alu1", sub)
+        if cl == CLS_V2:
+            return ("v2", int(img.sub[pc]))
+        if cl == CLS_V1:
+            return ("v1", int(img.sub[pc]))
+        if cl == CLS_VTEST:
+            return ("vtest", int(img.sub[pc]))
+        if cl == CLS_VSHIFT:
+            return ("vshift", int(img.sub[pc]))
+        if cl == CLS_VSPLAT:
+            return ("vsplat", int(img.sub[pc]))
+        if cl == CLS_VEXTRACT:
+            return ("vextract", int(img.sub[pc]))
+        if cl == CLS_VREPLACE:
+            return ("vreplace", int(img.sub[pc]))
+        if cl == CLS_VCONST:
+            return ("vconst",)
+        if cl == CLS_VSHUFFLE:
+            return ("vshuffle",)
+        if cl == CLS_VBITSEL:
+            return ("vbitsel",)
         if cl == CLS_LOAD:
             return ("loadi", int(img.b[pc]), int(img.c[pc]))
         if cl == CLS_STORE:
@@ -516,7 +582,8 @@ def pallas_image_eligibility(img: DeviceImage,
     if img.code_len > max_code_len:
         return f"code too large for SMEM ({img.code_len} instrs)"
     unhandled = (set(np.unique(img.cls).tolist())
-                 - set(_CLS_TO_HID) - {CLS_ALU2, CLS_ALU1})
+                 - set(_CLS_TO_HID) - set(_VCLS_TO_BASE)
+                 - {CLS_ALU2, CLS_ALU1})
     if unhandled:
         return f"classes without Pallas handlers: {sorted(unhandled)}"
     return None
@@ -531,6 +598,8 @@ def hid_plane(img: DeviceImage) -> np.ndarray:
             hid[pc] = H_ALU2_BASE + int(img.sub[pc])
         elif c == CLS_ALU1:
             hid[pc] = H_ALU1_BASE + int(img.sub[pc])
+        elif c in _VCLS_TO_BASE:
+            hid[pc] = _VCLS_TO_BASE[c] + int(img.sub[pc])
         elif c == CLS_LOAD and int(img.b[pc]) == 4 \
                 and int(img.c[pc]) in (0, 2):
             # i32.load / f32.load / i64.load32_u: lo = raw word, hi = 0
@@ -565,6 +634,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                   mem_pages_hard: int, gatherable: bool, interpret: bool,
                   mem_hbm: bool = False, CW: int = 0,
                   block_shapes: tuple = (),
+                  simd: bool = False, NV: int = 1,
                   optimistic: bool = False, snap_steps: int = 8192,
                   shadow_full: bool = None):
     """Compile the chunk-runner for one kernel geometry.
@@ -641,28 +711,47 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             GR //= 2
     GATHER_CHUNKS = W // GR if W % GR == 0 else 0
 
-    def kernel(hid_r, a_r, b_r, c_r, ilo_r, ihi_r,
-               fent_r, fnpar_r, fnloc_r, ftop_r, ftyp_r, brt_r, tbl_r,
-               ctrl_r, frames_in,
-               s_lo_in, s_hi_in, g_lo_in, g_hi_in, mem_in, trap_in,
-               sh_slo_in, sh_shi_in, sh_glo_in, sh_ghi_in, sh_trap_in,
-               sh_mem_in,
-               ctrl_out, frames_out,
-               s_lo_out, s_hi_out, g_lo_out, g_hi_out, mem_out, trap_out,
-               sh_slo, sh_shi, sh_glo, sh_ghi, sh_trap, sh_mem,
-               *scr):
+    # inputs/outputs: frames + 12 base planes (+4 v128 planes: stack
+    # e2/e3 and their rollback shadows, appended LAST so every existing
+    # index — scheduler plane map, hostcall serving, checkpointing —
+    # stays stable whether or not the module uses v128)
+    N_IN = 13 + (4 if simd else 0)
+
+    def kernel(*kargs_):
+        (hid_r, a_r, b_r, c_r, ilo_r, ihi_r,
+         fent_r, fnpar_r, fnloc_r, ftop_r, ftyp_r, brt_r, tbl_r,
+         v128t_r, ctrl_r) = kargs_[:15]
+        ins_ = kargs_[15:15 + N_IN]
+        (frames_in, s_lo_in, s_hi_in, g_lo_in, g_hi_in, mem_in,
+         trap_in, sh_slo_in, sh_shi_in, sh_glo_in, sh_ghi_in,
+         sh_trap_in, sh_mem_in) = ins_[:13]
+        outs_ = kargs_[15 + N_IN: 15 + N_IN + 1 + N_IN]
+        (ctrl_out, frames_out, s_lo_out, s_hi_out, g_lo_out, g_hi_out,
+         mem_out, trap_out, sh_slo, sh_shi, sh_glo, sh_ghi, sh_trap,
+         sh_mem) = outs_[:14]
+        if simd:
+            se2_in, se3_in, sh_se2_in, sh_se3_in = ins_[13:17]
+            se2_out, se3_out, sh_se2, sh_se3 = outs_[14:18]
+        scr = kargs_[15 + N_IN + 1 + N_IN:]
         # sh_* are the rollback-snapshot shadow planes (HBM, aliased
         # in/out, only touched in optimistic mode; degenerate [1, L]
         # sh_mem when the memory plane is HBM-resident — the plane
         # itself then already holds last-commit state).
+        it_ = iter(scr)
+        slo, shi = next(it_), next(it_)
+        se2s = next(it_) if simd else None
+        se3s = next(it_) if simd else None
+        glo, ghi = next(it_), next(it_)
         if mem_hbm:
-            slo, shi, glo, ghi, mwin0, mwin1, trapr, sems = scr[:8]
+            mwin0, mwin1 = next(it_), next(it_)
             memr = None
         else:
-            slo, shi, glo, ghi, memr, trapr, sems = scr[:7]
+            memr = next(it_)
             mwin0 = mwin1 = None
+        trapr, sems = next(it_), next(it_)
         if optimistic:
-            canr, flag, snapf, snapc = scr[-4:]
+            canr, flag, snapf, snapc = (next(it_), next(it_),
+                                        next(it_), next(it_))
         blk = pl.program_id(0)
         lo = blk * Lblk
 
@@ -683,6 +772,12 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                dma(5, trap_in.at[:, pl.ds(lo, Lblk)], trapr)]
         if not mem_hbm:
             ins.append(dma(4, mem_in.at[:, pl.ds(lo, Lblk)], memr))
+        if simd:
+            # sems 6/7 are reused for the e2/e3 planes here and in the
+            # snapshot paths: window DMAs (the other users of 6/7) are
+            # never in flight across those batches
+            ins += [dma(6, se2_in.at[:, pl.ds(lo, Lblk)], se2s),
+                    dma(7, se3_in.at[:, pl.ds(lo, Lblk)], se3s)]
         for c in ins:
             c.start()
         for c in ins:
@@ -722,6 +817,21 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
         def scal(vec):
             return vec[0, 0]
+
+        # 4-plane cell accessors (v128 cells span lo/hi/e2/e3; scalar
+        # cells leave e2/e3 don't-care — copies move whatever is there)
+        def srow4(i):
+            if simd:
+                return (srow(slo, i), srow(shi, i),
+                        srow(se2s, i), srow(se3s, i))
+            return (srow(slo, i), srow(shi, i))
+
+        def wrow4(i, v):
+            wrow(slo, i, v[0])
+            wrow(shi, i, v[1])
+            if simd:
+                wrow(se2s, i, v[2])
+                wrow(se3s, i, v[3])
 
         def allsame(vec, s):
             return jnp.all(vec == s)
@@ -806,6 +916,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                        dma(5, trapr, sh_trap.at[:, pl.ds(lo, Lblk)])]
                 if not mem_hbm and W > 1:
                     cps.append(dma(4, memr, sh_mem.at[:, pl.ds(lo, Lblk)]))
+                if simd:
+                    cps += [dma(6, se2s, sh_se2.at[:, pl.ds(lo, Lblk)]),
+                            dma(7, se3s, sh_se3.at[:, pl.ds(lo, Lblk)])]
                 for cp_ in cps:
                     cp_.start()
                 for cp_ in cps:
@@ -831,6 +944,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                        dma(5, sh_trap.at[:, pl.ds(lo, Lblk)], trapr)]
                 if not mem_hbm and W > 1:
                     cps.append(dma(4, sh_mem.at[:, pl.ds(lo, Lblk)], memr))
+                if simd:
+                    cps += [dma(6, sh_se2.at[:, pl.ds(lo, Lblk)], se2s),
+                            dma(7, sh_se3.at[:, pl.ds(lo, Lblk)], se3s)]
                 for cp_ in cps:
                     cp_.start()
                 for cp_ in cps:
@@ -887,22 +1003,19 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def h_local_get(c):
             pc, sp, fp = c[1], c[2], c[3]
             src = fp + a_r[pc]
-            wrow(slo, sp, srow(slo, src))
-            wrow(shi, sp, srow(shi, src))
+            wrow4(sp, srow4(src))
             return keep(c, pc=pc + 1, sp=sp + 1)
 
         def h_local_set(c):
             pc, sp, fp = c[1], c[2], c[3]
             dst = fp + a_r[pc]
-            wrow(slo, dst, srow(slo, sp - 1))
-            wrow(shi, dst, srow(shi, sp - 1))
+            wrow4(dst, srow4(sp - 1))
             return keep(c, pc=pc + 1, sp=sp - 1)
 
         def h_local_tee(c):
             pc, sp, fp = c[1], c[2], c[3]
             dst = fp + a_r[pc]
-            wrow(slo, dst, srow(slo, sp - 1))
-            wrow(shi, dst, srow(shi, sp - 1))
+            wrow4(dst, srow4(sp - 1))
             return keep(c, pc=pc + 1)
 
         def h_global_get(c):
@@ -925,23 +1038,21 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def h_select(c):
             pc, sp = c[1], c[2]
             cond = srow(slo, sp - 1)
-            v1l, v1h = srow(slo, sp - 2), srow(shi, sp - 2)
-            v2l, v2h = srow(slo, sp - 3), srow(shi, sp - 3)
-            wrow(slo, sp - 3, jnp.where(cond == 0, v1l, v2l))
-            wrow(shi, sp - 3, jnp.where(cond == 0, v1h, v2h))
+            v1 = srow4(sp - 2)
+            v2 = srow4(sp - 3)
+            wrow4(sp - 3, tuple(jnp.where(cond == 0, a, b)
+                                for a, b in zip(v1, v2)))
             return keep(c, pc=pc + 1, sp=sp - 2)
 
         def br_with(c, top1=None):
             pc, sp, ob = c[1], c[2], c[4]
             tgt, nkeep, pop_to = a_r[pc], b_r[pc], c_r[pc]
             tgt_sp = ob + pop_to
-            kept = top1 if top1 is not None else \
-                (srow(slo, sp - 1), srow(shi, sp - 1))
+            kept = top1 if top1 is not None else srow4(sp - 1)
 
             @pl.when(nkeep == 1)
             def _():
-                wrow(slo, tgt_sp, kept[0])
-                wrow(shi, tgt_sp, kept[1])
+                wrow4(tgt_sp, kept)
 
             return keep(c, pc=tgt, sp=tgt_sp + nkeep)
 
@@ -961,11 +1072,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             if not spill:
                 return
             if top1 is not None:
-                wrow(slo, sp - 1, top1[0])
-                wrow(shi, sp - 1, top1[1])
+                wrow4(sp - 1, top1)
             if top2 is not None:
-                wrow(slo, sp - 2, top2[0])
-                wrow(shi, sp - 2, top2[1])
+                wrow4(sp - 2, top2)
 
         def brz_with(c, top1=None, spill=False):
             pc, sp = c[1], c[2]
@@ -993,8 +1102,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def brnz_with(c, top1=None, top2=None, spill=False):
             pc, sp, ob = c[1], c[2], c[4]
             cond = top1[0] if top1 is not None else srow(slo, sp - 1)
-            kept = top2 if top2 is not None else \
-                (srow(slo, sp - 2), srow(shi, sp - 2))
+            kept = top2 if top2 is not None else srow4(sp - 2)
             tgt, nkeep, pop_to = a_r[pc], b_r[pc], c_r[pc]
             tgt_sp = ob + pop_to
             if optimistic:
@@ -1003,8 +1111,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(taken & (nkeep == 1))
                 def _():
-                    wrow(slo, tgt_sp, kept[0])
-                    wrow(shi, tgt_sp, kept[1])
+                    wrow4(tgt_sp, kept)
 
                 return lax.cond(
                     taken,
@@ -1016,8 +1123,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
             @pl.when(agree & taken & (nkeep == 1))
             def _():
-                wrow(slo, tgt_sp, kept[0])
-                wrow(shi, tgt_sp, kept[1])
+                wrow4(tgt_sp, kept)
 
             def diverge():
                 _spill_tops(sp, top1, top2, spill)
@@ -1037,8 +1143,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def br_table_with(c, top1=None, top2=None, spill=False):
             pc, sp, ob = c[1], c[2], c[4]
             idx = top1[0] if top1 is not None else srow(slo, sp - 1)
-            kept = top2 if top2 is not None else \
-                (srow(slo, sp - 2), srow(shi, sp - 2))
+            kept = top2 if top2 is not None else srow4(sp - 2)
             i0 = agree_i32(idx) if optimistic else scal(idx)
             agree = True if optimistic else allsame(idx, i0)
             base, n = a_r[pc], b_r[pc]
@@ -1049,8 +1154,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
             @pl.when(agree & (nkeep == 1))
             def _():
-                wrow(slo, tgt_sp, kept[0])
-                wrow(shi, tgt_sp, kept[1])
+                wrow4(tgt_sp, kept)
 
             def diverge():
                 _spill_tops(sp, top1, top2, spill)
@@ -1067,13 +1171,11 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def return_with(c, top1=None):
             pc, sp, fp, cd = c[1], c[2], c[3], c[5]
             nres = b_r[pc]
-            res = top1 if top1 is not None else \
-                (srow(slo, sp - 1), srow(shi, sp - 1))
+            res = top1 if top1 is not None else srow4(sp - 1)
 
             @pl.when(nres == 1)
             def _():
-                wrow(slo, fp, res[0])
-                wrow(shi, fp, res[1])
+                wrow4(fp, res)
 
             new_sp = fp + nres
             rd = jnp.clip(cd - 1, 0, CD - 1)
@@ -1109,11 +1211,11 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 frames_out[blk, 1, slot] = fp
                 frames_out[blk, 2, slot] = ob
                 zrow = jnp.zeros((1, Lblk), I32)
+                z4 = (zrow, zrow, zrow, zrow) if simd else (zrow, zrow)
                 for k in range(max_local_zeros):
                     @pl.when(k < (nloc - nargs))
                     def _(k=k):
-                        wrow(slo, fp_new + nargs + k, zrow)
-                        wrow(shi, fp_new + nargs + k, zrow)
+                        wrow4(fp_new + nargs + k, z4)
                 return keep(c, pc=fent_r[callee], sp=ob_new, fp=fp_new,
                             ob=ob_new, cd=cd + 1)
 
@@ -2638,8 +2740,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                                                       self.nbelow)
                         k = self.nbelow
                         idx = sp0 - 1 - k
-                        return ((srow(slo, idx), srow(shi, idx)),
-                                VS((), k + 1))
+                        return srow4(idx), VS((), k + 1)
 
                     def drop1(self):
                         if self.items:
@@ -2650,7 +2751,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                         if self.items:
                             return self.items[-1]
                         idx = sp0 - 1 - self.nbelow
-                        return (srow(slo, idx), srow(shi, idx))
+                        return srow4(idx)
 
                     def sp(self):
                         return sp0 + (len(self.items) - self.nbelow)
@@ -2659,8 +2760,16 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                         base = sp0 - self.nbelow
                         n = len(self.items) - skip_top
                         for i in range(n):
-                            wrow(slo, base + i, self.items[i][0])
-                            wrow(shi, base + i, self.items[i][1])
+                            wrow4(base + i, self.items[i])
+
+                def cell2(lo_v, hi_v):
+                    """A scalar-result cell: e2/e3 cleared when the
+                    module carries v128 planes (scalar consumers never
+                    read them; clearing beats stale garbage)."""
+                    if simd:
+                        z = full(0)
+                        return (lo_v, hi_v, z, z)
+                    return (lo_v, hi_v)
 
                 def bail(cb, j, vs):
                     """Un-advanced stop at op j: everything before j is
@@ -2680,29 +2789,27 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     if kind == "nop":
                         return emit(j + 1, cb, vs, pend_l, pend_g)
                     if kind == "const":
-                        vs = vs.push((full(ilo_r[pcj]), full(ihi_r[pcj])))
+                        vs = vs.push(cell2(full(ilo_r[pcj]),
+                                           full(ihi_r[pcj])))
                         return emit(j + 1, cb, vs, pend_l, pend_g)
                     if kind == "lget":
                         v = pend_l.get(op[1])
                         if v is None:
-                            src = fp + a_r[pcj]
-                            v = (srow(slo, src), srow(shi, src))
+                            v = srow4(fp + a_r[pcj])
                         return emit(j + 1, cb, vs.push(v), pend_l, pend_g)
                     if kind in ("lset", "ltee"):
                         if kind == "lset":
                             v, vs = vs.pop()
                         else:
                             v = vs.peek()
-                        dst = fp + a_r[pcj]
-                        wrow(slo, dst, v[0])
-                        wrow(shi, dst, v[1])
+                        wrow4(fp + a_r[pcj], v)
                         return emit(j + 1, cb, vs,
                                     {**pend_l, op[1]: v}, pend_g)
                     if kind == "gget":
                         v = pend_g.get(op[1])
                         if v is None:
                             g = a_r[pcj]
-                            v = (srow(glo, g), srow(ghi, g))
+                            v = cell2(srow(glo, g), srow(ghi, g))
                         return emit(j + 1, cb, vs.push(v), pend_l, pend_g)
                     if kind == "gset":
                         v, vs = vs.pop()
@@ -2718,20 +2825,70 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                         x2, vs = vs.pop()
                         x1, vs = vs.pop()
                         z = cnd[0] == 0
-                        vs = vs.push((jnp.where(z, x2[0], x1[0]),
-                                      jnp.where(z, x2[1], x1[1])))
+                        vs = vs.push(tuple(jnp.where(z, a, b)
+                                           for a, b in zip(x2, x1)))
                         return emit(j + 1, cb, vs, pend_l, pend_g)
                     if kind == "memsize":
-                        vs = vs.push((full(cb[6]), full(0)))
+                        vs = vs.push(cell2(full(cb[6]), full(0)))
                         return emit(j + 1, cb, vs, pend_l, pend_g)
                     if kind == "alu2":
                         y, vs = vs.pop()
                         x, vs = vs.pop()
-                        vs = vs.push(alu2[op[1]](x[0], x[1], y[0], y[1]))
+                        vs = vs.push(cell2(*alu2[op[1]](x[0], x[1],
+                                                        y[0], y[1])))
                         return emit(j + 1, cb, vs, pend_l, pend_g)
                     if kind == "alu1":
                         x, vs = vs.pop()
-                        vs = vs.push(alu1[op[1]](x[0], x[1]))
+                        vs = vs.push(cell2(*alu1[op[1]](x[0], x[1])))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "v2":
+                        y, vs = vs.pop()
+                        x, vs = vs.pop()
+                        vs = vs.push(sops.v2_fn(op[1])(x, y))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "v1":
+                        x, vs = vs.pop()
+                        vs = vs.push(sops.v1_fn(op[1])(x))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "vtest":
+                        x, vs = vs.pop()
+                        vs = vs.push(cell2(sops.vtest_fn(op[1])(x),
+                                           full(0)))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "vshift":
+                        cnt, vs = vs.pop()
+                        x, vs = vs.pop()
+                        vs = vs.push(sops.vshift_fn(op[1])(x, cnt[0]))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "vsplat":
+                        v, vs = vs.pop()
+                        vs = vs.push(sops.vsplat_fn(op[1])(v[0], v[1]))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "vextract":
+                        x, vs = vs.pop()
+                        rl, rh = sops.vextract_dyn(op[1])(x, a_r[pcj])
+                        vs = vs.push(cell2(rl, rh))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "vreplace":
+                        v, vs = vs.pop()
+                        x, vs = vs.pop()
+                        vs = vs.push(sops.vreplace_dyn(op[1])(
+                            x, a_r[pcj], v[0], v[1]))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "vconst":
+                        vs = vs.push(_vconst4(a_r[pcj]))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "vshuffle":
+                        y, vs = vs.pop()
+                        x, vs = vs.pop()
+                        vs = vs.push(sops.vshuffle_dyn()(
+                            x, y, _vconst4(a_r[pcj])))
+                        return emit(j + 1, cb, vs, pend_l, pend_g)
+                    if kind == "vbitsel":
+                        y, vs = vs.pop()
+                        x, vs = vs.pop()
+                        w_, vs = vs.pop()
+                        vs = vs.push(sops.vbitselect()(w_, x, y))
                         return emit(j + 1, cb, vs, pend_l, pend_g)
                     if kind in ("guardz", "guardnz"):
                         return emit_guard(j, cb, vs, pend_l, pend_g)
@@ -2837,8 +2994,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                             m2 = win_read_row(way, wfs2,
                                               jnp.minimum(u + 2, W - 1)) \
                                 if nbytes == 8 else None
-                            vs2 = vs.push(_load_val(m0, m1, m2, shB,
-                                                    nbytes, flags))
+                            vs2 = vs.push(cell2(*_load_val(
+                                m0, m1, m2, shB, nbytes, flags)))
                             return lax.cond(
                                 dirty, rolled_carry,
                                 lambda: lax.cond(
@@ -2850,8 +3007,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                         m1 = srow(memr, jnp.minimum(u + 1, W - 1))
                         m2 = srow(memr, jnp.minimum(u + 2, W - 1)) \
                             if nbytes == 8 else None
-                        vs2 = vs.push(_load_val(m0, m1, m2, shB,
-                                                nbytes, flags))
+                        vs2 = vs.push(cell2(*_load_val(m0, m1, m2, shB,
+                                                       nbytes, flags)))
                         return lax.cond(
                             oob0,
                             lambda: bail(cb, j, vs_pre),
@@ -2988,6 +3145,283 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 return emit(0, c, VS(), {}, {})
             return h
 
+        # ------------------- v128 handlers ----------------------------
+        # Same 4-plane cell model and simdops semantics as the SIMT
+        # engine (engine.py "v128 (SIMD)" section), executed in the one
+        # hot loop like the reference's interpreter runs the whole 0xFD
+        # page in its dispatch loop (lib/executor/engine/engine.cpp
+        # ~700-1610).  Only traced when the module's image uses them.
+        if simd:
+            from wasmedge_tpu.batch import simdops as sops
+
+            def _vconst4(idx):
+                i = jnp.clip(idx, 0, NV - 1)
+                return tuple(full(v128t_r[i, k]) for k in range(4))
+
+            def h_vconst(c):
+                pc, sp = c[1], c[2]
+                wrow4(sp, _vconst4(a_r[pc]))
+                return keep(c, pc=pc + 1, sp=sp + 1)
+
+            def mk_v2(sub):
+                fn = sops.v2_fn(sub)
+
+                def h(c):
+                    pc, sp = c[1], c[2]
+                    wrow4(sp - 2, fn(srow4(sp - 2), srow4(sp - 1)))
+                    return keep(c, pc=pc + 1, sp=sp - 1)
+                return h
+
+            def mk_v1(sub):
+                fn = sops.v1_fn(sub)
+
+                def h(c):
+                    pc, sp = c[1], c[2]
+                    wrow4(sp - 1, fn(srow4(sp - 1)))
+                    return keep(c, pc=pc + 1)
+                return h
+
+            def mk_vtest(sub):
+                fn = sops.vtest_fn(sub)
+
+                def h(c):
+                    pc, sp = c[1], c[2]
+                    r = fn(srow4(sp - 1))
+                    wrow(slo, sp - 1, r)
+                    wrow(shi, sp - 1, full(0))
+                    return keep(c, pc=pc + 1)
+                return h
+
+            def mk_vshift(sub):
+                fn = sops.vshift_fn(sub)
+
+                def h(c):
+                    pc, sp = c[1], c[2]
+                    cnt = srow(slo, sp - 1)
+                    wrow4(sp - 2, fn(srow4(sp - 2), cnt))
+                    return keep(c, pc=pc + 1, sp=sp - 1)
+                return h
+
+            def mk_vsplat(sub):
+                fn = sops.vsplat_fn(sub)
+
+                def h(c):
+                    pc, sp = c[1], c[2]
+                    wrow4(sp - 1, fn(srow(slo, sp - 1),
+                                     srow(shi, sp - 1)))
+                    return keep(c, pc=pc + 1)
+                return h
+
+            def mk_vextract(sub):
+                fn = sops.vextract_dyn(sub)
+
+                def h(c):
+                    pc, sp = c[1], c[2]
+                    rl, rh = fn(srow4(sp - 1), a_r[pc])
+                    wrow(slo, sp - 1, rl)
+                    wrow(shi, sp - 1, rh)
+                    return keep(c, pc=pc + 1)
+                return h
+
+            def mk_vreplace(sub):
+                fn = sops.vreplace_dyn(sub)
+
+                def h(c):
+                    pc, sp = c[1], c[2]
+                    r = fn(srow4(sp - 2), a_r[pc],
+                           srow(slo, sp - 1), srow(shi, sp - 1))
+                    wrow4(sp - 2, r)
+                    return keep(c, pc=pc + 1, sp=sp - 1)
+                return h
+
+            def h_vshuffle(c):
+                pc, sp = c[1], c[2]
+                r = sops.vshuffle_dyn()(srow4(sp - 2), srow4(sp - 1),
+                                        _vconst4(a_r[pc]))
+                wrow4(sp - 2, r)
+                return keep(c, pc=pc + 1, sp=sp - 1)
+
+            def h_vbitsel(c):
+                pc, sp = c[1], c[2]
+                r = sops.vbitselect()(srow4(sp - 3), srow4(sp - 2),
+                                      srow4(sp - 1))
+                wrow4(sp - 3, r)
+                return keep(c, pc=pc + 1, sp=sp - 2)
+
+            def _vmem_rows(cb, u, n_rows, wfs_sel):
+                """Read n_rows consecutive memory words starting at
+                scalar row u (resident rows or window rows)."""
+                if mem_hbm:
+                    way, wfs2 = wfs_sel
+                    return [win_read_row(way, wfs2,
+                                         jnp.minimum(u + k, W - 1))
+                            for k in range(n_rows)]
+                return [srow(memr, jnp.minimum(u + k, W - 1))
+                        for k in range(n_rows)]
+
+            def _v128_from_words(m, shB):
+                """Compose 4 planes from 5 words shifted right by shB
+                bits (the 16-byte unaligned window)."""
+                inv = (32 - shB) & 31
+                hi_or = jnp.where(shB == 0, 0, -1)
+                return tuple(
+                    lax.shift_right_logical(m[k], shB) |
+                    (lax.shift_left(m[k + 1], inv) & hi_or)
+                    for k in range(4))
+
+            def h_vload(c):
+                pc, sp = c[1], c[2]
+                addr = srow(slo, sp - 1)
+                off = a_r[pc]
+                ea = addr + off
+                if optimistic:
+                    _ea0, oob0, u, shB = opt_addr_prolog(
+                        ea, off, 16, c[6])
+                    if mem_hbm:
+                        rhi = jnp.minimum(u + 4, W - 1)
+                        dirty, snapped, way, wfs2 = _opt_window(
+                            c, u, rhi)
+                        m = _vmem_rows(c, u, 5, (way, wfs2))
+                        wrow4(sp - 1, _v128_from_words(m, shB))
+                        c2 = _keep_win(
+                            c, wfs2,
+                            ls=jnp.where(snapped, c[0], c[IDX["ls"]]))
+                        return lax.cond(
+                            dirty, rolled_carry,
+                            lambda: lax.cond(
+                                oob0,
+                                lambda: keep(c2,
+                                             status=I32(ST_DIVERGED)),
+                                lambda: keep(c2, pc=pc + 1)))
+                    m = _vmem_rows(c, u, 5, None)
+                    wrow4(sp - 1, _v128_from_words(m, shB))
+                    return lax.cond(
+                        oob0,
+                        lambda: keep(c, status=I32(ST_DIVERGED)),
+                        lambda: keep(c, pc=pc + 1))
+                # careful: uniform-address fast path, else hand the
+                # block to SIMT (full per-lane v128 over there)
+                carry_ = u_lt(ea, addr) | u_lt(ea, full(off))
+                end = ea + 16
+                mem_bytes = c[6] * I32(65536)
+                oob = carry_ | u_lt(end, ea) | u_lt(mem_bytes, end)
+                widx = jnp.clip(lax.shift_right_logical(ea, 2),
+                                0, W - 1)
+                shBv = (ea & 3) * 8
+                u0 = scal(widx)
+                ok = allsame(widx, u0) & allsame(shBv, scal(shBv)) & \
+                    ~jnp.any(oob)
+                shB = scal(shBv)
+                if mem_hbm:
+                    rhi = jnp.minimum(u0 + 4, W - 1)
+                    way, wfs = _win_select(_wfs_of(c), u0, rhi, ok)
+                    c2 = _keep_win(c, wfs)
+                    m = _vmem_rows(c2, u0, 5, (way, wfs))
+                else:
+                    c2 = c
+                    m = _vmem_rows(c2, u0, 5, None)
+
+                @pl.when(ok)
+                def _():
+                    wrow4(sp - 1, _v128_from_words(m, shB))
+
+                return lax.cond(
+                    ok,
+                    lambda: keep(c2, pc=pc + 1),
+                    lambda: keep(c2, status=I32(ST_DIVERGED)))
+
+            def h_vstore(c):
+                pc, sp = c[1], c[2]
+                v4 = srow4(sp - 1)
+                addr = srow(slo, sp - 2)
+                off = a_r[pc]
+                ea = addr + off
+
+                def word_val_mask(k, shB):
+                    """Word k (0..4) of the 128-bit value shifted left
+                    by shB bits, and its byte mask."""
+                    inv = (32 - shB) & 31
+                    hi_or = jnp.where(shB == 0, 0, -1)
+                    lo_p = lax.shift_left(v4[k], shB) if k < 4 else 0
+                    hi_p = (lax.shift_right_logical(v4[k - 1], inv)
+                            & hi_or) if k > 0 else 0
+                    m_lo = lax.shift_left(I32(-1), shB) if k < 4 else 0
+                    m_hi = (lax.shift_right_logical(I32(-1), inv)
+                            & hi_or) if k > 0 else 0
+                    return lo_p | hi_p, m_lo | m_hi
+
+                def commit(u, shB, okp, win):
+                    for k in range(5):
+                        v, mmask = word_val_mask(k, shB)
+                        w = jnp.minimum(u + k, W - 1)
+
+                        @pl.when(okp & (mmask != 0))
+                        def _(v=v, mmask=mmask, w=w):
+                            if mem_hbm:
+                                way, wfs2 = win
+                                cur = win_read_row(way, wfs2, w)
+                                win_write_row(
+                                    way, wfs2, w,
+                                    (cur & ~mmask) | (v & mmask))
+                            else:
+                                cur = srow(memr, w)
+                                wrow(memr, w,
+                                     (cur & ~mmask) | (v & mmask))
+
+                if optimistic:
+                    _ea0, oob0, u, shB = opt_addr_prolog(
+                        ea, off, 16, c[6])
+                    if mem_hbm:
+                        rhi = jnp.minimum(u + 4, W - 1)
+                        dirty, snapped, way, wfs2 = _opt_window(
+                            c, u, rhi)
+                        commit(u, shB, ~dirty & ~oob0, (way, wfs2))
+                        nwd0 = jnp.where(way == 0, I32(1), wfs2[1])
+                        nwd1 = jnp.where(way == 1, I32(1), wfs2[3])
+                        c2 = keep(c, wb0=wfs2[0], wd0=nwd0,
+                                  wb1=wfs2[2], wd1=nwd1, mru=wfs2[4],
+                                  ls=jnp.where(snapped, c[0],
+                                               c[IDX["ls"]]))
+                        return lax.cond(
+                            dirty, rolled_carry,
+                            lambda: lax.cond(
+                                oob0,
+                                lambda: keep(c2,
+                                             status=I32(ST_DIVERGED)),
+                                lambda: keep(c2, pc=pc + 1,
+                                             sp=sp - 2)))
+                    commit(u, shB, ~oob0, None)
+                    return lax.cond(
+                        oob0,
+                        lambda: keep(c, status=I32(ST_DIVERGED)),
+                        lambda: keep(c, pc=pc + 1, sp=sp - 2))
+                carry_ = u_lt(ea, addr) | u_lt(ea, full(off))
+                end = ea + 16
+                mem_bytes = c[6] * I32(65536)
+                oob = carry_ | u_lt(end, ea) | u_lt(mem_bytes, end)
+                widx = jnp.clip(lax.shift_right_logical(ea, 2),
+                                0, W - 1)
+                shBv = (ea & 3) * 8
+                u0 = scal(widx)
+                ok = allsame(widx, u0) & allsame(shBv, scal(shBv)) & \
+                    ~jnp.any(oob)
+                shB = scal(shBv)
+                if mem_hbm:
+                    rhi = jnp.minimum(u0 + 4, W - 1)
+                    way, wfs = _win_select(_wfs_of(c), u0, rhi, ok)
+                    commit(u0, shB, ok, (way, wfs))
+                    nwd0 = jnp.where(ok & (way == 0), I32(1), wfs[1])
+                    nwd1 = jnp.where(ok & (way == 1), I32(1), wfs[3])
+                    c2 = keep(c, wb0=wfs[0], wd0=nwd0, wb1=wfs[2],
+                              wd1=nwd1, mru=wfs[4])
+                else:
+                    commit(u0, shB, ok, None)
+                    c2 = c
+                return lax.cond(
+                    ok,
+                    lambda: keep(c2, pc=pc + 1, sp=sp - 2),
+                    lambda: keep(c2, status=I32(ST_DIVERGED)))
+
         base_handlers = {
             H_NOP: h_nop, H_CONST: h_const, H_LOCAL_GET: h_local_get,
             H_LOCAL_SET: h_local_set, H_LOCAL_TEE: h_local_tee,
@@ -3003,6 +3437,24 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def handler_for(hid):
             if hid >= H_BLOCK_BASE:
                 return mk_block(block_shapes[hid - H_BLOCK_BASE])
+            if simd and hid >= H_VCONST:
+                if hid >= H_VREPLACE_BASE:
+                    return mk_vreplace(hid - H_VREPLACE_BASE)
+                if hid >= H_VEXTRACT_BASE:
+                    return mk_vextract(hid - H_VEXTRACT_BASE)
+                if hid >= H_VSPLAT_BASE:
+                    return mk_vsplat(hid - H_VSPLAT_BASE)
+                if hid >= H_VSHIFT_BASE:
+                    return mk_vshift(hid - H_VSHIFT_BASE)
+                if hid >= H_VTEST_BASE:
+                    return mk_vtest(hid - H_VTEST_BASE)
+                if hid >= H_V1_BASE:
+                    return mk_v1(hid - H_V1_BASE)
+                if hid >= H_V2_BASE:
+                    return mk_v2(hid - H_V2_BASE)
+                return {H_VCONST: h_vconst, H_VSHUFFLE: h_vshuffle,
+                        H_VBITSEL: h_vbitsel, H_VLOAD: h_vload,
+                        H_VSTORE: h_vstore}[hid]
             if hid in (H_LOAD_W, H_LOAD_D, H_STORE_W, H_STORE_D):
                 # width-specialized paths exist for the hbm+optimistic
                 # kernel; everywhere else they alias the generic ops
@@ -3198,6 +3650,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 dma(5, trapr, trap_out.at[:, pl.ds(lo, Lblk)])]
         if not mem_hbm:
             outs.append(dma(4, memr, mem_out.at[:, pl.ds(lo, Lblk)]))
+        if simd:
+            outs += [dma(6, se2s, se2_out.at[:, pl.ds(lo, Lblk)]),
+                     dma(7, se3s, se3_out.at[:, pl.ds(lo, Lblk)])]
         for c in outs:
             c.start()
         for c in outs:
@@ -3217,28 +3672,25 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
     SH_NG = NGp if shadow_full else 1
     SH_L = L if shadow_full else 1
     WSH = (W if (not mem_hbm and W > 1) else 1) if shadow_full else 1
+    n_planes = 12 + (4 if simd else 0)  # aliased plane inputs/outputs
     spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=14,
+        num_scalar_prefetch=15,
         grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),      # frames_in
-            aspec(), aspec(),                           # stacks (HBM)
-            aspec(), aspec(),                           # globals (HBM)
-            aspec(), aspec(),                           # mem, trap (HBM)
-            aspec(), aspec(), aspec(), aspec(),         # shadows (HBM)
-            aspec(), aspec(),
-        ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),      # ctrl_out
-            pl.BlockSpec(memory_space=pltpu.SMEM),      # frames_out
-            aspec(), aspec(), aspec(), aspec(), aspec(), aspec(),
-            aspec(), aspec(), aspec(), aspec(), aspec(), aspec(),
-        ],
+        in_specs=(
+            [pl.BlockSpec(memory_space=pltpu.SMEM)]     # frames_in
+            + [aspec()] * n_planes),                    # planes (HBM)
+        out_specs=(
+            [pl.BlockSpec(memory_space=pltpu.SMEM),     # ctrl_out
+             pl.BlockSpec(memory_space=pltpu.SMEM)]     # frames_out
+            + [aspec()] * n_planes),
         scratch_shapes=(
             [pltpu.VMEM((D, Lblk), jnp.int32),          # slo
-             pltpu.VMEM((D, Lblk), jnp.int32),          # shi
-             pltpu.VMEM((NGp, Lblk), jnp.int32),        # glo
-             pltpu.VMEM((NGp, Lblk), jnp.int32)]        # ghi
+             pltpu.VMEM((D, Lblk), jnp.int32)]          # shi
+            + ([pltpu.VMEM((D, Lblk), jnp.int32),       # se2 (v128)
+                pltpu.VMEM((D, Lblk), jnp.int32)]       # se3 (v128)
+               if simd else [])
+            + [pltpu.VMEM((NGp, Lblk), jnp.int32),      # glo
+               pltpu.VMEM((NGp, Lblk), jnp.int32)]      # ghi
             + ([pltpu.VMEM((CW, Lblk), jnp.int32),      # mwin0 (way 0)
                 pltpu.VMEM((CW, Lblk), jnp.int32)]      # mwin1 (way 1)
                if mem_hbm else
@@ -3252,35 +3704,43 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                if optimistic else [])
         ),
     )
+    out_shape = [
+        jax.ShapeDtypeStruct((nblk, 16), jnp.int32),    # ctrl
+        jax.ShapeDtypeStruct((nblk, 3, CD), jnp.int32),  # frames
+        jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_lo
+        jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_hi
+        jax.ShapeDtypeStruct((NGp, L), jnp.int32),      # glob_lo
+        jax.ShapeDtypeStruct((NGp, L), jnp.int32),      # glob_hi
+        jax.ShapeDtypeStruct((W, L), jnp.int32),        # mem
+        jax.ShapeDtypeStruct((1, L), jnp.int32),        # trap
+        jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_slo
+        jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_shi
+        jax.ShapeDtypeStruct((SH_NG, SH_L), jnp.int32),  # sh_glo
+        jax.ShapeDtypeStruct((SH_NG, SH_L), jnp.int32),  # sh_ghi
+        jax.ShapeDtypeStruct((1, SH_L), jnp.int32),      # sh_trap
+        jax.ShapeDtypeStruct((WSH, SH_L), jnp.int32),    # sh_mem
+    ]
+    if simd:
+        out_shape += [
+            jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_e2
+            jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_e3
+            jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_se2
+            jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_se3
+        ]
+    # plane inputs (operands: 15 prefetch args, frames_in at 15, planes
+    # from 16) alias the plane outputs (after ctrl/frames)
+    aliases = {16 + k: 2 + k for k in range(n_planes)}
     fn = pl.pallas_call(
         kernel,
         grid_spec=spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((nblk, 16), jnp.int32),    # ctrl
-            jax.ShapeDtypeStruct((nblk, 3, CD), jnp.int32),  # frames
-            jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_lo
-            jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_hi
-            jax.ShapeDtypeStruct((NGp, L), jnp.int32),      # glob_lo
-            jax.ShapeDtypeStruct((NGp, L), jnp.int32),      # glob_hi
-            jax.ShapeDtypeStruct((W, L), jnp.int32),        # mem
-            jax.ShapeDtypeStruct((1, L), jnp.int32),        # trap
-            jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_slo
-            jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_shi
-            jax.ShapeDtypeStruct((SH_NG, SH_L), jnp.int32),  # sh_glo
-            jax.ShapeDtypeStruct((SH_NG, SH_L), jnp.int32),  # sh_ghi
-            jax.ShapeDtypeStruct((1, SH_L), jnp.int32),      # sh_trap
-            jax.ShapeDtypeStruct((WSH, SH_L), jnp.int32),    # sh_mem
-        ],
-        # inputs 15..26 (after 14 prefetch args + frames_in) alias
-        # outs 2..13
-        input_output_aliases={15: 2, 16: 3, 17: 4, 18: 5, 19: 6, 20: 7,
-                              21: 8, 22: 9, 23: 10, 24: 11, 25: 12,
-                              26: 13},
+        out_shape=out_shape,
+        input_output_aliases=aliases,
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )
-    return jax.jit(fn, donate_argnums=tuple(range(15, 27)))
+    return jax.jit(fn, donate_argnums=tuple(
+        range(16, 16 + n_planes)))
 
 
 def pallas_enabled(cfg) -> bool:
@@ -3386,7 +3846,9 @@ class PallasUniformEngine:
         D, CD = self._depths()
         NGp = max(self.img.globals_lo.shape[0], 1)
         memw = 2 * self.HBM_WINDOW_ROWS if mem_hbm else self._mem_words()
-        return 4 * (2 * D + 2 * NGp + memw + 1)
+        # v128 modules carry 4 stack planes (lo/hi/e2/e3) in scratch
+        nstack = 4 if self.img.has_simd else 2
+        return 4 * (nstack * D + 2 * NGp + memw + 1)
 
     def _blk_for(self, per_lane: int) -> Optional[int]:
         """Largest power-of-two lane block whose state fits the budget."""
@@ -3505,6 +3967,7 @@ class PallasUniformEngine:
             if img.has_memory else 0
         mem_hbm = self._mem_mode()
         self._geom = (D, CD, W, Lblk)
+        v128_t = np.asarray(img.v128, np.int32)
         self._kargs = (
             used, D, CD, W, self.lanes, Lblk, NG, img.code_len,
             len(img.f_entry), img.table0.shape[0],
@@ -3512,11 +3975,11 @@ class PallasUniformEngine:
             (not mem_hbm) and W * Lblk <= self.MAX_GATHER_ELEMS,
             interpret, mem_hbm,
             self.HBM_WINDOW_ROWS if mem_hbm else 0,
-            block_shapes)
+            block_shapes, bool(img.has_simd), v128_t.shape[0])
         self._tables = tuple(jnp.asarray(t) for t in (
             hid_dense, a_p, b_p, c_p, ilo_p, ihi_p,
             img.f_entry, img.f_nparams, img.f_nlocals, img.f_frame_top,
-            img.f_type, img.br_table.reshape(-1), img.table0))
+            img.f_type, img.br_table.reshape(-1), img.table0, v128_t))
         self._fn = self._with_export_cache(
             lambda: _build_kernel(*self._kargs,
                                   optimistic=self.optimistic,
@@ -3620,6 +4083,10 @@ class PallasUniformEngine:
                   i32((sh_ng, sh_l), _np.int32),
                   i32((sh_ng, sh_l), _np.int32),
                   i32((1, sh_l), _np.int32), i32((wsh, sh_l), _np.int32)]
+        if self.img.has_simd:
+            specs += [i32((D, L), _np.int32), i32((D, L), _np.int32),
+                      i32((sh_d, sh_l), _np.int32),
+                      i32((sh_d, sh_l), _np.int32)]
         return specs
 
     def _fn_careful(self):
@@ -3648,6 +4115,18 @@ class PallasUniformEngine:
         return [z((D, L), jnp.int32), z((D, L), jnp.int32),
                 z((NGp, L), jnp.int32), z((NGp, L), jnp.int32),
                 z((1, L), jnp.int32), z((wsh, L), jnp.int32)]
+
+    def _shadow_simd_planes(self):
+        """Rollback shadows for the v128 e2/e3 planes (appended after
+        them at the end of the state list)."""
+        import jax.numpy as jnp
+
+        D = self._geom[0]
+        if not self.optimistic:
+            return [jnp.zeros((1, 1), jnp.int32),
+                    jnp.zeros((1, 1), jnp.int32)]
+        return [jnp.zeros((D, self.lanes), jnp.int32),
+                jnp.zeros((D, self.lanes), jnp.int32)]
 
     # -- state ------------------------------------------------------------
     def _from_simt_state(self, simt_state):
@@ -3714,10 +4193,20 @@ class PallasUniformEngine:
             glo = np.concatenate([glo, pad], axis=0)
             ghi = np.concatenate([ghi, pad], axis=0)
         trap = np.asarray(simt_state.trap)[None, :]
-        return [jnp.asarray(ctrl), jnp.zeros((nblk, 3, CD), jnp.int32),
-                jnp.asarray(stack_lo), jnp.asarray(stack_hi),
-                jnp.asarray(glo[:NGp]), jnp.asarray(ghi[:NGp]),
-                jnp.asarray(mem), jnp.asarray(trap)] + self.shadow_planes()
+        state = [jnp.asarray(ctrl), jnp.zeros((nblk, 3, CD), jnp.int32),
+                 jnp.asarray(stack_lo), jnp.asarray(stack_hi),
+                 jnp.asarray(glo[:NGp]), jnp.asarray(ghi[:NGp]),
+                 jnp.asarray(mem), jnp.asarray(trap)] + \
+            self.shadow_planes()
+        if self.img.has_simd:
+            import jax.numpy as jnp2
+
+            for plane in (simt_state.stack_e2, simt_state.stack_e3):
+                p = np.asarray(plane)[:D] if plane is not None else \
+                    np.zeros((D, L), np.int32)
+                state.append(jnp2.asarray(p))
+            state += self._shadow_simd_planes()
+        return state
 
     def run_blocks(self, simt_state, max_steps: int = 10_000_000):
         """Run from a block-uniform SIMT state; returns (simt_state,
@@ -3840,6 +4329,7 @@ class PallasUniformEngine:
             mem_np = np.concatenate(
                 [mem_np, np.zeros((simt_w - mem_np.shape[0], L), np.int32)],
                 axis=0)
+        simd = self.img.has_simd
         return BatchState(
             pc=jnp.asarray(lanes_of(_C_PC)), sp=jnp.asarray(lanes_of(_C_SP)),
             fp=jnp.asarray(lanes_of(_C_FP)),
@@ -3856,6 +4346,10 @@ class PallasUniformEngine:
             glob_lo=jnp.asarray(np.asarray(state[4])),
             glob_hi=jnp.asarray(np.asarray(state[5])),
             mem=jnp.asarray(mem_np),
+            stack_e2=jnp.asarray(pad_rows(state[14], D_s)) if simd
+            else None,
+            stack_e3=jnp.asarray(pad_rows(state[15], D_s)) if simd
+            else None,
         )
 
     # -- run --------------------------------------------------------------

@@ -84,6 +84,9 @@ _B_FREE = 0
 _B_LIVE = 1     # installed in the device state (any kernel status)
 
 _PLANE_IDX = {"slo": 2, "shi": 3, "glo": 4, "ghi": 5, "mem": 6, "trap": 7}
+# v128 e2/e3 planes sit AFTER the 6 rollback shadows (indices 8-13) so
+# every non-simd index stays stable
+_PLANE_IDX_SIMD = dict(_PLANE_IDX, se2=14, se3=15)
 
 
 def _u32(x):
@@ -234,6 +237,8 @@ class BlockScheduler:
         self.retired = np.zeros(self.lanes, np.int64)
         self.fell_back_to_simt = False
         self.splits = 0
+        self._plane_idx = _PLANE_IDX_SIMD if outer.img.has_simd \
+            else _PLANE_IDX
         self._plan()
 
     # -- entry packing -----------------------------------------------------
@@ -393,6 +398,10 @@ class BlockScheduler:
                       jnp.zeros((self.nblk, 3, CD), jnp.int32),
                       stack_lo, stack_hi, glo, ghi, mem,
                       jnp.zeros((1, L), jnp.int32)] + eng.shadow_planes()
+        if img.has_simd:
+            self.state += [jnp.zeros((D, L), jnp.int32),
+                           jnp.zeros((D, L), jnp.int32)]
+            self.state += eng._shadow_simd_planes()
 
     # -- drive -------------------------------------------------------------
     def run(self):
@@ -875,7 +884,7 @@ class BlockScheduler:
         lo = b * Lblk
         idx = jnp.asarray(lo + np.asarray(cols, np.int64))
         out = {}
-        for name, i in _PLANE_IDX.items():
+        for name, i in self._plane_idx.items():
             out[name] = self.state[i][:, idx]
         for key, val in writes.items():
             row = key[1]
@@ -911,7 +920,7 @@ class BlockScheduler:
             # pad by cloning the first column
             sel = jnp.asarray(np.concatenate(
                 [np.arange(n), np.zeros(max(Lblk - n, 0), np.int64)]))
-            for name, i in _PLANE_IDX.items():
+            for name, i in self._plane_idx.items():
                 self.state[i] = self.state[i].at[:, lo:lo + Lblk].set(
                     p.cols[name][:, sel])
             ctrl[b] = p.ctrl
@@ -972,6 +981,9 @@ class BlockScheduler:
         frp = np.zeros((CD_s, L), np.int32)
         frf = np.zeros((CD_s, L), np.int32)
         fro = np.zeros((CD_s, L), np.int32)
+        simd = img.has_simd
+        s_e2 = np.zeros((D_s, L), np.int32) if simd else None
+        s_e3 = np.zeros((D_s, L), np.int32) if simd else None
         members = []
         for p in self._simt_queue:
             n = len(p.lane_ids)
@@ -990,6 +1002,9 @@ class BlockScheduler:
             d = min(p.cols["slo"].shape[0], D_s)
             s_lo[:d, li] = p.cols["slo"][:d, :n]
             s_hi[:d, li] = p.cols["shi"][:d, :n]
+            if simd:
+                s_e2[:d, li] = p.cols["se2"][:d, :n]
+                s_e3[:d, li] = p.cols["se3"][:d, :n]
             g = min(p.cols["glo"].shape[0], NG)
             g_lo[:g, li] = p.cols["glo"][:g, :n]
             g_hi[:g, li] = p.cols["ghi"][:g, :n]
@@ -1009,7 +1024,9 @@ class BlockScheduler:
             fr_ret_pc=jnp.asarray(frp), fr_fp=jnp.asarray(frf),
             fr_opbase=jnp.asarray(fro),
             glob_lo=jnp.asarray(g_lo), glob_hi=jnp.asarray(g_hi),
-            mem=jnp.asarray(mem))
+            mem=jnp.asarray(mem),
+            stack_e2=jnp.asarray(s_e2) if simd else None,
+            stack_e3=jnp.asarray(s_e3) if simd else None)
         # account for work already done on the kernel so the caller's
         # max_steps bounds TOTAL execution, not each engine separately
         # (coarse like the pre-scheduler handoff: the max over members)
