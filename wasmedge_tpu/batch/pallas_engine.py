@@ -3282,7 +3282,11 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                         dirty, snapped, way, wfs2 = _opt_window(
                             c, u, rhi)
                         m = _vmem_rows(c, u, 5, (way, wfs2))
-                        wrow4(sp - 1, _v128_from_words(m, shB))
+
+                        @pl.when(~dirty & ~oob0)
+                        def _():
+                            wrow4(sp - 1, _v128_from_words(m, shB))
+
                         c2 = _keep_win(
                             c, wfs2,
                             ls=jnp.where(snapped, c[0], c[IDX["ls"]]))
@@ -3294,7 +3298,11 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                                              status=I32(ST_DIVERGED)),
                                 lambda: keep(c2, pc=pc + 1)))
                     m = _vmem_rows(c, u, 5, None)
-                    wrow4(sp - 1, _v128_from_words(m, shB))
+
+                    @pl.when(~oob0)
+                    def _():
+                        wrow4(sp - 1, _v128_from_words(m, shB))
+
                     return lax.cond(
                         oob0,
                         lambda: keep(c, status=I32(ST_DIVERGED)),
@@ -3929,8 +3937,12 @@ class PallasUniformEngine:
             hid, block_shapes = fuse_blocks(hid, img)
         else:
             block_shapes = ()
-            hid, a_p, b_p, c_p, ilo_p, ihi_p = fuse_image(
-                hid, a_p, b_p, c_p, ilo_p, ihi_p, img)
+            if not img.has_simd:
+                # the legacy peephole superinstructions move only the
+                # lo/hi planes of kept values, which would truncate
+                # v128 cells — simd modules run unfused on this path
+                hid, a_p, b_p, c_p, ilo_p, ihi_p = fuse_image(
+                    hid, a_p, b_p, c_p, ilo_p, ihi_p, img)
         # tpu.aot artifacts carry the fused encoding.  Verification IS
         # regeneration (cheap next to XLA compilation); once verified,
         # the attached planes are the ones executed — a stale or
