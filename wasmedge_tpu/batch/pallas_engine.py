@@ -711,6 +711,29 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             GR //= 2
     GATHER_CHUNKS = W // GR if W % GR == 0 else 0
 
+    # ---- 8-sublane lane remap ------------------------------------------
+    # A (1, Lblk) int32 row occupies Lblk/128 vregs at 1/8 sublane
+    # utilization — the measured ~590ns/instr dispatch floor of r04
+    # (MEMORY_r04.json ceiling analysis).  When the lane block splits
+    # into 8 stripes of whole lane tiles (Lpb % 128 == 0), kernel state
+    # is laid out [rows, 8, Lpb] instead of [rows, Lblk]: every row op
+    # then runs on an (8, Lpb) array = Lblk/1024 fully-packed vregs, an
+    # 8x denser vector layout for identical state.  Host-side HBM
+    # planes keep their [rows, L] layout; the jit wrapper bitcast-
+    # reshapes them to [rows, L/Lpb, Lpb] so that block b's lanes are
+    # exactly stripes [8b, 8b+8) and each plane DMA stays one copy.
+    # Lane l maps to (stripe l//Lpb, column l%Lpb): lane 0 stays at
+    # (0, 0), so scal()/lane-0 optimistic decisions are unchanged.
+    # Interpret mode (CPU tests) takes the remap whenever Lblk divides
+    # by 8 so the suite exercises the 3-d path at small lane counts.
+    if interpret:
+        SUB = 8 if Lblk % 8 == 0 else 1
+    else:
+        SUB = 8 if Lblk % 1024 == 0 else 1
+    Lpb = Lblk // SUB
+    three_d = SUB > 1
+    ROW = (SUB, Lpb)
+
     # inputs/outputs: frames + 12 base planes (+4 v128 planes: stack
     # e2/e3 and their rollback shadows, appended LAST so every existing
     # index — scheduler plane map, hostcall serving, checkpointing —
@@ -754,6 +777,24 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                                         next(it_), next(it_))
         blk = pl.program_id(0)
         lo = blk * Lblk
+        # lane-block slices of the (wrapper-reshaped) HBM planes: in
+        # three_d mode a plane is [rows, L/Lpb, Lpb] and the block's
+        # lanes are stripes [8b, 8b+8) (whole (8,128) tiles, so the
+        # slice start is statically 8-aligned for Mosaic).
+        if three_d:
+            lo3 = pl.multiple_of(blk * SUB, SUB)
+
+            def lslice(ref):
+                return ref.at[:, pl.ds(lo3, SUB)]
+
+            def lsliceR(ref, r0, n):
+                return ref.at[pl.ds(r0, n), pl.ds(lo3, SUB)]
+        else:
+            def lslice(ref):
+                return ref.at[:, pl.ds(lo, Lblk)]
+
+            def lsliceR(ref, r0, n):
+                return ref.at[pl.ds(r0, n), pl.ds(lo, Lblk)]
 
         # State planes live in HBM (pl.ANY); the working copy is VMEM
         # scratch, DMA'd in per lane block and DMA'd back at the end.
@@ -765,19 +806,19 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         def dma(i, src, dst):
             return pltpu.make_async_copy(src, dst, sems.at[i])
 
-        ins = [dma(0, s_lo_in.at[:, pl.ds(lo, Lblk)], slo),
-               dma(1, s_hi_in.at[:, pl.ds(lo, Lblk)], shi),
-               dma(2, g_lo_in.at[:, pl.ds(lo, Lblk)], glo),
-               dma(3, g_hi_in.at[:, pl.ds(lo, Lblk)], ghi),
-               dma(5, trap_in.at[:, pl.ds(lo, Lblk)], trapr)]
+        ins = [dma(0, lslice(s_lo_in), slo),
+               dma(1, lslice(s_hi_in), shi),
+               dma(2, lslice(g_lo_in), glo),
+               dma(3, lslice(g_hi_in), ghi),
+               dma(5, lslice(trap_in), trapr)]
         if not mem_hbm:
-            ins.append(dma(4, mem_in.at[:, pl.ds(lo, Lblk)], memr))
+            ins.append(dma(4, lslice(mem_in), memr))
         if simd:
             # sems 6/7 are reused for the e2/e3 planes here and in the
             # snapshot paths: window DMAs (the other users of 6/7) are
             # never in flight across those batches
-            ins += [dma(6, se2_in.at[:, pl.ds(lo, Lblk)], se2s),
-                    dma(7, se3_in.at[:, pl.ds(lo, Lblk)], se3s)]
+            ins += [dma(6, lslice(se2_in), se2s),
+                    dma(7, lslice(se3_in), se3s)]
         for c in ins:
             c.start()
         for c in ins:
@@ -807,16 +848,55 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         chunk_eff = jnp.minimum(chunk, fuel_in)
 
         def full(v):
-            return jnp.full((1, Lblk), v, I32)
+            return jnp.full(ROW, v, I32)
 
-        def srow(ref, i):
-            return ref[pl.ds(i, 1), :]
+        # row access: a logical row is always a 2-d (SUB, Lpb) array —
+        # (1, Lblk) in legacy mode, (8, Lpb) fully tiled in three_d mode
+        if three_d:
+            def srow(ref, i):
+                return ref[pl.ds(i, 1)][0]
 
-        def wrow(ref, i, v):
-            ref[pl.ds(i, 1), :] = v
+            def wrow(ref, i, v):
+                ref[pl.ds(i, 1)] = v[None]
+
+            def srows(ref, r0, n):
+                return ref[pl.ds(r0, n)]            # (n, SUB, Lpb)
+
+            def wrows(ref, r0, n, v):
+                ref[pl.ds(r0, n)] = v
+
+            def riota(n):
+                # row-index iota over an (n, SUB, Lpb) row stack
+                return jax.lax.broadcasted_iota(I32, (n,) + ROW, 0)
+
+            def rsum(x):
+                # reduce an (n, SUB, Lpb) stack to one row
+                return jnp.sum(x, axis=0)
+        else:
+            def srow(ref, i):
+                return ref[pl.ds(i, 1), :]
+
+            def wrow(ref, i, v):
+                ref[pl.ds(i, 1), :] = v
+
+            def srows(ref, r0, n):
+                return ref[pl.ds(r0, n), :]
+
+            def wrows(ref, r0, n, v):
+                ref[pl.ds(r0, n), :] = v
+
+            def riota(n):
+                return jax.lax.broadcasted_iota(I32, (n, Lblk), 0)
+
+            def rsum(x):
+                return jnp.sum(x, axis=0, keepdims=True)
 
         def scal(vec):
             return vec[0, 0]
+
+        def trap_where(cond_row, code_row):
+            """Per-lane trap-code write: codes where cond, else keep."""
+            wrow(trapr, 0, jnp.where(cond_row, code_row, srow(trapr, 0)))
 
         # 4-plane cell accessors (v128 cells span lo/hi/e2/e3; scalar
         # cells leave e2/e3 don't-care — copies move whatever is there)
@@ -876,7 +956,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             def agree_i32(vec):
                 """lane-0 value decision; exact-mismatch canary."""
                 s = scal(vec)
-                canr[0, :] = canr[0, :] | (vec[0, :] ^ s)
+                wrow(canr, 0, srow(canr, 0) | (vec ^ s))
                 return s
 
             def opt_addr_prolog(ea, off, nbytes, pages):
@@ -901,24 +981,24 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 """lane-0 zeroness decision (branch conditions agree when
                 their zeroness agrees, not their values)."""
                 s = scal(vec)
-                canr[0, :] = canr[0, :] | jnp.where(
-                    (vec[0, :] != 0) != (s != 0), I32(1), I32(0))
+                wrow(canr, 0, srow(canr, 0) | jnp.where(
+                    (vec != 0) != (s != 0), I32(1), I32(0)))
                 return s
 
             def do_snapshot(c):
                 """Record the rollback point = the CURRENT (validated)
                 state: planes -> shadow HBM, live frames + carry ->
                 SMEM, canary reset."""
-                cps = [dma(0, slo, sh_slo.at[:, pl.ds(lo, Lblk)]),
-                       dma(1, shi, sh_shi.at[:, pl.ds(lo, Lblk)]),
-                       dma(2, glo, sh_glo.at[:, pl.ds(lo, Lblk)]),
-                       dma(3, ghi, sh_ghi.at[:, pl.ds(lo, Lblk)]),
-                       dma(5, trapr, sh_trap.at[:, pl.ds(lo, Lblk)])]
+                cps = [dma(0, slo, lslice(sh_slo)),
+                       dma(1, shi, lslice(sh_shi)),
+                       dma(2, glo, lslice(sh_glo)),
+                       dma(3, ghi, lslice(sh_ghi)),
+                       dma(5, trapr, lslice(sh_trap))]
                 if not mem_hbm and W > 1:
-                    cps.append(dma(4, memr, sh_mem.at[:, pl.ds(lo, Lblk)]))
+                    cps.append(dma(4, memr, lslice(sh_mem)))
                 if simd:
-                    cps += [dma(6, se2s, sh_se2.at[:, pl.ds(lo, Lblk)]),
-                            dma(7, se3s, sh_se3.at[:, pl.ds(lo, Lblk)])]
+                    cps += [dma(6, se2s, lslice(sh_se2)),
+                            dma(7, se3s, lslice(sh_se3))]
                 for cp_ in cps:
                     cp_.start()
                 for cp_ in cps:
@@ -933,20 +1013,20 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 lax.fori_loop(0, jnp.clip(cd_now, 0, CD), cpf, 0)
                 for k in range(NCARRY):
                     snapc[k] = c[k]
-                canr[0, :] = jnp.zeros((Lblk,), I32)
+                wrow(canr, 0, full(0))
 
             def do_restore():
                 """Rewind to the last snapshot (inverse of do_snapshot)."""
-                cps = [dma(0, sh_slo.at[:, pl.ds(lo, Lblk)], slo),
-                       dma(1, sh_shi.at[:, pl.ds(lo, Lblk)], shi),
-                       dma(2, sh_glo.at[:, pl.ds(lo, Lblk)], glo),
-                       dma(3, sh_ghi.at[:, pl.ds(lo, Lblk)], ghi),
-                       dma(5, sh_trap.at[:, pl.ds(lo, Lblk)], trapr)]
+                cps = [dma(0, lslice(sh_slo), slo),
+                       dma(1, lslice(sh_shi), shi),
+                       dma(2, lslice(sh_glo), glo),
+                       dma(3, lslice(sh_ghi), ghi),
+                       dma(5, lslice(sh_trap), trapr)]
                 if not mem_hbm and W > 1:
-                    cps.append(dma(4, sh_mem.at[:, pl.ds(lo, Lblk)], memr))
+                    cps.append(dma(4, lslice(sh_mem), memr))
                 if simd:
-                    cps += [dma(6, sh_se2.at[:, pl.ds(lo, Lblk)], se2s),
-                            dma(7, sh_se3.at[:, pl.ds(lo, Lblk)], se3s)]
+                    cps += [dma(6, lslice(sh_se2), se2s),
+                            dma(7, lslice(sh_se3), se3s)]
                 for cp_ in cps:
                     cp_.start()
                 for cp_ in cps:
@@ -959,7 +1039,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     return 0
 
                 lax.fori_loop(0, jnp.clip(cd_snap, 0, CD), cpf, 0)
-                canr[0, :] = jnp.zeros((Lblk,), I32)
+                wrow(canr, 0, full(0))
 
             def rolled_carry():
                 """Post-restore carry: snapshot scalars, ST_RECHECK, and
@@ -979,7 +1059,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 (memory.fill/copy: per-lane ranged, reduction-heavy).
                 Validate; roll back if a stale decision is pending; exit
                 at this exact instruction with ST_RECHECK."""
-                flag[0] = jnp.any(canr[0, :] != 0).astype(jnp.int32)
+                flag[0] = jnp.any(srow(canr, 0) != 0).astype(jnp.int32)
                 dirty = flag[0] != 0
 
                 @pl.when(dirty)
@@ -1202,7 +1282,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 code = jnp.where(cd >= CD - 1,
                                  I32(int(ErrCode.CallStackExhausted)),
                                  I32(int(ErrCode.StackOverflow)))
-                trapr[0, :] = jnp.full((Lblk,), code, I32)
+                wrow(trapr, 0, full(code))
                 return keep(c, status=I32(ST_TRAPPED_BASE) + code)
 
             def go_fn():
@@ -1210,7 +1290,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 frames_out[blk, 0, slot] = pc + 1
                 frames_out[blk, 1, slot] = fp
                 frames_out[blk, 2, slot] = ob
-                zrow = jnp.zeros((1, Lblk), I32)
+                zrow = full(0)
                 z4 = (zrow, zrow, zrow, zrow) if simd else (zrow, zrow)
                 for k in range(max_local_zeros):
                     @pl.when(k < (nloc - nargs))
@@ -1243,7 +1323,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     oob, I32(int(ErrCode.UndefinedElement)),
                     jnp.where(null, I32(int(ErrCode.UninitializedElement)),
                               I32(int(ErrCode.IndirectCallTypeMismatch))))
-                trapr[0, :] = jnp.full((Lblk,), code, I32)
+                wrow(trapr, 0, full(code))
                 return keep(c, status=I32(ST_TRAPPED_BASE) + code)
 
             def diverge():
@@ -1295,7 +1375,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
         def h_trap(c):
             code = a_r[c[1]]
-            trapr[0, :] = jnp.full((Lblk,), code, I32)
+            wrow(trapr, 0, full(code))
             return keep(c, status=I32(ST_TRAPPED_BASE) + code)
 
         def h_memfill(c):
@@ -1323,8 +1403,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
             def chunk(i, _):
                 base = i * GR
-                rows = memr[pl.ds(base, GR), :]
-                wi = base + jax.lax.broadcasted_iota(I32, (GR, Lblk), 0)
+                rows = srows(memr, base, GR)
+                wi = base + riota(GR)
                 byte0 = wi * 4
                 mask = jnp.zeros_like(rows)
                 for bpos in range(4):
@@ -1333,8 +1413,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     mask = mask | jnp.where(
                         inr, jnp.int32(lo_ops.BYTE_MASKS[bpos]), 0)
                 write = (mask != 0) & go
-                memr[pl.ds(base, GR), :] = jnp.where(
-                    write, (rows & ~mask) | (fill_word & mask), rows)
+                wrows(memr, base, GR, jnp.where(
+                    write, (rows & ~mask) | (fill_word & mask), rows))
                 return 0
 
             lax.fori_loop(c_lo, c_hi, chunk, 0)
@@ -1342,9 +1422,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
             @pl.when(any_oob)
             def _():
-                trapr[0, :] = jnp.where(
-                    oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
-                    trapr[0, :])
+                trap_where(oob, I32(int(ErrCode.MemoryOutOfBounds)))
 
             return lax.cond(
                 any_oob,
@@ -1402,7 +1480,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     m1 = srow(memr, jnp.clip(r + qv + 1, 0, W - 1))
                     val = lax.shift_right_logical(m0, shB) | \
                         (lax.shift_left(m1, inv) & hi_or)
-                    mask = jnp.zeros((1, Lblk), I32)
+                    mask = full(0)
                     for bpos in range(4):
                         ba = full(r * 4 + bpos)
                         inr = (~u_lt(ba, dst)) & u_lt(ba, dend)
@@ -1420,9 +1498,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(any_oob)
                 def _():
-                    trapr[0, :] = jnp.where(
-                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
-                        trapr[0, :])
+                    trap_where(oob, I32(int(ErrCode.MemoryOutOfBounds)))
 
                 return lax.cond(
                     any_oob,
@@ -1456,13 +1532,11 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
             def chunk(i, acc):
                 base = i * GR
-                rows = memr[pl.ds(base, GR), :]
-                wi = base + jax.lax.broadcasted_iota(I32, (GR, Lblk), 0)
-                return acc + jnp.sum(jnp.where(wi == widx, rows, 0),
-                                     axis=0, keepdims=True)
+                rows = srows(memr, base, GR)
+                wi = base + riota(GR)
+                return acc + rsum(jnp.where(wi == widx, rows, 0))
 
-            return lax.fori_loop(c_lo, c_hi, chunk,
-                                 jnp.zeros((1, Lblk), I32))
+            return lax.fori_loop(c_lo, c_hi, chunk, full(0))
 
         def _load_finish(c, mw0, mw1, mw2, shB, oob, any_oob):
             pc, sp = c[1], c[2]
@@ -1500,9 +1574,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
             @pl.when(any_oob)
             def _():
-                trapr[0, :] = jnp.where(
-                    oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
-                    trapr[0, :])
+                trap_where(oob, I32(int(ErrCode.MemoryOutOfBounds)))
 
         def h_load(c):
             pc, sp, pages = c[1], c[2], c[6]
@@ -1598,9 +1670,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(oob0)
                 def _():
-                    trapr[0, :] = jnp.where(
-                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
-                        trapr[0, :])
+                    trap_where(oob, I32(int(ErrCode.MemoryOutOfBounds)))
 
                 return lax.cond(
                     oob0,
@@ -1613,8 +1683,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             b2_ = nbytes == 2
             full_lo = jnp.where(b1, 0xFF, jnp.where(b2_, 0xFFFF, I32(-1)))
             full_hi = jnp.where(nbytes == 8, I32(-1), 0)
-            full_lo = jnp.broadcast_to(full_lo, (1, Lblk))
-            full_hi = jnp.broadcast_to(full_hi, (1, Lblk))
+            full_lo = jnp.broadcast_to(full_lo, ROW)
+            full_hi = jnp.broadcast_to(full_hi, ROW)
             ((sm0, sv0), (sm1, sv1), (sm2, sv2)) = shifted_store_triples(
                 full_lo, full_hi, vl, vh, shB)
             u0 = scal(widx)
@@ -1649,20 +1719,18 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                         def chunk(i, _, m=m, v=v, wk=wk):
                             base = i * GR
-                            rows = memr[pl.ds(base, GR), :]
-                            wi = base + jax.lax.broadcasted_iota(
-                                I32, (GR, Lblk), 0)
+                            rows = srows(memr, base, GR)
+                            wi = base + riota(GR)
                             hit = (wi == wk) & (ok & (m != 0))
-                            memr[pl.ds(base, GR), :] = jnp.where(
-                                hit, (rows & ~m) | (v & m), rows)
+                            wrows(memr, base, GR, jnp.where(
+                                hit, (rows & ~m) | (v & m), rows))
                             return 0
 
                         lax.fori_loop(c_lo, c_hi, chunk, 0)
 
             @pl.when(commit & any_oob)
             def _():
-                trapr[0, :] = jnp.where(
-                    oob[0], I32(int(ErrCode.MemoryOutOfBounds)), trapr[0, :])
+                trap_where(oob, I32(int(ErrCode.MemoryOutOfBounds)))
 
             return lax.cond(
                 commit,
@@ -1691,16 +1759,12 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 return pl.multiple_of(v, 8)
 
             def _wb_way0(wb):
-                cp = dma(6, mwin0, mem_out.at[
-                    pl.ds(a8(jnp.clip(wb, 0, W - CW)), CW),
-                    pl.ds(lo, Lblk)])
+                cp = dma(6, mwin0, lsliceR(mem_out, a8(jnp.clip(wb, 0, W - CW)), CW))
                 cp.start()
                 cp.wait()
 
             def _wb_way1(wb):
-                cp = dma(7, mwin1, mem_out.at[
-                    pl.ds(a8(jnp.clip(wb, 0, W - CW)), CW),
-                    pl.ds(lo, Lblk)])
+                cp = dma(7, mwin1, lsliceR(mem_out, a8(jnp.clip(wb, 0, W - CW)), CW))
                 cp.start()
                 cp.wait()
 
@@ -1735,8 +1799,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(repl0)
                 def _():
-                    cp = dma(6, mem_out.at[pl.ds(a8(nb), CW),
-                                           pl.ds(lo, Lblk)], mwin0)
+                    cp = dma(6, lsliceR(mem_out, a8(nb), CW), mwin0)
                     cp.start()
                     cp.wait()
 
@@ -1746,8 +1809,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(repl1)
                 def _():
-                    cp = dma(7, mem_out.at[pl.ds(a8(nb), CW),
-                                           pl.ds(lo, Lblk)], mwin1)
+                    cp = dma(7, lsliceR(mem_out, a8(nb), CW), mwin1)
                     cp.start()
                     cp.wait()
 
@@ -1779,26 +1841,25 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             def win_read_row(way, wfs, r):
                 i0 = jnp.clip(r - wfs[0], 0, CW - 1)
                 i1 = jnp.clip(r - wfs[2], 0, CW - 1)
-                return jnp.where(way == 0, mwin0[pl.ds(i0, 1), :],
-                                 mwin1[pl.ds(i1, 1), :])
+                return jnp.where(way == 0, srow(mwin0, i0), srow(mwin1, i1))
 
             def win_write_row(way, wfs, r, v):
                 @pl.when(way == 0)
                 def _():
-                    mwin0[pl.ds(jnp.clip(r - wfs[0], 0, CW - 1), 1), :] = v
+                    wrow(mwin0, jnp.clip(r - wfs[0], 0, CW - 1), v)
 
                 @pl.when(way == 1)
                 def _():
-                    mwin1[pl.ds(jnp.clip(r - wfs[2], 0, CW - 1), 1), :] = v
+                    wrow(mwin1, jnp.clip(r - wfs[2], 0, CW - 1), v)
 
             def _win_gather(way, wfs, wk):
                 """Per-lane word gather from the selected resident way."""
                 base = jnp.where(way == 0, wfs[0], wfs[2])
                 rel = wk - base
-                wi = jax.lax.broadcasted_iota(I32, (CW, Lblk), 0)
-                rows = jnp.where(way == 0, mwin0[:, :], mwin1[:, :])
-                return jnp.sum(jnp.where(wi == rel, rows, 0),
-                               axis=0, keepdims=True)
+                wi = riota(CW)
+                rows = jnp.where(way == 0, srows(mwin0, 0, CW),
+                                 srows(mwin1, 0, CW))
+                return rsum(jnp.where(wi == rel, rows, 0))
 
             def _wfs_of(c):
                 return (c[8], c[9], c[10], c[11], c[12])
@@ -1837,7 +1898,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(needs_wb)
                 def _():
-                    flag[0] = jnp.any(canr[0, :] != 0).astype(jnp.int32)
+                    flag[0] = jnp.any(srow(canr, 0) != 0).astype(jnp.int32)
 
                 dirty = needs_wb & (flag[0] != 0)
                 okp = ~dirty
@@ -1865,15 +1926,13 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(okp & repl0)
                 def _():
-                    cp = dma(6, mem_out.at[pl.ds(a8(nb), CW),
-                                           pl.ds(lo, Lblk)], mwin0)
+                    cp = dma(6, lsliceR(mem_out, a8(nb), CW), mwin0)
                     cp.start()
                     cp.wait()
 
                 @pl.when(okp & repl1)
                 def _():
-                    cp = dma(7, mem_out.at[pl.ds(a8(nb), CW),
-                                           pl.ds(lo, Lblk)], mwin1)
+                    cp = dma(7, lsliceR(mem_out, a8(nb), CW), mwin1)
                     cp.start()
                     cp.wait()
 
@@ -1926,9 +1985,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                     end = ea + nbytes
                     oob = carry_ | u_lt(end, ea) | \
                         u_lt(full(pages * I32(65536)), end)
-                    trapr[0, :] = jnp.where(
-                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
-                        trapr[0, :])
+                    trap_where(oob, I32(int(ErrCode.MemoryOutOfBounds)))
 
             def _mk_load_wd(is64):
                 nbytes = 8 if is64 else 4
@@ -1957,7 +2014,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                                 (lax.shift_left(m2, inv) & hi_or)
                             wrow(shi, sp - 1, lh)
                         else:
-                            wrow(shi, sp - 1, jnp.zeros((1, Lblk), I32))
+                            wrow(shi, sp - 1, full(0))
                         _opt_trap_oob(c, ea, nbytes, oob0)
 
                     c2 = _keep_win(
@@ -2119,9 +2176,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                     @pl.when(~dirty & oob0)
                     def _():
-                        trapr[0, :] = jnp.where(
-                            oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
-                            trapr[0, :])
+                        trap_where(oob, I32(int(ErrCode.MemoryOutOfBounds)))
 
                     nwd0 = jnp.where(way == 0, I32(1), wfs2[1])
                     nwd1 = jnp.where(way == 1, I32(1), wfs2[3])
@@ -2152,8 +2207,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 full_lo = jnp.where(b1, 0xFF,
                                     jnp.where(b2_, 0xFFFF, I32(-1)))
                 full_hi = jnp.where(nbytes == 8, I32(-1), 0)
-                full_lo = jnp.broadcast_to(full_lo, (1, Lblk))
-                full_hi = jnp.broadcast_to(full_hi, (1, Lblk))
+                full_lo = jnp.broadcast_to(full_lo, ROW)
+                full_hi = jnp.broadcast_to(full_hi, ROW)
                 ((sm0, sv0), (sm1, sv1), (sm2, sv2)) = \
                     shifted_store_triples(full_lo, full_hi, vl, vh, shB)
                 rlo = jnp.min(widx)
@@ -2181,7 +2236,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 @pl.when(fits & ~uni)
                 def _():
                     base = jnp.where(way == 0, wfs[0], wfs[2])
-                    wi = jax.lax.broadcasted_iota(I32, (CW, Lblk), 0) + base
+                    wi = riota(CW) + base
                     for k, (m, v) in enumerate(((sm0, sv0), (sm1, sv1),
                                                 (sm2, sv2))):
                         wk = jnp.clip(widx + k, 0, W - 1)
@@ -2189,15 +2244,15 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                         @pl.when(way == 0)
                         def _(hit=hit, m=m, v=v):
-                            mwin0[:, :] = jnp.where(
-                                hit, (mwin0[:, :] & ~m) | (v & m),
-                                mwin0[:, :])
+                            cur = srows(mwin0, 0, CW)
+                            wrows(mwin0, 0, CW, jnp.where(
+                                hit, (cur & ~m) | (v & m), cur))
 
                         @pl.when(way == 1)
                         def _(hit=hit, m=m, v=v):
-                            mwin1[:, :] = jnp.where(
-                                hit, (mwin1[:, :] & ~m) | (v & m),
-                                mwin1[:, :])
+                            cur = srows(mwin1, 0, CW)
+                            wrows(mwin1, 0, CW, jnp.where(
+                                hit, (cur & ~m) | (v & m), cur))
 
                 nwd0 = jnp.where(fits & (way == 0), I32(1), wfs[1])
                 nwd1 = jnp.where(fits & (way == 1), I32(1), wfs[3])
@@ -2206,9 +2261,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(fits & any_oob)
                 def _():
-                    trapr[0, :] = jnp.where(
-                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
-                        trapr[0, :])
+                    trap_where(oob, I32(int(ErrCode.MemoryOutOfBounds)))
 
                 return lax.cond(
                     fits,
@@ -2246,13 +2299,12 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 def chunk(i, _):
                     base = a8(i * GR)
-                    cin = dma(6,
-                              mem_out.at[pl.ds(base, GR), pl.ds(lo, Lblk)],
+                    cin = dma(6, lsliceR(mem_out, base, GR),
                               mwin0.at[pl.ds(0, GR)])
                     cin.start()
                     cin.wait()
-                    rows = mwin0[pl.ds(0, GR), :]
-                    wi = base + jax.lax.broadcasted_iota(I32, (GR, Lblk), 0)
+                    rows = srows(mwin0, 0, GR)
+                    wi = base + riota(GR)
                     byte0 = wi * 4
                     mask = jnp.zeros_like(rows)
                     for bpos in range(4):
@@ -2261,10 +2313,10 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                         mask = mask | jnp.where(
                             inr, jnp.int32(lo_ops.BYTE_MASKS[bpos]), 0)
                     write = (mask != 0) & go
-                    mwin0[pl.ds(0, GR), :] = jnp.where(
-                        write, (rows & ~mask) | (fill_word & mask), rows)
+                    wrows(mwin0, 0, GR, jnp.where(
+                        write, (rows & ~mask) | (fill_word & mask), rows))
                     cout = dma(6, mwin0.at[pl.ds(0, GR)],
-                               mem_out.at[pl.ds(base, GR), pl.ds(lo, Lblk)])
+                               lsliceR(mem_out, base, GR))
                     cout.start()
                     cout.wait()
                     return 0
@@ -2274,9 +2326,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(any_oob)
                 def _():
-                    trapr[0, :] = jnp.where(
-                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
-                        trapr[0, :])
+                    trap_where(oob, I32(int(ErrCode.MemoryOutOfBounds)))
 
                 c = _keep_win(c, wfs)
                 return lax.cond(
@@ -2328,7 +2378,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                 feasible = agree & (one_win | disjoint | (nrows == 0))
 
                 def row_mask(r):
-                    mask = jnp.zeros((1, Lblk), I32)
+                    mask = full(0)
                     for bpos in range(4):
                         ba = full(r * 4 + bpos)
                         inr = (~u_lt(ba, dst)) & u_lt(ba, dend)
@@ -2399,9 +2449,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(feasible & any_oob)
                 def _():
-                    trapr[0, :] = jnp.where(
-                        oob[0], I32(int(ErrCode.MemoryOutOfBounds)),
-                        trapr[0, :])
+                    trap_where(oob, I32(int(ErrCode.MemoryOutOfBounds)))
 
                 c = _keep_win(c, wfsB)
                 return lax.cond(
@@ -2626,10 +2674,10 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                     @pl.when(k0 != 0)
                     def _():
-                        codes = jnp.where(dz[0],
+                        codes = jnp.where(dz,
                                           I32(int(ErrCode.DivideByZero)),
                                           I32(int(ErrCode.IntegerOverflow)))
-                        trapr[0, :] = jnp.where(bad[0], codes, trapr[0, :])
+                        trap_where(bad, codes)
 
                     return lax.cond(
                         k0 != 0,
@@ -2642,9 +2690,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(any_bad)
                 def _():
-                    codes = jnp.where(dz[0], I32(int(ErrCode.DivideByZero)),
+                    codes = jnp.where(dz, I32(int(ErrCode.DivideByZero)),
                                       I32(int(ErrCode.IntegerOverflow)))
-                    trapr[0, :] = jnp.where(bad[0], codes, trapr[0, :])
+                    trap_where(bad, codes)
 
                 return lax.cond(
                     any_bad,
@@ -2676,8 +2724,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                     @pl.when(k0 != 0)
                     def _():
-                        trapr[0, :] = jnp.where(bad[0], codes[0],
-                                                trapr[0, :])
+                        trap_where(bad, codes)
 
                     return lax.cond(
                         k0 != 0,
@@ -2688,7 +2735,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
                 @pl.when(any_bad)
                 def _():
-                    trapr[0, :] = jnp.where(bad[0], codes[0], trapr[0, :])
+                    trap_where(bad, codes)
 
                 return lax.cond(
                     any_bad,
@@ -3547,7 +3594,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
             @pl.when(due)
             def _():
-                flag[0] = jnp.any(canr[0, :] != 0).astype(jnp.int32)
+                flag[0] = jnp.any(srow(canr, 0) != 0).astype(jnp.int32)
 
             dirty = due & (flag[0] != 0)
             clean = due & ~dirty
@@ -3595,14 +3642,14 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             init = init + (I32(0),)  # ls: last-snapshot step count
             # entry state was validated at the previous exit: it IS the
             # first rollback point
-            canr[0, :] = jnp.zeros((Lblk,), I32)
+            wrow(canr, 0, full(0))
             do_snapshot(init)
         fin = lax.while_loop(cond, body, init)
         if optimistic:
             # exit validation: every path out of the loop (chunk/fuel
             # exhaustion, DONE, trap, park, diverge) must not publish
             # state built on an unvalidated lane-0 decision
-            flag[0] = jnp.any(canr[0, :] != 0).astype(jnp.int32)
+            flag[0] = jnp.any(srow(canr, 0) != 0).astype(jnp.int32)
             pdirty = flag[0] != 0
 
             @pl.when(pdirty)
@@ -3633,9 +3680,10 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
 
         @pl.when(exhausted)
         def _():
-            trapr[0, :] = jnp.where(trapr[0, :] == 0,
-                                    I32(int(ErrCode.CostLimitExceeded)),
-                                    trapr[0, :])
+            tr_ = srow(trapr, 0)
+            wrow(trapr, 0, jnp.where(tr_ == 0,
+                                     I32(int(ErrCode.CostLimitExceeded)),
+                                     tr_))
 
         # the disabled-fuel sentinel must not drift down across launches
         # (a >2^31-step run would spuriously exhaust it)
@@ -3651,16 +3699,16 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         ctrl_out[blk, _C_CHUNK] = chunk
         ctrl_out[blk, _C_STEPS] = steps
 
-        outs = [dma(0, slo, s_lo_out.at[:, pl.ds(lo, Lblk)]),
-                dma(1, shi, s_hi_out.at[:, pl.ds(lo, Lblk)]),
-                dma(2, glo, g_lo_out.at[:, pl.ds(lo, Lblk)]),
-                dma(3, ghi, g_hi_out.at[:, pl.ds(lo, Lblk)]),
-                dma(5, trapr, trap_out.at[:, pl.ds(lo, Lblk)])]
+        outs = [dma(0, slo, lslice(s_lo_out)),
+                dma(1, shi, lslice(s_hi_out)),
+                dma(2, glo, lslice(g_lo_out)),
+                dma(3, ghi, lslice(g_hi_out)),
+                dma(5, trapr, lslice(trap_out))]
         if not mem_hbm:
-            outs.append(dma(4, memr, mem_out.at[:, pl.ds(lo, Lblk)]))
+            outs.append(dma(4, memr, lslice(mem_out)))
         if simd:
-            outs += [dma(6, se2s, se2_out.at[:, pl.ds(lo, Lblk)]),
-                     dma(7, se3s, se3_out.at[:, pl.ds(lo, Lblk)])]
+            outs += [dma(6, se2s, lslice(se2_out)),
+                     dma(7, se3s, lslice(se3_out))]
         for c in outs:
             c.start()
         for c in outs:
@@ -3681,6 +3729,17 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
     SH_L = L if shadow_full else 1
     WSH = (W if (not mem_hbm and W > 1) else 1) if shadow_full else 1
     n_planes = 12 + (4 if simd else 0)  # aliased plane inputs/outputs
+
+    def vmem_rows(n):
+        """VMEM scratch holding n state rows in the active row layout."""
+        return pltpu.VMEM((n,) + ROW if three_d else (n, Lblk), jnp.int32)
+
+    def p3(shape):
+        """Out-shape for an HBM plane: striped 3-d iff it is a full
+        lane plane (shape[-1] == L) and the remap is active."""
+        if three_d and shape[-1] == L:
+            return (shape[0], L // Lpb, Lpb)
+        return shape
     spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=15,
         grid=(nblk,),
@@ -3692,20 +3751,20 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
              pl.BlockSpec(memory_space=pltpu.SMEM)]     # frames_out
             + [aspec()] * n_planes),
         scratch_shapes=(
-            [pltpu.VMEM((D, Lblk), jnp.int32),          # slo
-             pltpu.VMEM((D, Lblk), jnp.int32)]          # shi
-            + ([pltpu.VMEM((D, Lblk), jnp.int32),       # se2 (v128)
-                pltpu.VMEM((D, Lblk), jnp.int32)]       # se3 (v128)
+            [vmem_rows(D),                              # slo
+             vmem_rows(D)]                              # shi
+            + ([vmem_rows(D),                           # se2 (v128)
+                vmem_rows(D)]                           # se3 (v128)
                if simd else [])
-            + [pltpu.VMEM((NGp, Lblk), jnp.int32),      # glo
-               pltpu.VMEM((NGp, Lblk), jnp.int32)]      # ghi
-            + ([pltpu.VMEM((CW, Lblk), jnp.int32),      # mwin0 (way 0)
-                pltpu.VMEM((CW, Lblk), jnp.int32)]      # mwin1 (way 1)
+            + [vmem_rows(NGp),                          # glo
+               vmem_rows(NGp)]                          # ghi
+            + ([vmem_rows(CW),                          # mwin0 (way 0)
+                vmem_rows(CW)]                          # mwin1 (way 1)
                if mem_hbm else
-               [pltpu.VMEM((W, Lblk), jnp.int32)])      # memr (resident)
-            + [pltpu.VMEM((1, Lblk), jnp.int32),        # trapr
+               [vmem_rows(W)])                          # memr (resident)
+            + [vmem_rows(1),                            # trapr
                pltpu.SemaphoreType.DMA((8,))]           # sems
-            + ([pltpu.VMEM((1, Lblk), jnp.int32),       # canr (canary)
+            + ([vmem_rows(1),                           # canr (canary)
                 pltpu.SMEM((2,), jnp.int32),            # flag
                 pltpu.SMEM((3, CD), jnp.int32),         # snapf (frames)
                 pltpu.SMEM((16,), jnp.int32)]           # snapc (carry)
@@ -3715,25 +3774,25 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
     out_shape = [
         jax.ShapeDtypeStruct((nblk, 16), jnp.int32),    # ctrl
         jax.ShapeDtypeStruct((nblk, 3, CD), jnp.int32),  # frames
-        jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_lo
-        jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_hi
-        jax.ShapeDtypeStruct((NGp, L), jnp.int32),      # glob_lo
-        jax.ShapeDtypeStruct((NGp, L), jnp.int32),      # glob_hi
-        jax.ShapeDtypeStruct((W, L), jnp.int32),        # mem
-        jax.ShapeDtypeStruct((1, L), jnp.int32),        # trap
-        jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_slo
-        jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_shi
-        jax.ShapeDtypeStruct((SH_NG, SH_L), jnp.int32),  # sh_glo
-        jax.ShapeDtypeStruct((SH_NG, SH_L), jnp.int32),  # sh_ghi
-        jax.ShapeDtypeStruct((1, SH_L), jnp.int32),      # sh_trap
-        jax.ShapeDtypeStruct((WSH, SH_L), jnp.int32),    # sh_mem
+        jax.ShapeDtypeStruct(p3((D, L)), jnp.int32),    # stack_lo
+        jax.ShapeDtypeStruct(p3((D, L)), jnp.int32),    # stack_hi
+        jax.ShapeDtypeStruct(p3((NGp, L)), jnp.int32),  # glob_lo
+        jax.ShapeDtypeStruct(p3((NGp, L)), jnp.int32),  # glob_hi
+        jax.ShapeDtypeStruct(p3((W, L)), jnp.int32),    # mem
+        jax.ShapeDtypeStruct(p3((1, L)), jnp.int32),    # trap
+        jax.ShapeDtypeStruct(p3((SH_D, SH_L)), jnp.int32),   # sh_slo
+        jax.ShapeDtypeStruct(p3((SH_D, SH_L)), jnp.int32),   # sh_shi
+        jax.ShapeDtypeStruct(p3((SH_NG, SH_L)), jnp.int32),  # sh_glo
+        jax.ShapeDtypeStruct(p3((SH_NG, SH_L)), jnp.int32),  # sh_ghi
+        jax.ShapeDtypeStruct(p3((1, SH_L)), jnp.int32),      # sh_trap
+        jax.ShapeDtypeStruct(p3((WSH, SH_L)), jnp.int32),    # sh_mem
     ]
     if simd:
         out_shape += [
-            jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_e2
-            jax.ShapeDtypeStruct((D, L), jnp.int32),        # stack_e3
-            jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_se2
-            jax.ShapeDtypeStruct((SH_D, SH_L), jnp.int32),   # sh_se3
+            jax.ShapeDtypeStruct(p3((D, L)), jnp.int32),     # stack_e2
+            jax.ShapeDtypeStruct(p3((D, L)), jnp.int32),     # stack_e3
+            jax.ShapeDtypeStruct(p3((SH_D, SH_L)), jnp.int32),  # sh_se2
+            jax.ShapeDtypeStruct(p3((SH_D, SH_L)), jnp.int32),  # sh_se3
         ]
     # plane inputs (operands: 15 prefetch args, frames_in at 15, planes
     # from 16) alias the plane outputs (after ctrl/frames)
@@ -3747,7 +3806,25 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )
-    return jax.jit(fn, donate_argnums=tuple(
+    if not three_d:
+        return jax.jit(fn, donate_argnums=tuple(
+            range(16, 16 + n_planes)))
+
+    # The remap wrapper: the host/engine keep every plane [rows, L];
+    # stripe-reshape to [rows, L/Lpb, Lpb] around the pallas_call (a
+    # bitcast — XLA aliases it, so donation still runs in place).
+    def run(*args):
+        pre = args[:16]                    # 15 prefetch + frames_in
+        planes = args[16:]
+        p3s = [x.reshape(x.shape[0], -1, Lpb) if x.shape[-1] == L else x
+               for x in planes]
+        out = fn(*pre, *p3s)
+        res = [out[0], out[1]]
+        for x in out[2:]:
+            res.append(x.reshape(x.shape[0], -1) if x.ndim == 3 else x)
+        return tuple(res)
+
+    return jax.jit(run, donate_argnums=tuple(
         range(16, 16 + n_planes)))
 
 
