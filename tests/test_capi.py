@@ -240,6 +240,50 @@ def test_vm_batch_extension():
     assert (batch.results[0] == 55).all()
 
 
+def test_vm_batch_weighted_cost_table_gas():
+    """A non-uniform cost table set through the C API drives the batch
+    engine's fuel: the weighted kill fires where flat per-instruction
+    counting would not (reference: CostTab-weighted gas,
+    include/common/statistics.h:85-98)."""
+    from wasmedge_tpu.common.errors import ErrCode
+    from wasmedge_tpu.common.opcodes import NAME_TO_ID
+    from wasmedge_tpu.common.statistics import _NUM_COST_SLOTS
+
+    def make_vm(limit, table=None):
+        conf = C.we_ConfigureCreate()
+        C.we_ConfigureStatisticsSetCostMeasuring(conf, True)
+        vm = C.we_VMCreate(conf)
+        stat = C.we_VMGetStatisticsContext(vm)
+        C.we_StatisticsSetCostLimit(stat, limit)
+        if table is not None:
+            C.we_StatisticsSetCostTable(stat, table)
+        assert C.we_ResultOK(C.we_VMLoadWasmFromBuffer(vm, build_fib()))
+        assert C.we_ResultOK(C.we_VMValidate(vm))
+        assert C.we_ResultOK(C.we_VMInstantiate(vm))
+        return vm
+
+    # fib(15) retires ~10k instructions / ~1.2k i32.add ops.  A flat
+    # budget of 100k completes easily...
+    vm = make_vm(100_000)
+    res, ok = C.we_VMBatchExecute(vm, "fib", [np.full(4, 15, np.int64)],
+                                  lanes=4)
+    assert C.we_ResultOK(res) and (ok.trap == -1).all()
+    # ...but the same budget with i32.add weighted 1000x must kill every
+    # lane with the gas trap: ~1.2k adds * 1000 >> 100k
+    table = [1] * _NUM_COST_SLOTS
+    table[int(NAME_TO_ID["i32.add"])] = 1000
+    vm = make_vm(100_000, table)
+    res, killed = C.we_VMBatchExecute(vm, "fib",
+                                      [np.full(4, 15, np.int64)], lanes=4)
+    assert C.we_ResultOK(res)
+    assert (killed.trap == int(ErrCode.CostLimitExceeded)).all()
+    # a uniform-weight run under the same table geometry still completes
+    vm = make_vm(100_000, [1] * _NUM_COST_SLOTS)
+    res, ok2 = C.we_VMBatchExecute(vm, "fib", [np.full(4, 15, np.int64)],
+                                   lanes=4)
+    assert C.we_ResultOK(res) and (ok2.trap == -1).all()
+
+
 # ---------------------------------------------------------------------------
 # the spec corpus through the capi VM family (APIVMCoreTest model)
 # ---------------------------------------------------------------------------

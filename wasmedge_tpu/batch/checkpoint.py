@@ -41,6 +41,13 @@ def image_fingerprint(img) -> str:
         # images identical in code planes but differing here must not
         # share a fingerprint
         h.update(np.ascontiguousarray(img.v128).tobytes())
+    # r05 segment snapshots feed table.init / memory.init — executable
+    # content like v128 constants (absent on pre-r05 images)
+    for name in ("elem_flat", "elem_off", "elem_len",
+                 "data_words", "data_off", "data_len"):
+        arr = getattr(img, name, None)
+        if arr is not None:
+            h.update(np.ascontiguousarray(arr).tobytes())
     return h.hexdigest()
 
 
@@ -115,6 +122,14 @@ def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:
                     "checkpoint refused: geometry mismatch — engine image "
                     f"has v128 but checkpoint lacks planes {missing} "
                     "(pre-SIMD checkpoint resumed against a SIMD image?)")
+        from wasmedge_tpu.batch.engine import r05_plane_names
+
+        missing = [n for n in r05_plane_names(engine.img)
+                   if fields.get(n) is None]
+        if missing:
+            raise ValueError(
+                "checkpoint refused: engine image uses table/segment "
+                f"families but checkpoint lacks planes {missing}")
         _validate_planes(fields, engine)
     return BatchState(**fields), meta["total_steps"]
 

@@ -71,6 +71,19 @@ from wasmedge_tpu.batch.image import (
     CLS_SELECT,
     CLS_STORE,
     CLS_TRAP,
+    CLS_TABLE_GET,
+    CLS_TABLE_SET,
+    CLS_TABLE_SIZE,
+    CLS_TABLE_GROW,
+    CLS_TABLE_FILL,
+    CLS_TABLE_COPY,
+    CLS_TABLE_INIT,
+    CLS_ELEM_DROP,
+    CLS_MEMINIT,
+    CLS_DATA_DROP,
+    CLS_RETCALL,
+    CLS_RETCALL_INDIRECT,
+    CLS_REFFUNC,
     NUM_CLASSES,
     TRAP_DONE,
     _F64_BIN,
@@ -105,6 +118,11 @@ class BatchState(NamedTuple):
     # for modules whose image uses SIMD (img.has_simd); None otherwise
     stack_e2: object = None
     stack_e3: object = None
+    # r05 optional planes (same None-when-unused discipline):
+    tab: object = None     # [table_cap, lanes] per-lane mutable table
+    tsize: object = None   # [lanes] per-lane table size (table.grow)
+    edrop: object = None   # [n_elem_segs, lanes] dropped flags
+    ddrop: object = None   # [n_data_segs, lanes] dropped flags
 
 
 @dataclasses.dataclass
@@ -121,6 +139,42 @@ class BatchResult:
     def completed(self) -> np.ndarray:
         """Mask of lanes that finished normally (results valid)."""
         return self.trap == TRAP_DONE
+
+
+def r05_plane_names(img: DeviceImage) -> tuple:
+    """Names of the r05 planes this image requires (no allocation —
+    checkpoint's missing-plane guard needs only the keys)."""
+    out = []
+    if getattr(img, "has_table_mut", False):
+        out += ["tab", "tsize"]
+    if bool(np.isin(img.cls, (CLS_TABLE_INIT, CLS_ELEM_DROP)).any()):
+        out.append("edrop")
+    if bool(np.isin(img.cls, (CLS_MEMINIT, CLS_DATA_DROP)).any()):
+        out.append("ddrop")
+    return tuple(out)
+
+
+def r05_state_planes(img: DeviceImage, lanes: int) -> dict:
+    """Initial tab/tsize/edrop/ddrop planes for the r05 table/segment
+    families — shared by every BatchState constructor (engine, uniform
+    handoff, multitenant, scheduler).  Returns {} (BatchState None
+    defaults) when the image uses none of them."""
+    import jax.numpy as jnp
+
+    out = {}
+    if getattr(img, "has_table_mut", False):
+        T = max(int(img.table_cap or img.table0.shape[0]), 1)
+        tb = np.zeros((T, lanes), np.int32)
+        n0 = min(img.table0.shape[0], T)
+        tb[:n0] = img.table0[:n0, None]
+        out["tab"] = jnp.asarray(tb)
+        out["tsize"] = jnp.full((lanes,), img.table_size_init, jnp.int32)
+    cls = img.cls
+    if bool(np.isin(cls, (CLS_TABLE_INIT, CLS_ELEM_DROP)).any()):
+        out["edrop"] = jnp.zeros((img.elem_len.shape[0], lanes), jnp.int32)
+    if bool(np.isin(cls, (CLS_MEMINIT, CLS_DATA_DROP)).any()):
+        out["ddrop"] = jnp.zeros((img.data_len.shape[0], lanes), jnp.int32)
+    return out
 
 
 def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
@@ -151,6 +205,18 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
     f_type = jnp.asarray(img.f_type)
     table0 = jnp.asarray(img.table0)
     fuel_enabled = cfg.fuel_per_launch is not None
+    # per-opcode gas weights: gather the Statistics cost table through
+    # the image's original-opcode plane (flat 1/instr when no table —
+    # the reference's CostTab default, statistics.h:85-98)
+    weighted_gas = (
+        fuel_enabled and cfg.cost_table is not None
+        and getattr(img, "op_id", None) is not None
+        and any(c != 1 for c in cfg.cost_table))
+    if weighted_gas:
+        _ct = np.clip(np.asarray(cfg.cost_table, np.int64),
+                      0, 1 << 30).astype(np.int32)
+        cost_t = jnp.asarray(
+            _ct[np.clip(img.op_id, 0, len(_ct) - 1)])
     HAS_SIMD = bool(getattr(img, "has_simd", False))
     if HAS_SIMD:
         from wasmedge_tpu.batch import simdops as sops
@@ -194,6 +260,25 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
 
     b2i = lo_ops.b2i
     u_lt = lo_ops.u_lt
+    # r05 families: static presence flags gate what gets traced
+    HAS_T_ANY = bool(np.isin(img.cls, (
+        CLS_TABLE_GET, CLS_TABLE_SET, CLS_TABLE_SIZE, CLS_TABLE_GROW,
+        CLS_TABLE_FILL, CLS_TABLE_COPY, CLS_TABLE_INIT)).any())
+    HAS_T_MUT = bool(img.has_table_mut)
+    HAS_ESEG = bool(np.isin(img.cls, (CLS_TABLE_INIT, CLS_ELEM_DROP)).any())
+    HAS_DSEG = bool(np.isin(img.cls, (CLS_MEMINIT, CLS_DATA_DROP)).any())
+    HAS_TAIL = bool(np.isin(img.cls, (CLS_RETCALL,
+                                      CLS_RETCALL_INDIRECT)).any())
+    T_CAP = max(int(img.table_cap or img.table0.shape[0]), 1)
+    MAX_NPAR = int(img.f_nparams.max()) if HAS_TAIL else 0
+    if HAS_ESEG:
+        elem_flat_t = jnp.asarray(img.elem_flat)
+        elem_off_t = jnp.asarray(img.elem_off)
+        elem_len_t = jnp.asarray(img.elem_len)
+    if HAS_DSEG:
+        data_words_t = jnp.asarray(img.data_words)
+        data_off_t = jnp.asarray(img.data_off)
+        data_len_t = jnp.asarray(img.data_len)
     used_alu2 = {int(sv) for sv, cv in zip(img.sub, img.cls)
                  if cv == CLS_ALU2}
     used_alu1 = {int(sv) for sv, cv in zip(img.sub, img.cls)
@@ -722,6 +807,159 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         new_mem_pages = jnp.where(active & is_grow & grow_ok,
                                   st.mem_pages + grow_delta, st.mem_pages)
 
+        # ========== memory.init / data.drop (r05) ==========
+        ddrop_p = st.ddrop
+        if HAS_DSEG:
+            is_minit = is_cls[CLS_MEMINIT]
+            is_ddrop = is_cls[CLS_DATA_DROP]
+            didx = jnp.clip(a, 0, data_len_t.shape[0] - 1)
+            ddropped = gat(st.ddrop, didx)
+            dseg_len = jnp.where(ddropped != 0, 0, data_len_t[didx])
+            dseg_off = data_off_t[didx]
+            mi_n, mi_src, mi_dst = v0_lo, v1_lo, v2_lo
+            mi_send = mi_src + mi_n
+            mi_dend = mi_dst + mi_n
+            mi_oob = is_minit & active & (
+                u_lt(mi_send, mi_src) | u_lt(dseg_len, mi_send)
+                | u_lt(mi_dend, mi_dst) | u_lt(mem_bytes, mi_dend))
+            mi_go = active & is_minit & ~mi_oob & (mi_n != 0)
+
+            def run_minit(mem_in):
+                rows = jnp.arange(mem_in.shape[0], dtype=I32)[:, None]
+                out = mem_in
+                # src byte index for dst byte addr ba: seg_off+src+(ba-dst)
+                base_sb = dseg_off + mi_src - mi_dst
+                nW = data_words_t.shape[0]
+                for bpos in range(4):
+                    ba = rows * 4 + bpos
+                    inr = (ba >= mi_dst) & (ba < mi_dend) & mi_go
+                    sbi = ba + base_sb
+                    w = data_words_t[jnp.clip(
+                        lax.shift_right_logical(sbi, 2), 0, nW - 1)]
+                    byte = lax.shift_right_logical(w, (sbi & 3) * 8) & 0xFF
+                    mk = np.int32(np.uint32(0xFF << (bpos * 8)))
+                    val = lax.shift_left(byte, bpos * 8)
+                    out = jnp.where(inr, (out & ~mk) | (val & mk), out)
+                return out
+
+            mem_plane = lax.cond(jnp.any(mi_go), run_minit,
+                                 lambda m: m, mem_plane)
+            ddrop_p = scat(st.ddrop, didx, jnp.ones_like(didx),
+                           active & is_ddrop)
+        else:
+            is_minit = jnp.bool_(False) & (cls == cls)
+            mi_oob = is_minit
+
+        # ========== table families (r05): per-lane table plane ==========
+        # The reference's tableInstr.cpp handlers over a shared
+        # TableInstance become masked ops over a [T_CAP, lanes] plane —
+        # functional arrays make copy/init overlap-safe for free (gather
+        # from the pre-op plane, then select).
+        tab_p, tsize_p, edrop_p = st.tab, st.tsize, st.edrop
+        table_trap = jnp.zeros_like(trap)
+        if HAS_T_ANY:
+            is_tget = is_cls[CLS_TABLE_GET]
+            is_tset = is_cls[CLS_TABLE_SET]
+            is_tgrow = is_cls[CLS_TABLE_GROW]
+            is_tfill = is_cls[CLS_TABLE_FILL]
+            is_tcopy = is_cls[CLS_TABLE_COPY]
+            is_tinit = is_cls[CLS_TABLE_INIT]
+            tbase = c
+            tsize_l = st.tsize if st.tsize is not None else b
+            tg_oob = is_tget & ~u_lt(v0_lo, tsize_l)
+            if HAS_T_MUT:
+                tget_val = gat(st.tab, tbase + v0_lo)
+            else:
+                tget_val = table0[jnp.clip(tbase + v0_lo, 0,
+                                           table0.shape[0] - 1)]
+            ts_oob = is_tset & ~u_lt(v1_lo, tsize_l)
+            # grow: ... init delta -> v0 = delta, v1 = init ref.  The
+            # instruction's b carries this table's CAPACITY (engine
+            # rewrites it after clamping; per-tenant slot size in a
+            # concatenated multi-tenant image) — growth past it returns
+            # -1, the spec-legal failure mode.
+            tgrow_new = tsize_l + v0_lo
+            tgrow_ok = is_tgrow & (v0_lo >= 0) & (tgrow_new >= tsize_l) \
+                & ~u_lt(b, tgrow_new)
+            tgrow_res = jnp.where(tgrow_ok, tsize_l, jnp.int32(-1))
+            # fill: ... i val n -> v0 = n, v1 = val, v2 = i
+            tf_end = v2_lo + v0_lo
+            tf_oob = is_tfill & (u_lt(tf_end, v2_lo)
+                                 | u_lt(tsize_l, tf_end))
+            # copy: ... dst src n -> v0 = n, v1 = src, v2 = dst
+            tc_send = v1_lo + v0_lo
+            tc_dend = v2_lo + v0_lo
+            tc_oob = is_tcopy & (
+                u_lt(tc_send, v1_lo) | u_lt(tsize_l, tc_send)
+                | u_lt(tc_dend, v2_lo) | u_lt(tsize_l, tc_dend))
+            # init: ... dst src n; a = elem segment (len 0 once dropped)
+            if HAS_ESEG:
+                eidx = jnp.clip(a, 0, elem_len_t.shape[0] - 1)
+                edropped = gat(st.edrop, eidx) if st.edrop is not None \
+                    else jnp.zeros_like(a)
+                eseg_len = jnp.where(edropped != 0, 0, elem_len_t[eidx])
+                eseg_off = elem_off_t[eidx]
+                ti_send2 = v1_lo + v0_lo
+                ti_dend2 = v2_lo + v0_lo
+                tinit_oob = is_tinit & (
+                    u_lt(ti_send2, v1_lo) | u_lt(eseg_len, ti_send2)
+                    | u_lt(ti_dend2, v2_lo) | u_lt(tsize_l, ti_dend2))
+            else:
+                tinit_oob = is_tinit  # unreachable (no segments)
+            t_oob = active & (tg_oob | ts_oob | tf_oob | tc_oob | tinit_oob)
+            table_trap = jnp.where(
+                t_oob, jnp.int32(int(ErrCode.TableOutOfBounds)), table_trap)
+            if HAS_T_MUT:
+                tab_p = scat(st.tab, tbase + v1_lo, v0_lo,
+                             active & is_tset & ~ts_oob)
+                m_grow = active & is_tgrow & tgrow_ok & (v0_lo > 0)
+                m_fill = active & is_tfill & ~tf_oob & (v0_lo != 0)
+                m_copy = active & is_tcopy & ~tc_oob & (v0_lo != 0)
+                m_init = active & is_tinit & ~tinit_oob & (v0_lo != 0) \
+                    if HAS_ESEG else jnp.bool_(False) & (cls == cls)
+                ranged_go = m_grow | m_fill | m_copy | m_init
+
+                def run_trange(tp):
+                    rows = jnp.arange(T_CAP, dtype=I32)[:, None]
+                    cur = tp
+                    # constant fill: grow writes init (v1) into the new
+                    # rows, table.fill writes val (v1) into [i, i+n)
+                    lo_f = tbase + jnp.where(m_grow, tsize_l, v2_lo)
+                    hi_f = tbase + jnp.where(m_grow, tgrow_new, tf_end)
+                    inr = (rows >= lo_f) & (rows < hi_f) & (m_grow | m_fill)
+                    cur = jnp.where(inr, v1_lo, cur)
+                    if bool((img.cls == CLS_TABLE_COPY).any()):
+                        srows = jnp.clip(rows - v2_lo + v1_lo, 0, T_CAP - 1)
+                        svals = jnp.take_along_axis(
+                            tp, jnp.broadcast_to(srows, tp.shape), axis=0)
+                        inc = (rows >= tbase + v2_lo) \
+                            & (rows < tbase + tc_dend) & m_copy
+                        cur = jnp.where(inc, svals, cur)
+                    if HAS_ESEG and bool((img.cls == CLS_TABLE_INIT).any()):
+                        sidx = jnp.clip(
+                            eseg_off + v1_lo + (rows - (tbase + v2_lo)),
+                            0, elem_flat_t.shape[0] - 1)
+                        ivals = elem_flat_t[sidx]
+                        ini = (rows >= tbase + v2_lo) \
+                            & (rows < tbase + ti_dend2) & m_init
+                        cur = jnp.where(ini, ivals, cur)
+                    return cur
+
+                tab_p = lax.cond(jnp.any(ranged_go), run_trange,
+                                 lambda t: t, tab_p)
+                if st.tsize is not None:
+                    tsize_p = jnp.where(active & is_tgrow & tgrow_ok,
+                                        tgrow_new, st.tsize)
+            if HAS_ESEG and st.edrop is not None:
+                is_edrop = is_cls[CLS_ELEM_DROP]
+                edrop_p = scat(st.edrop, eidx, jnp.ones_like(eidx),
+                               active & is_edrop)
+        else:
+            is_tget = is_tgrow = jnp.bool_(False) & (cls == cls)
+            tget_val = zl
+            tgrow_res = zl
+            tsize_l = b
+
         # =================== branches ===================
         is_br = is_cls[CLS_BR]
         is_brz = is_cls[CLS_BRZ]
@@ -738,29 +976,41 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         # =================== call / return ===================
         is_call = is_cls[CLS_CALL]
         is_calli = is_cls[CLS_CALL_INDIRECT]
-        is_callany = is_call | is_calli
+        if HAS_TAIL:
+            # return_call(_indirect): frame REPLACEMENT — the reference's
+            # StackManager tail-call path (include/runtime/stackmgr.h:80-98)
+            is_rcall = is_cls[CLS_RETCALL]
+            is_rcalli = is_cls[CLS_RETCALL_INDIRECT]
+        else:
+            is_rcall = is_rcalli = jnp.bool_(False) & (cls == cls)
+        is_tail = is_rcall | is_rcalli
+        is_icall = is_calli | is_rcalli
+        is_callany = is_call | is_calli | is_tail
         # per-instruction table window: b = size, c = base (multi-tenant
-        # concatenated tables)
-        ti = c + jnp.clip(v0_lo, 0, jnp.maximum(b - 1, 0))
-        ti = jnp.clip(ti, 0, table0.shape[0] - 1)
-        t_h = table0[ti]
+        # concatenated tables); per-lane tsize plane wins when present
+        # (table.grow can have changed it)
+        calli_size = st.tsize if (HAS_T_MUT and st.tsize is not None) else b
+        ti = c + jnp.clip(v0_lo, 0, jnp.maximum(calli_size - 1, 0))
+        ti = jnp.clip(ti, 0, T_CAP - 1 if HAS_T_MUT else table0.shape[0] - 1)
+        t_h = gat(st.tab, ti) if HAS_T_MUT else table0[ti]
         # unsigned idx < size (never size-1 arithmetic: b == 0 — an empty
         # table — must always be UndefinedElement, not an underflow)
-        ti_oob = is_calli & ~u_lt(v0_lo, b)
-        ti_null = is_calli & ~ti_oob & (t_h == 0)
-        callee = jnp.where(is_calli, jnp.clip(t_h - 1, 0, f_entry.shape[0] - 1),
+        ti_oob = is_icall & ~u_lt(v0_lo, calli_size)
+        ti_null = is_icall & ~ti_oob & (t_h == 0)
+        callee = jnp.where(is_icall, jnp.clip(t_h - 1, 0, f_entry.shape[0] - 1),
                            jnp.clip(a, 0, f_entry.shape[0] - 1))
-        sig_bad = is_calli & ~ti_oob & ~ti_null & (f_type[callee] != a)
+        sig_bad = is_icall & ~ti_oob & ~ti_null & (f_type[callee] != a)
         c_entry = f_entry[callee]
         c_nparams = f_nparams[callee]
         c_nlocals = f_nlocals[callee]
         c_frame_top = f_frame_top[callee]
-        sp_eff = jnp.where(is_calli, sp - 1, sp)
-        fp_new = sp_eff - c_nparams
+        sp_eff = jnp.where(is_icall, sp - 1, sp)
+        # tail calls reuse the caller's frame slot: fp stays, args slide
+        fp_new = jnp.where(is_tail, fp, sp_eff - c_nparams)
         opbase_new = fp_new + c_nlocals
         # CD-1, not CD: the scalar engine's entry sentinel frame counts
         # toward max_call_depth, so nesting capacity is depth-1 calls
-        depth_ovf = is_callany & (st.call_depth >= CD - 1)
+        depth_ovf = (is_call | is_calli) & (st.call_depth >= CD - 1)
         stack_ovf = is_callany & (fp_new + c_frame_top > D)
         call_trap = jnp.where(ti_oob, int(ErrCode.UndefinedElement), 0)
         call_trap = jnp.where(ti_null, int(ErrCode.UninitializedElement), call_trap)
@@ -768,11 +1018,14 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         call_trap = jnp.where(depth_ovf, int(ErrCode.CallStackExhausted), call_trap)
         call_trap = jnp.where(stack_ovf, int(ErrCode.StackOverflow), call_trap)
         call_ok = active & is_callany & (call_trap == 0)
+        tail_ok = call_ok & is_tail
 
-        # frame push
-        fr_ret_pc = scat(st.fr_ret_pc, st.call_depth, pc + 1, call_ok)
-        fr_fp = scat(st.fr_fp, st.call_depth, fp, call_ok)
-        fr_opbase = scat(st.fr_opbase, st.call_depth, opbase, call_ok)
+        # frame push (tail calls don't push — they replace)
+        fr_ret_pc = scat(st.fr_ret_pc, st.call_depth, pc + 1,
+                         call_ok & ~is_tail)
+        fr_fp = scat(st.fr_fp, st.call_depth, fp, call_ok & ~is_tail)
+        fr_opbase = scat(st.fr_opbase, st.call_depth, opbase,
+                         call_ok & ~is_tail)
 
         # return
         is_ret = is_cls[CLS_RETURN]
@@ -826,6 +1079,12 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             (is_vtest, sp - 1, vtest_res, zl),
             (is_vbitsel, sp - 3, *vbit_res),
             (is_vload & ~v_oob, sp - 1, *vload_res),
+            (is_tget & (table_trap == 0), sp - 1, tget_val,
+             jnp.zeros_like(tget_val)),
+            (is_cls[CLS_REFFUNC], sp, a + 1, jnp.zeros_like(a)),
+            (is_cls[CLS_TABLE_SIZE], sp, tsize_l, jnp.zeros_like(tsize_l)),
+            (is_tgrow & (table_trap == 0), sp - 2, tgrow_res,
+             jnp.zeros_like(tgrow_res)),
         ):
             m, pos, lo_v, hi_v = entry[0], entry[1], entry[2], entry[3]
             e2_v = entry[4] if len(entry) > 4 else zl
@@ -855,6 +1114,21 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             stack_e2 = scat(stack_e2, fp + a, v0_e2, lmask)
             stack_e3 = scat(stack_e3, fp + a, v0_e3, lmask)
 
+        # tail-call arg slide: [sp_eff - nparams, sp_eff) -> [fp, fp+nparams)
+        # (ascending copy is overlap-safe: src row >= dst row always,
+        # because src base sp_eff - nparams >= opbase >= fp)
+        if HAS_TAIL:
+            for k in range(MAX_NPAR):
+                amask = tail_ok & (k < c_nparams)
+                srcp = sp_eff - c_nparams + k
+                stack_lo = scat(stack_lo, fp + k, gat(stack_lo, srcp), amask)
+                stack_hi = scat(stack_hi, fp + k, gat(stack_hi, srcp), amask)
+                if HAS_SIMD:
+                    stack_e2 = scat(stack_e2, fp + k, gat(stack_e2, srcp),
+                                    amask)
+                    stack_e3 = scat(stack_e3, fp + k, gat(stack_e3, srcp),
+                                    amask)
+
         # zero callee locals beyond params (static unrolled window)
         for k in range(img.max_local_zeros):
             zpos = fp_new + c_nparams + k
@@ -881,12 +1155,15 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         # =================== merge: sp / pc / frames ===================
         new_sp = sp
         for m, v in (
-            (is_const | is_lget | is_gget | is_msize | is_vconst, sp + 1),
+            (is_const | is_lget | is_gget | is_msize | is_vconst
+             | is_cls[CLS_TABLE_SIZE] | is_cls[CLS_REFFUNC], sp + 1),
             (is_cls[CLS_DROP] | is_lset | is_gset | is_alu2 | is_brz
              | (is_brnz & cond_zero) | is_v2 | is_vshift | is_vshuffle
-             | is_vreplace, sp - 1),
-            (is_cls[CLS_STORE] | is_sel | is_vstore | is_vbitsel, sp - 2),
-            (is_bulk, sp - 3),
+             | is_vreplace | is_tgrow, sp - 1),
+            (is_cls[CLS_STORE] | is_sel | is_vstore | is_vbitsel
+             | is_cls[CLS_TABLE_SET], sp - 2),
+            (is_bulk | is_cls[CLS_TABLE_FILL] | is_cls[CLS_TABLE_COPY]
+             | is_cls[CLS_TABLE_INIT] | is_minit, sp - 3),
             (is_br, opbase + c + b),
             (brnz_taken, opbase + c + b),
             (is_brt, opbase + bt_pop + bt_keep),
@@ -907,7 +1184,7 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         new_fp = jnp.where(is_ret & ~ret_done, r_fp, new_fp)
         new_opbase = jnp.where(call_ok, opbase_new, opbase)
         new_opbase = jnp.where(is_ret & ~ret_done, r_opbase, new_opbase)
-        new_depth = st.call_depth + jnp.where(call_ok, 1, 0) \
+        new_depth = st.call_depth + jnp.where(call_ok & ~is_tail, 1, 0) \
             - jnp.where(active & is_ret & ~ret_done, 1, 0)
 
         # =================== traps / fuel / retire ===================
@@ -923,6 +1200,8 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             ((is_vload | is_vstore) & v_oob,
              jnp.int32(int(ErrCode.MemoryOutOfBounds))),
             (bulk_oob, jnp.int32(int(ErrCode.MemoryOutOfBounds))),
+            (mi_oob, jnp.int32(int(ErrCode.MemoryOutOfBounds))),
+            (table_trap != 0, table_trap),
             (is_callany & (call_trap != 0), call_trap),
             (ret_done, jnp.int32(TRAP_DONE)),
         ):
@@ -930,7 +1209,9 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
 
         new_retired = st.retired + b2i(active)
         if fuel_enabled:
-            new_fuel = st.fuel - b2i(active)
+            dec = jnp.where(active, cost_t[pc], 0) if weighted_gas \
+                else b2i(active)
+            new_fuel = st.fuel - dec
             new_trap = jnp.where(active & (new_fuel <= 0) & (new_trap == 0),
                                  int(ErrCode.CostLimitExceeded), new_trap)
         else:
@@ -960,6 +1241,10 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             mem=mem_plane,
             stack_e2=stack_e2,
             stack_e3=stack_e3,
+            tab=tab_p,
+            tsize=tsize_p,
+            edrop=edrop_p,
+            ddrop=ddrop_p,
         )
 
     return step
@@ -999,7 +1284,23 @@ class BatchEngine:
             raise ValueError(f"module not batchable: {reason}")
         self.img = build_device_image(
             inst.lowered, memories=inst.memories, globals_=inst.globals,
-            table0=self._table_snapshot(inst, store), mod=inst.ast)
+            table0=self._table_snapshot(inst, store), mod=inst.ast,
+            elem_segs=self._elem_snapshot(inst, store),
+            data_segs=[bytes(d.data) for d in inst.datas])
+        # Per-lane table capacity for table.grow, mirroring the memory
+        # knob clamp below: declared max wins, clamped by the Configure
+        # knob; grow beyond capacity returns -1 (spec-legal failure).
+        tsize0 = self.img.table0.shape[0]
+        if self.img.has_table_grow:
+            declared = self.img.table_max if self.img.table_max > 0 \
+                else cfg.table_elems_per_lane
+            self.img.table_cap = max(
+                tsize0, min(declared, cfg.table_elems_per_lane))
+            # table.grow checks capacity from its instruction word (b):
+            # per-table in a concatenated multi-tenant image
+            self.img.b[self.img.cls == CLS_TABLE_GROW] = self.img.table_cap
+        else:
+            self.img.table_cap = tsize0
         # Static per-lane memory ceiling: the declared max clamped by the
         # Configure knob (scalar analog: MemoryInstance.grow page_limit).
         # A module with no declared max (mem_pages_max == 0) gets the knob
@@ -1046,6 +1347,40 @@ class BatchEngine:
                                  "module not batchable")
             refs.append(idx + 1)
         return refs
+
+    @staticmethod
+    def _elem_snapshot(inst, store):
+        """Element segments resolved into the device funcref domain
+        (funcidx+1, 0 = null) for in-kernel table.init.  A segment
+        holding a cross-module ref only blocks modules that can reach it
+        (table.init); others keep batching with that segment omitted."""
+        from wasmedge_tpu.common.opcodes import Op
+
+        func_index = {id(f): i for i, f in enumerate(inst.funcs)}
+        segs = []
+        ops = np.asarray(inst.lowered.op[:inst.lowered.code_len])
+        needs = bool((ops == int(Op.table_init)).any())
+        for seg in inst.elems:
+            refs = []
+            bad = False
+            for h in seg.refs:
+                if h == 0:
+                    refs.append(0)
+                    continue
+                fi = store.deref_func(h) if store is not None else None
+                idx = func_index.get(id(fi)) if fi is not None else None
+                if idx is None:
+                    bad = True
+                    break
+                refs.append(idx + 1)
+            if bad:
+                if needs:
+                    raise ValueError(
+                        "element segment references a non-local function; "
+                        "module not batchable")
+                refs = []
+            segs.append(refs)
+        return segs
 
     # -- execution ---------------------------------------------------------
     def _build(self):
@@ -1130,6 +1465,7 @@ class BatchEngine:
             mem=jnp.asarray(mem),
             stack_e2=jnp.zeros((D, L), jnp.int32) if img.has_simd else None,
             stack_e3=jnp.zeros((D, L), jnp.int32) if img.has_simd else None,
+            **r05_state_planes(img, L),
         )
 
     def run(self, func_name: str, args_lanes: List[np.ndarray],

@@ -67,7 +67,29 @@ CLS_VSHUFFLE = 34  # a = v128 table idx (16-byte mask): pop2 push1
 CLS_VBITSEL = 35   # pop3 push1
 CLS_VLOAD = 36     # a = offset: pop addr push v128
 CLS_VSTORE = 37    # a = offset: pop (addr, v128)
-NUM_CLASSES = 38
+# table / bulk-segment / tail-call families (r05).  The reference runs
+# all of these inside its one dispatch loop
+# (/root/reference/lib/executor/engine/engine.cpp:181-205 +
+# lib/executor/engine/tableInstr.cpp); here they are SIMT handlers over
+# a per-lane table plane and per-lane segment-dropped flags.  Device
+# funcref domain: funcidx+1, 0 = null (same as table0).  c carries the
+# lane's table base inside a concatenated multi-tenant plane, b the
+# static table size (per-lane tsize plane overrides when table.grow is
+# present).
+CLS_TABLE_GET = 38   # pop idx, push ref
+CLS_TABLE_SET = 39   # pop (idx, ref)
+CLS_TABLE_SIZE = 40  # push size
+CLS_TABLE_GROW = 41  # pop (init, delta), push old size | -1
+CLS_TABLE_FILL = 42  # pop (i, ref, n)
+CLS_TABLE_COPY = 43  # pop (dst, src, n)
+CLS_TABLE_INIT = 44  # a = elem seg idx; pop (dst, src, n)
+CLS_ELEM_DROP = 45   # a = elem seg idx
+CLS_MEMINIT = 46     # a = data seg idx; pop (dst, src, n)
+CLS_DATA_DROP = 47   # a = data seg idx
+CLS_RETCALL = 48     # a = callee (tail call: frame replacement)
+CLS_RETCALL_INDIRECT = 49  # a = dense type id, b = size, c = base
+CLS_REFFUNC = 50     # a = funcidx: push device handle a+1 (rebasable)
+NUM_CLASSES = 51
 
 # -- ALU2 sub-ops (binary: pop2 push1) --------------------------------------
 _I32_BIN = ["add", "sub", "mul", "div_s", "div_u", "rem_s", "rem_u", "and",
@@ -142,13 +164,11 @@ _STORES = {
 # families and the widening/narrowing extensions still gate out.
 _UNSUPPORTED_PREFIXES = ("v128.", "i8x16.", "i16x8.", "i32x4.",
                          "i64x2.", "f32x4.", "f64x2.")
-_UNSUPPORTED_NAMES = {
-    "table.get", "table.set", "table.size", "table.grow", "table.fill",
-    "table.copy", "table.init", "elem.drop",
-    "memory.init", "data.drop",
-    "ref.func",
-    "return_call", "return_call_indirect",
-}
+
+# Table ops address only table 0 on the batch engines (the reference's
+# multi-table support exists, but multi-table modules fall back).
+_TABLE0_OPS = {"table.get", "table.set", "table.size", "table.grow",
+               "table.fill"}
 
 TRAP_DONE = -1  # lane finished normally (trap plane sentinel)
 TRAP_HOSTCALL = -2  # lane waiting on a host outcall
@@ -196,8 +216,15 @@ def batchability(image: LoweredModule,
 
             if name not in SUPPORTED_V128:
                 return f"unsupported op {name}"
-        if name in _UNSUPPORTED_NAMES:
-            return f"unsupported op {name}"
+        if name in _TABLE0_OPS and image.a[pc] != 0:
+            return f"{name} on table != 0"
+        if name == "table.copy" and (image.a[pc] != 0 or image.b[pc] != 0):
+            return "table.copy on table != 0"
+        if name == "table.init" and image.b[pc] != 0:
+            return "table.init on table != 0"
+        if name in ("call_indirect", "return_call_indirect") \
+                and image.b[pc] != 0:
+            return f"{name} on table != 0"
     return None
 
 
@@ -234,10 +261,28 @@ class DeviceImage:
     # v128 constant/shuffle-mask table as 4 int32 planes [n, 4]
     v128: np.ndarray = None
     has_simd: bool = False
+    # passive/active segment snapshots for table.init / memory.init
+    # (funcref domain funcidx+1; data packed little-endian into words)
+    elem_flat: np.ndarray = None   # [sum lens] int32
+    elem_off: np.ndarray = None    # [nseg] int32
+    elem_len: np.ndarray = None    # [nseg] int32
+    data_words: np.ndarray = None  # [ceil(bytes/4)] int32
+    data_off: np.ndarray = None    # [ndseg] byte offsets
+    data_len: np.ndarray = None    # [ndseg] byte lengths
+    # original opcode id per pc (Statistics cost-table domain; stubs
+    # and padding carry nop) — the per-opcode gas weights gather through
+    # this plane (reference CostTab: include/common/statistics.h:85-98)
+    op_id: np.ndarray = None
+    table_max: int = 0             # declared table0 max (0 = none)
+    table_cap: int = 0             # per-lane table plane rows (engine clamps)
+    table_size_init: int = 0       # true initial size (table0 is pad>=1)
+    has_table_mut: bool = False    # any set/grow/fill/copy/init
+    has_table_grow: bool = False
 
 
 def build_device_image(image: LoweredModule, memories=None, globals_=None,
-                       table0=None, mod=None) -> DeviceImage:
+                       table0=None, mod=None, elem_segs=None,
+                       data_segs=None) -> DeviceImage:
     # Imported (host) functions get a 2-instruction synthetic stub after
     # the module code: HOSTCALL (parks the lane; the host writes results
     # at the frame's operand base and re-arms at the next pc) followed by
@@ -306,6 +351,10 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
     stores = {NAME_TO_ID[nm]: v for nm, v in _STORES.items()}
     consts = {Op.i32_const, Op.i64_const, Op.f32_const, Op.f64_const}
     op_return = NAME_TO_ID["return"]
+
+    op_id = np.full(n, int(Op.nop), np.int32)
+    op_id[:image.code_len] = np.asarray(
+        image.op[:image.code_len], np.int32)
 
     stub_pc = {}
     for si, k in enumerate(imports):
@@ -402,6 +451,37 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
             cls[pc] = CLS_MEMFILL
         elif op == Op.memory_copy:
             cls[pc] = CLS_MEMCOPY
+        elif op == Op.table_get:
+            cls[pc], b[pc] = CLS_TABLE_GET, table_size
+        elif op == Op.table_set:
+            cls[pc], b[pc] = CLS_TABLE_SET, table_size
+        elif op == Op.table_size:
+            cls[pc], b[pc] = CLS_TABLE_SIZE, table_size
+        elif op == Op.table_grow:
+            cls[pc], b[pc] = CLS_TABLE_GROW, table_size
+        elif op == Op.table_fill:
+            cls[pc], b[pc] = CLS_TABLE_FILL, table_size
+        elif op == Op.table_copy:
+            cls[pc], b[pc] = CLS_TABLE_COPY, table_size
+        elif op == Op.table_init:
+            cls[pc], a[pc], b[pc] = CLS_TABLE_INIT, ia, table_size
+        elif op == Op.elem_drop:
+            cls[pc], a[pc] = CLS_ELEM_DROP, ia
+        elif op == Op.memory_init:
+            cls[pc], a[pc] = CLS_MEMINIT, ia
+        elif op == Op.data_drop:
+            cls[pc], a[pc] = CLS_DATA_DROP, ia
+        elif op == Op.ref_func:
+            # device funcref domain: funcidx+1 (matches table0 cells).
+            # Own class (not CLS_CONST) so multi-tenant concatenation can
+            # rebase the function index (multitenant.py concat_images)
+            cls[pc], a[pc] = CLS_REFFUNC, ia
+        elif op == Op.return_call:
+            cls[pc], a[pc] = CLS_RETCALL, ia
+        elif op == Op.return_call_indirect:
+            cls[pc], a[pc] = CLS_RETCALL_INDIRECT, _dense_type(ia)
+            b[pc] = table_size
+            c[pc] = 0
         elif op == Op.memory_size:
             cls[pc] = CLS_MEMSIZE
         elif op == Op.memory_grow:
@@ -476,6 +556,39 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         v128[i, 3] = _i32(int(v_hi[i]) >> 32)
     has_simd = bool(((cls >= CLS_VCONST) & (cls <= CLS_VSTORE)).any())
 
+    # segment snapshots (table.init / memory.init sources; per-lane
+    # dropped flags live in engine state, not here)
+    esegs = elem_segs or []
+    elem_off = np.zeros(max(len(esegs), 1), np.int32)
+    elem_len = np.zeros(max(len(esegs), 1), np.int32)
+    eflat: list = []
+    for i, seg in enumerate(esegs):
+        elem_off[i] = len(eflat)
+        elem_len[i] = len(seg)
+        eflat.extend(int(x) for x in seg)
+    elem_flat = np.asarray(eflat or [0], np.int32)
+    dsegs = data_segs or []
+    data_off = np.zeros(max(len(dsegs), 1), np.int32)
+    data_len = np.zeros(max(len(dsegs), 1), np.int32)
+    dbytes = bytearray()
+    for i, seg in enumerate(dsegs):
+        data_off[i] = len(dbytes)
+        data_len[i] = len(seg)
+        dbytes.extend(seg)
+    while len(dbytes) % 4:
+        dbytes.append(0)
+    data_words = (np.frombuffer(bytes(dbytes), np.uint8).view(np.int32)
+                  .astype(np.int32) if dbytes else np.zeros(1, np.int32))
+
+    table_max = 0
+    if mod is not None and getattr(mod, "tables", None):
+        lim = mod.tables[0].limit
+        table_max = lim.max if lim.max is not None else 0
+    _TMUT = (CLS_TABLE_SET, CLS_TABLE_GROW, CLS_TABLE_FILL,
+             CLS_TABLE_COPY, CLS_TABLE_INIT)
+    has_table_mut = bool(np.isin(cls, _TMUT).any())
+    has_table_grow = bool((cls == CLS_TABLE_GROW).any())
+
     return DeviceImage(
         cls=cls, sub=sub, a=a, b=b, c=c, imm_lo=imm_lo, imm_hi=imm_hi,
         br_table=image.arrays["br_table"],
@@ -486,4 +599,10 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         has_memory=bool(memories),
         max_local_zeros=max_zeros, code_len=n,
         v128=v128, has_simd=has_simd,
+        elem_flat=elem_flat, elem_off=elem_off, elem_len=elem_len,
+        data_words=data_words, data_off=data_off, data_len=data_len,
+        op_id=op_id,
+        table_max=table_max, table_cap=len(table0),
+        table_size_init=table_size,
+        has_table_mut=has_table_mut, has_table_grow=has_table_grow,
     )

@@ -30,9 +30,22 @@ from wasmedge_tpu.batch.image import (
     CLS_BRZ,
     CLS_CALL,
     CLS_CALL_INDIRECT,
+    CLS_DATA_DROP,
+    CLS_ELEM_DROP,
     CLS_GLOBAL_GET,
     CLS_GLOBAL_SET,
     CLS_HOSTCALL,
+    CLS_MEMINIT,
+    CLS_REFFUNC,
+    CLS_RETCALL,
+    CLS_RETCALL_INDIRECT,
+    CLS_TABLE_COPY,
+    CLS_TABLE_FILL,
+    CLS_TABLE_GET,
+    CLS_TABLE_GROW,
+    CLS_TABLE_INIT,
+    CLS_TABLE_SET,
+    CLS_TABLE_SIZE,
     DeviceImage,
 )
 
@@ -74,8 +87,11 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
     tbl_parts = []
     g_lo_parts = []
     g_hi_parts = []
+    eflat_parts, eoff_parts, elen_parts = [], [], []
+    dword_parts, doff_parts, dlen_parts = [], [], []
     bases = []
     pc_b = fn_b = gl_b = ty_b = brt_b = tbl_b = 0
+    eseg_b = eflat_b = dseg_b = dbyte_b = 0
     for t in tenants:
         img = t.img
         base = dict(pc=pc_b, func=fn_b, glob=gl_b, type=ty_b, brt=brt_b,
@@ -88,13 +104,24 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         is_branch = (cls == CLS_BR) | (cls == CLS_BRZ) | (cls == CLS_BRNZ)
         a[is_branch] += pc_b
         a[cls == CLS_CALL] += fn_b
+        a[cls == CLS_RETCALL] += fn_b
         a[cls == CLS_HOSTCALL] += fn_b
         a[(cls == CLS_GLOBAL_GET) | (cls == CLS_GLOBAL_SET)] += gl_b
-        is_ci = cls == CLS_CALL_INDIRECT
+        is_ci = (cls == CLS_CALL_INDIRECT) | (cls == CLS_RETCALL_INDIRECT)
         a[is_ci] += ty_b
         c[is_ci] += tbl_b
         a[cls == CLS_BR_TABLE] += brt_b
         a[(cls == CLS_VCONST) | (cls == CLS_VSHUFFLE)] += v128_b
+        # table ops address the tenant's slot [tbl_b, tbl_b + slot) in
+        # the concatenated plane; ref.func pushes rebase with the
+        # function index space
+        is_tb = np.isin(cls, (CLS_TABLE_GET, CLS_TABLE_SET, CLS_TABLE_SIZE,
+                              CLS_TABLE_GROW, CLS_TABLE_FILL,
+                              CLS_TABLE_COPY, CLS_TABLE_INIT))
+        c[is_tb] += tbl_b
+        a[(cls == CLS_TABLE_INIT) | (cls == CLS_ELEM_DROP)] += eseg_b
+        a[(cls == CLS_MEMINIT) | (cls == CLS_DATA_DROP)] += dseg_b
+        a[cls == CLS_REFFUNC] += fn_b
         planes["cls"].append(cls)
         planes["sub"].append(img.sub)
         planes["a"].append(a)
@@ -102,12 +129,36 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         planes["c"].append(c)
         planes["imm_lo"].append(img.imm_lo)
         planes["imm_hi"].append(img.imm_hi)
+        planes.setdefault("op_id", []).append(
+            img.op_id if img.op_id is not None
+            else np.zeros(img.code_len, np.int32))
         brt = img.br_table.copy()
         brt[:, 0] += pc_b
         brt_parts.append(brt)
-        tbl = img.table0.copy()
+        # each tenant's table slot is its table_cap rows (grow room);
+        # per-instruction capacity (b of CLS_TABLE_GROW) is already the
+        # slot size, so growth can never cross into a neighbour's slot
+        slot = max(int(img.table_cap or img.table0.shape[0]),
+                   img.table0.shape[0])
+        tbl = np.zeros(slot, img.table0.dtype)
+        tbl[:img.table0.shape[0]] = img.table0
         tbl[tbl != 0] += fn_b
         tbl_parts.append(tbl)
+        # segment snapshots: flat entries rebase with the function index
+        # space (funcref domain), offsets with the flat concatenation
+        ef = img.elem_flat.copy() if img.elem_flat is not None             else np.zeros(1, np.int32)
+        ef[ef != 0] += fn_b
+        eflat_parts.append(ef)
+        eoff_parts.append((img.elem_off if img.elem_off is not None
+                           else np.zeros(1, np.int32)) + eflat_b)
+        elen_parts.append(img.elem_len if img.elem_len is not None
+                          else np.zeros(1, np.int32))
+        dword_parts.append(img.data_words if img.data_words is not None
+                           else np.zeros(1, np.int32))
+        doff_parts.append((img.data_off if img.data_off is not None
+                           else np.zeros(1, np.int32)) + dbyte_b)
+        dlen_parts.append(img.data_len if img.data_len is not None
+                          else np.zeros(1, np.int32))
         f_parts["f_entry"].append(img.f_entry + pc_b)
         f_parts["f_nparams"].append(img.f_nparams)
         f_parts["f_nlocals"].append(img.f_nlocals)
@@ -124,7 +175,11 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         gl_b += img.globals_lo.shape[0]
         ty_b += int(img.f_type.max(initial=0)) + 1
         brt_b += img.br_table.shape[0]
-        tbl_b += img.table0.shape[0]
+        tbl_b += slot
+        eseg_b += elen_parts[-1].shape[0]
+        eflat_b += eflat_parts[-1].shape[0]
+        dseg_b += dlen_parts[-1].shape[0]
+        dbyte_b += 4 * dword_parts[-1].shape[0]
 
     image = DeviceImage(
         cls=np.concatenate(planes["cls"]),
@@ -134,6 +189,7 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         c=np.concatenate(planes["c"]),
         imm_lo=np.concatenate(planes["imm_lo"]),
         imm_hi=np.concatenate(planes["imm_hi"]),
+        op_id=np.concatenate(planes["op_id"]),
         br_table=np.concatenate(brt_parts, axis=0),
         f_entry=np.concatenate(f_parts["f_entry"]),
         f_nparams=np.concatenate(f_parts["f_nparams"]),
@@ -156,6 +212,17 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         code_len=pc_b,
         v128=np.concatenate(v128_parts, axis=0),
         has_simd=any(t.img.has_simd for t in tenants),
+        elem_flat=np.concatenate(eflat_parts),
+        elem_off=np.concatenate(eoff_parts),
+        elem_len=np.concatenate(elen_parts),
+        data_words=np.concatenate(dword_parts),
+        data_off=np.concatenate(doff_parts),
+        data_len=np.concatenate(dlen_parts),
+        table_cap=tbl_b,
+        has_table_mut=any(getattr(t.img, "has_table_mut", False)
+                          for t in tenants),
+        has_table_grow=any(getattr(t.img, "has_table_grow", False)
+                           for t in tenants),
     )
     return image, bases
 
@@ -259,7 +326,33 @@ class MultiTenantBatchEngine(BatchEngine):
             mem=jnp.asarray(mem),
             stack_e2=jnp.zeros((D, L), jnp.int32) if img.has_simd else None,
             stack_e3=jnp.zeros((D, L), jnp.int32) if img.has_simd else None,
+            **self._r05_planes(),
         )
+
+    def _r05_planes(self) -> dict:
+        """Concatenated-image variant of engine.r05_state_planes: the
+        tab plane holds every tenant's slot; tsize is per-lane (each
+        lane sees its own tenant's table size)."""
+        import jax.numpy as jnp
+
+        img = self.img
+        L = self.lanes
+        out = {}
+        if getattr(img, "has_table_mut", False):
+            T = max(int(img.table_cap or img.table0.shape[0]), 1)
+            tb = np.zeros((T, L), np.int32)
+            n0 = min(img.table0.shape[0], T)
+            tb[:n0] = img.table0[:n0, None]
+            tsz = np.zeros(L, np.int32)
+            for ti, t in enumerate(self.tenants):
+                tsz[self._tenant_slices[ti]] = t.img.table_size_init
+            out["tab"] = jnp.asarray(tb)
+            out["tsize"] = jnp.asarray(tsz)
+        if bool(np.isin(img.cls, (CLS_TABLE_INIT, CLS_ELEM_DROP)).any()):
+            out["edrop"] = jnp.zeros((img.elem_len.shape[0], L), jnp.int32)
+        if bool(np.isin(img.cls, (CLS_MEMINIT, CLS_DATA_DROP)).any()):
+            out["ddrop"] = jnp.zeros((img.data_len.shape[0], L), jnp.int32)
+        return out
 
     def _try_pallas(self):
         """Pallas fast path when every tenant\'s lane count aligns to the

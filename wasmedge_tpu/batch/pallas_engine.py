@@ -230,6 +230,12 @@ _FUEL_OFF = 0x7FFFFFFF  # fuel column value when gas metering is disabled
 _C_PC, _C_SP, _C_FP, _C_OB, _C_CD, _C_STATUS, _C_PAGES, _C_CHUNK = range(8)
 _C_STEPS = 8
 _C_FUEL = 9
+# per-block optimistic snapshot interval (adaptive: the host halves it
+# when a block rolls back — bounding the run-up a divergent block
+# discards — and doubles it back toward SNAP_STEPS on clean launches).
+# 0 means "use the kernel's build-time snap_steps".
+_C_SNAP = 10
+_SNAP_MIN = 256
 
 
 def merge_block_status_into_trap(trap_v: np.ndarray, ctrl: np.ndarray,
@@ -846,6 +852,8 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         # per-codeblock cost check (lib/executor/engine/engine.cpp).
         fuel_in = ctrl_r[blk, _C_FUEL]
         chunk_eff = jnp.minimum(chunk, fuel_in)
+        snap_in = ctrl_r[blk, _C_SNAP]
+        snap_dyn = jnp.where(snap_in > 0, snap_in, I32(snap_steps))
 
         def full(v):
             return jnp.full(ROW, v, I32)
@@ -3587,8 +3595,9 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
             # within a few hundred steps, and a short first window
             # bounds the optimistic run-up their rollback discards.
             interval = jnp.where(nc[IDX["ls"]] == 0,
-                                 I32(min(512, snap_steps)),
-                                 I32(snap_steps))
+                                 jnp.minimum(I32(min(512, snap_steps)),
+                                             snap_dyn),
+                                 snap_dyn)
             due = ((nc[0] - nc[IDX["ls"]]) >= interval) & \
                 (nc[7] == I32(ST_RUNNING))
 
@@ -3698,6 +3707,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         ctrl_out[blk, _C_PAGES] = pages
         ctrl_out[blk, _C_CHUNK] = chunk
         ctrl_out[blk, _C_STEPS] = steps
+        ctrl_out[blk, _C_SNAP] = snap_in
 
         outs = [dma(0, slo, lslice(s_lo_out)),
                 dma(1, shi, lslice(s_hi_out)),
@@ -3986,6 +3996,10 @@ class PallasUniformEngine:
             return reason
         if self.simt.mesh is not None:
             return "mesh sharding handled by SIMT engine"
+        if self.cfg.fuel_per_launch is not None and \
+                self.cfg.cost_table is not None and \
+                any(c != 1 for c in self.cfg.cost_table):
+            return "per-opcode cost-table gas handled by SIMT engine"
         if self._lane_block() is None:
             return (f"state too large for VMEM "
                     f"({self._mem_words()} mem words/lane)")
@@ -4326,6 +4340,19 @@ class PallasUniformEngine:
                 state, ctrl_np = self._run_recheck(state, ctrl_np)
                 steps_per_block += ctrl_np[:, _C_STEPS].astype(np.int64)
                 statuses = ctrl_np[:, _C_STATUS]
+            else:
+                # adaptive window growth: a launch with no rollback
+                # doubles a shrunken snapshot interval back toward
+                # SNAP_STEPS (careful_recheck is the halving side)
+                snap = ctrl_np[:, _C_SNAP]
+                if (snap > 0).any() and (snap < self.SNAP_STEPS).any():
+                    import jax.numpy as jnp
+
+                    ctrl_np = ctrl_np.copy()
+                    ctrl_np[:, _C_SNAP] = np.where(
+                        snap > 0,
+                        np.minimum(snap * 2, self.SNAP_STEPS), snap)
+                    state[0] = jnp.asarray(ctrl_np)
             if (statuses == ST_HOSTCALL).any() and \
                     int(steps_per_block.max()) < max_steps:
                 state = self._serve_hostcalls(state, ctrl_np)
@@ -4351,7 +4378,15 @@ class PallasUniformEngine:
         self.recheck_rounds += 1
         ctrl = ctrl_np.copy()
         saved_chunk = ctrl[:, _C_CHUNK].copy()
-        ctrl[:, _C_CHUNK] = np.where(recheck_mask, self.SNAP_STEPS + 64, 0)
+        # adaptive window: a block that just rolled back gets half its
+        # snapshot interval next time (down to _SNAP_MIN), so the run-up
+        # a genuinely divergent block discards shrinks geometrically;
+        # clean launches grow it back (engine._drive / BlockScheduler)
+        snap = np.where(ctrl[:, _C_SNAP] > 0, ctrl[:, _C_SNAP],
+                        self.SNAP_STEPS)
+        ctrl[:, _C_SNAP] = np.where(
+            recheck_mask, np.maximum(snap // 2, _SNAP_MIN), snap)
+        ctrl[:, _C_CHUNK] = np.where(recheck_mask, snap + 64, 0)
         ctrl[:, _C_STATUS] = np.where(recheck_mask, ST_RUNNING,
                                       ctrl[:, _C_STATUS])
         state[0] = jnp.asarray(ctrl)

@@ -65,6 +65,7 @@ from wasmedge_tpu.batch.pallas_engine import (
     ST_RUNNING,
     ST_TRAPPED_BASE,
     _C_CD,
+    _C_SNAP,
     _C_CHUNK,
     _C_FP,
     _C_FUEL,
@@ -466,6 +467,18 @@ class BlockScheduler:
             self.block_steps[live] += new_steps[live]
             if (live & (ctrl_np[:, _C_STATUS] == ST_RECHECK)).any():
                 ctrl_np = self._run_recheck(live)
+            else:
+                # adaptive-window growth (careful_recheck halves):
+                # clean launches double a shrunken snapshot interval
+                snap = ctrl_np[:, _C_SNAP]
+                grow = live & (snap > 0) & (snap < self.eng.SNAP_STEPS)
+                if grow.any():
+                    cc = self._ctrl()
+                    cc[:, _C_SNAP] = np.where(
+                        grow, np.minimum(snap * 2, self.eng.SNAP_STEPS),
+                        snap)
+                    self._ctrl_dirty = True
+                    ctrl_np = cc
             self._handle_statuses(ctrl_np)
             return True
         if self._handle_statuses(ctrl_np):

@@ -736,7 +736,7 @@ class UniformBatchEngine:
     def _to_simt_state(self, ust: "UniformState"):
         import jax.numpy as jnp
 
-        from wasmedge_tpu.batch.engine import BatchState
+        from wasmedge_tpu.batch.engine import BatchState, r05_state_planes
 
         L = self.lanes
         full = lambda v: jnp.full((L,), v, jnp.int32)
@@ -762,6 +762,11 @@ class UniformBatchEngine:
             fr_opbase=jnp.broadcast_to(ust.fr_opbase[:, None],
                                        (cfg.call_stack_depth, L)),
             glob_lo=ust.glob_lo, glob_hi=ust.glob_hi, mem=ust.mem,
+            # r05 planes at their pristine values: the converged path
+            # cannot execute the ops that mutate them (it bails first),
+            # so a divergence handoff always starts from the initial
+            # table/segment state
+            **r05_state_planes(self.img, L),
         )
 
     def run(self, func_name, args_lanes, max_steps: int = 10_000_000):
@@ -777,10 +782,15 @@ class UniformBatchEngine:
             res = self.pallas.run(func_name, args_lanes, max_steps)
             self.fell_back_to_simt = self.pallas.fell_back_to_simt
             return res
+        from wasmedge_tpu.batch.image import CLS_TABLE_GET
+
         if self.cfg.fuel_per_launch is not None or self.simt.mesh is not None \
-                or getattr(self.img, "has_simd", False):
-            # fuel accounting, mesh sharding, and v128 live in the SIMT
-            # engine (the converged single-pc path has no 4-plane cells)
+                or getattr(self.img, "has_simd", False) \
+                or bool((self.img.cls >= CLS_TABLE_GET).any()):
+            # fuel accounting, mesh sharding, v128, and the r05 table/
+            # segment/tail-call families live in the SIMT engine (the
+            # converged single-pc path has neither 4-plane cells nor the
+            # per-lane table planes)
             return self.simt.run(func_name, args_lanes, max_steps)
         if self._uchunk is None:
             self._build_uniform()

@@ -679,10 +679,13 @@ def we_VMBatchExecute(ctx, func_name: str, per_lane_args, lanes: int,
     def go():
         from wasmedge_tpu.batch.uniform import UniformBatchEngine
 
+        from wasmedge_tpu.vm.vm import batch_conf_with_gas
+
         inst = ctx.vm.active_module
         if inst is None:
             raise WasmError(ErrCode.WrongVMWorkflow, "no instantiated module")
-        eng = UniformBatchEngine(inst, store=ctx.vm.store, conf=ctx.vm.conf,
+        conf = batch_conf_with_gas(ctx.vm.conf, ctx.vm.stat)
+        eng = UniformBatchEngine(inst, store=ctx.vm.store, conf=conf,
                                  lanes=lanes)
         return eng.run(func_name, list(per_lane_args), max_steps=max_steps)
     return _wrap(go)

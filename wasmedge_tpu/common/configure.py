@@ -84,8 +84,15 @@ class BatchConfigure:
     value_stack_depth: int = 1024  # 64-bit slots per lane
     call_stack_depth: int = 512  # frames per lane
     memory_pages_per_lane: int = 1  # 64 KiB pages of linear memory per lane
+    # table.grow capacity cap per lane (like memory_pages_per_lane: a
+    # static HBM ceiling; grow beyond it returns -1, which the spec
+    # allows at any size)
+    table_elems_per_lane: int = 4096
     steps_per_launch: int = 1024  # device steps per host-loop iteration
     fuel_per_launch: Optional[int] = None  # per-lane fuel budget (gas analog)
+    # per-opcode gas weights (Statistics cost-table bridge, set by the
+    # VM/C-API batch entries when cost measuring is on; None = flat 1)
+    cost_table: Optional[tuple] = None
     uniform: bool = True  # converged-lane fast path (scalar PC dispatch)
     interpret: bool = False  # run Pallas kernels in interpreter mode
     # Pallas warp-interpreter selection: None = auto (on whenever the

@@ -831,6 +831,14 @@ class WatCompiler:
             return i + 1
         if op in _IDX_IMM:
             space = _IDX_IMM[op]
+            if space == "table" and (
+                    i >= len(items) or not isinstance(items[i], str)
+                    or not (items[i].startswith("$")
+                            or items[i].isdigit())):
+                # the table index is optional in the text format
+                # (defaults to table 0): (table.get) == (table.get 0)
+                out.append((op, 0))
+                return i
             tok = items[i]
             if space == "label":
                 out.append((op, self._label_depth(tok, labels)))

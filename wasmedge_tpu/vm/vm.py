@@ -33,6 +33,33 @@ from wasmedge_tpu.vm.async_ import Async
 Source = Union[str, bytes, bytearray, ast.Module]
 
 
+def batch_conf_with_gas(conf, stat):
+    """Bridge Statistics gas metering onto the batch engine's per-lane
+    fuel: when cost measuring is on with a real limit, the lanes get a
+    fuel budget and (for a non-uniform table) per-opcode weights —
+    the batch analog of the reference's CostTab-weighted CAS gas
+    (include/common/statistics.h:85-98)."""
+    import copy
+
+    if stat is None or not stat.cost_measuring:
+        return conf
+    limit = stat.cost_limit
+    # fuel is an int32 lane plane: a limit beyond it cannot be tracked
+    # exactly, and clamping would kill lanes EARLY — leave such runs
+    # ungated (the reference's default limit 2^64-1 means "unlimited")
+    if limit >= (1 << 31) - 1 and conf.batch.fuel_per_launch is None:
+        return conf
+    conf = copy.deepcopy(conf)
+    if conf.batch.fuel_per_launch is None:
+        # +1: Statistics traps on total_cost > limit (statistics.py),
+        # the fuel plane traps on fuel <= 0 — landing exactly on the
+        # budget must complete, like the reference's CAS gas
+        conf.batch.fuel_per_launch = int(limit) + 1
+    if any(c != 1 for c in stat.cost_table):
+        conf.batch.cost_table = tuple(stat.cost_table)
+    return conf
+
+
 class VMStage(enum.Enum):
     """reference: include/vm/vm.h:241"""
 
@@ -188,7 +215,8 @@ class VM:
             inst = self._active
         # the auto engine: Pallas warp-interpreter on TPU, XLA uniform on
         # CPU, SIMT for divergence/fuel/mesh — all behind one run()
-        eng = UniformBatchEngine(inst, store=self.store, conf=self.conf,
+        conf = batch_conf_with_gas(self.conf, self.stat)
+        eng = UniformBatchEngine(inst, store=self.store, conf=conf,
                                  lanes=lanes, mesh=mesh)
         return eng.run(func_name, list(args_lanes), max_steps=max_steps)
 
