@@ -72,15 +72,13 @@ int we_vm_run_i64(we_vm *vm, const char *wasm_path, const char *func,
                   const long long *args, int nargs,
                   long long *results, int max_results) {
     PyObject *params = PyList_New(nargs);
-    for (int i = 0; i < nargs; i++) {
-        PyObject *v = PyObject_CallMethod(g_capi, "we_ValueGenI64", "L",
-                                          args[i]);
-        if (!v) { set_err_from_py(); Py_DECREF(params); return -1; }
-        PyList_SET_ITEM(params, i, v);
-    }
+    for (int i = 0; i < nargs; i++)
+        PyList_SET_ITEM(params, i, PyLong_FromLongLong(args[i]));
+    /* raw 64-bit cells: coerced to the declared param types on the
+     * Python side (we_VMRunWasmFromFileCells) */
     PyObject *pair = PyObject_CallMethod(
-        g_capi, "we_VMRunWasmFromFile", "OssO", vm->ctx, wasm_path, func,
-        params);
+        g_capi, "we_VMRunWasmFromFileCells", "OssO", vm->ctx, wasm_path,
+        func, params);
     Py_DECREF(params);
     if (!pair) { set_err_from_py(); return -1; }
     PyObject *res = PyTuple_GetItem(pair, 0);
@@ -115,6 +113,244 @@ int we_vm_run_i64(we_vm *vm, const char *wasm_path, const char *func,
     }
     Py_DECREF(pair);
     return n;
+}
+
+/* -- typed values + staged pipeline (C++ SDK substrate) ---------------- */
+
+static PyObject *value_to_py(const we_value *v) {
+    switch (v->kind) {
+    case WE_I32:
+        return PyObject_CallMethod(g_capi, "we_ValueGenI32", "i", v->of.i32);
+    case WE_I64:
+        return PyObject_CallMethod(g_capi, "we_ValueGenI64", "L", v->of.i64);
+    case WE_F32:
+        return PyObject_CallMethod(g_capi, "we_ValueGenF32", "f", v->of.f32);
+    default:
+        return PyObject_CallMethod(g_capi, "we_ValueGenF64", "d", v->of.f64);
+    }
+}
+
+static int value_from_py(PyObject *cell, we_value *out) {
+    PyObject *ty = PyObject_GetAttrString(cell, "type");
+    const char *t = ty ? PyUnicode_AsUTF8(ty) : NULL;
+    /* only the four numeric kinds cross this ABI; v128/refs would
+     * silently truncate, so refuse them instead */
+    if (!t || (strcmp(t, "i32") && strcmp(t, "i64") && strcmp(t, "f32")
+               && strcmp(t, "f64"))) {
+        snprintf(g_err, sizeof g_err,
+                 "result type %s not representable as we_value",
+                 t ? t : "?");
+        Py_XDECREF(ty);
+        return -1;
+    }
+    const char *getter = "we_ValueGetI64";
+    out->kind = WE_I64;
+    if (strcmp(t, "i32") == 0) { out->kind = WE_I32; getter = "we_ValueGetI32"; }
+    else if (strcmp(t, "f32") == 0) { out->kind = WE_F32; getter = "we_ValueGetF32"; }
+    else if (strcmp(t, "f64") == 0) { out->kind = WE_F64; getter = "we_ValueGetF64"; }
+    Py_XDECREF(ty);
+    PyObject *raw = PyObject_CallMethod(g_capi, getter, "O", cell);
+    if (!raw) { set_err_from_py(); return -1; }
+    switch (out->kind) {
+    case WE_I32: out->of.i32 = (int32_t)PyLong_AsLong(raw); break;
+    case WE_I64: out->of.i64 = PyLong_AsLongLong(raw); break;
+    case WE_F32: out->of.f32 = (float)PyFloat_AsDouble(raw); break;
+    default: out->of.f64 = PyFloat_AsDouble(raw); break;
+    }
+    Py_DECREF(raw);
+    return 0;
+}
+
+/* Result-object -> 0 / negative error code (sets g_err). */
+static int check_result(PyObject *res) {
+    PyObject *ok = PyObject_CallMethod(g_capi, "we_ResultOK", "O", res);
+    if (!ok) { set_err_from_py(); return -1; }
+    if (PyObject_IsTrue(ok)) { Py_DECREF(ok); return 0; }
+    Py_DECREF(ok);
+    long c = -1;
+    PyObject *code = PyObject_CallMethod(g_capi, "we_ResultGetCode", "O", res);
+    PyObject *msg = PyObject_CallMethod(g_capi, "we_ResultGetMessage", "O", res);
+    if (msg && PyUnicode_Check(msg)) {
+        const char *m = PyUnicode_AsUTF8(msg);
+        snprintf(g_err, sizeof g_err, "%s", m ? m : "unknown error");
+    }
+    if (code) c = PyLong_AsLong(code);
+    Py_XDECREF(code); Py_XDECREF(msg);
+    return c > 0 ? -(int)c : -1;
+}
+
+static PyObject *strv_to_list(const char *const *sv) {
+    PyObject *lst = PyList_New(0);
+    for (; sv && *sv; sv++) {
+        PyObject *s = PyUnicode_FromString(*sv);
+        PyList_Append(lst, s);
+        Py_DECREF(s);
+    }
+    return lst;
+}
+
+we_vm *we_vm_create_ex(unsigned host_flags, const char *const *wasi_args,
+                       const char *const *wasi_envs,
+                       const char *const *wasi_preopens) {
+    if (we_init()) return NULL;
+    PyObject *conf = PyObject_CallMethod(g_capi, "we_ConfigureCreate", NULL);
+    if (!conf) { set_err_from_py(); return NULL; }
+    if (host_flags & WE_HOST_WASI) {
+        PyObject *r = PyObject_CallMethod(
+            g_capi, "we_ConfigureAddHostRegistration", "Os", conf, "wasi");
+        if (!r) { set_err_from_py(); Py_DECREF(conf); return NULL; }
+        Py_DECREF(r);
+    }
+    PyObject *ctx = PyObject_CallMethod(g_capi, "we_VMCreate", "O", conf);
+    Py_DECREF(conf);
+    if (!ctx) { set_err_from_py(); return NULL; }
+    if (host_flags & WE_HOST_WASI) {
+        PyObject *wasi = PyObject_CallMethod(
+            g_capi, "we_VMGetImportModuleContext", "Os", ctx, "wasi");
+        if (wasi && wasi != Py_None) {
+            /* args[0] is argv[0] (the program name), like the CLI
+             * (reference: wasmedger.cpp:216-221) */
+            PyObject *dirs = strv_to_list(wasi_preopens);
+            PyObject *args = strv_to_list(
+                wasi_args && wasi_args[0] ? wasi_args + 1 : wasi_args);
+            PyObject *envs = strv_to_list(wasi_envs);
+            PyObject *r;
+            if (wasi_args && wasi_args[0]) {
+                r = PyObject_CallMethod(
+                    g_capi, "we_ImportObjectInitWASI", "OOOOs", wasi,
+                    dirs, args, envs, wasi_args[0]);
+            } else {
+                r = PyObject_CallMethod(
+                    g_capi, "we_ImportObjectInitWASI", "OOOO", wasi,
+                    dirs, args, envs);
+            }
+            int failed = (r == NULL);
+            if (failed) set_err_from_py();
+            Py_XDECREF(r); Py_DECREF(dirs); Py_DECREF(args); Py_DECREF(envs);
+            if (failed) { Py_XDECREF(wasi); Py_DECREF(ctx); return NULL; }
+        }
+        Py_XDECREF(wasi);
+    }
+    we_vm *vm = (we_vm *)malloc(sizeof *vm);
+    vm->ctx = ctx;
+    return vm;
+}
+
+static int staged_call(we_vm *vm, const char *method, const char *arg) {
+    PyObject *res = arg
+        ? PyObject_CallMethod(g_capi, method, "Os", vm->ctx, arg)
+        : PyObject_CallMethod(g_capi, method, "O", vm->ctx);
+    if (!res) { set_err_from_py(); return -1; }
+    int rc = check_result(res);
+    Py_DECREF(res);
+    return rc;
+}
+
+int we_vm_load_file(we_vm *vm, const char *wasm_path) {
+    return staged_call(vm, "we_VMLoadWasmFromFile", wasm_path);
+}
+
+int we_vm_validate(we_vm *vm) {
+    return staged_call(vm, "we_VMValidate", NULL);
+}
+
+int we_vm_instantiate(we_vm *vm) {
+    return staged_call(vm, "we_VMInstantiate", NULL);
+}
+
+static int execute_common(we_vm *vm, PyObject *pair, we_value *results,
+                          int max_results) {
+    if (!pair) { set_err_from_py(); return -1; }
+    PyObject *res = PyTuple_GetItem(pair, 0);
+    PyObject *vals = PyTuple_GetItem(pair, 1);
+    if (!res || !vals) { set_err_from_py(); Py_DECREF(pair); return -1; }
+    int rc = check_result(res);
+    if (rc < 0) { Py_DECREF(pair); return rc; }
+    int n = (int)PyList_Size(vals);
+    for (int i = 0; i < n && i < max_results; i++) {
+        if (value_from_py(PyList_GetItem(vals, i), &results[i]) < 0) {
+            Py_DECREF(pair);
+            return -1;
+        }
+    }
+    Py_DECREF(pair);
+    return n;
+}
+
+int we_vm_execute(we_vm *vm, const char *func, const we_value *args,
+                  int nargs, we_value *results, int max_results) {
+    PyObject *params = PyList_New(nargs);
+    for (int i = 0; i < nargs; i++) {
+        PyObject *v = value_to_py(&args[i]);
+        if (!v) { set_err_from_py(); Py_DECREF(params); return -1; }
+        PyList_SET_ITEM(params, i, v);
+    }
+    PyObject *pair = PyObject_CallMethod(
+        g_capi, "we_VMExecute", "OsO", vm->ctx, func, params);
+    Py_DECREF(params);
+    return execute_common(vm, pair, results, max_results);
+}
+
+int we_vm_run(we_vm *vm, const char *wasm_path, const char *func,
+              const we_value *args, int nargs, we_value *results,
+              int max_results) {
+    int rc;
+    if ((rc = we_vm_load_file(vm, wasm_path)) < 0) return rc;
+    if ((rc = we_vm_validate(vm)) < 0) return rc;
+    if ((rc = we_vm_instantiate(vm)) < 0) return rc;
+    return we_vm_execute(vm, func, args, nargs, results, max_results);
+}
+
+int we_vm_wasi_exit_code(we_vm *vm) {
+    PyObject *wasi = PyObject_CallMethod(
+        g_capi, "we_VMGetImportModuleContext", "Os", vm->ctx, "wasi");
+    if (!wasi || wasi == Py_None) { Py_XDECREF(wasi); return -1; }
+    PyObject *c = PyObject_CallMethod(
+        g_capi, "we_ImportObjectWASIGetExitCode", "O", wasi);
+    Py_DECREF(wasi);
+    if (!c) { set_err_from_py(); return -1; }
+    int rc = (int)PyLong_AsLong(c);
+    Py_DECREF(c);
+    return rc;
+}
+
+int we_vm_wasi_has_exited(we_vm *vm) {
+    PyObject *wasi = PyObject_CallMethod(
+        g_capi, "we_VMGetImportModuleContext", "Os", vm->ctx, "wasi");
+    if (!wasi || wasi == Py_None) { Py_XDECREF(wasi); return 0; }
+    PyObject *c = PyObject_CallMethod(
+        g_capi, "we_ImportObjectWASIHasExited", "O", wasi);
+    Py_DECREF(wasi);
+    if (!c) { set_err_from_py(); return 0; }
+    int rc = PyObject_IsTrue(c);
+    Py_DECREF(c);
+    return rc;
+}
+
+int we_vm_function_list(we_vm *vm, char **names, int max_names) {
+    PyObject *lst = PyObject_CallMethod(g_capi, "we_VMGetFunctionList",
+                                        "O", vm->ctx);
+    if (!lst) { set_err_from_py(); return -1; }
+    int n = (int)PyList_Size(lst);
+    if (names) {
+        for (int i = 0; i < n && i < max_names; i++) {
+            PyObject *entry = PyList_GetItem(lst, i);
+            PyObject *nm = PyTuple_GetItem(entry, 0);
+            const char *s = nm ? PyUnicode_AsUTF8(nm) : NULL;
+            names[i] = strdup(s ? s : "");
+        }
+    }
+    Py_DECREF(lst);
+    return n;
+}
+
+int we_vm_register_file(we_vm *vm, const char *name, const char *path) {
+    PyObject *res = PyObject_CallMethod(
+        g_capi, "we_VMRegisterModuleFromFile", "Oss", vm->ctx, name, path);
+    if (!res) { set_err_from_py(); return -1; }
+    int rc = check_result(res);
+    Py_DECREF(res);
+    return rc;
 }
 
 int we_compile(const char *in_path, const char *out_path) {

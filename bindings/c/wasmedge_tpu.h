@@ -4,7 +4,9 @@
  * (reference: /root/reference/include/api/wasmedge/wasmedge.h): a C host
  * links against the shim (shim.c), which embeds CPython and drives the
  * wasmedge_tpu.capi surface — the same way the reference's Rust bindings
- * are an FFI layer over its C API (bindings/rust/wasmedge-sys).
+ * are an FFI layer over its C API (bindings/rust/wasmedge-sys).  The
+ * typed C++ SDK (../cpp/wasmedge_tpu.hpp) sits on this ABI the way
+ * wasmedge-sdk sits on wasmedge-sys.
  *
  * Build: cc -c shim.c $(python3-config --includes)
  *        cc example_fib.c shim.o $(python3-config --embed --ldflags)
@@ -14,18 +16,79 @@
 #ifndef WASMEDGE_TPU_H
 #define WASMEDGE_TPU_H
 
+#include <stdint.h>
+
 #ifdef __cplusplus
 extern "C" {
 #endif
 
 typedef struct we_vm we_vm;
 
+/* Typed wasm value crossing the ABI (reference: WasmEdge_Value). */
+typedef enum we_valkind {
+  WE_I32 = 0,
+  WE_I64 = 1,
+  WE_F32 = 2,
+  WE_F64 = 3
+} we_valkind;
+
+typedef struct we_value {
+  int32_t kind; /* we_valkind */
+  union {
+    int32_t i32;
+    int64_t i64;
+    float f32;
+    double f64;
+  } of;
+} we_value;
+
 /* Initialize the embedded runtime (idempotent). Returns 0 on success. */
 int we_init(void);
 void we_shutdown(void);
 
+/* flags for we_vm_create_ex */
+#define WE_HOST_WASI 1u
+
 we_vm *we_vm_create(void);
+/* host_flags: WE_HOST_* host-module registrations.  wasi_args /
+ * wasi_envs ("K=V") / wasi_preopens ("guest:host" or "dir") are
+ * NULL-terminated string arrays applied to the WASI module (any may be
+ * NULL). */
+we_vm *we_vm_create_ex(unsigned host_flags, const char *const *wasi_args,
+                       const char *const *wasi_envs,
+                       const char *const *wasi_preopens);
 void we_vm_delete(we_vm *vm);
+
+/* -- staged pipeline (reference: VMLoadWasm/Validate/Instantiate) ------ */
+int we_vm_load_file(we_vm *vm, const char *wasm_path);
+int we_vm_validate(we_vm *vm);
+int we_vm_instantiate(we_vm *vm);
+
+/* Execute an export of the instantiated module with typed values.
+ * Returns the number of results (written to `results`, up to
+ * max_results), or a negative engine error code. */
+int we_vm_execute(we_vm *vm, const char *func, const we_value *args,
+                  int nargs, we_value *results, int max_results);
+
+/* One-shot: load+validate+instantiate+execute (typed values). */
+int we_vm_run(we_vm *vm, const char *wasm_path, const char *func,
+              const we_value *args, int nargs, we_value *results,
+              int max_results);
+
+/* WASI exit code of the last command run (after executing _start). */
+int we_vm_wasi_exit_code(we_vm *vm);
+
+/* 1 only after the guest called proc_exit (distinguishes proc_exit(0)
+ * from a guest that trapped or returned without exiting). */
+int we_vm_wasi_has_exited(we_vm *vm);
+
+/* Exported function listing of the instantiated module.  Returns the
+ * count; when `names` is non-NULL writes up to max_names entries of
+ * newly malloc'd strings the caller frees. */
+int we_vm_function_list(we_vm *vm, char **names, int max_names);
+
+/* Register a module file under a namespace for cross-module imports. */
+int we_vm_register_file(we_vm *vm, const char *name, const char *path);
 
 /* Run `func` from the wasm/twasm file with 64-bit integer arguments.
  * Results are written to `results` (up to max_results cells).

@@ -1,0 +1,67 @@
+// C++ SDK example: staged fib + a WASI command program, out of process.
+//
+// Build (from bindings/cpp):
+//   cc -c ../c/shim.c $(python3-config --includes)
+//   c++ -std=c++17 example_sdk.cc shim.o $(python3-config --embed --ldflags)
+//
+// Usage: example_sdk fib.wasm <n> [wasi.wasm expected_exit]
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "wasmedge_tpu.hpp"
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s fib.wasm n [wasi.wasm exit]\n", argv[0]);
+    return 2;
+  }
+  // staged pipeline with typed values
+  wetpu::Vm vm;
+  if (!vm.valid()) {
+    std::fprintf(stderr, "vm create failed: %s\n", we_last_error());
+    return 2;
+  }
+  for (auto step : {vm.load(argv[1]), vm.validate(), vm.instantiate()}) {
+    if (!step) {
+      std::fprintf(stderr, "stage failed: %s\n", step.error().message.c_str());
+      return 1;
+    }
+  }
+  auto fns = vm.function_list();
+  if (!fns || fns->empty()) {
+    std::fprintf(stderr, "no exports listed\n");
+    return 1;
+  }
+  auto r = vm.execute("fib", {wetpu::Value::i32(std::atoi(argv[2]))});
+  if (!r) {
+    std::fprintf(stderr, "execute failed (%d): %s\n", r.error().code,
+                 r.error().message.c_str());
+    return 1;
+  }
+  std::printf("fib=%d exports=%zu\n", (*r)[0].as_i32(), fns->size());
+
+  // trap maps to a typed error, and the VM stays usable
+  auto bad = vm.execute("fib", {wetpu::Value::f32(1.0f)});
+  if (bad) {
+    std::fprintf(stderr, "arity/type mismatch not surfaced\n");
+    return 1;
+  }
+  std::printf("typed-error=%d\n", bad.error().code);
+
+  if (argc >= 5) {
+    wetpu::WasiConfig ws;
+    ws.args = {"guest", "one", "two"};
+    wetpu::Vm wasi_vm{ws};
+    auto code = wasi_vm.run_wasi_command(argv[3]);
+    if (!code) {
+      std::fprintf(stderr, "wasi run failed: %s\n",
+                   code.error().message.c_str());
+      return 1;
+    }
+    std::printf("wasi-exit=%d want=%d\n", *code, std::atoi(argv[4]));
+    if (*code != std::atoi(argv[4])) return 1;
+  }
+  std::puts("SDK OK");
+  return 0;
+}

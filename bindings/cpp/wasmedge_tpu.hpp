@@ -1,0 +1,247 @@
+// wasmedge_tpu C++ SDK: typed host-language bindings over the C shim.
+//
+// The analog of the reference's high-level Rust SDK
+// (/root/reference/bindings/rust/wasmedge-sdk/src/vm.rs) for this
+// framework: RAII VM with a staged or one-shot pipeline, a tagged Value
+// type, and error mapping — all over the C ABI in
+// ../c/wasmedge_tpu.h exactly the way wasmedge-sdk sits on
+// wasmedge-sys.  Header-only C++17; link shim.o and the embedded
+// CPython (see the header's build line).
+//
+//   namespace wetpu;
+//   wetpu::Vm vm;                                  // plain VM
+//   auto r = vm.run("app.wasm", "fib", {wetpu::Value::i64(20)});
+//   if (r) int64_t out = (*r)[0].as_i64();
+//
+//   wetpu::WasiConfig ws; ws.args = {"app", "hello"};
+//   wetpu::Vm wasi_vm{ws};                         // WASI command VM
+//   wasi_vm.run_wasi_command("app.wasm");          // -> exit code
+
+#ifndef WASMEDGE_TPU_HPP
+#define WASMEDGE_TPU_HPP
+
+#include <cstdint>
+#include <cstdlib>
+#include <optional>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../c/wasmedge_tpu.h"
+
+namespace wetpu {
+
+// -- values -----------------------------------------------------------------
+
+enum class ValKind { I32 = WE_I32, I64 = WE_I64, F32 = WE_F32, F64 = WE_F64 };
+
+class Value {
+ public:
+  static Value i32(int32_t v) {
+    Value x(ValKind::I32);
+    x.raw_.of.i32 = v;
+    return x;
+  }
+  static Value i64(int64_t v) {
+    Value x(ValKind::I64);
+    x.raw_.of.i64 = v;
+    return x;
+  }
+  static Value f32(float v) {
+    Value x(ValKind::F32);
+    x.raw_.of.f32 = v;
+    return x;
+  }
+  static Value f64(double v) {
+    Value x(ValKind::F64);
+    x.raw_.of.f64 = v;
+    return x;
+  }
+  static Value from_raw(const we_value &raw) {
+    Value x(static_cast<ValKind>(raw.kind));
+    x.raw_ = raw;
+    return x;
+  }
+
+  ValKind kind() const { return static_cast<ValKind>(raw_.kind); }
+  int32_t as_i32() const { return raw_.of.i32; }
+  int64_t as_i64() const { return raw_.of.i64; }
+  float as_f32() const { return raw_.of.f32; }
+  double as_f64() const { return raw_.of.f64; }
+  const we_value &raw() const { return raw_; }
+
+ private:
+  explicit Value(ValKind k) : raw_{} { raw_.kind = static_cast<int32_t>(k); }
+  we_value raw_;
+};
+
+// -- errors -----------------------------------------------------------------
+
+// Engine error codes surface as their positive ErrCode value (the C ABI
+// returns them negated); -1 means a binding-level failure.
+struct Error {
+  int code = -1;
+  std::string message;
+};
+
+// Minimal expected<T, Error> (the SDK requires only this shape).
+template <typename T>
+class Result {
+ public:
+  Result(T value) : value_(std::move(value)) {}        // NOLINT(runtime/explicit)
+  Result(Error err) : error_(std::move(err)) {}        // NOLINT(runtime/explicit)
+
+  explicit operator bool() const { return value_.has_value(); }
+  const T &operator*() const { return *value_; }
+  T &operator*() { return *value_; }
+  const T *operator->() const { return &*value_; }
+  const Error &error() const { return *error_; }
+
+ private:
+  std::optional<T> value_;
+  std::optional<Error> error_;
+};
+
+inline Error last_error(int rc) {
+  return Error{rc < -1 ? -rc : rc, we_last_error() ? we_last_error() : ""};
+}
+
+// -- configuration ----------------------------------------------------------
+
+struct WasiConfig {
+  std::vector<std::string> args;      // argv (args[0] = program name)
+  std::vector<std::string> envs;      // "KEY=VALUE"
+  std::vector<std::string> preopens;  // "guest_dir:host_dir" or "dir"
+};
+
+// -- the VM -----------------------------------------------------------------
+
+class Vm {
+ public:
+  Vm() : vm_(we_vm_create()) {}
+  explicit Vm(const WasiConfig &wasi) {
+    auto argv = c_strv(wasi.args);
+    auto envv = c_strv(wasi.envs);
+    auto prev = c_strv(wasi.preopens);
+    vm_ = we_vm_create_ex(WE_HOST_WASI, argv.data(), envv.data(),
+                          prev.data());
+  }
+  ~Vm() { reset(); }
+  Vm(Vm &&o) noexcept : vm_(o.vm_) { o.vm_ = nullptr; }
+  Vm &operator=(Vm &&o) noexcept {
+    if (this != &o) {
+      reset();
+      vm_ = o.vm_;
+      o.vm_ = nullptr;
+    }
+    return *this;
+  }
+  Vm(const Vm &) = delete;
+  Vm &operator=(const Vm &) = delete;
+
+  bool valid() const { return vm_ != nullptr; }
+
+  // -- staged pipeline (reference Vm::load_wasm/validate/instantiate) ----
+  Result<bool> load(const std::string &wasm_path) {
+    return unit(we_vm_load_file(vm_, wasm_path.c_str()));
+  }
+  Result<bool> validate() { return unit(we_vm_validate(vm_)); }
+  Result<bool> instantiate() { return unit(we_vm_instantiate(vm_)); }
+
+  // Execute an export of the instantiated module.
+  Result<std::vector<Value>> execute(const std::string &func,
+                                     const std::vector<Value> &args = {}) {
+    std::vector<we_value> raw(args.size());
+    for (size_t i = 0; i < args.size(); i++) raw[i] = args[i].raw();
+    we_value out[16];
+    int n = we_vm_execute(vm_, func.c_str(), raw.data(),
+                          static_cast<int>(raw.size()), out, 16);
+    return values(n, out);
+  }
+
+  // One-shot load+validate+instantiate+execute (Vm::run_func analog).
+  Result<std::vector<Value>> run(const std::string &wasm_path,
+                                 const std::string &func,
+                                 const std::vector<Value> &args = {}) {
+    std::vector<we_value> raw(args.size());
+    for (size_t i = 0; i < args.size(); i++) raw[i] = args[i].raw();
+    we_value out[16];
+    int n = we_vm_run(vm_, wasm_path.c_str(), func.c_str(), raw.data(),
+                      static_cast<int>(raw.size()), out, 16);
+    return values(n, out);
+  }
+
+  // WASI command mode: run _start, return the guest's exit code
+  // (the reference CLI's command-mode semantics, wasmedger.cpp:223-236).
+  Result<int> run_wasi_command(const std::string &wasm_path) {
+    we_value out[1];
+    int n = we_vm_run(vm_, wasm_path.c_str(), "_start", nullptr, 0, out, 1);
+    if (we_vm_wasi_has_exited(vm_))  // proc_exit unwinds as a "trap"
+      return we_vm_wasi_exit_code(vm_);
+    if (n < 0) return last_error(n);  // genuine trap / setup failure
+    return 0;                         // _start returned normally
+  }
+
+  // Exported function names of the instantiated module.
+  Result<std::vector<std::string>> function_list() {
+    int n = we_vm_function_list(vm_, nullptr, 0);
+    if (n < 0) return last_error(n);
+    std::vector<char *> raw(static_cast<size_t>(n), nullptr);
+    we_vm_function_list(vm_, raw.data(), n);
+    std::vector<std::string> out;
+    for (char *p : raw) {
+      out.emplace_back(p ? p : "");
+      std::free(p);
+    }
+    return out;
+  }
+
+  // Register a module file under an import namespace.
+  Result<bool> register_module(const std::string &name,
+                               const std::string &wasm_path) {
+    return unit(we_vm_register_file(vm_, name.c_str(), wasm_path.c_str()));
+  }
+
+ private:
+  void reset() {
+    if (vm_) we_vm_delete(vm_);
+    vm_ = nullptr;
+  }
+  static std::vector<const char *> c_strv(const std::vector<std::string> &v) {
+    std::vector<const char *> out;
+    for (const auto &s : v) out.push_back(s.c_str());
+    out.push_back(nullptr);
+    return out;
+  }
+  Result<bool> unit(int rc) {
+    if (rc < 0) return last_error(rc);
+    return true;
+  }
+  Result<std::vector<Value>> values(int n, const we_value *out) {
+    if (n < 0) return last_error(n);
+    std::vector<Value> vals;
+    for (int i = 0; i < n && i < 16; i++)
+      vals.push_back(Value::from_raw(out[i]));
+    return vals;
+  }
+
+  we_vm *vm_ = nullptr;
+};
+
+// -- AOT compiler -----------------------------------------------------------
+
+class Compiler {
+ public:
+  // wasm -> universal twasm (tpu.aot section), the reference's
+  // wasmedgec analog.
+  static Result<bool> compile(const std::string &in_path,
+                              const std::string &out_path) {
+    int rc = we_compile(in_path.c_str(), out_path.c_str());
+    if (rc < 0) return last_error(rc);
+    return true;
+  }
+};
+
+}  // namespace wetpu
+
+#endif  // WASMEDGE_TPU_HPP

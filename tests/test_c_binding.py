@@ -56,3 +56,52 @@ def test_c_example_runs_fib(tmp_path):
                          text=True, env=env, timeout=300)
     assert run.returncode == 0, (run.stdout, run.stderr)
     assert "fib(24) = 46368" in run.stdout
+
+
+def test_cpp_sdk_fib_and_wasi(tmp_path):
+    """The typed C++ SDK (bindings/cpp) out of process: staged fib with
+    typed values + error mapping, and a WASI command program with argv
+    and an exit code — the wasmedge-sdk analog over the C shim
+    (reference: bindings/rust/wasmedge-sdk/src/vm.rs)."""
+    cxx = shutil.which("c++") or shutil.which("g++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    includes = _python_config("--includes")
+    embed = subprocess.run([_config_tool(), "--embed", "--ldflags"],
+                           capture_output=True, text=True)
+    ldflags = embed.stdout.split() if embed.returncode == 0 \
+        else _python_config("--ldflags")
+    cppdir = os.path.join(ROOT, "bindings", "cpp")
+    exe = tmp_path / "example_sdk"
+    build = subprocess.run(
+        [cxx, "-std=c++17", os.path.join(cppdir, "example_sdk.cc"),
+         os.path.join(CDIR, "shim.c"), "-I", CDIR, "-o", str(exe)]
+        + includes + ldflags,
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+
+    from wasmedge_tpu.models import build_fib
+    from wasmedge_tpu.utils.wat import parse_wat
+
+    wasm = tmp_path / "fib.wasm"
+    wasm.write_bytes(build_fib())
+    # WASI guest: exit code = number of argv entries * 10
+    wasi_wat = """(module
+      (import "wasi_snapshot_preview1" "args_sizes_get"
+        (func $sizes (param i32 i32) (result i32)))
+      (import "wasi_snapshot_preview1" "proc_exit"
+        (func $exit (param i32)))
+      (memory (export "memory") 1)
+      (func (export "_start")
+        (drop (call $sizes (i32.const 0) (i32.const 4)))
+        (call $exit (i32.mul (i32.load (i32.const 0)) (i32.const 10)))))"""
+    wasi = tmp_path / "guest.wasm"
+    wasi.write_bytes(parse_wat(wasi_wat))
+    env = dict(os.environ, WASMEDGE_TPU_PYROOT=ROOT)
+    run = subprocess.run(
+        [str(exe), str(wasm), "20", str(wasi), "30"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert run.returncode == 0, (run.stdout, run.stderr)
+    assert "fib=6765" in run.stdout
+    assert "wasi-exit=30 want=30" in run.stdout
+    assert "SDK OK" in run.stdout

@@ -290,17 +290,22 @@ def test_vm_batch_weighted_cost_table_gas():
 
 def _capi_spec_callbacks(conf=None):
     vm = C.we_VMCreate(conf)
+    bytes_of = {}  # handle -> module bytes (register replays them)
 
     def on_module(name, data):
         if name:
             res = C.we_VMRegisterModuleFromBuffer(vm, name.lstrip("$"), data)
             _raise(res)
-            return ("named", name.lstrip("$"))
+            h = ("named", name.lstrip("$"))
+            bytes_of[h] = data
+            return h
         res = C.we_VMLoadWasmFromBuffer(vm, data)
         _raise(res)
         _raise(C.we_VMValidate(vm))
         _raise(C.we_VMInstantiate(vm))
-        return ("active", None)
+        h = ("active", None)
+        bytes_of[h] = data
+        return h
 
     def _raise(res):
         if not C.we_ResultOK(res):
@@ -316,7 +321,7 @@ def _capi_spec_callbacks(conf=None):
 
     def on_invoke(handle, field, raw_args):
         kind, name = handle
-        params = [C.we_Value("i64", a) for a in raw_args]
+        params = [C.we_Value("raw", a) for a in raw_args]
         if kind == "named":
             res, out = C.we_VMExecuteRegistered(vm, name, field, params)
         else:
@@ -325,9 +330,14 @@ def _capi_spec_callbacks(conf=None):
         return [v.raw for v in out]
 
     def on_register(handle, as_name):
-        # modules are registered at definition; wast `register` of the
-        # active module is not needed by our corpus
-        raise TrapError(ErrCode.FuncNotFound, "register unsupported in capi seam")
+        # replay the module bytes under the new namespace (the C API has
+        # no alias-an-instance entry; state-aliasing register chains are
+        # covered by the scalar harness)
+        data = bytes_of.get(handle)
+        if data is None:
+            raise TrapError(ErrCode.FuncNotFound,
+                            "register of unknown module")
+        _raise(C.we_VMRegisterModuleFromBuffer(vm, as_name, data))
 
     return SpecTest(on_module, on_invoke, on_register)
 

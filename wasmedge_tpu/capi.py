@@ -44,6 +44,7 @@ from wasmedge_tpu.common.errors import (
 )
 from wasmedge_tpu.common.statistics import Statistics
 from wasmedge_tpu.common.types import (
+    ValType,
     bits_to_typed,
     typed_to_bits,
     MASK32,
@@ -467,12 +468,23 @@ def we_ImportObjectCreateWASI(dirs=None, args=None, envs=None):
     return w
 
 
-def we_ImportObjectInitWASI(wasi, dirs=None, args=None, envs=None) -> None:
-    wasi.init_wasi(dirs=dirs, args=args, envs=envs)
+def we_ImportObjectInitWASI(wasi, dirs=None, args=None, envs=None,
+                            prog_name=None) -> None:
+    if prog_name is None:
+        wasi.init_wasi(dirs=dirs, args=args, envs=envs)
+    else:
+        wasi.init_wasi(dirs=dirs, prog_name=prog_name, args=args,
+                       envs=envs)
 
 
 def we_ImportObjectWASIGetExitCode(wasi) -> int:
     return wasi.exit_code
+
+
+def we_ImportObjectWASIHasExited(wasi) -> bool:
+    """True only after the guest called proc_exit (distinguishes
+    proc_exit(0) from never-exited; the C shim's wasi command mode)."""
+    return bool(getattr(wasi.env, "exited", False))
 
 
 def we_ImportObjectCreateWasmEdgeProcess(allowed_cmds=None, allow_all=False):
@@ -537,25 +549,38 @@ def we_VMInstantiate(ctx) -> we_Result:
     return _wrap(lambda: ctx.vm.instantiate())[0]
 
 
-def _typed_args(params: Sequence[we_Value]) -> List[int]:
-    return [p.raw for p in params]
+_VALTYPE_NAME = {ValType.I32: "i32", ValType.I64: "i64",
+                 ValType.F32: "f32", ValType.F64: "f64",
+                 ValType.V128: "v128", ValType.FuncRef: "funcref",
+                 ValType.ExternRef: "externref"}
 
 
-def _vm_exec_raw(ctx, func_name, raw_args, module_name=None):
+def _vm_exec_raw(ctx, func_name, params, module_name=None):
     vm = ctx.vm
     with vm._lock:
         fi = vm._find_function(func_name, module_name)
-    if len(raw_args) != len(fi.functype.params):
+    if len(params) != len(fi.functype.params):
         raise TrapError(ErrCode.FuncSigMismatch,
                         f"expected {len(fi.functype.params)} args, "
-                        f"got {len(raw_args)}")
-    cells = vm.executor.invoke_raw(vm.store, fi, list(raw_args))
+                        f"got {len(params)}")
+    # param TYPES are checked like the reference front door
+    # (lib/executor/executor.cpp:87-97), not just arity.  type "raw"
+    # (or None) marks an untyped 64-bit cell — the spec-harness /
+    # cells-convenience channel — and skips the check.
+    for i, (p, want) in enumerate(zip(params, fi.functype.params)):
+        ty = getattr(p, "type", None)
+        if ty not in (None, "raw") and ty != _VALTYPE_NAME.get(want, ty):
+            raise TrapError(
+                ErrCode.FuncSigMismatch,
+                f"arg {i}: expected {_VALTYPE_NAME.get(want)}, got {ty}")
+    cells = vm.executor.invoke_raw(vm.store, fi,
+                                   [p.raw for p in params])
     return fi.functype.results, cells
 
 
 def we_VMExecute(ctx, func_name: str, params: Sequence[we_Value] = ()):
     res, out = _wrap(
-        lambda: _vm_exec_raw(ctx, func_name, _typed_args(params)))
+        lambda: _vm_exec_raw(ctx, func_name, list(params)))
     if not we_ResultOK(res):
         return res, []
     types, cells = out
@@ -565,7 +590,7 @@ def we_VMExecute(ctx, func_name: str, params: Sequence[we_Value] = ()):
 def we_VMExecuteRegistered(ctx, mod_name: str, func_name: str,
                            params: Sequence[we_Value] = ()):
     res, out = _wrap(lambda: _vm_exec_raw(
-        ctx, func_name, _typed_args(params), module_name=mod_name))
+        ctx, func_name, list(params), module_name=mod_name))
     if not we_ResultOK(res):
         return res, []
     types, cells = out
@@ -583,6 +608,37 @@ def we_VMRunWasmFromBuffer(ctx, data: bytes, func_name: str,
     r = we_VMInstantiate(ctx)
     if not we_ResultOK(r):
         return r, []
+    return we_VMExecute(ctx, func_name, params)
+
+
+def we_VMRunWasmFromFileCells(ctx, path: str, func_name: str,
+                              cells: Sequence[int]):
+    """FFI convenience (the C shim's run_i64): raw 64-bit cells coerced
+    to the function's declared parameter types, then the strict typed
+    execute.  we_VMExecute itself stays reference-strict
+    (lib/executor/executor.cpp:87-97)."""
+    for step in (lambda: we_VMLoadWasmFromFile(ctx, path),
+                 lambda: we_VMValidate(ctx),
+                 lambda: we_VMInstantiate(ctx)):
+        r = step()
+        if not we_ResultOK(r):
+            return r, []
+
+    def build():
+        vm = ctx.vm
+        with vm._lock:
+            fi = vm._find_function(func_name)
+        if len(cells) != len(fi.functype.params):
+            raise TrapError(ErrCode.FuncSigMismatch,
+                            f"expected {len(fi.functype.params)} args, "
+                            f"got {len(cells)}")
+        return [we_Value(_VALTYPE_NAME.get(want, "i64"),
+                         int(c) & MASK64)
+                for c, want in zip(cells, fi.functype.params)]
+
+    res, params = _wrap(build)
+    if not we_ResultOK(res):
+        return res, []
     return we_VMExecute(ctx, func_name, params)
 
 

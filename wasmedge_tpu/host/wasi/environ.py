@@ -87,6 +87,7 @@ class WasiEnviron:
         self.envs: List[str] = []
         self.fds: Dict[int, FdEntry] = {}
         self.exit_code: int = 0
+        self.exited: bool = False
         self._next_fd = 3
 
     # -- lifecycle (environ.h init/fini) -----------------------------------
@@ -110,6 +111,7 @@ class WasiEnviron:
         }
         self._next_fd = 3
         self.exit_code = 0
+        self.exited = False
         for spec in dirs or []:
             guest, sep, host = spec.partition(":")
             if not sep:

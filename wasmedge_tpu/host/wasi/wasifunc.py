@@ -814,6 +814,7 @@ def poll_oneoff(env: WasiEnviron, mem, in_ptr, out_ptr, nsubs, nevents_ptr):
 @wasi_fn("proc_exit", "i", "")
 def proc_exit(env: WasiEnviron, mem, code):
     env.exit_code = code & MASK32
+    env.exited = True
     raise WasiExit(env.exit_code)
 
 
