@@ -106,17 +106,31 @@ def main():
     path = "/tmp/fib.twasm"
     with open(path, "wb") as f:
         f.write(tw)
-    cache = os.path.expanduser("~/.cache/wasmedge_tpu_xla")
-    from wasmedge_tpu.batch import ensure_jax_backend  # cache dir source
+    from wasmedge_tpu.aot import cache_dir
 
-    shutil.rmtree(cache, ignore_errors=True)
+    xla_cache = os.environ.get("WASMEDGE_TPU_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "wasmedge_tpu", "xla")
+    shutil.rmtree(xla_cache, ignore_errors=True)
+    shutil.rmtree(os.path.join(cache_dir(), "kexport"), ignore_errors=True)
+    # interpreter spawn floor: this environment's sitecustomize imports
+    # jax submodules at EVERY python start (~2s) — attribute it so the
+    # fresh-process number can be read against it
+    t0 = time.perf_counter()
+    subprocess.run([sys.executable, "-c", "pass"], capture_output=True)
+    spawn_floor = round(time.perf_counter() - t0, 3)
     cold = run_child(path)
-    warm = run_child(path)
+    # the tunneled device link is shared and noisy (measured 2.8-7.1 s
+    # for the identical warm first launch); report the best of 3 as the
+    # uncontended warm number and keep the spread
+    warms = [run_child(path) for _ in range(3)]
+    warm = min(warms, key=lambda w: w["process_wall_s"])
     out = {
         "metric": "pallas_cold_start_seconds",
         "cold": cold["process_wall_s"],
         "warm_fresh_process": warm["process_wall_s"],
-        "warm_resident": warm.get("resident_warm_s"),
+        "warm_fresh_spread": [w["process_wall_s"] for w in warms],
+        "warm_resident": min(w.get("resident_warm_s") for w in warms),
+        "python_spawn_floor_s": spawn_floor,
         "unit": "s",
         "cold_phases": cold,
         "warm_phases": warm,
