@@ -21,3 +21,30 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--fast", action="store_true", default=False,
+        help="run only the fast subset (skip the slow marked suites)")
+
+
+def pytest_collection_modifyitems(config, items):
+    """`pytest --fast` deselects the slow suites (full spec corpus,
+    opcode-exhaustive parity sweeps, SIMD batch matrix, multichip mesh
+    drives) — an iteration loop in ~minutes instead of the >60-minute
+    nightly wall.  The slow suites stay the default so `python -m
+    pytest tests/ -x -q` remains the full bar."""
+    if not config.getoption("--fast"):
+        return
+    import pytest as _pytest
+
+    slow_files = {
+        "test_spec.py", "test_batch_parity.py", "test_batch_simd.py",
+        "test_pallas_engine.py", "test_pallas_hbm.py", "test_optimistic.py",
+        "test_mesh.py", "test_scheduler.py", "test_simd.py",
+    }
+    skip = _pytest.mark.skip(reason="slow suite (run without --fast)")
+    for item in items:
+        if item.fspath.basename in slow_files:
+            item.add_marker(skip)
