@@ -23,28 +23,41 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: minutes-scale suite, skipped by --fast")
+
+
 def pytest_addoption(parser):
     parser.addoption(
         "--fast", action="store_true", default=False,
-        help="run only the fast subset (skip the slow marked suites)")
+        help="run only the fast subset (skip @pytest.mark.slow suites)")
+
+
+# Known minutes-scale suites are auto-marked slow so --fast works
+# without touching each file; NEW slow files should carry
+# `pytestmark = pytest.mark.slow` themselves (the marker is the
+# mechanism, this list is back-compat).
+_SLOW_FILES = {
+    "test_spec.py", "test_batch_parity.py", "test_batch_simd.py",
+    "test_pallas_engine.py", "test_pallas_hbm.py", "test_optimistic.py",
+    "test_mesh.py", "test_scheduler.py", "test_simd.py",
+}
 
 
 def pytest_collection_modifyitems(config, items):
-    """`pytest --fast` deselects the slow suites (full spec corpus,
-    opcode-exhaustive parity sweeps, SIMD batch matrix, multichip mesh
-    drives) — an iteration loop in ~minutes instead of the >60-minute
-    nightly wall.  The slow suites stay the default so `python -m
-    pytest tests/ -x -q` remains the full bar."""
-    if not config.getoption("--fast"):
-        return
+    """`pytest --fast` (or `-m "not slow"`) skips the slow suites —
+    an iteration loop in ~minutes instead of the >60-minute nightly
+    wall.  The slow suites stay the default so `python -m pytest
+    tests/ -x -q` remains the full bar."""
     import pytest as _pytest
 
-    slow_files = {
-        "test_spec.py", "test_batch_parity.py", "test_batch_simd.py",
-        "test_pallas_engine.py", "test_pallas_hbm.py", "test_optimistic.py",
-        "test_mesh.py", "test_scheduler.py", "test_simd.py",
-    }
+    for item in items:
+        if item.fspath.basename in _SLOW_FILES:
+            item.add_marker(_pytest.mark.slow)
+    if not config.getoption("--fast"):
+        return
     skip = _pytest.mark.skip(reason="slow suite (run without --fast)")
     for item in items:
-        if item.fspath.basename in slow_files:
+        if "slow" in item.keywords:
             item.add_marker(skip)

@@ -642,7 +642,7 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
                   block_shapes: tuple = (),
                   simd: bool = False, NV: int = 1,
                   optimistic: bool = False, snap_steps: int = 8192,
-                  shadow_full: bool = None):
+                  shadow_full: bool = None, hid_weights: tuple = ()):
     """Compile the chunk-runner for one kernel geometry.
 
     Returns a jitted callable over
@@ -3555,18 +3555,29 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
         handlers = [handler_for(h) for h in used_hids]
 
         def dispatch(hid, c):
-            """Balanced binary tree of lax.cond over the dense handler
-            ids.  Mosaic lowers lax.switch to a LINEAR if-chain (~15ns
-            per position walked — measured 124ns at index 0 vs 1056ns
-            at index 63 of a 64-way switch), so a module with many
-            live handlers paid hundreds of ns per dispatch just
-            scanning.  The tree makes dispatch ~log2(N) branches,
-            uniform across ids (measured ~150-190ns for 64 handlers,
-            bit-exact vs lax.switch)."""
+            """Weight-balanced binary tree of lax.cond over the dense
+            handler ids.  Mosaic lowers lax.switch to a LINEAR if-chain
+            (~15ns per position walked), so the tree keeps dispatch at
+            ~log branches; splitting on cumulative STATIC OPCODE
+            FREQUENCY instead of id count puts the handlers that
+            actually run at shallow depth (expected depth approaches
+            the hid distribution's entropy — a concatenated
+            multi-tenant image with dozens of live handlers gains the
+            most).  Bit-exact vs lax.switch; plain midpoint split when
+            no weights are known."""
+            w = list(hid_weights) if hid_weights else [1] * len(handlers)
+
             def tree(lo, hi):
                 if hi - lo == 1:
                     return handlers[lo](c)
-                mid = (lo + hi) // 2
+                total = sum(w[lo:hi])
+                best_mid, best_bal, acc = lo + 1, None, 0
+                for m in range(lo + 1, hi):
+                    acc += w[m - 1]
+                    bal = abs(2 * acc - total)
+                    if best_bal is None or bal < best_bal:
+                        best_bal, best_mid = bal, m
+                mid = best_mid
                 return lax.cond(hid < mid,
                                 lambda: tree(lo, mid),
                                 lambda: tree(mid, hi))
@@ -3879,7 +3890,17 @@ class PallasUniformEngine:
     # Optimistic-convergence commit interval: dispatches between canary
     # validations/snapshots.  Bounds both the validation amortization
     # and the worst-case replay a rollback hands the careful kernel.
-    SNAP_STEPS = 8192
+    # Snapshot cadence of the optimistic kernel.  Measured r05 (one
+    # v5e chip, 4096 lanes): raising 8192 -> 131072 moved flagship
+    # fib(30) 56 -> ~70-74G instr/s and the memory-heavy mix 29 -> 49G
+    # (snapshot DMA was ~25% of wall), with the divergent mix flat.
+    # Worst case a block that ran clean past its FIRST short window
+    # (512 steps — genuinely divergent blocks diverge inside it) and
+    # diverges late discards + carefully re-executes up to this many
+    # steps ONCE (~0.2 s at 4096 lanes); its per-block interval then
+    # halves adaptively (careful_recheck) down to _SNAP_MIN, so
+    # repeated rollbacks are geometrically cheaper.
+    SNAP_STEPS = 131072
 
     def __init__(self, inst, store=None, conf=None, lanes=None, mesh=None,
                  interpret=None, simt=None):
@@ -4052,6 +4073,11 @@ class PallasUniformEngine:
         used = tuple(sorted(set(int(h) for h in hid)))
         dense = {h: i for i, h in enumerate(used)}
         hid_dense = np.asarray([dense[int(h)] for h in hid], np.int32)
+        # static frequency of each dense handler id: the dispatch tree
+        # splits on cumulative weight, so hot handlers sit shallow
+        self._hid_weights = tuple(
+            int(c) for c in np.bincount(hid_dense,
+                                        minlength=len(used)))
         # host-side view of the fused encoding: the block scheduler's
         # divergence splitter evaluates the stopped instruction from
         # these.  _np_hid_orig is the UNfused plane: a block whose
@@ -4087,7 +4113,8 @@ class PallasUniformEngine:
             lambda: _build_kernel(*self._kargs,
                                   optimistic=self.optimistic,
                                   snap_steps=self.SNAP_STEPS,
-                                  shadow_full=self.optimistic))
+                                  shadow_full=self.optimistic,
+                                  hid_weights=self._hid_weights))
         self._fn_careful_cache = None if self.optimistic else self._fn
 
     def _export_cache_key(self):
@@ -4198,7 +4225,8 @@ class PallasUniformEngine:
         if self._fn_careful_cache is None:
             self._fn_careful_cache = _build_kernel(
                 *self._kargs, optimistic=False,
-                snap_steps=self.SNAP_STEPS, shadow_full=self.optimistic)
+                snap_steps=self.SNAP_STEPS, shadow_full=self.optimistic,
+                hid_weights=self._hid_weights)
         return self._fn_careful_cache
 
     def shadow_planes(self):
