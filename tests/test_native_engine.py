@@ -213,3 +213,82 @@ def test_simd_module_falls_back():
     out = ex.invoke(store, inst.find_func("f"), [7])
     assert out == [7]
     assert "unsupported op" in (ex.native_fallback_reason or "")
+
+
+def test_native_table_mutation_and_persistence():
+    """r05: the C++ loop runs the table family in-loop (reference
+    tableInstr.cpp) and mutations persist on the instance across
+    invokes and across ENGINES (scalar <-> native interleave)."""
+    import numpy as np  # noqa: F401
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.native import NativeModule
+    from wasmedge_tpu.utils.wat import parse_wat
+    from tests.helpers import instantiate
+
+    wat = """(module (table 4 8 funcref)
+      (func $a (result i32) (i32.const 7))
+      (elem $e func $a)
+      (func (export "go") (result i32)
+        (table.init $e (i32.const 1) (i32.const 0) (i32.const 1))
+        (table.set (i32.const 2) (ref.func $a))
+        (drop (table.grow (ref.null func) (i32.const 2)))
+        (i32.add (i32.mul (table.size) (i32.const 100))
+                 (call_indirect (result i32) (i32.const 2)))))"""
+    conf = Configure()
+    ex, st, inst = instantiate(parse_wat(wat), conf)
+    nm = NativeModule(inst, st)
+    assert nm.eligible, nm.reason
+    go = inst.exports["go"][1]
+    assert nm.invoke(go, [])[0] == [607]          # size 4 -> 6
+    assert ex.invoke_raw(st, inst.find_func("go"), []) == [807]  # 6 -> 8
+    assert nm.invoke(go, [])[0] == [807]          # grow at max fails
+    # elem.drop persistence: init after drop traps on both engines
+    wat2 = """(module (table 2 funcref)
+      (func $a (result i32) (i32.const 1))
+      (elem $e func $a)
+      (func (export "drop") (elem.drop $e))
+      (func (export "init")
+        (table.init $e (i32.const 0) (i32.const 0) (i32.const 1))))"""
+    ex2, st2, in2 = instantiate(parse_wat(wat2), conf)
+    nm2 = NativeModule(in2, st2)
+    assert nm2.eligible, nm2.reason
+    nm2.invoke(in2.exports["drop"][1], [])
+    from wasmedge_tpu.common.errors import ErrCode, TrapError
+    import pytest as _pytest
+    with _pytest.raises(TrapError) as e1:
+        nm2.invoke(in2.exports["init"][1], [])
+    assert e1.value.code == ErrCode.TableOutOfBounds
+    with _pytest.raises(TrapError) as e2:
+        ex2.invoke_raw(st2, in2.find_func("init"), [])
+    assert e2.value.code == ErrCode.TableOutOfBounds
+
+
+def test_native_tail_calls_deep():
+    """return_call frame replacement in C++: depth far beyond the frame
+    array, plus return_call_indirect through the table."""
+    from wasmedge_tpu.common.configure import Configure, Proposal
+    from wasmedge_tpu.native import NativeModule
+    from wasmedge_tpu.utils.wat import parse_wat
+    from tests.helpers import instantiate
+
+    wat = """(module
+      (table 1 funcref)
+      (type $t (func (param i32 i64) (result i64)))
+      (func $sum (type $t)
+        (if (result i64) (i32.eqz (local.get 0))
+          (then (local.get 1))
+          (else (return_call_indirect (type $t)
+            (i32.sub (local.get 0) (i32.const 1))
+            (i64.add (local.get 1) (i64.extend_i32_u (local.get 0)))
+            (i32.const 0)))))
+      (elem (i32.const 0) $sum)
+      (func (export "go") (param i32) (result i64)
+        (return_call $sum (local.get 0) (i64.const 0))))"""
+    conf = Configure()
+    conf.add_proposal(Proposal.TailCall)
+    ex, st, inst = instantiate(parse_wat(wat), conf)
+    nm = NativeModule(inst, st)
+    assert nm.eligible, nm.reason
+    n = 200_000  # >> max_call_depth: only O(1) frames completes this
+    out, retired = nm.invoke(inst.exports["go"][1], [n], max_call_depth=512)
+    assert out[0] == n * (n + 1) // 2

@@ -56,6 +56,7 @@ _ERR_EXPORTS = {
     "E_CallStackExhausted": ErrCode.CallStackExhausted,
     "E_StackOverflow": ErrCode.StackOverflow,
     "E_ExecutionFailed": ErrCode.ExecutionFailed,
+    "E_TableOOB": ErrCode.TableOutOfBounds,
 }
 
 
@@ -115,7 +116,9 @@ def _build_lib():
         i32p,                                           # br_table
         i32p, i32p, i32p, i32p, i32p, i32p, ctypes.c_int32,  # func metas
         i32p,                                           # typeid_of_type
-        i32p, ctypes.c_int32,                           # table
+        i32p, i32p, ctypes.c_int32,                     # table/size/cap
+        i32p, i32p, i32p, ctypes.c_int32, u8p,          # elem segs + drop
+        u8p, i32p, i32p, ctypes.c_int32, u8p,           # data segs + drop
         u64p,                                           # globals
         u8p, ctypes.c_int32, ctypes.c_int32,            # mem, cur/max pages
         ctypes.c_int32, u64p, ctypes.c_int32, u64p,     # func, args, results
@@ -159,6 +162,7 @@ class NativeModule:
 
     def __init__(self, inst, store=None):
         self.inst = inst
+        self.store = store  # funcref handle resolution + write-back
         self.reason: Optional[str] = None
         self._membuf = None  # cached memory transfer buffer
         self._prep(inst, store)
@@ -269,7 +273,7 @@ class NativeModule:
                 p32(self.brt), p32(self.f_entry), p32(self.f_nparams),
                 p32(self.f_nlocals), p32(self.f_nresults), p32(self.f_ftop),
                 p32(self.f_typeid), len(self.f_entry),
-                p32(self.typeid_of_type), p32(self.table), len(self.table))
+                p32(self.typeid_of_type))
 
     def invoke(self, func_idx: int, raw_args: List[int],
                max_call_depth: int = 2048,
@@ -315,8 +319,78 @@ class NativeModule:
         if stop_cell is None:
             stop_cell = np.zeros(1, np.int32)
 
+        # Mutable table + segment state, rebuilt per invoke from the
+        # instance (the scalar engine persists mutations across invokes;
+        # so must this one) and written back after.  Capacity: declared
+        # max when present, else a 64k-headroom growth window (growth
+        # beyond it returns -1, which the spec allows at any size).
+        u8p_ = u8p
+        func_index = {id(f): i for i, f in enumerate(inst.funcs)}
+
+        def to_handle_plane(refs):
+            out = np.zeros(max(len(refs), 1), np.int32)
+            for i, h in enumerate(refs):
+                if h == 0:
+                    continue
+                fi = store.deref_func(h) if store is not None else None
+                idx = func_index.get(id(fi)) if fi is not None else None
+                if idx is None:
+                    raise RuntimeError("non-local funcref in table/elem")
+            # second pass fills (first pass validated)
+            for i, h in enumerate(refs):
+                if h:
+                    out[i] = func_index[id(store.deref_func(h))] + 1
+            return out
+
+        store = self.store
+        if inst.tables:
+            t0 = inst.tables[0]
+            tsize0 = t0.size
+            tcap = t0.max if t0.max is not None else tsize0 + 65536
+            tcap = max(tcap, tsize0)
+            tbl = np.zeros(max(tcap, 1), np.int32)
+            tbl[:tsize0] = to_handle_plane(t0.refs)[:tsize0] \
+                if tsize0 else tbl[:0]
+        else:
+            tsize0, tcap = 0, 0
+            tbl = np.zeros(1, np.int32)
+        tsize_io = np.asarray([tsize0], np.int32)
+        esegs = inst.elems
+        eoff = np.zeros(max(len(esegs), 1), np.int32)
+        elen = np.zeros(max(len(esegs), 1), np.int32)
+        eflat_parts = []
+        acc = 0
+        for i, seg in enumerate(esegs):
+            eoff[i] = acc
+            elen[i] = len(seg.refs)
+            eflat_parts.append(to_handle_plane(seg.refs)[:len(seg.refs)])
+            acc += len(seg.refs)
+        eflat = np.concatenate(eflat_parts) if acc else np.zeros(1, np.int32)
+        edrop = np.zeros(max(len(esegs), 1), np.uint8)
+        for i, seg in enumerate(esegs):
+            if not seg.refs:
+                edrop[i] = 1  # dropped (or empty) segment: length 0
+        dsegs = inst.datas
+        doff = np.zeros(max(len(dsegs), 1), np.int32)
+        dlen = np.zeros(max(len(dsegs), 1), np.int32)
+        dacc = bytearray()
+        for i, seg in enumerate(dsegs):
+            doff[i] = len(dacc)
+            dlen[i] = len(seg.data)
+            dacc.extend(seg.data)
+        dflat = np.frombuffer(bytes(dacc) or b"\0", np.uint8).copy()
+        ddrop = np.zeros(max(len(dsegs), 1), np.uint8)
+
         rc = lib.we_native_invoke(
             *self._img_args(lib),
+            tbl.ctypes.data_as(i32p), tsize_io.ctypes.data_as(i32p),
+            int(tcap),
+            eflat.ctypes.data_as(i32p), eoff.ctypes.data_as(i32p),
+            elen.ctypes.data_as(i32p), len(esegs),
+            edrop.ctypes.data_as(u8p_),
+            dflat.ctypes.data_as(u8p_), doff.ctypes.data_as(i32p),
+            dlen.ctypes.data_as(i32p), len(dsegs),
+            ddrop.ctypes.data_as(u8p_),
             glob.ctypes.data_as(u64p),
             buf.ctypes.data_as(u8p), cur_pages, max_pages,
             func_idx, args.ctypes.data_as(u64p), len(raw_args),
@@ -334,6 +408,23 @@ class NativeModule:
             m = inst.memories[0]
             nbytes = int(out_pages[0]) * 65536
             m.data[:] = buf[:nbytes].tobytes()
+        if inst.tables:
+            t0 = inst.tables[0]
+            ns = int(tsize_io[0])
+            new_refs = []
+            for i in range(ns):
+                h = int(tbl[i])
+                new_refs.append(
+                    0 if h == 0 else
+                    (store.intern_ref(inst.funcs[h - 1])
+                     if store is not None else h))
+            t0.refs = new_refs
+        for i, seg in enumerate(esegs):
+            if edrop[i] and seg.refs:
+                seg.clear()
+        for i, seg in enumerate(dsegs):
+            if ddrop[i] and seg.data:
+                seg.clear()
         if rc != 0:
             raise TrapError(ErrCode(rc))
         return [int(results[i]) for i in range(meta.nresults)], int(retired[0])
@@ -364,7 +455,10 @@ def scalar_fib_ops_per_sec(n: int) -> float:
     # best of three: the baseline is "one dedicated CPU core"; taking
     # the max keeps the denominator honest when the host is busy (a
     # slow contended run would otherwise inflate every vs_baseline)
-    ops = max(lib.we_native_selfbench(*nm._img_args(lib), func_idx, n)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    tbl = nm.table.ctypes.data_as(i32p)
+    ops = max(lib.we_native_selfbench(*nm._img_args(lib), tbl,
+                                      len(nm.table), func_idx, n)
               for _ in range(3))
     if ops <= 0:
         raise RuntimeError("native selfbench failed")

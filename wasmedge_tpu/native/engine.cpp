@@ -9,12 +9,17 @@
 // are bit-exact with executor/numeric.py (NaN canonicalization on float
 // arithmetic, trapping truncation bounds, masked shifts, trunc division).
 //
-// Scope: the full scalar ISA (i32/i64/f32/f64 numerics + control + memory)
-// for single-module, no-host-import execution.  SIMD, table mutation,
-// cross-module calls and host functions stay on the Python engine — the
-// ctypes wrapper (native/__init__.py) gates eligibility and falls back,
-// the same graceful degradation the reference applies to mismatched AOT
-// sections (lib/loader/ast/module.cpp:279-326).
+// Scope: the full scalar ISA (i32/i64/f32/f64 numerics + control +
+// memory), the table/segment families (get/set/size/grow/fill/copy/init,
+// elem.drop, memory.init/data.drop — reference tableInstr.cpp) and tail
+// calls (frame replacement, stackmgr.h:80-98), for single-module,
+// single-table, no-host-import execution.  SIMD, cross-module calls and
+// host functions stay on the Python engine — the ctypes wrapper
+// (native/__init__.py) gates eligibility from this file's own `case`
+// labels and falls back, the same graceful degradation the reference
+// applies to mismatched AOT sections (lib/loader/ast/module.cpp:279-326).
+// Table/segment mutations write back to the instance, so invokes
+// interleave with the other engines without state divergence.
 //
 // Opcode ids come from gen_opcodes.h, generated from the Python opcode
 // table at build time so the two sides can never drift.
@@ -77,7 +82,16 @@ extern "C" int32_t we_native_invoke(
     const int32_t* brt, const int32_t* f_entry, const int32_t* f_nparams,
     const int32_t* f_nlocals, const int32_t* f_nresults,
     const int32_t* f_ftop, const int32_t* f_typeid, int32_t nf,
-    const int32_t* typeid_of_type, const int32_t* table, int32_t tsize,
+    const int32_t* typeid_of_type,
+    // table 0: mutable entries + size (funcidx+1 handles, 0 = null);
+    // tcap bounds table.grow (declared max clamped by the wrapper)
+    int32_t* table, int32_t* tsize_io, int32_t tcap,
+    // passive segments for table.init / memory.init; drop flags are
+    // written back so segment drops persist on the instance
+    const int32_t* elem_flat, const int32_t* elem_off,
+    const int32_t* elem_len, int32_t n_eseg, uint8_t* edrop,
+    const uint8_t* data_flat, const int32_t* data_off,
+    const int32_t* data_len, int32_t n_dseg, uint8_t* ddrop,
     // mutable instance state
     cell* globals, uint8_t* mem, int32_t cur_pages, int32_t max_pages,
     // invocation
@@ -88,6 +102,7 @@ extern "C" int32_t we_native_invoke(
     int64_t* retired_out, int32_t* out_pages) {
   int32_t trapcode = 0;
   int64_t retired = 0;
+  int32_t tsize = tsize_io ? *tsize_io : 0;
   cell* st = new cell[max_value_stack];
   Frame* frames = new Frame[max_call_depth + 2];
   int64_t sp = 0;  // next free slot
@@ -301,10 +316,13 @@ extern "C" int32_t we_native_invoke(
         break;
       }
       case OP_call:
-      case OP_call_indirect: {
+      case OP_call_indirect:
+      case OP_return_call:
+      case OP_return_call_indirect: {
         CHECK_STOP();
+        bool tail = (op == OP_return_call || op == OP_return_call_indirect);
         int32_t callee;
-        if (op == OP_call) {
+        if (op == OP_call || op == OP_return_call) {
           callee = aa[pc];
         } else {
           uint32_t i = (uint32_t)POP();
@@ -315,9 +333,24 @@ extern "C" int32_t we_native_invoke(
           if (f_typeid[callee] != typeid_of_type[aa[pc]])
             TRAP(E_IndirectCallTypeMismatch);
         }
-        if (depth >= max_call_depth) TRAP(E_CallStackExhausted);
         int32_t cn = f_nparams[callee];
         int32_t cl = f_nlocals[callee];
+        if (tail) {
+          // frame REPLACEMENT (reference StackManager tail-call path,
+          // include/runtime/stackmgr.h:80-98): args slide onto the
+          // caller's frame base, depth unchanged — O(1) frames for
+          // arbitrarily deep tail recursion.  Ascending copy is
+          // overlap-safe: src base sp-cn >= opbase >= fp.
+          if (fp + cl + (int64_t)f_ftop[callee] > max_value_stack)
+            TRAP(E_StackOverflow);
+          for (int32_t k = 0; k < cn; k++) st[fp + k] = st[sp - cn + k];
+          sp = fp + cn;
+          for (int32_t k = cn; k < cl; k++) st[sp++] = 0;
+          opbase = fp + cl;
+          pc = f_entry[callee];
+          break;
+        }
+        if (depth >= max_call_depth) TRAP(E_CallStackExhausted);
         frames[depth].ret_pc = pc + 1;
         frames[depth].fp = fp;
         frames[depth].opbase = opbase;
@@ -332,6 +365,104 @@ extern "C" int32_t we_native_invoke(
         pc = f_entry[callee];
         break;
       }
+
+      // ---- tables / segments (r05; reference tableInstr.cpp) --------
+      case OP_ref_func:
+        PUSH((cell)(uint32_t)(aa[pc] + 1));
+        pc++;
+        break;
+      case OP_table_get: {
+        uint32_t i = (uint32_t)POP();
+        if (i >= (uint32_t)tsize) TRAP(E_TableOOB);
+        PUSH((cell)(uint32_t)table[i]);
+        pc++;
+        break;
+      }
+      case OP_table_set: {
+        cell v = POP();
+        uint32_t i = (uint32_t)POP();
+        if (i >= (uint32_t)tsize) TRAP(E_TableOOB);
+        table[i] = (int32_t)(uint32_t)v;
+        pc++;
+        break;
+      }
+      case OP_table_size:
+        PUSH((cell)(uint32_t)tsize);
+        pc++;
+        break;
+      case OP_table_grow: {
+        uint32_t delta = (uint32_t)POP();
+        cell init = POP();
+        uint64_t ns = (uint64_t)(uint32_t)tsize + delta;
+        if (ns > (uint64_t)(uint32_t)tcap) {
+          PUSH((cell)(uint32_t)(int32_t)-1);
+        } else {
+          for (uint32_t k = 0; k < delta; k++)
+            table[tsize + (int32_t)k] = (int32_t)(uint32_t)init;
+          PUSH((cell)(uint32_t)tsize);
+          tsize = (int32_t)ns;
+        }
+        pc++;
+        break;
+      }
+      case OP_table_fill: {
+        uint32_t n = (uint32_t)POP();
+        cell v = POP();
+        uint32_t i = (uint32_t)POP();
+        if ((uint64_t)i + n > (uint64_t)(uint32_t)tsize) TRAP(E_TableOOB);
+        for (uint32_t k = 0; k < n; k++)
+          table[i + k] = (int32_t)(uint32_t)v;
+        pc++;
+        break;
+      }
+      case OP_table_copy: {
+        uint32_t n = (uint32_t)POP();
+        uint32_t src = (uint32_t)POP();
+        uint32_t dst = (uint32_t)POP();
+        if ((uint64_t)src + n > (uint64_t)(uint32_t)tsize ||
+            (uint64_t)dst + n > (uint64_t)(uint32_t)tsize)
+          TRAP(E_TableOOB);
+        std::memmove(table + dst, table + src, (size_t)n * 4);
+        pc++;
+        break;
+      }
+      case OP_table_init: {
+        uint32_t n = (uint32_t)POP();
+        uint32_t src = (uint32_t)POP();
+        uint32_t dst = (uint32_t)POP();
+        int32_t seg = aa[pc];
+        uint32_t slen =
+            (seg < n_eseg && !edrop[seg]) ? (uint32_t)elem_len[seg] : 0u;
+        if ((uint64_t)src + n > slen ||
+            (uint64_t)dst + n > (uint64_t)(uint32_t)tsize)
+          TRAP(E_TableOOB);
+        std::memcpy(table + dst, elem_flat + elem_off[seg] + src,
+                    (size_t)n * 4);
+        pc++;
+        break;
+      }
+      case OP_elem_drop:
+        if (aa[pc] < n_eseg) edrop[aa[pc]] = 1;
+        pc++;
+        break;
+      case OP_memory_init: {
+        uint32_t n = (uint32_t)POP();
+        uint32_t src = (uint32_t)POP();
+        uint32_t dst = (uint32_t)POP();
+        int32_t seg = aa[pc];
+        uint32_t slen =
+            (seg < n_dseg && !ddrop[seg]) ? (uint32_t)data_len[seg] : 0u;
+        if ((uint64_t)src + n > slen ||
+            (uint64_t)dst + n > (uint64_t)MEM_BYTES)
+          TRAP(E_MemoryOOB);
+        std::memcpy(mem + dst, data_flat + data_off[seg] + src, n);
+        pc++;
+        break;
+      }
+      case OP_data_drop:
+        if (aa[pc] < n_dseg) ddrop[aa[pc]] = 1;
+        pc++;
+        break;
 
       // ---- memory ---------------------------------------------------
       case OP_i32_load: {
@@ -813,6 +944,7 @@ extern "C" int32_t we_native_invoke(
 done:
   *retired_out = retired;
   *out_pages = cur_pages;
+  if (tsize_io) *tsize_io = tsize;
   delete[] st;
   delete[] frames;
   return trapcode;
@@ -836,10 +968,17 @@ extern "C" double we_native_selfbench(
   int64_t retired = 0;
   int32_t out_pages = 0;
   uint8_t dummy_mem[8] = {0};
+  int32_t tbl_copy[64];
+  int32_t nt = tsize < 64 ? tsize : 64;
+  for (int32_t i = 0; i < nt; i++) tbl_copy[i] = table[i];
+  int32_t ts_io = nt;
+  uint8_t no_drop[1] = {0};
   auto t0 = std::chrono::steady_clock::now();
   int32_t rc = we_native_invoke(
       ops, aa, bb, cc, imm, code_len, brt, f_entry, f_nparams, f_nlocals,
-      f_nresults, f_ftop, f_typeid, nf, typeid_of_type, table, tsize,
+      f_nresults, f_ftop, f_typeid, nf, typeid_of_type, tbl_copy, &ts_io,
+      nt, nullptr, nullptr, nullptr, 0, no_drop, nullptr, nullptr, nullptr,
+      0, no_drop,
       nullptr, dummy_mem, 0, 0, func_idx, args, 1, results, 8192, 1 << 20,
       nullptr, &retired, &out_pages);
   auto t1 = std::chrono::steady_clock::now();
