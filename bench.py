@@ -94,15 +94,22 @@ def main():
     base_ops, base_src = _native_baseline_ops()
     vs = agg_ops / (TARGET_MULTIPLE * base_ops)
 
+    engine = "pallas" if getattr(eng, "pallas", None) is not None else "xla"
     out = {
         "metric": f"aggregate_wasm_ops_per_sec_fib{FIB_N}_x{LANES}",
         "value": round(agg_ops, 1),
         "unit": "wasm_instr/s",
         "vs_baseline": round(vs, 4),
+        "engine": engine,
+        "steps": int(res.steps),
+        "wall_s": round(dt, 3),
+        "baseline_ops_per_sec": round(base_ops, 1),
+        "baseline_source": base_src,
     }
-    print(json.dumps(out))
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "BENCH_r06.json")
     # extra context on stderr (driver only parses stdout JSON)
-    engine = "pallas" if getattr(eng, "pallas", None) is not None else "xla"
     print(f"# engine={engine} lanes={LANES} steps={res.steps} wall={dt:.2f}s "
           f"retired_total={total_retired:.3g} baseline={base_ops:.3g} "
           f"({base_src}) target={TARGET_MULTIPLE}x", file=sys.stderr)

@@ -13,8 +13,30 @@ staging, /root/reference/include/vm/vm.h:241):
 
 __version__ = "0.1.0"
 
+# Import-tax discipline: this module (and everything it pulls in) must
+# stay free of jax/jaxlib/numpy so `import wasmedge_tpu` and the
+# scalar/native CLI paths never pay the JAX import tax (~1s of the
+# AOT_r05 python_spawn_floor).  Heavy entry points are exposed lazily
+# below; tests/test_spawn_time.py asserts the invariant in a fresh
+# interpreter.
 from wasmedge_tpu.common.configure import Configure, EngineKind
 from wasmedge_tpu.common.errors import ErrCode, TrapError, WasmError
+
+_LAZY = {
+    "VM": ("wasmedge_tpu.vm", "VM"),
+    "make_engine": ("wasmedge_tpu.batch", "make_engine"),
+    "WasiModule": ("wasmedge_tpu.host.wasi", "WasiModule"),
+}
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target[0]), target[1])
+
 
 __all__ = [
     "Configure",
@@ -22,4 +44,7 @@ __all__ = [
     "ErrCode",
     "TrapError",
     "WasmError",
+    "VM",
+    "make_engine",
+    "WasiModule",
 ]

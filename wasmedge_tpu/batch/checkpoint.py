@@ -130,6 +130,23 @@ def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:
             raise ValueError(
                 "checkpoint refused: engine image uses table/segment "
                 f"families but checkpoint lacks planes {missing}")
+        # r06 tier-0 hostcall planes: an engine that services tier-0
+        # in-kernel traces against t0_ctr (and so_buf/so_off when
+        # fd_write buffering is on) — a pre-r06 checkpoint must be
+        # refused cleanly, not crash at trace time
+        t0kinds = getattr(engine, "_t0kinds", None)
+        if t0kinds is not None:
+            from wasmedge_tpu.batch.image import T0_FD_WRITE
+
+            want = ["t0_ctr"]
+            if (t0kinds == T0_FD_WRITE).any():
+                want += ["so_buf", "so_off"]
+            missing = [n for n in want if fields.get(n) is None]
+            if missing:
+                raise ValueError(
+                    "checkpoint refused: engine services tier-0 "
+                    f"hostcalls but checkpoint lacks planes {missing} "
+                    "(pre-r06 checkpoint?)")
         _validate_planes(fields, engine)
     return BatchState(**fields), meta["total_steps"]
 

@@ -123,6 +123,16 @@ class BatchState(NamedTuple):
     tsize: object = None   # [lanes] per-lane table size (table.grow)
     edrop: object = None   # [n_elem_segs, lanes] dropped flags
     ddrop: object = None   # [n_data_segs, lanes] dropped flags
+    # r06 tier-0 hostcall planes (three-tier pipeline, batch/hostcall.py).
+    # The read-only per-launch time base is NOT a state field: it rides
+    # the jitted chunk as a separate non-donated argument (an identity-
+    # passthrough donated leaf miscompiles under the persistent
+    # compilation cache on the CPU backend).
+    t0_time: object = None  # reserved (always None; see note above)
+    t0_ctr: object = None   # [4, lanes] int32: clock seq / rng seq /
+    #                         fd_write count / yield+exit count
+    so_buf: object = None   # [SW, lanes] int32 stdout record buffer
+    so_off: object = None   # [lanes] int32 next free word in so_buf
 
 
 @dataclasses.dataclass
@@ -177,7 +187,132 @@ def r05_state_planes(img: DeviceImage, lanes: int) -> dict:
     return out
 
 
-def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
+# ---------------------------------------------------------------------------
+# tier-0 hostcalls: pure WASI calls serviced inside the kernel
+# ---------------------------------------------------------------------------
+T0_CTR_ROWS = 4  # clock seq / rng seq / fd_write count / yield+exit count
+
+
+def new_hostcall_stats() -> dict:
+    """Per-run hostcall pipeline counters (reset by BatchEngine.run):
+    tier0_* are in-kernel retirements (zero device<->host round trips),
+    tier1_calls is lanes drained through the outcall channel, and
+    serve_rounds counts park->drain->re-arm cycles (each one is at
+    least one device<->host round trip)."""
+    return {"tier0_clock": 0, "tier0_random": 0, "tier0_fd_write": 0,
+            "tier0_sys": 0, "tier0_calls": 0,
+            "tier1_calls": 0, "tier1_vectorized": 0, "serve_rounds": 0,
+            "stdout_flushes": 0, "stdout_bytes": 0}
+
+
+def t0_effective_kinds(img: DeviceImage, cfg) -> Optional[np.ndarray]:
+    """Per-pc tier-0 kinds this image+config will service in-kernel, or
+    None when tier 0 is entirely off (no recognized stubs, knob off, or
+    a concatenated multi-tenant image that carries no t0kind plane)."""
+    from wasmedge_tpu.batch.image import (
+        T0_CLOCK_TIME_GET, T0_FD_WRITE, T0_RANDOM_GET)
+
+    kinds = getattr(img, "t0kind", None)
+    if kinds is None or not getattr(cfg, "tier0_hostcalls", True):
+        return None
+    kinds = np.asarray(kinds, np.int32).copy()
+    if not getattr(img, "t0_fdwrite_safe", False):
+        kinds[kinds == T0_FD_WRITE] = 0
+    if not img.has_memory:
+        # clock/random/fd_write all write through guest memory
+        kinds[np.isin(kinds, (T0_CLOCK_TIME_GET, T0_RANDOM_GET,
+                              T0_FD_WRITE))] = 0
+    if not (kinds != 0).any():
+        return None
+    return kinds
+
+
+def t0_statics(cfg) -> dict:
+    """Shared tier-0 kernel constants — ONE source for the SIMT and
+    uniform engines (the random_get stream must stay bit-identical
+    across a divergence handoff; errnos mirror host/wasi/wasi_abi)."""
+    from wasmedge_tpu.host.wasi.wasi_abi import Errno
+
+    seed = getattr(cfg, "rng_seed", None)
+    if seed is None:
+        # fresh entropy, drawn ONCE per Configure so every engine built
+        # from it (SIMT + uniform fast path) shares the same stream
+        seed = getattr(cfg, "_rng_seed_drawn", None)
+        if seed is None:
+            import os
+
+            seed = int.from_bytes(os.urandom(4), "little")
+            cfg._rng_seed_drawn = seed
+    return {
+        "RMAX_W": max(int(getattr(cfg, "tier0_random_max", 64)), 4) // 4,
+        "WMAX_W": max(int(getattr(cfg, "tier0_write_max", 256)), 4) // 4,
+        "RNG_SEED": np.array(seed & 0xFFFFFFFF, np.uint32).view(np.int32),
+        "E_INVAL": int(Errno.INVAL),
+        "E_FAULT": int(Errno.FAULT),
+    }
+
+
+def t0_prng32(x):
+    """Counter-PRNG avalanche (int32 xorshift-multiply) behind tier-0
+    random_get, deterministic per (cfg.rng_seed, lane, call seq, word)."""
+    from jax import lax
+
+    x = x ^ lax.shift_right_logical(x, 16)
+    x = x * np.int32(0x7FEB352D)
+    x = x ^ lax.shift_right_logical(x, 15)
+    x = x * np.int32(np.uint32(0x846CA68B))
+    x = x ^ lax.shift_right_logical(x, 16)
+    return x
+
+
+def t0_word_mix(j: int) -> np.ndarray:
+    """Per-word whitening constant of the tier-0 random stream."""
+    return np.array((j * 0x27220A95) & 0xFFFFFFFF, np.uint32).view(np.int32)
+
+
+def t0_time_planes() -> np.ndarray:
+    """Per-relaunch time base: (realtime, monotonic) ns as int32 (lo, hi).
+
+    In-kernel clock_time_get returns base + per-lane call seq, so values
+    are strictly increasing per lane even within one launch window."""
+    import time
+
+    out = np.zeros((2, 2), np.int32)
+    for r, ns in enumerate((time.time_ns(), time.monotonic_ns())):
+        out[r, 0] = np.int32(np.uint32(ns & 0xFFFFFFFF))
+        out[r, 1] = np.int32(np.uint32((ns >> 32) & 0xFFFFFFFF))
+    return out
+
+
+def t0_state_planes(img: DeviceImage, cfg, lanes: int,
+                    kinds: Optional[np.ndarray]) -> dict:
+    """Initial tier-0 planes for a BatchState; {} when tier 0 is off.
+    `kinds` is the owning engine's gated kind plane (engine._t0kinds).
+    Shared by every BatchState constructor (engine, uniform/pallas
+    handoffs, scheduler residue)."""
+    import jax.numpy as jnp
+
+    from wasmedge_tpu.batch.image import T0_FD_WRITE
+
+    if kinds is None:
+        return {}
+    # NOTE t0_time is deliberately NOT part of the state: it is a
+    # read-only per-launch input threaded as a separate (non-donated)
+    # argument into the jitted chunk — an identity-passthrough donated
+    # leaf miscompiles under the persistent compilation cache on jax's
+    # CPU backend (deserialized executables lose the input/output alias)
+    out = {
+        "t0_ctr": jnp.zeros((T0_CTR_ROWS, lanes), jnp.int32),
+    }
+    if (kinds == T0_FD_WRITE).any():
+        sw = max(int(getattr(cfg, "stdout_buffer_words", 2048)), 16)
+        out["so_buf"] = jnp.zeros((sw, lanes), jnp.int32)
+        out["so_off"] = jnp.zeros((lanes,), jnp.int32)
+    return out
+
+
+def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
+               t0kinds: Optional[np.ndarray] = None):
     """Build the jittable single-step function closed over image constants."""
     import jax
     import jax.numpy as jnp
@@ -290,7 +425,33 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
     from wasmedge_tpu.batch.image import ALU1_SUB as _A1S
     _HEAVY_ALU1 = {_A1S["f64.sqrt"]}
 
-    def step(st: BatchState) -> BatchState:
+    # ---- tier-0 hostcall statics (three-tier pipeline) ----
+    from wasmedge_tpu.batch.image import (
+        T0_CLOCK_TIME_GET, T0_FD_WRITE, T0_PROC_EXIT, T0_RANDOM_GET,
+        T0_SCHED_YIELD)
+
+    t0k = t0kinds
+    HAS_T0 = t0k is not None
+    if HAS_T0:
+        t0k_t = jnp.asarray(np.asarray(t0k, np.int32))
+        USE_T0_CLOCK = bool((t0k == T0_CLOCK_TIME_GET).any())
+        USE_T0_RANDOM = bool((t0k == T0_RANDOM_GET).any())
+        USE_T0_YIELD = bool((t0k == T0_SCHED_YIELD).any())
+        USE_T0_EXIT = bool((t0k == T0_PROC_EXIT).any())
+        USE_T0_FDW = bool((t0k == T0_FD_WRITE).any())
+        _t0s = t0_statics(cfg)
+        RMAX_W = _t0s["RMAX_W"]
+        WMAX_W = _t0s["WMAX_W"]
+        RNG_SEED = jnp.asarray(_t0s["RNG_SEED"])
+        _E_INVAL = _t0s["E_INVAL"]
+        _E_FAULT = _t0s["E_FAULT"]
+        prng32 = t0_prng32
+
+    def step(st: BatchState, t0_time=None) -> BatchState:
+        """One lockstep instruction.  `t0_time` is the [2, 2] int32
+        per-launch time base (read-only; threaded as a separate argument
+        so the donated state never carries an identity-passthrough
+        leaf — see t0_state_planes)."""
         active = st.trap == 0
         pc = jnp.clip(st.pc, 0, img.code_len - 1)
         cls = cls_t[pc]
@@ -656,38 +817,55 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         nw1 = (mw1 & ~sm1) | (sv1 & sm1)
         nw2 = (mw2 & ~sm2) | (sv2 & sm2)
         store_ok = active & is_store & ~mem_oob
-        mem_plane = st.mem
-        mem_plane = scat(mem_plane, widx, nw0, store_ok & (sm0 != 0))
-        mem_plane = scat(mem_plane, widx + 1, nw1, store_ok & (sm1 != 0))
-        mem_plane = scat(mem_plane, widx + 2, nw2, store_ok & (sm2 != 0))
+
+        def run_stores(mp):
+            mp = scat(mp, widx, nw0, store_ok & (sm0 != 0))
+            mp = scat(mp, widx + 1, nw1, store_ok & (sm1 != 0))
+            mp = scat(mp, widx + 2, nw2, store_ok & (sm2 != 0))
+            return mp
+
+        # any-lane conditional: steps where no lane stores skip the
+        # plane scatters entirely (lockstep batches spend most steps in
+        # compute; an unconditional masked scatter still walks the
+        # plane on the CPU backend)
+        mem_plane = lax.cond(jnp.any(store_ok), run_stores,
+                             lambda m: m, st.mem)
 
         # ------ bulk memory: fill / copy (full-plane masked ops, run
         # under an any-lane conditional since they rewrite [W, lanes]) ---
-        is_fill = is_cls[CLS_MEMFILL]
-        is_copy = is_cls[CLS_MEMCOPY]
-        is_bulk = is_fill | is_copy
-        # operands (top of stack): fill = dst,val,n / copy = dst,src,n
-        bulk_n = v0_lo
-        bulk_b = v1_lo            # fill value / copy src
-        bulk_dst = v2_lo
-        mem_bytes_v = st.mem_pages * jnp.int32(65536)
-        bulk_end = bulk_dst + bulk_n
-        src_end = bulk_b + bulk_n
-        bulk_oob = is_bulk & active & (
-            u_lt(bulk_end, bulk_dst) | u_lt(mem_bytes_v, bulk_end)
-            | (is_copy & (u_lt(src_end, bulk_b)
-                          | u_lt(mem_bytes_v, src_end))))
-        bulk_go = is_bulk & active & ~bulk_oob & (bulk_n != 0)
+        # compiled only when the image contains bulk ops: the any-lane
+        # lax.cond costs a full-plane pass-through on the CPU backend,
+        # which a module without memory.fill/copy must never pay
+        HAS_BULK = bool(np.isin(img.cls, (CLS_MEMFILL, CLS_MEMCOPY)).any())
+        if HAS_BULK:
+            is_fill = is_cls[CLS_MEMFILL]
+            is_copy = is_cls[CLS_MEMCOPY]
+            is_bulk = is_fill | is_copy
+            # operands (top of stack): fill = dst,val,n / copy = dst,src,n
+            bulk_n = v0_lo
+            bulk_b = v1_lo            # fill value / copy src
+            bulk_dst = v2_lo
+            mem_bytes_v = st.mem_pages * jnp.int32(65536)
+            bulk_end = bulk_dst + bulk_n
+            src_end = bulk_b + bulk_n
+            bulk_oob = is_bulk & active & (
+                u_lt(bulk_end, bulk_dst) | u_lt(mem_bytes_v, bulk_end)
+                | (is_copy & (u_lt(src_end, bulk_b)
+                              | u_lt(mem_bytes_v, src_end))))
+            bulk_go = is_bulk & active & ~bulk_oob & (bulk_n != 0)
 
-        uses_copy = bool((img.cls == CLS_MEMCOPY).any())
+            uses_copy = bool((img.cls == CLS_MEMCOPY).any())
 
-        def run_bulk(mem_in):
-            return lo_ops.plane_fill_copy(
-                mem_in, bulk_dst, bulk_end, bulk_b, bulk_go,
-                copy_lanes=is_copy if uses_copy else None)
+            def run_bulk(mem_in):
+                return lo_ops.plane_fill_copy(
+                    mem_in, bulk_dst, bulk_end, bulk_b, bulk_go,
+                    copy_lanes=is_copy if uses_copy else None)
 
-        mem_plane = lax.cond(jnp.any(bulk_go), run_bulk,
-                             lambda m: m, mem_plane)
+            mem_plane = lax.cond(jnp.any(bulk_go), run_bulk,
+                                 lambda m: m, mem_plane)
+        else:
+            is_bulk = jnp.bool_(False) & (cls == cls)
+            bulk_oob = is_bulk
 
         # =================== v128 (SIMD) ===================
         # cells are 4 int32 planes; ops come from batch/simdops.py and
@@ -960,6 +1138,200 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             tgrow_res = zl
             tsize_l = b
 
+        # =================== tier-0 hostcalls ===================
+        # Pure WASI calls retired inside the kernel: the lane executes
+        # its HOSTCALL stub like any other instruction (result pushed at
+        # opbase, pc+1 to the stub's RETURN) instead of parking for the
+        # device->host outcall channel.  Unhandled shapes (cputime
+        # clocks, oversized buffers, full stdout buffer, foreign fds)
+        # keep the parking path below.
+        t0_push = jnp.bool_(False) & (cls == cls)   # retire with a result
+        t0_exit = jnp.bool_(False) & (cls == cls)   # proc_exit lanes
+        t0_val = zl                                  # pushed cell (errno)
+        t0_ctr_p = st.t0_ctr
+        so_buf_p = st.so_buf
+        so_off_p = st.so_off
+        if HAS_T0:
+            k0 = t0k_t[pc]
+            is_hc = is_cls[CLS_HOSTCALL] & active
+            arg0 = gat(st.stack_lo, fp)
+            arg1 = gat(st.stack_lo, fp + 1)
+            arg2 = gat(st.stack_lo, fp + 2)
+            arg3 = gat(st.stack_lo, fp + 3)
+            ctr_clk = st.t0_ctr[0]
+            ctr_rng = st.t0_ctr[1]
+            ctr_fdw = st.t0_ctr[2]
+            ctr_sys = st.t0_ctr[3]
+
+            def t0_store(plane, ea, v_lo, v_hi, nbytes_c, m):
+                """Masked little-endian store of nbytes_c (4/8, static)
+                at per-lane byte address ea (bounds checked by caller)."""
+                widx0 = lax.shift_right_logical(ea, 2)
+                shB0 = (ea & 3) * 8
+                f_lo = jnp.full_like(ea, jnp.int32(-1))
+                f_hi = jnp.full_like(
+                    ea, jnp.int32(-1) if nbytes_c == 8 else jnp.int32(0))
+                tm0, tm1 = lo_ops.shl64(f_lo, f_hi, shB0)
+                tm2 = jnp.where(shB0 == 0, 0,
+                                lo_ops.shr64_u(f_lo, f_hi, 64 - shB0)[0])
+                ts0, ts1 = lo_ops.shl64(v_lo, v_hi, shB0)
+                ts2 = jnp.where(shB0 == 0, 0,
+                                lo_ops.shr64_u(v_lo, v_hi, 64 - shB0)[0])
+                out = plane
+                for kk, (mm, vv) in enumerate(
+                        ((tm0, ts0), (tm1, ts1), (tm2, ts2))):
+                    cur = gat(out, widx0 + kk)
+                    out = scat(out, widx0 + kk, (cur & ~mm) | (vv & mm),
+                               m & (mm != 0))
+                return out
+
+            if USE_T0_CLOCK:
+                m_clk = is_hc & (k0 == T0_CLOCK_TIME_GET)
+                cid = arg0
+                tptr = arg2
+                bad_id = u_lt(jnp.int32(3), cid)       # unsigned id > 3
+                hard_id = (cid == 2) | (cid == 3)      # cputime: tier 1
+                tend = tptr + 8
+                c_oob = u_lt(tend, tptr) | u_lt(mem_bytes, tend)
+                base_lo = jnp.where(cid == 1, t0_time[1, 0],
+                                    t0_time[0, 0])
+                base_hi = jnp.where(cid == 1, t0_time[1, 1],
+                                    t0_time[0, 1])
+                tv_lo, tv_hi = lo_ops.add64(base_lo, base_hi, ctr_clk,
+                                            jnp.zeros_like(ctr_clk))
+                ok_c = m_clk & ~bad_id & ~hard_id
+                wr_c = ok_c & ~c_oob
+                mem_plane = lax.cond(
+                    jnp.any(wr_c),
+                    lambda mp: t0_store(mp, tptr, tv_lo, tv_hi, 8, wr_c),
+                    lambda mp: mp, mem_plane)
+                done_c = m_clk & ~hard_id
+                res_c = jnp.where(bad_id, jnp.int32(_E_INVAL),
+                                  jnp.where(c_oob, jnp.int32(_E_FAULT), 0))
+                t0_push = t0_push | done_c
+                t0_val = jnp.where(done_c, res_c, t0_val)
+                t0_ctr_p = t0_ctr_p.at[0].set(
+                    jnp.where(wr_c, ctr_clk + 1, ctr_clk))
+
+            if USE_T0_RANDOM:
+                m_rnd = is_hc & (k0 == T0_RANDOM_GET)
+                rbuf, rlen = arg0, arg1
+                fits_r = ~u_lt(jnp.int32(RMAX_W * 4), rlen)
+                rend = rbuf + rlen
+                r_oob = u_lt(rend, rbuf) | u_lt(mem_bytes, rend)
+                ok_r = m_rnd & fits_r
+                wr_r = ok_r & ~r_oob & (rlen != 0)
+                shB_r = (rbuf & 3) * 8
+                inv_r = (32 - shB_r) & 31
+                hi_or_r = jnp.where(shB_r == 0, 0, -1)
+                w0_r = lax.shift_right_logical(rbuf, 2)
+                lane_h = prng32(RNG_SEED ^ ((lane_iota + 1)
+                                            * jnp.int32(-1640531527)))
+                seq_h = lane_h ^ (ctr_rng * np.int32(np.uint32(0x85EBCA6B)))
+
+                def run_rand(mp):
+                    out = mp
+                    prev_pw = jnp.zeros_like(rbuf)
+                    for j in range(RMAX_W + 1):
+                        pw = prng32(seq_h ^ jnp.asarray(t0_word_mix(j))) \
+                            if j < RMAX_W else jnp.zeros_like(rbuf)
+                        val = lax.shift_left(pw, shB_r) | \
+                            (lax.shift_right_logical(prev_pw, inv_r)
+                             & hi_or_r)
+                        mk = zl
+                        for bpos in range(4):
+                            ba = (w0_r + j) * 4 + bpos
+                            inr = ~u_lt(ba, rbuf) & u_lt(ba, rend)
+                            mk = mk | jnp.where(
+                                inr, jnp.int32(lo_ops.BYTE_MASKS[bpos]), 0)
+                        cur = gat(out, w0_r + j)
+                        out = scat(out, w0_r + j,
+                                   (cur & ~mk) | (val & mk),
+                                   wr_r & (mk != 0))
+                        prev_pw = pw
+                    return out
+
+                mem_plane = lax.cond(jnp.any(wr_r), run_rand,
+                                     lambda mp: mp, mem_plane)
+                res_r = jnp.where(r_oob, jnp.int32(_E_FAULT), 0)
+                t0_push = t0_push | ok_r
+                t0_val = jnp.where(ok_r, res_r, t0_val)
+                t0_ctr_p = t0_ctr_p.at[1].set(
+                    jnp.where(wr_r, ctr_rng + 1, ctr_rng))
+
+            if USE_T0_FDW:
+                m_fdw = is_hc & (k0 == T0_FD_WRITE)
+                wfd, wiovs, wcnt, wnp = arg0, arg1, arg2, arg3
+                SW = so_buf_p.shape[0]
+                iov_end = wiovs + 8
+                iov_ok = ~(u_lt(iov_end, wiovs) | u_lt(mem_bytes, iov_end))
+                iw = lax.shift_right_logical(wiovs, 2)
+                wbuf = gat(mem_plane, iw)
+                wlen = gat(mem_plane, iw + 1)
+                fits_w = ~u_lt(jnp.int32(WMAX_W * 4), wlen)
+                nwords = lax.shift_right_logical(wlen + 3, 2)
+                space = ~u_lt(jnp.int32(SW), st.so_off + 1 + nwords)
+                npend = wnp + 4
+                np_ok = ~(u_lt(npend, wnp) | u_lt(mem_bytes, npend))
+                handled_w = m_fdw & ((wfd == 1) | (wfd == 2)) \
+                    & (wcnt == 1) & ((wiovs & 3) == 0) & iov_ok \
+                    & fits_w & space & np_ok
+                dend = wbuf + wlen
+                d_oob = u_lt(dend, wbuf) | u_lt(mem_bytes, dend)
+                wr_w = handled_w & ~d_oob
+                shB_w = (wbuf & 3) * 8
+                inv_w = (32 - shB_w) & 31
+                hi_or_w = jnp.where(shB_w == 0, 0, -1)
+                wsrc0 = lax.shift_right_logical(wbuf, 2)
+                mem_snapshot = mem_plane
+
+                def run_fdw(sob):
+                    # record: header (fd << 28 | len), then len bytes
+                    # padded to whole words — always word-aligned in the
+                    # buffer, so only the guest-side source is shifted
+                    hdr = wlen | lax.shift_left(wfd, 28)
+                    sob = scat(sob, st.so_off, hdr, wr_w)
+                    for j in range(WMAX_W):
+                        s0 = gat(mem_snapshot, wsrc0 + j)
+                        s1 = gat(mem_snapshot, wsrc0 + j + 1)
+                        v = lax.shift_right_logical(s0, shB_w) | \
+                            (lax.shift_left(s1, inv_w) & hi_or_w)
+                        sob = scat(sob, st.so_off + 1 + j, v,
+                                   wr_w & (jnp.int32(j * 4) < wlen))
+                    return sob
+
+                so_buf_p = lax.cond(jnp.any(wr_w), run_fdw,
+                                    lambda s: s, so_buf_p)
+                mem_plane = lax.cond(
+                    jnp.any(wr_w),
+                    lambda mp: t0_store(mp, wnp, wlen,
+                                        jnp.zeros_like(wlen), 4, wr_w),
+                    lambda mp: mp, mem_plane)
+                so_off_p = jnp.where(wr_w, st.so_off + 1 + nwords,
+                                     so_off_p)
+                res_w = jnp.where(d_oob, jnp.int32(_E_FAULT), 0)
+                done_w = handled_w
+                t0_push = t0_push | done_w
+                t0_val = jnp.where(done_w, res_w, t0_val)
+                t0_ctr_p = t0_ctr_p.at[2].set(
+                    jnp.where(wr_w, ctr_fdw + 1, ctr_fdw))
+
+            if USE_T0_YIELD:
+                m_yld = is_hc & (k0 == T0_SCHED_YIELD)
+                t0_push = t0_push | m_yld
+                t0_val = jnp.where(m_yld, 0, t0_val)
+                t0_ctr_p = t0_ctr_p.at[3].set(
+                    jnp.where(m_yld, ctr_sys + 1, ctr_sys))
+                ctr_sys = t0_ctr_p[3]
+
+            if USE_T0_EXIT:
+                m_ext = is_hc & (k0 == T0_PROC_EXIT)
+                t0_exit = t0_exit | m_ext
+                # exit code lands in the result slot for the harvester
+                t0_val = jnp.where(m_ext, arg0, t0_val)
+                t0_ctr_p = t0_ctr_p.at[3].set(
+                    jnp.where(m_ext, ctr_sys + 1, ctr_sys))
+
         # =================== branches ===================
         is_br = is_cls[CLS_BR]
         is_brz = is_cls[CLS_BRZ]
@@ -1053,7 +1425,7 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         we2 = zl
         we3 = zl
         does_write = is_const
-        for entry in (
+        write_entries = [
             (is_lget, sp, loc_lo, loc_hi, loc_e2, loc_e3),
             (is_gget, sp, g_lo, g_hi),
             (is_msize, sp, st.mem_pages, jnp.zeros_like(st.mem_pages)),
@@ -1085,7 +1457,13 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             (is_cls[CLS_TABLE_SIZE], sp, tsize_l, jnp.zeros_like(tsize_l)),
             (is_tgrow & (table_trap == 0), sp - 2, tgrow_res,
              jnp.zeros_like(tgrow_res)),
-        ):
+        ]
+        if HAS_T0:
+            # tier-0 retirements push their errno (or proc_exit code) at
+            # the frame's operand base, exactly where the host outcall
+            # serve would have written the result
+            write_entries.append((t0_push | t0_exit, opbase, t0_val, zl))
+        for entry in write_entries:
             m, pos, lo_v, hi_v = entry[0], entry[1], entry[2], entry[3]
             e2_v = entry[4] if len(entry) > 4 else zl
             e3_v = entry[5] if len(entry) > 5 else zl
@@ -1155,6 +1533,7 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         # =================== merge: sp / pc / frames ===================
         new_sp = sp
         for m, v in (
+            (t0_push, opbase + 1),
             (is_const | is_lget | is_gget | is_msize | is_vconst
              | is_cls[CLS_TABLE_SIZE] | is_cls[CLS_REFFUNC], sp + 1),
             (is_cls[CLS_DROP] | is_lset | is_gset | is_alu2 | is_brz
@@ -1191,8 +1570,13 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
         new_trap = trap
         for m, code in (
             (is_cls[CLS_TRAP], a),
-            # park at the stub; the host outcall loop re-arms the lane
-            (is_cls[CLS_HOSTCALL], jnp.int32(TRAP_HOSTCALL)),
+            # park at the stub UNLESS tier 0 retired the call in-kernel;
+            # the host outcall loop re-arms parked lanes
+            (is_cls[CLS_HOSTCALL] & ~t0_push & ~t0_exit,
+             jnp.int32(TRAP_HOSTCALL)),
+            # in-kernel proc_exit: the lane terminates; its exit code
+            # sits in the result slot (stack[opbase])
+            (t0_exit, jnp.int32(int(ErrCode.Terminated))),
             (alu2_trap != 0, alu2_trap),
             (alu1_trap != 0, alu1_trap),
             ((is_load | is_store) & mem_oob,
@@ -1245,6 +1629,11 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int):
             tsize=tsize_p,
             edrop=edrop_p,
             ddrop=ddrop_p,
+            # t0_time stays None in the carried state (it rides the
+            # chunk as a separate non-donated argument)
+            t0_ctr=t0_ctr_p,
+            so_buf=so_buf_p,
+            so_off=so_off_p,
         )
 
     return step
@@ -1270,10 +1659,12 @@ class BatchEngine:
         self.lanes = lanes or cfg.lanes
         self.inst = inst
         self.store = store  # kept for re-deriving engines (scheduler)
+        self.hostcall_stats = new_hostcall_stats()
         if img is not None:
             # share an already-built (and already-normalized) image — the
             # scheduler derives width-variant engines from one module
             self.img = img
+            self._t0kinds = self._t0_gate(t0_effective_kinds(img, cfg))
             self._step = None
             self._run_chunk = None
             return
@@ -1322,8 +1713,34 @@ class BatchEngine:
             if g.type.val_type == ValType.V128:
                 raise ValueError(
                     "module not batchable: v128-typed global")
+        self._t0kinds = self._t0_gate(t0_effective_kinds(self.img, cfg))
         self._step = None
         self._run_chunk = None
+
+    def _t0_gate(self, kinds):
+        """Engine-level tier-0 gating: fd_write buffering additionally
+        requires that the instance's WASI environ has fds 1/2 as plain
+        writable sinks at engine-build time (the image-level gate
+        already excludes modules that could mutate the fd table)."""
+        from wasmedge_tpu.batch.image import T0_FD_WRITE
+
+        if kinds is None or not (kinds == T0_FD_WRITE).any():
+            return kinds
+        from wasmedge_tpu.batch.hostcall import wasi_env_of
+        from wasmedge_tpu.host.wasi.wasi_abi import Rights
+
+        env = wasi_env_of(self)
+        ok = env is not None
+        for fd in (1, 2):
+            e = env.fds.get(fd) if ok else None
+            ok = ok and e is not None and e.kind in ("stdio", "file") \
+                and bool(e.rights_base & Rights.FD_WRITE)
+        if not ok:
+            kinds = kinds.copy()
+            kinds[kinds == T0_FD_WRITE] = 0
+            if not (kinds != 0).any():
+                return None
+        return kinds
 
     @staticmethod
     def _table_snapshot(inst, store):
@@ -1391,31 +1808,42 @@ class BatchEngine:
         import jax.numpy as jnp
         from jax import lax
 
-        step = _make_step(self.img, self.cfg, self.lanes)
+        step = _make_step(self.img, self.cfg, self.lanes,
+                          t0kinds=getattr(self, "_t0kinds", None))
         chunk = self.cfg.steps_per_launch
 
-        def run_chunk(state):
+        def run_chunk(state, t0_time):
             def cond(carry):
                 i, s = carry
                 return (i < chunk) & jnp.any(s.trap == 0)
 
             def body(carry):
                 i, s = carry
-                return i + 1, step(s)
+                return i + 1, step(s, t0_time)
 
             i, state = lax.while_loop(cond, body, (jnp.int32(0), state))
             return i, state
 
+        # jax 0.4.x CPU: an executable deserialized from the persistent
+        # compilation cache can lose input/output aliasing for donated
+        # carries and serve garbage outputs (observed with the r06
+        # tier-0 planes in the carry).  Donation only saves allocator
+        # churn on CPU; keep it for accelerator backends where it keeps
+        # the big planes in place.
+        donate = (0,)
+        if jax.default_backend() == "cpu" and \
+                getattr(jax.config, "jax_compilation_cache_dir", None):
+            donate = ()
         if self.mesh is not None:
             from wasmedge_tpu.parallel.mesh import state_shardings
 
             probe = self.initial_state(0, [])
             shardings = state_shardings(self.mesh, probe)
             self._run_chunk = jax.jit(
-                run_chunk, in_shardings=(shardings,),
-                out_shardings=(None, shardings), donate_argnums=0)
+                run_chunk, in_shardings=(shardings, None),
+                out_shardings=(None, shardings), donate_argnums=donate)
         else:
-            self._run_chunk = jax.jit(run_chunk, donate_argnums=0)
+            self._run_chunk = jax.jit(run_chunk, donate_argnums=donate)
         self._step = step
 
     def initial_state(self, func_idx: int, args_lanes: List[np.ndarray]):
@@ -1466,6 +1894,8 @@ class BatchEngine:
             stack_e2=jnp.zeros((D, L), jnp.int32) if img.has_simd else None,
             stack_e3=jnp.zeros((D, L), jnp.int32) if img.has_simd else None,
             **r05_state_planes(img, L),
+            **t0_state_planes(img, cfg, L,
+                              kinds=getattr(self, "_t0kinds", None)),
         )
 
     def run(self, func_name: str, args_lanes: List[np.ndarray],
@@ -1483,6 +1913,7 @@ class BatchEngine:
                 "(lane args are 64-bit cells)")
         if self._run_chunk is None:
             self._build()
+        self.hostcall_stats = new_hostcall_stats()
         state = self.initial_state(func_idx, args_lanes)
         if self.mesh is not None:
             from wasmedge_tpu.parallel.mesh import shard_batch_state
@@ -1514,12 +1945,22 @@ class BatchEngine:
         """Chunk loop from an arbitrary state (used directly and by the
         uniform/pallas engines\' divergence handoff), serving host
         outcalls between chunks (batch/hostcall.py)."""
-        from wasmedge_tpu.batch.hostcall import serve_batch_state
+        import jax.numpy as jnp
+
+        from wasmedge_tpu.batch.hostcall import (
+            flush_stdout_buffers, serve_batch_state)
 
         if self._run_chunk is None:
             self._build()
+        t0_active = state.t0_ctr is not None
+        if t0_active:
+            ctr_in = np.asarray(state.t0_ctr, np.int64).sum(axis=1)
+        dummy_time = np.zeros((2, 2), np.int32)
         while total < max_steps:
-            done_steps, state = self._run_chunk(state)
+            # per-relaunch time base: host->device only, no round trip
+            # (rides the launch as a non-donated argument)
+            tt = jnp.asarray(t0_time_planes() if t0_active else dummy_time)
+            done_steps, state = self._run_chunk(state, tt)
             total += int(done_steps)
             trap_host = np.asarray(state.trap)
             if (trap_host == TRAP_HOSTCALL).any():
@@ -1535,4 +1976,13 @@ class BatchEngine:
         # running when max_steps ran out"), the documented semantic.
         if (np.asarray(state.trap) == TRAP_HOSTCALL).any():
             state = serve_batch_state(self, state)
+        state = flush_stdout_buffers(self, state)
+        if t0_active:
+            ctr = np.asarray(state.t0_ctr, np.int64).sum(axis=1) - ctr_in
+            st_ = self.hostcall_stats
+            st_["tier0_clock"] += int(ctr[0])
+            st_["tier0_random"] += int(ctr[1])
+            st_["tier0_fd_write"] += int(ctr[2])
+            st_["tier0_sys"] += int(ctr[3])
+            st_["tier0_calls"] += int(ctr.sum())
         return state, total

@@ -29,6 +29,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from wasmedge_tpu.common.errors import ErrCode, TrapError
+from wasmedge_tpu.host.wasi.environ import WasiExit
 from wasmedge_tpu.runtime.instance import MemoryInstance
 
 MASK32 = 0xFFFFFFFF
@@ -75,70 +76,233 @@ def serve_one(fi, args_cells: List[int],
         return out, 0
     except TrapError as te:
         return [], int(te.code)
+    except WasiExit:
+        # proc_exit through the per-lane path: the lane terminates
+        # (vectorized groups go through vec_proc_exit instead)
+        return [], int(ErrCode.Terminated)
+
+
+def wasi_env_of(engine):
+    """The instance's WasiEnviron, found through any registered WASI
+    host function (per-tenant instances carry per-tenant environs)."""
+    inst = getattr(engine, "inst", None)
+    for f in getattr(inst, "funcs", None) or []:
+        if getattr(f, "kind", None) == "host":
+            env = getattr(getattr(f, "host", None), "_env", None)
+            if env is not None and hasattr(env, "get_fd"):
+                return env
+    return None
+
+
+def vec_impl_for(fi):
+    """(vectorized_fn, environ) for a WASI host function with a tier-1
+    SoA implementation, else (None, None)."""
+    host = getattr(fi, "host", None)
+    env = getattr(host, "_env", None)
+    if env is None or not hasattr(env, "get_fd"):
+        return None, None
+    from wasmedge_tpu.host.wasi.vectorized import VEC_WASI
+
+    return VEC_WASI.get(getattr(host, "name", None)), env
+
+
+def gather_arg_cells(stack_lo, stack_hi, fp, lanes, nargs) -> np.ndarray:
+    """Raw 64-bit argument cells [nargs, n] for a lane group (one fancy
+    gather, no per-lane loop)."""
+    n = int(lanes.size)
+    if nargs == 0:
+        return np.zeros((0, n), np.int64)
+    rows = np.asarray(fp[lanes], np.int64)[None, :] + \
+        np.arange(nargs, dtype=np.int64)[:, None]
+    lo = stack_lo[rows, lanes[None, :]].view(np.uint32).astype(np.uint64)
+    hi = stack_hi[rows, lanes[None, :]].view(np.uint32).astype(np.uint64)
+    return (lo | (hi << np.uint64(32))).view(np.int64)
+
+
+def flush_stdout_buffers(engine, state):
+    """Drain the tier-0 in-device stdout record buffers to the WASI
+    environ's fds (one download, one write per fd) and reset the
+    per-lane offsets.  Runs at harvest and before any tier-1 serve so
+    per-lane output ordering is preserved."""
+    if getattr(state, "so_buf", None) is None:
+        return state
+    so_off = np.asarray(state.so_off)
+    if not (so_off > 0).any():
+        return state
+    import jax.numpy as jnp
+
+    buf = np.asarray(state.so_buf)
+    env = wasi_env_of(engine)
+    per_fd = {}
+    nbytes = 0
+    for lane in np.nonzero(so_off > 0)[0]:
+        end = int(so_off[lane])
+        col = buf[:end, lane]
+        pos = 0
+        while pos < end:
+            hdr = int(np.uint32(col[pos]))
+            fd = hdr >> 28
+            ln = hdr & 0x0FFFFFFF
+            nw = (ln + 3) // 4
+            data = np.ascontiguousarray(
+                col[pos + 1:pos + 1 + nw]).tobytes()[:ln]
+            per_fd.setdefault(fd, []).append(data)
+            nbytes += ln
+            pos += 1 + nw
+    from wasmedge_tpu.host.wasi.vectorized import _write_all
+
+    for fd in sorted(per_fd):
+        e = env.fds.get(fd) if env is not None else None
+        if e is None or e.os_fd < 0:
+            continue  # fd vanished (tier-0 gating makes this unreachable)
+        _write_all(e, b"".join(per_fd[fd]))
+    stats = getattr(engine, "hostcall_stats", None)
+    if stats is not None:
+        stats["stdout_flushes"] += 1
+        stats["stdout_bytes"] += nbytes
+    return state._replace(so_off=jnp.zeros_like(state.so_off))
 
 
 def serve_batch_state(engine, state):
     """Serve all TRAP_HOSTCALL lanes of a SIMT BatchState; returns the
-    updated state (device arrays refreshed only where touched)."""
+    updated state (device arrays refreshed only where touched).
+
+    Tier-1 vectorized drain: lanes are grouped by hostcall id and each
+    group with a SoA implementation (host/wasi/vectorized.py) is served
+    in one vectorized call over the memory plane — no per-lane 64 KiB
+    materialization.  Groups without one (custom host functions,
+    sockets, oversized iovec arrays) fall back to the per-lane loop,
+    itself backed by the same chunked cache (no full-plane copies).
+
+    Transfer discipline: argument rows ride as ONE slab download,
+    guest memory as 4 KiB-row all-lane chunks fetched on touch and
+    written back dirty-only, results/trap/sp/pc as row/vector updates —
+    never a whole [W, lanes] plane round trip per serve."""
     import jax.numpy as jnp
 
     from wasmedge_tpu.batch.image import TRAP_HOSTCALL
+    from wasmedge_tpu.host.wasi.vectorized import NotVectorizable
 
     img = engine.img
     trap = np.asarray(state.trap)
     waiting = np.nonzero(trap == TRAP_HOSTCALL)[0]
     if waiting.size == 0:
         return state
+    # buffered tier-0 stdout must land before any tier-1 call can
+    # observe fd state (per-lane write ordering)
+    state = flush_stdout_buffers(engine, state)
+    stats = getattr(engine, "hostcall_stats", None)
+    if stats is not None:
+        stats["serve_rounds"] += 1
+        stats["tier1_calls"] += int(waiting.size)
     pc = np.asarray(state.pc)
     fp = np.asarray(state.fp)
     opbase = np.asarray(state.opbase)
     sp = np.asarray(state.sp).copy()
     pages = np.asarray(state.mem_pages).copy()
-    stack_lo = np.asarray(state.stack_lo).copy()
-    stack_hi = np.asarray(state.stack_hi).copy()
     has_mem = img.has_memory
-    mem_plane = np.asarray(state.mem).copy() if has_mem else None
+    cache = PlaneMemoryCache(state.mem) if has_mem else None
+    plane_cap = (int(state.mem.shape[0]) // (65536 // 4)) if has_mem else 0
+    max_pages = img.mem_pages_max if img.mem_pages_max > 0 else None
     new_trap = trap.copy()
     new_pc = pc.copy()
-    max_pages = img.mem_pages_max if img.mem_pages_max > 0 else None
+    use_vec = bool(getattr(engine.cfg, "vectorized_hostcalls", True))
 
-    for lane in waiting:
-        k = int(img.a[pc[lane]])
-        fi = engine.resolve_func(k)
-        nargs = len(fi.functype.params)
-        base = int(fp[lane])
-        args = []
-        for i in range(nargs):
-            lo = int(np.uint32(stack_lo[base + i, lane]))
-            hi = int(np.uint32(stack_hi[base + i, lane]))
-            args.append(lo | (hi << 32))
-        lane_mem = None
-        if has_mem:
-            lane_mem = _LaneMemory(
-                lane_memory_bytes(mem_plane, lane, int(pages[lane])),
-                max_pages, img.mem_pages_max)
-        out, code = serve_one(fi, args, lane_mem)
-        if code:
-            new_trap[lane] = code
+    ks = img.a[pc[waiting]]
+    nargs_by_k = {int(k): len(engine.resolve_func(int(k)).functype.params)
+                  for k in np.unique(ks)}
+    nargs_arr = np.array([nargs_by_k[int(k)] for k in ks], np.int64)
+    max_row = int((fp[waiting] + nargs_arr).max(initial=0))
+    slab_lo = np.asarray(state.stack_lo[:max_row]) if max_row else \
+        np.zeros((0, trap.size), np.int32)
+    slab_hi = np.asarray(state.stack_hi[:max_row]) if max_row else \
+        np.zeros((0, trap.size), np.int32)
+
+    stack_sets = []  # (rows [nres, n], lanes [n], lo [nres, n], hi)
+    for k in np.unique(ks):
+        lanes = waiting[ks == k]
+        fi = engine.resolve_func(int(k))
+        nargs = nargs_by_k[int(k)]
+        cells = codes = None
+        if use_vec and has_mem and getattr(fi, "kind", None) == "host":
+            vecfn, env = vec_impl_for(fi)
+            if vecfn is not None:
+                args = gather_arg_cells(slab_lo, slab_hi, fp, lanes,
+                                        nargs)
+                view = make_cached_view(cache, lanes, pages[lanes])
+                try:
+                    cells, codes = vecfn(env, view, args)
+                except NotVectorizable:
+                    cells = codes = None
+        if cells is not None:
+            if stats is not None:
+                stats["tier1_vectorized"] += int(lanes.size)
+            ok = codes == 0
+            okl = lanes[ok]
+            nres = cells.shape[0]
+            if okl.size and nres:
+                cu = cells[:, ok].astype(np.uint64)
+                obk = np.asarray(opbase[okl], np.int64)
+                rows = obk[None, :] + np.arange(nres,
+                                                dtype=np.int64)[:, None]
+                lo_v = (cu & np.uint64(MASK32)).astype(
+                    np.uint32).view(np.int32)
+                hi_v = (cu >> np.uint64(32)).astype(
+                    np.uint32).view(np.int32)
+                stack_sets.append((rows, okl, lo_v, hi_v))
+            sp[okl] = opbase[okl] + nres
+            new_trap[lanes] = np.where(ok, 0, codes)
+            new_pc[okl] = pc[okl] + 1  # resume at the stub's RETURN
             continue
-        ob = int(opbase[lane])
-        for i, cell in enumerate(out):
-            stack_lo[ob + i, lane] = np.int32(np.uint32(cell & MASK32))
-            stack_hi[ob + i, lane] = np.int32(np.uint32((cell >> 32) & MASK32))
-        sp[lane] = ob + len(out)
-        if has_mem:
-            store_lane_memory(mem_plane, lane, lane_mem.data)
-            pages[lane] = lane_mem.pages  # host fn may have grown memory
-        new_trap[lane] = 0
-        new_pc[lane] = pc[lane] + 1  # resume at the stub's RETURN
+        # ---- per-lane fallback (chunk-cached lane memory views) ----
+        g_rows, g_lanes, g_lo, g_hi = [], [], [], []
+        for lane in lanes:
+            base = int(fp[lane])
+            args1 = []
+            for i in range(nargs):
+                lo = int(np.uint32(slab_lo[base + i, lane]))
+                hi = int(np.uint32(slab_hi[base + i, lane]))
+                args1.append(lo | (hi << 32))
+            lane_mem = None
+            if has_mem:
+                lane_mem = _CachedLaneMemory(
+                    cache, int(lane), int(pages[lane]), max_pages,
+                    plane_cap)
+            out, code = serve_one(fi, args1, lane_mem)
+            if code:
+                new_trap[lane] = code
+                continue
+            ob = int(opbase[lane])
+            for i, cell in enumerate(out):
+                g_rows.append(ob + i)
+                g_lanes.append(int(lane))
+                g_lo.append(np.int32(np.uint32(cell & MASK32)))
+                g_hi.append(np.int32(np.uint32((cell >> 32) & MASK32)))
+            sp[lane] = ob + len(out)
+            if has_mem:
+                pages[lane] = lane_mem.pages  # host fn may have grown
+            new_trap[lane] = 0
+            new_pc[lane] = pc[lane] + 1  # resume at the stub's RETURN
+        if g_rows:
+            stack_sets.append((np.asarray(g_rows, np.int64)[None, :],
+                               np.asarray(g_lanes, np.int64),
+                               np.asarray(g_lo, np.int32)[None, :],
+                               np.asarray(g_hi, np.int32)[None, :]))
 
+    new_stack_lo = state.stack_lo
+    new_stack_hi = state.stack_hi
+    for rows, lanes_w, lo_v, hi_v in stack_sets:
+        rj = jnp.asarray(rows)
+        lj = jnp.asarray(np.broadcast_to(lanes_w[None, :], rows.shape))
+        new_stack_lo = new_stack_lo.at[rj, lj].set(jnp.asarray(lo_v))
+        new_stack_hi = new_stack_hi.at[rj, lj].set(jnp.asarray(hi_v))
     kw = dict(
         pc=jnp.asarray(new_pc), sp=jnp.asarray(sp),
         trap=jnp.asarray(new_trap),
-        stack_lo=jnp.asarray(stack_lo), stack_hi=jnp.asarray(stack_hi),
+        stack_lo=new_stack_lo, stack_hi=new_stack_hi,
     )
     if has_mem:
-        kw["mem"] = jnp.asarray(mem_plane)
+        kw["mem"] = cache.flush()  # dirty chunks only
         kw["mem_pages"] = jnp.asarray(pages)
     return state._replace(**kw)
 
@@ -304,3 +468,34 @@ class _CachedLaneMemory(MemoryInstance):
         return np.frombuffer(
             self._cache.read_bytes(self._lane, 0, self._nbytes()),
             dtype=np.uint8)
+
+
+def make_cached_view(cache: PlaneMemoryCache, lanes, pages):
+    """MemView over a PlaneMemoryCache for the Pallas block serve: word
+    gathers assemble from the cache's 4 KiB all-lane chunks
+    (download-on-touch); byte stores go through cache.write_bytes so
+    dirty-chunk flushing and pad-lane write replay keep working."""
+    from wasmedge_tpu.host.wasi.vectorized import MemView
+
+    class _CachedPlaneView(MemView):
+        def __init__(self):
+            super().__init__(lanes, pages)
+            self.cache = cache
+
+        def _words(self, widx):
+            widx = np.clip(np.asarray(widx, np.int64), 0, cache.W - 1)
+            out = np.empty(widx.shape, np.int32)
+            cr = PlaneMemoryCache.CHUNK_ROWS
+            cis = widx // cr
+            cols = np.broadcast_to(self.lanes[None, :], widx.shape) \
+                if widx.ndim == 2 else self.lanes
+            for ci in np.unique(cis):
+                chunk = cache._chunk(int(ci))
+                m = cis == ci
+                out[m] = chunk[widx[m] - int(ci) * cr, cols[m]]
+            return out
+
+        def _store_bytes_one(self, i, off, data):
+            cache.write_bytes(int(self.lanes[i]), off, bytes(data))
+
+    return _CachedPlaneView()

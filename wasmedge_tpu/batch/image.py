@@ -173,6 +173,36 @@ _TABLE0_OPS = {"table.get", "table.set", "table.size", "table.grow",
 TRAP_DONE = -1  # lane finished normally (trap plane sentinel)
 TRAP_HOSTCALL = -2  # lane waiting on a host outcall
 
+# ---------------------------------------------------------------------------
+# Tier-0 hostcalls: "pure" WASI imports the batch kernels can retire
+# in-kernel (no device->host round trip).  The stub's t0kind plane entry
+# names the call; the engine decides per-config whether to trace the
+# in-kernel handler (batch/engine.py) or leave the stub parking as usual.
+# ---------------------------------------------------------------------------
+T0_NONE = 0
+T0_CLOCK_TIME_GET = 1   # time from the per-relaunch time base + seq plane
+T0_RANDOM_GET = 2       # counter-PRNG plane (deterministic under cfg seed)
+T0_SCHED_YIELD = 3      # no-op, errno SUCCESS
+T0_PROC_EXIT = 4        # lane terminates (ErrCode.Terminated, code on stack)
+T0_FD_WRITE = 5         # fd 1/2 append into the in-device stdout record buf
+
+T0_WASI_KINDS = {
+    "clock_time_get": T0_CLOCK_TIME_GET,
+    "random_get": T0_RANDOM_GET,
+    "sched_yield": T0_SCHED_YIELD,
+    "proc_exit": T0_PROC_EXIT,
+    "fd_write": T0_FD_WRITE,
+}
+
+_WASI_MODULE = "wasi_snapshot_preview1"
+
+# fd_write may only be serviced from the in-device stdout buffer when no
+# other import can observe or mutate fd-table state mid-run (a guest that
+# can close/renumber/seek fds would make the kernel's "fd 1/2 is a plain
+# sink" assumption stale).  Anything in these families other than
+# fd_write itself disables the fd_write tier-0 path for the module.
+_T0_FD_UNSAFE_PREFIXES = ("fd_", "path_", "sock_", "poll_")
+
 
 
 
@@ -278,6 +308,14 @@ class DeviceImage:
     table_size_init: int = 0       # true initial size (table0 is pad>=1)
     has_table_mut: bool = False    # any set/grow/fill/copy/init
     has_table_grow: bool = False
+    # tier-0 hostcall kind per pc (T0_* above; nonzero only at HOSTCALL
+    # stubs of recognized pure WASI imports).  None = no tier-0 service
+    # (e.g. multi-tenant concatenated images keep every call on the
+    # per-tenant outcall channel).
+    t0kind: np.ndarray = None
+    # fd_write tier-0 is additionally gated on the module's import set —
+    # see _T0_FD_UNSAFE_PREFIXES
+    t0_fdwrite_safe: bool = False
 
 
 def build_device_image(image: LoweredModule, memories=None, globals_=None,
@@ -357,6 +395,8 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         image.op[:image.code_len], np.int32)
 
     stub_pc = {}
+    t0kind = np.zeros(n, np.int32)
+    t0_fdwrite_safe = True
     for si, k in enumerate(imports):
         at = image.code_len + 2 * si
         stub_pc[k] = at
@@ -364,6 +404,18 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         a[at] = k
         cls[at + 1] = CLS_RETURN
         b[at + 1] = image.funcs[k].nresults
+        fn = image.funcs[k]
+        if fn.import_module == _WASI_MODULE:
+            t0kind[at] = T0_WASI_KINDS.get(fn.import_name, T0_NONE)
+            if fn.import_name != "fd_write" and fn.import_name.startswith(
+                    _T0_FD_UNSAFE_PREFIXES):
+                t0_fdwrite_safe = False
+        else:
+            # non-WASI host imports can do anything (including fd work
+            # through their own closures is impossible, but a custom
+            # import observing output ordering is not) — keep fd_write
+            # buffering conservative: only pure-WASI modules qualify
+            t0_fdwrite_safe = False
 
     for pc in range(image.code_len):
         op = image.op[pc]
@@ -605,4 +657,5 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         table_max=table_max, table_cap=len(table0),
         table_size_init=table_size,
         has_table_mut=has_table_mut, has_table_grow=has_table_grow,
+        t0kind=t0kind, t0_fdwrite_safe=t0_fdwrite_safe,
     )

@@ -249,6 +249,13 @@ class MultiTenantBatchEngine(BatchEngine):
         self._func_owner = []
         for ti, t in enumerate(self.tenants):
             self._func_owner.extend([ti] * len(t.img.f_entry))
+        # concatenated images carry no t0kind plane: every tenant's
+        # hostcalls stay on the per-tenant outcall channel (tier 1),
+        # which is what keeps per-tenant WASI environs authoritative
+        from wasmedge_tpu.batch.engine import new_hostcall_stats
+
+        self._t0kinds = None
+        self.hostcall_stats = new_hostcall_stats()
         self._step = None
         self._run_chunk = None
 

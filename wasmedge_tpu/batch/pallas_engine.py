@@ -3818,13 +3818,17 @@ def _build_kernel(used_hids: tuple, D: int, CD: int, W: int, L: int,
     # plane inputs (operands: 15 prefetch args, frames_in at 15, planes
     # from 16) alias the plane outputs (after ctrl/frames)
     aliases = {16 + k: 2 + k for k in range(n_planes)}
+    # jax renamed TPUCompilerParams -> CompilerParams around 0.5; accept
+    # both so the kernel builds across the supported range
+    _CParams = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
     fn = pl.pallas_call(
         kernel,
         grid_spec=spec,
         out_shape=out_shape,
         input_output_aliases=aliases,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CParams(
             dimension_semantics=("arbitrary",)),
     )
     if not three_d:
@@ -4482,7 +4486,11 @@ class PallasUniformEngine:
                 [mem_np, np.zeros((simt_w - mem_np.shape[0], L), np.int32)],
                 axis=0)
         simd = self.img.has_simd
+        from wasmedge_tpu.batch.engine import t0_state_planes
+
         return BatchState(
+            **t0_state_planes(self.img, cfg, L,
+                              getattr(self.simt, "_t0kinds", None)),
             pc=jnp.asarray(lanes_of(_C_PC)), sp=jnp.asarray(lanes_of(_C_SP)),
             fp=jnp.asarray(lanes_of(_C_FP)),
             opbase=jnp.asarray(lanes_of(_C_OB)),
@@ -4522,25 +4530,85 @@ class PallasUniformEngine:
         sched.run()
         self.fell_back_to_simt = sched.fell_back_to_simt
         self.splits = sched.splits
+        self.quarantined = sched.quarantined
         self.recheck_rounds = sched.eng.recheck_rounds
         self.aot_fused_verified = sched.eng.aot_fused_verified
         return sched.result()
 
     def _serve_hostcalls(self, state, ctrl_np, valid_blocks=None):
         """Drain parked blocks through the host outcall channel and
-        re-arm them.
+        re-arm them (synchronous composition of the begin/finish halves
+        below — the block scheduler calls the halves directly so host
+        service of parked blocks OVERLAPS the next kernel launch)."""
+        import jax.numpy as jnp
+
+        pending = self._serve_hostcalls_begin(state, ctrl_np,
+                                              valid_blocks)
+        state, rearms = self._serve_hostcalls_finish(state, pending)
+        ctrl = ctrl_np.copy()
+        for b, row in rearms.items():
+            ctrl[b] = row
+        state[0] = jnp.asarray(ctrl)
+        return state
+
+    def _serve_hostcalls_begin(self, state, ctrl_np, valid_blocks=None):
+        """Phase 1 of the outcall serve: capture every device-side read
+        the serve needs — parked blocks' metas and ctrl rows, ONE
+        stack-slab download covering all argument rows, and a device-
+        side gather of the parked blocks' memory columns into a fresh
+        (non-donated) array.  After this returns, the caller may launch
+        the next kernel round; phase 2 never touches the launched
+        planes for reads.
 
         Transfer discipline (the host link costs ~100ms per transfer on
-        a tunneled TPU): ONE stack-slab download covers every parked
-        block's argument rows, guest memory goes through a
-        PlaneMemoryCache whose 4 KiB row chunks are fetched for ALL
-        lanes at once and written back dirty-chunks-only, and result
-        rows go up as per-block device updates — per-lane data never
-        rides the link alone (the "vectorized memory views" serve,
-        SURVEY §5.8/§7(d)).
+        a tunneled TPU): the slab is one download, guest memory goes
+        through a PlaneMemoryCache over the gathered columns whose
+        4 KiB row chunks are fetched for ALL lanes at once and written
+        back dirty-chunks-only — per-lane data never rides the link
+        alone (the "vectorized memory views" serve, SURVEY §5.8/§7(d))."""
+        import jax.numpy as jnp
 
-        valid_blocks: optional {block: bool[Lblk]} from the scheduler —
-        pad (clone) lanes are NOT served (a host function's side effects
+        img = self.img
+        D, CD, W, Lblk = self._geom
+        blocks = [int(b) for b in
+                  np.nonzero(ctrl_np[:, _C_STATUS] == ST_HOSTCALL)[0]]
+        metas = []
+        max_row = 0
+        for b in blocks:
+            pc = int(ctrl_np[b, _C_PC])
+            k = int(img.a[pc])
+            fi = self.simt.resolve_func(k)
+            nargs = len(fi.functype.params)
+            metas.append((b, pc, k, fi, nargs,
+                          int(ctrl_np[b, _C_FP]), int(ctrl_np[b, _C_OB]),
+                          int(ctrl_np[b, _C_PAGES]),
+                          ctrl_np[b].copy()))
+            max_row = max(max_row, int(ctrl_np[b, _C_FP]) + nargs)
+        has_mem = img.has_memory and bool(blocks)
+        cols = np.concatenate(
+            [np.arange(b * Lblk, (b + 1) * Lblk, dtype=np.int64)
+             for b in blocks]) if blocks else np.zeros(0, np.int64)
+        # device-side column gather: a fresh array the next launch's
+        # donation cannot invalidate (chunk downloads happen lazily in
+        # phase 2, overlapping the kernel)
+        mem_cols = state[6][:, jnp.asarray(cols)] if has_mem else None
+        slab_lo = np.asarray(state[2][:max_row]) if max_row else None
+        slab_hi = np.asarray(state[3][:max_row]) if max_row else None
+        return {"blocks": blocks, "metas": metas, "cols": cols,
+                "mem_cols": mem_cols, "slab_lo": slab_lo,
+                "slab_hi": slab_hi, "Lblk": Lblk,
+                "valid_blocks": valid_blocks or {}}
+
+    def _serve_hostcalls_finish(self, state, pending):
+        """Phase 2: run the host functions (vectorized per block where
+        a tier-1 SoA WASI implementation exists, per-lane otherwise)
+        and apply the results — result rows, trap columns, and dirty
+        memory chunks go back as device column updates; re-armed ctrl
+        rows are RETURNED for the caller to fold into its ctrl mirror
+        (the kernel may be mid-flight on the other blocks).
+
+        valid_blocks: {block: bool[Lblk]} from the scheduler — pad
+        (clone) lanes are NOT served (a host function's side effects
         must fire once per real instance, never for padding); their
         result columns and memory writes are replayed from the block's
         first valid lane (their clone source), keeping them converged."""
@@ -4549,69 +4617,99 @@ class PallasUniformEngine:
         from wasmedge_tpu.batch.hostcall import (
             PlaneMemoryCache,
             _CachedLaneMemory,
+            make_cached_view,
             serve_one,
+            vec_impl_for,
         )
+        from wasmedge_tpu.host.wasi.vectorized import NotVectorizable
 
         img = self.img
         D, CD, W, Lblk = self._geom
-        ctrl = ctrl_np.copy()
-        blocks = np.nonzero(ctrl[:, _C_STATUS] == ST_HOSTCALL)[0]
-        has_mem = img.has_memory
-        cache = PlaneMemoryCache(state[6]) if has_mem else None
+        metas = pending["metas"]
+        valid_blocks = pending["valid_blocks"]
+        slab_lo = pending["slab_lo"]
+        slab_hi = pending["slab_hi"]
+        has_mem = img.has_memory and pending["mem_cols"] is not None
+        cache = PlaneMemoryCache(pending["mem_cols"]) if has_mem else None
         plane_cap = (W // _PAGE_WORDS) if has_mem else 0
         if img.mem_pages_max > 0:
             max_pages = min(img.mem_pages_max, plane_cap)
         else:
             max_pages = plane_cap or None
+        use_vec = bool(getattr(self.cfg, "vectorized_hostcalls", True))
+        stats = getattr(self.simt, "hostcall_stats", None)
+        rearms = {}
 
-        metas = []
-        max_row = 0
-        for b in blocks:
-            pc = int(ctrl[b, _C_PC])
-            k = int(img.a[pc])
-            fi = self.simt.resolve_func(k)
-            nargs = len(fi.functype.params)
-            metas.append((int(b), pc, k, fi, nargs,
-                          int(ctrl[b, _C_FP]), int(ctrl[b, _C_OB])))
-            max_row = max(max_row, int(ctrl[b, _C_FP]) + nargs)
-        # one slab download for every block's argument rows
-        slab_lo = np.asarray(state[2][:max_row]) if max_row else None
-        slab_hi = np.asarray(state[3][:max_row]) if max_row else None
-
-        for (b, pc, k, fi, nargs, fp, ob) in metas:
-            lo_col = b * Lblk
-            vmask = valid_blocks.get(b) if valid_blocks else None
+        for bi, (b, pc, k, fi, nargs, fp, ob, pages, cc) in \
+                enumerate(metas):
+            lo_col = b * Lblk      # absolute columns (slab / state)
+            loc = bi * Lblk        # local columns (gathered mem cache)
+            vmask = valid_blocks.get(b)
             nres = int(img.f_nresults[k])
             res_lo = np.zeros((max(nres, 1), Lblk), np.int32)
             res_hi = np.zeros((max(nres, 1), Lblk), np.int32)
             trap_codes = np.zeros(Lblk, np.int32)
-            pages = int(ctrl[b, _C_PAGES])
             new_pages = np.full(Lblk, pages, np.int32)
-            lane_mems = {}
-            for li in range(Lblk):
-                if vmask is not None and not vmask[li]:
-                    continue  # pad lane: replayed from its clone below
-                lane = lo_col + li
-                args = []
-                for i in range(nargs):
-                    a_lo = int(np.uint32(slab_lo[fp + i, lane]))
-                    a_hi = int(np.uint32(slab_hi[fp + i, lane]))
-                    args.append(a_lo | (a_hi << 32))
-                lane_mem = None
-                if has_mem:
-                    lane_mem = _CachedLaneMemory(cache, lane, pages,
-                                                 max_pages, plane_cap)
-                    lane_mems[li] = lane_mem
-                out, code = serve_one(fi, args, lane_mem)
-                if code:
-                    trap_codes[li] = code
-                    continue
-                for i, cell in enumerate(out):
-                    res_lo[i, li] = np.int32(np.uint32(cell & 0xFFFFFFFF))
-                    res_hi[i, li] = np.int32(
-                        np.uint32((cell >> 32) & 0xFFFFFFFF))
-                if has_mem:
-                    new_pages[li] = lane_mem.pages
+            if stats is not None:
+                n_real = int(vmask.sum()) if vmask is not None else Lblk
+                stats["serve_rounds"] += 1 if bi == 0 else 0
+                stats["tier1_calls"] += n_real
+            served_vec = False
+            if use_vec and has_mem and getattr(fi, "kind", None) == "host":
+                vecfn, env = vec_impl_for(fi)
+                if vecfn is not None:
+                    from wasmedge_tpu.batch.hostcall import \
+                        gather_arg_cells
+
+                    vsel = np.arange(Lblk, dtype=np.int64) \
+                        if vmask is None else \
+                        np.nonzero(vmask)[0].astype(np.int64)
+                    fp_vec = np.full(slab_lo.shape[1], fp, np.int64)
+                    args = gather_arg_cells(slab_lo, slab_hi, fp_vec,
+                                            lo_col + vsel, nargs)
+                    view = make_cached_view(cache, loc + vsel,
+                                            np.full(vsel.size, pages))
+                    try:
+                        cells, codes = vecfn(env, view, args)
+                        served_vec = True
+                    except NotVectorizable:
+                        served_vec = False
+                    if served_vec:
+                        if stats is not None:
+                            stats["tier1_vectorized"] += int(vsel.size)
+                        cu = cells.astype(np.uint64)
+                        for r in range(cells.shape[0]):
+                            res_lo[r, vsel] = (
+                                cu[r] & np.uint64(0xFFFFFFFF)).astype(
+                                    np.uint32).view(np.int32)
+                            res_hi[r, vsel] = (
+                                cu[r] >> np.uint64(32)).astype(
+                                    np.uint32).view(np.int32)
+                        trap_codes[vsel] = codes
+            if not served_vec:
+                for li in range(Lblk):
+                    if vmask is not None and not vmask[li]:
+                        continue  # pad lane: replayed from clone below
+                    args = []
+                    for i in range(nargs):
+                        a_lo = int(np.uint32(slab_lo[fp + i, lo_col + li]))
+                        a_hi = int(np.uint32(slab_hi[fp + i, lo_col + li]))
+                        args.append(a_lo | (a_hi << 32))
+                    lane_mem = None
+                    if has_mem:
+                        lane_mem = _CachedLaneMemory(
+                            cache, loc + li, pages, max_pages, plane_cap)
+                    out, code = serve_one(fi, args, lane_mem)
+                    if code:
+                        trap_codes[li] = code
+                        continue
+                    for i, cell in enumerate(out):
+                        res_lo[i, li] = np.int32(
+                            np.uint32(cell & 0xFFFFFFFF))
+                        res_hi[i, li] = np.int32(
+                            np.uint32((cell >> 32) & 0xFFFFFFFF))
+                    if has_mem:
+                        new_pages[li] = lane_mem.pages
             if vmask is not None and not vmask.all():
                 src = int(np.argmax(vmask))  # first valid = clone source
                 pads = np.nonzero(~vmask)[0]
@@ -4622,26 +4720,24 @@ class PallasUniformEngine:
                     new_pages[li] = new_pages[src]
                 if has_mem:
                     # replay the clone source's memory writes onto pads
-                    for (off, n) in cache.writes_of(lo_col + src):
-                        data = cache.read_bytes(lo_col + src, off, n)
+                    for (off, n) in cache.writes_of(loc + src):
+                        data = cache.read_bytes(loc + src, off, n)
                         for li in pads:
-                            cache.write_bytes(lo_col + int(li), off, data)
+                            cache.write_bytes(loc + int(li), off, data)
             grew = (new_pages != pages) & (trap_codes == 0)
             if trap_codes.any() or grew.any():
                 # Per-lane outcomes: record them, re-arm at pc+1 with the
                 # served lanes' results applied (their host calls MUST
                 # NOT re-run), then leave the block DIVERGED for the
                 # scheduler to partition per lane.
-                trap_plane = np.asarray(state[7]).copy()
-                seg = trap_plane[0, lo_col:lo_col + Lblk]
-                seg[:] = np.where(trap_codes != 0, trap_codes, seg)
-                trap_plane[0, lo_col:lo_col + Lblk] = seg
-                state[7] = jnp.asarray(trap_plane)
+                state[7] = state[7].at[0, lo_col:lo_col + Lblk].max(
+                    jnp.asarray(trap_codes))
                 if grew.any():
                     self._pages_override[b] = new_pages.copy()
                 if (trap_codes != 0).all() and \
                         len(set(trap_codes.tolist())) == 1:
-                    ctrl[b, _C_STATUS] = ST_TRAPPED_BASE + int(trap_codes[0])
+                    cc[_C_STATUS] = ST_TRAPPED_BASE + int(trap_codes[0])
+                    rearms[b] = cc
                     continue
                 if nres:
                     state[2] = state[2].at[ob:ob + nres,
@@ -4650,9 +4746,10 @@ class PallasUniformEngine:
                     state[3] = state[3].at[ob:ob + nres,
                                            lo_col:lo_col + Lblk].set(
                         jnp.asarray(res_hi[:nres]))
-                ctrl[b, _C_PC] = pc + 1
-                ctrl[b, _C_SP] = ob + nres
-                ctrl[b, _C_STATUS] = ST_DIVERGED
+                cc[_C_PC] = pc + 1
+                cc[_C_SP] = ob + nres
+                cc[_C_STATUS] = ST_DIVERGED
+                rearms[b] = cc
                 continue
             if nres:
                 state[2] = state[2].at[ob:ob + nres,
@@ -4661,11 +4758,19 @@ class PallasUniformEngine:
                 state[3] = state[3].at[ob:ob + nres,
                                        lo_col:lo_col + Lblk].set(
                     jnp.asarray(res_hi[:nres]))
-            ctrl[b, _C_PC] = pc + 1
-            ctrl[b, _C_SP] = ob + nres
-            ctrl[b, _C_STATUS] = ST_RUNNING
-        if has_mem:
-            state[6] = cache.flush()
-        state[0] = jnp.asarray(ctrl)
-        return state
+            cc[_C_PC] = pc + 1
+            cc[_C_SP] = ob + nres
+            cc[_C_STATUS] = ST_RUNNING
+            rearms[b] = cc
+        if has_mem and cache._dirty:
+            # dirty chunks go back to the live plane as column updates
+            colsj = jnp.asarray(pending["cols"])
+            cr = PlaneMemoryCache.CHUNK_ROWS
+            for ci in sorted(cache._dirty):
+                lo = ci * cr
+                ch = cache._chunks[ci]
+                state[6] = state[6].at[lo:lo + ch.shape[0], colsj].set(
+                    jnp.asarray(ch))
+            cache._dirty.clear()
+        return state, rearms
 

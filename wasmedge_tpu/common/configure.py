@@ -113,6 +113,38 @@ class BatchConfigure:
     # of one per instruction).  None = on; False falls back to the
     # legacy peephole superinstruction fuser.
     block_fusion: Optional[bool] = None
+    # --- three-tier hostcall pipeline knobs (batch/hostcall.py) ---
+    # Tier 0: service pure WASI calls (clock_time_get / random_get /
+    # sched_yield / proc_exit / fd_write-to-buffered-stdout) directly in
+    # the SIMT kernel — they cost a dispatch slot, not a device<->host
+    # round trip.  False parks every hostcall on the outcall channel.
+    tier0_hostcalls: bool = True
+    # Seed for the in-kernel counter-PRNG behind tier-0 random_get
+    # (deterministic per (seed, lane, call, word)).  None (the default)
+    # draws fresh entropy once per Configure, so guests get
+    # unpredictable bytes run-to-run like the os.urandom-backed scalar
+    # and tier-1 paths; set an explicit seed for reproducible runs.
+    rng_seed: Optional[int] = None
+    # Per-lane in-device stdout record buffer, in 4-byte words (tier-0
+    # fd_write appends records here; the host drains them at flush
+    # points).  Writes that would overflow the buffer park on the
+    # tier-1 channel instead (after a flush they fit again).
+    stdout_buffer_words: int = 2048
+    # Max bytes of one tier-0 fd_write iovec / random_get request the
+    # kernel services inline; longer requests park on tier 1.
+    tier0_write_max: int = 256
+    tier0_random_max: int = 64
+    # Tier-1 vectorized drain: group parked lanes by hostcall and serve
+    # each group with SoA-vectorized NumPy WASI implementations
+    # (host/wasi/vectorized.py) instead of the per-lane Python loop.
+    vectorized_hostcalls: bool = True
+    # v128 SIMT-residue quarantine (batch/scheduler.py): the XLA
+    # per-step v128 fallback is known to fault TPU workers on very long
+    # runs, so a divergent v128 tenant's residue is capped at this many
+    # further steps; lanes still running at the cap re-run on the
+    # scalar engine when side-effect-free, else trap CostLimitExceeded.
+    # None disables the cap.
+    v128_residue_step_cap: Optional[int] = 1_000_000
 
 
 @dataclasses.dataclass

@@ -18,7 +18,15 @@ from __future__ import annotations
 import enum
 import struct
 
-import numpy as np
+# numpy is needed only by the four float bit-pattern helpers below; it
+# is imported lazily so the CLI's scalar/native paths (which pull this
+# module for ValType) keep a numpy-free spawn (tests/test_spawn_time.py)
+
+
+def _np():
+    import numpy
+
+    return numpy
 
 
 class ValType(enum.IntEnum):
@@ -78,11 +86,13 @@ def s64(x: int) -> int:
     return x - (1 << 64) if x >= (1 << 63) else x
 
 
-def f32_to_bits(v: float | np.float32) -> int:
+def f32_to_bits(v: "float | np.float32") -> int:
+    np = _np()
     return struct.unpack("<I", struct.pack("<f", float(np.float32(v))))[0]
 
 
-def bits_to_f32(b: int) -> np.float32:
+def bits_to_f32(b: int) -> "np.float32":
+    np = _np()
     return np.float32(struct.unpack("<f", struct.pack("<I", b & MASK32))[0])
 
 
@@ -90,7 +100,8 @@ def f64_to_bits(v: float) -> int:
     return struct.unpack("<Q", struct.pack("<d", float(v)))[0]
 
 
-def bits_to_f64(b: int) -> np.float64:
+def bits_to_f64(b: int) -> "np.float64":
+    np = _np()
     return np.float64(struct.unpack("<d", struct.pack("<Q", b & MASK64))[0])
 
 

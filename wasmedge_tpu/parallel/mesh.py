@@ -35,9 +35,13 @@ def state_shardings(mesh, state):
     """NamedSharding pytree for a BatchState: lane dim (last) sharded."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    lanes = int(state.pc.shape[0])
+
     def spec_for(x):
         nd = getattr(x, "ndim", 0)
-        if nd == 0:
+        # replicate planes whose trailing dim is not the lane dim (e.g.
+        # the [2, 2] tier-0 time base, batch/engine.py BatchState)
+        if nd == 0 or int(x.shape[-1]) != lanes:
             return NamedSharding(mesh, P())
         spec = [None] * (nd - 1) + ["lanes"]
         return NamedSharding(mesh, P(*spec))

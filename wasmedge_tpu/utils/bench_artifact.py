@@ -1,0 +1,41 @@
+"""Bench artifact emission: one JSON line on stdout for the driver, plus
+a persistent artifact file in the repo root so every round's numbers are
+recorded (VERDICT r5: "a round's claims must ship with its numbers").
+
+The artifact path defaults to the bench's canonical name (e.g.
+ECHO_r06.json).  Overrides:
+
+  BENCH_ARTIFACT=off           disable every artifact write
+  BENCH_ARTIFACT=<dir>/        redirect all benches into a directory
+                               (each keeps its canonical basename, so
+                               two benches never clobber each other)
+  BENCH_ARTIFACT_<STEM>=<path> per-bench path (STEM = canonical name
+                               uppercased, e.g. BENCH_ARTIFACT_ECHO_R06)
+
+stdout always gets the one-line JSON regardless.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def emit(result: dict, default_path: str) -> None:
+    print(json.dumps(result))
+    glob = os.environ.get("BENCH_ARTIFACT")
+    if glob == "off":
+        return
+    stem = os.path.splitext(os.path.basename(default_path))[0].upper()
+    path = os.environ.get(f"BENCH_ARTIFACT_{stem}")
+    if path is None:
+        if glob:
+            path = os.path.join(glob, os.path.basename(default_path)) \
+                if (os.path.isdir(glob) or glob.endswith(os.sep)) else glob
+        else:
+            path = default_path
+    try:
+        with open(path, "w") as f:
+            f.write(json.dumps(result, indent=2, sort_keys=True) + "\n")
+    except OSError:
+        pass  # the artifact is a record, never a bench failure
