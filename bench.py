@@ -67,6 +67,71 @@ def _native_baseline_ops():
         return RECORDED_CPP_INTERP_OPS, "recorded-estimate"
 
 
+def faults_smoke() -> int:
+    """`bench.py --faults-smoke`: run the echo workload once under a
+    single injected launch fault and assert the supervisor recovers —
+    the CI guard that supervised execution stays wired end-to-end.
+    Prints ONE JSON line; emits no benchmark artifact (this mode
+    measures recovery, not throughput)."""
+    import os
+    import tempfile
+
+    import bench_echo
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.batch.supervisor import BatchSupervisor
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.host.wasi import WasiModule
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.testing.faults import Fault, FaultInjector
+    from wasmedge_tpu.validator import Validator
+
+    lanes, iters = 64, 2
+    conf = Configure()
+    # small chunks so the injected fault lands mid-run, after at least
+    # one checkpoint exists (the echo workload retires in a few hundred
+    # steps per lane)
+    conf.batch.steps_per_launch = 100
+    conf.batch.value_stack_depth = 64
+    conf.batch.call_stack_depth = 16
+    conf.supervisor.checkpoint_every_steps = 100
+    conf.supervisor.backoff_base_s = 0.0
+    wasi = WasiModule()
+    wasi.init_wasi(dirs=[], prog_name="echo")
+    sink = os.open(os.devnull, os.O_WRONLY)
+    wasi.env.fds[1].os_fd = sink
+    mod = Validator(conf).validate(
+        Loader(conf).parse_module(bench_echo.build_module()))
+    store = StoreManager()
+    ex = Executor(conf)
+    ex.register_import_object(store, wasi)
+    inst = ex.instantiate(store, mod)
+    eng = BatchEngine(inst, store=store, conf=conf, lanes=lanes)
+    inj = FaultInjector([Fault(point="launch", at=1)])
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="faults-smoke-") as d:
+        sup = BatchSupervisor(eng, conf=conf, faults=inj,
+                              checkpoint_dir=d)
+        res = sup.run("echo", [np.full(lanes, iters, np.int64)],
+                      max_steps=1_000_000)
+    dt = time.perf_counter() - t0
+    os.close(sink)
+    ok = bool(res.completed.all()) and inj.fired == 1 \
+        and any(f.fault_class == "launch" for f in sup.failures)
+    print(json.dumps({
+        "metric": "faults_smoke_echo_recovery",
+        "value": 1 if ok else 0,
+        "unit": "recovered",
+        "ok": ok,
+        "injected": inj.fired,
+        "failures": [f.fault_class for f in sup.failures],
+        "lanes": lanes,
+        "wall_s": round(dt, 3),
+    }))
+    return 0 if ok else 1
+
+
 def main():
     eng = _build(LANES)
 
@@ -123,4 +188,6 @@ def _fib(n):
 
 
 if __name__ == "__main__":
+    if "--faults-smoke" in sys.argv[1:]:
+        sys.exit(faults_smoke())
     main()

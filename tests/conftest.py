@@ -26,6 +26,9 @@ jax.config.update("jax_platforms", "cpu")
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: minutes-scale suite, skipped by --fast")
+    config.addinivalue_line(
+        "markers", "faults: deterministic fault-injection suite "
+        "(supervised execution; tier-1 fast, runs under -m 'not slow')")
 
 
 def pytest_addoption(parser):

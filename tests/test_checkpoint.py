@@ -73,6 +73,37 @@ def test_checkpoint_refuses_wrong_image(tmp_path):
         load(ckpt, other_geom)
 
 
+def test_checkpoint_save_is_atomic(tmp_path, monkeypatch):
+    """An interrupted save must never leave a truncated .npz at the
+    target path nor clobber the previous good snapshot (the supervisor's
+    resume path depends on this)."""
+    import os
+
+    eng = make(build_fib())
+    state = eng.initial_state(eng.inst.exports["fib"][1],
+                              [np.full(16, 9, np.int64)])
+    state, total = eng.run_from_state(state, 0, 300)
+    ckpt = tmp_path / "c.ckpt"
+    save(ckpt, eng, state, total)
+    good = ckpt.read_bytes()
+
+    state2, total2 = eng.run_from_state(state, total, 600)
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("simulated crash mid-save")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError, match="simulated crash"):
+        save(ckpt, eng, state2, total2)
+    monkeypatch.setattr(os, "replace", real_replace)
+    # previous snapshot intact and loadable; no temp litter left behind
+    assert ckpt.read_bytes() == good
+    assert [p.name for p in tmp_path.iterdir()] == ["c.ckpt"]
+    restored, rtotal = load(ckpt, make(build_fib()))
+    assert rtotal == total
+
+
 def test_checkpoint_refuses_corrupt_control_planes(tmp_path):
     # ADVICE r2: the image hash proved provenance but the restored control
     # planes were trusted verbatim — a crafted npz with wild pc/fp/sp

@@ -442,8 +442,7 @@ def compile_cached(wasm_bytes: bytes, conf=None) -> bytes:
             return f.read()
     out = compile_module(wasm_bytes, conf)
     os.makedirs(cache_dir(), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(out)
-    os.replace(tmp, path)
+    from wasmedge_tpu.utils.fsio import atomic_write_bytes
+
+    atomic_write_bytes(path, out)
     return out

@@ -25,6 +25,7 @@ from typing import Tuple
 import numpy as np
 
 from wasmedge_tpu.batch.engine import BatchEngine, BatchState
+from wasmedge_tpu.utils.fsio import atomic_write_bytes
 
 FORMAT_VERSION = 1
 
@@ -77,8 +78,10 @@ def save(path, engine: BatchEngine, state: BatchState, total_steps: int):
     if hasattr(path, "write"):
         path.write(data)
     else:
-        with open(path, "wb") as f:
-            f.write(data)
+        # Crash-safe write: an interrupted save must never leave a
+        # truncated .npz at the target path for a later resume to trip
+        # over (or clobber a previous good snapshot).
+        atomic_write_bytes(path, data)
 
 
 def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:

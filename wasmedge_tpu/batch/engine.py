@@ -227,47 +227,19 @@ def t0_effective_kinds(img: DeviceImage, cfg) -> Optional[np.ndarray]:
     return kinds
 
 
-def t0_statics(cfg) -> dict:
-    """Shared tier-0 kernel constants — ONE source for the SIMT and
-    uniform engines (the random_get stream must stay bit-identical
-    across a divergence handoff; errnos mirror host/wasi/wasi_abi)."""
-    from wasmedge_tpu.host.wasi.wasi_abi import Errno
-
-    seed = getattr(cfg, "rng_seed", None)
-    if seed is None:
-        # fresh entropy, drawn ONCE per Configure so every engine built
-        # from it (SIMT + uniform fast path) shares the same stream
-        seed = getattr(cfg, "_rng_seed_drawn", None)
-        if seed is None:
-            import os
-
-            seed = int.from_bytes(os.urandom(4), "little")
-            cfg._rng_seed_drawn = seed
-    return {
-        "RMAX_W": max(int(getattr(cfg, "tier0_random_max", 64)), 4) // 4,
-        "WMAX_W": max(int(getattr(cfg, "tier0_write_max", 256)), 4) // 4,
-        "RNG_SEED": np.array(seed & 0xFFFFFFFF, np.uint32).view(np.int32),
-        "E_INVAL": int(Errno.INVAL),
-        "E_FAULT": int(Errno.FAULT),
-    }
-
-
-def t0_prng32(x):
-    """Counter-PRNG avalanche (int32 xorshift-multiply) behind tier-0
-    random_get, deterministic per (cfg.rng_seed, lane, call seq, word)."""
-    from jax import lax
-
-    x = x ^ lax.shift_right_logical(x, 16)
-    x = x * np.int32(0x7FEB352D)
-    x = x ^ lax.shift_right_logical(x, 15)
-    x = x * np.int32(np.uint32(0x846CA68B))
-    x = x ^ lax.shift_right_logical(x, 16)
-    return x
-
-
-def t0_word_mix(j: int) -> np.ndarray:
-    """Per-word whitening constant of the tier-0 random stream."""
-    return np.array((j * 0x27220A95) & 0xFFFFFFFF, np.uint32).view(np.int32)
+# Shared tier-0 kernel logic lives in batch/tier0.py (one source for the
+# SIMT and uniform engines' bit-identical streams); re-exported here for
+# compatibility with existing importers.
+from wasmedge_tpu.batch.tier0 import (  # noqa: F401
+    t0_clock_value,
+    t0_masked_store,
+    t0_prng32,
+    t0_random_fill,
+    t0_rng_seq_hash,
+    t0_shifted_src_word,
+    t0_statics,
+    t0_word_mix,
+)
 
 
 def t0_time_planes() -> np.ndarray:
@@ -445,7 +417,12 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
         RNG_SEED = jnp.asarray(_t0s["RNG_SEED"])
         _E_INVAL = _t0s["E_INVAL"]
         _E_FAULT = _t0s["E_FAULT"]
-        prng32 = t0_prng32
+
+        def t0_rmw(plane, idx, m, v, ok):
+            """Masked word RMW through this engine's gather/scatter —
+            the primitive the shared tier-0 bodies are built on."""
+            cur = gat(plane, idx)
+            return scat(plane, idx, (cur & ~m) | (v & m), ok & (m != 0))
 
     def step(st: BatchState, t0_time=None) -> BatchState:
         """One lockstep instruction.  `t0_time` is the [2, 2] int32
@@ -1163,28 +1140,6 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
             ctr_fdw = st.t0_ctr[2]
             ctr_sys = st.t0_ctr[3]
 
-            def t0_store(plane, ea, v_lo, v_hi, nbytes_c, m):
-                """Masked little-endian store of nbytes_c (4/8, static)
-                at per-lane byte address ea (bounds checked by caller)."""
-                widx0 = lax.shift_right_logical(ea, 2)
-                shB0 = (ea & 3) * 8
-                f_lo = jnp.full_like(ea, jnp.int32(-1))
-                f_hi = jnp.full_like(
-                    ea, jnp.int32(-1) if nbytes_c == 8 else jnp.int32(0))
-                tm0, tm1 = lo_ops.shl64(f_lo, f_hi, shB0)
-                tm2 = jnp.where(shB0 == 0, 0,
-                                lo_ops.shr64_u(f_lo, f_hi, 64 - shB0)[0])
-                ts0, ts1 = lo_ops.shl64(v_lo, v_hi, shB0)
-                ts2 = jnp.where(shB0 == 0, 0,
-                                lo_ops.shr64_u(v_lo, v_hi, 64 - shB0)[0])
-                out = plane
-                for kk, (mm, vv) in enumerate(
-                        ((tm0, ts0), (tm1, ts1), (tm2, ts2))):
-                    cur = gat(out, widx0 + kk)
-                    out = scat(out, widx0 + kk, (cur & ~mm) | (vv & mm),
-                               m & (mm != 0))
-                return out
-
             if USE_T0_CLOCK:
                 m_clk = is_hc & (k0 == T0_CLOCK_TIME_GET)
                 cid = arg0
@@ -1193,17 +1148,13 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
                 hard_id = (cid == 2) | (cid == 3)      # cputime: tier 1
                 tend = tptr + 8
                 c_oob = u_lt(tend, tptr) | u_lt(mem_bytes, tend)
-                base_lo = jnp.where(cid == 1, t0_time[1, 0],
-                                    t0_time[0, 0])
-                base_hi = jnp.where(cid == 1, t0_time[1, 1],
-                                    t0_time[0, 1])
-                tv_lo, tv_hi = lo_ops.add64(base_lo, base_hi, ctr_clk,
-                                            jnp.zeros_like(ctr_clk))
+                tv_lo, tv_hi = t0_clock_value(t0_time, cid, ctr_clk)
                 ok_c = m_clk & ~bad_id & ~hard_id
                 wr_c = ok_c & ~c_oob
                 mem_plane = lax.cond(
                     jnp.any(wr_c),
-                    lambda mp: t0_store(mp, tptr, tv_lo, tv_hi, 8, wr_c),
+                    lambda mp: t0_masked_store(t0_rmw, mp, tptr, tv_lo,
+                                               tv_hi, 8, wr_c),
                     lambda mp: mp, mem_plane)
                 done_c = m_clk & ~hard_id
                 res_c = jnp.where(bad_id, jnp.int32(_E_INVAL),
@@ -1221,38 +1172,13 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
                 r_oob = u_lt(rend, rbuf) | u_lt(mem_bytes, rend)
                 ok_r = m_rnd & fits_r
                 wr_r = ok_r & ~r_oob & (rlen != 0)
-                shB_r = (rbuf & 3) * 8
-                inv_r = (32 - shB_r) & 31
-                hi_or_r = jnp.where(shB_r == 0, 0, -1)
-                w0_r = lax.shift_right_logical(rbuf, 2)
-                lane_h = prng32(RNG_SEED ^ ((lane_iota + 1)
-                                            * jnp.int32(-1640531527)))
-                seq_h = lane_h ^ (ctr_rng * np.int32(np.uint32(0x85EBCA6B)))
+                seq_h = t0_rng_seq_hash(RNG_SEED, lane_iota, ctr_rng)
 
-                def run_rand(mp):
-                    out = mp
-                    prev_pw = jnp.zeros_like(rbuf)
-                    for j in range(RMAX_W + 1):
-                        pw = prng32(seq_h ^ jnp.asarray(t0_word_mix(j))) \
-                            if j < RMAX_W else jnp.zeros_like(rbuf)
-                        val = lax.shift_left(pw, shB_r) | \
-                            (lax.shift_right_logical(prev_pw, inv_r)
-                             & hi_or_r)
-                        mk = zl
-                        for bpos in range(4):
-                            ba = (w0_r + j) * 4 + bpos
-                            inr = ~u_lt(ba, rbuf) & u_lt(ba, rend)
-                            mk = mk | jnp.where(
-                                inr, jnp.int32(lo_ops.BYTE_MASKS[bpos]), 0)
-                        cur = gat(out, w0_r + j)
-                        out = scat(out, w0_r + j,
-                                   (cur & ~mk) | (val & mk),
-                                   wr_r & (mk != 0))
-                        prev_pw = pw
-                    return out
-
-                mem_plane = lax.cond(jnp.any(wr_r), run_rand,
-                                     lambda mp: mp, mem_plane)
+                mem_plane = lax.cond(
+                    jnp.any(wr_r),
+                    lambda mp: t0_random_fill(t0_rmw, mp, rbuf, rend,
+                                              wr_r, seq_h, RMAX_W, zl),
+                    lambda mp: mp, mem_plane)
                 res_r = jnp.where(r_oob, jnp.int32(_E_FAULT), 0)
                 t0_push = t0_push | ok_r
                 t0_val = jnp.where(ok_r, res_r, t0_val)
@@ -1292,10 +1218,8 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
                     hdr = wlen | lax.shift_left(wfd, 28)
                     sob = scat(sob, st.so_off, hdr, wr_w)
                     for j in range(WMAX_W):
-                        s0 = gat(mem_snapshot, wsrc0 + j)
-                        s1 = gat(mem_snapshot, wsrc0 + j + 1)
-                        v = lax.shift_right_logical(s0, shB_w) | \
-                            (lax.shift_left(s1, inv_w) & hi_or_w)
+                        v = t0_shifted_src_word(gat, mem_snapshot, wsrc0,
+                                                j, shB_w, inv_w, hi_or_w)
                         sob = scat(sob, st.so_off + 1 + j, v,
                                    wr_w & (jnp.int32(j * 4) < wlen))
                     return sob
@@ -1304,8 +1228,9 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
                                     lambda s: s, so_buf_p)
                 mem_plane = lax.cond(
                     jnp.any(wr_w),
-                    lambda mp: t0_store(mp, wnp, wlen,
-                                        jnp.zeros_like(wlen), 4, wr_w),
+                    lambda mp: t0_masked_store(t0_rmw, mp, wnp, wlen,
+                                               jnp.zeros_like(wlen), 4,
+                                               wr_w),
                     lambda mp: mp, mem_plane)
                 so_off_p = jnp.where(wr_w, st.so_off + 1 + nwords,
                                      so_off_p)
@@ -1956,14 +1881,22 @@ class BatchEngine:
         if t0_active:
             ctr_in = np.asarray(state.t0_ctr, np.int64).sum(axis=1)
         dummy_time = np.zeros((2, 2), np.int32)
+        # deterministic fault seam (testing/faults.py): the supervisor
+        # arms this before a launch / a tier-1 serve so injected device
+        # and host failures raise exactly where real ones would
+        fault = getattr(self, "_fault_hook", None)
         while total < max_steps:
             # per-relaunch time base: host->device only, no round trip
             # (rides the launch as a non-donated argument)
             tt = jnp.asarray(t0_time_planes() if t0_active else dummy_time)
+            if fault is not None:
+                fault("launch", total=total)
             done_steps, state = self._run_chunk(state, tt)
             total += int(done_steps)
             trap_host = np.asarray(state.trap)
             if (trap_host == TRAP_HOSTCALL).any():
+                if fault is not None:
+                    fault("serve", total=total)
                 state = serve_batch_state(self, state)
                 continue
             if not (trap_host == 0).any():

@@ -455,6 +455,14 @@ class MultiTenantBatchEngine(BatchEngine):
                 state, total = self.run_from_state(state, total, max_steps)
         else:
             state, total = self.run_from_state(state, 0, max_steps)
+        return self.results_from_state(state, total)
+
+    def results_from_state(self, state: BatchState, total: int
+                           ) -> List[BatchResult]:
+        """Harvest one BatchResult per tenant from a final SIMT state —
+        shared by run_tenants and the supervised entry
+        (batch/supervisor.py drives run_from_state slices itself for
+        checkpoint cadence, then harvests here)."""
         stack_lo = np.asarray(state.stack_lo)
         stack_hi = np.asarray(state.stack_hi)
         trap = np.asarray(state.trap)

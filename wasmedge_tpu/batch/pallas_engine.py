@@ -4181,10 +4181,9 @@ class PallasUniformEngine:
             specs = self._arg_specs()
             exp = jexport.export(fn)(*specs)
             os.makedirs(d, exist_ok=True)
-            tmp = path + f".tmp{os.getpid()}"
-            with open(tmp, "wb") as f:
-                f.write(exp.serialize())
-            os.replace(tmp, path)
+            from wasmedge_tpu.utils.fsio import atomic_write_bytes
+
+            atomic_write_bytes(path, exp.serialize())
             return exp.call
         except Exception:
             return build()

@@ -1112,7 +1112,13 @@ class BlockScheduler:
         """Lanes still running when the v128 residue cap hit: re-run
         them from their original arguments on the scalar engine when
         the module is side-effect-free (no host imports), else report
-        CostLimitExceeded.  Either way the device process survives."""
+        CostLimitExceeded.  Either way the device process survives.
+
+        The gas-metered scalar re-run itself is the shared bottom rung
+        of the supervisor's degradation ladder (batch/supervisor.py
+        scalar_rerun); host-side errors inside it surface as
+        FailureRecords in the process-wide log instead of being
+        silently swallowed."""
         self.quarantined = getattr(self, "quarantined", 0) + int(lanes.size)
         inst = self.inst
         has_host = any(getattr(f, "kind", None) == "host"
@@ -1120,42 +1126,26 @@ class BlockScheduler:
         if has_host:
             self.trap[lanes] = int(ErrCode.CostLimitExceeded)
             return
-        import copy
+        from wasmedge_tpu.batch.supervisor import scalar_rerun
+        from wasmedge_tpu.common.statistics import record_failure
 
-        from wasmedge_tpu.common.types import bits_to_typed, typed_to_bits
-        from wasmedge_tpu.executor import Executor
-        from wasmedge_tpu.runtime.store import StoreManager
-
-        conf = getattr(self.eng.simt, "conf", None)
-        # the scalar re-run must honor the caller's max_steps contract:
-        # gas-meter it (flat 1/instr) so an infinite-loop guest traps
-        # CostLimitExceeded instead of hanging the host
-        conf = copy.deepcopy(conf) if conf is not None else None
-        if conf is not None:
-            conf.statistics.cost_measuring = True
-            conf.statistics.cost_limit = max(int(self.max_steps), 1)
-        fi_t = inst.funcs[self.func_idx].functype
-        for lane in lanes:
-            # lane args are raw 64-bit cells; the scalar invoke takes
-            # TYPED values (float params would otherwise be re-encoded
-            # from their bit pattern)
-            args = [bits_to_typed(t, int(np.uint64(a[lane])))
-                    for t, a in zip(fi_t.params, self.args)]
-            try:
-                ex = Executor(conf)
-                st = StoreManager()
-                fresh = ex.instantiate(st, inst.ast)
-                out = ex.invoke(st, fresh.find_func(self.func_name), args)
-            except Exception:
-                self.trap[int(lane)] = int(ErrCode.CostLimitExceeded)
-                continue
-            for r, (t, v) in enumerate(zip(fi_t.results, out)):
-                cell = np.uint64(typed_to_bits(t, v) & ((1 << 64) - 1))
-                self.res_lo[r, lane] = np.int32(np.uint32(
-                    int(cell) & 0xFFFFFFFF))
-                self.res_hi[r, lane] = np.int32(np.uint32(
-                    (int(cell) >> 32) & 0xFFFFFFFF))
-            self.trap[int(lane)] = TRAP_DONE
+        cells, trap_codes, records = scalar_rerun(
+            inst, getattr(self.eng.simt, "conf", None), self.func_name,
+            self.func_idx, self.args, np.asarray(lanes, np.int64),
+            self.max_steps)
+        for rec in records:
+            record_failure(rec)
+        nres = len(inst.funcs[self.func_idx].functype.results)
+        for col, lane in enumerate(np.asarray(lanes, np.int64)):
+            code = int(trap_codes[col])
+            if code == TRAP_DONE:
+                for r in range(nres):
+                    cell = int(cells[r, col])
+                    self.res_lo[r, lane] = np.int32(np.uint32(
+                        cell & 0xFFFFFFFF))
+                    self.res_hi[r, lane] = np.int32(np.uint32(
+                        (cell >> 32) & 0xFFFFFFFF))
+            self.trap[int(lane)] = code
 
     # -- result ------------------------------------------------------------
     def result(self):

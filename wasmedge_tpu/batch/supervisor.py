@@ -1,0 +1,488 @@
+"""Supervised batch execution: auto-checkpoint, retry, degradation ladder.
+
+The north star serves long-lived batches of thousands of lanes; before
+this layer a single device fault, XLA miscompile, or host-serve exception
+mid-run killed the whole batch and lost every in-flight lane.  The
+supervisor wraps BlockScheduler/BatchEngine runs with the recovery loop a
+hypervisor owes its guests ("Towards a Linear-Algebraic Hypervisor",
+PAPERS.md) — cheap here because BatchState is plain SoA arrays the
+checkpoint layer (batch/checkpoint.py) already snapshots:
+
+1. **Periodic checkpointing** — step- and/or wall-clock cadence
+   (SupervisorConfigure.checkpoint_every_steps / _every_s), atomic
+   temp-file+rename writes, bounded lineage with pruning.  Cadence
+   applies on the SIMT tier, whose BatchState the checkpoint layer
+   understands; slices land on steps_per_launch chunk boundaries, so a
+   resumed run replays the exact chunk sequence an uninterrupted run
+   executes — crash/resume is bit-identical (tests/test_supervisor.py).
+
+2. **Retry with exponential backoff** — a launch (kernel dispatch/XLA)
+   or hostcall-serve exception restores the last good checkpoint (older
+   lineage members when the newest is corrupt; the initial state when
+   none survive) and retries under a budget.
+
+3. **Engine-degradation ladder** — Pallas/BlockScheduler -> per-step jit
+   SIMT -> gas-metered scalar engine.  A tier that exhausts its retry
+   budget is demoted; the bottom rung re-executes side-effect-free
+   batches lane-by-lane on the scalar interpreter with a fuel limit
+   (the generalization of the r6 v128-residue quarantine, whose scalar
+   re-run now lives here as `scalar_rerun`).  Per-lane poison
+   quarantine: a failure attributed to concrete lanes (exceptions
+   carrying `.lanes`) that repeats demotes those lanes to the scalar
+   rung or terminates them (ErrCode.Terminated) instead of sinking the
+   batch; a lane running past `lane_step_cap` retired instructions is a
+   runaway and is terminated.
+
+4. **Structured FailureRecords** (common/statistics.py) — every
+   incident (fault class, lane set, retry count, checkpoint lineage,
+   tier) lands on the supplied Statistics and the process-wide log.
+
+Side-effect caveat: host-visible WASI effects (tier-1 writes, tier-0
+stdout flushes) are at-least-once across a restore — output flushed
+before the failed slice is not un-written.  Flushes happen only at slice
+boundaries and serve points, so a checkpoint cadence aligned with output
+expectations bounds the duplication window; pure-compute batches are
+exactly-once by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from wasmedge_tpu.common.errors import EngineFailure, ErrCode, TrapError, WasmError
+from wasmedge_tpu.common.statistics import FailureRecord, record_failure
+
+MASK64 = (1 << 64) - 1
+
+
+class _TierExhausted(Exception):
+    """Internal: the current ladder tier burned its retry budget."""
+
+    def __init__(self, cause):
+        super().__init__(repr(cause))
+        self.cause = cause
+
+
+def scalar_rerun(inst, conf, func_name: str, func_idx: int, args_lanes,
+                 lanes, max_steps: int):
+    """Gas-metered scalar re-execution of `lanes` from their original
+    arguments — the ladder's bottom rung, shared with the block
+    scheduler's v128-residue quarantine (batch/scheduler.py).
+
+    Only sound for modules without host imports (no WASI side effects to
+    double-apply); callers gate on that.  Returns (cells [max(nres,1), n]
+    uint64 raw result cells, trap [n] int32 with TRAP_DONE on success,
+    records) where `records` are FailureRecords for host-side errors the
+    scalar engine itself hit (guest traps are per-lane trap codes, not
+    incidents)."""
+    import copy
+
+    from wasmedge_tpu.batch.image import TRAP_DONE
+    from wasmedge_tpu.common.types import bits_to_typed, typed_to_bits
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.runtime.store import StoreManager
+
+    # the scalar re-run must honor the caller's max_steps contract:
+    # gas-meter it (flat 1/instr) so an infinite-loop guest traps
+    # CostLimitExceeded instead of hanging the host
+    conf = copy.deepcopy(conf) if conf is not None else None
+    if conf is not None:
+        conf.statistics.cost_measuring = True
+        conf.statistics.cost_limit = max(int(max_steps), 1)
+    ft = inst.funcs[func_idx].functype
+    nres = len(ft.results)
+    lanes = np.asarray(lanes, np.int64)
+    n = int(lanes.size)
+    cells = np.zeros((max(nres, 1), n), np.uint64)
+    trap = np.zeros(n, np.int32)
+    records: List[FailureRecord] = []
+    for col, lane in enumerate(lanes):
+        # lane args are raw 64-bit cells; the scalar invoke takes TYPED
+        # values (float params would otherwise be re-encoded from bits)
+        args = [bits_to_typed(t, int(np.uint64(a[lane])))
+                for t, a in zip(ft.params, args_lanes)]
+        try:
+            ex = Executor(conf)
+            st = StoreManager()
+            fresh = ex.instantiate(st, inst.ast)
+            out = ex.invoke(st, fresh.find_func(func_name), args)
+        except TrapError as te:
+            # a genuine guest trap (incl. CostLimitExceeded from the
+            # fuel meter): per-lane outcome, same as the batch engines
+            trap[col] = int(te.code) or int(ErrCode.CostLimitExceeded)
+            continue
+        except WasmError:
+            # non-trap engine refusal (instantiation etc.): the lane did
+            # not complete within its budget
+            trap[col] = int(ErrCode.CostLimitExceeded)
+            continue
+        except Exception as e:  # host-side bug — record, don't silence
+            records.append(FailureRecord(
+                fault_class="scalar_rerun", error=repr(e),
+                lanes=(int(lane),), tier="scalar", time_s=time.time()))
+            trap[col] = int(ErrCode.CostLimitExceeded)
+            continue
+        for r, (t, v) in enumerate(zip(ft.results, out)):
+            cells[r, col] = np.uint64(typed_to_bits(t, v) & MASK64)
+        trap[col] = TRAP_DONE
+    return cells, trap, records
+
+
+class BatchSupervisor:
+    """Drives one engine's batch to completion under supervision.
+
+    `engine` is a SIMT BatchEngine or a MultiTenantBatchEngine; `run()`
+    returns the same shape their unsupervised entries do (a BatchResult,
+    or one per tenant).  `faults` is an optional
+    wasmedge_tpu.testing.faults.FaultInjector armed on the engine's
+    deterministic seams; `stats` an optional common.statistics.Statistics
+    that collects the FailureRecords (the process-wide log gets them
+    either way)."""
+
+    def __init__(self, engine, conf=None, stats=None, faults=None,
+                 checkpoint_dir: Optional[str] = None):
+        self.engine = engine
+        self.conf = conf if conf is not None else engine.conf
+        self.k = self.conf.supervisor
+        self.stats = stats
+        self.faults = faults
+        self.failures: List[FailureRecord] = []
+        self.retries = 0
+        self.checkpoint_dir = checkpoint_dir or self.k.checkpoint_dir
+        self._ckpts: List[Tuple[str, int]] = []   # lineage: (path, steps)
+        self._restored_from: Optional[str] = None
+        self._overlay = {}  # lane -> (result cells, trap) from scalar rung
+
+    # -- public -----------------------------------------------------------
+    def run(self, func_name: Optional[str] = None, args_lanes=None,
+            max_steps: int = 10_000_000):
+        eng = self.engine
+        self._multi = hasattr(eng, "tenants")
+        self._max_steps = int(max_steps)
+        self._overlay = {}
+        if not self._multi:
+            ex = eng.inst.exports.get(func_name)
+            if ex is None or ex[0] != 0:
+                raise KeyError(f"no exported function {func_name}")
+            self._func_name = func_name
+            self._func_idx = ex[1]
+            self._args = []
+            for a in (args_lanes or []):
+                arr = np.asarray(a, np.int64)
+                if arr.ndim == 0:
+                    arr = np.full(eng.lanes, arr, np.int64)
+                self._args.append(arr)
+        tiers = []
+        if self.k.use_kernel_tier and not self._multi:
+            tiers.append("pallas")
+        tiers.append("simt")
+        if self._scalar_ok():
+            tiers.append("scalar")
+        last_exc = None
+        for tier in tiers:
+            try:
+                if tier == "pallas":
+                    res = self._run_kernel_tier(max_steps)
+                    if res is None:
+                        continue  # ineligible here, not a failure
+                    return res
+                if tier == "simt":
+                    state, total = self._run_simt_tier(max_steps)
+                    if self._multi:
+                        return self.engine.results_from_state(state, total)
+                    return self._result_single(state, total)
+                return self._run_scalar_tier(max_steps)
+            except _TierExhausted as e:
+                last_exc = e.cause
+                self._record("demote", e.cause, tier=tier)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if tier != "pallas":
+                    raise
+                # the kernel tier is best-effort: any failure demotes
+                last_exc = e
+                self._record("launch", e, tier="pallas")
+                self._record("demote", e, tier="pallas")
+        raise EngineFailure(
+            f"supervised run failed on every tier: {last_exc!r}",
+            self.failures)
+
+    # -- ladder tiers -----------------------------------------------------
+    def _run_kernel_tier(self, max_steps):
+        from wasmedge_tpu.batch.pallas_engine import (
+            PallasUniformEngine, pallas_enabled)
+
+        eng = self.engine
+        if not pallas_enabled(eng.cfg):
+            return None
+        peng = PallasUniformEngine(eng.inst, simt=eng,
+                                   interpret=eng.cfg.interpret or None)
+        if not peng.eligible:
+            return None
+        return peng.run(self._func_name, list(self._args), max_steps)
+
+    def _run_simt_tier(self, max_steps):
+        eng = self.engine
+        k = self.k
+        state, total = self._initial_state(), 0
+        consecutive = 0
+        fail_keys = {}
+        self._last_ckpt_total = 0
+        self._last_ckpt_wall = time.monotonic()
+        while True:
+            target = self._slice_target(total, max_steps)
+            try:
+                if self.faults is not None:
+                    eng._fault_hook = self.faults.fire
+                state, total = eng.run_from_state(state, total, target)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self.retries += 1
+                consecutive += 1
+                point = getattr(e, "point", None) or "launch"
+                lanes = tuple(getattr(e, "lanes", ()) or ())
+                self._record("serve" if point == "serve" else "launch",
+                             e, lanes=lanes)
+                key = (point, lanes)
+                fail_keys[key] = fail_keys.get(key, 0) + 1
+                # the failed attempt may have consumed donated buffers:
+                # never reuse `state`, restore from the lineage
+                state, total = self._restore()
+                if lanes and fail_keys[key] >= k.poison_lane_retries:
+                    state = self._quarantine_lanes(state, lanes)
+                    fail_keys.pop(key, None)
+                    consecutive = 0
+                    continue
+                if consecutive > k.max_retries:
+                    raise _TierExhausted(e)
+                self._backoff(consecutive)
+                continue
+            finally:
+                eng._fault_hook = None
+            consecutive = 0
+            state = self._check_runaways(state)
+            if not (np.asarray(state.trap) == 0).any() \
+                    or total >= max_steps:
+                return state, total
+            self._maybe_checkpoint(state, total)
+
+    def _scalar_ok(self) -> bool:
+        return (self.k.allow_scalar_tier and not self._multi
+                and not any(getattr(f, "kind", None) == "host"
+                            for f in self.engine.inst.funcs))
+
+    def _run_scalar_tier(self, max_steps):
+        from wasmedge_tpu.batch.engine import BatchResult
+
+        eng = self.engine
+        lanes = np.arange(eng.lanes, dtype=np.int64)
+        cells, trap, recs = scalar_rerun(
+            eng.inst, self.conf, self._func_name, self._func_idx,
+            self._args, lanes, max_steps)
+        for r in recs:
+            self._record_rec(r)
+        nres = int(eng.inst.lowered.funcs[self._func_idx].nresults)
+        results = [cells[r].view(np.int64).copy() for r in range(nres)]
+        # retired counts live in device state the scalar rung never has;
+        # zeros keep the BatchResult contract (trap is authoritative)
+        return BatchResult(results=results, trap=trap,
+                           retired=np.zeros(eng.lanes, np.int64), steps=0)
+
+    # -- state / lineage --------------------------------------------------
+    def _initial_state(self):
+        if self._multi:
+            return self.engine.initial_state()
+        return self.engine.initial_state(self._func_idx, self._args)
+
+    def _restore(self):
+        """Newest surviving checkpoint, else the initial state.  A member
+        that fails to load (corrupt/truncated/injected) is recorded and
+        dropped from the lineage — the next-older one is tried."""
+        from wasmedge_tpu.batch import checkpoint
+
+        while self._ckpts:
+            path, steps = self._ckpts[-1]
+            try:
+                if self.faults is not None:
+                    self.faults.fire("checkpoint_load", path=path)
+                state, total = checkpoint.load(path, self.engine)
+                self._restored_from = path
+                self._reset_cadence(total)
+                return state, total
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._record("checkpoint", e, checkpoint=path)
+                self._ckpts.pop()
+        self._restored_from = None
+        self._reset_cadence(0)
+        return self._initial_state(), 0
+
+    def _reset_cadence(self, total: int):
+        """Re-anchor the checkpoint cadence at the restored position —
+        otherwise a restore to an older lineage member (or the initial
+        state) leaves the step anchor ahead of `total` and the replayed
+        region runs unprotected for up to several intervals."""
+        self._last_ckpt_total = int(total)
+        self._last_ckpt_wall = time.monotonic()
+
+    def _cadence(self) -> bool:
+        return bool(self.k.checkpoint_every_steps
+                    or self.k.checkpoint_every_s)
+
+    def _slice_target(self, total, max_steps) -> int:
+        # slice the run so checkpoint decisions land on chunk-aligned
+        # boundaries; without a cadence, one slice runs to the budget.
+        # Both cadences are "whichever fires first": a wall-clock
+        # cadence needs per-chunk boundary checks even when a (large)
+        # step cadence is also configured.
+        step = None
+        if self.k.checkpoint_every_steps:
+            step = int(self.k.checkpoint_every_steps)
+        if self.k.checkpoint_every_s:
+            chunk = max(int(self.engine.cfg.steps_per_launch), 1)
+            step = chunk if step is None else min(step, chunk)
+        if step is None:
+            return max_steps
+        return min(max_steps, total + step)
+
+    def _maybe_checkpoint(self, state, total):
+        if not self._cadence():
+            return
+        k = self.k
+        due = bool(k.checkpoint_every_steps
+                   and total - self._last_ckpt_total
+                   >= k.checkpoint_every_steps)
+        due = due or bool(k.checkpoint_every_s
+                          and time.monotonic() - self._last_ckpt_wall
+                          >= k.checkpoint_every_s)
+        if not due:
+            return
+        from wasmedge_tpu.batch import checkpoint
+
+        if self.checkpoint_dir is None:
+            self.checkpoint_dir = tempfile.mkdtemp(prefix="wasmedge-ckpt-")
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        path = os.path.join(self.checkpoint_dir, f"ckpt-{total:012d}.npz")
+        try:
+            if self.faults is not None:
+                self.faults.fire("checkpoint_save", path=path)
+            checkpoint.save(path, self.engine, state, total)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            # a failed snapshot must never kill a healthy run
+            self._record("checkpoint", e, checkpoint=path)
+            return
+        self._ckpts.append((path, total))
+        self._last_ckpt_total = total
+        self._last_ckpt_wall = time.monotonic()
+        while len(self._ckpts) > max(int(self.k.keep_checkpoints), 1):
+            old, _ = self._ckpts.pop(0)
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+
+    # -- quarantine -------------------------------------------------------
+    def _quarantine_lanes(self, state, lanes):
+        """Lanes that repeatedly fault the kernel: demote to the scalar
+        rung (side-effect-free single-module batches — their results
+        overlay the final harvest) or terminate (ErrCode.Terminated);
+        either way the batch proceeds without them."""
+        import jax.numpy as jnp
+
+        lane_arr = np.asarray(sorted({int(x) for x in lanes}), np.int64)
+        demoted = False
+        if self._scalar_ok():
+            cells, trap, recs = scalar_rerun(
+                self.engine.inst, self.conf, self._func_name,
+                self._func_idx, self._args, lane_arr, self._max_steps)
+            for r in recs:
+                self._record_rec(r)
+            for col, lane in enumerate(lane_arr):
+                self._overlay[int(lane)] = (cells[:, col].copy(),
+                                            int(trap[col]))
+            demoted = True
+        self._record(
+            "poison_lane", None, lanes=tuple(int(x) for x in lane_arr),
+            tier="scalar" if demoted else "simt",
+            error="demoted to scalar engine" if demoted
+            else "terminated (ErrCode.Terminated)")
+        trap_p = state.trap.at[jnp.asarray(lane_arr)].set(
+            jnp.int32(int(ErrCode.Terminated)))
+        return state._replace(trap=trap_p)
+
+    def _check_runaways(self, state):
+        cap = self.k.lane_step_cap
+        if cap is None:
+            return state
+        trap_np = np.asarray(state.trap)
+        ret_np = np.asarray(state.retired)
+        over = np.nonzero((trap_np == 0) & (ret_np >= int(cap)))[0]
+        if not over.size:
+            return state
+        import jax.numpy as jnp
+
+        self._record("runaway", None,
+                     lanes=tuple(int(x) for x in over),
+                     error=f"lane_step_cap={int(cap)} exceeded; "
+                           "terminated (ErrCode.Terminated)")
+        trap_p = state.trap.at[jnp.asarray(over)].set(
+            jnp.int32(int(ErrCode.Terminated)))
+        return state._replace(trap=trap_p)
+
+    # -- bookkeeping ------------------------------------------------------
+    def _backoff(self, attempt: int):
+        base = float(self.k.backoff_base_s)
+        if base <= 0:
+            return
+        time.sleep(min(float(self.k.backoff_max_s),
+                       base * float(self.k.backoff_factor)
+                       ** max(attempt - 1, 0)))
+
+    def _record(self, fault_class, exc, lanes=(), tier="simt",
+                checkpoint=None, error=None):
+        self._record_rec(FailureRecord(
+            fault_class=fault_class,
+            error=error if error is not None
+            else ("" if exc is None else repr(exc)),
+            lanes=tuple(int(x) for x in lanes), retry=self.retries,
+            checkpoint=checkpoint or self._restored_from, tier=tier,
+            time_s=time.time()))
+
+    def _record_rec(self, rec: FailureRecord):
+        self.failures.append(rec)
+        if self.stats is not None:
+            self.stats.add_failure(rec)
+        else:
+            record_failure(rec)
+
+    # -- harvest ----------------------------------------------------------
+    def _result_single(self, state, total):
+        from wasmedge_tpu.batch.engine import BatchResult
+
+        nres = int(self.engine.inst.lowered.funcs[self._func_idx].nresults)
+        stack_lo = np.asarray(state.stack_lo)
+        stack_hi = np.asarray(state.stack_hi)
+        results = []
+        for r in range(nres):
+            lo = stack_lo[r].view(np.uint32).astype(np.uint64)
+            hi = stack_hi[r].view(np.uint32).astype(np.uint64)
+            results.append((lo | (hi << np.uint64(32))).view(np.int64))
+        trap = np.asarray(state.trap).copy()
+        retired = np.asarray(state.retired).copy()
+        for lane, (cells, tc) in self._overlay.items():
+            trap[lane] = tc
+            for r in range(nres):
+                results[r][lane] = np.asarray(
+                    [cells[r]], np.uint64).view(np.int64)[0]
+        return BatchResult(results=results, trap=trap, retired=retired,
+                           steps=total)

@@ -1,0 +1,157 @@
+"""Shared tier-0 hostcall kernel logic (three-tier pipeline, r06).
+
+The SIMT engine (batch/engine.py) and the uniform converged engine
+(batch/uniform.py) both service pure WASI calls in-kernel, and their
+random_get streams / stored timestamps / stdout records must be
+BIT-IDENTICAL across a divergence handoff (pinned by tests/
+test_hostcall_pipeline.py::test_tier0_random_uniform_simt_bit_identical).
+The two engines address memory differently — per-lane gathers/scatters
+under lane masks vs dynamic-slice rows — so the shared bodies here are
+parameterized by the caller's primitives:
+
+  gather(plane, idx) -> [L]     per-lane word read at row idx
+  rmw(plane, idx, m, v, ok)     masked read-modify-write:
+                                plane[idx] = (cur & ~m) | (v & m)
+                                where ok & (m != 0), else unchanged
+
+Everything value-producing (the counter-PRNG, per-word whitening, clock
+arithmetic, byte-granular store masks) lives here exactly once; the
+engines keep only their dispatch/bail plumbing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def t0_statics(cfg) -> dict:
+    """Shared tier-0 kernel constants — ONE source for the SIMT and
+    uniform engines (the random_get stream must stay bit-identical
+    across a divergence handoff; errnos mirror host/wasi/wasi_abi)."""
+    from wasmedge_tpu.host.wasi.wasi_abi import Errno
+
+    seed = getattr(cfg, "rng_seed", None)
+    if seed is None:
+        # fresh entropy, drawn ONCE per Configure so every engine built
+        # from it (SIMT + uniform fast path) shares the same stream
+        seed = getattr(cfg, "_rng_seed_drawn", None)
+        if seed is None:
+            import os
+
+            seed = int.from_bytes(os.urandom(4), "little")
+            cfg._rng_seed_drawn = seed
+    return {
+        "RMAX_W": max(int(getattr(cfg, "tier0_random_max", 64)), 4) // 4,
+        "WMAX_W": max(int(getattr(cfg, "tier0_write_max", 256)), 4) // 4,
+        "RNG_SEED": np.array(seed & 0xFFFFFFFF, np.uint32).view(np.int32),
+        "E_INVAL": int(Errno.INVAL),
+        "E_FAULT": int(Errno.FAULT),
+    }
+
+
+def t0_prng32(x):
+    """Counter-PRNG avalanche (int32 xorshift-multiply) behind tier-0
+    random_get, deterministic per (cfg.rng_seed, lane, call seq, word)."""
+    from jax import lax
+
+    x = x ^ lax.shift_right_logical(x, 16)
+    x = x * np.int32(0x7FEB352D)
+    x = x ^ lax.shift_right_logical(x, 15)
+    x = x * np.int32(np.uint32(0x846CA68B))
+    x = x ^ lax.shift_right_logical(x, 16)
+    return x
+
+
+def t0_word_mix(j: int) -> np.ndarray:
+    """Per-word whitening constant of the tier-0 random stream."""
+    return np.array((j * 0x27220A95) & 0xFFFFFFFF, np.uint32).view(np.int32)
+
+
+def t0_rng_seq_hash(rng_seed, lane_iota, ctr):
+    """Per-(lane, call-seq) hash seeding the random_get word stream.
+    Identical on both engines by construction — this IS the stream
+    identity the handoff contract pins."""
+    lane_h = t0_prng32(rng_seed ^ ((lane_iota + 1)
+                                   * np.int32(-1640531527)))
+    return lane_h ^ (ctr * np.int32(np.uint32(0x85EBCA6B)))
+
+
+def t0_clock_value(t0_time, cid, ctr):
+    """clock_time_get value: per-launch time base (row 0 realtime, row 1
+    monotonic) plus the per-lane call sequence, as an int32 (lo, hi)
+    pair — strictly increasing per lane even within one launch."""
+    import jax.numpy as jnp
+
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    base_lo = jnp.where(cid == 1, t0_time[1, 0], t0_time[0, 0])
+    base_hi = jnp.where(cid == 1, t0_time[1, 1], t0_time[0, 1])
+    return lo_ops.add64(base_lo, base_hi, ctr, jnp.zeros_like(ctr))
+
+
+def t0_masked_store(rmw, plane, ea, v_lo, v_hi, nbytes_c, ok):
+    """Masked little-endian store of nbytes_c (4/8, static) at per-lane
+    byte address ea (bounds checked by the caller) through the caller's
+    read-modify-write primitive."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    widx = lax.shift_right_logical(ea, 2)
+    shB = (ea & 3) * 8
+    f_lo = jnp.full_like(ea, jnp.int32(-1))
+    f_hi = jnp.full_like(
+        ea, jnp.int32(-1) if nbytes_c == 8 else jnp.int32(0))
+    m0, m1 = lo_ops.shl64(f_lo, f_hi, shB)
+    m2 = jnp.where(shB == 0, 0,
+                   lo_ops.shr64_u(f_lo, f_hi, 64 - shB)[0])
+    s0, s1 = lo_ops.shl64(v_lo, v_hi, shB)
+    s2 = jnp.where(shB == 0, 0,
+                   lo_ops.shr64_u(v_lo, v_hi, 64 - shB)[0])
+    for k, (m, v) in enumerate(((m0, s0), (m1, s1), (m2, s2))):
+        plane = rmw(plane, widx + k, m, v, ok)
+    return plane
+
+
+def t0_random_fill(rmw, mem, rbuf, rend, wr, seq_h, rmax_w, zero):
+    """random_get word loop: write the counter-PRNG stream into guest
+    bytes [rbuf, rend) with byte-granular edge masks.  `zero` is the
+    caller's [L] int32 zero vector; the loop shape (rmax_w + 1 shifted
+    windows) is the stream layout both engines must share."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    shB = (rbuf & 3) * 8
+    inv = (32 - shB) & 31
+    hi_or = jnp.where(shB == 0, 0, -1)
+    w0 = lax.shift_right_logical(rbuf, 2)
+    prev = zero
+    for j in range(rmax_w + 1):
+        pw = t0_prng32(seq_h ^ jnp.asarray(t0_word_mix(j))) \
+            if j < rmax_w else zero
+        val = lax.shift_left(pw, shB) | \
+            (lax.shift_right_logical(prev, inv) & hi_or)
+        mk = zero
+        for bpos in range(4):
+            ba = (w0 + j) * 4 + bpos
+            inr = ~lo_ops.u_lt(ba, rbuf) & lo_ops.u_lt(ba, rend)
+            mk = mk | jnp.where(
+                inr, jnp.int32(lo_ops.BYTE_MASKS[bpos]), 0)
+        mem = rmw(mem, w0 + j, mk, val, wr)
+        prev = pw
+    return mem
+
+
+def t0_shifted_src_word(gather, mem, w0, j, shB, inv, hi_or):
+    """fd_write record payload: the j-th guest-memory source word of an
+    unaligned iovec buffer, assembled from the two straddling plane
+    words (the stdout record buffer itself is always word-aligned)."""
+    from jax import lax
+
+    s0 = gather(mem, w0 + j)
+    s1 = gather(mem, w0 + j + 1)
+    return lax.shift_right_logical(s0, shB) | \
+        (lax.shift_left(s1, inv) & hi_or)

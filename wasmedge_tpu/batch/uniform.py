@@ -601,8 +601,9 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int, t0kinds=None):
 
     HAS_T0 = t0kinds is not None
     if HAS_T0:
-        from wasmedge_tpu.batch.engine import (
-            t0_prng32 as prng32, t0_statics, t0_word_mix)
+        from wasmedge_tpu.batch.tier0 import (
+            t0_clock_value, t0_masked_store, t0_random_fill,
+            t0_rng_seq_hash, t0_shifted_src_word, t0_statics)
 
         t0k_t = jnp.asarray(np.asarray(t0kinds, np.int32))
         T0_PRESENT = sorted(set(int(k) for k in np.unique(t0kinds))
@@ -615,23 +616,6 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int, t0kinds=None):
         _E_FAULT = _t0s["E_FAULT"]
         lane_iota = jnp.arange(lanes, dtype=I32)
         zlv = jnp.zeros((lanes,), I32)
-
-        def t0_mem_store(mem, ea, v_lo, v_hi, nbytes_c, ok):
-            """Per-lane masked little-endian store (4/8 bytes static)."""
-            widx = lax.shift_right_logical(ea, 2)
-            shB = (ea & 3) * 8
-            f_lo = jnp.full((lanes,), -1, I32)
-            f_hi = jnp.full((lanes,), -1 if nbytes_c == 8 else 0, I32)
-            m0, m1 = lo_ops.shl64(f_lo, f_hi, shB)
-            m2 = jnp.where(shB == 0, 0,
-                           lo_ops.shr64_u(f_lo, f_hi, 64 - shB)[0])
-            s0, s1 = lo_ops.shl64(v_lo, v_hi, shB)
-            s2 = jnp.where(shB == 0, 0,
-                           lo_ops.shr64_u(v_lo, v_hi, 64 - shB)[0])
-            mem = _mem_rmw(mem, widx, m0, s0, ok)
-            mem = _mem_rmw(mem, widx + 1, m1, s1, ok)
-            mem = _mem_rmw(mem, widx + 2, m2, s2, ok)
-            return mem
 
         def t0_retire(st2, res_vec):
             sl = setrow(st2.stack_lo, st2.opbase, res_vec)
@@ -662,11 +646,10 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int, t0kinds=None):
             tend = tptr + 8
             oob = u_lt(tend, tptr) | u_lt(mem_bytes, tend)
             ctr = st.t0_ctr[0]
-            base_lo = jnp.where(cid == 1, t0_time[1, 0], t0_time[0, 0])
-            base_hi = jnp.where(cid == 1, t0_time[1, 1], t0_time[0, 1])
-            tv_lo, tv_hi = lo_ops.add64(base_lo, base_hi, ctr, zlv)
+            tv_lo, tv_hi = t0_clock_value(t0_time, cid, ctr)
             wr = ~bad & ~oob & ~hard
-            mem = t0_mem_store(st.mem, tptr, tv_lo, tv_hi, 8, wr)
+            mem = t0_masked_store(_mem_rmw, st.mem, tptr, tv_lo, tv_hi,
+                                  8, wr)
             res = jnp.where(bad, jnp.int32(_E_INVAL),
                             jnp.where(oob, jnp.int32(_E_FAULT), 0))
             st2 = t0_retire(
@@ -685,29 +668,10 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int, t0kinds=None):
             rend = rbuf + rlen
             oob = u_lt(rend, rbuf) | u_lt(mem_bytes, rend)
             ctr = st.t0_ctr[1]
-            lane_h = prng32(RNG_SEED ^ ((lane_iota + 1)
-                                        * jnp.int32(-1640531527)))
-            seq_h = lane_h ^ (ctr * np.int32(np.uint32(0x85EBCA6B)))
+            seq_h = t0_rng_seq_hash(RNG_SEED, lane_iota, ctr)
             wr = fits & ~oob & (rlen != 0)
-            shB = (rbuf & 3) * 8
-            inv = (32 - shB) & 31
-            hi_or = jnp.where(shB == 0, 0, -1)
-            w0 = lax.shift_right_logical(rbuf, 2)
-            mem = st.mem
-            prev = zlv
-            for j in range(RMAX_W + 1):
-                pw = prng32(seq_h ^ jnp.asarray(t0_word_mix(j))) \
-                    if j < RMAX_W else zlv
-                val = lax.shift_left(pw, shB) | \
-                    (lax.shift_right_logical(prev, inv) & hi_or)
-                mk = zlv
-                for bpos in range(4):
-                    ba = (w0 + j) * 4 + bpos
-                    inr = ~u_lt(ba, rbuf) & u_lt(ba, rend)
-                    mk = mk | jnp.where(
-                        inr, jnp.int32(lo_ops.BYTE_MASKS[bpos]), 0)
-                mem = _mem_rmw(mem, w0 + j, mk, val, wr)
-                prev = pw
+            mem = t0_random_fill(_mem_rmw, st.mem, rbuf, rend, wr,
+                                 seq_h, RMAX_W, zlv)
             res = jnp.where(oob, jnp.int32(_E_FAULT), 0)
             st2 = t0_retire(
                 st._replace(mem=mem, t0_ctr=st.t0_ctr.at[1].set(
@@ -756,16 +720,15 @@ def make_uniform_step(img: DeviceImage, cfg, lanes: int, t0kinds=None):
                 cur = row(st.so_buf, so0)
                 sob = setrow(st.so_buf, so0, jnp.where(wr, hdr, cur))
                 for j in range(WMAX_W):
-                    s0 = _mem_gather(st.mem, wsrc0 + j)
-                    s1 = _mem_gather(st.mem, wsrc0 + j + 1)
-                    v = lax.shift_right_logical(s0, shB) | \
-                        (lax.shift_left(s1, inv) & hi_or)
+                    v = t0_shifted_src_word(_mem_gather, st.mem, wsrc0,
+                                            j, shB, inv, hi_or)
                     mrow = wr & (jnp.int32(j) < nw0) & \
                         (jnp.int32(j * 4) < wlen)
                     curj = row(sob, so0 + 1 + j)
                     sob = setrow(sob, so0 + 1 + j,
                                  jnp.where(mrow, v, curj))
-                mem = t0_mem_store(st.mem, wnp, wlen, zlv, 4, wr)
+                mem = t0_masked_store(_mem_rmw, st.mem, wnp, wlen, zlv,
+                                      4, wr)
                 res = jnp.where(d_oob, jnp.int32(_E_FAULT), 0)
                 ctr = st.t0_ctr[2]
                 return t0_retire(st._replace(

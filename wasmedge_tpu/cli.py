@@ -65,6 +65,18 @@ def _runner_parser() -> ArgumentParser:
     p.add_option(["engine"],
                  Option("execution engine: scalar|native|tpu_batch|auto",
                         "kind", default="auto"))
+    p.add_option(["supervised"],
+                 Toggle("supervise --batch runs: auto-checkpoint, "
+                        "retry-with-backoff, engine-degradation ladder"))
+    p.add_option(["checkpoint-dir"],
+                 Option("checkpoint directory for supervised runs",
+                        "dir"))
+    p.add_option(["checkpoint-every"],
+                 Option("checkpoint every N retired steps (supervised; "
+                        "default 1000000)", "n", typ=int))
+    p.add_option(["max-retries"],
+                 Option("retry budget per engine tier (supervised)",
+                        "n", typ=int))
     p.add_positional("wasm_file", "WebAssembly file to run")
     return p
 
@@ -100,6 +112,19 @@ def _build_conf(p: ArgumentParser) -> Configure:
         st.cost_limit = p._opts["gas-limit"].value
     if p._opts["memory-page-limit"].seen:
         conf.runtime.max_memory_pages = p._opts["memory-page-limit"].value
+    if p._opts["checkpoint-dir"].seen:
+        conf.supervisor.checkpoint_dir = p._opts["checkpoint-dir"].value
+    if p._opts["checkpoint-every"].seen:
+        conf.supervisor.checkpoint_every_steps = \
+            p._opts["checkpoint-every"].value
+    if p._opts["max-retries"].seen:
+        conf.supervisor.max_retries = p._opts["max-retries"].value
+    if p._opts["supervised"].value and not (
+            conf.supervisor.checkpoint_every_steps
+            or conf.supervisor.checkpoint_every_s):
+        # --supervised promises auto-checkpointing: without an explicit
+        # cadence every retry would silently restart from step 0
+        conf.supervisor.checkpoint_every_steps = 1_000_000
     try:
         conf.engine = EngineKind(p._opts["engine"].value)
     except ValueError:
@@ -188,7 +213,8 @@ def run_command(argv: List[str], out=None, err=None) -> int:
                 res = vm.execute_batch(
                     fn_name,
                     [np.full(batch_lanes, int(a, 0), np.int64)
-                     for a in fn_args], lanes=batch_lanes)
+                     for a in fn_args], lanes=batch_lanes,
+                    supervised=p._opts["supervised"].value)
                 out.write(f"{[int(r[0]) for r in res.results]}"
                           f" ({int(res.completed.sum())}/{batch_lanes} lanes"
                           f" completed, {int(res.retired.sum())} instrs)\n")

@@ -148,6 +148,59 @@ class BatchConfigure:
 
 
 @dataclasses.dataclass
+class SupervisorConfigure:
+    """Knobs for supervised batch execution (batch/supervisor.py).
+
+    The supervisor wraps long-lived batch runs with automatic
+    checkpointing, retry-with-backoff, and an engine-degradation ladder
+    (Pallas -> jit SIMT -> gas-metered scalar); structured
+    FailureRecords land in common/statistics.py."""
+
+    # --- checkpoint cadence (batch/checkpoint.py snapshots) ---
+    # Take a checkpoint every N retired-step slice boundary (rounded up
+    # to whole steps_per_launch chunks).  None = no step cadence.
+    checkpoint_every_steps: Optional[int] = None
+    # ... or every S seconds of wall clock, whichever fires first.
+    checkpoint_every_s: Optional[float] = None
+    # Where snapshots land ("ckpt-<steps>.npz", written atomically via a
+    # temp file + os.replace).  None with a cadence set auto-creates a
+    # temp directory (recorded on the supervisor as .checkpoint_dir).
+    checkpoint_dir: Optional[str] = None
+    # Lineage depth: older snapshots beyond this count are pruned.  A
+    # corrupted newest snapshot falls back to the next in the lineage.
+    keep_checkpoints: int = 2
+    # --- retry / backoff ---
+    # Consecutive failed attempts (no forward progress) before the
+    # current engine tier is abandoned and the run demotes a tier.
+    max_retries: int = 3
+    # Exponential backoff between retries: min(backoff_max_s,
+    # backoff_base_s * backoff_factor**(attempt-1)).
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 2.0
+    # --- per-lane quarantine ---
+    # A failure attributed to a concrete lane set (exceptions carrying a
+    # .lanes attribute, e.g. from the fault-injection harness) repeating
+    # this many times quarantines those lanes — demoted to the scalar
+    # engine when the module is side-effect-free, else terminated with
+    # ErrCode.Terminated — instead of sinking the whole batch.
+    poison_lane_retries: int = 2
+    # A lane still running after retiring this many instructions is
+    # terminated (ErrCode.Terminated) and recorded as a "runaway" —
+    # the generalization of the r6 v128_residue_step_cap quarantine.
+    # None disables the cap.
+    lane_step_cap: Optional[int] = None
+    # --- ladder gates ---
+    # Attempt the Pallas/BlockScheduler kernel tier first when eligible
+    # (single-module, pallas enabled).  Checkpoint cadence only applies
+    # on the SIMT tier, whose BatchState the checkpoint layer snapshots.
+    use_kernel_tier: bool = True
+    # Allow the bottom rung: whole-batch gas-metered scalar re-execution
+    # (side-effect-free single-module batches only).
+    allow_scalar_tier: bool = True
+
+
+@dataclasses.dataclass
 class CompilerConfigure:
     """AOT-compiler knobs (reference: CompilerConfigure,
     include/common/configure.h:28-106).  The optimization level and
@@ -172,6 +225,8 @@ class Configure:
     runtime: RuntimeConfigure = dataclasses.field(default_factory=RuntimeConfigure)
     statistics: StatisticsConfigure = dataclasses.field(default_factory=StatisticsConfigure)
     batch: BatchConfigure = dataclasses.field(default_factory=BatchConfigure)
+    supervisor: SupervisorConfigure = dataclasses.field(
+        default_factory=SupervisorConfigure)
     compiler: CompilerConfigure = dataclasses.field(default_factory=CompilerConfigure)
 
     def add_proposal(self, p: Proposal) -> "Configure":

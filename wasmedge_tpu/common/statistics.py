@@ -5,11 +5,21 @@ statistics.h:29-191): per-run instruction count, per-opcode cost table with a
 limit (gas), and Wasm-vs-host time split. The batch engine keeps per-lane
 retired-instruction and fuel counters in device state and folds them in here
 on sync (SURVEY.md §5.1 TPU equivalent).
+
+Supervision addition: `FailureRecord` is the structured failure taxonomy
+of the supervised batch layer (batch/supervisor.py) — every recovered or
+degraded incident (device launch failure, host-serve exception, corrupted
+checkpoint, poisoned/runaway lane, tier demotion) lands here, either on a
+Statistics instance or in the process-wide bounded log, so long-lived
+servers can export what their batches survived.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import time
+from collections import deque
+from typing import Optional, Tuple
 
 from wasmedge_tpu.common.errors import ErrCode, TrapError
 from wasmedge_tpu.common.opcodes import NUM_OPCODES
@@ -17,6 +27,52 @@ from wasmedge_tpu.common.opcodes import NUM_OPCODES
 # The cost table covers lowered pseudo-ops (BR/BRZ/BRNZ) appended after the
 # wasm opcode space by validator/image.py.
 _NUM_COST_SLOTS = NUM_OPCODES + 3
+
+
+@dataclasses.dataclass
+class FailureRecord:
+    """One supervised-execution incident.
+
+    fault_class: "launch" (kernel dispatch / XLA failure), "serve"
+    (host-side WASI drain raised), "checkpoint" (unreadable/corrupt
+    snapshot skipped in the lineage), "poison_lane" (lane set repeatedly
+    faulting the kernel, demoted or terminated), "runaway" (lane past the
+    per-lane step cap, terminated), "demote" (engine tier given up on),
+    or "scalar_rerun" (host-side error inside the scalar bottom rung).
+    """
+
+    fault_class: str
+    error: str = ""
+    lanes: Tuple[int, ...] = ()      # affected lanes; () = whole batch
+    retry: int = 0                   # retry count when the incident fired
+    checkpoint: Optional[str] = None  # checkpoint lineage member involved
+    tier: str = ""                   # engine tier: "pallas"|"simt"|"scalar"
+    time_s: float = 0.0              # time.time() stamp
+
+    def asdict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["lanes"] = [int(x) for x in self.lanes]
+        return d
+
+
+# Process-wide bounded failure log: components without a Statistics at
+# hand (the block scheduler's quarantine, engine internals) record here;
+# Statistics instances mirror into it so one export point sees all.
+_FAILURE_LOG: deque = deque(maxlen=256)
+
+
+def record_failure(rec: FailureRecord):
+    if not rec.time_s:
+        rec.time_s = time.time()
+    _FAILURE_LOG.append(rec)
+
+
+def recent_failures() -> list:
+    return list(_FAILURE_LOG)
+
+
+def clear_failures():
+    _FAILURE_LOG.clear()
 
 
 class Statistics:
@@ -36,6 +92,13 @@ class Statistics:
         self.host_ns = 0
         self._wasm_t0 = None
         self._host_t0 = None
+        self.failures = []  # FailureRecords from supervised runs
+
+    def add_failure(self, rec: FailureRecord):
+        """Attach a supervised-execution incident to this run's stats and
+        mirror it into the process-wide log."""
+        self.failures.append(rec)
+        record_failure(rec)
 
     # -- counters ----------------------------------------------------------
     def inc_instr(self, n: int = 1):
@@ -78,10 +141,13 @@ class Statistics:
         return self.instr_count / (self.wasm_ns / 1e9)
 
     def dump(self) -> dict:
-        return {
+        out = {
             "instr_count": self.instr_count,
             "total_cost": self.total_cost,
             "wasm_ns": self.wasm_ns,
             "host_ns": self.host_ns,
             "instr_per_second": self.instr_per_second,
         }
+        if self.failures:
+            out["failures"] = [r.asdict() for r in self.failures]
+        return out

@@ -1,0 +1,149 @@
+"""Deterministic fault injection for supervised batch execution.
+
+The supervisor (batch/supervisor.py) exposes seeded injection seams —
+`"launch"` before every kernel dispatch, `"serve"` before every tier-1
+hostcall drain (both armed through `BatchEngine._fault_hook` inside
+`run_from_state`), `"checkpoint_save"` / `"checkpoint_load"` around the
+snapshot lineage.  A `FaultInjector` counts arrivals at each seam and
+raises an `InjectedFault` at the configured occurrence indices, so a test
+can reproduce "the 3rd launch dies", "the first WASI drain raises", or
+"the newest checkpoint is corrupt" bit-for-bit every run.
+
+Fault classes covered by the tier-1 suite (ISSUE 2 acceptance):
+  - launch-time device error       Fault(point="launch", ...)
+  - mid-serve host exception       Fault(point="serve", ...)
+  - corrupted/truncated checkpoint corrupt_checkpoint(path, ...) via
+                                   Fault.before, or a "checkpoint_load"
+                                   fault
+  - runaway / poison lane          build_selective_runaway() +
+                                   SupervisorConfigure.lane_step_cap, or
+                                   a lane-attributed Fault(lanes=(k,))
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    """The exception a Fault raises; carries the seam name and an
+    optional lane attribution the supervisor's poison-quarantine path
+    consumes (real device errors carry no attribution — whole-batch
+    retry is the fallback)."""
+
+    def __init__(self, point: str, index: int, lanes: Tuple[int, ...] = (),
+                 message: str = ""):
+        super().__init__(
+            message or f"injected fault at {point}[{index}]"
+            + (f" lanes={list(lanes)}" if lanes else ""))
+        self.point = point
+        self.index = index
+        self.lanes = tuple(int(x) for x in lanes)
+
+
+@dataclasses.dataclass
+class Fault:
+    """One armed fault: fire on arrivals [at, at + times) at `point`."""
+
+    point: str                 # "launch" | "serve" | "checkpoint_save" |
+    #                            "checkpoint_load"
+    at: int = 0                # 0-based arrival index at that seam
+    times: int = 1             # consecutive arrivals that fault
+    lanes: Tuple[int, ...] = ()  # lane attribution (poison quarantine)
+    message: str = ""
+    # runs just before raising — e.g. corrupt the newest checkpoint file
+    # so the restore path exercises the lineage fallback
+    before: Optional[Callable[..., None]] = None
+    # custom exception factory (ctx dict -> exception); default
+    # InjectedFault
+    exc: Optional[Callable[..., BaseException]] = None
+
+
+class FaultInjector:
+    """Deterministic seam counter: `fire(point, **ctx)` raises when an
+    armed fault covers this arrival.  `log` records every raised fault
+    as (point, index) for assertions."""
+
+    def __init__(self, faults: Sequence[Fault]):
+        self.faults = list(faults)
+        self.counts = {}
+        self.log = []
+
+    def fire(self, point: str, **ctx):
+        i = self.counts.get(point, 0)
+        self.counts[point] = i + 1
+        for f in self.faults:
+            if f.point != point or not (f.at <= i < f.at + f.times):
+                continue
+            if f.before is not None:
+                f.before()
+            self.log.append((point, i))
+            if f.exc is not None:
+                raise f.exc(dict(ctx, point=point, index=i))
+            raise InjectedFault(point, i, lanes=f.lanes,
+                                message=f.message)
+
+    @property
+    def fired(self) -> int:
+        return len(self.log)
+
+
+def seeded_faults(seed: int, points: Sequence[str] = ("launch", "serve"),
+                  n: int = 1, max_at: int = 4) -> list:
+    """Derive `n` faults deterministically from a seed — the fuzz mode
+    of the harness (same seed, same incident schedule)."""
+    rng = np.random.RandomState(int(seed) & 0x7FFFFFFF)
+    out = []
+    for _ in range(n):
+        out.append(Fault(point=points[int(rng.randint(len(points)))],
+                         at=int(rng.randint(max_at + 1))))
+    return out
+
+
+def corrupt_checkpoint(path, mode: str = "truncate", seed: int = 0):
+    """Damage a checkpoint file in place — the "corrupted/truncated
+    checkpoint" fault class.  `truncate` cuts the file mid-archive (an
+    interrupted non-atomic writer); `flip` xor-scrambles a byte span (bit
+    rot / torn write).  checkpoint.load must refuse both cleanly."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if mode == "truncate":
+        data = data[:max(len(data) // 2, 1)]
+    elif mode == "flip":
+        rng = np.random.RandomState(seed)
+        pos = int(rng.randint(max(len(data) - 64, 1)))
+        for k in range(min(64, len(data) - pos)):
+            data[pos + k] ^= 0xA5
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+
+
+def build_selective_runaway() -> bytes:
+    """Module whose export `work(n)` loops forever for n < 0 and returns
+    sum(0..n) otherwise — one poisoned argument turns one lane into a
+    runaway while its neighbours finish.  Drives the supervisor's
+    lane_step_cap quarantine in tests and the faults smoke bench."""
+    from wasmedge_tpu.utils.builder import ModuleBuilder
+
+    b = ModuleBuilder()
+    b.add_function(["i32"], ["i32"], ["i32", "i32"], [
+        ("local.get", 0), ("i32.const", 0), "i32.lt_s",
+        ("if", None),
+        ("loop", None), ("br", 0), "end",
+        "end",
+        ("block", None),
+        ("loop", None),
+        ("local.get", 1), ("local.get", 0), "i32.ge_u", ("br_if", 1),
+        ("local.get", 2), ("local.get", 1), "i32.add", ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        ("br", 0),
+        "end",
+        "end",
+        ("local.get", 2),
+    ], export="work")
+    return b.build()

@@ -203,10 +203,16 @@ class VM:
 
     def execute_batch(self, func_name: str, args_lanes: Sequence,
                       lanes: Optional[int] = None, mesh=None,
-                      max_steps: int = 10_000_000):
+                      max_steps: int = 10_000_000, supervised: bool = False):
         """Run the instantiated module's export over N device lanes in SIMT
         lockstep (the tpu_batch engine, SURVEY.md §2.10) and return the
-        BatchResult (per-lane results/trap/retired arrays)."""
+        BatchResult (per-lane results/trap/retired arrays).
+
+        `supervised=True` wraps the run in the supervision layer
+        (batch/supervisor.py): periodic checkpoints, retry-with-backoff
+        from the last good snapshot, and the Pallas -> SIMT -> scalar
+        degradation ladder, with FailureRecords landing on this VM's
+        Statistics (conf.supervisor holds the knobs)."""
         from wasmedge_tpu.batch.uniform import UniformBatchEngine
 
         with self._lock:
@@ -216,6 +222,15 @@ class VM:
         # the auto engine: Pallas warp-interpreter on TPU, XLA uniform on
         # CPU, SIMT for divergence/fuel/mesh — all behind one run()
         conf = batch_conf_with_gas(self.conf, self.stat)
+        if supervised:
+            from wasmedge_tpu.batch.engine import BatchEngine
+            from wasmedge_tpu.batch.supervisor import BatchSupervisor
+
+            eng = BatchEngine(inst, store=self.store, conf=conf,
+                              lanes=lanes, mesh=mesh)
+            sup = BatchSupervisor(eng, conf=conf, stats=self.stat)
+            return sup.run(func_name, list(args_lanes),
+                           max_steps=max_steps)
         eng = UniformBatchEngine(inst, store=self.store, conf=conf,
                                  lanes=lanes, mesh=mesh)
         return eng.run(func_name, list(args_lanes), max_steps=max_steps)
