@@ -45,16 +45,44 @@ def _build(lanes):
     from wasmedge_tpu.runtime.store import StoreManager
     from wasmedge_tpu.validator import Validator
 
+    import os
+
     conf = Configure()
     conf.batch.steps_per_launch = 50_000_000
     # Size the per-lane stacks to the workload (fib(30) needs ~180 value
     # slots / 30 frames); smaller state -> bigger lane blocks in VMEM.
     conf.batch.value_stack_depth = 256
     conf.batch.call_stack_depth = 256
+    # Flight recorder on by default (events are per-launch, and the
+    # flagship is a handful of launches — immeasurable against a
+    # 50M-step chunk); the trace artifact ships alongside the bench
+    # JSON so a regression investigation starts from attributable
+    # timings, not aggregates.  BENCH_OBS=off measures the recorder-
+    # DISABLED configuration the r5/r6 floors were taken under — the
+    # mode to reach for when separating a suspected obs overhead
+    # regression from an engine regression.
+    conf.obs.enabled = os.environ.get("BENCH_OBS", "on") != "off"
     mod = Validator(conf).validate(Loader(conf).parse_module(build_fib()))
     store = StoreManager()
     inst = Executor(conf).instantiate(store, mod)
     return UniformBatchEngine(inst, store=store, conf=conf, lanes=lanes)
+
+
+def _emit_trace(rec, default_path):
+    """Write the flight-recorder trace next to the bench artifact
+    (stdout stays one JSON line for the driver; BENCH_ARTIFACT
+    redirects/disables apply like every other artifact)."""
+    from wasmedge_tpu.utils.bench_artifact import artifact_path
+
+    path = artifact_path(default_path)
+    if path is None or rec is None or not rec.enabled:
+        return
+    from wasmedge_tpu.obs.trace import export_chrome_trace
+
+    try:
+        export_chrome_trace(rec, path)
+    except OSError:
+        pass  # the artifact is a record, never a bench failure
 
 
 def _native_baseline_ops():
@@ -67,36 +95,27 @@ def _native_baseline_ops():
         return RECORDED_CPP_INTERP_OPS, "recorded-estimate"
 
 
-def faults_smoke() -> int:
-    """`bench.py --faults-smoke`: run the echo workload once under a
-    single injected launch fault and assert the supervisor recovers —
-    the CI guard that supervised execution stays wired end-to-end.
-    Prints ONE JSON line; emits no benchmark artifact (this mode
-    measures recovery, not throughput)."""
+def _smoke_echo_engine(conf, lanes):
+    """Shared smoke scaffolding: echo module + WASI with fd 1 sunk to
+    /dev/null, tiny stacks/chunks, flight recorder on.  Returns
+    (engine, sink_fd); used by --faults-smoke and --trace-smoke so the
+    two CI modes exercise the same construction path."""
     import os
-    import tempfile
 
     import bench_echo
     from wasmedge_tpu.batch.engine import BatchEngine
-    from wasmedge_tpu.batch.supervisor import BatchSupervisor
-    from wasmedge_tpu.common.configure import Configure
     from wasmedge_tpu.executor import Executor
     from wasmedge_tpu.host.wasi import WasiModule
     from wasmedge_tpu.loader import Loader
     from wasmedge_tpu.runtime.store import StoreManager
-    from wasmedge_tpu.testing.faults import Fault, FaultInjector
     from wasmedge_tpu.validator import Validator
 
-    lanes, iters = 64, 2
-    conf = Configure()
-    # small chunks so the injected fault lands mid-run, after at least
-    # one checkpoint exists (the echo workload retires in a few hundred
-    # steps per lane)
+    # small chunks so injected faults land mid-run, after at least one
+    # checkpoint exists (echo retires in a few hundred steps per lane)
     conf.batch.steps_per_launch = 100
     conf.batch.value_stack_depth = 64
     conf.batch.call_stack_depth = 16
-    conf.supervisor.checkpoint_every_steps = 100
-    conf.supervisor.backoff_base_s = 0.0
+    conf.obs.enabled = True
     wasi = WasiModule()
     wasi.init_wasi(dirs=[], prog_name="echo")
     sink = os.open(os.devnull, os.O_WRONLY)
@@ -107,7 +126,28 @@ def faults_smoke() -> int:
     ex = Executor(conf)
     ex.register_import_object(store, wasi)
     inst = ex.instantiate(store, mod)
-    eng = BatchEngine(inst, store=store, conf=conf, lanes=lanes)
+    return BatchEngine(inst, store=store, conf=conf, lanes=lanes), sink
+
+
+def faults_smoke() -> int:
+    """`bench.py --faults-smoke`: run the echo workload once under a
+    single injected launch fault and assert the supervisor recovers —
+    the CI guard that supervised execution stays wired end-to-end (the
+    recorder is on, so the smoke also asserts the injected incident
+    shows up in the trace).  Prints ONE JSON line; emits no benchmark
+    artifact (this mode measures recovery, not throughput)."""
+    import os
+    import tempfile
+
+    from wasmedge_tpu.batch.supervisor import BatchSupervisor
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.testing.faults import Fault, FaultInjector
+
+    lanes, iters = 64, 2
+    conf = Configure()
+    conf.supervisor.checkpoint_every_steps = 100
+    conf.supervisor.backoff_base_s = 0.0
+    eng, sink = _smoke_echo_engine(conf, lanes)
     inj = FaultInjector([Fault(point="launch", at=1)])
     t0 = time.perf_counter()
     with tempfile.TemporaryDirectory(prefix="faults-smoke-") as d:
@@ -117,8 +157,13 @@ def faults_smoke() -> int:
                       max_steps=1_000_000)
     dt = time.perf_counter() - t0
     os.close(sink)
+    # the injected incident must be visible in the flight recorder's
+    # event stream (mirrored FailureRecord instant on the supervisor
+    # track) — the fault harness and the obs subsystem stay wired
+    trace_has_incident = "failure/launch" in sup.obs.event_names()
     ok = bool(res.completed.all()) and inj.fired == 1 \
-        and any(f.fault_class == "launch" for f in sup.failures)
+        and any(f.fault_class == "launch" for f in sup.failures) \
+        and trace_has_incident
     print(json.dumps({
         "metric": "faults_smoke_echo_recovery",
         "value": 1 if ok else 0,
@@ -126,6 +171,58 @@ def faults_smoke() -> int:
         "ok": ok,
         "injected": inj.fired,
         "failures": [f.fault_class for f in sup.failures],
+        "trace_has_incident": trace_has_incident,
+        "lanes": lanes,
+        "wall_s": round(dt, 3),
+    }))
+    return 0 if ok else 1
+
+
+def trace_smoke() -> int:
+    """`bench.py --trace-smoke`: run echo x64 with the flight recorder
+    on and validate the emitted Chrome trace_event JSON against the
+    schema (obs/trace.py validate_chrome_trace) — the CI guard that the
+    observability pipeline stays wired end-to-end.  Prints ONE JSON
+    line; no artifact emission."""
+    import io
+    import json as _json
+    import os
+
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.obs.trace import export_chrome_trace, \
+        validate_chrome_trace
+
+    lanes, iters = 64, 2
+    conf = Configure()
+    conf.batch.tier0_hostcalls = False  # exercise the tier-1 drain path
+    eng, sink = _smoke_echo_engine(conf, lanes)
+    t0 = time.perf_counter()
+    res = eng.run("echo", [np.full(lanes, iters, np.int64)],
+                  max_steps=1_000_000)
+    dt = time.perf_counter() - t0
+    os.close(sink)
+    buf = io.StringIO()
+    obj = export_chrome_trace(eng.obs, buf)
+    _json.loads(buf.getvalue())  # emitted bytes are real JSON
+    problems = validate_chrome_trace(obj)
+    names = eng.obs.event_names()
+    checks = {
+        "completed": bool(res.completed.all()),
+        "schema_ok": not problems,
+        "has_launch_span": "launch" in names,
+        "has_serve_span": "serve" in names,
+        "has_occupancy_counter": "live_lanes" in names,
+        "has_drain_histogram": "fd_write" in eng.obs.hostcalls,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "trace_smoke_echo_schema",
+        "value": 1 if ok else 0,
+        "unit": "valid",
+        "ok": ok,
+        **checks,
+        "problems": problems[:5],
+        "events": len(eng.obs.events),
         "lanes": lanes,
         "wall_s": round(dt, 3),
     }))
@@ -166,6 +263,7 @@ def main():
         "unit": "wasm_instr/s",
         "vs_baseline": round(vs, 4),
         "engine": engine,
+        "obs": bool(eng.obs.enabled),
         "steps": int(res.steps),
         "wall_s": round(dt, 3),
         "baseline_ops_per_sec": round(base_ops, 1),
@@ -173,7 +271,8 @@ def main():
     }
     from wasmedge_tpu.utils.bench_artifact import emit
 
-    emit(out, "BENCH_r06.json")
+    emit(out, "BENCH_r08.json")
+    _emit_trace(eng.obs, "BENCH_r08.trace.json")
     # extra context on stderr (driver only parses stdout JSON)
     print(f"# engine={engine} lanes={LANES} steps={res.steps} wall={dt:.2f}s "
           f"retired_total={total_retired:.3g} baseline={base_ops:.3g} "
@@ -190,4 +289,6 @@ def _fib(n):
 if __name__ == "__main__":
     if "--faults-smoke" in sys.argv[1:]:
         sys.exit(faults_smoke())
+    if "--trace-smoke" in sys.argv[1:]:
+        sys.exit(trace_smoke())
     main()

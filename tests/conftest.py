@@ -29,6 +29,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "faults: deterministic fault-injection suite "
         "(supervised execution; tier-1 fast, runs under -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "obs: observability suite (flight recorder, trace/"
+        "metrics export; tier-1 fast, runs under -m 'not slow')")
 
 
 def pytest_addoption(parser):

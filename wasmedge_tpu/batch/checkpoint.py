@@ -52,8 +52,14 @@ def image_fingerprint(img) -> str:
     return h.hexdigest()
 
 
-def save(path, engine: BatchEngine, state: BatchState, total_steps: int):
-    """Snapshot an in-flight batch to `path` (.npz)."""
+def save(path, engine: BatchEngine, state: BatchState, total_steps: int,
+         invocation=None):
+    """Snapshot an in-flight batch to `path` (.npz).
+
+    `invocation` (optional dict, e.g. the supervisor's function-name +
+    argument fingerprint) is recorded in the metadata so a CROSS-PROCESS
+    resume can refuse a snapshot taken for a different call — the image
+    hash alone cannot tell f(30) from f(31)."""
     cfg = engine.cfg
     meta = {
         "format": FORMAT_VERSION,
@@ -69,6 +75,8 @@ def save(path, engine: BatchEngine, state: BatchState, total_steps: int):
             "mem_pages_max": int(engine.img.mem_pages_max),
         },
     }
+    if invocation is not None:
+        meta["invocation"] = invocation
     arrays = {f"state_{name}": np.asarray(getattr(state, name))
               for name in state._fields
               if getattr(state, name) is not None}
@@ -82,6 +90,14 @@ def save(path, engine: BatchEngine, state: BatchState, total_steps: int):
         # truncated .npz at the target path for a later resume to trip
         # over (or clobber a previous good snapshot).
         atomic_write_bytes(path, data)
+
+
+def read_meta(path) -> dict:
+    """The metadata record alone (no state reconstruction) — used by
+    the supervisor's cross-process lineage adoption to check the
+    invocation binding before paying for a full load."""
+    with np.load(path, allow_pickle=False) as z:
+        return json.loads(str(z["meta"]))
 
 
 def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:

@@ -133,6 +133,11 @@ class BatchState(NamedTuple):
     #                         fd_write count / yield+exit count
     so_buf: object = None   # [SW, lanes] int32 stdout record buffer
     so_off: object = None   # [lanes] int32 next free word in so_buf
+    # r08 observability plane (Configure.obs.opcode_histogram): per-pc
+    # retired count, scatter-incremented once per step across lanes and
+    # folded into per-opcode counts (img.op_id -> Statistics cost_table
+    # domain) on sync.  None unless the knob is on (no per-step cost).
+    op_hist: object = None
 
 
 @dataclasses.dataclass
@@ -185,6 +190,21 @@ def r05_state_planes(img: DeviceImage, lanes: int) -> dict:
     if bool(np.isin(cls, (CLS_MEMINIT, CLS_DATA_DROP)).any()):
         out["ddrop"] = jnp.zeros((img.data_len.shape[0], lanes), jnp.int32)
     return out
+
+
+def obs_state_planes(conf, img: DeviceImage, mesh=None) -> dict:
+    """Initial op_hist plane for the device-side opcode histogram
+    (Configure.obs.opcode_histogram).  {} when the knob is off — the
+    BatchState default (None) then keeps the step function free of the
+    per-step scatter entirely.  Mesh runs skip the plane (it has no
+    lane axis to shard)."""
+    obs_conf = getattr(conf, "obs", None)
+    if mesh is not None or obs_conf is None \
+            or not (obs_conf.enabled and obs_conf.opcode_histogram):
+        return {}
+    import jax.numpy as jnp
+
+    return {"op_hist": jnp.zeros((img.cls.shape[0],), jnp.int32)}
 
 
 # ---------------------------------------------------------------------------
@@ -1585,6 +1605,11 @@ class BatchEngine:
         self.inst = inst
         self.store = store  # kept for re-deriving engines (scheduler)
         self.hostcall_stats = new_hostcall_stats()
+        # flight recorder (obs/): the shared ring when conf.obs is
+        # enabled, the no-op guard object otherwise
+        from wasmedge_tpu.obs.recorder import recorder_of
+
+        self.obs = recorder_of(self.conf)
         if img is not None:
             # share an already-built (and already-normalized) image — the
             # scheduler derives width-variant engines from one module
@@ -1738,13 +1763,25 @@ class BatchEngine:
         chunk = self.cfg.steps_per_launch
 
         def run_chunk(state, t0_time):
+            # trace-time static: the plane is None unless the obs
+            # opcode-histogram knob allocated it (obs_state_planes), so
+            # the disabled configuration compiles the exact seed loop
+            track_hist = state.op_hist is not None
+
             def cond(carry):
                 i, s = carry
                 return (i < chunk) & jnp.any(s.trap == 0)
 
             def body(carry):
                 i, s = carry
-                return i + 1, step(s, t0_time)
+                s2 = step(s, t0_time)
+                if track_hist:
+                    # attribute the step to the PRE-step pc of each
+                    # live lane (step() itself carries op_hist as None)
+                    pc = jnp.clip(s.pc, 0, s.op_hist.shape[0] - 1)
+                    s2 = s2._replace(op_hist=s.op_hist.at[pc].add(
+                        (s.trap == 0).astype(jnp.int32)))
+                return i + 1, s2
 
             i, state = lax.while_loop(cond, body, (jnp.int32(0), state))
             return i, state
@@ -1821,6 +1858,7 @@ class BatchEngine:
             **r05_state_planes(img, L),
             **t0_state_planes(img, cfg, L,
                               kinds=getattr(self, "_t0kinds", None)),
+            **obs_state_planes(self.conf, img, mesh=self.mesh),
         )
 
     def run(self, func_name: str, args_lanes: List[np.ndarray],
@@ -1885,19 +1923,39 @@ class BatchEngine:
         # arms this before a launch / a tier-1 serve so injected device
         # and host failures raise exactly where real ones would
         fault = getattr(self, "_fault_hook", None)
+        obs = self.obs
+        if obs.enabled:
+            prev_ret = int(np.asarray(state.retired, np.int64).sum())
         while total < max_steps:
             # per-relaunch time base: host->device only, no round trip
             # (rides the launch as a non-donated argument)
             tt = jnp.asarray(t0_time_planes() if t0_active else dummy_time)
             if fault is not None:
                 fault("launch", total=total)
+            t_launch = obs.now()
             done_steps, state = self._run_chunk(state, tt)
             total += int(done_steps)
             trap_host = np.asarray(state.trap)
-            if (trap_host == TRAP_HOSTCALL).any():
+            parked = int((trap_host == TRAP_HOSTCALL).sum())
+            if obs.enabled:
+                # per-launch span with lane occupancy + retired delta
+                # (one extra device read per LAUNCH, never per step)
+                live = int((trap_host == 0).sum())
+                ret = int(np.asarray(state.retired, np.int64).sum())
+                obs.span("launch", t_launch, cat="engine", track="simt",
+                         steps=int(done_steps), live_lanes=live,
+                         parked_lanes=parked,
+                         retired_delta=ret - prev_ret)
+                prev_ret = ret
+                obs.counter("live_lanes", live)
+                obs.counter("hostcall_queue_depth", parked)
+            if parked:
                 if fault is not None:
                     fault("serve", total=total)
+                t_serve = obs.now()
                 state = serve_batch_state(self, state)
+                obs.span("serve", t_serve, cat="engine", track="simt",
+                         lanes=parked)
                 continue
             if not (trap_host == 0).any():
                 break
@@ -1908,8 +1966,11 @@ class BatchEngine:
         # pending calls once — the lanes come back as trap == 0 ("still
         # running when max_steps ran out"), the documented semantic.
         if (np.asarray(state.trap) == TRAP_HOSTCALL).any():
+            t_serve = obs.now()
             state = serve_batch_state(self, state)
+            obs.span("serve", t_serve, cat="engine", track="simt")
         state = flush_stdout_buffers(self, state)
+        state = self._fold_op_hist(state)
         if t0_active:
             ctr = np.asarray(state.t0_ctr, np.int64).sum(axis=1) - ctr_in
             st_ = self.hostcall_stats
@@ -1919,3 +1980,23 @@ class BatchEngine:
             st_["tier0_sys"] += int(ctr[3])
             st_["tier0_calls"] += int(ctr.sum())
         return state, total
+
+    def _fold_op_hist(self, state):
+        """Fold + reset the device opcode-histogram plane: per-pc counts
+        map through img.op_id into the Statistics cost_table opcode
+        domain and land on the flight recorder (VM.execute_batch folds
+        them onward into its Statistics)."""
+        if getattr(state, "op_hist", None) is None:
+            return state
+        import jax.numpy as jnp
+
+        from wasmedge_tpu.validator.image import NUM_LOPS
+
+        pc_counts = np.asarray(state.op_hist, np.int64)
+        if pc_counts.any():
+            out = np.zeros(NUM_LOPS, np.int64)
+            np.add.at(out, np.asarray(self.img.op_id, np.int64),
+                      pc_counts)
+            self.obs.add_opcode_counts(out)
+            state = state._replace(op_hist=jnp.zeros_like(state.op_hist))
+        return state

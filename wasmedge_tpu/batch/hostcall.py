@@ -106,6 +106,18 @@ def vec_impl_for(fi):
     return VEC_WASI.get(getattr(host, "name", None)), env
 
 
+def hostcall_kind(fi) -> str:
+    """Stable label for a host function in the drain-latency histograms
+    (the WASI function name when known, else the import pair)."""
+    host = getattr(fi, "host", None)
+    name = getattr(host, "name", None)
+    if name:
+        return str(name)
+    mod = getattr(fi, "import_module", "") or "host"
+    imp = getattr(fi, "import_name", "") or "?"
+    return f"{mod}.{imp}"
+
+
 def gather_arg_cells(stack_lo, stack_hi, fp, lanes, nargs) -> np.ndarray:
     """Raw 64-bit argument cells [nargs, n] for a lane group (one fancy
     gather, no per-lane loop)."""
@@ -218,77 +230,95 @@ def serve_batch_state(engine, state):
     slab_hi = np.asarray(state.stack_hi[:max_row]) if max_row else \
         np.zeros((0, trap.size), np.int32)
 
-    stack_sets = []  # (rows [nres, n], lanes [n], lo [nres, n], hi)
-    for k in np.unique(ks):
-        lanes = waiting[ks == k]
-        fi = engine.resolve_func(int(k))
-        nargs = nargs_by_k[int(k)]
-        cells = codes = None
-        if use_vec and has_mem and getattr(fi, "kind", None) == "host":
-            vecfn, env = vec_impl_for(fi)
-            if vecfn is not None:
-                args = gather_arg_cells(slab_lo, slab_hi, fp, lanes,
-                                        nargs)
-                view = make_cached_view(cache, lanes, pages[lanes])
-                try:
-                    cells, codes = vecfn(env, view, args)
-                except NotVectorizable:
-                    cells = codes = None
-        if cells is not None:
-            if stats is not None:
-                stats["tier1_vectorized"] += int(lanes.size)
-            ok = codes == 0
-            okl = lanes[ok]
-            nres = cells.shape[0]
-            if okl.size and nres:
-                cu = cells[:, ok].astype(np.uint64)
-                obk = np.asarray(opbase[okl], np.int64)
-                rows = obk[None, :] + np.arange(nres,
-                                                dtype=np.int64)[:, None]
-                lo_v = (cu & np.uint64(MASK32)).astype(
-                    np.uint32).view(np.int32)
-                hi_v = (cu >> np.uint64(32)).astype(
-                    np.uint32).view(np.int32)
-                stack_sets.append((rows, okl, lo_v, hi_v))
-            sp[okl] = opbase[okl] + nres
-            new_trap[lanes] = np.where(ok, 0, codes)
-            new_pc[okl] = pc[okl] + 1  # resume at the stub's RETURN
-            continue
-        # ---- per-lane fallback (chunk-cached lane memory views) ----
-        g_rows, g_lanes, g_lo, g_hi = [], [], [], []
-        for lane in lanes:
-            base = int(fp[lane])
-            args1 = []
-            for i in range(nargs):
-                lo = int(np.uint32(slab_lo[base + i, lane]))
-                hi = int(np.uint32(slab_hi[base + i, lane]))
-                args1.append(lo | (hi << 32))
-            lane_mem = None
-            if has_mem:
-                lane_mem = _CachedLaneMemory(
-                    cache, int(lane), int(pages[lane]), max_pages,
-                    plane_cap)
-            out, code = serve_one(fi, args1, lane_mem)
-            if code:
-                new_trap[lane] = code
-                continue
-            ob = int(opbase[lane])
-            for i, cell in enumerate(out):
-                g_rows.append(ob + i)
-                g_lanes.append(int(lane))
-                g_lo.append(np.int32(np.uint32(cell & MASK32)))
-                g_hi.append(np.int32(np.uint32((cell >> 32) & MASK32)))
-            sp[lane] = ob + len(out)
-            if has_mem:
-                pages[lane] = lane_mem.pages  # host fn may have grown
-            new_trap[lane] = 0
-            new_pc[lane] = pc[lane] + 1  # resume at the stub's RETURN
-        if g_rows:
-            stack_sets.append((np.asarray(g_rows, np.int64)[None, :],
-                               np.asarray(g_lanes, np.int64),
-                               np.asarray(g_lo, np.int32)[None, :],
-                               np.asarray(g_hi, np.int32)[None, :]))
+    obs = getattr(engine, "obs", None)
+    # per-kind drain-latency seam: vectorized implementations time
+    # themselves (host/wasi/vectorized.py), the per-lane fallback is
+    # timed below; restored after the group loop even when a host
+    # function raises mid-drain
+    from wasmedge_tpu.host.wasi.vectorized import set_drain_recorder
 
+    prev_rec = set_drain_recorder(obs)
+    stack_sets = []  # (rows [nres, n], lanes [n], lo [nres, n], hi)
+    try:
+        for k in np.unique(ks):
+            lanes = waiting[ks == k]
+            fi = engine.resolve_func(int(k))
+            nargs = nargs_by_k[int(k)]
+            cells = codes = None
+            if use_vec and has_mem and getattr(fi, "kind", None) == "host":
+                vecfn, env = vec_impl_for(fi)
+                if vecfn is not None:
+                    args = gather_arg_cells(slab_lo, slab_hi, fp, lanes,
+                                            nargs)
+                    view = make_cached_view(cache, lanes, pages[lanes])
+                    try:
+                        cells, codes = vecfn(env, view, args)
+                    except NotVectorizable:
+                        cells = codes = None
+            if cells is not None:
+                if stats is not None:
+                    stats["tier1_vectorized"] += int(lanes.size)
+                ok = codes == 0
+                okl = lanes[ok]
+                nres = cells.shape[0]
+                if okl.size and nres:
+                    cu = cells[:, ok].astype(np.uint64)
+                    obk = np.asarray(opbase[okl], np.int64)
+                    rows = obk[None, :] + np.arange(nres,
+                                                    dtype=np.int64)[:, None]
+                    lo_v = (cu & np.uint64(MASK32)).astype(
+                        np.uint32).view(np.int32)
+                    hi_v = (cu >> np.uint64(32)).astype(
+                        np.uint32).view(np.int32)
+                    stack_sets.append((rows, okl, lo_v, hi_v))
+                sp[okl] = opbase[okl] + nres
+                new_trap[lanes] = np.where(ok, 0, codes)
+                new_pc[okl] = pc[okl] + 1  # resume at the stub's RETURN
+                continue
+            # ---- per-lane fallback (chunk-cached lane memory views) ----
+            # restart the drain timer: the histogram's vectorized=False
+            # observation must measure the fallback loop alone, not a
+            # failed NotVectorizable attempt above it
+            t_drain = obs.now() if obs is not None else 0.0
+            g_rows, g_lanes, g_lo, g_hi = [], [], [], []
+            for lane in lanes:
+                base = int(fp[lane])
+                args1 = []
+                for i in range(nargs):
+                    lo = int(np.uint32(slab_lo[base + i, lane]))
+                    hi = int(np.uint32(slab_hi[base + i, lane]))
+                    args1.append(lo | (hi << 32))
+                lane_mem = None
+                if has_mem:
+                    lane_mem = _CachedLaneMemory(
+                        cache, int(lane), int(pages[lane]), max_pages,
+                        plane_cap)
+                out, code = serve_one(fi, args1, lane_mem)
+                if code:
+                    new_trap[lane] = code
+                    continue
+                ob = int(opbase[lane])
+                for i, cell in enumerate(out):
+                    g_rows.append(ob + i)
+                    g_lanes.append(int(lane))
+                    g_lo.append(np.int32(np.uint32(cell & MASK32)))
+                    g_hi.append(np.int32(np.uint32((cell >> 32) & MASK32)))
+                sp[lane] = ob + len(out)
+                if has_mem:
+                    pages[lane] = lane_mem.pages  # host fn may have grown
+                new_trap[lane] = 0
+                new_pc[lane] = pc[lane] + 1  # resume at the stub's RETURN
+            if obs is not None and obs.enabled:
+                obs.hostcall(hostcall_kind(fi), obs.now() - t_drain,
+                             lanes=int(lanes.size), vectorized=False)
+            if g_rows:
+                stack_sets.append((np.asarray(g_rows, np.int64)[None, :],
+                                   np.asarray(g_lanes, np.int64),
+                                   np.asarray(g_lo, np.int32)[None, :],
+                                   np.asarray(g_hi, np.int32)[None, :]))
+
+    finally:
+        set_drain_recorder(prev_rec)
     new_stack_lo = state.stack_lo
     new_stack_hi = state.stack_hi
     for rows, lanes_w, lo_v, hi_v in stack_sets:

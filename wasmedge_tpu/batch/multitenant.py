@@ -256,6 +256,9 @@ class MultiTenantBatchEngine(BatchEngine):
 
         self._t0kinds = None
         self.hostcall_stats = new_hostcall_stats()
+        from wasmedge_tpu.obs.recorder import recorder_of
+
+        self.obs = recorder_of(self.conf)
         self._step = None
         self._run_chunk = None
 

@@ -3916,6 +3916,7 @@ class PallasUniformEngine:
         self.cfg = self.simt.cfg
         self.lanes = self.simt.lanes
         self.img = self.simt.img
+        self.obs = self.simt.obs  # shared flight recorder (obs/)
         self.interpret = interpret
         opt = getattr(self.cfg, "optimistic", None)
         self.optimistic = True if opt is None else bool(opt)
@@ -4569,6 +4570,7 @@ class PallasUniformEngine:
 
         img = self.img
         D, CD, W, Lblk = self._geom
+        t_begin = self.obs.now()
         blocks = [int(b) for b in
                   np.nonzero(ctrl_np[:, _C_STATUS] == ST_HOSTCALL)[0]]
         metas = []
@@ -4593,6 +4595,17 @@ class PallasUniformEngine:
         mem_cols = state[6][:, jnp.asarray(cols)] if has_mem else None
         slab_lo = np.asarray(state[2][:max_row]) if max_row else None
         slab_hi = np.asarray(state[3][:max_row]) if max_row else None
+        obs = self.obs
+        if obs.enabled and blocks:
+            obs.span("serve_begin", t_begin, cat="scheduler",
+                     track="serve", blocks=len(blocks))
+            # queue depth counts REAL parked lanes: pad (clone) lanes
+            # are never served, so a near-empty block must not inflate
+            # the counter track by Lblk
+            vb = valid_blocks or {}
+            obs.counter("hostcall_queue_depth", sum(
+                int(vb[b].sum()) if vb.get(b) is not None else Lblk
+                for b in blocks))
         return {"blocks": blocks, "metas": metas, "cols": cols,
                 "mem_cols": mem_cols, "slab_lo": slab_lo,
                 "slab_hi": slab_hi, "Lblk": Lblk,
@@ -4638,104 +4651,129 @@ class PallasUniformEngine:
         use_vec = bool(getattr(self.cfg, "vectorized_hostcalls", True))
         stats = getattr(self.simt, "hostcall_stats", None)
         rearms = {}
+        obs = self.obs
+        t_finish = obs.now()
+        from wasmedge_tpu.host.wasi.vectorized import set_drain_recorder
 
-        for bi, (b, pc, k, fi, nargs, fp, ob, pages, cc) in \
-                enumerate(metas):
-            lo_col = b * Lblk      # absolute columns (slab / state)
-            loc = bi * Lblk        # local columns (gathered mem cache)
-            vmask = valid_blocks.get(b)
-            nres = int(img.f_nresults[k])
-            res_lo = np.zeros((max(nres, 1), Lblk), np.int32)
-            res_hi = np.zeros((max(nres, 1), Lblk), np.int32)
-            trap_codes = np.zeros(Lblk, np.int32)
-            new_pages = np.full(Lblk, pages, np.int32)
-            if stats is not None:
-                n_real = int(vmask.sum()) if vmask is not None else Lblk
-                stats["serve_rounds"] += 1 if bi == 0 else 0
-                stats["tier1_calls"] += n_real
-            served_vec = False
-            if use_vec and has_mem and getattr(fi, "kind", None) == "host":
-                vecfn, env = vec_impl_for(fi)
-                if vecfn is not None:
-                    from wasmedge_tpu.batch.hostcall import \
-                        gather_arg_cells
+        prev_rec = set_drain_recorder(obs)
 
-                    vsel = np.arange(Lblk, dtype=np.int64) \
-                        if vmask is None else \
-                        np.nonzero(vmask)[0].astype(np.int64)
-                    fp_vec = np.full(slab_lo.shape[1], fp, np.int64)
-                    args = gather_arg_cells(slab_lo, slab_hi, fp_vec,
-                                            lo_col + vsel, nargs)
-                    view = make_cached_view(cache, loc + vsel,
-                                            np.full(vsel.size, pages))
-                    try:
-                        cells, codes = vecfn(env, view, args)
-                        served_vec = True
-                    except NotVectorizable:
-                        served_vec = False
-                    if served_vec:
-                        if stats is not None:
-                            stats["tier1_vectorized"] += int(vsel.size)
-                        cu = cells.astype(np.uint64)
-                        for r in range(cells.shape[0]):
-                            res_lo[r, vsel] = (
-                                cu[r] & np.uint64(0xFFFFFFFF)).astype(
-                                    np.uint32).view(np.int32)
-                            res_hi[r, vsel] = (
-                                cu[r] >> np.uint64(32)).astype(
-                                    np.uint32).view(np.int32)
-                        trap_codes[vsel] = codes
-            if not served_vec:
-                for li in range(Lblk):
-                    if vmask is not None and not vmask[li]:
-                        continue  # pad lane: replayed from clone below
-                    args = []
-                    for i in range(nargs):
-                        a_lo = int(np.uint32(slab_lo[fp + i, lo_col + li]))
-                        a_hi = int(np.uint32(slab_hi[fp + i, lo_col + li]))
-                        args.append(a_lo | (a_hi << 32))
-                    lane_mem = None
+        try:
+            for bi, (b, pc, k, fi, nargs, fp, ob, pages, cc) in \
+                    enumerate(metas):
+                lo_col = b * Lblk      # absolute columns (slab / state)
+                loc = bi * Lblk        # local columns (gathered mem cache)
+                vmask = valid_blocks.get(b)
+                nres = int(img.f_nresults[k])
+                res_lo = np.zeros((max(nres, 1), Lblk), np.int32)
+                res_hi = np.zeros((max(nres, 1), Lblk), np.int32)
+                trap_codes = np.zeros(Lblk, np.int32)
+                new_pages = np.full(Lblk, pages, np.int32)
+                if stats is not None:
+                    n_real = int(vmask.sum()) if vmask is not None else Lblk
+                    stats["serve_rounds"] += 1 if bi == 0 else 0
+                    stats["tier1_calls"] += n_real
+                served_vec = False
+                if use_vec and has_mem and getattr(fi, "kind", None) == "host":
+                    vecfn, env = vec_impl_for(fi)
+                    if vecfn is not None:
+                        from wasmedge_tpu.batch.hostcall import \
+                            gather_arg_cells
+
+                        vsel = np.arange(Lblk, dtype=np.int64) \
+                            if vmask is None else \
+                            np.nonzero(vmask)[0].astype(np.int64)
+                        fp_vec = np.full(slab_lo.shape[1], fp, np.int64)
+                        args = gather_arg_cells(slab_lo, slab_hi, fp_vec,
+                                                lo_col + vsel, nargs)
+                        view = make_cached_view(cache, loc + vsel,
+                                                np.full(vsel.size, pages))
+                        try:
+                            cells, codes = vecfn(env, view, args)
+                            served_vec = True
+                        except NotVectorizable:
+                            served_vec = False
+                        if served_vec:
+                            if stats is not None:
+                                stats["tier1_vectorized"] += int(vsel.size)
+                            cu = cells.astype(np.uint64)
+                            for r in range(cells.shape[0]):
+                                res_lo[r, vsel] = (
+                                    cu[r] & np.uint64(0xFFFFFFFF)).astype(
+                                        np.uint32).view(np.int32)
+                                res_hi[r, vsel] = (
+                                    cu[r] >> np.uint64(32)).astype(
+                                        np.uint32).view(np.int32)
+                            trap_codes[vsel] = codes
+                if not served_vec:
+                    t_drain = obs.now()
+                    for li in range(Lblk):
+                        if vmask is not None and not vmask[li]:
+                            continue  # pad lane: replayed from clone below
+                        args = []
+                        for i in range(nargs):
+                            a_lo = int(np.uint32(slab_lo[fp + i, lo_col + li]))
+                            a_hi = int(np.uint32(slab_hi[fp + i, lo_col + li]))
+                            args.append(a_lo | (a_hi << 32))
+                        lane_mem = None
+                        if has_mem:
+                            lane_mem = _CachedLaneMemory(
+                                cache, loc + li, pages, max_pages, plane_cap)
+                        out, code = serve_one(fi, args, lane_mem)
+                        if code:
+                            trap_codes[li] = code
+                            continue
+                        for i, cell in enumerate(out):
+                            res_lo[i, li] = np.int32(
+                                np.uint32(cell & 0xFFFFFFFF))
+                            res_hi[i, li] = np.int32(
+                                np.uint32((cell >> 32) & 0xFFFFFFFF))
+                        if has_mem:
+                            new_pages[li] = lane_mem.pages
+                    if obs.enabled:
+                        from wasmedge_tpu.batch.hostcall import hostcall_kind
+
+                        n_real = int(vmask.sum()) if vmask is not None else Lblk
+                        obs.hostcall(hostcall_kind(fi), obs.now() - t_drain,
+                                     lanes=n_real, vectorized=False)
+                if vmask is not None and not vmask.all():
+                    src = int(np.argmax(vmask))  # first valid = clone source
+                    pads = np.nonzero(~vmask)[0]
+                    for li in pads:
+                        res_lo[:, li] = res_lo[:, src]
+                        res_hi[:, li] = res_hi[:, src]
+                        trap_codes[li] = trap_codes[src]
+                        new_pages[li] = new_pages[src]
                     if has_mem:
-                        lane_mem = _CachedLaneMemory(
-                            cache, loc + li, pages, max_pages, plane_cap)
-                    out, code = serve_one(fi, args, lane_mem)
-                    if code:
-                        trap_codes[li] = code
+                        # replay the clone source's memory writes onto pads
+                        for (off, n) in cache.writes_of(loc + src):
+                            data = cache.read_bytes(loc + src, off, n)
+                            for li in pads:
+                                cache.write_bytes(loc + int(li), off, data)
+                grew = (new_pages != pages) & (trap_codes == 0)
+                if trap_codes.any() or grew.any():
+                    # Per-lane outcomes: record them, re-arm at pc+1 with the
+                    # served lanes' results applied (their host calls MUST
+                    # NOT re-run), then leave the block DIVERGED for the
+                    # scheduler to partition per lane.
+                    state[7] = state[7].at[0, lo_col:lo_col + Lblk].max(
+                        jnp.asarray(trap_codes))
+                    if grew.any():
+                        self._pages_override[b] = new_pages.copy()
+                    if (trap_codes != 0).all() and \
+                            len(set(trap_codes.tolist())) == 1:
+                        cc[_C_STATUS] = ST_TRAPPED_BASE + int(trap_codes[0])
+                        rearms[b] = cc
                         continue
-                    for i, cell in enumerate(out):
-                        res_lo[i, li] = np.int32(
-                            np.uint32(cell & 0xFFFFFFFF))
-                        res_hi[i, li] = np.int32(
-                            np.uint32((cell >> 32) & 0xFFFFFFFF))
-                    if has_mem:
-                        new_pages[li] = lane_mem.pages
-            if vmask is not None and not vmask.all():
-                src = int(np.argmax(vmask))  # first valid = clone source
-                pads = np.nonzero(~vmask)[0]
-                for li in pads:
-                    res_lo[:, li] = res_lo[:, src]
-                    res_hi[:, li] = res_hi[:, src]
-                    trap_codes[li] = trap_codes[src]
-                    new_pages[li] = new_pages[src]
-                if has_mem:
-                    # replay the clone source's memory writes onto pads
-                    for (off, n) in cache.writes_of(loc + src):
-                        data = cache.read_bytes(loc + src, off, n)
-                        for li in pads:
-                            cache.write_bytes(loc + int(li), off, data)
-            grew = (new_pages != pages) & (trap_codes == 0)
-            if trap_codes.any() or grew.any():
-                # Per-lane outcomes: record them, re-arm at pc+1 with the
-                # served lanes' results applied (their host calls MUST
-                # NOT re-run), then leave the block DIVERGED for the
-                # scheduler to partition per lane.
-                state[7] = state[7].at[0, lo_col:lo_col + Lblk].max(
-                    jnp.asarray(trap_codes))
-                if grew.any():
-                    self._pages_override[b] = new_pages.copy()
-                if (trap_codes != 0).all() and \
-                        len(set(trap_codes.tolist())) == 1:
-                    cc[_C_STATUS] = ST_TRAPPED_BASE + int(trap_codes[0])
+                    if nres:
+                        state[2] = state[2].at[ob:ob + nres,
+                                               lo_col:lo_col + Lblk].set(
+                            jnp.asarray(res_lo[:nres]))
+                        state[3] = state[3].at[ob:ob + nres,
+                                               lo_col:lo_col + Lblk].set(
+                            jnp.asarray(res_hi[:nres]))
+                    cc[_C_PC] = pc + 1
+                    cc[_C_SP] = ob + nres
+                    cc[_C_STATUS] = ST_DIVERGED
                     rearms[b] = cc
                     continue
                 if nres:
@@ -4747,20 +4785,10 @@ class PallasUniformEngine:
                         jnp.asarray(res_hi[:nres]))
                 cc[_C_PC] = pc + 1
                 cc[_C_SP] = ob + nres
-                cc[_C_STATUS] = ST_DIVERGED
+                cc[_C_STATUS] = ST_RUNNING
                 rearms[b] = cc
-                continue
-            if nres:
-                state[2] = state[2].at[ob:ob + nres,
-                                       lo_col:lo_col + Lblk].set(
-                    jnp.asarray(res_lo[:nres]))
-                state[3] = state[3].at[ob:ob + nres,
-                                       lo_col:lo_col + Lblk].set(
-                    jnp.asarray(res_hi[:nres]))
-            cc[_C_PC] = pc + 1
-            cc[_C_SP] = ob + nres
-            cc[_C_STATUS] = ST_RUNNING
-            rearms[b] = cc
+        finally:
+            set_drain_recorder(prev_rec)
         if has_mem and cache._dirty:
             # dirty chunks go back to the live plane as column updates
             colsj = jnp.asarray(pending["cols"])
@@ -4771,5 +4799,8 @@ class PallasUniformEngine:
                 state[6] = state[6].at[lo:lo + ch.shape[0], colsj].set(
                     jnp.asarray(ch))
             cache._dirty.clear()
+        if obs.enabled and metas:
+            obs.span("serve_finish", t_finish, cat="scheduler",
+                     track="serve", blocks=len(metas))
         return state, rearms
 

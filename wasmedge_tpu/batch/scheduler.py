@@ -239,6 +239,11 @@ class BlockScheduler:
         self.fell_back_to_simt = False
         self.splits = 0
         self.quarantined = 0
+        # flight recorder shared with the outer engine (obs/): the
+        # scheduler reports launches, serves, splits, frees, residue
+        # handoffs and live-lane occupancy; NULL_RECORDER when off
+        self.obs = outer.obs
+        self._t_launch = 0.0
         self._plane_idx = _PLANE_IDX_SIMD if outer.img.has_simd \
             else _PLANE_IDX
         self._plan()
@@ -455,6 +460,8 @@ class BlockScheduler:
         self._launched = bool(runnable.any())
         if self._launched:
             self._live_at_launch = live
+            self._t_launch = self.obs.now()
+            self._launch_blocks = int(runnable.sum())
             out = self.eng._fn(*self.eng._tables, self.state[0],
                                self.state[1], *self.state[2:])
             self.state = list(out)
@@ -500,6 +507,19 @@ class BlockScheduler:
             live = self._live_at_launch
             new_steps = ctrl_np[:, _C_STEPS].astype(np.int64)
             self.block_steps[live] += new_steps[live]
+            obs = self.obs
+            if obs.enabled:
+                # per-launch span closed at THIS sync point (the ctrl
+                # mirror download above is the launch's completion);
+                # occupancy counts real (non-pad) lanes of live blocks
+                valid = self.block_lanes >= 0
+                obs.span(
+                    "kernel_round", self._t_launch, cat="scheduler",
+                    track="pallas", blocks=self._launch_blocks,
+                    retired_delta=int(
+                        (new_steps[live] * valid[live].sum(axis=1)).sum()))
+                obs.counter("live_lanes", int(
+                    valid[self.block_state == _B_LIVE].sum()))
             if (live & (ctrl_np[:, _C_STATUS] == ST_RECHECK)).any():
                 ctrl_np = self._run_recheck(live)
             else:
@@ -628,6 +648,8 @@ class BlockScheduler:
         self.block_state[b] = _B_FREE
         self._ctrl()[b, _C_STATUS] = ST_DONE
         self._ctrl_dirty = True
+        self.obs.instant("block_free", cat="scheduler", track="pallas",
+                         block=b)
 
     # -- split machinery ---------------------------------------------------
     def _split(self, b: int, ctrl_np, status: int):
@@ -638,6 +660,9 @@ class BlockScheduler:
         frames = self._frames()[b]
         pages_over = eng._pages_override.pop(b, None)
         self.splits += 1
+        self.obs.instant("split", cat="scheduler", track="pallas",
+                         block=b, pc=int(ctrl[_C_PC]), status=status,
+                         splits=self.splits)
         if status == ST_REGROW or self.splits > self.split_budget:
             self._to_simt(b, ctrl, frames, pages_over)
             return
@@ -985,6 +1010,8 @@ class BlockScheduler:
         """Queue a block's valid lanes for the final SIMT pass."""
         ids = self.block_lanes[b]
         vcols = np.nonzero(ids >= 0)[0]
+        self.obs.instant("simt_residue_queue", cat="scheduler",
+                         track="pallas", block=b, lanes=int(vcols.size))
         cols = self._extract_cols(b, vcols, {})
         self._simt_queue.append(_Pending(
             ctrl=ctrl.copy(), frames=frames.copy(), cols=cols,
@@ -1002,6 +1029,7 @@ class BlockScheduler:
         from wasmedge_tpu.batch.engine import BatchState
 
         self.fell_back_to_simt = True
+        t_residue = self.obs.now()
         simt = self.eng.simt
         cfg = self.cfg
         L = simt.lanes
@@ -1103,6 +1131,9 @@ class BlockScheduler:
             s_hi_f = np.asarray(state.stack_hi[:self.nres])
             self.res_lo[:, all_m] = s_lo_f[:, all_m]
             self.res_hi[:, all_m] = s_hi_f[:, all_m]
+        self.obs.span("simt_residue", t_residue, cat="scheduler",
+                      track="simt", lanes=int(all_m.size),
+                      steps=int(total))
         if simd_capped and max_steps_eff < self.max_steps:
             survivors = all_m[trap_f[all_m] == 0]
             if survivors.size:
@@ -1120,6 +1151,8 @@ class BlockScheduler:
         FailureRecords in the process-wide log instead of being
         silently swallowed."""
         self.quarantined = getattr(self, "quarantined", 0) + int(lanes.size)
+        self.obs.instant("quarantine", cat="scheduler", track="simt",
+                         lanes=int(lanes.size))
         inst = self.inst
         has_host = any(getattr(f, "kind", None) == "host"
                        for f in inst.funcs)

@@ -124,7 +124,7 @@ def scalar_rerun(inst, conf, func_name: str, func_idx: int, args_lanes,
         except Exception as e:  # host-side bug — record, don't silence
             records.append(FailureRecord(
                 fault_class="scalar_rerun", error=repr(e),
-                lanes=(int(lane),), tier="scalar", time_s=time.time()))
+                lanes=(int(lane),), tier="scalar").stamp())
             trap[col] = int(ErrCode.CostLimitExceeded)
             continue
         for r, (t, v) in enumerate(zip(ft.results, out)):
@@ -145,15 +145,20 @@ class BatchSupervisor:
     either way)."""
 
     def __init__(self, engine, conf=None, stats=None, faults=None,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 resume: Optional[bool] = None):
+        from wasmedge_tpu.obs.recorder import recorder_of
+
         self.engine = engine
         self.conf = conf if conf is not None else engine.conf
         self.k = self.conf.supervisor
         self.stats = stats
         self.faults = faults
+        self.obs = recorder_of(self.conf)
         self.failures: List[FailureRecord] = []
         self.retries = 0
         self.checkpoint_dir = checkpoint_dir or self.k.checkpoint_dir
+        self.resume = self.k.resume if resume is None else bool(resume)
         self._ckpts: List[Tuple[str, int]] = []   # lineage: (path, steps)
         self._restored_from: Optional[str] = None
         self._overlay = {}  # lane -> (result cells, trap) from scalar rung
@@ -177,19 +182,34 @@ class BatchSupervisor:
                 if arr.ndim == 0:
                     arr = np.full(eng.lanes, arr, np.int64)
                 self._args.append(arr)
+        # a fresh run never inherits a previous run()'s lineage (stale
+        # checkpoints would restore the OLD run's state under new args);
+        # only an explicit resume adopts what is on disk
+        self._ckpts = []
+        self._adopted = None
+        self._invocation = self._invocation_fingerprint()
+        self._resumed = self.resume and self._adopt_lineage()
         tiers = []
-        if self.k.use_kernel_tier and not self._multi:
+        # a resumed run continues from its snapshot on the SIMT tier —
+        # the kernel tier can only start from the original arguments
+        # and would redo (and double-serve) the checkpointed work
+        if self.k.use_kernel_tier and not self._multi \
+                and not self._resumed:
             tiers.append("pallas")
         tiers.append("simt")
         if self._scalar_ok():
             tiers.append("scalar")
         last_exc = None
+        obs = self.obs
         for tier in tiers:
+            t_tier = obs.now()
+            ran = True
             try:
                 if tier == "pallas":
                     res = self._run_kernel_tier(max_steps)
                     if res is None:
-                        continue  # ineligible here, not a failure
+                        ran = False  # ineligible: no residency to record
+                        continue
                     return res
                 if tier == "simt":
                     state, total = self._run_simt_tier(max_steps)
@@ -209,6 +229,13 @@ class BatchSupervisor:
                 last_exc = e
                 self._record("launch", e, tier="pallas")
                 self._record("demote", e, tier="pallas")
+            finally:
+                # tier-residency: monotonic span whenever the tier ran
+                # (success, demotion, or raise — not ineligible-skip)
+                if ran:
+                    obs.add_tier_seconds(tier, obs.now() - t_tier)
+                    obs.span(f"tier/{tier}", t_tier, cat="supervisor",
+                             track="supervisor")
         raise EngineFailure(
             f"supervised run failed on every tier: {last_exc!r}",
             self.failures)
@@ -230,11 +257,22 @@ class BatchSupervisor:
     def _run_simt_tier(self, max_steps):
         eng = self.engine
         k = self.k
-        state, total = self._initial_state(), 0
+        if self._resumed and self._adopted is not None:
+            # adopted lineage (cross-process resume): continue from the
+            # newest good member — already loaded by _adopt_lineage's
+            # verification pass, so no second deserialization here
+            state, total = self._adopted
+            self._adopted = None
+            self._restored_from = self._ckpts[-1][0]
+        else:
+            state, total = self._initial_state(), 0
         consecutive = 0
         fail_keys = {}
-        self._last_ckpt_total = 0
-        self._last_ckpt_wall = time.monotonic()
+        # anchor the checkpoint cadence at the STARTING position (the
+        # restored step on resume, else 0) so a resumed run neither
+        # fires an immediate off-cadence save nor leaves the replayed
+        # region unprotected
+        self._reset_cadence(total)
         while True:
             target = self._slice_target(total, max_steps)
             try:
@@ -250,6 +288,9 @@ class BatchSupervisor:
                 lanes = tuple(getattr(e, "lanes", ()) or ())
                 self._record("serve" if point == "serve" else "launch",
                              e, lanes=lanes)
+                self.obs.instant("retry", cat="supervisor",
+                                 track="supervisor", retry=self.retries,
+                                 consecutive=consecutive, point=point)
                 key = (point, lanes)
                 fail_keys[key] = fail_keys.get(key, 0) + 1
                 # the failed attempt may have consumed donated buffers:
@@ -296,6 +337,91 @@ class BatchSupervisor:
                            retired=np.zeros(eng.lanes, np.int64), steps=0)
 
     # -- state / lineage --------------------------------------------------
+    def _invocation_fingerprint(self) -> dict:
+        """What this run is computing: the exported function plus a hash
+        of the per-lane arguments (multi-tenant: every tenant's tuple).
+        Recorded into each checkpoint and checked at lineage adoption —
+        the image hash alone cannot tell f(30) from f(31), and a resume
+        must never answer a NEW command with an OLD run's snapshot."""
+        import hashlib
+
+        h = hashlib.sha256()
+        if self._multi:
+            names = []
+            for t in self.engine.tenants:
+                names.append(t.func_name)
+                for a in t.args_lanes:
+                    h.update(np.ascontiguousarray(
+                        np.asarray(a, np.int64)).tobytes())
+            func = "|".join(names)
+        else:
+            func = self._func_name
+            for a in self._args:
+                h.update(np.ascontiguousarray(a).tobytes())
+        return {"func": func, "args_sha256": h.hexdigest()}
+
+    def _adopt_lineage(self) -> bool:
+        """Cross-process resume (ROADMAP open item): adopt an existing
+        checkpoint_dir lineage written by a previous process.  Scans for
+        ckpt-<steps>.npz members, verifies the newest loads cleanly
+        against THIS engine (image hash + geometry binding is
+        checkpoint.load's job), records corrupt/mismatched members as
+        FailureRecord(fault_class="checkpoint") and drops them, then
+        installs the surviving lineage so the SIMT tier starts from the
+        newest good member.  Returns True when a good member exists."""
+        import re
+
+        from wasmedge_tpu.batch import checkpoint
+
+        d = self.checkpoint_dir
+        if not d or not os.path.isdir(d):
+            return False
+        members = []
+        for fn in sorted(os.listdir(d)):
+            m = re.fullmatch(r"ckpt-(\d+)\.npz", fn)
+            if m:
+                members.append((os.path.join(d, fn), int(m.group(1))))
+        members.sort(key=lambda t: t[1])
+        # verify the newest member NOW so the run never starts from a
+        # snapshot that will refuse to load mid-recovery; older members
+        # stay lazily verified by _restore's fallback walk.  The loaded
+        # state is kept for _run_simt_tier (one deserialization, and
+        # the checkpoint_load fault seam fires once per member).
+        self._adopted = None
+        while members:
+            path, steps = members[-1]
+            try:
+                if self.faults is not None:
+                    self.faults.fire("checkpoint_load", path=path)
+                # invocation binding: a snapshot of a different call
+                # (other export / other args) must be refused, not
+                # silently continued and reported as THIS run's answer.
+                # Pre-invocation-stamp checkpoints carry no record and
+                # are accepted for back compatibility.
+                inv = checkpoint.read_meta(path).get("invocation")
+                if inv is not None and inv != self._invocation:
+                    raise ValueError(
+                        f"checkpoint invocation mismatch: snapshot is "
+                        f"{inv}, this run is {self._invocation}")
+                t_load = self.obs.now()
+                self._adopted = checkpoint.load(path, self.engine)
+                self.obs.span("checkpoint_load", t_load,
+                              cat="supervisor", track="supervisor",
+                              checkpoint=path,
+                              steps=int(self._adopted[1]))
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._record("checkpoint", e, checkpoint=path)
+                members.pop()
+        self._ckpts = members
+        if members:
+            self.obs.instant("resume_adopted", cat="supervisor",
+                             track="supervisor", checkpoint=members[-1][0],
+                             steps=members[-1][1], lineage=len(members))
+        return bool(members)
+
     def _initial_state(self):
         if self._multi:
             return self.engine.initial_state()
@@ -312,9 +438,22 @@ class BatchSupervisor:
             try:
                 if self.faults is not None:
                     self.faults.fire("checkpoint_load", path=path)
+                # older adopted members were only filename-scanned at
+                # adoption: re-check the invocation binding here so a
+                # retry can never walk back into a different call's
+                # snapshot (shared/mutated checkpoint_dir)
+                inv = checkpoint.read_meta(path).get("invocation")
+                if inv is not None and inv != self._invocation:
+                    raise ValueError(
+                        f"checkpoint invocation mismatch: snapshot is "
+                        f"{inv}, this run is {self._invocation}")
+                t_load = self.obs.now()
                 state, total = checkpoint.load(path, self.engine)
                 self._restored_from = path
                 self._reset_cadence(total)
+                self.obs.span("checkpoint_load", t_load, cat="supervisor",
+                              track="supervisor", checkpoint=path,
+                              steps=int(total))
                 return state, total
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -371,10 +510,15 @@ class BatchSupervisor:
             self.checkpoint_dir = tempfile.mkdtemp(prefix="wasmedge-ckpt-")
         os.makedirs(self.checkpoint_dir, exist_ok=True)
         path = os.path.join(self.checkpoint_dir, f"ckpt-{total:012d}.npz")
+        t_save = self.obs.now()
         try:
             if self.faults is not None:
                 self.faults.fire("checkpoint_save", path=path)
-            checkpoint.save(path, self.engine, state, total)
+            checkpoint.save(path, self.engine, state, total,
+                            invocation=self._invocation)
+            self.obs.span("checkpoint_save", t_save, cat="supervisor",
+                          track="supervisor", checkpoint=path,
+                          steps=int(total))
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
@@ -450,16 +594,21 @@ class BatchSupervisor:
 
     def _record(self, fault_class, exc, lanes=(), tier="simt",
                 checkpoint=None, error=None):
+        # stamp() fills both clocks: wall time_s for logs, mono_s for
+        # durations between incidents (survives wall-clock steps)
         self._record_rec(FailureRecord(
             fault_class=fault_class,
             error=error if error is not None
             else ("" if exc is None else repr(exc)),
             lanes=tuple(int(x) for x in lanes), retry=self.retries,
-            checkpoint=checkpoint or self._restored_from, tier=tier,
-            time_s=time.time()))
+            checkpoint=checkpoint or self._restored_from,
+            tier=tier).stamp())
 
     def _record_rec(self, rec: FailureRecord):
-        self.failures.append(rec)
+        self.failures.append(rec.stamp())
+        # every incident is mirrored into the flight recorder as an
+        # instant event on the supervisor track (obs/)
+        self.obs.failure(rec)
         if self.stats is not None:
             self.stats.add_failure(rec)
         else:

@@ -865,6 +865,7 @@ class UniformBatchEngine:
         self.cfg = self.simt.cfg
         self.lanes = self.simt.lanes
         self.img = self.simt.img
+        self.obs = self.simt.obs  # shared flight recorder (obs/)
         self._uchunk = None
         self.pallas = self._pick_pallas(inst, store, conf)
 
@@ -1016,11 +1017,24 @@ class UniformBatchEngine:
         t0_active = ust.t0_ctr is not None
         dummy_time = np.zeros((2, 2), np.int32)
         fell_back = False
+        obs = self.obs
+        prev_steps = 0
         while int(ust.steps) < max_steps:
             tt = jnp.asarray(t0_time_planes() if t0_active
                              else dummy_time)
+            t_launch = obs.now()
             ust = self._uchunk(ust, tt)
             status = int(ust.status)
+            if obs.enabled:
+                # converged path: every lane shares one pc, so
+                # occupancy is all-or-nothing
+                steps = int(ust.steps)
+                obs.span("launch", t_launch, cat="engine",
+                         track="uniform",
+                         live_lanes=self.lanes if status == ST_RUNNING
+                         else 0,
+                         retired_delta=(steps - prev_steps) * self.lanes)
+                prev_steps = steps
             if status == ST_RUNNING:
                 continue
             if status == ST_DIVERGED:

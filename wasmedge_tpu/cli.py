@@ -77,6 +77,17 @@ def _runner_parser() -> ArgumentParser:
     p.add_option(["max-retries"],
                  Option("retry budget per engine tier (supervised)",
                         "n", typ=int))
+    p.add_option(["resume"],
+                 Toggle("adopt an existing --checkpoint-dir lineage at "
+                        "startup (cross-process resume; implies "
+                        "--supervised)"))
+    p.add_option(["trace-out"],
+                 Option("write a Chrome trace_event JSON of the batch "
+                        "run (open in Perfetto / chrome://tracing)",
+                        "path"))
+    p.add_option(["metrics-out"],
+                 Option("write a Prometheus text-format metrics "
+                        "snapshot after the batch run", "path"))
     p.add_positional("wasm_file", "WebAssembly file to run")
     return p
 
@@ -119,7 +130,15 @@ def _build_conf(p: ArgumentParser) -> Configure:
             p._opts["checkpoint-every"].value
     if p._opts["max-retries"].seen:
         conf.supervisor.max_retries = p._opts["max-retries"].value
-    if p._opts["supervised"].value and not (
+    if p._opts["resume"].value:
+        conf.supervisor.resume = True
+    if p._opts["trace-out"].seen:
+        conf.obs.enabled = True
+        conf.obs.trace_out = p._opts["trace-out"].value
+    if p._opts["metrics-out"].seen:
+        conf.obs.enabled = True
+        conf.obs.metrics_out = p._opts["metrics-out"].value
+    if (p._opts["supervised"].value or p._opts["resume"].value) and not (
             conf.supervisor.checkpoint_every_steps
             or conf.supervisor.checkpoint_every_s):
         # --supervised promises auto-checkpointing: without an explicit
@@ -214,7 +233,9 @@ def run_command(argv: List[str], out=None, err=None) -> int:
                     fn_name,
                     [np.full(batch_lanes, int(a, 0), np.int64)
                      for a in fn_args], lanes=batch_lanes,
-                    supervised=p._opts["supervised"].value)
+                    supervised=p._opts["supervised"].value
+                    or p._opts["resume"].value,
+                    resume=p._opts["resume"].value)
                 out.write(f"{[int(r[0]) for r in res.results]}"
                           f" ({int(res.completed.sum())}/{batch_lanes} lanes"
                           f" completed, {int(res.retired.sum())} instrs)\n")

@@ -148,6 +148,37 @@ class BatchConfigure:
 
 
 @dataclasses.dataclass
+class ObsConfigure:
+    """Knobs for the batch observability subsystem (wasmedge_tpu/obs/).
+
+    When `enabled` is False every instrumentation seam holds the no-op
+    NULL_RECORDER guard object — hot loops pay no per-step Python
+    branching and no allocation (the bit-identical-output contract with
+    the seed engines is pinned by tests/test_obs.py)."""
+
+    # Master switch: create a FlightRecorder and report launch/serve/
+    # split/checkpoint/failure events + hostcall latency histograms.
+    enabled: bool = False
+    # Bounded event ring capacity (oldest events dropped beyond it;
+    # the drop count is exported).
+    ring_capacity: int = 65536
+    # Device-side per-opcode histogram plane (SIMT engine): one extra
+    # [code_len] int32 plane scatter-incremented per step, folded into
+    # per-opcode retired counts (Statistics cost_table domain) on sync.
+    # Costs one scatter-add per step — leave off unless attributing
+    # hot opcodes.
+    opcode_histogram: bool = False
+    # Export paths applied by VM.execute_batch / the CLI after a run
+    # (None = no file export; the recorder stays queryable in-process).
+    trace_out: Optional[str] = None
+    metrics_out: Optional[str] = None
+    # Lazily-created shared FlightRecorder (obs/recorder.py
+    # recorder_of); identity is preserved across Configure deepcopies.
+    _recorder: object = dataclasses.field(
+        default=None, init=False, repr=False, compare=False)
+
+
+@dataclasses.dataclass
 class SupervisorConfigure:
     """Knobs for supervised batch execution (batch/supervisor.py).
 
@@ -198,6 +229,13 @@ class SupervisorConfigure:
     # Allow the bottom rung: whole-batch gas-metered scalar re-execution
     # (side-effect-free single-module batches only).
     allow_scalar_tier: bool = True
+    # --- cross-process resume ---
+    # Adopt an existing checkpoint_dir lineage at startup: scan for
+    # ckpt-*.npz members, pick the newest that loads cleanly, and
+    # record skipped/corrupt members as FailureRecord("checkpoint").
+    # The run then continues from that snapshot on the SIMT tier (the
+    # kernel tier cannot resume mid-state).  CLI: --resume.
+    resume: bool = False
 
 
 @dataclasses.dataclass
@@ -227,6 +265,7 @@ class Configure:
     batch: BatchConfigure = dataclasses.field(default_factory=BatchConfigure)
     supervisor: SupervisorConfigure = dataclasses.field(
         default_factory=SupervisorConfigure)
+    obs: ObsConfigure = dataclasses.field(default_factory=ObsConfigure)
     compiler: CompilerConfigure = dataclasses.field(default_factory=CompilerConfigure)
 
     def add_proposal(self, p: Proposal) -> "Configure":

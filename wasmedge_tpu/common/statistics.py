@@ -47,12 +47,25 @@ class FailureRecord:
     retry: int = 0                   # retry count when the incident fired
     checkpoint: Optional[str] = None  # checkpoint lineage member involved
     tier: str = ""                   # engine tier: "pallas"|"simt"|"scalar"
-    time_s: float = 0.0              # time.time() stamp
+    # Event timestamp (wall clock, time.time()) — for humans and logs
+    # only.  Durations between incidents (retry/backoff intervals, trace
+    # span lengths) are derived from `mono_s`, the time.monotonic()
+    # stamp, so they survive wall-clock steps (NTP slew, manual resets).
+    time_s: float = 0.0
+    mono_s: float = 0.0
 
     def asdict(self) -> dict:
         d = dataclasses.asdict(self)
         d["lanes"] = [int(x) for x in self.lanes]
         return d
+
+    def stamp(self) -> "FailureRecord":
+        """Fill any unset clocks (idempotent)."""
+        if not self.time_s:
+            self.time_s = time.time()
+        if not self.mono_s:
+            self.mono_s = time.monotonic()
+        return self
 
 
 # Process-wide bounded failure log: components without a Statistics at
@@ -62,9 +75,7 @@ _FAILURE_LOG: deque = deque(maxlen=256)
 
 
 def record_failure(rec: FailureRecord):
-    if not rec.time_s:
-        rec.time_s = time.time()
-    _FAILURE_LOG.append(rec)
+    _FAILURE_LOG.append(rec.stamp())
 
 
 def recent_failures() -> list:
@@ -93,6 +104,7 @@ class Statistics:
         self._wasm_t0 = None
         self._host_t0 = None
         self.failures = []  # FailureRecords from supervised runs
+        self.opcode_counts = None  # per-opcode retired (obs histogram)
 
     def add_failure(self, rec: FailureRecord):
         """Attach a supervised-execution incident to this run's stats and
@@ -111,6 +123,23 @@ class Statistics:
 
     def add_instr_cost(self, op_id: int):
         self.add_cost(self.cost_table[op_id])
+
+    def add_opcode_counts(self, counts):
+        """Fold a per-opcode retired histogram (index = opcode id in
+        this table's slot domain, from the obs subsystem's device
+        histogram plane) into cost_table accounting: counts accumulate
+        on `opcode_counts` and their cost_table-weighted sum is exposed
+        via dump()["opcode_cost"].  Attribution only — instr_count /
+        total_cost (the trap-enforcing gas meter) are not touched, so
+        folding never double-counts against a live cost limit."""
+        import numpy as _np
+
+        counts = _np.asarray(counts, _np.int64)
+        if counts.size > _NUM_COST_SLOTS:
+            counts = counts[:_NUM_COST_SLOTS]
+        if self.opcode_counts is None:
+            self.opcode_counts = _np.zeros(_NUM_COST_SLOTS, _np.int64)
+        self.opcode_counts[:counts.size] += counts
 
     def set_cost_limit(self, limit: int):
         self.cost_limit = limit
@@ -150,4 +179,10 @@ class Statistics:
         }
         if self.failures:
             out["failures"] = [r.asdict() for r in self.failures]
+        if self.opcode_counts is not None:
+            nz = {int(i): int(n) for i, n in enumerate(self.opcode_counts)
+                  if n}
+            out["opcode_counts"] = nz
+            out["opcode_cost"] = int(sum(
+                n * self.cost_table[i] for i, n in nz.items()))
         return out

@@ -22,6 +22,7 @@ NotVectorizable routes the whole group to the per-lane fallback loop
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable, Dict
 
 import numpy as np
@@ -156,10 +157,43 @@ class SoAMemView(MemView):
 # ---------------------------------------------------------------------------
 VEC_WASI: Dict[str, Callable] = {}
 
+# Flight recorder the tier-1 drain reports per-hostcall-kind latency
+# histograms into (obs/recorder.py).  Installed by the serving loops
+# (batch/hostcall.py serve_batch_state, pallas_engine's block serve)
+# for the duration of one drain round; None when observability is off,
+# so the registered implementations run with zero timing overhead.
+# THREAD-LOCAL: concurrent serves (mesh per-device threads, multiple
+# VMs in one process) each install/restore their own engine's recorder
+# without clobbering another thread's attribution.
+_DRAIN = threading.local()
+
+
+def set_drain_recorder(rec):
+    """Install this thread's recorder for the drain round (None = off);
+    returns the previous one so callers can restore it."""
+    prev = getattr(_DRAIN, "rec", None)
+    _DRAIN.rec = rec if (rec is not None
+                         and getattr(rec, "enabled", False)) else None
+    return prev
+
 
 def _vec(name: str):
     def deco(fn):
-        VEC_WASI[name] = fn
+        def timed(env, view, args):
+            rec = getattr(_DRAIN, "rec", None)
+            if rec is None:
+                return fn(env, view, args)
+            t0 = rec.now()
+            # NotVectorizable propagates untimed: the group re-runs on
+            # the per-lane loop, which records its own observation
+            out = fn(env, view, args)
+            rec.hostcall(name, rec.now() - t0, lanes=view.n,
+                         vectorized=True)
+            return out
+
+        timed.__name__ = f"vec_{name}"
+        timed.inner = fn
+        VEC_WASI[name] = timed
         return fn
     return deco
 

@@ -1,0 +1,35 @@
+"""Batch observability subsystem: flight recorder, trace + metrics export.
+
+Three layers (ISSUE 3 / ROADMAP "attributable timings"):
+
+  recorder.py  bounded-ring FlightRecorder + the NULL_RECORDER guard
+               object all instrumentation seams hold when obs is off
+  trace.py     Chrome trace_event JSON export (Perfetto-openable) +
+               schema validator
+  metrics.py   Prometheus text-format export + strict parser
+
+Wiring: set `Configure.obs.enabled = True` (plus `opcode_histogram` for
+the device-side hot-opcode plane) before building engines; every engine
+/ scheduler / supervisor constructed from that Configure reports into
+one shared FlightRecorder (`recorder_of(conf)`).  `VM.execute_batch`
+takes `trace_out=` / `metrics_out=` paths (CLI: `--trace-out` /
+`--metrics-out`) and exports after the run.
+"""
+
+from wasmedge_tpu.obs.recorder import (  # noqa: F401
+    NULL_RECORDER,
+    FlightRecorder,
+    LatencyHistogram,
+    NullRecorder,
+    recorder_of,
+)
+from wasmedge_tpu.obs.trace import (  # noqa: F401
+    chrome_trace,
+    export_chrome_trace,
+    validate_chrome_trace,
+)
+from wasmedge_tpu.obs.metrics import (  # noqa: F401
+    export_prometheus,
+    parse_prometheus,
+    render_prometheus,
+)

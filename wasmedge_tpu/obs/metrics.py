@@ -1,0 +1,222 @@
+"""Prometheus text-format export for batch observability.
+
+One scrape-shaped snapshot aggregating everything a long-lived batch
+server wants on a dashboard:
+
+  - common/statistics.py counters (instructions, gas, wasm/host time)
+  - per-kind hostcall drain latency histograms (flight recorder)
+  - engine-tier residency seconds (supervisor ladder)
+  - failure-taxonomy counts (FailureRecords by fault_class)
+  - hostcall pipeline counters (tier-0/tier-1/serve rounds)
+  - per-opcode retired counts when the device histogram plane was on
+
+Rendering follows the Prometheus exposition format v0.0.4 (HELP/TYPE
+comment lines, histogram `_bucket{le=...}` cumulative counts + `_sum` +
+`_count`, escaped label values), so the output is scrapeable as-is by a
+real Prometheus — and parseable by the test suite's strict parser.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+class _Writer:
+    def __init__(self):
+        self.lines = []
+        self._typed = set()
+
+    def head(self, name: str, typ: str, help_: str):
+        if name in self._typed:
+            return
+        self._typed.add(name)
+        self.lines.append(f"# HELP {name} {help_}")
+        self.lines.append(f"# TYPE {name} {typ}")
+
+    def sample(self, name: str, labels: Optional[dict], value):
+        lab = ""
+        if labels:
+            inner = ",".join(f'{k}="{_esc(v)}"'
+                             for k, v in sorted(labels.items()))
+            lab = "{" + inner + "}"
+        self.lines.append(f"{name}{lab} {_fmt(value)}")
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
+                      failures=None) -> str:
+    """Render one metrics snapshot.  All sources optional: `recorder` a
+    FlightRecorder, `stats` a common.statistics.Statistics, `hostcall_stats`
+    an engine's pipeline counter dict, `failures` extra FailureRecords
+    (e.g. statistics.recent_failures()) merged into the taxonomy counts."""
+    w = _Writer()
+
+    if stats is not None:
+        w.head("wasmedge_instructions_total", "counter",
+               "Instructions retired (Statistics.instr_count).")
+        w.sample("wasmedge_instructions_total", None,
+                 int(stats.instr_count))
+        w.head("wasmedge_gas_cost_total", "counter",
+               "Weighted gas cost consumed (Statistics.total_cost).")
+        w.sample("wasmedge_gas_cost_total", None, int(stats.total_cost))
+        w.head("wasmedge_exec_seconds_total", "counter",
+               "Execution wall seconds split by where they were spent.")
+        w.sample("wasmedge_exec_seconds_total", {"where": "wasm"},
+                 stats.wasm_ns / 1e9)
+        w.sample("wasmedge_exec_seconds_total", {"where": "host"},
+                 stats.host_ns / 1e9)
+
+    # Failure taxonomy: the SAME FailureRecord is mirrored into the
+    # recorder, the run's Statistics, and the process-wide log, so
+    # summing sources would double-count every incident.  Each source
+    # individually counts the incidents it saw — merge by max per
+    # class (covers classes only one source observed).
+    counts = {}
+    if recorder is not None:
+        for fc, n in recorder.failure_counts.items():
+            counts[fc] = max(counts.get(fc, 0), int(n))
+    for src in ((stats.failures if stats is not None else []),
+                (failures or [])):
+        seen = {}
+        for rec in src:
+            fc = getattr(rec, "fault_class", "unknown")
+            seen[fc] = seen.get(fc, 0) + 1
+        for fc, n in seen.items():
+            counts[fc] = max(counts.get(fc, 0), n)
+    if counts:
+        w.head("wasmedge_failures_total", "counter",
+               "Supervised-execution incidents by fault class "
+               "(FailureRecord taxonomy).")
+        for fc in sorted(counts):
+            w.sample("wasmedge_failures_total", {"fault_class": fc},
+                     counts[fc])
+
+    if recorder is not None:
+        if recorder.hostcalls:
+            name = "wasmedge_hostcall_drain_latency_seconds"
+            w.head(name, "histogram",
+                   "Tier-1 hostcall drain latency per WASI call kind "
+                   "(one observation per drained group).")
+            for kind in sorted(recorder.hostcalls):
+                h = recorder.hostcalls[kind]
+                for le, acc in h.cumulative():
+                    w.sample(f"{name}_bucket",
+                             {"kind": kind, "le": repr(float(le))}, acc)
+                w.sample(f"{name}_bucket",
+                         {"kind": kind, "le": "+Inf"}, h.count)
+                w.sample(f"{name}_sum", {"kind": kind}, h.sum_s)
+                w.sample(f"{name}_count", {"kind": kind}, h.count)
+            w.head("wasmedge_hostcall_drained_lanes_total", "counter",
+                   "Lanes served through the tier-1 drain per call kind.")
+            for kind in sorted(recorder.hostcalls):
+                w.sample("wasmedge_hostcall_drained_lanes_total",
+                         {"kind": kind}, recorder.hostcalls[kind].lanes)
+        if recorder.tier_seconds:
+            w.head("wasmedge_tier_residency_seconds", "counter",
+                   "Wall seconds the batch spent on each engine tier "
+                   "(supervisor degradation ladder).")
+            for tier in sorted(recorder.tier_seconds):
+                w.sample("wasmedge_tier_residency_seconds",
+                         {"tier": tier}, recorder.tier_seconds[tier])
+        if recorder.opcode_counts is not None:
+            from wasmedge_tpu.validator.image import lop_name
+
+            w.head("wasmedge_opcode_retired_total", "counter",
+                   "Instructions retired per opcode (device histogram "
+                   "plane, Configure.obs.opcode_histogram).")
+            for op_id, n in enumerate(recorder.opcode_counts):
+                if n:
+                    w.sample("wasmedge_opcode_retired_total",
+                             {"op": lop_name(op_id)}, int(n))
+        w.head("wasmedge_obs_events_total", "counter",
+               "Flight-recorder events captured (ring occupancy).")
+        w.sample("wasmedge_obs_events_total", None, len(recorder.events))
+        w.head("wasmedge_obs_events_dropped_total", "counter",
+               "Flight-recorder events dropped by the bounded ring.")
+        w.sample("wasmedge_obs_events_dropped_total", None,
+                 recorder.dropped)
+
+    if hostcall_stats:
+        w.head("wasmedge_hostcall_pipeline_total", "counter",
+               "Three-tier hostcall pipeline counters "
+               "(batch/engine.py new_hostcall_stats).")
+        for key in sorted(hostcall_stats):
+            w.sample("wasmedge_hostcall_pipeline_total",
+                     {"counter": key}, int(hostcall_stats[key]))
+
+    return w.render()
+
+
+def export_prometheus(path, recorder=None, stats=None,
+                      hostcall_stats=None, failures=None) -> str:
+    """Render and write a metrics snapshot to `path` (or file-like)."""
+    text = render_prometheus(recorder=recorder, stats=stats,
+                             hostcall_stats=hostcall_stats,
+                             failures=failures)
+    if hasattr(path, "write"):
+        path.write(text)
+    else:
+        from wasmedge_tpu.utils.fsio import atomic_write_bytes
+
+        atomic_write_bytes(path, text.encode())
+    return text
+
+
+def parse_prometheus(text: str) -> dict:
+    """Strict-enough parser for the exposition format: returns
+    {(name, frozenset(labels.items())): float}.  Used by the test suite
+    to prove exports stay machine-readable, and handy for ad-hoc
+    assertions on snapshots."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            labpart, val = rest.rsplit("}", 1)
+            labels = {}
+            for item in _split_labels(labpart):
+                k, v = item.split("=", 1)
+                if not (v.startswith('"') and v.endswith('"')):
+                    raise ValueError(f"unquoted label value: {line!r}")
+                labels[k] = v[1:-1].replace('\\"', '"') \
+                    .replace("\\n", "\n").replace("\\\\", "\\")
+            out[(name, frozenset(labels.items()))] = float(val)
+        else:
+            name, val = line.rsplit(None, 1)
+            out[(name, frozenset())] = float(val)
+    return out
+
+
+def _split_labels(s: str):
+    """Split a label body on commas outside quotes."""
+    items, cur, inq = [], "", False
+    i = 0
+    while i < len(s):
+        ch = s[i]
+        if ch == '"' and (i == 0 or s[i - 1] != "\\"):
+            inq = not inq
+        if ch == "," and not inq:
+            if cur:
+                items.append(cur)
+            cur = ""
+        else:
+            cur += ch
+        i += 1
+    if cur:
+        items.append(cur)
+    return items

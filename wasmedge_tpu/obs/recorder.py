@@ -1,0 +1,280 @@
+"""Flight recorder: bounded ring of structured events for batch runs.
+
+The batch engines execute thousands of lanes behind two or three layers
+of scheduling (block scheduler -> kernel launches -> hostcall drains ->
+supervisor retries); when a 4096-lane run misbehaves, aggregate G/s
+numbers say nothing about *where* the time or the lanes went.  The
+recorder is the single sink every layer reports into:
+
+  span(name, t0)    a timed phase (kernel launch, hostcall drain,
+                    checkpoint save, SIMT residue pass)
+  instant(name)     a point incident (block split, quarantine, retry,
+                    every FailureRecord)
+  counter(name, v)  a sampled value series (live-lane occupancy,
+                    hostcall queue depth)
+  hostcall(kind, s) one tier-1 drain observation into the per-kind
+                    latency histogram
+
+Events land in a bounded deque (oldest dropped, drop count kept), so a
+long-lived server can leave the recorder on without unbounded growth.
+Exports: Chrome trace_event JSON (obs/trace.py — opens in Perfetto /
+chrome://tracing) and Prometheus text format (obs/metrics.py).
+
+Timing discipline: durations are differences of time.monotonic() (span
+timing survives wall-clock steps); the wall clock is sampled ONCE at
+recorder creation and event timestamps are reconstructed as
+epoch + (mono - mono0), so the trace timeline is still wall-anchored.
+
+Overhead discipline (guard-object pattern): when observability is off,
+every instrumented component holds NULL_RECORDER, whose hooks are
+no-ops and whose `enabled` is False — hot paths pay one attribute check
+(`if obs.enabled:`) per *launch/serve round*, never per step, and the
+disabled configuration allocates nothing.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from collections import deque
+from typing import Optional
+
+# Log-spaced latency bucket upper bounds (seconds) for the hostcall
+# drain histograms; the +Inf bucket is implicit.  10us..30s covers
+# in-process NumPy drains through tunneled-TPU round trips (~100ms).
+LATENCY_BUCKETS = (
+    1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2,
+    1e-1, 3e-1, 1.0, 3.0, 10.0, 30.0,
+)
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram (Prometheus-shaped: per-bucket
+    counts + total observation count + sum of observed seconds), with a
+    drained-lane tally on the side (one drain call serves many lanes)."""
+
+    __slots__ = ("counts", "count", "sum_s", "lanes")
+
+    def __init__(self):
+        self.counts = [0] * len(LATENCY_BUCKETS)
+        self.count = 0
+        self.sum_s = 0.0
+        self.lanes = 0
+
+    def observe(self, dur_s: float, lanes: int = 1):
+        i = bisect.bisect_left(LATENCY_BUCKETS, dur_s)
+        if i < len(self.counts):
+            self.counts[i] += 1
+        self.count += 1
+        self.sum_s += float(dur_s)
+        self.lanes += int(lanes)
+
+    def cumulative(self):
+        """[(le_bound, cumulative_count)] for Prometheus rendering."""
+        out, acc = [], 0
+        for le, c in zip(LATENCY_BUCKETS, self.counts):
+            acc += c
+            out.append((le, acc))
+        return out
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullRecorder:
+    """Guard object for disabled observability: every hook is a no-op.
+
+    Instrumented code never branches per event on "is obs on?" — it
+    calls the recorder unconditionally at coarse seams (per launch /
+    serve / split), and guards only the *extra data gathering* (device
+    reads like occupancy) behind `if obs.enabled:`.  now() avoids even
+    the clock syscall."""
+
+    enabled = False
+
+    def now(self) -> float:
+        return 0.0
+
+    def span(self, name, t0, cat="", track="main", **args):
+        pass
+
+    def timed(self, name, cat="", track="main", **args):
+        return _NULL_SPAN
+
+    def instant(self, name, cat="", track="main", **args):
+        pass
+
+    def counter(self, name, value, track="counters"):
+        pass
+
+    def hostcall(self, kind, dur_s, lanes=1, vectorized=True):
+        pass
+
+    def add_tier_seconds(self, tier, dur_s):
+        pass
+
+    def add_opcode_counts(self, counts):
+        pass
+
+    def failure(self, rec):
+        pass
+
+
+NULL_RECORDER = NullRecorder()
+
+
+class _Span:
+    """Context manager from FlightRecorder.timed()."""
+
+    __slots__ = ("_rec", "_name", "_cat", "_track", "_args", "_t0")
+
+    def __init__(self, rec, name, cat, track, args):
+        self._rec = rec
+        self._name = name
+        self._cat = cat
+        self._track = track
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = self._rec.now()
+        return self
+
+    def __exit__(self, *exc):
+        self._rec.span(self._name, self._t0, cat=self._cat,
+                       track=self._track, **self._args)
+        return False
+
+
+class FlightRecorder:
+    """Bounded-ring event recorder (see module docstring).
+
+    Events are plain dicts {name, ph, cat, ts, dur, track, args}: ph is
+    the Chrome trace_event phase ("X" complete span, "i" instant, "C"
+    counter), ts/dur are SECONDS (the trace exporter scales to us),
+    track is a logical lane mapped to a trace tid at export time."""
+
+    enabled = True
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = int(capacity)
+        self.events = deque(maxlen=self.capacity)
+        self.dropped = 0
+        self._epoch = time.time()       # wall anchor, sampled once
+        self._mono0 = time.monotonic()  # duration clock zero
+        self.hostcalls = {}        # kind -> LatencyHistogram
+        self.tier_seconds = {}     # tier -> accumulated seconds
+        self.failure_counts = {}   # fault_class -> count
+        self.opcode_counts = None  # np.int64 [NUM_OPCODES+3] when folded
+
+    # The recorder is a shared sink, not configuration data: components
+    # deepcopy their Configure (gas bridging, scalar reruns) and must
+    # keep reporting into the SAME ring, not a silent private copy.
+    def __deepcopy__(self, memo):
+        return self
+
+    def __copy__(self):
+        return self
+
+    # -- clock -------------------------------------------------------------
+    def now(self) -> float:
+        return time.monotonic()
+
+    def _ts(self, mono: float) -> float:
+        """Wall timestamp (seconds since epoch) for a monotonic stamp."""
+        return self._epoch + (mono - self._mono0)
+
+    # -- event hooks -------------------------------------------------------
+    def _push(self, ev: dict):
+        if len(self.events) == self.capacity:
+            self.dropped += 1
+        self.events.append(ev)
+
+    def span(self, name, t0, cat="", track="main", **args):
+        """Record a completed span begun at monotonic stamp `t0`."""
+        t1 = time.monotonic()
+        self._push({"name": name, "ph": "X", "cat": cat,
+                    "ts": self._ts(t0), "dur": max(t1 - t0, 0.0),
+                    "track": track, "args": args})
+
+    def timed(self, name, cat="", track="main", **args):
+        return _Span(self, name, cat, track, args)
+
+    def instant(self, name, cat="", track="main", **args):
+        self._push({"name": name, "ph": "i", "cat": cat,
+                    "ts": self._ts(time.monotonic()), "dur": 0.0,
+                    "track": track, "args": args})
+
+    def counter(self, name, value, track="counters"):
+        self._push({"name": name, "ph": "C", "cat": "counter",
+                    "ts": self._ts(time.monotonic()), "dur": 0.0,
+                    "track": track, "args": {name: value}})
+
+    # -- aggregates --------------------------------------------------------
+    def hostcall(self, kind, dur_s, lanes=1, vectorized=True):
+        """One tier-1 drain observation: histogram + trace span on the
+        hostcall track."""
+        h = self.hostcalls.get(kind)
+        if h is None:
+            h = self.hostcalls[kind] = LatencyHistogram()
+        h.observe(dur_s, lanes)
+        t1 = time.monotonic()
+        self._push({"name": f"drain/{kind}", "ph": "X", "cat": "hostcall",
+                    "ts": self._ts(t1 - dur_s), "dur": dur_s,
+                    "track": "hostcalls",
+                    "args": {"lanes": int(lanes),
+                             "vectorized": bool(vectorized)}})
+
+    def add_tier_seconds(self, tier, dur_s):
+        self.tier_seconds[tier] = \
+            self.tier_seconds.get(tier, 0.0) + float(dur_s)
+
+    def add_opcode_counts(self, counts):
+        """Fold a device-side opcode histogram (index = original opcode
+        id, the Statistics cost_table domain) into the run aggregate."""
+        import numpy as np
+
+        counts = np.asarray(counts, np.int64)
+        if self.opcode_counts is None:
+            self.opcode_counts = counts.copy()
+        else:
+            n = max(len(self.opcode_counts), len(counts))
+            if len(self.opcode_counts) < n:
+                self.opcode_counts = np.pad(
+                    self.opcode_counts, (0, n - len(self.opcode_counts)))
+            self.opcode_counts[:len(counts)] += counts
+
+    def failure(self, rec):
+        """Mirror one FailureRecord as an instant event + taxonomy count."""
+        fc = getattr(rec, "fault_class", "unknown")
+        self.failure_counts[fc] = self.failure_counts.get(fc, 0) + 1
+        self._push({"name": f"failure/{fc}", "ph": "i", "cat": "failure",
+                    "ts": self._ts(time.monotonic()), "dur": 0.0,
+                    "track": "supervisor", "args": rec.asdict()})
+
+    # -- queries (tests / exporters) ---------------------------------------
+    def event_names(self):
+        return [e["name"] for e in self.events]
+
+
+def recorder_of(conf) -> "FlightRecorder | NullRecorder":
+    """The recorder for a Configure: NULL_RECORDER unless conf.obs is
+    enabled, in which case one FlightRecorder is lazily created and
+    shared by every component holding (a copy of) that Configure."""
+    obs_conf = getattr(conf, "obs", None)
+    if obs_conf is None or not getattr(obs_conf, "enabled", False):
+        return NULL_RECORDER
+    rec = getattr(obs_conf, "_recorder", None)
+    if rec is None:
+        rec = FlightRecorder(capacity=obs_conf.ring_capacity)
+        obs_conf._recorder = rec
+    return rec

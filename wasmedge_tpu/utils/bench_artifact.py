@@ -21,11 +21,14 @@ import json
 import os
 
 
-def emit(result: dict, default_path: str) -> None:
-    print(json.dumps(result))
+def artifact_path(default_path: str):
+    """Resolve a bench artifact's target path under the BENCH_ARTIFACT
+    override rules above; None when artifacts are disabled.  Shared by
+    emit() and side artifacts (e.g. bench.py's flight-recorder trace)
+    so every file honors the same redirects."""
     glob = os.environ.get("BENCH_ARTIFACT")
     if glob == "off":
-        return
+        return None
     stem = os.path.splitext(os.path.basename(default_path))[0].upper()
     path = os.environ.get(f"BENCH_ARTIFACT_{stem}")
     if path is None:
@@ -34,6 +37,14 @@ def emit(result: dict, default_path: str) -> None:
                 if (os.path.isdir(glob) or glob.endswith(os.sep)) else glob
         else:
             path = default_path
+    return path
+
+
+def emit(result: dict, default_path: str) -> None:
+    print(json.dumps(result))
+    path = artifact_path(default_path)
+    if path is None:
+        return
     try:
         with open(path, "w") as f:
             f.write(json.dumps(result, indent=2, sort_keys=True) + "\n")

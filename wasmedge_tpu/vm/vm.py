@@ -203,7 +203,10 @@ class VM:
 
     def execute_batch(self, func_name: str, args_lanes: Sequence,
                       lanes: Optional[int] = None, mesh=None,
-                      max_steps: int = 10_000_000, supervised: bool = False):
+                      max_steps: int = 10_000_000, supervised: bool = False,
+                      resume: Optional[bool] = None,
+                      trace_out: Optional[str] = None,
+                      metrics_out: Optional[str] = None):
         """Run the instantiated module's export over N device lanes in SIMT
         lockstep (the tpu_batch engine, SURVEY.md §2.10) and return the
         BatchResult (per-lane results/trap/retired arrays).
@@ -212,28 +215,107 @@ class VM:
         (batch/supervisor.py): periodic checkpoints, retry-with-backoff
         from the last good snapshot, and the Pallas -> SIMT -> scalar
         degradation ladder, with FailureRecords landing on this VM's
-        Statistics (conf.supervisor holds the knobs)."""
+        Statistics (conf.supervisor holds the knobs).  `resume=True`
+        additionally adopts an existing checkpoint_dir lineage at
+        startup (cross-process resume).
+
+        `trace_out` / `metrics_out` enable the observability subsystem
+        (wasmedge_tpu/obs/) for this VM and export a Chrome trace_event
+        JSON / Prometheus text snapshot after the run; conf.obs holds
+        the knobs (ring capacity, device opcode histogram)."""
         from wasmedge_tpu.batch.uniform import UniformBatchEngine
 
         with self._lock:
             if self._active is None or self.stage != VMStage.Instantiated:
                 raise WasmError(ErrCode.WrongVMWorkflow, "no instantiated module")
             inst = self._active
+        # cross-process resume runs under the supervisor (only it owns
+        # the checkpoint lineage) — mirror the CLI's "--resume implies
+        # --supervised" so resume=True is never silently ignored
+        if resume:
+            supervised = True
+        # Per-call export: the paths stay LOCAL to this call (handed to
+        # _export_obs directly, never stored on the shared conf); only
+        # the `enabled` flag must reach the engines through conf.obs,
+        # and a flag this call flipped on is flipped back in the
+        # finally.  Concurrent traced calls on one VM degrade to one of
+        # them possibly building engines after the other's restore (its
+        # export is then empty) — never to corrupted or sticky config.
+        obs_conf = self.conf.obs
+        obs_flipped = bool((trace_out or metrics_out)
+                           and not obs_conf.enabled)
+        if obs_flipped:
+            obs_conf.enabled = True
+        # instantiate the shared recorder BEFORE the gas bridge's
+        # deepcopy so every engine copy reports into one ring
+        from wasmedge_tpu.obs.recorder import recorder_of
+
+        rec = recorder_of(self.conf)
         # the auto engine: Pallas warp-interpreter on TPU, XLA uniform on
         # CPU, SIMT for divergence/fuel/mesh — all behind one run()
         conf = batch_conf_with_gas(self.conf, self.stat)
-        if supervised:
-            from wasmedge_tpu.batch.engine import BatchEngine
-            from wasmedge_tpu.batch.supervisor import BatchSupervisor
+        eng = None
+        try:
+            if supervised:
+                from wasmedge_tpu.batch.engine import BatchEngine
+                from wasmedge_tpu.batch.supervisor import BatchSupervisor
 
-            eng = BatchEngine(inst, store=self.store, conf=conf,
-                              lanes=lanes, mesh=mesh)
-            sup = BatchSupervisor(eng, conf=conf, stats=self.stat)
-            return sup.run(func_name, list(args_lanes),
+                eng = BatchEngine(inst, store=self.store, conf=conf,
+                                  lanes=lanes, mesh=mesh)
+                sup = BatchSupervisor(eng, conf=conf, stats=self.stat,
+                                      resume=resume)
+                return sup.run(func_name, list(args_lanes),
+                               max_steps=max_steps)
+            eng = UniformBatchEngine(inst, store=self.store, conf=conf,
+                                     lanes=lanes, mesh=mesh)
+            return eng.run(func_name, list(args_lanes),
                            max_steps=max_steps)
-        eng = UniformBatchEngine(inst, store=self.store, conf=conf,
-                                 lanes=lanes, mesh=mesh)
-        return eng.run(func_name, list(args_lanes), max_steps=max_steps)
+        finally:
+            try:
+                if rec.enabled:
+                    self._export_obs(rec, eng=eng, trace_out=trace_out,
+                                     metrics_out=metrics_out)
+            except Exception as exp_err:
+                # the exports are a record of the run, never its fate:
+                # an unwritable path must not discard a computed
+                # BatchResult or mask the run's real exception
+                import sys
+
+                print(f"wasmedge-tpu: obs export failed: {exp_err!r}",
+                      file=sys.stderr)
+            finally:
+                if obs_flipped:
+                    obs_conf.enabled = False
+
+    def _export_obs(self, rec, eng=None, trace_out=None,
+                    metrics_out=None):
+        """Fold recorder aggregates into this VM's Statistics and write
+        the trace/metrics artifacts (per-call paths, else conf.obs)."""
+        if rec.opcode_counts is not None:
+            # fold only the delta since the last export: the recorder
+            # accumulates across runs, Statistics must not double-count
+            cur = rec.opcode_counts.copy()
+            prev = getattr(rec, "_stat_folded", None)
+            self.stat.add_opcode_counts(cur if prev is None
+                                        else cur - prev)
+            rec._stat_folded = cur
+        hs = getattr(eng, "hostcall_stats", None) if eng is not None \
+            else None
+        if hs is None and eng is not None:
+            hs = getattr(getattr(eng, "simt", None), "hostcall_stats",
+                         None)
+        oc = self.conf.obs
+        trace_out = trace_out or oc.trace_out
+        metrics_out = metrics_out or oc.metrics_out
+        if trace_out:
+            from wasmedge_tpu.obs.trace import export_chrome_trace
+
+            export_chrome_trace(rec, trace_out)
+        if metrics_out:
+            from wasmedge_tpu.obs.metrics import export_prometheus
+
+            export_prometheus(metrics_out, recorder=rec,
+                              stats=self.stat, hostcall_stats=hs)
 
     # -- async + interruption (reference: vm.cpp asyncExecute + stop) ------
     def stop(self):
