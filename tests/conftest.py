@@ -32,6 +32,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "obs: observability suite (flight recorder, trace/"
         "metrics export; tier-1 fast, runs under -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "serve: continuous-batching serving suite (request "
+        "queue, lane recycling, fairness; tier-1 fast, runs under "
+        "-m 'not slow')")
 
 
 def pytest_addoption(parser):

@@ -53,13 +53,20 @@ def image_fingerprint(img) -> str:
 
 
 def save(path, engine: BatchEngine, state: BatchState, total_steps: int,
-         invocation=None):
+         invocation=None, stdout_pos=None):
     """Snapshot an in-flight batch to `path` (.npz).
 
     `invocation` (optional dict, e.g. the supervisor's function-name +
     argument fingerprint) is recorded in the metadata so a CROSS-PROCESS
     resume can refuse a snapshot taken for a different call — the image
-    hash alone cannot tell f(30) from f(31)."""
+    hash alone cannot tell f(30) from f(31).
+
+    `stdout_pos` overrides the journaled stdout cursor with a caller-held
+    snapshot.  A caller whose `state` may be older than the engine's live
+    cursor (the serving layer checkpointing from another thread while a
+    launch slice is in flight) must pass the positions it captured when
+    `state` was current, or a restore would suppress output the saved
+    state has not produced yet."""
     cfg = engine.cfg
     meta = {
         "format": FORMAT_VERSION,
@@ -80,6 +87,21 @@ def save(path, engine: BatchEngine, state: BatchState, total_steps: int,
     arrays = {f"state_{name}": np.asarray(getattr(state, name))
               for name in state._fields
               if getattr(state, name) is not None}
+    # stdout flush cursor (batch/hostcall.py _stdout_cursor): journal the
+    # logical stream positions so a restore rewinds them with the state —
+    # the exactly-once half the high-water mark (engine-resident) needs.
+    # Materialized (zeros) even when no flush has happened yet: a
+    # snapshot taken BEFORE the first flush must still rewind pos to 0
+    # on restore, or the first post-snapshot flush replays unsuppressed.
+    if getattr(state, "so_buf", None) is not None:
+        if stdout_pos is not None:
+            arrays["stdout_pos"] = np.asarray(stdout_pos, np.int64)
+        else:
+            from wasmedge_tpu.batch.hostcall import _stdout_cursor
+
+            pos, _ = _stdout_cursor(engine,
+                                    int(np.asarray(state.so_off).size))
+            arrays["stdout_pos"] = np.asarray(pos, np.int64)
     buf = io.BytesIO()
     np.savez_compressed(buf, meta=json.dumps(meta), **arrays)
     data = buf.getvalue()
@@ -167,6 +189,19 @@ def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:
                     f"hostcalls but checkpoint lacks planes {missing} "
                     "(pre-r06 checkpoint?)")
         _validate_planes(fields, engine)
+        # rewind the stdout flush cursor with the state: the journaled
+        # logical position replaces the engine's, the written high-water
+        # mark only ever grows (in-process restore keeps suppressing
+        # output flushed after this snapshot; a fresh process starts its
+        # high-water AT the snapshot — output the dead process flushed
+        # beyond it is outside what any journal-in-checkpoint can prove)
+        if "stdout_pos" in z.files:
+            from wasmedge_tpu.batch.hostcall import _stdout_cursor
+
+            journaled = np.asarray(z["stdout_pos"], np.int64)
+            pos, hw = _stdout_cursor(engine, journaled.size)
+            pos[:] = journaled
+            np.maximum(hw, journaled, out=hw)
     return BatchState(**fields), meta["total_steps"]
 
 

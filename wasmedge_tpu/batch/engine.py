@@ -1877,6 +1877,10 @@ class BatchEngine:
         if self._run_chunk is None:
             self._build()
         self.hostcall_stats = new_hostcall_stats()
+        # a fresh run is a fresh output stream: both cursor halves reset
+        from wasmedge_tpu.batch.hostcall import stdout_cursor_reset
+
+        stdout_cursor_reset(self)
         state = self.initial_state(func_idx, args_lanes)
         if self.mesh is not None:
             from wasmedge_tpu.parallel.mesh import shard_batch_state

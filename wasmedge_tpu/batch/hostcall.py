@@ -131,11 +131,50 @@ def gather_arg_cells(stack_lo, stack_hi, fp, lanes, nargs) -> np.ndarray:
     return (lo | (hi << np.uint64(32))).view(np.int64)
 
 
+def _stdout_cursor(engine, lanes: int):
+    """Per-lane stdout stream cursor backing exactly-once flushing
+    across restores (ROADMAP r7 open item).
+
+    `pos[lane]` is the lane's LOGICAL stream position: total payload
+    bytes its tier-0 fd_write records have reached in this run's
+    deterministic replay order.  `hw[lane]` is the high-water mark of
+    bytes actually written to the host fds.  A restore rewinds `pos`
+    (checkpoint.load journals it; a restore to the initial state zeroes
+    it) while `hw` survives on the engine — so replayed records are
+    skipped up to the high-water mark instead of re-written."""
+    cur = getattr(engine, "_stdout_cursor", None)
+    if cur is None or cur[0].size != lanes:
+        cur = (np.zeros(lanes, np.int64), np.zeros(lanes, np.int64))
+        engine._stdout_cursor = cur
+    return cur
+
+
+def stdout_cursor_reset(engine, keep_highwater: bool = False):
+    """Reset the logical stream position (a fresh run, or a restore to
+    the initial state).  `keep_highwater=True` preserves the written
+    high-water mark so a from-scratch REPLAY of the same run suppresses
+    output it already flushed; False starts a genuinely new stream."""
+    cur = getattr(engine, "_stdout_cursor", None)
+    if cur is None:
+        return
+    cur[0][:] = 0
+    if not keep_highwater:
+        cur[1][:] = 0
+
+
 def flush_stdout_buffers(engine, state):
     """Drain the tier-0 in-device stdout record buffers to the WASI
     environ's fds (one download, one write per fd) and reset the
     per-lane offsets.  Runs at harvest and before any tier-1 serve so
-    per-lane output ordering is preserved."""
+    per-lane output ordering is preserved.
+
+    Exactly-once across restores: each lane's records advance a logical
+    stream cursor; bytes at positions below the engine's written
+    high-water mark are a deterministic replay of output a previous
+    attempt already flushed and are skipped (see _stdout_cursor).  The
+    guarantee assumes deterministic payloads — a guest that embeds
+    wall-clock values in its output regenerates different bytes and the
+    suppression degrades to at-least-once for the replayed window."""
     if getattr(state, "so_buf", None) is None:
         return state
     so_off = np.asarray(state.so_off)
@@ -145,29 +184,39 @@ def flush_stdout_buffers(engine, state):
 
     buf = np.asarray(state.so_buf)
     env = wasi_env_of(engine)
+    pos, hw = _stdout_cursor(engine, so_off.size)
     per_fd = {}
     nbytes = 0
     for lane in np.nonzero(so_off > 0)[0]:
         end = int(so_off[lane])
         col = buf[:end, lane]
-        pos = 0
-        while pos < end:
-            hdr = int(np.uint32(col[pos]))
+        p = int(pos[lane])
+        h = int(hw[lane])
+        off = 0
+        while off < end:
+            hdr = int(np.uint32(col[off]))
             fd = hdr >> 28
             ln = hdr & 0x0FFFFFFF
             nw = (ln + 3) // 4
-            data = np.ascontiguousarray(
-                col[pos + 1:pos + 1 + nw]).tobytes()[:ln]
-            per_fd.setdefault(fd, []).append(data)
-            nbytes += ln
-            pos += 1 + nw
+            skip = min(max(h - p, 0), ln)
+            if skip < ln:
+                data = np.ascontiguousarray(
+                    col[off + 1:off + 1 + nw]).tobytes()[:ln]
+                per_fd.setdefault(fd, []).append(data[skip:])
+                nbytes += ln - skip
+            p += ln
+            off += 1 + nw
+        pos[lane] = p
+        hw[lane] = max(h, p)
     from wasmedge_tpu.host.wasi.vectorized import _write_all
 
     for fd in sorted(per_fd):
         e = env.fds.get(fd) if env is not None else None
         if e is None or e.os_fd < 0:
             continue  # fd vanished (tier-0 gating makes this unreachable)
-        _write_all(e, b"".join(per_fd[fd]))
+        data = b"".join(per_fd[fd])
+        if data:
+            _write_all(e, data)
     stats = getattr(engine, "hostcall_stats", None)
     if stats is not None:
         stats["stdout_flushes"] += 1

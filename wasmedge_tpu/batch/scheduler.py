@@ -243,6 +243,12 @@ class BlockScheduler:
         # scheduler reports launches, serves, splits, frees, residue
         # handoffs and live-lane occupancy; NULL_RECORDER when off
         self.obs = outer.obs
+        # per-device trace attribution (parallel/mesh.py sets obs_track
+        # on each device's engine so multi-chip runs keep their devices'
+        # events on separate tracks instead of one interleaved "pallas")
+        self._track = getattr(outer, "obs_track", "pallas")
+        self._track_simt = "simt" if self._track == "pallas" \
+            else self._track
         self._t_launch = 0.0
         self._plane_idx = _PLANE_IDX_SIMD if outer.img.has_simd \
             else _PLANE_IDX
@@ -515,7 +521,7 @@ class BlockScheduler:
                 valid = self.block_lanes >= 0
                 obs.span(
                     "kernel_round", self._t_launch, cat="scheduler",
-                    track="pallas", blocks=self._launch_blocks,
+                    track=self._track, blocks=self._launch_blocks,
                     retired_delta=int(
                         (new_steps[live] * valid[live].sum(axis=1)).sum()))
                 obs.counter("live_lanes", int(
@@ -648,7 +654,7 @@ class BlockScheduler:
         self.block_state[b] = _B_FREE
         self._ctrl()[b, _C_STATUS] = ST_DONE
         self._ctrl_dirty = True
-        self.obs.instant("block_free", cat="scheduler", track="pallas",
+        self.obs.instant("block_free", cat="scheduler", track=self._track,
                          block=b)
 
     # -- split machinery ---------------------------------------------------
@@ -660,7 +666,7 @@ class BlockScheduler:
         frames = self._frames()[b]
         pages_over = eng._pages_override.pop(b, None)
         self.splits += 1
-        self.obs.instant("split", cat="scheduler", track="pallas",
+        self.obs.instant("split", cat="scheduler", track=self._track,
                          block=b, pc=int(ctrl[_C_PC]), status=status,
                          splits=self.splits)
         if status == ST_REGROW or self.splits > self.split_budget:
@@ -1011,7 +1017,7 @@ class BlockScheduler:
         ids = self.block_lanes[b]
         vcols = np.nonzero(ids >= 0)[0]
         self.obs.instant("simt_residue_queue", cat="scheduler",
-                         track="pallas", block=b, lanes=int(vcols.size))
+                         track=self._track, block=b, lanes=int(vcols.size))
         cols = self._extract_cols(b, vcols, {})
         self._simt_queue.append(_Pending(
             ctrl=ctrl.copy(), frames=frames.copy(), cols=cols,
@@ -1132,7 +1138,7 @@ class BlockScheduler:
             self.res_lo[:, all_m] = s_lo_f[:, all_m]
             self.res_hi[:, all_m] = s_hi_f[:, all_m]
         self.obs.span("simt_residue", t_residue, cat="scheduler",
-                      track="simt", lanes=int(all_m.size),
+                      track=self._track_simt, lanes=int(all_m.size),
                       steps=int(total))
         if simd_capped and max_steps_eff < self.max_steps:
             survivors = all_m[trap_f[all_m] == 0]
@@ -1151,7 +1157,7 @@ class BlockScheduler:
         FailureRecords in the process-wide log instead of being
         silently swallowed."""
         self.quarantined = getattr(self, "quarantined", 0) + int(lanes.size)
-        self.obs.instant("quarantine", cat="scheduler", track="simt",
+        self.obs.instant("quarantine", cat="scheduler", track=self._track_simt,
                          lanes=int(lanes.size))
         inst = self.inst
         has_host = any(getattr(f, "kind", None) == "host"

@@ -37,12 +37,14 @@ checkpoint layer (batch/checkpoint.py) already snapshots:
    incident (fault class, lane set, retry count, checkpoint lineage,
    tier) lands on the supplied Statistics and the process-wide log.
 
-Side-effect caveat: host-visible WASI effects (tier-1 writes, tier-0
-stdout flushes) are at-least-once across a restore — output flushed
-before the failed slice is not un-written.  Flushes happen only at slice
-boundaries and serve points, so a checkpoint cadence aligned with output
-expectations bounds the duplication window; pure-compute batches are
-exactly-once by construction.
+Side-effect caveat: tier-0 stdout is exactly-once across SIMT-tier
+restores since r9 — flushes advance a per-lane stream cursor journaled
+in every checkpoint, and replayed records are suppressed up to the
+engine's written high-water mark (batch/hostcall.py _stdout_cursor).
+Tier-1 writes, and any output a *demoted* tier already flushed (the
+pallas attempt's flushes live on its own engine object and lane
+packing, so its cursor cannot transfer to the SIMT replay), remain
+at-least-once; pure-compute batches are exactly-once by construction.
 """
 
 from __future__ import annotations
@@ -66,6 +68,16 @@ class _TierExhausted(Exception):
     def __init__(self, cause):
         super().__init__(repr(cause))
         self.cause = cause
+
+
+def backoff_seconds(knobs, attempt: int) -> float:
+    """Exponential backoff shared by the supervisor and the serving
+    layer (both knob objects carry backoff_base_s/_factor/_max_s)."""
+    base = float(knobs.backoff_base_s)
+    if base <= 0:
+        return 0.0
+    return min(float(knobs.backoff_max_s),
+               base * float(knobs.backoff_factor) ** max(attempt - 1, 0))
 
 
 def scalar_rerun(inst, conf, func_name: str, func_idx: int, args_lanes,
@@ -265,6 +277,10 @@ class BatchSupervisor:
             self._adopted = None
             self._restored_from = self._ckpts[-1][0]
         else:
+            # a fresh (non-resumed) run starts a fresh output stream
+            from wasmedge_tpu.batch.hostcall import stdout_cursor_reset
+
+            stdout_cursor_reset(self.engine)
             state, total = self._initial_state(), 0
         consecutive = 0
         fail_keys = {}
@@ -462,6 +478,13 @@ class BatchSupervisor:
                 self._ckpts.pop()
         self._restored_from = None
         self._reset_cadence(0)
+        # replay from scratch: rewind the logical stdout position but
+        # KEEP the written high-water mark — output the failed attempt
+        # already flushed is suppressed on replay, not written twice
+        # (exactly-once stdout across restores, batch/hostcall.py)
+        from wasmedge_tpu.batch.hostcall import stdout_cursor_reset
+
+        stdout_cursor_reset(self.engine, keep_highwater=True)
         return self._initial_state(), 0
 
     def _reset_cadence(self, total: int):
@@ -585,12 +608,9 @@ class BatchSupervisor:
 
     # -- bookkeeping ------------------------------------------------------
     def _backoff(self, attempt: int):
-        base = float(self.k.backoff_base_s)
-        if base <= 0:
-            return
-        time.sleep(min(float(self.k.backoff_max_s),
-                       base * float(self.k.backoff_factor)
-                       ** max(attempt - 1, 0)))
+        nap = backoff_seconds(self.k, attempt)
+        if nap > 0:
+            time.sleep(nap)
 
     def _record(self, fault_class, exc, lanes=(), tier="simt",
                 checkpoint=None, error=None):

@@ -992,6 +992,9 @@ class UniformBatchEngine:
         from wasmedge_tpu.batch.engine import new_hostcall_stats
 
         self.simt.hostcall_stats = new_hostcall_stats()
+        from wasmedge_tpu.batch.hostcall import stdout_cursor_reset
+
+        stdout_cursor_reset(self.simt)  # fresh run = fresh output stream
         if self.pallas is not None:
             res = self.pallas.run(func_name, args_lanes, max_steps)
             self.fell_back_to_simt = self.pallas.fell_back_to_simt

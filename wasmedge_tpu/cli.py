@@ -258,6 +258,197 @@ def run_command(argv: List[str], out=None, err=None) -> int:
     return code
 
 
+def _serve_parser() -> ArgumentParser:
+    p = ArgumentParser("wasmedge-tpu serve",
+                       "continuous-batching serving over device lanes: "
+                       "queue requests, recycle retired lanes, report "
+                       "latency/occupancy")
+    p.add_option(["lanes"], Option("device lanes to serve on", "n",
+                                   typ=int, default=64))
+    p.add_option(["requests"], Option("seeded request count", "n",
+                                      typ=int, default=256))
+    p.add_option(["arg-min"], Option("minimum argument value", "n",
+                                     typ=int, default=8))
+    p.add_option(["arg-max"], Option("maximum argument value", "n",
+                                     typ=int, default=20))
+    p.add_option(["seed"], Option("request schedule seed", "n",
+                                  typ=int, default=0))
+    p.add_option(["tenants"], Option("spread requests over N tenants",
+                                     "n", typ=int, default=1))
+    p.add_option(["deadline-ms"],
+                 Option("per-request deadline in milliseconds", "ms",
+                        typ=int))
+    p.add_option(["queue-capacity"],
+                 Option("bounded queue capacity (backpressure)", "n",
+                        typ=int))
+    p.add_option(["autotune"],
+                 Toggle("auto-tune steps_per_launch from the hostcall "
+                        "drain-latency histograms"))
+    p.add_option(["checkpoint-dir"],
+                 Option("serving-state checkpoint directory", "dir"))
+    p.add_option(["checkpoint-every"],
+                 Option("checkpoint every N serving rounds", "n",
+                        typ=int))
+    p.add_option(["resume"],
+                 Toggle("adopt an existing --checkpoint-dir serving "
+                        "lineage (in-flight requests come back)"))
+    p.add_option(["trace-out"],
+                 Option("write a Chrome trace_event JSON of the serving "
+                        "run", "path"))
+    p.add_option(["metrics-out"],
+                 Option("write a Prometheus metrics snapshot after the "
+                        "serving run", "path"))
+    p.add_positional("wasm_file", "WebAssembly file to serve")
+    p.add_positional("func", "exported function handling each request")
+    return p
+
+
+def serve_command(argv: List[str], out=None, err=None) -> int:
+    """`wasmedge-tpu serve app.wasm func [options]`: drive a seeded
+    request stream through the continuous-batching BatchServer and
+    print one JSON summary line (req/s, latency percentiles, occupancy,
+    recycled lanes)."""
+    import json
+
+    out = out or sys.stdout
+    err = err or sys.stderr
+    p = _serve_parser()
+    try:
+        if not p.parse(argv, out):
+            return 0
+        # the shared parser stops option processing at the last
+        # positional (`run`'s trailing args are guest argv payload);
+        # serve has no payload, so `serve app.wasm func --lanes 4`
+        # must keep parsing options instead of dropping them
+        if p.rest:
+            trailing, p.rest = p.rest, []
+            if not p.parse(trailing, out):
+                return 0
+            if p.rest:
+                raise ValueError(
+                    f"unexpected argument {p.rest[0]!r}")
+    except ValueError as e:
+        err.write(f"wasmedge-tpu: {e}\n")
+        return 2
+    conf = Configure()
+    conf.host_registrations.add(HostRegistration.Wasi)
+    if p._opts["queue-capacity"].seen:
+        conf.serve.queue_capacity = p._opts["queue-capacity"].value
+    if p._opts["autotune"].value:
+        conf.serve.autotune = True
+        conf.obs.enabled = True   # the tuner reads the drain histograms
+    if p._opts["checkpoint-every"].seen:
+        conf.serve.checkpoint_every_rounds = p._opts["checkpoint-every"].value
+    if p._opts["trace-out"].seen or p._opts["metrics-out"].seen:
+        conf.obs.enabled = True
+
+    from wasmedge_tpu.vm import VM
+
+    path, func = p.positional_values[0], p.positional_values[1]
+    vm = VM(conf)
+    if vm.wasi_module is not None:
+        vm.wasi_module.init_wasi(dirs=[], prog_name=path)
+    try:
+        vm.load_wasm(path)
+        vm.validate()
+        vm.instantiate()
+    except WasmError as e:
+        err.write(f"wasmedge-tpu: load failed: {e.formatted()}\n")
+        return 1
+    except OSError as e:
+        err.write(f"wasmedge-tpu: cannot read {path}: {e}\n")
+        return 1
+
+    import time as _time
+
+    import numpy as np
+
+    server = vm.serve(lanes=p._opts["lanes"].value,
+                      checkpoint_dir=p._opts["checkpoint-dir"].value,
+                      resume=p._opts["resume"].value)
+    # adopted in-flight requests complete alongside the fresh stream and
+    # land in the same counters — the exit check must expect them too
+    nadopted = len(server.adopted)
+    try:
+        # fail like run_command's "function not found", not a traceback
+        server.recycler.func_idx(func)
+    except (KeyError, ValueError) as e:
+        err.write(f"wasmedge-tpu: {e.args[0] if e.args else e}\n")
+        return 1
+    rng = np.random.RandomState(p._opts["seed"].value)
+    nreq = p._opts["requests"].value
+    ntenants = max(p._opts["tenants"].value, 1)
+    lo_a = p._opts["arg-min"].value
+    hi_a = max(p._opts["arg-max"].value, lo_a)
+    deadline_ms = p._opts["deadline-ms"].value
+    from wasmedge_tpu.serve import QueueSaturated
+
+    futures = []
+    t0 = _time.monotonic()
+    try:
+        for i in range(nreq):
+            args = [int(rng.randint(lo_a, hi_a + 1))]
+            while True:
+                try:
+                    futures.append(server.submit(
+                        func, args, tenant=f"tenant{i % ntenants}",
+                        deadline_s=deadline_ms / 1000.0
+                        if deadline_ms is not None else None))
+                    break
+                except QueueSaturated:
+                    # backpressure: serve a round to free queue space
+                    if not server.step():
+                        if server.failed is not None:
+                            # surface the terminal engine failure, not
+                            # the stale backpressure signal it caused
+                            raise server.failed from None
+                        raise
+        server.run_until_idle()
+    except WasmError as e:
+        err.write(f"wasmedge-tpu: serve failed: {e}\n")
+        return 1
+    wall = _time.monotonic() - t0
+    from wasmedge_tpu.utils.bench_artifact import percentile
+
+    lat = sorted(f.t_done - t0 for f in futures if f.t_done is not None)
+    c = server.counters
+    # true utilization, same definition bench.py --serve compares with:
+    # retired instructions over device step-lanes
+    occupancy = (c["retired_instructions"]
+                 / max(server.total * server.lanes, 1))
+    summary = {
+        "metric": "serve_cli",
+        "requests": nreq,
+        "adopted": nadopted,
+        "completed": c["completed"],
+        "trapped": c["trapped"],
+        "expired": c["expired"],
+        "killed": c["killed"],
+        "recycled_lanes": c["recycled_lanes"],
+        "rounds": c["rounds"],
+        "occupancy": round(occupancy, 4),
+        "wall_s": round(wall, 3),
+        "req_per_s": round(nreq / wall, 1) if wall > 0 else 0.0,
+        "p50_latency_s": round(percentile(lat, 0.5), 4) if lat else None,
+        "p99_latency_s": round(percentile(lat, 0.99), 4) if lat else None,
+    }
+    out.write(json.dumps(summary) + "\n")
+    if conf.obs.enabled:
+        rec = server.obs
+        if p._opts["trace-out"].seen:
+            from wasmedge_tpu.obs.trace import export_chrome_trace
+
+            export_chrome_trace(rec, p._opts["trace-out"].value)
+        if p._opts["metrics-out"].seen:
+            from wasmedge_tpu.obs.metrics import export_prometheus
+
+            export_prometheus(p._opts["metrics-out"].value, recorder=rec,
+                              stats=vm.statistics(),
+                              hostcall_stats=server.engine.hostcall_stats)
+    return 0 if c["completed"] + c["trapped"] + c["expired"] \
+        + c["killed"] == nreq + nadopted else 1
+
+
 def compile_command(argv: List[str], out=None, err=None) -> int:
     out = out or sys.stdout
     err = err or sys.stderr
@@ -302,14 +493,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         sys.stdout.write(
-            "usage: wasmedge-tpu [run|compile|version] ...\n"
+            "usage: wasmedge-tpu [run|serve|compile|version] ...\n"
             "  run      run a wasm file (default when first arg is a file)\n"
+            "  serve    continuous-batching serving over device lanes\n"
             "  compile  precompile to a universal twasm artifact\n"
             "  version  print version\n")
         return 0
     cmd, rest = argv[0], argv[1:]
     if cmd == "run":
         return run_command(rest)
+    if cmd == "serve":
+        return serve_command(rest)
     if cmd == "compile":
         return compile_command(rest)
     if cmd == "version":

@@ -239,6 +239,49 @@ class SupervisorConfigure:
 
 
 @dataclasses.dataclass
+class ServeConfigure:
+    """Knobs for the continuous-batching serving layer (wasmedge_tpu/serve/).
+
+    A BatchServer owns a bounded request queue, packs queued requests
+    into device lanes, and recycles lanes the moment they retire
+    instead of waiting for batch drain; per-tenant weighted-fair
+    admission, deadlines, and backpressure live here."""
+
+    # Bounded request queue: submit() beyond this many QUEUED (not yet
+    # admitted) requests is rejected with QueueSaturated (ErrCode
+    # backpressure, never silent drops).
+    queue_capacity: int = 65536
+    # Per-request retired-instruction budget: a request still running
+    # past it is terminated with CostLimitExceeded (runaway guard; the
+    # serving loop has no natural max_steps to drain to).
+    max_steps_per_request: int = 10_000_000
+    # Checkpoint the serving state every N serving rounds (the server's
+    # analog of SupervisorConfigure cadence; each round is one
+    # steps_per_launch slice).  None = only on demand.
+    checkpoint_every_rounds: Optional[int] = None
+    checkpoint_dir: Optional[str] = None
+    keep_checkpoints: int = 2
+    # Retry budget for launch/serve failures before the server gives up
+    # and fails the in-flight futures (restores from the newest good
+    # checkpoint, else re-queues the in-flight requests from scratch).
+    max_retries: int = 3
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 2.0
+    # --- steps_per_launch auto-tuning (serve/autotune.py) ---
+    # Feedback rule driven by the tier-1 hostcall drain-latency
+    # histograms (obs/): expensive drains relative to device launch
+    # time grow the chunk (amortize serve overhead), cheap drains with
+    # parked lanes shrink it (serve sooner).  Off by default; every
+    # adjustment is logged to the flight recorder as an "autotune"
+    # instant.  Changing the chunk rebuilds the jitted step loop, so
+    # adjustments are power-of-two quantized and bounded.
+    autotune: bool = False
+    autotune_min_chunk: int = 64
+    autotune_max_chunk: int = 1 << 20
+
+
+@dataclasses.dataclass
 class CompilerConfigure:
     """AOT-compiler knobs (reference: CompilerConfigure,
     include/common/configure.h:28-106).  The optimization level and
@@ -266,6 +309,7 @@ class Configure:
     supervisor: SupervisorConfigure = dataclasses.field(
         default_factory=SupervisorConfigure)
     obs: ObsConfigure = dataclasses.field(default_factory=ObsConfigure)
+    serve: ServeConfigure = dataclasses.field(default_factory=ServeConfigure)
     compiler: CompilerConfigure = dataclasses.field(default_factory=CompilerConfigure)
 
     def add_proposal(self, p: Proposal) -> "Configure":

@@ -124,6 +124,17 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
             for kind in sorted(recorder.hostcalls):
                 w.sample("wasmedge_hostcall_drained_lanes_total",
                          {"kind": kind}, recorder.hostcalls[kind].lanes)
+        admission = getattr(recorder, "admission", None)
+        if admission is not None and admission.count:
+            name = "wasmedge_serve_admission_latency_seconds"
+            w.head(name, "histogram",
+                   "Serving-layer admission latency: request submit() "
+                   "to lane install (wasmedge_tpu/serve/).")
+            for le, acc in admission.cumulative():
+                w.sample(f"{name}_bucket", {"le": repr(float(le))}, acc)
+            w.sample(f"{name}_bucket", {"le": "+Inf"}, admission.count)
+            w.sample(f"{name}_sum", None, admission.sum_s)
+            w.sample(f"{name}_count", None, admission.count)
         if recorder.tier_seconds:
             w.head("wasmedge_tier_residency_seconds", "counter",
                    "Wall seconds the batch spent on each engine tier "

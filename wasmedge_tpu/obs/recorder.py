@@ -120,6 +120,9 @@ class NullRecorder:
     def hostcall(self, kind, dur_s, lanes=1, vectorized=True):
         pass
 
+    def observe_admission(self, dur_s):
+        pass
+
     def add_tier_seconds(self, tier, dur_s):
         pass
 
@@ -172,6 +175,7 @@ class FlightRecorder:
         self._epoch = time.time()       # wall anchor, sampled once
         self._mono0 = time.monotonic()  # duration clock zero
         self.hostcalls = {}        # kind -> LatencyHistogram
+        self.admission = LatencyHistogram()  # serve submit -> install
         self.tier_seconds = {}     # tier -> accumulated seconds
         self.failure_counts = {}   # fault_class -> count
         self.opcode_counts = None  # np.int64 [NUM_OPCODES+3] when folded
@@ -233,6 +237,11 @@ class FlightRecorder:
                     "track": "hostcalls",
                     "args": {"lanes": int(lanes),
                              "vectorized": bool(vectorized)}})
+
+    def observe_admission(self, dur_s):
+        """One serving-layer admission observation: queue wait from
+        submit() to lane install (wasmedge_tpu/serve/)."""
+        self.admission.observe(dur_s)
 
     def add_tier_seconds(self, tier, dur_s):
         self.tier_seconds[tier] = \

@@ -100,6 +100,12 @@ def run_pallas_sharded(inst, store, conf, func_name, args_lanes,
             if not eng.eligible:
                 raise RuntimeError(
                     f"pallas ineligible: {eng.ineligible_reason}")
+            # per-device flight-recorder track (ROADMAP r8 open item):
+            # each device's scheduler events — kernel rounds, splits,
+            # frees, residue — land on their own trace track instead of
+            # interleaving on one "pallas" lane, so a multi-chip serving
+            # run is attributable per chip in Perfetto
+            eng.obs_track = f"pallas/dev{di}"
             sl = slice(di * per, (di + 1) * per)
             scheds.append((dev, BlockScheduler(
                 eng, func_name, [a[sl] for a in args], max_steps)))
@@ -117,7 +123,16 @@ def run_pallas_sharded(inst, store, conf, func_name, args_lanes,
         def drive(dev, s):
             try:
                 with jax.default_device(dev):
-                    s.run()   # includes the SIMT residue pass
+                    # one span per device thread bracketing its whole
+                    # drive, on the device's own track — the thread's
+                    # scheduler events nest under it in the trace
+                    t0 = s.obs.now()
+                    try:
+                        s.run()   # includes the SIMT residue pass
+                    finally:
+                        s.obs.span("device_drive", t0, cat="mesh",
+                                   track=s._track,
+                                   device=str(dev), lanes=s.lanes)
             except Exception as e:  # noqa: BLE001
                 errs.append((dev, e))
 

@@ -1,0 +1,18 @@
+"""Continuous-batching serving layer (r9).
+
+`BatchServer` turns the drain-to-empty batch engines into a long-lived
+service: a bounded request queue with per-tenant weighted-fair
+admission, lane recycling at launch boundaries, deadline/backpressure
+enforcement, checkpoint/restore supervision, and serve-track
+observability.  See serve/server.py for the architecture notes.
+"""
+
+from wasmedge_tpu.serve.queue import (  # noqa: F401
+    DeadlineExceeded,
+    FairQueue,
+    QueueSaturated,
+    ServeFuture,
+    ServeRequest,
+)
+from wasmedge_tpu.serve.recycle import LaneRecycler  # noqa: F401
+from wasmedge_tpu.serve.server import BatchServer  # noqa: F401

@@ -1,0 +1,81 @@
+"""steps_per_launch auto-tuning from the hostcall drain-latency histograms.
+
+ROADMAP r8 open item: the tier-1 drain histograms (obs/recorder.py,
+fed by host/wasi/vectorized.py) record how expensive each serve round's
+host-side WASI work actually is — exactly the signal needed to pick the
+launch chunk size.  `steps_per_launch` trades hostcall service latency
+(parked lanes wait out the rest of the chunk before the drain runs)
+against launch amortization (each serve round costs at least one device
+round trip):
+
+  - drains EXPENSIVE relative to the device launch  -> grow the chunk
+    (amortize the serve overhead over more device work)
+  - drains CHEAP while lanes are parking            -> shrink the chunk
+    (serve sooner; the round trip is the only cost and it's small)
+
+The rule is a conservative multiplicative feedback (double / halve,
+clamped to [autotune_min_chunk, autotune_max_chunk]) because changing
+the chunk rebuilds the engine's jitted step loop — power-of-two
+quantization bounds the number of distinct compilations.  Off by
+default (`Configure.serve.autotune`); every adjustment lands on the
+flight recorder as an "autotune" instant with the inputs that drove it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# hysteresis thresholds: drain seconds per launch second
+GROW_RATIO = 0.5     # drains cost >= half a launch -> amortize more
+SHRINK_RATIO = 0.05  # drains cost < 5% of a launch -> serve sooner
+
+
+class ChunkAutotuner:
+    """Per-server feedback loop; call observe() once per serving round."""
+
+    def __init__(self, engine, serve_cfg, recorder):
+        self.engine = engine
+        self.k = serve_cfg
+        self.obs = recorder
+        self._prev_count = 0
+        self._prev_sum = 0.0
+        self.adjustments = 0
+
+    def _drain_delta(self):
+        """(new observations, new drain seconds) since the last call,
+        summed over every hostcall kind's histogram."""
+        hists = getattr(self.obs, "hostcalls", None) or {}
+        count = sum(h.count for h in hists.values())
+        sum_s = sum(h.sum_s for h in hists.values())
+        d_count = count - self._prev_count
+        d_sum = sum_s - self._prev_sum
+        self._prev_count, self._prev_sum = count, sum_s
+        return d_count, d_sum
+
+    def observe(self, launch_s: float, parked_lanes: int) -> Optional[int]:
+        """One serving round's feedback: `launch_s` is the round's wall
+        time in the engine (launch + serves), `parked_lanes` how many
+        lanes hit the outcall channel.  Returns the new chunk when an
+        adjustment was applied, else None."""
+        d_count, d_sum = self._drain_delta()
+        cfg = self.engine.cfg
+        chunk = int(cfg.steps_per_launch)
+        new = chunk
+        if d_count > 0 and launch_s > 0:
+            ratio = d_sum / launch_s
+            if ratio >= GROW_RATIO:
+                new = min(chunk * 2, int(self.k.autotune_max_chunk))
+            elif ratio < SHRINK_RATIO and parked_lanes > 0:
+                new = max(chunk // 2, int(self.k.autotune_min_chunk))
+        if new == chunk:
+            return None
+        cfg.steps_per_launch = new
+        # the chunk is baked into the jitted step loop; force a rebuild
+        self.engine._run_chunk = None
+        self.engine._step = None
+        self.adjustments += 1
+        self.obs.instant(
+            "autotune", cat="serve", track="serve", old_chunk=chunk,
+            new_chunk=new, drain_s=round(d_sum, 6), drains=d_count,
+            launch_s=round(launch_s, 6), parked=int(parked_lanes))
+        return new

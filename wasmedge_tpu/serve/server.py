@@ -1,0 +1,846 @@
+"""BatchServer: the continuous-batching execution service.
+
+The ROADMAP north star serves heavy traffic from millions of users, yet
+every pre-r9 entry point (`VM.execute_batch`, `run_mixed`, the CLI)
+executes one pre-packed cohort and drains it to completion — a short
+request admitted behind fib(30) waits for the whole batch while freed
+lanes sit parked.  `BatchServer` turns the drain-to-empty batch runner
+into a long-lived service:
+
+  submit(func, args, tenant=, deadline_s=) -> ServeFuture
+      bounded queue (QueueSaturated backpressure), per-tenant
+      weighted-fair admission with in-flight quotas (serve/queue.py)
+
+  serving loop (step / run_until_idle / start)
+      each round runs ONE steps_per_launch slice of the SIMT engine
+      (`run_from_state`, hostcalls served between chunks as always),
+      then harvests every lane that retired, resolves its future, and
+      RE-INITIALIZES the freed lanes in place with queued requests
+      (serve/recycle.py — the `initial_state` column seam) instead of
+      waiting for batch drain.  Suspendable instances make this sound:
+      a BatchState lane is exactly the "continuation" the effect-
+      handlers line of work reifies, and recycling it is a column set.
+      Results are bit-identical to a solo `execute_batch` run for
+      lane-placement-independent guests; tier-0 random_get keys its
+      stream on the physical lane index, so a random-drawing guest's
+      output depends on which lane freed — same as any batch placement.
+
+  supervision
+      a serving state checkpoints/restores like any batch
+      (batch/checkpoint.py; the lane->request binding journal rides the
+      checkpoint's invocation metadata).  Launch/serve failures restore
+      the newest good snapshot with backoff; requests admitted after
+      that snapshot are re-queued at the front, so in-flight requests
+      survive a crash — across processes too (`resume=True` adopts the
+      lineage and returns fresh futures for the adopted requests).
+
+  observability
+      queue-depth / live-occupancy counter tracks, an admission-latency
+      histogram, and one span per request on the "serve" track land on
+      the shared flight recorder (obs/); `Configure.serve.autotune`
+      additionally drives steps_per_launch from the drain-latency
+      histograms (serve/autotune.py).
+"""
+
+from __future__ import annotations
+
+import copy
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from wasmedge_tpu.common.errors import EngineFailure, ErrCode, WasmError
+from wasmedge_tpu.common.statistics import FailureRecord, record_failure
+from wasmedge_tpu.batch.image import TRAP_DONE
+from wasmedge_tpu.serve.queue import (
+    DeadlineExceeded,
+    FairQueue,
+    QueueSaturated,
+    ServeFuture,
+    ServeRequest,
+)
+from wasmedge_tpu.serve.recycle import LaneRecycler
+
+
+class BatchServer:
+    """Continuous-batching server over one instantiated module.
+
+    `weights` / `quotas` map tenant name -> DRR weight / max in-flight
+    lanes (serve/queue.py).  `faults` is an optional
+    testing.faults.FaultInjector armed on the engine's deterministic
+    launch/serve/checkpoint seams.  `resume=True` adopts an existing
+    `checkpoint_dir` lineage: the serving state and its in-flight
+    requests come back under fresh futures (`server.adopted`)."""
+
+    def __init__(self, inst, store=None, conf=None, lanes: Optional[int] = None,
+                 stats=None, weights=None, quotas=None, faults=None,
+                 checkpoint_dir: Optional[str] = None,
+                 resume: bool = False):
+        from wasmedge_tpu.common.configure import Configure
+        from wasmedge_tpu.batch.engine import BatchEngine
+        from wasmedge_tpu.obs.recorder import recorder_of
+
+        # the server owns its knobs (autotune mutates steps_per_launch);
+        # the shared flight recorder's identity survives the deepcopy
+        self.conf = copy.deepcopy(conf) if conf is not None else Configure()
+        self.k = self.conf.serve
+        if self.k.autotune:
+            # the tuner feeds on the tier-1 drain-latency histograms;
+            # with the recorder off it would silently never fire (the
+            # CLI forces the same pairing)
+            self.conf.obs.enabled = True
+        self.engine = BatchEngine(inst, store=store, conf=self.conf,
+                                  lanes=lanes)
+        self.lanes = self.engine.lanes
+        self.obs = recorder_of(self.conf)
+        self.stats = stats
+        self.faults = faults
+        self.queue = FairQueue(self.k.queue_capacity, weights=weights,
+                               quotas=quotas)
+        self.recycler = LaneRecycler(self.engine)
+        self.checkpoint_dir = checkpoint_dir or self.k.checkpoint_dir
+        self.state = None
+        self.total = 0
+        self._bindings: Dict[int, ServeRequest] = {}
+        self._kills: Dict[int, BaseException] = {}
+        self._planes = None   # host (trap, retired) mirrors, one round
+        self._stepping = False   # one driver per round (see step())
+        self._inflight = False   # a launch slice is running off-lock
+        # min-heap of free lane indices: lowest-lane-first admission
+        # stays deterministic at O(log n) per pop instead of list.pop(0)
+        # shifts under the lock (an ascending list IS a valid heap)
+        self._free: List[int] = list(range(self.lanes))
+        self._served_before = np.zeros(self.lanes, bool)
+        self._ckpts: List[tuple] = []   # (path, total, bindings snapshot)
+        # stdout cursor positions captured when self.state was current:
+        # the launch slice runs outside the lock and its end-of-slice
+        # flush advances the engine-resident cursor while self.state is
+        # still the PRE-launch state — an on-demand checkpoint() from
+        # another thread must journal this snapshot, not the live cursor,
+        # or a restore would suppress output the saved state has not
+        # produced yet (silent loss)
+        self._stdout_snap = None
+        self._consecutive = 0
+        self._pending_backoff = 0.0
+        self.retries = 0
+        self.failures: List[FailureRecord] = []
+        self.failed: Optional[BaseException] = None
+        self._draining = False
+        self._lock = threading.RLock()
+        self._wake = threading.Condition(self._lock)
+        self._thread = None
+        self._stop = False
+        self.counters = {
+            "submitted": 0, "admitted": 0, "completed": 0, "trapped": 0,
+            "rejected": 0, "expired": 0, "killed": 0, "recycled_lanes": 0,
+            "rounds": 0, "retired_instructions": 0,
+        }
+        self.adopted: Dict[int, ServeFuture] = {}
+        if resume:
+            self._adopt_lineage()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, func_name: str, args=(),
+               tenant: str = "default",
+               deadline_s: Optional[float] = None) -> ServeFuture:
+        """Queue one request; returns its future.  Raises QueueSaturated
+        when the bounded queue is full, KeyError for an unknown export,
+        and the server's terminal error once it has failed."""
+        with self._lock:
+            if self.failed is not None:
+                raise self.failed
+            if self._draining:
+                raise WasmError(ErrCode.Terminated,
+                                "server is draining; submissions closed")
+            # a tenant configured out of admission (quota<=0 / weight<=0)
+            # can never be installed: reject now, never strand a future.
+            # NOT QueueSaturated — that signals "try later", and a
+            # retry-on-backpressure caller (the CLI's idiom) would
+            # livelock retrying a permanent condition
+            quota = self.queue.quotas.get(tenant)
+            if (quota is not None and quota <= 0) \
+                    or self.queue.weights.get(tenant, 1.0) <= 0:
+                raise WasmError(
+                    ErrCode.Terminated,
+                    f"tenant {tenant!r} has no admission capacity "
+                    f"(quota/weight <= 0)")
+            self.recycler.func_idx(func_name)  # validate the export now
+            now = time.monotonic()
+            req = ServeRequest(
+                func_name, tuple(int(a) for a in args), tenant=tenant,
+                deadline=(now + float(deadline_s))
+                if deadline_s is not None else None,
+                t_submit=now)
+            self.queue.push(req)   # raises QueueSaturated on backpressure
+            self.counters["submitted"] += 1
+            self.obs.counter("serve_queue_depth", len(self.queue),
+                             track="serve")
+            self._wake.notify_all()
+            return req.future
+
+    # -- serving loop ------------------------------------------------------
+    @property
+    def in_flight(self) -> int:
+        return len(self._bindings)
+
+    def _flight_by_tenant(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for req in self._bindings.values():
+            out[req.tenant] = out.get(req.tenant, 0) + 1
+        return out
+
+    def step(self) -> bool:
+        """One serving round: expire, admit, run one launch slice,
+        enforce deadlines/budgets, harvest, checkpoint, autotune.
+        Returns True while queued or in-flight work remains."""
+        with self._lock:
+            if self.failed is not None:
+                return False
+            if self._stepping:
+                # another driver is mid-round (e.g. a manual step()
+                # racing the start() thread): launching again from the
+                # same state would double-run the slice and clobber the
+                # first driver's harvest — wait for the round to end
+                # (so a run_until_idle() polling alongside start()
+                # parks instead of busy-spinning) and report status
+                self._wake.wait(timeout=0.05)
+                return bool(self._bindings or len(self.queue))
+            self._stepping = True
+        try:
+            return self._step_body()
+        finally:
+            # only the thread that RAN the round consumes the recovery
+            # backoff its _recover() may have set — a caller that
+            # bounced off the _stepping guard returns above and can
+            # neither steal the nap nor zero it.  The sleep itself
+            # stays OUTSIDE the lock: submit()/shutdown() from other
+            # threads must not block on it.
+            with self._lock:
+                self._stepping = False
+                self._inflight = False   # safety: never strand a waiter
+                self._wake.notify_all()
+                nap, self._pending_backoff = self._pending_backoff, 0.0
+            if nap > 0:
+                time.sleep(nap)
+
+    def _step_body(self) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self._expire_queued(now)
+            admitted = self._admit(now)
+            run_from = (self.state, self.total) if self._bindings else None
+            self._snap_stdout()   # pre-launch pairing for checkpoint()
+            self._inflight = run_from is not None
+        # the device launch slice runs OUTSIDE the lock — submit()/
+        # shutdown() from other threads must not block for a whole
+        # round's wall time.  Safe because only the serving thread
+        # reassigns state/total/bindings; concurrent submitters touch
+        # the queue, which every path still guards with the lock.
+        launched = launch_err = None
+        t_launch = 0.0
+        stats0 = None
+        if run_from is not None:
+            eng = self.engine
+            chunk = max(int(eng.cfg.steps_per_launch), 1)
+            stats0 = dict(eng.hostcall_stats)
+            t0 = time.monotonic()
+            try:
+                if self.faults is not None:
+                    eng._fault_hook = self.faults.fire
+                launched = eng.run_from_state(run_from[0], run_from[1],
+                                              run_from[1] + chunk)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                launch_err = e
+            finally:
+                eng._fault_hook = None
+            t_launch = time.monotonic() - t0
+        with self._lock:
+            self._inflight = False
+            self._wake.notify_all()   # unblock a waiting checkpoint()
+            if self.failed is not None:
+                return False
+            progressed = False
+            if run_from is not None:
+                progressed = True
+                if launch_err is not None:
+                    self._recover(launch_err)
+                else:
+                    self._consecutive = 0
+                    self.state, self.total = launched
+                    self._snap_stdout()   # cursor consistent again
+                    if self.k.autotune:
+                        self._autotune_observe(t_launch, stats0)
+                now = time.monotonic()
+                self._enforce(now)
+            self.counters["rounds"] += 1
+            harvested = self._harvest()
+            self.obs.counter("serve_live_lanes", len(self._bindings),
+                             track="serve")
+            self.obs.counter("serve_queue_depth", len(self.queue),
+                             track="serve")
+            self._maybe_checkpoint()
+            if not (admitted or progressed or harvested) \
+                    and not self._bindings and len(self.queue):
+                # possibly stalled — but a submit() racing the launch
+                # window lands in the queue AFTER this round's admit
+                # phase; re-try admission before declaring a stall so a
+                # perfectly admissible late arrival is installed (it
+                # runs next round) instead of swept
+                if self._admit(time.monotonic()):
+                    return True
+                # genuinely stalled: everything queued is admission-
+                # blocked with no in-flight work to unblock it — nothing
+                # will ever move, so reject rather than strand the
+                # futures.  NOT QueueSaturated (that means "try later");
+                # this is the same permanent condition submit() rejects
+                # with a non-backpressure error
+                for req in self.queue.pop_all():
+                    self.counters["rejected"] += 1
+                    req.future._reject(WasmError(
+                        ErrCode.Terminated,
+                        f"request {req.id} can never be admitted "
+                        f"(tenant {req.tenant!r} admission-blocked)"))
+                return False
+            return bool(self._bindings or len(self.queue))
+
+    def run_until_idle(self, max_rounds: Optional[int] = None) -> int:
+        """Drive step() until no work remains; returns rounds executed."""
+        rounds = 0
+        while self.step():
+            rounds += 1
+            if max_rounds is not None and rounds >= max_rounds:
+                break
+        return rounds
+
+    # -- background drive --------------------------------------------------
+    def start(self):
+        """Run the serving loop on a background thread until shutdown."""
+        with self._lock:
+            t = self._thread
+            if t is not None and t.is_alive() and not self._stop:
+                return self
+        if t is not None:
+            # a stopped/stopping driver exits at its round boundary —
+            # reap it (off-lock: it needs the lock to finish) so two
+            # drivers can never race the same state
+            t.join()
+        with self._lock:
+            if self._thread is t:
+                self._thread = None
+            if self._thread is not None:   # lost a race to another start()
+                return self
+            self._stop = False
+            self._thread = threading.Thread(target=self._drive,
+                                            name="wasmedge-serve",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def _drive(self):
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                has_work = bool(self._bindings or len(self.queue))
+                if not has_work:
+                    self._wake.wait(timeout=0.05)
+                    if self._stop:
+                        return
+                    # still nothing after the wait: don't burn an idle
+                    # round (rounds counter, no-op checkpoint checks)
+                    if not (self._bindings or len(self.queue)):
+                        continue
+            try:
+                self.step()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # terminal failure already recorded
+                with self._lock:
+                    if self.failed is None:
+                        self._fail(e)
+                return
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful drain: stop admitting new submissions, serve what is
+        queued and in flight to completion.  Returns True when idle."""
+        with self._lock:
+            self._draining = True
+            self._wake.notify_all()
+            threaded = self._thread is not None
+        deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        if threaded:
+            while True:
+                with self._lock:
+                    idle = not (self._bindings or len(self.queue)) \
+                        or self.failed is not None
+                if idle:
+                    return True
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
+                time.sleep(0.01)
+        while self.step():
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+        return not (self._bindings or len(self.queue))
+
+    def shutdown(self, drain: bool = True,
+                 timeout_s: Optional[float] = None):
+        """Stop the server.  With drain=True queued + in-flight work is
+        served first; without, unfinished futures are rejected."""
+        if drain:
+            self.drain(timeout_s=timeout_s)
+        with self._lock:
+            self._stop = True
+            self._draining = True
+            self._wake.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            if t.is_alive():
+                # a long round (first-install compile, big slice) is
+                # still in flight: _stop is set, so the thread exits at
+                # the round boundary — keep its handle so a subsequent
+                # start() cannot spawn a second driver alongside it
+                pass
+            else:
+                self._thread = None
+        with self._lock:
+            err = WasmError(ErrCode.Terminated, "server shut down")
+            for req in list(self._bindings.values()):
+                if not req.future.done:
+                    self.counters["killed"] += 1   # terminated in flight
+                req.future._reject(err)
+            self._bindings.clear()
+            self._free = sorted(set(range(self.lanes)))
+            for req in self.queue.pop_all():
+                self.counters["rejected"] += 1
+                req.future._reject(err)
+
+    # -- round phases ------------------------------------------------------
+    def _expire_queued(self, now: float):
+        for req in self.queue.expire(now):
+            self.counters["expired"] += 1
+            req.future._reject(DeadlineExceeded(
+                f"request {req.id} expired before admission"))
+
+    def _admit(self, now: float) -> int:
+        if not self._free or not len(self.queue):
+            return 0
+        picks = self.queue.pop(len(self._free), self._flight_by_tenant())
+        if not picks:
+            return 0
+        if self.state is None:
+            fidx0 = self.recycler.func_idx(picks[0].func_name)
+            self.state = self.recycler.idle_state(fidx0)
+        # group by function so each install is one column-set pass
+        by_func: Dict[int, List[ServeRequest]] = {}
+        for req in picks:
+            by_func.setdefault(self.recycler.func_idx(req.func_name),
+                               []).append(req)
+        for fidx, reqs in by_func.items():
+            lanes = [heapq.heappop(self._free) for _ in reqs]
+            nargs = max((len(r.args) for r in reqs), default=0)
+            args_rows = [[(r.args[i] if i < len(r.args) else 0)
+                          for r in reqs] for i in range(nargs)]
+            self.state = self.recycler.install(self.state, lanes, fidx,
+                                               args_rows)
+            for lane, req in zip(lanes, reqs):
+                self._bindings[lane] = req
+                if self._served_before[lane]:
+                    self.counters["recycled_lanes"] += 1
+                self._served_before[lane] = True
+                self.obs.observe_admission(now - req.t_submit)
+                self.obs.instant("admit", cat="serve", track="serve",
+                                 id=req.id, tenant=req.tenant, lane=lane)
+        self.counters["admitted"] += len(picks)
+        return len(picks)
+
+    def _autotune_observe(self, t_launch: float, stats0: dict):
+        """Feed the slice's wall time + tier-1 drain volume to the
+        steps_per_launch tuner (Configure.serve.autotune)."""
+        tuner = getattr(self, "_tuner", None)
+        if tuner is None:
+            from wasmedge_tpu.serve.autotune import ChunkAutotuner
+
+            tuner = self._tuner = ChunkAutotuner(self.engine, self.k,
+                                                 self.obs)
+        parked = self.engine.hostcall_stats["tier1_calls"] \
+            - stats0.get("tier1_calls", 0)
+        tuner.observe(t_launch, parked)
+
+    def _enforce(self, now: float):
+        """Deadline + per-request step-budget enforcement on in-flight
+        lanes: over-budget lanes are terminated in the state plane and
+        their futures rejected at harvest."""
+        if not self._bindings:
+            return
+        trap = np.asarray(self.state.trap).copy()
+        retired = np.asarray(self.state.retired, np.int64)
+        cap = int(self.k.max_steps_per_request)
+        kill_lanes, kill_codes = [], []
+        for lane, req in self._bindings.items():
+            if trap[lane] != 0:
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                kill_lanes.append(lane)
+                kill_codes.append(int(ErrCode.Terminated))
+                self._kills[lane] = DeadlineExceeded(
+                    f"request {req.id} exceeded its deadline in flight")
+            elif retired[lane] >= cap:
+                kill_lanes.append(lane)
+                kill_codes.append(int(ErrCode.CostLimitExceeded))
+                self._kills[lane] = WasmError(
+                    ErrCode.CostLimitExceeded,
+                    f"request {req.id} exceeded max_steps_per_request")
+        if kill_lanes:
+            import jax.numpy as jnp
+
+            # "killed" is counted at harvest under the first-completion
+            # guard — a restore can replay a kill, and the replayed
+            # request must not count twice
+            self.state = self.state._replace(
+                trap=self.state.trap.at[jnp.asarray(
+                    np.asarray(kill_lanes, np.int64))].set(
+                    jnp.asarray(np.asarray(kill_codes, np.int32))))
+            trap[np.asarray(kill_lanes, np.int64)] = kill_codes
+        # hand the host mirrors (kills applied) to _harvest: the planes
+        # are unchanged until the next launch, so the harvest phase must
+        # not pay a second device->host sync for them
+        self._planes = (trap, retired)
+
+    def _harvest(self) -> int:
+        """Resolve futures of every bound lane that stopped; park and
+        free the lanes (the recycling half of continuous batching)."""
+        planes, self._planes = self._planes, None
+        if not self._bindings or self.state is None:
+            return 0
+        if planes is not None:
+            trap, retired = planes
+        else:  # defensive: a harvest not preceded by _enforce this round
+            trap = np.asarray(self.state.trap)
+            retired = np.asarray(self.state.retired, np.int64)
+        done = [lane for lane in self._bindings if trap[lane] != 0]
+        if not done:
+            return 0
+        by_func: Dict[int, List[int]] = {}
+        for lane in done:
+            by_func.setdefault(
+                self.recycler.func_idx(self._bindings[lane].func_name),
+                []).append(lane)
+        for fidx, lanes in by_func.items():
+            cells = self.recycler.harvest_cells(self.state, lanes, fidx)
+            for col, lane in enumerate(lanes):
+                req = self._bindings.pop(lane)
+                code = int(trap[lane])
+                # a crash-restore replay can re-complete an already
+                # resolved request (future resolution is first-wins);
+                # count and trace only the first completion
+                first = not req.future.done
+                if code == int(TRAP_DONE):
+                    req.future._resolve(
+                        [int(cells[r, col]) for r in range(cells.shape[0])])
+                    if first:
+                        self.counters["completed"] += 1
+                else:
+                    exc = self._kills.pop(lane, None)
+                    if exc is None:
+                        # a genuine guest trap
+                        if first:
+                            self.counters["trapped"] += 1
+                        exc = WasmError(ErrCode(code)
+                                        if code in ErrCode._value2member_map_
+                                        else ErrCode.ExecutionFailed)
+                    elif first:
+                        self.counters["killed"] += 1
+                    req.future._reject(exc)
+                if first:
+                    # install() resets the lane's retired plane, so this
+                    # is the REQUEST's retired count (true-utilization
+                    # occupancy: retired / (total steps * lanes))
+                    self.counters["retired_instructions"] += \
+                        int(retired[lane])
+                    self.obs.span(f"request/{req.tenant}", req.t_submit,
+                                  cat="serve", track="serve", id=req.id,
+                                  func=req.func_name, trap=code,
+                                  retired=int(retired[lane]))
+        self.state = self.recycler.park(self.state, done)
+        for lane in done:
+            heapq.heappush(self._free, lane)
+        return len(done)
+
+    # -- supervision -------------------------------------------------------
+    def _snap_stdout(self):
+        """Capture the stdout cursor positions consistent with the
+        CURRENT self.state (called under the lock at every point the
+        state/cursor pairing is known-consistent; see _stdout_snap)."""
+        cur = getattr(self.engine, "_stdout_cursor", None)
+        self._stdout_snap = np.zeros(self.lanes, np.int64) \
+            if cur is None else cur[0].copy()
+
+    def _record(self, fault_class: str, exc, checkpoint=None):
+        rec = FailureRecord(
+            fault_class=fault_class,
+            error="" if exc is None else repr(exc),
+            retry=self.retries, checkpoint=checkpoint,
+            tier="serve").stamp()
+        self.failures.append(rec)
+        self.obs.failure(rec)
+        if self.stats is not None:
+            self.stats.add_failure(rec)
+        else:
+            record_failure(rec)
+
+    def _recover(self, exc: BaseException):
+        """Launch/serve failure: restore the newest good checkpoint (or
+        scratch), re-queue requests the snapshot doesn't cover, back
+        off, and keep serving — in-flight requests survive the crash."""
+        self.retries += 1
+        self._consecutive += 1
+        point = getattr(exc, "point", None) or "launch"
+        self._record("serve" if point == "serve" else "launch", exc)
+        self.obs.instant("retry", cat="serve", track="serve",
+                         retry=self.retries,
+                         consecutive=self._consecutive, point=str(point))
+        if self._consecutive > int(self.k.max_retries):
+            self._fail(EngineFailure(
+                f"serving loop failed {self._consecutive} times: {exc!r}",
+                self.failures))
+            raise self.failed
+        old_bindings = dict(self._bindings)
+        state = total = None
+        bindings: Dict[int, ServeRequest] = {}
+        from wasmedge_tpu.batch import checkpoint
+
+        while self._ckpts:
+            path, steps, snap = self._ckpts[-1]
+            try:
+                if self.faults is not None:
+                    self.faults.fire("checkpoint_load", path=path)
+                state, total = checkpoint.load(path, self.engine)
+                bindings = dict(snap)
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._record("checkpoint", e, checkpoint=path)
+                self._ckpts.pop()
+        if state is None:
+            # no surviving snapshot: restore an all-idle state and send
+            # EVERY in-flight request back to the head of the queue
+            from wasmedge_tpu.batch.hostcall import stdout_cursor_reset
+
+            if old_bindings or self.state is not None:
+                fidx0 = next(iter(
+                    self.recycler.func_idx(r.func_name)
+                    for r in old_bindings.values()), 0) \
+                    if old_bindings else 0
+                state = self.recycler.idle_state(fidx0)
+            total = 0
+            stdout_cursor_reset(self.engine)
+        # Serving-layer stdout is AT-LEAST-once across a crash restore:
+        # unlike the supervisor's fixed cohort, recovery may re-admit a
+        # re-queued request onto a DIFFERENT lane, so the per-lane
+        # high-water mark no longer describes the lane's future stream —
+        # keeping it would silently swallow a later request's first
+        # bytes (loss is worse than duplication).  Collapse it to the
+        # restored logical position; replayed post-snapshot output may
+        # duplicate, nothing is ever dropped.
+        cur = getattr(self.engine, "_stdout_cursor", None)
+        if cur is not None:
+            cur[1][:] = cur[0]
+        self.state, self.total = state, total
+        self._bindings = bindings
+        self._planes = None
+        self._snap_stdout()   # restored state + collapsed cursor pair up
+        # submission order (monotonic request id), not lane order: lanes
+        # are reassigned on admission, so lane order would invert a
+        # tenant's FIFO across the restore
+        covered = {req.id for req in bindings.values()}
+        requeue = sorted((req for req in old_bindings.values()
+                          if req.id not in covered
+                          and not req.future.done),
+                         key=lambda r: r.id)
+        self.queue.push_front(requeue)
+        self._free = sorted(set(range(self.lanes)) - set(bindings))
+        self._kills.clear()
+        # the sleep itself happens in step() AFTER the lock is released
+        # — a background-thread server must not freeze submit()/shutdown
+        # for the whole backoff window
+        from wasmedge_tpu.batch.supervisor import backoff_seconds
+
+        self._pending_backoff = backoff_seconds(self.k, self._consecutive)
+
+    def _fail(self, exc: BaseException):
+        self.failed = exc
+        # keep the counters reconcilable (submitted == completed +
+        # trapped + expired + killed + rejected) even on terminal failure
+        for req in list(self._bindings.values()):
+            if not req.future.done:
+                self.counters["killed"] += 1
+            req.future._reject(exc)
+        self._bindings.clear()
+        for req in self.queue.pop_all():
+            if not req.future.done:
+                self.counters["rejected"] += 1
+            req.future._reject(exc)
+
+    def _maybe_checkpoint(self):
+        every = self.k.checkpoint_every_rounds
+        if not every or self.state is None:
+            return
+        if self.counters["rounds"] % int(every):
+            return
+        # idle rounds don't advance total: re-snapshotting the same
+        # step count would stack duplicate paths in _ckpts and the
+        # prune pass would unlink the file it just wrote
+        if self._ckpts and self._ckpts[-1][1] == self.total:
+            return
+        self.checkpoint()
+
+    def checkpoint(self) -> Optional[str]:
+        """Snapshot the serving state + the lane->request binding
+        journal; returns the path (None when saving failed — a failed
+        snapshot never kills a healthy server).  Locked: an on-demand
+        call from another thread must see a state/journal pair from the
+        same round, or a restore could resolve the wrong request.
+
+        Blocks while a launch slice is in flight: the jitted chunk
+        donates the pre-launch state's device buffers, so reading them
+        mid-slice would hit deleted arrays — the wait bounds at one
+        round's wall time and lands on the post-launch pairing."""
+        with self._lock:
+            while self._inflight and self.failed is None:
+                self._wake.wait(timeout=0.1)
+            return self._checkpoint_locked()
+
+    def _checkpoint_locked(self) -> Optional[str]:
+        if self.state is None:
+            return None
+        import os
+        import tempfile
+
+        from wasmedge_tpu.batch import checkpoint
+
+        if self.checkpoint_dir is None:
+            self.checkpoint_dir = tempfile.mkdtemp(prefix="wasmedge-serve-")
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        path = os.path.join(self.checkpoint_dir,
+                            f"serve-{self.total:012d}.npz")
+        journal = [dict(lane=lane, **req.asdict())
+                   for lane, req in sorted(self._bindings.items())]
+        t0 = self.obs.now()
+        try:
+            if self.faults is not None:
+                self.faults.fire("checkpoint_save", path=path)
+            checkpoint.save(path, self.engine, self.state, self.total,
+                            invocation={"serve_bindings": journal},
+                            stdout_pos=self._stdout_snap)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            self._record("checkpoint", e, checkpoint=path)
+            return None
+        self.obs.span("checkpoint_save", t0, cat="serve", track="serve",
+                      checkpoint=path, steps=int(self.total),
+                      in_flight=len(self._bindings))
+        entry = (path, self.total, dict(self._bindings))
+        if self._ckpts and self._ckpts[-1][0] == path:
+            # same total -> same path: replace the lineage entry (the
+            # state/journal may still differ via admissions) instead of
+            # stacking duplicates the prune pass would unlink while
+            # surviving entries still reference the file
+            self._ckpts[-1] = entry
+        else:
+            self._ckpts.append(entry)
+        while len(self._ckpts) > max(int(self.k.keep_checkpoints), 1):
+            old, _, _ = self._ckpts.pop(0)
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+        return path
+
+    def _adopt_lineage(self):
+        """Cross-process resume: newest loadable serve-*.npz plus its
+        binding journal; adopted requests get fresh futures
+        (`self.adopted[id]`)."""
+        import os
+        import re
+
+        from wasmedge_tpu.batch import checkpoint
+
+        d = self.checkpoint_dir
+        if not d or not os.path.isdir(d):
+            return
+        members = []
+        for fn in sorted(os.listdir(d)):
+            m = re.fullmatch(r"serve-(\d+)\.npz", fn)
+            if m:
+                members.append((os.path.join(d, fn), int(m.group(1))))
+        members.sort(key=lambda t: t[1])
+        while members:
+            path, steps = members[-1]
+            try:
+                state, total = checkpoint.load(path, self.engine)
+                journal = checkpoint.read_meta(path).get(
+                    "invocation", {}).get("serve_bindings", [])
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._record("checkpoint", e, checkpoint=path)
+                members.pop()
+                continue
+            self.state, self.total = state, total
+            self._snap_stdout()   # load() rewound the cursor in place
+            from wasmedge_tpu.serve.queue import advance_request_ids
+
+            for entry in journal:
+                req = ServeRequest.from_journal(entry)
+                req.t_submit = time.monotonic()
+                self._bindings[int(entry["lane"])] = req
+                self.adopted[req.id] = req.future
+                advance_request_ids(req.id)
+            self._free = sorted(set(range(self.lanes))
+                                - set(self._bindings))
+            self._served_before[list(self._bindings)] = True
+            # the full surviving lineage joins _ckpts (like the
+            # supervisor's twin adoption): older members stay usable as
+            # _recover fallbacks, and the prune pass below keeps
+            # crash/resume cycles from accumulating serve-*.npz forever.
+            # Older journals reuse the adopted request objects by id so
+            # a fallback restore resolves the futures callers hold.
+            byid = {r.id: r for r in self._bindings.values()}
+            self._ckpts = []
+            for p2, s2 in members[:-1]:
+                try:
+                    j2 = checkpoint.read_meta(p2).get(
+                        "invocation", {}).get("serve_bindings", [])
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    self._record("checkpoint", e, checkpoint=p2)
+                    continue
+                snap2 = {}
+                for e2 in j2:
+                    req2 = byid.get(int(e2["id"]))
+                    if req2 is None:
+                        req2 = ServeRequest.from_journal(e2)
+                        advance_request_ids(req2.id)
+                    snap2[int(e2["lane"])] = req2
+                self._ckpts.append((p2, s2, snap2))
+            self._ckpts.append((path, total, dict(self._bindings)))
+            while len(self._ckpts) > max(int(self.k.keep_checkpoints), 1):
+                old, _, _ = self._ckpts.pop(0)
+                try:
+                    os.unlink(old)
+                except OSError:
+                    pass
+            self.obs.instant("resume_adopted", cat="serve", track="serve",
+                             checkpoint=path, steps=int(total),
+                             in_flight=len(self._bindings))
+            return

@@ -50,3 +50,17 @@ def emit(result: dict, default_path: str) -> None:
             f.write(json.dumps(result, indent=2, sort_keys=True) + "\n")
     except OSError:
         pass  # the artifact is a record, never a bench failure
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile over an ascending sequence (None when
+    empty): rank ceil(n*q), 1-based.  Shared by bench.py and the CLI
+    serve summary so the p50/p99 index math cannot drift between the
+    two reports."""
+    import math
+
+    if not sorted_vals:
+        return None
+    i = min(max(math.ceil(len(sorted_vals) * q) - 1, 0),
+            len(sorted_vals) - 1)
+    return sorted_vals[i]

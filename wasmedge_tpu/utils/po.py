@@ -107,7 +107,10 @@ class ArgumentParser:
         """Returns False when help was requested (caller should exit 0);
         raises ValueError on malformed input."""
         i = 0
-        npos = 0
+        # resume from positionals collected by an earlier parse() call
+        # (a command with no trailing payload re-feeds `rest` through
+        # the same parser to keep option processing going)
+        npos = len(self.positional_values)
         while i < len(argv):
             arg = argv[i]
             if npos == 0 and not self.positional_values \

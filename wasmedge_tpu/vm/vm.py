@@ -287,6 +287,29 @@ class VM:
                 if obs_flipped:
                     obs_conf.enabled = False
 
+    def serve(self, lanes: Optional[int] = None, weights=None,
+              quotas=None, checkpoint_dir: Optional[str] = None,
+              resume: bool = False):
+        """Continuous-batching serving over the instantiated module
+        (wasmedge_tpu/serve/): returns a BatchServer whose submit()
+        queues one request per call and whose serving loop recycles
+        retired device lanes with queued requests instead of draining
+        the batch.  conf.serve holds the knobs (queue capacity,
+        per-request budget, checkpoint cadence, autotune); `weights` /
+        `quotas` configure per-tenant fair admission.  `resume=True`
+        adopts an existing checkpoint_dir serving lineage — in-flight
+        requests come back under fresh futures (server.adopted)."""
+        from wasmedge_tpu.serve import BatchServer
+
+        with self._lock:
+            if self._active is None or self.stage != VMStage.Instantiated:
+                raise WasmError(ErrCode.WrongVMWorkflow, "no instantiated module")
+            inst = self._active
+        conf = batch_conf_with_gas(self.conf, self.stat)
+        return BatchServer(inst, store=self.store, conf=conf, lanes=lanes,
+                           stats=self.stat, weights=weights, quotas=quotas,
+                           checkpoint_dir=checkpoint_dir, resume=resume)
+
     def _export_obs(self, rec, eng=None, trace_out=None,
                     metrics_out=None):
         """Fold recorder aggregates into this VM's Statistics and write
