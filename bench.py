@@ -185,6 +185,71 @@ def faults_smoke() -> int:
     return 0 if ok else 1
 
 
+def mesh_faults_smoke() -> int:
+    """`bench.py --mesh-faults-smoke`: run the echo workload across 4
+    fake CPU devices under one injected device fault and assert the
+    mesh supervisor recovers — the CI guard that mesh-level fault
+    tolerance (parallel/supervisor.py) stays wired end-to-end,
+    mirroring --faults-smoke / --serve-smoke.  Prints ONE JSON line;
+    emits no benchmark artifact (this mode measures recovery, not
+    throughput)."""
+    import os
+    import tempfile
+
+    # the fake multi-device mesh must exist before the first jax import
+    # (same mechanism as tests/conftest.py)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.parallel.supervisor import MeshSupervisor
+    from wasmedge_tpu.testing.faults import Fault, FaultInjector
+
+    lanes, iters = 64, 2
+    conf = Configure()
+    conf.supervisor.checkpoint_every_steps = 200
+    conf.supervisor.backoff_base_s = 0.0
+    eng, sink = _smoke_echo_engine(conf, lanes)
+    devices = jax.devices()[:4]
+    inj = FaultInjector([Fault(point="device_launch", at=0,
+                               match={"device": 1})])
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="mesh-faults-smoke-") as d:
+        sup = MeshSupervisor(eng.inst, store=eng.store, conf=conf,
+                             devices=devices, faults=inj,
+                             checkpoint_dir=d)
+        res = sup.run("echo", [np.full(lanes, iters, np.int64)],
+                      max_steps=1_000_000)
+    dt = time.perf_counter() - t0
+    os.close(sink)
+    # the injected device incident must be visible in the flight
+    # recorder's event stream (mirrored FailureRecord instant)
+    trace_has_incident = \
+        "failure/device_launch" in sup.obs.event_names()
+    ok = bool(res.completed.all()) and inj.fired == 1 \
+        and any(f.fault_class == "device_launch" for f in sup.failures) \
+        and trace_has_incident and len(devices) == 4
+    print(json.dumps({
+        "metric": "mesh_faults_smoke_echo_recovery",
+        "value": 1 if ok else 0,
+        "unit": "recovered",
+        "ok": ok,
+        "devices": len(devices),
+        "injected": inj.fired,
+        "failures": [f.fault_class for f in sup.failures],
+        "trace_has_incident": trace_has_incident,
+        "lanes": lanes,
+        "wall_s": round(dt, 3),
+    }))
+    return 0 if ok else 1
+
+
 def trace_smoke() -> int:
     """`bench.py --trace-smoke`: run echo x64 with the flight recorder
     on and validate the emitted Chrome trace_event JSON against the
@@ -446,6 +511,8 @@ def _fib(n):
 if __name__ == "__main__":
     if "--faults-smoke" in sys.argv[1:]:
         sys.exit(faults_smoke())
+    if "--mesh-faults-smoke" in sys.argv[1:]:
+        sys.exit(mesh_faults_smoke())
     if "--trace-smoke" in sys.argv[1:]:
         sys.exit(trace_smoke())
     if "--serve-smoke" in sys.argv[1:]:

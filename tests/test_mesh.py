@@ -165,6 +165,71 @@ def test_pallas_sharded_over_virtual_devices():
             np.asarray([fib[int(n)] for n in ns])).all()
 
 
+def test_pallas_sharded_1000_lanes_8_devices():
+    """ISSUE 5 padding satellite, at scale: 1000 lanes across 8 fake
+    devices through the unsupervised pallas drive, merged lane-ordered."""
+    import jax
+
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.parallel.mesh import run_pallas_sharded
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    conf = Configure()
+    conf.batch.value_stack_depth = 128
+    conf.batch.call_stack_depth = 64
+    conf.batch.steps_per_launch = 20_000
+    conf.batch.interpret = True
+    mod = Validator(conf).validate(Loader(conf).parse_module(build_fib()))
+    store = StoreManager()
+    inst = Executor(conf).instantiate(store, mod)
+    lanes = 1000
+    ns = (np.arange(lanes, dtype=np.int64) % 5) + 6
+    res = run_pallas_sharded(inst, store, conf, "fib", [ns],
+                             devices=jax.devices()[:8],
+                             max_steps=2_000_000, interpret=True)
+    assert res.trap.shape == (lanes,)
+    assert res.results[0].shape == (lanes,)
+    assert (res.trap == -1).all()
+    assert (np.asarray(res.results[0]) ==
+            np.asarray([_fib(int(n)) for n in ns])).all()
+
+
+def test_pallas_sharded_pads_uneven_lanes():
+    """30 lanes on 8 devices: the old `lanes % n_devices` hard error is
+    lifted — the drive splits lanes into contiguous near-equal ranges
+    (4x4 + 4x3 here) and merges them back in original lane order."""
+    import jax
+
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.parallel.mesh import run_pallas_sharded
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    conf = Configure()
+    conf.batch.value_stack_depth = 64
+    conf.batch.call_stack_depth = 32
+    conf.batch.steps_per_launch = 5_000
+    conf.batch.interpret = True
+    mod = Validator(conf).validate(Loader(conf).parse_module(build_fib()))
+    store = StoreManager()
+    inst = Executor(conf).instantiate(store, mod)
+    lanes = 30
+    ns = (np.arange(lanes, dtype=np.int64) % 5) + 5
+    res = run_pallas_sharded(inst, store, conf, "fib", [ns],
+                             devices=jax.devices()[:8],
+                             max_steps=500_000, interpret=True)
+    assert res.trap.shape == (lanes,)
+    assert res.results[0].shape == (lanes,)
+    assert (res.trap == -1).all()
+    assert (np.asarray(res.results[0]) ==
+            np.asarray([_fib(int(n)) for n in ns])).all()
+
+
 def test_sharded_drive_overlaps_devices(monkeypatch):
     """The threaded sharded drive must actually interleave devices: with
     8 schedulers, kernel launches from different devices must overlap in

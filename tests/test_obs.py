@@ -366,7 +366,7 @@ def test_reused_supervisor_second_run_starts_fresh(tmp_path):
                           checkpoint_dir=str(tmp_path))
     r1 = sup.run("fib", [np.full(LANES, 15, np.int64)],
                  max_steps=500_000)
-    assert r1.completed.all() and sup._ckpts  # lineage left behind
+    assert r1.completed.all() and sup._lineage  # lineage left behind
     r2 = sup.run("fib", [np.full(LANES, 6, np.int64)],
                  max_steps=500_000)
     assert r2.completed.all()

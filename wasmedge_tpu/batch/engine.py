@@ -1927,10 +1927,18 @@ class BatchEngine:
         # arms this before a launch / a tier-1 serve so injected device
         # and host failures raise exactly where real ones would
         fault = getattr(self, "_fault_hook", None)
+        # cooperative cancellation (parallel/supervisor.py): when a mesh
+        # run is doomed, sibling devices stop at their next launch
+        # boundary instead of driving the slice to completion
+        cancel = getattr(self, "_cancel_hook", None)
+        # per-device trace attribution for mesh drives (else "simt")
+        track = getattr(self, "obs_track", "simt")
         obs = self.obs
         if obs.enabled:
             prev_ret = int(np.asarray(state.retired, np.int64).sum())
         while total < max_steps:
+            if cancel is not None and cancel():
+                break
             # per-relaunch time base: host->device only, no round trip
             # (rides the launch as a non-donated argument)
             tt = jnp.asarray(t0_time_planes() if t0_active else dummy_time)
@@ -1946,7 +1954,7 @@ class BatchEngine:
                 # (one extra device read per LAUNCH, never per step)
                 live = int((trap_host == 0).sum())
                 ret = int(np.asarray(state.retired, np.int64).sum())
-                obs.span("launch", t_launch, cat="engine", track="simt",
+                obs.span("launch", t_launch, cat="engine", track=track,
                          steps=int(done_steps), live_lanes=live,
                          parked_lanes=parked,
                          retired_delta=ret - prev_ret)
@@ -1958,7 +1966,7 @@ class BatchEngine:
                     fault("serve", total=total)
                 t_serve = obs.now()
                 state = serve_batch_state(self, state)
-                obs.span("serve", t_serve, cat="engine", track="simt",
+                obs.span("serve", t_serve, cat="engine", track=track,
                          lanes=parked)
                 continue
             if not (trap_host == 0).any():
@@ -1972,7 +1980,7 @@ class BatchEngine:
         if (np.asarray(state.trap) == TRAP_HOSTCALL).any():
             t_serve = obs.now()
             state = serve_batch_state(self, state)
-            obs.span("serve", t_serve, cat="engine", track="simt")
+            obs.span("serve", t_serve, cat="engine", track=track)
         state = flush_stdout_buffers(self, state)
         state = self._fold_op_hist(state)
         if t0_active:

@@ -428,7 +428,13 @@ class BlockScheduler:
         block B keeps executing on the device.  Re-arms are column
         updates into the live state (no kernel rebuild/relaunch cost);
         the re-armed blocks run from the following launch."""
+        # cooperative mesh cancellation (parallel/supervisor.py): a
+        # doomed sharded run stops sibling schedulers at their next
+        # launch boundary instead of running to completion
+        cancel = getattr(self, "cancel_check", None)
         while True:
+            if cancel is not None and cancel():
+                return
             self.launch()
             if not self.process():
                 break

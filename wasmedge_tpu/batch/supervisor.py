@@ -58,6 +58,7 @@ import numpy as np
 
 from wasmedge_tpu.common.errors import EngineFailure, ErrCode, TrapError, WasmError
 from wasmedge_tpu.common.statistics import FailureRecord, record_failure
+from wasmedge_tpu.batch.lineage import Lineage
 
 MASK64 = (1 << 64) - 1
 
@@ -171,7 +172,7 @@ class BatchSupervisor:
         self.retries = 0
         self.checkpoint_dir = checkpoint_dir or self.k.checkpoint_dir
         self.resume = self.k.resume if resume is None else bool(resume)
-        self._ckpts: List[Tuple[str, int]] = []   # lineage: (path, steps)
+        self._lineage = Lineage()   # shared machinery (batch/lineage.py)
         self._restored_from: Optional[str] = None
         self._overlay = {}  # lane -> (result cells, trap) from scalar rung
 
@@ -197,7 +198,7 @@ class BatchSupervisor:
         # a fresh run never inherits a previous run()'s lineage (stale
         # checkpoints would restore the OLD run's state under new args);
         # only an explicit resume adopts what is on disk
-        self._ckpts = []
+        self._lineage.reset()
         self._adopted = None
         self._invocation = self._invocation_fingerprint()
         self._resumed = self.resume and self._adopt_lineage()
@@ -275,7 +276,7 @@ class BatchSupervisor:
             # verification pass, so no second deserialization here
             state, total = self._adopted
             self._adopted = None
-            self._restored_from = self._ckpts[-1][0]
+            self._restored_from = self._lineage.newest().path
         else:
             # a fresh (non-resumed) run starts a fresh output stream
             from wasmedge_tpu.batch.hostcall import stdout_cursor_reset
@@ -376,67 +377,52 @@ class BatchSupervisor:
                 h.update(np.ascontiguousarray(a).tobytes())
         return {"func": func, "args_sha256": h.hexdigest()}
 
-    def _adopt_lineage(self) -> bool:
-        """Cross-process resume (ROADMAP open item): adopt an existing
-        checkpoint_dir lineage written by a previous process.  Scans for
-        ckpt-<steps>.npz members, verifies the newest loads cleanly
-        against THIS engine (image hash + geometry binding is
-        checkpoint.load's job), records corrupt/mismatched members as
-        FailureRecord(fault_class="checkpoint") and drops them, then
-        installs the surviving lineage so the SIMT tier starts from the
-        newest good member.  Returns True when a good member exists."""
-        import re
-
+    def _load_member(self, m):
+        """Load one lineage member against THIS engine: fault seam,
+        invocation binding (a snapshot of a different call — other
+        export / other args — must be refused, not silently continued
+        and reported as THIS run's answer; pre-invocation-stamp
+        checkpoints carry no record and are accepted for back
+        compatibility), then checkpoint.load (image hash + geometry
+        binding is its job)."""
         from wasmedge_tpu.batch import checkpoint
 
-        d = self.checkpoint_dir
-        if not d or not os.path.isdir(d):
-            return False
-        members = []
-        for fn in sorted(os.listdir(d)):
-            m = re.fullmatch(r"ckpt-(\d+)\.npz", fn)
-            if m:
-                members.append((os.path.join(d, fn), int(m.group(1))))
-        members.sort(key=lambda t: t[1])
-        # verify the newest member NOW so the run never starts from a
-        # snapshot that will refuse to load mid-recovery; older members
-        # stay lazily verified by _restore's fallback walk.  The loaded
-        # state is kept for _run_simt_tier (one deserialization, and
-        # the checkpoint_load fault seam fires once per member).
-        self._adopted = None
-        while members:
-            path, steps = members[-1]
-            try:
-                if self.faults is not None:
-                    self.faults.fire("checkpoint_load", path=path)
-                # invocation binding: a snapshot of a different call
-                # (other export / other args) must be refused, not
-                # silently continued and reported as THIS run's answer.
-                # Pre-invocation-stamp checkpoints carry no record and
-                # are accepted for back compatibility.
-                inv = checkpoint.read_meta(path).get("invocation")
-                if inv is not None and inv != self._invocation:
-                    raise ValueError(
-                        f"checkpoint invocation mismatch: snapshot is "
-                        f"{inv}, this run is {self._invocation}")
-                t_load = self.obs.now()
-                self._adopted = checkpoint.load(path, self.engine)
-                self.obs.span("checkpoint_load", t_load,
-                              cat="supervisor", track="supervisor",
-                              checkpoint=path,
-                              steps=int(self._adopted[1]))
-                break
-            except (KeyboardInterrupt, SystemExit):
-                raise
-            except Exception as e:
-                self._record("checkpoint", e, checkpoint=path)
-                members.pop()
-        self._ckpts = members
-        if members:
+        if self.faults is not None:
+            self.faults.fire("checkpoint_load", path=m.path)
+        inv = checkpoint.read_meta(m.path).get("invocation")
+        if inv is not None and inv != self._invocation:
+            raise ValueError(
+                f"checkpoint invocation mismatch: snapshot is "
+                f"{inv}, this run is {self._invocation}")
+        t_load = self.obs.now()
+        state, total = checkpoint.load(m.path, self.engine)
+        self.obs.span("checkpoint_load", t_load, cat="supervisor",
+                      track="supervisor", checkpoint=m.path,
+                      steps=int(total))
+        return state, total
+
+    def _bad_member(self, exc, m):
+        self._record("checkpoint", exc, checkpoint=m.path)
+
+    def _adopt_lineage(self) -> bool:
+        """Cross-process resume: adopt an existing checkpoint_dir
+        lineage written by a previous process (shared newest-good-member
+        walk, batch/lineage.py).  Verifies the newest member NOW so the
+        run never starts from a snapshot that will refuse to load
+        mid-recovery; older members stay lazily verified by _restore's
+        fallback walk.  The loaded state is kept for _run_simt_tier (one
+        deserialization, and the checkpoint_load fault seam fires once
+        per member).  Returns True when a good member exists."""
+        lin = self._lineage
+        lin.install(Lineage.scan(self.checkpoint_dir, r"ckpt-(\d+)\.npz"))
+        self._adopted = lin.walk_newest(self._load_member,
+                                        self._bad_member)
+        if lin:
+            newest = lin.newest()
             self.obs.instant("resume_adopted", cat="supervisor",
-                             track="supervisor", checkpoint=members[-1][0],
-                             steps=members[-1][1], lineage=len(members))
-        return bool(members)
+                             track="supervisor", checkpoint=newest.path,
+                             steps=newest.steps, lineage=len(lin))
+        return bool(lin)
 
     def _initial_state(self):
         if self._multi:
@@ -446,36 +432,19 @@ class BatchSupervisor:
     def _restore(self):
         """Newest surviving checkpoint, else the initial state.  A member
         that fails to load (corrupt/truncated/injected) is recorded and
-        dropped from the lineage — the next-older one is tried."""
-        from wasmedge_tpu.batch import checkpoint
+        dropped from the lineage — the next-older one is tried.  (Older
+        adopted members were only filename-scanned at adoption;
+        _load_member re-checks the invocation binding here so a retry
+        can never walk back into a different call's snapshot.)"""
+        def load(m):
+            state, total = self._load_member(m)
+            self._restored_from = m.path
+            self._reset_cadence(total)
+            return state, total
 
-        while self._ckpts:
-            path, steps = self._ckpts[-1]
-            try:
-                if self.faults is not None:
-                    self.faults.fire("checkpoint_load", path=path)
-                # older adopted members were only filename-scanned at
-                # adoption: re-check the invocation binding here so a
-                # retry can never walk back into a different call's
-                # snapshot (shared/mutated checkpoint_dir)
-                inv = checkpoint.read_meta(path).get("invocation")
-                if inv is not None and inv != self._invocation:
-                    raise ValueError(
-                        f"checkpoint invocation mismatch: snapshot is "
-                        f"{inv}, this run is {self._invocation}")
-                t_load = self.obs.now()
-                state, total = checkpoint.load(path, self.engine)
-                self._restored_from = path
-                self._reset_cadence(total)
-                self.obs.span("checkpoint_load", t_load, cat="supervisor",
-                              track="supervisor", checkpoint=path,
-                              steps=int(total))
-                return state, total
-            except (KeyboardInterrupt, SystemExit):
-                raise
-            except Exception as e:
-                self._record("checkpoint", e, checkpoint=path)
-                self._ckpts.pop()
+        got = self._lineage.walk_newest(load, self._bad_member)
+        if got is not None:
+            return got
         self._restored_from = None
         self._reset_cadence(0)
         # replay from scratch: rewind the logical stdout position but
@@ -548,15 +517,10 @@ class BatchSupervisor:
             # a failed snapshot must never kill a healthy run
             self._record("checkpoint", e, checkpoint=path)
             return
-        self._ckpts.append((path, total))
+        self._lineage.add(path, total)
         self._last_ckpt_total = total
         self._last_ckpt_wall = time.monotonic()
-        while len(self._ckpts) > max(int(self.k.keep_checkpoints), 1):
-            old, _ = self._ckpts.pop(0)
-            try:
-                os.unlink(old)
-            except OSError:
-                pass
+        self._lineage.prune(self.k.keep_checkpoints)
 
     # -- quarantine -------------------------------------------------------
     def _quarantine_lanes(self, state, lanes):

@@ -65,6 +65,11 @@ def _runner_parser() -> ArgumentParser:
     p.add_option(["engine"],
                  Option("execution engine: scalar|native|tpu_batch|auto",
                         "kind", default="auto"))
+    p.add_option(["devices"],
+                 Option("shard --batch lanes across N devices (mesh "
+                        "drive; with --supervised adds device "
+                        "quarantine, lane migration, and coordinated "
+                        "mesh checkpoints)", "n", typ=int))
     p.add_option(["supervised"],
                  Toggle("supervise --batch runs: auto-checkpoint, "
                         "retry-with-backoff, engine-degradation ladder"))
@@ -233,6 +238,7 @@ def run_command(argv: List[str], out=None, err=None) -> int:
                     fn_name,
                     [np.full(batch_lanes, int(a, 0), np.int64)
                      for a in fn_args], lanes=batch_lanes,
+                    devices=p._opts["devices"].value,
                     supervised=p._opts["supervised"].value
                     or p._opts["resume"].value,
                     resume=p._opts["resume"].value)

@@ -236,6 +236,18 @@ class SupervisorConfigure:
     # The run then continues from that snapshot on the SIMT tier (the
     # kernel tier cannot resume mid-state).  CLI: --resume.
     resume: bool = False
+    # --- mesh-level fault tolerance (parallel/supervisor.py) ---
+    # Consecutive failed slices on ONE device of a supervised sharded
+    # drive before that device is ejected from the mesh (its lanes
+    # migrate to surviving devices).  Retries between failures back off
+    # with the shared backoff_* formula above.
+    max_device_retries: int = 2
+    # Elastic shrink: eject a repeatedly-failing device and migrate its
+    # lanes onto survivors.  False = fail fast instead — the whole mesh
+    # run cancels cooperatively (sibling devices stop at their next
+    # launch boundary) and raises with per-device attribution; some
+    # operators prefer visible capacity loss over silent shrink.
+    eject_devices: bool = True
 
 
 @dataclasses.dataclass

@@ -1,7 +1,10 @@
 from wasmedge_tpu.parallel.mesh import (
+    MeshDriveError,
     lane_mesh,
+    run_pallas_sharded,
     shard_batch_state,
     state_shardings,
 )
 
-__all__ = ["lane_mesh", "shard_batch_state", "state_shardings"]
+__all__ = ["MeshDriveError", "lane_mesh", "run_pallas_sharded",
+           "shard_batch_state", "state_shardings"]

@@ -57,9 +57,81 @@ def shard_batch_state(state, mesh):
     return jax.device_put(state, state_shardings(mesh, state))
 
 
+class MeshDriveError(RuntimeError):
+    """Aggregated per-device failures from a sharded drive.
+
+    `failures` is [(device, exception)] for EVERY device that failed —
+    surfacing only the first loses the (common) correlated-failure
+    signature; the first failure stays chained as __cause__."""
+
+    def __init__(self, failures):
+        self.failures = list(failures)
+        detail = "; ".join(f"{dev}: {e!r}" for dev, e in self.failures)
+        super().__init__(
+            f"sharded drive failed on {len(self.failures)} "
+            f"device(s): {detail}")
+
+
+def size_lane_args(args_lanes, lanes=None):
+    """Normalize batch args for a mesh drive: int64 arrays, scalars
+    broadcast to the lane count (taken from the first per-lane array
+    when `lanes` is not given).  One rule shared by the unsupervised
+    drive, the MeshSupervisor, and VM.execute_batch's devices path —
+    the pinned bit-identical-across-device-counts guarantee depends on
+    all three agreeing.  Returns (args, lanes)."""
+    import numpy as np
+
+    args = [np.asarray(a, np.int64) for a in (args_lanes or [])]
+    if lanes is None:
+        lanes = next((a.shape[0] for a in args if a.ndim), None)
+        if lanes is None:
+            raise ValueError(
+                "the mesh drive needs `lanes` or at least one per-lane "
+                "(non-scalar) argument array to size the batch")
+    return ([a if a.ndim else np.full(lanes, a, np.int64) for a in args],
+            int(lanes))
+
+
+def split_lanes(lanes: int, n: int):
+    """Contiguous near-equal lane ranges for n devices: uneven lane
+    counts split unevenly (each device's engine is sized to its own
+    slice, so no clone/pad lanes ever execute and host-visible WASI
+    effects are never duplicated); devices left without lanes sit
+    out."""
+    import numpy as np
+
+    return [p.astype(np.int64)
+            for p in np.array_split(np.arange(lanes), n) if p.size]
+
+
+def make_device_scheduler(inst, store, conf, func_name, dev_args,
+                          max_steps, interpret, di):
+    """One device's warp-interpreter drive: a PallasUniformEngine plus
+    its BlockScheduler over `dev_args` (this device's lane slice).
+    Shared by the unsupervised drive below and the MeshSupervisor's
+    kernel tier (which rebuilds a fresh scheduler per retry)."""
+    from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
+    from wasmedge_tpu.batch.scheduler import BlockScheduler
+
+    eng = PallasUniformEngine(inst, store=store, conf=conf,
+                              lanes=len(dev_args[0]) if dev_args else None,
+                              interpret=interpret)
+    if not eng.eligible:
+        raise RuntimeError(f"pallas ineligible: {eng.ineligible_reason}")
+    # per-device flight-recorder track (ROADMAP r8 open item): each
+    # device's scheduler events — kernel rounds, splits, frees,
+    # residue — land on their own trace track instead of interleaving
+    # on one "pallas" lane, so a multi-chip run is attributable per
+    # chip in Perfetto
+    eng.obs_track = f"pallas/dev{di}"
+    return BlockScheduler(eng, func_name, dev_args, max_steps)
+
+
 def run_pallas_sharded(inst, store, conf, func_name, args_lanes,
                        devices=None, max_steps: int = 10_000_000,
-                       interpret=None, threaded: bool = True):
+                       interpret=None, threaded: bool = True,
+                       supervised: bool = False, faults=None, stats=None,
+                       checkpoint_dir=None, resume=None, lanes=None):
     """Run the Pallas warp-interpreter sharded across devices.
 
     Wasm instances are share-nothing, so multi-chip Pallas execution is
@@ -71,44 +143,45 @@ def run_pallas_sharded(inst, store, conf, func_name, args_lanes,
     interleave on the host — the same latency-hiding drive the
     multi-tenant engine uses across tenants, here across chips.
     Returns one merged BatchResult in original lane order.
+
+    A lane count that does not divide the device count splits unevenly
+    (contiguous `np.array_split` ranges; devices left without lanes sit
+    out) — each device's engine is sized to its own slice, so no clone
+    lanes execute and host-visible WASI effects are never duplicated.
+    `supervised=True` (or `resume`) routes the
+    drive through the MeshSupervisor (parallel/supervisor.py): device
+    quarantine + retry with backoff, lane migration off ejected
+    devices, coordinated mesh checkpointing, cooperative cancellation —
+    `faults`/`stats`/`checkpoint_dir`/`resume` are its knobs.
     """
     import jax
     import numpy as np
 
     from wasmedge_tpu.batch.engine import BatchResult
-    from wasmedge_tpu.batch.pallas_engine import PallasUniformEngine
-    from wasmedge_tpu.batch.scheduler import BlockScheduler
+
+    if supervised or resume:
+        from wasmedge_tpu.parallel.supervisor import MeshSupervisor
+
+        sup = MeshSupervisor(inst, store=store, conf=conf,
+                             devices=devices, faults=faults, stats=stats,
+                             checkpoint_dir=checkpoint_dir, resume=resume,
+                             interpret=interpret)
+        return sup.run(func_name, list(args_lanes), max_steps=max_steps,
+                       lanes=lanes)
 
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
-    args = [np.asarray(a, np.int64) for a in args_lanes]
-    lanes = next((a.shape[0] for a in args if a.ndim), None)
-    if lanes is None:
-        raise ValueError("run_pallas_sharded needs at least one per-lane "
-                         "(non-scalar) argument array to size the batch")
-    # scalar args broadcast to every lane, as in the single-device path
-    args = [a if a.ndim else np.full(lanes, a, np.int64) for a in args]
-    if lanes % n:
-        raise ValueError(f"{lanes} lanes not divisible by {n} devices")
-    per = lanes // n
+    args, lanes = size_lane_args(args_lanes, lanes)
+    parts = split_lanes(lanes, n)
 
     scheds = []
-    for di, dev in enumerate(devices):
+    for di, part in enumerate(parts):
+        dev = devices[di]
         with jax.default_device(dev):
-            eng = PallasUniformEngine(inst, store=store, conf=conf,
-                                      lanes=per, interpret=interpret)
-            if not eng.eligible:
-                raise RuntimeError(
-                    f"pallas ineligible: {eng.ineligible_reason}")
-            # per-device flight-recorder track (ROADMAP r8 open item):
-            # each device's scheduler events — kernel rounds, splits,
-            # frees, residue — land on their own trace track instead of
-            # interleaving on one "pallas" lane, so a multi-chip serving
-            # run is attributable per chip in Perfetto
-            eng.obs_track = f"pallas/dev{di}"
-            sl = slice(di * per, (di + 1) * per)
-            scheds.append((dev, BlockScheduler(
-                eng, func_name, [a[sl] for a in args], max_steps)))
+            sl = slice(int(part[0]), int(part[-1]) + 1)
+            scheds.append((dev, make_device_scheduler(
+                inst, store, conf, func_name, [a[sl] for a in args],
+                max_steps, interpret, di)))
 
     if threaded:
         # one host thread per device: device kernels already overlap
@@ -143,24 +216,32 @@ def run_pallas_sharded(inst, store, conf, func_name, args_lanes,
         for t in ts:
             t.join()
         if errs:
-            raise RuntimeError(f"sharded drive failed: {errs[0][1]!r} "
-                               f"on {errs[0][0]}") from errs[0][1]
+            # every device's failure, attributed — not just errs[0]
+            raise MeshDriveError(errs) from errs[0][1]
     else:
         active = list(scheds)
-        while active:
-            for dev, s in active:
-                with jax.default_device(dev):
-                    s.launch()
-            done = []
-            for dev, s in active:
-                with jax.default_device(dev):
-                    if not s.process():
-                        done.append((dev, s))
-            for d in done:
-                active.remove(d)
-        for dev, s in scheds:
-            with jax.default_device(dev):
-                s._run_simt_residue()
+        cur = None
+        try:
+            while active:
+                for cur, s in active:
+                    with jax.default_device(cur):
+                        s.launch()
+                done = []
+                for cur, s in active:
+                    with jax.default_device(cur):
+                        if not s.process():
+                            done.append((cur, s))
+                for d in done:
+                    active.remove(d)
+            for cur, s in scheds:
+                with jax.default_device(cur):
+                    s._run_simt_residue()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            # device-attributed wrapping for the serial drive too (its
+            # exceptions used to escape raw, naming no device)
+            raise MeshDriveError([(cur, e)]) from e
 
     results = [s.result() for _, s in scheds]
     nres = len(results[0].results)

@@ -1,0 +1,784 @@
+"""MeshSupervisor: mesh-level fault tolerance for the sharded drive.
+
+`run_pallas_sharded` (parallel/mesh.py) was the last unsupervised
+multi-device path: one device exception killed the whole run and threw
+away every surviving device's work.  This module extends r7's
+single-device supervision (batch/supervisor.py) across the mesh.  Wasm
+lanes are share-nothing, so every mechanism is per-device state surgery
+— no collectives, no global barrier beyond the coordinator's round
+boundary:
+
+1. **Per-device failure detection and quarantine** — each device's
+   drive runs in its own thread; an exception marks the device suspect.
+   Suspects are retried from their newest mesh-checkpoint shard (else
+   their initial sub-state) with the shared `backoff_seconds` formula;
+   after `supervisor.max_device_retries` consecutive failures the
+   device is ejected from the mesh.
+
+2. **Lane migration (elastic shrink)** — an ejected device's unfinished
+   lanes are exported at the last launch boundary (its restored
+   BatchState — the same plane-level seam batch/checkpoint.py
+   snapshots), column-sliced, and re-packed onto surviving devices,
+   which run them to completion.  Results merge in original lane order
+   either way.
+
+3. **Coordinated mesh checkpointing** — a cadence (the shared
+   `supervisor.checkpoint_every_steps/_s` knobs) snapshots EVERY
+   device's state at a launch-boundary barrier into one atomic lineage
+   member: a `mesh-<seq>/` directory of per-device shards plus a
+   manifest and the partial merged results, renamed into place only
+   when complete.  A whole-process crash resumes with `resume=True`
+   exactly like the single-device supervisor, re-binding shards to the
+   currently-available devices (the lineage machinery is the shared
+   batch/lineage.py).
+
+4. **Cooperative cancellation** — when a run is doomed (a device
+   exhausts its retries with `eject_devices=False`, or no healthy
+   device remains to migrate to), sibling device threads observe the
+   cancel flag at their next launch boundary (BatchEngine._cancel_hook
+   / BlockScheduler.cancel_check) instead of driving doomed work to
+   completion.
+
+Tier policy mirrors the single-device supervisor: the Pallas/
+BlockScheduler kernel tier is attempted per device when eligible and
+best-effort (a device that exhausts kernel-tier retries demotes to its
+SIMT engine from the original arguments); checkpoint cadence, retry-
+from-snapshot, and migration all operate on the SIMT tier, whose
+BatchState the checkpoint layer understands.  A configured cadence (or
+resume) therefore drives the SIMT tier directly — exporting a live
+BlockScheduler's block-packed state remains a ROADMAP open item.
+
+Side-effect caveat: a device retry that falls back to its initial
+sub-state replays that device's lanes from scratch; tier-0 stdout
+suppression is per-engine (batch/hostcall.py), so mesh-tier output is
+at-least-once across device restores — pure-compute batches are
+exactly-once by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from wasmedge_tpu.batch.lineage import Lineage
+from wasmedge_tpu.common.errors import EngineFailure
+from wasmedge_tpu.common.statistics import FailureRecord, record_failure
+
+MANIFEST_FORMAT = 1
+_MEMBER_PATTERN = r"mesh-(\d+)"
+
+
+def slice_state_lanes(state, cols):
+    """Column-slice a BatchState on its lane (last) dim — the export
+    seam lane migration rides.  Planes whose trailing dim is not the
+    lane dim (the [2, 2] tier-0 time base) are shared, like
+    state_shardings' replication rule."""
+    import jax
+    import jax.numpy as jnp
+
+    lanes = int(state.pc.shape[0])
+    idx = jnp.asarray(np.asarray(cols, np.int64))
+
+    def take(x):
+        nd = getattr(x, "ndim", 0)
+        if nd == 0 or int(x.shape[-1]) != lanes:
+            return x
+        return jnp.take(x, idx, axis=nd - 1)
+
+    return jax.tree_util.tree_map(take, state)
+
+
+class _Shard:
+    """One device's slice of the batch: its lane ids, engine, in-flight
+    state, and supervision counters."""
+
+    __slots__ = ("di", "dev_index", "device", "lane_ids", "engine",
+                 "state", "total", "consecutive", "alive", "done",
+                 "fatal", "track", "migrate_state")
+
+    def __init__(self, di: int, dev_index: int, device, lane_ids):
+        self.di = di                  # shard id (monotonic)
+        self.dev_index = dev_index    # position in the device list
+        self.device = device
+        self.lane_ids = np.asarray(lane_ids, np.int64)
+        self.engine = None
+        self.state = None
+        self.total = 0
+        self.consecutive = 0
+        self.alive = True
+        self.done = False
+        self.fatal = None
+        self.track = f"mesh/dev{dev_index}"
+        self.migrate_state = None     # sliced state handed off by _eject
+
+
+class MeshSupervisor:
+    """Supervised multi-device drive of one module's batch.
+
+    `run()` returns the same merged BatchResult `run_pallas_sharded`
+    does.  `faults` is an optional testing.faults.FaultInjector armed on
+    the mesh seams (`device_launch`/`device_serve` per device-engine
+    chunk with `device=<index>` context, `mesh_checkpoint_save` /
+    `checkpoint_load` around the coordinated lineage)."""
+
+    def __init__(self, inst, store=None, conf=None, devices=None,
+                 faults=None, stats=None,
+                 checkpoint_dir: Optional[str] = None,
+                 resume: Optional[bool] = None, interpret=None):
+        from wasmedge_tpu.common.configure import Configure
+        from wasmedge_tpu.obs.recorder import recorder_of
+
+        self.inst = inst
+        self.store = store
+        self.conf = conf if conf is not None else Configure()
+        self.k = self.conf.supervisor
+        self.faults = faults
+        self.stats = stats
+        self.obs = recorder_of(self.conf)
+        self.interpret = interpret
+        self.checkpoint_dir = checkpoint_dir or self.k.checkpoint_dir
+        self.resume = self.k.resume if resume is None else bool(resume)
+        import jax
+
+        self.devices = list(devices) if devices is not None \
+            else jax.devices()
+        if not self.devices:
+            raise ValueError("mesh supervision needs at least one device")
+        self.failures: List[FailureRecord] = []
+        self.retries = 0
+        self.shards: List[_Shard] = []
+        self._lineage = Lineage()
+        self._cancel = threading.Event()
+        self._bad_devices = set()     # dev_index of ejected devices
+        self._next_di = 0
+        self._seq = 0                 # mesh member sequence counter
+        self.resumed = False
+
+    # -- public ------------------------------------------------------------
+    def run(self, func_name: str, args_lanes, max_steps: int = 10_000_000,
+            lanes=None):
+        from wasmedge_tpu.parallel.mesh import size_lane_args, split_lanes
+
+        ex = self.inst.exports.get(func_name)
+        if ex is None or ex[0] != 0:
+            raise KeyError(f"no exported function {func_name}")
+        self._func_name = func_name
+        self._func_idx = ex[1]
+        self._nres = int(self.inst.lowered.funcs[self._func_idx].nresults)
+        self._max_steps = int(max_steps)
+        args, lanes = size_lane_args(args_lanes, lanes)
+        self.lanes = lanes
+        self._args = args
+        self._invocation = self._invocation_fingerprint()
+        # fresh run state (a reused supervisor starts over; only an
+        # explicit resume adopts disk state)
+        self._lineage.reset()
+        self._cancel.clear()
+        self._bad_devices = set()
+        self.shards = []
+        self._next_di = 0
+        self._seq = 0
+        self._steps = 0
+        self.resumed = self.resume and self._adopt_lineage()
+        if not self.resumed:
+            self._init_accumulators()
+            for di, part in enumerate(split_lanes(lanes,
+                                                  len(self.devices))):
+                self.shards.append(self._new_shard(
+                    di, self.devices[di], part))
+        if not self.resumed and self.k.use_kernel_tier \
+                and not self._wants_cadence() and self._kernel_tier_on():
+            self._run_kernel_tier()
+        self._reset_cadence()
+        self._run_simt_rounds()
+        return self._merged_result()
+
+    # -- setup -------------------------------------------------------------
+    def _invocation_fingerprint(self) -> dict:
+        import hashlib
+
+        h = hashlib.sha256()
+        for a in self._args:
+            h.update(np.ascontiguousarray(a).tobytes())
+        return {"func": self._func_name, "args_sha256": h.hexdigest(),
+                "lanes": self.lanes}
+
+    def _init_accumulators(self):
+        self._res = np.zeros((max(self._nres, 1), self.lanes), np.int64)
+        self._trap = np.zeros(self.lanes, np.int32)
+        self._retired = np.zeros(self.lanes, np.int64)
+        self._done_mask = np.zeros(self.lanes, bool)
+
+    def _new_shard(self, dev_index: int, device, lane_ids) -> _Shard:
+        s = _Shard(self._next_di, dev_index, device, lane_ids)
+        self._next_di += 1
+        return s
+
+    def _wants_cadence(self) -> bool:
+        return bool(self.k.checkpoint_every_steps
+                    or self.k.checkpoint_every_s)
+
+    def _kernel_tier_on(self) -> bool:
+        from wasmedge_tpu.batch.pallas_engine import pallas_enabled
+
+        return bool(self.interpret) or pallas_enabled(self.conf.batch)
+
+    # -- kernel tier (best-effort, mirrors the single supervisor) ----------
+    def _run_kernel_tier(self):
+        """Per-device BlockScheduler drive with retry; a device that
+        exhausts its kernel-tier budget demotes to the SIMT rounds from
+        its original arguments (recorded), it is NOT ejected — device
+        health is judged on the checkpointable tier."""
+        import jax
+
+        from wasmedge_tpu.parallel.mesh import make_device_scheduler
+
+        k = self.k
+
+        def drive(shard: _Shard):
+            attempt = 0
+            while not self._cancel.is_set():
+                try:
+                    if self.faults is not None:
+                        self.faults.fire("device_launch",
+                                         device=shard.dev_index,
+                                         tier="pallas", attempt=attempt)
+                    with jax.default_device(shard.device):
+                        sched = make_device_scheduler(
+                            self.inst, self.store, self.conf,
+                            self._func_name,
+                            [a[shard.lane_ids] for a in self._args],
+                            self._max_steps, self.interpret,
+                            shard.dev_index)
+                        sched.cancel_check = self._cancel.is_set
+                        t0 = self.obs.now()
+                        sched.run()
+                        if self._cancel.is_set():
+                            return
+                        res = sched.result()
+                        self.obs.span("device_drive", t0, cat="mesh",
+                                      track=shard.track,
+                                      device=str(shard.device),
+                                      lanes=int(shard.lane_ids.size))
+                    self._merge_kernel_result(shard, res)
+                    shard.done = True
+                    return
+                except (KeyboardInterrupt, SystemExit) as e:
+                    shard.fatal = e
+                    self._cancel.set()
+                    return
+                except Exception as e:
+                    attempt += 1
+                    self.retries += 1
+                    self._record("device_launch", e, shard=shard,
+                                 tier="pallas")
+                    self.obs.instant("device_suspect", cat="mesh",
+                                     track=shard.track,
+                                     device=str(shard.device),
+                                     tier="pallas", attempt=attempt)
+                    if attempt > k.max_device_retries:
+                        # best-effort tier: demote, don't eject
+                        self._record("demote", e, shard=shard,
+                                     tier="pallas")
+                        return
+                    self._backoff(attempt)
+
+        ts = [threading.Thread(target=drive, args=(s,), daemon=True)
+              for s in self.shards]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for s in self.shards:
+            if s.fatal is not None:
+                raise s.fatal
+
+    def _merge_kernel_result(self, shard: _Shard, res):
+        ids = shard.lane_ids
+        for r in range(self._nres):
+            self._res[r, ids] = np.asarray(res.results[r], np.int64)
+        self._trap[ids] = np.asarray(res.trap, np.int32)
+        self._retired[ids] = np.asarray(res.retired, np.int64)
+        self._done_mask[ids] = True
+        self._steps = max(self._steps, int(res.steps))
+
+    # -- SIMT rounds (supervised tier) -------------------------------------
+    def _ensure_engine(self, shard: _Shard):
+        import jax
+
+        if shard.engine is None:
+            self._ensure_engine_only(shard)
+        if shard.state is None:
+            with jax.default_device(shard.device):
+                if shard.migrate_state is not None:
+                    shard.state = jax.device_put(shard.migrate_state,
+                                                 shard.device)
+                    shard.migrate_state = None
+                else:
+                    shard.state = self._initial_shard_state(shard)
+
+    def _initial_shard_state(self, shard: _Shard):
+        return shard.engine.initial_state(
+            self._func_idx, [a[shard.lane_ids] for a in self._args])
+
+    def _device_hook(self, shard: _Shard):
+        fire = self.faults.fire
+
+        def hook(point, **ctx):
+            if point in ("launch", "serve"):
+                point = "device_" + point
+            fire(point, device=shard.dev_index, **ctx)
+
+        return hook
+
+    def _run_simt_rounds(self):
+        import jax
+
+        while True:
+            active = [s for s in self.shards if s.alive and not s.done]
+            if not active:
+                break
+            if self._cancel.is_set():
+                self._raise_cancelled()
+            for s in active:
+                self._ensure_engine(s)
+            errs = {}
+            crash: List[BaseException] = []
+
+            def drive(shard: _Shard):
+                try:
+                    with jax.default_device(shard.device):
+                        eng = shard.engine
+                        if self.faults is not None:
+                            eng._fault_hook = self._device_hook(shard)
+                        t0 = self.obs.now()
+                        target = self._slice_target(shard.total)
+                        shard.state, shard.total = eng.run_from_state(
+                            shard.state, shard.total, target)
+                        self.obs.span("device_slice", t0, cat="mesh",
+                                      track=shard.track,
+                                      device=str(shard.device),
+                                      steps=int(shard.total))
+                except (KeyboardInterrupt, SystemExit) as e:
+                    shard.fatal = e
+                    crash.append(e)
+                    self._cancel.set()
+                except Exception as e:
+                    errs[shard.di] = e
+                    # fail-fast mode: siblings may stop mid-slice as
+                    # soon as this shard's budget is known-exhausted
+                    if not self.k.eject_devices and \
+                            shard.consecutive + 1 > self.k.max_device_retries:
+                        self._cancel.set()
+                finally:
+                    if shard.engine is not None:
+                        shard.engine._fault_hook = None
+
+            if len(active) == 1:
+                drive(active[0])   # no thread hop for a lone shard
+            else:
+                ts = [threading.Thread(target=drive, args=(s,),
+                                       daemon=True) for s in active]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+            if crash:
+                raise crash[0]
+            for s in active:
+                e = errs.get(s.di)
+                if e is None:
+                    s.consecutive = 0
+                    if s.alive and not s.done and self._finished(s):
+                        self._harvest_shard(s)
+                else:
+                    self._handle_failure(s, e)
+            if self._cancel.is_set():
+                self._raise_cancelled()
+            self._maybe_checkpoint()
+
+    def _slice_target(self, total: int) -> int:
+        # slice the drive so checkpoint decisions land on chunk-aligned
+        # launch boundaries (same formula as the single supervisor)
+        step = None
+        if self.k.checkpoint_every_steps:
+            step = int(self.k.checkpoint_every_steps)
+        if self.k.checkpoint_every_s:
+            chunk = max(int(self.conf.batch.steps_per_launch), 1)
+            step = chunk if step is None else min(step, chunk)
+        if step is None:
+            return self._max_steps
+        return min(self._max_steps, total + step)
+
+    def _finished(self, shard: _Shard) -> bool:
+        trap = np.asarray(shard.state.trap)
+        return not (trap == 0).any() or shard.total >= self._max_steps
+
+    # -- failure handling --------------------------------------------------
+    def _handle_failure(self, shard: _Shard, exc: BaseException):
+        self.retries += 1
+        shard.consecutive += 1
+        point = getattr(exc, "point", None) or "device_launch"
+        if point in ("launch", "serve"):
+            point = "device_" + point
+        if point not in ("device_launch", "device_serve"):
+            point = "device_launch"
+        self._record(point, exc, shard=shard)
+        self.obs.instant("device_suspect", cat="mesh", track=shard.track,
+                         device=str(shard.device),
+                         consecutive=shard.consecutive, point=point)
+        if shard.consecutive > self.k.max_device_retries:
+            if not self.k.eject_devices:
+                shard.alive = False
+                shard.fatal = exc
+                self._cancel.set()
+                return
+            self._eject(shard, exc)
+            return
+        # the failed slice may have consumed donated buffers: never
+        # reuse the state, restore from the mesh lineage (else initial)
+        shard.state, shard.total = self._restore_shard(shard)
+        self._backoff(shard.consecutive)
+
+    def _eject(self, shard: _Shard, exc: BaseException):
+        """Quarantine the device and migrate its unfinished lanes onto
+        the surviving devices (elastic shrink)."""
+        shard.alive = False
+        self._bad_devices.add(shard.dev_index)
+        self._record("device_quarantine", exc, shard=shard,
+                     error=f"device {shard.dev_index} ({shard.device}) "
+                           f"ejected after {shard.consecutive - 1} "
+                           f"retries: {exc!r}")
+        self.obs.instant("device_quarantine", cat="mesh",
+                         track=shard.track, device=str(shard.device),
+                         lanes=int(shard.lane_ids.size))
+        targets = [(i, d) for i, d in enumerate(self.devices)
+                   if i not in self._bad_devices]
+        if not targets:
+            shard.fatal = exc
+            self._cancel.set()
+            return
+        state, total = self._restore_shard(shard)
+        from wasmedge_tpu.batch.image import TRAP_HOSTCALL
+
+        trap = np.asarray(state.trap)
+        finished = (trap != 0) & (trap != TRAP_HOSTCALL)
+        if finished.any():
+            self._harvest_state(state, shard.lane_ids, finished, total)
+        live = np.nonzero(~finished)[0]
+        if not live.size:
+            shard.done = True
+            return
+        parts = np.array_split(live, min(len(targets), int(live.size)))
+        for part, (tidx, dev) in zip(parts, targets):
+            sub = self._new_shard(tidx, dev, shard.lane_ids[part])
+            sub.total = total
+            sub.migrate_state = slice_state_lanes(state, part)
+            self.shards.append(sub)
+            self._record("lane_migrate", None, shard=shard,
+                         error=f"{int(part.size)} lanes "
+                               f"{shard.device} -> {dev}")
+            self.obs.instant("lane_migrate", cat="mesh", track=sub.track,
+                             lanes=int(part.size), src=str(shard.device),
+                             dst=str(dev))
+
+    def _restore_shard(self, shard: _Shard):
+        """Newest mesh-lineage shard covering this shard's exact lane
+        set, else the initial sub-state.  A shard file that fails to
+        load is recorded but the member is kept — its OTHER shards may
+        still be the best snapshot for their devices (unlike the
+        single-device lineage, one member covers many devices)."""
+        from wasmedge_tpu.batch import checkpoint
+
+        want = [int(x) for x in shard.lane_ids]
+        for m in reversed(self._lineage.members):
+            manifest = m.payload or {}
+            entry = next((s for s in manifest.get("shards", [])
+                          if s.get("lane_ids") == want), None)
+            if entry is None:
+                continue   # e.g. a post-migration shard older members predate
+            path = os.path.join(m.path, entry["file"])
+            try:
+                if self.faults is not None:
+                    self.faults.fire("checkpoint_load", path=path,
+                                     device=shard.dev_index)
+                t0 = self.obs.now()
+                import jax
+
+                with jax.default_device(shard.device):
+                    state, total = checkpoint.load(path, shard.engine)
+                self.obs.span("checkpoint_load", t0, cat="mesh",
+                              track=shard.track, checkpoint=path,
+                              steps=int(total))
+                return state, total
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._record("checkpoint", e, shard=shard,
+                             checkpoint=path)
+        import jax
+
+        with jax.default_device(shard.device):
+            return self._initial_shard_state(shard), 0
+
+    def _raise_cancelled(self):
+        fatal = [(s, s.fatal) for s in self.shards if s.fatal is not None]
+        detail = "; ".join(
+            f"device {s.dev_index} ({s.device}): {e!r}"
+            for s, e in fatal) or "cancelled"
+        raise EngineFailure(
+            f"mesh run cancelled, siblings stopped at their launch "
+            f"boundary: {detail}", self.failures)
+
+    # -- coordinated checkpointing -----------------------------------------
+    def _reset_cadence(self):
+        totals = [s.total for s in self.shards if s.alive and not s.done]
+        self._last_ckpt_total = min(totals) if totals else 0
+        self._last_ckpt_wall = time.monotonic()
+
+    def _maybe_checkpoint(self):
+        if not self._wants_cadence():
+            return
+        active = [s for s in self.shards if s.alive and not s.done]
+        if not active:
+            return
+        cur = min(s.total for s in active)
+        k = self.k
+        due = bool(k.checkpoint_every_steps
+                   and cur - self._last_ckpt_total
+                   >= k.checkpoint_every_steps)
+        due = due or bool(k.checkpoint_every_s
+                          and time.monotonic() - self._last_ckpt_wall
+                          >= k.checkpoint_every_s)
+        if not due:
+            return
+        self._save_checkpoint(active, cur)
+
+    def _save_checkpoint(self, active: List[_Shard], cur: int):
+        """One atomic lineage member: per-device shards + manifest +
+        partial merged results, written to a temp directory and renamed
+        into place (a crash mid-write leaves only an ignored *.tmp)."""
+        from wasmedge_tpu.batch import checkpoint
+
+        if self.checkpoint_dir is None:
+            self.checkpoint_dir = tempfile.mkdtemp(prefix="wasmedge-mesh-")
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        self._seq += 1
+        name = f"mesh-{self._seq:06d}"
+        final = os.path.join(self.checkpoint_dir, name)
+        tmp = final + ".tmp"
+        t0 = self.obs.now()
+        try:
+            if self.faults is not None:
+                self.faults.fire("mesh_checkpoint_save", member=name)
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            shards_meta = []
+            for i, s in enumerate(active):
+                # a shard migrated THIS round still parks its state in
+                # migrate_state (engines materialize at the next
+                # round's _ensure_engine) — materialize it now so the
+                # member covers every active lane
+                if s.engine is None or s.state is None:
+                    self._ensure_engine(s)
+                fn = f"shard{i}.npz"
+                checkpoint.save(os.path.join(tmp, fn), s.engine, s.state,
+                                s.total, invocation=self._invocation)
+                shards_meta.append({
+                    "file": fn,
+                    "lane_ids": [int(x) for x in s.lane_ids],
+                    "total": int(s.total),
+                })
+            np.savez_compressed(
+                os.path.join(tmp, "merged.npz"), res=self._res,
+                trap=self._trap, retired=self._retired,
+                done=self._done_mask, steps=np.int64(self._steps))
+            manifest = {
+                "format": MANIFEST_FORMAT,
+                "invocation": self._invocation,
+                "lanes": int(self.lanes),
+                "shards": shards_meta,
+            }
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f)
+            # a stale same-seq member (prior run's leftovers, or a
+            # corrupt newer member popped at adoption) blocks a
+            # directory rename with ENOTEMPTY — it is never referenced
+            # by THIS run's lineage, so replace it
+            shutil.rmtree(final, ignore_errors=True)
+            os.rename(tmp, final)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            # a failed snapshot must never kill a healthy run
+            self._record("mesh_checkpoint", e, checkpoint=final)
+            shutil.rmtree(tmp, ignore_errors=True)
+            return
+        self.obs.span("mesh_checkpoint", t0, cat="mesh", track="mesh",
+                      member=final, shards=len(active), steps=int(cur))
+        for s in active:
+            self.obs.instant("mesh_checkpoint", cat="mesh", track=s.track,
+                             member=final, steps=int(s.total))
+        self._lineage.add(final, self._seq, manifest)
+        self._lineage.prune(self.k.keep_checkpoints, unlink=shutil.rmtree)
+        self._last_ckpt_total = int(cur)
+        self._last_ckpt_wall = time.monotonic()
+
+    def _adopt_lineage(self) -> bool:
+        """Cross-process resume: adopt the newest complete mesh member
+        (shared newest-good walk, batch/lineage.py), rebuilding shards
+        over the currently-available devices — the member's lane
+        assignment, not its device identities, is authoritative."""
+        from wasmedge_tpu.batch import checkpoint
+        import jax
+
+        lin = self._lineage
+        lin.install(Lineage.scan(self.checkpoint_dir, _MEMBER_PATTERN))
+
+        def load(m):
+            with open(os.path.join(m.path, "manifest.json")) as f:
+                manifest = json.load(f)
+            if manifest.get("format") != MANIFEST_FORMAT:
+                raise ValueError(
+                    f"unsupported mesh manifest format "
+                    f"{manifest.get('format')}")
+            inv = manifest.get("invocation")
+            if inv != self._invocation:
+                raise ValueError(
+                    f"mesh checkpoint invocation mismatch: snapshot is "
+                    f"{inv}, this run is {self._invocation}")
+            with np.load(os.path.join(m.path, "merged.npz"),
+                         allow_pickle=False) as z:
+                merged = {k2: np.asarray(z[k2])
+                          for k2 in ("res", "trap", "retired", "done",
+                                     "steps")}
+            shards = []
+            for si, entry in enumerate(manifest["shards"]):
+                dev_index = si % len(self.devices)
+                shard = self._new_shard(dev_index,
+                                        self.devices[dev_index],
+                                        np.asarray(entry["lane_ids"],
+                                                   np.int64))
+                self._ensure_engine_only(shard)
+                path = os.path.join(m.path, entry["file"])
+                if self.faults is not None:
+                    self.faults.fire("checkpoint_load", path=path,
+                                     device=dev_index)
+                with jax.default_device(shard.device):
+                    shard.state, shard.total = checkpoint.load(
+                        path, shard.engine)
+                shards.append(shard)
+            return manifest, merged, shards
+
+        got = lin.walk_newest(
+            load, lambda e, m: self._record("mesh_checkpoint", e,
+                                            checkpoint=m.path))
+        if got is None:
+            return False
+        manifest, merged, shards = got
+        newest = lin.newest()
+        # older members keep their manifests as restore-walk payloads;
+        # ones with an unreadable manifest are dropped from the lineage
+        survivors = []
+        for m in lin.members[:-1]:
+            try:
+                with open(os.path.join(m.path, "manifest.json")) as f:
+                    m.payload = json.load(f)
+                survivors.append(m)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._record("mesh_checkpoint", e, checkpoint=m.path)
+        newest.payload = manifest
+        lin.members = survivors + [newest]
+        # bound crash/resume cycles at keep_checkpoints like the serve
+        # twin — without this, adopted members accumulate on disk until
+        # the next cadence save (which a short resumed run may never
+        # reach)
+        lin.prune(self.k.keep_checkpoints, unlink=shutil.rmtree)
+        self._seq = int(newest.steps)
+        self._res = merged["res"]
+        self._trap = merged["trap"]
+        self._retired = merged["retired"]
+        self._done_mask = merged["done"]
+        self._steps = int(merged["steps"])
+        self.shards = shards
+        self.obs.instant("resume_adopted", cat="mesh", track="mesh",
+                         member=newest.path, shards=len(shards),
+                         lineage=len(lin))
+        return True
+
+    def _ensure_engine_only(self, shard: _Shard):
+        import jax
+
+        from wasmedge_tpu.batch.engine import BatchEngine
+
+        with jax.default_device(shard.device):
+            eng = BatchEngine(self.inst, store=self.store, conf=self.conf,
+                              lanes=int(shard.lane_ids.size))
+        eng._cancel_hook = self._cancel.is_set
+        eng.obs_track = shard.track
+        shard.engine = eng
+
+    # -- harvest / merge ---------------------------------------------------
+    def _harvest_shard(self, shard: _Shard):
+        mask = np.ones(shard.lane_ids.size, bool)
+        self._harvest_state(shard.state, shard.lane_ids, mask, shard.total)
+        shard.done = True
+
+    def _harvest_state(self, state, lane_ids, mask, total: int):
+        cols = np.nonzero(np.asarray(mask))[0]
+        ids = np.asarray(lane_ids, np.int64)[cols]
+        stack_lo = np.asarray(state.stack_lo)
+        stack_hi = np.asarray(state.stack_hi)
+        for r in range(self._nres):
+            lo = stack_lo[r, cols].view(np.uint32).astype(np.uint64)
+            hi = stack_hi[r, cols].view(np.uint32).astype(np.uint64)
+            self._res[r, ids] = (lo | (hi << np.uint64(32))).view(np.int64)
+        self._trap[ids] = np.asarray(state.trap)[cols]
+        self._retired[ids] = np.asarray(state.retired,
+                                        np.int64)[cols]
+        self._done_mask[ids] = True
+        self._steps = max(self._steps, int(total))
+
+    def _merged_result(self):
+        from wasmedge_tpu.batch.engine import BatchResult
+
+        return BatchResult(
+            results=[self._res[r].copy() for r in range(self._nres)],
+            trap=self._trap.copy(),
+            retired=self._retired.copy(),
+            steps=int(self._steps))
+
+    # -- bookkeeping -------------------------------------------------------
+    def _backoff(self, attempt: int):
+        from wasmedge_tpu.batch.supervisor import backoff_seconds
+
+        nap = backoff_seconds(self.k, attempt)
+        if nap > 0:
+            time.sleep(nap)
+
+    def _record(self, fault_class, exc, shard: Optional[_Shard] = None,
+                tier: str = "mesh", checkpoint=None, error=None):
+        if error is None:
+            error = "" if exc is None else repr(exc)
+        if shard is not None and not error.startswith("device "):
+            error = (f"device {shard.dev_index} ({shard.device}): "
+                     f"{error}")
+        rec = FailureRecord(
+            fault_class=fault_class, error=error,
+            lanes=tuple(getattr(exc, "lanes", ()) or ()),
+            retry=self.retries, checkpoint=checkpoint, tier=tier).stamp()
+        self.failures.append(rec)
+        self.obs.failure(rec)
+        if self.stats is not None:
+            self.stats.add_failure(rec)
+        else:
+            record_failure(rec)

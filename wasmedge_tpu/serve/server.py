@@ -55,6 +55,7 @@ import numpy as np
 from wasmedge_tpu.common.errors import EngineFailure, ErrCode, WasmError
 from wasmedge_tpu.common.statistics import FailureRecord, record_failure
 from wasmedge_tpu.batch.image import TRAP_DONE
+from wasmedge_tpu.batch.lineage import Lineage
 from wasmedge_tpu.serve.queue import (
     DeadlineExceeded,
     FairQueue,
@@ -114,7 +115,9 @@ class BatchServer:
         # shifts under the lock (an ascending list IS a valid heap)
         self._free: List[int] = list(range(self.lanes))
         self._served_before = np.zeros(self.lanes, bool)
-        self._ckpts: List[tuple] = []   # (path, total, bindings snapshot)
+        # checkpoint members with the lane->request binding snapshot as
+        # the payload (shared machinery, batch/lineage.py)
+        self._lineage = Lineage()
         # stdout cursor positions captured when self.state was current:
         # the launch slice runs outside the lock and its end-of-slice
         # flush advances the engine-resident cursor while self.state is
@@ -616,19 +619,17 @@ class BatchServer:
         bindings: Dict[int, ServeRequest] = {}
         from wasmedge_tpu.batch import checkpoint
 
-        while self._ckpts:
-            path, steps, snap = self._ckpts[-1]
-            try:
-                if self.faults is not None:
-                    self.faults.fire("checkpoint_load", path=path)
-                state, total = checkpoint.load(path, self.engine)
-                bindings = dict(snap)
-                break
-            except (KeyboardInterrupt, SystemExit):
-                raise
-            except Exception as e:
-                self._record("checkpoint", e, checkpoint=path)
-                self._ckpts.pop()
+        def load(m):
+            if self.faults is not None:
+                self.faults.fire("checkpoint_load", path=m.path)
+            st, tot = checkpoint.load(m.path, self.engine)
+            return st, tot, dict(m.payload or {})
+
+        got = self._lineage.walk_newest(
+            load, lambda e, m: self._record("checkpoint", e,
+                                            checkpoint=m.path))
+        if got is not None:
+            state, total, bindings = got
         if state is None:
             # no surviving snapshot: restore an all-idle state and send
             # EVERY in-flight request back to the head of the queue
@@ -696,9 +697,10 @@ class BatchServer:
         if self.counters["rounds"] % int(every):
             return
         # idle rounds don't advance total: re-snapshotting the same
-        # step count would stack duplicate paths in _ckpts and the
+        # step count would stack duplicate paths in the lineage and the
         # prune pass would unlink the file it just wrote
-        if self._ckpts and self._ckpts[-1][1] == self.total:
+        newest = self._lineage.newest()
+        if newest is not None and newest.steps == self.total:
             return
         self.checkpoint()
 
@@ -748,99 +750,80 @@ class BatchServer:
         self.obs.span("checkpoint_save", t0, cat="serve", track="serve",
                       checkpoint=path, steps=int(self.total),
                       in_flight=len(self._bindings))
-        entry = (path, self.total, dict(self._bindings))
-        if self._ckpts and self._ckpts[-1][0] == path:
-            # same total -> same path: replace the lineage entry (the
-            # state/journal may still differ via admissions) instead of
-            # stacking duplicates the prune pass would unlink while
-            # surviving entries still reference the file
-            self._ckpts[-1] = entry
-        else:
-            self._ckpts.append(entry)
-        while len(self._ckpts) > max(int(self.k.keep_checkpoints), 1):
-            old, _, _ = self._ckpts.pop(0)
-            try:
-                os.unlink(old)
-            except OSError:
-                pass
+        # same total -> same path: Lineage.add replaces the entry (the
+        # state/journal may still differ via admissions) instead of
+        # stacking duplicates the prune pass would unlink while
+        # surviving entries still reference the file
+        self._lineage.add(path, self.total, dict(self._bindings))
+        self._lineage.prune(self.k.keep_checkpoints)
         return path
 
     def _adopt_lineage(self):
         """Cross-process resume: newest loadable serve-*.npz plus its
-        binding journal; adopted requests get fresh futures
+        binding journal (shared newest-good-member walk,
+        batch/lineage.py); adopted requests get fresh futures
         (`self.adopted[id]`)."""
-        import os
-        import re
-
         from wasmedge_tpu.batch import checkpoint
 
-        d = self.checkpoint_dir
-        if not d or not os.path.isdir(d):
+        lin = self._lineage
+        lin.install(Lineage.scan(self.checkpoint_dir,
+                                 r"serve-(\d+)\.npz"))
+
+        def load(m):
+            state, total = checkpoint.load(m.path, self.engine)
+            journal = checkpoint.read_meta(m.path).get(
+                "invocation", {}).get("serve_bindings", [])
+            return state, total, journal
+
+        got = lin.walk_newest(
+            load, lambda e, m: self._record("checkpoint", e,
+                                            checkpoint=m.path))
+        if got is None:
             return
-        members = []
-        for fn in sorted(os.listdir(d)):
-            m = re.fullmatch(r"serve-(\d+)\.npz", fn)
-            if m:
-                members.append((os.path.join(d, fn), int(m.group(1))))
-        members.sort(key=lambda t: t[1])
-        while members:
-            path, steps = members[-1]
+        state, total, journal = got
+        self.state, self.total = state, total
+        self._snap_stdout()   # load() rewound the cursor in place
+        from wasmedge_tpu.serve.queue import advance_request_ids
+
+        for entry in journal:
+            req = ServeRequest.from_journal(entry)
+            req.t_submit = time.monotonic()
+            self._bindings[int(entry["lane"])] = req
+            self.adopted[req.id] = req.future
+            advance_request_ids(req.id)
+        self._free = sorted(set(range(self.lanes))
+                            - set(self._bindings))
+        self._served_before[list(self._bindings)] = True
+        # the full surviving lineage stays installed (like the
+        # supervisor's twin adoption): older members remain usable as
+        # _recover fallbacks, and the prune pass below keeps
+        # crash/resume cycles from accumulating serve-*.npz forever.
+        # Older journals reuse the adopted request objects by id so a
+        # fallback restore resolves the futures callers hold.
+        byid = {r.id: r for r in self._bindings.values()}
+        survivors = []
+        for m in lin.members[:-1]:
             try:
-                state, total = checkpoint.load(path, self.engine)
-                journal = checkpoint.read_meta(path).get(
+                j2 = checkpoint.read_meta(m.path).get(
                     "invocation", {}).get("serve_bindings", [])
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
-                self._record("checkpoint", e, checkpoint=path)
-                members.pop()
+                self._record("checkpoint", e, checkpoint=m.path)
                 continue
-            self.state, self.total = state, total
-            self._snap_stdout()   # load() rewound the cursor in place
-            from wasmedge_tpu.serve.queue import advance_request_ids
-
-            for entry in journal:
-                req = ServeRequest.from_journal(entry)
-                req.t_submit = time.monotonic()
-                self._bindings[int(entry["lane"])] = req
-                self.adopted[req.id] = req.future
-                advance_request_ids(req.id)
-            self._free = sorted(set(range(self.lanes))
-                                - set(self._bindings))
-            self._served_before[list(self._bindings)] = True
-            # the full surviving lineage joins _ckpts (like the
-            # supervisor's twin adoption): older members stay usable as
-            # _recover fallbacks, and the prune pass below keeps
-            # crash/resume cycles from accumulating serve-*.npz forever.
-            # Older journals reuse the adopted request objects by id so
-            # a fallback restore resolves the futures callers hold.
-            byid = {r.id: r for r in self._bindings.values()}
-            self._ckpts = []
-            for p2, s2 in members[:-1]:
-                try:
-                    j2 = checkpoint.read_meta(p2).get(
-                        "invocation", {}).get("serve_bindings", [])
-                except (KeyboardInterrupt, SystemExit):
-                    raise
-                except Exception as e:
-                    self._record("checkpoint", e, checkpoint=p2)
-                    continue
-                snap2 = {}
-                for e2 in j2:
-                    req2 = byid.get(int(e2["id"]))
-                    if req2 is None:
-                        req2 = ServeRequest.from_journal(e2)
-                        advance_request_ids(req2.id)
-                    snap2[int(e2["lane"])] = req2
-                self._ckpts.append((p2, s2, snap2))
-            self._ckpts.append((path, total, dict(self._bindings)))
-            while len(self._ckpts) > max(int(self.k.keep_checkpoints), 1):
-                old, _, _ = self._ckpts.pop(0)
-                try:
-                    os.unlink(old)
-                except OSError:
-                    pass
-            self.obs.instant("resume_adopted", cat="serve", track="serve",
-                             checkpoint=path, steps=int(total),
-                             in_flight=len(self._bindings))
-            return
+            snap2 = {}
+            for e2 in j2:
+                req2 = byid.get(int(e2["id"]))
+                if req2 is None:
+                    req2 = ServeRequest.from_journal(e2)
+                    advance_request_ids(req2.id)
+                snap2[int(e2["lane"])] = req2
+            m.payload = snap2
+            survivors.append(m)
+        newest = lin.members[-1]
+        newest.payload = dict(self._bindings)
+        lin.members = survivors + [newest]
+        lin.prune(self.k.keep_checkpoints)
+        self.obs.instant("resume_adopted", cat="serve", track="serve",
+                         checkpoint=newest.path, steps=int(total),
+                         in_flight=len(self._bindings))

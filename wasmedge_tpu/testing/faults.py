@@ -9,7 +9,17 @@ raises an `InjectedFault` at the configured occurrence indices, so a test
 can reproduce "the 3rd launch dies", "the first WASI drain raises", or
 "the newest checkpoint is corrupt" bit-for-bit every run.
 
-Fault classes covered by the tier-1 suite (ISSUE 2 acceptance):
+The mesh supervisor (parallel/supervisor.py) adds device-level seams:
+`"device_launch"` / `"device_serve"` fire per device-engine chunk with
+`device=<index>` in the context, and `"mesh_checkpoint_save"` brackets a
+coordinated mesh snapshot.  Arrivals at a shared seam interleave across
+device threads in scheduling order, so device-targeted faults should use
+`Fault.match` (e.g. `match={"device": 2}`) — matched faults count their
+OWN arrivals, making "device 2's first launch" deterministic regardless
+of thread interleaving.  `fire` is locked: concurrent device threads
+never corrupt the arrival counters.
+
+Fault classes covered by the tier-1 suites (ISSUE 2 + ISSUE 5):
   - launch-time device error       Fault(point="launch", ...)
   - mid-serve host exception       Fault(point="serve", ...)
   - corrupted/truncated checkpoint corrupt_checkpoint(path, ...) via
@@ -18,11 +28,14 @@ Fault classes covered by the tier-1 suite (ISSUE 2 acceptance):
   - runaway / poison lane          build_selective_runaway() +
                                    SupervisorConfigure.lane_step_cap, or
                                    a lane-attributed Fault(lanes=(k,))
+  - per-device mesh failure        Fault(point="device_launch",
+                                   match={"device": k}, ...)
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
@@ -49,7 +62,8 @@ class Fault:
     """One armed fault: fire on arrivals [at, at + times) at `point`."""
 
     point: str                 # "launch" | "serve" | "checkpoint_save" |
-    #                            "checkpoint_load"
+    #                            "checkpoint_load" | "device_launch" |
+    #                            "device_serve" | "mesh_checkpoint_save"
     at: int = 0                # 0-based arrival index at that seam
     times: int = 1             # consecutive arrivals that fault
     lanes: Tuple[int, ...] = ()  # lane attribution (poison quarantine)
@@ -60,31 +74,57 @@ class Fault:
     # custom exception factory (ctx dict -> exception); default
     # InjectedFault
     exc: Optional[Callable[..., BaseException]] = None
+    # context filter: only arrivals whose fire() ctx is a superset of
+    # this dict are considered, and `at` then indexes the MATCHED
+    # arrivals (per-fault counter) instead of all arrivals at the seam —
+    # "device 2's first launch" stays deterministic under the mesh
+    # drive's thread interleaving
+    match: Optional[dict] = None
 
 
 class FaultInjector:
     """Deterministic seam counter: `fire(point, **ctx)` raises when an
     armed fault covers this arrival.  `log` records every raised fault
-    as (point, index) for assertions."""
+    as (point, index) for assertions.  Thread-safe: the mesh drive fires
+    seams from concurrent per-device threads."""
 
     def __init__(self, faults: Sequence[Fault]):
         self.faults = list(faults)
         self.counts = {}
         self.log = []
+        self._match_counts = {}
+        self._lock = threading.Lock()
 
     def fire(self, point: str, **ctx):
-        i = self.counts.get(point, 0)
-        self.counts[point] = i + 1
-        for f in self.faults:
-            if f.point != point or not (f.at <= i < f.at + f.times):
-                continue
+        with self._lock:
+            i = self.counts.get(point, 0)
+            self.counts[point] = i + 1
+            fire_f = fire_idx = None
+            for fi, f in enumerate(self.faults):
+                if f.point != point:
+                    continue
+                if f.match is not None:
+                    if any(ctx.get(k) != v for k, v in f.match.items()):
+                        continue
+                    j = self._match_counts.get(fi, 0)
+                    self._match_counts[fi] = j + 1
+                    idx = j
+                else:
+                    idx = i
+                if not (f.at <= idx < f.at + f.times):
+                    continue
+                if fire_f is None:
+                    fire_f, fire_idx = f, idx
+            if fire_f is None:
+                return
+            f, idx = fire_f, fire_idx
             if f.before is not None:
                 f.before()
-            self.log.append((point, i))
-            if f.exc is not None:
-                raise f.exc(dict(ctx, point=point, index=i))
-            raise InjectedFault(point, i, lanes=f.lanes,
-                                message=f.message)
+            self.log.append((point, idx))
+        if f.exc is not None:
+            raise f.exc(dict(ctx, point=point, index=idx))
+        raise InjectedFault(point, idx, lanes=f.lanes,
+                            message=f.message)
 
     @property
     def fired(self) -> int:

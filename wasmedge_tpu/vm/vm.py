@@ -203,6 +203,7 @@ class VM:
 
     def execute_batch(self, func_name: str, args_lanes: Sequence,
                       lanes: Optional[int] = None, mesh=None,
+                      devices=None,
                       max_steps: int = 10_000_000, supervised: bool = False,
                       resume: Optional[bool] = None,
                       trace_out: Optional[str] = None,
@@ -210,6 +211,14 @@ class VM:
         """Run the instantiated module's export over N device lanes in SIMT
         lockstep (the tpu_batch engine, SURVEY.md §2.10) and return the
         BatchResult (per-lane results/trap/retired arrays).
+
+        `devices` (an int prefix of jax.devices() or an explicit device
+        list) shards the lane batch across a device mesh via
+        parallel/mesh.py — one engine per chip, merged lane-ordered
+        result.  Combined with `supervised=True` the drive runs under
+        the MeshSupervisor (parallel/supervisor.py): per-device failure
+        quarantine, lane migration off ejected devices, coordinated
+        mesh checkpointing, cooperative cancellation.
 
         `supervised=True` wraps the run in the supervision layer
         (batch/supervisor.py): periodic checkpoints, retry-with-backoff
@@ -256,6 +265,19 @@ class VM:
         conf = batch_conf_with_gas(self.conf, self.stat)
         eng = None
         try:
+            if devices is not None:
+                import jax
+
+                from wasmedge_tpu.parallel.mesh import run_pallas_sharded
+
+                devs = jax.devices()[:int(devices)] \
+                    if isinstance(devices, int) else list(devices)
+                # `lanes` forwards so the scalar-broadcast contract of
+                # the single-device paths holds on the mesh drive too
+                return run_pallas_sharded(
+                    inst, self.store, conf, func_name, list(args_lanes),
+                    devices=devs, max_steps=max_steps, lanes=lanes,
+                    supervised=supervised, stats=self.stat, resume=resume)
             if supervised:
                 from wasmedge_tpu.batch.engine import BatchEngine
                 from wasmedge_tpu.batch.supervisor import BatchSupervisor
