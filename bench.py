@@ -451,6 +451,287 @@ def serve_bench(smoke: bool = False) -> int:
     return 0 if out["ok"] else 1
 
 
+def _gateway_rpc(host, port, method, path, body=None, headers=None,
+                 timeout=120.0):
+    """One stdlib-HTTP round trip to the gateway (real sockets — the
+    bench measures the wire protocol, not in-process calls)."""
+    import json as _json
+    from http.client import HTTPConnection
+
+    c = HTTPConnection(host, port, timeout=timeout)
+    try:
+        if isinstance(body, dict):
+            body = _json.dumps(body).encode()
+        c.request(method, path, body=body, headers=headers or {})
+        r = c.getresponse()
+        raw = r.read()
+        retry_after = r.getheader("Retry-After")
+    finally:
+        c.close()
+    try:
+        doc = _json.loads(raw)
+    except Exception:
+        doc = raw.decode(errors="replace")
+    return r.status, doc, retry_after
+
+
+def _start_gateway(conf, lanes, tenants=None):
+    from wasmedge_tpu.gateway import Gateway, GatewayService
+
+    svc = GatewayService(conf=conf, lanes=lanes, tenants=tenants)
+    gw = Gateway(svc, host="127.0.0.1", port=0).start()
+    return gw, svc
+
+
+def gateway_smoke() -> int:
+    """`bench.py --gateway-smoke`: start the gateway on an ephemeral
+    port, register the echo module OVER HTTP at runtime, drive a small
+    mixed-tenant echo stream through real sockets, flood one
+    rate-limited tenant until it draws a 429, and assert every accepted
+    request resolves + the gateway shuts down cleanly.  The CI guard
+    that the network layer stays wired end-to-end; prints ONE JSON
+    line, emits no artifact."""
+    import time as _time
+
+    import bench_echo
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.gateway import GatewayTenants
+
+    conf = Configure()
+    conf.batch.steps_per_launch = 128
+    conf.batch.value_stack_depth = 64
+    conf.batch.call_stack_depth = 16
+    conf.obs.enabled = True
+    # rate 1/s with burst 4: after 4 banked tokens, a tight loop of 10
+    # CANNOT be outrun by refill no matter how slow the CI machine is
+    # — the 429 assertion is deterministic, not a timing race
+    tenants = GatewayTenants.from_dict({"tenants": {
+        "flood": {"rate_per_s": 1.0, "burst": 4},
+        "t0": {}, "t1": {},
+    }})
+    t0 = time.perf_counter()
+    gw, svc = _start_gateway(conf, lanes=8, tenants=tenants)
+    checks = {}
+    try:
+        # registration rides a LISTED tenant: with a policy table
+        # present, unlisted tenants may not register (can_register)
+        st, doc, _ = _gateway_rpc(
+            gw.host, gw.port, "POST", "/v1/modules?name=echo&tenant=t0",
+            body=bench_echo.build_module(),
+            headers={"Content-Type": "application/wasm"})
+        checks["registered_over_http"] = st == 201
+        # mixed-tenant echo stream, async + poll (each request = 2
+        # fd_write hostcalls per iteration through the tier-1 drain)
+        ids = []
+        for i in range(12):
+            st, doc, _ = _gateway_rpc(
+                gw.host, gw.port, "POST", "/v1/invoke",
+                body={"module": "echo", "func": "echo", "args": [2],
+                      "tenant": f"t{i % 2}", "async": True})
+            if st == 202:
+                ids.append(doc["request_id"])
+        checks["accepted"] = len(ids) == 12
+        # flood one tenant past its token bucket: burst 4 at 1/s —
+        # a tight loop of 10 must draw at least one 429
+        flood_429 = 0
+        for _ in range(10):
+            st, doc, retry_after = _gateway_rpc(
+                gw.host, gw.port, "POST", "/v1/invoke",
+                body={"module": "echo", "func": "echo", "args": [1],
+                      "tenant": "flood", "async": True})
+            if st == 202:
+                ids.append(doc["request_id"])
+            elif st == 429:
+                flood_429 += 1
+                checks.setdefault("retry_after_header",
+                                  retry_after is not None)
+        checks["flood_saw_429"] = flood_429 >= 1
+        # every ACCEPTED request resolves ok
+        deadline = _time.monotonic() + 60.0
+        done = {}
+        while len(done) < len(ids) and _time.monotonic() < deadline:
+            for rid in ids:
+                if rid in done:
+                    continue
+                st, doc, _ = _gateway_rpc(gw.host, gw.port, "GET",
+                                          f"/v1/requests/{rid}")
+                if isinstance(doc, dict) \
+                        and doc.get("status") != "pending":
+                    done[rid] = (st, doc)
+            _time.sleep(0.02)
+        checks["all_resolved"] = len(done) == len(ids) and all(
+            st == 200 and doc.get("ok") for st, doc in done.values())
+        st, doc, _ = _gateway_rpc(gw.host, gw.port, "GET", "/v1/status")
+        checks["status_ok"] = st == 200 and doc.get("generation") == 1
+        st, text, _ = _gateway_rpc(gw.host, gw.port, "GET", "/metrics")
+        checks["metrics_has_http_counter"] = \
+            st == 200 and "wasmedge_gateway_http_requests_total" in text
+    finally:
+        gw.shutdown(drain=True, timeout_s=60.0)
+    checks["clean_shutdown"] = svc.status()["in_flight"] == 0 \
+        if "in_flight" in svc.status() else True
+    dt = time.perf_counter() - t0
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "gateway_smoke_http_echo",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "flood_429": flood_429,
+        "requests": len(ids),
+        "wall_s": round(dt, 3),
+    }))
+    return 0 if ok else 1
+
+
+def gateway_bench() -> int:
+    """`bench.py --gateway`: open- and closed-loop request streams over
+    real sockets against the HTTP gateway, reporting the latency SLO
+    numbers (p50/p99 via utils/bench_artifact.percentile), sustained
+    throughput, and reject/deadline counts.  Emits SERVE_r11.json.
+
+    closed loop: W workers, each a serial sync-invoke client — models
+    a fixed client population; throughput is the capacity number.
+    open loop: requests fired at a fixed arrival rate regardless of
+    completions — models external traffic; p99 shows queueing delay."""
+    import os
+    import threading
+    import time as _time
+
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.models import build_fib
+    from wasmedge_tpu.utils.bench_artifact import percentile
+
+    lanes = int(os.environ.get("GATEWAY_LANES", 32))
+    nreq = int(os.environ.get("GATEWAY_REQUESTS", 160))
+    workers = int(os.environ.get("GATEWAY_WORKERS", 8))
+    rate = float(os.environ.get("GATEWAY_RATE", 120.0))
+    deadline_ms = int(os.environ.get("GATEWAY_DEADLINE_MS", 30_000))
+
+    conf = Configure()
+    conf.batch.steps_per_launch = 2048
+    conf.batch.value_stack_depth = 128
+    conf.batch.call_stack_depth = 64
+    gw, svc = _start_gateway(conf, lanes=lanes)
+    st, doc, _ = _gateway_rpc(
+        gw.host, gw.port, "POST", "/v1/modules?name=fib",
+        body=build_fib(), headers={"Content-Type": "application/wasm"})
+    assert st == 201, doc
+    args = _serve_workload(seed=0, nreq=nreq, short_n=10, long_n=18,
+                           long_every=8)
+    counts = {"429": 0, "504": 0, "other": 0}
+    lock = threading.Lock()
+
+    def invoke(n, tenant, lat_sink, t_sched=None):
+        t_send = _time.monotonic()
+        st, doc, _ = _gateway_rpc(
+            gw.host, gw.port, "POST", "/v1/invoke",
+            body={"module": "fib", "func": "fib", "args": [int(n)],
+                  "tenant": tenant, "deadline_ms": deadline_ms})
+        t_done = _time.monotonic()
+        with lock:
+            if st == 200 and isinstance(doc, dict) and doc.get("ok"):
+                # open-loop latency anchors at the SCHEDULED send time:
+                # a client that falls behind its schedule still pays
+                lat_sink.append(t_done - (t_sched if t_sched is not None
+                                          else t_send))
+            elif st == 429:
+                counts["429"] += 1
+            elif st == 504:
+                counts["504"] += 1
+            else:
+                counts["other"] += 1
+
+    # --- closed loop: W serial clients, nreq total ---
+    closed_lat = []
+    per_worker = nreq // workers
+    t0 = _time.monotonic()
+    threads = []
+    for w in range(workers):
+        chunk = args[w * per_worker:(w + 1) * per_worker]
+
+        def drive(chunk=chunk, w=w):
+            for n in chunk:
+                invoke(n, f"t{w % 4}", closed_lat)
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    closed_wall = _time.monotonic() - t0
+    closed_n = workers * per_worker
+
+    # --- open loop: fixed arrival rate, one thread per in-flight req ---
+    open_lat = []
+    t0 = _time.monotonic()
+    threads = []
+    for i, n in enumerate(args):
+        t_sched = t0 + i / rate
+        now = _time.monotonic()
+        if t_sched > now:
+            _time.sleep(t_sched - now)
+        t = threading.Thread(target=invoke,
+                             args=(n, f"t{i % 4}", open_lat, t_sched),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    open_wall = _time.monotonic() - t0
+    gw.shutdown(drain=True, timeout_s=120.0)
+
+    closed_lat.sort()
+    open_lat.sort()
+    ok = bool(closed_lat and open_lat
+              and counts["other"] == 0
+              and len(closed_lat) + len(open_lat) + counts["429"]
+              + counts["504"] == closed_n + nreq)
+    out = {
+        "metric": "gateway_open_closed_loop_fib",
+        "value": round(closed_n / closed_wall, 1)
+        if closed_wall > 0 else 0.0,
+        "unit": "req/s",
+        "ok": ok,
+        "lanes": lanes,
+        "deadline_ms": deadline_ms,
+        "rejected_429": counts["429"],
+        "deadline_504": counts["504"],
+        "failed_other": counts["other"],
+        "closed_loop": {
+            "workers": workers,
+            "requests": closed_n,
+            "wall_s": round(closed_wall, 3),
+            "req_per_s": round(closed_n / closed_wall, 1),
+            "p50_latency_s": round(percentile(closed_lat, 0.5), 4)
+            if closed_lat else None,
+            "p99_latency_s": round(percentile(closed_lat, 0.99), 4)
+            if closed_lat else None,
+        },
+        "open_loop": {
+            "target_rate_per_s": rate,
+            "requests": nreq,
+            "wall_s": round(open_wall, 3),
+            "req_per_s": round(len(open_lat) / open_wall, 1)
+            if open_wall > 0 else 0.0,
+            "p50_latency_s": round(percentile(open_lat, 0.5), 4)
+            if open_lat else None,
+            "p99_latency_s": round(percentile(open_lat, 0.99), 4)
+            if open_lat else None,
+        },
+    }
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "SERVE_r11.json")
+    print(json.dumps(out))
+    print(f"# gateway lanes={lanes} closed={closed_n}req/"
+          f"{closed_wall:.2f}s open={nreq}req@{rate}/s/"
+          f"{open_wall:.2f}s 429={counts['429']} 504={counts['504']}",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main():
     eng = _build(LANES)
 
@@ -519,4 +800,8 @@ if __name__ == "__main__":
         sys.exit(serve_bench(smoke=True))
     if "--serve" in sys.argv[1:]:
         sys.exit(serve_bench())
+    if "--gateway-smoke" in sys.argv[1:]:
+        sys.exit(gateway_smoke())
+    if "--gateway" in sys.argv[1:]:
+        sys.exit(gateway_bench())
     main()

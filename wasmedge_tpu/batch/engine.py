@@ -262,6 +262,48 @@ from wasmedge_tpu.batch.tier0 import (  # noqa: F401
 )
 
 
+def check_batch_entry(inst, func_name: str) -> int:
+    """Resolve an exported batch entry on `inst` with the ONE entry
+    guard every batch front door shares (BatchEngine.run/export_func_idx
+    and the multi-module engine's qualified-name lookup): the export
+    must be a function and its signature must not carry v128 —
+    install()/harvest move only the 64-bit lo/hi cell halves, so a
+    v128 entry would silently compute garbage instead of failing
+    loudly.  Returns the instance-local function index."""
+    ex = inst.exports.get(func_name)
+    if ex is None or ex[0] != 0:
+        raise KeyError(f"no exported function {func_name}")
+    from wasmedge_tpu.common.types import ValType
+
+    ft = inst.funcs[ex[1]].functype
+    if ValType.V128 in tuple(ft.params) + tuple(ft.results):
+        raise ValueError(
+            "batch entry functions cannot take or return v128 "
+            f"({func_name})")
+    return ex[1]
+
+
+def pack_lane_args(args_lanes, lanes: int, depth: int):
+    """Entry arguments -> the (stack_lo, stack_hi) int32 planes: one
+    int64 cell per (arg, lane), scalars broadcast, shapes validated.
+    Shared by every lane-uniform state constructor (BatchEngine and the
+    multi-module engine, batch/multitenant.py)."""
+    stack_lo = np.zeros((depth, lanes), np.int32)
+    stack_hi = np.zeros((depth, lanes), np.int32)
+    for i, arg in enumerate(args_lanes):
+        arr = np.asarray(arg, dtype=np.int64)
+        if arr.ndim == 0:
+            arr = np.full(lanes, arr, np.int64)
+        if arr.shape != (lanes,):
+            raise ValueError(
+                f"arg {i}: expected shape ({lanes},) (one value per "
+                f"lane) or a scalar, got {arr.shape}")
+        stack_lo[i] = (arr & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+        stack_hi[i] = ((arr >> 32) & 0xFFFFFFFF).astype(np.uint32) \
+            .view(np.int32)
+    return stack_lo, stack_hi
+
+
 def t0_time_planes() -> np.ndarray:
     """Per-relaunch time base: (realtime, monotonic) ns as int32 (lo, hi).
 
@@ -1817,19 +1859,7 @@ class BatchEngine:
         meta = self.inst.lowered.funcs[func_idx]
         D = cfg.value_stack_depth
         CD = cfg.call_stack_depth
-        stack_lo = np.zeros((D, L), np.int32)
-        stack_hi = np.zeros((D, L), np.int32)
-        for i, arg in enumerate(args_lanes):
-            arr = np.asarray(arg, dtype=np.int64)
-            if arr.ndim == 0:
-                arr = np.full(L, arr, np.int64)
-            if arr.shape != (L,):
-                raise ValueError(
-                    f"arg {i}: expected shape ({L},) (one value per lane) "
-                    f"or a scalar, got {arr.shape}")
-            stack_lo[i] = (arr & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
-            stack_hi[i] = ((arr >> 32) & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
-        ng = img.globals_lo.shape[0]
+        stack_lo, stack_hi = pack_lane_args(args_lanes, L, D)
         mem_words = max(img.mem_pages_max * _PAGE_WORDS, 1)
         mem = np.zeros((mem_words, L), np.int32)
         if img.mem_init.shape[0] > 1 or img.mem_pages_init:
@@ -1863,17 +1893,7 @@ class BatchEngine:
 
     def run(self, func_name: str, args_lanes: List[np.ndarray],
             max_steps: int = 10_000_000) -> BatchResult:
-        ex = self.inst.exports.get(func_name)
-        if ex is None or ex[0] != 0:
-            raise KeyError(f"no exported function {func_name}")
-        func_idx = ex[1]
-        from wasmedge_tpu.common.types import ValType
-
-        ft = self.inst.funcs[func_idx].functype
-        if ValType.V128 in tuple(ft.params) + tuple(ft.results):
-            raise ValueError(
-                "batch entry functions cannot take or return v128 "
-                "(lane args are 64-bit cells)")
+        func_idx = self.export_func_idx(func_name)
         if self._run_chunk is None:
             self._build()
         self.hostcall_stats = new_hostcall_stats()
@@ -1907,6 +1927,21 @@ class BatchEngine:
         """Concatenated-image func index -> FunctionInstance (overridden by
         the multi-tenant engine, batch/multitenant.py)."""
         return self.inst.funcs[k]
+
+    def export_func_idx(self, func_name: str) -> int:
+        """Engine-global function index of an exported batch entry, with
+        the shared entry guard (v128 params/results cannot ride the
+        64-bit lane cells).  The serving layer's LaneRecycler resolves
+        names through this seam so multi-module engines
+        (batch/multitenant.py) can rebase qualified names onto the
+        concatenated index space.  Raises KeyError for an unknown
+        export, ValueError for a v128 signature."""
+        return check_batch_entry(self.inst, func_name)
+
+    def func_nresults(self, func_idx: int) -> int:
+        """Result arity of an engine-global function index (the other
+        half of the export_func_idx seam)."""
+        return int(self.inst.lowered.funcs[func_idx].nresults)
 
     def run_from_state(self, state, total: int, max_steps: int):
         """Chunk loop from an arbitrary state (used directly and by the

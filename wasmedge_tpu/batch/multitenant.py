@@ -339,10 +339,12 @@ class MultiTenantBatchEngine(BatchEngine):
             **self._r05_planes(),
         )
 
-    def _r05_planes(self) -> dict:
+    def _r05_planes(self, tsize: Optional[np.ndarray] = None) -> dict:
         """Concatenated-image variant of engine.r05_state_planes: the
-        tab plane holds every tenant's slot; tsize is per-lane (each
-        lane sees its own tenant's table size)."""
+        tab plane holds every tenant's slot; `tsize` is the per-lane
+        table-size vector — None derives the fixed-cohort default
+        (each tenant's slice sees its own table size); the serving
+        engine passes a lane-uniform vector instead."""
         import jax.numpy as jnp
 
         img = self.img
@@ -353,11 +355,12 @@ class MultiTenantBatchEngine(BatchEngine):
             tb = np.zeros((T, L), np.int32)
             n0 = min(img.table0.shape[0], T)
             tb[:n0] = img.table0[:n0, None]
-            tsz = np.zeros(L, np.int32)
-            for ti, t in enumerate(self.tenants):
-                tsz[self._tenant_slices[ti]] = t.img.table_size_init
+            if tsize is None:
+                tsize = np.zeros(L, np.int32)
+                for ti, t in enumerate(self.tenants):
+                    tsize[self._tenant_slices[ti]] = t.img.table_size_init
             out["tab"] = jnp.asarray(tb)
-            out["tsize"] = jnp.asarray(tsz)
+            out["tsize"] = jnp.asarray(np.asarray(tsize, np.int32))
         if bool(np.isin(img.cls, (CLS_TABLE_INIT, CLS_ELEM_DROP)).any()):
             out["edrop"] = jnp.zeros((img.elem_len.shape[0], L), jnp.int32)
         if bool(np.isin(img.cls, (CLS_MEMINIT, CLS_DATA_DROP)).any()):
@@ -483,6 +486,148 @@ class MultiTenantBatchEngine(BatchEngine):
             out.append(BatchResult(results=results, trap=trap[sl],
                                    retired=retired[sl], steps=total))
         return out
+
+
+class MultiModuleBatchEngine(MultiTenantBatchEngine):
+    """Serving-oriented concatenation: many modules, ANY lane, ANY entry.
+
+    `MultiTenantBatchEngine` packs a fixed cohort — each tenant owns a
+    contiguous lane slice initialized once at its own entry.  The
+    serving gateway needs the transpose: one long-lived lane pool where
+    a freed lane can be re-initialized onto ANY registered module's
+    exported function (the LaneRecycler `initial_state` template seam).
+    This engine keeps the pure-concatenation image (every module's
+    index spaces rebased into one super-image, so per-module execution
+    is bit-identical to a solo run) but makes `initial_state` lane-
+    UNIFORM per engine-global function index: entry pc/locals from the
+    owning module, that module's memory/table snapshot in every lane,
+    the full concatenated global plane (a fresh request resets its
+    lane's whole global column to init — fresh-instance semantics).
+
+    Entry names are qualified `module:func` (`export_func_idx`); an
+    unqualified name falls back to the first registered module, so a
+    one-module engine behaves like a plain BatchEngine under the
+    serving layer.  Hostcalls stay on the per-module tier-1 channel
+    (concatenated images carry no t0kind plane), which is what keeps
+    per-module WASI environs authoritative.
+
+    `modules` is an ordered [(name, inst, store)]; `lanes` is the
+    serving pool width (unrelated to any per-module cohort).
+    `engines` optionally supplies the per-module BatchEngines (one per
+    entry of `modules`, order-matched) so repeated generation builds
+    reuse the already-built-and-normalized DeviceImages instead of
+    re-lowering every registered module on each swap (the gateway's
+    registry caches one engine per module at registration time)."""
+
+    def __init__(self, modules: Sequence[Tuple[str, object, object]],
+                 conf=None, lanes: Optional[int] = None, engines=None):
+        if not modules:
+            raise ValueError("no modules")
+        names = [name for name, _, _ in modules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate module names in {names}")
+        tenants = []
+        for k, (name, inst, store) in enumerate(modules):
+            # per-module BatchEngine: builds + normalizes the module's
+            # own DeviceImage (raises ValueError when not batchable);
+            # lanes=1 — only the image is used, never its state
+            eng = engines[k] if engines is not None \
+                else BatchEngine(inst, store=store, conf=conf, lanes=1)
+            tenants.append(Tenant(engine=eng, func_name="",
+                                  args_lanes=[], lanes=0))
+        super().__init__(tenants, conf=conf)
+        self.lanes = int(lanes) if lanes else self.cfg.lanes
+        self.module_names = list(names)
+        self._mod_index = {name: ti for ti, name in enumerate(names)}
+
+    # -- the export_func_idx / func_nresults seam (serve/recycle.py) ------
+    def export_func_idx(self, func_name: str) -> int:
+        from wasmedge_tpu.batch.engine import check_batch_entry
+
+        mod, sep, fn = func_name.partition(":")
+        if not sep:
+            mod, fn = self.module_names[0], func_name
+        ti = self._mod_index.get(mod)
+        if ti is None:
+            raise KeyError(f"no registered module {mod!r}")
+        try:
+            local = check_batch_entry(self.tenants[ti].inst, fn)
+        except KeyError:
+            raise KeyError(
+                f"no exported function {fn!r} in module {mod!r}") \
+                from None
+        return local + self.bases[ti]["func"]
+
+    def func_nresults(self, func_idx: int) -> int:
+        return int(self.img.f_nresults[func_idx])
+
+    def func_owner(self, func_idx: int) -> str:
+        """Owning module name of an engine-global function index."""
+        return self.module_names[self._func_owner[func_idx]]
+
+    def exported_funcs(self, module: str) -> List[str]:
+        return self.tenants[self._mod_index[module]].inst.func_names()
+
+    # -- lane-uniform entry state (the recycler's template source) --------
+    def initial_state(self, func_idx: int = 0, args_lanes=None
+                      ) -> BatchState:
+        import jax.numpy as jnp
+
+        from wasmedge_tpu.batch.engine import pack_lane_args
+
+        args_lanes = args_lanes or []
+        cfg = self.cfg
+        L = self.lanes
+        img = self.img
+        ti = self._func_owner[func_idx]
+        t = self.tenants[ti]
+        meta = t.inst.lowered.funcs[func_idx - self.bases[ti]["func"]]
+        D = cfg.value_stack_depth
+        CD = cfg.call_stack_depth
+        stack_lo, stack_hi = pack_lane_args(args_lanes, L, D)
+        # plane geometry is function-INDEPENDENT (the pool's lanes are
+        # recycled across modules): memory sized to the concatenated
+        # image's max, initialized with the owning module's snapshot
+        mem_words = max(img.mem_pages_max * _PAGE_WORDS, 1)
+        mem = np.zeros((mem_words, L), np.int32)
+        pages = 0
+        if t.img.has_memory:
+            pages = t.img.mem_pages_init
+            n = min(t.img.mem_init.shape[0], mem_words)
+            mem[:n] = t.img.mem_init[:n, None]
+        fuel0 = cfg.fuel_per_launch if cfg.fuel_per_launch is not None \
+            else 0
+        return BatchState(
+            pc=jnp.full((L,), int(img.f_entry[func_idx]), jnp.int32),
+            sp=jnp.full((L,), meta.nlocals, jnp.int32),
+            fp=jnp.zeros(L, jnp.int32),
+            opbase=jnp.full((L,), meta.nlocals, jnp.int32),
+            call_depth=jnp.zeros(L, jnp.int32),
+            trap=jnp.zeros(L, jnp.int32),
+            retired=jnp.zeros(L, jnp.int32),
+            fuel=jnp.full(L, fuel0, jnp.int32),
+            mem_pages=jnp.full((L,), pages, jnp.int32),
+            stack_lo=jnp.asarray(stack_lo),
+            stack_hi=jnp.asarray(stack_hi),
+            fr_ret_pc=jnp.zeros((CD, L), jnp.int32),
+            fr_fp=jnp.zeros((CD, L), jnp.int32),
+            fr_opbase=jnp.zeros((CD, L), jnp.int32),
+            glob_lo=jnp.asarray(
+                np.repeat(img.globals_lo[:, None], L, axis=1)),
+            glob_hi=jnp.asarray(
+                np.repeat(img.globals_hi[:, None], L, axis=1)),
+            mem=jnp.asarray(mem),
+            stack_e2=jnp.zeros((D, L), jnp.int32) if img.has_simd
+            else None,
+            stack_e3=jnp.zeros((D, L), jnp.int32) if img.has_simd
+            else None,
+            # lane-uniform tsize: every lane sees the owning module's
+            # table size (the tab plane still holds every module's
+            # slot — table ops address slots through the rebased
+            # instruction words)
+            **self._r05_planes(
+                np.full(L, t.img.table_size_init, np.int32)),
+        )
 
 
 def run_mixed(specs, conf=None, max_steps: int = 10_000_000):

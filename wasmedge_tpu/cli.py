@@ -387,7 +387,6 @@ def serve_command(argv: List[str], out=None, err=None) -> int:
     lo_a = p._opts["arg-min"].value
     hi_a = max(p._opts["arg-max"].value, lo_a)
     deadline_ms = p._opts["deadline-ms"].value
-    from wasmedge_tpu.serve import QueueSaturated
 
     futures = []
     t0 = _time.monotonic()
@@ -401,7 +400,12 @@ def serve_command(argv: List[str], out=None, err=None) -> int:
                         deadline_s=deadline_ms / 1000.0
                         if deadline_ms is not None else None))
                     break
-                except QueueSaturated:
+                except WasmError as e:
+                    # the structured rejection contract: only a
+                    # retryable rejection (backpressure) is worth a
+                    # retry — permanent conditions re-raise unchanged
+                    if not e.retryable:
+                        raise
                     # backpressure: serve a round to free queue space
                     if not server.step():
                         if server.failed is not None:
@@ -455,6 +459,136 @@ def serve_command(argv: List[str], out=None, err=None) -> int:
         + c["killed"] == nreq + nadopted else 1
 
 
+def _gateway_parser() -> ArgumentParser:
+    p = ArgumentParser("wasmedge-tpu gateway",
+                       "network-facing multi-tenant serving gateway: "
+                       "HTTP invoke/poll, runtime module registration, "
+                       "per-tenant auth/rate/quota")
+    p.add_option(["host"], Option("bind address", "addr",
+                                  default="127.0.0.1"))
+    p.add_option(["port"], Option("bind port (0 = ephemeral; the bound "
+                                  "port is printed)", "n", typ=int,
+                                  default=8080))
+    p.add_option(["lanes"], Option("device lanes per serving generation",
+                                   "n", typ=int, default=64))
+    p.add_option(["module"],
+                 ListOpt("preload a guest module as NAME=PATH "
+                         "(repeatable; more can be registered at "
+                         "runtime via POST /v1/modules)", "name=path"))
+    p.add_option(["tenants"],
+                 Option("tenant policy file (JSON or .toml): api keys, "
+                        "weights, quotas, rate limits", "file"))
+    p.add_option(["queue-capacity"],
+                 Option("bounded request queue capacity "
+                        "(backpressure -> 429)", "n", typ=int))
+    p.add_option(["obs"],
+                 Toggle("enable the flight recorder (gateway/<tenant> "
+                        "spans, drain histograms; served at /metrics)"))
+    p.add_option(["duration"],
+                 Option("serve for N seconds then drain and exit "
+                        "(default: until SIGINT)", "s", typ=float))
+    p.add_positional("wasm_file", "guest module registered as 'main'",
+                     required=False)
+    return p
+
+
+def gateway_command(argv: List[str], out=None, err=None) -> int:
+    """`wasmedge-tpu gateway [app.wasm] [options]`: serve the gateway
+    until SIGINT (or --duration), printing one JSON line with the
+    bound address at startup and one summary line at shutdown."""
+    import json
+    import time as _time
+
+    out = out or sys.stdout
+    err = err or sys.stderr
+    p = _gateway_parser()
+    try:
+        if not p.parse(argv, out):
+            return 0
+        if p.rest:   # same trailing-options idiom as serve_command
+            trailing, p.rest = p.rest, []
+            if not p.parse(trailing, out):
+                return 0
+            if p.rest:
+                raise ValueError(f"unexpected argument {p.rest[0]!r}")
+    except ValueError as e:
+        err.write(f"wasmedge-tpu: {e}\n")
+        return 2
+    conf = Configure()
+    conf.host_registrations.add(HostRegistration.Wasi)
+    if p._opts["queue-capacity"].seen:
+        conf.serve.queue_capacity = p._opts["queue-capacity"].value
+    if p._opts["obs"].value:
+        conf.obs.enabled = True
+
+    from wasmedge_tpu.gateway import Gateway, GatewayService, \
+        GatewayTenants
+
+    tenants = None
+    if p._opts["tenants"].seen:
+        try:
+            tenants = GatewayTenants.from_file(p._opts["tenants"].value)
+        except (OSError, ValueError, KeyError) as e:
+            err.write(f"wasmedge-tpu: bad tenants file: {e}\n")
+            return 2
+    svc = GatewayService(conf=conf, lanes=p._opts["lanes"].value,
+                         tenants=tenants)
+    boot = []
+    if p.positional_values:
+        boot.append(("main", p.positional_values[0]))
+    for spec in p._opts["module"].value:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            err.write(f"wasmedge-tpu: bad --module {spec!r} "
+                      f"(want NAME=PATH)\n")
+            return 2
+        boot.append((name, path))
+    entries = []
+    for name, path in boot:
+        try:
+            with open(path, "rb") as f:
+                entries.append((name, f.read()))
+        except OSError as e:
+            err.write(f"wasmedge-tpu: cannot read {path}: {e}\n")
+            return 1
+    if entries:
+        try:
+            # ONE generation for the whole boot set — not a build-and-
+            # drain per module
+            svc.preload(entries)
+        except (WasmError, ValueError) as e:
+            err.write(f"wasmedge-tpu: boot module rejected: {e}\n")
+            return 1
+    try:
+        gw = Gateway(svc, host=p._opts["host"].value,
+                     port=p._opts["port"].value).start()
+    except OSError as e:
+        err.write(f"wasmedge-tpu: cannot bind: {e}\n")
+        return 1
+    out.write(json.dumps({
+        "listening": f"http://{gw.host}:{gw.port}",
+        "modules": svc.registry.names,
+        "lanes": svc.lanes,
+        "tenants": sorted(svc.tenants.policies),
+    }) + "\n")
+    out.flush()
+    duration = p._opts["duration"].value
+    try:
+        if duration is not None:
+            _time.sleep(duration)
+        else:
+            while True:
+                _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.shutdown(drain=True)
+    st = svc.status()
+    out.write(json.dumps({"metric": "gateway_exit",
+                          **st["gateway"], "http": st["http"]}) + "\n")
+    return 0
+
+
 def compile_command(argv: List[str], out=None, err=None) -> int:
     out = out or sys.stdout
     err = err or sys.stderr
@@ -499,9 +633,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         sys.stdout.write(
-            "usage: wasmedge-tpu [run|serve|compile|version] ...\n"
+            "usage: wasmedge-tpu [run|serve|gateway|compile|version] ...\n"
             "  run      run a wasm file (default when first arg is a file)\n"
             "  serve    continuous-batching serving over device lanes\n"
+            "  gateway  HTTP multi-tenant serving gateway (runtime module\n"
+            "           registration, per-tenant auth/rate/quota)\n"
             "  compile  precompile to a universal twasm artifact\n"
             "  version  print version\n")
         return 0
@@ -510,6 +646,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_command(rest)
     if cmd == "serve":
         return serve_command(rest)
+    if cmd == "gateway":
+        return gateway_command(rest)
     if cmd == "compile":
         return compile_command(rest)
     if cmd == "version":

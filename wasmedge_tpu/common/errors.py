@@ -121,7 +121,17 @@ TRAP_MESSAGES = {
 class WasmError(Exception):
     """Base for all phase errors; carries an ErrCode and an ErrInfo record
     chain (reference: include/common/errinfo.h:1-299 — context records
-    attached as the error unwinds, printed by the CLI)."""
+    attached as the error unwinds, printed by the CLI).
+
+    `retryable` is the machine-readable half of the rejection contract:
+    True means the SAME request can succeed later (transient
+    backpressure — QueueSaturated sets it), False means retrying
+    verbatim can never help (traps, permanent admission blocks,
+    deadline expiry).  Callers branch on the flag, never on message
+    text; the gateway maps it onto HTTP 429-vs-terminal and the CLI's
+    backpressure loop retries only when it is set."""
+
+    retryable: bool = False
 
     def __init__(self, code: ErrCode, msg: str = "", offset: int | None = None):
         self.code = ErrCode(code)
@@ -179,3 +189,28 @@ class EngineFailure(WasmError):
 
 def trap(code: ErrCode, msg: str = ""):
     raise TrapError(code, msg)
+
+
+def rejection_info(exc: BaseException) -> dict:
+    """Structured machine-readable view of a rejection: stable ErrCode
+    value + name, the retryable flag, an optional retry-after hint, and
+    the human message LAST (clients must never parse it).  Non-WasmError
+    exceptions map to ExecutionFailed/non-retryable so every failure
+    path yields the same shape."""
+    if isinstance(exc, WasmError):
+        out = {
+            "code": int(exc.code),
+            "name": exc.code.name,
+            "retryable": bool(getattr(exc, "retryable", False)),
+            "message": str(exc),
+        }
+        after = getattr(exc, "retry_after_s", None)
+        if after is not None:
+            out["retry_after_s"] = float(after)
+        return out
+    return {
+        "code": int(ErrCode.ExecutionFailed),
+        "name": ErrCode.ExecutionFailed.name,
+        "retryable": False,
+        "message": f"{type(exc).__name__}: {exc}",
+    }

@@ -1,0 +1,30 @@
+"""Network-facing multi-tenant serving gateway (r11).
+
+The front door of the "millions of users" story: a stdlib HTTP server
+(gateway/http.py) over a generation-swapped fleet of BatchServers
+(gateway/service.py), with runtime guest-module registration through
+the full loader -> validator -> image pipeline (gateway/registry.py)
+and per-tenant auth/rate/quota edge policy (gateway/tenants.py).
+
+    from wasmedge_tpu.gateway import Gateway, GatewayService
+
+    svc = GatewayService(lanes=64)
+    svc.register_module("fib", wasm_bytes=data)
+    gw = Gateway(svc, port=8080).start()
+    # POST /v1/invoke {"module": "fib", "func": "fib", "args": [30]}
+
+or `wasmedge-tpu gateway app.wasm --port 8080` from the CLI.
+"""
+
+from wasmedge_tpu.gateway.http import Gateway  # noqa: F401
+from wasmedge_tpu.gateway.registry import ModuleRegistry  # noqa: F401
+from wasmedge_tpu.gateway.service import (  # noqa: F401
+    GatewayRequest,
+    GatewayService,
+)
+from wasmedge_tpu.gateway.tenants import (  # noqa: F401
+    AuthError,
+    GatewayTenants,
+    RateLimited,
+    TenantPolicy,
+)

@@ -1,0 +1,220 @@
+"""Runtime guest-module registry for the serving gateway.
+
+`POST /v1/modules` lands here: raw Wasm bytes go through the SAME
+loader -> validator -> executor -> DeviceImage pipeline every other
+entry point uses (no gateway-special compilation path), each module in
+its own StoreManager with its own WASI instance (the per-tenant
+sandbox model of batch/multitenant.py), and the registry's current
+module set concatenates into one `MultiModuleBatchEngine` per serving
+generation (`build_engine`).
+
+Registration is VALIDATING: a module that fails to parse, validate,
+instantiate, or batch (build_device_image raises for v128 entries,
+cross-module table refs, ...) is rejected with the load/validation
+ErrCode taxonomy and never reaches an engine — the serving generations
+only ever see known-good images.
+
+Guest stdout/stderr are sunk to /dev/null by default: a network server
+must not let thousands of guest lanes write to ITS stdout.  (A later
+PR can stream fd_write output back over the wire; the per-module
+WasiEnviron here is exactly the seam for it.)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from wasmedge_tpu.common.errors import ErrCode, WasmError
+
+
+class RegisteredModule:
+    """One registered guest: its instantiated module + private store,
+    plus the per-module BatchEngine built once at registration (the
+    normalized DeviceImage every later generation concatenation
+    reuses — registering module N must not re-lower modules 1..N-1)."""
+
+    __slots__ = ("name", "inst", "store", "engine", "sha256", "nbytes",
+                 "source", "_sink_fds")
+
+    def __init__(self, name, inst, store, engine, sha256="", nbytes=0,
+                 source="boot", sink_fds=()):
+        self.name = name
+        self.inst = inst
+        self.store = store
+        self.engine = engine
+        self.sha256 = sha256
+        self.nbytes = nbytes
+        self.source = source
+        self._sink_fds = list(sink_fds)
+
+    def exported_funcs(self) -> List[str]:
+        return self.inst.func_names()
+
+    def close(self):
+        import os
+
+        for fd in self._sink_fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._sink_fds = []
+
+
+class ModuleRegistry:
+    """Ordered name -> RegisteredModule map + engine builder."""
+
+    def __init__(self, conf=None, sink_stdout: bool = True):
+        from wasmedge_tpu.common.configure import Configure
+
+        self.conf = conf or Configure()
+        self.sink_stdout = sink_stdout
+        self._mods: Dict[str, RegisteredModule] = {}
+        self._order: List[str] = []
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    @property
+    def names(self) -> List[str]:
+        return list(self._order)
+
+    def get(self, name: str) -> Optional[RegisteredModule]:
+        return self._mods.get(name)
+
+    # -- registration ------------------------------------------------------
+    def add_wasm(self, name: str, data: bytes,
+                 source: str = "http") -> RegisteredModule:
+        """Validate + compile + instantiate `data` and register it under
+        `name`.  Raises WasmError(ModuleNameConflict) for a duplicate
+        name, Load/Validation/Instantiation errors for bad wasm, and
+        ValueError for a module the batch pipeline cannot image."""
+        self._check_name(name)
+        from wasmedge_tpu.executor import Executor
+        from wasmedge_tpu.loader import Loader
+        from wasmedge_tpu.runtime.store import StoreManager
+        from wasmedge_tpu.validator import Validator
+
+        data = bytes(data)
+        mod = Validator(self.conf).validate(
+            Loader(self.conf).parse_module(data))
+        store = StoreManager()
+        ex = Executor(self.conf)
+        sinks = self._register_wasi(ex, store, name)
+        try:
+            inst = ex.instantiate(store, mod)
+            # prove batchability NOW (image build raises on v128
+            # entries, non-local table refs, ...) so a bad module 400s
+            # at POST time instead of sinking the next generation
+            # build — and KEEP the engine: its normalized image is
+            # what every later generation concatenates
+            from wasmedge_tpu.batch.engine import BatchEngine
+
+            eng = BatchEngine(inst, store=store, conf=self.conf,
+                              lanes=1)
+        except BaseException:
+            # the sink fds were opened before instantiation — a
+            # rejected module (unlinkable import, unbatchable image)
+            # must not leak two fds per POST
+            import os
+
+            for fd in sinks:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            raise
+        rm = RegisteredModule(
+            name, inst, store, eng,
+            sha256=hashlib.sha256(data).hexdigest(),
+            nbytes=len(data), source=source, sink_fds=sinks)
+        return self._install(rm)
+
+    def add_instance(self, name: str, inst, store,
+                     source: str = "boot") -> RegisteredModule:
+        """Register an already-instantiated module (the VM/CLI boot
+        path); batchability is proven the same way as add_wasm."""
+        self._check_name(name)
+        from wasmedge_tpu.batch.engine import BatchEngine
+
+        eng = BatchEngine(inst, store=store, conf=self.conf, lanes=1)
+        return self._install(RegisteredModule(name, inst, store, eng,
+                                              source=source))
+
+    def remove(self, name: str):
+        rm = self._mods.pop(name, None)
+        if rm is not None:
+            self._order.remove(name)
+            rm.close()
+
+    def _check_name(self, name: str):
+        if not name or ":" in name or "/" in name:
+            raise WasmError(ErrCode.IllegalPath,
+                            f"invalid module name {name!r} (non-empty, "
+                            f"no ':' or '/')")
+        if name in self._mods:
+            raise WasmError(ErrCode.ModuleNameConflict,
+                            f"module {name!r} already registered")
+
+    def _install(self, rm: RegisteredModule) -> RegisteredModule:
+        with self._lock:
+            if rm.name in self._mods:   # lost a registration race
+                rm.close()
+                raise WasmError(ErrCode.ModuleNameConflict,
+                                f"module {rm.name!r} already registered")
+            self._mods[rm.name] = rm
+            self._order.append(rm.name)
+        return rm
+
+    def _register_wasi(self, ex, store, prog_name: str) -> List[int]:
+        """A fresh per-module WASI instance (per-module environ =
+        per-module sandbox), stdout/stderr sunk to /dev/null when
+        configured.  Registered unconditionally — modules that import
+        nothing are unaffected, modules importing
+        wasi_snapshot_preview1 resolve."""
+        import os
+
+        from wasmedge_tpu.host.wasi import WasiModule
+
+        wasi = WasiModule()
+        wasi.init_wasi(dirs=[], prog_name=prog_name)
+        sinks = []
+        if self.sink_stdout:
+            for fd in (1, 2):
+                e = wasi.env.fds.get(fd)
+                if e is not None:
+                    sink = os.open(os.devnull, os.O_WRONLY)
+                    e.os_fd = sink
+                    sinks.append(sink)
+        ex.register_import_object(store, wasi)
+        return sinks
+
+    # -- engine builder ----------------------------------------------------
+    def modules_snapshot(self) -> List[RegisteredModule]:
+        with self._lock:
+            return [self._mods[n] for n in self._order]
+
+    def build_engine(self, conf, lanes: int):
+        """Concatenated multi-module engine over the CURRENT module set
+        (one serving generation's engine; gateway/service.py swaps
+        generations at a launch boundary).  The per-module engines
+        cached at registration time are reused, so a swap costs one
+        image concatenation — not a re-lower of every module."""
+        from wasmedge_tpu.batch.multitenant import MultiModuleBatchEngine
+
+        mods = self.modules_snapshot()
+        if not mods:
+            raise WasmError(ErrCode.WrongVMWorkflow,
+                            "no modules registered")
+        return MultiModuleBatchEngine(
+            [(rm.name, rm.inst, rm.store) for rm in mods],
+            conf=conf, lanes=lanes,
+            engines=[rm.engine for rm in mods])
+
+    def close(self):
+        with self._lock:
+            for rm in self._mods.values():
+                rm.close()

@@ -1,0 +1,414 @@
+"""GatewayService: multi-tenant serving generations over BatchServer.
+
+The long-lived core the HTTP layer (gateway/http.py) is a thin skin
+over.  One *generation* = one `MultiModuleBatchEngine` (the
+concatenated image of every registered module, batch/multitenant.py)
+driven by one `BatchServer` on a background thread.  Runtime module
+registration is a **generation swap**:
+
+    POST /v1/modules
+      -> registry.add_wasm()       (loader -> validator -> image, 400s
+                                    on bad/unbatchable wasm)
+      -> build generation N+1      (image rebuilt WITH the new module;
+                                    freed lanes recycle onto the new
+                                    function via the LaneRecycler /
+                                    initial_state template seam)
+      -> atomic pointer swap       (new submissions -> generation N+1)
+      -> generation N drains       (in-flight AND queued requests
+                                    finish on the OLD image — results
+                                    stay bit-identical to solo runs —
+                                    then the old server shuts down at
+                                    its launch boundary)
+
+The swap is wait-free for submitters: the swap holds the submit lock
+only for the pointer write; the expensive parts (validation, image
+concatenation) happen outside it, and the new engine's first jit
+compile happens on its serving thread's first launch.
+
+Request lifecycle: `submit()` stamps a GatewayRequest into the stash
+keyed by the process-global request id (shared with ServeFuture), so
+`202 Accepted` clients poll `GET /v1/requests/<id>` against the same
+object the sync path waits on.  Resolved requests are kept for
+`result_cache` completions and then pruned oldest-first.
+
+Observability (off by default, like every other obs track): a
+`gateway/<tenant>` span per request (receive -> resolve, with the
+module/func/outcome in args) on the shared flight recorder, plus
+`wasmedge_gateway_http_requests_total{code}` counters in the
+Prometheus export fed by the HTTP layer's `count_http`.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from wasmedge_tpu.common.errors import ErrCode, WasmError
+from wasmedge_tpu.gateway.registry import ModuleRegistry
+from wasmedge_tpu.gateway.tenants import GatewayTenants
+
+
+class GatewayClosed(WasmError):
+    """The gateway is shutting down — distinct from a tenant's
+    permanent admission block (both ride ErrCode.Terminated): the HTTP
+    layer maps THIS to 503 (restarting service, come back) and the
+    admission block to 403 (your policy forbids it, don't)."""
+
+    def __init__(self):
+        super().__init__(ErrCode.Terminated, "gateway shut down")
+
+
+class GatewayRequest:
+    """Stash entry for one gateway request (sync waiters and async
+    pollers share it)."""
+
+    __slots__ = ("id", "tenant", "module", "func", "future", "t_recv",
+                 "gen_id", "finalized")
+
+    def __init__(self, future, tenant, module, func, gen_id, t_recv):
+        self.id = future.request_id
+        self.future = future
+        self.tenant = tenant
+        self.module = module
+        self.func = func
+        self.gen_id = gen_id
+        self.t_recv = t_recv
+        self.finalized = False
+
+
+class _Generation:
+    __slots__ = ("gen_id", "engine", "server", "modules")
+
+    def __init__(self, gen_id, engine, server, modules):
+        self.gen_id = gen_id
+        self.engine = engine
+        self.server = server
+        self.modules = tuple(modules)
+
+
+class GatewayService:
+    """The gateway's engine room (transport-free; see gateway/http.py).
+
+    `conf` is the template Configure every generation deep-copies (the
+    BatchServer mutates serve knobs on its copy); `tenants` the edge
+    policy table; `lanes` the per-generation serving pool width."""
+
+    def __init__(self, conf=None, lanes: int = 64,
+                 tenants: Optional[GatewayTenants] = None,
+                 result_cache: int = 4096,
+                 sync_wait_s: float = 60.0,
+                 sink_stdout: bool = True):
+        from wasmedge_tpu.common.configure import Configure
+        from wasmedge_tpu.obs.recorder import recorder_of
+
+        self.template = conf or Configure()
+        # instantiate the shared ring BEFORE any generation deepcopies
+        # its Configure, so every generation reports into ONE recorder
+        self.obs = recorder_of(self.template)
+        self.lanes = int(lanes)
+        self.tenants = tenants or GatewayTenants()
+        self.registry = ModuleRegistry(conf=self.template,
+                                       sink_stdout=sink_stdout)
+        self.result_cache = int(result_cache)
+        self.sync_wait_s = float(sync_wait_s)
+        self._lock = threading.RLock()
+        self._reg_lock = threading.Lock()   # one registration at a time
+        self._gens: List[_Generation] = []  # current is last
+        self._gen_seq = 0
+        self._reapers: List[threading.Thread] = []
+        self._requests: Dict[int, GatewayRequest] = {}
+        self._resolved = deque()
+        self._closed = False
+        self.http_counts: Dict[str, int] = {}
+        self.counters = {
+            "received": 0, "completed": 0, "failed": 0, "deadline": 0,
+            "rejected": 0, "rate_limited": 0, "registered_modules": 0,
+            "generations": 0,
+        }
+
+    # -- generations -------------------------------------------------------
+    @property
+    def current(self) -> Optional[_Generation]:
+        with self._lock:
+            return self._gens[-1] if self._gens else None
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._gens[-1].gen_id if self._gens else 0
+
+    def _build_generation(self) -> _Generation:
+        from wasmedge_tpu.serve.server import BatchServer
+
+        conf = copy.deepcopy(self.template)
+        if conf.serve.autotune:
+            # the tuner reads the drain-latency histograms: the flag
+            # must flip BEFORE the engine captures its recorder, or
+            # the engine holds NULL_RECORDER forever and autotune is a
+            # silent no-op (the injected-engine path cannot fix this
+            # up afterwards the way BatchServer's own build can)
+            conf.obs.enabled = True
+        engine = self.registry.build_engine(conf, self.lanes)
+        server = BatchServer(engine=engine,
+                             weights=self.tenants.weights(),
+                             quotas=self.tenants.quotas())
+        self._gen_seq += 1
+        self.counters["generations"] += 1
+        return _Generation(self._gen_seq, engine, server,
+                           self.registry.names)
+
+    def _swap_in(self, gen: _Generation):
+        """Install `gen` as current; the displaced generation drains in
+        the background (its in-flight lanes finish on the old image at
+        their own launch boundaries) and is reaped once idle."""
+        gen.server.start()
+        with self._lock:
+            old = self._gens[-1] if self._gens else None
+            self._gens.append(gen)
+        if old is not None:
+            t = threading.Thread(target=self._drain_old, args=(old,),
+                                 name=f"gw-drain-gen{old.gen_id}",
+                                 daemon=True)
+            t.start()
+            self._reapers.append(t)
+        self.obs.instant("generation_swap", cat="gateway",
+                         track="gateway", generation=gen.gen_id,
+                         modules=list(gen.modules))
+
+    def _drain_old(self, old: _Generation):
+        try:
+            old.server.shutdown(drain=True)
+        finally:
+            with self._lock:
+                if old in self._gens:
+                    self._gens.remove(old)
+
+    # -- module registration ----------------------------------------------
+    def register_module(self, name: str, wasm_bytes: Optional[bytes] = None,
+                        inst=None, store=None,
+                        source: str = "http") -> dict:
+        """Register a module and swap in a fresh generation.  Either
+        raw `wasm_bytes` (the HTTP path: full validation pipeline) or a
+        pre-instantiated (inst, store) pair (the VM/CLI boot path)."""
+        return self._register([(name, wasm_bytes, inst, store)],
+                              source=source)
+
+    def preload(self, entries, source: str = "boot") -> dict:
+        """Register several modules with ONE generation build — the
+        boot path (`--module a=.. --module b=..`) must not pay for and
+        immediately drain N-1 throwaway generations.  `entries` is
+        [(name, wasm_bytes)]."""
+        return self._register([(n, b, None, None) for n, b in entries],
+                              source=source)
+
+    def _register(self, entries, source: str) -> dict:
+        with self._reg_lock:
+            if self._closed:
+                raise GatewayClosed()
+            added = []
+            try:
+                for name, wasm_bytes, inst, store in entries:
+                    if wasm_bytes is not None:
+                        rm = self.registry.add_wasm(name, wasm_bytes,
+                                                    source=source)
+                    else:
+                        rm = self.registry.add_instance(name, inst,
+                                                        store,
+                                                        source=source)
+                    added.append(rm)
+                gen = self._build_generation()
+            except BaseException:
+                # never leave a module registered that no generation
+                # serves — the registry and the serving set must agree
+                for rm in added:
+                    self.registry.remove(rm.name)
+                raise
+            self._swap_in(gen)
+        with self._lock:
+            self.counters["registered_modules"] += len(added)
+        last = added[-1]
+        return {
+            "module": last.name,
+            "sha256": last.sha256,
+            "exports": last.exported_funcs(),
+            "generation": gen.gen_id,
+            "modules": list(gen.modules),
+        }
+
+    # -- requests ----------------------------------------------------------
+    def submit(self, func: str, args, module: Optional[str] = None,
+               tenant: str = "default",
+               deadline_s: Optional[float] = None) -> GatewayRequest:
+        """Edge admission: rate limit, then the current generation's
+        BatchServer.  Raises RateLimited, QueueSaturated (retryable),
+        KeyError (unknown module/func), or the serving taxonomy."""
+        from wasmedge_tpu.gateway.tenants import RateLimited
+
+        try:
+            self.tenants.check_rate(tenant)
+        except RateLimited:
+            with self._lock:
+                self.counters["rate_limited"] += 1
+            raise
+        with self._lock:
+            if self._closed:
+                raise GatewayClosed()
+            gen = self._gens[-1] if self._gens else None
+        if gen is None:
+            raise KeyError("no modules registered")
+        qualified = f"{module}:{func}" if module else func
+        t_recv = time.monotonic()
+        while True:
+            try:
+                fut = gen.server.submit(qualified, args, tenant=tenant,
+                                        deadline_s=deadline_s)
+                break
+            except WasmError:
+                # a submit can race a generation swap: the generation
+                # captured above starts DRAINING the moment its
+                # successor is installed, and rejects submissions with
+                # a permanent (non-retryable) error.  That rejection
+                # belongs to the stale generation, not the request —
+                # re-resolve and retry on the successor.  Only a
+                # still-current generation's rejection is authoritative.
+                with self._lock:
+                    cur = self._gens[-1] if self._gens else None
+                    closed = self._closed
+                if cur is gen or cur is None:
+                    with self._lock:
+                        self.counters["rejected"] += 1
+                    if closed:
+                        # the generation rejected because the GATEWAY
+                        # is going down, not because of the tenant's
+                        # policy — surface the lifecycle class (503)
+                        raise GatewayClosed() from None
+                    raise
+                gen = cur
+            except BaseException:
+                with self._lock:
+                    self.counters["rejected"] += 1
+                raise
+        req = GatewayRequest(fut, tenant, module, qualified, gen.gen_id,
+                             t_recv)
+        with self._lock:
+            self.counters["received"] += 1
+            self._requests[req.id] = req
+        self.obs.instant("gateway_receive", cat="gateway",
+                         track="gateway", id=req.id, tenant=tenant,
+                         func=qualified)
+        return req
+
+    def get_request(self, request_id: int) -> Optional[GatewayRequest]:
+        with self._lock:
+            req = self._requests.get(int(request_id))
+        if req is not None:
+            self.finalize(req)
+        return req
+
+    def wait(self, req: GatewayRequest,
+             timeout_s: Optional[float] = None) -> bool:
+        """Block on the request's future (the sync-invoke path); the
+        gateway-level cap applies when the caller sets none."""
+        done = req.future.wait(self.sync_wait_s if timeout_s is None
+                               else timeout_s)
+        if done:
+            self.finalize(req)
+        return done
+
+    def finalize(self, req: GatewayRequest):
+        """Account + trace a completed request exactly once (called
+        from every path that observes completion, and by the pruning
+        sweep for never-polled async requests)."""
+        if req.finalized or not req.future.done:
+            return
+        with self._lock:
+            if req.finalized:
+                return
+            req.finalized = True
+            self._resolved.append(req.id)
+            err = req.future.error
+            from wasmedge_tpu.serve.queue import DeadlineExceeded
+
+            if err is None:
+                self.counters["completed"] += 1
+            elif isinstance(err, DeadlineExceeded):
+                self.counters["deadline"] += 1
+            else:
+                self.counters["failed"] += 1
+            while len(self._resolved) > self.result_cache:
+                self._requests.pop(self._resolved.popleft(), None)
+        self.obs.span(f"gateway/{req.tenant}", req.t_recv,
+                      cat="gateway", track="gateway", id=req.id,
+                      func=req.func, generation=req.gen_id,
+                      ok=req.future.error is None)
+
+    def sweep(self):
+        """Finalize any resolved-but-unpolled async requests (keeps the
+        gateway spans/counters complete without a per-future callback
+        seam; called from status/metrics)."""
+        with self._lock:
+            pending = [r for r in self._requests.values()
+                       if not r.finalized and r.future.done]
+        for r in pending:
+            self.finalize(r)
+
+    # -- edge accounting ---------------------------------------------------
+    def count_http(self, code: int):
+        with self._lock:
+            key = str(int(code))
+            self.http_counts[key] = self.http_counts.get(key, 0) + 1
+
+    # -- introspection -----------------------------------------------------
+    def status(self) -> dict:
+        self.sweep()
+        with self._lock:
+            gen = self._gens[-1] if self._gens else None
+            draining = max(len(self._gens) - 1, 0)
+            out = {
+                "generation": gen.gen_id if gen else 0,
+                "modules": {
+                    name: self.registry.get(name).exported_funcs()
+                    for name in (gen.modules if gen else ())},
+                "lanes": self.lanes,
+                "draining_generations": draining,
+                "gateway": dict(self.counters),
+                "http": dict(self.http_counts),
+                "tenants": sorted(self.tenants.policies),
+            }
+            if gen is not None:
+                out["queue_depth"] = len(gen.server.queue)
+                out["in_flight"] = gen.server.in_flight
+                out["serve"] = dict(gen.server.counters)
+        return out
+
+    def metrics_text(self) -> str:
+        self.sweep()
+        from wasmedge_tpu.obs.metrics import render_prometheus
+
+        gen = self.current
+        return render_prometheus(
+            recorder=self.obs if self.obs.enabled else None,
+            hostcall_stats=gen.engine.hostcall_stats if gen else None,
+            http_requests=dict(self.http_counts))
+
+    # -- lifecycle ---------------------------------------------------------
+    def shutdown(self, drain: bool = True,
+                 timeout_s: Optional[float] = None):
+        # _reg_lock first: an in-flight registration finishes its swap
+        # (its generation lands in the snapshot below) and later ones
+        # see _closed — otherwise a generation swapped in after the
+        # snapshot would keep serving on registry fds close() is about
+        # to invalidate, while shutdown() reports a clean stop
+        with self._reg_lock:
+            with self._lock:
+                self._closed = True
+                gens = list(self._gens)
+        for g in gens:
+            g.server.shutdown(drain=drain, timeout_s=timeout_s)
+        for t in self._reapers:
+            t.join(timeout=5.0)
+        self.sweep()
+        self.registry.close()

@@ -57,12 +57,21 @@ class _Writer:
 
 
 def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
-                      failures=None) -> str:
+                      failures=None, http_requests=None) -> str:
     """Render one metrics snapshot.  All sources optional: `recorder` a
     FlightRecorder, `stats` a common.statistics.Statistics, `hostcall_stats`
     an engine's pipeline counter dict, `failures` extra FailureRecords
-    (e.g. statistics.recent_failures()) merged into the taxonomy counts."""
+    (e.g. statistics.recent_failures()) merged into the taxonomy counts,
+    `http_requests` the gateway's {status_code: count} edge tally."""
     w = _Writer()
+
+    if http_requests:
+        w.head("wasmedge_gateway_http_requests_total", "counter",
+               "Gateway HTTP responses by status code "
+               "(wasmedge_tpu/gateway/).")
+        for code in sorted(http_requests):
+            w.sample("wasmedge_gateway_http_requests_total",
+                     {"code": str(code)}, int(http_requests[code]))
 
     if stats is not None:
         w.head("wasmedge_instructions_total", "counter",
@@ -172,11 +181,13 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
 
 
 def export_prometheus(path, recorder=None, stats=None,
-                      hostcall_stats=None, failures=None) -> str:
+                      hostcall_stats=None, failures=None,
+                      http_requests=None) -> str:
     """Render and write a metrics snapshot to `path` (or file-like)."""
     text = render_prometheus(recorder=recorder, stats=stats,
                              hostcall_stats=hostcall_stats,
-                             failures=failures)
+                             failures=failures,
+                             http_requests=http_requests)
     if hasattr(path, "write"):
         path.write(text)
     else:

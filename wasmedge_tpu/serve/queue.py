@@ -30,16 +30,40 @@ from wasmedge_tpu.common.errors import ErrCode, WasmError
 
 
 class QueueSaturated(WasmError):
-    """The bounded request queue is full — backpressure, try later."""
+    """The bounded request queue is full — backpressure, try later.
 
-    def __init__(self, msg: str = "serve queue saturated"):
+    The ONE retryable rejection in the serving taxonomy: `retryable`
+    is the machine-readable contract (common/errors.rejection_info)
+    callers branch on instead of the exception type or message text,
+    and `retry_after_s` is an optional hint for when capacity is
+    expected (the gateway forwards it as HTTP Retry-After)."""
+
+    retryable = True
+
+    def __init__(self, msg: str = "serve queue saturated",
+                 retry_after_s: Optional[float] = None):
         super().__init__(ErrCode.CostLimitExceeded, msg)
+        self.retry_after_s = retry_after_s
 
 
 class DeadlineExceeded(WasmError):
-    """The request's deadline passed before it completed."""
+    """The request's deadline passed before it completed.  Never
+    retryable: the deadline belonged to THIS request; the caller must
+    issue a new one if the work still matters."""
 
     def __init__(self, msg: str = "request deadline exceeded"):
+        super().__init__(ErrCode.Terminated, msg)
+
+
+class ServeRejected(WasmError):
+    """The serving LIFECYCLE rejected an accepted request before (or
+    instead of) running it — non-drain shutdown, or the stall sweep
+    for a request that can never be admitted.  Distinct from a guest
+    trap so result consumers (the gateway's status mapping) never
+    present an infrastructure rejection as \"the guest ran and
+    trapped\"."""
+
+    def __init__(self, msg: str):
         super().__init__(ErrCode.Terminated, msg)
 
 
@@ -122,6 +146,10 @@ def advance_request_ids(past_id: int):
         _req_ids = itertools.count(max(nxt, int(past_id) + 1))
 
 
+INT64_MIN = -(1 << 63)
+INT64_MAX = (1 << 63) - 1
+
+
 class ServeRequest:
     """One lane's worth of work (immutable once submitted)."""
 
@@ -136,7 +164,17 @@ class ServeRequest:
         self.id = int(request_id) if request_id is not None \
             else _next_request_id()
         self.func_name = func_name
-        self.args = tuple(int(a) for a in args)
+        args = tuple(int(a) for a in args)
+        for a in args:
+            # lane cells are 64-bit: an unrepresentable arg must be
+            # rejected HERE, at submission — np.int64 conversion at
+            # admission would raise OverflowError on the SERVING
+            # thread and terminally fail the whole generation (every
+            # tenant's in-flight work) for one bad request
+            if not (INT64_MIN <= a <= INT64_MAX):
+                raise ValueError(
+                    f"arg {a} does not fit a 64-bit lane cell")
+        self.args = args
         self.tenant = tenant
         self.deadline = deadline      # monotonic stamp, None = none
         self.t_submit = t_submit      # monotonic stamp (admission latency)

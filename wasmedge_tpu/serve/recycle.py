@@ -47,31 +47,21 @@ class LaneRecycler:
     def func_idx(self, func_name: str) -> int:
         # memoized like _nres/_templates: harvest calls this once per
         # retired lane and submit once per request, all under the
-        # server lock — the export lookup + v128 signature scan only
-        # needs to happen once per name
+        # server lock — the export lookup + v128 signature scan
+        # (engine.export_func_idx: single-module names on BatchEngine,
+        # "module:func" qualified names on the multi-module engine)
+        # only needs to happen once per name
         idx = self._fidx.get(func_name)
         if idx is not None:
             return idx
-        ex = self.engine.inst.exports.get(func_name)
-        if ex is None or ex[0] != 0:
-            raise KeyError(f"no exported function {func_name}")
-        # mirror BatchEngine.run's entry guard: install()/harvest_cells
-        # move only the 64-bit lo/hi cell halves, so a v128 entry would
-        # silently compute garbage instead of failing loudly
-        from wasmedge_tpu.common.types import ValType
-
-        ft = self.engine.inst.funcs[ex[1]].functype
-        if ValType.V128 in tuple(ft.params) + tuple(ft.results):
-            raise ValueError(
-                "batch entry functions cannot take or return v128 "
-                f"({func_name})")
-        self._fidx[func_name] = ex[1]
-        return ex[1]
+        idx = self.engine.export_func_idx(func_name)
+        self._fidx[func_name] = idx
+        return idx
 
     def nresults(self, func_idx: int) -> int:
         n = self._nres.get(func_idx)
         if n is None:
-            n = int(self.engine.inst.lowered.funcs[func_idx].nresults)
+            n = self.engine.func_nresults(func_idx)
             self._nres[func_idx] = n
         return n
 

@@ -61,6 +61,7 @@ from wasmedge_tpu.serve.queue import (
     FairQueue,
     QueueSaturated,
     ServeFuture,
+    ServeRejected,
     ServeRequest,
 )
 from wasmedge_tpu.serve.recycle import LaneRecycler
@@ -76,25 +77,40 @@ class BatchServer:
     `checkpoint_dir` lineage: the serving state and its in-flight
     requests come back under fresh futures (`server.adopted`)."""
 
-    def __init__(self, inst, store=None, conf=None, lanes: Optional[int] = None,
+    def __init__(self, inst=None, store=None, conf=None,
+                 lanes: Optional[int] = None,
                  stats=None, weights=None, quotas=None, faults=None,
                  checkpoint_dir: Optional[str] = None,
-                 resume: bool = False):
+                 resume: bool = False, engine=None):
         from wasmedge_tpu.common.configure import Configure
         from wasmedge_tpu.batch.engine import BatchEngine
         from wasmedge_tpu.obs.recorder import recorder_of
 
-        # the server owns its knobs (autotune mutates steps_per_launch);
-        # the shared flight recorder's identity survives the deepcopy
-        self.conf = copy.deepcopy(conf) if conf is not None else Configure()
-        self.k = self.conf.serve
-        if self.k.autotune:
-            # the tuner feeds on the tier-1 drain-latency histograms;
-            # with the recorder off it would silently never fire (the
-            # CLI forces the same pairing)
-            self.conf.obs.enabled = True
-        self.engine = BatchEngine(inst, store=store, conf=self.conf,
-                                  lanes=lanes)
+        if engine is not None:
+            # pre-built engine (the gateway's multi-module concatenated
+            # engine, gateway/): its Configure governs the run, and the
+            # CALLER must hand a dedicated copy — the server mutates
+            # serve/autotune knobs on it (inst/store/lanes are the
+            # engine's own)
+            self.conf = engine.conf
+            self.k = self.conf.serve
+            if self.k.autotune:
+                self.conf.obs.enabled = True
+            self.engine = engine
+        else:
+            # the server owns its knobs (autotune mutates
+            # steps_per_launch); the shared flight recorder's identity
+            # survives the deepcopy
+            self.conf = copy.deepcopy(conf) if conf is not None \
+                else Configure()
+            self.k = self.conf.serve
+            if self.k.autotune:
+                # the tuner feeds on the tier-1 drain-latency
+                # histograms; with the recorder off it would silently
+                # never fire (the CLI forces the same pairing)
+                self.conf.obs.enabled = True
+            self.engine = BatchEngine(inst, store=store, conf=self.conf,
+                                      lanes=lanes)
         self.lanes = self.engine.lanes
         self.obs = recorder_of(self.conf)
         self.stats = stats
@@ -304,8 +320,7 @@ class BatchServer:
                 # with a non-backpressure error
                 for req in self.queue.pop_all():
                     self.counters["rejected"] += 1
-                    req.future._reject(WasmError(
-                        ErrCode.Terminated,
+                    req.future._reject(ServeRejected(
                         f"request {req.id} can never be admitted "
                         f"(tenant {req.tenant!r} admission-blocked)"))
                 return False
@@ -413,7 +428,7 @@ class BatchServer:
             else:
                 self._thread = None
         with self._lock:
-            err = WasmError(ErrCode.Terminated, "server shut down")
+            err = ServeRejected("server shut down")
             for req in list(self._bindings.values()):
                 if not req.future.done:
                     self.counters["killed"] += 1   # terminated in flight

@@ -332,6 +332,31 @@ class VM:
                            stats=self.stat, weights=weights, quotas=quotas,
                            checkpoint_dir=checkpoint_dir, resume=resume)
 
+    def gateway(self, host: str = "127.0.0.1", port: int = 0,
+                lanes: Optional[int] = None, tenants=None,
+                module_name: str = "main"):
+        """Network-facing serving gateway over the instantiated module
+        (wasmedge_tpu/gateway/): returns an UNSTARTED Gateway whose
+        HTTP surface exposes POST /v1/invoke, async polling, runtime
+        module registration (POST /v1/modules — more guests join the
+        concatenated multi-module image at generation swaps), and
+        /metrics / /v1/status.  This VM's module is pre-registered as
+        `module_name`.  `tenants` is a gateway.GatewayTenants policy
+        table (auth/rate/quota/weight); call `.start()` on the result
+        and `.shutdown()` to drain."""
+        from wasmedge_tpu.gateway import Gateway, GatewayService
+
+        with self._lock:
+            if self._active is None or self.stage != VMStage.Instantiated:
+                raise WasmError(ErrCode.WrongVMWorkflow, "no instantiated module")
+            inst = self._active
+        conf = batch_conf_with_gas(self.conf, self.stat)
+        svc = GatewayService(conf=conf, lanes=lanes or 64,
+                             tenants=tenants)
+        svc.register_module(module_name, inst=inst, store=self.store,
+                            source="vm")
+        return Gateway(svc, host=host, port=port)
+
     def _export_obs(self, rec, eng=None, trace_out=None,
                     metrics_out=None):
         """Fold recorder aggregates into this VM's Statistics and write
