@@ -301,6 +301,156 @@ def trace_smoke() -> int:
     return 0 if ok else 1
 
 
+def analyze_smoke() -> int:
+    """`bench.py --analyze-smoke`: the static-analyzer CI guard.
+
+    1. Analyze the echo + fib bench fixtures; every report must
+       validate against the wasmedge-tpu/analysis/v1 schema, with the
+       expected verdicts (both unbounded: echo loops, fib recurses).
+    2. Soundness against a REAL run: per-invocation static cost bound
+       >= the engine's measured retired instructions — trivially for
+       the unbounded fixtures (bound = +inf), and meaningfully for a
+       bounded straight-line/call fixture whose finite bound must
+       dominate the measured count.
+    3. A policy-enabled gateway must reject a crafted unbounded-loop
+       module at POST /v1/modules with the structured
+       StaticPolicyViolation taxonomy (HTTP 400 + violations list),
+       while admitting a bounded module.
+
+    Prints ONE JSON line; emits no benchmark artifact."""
+    import bench_echo
+    from wasmedge_tpu.analysis import analyze_validated, validate_report
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.gateway import GatewayTenants
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.models import build_fib
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.utils.builder import ModuleBuilder
+    from wasmedge_tpu.validator import Validator
+
+    t0 = time.perf_counter()
+    checks = {}
+
+    def analyzed(data):
+        conf = Configure()
+        mod = Validator(conf).validate(Loader(conf).parse_module(data))
+        return mod, analyze_validated(mod)
+
+    # 1. fixtures analyze + schema-validate with the expected verdicts
+    _, a_echo = analyzed(bench_echo.build_module())
+    _, a_fib = analyzed(build_fib())
+    checks["echo_schema_ok"] = not validate_report(a_echo.to_dict())
+    checks["fib_schema_ok"] = not validate_report(a_fib.to_dict())
+    checks["echo_unbounded_loop"] = a_echo.cost_bound is None \
+        and any(f.has_loop for f in a_echo.funcs)
+    checks["fib_unbounded_recursion"] = a_fib.cost_bound is None \
+        and any(f.recursive for f in a_fib.funcs)
+    checks["echo_tier0_fd_write"] = a_echo.tier0_sites == 2 \
+        and a_echo.drain_sites == 0
+
+    # 2. soundness vs a real run.  The unbounded fixtures satisfy the
+    # bound as +inf; the bounded fixture pins the finite case.
+    def bound_of(a):
+        return float("inf") if a.cost_bound is None else a.cost_bound
+
+    b = ModuleBuilder()
+    leaf = b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("i32.const", 3), "i32.mul"])
+    b.add_function(["i32"], ["i32"], [], [
+        ("local.get", 0), ("i32.const", 2), "i32.lt_s",
+        ("if", "i32"),
+        ("local.get", 0), ("call", leaf),
+        "else",
+        ("local.get", 0), ("i32.const", 5), "i32.add", ("call", leaf),
+        "end",
+    ], export="f")
+    bounded_wasm = b.build()
+    mod_b, a_bounded = analyzed(bounded_wasm)
+    checks["bounded_schema_ok"] = not validate_report(
+        a_bounded.to_dict())
+    conf = Configure()
+    conf.batch.steps_per_launch = 64
+    conf.batch.value_stack_depth = 32
+    conf.batch.call_stack_depth = 8
+    store = StoreManager()
+    inst = Executor(conf).instantiate(store, mod_b)
+    eng = BatchEngine(inst, store=store, conf=conf, lanes=4)
+    res = eng.run("f", [np.array([0, 1, 5, 9], np.int64)],
+                  max_steps=10_000)
+    checks["bounded_run_completed"] = bool(res.completed.all())
+    checks["bound_ge_retired"] = a_bounded.cost_bound is not None \
+        and a_bounded.cost_bound >= int(res.retired.max())
+    checks["image_carries_analysis"] = \
+        getattr(eng.img, "analysis", None) is not None \
+        and eng.img.analysis.cost_bound == a_bounded.cost_bound
+
+    # fib under the engine too: bound_of(+inf) >= anything, but the run
+    # proves the fixtures the analyzer vetted are the ones that execute
+    conf_f = Configure()
+    conf_f.batch.steps_per_launch = 4096
+    conf_f.batch.value_stack_depth = 128
+    conf_f.batch.call_stack_depth = 64
+    mod_f = Validator(conf_f).validate(
+        Loader(conf_f).parse_module(build_fib()))
+    store_f = StoreManager()
+    inst_f = Executor(conf_f).instantiate(store_f, mod_f)
+    eng_f = BatchEngine(inst_f, store=store_f, conf=conf_f, lanes=4)
+    res_f = eng_f.run("fib", [np.full(4, 10, np.int64)],
+                      max_steps=1_000_000)
+    checks["fib_bound_ge_retired"] = bool(res_f.completed.all()) \
+        and bound_of(a_fib) >= int(res_f.retired.max())
+
+    # 3. policy-enabled gateway rejects the crafted unbounded module
+    bldr = ModuleBuilder()
+    bldr.add_function(["i32"], ["i32"], [], [
+        ("block", None), ("loop", None), ("br", 0), "end", "end",
+        ("local.get", 0)], export="spin")
+    unbounded_wasm = bldr.build()
+    conf_g = Configure()
+    conf_g.batch.steps_per_launch = 128
+    tenants = GatewayTenants.from_dict(
+        {"analysis": {"max_static_cost": 1_000_000,
+                      "max_memory_pages": 16}})
+    gw, svc = _start_gateway(conf_g, lanes=2, tenants=tenants)
+    try:
+        st, doc, _ = _gateway_rpc(
+            gw.host, gw.port, "POST", "/v1/modules?name=spin",
+            body=unbounded_wasm,
+            headers={"Content-Type": "application/wasm"})
+        checks["gateway_rejects_unbounded"] = (
+            st == 400 and isinstance(doc, dict)
+            and doc.get("err", {}).get("name") == "StaticPolicyViolation"
+            and any(v.get("limit") == "max_static_cost"
+                    for v in doc.get("err", {}).get("violations", [])))
+        st, doc, _ = _gateway_rpc(
+            gw.host, gw.port, "POST", "/v1/modules?name=ok",
+            body=bounded_wasm,
+            headers={"Content-Type": "application/wasm"})
+        checks["gateway_admits_bounded"] = st == 201 \
+            and isinstance(doc, dict) \
+            and doc.get("analysis", {}).get("bounded") is True
+        st, text, _ = _gateway_rpc(gw.host, gw.port, "GET", "/metrics")
+        checks["metrics_has_analysis_counters"] = st == 200 \
+            and "wasmedge_analysis_policy_rejections_total 1" in text
+    finally:
+        gw.shutdown(drain=True, timeout_s=60.0)
+    dt = time.perf_counter() - t0
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "analyze_smoke_static_soundness",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "bounded_cost_bound": a_bounded.cost_bound,
+        "bounded_retired_max": int(res.retired.max()),
+        "wall_s": round(dt, 3),
+    }))
+    return 0 if ok else 1
+
+
 def _serve_workload(seed: int, nreq: int, short_n: int, long_n: int,
                     long_every: int):
     """Seeded mixed request stream: mostly short fib(short_n) with a
@@ -800,6 +950,8 @@ if __name__ == "__main__":
         sys.exit(serve_bench(smoke=True))
     if "--serve" in sys.argv[1:]:
         sys.exit(serve_bench())
+    if "--analyze-smoke" in sys.argv[1:]:
+        sys.exit(analyze_smoke())
     if "--gateway-smoke" in sys.argv[1:]:
         sys.exit(gateway_smoke())
     if "--gateway" in sys.argv[1:]:

@@ -36,6 +36,10 @@ def pytest_configure(config):
         "markers", "serve: continuous-batching serving suite (request "
         "queue, lane recycling, fairness; tier-1 fast, runs under "
         "-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "analysis: static bytecode analyzer suite (CFG/"
+        "cost/divergence reports, gateway admission policy; tier-1 "
+        "fast, runs under -m 'not slow')")
 
 
 def pytest_addoption(parser):

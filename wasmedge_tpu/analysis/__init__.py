@@ -1,0 +1,30 @@
+"""Static bytecode analysis over the validated/lowered image.
+
+Three consumers share one analysis (built once per module lowering):
+
+  - `wasmedge-tpu analyze mod.wasm` — JSON report + annotated disasm
+  - `DeviceImage.analysis` — attached at image-build time, block
+    metadata for the superinstruction/fusion tier (ROADMAP #3) and the
+    divergence scheduler (ROADMAP #5)
+  - gateway admission — `POST /v1/modules` evaluates the report
+    against per-tenant AnalysisPolicy limits (analysis/policy.py)
+"""
+
+from wasmedge_tpu.analysis.analyzer import (  # noqa: F401
+    SCHEMA,
+    FuncAnalysis,
+    HostcallSite,
+    ModuleAnalysis,
+    analyze_module,
+    analyze_validated,
+)
+from wasmedge_tpu.analysis.cfg import (  # noqa: F401
+    BasicBlock,
+    FuncCFG,
+    build_func_cfg,
+)
+from wasmedge_tpu.analysis.policy import (  # noqa: F401
+    AnalysisPolicy,
+    AnalysisRejection,
+)
+from wasmedge_tpu.analysis.report import validate_report  # noqa: F401

@@ -1,0 +1,160 @@
+"""Admission policy over ModuleAnalysis — the gateway's static vetting.
+
+A tenant file (gateway/tenants.py) may carry an `analysis` table,
+either per-tenant or top-level (the default for tenants without their
+own):
+
+    {
+      "analysis": {"max_static_cost": 1000000, "max_memory_pages": 16},
+      "tenants": {
+        "alice": {"api_key": "sk-alice",
+                  "analysis": {"require_bounded": true,
+                               "tier0_only_hostcalls": true}}
+      }
+    }
+
+`POST /v1/modules` evaluates the already-built image's ModuleAnalysis
+(one lowering, shared with the batchability probe) against the
+registering tenant's policy.  Violations reject with the structured
+ErrCode taxonomy (StaticPolicyViolation -> HTTP 400, violations list
+in the body) — or, with `"enforce": false`, register the module and
+return the violations as `analysis_warnings` (flag, don't block).
+
+The runtime backstops stay what they were (per-request step budgets,
+lane quarantine): this layer refuses work the runtime would have had
+to kill, before it ever owns a lane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from wasmedge_tpu.common.errors import ErrCode, WasmError
+
+
+class AnalysisRejection(WasmError):
+    """A module's static bounds exceed the registering tenant's policy.
+    Carries the machine-readable violation list (rejection_info
+    includes it, so HTTP bodies show limit/allowed/actual per item)."""
+
+    def __init__(self, module: str, violations: List[dict]):
+        limits = ", ".join(v["limit"] for v in violations) or "policy"
+        super().__init__(
+            ErrCode.StaticPolicyViolation,
+            f"module {module!r} rejected by static admission policy "
+            f"({limits})")
+        self.violations = list(violations)
+
+
+def _violation(limit: str, allowed, actual, message: str) -> dict:
+    return {"limit": limit, "allowed": allowed,
+            "actual": "unbounded" if actual is None else actual,
+            "message": message}
+
+
+@dataclasses.dataclass
+class AnalysisPolicy:
+    """Static-bound limits one tenant imposes on modules it registers.
+    All limits optional; None/False = not enforced."""
+
+    # Reject modules whose per-invocation retired-instruction bound is
+    # unbounded (loops/recursion with no static exit) or exceeds this.
+    max_static_cost: Optional[int] = None
+    # Reject unbounded modules even without a numeric cost cap ("no
+    # unbounded loops unless a gas budget bounds them at runtime").
+    require_bounded: bool = False
+    # Static memory footprint: reject when the page bound (declared max
+    # when grow sites exist, initial pages otherwise) is unbounded or
+    # over this — the resident-lane HBM budget (ROADMAP #4).
+    max_memory_pages: Optional[int] = None
+    # Value-stack / frame-depth bounds along the static call graph.
+    max_value_stack: Optional[int] = None
+    max_call_depth: Optional[int] = None
+    # Reject modules with drain-required hostcall sites (imports the
+    # kernels cannot service in-kernel — every one is a device<->host
+    # round trip a hostile module can spin).
+    tier0_only_hostcalls: bool = False
+    # False = flag mode: violations are reported, never rejected.
+    enforce: bool = True
+
+    _KNOWN = frozenset((
+        "max_static_cost", "require_bounded", "max_memory_pages",
+        "max_value_stack", "max_call_depth", "tier0_only_hostcalls",
+        "enforce"))
+
+    @classmethod
+    def from_dict(cls, d: dict, where: str = "analysis") \
+            -> "AnalysisPolicy":
+        bad = set(d) - cls._KNOWN
+        if bad:
+            raise ValueError(
+                f"{where}: unknown analysis policy keys {sorted(bad)}")
+
+        def _int(key):
+            return int(d[key]) if d.get(key) is not None else None
+
+        return cls(
+            max_static_cost=_int("max_static_cost"),
+            require_bounded=bool(d.get("require_bounded", False)),
+            max_memory_pages=_int("max_memory_pages"),
+            max_value_stack=_int("max_value_stack"),
+            max_call_depth=_int("max_call_depth"),
+            tier0_only_hostcalls=bool(d.get("tier0_only_hostcalls",
+                                            False)),
+            enforce=bool(d.get("enforce", True)))
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self, analysis) -> List[dict]:
+        """Violations of this policy by a ModuleAnalysis (empty = admit).
+        `analysis` None (analyzer unavailable for the image) violates
+        every enforced limit category at once — a policy-carrying
+        tenant never admits an unvetted module."""
+        out: List[dict] = []
+        if analysis is None:
+            if self.max_static_cost is not None or self.require_bounded \
+                    or self.max_memory_pages is not None \
+                    or self.max_value_stack is not None \
+                    or self.max_call_depth is not None \
+                    or self.tier0_only_hostcalls:
+                out.append(_violation(
+                    "analysis", "required", "missing",
+                    "no static analysis available for this module"))
+            return out
+        cost = analysis.cost_bound
+        if self.require_bounded and cost is None:
+            out.append(_violation(
+                "require_bounded", "bounded", None,
+                "static cost bound is unbounded (loop/recursion/"
+                "dynamic call with no static exit)"))
+        if self.max_static_cost is not None and \
+                (cost is None or cost > self.max_static_cost):
+            out.append(_violation(
+                "max_static_cost", self.max_static_cost, cost,
+                "per-invocation retired-instruction bound over limit"))
+        if self.max_memory_pages is not None:
+            pages = analysis.mem_pages_bound
+            if pages is None or pages > self.max_memory_pages:
+                out.append(_violation(
+                    "max_memory_pages", self.max_memory_pages, pages,
+                    "static linear-memory page bound over the "
+                    "resident-lane budget"))
+        if self.max_value_stack is not None:
+            vs = analysis.value_stack_bound
+            if vs is None or vs > self.max_value_stack:
+                out.append(_violation(
+                    "max_value_stack", self.max_value_stack, vs,
+                    "value-stack depth bound over the lane plane "
+                    "budget"))
+        if self.max_call_depth is not None:
+            cd = analysis.call_depth_bound
+            if cd is None or cd > self.max_call_depth:
+                out.append(_violation(
+                    "max_call_depth", self.max_call_depth, cd,
+                    "frame-depth bound over the lane plane budget"))
+        if self.tier0_only_hostcalls and analysis.drain_sites > 0:
+            out.append(_violation(
+                "tier0_only_hostcalls", 0, analysis.drain_sites,
+                "module has drain-required hostcall sites (imports "
+                "outside the in-kernel tier-0 set)"))
+        return out

@@ -1,0 +1,145 @@
+"""Report schema for the analyze CLI / --analyze-smoke CI guard.
+
+Hand-rolled structural checker (the container bakes no jsonschema):
+`validate_report(doc)` returns a list of problem strings, empty when
+the document matches the `wasmedge-tpu/analysis/v1` shape emitted by
+ModuleAnalysis.to_dict().  The smoke guard and tests/test_analysis.py
+run every emitted report through it, so the wire shape cannot drift
+silently."""
+
+from __future__ import annotations
+
+from typing import List
+
+from wasmedge_tpu.analysis.analyzer import SCHEMA
+
+
+def _is_bound(v) -> bool:
+    return v is None or (isinstance(v, int) and not isinstance(v, bool)
+                         and v >= 0)
+
+
+def _req(doc, key, typ, problems, where):
+    if key not in doc:
+        problems.append(f"{where}: missing key {key!r}")
+        return None
+    v = doc[key]
+    if typ is int and isinstance(v, bool):
+        problems.append(f"{where}.{key}: expected int, got bool")
+        return None
+    if not isinstance(v, typ):
+        problems.append(f"{where}.{key}: expected {typ}, "
+                        f"got {type(v).__name__}")
+        return None
+    return v
+
+
+def validate_report(doc) -> List[str]:
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["report: not an object"]
+    if doc.get("schema") != SCHEMA:
+        problems.append(f"schema: expected {SCHEMA!r}, "
+                        f"got {doc.get('schema')!r}")
+    code_len = _req(doc, "code_len", int, problems, "report")
+    _req(doc, "n_funcs", int, problems, "report")
+    _req(doc, "exports", dict, problems, "report")
+
+    summary = _req(doc, "summary", dict, problems, "report")
+    if summary is not None:
+        _req(summary, "bounded", bool, problems, "summary")
+        for key in ("cost_bound", "value_stack_bound",
+                    "call_depth_bound", "mem_pages_bound"):
+            if key not in summary:
+                problems.append(f"summary: missing key {key!r}")
+            elif not _is_bound(summary[key]):
+                problems.append(f"summary.{key}: not a bound "
+                                f"(int >= 0 or null)")
+        if summary.get("bounded") and summary.get("cost_bound") is None:
+            problems.append("summary: bounded=true with null cost_bound")
+        if not summary.get("bounded", True) \
+                and summary.get("cost_bound") is not None:
+            problems.append("summary: bounded=false with a cost_bound")
+
+    mem = _req(doc, "memory", dict, problems, "report")
+    if mem is not None:
+        for key in ("pages_init", "pages_max_declared", "grow_sites"):
+            _req(mem, key, int, problems, "memory")
+        if "pages_bound" not in mem or not _is_bound(mem["pages_bound"]):
+            problems.append("memory.pages_bound: not a bound")
+
+    hc = _req(doc, "hostcalls", dict, problems, "report")
+    if hc is not None:
+        _req(hc, "imports", list, problems, "hostcalls")
+        for key in ("tier0_sites", "drain_sites", "dynamic_call_sites"):
+            _req(hc, key, int, problems, "hostcalls")
+
+    supers = _req(doc, "superinstructions", list, problems, "report")
+    if supers is not None:
+        for i, c in enumerate(supers):
+            where = f"superinstructions[{i}]"
+            if not isinstance(c, dict):
+                problems.append(f"{where}: not an object")
+                continue
+            ops = _req(c, "ops", list, problems, where)
+            n = _req(c, "n", int, problems, where)
+            _req(c, "count", int, problems, where)
+            _req(c, "weight", int, problems, where)
+            if ops is not None and n is not None and len(ops) != n:
+                problems.append(f"{where}: len(ops) != n")
+            if ops is not None and not all(isinstance(o, str)
+                                           for o in ops):
+                problems.append(f"{where}.ops: non-string opcode name")
+
+    funcs = _req(doc, "funcs", list, problems, "report")
+    if funcs is not None:
+        for fi, f in enumerate(funcs):
+            where = f"funcs[{fi}]"
+            if not isinstance(f, dict):
+                problems.append(f"{where}: not an object")
+                continue
+            _req(f, "idx", int, problems, where)
+            _req(f, "name", str, problems, where)
+            entry = _req(f, "entry_pc", int, problems, where)
+            end = _req(f, "end_pc", int, problems, where)
+            bounded = _req(f, "bounded", bool, problems, where)
+            for key in ("cost_bound", "value_stack_bound",
+                        "call_depth_bound"):
+                if key not in f or not _is_bound(f[key]):
+                    problems.append(f"{where}.{key}: not a bound")
+            if bounded is not None and "cost_bound" in f:
+                if bounded != (f["cost_bound"] is not None):
+                    problems.append(
+                        f"{where}: bounded flag disagrees with "
+                        f"cost_bound")
+            blocks = _req(f, "blocks", list, problems, where)
+            if blocks is None or entry is None or end is None:
+                continue
+            starts = set()
+            for bi, b in enumerate(blocks):
+                bw = f"{where}.blocks[{bi}]"
+                if not isinstance(b, dict):
+                    problems.append(f"{bw}: not an object")
+                    continue
+                s = _req(b, "start", int, problems, bw)
+                e = _req(b, "end", int, problems, bw)
+                succ = _req(b, "succ", list, problems, bw)
+                _req(b, "cost", int, problems, bw)
+                _req(b, "divergence", int, problems, bw)
+                if s is not None:
+                    starts.add(s)
+                if s is not None and e is not None and \
+                        not (entry <= s <= e <= end):
+                    problems.append(f"{bw}: range outside function")
+                if code_len is not None and e is not None \
+                        and e >= code_len:
+                    problems.append(f"{bw}: end past code_len")
+            for bi, b in enumerate(blocks):
+                if not isinstance(b, dict):
+                    continue
+                for t in b.get("succ") or []:
+                    if t not in starts:
+                        problems.append(
+                            f"{where}.blocks[{bi}]: successor {t} is "
+                            f"not a block start")
+    return problems

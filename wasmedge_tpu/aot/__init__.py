@@ -67,7 +67,8 @@ def fused_planes_for(img: LoweredModule, mod):
     )
 
     host_imports = {i for i, f in enumerate(img.funcs) if f.is_import}
-    if batchability(img, host_imports=host_imports) is not None:
+    if batchability(img, host_imports=host_imports,
+                    n_memories=len(mod.all_memory_types())) is not None:
         return None
     tables = mod.all_table_types()
     table0 = [0] * int(tables[0].limit.min) if tables else None

@@ -229,8 +229,7 @@ def t0_effective_kinds(img: DeviceImage, cfg) -> Optional[np.ndarray]:
     """Per-pc tier-0 kinds this image+config will service in-kernel, or
     None when tier 0 is entirely off (no recognized stubs, knob off, or
     a concatenated multi-tenant image that carries no t0kind plane)."""
-    from wasmedge_tpu.batch.image import (
-        T0_CLOCK_TIME_GET, T0_FD_WRITE, T0_RANDOM_GET)
+    from wasmedge_tpu.batch.image import T0_FD_WRITE, T0_NEEDS_MEMORY
 
     kinds = getattr(img, "t0kind", None)
     if kinds is None or not getattr(cfg, "tier0_hostcalls", True):
@@ -239,9 +238,8 @@ def t0_effective_kinds(img: DeviceImage, cfg) -> Optional[np.ndarray]:
     if not getattr(img, "t0_fdwrite_safe", False):
         kinds[kinds == T0_FD_WRITE] = 0
     if not img.has_memory:
-        # clock/random/fd_write all write through guest memory
-        kinds[np.isin(kinds, (T0_CLOCK_TIME_GET, T0_RANDOM_GET,
-                              T0_FD_WRITE))] = 0
+        # these kinds all write through guest memory
+        kinds[np.isin(kinds, T0_NEEDS_MEMORY)] = 0
     if not (kinds != 0).any():
         return None
     return kinds
@@ -1662,7 +1660,8 @@ class BatchEngine:
             return
         host_imports = {i for i, f in enumerate(inst.funcs)
                         if getattr(f, "kind", None) == "host"}
-        reason = batchability(inst.lowered, host_imports=host_imports)
+        reason = batchability(inst.lowered, host_imports=host_imports,
+                              n_memories=len(inst.memories or ()))
         if reason is not None:
             raise ValueError(f"module not batchable: {reason}")
         self.img = build_device_image(

@@ -203,6 +203,37 @@ _WASI_MODULE = "wasi_snapshot_preview1"
 # fd_write itself disables the fd_write tier-0 path for the module.
 _T0_FD_UNSAFE_PREFIXES = ("fd_", "path_", "sock_", "poll_")
 
+# Tier-0 kinds that write through guest linear memory — serviceable
+# in-kernel only when the module has one (engine.t0_effective_kinds and
+# the static analyzer share this set).
+T0_NEEDS_MEMORY = (T0_CLOCK_TIME_GET, T0_RANDOM_GET, T0_FD_WRITE)
+
+
+def classify_t0_imports(funcs) -> Tuple[dict, bool]:
+    """Per-import tier-0 kind + module-level fd_write safety over a
+    FuncMeta list: {func_idx: T0_*} and whether fd_write may buffer
+    in-device.  The ONE source for the import-gating rules — consumed
+    by build_device_image (t0kind plane, t0_fdwrite_safe) and the
+    static analyzer (analysis/analyzer.py), so admission verdicts can
+    never drift from what the engine services in-kernel."""
+    kinds = {}
+    fdwrite_safe = True
+    for idx, fn in enumerate(funcs):
+        if not fn.is_import:
+            continue
+        if fn.import_module == _WASI_MODULE:
+            kinds[idx] = T0_WASI_KINDS.get(fn.import_name, T0_NONE)
+            if fn.import_name != "fd_write" and fn.import_name.startswith(
+                    _T0_FD_UNSAFE_PREFIXES):
+                fdwrite_safe = False
+        else:
+            # non-WASI host imports can do anything — a custom import
+            # observing output ordering would make in-device stdout
+            # buffering visible; keep fd_write conservative
+            kinds[idx] = T0_NONE
+            fdwrite_safe = False
+    return kinds, fdwrite_safe
+
 
 
 
@@ -213,12 +244,18 @@ def _i32(v: int) -> np.int32:
 
 
 def batchability(image: LoweredModule,
-                 host_imports: Optional[set] = None) -> Optional[str]:
+                 host_imports: Optional[set] = None,
+                 n_memories: int = 1) -> Optional[str]:
     """None if the module image can run on the batch engine, else reason.
 
     host_imports: func indices backed by host functions the engine can
     serve through the outcall channel (batch/hostcall.py); imports outside
-    it (e.g. cross-module wasm imports) stay unbatchable."""
+    it (e.g. cross-module wasm imports) stay unbatchable.
+    n_memories: linear memories on the instance — the lane state carries
+    exactly one mem plane, so multi-memory modules (MultiMemories
+    proposal) fall back rather than silently addressing memory 0."""
+    if n_memories > 1:
+        return "multiple memories"
     for idx, fn in enumerate(image.funcs):
         if fn.is_import:
             if host_imports is None or idx not in host_imports:
@@ -316,6 +353,34 @@ class DeviceImage:
     # fd_write tier-0 is additionally gated on the module's import set —
     # see _T0_FD_UNSAFE_PREFIXES
     t0_fdwrite_safe: bool = False
+    # Static-analysis thunk (wasmedge_tpu/analysis/), bound at build
+    # time and evaluated on FIRST ACCESS of `.analysis` — run/serve
+    # startups that never read the report never pay for it.  Advisory
+    # metadata only: nothing in the execution path reads it
+    # (analysis-off runs are bit-identical by construction); the
+    # gateway admission policy and the superinstruction tier
+    # (ROADMAP #3) are the consumers.
+    analysis_builder: object = None
+
+    @property
+    def analysis(self):
+        """ModuleAnalysis of the lowered module, built lazily and
+        cached; None when no builder was bound (e.g. concatenated
+        multi-tenant images) or the analyzer failed — admission
+        policies treat None as a violation, never as a pass."""
+        cached = self.__dict__.get("_analysis", _ANALYSIS_UNSET)
+        if cached is _ANALYSIS_UNSET:
+            cached = None
+            if self.analysis_builder is not None:
+                try:
+                    cached = self.analysis_builder()
+                except Exception:
+                    cached = None
+            self.__dict__["_analysis"] = cached
+        return cached
+
+
+_ANALYSIS_UNSET = object()
 
 
 def build_device_image(image: LoweredModule, memories=None, globals_=None,
@@ -396,7 +461,7 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
 
     stub_pc = {}
     t0kind = np.zeros(n, np.int32)
-    t0_fdwrite_safe = True
+    t0_kind_of, t0_fdwrite_safe = classify_t0_imports(image.funcs)
     for si, k in enumerate(imports):
         at = image.code_len + 2 * si
         stub_pc[k] = at
@@ -404,18 +469,7 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         a[at] = k
         cls[at + 1] = CLS_RETURN
         b[at + 1] = image.funcs[k].nresults
-        fn = image.funcs[k]
-        if fn.import_module == _WASI_MODULE:
-            t0kind[at] = T0_WASI_KINDS.get(fn.import_name, T0_NONE)
-            if fn.import_name != "fd_write" and fn.import_name.startswith(
-                    _T0_FD_UNSAFE_PREFIXES):
-                t0_fdwrite_safe = False
-        else:
-            # non-WASI host imports can do anything (including fd work
-            # through their own closures is impossible, but a custom
-            # import observing output ordering is not) — keep fd_write
-            # buffering conservative: only pure-WASI modules qualify
-            t0_fdwrite_safe = False
+        t0kind[at] = t0_kind_of.get(k, T0_NONE)
 
     for pc in range(image.code_len):
         op = image.op[pc]
@@ -641,6 +695,26 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
     has_table_mut = bool(np.isin(cls, _TMUT).any())
     has_table_grow = bool((cls == CLS_TABLE_GROW).any())
 
+    # Static analysis rides the image (same lowering the batchability
+    # probe used — the gateway never analyzes from scratch), bound as
+    # a thunk the `.analysis` property evaluates on first access: a
+    # run/serve that never reads the report never pays for it.  The
+    # declared (pre-knob-clamp) page values are captured HERE — the
+    # engine mutates img.mem_pages_max afterwards and footprint policy
+    # must judge what the module declares, not one host's clamp.
+    exports = None
+    if mod is not None:
+        exports = {e.name: e.index for e in mod.exports if e.kind == 0}
+
+    def _analysis_builder(_image=image, _exports=exports,
+                          _init=pages_init, _max=pages_max,
+                          _has_mem=bool(memories)):
+        from wasmedge_tpu.analysis import analyze_module
+
+        return analyze_module(_image, exports=_exports,
+                              mem_pages_init=_init, mem_pages_max=_max,
+                              has_memory=_has_mem)
+
     return DeviceImage(
         cls=cls, sub=sub, a=a, b=b, c=c, imm_lo=imm_lo, imm_hi=imm_hi,
         br_table=image.arrays["br_table"],
@@ -658,4 +732,5 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         table_size_init=table_size,
         has_table_mut=has_table_mut, has_table_grow=has_table_grow,
         t0kind=t0kind, t0_fdwrite_safe=t0_fdwrite_safe,
+        analysis_builder=_analysis_builder,
     )

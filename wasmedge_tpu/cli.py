@@ -589,6 +589,81 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
     return 0
 
 
+def _analyze_parser() -> ArgumentParser:
+    p = ArgumentParser("wasmedge-tpu analyze",
+                       "static bytecode analysis over the lowered "
+                       "image: per-function CFG, cost/gas bounds, "
+                       "loop/recursion verdicts, hostcall inventory, "
+                       "divergence scores, footprint bounds")
+    p.add_option("disasm",
+                 Toggle("include the block-annotated disassembly in "
+                        "the report (\"disasm\" key)"))
+    p.add_option(["out"],
+                 Option("write the JSON report to a file instead of "
+                        "stdout", "path"))
+    p.add_option(["compact"],
+                 Toggle("one-line JSON (default pretty-prints)"))
+    p.add_positional("wasm_file", "WebAssembly file to analyze")
+    return p
+
+
+def analyze_command(argv: List[str], out=None, err=None) -> int:
+    """`wasmedge-tpu analyze app.wasm [--disasm] [--out report.json]`:
+    load + validate (no instantiation — unlinkable imports still
+    analyze), run the static analyzer over the lowered image, and emit
+    the JSON report (wasmedge-tpu/analysis/v1 schema)."""
+    import json
+
+    out = out or sys.stdout
+    err = err or sys.stderr
+    p = _analyze_parser()
+    try:
+        if not p.parse(argv, out):
+            return 0
+        if p.rest:   # same trailing-options idiom as serve_command
+            trailing, p.rest = p.rest, []
+            if not p.parse(trailing, out):
+                return 0
+            if p.rest:
+                raise ValueError(f"unexpected argument {p.rest[0]!r}")
+    except ValueError as e:
+        err.write(f"wasmedge-tpu: {e}\n")
+        return 2
+    path = p.positional_values[0]
+    conf = Configure()
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        err.write(f"wasmedge-tpu: cannot read {path}: {e}\n")
+        return 1
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.validator import Validator
+
+    try:
+        mod = Validator(conf).validate(Loader(conf).parse_module(data))
+    except WasmError as e:
+        err.write(f"wasmedge-tpu: load failed: {e.formatted()}\n")
+        return 1
+    from wasmedge_tpu.analysis import analyze_validated
+
+    analysis = analyze_validated(mod)
+    report = analysis.to_dict()
+    report["file"] = path
+    if p._opts["disasm"].value:
+        report["disasm"] = analysis.annotated_disasm(mod.lowered)
+    text = json.dumps(report,
+                      indent=None if p._opts["compact"].value else 2)
+    if p._opts["out"].seen:
+        from wasmedge_tpu.utils.fsio import atomic_write_bytes
+
+        atomic_write_bytes(p._opts["out"].value, (text + "\n").encode())
+        out.write(f"written: {p._opts['out'].value}\n")
+    else:
+        out.write(text + "\n")
+    return 0
+
+
 def compile_command(argv: List[str], out=None, err=None) -> int:
     out = out or sys.stdout
     err = err or sys.stderr
@@ -633,11 +708,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         sys.stdout.write(
-            "usage: wasmedge-tpu [run|serve|gateway|compile|version] ...\n"
+            "usage: wasmedge-tpu [run|serve|gateway|analyze|compile|"
+            "version] ...\n"
             "  run      run a wasm file (default when first arg is a file)\n"
             "  serve    continuous-batching serving over device lanes\n"
             "  gateway  HTTP multi-tenant serving gateway (runtime module\n"
             "           registration, per-tenant auth/rate/quota)\n"
+            "  analyze  static bytecode analysis: CFG/cost/divergence\n"
+            "           JSON report over the lowered image\n"
             "  compile  precompile to a universal twasm artifact\n"
             "  version  print version\n")
         return 0
@@ -648,6 +726,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return serve_command(rest)
     if cmd == "gateway":
         return gateway_command(rest)
+    if cmd == "analyze":
+        return analyze_command(rest)
     if cmd == "compile":
         return compile_command(rest)
     if cmd == "version":

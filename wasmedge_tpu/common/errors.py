@@ -98,6 +98,10 @@ class ErrCode(enum.IntEnum):
     FuncNotFound = 0x94
     ExecutionFailed = 0x95
     NotValidated = 0x96
+    # Static-analysis admission: a module's static bounds exceed the
+    # registering tenant's policy (wasmedge_tpu/analysis/policy.py).
+    # Gateway maps it to HTTP 400 with the violation list in the body.
+    StaticPolicyViolation = 0x97
 
 
 # Spec-test-compatible trap messages (the conformance harness matches these,
@@ -207,6 +211,11 @@ def rejection_info(exc: BaseException) -> dict:
         after = getattr(exc, "retry_after_s", None)
         if after is not None:
             out["retry_after_s"] = float(after)
+        violations = getattr(exc, "violations", None)
+        if violations:
+            # static-analysis admission rejections carry the per-limit
+            # breakdown (analysis/policy.py AnalysisRejection)
+            out["violations"] = list(violations)
         return out
     return {
         "code": int(ErrCode.ExecutionFailed),

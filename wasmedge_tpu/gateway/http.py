@@ -24,7 +24,10 @@ common/errors.rejection_info on the wire):
                       registration not allowed
   404                 unknown module, function, or request id
   400                 malformed request, bad/unbatchable wasm
-                      (Load/Validation ErrCode in the body)
+                      (Load/Validation ErrCode in the body), or a
+                      static admission policy violation
+                      (StaticPolicyViolation + per-limit violations
+                      list, analysis/policy.py)
   409                 duplicate module name
   503                 server terminal failure / shutting down
   200 {"ok": false}   the request RAN and trapped — guest-level
@@ -323,7 +326,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 "message": f"tenant {tenant!r} may not register "
                            f"modules"}})
         info = self.svc.register_module(name, wasm_bytes=data,
-                                        source=f"http/{tenant}")
+                                        source=f"http/{tenant}",
+                                        tenant=tenant)
         return self._reply(201, dict(info, ok=True))
 
 

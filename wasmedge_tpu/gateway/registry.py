@@ -24,9 +24,16 @@ from __future__ import annotations
 
 import hashlib
 import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from wasmedge_tpu.common.errors import ErrCode, WasmError
+
+# Rejected-registration probe cache depth: engines stashed on the
+# reject path (admission-policy violation, generation-build failure)
+# waiting for a re-POST of the same bytes.  Small — each entry pins an
+# instantiated module + two sink fds.
+_PROBE_CACHE_DEPTH = 4
 
 
 class RegisteredModule:
@@ -36,10 +43,10 @@ class RegisteredModule:
     reuses — registering module N must not re-lower modules 1..N-1)."""
 
     __slots__ = ("name", "inst", "store", "engine", "sha256", "nbytes",
-                 "source", "_sink_fds")
+                 "source", "wasi", "_sink_fds")
 
     def __init__(self, name, inst, store, engine, sha256="", nbytes=0,
-                 source="boot", sink_fds=()):
+                 source="boot", sink_fds=(), wasi=None):
         self.name = name
         self.inst = inst
         self.store = store
@@ -47,7 +54,16 @@ class RegisteredModule:
         self.sha256 = sha256
         self.nbytes = nbytes
         self.source = source
+        self.wasi = wasi  # per-module WasiModule (None on boot path)
         self._sink_fds = list(sink_fds)
+
+    def rename(self, name: str):
+        """Adopt a new registration name (the probe-cache reuse path):
+        the guest-visible argv[0] must track it — a cache hit may not
+        be observably different from a fresh registration."""
+        self.name = name
+        if self.wasi is not None and self.wasi.env.args:
+            self.wasi.env.args[0] = name
 
     def exported_funcs(self) -> List[str]:
         return self.inst.func_names()
@@ -74,6 +90,15 @@ class ModuleRegistry:
         self._mods: Dict[str, RegisteredModule] = {}
         self._order: List[str] = []
         self._lock = threading.Lock()
+        # sha256 -> RegisteredModule whose registration was rolled back
+        # AFTER the (expensive) lowering succeeded — the batchability
+        # probe result.  A later add_wasm of identical bytes adopts it
+        # instead of lowering twice (rejected-then-fixed round trips).
+        self._probe_cache: "OrderedDict[str, RegisteredModule]" = \
+            OrderedDict()
+        # lowerings actually performed (probe-cache hits don't count) —
+        # pinned by tests to prove the reject path reuses the engine
+        self.lowered_count = 0
 
     def __len__(self) -> int:
         return len(self._order)
@@ -99,11 +124,21 @@ class ModuleRegistry:
         from wasmedge_tpu.validator import Validator
 
         data = bytes(data)
+        sha = hashlib.sha256(data).hexdigest()
+        with self._lock:
+            cached = self._probe_cache.pop(sha, None)
+        if cached is not None:
+            # an identical module was lowered and then rolled back
+            # (policy rejection, failed generation build): adopt the
+            # probe's engine under the new name instead of re-lowering
+            cached.rename(name)
+            cached.source = source
+            return self._install(cached)
         mod = Validator(self.conf).validate(
             Loader(self.conf).parse_module(data))
         store = StoreManager()
         ex = Executor(self.conf)
-        sinks = self._register_wasi(ex, store, name)
+        wasi, sinks = self._register_wasi(ex, store, name)
         try:
             inst = ex.instantiate(store, mod)
             # prove batchability NOW (image build raises on v128
@@ -115,6 +150,7 @@ class ModuleRegistry:
 
             eng = BatchEngine(inst, store=store, conf=self.conf,
                               lanes=1)
+            self.lowered_count += 1
         except BaseException:
             # the sink fds were opened before instantiation — a
             # rejected module (unlinkable import, unbatchable image)
@@ -128,9 +164,9 @@ class ModuleRegistry:
                     pass
             raise
         rm = RegisteredModule(
-            name, inst, store, eng,
-            sha256=hashlib.sha256(data).hexdigest(),
-            nbytes=len(data), source=source, sink_fds=sinks)
+            name, inst, store, eng, sha256=sha,
+            nbytes=len(data), source=source, sink_fds=sinks,
+            wasi=wasi)
         return self._install(rm)
 
     def add_instance(self, name: str, inst, store,
@@ -141,13 +177,37 @@ class ModuleRegistry:
         from wasmedge_tpu.batch.engine import BatchEngine
 
         eng = BatchEngine(inst, store=store, conf=self.conf, lanes=1)
+        self.lowered_count += 1
         return self._install(RegisteredModule(name, inst, store, eng,
                                               source=source))
 
-    def remove(self, name: str):
-        rm = self._mods.pop(name, None)
-        if rm is not None:
-            self._order.remove(name)
+    def remove(self, name: str, stash: bool = False):
+        """Unregister `name`.  With stash=True a wasm-sourced module's
+        lowered engine is parked in the probe cache (keyed by content
+        sha256) instead of discarded — the reject-path call of
+        gateway/service.py, so a rejected-then-fixed registration of
+        the same bytes never pays for a second lowering."""
+        with self._lock:
+            rm = self._mods.pop(name, None)
+            if rm is not None:
+                self._order.remove(name)
+        if rm is None:
+            return
+        if stash and rm.sha256:
+            with self._lock:
+                # a same-bytes entry may already be stashed (e.g. two
+                # copies in one rolled-back preload): close the one we
+                # displace or its sink fds leak
+                displaced = self._probe_cache.pop(rm.sha256, None)
+                self._probe_cache[rm.sha256] = rm
+                evicted = []
+                while len(self._probe_cache) > _PROBE_CACHE_DEPTH:
+                    evicted.append(self._probe_cache.popitem(last=False))
+            if displaced is not None:
+                displaced.close()
+            for _, old in evicted:
+                old.close()
+        else:
             rm.close()
 
     def _check_name(self, name: str):
@@ -169,12 +229,15 @@ class ModuleRegistry:
             self._order.append(rm.name)
         return rm
 
-    def _register_wasi(self, ex, store, prog_name: str) -> List[int]:
+    def _register_wasi(self, ex, store, prog_name: str) \
+            -> Tuple[object, List[int]]:
         """A fresh per-module WASI instance (per-module environ =
         per-module sandbox), stdout/stderr sunk to /dev/null when
         configured.  Registered unconditionally — modules that import
         nothing are unaffected, modules importing
-        wasi_snapshot_preview1 resolve."""
+        wasi_snapshot_preview1 resolve.  Returns (wasi, sink_fds);
+        the WasiModule rides the RegisteredModule so probe-cache
+        adoption can retarget argv[0]."""
         import os
 
         from wasmedge_tpu.host.wasi import WasiModule
@@ -190,7 +253,7 @@ class ModuleRegistry:
                     e.os_fd = sink
                     sinks.append(sink)
         ex.register_import_object(store, wasi)
-        return sinks
+        return wasi, sinks
 
     # -- engine builder ----------------------------------------------------
     def modules_snapshot(self) -> List[RegisteredModule]:
@@ -218,3 +281,6 @@ class ModuleRegistry:
         with self._lock:
             for rm in self._mods.values():
                 rm.close()
+            for rm in self._probe_cache.values():
+                rm.close()
+            self._probe_cache.clear()

@@ -126,8 +126,13 @@ class GatewayService:
         self.counters = {
             "received": 0, "completed": 0, "failed": 0, "deadline": 0,
             "rejected": 0, "rate_limited": 0, "registered_modules": 0,
-            "generations": 0,
+            "generations": 0, "policy_rejected": 0,
         }
+        # static-analysis admission summary (obs/metrics.py renders it
+        # as wasmedge_analysis_* counters): verdicts of every module
+        # that reached the policy gate + rejections it issued
+        self.analysis_counts = {"bounded": 0, "unbounded": 0,
+                                "policy_rejected": 0}
 
     # -- generations -------------------------------------------------------
     @property
@@ -189,12 +194,15 @@ class GatewayService:
     # -- module registration ----------------------------------------------
     def register_module(self, name: str, wasm_bytes: Optional[bytes] = None,
                         inst=None, store=None,
-                        source: str = "http") -> dict:
+                        source: str = "http",
+                        tenant: Optional[str] = None) -> dict:
         """Register a module and swap in a fresh generation.  Either
         raw `wasm_bytes` (the HTTP path: full validation pipeline) or a
-        pre-instantiated (inst, store) pair (the VM/CLI boot path)."""
+        pre-instantiated (inst, store) pair (the VM/CLI boot path).
+        `tenant` selects the static-analysis admission policy (the
+        tenant's own, else the file-level default)."""
         return self._register([(name, wasm_bytes, inst, store)],
-                              source=source)
+                              source=source, tenant=tenant)
 
     def preload(self, entries, source: str = "boot") -> dict:
         """Register several modules with ONE generation build — the
@@ -204,11 +212,45 @@ class GatewayService:
         return self._register([(n, b, None, None) for n, b in entries],
                               source=source)
 
-    def _register(self, entries, source: str) -> dict:
+    def _vet(self, rm, tenant: Optional[str]) -> List[dict]:
+        """Static-analysis admission: evaluate the already-built
+        image's ModuleAnalysis (one lowering — shared with the
+        batchability probe) against the registering tenant's policy.
+        Raises AnalysisRejection in enforce mode; returns the
+        violation list in flag mode (surfaced as analysis_warnings).
+
+        Boot/preload registrations (tenant None — the CLI --module
+        set, VM.gateway()) are operator-trusted and only COUNTED, never
+        policy-gated: a strict file-level default aimed at runtime
+        HTTP registrants must not abort gateway startup on the
+        operator's own modules."""
+        from wasmedge_tpu.analysis.policy import AnalysisRejection
+
+        analysis = getattr(rm.engine.img, "analysis", None)
+        with self._lock:
+            if analysis is not None:
+                key = "bounded" if analysis.bounded else "unbounded"
+                self.analysis_counts[key] += 1
+        if tenant is None:
+            return []
+        policy = self.tenants.admission_policy(tenant)
+        if policy is None:
+            return []
+        violations = policy.evaluate(analysis)
+        if violations and policy.enforce:
+            with self._lock:
+                self.counters["policy_rejected"] += 1
+                self.analysis_counts["policy_rejected"] += 1
+            raise AnalysisRejection(rm.name, violations)
+        return violations
+
+    def _register(self, entries, source: str,
+                  tenant: Optional[str] = None) -> dict:
         with self._reg_lock:
             if self._closed:
                 raise GatewayClosed()
             added = []
+            warnings: List[dict] = []
             try:
                 for name, wasm_bytes, inst, store in entries:
                     if wasm_bytes is not None:
@@ -219,24 +261,37 @@ class GatewayService:
                                                         store,
                                                         source=source)
                     added.append(rm)
+                    warnings.extend(self._vet(rm, tenant))
                 gen = self._build_generation()
             except BaseException:
                 # never leave a module registered that no generation
-                # serves — the registry and the serving set must agree
+                # serves — the registry and the serving set must agree.
+                # stash=True parks the already-lowered engine in the
+                # registry's probe cache: a re-POST of the same bytes
+                # (fixed policy, different tenant/name) reuses it
+                # instead of lowering twice
                 for rm in added:
-                    self.registry.remove(rm.name)
+                    self.registry.remove(rm.name, stash=True)
                 raise
             self._swap_in(gen)
         with self._lock:
             self.counters["registered_modules"] += len(added)
         last = added[-1]
-        return {
+        out = {
             "module": last.name,
             "sha256": last.sha256,
             "exports": last.exported_funcs(),
             "generation": gen.gen_id,
             "modules": list(gen.modules),
         }
+        analysis = getattr(last.engine.img, "analysis", None)
+        if analysis is not None:
+            out["analysis"] = analysis.summary()
+        if warnings:
+            # flag-mode policy (enforce=false): registered, but the
+            # violations ride the 201 body so operators can see them
+            out["analysis_warnings"] = warnings
+        return out
 
     # -- requests ----------------------------------------------------------
     def submit(self, func: str, args, module: Optional[str] = None,
@@ -375,6 +430,7 @@ class GatewayService:
                 "lanes": self.lanes,
                 "draining_generations": draining,
                 "gateway": dict(self.counters),
+                "analysis": dict(self.analysis_counts),
                 "http": dict(self.http_counts),
                 "tenants": sorted(self.tenants.policies),
             }
@@ -392,7 +448,8 @@ class GatewayService:
         return render_prometheus(
             recorder=self.obs if self.obs.enabled else None,
             hostcall_stats=gen.engine.hostcall_stats if gen else None,
-            http_requests=dict(self.http_counts))
+            http_requests=dict(self.http_counts),
+            analysis_counts=dict(self.analysis_counts))
 
     # -- lifecycle ---------------------------------------------------------
     def shutdown(self, drain: bool = True,

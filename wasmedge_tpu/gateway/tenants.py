@@ -87,15 +87,25 @@ class TenantPolicy:
     rate_per_s: Optional[float] = None  # HTTP-edge request rate
     burst: Optional[float] = None       # bucket capacity (default 2*rate)
     can_register: bool = True           # POST /v1/modules allowed
+    # Static-analysis admission limits for modules THIS tenant registers
+    # (analysis/policy.py AnalysisPolicy; None = inherit the file's
+    # top-level "analysis" default, which itself defaults to no vetting)
+    analysis: Optional[object] = None
 
     @classmethod
     def from_dict(cls, name: str, d: dict) -> "TenantPolicy":
         known = {"api_key", "weight", "quota", "rate_per_s", "burst",
-                 "can_register"}
+                 "can_register", "analysis"}
         bad = set(d) - known
         if bad:
             raise ValueError(
                 f"tenant {name!r}: unknown policy keys {sorted(bad)}")
+        analysis = None
+        if d.get("analysis") is not None:
+            from wasmedge_tpu.analysis.policy import AnalysisPolicy
+
+            analysis = AnalysisPolicy.from_dict(
+                d["analysis"], where=f"tenant {name!r} analysis")
         return cls(name=name,
                    api_key=d.get("api_key"),
                    weight=float(d.get("weight", 1.0)),
@@ -106,7 +116,8 @@ class TenantPolicy:
                                else None),
                    burst=(float(d["burst"]) if d.get("burst") is not None
                           else None),
-                   can_register=bool(d.get("can_register", True)))
+                   can_register=bool(d.get("can_register", True)),
+                   analysis=analysis)
 
 
 class GatewayTenants:
@@ -119,10 +130,14 @@ class GatewayTenants:
 
     def __init__(self, policies: Optional[Dict[str, TenantPolicy]] = None,
                  require_auth: bool = False,
-                 default_tenant: str = "default"):
+                 default_tenant: str = "default",
+                 analysis_default: Optional[object] = None):
         self.policies = dict(policies or {})
         self.require_auth = bool(require_auth)
         self.default_tenant = default_tenant
+        # top-level "analysis" table: the AnalysisPolicy for tenants
+        # without their own (None = no static vetting)
+        self.analysis_default = analysis_default
         self._by_key = {p.api_key: p for p in self.policies.values()
                         if p.api_key}
         self._buckets: Dict[str, TokenBucket] = {}
@@ -152,9 +167,15 @@ class GatewayTenants:
     def from_dict(cls, doc: dict) -> "GatewayTenants":
         policies = {name: TenantPolicy.from_dict(name, d)
                     for name, d in (doc.get("tenants") or {}).items()}
+        analysis_default = None
+        if doc.get("analysis") is not None:
+            from wasmedge_tpu.analysis.policy import AnalysisPolicy
+
+            analysis_default = AnalysisPolicy.from_dict(doc["analysis"])
         return cls(policies=policies,
                    require_auth=bool(doc.get("require_auth", False)),
-                   default_tenant=doc.get("default_tenant", "default"))
+                   default_tenant=doc.get("default_tenant", "default"),
+                   analysis_default=analysis_default)
 
     # -- FairQueue bridge --------------------------------------------------
     def weights(self) -> Dict[str, float]:
@@ -199,6 +220,17 @@ class GatewayTenants:
         after = b.try_take()
         if after is not None:
             raise RateLimited(tenant, after)
+
+    def admission_policy(self, tenant: Optional[str]):
+        """The AnalysisPolicy governing modules `tenant` registers:
+        the tenant's own `analysis` table, else the file-level default,
+        else None (no static vetting).  The gateway only consults it
+        for tenant-attributed registrations — boot/preload modules
+        (tenant None) are operator-trusted and never policy-gated."""
+        p = self.policies.get(tenant) if tenant else None
+        if p is not None and p.analysis is not None:
+            return p.analysis
+        return self.analysis_default
 
     def can_register(self, tenant: str) -> bool:
         p = self.policies.get(tenant)

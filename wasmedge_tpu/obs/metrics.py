@@ -57,13 +57,31 @@ class _Writer:
 
 
 def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
-                      failures=None, http_requests=None) -> str:
+                      failures=None, http_requests=None,
+                      analysis_counts=None) -> str:
     """Render one metrics snapshot.  All sources optional: `recorder` a
     FlightRecorder, `stats` a common.statistics.Statistics, `hostcall_stats`
     an engine's pipeline counter dict, `failures` extra FailureRecords
     (e.g. statistics.recent_failures()) merged into the taxonomy counts,
-    `http_requests` the gateway's {status_code: count} edge tally."""
+    `http_requests` the gateway's {status_code: count} edge tally,
+    `analysis_counts` the gateway's static-analysis admission summary
+    ({"bounded": n, "unbounded": n, "policy_rejected": n})."""
     w = _Writer()
+
+    if analysis_counts and any(analysis_counts.values()):
+        w.head("wasmedge_analysis_modules_total", "counter",
+               "Modules vetted by the static analyzer at registration, "
+               "by cost verdict (wasmedge_tpu/analysis/).")
+        for verdict in ("bounded", "unbounded"):
+            if analysis_counts.get(verdict):
+                w.sample("wasmedge_analysis_modules_total",
+                         {"verdict": verdict},
+                         int(analysis_counts[verdict]))
+        w.head("wasmedge_analysis_policy_rejections_total", "counter",
+               "Registrations rejected by a static admission policy "
+               "(analysis/policy.py AnalysisPolicy).")
+        w.sample("wasmedge_analysis_policy_rejections_total", None,
+                 int(analysis_counts.get("policy_rejected", 0)))
 
     if http_requests:
         w.head("wasmedge_gateway_http_requests_total", "counter",
@@ -182,12 +200,13 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
 
 def export_prometheus(path, recorder=None, stats=None,
                       hostcall_stats=None, failures=None,
-                      http_requests=None) -> str:
+                      http_requests=None, analysis_counts=None) -> str:
     """Render and write a metrics snapshot to `path` (or file-like)."""
     text = render_prometheus(recorder=recorder, stats=stats,
                              hostcall_stats=hostcall_stats,
                              failures=failures,
-                             http_requests=http_requests)
+                             http_requests=http_requests,
+                             analysis_counts=analysis_counts)
     if hasattr(path, "write"):
         path.write(text)
     else:

@@ -46,7 +46,19 @@ _LOP_NAMES = {LOP_BR: "lop.br", LOP_BRZ: "lop.brz", LOP_BRNZ: "lop.brnz"}
 
 
 def lop_name(op: int) -> str:
-    return _LOP_NAMES.get(op) or name_of(op)
+    """Printable name of any id in the lowered ISA (wasm opcodes +
+    LOP_* pseudo-ops).  Out-of-range ids raise instead of silently
+    aliasing (a negative id would index the opcode table from the END
+    and print a plausible but WRONG name) — new pseudo-ops must be
+    added to _LOP_NAMES, pinned by the disasm round-trip test."""
+    name = _LOP_NAMES.get(op)
+    if name is not None:
+        return name
+    if 0 <= op < NUM_OPCODES:
+        return name_of(op)
+    raise ValueError(
+        f"opcode id {op} outside the lowered ISA (0..{NUM_LOPS - 1}); "
+        f"new pseudo-ops need a _LOP_NAMES entry")
 
 
 @dataclasses.dataclass
