@@ -882,6 +882,288 @@ def gateway_bench() -> int:
     return 0 if ok else 1
 
 
+def chaos_bench(smoke: bool = False) -> int:
+    """`bench.py --chaos`: live-traffic chaos test of the durable
+    gateway (r13 acceptance).  An open-loop HTTP client fleet submits
+    async requests at a fixed arrival rate while a seeded fault
+    schedule (testing/faults.gateway_chaos_schedule: engine
+    launch/serve faults, a generation build/swap fault, durable-journal
+    write faults, HTTP delay/drop) runs underneath — and mid-stream the
+    gateway process is KILLED (Gateway.kill(): no drain, no flush) and
+    restarted with resume=True over the same state dir.  Asserts:
+
+      - every accepted (202) request id reaches exactly one terminal
+        outcome — resolved, or machine-readably rejected (err taxonomy
+        in the body) — and the outcome is stable across repeat polls
+      - zero accepted ids are lost across the kill/restart (no 404s)
+      - the registered module set (including the one registered
+        through a rolled-back-then-retried swap) is fully present
+        post-resume
+      - the swap fault rolled back atomically (rollbacks >= 1) and the
+        pre-kill fault schedule actually fired
+
+    Emits CHAOS_r13.json.  `--chaos-smoke` is the CI guard: a short
+    serial schedule, one in-process kill/restart, the same zero-lost /
+    exactly-once assertions, no artifact emission."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.gateway import Gateway, GatewayService
+    from wasmedge_tpu.models import build_fib
+    from wasmedge_tpu.testing.faults import (
+        Fault,
+        FaultInjector,
+        gateway_chaos_schedule,
+    )
+    from wasmedge_tpu.utils.builder import ModuleBuilder
+
+    seed = int(os.environ.get("CHAOS_SEED", 13))
+    if smoke:
+        lanes, nreq, rate = 4, 16, 200.0
+        fib_lo, fib_hi = 8, 12
+        # launch at=0: the very first serving launch faults and the
+        # server recovers from scratch — deterministic regardless of
+        # how many rounds run before the kill
+        schedule = [Fault(point="launch", at=0),
+                    Fault(point="generation_build", at=1),
+                    Fault(point="journal_write", at=6)]
+    else:
+        lanes = int(os.environ.get("CHAOS_LANES", 8))
+        nreq = int(os.environ.get("CHAOS_REQUESTS", 96))
+        rate = float(os.environ.get("CHAOS_RATE", 40.0))
+        fib_lo, fib_hi = 8, 16
+        schedule = gateway_chaos_schedule(seed)
+    reg_at, kill_at = nreq // 3, nreq // 2
+
+    def fresh_conf():
+        conf = Configure()
+        conf.batch.steps_per_launch = 128
+        conf.batch.value_stack_depth = 64
+        conf.batch.call_stack_depth = 32
+        conf.obs.enabled = not smoke
+        return conf
+
+    def build_dbl():
+        b = ModuleBuilder()
+        b.add_function(["i64"], ["i64"], [],
+                       [("local.get", 0), ("i64.const", 2), "i64.mul",
+                        ("i64.const", 7), "i64.add"], export="dbl")
+        return b.build()
+
+    state_dir = tempfile.mkdtemp(prefix="chaos-gw-")
+    inj = FaultInjector(schedule)
+    t0 = time.perf_counter()
+    svc = GatewayService(conf=fresh_conf(), lanes=lanes, faults=inj,
+                         state_dir=state_dir)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    gw = Gateway(svc, port=0).start()
+    addr = {"host": gw.host, "port": gw.port}
+
+    accepted = []          # ids the CLIENT holds a 202 for
+    rejected_mr = []       # machine-readable submit rejections
+    transport_errors = [0]
+    outcomes = {}          # id -> (status, doc) first terminal poll
+    lock = threading.Lock()
+    stop_poll = threading.Event()
+
+    def poll_once(rid):
+        try:
+            st, doc, _ = _gateway_rpc(addr["host"], addr["port"], "GET",
+                                      f"/v1/requests/{rid}", timeout=30.0)
+        except OSError:
+            return False   # dropped/killed wire: retry later
+        if not isinstance(doc, dict) or doc.get("status") == "pending":
+            return False
+        with lock:
+            outcomes.setdefault(rid, (st, doc))
+        return True
+
+    def poller():
+        while not stop_poll.is_set():
+            with lock:
+                todo = [r for r in accepted if r not in outcomes]
+            if not todo:
+                _time.sleep(0.02)
+                continue
+            for rid in todo:
+                poll_once(rid)
+                if stop_poll.is_set():
+                    return
+            _time.sleep(0.01)
+
+    pollers = [threading.Thread(target=poller, daemon=True)
+               for _ in range(1 if smoke else 3)]
+    for t in pollers:
+        t.start()
+
+    def submit(n):
+        try:
+            st, doc, _ = _gateway_rpc(
+                addr["host"], addr["port"], "POST",
+                "/v1/invoke?async=1",
+                body={"module": "fib", "func": "fib", "args": [int(n)]},
+                timeout=30.0)
+        except OSError:
+            transport_errors[0] += 1
+            return
+        if st == 202 and isinstance(doc, dict):
+            with lock:
+                accepted.append(doc["request_id"])
+        elif isinstance(doc, dict) and isinstance(doc.get("err"), dict) \
+                and "name" in doc["err"]:
+            rejected_mr.append((st, doc["err"]["name"]))
+        else:
+            transport_errors[0] += 1
+
+    def register_dbl():
+        """Draw the armed swap fault (503 + Retry-After, rolled back),
+        then retry until the registration lands."""
+        saw_503 = False
+        for _ in range(6):
+            st, doc, _ = _gateway_rpc(
+                addr["host"], addr["port"], "POST",
+                "/v1/modules?name=dbl", body=build_dbl(),
+                headers={"Content-Type": "application/wasm"},
+                timeout=180.0)
+            if st == 201:
+                return saw_503, True
+            if st == 503:
+                saw_503 = True
+                _time.sleep(0.1)
+                continue
+            return saw_503, False
+        return saw_503, False
+
+    checks = {}
+    rng_args = np.random.RandomState(seed).randint(
+        fib_lo, fib_hi + 1, size=nreq)
+    saw_rollback_503 = dbl_registered = False
+    restarted = False
+    pre_kill_counters = {}
+    t_sched0 = _time.monotonic()
+    for i, n in enumerate(rng_args):
+        t_sched = t_sched0 + i / rate
+        now = _time.monotonic()
+        if t_sched > now:
+            _time.sleep(t_sched - now)
+        if i == reg_at:
+            saw_rollback_503, dbl_registered = register_dbl()
+        if i == kill_at:
+            # THE crash: no drain, no flush — then resume from disk
+            pre_kill_counters = dict(svc.counters)
+            gw.kill()
+            inj2 = FaultInjector([])   # calm weather after the storm
+            svc = GatewayService(conf=fresh_conf(), lanes=lanes,
+                                 faults=inj2, state_dir=state_dir,
+                                 resume=True)
+            gw = Gateway(svc, port=0).start()
+            addr["host"], addr["port"] = gw.host, gw.port
+            restarted = True
+        submit(n)
+
+    # drain: every accepted id must reach ONE terminal outcome
+    deadline = _time.monotonic() + (120.0 if smoke else 300.0)
+    while _time.monotonic() < deadline:
+        with lock:
+            if len(outcomes) == len(accepted):
+                break
+        _time.sleep(0.05)
+    stop_poll.set()
+    for t in pollers:
+        t.join(timeout=5.0)
+
+    # exactly-once: a second poll of every id must repeat the outcome
+    stable = lost = resolved = rejected_after = 0
+    for rid in accepted:
+        first = outcomes.get(rid)
+        try:
+            st, doc, _ = _gateway_rpc(addr["host"], addr["port"], "GET",
+                                      f"/v1/requests/{rid}", timeout=30.0)
+        except OSError:
+            st, doc = None, None
+        if first is None:
+            lost += 1
+            continue
+        if st == 404 and isinstance(doc, dict) \
+                and doc.get("err", {}).get("detail") != "pruned":
+            lost += 1
+            continue
+        if isinstance(doc, dict) and doc.get("ok") and \
+                first[1].get("ok") and \
+                doc.get("result") == first[1].get("result"):
+            stable += 1
+        elif isinstance(doc, dict) and not doc.get("ok") \
+                and not first[1].get("ok"):
+            stable += 1
+        if first[1].get("ok"):
+            resolved += 1
+        else:
+            rejected_after += 1
+    st, status_doc, _ = _gateway_rpc(addr["host"], addr["port"], "GET",
+                                     "/v1/status", timeout=60.0)
+    st_m, metrics_text, _ = _gateway_rpc(addr["host"], addr["port"],
+                                         "GET", "/metrics", timeout=60.0)
+    gw.shutdown(drain=True, timeout_s=120.0)
+    shutil.rmtree(state_dir, ignore_errors=True)
+    dt = time.perf_counter() - t0
+
+    gcounters = status_doc.get("gateway", {}) if isinstance(
+        status_doc, dict) else {}
+    checks["accepted_all_terminal"] = len(outcomes) == len(accepted)
+    checks["zero_ids_lost"] = lost == 0
+    checks["outcomes_stable"] = stable == len(accepted)
+    checks["restarted_mid_stream"] = restarted
+    checks["modules_present_post_resume"] = isinstance(
+        status_doc, dict) and set(status_doc.get("modules", {})) >= (
+        {"fib", "dbl"} if dbl_registered else {"fib"})
+    checks["swap_fault_rolled_back"] = (not any(
+        f.point in ("generation_build", "generation_swap")
+        for f in schedule)) or (saw_rollback_503 and dbl_registered)
+    checks["pre_kill_faults_fired"] = inj.fired >= 1
+    checks["restart_counted"] = gcounters.get("restarts", 0) >= 1 \
+        and "wasmedge_gateway_restarts_total" in str(metrics_text)
+    ok = all(checks.values())
+    out = {
+        "metric": "gateway_chaos_smoke" if smoke
+        else "gateway_chaos_open_loop",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "seed": seed,
+        "lanes": lanes,
+        "requests": nreq,
+        "accepted": len(accepted),
+        "rejected_machine_readable": len(rejected_mr),
+        "transport_errors": transport_errors[0],
+        "resolved_ok": resolved,
+        "rejected_after_accept": rejected_after,
+        "injected_pre_kill": inj.log,
+        "restarts": gcounters.get("restarts", 0),
+        # rollbacks is a per-process counter: the swap fault fired (and
+        # rolled back) in the PRE-kill process
+        "rollbacks": max(gcounters.get("rollbacks", 0),
+                         pre_kill_counters.get("rollbacks", 0)),
+        "wall_s": round(dt, 3),
+    }
+    if smoke:
+        print(json.dumps(out))
+        return 0 if ok else 1
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "CHAOS_r13.json")
+    print(json.dumps(out))
+    print(f"# chaos lanes={lanes} reqs={nreq} accepted={len(accepted)} "
+          f"lost={lost} restarts={gcounters.get('restarts')} "
+          f"rollbacks={gcounters.get('rollbacks')} wall={dt:.1f}s",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main():
     eng = _build(LANES)
 
@@ -956,4 +1238,8 @@ if __name__ == "__main__":
         sys.exit(gateway_smoke())
     if "--gateway" in sys.argv[1:]:
         sys.exit(gateway_bench())
+    if "--chaos-smoke" in sys.argv[1:]:
+        sys.exit(chaos_bench(smoke=True))
+    if "--chaos" in sys.argv[1:]:
+        sys.exit(chaos_bench())
     main()

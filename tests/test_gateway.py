@@ -269,6 +269,37 @@ def test_unknown_module_bad_wasm_and_conflict_rejection(gw_main):
 
 
 # ---------------------------------------------------------------------------
+# r13 surface on the shared gateway: truthful /healthz + durability fields
+# ---------------------------------------------------------------------------
+def test_healthz_and_status_carry_machine_readable_health(gw_main):
+    """/healthz is no longer a liveness stub: the body carries the
+    per-check breakdown (driver/queue/checkpoint), /v1/status embeds
+    the same health block plus the durability flag, and the restart/
+    rollback counters always render in /metrics (zero-valued on a
+    fresh non-durable gateway)."""
+    gw = gw_main
+    st, doc, _ = rpc(gw, "GET", "/healthz")
+    assert st == 200, doc
+    assert doc["ok"] is True
+    assert doc["status"] in ("healthy", "degraded")
+    for check in ("driver", "queue", "checkpoint"):
+        assert check in doc["checks"]
+        assert set(doc["checks"][check]) == {"ok", "level", "detail"}
+
+    st, doc, _ = rpc(gw, "GET", "/v1/status")
+    assert st == 200
+    assert doc["health"]["status"] in ("healthy", "degraded")
+    assert doc["durable"] is False   # no state_dir on the shared gw
+    assert "rollbacks" in doc["gateway"]
+    assert "restarts" in doc["gateway"]
+
+    st, text, _ = rpc(gw, "GET", "/metrics")
+    assert st == 200
+    assert "wasmedge_gateway_restarts_total" in text
+    assert "wasmedge_generation_rollbacks_total" in text
+
+
+# ---------------------------------------------------------------------------
 # observability: gateway spans + http_requests_total
 # ---------------------------------------------------------------------------
 def test_gateway_obs_spans_and_metrics(gw_main):
@@ -615,6 +646,9 @@ def test_cli_gateway_command(tmp_path):
     assert startup["modules"] == ["main", "second"]
     assert startup["listening"].startswith("http://127.0.0.1:")
     assert startup["lanes"] == 2
+    # the boot health gate ran and the startup line reports it
+    assert startup["health"] == "healthy"
+    assert startup["durable"] is False and startup["restarts"] == 0
     summary = json.loads(lines[-1])
     assert summary["metric"] == "gateway_exit"
     assert summary["received"] == 0

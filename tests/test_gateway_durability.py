@@ -1,0 +1,619 @@
+"""Durable gateway: crash/restart resume, swap rollback, health-gated
+shedding (wasmedge_tpu/gateway/durable.py + health.py, marker `serve`).
+
+Pins the r13 acceptance contract:
+
+  - a deterministic generation build/swap fault rolls back ATOMICALLY:
+    the prior generation keeps serving bit-identically, the failed
+    registration returns a retryable 503-class GenerationBuildFailed,
+    the probe-cache stash makes the retry skip the re-lowering, and
+    the rollback is counted + flight-recorded
+  - a wedged generation build hits the build timeout and rolls back
+    the same way (the registration lock is never held unboundedly)
+  - kill (no drain, no flush) + resume over the same state_dir brings
+    back the module set under one boot generation, replays resolved
+    ids from the durable result cache (exactly-once), and re-queues
+    unresolved ids under their ORIGINAL ids (at-least-once)
+  - a faulted durable journal write REJECTS the submission retryably
+    (the 202 id is never issued undurably) and degrades health
+  - /healthz is truthful: dead driver / failed generation -> 503,
+    rollback/journal trouble -> degraded-200 with machine-readable
+    checks; the CLI gateway command exits non-zero on an unhealthy boot
+  - degraded gateways shed lowest-weight-tier traffic with retryable
+    429s (ShedLoad, detail "shed"), never sole-tier traffic
+  - a pruned async id answers 404 with the distinct "pruned" detail,
+    and result_cache is a working config knob
+
+Speed discipline: tier-1 fast — tiny geometry, the module-scoped JAX
+persistent cache shared with tests/test_gateway.py's idiom, and HTTP
+only where the wire contract itself is under test.
+"""
+
+import json
+import tempfile
+import time
+
+import pytest
+
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.common.errors import WasmError, rejection_info
+from wasmedge_tpu.gateway import (
+    Gateway,
+    GatewayService,
+    GatewayTenants,
+    GenerationBuildFailed,
+)
+from wasmedge_tpu.gateway.health import ShedLoad
+from wasmedge_tpu.models import build_fib
+from wasmedge_tpu.testing.faults import Fault, FaultInjector
+from wasmedge_tpu.utils.builder import ModuleBuilder
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache():
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    d = tempfile.mkdtemp(prefix="gateway-durable-jit-cache-")
+    jax.config.update("jax_compilation_cache_dir", d)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def _fib(n):
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+def _conf(obs=False):
+    conf = Configure()
+    conf.batch.steps_per_launch = 256
+    conf.batch.value_stack_depth = 128
+    conf.batch.call_stack_depth = 64
+    conf.obs.enabled = obs
+    return conf
+
+
+def build_dbl() -> bytes:
+    b = ModuleBuilder()
+    b.add_function(["i64"], ["i64"], [],
+                   [("local.get", 0), ("i64.const", 2), "i64.mul",
+                    ("i64.const", 7), "i64.add"],
+                   export="dbl")
+    return b.build()
+
+
+def _invoke(svc, func, args, module=None, tenant="default"):
+    req = svc.submit(func, args, module=module, tenant=tenant)
+    assert svc.wait(req, timeout_s=120.0)
+    return req.future.result(0)
+
+
+# ---------------------------------------------------------------------------
+# swap rollback: deterministic fault, atomic, retryable, stash reused
+# ---------------------------------------------------------------------------
+def test_generation_build_fault_rolls_back_atomically():
+    inj = FaultInjector([Fault(point="generation_build", at=1)])
+    svc = GatewayService(conf=_conf(obs=True), lanes=2, faults=inj)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    try:
+        before = _invoke(svc, "fib", [12], module="fib")
+        gen_before = svc.generation
+        lowered_before = svc.registry.lowered_count
+
+        with pytest.raises(GenerationBuildFailed) as exc:
+            svc.register_module("dbl", wasm_bytes=build_dbl())
+        # retryable 503 class with a Retry-After hint on the wire
+        assert exc.value.retryable is True
+        info = rejection_info(exc.value)
+        assert info["retryable"] is True
+        from wasmedge_tpu.gateway.http import (
+            retry_after_of,
+            submit_status_of,
+        )
+
+        assert submit_status_of(exc.value) == 503
+        assert retry_after_of(exc.value) is not None
+
+        # atomic: no half-swapped pointer, module set unchanged, the
+        # prior generation serves bit-identically
+        assert svc.generation == gen_before
+        assert svc.registry.names == ["fib"]
+        assert _invoke(svc, "fib", [12], module="fib") == before \
+            == [_fib(12)]
+        assert svc.counters["rollbacks"] == 1
+        assert svc.last_swap is not None and not svc.last_swap["ok"]
+        assert "generation_rollback" in svc.obs.event_names()
+
+        # one registration lowered dbl exactly once; the rolled-back
+        # engine is stashed, so the retry adopts it instead of
+        # re-lowering — and then the swap succeeds
+        assert svc.registry.lowered_count == lowered_before + 1
+        out = svc.register_module("dbl", wasm_bytes=build_dbl())
+        assert out["generation"] == gen_before + 1
+        assert svc.registry.lowered_count == lowered_before + 1
+        assert svc.last_swap["ok"] is True
+        assert _invoke(svc, "dbl", [5], module="dbl") == [17]
+    finally:
+        svc.shutdown()
+
+
+def test_generation_swap_fault_never_half_swaps():
+    """The swap seam fires before the server starts or the pointer
+    moves: an injected swap fault leaves the submit pointer on the
+    prior generation, which keeps serving."""
+    inj = FaultInjector([Fault(point="generation_swap", at=1)])
+    svc = GatewayService(conf=_conf(), lanes=2, faults=inj)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    try:
+        gen_before = svc.generation
+        with pytest.raises(GenerationBuildFailed):
+            svc.register_module("dbl", wasm_bytes=build_dbl())
+        assert svc.generation == gen_before
+        assert len(svc._gens) == 1   # nothing half-installed
+        assert svc.registry.names == ["fib"]
+        assert _invoke(svc, "fib", [10], module="fib") == [55]
+    finally:
+        svc.shutdown()
+
+
+def test_build_timeout_rolls_back_and_recovers(monkeypatch):
+    from wasmedge_tpu.gateway.registry import ModuleRegistry
+
+    svc = GatewayService(conf=_conf(), lanes=2, build_timeout_s=0.2)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    orig = ModuleRegistry.build_engine
+    calls = []
+
+    def wedged(self, conf, lanes):
+        calls.append(1)
+        time.sleep(1.5)   # a wedged compile, well past the timeout
+        return orig(self, conf, lanes)
+
+    try:
+        monkeypatch.setattr(ModuleRegistry, "build_engine", wedged)
+        t0 = time.monotonic()
+        with pytest.raises(GenerationBuildFailed) as exc:
+            svc.register_module("dbl", wasm_bytes=build_dbl())
+        # the registration lock was released at the TIMEOUT, not when
+        # the wedged build eventually finished
+        assert time.monotonic() - t0 < 1.2
+        assert "timeout" in str(exc.value)
+        assert exc.value.retryable is True
+        assert svc.counters["rollbacks"] == 1
+        monkeypatch.setattr(ModuleRegistry, "build_engine", orig)
+        # the abandoned build thread committed nothing; a clean retry
+        # swaps in generation 2 and both modules serve
+        out = svc.register_module("dbl", wasm_bytes=build_dbl())
+        assert out["generation"] == 2
+        assert _invoke(svc, "fib", [10], module="fib") == [55]
+        assert _invoke(svc, "dbl", [4], module="dbl") == [15]
+    finally:
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# durability: kill -> resume brings back modules, ids, results
+# ---------------------------------------------------------------------------
+def test_kill_resume_restores_modules_and_request_ids(tmp_path):
+    d = str(tmp_path / "state")
+    svc = GatewayService(conf=_conf(), lanes=2, state_dir=d)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    done = svc.submit("fib", [10], module="fib")
+    assert svc.wait(done, timeout_s=120.0)
+    assert done.future.result(0) == [55]
+    done_id = done.id
+    # a long request left unresolved at the kill
+    pending = svc.submit("fib", [24], module="fib")
+    pending_id = pending.id
+    time.sleep(0.2)   # give the serving loop a round or two
+    svc.kill()        # no drain, no flush — the honest crash
+
+    svc2 = GatewayService(conf=_conf(obs=True), lanes=2, state_dir=d,
+                          resume=True)
+    try:
+        # module set back under one boot generation
+        assert svc2.registry.names == ["fib"]
+        assert svc2.counters["generations"] == 1
+        assert svc2.counters["restarts"] == 1
+        assert svc2.counters["resumed"] >= 1
+        assert "gateway_resume" in svc2.obs.event_names()
+
+        # resolved-before-crash id replays from the durable result
+        # cache: exactly-once (same result, NOT re-counted as new work)
+        state, req = svc2.request_state(done_id)
+        assert state == "ok"
+        assert req.future.done and req.future.result(0) == [55]
+        assert svc2.counters["completed"] == 0
+
+        # the unresolved id survives under its ORIGINAL id and
+        # resolves (re-queued or adopted; at-least-once)
+        state, req2 = svc2.request_state(pending_id)
+        assert state == "ok"
+        assert req2.future.wait(120.0)
+        assert req2.future.error is None
+        assert req2.future.result(0) == [_fib(24)]
+
+        # fresh submissions never collide with restored ids
+        fresh = svc2.submit("fib", [9], module="fib")
+        assert fresh.id > pending_id
+        assert svc2.wait(fresh, timeout_s=120.0)
+    finally:
+        svc2.shutdown()
+
+    # a second restart keeps counting (the manifest carries the tally)
+    svc3 = GatewayService(conf=_conf(), lanes=2, state_dir=d,
+                          resume=True)
+    try:
+        assert svc3.counters["restarts"] == 2
+        assert svc3.registry.names == ["fib"]
+    finally:
+        svc3.shutdown()
+
+
+def test_corrupt_newest_journal_falls_back(tmp_path):
+    """The durable snapshots ride the lineage contract: a torn/corrupt
+    newest member is skipped (and counted), the previous one loads."""
+    import os
+
+    from wasmedge_tpu.gateway.durable import DurableStore
+
+    d = str(tmp_path)
+    store = DurableStore(d)
+    store.write_journal([{"id": 1, "func": "f", "args": []}], [])
+    store.write_journal([{"id": 2, "func": "f", "args": []}], [])
+    newest = sorted(fn for fn in os.listdir(d)
+                    if fn.startswith("journal-"))[-1]
+    with open(os.path.join(d, newest), "w") as f:
+        f.write('{"truncated')
+    store2 = DurableStore(d)
+    _, journal = store2.load()
+    assert journal["unresolved"][0]["id"] == 1
+    assert store2.load_errors == 1
+
+
+def test_journal_write_fault_rejects_submission(tmp_path):
+    """A submit whose durable journal write faults is rejected with a
+    retryable DurabilityError — the id is NEVER accepted undurably,
+    and the acceptance is WITHDRAWN (out of the stash, out of the
+    received tally, pulled back from the serving queue so the guest
+    does not run disowned work) — and health degrades until a write
+    succeeds."""
+    from wasmedge_tpu.gateway.durable import DurabilityError
+
+    inj = FaultInjector([Fault(point="journal_write", at=0,
+                               match={"kind": "journal"})])
+    svc = GatewayService(conf=_conf(), lanes=2, faults=inj,
+                         state_dir=str(tmp_path / "state"))
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    try:
+        with pytest.raises(DurabilityError) as exc:
+            svc.submit("fib", [8], module="fib")
+        assert exc.value.retryable is True
+        from wasmedge_tpu.gateway.http import submit_status_of
+
+        assert submit_status_of(exc.value) == 503
+        assert svc.counters["journal_errors"] == 1
+        # the acceptance was fully withdrawn
+        assert svc.counters["received"] == 0
+        assert len(svc._requests) == 0
+        h = svc.health()
+        assert h["status"] == "degraded"
+        assert h["checks"]["journal"]["ok"] is False
+        # the next submit journals fine and health recovers
+        req = svc.submit("fib", [8], module="fib")
+        assert svc.wait(req, timeout_s=120.0)
+        assert svc.health()["checks"]["journal"]["ok"] is True
+    finally:
+        svc.shutdown()
+
+
+def test_withdraw_pulls_a_queued_request_back():
+    """BatchServer.withdraw removes a not-yet-admitted request from
+    the queue (counted rejected, counters reconcile); an already-
+    admitted id reports False and is left to finish."""
+    from tests.test_serve import _server
+
+    srv = _server(lanes=1)
+    # no driver thread: nothing gets admitted until we step
+    f1 = srv.submit("fib", [10])
+    f2 = srv.submit("fib", [11])
+    assert srv.withdraw(f2.request_id) is True
+    assert srv.withdraw(f2.request_id) is False   # already gone
+    assert len(srv.queue) == 1
+    srv.run_until_idle()
+    assert f1.result(0) == [55]
+    assert not f2.done   # withdrawn, never ran
+    c = srv.counters
+    assert c["rejected"] == 1
+    assert c["submitted"] == c["completed"] + c["rejected"]
+    srv.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# truthful health + CLI boot gate
+# ---------------------------------------------------------------------------
+def test_healthz_truthful_over_http():
+    from wasmedge_tpu.common.errors import EngineFailure
+
+    svc = GatewayService(conf=_conf(), lanes=2)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    gw = Gateway(svc, port=0).start()
+    try:
+        from tests.test_gateway import rpc
+
+        st, doc, _ = rpc(gw, "GET", "/healthz")
+        assert st == 200 and doc["ok"] and doc["status"] == "healthy"
+        assert doc["checks"]["driver"]["ok"] is True
+
+        # degraded (failed last swap): still 200, machine-readable why
+        svc.last_swap = {"ok": False, "generation": 1,
+                         "error": "InjectedFault('generation_build')",
+                         "t": 0.0}
+        st, doc, _ = rpc(gw, "GET", "/healthz")
+        assert st == 200 and doc["status"] == "degraded"
+        assert doc["checks"]["last_swap"]["ok"] is False
+        svc.last_swap = None
+
+        # unhealthy (terminally failed generation): 503 — the r11 stub
+        # would have said 200 here
+        srv = svc.current.server
+        srv.failed = EngineFailure("driver dead for the test")
+        st, doc, _ = rpc(gw, "GET", "/healthz")
+        assert st == 503 and not doc["ok"]
+        assert doc["status"] == "unhealthy"
+        assert doc["checks"]["driver"]["ok"] is False
+        srv.failed = None
+        st, doc, _ = rpc(gw, "GET", "/healthz")
+        assert st == 200
+    finally:
+        gw.shutdown(drain=False)
+
+
+def test_cli_resume_reuses_the_same_command_line(tmp_path):
+    """A restart runs the SAME command line (systemd et al.): boot
+    modules the manifest already restored must be skipped, not
+    re-registered into a ModuleNameConflict."""
+    import io
+
+    from wasmedge_tpu.cli import gateway_command
+
+    wasm = tmp_path / "fib.wasm"
+    wasm.write_bytes(build_fib())
+    d = str(tmp_path / "state")
+    argv = [str(wasm), "--port", "0", "--lanes", "2",
+            "--state-dir", d, "--duration", "0.1"]
+    out, errs = io.StringIO(), io.StringIO()
+    assert gateway_command(argv, out=out, err=errs) == 0, errs.getvalue()
+    out2, errs2 = io.StringIO(), io.StringIO()
+    rc = gateway_command(argv + ["--resume"], out=out2, err=errs2)
+    assert rc == 0, errs2.getvalue()
+    startup = json.loads(out2.getvalue().splitlines()[0])
+    assert startup["modules"] == ["main"]
+    assert startup["restarts"] == 1 and startup["durable"] is True
+    # --resume without --state-dir is a usage error
+    rc = gateway_command(["--resume"], out=io.StringIO(),
+                         err=(e3 := io.StringIO()))
+    assert rc == 2 and "--state-dir" in e3.getvalue()
+
+
+def test_cli_gateway_exits_nonzero_on_unhealthy_boot(tmp_path,
+                                                    monkeypatch):
+    import io
+
+    from wasmedge_tpu.cli import gateway_command
+
+    wasm = tmp_path / "fib.wasm"
+    wasm.write_bytes(build_fib())
+
+    def unhealthy(self, fresh=True):
+        return {"ok": False, "status": "unhealthy", "checks": {
+            "driver": {"ok": False, "level": "unhealthy",
+                       "detail": "driver thread died at boot"}}}
+
+    monkeypatch.setattr(GatewayService, "health", unhealthy)
+    out, errs = io.StringIO(), io.StringIO()
+    rc = gateway_command([str(wasm), "--port", "0", "--lanes", "2",
+                          "--duration", "0.1"], out=out, err=errs)
+    assert rc == 1
+    assert "unhealthy" in errs.getvalue()
+    assert "driver thread died" in errs.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# health-gated shedding
+# ---------------------------------------------------------------------------
+def test_degraded_gateway_sheds_lowest_weight_tier():
+    tenants = GatewayTenants.from_dict({"tenants": {
+        "gold": {"weight": 3.0},
+    }})
+    # tiers: {3.0, 1.0-default} -> floor 1.0: default-tier tenants shed
+    assert tenants.shed_weight_floor() == 1.0
+    svc = GatewayService(conf=_conf(), lanes=2, tenants=tenants)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    try:
+        svc.force_degraded = True
+        with pytest.raises(ShedLoad) as exc:
+            svc.submit("fib", [8], module="fib", tenant="bronze")
+        assert exc.value.retryable is True
+        info = rejection_info(exc.value)
+        assert info["retryable"] is True and info["detail"] == "shed"
+        from wasmedge_tpu.gateway.http import (
+            retry_after_of,
+            submit_status_of,
+        )
+
+        assert submit_status_of(exc.value) == 429
+        assert retry_after_of(exc.value) is not None
+        assert svc.counters["shed"] == 1
+        assert svc.shed_counts == {"bronze": 1}
+        # gold traffic keeps flowing while degraded
+        assert _invoke(svc, "fib", [10], module="fib",
+                       tenant="gold") == [55]
+        # the per-tenant counter lands in the Prometheus export
+        text = svc.metrics_text()
+        assert 'wasmedge_gateway_shed_total{tenant="bronze"} 1' in text
+        # recovery: healthy again -> the shed tenant serves
+        svc.force_degraded = False
+        assert _invoke(svc, "fib", [9], module="fib",
+                       tenant="bronze") == [34]
+    finally:
+        svc.shutdown()
+
+
+def test_single_tier_never_sheds():
+    """With every tenant on one weight tier there is no 'lowest' to
+    sacrifice — shedding everyone would turn degradation into an
+    outage, so the gateway falls back to ordinary backpressure."""
+    tenants = GatewayTenants()
+    assert tenants.shed_weight_floor() is None
+    # under require_auth the phantom 1.0 default tier must not count:
+    # two authenticated tenants both at 0.5 are ONE tier, unsheddable
+    closed = GatewayTenants.from_dict({
+        "require_auth": True,
+        "tenants": {"a": {"api_key": "ka", "weight": 0.5},
+                    "b": {"api_key": "kb", "weight": 0.5}}})
+    assert closed.shed_weight_floor() is None
+    # the same weights in an OPEN config shed (unlisted tenants ride
+    # the 1.0 default tier above them)
+    open_ = GatewayTenants.from_dict({
+        "tenants": {"a": {"weight": 0.5}, "b": {"weight": 0.5}}})
+    assert open_.shed_weight_floor() == 0.5
+    svc = GatewayService(conf=_conf(), lanes=2, tenants=tenants)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    try:
+        svc.force_degraded = True
+        assert svc.health()["status"] == "degraded"
+        assert _invoke(svc, "fib", [8], module="fib") == [21]
+    finally:
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# stash pruning vs polling clients + result_cache knob
+# ---------------------------------------------------------------------------
+def test_pruned_async_id_distinct_404_and_result_cache_knob():
+    svc = GatewayService(conf=_conf(), lanes=2, result_cache=2)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    gw = Gateway(svc, port=0).start()
+    try:
+        ids = []
+        for n in (8, 9, 10):
+            req = svc.submit("fib", [n], module="fib")
+            assert svc.wait(req, timeout_s=120.0)
+            ids.append(req.id)
+        # result_cache=2: the oldest resolved id was pruned
+        assert svc.request_state(ids[0]) == ("pruned", None)
+        state, req = svc.request_state(ids[2])
+        assert state == "ok" and req.future.result(0) == [55]
+
+        from tests.test_gateway import rpc
+
+        # distinct machine-readable detail for the pruned id ...
+        st, doc, _ = rpc(gw, "GET", f"/v1/requests/{ids[0]}")
+        assert st == 404
+        assert doc["err"]["detail"] == "pruned"
+        assert doc["err"]["name"] == "NotFound"
+        # ... which a never-issued id does NOT carry
+        st, doc, _ = rpc(gw, "GET", "/v1/requests/999999")
+        assert st == 404
+        assert "detail" not in doc["err"]
+        # live ids still poll fine
+        st, doc, _ = rpc(gw, "GET", f"/v1/requests/{ids[2]}")
+        assert st == 200 and doc["ok"] and doc["result"] == [55]
+    finally:
+        gw.shutdown(drain=False)
+
+
+def test_aged_out_id_answers_pruned_after_resume(tmp_path):
+    """A resolved id whose entry aged out of the durable result cache
+    still answers the PRUNED 404 detail after a restart (the journaled
+    max-id floor marks it issued-and-aged) — never the generic
+    unknown-id message a client would read as 'my 202 never existed'."""
+    d = str(tmp_path / "state")
+    svc = GatewayService(conf=_conf(), lanes=2, state_dir=d,
+                         result_cache=1)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    first = svc.submit("fib", [8], module="fib")
+    assert svc.wait(first, timeout_s=120.0)
+    second = svc.submit("fib", [9], module="fib")
+    assert svc.wait(second, timeout_s=120.0)
+    # result_cache=1: first's durable entry was displaced by second's
+    assert svc.request_state(first.id) == ("pruned", None)
+    svc.kill()
+    svc2 = GatewayService(conf=_conf(), lanes=2, state_dir=d,
+                          result_cache=1, resume=True)
+    try:
+        assert svc2.request_state(first.id) == ("pruned", None)
+        state, req = svc2.request_state(second.id)
+        assert state == "ok" and req.future.result(0) == [34]
+        # a genuinely never-issued id stays "unknown"
+        assert svc2.request_state(999999) == ("unknown", None)
+        # fresh ids allocate above the journaled floor
+        fresh = svc2.submit("fib", [8], module="fib")
+        assert fresh.id > second.id
+        assert svc2.wait(fresh, timeout_s=120.0)
+    finally:
+        svc2.shutdown()
+
+
+def test_gateway_closed_is_retryable_with_retry_after():
+    """'Gateway shutting down' carries the full retryable contract
+    (503 + Retry-After): the same request is welcome at the restarted
+    gateway — while the permanent admission block (same ErrCode) stays
+    non-retryable."""
+    from wasmedge_tpu.gateway.http import retry_after_of
+    from wasmedge_tpu.gateway.service import GatewayClosed
+
+    from wasmedge_tpu.common.errors import ErrCode
+
+    exc = GatewayClosed()
+    assert exc.retryable is True
+    assert rejection_info(exc)["retryable"] is True
+    assert retry_after_of(exc) is not None
+    assert WasmError(ErrCode.Terminated).retryable is False
+
+
+# ---------------------------------------------------------------------------
+# chaos plumbing: seeded schedule + restart counters in the export
+# ---------------------------------------------------------------------------
+def test_gateway_chaos_schedule_is_deterministic():
+    from wasmedge_tpu.testing.faults import gateway_chaos_schedule
+
+    a = gateway_chaos_schedule(13)
+    b = gateway_chaos_schedule(13)
+    assert [(f.point, f.at) for f in a] == [(f.point, f.at) for f in b]
+    points = {f.point for f in a}
+    assert points & {"launch", "serve"}
+    assert points & {"generation_build", "generation_swap"}
+    assert "journal_write" in points
+    # the swap fault targets the FIRST runtime registration (arrival 0
+    # is the boot build), so one registration deterministically draws it
+    swap = [f for f in a
+            if f.point in ("generation_build", "generation_swap")]
+    assert all(f.at == 1 + 2 * k for k, f in enumerate(swap))
+    # drops only ever target the (retried-harmlessly) polling route
+    for f in a:
+        if f.point == "http_response_drop":
+            assert f.match == {"route": "requests"}
+
+
+def test_restart_and_rollback_counters_in_prometheus():
+    from wasmedge_tpu.obs.metrics import parse_prometheus
+
+    svc = GatewayService(conf=_conf(), lanes=2)
+    svc.register_module("fib", wasm_bytes=build_fib(), source="boot")
+    try:
+        parsed = parse_prometheus(svc.metrics_text())
+        assert parsed[("wasmedge_gateway_restarts_total",
+                       frozenset())] == 0.0
+        assert parsed[("wasmedge_generation_rollbacks_total",
+                       frozenset())] == 0.0
+    finally:
+        svc.shutdown()

@@ -51,6 +51,14 @@ class Lineage:
     def newest(self) -> Optional[Member]:
         return self.members[-1] if self.members else None
 
+    def next_seq(self) -> int:
+        """The cursor one past the newest member's — snapshot consumers
+        whose members are plain sequence-numbered files (the gateway's
+        durable manifest/journal, gateway/durable.py) allocate their
+        next filename from it."""
+        m = self.newest()
+        return (m.steps + 1) if m is not None else 0
+
     def reset(self):
         """Drop every member (a fresh run must never inherit a previous
         run()'s lineage; only an explicit resume adopts disk state)."""

@@ -484,6 +484,24 @@ def _gateway_parser() -> ArgumentParser:
     p.add_option(["obs"],
                  Toggle("enable the flight recorder (gateway/<tenant> "
                         "spans, drain histograms; served at /metrics)"))
+    p.add_option(["state-dir"],
+                 Option("durable gateway state directory: registered "
+                        "module store + async-request journal + serve "
+                        "checkpoints (crash/restart survivable)",
+                        "dir"))
+    p.add_option(["resume"],
+                 Toggle("adopt an existing --state-dir at startup: "
+                        "re-register the stored module set, restore "
+                        "the serving checkpoint lineage, re-queue "
+                        "journaled unresolved request ids"))
+    p.add_option(["build-timeout"],
+                 Option("generation build timeout in seconds; a build "
+                        "exceeding it rolls back with a retryable 503 "
+                        "(default 120)", "s", typ=float))
+    p.add_option(["result-cache"],
+                 Option("resolved async requests kept pollable (and "
+                        "durably replayable) before pruning "
+                        "(default 4096)", "n", typ=int))
     p.add_option(["duration"],
                  Option("serve for N seconds then drain and exit "
                         "(default: until SIGINT)", "s", typ=float))
@@ -531,8 +549,21 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
         except (OSError, ValueError, KeyError) as e:
             err.write(f"wasmedge-tpu: bad tenants file: {e}\n")
             return 2
-    svc = GatewayService(conf=conf, lanes=p._opts["lanes"].value,
-                         tenants=tenants)
+    if p._opts["resume"].value and not p._opts["state-dir"].seen:
+        err.write("wasmedge-tpu: --resume requires --state-dir\n")
+        return 2
+    try:
+        svc = GatewayService(
+            conf=conf, lanes=p._opts["lanes"].value, tenants=tenants,
+            state_dir=p._opts["state-dir"].value,
+            resume=p._opts["resume"].value,
+            build_timeout_s=p._opts["build-timeout"].value
+            if p._opts["build-timeout"].seen else 120.0,
+            result_cache=p._opts["result-cache"].value
+            if p._opts["result-cache"].seen else 4096)
+    except (WasmError, ValueError, OSError) as e:
+        err.write(f"wasmedge-tpu: gateway resume failed: {e}\n")
+        return 1
     boot = []
     if p.positional_values:
         boot.append(("main", p.positional_values[0]))
@@ -551,6 +582,12 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
         except OSError as e:
             err.write(f"wasmedge-tpu: cannot read {path}: {e}\n")
             return 1
+    if p._opts["resume"].value:
+        # a restart reuses the SAME command line (systemd et al.): boot
+        # modules the manifest already restored must not re-register
+        # and collide with themselves
+        restored = set(svc.registry.names)
+        entries = [(n, b) for n, b in entries if n not in restored]
     if entries:
         try:
             # ONE generation for the whole boot set — not a build-and-
@@ -559,17 +596,33 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
         except (WasmError, ValueError) as e:
             err.write(f"wasmedge-tpu: boot module rejected: {e}\n")
             return 1
+    # truthful-health boot gate: a dead driver thread or a terminally
+    # failed boot generation must fail the command, not silently serve
+    # 503s until someone notices (the /healthz fix's CLI half)
+    health = svc.health()
+    if health["status"] == "unhealthy":
+        bad = "; ".join(c["detail"] for c in health["checks"].values()
+                        if not c["ok"])
+        err.write(f"wasmedge-tpu: gateway unhealthy after boot: "
+                  f"{bad}\n")
+        svc.shutdown(drain=False)
+        return 1
     try:
         gw = Gateway(svc, host=p._opts["host"].value,
                      port=p._opts["port"].value).start()
     except OSError as e:
         err.write(f"wasmedge-tpu: cannot bind: {e}\n")
+        svc.shutdown(drain=False)
         return 1
     out.write(json.dumps({
         "listening": f"http://{gw.host}:{gw.port}",
         "modules": svc.registry.names,
         "lanes": svc.lanes,
         "tenants": sorted(svc.tenants.policies),
+        "health": health["status"],
+        "durable": svc.durable is not None,
+        "restarts": svc.counters["restarts"],
+        "resumed_requests": svc.counters["resumed"],
     }) + "\n")
     out.flush()
     duration = p._opts["duration"].value

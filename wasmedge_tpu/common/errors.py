@@ -211,6 +211,13 @@ def rejection_info(exc: BaseException) -> dict:
         after = getattr(exc, "retry_after_s", None)
         if after is not None:
             out["retry_after_s"] = float(after)
+        detail = getattr(exc, "detail", None)
+        if detail:
+            # machine-readable sub-taxonomy inside one ErrCode (e.g. a
+            # pruned async id is NotFound like an unknown id, but a
+            # client that cached the 202 must be able to tell "your id
+            # aged out" from "never existed"); stable token, not prose
+            out["detail"] = str(detail)
         violations = getattr(exc, "violations", None)
         if violations:
             # static-analysis admission rejections carry the per-limit

@@ -1,26 +1,43 @@
-"""Network-facing multi-tenant serving gateway (r11).
+"""Network-facing multi-tenant serving gateway (r11, durable r13).
 
 The front door of the "millions of users" story: a stdlib HTTP server
 (gateway/http.py) over a generation-swapped fleet of BatchServers
 (gateway/service.py), with runtime guest-module registration through
-the full loader -> validator -> image pipeline (gateway/registry.py)
-and per-tenant auth/rate/quota edge policy (gateway/tenants.py).
+the full loader -> validator -> image pipeline (gateway/registry.py),
+per-tenant auth/rate/quota edge policy (gateway/tenants.py),
+crash/restart durability over an on-disk module store + async-request
+journal (gateway/durable.py), and truthful health + degraded-mode load
+shedding (gateway/health.py).
 
     from wasmedge_tpu.gateway import Gateway, GatewayService
 
-    svc = GatewayService(lanes=64)
+    svc = GatewayService(lanes=64, state_dir="/var/lib/wasmedge-gw")
     svc.register_module("fib", wasm_bytes=data)
     gw = Gateway(svc, port=8080).start()
     # POST /v1/invoke {"module": "fib", "func": "fib", "args": [30]}
+    # ... crash ...
+    svc = GatewayService(lanes=64, state_dir="/var/lib/wasmedge-gw",
+                         resume=True)   # modules + 202 ids come back
 
-or `wasmedge-tpu gateway app.wasm --port 8080` from the CLI.
+or `wasmedge-tpu gateway app.wasm --port 8080 --state-dir d [--resume]`
+from the CLI.
 """
 
+from wasmedge_tpu.gateway.durable import (  # noqa: F401
+    DurabilityError,
+    DurableStore,
+)
+from wasmedge_tpu.gateway.health import (  # noqa: F401
+    HealthGate,
+    ShedLoad,
+    health_of,
+)
 from wasmedge_tpu.gateway.http import Gateway  # noqa: F401
 from wasmedge_tpu.gateway.registry import ModuleRegistry  # noqa: F401
 from wasmedge_tpu.gateway.service import (  # noqa: F401
     GatewayRequest,
     GatewayService,
+    GenerationBuildFailed,
 )
 from wasmedge_tpu.gateway.tenants import (  # noqa: F401
     AuthError,

@@ -12,30 +12,49 @@ and the protocol is deliberately small:
                           with ?name=) -> 201 + generation
   GET  /v1/status        queue/occupancy/generation counters (JSON)
   GET  /metrics          Prometheus text exposition
-  GET  /healthz          liveness
+  GET  /healthz          TRUTHFUL health (gateway/health.py): driver
+                          liveness, last-swap outcome, queue
+                          saturation, checkpoint/journal write health.
+                          200 healthy, 200 + status "degraded" with
+                          the failing checks in the body, 503
+                          unhealthy — machine-readable either way.
 
 Status-code contract (the machine-readable rejection taxonomy of
 common/errors.rejection_info on the wire):
 
   429 + Retry-After   QueueSaturated backpressure / tenant rate limit
-                      (the ONE retryable class)
+                      / degraded-mode load shedding (ShedLoad carries
+                      detail "shed") — the retryable classes
   504                 DeadlineExceeded (queued or in flight)
   401 / 403           auth stub rejection / permanent admission block,
                       registration not allowed
-  404                 unknown module, function, or request id
+  404                 unknown module, function, or request id; a
+                      PRUNED async id carries detail "pruned" so a
+                      client holding a real 202 can tell "aged out"
+                      from "never existed"
   400                 malformed request, bad/unbatchable wasm
                       (Load/Validation ErrCode in the body), or a
                       static admission policy violation
                       (StaticPolicyViolation + per-limit violations
                       list, analysis/policy.py)
   409                 duplicate module name
-  503                 server terminal failure / shutting down
+  503 + Retry-After   retryable infrastructure: a rolled-back
+                      generation build/swap (GenerationBuildFailed),
+                      a failed durable journal write (the 202 id was
+                      never issued), gateway shutting down
+  503                 server terminal failure
   200 {"ok": false}   the request RAN and trapped — guest-level
                       failures carry the ErrCode taxonomy in the body,
                       exactly like the CLI's per-request reporting
 
 Auth: `Authorization: Bearer <key>` or `X-Api-Key: <key>`; the key
 resolves the tenant (gateway/tenants.py).
+
+Chaos seams: with a FaultInjector armed on the service, the
+`http_response_delay` / `http_response_drop` seams fire per response —
+delay sleeps ~50ms before the bytes, drop severs the connection with
+no response written (testing/faults.py; absorbed here, never raised
+to the route handlers).
 """
 
 from __future__ import annotations
@@ -56,6 +75,8 @@ from wasmedge_tpu.common.errors import (
     WasmError,
     rejection_info,
 )
+from wasmedge_tpu.gateway.durable import DurabilityError
+from wasmedge_tpu.gateway.health import ShedLoad
 from wasmedge_tpu.gateway.service import (
     GatewayClosed,
     GatewayRequest,
@@ -93,16 +114,21 @@ def submit_status_of(exc: BaseException) -> int:
     admission, registration, routing)."""
     if isinstance(exc, AuthError):
         return 401
-    if isinstance(exc, (RateLimited, QueueSaturated)):
+    if isinstance(exc, (RateLimited, QueueSaturated, ShedLoad)):
         return 429
     if isinstance(exc, DeadlineExceeded):
         return 504
     if isinstance(exc, KeyError):
         return 404
+    if isinstance(exc, DurabilityError):
+        # the journal write failed, so the id was never accepted:
+        # service unavailable, retry against a recovered gateway
+        return 503
     if isinstance(exc, (EngineFailure, GatewayClosed)):
-        # terminal generation failure / gateway going down: service
-        # unavailable, NOT a permission problem — clients may retry
-        # against a restarted gateway
+        # terminal generation failure, a rolled-back generation
+        # build/swap (GenerationBuildFailed, retryable), or the
+        # gateway going down: service unavailable, NOT a permission
+        # problem — clients may retry against a recovered gateway
         return 503
     if isinstance(exc, (LoadError, ValidationError, InstantiationError)):
         return 400
@@ -120,8 +146,11 @@ def submit_status_of(exc: BaseException) -> int:
 
 
 def retry_after_of(exc: BaseException) -> Optional[str]:
+    """Retry-After for every retryable rejection (backpressure, rate
+    limit, shedding, rolled-back swap, failed journal write) — the
+    header IS the machine-readable half of "try again"."""
     after = getattr(exc, "retry_after_s", None)
-    if isinstance(exc, (RateLimited, QueueSaturated)):
+    if isinstance(exc, RateLimited) or getattr(exc, "retryable", False):
         if after is None or not math.isfinite(after):
             return "1"
         return str(max(1, math.ceil(after)))
@@ -167,8 +196,48 @@ class GatewayHandler(BaseHTTPRequestHandler):
         return self.server.service
 
     # -- plumbing ----------------------------------------------------------
+    def _chaos_edge(self, code: int) -> bool:
+        """Fire the HTTP edge fault seams (absorbed, never raised to
+        the routes): delay sleeps before the response bytes; drop
+        severs the connection with nothing written.  Returns True when
+        the response must be dropped."""
+        faults = self.svc.faults
+        if faults is None:
+            return False
+        from wasmedge_tpu.testing.faults import InjectedFault
+
+        import time as _time
+
+        # coarse route tag so Fault.match can target e.g. only the
+        # polling traffic ({"route": "requests"}) without enumerating
+        # per-id paths
+        path = self.path.split("?", 1)[0]
+        route = path.strip("/").split("/")[-1] if path != "/" else ""
+        if path.startswith("/v1/requests/"):
+            route = "requests"
+        try:
+            faults.fire("http_response_delay", path=self.path,
+                        route=route, code=int(code))
+        except InjectedFault:
+            _time.sleep(0.05)
+        try:
+            faults.fire("http_response_drop", path=self.path,
+                        route=route, code=int(code))
+        except InjectedFault:
+            return True
+        return False
+
     def _reply(self, code: int, body, content_type="application/json",
                headers=None):
+        if self._chaos_edge(code):
+            # injected wire failure: close with no response (the client
+            # sees a severed connection, exactly like a dropped packet)
+            self.close_connection = True
+            try:
+                self.wfile.flush()
+            except OSError:
+                pass
+            return
         data = body if isinstance(body, (bytes, bytearray)) \
             else json.dumps(body).encode()
         self.send_response(code)
@@ -209,7 +278,11 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 return self._reply(200, self.svc.metrics_text().encode(),
                                    content_type="text/plain; version=0.0.4")
             if url.path == "/healthz":
-                return self._reply(200, {"ok": True})
+                # truthful health: a dead driver thread or terminally
+                # failed generation answers 503, a degraded gateway
+                # answers 200 with the failing checks in the body
+                h = self.svc.health()
+                return self._reply(200 if h["ok"] else 503, h)
             if url.path.startswith("/v1/requests/"):
                 return self._get_request(url.path)
             return self._reply(404, {"ok": False, "err": {
@@ -279,9 +352,18 @@ class GatewayHandler(BaseHTTPRequestHandler):
             rid = int(path.rsplit("/", 1)[1])
         except ValueError:
             raise ValueError(f"bad request id in {path!r}") from None
-        req = self.svc.get_request(rid)
+        state, req = self.svc.request_state(rid)
         if req is None:
-            raise KeyError(f"no request {rid} (unknown or pruned)")
+            if state == "pruned":
+                # the id WAS real; its resolved entry aged out of the
+                # result cache — distinct detail so a polling client
+                # can stop retrying instead of doubting its own 202
+                return self._reply(404, {"ok": False, "err": {
+                    "name": "NotFound", "retryable": False,
+                    "detail": "pruned",
+                    "message": f"request {rid} was resolved and its "
+                               f"result pruned from the cache"}})
+            raise KeyError(f"no request {rid}")
         if not req.future.done:
             return self._reply(200, {"ok": True, "status": "pending",
                                      "request_id": req.id})
@@ -365,3 +447,16 @@ class Gateway:
             self._thread.join(timeout=5.0)
             self._thread = None
         self.service.shutdown(drain=drain, timeout_s=timeout_s)
+
+    def kill(self):
+        """Simulated SIGKILL (chaos harness): close the listening
+        socket and stop the serving threads with NO drain, NO future
+        resolution, NO journal flush — on-disk state is exactly what a
+        real crash leaves.  Restart with GatewayService(resume=True)
+        over the same state_dir."""
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.service.kill()

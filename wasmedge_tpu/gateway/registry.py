@@ -43,10 +43,10 @@ class RegisteredModule:
     reuses — registering module N must not re-lower modules 1..N-1)."""
 
     __slots__ = ("name", "inst", "store", "engine", "sha256", "nbytes",
-                 "source", "wasi", "_sink_fds")
+                 "source", "tenant", "wasi", "_sink_fds")
 
     def __init__(self, name, inst, store, engine, sha256="", nbytes=0,
-                 source="boot", sink_fds=(), wasi=None):
+                 source="boot", tenant=None, sink_fds=(), wasi=None):
         self.name = name
         self.inst = inst
         self.store = store
@@ -54,6 +54,10 @@ class RegisteredModule:
         self.sha256 = sha256
         self.nbytes = nbytes
         self.source = source
+        # the tenant that registered this module (None = operator/boot).
+        # Rides the durable manifest so a resumed gateway re-registers
+        # under the same attribution (gateway/durable.py).
+        self.tenant = tenant
         self.wasi = wasi  # per-module WasiModule (None on boot path)
         self._sink_fds = list(sink_fds)
 
@@ -112,7 +116,8 @@ class ModuleRegistry:
 
     # -- registration ------------------------------------------------------
     def add_wasm(self, name: str, data: bytes,
-                 source: str = "http") -> RegisteredModule:
+                 source: str = "http",
+                 tenant: Optional[str] = None) -> RegisteredModule:
         """Validate + compile + instantiate `data` and register it under
         `name`.  Raises WasmError(ModuleNameConflict) for a duplicate
         name, Load/Validation/Instantiation errors for bad wasm, and
@@ -133,6 +138,7 @@ class ModuleRegistry:
             # probe's engine under the new name instead of re-lowering
             cached.rename(name)
             cached.source = source
+            cached.tenant = tenant
             return self._install(cached)
         mod = Validator(self.conf).validate(
             Loader(self.conf).parse_module(data))
@@ -165,8 +171,8 @@ class ModuleRegistry:
             raise
         rm = RegisteredModule(
             name, inst, store, eng, sha256=sha,
-            nbytes=len(data), source=source, sink_fds=sinks,
-            wasi=wasi)
+            nbytes=len(data), source=source, tenant=tenant,
+            sink_fds=sinks, wasi=wasi)
         return self._install(rm)
 
     def add_instance(self, name: str, inst, store,

@@ -36,39 +36,102 @@ Observability (off by default, like every other obs track): a
 module/func/outcome in args) on the shared flight recorder, plus
 `wasmedge_gateway_http_requests_total{code}` counters in the
 Prometheus export fed by the HTTP layer's `count_http`.
+
+r13 made the front door crash-survivable and self-degrading:
+
+  durability    `state_dir=` attaches a gateway/durable.py DurableStore
+                (module blobs + manifest + async-request journal, all
+                crash-atomic); `resume=True` re-registers the stored
+                module set under ONE boot generation, adopts the
+                previous generation's BatchServer checkpoint lineage,
+                replays resolved ids from the durable result cache and
+                re-queues the rest under their ORIGINAL ids — a
+                polling client's 202 id survives the restart
+  swap safety   generation builds run against a build timeout on a
+                worker thread; a build/swap that fails or times out
+                rolls back ATOMICALLY (registry stash kept for the
+                retry, submit pointer untouched, prior generation
+                keeps serving bit-identically) and the registration
+                returns a retryable GenerationBuildFailed (HTTP 503)
+  health        `health()` (gateway/health.py) is the truthful
+                /healthz: driver liveness, last-swap outcome, queue
+                saturation, checkpoint/journal write health -> one of
+                healthy / degraded / unhealthy
+  shedding      while degraded, submissions from the lowest-weight
+                tenant tier reject up front with a retryable 429
+                (ShedLoad) instead of queueing into a timeout
+  chaos seams   a testing/faults.py FaultInjector handed in as
+                `faults=` arms gateway_register / generation_build /
+                generation_swap / journal_write (plus the engine-tier
+                launch/serve/checkpoint seams on every generation's
+                BatchServer); `kill()` is the supported simulated
+                SIGKILL the chaos harness restarts from
 """
 
 from __future__ import annotations
 
 import copy
+import os
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
 
-from wasmedge_tpu.common.errors import ErrCode, WasmError
+from wasmedge_tpu.common.errors import EngineFailure, ErrCode, WasmError
+from wasmedge_tpu.gateway.durable import (
+    DurabilityError,
+    DurableStore,
+    _resolved_entry,
+    resolved_error,
+)
+from wasmedge_tpu.gateway.health import HealthGate
 from wasmedge_tpu.gateway.registry import ModuleRegistry
 from wasmedge_tpu.gateway.tenants import GatewayTenants
+
+# ids remembered as "pruned" (vs never-issued) for the distinct 404
+# detail; bounded so a long-lived gateway can't grow it forever
+_PRUNED_MEMORY = 65536
+
+
+class GenerationBuildFailed(EngineFailure):
+    """A serving-generation build or swap failed (or exceeded the build
+    timeout) and was rolled back: the PRIOR generation kept serving and
+    nothing was half-swapped.  Retryable — the lowered module is
+    stashed in the registry's probe cache, so a re-POST of the same
+    bytes skips the lowering and retries only the build."""
+
+    retryable = True
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        self.retry_after_s = 1.0
 
 
 class GatewayClosed(WasmError):
     """The gateway is shutting down — distinct from a tenant's
     permanent admission block (both ride ErrCode.Terminated): the HTTP
     layer maps THIS to 503 (restarting service, come back) and the
-    admission block to 403 (your policy forbids it, don't)."""
+    admission block to 403 (your policy forbids it, don't).
+    Retryable: the SAME request is welcome at the restarted gateway,
+    so the 503 carries Retry-After like the other transient classes."""
+
+    retryable = True
 
     def __init__(self):
         super().__init__(ErrCode.Terminated, "gateway shut down")
+        self.retry_after_s = 1.0
 
 
 class GatewayRequest:
     """Stash entry for one gateway request (sync waiters and async
-    pollers share it)."""
+    pollers share it).  `args`/`deadline_s` ride along for the durable
+    journal — a re-queued request must be re-executable verbatim."""
 
     __slots__ = ("id", "tenant", "module", "func", "future", "t_recv",
-                 "gen_id", "finalized")
+                 "gen_id", "finalized", "args", "deadline_s")
 
-    def __init__(self, future, tenant, module, func, gen_id, t_recv):
+    def __init__(self, future, tenant, module, func, gen_id, t_recv,
+                 args=(), deadline_s=None):
         self.id = future.request_id
         self.future = future
         self.tenant = tenant
@@ -77,16 +140,19 @@ class GatewayRequest:
         self.gen_id = gen_id
         self.t_recv = t_recv
         self.finalized = False
+        self.args = tuple(int(a) for a in args)
+        self.deadline_s = deadline_s
 
 
 class _Generation:
-    __slots__ = ("gen_id", "engine", "server", "modules")
+    __slots__ = ("gen_id", "engine", "server", "modules", "serve_dir")
 
-    def __init__(self, gen_id, engine, server, modules):
+    def __init__(self, gen_id, engine, server, modules, serve_dir=None):
         self.gen_id = gen_id
         self.engine = engine
         self.server = server
         self.modules = tuple(modules)
+        self.serve_dir = serve_dir
 
 
 class GatewayService:
@@ -100,7 +166,12 @@ class GatewayService:
                  tenants: Optional[GatewayTenants] = None,
                  result_cache: int = 4096,
                  sync_wait_s: float = 60.0,
-                 sink_stdout: bool = True):
+                 sink_stdout: bool = True,
+                 faults=None,
+                 state_dir: Optional[str] = None,
+                 resume: bool = False,
+                 build_timeout_s: Optional[float] = 120.0,
+                 shed_on_degraded: bool = True):
         from wasmedge_tpu.common.configure import Configure
         from wasmedge_tpu.obs.recorder import recorder_of
 
@@ -114,6 +185,10 @@ class GatewayService:
                                        sink_stdout=sink_stdout)
         self.result_cache = int(result_cache)
         self.sync_wait_s = float(sync_wait_s)
+        self.faults = faults
+        self.build_timeout_s = build_timeout_s
+        self.shed_on_degraded = bool(shed_on_degraded)
+        self.force_degraded = False   # operator/test switch
         self._lock = threading.RLock()
         self._reg_lock = threading.Lock()   # one registration at a time
         self._gens: List[_Generation] = []  # current is last
@@ -121,18 +196,54 @@ class GatewayService:
         self._reapers: List[threading.Thread] = []
         self._requests: Dict[int, GatewayRequest] = {}
         self._resolved = deque()
+        self._pruned: "deque[int]" = deque(maxlen=_PRUNED_MEMORY)
+        self._pruned_set = set()
         self._closed = False
         self.http_counts: Dict[str, int] = {}
+        self.last_swap: Optional[dict] = None
+        self.shed_counts: Dict[str, int] = {}
         self.counters = {
             "received": 0, "completed": 0, "failed": 0, "deadline": 0,
             "rejected": 0, "rate_limited": 0, "registered_modules": 0,
             "generations": 0, "policy_rejected": 0,
+            "restarts": 0, "rollbacks": 0, "shed": 0,
+            "journal_errors": 0, "resumed": 0,
         }
         # static-analysis admission summary (obs/metrics.py renders it
         # as wasmedge_analysis_* counters): verdicts of every module
         # that reached the policy gate + rejections it issued
         self.analysis_counts = {"bounded": 0, "unbounded": 0,
                                 "policy_rejected": 0}
+        # durable result cache mirrored to the journal: finalized
+        # request outcomes a resumed gateway replays verbatim.  Capped
+        # below the (in-memory) stash depth — every journal write
+        # serializes this list, so its size is hot-path cost, and the
+        # ISSUE contract is a SMALL durable cache with older ids
+        # degrading to the pruned-404 answer
+        self._durable_cache_depth = min(max(self.result_cache, 1), 512)
+        self._result_cache = deque(maxlen=self._durable_cache_depth)
+        self._journal_fail_streak = 0
+        self._manifest_dirty = False
+        # serializes snapshot->write so an older journal snapshot can
+        # never land a NEWER sequence number (which would make it the
+        # authoritative journal and lose a durably-accepted id)
+        self._journal_mutex = threading.Lock()
+        # ids at/below this were issued by a pre-crash process: an
+        # unknown id under the floor answers the pruned 404 detail,
+        # not "never existed" (journaled as max_id)
+        self._resume_floor = 0
+        # pending serve-lineage adoption consumed by the next
+        # generation build (set only during _resume_from_disk)
+        self._pending_resume: Optional[str] = None
+        self.durable = DurableStore(
+            state_dir, faults=faults,
+            result_cache=self._durable_cache_depth) \
+            if state_dir else None
+        self._health = HealthGate(self)
+        if resume:
+            if self.durable is None:
+                raise ValueError("resume=True requires a state_dir")
+            self._resume_from_disk()
 
     # -- generations -------------------------------------------------------
     @property
@@ -145,9 +256,18 @@ class GatewayService:
         with self._lock:
             return self._gens[-1].gen_id if self._gens else 0
 
-    def _build_generation(self) -> _Generation:
+    def _make_generation(self, gen_id: int, serve_dir: Optional[str],
+                         resume: bool) -> _Generation:
+        """Pure build of generation `gen_id` (no shared-state commit
+        and NO disk mutation — the timed wrapper may abandon this work
+        on timeout, and the retry reuses `gen_id`, so an abandoned
+        thread must not be able to touch the retry's live
+        serve-checkpoint directory)."""
         from wasmedge_tpu.serve.server import BatchServer
 
+        if self.faults is not None:
+            self.faults.fire("generation_build", generation=gen_id,
+                             modules=self.registry.names)
         conf = copy.deepcopy(self.template)
         if conf.serve.autotune:
             # the tuner reads the drain-latency histograms: the flag
@@ -156,19 +276,90 @@ class GatewayService:
             # silent no-op (the injected-engine path cannot fix this
             # up afterwards the way BatchServer's own build can)
             conf.obs.enabled = True
+        if serve_dir is not None \
+                and conf.serve.checkpoint_every_rounds is None:
+            # durability implies a checkpoint cadence — resume has
+            # nothing to adopt otherwise
+            conf.serve.checkpoint_every_rounds = 1
         engine = self.registry.build_engine(conf, self.lanes)
         server = BatchServer(engine=engine,
                              weights=self.tenants.weights(),
-                             quotas=self.tenants.quotas())
-        self._gen_seq += 1
+                             quotas=self.tenants.quotas(),
+                             faults=self.faults,
+                             checkpoint_dir=serve_dir,
+                             resume=resume)
+        return _Generation(gen_id, engine, server, self.registry.names,
+                           serve_dir=serve_dir)
+
+    def _build_generation_timed(self) -> _Generation:
+        """Build the next generation against `build_timeout_s` on a
+        worker thread, so one wedged compile cannot hold the
+        registration lock forever.  A timed-out build is abandoned
+        (daemon thread; it commits nothing and mutates no disk state —
+        the serve-dir wipe happens HERE, on the caller thread, before
+        the worker starts) and surfaces as a retryable
+        GenerationBuildFailed; only a build that returns in time
+        commits the generation counters."""
+        gen_id = self._gen_seq + 1   # under _reg_lock: race-free
+        serve_dir = None
+        resume = False
+        if self._pending_resume is not None:
+            # the resume boot generation adopts the previous process's
+            # serve-checkpoint lineage (in-flight requests come back)
+            serve_dir, resume = self._pending_resume, True
+        elif self.durable is not None:
+            serve_dir = self.durable.serve_dir_for(gen_id)
+            # a non-resume generation owns a FRESH lineage: stale
+            # serve-*.npz from an earlier process in this slot would
+            # otherwise be adoptable by the NEXT resume as phantom state
+            import shutil
+
+            shutil.rmtree(serve_dir, ignore_errors=True)
+        timeout = self.build_timeout_s
+        if timeout is None:
+            gen = self._make_generation(gen_id, serve_dir, resume)
+        else:
+            box: dict = {}
+            done = threading.Event()
+
+            def build():
+                try:
+                    box["gen"] = self._make_generation(gen_id,
+                                                       serve_dir,
+                                                       resume)
+                except BaseException as e:
+                    box["err"] = e
+                finally:
+                    done.set()
+
+            t = threading.Thread(target=build, daemon=True,
+                                 name=f"gw-build-gen{gen_id}")
+            t.start()
+            if not done.wait(float(timeout)):
+                raise GenerationBuildFailed(
+                    f"generation {gen_id} build exceeded the "
+                    f"{timeout}s build timeout")
+            err = box.get("err")
+            if err is not None:
+                if isinstance(err, (KeyboardInterrupt, SystemExit)):
+                    raise err
+                raise GenerationBuildFailed(
+                    f"generation {gen_id} build failed: {err!r}") from err
+            gen = box["gen"]
+        self._gen_seq = gen_id
         self.counters["generations"] += 1
-        return _Generation(self._gen_seq, engine, server,
-                           self.registry.names)
+        return gen
 
     def _swap_in(self, gen: _Generation):
         """Install `gen` as current; the displaced generation drains in
         the background (its in-flight lanes finish on the old image at
-        their own launch boundaries) and is reaped once idle."""
+        their own launch boundaries) and is reaped once idle.  The
+        `generation_swap` fault seam fires BEFORE the server starts or
+        the pointer moves — an injected swap fault rolls back with the
+        submit pointer untouched, never half-swapped."""
+        if self.faults is not None:
+            self.faults.fire("generation_swap", generation=gen.gen_id,
+                             modules=list(gen.modules))
         gen.server.start()
         with self._lock:
             old = self._gens[-1] if self._gens else None
@@ -190,6 +381,10 @@ class GatewayService:
             with self._lock:
                 if old in self._gens:
                     self._gens.remove(old)
+            if self.durable is not None and old.serve_dir \
+                    and not any(g.serve_dir == old.serve_dir
+                                for g in self._gens):
+                self.durable.drop_serve_dir(old.serve_dir)
 
     # -- module registration ----------------------------------------------
     def register_module(self, name: str, wasm_bytes: Optional[bytes] = None,
@@ -201,16 +396,17 @@ class GatewayService:
         pre-instantiated (inst, store) pair (the VM/CLI boot path).
         `tenant` selects the static-analysis admission policy (the
         tenant's own, else the file-level default)."""
-        return self._register([(name, wasm_bytes, inst, store)],
-                              source=source, tenant=tenant)
+        return self._register([(name, wasm_bytes, inst, store, tenant)],
+                              source=source, vet_tenant=tenant)
 
     def preload(self, entries, source: str = "boot") -> dict:
         """Register several modules with ONE generation build — the
         boot path (`--module a=.. --module b=..`) must not pay for and
         immediately drain N-1 throwaway generations.  `entries` is
         [(name, wasm_bytes)]."""
-        return self._register([(n, b, None, None) for n, b in entries],
-                              source=source)
+        return self._register(
+            [(n, b, None, None, None) for n, b in entries],
+            source=source)
 
     def _vet(self, rm, tenant: Optional[str]) -> List[dict]:
         """Static-analysis admission: evaluate the already-built
@@ -245,24 +441,34 @@ class GatewayService:
         return violations
 
     def _register(self, entries, source: str,
-                  tenant: Optional[str] = None) -> dict:
+                  vet_tenant: Optional[str] = None) -> dict:
+        """One registration transaction: add -> vet -> timed build ->
+        swap -> persist.  Every failure before the pointer swap rolls
+        back ATOMICALLY (registry stash kept, prior generation serving
+        bit-identically); build/swap infrastructure failures surface
+        as a retryable GenerationBuildFailed (HTTP 503), while the
+        wasm/policy taxonomy of the add/vet phase passes through
+        unchanged (400s)."""
         with self._reg_lock:
             if self._closed:
                 raise GatewayClosed()
+            if self.faults is not None:
+                self.faults.fire("gateway_register",
+                                 names=[e[0] for e in entries])
             added = []
             warnings: List[dict] = []
             try:
-                for name, wasm_bytes, inst, store in entries:
+                for name, wasm_bytes, inst, store, owner in entries:
                     if wasm_bytes is not None:
                         rm = self.registry.add_wasm(name, wasm_bytes,
-                                                    source=source)
+                                                    source=source,
+                                                    tenant=owner)
                     else:
                         rm = self.registry.add_instance(name, inst,
                                                         store,
                                                         source=source)
-                    added.append(rm)
-                    warnings.extend(self._vet(rm, tenant))
-                gen = self._build_generation()
+                    added.append((rm, wasm_bytes))
+                    warnings.extend(self._vet(rm, vet_tenant))
             except BaseException:
                 # never leave a module registered that no generation
                 # serves — the registry and the serving set must agree.
@@ -270,13 +476,27 @@ class GatewayService:
                 # registry's probe cache: a re-POST of the same bytes
                 # (fixed policy, different tenant/name) reuses it
                 # instead of lowering twice
-                for rm in added:
+                for rm, _ in added:
                     self.registry.remove(rm.name, stash=True)
                 raise
-            self._swap_in(gen)
+            try:
+                gen = self._build_generation_timed()
+                self._swap_in(gen)
+            except BaseException as e:
+                for rm, _ in added:
+                    self.registry.remove(rm.name, stash=True)
+                self._note_rollback(e)
+                if isinstance(e, (KeyboardInterrupt, SystemExit,
+                                  GatewayClosed, GenerationBuildFailed)):
+                    raise
+                raise GenerationBuildFailed(
+                    f"generation swap failed: {e!r}") from e
+            self.last_swap = {"ok": True, "generation": gen.gen_id,
+                              "error": None, "t": time.monotonic()}
+            durable_ok = self._persist_registration(added, gen)
         with self._lock:
             self.counters["registered_modules"] += len(added)
-        last = added[-1]
+        last = added[-1][0]
         out = {
             "module": last.name,
             "sha256": last.sha256,
@@ -284,6 +504,8 @@ class GatewayService:
             "generation": gen.gen_id,
             "modules": list(gen.modules),
         }
+        if self.durable is not None:
+            out["durable"] = durable_ok
         analysis = getattr(last.engine.img, "analysis", None)
         if analysis is not None:
             out["analysis"] = analysis.summary()
@@ -293,13 +515,280 @@ class GatewayService:
             out["analysis_warnings"] = warnings
         return out
 
+    def _note_rollback(self, exc: BaseException):
+        with self._lock:
+            self.counters["rollbacks"] += 1
+        self.last_swap = {"ok": False, "generation": self.generation,
+                          "error": repr(exc), "t": time.monotonic()}
+        self.obs.instant("generation_rollback", cat="gateway",
+                         track="gateway", error=repr(exc),
+                         serving_generation=self.generation)
+
+    # -- durability --------------------------------------------------------
+    def _persist_registration(self, added, gen: _Generation) -> bool:
+        """Module blobs + manifest, written BEFORE the 201 returns.  A
+        failed write degrades health (and the body says durable:false)
+        but does not un-swap the generation — the next successful
+        durable write self-heals via the dirty flag, since every
+        manifest is a full-set snapshot."""
+        if self.durable is None:
+            return True
+        try:
+            for rm, data in added:
+                if data is not None and rm.sha256:
+                    self.durable.save_module_bytes(rm.sha256,
+                                                   bytes(data))
+            self._write_manifest(gen)
+            return True
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException:
+            with self._lock:
+                self.counters["journal_errors"] += 1
+                self._journal_fail_streak += 1
+                self._manifest_dirty = True
+            return False
+
+    def _write_manifest(self, gen: _Generation):
+        mods = [{"name": rm.name, "sha256": rm.sha256,
+                 "tenant": rm.tenant, "source": rm.source}
+                for rm in self.registry.modules_snapshot()
+                if rm.sha256]   # instance-registered modules (VM boot
+        #                         path) have no bytes to restore from
+        rel = os.path.relpath(gen.serve_dir, self.durable.dir) \
+            if gen.serve_dir else None
+        self.durable.write_manifest(mods, gen.gen_id, rel,
+                                    self.counters["restarts"])
+        self._manifest_dirty = False
+
+    def _journal_snapshot(self):
+        from wasmedge_tpu.serve.queue import peek_request_ids
+
+        with self._lock:
+            unresolved = [
+                {"id": r.id, "tenant": r.tenant, "module": r.module,
+                 "func": r.func, "args": list(r.args),
+                 "deadline_s": r.deadline_s}
+                for r in self._requests.values()
+                if not r.future.done]
+            resolved = list(self._result_cache)
+            max_id = max([self._resume_floor, peek_request_ids()]
+                         + [r.id for r in self._requests.values()])
+        return unresolved, resolved, max_id
+
+    def _journal_sync(self, strict_req: Optional[GatewayRequest] = None):
+        """Write the request journal (and a dirty manifest, if one is
+        owed).  With `strict_req`, a failed write WITHDRAWS that
+        request's acceptance — pulled back out of the serving queue,
+        its future rejected, and a retryable DurabilityError raised —
+        so the gateway never issues a 202 id that would not survive a
+        restart (and never burns a lane on work it disowned).  Without
+        it (the finalize path), failures only degrade health.
+
+        `_journal_mutex` serializes snapshot->write: two concurrent
+        syncs could otherwise snapshot in one order and acquire the
+        store's sequence numbers in the other, making an OLDER
+        snapshot the authoritative (newest) journal and losing a
+        durably-accepted id across a crash."""
+        if self.durable is None:
+            return
+        try:
+            with self._journal_mutex:
+                unresolved, resolved, max_id = self._journal_snapshot()
+                if self._manifest_dirty:
+                    cur = self.current
+                    if cur is not None:
+                        self._write_manifest(cur)
+                self.durable.write_journal(unresolved, resolved,
+                                           max_id=max_id)
+            with self._lock:
+                self._journal_fail_streak = 0
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            with self._lock:
+                self.counters["journal_errors"] += 1
+                self._journal_fail_streak += 1
+            if strict_req is not None:
+                self._withdraw(strict_req)
+                err = DurabilityError(
+                    f"request journal write failed: {e!r}")
+                strict_req.future._reject(err)
+                raise err from e
+
+    def _withdraw(self, req: GatewayRequest):
+        """Take back an acceptance that could not be made durable: the
+        request comes OUT of the serving queue (if not yet admitted —
+        the guest must not run work whose id the client was told never
+        existed), out of the stash, and out of the received tally."""
+        with self._lock:
+            gen = next((g for g in self._gens
+                        if g.gen_id == req.gen_id), None)
+            if self._requests.pop(req.id, None) is not None:
+                self.counters["received"] -= 1
+        if gen is not None:
+            gen.server.withdraw(req.id)
+
+    def _resume_from_disk(self):
+        """Crash/restart resume: re-register the stored module set
+        under ONE boot generation (adopting the previous generation's
+        serve-checkpoint lineage), then re-install the async-request
+        journal — resolved ids replay from the durable result cache
+        (exactly-once), everything else re-queues under its original id
+        (at-least-once, README table)."""
+        manifest, journal = self.durable.load()
+        self.counters["restarts"] = \
+            int((manifest or {}).get("restarts", 0)) + 1
+        mods = (manifest or {}).get("modules") or []
+        gen = None
+        if mods:
+            # continue the generation numbering so a fresh generation
+            # in this process can never collide with (and later adopt)
+            # a dead process's serve-checkpoint slot
+            self._gen_seq = max(int(manifest.get("generation", 0)),
+                                self._gen_seq)
+            entries = []
+            for m in mods:
+                entries.append((m["name"],
+                                self.durable.module_bytes(m["sha256"]),
+                                None, None, m.get("tenant")))
+            rel = manifest.get("serve_dir")
+            self._pending_resume = \
+                os.path.join(self.durable.dir, rel) if rel else None
+            try:
+                self._register(entries, source="resume")
+            finally:
+                self._pending_resume = None
+            gen = self.current
+        else:
+            # nothing to restore; still make the restart count durable
+            self.durable.write_manifest([], 0, None,
+                                        self.counters["restarts"])
+        self._restore_journal(journal or {}, gen)
+        self.obs.instant("gateway_resume", cat="gateway",
+                         track="gateway",
+                         restarts=self.counters["restarts"],
+                         modules=[m["name"] for m in mods],
+                         resumed_requests=self.counters["resumed"])
+        self._journal_sync()
+
+    def _restore_journal(self, journal: dict, gen: Optional[_Generation]):
+        from wasmedge_tpu.serve.queue import advance_request_ids
+
+        floor = int(journal.get("max_id", 0))
+        if floor:
+            # every id at/below the floor was issued by a dead
+            # process: unknown ones answer the pruned 404 detail, and
+            # fresh ids must allocate above them
+            self._resume_floor = floor
+            advance_request_ids(floor)
+        for entry in journal.get("resolved", []):
+            # durable result cache: replay verbatim so a poll of an id
+            # resolved before the crash is exactly-once observable
+            self._result_cache.append(entry)
+            self._install_replay(entry, gen)
+        if gen is None:
+            return
+        adopted = dict(gen.server.adopted)
+        with gen.server._lock:
+            bind_by_id = {r.id: r
+                          for r in gen.server._bindings.values()}
+        for entry in journal.get("unresolved", []):
+            rid = int(entry["id"])
+            with self._lock:
+                if rid in self._requests:
+                    continue
+            tenant = entry.get("tenant", "default")
+            module = entry.get("module")
+            func = entry.get("func", "")
+            args = entry.get("args", [])
+            fut = adopted.pop(rid, None)
+            if fut is None:
+                # accepted but not covered by the serve checkpoint:
+                # re-queue under the ORIGINAL id.  At-least-once — the
+                # guest may have partially run before the crash.  The
+                # journaled deadline restarts its clock here: after a
+                # restart, completing late beats expiring work the
+                # client is still polling for.
+                try:
+                    fut = gen.server.submit(
+                        func, args, tenant=tenant,
+                        deadline_s=entry.get("deadline_s"),
+                        request_id=rid)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    # unservable after resume (export gone from the
+                    # restored set): machine-readable rejection, never
+                    # a silently-lost id
+                    from wasmedge_tpu.serve.queue import (
+                        ServeFuture,
+                        ServeRejected,
+                    )
+
+                    fut = ServeFuture(rid)
+                    fut._reject(ServeRejected(
+                        f"request {rid} could not be re-queued after "
+                        f"gateway restart: {e}"))
+                    advance_request_ids(rid)
+            req = GatewayRequest(fut, tenant, module, func, gen.gen_id,
+                                 time.monotonic(), args=args,
+                                 deadline_s=entry.get("deadline_s"))
+            with self._lock:
+                self._requests[req.id] = req
+                self.counters["received"] += 1
+                self.counters["resumed"] += 1
+        # adopted serve-checkpoint requests the journal missed (a
+        # faulted journal write raced the snapshot): wrap them too —
+        # their futures resolve as the resumed serving loop finishes
+        for rid, fut in adopted.items():
+            with self._lock:
+                if rid in self._requests:
+                    continue
+            sr = bind_by_id.get(rid)
+            req = GatewayRequest(
+                fut, sr.tenant if sr else "default", None,
+                sr.func_name if sr else "", gen.gen_id,
+                time.monotonic(),
+                args=(sr.args if sr else ()))
+            with self._lock:
+                self._requests[req.id] = req
+                self.counters["received"] += 1
+                self.counters["resumed"] += 1
+
+    def _install_replay(self, entry: dict, gen: Optional[_Generation]):
+        from wasmedge_tpu.serve.queue import ServeFuture, \
+            advance_request_ids
+
+        rid = int(entry["id"])
+        with self._lock:
+            if rid in self._requests:
+                return
+        fut = ServeFuture(rid)
+        if entry.get("ok"):
+            fut._resolve([int(c) for c in entry.get("result", [])])
+        else:
+            fut._reject(resolved_error(entry))
+        advance_request_ids(rid)
+        req = GatewayRequest(fut, entry.get("tenant", "default"), None,
+                             entry.get("func", ""),
+                             gen.gen_id if gen else 0, time.monotonic())
+        # outcome counted by the PREVIOUS process; replay only
+        req.finalized = True
+        with self._lock:
+            self._requests[rid] = req
+            self._resolved.append(rid)
+
     # -- requests ----------------------------------------------------------
     def submit(self, func: str, args, module: Optional[str] = None,
                tenant: str = "default",
                deadline_s: Optional[float] = None) -> GatewayRequest:
-        """Edge admission: rate limit, then the current generation's
-        BatchServer.  Raises RateLimited, QueueSaturated (retryable),
-        KeyError (unknown module/func), or the serving taxonomy."""
+        """Edge admission: rate limit, degraded-mode shedding, then the
+        current generation's BatchServer.  Raises RateLimited,
+        ShedLoad / QueueSaturated (retryable), KeyError (unknown
+        module/func), DurabilityError (journal write failed — the id
+        was never accepted), or the serving taxonomy."""
+        from wasmedge_tpu.gateway.health import ShedLoad
         from wasmedge_tpu.gateway.tenants import RateLimited
 
         try:
@@ -307,6 +796,16 @@ class GatewayService:
         except RateLimited:
             with self._lock:
                 self.counters["rate_limited"] += 1
+            raise
+        try:
+            self._health.maybe_shed(tenant)
+        except ShedLoad:
+            with self._lock:
+                self.counters["shed"] += 1
+                self.shed_counts[tenant] = \
+                    self.shed_counts.get(tenant, 0) + 1
+            self.obs.instant("shed", cat="gateway", track="gateway",
+                             tenant=tenant)
             raise
         with self._lock:
             if self._closed:
@@ -347,10 +846,14 @@ class GatewayService:
                     self.counters["rejected"] += 1
                 raise
         req = GatewayRequest(fut, tenant, module, qualified, gen.gen_id,
-                             t_recv)
+                             t_recv, args=args, deadline_s=deadline_s)
         with self._lock:
             self.counters["received"] += 1
             self._requests[req.id] = req
+        # the acceptance is not real until it is durable: a journal
+        # write failure rejects THIS request retryably (the id was
+        # never handed out, so a restart owes nothing for it)
+        self._journal_sync(strict_req=req)
         self.obs.instant("gateway_receive", cat="gateway",
                          track="gateway", id=req.id, tenant=tenant,
                          func=qualified)
@@ -363,6 +866,25 @@ class GatewayService:
             self.finalize(req)
         return req
 
+    def request_state(self, request_id: int):
+        """('ok', req) for a live/stash-resident id, ('pruned', None)
+        for an id whose resolved entry aged out of the result cache
+        (the HTTP layer's distinct 404 detail — a client that cached a
+        202 can tell "aged out" from "never existed"), ('unknown',
+        None) otherwise."""
+        rid = int(request_id)
+        with self._lock:
+            req = self._requests.get(rid)
+            # ids under the resume floor were issued by a pre-crash
+            # process: anything unknown there has aged out, it did not
+            # "never exist"
+            pruned = req is None and (rid in self._pruned_set
+                                      or 0 < rid <= self._resume_floor)
+        if req is not None:
+            self.finalize(req)
+            return "ok", req
+        return ("pruned" if pruned else "unknown"), None
+
     def wait(self, req: GatewayRequest,
              timeout_s: Optional[float] = None) -> bool:
         """Block on the request's future (the sync-invoke path); the
@@ -373,10 +895,12 @@ class GatewayService:
             self.finalize(req)
         return done
 
-    def finalize(self, req: GatewayRequest):
+    def finalize(self, req: GatewayRequest, journal: bool = True):
         """Account + trace a completed request exactly once (called
         from every path that observes completion, and by the pruning
-        sweep for never-polled async requests)."""
+        sweep for never-polled async requests).  `journal=False` lets
+        a batch caller (sweep) coalesce many resolutions into one
+        durable write."""
         if req.finalized or not req.future.done:
             return
         with self._lock:
@@ -393,8 +917,25 @@ class GatewayService:
                 self.counters["deadline"] += 1
             else:
                 self.counters["failed"] += 1
+            if self.durable is not None:
+                try:
+                    self._result_cache.append(_resolved_entry(req))
+                except Exception:
+                    pass   # an unserializable outcome never blocks
+                #            finalization; the entry just isn't cached
             while len(self._resolved) > self.result_cache:
-                self._requests.pop(self._resolved.popleft(), None)
+                pruned_id = self._resolved.popleft()
+                self._requests.pop(pruned_id, None)
+                # remember the id as PRUNED (bounded memory) so a late
+                # poll draws the distinct 404 detail, not "unknown id"
+                if len(self._pruned) == self._pruned.maxlen:
+                    self._pruned_set.discard(self._pruned[0])
+                self._pruned.append(pruned_id)
+                self._pruned_set.add(pruned_id)
+        # journal the resolution (never strict: a completed request's
+        # durability failure degrades health, it cannot un-complete)
+        if journal:
+            self._journal_sync()
         self.obs.span(f"gateway/{req.tenant}", req.t_recv,
                       cat="gateway", track="gateway", id=req.id,
                       func=req.func, generation=req.gen_id,
@@ -408,7 +949,9 @@ class GatewayService:
             pending = [r for r in self._requests.values()
                        if not r.finalized and r.future.done]
         for r in pending:
-            self.finalize(r)
+            self.finalize(r, journal=False)
+        if pending:
+            self._journal_sync()   # one durable write for the batch
 
     # -- edge accounting ---------------------------------------------------
     def count_http(self, code: int):
@@ -417,6 +960,12 @@ class GatewayService:
             self.http_counts[key] = self.http_counts.get(key, 0) + 1
 
     # -- introspection -----------------------------------------------------
+    def health(self, fresh: bool = True) -> dict:
+        """The truthful /healthz body (gateway/health.py): driver
+        liveness, last-swap outcome, queue saturation, checkpoint +
+        journal write health -> healthy / degraded / unhealthy."""
+        return self._health.health(fresh=fresh)
+
     def status(self) -> dict:
         self.sweep()
         with self._lock:
@@ -433,11 +982,16 @@ class GatewayService:
                 "analysis": dict(self.analysis_counts),
                 "http": dict(self.http_counts),
                 "tenants": sorted(self.tenants.policies),
+                "shed": dict(self.shed_counts),
+                "last_swap": dict(self.last_swap)
+                if self.last_swap else None,
+                "durable": self.durable is not None,
             }
             if gen is not None:
                 out["queue_depth"] = len(gen.server.queue)
                 out["in_flight"] = gen.server.in_flight
                 out["serve"] = dict(gen.server.counters)
+        out["health"] = self.health()
         return out
 
     def metrics_text(self) -> str:
@@ -445,11 +999,19 @@ class GatewayService:
         from wasmedge_tpu.obs.metrics import render_prometheus
 
         gen = self.current
+        with self._lock:
+            gateway_counts = {
+                "restarts": self.counters["restarts"],
+                "rollbacks": self.counters["rollbacks"],
+            }
+            shed_counts = dict(self.shed_counts)
         return render_prometheus(
             recorder=self.obs if self.obs.enabled else None,
             hostcall_stats=gen.engine.hostcall_stats if gen else None,
             http_requests=dict(self.http_counts),
-            analysis_counts=dict(self.analysis_counts))
+            analysis_counts=dict(self.analysis_counts),
+            gateway_counts=gateway_counts,
+            shed_counts=shed_counts)
 
     # -- lifecycle ---------------------------------------------------------
     def shutdown(self, drain: bool = True,
@@ -468,4 +1030,29 @@ class GatewayService:
         for t in self._reapers:
             t.join(timeout=5.0)
         self.sweep()
+        self._journal_sync()   # the journal reflects the final state
+        self.registry.close()
+
+    def kill(self):
+        """Simulated SIGKILL (the chaos harness's supported in-process
+        crash): stop every serving thread WITHOUT draining, rejecting
+        futures, or flushing the journal — exactly the state a real
+        kill -9 leaves on disk, so `GatewayService(resume=True)` over
+        the same state_dir is the honest recovery test.  Registry fds
+        are closed (a real dead process drops them too)."""
+        with self._lock:
+            self._closed = True   # later registrations see it and stop
+        with self._reg_lock:
+            pass   # let an in-flight registration's swap finish or fail
+        with self._lock:
+            gens = list(self._gens)
+        for g in gens:
+            srv = g.server
+            with srv._lock:
+                srv._stop = True
+                srv._draining = True
+                srv._wake.notify_all()
+            t = srv._thread
+            if t is not None:
+                t.join(timeout=30.0)
         self.registry.close()

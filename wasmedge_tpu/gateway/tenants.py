@@ -185,6 +185,30 @@ class GatewayTenants:
         return {p.name: p.quota for p in self.policies.values()
                 if p.quota is not None}
 
+    # -- load shedding -----------------------------------------------------
+    def effective_weight(self, tenant: str) -> float:
+        """The DRR weight a tenant submits under (1.0 when it has no
+        policy — the FairQueue default)."""
+        p = self.policies.get(tenant)
+        return p.weight if p is not None else 1.0
+
+    def shed_weight_floor(self) -> Optional[float]:
+        """The weight tier a degraded gateway sheds: the LOWEST
+        effective weight across the tiers that can actually submit —
+        the configured policies, plus the 1.0 default tier ONLY in an
+        open (no-require_auth) configuration where unlisted tenants
+        exist.  None when only one tier exists: with every tenant
+        equal there is no "lowest" to sacrifice, and shedding everyone
+        would turn degradation into an outage (under require_auth, two
+        tenants both at weight 0.5 are ONE tier — the phantom 1.0
+        default must not make them sheddable)."""
+        tiers = {p.weight for p in self.policies.values()}
+        if not self.require_auth:
+            tiers.add(1.0)   # unlisted tenants ride the default tier
+        if len(tiers) < 2:
+            return None
+        return min(tiers)
+
     # -- edge checks -------------------------------------------------------
     def authenticate(self, api_key: Optional[str],
                      claimed: Optional[str]) -> str:

@@ -58,15 +58,40 @@ class _Writer:
 
 def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                       failures=None, http_requests=None,
-                      analysis_counts=None) -> str:
+                      analysis_counts=None, gateway_counts=None,
+                      shed_counts=None) -> str:
     """Render one metrics snapshot.  All sources optional: `recorder` a
     FlightRecorder, `stats` a common.statistics.Statistics, `hostcall_stats`
     an engine's pipeline counter dict, `failures` extra FailureRecords
     (e.g. statistics.recent_failures()) merged into the taxonomy counts,
     `http_requests` the gateway's {status_code: count} edge tally,
     `analysis_counts` the gateway's static-analysis admission summary
-    ({"bounded": n, "unbounded": n, "policy_rejected": n})."""
+    ({"bounded": n, "unbounded": n, "policy_rejected": n}),
+    `gateway_counts` the gateway's durability/robustness counters
+    ({"restarts": n, "rollbacks": n}), `shed_counts` the per-tenant
+    degraded-mode shed tally."""
     w = _Writer()
+
+    if gateway_counts is not None:
+        w.head("wasmedge_gateway_restarts_total", "counter",
+               "Gateway crash/restart resumes over this state dir "
+               "(durable count, gateway/durable.py manifest).")
+        w.sample("wasmedge_gateway_restarts_total", None,
+                 int(gateway_counts.get("restarts", 0)))
+        w.head("wasmedge_generation_rollbacks_total", "counter",
+               "Serving-generation builds/swaps that failed or timed "
+               "out and rolled back atomically (gateway/service.py).")
+        w.sample("wasmedge_generation_rollbacks_total", None,
+                 int(gateway_counts.get("rollbacks", 0)))
+
+    if shed_counts:
+        w.head("wasmedge_gateway_shed_total", "counter",
+               "Submissions shed at the edge while the gateway was "
+               "degraded, by tenant (gateway/health.py ShedLoad).")
+        for tenant in sorted(shed_counts):
+            w.sample("wasmedge_gateway_shed_total",
+                     {"tenant": str(tenant)},
+                     int(shed_counts[tenant]))
 
     if analysis_counts and any(analysis_counts.values()):
         w.head("wasmedge_analysis_modules_total", "counter",
@@ -200,13 +225,16 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
 
 def export_prometheus(path, recorder=None, stats=None,
                       hostcall_stats=None, failures=None,
-                      http_requests=None, analysis_counts=None) -> str:
+                      http_requests=None, analysis_counts=None,
+                      gateway_counts=None, shed_counts=None) -> str:
     """Render and write a metrics snapshot to `path` (or file-like)."""
     text = render_prometheus(recorder=recorder, stats=stats,
                              hostcall_stats=hostcall_stats,
                              failures=failures,
                              http_requests=http_requests,
-                             analysis_counts=analysis_counts)
+                             analysis_counts=analysis_counts,
+                             gateway_counts=gateway_counts,
+                             shed_counts=shed_counts)
     if hasattr(path, "write"):
         path.write(text)
     else:

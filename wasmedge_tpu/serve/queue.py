@@ -123,11 +123,24 @@ class ServeFuture:
 
 _req_ids = itertools.count(1)
 _req_ids_lock = threading.Lock()   # draws and rebinds must serialize
+_last_req_id = 0                   # highest id ever issued/adopted
 
 
 def _next_request_id() -> int:
+    global _last_req_id
     with _req_ids_lock:
-        return next(_req_ids)
+        rid = next(_req_ids)
+        _last_req_id = max(_last_req_id, rid)
+        return rid
+
+
+def peek_request_ids() -> int:
+    """The highest request id issued (or adopted) so far, without
+    consuming one — the gateway journals it as `max_id` so a resumed
+    process can tell "this id existed and aged out" (pruned 404) from
+    "never issued" for ids below the crash floor."""
+    with _req_ids_lock:
+        return _last_req_id
 
 
 def advance_request_ids(past_id: int):
@@ -140,10 +153,11 @@ def advance_request_ids(past_id: int):
     checkpoint journal.  Locked against concurrent draws: a submit on
     another server mid-rebind could otherwise still allocate an id at
     or below `past_id`."""
-    global _req_ids
+    global _req_ids, _last_req_id
     with _req_ids_lock:
         nxt = next(_req_ids)
         _req_ids = itertools.count(max(nxt, int(past_id) + 1))
+        _last_req_id = max(_last_req_id, int(past_id))
 
 
 INT64_MIN = -(1 << 63)
@@ -281,6 +295,21 @@ class FairQueue:
                     keep.append(r)
             self._q[t] = keep
         return out
+
+    def remove_by_id(self, request_id: int) -> Optional[ServeRequest]:
+        """Remove one QUEUED (not yet admitted) request by id — the
+        gateway's withdrawal path for an acceptance it could not make
+        durable.  Returns the removed request, or None when the id is
+        not queued (already admitted, completed, or never here)."""
+        for tenant, q in self._q.items():
+            for r in q:
+                if r.id == request_id:
+                    q.remove(r)
+                    self.size -= 1
+                    if r.deadline is not None:
+                        self._deadlined[tenant] -= 1
+                    return r
+        return None
 
     def pop_all(self) -> List[ServeRequest]:
         """Empty the queue unconditionally (shutdown/terminal-failure

@@ -145,6 +145,11 @@ class BatchServer:
         self._consecutive = 0
         self._pending_backoff = 0.0
         self.retries = 0
+        # checkpoint-write health: consecutive failed snapshot saves
+        # since the last good one (the gateway's /healthz reads this —
+        # a server that cannot persist its state is degraded, not dead)
+        self.checkpoint_fail_streak = 0
+        self.last_checkpoint_error: Optional[BaseException] = None
         self.failures: List[FailureRecord] = []
         self.failed: Optional[BaseException] = None
         self._draining = False
@@ -164,10 +169,16 @@ class BatchServer:
     # -- submission --------------------------------------------------------
     def submit(self, func_name: str, args=(),
                tenant: str = "default",
-               deadline_s: Optional[float] = None) -> ServeFuture:
+               deadline_s: Optional[float] = None,
+               request_id: Optional[int] = None) -> ServeFuture:
         """Queue one request; returns its future.  Raises QueueSaturated
         when the bounded queue is full, KeyError for an unknown export,
-        and the server's terminal error once it has failed."""
+        and the server's terminal error once it has failed.
+
+        `request_id` re-queues a journaled request under its ORIGINAL id
+        (the gateway's durable-resume path: a polling client's 202 id
+        must survive a gateway restart) — the process-global counter is
+        advanced past it so fresh submissions can never collide."""
         with self._lock:
             if self.failed is not None:
                 raise self.failed
@@ -192,13 +203,32 @@ class BatchServer:
                 func_name, tuple(int(a) for a in args), tenant=tenant,
                 deadline=(now + float(deadline_s))
                 if deadline_s is not None else None,
-                t_submit=now)
+                t_submit=now, request_id=request_id)
+            if request_id is not None:
+                from wasmedge_tpu.serve.queue import advance_request_ids
+
+                advance_request_ids(req.id)
             self.queue.push(req)   # raises QueueSaturated on backpressure
             self.counters["submitted"] += 1
             self.obs.counter("serve_queue_depth", len(self.queue),
                              track="serve")
             self._wake.notify_all()
             return req.future
+
+    def withdraw(self, request_id: int) -> bool:
+        """Remove a still-QUEUED request (the gateway's take-back for
+        an acceptance it could not journal durably): the guest must
+        not burn a lane on work whose id the client was told never
+        existed.  Counted as rejected so the counters reconcile;
+        returns False when the request was already admitted (its lane
+        runs to completion, but its future is already rejected and the
+        first-outcome-wins guard swallows the late result)."""
+        with self._lock:
+            req = self.queue.remove_by_id(int(request_id))
+            if req is None:
+                return False
+            self.counters["rejected"] += 1
+            return True
 
     # -- serving loop ------------------------------------------------------
     @property
@@ -760,8 +790,12 @@ class BatchServer:
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
+            self.checkpoint_fail_streak += 1
+            self.last_checkpoint_error = e
             self._record("checkpoint", e, checkpoint=path)
             return None
+        self.checkpoint_fail_streak = 0
+        self.last_checkpoint_error = None
         self.obs.span("checkpoint_save", t0, cat="serve", track="serve",
                       checkpoint=path, steps=int(self.total),
                       in_flight=len(self._bindings))

@@ -19,6 +19,29 @@ OWN arrivals, making "device 2's first launch" deterministic regardless
 of thread interleaving.  `fire` is locked: concurrent device threads
 never corrupt the arrival counters.
 
+The gateway (gateway/service.py, gateway/http.py) adds the tier above
+the engines — r13's chaos surface:
+  - `"gateway_register"`   at the top of a registration transaction
+  - `"generation_build"`   before a serving generation's engine build
+                           (injected -> atomic rollback to the prior
+                           generation, retryable 503)
+  - `"generation_swap"`    before the submit-pointer swap (same
+                           rollback contract; never half-swapped)
+  - `"journal_write"`      before every durable manifest/journal write
+                           (gateway/durable.py; a submit whose journal
+                           write faults is rejected retryably — the
+                           202 id is never issued undurably)
+  - `"http_response_delay"` / `"http_response_drop"` at the HTTP edge:
+                           these are ABSORBED by the handler (delay
+                           sleeps ~50ms before the bytes; drop closes
+                           the connection with no response), modelling
+                           a slow/flaky network rather than a server
+                           exception.
+A gateway process kill/restart is NOT a seam — it is orchestrated by
+the chaos driver (bench.py --chaos: Gateway.kill() then a fresh
+GatewayService(resume=True) over the same state dir), with the seams
+above supplying the weather around it.
+
 Fault classes covered by the tier-1 suites (ISSUE 2 + ISSUE 5):
   - launch-time device error       Fault(point="launch", ...)
   - mid-serve host exception       Fault(point="serve", ...)
@@ -30,6 +53,7 @@ Fault classes covered by the tier-1 suites (ISSUE 2 + ISSUE 5):
                                    a lane-attributed Fault(lanes=(k,))
   - per-device mesh failure        Fault(point="device_launch",
                                    match={"device": k}, ...)
+  - gateway swap/journal/edge      the gateway-tier seams above
 """
 
 from __future__ import annotations
@@ -63,7 +87,11 @@ class Fault:
 
     point: str                 # "launch" | "serve" | "checkpoint_save" |
     #                            "checkpoint_load" | "device_launch" |
-    #                            "device_serve" | "mesh_checkpoint_save"
+    #                            "device_serve" | "mesh_checkpoint_save" |
+    #                            "gateway_register" | "generation_build" |
+    #                            "generation_swap" | "journal_write" |
+    #                            "http_response_delay" |
+    #                            "http_response_drop"
     at: int = 0                # 0-based arrival index at that seam
     times: int = 1             # consecutive arrivals that fault
     lanes: Tuple[int, ...] = ()  # lane attribution (poison quarantine)
@@ -140,6 +168,54 @@ def seeded_faults(seed: int, points: Sequence[str] = ("launch", "serve"),
     for _ in range(n):
         out.append(Fault(point=points[int(rng.randint(len(points)))],
                          at=int(rng.randint(max_at + 1))))
+    return out
+
+
+def gateway_chaos_schedule(seed: int,
+                           engine_faults: int = 2,
+                           swap_faults: int = 1,
+                           journal_faults: int = 1,
+                           edge_faults: int = 2,
+                           max_at: int = 6) -> list:
+    """The seeded fault schedule `bench.py --chaos` arms on the gateway:
+    engine launch/serve faults (the supervisor tier recovers), one-shot
+    generation build/swap faults (the registration tier rolls back with
+    a retryable 503), durable-journal write faults (the submit is
+    rejected retryably, never accepted undurably), and HTTP edge
+    delay/drop faults (clients see a slow or severed wire).  Same seed,
+    same incident schedule — the chaos run is reproducible bit-for-bit
+    up to thread interleaving.  The gateway process kill/restart is
+    orchestrated by the driver, not armed here."""
+    rng = np.random.RandomState(int(seed) & 0x7FFFFFFF)
+    out = []
+    for _ in range(engine_faults):
+        out.append(Fault(point=("launch", "serve")[int(rng.randint(2))],
+                         at=int(rng.randint(1, max_at + 1))))
+    for k in range(swap_faults):
+        # at = 1 + k: arrival 0 is the boot/resume generation build —
+        # the schedule breaks the k-th RUNTIME registration (which
+        # point along the build->swap transaction it breaks stays
+        # seeded), and its retry (the next arrival) goes through
+        out.append(Fault(
+            point=("generation_build",
+                   "generation_swap")[int(rng.randint(2))],
+            at=1 + 2 * k))
+    for _ in range(journal_faults):
+        out.append(Fault(point="journal_write",
+                         at=int(rng.randint(1, 4 * max_at))))
+    for _ in range(edge_faults):
+        point = ("http_response_delay",
+                 "http_response_drop")[int(rng.randint(2))]
+        # drops target only the POLLING route: a dropped poll is
+        # retried harmlessly, while a dropped submit response would
+        # strand an accepted id the client never learned (real clients
+        # need idempotency keys for that; the harness asserts the
+        # ids it KNOWS about)
+        out.append(Fault(
+            point=point,
+            at=int(rng.randint(0, 8 * max_at)),
+            match={"route": "requests"}
+            if point == "http_response_drop" else None))
     return out
 
 

@@ -334,16 +334,21 @@ class VM:
 
     def gateway(self, host: str = "127.0.0.1", port: int = 0,
                 lanes: Optional[int] = None, tenants=None,
-                module_name: str = "main"):
+                module_name: str = "main",
+                state_dir: Optional[str] = None):
         """Network-facing serving gateway over the instantiated module
         (wasmedge_tpu/gateway/): returns an UNSTARTED Gateway whose
         HTTP surface exposes POST /v1/invoke, async polling, runtime
         module registration (POST /v1/modules — more guests join the
         concatenated multi-module image at generation swaps), and
-        /metrics / /v1/status.  This VM's module is pre-registered as
-        `module_name`.  `tenants` is a gateway.GatewayTenants policy
-        table (auth/rate/quota/weight); call `.start()` on the result
-        and `.shutdown()` to drain."""
+        /metrics / /v1/status / truthful /healthz.  This VM's module is
+        pre-registered as `module_name`.  `tenants` is a
+        gateway.GatewayTenants policy table (auth/rate/quota/weight);
+        `state_dir` makes runtime registrations and async request ids
+        crash-survivable (note: THIS instance-registered module has no
+        byte blob to persist — resume restores only wasm-registered
+        modules).  Call `.start()` on the result and `.shutdown()` to
+        drain."""
         from wasmedge_tpu.gateway import Gateway, GatewayService
 
         with self._lock:
@@ -352,7 +357,7 @@ class VM:
             inst = self._active
         conf = batch_conf_with_gas(self.conf, self.stat)
         svc = GatewayService(conf=conf, lanes=lanes or 64,
-                             tenants=tenants)
+                             tenants=tenants, state_dir=state_dir)
         svc.register_module(module_name, inst=inst, store=self.store,
                             source="vm")
         return Gateway(svc, host=host, port=port)
