@@ -601,6 +601,122 @@ def serve_bench(smoke: bool = False) -> int:
     return 0 if out["ok"] else 1
 
 
+def oversub_bench(smoke: bool = False) -> int:
+    """`bench.py --oversub`: open-loop mixed short/long request stream
+    through an oversubscribed BatchServer (4x virtual:physical lane
+    ratio — lane-memory virtualization, wasmedge_tpu/hv/) vs the same
+    stream through a no-oversub baseline server.  The hv server admits
+    the whole stream immediately (admitted concurrency > physical
+    lanes, the ROADMAP #4 capacity multiplier) and rotates cold lanes
+    through the host-side SwapStore; the baseline queues everything
+    beyond the lane count.  Emits OVERSUB_r14.json.
+
+    `--oversub-smoke` is the CI guard: a tiny stream, asserts every
+    future resolves, swaps happened in BOTH directions, and results
+    are bit-identical to the unswapped reference — no artifact."""
+    import os
+    import time as _time
+
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.serve import BatchServer
+    from wasmedge_tpu.utils.bench_artifact import percentile
+
+    if smoke:
+        lanes, ratio, nreq = 4, 4, 24
+        short_n, long_n, long_every = 8, 12, 6
+        chunk = 256
+    else:
+        lanes = int(os.environ.get("OVERSUB_LANES", 8))
+        ratio = int(os.environ.get("OVERSUB_RATIO", 4))
+        nreq = int(os.environ.get("OVERSUB_REQUESTS", 96))
+        short_n, long_n, long_every = 10, 18, 8
+        chunk = 2048
+
+    args = _serve_workload(seed=14, nreq=nreq, short_n=short_n,
+                           long_n=long_n, long_every=long_every)
+
+    def run(oversub: bool):
+        conf = Configure()
+        conf.batch.steps_per_launch = chunk
+        conf.batch.value_stack_depth = 128
+        conf.batch.call_stack_depth = 64
+        conf.obs.enabled = not smoke
+        if oversub:
+            conf.hv.max_virtual_lanes = lanes * ratio
+        inst, store = _instantiate_fib(conf)
+        server = BatchServer(inst, store=store, conf=conf, lanes=lanes)
+        t0 = _time.monotonic()
+        # open loop: the whole stream arrives up front, regardless of
+        # completion — exactly the shape where admission capped at the
+        # physical lane count leaves the queue deep
+        futures = [server.submit("fib", [int(n)],
+                                 tenant=f"t{i % 4}")
+                   for i, n in enumerate(args)]
+        peak_admitted = 0
+        while server.step():
+            peak_admitted = max(peak_admitted, server.in_flight)
+        wall = _time.monotonic() - t0
+        lat = sorted(f.t_done - t0 for f in futures
+                     if f.t_done is not None)
+        results = [f.result(0)[0] if f.error is None else None
+                   for f in futures]
+        hv = server.hv_stats()
+        return {
+            "wall_s": round(wall, 3),
+            "req_per_s": round(nreq / wall, 1) if wall > 0 else 0.0,
+            "p50_latency_s": round(percentile(lat, 0.5), 4),
+            "p99_latency_s": round(percentile(lat, 0.99), 4),
+            "peak_admitted_concurrency": peak_admitted,
+            "swaps_in": hv["swaps_in"] if hv else 0,
+            "swaps_out": hv["swaps_out"] if hv else 0,
+            "resolved": all(f.done for f in futures),
+            "results": results,
+            "counters": dict(server.counters),
+        }
+
+    base = run(oversub=False)
+    over = run(oversub=True)
+    results_match = over["results"] == base["results"]
+    ok = bool(
+        base["resolved"] and over["resolved"] and results_match
+        and over["swaps_in"] > 0 and over["swaps_out"] > 0
+        and over["peak_admitted_concurrency"] > lanes)
+    out = {
+        "metric": "oversub_smoke" if smoke
+        else "oversub_4x_vs_no_oversub",
+        "value": over["req_per_s"],
+        "unit": "req/s",
+        "ok": ok,
+        "lanes": lanes,
+        "virtual_lanes": lanes * ratio,
+        "requests": nreq,
+        "results_match_baseline": results_match,
+        "admitted_concurrency": over["peak_admitted_concurrency"],
+        "baseline_admitted_concurrency":
+            base["peak_admitted_concurrency"],
+        "swaps_in": over["swaps_in"],
+        "swaps_out": over["swaps_out"],
+        "oversub": {k: over[k] for k in
+                    ("wall_s", "req_per_s", "p50_latency_s",
+                     "p99_latency_s")},
+        "no_oversub": {k: base[k] for k in
+                       ("wall_s", "req_per_s", "p50_latency_s",
+                        "p99_latency_s")},
+    }
+    if smoke:
+        print(json.dumps(out))
+        return 0 if ok else 1
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "OVERSUB_r14.json")
+    print(f"# oversub lanes={lanes} virt={lanes * ratio} reqs={nreq} "
+          f"admitted_peak={out['admitted_concurrency']} "
+          f"swaps={out['swaps_out']}/{out['swaps_in']} "
+          f"over={over['wall_s']}s base={base['wall_s']}s",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
 def _gateway_rpc(host, port, method, path, body=None, headers=None,
                  timeout=120.0):
     """One stdlib-HTTP round trip to the gateway (real sockets — the
@@ -1242,4 +1358,8 @@ if __name__ == "__main__":
         sys.exit(chaos_bench(smoke=True))
     if "--chaos" in sys.argv[1:]:
         sys.exit(chaos_bench())
+    if "--oversub-smoke" in sys.argv[1:]:
+        sys.exit(oversub_bench(smoke=True))
+    if "--oversub" in sys.argv[1:]:
+        sys.exit(oversub_bench())
     main()

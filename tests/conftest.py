@@ -40,6 +40,10 @@ def pytest_configure(config):
         "markers", "analysis: static bytecode analyzer suite (CFG/"
         "cost/divergence reports, gateway admission policy; tier-1 "
         "fast, runs under -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "hv: lane-memory virtualization suite (swap store, "
+        "eviction policy, oversubscribed serving; tier-1 fast, runs "
+        "under -m 'not slow')")
 
 
 def pytest_addoption(parser):

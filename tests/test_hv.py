@@ -1,0 +1,642 @@
+"""Lane-memory virtualization suite (wasmedge_tpu/hv/, marker `hv`).
+
+Pins the r14 acceptance contract:
+  - oversubscribed results bit-identical to a never-swapped run (same
+    scoping as the r9 recycler guarantee: lane-placement-independent
+    guests), with swaps in BOTH directions and admitted concurrency
+    beyond the physical lane count
+  - deterministic LRU victim selection; eviction never picks a
+    mid-hostcall-drain lane or the sole runnable lane
+  - swap store crash-atomicity, refcounting, and corrupt-entry
+    detection (a corrupt live swap-in rejects ONE request machine-
+    readably; the server keeps serving)
+  - deterministic fault seams: a faulted swap-out leaves the lane
+    resident and retries next boundary; a faulted swap-in re-queues
+    the virtual lane without losing it
+  - checkpoint/resume with a majority-swapped population (swapped
+    blobs embedded in the snapshot npz; cross-process adoption)
+  - per-tenant resident-budget quota enforcement over HTTP
+  - the stall-rejection sweep and /healthz queue check treat "no
+    physical lane free but virtual headroom available" as
+    backpressure, not a permanent admission block
+
+Speed discipline: tier-1 fast — shared tiny engine geometry (lanes
+2/4, chunk 256, stacks 128/64) and a module-scoped JAX persistent
+compilation cache, mirroring tests/test_serve.py.
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.executor import Executor
+from wasmedge_tpu.hv.policy import (
+    EvictionCandidate,
+    pick_victims,
+    resident_lane_cap,
+)
+from wasmedge_tpu.hv.swapstore import SwapCorrupt, SwapStore
+from wasmedge_tpu.loader import Loader
+from wasmedge_tpu.models import build_fib
+from wasmedge_tpu.runtime.store import StoreManager
+from wasmedge_tpu.serve import BatchServer
+from wasmedge_tpu.testing.faults import Fault, FaultInjector
+from wasmedge_tpu.validator import Validator
+
+pytestmark = pytest.mark.hv
+
+TRAP_HOSTCALL = -2
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache():
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    d = tempfile.mkdtemp(prefix="hv-jit-cache-")
+    jax.config.update("jax_compilation_cache_dir", d)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def _fib(n):
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+def _conf(max_virtual=None, budget=None, obs=False, swap_dir=None):
+    conf = Configure()
+    conf.batch.steps_per_launch = 256
+    conf.batch.value_stack_depth = 128
+    conf.batch.call_stack_depth = 64
+    conf.obs.enabled = obs
+    conf.hv.max_virtual_lanes = max_virtual
+    conf.hv.resident_budget_bytes = budget
+    conf.hv.swap_dir = swap_dir
+    return conf
+
+
+def _server(conf=None, lanes=4, **kw):
+    conf = conf or _conf()
+    mod = Validator(conf).validate(Loader(conf).parse_module(build_fib()))
+    store = StoreManager()
+    inst = Executor(conf).instantiate(store, mod)
+    return BatchServer(inst, store=store, conf=conf, lanes=lanes, **kw)
+
+
+NS = [5, 11, 12, 7, 3, 12, 9, 2, 10, 6, 12, 11, 8, 12, 4, 9]
+
+
+# ---------------------------------------------------------------------------
+# eviction policy (pure, deterministic)
+# ---------------------------------------------------------------------------
+def _cand(lane, last=0, since=0, deadline=None, trap=0):
+    return EvictionCandidate(lane=lane, last_progress_step=last,
+                             resident_since_round=since,
+                             deadline=deadline, trap=trap)
+
+
+def test_policy_lru_order_and_determinism():
+    cands = [_cand(0, last=300), _cand(1, last=100), _cand(2, last=200),
+             _cand(3, last=100)]
+    # LRU first (stalest last-progress), lane index breaks the tie
+    got = pick_victims(cands, 3, now=0.0, current_round=5)
+    assert got == [1, 3, 2]
+    # same inputs, same order — every time
+    for _ in range(5):
+        assert pick_victims(list(reversed(cands)), 3, now=0.0,
+                            current_round=5) == got
+
+
+def test_policy_deadline_distant_bias():
+    now = 100.0
+    cands = [_cand(0, deadline=now + 0.1),   # imminent: protect
+             _cand(1, deadline=now + 50.0),  # distant
+             _cand(2, deadline=None)]        # no deadline: most evictable
+    # the sole-runnable guard keeps one survivor, so the IMMINENT
+    # deadline is the lane that stays resident
+    got = pick_victims(cands, 3, now=now, current_round=5)
+    assert got == [2, 1]
+
+
+def test_policy_never_mid_drain_lane():
+    cands = [_cand(0, trap=TRAP_HOSTCALL), _cand(1), _cand(2)]
+    got = pick_victims(cands, 3, now=0.0, current_round=5)
+    assert 0 not in got
+
+
+def test_policy_never_sole_runnable_lane():
+    # one runnable lane: nothing may be evicted (the device would idle)
+    assert pick_victims([_cand(0)], 1, now=0.0, current_round=5) == []
+    # two runnable: at most one (a runnable survivor always remains)
+    got = pick_victims([_cand(0), _cand(1)], 2, now=0.0,
+                       current_round=5)
+    assert len(got) == 1
+    # ... unless the caller is installing replacements this boundary
+    got = pick_victims([_cand(0)], 1, now=0.0, current_round=5,
+                       incoming_runnable=1)
+    assert got == [0]
+
+
+def test_policy_min_resident_rounds():
+    cands = [_cand(0, since=5), _cand(1, since=3)]
+    got = pick_victims(cands, 2, now=0.0, current_round=5,
+                       min_resident_rounds=1)
+    assert got == [1]   # lane 0 installed THIS round: not evictable
+
+
+def test_resident_lane_cap_math():
+    assert resident_lane_cap(8, None, 1000) == 8
+    assert resident_lane_cap(8, 4000, 1000) == 4
+    assert resident_lane_cap(8, 100, 1000) == 1     # never 0: deadlock
+    assert resident_lane_cap(8, 10**9, 1000) == 8   # clamped to lanes
+
+
+# ---------------------------------------------------------------------------
+# swap store
+# ---------------------------------------------------------------------------
+def test_swapstore_roundtrip_refcount_and_disk(tmp_path):
+    st = SwapStore(dir=str(tmp_path))
+    key = st.put(b"hello lane state")
+    assert st.get(key) == b"hello lane state"
+    assert (tmp_path / f"{key}.lane").exists()
+    # content-addressed: identical payloads share the entry
+    assert st.put(b"hello lane state") == key
+    assert len(st) == 1
+    st.release(key)
+    assert st.get(key) == b"hello lane state"   # one ref remains
+    st.release(key)
+    assert len(st) == 0
+    assert not (tmp_path / f"{key}.lane").exists()
+    with pytest.raises(SwapCorrupt):
+        st.get(key)
+
+
+def test_swapstore_detects_corruption(tmp_path):
+    st = SwapStore(dir=str(tmp_path))
+    key = st.put(b"precious bits")
+    st._mem[key] = b"rotted bits!!"
+    with pytest.raises(SwapCorrupt):
+        st.get(key)
+    # adopt() verifies before trusting a snapshot blob
+    st2 = SwapStore()
+    with pytest.raises(SwapCorrupt):
+        st2.adopt(key, b"not the right content")
+
+
+def test_swapstore_write_fault_leaves_nothing(tmp_path):
+    inj = FaultInjector([Fault(point="swap_store_write", at=0)])
+    st = SwapStore(dir=str(tmp_path), faults=inj)
+    with pytest.raises(Exception):
+        st.put(b"doomed payload")
+    assert len(st) == 0
+    assert list(tmp_path.iterdir()) == []   # no blob, no temp litter
+    # the next attempt (fault exhausted) succeeds
+    key = st.put(b"doomed payload")
+    assert st.get(key) == b"doomed payload"
+
+
+# ---------------------------------------------------------------------------
+# oversubscription end to end
+# ---------------------------------------------------------------------------
+def test_oversub_bit_identical_to_unswapped_run():
+    ref_srv = _server(_conf(), lanes=4)
+    ref_futs = [ref_srv.submit("fib", [n]) for n in NS]
+    ref_srv.run_until_idle()
+    ref = [f.result(0)[0] for f in ref_futs]
+    assert ref == [_fib(n) for n in NS]
+
+    srv = _server(_conf(max_virtual=16), lanes=4)
+    futs = [srv.submit("fib", [n]) for n in NS]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == ref
+    hv = srv.hv_stats()
+    assert hv["swaps_out"] > 0 and hv["swaps_in"] > 0
+    assert hv["peak_admitted"] > 4            # true oversubscription
+    c = srv.counters
+    assert c["completed"] == len(NS)
+    assert c["submitted"] == c["completed"] + c["trapped"] \
+        + c["expired"] + c["killed"] + c["rejected"]
+    assert c["rejected"] == 0   # backpressure never became a sweep
+
+
+def test_resident_budget_caps_installed_lanes():
+    # budget for exactly one lane: serial residency, everything still
+    # completes (admission counts the budget, not the free-lane heap)
+    conf = _conf(max_virtual=8)
+    srv = _server(conf, lanes=4)
+    one_lane_budget = srv.hv.lane_bytes  # exactly one lane's bytes
+    conf2 = _conf(max_virtual=8, budget=one_lane_budget)
+    srv2 = _server(conf2, lanes=4)
+    assert srv2.hv.resident_cap == 1
+    futs = [srv2.submit("fib", [n]) for n in NS[:6]]
+    srv2.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == [_fib(n) for n in NS[:6]]
+    assert srv2.hv.peak_admitted > 1   # admitted beyond residency
+    hv = srv2.hv_stats()
+    assert hv["resident_cap"] == 1
+
+
+def test_swap_dir_spills_to_disk(tmp_path):
+    srv = _server(_conf(max_virtual=8, swap_dir=str(tmp_path)), lanes=2)
+    futs = [srv.submit("fib", [n]) for n in NS[:8]]
+    # drive a few rounds: some lane state must hit the directory
+    seen_blob = False
+    for _ in range(40):
+        if not srv.step():
+            break
+        if any(p.suffix == ".lane" for p in tmp_path.iterdir()):
+            seen_blob = True
+    srv.run_until_idle()
+    assert seen_blob
+    assert [f.result(0)[0] for f in futs] == [_fib(n) for n in NS[:8]]
+
+
+# ---------------------------------------------------------------------------
+# fault seams
+# ---------------------------------------------------------------------------
+def test_swap_out_fault_leaves_lane_resident_and_retries():
+    inj = FaultInjector([Fault(point="swap_out", at=0, times=2)])
+    srv = _server(_conf(max_virtual=12), lanes=4, faults=inj)
+    futs = [srv.submit("fib", [n]) for n in NS[:12]]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == [_fib(n) for n in NS[:12]]
+    hv = srv.hv_stats()
+    assert hv["swap_out_faults"] == 2          # both injected arrivals
+    assert hv["swaps_out"] > 0                 # retried and succeeded
+    assert any(f.fault_class == "swap" for f in srv.failures)
+
+
+def test_swap_in_fault_requeues_without_losing_the_lane():
+    inj = FaultInjector([Fault(point="swap_in", at=0, times=2)])
+    srv = _server(_conf(max_virtual=12), lanes=4, faults=inj)
+    futs = [srv.submit("fib", [n]) for n in NS[:12]]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == [_fib(n) for n in NS[:12]]
+    hv = srv.hv_stats()
+    assert hv["swap_in_faults"] == 2
+    assert hv["swaps_in"] > 0
+
+
+def test_swap_store_write_fault_is_a_swap_out_fault():
+    inj = FaultInjector([Fault(point="swap_store_write", at=0)])
+    srv = _server(_conf(max_virtual=12), lanes=4, faults=inj)
+    futs = [srv.submit("fib", [n]) for n in NS[:12]]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == [_fib(n) for n in NS[:12]]
+    assert srv.hv_stats()["swap_out_faults"] == 1
+
+
+def test_corrupt_swap_entry_rejects_only_that_request():
+    from wasmedge_tpu.serve.queue import ServeRejected
+
+    srv = _server(_conf(max_virtual=12), lanes=2)
+    futs = [srv.submit("fib", [n]) for n in NS[:10]]
+    # drive until some lane state is actually swapped out, then rot it
+    corrupted = 0
+    for _ in range(200):
+        if not srv.step():
+            break
+        if corrupted == 0:
+            with srv._lock:
+                swapped = [v for v in srv.hv.waiting.values()
+                           if v.key is not None]
+                for v in swapped[:1]:
+                    srv.hv.store._mem[v.key] = b"bit rot"
+                    corrupted += 1
+    srv.run_until_idle()
+    assert corrupted == 1
+    outcomes = []
+    for f in futs:
+        assert f.done
+        outcomes.append(f.error)
+    rejected = [e for e in outcomes if e is not None]
+    assert len(rejected) == 1
+    assert isinstance(rejected[0], ServeRejected)
+    assert "corrupt" in str(rejected[0])
+    assert srv.hv_stats()["swap_corrupt"] == 1
+    # everyone else still finished with the right answers
+    good = [f.result(0)[0] for f in futs if f.error is None]
+    assert len(good) == 9
+    # the loss is an in-flight kill: outcome counters reconcile
+    c = srv.counters
+    assert c["killed"] == 1
+    assert c["submitted"] == c["completed"] + c["trapped"] \
+        + c["expired"] + c["killed"] + c["rejected"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume with a majority-swapped population
+# ---------------------------------------------------------------------------
+def test_checkpoint_resume_majority_swapped(tmp_path):
+    conf = _conf(max_virtual=12)
+    conf.serve.checkpoint_dir = str(tmp_path)
+    srv = _server(conf, lanes=2)
+    ns = [12, 12, 11, 12, 11, 12, 11, 12, 11, 12]
+    futs = [srv.submit("fib", [n]) for n in ns]
+    by_id = {f.request_id: n for f, n in zip(futs, ns)}
+    # run a few rounds: 2 resident, the rest virtual (majority swapped
+    # or fresh off-device)
+    for _ in range(6):
+        srv.step()
+    with srv._lock:
+        swapped = sum(1 for v in srv.hv.waiting.values()
+                      if v.key is not None)
+        waiting = len(srv.hv.waiting)
+        resident = len(srv._bindings)
+    assert waiting > resident          # majority off-device
+    assert swapped > 0
+    path = srv.checkpoint()
+    assert path is not None
+
+    # "crash": a fresh server adopts the lineage cross-process style
+    conf2 = _conf(max_virtual=12)
+    conf2.serve.checkpoint_dir = str(tmp_path)
+    srv2 = _server(conf2, lanes=2, resume=True)
+    # every in-flight request came back: resident + virtual
+    assert set(srv2.adopted) == set(by_id)
+    srv2.run_until_idle()
+    for rid, fut in srv2.adopted.items():
+        assert fut.result(0)[0] == _fib(by_id[rid])
+
+
+def test_recover_restores_virtual_table_in_process(tmp_path):
+    from wasmedge_tpu.testing.faults import InjectedFault
+
+    conf = _conf(max_virtual=12)
+    conf.serve.checkpoint_dir = str(tmp_path)
+    conf.serve.checkpoint_every_rounds = 1
+    inj = FaultInjector([Fault(point="launch", at=8)])
+    srv = _server(conf, lanes=2, faults=inj)
+    ns = [12, 12, 11, 12, 11, 12, 11, 12]
+    futs = [srv.submit("fib", [n]) for n in ns]
+    srv.run_until_idle()
+    assert inj.fired == 1
+    assert [f.result(0)[0] for f in futs] == [_fib(n) for n in ns]
+    assert srv.retries == 1
+    assert isinstance(srv.failures[0].error, str)
+    assert "InjectedFault" in srv.failures[0].error or \
+        InjectedFault is not None
+
+
+# ---------------------------------------------------------------------------
+# backpressure, not a permanent block
+# ---------------------------------------------------------------------------
+def test_no_free_lane_with_headroom_is_backpressure_not_sweep():
+    srv = _server(_conf(max_virtual=6), lanes=2)
+    futs = [srv.submit("fib", [n]) for n in NS[:10]]
+    # rounds where no physical lane is free and the queue holds the
+    # overflow: the stall sweep must never fire
+    srv.run_until_idle()
+    assert srv.counters["rejected"] == 0
+    assert all(f.error is None for f in futs)
+
+
+def test_healthz_queue_check_hv_aware():
+    from wasmedge_tpu.gateway.health import QUEUE_SATURATION_RATIO
+    from wasmedge_tpu.serve.queue import ServeRequest
+
+    # saturate the queue of an hv server that still has headroom
+    conf = _conf(max_virtual=16)
+    conf.serve.queue_capacity = 4
+    srv = _server(conf, lanes=2)
+    for _ in range(4):
+        srv.queue.push(ServeRequest("fib", (5,)))
+    assert len(srv.queue) / 4 >= QUEUE_SATURATION_RATIO
+
+    class _Gen:
+        gen_id = 1
+        server = srv
+
+    class _Svc:
+        current = _Gen()
+        last_swap = None
+        durable = None
+        force_degraded = False
+
+    from wasmedge_tpu.gateway.health import health_of
+
+    h = health_of(_Svc())
+    assert h["checks"]["queue"]["ok"]          # headroom => healthy
+    assert "headroom" in h["checks"]["queue"]["detail"]
+
+    # the same saturation WITHOUT hv still degrades
+    conf2 = _conf()
+    conf2.serve.queue_capacity = 4
+    srv2 = _server(conf2, lanes=2)
+    for _ in range(4):
+        srv2.queue.push(ServeRequest("fib", (5,)))
+    _Gen.server = srv2
+    h2 = health_of(_Svc())
+    assert not h2["checks"]["queue"]["ok"]
+    # drain the stranded futures so nothing leaks into other tests
+    srv.queue.pop_all()
+    srv2.queue.pop_all()
+
+
+# ---------------------------------------------------------------------------
+# obs / metrics
+# ---------------------------------------------------------------------------
+def test_hv_metrics_render_and_parse():
+    from wasmedge_tpu.obs.metrics import parse_prometheus, \
+        render_prometheus
+
+    srv = _server(_conf(max_virtual=12, obs=True), lanes=4)
+    futs = [srv.submit("fib", [n]) for n in NS[:12]]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == [_fib(n) for n in NS[:12]]
+    text = render_prometheus(recorder=srv.obs, hv_stats=srv.hv_stats())
+    parsed = parse_prometheus(text)
+    out = parsed[("wasmedge_hv_swaps_total",
+                  frozenset({("direction", "out")}.union()))]
+    inn = parsed[("wasmedge_hv_swaps_total",
+                  frozenset({("direction", "in")}))]
+    assert out > 0 and inn > 0
+    assert ("wasmedge_hv_resident_lanes", frozenset()) in parsed
+    assert ("wasmedge_hv_virtual_lanes", frozenset()) in parsed
+    # swap latency histogram made it through the recorder
+    count_keys = [k for k in parsed
+                  if k[0] == "wasmedge_hv_swap_latency_seconds_count"]
+    assert count_keys
+    # swap instants landed on the hv track
+    names = srv.obs.event_names()
+    assert "swap_out" in names and "swap_in" in names
+
+
+def test_hv_obs_off_is_default_and_silent():
+    srv = _server(_conf(max_virtual=8), lanes=2)
+    from wasmedge_tpu.obs.recorder import NULL_RECORDER
+
+    assert srv.obs is NULL_RECORDER
+    futs = [srv.submit("fib", [n]) for n in NS[:6]]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == [_fib(n) for n in NS[:6]]
+
+
+# ---------------------------------------------------------------------------
+# CLI flags exist and parse
+# ---------------------------------------------------------------------------
+def test_cli_flags_parse():
+    import io
+
+    from wasmedge_tpu.cli import _gateway_parser, _serve_parser
+
+    p = _serve_parser()
+    assert p.parse(["--max-virtual-lanes", "32",
+                    "--resident-budget-bytes", "1048576",
+                    "--swap-dir", "/tmp/x", "app.wasm", "fib"],
+                   io.StringIO())
+    assert p._opts["max-virtual-lanes"].value == 32
+    assert p._opts["resident-budget-bytes"].value == 1048576
+    g = _gateway_parser()
+    assert g.parse(["--max-virtual-lanes", "32",
+                    "--resident-budget-bytes", "1048576"],
+                   io.StringIO())
+    assert g._opts["max-virtual-lanes"].value == 32
+
+
+# ---------------------------------------------------------------------------
+# per-tenant resident budget over HTTP
+# ---------------------------------------------------------------------------
+@pytest.mark.serve
+def test_tenant_resident_budget_quota_over_http():
+    import json
+    from http.client import HTTPConnection
+
+    from wasmedge_tpu.gateway import (
+        Gateway,
+        GatewayService,
+        GatewayTenants,
+    )
+
+    conf = _conf(max_virtual=8)
+    tenants = GatewayTenants.from_dict({
+        "tenants": {
+            # budget for exactly one resident lane (any positive value
+            # below 2 lanes' bytes caps at 1; 1 byte floors to the
+            # minimum of one lane)
+            "small": {"resident_budget_bytes": 1},
+            "big": {},
+        }})
+    svc = GatewayService(conf=conf, lanes=4, tenants=tenants)
+    mod = build_fib()
+    svc.register_module("fib", wasm_bytes=mod, source="test")
+    gw = Gateway(svc, port=0).start()
+    try:
+        ids = []
+        for i, (tenant, n) in enumerate(
+                [("small", 11), ("small", 12), ("small", 11),
+                 ("big", 12), ("big", 11), ("big", 12)]):
+            c = HTTPConnection(gw.host, gw.port, timeout=60)
+            c.request("POST", "/v1/invoke?async=1", body=json.dumps({
+                "module": "fib", "func": "fib", "args": [n],
+                "tenant": tenant}).encode())
+            r = c.getresponse()
+            body = json.loads(r.read())
+            assert r.status == 202, body
+            ids.append((body["request_id"], n))
+            c.close()
+        # poll all to completion
+        import time as _t
+
+        deadline = _t.monotonic() + 120
+        for rid, n in ids:
+            while True:
+                c = HTTPConnection(gw.host, gw.port, timeout=60)
+                c.request("GET", f"/v1/requests/{rid}")
+                r = c.getresponse()
+                body = json.loads(r.read())
+                c.close()
+                if body.get("status") == "done":
+                    assert body["result"] == [_fib(n)]
+                    break
+                assert _t.monotonic() < deadline, body
+                _t.sleep(0.05)
+        # the status hv block proves the quota held: tenant "small"
+        # never held more than its single budgeted physical lane
+        c = HTTPConnection(gw.host, gw.port, timeout=60)
+        c.request("GET", "/v1/status")
+        st = json.loads(c.getresponse().read())
+        c.close()
+        assert "hv" in st
+        assert st["hv"]["tenant_resident_caps"]["small"] == 1
+        assert st["hv"]["peak_resident_by_tenant"].get("small", 0) <= 1
+        assert st["hv"]["swaps_out"] >= 0
+        # and the Prometheus export carries the hv series
+        c = HTTPConnection(gw.host, gw.port, timeout=60)
+        c.request("GET", "/metrics")
+        text = c.getresponse().read().decode()
+        c.close()
+        assert "wasmedge_hv_resident_lanes" in text
+    finally:
+        gw.shutdown(drain=False)
+
+
+def test_capped_tenant_rotates_its_own_lane():
+    """A capped tenant's waiter can only be seated by evicting the
+    tenant's OWN resident lane.  When the LRU pick is another tenant's
+    (colder) lane — whose eviction seats nobody — the planner must move
+    on to the next victim in policy order, not abandon rotation: the
+    capped tenant's virtual lane would otherwise starve."""
+    conf = _conf(max_virtual=6)
+    srv = _server(conf, lanes=2, resident_budgets={"a": 1})
+    assert srv.hv.tenant_caps == {"a": 1}
+    fa1 = srv.submit("fib", [12], tenant="a")
+    fb1 = srv.submit("fib", [12], tenant="b")
+    fa2 = srv.submit("fib", [12], tenant="a")   # waits: a is at cap
+    srv.step()
+    with srv._lock:
+        assert fa2.request_id in srv.hv.waiting
+        # make b's lane the LRU pick: stalest progress by far
+        b_lane = next(lane for lane, r in srv._bindings.items()
+                      if r.tenant == "b")
+        a_lane = next(lane for lane, r in srv._bindings.items()
+                      if r.tenant == "a")
+        srv.hv._last_progress[b_lane] = -10**6
+        srv.hv._last_progress[a_lane] = 10**6
+    srv.step()
+    # rotation happened by evicting a's OWN lane (b's eviction seats
+    # nobody under a's cap), so a2 is now resident and a1 swapped
+    with srv._lock:
+        assert fa2.request_id not in srv.hv.waiting
+        assert fa1.request_id in srv.hv.waiting
+        assert b_lane in srv._bindings   # b was never evicted for a
+    srv.run_until_idle()
+    for f in (fa1, fb1, fa2):
+        assert f.result(0)[0] == _fib(12)
+    # the cap held throughout
+    assert srv.hv.peak_resident_by_tenant.get("a", 0) <= 1
+
+
+def test_deadline_expires_virtual_lane_off_device():
+    import time as _t
+
+    from wasmedge_tpu.serve.queue import DeadlineExceeded
+
+    # min_resident_rounds high enough that the waiter cannot rotate in
+    # before its deadline — it must expire OFF-device, as a virtual
+    # lane (an admitted in-flight kill, not a queued expiry)
+    conf = _conf(max_virtual=8)
+    conf.hv.min_resident_rounds = 10_000
+    srv = _server(conf, lanes=2)
+    long_futs = [srv.submit("fib", [12]) for _ in range(2)]
+    doomed = srv.submit("fib", [12], deadline_s=0.2)
+    srv.step()   # round 1: doomed admits as a fresh virtual lane
+    assert not doomed.done
+    with srv._lock:
+        assert doomed.request_id in srv.hv.waiting
+    _t.sleep(0.25)
+    srv.step()   # boundary: the virtual lane expires off-device
+    assert doomed.done
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(0)
+    assert srv.counters["killed"] >= 1
+    assert srv.counters["expired"] == 0
+    srv.run_until_idle()
+    assert all(f.result(0)[0] == _fib(12) for f in long_futs)

@@ -53,7 +53,7 @@ def image_fingerprint(img) -> str:
 
 
 def save(path, engine: BatchEngine, state: BatchState, total_steps: int,
-         invocation=None, stdout_pos=None):
+         invocation=None, stdout_pos=None, extra_arrays=None):
     """Snapshot an in-flight batch to `path` (.npz).
 
     `invocation` (optional dict, e.g. the supervisor's function-name +
@@ -66,7 +66,14 @@ def save(path, engine: BatchEngine, state: BatchState, total_steps: int,
     cursor (the serving layer checkpointing from another thread while a
     launch slice is in flight) must pass the positions it captured when
     `state` was current, or a restore would suppress output the saved
-    state has not produced yet."""
+    state has not produced yet.
+
+    `extra_arrays` (optional {name: ndarray}) rides alongside the state
+    planes — the serving layer embeds swapped virtual-lane blobs
+    (wasmedge_tpu/hv/) so a snapshot is self-contained without faulting
+    cold lanes onto the device.  Names must not collide with the
+    `state_` prefix; `load()` ignores them, `read_extra_arrays()` reads
+    them back."""
     cfg = engine.cfg
     meta = {
         "format": FORMAT_VERSION,
@@ -102,6 +109,11 @@ def save(path, engine: BatchEngine, state: BatchState, total_steps: int,
             pos, _ = _stdout_cursor(engine,
                                     int(np.asarray(state.so_off).size))
             arrays["stdout_pos"] = np.asarray(pos, np.int64)
+    for name, arr in (extra_arrays or {}).items():
+        if name.startswith("state_") or name in arrays:
+            raise ValueError(f"extra array name {name!r} collides with "
+                             f"a state plane")
+        arrays[name] = np.asarray(arr)
     buf = io.BytesIO()
     np.savez_compressed(buf, meta=json.dumps(meta), **arrays)
     data = buf.getvalue()
@@ -120,6 +132,18 @@ def read_meta(path) -> dict:
     invocation binding before paying for a full load."""
     with np.load(path, allow_pickle=False) as z:
         return json.loads(str(z["meta"]))
+
+
+def read_extra_arrays(path, prefix: str) -> dict:
+    """Extra (non-state) arrays whose names start with `prefix` — the
+    read half of save()'s `extra_arrays` (serving-layer swapped-lane
+    blobs ride here)."""
+    out = {}
+    with np.load(path, allow_pickle=False) as z:
+        for name in z.files:
+            if name.startswith(prefix):
+                out[name] = np.asarray(z[name])
+    return out
 
 
 def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:

@@ -290,6 +290,19 @@ def _serve_parser() -> ArgumentParser:
     p.add_option(["autotune"],
                  Toggle("auto-tune steps_per_launch from the hostcall "
                         "drain-latency histograms"))
+    p.add_option(["max-virtual-lanes"],
+                 Option("oversubscribe: admit up to N concurrent "
+                        "requests (resident + host-swapped virtual "
+                        "lanes; default = --lanes, no "
+                        "oversubscription)", "n", typ=int))
+    p.add_option(["resident-budget-bytes"],
+                 Option("cap device-resident lane bytes: admission "
+                        "installs floor(budget/lane-bytes) physical "
+                        "lanes, the rest wait as virtual lanes", "b",
+                        typ=int))
+    p.add_option(["swap-dir"],
+                 Option("spill swapped lane state to this directory "
+                        "(default: host memory only)", "dir"))
     p.add_option(["checkpoint-dir"],
                  Option("serving-state checkpoint directory", "dir"))
     p.add_option(["checkpoint-every"],
@@ -345,6 +358,13 @@ def serve_command(argv: List[str], out=None, err=None) -> int:
         conf.obs.enabled = True   # the tuner reads the drain histograms
     if p._opts["checkpoint-every"].seen:
         conf.serve.checkpoint_every_rounds = p._opts["checkpoint-every"].value
+    if p._opts["max-virtual-lanes"].seen:
+        conf.hv.max_virtual_lanes = p._opts["max-virtual-lanes"].value
+    if p._opts["resident-budget-bytes"].seen:
+        conf.hv.resident_budget_bytes = \
+            p._opts["resident-budget-bytes"].value
+    if p._opts["swap-dir"].seen:
+        conf.hv.swap_dir = p._opts["swap-dir"].value
     if p._opts["trace-out"].seen or p._opts["metrics-out"].seen:
         conf.obs.enabled = True
 
@@ -442,6 +462,12 @@ def serve_command(argv: List[str], out=None, err=None) -> int:
         "p50_latency_s": round(percentile(lat, 0.5), 4) if lat else None,
         "p99_latency_s": round(percentile(lat, 0.99), 4) if lat else None,
     }
+    hv = server.hv_stats()
+    if hv is not None:
+        summary["swaps_in"] = hv["swaps_in"]
+        summary["swaps_out"] = hv["swaps_out"]
+        summary["peak_admitted"] = hv["peak_admitted"]
+        summary["resident_cap"] = hv["resident_cap"]
     out.write(json.dumps(summary) + "\n")
     if conf.obs.enabled:
         rec = server.obs
@@ -454,7 +480,8 @@ def serve_command(argv: List[str], out=None, err=None) -> int:
 
             export_prometheus(p._opts["metrics-out"].value, recorder=rec,
                               stats=vm.statistics(),
-                              hostcall_stats=server.engine.hostcall_stats)
+                              hostcall_stats=server.engine.hostcall_stats,
+                              hv_stats=hv)
     return 0 if c["completed"] + c["trapped"] + c["expired"] \
         + c["killed"] == nreq + nadopted else 1
 
@@ -481,6 +508,16 @@ def _gateway_parser() -> ArgumentParser:
     p.add_option(["queue-capacity"],
                  Option("bounded request queue capacity "
                         "(backpressure -> 429)", "n", typ=int))
+    p.add_option(["max-virtual-lanes"],
+                 Option("oversubscribe each serving generation: admit "
+                        "up to N concurrent requests (resident + "
+                        "host-swapped virtual lanes; default = "
+                        "--lanes)", "n", typ=int))
+    p.add_option(["resident-budget-bytes"],
+                 Option("cap device-resident lane bytes per "
+                        "generation (admission counts the budget "
+                        "instead of the raw free-lane count)", "b",
+                        typ=int))
     p.add_option(["obs"],
                  Toggle("enable the flight recorder (gateway/<tenant> "
                         "spans, drain histograms; served at /metrics)"))
@@ -536,6 +573,11 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
     conf.host_registrations.add(HostRegistration.Wasi)
     if p._opts["queue-capacity"].seen:
         conf.serve.queue_capacity = p._opts["queue-capacity"].value
+    if p._opts["max-virtual-lanes"].seen:
+        conf.hv.max_virtual_lanes = p._opts["max-virtual-lanes"].value
+    if p._opts["resident-budget-bytes"].seen:
+        conf.hv.resident_budget_bytes = \
+            p._opts["resident-budget-bytes"].value
     if p._opts["obs"].value:
         conf.obs.enabled = True
 

@@ -294,6 +294,45 @@ class ServeConfigure:
 
 
 @dataclasses.dataclass
+class HvConfigure:
+    """Knobs for lane-memory virtualization (wasmedge_tpu/hv/).
+
+    The serving layer's hypervisor mode: admitted requests beyond the
+    physical lane count (or beyond the resident-bytes budget) wait as
+    VIRTUAL lanes whose state lives host-side, swapping onto free
+    physical lanes at launch boundaries.  Off (the default: both
+    capacity knobs None) the BatchServer behaves exactly as before —
+    admission is the free-lane heap, nothing ever swaps."""
+
+    # Concurrent admitted requests (resident + virtual).  None = the
+    # physical lane count (no oversubscription).  CLI:
+    # --max-virtual-lanes.
+    max_virtual_lanes: Optional[int] = None
+    # Device bytes the resident population may hold: admission installs
+    # at most floor(budget / effective-lane-bytes) physical lanes
+    # (effective bytes seeded from DeviceImage.analysis footprint
+    # bounds when the analyzer proved them, else the allocated plane
+    # geometry — hv/policy.py).  None = every physical lane may be
+    # resident.  CLI: --resident-budget-bytes.
+    resident_budget_bytes: Optional[int] = None
+    # SwapStore spill directory (content-addressed .lane blobs, crash-
+    # atomic writes).  None keeps blobs in host memory only — serve
+    # checkpoints still embed them, so crash/resume does not depend on
+    # this knob.
+    swap_dir: Optional[str] = None
+    # Anti-thrash: a lane must have held the device for this many
+    # serving rounds (launch slices) before it is evictable.
+    min_resident_rounds: int = 1
+    # Evictions per boundary rebalance (None = up to the lane count).
+    max_swaps_per_round: Optional[int] = None
+
+    @property
+    def active(self) -> bool:
+        return self.max_virtual_lanes is not None \
+            or self.resident_budget_bytes is not None
+
+
+@dataclasses.dataclass
 class CompilerConfigure:
     """AOT-compiler knobs (reference: CompilerConfigure,
     include/common/configure.h:28-106).  The optimization level and
@@ -322,6 +361,7 @@ class Configure:
         default_factory=SupervisorConfigure)
     obs: ObsConfigure = dataclasses.field(default_factory=ObsConfigure)
     serve: ServeConfigure = dataclasses.field(default_factory=ServeConfigure)
+    hv: HvConfigure = dataclasses.field(default_factory=HvConfigure)
     compiler: CompilerConfigure = dataclasses.field(default_factory=CompilerConfigure)
 
     def add_proposal(self, p: Proposal) -> "Configure":

@@ -96,9 +96,25 @@ def health_of(svc) -> dict:
         cap = max(int(srv.k.queue_capacity), 1)
         depth = len(srv.queue)
         ratio = depth / cap
-        checks["queue"] = _check(
-            ratio < QUEUE_SATURATION_RATIO, "degraded",
-            f"queue {depth}/{cap} ({ratio:.0%} of capacity)")
+        saturated = ratio >= QUEUE_SATURATION_RATIO
+        detail = f"queue {depth}/{cap} ({ratio:.0%} of capacity)"
+        hv = getattr(srv, "hv", None)
+        if saturated and hv is not None:
+            # an oversubscribed server drains the queue into VIRTUAL
+            # lanes at every boundary: "no physical lane free but
+            # resident budget / virtual headroom available" is
+            # backpressure the next rebalance absorbs, not saturation
+            # — the pre-hv free-lane-heap reading would misclassify an
+            # oversubscribed-but-healthy server as degraded here.  The
+            # headroom must cover the QUEUED depth though: 2 open
+            # virtual slots against 950 queued is still saturation,
+            # or health would flap with probe timing and shedding
+            # would never engage on a genuinely overloaded server.
+            headroom = hv.headroom(srv._bindings)
+            if headroom >= depth:
+                saturated = False
+                detail += f" (hv headroom {headroom})"
+        checks["queue"] = _check(not saturated, "degraded", detail)
         streak = int(getattr(srv, "checkpoint_fail_streak", 0))
         checks["checkpoint"] = _check(
             streak == 0, "degraded",

@@ -287,7 +287,9 @@ class GatewayService:
                              quotas=self.tenants.quotas(),
                              faults=self.faults,
                              checkpoint_dir=serve_dir,
-                             resume=resume)
+                             resume=resume,
+                             resident_budgets=self.tenants
+                             .resident_budgets())
         return _Generation(gen_id, engine, server, self.registry.names,
                            serve_dir=serve_dir)
 
@@ -991,6 +993,12 @@ class GatewayService:
                 out["queue_depth"] = len(gen.server.queue)
                 out["in_flight"] = gen.server.in_flight
                 out["serve"] = dict(gen.server.counters)
+        if gen is not None:
+            # resident/virtual occupancy (lane virtualization, hv/) —
+            # absent when the gateway runs without oversubscription
+            hv = gen.server.hv_stats()
+            if hv is not None:
+                out["hv"] = hv
         out["health"] = self.health()
         return out
 
@@ -1011,7 +1019,8 @@ class GatewayService:
             http_requests=dict(self.http_counts),
             analysis_counts=dict(self.analysis_counts),
             gateway_counts=gateway_counts,
-            shed_counts=shed_counts)
+            shed_counts=shed_counts,
+            hv_stats=gen.server.hv_stats() if gen else None)
 
     # -- lifecycle ---------------------------------------------------------
     def shutdown(self, drain: bool = True,

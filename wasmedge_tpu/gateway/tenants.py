@@ -91,11 +91,17 @@ class TenantPolicy:
     # (analysis/policy.py AnalysisPolicy; None = inherit the file's
     # top-level "analysis" default, which itself defaults to no vetting)
     analysis: Optional[object] = None
+    # Lane-virtualization resident-bytes budget (wasmedge_tpu/hv/):
+    # caps how many PHYSICAL lanes this tenant's requests may hold at
+    # once (budget / effective-lane-bytes); over-cap requests wait as
+    # swapped-out virtual lanes instead of being rejected.  None =
+    # unlimited.  Only meaningful on an hv-enabled gateway.
+    resident_budget_bytes: Optional[int] = None
 
     @classmethod
     def from_dict(cls, name: str, d: dict) -> "TenantPolicy":
         known = {"api_key", "weight", "quota", "rate_per_s", "burst",
-                 "can_register", "analysis"}
+                 "can_register", "analysis", "resident_budget_bytes"}
         bad = set(d) - known
         if bad:
             raise ValueError(
@@ -117,7 +123,11 @@ class TenantPolicy:
                    burst=(float(d["burst"]) if d.get("burst") is not None
                           else None),
                    can_register=bool(d.get("can_register", True)),
-                   analysis=analysis)
+                   analysis=analysis,
+                   resident_budget_bytes=(
+                       int(d["resident_budget_bytes"])
+                       if d.get("resident_budget_bytes") is not None
+                       else None))
 
 
 class GatewayTenants:
@@ -184,6 +194,14 @@ class GatewayTenants:
     def quotas(self) -> Dict[str, int]:
         return {p.name: p.quota for p in self.policies.values()
                 if p.quota is not None}
+
+    def resident_budgets(self) -> Dict[str, int]:
+        """tenant -> resident-bytes budget for the lane-virtualization
+        layer (BatchServer resident_budgets=); tenants without one are
+        uncapped."""
+        return {p.name: p.resident_budget_bytes
+                for p in self.policies.values()
+                if p.resident_budget_bytes is not None}
 
     # -- load shedding -----------------------------------------------------
     def effective_weight(self, tenant: str) -> float:

@@ -1,0 +1,43 @@
+"""Lane-memory virtualization: the scheduler as a hypervisor (r14).
+
+The serving layer's capacity was hard-capped at the physical lane
+count: every admitted request owned a full device-resident linear
+memory + stack plane for its whole lifetime, even while parked behind
+a long neighbour.  Following "Towards a Linear-Algebraic Hypervisor"
+(PAPERS.md), this package decouples *virtual* lanes (requests with
+live guest state) from *physical* device lanes: cold lanes swap their
+memory/stack/globals/t0 plane columns to a host-side content-addressed
+`SwapStore` at launch boundaries, and swap back onto ANY free physical
+lane through the same jitted column-install seam the lane recycler
+uses — a parked lane is a suspended continuation whose state needs no
+HBM ("Continuing WebAssembly with Effect Handlers", PAPERS.md).
+
+  swapstore.py   content-addressed host store (crash-atomic writes,
+                 refcounted blobs, corruption detection) + the per-lane
+                 plane column serializer (batch/checkpoint.py's plane
+                 discipline, one lane wide)
+  policy.py      deterministic LRU eviction policy (last-progress
+                 step, deadline-distance bias, never mid-hostcall-
+                 drain, never the sole runnable lane) and the
+                 resident-bytes budget math (seeded from
+                 DeviceImage.analysis footprint bounds when available)
+  manager.py     LaneVirtualizer: the BatchServer-side orchestrator —
+                 virtual admission, boundary rebalance (swap-out /
+                 swap-in), per-tenant resident caps, checkpoint
+                 journal, fault seams (swap_out / swap_in /
+                 swap_store_write)
+"""
+
+from wasmedge_tpu.hv.manager import LaneVirtualizer, VirtualLane  # noqa: F401
+from wasmedge_tpu.hv.policy import (  # noqa: F401
+    EvictionCandidate,
+    effective_lane_bytes,
+    pick_victims,
+    resident_lane_cap,
+)
+from wasmedge_tpu.hv.swapstore import (  # noqa: F401
+    SwapCorrupt,
+    SwapStore,
+    deserialize_lane,
+    serialize_lanes,
+)

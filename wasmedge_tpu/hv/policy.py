@@ -1,0 +1,147 @@
+"""Eviction policy + resident-budget math for lane virtualization.
+
+Everything here is a pure, deterministic function over plain host data
+— the manager feeds it per-lane tracking dicts and it returns ordered
+victim lists — so the policy is unit-testable without a device and two
+runs over the same inputs always evict the same lanes (the bit-
+identical oversubscription guarantee depends on it).
+
+Eviction order (most-evictable first):
+  1. LRU over last-retired step: lanes whose retired count has not
+     advanced for the longest (parked/blocked lanes have the stalest
+     progress, so the "biased toward parked/blocked" clause falls out
+     of the same key)
+  2. deadline distance: among equally-cold lanes, no-deadline lanes
+     first, then the most deadline-DISTANT (evicting a lane about to
+     meet its deadline would convert a near-win into a 504)
+  3. longest-resident first (round-robin rotation under ties — every
+     virtual lane gets device time, so no future starves)
+  4. lane index (total order: determinism under full ties)
+
+Hard exclusions (never victims):
+  - a lane mid-hostcall-drain (trap == TRAP_HOSTCALL): its host-side
+    outcall is in flight and the drain writes back into the column
+  - the sole runnable resident lane: evicting it would idle the device
+  - a lane resident for fewer than `min_resident_rounds` (anti-thrash:
+    every install earns at least one launch slice)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class EvictionCandidate:
+    """Host-side view of one resident lane at a launch boundary."""
+
+    lane: int
+    last_progress_step: int     # server total at last retired advance
+    resident_since_round: int   # round the lane was (re)installed
+    deadline: Optional[float]   # monotonic stamp, None = none
+    trap: int = 0               # 0 running / TRAP_HOSTCALL mid-drain
+
+
+def pick_victims(candidates: Sequence[EvictionCandidate], need: int,
+                 now: float, current_round: int,
+                 min_resident_rounds: int = 1,
+                 incoming_runnable: int = 0) -> List[int]:
+    """Up to `need` victim lane indices, most-evictable first.  Pure +
+    deterministic (see module docstring for the order).
+
+    Only RUNNABLE lanes (trap == 0) are eligible: that excludes every
+    parked/trapped lane and, in particular, a mid-hostcall-drain lane
+    (the TRAP_HOSTCALL sentinel is nonzero) — its host-side outcall
+    writes back into the column.
+
+    `incoming_runnable` counts runnable lanes the caller has already
+    planned to install this same boundary (they are not in
+    `candidates` yet) — the sole-runnable guard must credit them, or a
+    server that frees a lane every round would never rotate."""
+    if need <= 0:
+        return []
+    eligible = [c for c in candidates
+                if c.trap == 0
+                and current_round - c.resident_since_round
+                >= min_resident_rounds]
+    runnable = sum(1 for c in candidates if c.trap == 0) \
+        + max(int(incoming_runnable), 0)
+    # never the sole runnable lane: at least one runnable resident must
+    # survive every eviction pass or the device idles (and a 1-lane
+    # server would stall outright)
+    max_evict = max(runnable - 1, 0)
+
+    def key(c: EvictionCandidate):
+        return (
+            c.last_progress_step,
+            0 if c.deadline is None else 1,
+            -(c.deadline - now) if c.deadline is not None else 0.0,
+            c.resident_since_round,
+            c.lane,
+        )
+
+    picks = sorted(eligible, key=key)[:min(need, max_evict)]
+    return [c.lane for c in picks]
+
+
+# ---------------------------------------------------------------------------
+# resident-bytes budget
+# ---------------------------------------------------------------------------
+def effective_lane_bytes(engine) -> int:
+    """Bytes of device state the budget charges per resident lane.
+
+    Seeded from `DeviceImage.analysis` static footprint bounds when the
+    analyzer proved them (analysis/analyzer.py: mem_pages_bound /
+    value_stack_bound / call_depth_bound) — a module proven to touch
+    one page and 40 stack slots should not be charged for the full
+    configured plane allocation, since that is exactly the headroom a
+    kernel-tier block-packed layout reclaims.  Each term clamps to the
+    engine's actual allocation (image page ceiling, configured stack/
+    frame depths), so the bound never exceeds what the planes hold.
+    Falls back to the allocated geometry for unbounded or unanalyzed
+    modules, so the budget is never optimistic without proof."""
+    analysis = getattr(getattr(engine, "img", None), "analysis", None)
+    if analysis is None:
+        return _geometry_lane_bytes(engine)
+    pages = getattr(analysis, "mem_pages_bound", None)
+    stack = getattr(analysis, "value_stack_bound", None)
+    depth = getattr(analysis, "call_depth_bound", None)
+    if pages is None or stack is None or depth is None:
+        return _geometry_lane_bytes(engine)
+    cfg = engine.cfg
+    mem_b = min(int(pages), int(engine.img.mem_pages_max)) * 65536
+    # per-slot cost matches the allocated plane set: lo/hi int32 pairs,
+    # plus e2/e3 only when the image carries the v128 extension planes
+    slot_b = 4 * (4 if getattr(engine.img, "has_simd", False) else 2)
+    stack_b = min(int(stack), int(cfg.value_stack_depth)) * slot_b
+    frame_b = min(int(depth), int(cfg.call_stack_depth)) * 12
+    bound = max(mem_b + stack_b + frame_b + 256, 1)
+    # the proven bound can never charge MORE than the allocation holds
+    return min(bound, _geometry_lane_bytes(engine))
+
+
+def _geometry_lane_bytes(engine) -> int:
+    """Static per-lane byte estimate from the engine geometry alone
+    (no state built yet): memory plane + value stack (lo/hi[/e2/e3]) +
+    frame planes + globals + fixed scalars."""
+    cfg = engine.cfg
+    img = engine.img
+    mem_b = max(int(img.mem_pages_max), 0) * 65536 \
+        if getattr(img, "has_memory", True) else 0
+    simd = 4 if getattr(img, "has_simd", False) else 2
+    stack_b = int(cfg.value_stack_depth) * 4 * simd
+    frame_b = int(cfg.call_stack_depth) * 12
+    glob_b = len(getattr(img, "globals_lo", ())) * 8
+    return max(mem_b + stack_b + frame_b + glob_b + 256, 1)
+
+
+def resident_lane_cap(lanes: int, budget_bytes: Optional[int],
+                      lane_bytes: int) -> int:
+    """Physical lanes the resident-bytes budget admits concurrently:
+    floor(budget / bytes-per-lane), clamped to [1, lanes] — at least
+    one lane must stay installable or the server deadlocks with work
+    admitted."""
+    if budget_bytes is None:
+        return int(lanes)
+    return max(1, min(int(lanes), int(budget_bytes) // max(lane_bytes, 1)))

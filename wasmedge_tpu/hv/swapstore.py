@@ -1,0 +1,203 @@
+"""Host-side swap store for cold lane state + the lane column serializer.
+
+A swapped-out virtual lane is one column of every lane-axis BatchState
+plane (pc/stacks/frames/globals/memory/t0 — exactly the planes
+batch/checkpoint.py snapshots, one lane wide) packed into a compressed
+npz payload.  The `SwapStore` keys payloads by content (sha256), keeps
+them in memory, and — when given a directory — mirrors them to disk
+through `utils/fsio.atomic_write_bytes`, so a crash mid-swap can never
+leave a truncated blob where a later swap-in would trip over it.
+
+Integrity is end-to-end: `get()` re-hashes the payload against its key
+and raises `SwapCorrupt` on any mismatch (bit rot, torn write, a
+crafted file) — the caller decides whether that is a skip-and-record
+(checkpoint adoption) or a rejected request (live swap-in).
+
+Blobs are refcounted, not garbage-collected by scan: the manager
+releases a key when the owning request resolves (or when a re-swap
+supersedes it); serve checkpoints embed the payload bytes directly in
+the snapshot npz, so a restore never depends on the store's retention.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from wasmedge_tpu.utils.fsio import atomic_write_bytes
+
+
+class SwapCorrupt(RuntimeError):
+    """A swap-store payload failed its content-hash check (or is
+    missing entirely): the lane state it held is unrecoverable.  Live
+    swap-ins surface this as a machine-readable request failure;
+    lineage adoption records and skips the entry."""
+
+    def __init__(self, key: str, reason: str):
+        super().__init__(f"swap entry {key[:12]}… corrupt: {reason}")
+        self.key = key
+        self.reason = reason
+
+
+class SwapStore:
+    """Content-addressed, refcounted host store for swapped lane state.
+
+    `faults` is an optional testing.faults.FaultInjector: `put()` fires
+    the `swap_store_write` seam before any bytes move, so an injected
+    store failure leaves neither a memory entry nor a disk file — the
+    swap-out that drove it keeps its lane resident and retries at the
+    next boundary."""
+
+    def __init__(self, dir: Optional[str] = None, faults=None):
+        self.dir = os.fspath(dir) if dir else None
+        self.faults = faults
+        self._mem: Dict[str, bytes] = {}
+        self._refs: Dict[str, int] = {}
+        self.puts = 0
+        self.gets = 0
+        if self.dir:
+            os.makedirs(self.dir, exist_ok=True)
+
+    def __len__(self) -> int:
+        return len(self._mem)
+
+    @property
+    def bytes_held(self) -> int:
+        return sum(len(b) for b in self._mem.values())
+
+    @staticmethod
+    def key_of(payload: bytes) -> str:
+        return hashlib.sha256(payload).hexdigest()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}.lane")
+
+    def put(self, payload: bytes) -> str:
+        """Store one serialized lane; returns the content key.  An
+        identical payload (same content) shares the entry — the
+        refcount tracks owners."""
+        key = self.key_of(payload)
+        if self.faults is not None:
+            self.faults.fire("swap_store_write", key=key,
+                             nbytes=len(payload))
+        if key not in self._mem:
+            self._mem[key] = bytes(payload)
+            if self.dir:
+                atomic_write_bytes(self._path(key), payload)
+        self._refs[key] = self._refs.get(key, 0) + 1
+        self.puts += 1
+        return key
+
+    def adopt(self, key: str, payload: bytes):
+        """Re-seed an entry from a checkpoint-embedded blob (restore
+        path).  The payload is verified against the key FIRST — a
+        corrupt snapshot blob must never become a trusted entry."""
+        if self.key_of(payload) != key:
+            raise SwapCorrupt(key, "adopted payload hash mismatch")
+        if key not in self._mem:
+            self._mem[key] = bytes(payload)
+            if self.dir:
+                atomic_write_bytes(self._path(key), payload)
+        self._refs[key] = self._refs.get(key, 0) + 1
+
+    def get(self, key: str) -> bytes:
+        """Fetch + verify one payload; raises SwapCorrupt on hash
+        mismatch or a missing entry."""
+        self.gets += 1
+        payload = self._mem.get(key)
+        if payload is None and self.dir:
+            try:
+                with open(self._path(key), "rb") as f:
+                    payload = f.read()
+            except OSError as e:
+                raise SwapCorrupt(key, f"unreadable: {e}") from e
+        if payload is None:
+            raise SwapCorrupt(key, "missing entry")
+        if self.key_of(payload) != key:
+            raise SwapCorrupt(key, "content hash mismatch")
+        return payload
+
+    def release(self, key: str):
+        """Drop one reference; the entry (and its disk mirror) goes
+        away with the last one.  Unknown keys are a no-op — a restore
+        may release entries an older process owned."""
+        n = self._refs.get(key)
+        if n is None:
+            return
+        if n > 1:
+            self._refs[key] = n - 1
+            return
+        self._refs.pop(key, None)
+        self._mem.pop(key, None)
+        if self.dir:
+            try:
+                os.unlink(self._path(key))
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# lane column serialization
+# ---------------------------------------------------------------------------
+def lane_plane_names(state, lanes: int) -> Tuple[str, ...]:
+    """The BatchState fields that carry a per-lane column (last axis ==
+    lanes) — the same detection rule the LaneRecycler's template
+    capture uses, so the two seams can never disagree about what
+    constitutes 'lane state'."""
+    out = []
+    for name in state._fields:
+        plane = getattr(state, name)
+        if plane is None:
+            continue
+        arr = np.asarray(plane)
+        if arr.ndim == 0 or arr.shape[-1] != lanes:
+            continue  # no lane axis (e.g. the op_hist histogram)
+        out.append(name)
+    return tuple(out)
+
+
+def serialize_lanes(state, lane_idx, lanes: int,
+                    stdout_pos=None) -> list:
+    """Several lanes' plane columns -> one compressed npz payload per
+    lane.  Batched on purpose: ONE device->host gather per plane for
+    the whole victim set (a per-lane loop would pay the dispatch
+    overhead `planes x victims` times per boundary).
+
+    `stdout_pos[k]` is lane k's logical stdout stream position
+    (batch/hostcall.py cursor) — it rides the payload so a swap-in onto
+    a DIFFERENT physical lane continues the request's output stream
+    instead of inheriting the target lane's history."""
+    idx = np.asarray(lane_idx, np.int64)
+    names = lane_plane_names(state, lanes)
+    mirrors = {}
+    for name in names:
+        plane = getattr(state, name)
+        # jnp fancy-index gathers only the victim columns; np.asarray
+        # then moves exactly those bytes host-side
+        mirrors[name] = np.asarray(plane[..., idx])
+    out = []
+    for k in range(idx.size):
+        arrays = {f"p_{name}": np.ascontiguousarray(m[..., k])
+                  for name, m in mirrors.items()}
+        meta = {"planes": list(names),
+                "stdout_pos": int(stdout_pos[k])
+                if stdout_pos is not None else 0}
+        buf = io.BytesIO()
+        np.savez_compressed(buf, meta=json.dumps(meta), **arrays)
+        out.append(buf.getvalue())
+    return out
+
+
+
+
+def deserialize_lane(payload: bytes) -> Tuple[Dict[str, np.ndarray], int]:
+    """Payload bytes -> ({plane_name: column}, stdout_pos)."""
+    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+        meta = json.loads(str(z["meta"]))
+        cols = {name: z[f"p_{name}"] for name in meta["planes"]}
+    return cols, int(meta.get("stdout_pos", 0))

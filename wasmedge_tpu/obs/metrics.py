@@ -59,7 +59,7 @@ class _Writer:
 def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                       failures=None, http_requests=None,
                       analysis_counts=None, gateway_counts=None,
-                      shed_counts=None) -> str:
+                      shed_counts=None, hv_stats=None) -> str:
     """Render one metrics snapshot.  All sources optional: `recorder` a
     FlightRecorder, `stats` a common.statistics.Statistics, `hostcall_stats`
     an engine's pipeline counter dict, `failures` extra FailureRecords
@@ -69,8 +69,47 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
     ({"bounded": n, "unbounded": n, "policy_rejected": n}),
     `gateway_counts` the gateway's durability/robustness counters
     ({"restarts": n, "rollbacks": n}), `shed_counts` the per-tenant
-    degraded-mode shed tally."""
+    degraded-mode shed tally, `hv_stats` a BatchServer.hv_stats()
+    lane-virtualization snapshot (wasmedge_tpu/hv/)."""
     w = _Writer()
+
+    if hv_stats:
+        w.head("wasmedge_hv_swaps_total", "counter",
+               "Virtual-lane swaps by direction (wasmedge_tpu/hv/: "
+               "out = lane state parked host-side, in = reinstalled "
+               "onto a physical lane).")
+        w.sample("wasmedge_hv_swaps_total", {"direction": "out"},
+                 int(hv_stats.get("swaps_out", 0)))
+        w.sample("wasmedge_hv_swaps_total", {"direction": "in"},
+                 int(hv_stats.get("swaps_in", 0)))
+        w.head("wasmedge_hv_resident_lanes", "gauge",
+               "Physical lanes currently holding a request.")
+        w.sample("wasmedge_hv_resident_lanes", None,
+                 int(hv_stats.get("resident", 0)))
+        w.head("wasmedge_hv_virtual_lanes", "gauge",
+               "Admitted requests currently off-device (fresh + "
+               "swapped virtual lanes).")
+        w.sample("wasmedge_hv_virtual_lanes", None,
+                 int(hv_stats.get("virtual", 0)))
+        w.head("wasmedge_hv_resident_lane_cap", "gauge",
+               "Physical lanes the resident-bytes budget admits.")
+        w.sample("wasmedge_hv_resident_lane_cap", None,
+                 int(hv_stats.get("resident_cap", 0)))
+        w.head("wasmedge_hv_swap_store_bytes", "gauge",
+               "Host bytes held by the swap store.")
+        w.sample("wasmedge_hv_swap_store_bytes", None,
+                 int(hv_stats.get("store_bytes", 0)))
+        if hv_stats.get("swap_out_faults") or \
+                hv_stats.get("swap_in_faults") or \
+                hv_stats.get("swap_corrupt"):
+            w.head("wasmedge_hv_swap_faults_total", "counter",
+                   "Swap operations that failed (faulted swap-out/"
+                   "swap-in retried; corrupt entries rejected).")
+            for kind in ("swap_out_faults", "swap_in_faults",
+                         "swap_corrupt"):
+                if hv_stats.get(kind):
+                    w.sample("wasmedge_hv_swap_faults_total",
+                             {"kind": kind}, int(hv_stats[kind]))
 
     if gateway_counts is not None:
         w.head("wasmedge_gateway_restarts_total", "counter",
@@ -176,6 +215,25 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
             for kind in sorted(recorder.hostcalls):
                 w.sample("wasmedge_hostcall_drained_lanes_total",
                          {"kind": kind}, recorder.hostcalls[kind].lanes)
+        hv_swaps = getattr(recorder, "hv_swaps", None)
+        if hv_swaps:
+            name = "wasmedge_hv_swap_latency_seconds"
+            w.head(name, "histogram",
+                   "Lane-virtualization swap latency by direction "
+                   "(serialize+store for out, fetch+install for in).")
+            for direction in sorted(hv_swaps):
+                h = hv_swaps[direction]
+                for le, acc in h.cumulative():
+                    w.sample(f"{name}_bucket",
+                             {"direction": direction,
+                              "le": repr(float(le))}, acc)
+                w.sample(f"{name}_bucket",
+                         {"direction": direction, "le": "+Inf"},
+                         h.count)
+                w.sample(f"{name}_sum", {"direction": direction},
+                         h.sum_s)
+                w.sample(f"{name}_count", {"direction": direction},
+                         h.count)
         admission = getattr(recorder, "admission", None)
         if admission is not None and admission.count:
             name = "wasmedge_serve_admission_latency_seconds"
@@ -226,7 +284,8 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
 def export_prometheus(path, recorder=None, stats=None,
                       hostcall_stats=None, failures=None,
                       http_requests=None, analysis_counts=None,
-                      gateway_counts=None, shed_counts=None) -> str:
+                      gateway_counts=None, shed_counts=None,
+                      hv_stats=None) -> str:
     """Render and write a metrics snapshot to `path` (or file-like)."""
     text = render_prometheus(recorder=recorder, stats=stats,
                              hostcall_stats=hostcall_stats,
@@ -234,7 +293,8 @@ def export_prometheus(path, recorder=None, stats=None,
                              http_requests=http_requests,
                              analysis_counts=analysis_counts,
                              gateway_counts=gateway_counts,
-                             shed_counts=shed_counts)
+                             shed_counts=shed_counts,
+                             hv_stats=hv_stats)
     if hasattr(path, "write"):
         path.write(text)
     else:

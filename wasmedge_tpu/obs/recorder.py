@@ -123,6 +123,9 @@ class NullRecorder:
     def observe_admission(self, dur_s):
         pass
 
+    def observe_swap(self, direction, dur_s):
+        pass
+
     def add_tier_seconds(self, tier, dur_s):
         pass
 
@@ -176,6 +179,7 @@ class FlightRecorder:
         self._mono0 = time.monotonic()  # duration clock zero
         self.hostcalls = {}        # kind -> LatencyHistogram
         self.admission = LatencyHistogram()  # serve submit -> install
+        self.hv_swaps = {}         # "in"/"out" -> LatencyHistogram
         self.tier_seconds = {}     # tier -> accumulated seconds
         self.failure_counts = {}   # fault_class -> count
         self.opcode_counts = None  # np.int64 [NUM_OPCODES+3] when folded
@@ -242,6 +246,14 @@ class FlightRecorder:
         """One serving-layer admission observation: queue wait from
         submit() to lane install (wasmedge_tpu/serve/)."""
         self.admission.observe(dur_s)
+
+    def observe_swap(self, direction, dur_s):
+        """One lane-virtualization swap observation (wasmedge_tpu/hv/):
+        serialize+store for "out", fetch+install for "in"."""
+        h = self.hv_swaps.get(direction)
+        if h is None:
+            h = self.hv_swaps[direction] = LatencyHistogram()
+        h.observe(dur_s)
 
     def add_tier_seconds(self, tier, dur_s):
         self.tier_seconds[tier] = \

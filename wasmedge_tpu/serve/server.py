@@ -81,7 +81,8 @@ class BatchServer:
                  lanes: Optional[int] = None,
                  stats=None, weights=None, quotas=None, faults=None,
                  checkpoint_dir: Optional[str] = None,
-                 resume: bool = False, engine=None):
+                 resume: bool = False, engine=None,
+                 resident_budgets=None):
         from wasmedge_tpu.common.configure import Configure
         from wasmedge_tpu.batch.engine import BatchEngine
         from wasmedge_tpu.obs.recorder import recorder_of
@@ -118,6 +119,25 @@ class BatchServer:
         self.queue = FairQueue(self.k.queue_capacity, weights=weights,
                                quotas=quotas)
         self.recycler = LaneRecycler(self.engine)
+        # lane virtualization (wasmedge_tpu/hv/): when either capacity
+        # knob is set, admission counts the resident-bytes budget and
+        # virtual-lane headroom instead of the raw free-lane heap, and
+        # the boundary rebalance swaps cold lanes host-side.  Off (the
+        # default) every path below behaves exactly as before.
+        self.hv = None
+        if getattr(self.conf, "hv", None) is not None \
+                and self.conf.hv.active:
+            from wasmedge_tpu.hv import LaneVirtualizer
+
+            self.hv = LaneVirtualizer(
+                self.engine, self.recycler, self.conf.hv, self.obs,
+                faults=faults, record=self._record,
+                tenant_budgets=resident_budgets)
+            self.hv.install_cb = self._hv_on_install
+            # a corrupt-entry loss is an admitted request terminated by
+            # the infrastructure — counted like an in-flight kill so
+            # the outcome counters keep reconciling with submitted
+            self.hv.lost_cb = self._hv_on_lost
         self.checkpoint_dir = checkpoint_dir or self.k.checkpoint_dir
         self.state = None
         self.total = 0
@@ -233,12 +253,27 @@ class BatchServer:
     # -- serving loop ------------------------------------------------------
     @property
     def in_flight(self) -> int:
-        return len(self._bindings)
+        """Admitted requests holding capacity: resident lanes plus (hv)
+        virtual lanes waiting off-device."""
+        n = len(self._bindings)
+        if self.hv is not None:
+            n += len(self.hv.waiting)
+        return n
+
+    def _has_work(self) -> bool:
+        return bool(self._bindings or len(self.queue)
+                    or (self.hv is not None and self.hv.waiting))
 
     def _flight_by_tenant(self) -> Dict[str, int]:
+        """Per-tenant admitted counts for FairQueue quota accounting —
+        virtual lanes count too: an admitted-but-swapped request holds
+        its tenant's quota exactly like a resident one."""
         out: Dict[str, int] = {}
         for req in self._bindings.values():
             out[req.tenant] = out.get(req.tenant, 0) + 1
+        if self.hv is not None:
+            for v in self.hv.waiting.values():
+                out[v.req.tenant] = out.get(v.req.tenant, 0) + 1
         return out
 
     def step(self) -> bool:
@@ -256,7 +291,7 @@ class BatchServer:
                 # (so a run_until_idle() polling alongside start()
                 # parks instead of busy-spinning) and report status
                 self._wake.wait(timeout=0.05)
-                return bool(self._bindings or len(self.queue))
+                return self._has_work()
             self._stepping = True
         try:
             return self._step_body()
@@ -280,6 +315,8 @@ class BatchServer:
             now = time.monotonic()
             self._expire_queued(now)
             admitted = self._admit(now)
+            if self.hv is not None:
+                admitted += self._hv_boundary(now)
             run_from = (self.state, self.total) if self._bindings else None
             self._snap_stdout()   # pre-launch pairing for checkpoint()
             self._inflight = run_from is not None
@@ -334,12 +371,19 @@ class BatchServer:
                              track="serve")
             self._maybe_checkpoint()
             if not (admitted or progressed or harvested) \
-                    and not self._bindings and len(self.queue):
+                    and not self._bindings and len(self.queue) \
+                    and not (self.hv is not None and self.hv.waiting):
                 # possibly stalled — but a submit() racing the launch
                 # window lands in the queue AFTER this round's admit
                 # phase; re-try admission before declaring a stall so a
                 # perfectly admissible late arrival is installed (it
-                # runs next round) instead of swept
+                # runs next round) instead of swept.  An hv server with
+                # virtual lanes outstanding is NEVER swept here: "no
+                # physical lane free but resident budget / virtual
+                # headroom available" is backpressure (the waiters
+                # drain at coming boundaries), not a permanent
+                # admission block — the pre-hv free-lane-heap check
+                # would have misclassified exactly this state.
                 if self._admit(time.monotonic()):
                     return True
                 # genuinely stalled: everything queued is admission-
@@ -354,7 +398,7 @@ class BatchServer:
                         f"request {req.id} can never be admitted "
                         f"(tenant {req.tenant!r} admission-blocked)"))
                 return False
-            return bool(self._bindings or len(self.queue))
+            return self._has_work()
 
     def run_until_idle(self, max_rounds: Optional[int] = None) -> int:
         """Drive step() until no work remains; returns rounds executed."""
@@ -394,14 +438,13 @@ class BatchServer:
             with self._lock:
                 if self._stop:
                     return
-                has_work = bool(self._bindings or len(self.queue))
-                if not has_work:
+                if not self._has_work():
                     self._wake.wait(timeout=0.05)
                     if self._stop:
                         return
                     # still nothing after the wait: don't burn an idle
                     # round (rounds counter, no-op checkpoint checks)
-                    if not (self._bindings or len(self.queue)):
+                    if not self._has_work():
                         continue
             try:
                 self.step()
@@ -424,7 +467,7 @@ class BatchServer:
         if threaded:
             while True:
                 with self._lock:
-                    idle = not (self._bindings or len(self.queue)) \
+                    idle = not self._has_work() \
                         or self.failed is not None
                 if idle:
                     return True
@@ -434,7 +477,7 @@ class BatchServer:
         while self.step():
             if deadline is not None and time.monotonic() >= deadline:
                 return False
-        return not (self._bindings or len(self.queue))
+        return not self._has_work()
 
     def shutdown(self, drain: bool = True,
                  timeout_s: Optional[float] = None):
@@ -464,6 +507,13 @@ class BatchServer:
                     self.counters["killed"] += 1   # terminated in flight
                 req.future._reject(err)
             self._bindings.clear()
+            if self.hv is not None:
+                # virtual lanes are admitted in-flight work too: their
+                # blobs release and their futures reject like bindings
+                for req in self.hv.drop_all():
+                    if not req.future.done:
+                        self.counters["killed"] += 1
+                    req.future._reject(err)
             self._free = sorted(set(range(self.lanes)))
             for req in self.queue.pop_all():
                 self.counters["rejected"] += 1
@@ -477,6 +527,23 @@ class BatchServer:
                 f"request {req.id} expired before admission"))
 
     def _admit(self, now: float) -> int:
+        if self.hv is not None:
+            # hv admission counts the resident-bytes budget and the
+            # virtual headroom, not the raw free-lane heap: requests
+            # beyond the physical lane count admit as fresh VIRTUAL
+            # lanes and install at a boundary rebalance when budget
+            # allows (the direct capacity multiplier of ROADMAP #4)
+            headroom = self.hv.headroom(self._bindings)
+            if headroom <= 0 or not len(self.queue):
+                return 0
+            picks = self.queue.pop(headroom, self._flight_by_tenant())
+            rnd = self.counters["rounds"]
+            for req in picks:
+                self.hv.admit(req, rnd)
+                self.obs.instant("admit_virtual", cat="hv", track="hv",
+                                 id=req.id, tenant=req.tenant)
+            self.counters["admitted"] += len(picks)
+            return len(picks)
         if not self._free or not len(self.queue):
             return 0
         picks = self.queue.pop(len(self._free), self._flight_by_tenant())
@@ -507,6 +574,66 @@ class BatchServer:
                                  id=req.id, tenant=req.tenant, lane=lane)
         self.counters["admitted"] += len(picks)
         return len(picks)
+
+    def _hv_boundary(self, now: float) -> int:
+        """Lane-virtualization boundary pass (under the lock, before
+        the launch slice): expire deadline-passed virtual lanes, then
+        rebalance — install waiting virtual lanes into free physical
+        lanes within the resident budget, evicting LRU victims to keep
+        rotating when the device is full.  Returns the number of
+        installs (progress, for the stall check)."""
+        moved = 0
+        for req in self.hv.expire(now):
+            # a virtual lane is ADMITTED work: its deadline kill counts
+            # like an in-flight kill, not a queued expiry
+            self.counters["killed"] += 1
+            moved += 1
+            req.future._reject(DeadlineExceeded(
+                f"request {req.id} exceeded its deadline while "
+                f"swapped out"))
+        if not self.hv.waiting:
+            return moved
+        if self.state is None:
+            v0 = next(iter(self.hv.waiting.values()))
+            fidx0 = self.recycler.func_idx(v0.req.func_name)
+            self.state = self.recycler.idle_state(fidx0)
+        before = len(self._bindings)
+        swaps0 = self.hv.counters["swaps_in"] \
+            + self.hv.counters["swaps_out"]
+        self.state = self.hv.rebalance(self.state, self._bindings,
+                                       self._free, now, self.total,
+                                       self.counters["rounds"])
+        swapped = (self.hv.counters["swaps_in"]
+                   + self.hv.counters["swaps_out"]) - swaps0
+        return moved + max(len(self._bindings) - before, 0) + swapped
+
+    def _hv_on_install(self, lane: int, req, first: bool):
+        """Install hook the LaneVirtualizer calls for every lane it
+        (re)initializes — keeps the recycled_lanes counter and the
+        admission-latency histogram identical to the non-hv path.
+        `first` marks a FRESH install (the request's first time on a
+        device lane): only those count as recycling and observe
+        admission latency — a swap-in is a continuation, not a new
+        occupancy (it has its own swaps_in counter)."""
+        if first:
+            if self._served_before[lane]:
+                self.counters["recycled_lanes"] += 1
+            self.obs.observe_admission(time.monotonic() - req.t_submit)
+            self.obs.instant("admit", cat="serve", track="serve",
+                             id=req.id, tenant=req.tenant, lane=lane)
+        self._served_before[lane] = True
+
+    def _hv_on_lost(self, req):
+        self.counters["killed"] += 1
+
+    def hv_stats(self) -> Optional[dict]:
+        """Lane-virtualization occupancy/counters snapshot (None when
+        hv is off) — the /v1/status "hv" block and the Prometheus
+        wasmedge_hv_* series read this."""
+        if self.hv is None:
+            return None
+        with self._lock:
+            return self.hv.stats(self._bindings)
 
     def _autotune_observe(self, t_launch: float, stats0: dict):
         """Feed the slice's wall time + tier-1 drain volume to the
@@ -560,6 +687,10 @@ class BatchServer:
         # are unchanged until the next launch, so the harvest phase must
         # not pay a second device->host sync for them
         self._planes = (trap, retired)
+        if self.hv is not None:
+            # LRU bookkeeping rides the mirrors this round already paid
+            # for: lanes whose retired count advanced are recently-used
+            self.hv.note_progress(trap, retired, self.total)
 
     def _harvest(self) -> int:
         """Resolve futures of every bound lane that stopped; park and
@@ -619,6 +750,8 @@ class BatchServer:
         self.state = self.recycler.park(self.state, done)
         for lane in done:
             heapq.heappush(self._free, lane)
+            if self.hv is not None:
+                self.hv.on_free(lane)
         return len(done)
 
     # -- supervision -------------------------------------------------------
@@ -660,21 +793,39 @@ class BatchServer:
                 self.failures))
             raise self.failed
         old_bindings = dict(self._bindings)
+        old_virtual: Dict[int, ServeRequest] = {}
+        if self.hv is not None:
+            old_virtual = {rid: v.req
+                           for rid, v in self.hv.waiting.items()}
         state = total = None
         bindings: Dict[int, ServeRequest] = {}
+        hv_triples: list = []
+        blobs: Dict[str, bytes] = {}
         from wasmedge_tpu.batch import checkpoint
 
         def load(m):
             if self.faults is not None:
                 self.faults.fire("checkpoint_load", path=m.path)
             st, tot = checkpoint.load(m.path, self.engine)
-            return st, tot, dict(m.payload or {})
+            payload = m.payload or {}
+            if isinstance(payload, dict) and "bindings" in payload:
+                b = dict(payload.get("bindings") or {})
+                triples = list(payload.get("hv") or [])
+            else:   # pre-hv payload shape: the bindings dict itself
+                b = dict(payload)
+                triples = []
+            bl = {}
+            if any(k is not None for _, k, _ in triples):
+                raw = checkpoint.read_extra_arrays(m.path, "hvblob_")
+                bl = {name[len("hvblob_"):]: arr.tobytes()
+                      for name, arr in raw.items()}
+            return st, tot, b, triples, bl
 
         got = self._lineage.walk_newest(
             load, lambda e, m: self._record("checkpoint", e,
                                             checkpoint=m.path))
         if got is not None:
-            state, total, bindings = got
+            state, total, bindings, hv_triples, blobs = got
         if state is None:
             # no surviving snapshot: restore an all-idle state and send
             # EVERY in-flight request back to the head of the queue
@@ -701,13 +852,32 @@ class BatchServer:
             cur[1][:] = cur[0]
         self.state, self.total = state, total
         self._bindings = bindings
+        if self.hv is not None:
+            self.hv.reset_residency(bindings, self.counters["rounds"],
+                                    self.total)
         self._planes = None
         self._snap_stdout()   # restored state + collapsed cursor pair up
         # submission order (monotonic request id), not lane order: lanes
         # are reassigned on admission, so lane order would invert a
         # tenant's FIFO across the restore
         covered = {req.id for req in bindings.values()}
-        requeue = sorted((req for req in old_bindings.values()
+        candidates: Dict[int, ServeRequest] = {}
+        for req in old_bindings.values():
+            candidates[req.id] = req
+        for rid, req in old_virtual.items():
+            candidates[rid] = req
+        if self.hv is not None:
+            # the snapshot's virtual table is authoritative: swapped
+            # blobs re-adopt from the npz-embedded copies; entries
+            # whose blob is corrupt/missing come back as `lost` and
+            # re-run from scratch (at-least-once, like any uncovered
+            # in-flight request)
+            lost = self.hv.restore(hv_triples, blobs, covered)
+            covered |= {v.req.id
+                        for v in self.hv.waiting.values()}
+            for req in lost:
+                candidates[req.id] = req
+        requeue = sorted((req for req in candidates.values()
                           if req.id not in covered
                           and not req.future.done),
                          key=lambda r: r.id)
@@ -730,6 +900,11 @@ class BatchServer:
                 self.counters["killed"] += 1
             req.future._reject(exc)
         self._bindings.clear()
+        if self.hv is not None:
+            for req in self.hv.drop_all():
+                if not req.future.done:
+                    self.counters["killed"] += 1
+                req.future._reject(exc)
         for req in self.queue.pop_all():
             if not req.future.done:
                 self.counters["rejected"] += 1
@@ -780,13 +955,27 @@ class BatchServer:
                             f"serve-{self.total:012d}.npz")
         journal = [dict(lane=lane, **req.asdict())
                    for lane, req in sorted(self._bindings.items())]
+        invocation = {"serve_bindings": journal}
+        extra = None
+        payload = dict(self._bindings)
+        if self.hv is not None:
+            # the virtual table journals alongside the bindings, and
+            # swapped blobs embed in the npz straight from the
+            # SwapStore — the snapshot never faults a cold lane onto
+            # the device, and a restore never depends on store
+            # retention
+            invocation["hv_lanes"] = self.hv.journal_entries()
+            extra = self.hv.blob_arrays()
+            payload = {"bindings": dict(self._bindings),
+                       "hv": self.hv.snapshot_payload()}
         t0 = self.obs.now()
         try:
             if self.faults is not None:
                 self.faults.fire("checkpoint_save", path=path)
             checkpoint.save(path, self.engine, self.state, self.total,
-                            invocation={"serve_bindings": journal},
-                            stdout_pos=self._stdout_snap)
+                            invocation=invocation,
+                            stdout_pos=self._stdout_snap,
+                            extra_arrays=extra)
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
@@ -803,7 +992,7 @@ class BatchServer:
         # state/journal may still differ via admissions) instead of
         # stacking duplicates the prune pass would unlink while
         # surviving entries still reference the file
-        self._lineage.add(path, self.total, dict(self._bindings))
+        self._lineage.add(path, self.total, payload)
         self._lineage.prune(self.k.keep_checkpoints)
         return path
 
@@ -820,16 +1009,16 @@ class BatchServer:
 
         def load(m):
             state, total = checkpoint.load(m.path, self.engine)
-            journal = checkpoint.read_meta(m.path).get(
-                "invocation", {}).get("serve_bindings", [])
-            return state, total, journal
+            inv = checkpoint.read_meta(m.path).get("invocation", {})
+            return (state, total, inv.get("serve_bindings", []),
+                    inv.get("hv_lanes", []))
 
         got = lin.walk_newest(
             load, lambda e, m: self._record("checkpoint", e,
                                             checkpoint=m.path))
         if got is None:
             return
-        state, total, journal = got
+        state, total, journal, hv_journal = got
         self.state, self.total = state, total
         self._snap_stdout()   # load() rewound the cursor in place
         from wasmedge_tpu.serve.queue import advance_request_ids
@@ -840,9 +1029,12 @@ class BatchServer:
             self._bindings[int(entry["lane"])] = req
             self.adopted[req.id] = req.future
             advance_request_ids(req.id)
+        self._adopt_hv(hv_journal, lin.members[-1].path)
         self._free = sorted(set(range(self.lanes))
                             - set(self._bindings))
         self._served_before[list(self._bindings)] = True
+        if self.hv is not None:
+            self.hv.reset_residency(self._bindings, 0, self.total)
         # the full surviving lineage stays installed (like the
         # supervisor's twin adoption): older members remain usable as
         # _recover fallbacks, and the prune pass below keeps
@@ -850,11 +1042,16 @@ class BatchServer:
         # Older journals reuse the adopted request objects by id so a
         # fallback restore resolves the futures callers hold.
         byid = {r.id: r for r in self._bindings.values()}
+        if self.hv is not None:
+            for v in self.hv.waiting.values():
+                byid[v.req.id] = v.req
         survivors = []
         for m in lin.members[:-1]:
             try:
-                j2 = checkpoint.read_meta(m.path).get(
-                    "invocation", {}).get("serve_bindings", [])
+                inv2 = checkpoint.read_meta(m.path).get(
+                    "invocation", {})
+                j2 = inv2.get("serve_bindings", [])
+                hv2 = inv2.get("hv_lanes", [])
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
@@ -867,12 +1064,61 @@ class BatchServer:
                     req2 = ServeRequest.from_journal(e2)
                     advance_request_ids(req2.id)
                 snap2[int(e2["lane"])] = req2
-            m.payload = snap2
+            triples2 = []
+            for e2 in hv2:
+                req2 = byid.get(int(e2["id"]))
+                if req2 is None:
+                    req2 = ServeRequest.from_journal(e2)
+                    advance_request_ids(req2.id)
+                triples2.append((req2, e2.get("key"),
+                                 int(e2.get("stdout_pos", 0))))
+            m.payload = {"bindings": snap2, "hv": triples2} \
+                if (self.hv is not None or triples2) else snap2
             survivors.append(m)
         newest = lin.members[-1]
-        newest.payload = dict(self._bindings)
+        newest.payload = {"bindings": dict(self._bindings),
+                          "hv": self.hv.snapshot_payload()} \
+            if self.hv is not None else dict(self._bindings)
         lin.members = survivors + [newest]
         lin.prune(self.k.keep_checkpoints)
         self.obs.instant("resume_adopted", cat="serve", track="serve",
                          checkpoint=newest.path, steps=int(total),
                          in_flight=len(self._bindings))
+
+    def _adopt_hv(self, hv_journal, path: str):
+        """Cross-process adoption of the virtual-lane table: swapped
+        entries re-seed the SwapStore from the snapshot-embedded blobs;
+        corrupt/missing blobs (and every entry when this process runs
+        with hv OFF) re-queue at the front as fresh requests (at-least-
+        once) — a journaled virtual lane is never silently lost.
+        Adopted virtual requests get fresh futures like bindings do."""
+        if not hv_journal:
+            return
+        from wasmedge_tpu.batch import checkpoint
+        from wasmedge_tpu.serve.queue import advance_request_ids
+
+        triples = []
+        fallback = []
+        for e in hv_journal:
+            req = ServeRequest.from_journal(e)
+            req.t_submit = time.monotonic()
+            advance_request_ids(req.id)
+            self.adopted[req.id] = req.future
+            if self.hv is None:
+                fallback.append(req)
+            else:
+                triples.append((req, e.get("key"),
+                                int(e.get("stdout_pos", 0))))
+        if self.hv is not None:
+            try:
+                raw = checkpoint.read_extra_arrays(path, "hvblob_")
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._record("checkpoint", e, checkpoint=path)
+                raw = {}
+            blobs = {name[len("hvblob_"):]: arr.tobytes()
+                     for name, arr in raw.items()}
+            covered = {r.id for r in self._bindings.values()}
+            fallback.extend(self.hv.restore(triples, blobs, covered))
+        self.queue.push_front(sorted(fallback, key=lambda r: r.id))

@@ -42,6 +42,23 @@ the chaos driver (bench.py --chaos: Gateway.kill() then a fresh
 GatewayService(resume=True) over the same state dir), with the seams
 above supplying the weather around it.
 
+The lane-virtualization layer (wasmedge_tpu/hv/) adds the swap seams
+— r14's oversubscription surface:
+  - `"swap_out"`          before a victim lane's columns serialize
+                          (ctx: lane, id).  A faulted swap-out leaves
+                          the lane RESIDENT and retries at the next
+                          launch boundary — no state moves.
+  - `"swap_in"`           before a swapped virtual lane reinstalls
+                          onto a physical lane (ctx: lane, id).  A
+                          faulted swap-in re-queues the virtual lane
+                          without losing it; the target lane stays
+                          free.
+  - `"swap_store_write"`  inside SwapStore.put, before any bytes move
+                          (ctx: key, nbytes) — an injected store
+                          failure surfaces as a faulted swap-out (the
+                          crash-atomic writer guarantees no partial
+                          blob either way).
+
 Fault classes covered by the tier-1 suites (ISSUE 2 + ISSUE 5):
   - launch-time device error       Fault(point="launch", ...)
   - mid-serve host exception       Fault(point="serve", ...)
@@ -91,7 +108,8 @@ class Fault:
     #                            "gateway_register" | "generation_build" |
     #                            "generation_swap" | "journal_write" |
     #                            "http_response_delay" |
-    #                            "http_response_drop"
+    #                            "http_response_drop" | "swap_out" |
+    #                            "swap_in" | "swap_store_write"
     at: int = 0                # 0-based arrival index at that seam
     times: int = 1             # consecutive arrivals that fault
     lanes: Tuple[int, ...] = ()  # lane attribution (poison quarantine)
