@@ -21,13 +21,20 @@ vs_baseline  value / (50 x single-core interpreter ops/s) — the BASELINE.json
 """
 
 import json
+import os as _os
 import sys
 import time
 
 import numpy as np
 
 LANES = 4096
-FIB_N = 30          # BASELINE.json config 1: fib(30) per lane
+# BASELINE.json config 1: fib(30) per lane.  BENCH_FIB_N scales the
+# flagship down for CPU-container rounds (the r5 floors are TPU
+# numbers; a CPU container at ~hundreds of lockstep steps/s cannot
+# finish fib(30)x4096 in a bench budget) — the metric name and the
+# artifact record the actual n, so a scaled number can never be
+# mistaken for the flagship floor.
+FIB_N = int(_os.environ.get("BENCH_FIB_N", "30"))
 WARMUP_N = 8        # small run to trigger compilation before timing
 
 # Recorded single-core C++ interpreter throughput (wasm instrs/sec) used
@@ -226,7 +233,6 @@ def mesh_faults_smoke() -> int:
                              checkpoint_dir=d)
         res = sup.run("echo", [np.full(lanes, iters, np.int64)],
                       max_steps=1_000_000)
-    dt = time.perf_counter() - t0
     os.close(sink)
     # the injected device incident must be visible in the flight
     # recorder's event stream (mirrored FailureRecord instant)
@@ -235,6 +241,25 @@ def mesh_faults_smoke() -> int:
     ok = bool(res.completed.all()) and inj.fired == 1 \
         and any(f.fault_class == "device_launch" for f in sup.failures) \
         and trace_has_incident and len(devices) == 4
+
+    # phase 2 (r15): an injected SHARD-DRIVE fault must demote the
+    # supervisor to the threaded per-device rung — fallback-ladder
+    # wiring for the single-program mesh drive.  No cadence here, so
+    # the shard tier is attempted (and killed) first.
+    conf2 = Configure()
+    conf2.supervisor.backoff_base_s = 0.0
+    eng2, sink2 = _smoke_echo_engine(conf2, lanes)
+    inj2 = FaultInjector([Fault(point="shard_launch", at=0)])
+    sup2 = MeshSupervisor(eng2.inst, store=eng2.store, conf=conf2,
+                          devices=devices, faults=inj2)
+    res2 = sup2.run("echo", [np.full(lanes, iters, np.int64)],
+                    max_steps=1_000_000)
+    os.close(sink2)
+    dt = time.perf_counter() - t0
+    shard_fell_back = bool(res2.completed.all()) and inj2.fired == 1 \
+        and any(f.fault_class == "shard_drive" for f in sup2.failures) \
+        and "failure/shard_drive" in sup2.obs.event_names()
+    ok = ok and shard_fell_back
     print(json.dumps({
         "metric": "mesh_faults_smoke_echo_recovery",
         "value": 1 if ok else 0,
@@ -244,9 +269,254 @@ def mesh_faults_smoke() -> int:
         "injected": inj.fired,
         "failures": [f.fault_class for f in sup.failures],
         "trace_has_incident": trace_has_incident,
+        "shard_drive_fell_back_to_threaded": shard_fell_back,
+        "shard_failures": [f.fault_class for f in sup2.failures],
         "lanes": lanes,
         "wall_s": round(dt, 3),
     }))
+    return 0 if ok else 1
+
+
+def _mesh_env(n: int = 8):
+    """Force the virtual n-device CPU mesh (must run before the first
+    jax import — same mechanism as tests/conftest.py) and return jax.
+    A pre-existing smaller device-count flag is REPLACED, not kept —
+    an 8-device artifact must never silently record 4-device numbers —
+    and a backend already initialized with fewer devices fails loudly."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n:
+        raise SystemExit(
+            f"mesh bench needs {n} virtual devices, backend has "
+            f"{len(jax.devices())} (jax initialized before _mesh_env?)")
+    return jax
+
+
+def _mesh_parity(jax, report: dict) -> bool:
+    """Shard-drive parity block shared by --mesh-smoke and --mesh-bench:
+    merged results must be bit-identical to single-device
+    execute_batch across device counts, including an uneven
+    `lanes % n_devices` split (pad lanes must never retire) and the
+    hostcall-heavy echo workload (no duplicated WASI side effects)."""
+    import os
+
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.parallel.shard_drive import ShardDrive
+
+    ok = True
+    # fib, uneven 30 lanes over 8 and 4 devices
+    conf = Configure()
+    conf.batch.steps_per_launch = 2000
+    conf.batch.value_stack_depth = 128
+    conf.batch.call_stack_depth = 64
+    inst, store = _instantiate_fib(conf)
+    lanes = 30
+    ns = (np.arange(lanes, dtype=np.int64) % 11)
+    ref = BatchEngine(inst, store=store, conf=conf, lanes=lanes).run(
+        "fib", [ns], max_steps=300_000)
+    for n in (4, 8):
+        res = ShardDrive(inst, store=store, conf=conf,
+                         devices=jax.devices()[:n]).run(
+            "fib", [ns], max_steps=300_000)
+        same = bool((res.results[0] == ref.results[0]).all()
+                    and (res.trap == ref.trap).all()
+                    and (res.retired == ref.retired).all())
+        report[f"fib_parity_{n}dev"] = same
+        ok = ok and same
+    # hostcall-heavy echo, uneven 20 lanes over 8 devices
+    conf_e = Configure()
+    ref_eng, sink1 = _smoke_echo_engine(conf_e, 20)
+    iters = np.full(20, 2, np.int64)
+    eref = ref_eng.run("echo", [iters], max_steps=200_000)
+    conf_s = Configure()
+    conf_s.obs.enabled = True   # the mesh_round spans must appear
+    s_eng, sink2 = _smoke_echo_engine(conf_s, 20)
+    drv = ShardDrive(s_eng.inst, store=s_eng.store, conf=conf_s,
+                     devices=jax.devices()[:8])
+    eres = drv.run("echo", [iters], max_steps=200_000)
+    os.close(sink1)
+    os.close(sink2)
+    echo_same = bool((eres.results[0] == eref.results[0]).all()
+                     and (eres.trap == eref.trap).all()
+                     and (eres.retired == eref.retired).all())
+    # WASI effect parity: the shard drive's engine must have produced
+    # exactly the single-device stdout volume (pads write nothing)
+    wasi_same = (drv.engine.hostcall_stats["stdout_bytes"]
+                 == ref_eng.hostcall_stats["stdout_bytes"])
+    spans = "mesh_round" in drv.engine.obs.event_names()
+    report["echo_parity_8dev"] = echo_same
+    report["echo_wasi_bytes_equal"] = wasi_same
+    report["mesh_round_spans"] = spans
+    return ok and echo_same and wasi_same and spans
+
+
+def mesh_smoke() -> int:
+    """`bench.py --mesh-smoke`: the pass/fail CI guard for the
+    single-program shard drive — bit-identical merged results vs
+    single-device execute_batch across device counts (incl. uneven
+    splits and the hostcall-heavy echo), per-device mesh_round spans
+    present.  Prints ONE JSON line; no artifact."""
+    jax = _mesh_env(8)
+    t0 = time.perf_counter()
+    report: dict = {}
+    ok = _mesh_parity(jax, report)
+    print(json.dumps({
+        "metric": "mesh_smoke_shard_drive_parity",
+        "value": 1 if ok else 0,
+        "unit": "bit_identical",
+        "ok": bool(ok),
+        "devices": len(jax.devices()),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        **report,
+    }))
+    return 0 if ok else 1
+
+
+def mesh_bench() -> int:
+    """`bench.py --mesh-bench`: threaded vs shard_map drive on the
+    8-virtual-device CPU mesh (flagship-shaped fib + hostcall-heavy
+    echo).  Emits MESH_r15.json (drive-overhead matrix: per-round
+    host-side drive cost across device counts — the shard drive issues
+    ONE dispatch per round regardless of device count, so its per-round
+    overhead must not scale with devices) and a refreshed
+    BENCH_r15.json (obs-off flagship number against the r5 floors).
+    CPU-container numbers: virtual devices share host cores, so
+    absolute rates are wiring floors, not capacity claims."""
+    import os
+
+    jax = _mesh_env(8)
+
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.parallel.mesh import run_pallas_sharded
+    from wasmedge_tpu.parallel.shard_drive import ShardDrive
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    report: dict = {}
+    parity_ok = _mesh_parity(jax, report)
+
+    # --- per-round host drive overhead vs device count ---------------
+    # tiny chunks make every round host-overhead-dominated: wall /
+    # rounds then measures the DRIVE cost per launch boundary, the
+    # quantity that scaled with device count on the threaded drive.
+    # Each cell runs TWICE under a shared persistent compilation cache
+    # and reports the second (warm) run — a cold cell would measure
+    # XLA compile-time scaling, not drive overhead.
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="mesh-bench-jit-cache-")
+    prev_cache = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+
+    def overhead_once(drive: str, n: int):
+        conf = Configure()
+        conf.batch.steps_per_launch = 64
+        conf.batch.value_stack_depth = 128
+        conf.batch.call_stack_depth = 64
+        conf.supervisor.use_kernel_tier = False   # threaded SIMT rung
+        conf.supervisor.backoff_base_s = 0.0
+        inst, store = _instantiate_fib(conf)
+        lanes = 512
+        ns = np.full(lanes, 12, np.int64)
+        devices = jax.devices()[:n]
+        t0 = time.perf_counter()
+        if drive == "shard":
+            res = ShardDrive(inst, store=store, conf=conf,
+                             devices=devices).run(
+                "fib", [ns], max_steps=1_000_000)
+        else:
+            from wasmedge_tpu.parallel.supervisor import MeshSupervisor
+
+            res = MeshSupervisor(inst, store=store, conf=conf,
+                                 devices=devices,
+                                 drive="threaded").run(
+                "fib", [ns], max_steps=1_000_000)
+        dt = time.perf_counter() - t0
+        assert res.completed.all()
+        rounds = max(int(np.ceil(res.steps / 64)), 1)
+        return {"wall_s": round(dt, 3), "rounds": rounds,
+                "ms_per_round": round(1e3 * dt / rounds, 3)}
+
+    def overhead(drive: str, n: int):
+        overhead_once(drive, n)          # populate the compile cache
+        return overhead_once(drive, n)   # the warm measurement
+
+    matrix = {}
+    try:
+        for drive in ("shard", "threaded"):
+            for n in (2, 4, 8):
+                matrix[f"{drive}_{n}dev"] = overhead(drive, n)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_cache)
+    shard_growth = matrix["shard_8dev"]["ms_per_round"] \
+        / max(matrix["shard_2dev"]["ms_per_round"], 1e-9)
+    threaded_growth = matrix["threaded_8dev"]["ms_per_round"] \
+        / max(matrix["threaded_2dev"]["ms_per_round"], 1e-9)
+
+    # --- hostcall-heavy echo throughput, both drives @ 8 devices -----
+    def echo_rate(drive: str):
+        conf = Configure()
+        conf.batch.steps_per_launch = 100
+        eng, sink = _smoke_echo_engine(conf, 128)
+        conf.obs.enabled = False
+        iters = np.full(128, 2, np.int64)
+        t0 = time.perf_counter()
+        calls = 2 * 128 * 2   # two fd_writes per iteration per lane
+        if drive == "shard":
+            drv = ShardDrive(eng.inst, store=eng.store, conf=conf,
+                             devices=jax.devices()[:8])
+            res = drv.run("echo", [iters], max_steps=2_000_000)
+        else:
+            from wasmedge_tpu.parallel.supervisor import MeshSupervisor
+
+            conf.supervisor.use_kernel_tier = False
+            conf.supervisor.backoff_base_s = 0.0
+            res = MeshSupervisor(eng.inst, store=eng.store, conf=conf,
+                                 devices=jax.devices()[:8],
+                                 drive="threaded").run(
+                "echo", [iters], max_steps=2_000_000)
+        dt = time.perf_counter() - t0
+        os.close(sink)
+        assert res.completed.all()
+        return {"wall_s": round(dt, 3),
+                "calls_per_s": round(calls / dt, 1)}
+
+    echo = {"shard": echo_rate("shard"), "threaded": echo_rate("threaded")}
+
+    # the r15 claim: per-round host-side drive overhead no longer
+    # scales with device count (threaded grew with n; shard must not)
+    ok = bool(parity_ok and shard_growth < max(2.0, 0.75 * threaded_growth))
+    out = {
+        "metric": "mesh_drive_overhead_threaded_vs_shard",
+        "value": round(matrix["shard_8dev"]["ms_per_round"], 3),
+        "unit": "ms_per_round_8dev",
+        "ok": ok,
+        "environment": "cpu-container-virtual-devices",
+        "parity": report,
+        "overhead_matrix": matrix,
+        "shard_overhead_growth_2to8dev": round(shard_growth, 3),
+        "threaded_overhead_growth_2to8dev": round(threaded_growth, 3),
+        "echo_8dev": echo,
+    }
+    emit(out, "MESH_r15.json")
+
+    # --- refreshed flagship number (obs off, r5-floor methodology;
+    # scaled to fib(16) on CPU containers — the real flagship geometry
+    # needs TPU hardware, and the artifact records the actual n) ---
+    os.environ["BENCH_OBS"] = "off"
+    if jax.default_backend() == "cpu":
+        os.environ.setdefault("BENCH_FIB_N", "16")
+        global FIB_N
+        FIB_N = int(os.environ["BENCH_FIB_N"])
+    main()
     return 0 if ok else 1
 
 
@@ -1308,12 +1578,17 @@ def main():
     vs = agg_ops / (TARGET_MULTIPLE * base_ops)
 
     engine = "pallas" if getattr(eng, "pallas", None) is not None else "xla"
+    import jax
+
     out = {
         "metric": f"aggregate_wasm_ops_per_sec_fib{FIB_N}_x{LANES}",
         "value": round(agg_ops, 1),
         "unit": "wasm_instr/s",
         "vs_baseline": round(vs, 4),
         "engine": engine,
+        "backend": jax.default_backend(),
+        "fib_n": FIB_N,
+        "lanes": LANES,
         "obs": bool(eng.obs.enabled),
         "steps": int(res.steps),
         "wall_s": round(dt, 3),
@@ -1322,8 +1597,8 @@ def main():
     }
     from wasmedge_tpu.utils.bench_artifact import emit
 
-    emit(out, "BENCH_r08.json")
-    _emit_trace(eng.obs, "BENCH_r08.trace.json")
+    emit(out, "BENCH_r15.json")
+    _emit_trace(eng.obs, "BENCH_r15.trace.json")
     # extra context on stderr (driver only parses stdout JSON)
     print(f"# engine={engine} lanes={LANES} steps={res.steps} wall={dt:.2f}s "
           f"retired_total={total_retired:.3g} baseline={base_ops:.3g} "
@@ -1342,6 +1617,10 @@ if __name__ == "__main__":
         sys.exit(faults_smoke())
     if "--mesh-faults-smoke" in sys.argv[1:]:
         sys.exit(mesh_faults_smoke())
+    if "--mesh-smoke" in sys.argv[1:]:
+        sys.exit(mesh_smoke())
+    if "--mesh-bench" in sys.argv[1:]:
+        sys.exit(mesh_bench())
     if "--trace-smoke" in sys.argv[1:]:
         sys.exit(trace_smoke())
     if "--serve-smoke" in sys.argv[1:]:

@@ -288,3 +288,251 @@ def test_sharded_drive_overlaps_devices(monkeypatch):
         1 for i in range(len(spans)) for j in range(i + 1, len(spans))
         if spans[i][0] < spans[j][1] and spans[j][0] < spans[i][1])
     assert overlapping >= len(devices) - 1, spans
+
+
+# ---------------------------------------------------------------------------
+# r15: single-program shard drive (parallel/shard_drive.py)
+# ---------------------------------------------------------------------------
+def _shard_conf(chunk=2000):
+    conf = Configure()
+    conf.batch.steps_per_launch = chunk
+    conf.batch.value_stack_depth = 128
+    conf.batch.call_stack_depth = 64
+    return conf
+
+
+def _fib_inst(conf):
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    mod = Validator(conf).validate(Loader(conf).parse_module(build_fib()))
+    store = StoreManager()
+    return Executor(conf).instantiate(store, mod), store
+
+
+def test_shard_drive_bit_identical_across_drives_and_device_counts():
+    """The r15 acceptance pin: the single-program shard drive's merged
+    BatchResult is bit-identical to single-device execute_batch AND to
+    the threaded per-device drive, across device counts — results,
+    trap, and retired planes all equal."""
+    import jax
+
+    from wasmedge_tpu.parallel.shard_drive import ShardDrive
+
+    conf = _shard_conf()
+    inst, store = _fib_inst(conf)
+    lanes = 64
+    ns = (np.arange(lanes, dtype=np.int64) % 11)
+    ref = BatchEngine(inst, store=store, conf=conf, lanes=lanes).run(
+        "fib", [ns], max_steps=300_000)
+    for n in (2, 4, 8):
+        res = ShardDrive(inst, store=store, conf=conf,
+                         devices=jax.devices()[:n]).run(
+            "fib", [ns], max_steps=300_000)
+        assert (res.results[0] == ref.results[0]).all(), f"{n} devices"
+        assert (res.trap == ref.trap).all()
+        assert (res.retired == ref.retired).all()
+    # threaded rung (supervised SIMT tier, per-device engines)
+    from wasmedge_tpu.parallel.supervisor import MeshSupervisor
+
+    conf_t = _shard_conf()
+    conf_t.supervisor.use_kernel_tier = False
+    conf_t.supervisor.backoff_base_s = 0.0
+    tres = MeshSupervisor(inst, store=store, conf=conf_t,
+                          devices=jax.devices()[:8],
+                          drive="threaded").run(
+        "fib", [ns], max_steps=300_000)
+    assert (tres.results[0] == ref.results[0]).all()
+    assert (tres.trap == ref.trap).all()
+    assert (tres.retired == ref.retired).all()
+
+
+def test_shard_drive_uneven_split_pads_never_retire():
+    """lanes % n_devices != 0: the global array pads up to a device
+    multiple, pad lanes are born parked — the merged result has exactly
+    `lanes` entries and the retired plane matches single-device
+    bit-for-bit (a pad lane retiring even one instruction would show)."""
+    import jax
+
+    from wasmedge_tpu.parallel.shard_drive import (
+        ShardDrive, padded_lanes, shard_slices)
+
+    assert padded_lanes(30, 8) == 32
+    assert [s.stop - s.start for s in shard_slices(32, 8)] == [4] * 8
+    conf = _shard_conf()
+    inst, store = _fib_inst(conf)
+    for lanes in (30, 13):
+        ns = (np.arange(lanes, dtype=np.int64) % 9)
+        ref = BatchEngine(inst, store=store, conf=conf, lanes=lanes).run(
+            "fib", [ns], max_steps=200_000)
+        drv = ShardDrive(inst, store=store, conf=conf,
+                         devices=jax.devices()[:8])
+        res = drv.run("fib", [ns], max_steps=200_000)
+        assert drv.engine.lanes == padded_lanes(lanes, 8)
+        assert res.trap.shape == (lanes,)
+        assert (res.results[0] == ref.results[0]).all()
+        assert (res.trap == ref.trap).all()
+        assert (res.retired == ref.retired).all()
+
+
+def _lane_stamp_module():
+    """Each lane fd_writes its 4-byte little-endian argument once —
+    a self-identifying WASI record for byte-parity pins."""
+    b = ModuleBuilder()
+    b.import_func("wasi_snapshot_preview1", "fd_write",
+                  ["i32", "i32", "i32", "i32"], ["i32"])
+    b.add_memory(1, 1)
+    b.add_function(["i32"], ["i32"], ["i32"], [
+        ("i32.const", 128), ("local.get", 0), ("i32.store", 2, 0),
+        ("i32.const", 64), ("i32.const", 128), ("i32.store", 2, 0),
+        ("i32.const", 68), ("i32.const", 4), ("i32.store", 2, 0),
+        ("i32.const", 1), ("i32.const", 64), ("i32.const", 1),
+        ("i32.const", 32), ("call", 0), ("local.set", 1),
+        ("local.get", 0),
+    ], export="stamp")
+    return b.build()
+
+
+def _stamp_run(run_fn, lanes, tmp_path, tag):
+    """Instantiate the lane-stamp module with fd 1 redirected to a
+    file, run `run_fn(inst, store, conf, args)`, return (result,
+    bytes_written)."""
+    import os
+
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.host.wasi import WasiModule
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    conf = _shard_conf(chunk=200)
+    wasi = WasiModule()
+    wasi.init_wasi(dirs=[], prog_name="stamp")
+    path = str(tmp_path / f"stamp-{tag}.bin")
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC)
+    wasi.env.fds[1].os_fd = fd
+    mod = Validator(conf).validate(
+        Loader(conf).parse_module(_lane_stamp_module()))
+    store = StoreManager()
+    ex = Executor(conf)
+    ex.register_import_object(store, wasi)
+    inst = ex.instantiate(store, mod)
+    args = np.arange(lanes, dtype=np.int64) + 1000
+    res = run_fn(inst, store, conf, args)
+    os.close(fd)
+    with open(path, "rb") as f:
+        return res, f.read()
+
+
+def test_shard_drive_wasi_echo_byte_parity(tmp_path):
+    """WASI byte parity on an UNEVEN split (20 lanes / 8 devices): the
+    shard drive's stdout stream is byte-identical to single-device
+    execute_batch (global lane order restores single-device
+    determinism), every lane's record appears exactly once (pad lanes
+    never duplicate WASI side effects), and the threaded rung emits the
+    same record multiset (its cross-device flush interleaving is
+    scheduler-dependent, so only per-lane attribution is pinned there)."""
+    import jax
+
+    from wasmedge_tpu.parallel.shard_drive import ShardDrive
+    from wasmedge_tpu.parallel.supervisor import MeshSupervisor
+
+    lanes = 20
+
+    def single(inst, store, conf, args):
+        return BatchEngine(inst, store=store, conf=conf,
+                           lanes=lanes).run("stamp", [args],
+                                            max_steps=100_000)
+
+    def shard(inst, store, conf, args):
+        return ShardDrive(inst, store=store, conf=conf,
+                          devices=jax.devices()[:8]).run(
+            "stamp", [args], max_steps=100_000)
+
+    def threaded(inst, store, conf, args):
+        conf.supervisor.use_kernel_tier = False
+        conf.supervisor.backoff_base_s = 0.0
+        return MeshSupervisor(inst, store=store, conf=conf,
+                              devices=jax.devices()[:8],
+                              drive="threaded").run(
+            "stamp", [args], max_steps=100_000)
+
+    ref, ref_bytes = _stamp_run(single, lanes, tmp_path, "single")
+    sres, s_bytes = _stamp_run(shard, lanes, tmp_path, "shard")
+    tres, t_bytes = _stamp_run(threaded, lanes, tmp_path, "threaded")
+    assert (ref.trap == -1).all()
+    expect = np.frombuffer(ref_bytes, np.int32)
+    assert sorted(expect) == sorted(range(1000, 1000 + lanes))
+    # shard drive: exact byte-for-byte stream parity with single-device
+    assert s_bytes == ref_bytes
+    # threaded rung: same records, each exactly once (attribution pin)
+    assert sorted(np.frombuffer(t_bytes, np.int32).tolist()) \
+        == sorted(expect.tolist())
+    for res in (sres, tres):
+        assert (res.results[0] == ref.results[0]).all()
+        assert (res.trap == ref.trap).all()
+        assert (res.retired == ref.retired).all()
+
+
+def test_shard_drive_mesh_round_spans_per_device():
+    """obs satellite: on the shard drive there is ONE driving thread,
+    so per-device attribution comes from mesh_round spans — one per
+    (round, device) on the mesh/devN tracks, carrying per-shard
+    occupancy args (lanes / live_lanes / parked_lanes / pad_lanes)."""
+    import jax
+
+    from wasmedge_tpu.parallel.shard_drive import ShardDrive
+
+    conf = _shard_conf(chunk=500)
+    conf.obs.enabled = True
+    inst, store = _fib_inst(conf)
+    lanes = 30   # uneven: dev7's shard carries the 2 pad lanes
+    ns = (np.arange(lanes, dtype=np.int64) % 9)
+    drv = ShardDrive(inst, store=store, conf=conf,
+                     devices=jax.devices()[:8])
+    res = drv.run("fib", [ns], max_steps=200_000)
+    assert (res.trap == -1).all()
+    rounds = [e for e in drv.engine.obs.events
+              if e["name"] == "mesh_round"]
+    assert rounds, "no mesh_round spans recorded"
+    tracks = {e["track"] for e in rounds}
+    assert tracks == {f"mesh/dev{i}" for i in range(8)}
+    for e in rounds:
+        args = e["args"]
+        assert args["lanes"] == 4
+        assert 0 <= args["live_lanes"] <= 4
+        assert args["pad_lanes"] == (2 if e["track"] == "mesh/dev7"
+                                     else 0)
+
+
+def test_run_mesh_default_is_shard_drive(monkeypatch):
+    """Drive selection: run_mesh's default never touches the threaded
+    drive; drive='threaded' dispatches to it explicitly."""
+    import jax
+
+    from wasmedge_tpu.parallel import mesh as mesh_mod
+
+    conf = _shard_conf()
+    inst, store = _fib_inst(conf)
+    ns = np.arange(16, dtype=np.int64) % 9
+
+    def boom(*a, **k):
+        raise AssertionError("threaded drive used on the default path")
+
+    monkeypatch.setattr(mesh_mod, "run_pallas_sharded", boom)
+    res = mesh_mod.run_mesh(inst, store, conf, "fib", [ns],
+                            devices=jax.devices()[:4],
+                            max_steps=200_000)
+    assert (res.trap == -1).all()
+
+    sentinel = object()
+    monkeypatch.setattr(mesh_mod, "run_pallas_sharded",
+                        lambda *a, **k: sentinel)
+    assert mesh_mod.run_mesh(inst, store, conf, "fib", [ns],
+                             devices=jax.devices()[:4],
+                             drive="threaded") is sentinel
+    with pytest.raises(ValueError):
+        mesh_mod.run_mesh(inst, store, conf, "fib", [ns],
+                          devices=jax.devices()[:4], drive="bogus")

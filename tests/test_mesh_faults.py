@@ -423,3 +423,86 @@ def test_mesh_checkpoint_save_fault_never_kills_run(tmp_path,
     assert inj.fired == 1
     assert_results_identical(res, fib_ref_result)
     assert any(f.fault_class == "mesh_checkpoint" for f in sup.failures)
+
+
+# ---------------------------------------------------------------------------
+# r15: shard-drive rung of the degradation ladder
+# ---------------------------------------------------------------------------
+def test_shard_drive_fault_falls_back_to_threaded_rung():
+    """An injected shard-drive failure demotes the supervised run to
+    the threaded per-device rung: the run completes bit-identical to
+    an unfaulted one, with a FailureRecord('shard_drive') attributing
+    the demotion."""
+    conf = make_conf(checkpoint_every_steps=None)
+    store, inst = make_inst(build_fib(), conf)
+    ref = MeshSupervisor(inst, store=store, conf=conf,
+                         devices=devices(4)).run(
+        "fib", FIB_ARGS, max_steps=200_000)
+    assert (ref.results[0] == FIB_EXPECT).all()
+
+    inj = FaultInjector([Fault(point="shard_launch", at=0)])
+    sup = MeshSupervisor(inst, store=store, conf=conf,
+                         devices=devices(4), faults=inj)
+    res = sup.run("fib", FIB_ARGS, max_steps=200_000)
+    assert inj.fired == 1
+    assert any(f.fault_class == "shard_drive" for f in sup.failures)
+    assert_results_identical(res, ref)
+
+
+def test_shard_drive_skipped_when_cadence_configured():
+    """A checkpoint cadence needs the per-device SIMT tier (the
+    coordinated mesh snapshots slice per-device states), so the shard
+    tier must not even be attempted — an armed shard fault never
+    fires."""
+    conf = make_conf()   # checkpoint_every_steps=200 (cadence on)
+    store, inst = make_inst(build_fib(), conf)
+    inj = FaultInjector([Fault(point="shard_launch", at=0)])
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="mesh-ckpt-") as d:
+        sup = MeshSupervisor(inst, store=store, conf=conf,
+                             devices=devices(2), faults=inj,
+                             checkpoint_dir=d)
+        res = sup.run("fib", FIB_ARGS, max_steps=200_000)
+    assert inj.fired == 0
+    assert (res.results[0] == FIB_EXPECT).all()
+
+
+def test_shard_drive_threaded_param_skips_shard_tier():
+    """MeshSupervisor(drive='threaded') never attempts the shard rung
+    even with the knob on; use_shard_drive=False does the same through
+    the Configure."""
+    conf = make_conf(checkpoint_every_steps=None)
+    store, inst = make_inst(build_fib(), conf)
+    inj = FaultInjector([Fault(point="shard_launch", at=0, times=99)])
+    res = MeshSupervisor(inst, store=store, conf=conf,
+                         devices=devices(2), faults=inj,
+                         drive="threaded").run(
+        "fib", FIB_ARGS, max_steps=200_000)
+    assert inj.fired == 0
+    assert (res.results[0] == FIB_EXPECT).all()
+
+    conf2 = make_conf(checkpoint_every_steps=None,
+                      use_shard_drive=False)
+    store2, inst2 = make_inst(build_fib(), conf2)
+    res2 = MeshSupervisor(inst2, store=store2, conf=conf2,
+                          devices=devices(2), faults=inj).run(
+        "fib", FIB_ARGS, max_steps=200_000)
+    assert inj.fired == 0
+    assert (res2.results[0] == FIB_EXPECT).all()
+
+
+def test_unsupervised_shard_drive_wraps_failures():
+    """The unsupervised shard drive wraps any drive failure in
+    ShardDriveError with the cause chained (run_mesh's documented
+    contract: the fallback ladder lives in the supervisor)."""
+    from wasmedge_tpu.parallel.mesh import run_mesh
+    from wasmedge_tpu.parallel.shard_drive import ShardDriveError
+
+    conf = make_conf(checkpoint_every_steps=None)
+    store, inst = make_inst(build_fib(), conf)
+    inj = FaultInjector([Fault(point="shard_launch", at=0)])
+    with pytest.raises(ShardDriveError) as ei:
+        run_mesh(inst, store, conf, "fib", FIB_ARGS,
+                 devices=devices(2), max_steps=200_000, faults=inj)
+    assert isinstance(ei.value.__cause__, InjectedFault)

@@ -1,0 +1,170 @@
+"""Serving on the single-program mesh drive (r15).
+
+The serving stack rides the lane-sharded mesh engine: BatchServer
+(and the gateway above it) submits into a lane-sharded state for
+mesh-tier continuous batching, the LaneRecycler's column installs and
+the hv column sets address GLOBAL lane indices — so a recycled request
+or a virtual lane's SwapStore blob can land on ANY device's shard, and
+the merged outcomes stay bit-identical to a single-device server.
+
+Runs on the conftest-forced 8-device virtual CPU mesh.  Speed
+discipline mirrors tests/test_serve.py / test_hv.py: tiny geometry and
+a module-scoped JAX persistent compilation cache.
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.executor import Executor
+from wasmedge_tpu.loader import Loader
+from wasmedge_tpu.models import build_fib
+from wasmedge_tpu.runtime.store import StoreManager
+from wasmedge_tpu.serve import BatchServer
+from wasmedge_tpu.validator import Validator
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache():
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    d = tempfile.mkdtemp(prefix="serve-mesh-jit-cache-")
+    jax.config.update("jax_compilation_cache_dir", d)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def _fib(n):
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+def _conf(hv_virtual=None, obs=False):
+    conf = Configure()
+    conf.batch.steps_per_launch = 256
+    conf.batch.value_stack_depth = 128
+    conf.batch.call_stack_depth = 64
+    conf.obs.enabled = obs
+    if hv_virtual is not None:
+        conf.hv.max_virtual_lanes = hv_virtual
+    return conf
+
+
+def _server(conf, lanes, **kw):
+    mod = Validator(conf).validate(Loader(conf).parse_module(build_fib()))
+    store = StoreManager()
+    inst = Executor(conf).instantiate(store, mod)
+    return BatchServer(inst, store=store, conf=conf, lanes=lanes, **kw)
+
+
+NS = [5, 11, 12, 7, 3, 12, 9, 2, 10, 6, 12, 11, 8, 12, 4, 9]
+
+
+def _mesh_devices(n):
+    import jax
+
+    devs = jax.devices()[:n]
+    assert len(devs) == n, "virtual device mesh missing"
+    return devs
+
+
+def test_serve_on_mesh_bit_identical_with_recycling():
+    """`--serve-smoke`-shaped run with devices>1: continuous batching
+    over the lane-sharded mesh engine — recycling installs land on
+    whatever shard freed a lane, and every outcome matches the
+    single-device server bit-for-bit."""
+    ref_srv = _server(_conf(), lanes=8)
+    ref_futs = [ref_srv.submit("fib", [n]) for n in NS]
+    ref_srv.run_until_idle()
+    ref = [f.result(0)[0] for f in ref_futs]
+    assert ref == [_fib(n) for n in NS]
+
+    srv = _server(_conf(), lanes=8, devices=_mesh_devices(4))
+    assert srv.engine.mesh is not None
+    assert srv.lanes == 8   # already a device multiple
+    futs = [srv.submit("fib", [n]) for n in NS]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == ref
+    c = srv.counters
+    assert c["recycled_lanes"] > 0          # continuous batching, not drain
+    assert c["completed"] == len(NS)
+    assert c["submitted"] == c["completed"] + c["trapped"] \
+        + c["expired"] + c["killed"] + c["rejected"]
+
+
+def test_serve_on_mesh_rounds_lanes_up_to_device_multiple():
+    srv = _server(_conf(), lanes=6, devices=_mesh_devices(4))
+    assert srv.lanes == 8
+    futs = [srv.submit("fib", [n]) for n in NS[:10]]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == [_fib(n) for n in NS[:10]]
+
+
+def test_hv_swap_in_lands_on_a_different_devices_shard():
+    """r15 pin: with one lane per device shard (4 lanes / 4 devices)
+    and hv oversubscription, some virtual lane's SwapStore blob must
+    reinstall on a DIFFERENT device's shard than the lane it swapped
+    out from — and the results stay bit-identical to the unswapped
+    single-device reference."""
+    ref_srv = _server(_conf(), lanes=4)
+    ref_futs = [ref_srv.submit("fib", [n]) for n in NS]
+    ref_srv.run_until_idle()
+    ref = [f.result(0)[0] for f in ref_futs]
+
+    conf = _conf(hv_virtual=16, obs=True)
+    srv = _server(conf, lanes=4, devices=_mesh_devices(4))
+    futs = [srv.submit("fib", [n]) for n in NS]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == ref
+    hv = srv.hv_stats()
+    assert hv["swaps_out"] > 0 and hv["swaps_in"] > 0
+    assert hv["peak_admitted"] > 4
+
+    # lane == shard here (1 lane per device): pair each request's
+    # swap-out lane with its next swap-in lane from the obs stream and
+    # require at least one cross-shard reinstall
+    events = [e for e in srv.obs.events
+              if e["name"] in ("swap_out", "swap_in")]
+    assert events
+    out_lane = {}
+    cross = 0
+    for e in events:
+        rid = e["args"]["id"]
+        lane = e["args"]["lane"]
+        if e["name"] == "swap_out":
+            out_lane[rid] = lane
+        elif rid in out_lane:
+            if lane != out_lane.pop(rid):
+                cross += 1
+    assert cross > 0, "every swap-in landed on its original shard"
+
+
+def test_gateway_on_mesh_drive():
+    """The gateway's generation engine builds over the mesh: lanes
+    round up to a device multiple and multi-module requests resolve
+    bit-identically."""
+    from wasmedge_tpu.gateway.service import GatewayService
+
+    gw = GatewayService(conf=_conf(), lanes=6,
+                        devices=_mesh_devices(4))
+    try:
+        gw.register_module("fib", build_fib())
+        srv = gw.current.server
+        assert srv.engine.mesh is not None
+        assert srv.lanes == 8
+        reqs = [gw.submit("fib", [n], module="fib")
+                for n in (9, 10, 11, 7)]
+        srv.run_until_idle()
+        assert [r.future.result(5)[0] for r in reqs] \
+            == [_fib(n) for n in (9, 10, 11, 7)]
+    finally:
+        gw.shutdown()

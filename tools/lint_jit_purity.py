@@ -34,6 +34,10 @@ TARGETS = {
     "wasmedge_tpu/batch/uniform.py": ("make_uniform_step",
                                       "_build_uniform"),
     "wasmedge_tpu/serve/recycle.py": ("_install_fn",),
+    # single-program mesh drive: the sharded jit wrapper around the
+    # engine's chunk body (the body itself is covered by engine.py's
+    # targets; this keeps the mesh-side wrapper honest too)
+    "wasmedge_tpu/parallel/shard_drive.py": ("_build_shard_chunk",),
 }
 
 # Dotted-call prefixes that are host-side nondeterminism (or host

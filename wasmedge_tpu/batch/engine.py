@@ -1838,13 +1838,15 @@ class BatchEngine:
                 getattr(jax.config, "jax_compilation_cache_dir", None):
             donate = ()
         if self.mesh is not None:
-            from wasmedge_tpu.parallel.mesh import state_shardings
+            # single-program mesh drive: ONE jitted program over the
+            # named mesh, lane planes sharded on the `lanes` axis — the
+            # chunk body above runs per-shard unchanged
+            from wasmedge_tpu.parallel.shard_drive import \
+                _build_shard_chunk
 
             probe = self.initial_state(0, [])
-            shardings = state_shardings(self.mesh, probe)
-            self._run_chunk = jax.jit(
-                run_chunk, in_shardings=(shardings, None),
-                out_shardings=(None, shardings), donate_argnums=donate)
+            self._run_chunk = _build_shard_chunk(run_chunk, self.mesh,
+                                                 probe, donate)
         else:
             self._run_chunk = jax.jit(run_chunk, donate_argnums=donate)
         self._step = step
@@ -1967,6 +1969,10 @@ class BatchEngine:
         cancel = getattr(self, "_cancel_hook", None)
         # per-device trace attribution for mesh drives (else "simt")
         track = getattr(self, "obs_track", "simt")
+        # launch-boundary mirror seam (parallel/shard_drive.py): the
+        # single-program mesh drive emits per-shard mesh_round spans
+        # from the trap mirror this loop already gathers every round
+        round_hook = getattr(self, "_round_hook", None)
         obs = self.obs
         if obs.enabled:
             prev_ret = int(np.asarray(state.retired, np.int64).sum())
@@ -1983,6 +1989,8 @@ class BatchEngine:
             total += int(done_steps)
             trap_host = np.asarray(state.trap)
             parked = int((trap_host == TRAP_HOSTCALL).sum())
+            if round_hook is not None:
+                round_hook(int(done_steps), trap_host, t_launch)
             if obs.enabled:
                 # per-launch span with lane occupancy + retired delta
                 # (one extra device read per LAUNCH, never per step)

@@ -234,13 +234,16 @@ class MultiTenantBatchEngine(BatchEngine):
     its own instance snapshot); lanes are assigned contiguously per
     tenant in order."""
 
-    def __init__(self, tenants: Sequence[Tenant], conf=None):
+    def __init__(self, tenants: Sequence[Tenant], conf=None, mesh=None):
         from wasmedge_tpu.common.configure import Configure
 
         if not tenants:
             raise ValueError("no tenants")
         self.tenants = list(tenants)
-        self.mesh = None
+        # lane-sharded mesh execution (parallel/shard_drive.py): the
+        # concatenated image replicates, lane planes shard — the same
+        # single-program chunk the single-module engine jits
+        self.mesh = mesh
         self.conf = conf or Configure()
         self.cfg = self.conf.batch
         self.lanes = sum(t.lanes for t in self.tenants)
@@ -520,7 +523,8 @@ class MultiModuleBatchEngine(MultiTenantBatchEngine):
     registry caches one engine per module at registration time)."""
 
     def __init__(self, modules: Sequence[Tuple[str, object, object]],
-                 conf=None, lanes: Optional[int] = None, engines=None):
+                 conf=None, lanes: Optional[int] = None, engines=None,
+                 mesh=None):
         if not modules:
             raise ValueError("no modules")
         names = [name for name, _, _ in modules]
@@ -535,8 +539,15 @@ class MultiModuleBatchEngine(MultiTenantBatchEngine):
                 else BatchEngine(inst, store=store, conf=conf, lanes=1)
             tenants.append(Tenant(engine=eng, func_name="",
                                   args_lanes=[], lanes=0))
-        super().__init__(tenants, conf=conf)
+        super().__init__(tenants, conf=conf, mesh=mesh)
         self.lanes = int(lanes) if lanes else self.cfg.lanes
+        if mesh is not None:
+            # even lane split across the mesh: round the serving pool
+            # up — the extra lanes are plain capacity (idle lanes park
+            # TRAP_DONE and cost only their plane storage)
+            from wasmedge_tpu.parallel.shard_drive import padded_lanes
+
+            self.lanes = padded_lanes(self.lanes, int(mesh.devices.size))
         self.module_names = list(names)
         self._mod_index = {name: ti for ti, name in enumerate(names)}
 

@@ -70,6 +70,11 @@ def _runner_parser() -> ArgumentParser:
                         "drive; with --supervised adds device "
                         "quarantine, lane migration, and coordinated "
                         "mesh checkpoints)", "n", typ=int))
+    p.add_option(["mesh-drive"],
+                 Option("mesh drive for --devices: shard (default; one "
+                        "jitted program over the lane-sharded named "
+                        "mesh) | threaded (per-device engines, the "
+                        "degradation-ladder rung)", "kind"))
     p.add_option(["supervised"],
                  Toggle("supervise --batch runs: auto-checkpoint, "
                         "retry-with-backoff, engine-degradation ladder"))
@@ -239,6 +244,7 @@ def run_command(argv: List[str], out=None, err=None) -> int:
                     [np.full(batch_lanes, int(a, 0), np.int64)
                      for a in fn_args], lanes=batch_lanes,
                     devices=p._opts["devices"].value,
+                    mesh_drive=p._opts["mesh-drive"].value,
                     supervised=p._opts["supervised"].value
                     or p._opts["resume"].value,
                     resume=p._opts["resume"].value)
@@ -498,6 +504,10 @@ def _gateway_parser() -> ArgumentParser:
                                   default=8080))
     p.add_option(["lanes"], Option("device lanes per serving generation",
                                    "n", typ=int, default=64))
+    p.add_option(["devices"],
+                 Option("serve over N devices (single-program mesh "
+                        "drive, lane-sharded serving pool; lanes round "
+                        "up to a device multiple)", "n", typ=int))
     p.add_option(["module"],
                  ListOpt("preload a guest module as NAME=PATH "
                          "(repeatable; more can be registered at "
@@ -597,6 +607,7 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
     try:
         svc = GatewayService(
             conf=conf, lanes=p._opts["lanes"].value, tenants=tenants,
+            devices=p._opts["devices"].value,
             state_dir=p._opts["state-dir"].value,
             resume=p._opts["resume"].value,
             build_timeout_s=p._opts["build-timeout"].value

@@ -236,6 +236,14 @@ class SupervisorConfigure:
     # The run then continues from that snapshot on the SIMT tier (the
     # kernel tier cannot resume mid-state).  CLI: --resume.
     resume: bool = False
+    # Attempt the single-program shard drive first on supervised mesh
+    # runs (parallel/shard_drive.py: ONE jitted program over the named
+    # mesh, lane planes sharded on the `lanes` axis).  Any shard-drive
+    # failure demotes to the threaded per-device rungs below it;
+    # cadence-configured (checkpointing) and resumed runs skip straight
+    # to the per-device SIMT tier, whose states the coordinated
+    # checkpoints snapshot.
+    use_shard_drive: bool = True
     # --- mesh-level fault tolerance (parallel/supervisor.py) ---
     # Consecutive failed slices on ONE device of a supervised sharded
     # drive before that device is ejected from the mesh (its lanes

@@ -266,22 +266,30 @@ class ModuleRegistry:
         with self._lock:
             return [self._mods[n] for n in self._order]
 
-    def build_engine(self, conf, lanes: int):
+    def build_engine(self, conf, lanes: int, devices=None):
         """Concatenated multi-module engine over the CURRENT module set
         (one serving generation's engine; gateway/service.py swaps
         generations at a launch boundary).  The per-module engines
         cached at registration time are reused, so a swap costs one
-        image concatenation — not a re-lower of every module."""
+        image concatenation — not a re-lower of every module.
+        `devices` builds the engine over a lane-sharded named mesh
+        (mesh-tier continuous batching: the gateway's serving pool
+        spans every device, parallel/shard_drive.py)."""
         from wasmedge_tpu.batch.multitenant import MultiModuleBatchEngine
 
         mods = self.modules_snapshot()
         if not mods:
             raise WasmError(ErrCode.WrongVMWorkflow,
                             "no modules registered")
+        mesh = None
+        if devices is not None:
+            from wasmedge_tpu.parallel.mesh import lane_mesh
+
+            mesh = lane_mesh(devices=devices)
         return MultiModuleBatchEngine(
             [(rm.name, rm.inst, rm.store) for rm in mods],
             conf=conf, lanes=lanes,
-            engines=[rm.engine for rm in mods])
+            engines=[rm.engine for rm in mods], mesh=mesh)
 
     def close(self):
         with self._lock:

@@ -171,7 +171,8 @@ class GatewayService:
                  state_dir: Optional[str] = None,
                  resume: bool = False,
                  build_timeout_s: Optional[float] = 120.0,
-                 shed_on_degraded: bool = True):
+                 shed_on_degraded: bool = True,
+                 devices=None):
         from wasmedge_tpu.common.configure import Configure
         from wasmedge_tpu.obs.recorder import recorder_of
 
@@ -180,6 +181,15 @@ class GatewayService:
         # its Configure, so every generation reports into ONE recorder
         self.obs = recorder_of(self.template)
         self.lanes = int(lanes)
+        # mesh-tier serving (ROADMAP #1): every generation's engine is
+        # built over this lane-sharded device mesh and driven by the
+        # single-program shard drive; the pool rounds up to a device
+        # multiple (MultiModuleBatchEngine does the rounding)
+        self.devices = None
+        if devices is not None:
+            from wasmedge_tpu.parallel.mesh import normalize_devices
+
+            self.devices = normalize_devices(devices)
         self.tenants = tenants or GatewayTenants()
         self.registry = ModuleRegistry(conf=self.template,
                                        sink_stdout=sink_stdout)
@@ -281,7 +291,8 @@ class GatewayService:
             # durability implies a checkpoint cadence — resume has
             # nothing to adopt otherwise
             conf.serve.checkpoint_every_rounds = 1
-        engine = self.registry.build_engine(conf, self.lanes)
+        engine = self.registry.build_engine(conf, self.lanes,
+                                            devices=self.devices)
         server = BatchServer(engine=engine,
                              weights=self.tenants.weights(),
                              quotas=self.tenants.quotas(),

@@ -1,10 +1,17 @@
 from wasmedge_tpu.parallel.mesh import (
     MeshDriveError,
     lane_mesh,
+    run_mesh,
     run_pallas_sharded,
     shard_batch_state,
     state_shardings,
 )
+from wasmedge_tpu.parallel.shard_drive import (
+    ShardDrive,
+    ShardDriveError,
+    run_shard_drive,
+)
 
-__all__ = ["MeshDriveError", "lane_mesh", "run_pallas_sharded",
+__all__ = ["MeshDriveError", "ShardDrive", "ShardDriveError", "lane_mesh",
+           "run_mesh", "run_pallas_sharded", "run_shard_drive",
            "shard_batch_state", "state_shardings"]

@@ -17,6 +17,20 @@ from __future__ import annotations
 from typing import Optional
 
 
+def normalize_devices(devices):
+    """One rule for every `devices=` front door (VM.execute_batch,
+    BatchServer, GatewayService): an int selects a prefix of
+    jax.devices(), anything else is taken as an explicit device list;
+    None means all devices."""
+    import jax
+
+    if devices is None:
+        return jax.devices()
+    if isinstance(devices, int):
+        return jax.devices()[:devices]
+    return list(devices)
+
+
 def lane_mesh(n_devices: Optional[int] = None, devices=None):
     """1-D mesh over the 'lanes' axis."""
     import jax
@@ -125,6 +139,52 @@ def make_device_scheduler(inst, store, conf, func_name, dev_args,
     # chip in Perfetto
     eng.obs_track = f"pallas/dev{di}"
     return BlockScheduler(eng, func_name, dev_args, max_steps)
+
+
+def run_mesh(inst, store, conf, func_name, args_lanes, devices=None,
+             max_steps: int = 10_000_000, interpret=None,
+             drive: Optional[str] = None, supervised: bool = False,
+             faults=None, stats=None, checkpoint_dir=None, resume=None,
+             lanes=None):
+    """Multi-device front door: pick a mesh drive and run.
+
+    `drive` selects the rung:
+      - None / "shard" (default): the single-program shard drive — ONE
+        jitted program over the named mesh, lane planes sharded on the
+        `lanes` axis, one driving host thread
+        (parallel/shard_drive.py).  Unsupervised shard failures raise
+        ShardDriveError; the fallback ladder lives in the supervisor.
+      - "threaded": the per-device threaded drive (run_pallas_sharded)
+        — N host threads, one Pallas/BlockScheduler engine per device —
+        retained as the explicit degradation-ladder rung below the
+        shard drive.
+
+    `supervised=True` (or `resume`) routes through the MeshSupervisor,
+    which attempts the shard drive first (unless `drive="threaded"`)
+    and demotes to the threaded rungs on shard-drive failure, keeping
+    device quarantine / lane migration / coordinated checkpointing."""
+    if drive not in (None, "shard", "threaded"):
+        raise ValueError(f"unknown mesh drive {drive!r} "
+                         f"(expected 'shard' or 'threaded')")
+    if supervised or resume:
+        from wasmedge_tpu.parallel.supervisor import MeshSupervisor
+
+        sup = MeshSupervisor(inst, store=store, conf=conf,
+                             devices=devices, faults=faults, stats=stats,
+                             checkpoint_dir=checkpoint_dir, resume=resume,
+                             interpret=interpret, drive=drive)
+        return sup.run(func_name, list(args_lanes), max_steps=max_steps,
+                       lanes=lanes)
+    if drive in (None, "shard"):
+        from wasmedge_tpu.parallel.shard_drive import run_shard_drive
+
+        return run_shard_drive(inst, store, conf, func_name,
+                               list(args_lanes), devices=devices,
+                               max_steps=max_steps, lanes=lanes,
+                               faults=faults)
+    return run_pallas_sharded(inst, store, conf, func_name, args_lanes,
+                              devices=devices, max_steps=max_steps,
+                              interpret=interpret, lanes=lanes)
 
 
 def run_pallas_sharded(inst, store, conf, func_name, args_lanes,

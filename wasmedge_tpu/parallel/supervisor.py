@@ -131,7 +131,8 @@ class MeshSupervisor:
     def __init__(self, inst, store=None, conf=None, devices=None,
                  faults=None, stats=None,
                  checkpoint_dir: Optional[str] = None,
-                 resume: Optional[bool] = None, interpret=None):
+                 resume: Optional[bool] = None, interpret=None,
+                 drive: Optional[str] = None):
         from wasmedge_tpu.common.configure import Configure
         from wasmedge_tpu.obs.recorder import recorder_of
 
@@ -145,6 +146,13 @@ class MeshSupervisor:
         self.interpret = interpret
         self.checkpoint_dir = checkpoint_dir or self.k.checkpoint_dir
         self.resume = self.k.resume if resume is None else bool(resume)
+        # drive selection: None defers to the use_shard_drive knob,
+        # "shard" forces the single-program attempt, "threaded" skips
+        # straight to the per-device rungs
+        if drive not in (None, "shard", "threaded"):
+            raise ValueError(f"unknown mesh drive {drive!r} "
+                             f"(expected 'shard' or 'threaded')")
+        self.drive = drive
         import jax
 
         self.devices = list(devices) if devices is not None \
@@ -193,7 +201,18 @@ class MeshSupervisor:
                                                   len(self.devices))):
                 self.shards.append(self._new_shard(
                     di, self.devices[di], part))
-        if not self.resumed and self.k.use_kernel_tier \
+        # top of the degradation ladder: the single-program shard drive
+        # (parallel/shard_drive.py) — one jitted program over the named
+        # mesh.  Attempted only for fresh cadence-free runs (the
+        # coordinated-checkpoint tier needs per-device SIMT states);
+        # any failure demotes to the threaded per-device rungs below,
+        # preserving quarantine/ejection/migration semantics.
+        if not self.resumed and not self._wants_cadence() \
+                and self._shard_drive_on() and self._run_shard_tier():
+            for s in self.shards:
+                s.done = True
+        if not all(s.done for s in self.shards) \
+                and not self.resumed and self.k.use_kernel_tier \
                 and not self._wants_cadence() and self._kernel_tier_on():
             self._run_kernel_tier()
         self._reset_cadence()
@@ -229,6 +248,47 @@ class MeshSupervisor:
         from wasmedge_tpu.batch.pallas_engine import pallas_enabled
 
         return bool(self.interpret) or pallas_enabled(self.conf.batch)
+
+    def _shard_drive_on(self) -> bool:
+        if self.drive == "threaded":
+            return False
+        if self.drive == "shard":
+            return True
+        return bool(self.k.use_shard_drive)
+
+    # -- single-program shard tier (top of the ladder) ---------------------
+    def _run_shard_tier(self) -> bool:
+        """One single-program shard-drive attempt over the whole mesh
+        (parallel/shard_drive.py).  True = merged and done; False =
+        recorded demotion, the threaded per-device rungs take over with
+        their quarantine/ejection/migration semantics intact."""
+        from wasmedge_tpu.parallel.shard_drive import ShardDrive
+
+        t0 = self.obs.now()
+        try:
+            drv = ShardDrive(self.inst, store=self.store, conf=self.conf,
+                             devices=self.devices, faults=self.faults)
+            res = drv.run(self._func_name, self._args,
+                          max_steps=self._max_steps, lanes=self.lanes)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            self.retries += 1
+            self._record("shard_drive", e, tier="shard")
+            self.obs.instant("shard_drive_demote", cat="mesh",
+                             track="mesh", error=repr(e),
+                             devices=len(self.devices))
+            return False
+        for r in range(self._nres):
+            self._res[r] = np.asarray(res.results[r], np.int64)
+        self._trap[:] = np.asarray(res.trap, np.int32)
+        self._retired[:] = np.asarray(res.retired, np.int64)
+        self._done_mask[:] = True
+        self._steps = max(self._steps, int(res.steps))
+        self.obs.span("shard_drive", t0, cat="mesh", track="mesh",
+                      devices=len(self.devices), lanes=int(self.lanes),
+                      steps=int(res.steps))
+        return True
 
     # -- kernel tier (best-effort, mirrors the single supervisor) ----------
     def _run_kernel_tier(self):

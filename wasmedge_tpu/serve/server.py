@@ -82,7 +82,7 @@ class BatchServer:
                  stats=None, weights=None, quotas=None, faults=None,
                  checkpoint_dir: Optional[str] = None,
                  resume: bool = False, engine=None,
-                 resident_budgets=None):
+                 resident_budgets=None, devices=None):
         from wasmedge_tpu.common.configure import Configure
         from wasmedge_tpu.batch.engine import BatchEngine
         from wasmedge_tpu.obs.recorder import recorder_of
@@ -92,7 +92,16 @@ class BatchServer:
             # engine, gateway/): its Configure governs the run, and the
             # CALLER must hand a dedicated copy — the server mutates
             # serve/autotune knobs on it (inst/store/lanes are the
-            # engine's own)
+            # engine's own).  The mesh, too: a caller wanting a
+            # sharded server builds the engine over the mesh itself
+            # (registry.build_engine(devices=...)), so `devices` here
+            # would be silently ignored — refuse loudly instead.
+            if devices is not None:
+                raise ValueError(
+                    "BatchServer(engine=..., devices=...): a pre-built "
+                    "engine carries its own mesh; build it over the "
+                    "devices instead (e.g. BatchEngine(..., "
+                    "mesh=lane_mesh(devices=...)))")
             self.conf = engine.conf
             self.k = self.conf.serve
             if self.k.autotune:
@@ -110,8 +119,26 @@ class BatchServer:
                 # histograms; with the recorder off it would silently
                 # never fire (the CLI forces the same pairing)
                 self.conf.obs.enabled = True
+            # mesh-tier continuous batching (ROADMAP #1): `devices`
+            # builds the engine over a lane-sharded named mesh driven
+            # by the single-program shard drive — the serving pool
+            # rounds UP to a device multiple (extra lanes are just
+            # capacity; idle lanes park TRAP_DONE, so no pad masking
+            # is needed) and every install/harvest/swap addresses
+            # GLOBAL lane indices, so a recycled or hv-swapped request
+            # can land on any device's shard.
+            mesh = None
+            if devices is not None:
+                from wasmedge_tpu.parallel.mesh import (
+                    lane_mesh, normalize_devices)
+                from wasmedge_tpu.parallel.shard_drive import padded_lanes
+
+                devs = normalize_devices(devices)
+                mesh = lane_mesh(devices=devs)
+                lanes = padded_lanes(lanes or self.conf.batch.lanes,
+                                     len(devs))
             self.engine = BatchEngine(inst, store=store, conf=self.conf,
-                                      lanes=lanes)
+                                      lanes=lanes, mesh=mesh)
         self.lanes = self.engine.lanes
         self.obs = recorder_of(self.conf)
         self.stats = stats
@@ -519,6 +546,18 @@ class BatchServer:
                 self.counters["rejected"] += 1
                 req.future._reject(err)
 
+    def _idle_state(self, fidx: int):
+        """A fresh all-idle serving state, placed lane-sharded on the
+        mesh when the engine drives one (so the first launch does not
+        pay a host->device reshard of every plane)."""
+        state = self.recycler.idle_state(fidx)
+        mesh = getattr(self.engine, "mesh", None)
+        if mesh is not None:
+            from wasmedge_tpu.parallel.mesh import shard_batch_state
+
+            state = shard_batch_state(state, mesh)
+        return state
+
     # -- round phases ------------------------------------------------------
     def _expire_queued(self, now: float):
         for req in self.queue.expire(now):
@@ -551,7 +590,7 @@ class BatchServer:
             return 0
         if self.state is None:
             fidx0 = self.recycler.func_idx(picks[0].func_name)
-            self.state = self.recycler.idle_state(fidx0)
+            self.state = self._idle_state(fidx0)
         # group by function so each install is one column-set pass
         by_func: Dict[int, List[ServeRequest]] = {}
         for req in picks:
@@ -596,7 +635,7 @@ class BatchServer:
         if self.state is None:
             v0 = next(iter(self.hv.waiting.values()))
             fidx0 = self.recycler.func_idx(v0.req.func_name)
-            self.state = self.recycler.idle_state(fidx0)
+            self.state = self._idle_state(fidx0)
         before = len(self._bindings)
         swaps0 = self.hv.counters["swaps_in"] \
             + self.hv.counters["swaps_out"]
@@ -836,7 +875,7 @@ class BatchServer:
                     self.recycler.func_idx(r.func_name)
                     for r in old_bindings.values()), 0) \
                     if old_bindings else 0
-                state = self.recycler.idle_state(fidx0)
+                state = self._idle_state(fidx0)
             total = 0
             stdout_cursor_reset(self.engine)
         # Serving-layer stdout is AT-LEAST-once across a crash restore:

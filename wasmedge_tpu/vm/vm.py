@@ -203,7 +203,7 @@ class VM:
 
     def execute_batch(self, func_name: str, args_lanes: Sequence,
                       lanes: Optional[int] = None, mesh=None,
-                      devices=None,
+                      devices=None, mesh_drive: Optional[str] = None,
                       max_steps: int = 10_000_000, supervised: bool = False,
                       resume: Optional[bool] = None,
                       trace_out: Optional[str] = None,
@@ -213,12 +213,18 @@ class VM:
         BatchResult (per-lane results/trap/retired arrays).
 
         `devices` (an int prefix of jax.devices() or an explicit device
-        list) shards the lane batch across a device mesh via
-        parallel/mesh.py — one engine per chip, merged lane-ordered
-        result.  Combined with `supervised=True` the drive runs under
-        the MeshSupervisor (parallel/supervisor.py): per-device failure
-        quarantine, lane migration off ejected devices, coordinated
-        mesh checkpointing, cooperative cancellation.
+        list) shards the lane batch across a named device mesh via
+        parallel/mesh.py run_mesh.  `mesh_drive` picks the rung: None/
+        "shard" (default) is the single-program shard drive — ONE
+        jitted program over the mesh with lane planes sharded on the
+        `lanes` axis, one driving host thread
+        (parallel/shard_drive.py); "threaded" is the per-device
+        threaded drive retained as the explicit degradation-ladder
+        rung.  Combined with `supervised=True` the drive runs under
+        the MeshSupervisor (parallel/supervisor.py): shard drive first
+        with demotion to the threaded rungs on failure, per-device
+        failure quarantine, lane migration off ejected devices,
+        coordinated mesh checkpointing, cooperative cancellation.
 
         `supervised=True` wraps the run in the supervision layer
         (batch/supervisor.py): periodic checkpoints, retry-with-backoff
@@ -266,18 +272,17 @@ class VM:
         eng = None
         try:
             if devices is not None:
-                import jax
+                from wasmedge_tpu.parallel.mesh import (
+                    normalize_devices, run_mesh)
 
-                from wasmedge_tpu.parallel.mesh import run_pallas_sharded
-
-                devs = jax.devices()[:int(devices)] \
-                    if isinstance(devices, int) else list(devices)
+                devs = normalize_devices(devices)
                 # `lanes` forwards so the scalar-broadcast contract of
                 # the single-device paths holds on the mesh drive too
-                return run_pallas_sharded(
+                return run_mesh(
                     inst, self.store, conf, func_name, list(args_lanes),
                     devices=devs, max_steps=max_steps, lanes=lanes,
-                    supervised=supervised, stats=self.stat, resume=resume)
+                    drive=mesh_drive, supervised=supervised,
+                    stats=self.stat, resume=resume)
             if supervised:
                 from wasmedge_tpu.batch.engine import BatchEngine
                 from wasmedge_tpu.batch.supervisor import BatchSupervisor
