@@ -1550,6 +1550,325 @@ def chaos_bench(smoke: bool = False) -> int:
     return 0 if ok else 1
 
 
+def federation_bench(smoke: bool = False) -> int:
+    """`bench.py --federation`: the r16 fleet-federation acceptance —
+    TWO gateways federated over localhost ephemeral ports (in-process
+    services + real sockets, with `Gateway.kill()` as the supported
+    simulated SIGKILL, the r13 chaos precedent):
+
+      - the guest module registers over HTTP on peer A only; peer B
+        becomes servable through the peer-replicated module store
+      - an open-loop async stream submits through BOTH peers (routing
+        forwards across the fleet); retryable 503/429 rejections
+        (suspect owner, strict-replication failure) are retried per
+        their Retry-After — the machine-readable contract in action
+      - one parked (swapped) virtual lane cross-host-MIGRATES A -> B
+        before the kill; its result must be bit-identical to the
+        unmigrated same-argument reference
+      - peer A is KILLED mid-stream (no drain, no flush); B's
+        heartbeat state machine declares it dead, adopts its
+        replicated journal (ids accepted by A answer from B), and
+        re-queues its own forwards — every accepted id reaches exactly
+        one stable terminal outcome, zero ids lost
+      - the full module set stays servable from the survivor
+
+    Emits FLEET_r16.json.  `--federation-smoke` is the CI guard: a
+    short stream, same assertions, no artifact."""
+    import os
+    import threading
+    import time as _time
+
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.fleet import FleetConfig
+    from wasmedge_tpu.gateway import Gateway, GatewayService
+    from wasmedge_tpu.models import build_fib
+
+    seed = int(os.environ.get("FLEET_SEED", 16))
+    if smoke:
+        lanes, nreq, rate = 4, 14, 60.0
+        fib_lo, fib_hi = 8, 12
+    else:
+        lanes = int(os.environ.get("FLEET_LANES", 4))
+        nreq = int(os.environ.get("FLEET_REQUESTS", 48))
+        rate = float(os.environ.get("FLEET_RATE", 24.0))
+        fib_lo, fib_hi = 8, 14
+    kill_at = nreq // 2
+
+    def fresh_conf():
+        conf = Configure()
+        conf.batch.steps_per_launch = 128
+        conf.batch.value_stack_depth = 64
+        conf.batch.call_stack_depth = 32
+        conf.hv.max_virtual_lanes = 3 * lanes   # parking -> migratable
+        return conf
+
+    def fleet_cfg(peers=()):
+        return FleetConfig(peers=peers, heartbeat_s=0.1,
+                           suspect_after=2, dead_after=3,
+                           backoff_base_s=0.02, request_timeout_s=5.0)
+
+    t0 = time.perf_counter()
+    svc_a = GatewayService(conf=fresh_conf(), lanes=lanes,
+                           fleet=fleet_cfg())
+    gw_a = Gateway(svc_a, port=0).start()
+    svc_b = GatewayService(
+        conf=fresh_conf(), lanes=lanes,
+        fleet=fleet_cfg([f"{gw_a.host}:{gw_a.port}"]))
+    gw_b = Gateway(svc_b, port=0).start()
+    a = {"host": gw_a.host, "port": gw_a.port}
+    b = {"host": gw_b.host, "port": gw_b.port}
+
+    # -- module registers on A ONLY, over the wire --------------------
+    st, doc, _ = _gateway_rpc(a["host"], a["port"], "POST",
+                              "/v1/modules?name=fib", body=build_fib(),
+                              headers={"Content-Type":
+                                       "application/wasm"},
+                              timeout=180.0)
+    assert st == 201, (st, doc)
+    # ...and replicates to B (heartbeat manifest sync)
+    deadline = _time.monotonic() + 120.0
+    replicated = False
+    while _time.monotonic() < deadline:
+        st, doc, _ = _gateway_rpc(b["host"], b["port"], "GET",
+                                  "/v1/status", timeout=30.0)
+        if st == 200 and "fib" in (doc.get("modules") or {}):
+            replicated = True
+            break
+        _time.sleep(0.05)
+
+    accepted = {}          # id -> fib arg
+    rejected_mr = []
+    transport_errors = [0]
+    outcomes = {}
+    lock = threading.Lock()
+    stop_poll = threading.Event()
+    a_dead = threading.Event()
+
+    def poll_once(rid):
+        # post-kill, ids accepted by A answer from B only after
+        # adoption: a 404 is "not yet", never a terminal outcome (a
+        # genuinely lost id fails the drain deadline instead)
+        try:
+            st, doc, _ = _gateway_rpc(b["host"], b["port"], "GET",
+                                      f"/v1/requests/{rid}",
+                                      timeout=30.0)
+        except OSError:
+            return False
+        if st == 404 or not isinstance(doc, dict) \
+                or doc.get("status") == "pending":
+            return False
+        with lock:
+            outcomes.setdefault(rid, (st, doc))
+        return True
+
+    def poller():
+        while not stop_poll.is_set():
+            with lock:
+                todo = [r for r in accepted if r not in outcomes]
+            if not todo:
+                _time.sleep(0.02)
+                continue
+            for rid in todo:
+                poll_once(rid)
+                if stop_poll.is_set():
+                    return
+            _time.sleep(0.01)
+
+    pollers = [threading.Thread(target=poller, daemon=True)
+               for _ in range(1 if smoke else 2)]
+    for t in pollers:
+        t.start()
+
+    def submit(peer, n):
+        """One async submit with bounded retry of the RETRYABLE
+        classes (suspect owner 503, strict-replication 503,
+        backpressure 429) — the Retry-After contract exercised."""
+        for _ in range(8):
+            try:
+                st, doc, after = _gateway_rpc(
+                    peer["host"], peer["port"], "POST",
+                    "/v1/invoke?async=1",
+                    body={"module": "fib", "func": "fib",
+                          "args": [int(n)]}, timeout=30.0)
+            except OSError:
+                transport_errors[0] += 1
+                return
+            if st == 202 and isinstance(doc, dict):
+                with lock:
+                    accepted[doc["request_id"]] = int(n)
+                return
+            err = doc.get("err") if isinstance(doc, dict) else None
+            if isinstance(err, dict) and err.get("retryable"):
+                rejected_mr.append((st, err.get("name"),
+                                    err.get("detail")))
+                _time.sleep(min(float(after or 0.2), 0.3))
+                continue
+            if isinstance(err, dict):
+                rejected_mr.append((st, err.get("name"),
+                                    err.get("detail")))
+                return
+            transport_errors[0] += 1
+            return
+
+    # -- the stream: alternate peers pre-kill, survivor-only after ----
+    rng = np.random.RandomState(seed)
+    args_stream = rng.randint(fib_lo, fib_hi + 1, size=nreq)
+    migrated_id = None
+    migrated_arg = None
+    restarted = False
+    t_sched0 = _time.monotonic()
+    for i, n in enumerate(args_stream):
+        t_sched = t_sched0 + i / rate
+        now = _time.monotonic()
+        if t_sched > now:
+            _time.sleep(t_sched - now)
+        if i == kill_at:
+            # -- cross-host migration first: pressure-burst A so its
+            # hv layer parks a lane, then ship one parked vlane A -> B
+            # and keep its id for the bit-identical check
+            for _ in range(2 * lanes + 2):
+                submit(a, fib_hi + 2)
+            mig_deadline = _time.monotonic() + (30.0 if smoke else 60.0)
+            while _time.monotonic() < mig_deadline:
+                st, doc, _ = _gateway_rpc(a["host"], a["port"], "GET",
+                                          "/v1/fleet/status",
+                                          timeout=30.0)
+                swapped = [r for r in (doc.get("swapped") or [])
+                           if r in accepted] if st == 200 else []
+                if swapped:
+                    rid = swapped[0]
+                    st, doc, _ = _gateway_rpc(
+                        a["host"], a["port"], "POST",
+                        "/v1/fleet/migrate_out",
+                        body={"id": rid,
+                              "peer": f"{b['host']}:{b['port']}"},
+                        timeout=30.0)
+                    if st == 200 and isinstance(doc, dict) \
+                            and doc.get("ok"):
+                        migrated_id = rid
+                        migrated_arg = accepted[rid]
+                    break
+                _time.sleep(0.05)
+            # -- THE kill: no drain, no flush, heartbeats just stop
+            gw_a.kill()
+            a_dead.set()
+            restarted = True
+        peer = b if a_dead.is_set() or (i % 2 == 0) else a
+        submit(peer, n)
+
+    # -- drain --------------------------------------------------------
+    deadline = _time.monotonic() + (180.0 if smoke else 420.0)
+    while _time.monotonic() < deadline:
+        with lock:
+            if len(outcomes) == len(accepted):
+                break
+        _time.sleep(0.05)
+    stop_poll.set()
+    for t in pollers:
+        t.join(timeout=5.0)
+
+    def fibv(n):
+        x, y = 0, 1
+        for _ in range(n):
+            x, y = y, x + y
+        return x
+
+    # exactly one STABLE terminal outcome per accepted id, and every
+    # ok outcome carries the right cells (server-side correctness is
+    # client-visible)
+    stable = lost = resolved = wrong = 0
+    for rid, n in accepted.items():
+        first = outcomes.get(rid)
+        if first is None:
+            lost += 1
+            continue
+        try:
+            st, doc, _ = _gateway_rpc(b["host"], b["port"], "GET",
+                                      f"/v1/requests/{rid}",
+                                      timeout=30.0)
+        except OSError:
+            st, doc = None, None
+        if isinstance(doc, dict) and doc.get("ok") \
+                and first[1].get("ok") \
+                and doc.get("result") == first[1].get("result"):
+            stable += 1
+        elif isinstance(doc, dict) and not doc.get("ok") \
+                and not first[1].get("ok"):
+            stable += 1
+        if first[1].get("ok"):
+            resolved += 1
+            if first[1].get("result") != [fibv(n)]:
+                wrong += 1
+
+    # migrated-lane bit-identity: the migrated id resolved with the
+    # SAME cells as the unmigrated same-argument oracle
+    mig_ok = migrated_id is not None
+    if mig_ok:
+        out_m = outcomes.get(migrated_id)
+        mig_ok = out_m is not None and out_m[1].get("ok") \
+            and out_m[1].get("result") == [fibv(migrated_arg)]
+
+    st, status_b, _ = _gateway_rpc(b["host"], b["port"], "GET",
+                                   "/v1/status", timeout=60.0)
+    st_m, metrics_b, _ = _gateway_rpc(b["host"], b["port"], "GET",
+                                      "/metrics", timeout=60.0)
+    fleet_b = status_b.get("fleet", {}) if isinstance(status_b, dict) \
+        else {}
+    gw_b.shutdown(drain=True, timeout_s=120.0)
+    dt = time.perf_counter() - t0
+
+    checks = {
+        "module_replicated_to_peer": replicated,
+        "accepted_all_terminal": len(outcomes) == len(accepted),
+        "zero_ids_lost": lost == 0,
+        "outcomes_stable": stable == len(accepted),
+        "results_correct": wrong == 0,
+        "peer_killed_mid_stream": restarted,
+        "peer_declared_dead": fleet_b.get("peer_states", {}).get(
+            f"{a['host']}:{a['port']}", {}).get("state") == "dead",
+        "modules_servable_from_survivor": isinstance(status_b, dict)
+        and set(status_b.get("modules", {})) >= {"fib"},
+        "migrated_lane_bit_identical": mig_ok,
+        "fleet_metrics_exported":
+            "wasmedge_fleet_peers" in str(metrics_b)
+            and "wasmedge_fleet_migrations_total" in str(metrics_b),
+    }
+    ok = all(checks.values())
+    out = {
+        "metric": "fleet_federation_smoke" if smoke
+        else "fleet_federation_open_loop",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "seed": seed,
+        "lanes_per_peer": lanes,
+        "peers": 2,
+        "requests": nreq,
+        "accepted": len(accepted),
+        "rejected_retryable_then_retried": len(rejected_mr),
+        "transport_errors": transport_errors[0],
+        "resolved_ok": resolved,
+        "migrated_id": migrated_id,
+        "adoptions": fleet_b.get("adoptions", 0),
+        "forward_requeues": fleet_b.get("forward_requeues", 0),
+        "wall_s": round(dt, 3),
+    }
+    if smoke:
+        print(json.dumps(out))
+        return 0 if ok else 1
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "FLEET_r16.json")
+    print(json.dumps(out))
+    print(f"# federation peers=2 lanes={lanes} reqs={nreq} "
+          f"accepted={len(accepted)} lost={lost} "
+          f"adoptions={fleet_b.get('adoptions')} "
+          f"requeues={fleet_b.get('forward_requeues')} "
+          f"migrated={migrated_id} wall={dt:.1f}s", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main():
     eng = _build(LANES)
 
@@ -1637,6 +1956,10 @@ if __name__ == "__main__":
         sys.exit(chaos_bench(smoke=True))
     if "--chaos" in sys.argv[1:]:
         sys.exit(chaos_bench())
+    if "--federation-smoke" in sys.argv[1:]:
+        sys.exit(federation_bench(smoke=True))
+    if "--federation" in sys.argv[1:]:
+        sys.exit(federation_bench())
     if "--oversub-smoke" in sys.argv[1:]:
         sys.exit(oversub_bench(smoke=True))
     if "--oversub" in sys.argv[1:]:

@@ -595,6 +595,30 @@ def test_structured_rejection_contract():
         ServeRequest("f", (1 << 80,))
     ServeRequest("f", ((1 << 63) - 1, -(1 << 63)))  # extremes fit
 
+    # fleet routing (r16): a request whose rendezvous owner is a
+    # SUSPECT peer refuses retryably with Retry-After — 503 at the
+    # edge with detail "peer_suspect", never a bare 503 string (the
+    # over-the-wire half is pinned in tests/test_fleet.py)
+    from wasmedge_tpu.fleet import PeerSuspect
+    from wasmedge_tpu.gateway.http import retry_after_of, \
+        submit_status_of
+
+    ps = PeerSuspect("10.0.0.2:8080", 41)
+    assert ps.retryable is True
+    info = rejection_info(ps)
+    assert info["retryable"] is True
+    assert info["retry_after_s"] > 0
+    assert info["detail"] == "peer_suspect"
+    assert submit_status_of(ps) == 503
+    assert retry_after_of(ps) is not None
+
+    # strict journal replication failure withdraws the acceptance with
+    # the same retryable contract as a failed local journal write
+    from wasmedge_tpu.fleet import ReplicationFailed
+
+    rf = ReplicationFailed("no peer reachable")
+    assert rejection_info(rf)["retryable"] is True
+
 
 def test_server_submit_rejections_carry_the_flag():
     """BatchServer.submit's two rejection classes are distinguishable

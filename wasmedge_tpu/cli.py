@@ -552,6 +552,16 @@ def _gateway_parser() -> ArgumentParser:
     p.add_option(["duration"],
                  Option("serve for N seconds then drain and exit "
                         "(default: until SIGINT)", "s", typ=float))
+    p.add_option(["peer"],
+                 ListOpt("federate with the gateway at HOST:PORT "
+                         "(repeatable; wasmedge_tpu/fleet/: peer-"
+                         "replicated module store, rendezvous request "
+                         "routing, journal-replicated failover, "
+                         "cross-host lane migration)", "host:port"))
+    p.add_option(["fleet-heartbeat"],
+                 Option("peer heartbeat interval in seconds "
+                        "(default 0.25; drives the suspect->dead "
+                        "liveness state machine)", "s", typ=float))
     p.add_positional("wasm_file", "guest module registered as 'main'",
                      required=False)
     return p
@@ -604,6 +614,18 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
     if p._opts["resume"].value and not p._opts["state-dir"].seen:
         err.write("wasmedge-tpu: --resume requires --state-dir\n")
         return 2
+    # the fleet controller is ALWAYS on for the CLI gateway (a no-peer
+    # FleetConfig is inert and pinned bit-identical to a non-federated
+    # gateway): the /v1/fleet/* routes must answer even on a gateway
+    # started without --peer, or a peer that lists THIS address could
+    # never introduce itself and one-directional configs would never
+    # converge
+    from wasmedge_tpu.fleet import FleetConfig
+
+    fleet = FleetConfig(
+        peers=p._opts["peer"].value,
+        heartbeat_s=p._opts["fleet-heartbeat"].value
+        if p._opts["fleet-heartbeat"].seen else 0.25)
     try:
         svc = GatewayService(
             conf=conf, lanes=p._opts["lanes"].value, tenants=tenants,
@@ -613,7 +635,8 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
             build_timeout_s=p._opts["build-timeout"].value
             if p._opts["build-timeout"].seen else 120.0,
             result_cache=p._opts["result-cache"].value
-            if p._opts["result-cache"].seen else 4096)
+            if p._opts["result-cache"].seen else 4096,
+            fleet=fleet)
     except (WasmError, ValueError, OSError) as e:
         err.write(f"wasmedge-tpu: gateway resume failed: {e}\n")
         return 1
@@ -676,6 +699,8 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
         "durable": svc.durable is not None,
         "restarts": svc.counters["restarts"],
         "resumed_requests": svc.counters["resumed"],
+        "fleet_peers": sorted(svc.fleet.peers)
+        if svc.fleet is not None else None,
     }) + "\n")
     out.flush()
     duration = p._opts["duration"].value

@@ -1,0 +1,35 @@
+"""Multi-host fleet federation (r16).
+
+Federates >=2 gateway processes into one fault-tolerant serving fleet:
+a peer-replicated content-addressed module store, rendezvous-hash
+request routing with journal-replicated failover (a dead peer's
+accepted ids are adopted by survivors), cross-host lane migration of
+parked SwapStore entries (hash-verified end to end), and a fleet-wide
+health view with suspect→dead liveness tracking.  A one-host fleet is
+bit-identical to the non-federated gateway.
+
+  fleet/routing.py     rendezvous (highest-random-weight) ownership
+  fleet/peer.py        peer transport + liveness state machine
+  fleet/federation.py  the FleetController riding a GatewayService
+"""
+
+from wasmedge_tpu.fleet.federation import (
+    FleetConfig,
+    FleetController,
+    PeerSuspect,
+    ReplicationFailed,
+)
+from wasmedge_tpu.fleet.peer import PeerClient, PeerState, PeerUnreachable
+from wasmedge_tpu.fleet.routing import rendezvous_owner, rendezvous_ranked
+
+__all__ = [
+    "FleetConfig",
+    "FleetController",
+    "PeerSuspect",
+    "ReplicationFailed",
+    "PeerClient",
+    "PeerState",
+    "PeerUnreachable",
+    "rendezvous_owner",
+    "rendezvous_ranked",
+]

@@ -1,0 +1,888 @@
+"""FleetController: federation of gateway processes into one fleet.
+
+One controller rides one GatewayService (gateway/service.py) and owns
+everything multi-host (r16 tentpole):
+
+  membership      static peer list (CLI --peer / FleetConfig.peers),
+                  liveness via the heartbeat loop's suspect→dead state
+                  machine with exponential probe backoff
+                  (fleet/peer.py); a one-host fleet (no peers) is
+                  inert — the submit path, id sequence, and results
+                  are bit-identical to a non-federated gateway
+  module store    the content-addressed module manifest replicates
+                  peer-to-peer: heartbeats exchange {name, sha256}
+                  manifests, missing blobs are fetched over
+                  GET /v1/fleet/modules/<sha> and verified against
+                  their sha before registration (sha keys make
+                  replication idempotent and verification free), so a
+                  module registered on any gateway is servable on all
+  routing         rendezvous hash on the request id over the available
+                  membership (fleet/routing.py): the owner executes;
+                  a request routed to a SUSPECT owner is refused with
+                  a retryable PeerSuspect (Retry-After) instead of
+                  being forwarded into a probable black hole; when no
+                  remote peer is available everything routes to self
+                  (solo fallback)
+  durability      every accepted id is journaled durably AND
+                  replicated to at least one alive peer BEFORE the
+                  202 (strict replication rides the same withdraw-on-
+                  failure contract as the r13 durable journal); the
+                  replicated journal + result cache are what survivors
+                  adopt from
+  failover        a peer's death (suspect→dead) triggers adoption of
+                  its replicated journal exactly once per incarnation:
+                  resolved ids replay exactly-once from the replicated
+                  result cache, unresolved ids re-queue at-least-once
+                  under their ORIGINAL ids on their rendezvous owner
+                  among the survivors (ids forwarded by a still-alive
+                  edge are skipped — the edge re-queues its own
+                  forwards when it notices the owner died)
+  migration       a parked (swapped) virtual lane ships to a peer as
+                  its SwapStore payload + metadata, hash-verified end
+                  to end (the content key IS the verification), and
+                  reinstalls through the existing jitted column-set
+                  pass — results bit-identical to the unmigrated run;
+                  a failed send re-adopts the lane locally (a request
+                  is never lost mid-migration)
+
+Fault seams (testing/faults.py): `peer_send` before every outbound
+peer request, `peer_recv` on receipt of every inbound one, and
+`peer_heartbeat` before each liveness probe — `partition_schedule`
+builds deterministic one-directional link cuts from them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from wasmedge_tpu.common.errors import EngineFailure, ErrCode, WasmError
+from wasmedge_tpu.fleet.peer import (
+    BACKOFF_BASE_S,
+    DEAD_AFTER,
+    SUSPECT_AFTER,
+    PeerClient,
+    PeerState,
+    PeerUnreachable,
+)
+from wasmedge_tpu.fleet.routing import rendezvous_owner
+
+
+class PeerSuspect(EngineFailure):
+    """The request's rendezvous owner is currently SUSPECT (missing
+    heartbeats but not yet declared dead): forwarding would probably
+    black-hole it, executing locally would double-run it if the owner
+    is merely slow.  Retryable with Retry-After — by the next attempt
+    the owner is either alive again or dead (and routing has moved
+    on), so the client's retry lands.  Never a bare 503 string: the
+    body carries the full rejection_info contract with the
+    `peer_suspect` detail."""
+
+    retryable = True
+    detail = "peer_suspect"
+
+    def __init__(self, peer_id: str, request_id: int):
+        super().__init__(
+            f"request {request_id} routes to peer {peer_id!r} which is "
+            f"suspect (missed heartbeats); retry shortly")
+        self.peer = peer_id
+        self.retry_after_s = 1.0
+
+
+class ReplicationFailed(WasmError):
+    """Strict journal replication could not reach ANY alive peer: the
+    acceptance would not survive this host's death, so it is withdrawn
+    (the same contract as a failed durable journal write)."""
+
+    retryable = True
+
+    def __init__(self, msg: str):
+        super().__init__(ErrCode.ExecutionFailed, msg)
+        self.retry_after_s = 1.0
+
+
+class FleetConfig:
+    """Federation knobs.  `peers` is ["host:port", ...]; the peer id
+    IS the address string (unique within a fleet by construction)."""
+
+    def __init__(self, peers=(), self_id: Optional[str] = None,
+                 heartbeat_s: float = 0.25,
+                 suspect_after: int = SUSPECT_AFTER,
+                 dead_after: int = DEAD_AFTER,
+                 backoff_base_s: float = BACKOFF_BASE_S,
+                 replicate_min_interval_s: float = 0.05,
+                 request_timeout_s: float = 10.0,
+                 auto_tick: bool = True):
+        self.peers = [str(p) for p in peers]
+        self.self_id = self_id
+        self.heartbeat_s = float(heartbeat_s)
+        self.suspect_after = int(suspect_after)
+        self.dead_after = int(dead_after)
+        self.backoff_base_s = float(backoff_base_s)
+        self.replicate_min_interval_s = float(replicate_min_interval_s)
+        self.request_timeout_s = float(request_timeout_s)
+        # False = no background tick thread; the caller (deterministic
+        # fault tests) drives tick() by hand so seam arrival counters
+        # never race a timer
+        self.auto_tick = bool(auto_tick)
+
+
+class _Forward:
+    """One request this gateway accepted but a peer is executing (a
+    routed forward or an outbound migration): the relay polls the
+    owner until a terminal outcome resolves the local future, and an
+    owner death re-queues the request locally under its original id."""
+
+    __slots__ = ("rid", "owner", "req", "t0")
+
+    def __init__(self, rid: int, owner: str, req):
+        self.rid = rid
+        self.owner = owner
+        self.req = req
+        self.t0 = time.monotonic()
+
+
+def _error_from_payload(status: int, err: dict) -> BaseException:
+    """Rebuild a peer-reported failure preserving the class the HTTP
+    status mapping branches on (mirror of durable.resolved_error)."""
+    from wasmedge_tpu.serve.queue import DeadlineExceeded, ServeRejected
+
+    msg = (err or {}).get("message", "")
+    if status == 504:
+        return DeadlineExceeded(msg or "deadline exceeded on peer")
+    if status == 503:
+        return ServeRejected(msg or "rejected by peer lifecycle")
+    code = (err or {}).get("code")
+    code = ErrCode(code) if code in ErrCode._value2member_map_ \
+        else ErrCode.ExecutionFailed
+    return WasmError(code, msg)
+
+
+class FleetController:
+    """Federation state machine for one GatewayService.  All peer I/O
+    runs on the controller's tick thread or an HTTP handler thread —
+    never under the service's locks."""
+
+    def __init__(self, svc, config: FleetConfig):
+        self.svc = svc
+        self.cfg = config
+        self.self_id: str = config.self_id or ""
+        self.self_url: str = ""
+        # fresh incarnation marker: a peer seeing a NEW epoch knows our
+        # journal was resumed from disk and resets its adoption record
+        self.epoch = uuid.uuid4().hex[:12]
+        self._lock = threading.RLock()
+        self.peers: Dict[str, PeerState] = {}
+        self._client: Optional[PeerClient] = None
+        self._forwards: Dict[int, _Forward] = {}
+        self._module_bytes: Dict[str, bytes] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._repl_doc: Optional[dict] = None
+        self._repl_dirty = False
+        self._repl_last = 0.0
+        self.counters = {
+            "heartbeats_ok": 0, "heartbeats_missed": 0,
+            "modules_synced": 0, "module_conflicts": 0,
+            "adoptions": 0, "adoptions_replayed": 0,
+            "forwards": 0, "forward_requeues": 0,
+            "migrations_out": 0, "migrations_in": 0,
+            "replication_errors": 0, "suspect_rejections": 0,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, host: str, port: int):
+        """Bind the fleet identity to the gateway's LISTENING address
+        (known only after the HTTP server binds) and start the tick
+        thread.  Idempotent."""
+        self.self_url = f"{host}:{port}"
+        if not self.self_id:
+            self.self_id = self.self_url
+        self._client = PeerClient(self.self_id, faults=self.svc.faults,
+                                  timeout_s=self.cfg.request_timeout_s)
+        with self._lock:
+            for url in self.cfg.peers:
+                pid = str(url)
+                if pid != self.self_id and pid not in self.peers:
+                    self.peers[pid] = PeerState(pid, pid)
+        # fleet-unique id space: fresh ids allocate above a 40-bit
+        # hash of the peer identity so two peers' original-id re-queues
+        # can never collide (adoption preserves ids across hosts)
+        from wasmedge_tpu.serve.queue import advance_request_ids
+
+        if self.peers:
+            base = (int.from_bytes(
+                hashlib.sha256(self.self_id.encode()).digest()[:5],
+                "big") << 20)
+            advance_request_ids(base)
+        if self.peers and self.cfg.auto_tick and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"fleet:{self.self_id}")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    @property
+    def started(self) -> bool:
+        return self._client is not None
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                pass   # a tick must never kill the loop; the next
+            #            heartbeat re-observes whatever went wrong
+            self._stop.wait(self.cfg.heartbeat_s)
+
+    # -- membership view ---------------------------------------------------
+    def members(self) -> List[str]:
+        """Routable membership: self plus every non-dead peer (the
+        rendezvous universe — stable across a suspect flap)."""
+        with self._lock:
+            return [self.self_id] + [p.peer_id
+                                     for p in self.peers.values()
+                                     if p.available()]
+
+    def remote_available(self) -> bool:
+        with self._lock:
+            return any(p.available() for p in self.peers.values())
+
+    def peer_states(self) -> Dict[str, dict]:
+        with self._lock:
+            return {p.peer_id: {"url": p.url, "state": p.state,
+                                "streak": p.streak,
+                                "epoch": p.epoch,
+                                "transitions": p.transitions}
+                    for p in self.peers.values()}
+
+    # -- tick: heartbeat / sync / relay ------------------------------------
+    def tick(self):
+        """One federation round (the background thread calls this
+        every heartbeat_s; tests call it directly for determinism):
+        probe due peers, sync missing modules, push a dirty journal
+        replica, poll outstanding forwards."""
+        now = time.monotonic()
+        with self._lock:
+            due = [p for p in self.peers.values() if now >= p.next_probe]
+        for p in due:
+            self._probe(p)
+        self._sync_modules()
+        self._push_replica()
+        self.poll_forwards()
+
+    def _probe(self, p: PeerState):
+        """One heartbeat probe: exchange identity, manifests, and (as
+        the response piggyback) the peer's current journal replica."""
+        try:
+            if self.svc.faults is not None:
+                self.svc.faults.fire("peer_heartbeat",
+                                     src=self.self_id, dst=p.peer_id)
+            st, doc = self._client.request(
+                p.peer_id, p.url, "POST", "/v1/fleet/heartbeat",
+                body=self._hello())
+            if st != 200 or not isinstance(doc, dict):
+                raise PeerUnreachable(p.peer_id, f"heartbeat HTTP {st}")
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException:
+            self._note_miss(p)
+            return
+        self._note_ok(p, doc)
+
+    def _hello(self) -> dict:
+        """Heartbeat body: who we are + what we serve + (catch-up
+        only) our LAST journal snapshot.  The journal's primary
+        channel is the push path (`replicate`/`_push_replica`) — the
+        heartbeat reuses the already-built stashed doc rather than
+        taking a fresh svc-locked snapshot per probe, so a big result
+        cache is serialized once per change, not once per heartbeat."""
+        out = {"peer_id": self.self_id, "epoch": self.epoch,
+               "url": self.self_url,
+               "generation": self.svc.generation,
+               "modules": self._manifest()}
+        with self._lock:
+            doc = self._repl_doc
+        if doc is not None:
+            out["journal"] = doc
+        return out
+
+    def _manifest(self) -> List[dict]:
+        out = []
+        for rm in self.svc.registry.modules_snapshot():
+            if rm.sha256:
+                out.append({"name": rm.name, "sha256": rm.sha256})
+        return out
+
+    def _note_ok(self, p: PeerState, doc: dict):
+        now = time.monotonic()
+        with self._lock:
+            fresh = p.note_ok(now, doc.get("epoch"))
+            if fresh:
+                # new incarnation: its journal replica restarts, and a
+                # future death of THIS incarnation adopts again
+                p.adopted_epoch = None
+                p.replica = None
+            if isinstance(doc.get("modules"), list):
+                p.modules = doc["modules"]
+            if isinstance(doc.get("journal"), dict):
+                p.replica = doc["journal"]
+            self.counters["heartbeats_ok"] += 1
+
+    def _note_miss(self, p: PeerState):
+        now = time.monotonic()
+        with self._lock:
+            transition = p.note_miss(
+                now, suspect_after=self.cfg.suspect_after,
+                dead_after=self.cfg.dead_after,
+                backoff_base_s=self.cfg.backoff_base_s)
+            self.counters["heartbeats_missed"] += 1
+        if transition is not None:
+            self.svc.obs.instant("peer_" + transition, cat="fleet",
+                                 track="fleet", peer=p.peer_id,
+                                 streak=p.streak)
+        if transition == "dead":
+            self._adopt_peer(p)
+            self._requeue_forwards(p.peer_id)
+
+    # -- inbound peer protocol (called from gateway/http.py) ---------------
+    def _recv(self, route: str, src: Optional[str]):
+        if self.svc.faults is not None:
+            self.svc.faults.fire("peer_recv", dst=self.self_id,
+                                 src=src or "?", route=route)
+
+    def on_heartbeat(self, body: dict) -> dict:
+        """Inbound heartbeat: a probe FROM a peer proves its liveness
+        as well as ours — record it, absorb its manifest/journal, and
+        answer with our own (bidirectional sync from either side's
+        probe)."""
+        self._recv("heartbeat", body.get("peer_id"))
+        pid = str(body.get("peer_id", ""))
+        if pid and pid != self.self_id:
+            with self._lock:
+                p = self.peers.get(pid)
+                if p is None:
+                    # a configured-elsewhere peer introduced itself:
+                    # admit it (static lists on each side may be
+                    # asymmetric; membership still converges)
+                    url = str(body.get("url") or pid)
+                    p = self.peers[pid] = PeerState(pid, url)
+            self._note_ok(p, body)
+        return self._hello()
+
+    def on_journal(self, body: dict) -> dict:
+        """Inbound journal replica push (the strict-replication path a
+        202 waits on)."""
+        self._recv("journal", body.get("peer_id"))
+        pid = str(body.get("peer_id", ""))
+        with self._lock:
+            p = self.peers.get(pid)
+            if p is None and pid and pid != self.self_id:
+                # a peer we have not met may push its journal before
+                # its first heartbeat lands here: ADMIT it rather than
+                # drop the replica — acking a push we discarded would
+                # fake the sender's strict-replication guarantee
+                # (peer ids default to addresses, so pid doubles as
+                # the url until a heartbeat supplies a better one)
+                p = self.peers[pid] = PeerState(pid, pid)
+            if p is not None:
+                if body.get("epoch") and body["epoch"] != p.epoch:
+                    p.adopted_epoch = None
+                    p.epoch = body["epoch"]
+                # seq-gated: the sender pushes OUTSIDE its journal
+                # mutex, so a slow older push can arrive after a newer
+                # one — storing it would regress the replica and could
+                # lose a durably-accepted id on adoption
+                have = (p.replica or {}).get("seq", -1) \
+                    if (p.replica or {}).get("epoch") \
+                    == body.get("epoch") else -1
+                if int(body.get("seq", 0)) >= have:
+                    p.replica = body
+                p.last_seen = time.monotonic()
+        return {"ok": True, "peer_id": self.self_id}
+
+    def on_execute(self, body: dict):
+        """Inbound routed request: execute locally under the edge's
+        ORIGINAL id.  Idempotent — a retried forward of a known id is
+        acknowledged, not double-queued."""
+        self._recv("execute", body.get("edge"))
+        rid = int(body["id"])
+        state, _ = self.svc.request_state(rid)
+        if state == "ok":
+            return {"ok": True, "request_id": rid, "dedup": True}
+        req = self.svc._submit_local(
+            body.get("func", ""), body.get("args", []),
+            module=body.get("module"),
+            tenant=body.get("tenant", "default"),
+            deadline_s=body.get("deadline_s"),
+            request_id=rid, edge=body.get("edge"))
+        return {"ok": True, "request_id": req.id}
+
+    def on_migrate(self, body: dict):
+        """Inbound lane migration: verify the payload against its
+        content key (hash verification IS the end-to-end integrity
+        check), adopt the blob into the local SwapStore, and park the
+        request as a swapped virtual lane — it reinstalls through the
+        existing jitted column-set pass at a coming boundary."""
+        import base64
+
+        self._recv("migrate", body.get("edge"))
+        entry = body.get("entry") or {}
+        # journal the sender as this request's edge: it keeps the
+        # client-facing future and re-queues on OUR death, so adoption
+        # elsewhere must skip the entry while the sender lives
+        entry.setdefault("edge", body.get("edge"))
+        rid = int(entry["id"])
+        payload = None
+        if body.get("blob_b64"):
+            # hash verification lives in ONE place: SwapStore.adopt
+            # (inside adopt_vlane) checks the payload against its
+            # content key BEFORE any server state moves and raises
+            # SwapCorrupt on mismatch — the sender sees a non-2xx and
+            # keeps its copy
+            payload = base64.b64decode(body["blob_b64"])
+        gen = self.svc.current
+        if gen is None:
+            raise KeyError("no serving generation to migrate onto")
+        fut = gen.server.adopt_vlane(entry, payload)
+        self.svc._wrap_foreign(fut, entry, gen)
+        with self._lock:
+            self.counters["migrations_in"] += 1
+        self.svc.obs.instant("fleet_migrate_in", cat="fleet",
+                             track="fleet", id=rid,
+                             src=body.get("edge"))
+        # the id is ours now: make it durable (and replicated) before
+        # the sender drops its copy on our ack
+        self.svc._journal_sync()
+        return {"ok": True, "request_id": rid}
+
+    def module_bytes(self, sha256: str) -> Optional[bytes]:
+        """Serve a module blob to a peer: the durable store when one
+        is attached, else the in-memory fleet cache."""
+        if self.svc.durable is not None:
+            try:
+                return self.svc.durable.module_bytes(sha256)
+            except OSError:
+                pass
+        return self._module_bytes.get(sha256)
+
+    def note_modules(self, entries):
+        """Keep blob bytes for peer fetches (non-durable gateways have
+        no disk copy to serve from).  `entries` is [(rm, bytes|None)]."""
+        for rm, data in entries:
+            if data is not None and rm.sha256:
+                self._module_bytes[rm.sha256] = bytes(data)
+
+    # -- module replication ------------------------------------------------
+    def _sync_modules(self):
+        """Fetch + register every module a peer advertises that we do
+        not serve.  Content-addressed: the sha verifies the transfer
+        and makes a re-fetch idempotent; same-name/different-sha is a
+        conflict (counted, skipped — first registration wins fleet-wide
+        the same way a duplicate POST /v1/modules 409s)."""
+        with self._lock:
+            wanted = []
+            for p in self.peers.values():
+                if p.state == "dead":
+                    continue
+                for m in p.modules:
+                    wanted.append((p, str(m.get("name", "")),
+                                   str(m.get("sha256", ""))))
+        for p, name, sha in wanted:
+            if not name or not sha:
+                continue
+            have = self.svc.registry.get(name) \
+                if name in self.svc.registry.names else None
+            if have is not None:
+                if have.sha256 != sha:
+                    with self._lock:
+                        self.counters["module_conflicts"] += 1
+                continue
+            try:
+                st, data = self._client.request(
+                    p.peer_id, p.url, "GET",
+                    f"/v1/fleet/modules/{sha}", raw=True)
+                if st != 200:
+                    continue
+                if hashlib.sha256(data).hexdigest() != sha:
+                    continue   # corrupt transfer: the next tick refetches
+                self.svc.register_module(name, wasm_bytes=bytes(data),
+                                         source=f"fleet/{p.peer_id}")
+                with self._lock:
+                    self.counters["modules_synced"] += 1
+                self.svc.obs.instant("fleet_module_sync", cat="fleet",
+                                     track="fleet", module=name,
+                                     src=p.peer_id)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException:
+                continue   # unreachable peer / racing registration:
+            #                the next tick re-evaluates
+
+    # -- journal replication -----------------------------------------------
+    def replicate(self, unresolved, resolved, max_id, strict: bool,
+                  seq: int = 0):
+        """Ship the current journal snapshot to peers.  `strict` (the
+        202 path) must land on >=1 ALIVE peer — total failure raises
+        ReplicationFailed and the acceptance is withdrawn upstream.
+        Non-strict updates are throttled: the snapshot is stashed and
+        pushed by the next tick (resolved-result replication is
+        allowed to lag; adoption re-queues at-least-once either way).
+        `seq` was drawn under the sender's journal mutex — receivers
+        discard older-seq snapshots, so the HTTP here is safe to run
+        outside it."""
+        doc = {"peer_id": self.self_id, "epoch": self.epoch,
+               "seq": int(seq),
+               "max_id": int(max_id),
+               "unresolved": list(unresolved),
+               "resolved": list(resolved)}
+        with self._lock:
+            alive = [p for p in self.peers.values()
+                     if p.state == "alive"]
+            self._repl_doc = doc
+            self._repl_dirty = True
+        if not strict:
+            now = time.monotonic()
+            if now - self._repl_last < self.cfg.replicate_min_interval_s:
+                return
+            self._push_replica()
+            return
+        if not alive:
+            # no alive peer: solo mode — local durability is the whole
+            # story, exactly like the non-federated gateway
+            return
+        ok = 0
+        errs = []
+        for p in alive:
+            if self._send_replica(p, doc):
+                ok += 1
+            else:
+                errs.append(p.peer_id)
+        if ok == 0:
+            with self._lock:
+                self.counters["replication_errors"] += 1
+            raise ReplicationFailed(
+                f"journal replication reached no peer "
+                f"(tried {errs})")
+
+    def _send_replica(self, p: PeerState, doc: dict) -> bool:
+        try:
+            st, _ = self._client.request(p.peer_id, p.url, "POST",
+                                         "/v1/fleet/journal", body=doc)
+            return st == 200
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException:
+            return False
+
+    def _push_replica(self):
+        with self._lock:
+            if not self._repl_dirty or self._repl_doc is None:
+                return
+            doc = self._repl_doc
+            self._repl_dirty = False
+            alive = [p for p in self.peers.values()
+                     if p.state == "alive"]
+        self._repl_last = time.monotonic()
+        for p in alive:
+            self._send_replica(p, doc)
+
+    # -- routing -----------------------------------------------------------
+    def maybe_route(self, func, args, module=None, tenant="default",
+                    deadline_s=None):
+        """Fleet routing for one edge submission.  Returns the
+        GatewayRequest when the fleet handled it (locally under a
+        fleet-allocated id, or forwarded to its owner), or None to let
+        the plain local path run — which is exactly what happens with
+        no peers configured (solo fleets are bit-identical to a
+        non-federated gateway, id sequence included) or with every
+        peer dead (solo fallback)."""
+        if not self.started or not self.remote_available():
+            return None
+        from wasmedge_tpu.serve.queue import _next_request_id
+
+        rid = _next_request_id()
+        owner = rendezvous_owner(rid, self.members())
+        if owner == self.self_id:
+            return self.svc._submit_local(func, args, module=module,
+                                          tenant=tenant,
+                                          deadline_s=deadline_s,
+                                          request_id=rid)
+        with self._lock:
+            p = self.peers.get(owner)
+            if p is not None and p.state == "suspect":
+                self.counters["suspect_rejections"] += 1
+                raise PeerSuspect(owner, rid)
+        return self._forward(p, rid, func, args, module, tenant,
+                             deadline_s)
+
+    def _forward(self, p: PeerState, rid: int, func, args, module,
+                 tenant, deadline_s):
+        """Accept rid at this edge (durable + replicated BEFORE any
+        dispatch), then hand execution to its owner.  An unreachable
+        owner falls back to local execution — at-least-once, never a
+        stranded acceptance."""
+        from wasmedge_tpu.serve.queue import ServeFuture
+
+        svc = self.svc
+        qualified = f"{module}:{func}" if module else func
+        fut = ServeFuture(rid)
+        req = svc._stash_request(fut, tenant, module, qualified,
+                                 args, deadline_s)
+        try:
+            svc._journal_sync(strict_req=req)
+        except BaseException:
+            raise   # withdrawn upstream; the id was never accepted
+        body = {"id": rid, "edge": self.self_id, "module": module,
+                "func": func, "args": [int(a) for a in args],
+                "tenant": tenant, "deadline_s": deadline_s}
+        try:
+            st, doc = self._client.request(p.peer_id, p.url, "POST",
+                                           "/v1/fleet/execute",
+                                           body=body)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException:
+            st, doc = None, None
+        if st == 200 and isinstance(doc, dict) and doc.get("ok"):
+            with self._lock:
+                self._forwards[rid] = _Forward(rid, p.peer_id, req)
+                self.counters["forwards"] += 1
+            svc.obs.instant("fleet_forward", cat="fleet", track="fleet",
+                            id=rid, owner=p.peer_id)
+            return req
+        if st is not None and isinstance(doc, dict) \
+                and isinstance(doc.get("err"), dict):
+            # the owner REFUSED machine-readably (queue saturated,
+            # unknown module, ...): surface its taxonomy to the client
+            # and take the acceptance back — the id never ran anywhere
+            svc._withdraw(req)
+            err = _error_from_payload(st, doc["err"])
+            fut._reject(err)
+            raise err
+        # wire failure: execute locally under the original id instead
+        return self._local_fallback(req)
+
+    def _local_fallback(self, req):
+        """Run a forward-owned request on the local server under its
+        original id (owner unreachable/dead).  At-least-once: the
+        owner MAY also have started it; the client still observes one
+        stable outcome through this (the accepting) gateway."""
+        svc = self.svc
+        gen = svc.current
+        if gen is None:
+            from wasmedge_tpu.serve.queue import ServeRejected
+
+            req.future._reject(ServeRejected(
+                f"request {req.id}: owner unreachable and no local "
+                f"generation to fall back to"))
+            return req
+        try:
+            fut = gen.server.submit(req.func, req.args,
+                                    tenant=req.tenant,
+                                    deadline_s=req.deadline_s,
+                                    request_id=req.id)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            svc._withdraw(req)
+            req.future._reject(e if isinstance(e, WasmError)
+                               else WasmError(ErrCode.ExecutionFailed,
+                                              repr(e)))
+            raise
+        svc._relink_future(req, fut)
+        with self._lock:
+            self.counters["forward_requeues"] += 1
+        return req
+
+    # -- forward relay -----------------------------------------------------
+    def poll_forwards(self):
+        """Resolve outstanding forwarded/migrated requests from their
+        owners' poll route; re-queue the ones whose owner died."""
+        with self._lock:
+            todo = list(self._forwards.values())
+        for fw in todo:
+            if fw.req.future.done:
+                with self._lock:
+                    self._forwards.pop(fw.rid, None)
+                continue
+            with self._lock:
+                p = self.peers.get(fw.owner)
+            if p is None or p.state == "dead":
+                with self._lock:
+                    self._forwards.pop(fw.rid, None)
+                self._local_fallback(fw.req)
+                continue
+            try:
+                # allow_5xx: a 503/504 poll body IS a terminal outcome
+                # (lifecycle/deadline) — only a transport failure or a
+                # bodyless 5xx means "can't tell", and liveness is the
+                # heartbeat's job either way
+                st, doc = self._client.request(
+                    fw.owner, p.url, "GET",
+                    f"/v1/requests/{fw.rid}", allow_5xx=True)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException:
+                continue   # transient; liveness is the heartbeat's job
+            if not isinstance(doc, dict) \
+                    or not ("ok" in doc or "err" in doc) \
+                    or doc.get("status") == "pending":
+                continue
+            if st == 200 and doc.get("ok"):
+                fw.req.future._resolve(
+                    [int(c) for c in doc.get("result", [])])
+            elif st == 404:
+                # the owner does not know the id (it never accepted or
+                # already pruned it): reclaim and run locally
+                with self._lock:
+                    self._forwards.pop(fw.rid, None)
+                self._local_fallback(fw.req)
+                continue
+            else:
+                fw.req.future._reject(
+                    _error_from_payload(st, doc.get("err")))
+            with self._lock:
+                self._forwards.pop(fw.rid, None)
+            self.svc.finalize(fw.req)
+
+    def _requeue_forwards(self, dead_peer: str):
+        """A peer died: every forward it owned re-queues locally under
+        its original id (at-least-once)."""
+        with self._lock:
+            mine = [fw for fw in self._forwards.values()
+                    if fw.owner == dead_peer]
+            for fw in mine:
+                self._forwards.pop(fw.rid, None)
+        for fw in mine:
+            if not fw.req.future.done:
+                self._local_fallback(fw.req)
+
+    # -- failover adoption -------------------------------------------------
+    def _adopt_peer(self, p: PeerState):
+        """A peer was declared dead: adopt its replicated journal.
+        Resolved ids replay exactly-once from the replicated result
+        cache (every survivor replays — replay is locally idempotent
+        and each survivor then answers polls for them); unresolved ids
+        re-queue at-least-once under their ORIGINAL ids on their
+        rendezvous owner among the survivors.  Once per incarnation:
+        a heartbeat flap cannot re-adopt."""
+        with self._lock:
+            if p.adopted_epoch is not None \
+                    and p.adopted_epoch == (p.epoch or ""):
+                return
+            p.adopted_epoch = p.epoch or ""
+            replica = p.replica
+            members = [self.self_id] + [
+                q.peer_id for q in self.peers.values() if q.available()]
+            alive = {q.peer_id for q in self.peers.values()
+                     if q.state == "alive"}
+        if not replica:
+            return
+        svc = self.svc
+        gen = svc.current
+        replayed = adopted = 0
+        for entry in replica.get("resolved", []):
+            svc._install_replay(entry, gen)
+            replayed += 1
+        for entry in replica.get("unresolved", []):
+            rid = int(entry.get("id", 0))
+            edge = entry.get("edge")
+            if edge and edge != p.peer_id and edge in alive:
+                continue   # the accepting edge is alive: it re-queues
+            #                its own forward when it notices the death
+            if rendezvous_owner(rid, members) != self.self_id:
+                continue   # another survivor owns this id
+            svc.adopt_foreign(entry, src=p.peer_id)
+            adopted += 1
+        with self._lock:
+            self.counters["adoptions"] += adopted
+            self.counters["adoptions_replayed"] += replayed
+        if adopted or replayed:
+            svc.obs.instant("fleet_adopt", cat="fleet", track="fleet",
+                            peer=p.peer_id, adopted=adopted,
+                            replayed=replayed)
+            svc._journal_sync()
+
+    # -- migration ---------------------------------------------------------
+    def migrate_out(self, request_id: int, peer_id: str) -> dict:
+        """Ship one PARKED (swapped) virtual lane to `peer_id`: export
+        the SwapStore payload, send it with its content key, and on
+        ack hand the request over to the forward relay (polls answer
+        from this gateway until the peer resolves it).  Any failure
+        re-adopts the lane locally — the request is never lost
+        mid-migration, and a dead receiver just means the lane stays
+        (or re-queues) here."""
+        import base64
+
+        svc = self.svc
+        with self._lock:
+            p = self.peers.get(str(peer_id))
+        if p is None or not p.available():
+            raise KeyError(f"no available peer {peer_id!r}")
+        gen = svc.current
+        if gen is None:
+            raise KeyError("no serving generation")
+        rid = int(request_id)
+        entry, payload = gen.server.export_vlane(rid)
+        body = {"edge": self.self_id, "entry": entry,
+                "blob_b64": base64.b64encode(payload).decode()
+                if payload is not None else None}
+        try:
+            st, doc = self._client.request(p.peer_id, p.url, "POST",
+                                           "/v1/fleet/migrate",
+                                           body=body)
+            ok = st == 200 and isinstance(doc, dict) and doc.get("ok")
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException:
+            ok = False
+        if not ok:
+            # mid-migration failure: the lane never leaves this host —
+            # re-adopt it exactly as exported and let the boundary
+            # rebalance reinstall it.  The re-adopted vlane runs under
+            # a FRESH server future; the client still waits on the one
+            # its 202 was issued against, so bridge the outcome across
+            fut = gen.server.adopt_vlane(entry, payload, requeue=True)
+            req = svc.get_request(rid)
+            if req is not None and fut is not req.future:
+                fut.mirror(req.future)
+            raise PeerUnreachable(p.peer_id,
+                                  f"migration of {rid} not acked")
+        req = svc.get_request(rid)
+        if req is not None and not req.future.done:
+            with self._lock:
+                self._forwards[rid] = _Forward(rid, p.peer_id, req)
+        with self._lock:
+            self.counters["migrations_out"] += 1
+        svc.obs.instant("fleet_migrate_out", cat="fleet", track="fleet",
+                        id=rid, dst=p.peer_id,
+                        nbytes=len(payload) if payload else 0)
+        return {"ok": True, "request_id": rid, "peer": p.peer_id}
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            by_state = {"alive": 0, "suspect": 0, "dead": 0}
+            for p in self.peers.values():
+                by_state[p.state] = by_state.get(p.state, 0) + 1
+            return {
+                "self_id": self.self_id,
+                "epoch": self.epoch,
+                "peers": dict(by_state),
+                "configured_peers": len(self.peers),
+                "forwards_outstanding": len(self._forwards),
+                **self.counters,
+            }

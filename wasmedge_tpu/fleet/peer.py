@@ -1,0 +1,192 @@
+"""Peer transport + liveness state machine for fleet federation.
+
+`PeerClient` is the fleet's entire wire layer: stdlib http.client
+requests against a peer gateway's `/v1/fleet/*` routes, with the
+`peer_send` fault seam fired before every request (testing/faults.py)
+so a test can sever exactly one direction of one link at one moment —
+the deterministic half of a network partition.  Every transport
+failure (injected or real: refused, reset, timeout, non-2xx) surfaces
+as `PeerUnreachable`; callers never see raw socket errors.
+
+`PeerState` is one peer's liveness record driven by the heartbeat
+loop's suspect→dead state machine:
+
+    alive ──(miss)──> alive(streak) ──(streak>=suspect_after)──> suspect
+    suspect ──(streak>=dead_after)──> dead ──(probe succeeds)──> alive
+
+  - probes back off exponentially with the miss streak (base * 2^k,
+    capped), so a dead peer costs one cheap connect attempt per
+    backoff window, not one per heartbeat tick
+  - a successful probe from ANY state returns the peer to `alive` and
+    zeroes the streak — dead is not a terminal state, it is "currently
+    believed gone" (the peer may restart)
+  - each gateway process draws a random `epoch` at boot; a peer that
+    comes back with a NEW epoch is a fresh incarnation (its journal
+    was resumed from disk, adoption bookkeeping resets)
+
+The `dead` transition is the fleet's failover trigger: the federation
+controller adopts the dead peer's replicated journal exactly once per
+incarnation (fleet/federation.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional, Tuple
+
+# liveness state machine defaults (overridable via FleetConfig)
+SUSPECT_AFTER = 2      # consecutive missed probes -> suspect
+DEAD_AFTER = 4         # consecutive missed probes -> dead
+BACKOFF_BASE_S = 0.05  # probe backoff: base * 2^streak, capped
+BACKOFF_CAP_S = 2.0
+
+
+class PeerUnreachable(RuntimeError):
+    """A peer request failed at the transport layer (connect/read
+    error, injected partition fault, or a non-2xx fleet response).
+    The liveness state machine consumes these; they never escape to a
+    client-facing route."""
+
+    def __init__(self, peer: str, reason: str):
+        super().__init__(f"peer {peer} unreachable: {reason}")
+        self.peer = peer
+        self.reason = reason
+
+
+class PeerClient:
+    """Minimal HTTP client for the peer protocol.  One instance per
+    federation controller; stateless between calls (a fresh connection
+    per request — peer traffic is low-rate control plane, and a cached
+    connection would turn one partition into a poisoned socket)."""
+
+    def __init__(self, self_id: str, faults=None, timeout_s: float = 10.0):
+        self.self_id = self_id
+        self.faults = faults
+        self.timeout_s = float(timeout_s)
+
+    def _fire(self, point: str, **ctx):
+        if self.faults is not None:
+            self.faults.fire(point, **ctx)
+
+    def request(self, peer_id: str, url: str, method: str, path: str,
+                body: Optional[dict] = None,
+                raw: bool = False,
+                allow_5xx: bool = False) -> Tuple[int, object]:
+        """One peer HTTP round trip.  `url` is "host:port".  Returns
+        (status, parsed-JSON) — or (status, bytes) with `raw=True`.
+        Raises PeerUnreachable on ANY transport failure, including an
+        injected `peer_send` fault (the deterministic severed link).
+        A >=500 response counts as unreachable too (a peer_recv fault
+        surfaces as one) UNLESS `allow_5xx` — the forward relay polls
+        /v1/requests/<id>, where 503/504 bodies ARE the terminal
+        outcome (deadline/lifecycle classes) and must reach the
+        caller, not be mistaken for a dead peer."""
+        import http.client
+
+        route = path.strip("/").split("/")[-1].split("?")[0]
+        if path.startswith("/v1/fleet/modules/"):
+            route = "modules"
+        elif path.startswith("/v1/requests/"):
+            route = "requests"
+        try:
+            self._fire("peer_send", src=self.self_id, dst=peer_id,
+                       route=route)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            raise PeerUnreachable(peer_id, f"injected: {e}") from e
+        host, _, port = url.rpartition(":")
+        try:
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=self.timeout_s)
+            try:
+                data = None
+                headers = {"X-Fleet-Peer": self.self_id}
+                if body is not None:
+                    data = json.dumps(body).encode()
+                    headers["Content-Type"] = "application/json"
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+            finally:
+                conn.close()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            raise PeerUnreachable(peer_id, repr(e)) from e
+        if resp.status >= 500 and not allow_5xx:
+            raise PeerUnreachable(peer_id,
+                                  f"HTTP {resp.status} on {path}")
+        if raw:
+            return resp.status, payload
+        try:
+            return resp.status, json.loads(payload) if payload else {}
+        except ValueError as e:
+            raise PeerUnreachable(peer_id,
+                                  f"bad JSON from {path}: {e}") from e
+
+
+class PeerState:
+    """One peer's liveness + replication record."""
+
+    __slots__ = ("peer_id", "url", "state", "streak", "last_seen",
+                 "next_probe", "epoch", "replica", "adopted_epoch",
+                 "modules", "transitions")
+
+    def __init__(self, peer_id: str, url: str):
+        self.peer_id = peer_id
+        self.url = url                 # "host:port"
+        self.state = "alive"           # optimistic until proven missing
+        self.streak = 0                # consecutive missed probes
+        self.last_seen = -1.0
+        self.next_probe = 0.0          # monotonic gate (backoff)
+        self.epoch: Optional[str] = None
+        self.replica: Optional[dict] = None   # last journal snapshot
+        self.adopted_epoch: Optional[str] = None
+        self.modules: list = []        # last manifest [{name, sha256}]
+        self.transitions = 0           # state changes (flap visibility)
+
+    def available(self) -> bool:
+        """Routable: requests may be owned by (and forwarded to) this
+        peer.  Suspect peers stay in the membership view so routing is
+        stable across a flap — but a submit routed to one is refused
+        retryably (fleet/federation.py PeerSuspect) rather than
+        forwarded into a probable black hole."""
+        return self.state != "dead"
+
+    def note_ok(self, now: float, epoch: Optional[str]) -> bool:
+        """Record a successful probe; returns True when the peer came
+        back as a NEW incarnation (fresh epoch — reset adoption)."""
+        fresh = epoch is not None and self.epoch is not None \
+            and epoch != self.epoch
+        if self.state != "alive":
+            self.transitions += 1
+        self.state = "alive"
+        self.streak = 0
+        self.last_seen = now
+        self.next_probe = now
+        if epoch is not None:
+            self.epoch = epoch
+        return fresh
+
+    def note_miss(self, now: float, suspect_after: int = SUSPECT_AFTER,
+                  dead_after: int = DEAD_AFTER,
+                  backoff_base_s: float = BACKOFF_BASE_S) -> Optional[str]:
+        """Record a missed probe; advances the state machine and arms
+        the exponential probe backoff.  Returns the NEW state when this
+        miss caused a transition (the "dead" return is the federation
+        controller's adoption trigger), else None."""
+        self.streak += 1
+        self.next_probe = now + min(
+            backoff_base_s * (2 ** min(self.streak, 16)), BACKOFF_CAP_S)
+        new = None
+        if self.streak >= dead_after:
+            new = "dead"
+        elif self.streak >= suspect_after:
+            new = "suspect"
+        if new is not None and new != self.state:
+            self.state = new
+            self.transitions += 1
+            return new
+        return None

@@ -1,0 +1,48 @@
+"""Consistent request routing across fleet peers.
+
+Rendezvous (highest-random-weight) hashing: every peer scores each
+routing key as sha256(peer_id | key) and the highest score owns the
+key.  Properties the failover design leans on:
+
+  - deterministic: every peer computes the SAME owner from the same
+    membership view, with no coordination and no shared state
+  - minimal churn: when a peer dies, only the keys it owned move (each
+    to its runner-up peer) — survivors' keys never reshuffle, so a
+    peer death re-routes exactly the dead peer's share of traffic
+  - no ring state: membership is just the set of peer ids; a one-entry
+    set trivially routes everything to self (solo mode falls out for
+    free)
+
+Keys are request ids (one request = one owner) so adoption after a
+peer death can deterministically partition the dead peer's journal
+among survivors: every survivor adopts exactly the ids it now owns,
+and no id is adopted twice or by nobody.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List, Optional
+
+
+def _score(peer_id: str, key: str) -> bytes:
+    return hashlib.sha256(f"{peer_id}|{key}".encode()).digest()
+
+
+def rendezvous_owner(key, peer_ids: Iterable[str]) -> Optional[str]:
+    """The peer that owns `key` under rendezvous hashing, or None for
+    an empty membership."""
+    best = None
+    best_score = b""
+    for pid in peer_ids:
+        s = _score(pid, str(key))
+        if best is None or s > best_score:
+            best, best_score = pid, s
+    return best
+
+
+def rendezvous_ranked(key, peer_ids: Iterable[str]) -> List[str]:
+    """Full preference order for `key` (owner first) — the runner-up
+    is the failover target when the owner is down."""
+    return sorted(peer_ids, key=lambda pid: _score(pid, str(key)),
+                  reverse=True)

@@ -1,4 +1,5 @@
-"""Network-facing multi-tenant serving gateway (r11, durable r13).
+"""Network-facing multi-tenant serving gateway (r11, durable r13,
+federated r16).
 
 The front door of the "millions of users" story: a stdlib HTTP server
 (gateway/http.py) over a generation-swapped fleet of BatchServers
@@ -6,8 +7,11 @@ The front door of the "millions of users" story: a stdlib HTTP server
 the full loader -> validator -> image pipeline (gateway/registry.py),
 per-tenant auth/rate/quota edge policy (gateway/tenants.py),
 crash/restart durability over an on-disk module store + async-request
-journal (gateway/durable.py), and truthful health + degraded-mode load
-shedding (gateway/health.py).
+journal (gateway/durable.py), truthful health + degraded-mode load
+shedding (gateway/health.py), and multi-host fleet federation —
+peer-replicated module store, journal-replicated failover, cross-host
+lane migration (wasmedge_tpu/fleet/; `GatewayService(fleet=[...])` or
+CLI `--peer host:port`).
 
     from wasmedge_tpu.gateway import Gateway, GatewayService
 

@@ -177,14 +177,18 @@ class DurableStore:
         self._write(self._manifest, "manifest", doc)
 
     def write_journal(self, unresolved: List[dict],
-                      resolved: List[dict], max_id: int = 0):
+                      resolved: List[dict], max_id: int = 0,
+                      min_id: int = 0):
         """Persist the request journal: every accepted-but-unresolved
         id, the bounded durable result cache (newest last; the depth
         cap is applied here so the on-disk cache can never outgrow the
-        knob), and the highest id ever issued (`max_id`) — the resumed
-        process's pruned-vs-never-issued floor."""
+        knob), and the id RANGE ever issued (`min_id`/`max_id`) — the
+        resumed process's pruned-vs-never-issued window (min_id
+        matters since r16: fleet id-space rebasing means a gateway's
+        ids need not start anywhere near 1)."""
         doc = {"format": FORMAT_VERSION,
                "max_id": int(max_id),
+               "min_id": int(min_id),
                "unresolved": list(unresolved),
                "resolved": list(resolved)[-self.result_cache:]}
         self._write(self._journal, "journal", doc)

@@ -133,6 +133,25 @@ def health_of(svc) -> dict:
             streak == 0, "degraded",
             f"durable journal writes failing (streak {streak}, "
             f"total {bad})" if streak else "durable journal writing")
+    fleet = getattr(svc, "fleet", None)
+    if fleet is not None and fleet.started:
+        snap = fleet.stats()
+        peers = snap.get("peers", {})
+        total = int(snap.get("configured_peers", 0))
+        if total:
+            # fleet capacity view: a suspect/dead peer is lost
+            # aggregate capacity — DEGRADED, which (with shedding on)
+            # sheds the lowest weight tier fleet-wide until the peer
+            # recovers or its load is adopted.  A fleet with no peers
+            # configured adds NO check at all: solo mode must look
+            # exactly like the non-federated gateway.
+            missing = int(peers.get("suspect", 0)) \
+                + int(peers.get("dead", 0))
+            checks["fleet"] = _check(
+                missing == 0, "degraded",
+                f"{peers.get('alive', 0)}/{total} peers alive "
+                f"({peers.get('suspect', 0)} suspect, "
+                f"{peers.get('dead', 0)} dead)")
     if getattr(svc, "force_degraded", False):
         checks["forced"] = _check(False, "degraded",
                                   "operator forced degraded mode")

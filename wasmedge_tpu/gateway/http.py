@@ -285,6 +285,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 return self._reply(200 if h["ok"] else 503, h)
             if url.path.startswith("/v1/requests/"):
                 return self._get_request(url.path)
+            if url.path.startswith("/v1/fleet/"):
+                return self._fleet_get(url.path)
             return self._reply(404, {"ok": False, "err": {
                 "name": "NotFound", "retryable": False,
                 "message": f"no route {url.path}"}})
@@ -298,11 +300,68 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 return self._invoke(url)
             if url.path == "/v1/modules":
                 return self._register(url)
+            if url.path.startswith("/v1/fleet/"):
+                return self._fleet_post(url.path)
             return self._reply(404, {"ok": False, "err": {
                 "name": "NotFound", "retryable": False,
                 "message": f"no route {url.path}"}})
         except Exception as e:
             return self._reject(e)
+
+    # -- peer protocol (wasmedge_tpu/fleet/, r16) --------------------------
+    # Operator/peer control plane: no tenant auth (like /healthz), and
+    # every handler fires the `peer_recv` fault seam so a test can
+    # sever exactly the inbound half of one link (an injected fault
+    # surfaces as a 5xx the SENDING peer counts as unreachable).
+    def _fleet(self):
+        fl = self.svc.fleet
+        if fl is None:
+            raise KeyError("fleet federation is not enabled")
+        return fl
+
+    def _fleet_get(self, path: str):
+        fl = self._fleet()
+        if path.startswith("/v1/fleet/modules/"):
+            sha = path.rsplit("/", 1)[1]
+            fl._recv("modules", self.headers.get("X-Fleet-Peer"))
+            data = fl.module_bytes(sha)
+            if data is None:
+                raise KeyError(f"no module blob {sha[:12]}")
+            return self._reply(200, data,
+                               content_type="application/wasm")
+        if path == "/v1/fleet/manifest":
+            fl._recv("manifest", self.headers.get("X-Fleet-Peer"))
+            return self._reply(200, fl._hello())
+        if path == "/v1/fleet/status":
+            return self._reply(200, dict(
+                fl.stats(), peer_states=fl.peer_states(),
+                swapped=[int(x) for x in
+                         (self.svc.current.server.list_swapped()
+                          if self.svc.current else [])]))
+        raise KeyError(f"no fleet route {path}")
+
+    def _fleet_post(self, path: str):
+        import json as _json
+
+        fl = self._fleet()
+        body = self._read_body()
+        try:
+            doc = _json.loads(body or b"{}")
+        except _json.JSONDecodeError as e:
+            raise ValueError(f"malformed JSON body: {e}") from e
+        if path == "/v1/fleet/heartbeat":
+            return self._reply(200, fl.on_heartbeat(doc))
+        if path == "/v1/fleet/journal":
+            return self._reply(200, fl.on_journal(doc))
+        if path == "/v1/fleet/execute":
+            return self._reply(200, fl.on_execute(doc))
+        if path == "/v1/fleet/migrate":
+            return self._reply(200, fl.on_migrate(doc))
+        if path == "/v1/fleet/migrate_out":
+            # operator/bench trigger: ship one parked virtual lane
+            return self._reply(200, fl.migrate_out(
+                int(doc["id"]), str(doc["peer"])))
+        raise KeyError(f"no fleet route {path}")
 
     # -- handlers ----------------------------------------------------------
     def _invoke(self, url):
@@ -437,6 +496,10 @@ class Gateway:
                 kwargs={"poll_interval": 0.05},
                 name=f"wasmedge-gateway:{self.port}", daemon=True)
             self._thread.start()
+        if self.service.fleet is not None:
+            # the fleet identity is the LISTENING address — known only
+            # now that the socket is bound
+            self.service.fleet.start(self.host, self.port)
         return self
 
     def shutdown(self, drain: bool = True,

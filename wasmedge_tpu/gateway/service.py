@@ -128,10 +128,10 @@ class GatewayRequest:
     journal — a re-queued request must be re-executable verbatim."""
 
     __slots__ = ("id", "tenant", "module", "func", "future", "t_recv",
-                 "gen_id", "finalized", "args", "deadline_s")
+                 "gen_id", "finalized", "args", "deadline_s", "edge")
 
     def __init__(self, future, tenant, module, func, gen_id, t_recv,
-                 args=(), deadline_s=None):
+                 args=(), deadline_s=None, edge=None):
         self.id = future.request_id
         self.future = future
         self.tenant = tenant
@@ -142,6 +142,11 @@ class GatewayRequest:
         self.finalized = False
         self.args = tuple(int(a) for a in args)
         self.deadline_s = deadline_s
+        # fleet routing: the peer that ACCEPTED this request (its 202
+        # came from there) when it differs from the executing gateway —
+        # journaled so failover adoption can tell "the edge re-queues
+        # its own forward" from "nobody is left to re-queue this"
+        self.edge = edge
 
 
 class _Generation:
@@ -172,7 +177,8 @@ class GatewayService:
                  resume: bool = False,
                  build_timeout_s: Optional[float] = 120.0,
                  shed_on_degraded: bool = True,
-                 devices=None):
+                 devices=None,
+                 fleet=None):
         from wasmedge_tpu.common.configure import Configure
         from wasmedge_tpu.obs.recorder import recorder_of
 
@@ -238,10 +244,26 @@ class GatewayService:
         # never land a NEWER sequence number (which would make it the
         # authoritative journal and lose a durably-accepted id)
         self._journal_mutex = threading.Lock()
+        # replication sequence (drawn under _journal_mutex): stamps
+        # fleet journal pushes so a receiver can discard an older
+        # snapshot that arrives after a newer one — which frees the
+        # peer HTTP to run OUTSIDE the mutex
+        self._repl_seq = 0
         # ids at/below this were issued by a pre-crash process: an
         # unknown id under the floor answers the pruned 404 detail,
         # not "never existed" (journaled as max_id)
         self._resume_floor = 0
+        # id range THIS gateway ever stashed — journaled as
+        # min_id/max_id, the resumed process's pruned-404 window.
+        # Deliberately not the process-global counter: the fleet's
+        # id-space rebase (and any sibling gateway in-process) pushes
+        # the global high-water far past ids this gateway issued —
+        # journaling the global counter (or assuming ids start near 1)
+        # would make a resumed gateway answer the pruned 404 for ids
+        # it never accepted
+        self._max_issued = 0
+        self._min_issued = 0   # 0 = nothing issued yet
+        self._resume_min = 1   # legacy journals: ids start near 1
         # pending serve-lineage adoption consumed by the next
         # generation build (set only during _resume_from_disk)
         self._pending_resume: Optional[str] = None
@@ -249,6 +271,19 @@ class GatewayService:
             state_dir, faults=faults,
             result_cache=self._durable_cache_depth) \
             if state_dir else None
+        # fleet federation (wasmedge_tpu/fleet/, r16): `fleet` is a
+        # FleetConfig or a plain list of "host:port" peers.  The
+        # controller starts when the HTTP layer binds (Gateway.start
+        # knows the port); a fleet with no peers is inert — the submit
+        # path and id sequence stay bit-identical to a non-federated
+        # gateway.
+        self.fleet = None
+        if fleet is not None:
+            from wasmedge_tpu.fleet import FleetConfig, FleetController
+
+            cfg = fleet if isinstance(fleet, FleetConfig) \
+                else FleetConfig(peers=list(fleet))
+            self.fleet = FleetController(self, cfg)
         self._health = HealthGate(self)
         if resume:
             if self.durable is None:
@@ -507,6 +542,11 @@ class GatewayService:
             self.last_swap = {"ok": True, "generation": gen.gen_id,
                               "error": None, "t": time.monotonic()}
             durable_ok = self._persist_registration(added, gen)
+            if self.fleet is not None:
+                # keep blob bytes servable to peers (non-durable
+                # gateways have no disk copy to answer
+                # GET /v1/fleet/modules/<sha> from)
+                self.fleet.note_modules(added)
         with self._lock:
             self.counters["registered_modules"] += len(added)
         last = added[-1][0]
@@ -575,19 +615,44 @@ class GatewayService:
         self._manifest_dirty = False
 
     def _journal_snapshot(self):
-        from wasmedge_tpu.serve.queue import peek_request_ids
-
         with self._lock:
-            unresolved = [
-                {"id": r.id, "tenant": r.tenant, "module": r.module,
-                 "func": r.func, "args": list(r.args),
-                 "deadline_s": r.deadline_s}
-                for r in self._requests.values()
-                if not r.future.done]
+            unresolved = []
+            for r in self._requests.values():
+                if r.future.done:
+                    continue
+                entry = {"id": r.id, "tenant": r.tenant,
+                         "module": r.module, "func": r.func,
+                         "args": list(r.args),
+                         "deadline_s": r.deadline_s}
+                if r.edge:
+                    entry["edge"] = r.edge
+                unresolved.append(entry)
             resolved = list(self._result_cache)
-            max_id = max([self._resume_floor, peek_request_ids()]
+            # a resolved-but-not-yet-finalized async id (nobody polled
+            # it HERE — its client may be polling a fleet peer) must
+            # not vanish from the journal: it is no longer unresolved,
+            # and without its outcome in the resolved cache a peer
+            # adopting this journal after our death would answer 404
+            # for an id we actually completed.  Include the outcome
+            # inline; finalize() later re-appends it to the capped
+            # cache idempotently (replay installs guard by id).
+            seen = {e.get("id") for e in resolved}
+            for r in self._requests.values():
+                if r.future.done and not r.finalized \
+                        and r.id not in seen:
+                    try:
+                        resolved.append(_resolved_entry(r))
+                    except Exception:
+                        pass
+            max_id = max([self._resume_floor, self._max_issued]
                          + [r.id for r in self._requests.values()])
-        return unresolved, resolved, max_id
+            # lower edge of the pruned-404 window: the smallest id
+            # this gateway (or the lineage it resumed) ever issued
+            mins = [self._min_issued]
+            if self._resume_floor:
+                mins.append(self._resume_min)
+            min_id = min([m for m in mins if m] or [0])
+        return unresolved, resolved, max_id, min_id
 
     def _journal_sync(self, strict_req: Optional[GatewayRequest] = None):
         """Write the request journal (and a dirty manifest, if one is
@@ -603,17 +668,37 @@ class GatewayService:
         store's sequence numbers in the other, making an OLDER
         snapshot the authoritative (newest) journal and losing a
         durably-accepted id across a crash."""
-        if self.durable is None:
+        fleet = self.fleet if self.fleet is not None \
+            and self.fleet.started else None
+        if self.durable is None and fleet is None:
             return
         try:
             with self._journal_mutex:
-                unresolved, resolved, max_id = self._journal_snapshot()
-                if self._manifest_dirty:
-                    cur = self.current
-                    if cur is not None:
-                        self._write_manifest(cur)
-                self.durable.write_journal(unresolved, resolved,
-                                           max_id=max_id)
+                unresolved, resolved, max_id, min_id = \
+                    self._journal_snapshot()
+                if self.durable is not None:
+                    if self._manifest_dirty:
+                        cur = self.current
+                        if cur is not None:
+                            self._write_manifest(cur)
+                    self.durable.write_journal(unresolved, resolved,
+                                               max_id=max_id,
+                                               min_id=min_id)
+                self._repl_seq += 1
+                seq = self._repl_seq
+            if fleet is not None:
+                # cross-host durability: a STRICT sync (the 202 path)
+                # must land the snapshot on >=1 alive peer — total
+                # failure raises and the acceptance is withdrawn
+                # below, exactly like a failed local journal write.
+                # The peer HTTP happens OUTSIDE _journal_mutex (one
+                # slow peer must not stall every accept behind the
+                # mutex); `seq` — drawn under the mutex, so ordered
+                # like the disk writes — lets receivers discard an
+                # out-of-order older snapshot (fleet on_journal)
+                fleet.replicate(unresolved, resolved, max_id,
+                                strict=strict_req is not None,
+                                seq=seq)
             with self._lock:
                 self._journal_fail_streak = 0
         except (KeyboardInterrupt, SystemExit):
@@ -689,6 +774,7 @@ class GatewayService:
         from wasmedge_tpu.serve.queue import advance_request_ids
 
         floor = int(journal.get("max_id", 0))
+        self._resume_min = max(int(journal.get("min_id", 0) or 1), 1)
         if floor:
             # every id at/below the floor was issued by a dead
             # process: unknown ones answer the pruned 404 detail, and
@@ -749,6 +835,7 @@ class GatewayService:
                                  deadline_s=entry.get("deadline_s"))
             with self._lock:
                 self._requests[req.id] = req
+                self._note_issued(req.id)
                 self.counters["received"] += 1
                 self.counters["resumed"] += 1
         # adopted serve-checkpoint requests the journal missed (a
@@ -766,6 +853,7 @@ class GatewayService:
                 args=(sr.args if sr else ()))
             with self._lock:
                 self._requests[req.id] = req
+                self._note_issued(req.id)
                 self.counters["received"] += 1
                 self.counters["resumed"] += 1
 
@@ -790,6 +878,7 @@ class GatewayService:
         req.finalized = True
         with self._lock:
             self._requests[rid] = req
+            self._note_issued(rid)
             self._resolved.append(rid)
 
     # -- requests ----------------------------------------------------------
@@ -820,6 +909,38 @@ class GatewayService:
             self.obs.instant("shed", cat="gateway", track="gateway",
                              tenant=tenant)
             raise
+        if self.fleet is not None and self.fleet.started:
+            # consistent fleet routing (rendezvous hash on the request
+            # id): the owner executes; a suspect owner refuses
+            # retryably; no remote available falls through to the
+            # plain local path (solo fallback, bit-identical)
+            try:
+                routed = self.fleet.maybe_route(
+                    func, args, module=module, tenant=tenant,
+                    deadline_s=deadline_s)
+            except WasmError:
+                with self._lock:
+                    self.counters["rejected"] += 1
+                raise
+            if routed is not None:
+                self.obs.instant("gateway_receive", cat="gateway",
+                                 track="gateway", id=routed.id,
+                                 tenant=tenant, func=routed.func)
+                return routed
+        return self._submit_local(func, args, module=module,
+                                  tenant=tenant, deadline_s=deadline_s)
+
+    def _submit_local(self, func: str, args,
+                      module: Optional[str] = None,
+                      tenant: str = "default",
+                      deadline_s: Optional[float] = None,
+                      request_id: Optional[int] = None,
+                      edge: Optional[str] = None) -> GatewayRequest:
+        """Queue on the LOCAL serving generation (edge policy already
+        applied by submit(); the fleet's execute route calls this
+        directly — the edge peer enforced its own policy before
+        forwarding).  `request_id` submits under a fleet-allocated or
+        forwarded ORIGINAL id; `edge` journals the accepting peer."""
         with self._lock:
             if self._closed:
                 raise GatewayClosed()
@@ -831,7 +952,8 @@ class GatewayService:
         while True:
             try:
                 fut = gen.server.submit(qualified, args, tenant=tenant,
-                                        deadline_s=deadline_s)
+                                        deadline_s=deadline_s,
+                                        request_id=request_id)
                 break
             except WasmError:
                 # a submit can race a generation swap: the generation
@@ -859,10 +981,12 @@ class GatewayService:
                     self.counters["rejected"] += 1
                 raise
         req = GatewayRequest(fut, tenant, module, qualified, gen.gen_id,
-                             t_recv, args=args, deadline_s=deadline_s)
+                             t_recv, args=args, deadline_s=deadline_s,
+                             edge=edge)
         with self._lock:
             self.counters["received"] += 1
             self._requests[req.id] = req
+            self._note_issued(req.id)
         # the acceptance is not real until it is durable: a journal
         # write failure rejects THIS request retryably (the id was
         # never handed out, so a restart owes nothing for it)
@@ -870,6 +994,94 @@ class GatewayService:
         self.obs.instant("gateway_receive", cat="gateway",
                          track="gateway", id=req.id, tenant=tenant,
                          func=qualified)
+        return req
+
+    def _note_issued(self, rid: int):
+        """Track the id range this gateway has stashed (callers hold
+        self._lock); journaled so the resumed pruned-404 window is
+        exactly [min_id, max_id], not 'everything below the counter'."""
+        rid = int(rid)
+        self._max_issued = max(self._max_issued, rid)
+        if self._min_issued == 0 or rid < self._min_issued:
+            self._min_issued = rid
+
+    # -- fleet seams (wasmedge_tpu/fleet/federation.py) --------------------
+    def _stash_request(self, fut, tenant, module, qualified, args,
+                       deadline_s, edge=None) -> GatewayRequest:
+        """Register an acceptance whose EXECUTION lives elsewhere (a
+        forwarded request): same stash/counters as a local submit, no
+        server involvement."""
+        req = GatewayRequest(fut, tenant, module, qualified,
+                             self.generation, time.monotonic(),
+                             args=args, deadline_s=deadline_s,
+                             edge=edge)
+        with self._lock:
+            self.counters["received"] += 1
+            self._requests[req.id] = req
+            self._note_issued(req.id)
+        return req
+
+    def _relink_future(self, req: GatewayRequest, fut):
+        """Bridge a fresh server future into the future the client's
+        202 was issued against (fleet local-fallback: the re-queued
+        request resolves the ORIGINAL handle)."""
+        fut.mirror(req.future)
+
+    def _wrap_foreign(self, fut, entry: dict, gen) -> GatewayRequest:
+        """Stash a request adopted from a peer (migration/execute):
+        polls against THIS gateway answer for it from now on."""
+        req = GatewayRequest(fut, entry.get("tenant", "default"), None,
+                             entry.get("func", ""),
+                             gen.gen_id if gen else 0,
+                             time.monotonic(),
+                             args=tuple(entry.get("args", ())),
+                             deadline_s=entry.get("deadline_s"),
+                             edge=entry.get("edge"))
+        with self._lock:
+            if req.id in self._requests:
+                return self._requests[req.id]
+            self.counters["received"] += 1
+            self._requests[req.id] = req
+            self._note_issued(req.id)
+        return req
+
+    def adopt_foreign(self, entry: dict, src: str = "") -> GatewayRequest:
+        """Failover adoption of one unresolved journal entry from a
+        DEAD peer: re-queue under the ORIGINAL id (at-least-once — the
+        dead peer may have partially run it).  Unservable entries
+        reject machine-readably; an id is never silently lost."""
+        from wasmedge_tpu.serve.queue import (
+            ServeFuture,
+            ServeRejected,
+            advance_request_ids,
+        )
+
+        rid = int(entry["id"])
+        with self._lock:
+            if rid in self._requests:
+                return self._requests[rid]
+        gen = self.current
+        fut = None
+        if gen is not None:
+            try:
+                fut = gen.server.submit(
+                    entry.get("func", ""), entry.get("args", []),
+                    tenant=entry.get("tenant", "default"),
+                    deadline_s=entry.get("deadline_s"),
+                    request_id=rid)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                fut = None
+        if fut is None:
+            fut = ServeFuture(rid)
+            fut._reject(ServeRejected(
+                f"request {rid} adopted from dead peer {src!r} could "
+                f"not be re-queued"))
+            advance_request_ids(rid)
+        req = self._wrap_foreign(fut, entry, gen)
+        with self._lock:
+            self.counters["resumed"] += 1
         return req
 
     def get_request(self, request_id: int) -> Optional[GatewayRequest]:
@@ -888,11 +1100,15 @@ class GatewayService:
         rid = int(request_id)
         with self._lock:
             req = self._requests.get(rid)
-            # ids under the resume floor were issued by a pre-crash
-            # process: anything unknown there has aged out, it did not
-            # "never exist"
-            pruned = req is None and (rid in self._pruned_set
-                                      or 0 < rid <= self._resume_floor)
+            # ids inside the resumed [min_id, max_id] window were
+            # issued by a pre-crash process: anything unknown there
+            # has aged out, it did not "never exist".  The window has
+            # a LOWER edge too — fleet id-space rebasing means ids do
+            # not start near 1, and an id below everything this
+            # lineage ever issued really is unknown
+            pruned = req is None and (
+                rid in self._pruned_set
+                or self._resume_min <= rid <= self._resume_floor)
         if req is not None:
             self.finalize(req)
             return "ok", req
@@ -930,7 +1146,11 @@ class GatewayService:
                 self.counters["deadline"] += 1
             else:
                 self.counters["failed"] += 1
-            if self.durable is not None:
+            if self.durable is not None or self.fleet is not None:
+                # the durable result cache also feeds the FLEET's
+                # replicated journal: peers replay these exactly-once
+                # when this gateway dies, so fleet-only (no state_dir)
+                # gateways populate it too
                 try:
                     self._result_cache.append(_resolved_entry(req))
                 except Exception:
@@ -1004,6 +1224,9 @@ class GatewayService:
                 out["queue_depth"] = len(gen.server.queue)
                 out["in_flight"] = gen.server.in_flight
                 out["serve"] = dict(gen.server.counters)
+        if self.fleet is not None:
+            out["fleet"] = dict(self.fleet.stats(),
+                                peer_states=self.fleet.peer_states())
         if gen is not None:
             # resident/virtual occupancy (lane virtualization, hv/) —
             # absent when the gateway runs without oversubscription
@@ -1031,7 +1254,9 @@ class GatewayService:
             analysis_counts=dict(self.analysis_counts),
             gateway_counts=gateway_counts,
             shed_counts=shed_counts,
-            hv_stats=gen.server.hv_stats() if gen else None)
+            hv_stats=gen.server.hv_stats() if gen else None,
+            fleet_stats=self.fleet.stats()
+            if self.fleet is not None else None)
 
     # -- lifecycle ---------------------------------------------------------
     def shutdown(self, drain: bool = True,
@@ -1045,6 +1270,8 @@ class GatewayService:
             with self._lock:
                 self._closed = True
                 gens = list(self._gens)
+        if self.fleet is not None:
+            self.fleet.stop()
         for g in gens:
             g.server.shutdown(drain=drain, timeout_s=timeout_s)
         for t in self._reapers:
@@ -1062,6 +1289,11 @@ class GatewayService:
         are closed (a real dead process drops them too)."""
         with self._lock:
             self._closed = True   # later registrations see it and stop
+        if self.fleet is not None:
+            # a killed process's heartbeats just STOP (no goodbye, no
+            # final replication) — peers discover the death the honest
+            # way, through the suspect→dead state machine
+            self.fleet.stop()
         with self._reg_lock:
             pass   # let an in-flight registration's swap finish or fail
         with self._lock:

@@ -59,7 +59,8 @@ class _Writer:
 def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                       failures=None, http_requests=None,
                       analysis_counts=None, gateway_counts=None,
-                      shed_counts=None, hv_stats=None) -> str:
+                      shed_counts=None, hv_stats=None,
+                      fleet_stats=None) -> str:
     """Render one metrics snapshot.  All sources optional: `recorder` a
     FlightRecorder, `stats` a common.statistics.Statistics, `hostcall_stats`
     an engine's pipeline counter dict, `failures` extra FailureRecords
@@ -70,8 +71,32 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
     `gateway_counts` the gateway's durability/robustness counters
     ({"restarts": n, "rollbacks": n}), `shed_counts` the per-tenant
     degraded-mode shed tally, `hv_stats` a BatchServer.hv_stats()
-    lane-virtualization snapshot (wasmedge_tpu/hv/)."""
+    lane-virtualization snapshot (wasmedge_tpu/hv/), `fleet_stats` a
+    FleetController.stats() federation snapshot (wasmedge_tpu/fleet/)."""
     w = _Writer()
+
+    if fleet_stats:
+        w.head("wasmedge_fleet_peers", "gauge",
+               "Fleet peers by liveness state (wasmedge_tpu/fleet/: "
+               "heartbeat-driven suspect->dead state machine).")
+        peers = fleet_stats.get("peers", {})
+        for state in ("alive", "suspect", "dead"):
+            w.sample("wasmedge_fleet_peers", {"state": state},
+                     int(peers.get(state, 0)))
+        w.head("wasmedge_fleet_migrations_total", "counter",
+               "Cross-host lane migrations (out = parked vlane "
+               "shipped to a peer, in = adopted from one; SwapStore "
+               "payloads hash-verified end to end).")
+        w.sample("wasmedge_fleet_migrations_total", {"direction": "out"},
+                 int(fleet_stats.get("migrations_out", 0)))
+        w.sample("wasmedge_fleet_migrations_total", {"direction": "in"},
+                 int(fleet_stats.get("migrations_in", 0)))
+        w.head("wasmedge_fleet_adoptions_total", "counter",
+               "Unresolved requests adopted from dead peers' "
+               "replicated journals (re-queued at-least-once under "
+               "their original ids).")
+        w.sample("wasmedge_fleet_adoptions_total", None,
+                 int(fleet_stats.get("adoptions", 0)))
 
     if hv_stats:
         w.head("wasmedge_hv_swaps_total", "counter",
@@ -285,7 +310,7 @@ def export_prometheus(path, recorder=None, stats=None,
                       hostcall_stats=None, failures=None,
                       http_requests=None, analysis_counts=None,
                       gateway_counts=None, shed_counts=None,
-                      hv_stats=None) -> str:
+                      hv_stats=None, fleet_stats=None) -> str:
     """Render and write a metrics snapshot to `path` (or file-like)."""
     text = render_prometheus(recorder=recorder, stats=stats,
                              hostcall_stats=hostcall_stats,
@@ -294,7 +319,8 @@ def export_prometheus(path, recorder=None, stats=None,
                              analysis_counts=analysis_counts,
                              gateway_counts=gateway_counts,
                              shed_counts=shed_counts,
-                             hv_stats=hv_stats)
+                             hv_stats=hv_stats,
+                             fleet_stats=fleet_stats)
     if hasattr(path, "write"):
         path.write(text)
     else:

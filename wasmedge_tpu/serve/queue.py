@@ -76,7 +76,8 @@ class ServeFuture:
                 DeadlineExceeded, or the server's terminal failure.
     """
 
-    __slots__ = ("_ev", "_cells", "_error", "request_id", "t_done")
+    __slots__ = ("_ev", "_cells", "_error", "request_id", "t_done",
+                 "_mirrors", "_mlock")
 
     def __init__(self, request_id: int):
         self._ev = threading.Event()
@@ -84,6 +85,8 @@ class ServeFuture:
         self._error: Optional[BaseException] = None
         self.request_id = request_id
         self.t_done: Optional[float] = None   # monotonic resolution stamp
+        self._mirrors: Optional[List["ServeFuture"]] = None
+        self._mlock = threading.Lock()
 
     # -- serving-loop side (first outcome wins: a replayed lane after a
     # crash restore may re-complete an already-resolved request) -----------
@@ -93,6 +96,7 @@ class ServeFuture:
         self._cells = list(cells)
         self.t_done = time.monotonic()
         self._ev.set()
+        self._fan_out()
 
     def _reject(self, error: BaseException):
         if self._ev.is_set():
@@ -100,6 +104,36 @@ class ServeFuture:
         self._error = error
         self.t_done = time.monotonic()
         self._ev.set()
+        self._fan_out()
+
+    def mirror(self, other: "ServeFuture"):
+        """Propagate this future's outcome into `other` (the fleet's
+        local-fallback seam: a re-queued request gets a FRESH server
+        future, while the caller still waits on the one its 202 was
+        issued against).  First-outcome-wins on the target, so a
+        mirror can never overwrite an already-settled future.
+        `_mlock` closes the register-vs-settle race: without it a
+        concurrent _fan_out could swap _mirrors to None between this
+        method's check and append, dropping the registration."""
+        with self._mlock:
+            if not self._ev.is_set():
+                if self._mirrors is None:
+                    self._mirrors = []
+                self._mirrors.append(other)
+                return
+        self._propagate(other)   # already settled: deliver now
+
+    def _fan_out(self):
+        with self._mlock:
+            mirrors, self._mirrors = self._mirrors, None
+        for m in (mirrors or ()):
+            self._propagate(m)
+
+    def _propagate(self, other: "ServeFuture"):
+        if self._error is not None:
+            other._reject(self._error)
+        else:
+            other._resolve(self._cells or [])
 
     # -- caller side -------------------------------------------------------
     @property

@@ -40,6 +40,13 @@ into a long-lived service:
       the shared flight recorder (obs/); `Configure.serve.autotune`
       additionally drives steps_per_launch from the drain-latency
       histograms (serve/autotune.py).
+
+  cross-host migration seams (r16, wasmedge_tpu/fleet/)
+      `export_vlane` detaches one parked (swapped) virtual lane as its
+      content-keyed SwapStore payload + journal entry;  `adopt_vlane`
+      installs one received from a peer (hash-verified) as a swapped
+      virtual lane under its ORIGINAL id, reinstalled by the ordinary
+      hv boundary rebalance;  `list_swapped` is the migratable set.
 """
 
 from __future__ import annotations
@@ -276,6 +283,126 @@ class BatchServer:
                 return False
             self.counters["rejected"] += 1
             return True
+
+    # -- cross-host lane migration (fleet/, r16) ---------------------------
+    def list_swapped(self) -> List[int]:
+        """Request ids currently parked as SWAPPED virtual lanes (hv):
+        the migratable set — their full lane state is already a
+        content-addressed SwapStore payload."""
+        with self._lock:
+            if self.hv is None:
+                return []
+            return [rid for rid, v in self.hv.waiting.items()
+                    if v.key is not None]
+
+    def export_vlane(self, request_id: int):
+        """Detach one waiting virtual lane for cross-host migration:
+        returns (entry, payload) where `entry` is the JSON-shaped
+        journal record (id/func/args/tenant/key/stdout_pos plus the
+        remaining deadline in seconds) and `payload` the SwapStore
+        blob bytes (None for a FRESH vlane that never installed — its
+        state is reproducible from func+args alone).  The request
+        leaves this server's accounting as `migrated`; its future is
+        NOT resolved — the caller (fleet/federation.py) keeps it and
+        resolves it from the receiving peer's outcome.  Raises
+        KeyError when the id is not a waiting virtual lane."""
+        with self._lock:
+            if self.hv is None:
+                raise KeyError("lane virtualization is off: no "
+                               "migratable virtual lanes")
+            v = self.hv.waiting.get(int(request_id))
+            if v is None:
+                raise KeyError(f"request {request_id} is not a waiting "
+                               f"virtual lane")
+            # read the payload BEFORE detaching anything: a corrupt /
+            # unreadable blob leaves the vlane exactly where it was —
+            # the next boundary's swap-in attempt surfaces it through
+            # the existing corrupt-entry path (machine-readable
+            # rejection), never a silently-lost request
+            payload = None
+            if v.key is not None:
+                payload = self.hv.store.get(v.key)
+            self.hv.waiting.pop(int(request_id), None)
+            entry = v.journal()
+            if v.req.deadline is not None:
+                entry["deadline_s"] = max(
+                    v.req.deadline - time.monotonic(), 0.001)
+            if v.key is not None:
+                self.hv.store.release(v.key)
+            self.counters["migrated"] = \
+                self.counters.get("migrated", 0) + 1
+            return entry, payload
+
+    def adopt_vlane(self, entry: dict, payload: Optional[bytes],
+                    requeue: bool = False):
+        """Install a migrated lane from a peer (or re-adopt a failed
+        outbound migration with `requeue=True`): the payload is
+        verified against its content key by SwapStore.adopt (hash
+        verification IS the integrity check), parked as a swapped
+        virtual lane under the request's ORIGINAL id, and reinstalled
+        by a coming boundary rebalance through the existing jitted
+        column-set pass.  Without a payload the request re-queues
+        fresh (same at-least-once semantics as a crash re-queue).
+        Returns the (new) local future.  Raises KeyError for an
+        unknown export and ValueError when hv is off but a payload
+        (mid-run state) was shipped."""
+        from wasmedge_tpu.serve.queue import advance_request_ids
+
+        rid = int(entry["id"])
+        func = entry.get("func", "")
+        args = tuple(entry.get("args", ()))
+        with self._lock:
+            if self.failed is not None:
+                raise self.failed
+            if self._draining:
+                raise WasmError(ErrCode.Terminated,
+                                "server is draining; migrations closed")
+            self.recycler.func_idx(func)   # unknown export raises NOW
+            if payload is None or entry.get("key") is None:
+                # stateless: indistinguishable from a fresh re-queue
+                fut = None
+            elif self.hv is None:
+                raise ValueError(
+                    "cannot adopt mid-run lane state: lane "
+                    "virtualization is off on this server")
+            else:
+                self.hv.store.adopt(entry["key"], bytes(payload))
+                now = time.monotonic()
+                req = ServeRequest(
+                    func, args, tenant=entry.get("tenant", "default"),
+                    deadline=(now + float(entry["deadline_s"]))
+                    if entry.get("deadline_s") is not None else None,
+                    t_submit=now, request_id=rid)
+                advance_request_ids(rid)
+                from wasmedge_tpu.hv.manager import VirtualLane
+
+                v = VirtualLane(req, key=entry["key"],
+                                stdout_pos=int(entry.get("stdout_pos",
+                                                         0)))
+                v.swaps = 1
+                self.hv.waiting[rid] = v
+                if not requeue:
+                    self.counters["submitted"] += 1
+                    self.counters["admitted"] += 1
+                else:
+                    self.counters["migrated"] = \
+                        self.counters.get("migrated", 0) - 1
+                self._wake.notify_all()
+                return req.future
+        if fut is None:
+            fut = self.submit(func, args,
+                              tenant=entry.get("tenant", "default"),
+                              deadline_s=entry.get("deadline_s"),
+                              request_id=rid)
+            if requeue:
+                with self._lock:
+                    # the failed migration's export counted `migrated`
+                    # and this re-queue counted `submitted` again: back
+                    # both out so the ledger shows one request once
+                    self.counters["migrated"] = \
+                        self.counters.get("migrated", 0) - 1
+                    self.counters["submitted"] -= 1
+        return fut
 
     # -- serving loop ------------------------------------------------------
     @property

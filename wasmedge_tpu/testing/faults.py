@@ -59,6 +59,29 @@ The lane-virtualization layer (wasmedge_tpu/hv/) adds the swap seams
                           crash-atomic writer guarantees no partial
                           blob either way).
 
+The fleet federation layer (wasmedge_tpu/fleet/) adds the peer seams
+— r16's multi-host chaos surface:
+  - `"peer_send"`       in PeerClient before every outbound peer
+                        request (ctx: src, dst, route in {heartbeat,
+                        journal, execute, migrate, modules,
+                        requests...}).  An injected fault is a severed
+                        outbound link: the sender sees
+                        PeerUnreachable, the receiver sees nothing.
+  - `"peer_recv"`       in the /v1/fleet/* handlers on receipt (ctx:
+                        src, dst, route).  An injected fault is a
+                        message lost at the receiver: the sender gets
+                        a 5xx it counts as unreachable, and the
+                        receiver processes nothing.
+  - `"peer_heartbeat"`  in the heartbeat loop before each liveness
+                        probe (ctx: src, dst) — the cheap way to
+                        starve ONE peer's probes without touching the
+                        data plane.
+  `partition_schedule()` composes these into deterministic network
+  partitions: directional link cuts between named peers over a window
+  of arrivals, healing when the window passes.  A gateway process
+  kill/restart is still driver-orchestrated (bench.py --federation),
+  with these seams supplying the weather.
+
 Fault classes covered by the tier-1 suites (ISSUE 2 + ISSUE 5):
   - launch-time device error       Fault(point="launch", ...)
   - mid-serve host exception       Fault(point="serve", ...)
@@ -109,7 +132,9 @@ class Fault:
     #                            "generation_swap" | "journal_write" |
     #                            "http_response_delay" |
     #                            "http_response_drop" | "swap_out" |
-    #                            "swap_in" | "swap_store_write"
+    #                            "swap_in" | "swap_store_write" |
+    #                            "peer_send" | "peer_recv" |
+    #                            "peer_heartbeat"
     at: int = 0                # 0-based arrival index at that seam
     times: int = 1             # consecutive arrivals that fault
     lanes: Tuple[int, ...] = ()  # lane attribution (poison quarantine)
@@ -234,6 +259,36 @@ def gateway_chaos_schedule(seed: int,
             at=int(rng.randint(0, 8 * max_at)),
             match={"route": "requests"}
             if point == "http_response_drop" else None))
+    return out
+
+
+def partition_schedule(links, at: int = 0, times: int = 1000000,
+                       both_ends: bool = False) -> list:
+    """Deterministic network partition for the fleet peer seams.
+
+    `links` is [(src, dst), ...] — each cuts the src->dst direction:
+    every `peer_send` from src to dst (heartbeat probes included —
+    they ride the same transport) faults for arrivals [at, at+times)
+    of THAT link (per-fault matched counters, so multi-link schedules
+    stay deterministic under thread interleaving).  Only the TRANSPORT
+    seam is armed: arming `peer_heartbeat` too would shield the
+    `peer_send` window behind it (the probe fires heartbeat first) and
+    the partition would outlive its `times` — target `peer_heartbeat`
+    directly only to starve probes while leaving the data plane up.
+    `both_ends=True` also arms the receiver's `peer_recv` seam,
+    modelling loss on the wire rather than at the sender's NIC.  A
+    finite `times` heals the partition after the window —
+    heartbeat-flap tests arm small windows to flap a peer into suspect
+    and back."""
+    out = []
+    for src, dst in links:
+        m = {"src": str(src), "dst": str(dst)}
+        out.append(Fault(point="peer_send", at=at, times=times,
+                         match=dict(m)))
+        if both_ends:
+            out.append(Fault(point="peer_recv", at=at, times=times,
+                             match={"src": str(src),
+                                    "dst": str(dst)}))
     return out
 
 
