@@ -157,7 +157,10 @@ def faults_smoke() -> int:
     from wasmedge_tpu.common.configure import Configure
     from wasmedge_tpu.testing.faults import Fault, FaultInjector
 
-    lanes, iters = 64, 2
+    # enough iterations that even the FUSED build (batch/fuse.py
+    # retires whole runs per dispatch) needs multiple launches, so the
+    # at=1 fault lands after the first checkpoint exists
+    lanes, iters = 64, 8
     conf = Configure()
     conf.supervisor.checkpoint_every_steps = 100
     conf.supervisor.backoff_base_s = 0.0
@@ -718,6 +721,286 @@ def analyze_smoke() -> int:
         "bounded_retired_max": int(res.retired.max()),
         "wall_s": round(dt, 3),
     }))
+    return 0 if ok else 1
+
+
+def _fuse_fib_engine(fuse: bool, lanes: int, obs: bool = False):
+    """SIMT (BatchEngine) flagship rig at the standard bench geometry
+    with the superinstruction-fusion knob pinned — the tier the shard
+    drive, the serving layer, and hv oversubscription execute."""
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.common.configure import Configure
+
+    conf = Configure()
+    conf.batch.fuse_superinstructions = fuse
+    conf.batch.steps_per_launch = 50_000_000
+    conf.batch.value_stack_depth = 256
+    conf.batch.call_stack_depth = 256
+    conf.obs.enabled = obs
+    inst, store = _instantiate_fib(conf)
+    return BatchEngine(inst, store=store, conf=conf, lanes=lanes)
+
+
+def _fuse_echo_engine(conf, lanes, sink_path):
+    """Echo engine with fd 1 sunk to a FILE (not /dev/null) so the
+    fusion smoke can compare the two runs' stdout byte streams."""
+    import os
+
+    import bench_echo
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.host.wasi import WasiModule
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    conf.batch.steps_per_launch = 100
+    conf.batch.value_stack_depth = 64
+    conf.batch.call_stack_depth = 16
+    wasi = WasiModule()
+    wasi.init_wasi(dirs=[], prog_name="echo")
+    sink = os.open(sink_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC)
+    wasi.env.fds[1].os_fd = sink
+    mod = Validator(conf).validate(
+        Loader(conf).parse_module(bench_echo.build_module()))
+    store = StoreManager()
+    ex = Executor(conf)
+    ex.register_import_object(store, wasi)
+    inst = ex.instantiate(store, mod)
+    return BatchEngine(inst, store=store, conf=conf, lanes=lanes), sink
+
+
+def fuse_smoke() -> int:
+    """`bench.py --fuse-smoke`: the superinstruction-fusion CI guard.
+    Asserts (a) the translation pass realizes fused cells on the
+    flagship fib image, and (b) fusion on/off is bit-identical on echo
+    (WASI/hostcall path, including the stdout byte stream) and fib
+    (compute path) at identical geometry, with fewer dispatches when
+    on.  Prints ONE JSON line; emits no artifact (this mode checks
+    correctness, not throughput)."""
+    import os
+    import tempfile
+
+    from wasmedge_tpu.common.configure import Configure
+
+    t0 = time.perf_counter()
+    lanes = 32
+    checks = {}
+    # -- fib (pure compute) --
+    fib_res = {}
+    fused_report = None
+    for fuse in (True, False):
+        eng = _fuse_fib_engine(fuse, lanes)
+        fib_res[fuse] = eng.run("fib", [np.full(lanes, 12, np.int64)],
+                                max_steps=5_000_000)
+        if fuse:
+            # planning is deferred to the first build — read after run
+            fused_report = eng.img.fusion_report
+    a, b = fib_res[True], fib_res[False]
+    checks["fib_realized_runs"] = (fused_report or {}).get(
+        "fused_runs", 0) > 0
+    checks["fib_bit_identical"] = bool(
+        (a.results[0] == b.results[0]).all()
+        and (a.trap == b.trap).all() and (a.retired == b.retired).all())
+    checks["fib_fewer_dispatches"] = a.steps < b.steps
+    # -- echo (hostcall + tier-0 stdout path) --
+    echo = {}
+    with tempfile.TemporaryDirectory(prefix="fuse-smoke-") as d:
+        for fuse in (True, False):
+            conf = Configure()
+            conf.batch.fuse_superinstructions = fuse
+            path = os.path.join(d, f"out-{fuse}")
+            eng, sink = _fuse_echo_engine(conf, lanes, path)
+            res = eng.run("echo", [np.full(lanes, 2, np.int64)],
+                          max_steps=1_000_000)
+            os.close(sink)
+            echo[fuse] = (res, open(path, "rb").read())
+        ra, sa = echo[True]
+        rb, sb = echo[False]
+        checks["echo_completed"] = bool(ra.completed.all()
+                                        and rb.completed.all())
+        checks["echo_bit_identical"] = bool(
+            (ra.results[0] == rb.results[0]).all()
+            and (ra.trap == rb.trap).all()
+            and (ra.retired == rb.retired).all())
+        checks["echo_stdout_identical"] = sa == sb and len(sa) > 0
+    dt = time.perf_counter() - t0
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "fuse_smoke_bit_identity",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "fib_steps_fused": int(a.steps),
+        "fib_steps_unfused": int(b.steps),
+        "fused_runs": (fused_report or {}).get("fused_runs", 0),
+        "fused_patterns": (fused_report or {}).get("patterns", 0),
+        "lanes": lanes,
+        "wall_s": round(dt, 3),
+    }))
+    return 0 if ok else 1
+
+
+def fuse_bench() -> int:
+    """`bench.py --fuse-bench`: obs-off flagship A/B — the SIMT chunk
+    tier with superinstruction fusion on vs off at identical geometry —
+    plus re-measured divergent-mix and multi-tenant floors under the
+    new default (fusion on).  Emits BENCH_r17.json.  Workload sizes are
+    CPU-container-scaled via env (BENCH_FUSE_FIB_N / BENCH_FUSE_LANES /
+    BENCH_FUSE_DIV_LO / BENCH_FUSE_DIV_HI); the metric names record the
+    actual geometry so a scaled number can never be mistaken for the
+    TPU floor."""
+    import os
+
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.batch.multitenant import (
+        MultiTenantBatchEngine, Tenant)
+    from wasmedge_tpu.batch.uniform import UniformBatchEngine
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.models import (
+        build_coremark_kernel, build_fac, build_fib, build_loop_sum)
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    fib_n = int(os.environ.get("BENCH_FUSE_FIB_N", "15"))
+    lanes = int(os.environ.get("BENCH_FUSE_LANES", "4096"))
+    div_lo = int(os.environ.get("BENCH_FUSE_DIV_LO", "8"))
+    div_hi = int(os.environ.get("BENCH_FUSE_DIV_HI", "14"))
+    import jax
+
+    out = {"metric": f"fusion_ab_fib{fib_n}_x{lanes}",
+           "unit": "wasm_instr/s", "backend": jax.default_backend(),
+           "obs": False, "lanes": lanes, "fib_n": fib_n}
+    expected = _fib(fib_n)
+
+    # ---- flagship A/B: SIMT tier, fusion on vs off ----
+    flagship = {}
+    for fuse in (True, False):
+        eng = _fuse_fib_engine(fuse, lanes)
+        eng.run("fib", [np.full(lanes, WARMUP_N, np.int64)],
+                max_steps=10_000_000)  # compile
+        t0 = time.perf_counter()
+        res = eng.run("fib", [np.full(lanes, fib_n, np.int64)],
+                      max_steps=500_000_000)
+        dt = time.perf_counter() - t0
+        assert res.completed.all() and \
+            (res.results[0] == expected).all(), "flagship wrong result"
+        retired = float(np.asarray(res.retired, np.float64).sum())
+        key = "fused" if fuse else "unfused"
+        flagship[key] = {
+            "ops_per_sec": round(retired / dt, 1),
+            "steps": int(res.steps), "wall_s": round(dt, 2),
+            "retired": retired,
+        }
+        if fuse:
+            rep = eng.img.fusion_report
+            flagship["fused"]["fused_runs"] = rep.get("fused_runs")
+            flagship["fused"]["patterns"] = rep.get("patterns")
+    flagship["speedup"] = round(
+        flagship["fused"]["ops_per_sec"]
+        / max(flagship["unfused"]["ops_per_sec"], 1e-9), 4)
+    flagship["dispatch_reduction"] = round(
+        1.0 - flagship["fused"]["steps"]
+        / max(flagship["unfused"]["steps"], 1), 4)
+    out["flagship_simt"] = flagship
+    out["value"] = flagship["fused"]["ops_per_sec"]
+    out["speedup"] = flagship["speedup"]
+
+    def _inst_of(conf, data):
+        mod = Validator(conf).validate(Loader(conf).parse_module(data))
+        store = StoreManager()
+        return Executor(conf).instantiate(store, mod), store
+
+    # ---- divergent mix (floor re-measure, fusion on vs off) ----
+    div = {}
+    ns = div_lo + (np.arange(lanes, dtype=np.int64)
+                   % (div_hi - div_lo + 1))
+    np.random.default_rng(42).shuffle(ns)
+    expect = np.asarray([_fib(int(n)) for n in ns], np.int64)
+    for fuse in (True, False):
+        conf = Configure()
+        conf.batch.fuse_superinstructions = fuse
+        conf.batch.steps_per_launch = 50_000_000
+        conf.batch.value_stack_depth = 256
+        conf.batch.call_stack_depth = 256
+        inst, store = _inst_of(conf, build_fib())
+        eng = UniformBatchEngine(inst, store=store, conf=conf,
+                                 lanes=lanes)
+        eng.run("fib", [np.maximum(ns - 6, 1)], max_steps=50_000_000)
+        t0 = time.perf_counter()
+        res = eng.run("fib", [ns], max_steps=2_000_000_000)
+        dt = time.perf_counter() - t0
+        assert res.completed.all() and \
+            (np.asarray(res.results[0], np.int64) == expect).all(), \
+            "divergent wrong result"
+        retired = float(np.asarray(res.retired, np.float64).sum())
+        div["fused" if fuse else "unfused"] = {
+            "ops_per_sec": round(retired / dt, 1),
+            "wall_s": round(dt, 2)}
+    div["speedup"] = round(div["fused"]["ops_per_sec"]
+                           / max(div["unfused"]["ops_per_sec"], 1e-9), 4)
+    div["metric"] = f"divergent_fib{div_lo}to{div_hi}_x{lanes}"
+    out["divergent_mix"] = div
+
+    # ---- multi-tenant mix (floor re-measure, fusion on vs off) ----
+    mt_out = {}
+    L = max(lanes // 4, 1)
+    specs = [
+        (build_fib(), "fib", [np.full(L, 13, np.int64)]),
+        (build_fac(), "fac", [np.full(L, 12, np.int64)]),
+        (build_loop_sum(), "loop_sum", [np.full(L, 1200, np.int64)]),
+        (build_coremark_kernel(), "coremark",
+         [np.full(L, 4096, np.int64)]),
+    ]
+    results_by_knob = {}
+    for fuse in (True, False):
+        conf = Configure()
+        conf.batch.fuse_superinstructions = fuse
+        conf.batch.steps_per_launch = 50_000_000
+        conf.batch.value_stack_depth = 256
+        conf.batch.call_stack_depth = 256
+        tenants = []
+        for data, fn, args in specs:
+            inst, store = _inst_of(conf, data)
+            tenants.append(Tenant(
+                engine=BatchEngine(inst, store=store, conf=conf,
+                                   lanes=L),
+                func_name=fn, args_lanes=args, lanes=L))
+        mt = MultiTenantBatchEngine(tenants, conf=conf)
+        mt.run_tenants(max_steps=2000)  # compile
+        mt2 = MultiTenantBatchEngine(tenants, conf=conf)
+        t0 = time.perf_counter()
+        res = mt2.run_tenants(max_steps=4_000_000_000)
+        dt = time.perf_counter() - t0
+        assert all(r.completed.all() for r in res), "multitenant traps"
+        retired = float(sum(np.asarray(r.retired, np.float64).sum()
+                            for r in res))
+        results_by_knob[fuse] = res
+        mt_out["fused" if fuse else "unfused"] = {
+            "ops_per_sec": round(retired / dt, 1),
+            "wall_s": round(dt, 2)}
+    mt_out["bit_identical"] = bool(all(
+        (a.results[0] == b.results[0]).all() and (a.trap == b.trap).all()
+        and (a.retired == b.retired).all()
+        for a, b in zip(results_by_knob[True], results_by_knob[False])))
+    mt_out["speedup"] = round(
+        mt_out["fused"]["ops_per_sec"]
+        / max(mt_out["unfused"]["ops_per_sec"], 1e-9), 4)
+    mt_out["metric"] = f"multitenant_mix4_x{4 * L}"
+    out["multitenant"] = mt_out
+
+    ok = flagship["speedup"] > 1.0 and mt_out["bit_identical"]
+    out["ok"] = bool(ok)
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "BENCH_r17.json")
+    print(f"# flagship speedup={flagship['speedup']} "
+          f"dispatch_reduction={flagship['dispatch_reduction']} "
+          f"divergent speedup={div['speedup']} "
+          f"multitenant speedup={mt_out['speedup']}", file=sys.stderr)
     return 0 if ok else 1
 
 
@@ -1948,6 +2231,10 @@ if __name__ == "__main__":
         sys.exit(serve_bench())
     if "--analyze-smoke" in sys.argv[1:]:
         sys.exit(analyze_smoke())
+    if "--fuse-smoke" in sys.argv[1:]:
+        sys.exit(fuse_smoke())
+    if "--fuse-bench" in sys.argv[1:]:
+        sys.exit(fuse_bench())
     if "--gateway-smoke" in sys.argv[1:]:
         sys.exit(gateway_smoke())
     if "--gateway" in sys.argv[1:]:

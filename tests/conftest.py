@@ -44,6 +44,10 @@ def pytest_configure(config):
         "markers", "hv: lane-memory virtualization suite (swap store, "
         "eviction policy, oversubscribed serving; tier-1 fast, runs "
         "under -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "fuse: SIMT superinstruction-fusion suite "
+        "(translation pass, fused-dispatch bit-exactness, ladder "
+        "demotion; tier-1 fast, runs under -m 'not slow')")
 
 
 def pytest_addoption(parser):

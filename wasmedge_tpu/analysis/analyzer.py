@@ -191,9 +191,16 @@ class ModuleAnalysis:
         }
 
     # -- annotated disassembly --------------------------------------------
-    def annotated_disasm(self, image: LoweredModule) -> str:
+    def annotated_disasm(self, image: LoweredModule,
+                         fusion: Optional[dict] = None) -> str:
         """LoweredModule.disasm interleaved with block/analysis
-        annotations — the human half of the analyze CLI's report."""
+        annotations — the human half of the analyze CLI's report.
+        `fusion` (a batch/fuse.py plan_fusion report) annotates which
+        candidate runs were REALIZED as fused dispatch cells:
+        `fused=<head>+<len>` marks on the owning block lines."""
+        runs_by_pc = {}
+        for r in (fusion or {}).get("runs", ()):
+            runs_by_pc[int(r[0])] = (int(r[1]), int(r[2]))
         out: List[str] = []
         for f in self.funcs:
             flags = []
@@ -219,6 +226,11 @@ class ModuleAnalysis:
                         "ngrams=" + ",".join(
                             "|".join(ops)
                             for ops in self.block_ngram_names(f, i)))
+                fused_here = [f"{pc}+{n}" for pc, (n, _k)
+                              in sorted(runs_by_pc.items())
+                              if b.start <= pc <= b.end]
+                if fused_here:
+                    marks.append("fused=" + ",".join(fused_here))
                 out.append(f";;   block [{b.start}..{b.end}] "
                            f"kind={b.kind} cost={f.block_costs[i]} "
                            f"div={f.block_divergence[i]} "

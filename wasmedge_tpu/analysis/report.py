@@ -91,6 +91,41 @@ def validate_report(doc) -> List[str]:
                                            for o in ops):
                 problems.append(f"{where}.ops: non-string opcode name")
 
+    # optional fusion section (batch/fuse.py plan_fusion: the analyze
+    # CLI attaches planned-vs-realized translation counts)
+    if "fusion" in doc:
+        fu = doc["fusion"]
+        if not isinstance(fu, dict):
+            problems.append("fusion: not an object")
+        else:
+            _req(fu, "enabled", bool, problems, "fusion")
+            for key in ("patterns", "fused_runs", "fused_cells"):
+                _req(fu, key, int, problems, "fusion")
+            fcands = _req(fu, "candidates", list, problems, "fusion")
+            realized_total = 0
+            for i, c in enumerate(fcands or ()):
+                where = f"fusion.candidates[{i}]"
+                if not isinstance(c, dict):
+                    problems.append(f"{where}: not an object")
+                    continue
+                _req(c, "ops", list, problems, where)
+                _req(c, "eligible", bool, problems, where)
+                planned = _req(c, "planned", int, problems, where)
+                runs_n = _req(c, "realized_runs", int, problems, where)
+                _req(c, "realized_cells", int, problems, where)
+                if planned is not None and runs_n is not None \
+                        and runs_n > planned:
+                    problems.append(
+                        f"{where}: realized_runs > planned")
+                if runs_n:
+                    realized_total += runs_n
+            if fcands is not None and isinstance(
+                    fu.get("fused_runs"), int) \
+                    and realized_total != fu["fused_runs"]:
+                problems.append(
+                    "fusion: fused_runs disagrees with candidate "
+                    "realized_runs sum")
+
     funcs = _req(doc, "funcs", list, problems, "report")
     if funcs is not None:
         for fi, f in enumerate(funcs):

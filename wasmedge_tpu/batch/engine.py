@@ -137,7 +137,15 @@ class BatchState(NamedTuple):
     # retired count, scatter-incremented once per step across lanes and
     # folded into per-opcode counts (img.op_id -> Statistics cost_table
     # domain) on sync.  None unless the knob is on (no per-step cost).
+    # Under superinstruction fusion every CONSTITUENT op of a fused run
+    # increments its own pc (histogram == retired, batch/fuse.py).
     op_hist: object = None
+    # r17 fusion counters [3] int32: fused dispatches / instructions
+    # retired through fused cells / total retired.  Laneless like
+    # op_hist; allocated only when obs is enabled AND the image
+    # compiled fused cells (obs_state_planes), folded into the flight
+    # recorder on sync.
+    fu_ctr: object = None
 
 
 @dataclasses.dataclass
@@ -193,18 +201,26 @@ def r05_state_planes(img: DeviceImage, lanes: int) -> dict:
 
 
 def obs_state_planes(conf, img: DeviceImage, mesh=None) -> dict:
-    """Initial op_hist plane for the device-side opcode histogram
-    (Configure.obs.opcode_histogram).  {} when the knob is off — the
-    BatchState default (None) then keeps the step function free of the
-    per-step scatter entirely.  Mesh runs skip the plane (it has no
-    lane axis to shard)."""
+    """Initial device-side observability planes: the per-pc opcode
+    histogram (Configure.obs.opcode_histogram) and the fusion
+    dispatch/retired counters (allocated whenever obs is enabled and
+    the image compiled fused cells).  {} when obs is off — the
+    BatchState defaults (None) then keep the step function free of the
+    per-step scatters entirely.  Mesh runs skip both (no lane axis to
+    shard)."""
     obs_conf = getattr(conf, "obs", None)
-    if mesh is not None or obs_conf is None \
-            or not (obs_conf.enabled and obs_conf.opcode_histogram):
+    if mesh is not None or obs_conf is None or not obs_conf.enabled:
         return {}
     import jax.numpy as jnp
 
-    return {"op_hist": jnp.zeros((img.cls.shape[0],), jnp.int32)}
+    out = {}
+    if obs_conf.opcode_histogram:
+        out["op_hist"] = jnp.zeros((img.cls.shape[0],), jnp.int32)
+    from wasmedge_tpu.batch.fuse import fusion_active
+
+    if fusion_active(img, conf.batch):
+        out["fu_ctr"] = jnp.zeros((3,), jnp.int32)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -484,13 +500,49 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
             cur = gat(plane, idx)
             return scat(plane, idx, (cur & ~m) | (v & m), ok & (m != 0))
 
+    # ---- superinstruction fusion statics (batch/fuse.py) ----
+    # FUSE_ON is trace-time static: knob off (or nothing realized)
+    # compiles the exact seed per-op step.
+    from wasmedge_tpu.batch.fuse import fusion_active, make_fused_apply
+
+    FUSE_ON = fusion_active(img, cfg)
+    if FUSE_ON:
+        flen_t = jnp.asarray(img.fuse_len)
+        MAX_F = int(np.asarray(img.fuse_len).max())
+        fused_apply = make_fused_apply(img, lanes, HAS_SIMD)
+
     def step(st: BatchState, t0_time=None) -> BatchState:
-        """One lockstep instruction.  `t0_time` is the [2, 2] int32
+        """One lockstep instruction (or one fused dispatch cell — a
+        whole straight-line run of stack/ALU effects for lanes parked
+        at a fused run head).  `t0_time` is the [2, 2] int32
         per-launch time base (read-only; threaded as a separate argument
         so the donated state never carries an identity-passthrough
         leaf — see t0_state_planes)."""
-        active = st.trap == 0
+        alive = st.trap == 0
         pc = jnp.clip(st.pc, 0, img.code_len - 1)
+        if FUSE_ON:
+            f_n = flen_t[pc]
+            is_fused = alive & (f_n >= 2)
+            if fuel_enabled:
+                # a lane without the fuel to retire the WHOLE run steps
+                # through the original per-op cells instead, so gas
+                # exhaustion lands at the correct op with the correct
+                # pre-op sp/pc — bit-exact with the unfused build
+                if weighted_gas:
+                    fuse_cost = jnp.zeros_like(f_n)
+                    for j in range(MAX_F):
+                        pcj = jnp.clip(pc + j, 0, img.code_len - 1)
+                        fuse_cost = fuse_cost + jnp.where(
+                            j < f_n, cost_t[pcj], 0)
+                else:
+                    fuse_cost = f_n
+                is_fused = is_fused & (st.fuel - fuse_cost > 0)
+            # the per-op path must not also fire for fused lanes: the
+            # head pc still carries its ORIGINAL first-op cell
+            active = alive & ~is_fused
+        else:
+            is_fused = jnp.bool_(False) & alive
+            active = alive
         cls = cls_t[pc]
         sub = sub_t[pc]
         a = a_t[pc]
@@ -1515,6 +1567,35 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
         glob_hi = st.glob_hi.at[gidx, lane_iota].set(
             jnp.where(gmask, v0_hi, gcur_hi))
 
+        # =================== fused dispatch cells ===================
+        # one dispatch retires a whole straight-line run's stack
+        # effects (batch/fuse.py); fused-lane masks are disjoint from
+        # every per-op write mask above (active excludes them), so
+        # applying the fused scatters after the per-op ones is exact.
+        # Any-lane conditional: steps where no lane sits at a fused
+        # head skip the pattern handlers entirely (same rationale as
+        # the store scatters above on the CPU backend).
+        if FUSE_ON:
+            _stk = tuple([stack_lo, stack_hi] + (
+                [stack_e2, stack_e3] if HAS_SIMD else []))
+
+            def _run_fused(ops):
+                stk, gl, gh = ops
+                stk2, (gl2, gh2), fsp = fused_apply(
+                    list(stk), (gl, gh), pc, sp, fp, is_fused)
+                return tuple(stk2), gl2, gh2, fsp
+
+            def _skip_fused(ops):
+                stk, gl, gh = ops
+                return stk, gl, gh, sp
+
+            _stk, glob_lo, glob_hi, fused_sp = lax.cond(
+                jnp.any(is_fused), _run_fused, _skip_fused,
+                (_stk, glob_lo, glob_hi))
+            stack_lo, stack_hi = _stk[0], _stk[1]
+            if HAS_SIMD:
+                stack_e2, stack_e3 = _stk[2], _stk[3]
+
         # =================== merge: sp / pc / frames ===================
         new_sp = sp
         for m, v in (
@@ -1576,10 +1657,20 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
         ):
             new_trap = jnp.where(active & m, code, new_trap)
 
-        new_retired = st.retired + b2i(active)
+        if FUSE_ON:
+            # a fused dispatch retires the whole run; each constituent
+            # keeps per-op attribution (f_n ops of gas/histogram)
+            new_retired = st.retired + jnp.where(
+                alive, jnp.where(is_fused, f_n, jnp.int32(1)), jnp.int32(0))
+        else:
+            new_retired = st.retired + b2i(active)
         if fuel_enabled:
             dec = jnp.where(active, cost_t[pc], 0) if weighted_gas \
                 else b2i(active)
+            if FUSE_ON:
+                # fused lanes are pre-gated on fuel > run cost, so the
+                # exhaustion check below (active-only) stays exact
+                dec = dec + jnp.where(is_fused, fuse_cost, 0)
             new_fuel = st.fuel - dec
             new_trap = jnp.where(active & (new_fuel <= 0) & (new_trap == 0),
                                  int(ErrCode.CostLimitExceeded), new_trap)
@@ -1590,9 +1681,51 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
         halted_now = active & (new_trap != 0)
         new_pc = jnp.where(halted_now, pc, new_pc)
         keep = ~halted_now & active
+        pc_out = jnp.where(keep, new_pc, st.pc)
+        sp_out = jnp.where(keep, new_sp,
+                           jnp.where(ret_done, fp + nres, st.sp))
+        if FUSE_ON:
+            # fused lanes: pc jumps past the whole run, sp takes the
+            # run's net stack effect (fp/opbase/depth never change —
+            # fused classes are pure stack/ALU)
+            pc_out = jnp.where(is_fused, pc + f_n, pc_out)
+            sp_out = jnp.where(is_fused, fused_sp, sp_out)
+
+        # device-side obs planes: per-pc retired histogram (attributed
+        # to every CONSTITUENT op of a fused run — histogram == retired
+        # by construction) and the fused/unfused dispatch counters.
+        # Both are trace-time static: None planes compile to nothing.
+        op_hist_p = st.op_hist
+        if st.op_hist is not None:
+            H = st.op_hist.shape[0]
+            if FUSE_ON:
+                hln = jnp.where(is_fused, f_n, jnp.int32(1))
+                for j in range(MAX_F):
+                    op_hist_p = op_hist_p.at[
+                        jnp.clip(pc + j, 0, H - 1)].add(
+                        b2i(alive & (j < hln)))
+            else:
+                op_hist_p = op_hist_p.at[jnp.clip(pc, 0, H - 1)].add(
+                    b2i(alive))
+        fu_ctr_p = st.fu_ctr
+        if st.fu_ctr is not None:
+            if FUSE_ON:
+                fu_ctr_p = st.fu_ctr + jnp.stack([
+                    jnp.sum(b2i(is_fused)),
+                    jnp.sum(jnp.where(is_fused, f_n, 0)),
+                    jnp.sum(jnp.where(alive,
+                                      jnp.where(is_fused, f_n,
+                                                jnp.int32(1)), 0))])
+            else:
+                # a fused-plane state resumed on an unfused build (the
+                # supervisor's demotion rung) keeps the total-retired
+                # row live so the plane is never an identity
+                # passthrough in the donated carry
+                fu_ctr_p = st.fu_ctr + jnp.stack([
+                    jnp.int32(0), jnp.int32(0), jnp.sum(b2i(active))])
         return BatchState(
-            pc=jnp.where(keep, new_pc, st.pc),
-            sp=jnp.where(keep, new_sp, jnp.where(ret_done, fp + nres, st.sp)),
+            pc=pc_out,
+            sp=sp_out,
             fp=jnp.where(keep, new_fp, st.fp),
             opbase=jnp.where(keep, new_opbase, st.opbase),
             call_depth=jnp.where(keep, new_depth, st.call_depth),
@@ -1619,6 +1752,8 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
             t0_ctr=t0_ctr_p,
             so_buf=so_buf_p,
             so_off=so_off_p,
+            op_hist=op_hist_p,
+            fu_ctr=fu_ctr_p,
         )
 
     return step
@@ -1708,6 +1843,26 @@ class BatchEngine:
         self._step = None
         self._run_chunk = None
 
+    def _plan_fusion(self):
+        """Run the superinstruction translation pass once per image
+        (batch/fuse.py): the analyzer's top candidates become fused
+        dispatch cells in new image planes.  Knob off = never planned =
+        the step builder compiles the bit-identical seed path.
+
+        Deferred to first _build() / obs-on initial_state / ladder
+        gating / image concat rather than engine construction: planning
+        dereferences the image's LAZY analysis binding, and a merely-
+        constructed engine (batchability probes, registry stash) must
+        keep the r12 guarantee that startups which never compile a step
+        never pay the analyzer.  Idempotent (fusion_report sentinel)."""
+        if not getattr(self.cfg, "fuse_superinstructions", True):
+            return
+        if getattr(self.img, "fusion_report", None) is not None:
+            return  # already planned (shared image)
+        from wasmedge_tpu.batch.fuse import plan_fusion
+
+        plan_fusion(self.img, self.cfg)
+
     def _t0_gate(self, kinds):
         """Engine-level tier-0 gating: fd_write buffering additionally
         requires that the instance's WASI environ has fds 1/2 as plain
@@ -1794,6 +1949,7 @@ class BatchEngine:
     def _build(self):
         from wasmedge_tpu.batch import ensure_jax_backend
 
+        self._plan_fusion()
         ensure_jax_backend()
         import jax
         import jax.numpy as jnp
@@ -1804,10 +1960,9 @@ class BatchEngine:
         chunk = self.cfg.steps_per_launch
 
         def run_chunk(state, t0_time):
-            # trace-time static: the plane is None unless the obs
-            # opcode-histogram knob allocated it (obs_state_planes), so
-            # the disabled configuration compiles the exact seed loop
-            track_hist = state.op_hist is not None
+            # the obs planes (op_hist / fu_ctr) are carried and updated
+            # by step() itself when allocated (obs_state_planes); a
+            # None plane compiles the exact seed loop
 
             def cond(carry):
                 i, s = carry
@@ -1815,14 +1970,7 @@ class BatchEngine:
 
             def body(carry):
                 i, s = carry
-                s2 = step(s, t0_time)
-                if track_hist:
-                    # attribute the step to the PRE-step pc of each
-                    # live lane (step() itself carries op_hist as None)
-                    pc = jnp.clip(s.pc, 0, s.op_hist.shape[0] - 1)
-                    s2 = s2._replace(op_hist=s.op_hist.at[pc].add(
-                        (s.trap == 0).astype(jnp.int32)))
-                return i + 1, s2
+                return i + 1, step(s, t0_time)
 
             i, state = lax.while_loop(cond, body, (jnp.int32(0), state))
             return i, state
@@ -1854,6 +2002,12 @@ class BatchEngine:
     def initial_state(self, func_idx: int, args_lanes: List[np.ndarray]):
         import jax.numpy as jnp
 
+        obs_conf = getattr(self.conf, "obs", None)
+        if obs_conf is not None and obs_conf.enabled:
+            # the fu_ctr allocation decision (obs_state_planes) needs
+            # the translation pass to have run; obs-off states defer it
+            # to _build() with the rest of the step compile
+            self._plan_fusion()
         cfg = self.cfg
         L = self.lanes
         img = self.img
@@ -2025,6 +2179,7 @@ class BatchEngine:
             obs.span("serve", t_serve, cat="engine", track=track)
         state = flush_stdout_buffers(self, state)
         state = self._fold_op_hist(state)
+        state = self._fold_fuse_ctr(state)
         if t0_active:
             ctr = np.asarray(state.t0_ctr, np.int64).sum(axis=1) - ctr_in
             st_ = self.hostcall_stats
@@ -2053,4 +2208,20 @@ class BatchEngine:
                       pc_counts)
             self.obs.add_opcode_counts(out)
             state = state._replace(op_hist=jnp.zeros_like(state.op_hist))
+        return state
+
+    def _fold_fuse_ctr(self, state):
+        """Fold + reset the fusion counter plane ([dispatches,
+        retired-through-fused-cells, total retired]) into the flight
+        recorder; the Prometheus export renders the fused/unfused
+        retired split from it (obs/metrics.py)."""
+        if getattr(state, "fu_ctr", None) is None:
+            return state
+        import jax.numpy as jnp
+
+        ctr = np.asarray(state.fu_ctr, np.int64)
+        if ctr.any():
+            self.obs.add_fused_counts(int(ctr[0]), int(ctr[1]),
+                                      int(ctr[2]))
+            state = state._replace(fu_ctr=jnp.zeros_like(state.fu_ctr))
         return state

@@ -1,0 +1,357 @@
+"""SIMT-tier superinstruction fusion: the ROADMAP #2 translation half.
+
+The discovery half (r12) ranks straight-line opcode n-grams as
+superinstruction candidates (`ModuleAnalysis.superinstructions`, keyed
+by `saved_dispatches`, loop-weighted by the CFG's `in_loop` marking).
+This module translates them: `plan_fusion` rewrites the top-K
+candidates' pc runs into fused dispatch cells — new DeviceImage planes
+(`fuse_len`, `fuse_pat`) naming, at each run HEAD, how many ops one
+`_make_step` dispatch retires and which specialized pattern handler
+does it — and `make_fused_apply` builds that handler at trace time by
+symbolically executing the pattern's stack effects (intermediates live
+in registers; one plane write-back per produced cell instead of one
+gather/scatter round per op).
+
+Safety rules (exactly the r12 CFG's):
+
+  - a run never spans a branch, call, branch target, or block
+    terminator — runs live strictly inside one basic block, and blocks
+    split at leaders, so fusion cannot change control-flow
+    observability;
+  - only pure stack/ALU op classes fuse (const, local/global
+    get/set/tee, drop/select, non-trapping alu1/alu2) — a fused run
+    cannot trap mid-flight;
+  - the original per-pc cells are never overwritten: a lane whose pc
+    sits mid-run (SIMT residue handoff, hostcall re-arm, hv swap-in
+    restore, checkpoint resume) executes the per-op stream until the
+    next head, and a lane without the fuel to retire the whole run
+    steps through the originals so gas exhaustion lands at the correct
+    op with per-op attribution — bit-exactness against the scalar
+    engine holds unconditionally (tests/test_fuse.py).
+
+Each constituent op keeps its `op_id`: the opcode histogram and the
+weighted-gas meter attribute per CONSTITUENT under fusion (histogram ==
+retired is pinned by test).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from wasmedge_tpu.batch.image import (
+    ALU1_SUB,
+    ALU2_F64_BASE,
+    ALU2_I32_BASE,
+    ALU2_I64_BASE,
+    CLS_ALU1,
+    CLS_ALU2,
+    CLS_CONST,
+    CLS_DROP,
+    CLS_GLOBAL_GET,
+    CLS_GLOBAL_SET,
+    CLS_LOCAL_GET,
+    CLS_LOCAL_SET,
+    CLS_LOCAL_TEE,
+    CLS_NOP,
+    CLS_SELECT,
+    _F64_BIN,
+    _I32_BIN,
+)
+
+# -- eligibility ------------------------------------------------------------
+# Pure stack-motion classes: no memory, no control, no traps.
+_PURE_CLS = frozenset((CLS_NOP, CLS_CONST, CLS_LOCAL_GET, CLS_LOCAL_SET,
+                       CLS_LOCAL_TEE, CLS_GLOBAL_GET, CLS_GLOBAL_SET,
+                       CLS_DROP, CLS_SELECT))
+
+# ALU2 subs that can trap (integer division families) or run under an
+# any-lane heavy conditional in the main step (iterative f64 div) stay
+# on the per-op path.
+_DIV_REM = ("div_s", "div_u", "rem_s", "rem_u")
+_ALU2_BLOCKED = frozenset(
+    {ALU2_I32_BASE + _I32_BIN.index(n) for n in _DIV_REM}
+    | {ALU2_I64_BASE + _I32_BIN.index(n) for n in _DIV_REM}
+    | {ALU2_F64_BASE + _F64_BIN.index("div")})
+
+# ALU1: the non-saturating float->int truncations trap
+# (laneops.alu1_trap_fns); f64.sqrt is the any-lane heavy kernel.
+_ALU1_BLOCKED = frozenset(
+    ALU1_SUB[n] for n in (
+        "i32.trunc_f32_s", "i32.trunc_f32_u",
+        "i32.trunc_f64_s", "i32.trunc_f64_u",
+        "i64.trunc_f32_s", "i64.trunc_f32_u",
+        "i64.trunc_f64_s", "i64.trunc_f64_u",
+        "f64.sqrt",
+    ) if n in ALU1_SUB)
+
+# Hard ceiling on merged pattern tables for concatenated multi-tenant
+# images (per-module planning is already capped by cfg.fuse_max_patterns).
+CONCAT_MAX_PATTERNS = 16
+
+
+def cell_eligible(cls: int, sub: int) -> bool:
+    """May the device cell (cls, sub) join a fused run?"""
+    if cls in _PURE_CLS:
+        return True
+    if cls == CLS_ALU1:
+        return sub not in _ALU1_BLOCKED
+    if cls == CLS_ALU2:
+        return sub not in _ALU2_BLOCKED
+    return False
+
+
+def fusion_active(img, cfg) -> bool:
+    """Will `_make_step(img, cfg, ...)` compile fused dispatch cells?
+    Shared by the step builder, the obs counter-plane allocator, and
+    the supervisor's ladder gating so they can never disagree."""
+    if not getattr(cfg, "fuse_superinstructions", True):
+        return False
+    flen = getattr(img, "fuse_len", None)
+    return flen is not None and bool((np.asarray(flen) >= 2).any())
+
+
+# -- the translation pass ---------------------------------------------------
+
+def plan_fusion(img, cfg=None, analysis=None) -> dict:
+    """Rewrite the top-K analyzer candidates' pc runs into fused cells.
+
+    Mutates `img` in place (fuse_len / fuse_pat / fuse_patterns /
+    fusion_report) and returns the report.  Pure numpy/python — no jax
+    import, so the analyze CLI can plan without the device stack.
+    `analysis` defaults to the image's lazily-bound ModuleAnalysis;
+    None (concatenated images, analyzer failure) plans nothing."""
+    from wasmedge_tpu.validator.image import lop_name
+
+    if cfg is None:
+        from wasmedge_tpu.common.configure import BatchConfigure
+
+        cfg = BatchConfigure()
+    top_k = max(int(getattr(cfg, "fuse_top_k", 12)), 0)
+    max_pat = max(int(getattr(cfg, "fuse_max_patterns", 8)), 0)
+    report = {
+        "enabled": bool(getattr(cfg, "fuse_superinstructions", True)),
+        "top_k": top_k,
+        "max_patterns": max_pat,
+        "patterns": 0,
+        "fused_runs": 0,
+        "fused_cells": 0,
+        "candidates": [],
+        "runs": [],
+    }
+    img.fusion_report = report
+    if not report["enabled"]:
+        return report
+    if analysis is None:
+        analysis = img.analysis
+    if analysis is None or not getattr(analysis, "superinstructions", None):
+        return report
+
+    cands = list(analysis.superinstructions[:top_k])
+    cand_rows = []
+    for c in cands:
+        cand_rows.append({
+            "ops": list(c["ops"]), "n": int(c["n"]),
+            "planned": int(c["count"]),
+            "saved_dispatches": int(c["saved_dispatches"]),
+            "eligible": False,
+            "realized_runs": 0, "realized_cells": 0,
+        })
+    report["candidates"] = cand_rows
+    if not cands:
+        return report
+
+    op_id = np.asarray(img.op_id)
+    names = [lop_name(int(x)) for x in op_id]
+    n_code = len(names)
+    flen = np.zeros(n_code, np.int32)
+    fpat = np.full(n_code, -1, np.int32)
+    assigned = np.zeros(n_code, bool)
+    patterns: List[tuple] = []
+    pat_idx = {}
+    runs: List[list] = []
+
+    for f in analysis.funcs:
+        for b in f.cfg.blocks:
+            # the straight-line run excludes the control terminator —
+            # the same rule the r12 census applied (a fused cell cannot
+            # span a dispatch exit)
+            end = b.end if b.kind == "fallthrough" else b.end - 1
+            end = min(end, n_code - 1)
+            i = b.start
+            while i <= end:
+                matched = False
+                for ci, c in enumerate(cands):
+                    n = int(c["n"])
+                    if i + n - 1 > end:
+                        continue
+                    if any(names[p] != nm
+                           for p, nm in zip(range(i, i + n), c["ops"])):
+                        continue
+                    cells = tuple((int(img.cls[p]), int(img.sub[p]))
+                                  for p in range(i, i + n))
+                    if not all(cell_eligible(cl, sb) for cl, sb in cells):
+                        continue
+                    cand_rows[ci]["eligible"] = True
+                    if any(assigned[p] for p in range(i, i + n)):
+                        continue
+                    k = pat_idx.get(cells)
+                    if k is None:
+                        if len(patterns) >= max_pat:
+                            continue
+                        k = len(patterns)
+                        patterns.append(cells)
+                        pat_idx[cells] = k
+                    flen[i] = n
+                    fpat[i] = k
+                    assigned[i:i + n] = True
+                    cand_rows[ci]["realized_runs"] += 1
+                    cand_rows[ci]["realized_cells"] += n
+                    runs.append([int(i), n, int(k)])
+                    i += n
+                    matched = True
+                    break
+                if not matched:
+                    i += 1
+
+    if patterns:
+        img.fuse_len = flen
+        img.fuse_pat = fpat
+        img.fuse_patterns = tuple(patterns)
+    report["patterns"] = len(patterns)
+    report["fused_runs"] = len(runs)
+    report["fused_cells"] = int(flen.sum())
+    report["runs"] = runs
+    return report
+
+
+# -- the fused step handler (trace-time builder) ----------------------------
+
+def make_fused_apply(img, lanes: int, has_simd: bool):
+    """Build the fused dispatch handler `_make_step` merges in.
+
+    For each realized pattern the builder symbolically executes the
+    (cls, sub) sequence over the lane planes: pops beyond what the
+    pattern produced gather lazily from the live stack, pushes stay in
+    registers, local/global writes scatter under the pattern mask as
+    they happen (so an in-pattern local.set -> local.get dependency
+    reads its own write), and the surviving register values write back
+    in one masked pass at the end.  Per-slot operands (local index,
+    immediate) gather from the ORIGINAL image planes at pc + slot, so
+    one pattern serves every run instance.
+
+    jit-purity lint target (tools/lint_jit_purity.py): everything
+    nested here runs under trace.
+    """
+    import jax.numpy as jnp
+
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    I32 = jnp.int32
+    lane_iota = jnp.arange(lanes, dtype=I32)
+    a_t = jnp.asarray(img.a)
+    ilo_t = jnp.asarray(img.imm_lo)
+    ihi_t = jnp.asarray(img.imm_hi)
+    pat_t = jnp.asarray(img.fuse_pat)
+    patterns = img.fuse_patterns
+    A2F = lo_ops.alu2_fns()
+    A1F = lo_ops.alu1_fns()
+    NC = 4 if has_simd else 2
+
+    def gat(plane, idx):
+        idx = jnp.clip(idx, 0, plane.shape[0] - 1)
+        return jnp.take_along_axis(plane, idx[None, :], axis=0)[0]
+
+    def scat(plane, idx, vals, mask):
+        idx = jnp.clip(idx, 0, plane.shape[0] - 1)
+        cur = jnp.take_along_axis(plane, idx[None, :], axis=0)[0]
+        return plane.at[idx, lane_iota].set(jnp.where(mask, vals, cur))
+
+    def fused_apply(stacks, globs, pc, sp, fp, is_fused):
+        """stacks = [lo, hi(, e2, e3)] value planes AFTER the per-op
+        path's writes (fused lanes' columns are untouched there —
+        masks are disjoint); globs = (glob_lo, glob_hi).  Returns
+        (stacks', globs', fused_sp) with fused lanes' effects applied;
+        non-fused lanes' columns pass through bit-unchanged."""
+        stacks = list(stacks)
+        glob_lo, glob_hi = globs
+        zl = jnp.zeros_like(sp)
+        fused_sp = sp
+        ng = glob_lo.shape[0]
+
+        def cell(lo, hi):
+            return (lo, hi) if NC == 2 else (lo, hi, zl, zl)
+
+        for k, pat in enumerate(patterns):
+            m = is_fused & (pat_t[pc] == k)
+            virt: list = []
+            taken = [0]
+
+            def ppop(virt=virt, taken=taken):
+                if virt:
+                    return virt.pop()
+                taken[0] += 1
+                idx = sp - taken[0]
+                return tuple(gat(p, idx) for p in stacks)
+
+            for j, (cls_j, sub_j) in enumerate(pat):
+                pcj = jnp.clip(pc + j, 0, a_t.shape[0] - 1)
+                if cls_j == CLS_NOP:
+                    continue
+                if cls_j == CLS_CONST:
+                    virt.append(cell(ilo_t[pcj], ihi_t[pcj]))
+                elif cls_j == CLS_LOCAL_GET:
+                    idx = fp + a_t[pcj]
+                    virt.append(tuple(gat(p, idx) for p in stacks))
+                elif cls_j in (CLS_LOCAL_SET, CLS_LOCAL_TEE):
+                    v = ppop()
+                    if cls_j == CLS_LOCAL_TEE:
+                        virt.append(v)
+                    idx = fp + a_t[pcj]
+                    for c in range(NC):
+                        stacks[c] = scat(stacks[c], idx, v[c], m)
+                elif cls_j == CLS_GLOBAL_GET:
+                    gi = jnp.clip(a_t[pcj], 0, ng - 1)
+                    gl = jnp.take_along_axis(glob_lo, gi[None, :], axis=0)[0]
+                    gh = jnp.take_along_axis(glob_hi, gi[None, :], axis=0)[0]
+                    virt.append(cell(gl, gh))
+                elif cls_j == CLS_GLOBAL_SET:
+                    v = ppop()
+                    gi = jnp.clip(a_t[pcj], 0, ng - 1)
+                    cl = jnp.take_along_axis(glob_lo, gi[None, :], axis=0)[0]
+                    ch = jnp.take_along_axis(glob_hi, gi[None, :], axis=0)[0]
+                    glob_lo = glob_lo.at[gi, lane_iota].set(
+                        jnp.where(m, v[0], cl))
+                    glob_hi = glob_hi.at[gi, lane_iota].set(
+                        jnp.where(m, v[1], ch))
+                elif cls_j == CLS_DROP:
+                    ppop()
+                elif cls_j == CLS_SELECT:
+                    cv = ppop()   # cond (top)
+                    v2 = ppop()   # val2
+                    v1 = ppop()   # val1
+                    cz = cv[0] == 0
+                    virt.append(tuple(jnp.where(cz, b_c, a_c)
+                                      for b_c, a_c in zip(v2, v1)))
+                elif cls_j == CLS_ALU1:
+                    v = ppop()
+                    rl, rh = A1F[sub_j](v[0], v[1])
+                    virt.append(cell(rl, rh))
+                elif cls_j == CLS_ALU2:
+                    y = ppop()
+                    x = ppop()
+                    rl, rh = A2F[sub_j](x[0], x[1], y[0], y[1])
+                    virt.append(cell(rl, rh))
+                else:  # planner bug: surface at trace time, not as
+                    # silent misexecution
+                    raise AssertionError(
+                        f"unfusable class {cls_j} in pattern {k}")
+            base = sp - taken[0]
+            for i, v in enumerate(virt):
+                for c in range(NC):
+                    stacks[c] = scat(stacks[c], base + i, v[c], m)
+            fused_sp = jnp.where(m, base + len(virt), fused_sp)
+        return stacks, (glob_lo, glob_hi), fused_sp
+
+    return fused_apply

@@ -353,6 +353,23 @@ class DeviceImage:
     # fd_write tier-0 is additionally gated on the module's import set —
     # see _T0_FD_UNSAFE_PREFIXES
     t0_fdwrite_safe: bool = False
+    # --- superinstruction fusion planes (batch/fuse.py plan_fusion) ---
+    # fuse_len[pc]: at the HEAD pc of a fused straight-line run, the
+    # number of constituent ops (>= 2); 0 everywhere else.  The
+    # original per-pc cells are NEVER overwritten — a lane whose pc
+    # sits mid-run (residue handoff, hostcall re-arm, swap-in restore)
+    # executes the original per-op stream until the next head, and a
+    # lane without the fuel to retire the whole run steps through the
+    # originals so gas exhaustion lands at the correct op.
+    fuse_len: np.ndarray = None
+    # fuse_pat[pc]: fused-cell pattern id at run heads, -1 elsewhere.
+    fuse_pat: np.ndarray = None
+    # Ordered pattern table: tuple of ((cls, sub), ...) per pattern id.
+    fuse_patterns: tuple = None
+    # Planner report: planned-vs-realized per analyzer candidate plus
+    # the realized run list (head pc, len, pattern) — the analyze CLI
+    # and the --fuse-smoke guard read it.  None = planning never ran.
+    fusion_report: dict = None
     # Static-analysis thunk (wasmedge_tpu/analysis/), bound at build
     # time and evaluated on FIRST ACCESS of `.analysis` — run/serve
     # startups that never read the report never pay for it.  Advisory

@@ -51,6 +51,11 @@ from wasmedge_tpu.batch.image import (
 
 _PAGE_WORDS = 65536 // 4
 
+# merged fused-pattern table cap for concatenated images (fuse.py is
+# numpy-only, so this import never pulls in the device stack)
+from wasmedge_tpu.batch.fuse import CONCAT_MAX_PATTERNS \
+    as _CONCAT_MAX_PATTERNS  # noqa: E402
+
 
 @dataclasses.dataclass
 class Tenant:
@@ -89,11 +94,24 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
     g_hi_parts = []
     eflat_parts, eoff_parts, elen_parts = [], [], []
     dword_parts, doff_parts, dlen_parts = [], [], []
+    # superinstruction fusion planes (batch/fuse.py): per-tenant runs
+    # concatenate with NO pc rebasing needed beyond the plane offset
+    # (runs are block-local); pattern ids remap into one deduped table
+    flen_parts, fpat_parts = [], []
+    merged_patterns: list = []
+    pat_map: dict = {}
+    any_fuse = False
     bases = []
     pc_b = fn_b = gl_b = ty_b = brt_b = tbl_b = 0
     eseg_b = eflat_b = dseg_b = dbyte_b = 0
     for t in tenants:
         img = t.img
+        # planning is deferred to first build — run each tenant's
+        # translation pass now so the concatenated planes see it
+        # (idempotent; knob off plans nothing)
+        plan = getattr(t.engine, "_plan_fusion", None)
+        if plan is not None:
+            plan()
         base = dict(pc=pc_b, func=fn_b, glob=gl_b, type=ty_b, brt=brt_b,
                     table=tbl_b)
         bases.append(base)
@@ -159,6 +177,30 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
                            else np.zeros(1, np.int32)) + dbyte_b)
         dlen_parts.append(img.data_len if img.data_len is not None
                           else np.zeros(1, np.int32))
+        t_flen = getattr(img, "fuse_len", None)
+        if t_flen is None:
+            flen_parts.append(np.zeros(img.code_len, np.int32))
+            fpat_parts.append(np.full(img.code_len, -1, np.int32))
+        else:
+            any_fuse = True
+            remap = {}
+            for ki, key in enumerate(img.fuse_patterns or ()):
+                k2 = pat_map.get(key)
+                if k2 is None:
+                    k2 = len(merged_patterns)
+                    merged_patterns.append(key)
+                    pat_map[key] = k2
+                remap[ki] = k2
+            flen2 = np.asarray(t_flen, np.int32).copy()
+            fpat2 = np.full(img.code_len, -1, np.int32)
+            for p in np.nonzero(flen2 >= 2)[0]:
+                k2 = remap.get(int(img.fuse_pat[p]), -1)
+                if 0 <= k2 < _CONCAT_MAX_PATTERNS:
+                    fpat2[p] = k2
+                else:
+                    flen2[p] = 0  # beyond the merged cap: stay per-op
+            flen_parts.append(flen2)
+            fpat_parts.append(fpat2)
         f_parts["f_entry"].append(img.f_entry + pc_b)
         f_parts["f_nparams"].append(img.f_nparams)
         f_parts["f_nlocals"].append(img.f_nlocals)
@@ -223,6 +265,20 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
                           for t in tenants),
         has_table_grow=any(getattr(t.img, "has_table_grow", False)
                            for t in tenants),
+        fuse_len=np.concatenate(flen_parts) if any_fuse else None,
+        fuse_pat=np.concatenate(fpat_parts) if any_fuse else None,
+        fuse_patterns=tuple(merged_patterns[:_CONCAT_MAX_PATTERNS])
+        if any_fuse else None,
+        fusion_report={
+            "enabled": any_fuse,
+            "patterns": min(len(merged_patterns), _CONCAT_MAX_PATTERNS),
+            # recomputed from the MERGED planes (a run whose pattern
+            # fell beyond the merged cap reverted to per-op cells and
+            # must not be counted)
+            "fused_runs": int(sum((p >= 2).sum() for p in flen_parts)),
+            "fused_cells": int(sum(p.sum() for p in flen_parts)),
+            "candidates": [], "runs": [],
+        },
     )
     return image, bases
 

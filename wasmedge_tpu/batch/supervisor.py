@@ -163,6 +163,9 @@ class BatchSupervisor:
         from wasmedge_tpu.obs.recorder import recorder_of
 
         self.engine = engine
+        # pristine reference: run() restores it so a fused->unfused
+        # demotion in one run() never silently de-fuses later runs
+        self._engine0 = engine
         self.conf = conf if conf is not None else engine.conf
         self.k = self.conf.supervisor
         self.stats = stats
@@ -179,10 +182,11 @@ class BatchSupervisor:
     # -- public -----------------------------------------------------------
     def run(self, func_name: Optional[str] = None, args_lanes=None,
             max_steps: int = 10_000_000):
-        eng = self.engine
+        self.engine = eng = self._engine0
         self._multi = hasattr(eng, "tenants")
         self._max_steps = int(max_steps)
         self._overlay = {}
+        self._replay_tier = False
         if not self._multi:
             ex = eng.inst.exports.get(func_name)
             if ex is None or ex[0] != 0:
@@ -210,6 +214,16 @@ class BatchSupervisor:
                 and not self._resumed:
             tiers.append("pallas")
         tiers.append("simt")
+        # a fused-step fault demotes to the UNFUSED SIMT build before
+        # the scalar rung: same image, same state geometry (fusion adds
+        # no lane planes), checkpoints transfer untouched — only the
+        # compiled step program changes (batch/fuse.py).  Gated here on
+        # the KNOB only: whether the image actually realized fused
+        # cells is decided at demotion time, when the SIMT rung has
+        # already planned — keeping the lazy-analyzer guarantee for
+        # runs the kernel tier serves outright.
+        if getattr(self.engine.cfg, "fuse_superinstructions", True):
+            tiers.append("simt_unfused")
         if self._scalar_ok():
             tiers.append("scalar")
         last_exc = None
@@ -224,7 +238,18 @@ class BatchSupervisor:
                         ran = False  # ineligible: no residency to record
                         continue
                     return res
-                if tier == "simt":
+                if tier in ("simt", "simt_unfused"):
+                    if tier == "simt_unfused":
+                        from wasmedge_tpu.batch.fuse import fusion_active
+
+                        if not fusion_active(self.engine.img,
+                                             self.engine.cfg):
+                            # the SIMT rung compiled nothing fused (no
+                            # realized runs, or already demoted):
+                            # nothing to un-fuse, fall through
+                            ran = False
+                            continue
+                        self._demote_unfused()
                     state, total = self._run_simt_tier(max_steps)
                     if self._multi:
                         return self.engine.results_from_state(state, total)
@@ -254,6 +279,36 @@ class BatchSupervisor:
             self.failures)
 
     # -- ladder tiers -----------------------------------------------------
+    def _demote_unfused(self):
+        """Swap the supervised engine for a shallow clone whose step
+        builder compiles the seed per-op path (fuse knob off).  The
+        clone shares image, instance, stats, and recorder; fusion adds
+        no state planes, so the fused tier's checkpoints restore onto
+        it bit-exactly (the image fingerprint ignores fusion planes).
+        The newest surviving lineage member is adopted so the unfused
+        rung continues from the fused rung's progress instead of
+        replaying from scratch."""
+        import copy
+        import dataclasses as _dc
+
+        eng = copy.copy(self.engine)
+        eng.cfg = _dc.replace(eng.cfg, fuse_superinstructions=False)
+        # keep conf.batch consistent with cfg: the obs plane allocator
+        # (obs_state_planes reads conf.batch) must agree with the step
+        # builder that this rung compiles nothing fused — fusion_active
+        # can never disagree across the two
+        eng.conf = copy.copy(eng.conf)
+        eng.conf.batch = eng.cfg
+        eng._step = None
+        eng._run_chunk = None
+        self.engine = eng
+        self._replay_tier = True
+        got = self._lineage.walk_newest(self._load_member,
+                                        self._bad_member)
+        if got is not None:
+            self._adopted = got
+            self._resumed = True
+
     def _run_kernel_tier(self, max_steps):
         from wasmedge_tpu.batch.pallas_engine import (
             PallasUniformEngine, pallas_enabled)
@@ -278,10 +333,16 @@ class BatchSupervisor:
             self._adopted = None
             self._restored_from = self._lineage.newest().path
         else:
-            # a fresh (non-resumed) run starts a fresh output stream
+            # a fresh (non-resumed) run starts a fresh output stream; a
+            # demoted-from-fused replay keeps the written high-water
+            # mark so tier-0 output stays exactly-once across the
+            # fused -> unfused restart (the clone shares the engine's
+            # stdout cursor)
             from wasmedge_tpu.batch.hostcall import stdout_cursor_reset
 
-            stdout_cursor_reset(self.engine)
+            stdout_cursor_reset(self.engine,
+                                keep_highwater=getattr(
+                                    self, "_replay_tier", False))
             state, total = self._initial_state(), 0
         consecutive = 0
         fail_keys = {}

@@ -781,8 +781,23 @@ def analyze_command(argv: List[str], out=None, err=None) -> int:
     analysis = analyze_validated(mod)
     report = analysis.to_dict()
     report["file"] = path
+    # superinstruction translation view (batch/fuse.py): plan the fused
+    # dispatch cells the batch engine would realize, so the report
+    # shows planned-vs-realized per candidate.  numpy-only (no jax);
+    # a planner failure degrades to a report without the section.
+    fusion = None
+    try:
+        from wasmedge_tpu.batch.fuse import plan_fusion
+        from wasmedge_tpu.batch.image import build_device_image
+
+        img = build_device_image(mod.lowered, mod=mod)
+        fusion = plan_fusion(img, conf.batch, analysis=analysis)
+        report["fusion"] = fusion
+    except Exception as e:  # advisory section, never a CLI failure
+        err.write(f"wasmedge-tpu: fusion planning skipped: {e!r}\n")
     if p._opts["disasm"].value:
-        report["disasm"] = analysis.annotated_disasm(mod.lowered)
+        report["disasm"] = analysis.annotated_disasm(mod.lowered,
+                                                     fusion=fusion)
     text = json.dumps(report,
                       indent=None if p._opts["compact"].value else 2)
     if p._opts["out"].seen:

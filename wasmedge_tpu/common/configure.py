@@ -113,6 +113,22 @@ class BatchConfigure:
     # of one per instruction).  None = on; False falls back to the
     # legacy peephole superinstruction fuser.
     block_fusion: Optional[bool] = None
+    # --- SIMT-tier superinstruction fusion (batch/fuse.py) ---
+    # Rewrite the analyzer's top straight-line candidates into fused
+    # dispatch cells at image-build time: ONE _make_step dispatch
+    # retires the whole run's stack effects (each constituent op keeps
+    # its op_id for gas/opcode-histogram attribution).  Off compiles
+    # the bit-identical seed per-op step; results are bit-identical
+    # either way (pinned against the scalar engine and the unfused
+    # SIMT build, tests/test_fuse.py).
+    fuse_superinstructions: bool = True
+    # How many ranked analyzer candidates the translation pass consumes
+    # (ModuleAnalysis.superinstructions order: saved_dispatches).
+    fuse_top_k: int = 12
+    # Distinct fused (class, sub) cell patterns compiled into one step
+    # function (each pattern is a specialized straight-line handler;
+    # more patterns = bigger traced step).
+    fuse_max_patterns: int = 8
     # --- three-tier hostcall pipeline knobs (batch/hostcall.py) ---
     # Tier 0: service pure WASI calls (clock_time_get / random_get /
     # sched_yield / proc_exit / fd_write-to-buffered-stdout) directly in

@@ -277,6 +277,23 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
             for tier in sorted(recorder.tier_seconds):
                 w.sample("wasmedge_tier_residency_seconds",
                          {"tier": tier}, recorder.tier_seconds[tier])
+        fused = getattr(recorder, "fused_counts", None)
+        if fused and fused.get("retired_total"):
+            w.head("wasmedge_fused_dispatches_total", "counter",
+                   "Fused superinstruction dispatch cells executed on "
+                   "the SIMT tier (each retires a whole straight-line "
+                   "run in one dispatch, batch/fuse.py).")
+            w.sample("wasmedge_fused_dispatches_total", None,
+                     int(fused.get("dispatches", 0)))
+            w.head("wasmedge_retired_by_path_total", "counter",
+                   "Instructions retired by dispatch path: fused "
+                   "superinstruction cells vs per-op dispatch.")
+            rf = int(fused.get("retired_fused", 0))
+            rt = int(fused.get("retired_total", 0))
+            w.sample("wasmedge_retired_by_path_total",
+                     {"path": "fused"}, rf)
+            w.sample("wasmedge_retired_by_path_total",
+                     {"path": "unfused"}, max(rt - rf, 0))
         if recorder.opcode_counts is not None:
             from wasmedge_tpu.validator.image import lop_name
 

@@ -132,6 +132,9 @@ class NullRecorder:
     def add_opcode_counts(self, counts):
         pass
 
+    def add_fused_counts(self, dispatches, retired_fused, retired_total):
+        pass
+
     def failure(self, rec):
         pass
 
@@ -183,6 +186,10 @@ class FlightRecorder:
         self.tier_seconds = {}     # tier -> accumulated seconds
         self.failure_counts = {}   # fault_class -> count
         self.opcode_counts = None  # np.int64 [NUM_OPCODES+3] when folded
+        # superinstruction-fusion counters folded from the device
+        # fu_ctr plane (batch/engine.py _fold_fuse_ctr)
+        self.fused_counts = {"dispatches": 0, "retired_fused": 0,
+                             "retired_total": 0}
 
     # The recorder is a shared sink, not configuration data: components
     # deepcopy their Configure (gas bridging, scalar reruns) and must
@@ -258,6 +265,14 @@ class FlightRecorder:
     def add_tier_seconds(self, tier, dur_s):
         self.tier_seconds[tier] = \
             self.tier_seconds.get(tier, 0.0) + float(dur_s)
+
+    def add_fused_counts(self, dispatches, retired_fused, retired_total):
+        """Fold the device fusion counters (fused dispatch cells
+        executed / instructions retired through them / total retired
+        while the plane was live — batch/engine.py _fold_fuse_ctr)."""
+        self.fused_counts["dispatches"] += int(dispatches)
+        self.fused_counts["retired_fused"] += int(retired_fused)
+        self.fused_counts["retired_total"] += int(retired_total)
 
     def add_opcode_counts(self, counts):
         """Fold a device-side opcode histogram (index = original opcode
