@@ -770,6 +770,220 @@ def _fuse_echo_engine(conf, lanes, sink_path):
     return BatchEngine(inst, store=store, conf=conf, lanes=lanes), sink
 
 
+def _emit_fusion_report(rep: dict, default_path: str):
+    """Write a full realized-fusion report as a sibling artifact file
+    (no stdout line — the driver parses exactly one JSON line per
+    bench).  Honors the BENCH_ARTIFACT redirects."""
+    from wasmedge_tpu.utils.bench_artifact import artifact_path
+
+    path = artifact_path(default_path)
+    if path is None:
+        return
+    try:
+        with open(path, "w") as f:
+            f.write(json.dumps(rep, indent=2, sort_keys=True,
+                               default=int) + "\n")
+    except OSError:
+        pass
+
+
+def _compact_fib_engine(compact: bool, lanes: int, chunk: int,
+                        forced: bool = False):
+    """SIMT flagship rig with the lane-compaction knob pinned (fusion
+    stays at its default on both sides — the A/B isolates compaction).
+    `forced` pins the policy fully open (smoke geometry: tiny mixes
+    would not clear the production cost model)."""
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.common.configure import Configure
+
+    conf = Configure()
+    conf.batch.compact = compact
+    conf.batch.steps_per_launch = chunk
+    conf.batch.value_stack_depth = 256
+    conf.batch.call_stack_depth = 256
+    if forced:
+        conf.batch.compact_min_interval = 1
+        conf.batch.compact_trigger = 0.0
+        conf.batch.compact_cost_factor = 0.0
+        conf.batch.compact_width_floor = 8
+    inst, store = _instantiate_fib(conf)
+    return BatchEngine(inst, store=store, conf=conf, lanes=lanes)
+
+
+def compact_smoke() -> int:
+    """`bench.py --compact-smoke`: the lane-compaction CI guard.
+    Divergent fib mix with compaction on vs off at identical geometry:
+    results bit-identical, >= 1 compaction fired, and strictly fewer
+    dispatch slots (steps x dispatch width) when on — i.e. more
+    retired instructions per dispatch.  Prints ONE JSON line; emits no
+    artifact (correctness guard, not a throughput claim)."""
+    t0 = time.perf_counter()
+    lanes = 32
+    ns = (4 + np.arange(lanes, dtype=np.int64) % 9)
+    np.random.default_rng(7).shuffle(ns)
+    expect = np.asarray([_fib(int(n)) for n in ns], np.int64)
+    res = {}
+    stats = None
+    for compact in (True, False):
+        eng = _compact_fib_engine(compact, lanes, chunk=64, forced=True)
+        res[compact] = eng.run("fib", [ns], max_steps=5_000_000)
+        if compact:
+            stats = dict(eng.compactor.stats)
+    a, b = res[True], res[False]
+    slots_on = int(stats["dispatch_slots"])
+    slots_off = int(b.steps) * lanes
+    checks = {
+        "correct": bool(a.completed.all()
+                        and (np.asarray(a.results[0]) == expect).all()),
+        "bit_identical": bool(
+            (a.results[0] == b.results[0]).all()
+            and (a.trap == b.trap).all()
+            and (a.retired == b.retired).all()),
+        "compactions_fired": int(stats["fires"]) >= 1,
+        "fewer_dispatch_slots": slots_on < slots_off,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "compact_smoke_bit_identity",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "fires": int(stats["fires"]),
+        "dispatch_slots_on": slots_on,
+        "dispatch_slots_off": slots_off,
+        "min_width": int(stats["min_width"]),
+        "lanes": lanes,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }))
+    return 0 if ok else 1
+
+
+def compact_bench() -> int:
+    """`bench.py --compact-bench`: obs-off divergent-mix A/B — lane
+    compaction on vs off at identical geometry on the SIMT tier
+    (fusion at its default both sides) — plus the flagship
+    (already-convergent) guard proving the trigger never regresses a
+    convergent workload.  Emits BENCH_r18.json and the realized-fusion
+    sibling BENCH_r18.fusion.json.  Geometry scales via BENCH_DIV_* /
+    BENCH_FUSE_FIB_N / BENCH_FUSE_LANES / BENCH_COMPACT_CHUNK; the
+    metric names record the actual geometry."""
+    import os
+
+    import jax
+
+    fib_n = int(os.environ.get("BENCH_FUSE_FIB_N", "15"))
+    lanes = int(os.environ.get("BENCH_FUSE_LANES", "4096"))
+    div_lanes = int(os.environ.get("BENCH_DIV_LANES", str(lanes)))
+    div_lo = int(os.environ.get("BENCH_DIV_LO", "8"))
+    div_hi = int(os.environ.get("BENCH_DIV_HI", "14"))
+    chunk = int(os.environ.get("BENCH_COMPACT_CHUNK", "2048"))
+    out = {"metric": f"compact_ab_fib{div_lo}to{div_hi}_x{div_lanes}",
+           "unit": "wasm_instr/s", "backend": jax.default_backend(),
+           "obs": False, "div_lanes": div_lanes, "chunk": chunk,
+           "fib_n": fib_n, "lanes": lanes}
+
+    # ---- divergent mix A/B: compaction on vs off ----
+    ns = div_lo + (np.arange(div_lanes, dtype=np.int64)
+                   % (div_hi - div_lo + 1))
+    np.random.default_rng(42).shuffle(ns)
+    expect = np.asarray([_fib(int(n)) for n in ns], np.int64)
+    div = {}
+    results = {}
+    stats = None
+    for compact in (True, False):
+        eng = _compact_fib_engine(compact, div_lanes, chunk)
+        # warmup runs the FULL mix once: the divergent live-count
+        # trajectory is what triggers the narrowed-width variants, so
+        # a shrunken warmup would leave their compiles inside the
+        # timed region (both arms get the identical warmup)
+        eng.run("fib", [ns], max_steps=2_000_000_000)
+        t0 = time.perf_counter()
+        res = eng.run("fib", [ns], max_steps=2_000_000_000)
+        dt = time.perf_counter() - t0
+        assert res.completed.all() and \
+            (np.asarray(res.results[0], np.int64) == expect).all(), \
+            "divergent wrong result"
+        retired = float(np.asarray(res.retired, np.float64).sum())
+        results[compact] = res
+        key = "compact" if compact else "baseline"
+        if compact:
+            stats = dict(eng.compactor.stats)
+            slots = int(stats["dispatch_slots"])
+        else:
+            slots = int(res.steps) * div_lanes
+        div[key] = {
+            "ops_per_sec": round(retired / dt, 1),
+            "wall_s": round(dt, 2), "steps": int(res.steps),
+            "dispatch_slots": slots,
+            "retired_per_dispatch_slot": round(retired / max(slots, 1),
+                                               4),
+        }
+        if compact:
+            div[key]["compactions"] = int(stats["fires"])
+            div[key]["min_width"] = int(stats["min_width"])
+            rep = eng.img.fusion_report or {}
+            _emit_fusion_report(rep, "BENCH_r18.fusion.json")
+            out["realized_fusion"] = {
+                "patterns": rep.get("patterns", 0),
+                "fused_runs": rep.get("fused_runs", 0),
+                "fused_cells": rep.get("fused_cells", 0),
+            }
+    a, b = results[True], results[False]
+    div["bit_identical"] = bool(
+        (a.results[0] == b.results[0]).all()
+        and (a.trap == b.trap).all() and (a.retired == b.retired).all())
+    div["speedup"] = round(div["compact"]["ops_per_sec"]
+                           / max(div["baseline"]["ops_per_sec"], 1e-9),
+                           4)
+    out["divergent_mix"] = div
+    out["value"] = div["compact"]["ops_per_sec"]
+    out["speedup"] = div["speedup"]
+
+    # ---- flagship guard: convergent workload, trigger must not fire
+    # into a regression ----
+    flag = {}
+    expected = _fib(fib_n)
+    for compact in (True, False):
+        eng = _compact_fib_engine(compact, lanes, chunk)
+        eng.run("fib", [np.full(lanes, WARMUP_N, np.int64)],
+                max_steps=10_000_000)
+        t0 = time.perf_counter()
+        res = eng.run("fib", [np.full(lanes, fib_n, np.int64)],
+                      max_steps=500_000_000)
+        dt = time.perf_counter() - t0
+        assert res.completed.all() and \
+            (res.results[0] == expected).all(), "flagship wrong result"
+        retired = float(np.asarray(res.retired, np.float64).sum())
+        key = "compact" if compact else "baseline"
+        flag[key] = {"ops_per_sec": round(retired / dt, 1),
+                     "wall_s": round(dt, 2)}
+        if compact:
+            flag["compactions"] = int(eng.compactor.stats["fires"])
+    flag["ratio"] = round(flag["compact"]["ops_per_sec"]
+                          / max(flag["baseline"]["ops_per_sec"], 1e-9),
+                          4)
+    flag["metric"] = f"flagship_fib{fib_n}_x{lanes}_compact_guard"
+    out["flagship_guard"] = flag
+
+    ok = (div["speedup"] > 1.0 and div["bit_identical"]
+          and div["compact"]["retired_per_dispatch_slot"]
+          > div["baseline"]["retired_per_dispatch_slot"]
+          and div["compact"]["compactions"] >= 1
+          and flag["ratio"] >= 0.95)
+    out["ok"] = bool(ok)
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "BENCH_r18.json")
+    print(f"# divergent speedup={div['speedup']} "
+          f"slots {div['compact']['dispatch_slots']} vs "
+          f"{div['baseline']['dispatch_slots']} "
+          f"compactions={div['compact']['compactions']} "
+          f"min_width={div['compact']['min_width']} "
+          f"flagship_ratio={flag['ratio']}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def fuse_smoke() -> int:
     """`bench.py --fuse-smoke`: the superinstruction-fusion CI guard.
     Asserts (a) the translation pass realizes fused cells on the
@@ -867,8 +1081,14 @@ def fuse_bench() -> int:
 
     fib_n = int(os.environ.get("BENCH_FUSE_FIB_N", "15"))
     lanes = int(os.environ.get("BENCH_FUSE_LANES", "4096"))
-    div_lo = int(os.environ.get("BENCH_FUSE_DIV_LO", "8"))
-    div_hi = int(os.environ.get("BENCH_FUSE_DIV_HI", "14"))
+    # the divergent phase scales independently of the flagship (r18:
+    # BENCH_DIV_*; the old BENCH_FUSE_DIV_* names stay as fallbacks,
+    # and BENCH_DIV_LANES defaults to the flagship width)
+    div_lanes = int(os.environ.get("BENCH_DIV_LANES", str(lanes)))
+    div_lo = int(os.environ.get(
+        "BENCH_DIV_LO", os.environ.get("BENCH_FUSE_DIV_LO", "8")))
+    div_hi = int(os.environ.get(
+        "BENCH_DIV_HI", os.environ.get("BENCH_FUSE_DIV_HI", "14")))
     import jax
 
     out = {"metric": f"fusion_ab_fib{fib_n}_x{lanes}",
@@ -916,7 +1136,7 @@ def fuse_bench() -> int:
 
     # ---- divergent mix (floor re-measure, fusion on vs off) ----
     div = {}
-    ns = div_lo + (np.arange(lanes, dtype=np.int64)
+    ns = div_lo + (np.arange(div_lanes, dtype=np.int64)
                    % (div_hi - div_lo + 1))
     np.random.default_rng(42).shuffle(ns)
     expect = np.asarray([_fib(int(n)) for n in ns], np.int64)
@@ -928,7 +1148,7 @@ def fuse_bench() -> int:
         conf.batch.call_stack_depth = 256
         inst, store = _inst_of(conf, build_fib())
         eng = UniformBatchEngine(inst, store=store, conf=conf,
-                                 lanes=lanes)
+                                 lanes=div_lanes)
         eng.run("fib", [np.maximum(ns - 6, 1)], max_steps=50_000_000)
         t0 = time.perf_counter()
         res = eng.run("fib", [ns], max_steps=2_000_000_000)
@@ -940,9 +1160,22 @@ def fuse_bench() -> int:
         div["fused" if fuse else "unfused"] = {
             "ops_per_sec": round(retired / dt, 1),
             "wall_s": round(dt, 2)}
+        if fuse:
+            # the realized-fusion report is the block-selection input
+            # ROADMAP #2's kernel-tier follow-on consumes: record it
+            # alongside the artifact (trimmed into the JSON, full
+            # report as a sibling file below)
+            rep = eng.simt.img.fusion_report or {}
+            div["realized_fusion"] = {
+                "patterns": rep.get("patterns", 0),
+                "fused_runs": rep.get("fused_runs", 0),
+                "fused_cells": rep.get("fused_cells", 0),
+                "candidates": rep.get("candidates", []),
+            }
+            _emit_fusion_report(rep, "BENCH_r17.fusion.json")
     div["speedup"] = round(div["fused"]["ops_per_sec"]
                            / max(div["unfused"]["ops_per_sec"], 1e-9), 4)
-    div["metric"] = f"divergent_fib{div_lo}to{div_hi}_x{lanes}"
+    div["metric"] = f"divergent_fib{div_lo}to{div_hi}_x{div_lanes}"
     out["divergent_mix"] = div
 
     # ---- multi-tenant mix (floor re-measure, fusion on vs off) ----
@@ -2235,6 +2468,10 @@ if __name__ == "__main__":
         sys.exit(fuse_smoke())
     if "--fuse-bench" in sys.argv[1:]:
         sys.exit(fuse_bench())
+    if "--compact-smoke" in sys.argv[1:]:
+        sys.exit(compact_smoke())
+    if "--compact-bench" in sys.argv[1:]:
+        sys.exit(compact_bench())
     if "--gateway-smoke" in sys.argv[1:]:
         sys.exit(gateway_smoke())
     if "--gateway" in sys.argv[1:]:

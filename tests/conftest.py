@@ -48,6 +48,10 @@ def pytest_configure(config):
         "markers", "fuse: SIMT superinstruction-fusion suite "
         "(translation pass, fused-dispatch bit-exactness, ladder "
         "demotion; tier-1 fast, runs under -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "compact: divergence-aware lane-compaction suite "
+        "(PC-sorted regrouping, serving/hv/checkpoint permutation "
+        "remap; tier-1 fast, runs under -m 'not slow')")
 
 
 def pytest_addoption(parser):

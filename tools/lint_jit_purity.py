@@ -30,7 +30,8 @@ from typing import List, Tuple
 # regions (the builders return traced callables; everything nested in
 # them runs under trace)
 TARGETS = {
-    "wasmedge_tpu/batch/engine.py": ("_make_step", "_build"),
+    "wasmedge_tpu/batch/engine.py": ("_make_step", "_build",
+                                     "_build_narrow_chunk"),
     "wasmedge_tpu/batch/uniform.py": ("make_uniform_step",
                                       "_build_uniform"),
     "wasmedge_tpu/serve/recycle.py": ("_install_fn",),
@@ -43,6 +44,10 @@ TARGETS = {
     # engine's chunk body (the body itself is covered by engine.py's
     # targets; this keeps the mesh-side wrapper honest too)
     "wasmedge_tpu/parallel/shard_drive.py": ("_build_shard_chunk",),
+    # lane compaction (batch/compact.py): the jitted gather-permutation
+    # builder; the narrowed chunk variant traces inside the engine's
+    # _build_narrow_chunk, covered alongside the main builders
+    "wasmedge_tpu/batch/compact.py": ("make_permute",),
 }
 
 # Dotted-call prefixes that are host-side nondeterminism (or host

@@ -117,6 +117,14 @@ def validate_report(doc) -> List[str]:
                         and runs_n > planned:
                     problems.append(
                         f"{where}: realized_runs > planned")
+                # planned-vs-realized delta (r18): when present it must
+                # reconcile with the counts it summarizes
+                if isinstance(c.get("delta_runs"), int) \
+                        and planned is not None and runs_n is not None \
+                        and c["delta_runs"] != planned - runs_n:
+                    problems.append(
+                        f"{where}: delta_runs != planned - "
+                        f"realized_runs")
                 if runs_n:
                     realized_total += runs_n
             if fcands is not None and isinstance(

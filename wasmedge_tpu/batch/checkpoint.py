@@ -109,6 +109,14 @@ def save(path, engine: BatchEngine, state: BatchState, total_steps: int,
             pos, _ = _stdout_cursor(engine,
                                     int(np.asarray(state.so_off).size))
             arrays["stdout_pos"] = np.asarray(pos, np.int64)
+    # lane-compaction permutation (batch/compact.py): the src mapping
+    # must roll back with the state on restore, or results would come
+    # back lane-shuffled after a crash mid-compacted-run.  Serving
+    # engines never carry one (the server's binding journal is already
+    # permuted consistently with the snapshot).
+    comp = getattr(engine, "compactor", None)
+    if comp is not None and not comp.identity:
+        arrays["lane_src"] = np.asarray(comp.src, np.int64)
     for name, arr in (extra_arrays or {}).items():
         if name.startswith("state_") or name in arrays:
             raise ValueError(f"extra array name {name!r} collides with "
@@ -226,6 +234,12 @@ def load(path, engine: BatchEngine) -> Tuple[BatchState, int]:
             pos, hw = _stdout_cursor(engine, journaled.size)
             pos[:] = journaled
             np.maximum(hw, journaled, out=hw)
+        # roll the lane-compaction mapping back to this snapshot's
+        # (identity when the snapshot predates any compaction)
+        from wasmedge_tpu.batch.compact import restore_lane_src
+
+        restore_lane_src(engine, np.asarray(z["lane_src"], np.int64)
+                         if "lane_src" in z.files else None)
     return BatchState(**fields), meta["total_steps"]
 
 

@@ -1785,6 +1785,12 @@ class BatchEngine:
         from wasmedge_tpu.obs.recorder import recorder_of
 
         self.obs = recorder_of(self.conf)
+        # divergence-aware lane compaction (batch/compact.py): armed
+        # per run by the fixed-cohort drivers (run/ShardDrive/uniform
+        # handoff/supervisor SIMT tier); the serving layer sets
+        # _compact_external and owns its own compactor instead, so the
+        # engine never permutes under a server's lane bindings
+        self.compactor = None
         if img is not None:
             # share an already-built (and already-normalized) image — the
             # scheduler derives width-variant engines from one module
@@ -1999,6 +2005,73 @@ class BatchEngine:
             self._run_chunk = jax.jit(run_chunk, donate_argnums=donate)
         self._step = step
 
+    def _build_narrow_chunk(self, width: int):
+        """Chunk loop at a live-prefix dispatch width < lanes (lane
+        compaction's narrowing rung, batch/compact.py): the step
+        retraces at `width`, each launch slices the live prefix out of
+        the full-width state, drives it, and writes it back in place.
+        Lanes beyond the prefix are guaranteed dead (trap != 0 and
+        never TRAP_HOSTCALL) by the compactor's sort, so skipping them
+        cannot change any observable state; laneless obs planes
+        (op_hist, fu_ctr) ride the narrow loop and replace the full
+        state's copies wholesale.
+
+        jit-purity lint target (tools/lint_jit_purity.py): everything
+        nested here runs under trace.
+        """
+        from wasmedge_tpu.batch import ensure_jax_backend
+
+        ensure_jax_backend()
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        step = _make_step(self.img, self.cfg, width,
+                          t0kinds=getattr(self, "_t0kinds", None))
+        chunk = self.cfg.steps_per_launch
+        lanes = self.lanes
+
+        def run_chunk_narrow(state, t0_time):
+            fields = {}
+            lane_fields = []
+            for name in state._fields:
+                p = getattr(state, name)
+                if p is None:
+                    fields[name] = None
+                elif p.ndim and p.shape[-1] == lanes:
+                    lane_fields.append(name)
+                    fields[name] = p[..., :width]
+                else:
+                    fields[name] = p
+            ns = BatchState(**fields)
+
+            def cond(carry):
+                i, s = carry
+                return (i < chunk) & jnp.any(s.trap == 0)
+
+            def body(carry):
+                i, s = carry
+                return i + 1, step(s, t0_time)
+
+            i, ns = lax.while_loop(cond, body, (jnp.int32(0), ns))
+            updates = {}
+            for name in state._fields:
+                p = getattr(state, name)
+                if p is None:
+                    continue
+                if name in lane_fields:
+                    updates[name] = p.at[..., :width].set(
+                        getattr(ns, name))
+                else:
+                    updates[name] = getattr(ns, name)
+            return i, state._replace(**updates)
+
+        donate = (0,)
+        if jax.default_backend() == "cpu" and \
+                getattr(jax.config, "jax_compilation_cache_dir", None):
+            donate = ()
+        return jax.jit(run_chunk_narrow, donate_argnums=donate)
+
     def initial_state(self, func_idx: int, args_lanes: List[np.ndarray]):
         import jax.numpy as jnp
 
@@ -2056,6 +2129,11 @@ class BatchEngine:
         from wasmedge_tpu.batch.hostcall import stdout_cursor_reset
 
         stdout_cursor_reset(self)
+        # divergence-aware lane compaction (batch/compact.py): fresh
+        # identity mapping per cohort run; off = None = seed path
+        from wasmedge_tpu.batch.compact import arm
+
+        arm(self)
         state = self.initial_state(func_idx, args_lanes)
         if self.mesh is not None:
             from wasmedge_tpu.parallel.mesh import shard_batch_state
@@ -2065,7 +2143,12 @@ class BatchEngine:
         nres = int(self.inst.lowered.funcs[func_idx].nresults)
         stack_lo = np.asarray(state.stack_lo)
         stack_hi = np.asarray(state.stack_hi)
-        fp = np.asarray(state.fp)
+        # compaction moved lanes: gather mirrors back to original order
+        from wasmedge_tpu.batch.compact import restore_mirrors
+
+        stack_lo, stack_hi, trap, retired = restore_mirrors(
+            self.compactor, stack_lo, stack_hi,
+            np.asarray(state.trap), np.asarray(state.retired))
         results = []
         for r in range(nres):
             lo = stack_lo[r].view(np.uint32).astype(np.uint64)
@@ -2073,8 +2156,8 @@ class BatchEngine:
             results.append((lo | (hi << np.uint64(32))).view(np.int64))
         return BatchResult(
             results=results,
-            trap=np.asarray(state.trap),
-            retired=np.asarray(state.retired),
+            trap=trap,
+            retired=retired,
             steps=total,
         )
 
@@ -2128,19 +2211,29 @@ class BatchEngine:
         # from the trap mirror this loop already gathers every round
         round_hook = getattr(self, "_round_hook", None)
         obs = self.obs
+        # divergence-aware lane compaction (batch/compact.py): armed by
+        # the cohort drivers only — a serving engine's compactor is
+        # always None (the server remaps its own binding tables)
+        comp = self.compactor
         if obs.enabled:
             prev_ret = int(np.asarray(state.retired, np.int64).sum())
         while total < max_steps:
             if cancel is not None and cancel():
                 break
+            if comp is not None:
+                state = comp.boundary(self, state)
             # per-relaunch time base: host->device only, no round trip
             # (rides the launch as a non-donated argument)
             tt = jnp.asarray(t0_time_planes() if t0_active else dummy_time)
             if fault is not None:
                 fault("launch", total=total)
             t_launch = obs.now()
-            done_steps, state = self._run_chunk(state, tt)
+            run_chunk = self._run_chunk if comp is None \
+                else comp.chunk_fn(self)
+            done_steps, state = run_chunk(state, tt)
             total += int(done_steps)
+            if comp is not None:
+                comp.note_launch(int(done_steps))
             trap_host = np.asarray(state.trap)
             parked = int((trap_host == TRAP_HOSTCALL).sum())
             if round_hook is not None:
@@ -2157,6 +2250,14 @@ class BatchEngine:
                 prev_ret = ret
                 obs.counter("live_lanes", live)
                 obs.counter("hostcall_queue_depth", parked)
+                # per-round convergence metrics (ROADMAP #6a): unique
+                # active pcs + largest convergent group among live
+                # lanes, one extra [lanes] pc read per launch
+                if live:
+                    pcs = np.asarray(state.pc)[trap_host == 0]
+                    _, counts = np.unique(pcs, return_counts=True)
+                    obs.observe_convergence(
+                        int(counts.size), float(counts.max()) / live)
             if parked:
                 if fault is not None:
                     fault("serve", total=total)

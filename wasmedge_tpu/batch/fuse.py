@@ -114,6 +114,26 @@ def fusion_active(img, cfg) -> bool:
 
 # -- the translation pass ---------------------------------------------------
 
+def _candidate_divergence(analysis) -> dict:
+    """ops-tuple -> mean r12 block-divergence score over the blocks
+    where the candidate occurs (the analyzer's block_ngrams metadata
+    indexes candidates by their position in the FULL superinstructions
+    list).  Candidates never seen in any block map to 0.0."""
+    sums: dict = {}
+    counts: dict = {}
+    keys = [tuple(c["ops"]) for c in analysis.superinstructions]
+    for f in analysis.funcs:
+        for bi, present in enumerate(getattr(f, "block_ngrams", ())):
+            score = f.block_divergence[bi] \
+                if bi < len(f.block_divergence) else 0
+            for ci in present:
+                if 0 <= ci < len(keys):
+                    k = keys[ci]
+                    sums[k] = sums.get(k, 0.0) + float(score)
+                    counts[k] = counts.get(k, 0) + 1
+    return {k: sums[k] / counts[k] for k in sums}
+
+
 def plan_fusion(img, cfg=None, analysis=None) -> dict:
     """Rewrite the top-K analyzer candidates' pc runs into fused cells.
 
@@ -130,10 +150,12 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
         cfg = BatchConfigure()
     top_k = max(int(getattr(cfg, "fuse_top_k", 12)), 0)
     max_pat = max(int(getattr(cfg, "fuse_max_patterns", 8)), 0)
+    div_bias = float(getattr(cfg, "fuse_divergence_bias", 0.0))
     report = {
         "enabled": bool(getattr(cfg, "fuse_superinstructions", True)),
         "top_k": top_k,
         "max_patterns": max_pat,
+        "divergence_bias": div_bias,
         "patterns": 0,
         "fused_runs": 0,
         "fused_cells": 0,
@@ -148,16 +170,42 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
     if analysis is None or not getattr(analysis, "superinstructions", None):
         return report
 
-    cands = list(analysis.superinstructions[:top_k])
+    # Per-candidate divergence: the mean of the r12 per-block
+    # divergence scores over the blocks where the candidate occurs
+    # (block_ngrams indexes into the FULL superinstructions order).
+    # With fuse_divergence_bias > 0 the ranking key becomes
+    # saved_dispatches / (1 + bias * divergence), down-weighting
+    # candidates whose occurrences sit in high-divergence blocks —
+    # lanes there rarely reach the fused head together, so the cells
+    # realize little and cost trace size.  bias == 0 (the default)
+    # keeps the analyzer's exact order: planning is bit-identical.
+    cand_div = _candidate_divergence(analysis)
+    ranked = list(analysis.superinstructions)
+    if div_bias > 0:
+        ranked = sorted(
+            ranked,
+            key=lambda c: (
+                float(c["saved_dispatches"])
+                / (1.0 + div_bias
+                   * cand_div.get(tuple(c["ops"]), 0.0)),
+                c["count"], tuple(c["ops"])),
+            reverse=True)
+    cands = ranked[:top_k]
     cand_rows = []
     for c in cands:
-        cand_rows.append({
+        dv = cand_div.get(tuple(c["ops"]), 0.0)
+        row = {
             "ops": list(c["ops"]), "n": int(c["n"]),
             "planned": int(c["count"]),
             "saved_dispatches": int(c["saved_dispatches"]),
+            "divergence": round(float(dv), 4),
             "eligible": False,
             "realized_runs": 0, "realized_cells": 0,
-        })
+        }
+        if div_bias > 0:
+            row["adjusted_saved_dispatches"] = round(
+                float(c["saved_dispatches"]) / (1.0 + div_bias * dv), 4)
+        cand_rows.append(row)
     report["candidates"] = cand_rows
     if not cands:
         return report
@@ -223,6 +271,12 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
     report["fused_runs"] = len(runs)
     report["fused_cells"] = int(flen.sum())
     report["runs"] = runs
+    # planned-vs-realized delta per candidate (the analyze report's
+    # fusion section surfaces it; the census counts STATIC occurrences
+    # so delta > 0 means overlaps/ineligible cells ate into the plan)
+    for row in cand_rows:
+        row["delta_runs"] = int(row["planned"]) - int(
+            row["realized_runs"])
     return report
 
 

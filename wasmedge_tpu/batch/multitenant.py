@@ -508,6 +508,9 @@ class MultiTenantBatchEngine(BatchEngine):
                                        max_steps)
                     out.append(res)
             return out
+        from wasmedge_tpu.batch.compact import arm
+
+        arm(self)   # fresh per-run lane-compaction mapping (off = None)
         state = self.initial_state()
         total = 0
         pallas = self._try_pallas()
@@ -530,8 +533,13 @@ class MultiTenantBatchEngine(BatchEngine):
         checkpoint cadence, then harvests here)."""
         stack_lo = np.asarray(state.stack_lo)
         stack_hi = np.asarray(state.stack_hi)
-        trap = np.asarray(state.trap)
-        retired = np.asarray(state.retired)
+        # lane compaction permutes across tenant slice boundaries: the
+        # src mapping restores original (per-tenant-contiguous) order
+        from wasmedge_tpu.batch.compact import restore_mirrors
+
+        stack_lo, stack_hi, trap, retired = restore_mirrors(
+            getattr(self, "compactor", None), stack_lo, stack_hi,
+            np.asarray(state.trap), np.asarray(state.retired))
         out = []
         for ti, t in enumerate(self.tenants):
             sl = self._tenant_slices[ti]

@@ -183,6 +183,16 @@ class BatchSupervisor:
     def run(self, func_name: Optional[str] = None, args_lanes=None,
             max_steps: int = 10_000_000):
         self.engine = eng = self._engine0
+        # supervised rungs run UNcompacted (the poison-lane
+        # quarantine, runaway caps, and scalar-overlay harvest all key
+        # on physical lane indices across restores).  Marking the
+        # engine externally-managed BEFORE lineage adoption makes
+        # restore_lane_src REFUSE a lane-compacted (lane_src) snapshot
+        # loudly instead of arming a compactor this tier would then
+        # silently discard — which would return every lane's result at
+        # the wrong index (batch/compact.py).
+        eng._compact_external = True
+        eng.compactor = None
         self._multi = hasattr(eng, "tenants")
         self._max_steps = int(max_steps)
         self._overlay = {}
@@ -325,6 +335,9 @@ class BatchSupervisor:
     def _run_simt_tier(self, max_steps):
         eng = self.engine
         k = self.k
+        # uncompacted invariant (see run()): the flag is set before
+        # lineage adoption; this re-assert is defensive only
+        eng.compactor = None
         if self._resumed and self._adopted is not None:
             # adopted lineage (cross-process resume): continue from the
             # newest good member — already loaded by _adopt_lineage's

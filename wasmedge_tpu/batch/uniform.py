@@ -995,6 +995,9 @@ class UniformBatchEngine:
         from wasmedge_tpu.batch.hostcall import stdout_cursor_reset
 
         stdout_cursor_reset(self.simt)  # fresh run = fresh output stream
+        # stale compaction mapping from a previous run must never leak
+        # into this one (the handoff below re-arms when the knob is on)
+        self.simt.compactor = None
         if self.pallas is not None:
             res = self.pallas.run(func_name, args_lanes, max_steps)
             self.fell_back_to_simt = self.pallas.fell_back_to_simt
@@ -1055,7 +1058,12 @@ class UniformBatchEngine:
             st_["tier0_sys"] += int(ctr[3])
             st_["tier0_calls"] += int(ctr.sum())
         if fell_back:
-            # migrate to SIMT and finish there (incl. host outcalls)
+            # migrate to SIMT and finish there (incl. host outcalls);
+            # the divergence handoff is exactly where lane compaction
+            # pays, so arm it for the SIMT leg (batch/compact.py)
+            from wasmedge_tpu.batch.compact import arm
+
+            arm(self.simt)
             state = self._to_simt_state(ust)
             state, total = self.simt.run_from_state(
                 state, int(ust.steps), max_steps)
@@ -1073,10 +1081,17 @@ class UniformBatchEngine:
         nres = int(self.inst.lowered.funcs[func_idx].nresults)
         stack_lo = np.asarray(state.stack_lo)
         stack_hi = np.asarray(state.stack_hi)
+        # the SIMT leg may have compacted (permuted) the lanes: gather
+        # the result mirrors back to original lane order
+        from wasmedge_tpu.batch.compact import restore_mirrors
+
+        stack_lo, stack_hi, trap, retired = restore_mirrors(
+            getattr(self.simt, "compactor", None), stack_lo, stack_hi,
+            np.asarray(state.trap), np.asarray(state.retired))
         results = []
         for r in range(nres):
             lo = stack_lo[r].view(np.uint32).astype(np.uint64)
             hi = stack_hi[r].view(np.uint32).astype(np.uint64)
             results.append((lo | (hi << np.uint64(32))).view(np.int64))
-        return BatchResult(results=results, trap=np.asarray(state.trap),
-                           retired=np.asarray(state.retired), steps=steps)
+        return BatchResult(results=results, trap=trap,
+                           retired=retired, steps=steps)

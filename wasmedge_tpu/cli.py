@@ -75,6 +75,11 @@ def _runner_parser() -> ArgumentParser:
                         "jitted program over the lane-sharded named "
                         "mesh) | threaded (per-device engines, the "
                         "degradation-ladder rung)", "kind"))
+    p.add_option(["compact"],
+                 Toggle("divergence-aware lane compaction for --batch "
+                        "runs: PC-sorted lane regrouping + live-prefix "
+                        "packing at launch boundaries "
+                        "(batch/compact.py)"))
     p.add_option(["supervised"],
                  Toggle("supervise --batch runs: auto-checkpoint, "
                         "retry-with-backoff, engine-degradation ladder"))
@@ -133,6 +138,8 @@ def _build_conf(p: ArgumentParser) -> Configure:
         st.cost_limit = p._opts["gas-limit"].value
     if p._opts["memory-page-limit"].seen:
         conf.runtime.max_memory_pages = p._opts["memory-page-limit"].value
+    if p._opts["compact"].value:
+        conf.batch.compact = True
     if p._opts["checkpoint-dir"].seen:
         conf.supervisor.checkpoint_dir = p._opts["checkpoint-dir"].value
     if p._opts["checkpoint-every"].seen:
@@ -309,6 +316,10 @@ def _serve_parser() -> ArgumentParser:
     p.add_option(["swap-dir"],
                  Option("spill swapped lane state to this directory "
                         "(default: host memory only)", "dir"))
+    p.add_option(["compact"],
+                 Toggle("divergence-aware lane compaction: PC-sorted "
+                        "lane regrouping at launch boundaries "
+                        "(bindings follow their lane)"))
     p.add_option(["checkpoint-dir"],
                  Option("serving-state checkpoint directory", "dir"))
     p.add_option(["checkpoint-every"],
@@ -371,6 +382,8 @@ def serve_command(argv: List[str], out=None, err=None) -> int:
             p._opts["resident-budget-bytes"].value
     if p._opts["swap-dir"].seen:
         conf.hv.swap_dir = p._opts["swap-dir"].value
+    if p._opts["compact"].value:
+        conf.batch.compact = True
     if p._opts["trace-out"].seen or p._opts["metrics-out"].seen:
         conf.obs.enabled = True
 
@@ -528,6 +541,10 @@ def _gateway_parser() -> ArgumentParser:
                         "generation (admission counts the budget "
                         "instead of the raw free-lane count)", "b",
                         typ=int))
+    p.add_option(["compact"],
+                 Toggle("divergence-aware lane compaction on every "
+                        "serving generation: PC-sorted lane regrouping "
+                        "at launch boundaries"))
     p.add_option(["obs"],
                  Toggle("enable the flight recorder (gateway/<tenant> "
                         "spans, drain histograms; served at /metrics)"))
@@ -598,6 +615,8 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
     if p._opts["resident-budget-bytes"].seen:
         conf.hv.resident_budget_bytes = \
             p._opts["resident-budget-bytes"].value
+    if p._opts["compact"].value:
+        conf.batch.compact = True
     if p._opts["obs"].value:
         conf.obs.enabled = True
 

@@ -129,6 +129,36 @@ class BatchConfigure:
     # function (each pattern is a specialized straight-line handler;
     # more patterns = bigger traced step).
     fuse_max_patterns: int = 8
+    # Down-weight fusion candidates whose occurrences sit in
+    # high-divergence blocks (the analyzer's r12 per-block scores):
+    # ranking key becomes saved_dispatches / (1 + bias * block_score).
+    # 0.0 (the default) is bit-identical to unbiased planning.
+    fuse_divergence_bias: float = 0.0
+    # --- divergence-aware lane compaction (batch/compact.py) ---
+    # Sort/permute live lanes by (divergence-score bias, pc) at launch
+    # boundaries via one jitted gather-permutation, packing live lanes
+    # to a contiguous prefix (retired lanes stop occupying dispatch
+    # width on fixed-cohort runs — the step loop narrows to the live
+    # prefix).  Off (the default) compiles and executes the exact seed
+    # path; results are bit-identical either way for lane-placement-
+    # independent guests (tier-0 random_get keys on the physical lane
+    # index — the recycling/hv scoping caveat).
+    compact: bool = False
+    # Anti-thrash quantum: at least this many launch boundaries between
+    # compactions (the hv min_resident_rounds shape).
+    compact_min_interval: int = 2
+    # Sorting trigger: adjacent-key breaks removable by a sort must
+    # exceed this fraction of the live lanes.
+    compact_trigger: float = 0.05
+    # Cost model: the estimated win (removable breaks x steps per
+    # launch) must exceed factor x lane-width copy cost; 0 fires on
+    # every eligible boundary (tests).
+    compact_cost_factor: float = 4.0
+    # Live-prefix dispatch-width narrowing (fixed-cohort runs, single
+    # device): retraces the step per power-of-two width, so the floor
+    # bounds compile count and the smallest useful slice.
+    compact_narrow: bool = True
+    compact_width_floor: int = 64
     # --- three-tier hostcall pipeline knobs (batch/hostcall.py) ---
     # Tier 0: service pure WASI calls (clock_time_get / random_get /
     # sched_yield / proc_exit / fd_write-to-buffered-stdout) directly in

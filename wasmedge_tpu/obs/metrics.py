@@ -277,6 +277,37 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
             for tier in sorted(recorder.tier_seconds):
                 w.sample("wasmedge_tier_residency_seconds",
                          {"tier": tier}, recorder.tier_seconds[tier])
+        conv = getattr(recorder, "convergence", None)
+        if conv and conv.get("rounds"):
+            w.head("wasmedge_convergence_unique_pcs", "gauge",
+                   "Distinct active pcs among live lanes at the last "
+                   "launch boundary (batch/compact.py divergence "
+                   "estimate; 1 = fully convergent).")
+            w.sample("wasmedge_convergence_unique_pcs", None,
+                     int(conv.get("unique_pcs", 0)))
+            w.head("wasmedge_convergence_largest_group_fraction",
+                   "gauge",
+                   "Largest convergent lane group as a fraction of "
+                   "live lanes at the last launch boundary.")
+            w.sample("wasmedge_convergence_largest_group_fraction",
+                     None, round(float(conv.get("largest_group", 1.0)),
+                                 6))
+        n_compact = int(getattr(recorder, "compactions_total", 0))
+        if n_compact:
+            w.head("wasmedge_compactions_total", "counter",
+                   "Lane compactions fired at launch boundaries "
+                   "(PC-sorted regrouping, batch/compact.py).")
+            w.sample("wasmedge_compactions_total", None, n_compact)
+            h = recorder.compaction
+            name = "wasmedge_compaction_latency_seconds"
+            w.head(name, "histogram",
+                   "Host-side latency of one fired lane compaction "
+                   "(permutation build + dispatch).")
+            for le, acc in h.cumulative():
+                w.sample(f"{name}_bucket", {"le": repr(float(le))}, acc)
+            w.sample(f"{name}_bucket", {"le": "+Inf"}, h.count)
+            w.sample(f"{name}_sum", None, h.sum_s)
+            w.sample(f"{name}_count", None, h.count)
         fused = getattr(recorder, "fused_counts", None)
         if fused and fused.get("retired_total"):
             w.head("wasmedge_fused_dispatches_total", "counter",

@@ -126,6 +126,12 @@ class NullRecorder:
     def observe_swap(self, direction, dur_s):
         pass
 
+    def observe_convergence(self, unique_pcs, largest_group):
+        pass
+
+    def observe_compaction(self, dur_s):
+        pass
+
     def add_tier_seconds(self, tier, dur_s):
         pass
 
@@ -183,6 +189,13 @@ class FlightRecorder:
         self.hostcalls = {}        # kind -> LatencyHistogram
         self.admission = LatencyHistogram()  # serve submit -> install
         self.hv_swaps = {}         # "in"/"out" -> LatencyHistogram
+        # per-round convergence gauges (batch/engine.py run_from_state)
+        # + lane-compaction counters (batch/compact.py): last-observed
+        # values for the Prometheus gauges, counts for the totals
+        self.convergence = {"rounds": 0, "unique_pcs": 0,
+                            "largest_group": 1.0}
+        self.compactions_total = 0
+        self.compaction = LatencyHistogram()
         self.tier_seconds = {}     # tier -> accumulated seconds
         self.failure_counts = {}   # fault_class -> count
         self.opcode_counts = None  # np.int64 [NUM_OPCODES+3] when folded
@@ -261,6 +274,26 @@ class FlightRecorder:
         if h is None:
             h = self.hv_swaps[direction] = LatencyHistogram()
         h.observe(dur_s)
+
+    def observe_convergence(self, unique_pcs, largest_group):
+        """One launch-round convergence observation: distinct active
+        pcs + largest convergent group fraction among live lanes
+        (batch/engine.py pulls the pc mirror once per launch when obs
+        is on).  Last values back the Prometheus gauges; counter
+        events land on the ring for the trace."""
+        self.convergence["rounds"] += 1
+        self.convergence["unique_pcs"] = int(unique_pcs)
+        self.convergence["largest_group"] = float(largest_group)
+        self.counter("convergence_unique_pcs", int(unique_pcs))
+        self.counter("convergence_largest_group",
+                     round(float(largest_group), 4))
+
+    def observe_compaction(self, dur_s):
+        """One fired lane compaction (batch/compact.py): latency
+        histogram + total, rendered as wasmedge_compactions_total and
+        wasmedge_compaction_latency_seconds."""
+        self.compactions_total += 1
+        self.compaction.observe(dur_s)
 
     def add_tier_seconds(self, tier, dur_s):
         self.tier_seconds[tier] = \

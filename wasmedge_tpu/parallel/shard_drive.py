@@ -199,6 +199,12 @@ class ShardDrive:
         func_idx = eng.export_func_idx(func_name)
         eng.hostcall_stats = new_hostcall_stats()
         stdout_cursor_reset(eng)   # fresh run = fresh output stream
+        # lane compaction (batch/compact.py): per-shard permutations
+        # only (the compactor derives the shard blocks from the mesh),
+        # fresh mapping per run
+        from wasmedge_tpu.batch.compact import arm
+
+        arm(eng)
         state = eng.initial_state(func_idx, args)
         if self._pad:
             import jax.numpy as jnp
@@ -222,19 +228,26 @@ class ShardDrive:
         finally:
             eng._fault_hook = None
             eng._round_hook = None
-        # harvest: same decode as BatchEngine.run, pads stripped
+        # harvest: same decode as BatchEngine.run, pads stripped.  A
+        # compacted run's pads may have migrated within their shard, so
+        # the restore order (physical position of each original lane)
+        # replaces the plain prefix slice — sel[:lanes] covers exactly
+        # the original lanes because pad src ids sort after them.
         nres = eng.func_nresults(func_idx)
+        comp = getattr(eng, "compactor", None)
+        order = None if comp is None else comp.restore_order()
+        sel = slice(None, lanes) if order is None else order[:lanes]
         stack_lo = np.asarray(state.stack_lo)
         stack_hi = np.asarray(state.stack_hi)
         results = []
         for r in range(nres):
-            lo = stack_lo[r, :lanes].view(np.uint32).astype(np.uint64)
-            hi = stack_hi[r, :lanes].view(np.uint32).astype(np.uint64)
+            lo = stack_lo[r, sel].view(np.uint32).astype(np.uint64)
+            hi = stack_hi[r, sel].view(np.uint32).astype(np.uint64)
             results.append((lo | (hi << np.uint64(32))).view(np.int64))
         return BatchResult(
             results=results,
-            trap=np.asarray(state.trap)[:lanes].copy(),
-            retired=np.asarray(state.retired)[:lanes].copy(),
+            trap=np.asarray(state.trap)[sel].copy(),
+            retired=np.asarray(state.retired)[sel].copy(),
             steps=total)
 
 

@@ -147,6 +147,19 @@ class BatchServer:
             self.engine = BatchEngine(inst, store=store, conf=self.conf,
                                       lanes=lanes, mesh=mesh)
         self.lanes = self.engine.lanes
+        # divergence-aware lane compaction (batch/compact.py): the
+        # SERVER owns the boundary pass — the engine-level compactor
+        # stays disarmed (_compact_external) so a permutation can never
+        # fire under the lane->request bindings without the remap below
+        # (_compact_round).  Narrowing is off: serving lanes are
+        # capacity, not a fixed cohort.
+        self.engine._compact_external = True
+        self.engine.compactor = None
+        self._compactor = None
+        if getattr(self.conf.batch, "compact", False):
+            from wasmedge_tpu.batch.compact import LaneCompactor
+
+            self._compactor = LaneCompactor(self.engine, narrow=False)
         self.obs = recorder_of(self.conf)
         self.stats = stats
         self.faults = faults
@@ -471,6 +484,8 @@ class BatchServer:
             admitted = self._admit(now)
             if self.hv is not None:
                 admitted += self._hv_boundary(now)
+            if self._compactor is not None and self._bindings:
+                self._compact_round()
             run_from = (self.state, self.total) if self._bindings else None
             self._snap_stdout()   # pre-launch pairing for checkpoint()
             self._inflight = run_from is not None
@@ -772,6 +787,51 @@ class BatchServer:
         swapped = (self.hv.counters["swaps_in"]
                    + self.hv.counters["swaps_out"]) - swaps0
         return moved + max(len(self._bindings) - before, 0) + swapped
+
+    def _compact_round(self):
+        """Lane-compaction boundary pass (under the lock, before the
+        launch slice — batch/compact.py): when the policy fires, ONE
+        jitted gather-permutation groups live lanes by (divergence
+        bias, pc) and every lane-keyed server structure follows its
+        lane through the permutation — bindings, pending kills, the
+        free heap, recycling history, hv residency tracking, and the
+        exactly-once stdout cursor (permuted by the compactor itself).
+        The binding journal is remapped in the same locked section, so
+        any checkpoint snapshots a consistent (state, journal) pair."""
+        comp = self._compactor
+        if self.state is None:
+            return
+        t0 = self.obs.now()
+        plan = comp.plan_boundary(self.engine, self.state)
+        if plan is None:
+            return
+        d, perm = plan
+        self.state = comp.permute_state(self.engine, self.state, perm)
+        inv = np.empty(perm.size, np.int64)
+        inv[perm] = np.arange(perm.size)
+        self._bindings = {int(inv[lane]): req
+                          for lane, req in self._bindings.items()}
+        self._kills = {int(inv[lane]): exc
+                       for lane, exc in self._kills.items()}
+        self._served_before = self._served_before[perm]
+        self._free = sorted(int(inv[lane]) for lane in self._free)
+        self._planes = None   # stale mirrors must never feed a harvest
+        if self.hv is not None:
+            hv = self.hv
+            hv._last_retired = hv._last_retired[perm]
+            hv._last_trap = hv._last_trap[perm]
+            hv._resident_since = {int(inv[lane]): v for lane, v
+                                  in hv._resident_since.items()}
+            hv._last_progress = {int(inv[lane]): v for lane, v
+                                 in hv._last_progress.items()}
+        comp.fired(d)
+        self._snap_stdout()   # cursor permuted with the state
+        self.obs.observe_compaction(self.obs.now() - t0)
+        self.obs.instant("compact", cat="compact", track="compact",
+                         live=d.nlive, breaks_before=d.breaks,
+                         breaks_ideal=d.ideal_breaks,
+                         unique_pcs=d.unique_pcs,
+                         in_flight=len(self._bindings))
 
     def _hv_on_install(self, lane: int, req, first: bool):
         """Install hook the LaneVirtualizer calls for every lane it
