@@ -589,6 +589,11 @@ def analyze_smoke() -> int:
        module at POST /v1/modules with the structured
        StaticPolicyViolation taxonomy (HTTP 400 + violations list),
        while admitting a bounded module.
+    4. r19 absint precision: the counted-loop fixture (verdict
+       "unbounded" before the abstract interpreter) must report a
+       finite bound proven >= the real BatchEngine retired max, and
+       the gateway — now under `require_bounded` — must ADMIT it
+       while still 400-ing the genuinely unbounded module.
 
     Prints ONE JSON line; emits no benchmark artifact."""
     import bench_echo
@@ -598,7 +603,7 @@ def analyze_smoke() -> int:
     from wasmedge_tpu.executor import Executor
     from wasmedge_tpu.gateway import GatewayTenants
     from wasmedge_tpu.loader import Loader
-    from wasmedge_tpu.models import build_fib
+    from wasmedge_tpu.models import build_counted_loop, build_fib
     from wasmedge_tpu.runtime.store import StoreManager
     from wasmedge_tpu.utils.builder import ModuleBuilder
     from wasmedge_tpu.validator import Validator
@@ -675,7 +680,30 @@ def analyze_smoke() -> int:
     checks["fib_bound_ge_retired"] = bool(res_f.completed.all()) \
         and bound_of(a_fib) >= int(res_f.retired.max())
 
+    # 4. r19 counted-loop precision: unbounded -> finite sound bound
+    counted_wasm = build_counted_loop(64)
+    mod_c, a_counted = analyzed(counted_wasm)
+    checks["counted_schema_ok"] = not validate_report(
+        a_counted.to_dict())
+    checks["counted_loop_now_bounded"] = a_counted.bounded \
+        and a_counted.funcs[0].has_loop \
+        and a_counted.cost_bound is not None
+    conf_c = Configure()
+    conf_c.batch.steps_per_launch = 256
+    conf_c.batch.value_stack_depth = 32
+    conf_c.batch.call_stack_depth = 8
+    store_c = StoreManager()
+    inst_c = Executor(conf_c).instantiate(store_c, mod_c)
+    eng_c = BatchEngine(inst_c, store=store_c, conf=conf_c, lanes=4)
+    res_c = eng_c.run("count", [np.zeros(4, np.int64)],
+                      max_steps=50_000)
+    checks["counted_bound_ge_retired"] = bool(
+        res_c.completed.all()) and a_counted.cost_bound is not None \
+        and a_counted.cost_bound >= int(res_c.retired.max())
+
     # 3. policy-enabled gateway rejects the crafted unbounded module
+    # (now under require_bounded too — the r19 admission-precision
+    # policy a pre-absint analyzer would have rejected EVERY loop for)
     bldr = ModuleBuilder()
     bldr.add_function(["i32"], ["i32"], [], [
         ("block", None), ("loop", None), ("br", 0), "end", "end",
@@ -685,7 +713,8 @@ def analyze_smoke() -> int:
     conf_g.batch.steps_per_launch = 128
     tenants = GatewayTenants.from_dict(
         {"analysis": {"max_static_cost": 1_000_000,
-                      "max_memory_pages": 16}})
+                      "max_memory_pages": 16,
+                      "require_bounded": True}})
     gw, svc = _start_gateway(conf_g, lanes=2, tenants=tenants)
     try:
         st, doc, _ = _gateway_rpc(
@@ -704,6 +733,17 @@ def analyze_smoke() -> int:
         checks["gateway_admits_bounded"] = st == 201 \
             and isinstance(doc, dict) \
             and doc.get("analysis", {}).get("bounded") is True
+        # the COUNTED-LOOP module: pre-absint this was "unbounded" and
+        # require_bounded would 400 it; now it must ADMIT
+        st, doc, _ = _gateway_rpc(
+            gw.host, gw.port, "POST", "/v1/modules?name=counted",
+            body=counted_wasm,
+            headers={"Content-Type": "application/wasm"})
+        checks["gateway_admits_counted_loop"] = st == 201 \
+            and isinstance(doc, dict) \
+            and doc.get("analysis", {}).get("bounded") is True \
+            and doc.get("analysis", {}).get("trip_bounded_loops",
+                                            0) >= 1
         st, text, _ = _gateway_rpc(gw.host, gw.port, "GET", "/metrics")
         checks["metrics_has_analysis_counters"] = st == 200 \
             and "wasmedge_analysis_policy_rejections_total 1" in text
@@ -719,6 +759,8 @@ def analyze_smoke() -> int:
         **checks,
         "bounded_cost_bound": a_bounded.cost_bound,
         "bounded_retired_max": int(res.retired.max()),
+        "counted_cost_bound": a_counted.cost_bound,
+        "counted_retired_max": int(res_c.retired.max()),
         "wall_s": round(dt, 3),
     }))
     return 0 if ok else 1
@@ -1234,6 +1276,194 @@ def fuse_bench() -> int:
           f"dispatch_reduction={flagship['dispatch_reduction']} "
           f"divergent speedup={div['speedup']} "
           f"multitenant speedup={mt_out['speedup']}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def _memfuse_engine(memfuse: bool, lanes: int, data: bytes,
+                    chunk: int = 50_000_000):
+    """SIMT rig with the r19 memory-run fusion knob pinned (the pure
+    superinstruction tier stays at its default on BOTH sides — the
+    A/B isolates the licensed load/store run class)."""
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    conf = Configure()
+    conf.batch.fuse_memory_runs = memfuse
+    conf.batch.steps_per_launch = chunk
+    conf.batch.value_stack_depth = 64
+    conf.batch.call_stack_depth = 16
+    mod = Validator(conf).validate(Loader(conf).parse_module(data))
+    store = StoreManager()
+    inst = Executor(conf).instantiate(store, mod)
+    return BatchEngine(inst, store=store, conf=conf, lanes=lanes)
+
+
+def _memfuse_checksum(n_words: int, passes: int) -> int:
+    """Independent numpy oracle for build_memfuse_workload — the SAME
+    store pattern as bench_memory's workload, so the one oracle
+    serves both (u32 domain)."""
+    from bench_memory import expected_checksum
+
+    return expected_checksum(n_words, passes)
+
+
+def memfuse_smoke() -> int:
+    """`bench.py --memfuse-smoke`: the r19 memory-run fusion CI guard.
+    Licensed workload: fusion on/off bit-identical with strictly
+    fewer dispatches and realized memory runs.  Adversarial fixtures:
+    a misaligned store/load mix and an OOB-adjacent loop must REVERT
+    to the per-op path (license refused) — bit-identical results and,
+    for the OOB fixture, the identical MemoryOutOfBounds trap at the
+    identical retired count.  Prints ONE JSON line; no artifact."""
+    from wasmedge_tpu.common.errors import ErrCode
+    from wasmedge_tpu.models import build_memfuse_workload
+
+    t0 = time.perf_counter()
+    lanes = 16
+    checks = {}
+
+    def ab(data, chunk=256, max_steps=500_000):
+        out = {}
+        rep = None
+        for memfuse in (True, False):
+            eng = _memfuse_engine(memfuse, lanes, data, chunk=chunk)
+            out[memfuse] = eng.run(
+                "memfuse", [np.zeros(lanes, np.int64)],
+                max_steps=max_steps)
+            if memfuse:
+                rep = eng.img.fusion_report["memory"]
+        a, b = out[True], out[False]
+        ident = bool((a.results[0] == b.results[0]).all()
+                     and (a.trap == b.trap).all()
+                     and (a.retired == b.retired).all())
+        return a, b, rep, ident
+
+    # -- licensed workload --
+    a, b, rep, ident = ab(build_memfuse_workload(96, passes=2))
+    checks["licensed_runs_realized"] = rep["mem_runs"] > 0 \
+        and rep["licensed_sites"] == 2
+    checks["licensed_bit_identical"] = ident and bool(
+        a.completed.all())
+    checks["licensed_fewer_dispatches"] = a.steps < b.steps
+    checks["licensed_correct"] = bool(
+        (np.asarray(a.results[0], np.int64) & 0xFFFFFFFF
+         == _memfuse_checksum(96, 2)).all())
+
+    # -- misaligned: license refused, per-op both sides --
+    a, b, rep, ident = ab(build_memfuse_workload(64, byte_offset=2))
+    checks["misaligned_reverted"] = rep["mem_runs"] == 0 \
+        and rep["unlicensed_sites"] == 2
+    checks["misaligned_bit_identical"] = ident and bool(
+        a.completed.all())
+
+    # -- OOB-adjacent: refused, traps identically --
+    a, b, rep, ident = ab(build_memfuse_workload(
+        64, byte_offset=65400))
+    checks["oob_reverted"] = rep["mem_runs"] == 0
+    checks["oob_trap_identical"] = ident and bool(
+        (np.asarray(a.trap)
+         == int(ErrCode.MemoryOutOfBounds)).all())
+
+    dt = time.perf_counter() - t0
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "memfuse_smoke_bit_identity",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "lanes": lanes,
+        "wall_s": round(dt, 3),
+    }))
+    return 0 if ok else 1
+
+
+def memfuse_bench() -> int:
+    """`bench.py --memfuse-bench`: obs-off memory-workload A/B — the
+    SIMT tier with r19 memory-run fusion on vs off at identical
+    geometry (the pure superinstruction tier at its default on both
+    sides).  Emits BENCH_r19.json; ok requires fusion-on strictly
+    faster with strictly fewer dispatches and bit-identical results.
+    Geometry scales via BENCH_MEMFUSE_WORDS / BENCH_MEMFUSE_PASSES /
+    BENCH_FUSE_LANES; the metric name records the actual geometry."""
+    import os
+
+    import jax
+
+    from wasmedge_tpu.models import build_memfuse_workload
+
+    n_words = int(os.environ.get("BENCH_MEMFUSE_WORDS", "512"))
+    passes = int(os.environ.get("BENCH_MEMFUSE_PASSES", "2"))
+    lanes = int(os.environ.get("BENCH_FUSE_LANES", "4096"))
+    data = build_memfuse_workload(n_words, passes=passes)
+    expect = _memfuse_checksum(n_words, passes)
+    out = {
+        "metric": f"memfuse_ab_{n_words}wx{passes}p_x{lanes}",
+        "unit": "wasm_instr/s",
+        "backend": jax.default_backend(),
+        "obs": False,
+        "n_words": n_words, "passes": passes, "lanes": lanes,
+    }
+    results = {}
+    ab = {}
+    for memfuse in (True, False):
+        eng = _memfuse_engine(memfuse, lanes, data)
+        # warmup compiles the step (single chunk covers the full run)
+        eng.run("memfuse", [np.zeros(lanes, np.int64)],
+                max_steps=2_000_000_000)
+        t0 = time.perf_counter()
+        res = eng.run("memfuse", [np.zeros(lanes, np.int64)],
+                      max_steps=2_000_000_000)
+        dt = time.perf_counter() - t0
+        assert res.completed.all() and (
+            np.asarray(res.results[0], np.int64) & 0xFFFFFFFF
+            == expect).all(), "memfuse wrong result"
+        retired = float(np.asarray(res.retired, np.float64).sum())
+        results[memfuse] = res
+        key = "memfuse" if memfuse else "baseline"
+        ab[key] = {
+            "ops_per_sec": round(retired / dt, 1),
+            "wall_s": round(dt, 2),
+            "dispatches": int(res.steps),
+        }
+        if memfuse:
+            rep = eng.img.fusion_report
+            out["realized"] = {
+                "mem_runs": rep["memory"]["mem_runs"],
+                "mem_cells": rep["memory"]["mem_cells"],
+                "mem_patterns": rep["memory"]["mem_patterns"],
+                "licensed_sites": rep["memory"]["licensed_sites"],
+            }
+            _emit_fusion_report(rep, "BENCH_r19.fusion.json")
+    a, b = results[True], results[False]
+    ab["bit_identical"] = bool(
+        (a.results[0] == b.results[0]).all()
+        and (a.trap == b.trap).all()
+        and (a.retired == b.retired).all())
+    ab["speedup"] = round(ab["memfuse"]["ops_per_sec"]
+                          / max(ab["baseline"]["ops_per_sec"], 1e-9),
+                          4)
+    ab["dispatch_reduction"] = round(
+        1.0 - ab["memfuse"]["dispatches"]
+        / max(ab["baseline"]["dispatches"], 1), 4)
+    out["memory_workload"] = ab
+    out["value"] = ab["memfuse"]["ops_per_sec"]
+    out["speedup"] = ab["speedup"]
+    ok = (ab["speedup"] > 1.0 and ab["bit_identical"]
+          and ab["memfuse"]["dispatches"] < ab["baseline"]["dispatches"]
+          and out["realized"]["mem_runs"] > 0)
+    out["ok"] = bool(ok)
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "BENCH_r19.json")
+    print(f"# memfuse speedup={ab['speedup']} dispatches "
+          f"{ab['memfuse']['dispatches']} vs "
+          f"{ab['baseline']['dispatches']} "
+          f"mem_runs={out['realized']['mem_runs']}", file=sys.stderr)
     return 0 if ok else 1
 
 
@@ -2468,6 +2698,10 @@ if __name__ == "__main__":
         sys.exit(fuse_smoke())
     if "--fuse-bench" in sys.argv[1:]:
         sys.exit(fuse_bench())
+    if "--memfuse-smoke" in sys.argv[1:]:
+        sys.exit(memfuse_smoke())
+    if "--memfuse-bench" in sys.argv[1:]:
+        sys.exit(memfuse_bench())
     if "--compact-smoke" in sys.argv[1:]:
         sys.exit(compact_smoke())
     if "--compact-bench" in sys.argv[1:]:

@@ -1,0 +1,248 @@
+"""Memory-run fusion (batch/fuse.py + analysis/absint.py) — r19.
+
+The consumer half of the abstract interpreter: straight-line
+load/store runs whose every access carries an absint license (proven
+in-bounds + aligned, i.e. trap-free) compile into fused dispatch
+cells doing one gather/scatter per access — and one dispatch per run
+— instead of the per-op three-word RMW window.  Pins the r17 bar for
+the new run class:
+
+  - memfuse on/off bit-identical to each other AND the scalar engine
+    (results, traps, retired) on the licensed workload — with strictly
+    fewer dispatches when on;
+  - the same parity on the 8-device shard mesh and a multi-tenant
+    concatenated image;
+  - the adversarial fixtures: misaligned and OOB-adjacent accesses
+    REVERT to the per-op path (license refused) and trap identically
+    on and off;
+  - fuel exhaustion lands at the correct op (fused lanes pre-gate on
+    whole-run fuel) and the opcode histogram equals retired;
+  - licensed-vs-reverted counters reach the Prometheus export.
+
+Fast by construction (tiny lanes, small word counts): tier-1.
+"""
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.batch.engine import BatchEngine
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.common.errors import ErrCode
+from wasmedge_tpu.models import build_fib, build_memfuse_workload
+from tests.helpers import instantiate, run_wasm
+
+pytestmark = pytest.mark.fuse
+
+LANES = 8
+
+
+def checksum_ref(n_words: int, passes: int = 1) -> int:
+    acc = np.uint32(0)
+    i = np.arange(n_words, dtype=np.uint32)
+    for p in range(passes, 0, -1):
+        acc ^= np.bitwise_xor.reduce(
+            (i * np.uint32(0x9E3779B1)) ^ np.uint32(p - 1))
+    return int(acc)   # u32 domain (compare masked)
+
+
+def make_conf(memfuse=True, **batch):
+    conf = Configure()
+    conf.batch.fuse_memory_runs = memfuse
+    conf.batch.steps_per_launch = 256
+    conf.batch.value_stack_depth = 64
+    conf.batch.call_stack_depth = 16
+    for k, v in batch.items():
+        setattr(conf.batch, k, v)
+    return conf
+
+
+def make_engine(data, conf, lanes=LANES, mesh=None):
+    ex, store, inst = instantiate(data, conf)
+    return BatchEngine(inst, store=store, conf=conf, lanes=lanes,
+                       mesh=mesh)
+
+
+def assert_results_identical(a, b):
+    assert (a.trap == b.trap).all()
+    assert (a.retired == b.retired).all()
+    for ra, rb in zip(a.results, b.results):
+        assert (ra == rb).all()
+
+
+class TestBitExact:
+    def test_memfuse_matches_unfused_and_scalar(self):
+        data = build_memfuse_workload(96, passes=2)
+        res = {}
+        for memfuse in (True, False):
+            eng = make_engine(data, make_conf(memfuse))
+            res[memfuse] = eng.run(
+                "memfuse", [np.zeros(LANES, np.int64)],
+                max_steps=200_000)
+            if memfuse:
+                mem = eng.img.fusion_report["memory"]
+                assert mem["mem_runs"] > 0
+                assert mem["licensed_sites"] == 2
+                assert mem["unlicensed_sites"] == 0
+        assert res[True].completed.all()
+        assert_results_identical(res[True], res[False])
+        assert res[True].steps < res[False].steps
+        expect = checksum_ref(96, 2)
+        assert (np.asarray(res[True].results[0], np.int64)
+                & 0xFFFFFFFF == expect).all()
+        # scalar engine agrees
+        assert int(run_wasm(data, "memfuse", [0])[0]) \
+            & 0xFFFFFFFF == expect
+
+    def test_sub_word_stores_fuse_bit_exact(self):
+        """store16 RMW keeps neighbouring bytes: fused vs per-op."""
+        data = build_memfuse_workload(64, store_width=2)
+        res = {}
+        for memfuse in (True, False):
+            eng = make_engine(data, make_conf(memfuse))
+            res[memfuse] = eng.run(
+                "memfuse", [np.zeros(LANES, np.int64)],
+                max_steps=200_000)
+            if memfuse:
+                assert eng.img.fusion_report["memory"]["mem_runs"] > 0
+        assert res[True].completed.all()
+        assert_results_identical(res[True], res[False])
+        assert int(run_wasm(data, "memfuse", [0])[0]) \
+            == int(np.asarray(res[True].results[0])[0])
+
+    def test_knob_off_plans_nothing(self):
+        eng = make_engine(build_memfuse_workload(64),
+                          make_conf(memfuse=False))
+        eng._plan_fusion()
+        rep = eng.img.fusion_report
+        assert rep["memory"]["mem_runs"] == 0
+        assert rep["mem_runs"] == []
+
+
+class TestReverts:
+    def test_misaligned_reverts_to_per_op(self):
+        data = build_memfuse_workload(64, byte_offset=2)
+        res = {}
+        for memfuse in (True, False):
+            eng = make_engine(data, make_conf(memfuse))
+            res[memfuse] = eng.run(
+                "memfuse", [np.zeros(LANES, np.int64)],
+                max_steps=200_000)
+            if memfuse:
+                mem = eng.img.fusion_report["memory"]
+                assert mem["mem_runs"] == 0          # license refused
+                assert mem["unlicensed_sites"] == 2
+        assert res[True].completed.all()
+        assert_results_identical(res[True], res[False])
+
+    def test_oob_adjacent_traps_identically(self):
+        """The write loop runs off the single page: the trap must land
+        at the same op with the same retired count, fusion on or off
+        (the license refused the site, so both run per-op)."""
+        data = build_memfuse_workload(16385)
+        res = {}
+        for memfuse in (True, False):
+            eng = make_engine(data, make_conf(
+                memfuse, steps_per_launch=4096))
+            res[memfuse] = eng.run(
+                "memfuse", [np.zeros(LANES, np.int64)],
+                max_steps=2_000_000)
+            if memfuse:
+                assert eng.img.fusion_report["memory"]["mem_runs"] == 0
+        assert (np.asarray(res[True].trap)
+                == int(ErrCode.MemoryOutOfBounds)).all()
+        assert_results_identical(res[True], res[False])
+
+
+class TestGas:
+    def test_fuel_exhaustion_lands_per_op(self):
+        """A fuel budget that dies mid-run: fused lanes pre-gate on
+        whole-run fuel, so exhaustion executes the original per-op
+        cells and lands at the same op either way."""
+        data = build_memfuse_workload(64)
+        res = {}
+        for memfuse in (True, False):
+            eng = make_engine(data, make_conf(
+                memfuse, fuel_per_launch=137, steps_per_launch=64))
+            res[memfuse] = eng.run(
+                "memfuse", [np.zeros(LANES, np.int64)],
+                max_steps=10_000)
+        assert (np.asarray(res[True].trap)
+                == int(ErrCode.CostLimitExceeded)).all()
+        assert_results_identical(res[True], res[False])
+
+
+@pytest.mark.obs
+class TestObs:
+    def test_histogram_equals_retired_and_metrics(self):
+        from wasmedge_tpu.obs.metrics import render_prometheus
+
+        conf = make_conf(True)
+        conf.obs.enabled = True
+        conf.obs.opcode_histogram = True
+        eng = make_engine(build_memfuse_workload(64), conf)
+        res = eng.run("memfuse", [np.zeros(LANES, np.int64)],
+                      max_steps=200_000)
+        assert res.completed.all()
+        hist = eng.obs.opcode_counts
+        assert hist is not None
+        assert int(hist.sum()) == int(np.asarray(res.retired,
+                                                 np.int64).sum())
+        fused = eng.obs.fused_counts
+        assert fused["dispatches"] > 0
+        assert fused["retired_fused"] > 0
+        text = render_prometheus(eng.obs)
+        assert 'wasmedge_memfuse_runs{verdict="licensed"}' in text
+        assert 'verdict="reverted_sites"' in text
+
+
+class TestComposition:
+    def test_shard_drive_memfuse_parity(self):
+        from wasmedge_tpu.parallel.mesh import lane_mesh
+
+        data = build_memfuse_workload(48)
+        args = [np.zeros(32, np.int64)]
+        out = {}
+        for memfuse in (True, False):
+            out[memfuse] = make_engine(
+                data, make_conf(memfuse), lanes=32,
+                mesh=lane_mesh(8)).run("memfuse", args,
+                                       max_steps=200_000)
+        solo = make_engine(data, make_conf(True), lanes=32).run(
+            "memfuse", args, max_steps=200_000)
+        assert out[True].completed.all()
+        assert_results_identical(out[True], out[False])
+        assert_results_identical(out[True], solo)
+
+    def test_multitenant_concat_memfuse_parity(self):
+        from wasmedge_tpu.batch.multitenant import (
+            MultiTenantBatchEngine, Tenant)
+
+        L = 8
+        data = build_memfuse_workload(48)
+        out = {}
+        for memfuse in (True, False):
+            conf = make_conf(memfuse)
+            tenants = []
+            for mod_data, fn, args in (
+                    (data, "memfuse", [np.zeros(L, np.int64)]),
+                    (build_fib(), "fib",
+                     [np.full(L, 10, np.int64)])):
+                ex, store, inst = instantiate(mod_data, conf)
+                tenants.append(Tenant(
+                    engine=BatchEngine(inst, store=store, conf=conf,
+                                       lanes=L),
+                    func_name=fn, args_lanes=args, lanes=L))
+            mt = MultiTenantBatchEngine(tenants, conf=conf)
+            if memfuse:
+                # the concatenated planes carry the per-tenant mem
+                # runs (pattern ids remapped into the merged table)
+                from wasmedge_tpu.batch.fuse import pattern_has_mem
+
+                assert any(pattern_has_mem(p)
+                           for p in mt.img.fuse_patterns)
+            out[memfuse] = mt.run_tenants(max_steps=200_000)
+        for a, b in zip(out[True], out[False]):
+            assert a.completed.all()
+            assert_results_identical(a, b)
+        assert (np.asarray(out[True][0].results[0], np.int64)
+                & 0xFFFFFFFF == checksum_ref(48)).all()

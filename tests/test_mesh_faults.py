@@ -590,7 +590,10 @@ def test_threaded_restore_stdout_at_least_once_window_bounded(tmp_path):
 
     (The shard drive resolved this caveat structurally — one engine,
     one stdout cursor; see README 'Single-program mesh'.)"""
-    lanes, iters, chunk = 8, 6, 100
+    # 12 iterations: the faulted device must reach a SECOND launch
+    # (chunk 100) even with r19 memory-run fusion retiring the stamp
+    # loop's licensed stores in fused dispatch cells
+    lanes, iters, chunk = 8, 12, 100
     dev_n = 4
 
     def base_conf():
@@ -609,9 +612,12 @@ def test_threaded_restore_stdout_at_least_once_window_bounded(tmp_path):
                                       single, lanes, iters)
     assert (ref.trap == -1).all()
     assert all(ref_counts[1000 + k] == iters for k in range(lanes))
-    # steps one loop iteration retires (from the oracle run): the
-    # launch-window write bound below derives from it
-    spi = int(np.asarray(ref.retired, np.int64)[0]) // iters
+    # DISPATCH steps one loop iteration takes (from the oracle run):
+    # the launch-window write bound below derives from it.  Steps, not
+    # retired — under superinstruction/memory-run fusion one dispatch
+    # retires a whole run, and the launch window is denominated in
+    # dispatches
+    spi = int(ref.steps) // iters
     w_max = chunk // max(spi, 1) + 1   # writes one launch can flush
 
     fault_dev = 2

@@ -372,7 +372,10 @@ def _run_echo_supervised(tmp, name, faults, ckpt_cadence=40):
         d = os.path.join(tmp, name + ".ckpt")
         sup = BatchSupervisor(eng, conf=conf, faults=faults,
                               checkpoint_dir=d)
-        res = sup.run("echo", [np.full(4, 3, np.int64)],
+        # 5 echo iterations: enough launches (chunk 40) that the
+        # at=2 launch fault still fires now that r19 memory-run
+        # fusion retires the message-building stores in fused cells
+        res = sup.run("echo", [np.full(4, 5, np.int64)],
                       max_steps=1_000_000)
         assert res.completed.all()
     finally:

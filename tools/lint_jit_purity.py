@@ -35,11 +35,13 @@ TARGETS = {
     "wasmedge_tpu/batch/uniform.py": ("make_uniform_step",
                                       "_build_uniform"),
     "wasmedge_tpu/serve/recycle.py": ("_install_fn",),
-    # superinstruction fused-step builder: the specialized pattern
-    # handlers trace inside make_fused_apply (batch/fuse.py); the
-    # missing-target guard below means a rename cannot silently shrink
-    # this coverage
-    "wasmedge_tpu/batch/fuse.py": ("make_fused_apply",),
+    # superinstruction fused-step builders: the specialized pattern
+    # handlers trace inside make_fused_apply and — for the r19
+    # absint-licensed memory runs — make_memfuse_apply (batch/fuse.py);
+    # the missing-target guard below means a rename cannot silently
+    # shrink this coverage
+    "wasmedge_tpu/batch/fuse.py": ("make_fused_apply",
+                                   "make_memfuse_apply"),
     # single-program mesh drive: the sharded jit wrapper around the
     # engine's chunk body (the body itself is covered by engine.py's
     # targets; this keeps the mesh-side wrapper honest too)

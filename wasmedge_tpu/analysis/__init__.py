@@ -10,6 +10,13 @@ Three consumers share one analysis (built once per module lowering):
     against per-tenant AnalysisPolicy limits (analysis/policy.py)
 """
 
+from wasmedge_tpu.analysis.absint import (  # noqa: F401
+    FuncAbsint,
+    LoopFact,
+    MemFact,
+    analyze_module_absint,
+    loop_nest_cost,
+)
 from wasmedge_tpu.analysis.analyzer import (  # noqa: F401
     SCHEMA,
     FuncAnalysis,

@@ -1,0 +1,1054 @@
+"""Abstract-interpretation value-range analysis over the lowered CFG.
+
+A sound intraprocedural abstract interpreter in the superinstruction
+lineage of "A fast in-place interpreter for WebAssembly": it turns
+static facts into admission precision (finite cost bounds for counted
+loops), tighter hv footprint budgets (proven max page touch), and a
+new fused-dispatch class (statically-licensed load/store runs,
+batch/fuse.py).
+
+Domain
+------
+Each abstract value is an (interval, congruence) pair over the i32
+signed range:
+
+    (lo, hi, mod, rem)   value in [lo, hi], value === rem  (mod mod)
+
+`mod` is a power of two <= 2**16.  Congruence survives i32 wraparound
+(powers of two divide 2**32), so alignment facts stay precise even
+when the interval widens to TOP.  Interval arithmetic that could wrap
+collapses the interval to the full range instead of guessing.
+
+State flows per basic block over the LOCALS vector (+ module globals
+that are provably never written — their initial value is a constant).
+The operand stack is tracked only *within* a block (suffix-only: a
+block entry's inherited stack is unknown).  Addresses and loop tests
+in lowered WAT are computed in-block from locals, so this loses almost
+nothing while making the transfer independent of cross-block arity
+bookkeeping.
+
+Loop heads (the r12 CFG's `is_loop_head` marking) widen after
+`WIDEN_DELAY` joins; after the ascending fixpoint two descending
+(narrowing) Jacobi passes re-run every transfer without widening —
+monotone F applied to a post-fixpoint stays above the least fixpoint,
+so the result is still sound while conditional-branch refinement
+(`i < N` on the continue edge) pulls widened bounds back down to the
+loop invariant.  Structured wasm control flow is reducible, so every
+CFG cycle passes a marked loop head and the ascending phase
+terminates; MAX_ITERS is a belt-and-suspenders bail-out that degrades
+to "no facts", never to a wrong fact.
+
+Products (consumed by analysis/analyzer.py)
+-------------------------------------------
+  - trip bounds for counted loops: a unique-head SCC whose back-edge
+    blocks each increment one induction local by the same constant
+    step, tested against a constant / loop-invariant ranged limit.
+    Composed through `loop_nest_cost` the previously-"unbounded"
+    function gets a finite sound cost bound (exact on the canonical
+    latch-tested single-block counted loop).
+  - per-site memory-effect facts: static effective-address range +
+    alignment class for every load/store; `licensed` means proven
+    in-bounds against the module's MINIMUM memory (initial pages —
+    memory only grows) and aligned enough to never straddle a device
+    word, i.e. the access can never trap.  batch/fuse.py compiles
+    licensed straight-line runs into fused gather/scatter cells.
+  - proven max page touch (`mem_pages_touch_bound`) feeding the hv
+    resident-budget math (hv/policy.py effective_lane_bytes).
+
+Soundness contract: every fact holds for EVERY concrete execution of
+the function from its entry (params unknown).  Anything the analysis
+cannot prove degrades to TOP / no-license / unbounded — never a guess.
+Pure Python over numpy planes: importable without jax (the analyze
+CLI and the image-build analysis thunk both run device-free).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+I32_MIN = -(1 << 31)
+I32_MAX = (1 << 31) - 1
+_MOD_CAP = 1 << 16          # congruence modulus ceiling (page math)
+WIDEN_DELAY = 2             # plain joins at a loop head before widening
+NARROW_PASSES = 2           # descending Jacobi passes after the fixpoint
+MAX_ITERS = 10_000          # worklist safety valve: a hit bails out to
+#                             "no facts" (sound), never to a wrong fact
+
+TOP = (I32_MIN, I32_MAX, 1, 0)
+
+
+def _pow2_gcd(*vals) -> int:
+    """Largest power of two dividing gcd(vals) (all-zero -> cap)."""
+    g = 0
+    for v in vals:
+        g = math.gcd(g, int(abs(v)))
+    if g == 0:
+        return _MOD_CAP
+    return min(g & (-g), _MOD_CAP)
+
+
+def const_val(c: int):
+    c = int(c)
+    return (c, c, _MOD_CAP, c % _MOD_CAP)
+
+
+def _clamp(lo, hi, mod, rem):
+    """Interval overflow -> full range; congruence survives wraparound
+    (every mod is a power of two dividing 2**32)."""
+    mod = max(int(mod), 1)
+    rem = int(rem) % mod
+    if lo < I32_MIN or hi > I32_MAX or lo > hi:
+        return (I32_MIN, I32_MAX, mod, rem)
+    return (int(lo), int(hi), mod, rem)
+
+
+def join(a, b):
+    m = _pow2_gcd(a[2], b[2], a[3] - b[3])
+    return (min(a[0], b[0]), max(a[1], b[1]), m, a[3] % m)
+
+
+def widen(old, new):
+    lo = old[0] if new[0] >= old[0] else I32_MIN
+    hi = old[1] if new[1] <= old[1] else I32_MAX
+    m = _pow2_gcd(old[2], new[2], old[3] - new[3])
+    return (lo, hi, m, old[3] % m)
+
+
+def v_add(a, b):
+    m = _pow2_gcd(a[2], b[2])
+    return _clamp(a[0] + b[0], a[1] + b[1], m, a[3] + b[3])
+
+
+def v_sub(a, b):
+    m = _pow2_gcd(a[2], b[2])
+    return _clamp(a[0] - b[1], a[1] - b[0], m, a[3] - b[3])
+
+
+def v_mul(a, b):
+    # exact when either side is a known constant; otherwise keep only
+    # the congruence product (mixed-sign interval products are fiddly
+    # and nothing downstream needs them)
+    if a[0] == a[1]:
+        a, b = b, a
+    if b[0] == b[1]:
+        c = b[0]
+        if c == 0:
+            return const_val(0)
+        lo, hi = sorted((a[0] * c, a[1] * c))
+        return _clamp(lo, hi, _pow2_gcd(a[2] * c), a[3] * c)
+    m = _pow2_gcd(a[2] * b[3], b[2] * a[3], a[2] * b[2])
+    return (I32_MIN, I32_MAX, m, (a[3] * b[3]) % max(m, 1))
+
+
+def v_shl(a, k_val):
+    if k_val[0] != k_val[1]:
+        return TOP
+    return v_mul(a, const_val(1 << (k_val[0] & 31)))
+
+
+def v_and(a, b):
+    # x & y <= min(x, y) when the bound side is non-negative
+    if a[0] == a[1]:
+        a, b = b, a
+    if b[0] == b[1] and b[0] >= 0:
+        return (0, b[0], 1, 0)
+    if a[0] >= 0 and b[0] >= 0:
+        return (0, min(a[1], b[1]), 1, 0)
+    if a[0] >= 0:
+        return (0, a[1], 1, 0)
+    if b[0] >= 0:
+        return (0, b[1], 1, 0)
+    return TOP
+
+
+BOOL = (0, 1, 1, 0)
+
+
+# ---------------------------------------------------------------------------
+# symbolic terms (trip-bound + branch-refinement bookkeeping)
+#
+#   ('k', c)          constant c
+#   ('cur', i, d)     current value of local i == block-entry value + d
+#   ('cmp', op, lsym, lval, rsym, rval)
+#                     i32 comparison; the operand syms AND their
+#                     abstract values at compare time
+# ---------------------------------------------------------------------------
+
+_CMP_NEG = {"eq": "ne", "ne": "eq",
+            "lt_s": "ge_s", "ge_s": "lt_s", "gt_s": "le_s",
+            "le_s": "gt_s", "lt_u": "ge_u", "ge_u": "lt_u",
+            "gt_u": "le_u", "le_u": "gt_u"}
+_CMP_SWAP = {"eq": "eq", "ne": "ne",
+             "lt_s": "gt_s", "gt_s": "lt_s", "le_s": "ge_s",
+             "ge_s": "le_s", "lt_u": "gt_u", "gt_u": "lt_u",
+             "le_u": "ge_u", "ge_u": "le_u"}
+
+
+@dataclasses.dataclass
+class MemFact:
+    """Static effect of one memory-access site (absolute image pc)."""
+
+    pc: int
+    kind: str            # "load" / "store" / "vload" / "vstore" / "bulk"
+    nbytes: int
+    lo: Optional[int]    # effective-address range (None = unproven)
+    hi: Optional[int]
+    align: int           # largest power of two dividing every address
+    in_bounds: bool      # proven < initial pages for every execution
+    aligned: bool        # proven never to straddle a device word
+    licensed: bool       # in_bounds & aligned & scalar -> fusable
+
+    def asdict(self) -> dict:
+        return {"pc": self.pc, "kind": self.kind, "nbytes": self.nbytes,
+                "lo": self.lo, "hi": self.hi, "align": self.align,
+                "in_bounds": self.in_bounds, "aligned": self.aligned,
+                "licensed": self.licensed}
+
+
+@dataclasses.dataclass
+class LoopFact:
+    """One CFG loop: the r12 head block + the absint trip verdict."""
+
+    head_pc: int                # start pc of the loop-head block
+    trip_bound: Optional[int]   # max head executions; None = unproven
+
+    def asdict(self) -> dict:
+        return {"head": self.head_pc, "trip_bound": self.trip_bound}
+
+
+@dataclasses.dataclass
+class FuncAbsint:
+    """Per-function absint products."""
+
+    ok: bool = False
+    loops: List[LoopFact] = dataclasses.field(default_factory=list)
+    mem_facts: List[MemFact] = dataclasses.field(default_factory=list)
+    trips: Dict[int, int] = dataclasses.field(default_factory=dict)
+    # block_idx -> trip bound (loop_nest_cost's input; head blocks only)
+
+
+# ---------------------------------------------------------------------------
+# classified cells + per-class arity
+# ---------------------------------------------------------------------------
+
+class _Cells:
+    """The classified device cells absint interprets.  Built once per
+    module from the lowered image via batch/image.build_device_image
+    (numpy only, no jax) so the transfer function reads the SAME
+    two-level dispatch encoding the engine executes."""
+
+    def __init__(self, image, globals_init=None):
+        from wasmedge_tpu.batch.image import (
+            ALU2_I32_BASE, CLS_GLOBAL_SET, _I32_BIN, build_device_image)
+
+        dev = build_device_image(image)
+        self.cls = dev.cls
+        self.sub = dev.sub
+        self.a = dev.a
+        self.b = dev.b
+        self.c = dev.c
+        self.imm_lo = dev.imm_lo
+        self.f_nparams = dev.f_nparams
+        self.f_nresults = dev.f_nresults
+        self.i32_sub_name = {ALU2_I32_BASE + i: n
+                             for i, n in enumerate(_I32_BIN)}
+        # globals never written anywhere in the module keep their
+        # initial value ("non-escaping": nothing can mutate them)
+        self.written_globals = set(
+            int(x) for x in dev.a[dev.cls == CLS_GLOBAL_SET])
+        self.globals_init = list(globals_init) if globals_init else None
+
+
+def _arity_table():
+    """(pops, pushes) per opcode class for cells the transfer does not
+    model precisely — their results are TOP, stack depth stays exact."""
+    from wasmedge_tpu.batch import image as im
+
+    return {
+        im.CLS_NOP: (0, 0), im.CLS_CONST: (0, 1),
+        im.CLS_LOCAL_GET: (0, 1), im.CLS_LOCAL_SET: (1, 0),
+        im.CLS_LOCAL_TEE: (1, 1), im.CLS_GLOBAL_GET: (0, 1),
+        im.CLS_GLOBAL_SET: (1, 0), im.CLS_ALU1: (1, 1),
+        im.CLS_ALU2: (2, 1), im.CLS_SELECT: (3, 1),
+        im.CLS_DROP: (1, 0), im.CLS_LOAD: (1, 1),
+        im.CLS_STORE: (2, 0), im.CLS_MEMSIZE: (0, 1),
+        im.CLS_MEMGROW: (1, 1), im.CLS_MEMFILL: (3, 0),
+        im.CLS_MEMCOPY: (3, 0), im.CLS_VCONST: (0, 1),
+        im.CLS_V2: (2, 1), im.CLS_V1: (1, 1), im.CLS_VTEST: (1, 1),
+        im.CLS_VSHIFT: (2, 1), im.CLS_VSPLAT: (1, 1),
+        im.CLS_VEXTRACT: (1, 1), im.CLS_VREPLACE: (2, 1),
+        im.CLS_VSHUFFLE: (2, 1), im.CLS_VBITSEL: (3, 1),
+        im.CLS_VLOAD: (1, 1), im.CLS_VSTORE: (2, 0),
+        im.CLS_TABLE_GET: (1, 1), im.CLS_TABLE_SET: (2, 0),
+        im.CLS_TABLE_SIZE: (0, 1), im.CLS_TABLE_GROW: (2, 1),
+        im.CLS_TABLE_FILL: (3, 0), im.CLS_TABLE_COPY: (3, 0),
+        im.CLS_TABLE_INIT: (3, 0), im.CLS_ELEM_DROP: (0, 0),
+        im.CLS_MEMINIT: (3, 0), im.CLS_DATA_DROP: (0, 0),
+        im.CLS_REFFUNC: (0, 1), im.CLS_TRAP: (0, 0),
+    }
+
+
+class _BlockScan:
+    """Result of symbolically executing one block's straight-line run."""
+
+    __slots__ = ("locals_out", "writes", "n_writes", "cond_sym",
+                 "facts", "bulk_ends")
+
+    def __init__(self):
+        self.locals_out = None   # locals after the block body
+        self.writes = {}         # local idx -> sym of LAST write
+        #                          (('cur', i, d) / ('k', c) / None)
+        self.n_writes = {}       # local idx -> write count
+        self.cond_sym = None     # ('cmp', ...) at a brz/brnz terminator
+        self.facts = []          # MemFact list (final pass only)
+        self.bulk_ends = []      # per bulk op: proven end byte or None
+
+
+def _transfer_block(cells: _Cells, arity, block, locals_in,
+                    globals_const, min_mem_bytes, collect_facts,
+                    mem_decl_max_pages):
+    """Symbolically run one block's straight-line body from the entry
+    locals.  Returns a _BlockScan."""
+    from wasmedge_tpu.batch import image as im
+
+    env = list(locals_in)
+    locsym: Dict[int, tuple] = {}
+    stack: List[tuple] = []      # (absval, sym-or-None), suffix only
+    scan = _BlockScan()
+
+    def cur_sym(i):
+        # a local WRITTEN in this block keeps its recorded sym — which
+        # is None after an opaque (non-affine) write, severing the
+        # entry-value relation for every later read: a comparison
+        # computed before the clobber must never refine the interval
+        # of the post-clobber value
+        if i in locsym:
+            return locsym[i]
+        return ("cur", i, 0)
+
+    def push(v, s=None):
+        stack.append((v, s))
+
+    def pop():
+        return stack.pop() if stack else (TOP, None)
+
+    def write_local(a, v, s):
+        if not (0 <= a < len(env)):
+            return
+        env[a] = v
+        ws = None
+        if s is not None and (s[0] == "k"
+                              or (s[0] == "cur" and s[1] == a)):
+            ws = s
+        # an opaque write stores None EXPLICITLY (never popped): a
+        # later read must see "severed", not fall back to the
+        # pristine entry-value sym — that fabricated baseline would
+        # let a pre-clobber comparison refine a post-clobber value
+        # (a false license, the one unsound shape)
+        locsym[a] = ws
+        scan.writes[a] = ws
+        scan.n_writes[a] = scan.n_writes.get(a, 0) + 1
+
+    end = block.end if block.kind == "fallthrough" else block.end - 1
+    for pc in range(block.start, end + 1):
+        k = int(cells.cls[pc])
+        sub = int(cells.sub[pc])
+        a = int(cells.a[pc])
+        if k == im.CLS_NOP:
+            continue
+        if k == im.CLS_CONST:
+            c = int(cells.imm_lo[pc])
+            push(const_val(c), ("k", c))
+        elif k == im.CLS_LOCAL_GET:
+            if 0 <= a < len(env):
+                push(env[a], cur_sym(a))
+            else:
+                push(TOP)
+        elif k in (im.CLS_LOCAL_SET, im.CLS_LOCAL_TEE):
+            v, s = pop()
+            if k == im.CLS_LOCAL_TEE:
+                push(v, s)
+            write_local(a, v, s)
+        elif k == im.CLS_GLOBAL_GET:
+            push(globals_const.get(a, TOP))
+        elif k == im.CLS_GLOBAL_SET:
+            pop()
+        elif k == im.CLS_ALU2:
+            name = cells.i32_sub_name.get(sub)
+            y, ys = pop()
+            x, xs = pop()
+            if name in _CMP_NEG:            # i32 comparison family
+                sym = None
+                if xs is not None or ys is not None:
+                    sym = ("cmp", name, xs, x, ys, y)
+                push(BOOL, sym)
+            elif name == "add":
+                s = None
+                if xs and ys and xs[0] == "cur" and ys[0] == "k":
+                    s = ("cur", xs[1], xs[2] + ys[1])
+                elif xs and ys and xs[0] == "k" and ys[0] == "cur":
+                    s = ("cur", ys[1], ys[2] + xs[1])
+                elif xs and ys and xs[0] == "k" and ys[0] == "k":
+                    s = ("k", xs[1] + ys[1])
+                push(v_add(x, y), s)
+            elif name == "sub":
+                s = None
+                if xs and ys and xs[0] == "cur" and ys[0] == "k":
+                    s = ("cur", xs[1], xs[2] - ys[1])
+                elif xs and ys and xs[0] == "k" and ys[0] == "k":
+                    s = ("k", xs[1] - ys[1])
+                push(v_sub(x, y), s)
+            elif name == "mul":
+                push(v_mul(x, y))
+            elif name == "and":
+                push(v_and(x, y))
+            elif name in ("or", "xor"):
+                # non-negative operands stay under the next power of two
+                if x[0] >= 0 and y[0] >= 0:
+                    bound = (1 << max(x[1], y[1], 1).bit_length()) - 1
+                    push(_clamp(0, bound, 1, 0))
+                else:
+                    push(TOP)
+            elif name == "shl":
+                push(v_shl(x, y))
+            elif name in ("shr_u", "shr_s"):
+                if y[0] == y[1] and x[0] >= 0:
+                    sh = y[0] & 31
+                    push(_clamp(x[0] >> sh, x[1] >> sh, 1, 0))
+                else:
+                    push(TOP)
+            else:
+                push(TOP)
+        elif k == im.CLS_ALU1:
+            pop()
+            # i32.eqz / i64.eqz produce booleans; the rest is TOP
+            push(BOOL if sub in (3, 9) else TOP)
+        elif k == im.CLS_SELECT:
+            pop()
+            v2, _ = pop()
+            v1, _ = pop()
+            push(join(v1, v2))
+        elif k == im.CLS_DROP:
+            pop()
+        elif k in (im.CLS_LOAD, im.CLS_VLOAD):
+            addr, _ = pop()
+            if collect_facts:
+                scalar = k == im.CLS_LOAD
+                scan.facts.append(_mem_fact(
+                    pc, "load" if scalar else "vload",
+                    int(cells.b[pc]) if scalar else 16,
+                    addr, a, min_mem_bytes, scalar))
+            push(TOP)
+        elif k in (im.CLS_STORE, im.CLS_VSTORE):
+            pop()                           # value
+            addr, _ = pop()
+            if collect_facts:
+                scalar = k == im.CLS_STORE
+                scan.facts.append(_mem_fact(
+                    pc, "store" if scalar else "vstore",
+                    int(cells.b[pc]) if scalar else 16,
+                    addr, a, min_mem_bytes, scalar))
+        elif k in (im.CLS_MEMFILL, im.CLS_MEMCOPY, im.CLS_MEMINIT):
+            n, _ = pop()
+            src, _ = pop()
+            dst, _ = pop()
+            if collect_facts:
+                bases = (dst, src) if k == im.CLS_MEMCOPY else (dst,)
+                for base in bases:
+                    if base[0] >= 0 and n[0] >= 0 \
+                            and base[1] <= I32_MAX - n[1]:
+                        scan.bulk_ends.append(base[1] + n[1])
+                    else:
+                        scan.bulk_ends.append(None)
+        elif k == im.CLS_MEMSIZE:
+            lo = max(min_mem_bytes // 65536, 0)
+            hi = mem_decl_max_pages if mem_decl_max_pages > 0 else 65536
+            push(_clamp(lo, max(hi, lo), 1, 0))
+        elif k == im.CLS_MEMGROW:
+            pop()
+            push(_clamp(-1, 65536, 1, 0))
+        elif k in (im.CLS_CALL, im.CLS_RETCALL):
+            npar = int(cells.f_nparams[a]) \
+                if 0 <= a < len(cells.f_nparams) else 0
+            nres = int(cells.f_nresults[a]) \
+                if 0 <= a < len(cells.f_nresults) else 0
+            for _ in range(npar):
+                pop()
+            for _ in range(nres):
+                push(TOP)
+        elif k in (im.CLS_CALL_INDIRECT, im.CLS_RETCALL_INDIRECT,
+                   im.CLS_HOSTCALL):
+            stack.clear()                   # unknown arity: whole
+            #                                 in-block suffix is gone
+        else:
+            p, q = arity.get(k, (0, 0))
+            for _ in range(p):
+                pop()
+            for _ in range(q):
+                push(TOP)
+
+    if block.kind in ("brz", "brnz"):
+        cv, cs = pop()
+        if cs is not None and cs[0] == "cur":
+            # raw-value test: continue-while-nonzero == `ne 0`
+            cs = ("cmp", "ne", cs, cv, ("k", 0), const_val(0))
+        if cs is not None and cs[0] != "cmp":
+            cs = None
+        scan.cond_sym = cs
+    scan.locals_out = env
+    return scan
+
+
+def _mem_fact(pc, kind, nbytes, addr, off, min_mem_bytes,
+              scalar) -> MemFact:
+    """MemFact for one access: ea = addr + static offset `off`."""
+    off = int(np.uint32(np.int32(off)))     # offsets are u32 imm
+    ea = v_add(addr, const_val(off)) if off <= I32_MAX else TOP
+    m, r = ea[2], ea[3] % max(ea[2], 1)
+    align = _pow2_gcd(m, r)                 # divides every address
+    req = min(nbytes, 4)                    # word-straddle threshold
+    aligned = align % req == 0 if req > 1 else True
+    known = ea[0] > I32_MIN or ea[1] < I32_MAX
+    in_b = (known and ea[0] >= 0 and min_mem_bytes > 0
+            and ea[1] <= min_mem_bytes - nbytes)
+    return MemFact(
+        pc=pc, kind=kind, nbytes=nbytes,
+        lo=int(ea[0]) if known else None,
+        hi=int(ea[1]) if known else None,
+        align=int(align),
+        in_bounds=bool(in_b), aligned=bool(aligned),
+        licensed=bool(scalar and in_b and aligned))
+
+
+def _refine(locals_vec, scan, truth) -> list:
+    """Constrain the out-locals along one edge of a brz/brnz whose
+    condition is a tracked comparison (`truth` = condition value on
+    this edge)."""
+    cs = scan.cond_sym
+    if cs is None:
+        return locals_vec
+    _, name, lsym, lval, rsym, rval = cs
+    if not truth:
+        name = _CMP_NEG[name]
+    out = list(locals_vec)
+
+    def constrain(sym, other_val, cmp_name):
+        if sym is None or sym[0] != "cur" or other_val is None:
+            return
+        i, d = sym[1], sym[2]
+        if not (0 <= i < len(out)):
+            return
+        w = scan.writes.get(i)
+        if i in scan.writes and (w is None or w[0] != "cur"):
+            return                       # opaque write: cannot relate
+        d_cur = w[2] if w is not None else 0
+        shift = d_cur - d   # current value = compared value + shift
+        lo, hi, m, r = out[i]
+        if cmp_name in ("lt_u", "le_u"):
+            # unsigned `x < N` with N in the non-negative signed range
+            # bounds BOTH sides: the bit pattern is < N, so the signed
+            # value sits in [0, N-1] — this is what recovers the lower
+            # bound after a widened increment had to collapse to TOP
+            if other_val[0] < 0:
+                return
+            lo = max(lo, 0 + shift)
+            hi = min(hi, other_val[1] + shift
+                     - (1 if cmp_name == "lt_u" else 0))
+        elif cmp_name in ("gt_u", "ge_u"):
+            # sound only where the signed and unsigned orders agree
+            if lo < 0 or other_val[0] < 0:
+                return
+            lo = max(lo, other_val[0] + shift
+                     + (1 if cmp_name == "gt_u" else 0))
+        elif cmp_name == "lt_s":
+            hi = min(hi, other_val[1] - 1 + shift)
+        elif cmp_name == "le_s":
+            hi = min(hi, other_val[1] + shift)
+        elif cmp_name == "gt_s":
+            lo = max(lo, other_val[0] + 1 + shift)
+        elif cmp_name == "ge_s":
+            lo = max(lo, other_val[0] + shift)
+        elif cmp_name == "eq":
+            lo = max(lo, other_val[0] + shift)
+            hi = min(hi, other_val[1] + shift)
+        else:
+            return
+        if lo > hi:         # contradictory edge: dead in the concrete;
+            return          # keeping the old state stays sound
+        out[i] = (lo, hi, m, r)
+
+    constrain(lsym, rval, name)
+    constrain(rsym, lval, _CMP_SWAP[name])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the per-function driver
+# ---------------------------------------------------------------------------
+
+def analyze_func(cells: _Cells, cfg, fn_meta, mem_pages_init: int,
+                 mem_pages_max: int, has_memory: bool) -> FuncAbsint:
+    """Run the abstract interpreter over one defined function's CFG."""
+    out = FuncAbsint()
+    blocks = cfg.blocks
+    if not blocks:
+        out.ok = True
+        return out
+    arity = _arity_table()
+    nloc = int(fn_meta.nlocals)
+    npar = int(fn_meta.nparams)
+    entry = [TOP] * npar + [const_val(0)] * max(nloc - npar, 0)
+    globals_const: Dict[int, tuple] = {}
+    if cells.globals_init:
+        for gi, gv in enumerate(cells.globals_init):
+            if gi not in cells.written_globals and gv is not None:
+                globals_const[gi] = const_val(
+                    int(np.int32(np.uint32(int(gv) & 0xFFFFFFFF))))
+    min_mem = int(mem_pages_init) * 65536 if has_memory else 0
+
+    idx_of = {b.start: i for i, b in enumerate(blocks)}
+    succs = [[idx_of[s] for s in b.succ if s in idx_of] for b in blocks]
+    preds: List[List[int]] = [[] for _ in blocks]
+    for i, ss in enumerate(succs):
+        for s in ss:
+            preds[s].append(i)
+
+    def run_block(i, locals_in, collect=False):
+        return _transfer_block(cells, arity, blocks[i], locals_in,
+                               globals_const, min_mem, collect,
+                               mem_pages_max)
+
+    def edge_states(i, scan):
+        """(succ block idx, refined out-locals) per out edge."""
+        b = blocks[i]
+        outs = []
+        if b.kind in ("brz", "brnz"):
+            # succ[0] is the branch target, succ[1] the fallthrough;
+            # brnz branches on nonzero (cmp true), brz on zero
+            for ei, s in enumerate(b.succ):
+                si = idx_of.get(s)
+                if si is None:
+                    continue
+                truth = (ei == 0) == (b.kind == "brnz")
+                outs.append((si, _refine(scan.locals_out, scan, truth)))
+        else:
+            for s in b.succ:
+                si = idx_of.get(s)
+                if si is not None:
+                    outs.append((si, list(scan.locals_out)))
+        return outs
+
+    # -- ascending fixpoint with widening at loop heads ------------------
+    in_state: Dict[int, list] = {0: entry}
+    join_count = [0] * len(blocks)
+    work = [0]
+    iters = 0
+    while work:
+        iters += 1
+        if iters > MAX_ITERS:
+            return out                   # sound bail-out: no facts
+        i = work.pop()
+        st = in_state.get(i)
+        if st is None:
+            continue
+        scan = run_block(i, st)
+        for si, sout in edge_states(i, scan):
+            old = in_state.get(si)
+            if old is None:
+                in_state[si] = sout
+                work.append(si)
+                continue
+            new = [join(o, n) for o, n in zip(old, sout)]
+            if new == old:
+                continue
+            if blocks[si].is_loop_head:
+                join_count[si] += 1
+                if join_count[si] > WIDEN_DELAY:
+                    new = [widen(o, n) for o, n in zip(old, new)]
+            in_state[si] = new
+            work.append(si)
+
+    # -- descending (narrowing) passes: monotone F applied to a post-
+    # fixpoint stays above the least fixpoint, so the branch
+    # refinement can pull widened loop-head bounds back down ------------
+    for _ in range(NARROW_PASSES):
+        new_in: Dict[int, list] = {0: list(entry)}
+        for i in range(len(blocks)):
+            st = in_state.get(i)
+            if st is None:
+                continue
+            scan = run_block(i, st)
+            for si, sout in edge_states(i, scan):
+                cur = new_in.get(si)
+                new_in[si] = sout if cur is None else \
+                    [join(o, n) for o, n in zip(cur, sout)]
+        in_state = new_in
+
+    # -- final pass: collect facts + per-block scans for trip bounds ----
+    scans: Dict[int, _BlockScan] = {}
+    for i in range(len(blocks)):
+        st = in_state.get(i)
+        if st is None:
+            continue
+        scans[i] = run_block(i, st, collect=True)
+        out.mem_facts.extend(scans[i].facts)
+        for e in scans[i].bulk_ends:
+            out.mem_facts.append(MemFact(
+                pc=blocks[i].start, kind="bulk", nbytes=0,
+                lo=0, hi=e, align=1,
+                in_bounds=e is not None and e <= min_mem,
+                aligned=True, licensed=False))
+
+    # -- trip bounds per loop nest (recursive SCC decomposition, the
+    # exact decomposition loop_nest_cost replays: an inner loop is a
+    # cyclic SCC of the outer loop's body once the back edges into the
+    # outer head are removed) --------------------------------------------
+    def collect_loops(nodes, edges, depth):
+        for comp in _sccs_sub(sorted(nodes), edges):
+            cset = set(comp)
+            if not (len(comp) > 1 or comp[0] in edges.get(comp[0], ())):
+                continue
+            heads = [n for n in comp
+                     if n == 0 or any(p not in cset for p in preds[n])]
+            trip = None
+            head_blk = min(comp)
+            if len(heads) == 1:
+                head_blk = heads[0]
+                trip = _trip_bound(blocks, cset, head_blk, scans,
+                                   entry, idx_of, preds,
+                                   edge_states)
+                if trip is not None:
+                    out.trips[head_blk] = trip
+            out.loops.append(LoopFact(head_pc=blocks[head_blk].start,
+                                      trip_bound=trip))
+            if len(heads) == 1 and depth < 64:
+                inner = {n: [s for s in edges.get(n, ())
+                             if s in cset and s != heads[0]]
+                         for n in cset}
+                collect_loops(cset, inner, depth + 1)
+
+    collect_loops(set(range(len(blocks))),
+                  {i: list(ss) for i, ss in enumerate(succs)}, 0)
+    out.loops.sort(key=lambda lf: lf.head_pc)
+    out.ok = True
+    return out
+
+
+def _sccs(n, succs) -> List[List[int]]:
+    """Iterative Tarjan over [0, n) (reverse-topological order)."""
+    index = [0] * n
+    low = [0] * n
+    on = [False] * n
+    seen = [False] * n
+    stack: List[int] = []
+    counter = [1]
+    comps: List[List[int]] = []
+    for root in range(n):
+        if seen[root]:
+            continue
+        work = [(root, 0)]
+        while work:
+            v, ei = work[-1]
+            if ei == 0:
+                seen[v] = True
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on[v] = True
+            advanced = False
+            while ei < len(succs[v]):
+                w = succs[v][ei]
+                ei += 1
+                if not seen[w]:
+                    work[-1] = (v, ei)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if on[w]:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on[w] = False
+                    scc.append(w)
+                    if w == v:
+                        break
+                comps.append(scc)
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+    return comps
+
+
+def _trip_bound(blocks, sset, h, scans, entry_state, idx_of, preds,
+                edge_states) -> Optional[int]:
+    """Counted-loop trip bound for the SCC `sset` with unique head `h`,
+    or None.  Requirements (each individually sound to refuse):
+
+      - a single conditional test block t with one successor inside
+        the SCC and one outside, where t is the head or the ONLY
+        back-edge source (so every iteration passes the test);
+      - condition `cmp(op, local i + d, limit)` with limit a constant
+        or a loop-invariant local's ranged value;
+      - every SCC write to local i sits in a back-edge source block,
+        exactly once per such block, all with the same constant step.
+
+    The returned bound counts executions of the test block — an upper
+    bound on every SCC block's executions (each full traversal of the
+    loop passes the test exactly once), which is what loop_nest_cost
+    multiplies by the per-iteration path cost.
+    """
+    head_pc = blocks[h].start
+    back_srcs = [n for n in sset
+                 if any(s == head_pc for s in blocks[n].succ)]
+    if not back_srcs:
+        return None
+    tests = []
+    for n in sset:
+        b = blocks[n]
+        if b.kind not in ("brz", "brnz") or len(b.succ) != 2:
+            continue
+        in_s = [s for s in b.succ if idx_of.get(s) in sset]
+        out_s = [s for s in b.succ if idx_of.get(s) not in sset]
+        if len(in_s) == 1 and len(out_s) == 1:
+            tests.append((n, in_s[0]))
+    tests = [(n, cont) for n, cont in tests
+             if n == h or (len(back_srcs) == 1 and back_srcs[0] == n)]
+    if len(tests) != 1:
+        return None
+    t, cont = tests[0]
+    scan = scans.get(t)
+    if scan is None or scan.cond_sym is None:
+        return None
+    _, name, lsym, lval, rsym, rval = scan.cond_sym
+    # normalize: induction local on the left
+    if (lsym is None or lsym[0] != "cur") \
+            and rsym is not None and rsym[0] == "cur":
+        lsym, lval, rsym, rval = rsym, rval, lsym, lval
+        name = _CMP_SWAP[name]
+    if lsym is None or lsym[0] != "cur":
+        return None
+    i, d = lsym[1], lsym[2]
+    # continue-edge orientation: the brnz branch edge is cond-true
+    taken_is_continue = (blocks[t].kind == "brnz") == \
+        (cont == blocks[t].succ[0])
+    op = name if taken_is_continue else _CMP_NEG[name]
+    # limit: a constant, or a loop-invariant local read unmodified
+    if rsym is not None and rsym[0] == "k":
+        limit = const_val(rsym[1])
+    elif rsym is not None and rsym[0] == "cur" and rsym[2] == 0 \
+            and all(scans[n].n_writes.get(rsym[1], 0) == 0
+                    for n in sset if n in scans):
+        limit = rval
+    else:
+        return None
+    # induction step: uniform across all back-edge source blocks
+    step = None
+    for n in sset:
+        sc = scans.get(n)
+        if sc is None:
+            return None
+        nw = sc.n_writes.get(i, 0)
+        if nw == 0:
+            continue
+        w = sc.writes.get(i)
+        if n not in back_srcs or nw != 1 or w is None \
+                or w[0] != "cur" or w[1] != i:
+            return None
+        if w[2] == 0 or (step is not None and w[2] != step):
+            return None
+        step = w[2]
+    if step is None:
+        return None
+    # the compared value's offset d is relative to the TEST block's
+    # entry; when the test block also hosts the write, d already
+    # includes the in-iteration step (the canonical latch shape)
+    # entry value of local i at the head from OUTSIDE the loop only
+    ext = None
+    for p in preds[h]:
+        if p in sset:
+            continue
+        pscan = scans.get(p)
+        if pscan is None:
+            continue
+        for si, sout in edge_states(p, pscan):
+            if si == h and i < len(sout):
+                ext = sout[i] if ext is None else join(ext, sout[i])
+    if ext is None:
+        if h == 0 and i < len(entry_state):
+            # the head IS the entry block: the only external "edge" is
+            # the function entry itself (params TOP, locals zero) —
+            # NOT the joined in-state, which already includes the
+            # loop's own back-edge contributions
+            ext = entry_state[i]
+        else:
+            return None
+    i0_lo, i0_hi = ext[0], ext[1]
+    n_lo, n_hi = limit[0], limit[1]
+    if i0_lo <= I32_MIN or i0_hi >= I32_MAX \
+            or n_lo <= I32_MIN or n_hi >= I32_MAX:
+        return None
+    if op.endswith("_u") and (i0_lo < 0 or n_lo < 0):
+        return None                  # unsigned order != signed order
+
+    def ceil_div(a, b):
+        return -((-a) // b)
+
+    # T = executions of the test block; the k-th test sees the value
+    # i0 + (k-1)*step + d and continues while `value <op> limit`
+    if step > 0:
+        if op in ("lt_s", "lt_u"):
+            t_max = ceil_div(n_hi - i0_lo - d, step) + 1
+        elif op in ("le_s", "le_u"):
+            t_max = (n_hi - i0_lo - d) // step + 2
+        elif op == "ne":
+            # an equality exit needs the advance per test to be EXACTLY
+            # the step: the test block must be the sole back-edge
+            # source (monotone compares tolerate extra increments per
+            # traversal, `ne` would step over the exit value)
+            if step != 1 or i0_hi + d > n_lo \
+                    or back_srcs != [t]:
+                return None
+            t_max = n_hi - i0_lo - d + 1
+        else:
+            return None
+    else:
+        if op in ("gt_s", "gt_u"):
+            t_max = ceil_div(i0_hi + d - n_lo, -step) + 1
+        elif op in ("ge_s", "ge_u"):
+            t_max = (i0_hi + d - n_lo) // (-step) + 2
+        elif op == "ne":
+            if step != -1 or i0_lo + d < n_hi \
+                    or back_srcs != [t]:
+                return None
+            t_max = i0_hi + d - n_lo + 1
+        else:
+            return None
+    # the whole progression must stay in i32 (no wraparound mid-loop)
+    span = abs(step) * (max(int(t_max), 1) + 1)
+    if i0_hi + span > I32_MAX or i0_lo - span < I32_MIN:
+        return None
+    return max(int(t_max), 1)
+
+
+# ---------------------------------------------------------------------------
+# loop-nest cost composition
+# ---------------------------------------------------------------------------
+
+def loop_nest_cost(cfg, block_cost, trips: Dict[int, int]) \
+        -> Optional[int]:
+    """Max-cost path from entry over the CFG where each counted loop
+    (a cyclic SCC with a trip bound at its unique head) contributes
+    trip * (max per-iteration path cost), recursively for nested
+    loops (the inner graph drops the back edges into the head).  None
+    whenever any needed trip bound or block cost is unknown — the
+    honest "unbounded" verdict."""
+    blocks = cfg.blocks
+    if not blocks:
+        return 0
+    idx_of = {b.start: i for i, b in enumerate(blocks)}
+    all_succs = [[idx_of[s] for s in b.succ if s in idx_of]
+                 for b in blocks]
+
+    def cost_of(nodes, edges, entry) -> Optional[int]:
+        node_list = sorted(nodes)
+        comps = _sccs_sub(node_list, edges)
+        comp_of: Dict[int, int] = {}
+        for ci, comp in enumerate(comps):
+            for n in comp:
+                comp_of[n] = ci
+        comp_cost: List[Optional[int]] = []
+        for comp in comps:
+            cset = set(comp)
+            cyclic = len(comp) > 1 or comp[0] in edges.get(comp[0], ())
+            if not cyclic:
+                comp_cost.append(block_cost(blocks[comp[0]]))
+                continue
+            heads = [n for n in comp if n == entry or any(
+                n in edges.get(p, ()) for p in nodes if p not in cset)]
+            if len(heads) != 1:
+                comp_cost.append(None)
+                continue
+            head = heads[0]
+            trip = trips.get(head)
+            if trip is None:
+                comp_cost.append(None)
+                continue
+            inner = {n: [s for s in edges.get(n, ())
+                         if s in cset and s != head] for n in cset}
+            per_iter = cost_of(cset, inner, head)
+            comp_cost.append(None if per_iter is None
+                             else int(trip) * per_iter)
+        comp_succs: List[set] = [set() for _ in comps]
+        for n in nodes:
+            for s in edges.get(n, ()):
+                if s in comp_of and comp_of[s] != comp_of[n]:
+                    comp_succs[comp_of[n]].add(comp_of[s])
+        # comps arrive reverse-topological (successors first), so one
+        # forward pass memoizes every path without recursion
+        memo: List[Optional[int]] = [None] * len(comps)
+        done: List[bool] = [False] * len(comps)
+        for ci in range(len(comps)):
+            own = comp_cost[ci]
+            best: Optional[int] = 0
+            if own is None:
+                best = None
+            else:
+                for s in comp_succs[ci]:
+                    if not done[s] or memo[s] is None:
+                        best = None
+                        break
+                    best = max(best, memo[s])
+                if best is not None:
+                    best = own + best
+            memo[ci] = best
+            done[ci] = True
+        ei = comp_of.get(entry)
+        return memo[ei] if ei is not None else 0
+
+    return cost_of(set(range(len(blocks))),
+                   {i: list(ss) for i, ss in enumerate(all_succs)}, 0)
+
+
+def _sccs_sub(nodes: List[int], edges: Dict[int, list]) \
+        -> List[List[int]]:
+    pos = {n: i for i, n in enumerate(nodes)}
+    succs = [[pos[s] for s in edges.get(n, ()) if s in pos]
+             for n in nodes]
+    return [[nodes[i] for i in comp]
+            for comp in _sccs(len(nodes), succs)]
+
+
+# ---------------------------------------------------------------------------
+# module driver
+# ---------------------------------------------------------------------------
+
+def analyze_module_absint(image, cfgs: Dict[int, object],
+                          mem_pages_init: int, mem_pages_max: int,
+                          has_memory: bool,
+                          globals_init=None) -> Dict[int, FuncAbsint]:
+    """Run absint over every defined function.  `cfgs` is the r12
+    {func_idx: FuncCFG} map.  Any per-function failure degrades to an
+    empty FuncAbsint (no facts, honest unbounded), never an exception
+    — the analyzer must stay total."""
+    out: Dict[int, FuncAbsint] = {}
+    try:
+        cells = _Cells(image, globals_init=globals_init)
+    except Exception:
+        return {i: FuncAbsint() for i in cfgs}
+    for i, cfg in cfgs.items():
+        try:
+            out[i] = analyze_func(cells, cfg, image.funcs[i],
+                                  mem_pages_init, mem_pages_max,
+                                  has_memory)
+        except Exception:
+            out[i] = FuncAbsint()
+    return out

@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 from wasmedge_tpu.analysis.cfg import BasicBlock, FuncCFG, build_func_cfg, \
     longest_path_cost
-from wasmedge_tpu.common.opcodes import NAME_TO_ID
+from wasmedge_tpu.common.opcodes import NAME_TO_ID, Op
 from wasmedge_tpu.validator.image import LoweredModule, lop_name
 
 SCHEMA = "wasmedge-tpu/analysis/v1"
@@ -88,6 +88,12 @@ class FuncAnalysis:
     block_ngrams: List[List[int]] = dataclasses.field(default_factory=list)
     hostcall_sites: List[HostcallSite] = dataclasses.field(
         default_factory=list)
+    # absint (analysis/absint.py) products: one entry per CFG loop
+    # ({"head": pc, "trip_bound": int|None}) and one per memory-access
+    # site ({"pc", "kind", "nbytes", "lo", "hi", "align", "in_bounds",
+    # "aligned", "licensed"})
+    loops: List[dict] = dataclasses.field(default_factory=list)
+    mem_facts: List[dict] = dataclasses.field(default_factory=list)
 
     @property
     def bounded(self) -> bool:
@@ -116,6 +122,8 @@ class FuncAnalysis:
             "call_depth_bound": self.call_depth_bound,
             "divergence": self.divergence,
             "hostcall_sites": [s.asdict() for s in self.hostcall_sites],
+            "loops": [dict(l) for l in self.loops],
+            "mem_facts": [dict(m) for m in self.mem_facts],
             "blocks": blocks,
         }
 
@@ -143,6 +151,15 @@ class ModuleAnalysis:
     tier0_sites: int = 0
     drain_sites: int = 0
     dynamic_call_sites: int = 0
+    # absint aggregate: proven max page TOUCH (every access site's
+    # effective-address range is finite and hostcalls cannot write
+    # guest memory), vs the declared bound above; plus the licensed
+    # (trap-free-provable) vs unproven scalar load/store site split —
+    # batch/fuse.py consumes licensed_pcs as its fusion license
+    mem_pages_touch_bound: Optional[int] = None
+    licensed_sites: int = 0
+    unlicensed_sites: int = 0
+    licensed_pcs: frozenset = frozenset()
 
     def func_by_idx(self, idx: int) -> Optional[FuncAnalysis]:
         for f in self.funcs:
@@ -160,11 +177,17 @@ class ModuleAnalysis:
             "call_depth_bound": self.call_depth_bound,
             "divergence": self.divergence,
             "mem_pages_bound": self.mem_pages_bound,
+            "mem_pages_touch_bound": self.mem_pages_touch_bound,
             "mem_grow_sites": self.mem_grow_sites,
             "tier0_hostcall_sites": self.tier0_sites,
             "drain_hostcall_sites": self.drain_sites,
             "dynamic_call_sites": self.dynamic_call_sites,
             "superinstruction_candidates": len(self.superinstructions),
+            "licensed_mem_sites": self.licensed_sites,
+            "unlicensed_mem_sites": self.unlicensed_sites,
+            "trip_bounded_loops": sum(
+                1 for f in self.funcs for l in f.loops
+                if l.get("trip_bound") is not None),
         }
 
     def to_dict(self) -> dict:
@@ -179,6 +202,7 @@ class ModuleAnalysis:
                 "pages_max_declared": self.mem_pages_max,
                 "grow_sites": self.mem_grow_sites,
                 "pages_bound": self.mem_pages_bound,
+                "pages_touch_bound": self.mem_pages_touch_bound,
             },
             "hostcalls": {
                 "imports": list(self.imports),
@@ -197,10 +221,17 @@ class ModuleAnalysis:
         annotations — the human half of the analyze CLI's report.
         `fusion` (a batch/fuse.py plan_fusion report) annotates which
         candidate runs were REALIZED as fused dispatch cells:
-        `fused=<head>+<len>` marks on the owning block lines."""
+        `fused=<head>+<len>` marks on the owning block lines
+        (`memfused=` for the r19 licensed load/store runs).  Loop
+        heads carry their absint trip verdict (`trip<=N` /
+        `trip=unbounded`), memory-access sites their proven
+        range/alignment class."""
         runs_by_pc = {}
         for r in (fusion or {}).get("runs", ()):
             runs_by_pc[int(r[0])] = (int(r[1]), int(r[2]))
+        mem_runs_by_pc = {}
+        for r in (fusion or {}).get("mem_runs", ()):
+            mem_runs_by_pc[int(r[0])] = (int(r[1]), int(r[2]))
         out: List[str] = []
         for f in self.funcs:
             flags = []
@@ -215,10 +246,14 @@ class ModuleAnalysis:
             out.append(f";; func {f.idx} {f.name!r} "
                        f"[{f.entry_pc}..{f.end_pc}] cost {bound}"
                        + (f" ({', '.join(flags)})" if flags else ""))
+            trips_by_head = {l["head"]: l["trip_bound"] for l in f.loops}
             for i, b in enumerate(f.cfg.blocks):
                 marks = []
                 if b.is_loop_head:
                     marks.append("loop-head")
+                    t = trips_by_head.get(b.start)
+                    marks.append("trip=unbounded" if t is None
+                                 else f"trip<={t}")
                 if b.in_loop:
                     marks.append("in-loop")
                 if self.block_ngram_names(f, i):
@@ -231,11 +266,27 @@ class ModuleAnalysis:
                               if b.start <= pc <= b.end]
                 if fused_here:
                     marks.append("fused=" + ",".join(fused_here))
+                memfused_here = [f"{pc}+{n}" for pc, (n, _k)
+                                 in sorted(mem_runs_by_pc.items())
+                                 if b.start <= pc <= b.end]
+                if memfused_here:
+                    marks.append("memfused=" + ",".join(memfused_here))
                 out.append(f";;   block [{b.start}..{b.end}] "
                            f"kind={b.kind} cost={f.block_costs[i]} "
                            f"div={f.block_divergence[i]} "
                            f"succ={list(b.succ)}"
                            + ((" " + " ".join(marks)) if marks else ""))
+                for m in f.mem_facts:
+                    if not (b.start <= m["pc"] <= b.end) \
+                            or m["kind"] == "bulk":
+                        continue
+                    rng = "[?]" if m["hi"] is None \
+                        else f"[{m['lo']}..{m['hi']}]"
+                    verdict = "licensed" if m["licensed"] else \
+                        ("in-bounds" if m["in_bounds"] else "unproven")
+                    out.append(f";;     mem@{m['pc']} {m['kind']}"
+                               f"{m['nbytes']} {rng} "
+                               f"align={m['align']} {verdict}")
                 out.append(image.disasm(b.start, b.end + 1))
         return "\n".join(out)
 
@@ -292,11 +343,26 @@ def analyze_validated(mod, cost_table=None) -> "ModuleAnalysis":
     batch/image.py stays the only instance-level variant)."""
     exports = {e.name: e.index for e in mod.exports if e.kind == 0}
     mems = mod.all_memory_types()
+    # non-escaping-global seeding for absint: only module-local const
+    # inits are extractable without instantiation (imported globals
+    # make every index unknowable pre-link -> None, which degrades the
+    # global domain to TOP, never to a wrong constant)
+    globals_init = None
+    if not mod.imported_globals():
+        globals_init = []
+        for g in mod.globals:
+            if len(g.init) == 1 and g.init[0].op in (
+                    Op.i32_const, Op.i64_const, Op.f32_const,
+                    Op.f64_const):
+                globals_init.append(int(g.init[0].imm))
+            else:
+                globals_init.append(None)
     return analyze_module(
         mod.lowered, exports=exports,
         mem_pages_init=mems[0].limit.min if mems else 0,
         mem_pages_max=(mems[0].limit.max or 0) if mems else 0,
-        has_memory=bool(mems), cost_table=cost_table)
+        has_memory=bool(mems), cost_table=cost_table,
+        globals_init=globals_init)
 
 
 def analyze_module(image: LoweredModule,
@@ -304,11 +370,14 @@ def analyze_module(image: LoweredModule,
                    mem_pages_init: int = 0,
                    mem_pages_max: int = 0,
                    has_memory: Optional[bool] = None,
-                   cost_table=None) -> ModuleAnalysis:
+                   cost_table=None,
+                   globals_init=None) -> ModuleAnalysis:
     """Analyze a validated lowered image.  `exports` maps export name
     -> function index (used for naming and the module-level aggregate);
     `cost_table` maps opcode id -> gas weight (flat 1 = bounds in
-    retired-instruction units)."""
+    retired-instruction units); `globals_init` optionally carries the
+    module globals' initial values (absint constant-folds the ones no
+    global.set site can reach)."""
     exports = exports or {}
     if has_memory is None:
         has_memory = mem_pages_init > 0 or mem_pages_max > 0
@@ -339,6 +408,17 @@ def analyze_module(image: LoweredModule,
 
     # recursion: any call-graph cycle reachable through static edges
     recursive = _callgraph_cycles(defined, callees)
+
+    # -- abstract interpretation (analysis/absint.py): loop trip
+    # bounds, memory-effect facts, fusion licenses.  Total by
+    # construction (a per-function failure degrades to no facts).
+    from wasmedge_tpu.analysis.absint import (
+        analyze_module_absint, loop_nest_cost)
+
+    absints = analyze_module_absint(
+        image, cfgs, mem_pages_init=mem_pages_init,
+        mem_pages_max=mem_pages_max, has_memory=bool(has_memory),
+        globals_init=globals_init)
 
     # -- bottom-up bounds over the call-graph condensation ------------------
     cost_bound: Dict[int, Optional[int]] = {}
@@ -376,7 +456,17 @@ def analyze_module(image: LoweredModule,
                 total += sub
             return total
 
-        cost_bound[i] = longest_path_cost(cfg, bcost)
+        if cfg.has_loop:
+            # counted loops: the absint trip bounds compose through
+            # the loop-nest walk (trip x per-iteration longest path,
+            # recursively); any unbounded loop poisons to None — the
+            # seed's honest verdict, now only for loops that ARE
+            # statically unbounded
+            trips = absints[i].trips if i in absints else {}
+            cost_bound[i] = loop_nest_cost(cfg, bcost, trips) \
+                if trips else None
+        else:
+            cost_bound[i] = longest_path_cost(cfg, bcost)
         frame = fn.nlocals + fn.max_height
         sb: Optional[int] = frame
         db: Optional[int] = 1
@@ -465,6 +555,7 @@ def analyze_module(image: LoweredModule,
                 total_dyn += 1
         total_t0 += sum(1 for s in sites if s.tier0)
         total_drain += sum(1 for s in sites if not s.tier0)
+        ai = absints.get(i)
         funcs.append(FuncAnalysis(
             idx=i, name=export_of.get(i, f"func{i}"),
             entry_pc=fn.entry_pc, end_pc=fn.end_pc, cfg=cfg,
@@ -476,7 +567,9 @@ def analyze_module(image: LoweredModule,
             call_depth_bound=depth_bound[i],
             divergence=max(div) if div else 0,
             block_divergence=div, block_ngrams=ngrams,
-            hostcall_sites=sites))
+            hostcall_sites=sites,
+            loops=[l.asdict() for l in ai.loops] if ai else [],
+            mem_facts=[m.asdict() for m in ai.mem_facts] if ai else []))
 
     # -- module aggregate ---------------------------------------------------
     roots = [f for f in funcs
@@ -502,6 +595,27 @@ def analyze_module(image: LoweredModule,
     else:
         pages_bound = None  # growable with no declared ceiling
 
+    # -- proven max page touch + fusion licenses (absint aggregate) ---------
+    all_facts = [m for f in funcs for m in f.mem_facts]
+    licensed_pcs = frozenset(m["pc"] for m in all_facts
+                             if m.get("licensed"))
+    scalar_sites = [m for m in all_facts if m["kind"] in ("load",
+                                                          "store")]
+    licensed_sites = sum(1 for m in scalar_sites if m["licensed"])
+    # touch bound: every access site's end is proven finite AND no
+    # hostcall can write guest memory at a guest-chosen pointer AND
+    # every function's absint ran (dead-code sites carry no facts and
+    # never execute, so their absence is fine)
+    touch: Optional[int] = None
+    if has_memory and total_t0 + total_drain == 0 \
+            and all(absints.get(i) is not None and absints[i].ok
+                    for i in defined):
+        ends = [(m["hi"] or 0) + m["nbytes"] if m["hi"] is not None
+                else None for m in all_facts]
+        if all(e is not None for e in ends):
+            touch = max(
+                max((-(-e // 65536) for e in ends), default=0), 1)
+
     return ModuleAnalysis(
         funcs=funcs,
         imports=[{"func": idx, "import": qual, "tier0": t0,
@@ -519,6 +633,10 @@ def analyze_module(image: LoweredModule,
         mem_grow_sites=mem_grow_sites, mem_pages_bound=pages_bound,
         tier0_sites=total_t0, drain_sites=total_drain,
         dynamic_call_sites=total_dyn,
+        mem_pages_touch_bound=touch,
+        licensed_sites=licensed_sites,
+        unlicensed_sites=len(scalar_sites) - licensed_sites,
+        licensed_pcs=licensed_pcs,
     )
 
 

@@ -68,6 +68,11 @@ class AnalysisPolicy:
     # when grow sites exist, initial pages otherwise) is unbounded or
     # over this — the resident-lane HBM budget (ROADMAP #4).
     max_memory_pages: Optional[int] = None
+    # Proven max page TOUCH (absint, r19): reject when the abstract
+    # interpreter could not bound the pages the module's accesses can
+    # reach, or the proven touch exceeds this.  Stricter than
+    # max_memory_pages: it demands a PROOF, not just a declaration.
+    max_memory_pages_touched: Optional[int] = None
     # Value-stack / frame-depth bounds along the static call graph.
     max_value_stack: Optional[int] = None
     max_call_depth: Optional[int] = None
@@ -80,8 +85,8 @@ class AnalysisPolicy:
 
     _KNOWN = frozenset((
         "max_static_cost", "require_bounded", "max_memory_pages",
-        "max_value_stack", "max_call_depth", "tier0_only_hostcalls",
-        "enforce"))
+        "max_memory_pages_touched", "max_value_stack",
+        "max_call_depth", "tier0_only_hostcalls", "enforce"))
 
     @classmethod
     def from_dict(cls, d: dict, where: str = "analysis") \
@@ -98,6 +103,7 @@ class AnalysisPolicy:
             max_static_cost=_int("max_static_cost"),
             require_bounded=bool(d.get("require_bounded", False)),
             max_memory_pages=_int("max_memory_pages"),
+            max_memory_pages_touched=_int("max_memory_pages_touched"),
             max_value_stack=_int("max_value_stack"),
             max_call_depth=_int("max_call_depth"),
             tier0_only_hostcalls=bool(d.get("tier0_only_hostcalls",
@@ -114,6 +120,7 @@ class AnalysisPolicy:
         if analysis is None:
             if self.max_static_cost is not None or self.require_bounded \
                     or self.max_memory_pages is not None \
+                    or self.max_memory_pages_touched is not None \
                     or self.max_value_stack is not None \
                     or self.max_call_depth is not None \
                     or self.tier0_only_hostcalls:
@@ -139,6 +146,14 @@ class AnalysisPolicy:
                     "max_memory_pages", self.max_memory_pages, pages,
                     "static linear-memory page bound over the "
                     "resident-lane budget"))
+        if self.max_memory_pages_touched is not None:
+            touched = getattr(analysis, "mem_pages_touch_bound", None)
+            if touched is None or touched > self.max_memory_pages_touched:
+                out.append(_violation(
+                    "max_memory_pages_touched",
+                    self.max_memory_pages_touched, touched,
+                    "abstract interpretation could not prove the "
+                    "page-touch bound under the limit"))
         if self.max_value_stack is not None:
             vs = analysis.value_stack_bound
             if vs is None or vs > self.max_value_stack:

@@ -60,6 +60,18 @@ def validate_report(doc) -> List[str]:
         if not summary.get("bounded", True) \
                 and summary.get("cost_bound") is not None:
             problems.append("summary: bounded=false with a cost_bound")
+        # absint keys (r19) are OPTIONAL — pre-absint reports must keep
+        # validating — but when present they must be well-formed
+        if "mem_pages_touch_bound" in summary \
+                and not _is_bound(summary["mem_pages_touch_bound"]):
+            problems.append("summary.mem_pages_touch_bound: not a "
+                            "bound (int >= 0 or null)")
+        for key in ("licensed_mem_sites", "unlicensed_mem_sites",
+                    "trip_bounded_loops"):
+            if key in summary and (isinstance(summary[key], bool)
+                                   or not isinstance(summary[key],
+                                                     int)):
+                problems.append(f"summary.{key}: expected int")
 
     mem = _req(doc, "memory", dict, problems, "report")
     if mem is not None:
@@ -133,14 +145,75 @@ def validate_report(doc) -> List[str]:
                 problems.append(
                     "fusion: fused_runs disagrees with candidate "
                     "realized_runs sum")
+            # r19 memory-run section (optional, back-compat)
+            if "memory" in fu:
+                fm = fu["memory"]
+                if not isinstance(fm, dict):
+                    problems.append("fusion.memory: not an object")
+                else:
+                    for key in ("licensed_sites", "unlicensed_sites",
+                                "mem_runs", "mem_cells",
+                                "mem_patterns"):
+                        _req(fm, key, int, problems, "fusion.memory")
+                    mr = fu.get("mem_runs")
+                    if isinstance(mr, list) and isinstance(
+                            fm.get("mem_runs"), int) \
+                            and len(mr) != fm["mem_runs"]:
+                        problems.append(
+                            "fusion.memory: mem_runs count disagrees "
+                            "with the realized run list")
 
     funcs = _req(doc, "funcs", list, problems, "report")
+    mem_fact_by_pc = {}
     if funcs is not None:
         for fi, f in enumerate(funcs):
             where = f"funcs[{fi}]"
             if not isinstance(f, dict):
                 problems.append(f"{where}: not an object")
                 continue
+            # absint keys (r19): optional for back-compat; reconciled
+            # when present
+            loops = f.get("loops")
+            if loops is not None:
+                if not isinstance(loops, list):
+                    problems.append(f"{where}.loops: not a list")
+                    loops = []
+                for li, l in enumerate(loops):
+                    if not isinstance(l, dict) \
+                            or not isinstance(l.get("head"), int) \
+                            or not _is_bound(l.get("trip_bound")):
+                        problems.append(
+                            f"{where}.loops[{li}]: malformed")
+                # a function with a loop can only be cost-bounded when
+                # every one of its loops carries a finite trip bound
+                if f.get("bounded") and f.get("has_loop") \
+                        and any(l.get("trip_bound") is None
+                                for l in loops
+                                if isinstance(l, dict)):
+                    problems.append(
+                        f"{where}: bounded with an unbounded loop "
+                        f"(trip bounds must license the cost bound)")
+            mfs = f.get("mem_facts")
+            if mfs is not None:
+                if not isinstance(mfs, list):
+                    problems.append(f"{where}.mem_facts: not a list")
+                    mfs = []
+                for mi, mf in enumerate(mfs):
+                    if not isinstance(mf, dict) \
+                            or not isinstance(mf.get("pc"), int) \
+                            or not isinstance(mf.get("licensed"),
+                                              bool):
+                        problems.append(
+                            f"{where}.mem_facts[{mi}]: malformed")
+                        continue
+                    if mf.get("licensed") and not (
+                            mf.get("in_bounds") and mf.get("aligned")):
+                        problems.append(
+                            f"{where}.mem_facts[{mi}]: licensed "
+                            f"without in_bounds+aligned proof")
+                    if mf.get("kind") in ("load", "store"):
+                        mem_fact_by_pc[mf["pc"]] = bool(
+                            mf.get("licensed"))
             _req(f, "idx", int, problems, where)
             _req(f, "name", str, problems, where)
             entry = _req(f, "entry_pc", int, problems, where)
@@ -185,4 +258,20 @@ def validate_report(doc) -> List[str]:
                         problems.append(
                             f"{where}.blocks[{bi}]: successor {t} is "
                             f"not a block start")
+    # realized memory runs must be covered by licenses: every scalar
+    # load/store inside a fused mem run carries licensed=true (the
+    # "licensed runs are a superset of realized runs" reconciliation)
+    if isinstance(doc.get("fusion"), dict) and mem_fact_by_pc:
+        for ri, r in enumerate(doc["fusion"].get("mem_runs") or ()):
+            if not (isinstance(r, list) and len(r) >= 2
+                    and all(isinstance(x, int) for x in r[:2])):
+                problems.append(f"fusion.mem_runs[{ri}]: malformed")
+                continue
+            head, n = r[0], r[1]
+            for pc in range(head, head + n):
+                if pc in mem_fact_by_pc and not mem_fact_by_pc[pc]:
+                    problems.append(
+                        f"fusion.mem_runs[{ri}]: unlicensed "
+                        f"load/store at pc {pc} inside a fused "
+                        f"memory run")
     return problems

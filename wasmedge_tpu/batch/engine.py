@@ -502,14 +502,45 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
 
     # ---- superinstruction fusion statics (batch/fuse.py) ----
     # FUSE_ON is trace-time static: knob off (or nothing realized)
-    # compiles the exact seed per-op step.
-    from wasmedge_tpu.batch.fuse import fusion_active, make_fused_apply
+    # compiles the exact seed per-op step.  Memory-run patterns (r19,
+    # absint-licensed load/store runs) compile through their own
+    # handler; a pattern table with only one kind builds only that
+    # handler.
+    from wasmedge_tpu.batch.fuse import (
+        fusion_active, make_fused_apply, make_memfuse_apply,
+        pattern_has_mem)
 
     FUSE_ON = fusion_active(img, cfg)
+    HAS_PURE_PAT = HAS_MEM_PAT = False
     if FUSE_ON:
         flen_t = jnp.asarray(img.fuse_len)
         MAX_F = int(np.asarray(img.fuse_len).max())
-        fused_apply = make_fused_apply(img, lanes, HAS_SIMD)
+        _pats = img.fuse_patterns or ()
+        _pat_mem = np.array([pattern_has_mem(p) for p in _pats], bool)
+        HAS_PURE_PAT = bool((~_pat_mem).any())
+        HAS_MEM_PAT = bool(_pat_mem.any())
+        if HAS_PURE_PAT:
+            fused_apply = make_fused_apply(img, lanes, HAS_SIMD)
+        if HAS_MEM_PAT:
+            from wasmedge_tpu.batch.fuse import memfuse_store_slots
+
+            memfuse_apply = make_memfuse_apply(img, lanes, HAS_SIMD)
+            N_MEM_SLOTS = memfuse_store_slots(img)
+            _fpat_np = np.asarray(img.fuse_pat)
+            _memhead = np.zeros(_fpat_np.shape[0], bool)
+            _valid = _fpat_np >= 0
+            _memhead[_valid] = _pat_mem[_fpat_np[_valid]]
+            _memhead &= np.asarray(img.fuse_len) >= 2
+            memhead_t = jnp.asarray(_memhead)
+            # heads of patterns that STORE (the fused-store channel's
+            # any-lane gate; load-only runs never touch the plane)
+            _pat_st = np.array(
+                [any(cl == CLS_STORE for cl, _ in p) for p in _pats],
+                bool)
+            _sthead = np.zeros(_fpat_np.shape[0], bool)
+            _sthead[_valid] = _pat_st[_fpat_np[_valid]]
+            _sthead &= _memhead
+            sthead_t = jnp.asarray(_sthead)
 
     def step(st: BatchState, t0_time=None) -> BatchState:
         """One lockstep instruction (or one fused dispatch cell — a
@@ -540,8 +571,15 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
             # the per-op path must not also fire for fused lanes: the
             # head pc still carries its ORIGINAL first-op cell
             active = alive & ~is_fused
+            if HAS_MEM_PAT:
+                is_fused_mem = is_fused & memhead_t[pc]
+                is_fused_pure = is_fused & ~memhead_t[pc]
+            else:
+                is_fused_mem = jnp.bool_(False) & alive
+                is_fused_pure = is_fused
         else:
             is_fused = jnp.bool_(False) & alive
+            is_fused_mem = is_fused_pure = is_fused
             active = alive
         cls = cls_t[pc]
         sub = sub_t[pc]
@@ -1576,22 +1614,62 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
         # head skip the pattern handlers entirely (same rationale as
         # the store scatters above on the CPU backend).
         if FUSE_ON:
+            fused_sp = sp
             _stk = tuple([stack_lo, stack_hi] + (
                 [stack_e2, stack_e3] if HAS_SIMD else []))
 
-            def _run_fused(ops):
-                stk, gl, gh = ops
-                stk2, (gl2, gh2), fsp = fused_apply(
-                    list(stk), (gl, gh), pc, sp, fp, is_fused)
-                return tuple(stk2), gl2, gh2, fsp
+            if HAS_PURE_PAT:
+                def _run_fused(ops):
+                    stk, gl, gh = ops
+                    stk2, (gl2, gh2), fsp = fused_apply(
+                        list(stk), (gl, gh), pc, sp, fp,
+                        is_fused_pure)
+                    return tuple(stk2), gl2, gh2, fsp
 
-            def _skip_fused(ops):
-                stk, gl, gh = ops
-                return stk, gl, gh, sp
+                def _skip_fused(ops):
+                    stk, gl, gh = ops
+                    return stk, gl, gh, sp
 
-            _stk, glob_lo, glob_hi, fused_sp = lax.cond(
-                jnp.any(is_fused), _run_fused, _skip_fused,
-                (_stk, glob_lo, glob_hi))
+                _stk, glob_lo, glob_hi, fused_sp = lax.cond(
+                    jnp.any(is_fused_pure), _run_fused, _skip_fused,
+                    (_stk, glob_lo, glob_hi))
+            if HAS_MEM_PAT:
+                # licensed memory runs (r19): same disjoint-mask merge
+                # for the stack/global planes; the memory plane itself
+                # NEVER rides the conditional's tuple carry (a big
+                # buffer there costs a full-plane copy every step on
+                # the CPU backend) — the handler reads it and returns
+                # per-lane (widx, value, mask) store triples, applied
+                # below under the per-op path's run_stores shape
+                _zstores = tuple((zl, zl, is_fused_mem & False)
+                                 for _ in range(N_MEM_SLOTS))
+
+                def _run_memfused(ops):
+                    stk, gl, gh = ops
+                    stk2, (gl2, gh2), st_out, fsp = memfuse_apply(
+                        list(stk), (gl, gh), mem_plane, pc, sp, fp,
+                        is_fused_mem)
+                    return tuple(stk2), gl2, gh2, st_out, fsp
+
+                def _skip_memfused(ops):
+                    stk, gl, gh = ops
+                    return stk, gl, gh, _zstores, sp
+
+                _stk, glob_lo, glob_hi, _mstores, _fsp_mem = \
+                    lax.cond(jnp.any(is_fused_mem), _run_memfused,
+                             _skip_memfused,
+                             (_stk, glob_lo, glob_hi))
+                fused_sp = jnp.where(is_fused_mem, _fsp_mem, fused_sp)
+                fused_st = is_fused_mem & sthead_t[pc]
+
+                def _apply_mstores(mp):
+                    for wi, v, mk in _mstores:
+                        mp = scat(mp, wi, v, mk)
+                    return mp
+
+                mem_plane = lax.cond(jnp.any(fused_st),
+                                     _apply_mstores, lambda mp: mp,
+                                     mem_plane)
             stack_lo, stack_hi = _stk[0], _stk[1]
             if HAS_SIMD:
                 stack_e2, stack_e3 = _stk[2], _stk[3]
@@ -1868,6 +1946,12 @@ class BatchEngine:
         from wasmedge_tpu.batch.fuse import plan_fusion
 
         plan_fusion(self.img, self.cfg)
+        # licensed-vs-reverted memory-run counters for the Prometheus
+        # export (planning statics — the device fu_ctr plane already
+        # counts fused dispatches at runtime)
+        mem = (self.img.fusion_report or {}).get("memory")
+        if mem and self.obs.enabled:
+            self.obs.set_memfuse_static(mem)
 
     def _t0_gate(self, kinds):
         """Engine-level tier-0 gating: fd_write buffering additionally

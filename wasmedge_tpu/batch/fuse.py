@@ -51,11 +51,13 @@ from wasmedge_tpu.batch.image import (
     CLS_DROP,
     CLS_GLOBAL_GET,
     CLS_GLOBAL_SET,
+    CLS_LOAD,
     CLS_LOCAL_GET,
     CLS_LOCAL_SET,
     CLS_LOCAL_TEE,
     CLS_NOP,
     CLS_SELECT,
+    CLS_STORE,
     _F64_BIN,
     _I32_BIN,
 )
@@ -100,6 +102,44 @@ def cell_eligible(cls: int, sub: int) -> bool:
     if cls == CLS_ALU2:
         return sub not in _ALU2_BLOCKED
     return False
+
+
+# -- memory-run cells (r19) -------------------------------------------------
+# A load/store may join a fused run ONLY at a pc the abstract
+# interpreter licensed (analysis/absint.py: the access is proven
+# in-bounds against the module's minimum memory and aligned enough to
+# never straddle a device word — it can never trap).  Pattern cells
+# for memory ops encode the STATIC width/flags instead of `sub` (the
+# sub plane is 0 for loads/stores; width lives in the b/c planes):
+#
+#   (CLS_LOAD,  nbytes | signed << 8 | is64 << 9)
+#   (CLS_STORE, nbytes)
+#
+# so each pattern handler compiles a width-specialized access.
+_MEM_CLS = (CLS_LOAD, CLS_STORE)
+
+
+def mem_cell_key(img, pc: int):
+    """Pattern-cell encoding for the load/store at `pc`."""
+    cls = int(img.cls[pc])
+    if cls == CLS_LOAD:
+        return (CLS_LOAD, int(img.b[pc]) | (int(img.c[pc]) << 8))
+    return (CLS_STORE, int(img.b[pc]))
+
+
+def pattern_has_mem(pat) -> bool:
+    """Does a fused pattern contain load/store cells?  (Such patterns
+    are compiled by make_memfuse_apply, never by make_fused_apply.)"""
+    return any(cl in _MEM_CLS for cl, _ in pat)
+
+
+def _mem_cell_ok(img, pc: int, licensed) -> bool:
+    """May the cell at `pc` join a MEMORY run?  Pure-eligible cells
+    always can; loads/stores only with an absint license."""
+    cls = int(img.cls[pc])
+    if cls in _MEM_CLS:
+        return pc in licensed
+    return cell_eligible(cls, int(img.sub[pc]))
 
 
 def fusion_active(img, cfg) -> bool:
@@ -151,6 +191,9 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
     top_k = max(int(getattr(cfg, "fuse_top_k", 12)), 0)
     max_pat = max(int(getattr(cfg, "fuse_max_patterns", 8)), 0)
     div_bias = float(getattr(cfg, "fuse_divergence_bias", 0.0))
+    mem_on = bool(getattr(cfg, "fuse_memory_runs", True))
+    mem_max_run = max(int(getattr(cfg, "memfuse_max_run", 24)), 2)
+    mem_max_pat = max(int(getattr(cfg, "memfuse_max_patterns", 8)), 0)
     report = {
         "enabled": bool(getattr(cfg, "fuse_superinstructions", True)),
         "top_k": top_k,
@@ -161,14 +204,29 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
         "fused_cells": 0,
         "candidates": [],
         "runs": [],
+        "mem_runs": [],
+        "memory": {
+            "enabled": mem_on,
+            "max_run": mem_max_run,
+            "max_patterns": mem_max_pat,
+            "licensed_sites": 0,
+            "unlicensed_sites": 0,
+            "mem_runs": 0,
+            "mem_cells": 0,
+            "mem_patterns": 0,
+        },
     }
     img.fusion_report = report
     if not report["enabled"]:
         return report
     if analysis is None:
         analysis = img.analysis
-    if analysis is None or not getattr(analysis, "superinstructions", None):
+    if analysis is None:
         return report
+    report["memory"]["licensed_sites"] = int(
+        getattr(analysis, "licensed_sites", 0))
+    report["memory"]["unlicensed_sites"] = int(
+        getattr(analysis, "unlicensed_sites", 0))
 
     # Per-candidate divergence: the mean of the r12 per-block
     # divergence scores over the blocks where the candidate occurs
@@ -180,7 +238,7 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
     # realize little and cost trace size.  bias == 0 (the default)
     # keeps the analyzer's exact order: planning is bit-identical.
     cand_div = _candidate_divergence(analysis)
-    ranked = list(analysis.superinstructions)
+    ranked = list(getattr(analysis, "superinstructions", None) or ())
     if div_bias > 0:
         ranked = sorted(
             ranked,
@@ -207,8 +265,6 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
                 float(c["saved_dispatches"]) / (1.0 + div_bias * dv), 4)
         cand_rows.append(row)
     report["candidates"] = cand_rows
-    if not cands:
-        return report
 
     op_id = np.asarray(img.op_id)
     names = [lop_name(int(x)) for x in op_id]
@@ -219,6 +275,20 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
     patterns: List[tuple] = []
     pat_idx = {}
     runs: List[list] = []
+
+    # -- memory-eligible runs FIRST (r19): maximal licensed stretches
+    # beat candidate n-grams to the cells so a load/store run is never
+    # fragmented by a shorter pure candidate claiming its prefix.
+    # Planning order cannot affect semantics (any planning is
+    # bit-identical by construction), only dispatch counts.
+    licensed = getattr(analysis, "licensed_pcs", None) or frozenset()
+    if mem_on and licensed:
+        _plan_memory_runs(img, analysis, licensed, mem_max_run,
+                          mem_max_pat, n_code, flen, fpat, assigned,
+                          patterns, pat_idx, report)
+
+    if not cands and not patterns:
+        return report
 
     for f in analysis.funcs:
         for b in f.cfg.blocks:
@@ -246,7 +316,11 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
                         continue
                     k = pat_idx.get(cells)
                     if k is None:
-                        if len(patterns) >= max_pat:
+                        # the pure-tier pattern cap counts pure
+                        # patterns only (memory runs have their own)
+                        n_pure = len(patterns) \
+                            - report["memory"]["mem_patterns"]
+                        if n_pure >= max_pat:
                             continue
                         k = len(patterns)
                         patterns.append(cells)
@@ -269,7 +343,11 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
         img.fuse_patterns = tuple(patterns)
     report["patterns"] = len(patterns)
     report["fused_runs"] = len(runs)
-    report["fused_cells"] = int(flen.sum())
+    # fused_runs/fused_cells stay the CANDIDATE tier's counts (the
+    # validator reconciles them against per-candidate realized_runs);
+    # the memory tier reports under report["memory"] / "mem_runs"
+    report["fused_cells"] = int(flen.sum()) \
+        - report["memory"]["mem_cells"]
     report["runs"] = runs
     # planned-vs-realized delta per candidate (the analyze report's
     # fusion section surfaces it; the census counts STATIC occurrences
@@ -278,6 +356,62 @@ def plan_fusion(img, cfg=None, analysis=None) -> dict:
         row["delta_runs"] = int(row["planned"]) - int(
             row["realized_runs"])
     return report
+
+
+def _plan_memory_runs(img, analysis, licensed, max_run, max_pat,
+                      n_code, flen, fpat, assigned, patterns, pat_idx,
+                      report):
+    """The r19 memory-eligible run class: maximal straight-line
+    stretches of (pure-eligible | licensed load/store) cells holding
+    at least one memory cell become fused runs — one dispatch retires
+    the stretch, each access compiled width-specialized without the
+    trap checks its license proved redundant.  Unlicensed sites never
+    join (they keep the per-op path and its exact trap semantics)."""
+    mem = report["memory"]
+    for f in analysis.funcs:
+        for b in f.cfg.blocks:
+            end = b.end if b.kind == "fallthrough" else b.end - 1
+            end = min(end, n_code - 1)
+            i = b.start
+            while i <= end:
+                if assigned[i] or not _mem_cell_ok(img, i, licensed):
+                    i += 1
+                    continue
+                j = i
+                while j + 1 <= end and not assigned[j + 1] \
+                        and _mem_cell_ok(img, j + 1, licensed):
+                    j += 1
+                k0 = i
+                while k0 <= j:
+                    k1 = min(k0 + max_run - 1, j)
+                    has_mem = any(int(img.cls[p]) in _MEM_CLS
+                                  for p in range(k0, k1 + 1))
+                    n = k1 - k0 + 1
+                    if n < 2 or not has_mem:
+                        k0 = k1 + 1
+                        continue
+                    cells = tuple(
+                        mem_cell_key(img, p)
+                        if int(img.cls[p]) in _MEM_CLS
+                        else (int(img.cls[p]), int(img.sub[p]))
+                        for p in range(k0, k1 + 1))
+                    k = pat_idx.get(cells)
+                    if k is None:
+                        if mem["mem_patterns"] >= max_pat:
+                            k0 = k1 + 1
+                            continue
+                        k = len(patterns)
+                        patterns.append(cells)
+                        pat_idx[cells] = k
+                        mem["mem_patterns"] += 1
+                    flen[k0] = n
+                    fpat[k0] = k
+                    assigned[k0:k1 + 1] = True
+                    report["mem_runs"].append([int(k0), n, int(k)])
+                    mem["mem_runs"] += 1
+                    mem["mem_cells"] += n
+                    k0 = k1 + 1
+                i = j + 1
 
 
 # -- the fused step handler (trace-time builder) ----------------------------
@@ -338,6 +472,8 @@ def make_fused_apply(img, lanes: int, has_simd: bool):
             return (lo, hi) if NC == 2 else (lo, hi, zl, zl)
 
         for k, pat in enumerate(patterns):
+            if pattern_has_mem(pat):
+                continue             # compiled by make_memfuse_apply
             m = is_fused & (pat_t[pc] == k)
             virt: list = []
             taken = [0]
@@ -409,3 +545,231 @@ def make_fused_apply(img, lanes: int, has_simd: bool):
         return stacks, (glob_lo, glob_hi), fused_sp
 
     return fused_apply
+
+
+def memfuse_store_slots(img) -> int:
+    """Static count of store slots across the image's MEMORY patterns
+    (one per store cell; two for 8-byte stores).  The step builder
+    sizes the fused-store channel with it: make_memfuse_apply returns
+    exactly this many (widx, value, mask) triples, and the skip branch
+    of the engine's any-lane conditional fabricates the same shape."""
+    n = 0
+    for pat in (img.fuse_patterns or ()):
+        if not pattern_has_mem(pat):
+            continue
+        for cl, key in pat:
+            if cl == CLS_STORE:
+                n += 2 if key == 8 else 1
+    return n
+
+
+def make_memfuse_apply(img, lanes: int, has_simd: bool):
+    """Build the fused MEMORY-run handler (r19) `_make_step` merges in.
+
+    Same symbolic-execution scheme as make_fused_apply, extended with
+    load/store cells whose width/flags are static per pattern slot
+    (mem_cell_key).  Because every memory cell carries an absint
+    license — the access is proven in-bounds against the module's
+    minimum memory and proven never to straddle a device word — each
+    access compiles width-specialized with no bounds mask and no trap
+    plumbing: ONE gather per load, and per store ONE (widx, value,
+    mask) triple on the fused-store channel (a word RMW for sub-word
+    stores).  The handler never carries the memory PLANE itself: the
+    plane rides its own any-lane conditional in the step (exactly the
+    per-op path's run_stores shape — a big buffer in a conditional's
+    tuple carry costs a full-plane copy every step on the CPU
+    backend), and the triples it returns are [lanes] vectors.  In-run
+    store -> load dependencies read through the pending triples
+    (memory columns are per-lane, and a lane runs at most one fused
+    pattern per step, so cross-pattern interleaving cannot exist).
+
+    Returns (stacks', globs', stores, fused_sp) with `stores` a tuple
+    of exactly memfuse_store_slots(img) triples.
+
+    jit-purity lint target (tools/lint_jit_purity.py): everything
+    nested here runs under trace.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    I32 = jnp.int32
+    lane_iota = jnp.arange(lanes, dtype=I32)
+    a_t = jnp.asarray(img.a)
+    ilo_t = jnp.asarray(img.imm_lo)
+    ihi_t = jnp.asarray(img.imm_hi)
+    pat_t = jnp.asarray(img.fuse_pat)
+    patterns = img.fuse_patterns
+    A2F = lo_ops.alu2_fns()
+    A1F = lo_ops.alu1_fns()
+    NC = 4 if has_simd else 2
+    N_SLOTS = memfuse_store_slots(img)
+
+    def gat(plane, idx):
+        idx = jnp.clip(idx, 0, plane.shape[0] - 1)
+        return jnp.take_along_axis(plane, idx[None, :], axis=0)[0]
+
+    def scat(plane, idx, vals, mask):
+        idx = jnp.clip(idx, 0, plane.shape[0] - 1)
+        cur = jnp.take_along_axis(plane, idx[None, :], axis=0)[0]
+        return plane.at[idx, lane_iota].set(jnp.where(mask, vals, cur))
+
+    def memfuse_apply(stacks, globs, mem, pc, sp, fp, is_fused):
+        """`mem` is READ-ONLY here (loads gather from it); the
+        returned store triples are applied to the plane by the step's
+        own conditional.  Lanes outside `is_fused`-masked patterns
+        pass through bit-unchanged."""
+        stacks = list(stacks)
+        glob_lo, glob_hi = globs
+        zl = jnp.zeros_like(sp)
+        fused_sp = sp
+        ng = glob_lo.shape[0]
+        stores: list = []
+
+        def cell(lo, hi):
+            return (lo, hi) if NC == 2 else (lo, hi, zl, zl)
+
+        for k, pat in enumerate(patterns):
+            if not pattern_has_mem(pat):
+                continue             # compiled by make_fused_apply
+            m = is_fused & (pat_t[pc] == k)
+            virt: list = []
+            taken = [0]
+            pending: list = []       # this pattern's (widx, word) so
+            #                          an in-run load reads its writes
+
+            def ppop(virt=virt, taken=taken):
+                if virt:
+                    return virt.pop()
+                taken[0] += 1
+                idx = sp - taken[0]
+                return tuple(gat(p, idx) for p in stacks)
+
+            def read_word(w_idx, pending=pending):
+                w = gat(mem, w_idx)
+                for pwi, pv in pending:
+                    w = jnp.where(w_idx == pwi, pv, w)
+                return w
+
+            def put_word(w_idx, val, m=m, pending=pending):
+                pending.append((w_idx, val))
+                stores.append((w_idx, val, m))
+
+            for j, (cls_j, key_j) in enumerate(pat):
+                pcj = jnp.clip(pc + j, 0, a_t.shape[0] - 1)
+                if cls_j == CLS_NOP:
+                    continue
+                if cls_j == CLS_LOAD:
+                    nbytes = key_j & 0xFF
+                    signed = (key_j >> 8) & 1
+                    is64 = (key_j >> 9) & 1
+                    av = ppop()
+                    ea = av[0] + a_t[pcj]
+                    widx = lax.shift_right_logical(ea, 2)
+                    w0 = read_word(widx)
+                    hi = zl
+                    if nbytes == 8:
+                        lo = w0
+                        hi = read_word(widx + 1)
+                    elif nbytes == 4:
+                        lo = w0
+                    else:
+                        sh = (ea & 3) * 8
+                        raw = lax.shift_right_logical(w0, sh)
+                        bits = nbytes * 8
+                        if signed:
+                            lo = lax.shift_right_arithmetic(
+                                lax.shift_left(raw, 32 - bits),
+                                32 - bits)
+                        else:
+                            lo = raw & ((1 << bits) - 1)
+                    if is64 and nbytes < 8:
+                        hi = lax.shift_right_arithmetic(lo, 31) \
+                            if signed else zl
+                    virt.append(cell(lo, hi))
+                elif cls_j == CLS_STORE:
+                    nbytes = key_j
+                    v = ppop()       # value (top)
+                    av = ppop()      # address
+                    ea = av[0] + a_t[pcj]
+                    widx = lax.shift_right_logical(ea, 2)
+                    if nbytes == 8:
+                        put_word(widx, v[0])
+                        put_word(widx + 1, v[1])
+                    elif nbytes == 4:
+                        put_word(widx, v[0])
+                    else:
+                        # sub-word store: single-word RMW (the license
+                        # proves it cannot straddle)
+                        sh = (ea & 3) * 8
+                        base = jnp.int32(0xFF if nbytes == 1
+                                         else 0xFFFF)
+                        msk = lax.shift_left(base, sh)
+                        cur = read_word(widx)
+                        nw = (cur & ~msk) | \
+                            (lax.shift_left(v[0], sh) & msk)
+                        put_word(widx, nw)
+                elif cls_j == CLS_CONST:
+                    virt.append(cell(ilo_t[pcj], ihi_t[pcj]))
+                elif cls_j == CLS_LOCAL_GET:
+                    idx = fp + a_t[pcj]
+                    virt.append(tuple(gat(p, idx) for p in stacks))
+                elif cls_j in (CLS_LOCAL_SET, CLS_LOCAL_TEE):
+                    v = ppop()
+                    if cls_j == CLS_LOCAL_TEE:
+                        virt.append(v)
+                    idx = fp + a_t[pcj]
+                    for c in range(NC):
+                        stacks[c] = scat(stacks[c], idx, v[c], m)
+                elif cls_j == CLS_GLOBAL_GET:
+                    gi = jnp.clip(a_t[pcj], 0, ng - 1)
+                    gl = jnp.take_along_axis(glob_lo, gi[None, :],
+                                             axis=0)[0]
+                    gh = jnp.take_along_axis(glob_hi, gi[None, :],
+                                             axis=0)[0]
+                    virt.append(cell(gl, gh))
+                elif cls_j == CLS_GLOBAL_SET:
+                    v = ppop()
+                    gi = jnp.clip(a_t[pcj], 0, ng - 1)
+                    cl = jnp.take_along_axis(glob_lo, gi[None, :],
+                                             axis=0)[0]
+                    ch = jnp.take_along_axis(glob_hi, gi[None, :],
+                                             axis=0)[0]
+                    glob_lo = glob_lo.at[gi, lane_iota].set(
+                        jnp.where(m, v[0], cl))
+                    glob_hi = glob_hi.at[gi, lane_iota].set(
+                        jnp.where(m, v[1], ch))
+                elif cls_j == CLS_DROP:
+                    ppop()
+                elif cls_j == CLS_SELECT:
+                    cv = ppop()   # cond (top)
+                    v2 = ppop()   # val2
+                    v1 = ppop()   # val1
+                    cz = cv[0] == 0
+                    virt.append(tuple(jnp.where(cz, b_c, a_c)
+                                      for b_c, a_c in zip(v2, v1)))
+                elif cls_j == CLS_ALU1:
+                    v = ppop()
+                    rl, rh = A1F[key_j](v[0], v[1])
+                    virt.append(cell(rl, rh))
+                elif cls_j == CLS_ALU2:
+                    y = ppop()
+                    x = ppop()
+                    rl, rh = A2F[key_j](x[0], x[1], y[0], y[1])
+                    virt.append(cell(rl, rh))
+                else:  # planner bug: surface at trace time, not as
+                    # silent misexecution
+                    raise AssertionError(
+                        f"unfusable class {cls_j} in mem pattern {k}")
+            base = sp - taken[0]
+            for i, v in enumerate(virt):
+                for c in range(NC):
+                    stacks[c] = scat(stacks[c], base + i, v[c], m)
+            fused_sp = jnp.where(m, base + len(virt), fused_sp)
+        # pad to the static slot count (patterns share one channel;
+        # the count is exact by construction — assert loudly if not)
+        assert len(stores) == N_SLOTS, (len(stores), N_SLOTS)
+        return stacks, (glob_lo, glob_hi), tuple(stores), fused_sp
+
+    return memfuse_apply

@@ -725,12 +725,15 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
 
     def _analysis_builder(_image=image, _exports=exports,
                           _init=pages_init, _max=pages_max,
-                          _has_mem=bool(memories)):
+                          _has_mem=bool(memories),
+                          _globals=[int(g.value) for g in (globals_ or ())]
+                          or None):
         from wasmedge_tpu.analysis import analyze_module
 
         return analyze_module(_image, exports=_exports,
                               mem_pages_init=_init, mem_pages_max=_max,
-                              has_memory=_has_mem)
+                              has_memory=_has_mem,
+                              globals_init=_globals)
 
     return DeviceImage(
         cls=cls, sub=sub, a=a, b=b, c=c, imm_lo=imm_lo, imm_hi=imm_hi,

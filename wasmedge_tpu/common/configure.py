@@ -134,6 +134,20 @@ class BatchConfigure:
     # ranking key becomes saved_dispatches / (1 + bias * block_score).
     # 0.0 (the default) is bit-identical to unbiased planning.
     fuse_divergence_bias: float = 0.0
+    # --- memory-run fusion (r19, batch/fuse.py + analysis/absint.py) ---
+    # Fuse straight-line runs CONTAINING loads/stores whose every
+    # access the abstract interpreter licensed (proven in-bounds
+    # against the module's minimum memory and word-aligned — the run
+    # can never trap): the fused cell does one gather/scatter per
+    # access instead of the per-op three-word RMW window, and one
+    # dispatch retires the whole run.  Unlicensed sites always stay on
+    # the per-op path; results are bit-identical either way
+    # (tests/test_memfuse.py).
+    fuse_memory_runs: bool = True
+    # Distinct fused memory-run patterns per image (on top of
+    # fuse_max_patterns for the pure tier), and the per-run cell cap.
+    memfuse_max_patterns: int = 8
+    memfuse_max_run: int = 24
     # --- divergence-aware lane compaction (batch/compact.py) ---
     # Sort/permute live lanes by (divergence-score bias, pc) at launch
     # boundaries via one jitted gather-permutation, packing live lanes

@@ -109,6 +109,14 @@ def effective_lane_bytes(engine) -> int:
     depth = getattr(analysis, "call_depth_bound", None)
     if pages is None or stack is None or depth is None:
         return _geometry_lane_bytes(engine)
+    # absint page-touch bound (r19): when the abstract interpreter
+    # proved every access site's reach, the pages a lane can DIRTY are
+    # tighter than what the module declares — the swap/budget cost a
+    # content-addressed store actually pays tracks dirtied pages, so
+    # the budget charges the proven touch, never more than declared
+    touched = getattr(analysis, "mem_pages_touch_bound", None)
+    if touched is not None:
+        pages = min(int(pages), int(touched))
     cfg = engine.cfg
     mem_b = min(int(pages), int(engine.img.mem_pages_max)) * 65536
     # per-slot cost matches the allocated plane set: lo/hi int32 pairs,

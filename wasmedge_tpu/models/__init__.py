@@ -8,9 +8,11 @@ plus small modules exercising each subsystem.
 
 from wasmedge_tpu.models.programs import (
     build_coremark_kernel,
+    build_counted_loop,
     build_fac,
     build_fib,
     build_loop_sum,
+    build_memfuse_workload,
     build_memory_workload,
 )
 
@@ -18,6 +20,8 @@ __all__ = [
     "build_fib",
     "build_fac",
     "build_loop_sum",
+    "build_counted_loop",
     "build_memory_workload",
+    "build_memfuse_workload",
     "build_coremark_kernel",
 ]

@@ -103,6 +103,86 @@ def build_memory_workload(passes: int = 1) -> bytes:
     return b.build()
 
 
+def build_counted_loop(n: int = 64) -> bytes:
+    """Latch-tested counted loop with a CONSTANT limit — the canonical
+    shape the absint trip analysis (analysis/absint.py) bounds
+    EXACTLY: body runs `n` times, cost_bound == measured retired.
+    Before r19 this verdict was "unbounded" (any loop was); the
+    admission-precision fixture for `require_bounded` policies."""
+    b = ModuleBuilder()
+    # locals: 0=arg (ignored: limits must be static), 1=i, 2=acc
+    b.add_function(["i32"], ["i32"], ["i32", "i32"], [
+        ("block", None),
+        ("loop", None),
+        ("local.get", 2), ("local.get", 1), "i32.add", ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        ("local.get", 1), ("i32.const", n), "i32.lt_u", ("br_if", 0),
+        "end", "end",
+        ("local.get", 2),
+    ], export="count")
+    return b.build()
+
+
+def build_memfuse_workload(n_words: int = 1024, passes: int = 1,
+                           byte_offset: int = 0,
+                           store_width: int = 4) -> bytes:
+    """Write-then-xor-checksum with STATIC bounds — the r19 memory-run
+    fusion workload.  Unlike build_memory_workload (whose limits are
+    params, so nothing licenses), every loop here is counted against a
+    constant, so absint proves each store/load in-bounds and aligned
+    and batch/fuse.py compiles the whole loop bodies into fused
+    gather/scatter runs.
+
+    `byte_offset`/`store_width` build the ADVERSARIAL variants: a
+    byte_offset of 2 with store_width 4 makes every access misaligned
+    (license refused -> per-op path), and an n_words pushing
+    n_words*4 + byte_offset past the 64 KiB page makes the tail access
+    OOB (license refused; the trap must land identically on the
+    per-op path whether fusion is on or off)."""
+    b = ModuleBuilder()
+    b.add_memory(1, 1)
+    store_op = {1: "i32.store8", 2: "i32.store16", 4: "i32.store"}[
+        store_width]
+    # locals: 0=arg (ignored), 1=i, 2=acc, 3=pass counter
+    b.add_function(["i32"], ["i32"], ["i32", "i32", "i32"], [
+        ("i32.const", passes), ("local.set", 3),
+        ("block", None), ("loop", None),
+        # store n_words words of i*0x9E3779B1 ^ (pass-1)
+        ("i32.const", 0), ("local.set", 1),
+        ("block", None), ("loop", None),
+        ("local.get", 1), ("i32.const", 4), "i32.mul",
+        ("i32.const", byte_offset), "i32.add",
+        ("local.get", 1), ("i32.const", 0x9E3779B1 - 2 ** 32),
+        "i32.mul",
+        ("local.get", 3), ("i32.const", 1), "i32.sub", "i32.xor",
+        (store_op, 0, 0),
+        ("local.get", 1), ("i32.const", 1), "i32.add",
+        ("local.set", 1),
+        ("local.get", 1), ("i32.const", n_words), "i32.lt_u",
+        ("br_if", 0),
+        "end", "end",
+        # xor-reduce them back
+        ("i32.const", 0), ("local.set", 1),
+        ("block", None), ("loop", None),
+        ("local.get", 2),
+        ("local.get", 1), ("i32.const", 4), "i32.mul",
+        ("i32.const", byte_offset), "i32.add",
+        ("i32.load", 2, 0),
+        "i32.xor", ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add",
+        ("local.set", 1),
+        ("local.get", 1), ("i32.const", n_words), "i32.lt_u",
+        ("br_if", 0),
+        "end", "end",
+        # next pass (counted down to zero: `ne 0` trip shape)
+        ("local.get", 3), ("i32.const", 1), "i32.sub",
+        ("local.tee", 3), ("br_if", 0),
+        "end", "end",
+        ("local.get", 2),
+    ], export="memfuse")
+    return b.build()
+
+
 def build_coremark_kernel() -> bytes:
     """CoreMark-flavored kernel: list-free core mix of matrix-multiply-ish
     integer MACs, state-machine branches, and CRC over linear memory.

@@ -325,6 +325,19 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                      {"path": "fused"}, rf)
             w.sample("wasmedge_retired_by_path_total",
                      {"path": "unfused"}, max(rt - rf, 0))
+        mfs = getattr(recorder, "memfuse_static", None)
+        if mfs:
+            w.head("wasmedge_memfuse_runs", "gauge",
+                   "Fused memory runs by license verdict: realized "
+                   "(every load/store absint-licensed trap-free) vs "
+                   "reverted load/store sites the license refused — "
+                   "those stay on the per-op path (batch/fuse.py).")
+            w.sample("wasmedge_memfuse_runs",
+                     {"verdict": "licensed"},
+                     int(mfs.get("mem_runs", 0)))
+            w.sample("wasmedge_memfuse_runs",
+                     {"verdict": "reverted_sites"},
+                     int(mfs.get("unlicensed_sites", 0)))
         if recorder.opcode_counts is not None:
             from wasmedge_tpu.validator.image import lop_name
 

@@ -141,6 +141,9 @@ class NullRecorder:
     def add_fused_counts(self, dispatches, retired_fused, retired_total):
         pass
 
+    def set_memfuse_static(self, section):
+        pass
+
     def failure(self, rec):
         pass
 
@@ -203,6 +206,10 @@ class FlightRecorder:
         # fu_ctr plane (batch/engine.py _fold_fuse_ctr)
         self.fused_counts = {"dispatches": 0, "retired_fused": 0,
                              "retired_total": 0}
+        # memory-run fusion planning statics (r19): licensed vs
+        # reverted (license-refused) load/store sites + realized runs,
+        # set once per plan by BatchEngine._plan_fusion
+        self.memfuse_static = None
 
     # The recorder is a shared sink, not configuration data: components
     # deepcopy their Configure (gas bridging, scalar reruns) and must
@@ -306,6 +313,12 @@ class FlightRecorder:
         self.fused_counts["dispatches"] += int(dispatches)
         self.fused_counts["retired_fused"] += int(retired_fused)
         self.fused_counts["retired_total"] += int(retired_total)
+
+    def set_memfuse_static(self, section):
+        """Record the memory-run fusion planning statics (the
+        plan_fusion report's "memory" section: licensed vs reverted
+        sites, realized runs/cells) for the Prometheus export."""
+        self.memfuse_static = dict(section)
 
     def add_opcode_counts(self, counts):
         """Fold a device-side opcode histogram (index = original opcode
