@@ -1467,6 +1467,216 @@ def memfuse_bench() -> int:
     return 0 if ok else 1
 
 
+def _tierup_engine(tierup: bool, lanes: int, data: bytes,
+                   chunk: int = 50_000_000, obs: bool = False,
+                   **batch):
+    """SIMT rig with the r20 compiled-function tier knob pinned
+    (fusion stays at its default on BOTH sides — the A/B isolates the
+    whole-function tier)."""
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.loader import Loader
+    from wasmedge_tpu.runtime.store import StoreManager
+    from wasmedge_tpu.validator import Validator
+
+    conf = Configure()
+    conf.batch.tierup = tierup
+    conf.batch.steps_per_launch = chunk
+    conf.batch.value_stack_depth = 64
+    conf.batch.call_stack_depth = 16
+    for k, v in batch.items():
+        setattr(conf.batch, k, v)
+    if obs:
+        conf.obs.enabled = True
+    mod = Validator(conf).validate(Loader(conf).parse_module(data))
+    store = StoreManager()
+    inst = Executor(conf).instantiate(store, mod)
+    return BatchEngine(inst, store=store, conf=conf, lanes=lanes)
+
+
+def tierup_smoke() -> int:
+    """`bench.py --tierup-smoke`: the r20 compiled-function tier CI
+    guard.  The canonical counted loop promotes (device loop under its
+    absint trip-bound license) and the driver/leaf call workload runs
+    per-call compiled dispatches — both bit-identical to the tier-off
+    build with strictly fewer dispatches.  A fuel budget below the
+    promoted fuel bound must refuse promotion and land the exhaustion
+    trap per-op, bit-identically.  Prints ONE JSON line; no artifact."""
+    from wasmedge_tpu.common.errors import ErrCode
+    from wasmedge_tpu.models import (build_call_counted_loop,
+                                     build_counted_loop)
+
+    t0 = time.perf_counter()
+    lanes = 16
+    checks = {}
+
+    def ab(data, name, chunk=256, max_steps=2_000_000, **batch):
+        out = {}
+        rep = None
+        for tierup in (True, False):
+            eng = _tierup_engine(tierup, lanes, data, chunk=chunk,
+                                 **batch)
+            out[tierup] = eng.run(name, [np.zeros(lanes, np.int64)],
+                                  max_steps=max_steps)
+            if tierup:
+                rep = eng.img.tierup_report
+        a, b = out[True], out[False]
+        ident = bool((a.results[0] == b.results[0]).all()
+                     and (a.trap == b.trap).all()
+                     and (a.retired == b.retired).all())
+        return a, b, rep, ident
+
+    # -- canonical counted loop: whole function, one dispatch --
+    a, b, rep, ident = ab(build_counted_loop(64), "count")
+    promoted = rep["promoted"]
+    checks["counted_loop_promoted"] = len(promoted) == 1 \
+        and promoted[0]["cost_bound"] == 770
+    checks["counted_loop_device_loop"] = bool(
+        promoted and promoted[0]["device_loops"] >= 1)
+    checks["counted_loop_bit_identical"] = ident and bool(
+        a.completed.all())
+    checks["counted_loop_fewer_dispatches"] = a.steps < b.steps
+    checks["counted_loop_correct"] = bool(
+        (np.asarray(a.results[0], np.int64) == 64 * 63 // 2).all())
+
+    # -- driver/leaf: one compiled dispatch per CALL --
+    a, b, rep, ident = ab(build_call_counted_loop(32, 16),
+                          "call_count")
+    checks["call_leaf_only_promoted"] = [
+        p["idx"] for p in rep["promoted"]] == [1]
+    checks["call_bit_identical"] = ident and bool(a.completed.all())
+    checks["call_fewer_dispatches"] = a.steps < b.steps
+    checks["call_correct"] = bool(
+        (np.asarray(a.results[0], np.int64)
+         == 16 * (32 * 31 // 2)).all())
+
+    # -- tight fuel: runtime gate refuses promotion, lands per-op --
+    a, b, rep, ident = ab(build_counted_loop(64), "count",
+                          fuel_per_launch=300)
+    checks["fuel_gate_trap_identical"] = ident and bool(
+        (np.asarray(a.trap) == int(ErrCode.CostLimitExceeded)).all())
+
+    dt = time.perf_counter() - t0
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "tierup_smoke_bit_identity",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "lanes": lanes,
+        "wall_s": round(dt, 3),
+    }))
+    return 0 if ok else 1
+
+
+def tierup_bench() -> int:
+    """`bench.py --tierup-bench`: obs-off A/B — the SIMT tier with the
+    r20 compiled-function tier on vs off at identical geometry (fusion
+    at its default on both sides).  Emits BENCH_r20.json; ok requires
+    tier-on strictly faster with strictly fewer dispatches,
+    bit-identical results, >= 1 counted loop promoted as a bounded
+    device loop, and the per-function-call dispatch count verified on
+    a small obs-on accounting run.  Geometry scales via
+    BENCH_TIERUP_N / BENCH_TIERUP_CALLS / BENCH_TIERUP_LANES."""
+    import os
+
+    import jax
+
+    from wasmedge_tpu.models import build_call_counted_loop
+
+    n = int(os.environ.get("BENCH_TIERUP_N", "64"))
+    calls = int(os.environ.get("BENCH_TIERUP_CALLS", "64"))
+    lanes = int(os.environ.get("BENCH_TIERUP_LANES", "1024"))
+    data = build_call_counted_loop(n, calls)
+    expect = calls * (n * (n - 1) // 2)
+    out = {
+        "metric": f"tierup_ab_call{calls}x{n}_x{lanes}",
+        "unit": "wasm_instr/s",
+        "backend": jax.default_backend(),
+        "obs": False,
+        "n": n, "calls": calls, "lanes": lanes,
+    }
+    results = {}
+    ab = {}
+    for tierup in (True, False):
+        eng = _tierup_engine(tierup, lanes, data)
+        # warmup compiles the step (single chunk covers the full run)
+        eng.run("call_count", [np.zeros(lanes, np.int64)],
+                max_steps=2_000_000_000)
+        t0 = time.perf_counter()
+        res = eng.run("call_count", [np.zeros(lanes, np.int64)],
+                      max_steps=2_000_000_000)
+        dt = time.perf_counter() - t0
+        assert res.completed.all() and (
+            np.asarray(res.results[0], np.int64) == expect).all(), \
+            "tierup wrong result"
+        retired = float(np.asarray(res.retired, np.float64).sum())
+        results[tierup] = res
+        key = "tierup" if tierup else "baseline"
+        ab[key] = {
+            "ops_per_sec": round(retired / dt, 1),
+            "wall_s": round(dt, 2),
+            "dispatches": int(res.steps),
+        }
+        if tierup:
+            rep = eng.img.tierup_report
+            out["realized"] = {
+                "promoted": [
+                    {"idx": p["idx"], "cost_bound": p["cost_bound"],
+                     "fuel_bound": p["fuel_bound"],
+                     "device_loops": p["device_loops"]}
+                    for p in rep["promoted"]],
+                "device_loops": sum(p["device_loops"]
+                                    for p in rep["promoted"]),
+            }
+    a, b = results[True], results[False]
+    ab["bit_identical"] = bool(
+        (a.results[0] == b.results[0]).all()
+        and (a.trap == b.trap).all()
+        and (a.retired == b.retired).all())
+    ab["speedup"] = round(ab["tierup"]["ops_per_sec"]
+                          / max(ab["baseline"]["ops_per_sec"], 1e-9),
+                          4)
+    ab["dispatch_reduction"] = round(
+        1.0 - ab["tierup"]["dispatches"]
+        / max(ab["baseline"]["dispatches"], 1), 4)
+    out["call_workload"] = ab
+
+    # per-function-call dispatch accounting (small obs-on run: the
+    # tu_ctr plane counts one compiled-body dispatch per lane per CALL)
+    acc_lanes = 16
+    eng = _tierup_engine(True, acc_lanes, data, obs=True)
+    res = eng.run("call_count", [np.zeros(acc_lanes, np.int64)],
+                  max_steps=2_000_000_000)
+    tu = dict(eng.obs.tierup_counts)
+    out["accounting"] = {
+        "lanes": acc_lanes,
+        "calls_per_lane": calls,
+        "compiled_dispatches": tu["dispatches"],
+        "retired_comp": tu["retired_comp"],
+        "retired_total": tu["retired_total"],
+        "dispatch_per_call": tu["dispatches"] == acc_lanes * calls,
+    }
+    out["value"] = ab["tierup"]["ops_per_sec"]
+    out["speedup"] = ab["speedup"]
+    ok = (ab["speedup"] > 1.0 and ab["bit_identical"]
+          and ab["tierup"]["dispatches"] < ab["baseline"]["dispatches"]
+          and out["realized"]["device_loops"] >= 1
+          and out["accounting"]["dispatch_per_call"]
+          and res.completed.all())
+    out["ok"] = bool(ok)
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "BENCH_r20.json")
+    print(f"# tierup speedup={ab['speedup']} dispatches "
+          f"{ab['tierup']['dispatches']} vs "
+          f"{ab['baseline']['dispatches']} promoted="
+          f"{len(out['realized']['promoted'])}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def _serve_workload(seed: int, nreq: int, short_n: int, long_n: int,
                     long_every: int):
     """Seeded mixed request stream: mostly short fib(short_n) with a
@@ -2702,6 +2912,10 @@ if __name__ == "__main__":
         sys.exit(memfuse_smoke())
     if "--memfuse-bench" in sys.argv[1:]:
         sys.exit(memfuse_bench())
+    if "--tierup-smoke" in sys.argv[1:]:
+        sys.exit(tierup_smoke())
+    if "--tierup-bench" in sys.argv[1:]:
+        sys.exit(tierup_bench())
     if "--compact-smoke" in sys.argv[1:]:
         sys.exit(compact_smoke())
     if "--compact-bench" in sys.argv[1:]:

@@ -49,6 +49,10 @@ def pytest_configure(config):
         "(translation pass, fused-dispatch bit-exactness, ladder "
         "demotion; tier-1 fast, runs under -m 'not slow')")
     config.addinivalue_line(
+        "markers", "tierup: compiled-function tier suite (whole-"
+        "function promotion, per-call dispatch, demotion ladder; "
+        "tier-1 fast, runs under -m 'not slow')")
+    config.addinivalue_line(
         "markers", "compact: divergence-aware lane-compaction suite "
         "(PC-sorted regrouping, serving/hv/checkpoint permutation "
         "remap; tier-1 fast, runs under -m 'not slow')")

@@ -139,6 +139,43 @@ def test_divergence_bias_groups_high_scores_first():
     assert perm.tolist() == [1, 3, 0, 2]
 
 
+def test_function_key_groups_lanes_per_function():
+    """r20 satellite: the engine-global function ordinal is the
+    PRIMARY live key — lanes in the same function become contiguous
+    even when a finer key (divergence, pc) would interleave them."""
+    from wasmedge_tpu.batch.compact import function_key
+
+    # two "functions": entry pcs 0 and 10; lanes alternate between them
+    pc = np.asarray([12, 1, 11, 3, 10, 2], np.int64)
+    trap = np.zeros(6, np.int64)
+    fnkey = np.asarray([0] * 10 + [1] * 10, np.int64)
+    # divergence says pc 12 is hottest — WITHOUT fnkey it would lead
+    dscore = np.zeros(20, np.int64)
+    dscore[12] = 9
+    perm = build_permutation(pc, trap, dscore=dscore, fnkey=fnkey)
+    # fn 0 lanes (pcs 1,2,3) first in pc order, then fn 1 lanes with
+    # the divergence bias ordering inside the function group
+    assert perm.tolist() == [1, 5, 3, 0, 4, 2]
+    # same geometry WITHOUT the function key: divergence leads
+    assert build_permutation(pc, trap, dscore=dscore).tolist() \
+        == [0, 1, 5, 3, 4, 2]
+
+    # function_key derives the ordinal plane from the image f_entry
+    class _Img:
+        f_entry = np.asarray([0, 10, -1], np.int64)   # one import
+        code_len = 20
+
+    fk = function_key(_Img())
+    assert fk is not None
+    assert fk.tolist() == [0] * 10 + [1] * 10
+
+    class _Broken:
+        f_entry = None
+        code_len = 20
+
+    assert function_key(_Broken()) is None   # never raises
+
+
 def test_anti_thrash_quantum():
     pc = np.asarray([3, 1, 3, 1], np.int64)
     trap = np.zeros(4, np.int64)

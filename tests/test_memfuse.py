@@ -109,6 +109,37 @@ class TestBitExact:
         assert int(run_wasm(data, "memfuse", [0])[0]) \
             == int(np.asarray(res[True].results[0])[0])
 
+    def test_v128_runs_license_and_fuse_bit_exact(self):
+        """r20 satellite: licensed v128 load/store sites join memory
+        runs as four-whole-word cells — bit-identical to the per-op
+        path with strictly fewer dispatches."""
+        from wasmedge_tpu.batch.image import CLS_VLOAD, CLS_VSTORE
+        from wasmedge_tpu.models import build_simd_memfuse_workload
+
+        data = build_simd_memfuse_workload(64, passes=2)
+        res = {}
+        steps = {}
+        for memfuse in (True, False):
+            eng = make_engine(data, make_conf(
+                memfuse, steps_per_launch=4096, tierup=False))
+            res[memfuse] = eng.run(
+                "simd_memfuse", [np.zeros(LANES, np.int64)],
+                max_steps=500_000)
+            steps[memfuse] = res[memfuse].steps
+            if memfuse:
+                mem = eng.img.fusion_report["memory"]
+                assert mem["licensed_sites"] == 2
+                assert mem["unlicensed_sites"] == 0
+                assert mem["mem_runs"] >= 2
+                vcls = {c for p in eng.img.fuse_patterns or ()
+                        for c, _ in p}
+                assert CLS_VLOAD in vcls and CLS_VSTORE in vcls
+        assert res[True].completed.all()
+        assert_results_identical(res[True], res[False])
+        assert steps[True] < steps[False]
+        assert int(run_wasm(data, "simd_memfuse", [0])[0]) \
+            == int(np.asarray(res[True].results[0])[0])
+
     def test_knob_off_plans_nothing(self):
         eng = make_engine(build_memfuse_workload(64),
                           make_conf(memfuse=False))

@@ -1,0 +1,268 @@
+"""Whole-function tier-up (batch/tierup.py) — r20.
+
+The compiled-function tier: hot, provably-safe functions (leaf, pure
+cells + licensed memory, structured control flow, finite absint cost
+bound) compile into lane-masked jitted bodies dispatched ONCE per
+function call instead of once per op — counted loops run as bounded
+device loops under their r19 trip-bound license.  Pins the r17/r19
+bar for the new tier:
+
+  - tierup on/off bit-identical (results, traps, retired) with
+    strictly fewer steps on — and the scalar engine agrees;
+  - the canonical counted loop promotes with >= 1 bounded device
+    loop, and the off-knob build plans nothing (seed path by
+    construction);
+  - per-function-call dispatch accounting: the tu_ctr counter plane
+    reaches the flight recorder and the Prometheus export, and the
+    opcode histogram still equals retired;
+  - a fuel budget below the promoted fuel bound refuses promotion at
+    runtime and lands the exhaustion trap per-op, bit-identically;
+  - the FULL demotion ladder: a compiled-tier fault walks
+    compiled-fn -> fused SIMT -> unfused SIMT -> scalar, adopting the
+    newest checkpoint at each SIMT rung, bit-identical to the
+    unfaulted run (deterministic via the testing/faults.py seams).
+
+Fast by construction (tiny lanes, small trip counts): tier-1.
+"""
+
+import numpy as np
+import pytest
+
+from wasmedge_tpu.batch.engine import BatchEngine
+from wasmedge_tpu.batch.supervisor import BatchSupervisor
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.common.errors import ErrCode
+from wasmedge_tpu.models import build_call_counted_loop, build_counted_loop
+from wasmedge_tpu.testing.faults import Fault, FaultInjector
+from tests.helpers import instantiate, run_wasm
+
+pytestmark = pytest.mark.tierup
+
+LANES = 8
+N, CALLS = 32, 48                       # driver/leaf cadence fixture
+LEAF_SUM = N * (N - 1) // 2
+
+
+def make_conf(tierup=True, sup=(), **batch):
+    conf = Configure()
+    conf.batch.tierup = tierup
+    conf.batch.steps_per_launch = 100
+    conf.batch.value_stack_depth = 64
+    conf.batch.call_stack_depth = 16
+    conf.batch.rng_seed = 7
+    for k, v in batch.items():
+        setattr(conf.batch, k, v)
+    conf.supervisor.backoff_base_s = 0.0
+    conf.supervisor.checkpoint_every_steps = 200
+    for k, v in dict(sup).items():
+        setattr(conf.supervisor, k, v)
+    return conf
+
+
+def make_engine(data, conf, lanes=LANES):
+    ex, store, inst = instantiate(data, conf)
+    return BatchEngine(inst, store=store, conf=conf, lanes=lanes)
+
+
+def assert_results_identical(a, b):
+    assert (np.asarray(a.trap) == np.asarray(b.trap)).all()
+    assert (np.asarray(a.retired) == np.asarray(b.retired)).all()
+    for ra, rb in zip(a.results, b.results):
+        assert (np.asarray(ra) == np.asarray(rb)).all()
+
+
+class TestBitExact:
+    def test_counted_loop_promotes_as_bounded_device_loop(self):
+        """The canonical absint fixture compiles whole: one dispatch
+        retires the entire function, with its counted latch licensed
+        as a bounded lax.while_loop (device_loops >= 1)."""
+        data = build_counted_loop(64)
+        args = [np.arange(LANES, dtype=np.int64)]
+        res = {}
+        for tierup in (True, False):
+            eng = make_engine(data, make_conf(tierup))
+            res[tierup] = eng.run("count", args, max_steps=100_000)
+            if tierup:
+                rep = eng.img.tierup_report
+                assert rep["promoted"], "nothing promoted"
+                p = rep["promoted"][0]
+                assert p["cost_bound"] == 770   # absint exact bound
+                assert p["fuel_bound"] >= p["cost_bound"]
+                assert p["device_loops"] >= 1   # trip-bound license
+        assert res[True].completed.all()
+        assert_results_identical(res[True], res[False])
+        assert res[True].steps < res[False].steps
+        # arg is ignored by the loop: every lane returns sum(0..63)
+        assert (np.asarray(res[True].results[0]) == 64 * 63 // 2).all()
+        assert int(run_wasm(data, "count", [0])[0]) == 64 * 63 // 2
+
+    def test_driver_leaf_calls_bit_identical_across_launches(self):
+        """Per-CALL dispatch cadence: a non-promotable driver calls the
+        promoted leaf CALLS times, spanning several launch boundaries
+        in both modes."""
+        data = build_call_counted_loop(N, CALLS)
+        args = [np.arange(LANES, dtype=np.int64)]
+        res = {}
+        for tierup in (True, False):
+            eng = make_engine(data, make_conf(tierup))
+            res[tierup] = eng.run("call_count", args,
+                                  max_steps=2_000_000)
+            if tierup:
+                rep = eng.img.tierup_report
+                # the driver has CALL ops: leaf-only verdict promotes
+                # exactly the leaf
+                assert [p["idx"] for p in rep["promoted"]] == [1]
+        assert res[True].completed.all()
+        assert_results_identical(res[True], res[False])
+        assert res[True].steps < res[False].steps
+        expect = np.arange(LANES) + CALLS * LEAF_SUM
+        assert (np.asarray(res[True].results[0]) == expect).all()
+
+    def test_knob_off_plans_nothing(self):
+        """tierup=False is the seed path by construction: no tier
+        planes exist, so the step builder compiles the identical
+        program it did before r20."""
+        eng = make_engine(build_counted_loop(64), make_conf(False))
+        res = eng.run("count", [np.zeros(LANES, np.int64)],
+                      max_steps=100_000)
+        assert res.completed.all()
+        assert getattr(eng.img, "tier_fn", None) is None
+
+
+class TestGas:
+    def test_tight_fuel_refuses_promotion_lands_per_op(self):
+        """fuel <= fuel_bound: the runtime gate keeps every lane on
+        the per-op path, so exhaustion lands at the same op with the
+        same retired count whether the tier is on or off."""
+        data = build_counted_loop(64)
+        res = {}
+        for tierup in (True, False):
+            eng = make_engine(data, make_conf(
+                tierup, fuel_per_launch=300))
+            res[tierup] = eng.run("count", [np.zeros(LANES, np.int64)],
+                                  max_steps=100_000)
+        assert (np.asarray(res[True].trap)
+                == int(ErrCode.CostLimitExceeded)).all()
+        assert_results_identical(res[True], res[False])
+
+    def test_ample_fuel_still_promotes(self):
+        data = build_counted_loop(64)
+        res = {}
+        for tierup in (True, False):
+            eng = make_engine(data, make_conf(
+                tierup, fuel_per_launch=100_000))
+            res[tierup] = eng.run("count", [np.zeros(LANES, np.int64)],
+                                  max_steps=100_000)
+        assert res[True].completed.all()
+        assert_results_identical(res[True], res[False])
+        assert res[True].steps < res[False].steps
+
+
+@pytest.mark.obs
+class TestObs:
+    def test_dispatch_per_call_counters_and_histogram(self):
+        from wasmedge_tpu.obs.metrics import render_prometheus
+
+        conf = make_conf(True)
+        conf.obs.enabled = True
+        conf.obs.opcode_histogram = True
+        eng = make_engine(build_call_counted_loop(N, CALLS), conf)
+        res = eng.run("call_count",
+                      [np.arange(LANES, dtype=np.int64)],
+                      max_steps=2_000_000)
+        assert res.completed.all()
+        retired = int(np.asarray(res.retired, np.int64).sum())
+        hist = eng.obs.opcode_counts
+        assert hist is not None and int(hist.sum()) == retired
+        tu = eng.obs.tierup_counts
+        # ONE compiled-body dispatch per function call per lane — the
+        # r20 dispatch contract
+        assert tu["dispatches"] == LANES * CALLS
+        assert 0 < tu["retired_comp"] <= tu["retired_total"]
+        assert tu["retired_total"] == retired
+        text = render_prometheus(eng.obs)
+        assert "wasmedge_tierup_dispatches_total" in text
+        assert 'wasmedge_tierup_retired_total{tier="compiled"}' in text
+        assert 'wasmedge_tierup_functions{kind="promoted"} 1' in text
+
+
+@pytest.mark.faults
+class TestLadder:
+    """compiled-fn -> fused SIMT -> unfused SIMT -> scalar."""
+
+    ARGS = [np.arange(LANES, dtype=np.int64)]
+    EXPECT = np.arange(LANES) + CALLS * LEAF_SUM
+
+    def _ref(self, tmp_path):
+        sup = BatchSupervisor(
+            make_engine(build_call_counted_loop(N, CALLS), make_conf()),
+            checkpoint_dir=str(tmp_path / "ref"))
+        res = sup.run("call_count", list(self.ARGS),
+                      max_steps=2_000_000)
+        assert res.completed.all()
+        assert (np.asarray(res.results[0]) == self.EXPECT).all()
+        return res
+
+    def test_demote_nocomp_adopts_checkpoint(self, tmp_path):
+        """One compiled-tier fault after two clean launches: the
+        simt_nocomp rung must adopt the compiled rung's checkpoint
+        (not replay from scratch) and finish bit-identical."""
+        rres = self._ref(tmp_path)
+        inj = FaultInjector([Fault(point="launch", at=2)])
+        sup = BatchSupervisor(
+            make_engine(build_call_counted_loop(N, CALLS),
+                        make_conf(sup={"max_retries": 0})),
+            faults=inj, checkpoint_dir=str(tmp_path / "sup"))
+        res = sup.run("call_count", list(self.ARGS),
+                      max_steps=2_000_000)
+        assert inj.fired == 1
+        assert res.completed.all()
+        assert_results_identical(res, rres)
+        demotes = [f for f in sup.failures if f.fault_class == "demote"]
+        assert [f.tier for f in demotes] == ["simt"]
+        # checkpoint adoption: the demoted rung resumed mid-stream
+        assert sup._restored_from is not None
+
+    def test_full_ladder_to_scalar(self, tmp_path):
+        """Three consecutive launch faults exhaust every SIMT rung in
+        order; the scalar rung finishes the batch correctly."""
+        rres = self._ref(tmp_path)
+        inj = FaultInjector([Fault(point="launch", at=2, times=3)])
+        sup = BatchSupervisor(
+            make_engine(build_call_counted_loop(N, CALLS),
+                        make_conf(sup={"max_retries": 0})),
+            faults=inj, checkpoint_dir=str(tmp_path / "sup"))
+        res = sup.run("call_count", list(self.ARGS),
+                      max_steps=2_000_000)
+        assert inj.fired == 3
+        assert res.completed.all()
+        # scalar rung reports zero retired (no device state): compare
+        # results + traps against the unfaulted reference
+        assert (np.asarray(res.trap) == np.asarray(rres.trap)).all()
+        assert (np.asarray(res.results[0]) == self.EXPECT).all()
+        demotes = [f for f in sup.failures if f.fault_class == "demote"]
+        assert [f.tier for f in demotes] == \
+            ["simt", "simt_nocomp", "simt_unfused"]
+        launches = [f for f in sup.failures
+                    if f.fault_class == "launch"]
+        assert len(launches) == 3   # max_retries=0: one per SIMT rung
+
+    def test_unpromoted_module_skips_nocomp_rung(self, tmp_path):
+        """A module that promotes nothing (recursive fib) must fall
+        straight through simt_nocomp: the rung is ineligible, not a
+        retry burner."""
+        from wasmedge_tpu.models import build_fib
+
+        inj = FaultInjector([Fault(point="launch", at=0, times=2)])
+        sup = BatchSupervisor(
+            make_engine(build_fib(), make_conf(sup={"max_retries": 0})),
+            faults=inj, checkpoint_dir=str(tmp_path))
+        res = sup.run("fib", [np.full(LANES, 9, np.int64)],
+                      max_steps=500_000)
+        assert inj.fired == 2
+        assert res.completed.all()
+        demotes = [f.tier for f in sup.failures
+                   if f.fault_class == "demote"]
+        # simt fails, nocomp skipped (nothing promoted), unfused fails,
+        # scalar finishes
+        assert demotes == ["simt", "simt_unfused"]

@@ -42,6 +42,9 @@ TARGETS = {
     # shrink this coverage
     "wasmedge_tpu/batch/fuse.py": ("make_fused_apply",
                                    "make_memfuse_apply"),
+    # whole-function tier-up (r20): the compiled-body builder the step
+    # merges in — lane-masked CFG bodies under bounded lax.while_loop
+    "wasmedge_tpu/batch/tierup.py": ("make_tierup_apply",),
     # single-program mesh drive: the sharded jit wrapper around the
     # engine's chunk body (the body itself is covered by engine.py's
     # targets; this keeps the mesh-side wrapper honest too)

@@ -200,7 +200,7 @@ class MemFact:
     align: int           # largest power of two dividing every address
     in_bounds: bool      # proven < initial pages for every execution
     aligned: bool        # proven never to straddle a device word
-    licensed: bool       # in_bounds & aligned & scalar -> fusable
+    licensed: bool       # in_bounds & aligned -> fusable (v128 too)
 
     def asdict(self) -> dict:
         return {"pc": self.pc, "kind": self.kind, "nbytes": self.nbytes,
@@ -441,7 +441,7 @@ def _transfer_block(cells: _Cells, arity, block, locals_in,
                 scan.facts.append(_mem_fact(
                     pc, "load" if scalar else "vload",
                     int(cells.b[pc]) if scalar else 16,
-                    addr, a, min_mem_bytes, scalar))
+                    addr, a, min_mem_bytes))
             push(TOP)
         elif k in (im.CLS_STORE, im.CLS_VSTORE):
             pop()                           # value
@@ -451,7 +451,7 @@ def _transfer_block(cells: _Cells, arity, block, locals_in,
                 scan.facts.append(_mem_fact(
                     pc, "store" if scalar else "vstore",
                     int(cells.b[pc]) if scalar else 16,
-                    addr, a, min_mem_bytes, scalar))
+                    addr, a, min_mem_bytes))
         elif k in (im.CLS_MEMFILL, im.CLS_MEMCOPY, im.CLS_MEMINIT):
             n, _ = pop()
             src, _ = pop()
@@ -503,14 +503,17 @@ def _transfer_block(cells: _Cells, arity, block, locals_in,
     return scan
 
 
-def _mem_fact(pc, kind, nbytes, addr, off, min_mem_bytes,
-              scalar) -> MemFact:
+def _mem_fact(pc, kind, nbytes, addr, off,
+              min_mem_bytes) -> MemFact:
     """MemFact for one access: ea = addr + static offset `off`."""
     off = int(np.uint32(np.int32(off)))     # offsets are u32 imm
     ea = v_add(addr, const_val(off)) if off <= I32_MAX else TOP
     m, r = ea[2], ea[3] % max(ea[2], 1)
     align = _pow2_gcd(m, r)                 # divides every address
-    req = min(nbytes, 4)                    # word-straddle threshold
+    # word-straddle threshold: a v128 access (nbytes=16) at 4-aligned
+    # addresses covers exactly four whole device words, so word
+    # alignment is the requirement for EVERY width above one byte
+    req = min(nbytes, 4)
     aligned = align % req == 0 if req > 1 else True
     known = ea[0] > I32_MIN or ea[1] < I32_MAX
     in_b = (known and ea[0] >= 0 and min_mem_bytes > 0
@@ -521,7 +524,7 @@ def _mem_fact(pc, kind, nbytes, addr, off, min_mem_bytes,
         hi=int(ea[1]) if known else None,
         align=int(align),
         in_bounds=bool(in_b), aligned=bool(aligned),
-        licensed=bool(scalar and in_b and aligned))
+        licensed=bool(in_b and aligned))
 
 
 def _refine(locals_vec, scan, truth) -> list:

@@ -599,9 +599,9 @@ def analyze_module(image: LoweredModule,
     all_facts = [m for f in funcs for m in f.mem_facts]
     licensed_pcs = frozenset(m["pc"] for m in all_facts
                              if m.get("licensed"))
-    scalar_sites = [m for m in all_facts if m["kind"] in ("load",
-                                                          "store")]
-    licensed_sites = sum(1 for m in scalar_sites if m["licensed"])
+    mem_sites = [m for m in all_facts
+                 if m["kind"] in ("load", "store", "vload", "vstore")]
+    licensed_sites = sum(1 for m in mem_sites if m["licensed"])
     # touch bound: every access site's end is proven finite AND no
     # hostcall can write guest memory at a guest-chosen pointer AND
     # every function's absint ran (dead-code sites carry no facts and
@@ -635,7 +635,7 @@ def analyze_module(image: LoweredModule,
         dynamic_call_sites=total_dyn,
         mem_pages_touch_bound=touch,
         licensed_sites=licensed_sites,
-        unlicensed_sites=len(scalar_sites) - licensed_sites,
+        unlicensed_sites=len(mem_sites) - licensed_sites,
         licensed_pcs=licensed_pcs,
     )
 

@@ -121,6 +121,29 @@ def divergence_key(img) -> Optional[np.ndarray]:
         return None
 
 
+def function_key(img) -> Optional[np.ndarray]:
+    """Per-pc ENGINE-GLOBAL function ordinal from the image's entry-pc
+    plane, the r20 coarse grouping key: on a multi-tenant concatenated
+    image f_entry is already rebased per tenant, so sorting by it first
+    regroups serving mixes per function (and per tenant) before the
+    finer (divergence, pc) keys order lanes inside one body.  Needs no
+    analysis — unlike divergence_key it works on concatenated images.
+    Never raises: compaction is a performance pass, not a correctness
+    gate."""
+    try:
+        entries = np.asarray(getattr(img, "f_entry"), np.int64)
+        entries = np.sort(entries[entries >= 0])
+        if entries.size == 0:
+            return None
+        pcs = np.arange(int(img.code_len), dtype=np.int64)
+        return np.searchsorted(entries, pcs, side="right").astype(
+            np.int64) - 1
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return None
+
+
 def estimate_breaks(pc: np.ndarray, live: np.ndarray,
                     shard_slices: Optional[List[slice]] = None):
     """(breaks, ideal_breaks, unique_pcs, largest_group_fraction) of
@@ -151,15 +174,19 @@ def estimate_breaks(pc: np.ndarray, live: np.ndarray,
 
 def build_permutation(pc: np.ndarray, trap: np.ndarray,
                       dscore: Optional[np.ndarray] = None,
-                      shard_slices: Optional[List[slice]] = None
+                      shard_slices: Optional[List[slice]] = None,
+                      fnkey: Optional[np.ndarray] = None
                       ) -> np.ndarray:
     """The boundary permutation as `perm` (destination -> source lane):
     new_plane[..., d] = old_plane[..., perm[d]].  Within each shard
     slice (the whole array when None — no cross-device moves on a
-    mesh), live lanes sort to the front keyed by (descending
-    divergence score, pc, original position) and dead lanes keep their
-    relative order at the tail.  A bijection by construction; stable,
-    so an already-grouped population is a no-op."""
+    mesh), live lanes sort to the front keyed by (function ordinal,
+    descending divergence score, pc, original position) and dead lanes
+    keep their relative order at the tail.  A bijection by
+    construction; stable, so an already-grouped population is a
+    no-op.  `fnkey` (function_key) is the r20 coarse group: lanes
+    executing the same function become contiguous before the finer
+    keys order them within it."""
     pc = np.asarray(pc, np.int64)
     live = live_mask(trap)
     n = pc.size
@@ -168,14 +195,22 @@ def build_permutation(pc: np.ndarray, trap: np.ndarray,
                                                      dscore.size - 1)]
     else:
         score = np.zeros(n, np.int64)
+    if fnkey is not None and fnkey.size:
+        fk = np.asarray(fnkey, np.int64)[np.clip(pc, 0,
+                                                 fnkey.size - 1)]
+    else:
+        fk = np.zeros(n, np.int64)
     dead = (~live).astype(np.int64)
     pckey = np.where(live, pc, np.int64(0))
     skey = np.where(live, -score, np.int64(0))
+    fkey = np.where(live, fk, np.int64(0))
     pos = np.arange(n, dtype=np.int64)
     perm = np.empty(n, np.int64)
     for sl in (shard_slices or [slice(0, n)]):
-        # np.lexsort: LAST key is primary -> (dead, -score, pc, pos)
-        order = np.lexsort((pos[sl], pckey[sl], skey[sl], dead[sl]))
+        # np.lexsort: LAST key is primary ->
+        # (dead, fn, -score, pc, pos)
+        order = np.lexsort((pos[sl], pckey[sl], skey[sl], fkey[sl],
+                            dead[sl]))
         perm[sl] = order + sl.start
     return perm
 
@@ -283,6 +318,8 @@ class LaneCompactor:
         self.last_fire = -(1 << 30)
         self._dscore = None
         self._dscore_ready = False
+        self._fnkey = None
+        self._fnkey_ready = False
         self._permute = None
         self._chunks = {}
         self._shards = self._shard_slices()
@@ -304,6 +341,12 @@ class LaneCompactor:
             self._dscore = divergence_key(img)
             self._dscore_ready = True
         return self._dscore
+
+    def fnkey(self, img) -> Optional[np.ndarray]:
+        if not self._fnkey_ready:
+            self._fnkey = function_key(img)
+            self._fnkey_ready = True
+        return self._fnkey
 
     # -- permutation bookkeeping -------------------------------------------
     @property
@@ -355,7 +398,8 @@ class LaneCompactor:
         if not d.fire:
             return None
         perm = build_permutation(pc, trap, self.dscore(engine.img),
-                                 self._shards)
+                                 self._shards,
+                                 fnkey=self.fnkey(engine.img))
         if (perm == np.arange(perm.size)).all():
             self.fired(d, moved=False)
             return None

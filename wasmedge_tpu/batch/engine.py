@@ -146,6 +146,11 @@ class BatchState(NamedTuple):
     # compiled fused cells (obs_state_planes), folded into the flight
     # recorder on sync.
     fu_ctr: object = None
+    # r20 tier-up counters [3] int32: compiled function-call dispatches
+    # / instructions retired through compiled bodies / total retired
+    # (liveness row: never an identity passthrough in the donated carry
+    # when a promoted-plane state resumes on a tierup-off build).
+    tu_ctr: object = None
 
 
 @dataclasses.dataclass
@@ -220,6 +225,10 @@ def obs_state_planes(conf, img: DeviceImage, mesh=None) -> dict:
 
     if fusion_active(img, conf.batch):
         out["fu_ctr"] = jnp.zeros((3,), jnp.int32)
+    from wasmedge_tpu.batch.tierup import tierup_active
+
+    if tierup_active(img, conf.batch):
+        out["tu_ctr"] = jnp.zeros((3,), jnp.int32)
     return out
 
 
@@ -398,8 +407,10 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
     if weighted_gas:
         _ct = np.clip(np.asarray(cfg.cost_table, np.int64),
                       0, 1 << 30).astype(np.int32)
-        cost_t = jnp.asarray(
-            _ct[np.clip(img.op_id, 0, len(_ct) - 1)])
+        _cost_np = _ct[np.clip(img.op_id, 0, len(_ct) - 1)]
+        cost_t = jnp.asarray(_cost_np)
+    else:
+        _cost_np = None
     HAS_SIMD = bool(getattr(img, "has_simd", False))
     if HAS_SIMD:
         from wasmedge_tpu.batch import simdops as sops
@@ -535,12 +546,25 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
             # heads of patterns that STORE (the fused-store channel's
             # any-lane gate; load-only runs never touch the plane)
             _pat_st = np.array(
-                [any(cl == CLS_STORE for cl, _ in p) for p in _pats],
+                [any(cl in (CLS_STORE, CLS_VSTORE) for cl, _ in p)
+                 for p in _pats],
                 bool)
             _sthead = np.zeros(_fpat_np.shape[0], bool)
             _sthead[_valid] = _pat_st[_fpat_np[_valid]]
             _sthead &= _memhead
             sthead_t = jnp.asarray(_sthead)
+
+    # ---- whole-function tier-up statics (batch/tierup.py) ----
+    # TIER_ON is trace-time static like FUSE_ON: knob off (or nothing
+    # promoted) compiles the exact seed/fused step by construction.
+    from wasmedge_tpu.batch.tierup import make_tierup_apply, tierup_active
+
+    TIER_ON = tierup_active(img, cfg)
+    if TIER_ON:
+        tier_fn_t = jnp.asarray(img.tier_fn)
+        if fuel_enabled:
+            tier_fuel_t = jnp.asarray(img.tier_fuel_bound)
+        tierup_apply = make_tierup_apply(img, lanes, HAS_SIMD, _cost_np)
 
     def step(st: BatchState, t0_time=None) -> BatchState:
         """One lockstep instruction (or one fused dispatch cell — a
@@ -551,9 +575,24 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
         leaf — see t0_state_planes)."""
         alive = st.trap == 0
         pc = jnp.clip(st.pc, 0, img.code_len - 1)
+        if TIER_ON:
+            # lanes parked at a promoted function's ENTRY pc run the
+            # compiled CFG body this step (one dispatch per call); they
+            # leave both the per-op and fused paths.  The fuel pre-gate
+            # mirrors the fused one: a lane without fuel for the
+            # worst-case whole call steps per-op instead, so gas
+            # exhaustion lands at the correct op bit-identically.
+            is_comp = tier_fn_t[pc] >= 0
+            if fuel_enabled:
+                is_comp = is_comp & (st.fuel > tier_fuel_t[pc])
+            is_comp = alive & is_comp
+        else:
+            is_comp = jnp.bool_(False) & alive
         if FUSE_ON:
             f_n = flen_t[pc]
             is_fused = alive & (f_n >= 2)
+            if TIER_ON:
+                is_fused = is_fused & ~is_comp
             if fuel_enabled:
                 # a lane without the fuel to retire the WHOLE run steps
                 # through the original per-op cells instead, so gas
@@ -581,6 +620,8 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
             is_fused = jnp.bool_(False) & alive
             is_fused_mem = is_fused_pure = is_fused
             active = alive
+        if TIER_ON:
+            active = active & ~is_comp
         cls = cls_t[pc]
         sub = sub_t[pc]
         a = a_t[pc]
@@ -1674,6 +1715,41 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
             if HAS_SIMD:
                 stack_e2, stack_e3 = _stk[2], _stk[3]
 
+        # =================== compiled-function bodies ===================
+        # one dispatch retires a whole promoted CALL (batch/tierup.py);
+        # compiled-lane masks are disjoint from every per-op and fused
+        # mask above (active/is_fused exclude them), so applying the
+        # body's scatters after theirs is exact.  Any-lane conditional:
+        # steps where no lane sits at a promoted entry skip the bodies
+        # entirely.  The memory plane is READ-ONLY inside (v1 promotes
+        # load-only functions) and the opcode histogram rides the
+        # conditional so in-body retirement attributes per-pc
+        # (histogram == retired, as with fused runs).
+        if TIER_ON:
+            _cstk = tuple([stack_lo, stack_hi] + (
+                [stack_e2, stack_e3] if HAS_SIMD else []))
+            _c_hist0 = st.op_hist
+
+            def _run_comp(ops):
+                stk, oh = ops
+                stk2, oh2, csp, cret, cbail, cbpc, crd, cfd = \
+                    tierup_apply(list(stk), mem_plane, oh, pc, sp, fp,
+                                 opbase, is_comp)
+                return tuple(stk2), oh2, csp, cret, cbail, cbpc, crd, cfd
+
+            def _skip_comp(ops):
+                stk, oh = ops
+                fl = jnp.bool_(False) & alive
+                return stk, oh, sp, fl, fl, pc, zl, zl
+
+            (_cstk, _c_hist, comp_sp, comp_ret, comp_bail, comp_bail_pc,
+             comp_rd, comp_fd) = lax.cond(
+                jnp.any(is_comp), _run_comp, _skip_comp,
+                (_cstk, _c_hist0))
+            stack_lo, stack_hi = _cstk[0], _cstk[1]
+            if HAS_SIMD:
+                stack_e2, stack_e3 = _cstk[2], _cstk[3]
+
         # =================== merge: sp / pc / frames ===================
         new_sp = sp
         for m, v in (
@@ -1738,10 +1814,15 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
         if FUSE_ON:
             # a fused dispatch retires the whole run; each constituent
             # keeps per-op attribution (f_n ops of gas/histogram)
-            new_retired = st.retired + jnp.where(
+            ret_inc = jnp.where(
                 alive, jnp.where(is_fused, f_n, jnp.int32(1)), jnp.int32(0))
         else:
-            new_retired = st.retired + b2i(active)
+            ret_inc = b2i(active)
+        if TIER_ON:
+            # a compiled dispatch retires the whole CALL; the body
+            # reports the exact per-lane count (bail-outs included)
+            ret_inc = jnp.where(is_comp, comp_rd, ret_inc)
+        new_retired = st.retired + ret_inc
         if fuel_enabled:
             dec = jnp.where(active, cost_t[pc], 0) if weighted_gas \
                 else b2i(active)
@@ -1749,6 +1830,10 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
                 # fused lanes are pre-gated on fuel > run cost, so the
                 # exhaustion check below (active-only) stays exact
                 dec = dec + jnp.where(is_fused, fuse_cost, 0)
+            if TIER_ON:
+                # compiled lanes: exact per-op gas from the body, also
+                # pre-gated (fuel > whole-call worst case)
+                dec = dec + jnp.where(is_comp, comp_fd, 0)
             new_fuel = st.fuel - dec
             new_trap = jnp.where(active & (new_fuel <= 0) & (new_trap == 0),
                                  int(ErrCode.CostLimitExceeded), new_trap)
@@ -1768,45 +1853,83 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
             # fused classes are pure stack/ALU)
             pc_out = jnp.where(is_fused, pc + f_n, pc_out)
             sp_out = jnp.where(is_fused, fused_sp, sp_out)
+        fp_out = jnp.where(keep, new_fp, st.fp)
+        opbase_out = jnp.where(keep, new_opbase, st.opbase)
+        depth_out = jnp.where(keep, new_depth, st.call_depth)
+        if TIER_ON:
+            # compiled lanes come back RETURNED (the whole call retired:
+            # replicate the per-op CLS_RETURN merge — the body never
+            # pushed frames, so r_pc/r_fp/r_opbase gathered from the
+            # pre-step frame stack are exactly the right pop) or BAILED
+            # at a block head (iteration cap: resume per-op mid-function
+            # with the body's partial sp/retired/fuel, bit-identically)
+            comp_done = comp_ret & (st.call_depth == 0)
+            comp_pop = comp_ret & (st.call_depth > 0)
+            pc_out = jnp.where(comp_pop, r_pc, pc_out)
+            pc_out = jnp.where(comp_bail, comp_bail_pc, pc_out)
+            # comp_done lanes keep their pre-step pc (the halted shape:
+            # pc_out already defaults to st.pc for non-active lanes)
+            sp_out = jnp.where(is_comp, comp_sp, sp_out)
+            fp_out = jnp.where(comp_pop, r_fp, fp_out)
+            opbase_out = jnp.where(comp_pop, r_opbase, opbase_out)
+            depth_out = jnp.where(comp_pop, st.call_depth - 1, depth_out)
+            new_trap = jnp.where(comp_done, jnp.int32(TRAP_DONE),
+                                 new_trap)
 
         # device-side obs planes: per-pc retired histogram (attributed
         # to every CONSTITUENT op of a fused run — histogram == retired
         # by construction) and the fused/unfused dispatch counters.
         # Both are trace-time static: None planes compile to nothing.
-        op_hist_p = st.op_hist
+        op_hist_p = _c_hist if (TIER_ON and st.op_hist is not None) \
+            else st.op_hist
         if st.op_hist is not None:
             H = st.op_hist.shape[0]
             if FUSE_ON:
                 hln = jnp.where(is_fused, f_n, jnp.int32(1))
+                if TIER_ON:
+                    # compiled lanes attributed in-body (per block
+                    # execution count -> per constituent pc)
+                    hln = jnp.where(is_comp, jnp.int32(0), hln)
                 for j in range(MAX_F):
                     op_hist_p = op_hist_p.at[
                         jnp.clip(pc + j, 0, H - 1)].add(
                         b2i(alive & (j < hln)))
             else:
+                hm = (alive & ~is_comp) if TIER_ON else alive
                 op_hist_p = op_hist_p.at[jnp.clip(pc, 0, H - 1)].add(
-                    b2i(alive))
+                    b2i(hm))
         fu_ctr_p = st.fu_ctr
         if st.fu_ctr is not None:
             if FUSE_ON:
                 fu_ctr_p = st.fu_ctr + jnp.stack([
                     jnp.sum(b2i(is_fused)),
                     jnp.sum(jnp.where(is_fused, f_n, 0)),
-                    jnp.sum(jnp.where(alive,
-                                      jnp.where(is_fused, f_n,
-                                                jnp.int32(1)), 0))])
+                    jnp.sum(ret_inc)])
             else:
                 # a fused-plane state resumed on an unfused build (the
                 # supervisor's demotion rung) keeps the total-retired
                 # row live so the plane is never an identity
                 # passthrough in the donated carry
                 fu_ctr_p = st.fu_ctr + jnp.stack([
-                    jnp.int32(0), jnp.int32(0), jnp.sum(b2i(active))])
+                    jnp.int32(0), jnp.int32(0), jnp.sum(ret_inc)])
+        tu_ctr_p = st.tu_ctr
+        if st.tu_ctr is not None:
+            if TIER_ON:
+                tu_ctr_p = st.tu_ctr + jnp.stack([
+                    jnp.sum(b2i(is_comp)),
+                    jnp.sum(jnp.where(is_comp, comp_rd, 0)),
+                    jnp.sum(ret_inc)])
+            else:
+                # same liveness discipline as fu_ctr for states resumed
+                # on a tierup-off build (the simt_nocomp demotion rung)
+                tu_ctr_p = st.tu_ctr + jnp.stack([
+                    jnp.int32(0), jnp.int32(0), jnp.sum(ret_inc)])
         return BatchState(
             pc=pc_out,
             sp=sp_out,
-            fp=jnp.where(keep, new_fp, st.fp),
-            opbase=jnp.where(keep, new_opbase, st.opbase),
-            call_depth=jnp.where(keep, new_depth, st.call_depth),
+            fp=fp_out,
+            opbase=opbase_out,
+            call_depth=depth_out,
             trap=new_trap,
             retired=new_retired,
             fuel=new_fuel,
@@ -1832,6 +1955,7 @@ def _make_step(img: DeviceImage, cfg: BatchConfigure, lanes: int,
             so_off=so_off_p,
             op_hist=op_hist_p,
             fu_ctr=fu_ctr_p,
+            tu_ctr=tu_ctr_p,
         )
 
     return step
@@ -1953,6 +2077,25 @@ class BatchEngine:
         if mem and self.obs.enabled:
             self.obs.set_memfuse_static(mem)
 
+    def _plan_tierup(self):
+        """Run the whole-function promotion pass once per image
+        (batch/tierup.py), AFTER _plan_fusion — hot-function selection
+        reads the realized fusion plan.  Same lazy/idempotent
+        discipline as _plan_fusion (tierup_report sentinel); knob off =
+        never planned = the step builder compiles the bit-identical
+        seed/fused path."""
+        if not getattr(self.cfg, "tierup", True):
+            return
+        if getattr(self.img, "tierup_report", None) is not None:
+            return  # already planned (shared image)
+        self._plan_fusion()
+        from wasmedge_tpu.batch.tierup import plan_tierup
+
+        plan_tierup(self.img, self.cfg)
+        rep = self.img.tierup_report or {}
+        if rep and self.obs.enabled:
+            self.obs.set_tierup_static(rep)
+
     def _t0_gate(self, kinds):
         """Engine-level tier-0 gating: fd_write buffering additionally
         requires that the instance's WASI environ has fds 1/2 as plain
@@ -2040,6 +2183,7 @@ class BatchEngine:
         from wasmedge_tpu.batch import ensure_jax_backend
 
         self._plan_fusion()
+        self._plan_tierup()
         ensure_jax_backend()
         import jax
         import jax.numpy as jnp
@@ -2161,10 +2305,12 @@ class BatchEngine:
 
         obs_conf = getattr(self.conf, "obs", None)
         if obs_conf is not None and obs_conf.enabled:
-            # the fu_ctr allocation decision (obs_state_planes) needs
-            # the translation pass to have run; obs-off states defer it
-            # to _build() with the rest of the step compile
+            # the fu_ctr/tu_ctr allocation decisions (obs_state_planes)
+            # need the translation/promotion passes to have run; obs-off
+            # states defer them to _build() with the rest of the step
+            # compile
             self._plan_fusion()
+            self._plan_tierup()
         cfg = self.cfg
         L = self.lanes
         img = self.img
@@ -2365,6 +2511,7 @@ class BatchEngine:
         state = flush_stdout_buffers(self, state)
         state = self._fold_op_hist(state)
         state = self._fold_fuse_ctr(state)
+        state = self._fold_tierup_ctr(state)
         if t0_active:
             ctr = np.asarray(state.t0_ctr, np.int64).sum(axis=1) - ctr_in
             st_ = self.hostcall_stats
@@ -2409,4 +2556,20 @@ class BatchEngine:
             self.obs.add_fused_counts(int(ctr[0]), int(ctr[1]),
                                       int(ctr[2]))
             state = state._replace(fu_ctr=jnp.zeros_like(state.fu_ctr))
+        return state
+
+    def _fold_tierup_ctr(self, state):
+        """Fold + reset the tier-up counter plane ([compiled-body
+        dispatches, retired-through-compiled-bodies, total retired])
+        into the flight recorder; the Prometheus export renders the
+        compiled/interpreted retired split from it (obs/metrics.py)."""
+        if getattr(state, "tu_ctr", None) is None:
+            return state
+        import jax.numpy as jnp
+
+        ctr = np.asarray(state.tu_ctr, np.int64)
+        if ctr.any():
+            self.obs.add_tierup_counts(int(ctr[0]), int(ctr[1]),
+                                       int(ctr[2]))
+            state = state._replace(tu_ctr=jnp.zeros_like(state.tu_ctr))
         return state

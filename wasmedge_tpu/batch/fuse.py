@@ -58,6 +58,8 @@ from wasmedge_tpu.batch.image import (
     CLS_NOP,
     CLS_SELECT,
     CLS_STORE,
+    CLS_VLOAD,
+    CLS_VSTORE,
     _F64_BIN,
     _I32_BIN,
 )
@@ -112,11 +114,13 @@ def cell_eligible(cls: int, sub: int) -> bool:
 # for memory ops encode the STATIC width/flags instead of `sub` (the
 # sub plane is 0 for loads/stores; width lives in the b/c planes):
 #
-#   (CLS_LOAD,  nbytes | signed << 8 | is64 << 9)
-#   (CLS_STORE, nbytes)
+#   (CLS_LOAD,   nbytes | signed << 8 | is64 << 9)
+#   (CLS_STORE,  nbytes)
+#   (CLS_VLOAD,  16)    (v128: license requires word alignment, so the
+#   (CLS_VSTORE, 16)     access is exactly four whole device words)
 #
 # so each pattern handler compiles a width-specialized access.
-_MEM_CLS = (CLS_LOAD, CLS_STORE)
+_MEM_CLS = (CLS_LOAD, CLS_STORE, CLS_VLOAD, CLS_VSTORE)
 
 
 def mem_cell_key(img, pc: int):
@@ -124,6 +128,8 @@ def mem_cell_key(img, pc: int):
     cls = int(img.cls[pc])
     if cls == CLS_LOAD:
         return (CLS_LOAD, int(img.b[pc]) | (int(img.c[pc]) << 8))
+    if cls in (CLS_VLOAD, CLS_VSTORE):
+        return (cls, 16)
     return (CLS_STORE, int(img.b[pc]))
 
 
@@ -560,6 +566,8 @@ def memfuse_store_slots(img) -> int:
         for cl, key in pat:
             if cl == CLS_STORE:
                 n += 2 if key == 8 else 1
+            elif cl == CLS_VSTORE:
+                n += 4
     return n
 
 
@@ -688,6 +696,23 @@ def make_memfuse_apply(img, lanes: int, has_simd: bool):
                         hi = lax.shift_right_arithmetic(lo, 31) \
                             if signed else zl
                     virt.append(cell(lo, hi))
+                elif cls_j == CLS_VLOAD:
+                    # licensed v128: word-aligned by proof, exactly
+                    # four whole device words (and has_simd => NC == 4)
+                    assert NC == 4, "v128 cell in a 2-comp image"
+                    av = ppop()
+                    ea = av[0] + a_t[pcj]
+                    widx = lax.shift_right_logical(ea, 2)
+                    virt.append(tuple(read_word(widx + kk)
+                                      for kk in range(4)))
+                elif cls_j == CLS_VSTORE:
+                    assert NC == 4, "v128 cell in a 2-comp image"
+                    v = ppop()       # value (top)
+                    av = ppop()      # address
+                    ea = av[0] + a_t[pcj]
+                    widx = lax.shift_right_logical(ea, 2)
+                    for kk in range(4):
+                        put_word(widx + kk, v[kk])
                 elif cls_j == CLS_STORE:
                     nbytes = key_j
                     v = ppop()       # value (top)

@@ -101,6 +101,16 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
     merged_patterns: list = []
     pat_map: dict = {}
     any_fuse = False
+    # whole-function promotion planes (batch/tierup.py): entry pcs,
+    # block lists and branch targets all rebase by the plane offset,
+    # slots by the running promoted count — the compiled bodies read
+    # the CONCATENATED planes at the rebased static pcs, which match
+    # the tenant planes verbatim (cls/sub/b/c/imms copy; `a` rebases
+    # identically for branches on both sides)
+    tfn_parts, tfb_parts = [], []
+    merged_tier_fns: list = []
+    tier_slot_b = 0
+    any_tier = False
     bases = []
     pc_b = fn_b = gl_b = ty_b = brt_b = tbl_b = 0
     eseg_b = eflat_b = dseg_b = dbyte_b = 0
@@ -112,6 +122,9 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         plan = getattr(t.engine, "_plan_fusion", None)
         if plan is not None:
             plan()
+        plan_t = getattr(t.engine, "_plan_tierup", None)
+        if plan_t is not None:
+            plan_t()
         base = dict(pc=pc_b, func=fn_b, glob=gl_b, type=ty_b, brt=brt_b,
                     table=tbl_b)
         bases.append(base)
@@ -201,6 +214,29 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
                     flen2[p] = 0  # beyond the merged cap: stay per-op
             flen_parts.append(flen2)
             fpat_parts.append(fpat2)
+        t_tfn = getattr(img, "tier_fn", None)
+        if t_tfn is None:
+            tfn_parts.append(np.full(img.code_len, -1, np.int32))
+            tfb_parts.append(np.zeros(img.code_len, np.int32))
+        else:
+            any_tier = True
+            tfn2 = np.asarray(t_tfn, np.int32).copy()
+            tfn2[tfn2 >= 0] += tier_slot_b
+            tfn_parts.append(tfn2)
+            tfb_parts.append(np.asarray(img.tier_fuel_bound, np.int32))
+            for p in img.tier_fns:
+                merged_tier_fns.append(dict(
+                    p,
+                    slot=p["slot"] + tier_slot_b,
+                    entry_pc=p["entry_pc"] + pc_b,
+                    end_pc=p["end_pc"] + pc_b,
+                    blocks=[dict(bk, start=bk["start"] + pc_b,
+                                 end=bk["end"] + pc_b,
+                                 succ=tuple(s + pc_b
+                                            for s in bk["succ"]))
+                            for bk in p["blocks"]],
+                ))
+            tier_slot_b += len(img.tier_fns)
         f_parts["f_entry"].append(img.f_entry + pc_b)
         f_parts["f_nparams"].append(img.f_nparams)
         f_parts["f_nlocals"].append(img.f_nlocals)
@@ -280,6 +316,22 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
             "candidates": [], "runs": [],
         },
     )
+    # whole-function promotion planes ride as plain attributes, like
+    # plan_tierup binds them (batch/tierup.py); the report doubles as
+    # the planned-sentinel so the merged engine's _plan_tierup never
+    # re-plans (the concat image has no ModuleAnalysis to plan from)
+    image.tier_fn = np.concatenate(tfn_parts) if any_tier else None
+    image.tier_fuel_bound = (np.concatenate(tfb_parts) if any_tier
+                             else None)
+    image.tier_fns = tuple(merged_tier_fns)
+    image.tierup_report = {
+        "enabled": any_tier,
+        "promoted": [{k: p[k] for k in ("slot", "idx", "name",
+                                        "entry_pc", "cost_bound",
+                                        "fuel_bound", "device_loops")}
+                     for p in merged_tier_fns],
+        "candidates": [],
+    }
     return image, bases
 
 

@@ -224,6 +224,15 @@ class BatchSupervisor:
                 and not self._resumed:
             tiers.append("pallas")
         tiers.append("simt")
+        # a compiled-function-tier fault demotes to the plain fused
+        # SIMT build first (tierup off, fusion kept): same image, same
+        # lane geometry — the tu_ctr counter plane stays live on the
+        # demoted build, so checkpoints transfer untouched and only
+        # the compiled step program changes (batch/tierup.py).  Knob
+        # gate only, like simt_unfused below: whether functions were
+        # actually promoted is decided at demotion time.
+        if getattr(self.engine.cfg, "tierup", True):
+            tiers.append("simt_nocomp")
         # a fused-step fault demotes to the UNFUSED SIMT build before
         # the scalar rung: same image, same state geometry (fusion adds
         # no lane planes), checkpoints transfer untouched — only the
@@ -248,7 +257,18 @@ class BatchSupervisor:
                         ran = False  # ineligible: no residency to record
                         continue
                     return res
-                if tier in ("simt", "simt_unfused"):
+                if tier in ("simt", "simt_nocomp", "simt_unfused"):
+                    if tier == "simt_nocomp":
+                        from wasmedge_tpu.batch.tierup import tierup_active
+
+                        if not tierup_active(self.engine.img,
+                                             self.engine.cfg):
+                            # the SIMT rung promoted nothing (or never
+                            # planned): no compiled bodies to shed,
+                            # fall through to the un-fuse rung
+                            ran = False
+                            continue
+                        self._demote_nocomp()
                     if tier == "simt_unfused":
                         from wasmedge_tpu.batch.fuse import fusion_active
 
@@ -289,6 +309,34 @@ class BatchSupervisor:
             self.failures)
 
     # -- ladder tiers -----------------------------------------------------
+    def _demote_nocomp(self):
+        """Swap the supervised engine for a shallow clone whose step
+        builder keeps fusion but compiles no whole-function bodies
+        (tierup knob off).  The clone shares image, instance, stats,
+        and recorder; the compiled tier adds only the laneless tu_ctr
+        counter plane, which the tierup-off step keeps live, so the
+        compiled rung's checkpoints restore onto it bit-exactly (the
+        image fingerprint ignores the tier_fn promotion plane).  The
+        newest surviving lineage member is adopted so this rung
+        continues from the compiled rung's progress."""
+        import copy
+        import dataclasses as _dc
+
+        eng = copy.copy(self.engine)
+        eng.cfg = _dc.replace(eng.cfg, tierup=False)
+        # keep conf.batch consistent with cfg (see _demote_unfused)
+        eng.conf = copy.copy(eng.conf)
+        eng.conf.batch = eng.cfg
+        eng._step = None
+        eng._run_chunk = None
+        self.engine = eng
+        self._replay_tier = True
+        got = self._lineage.walk_newest(self._load_member,
+                                        self._bad_member)
+        if got is not None:
+            self._adopted = got
+            self._resumed = True
+
     def _demote_unfused(self):
         """Swap the supervised engine for a shallow clone whose step
         builder compiles the seed per-op path (fuse knob off).  The
@@ -302,7 +350,11 @@ class BatchSupervisor:
         import dataclasses as _dc
 
         eng = copy.copy(self.engine)
-        eng.cfg = _dc.replace(eng.cfg, fuse_superinstructions=False)
+        # tierup is pinned off too: reaching this rung means the
+        # compiled tier either already demoted (simt_nocomp) or was
+        # never eligible, and the un-fused build must not resurrect it
+        eng.cfg = _dc.replace(eng.cfg, fuse_superinstructions=False,
+                              tierup=False)
         # keep conf.batch consistent with cfg: the obs plane allocator
         # (obs_state_planes reads conf.batch) must agree with the step
         # builder that this rung compiles nothing fused — fusion_active

@@ -1,0 +1,589 @@
+"""Whole-function tier-up compilation (r20).
+
+The r17/r19 superinstruction tiers shortened straight-line runs, but
+every basic block still returns to the any-lane dispatch switch: a
+counted loop of 8 ops pays one dispatch per op per iteration.  This
+module promotes the hottest COMPILABLE whole functions out of the
+dispatch loop entirely — the tiering argument of "A fast in-place
+interpreter for WebAssembly" applied to the lockstep batch engine —
+while keeping the promoted bodies lane-masked so divergent cohorts
+stay correct ("Control Flow Management in Modern GPUs").
+
+Three pieces, mirroring batch/fuse.py's planner/builder split:
+
+  plan_tierup(img, cfg)   -- pure numpy/python planning pass: select
+                             hot candidates (realized fusion weight +
+                             analyzer cost bounds), apply the
+                             compilability verdict, and bind the
+                             promotion planes to the image
+                             (tier_fn / tier_fuel_bound / tier_fns /
+                             tierup_report).
+  tierup_active(img, cfg) -- will `_make_step` compile promoted
+                             bodies?  Shared by the step builder, the
+                             obs counter-plane allocator and the
+                             supervisor ladder so they never disagree.
+  make_tierup_apply(...)  -- the jit-pure compiled-body builder the
+                             step merges in (lint target).
+
+The COMPILABILITY VERDICT is deliberately conservative (v1): a
+promoted function must be a defined, non-recursive LEAF whose every
+op is either a pure-eligible cell (batch/fuse.py eligibility: stack
+motion + non-trapping ALU), an absint-LICENSED load (proven in-bounds
+and aligned — it can never trap), or structured control flow
+(br / br_if lowered forms / return), and whose analyzer cost bound is
+finite — the r19 trip-bound license is what turns the function's
+loops into bounded device loops.  Everything else keeps the
+interpreted path; promotion never changes semantics, only dispatch
+count.
+
+A promoted call retires in ONE dispatch: the step routes lanes parked
+at a promoted entry pc into a lane-masked CFG body (block dispatch
+inside a bounded `lax.while_loop`), and the lanes come back either
+RETURNED (the step's return merge pops their frame exactly like the
+per-op CLS_RETURN rung) or BAILED at a block head (the iteration cap —
+never reached when the bound is exact — hands them back to the per-op
+path mid-function, bit-identically).  Off (or nothing promoted)
+compiles the bit-identical seed step by construction.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from wasmedge_tpu.batch.fuse import cell_eligible
+from wasmedge_tpu.batch.image import (
+    CLS_ALU1,
+    CLS_ALU2,
+    CLS_BR,
+    CLS_BRNZ,
+    CLS_BRZ,
+    CLS_CONST,
+    CLS_DROP,
+    CLS_LOAD,
+    CLS_LOCAL_GET,
+    CLS_LOCAL_SET,
+    CLS_LOCAL_TEE,
+    CLS_NOP,
+    CLS_RETURN,
+    CLS_SELECT,
+)
+
+# Block terminator kinds the v1 body compiles (analysis/cfg.py
+# _block_kind).  Everything else (br_table, calls, tail calls,
+# unreachable) fails the verdict.
+_OK_KINDS = frozenset(("fallthrough", "br", "brz", "brnz", "return"))
+
+# Straight-line classes the body compiles besides licensed loads and
+# the terminators above.  GLOBAL_GET/SET are pure-eligible for fusion
+# but excluded here to keep the conditional's tuple carry to the stack
+# planes (the counted-loop shapes that win never touch globals).
+_PURE_OK = frozenset((CLS_NOP, CLS_CONST, CLS_LOCAL_GET, CLS_LOCAL_SET,
+                      CLS_LOCAL_TEE, CLS_DROP, CLS_SELECT))
+_TERM_CLS = frozenset((CLS_BR, CLS_BRZ, CLS_BRNZ, CLS_RETURN))
+
+
+def tierup_active(img, cfg) -> bool:
+    """Will `_make_step(img, cfg, ...)` compile promoted bodies?"""
+    if not getattr(cfg, "tierup", True):
+        return False
+    tf = getattr(img, "tier_fn", None)
+    return tf is not None and bool((np.asarray(tf) >= 0).any())
+
+
+def _op_verdict(img, pc: int, licensed) -> Optional[str]:
+    """None when the op at `pc` may join a compiled body, else the
+    refusal reason."""
+    cls = int(img.cls[pc])
+    if cls in _PURE_OK or cls in _TERM_CLS:
+        return None
+    if cls in (CLS_ALU1, CLS_ALU2):
+        if cell_eligible(cls, int(img.sub[pc])):
+            return None
+        return f"trapping/heavy alu at pc {pc}"
+    if cls == CLS_LOAD:
+        if pc in licensed:
+            return None
+        return f"unlicensed load at pc {pc}"
+    return f"class {cls} at pc {pc}"
+
+
+def _func_verdict(img, f, licensed, max_blocks: int,
+                  max_ops: int) -> Optional[str]:
+    """None when FuncAnalysis `f` is promotable, else the reason."""
+    cfg = getattr(f, "cfg", None)
+    if cfg is None or f.entry_pc < 0:
+        return "no cfg / import"
+    if getattr(f, "recursive", False):
+        return "recursive"
+    if getattr(f, "dynamic_calls", False):
+        return "dynamic calls"
+    if getattr(f, "hostcall_sites", None):
+        return "hostcall sites"
+    if f.cost_bound is None:
+        return "unbounded cost (no trip license)"
+    if len(cfg.blocks) > max_blocks:
+        return f"{len(cfg.blocks)} blocks > cap {max_blocks}"
+    n_ops = f.end_pc - f.entry_pc + 1
+    if n_ops > max_ops:
+        return f"{n_ops} ops > cap {max_ops}"
+    by_start = {b.start for b in cfg.blocks}
+    for bi, b in enumerate(cfg.blocks):
+        if b.calls or b.dynamic_call:
+            return "leaf only (calls in body)"
+        if b.kind not in _OK_KINDS:
+            return f"terminator {b.kind}"
+        if b.kind == "fallthrough" and not b.succ:
+            return "falls off function end"
+        if b.kind in ("brz", "brnz") and len(b.succ) != 2:
+            return "conditional without fallthrough"
+        for s in b.succ:
+            if s not in by_start:
+                return f"successor {s} outside function"
+        # analyzer block cost must dominate the block's op count so
+        # cost_bound also bounds RETIRED OPS (the device-loop cap and
+        # the ops-times-max-weight fuel gate both lean on this; a
+        # zero-weight cost table would break the domination)
+        costs = getattr(f, "block_costs", None)
+        if costs is not None and bi < len(costs) \
+                and costs[bi] < (b.end - b.start + 1):
+            return "zero-weight cost table"
+    for pc in range(f.entry_pc, f.end_pc + 1):
+        r = _op_verdict(img, pc, licensed)
+        if r is not None:
+            return r
+    return None
+
+
+def _fuel_bound(img, cfg, f) -> int:
+    """Static upper bound on the WEIGHTED gas one full call consumes.
+
+    cost_bound bounds retired ops (block costs dominate op counts —
+    verdict-checked), so ops x the function's max per-op engine weight
+    bounds the gas.  Conservative is fine: lanes failing the fuel
+    pre-gate step per-op, bit-identically."""
+    maxw = 1
+    ct = getattr(cfg, "cost_table", None)
+    op_id = getattr(img, "op_id", None)
+    if ct is not None and op_id is not None:
+        for pc in range(f.entry_pc, f.end_pc + 1):
+            o = int(op_id[pc])
+            try:
+                maxw = max(maxw, int(ct[o]))
+            except (IndexError, KeyError):
+                maxw = max(maxw, 1)
+    return int(f.cost_bound) * maxw
+
+
+def _hot_score(img, f) -> int:
+    """Hotness rank: realized fused-run weight within the function
+    (the r17/r19 `.fusion.json` plan, read back off the fuse_len
+    plane) plus the analyzer cost bound (bounded loop nests are where
+    the per-op dispatches go)."""
+    score = int(f.cost_bound or 0)
+    flen = getattr(img, "fuse_len", None)
+    if flen is not None:
+        fl = np.asarray(flen)[f.entry_pc:f.end_pc + 1]
+        score += int(fl[fl >= 2].sum())
+    return score
+
+
+def plan_tierup(img, cfg=None, analysis=None) -> dict:
+    """Select + verdict the promoted set and bind it to `img`.
+
+    Mutates the image in place (tier_fn / tier_fuel_bound / tier_fns /
+    tierup_report) and returns the report.  Pure numpy/python — no jax
+    import.  `analysis` defaults to the image's lazily-bound
+    ModuleAnalysis; None (concatenated multi-tenant images, analyzer
+    failure) plans nothing."""
+    if cfg is None:
+        from wasmedge_tpu.common.configure import BatchConfigure
+
+        cfg = BatchConfigure()
+    top_k = max(int(getattr(cfg, "tierup_top_k", 4)), 0)
+    max_blocks = max(int(getattr(cfg, "tierup_max_blocks", 16)), 1)
+    max_ops = max(int(getattr(cfg, "tierup_max_ops", 128)), 1)
+    report: dict = {
+        "enabled": bool(getattr(cfg, "tierup", True)),
+        "top_k": top_k,
+        "max_blocks": max_blocks,
+        "max_ops": max_ops,
+        "candidates": [],
+        "promoted": [],
+    }
+    img.tierup_report = report
+    img.tier_fn = None
+    img.tier_fuel_bound = None
+    img.tier_fns = ()
+    if not report["enabled"] or top_k == 0:
+        return report
+    if analysis is None:
+        analysis = img.analysis
+    if analysis is None:
+        return report
+    licensed = getattr(analysis, "licensed_pcs", frozenset()) or frozenset()
+
+    rows = []
+    for f in analysis.funcs:
+        verdict = _func_verdict(img, f, licensed, max_blocks, max_ops)
+        row = {
+            "idx": int(f.idx),
+            "name": getattr(f, "name", None) or f"func{f.idx}",
+            "cost_bound": f.cost_bound,
+            "score": _hot_score(img, f),
+            "promotable": verdict is None,
+            "refusal": verdict,
+        }
+        rows.append((row, f))
+    rows.sort(key=lambda rf: (-rf[0]["score"], rf[0]["idx"]))
+    report["candidates"] = [r for r, _ in rows]
+
+    tier_fn = np.full(int(img.code_len), -1, np.int32)
+    fuel_bound = np.zeros(int(img.code_len), np.int32)
+    plans: List[dict] = []
+    for row, f in rows:
+        if not row["promotable"] or len(plans) >= top_k:
+            continue
+        slot = len(plans)
+        fb = min(_fuel_bound(img, cfg, f), (1 << 30))
+        blocks = [{
+            "start": int(b.start), "end": int(b.end),
+            "kind": b.kind, "succ": tuple(int(s) for s in b.succ),
+            "is_loop_head": bool(b.is_loop_head),
+        } for b in f.cfg.blocks]
+        plan = {
+            "slot": slot,
+            "idx": int(f.idx),
+            "name": row["name"],
+            "entry_pc": int(f.entry_pc),
+            "end_pc": int(f.end_pc),
+            "cost_bound": int(f.cost_bound),
+            "fuel_bound": int(fb),
+            "blocks": blocks,
+            # the bounded-device-loop license: a loop head inside a
+            # finite-cost_bound function iterates under the absint
+            # trip bound (unbounded loops poison cost_bound to None)
+            "device_loops": sum(1 for b in blocks if b["is_loop_head"]),
+        }
+        plans.append(plan)
+        tier_fn[f.entry_pc] = slot
+        fuel_bound[f.entry_pc] = fb
+        report["promoted"].append({
+            k: plan[k] for k in ("slot", "idx", "name", "entry_pc",
+                                 "cost_bound", "fuel_bound",
+                                 "device_loops")})
+    if plans:
+        img.tier_fn = tier_fn
+        img.tier_fuel_bound = fuel_bound
+        img.tier_fns = tuple(plans)
+    return report
+
+
+def make_tierup_apply(img, lanes: int, has_simd: bool,
+                      cost_np=None):
+    """Build the compiled-function handler `_make_step` merges in.
+
+    One lane-masked CFG body per promoted function, each wrapped in
+    its own any-lane conditional: a bounded `lax.while_loop` whose
+    carry holds the per-lane block index, and whose body executes
+    every block's straight-line ops as trace-time-static masked
+    gather/scatter (pcs are Python ints, so operands come from numpy
+    planes, not device gathers) and then resolves the terminator into
+    the next block index.  Loop heads iterate INSIDE the device loop —
+    the r19 trip bound (finite cost_bound, verdict-enforced) caps the
+    iteration count, so the loop is bounded by construction.
+
+    `cost_np` is the engine's per-op gas weight plane (None = flat 1);
+    the body returns exact per-lane retired/fuel deltas so gas and the
+    opcode histogram attribute identically to the per-op path.
+
+    Returns tierup_apply(stacks, mem, op_hist, pc, sp, fp, opbase,
+    is_comp) -> (stacks', op_hist', sp', returned, bailed, bail_pc,
+    retired_d, fuel_d).  `mem` is READ-ONLY (v1 promotes load-only
+    functions); lanes outside `is_comp` pass through bit-unchanged.
+
+    jit-purity lint target (tools/lint_jit_purity.py): everything
+    nested here runs under trace.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from wasmedge_tpu.batch import laneops as lo_ops
+
+    I32 = jnp.int32
+    lane_iota = jnp.arange(lanes, dtype=I32)
+    A2F = lo_ops.alu2_fns()
+    A1F = lo_ops.alu1_fns()
+    b2i = lo_ops.b2i
+    NC = 4 if has_simd else 2
+    plans = img.tier_fns
+    cls_np = np.asarray(img.cls)
+    sub_np = np.asarray(img.sub)
+    a_np = np.asarray(img.a)
+    b_np = np.asarray(img.b)
+    c_np = np.asarray(img.c)
+    ilo_np = np.asarray(img.imm_lo)
+    ihi_np = np.asarray(img.imm_hi)
+    w_np = (np.asarray(cost_np) if cost_np is not None
+            else np.ones(cls_np.shape[0], np.int32))
+
+    def gat(plane, idx):
+        idx = jnp.clip(idx, 0, plane.shape[0] - 1)
+        return jnp.take_along_axis(plane, idx[None, :], axis=0)[0]
+
+    def scat(plane, idx, vals, mask):
+        idx = jnp.clip(idx, 0, plane.shape[0] - 1)
+        cur = jnp.take_along_axis(plane, idx[None, :], axis=0)[0]
+        return plane.at[idx, lane_iota].set(jnp.where(mask, vals, cur))
+
+    def tierup_apply(stacks, mem, op_hist, pc, sp, fp, opbase, is_comp):
+        stacks = tuple(stacks)
+        zl = jnp.zeros_like(sp)
+        false_l = is_comp & False
+        out_sp = sp
+        out_ret = false_l
+        out_bail = false_l
+        out_bail_pc = pc
+        out_rd = zl
+        out_fd = zl
+
+        for plan in plans:
+            m_f = is_comp & (pc == plan["entry_pc"])
+            blocks = plan["blocks"]
+            bi_of = {b["start"]: bi for bi, b in enumerate(blocks)}
+            nb = len(blocks)
+            cap = max(int(plan["cost_bound"]), 1)
+            track_hist = op_hist is not None
+
+            def _run_fn(ops, blocks=blocks, bi_of=bi_of, nb=nb,
+                        cap=cap, m_f=m_f, track_hist=track_hist,
+                        plan=plan):
+                stks, oh = ops
+
+                def push(stks, spv, m, v):
+                    for comp in range(NC):
+                        stks[comp] = scat(stks[comp], spv,
+                                          v[comp] if comp < len(v)
+                                          else zl, m)
+                    return jnp.where(m, spv + 1, spv)
+
+                def rd3(stks, idx):
+                    return tuple(gat(p, idx) for p in stks)
+
+                def cond(carry):
+                    _, _, _, live, _, _, _, i = carry
+                    return (i < cap) & jnp.any(live)
+
+                def body(carry):
+                    stks, spv, blk, live, ret, rd, fd, i = carry
+                    stks = list(stks)
+                    blk_n = blk
+                    for bi, blkp in enumerate(blocks):
+                        mb = live & (blk == bi)
+                        start, end, kind = (blkp["start"], blkp["end"],
+                                            blkp["kind"])
+                        term = end if kind != "fallthrough" else end + 1
+                        for pcj in range(start, term):
+                            cls_j = int(cls_np[pcj])
+                            aj = int(a_np[pcj])
+                            if cls_j == CLS_NOP:
+                                continue
+                            elif cls_j == CLS_CONST:
+                                spv = push(stks, spv, mb, (
+                                    jnp.full_like(zl, int(ilo_np[pcj])),
+                                    jnp.full_like(zl, int(ihi_np[pcj]))))
+                            elif cls_j == CLS_LOCAL_GET:
+                                v = rd3(stks, fp + aj)
+                                spv = push(stks, spv, mb, v)
+                            elif cls_j in (CLS_LOCAL_SET,
+                                           CLS_LOCAL_TEE):
+                                v = rd3(stks, spv - 1)
+                                for comp in range(NC):
+                                    stks[comp] = scat(
+                                        stks[comp], fp + aj, v[comp],
+                                        mb)
+                                if cls_j == CLS_LOCAL_SET:
+                                    spv = jnp.where(mb, spv - 1, spv)
+                            elif cls_j == CLS_DROP:
+                                spv = jnp.where(mb, spv - 1, spv)
+                            elif cls_j == CLS_SELECT:
+                                cv = rd3(stks, spv - 1)
+                                v2 = rd3(stks, spv - 2)
+                                v1 = rd3(stks, spv - 3)
+                                cz = cv[0] == 0
+                                sel = tuple(jnp.where(cz, b_c, a_c)
+                                            for b_c, a_c
+                                            in zip(v2, v1))
+                                for comp in range(NC):
+                                    stks[comp] = scat(
+                                        stks[comp], spv - 3,
+                                        sel[comp], mb)
+                                spv = jnp.where(mb, spv - 2, spv)
+                            elif cls_j == CLS_ALU1:
+                                v = rd3(stks, spv - 1)
+                                rl, rh = A1F[int(sub_np[pcj])](
+                                    v[0], v[1])
+                                spv = push(stks,
+                                           jnp.where(mb, spv - 1, spv),
+                                           mb, (rl, rh))
+                            elif cls_j == CLS_ALU2:
+                                y = rd3(stks, spv - 1)
+                                x = rd3(stks, spv - 2)
+                                rl, rh = A2F[int(sub_np[pcj])](
+                                    x[0], x[1], y[0], y[1])
+                                spv = push(stks,
+                                           jnp.where(mb, spv - 2, spv),
+                                           mb, (rl, rh))
+                            elif cls_j == CLS_LOAD:
+                                # absint-licensed: in-bounds, never
+                                # straddles a word (width-specialized,
+                                # the make_memfuse_apply load shape)
+                                nbytes = int(b_np[pcj])
+                                signed = int(c_np[pcj]) & 1
+                                is64 = (int(c_np[pcj]) >> 1) & 1
+                                av = rd3(stks, spv - 1)
+                                ea = av[0] + aj
+                                widx = lax.shift_right_logical(ea, 2)
+                                w0 = gat(mem, widx)
+                                hi = zl
+                                if nbytes == 8:
+                                    lo = w0
+                                    hi = gat(mem, widx + 1)
+                                elif nbytes == 4:
+                                    lo = w0
+                                else:
+                                    sh = (ea & 3) * 8
+                                    raw = lax.shift_right_logical(
+                                        w0, sh)
+                                    bits = nbytes * 8
+                                    if signed:
+                                        lo = lax.shift_right_arithmetic(
+                                            lax.shift_left(
+                                                raw, 32 - bits),
+                                            32 - bits)
+                                    else:
+                                        lo = raw & ((1 << bits) - 1)
+                                if is64 and nbytes < 8:
+                                    hi = lax.shift_right_arithmetic(
+                                        lo, 31) if signed else zl
+                                spv = push(stks,
+                                           jnp.where(mb, spv - 1, spv),
+                                           mb, (lo, hi))
+                            else:  # planner bug: surface at trace time
+                                raise AssertionError(
+                                    f"uncompilable class {cls_j} at "
+                                    f"pc {pcj} in promoted "
+                                    f"{plan['name']}")
+                        # terminator -> next block index / return
+                        if kind == "fallthrough":
+                            nxt = bi_of[blkp["succ"][0]]
+                            blk_n = jnp.where(mb, nxt, blk_n)
+                        elif kind == "br":
+                            bv, cv_ = int(b_np[end]), int(c_np[end])
+                            if bv == 1:
+                                v = rd3(stks, spv - 1)
+                                for comp in range(NC):
+                                    stks[comp] = scat(
+                                        stks[comp], opbase + cv_,
+                                        v[comp], mb)
+                            spv = jnp.where(mb, opbase + cv_ + bv, spv)
+                            blk_n = jnp.where(
+                                mb, bi_of[int(a_np[end])], blk_n)
+                        elif kind == "brz":
+                            cv = rd3(stks, spv - 1)
+                            spv = jnp.where(mb, spv - 1, spv)
+                            taken = mb & (cv[0] == 0)
+                            blk_n = jnp.where(
+                                taken, bi_of[int(a_np[end])],
+                                jnp.where(mb, bi_of[end + 1], blk_n))
+                        elif kind == "brnz":
+                            bv, cv_ = int(b_np[end]), int(c_np[end])
+                            cv = rd3(stks, spv - 1)
+                            taken = mb & (cv[0] != 0)
+                            if bv == 1:
+                                v = rd3(stks, spv - 2)
+                                for comp in range(NC):
+                                    stks[comp] = scat(
+                                        stks[comp], opbase + cv_,
+                                        v[comp], taken)
+                            spv = jnp.where(
+                                taken, opbase + cv_ + bv,
+                                jnp.where(mb, spv - 1, spv))
+                            blk_n = jnp.where(
+                                taken, bi_of[int(a_np[end])],
+                                jnp.where(mb, bi_of[end + 1], blk_n))
+                        else:  # return
+                            nres = int(b_np[end])
+                            if nres == 1:
+                                v = rd3(stks, spv - 1)
+                                for comp in range(NC):
+                                    stks[comp] = scat(
+                                        stks[comp], fp, v[comp], mb)
+                            spv = jnp.where(mb, fp + nres, spv)
+                            ret = ret | mb
+                            live = live & ~mb
+                        n_ops = end - start + 1
+                        w_blk = int(w_np[start:end + 1].sum())
+                        rd = rd + jnp.where(mb, n_ops, 0)
+                        fd = fd + jnp.where(mb, w_blk, 0)
+                        if track_hist:
+                            nonlocal_oh[bi] = nonlocal_oh[bi] \
+                                + jnp.sum(b2i(mb))
+                    return (tuple(stks), spv, blk_n, live, ret, rd,
+                            fd, i + 1)
+
+                # per-block execution counters for the opcode
+                # histogram (device plane; list is rebuilt per trace)
+                nonlocal_oh = [jnp.int32(0)] * nb
+                if track_hist:
+                    def body_h(carry):
+                        c, oh_c = carry
+                        nonlocal_oh.clear()
+                        nonlocal_oh.extend(
+                            oh_c[bi] for bi in range(nb))
+                        out = body(c)
+                        return out, tuple(nonlocal_oh)
+
+                    def cond_h(carry):
+                        return cond(carry[0])
+
+                    entry_bi = bi_of[plan["entry_pc"]]
+                    carry0 = ((stks, sp, jnp.full_like(zl, entry_bi),
+                               m_f, false_l, zl, zl, jnp.int32(0)),
+                              tuple(jnp.int32(0) for _ in range(nb)))
+                    (stks2, spv, blk, live, ret, rd, fd, _), oh_cnt = \
+                        lax.while_loop(cond_h, body_h, carry0)
+                    for bi, blkp in enumerate(blocks):
+                        for pcj in range(blkp["start"],
+                                         blkp["end"] + 1):
+                            oh = oh.at[pcj].add(oh_cnt[bi])
+                else:
+                    entry_bi = bi_of[plan["entry_pc"]]
+                    carry0 = (stks, sp, jnp.full_like(zl, entry_bi),
+                              m_f, false_l, zl, zl, jnp.int32(0))
+                    stks2, spv, blk, live, ret, rd, fd, _ = \
+                        lax.while_loop(cond, body, carry0)
+                starts = jnp.asarray(
+                    np.array([b["start"] for b in blocks], np.int32))
+                bail_pc = starts[jnp.clip(blk, 0, nb - 1)]
+                return (tuple(stks2), oh, spv, ret, live, bail_pc,
+                        rd, fd)
+
+            def _skip_fn(ops):
+                stks, oh = ops
+                return (stks, oh, sp, false_l, false_l, pc, zl, zl)
+
+            stacks, op_hist, f_sp, f_ret, f_bail, f_bpc, f_rd, f_fd = \
+                lax.cond(jnp.any(m_f), _run_fn, _skip_fn,
+                         (stacks, op_hist))
+            out_sp = jnp.where(m_f, f_sp, out_sp)
+            out_ret = out_ret | (m_f & f_ret)
+            out_bail = out_bail | (m_f & f_bail)
+            out_bail_pc = jnp.where(m_f & f_bail, f_bpc, out_bail_pc)
+            out_rd = out_rd + jnp.where(m_f, f_rd, 0)
+            out_fd = out_fd + jnp.where(m_f, f_fd, 0)
+        return (list(stacks), op_hist, out_sp, out_ret, out_bail,
+                out_bail_pc, out_rd, out_fd)
+
+    return tierup_apply

@@ -148,6 +148,25 @@ class BatchConfigure:
     # fuse_max_patterns for the pure tier), and the per-run cell cap.
     memfuse_max_patterns: int = 8
     memfuse_max_run: int = 24
+    # --- whole-function tier-up compilation (r20, batch/tierup.py) ---
+    # Promote the hottest COMPILABLE whole functions out of the any-lane
+    # dispatch switch: each promoted function becomes a lane-masked
+    # jitted CFG body (block dispatch inside a bounded lax.while_loop,
+    # trip bounds licensed by the r19 abstract interpreter) so a call
+    # costs ONE dispatch instead of one per retired op.  Promotion is
+    # conservative — leaf functions whose every op is pure-eligible or
+    # an absint-licensed load, with a finite analyzer cost bound — and
+    # unpromoted code keeps the per-op/fused path.  Off compiles the
+    # bit-identical seed step by construction; results are bit-identical
+    # either way (tests/test_tierup.py).
+    tierup: bool = True
+    # How many verdict-passing functions the planner promotes, ranked
+    # hottest-first (realized fusion-run weight, then cost bound).
+    tierup_top_k: int = 4
+    # Compiled-body size caps: candidates whose CFG exceeds either cap
+    # keep the interpreted path (bigger bodies = bigger traced step).
+    tierup_max_blocks: int = 16
+    tierup_max_ops: int = 128
     # --- divergence-aware lane compaction (batch/compact.py) ---
     # Sort/permute live lanes by (divergence-score bias, pc) at launch
     # boundaries via one jitted gather-permutation, packing live lanes

@@ -7,6 +7,7 @@ plus small modules exercising each subsystem.
 """
 
 from wasmedge_tpu.models.programs import (
+    build_call_counted_loop,
     build_coremark_kernel,
     build_counted_loop,
     build_fac,
@@ -14,6 +15,7 @@ from wasmedge_tpu.models.programs import (
     build_loop_sum,
     build_memfuse_workload,
     build_memory_workload,
+    build_simd_memfuse_workload,
 )
 
 __all__ = [
@@ -21,7 +23,9 @@ __all__ = [
     "build_fac",
     "build_loop_sum",
     "build_counted_loop",
+    "build_call_counted_loop",
     "build_memory_workload",
     "build_memfuse_workload",
+    "build_simd_memfuse_workload",
     "build_coremark_kernel",
 ]

@@ -123,6 +123,43 @@ def build_counted_loop(n: int = 64) -> bytes:
     return b.build()
 
 
+def build_call_counted_loop(n: int = 64, calls: int = 24) -> bytes:
+    """A non-promotable driver calling a promotable counted-loop leaf
+    `calls` times — the r20 tier-up cadence fixture.  The driver has
+    CALL ops so the compiled-function verdict refuses it; the leaf is
+    the build_counted_loop shape (constant latch, exact absint trip
+    bound) so it promotes.  With the compiled tier on, each call
+    retires through ONE compiled-body dispatch plus the driver's
+    per-op glue — enough launches either way that supervised runs
+    cross checkpoint boundaries mid-stream (tests/test_tierup.py).
+
+    Result: arg + calls * (n*(n-1)/2)."""
+    b = ModuleBuilder()
+    # func 0 (driver): locals 0=arg, 1=j, 2=acc
+    b.add_function(["i32"], ["i32"], ["i32", "i32"], [
+        ("local.get", 0), ("local.set", 2),
+        ("block", None),
+        ("loop", None),
+        ("local.get", 2), ("local.get", 1), ("call", 1), "i32.add",
+        ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        ("local.get", 1), ("i32.const", calls), "i32.lt_u", ("br_if", 0),
+        "end", "end",
+        ("local.get", 2),
+    ], export="call_count")
+    # func 1 (leaf): the counted-loop body — locals 0=arg, 1=i, 2=acc
+    b.add_function(["i32"], ["i32"], ["i32", "i32"], [
+        ("block", None),
+        ("loop", None),
+        ("local.get", 2), ("local.get", 1), "i32.add", ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add", ("local.set", 1),
+        ("local.get", 1), ("i32.const", n), "i32.lt_u", ("br_if", 0),
+        "end", "end",
+        ("local.get", 2),
+    ])
+    return b.build()
+
+
 def build_memfuse_workload(n_words: int = 1024, passes: int = 1,
                            byte_offset: int = 0,
                            store_width: int = 4) -> bytes:
@@ -180,6 +217,56 @@ def build_memfuse_workload(n_words: int = 1024, passes: int = 1,
         "end", "end",
         ("local.get", 2),
     ], export="memfuse")
+    return b.build()
+
+
+def build_simd_memfuse_workload(n_vecs: int = 64,
+                                passes: int = 1) -> bytes:
+    """v128 analog of build_memfuse_workload: fill `n_vecs` 16-byte
+    vectors with splatted counters, then xor-reduce a lane back out
+    through v128 loads.  Every access sits at i*16 against CONSTANT
+    loop bounds, so absint proves each v128 site in-bounds and
+    word-aligned (16-byte stride => 4-aligned) and licenses it — the
+    r20 satellite that lets batch/fuse.py compile the SIMD loop bodies
+    into fused four-word gather/scatter runs.  The splat/extract cells
+    stay per-op (not fusion-eligible), so each loop body realizes one
+    fused run holding the licensed v128 access."""
+    b = ModuleBuilder()
+    b.add_memory(1, 1)
+    # locals: 0=arg (ignored: limits must be static), 1=i, 2=acc, 3=pass
+    b.add_function(["i32"], ["i32"], ["i32", "i32", "i32"], [
+        ("i32.const", passes), ("local.set", 3),
+        ("block", None), ("loop", None),
+        # store n_vecs splatted vectors of i + pass
+        ("i32.const", 0), ("local.set", 1),
+        ("block", None), ("loop", None),
+        ("local.get", 1), ("i32.const", 16), "i32.mul",
+        ("local.get", 1), ("local.get", 3), "i32.add", "i32x4.splat",
+        ("v128.store", 0, 0),
+        ("local.get", 1), ("i32.const", 1), "i32.add",
+        ("local.set", 1),
+        ("local.get", 1), ("i32.const", n_vecs), "i32.lt_u",
+        ("br_if", 0),
+        "end", "end",
+        # xor-reduce one lane of each back
+        ("i32.const", 0), ("local.set", 1),
+        ("block", None), ("loop", None),
+        ("local.get", 2),
+        ("local.get", 1), ("i32.const", 16), "i32.mul",
+        ("v128.load", 0, 0),
+        ("i32x4.extract_lane", 1),
+        "i32.xor", ("local.set", 2),
+        ("local.get", 1), ("i32.const", 1), "i32.add",
+        ("local.set", 1),
+        ("local.get", 1), ("i32.const", n_vecs), "i32.lt_u",
+        ("br_if", 0),
+        "end", "end",
+        # next pass (counted down to zero: `ne 0` trip shape)
+        ("local.get", 3), ("i32.const", 1), "i32.sub",
+        ("local.tee", 3), ("br_if", 0),
+        "end", "end",
+        ("local.get", 2),
+    ], export="simd_memfuse")
     return b.build()
 
 

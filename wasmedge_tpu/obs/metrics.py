@@ -325,6 +325,36 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                      {"path": "fused"}, rf)
             w.sample("wasmedge_retired_by_path_total",
                      {"path": "unfused"}, max(rt - rf, 0))
+        tier = getattr(recorder, "tierup_counts", None)
+        if tier and tier.get("retired_total"):
+            w.head("wasmedge_tierup_dispatches_total", "counter",
+                   "Compiled-function tier bodies dispatched (each "
+                   "retires a whole function call in one dispatch, "
+                   "batch/tierup.py).")
+            w.sample("wasmedge_tierup_dispatches_total", None,
+                     int(tier.get("dispatches", 0)))
+            w.head("wasmedge_tierup_retired_total", "counter",
+                   "Instructions retired by tier: compiled-function "
+                   "bodies vs the interpreted SIMT path.")
+            rc = int(tier.get("retired_comp", 0))
+            rt = int(tier.get("retired_total", 0))
+            w.sample("wasmedge_tierup_retired_total",
+                     {"tier": "compiled"}, rc)
+            w.sample("wasmedge_tierup_retired_total",
+                     {"tier": "interpreted"}, max(rt - rc, 0))
+        tus = getattr(recorder, "tierup_static", None)
+        if tus:
+            w.head("wasmedge_tierup_functions", "gauge",
+                   "Whole functions promoted to the compiled tier "
+                   "(batch/tierup.py plan_tierup) and counted loops "
+                   "licensed as bounded device loops inside them.")
+            w.sample("wasmedge_tierup_functions",
+                     {"kind": "promoted"},
+                     len(tus.get("promoted", ())))
+            w.sample("wasmedge_tierup_functions",
+                     {"kind": "device_loops"},
+                     sum(int(p.get("device_loops", 0))
+                         for p in tus.get("promoted", ())))
         mfs = getattr(recorder, "memfuse_static", None)
         if mfs:
             w.head("wasmedge_memfuse_runs", "gauge",

@@ -144,6 +144,12 @@ class NullRecorder:
     def set_memfuse_static(self, section):
         pass
 
+    def add_tierup_counts(self, dispatches, retired_comp, retired_total):
+        pass
+
+    def set_tierup_static(self, report):
+        pass
+
     def failure(self, rec):
         pass
 
@@ -210,6 +216,12 @@ class FlightRecorder:
         # reverted (license-refused) load/store sites + realized runs,
         # set once per plan by BatchEngine._plan_fusion
         self.memfuse_static = None
+        # compiled-function tier counters folded from the device
+        # tu_ctr plane (batch/engine.py _fold_tierup_ctr) + the
+        # promotion report set once per plan by _plan_tierup (r20)
+        self.tierup_counts = {"dispatches": 0, "retired_comp": 0,
+                              "retired_total": 0}
+        self.tierup_static = None
 
     # The recorder is a shared sink, not configuration data: components
     # deepcopy their Configure (gas bridging, scalar reruns) and must
@@ -319,6 +331,20 @@ class FlightRecorder:
         plan_fusion report's "memory" section: licensed vs reverted
         sites, realized runs/cells) for the Prometheus export."""
         self.memfuse_static = dict(section)
+
+    def add_tierup_counts(self, dispatches, retired_comp, retired_total):
+        """Fold the device tier-up counters (compiled-function bodies
+        dispatched / instructions retired through them / total retired
+        while the plane was live — batch/engine.py _fold_tierup_ctr)."""
+        self.tierup_counts["dispatches"] += int(dispatches)
+        self.tierup_counts["retired_comp"] += int(retired_comp)
+        self.tierup_counts["retired_total"] += int(retired_total)
+
+    def set_tierup_static(self, report):
+        """Record the tier-up planning report (batch/tierup.py
+        plan_tierup: promoted functions, refusal reasons, device-loop
+        counts) for the Prometheus export."""
+        self.tierup_static = dict(report)
 
     def add_opcode_counts(self, counts):
         """Fold a device-side opcode histogram (index = original opcode
