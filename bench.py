@@ -2825,6 +2825,381 @@ def federation_bench(smoke: bool = False) -> int:
     return 0 if ok else 1
 
 
+def elastic_bench(smoke: bool = False) -> int:
+    """`bench.py --elastic`: the r21 elastic-fleet acceptance — one
+    JOIN, one live RESHARD, and one clean LEAVE mid-stream under
+    open-loop load, with seeded gossip-drop weather
+    (testing/faults.churn_schedule):
+
+      - gateway A serves on 2 of the 4 virtual devices; B is a static
+        boot peer; the stream alternates submits across live peers
+      - mid-stream a THIRD gateway C joins by announcing itself to
+        seed A: the bumped membership view gossips fleet-wide, C syncs
+        the module set on its first heartbeat, and C must take traffic
+        (its first 202) within ONE heartbeat of becoming servable —
+        and actually COMPLETE requests
+      - A live-reshards 2 -> 4 devices over POST /v1/reshard while
+        lanes are resident: no drain, zero resident requests dropped,
+        every result still fib-oracle-correct (bit-identity is the
+        serve path's grow-only-pool construction, pinned per-lane in
+        tests/test_elastic.py)
+      - B announces departure over POST /v1/fleet/leave and shuts
+        down: survivors unroute it as churn (never degradation), and
+        every id B accepted still reaches one stable terminal outcome
+        (clean drain + replicated-journal adoption after the left
+        peer's heartbeats stop)
+      - every accepted id fleet-wide: exactly one STABLE terminal
+        outcome, zero lost, zero wrong cells
+
+    Emits ELASTIC_r21.json.  `--elastic-smoke` is the CI guard: a
+    short stream, same assertions, no artifact."""
+    import os
+    import threading
+    import time as _time
+
+    jax = _mesh_env(8)
+
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.fleet import FleetConfig
+    from wasmedge_tpu.gateway import Gateway, GatewayService
+    from wasmedge_tpu.models import build_fib
+    from wasmedge_tpu.testing.faults import FaultInjector, churn_schedule
+
+    seed = int(os.environ.get("ELASTIC_SEED", 21))
+    if smoke:
+        lanes, nreq, rate = 4, 12, 40.0
+        fib_lo, fib_hi = 8, 12
+    else:
+        lanes = int(os.environ.get("ELASTIC_LANES", 4))
+        nreq = int(os.environ.get("ELASTIC_REQUESTS", 36))
+        rate = float(os.environ.get("ELASTIC_RATE", 18.0))
+        fib_lo, fib_hi = 8, 14
+    heartbeat_s = 0.1
+    join_at, reshard_at, leave_at = nreq // 3, nreq // 2, (2 * nreq) // 3
+
+    def fresh_conf():
+        conf = Configure()
+        conf.batch.steps_per_launch = 128
+        conf.batch.value_stack_depth = 64
+        conf.batch.call_stack_depth = 32
+        conf.hv.max_virtual_lanes = 3 * lanes
+        return conf
+
+    def fleet_cfg(peers=()):
+        return FleetConfig(peers=peers, heartbeat_s=heartbeat_s,
+                           suspect_after=2, dead_after=3,
+                           backoff_base_s=0.02, request_timeout_s=5.0)
+
+    t0 = time.perf_counter()
+    # seeded churn weather on the seed gateway: dropped gossip merges
+    # must only DELAY convergence
+    inj = FaultInjector(churn_schedule(seed, gossip_drops=2, max_at=4))
+    svc_a = GatewayService(conf=fresh_conf(), lanes=lanes,
+                           devices=jax.devices()[:2], faults=inj,
+                           fleet=fleet_cfg())
+    gw_a = Gateway(svc_a, port=0).start()
+    svc_b = GatewayService(
+        conf=fresh_conf(), lanes=lanes,
+        fleet=fleet_cfg([f"{gw_a.host}:{gw_a.port}"]))
+    gw_b = Gateway(svc_b, port=0).start()
+    a = {"host": gw_a.host, "port": gw_a.port}
+    b = {"host": gw_b.host, "port": gw_b.port}
+    c = None          # joins mid-stream
+    gw_c = None
+
+    st, doc, _ = _gateway_rpc(a["host"], a["port"], "POST",
+                              "/v1/modules?name=fib", body=build_fib(),
+                              headers={"Content-Type":
+                                       "application/wasm"},
+                              timeout=180.0)
+    assert st == 201, (st, doc)
+    deadline = _time.monotonic() + 120.0
+    replicated = False
+    while _time.monotonic() < deadline:
+        st, doc, _ = _gateway_rpc(b["host"], b["port"], "GET",
+                                  "/v1/status", timeout=30.0)
+        if st == 200 and "fib" in (doc.get("modules") or {}):
+            replicated = True
+            break
+        _time.sleep(0.05)
+
+    accepted = {}            # id -> (fib arg, accepting peer dict)
+    rejected_mr = []
+    transport_errors = [0]
+    outcomes = {}
+    lock = threading.Lock()
+    stop_poll = threading.Event()
+    b_gone = threading.Event()
+
+    def poll_at(peer, rid):
+        try:
+            return _gateway_rpc(peer["host"], peer["port"], "GET",
+                                f"/v1/requests/{rid}", timeout=30.0)
+        except OSError:
+            return None, None, None
+
+    def poll_once(rid):
+        _, (n, peer) = rid, accepted[rid]
+        if peer is b and b_gone.is_set():
+            peer = a          # departed peer's ids adopt to survivors
+        st, doc, _ = poll_at(peer, rid)
+        if st == 404 and isinstance(doc, dict):
+            # r21 poll redirection: follow the machine-readable
+            # owner_hint instead of blind survivor polling
+            hint = (doc.get("err") or {}).get("owner_hint")
+            url = (hint or {}).get("url", "")
+            if ":" in url:
+                host, _, port = url.rpartition(":")
+                try:
+                    st, doc, _ = poll_at({"host": host,
+                                          "port": int(port)}, rid)
+                except ValueError:
+                    return False
+        if st in (None, 404) or not isinstance(doc, dict) \
+                or doc.get("status") == "pending":
+            return False
+        with lock:
+            outcomes.setdefault(rid, (st, doc))
+        return True
+
+    def poller():
+        while not stop_poll.is_set():
+            with lock:
+                todo = [r for r in accepted if r not in outcomes]
+            if not todo:
+                _time.sleep(0.02)
+                continue
+            for rid in todo:
+                poll_once(rid)
+                if stop_poll.is_set():
+                    return
+            _time.sleep(0.01)
+
+    pollers = [threading.Thread(target=poller, daemon=True)
+               for _ in range(1 if smoke else 2)]
+    for t in pollers:
+        t.start()
+
+    def submit(peer, n):
+        for _ in range(8):
+            try:
+                st, doc, after = _gateway_rpc(
+                    peer["host"], peer["port"], "POST",
+                    "/v1/invoke?async=1",
+                    body={"module": "fib", "func": "fib",
+                          "args": [int(n)]}, timeout=30.0)
+            except OSError:
+                transport_errors[0] += 1
+                return None
+            if st == 202 and isinstance(doc, dict):
+                with lock:
+                    accepted[doc["request_id"]] = (int(n), peer)
+                return doc["request_id"]
+            err = doc.get("err") if isinstance(doc, dict) else None
+            if isinstance(err, dict) and err.get("retryable"):
+                rejected_mr.append((st, err.get("name"),
+                                    err.get("detail")))
+                _time.sleep(min(float(after or 0.2), 0.3))
+                continue
+            if isinstance(err, dict):
+                rejected_mr.append((st, err.get("name"),
+                                    err.get("detail")))
+                return None
+            transport_errors[0] += 1
+            return None
+
+    rng = np.random.RandomState(seed)
+    args_stream = rng.randint(fib_lo, fib_hi + 1, size=nreq)
+    joined = resharded = left = False
+    join_first_202_s = None
+    join_to_servable_s = None
+    reshard_reply = None
+    t_sched0 = _time.monotonic()
+    for i, n in enumerate(args_stream):
+        t_sched = t_sched0 + i / rate
+        now = _time.monotonic()
+        if t_sched > now:
+            _time.sleep(t_sched - now)
+        if i == join_at and not joined:
+            # -- THE join: C announces itself to seed A only ----------
+            svc_c = GatewayService(
+                conf=fresh_conf(), lanes=lanes,
+                fleet=fleet_cfg([f"{gw_a.host}:{gw_a.port}"]))
+            gw_c = Gateway(svc_c, port=0).start()
+            c = {"host": gw_c.host, "port": gw_c.port}
+            t_join = _time.monotonic()
+            # module sync rides C's first heartbeat; "takes traffic
+            # within one heartbeat" is measured from servable (module
+            # synced + generation built) to the first accepted 202 —
+            # a burst de-flakes the measurement
+            sv_deadline = _time.monotonic() + 180.0
+            while _time.monotonic() < sv_deadline:
+                st, doc, _ = _gateway_rpc(c["host"], c["port"], "GET",
+                                          "/v1/status", timeout=30.0)
+                # servable = module synced AND a serving generation
+                # swapped in ("serve" counters only exist with one)
+                if st == 200 and "fib" in (doc.get("modules") or {}) \
+                        and "serve" in doc:
+                    break
+                _time.sleep(0.01)
+            t_servable = _time.monotonic()
+            join_to_servable_s = t_servable - t_join
+            for _ in range(20):
+                if submit(c, int(n)) is not None:
+                    join_first_202_s = _time.monotonic() - t_servable
+                    break
+            joined = True
+            continue
+        if i == reshard_at and not resharded:
+            # -- THE reshard: A grows 2 -> 4 devices, lanes resident --
+            st, reshard_reply, _ = _gateway_rpc(
+                a["host"], a["port"], "POST", "/v1/reshard",
+                body={"devices": 4}, timeout=300.0)
+            resharded = st == 200 and isinstance(reshard_reply, dict) \
+                and bool(reshard_reply.get("ok"))
+        if i == leave_at and not left:
+            # -- THE leave: B says goodbye, drains, and goes ----------
+            st, doc, _ = _gateway_rpc(b["host"], b["port"], "POST",
+                                      "/v1/fleet/leave", body={},
+                                      timeout=30.0)
+            left = st == 200 and isinstance(doc, dict) \
+                and bool(doc.get("ok"))
+            gw_b.shutdown(drain=True, timeout_s=120.0)
+            b_gone.set()
+        peers_live = [a] + ([c] if joined and c else []) \
+            + ([] if b_gone.is_set() else [b])
+        submit(peers_live[i % len(peers_live)], n)
+
+    deadline = _time.monotonic() + (180.0 if smoke else 420.0)
+    while _time.monotonic() < deadline:
+        with lock:
+            if len(outcomes) == len(accepted):
+                break
+        _time.sleep(0.05)
+    stop_poll.set()
+    for t in pollers:
+        t.join(timeout=5.0)
+
+    def fibv(n):
+        x, y = 0, 1
+        for _ in range(n):
+            x, y = y, x + y
+        return x
+
+    stable = lost = resolved = wrong = 0
+    for rid, (n, _peer) in accepted.items():
+        first = outcomes.get(rid)
+        if first is None:
+            lost += 1
+            continue
+        poll_once(rid)      # idempotent re-read through the same path
+        peer = a if _peer is b and b_gone.is_set() else _peer
+        st, doc, _ = poll_at(peer, rid)
+        if st == 404 and isinstance(doc, dict):
+            hint = (doc.get("err") or {}).get("owner_hint")
+            url = (hint or {}).get("url", "")
+            if ":" in url:
+                host, _, port = url.rpartition(":")
+                st, doc, _ = poll_at({"host": host,
+                                      "port": int(port)}, rid)
+        if isinstance(doc, dict) and doc.get("ok") \
+                and first[1].get("ok") \
+                and doc.get("result") == first[1].get("result"):
+            stable += 1
+        elif isinstance(doc, dict) and not doc.get("ok") \
+                and not first[1].get("ok"):
+            stable += 1
+        if first[1].get("ok"):
+            resolved += 1
+            if first[1].get("result") != [fibv(n)]:
+                wrong += 1
+
+    st, status_a, _ = _gateway_rpc(a["host"], a["port"], "GET",
+                                   "/v1/status", timeout=60.0)
+    st_m, metrics_a, _ = _gateway_rpc(a["host"], a["port"], "GET",
+                                      "/metrics", timeout=60.0)
+    st_c, status_c, _ = _gateway_rpc(c["host"], c["port"], "GET",
+                                     "/v1/status", timeout=60.0) \
+        if c else (None, {}, None)
+    fleet_a = status_a.get("fleet", {}) if isinstance(status_a, dict) \
+        else {}
+    b_state = fleet_a.get("peer_states", {}).get(
+        f"{b['host']}:{b['port']}", {})
+    serve_a = status_a.get("serve", {}) if isinstance(status_a, dict) \
+        else {}
+    if gw_c is not None:
+        gw_c.shutdown(drain=True, timeout_s=120.0)
+    gw_a.shutdown(drain=True, timeout_s=120.0)
+    dt = time.perf_counter() - t0
+
+    checks = {
+        "module_replicated_to_peer": replicated,
+        "accepted_all_terminal": len(outcomes) == len(accepted),
+        "zero_ids_lost": lost == 0,
+        "outcomes_stable": stable == len(accepted),
+        "results_correct": wrong == 0,
+        "peer_joined_mid_stream": joined,
+        "join_within_one_heartbeat": join_first_202_s is not None
+        and join_first_202_s <= heartbeat_s,
+        "joined_peer_completed_requests": isinstance(status_c, dict)
+        and int((status_c.get("gateway") or {})
+                .get("completed", 0)) >= 1,
+        "reshard_applied_live": resharded
+        and isinstance(status_a, dict) and status_a.get("devices") == 4
+        and int(serve_a.get("reshards", 0)) >= 1,
+        "zero_resident_lanes_dropped":
+            int(serve_a.get("killed", 0)) == 0
+            and int(serve_a.get("trapped", 0)) == 0,
+        "peer_left_cleanly": left and b_state.get("left") is True,
+        "membership_epoch_advanced":
+            int(fleet_a.get("membership_epoch", 0)) >= 3,
+        "elastic_metrics_exported":
+            "wasmedge_fleet_membership_epoch" in str(metrics_a)
+            and "wasmedge_reshards_total" in str(metrics_a),
+    }
+    ok = all(checks.values())
+    out = {
+        "metric": "elastic_fleet_smoke" if smoke
+        else "elastic_fleet_open_loop",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "seed": seed,
+        "lanes_per_peer": lanes,
+        "requests": nreq,
+        "accepted": len(accepted),
+        "rejected_retryable_then_retried": len(rejected_mr),
+        "transport_errors": transport_errors[0],
+        "resolved_ok": resolved,
+        "join_to_servable_s": round(join_to_servable_s, 4)
+        if join_to_servable_s is not None else None,
+        "join_first_202_s": round(join_first_202_s, 4)
+        if join_first_202_s is not None else None,
+        "reshard": {k: reshard_reply.get(k) for k in
+                    ("devices", "old_devices", "lanes", "old_lanes",
+                     "resident", "direction")}
+        if isinstance(reshard_reply, dict) else None,
+        "gossip_drops_fired": inj.fired,
+        "membership_epoch": fleet_a.get("membership_epoch"),
+        "adoptions": fleet_a.get("adoptions", 0),
+        "wall_s": round(dt, 3),
+    }
+    if smoke:
+        print(json.dumps(out))
+        return 0 if ok else 1
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "ELASTIC_r21.json")
+    print(json.dumps(out))
+    print(f"# elastic peers=2+1 lanes={lanes} reqs={nreq} "
+          f"accepted={len(accepted)} lost={lost} "
+          f"join_202={join_first_202_s} reshard={resharded} "
+          f"epoch={fleet_a.get('membership_epoch')} wall={dt:.1f}s",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main():
     eng = _build(LANES)
 
@@ -2936,4 +3311,8 @@ if __name__ == "__main__":
         sys.exit(oversub_bench(smoke=True))
     if "--oversub" in sys.argv[1:]:
         sys.exit(oversub_bench())
+    if "--elastic-smoke" in sys.argv[1:]:
+        sys.exit(elastic_bench(smoke=True))
+    if "--elastic" in sys.argv[1:]:
+        sys.exit(elastic_bench())
     main()

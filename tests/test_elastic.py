@@ -1,0 +1,462 @@
+"""Elastic fleet (r21): live resharding + autoscale policy.
+
+Pins the r21 acceptance contract deterministically, on the
+conftest-forced 8-device virtual CPU mesh:
+
+  - live reshard, grow (2 -> 4 devices): resident lanes ride through a
+    mid-stream device-set change with results bit-identical to an
+    unresharded single-device reference — no drain, no re-queue
+  - live reshard, shrink (4 -> 2 devices): the lane width holds and
+    re-splits across fewer devices, same bit-identity
+  - hv-swapped virtual lanes (parked in the SwapStore at reshard time)
+    and compaction-permuted lanes ride through the move too
+  - a `reshard_install` fault rolls the server back onto the OLD mesh
+    with every resident lane intact, and the retry succeeds
+  - the gateway tier: GatewayService.reshard moves the RUNNING
+    generation and future generations inherit the new geometry;
+    wasmedge_reshards_total{direction} renders; a gateway that never
+    reshards emits no reshard series at all
+  - the autoscale ladder (gateway/autoscale.py) is deterministic:
+    spike -> raise_virtual -> reshard_grow -> shed, calm reverses,
+    cooldown holds between actions; autoscale-off gateways carry no
+    controller, no status key, no metric series (r16 identity)
+
+Speed discipline mirrors tests/test_serve_mesh.py: tiny geometry, a
+module-scoped JAX persistent compile cache, tier-1 fast.
+"""
+
+import tempfile
+
+import pytest
+
+from wasmedge_tpu.common.configure import Configure
+from wasmedge_tpu.executor import Executor
+from wasmedge_tpu.loader import Loader
+from wasmedge_tpu.models import build_fib
+from wasmedge_tpu.runtime.store import StoreManager
+from wasmedge_tpu.serve import BatchServer
+from wasmedge_tpu.testing.faults import Fault, FaultInjector, InjectedFault
+from wasmedge_tpu.validator import Validator
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache():
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    d = tempfile.mkdtemp(prefix="elastic-jit-cache-")
+    jax.config.update("jax_compilation_cache_dir", d)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def _fib(n):
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+def _conf(hv_virtual=None, compact=False, obs=False):
+    conf = Configure()
+    conf.batch.steps_per_launch = 256
+    conf.batch.value_stack_depth = 128
+    conf.batch.call_stack_depth = 64
+    conf.obs.enabled = obs
+    if hv_virtual is not None:
+        conf.hv.max_virtual_lanes = hv_virtual
+    if compact:
+        # hair-trigger policy: compact at every eligible boundary
+        conf.batch.compact = True
+        conf.batch.compact_min_interval = 1
+        conf.batch.compact_trigger = 0.0
+        conf.batch.compact_cost_factor = 0.0
+    return conf
+
+
+def _server(conf, lanes, **kw):
+    mod = Validator(conf).validate(Loader(conf).parse_module(build_fib()))
+    store = StoreManager()
+    inst = Executor(conf).instantiate(store, mod)
+    return BatchServer(inst, store=store, conf=conf, lanes=lanes, **kw)
+
+
+NS = [5, 11, 12, 7, 3, 12, 9, 2, 10, 6, 12, 11, 8, 12, 4, 9]
+
+
+def _mesh_devices(n):
+    import jax
+
+    devs = jax.devices()[:n]
+    assert len(devs) == n, "virtual device mesh missing"
+    return devs
+
+
+@pytest.fixture(scope="module")
+def ref_results(_compile_cache):
+    """The unresharded single-device reference every bit-identity
+    assertion compares against."""
+    srv = _server(_conf(), lanes=6)
+    futs = [srv.submit("fib", [n]) for n in NS]
+    srv.run_until_idle()
+    ref = [f.result(0)[0] for f in futs]
+    assert ref == [_fib(n) for n in NS]
+    return ref
+
+
+# ---------------------------------------------------------------------------
+# live reshard: the running server moves device sets mid-stream
+# ---------------------------------------------------------------------------
+def test_reshard_grow_2_to_4_resident_lanes_bit_identical(ref_results):
+    srv = _server(_conf(), lanes=6, devices=_mesh_devices(2))
+    futs = [srv.submit("fib", [n]) for n in NS]
+    for _ in range(2):
+        srv.step()
+    assert srv.in_flight > 0          # resident lanes mid-request
+    out = srv.reshard(devices=_mesh_devices(4))
+    # grow-only pool: 6 lanes over 2 devices pads to 8 over 4 — the
+    # resident lanes keep their global indices and their columns
+    assert out == {"ok": True, "devices": 4, "old_devices": 2,
+                   "lanes": 8, "old_lanes": 6,
+                   "resident": out["resident"]}
+    assert out["resident"] > 0
+    assert srv.lanes == 8 and srv.engine.mesh is not None
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == ref_results
+    assert srv.counters["reshards"] == 1
+    c = srv.counters
+    assert c["submitted"] == c["completed"] + c["trapped"] \
+        + c["expired"] + c["killed"] + c["rejected"]
+
+
+def test_reshard_shrink_4_to_2_keeps_lane_width(ref_results):
+    srv = _server(_conf(), lanes=8, devices=_mesh_devices(4))
+    futs = [srv.submit("fib", [n]) for n in NS]
+    for _ in range(2):
+        srv.step()
+    out = srv.reshard(devices=_mesh_devices(2))
+    assert out["devices"] == 2 and out["old_devices"] == 4
+    assert out["lanes"] == 8 == out["old_lanes"]   # width holds
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == ref_results
+
+
+def test_reshard_idle_server_serves_new_work(ref_results):
+    """An IDLE reshard (resident=0) must leave the server fully
+    servable: the next admitted requests run to completion on the new
+    mesh with bit-identical results — no drain state leaks into the
+    rebuilt launch path."""
+    srv = _server(_conf(), lanes=6, devices=_mesh_devices(2))
+    warm = [srv.submit("fib", [n]) for n in NS[:4]]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in warm] == ref_results[:4]
+    out = srv.reshard(devices=_mesh_devices(4))
+    assert out["ok"] and out["resident"] == 0
+    futs = [srv.submit("fib", [n]) for n in NS]
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == ref_results
+    assert srv.counters["trapped"] == 0 and srv.counters["killed"] == 0
+
+
+def test_reshard_with_hv_swapped_vlanes_rides_through(ref_results):
+    """Oversubscribed server: requests parked in the SwapStore at
+    reshard time reinstall onto the NEW geometry bit-identically."""
+    srv = _server(_conf(hv_virtual=16), lanes=6,
+                  devices=_mesh_devices(2))
+    futs = [srv.submit("fib", [n]) for n in NS]
+    for _ in range(8):
+        srv.step()
+        if srv.list_swapped():
+            break
+    assert srv.list_swapped(), "no vlane parked before the reshard"
+    out = srv.reshard(devices=_mesh_devices(4))
+    assert out["lanes"] == 8
+    assert srv.hv.lanes == 8           # hv pool resized with the move
+    assert srv.hv.virtual_cap == 16    # explicit cap survives
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == ref_results
+    hv = srv.hv_stats()
+    assert hv["swaps_out"] > 0 and hv["swaps_in"] > 0
+
+
+def test_reshard_with_compaction_permutation_applied(ref_results):
+    """A lane permutation already applied by the compactor is part of
+    the running state: it moves with the reshard, and the compactor
+    itself is rebuilt over the new geometry and keeps firing."""
+    srv = _server(_conf(compact=True, obs=True), lanes=6,
+                  devices=_mesh_devices(2))
+    futs = [srv.submit("fib", [n]) for n in NS]
+    for _ in range(8):
+        srv.step()
+        if any(e["name"] == "compact" for e in srv.obs.events):
+            break
+    assert any(e["name"] == "compact" for e in srv.obs.events), \
+        "no compaction fired before the reshard"
+    old_compactor = srv._compactor
+    srv.reshard(devices=_mesh_devices(4))
+    assert srv._compactor is not None
+    assert srv._compactor is not old_compactor
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == ref_results
+    assert any(e["name"] == "reshard" for e in srv.obs.events)
+
+
+def test_reshard_install_fault_rolls_back_then_retry_succeeds(
+        ref_results):
+    inj = FaultInjector([Fault(point="reshard_install", at=0)])
+    srv = _server(_conf(), lanes=6, devices=_mesh_devices(2),
+                  faults=inj)
+    futs = [srv.submit("fib", [n]) for n in NS]
+    for _ in range(2):
+        srv.step()
+    resident = srv.in_flight
+    with pytest.raises(InjectedFault):
+        srv.reshard(devices=_mesh_devices(4))
+    # fail-closed: the OLD mesh keeps serving, nothing dropped
+    assert srv.lanes == 6
+    assert srv.in_flight == resident
+    assert srv.counters["reshards"] == 0
+    assert inj.log == [("reshard_install", 0)]
+    out = srv.reshard(devices=_mesh_devices(4))   # arrival 1: clean
+    assert out["ok"] and srv.lanes == 8
+    srv.run_until_idle()
+    assert [f.result(0)[0] for f in futs] == ref_results
+    assert srv.counters["reshards"] == 1
+
+
+def test_gateway_reshard_rejects_bad_device_counts_pre_mutation():
+    from wasmedge_tpu.gateway.service import GatewayService
+
+    gw = GatewayService(conf=_conf(), lanes=4,
+                        devices=_mesh_devices(2))
+    try:
+        gw.register_module("fib", build_fib())
+        reqs = [gw.submit("fib", [n], module="fib") for n in NS[:4]]
+        with pytest.raises(ValueError):
+            gw.reshard(n_devices=64)   # more than the mesh has
+        with pytest.raises(ValueError):
+            gw.reshard(n_devices=0)
+        assert gw.status()["devices"] == 2   # nothing moved
+        assert gw.status()["reshards"] == {}
+        gw.current.server.run_until_idle()
+        assert [r.future.result(5)[0] for r in reqs] \
+            == [_fib(n) for n in NS[:4]]
+    finally:
+        gw.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# gateway tier: service-level reshard + metrics
+# ---------------------------------------------------------------------------
+def test_gateway_reshard_moves_generation_and_future_builds(
+        ref_results):
+    from wasmedge_tpu.gateway.service import GatewayService
+    from wasmedge_tpu.obs.metrics import parse_prometheus
+
+    gw = GatewayService(conf=_conf(), lanes=6,
+                        devices=_mesh_devices(2))
+    try:
+        gw.register_module("fib", build_fib())
+        reqs = [gw.submit("fib", [n], module="fib") for n in NS]
+        out = gw.reshard(n_devices=4)
+        assert out["ok"] and out["direction"] == "grow"
+        assert out["lanes"] == 8
+        srv = gw.current.server
+        srv.run_until_idle()
+        assert [r.future.result(5)[0] for r in reqs] == ref_results
+        st = gw.status()
+        assert st["devices"] == 4
+        assert st["reshards"] == {"grow": 1}
+        assert st["lanes"] == 8        # future generations inherit
+        m = parse_prometheus(gw.metrics_text())
+        assert ("wasmedge_reshards_total",
+                frozenset({("direction", "grow")})) in m
+        # a second registration builds AT the resharded geometry
+        gw.register_module("fib2", build_fib())
+        assert gw.current.server.lanes == 8
+        assert gw.current.server.engine.mesh is not None
+    finally:
+        gw.shutdown()
+
+
+def test_gateway_without_reshard_emits_no_reshard_series():
+    from wasmedge_tpu.gateway.service import GatewayService
+    from wasmedge_tpu.obs.metrics import render_prometheus
+
+    gw = GatewayService(conf=_conf(), lanes=2)
+    try:
+        gw.register_module("fib", build_fib())
+        text = gw.metrics_text()
+        assert "wasmedge_reshards_total" not in text
+        assert "wasmedge_autoscale" not in text
+        assert gw.autoscale is None
+        assert "autoscale" not in gw.status()
+        assert "reshards" not in gw.current.server.counters or \
+            gw.current.server.counters["reshards"] == 0
+    finally:
+        gw.shutdown()
+    # and the bare renderer stays r16-shaped with the new args absent
+    assert "wasmedge_reshards" not in render_prometheus()
+    assert "wasmedge_autoscale" not in render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# autoscale: the deterministic spike/calm ladder
+# ---------------------------------------------------------------------------
+class _StubHv:
+    def __init__(self, virtual_cap):
+        self.virtual_cap = virtual_cap
+
+
+class _StubServer:
+    def __init__(self, lanes=4, hv_cap=4, queue_cap=16):
+        import threading
+
+        self.lanes = lanes
+        self.hv = _StubHv(hv_cap)
+        self.queue = []
+        self.in_flight = 0
+        self._lock = threading.Lock()
+        self.k = type("K", (), {"queue_capacity": queue_cap})()
+
+
+class _StubSvc:
+    """Just enough GatewayService surface for the controller: the
+    ladder's decisions are pure functions of these signals, so the
+    stub makes every branch deterministic and instant."""
+
+    def __init__(self, srv, devices=2):
+        from wasmedge_tpu.obs.recorder import NULL_RECORDER
+
+        self._srv = srv
+        self.devices = list(range(devices)) if devices > 1 else None
+        self.force_degraded = False
+        self.obs = NULL_RECORDER
+        self.resharded_to = []
+        self.reshard_fails = False
+        self.current = type("G", (), {"server": srv})()
+
+    def reshard(self, n_devices=None, devices=None):
+        if self.reshard_fails:
+            raise RuntimeError("reshard rolled back")
+        self.resharded_to.append(n_devices)
+        self.devices = list(range(n_devices)) if n_devices > 1 else None
+        return {"ok": True, "lanes": self._srv.lanes}
+
+
+def _ctl(svc, **kw):
+    from wasmedge_tpu.gateway.autoscale import (AutoscaleConfig,
+                                                AutoscaleController)
+
+    kw.setdefault("enabled", True)
+    kw.setdefault("auto_tick", False)
+    kw.setdefault("cooldown_ticks", 0)
+    return AutoscaleController(svc, AutoscaleConfig(**kw))
+
+
+def test_autoscale_spike_ladder_virtual_then_reshard_then_shed():
+    srv = _StubServer(lanes=4, hv_cap=4, queue_cap=16)
+    svc = _StubSvc(srv, devices=2)
+    ctl = _ctl(svc, max_virtual_factor=2.0, device_ladder=[2, 4])
+    srv.queue = [None] * 16            # saturated
+    assert ctl.tick() == "raise_virtual"
+    assert srv.hv.virtual_cap == 8     # +lanes, clamped at 2.0x
+    assert ctl.tick() == "reshard_grow"
+    assert svc.resharded_to == [4]
+    assert ctl.tick() == "shed"        # ladder exhausted
+    assert svc.force_degraded is True
+    assert ctl.tick() is None          # already shedding: nothing left
+    assert ctl.actions == {"raise_virtual": 1, "lower_virtual": 0,
+                           "reshard_grow": 1, "reshard_shrink": 0,
+                           "shed": 1, "unshed": 0}
+
+
+def test_autoscale_calm_ladder_reverses_and_restores_base():
+    srv = _StubServer(lanes=4, hv_cap=4, queue_cap=16)
+    svc = _StubSvc(srv, devices=2)
+    ctl = _ctl(svc, max_virtual_factor=2.0, device_ladder=[2, 4])
+    srv.queue = [None] * 16
+    for _ in range(3):
+        ctl.tick()                     # raise + grow + shed
+    srv.queue = []                     # traffic gone
+    srv.in_flight = 0
+    assert ctl.tick() == "unshed"
+    assert svc.force_degraded is False
+    assert ctl.tick() == "reshard_shrink"
+    assert svc.resharded_to == [4, 2]
+    assert ctl.tick() == "lower_virtual"
+    assert srv.hv.virtual_cap == 4     # back at the recorded base
+    assert ctl.tick() is None          # fully unwound
+
+
+def test_autoscale_cooldown_holds_between_actions():
+    srv = _StubServer(lanes=4, hv_cap=4, queue_cap=16)
+    svc = _StubSvc(srv, devices=2)
+    ctl = _ctl(svc, cooldown_ticks=2, max_virtual_factor=4.0)
+    srv.queue = [None] * 16
+    assert ctl.tick() == "raise_virtual"
+    assert ctl.tick() is None          # cooldown 2
+    assert ctl.tick() is None          # cooldown 1
+    assert ctl.tick() == "raise_virtual"
+
+
+def test_autoscale_failed_reshard_falls_through_to_shed():
+    srv = _StubServer(lanes=4, hv_cap=8, queue_cap=16)   # hv at ceil
+    svc = _StubSvc(srv, devices=2)
+    svc.reshard_fails = True
+    ctl = _ctl(svc, max_virtual_factor=2.0, device_ladder=[2, 4])
+    srv.queue = [None] * 16
+    assert ctl.tick() == "shed"        # rollback absorbed, degrade
+    assert svc.force_degraded is True
+
+
+def test_autoscale_in_band_takes_no_action():
+    srv = _StubServer(lanes=4, hv_cap=4, queue_cap=16)
+    svc = _StubSvc(srv, devices=2)
+    ctl = _ctl(svc, device_ladder=[2, 4])
+    srv.queue = [None] * 8             # 50%: between watermarks
+    assert ctl.tick() is None
+    assert ctl.actions["raise_virtual"] == 0
+
+
+def test_autoscale_actions_render_as_metrics():
+    from wasmedge_tpu.obs.metrics import (parse_prometheus,
+                                          render_prometheus)
+
+    srv = _StubServer()
+    svc = _StubSvc(srv)
+    ctl = _ctl(svc, max_virtual_factor=2.0)
+    srv.queue = [None] * 16
+    ctl.tick()
+    m = parse_prometheus(render_prometheus(
+        autoscale_actions=dict(ctl.actions)))
+    assert m[("wasmedge_autoscale_actions_total",
+              frozenset({("action", "raise_virtual")}))] == 1.0
+    assert ("wasmedge_autoscale_actions_total",
+            frozenset({("action", "shed")})) in m
+
+
+def test_gateway_constructs_controller_only_when_enabled():
+    from wasmedge_tpu.gateway.autoscale import AutoscaleConfig
+    from wasmedge_tpu.gateway.service import GatewayService
+
+    off = GatewayService(conf=_conf(), lanes=2,
+                         autoscale=AutoscaleConfig(enabled=False))
+    try:
+        assert off.autoscale is None   # r16 identity by construction
+    finally:
+        off.shutdown()
+    on = GatewayService(conf=_conf(), lanes=2,
+                        autoscale=AutoscaleConfig(
+                            enabled=True, auto_tick=False))
+    try:
+        assert on.autoscale is not None
+        assert on.autoscale._thread is None   # manual-tick: no timer
+        assert on.status()["autoscale"]["enabled"] is True
+        assert "wasmedge_autoscale_actions_total" in on.metrics_text()
+    finally:
+        on.shutdown()

@@ -689,3 +689,207 @@ def test_fleet_metrics_render_and_parse(fleet_pair):
     from wasmedge_tpu.obs.metrics import render_prometheus
 
     assert "wasmedge_fleet" not in render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# elastic membership (r21): gossip join/leave, owner hints, churn health
+# ---------------------------------------------------------------------------
+def _solo(peers=(), faults=None, **fleet_kw):
+    svc = GatewayService(conf=_conf(), lanes=2, faults=faults,
+                         fleet=_fleet_cfg(peers, **fleet_kw))
+    return Gateway(svc, port=0).start()
+
+
+def test_join_gossips_to_full_fleet_convergence():
+    """A new gateway announces itself to ONE seed and the whole fleet
+    learns it: the seed's bumped membership view rides every heartbeat
+    until the views converge (same epoch, same member set), and the
+    joined peer is rendezvous-routable everywhere."""
+    gw_a = _solo()                                  # seed, no peers
+    addr_a = f"{gw_a.host}:{gw_a.port}"
+    gw_b = _solo([addr_a])
+    gw_c = None
+    try:
+        fa, fb = gw_a.service.fleet, gw_b.service.fleet
+        fb.tick()                                   # B introduces itself
+        assert fa.view.epoch == 1                   # join = origin event
+        assert sorted(fa.members()) == sorted([fa.self_id, fb.self_id])
+        gw_c = _solo([addr_a])                      # C joins via seed A
+        fc = gw_c.service.fleet
+        fc.tick()
+        assert fa.view.epoch == 2
+        # C learned B from A's heartbeat RESPONSE (gossip piggyback)
+        assert sorted(fc.members()) \
+            == sorted([fa.self_id, fb.self_id, fc.self_id])
+        fb.tick()                                   # B pulls the view
+        assert sorted(fb.members()) == sorted(fc.members())
+        assert fb.view.epoch == fc.view.epoch == fa.view.epoch == 2
+        assert fb.counters["gossip_merges"] > 0
+        # a clean join NEVER trips fleet degradation (satellite: churn
+        # vs genuine loss) — no gateway's fleet check goes unhealthy
+        # (these bare gateways serve no modules, so overall status
+        # reflects the generation check; the FLEET check is the pin)
+        for gw in (gw_a, gw_b, gw_c):
+            checks = gw.service.health()["checks"]
+            assert checks.get("fleet", {"ok": True})["ok"]
+    finally:
+        for gw in (gw_c, gw_b, gw_a):
+            if gw is not None:
+                gw.shutdown()
+
+
+def test_leave_unroutes_peer_and_health_stays_clean():
+    """POST /v1/fleet/leave: the departing gateway broadcasts its own
+    departure; survivors drop it from the rendezvous universe, report
+    it as churn (never degradation), and refuse to resurrect the
+    departed identity when it heartbeats again."""
+    gw_a = _solo()
+    addr_a = f"{gw_a.host}:{gw_a.port}"
+    gw_b = _solo([addr_a])
+    try:
+        fa, fb = gw_a.service.fleet, gw_b.service.fleet
+        fb.tick()                                   # join handshake
+        assert fb.self_id in fa.members()
+        st, doc, _ = rpc(gw_b, "POST", "/v1/fleet/leave", body={})
+        assert st == 200 and doc["ok"] and doc["peer_id"] == fb.self_id
+        assert fb.self_left
+        # the direct broadcast already unrouted B on A
+        assert fa.members() == [fa.self_id]
+        assert fa.view.status_of(fb.self_id) == "left"
+        snap = fa.stats()
+        assert snap["left_peers"] == 1
+        # left is expected absence: the departed peer leaves the
+        # fleet-capacity tally entirely (no fleet check remains for a
+        # fleet whose only peer left), and the churn check SHOWS the
+        # departure without ever failing
+        h = gw_a.service.health()
+        assert "fleet" not in h["checks"]
+        assert "churn" in h["checks"] and h["checks"]["churn"]["ok"]
+        assert "left" in h["checks"]["churn"]["detail"]
+        # a duplicate leave is a dedup ack, not a second epoch bump
+        epoch = fa.view.epoch
+        st, doc, _ = rpc(gw_a, "POST", "/v1/fleet/leave",
+                         body={"peer_id": fb.self_id})
+        assert st == 200 and doc.get("dedup") is True
+        assert fa.view.epoch == epoch
+        # left dominates: the departed identity heartbeating again
+        # stays unroutable (a rejoin is a NEW host:port identity)
+        fb.tick()
+        assert fa.members() == [fa.self_id]
+    finally:
+        gw_b.shutdown()
+        gw_a.shutdown()
+
+
+def test_owner_hint_redirects_poll_on_non_owner(fleet_pair):
+    """Satellite pin: GET /v1/requests/<id> on a gateway that never
+    accepted the id answers 404 with a machine-readable owner_hint
+    (303-style) naming the id's rendezvous owner — so a client whose
+    issuing peer died knows WHERE to poll."""
+    gw_a, gw_b = fleet_pair
+    fb = gw_b.service.fleet
+    members = fb.members()
+    assert len(members) >= 2
+    owner_a = next(rid for rid in range(10 ** 9, 10 ** 9 + 4096)
+                   if rendezvous_owner(rid, members) != fb.self_id)
+    st, doc, _ = rpc(gw_b, "GET", f"/v1/requests/{owner_a}")
+    assert st == 404
+    err = doc["err"]
+    assert err["detail"] == "not_owner" and err["retryable"] is True
+    hint = err["owner_hint"]
+    assert hint["peer"] == rendezvous_owner(owner_a, members)
+    assert hint["url"] and "membership_epoch" in hint
+    # an unknown id this gateway ITSELF owns gets the plain 404 (no
+    # hint to give — polling elsewhere would not help)
+    owned = next(rid for rid in range(10 ** 9, 10 ** 9 + 4096)
+                 if rendezvous_owner(rid, members) == fb.self_id)
+    st, doc, _ = rpc(gw_b, "GET", f"/v1/requests/{owned}")
+    assert st == 404
+    assert "owner_hint" not in doc.get("err", {})
+
+
+def test_membership_gossip_drop_delays_but_never_breaks_convergence():
+    """The membership_gossip fault seam drops exactly one piggybacked
+    view merge: the heartbeat it rode still counts for liveness, and
+    the next exchange re-gossips — convergence is delayed, never
+    broken (the CRDT merge is order/loss tolerant)."""
+    from wasmedge_tpu.testing.faults import churn_schedule
+
+    sched = churn_schedule(seed=7, gossip_drops=2, max_at=0)
+    assert all(f.point == "membership_gossip" and f.at == 0
+               for f in sched)
+    gw_a = _solo()
+    addr_a = f"{gw_a.host}:{gw_a.port}"
+    inj = FaultInjector([Fault(point="membership_gossip", at=0,
+                               times=2)])
+    gw_b = _solo([addr_a], faults=inj)
+    gw_c = None
+    try:
+        fa, fb = gw_a.service.fleet, gw_b.service.fleet
+        gw_c = _solo([addr_a])
+        gw_c.service.fleet.tick()                   # A knows C
+        fb.tick()                                   # drop 1
+        assert fb.counters["heartbeats_ok"] == 1    # liveness intact
+        assert fb.counters["gossip_merges"] == 0
+        assert gw_c.service.fleet.self_id not in fb.members()
+        fb.tick()                                   # drop 2
+        fb.tick()                                   # goes through
+        assert gw_c.service.fleet.self_id in fb.members()
+        assert fb.view.epoch == fa.view.epoch
+        assert inj.fired == 2
+    finally:
+        for gw in (gw_c, gw_b, gw_a):
+            if gw is not None:
+                gw.shutdown()
+
+
+def test_joining_peer_grace_window_is_churn_not_degradation():
+    """A runtime-joined peer that goes quiet inside its churn grace
+    window reads as 'joining' (it may still be compiling its first
+    generation) — health stays clean.  Past the window, the same
+    silence is genuine degradation."""
+    gw_a = _solo(churn_grace_s=1.5)
+    addr_a = f"{gw_a.host}:{gw_a.port}"
+    gw_b = _solo([addr_a])
+    try:
+        fa, fb = gw_a.service.fleet, gw_b.service.fleet
+        fb.tick()                                   # B joins A
+        gw_b.shutdown()                             # ...and vanishes
+        fa.tick()
+        fa.tick()                                   # misses -> suspect
+        snap = fa.stats()
+        assert snap["peers"]["joining"] == 1        # inside the window
+        assert snap["peers"]["suspect"] == 0
+        h = gw_a.service.health()
+        assert h["checks"]["fleet"]["ok"]           # churn, not loss
+        assert h["checks"]["churn"]["ok"]
+        time.sleep(1.6)                             # window expires
+        snap = fa.stats()
+        assert snap["peers"]["joining"] == 0
+        assert snap["peers"]["suspect"] + snap["peers"]["dead"] == 1
+        assert not gw_a.service.health(fresh=True)["checks"]["fleet"]["ok"]
+    finally:
+        gw_a.shutdown()
+
+
+def test_membership_epoch_metric_and_static_fleet_stays_epoch_zero(
+        fleet_pair):
+    from wasmedge_tpu.obs.metrics import parse_prometheus
+
+    gw_a, gw_b = fleet_pair
+    st, text, _ = rpc(gw_b, "GET", "/metrics")
+    assert st == 200
+    m = parse_prometheus(text if isinstance(text, str)
+                         else text.decode())
+    key = ("wasmedge_fleet_membership_epoch", frozenset())
+    assert key in m
+    # the shared pair's A side admits B at runtime (asymmetric list):
+    # the epoch is whatever the views converged to — both sides agree
+    assert m[key] == float(gw_a.service.fleet.view.epoch)
+    # a fleet whose peers all arrive boot-configured never bumps:
+    # static membership is bit-identical to r16 (epoch 0 forever)
+    gw_s = _solo()
+    try:
+        assert gw_s.service.fleet.view.epoch == 0
+    finally:
+        gw_s.shutdown()

@@ -19,12 +19,14 @@ from wasmedge_tpu.fleet.federation import (
     PeerSuspect,
     ReplicationFailed,
 )
+from wasmedge_tpu.fleet.membership import MembershipView
 from wasmedge_tpu.fleet.peer import PeerClient, PeerState, PeerUnreachable
 from wasmedge_tpu.fleet.routing import rendezvous_owner, rendezvous_ranked
 
 __all__ = [
     "FleetConfig",
     "FleetController",
+    "MembershipView",
     "PeerSuspect",
     "ReplicationFailed",
     "PeerClient",

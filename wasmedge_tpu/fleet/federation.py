@@ -3,7 +3,13 @@
 One controller rides one GatewayService (gateway/service.py) and owns
 everything multi-host (r16 tentpole):
 
-  membership      static peer list (CLI --peer / FleetConfig.peers),
+  membership      a seed peer list (CLI --peer / FleetConfig.peers)
+                  plus GOSSIP dynamic membership (r21,
+                  fleet/membership.py): an epoch-stamped view
+                  piggybacks on every heartbeat, a joining peer
+                  announces itself to any seed and the view gossips
+                  until convergence, POST /v1/fleet/leave departs a
+                  member (left dominates up — no resurrection);
                   liveness via the heartbeat loop's suspect→dead state
                   machine with exponential probe backoff
                   (fleet/peer.py); a one-host fleet (no peers) is
@@ -46,9 +52,12 @@ everything multi-host (r16 tentpole):
                   is never lost mid-migration)
 
 Fault seams (testing/faults.py): `peer_send` before every outbound
-peer request, `peer_recv` on receipt of every inbound one, and
-`peer_heartbeat` before each liveness probe — `partition_schedule`
-builds deterministic one-directional link cuts from them.
+peer request, `peer_recv` on receipt of every inbound one,
+`peer_heartbeat` before each liveness probe, and `membership_gossip`
+before a remote membership view is merged (an injected fault drops
+exactly that gossip message; the heartbeat it rode on still counts) —
+`partition_schedule` builds deterministic one-directional link cuts
+from them and `churn_schedule` deterministic join/leave storms.
 """
 
 from __future__ import annotations
@@ -60,6 +69,7 @@ import uuid
 from typing import Dict, List, Optional
 
 from wasmedge_tpu.common.errors import EngineFailure, ErrCode, WasmError
+from wasmedge_tpu.fleet.membership import MembershipView
 from wasmedge_tpu.fleet.peer import (
     BACKOFF_BASE_S,
     DEAD_AFTER,
@@ -115,6 +125,7 @@ class FleetConfig:
                  backoff_base_s: float = BACKOFF_BASE_S,
                  replicate_min_interval_s: float = 0.05,
                  request_timeout_s: float = 10.0,
+                 churn_grace_s: float = 2.0,
                  auto_tick: bool = True):
         self.peers = [str(p) for p in peers]
         self.self_id = self_id
@@ -124,6 +135,10 @@ class FleetConfig:
         self.backoff_base_s = float(backoff_base_s)
         self.replicate_min_interval_s = float(replicate_min_interval_s)
         self.request_timeout_s = float(request_timeout_s)
+        # a runtime-joined peer's probation window: inside it, missed
+        # heartbeats count as churn-in-progress (gateway/health.py),
+        # not degradation — a clean join must not trip shedding
+        self.churn_grace_s = float(churn_grace_s)
         # False = no background tick thread; the caller (deterministic
         # fault tests) drives tick() by hand so seam arrival counters
         # never race a timer
@@ -180,10 +195,17 @@ class FleetController:
         self._forwards: Dict[int, _Forward] = {}
         self._module_bytes: Dict[str, bytes] = {}
         self._thread: Optional[threading.Thread] = None
+        self._ticking = False
         self._stop = threading.Event()
         self._repl_doc: Optional[dict] = None
         self._repl_dirty = False
         self._repl_last = 0.0
+        # gossip membership (r21, fleet/membership.py): the epoch-
+        # stamped view every heartbeat carries.  self_left flips when
+        # THIS gateway announces departure — it keeps serving what it
+        # holds, peers stop routing to it
+        self.view = MembershipView()
+        self.self_left = False
         self.counters = {
             "heartbeats_ok": 0, "heartbeats_missed": 0,
             "modules_synced": 0, "module_conflicts": 0,
@@ -191,6 +213,7 @@ class FleetController:
             "forwards": 0, "forward_requeues": 0,
             "migrations_out": 0, "migrations_in": 0,
             "replication_errors": 0, "suspect_rejections": 0,
+            "joins": 0, "leaves": 0, "gossip_merges": 0,
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -204,26 +227,50 @@ class FleetController:
         self._client = PeerClient(self.self_id, faults=self.svc.faults,
                                   timeout_s=self.cfg.request_timeout_s)
         with self._lock:
+            # boot-configured membership lives at epoch 0 on every
+            # host (seeding is not an origin event — a static fleet
+            # keeps epoch 0 forever, bit-identical to r16)
+            self.view.members.setdefault(
+                self.self_id, {"url": self.self_url, "status": "up"})
             for url in self.cfg.peers:
                 pid = str(url)
                 if pid != self.self_id and pid not in self.peers:
                     self.peers[pid] = PeerState(pid, pid)
+                if pid != self.self_id:
+                    self.view.members.setdefault(
+                        pid, {"url": pid, "status": "up"})
+        if self.peers:
+            self._ensure_ticking()
+        return self
+
+    def _ensure_ticking(self):
+        """Become an ACTIVE fleet member: offset the id space and spawn
+        the heartbeat loop.  Runs once — at start() for a
+        boot-configured peer list, or at FIRST runtime admission for a
+        seed that booted with no peers (r21 dynamic join: a peerless
+        gateway is inert and bit-identical to a non-federated one, but
+        the moment another gateway announces itself the seed must
+        heartbeat back, or it would never probe the joiner, never gossip
+        the view onward, and never detect its death for adoption)."""
+        with self._lock:
+            if self._ticking:
+                return
+            self._ticking = True
         # fleet-unique id space: fresh ids allocate above a 40-bit
         # hash of the peer identity so two peers' original-id re-queues
-        # can never collide (adoption preserves ids across hosts)
+        # can never collide (adoption preserves ids across hosts; the
+        # advance is monotonic, so ids issued while solo stay valid)
         from wasmedge_tpu.serve.queue import advance_request_ids
 
-        if self.peers:
-            base = (int.from_bytes(
-                hashlib.sha256(self.self_id.encode()).digest()[:5],
-                "big") << 20)
-            advance_request_ids(base)
-        if self.peers and self.cfg.auto_tick and self._thread is None:
+        base = (int.from_bytes(
+            hashlib.sha256(self.self_id.encode()).digest()[:5],
+            "big") << 20)
+        advance_request_ids(base)
+        if self.cfg.auto_tick and self._thread is None:
             self._thread = threading.Thread(
                 target=self._run, daemon=True,
                 name=f"fleet:{self.self_id}")
             self._thread.start()
-        return self
 
     def stop(self):
         self._stop.set()
@@ -265,6 +312,7 @@ class FleetController:
             return {p.peer_id: {"url": p.url, "state": p.state,
                                 "streak": p.streak,
                                 "epoch": p.epoch,
+                                "left": p.left,
                                 "transitions": p.transitions}
                     for p in self.peers.values()}
 
@@ -309,12 +357,14 @@ class FleetController:
         heartbeat reuses the already-built stashed doc rather than
         taking a fresh svc-locked snapshot per probe, so a big result
         cache is serialized once per change, not once per heartbeat."""
+        with self._lock:
+            membership = self.view.to_doc()
+            doc = self._repl_doc
         out = {"peer_id": self.self_id, "epoch": self.epoch,
                "url": self.self_url,
                "generation": self.svc.generation,
-               "modules": self._manifest()}
-        with self._lock:
-            doc = self._repl_doc
+               "modules": self._manifest(),
+               "membership": membership}
         if doc is not None:
             out["journal"] = doc
         return out
@@ -340,6 +390,62 @@ class FleetController:
             if isinstance(doc.get("journal"), dict):
                 p.replica = doc["journal"]
             self.counters["heartbeats_ok"] += 1
+        self._merge_view(doc.get("membership"), src=p.peer_id)
+
+    def _merge_view(self, doc, src: Optional[str] = None):
+        """Fold a peer's membership view into ours (the gossip step).
+        The `membership_gossip` seam fires FIRST: an injected fault
+        drops exactly this gossip message — the heartbeat it rode on
+        still counted, and the next exchange re-gossips (convergence
+        is delayed, never broken).  Newly-learned up members get
+        PeerStates (probing + replication reach them on the next
+        tick); members the view marks left stop being routable."""
+        if not isinstance(doc, dict):
+            return
+        if self.svc.faults is not None:
+            try:
+                self.svc.faults.fire("membership_gossip",
+                                     src=src or "?", dst=self.self_id,
+                                     epoch=doc.get("epoch"))
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException:
+                return   # this gossip message was lost on the wire
+        now = time.monotonic()
+        joined, left = [], []
+        with self._lock:
+            if not self.view.merge(doc):
+                return
+            self.counters["gossip_merges"] += 1
+            for pid, info in self.view.members.items():
+                if pid == self.self_id:
+                    if info.get("status") == "left":
+                        self.self_left = True
+                    continue
+                p = self.peers.get(pid)
+                if info.get("status") == "left":
+                    if p is not None and not p.left:
+                        p.left = True
+                        left.append(pid)
+                    continue
+                if p is None:
+                    p = self.peers[pid] = PeerState(
+                        pid, str(info.get("url") or pid))
+                    p.joined_at = now
+                    joined.append(pid)
+            self.counters["joins"] += len(joined)
+            self.counters["leaves"] += len(left)
+            epoch = self.view.epoch
+        if joined:
+            self._ensure_ticking()
+        for pid in joined:
+            self.svc.obs.instant("fleet_join", cat="fleet",
+                                 track="fleet", peer=pid, epoch=epoch,
+                                 via=src)
+        for pid in left:
+            self.svc.obs.instant("fleet_leave", cat="fleet",
+                                 track="fleet", peer=pid, epoch=epoch,
+                                 via=src)
 
     def _note_miss(self, p: PeerState):
         now = time.monotonic()
@@ -371,14 +477,34 @@ class FleetController:
         self._recv("heartbeat", body.get("peer_id"))
         pid = str(body.get("peer_id", ""))
         if pid and pid != self.self_id:
+            admitted = False
             with self._lock:
                 p = self.peers.get(pid)
                 if p is None:
-                    # a configured-elsewhere peer introduced itself:
-                    # admit it (static lists on each side may be
-                    # asymmetric; membership still converges)
+                    # a peer introduced itself directly: admit it.
+                    # This is a membership ORIGIN event — the r21 join
+                    # path (a new gateway announces itself to any
+                    # seed) and the r16 asymmetric-static-list case
+                    # are the same mechanism; the bumped view gossips
+                    # out on every subsequent heartbeat until the
+                    # fleet converges
                     url = str(body.get("url") or pid)
                     p = self.peers[pid] = PeerState(pid, url)
+                    p.joined_at = time.monotonic()
+                    if self.view.add(pid, url):
+                        admitted = True
+                        self.counters["joins"] += 1
+                        epoch = self.view.epoch
+                    elif self.view.is_left(pid):
+                        # a departed identity heartbeating again: it
+                        # stays unroutable (left dominates; a rejoin
+                        # is a NEW host:port identity)
+                        p.left = True
+            if admitted:
+                self._ensure_ticking()
+                self.svc.obs.instant("fleet_join", cat="fleet",
+                                     track="fleet", peer=pid,
+                                     epoch=epoch, via="direct")
             self._note_ok(p, body)
         return self._hello()
 
@@ -412,6 +538,66 @@ class FleetController:
                     p.replica = body
                 p.last_seen = time.monotonic()
         return {"ok": True, "peer_id": self.self_id}
+
+    def on_leave(self, body: dict) -> dict:
+        """Inbound departure announcement (POST /v1/fleet/leave): mark
+        `peer_id` (default: the receiving gateway itself) as left — a
+        membership ORIGIN event.  A self-leave additionally broadcasts
+        one best-effort leave to every alive peer so the fleet stops
+        routing to us within a round trip instead of a gossip round;
+        either way the bumped view rides every later heartbeat."""
+        self._recv("leave", body.get("peer_id") or body.get("edge"))
+        pid = str(body.get("peer_id") or self.self_id)
+        changed = False
+        with self._lock:
+            if self.view.leave(pid):
+                changed = True
+                self.counters["leaves"] += 1
+                epoch = self.view.epoch
+                if pid == self.self_id:
+                    self.self_left = True
+                else:
+                    p = self.peers.get(pid)
+                    if p is not None:
+                        p.left = True
+            alive = [p for p in self.peers.values()
+                     if p.state == "alive" and not p.left] \
+                if changed and pid == self.self_id else []
+        if not changed:
+            return {"ok": True, "peer_id": pid, "dedup": True,
+                    "epoch": self.view.epoch}
+        self.svc.obs.instant("fleet_leave", cat="fleet", track="fleet",
+                             peer=pid, epoch=epoch, via="direct")
+        for p in alive:
+            try:
+                self._client.request(p.peer_id, p.url, "POST",
+                                     "/v1/fleet/leave",
+                                     body={"peer_id": pid,
+                                           "edge": self.self_id})
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException:
+                pass   # gossip on the next heartbeat converges it
+        return {"ok": True, "peer_id": pid, "epoch": epoch}
+
+    def owner_hint(self, request_id: int) -> Optional[dict]:
+        """Poll-redirection hint for GET /v1/requests/<id> on a
+        non-owner: where the id's rendezvous owner currently lives, so
+        a client whose issuing peer died polls THERE instead of trying
+        survivors blindly.  None when the hint is this gateway itself
+        (no redirection to give) or the fleet is inert."""
+        if not self.started or not self.remote_available():
+            return None
+        rid = int(request_id)
+        owner = rendezvous_owner(rid, self.members())
+        if owner == self.self_id:
+            return None
+        with self._lock:
+            p = self.peers.get(owner)
+            url = p.url if p is not None else self.view.url_of(owner)
+            epoch = self.view.epoch
+        return {"peer": owner, "url": url or owner,
+                "membership_epoch": epoch}
 
     def on_execute(self, body: dict):
         """Inbound routed request: execute locally under the edge's
@@ -549,8 +735,12 @@ class FleetController:
                "unresolved": list(unresolved),
                "resolved": list(resolved)}
         with self._lock:
+            # strict replication targets the CURRENT membership view:
+            # a mid-churn acceptance lands on peers that will still be
+            # fleet members after the churn settles (left peers are
+            # about to disappear — a copy there survives nothing)
             alive = [p for p in self.peers.values()
-                     if p.state == "alive"]
+                     if p.state == "alive" and not p.left]
             self._repl_doc = doc
             self._repl_dirty = True
         if not strict:
@@ -594,7 +784,7 @@ class FleetController:
             doc = self._repl_doc
             self._repl_dirty = False
             alive = [p for p in self.peers.values()
-                     if p.state == "alive"]
+                     if p.state == "alive" and not p.left]
         self._repl_last = time.monotonic()
         for p in alive:
             self._send_replica(p, doc)
@@ -874,14 +1064,32 @@ class FleetController:
 
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:
+        now = time.monotonic()
         with self._lock:
-            by_state = {"alive": 0, "suspect": 0, "dead": 0}
+            by_state = {"alive": 0, "suspect": 0, "dead": 0,
+                        "joining": 0}
+            n_left = 0
             for p in self.peers.values():
+                if p.left:
+                    n_left += 1
+                    continue   # departed members leave the liveness
+                #                tally (health reads it for shedding)
+                if p.state != "alive" and p.joined_at is not None \
+                        and now - p.joined_at < self.cfg.churn_grace_s:
+                    # a runtime join inside its probation window:
+                    # missed probes here are churn-in-progress (the
+                    # peer may still be compiling its first
+                    # generation), not degradation
+                    by_state["joining"] += 1
+                    continue
                 by_state[p.state] = by_state.get(p.state, 0) + 1
             return {
                 "self_id": self.self_id,
                 "epoch": self.epoch,
+                "membership_epoch": self.view.epoch,
                 "peers": dict(by_state),
+                "left_peers": n_left,
+                "self_left": self.self_left,
                 "configured_peers": len(self.peers),
                 "forwards_outstanding": len(self._forwards),
                 **self.counters,

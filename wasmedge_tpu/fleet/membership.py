@@ -1,0 +1,130 @@
+"""Epoch-stamped gossip membership (r21).
+
+The fleet's peer set stops being a list frozen at boot: every
+controller carries a `MembershipView` — a monotone epoch plus a
+member table `{peer_id: {"url", "status"}}` — piggybacked on the
+existing heartbeat exchange.  A joining peer announces itself to any
+seed; the seed admits it (an ORIGIN event: epoch bumps), and the new
+view gossips outward on every subsequent heartbeat until the fleet
+converges.  A leave is the other origin event: the member's status
+flips to "left" and the epoch bumps.
+
+The merge is a join-semilattice, so gossip converges regardless of
+message order or loss:
+
+  * epoch      = max(ours, theirs)
+  * member set = union
+  * status     = "left" dominates "up" (a departed peer can never be
+                 resurrected by a stale view that still says "up" —
+                 peer ids are host:port incarnations, a rejoin is a
+                 NEW identity)
+
+Merging a remote view never bumps the epoch — only origin events do.
+A fleet whose membership never changes therefore keeps epoch 0
+forever, and the static-membership configuration is behaviorally
+identical to r16.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["MembershipView"]
+
+
+class MembershipView:
+    """The convergent membership CRDT one controller carries.
+
+    Not thread-safe by itself — the FleetController serializes all
+    mutation under its own lock (heartbeats, leaves, and admissions
+    all run on the controller's tick/HTTP paths)."""
+
+    __slots__ = ("epoch", "members")
+
+    def __init__(self):
+        self.epoch = 0
+        self.members: Dict[str, dict] = {}
+
+    # -- origin events (the ONLY places the epoch advances) ---------------
+    def add(self, peer_id: str, url: Optional[str] = None) -> bool:
+        """Admit `peer_id` as an up member.  Returns True (and bumps
+        the epoch) only when this is NEW information — re-admitting a
+        known up member is a no-op, and a departed member stays
+        departed (left dominates)."""
+        cur = self.members.get(peer_id)
+        if cur is not None:
+            if cur.get("status") == "left":
+                return False
+            if url and not cur.get("url"):
+                cur["url"] = url   # learned the address; not an event
+            return False
+        self.members[peer_id] = {"url": url, "status": "up"}
+        self.epoch += 1
+        return True
+
+    def leave(self, peer_id: str) -> bool:
+        """Mark `peer_id` departed.  Returns True (and bumps the
+        epoch) when the member was present and not already left."""
+        cur = self.members.get(peer_id)
+        if cur is None or cur.get("status") == "left":
+            return False
+        cur["status"] = "left"
+        self.epoch += 1
+        return True
+
+    # -- gossip ------------------------------------------------------------
+    def merge(self, doc) -> bool:
+        """Fold a remote view into this one (max epoch, member union,
+        left dominates).  Returns whether anything changed.  Malformed
+        docs are ignored — gossip must never take a controller down."""
+        if not isinstance(doc, dict):
+            return False
+        changed = False
+        remote_epoch = doc.get("epoch")
+        if isinstance(remote_epoch, int) and remote_epoch > self.epoch:
+            self.epoch = remote_epoch
+            changed = True
+        remote = doc.get("members")
+        if not isinstance(remote, dict):
+            return changed
+        for pid, info in remote.items():
+            if not isinstance(pid, str) or not isinstance(info, dict):
+                continue
+            status = info.get("status")
+            if status not in ("up", "left"):
+                continue
+            url = info.get("url")
+            cur = self.members.get(pid)
+            if cur is None:
+                self.members[pid] = {"url": url, "status": status}
+                changed = True
+            else:
+                if status == "left" and cur.get("status") != "left":
+                    cur["status"] = "left"
+                    changed = True
+                if url and not cur.get("url"):
+                    cur["url"] = url
+                    changed = True
+        return changed
+
+    # -- queries -----------------------------------------------------------
+    def status_of(self, peer_id: str) -> Optional[str]:
+        cur = self.members.get(peer_id)
+        return cur.get("status") if cur is not None else None
+
+    def is_left(self, peer_id: str) -> bool:
+        return self.status_of(peer_id) == "left"
+
+    def url_of(self, peer_id: str) -> Optional[str]:
+        cur = self.members.get(peer_id)
+        return cur.get("url") if cur is not None else None
+
+    def up_members(self):
+        return [pid for pid, info in self.members.items()
+                if info.get("status") == "up"]
+
+    def to_doc(self) -> dict:
+        return {"epoch": self.epoch,
+                "members": {pid: {"url": info.get("url"),
+                                  "status": info.get("status")}
+                            for pid, info in self.members.items()}}

@@ -132,7 +132,7 @@ class PeerState:
 
     __slots__ = ("peer_id", "url", "state", "streak", "last_seen",
                  "next_probe", "epoch", "replica", "adopted_epoch",
-                 "modules", "transitions")
+                 "modules", "transitions", "left", "joined_at")
 
     def __init__(self, peer_id: str, url: str):
         self.peer_id = peer_id
@@ -146,14 +146,23 @@ class PeerState:
         self.adopted_epoch: Optional[str] = None
         self.modules: list = []        # last manifest [{name, sha256}]
         self.transitions = 0           # state changes (flap visibility)
+        # gossip membership (r21): a departed member is excluded from
+        # routing and health accounting but still probed — its eventual
+        # death must trigger normal journal adoption for any ids it
+        # accepted before leaving.  `joined_at` is None for a
+        # boot-configured peer and the monotonic admission time for a
+        # runtime join (health.py grants it a churn grace window).
+        self.left = False
+        self.joined_at: Optional[float] = None
 
     def available(self) -> bool:
         """Routable: requests may be owned by (and forwarded to) this
         peer.  Suspect peers stay in the membership view so routing is
         stable across a flap — but a submit routed to one is refused
         retryably (fleet/federation.py PeerSuspect) rather than
-        forwarded into a probable black hole."""
-        return self.state != "dead"
+        forwarded into a probable black hole.  A departed (left)
+        member is never routable, whatever its liveness."""
+        return self.state != "dead" and not self.left
 
     def note_ok(self, now: float, epoch: Optional[str]) -> bool:
         """Record a successful probe; returns True when the peer came

@@ -1,0 +1,240 @@
+"""Traffic-driven autoscale policy (r21 tentpole leg c).
+
+A deterministic controller rides one GatewayService and drives the
+three capacity actuators the stack already exposes, from the
+queue-depth/occupancy signals the obs layer already exports:
+
+  raise_virtual    grow the hv oversubscription ratio (admission
+                   headroom IS the virtual-lane cap — hv admission
+                   gates on it) up to `max_virtual_factor` × the
+                   physical lane pool
+  reshard_grow     recruit devices: a live reshard of the running
+                   generation up the `device_ladder`
+                   (gateway/service.py reshard — no drain)
+  shed             last resort under sustained saturation with no
+                   capacity left to recruit: flip the gateway into
+                   degraded-mode shedding (gateway/health.py —
+                   lowest-weight tier rejected 429-retryable at the
+                   edge) instead of timing everyone out
+
+and the reverse ladder when traffic calms: `unshed`, then
+`reshard_shrink` back down the ladder, then `lower_virtual`.
+
+The controller is DETERMINISTIC and cheap: one `tick()` reads the
+queue ratio + occupancy, takes at most ONE action, and then holds for
+`cooldown_ticks` — tests drive `tick()` by hand (auto_tick=False) and
+assert the exact action sequence; production runs it on a small timer
+thread.  `enabled=False` (the default) constructs nothing: the
+autoscale-off configuration is behaviorally identical to r16 by
+construction.
+
+Every action increments `actions["<name>"]` — rendered as
+`wasmedge_autoscale_actions_total{action=...}` (obs/metrics.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+__all__ = ["AutoscaleConfig", "AutoscaleController"]
+
+
+class AutoscaleConfig:
+    """Policy knobs.  `device_ladder` is the ordered device-count
+    rungs reshard actions walk (e.g. [2, 4, 8]); empty disables
+    reshard actions.  Watermarks are queued/capacity ratios."""
+
+    def __init__(self, enabled: bool = False,
+                 tick_s: float = 0.5,
+                 high_queue_ratio: float = 0.75,
+                 low_queue_ratio: float = 0.10,
+                 cooldown_ticks: int = 4,
+                 max_virtual_factor: float = 4.0,
+                 virtual_step: Optional[int] = None,
+                 device_ladder: Optional[List[int]] = None,
+                 shed_when_exhausted: bool = True,
+                 auto_tick: bool = True):
+        self.enabled = bool(enabled)
+        self.tick_s = float(tick_s)
+        self.high_queue_ratio = float(high_queue_ratio)
+        self.low_queue_ratio = float(low_queue_ratio)
+        self.cooldown_ticks = int(cooldown_ticks)
+        self.max_virtual_factor = float(max_virtual_factor)
+        # virtual-cap increment per raise action; None = one physical
+        # pool width per step
+        self.virtual_step = virtual_step
+        self.device_ladder = sorted(int(d) for d in device_ladder) \
+            if device_ladder else []
+        self.shed_when_exhausted = bool(shed_when_exhausted)
+        self.auto_tick = bool(auto_tick)
+
+
+class AutoscaleController:
+    """One deterministic control loop over a GatewayService."""
+
+    def __init__(self, svc, cfg: AutoscaleConfig):
+        self.svc = svc
+        self.cfg = cfg
+        self.actions = {"raise_virtual": 0, "lower_virtual": 0,
+                        "reshard_grow": 0, "reshard_shrink": 0,
+                        "shed": 0, "unshed": 0}
+        self.last_action: Optional[str] = None
+        self._cooldown = 0
+        self._base_virtual: Optional[int] = None
+        self._shedding = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if not self.cfg.enabled or not self.cfg.auto_tick \
+                or self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="gw-autoscale")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                pass   # one bad tick never kills the loop
+            self._stop.wait(self.cfg.tick_s)
+
+    # -- signals -----------------------------------------------------------
+    def _signals(self):
+        """(server, queue_ratio, occupancy) of the CURRENT generation,
+        or None while nothing serves."""
+        gen = self.svc.current
+        if gen is None:
+            return None
+        srv = gen.server
+        cap = max(int(srv.k.queue_capacity), 1)
+        ratio = len(srv.queue) / cap
+        occ = srv.in_flight / max(srv.lanes, 1)
+        return srv, ratio, occ
+
+    # -- the ladder --------------------------------------------------------
+    def tick(self) -> Optional[str]:
+        """One control round: read signals, take at most one action,
+        hold through the cooldown.  Returns the action taken (None
+        when holding or in band) — tests assert on this directly."""
+        if not self.cfg.enabled:
+            return None
+        sig = self._signals()
+        if sig is None:
+            return None
+        srv, ratio, occ = sig
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        action = None
+        if ratio >= self.cfg.high_queue_ratio:
+            action = self._spike(srv)
+        elif ratio <= self.cfg.low_queue_ratio:
+            action = self._calm(srv, occ)
+        if action is not None:
+            self.actions[action] += 1
+            self.last_action = action
+            self._cooldown = self.cfg.cooldown_ticks
+            self.svc.obs.instant("autoscale", cat="gateway",
+                                 track="gateway", action=action,
+                                 queue_ratio=round(ratio, 3),
+                                 occupancy=round(occ, 3))
+        return action
+
+    def _spike(self, srv) -> Optional[str]:
+        # rung 1: raise the hv oversubscription ratio (admission
+        # headroom) while under the configured ceiling
+        hv = getattr(srv, "hv", None)
+        if hv is not None:
+            ceil = int(self.cfg.max_virtual_factor * srv.lanes)
+            if hv.virtual_cap < ceil:
+                if self._base_virtual is None:
+                    self._base_virtual = int(hv.virtual_cap)
+                step = self.cfg.virtual_step or srv.lanes
+                with srv._lock:
+                    hv.virtual_cap = min(hv.virtual_cap + int(step),
+                                         ceil)
+                return "raise_virtual"
+        # rung 2: recruit devices — live reshard up the ladder
+        nxt = self._next_rung(up=True)
+        if nxt is not None:
+            try:
+                self.svc.reshard(n_devices=nxt)
+                return "reshard_grow"
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException:
+                pass   # rolled back intact; fall through to shed
+        # rung 3: nothing left to recruit — degrade gracefully by
+        # shedding the lowest tier instead of timing everyone out
+        if self.cfg.shed_when_exhausted and not self._shedding:
+            self._shedding = True
+            self.svc.force_degraded = True
+            return "shed"
+        return None
+
+    def _calm(self, srv, occ: float) -> Optional[str]:
+        # reverse order: stop shedding first, then give devices back,
+        # then relax the oversubscription ratio
+        if self._shedding:
+            self._shedding = False
+            self.svc.force_degraded = False
+            return "unshed"
+        if occ < 0.5:
+            prev = self._next_rung(up=False)
+            if prev is not None:
+                try:
+                    self.svc.reshard(n_devices=prev)
+                    return "reshard_shrink"
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException:
+                    pass
+        hv = getattr(srv, "hv", None)
+        if hv is not None and self._base_virtual is not None \
+                and hv.virtual_cap > self._base_virtual:
+            step = self.cfg.virtual_step or srv.lanes
+            with srv._lock:
+                hv.virtual_cap = max(hv.virtual_cap - int(step),
+                                     self._base_virtual)
+            return "lower_virtual"
+        return None
+
+    def _next_rung(self, up: bool) -> Optional[int]:
+        """The device-ladder rung above/below the service's CURRENT
+        device count, or None at the end of the ladder (or with no
+        ladder configured)."""
+        ladder = self.cfg.device_ladder
+        if not ladder:
+            return None
+        cur = len(self.svc.devices) if self.svc.devices else 1
+        if up:
+            for d in ladder:
+                if d > cur:
+                    return d
+            return None
+        for d in reversed(ladder):
+            if d < cur:
+                return d
+        return None
+
+    def stats(self) -> dict:
+        return {"enabled": self.cfg.enabled,
+                "actions": dict(self.actions),
+                "last_action": self.last_action,
+                "cooldown": self._cooldown,
+                "shedding": self._shedding}

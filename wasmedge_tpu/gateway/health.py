@@ -137,21 +137,44 @@ def health_of(svc) -> dict:
     if fleet is not None and fleet.started:
         snap = fleet.stats()
         peers = snap.get("peers", {})
-        total = int(snap.get("configured_peers", 0))
-        if total:
+        total = int(snap.get("configured_peers", 0)) \
+            - int(snap.get("left_peers", 0))
+        if total > 0:
             # fleet capacity view: a suspect/dead peer is lost
             # aggregate capacity — DEGRADED, which (with shedding on)
             # sheds the lowest weight tier fleet-wide until the peer
             # recovers or its load is adopted.  A fleet with no peers
             # configured adds NO check at all: solo mode must look
             # exactly like the non-federated gateway.
+            #
+            # Churn is NOT degradation (r21): a departed (left) member
+            # is expected absence and leaves the tally entirely, and a
+            # runtime-joined peer inside its churn grace window counts
+            # as "joining", not missing — a clean join/leave must not
+            # trip degraded-mode shedding.  A genuinely missing
+            # boot-configured peer still degrades.
             missing = int(peers.get("suspect", 0)) \
                 + int(peers.get("dead", 0))
             checks["fleet"] = _check(
                 missing == 0, "degraded",
                 f"{peers.get('alive', 0)}/{total} peers alive "
                 f"({peers.get('suspect', 0)} suspect, "
-                f"{peers.get('dead', 0)} dead)")
+                f"{peers.get('dead', 0)} dead, "
+                f"{peers.get('joining', 0)} joining, "
+                f"{snap.get('left_peers', 0)} left)")
+        _fleet_churn = int(peers.get("joining", 0)) \
+            + int(snap.get("left_peers", 0))
+    else:
+        peers, snap, _fleet_churn = {}, {}, 0
+    resharding = int(getattr(svc, "_resharding", 0))
+    if _fleet_churn or resharding:
+        # informational, always healthy: operators (and tests) can
+        # see churn-in-progress distinctly from degradation
+        checks["churn"] = _check(
+            True, "degraded",
+            f"churn in progress: {peers.get('joining', 0)} joining, "
+            f"{snap.get('left_peers', 0)} left, "
+            f"{resharding} reshard(s) in flight")
     if getattr(svc, "force_degraded", False):
         checks["forced"] = _check(False, "degraded",
                                   "operator forced degraded mode")

@@ -302,6 +302,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 return self._register(url)
             if url.path.startswith("/v1/fleet/"):
                 return self._fleet_post(url.path)
+            if url.path == "/v1/reshard":
+                return self._reshard()
             return self._reply(404, {"ok": False, "err": {
                 "name": "NotFound", "retryable": False,
                 "message": f"no route {url.path}"}})
@@ -361,7 +363,29 @@ class GatewayHandler(BaseHTTPRequestHandler):
             # operator/bench trigger: ship one parked virtual lane
             return self._reply(200, fl.migrate_out(
                 int(doc["id"]), str(doc["peer"])))
+        if path == "/v1/fleet/leave":
+            # departure announcement (r21 gossip membership): mark a
+            # member — default: this gateway — as left and gossip it
+            return self._reply(200, fl.on_leave(doc))
         raise KeyError(f"no fleet route {path}")
+
+    def _reshard(self):
+        """Operator/bench trigger for a live device-set change (r21):
+        POST /v1/reshard {"devices": N} rebuilds the CURRENT serving
+        generation over the first N local devices at a launch boundary
+        — no drain, no request re-queue (gateway/service.py
+        reshard)."""
+        import json as _json
+
+        body = self._read_body()
+        try:
+            doc = _json.loads(body or b"{}")
+        except _json.JSONDecodeError as e:
+            raise ValueError(f"malformed JSON body: {e}") from e
+        n = doc.get("devices")
+        if not isinstance(n, int) or n < 1:
+            raise ValueError('"devices" must be a positive integer')
+        return self._reply(200, self.svc.reshard(n_devices=n))
 
     # -- handlers ----------------------------------------------------------
     def _invoke(self, url):
@@ -422,6 +446,20 @@ class GatewayHandler(BaseHTTPRequestHandler):
                     "detail": "pruned",
                     "message": f"request {rid} was resolved and its "
                                f"result pruned from the cache"}})
+            # poll-redirection hint (r21): an id this gateway never
+            # accepted may live on its rendezvous owner — tell the
+            # client WHERE to poll (303-style detail in the 404 body)
+            # instead of forcing blind survivor polling
+            hint = self.svc.fleet.owner_hint(rid) \
+                if self.svc.fleet is not None else None
+            if hint is not None:
+                return self._reply(404, {"ok": False, "err": {
+                    "name": "NotFound", "retryable": True,
+                    "detail": "not_owner",
+                    "owner_hint": hint,
+                    "message": f"request {rid} is unknown here; its "
+                               f"rendezvous owner is "
+                               f"{hint['peer']}"}})
             raise KeyError(f"no request {rid}")
         if not req.future.done:
             return self._reply(200, {"ok": True, "status": "pending",

@@ -178,7 +178,8 @@ class GatewayService:
                  build_timeout_s: Optional[float] = 120.0,
                  shed_on_degraded: bool = True,
                  devices=None,
-                 fleet=None):
+                 fleet=None,
+                 autoscale=None):
         from wasmedge_tpu.common.configure import Configure
         from wasmedge_tpu.obs.recorder import recorder_of
 
@@ -284,6 +285,23 @@ class GatewayService:
             cfg = fleet if isinstance(fleet, FleetConfig) \
                 else FleetConfig(peers=list(fleet))
             self.fleet = FleetController(self, cfg)
+        # live resharding (r21): reshards currently installing (health
+        # reports them as churn, not degradation) + per-direction
+        # totals (wasmedge_reshards_total{direction})
+        self._resharding = 0
+        self.reshard_counts: Dict[str, int] = {}
+        # traffic-driven autoscale (r21): `autoscale` is an
+        # AutoscaleConfig; the default (None / enabled=False) builds
+        # no controller — behaviorally identical to r16
+        self.autoscale = None
+        if autoscale is not None:
+            from wasmedge_tpu.gateway.autoscale import (AutoscaleConfig,
+                                                        AutoscaleController)
+
+            acfg = autoscale if isinstance(autoscale, AutoscaleConfig) \
+                else AutoscaleConfig(**dict(autoscale))
+            if acfg.enabled:
+                self.autoscale = AutoscaleController(self, acfg).start()
         self._health = HealthGate(self)
         if resume:
             if self.durable is None:
@@ -1193,6 +1211,59 @@ class GatewayService:
             self.http_counts[key] = self.http_counts.get(key, 0) + 1
 
     # -- introspection -----------------------------------------------------
+    def reshard(self, n_devices: Optional[int] = None,
+                devices=None) -> dict:
+        """Live-reshard the CURRENT generation onto a new device set
+        (r21 tentpole leg b) — no drain, no re-queue: resident lanes
+        ride through with their state bit-identical (grow-only lane
+        pool; a device SHRINK keeps the lane width and re-splits it
+        across fewer devices).  Future generations build at the new
+        geometry too.  A mid-install fault rolls the server back onto
+        the old mesh and this raises — the gateway keeps serving at
+        the OLD geometry."""
+        import jax
+
+        from wasmedge_tpu.parallel.mesh import normalize_devices
+
+        if devices is not None:
+            devs = normalize_devices(devices)
+        else:
+            n = 1 if n_devices is None else int(n_devices)
+            if n < 1:
+                raise ValueError("n_devices must be positive")
+            avail = jax.devices()
+            if n > len(avail):
+                raise ValueError(
+                    f"reshard wants {n} devices, only {len(avail)} "
+                    f"visible")
+            devs = normalize_devices(avail[:n])
+        gen = self.current
+        if gen is None:
+            raise RuntimeError("no serving generation to reshard")
+        old_ndev = len(self.devices) if self.devices else 1
+        # health surfaces in-flight reshards as churn (not
+        # degradation) while the install runs
+        with self._lock:
+            self._resharding += 1
+        try:
+            out = gen.server.reshard(devices=devs)
+        finally:
+            with self._lock:
+                self._resharding -= 1
+        direction = "grow" if len(devs) >= old_ndev else "shrink"
+        with self._lock:
+            # future generations (module registrations trigger a fresh
+            # build) inherit the new geometry
+            self.devices = devs if len(devs) > 1 else None
+            self.lanes = int(out["lanes"])
+            self.reshard_counts[direction] = \
+                self.reshard_counts.get(direction, 0) + 1
+        self.obs.instant("gateway_reshard", cat="gateway",
+                         track="gateway", direction=direction,
+                         devices=len(devs), old_devices=old_ndev,
+                         lanes=out["lanes"], generation=gen.gen_id)
+        return dict(out, direction=direction, generation=gen.gen_id)
+
     def health(self, fresh: bool = True) -> dict:
         """The truthful /healthz body (gateway/health.py): driver
         liveness, last-swap outcome, queue saturation, checkpoint +
@@ -1219,6 +1290,9 @@ class GatewayService:
                 "last_swap": dict(self.last_swap)
                 if self.last_swap else None,
                 "durable": self.durable is not None,
+                "devices": len(self.devices) if self.devices else 1,
+                "reshards": dict(self.reshard_counts),
+                "resharding": self._resharding,
             }
             if gen is not None:
                 out["queue_depth"] = len(gen.server.queue)
@@ -1233,6 +1307,8 @@ class GatewayService:
             hv = gen.server.hv_stats()
             if hv is not None:
                 out["hv"] = hv
+        if self.autoscale is not None:
+            out["autoscale"] = self.autoscale.stats()
         out["health"] = self.health()
         return out
 
@@ -1247,6 +1323,7 @@ class GatewayService:
                 "rollbacks": self.counters["rollbacks"],
             }
             shed_counts = dict(self.shed_counts)
+            reshard_counts = dict(self.reshard_counts)
         return render_prometheus(
             recorder=self.obs if self.obs.enabled else None,
             hostcall_stats=gen.engine.hostcall_stats if gen else None,
@@ -1256,7 +1333,10 @@ class GatewayService:
             shed_counts=shed_counts,
             hv_stats=gen.server.hv_stats() if gen else None,
             fleet_stats=self.fleet.stats()
-            if self.fleet is not None else None)
+            if self.fleet is not None else None,
+            reshard_counts=reshard_counts or None,
+            autoscale_actions=dict(self.autoscale.actions)
+            if self.autoscale is not None else None)
 
     # -- lifecycle ---------------------------------------------------------
     def shutdown(self, drain: bool = True,
@@ -1270,6 +1350,8 @@ class GatewayService:
             with self._lock:
                 self._closed = True
                 gens = list(self._gens)
+        if self.autoscale is not None:
+            self.autoscale.stop()
         if self.fleet is not None:
             self.fleet.stop()
         for g in gens:
@@ -1289,6 +1371,8 @@ class GatewayService:
         are closed (a real dead process drops them too)."""
         with self._lock:
             self._closed = True   # later registrations see it and stop
+        if self.autoscale is not None:
+            self.autoscale.stop()
         if self.fleet is not None:
             # a killed process's heartbeats just STOP (no goodbye, no
             # final replication) — peers discover the death the honest

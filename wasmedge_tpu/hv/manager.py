@@ -101,11 +101,14 @@ class LaneVirtualizer:
             self.lanes, knobs.resident_budget_bytes, self.lane_bytes)
         mv = knobs.max_virtual_lanes
         self.virtual_cap = max(int(mv), 1) if mv is not None else self.lanes
-        self.tenant_caps: Dict[str, int] = {}
-        for tenant, budget in (tenant_budgets or {}).items():
-            if budget is not None:
-                self.tenant_caps[tenant] = resident_lane_cap(
-                    self.lanes, int(budget), self.lane_bytes)
+        # kept verbatim so a live reshard (resize) can re-derive the
+        # per-tenant caps at the new lane width
+        self._tenant_budgets: Dict[str, int] = {
+            t: int(b) for t, b in (tenant_budgets or {}).items()
+            if b is not None}
+        self.tenant_caps: Dict[str, int] = {
+            t: resident_lane_cap(self.lanes, b, self.lane_bytes)
+            for t, b in self._tenant_budgets.items()}
         self.waiting: "OrderedDict[int, VirtualLane]" = OrderedDict()
         # per-resident-lane tracking (host side)
         self._last_progress: Dict[int, int] = {}
@@ -129,6 +132,38 @@ class LaneVirtualizer:
         }
         self.peak_admitted = 0
         self.peak_resident_by_tenant: Dict[str, int] = {}
+
+    # -- geometry ----------------------------------------------------------
+    def resize(self, lanes: int):
+        """Adopt a grown lane pool after a live reshard (r21,
+        serve/server.py reshard): lanes only ever grow, and global
+        lane indices are preserved, so resident tracking keeps its
+        entries verbatim and the per-lane mirrors pad with zeros (the
+        new tail lanes are idle — no progress, trap TRAP_DONE lands
+        with the next note_progress).  Budgets re-derive at the new
+        width; waiting virtual lanes are keyed by request id and ride
+        through untouched."""
+        lanes = int(lanes)
+        if lanes < self.lanes:
+            raise ValueError(
+                f"hv resize cannot shrink ({self.lanes} -> {lanes})")
+        if lanes == self.lanes:
+            return
+        grow = lanes - self.lanes
+        self.lanes = lanes
+        self.resident_cap = resident_lane_cap(
+            self.lanes, self.k.resident_budget_bytes, self.lane_bytes)
+        mv = self.k.max_virtual_lanes
+        self.virtual_cap = max(int(mv), 1) if mv is not None \
+            else self.lanes
+        self.tenant_caps = {
+            t: resident_lane_cap(self.lanes, b, self.lane_bytes)
+            for t, b in self._tenant_budgets.items()}
+        self._last_retired = np.concatenate(
+            [self._last_retired, np.zeros(grow, np.int64)])
+        self._last_trap = np.concatenate(
+            [self._last_trap, np.zeros(grow, np.int64)])
+        self._install_jit = None   # retrace at the new state shapes
 
     # -- admission ---------------------------------------------------------
     def admitted(self, bindings) -> int:

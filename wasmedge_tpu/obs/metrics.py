@@ -60,7 +60,8 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                       failures=None, http_requests=None,
                       analysis_counts=None, gateway_counts=None,
                       shed_counts=None, hv_stats=None,
-                      fleet_stats=None) -> str:
+                      fleet_stats=None, reshard_counts=None,
+                      autoscale_actions=None) -> str:
     """Render one metrics snapshot.  All sources optional: `recorder` a
     FlightRecorder, `stats` a common.statistics.Statistics, `hostcall_stats`
     an engine's pipeline counter dict, `failures` extra FailureRecords
@@ -72,7 +73,12 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
     ({"restarts": n, "rollbacks": n}), `shed_counts` the per-tenant
     degraded-mode shed tally, `hv_stats` a BatchServer.hv_stats()
     lane-virtualization snapshot (wasmedge_tpu/hv/), `fleet_stats` a
-    FleetController.stats() federation snapshot (wasmedge_tpu/fleet/)."""
+    FleetController.stats() federation snapshot (wasmedge_tpu/fleet/),
+    `reshard_counts` the gateway's {direction: count} live-reshard
+    tally (emitted only when a reshard has happened), and
+    `autoscale_actions` the AutoscaleController's {action: count}
+    tally (emitted only when the controller is constructed) — both
+    r21; a gateway without them renders bit-identically to r16."""
     w = _Writer()
 
     if fleet_stats:
@@ -97,6 +103,31 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                "their original ids).")
         w.sample("wasmedge_fleet_adoptions_total", None,
                  int(fleet_stats.get("adoptions", 0)))
+        w.head("wasmedge_fleet_membership_epoch", "gauge",
+               "Gossip membership view epoch (wasmedge_tpu/fleet/"
+               "membership.py: bumps on join/leave origin events; a "
+               "static fleet stays at 0).")
+        w.sample("wasmedge_fleet_membership_epoch", None,
+                 int(fleet_stats.get("membership_epoch", 0)))
+
+    if reshard_counts:
+        w.head("wasmedge_reshards_total", "counter",
+               "Live reshards of the running generation by direction "
+               "(serve/server.py reshard: device-set change at a "
+               "launch boundary, resident lanes ride through).")
+        for direction in sorted(reshard_counts):
+            w.sample("wasmedge_reshards_total",
+                     {"direction": str(direction)},
+                     int(reshard_counts[direction]))
+
+    if autoscale_actions is not None:
+        w.head("wasmedge_autoscale_actions_total", "counter",
+               "Autoscale controller actions by kind (gateway/"
+               "autoscale.py: deterministic spike/calm ladder).")
+        for action in sorted(autoscale_actions):
+            w.sample("wasmedge_autoscale_actions_total",
+                     {"action": str(action)},
+                     int(autoscale_actions[action]))
 
     if hv_stats:
         w.head("wasmedge_hv_swaps_total", "counter",
@@ -401,7 +432,9 @@ def export_prometheus(path, recorder=None, stats=None,
                       hostcall_stats=None, failures=None,
                       http_requests=None, analysis_counts=None,
                       gateway_counts=None, shed_counts=None,
-                      hv_stats=None, fleet_stats=None) -> str:
+                      hv_stats=None, fleet_stats=None,
+                      reshard_counts=None,
+                      autoscale_actions=None) -> str:
     """Render and write a metrics snapshot to `path` (or file-like)."""
     text = render_prometheus(recorder=recorder, stats=stats,
                              hostcall_stats=hostcall_stats,
@@ -411,7 +444,9 @@ def export_prometheus(path, recorder=None, stats=None,
                              gateway_counts=gateway_counts,
                              shed_counts=shed_counts,
                              hv_stats=hv_stats,
-                             fleet_stats=fleet_stats)
+                             fleet_stats=fleet_stats,
+                             reshard_counts=reshard_counts,
+                             autoscale_actions=autoscale_actions)
     if hasattr(path, "write"):
         path.write(text)
     else:

@@ -82,6 +82,44 @@ def shard_mirrors(mirror, slices):
     return [mirror[sl] for sl in slices]
 
 
+def regrow_state(state, old_lanes: int, idle_state, new_lanes: int):
+    """Host-side state re-placement for a LIVE reshard (r21): every
+    lane-trailing plane of `state` (the running generation, old_lanes
+    wide) keeps its columns at their GLOBAL lane indices and extends
+    with the matching columns of `idle_state` (a fresh all-idle state
+    at the new geometry — its tail lanes are born parked TRAP_DONE,
+    exactly like the pad lanes of an uneven split).  Laneless planes
+    pass through from the running state untouched.
+
+    Lanes only ever grow across a reshard (the server pads the lane
+    pool up from its CURRENT width, never down — a device shrink keeps
+    the width and just re-splits it), so every resident lane's column
+    is preserved verbatim: results are bit-identical to the
+    unresharded run by construction, not by remapping.
+
+    Returns a host (numpy) pytree — the caller places it on the new
+    mesh (parallel/mesh.py shard_batch_state) or hands it straight to
+    the unsharded jit for a single-device target."""
+    import jax
+
+    if new_lanes < old_lanes:
+        raise ValueError(
+            f"reshard cannot shrink the lane pool "
+            f"({old_lanes} -> {new_lanes}); device shrinks keep the "
+            f"width and re-split it")
+
+    def _combine(old_leaf, idle_leaf):
+        o = np.asarray(old_leaf)
+        if o.ndim and o.shape[-1] == old_lanes:
+            if new_lanes == old_lanes:
+                return o
+            pad = np.asarray(idle_leaf)[..., old_lanes:new_lanes]
+            return np.concatenate([o, pad.astype(o.dtype)], axis=-1)
+        return o
+
+    return jax.tree_util.tree_map(_combine, state, idle_state)
+
+
 def _build_shard_chunk(run_chunk, mesh, probe_state, donate):
     """Jit the chunk body as ONE program over the named mesh.
 

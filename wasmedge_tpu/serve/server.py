@@ -227,7 +227,7 @@ class BatchServer:
         self.counters = {
             "submitted": 0, "admitted": 0, "completed": 0, "trapped": 0,
             "rejected": 0, "expired": 0, "killed": 0, "recycled_lanes": 0,
-            "rounds": 0, "retired_instructions": 0,
+            "rounds": 0, "retired_instructions": 0, "reshards": 0,
         }
         self.adopted: Dict[int, ServeFuture] = {}
         if resume:
@@ -979,6 +979,148 @@ class BatchServer:
             if self.hv is not None:
                 self.hv.on_free(lane)
         return len(done)
+
+    # -- live resharding (r21) ---------------------------------------------
+    def reshard(self, devices=None) -> dict:
+        """Live device-set change: rebuild the jitted shard chunk over
+        a NEW mesh at a launch boundary and reinstall every resident
+        lane's plane columns — no drain, no request re-queue.
+
+        The lane pool only ever pads UP from its current width
+        (padded_lanes over the new device count), so every resident
+        lane keeps its GLOBAL index and its column verbatim: results
+        are bit-identical to the unresharded run by construction.
+        hv-parked virtual lanes are keyed by request id and ride
+        through; a compaction permutation already applied is part of
+        the running state and moves with it (the compactor itself is
+        rebuilt over the new geometry).  A device SHRINK keeps the
+        width and re-splits it across fewer devices.
+
+        Blocks while a launch slice is in flight (the jitted chunk
+        donates the pre-launch state's buffers — same hazard as
+        checkpoint()).  The `reshard_install` fault seam fires BEFORE
+        any mutation, and every failure mid-move rolls the old mesh,
+        state, and bookkeeping back intact."""
+        from wasmedge_tpu.parallel.mesh import (
+            lane_mesh, normalize_devices, shard_batch_state)
+        from wasmedge_tpu.parallel.shard_drive import (
+            padded_lanes, regrow_state)
+
+        devs = normalize_devices(devices) if devices is not None else []
+        n_dev = max(len(devs), 1)
+        # mesh construction validates the device set up front — a bad
+        # set fails HERE, before the lock and before any mutation
+        new_mesh = lane_mesh(devices=devs) if len(devs) > 1 else None
+        with self._lock:
+            while self._inflight and self.failed is None:
+                self._wake.wait(timeout=0.1)
+            if self.failed is not None:
+                raise self.failed
+            eng = self.engine
+            old_lanes = self.lanes
+            old_mesh = getattr(eng, "mesh", None)
+            old_ndev = int(old_mesh.devices.size) \
+                if old_mesh is not None else 1
+            new_lanes = padded_lanes(old_lanes, n_dev)
+            old = dict(run_chunk=eng._run_chunk, step=eng._step,
+                       state=self.state, free=list(self._free),
+                       served=self._served_before,
+                       planes=self._planes,
+                       compactor=self._compactor,
+                       cursor=getattr(eng, "_stdout_cursor", None),
+                       snap=self._stdout_snap,
+                       rec_lanes=self.recycler.lanes)
+            hv_old = None
+            if self.hv is not None:
+                hv = self.hv
+                hv_old = (hv.lanes, hv.resident_cap, hv.virtual_cap,
+                          dict(hv.tenant_caps), hv._last_retired,
+                          hv._last_trap, hv._install_jit)
+            try:
+                if self.faults is not None:
+                    self.faults.fire("reshard_install",
+                                     old_devices=old_ndev,
+                                     new_devices=n_dev,
+                                     old_lanes=old_lanes,
+                                     lanes=new_lanes)
+                eng.lanes = new_lanes
+                eng.mesh = new_mesh
+                eng._run_chunk = None   # full retrace over the new mesh
+                eng._step = None
+                # the recycler must see the new width BEFORE building
+                # the idle template (its column capture skips planes
+                # whose trailing dim mismatches self.lanes)
+                self.recycler.lanes = new_lanes
+                if self.state is not None:
+                    idle = self.recycler.idle_state(0)
+                    host = regrow_state(old["state"], old_lanes, idle,
+                                        new_lanes)
+                    # the new tail lanes are born parked TRAP_DONE
+                    # (the idle template), exactly like the pad lanes
+                    # of an uneven split — free capacity, not work
+                    self.state = shard_batch_state(host, new_mesh) \
+                        if new_mesh is not None else host
+                # exactly-once stdout: the hostcall layer REPLACES a
+                # size-mismatched cursor with zeros — pad-extend it
+                # instead, or every resident lane's flushed prefix
+                # would replay
+                cur = old["cursor"]
+                if cur is not None and cur[0].size == old_lanes \
+                        and new_lanes != old_lanes:
+                    pad = np.zeros(new_lanes - old_lanes, cur[0].dtype)
+                    eng._stdout_cursor = (
+                        np.concatenate([cur[0], pad]),
+                        np.concatenate([cur[1], pad.copy()]))
+                if self.hv is not None:
+                    self.hv.resize(new_lanes)
+                if self._compactor is not None:
+                    from wasmedge_tpu.batch.compact import LaneCompactor
+
+                    self._compactor = LaneCompactor(eng, narrow=False)
+                self.lanes = new_lanes
+                for lane in range(old_lanes, new_lanes):
+                    heapq.heappush(self._free, lane)
+                if new_lanes != old_lanes:
+                    self._served_before = np.concatenate(
+                        [self._served_before,
+                         np.zeros(new_lanes - old_lanes, bool)])
+                self._planes = None   # stale mirrors never feed a
+                #                       harvest across the move
+                self._snap_stdout()
+                eng._build()   # eager: a mesh/compile-setup failure
+                #                surfaces NOW, inside the rollback
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                eng.lanes = old_lanes
+                eng.mesh = old_mesh
+                eng._run_chunk = old["run_chunk"]
+                eng._step = old["step"]
+                eng._stdout_cursor = old["cursor"]
+                self.recycler.lanes = old["rec_lanes"]
+                self.state = old["state"]
+                self._free = old["free"]
+                self._served_before = old["served"]
+                self._planes = old["planes"]
+                self._compactor = old["compactor"]
+                self._stdout_snap = old["snap"]
+                if hv_old is not None:
+                    hv = self.hv
+                    (hv.lanes, hv.resident_cap, hv.virtual_cap,
+                     hv.tenant_caps, hv._last_retired, hv._last_trap,
+                     hv._install_jit) = hv_old
+                self.lanes = old_lanes
+                self._record("reshard", e)
+                raise
+            self.counters["reshards"] += 1
+            resident = len(self._bindings)
+        self.obs.instant("reshard", cat="serve", track="serve",
+                         old_devices=old_ndev, devices=n_dev,
+                         old_lanes=old_lanes, lanes=new_lanes,
+                         resident=resident)
+        return {"ok": True, "devices": n_dev, "old_devices": old_ndev,
+                "lanes": new_lanes, "old_lanes": old_lanes,
+                "resident": resident}
 
     # -- supervision -------------------------------------------------------
     def _snap_stdout(self):

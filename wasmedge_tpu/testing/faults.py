@@ -82,6 +82,24 @@ The fleet federation layer (wasmedge_tpu/fleet/) adds the peer seams
   kill/restart is still driver-orchestrated (bench.py --federation),
   with these seams supplying the weather.
 
+The elastic-fleet layer (r21) adds the churn seams:
+  - `"membership_gossip"`  in FleetController before a piggybacked
+                           membership view MERGES (ctx: src, dst,
+                           epoch).  An injected fault drops JUST that
+                           gossip message — the heartbeat it rode
+                           still counts for liveness, and the next
+                           exchange re-gossips the view (the CRDT
+                           merge converges regardless of which
+                           messages are lost).
+  - `"reshard_install"`    in BatchServer.reshard before the new-mesh
+                           install mutates anything (ctx: old_devices,
+                           new_devices, old_lanes, lanes).  An
+                           injected fault rolls the server back onto
+                           the OLD mesh with every resident lane
+                           intact — the reshard fails closed.
+  `churn_schedule()` composes these into the seeded join/leave/reshard
+  weather `bench.py --elastic` arms.
+
 Fault classes covered by the tier-1 suites (ISSUE 2 + ISSUE 5):
   - launch-time device error       Fault(point="launch", ...)
   - mid-serve host exception       Fault(point="serve", ...)
@@ -134,7 +152,8 @@ class Fault:
     #                            "http_response_drop" | "swap_out" |
     #                            "swap_in" | "swap_store_write" |
     #                            "peer_send" | "peer_recv" |
-    #                            "peer_heartbeat"
+    #                            "peer_heartbeat" |
+    #                            "membership_gossip" | "reshard_install"
     at: int = 0                # 0-based arrival index at that seam
     times: int = 1             # consecutive arrivals that fault
     lanes: Tuple[int, ...] = ()  # lane attribution (poison quarantine)
@@ -289,6 +308,29 @@ def partition_schedule(links, at: int = 0, times: int = 1000000,
             out.append(Fault(point="peer_recv", at=at, times=times,
                              match={"src": str(src),
                                     "dst": str(dst)}))
+    return out
+
+
+def churn_schedule(seed: int, gossip_drops: int = 2,
+                   reshard_faults: int = 0,
+                   max_at: int = 6) -> list:
+    """The seeded churn weather `bench.py --elastic` arms: a few
+    dropped membership-gossip messages (the CRDT view must still
+    converge through later exchanges) and, optionally, reshard-install
+    faults (the live reshard must roll back onto the old mesh and a
+    retry must succeed).  Same seed, same schedule.  The join/leave/
+    reshard EVENTS themselves are driver-orchestrated — these seams
+    supply the weather around them, exactly like partition_schedule
+    for r16 partitions."""
+    rng = np.random.RandomState(int(seed) & 0x7FFFFFFF)
+    out = []
+    for _ in range(gossip_drops):
+        out.append(Fault(point="membership_gossip",
+                         at=int(rng.randint(max_at + 1))))
+    for k in range(reshard_faults):
+        # arrival 2k faults, its retry (2k+1) goes through — mirrors
+        # the gateway_chaos_schedule build/swap pairing
+        out.append(Fault(point="reshard_install", at=2 * k))
     return out
 
 
