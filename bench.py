@@ -3200,6 +3200,154 @@ def elastic_bench(smoke: bool = False) -> int:
     return 0 if ok else 1
 
 
+def coldstart_bench(smoke: bool = False) -> int:
+    """`bench.py --coldstart` / `--coldstart-smoke`: the r22 cold-start
+    wall.  One gateway with every imagestore knob on registers K
+    modules one at a time — the acceptance pins are DETERMINISTIC
+    counters, not wall-clock: each module lowers exactly once across
+    all K generation builds, each module's image segment builds exactly
+    once (the SegmentCache hit count proves every prior segment was
+    reused verbatim), and a module with a nontrivial `_initialize`
+    returns bit-identical results through the snapshot path and the
+    template-init path.  Registration latency per module count and
+    snapshot-vs-init-replay p50/p99 ride along as the reported curve.
+    Emits COLDSTART_r22.json (smoke: prints one JSON line only)."""
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.gateway import GatewayService
+    from wasmedge_tpu.utils.bench_artifact import percentile
+    from wasmedge_tpu.utils.builder import ModuleBuilder
+
+    nmod = 3 if smoke else 8
+    nreq = 4 if smoke else 24
+
+    def _conf(segmented=False, compile_cache=False, snapshots=False):
+        conf = Configure()
+        conf.batch.steps_per_launch = 256
+        conf.batch.value_stack_depth = 128
+        conf.batch.call_stack_depth = 64
+        conf.imagestore.segmented = segmented
+        conf.imagestore.compile_cache = compile_cache
+        conf.imagestore.snapshots = snapshots
+        return conf
+
+    def build_affine(mul, add):
+        b = ModuleBuilder()
+        b.add_function(["i64"], ["i64"], [],
+                       [("local.get", 0), ("i64.const", mul), "i64.mul",
+                        ("i64.const", add), "i64.add"], export="f")
+        return b.build()
+
+    def build_lazyinit():
+        b = ModuleBuilder()
+        b.add_memory(1)
+        b.add_global("i32", True, [("i32.const", 0)])
+        b.add_global("i64", True, [("i64.const", 0)])
+        b.add_function([], [], [],
+                       [("i32.const", 1), ("global.set", 0),
+                        ("i64.const", 7), ("global.set", 1),
+                        ("i32.const", 0), ("i64.const", 42),
+                        ("i64.store", 3, 0)], export="_initialize")
+        b.add_function(["i64"], ["i64"], [],
+                       [("global.get", 0), "i32.eqz",
+                        ("if", None), ("call", 0), "end",
+                        ("local.get", 0), ("global.get", 1), "i64.add",
+                        ("i32.const", 0), ("i64.load", 3, 0),
+                        "i64.add"], export="compute")
+        return b.build()
+
+    def _invoke(svc, func, args, module):
+        req = svc.submit(func, args, module=module, tenant="default")
+        assert svc.wait(req, timeout_s=120.0)
+        return req.future.result(0)
+
+    t0 = time.perf_counter()
+    checks = {}
+    svc = GatewayService(conf=_conf(segmented=True, compile_cache=True,
+                                    snapshots=True), lanes=4)
+    reg_s = []
+    snap_lat = []
+    try:
+        for k in range(nmod):
+            t = time.perf_counter()
+            svc.register_module(f"m{k}",
+                                wasm_bytes=build_affine(2 + k, 3 * k))
+            reg_s.append(round(time.perf_counter() - t, 4))
+        t = time.perf_counter()
+        svc.register_module("lazy", wasm_bytes=build_lazyinit())
+        reg_s.append(round(time.perf_counter() - t, 4))
+        nregs = nmod + 1
+        # the counter pins: registering module N+1 lowered nothing
+        # twice and rebuilt no existing segment
+        seg = svc.registry.segment_cache.stats()
+        checks["lowered_once_each"] = \
+            svc.registry.lowered_count == nregs
+        checks["segment_builds"] = seg["builds"] == nregs
+        checks["segment_hits"] = \
+            seg["hits"] == nregs * (nregs - 1) // 2
+        checks["snapshot_captured"] = \
+            svc.snapshot_counts.get("captured", 0) == 1
+        ok_results = True
+        for k in range(nmod):
+            ok_results &= _invoke(svc, "f", [10], module=f"m{k}") \
+                == [10 * (2 + k) + 3 * k]
+        checks["affine_results"] = ok_results
+        snap_res = []
+        for i in range(nreq):
+            t = time.perf_counter()
+            snap_res.append(
+                _invoke(svc, "compute", [i], module="lazy")[0])
+            snap_lat.append(time.perf_counter() - t)
+        checks["snapshot_installs"] = \
+            svc.snapshot_counts.get("installs", 0) >= nreq
+    finally:
+        svc.shutdown()
+    # init-replay reference: same module, every knob off (the r21 path)
+    ref = GatewayService(conf=_conf(), lanes=4)
+    ref_lat = []
+    try:
+        ref.register_module("lazy", wasm_bytes=build_lazyinit())
+        ref_res = []
+        for i in range(nreq):
+            t = time.perf_counter()
+            ref_res.append(
+                _invoke(ref, "compute", [i], module="lazy")[0])
+            ref_lat.append(time.perf_counter() - t)
+    finally:
+        ref.shutdown()
+    checks["snapshot_bitidentical"] = snap_res == ref_res
+    dt = time.perf_counter() - t0
+    ok = all(checks.values())
+    snap_lat.sort()
+    ref_lat.sort()
+    out = {
+        "metric": "coldstart_registration_and_snapshot_admission",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "modules": nmod + 1,
+        "registration_s": reg_s,
+        "registration_last_over_first":
+            round(reg_s[-1] / max(reg_s[0], 1e-9), 3),
+        "snapshot_p50_s": round(percentile(snap_lat, 0.50), 4),
+        "snapshot_p99_s": round(percentile(snap_lat, 0.99), 4),
+        "init_replay_p50_s": round(percentile(ref_lat, 0.50), 4),
+        "init_replay_p99_s": round(percentile(ref_lat, 0.99), 4),
+        "wall_s": round(dt, 3),
+    }
+    if smoke:
+        print(json.dumps(out))
+        return 0 if ok else 1
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "COLDSTART_r22.json")
+    print(f"# coldstart modules={nmod + 1} reg_s={reg_s} "
+          f"snap_p50={out['snapshot_p50_s']} "
+          f"replay_p50={out['init_replay_p50_s']} wall={dt:.1f}s",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main():
     eng = _build(LANES)
 
@@ -3315,4 +3463,8 @@ if __name__ == "__main__":
         sys.exit(elastic_bench(smoke=True))
     if "--elastic" in sys.argv[1:]:
         sys.exit(elastic_bench())
+    if "--coldstart-smoke" in sys.argv[1:]:
+        sys.exit(coldstart_bench(smoke=True))
+    if "--coldstart" in sys.argv[1:]:
+        sys.exit(coldstart_bench())
     main()

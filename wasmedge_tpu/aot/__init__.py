@@ -171,22 +171,36 @@ def verify_fused(img: LoweredModule, mod) -> bool:
     return all(np.array_equal(fused[k], regen[k]) for k in regen)
 
 
-def compile_module(wasm_bytes: bytes, conf=None) -> bytes:
-    """wasm -> universal twasm: original bytes + tpu.aot custom section
-    (reference: outputWasmLibrary, compiler.cpp:4270)."""
+def compile_payload(wasm_bytes: bytes, conf=None) -> bytes:
+    """wasm -> the serialized lowered-image payload.  These are the
+    exact bytes a .twasm's tpu.aot section embeds AND what the
+    gateway's content-addressed CompileCache stores (imagestore/
+    compilecache.py) — one payload format, every cache tier."""
     from wasmedge_tpu.common.configure import Configure
     from wasmedge_tpu.loader.loader import Loader
     from wasmedge_tpu.validator.validator import Validator
 
     conf = conf or Configure()
     mod = Validator(conf).validate(Loader(conf).parse_module(wasm_bytes))
-    payload = serialize_image(mod.lowered, mod=mod)
+    return serialize_image(mod.lowered, mod=mod)
+
+
+def twasm_from_payload(wasm_bytes: bytes, payload: bytes) -> bytes:
+    """Append an already-built image payload as the tpu.aot section
+    (reference: outputWasmLibrary, compiler.cpp:4270)."""
     digest = hashlib.sha256(wasm_bytes).digest()
     body = struct.pack("<I", AOT_VERSION) + digest + payload
     name = SECTION_NAME.encode()
     content = _uleb(len(name)) + name + body
     section = b"\x00" + _uleb(len(content)) + content
     return wasm_bytes + section
+
+
+def compile_module(wasm_bytes: bytes, conf=None) -> bytes:
+    """wasm -> universal twasm: original bytes + tpu.aot custom
+    section."""
+    return twasm_from_payload(wasm_bytes,
+                              compile_payload(wasm_bytes, conf))
 
 
 def extract_precompiled(wasm_bytes: bytes, custom_sections) -> Optional[bytes]:
@@ -441,7 +455,20 @@ def compile_cached(wasm_bytes: bytes, conf=None) -> bytes:
     if os.path.exists(path):
         with open(path, "rb") as f:
             return f.read()
-    out = compile_module(wasm_bytes, conf)
+    # the shared image-payload cache (imagestore/compilecache.py) lives
+    # beside the .twasm artifacts: a lowering the gateway (or a prior
+    # export) already paid for turns into a pure section append here,
+    # and a fresh lowering here seeds the gateway's next registration
+    from wasmedge_tpu.imagestore.compilecache import CompileCache
+
+    sha = hashlib.sha256(wasm_bytes).hexdigest()
+    cc = CompileCache()
+    cc.enable(cache_dir())
+    payload = cc.load(sha)
+    if payload is None:
+        payload = compile_payload(wasm_bytes, conf)
+        cc.store(sha, payload)
+    out = twasm_from_payload(wasm_bytes, payload)
     os.makedirs(cache_dir(), exist_ok=True)
     from wasmedge_tpu.utils.fsio import atomic_write_bytes
 

@@ -754,3 +754,54 @@ def build_device_image(image: LoweredModule, memories=None, globals_=None,
         t0kind=t0kind, t0_fdwrite_safe=t0_fdwrite_safe,
         analysis_builder=_analysis_builder,
     )
+
+
+def image_fingerprint(img: DeviceImage) -> str:
+    """Content fingerprint of a DeviceImage's static planes (sha256 over
+    the code/function/snapshot arrays plus the fusion/tier attributes).
+
+    The imagestore segment cache (wasmedge_tpu/imagestore/segments.py)
+    keys memoized concat segments on this: two engines lowered from
+    identical bytes under identical knobs fingerprint identically, and
+    a re-planned image (fusion/tierup planes bound later) fingerprints
+    differently — a stale segment can never alias a changed image.
+    Cached on the instance: the planes are frozen after normalization,
+    so one hash per image covers every later generation build."""
+    import hashlib
+
+    cached = getattr(img, "_fingerprint", None)
+    if cached is not None:
+        return cached
+    h = hashlib.sha256()
+    for name in ("cls", "sub", "a", "b", "c", "imm_lo", "imm_hi",
+                 "op_id", "br_table", "f_entry", "f_nparams",
+                 "f_nlocals", "f_nresults", "f_frame_top", "f_type",
+                 "table0", "globals_lo", "globals_hi", "mem_init",
+                 "v128", "elem_flat", "elem_off", "elem_len",
+                 "data_words", "data_off", "data_len", "fuse_len",
+                 "fuse_pat", "tier_fn", "tier_fuel_bound"):
+        arr = getattr(img, name, None)
+        h.update(name.encode())
+        if arr is None:
+            h.update(b"\x00")
+            continue
+        arr = np.ascontiguousarray(arr)
+        h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+        h.update(arr.tobytes())
+    for scalar in (img.mem_pages_init, img.mem_pages_max,
+                   int(img.has_memory), img.max_local_zeros,
+                   img.code_len, int(img.has_simd), img.table_cap,
+                   img.table_size_init,
+                   int(getattr(img, "has_table_mut", False)),
+                   int(getattr(img, "has_table_grow", False)),
+                   len(getattr(img, "fuse_patterns", None) or ()),
+                   len(getattr(img, "tier_fns", None) or ())):
+        h.update(str(int(scalar)).encode() + b",")
+    for key in getattr(img, "fuse_patterns", None) or ():
+        h.update(repr(key).encode())
+    fp = h.hexdigest()
+    try:
+        img._fingerprint = fp
+    except Exception:
+        pass  # frozen dataclass variants: recompute per call
+    return fp

@@ -75,47 +75,229 @@ class Tenant:
         return self.engine.img
 
 
-def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
-    """Concatenate tenant DeviceImages into one super-image.
+@dataclasses.dataclass
+class Segment:
+    """One tenant's fully-rebased contribution to a concatenated image.
 
-    Returns (image, bases) where bases[i] = dict of per-tenant index-space
-    offsets (pc/func/global/type/brt/table)."""
+    A segment is a pure function of (tenant image, index-space offsets,
+    merged-fuse-pattern prefix): every array in it is already rebased
+    into the super-image's coordinate space, so assembly is plain
+    concatenation.  The imagestore SegmentCache keys on exactly those
+    inputs — appending module N+1 leaves modules 1..N's offsets and
+    pattern prefix untouched, so their segments replay from cache and
+    only the new module is rebased."""
+
+    base: dict                 # indirection row: per-index-space offsets
+    planes: dict               # cls/sub/a/b/c/imm_lo/imm_hi/op_id
+    brt: np.ndarray
+    tbl: np.ndarray
+    ef: np.ndarray
+    eoff: np.ndarray
+    elen: np.ndarray
+    dwords: np.ndarray
+    doff: np.ndarray
+    dlen: np.ndarray
+    flen: np.ndarray
+    fpat: np.ndarray
+    has_fuse: bool
+    new_patterns: list         # fuse patterns novel vs. the entry prefix
+    tfn: np.ndarray
+    tfb: np.ndarray
+    tier_fns: list             # rebased whole-function promotion entries
+    has_tier: bool
+    f_parts: dict              # f_entry/f_nparams/... (rebased)
+    g_lo: np.ndarray
+    g_hi: np.ndarray
+    v128: np.ndarray
+    advance: dict              # per-index-space deltas for the next seg
+
+
+def build_segment(t: Tenant, off: dict, pat_state: tuple) -> Segment:
+    """Rebase one tenant's DeviceImage at the given index-space offsets.
+
+    `off` carries the running offsets (pc/func/glob/type/brt/table/v128/
+    eseg/eflat/dseg/dbyte/tier_slot); `pat_state` is the tuple of fused
+    patterns merged before this tenant.  Pure — reads only the tenant
+    image and its arguments, which is what makes segments cacheable."""
     from wasmedge_tpu.batch.image import CLS_VCONST, CLS_VSHUFFLE
 
-    planes = {k: [] for k in ("cls", "sub", "a", "b", "c", "imm_lo",
-                              "imm_hi")}
-    v128_parts = []
-    v128_b = 0
-    f_parts = {k: [] for k in ("f_entry", "f_nparams", "f_nlocals",
-                               "f_nresults", "f_frame_top", "f_type")}
-    brt_parts = []
-    tbl_parts = []
-    g_lo_parts = []
-    g_hi_parts = []
-    eflat_parts, eoff_parts, elen_parts = [], [], []
-    dword_parts, doff_parts, dlen_parts = [], [], []
+    img = t.img
+    pc_b = off["pc"]
+    fn_b = off["func"]
+    gl_b = off["glob"]
+    ty_b = off["type"]
+    brt_b = off["brt"]
+    tbl_b = off["table"]
+    v128_b = off["v128"]
+    eseg_b = off["eseg"]
+    eflat_b = off["eflat"]
+    dseg_b = off["dseg"]
+    dbyte_b = off["dbyte"]
+    tier_slot_b = off["tier_slot"]
+    base = dict(pc=pc_b, func=fn_b, glob=gl_b, type=ty_b, brt=brt_b,
+                table=tbl_b, eseg=eseg_b, dseg=dseg_b)
+    a = img.a.copy()
+    b = img.b.copy()
+    c = img.c.copy()
+    cls = img.cls
+    is_branch = (cls == CLS_BR) | (cls == CLS_BRZ) | (cls == CLS_BRNZ)
+    a[is_branch] += pc_b
+    a[cls == CLS_CALL] += fn_b
+    a[cls == CLS_RETCALL] += fn_b
+    a[cls == CLS_HOSTCALL] += fn_b
+    a[(cls == CLS_GLOBAL_GET) | (cls == CLS_GLOBAL_SET)] += gl_b
+    is_ci = (cls == CLS_CALL_INDIRECT) | (cls == CLS_RETCALL_INDIRECT)
+    a[is_ci] += ty_b
+    c[is_ci] += tbl_b
+    a[cls == CLS_BR_TABLE] += brt_b
+    a[(cls == CLS_VCONST) | (cls == CLS_VSHUFFLE)] += v128_b
+    # table ops address the tenant's slot [tbl_b, tbl_b + slot) in
+    # the concatenated plane; ref.func pushes rebase with the
+    # function index space
+    is_tb = np.isin(cls, (CLS_TABLE_GET, CLS_TABLE_SET, CLS_TABLE_SIZE,
+                          CLS_TABLE_GROW, CLS_TABLE_FILL,
+                          CLS_TABLE_COPY, CLS_TABLE_INIT))
+    c[is_tb] += tbl_b
+    a[(cls == CLS_TABLE_INIT) | (cls == CLS_ELEM_DROP)] += eseg_b
+    a[(cls == CLS_MEMINIT) | (cls == CLS_DATA_DROP)] += dseg_b
+    a[cls == CLS_REFFUNC] += fn_b
+    planes = dict(
+        cls=cls, sub=img.sub, a=a, b=b, c=c,
+        imm_lo=img.imm_lo, imm_hi=img.imm_hi,
+        op_id=(img.op_id if img.op_id is not None
+               else np.zeros(img.code_len, np.int32)))
+    brt = img.br_table.copy()
+    brt[:, 0] += pc_b
+    # each tenant's table slot is its table_cap rows (grow room);
+    # per-instruction capacity (b of CLS_TABLE_GROW) is already the
+    # slot size, so growth can never cross into a neighbour's slot
+    slot = max(int(img.table_cap or img.table0.shape[0]),
+               img.table0.shape[0])
+    tbl = np.zeros(slot, img.table0.dtype)
+    tbl[:img.table0.shape[0]] = img.table0
+    tbl[tbl != 0] += fn_b
+    # segment snapshots: flat entries rebase with the function index
+    # space (funcref domain), offsets with the flat concatenation
+    ef = img.elem_flat.copy() if img.elem_flat is not None \
+        else np.zeros(1, np.int32)
+    ef[ef != 0] += fn_b
+    eoff = (img.elem_off if img.elem_off is not None
+            else np.zeros(1, np.int32)) + eflat_b
+    elen = (img.elem_len if img.elem_len is not None
+            else np.zeros(1, np.int32))
+    dwords = (img.data_words if img.data_words is not None
+              else np.zeros(1, np.int32))
+    doff = (img.data_off if img.data_off is not None
+            else np.zeros(1, np.int32)) + dbyte_b
+    dlen = (img.data_len if img.data_len is not None
+            else np.zeros(1, np.int32))
     # superinstruction fusion planes (batch/fuse.py): per-tenant runs
     # concatenate with NO pc rebasing needed beyond the plane offset
     # (runs are block-local); pattern ids remap into one deduped table
-    flen_parts, fpat_parts = [], []
-    merged_patterns: list = []
-    pat_map: dict = {}
-    any_fuse = False
+    t_flen = getattr(img, "fuse_len", None)
+    new_patterns: list = []
+    if t_flen is None:
+        has_fuse = False
+        flen = np.zeros(img.code_len, np.int32)
+        fpat = np.full(img.code_len, -1, np.int32)
+    else:
+        has_fuse = True
+        pat_map = {key: i for i, key in enumerate(pat_state)}
+        remap = {}
+        for ki, key in enumerate(img.fuse_patterns or ()):
+            k2 = pat_map.get(key)
+            if k2 is None:
+                k2 = len(pat_map)
+                pat_map[key] = k2
+                new_patterns.append(key)
+            remap[ki] = k2
+        flen = np.asarray(t_flen, np.int32).copy()
+        fpat = np.full(img.code_len, -1, np.int32)
+        for p in np.nonzero(flen >= 2)[0]:
+            k2 = remap.get(int(img.fuse_pat[p]), -1)
+            if 0 <= k2 < _CONCAT_MAX_PATTERNS:
+                fpat[p] = k2
+            else:
+                flen[p] = 0  # beyond the merged cap: stay per-op
     # whole-function promotion planes (batch/tierup.py): entry pcs,
     # block lists and branch targets all rebase by the plane offset,
     # slots by the running promoted count — the compiled bodies read
     # the CONCATENATED planes at the rebased static pcs, which match
     # the tenant planes verbatim (cls/sub/b/c/imms copy; `a` rebases
     # identically for branches on both sides)
-    tfn_parts, tfb_parts = [], []
-    merged_tier_fns: list = []
-    tier_slot_b = 0
-    any_tier = False
-    bases = []
-    pc_b = fn_b = gl_b = ty_b = brt_b = tbl_b = 0
-    eseg_b = eflat_b = dseg_b = dbyte_b = 0
+    t_tfn = getattr(img, "tier_fn", None)
+    tier_fns: list = []
+    if t_tfn is None:
+        has_tier = False
+        tfn = np.full(img.code_len, -1, np.int32)
+        tfb = np.zeros(img.code_len, np.int32)
+        ntier = 0
+    else:
+        has_tier = True
+        tfn = np.asarray(t_tfn, np.int32).copy()
+        tfn[tfn >= 0] += tier_slot_b
+        tfb = np.asarray(img.tier_fuel_bound, np.int32)
+        for p in img.tier_fns:
+            tier_fns.append(dict(
+                p,
+                slot=p["slot"] + tier_slot_b,
+                entry_pc=p["entry_pc"] + pc_b,
+                end_pc=p["end_pc"] + pc_b,
+                blocks=[dict(bk, start=bk["start"] + pc_b,
+                             end=bk["end"] + pc_b,
+                             succ=tuple(s + pc_b
+                                        for s in bk["succ"]))
+                        for bk in p["blocks"]],
+            ))
+        ntier = len(img.tier_fns)
+    f_parts = dict(
+        f_entry=img.f_entry + pc_b,
+        f_nparams=img.f_nparams,
+        f_nlocals=img.f_nlocals,
+        f_nresults=img.f_nresults,
+        f_frame_top=img.f_frame_top,
+        f_type=img.f_type + ty_b,
+    )
+    v128 = img.v128 if img.v128 is not None else np.zeros((1, 4), np.int32)
+    advance = dict(
+        pc=img.code_len,
+        func=len(img.f_entry),
+        glob=img.globals_lo.shape[0],
+        type=int(img.f_type.max(initial=0)) + 1,
+        brt=img.br_table.shape[0],
+        table=slot,
+        v128=v128.shape[0],
+        eseg=elen.shape[0],
+        eflat=ef.shape[0],
+        dseg=dlen.shape[0],
+        dbyte=4 * dwords.shape[0],
+        tier_slot=ntier,
+    )
+    return Segment(base=base, planes=planes, brt=brt, tbl=tbl, ef=ef,
+                   eoff=eoff, elen=elen, dwords=dwords, doff=doff,
+                   dlen=dlen, flen=flen, fpat=fpat, has_fuse=has_fuse,
+                   new_patterns=new_patterns, tfn=tfn, tfb=tfb,
+                   tier_fns=tier_fns, has_tier=has_tier,
+                   f_parts=f_parts, g_lo=img.globals_lo,
+                   g_hi=img.globals_hi, v128=v128, advance=advance)
+
+
+def concat_images(tenants: Sequence[Tenant], cache=None
+                  ) -> Tuple[DeviceImage, list]:
+    """Concatenate tenant DeviceImages into one super-image.
+
+    Returns (image, bases) where bases[i] = dict of per-tenant index-space
+    offsets (pc/func/glob/type/brt/table/eseg/dseg) — the indirection
+    table.  `cache` (an imagestore SegmentCache, or None) memoizes the
+    rebased per-tenant segments: with a cache, appending one module to an
+    N-module generation rebuilds exactly one segment; without one this is
+    the same per-tenant loop as ever, one build_segment call each, so the
+    cache-off path is bit-identical by construction."""
+    off = dict(pc=0, func=0, glob=0, type=0, brt=0, table=0, v128=0,
+               eseg=0, eflat=0, dseg=0, dbyte=0, tier_slot=0)
+    merged_patterns: list = []
+    segments: List[Segment] = []
     for t in tenants:
-        img = t.img
         # planning is deferred to first build — run each tenant's
         # translation pass now so the concatenated planes see it
         # (idempotent; knob off plans nothing)
@@ -125,159 +307,48 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
         plan_t = getattr(t.engine, "_plan_tierup", None)
         if plan_t is not None:
             plan_t()
-        base = dict(pc=pc_b, func=fn_b, glob=gl_b, type=ty_b, brt=brt_b,
-                    table=tbl_b)
-        bases.append(base)
-        a = img.a.copy()
-        b = img.b.copy()
-        c = img.c.copy()
-        cls = img.cls
-        is_branch = (cls == CLS_BR) | (cls == CLS_BRZ) | (cls == CLS_BRNZ)
-        a[is_branch] += pc_b
-        a[cls == CLS_CALL] += fn_b
-        a[cls == CLS_RETCALL] += fn_b
-        a[cls == CLS_HOSTCALL] += fn_b
-        a[(cls == CLS_GLOBAL_GET) | (cls == CLS_GLOBAL_SET)] += gl_b
-        is_ci = (cls == CLS_CALL_INDIRECT) | (cls == CLS_RETCALL_INDIRECT)
-        a[is_ci] += ty_b
-        c[is_ci] += tbl_b
-        a[cls == CLS_BR_TABLE] += brt_b
-        a[(cls == CLS_VCONST) | (cls == CLS_VSHUFFLE)] += v128_b
-        # table ops address the tenant's slot [tbl_b, tbl_b + slot) in
-        # the concatenated plane; ref.func pushes rebase with the
-        # function index space
-        is_tb = np.isin(cls, (CLS_TABLE_GET, CLS_TABLE_SET, CLS_TABLE_SIZE,
-                              CLS_TABLE_GROW, CLS_TABLE_FILL,
-                              CLS_TABLE_COPY, CLS_TABLE_INIT))
-        c[is_tb] += tbl_b
-        a[(cls == CLS_TABLE_INIT) | (cls == CLS_ELEM_DROP)] += eseg_b
-        a[(cls == CLS_MEMINIT) | (cls == CLS_DATA_DROP)] += dseg_b
-        a[cls == CLS_REFFUNC] += fn_b
-        planes["cls"].append(cls)
-        planes["sub"].append(img.sub)
-        planes["a"].append(a)
-        planes["b"].append(b)
-        planes["c"].append(c)
-        planes["imm_lo"].append(img.imm_lo)
-        planes["imm_hi"].append(img.imm_hi)
-        planes.setdefault("op_id", []).append(
-            img.op_id if img.op_id is not None
-            else np.zeros(img.code_len, np.int32))
-        brt = img.br_table.copy()
-        brt[:, 0] += pc_b
-        brt_parts.append(brt)
-        # each tenant's table slot is its table_cap rows (grow room);
-        # per-instruction capacity (b of CLS_TABLE_GROW) is already the
-        # slot size, so growth can never cross into a neighbour's slot
-        slot = max(int(img.table_cap or img.table0.shape[0]),
-                   img.table0.shape[0])
-        tbl = np.zeros(slot, img.table0.dtype)
-        tbl[:img.table0.shape[0]] = img.table0
-        tbl[tbl != 0] += fn_b
-        tbl_parts.append(tbl)
-        # segment snapshots: flat entries rebase with the function index
-        # space (funcref domain), offsets with the flat concatenation
-        ef = img.elem_flat.copy() if img.elem_flat is not None             else np.zeros(1, np.int32)
-        ef[ef != 0] += fn_b
-        eflat_parts.append(ef)
-        eoff_parts.append((img.elem_off if img.elem_off is not None
-                           else np.zeros(1, np.int32)) + eflat_b)
-        elen_parts.append(img.elem_len if img.elem_len is not None
-                          else np.zeros(1, np.int32))
-        dword_parts.append(img.data_words if img.data_words is not None
-                           else np.zeros(1, np.int32))
-        doff_parts.append((img.data_off if img.data_off is not None
-                           else np.zeros(1, np.int32)) + dbyte_b)
-        dlen_parts.append(img.data_len if img.data_len is not None
-                          else np.zeros(1, np.int32))
-        t_flen = getattr(img, "fuse_len", None)
-        if t_flen is None:
-            flen_parts.append(np.zeros(img.code_len, np.int32))
-            fpat_parts.append(np.full(img.code_len, -1, np.int32))
-        else:
-            any_fuse = True
-            remap = {}
-            for ki, key in enumerate(img.fuse_patterns or ()):
-                k2 = pat_map.get(key)
-                if k2 is None:
-                    k2 = len(merged_patterns)
-                    merged_patterns.append(key)
-                    pat_map[key] = k2
-                remap[ki] = k2
-            flen2 = np.asarray(t_flen, np.int32).copy()
-            fpat2 = np.full(img.code_len, -1, np.int32)
-            for p in np.nonzero(flen2 >= 2)[0]:
-                k2 = remap.get(int(img.fuse_pat[p]), -1)
-                if 0 <= k2 < _CONCAT_MAX_PATTERNS:
-                    fpat2[p] = k2
-                else:
-                    flen2[p] = 0  # beyond the merged cap: stay per-op
-            flen_parts.append(flen2)
-            fpat_parts.append(fpat2)
-        t_tfn = getattr(img, "tier_fn", None)
-        if t_tfn is None:
-            tfn_parts.append(np.full(img.code_len, -1, np.int32))
-            tfb_parts.append(np.zeros(img.code_len, np.int32))
-        else:
-            any_tier = True
-            tfn2 = np.asarray(t_tfn, np.int32).copy()
-            tfn2[tfn2 >= 0] += tier_slot_b
-            tfn_parts.append(tfn2)
-            tfb_parts.append(np.asarray(img.tier_fuel_bound, np.int32))
-            for p in img.tier_fns:
-                merged_tier_fns.append(dict(
-                    p,
-                    slot=p["slot"] + tier_slot_b,
-                    entry_pc=p["entry_pc"] + pc_b,
-                    end_pc=p["end_pc"] + pc_b,
-                    blocks=[dict(bk, start=bk["start"] + pc_b,
-                                 end=bk["end"] + pc_b,
-                                 succ=tuple(s + pc_b
-                                            for s in bk["succ"]))
-                            for bk in p["blocks"]],
-                ))
-            tier_slot_b += len(img.tier_fns)
-        f_parts["f_entry"].append(img.f_entry + pc_b)
-        f_parts["f_nparams"].append(img.f_nparams)
-        f_parts["f_nlocals"].append(img.f_nlocals)
-        f_parts["f_nresults"].append(img.f_nresults)
-        f_parts["f_frame_top"].append(img.f_frame_top)
-        f_parts["f_type"].append(img.f_type + ty_b)
-        g_lo_parts.append(img.globals_lo)
-        g_hi_parts.append(img.globals_hi)
-        v128_parts.append(img.v128 if img.v128 is not None
-                          else np.zeros((1, 4), np.int32))
-        v128_b += v128_parts[-1].shape[0]
-        pc_b += img.code_len
-        fn_b += len(img.f_entry)
-        gl_b += img.globals_lo.shape[0]
-        ty_b += int(img.f_type.max(initial=0)) + 1
-        brt_b += img.br_table.shape[0]
-        tbl_b += slot
-        eseg_b += elen_parts[-1].shape[0]
-        eflat_b += eflat_parts[-1].shape[0]
-        dseg_b += dlen_parts[-1].shape[0]
-        dbyte_b += 4 * dword_parts[-1].shape[0]
+        pat_state = tuple(merged_patterns)
+        seg = cache.lookup(t.img, off, pat_state) if cache is not None \
+            else None
+        if seg is None:
+            seg = build_segment(t, off, pat_state)
+            if cache is not None:
+                cache.store(t.img, off, pat_state, seg)
+        segments.append(seg)
+        merged_patterns.extend(seg.new_patterns)
+        for k, v in seg.advance.items():
+            off[k] += v
+    bases = [seg.base for seg in segments]
+    any_fuse = any(seg.has_fuse for seg in segments)
+    any_tier = any(seg.has_tier for seg in segments)
+    # promotion descriptors are copied out of the (possibly cached,
+    # cross-generation) segments so no two images ever share dicts
+    merged_tier_fns = [dict(p, blocks=[dict(bk) for bk in p["blocks"]])
+                       for seg in segments for p in seg.tier_fns]
 
     image = DeviceImage(
-        cls=np.concatenate(planes["cls"]),
-        sub=np.concatenate(planes["sub"]),
-        a=np.concatenate(planes["a"]),
-        b=np.concatenate(planes["b"]),
-        c=np.concatenate(planes["c"]),
-        imm_lo=np.concatenate(planes["imm_lo"]),
-        imm_hi=np.concatenate(planes["imm_hi"]),
-        op_id=np.concatenate(planes["op_id"]),
-        br_table=np.concatenate(brt_parts, axis=0),
-        f_entry=np.concatenate(f_parts["f_entry"]),
-        f_nparams=np.concatenate(f_parts["f_nparams"]),
-        f_nlocals=np.concatenate(f_parts["f_nlocals"]),
-        f_nresults=np.concatenate(f_parts["f_nresults"]),
-        f_frame_top=np.concatenate(f_parts["f_frame_top"]),
-        f_type=np.concatenate(f_parts["f_type"]),
-        table0=np.concatenate(tbl_parts),
-        globals_lo=np.concatenate(g_lo_parts),
-        globals_hi=np.concatenate(g_hi_parts),
+        cls=np.concatenate([s.planes["cls"] for s in segments]),
+        sub=np.concatenate([s.planes["sub"] for s in segments]),
+        a=np.concatenate([s.planes["a"] for s in segments]),
+        b=np.concatenate([s.planes["b"] for s in segments]),
+        c=np.concatenate([s.planes["c"] for s in segments]),
+        imm_lo=np.concatenate([s.planes["imm_lo"] for s in segments]),
+        imm_hi=np.concatenate([s.planes["imm_hi"] for s in segments]),
+        op_id=np.concatenate([s.planes["op_id"] for s in segments]),
+        br_table=np.concatenate([s.brt for s in segments], axis=0),
+        f_entry=np.concatenate([s.f_parts["f_entry"] for s in segments]),
+        f_nparams=np.concatenate([s.f_parts["f_nparams"]
+                                  for s in segments]),
+        f_nlocals=np.concatenate([s.f_parts["f_nlocals"]
+                                  for s in segments]),
+        f_nresults=np.concatenate([s.f_parts["f_nresults"]
+                                   for s in segments]),
+        f_frame_top=np.concatenate([s.f_parts["f_frame_top"]
+                                    for s in segments]),
+        f_type=np.concatenate([s.f_parts["f_type"] for s in segments]),
+        table0=np.concatenate([s.tbl for s in segments]),
+        globals_lo=np.concatenate([s.g_lo for s in segments]),
+        globals_hi=np.concatenate([s.g_hi for s in segments]),
         mem_init=np.zeros(1, np.int32),       # per-lane init in the engine
         # watermark sizing reads mem_pages_init; cover every tenant's
         # initial pages (per-lane counts come from initial_state)
@@ -287,22 +358,24 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
                            if t.img.has_memory), default=0),
         has_memory=any(t.img.has_memory for t in tenants),
         max_local_zeros=max(t.img.max_local_zeros for t in tenants),
-        code_len=pc_b,
-        v128=np.concatenate(v128_parts, axis=0),
+        code_len=off["pc"],
+        v128=np.concatenate([s.v128 for s in segments], axis=0),
         has_simd=any(t.img.has_simd for t in tenants),
-        elem_flat=np.concatenate(eflat_parts),
-        elem_off=np.concatenate(eoff_parts),
-        elem_len=np.concatenate(elen_parts),
-        data_words=np.concatenate(dword_parts),
-        data_off=np.concatenate(doff_parts),
-        data_len=np.concatenate(dlen_parts),
-        table_cap=tbl_b,
+        elem_flat=np.concatenate([s.ef for s in segments]),
+        elem_off=np.concatenate([s.eoff for s in segments]),
+        elem_len=np.concatenate([s.elen for s in segments]),
+        data_words=np.concatenate([s.dwords for s in segments]),
+        data_off=np.concatenate([s.doff for s in segments]),
+        data_len=np.concatenate([s.dlen for s in segments]),
+        table_cap=off["table"],
         has_table_mut=any(getattr(t.img, "has_table_mut", False)
                           for t in tenants),
         has_table_grow=any(getattr(t.img, "has_table_grow", False)
                            for t in tenants),
-        fuse_len=np.concatenate(flen_parts) if any_fuse else None,
-        fuse_pat=np.concatenate(fpat_parts) if any_fuse else None,
+        fuse_len=(np.concatenate([s.flen for s in segments])
+                  if any_fuse else None),
+        fuse_pat=(np.concatenate([s.fpat for s in segments])
+                  if any_fuse else None),
         fuse_patterns=tuple(merged_patterns[:_CONCAT_MAX_PATTERNS])
         if any_fuse else None,
         fusion_report={
@@ -311,8 +384,8 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
             # recomputed from the MERGED planes (a run whose pattern
             # fell beyond the merged cap reverted to per-op cells and
             # must not be counted)
-            "fused_runs": int(sum((p >= 2).sum() for p in flen_parts)),
-            "fused_cells": int(sum(p.sum() for p in flen_parts)),
+            "fused_runs": int(sum((s.flen >= 2).sum() for s in segments)),
+            "fused_cells": int(sum(s.flen.sum() for s in segments)),
             "candidates": [], "runs": [],
         },
     )
@@ -320,9 +393,10 @@ def concat_images(tenants: Sequence[Tenant]) -> Tuple[DeviceImage, list]:
     # plan_tierup binds them (batch/tierup.py); the report doubles as
     # the planned-sentinel so the merged engine's _plan_tierup never
     # re-plans (the concat image has no ModuleAnalysis to plan from)
-    image.tier_fn = np.concatenate(tfn_parts) if any_tier else None
-    image.tier_fuel_bound = (np.concatenate(tfb_parts) if any_tier
-                             else None)
+    image.tier_fn = (np.concatenate([s.tfn for s in segments])
+                     if any_tier else None)
+    image.tier_fuel_bound = (np.concatenate([s.tfb for s in segments])
+                             if any_tier else None)
     image.tier_fns = tuple(merged_tier_fns)
     image.tierup_report = {
         "enabled": any_tier,
@@ -356,7 +430,8 @@ class MultiTenantBatchEngine(BatchEngine):
         self.cfg = self.conf.batch
         self.lanes = sum(t.lanes for t in self.tenants)
         self.inst = self.tenants[0].inst  # nresults fallback; see run()
-        self.img, self.bases = concat_images(self.tenants)
+        self.img, self.bases = concat_images(
+            self.tenants, cache=getattr(self, "_segment_cache", None))
         self._func_owner = []
         for ti, t in enumerate(self.tenants):
             self._func_owner.extend([ti] * len(t.img.f_entry))
@@ -450,12 +525,16 @@ class MultiTenantBatchEngine(BatchEngine):
             **self._r05_planes(),
         )
 
-    def _r05_planes(self, tsize: Optional[np.ndarray] = None) -> dict:
+    def _r05_planes(self, tsize: Optional[np.ndarray] = None,
+                    patches: Optional[dict] = None) -> dict:
         """Concatenated-image variant of engine.r05_state_planes: the
         tab plane holds every tenant's slot; `tsize` is the per-lane
         table-size vector — None derives the fixed-cohort default
         (each tenant's slice sees its own table size); the serving
-        engine passes a lane-uniform vector instead."""
+        engine passes a lane-uniform vector instead.  `patches` is the
+        snapshot-overlay row-range writes ({"tab"/"edrop"/"ddrop":
+        (row0, column)}) applied lane-uniformly before upload; None
+        (every non-snapshot caller) leaves the planes untouched."""
         import jax.numpy as jnp
 
         img = self.img
@@ -466,6 +545,11 @@ class MultiTenantBatchEngine(BatchEngine):
             tb = np.zeros((T, L), np.int32)
             n0 = min(img.table0.shape[0], T)
             tb[:n0] = img.table0[:n0, None]
+            if patches and "tab" in patches:
+                row0, col = patches["tab"]
+                n = min(col.shape[0], T - row0)
+                if n > 0:
+                    tb[row0:row0 + n] = col[:n, None]
             if tsize is None:
                 tsize = np.zeros(L, np.int32)
                 for ti, t in enumerate(self.tenants):
@@ -473,9 +557,21 @@ class MultiTenantBatchEngine(BatchEngine):
             out["tab"] = jnp.asarray(tb)
             out["tsize"] = jnp.asarray(np.asarray(tsize, np.int32))
         if bool(np.isin(img.cls, (CLS_TABLE_INIT, CLS_ELEM_DROP)).any()):
-            out["edrop"] = jnp.zeros((img.elem_len.shape[0], L), jnp.int32)
+            ed = np.zeros((img.elem_len.shape[0], L), np.int32)
+            if patches and "edrop" in patches:
+                row0, col = patches["edrop"]
+                n = min(col.shape[0], ed.shape[0] - row0)
+                if n > 0:
+                    ed[row0:row0 + n] = col[:n, None]
+            out["edrop"] = jnp.asarray(ed)
         if bool(np.isin(img.cls, (CLS_MEMINIT, CLS_DATA_DROP)).any()):
-            out["ddrop"] = jnp.zeros((img.data_len.shape[0], L), jnp.int32)
+            dd = np.zeros((img.data_len.shape[0], L), np.int32)
+            if patches and "ddrop" in patches:
+                row0, col = patches["ddrop"]
+                n = min(col.shape[0], dd.shape[0] - row0)
+                if n > 0:
+                    dd[row0:row0 + n] = col[:n, None]
+            out["ddrop"] = jnp.asarray(dd)
         return out
 
     def _try_pallas(self):
@@ -640,7 +736,8 @@ class MultiModuleBatchEngine(MultiTenantBatchEngine):
 
     def __init__(self, modules: Sequence[Tuple[str, object, object]],
                  conf=None, lanes: Optional[int] = None, engines=None,
-                 mesh=None):
+                 mesh=None, segment_cache=None, init_overlays=None,
+                 snapshot_counts=None):
         if not modules:
             raise ValueError("no modules")
         names = [name for name, _, _ in modules]
@@ -655,7 +752,13 @@ class MultiModuleBatchEngine(MultiTenantBatchEngine):
                 else BatchEngine(inst, store=store, conf=conf, lanes=1)
             tenants.append(Tenant(engine=eng, func_name="",
                                   args_lanes=[], lanes=0))
+        # segment memoization must be visible to the base __init__'s
+        # concat_images call; overlays only matter to initial_state
+        self._segment_cache = segment_cache
         super().__init__(tenants, conf=conf, mesh=mesh)
+        self._init_overlays = dict(init_overlays) if init_overlays else {}
+        self.snapshot_counts = (snapshot_counts
+                                if snapshot_counts is not None else {})
         self.lanes = int(lanes) if lanes else self.cfg.lanes
         if mesh is not None:
             # even lane split across the mesh: round the serving pool
@@ -692,6 +795,19 @@ class MultiModuleBatchEngine(MultiTenantBatchEngine):
         """Owning module name of an engine-global function index."""
         return self.module_names[self._func_owner[func_idx]]
 
+    def note_snapshot_install(self, func_idx: int, n: int) -> None:
+        """Recycler hook: count lanes admitted onto a snapshot overlay.
+
+        serve/recycle.py calls this on every install; only entries whose
+        owning module carries a pre-initialized overlay count (modules
+        without one admit through plain template init)."""
+        if not self._init_overlays:
+            return
+        if self.module_names[self._func_owner[func_idx]] \
+                in self._init_overlays:
+            self.snapshot_counts["installs"] = \
+                self.snapshot_counts.get("installs", 0) + int(n)
+
     def exported_funcs(self, module: str) -> List[str]:
         return self.tenants[self._mod_index[module]].inst.func_names()
 
@@ -722,6 +838,46 @@ class MultiModuleBatchEngine(MultiTenantBatchEngine):
             pages = t.img.mem_pages_init
             n = min(t.img.mem_init.shape[0], mem_words)
             mem[:n] = t.img.mem_init[:n, None]
+        g_lo = np.repeat(img.globals_lo[:, None], L, axis=1)
+        g_hi = np.repeat(img.globals_hi[:, None], L, axis=1)
+        tsize_val = t.img.table_size_init
+        patches = None
+        ov = (self._init_overlays.get(self.module_names[ti])
+              if getattr(self, "_init_overlays", None) else None)
+        if ov is not None:
+            # pre-initialized snapshot overlay (imagestore/snapshot.py):
+            # the captured post-init columns replace the owning module's
+            # template init in every lane — memory/pages from row 0 of
+            # the shared per-lane planes, globals/table/drop flags into
+            # the module's segment rows via the indirection bases
+            om = ov.get("mem")
+            if om is not None:
+                n = min(om.shape[0], mem_words)
+                mem[:n] = om[:n, None]
+            if ov.get("mem_pages") is not None:
+                pages = int(ov["mem_pages"])
+            og = ov.get("glob_lo")
+            if og is not None:
+                gb = self.bases[ti]["glob"]
+                g_lo[gb:gb + og.shape[0]] = og[:, None]
+                oh = ov["glob_hi"]
+                g_hi[gb:gb + oh.shape[0]] = oh[:, None]
+            patches = {}
+            ot = ov.get("tab")
+            if ot is not None:
+                # runtime table entries are funcidx+1 (0 = null); rebase
+                # exactly the way concat rebases table0 snapshots
+                col = np.asarray(ot, np.int32).copy()
+                col[col != 0] += self.bases[ti]["func"]
+                patches["tab"] = (self.bases[ti]["table"], col)
+            if ov.get("tsize") is not None:
+                tsize_val = int(ov["tsize"])
+            if ov.get("edrop") is not None:
+                patches["edrop"] = (self.bases[ti]["eseg"],
+                                    np.asarray(ov["edrop"], np.int32))
+            if ov.get("ddrop") is not None:
+                patches["ddrop"] = (self.bases[ti]["dseg"],
+                                    np.asarray(ov["ddrop"], np.int32))
         fuel0 = cfg.fuel_per_launch if cfg.fuel_per_launch is not None \
             else 0
         return BatchState(
@@ -739,10 +895,8 @@ class MultiModuleBatchEngine(MultiTenantBatchEngine):
             fr_ret_pc=jnp.zeros((CD, L), jnp.int32),
             fr_fp=jnp.zeros((CD, L), jnp.int32),
             fr_opbase=jnp.zeros((CD, L), jnp.int32),
-            glob_lo=jnp.asarray(
-                np.repeat(img.globals_lo[:, None], L, axis=1)),
-            glob_hi=jnp.asarray(
-                np.repeat(img.globals_hi[:, None], L, axis=1)),
+            glob_lo=jnp.asarray(g_lo),
+            glob_hi=jnp.asarray(g_hi),
             mem=jnp.asarray(mem),
             stack_e2=jnp.zeros((D, L), jnp.int32) if img.has_simd
             else None,
@@ -753,7 +907,7 @@ class MultiModuleBatchEngine(MultiTenantBatchEngine):
             # slot — table ops address slots through the rebased
             # instruction words)
             **self._r05_planes(
-                np.full(L, t.img.table_size_init, np.int32)),
+                np.full(L, tsize_val, np.int32), patches=patches),
         )
 
 

@@ -420,6 +420,49 @@ class HvConfigure:
 
 
 @dataclasses.dataclass
+class ImagestoreConfigure:
+    """Knobs for the segmented-image / compile-cache / snapshot
+    subsystem (wasmedge_tpu/imagestore/, r22).
+
+    All three default OFF: the off configuration runs the exact r21
+    code path (concat_images builds every segment inline, the registry
+    consults no disk cache, initial_state carries no overlays), so
+    behavior is bit-identical by construction."""
+
+    # Memoize per-module image segments across generation builds: a
+    # generation swap re-uses every already-built segment verbatim and
+    # only builds the new module's (the indirection table is the bases
+    # list).  CLI: --imagestore-segmented.
+    segmented: bool = False
+    # Persistent cross-process compile cache: registration consults a
+    # sha256-keyed serialized-image cache before lowering, and stores
+    # fresh lowerings back.  Entries fleet-replicate alongside module
+    # blobs (GET /v1/fleet/cache/<sha>).  CLI: --compile-cache.
+    compile_cache: bool = False
+    # Cache directory.  None + a gateway state_dir -> <state_dir>/
+    # compilecache; None without one -> in-memory only (still unifies
+    # the probe tier and serves fleet replication, but does not
+    # survive a process restart).
+    compile_cache_dir: Optional[str] = None
+    # Pre-initialized lane snapshots: run a module's _initialize/_start
+    # once at registration, capture the post-init plane columns
+    # (content-addressed SwapStore entry sized by the r19 page-touch
+    # bound), and install that snapshot into admitted lanes through the
+    # existing jitted column-set pass.  CLI: --snapshots.
+    snapshots: bool = False
+    # Snapshot SwapStore spill directory (None = host memory only).
+    snapshot_dir: Optional[str] = None
+    # Step budget for the one-time registration init run; a module
+    # whose init exceeds it (or traps) simply gets no snapshot and
+    # admits through the r21 template path.
+    snapshot_init_max_steps: int = 2_000_000
+
+    @property
+    def active(self) -> bool:
+        return self.segmented or self.compile_cache or self.snapshots
+
+
+@dataclasses.dataclass
 class CompilerConfigure:
     """AOT-compiler knobs (reference: CompilerConfigure,
     include/common/configure.h:28-106).  The optimization level and
@@ -449,6 +492,8 @@ class Configure:
     obs: ObsConfigure = dataclasses.field(default_factory=ObsConfigure)
     serve: ServeConfigure = dataclasses.field(default_factory=ServeConfigure)
     hv: HvConfigure = dataclasses.field(default_factory=HvConfigure)
+    imagestore: ImagestoreConfigure = dataclasses.field(
+        default_factory=ImagestoreConfigure)
     compiler: CompilerConfigure = dataclasses.field(default_factory=CompilerConfigure)
 
     def add_proposal(self, p: Proposal) -> "Configure":

@@ -209,6 +209,7 @@ class FleetController:
         self.counters = {
             "heartbeats_ok": 0, "heartbeats_missed": 0,
             "modules_synced": 0, "module_conflicts": 0,
+            "cache_synced": 0,
             "adoptions": 0, "adoptions_replayed": 0,
             "forwards": 0, "forward_requeues": 0,
             "migrations_out": 0, "migrations_in": 0,
@@ -671,6 +672,18 @@ class FleetController:
             if data is not None and rm.sha256:
                 self._module_bytes[rm.sha256] = bytes(data)
 
+    def cache_bytes(self, sha256: str) -> Optional[bytes]:
+        """Serve a compile-cache entry (raw header+payload,
+        imagestore/compilecache.py) to a peer; None when the cache is
+        off or has no entry for this sha."""
+        cc = self.svc.registry.compile_cache
+        if not cc.enabled:
+            return None
+        try:
+            return cc.entry_bytes(sha256)
+        except KeyError:
+            return None
+
     # -- module replication ------------------------------------------------
     def _sync_modules(self):
         """Fetch + register every module a peer advertises that we do
@@ -704,6 +717,24 @@ class FleetController:
                     continue
                 if hashlib.sha256(data).hexdigest() != sha:
                     continue   # corrupt transfer: the next tick refetches
+                # compile-cache replication (r22): pull the peer's
+                # lowered-image entry FIRST so the registration below
+                # adopts it instead of re-lowering.  Best-effort — a
+                # peer without the entry (or a corrupt one, rejected by
+                # adopt_entry's digest check) just means a local lower.
+                cc = self.svc.registry.compile_cache
+                if cc.enabled:
+                    try:
+                        cst, craw = self._client.request(
+                            p.peer_id, p.url, "GET",
+                            f"/v1/fleet/cache/{sha}", raw=True)
+                        if cst == 200 and cc.adopt_entry(sha, craw):
+                            with self._lock:
+                                self.counters["cache_synced"] += 1
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except BaseException:
+                        pass
                 self.svc.register_module(name, wasm_bytes=bytes(data),
                                          source=f"fleet/{p.peer_id}")
                 with self._lock:

@@ -164,6 +164,15 @@ class DurableStore:
                   "rb") as f:
             return f.read()
 
+    def compile_cache_dir(self) -> str:
+        """Directory for the persistent compile cache's entry files,
+        beside the module blobs (same crash-survivability story: a
+        resumed gateway re-registers the manifest's modules and every
+        lowering comes off this cache instead of the validator)."""
+        path = os.path.join(self.dir, "compilecache")
+        os.makedirs(path, exist_ok=True)
+        return path
+
     # -- snapshots ---------------------------------------------------------
     def write_manifest(self, modules: List[dict], generation: int,
                        serve_dir: str, restarts: int):

@@ -331,6 +331,16 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 raise KeyError(f"no module blob {sha[:12]}")
             return self._reply(200, data,
                                content_type="application/wasm")
+        if path.startswith("/v1/fleet/cache/"):
+            # compile-cache replication (r22): raw entry bytes, digest
+            # verified end to end by the receiver's adopt_entry
+            sha = path.rsplit("/", 1)[1]
+            fl._recv("cache", self.headers.get("X-Fleet-Peer"))
+            data = fl.cache_bytes(sha)
+            if data is None:
+                raise KeyError(f"no cache entry {sha[:12]}")
+            return self._reply(200, data,
+                               content_type="application/octet-stream")
         if path == "/v1/fleet/manifest":
             fl._recv("manifest", self.headers.get("X-Fleet-Peer"))
             return self._reply(200, fl._hello())

@@ -24,16 +24,9 @@ from __future__ import annotations
 
 import hashlib
 import threading
-from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from wasmedge_tpu.common.errors import ErrCode, WasmError
-
-# Rejected-registration probe cache depth: engines stashed on the
-# reject path (admission-policy violation, generation-build failure)
-# waiting for a re-POST of the same bytes.  Small — each entry pins an
-# instantiated module + two sink fds.
-_PROBE_CACHE_DEPTH = 4
 
 
 class RegisteredModule:
@@ -43,7 +36,7 @@ class RegisteredModule:
     reuses — registering module N must not re-lower modules 1..N-1)."""
 
     __slots__ = ("name", "inst", "store", "engine", "sha256", "nbytes",
-                 "source", "tenant", "wasi", "_sink_fds")
+                 "source", "tenant", "wasi", "snapshot", "_sink_fds")
 
     def __init__(self, name, inst, store, engine, sha256="", nbytes=0,
                  source="boot", tenant=None, sink_fds=(), wasi=None):
@@ -59,6 +52,9 @@ class RegisteredModule:
         # under the same attribution (gateway/durable.py).
         self.tenant = tenant
         self.wasi = wasi  # per-module WasiModule (None on boot path)
+        # imagestore SnapshotEntry captured at registration (None =
+        # no usable init export / snapshots off / capture skipped)
+        self.snapshot = None
         self._sink_fds = list(sink_fds)
 
     def rename(self, name: str):
@@ -94,14 +90,21 @@ class ModuleRegistry:
         self._mods: Dict[str, RegisteredModule] = {}
         self._order: List[str] = []
         self._lock = threading.Lock()
-        # sha256 -> RegisteredModule whose registration was rolled back
-        # AFTER the (expensive) lowering succeeded — the batchability
-        # probe result.  A later add_wasm of identical bytes adopts it
-        # instead of lowering twice (rejected-then-fixed round trips).
-        self._probe_cache: "OrderedDict[str, RegisteredModule]" = \
-            OrderedDict()
-        # lowerings actually performed (probe-cache hits don't count) —
-        # pinned by tests to prove the reject path reuses the engine
+        # ONE sha256-keyed lowering cache (imagestore/compilecache.py):
+        # its probe tier is the r12 rejected-registration stash (a later
+        # add_wasm of identical bytes adopts the parked engine instead
+        # of lowering twice); its persistent tier — inert until the
+        # gateway enables it — holds aot image payloads that survive
+        # restarts and replicate across the fleet.
+        from wasmedge_tpu.imagestore.compilecache import CompileCache
+
+        self.compile_cache = CompileCache()
+        # generation-segment memoization (imagestore/segments.py); the
+        # gateway installs one when Configure.imagestore.segmented is on
+        self.segment_cache = None
+        # lowerings actually performed (probe-cache and compile-cache
+        # hits don't count) — pinned by tests to prove the reject path
+        # and the persistent cache reuse the engine/image
         self.lowered_count = 0
 
     def __len__(self) -> int:
@@ -130,8 +133,7 @@ class ModuleRegistry:
 
         data = bytes(data)
         sha = hashlib.sha256(data).hexdigest()
-        with self._lock:
-            cached = self._probe_cache.pop(sha, None)
+        cached = self.compile_cache.pop_probe(sha)
         if cached is not None:
             # an identical module was lowered and then rolled back
             # (policy rejection, failed generation build): adopt the
@@ -140,8 +142,13 @@ class ModuleRegistry:
             cached.source = source
             cached.tenant = tenant
             return self._install(cached)
+        # persistent tier: a verified cached image payload replaces the
+        # body-validation + lowering pass entirely (restart survival,
+        # fleet replication); any mismatch silently lowers fresh
+        payload = self.compile_cache.load(sha) \
+            if self.compile_cache.enabled else None
         mod = Validator(self.conf).validate(
-            Loader(self.conf).parse_module(data))
+            Loader(self.conf).parse_module(data), precompiled=payload)
         store = StoreManager()
         ex = Executor(self.conf)
         wasi, sinks = self._register_wasi(ex, store, name)
@@ -156,7 +163,18 @@ class ModuleRegistry:
 
             eng = BatchEngine(inst, store=store, conf=self.conf,
                               lanes=1)
-            self.lowered_count += 1
+            if getattr(mod, "precompiled_src", None) == "cache":
+                pass  # adopted the cached lowering: not a fresh lower
+            else:
+                self.lowered_count += 1
+                if self.compile_cache.enabled and mod.lowered is not None:
+                    from wasmedge_tpu.aot import serialize_image
+
+                    try:
+                        self.compile_cache.store(
+                            sha, serialize_image(mod.lowered, mod=mod))
+                    except Exception:
+                        pass  # cache write is never load-bearing
         except BaseException:
             # the sink fds were opened before instantiation — a
             # rejected module (unlinkable import, unbatchable image)
@@ -200,19 +218,10 @@ class ModuleRegistry:
         if rm is None:
             return
         if stash and rm.sha256:
-            with self._lock:
-                # a same-bytes entry may already be stashed (e.g. two
-                # copies in one rolled-back preload): close the one we
-                # displace or its sink fds leak
-                displaced = self._probe_cache.pop(rm.sha256, None)
-                self._probe_cache[rm.sha256] = rm
-                evicted = []
-                while len(self._probe_cache) > _PROBE_CACHE_DEPTH:
-                    evicted.append(self._probe_cache.popitem(last=False))
-            if displaced is not None:
-                displaced.close()
-            for _, old in evicted:
-                old.close()
+            # the cache closes any same-bytes entry it displaces (e.g.
+            # two copies in one rolled-back preload) and LRU evictions,
+            # or their sink fds would leak
+            self.compile_cache.stash_probe(rm.sha256, rm)
         else:
             rm.close()
 
@@ -266,7 +275,8 @@ class ModuleRegistry:
         with self._lock:
             return [self._mods[n] for n in self._order]
 
-    def build_engine(self, conf, lanes: int, devices=None):
+    def build_engine(self, conf, lanes: int, devices=None,
+                     init_overlays=None, snapshot_counts=None):
         """Concatenated multi-module engine over the CURRENT module set
         (one serving generation's engine; gateway/service.py swaps
         generations at a launch boundary).  The per-module engines
@@ -289,12 +299,13 @@ class ModuleRegistry:
         return MultiModuleBatchEngine(
             [(rm.name, rm.inst, rm.store) for rm in mods],
             conf=conf, lanes=lanes,
-            engines=[rm.engine for rm in mods], mesh=mesh)
+            engines=[rm.engine for rm in mods], mesh=mesh,
+            segment_cache=self.segment_cache,
+            init_overlays=init_overlays,
+            snapshot_counts=snapshot_counts)
 
     def close(self):
         with self._lock:
             for rm in self._mods.values():
                 rm.close()
-            for rm in self._probe_cache.values():
-                rm.close()
-            self._probe_cache.clear()
+        self.compile_cache.close()

@@ -272,6 +272,34 @@ class GatewayService:
             state_dir, faults=faults,
             result_cache=self._durable_cache_depth) \
             if state_dir else None
+        # imagestore (r22): segmented device images, the persistent
+        # compile cache, and pre-initialized lane snapshots.  All three
+        # knobs default off, leaving this block inert — the registry's
+        # segment_cache stays None, the compile cache's persistent tier
+        # never enables, no snapshot store exists — so the default
+        # gateway is bit-identical r21 by construction.
+        self.snapshot_store = None
+        self.snapshot_counts: Dict[str, int] = {}
+        ist = getattr(self.template, "imagestore", None)
+        self.imagestore_enabled = bool(ist is not None and ist.active)
+        if self.imagestore_enabled:
+            # the cache_read fault seam fires through the registry's
+            # cache; wire the gateway's injector in
+            self.registry.compile_cache.faults = faults
+            if ist.segmented:
+                from wasmedge_tpu.imagestore import SegmentCache
+
+                self.registry.segment_cache = SegmentCache()
+            if ist.compile_cache:
+                cc_dir = ist.compile_cache_dir or \
+                    (self.durable.compile_cache_dir()
+                     if self.durable is not None else None)
+                self.registry.compile_cache.enable(cc_dir)
+            if ist.snapshots:
+                from wasmedge_tpu.hv.swapstore import SwapStore
+
+                self.snapshot_store = SwapStore(dir=ist.snapshot_dir,
+                                                faults=faults)
         # fleet federation (wasmedge_tpu/fleet/, r16): `fleet` is a
         # FleetConfig or a plain list of "host:port" peers.  The
         # controller starts when the HTTP layer binds (Gateway.start
@@ -344,8 +372,29 @@ class GatewayService:
             # durability implies a checkpoint cadence — resume has
             # nothing to adopt otherwise
             conf.serve.checkpoint_every_rounds = 1
-        engine = self.registry.build_engine(conf, self.lanes,
-                                            devices=self.devices)
+        init_overlays = None
+        snapshot_counts = None
+        if self.snapshot_store is not None:
+            # decode every registered module's post-init snapshot into
+            # a plane overlay for this generation's initial_state; a
+            # faulted/corrupt entry drops to template init replay for
+            # that module (counted, never wrong state)
+            from wasmedge_tpu.imagestore import decode_overlay
+
+            snapshot_counts = self.snapshot_counts
+            init_overlays = {}
+            for rm in self.registry.modules_snapshot():
+                if rm.snapshot is None:
+                    continue
+                ov = decode_overlay(rm, self.snapshot_store,
+                                    faults=self.faults,
+                                    counts=self.snapshot_counts)
+                if ov is not None:
+                    init_overlays[rm.name] = ov
+        engine = self.registry.build_engine(
+            conf, self.lanes, devices=self.devices,
+            init_overlays=init_overlays,
+            snapshot_counts=snapshot_counts)
         server = BatchServer(engine=engine,
                              weights=self.tenants.weights(),
                              quotas=self.tenants.quotas(),
@@ -545,6 +594,29 @@ class GatewayService:
                 for rm, _ in added:
                     self.registry.remove(rm.name, stash=True)
                 raise
+            if self.snapshot_store is not None:
+                # one-time init run per freshly-added module: capture
+                # the post-_start plane columns as a content-addressed
+                # snapshot (imagestore/snapshot.py).  Best-effort — a
+                # module with no init export, a parked/trapped init, or
+                # a store failure just admits through template init.
+                # A probe-cache re-adoption keeps its earlier capture.
+                from wasmedge_tpu.imagestore import capture_snapshot
+
+                ist = self.template.imagestore
+                for rm, _ in added:
+                    if rm.snapshot is not None:
+                        continue
+                    try:
+                        rm.snapshot = capture_snapshot(
+                            rm, self.snapshot_store,
+                            self.snapshot_counts,
+                            max_steps=ist.snapshot_init_max_steps)
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except Exception:
+                        self.snapshot_counts["skipped"] = \
+                            self.snapshot_counts.get("skipped", 0) + 1
             try:
                 gen = self._build_generation_timed()
                 self._swap_in(gen)
@@ -1309,6 +1381,16 @@ class GatewayService:
                 out["hv"] = hv
         if self.autoscale is not None:
             out["autoscale"] = self.autoscale.stats()
+        if self.imagestore_enabled:
+            # cold-start telemetry (r22): present only when a knob is
+            # on, so the default status body stays bit-identical r21
+            sc = self.registry.segment_cache
+            out["coldstart"] = {
+                "compile_cache": self.registry.compile_cache.stats(),
+                "segments": sc.stats() if sc is not None else None,
+                "snapshots": dict(self.snapshot_counts),
+                "lowered_count": self.registry.lowered_count,
+            }
         out["health"] = self.health()
         return out
 
@@ -1336,7 +1418,11 @@ class GatewayService:
             if self.fleet is not None else None,
             reshard_counts=reshard_counts or None,
             autoscale_actions=dict(self.autoscale.actions)
-            if self.autoscale is not None else None)
+            if self.autoscale is not None else None,
+            compile_cache_counts=dict(self.registry.compile_cache.counts)
+            if self.imagestore_enabled else None,
+            snapshot_counts=dict(self.snapshot_counts)
+            if self.snapshot_store is not None else None)
 
     # -- lifecycle ---------------------------------------------------------
     def shutdown(self, drain: bool = True,

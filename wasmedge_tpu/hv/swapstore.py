@@ -201,3 +201,28 @@ def deserialize_lane(payload: bytes) -> Tuple[Dict[str, np.ndarray], int]:
         meta = json.loads(str(z["meta"]))
         cols = {name: z[f"p_{name}"] for name in meta["planes"]}
     return cols, int(meta.get("stdout_pos", 0))
+
+
+def serialize_columns(cols: Dict[str, np.ndarray],
+                      meta: Optional[dict] = None) -> bytes:
+    """Generic named-column payload (same npz envelope as lane
+    serialization, arbitrary names + JSON side-meta).  The imagestore
+    snapshot path stores a module's post-init plane columns this way so
+    they content-address and integrity-check through the same SwapStore
+    machinery as swapped lanes."""
+    arrays = {f"p_{name}": np.ascontiguousarray(arr)
+              for name, arr in cols.items()}
+    m = dict(meta or {})
+    m["planes"] = sorted(cols)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, meta=json.dumps(m), **arrays)
+    return buf.getvalue()
+
+
+def deserialize_columns(payload: bytes
+                        ) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Payload bytes -> ({name: array}, meta dict)."""
+    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+        meta = json.loads(str(z["meta"]))
+        cols = {name: z[f"p_{name}"] for name in meta["planes"]}
+    return cols, meta

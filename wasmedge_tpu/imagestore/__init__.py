@@ -1,0 +1,35 @@
+"""Segmented device images, the persistent compile cache, and
+pre-initialized lane snapshots (r22) — the cold-start subsystem.
+
+Three coupled pieces, all knob-gated through `Configure.imagestore`
+(every default OFF reproduces the r21 path bit-identically):
+
+- `segments.SegmentCache` memoizes per-module rebased image segments so
+  registering module N+1 rebases exactly one segment and a generation
+  swap is an indirection-table update, not an O(modules) rebuild.
+- `compilecache.CompileCache` is ONE sha256-keyed lowering cache: the
+  r12 in-memory probe stash is its hot tier, the aot image payload its
+  persistent tier — gateway restarts and fleet siblings never re-lower.
+- `snapshot` captures a module's post-`_start` plane columns once at
+  registration (content-addressed through hv/swapstore) and admits new
+  requests by installing the snapshot through the recycler's jitted
+  column-set pass instead of replaying init per lane.
+"""
+
+from wasmedge_tpu.imagestore.compilecache import CompileCache
+from wasmedge_tpu.imagestore.segments import SegmentCache
+from wasmedge_tpu.imagestore.snapshot import (
+    SnapshotEntry,
+    capture_snapshot,
+    decode_overlay,
+    init_export_of,
+)
+
+__all__ = [
+    "CompileCache",
+    "SegmentCache",
+    "SnapshotEntry",
+    "capture_snapshot",
+    "decode_overlay",
+    "init_export_of",
+]

@@ -1,0 +1,241 @@
+"""ONE sha256-keyed lowering cache for the serving gateway (r22).
+
+Unifies the two places a finished lowering used to hide:
+
+- the r12 registry probe cache — instantiated `RegisteredModule`s whose
+  registration was rolled back after the expensive lowering succeeded —
+  is now the cache's HOT tier (`stash_probe`/`pop_probe`, unchanged
+  adopt-on-re-POST semantics, so the `lowered_count` pins hold);
+- the aot image payload (`aot.serialize_image`, the exact bytes a
+  `.twasm` embeds) is the PERSISTENT tier: content-addressed by the
+  module's wasm sha256, mirrored to disk when a directory is enabled,
+  consulted by the validator's precompiled fast path on the next
+  registration — across gateway restarts and, via
+  `entry_bytes`/`adopt_entry`, across fleet siblings (the r16 peer
+  protocol replicates entries alongside module blobs).
+
+Entry file format (`<dir>/<sha>.img`): magic ``WTIC`` + u32 version +
+raw sha256(payload) + payload.  Integrity is end-to-end — `load()`
+re-hashes the payload against the stored digest and treats any mismatch
+as a miss (a corrupt entry falls back to a fresh lower, never serves
+wrong code).  The `cache_read` fault seam (testing/faults.py) injects
+exactly that failure."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional
+
+_MAGIC = b"WTIC"
+_VERSION = 1
+_HEADER = struct.Struct("<4sI32s")
+
+# probe-tier depth (unchanged from the r12 registry stash): each entry
+# pins an instantiated module + two sink fds, so keep it small
+PROBE_DEPTH = 4
+
+
+def _new_counts() -> Dict[str, int]:
+    return {"probe_hits": 0, "disk_hits": 0, "misses": 0, "stores": 0,
+            "corrupt": 0, "read_faults": 0}
+
+
+class CompileCache:
+    """Two-tier content-addressed lowering cache.
+
+    Constructed unconditionally by the ModuleRegistry (the probe tier
+    IS the r12 behavior); the persistent tier stays inert until
+    `enable()` — so a gateway without the knob is bit-identical r21."""
+
+    def __init__(self, faults=None):
+        self.faults = faults
+        self._lock = threading.Lock()
+        self._probe: "OrderedDict[str, object]" = OrderedDict()
+        self.dir: Optional[str] = None
+        self._payloads: Optional[Dict[str, bytes]] = None
+        self.counts = _new_counts()
+
+    # -- persistent tier ---------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._payloads is not None or self.dir is not None
+
+    def enable(self, dir: Optional[str] = None) -> None:
+        """Turn the persistent tier on.  With a directory entries
+        mirror to disk (restart + fleet survival); without one they
+        stay in-memory for the process lifetime (still unifies the
+        probe/aot paths and still serves fleet replication)."""
+        if dir:
+            self.dir = os.fspath(dir)
+            os.makedirs(self.dir, exist_ok=True)
+        if self._payloads is None:
+            self._payloads = {}
+
+    def _path(self, sha: str) -> str:
+        return os.path.join(self.dir, f"{sha}.img")
+
+    @staticmethod
+    def _encode(payload: bytes) -> bytes:
+        return _HEADER.pack(_MAGIC, _VERSION,
+                            hashlib.sha256(payload).digest()) + payload
+
+    @staticmethod
+    def _decode(raw: bytes) -> Optional[bytes]:
+        """Entry bytes -> verified payload, or None for anything torn,
+        truncated, version-skewed, or bit-rotted."""
+        if len(raw) < _HEADER.size:
+            return None
+        magic, version, digest = _HEADER.unpack_from(raw)
+        if magic != _MAGIC or version != _VERSION:
+            return None
+        payload = raw[_HEADER.size:]
+        if hashlib.sha256(payload).digest() != digest:
+            return None
+        return payload
+
+    def load(self, sha: str) -> Optional[bytes]:
+        """Verified aot image payload for a wasm sha, or None (miss).
+        Every failure mode — injected read fault, missing entry,
+        integrity mismatch — is a miss: the caller lowers fresh."""
+        if not self.enabled:
+            return None
+        if self.faults is not None:
+            from wasmedge_tpu.testing.faults import InjectedFault
+
+            try:
+                self.faults.fire("cache_read", sha=sha)
+            except InjectedFault:
+                self.counts["read_faults"] += 1
+                return None
+        raw = None
+        with self._lock:
+            if self._payloads is not None and sha in self._payloads:
+                raw = self._payloads[sha]
+        if raw is None and self.dir:
+            try:
+                with open(self._path(sha), "rb") as f:
+                    raw = f.read()
+            except OSError:
+                raw = None
+        if raw is None:
+            self.counts["misses"] += 1
+            return None
+        payload = self._decode(raw)
+        if payload is None:
+            self.counts["corrupt"] += 1
+            return None
+        self.counts["disk_hits"] += 1
+        return payload
+
+    def store(self, sha: str, payload: bytes) -> None:
+        """Record a fresh lowering's image payload.  Write failures are
+        swallowed — the cache is an accelerator, never a correctness
+        dependency."""
+        if not self.enabled:
+            return
+        raw = self._encode(bytes(payload))
+        with self._lock:
+            if self._payloads is not None:
+                self._payloads[sha] = raw
+        if self.dir:
+            try:
+                from wasmedge_tpu.utils.fsio import atomic_write_bytes
+
+                atomic_write_bytes(self._path(sha), raw)
+            except OSError:
+                pass
+        self.counts["stores"] += 1
+
+    # -- fleet replication (r16 peer protocol) -----------------------------
+    def entry_bytes(self, sha: str) -> bytes:
+        """Raw entry (header + payload) for peer replication; raises
+        KeyError when absent."""
+        with self._lock:
+            if self._payloads is not None and sha in self._payloads:
+                return self._payloads[sha]
+        if self.dir:
+            try:
+                with open(self._path(sha), "rb") as f:
+                    return f.read()
+            except OSError:
+                pass
+        raise KeyError(sha)
+
+    def adopt_entry(self, sha: str, raw: bytes) -> bool:
+        """Install a peer-replicated entry after verifying its payload
+        digest; a corrupt entry is dropped (the local lower path covers
+        it).  Returns True when adopted."""
+        if not self.enabled:
+            return False
+        raw = bytes(raw)
+        if self._decode(raw) is None:
+            self.counts["corrupt"] += 1
+            return False
+        with self._lock:
+            if self._payloads is not None:
+                self._payloads[sha] = raw
+        if self.dir:
+            try:
+                from wasmedge_tpu.utils.fsio import atomic_write_bytes
+
+                atomic_write_bytes(self._path(sha), raw)
+            except OSError:
+                pass
+        return True
+
+    def known_shas(self) -> list:
+        """Shas with a resident persistent-tier entry (fleet gossip)."""
+        out = set()
+        with self._lock:
+            if self._payloads is not None:
+                out.update(self._payloads)
+        if self.dir:
+            try:
+                out.update(fn[:-4] for fn in os.listdir(self.dir)
+                           if fn.endswith(".img"))
+            except OSError:
+                pass
+        return sorted(out)
+
+    # -- probe tier (the r12 rejected-registration stash) ------------------
+    def pop_probe(self, sha: str):
+        """Adopt-and-remove a stashed RegisteredModule for these exact
+        bytes (None = no probe)."""
+        with self._lock:
+            rm = self._probe.pop(sha, None)
+        if rm is not None:
+            self.counts["probe_hits"] += 1
+        return rm
+
+    def stash_probe(self, sha: str, rm) -> None:
+        """Park a rolled-back module's lowered engine for a re-POST of
+        the same bytes; displaced/evicted entries close (their sink fds
+        must not leak)."""
+        with self._lock:
+            displaced = self._probe.pop(sha, None)
+            self._probe[sha] = rm
+            evicted = []
+            while len(self._probe) > PROBE_DEPTH:
+                evicted.append(self._probe.popitem(last=False))
+        if displaced is not None:
+            displaced.close()
+        for _, old in evicted:
+            old.close()
+
+    def close(self) -> None:
+        with self._lock:
+            probes = list(self._probe.values())
+            self._probe.clear()
+        for rm in probes:
+            rm.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            probe_depth = len(self._probe)
+        return dict(self.counts, enabled=self.enabled,
+                    probe_entries=probe_depth,
+                    dir=self.dir or "")

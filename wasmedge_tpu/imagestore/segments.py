@@ -1,0 +1,77 @@
+"""Per-module segment memoization for concatenated device images.
+
+`batch/multitenant.concat_images` builds one `Segment` per tenant — a
+pure function of (tenant DeviceImage, index-space offsets, merged
+fuse-pattern prefix).  This cache keys on exactly those inputs, so a
+generation rebuild after registering module N+1 replays modules 1..N's
+segments verbatim and rebases only the newcomer: registration work is
+O(1) in the registered-module count, and the swap reduces to updating
+the indirection table (the `bases` list) plus one concatenation.
+
+Keying uses the image's content fingerprint (`image_fingerprint`,
+batch/image.py) so two generations that happen to hold equal-content
+images at the same offsets share segments, while any re-lowered or
+re-planned image (fingerprint covers the fuse/tier planes) misses and
+rebuilds.  Entries also pin the image object itself: a hit additionally
+requires identity, which keeps a cached segment's arrays alive exactly
+as long as the engine that produced them and makes hits O(1) without
+re-hashing (the fingerprint memoizes on the image)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+from wasmedge_tpu.batch.image import image_fingerprint
+
+# enough for every registered module of a deep gateway plus a couple of
+# in-flight rebuild generations; LRU beyond that (a miss just rebuilds)
+_DEFAULT_DEPTH = 64
+
+_OFF_KEYS = ("pc", "func", "glob", "type", "brt", "table", "v128",
+             "eseg", "eflat", "dseg", "dbyte", "tier_slot")
+
+
+class SegmentCache:
+    """LRU of rebased image segments keyed by (image content, offsets,
+    pattern prefix)."""
+
+    def __init__(self, depth: int = _DEFAULT_DEPTH):
+        self.depth = int(depth)
+        self._entries: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.builds = 0
+
+    @staticmethod
+    def _key(img, off: dict, pat_state: tuple):
+        return (image_fingerprint(img),
+                tuple(off[k] for k in _OFF_KEYS),
+                pat_state)
+
+    def lookup(self, img, off: dict, pat_state: tuple):
+        key = self._key(img, off, pat_state)
+        ent = self._entries.get(key)
+        if ent is None:
+            return None
+        cached_img, seg = ent
+        if cached_img is not img:
+            # same content at the same offsets but a different live
+            # image object: the segment arrays are still valid (they
+            # are pure functions of content + offsets) — refresh the
+            # pin so the arrays outlive the older engine
+            self._entries[key] = (img, seg)
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return seg
+
+    def store(self, img, off: dict, pat_state: tuple, seg) -> None:
+        key = self._key(img, off, pat_state)
+        self._entries[key] = (img, seg)
+        self._entries.move_to_end(key)
+        self.builds += 1
+        while len(self._entries) > self.depth:
+            self._entries.popitem(last=False)
+
+    def stats(self) -> dict:
+        return {"entries": len(self._entries), "hits": self.hits,
+                "builds": self.builds}

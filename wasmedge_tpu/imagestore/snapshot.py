@@ -1,0 +1,149 @@
+"""Pre-initialized lane snapshots: run a module's init once, admit
+requests from the captured columns (r22).
+
+At registration the gateway runs the module's exported `_initialize`
+(reactor) or `_start` (command) ONCE on the module's solo lanes=1
+engine, captures the post-init per-lane plane columns — memory sized by
+r19's proven `mem_pages_touch_bound` when the analyzer proved one —
+and stores them as a content-addressed SwapStore payload.  Generation
+builds decode the entry into an `init_overlay` for the concatenated
+serving engine: every admitted lane then starts from the post-init
+image through the recycler's existing jitted column-set pass, instead
+of replaying init per lane (or relying on guest-side lazy init).
+
+Capture is strictly best-effort and conservative: no init export, a
+trapping init, an init that reaches a host outcall (its effects would
+span the WASI environ, which the overlay cannot carry), or an injected
+fault all mean "no snapshot" — the module admits through plain
+template init exactly as r21 did.  Install verifies content end-to-end
+(SwapStore re-hashes; the `snapshot_install` fault seam injects the
+failure) and falls back the same way: wrong state is never served."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from wasmedge_tpu.batch.image import TRAP_DONE
+
+_PAGE_WORDS = 65536 // 4
+
+# WASI preopens both spellings; reactor-style _initialize first — a
+# command _start that also runs main() is still a valid snapshot point
+# (the captured state is simply post-main, which is what a re-POSTed
+# run would observe too)
+_INIT_EXPORTS = ("_initialize", "_start")
+
+
+@dataclasses.dataclass
+class SnapshotEntry:
+    """One captured post-init state: the SwapStore content key plus the
+    scalar side-meta the overlay needs (page count, table size)."""
+
+    key: str
+    meta: dict
+
+
+def init_export_of(rm) -> Optional[str]:
+    """The module's nullary init export name, or None."""
+    for name in _INIT_EXPORTS:
+        ex = rm.inst.exports.get(name)
+        if ex is None or ex[0] != 0:
+            continue
+        ft = rm.inst.funcs[ex[1]].functype
+        if not tuple(ft.params) and not tuple(ft.results):
+            return name
+    return None
+
+
+def capture_snapshot(rm, store, counts: dict,
+                     max_steps: int = 2_000_000) -> Optional[SnapshotEntry]:
+    """Run `rm`'s init once on its registration-time solo engine and
+    store the post-init columns; returns the entry or None (skipped).
+
+    Pure with respect to the engine: `initial_state` is functional, so
+    the registration engine's image is untouched either way."""
+    from wasmedge_tpu.batch.engine import check_batch_entry
+    from wasmedge_tpu.hv.swapstore import serialize_columns
+
+    name = init_export_of(rm)
+    if name is None:
+        return None
+    eng = rm.engine  # lanes=1 BatchEngine kept from registration
+    try:
+        local = check_batch_entry(rm.inst, name)
+        state = eng.initial_state(local, [])
+        state, _total = eng.run_from_state(state, 0, max_steps)
+    except Exception:
+        counts["skipped"] = counts.get("skipped", 0) + 1
+        return None
+    trap = int(np.asarray(state.trap)[0])
+    if trap != TRAP_DONE:
+        # still running (fuel), trapped, or parked on a host outcall —
+        # the overlay cannot represent any of those; admit via template
+        counts["skipped"] = counts.get("skipped", 0) + 1
+        return None
+    img = eng.img
+    cols = {}
+    meta = {"module": rm.name, "sha": rm.sha256}
+    if img.has_memory:
+        pages = int(np.asarray(state.mem_pages)[0])
+        meta["mem_pages"] = pages
+        mem = np.asarray(state.mem)
+        rows = pages * _PAGE_WORDS
+        # r19's proven page-touch bound: init can only have written
+        # inside it, and rows beyond the capture keep the template's
+        # init content at install time (overlay writes [0, rows) only)
+        ana = getattr(img, "analysis", None)
+        bound = getattr(ana, "mem_pages_touch_bound", None)
+        if bound is not None:
+            rows = min(rows, max(int(bound) * _PAGE_WORDS,
+                                 img.mem_init.shape[0]))
+        rows = min(rows, mem.shape[0])
+        cols["mem"] = mem[:rows, 0]
+    cols["glob_lo"] = np.asarray(state.glob_lo)[:, 0]
+    cols["glob_hi"] = np.asarray(state.glob_hi)[:, 0]
+    if getattr(state, "tab", None) is not None:
+        cols["tab"] = np.asarray(state.tab)[:, 0]
+        meta["tsize"] = int(np.asarray(state.tsize)[0])
+    if getattr(state, "edrop", None) is not None:
+        cols["edrop"] = np.asarray(state.edrop)[:, 0]
+    if getattr(state, "ddrop", None) is not None:
+        cols["ddrop"] = np.asarray(state.ddrop)[:, 0]
+    key = store.put(serialize_columns(cols, meta))
+    counts["captured"] = counts.get("captured", 0) + 1
+    return SnapshotEntry(key=key, meta=meta)
+
+
+def decode_overlay(rm, store, faults=None,
+                   counts: Optional[dict] = None) -> Optional[dict]:
+    """SnapshotEntry -> init_overlay dict for the serving engine, or
+    None (template fallback) on any integrity or injected failure."""
+    from wasmedge_tpu.hv.swapstore import SwapCorrupt, deserialize_columns
+
+    entry = getattr(rm, "snapshot", None)
+    if entry is None:
+        return None
+    counts = counts if counts is not None else {}
+    if faults is not None:
+        from wasmedge_tpu.testing.faults import InjectedFault
+
+        try:
+            faults.fire("snapshot_install", module=rm.name,
+                        key=entry.key)
+        except InjectedFault:
+            counts["install_faults"] = counts.get("install_faults", 0) + 1
+            return None
+    try:
+        payload = store.get(entry.key)
+    except SwapCorrupt:
+        counts["corrupt"] = counts.get("corrupt", 0) + 1
+        return None
+    cols, meta = deserialize_columns(payload)
+    overlay = {k: cols.get(k) for k in ("mem", "glob_lo", "glob_hi",
+                                        "tab", "edrop", "ddrop")}
+    overlay["mem_pages"] = meta.get("mem_pages")
+    overlay["tsize"] = meta.get("tsize")
+    return overlay

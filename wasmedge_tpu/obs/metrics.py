@@ -61,7 +61,9 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                       analysis_counts=None, gateway_counts=None,
                       shed_counts=None, hv_stats=None,
                       fleet_stats=None, reshard_counts=None,
-                      autoscale_actions=None) -> str:
+                      autoscale_actions=None,
+                      compile_cache_counts=None,
+                      snapshot_counts=None) -> str:
     """Render one metrics snapshot.  All sources optional: `recorder` a
     FlightRecorder, `stats` a common.statistics.Statistics, `hostcall_stats`
     an engine's pipeline counter dict, `failures` extra FailureRecords
@@ -78,8 +80,65 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
     tally (emitted only when a reshard has happened), and
     `autoscale_actions` the AutoscaleController's {action: count}
     tally (emitted only when the controller is constructed) — both
-    r21; a gateway without them renders bit-identically to r16."""
+    r21; a gateway without them renders bit-identically to r16.
+    `compile_cache_counts` the registry compile cache's counter dict
+    and `snapshot_counts` the imagestore snapshot tally — both r22,
+    passed only when Configure.imagestore is active, so a gateway
+    without the subsystem renders bit-identically to r21."""
     w = _Writer()
+
+    if compile_cache_counts:
+        w.head("wasmedge_compile_cache_hits_total", "counter",
+               "Content-addressed compile-cache hits by tier: probe = "
+               "in-process parked-engine adoption, disk = persistent "
+               "cross-process image payload (imagestore/compilecache).")
+        w.sample("wasmedge_compile_cache_hits_total", {"tier": "probe"},
+                 int(compile_cache_counts.get("probe_hits", 0)))
+        w.sample("wasmedge_compile_cache_hits_total", {"tier": "disk"},
+                 int(compile_cache_counts.get("disk_hits", 0)))
+        w.head("wasmedge_compile_cache_misses_total", "counter",
+               "Registrations that lowered fresh: no cache entry, a "
+               "corrupt/mismatched entry, or a faulted read (the last "
+               "two also count in their own kinds below).")
+        w.sample("wasmedge_compile_cache_misses_total", None,
+                 int(compile_cache_counts.get("misses", 0)))
+        if compile_cache_counts.get("corrupt") or \
+                compile_cache_counts.get("read_faults"):
+            w.head("wasmedge_compile_cache_errors_total", "counter",
+                   "Cache entries rejected (corrupt = integrity/decode "
+                   "failure, read_fault = injected/IO read fault); "
+                   "every one fell back to a fresh lower.")
+            for kind in ("corrupt", "read_faults"):
+                if compile_cache_counts.get(kind):
+                    w.sample("wasmedge_compile_cache_errors_total",
+                             {"kind": kind},
+                             int(compile_cache_counts[kind]))
+
+    if snapshot_counts:
+        w.head("wasmedge_snapshot_installs_total", "counter",
+               "Lanes admitted through a pre-initialized snapshot "
+               "overlay instead of init replay (imagestore/snapshot).")
+        w.sample("wasmedge_snapshot_installs_total", None,
+                 int(snapshot_counts.get("installs", 0)))
+        w.head("wasmedge_snapshot_captures_total", "counter",
+               "Registration-time snapshot captures by outcome "
+               "(skipped = no init export / init parked or trapped).")
+        for kind in ("captured", "skipped"):
+            if snapshot_counts.get(kind):
+                w.sample("wasmedge_snapshot_captures_total",
+                         {"outcome": kind},
+                         int(snapshot_counts[kind]))
+        if snapshot_counts.get("install_faults") or \
+                snapshot_counts.get("corrupt"):
+            w.head("wasmedge_snapshot_errors_total", "counter",
+                   "Snapshot overlays rejected at generation build "
+                   "(faulted install, corrupt store entry); the "
+                   "generation fell back to template init replay.")
+            for kind in ("install_faults", "corrupt"):
+                if snapshot_counts.get(kind):
+                    w.sample("wasmedge_snapshot_errors_total",
+                             {"kind": kind},
+                             int(snapshot_counts[kind]))
 
     if fleet_stats:
         w.head("wasmedge_fleet_peers", "gauge",
@@ -434,7 +493,9 @@ def export_prometheus(path, recorder=None, stats=None,
                       gateway_counts=None, shed_counts=None,
                       hv_stats=None, fleet_stats=None,
                       reshard_counts=None,
-                      autoscale_actions=None) -> str:
+                      autoscale_actions=None,
+                      compile_cache_counts=None,
+                      snapshot_counts=None) -> str:
     """Render and write a metrics snapshot to `path` (or file-like)."""
     text = render_prometheus(recorder=recorder, stats=stats,
                              hostcall_stats=hostcall_stats,
@@ -446,7 +507,9 @@ def export_prometheus(path, recorder=None, stats=None,
                              hv_stats=hv_stats,
                              fleet_stats=fleet_stats,
                              reshard_counts=reshard_counts,
-                             autoscale_actions=autoscale_actions)
+                             autoscale_actions=autoscale_actions,
+                             compile_cache_counts=compile_cache_counts,
+                             snapshot_counts=snapshot_counts)
     if hasattr(path, "write"):
         path.write(text)
     else:

@@ -159,6 +159,13 @@ class LaneRecycler:
         n = int(lanes.size)
         if n == 0:
             return state
+        # imagestore observability: when the engine carries a
+        # pre-initialized overlay for this function's module, these
+        # lanes are snapshot-admitted (the template the column-set
+        # writes IS the post-init snapshot) — let it count them
+        note = getattr(self.engine, "note_snapshot_install", None)
+        if note is not None:
+            note(func_idx, n)
         nargs = len(args_rows)
         # pad the index vector to the next power of two so a sparse
         # steady-state install (1-2 recycled lanes on a 4096-lane

@@ -100,6 +100,22 @@ The elastic-fleet layer (r21) adds the churn seams:
   `churn_schedule()` composes these into the seeded join/leave/reshard
   weather `bench.py --elastic` arms.
 
+The imagestore layer (r22) adds the cold-start seams:
+  - `"cache_read"`         in CompileCache.load before a persistent
+                           compile-cache entry is consulted (ctx:
+                           sha).  An injected fault — like a corrupt
+                           or truncated entry — is a MISS: the
+                           registration lowers fresh and re-stores;
+                           wrong code is never served.
+  - `"snapshot_install"`   in imagestore.decode_overlay before a
+                           module's pre-initialized snapshot becomes a
+                           generation's init overlay (ctx: module,
+                           key).  An injected fault — like a SwapStore
+                           integrity failure — drops the overlay for
+                           that generation: the module's requests
+                           admit through plain template init (the r21
+                           path), bit-identical results, just colder.
+
 Fault classes covered by the tier-1 suites (ISSUE 2 + ISSUE 5):
   - launch-time device error       Fault(point="launch", ...)
   - mid-serve host exception       Fault(point="serve", ...)
@@ -153,7 +169,8 @@ class Fault:
     #                            "swap_in" | "swap_store_write" |
     #                            "peer_send" | "peer_recv" |
     #                            "peer_heartbeat" |
-    #                            "membership_gossip" | "reshard_install"
+    #                            "membership_gossip" | "reshard_install" |
+    #                            "cache_read" | "snapshot_install"
     at: int = 0                # 0-based arrival index at that seam
     times: int = 1             # consecutive arrivals that fault
     lanes: Tuple[int, ...] = ()  # lane attribution (poison quarantine)

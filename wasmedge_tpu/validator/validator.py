@@ -29,7 +29,13 @@ class Validator:
         self.conf = conf or Configure()
         self.gates = self.conf.proposal_gates()
 
-    def validate(self, mod: ast.Module) -> ast.Module:
+    def validate(self, mod: ast.Module,
+                 precompiled: Optional[bytes] = None) -> ast.Module:
+        """`precompiled` optionally supplies a serialized lowered image
+        (an aot.serialize_image payload — e.g. from the gateway's
+        content-addressed compile cache) to try in place of the body
+        pass; it is verified exactly like an embedded tpu.aot section
+        and silently ignored on any mismatch."""
         if len(mod.functions) != len(mod.codes):
             raise ValidationError(ErrCode.IncompatibleFuncCode)
 
@@ -156,6 +162,23 @@ class Validator:
                     return mod
                 except Exception:
                     pass  # fall through to full body validation
+
+        # Caller-supplied payload (the gateway's compile cache): same
+        # verify-or-ignore discipline as the embedded section — a stale
+        # or corrupt cache entry falls back to the body pass below and
+        # can never serve wrong code.
+        if mod.lowered is None and precompiled is not None:
+            from wasmedge_tpu import aot
+
+            try:
+                img = aot.deserialize_image(precompiled)
+                aot.verify_image(img, mod)
+                mod.lowered = img
+                mod.validated = True
+                mod.precompiled_src = "cache"
+                return mod
+            except Exception:
+                pass  # fall through to full body validation
 
         # Function bodies -> lowered image.
         image = LoweredModule()
