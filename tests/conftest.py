@@ -56,6 +56,10 @@ def pytest_configure(config):
         "markers", "compact: divergence-aware lane-compaction suite "
         "(PC-sorted regrouping, serving/hv/checkpoint permutation "
         "remap; tier-1 fast, runs under -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "effects: guest suspend/resume suite (parked "
+        "sessions, external wake, streamed output; tier-1 fast, runs "
+        "under -m 'not slow')")
 
 
 def pytest_addoption(parser):

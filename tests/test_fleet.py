@@ -585,6 +585,99 @@ def test_migrate_corrupt_blob_rejected_by_hash(fleet_pair):
     assert state == "unknown"
 
 
+def _await_mod() -> bytes:
+    """wait(n) -> await_event(buf=64, len=8, nwritten=32); returns
+    first-payload-word + n (proves delivery and guest-state survival
+    across the migration)."""
+    from wasmedge_tpu.utils.builder import ModuleBuilder
+
+    b = ModuleBuilder()
+    b.import_func("wasmedge", "await_event",
+                  ["i32", "i32", "i32"], ["i32"])
+    b.add_memory(1, 1)
+    b.add_function(["i64"], ["i64"], [], [
+        ("i32.const", 64), ("i32.const", 8), ("i32.const", 32),
+        ("call", 0), "drop",
+        ("i32.const", 64), ("i32.load", 2, 0), "i64.extend_i32_u",
+        ("local.get", 0), "i64.add",
+    ], export="wait")
+    return b.build()
+
+
+def test_parked_session_migrates_cross_host_and_wakes_bit_identically():
+    """An effects PARKED SESSION (guest suspended on await_event, r23)
+    ships through the SAME hash-verified migration path as a swapped
+    vlane: B adopts it parked (zero resident lanes burned), a wake
+    delivered to B's wire resolves it bit-identically to a
+    never-migrated oracle, and the id is pollable on both ends."""
+    import struct
+
+    def conf():
+        c = _conf()
+        c.effects.suspend = True
+        return c
+
+    svc_a = GatewayService(conf=conf(), lanes=2, fleet=_fleet_cfg())
+    gw_a = Gateway(svc_a, port=0).start()
+    svc_a.register_module("awaitmod", wasm_bytes=_await_mod(),
+                          source="boot")
+    svc_b = GatewayService(
+        conf=conf(), lanes=2,
+        fleet=_fleet_cfg([f"{gw_a.host}:{gw_a.port}"]))
+    gw_b = Gateway(svc_b, port=0).start()
+    try:
+        svc_b.fleet.tick()   # learn manifest + replicate awaitmod
+        svc_b.fleet.tick()
+        assert "awaitmod" in svc_b.registry.names
+        payload = struct.pack("<I", 900)
+
+        # never-migrated oracle on A: park -> wake -> resolve
+        oracle = svc_a._submit_local("wait", [5], module="awaitmod")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if oracle.id in svc_a.current.server.list_swapped():
+                break
+            time.sleep(0.01)
+        else:
+            raise TimeoutError("oracle never parked")
+        svc_a.wake(oracle.id, payload)
+        assert svc_a.wait(oracle, timeout_s=120.0)
+        want = oracle.future.result(0)
+        assert want == [905]
+
+        # the migrated run: park on A, ship to B, wake on B's wire
+        req = svc_a._submit_local("wait", [5], module="awaitmod")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if req.id in svc_a.current.server.list_swapped():
+                break
+            time.sleep(0.01)
+        else:
+            raise TimeoutError("session never parked")
+        out = svc_a.fleet.migrate_out(req.id, svc_b.fleet.self_id)
+        assert out["ok"] and out["request_id"] == req.id
+        # B holds it PARKED (not running); A no longer does
+        assert req.id in svc_b.current.server.list_swapped()
+        assert req.id not in svc_a.current.server.list_swapped()
+        assert svc_b.status()["sessions"]["parked"] == 1
+        st, doc, _ = rpc(gw_b, "POST",
+                         f"/v1/requests/{req.id}/wake", body=payload)
+        assert st == 202 and doc["state"] == "parked"
+        # the relay poll resolves the sender-side future bit-identically
+        _drain(svc_a, [req], timeout_s=180.0)
+        assert req.future.result(0) == want
+        assert svc_a.fleet.counters["migrations_out"] >= 1
+        assert svc_b.fleet.counters["migrations_in"] >= 1
+        # pollable on BOTH ends with the same outcome
+        st_a, doc_a, _ = rpc(gw_a, "GET", f"/v1/requests/{req.id}")
+        st_b, doc_b, _ = rpc(gw_b, "GET", f"/v1/requests/{req.id}")
+        assert st_a == st_b == 200
+        assert doc_a["result"] == doc_b["result"] == want
+    finally:
+        gw_b.shutdown()
+        gw_a.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # solo-mode fallback
 # ---------------------------------------------------------------------------

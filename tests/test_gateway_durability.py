@@ -617,3 +617,90 @@ def test_restart_and_rollback_counters_in_prometheus():
                        frozenset())] == 0.0
     finally:
         svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# parked sessions (effects/): kill/restart with a majority-parked
+# population resumes every session exactly-once (r23)
+# ---------------------------------------------------------------------------
+def _await_mod() -> bytes:
+    """wait(n) -> await_event(buf=64, len=8, nwritten=32); returns
+    first-payload-word + n (delivery AND guest-state survival)."""
+    b = ModuleBuilder()
+    b.import_func("wasmedge", "await_event",
+                  ["i32", "i32", "i32"], ["i32"])
+    b.add_memory(1, 1)
+    b.add_function(["i64"], ["i64"], [], [
+        ("i32.const", 64), ("i32.const", 8), ("i32.const", 32),
+        ("call", 0), "drop",
+        ("i32.const", 64), ("i32.load", 2, 0), "i64.extend_i32_u",
+        ("local.get", 0), "i64.add",
+    ], export="wait")
+    return b.build()
+
+
+def test_kill_resume_resumes_parked_sessions_exactly_once(tmp_path):
+    """Majority-parked kill/restart: 3 of 4 lanes park on await_event,
+    the gateway dies without drain, and the resumed process restores
+    EVERY parked session exactly-once — adopted as parked (parks stays
+    0 on the new server: nothing re-executed from scratch), unresolved
+    until its wake arrives, then bit-identical to a never-killed run."""
+    import struct
+
+    d = str(tmp_path / "state")
+
+    def conf():
+        c = _conf()
+        c.effects.suspend = True
+        return c
+
+    svc = GatewayService(conf=conf(), lanes=4, state_dir=d)
+    svc.register_module("awaitmod", wasm_bytes=_await_mod(),
+                        source="boot")
+    ids = [svc.submit("wait", [10 + i], module="awaitmod").id
+           for i in range(3)]
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if svc.status().get("sessions", {}).get("parked") == 3:
+            break
+        time.sleep(0.02)
+    else:
+        raise TimeoutError("sessions never parked")
+    # cadence-1 serve checkpoint (state_dir forces it) lands at the
+    # parking round's boundary; give the drive loop a beat to write it
+    time.sleep(0.3)
+    svc.kill()
+
+    svc2 = GatewayService(conf=conf(), lanes=4, state_dir=d,
+                          resume=True)
+    try:
+        sessions = svc2.status()["sessions"]
+        # exactly-once restore: the full parked population is back as
+        # PARKED state (no re-execution — a re-run would re-park and
+        # bump the new process's park counter)
+        assert sessions["parked"] == 3
+        assert sessions["parks"] == 0
+        for rid in ids:
+            state, req = svc2.request_state(rid)
+            assert state == "ok" and not req.future.done
+        # each wake resolves its ORIGINAL id exactly once
+        reqs = []
+        for i, rid in enumerate(ids):
+            out = svc2.wake(rid, struct.pack("<I", 100 + i))
+            assert out["ok"] and out["state"] == "parked"
+            reqs.append(svc2.request_state(rid)[1])
+        for i, req in enumerate(reqs):
+            assert svc2.wait(req, timeout_s=120.0)
+            assert req.future.result(0) == [100 + i + 10 + i]
+        final = svc2.status()["sessions"]
+        assert final["parked"] == 0
+        assert final["resumes"] == 3
+        assert svc2.counters["restarts"] == 1
+        # fresh ids allocate above the adopted window
+        fresh = svc2.submit("wait", [1], module="awaitmod")
+        assert fresh.id > max(ids)
+        assert svc2.wake(fresh.id, struct.pack("<I", 7))["ok"]
+        assert svc2.wait(fresh, timeout_s=120.0)
+        assert fresh.future.result(0) == [8]
+    finally:
+        svc2.shutdown()

@@ -497,6 +497,54 @@ def test_serve_observability_metrics_and_drain():
     srv.shutdown(drain=False)
 
 
+def test_parked_deadline_pauses_for_explicit_wake():
+    """ISSUE 19 satellite: a session parked in `await_event` must not
+    burn its deadline budget while waiting on an explicit wake — the
+    clock pauses at park and re-arms at install.  (Timer sleeps keep
+    their absolute deadline; tests/test_effects.py pins that half.)"""
+    import struct
+
+    from wasmedge_tpu.effects import effects_import_object
+    from wasmedge_tpu.executor import Executor
+    from wasmedge_tpu.utils.builder import ModuleBuilder
+
+    conf = _conf()
+    conf.effects.suspend = True
+    b = ModuleBuilder()
+    b.import_func("wasmedge", "await_event",
+                  ["i32", "i32", "i32"], ["i32"])
+    b.add_memory(1, 1)
+    b.add_function(["i64"], ["i64"], [], [
+        ("i32.const", 64), ("i32.const", 8), ("i32.const", 32),
+        ("call", 0), "drop",
+        ("i32.const", 64), ("i32.load", 2, 0), "i64.extend_i32_u",
+        ("local.get", 0), "i64.add",
+    ], export="wait")
+    mod = Validator(conf).validate(Loader(conf).parse_module(b.build()))
+    store = StoreManager()
+    ex = Executor(conf)
+    ex.register_import_object(store, effects_import_object())
+    inst = ex.instantiate(store, mod)
+    srv = BatchServer(inst, store=store, conf=conf, lanes=2)
+    import time as _t
+
+    fut = srv.submit("wait", [3], deadline_s=0.15)
+    srv.run_until_idle()                  # parks awaiting the wake
+    assert srv.effects.in_flight() == 1
+    _t.sleep(0.25)                        # wall clock sails PAST 0.15s
+    srv.step()                            # boundary: must NOT expire it
+    assert not fut.done
+    assert srv.wake(fut.request_id, struct.pack("<I", 5)) == "parked"
+    srv.run_until_idle()
+    assert fut.result(0)[0] == 8          # resolved, not DeadlineExceeded
+    assert srv.counters["killed"] == 0
+    # the re-armed budget is live again after install: a request woken
+    # with (nearly) spent budget still gets its full remainder, so the
+    # paused window really was excluded from the accounting
+    st = srv.session_stats()
+    assert st["resumes"] == 1 and st["parked"] == 0
+
+
 def test_cli_serve_options_after_positionals(tmp_path):
     """`wasmedge-tpu serve app.wasm func --lanes 2 --requests 3` — the
     documented form — must honor trailing options (the shared parser

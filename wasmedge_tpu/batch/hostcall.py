@@ -162,6 +162,55 @@ def stdout_cursor_reset(engine, keep_highwater: bool = False):
         cur[1][:] = 0
 
 
+def _tap_tier1_stdout(eff, engine, cache, slab_lo, slab_hi, fp, pages,
+                      lanes, max_pages, plane_cap):
+    """Mirror a tier-1 fd_write group's fd-1 bytes into the owning
+    requests' stream buffers (effects/stream.py) before the host drain
+    writes the real fds.
+
+    Concatenated multi-module images carry no t0kind plane
+    (batch/multitenant.py), so a gateway guest's stdout arrives HERE
+    rather than through the tier-0 record buffer.  The same per-lane
+    logical cursor advances (parked sessions journal it as stdout_pos,
+    checkpoints carry it), and the same high-water mark suppresses
+    re-streaming a restored round's deterministic replay — one cursor,
+    whichever tier carried the bytes."""
+    pos, hw = _stdout_cursor(engine, int(np.asarray(fp).size))
+    for lane in lanes:
+        base = int(fp[lane])
+
+        def arg(i):
+            lo = int(np.uint32(slab_lo[base + i, int(lane)]))
+            hi = int(np.uint32(slab_hi[base + i, int(lane)]))
+            return lo | (hi << 32)
+
+        if (arg(0) & MASK32) != 1:
+            continue
+        mem = _CachedLaneMemory(cache, int(lane), int(pages[lane]),
+                                max_pages, plane_cap)
+        try:
+            iovs = arg(1) & MASK32
+            n = arg(2) & MASK32
+            mem.check_bounds(iovs, 8 * n)
+            data = b""
+            for k in range(n):
+                buf = mem.load(iovs + 8 * k, 4, False)
+                ln = mem.load((iovs + 8 * k + 4) & MASK32, 4, False)
+                if ln:
+                    data += mem.load_bytes(buf & MASK32, ln)
+        except TrapError:
+            continue   # malformed iovs: the host fn reports the errno
+        if not data:
+            continue
+        p = int(pos[lane])
+        skip = min(max(int(hw[lane]) - p, 0), len(data))
+        rid = eff.lane_rids.get(int(lane))
+        if rid is not None and skip < len(data):
+            eff.stream_append(rid, p + skip, data[skip:])
+        pos[lane] = p + len(data)
+        hw[lane] = max(int(hw[lane]), p + len(data))
+
+
 def flush_stdout_buffers(engine, state):
     """Drain the tier-0 in-device stdout record buffers to the WASI
     environ's fds (one download, one write per fd) and reset the
@@ -185,6 +234,11 @@ def flush_stdout_buffers(engine, state):
     buf = np.asarray(state.so_buf)
     env = wasi_env_of(engine)
     pos, hw = _stdout_cursor(engine, so_off.size)
+    # r23 stream seam: fresh stdout record bytes also feed the owning
+    # request's StreamBuf (effects/stream.py) with their logical stream
+    # position, so gateway /stream subscribers follow the same
+    # exactly-once cursor the host fds do
+    eff = getattr(engine, "_effects", None)
     per_fd = {}
     nbytes = 0
     for lane in np.nonzero(so_off > 0)[0]:
@@ -204,6 +258,10 @@ def flush_stdout_buffers(engine, state):
                     col[off + 1:off + 1 + nw]).tobytes()[:ln]
                 per_fd.setdefault(fd, []).append(data[skip:])
                 nbytes += ln - skip
+                if eff is not None and fd == 1:
+                    rid = eff.lane_rids.get(int(lane))
+                    if rid is not None:
+                        eff.stream_append(rid, p + skip, data[skip:])
             p += ln
             off += 1 + nw
         pos[lane] = p
@@ -288,11 +346,31 @@ def serve_batch_state(engine, state):
 
     prev_rec = set_drain_recorder(obs)
     stack_sets = []  # (rows [nres, n], lanes [n], lo [nres, n], hi)
+    # r23 effect lowering: blocking hostcalls (await_event, pure-clock
+    # poll_oneoff) either complete from pending wake state or mark
+    # their lane TRAP_PARKED for the boundary park — either way they
+    # leave the normal host drain below
+    eff = getattr(engine, "_effects", None)
+    if eff is not None:
+        consumed = eff.intercept(engine, waiting, ks, slab_lo, slab_hi,
+                                 fp, pc, opbase, sp, cache, new_trap,
+                                 new_pc, stack_sets)
+        if consumed:
+            keep = np.array([int(lane) not in consumed
+                             for lane in waiting], bool)
+            waiting = waiting[keep]
+            ks = ks[keep]
     try:
         for k in np.unique(ks):
             lanes = waiting[ks == k]
             fi = engine.resolve_func(int(k))
             nargs = nargs_by_k[int(k)]
+            if eff is not None and has_mem and nargs >= 3 \
+                    and getattr(getattr(fi, "host", None), "name",
+                                None) == "fd_write":
+                _tap_tier1_stdout(eff, engine, cache, slab_lo, slab_hi,
+                                  fp, pages, lanes, max_pages,
+                                  plane_cap)
             cells = codes = None
             if use_vec and has_mem and getattr(fi, "kind", None) == "host":
                 vecfn, env = vec_impl_for(fi)

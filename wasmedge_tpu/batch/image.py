@@ -172,6 +172,10 @@ _TABLE0_OPS = {"table.get", "table.set", "table.size", "table.grow",
 
 TRAP_DONE = -1  # lane finished normally (trap plane sentinel)
 TRAP_HOSTCALL = -2  # lane waiting on a host outcall
+TRAP_PARKED = -3  # lane suspended on a blocking effect (effects/) —
+#                   excluded from the runnable mask like any nonzero
+#                   trap; the serving boundary swaps it out and frees
+#                   the physical lane
 
 # ---------------------------------------------------------------------------
 # Tier-0 hostcalls: "pure" WASI imports the batch kernels can retire
@@ -229,9 +233,15 @@ def classify_t0_imports(funcs) -> Tuple[dict, bool]:
         else:
             # non-WASI host imports can do anything — a custom import
             # observing output ordering would make in-device stdout
-            # buffering visible; keep fd_write conservative
+            # buffering visible; keep fd_write conservative.  The
+            # "wasmedge" effect-handler module (effects/hostfuncs.py)
+            # is OURS and fd-inert: await_event only touches its own
+            # guest buffer, so it must not demote a module's stdout to
+            # tier-1 — streaming and exactly-once stdout both ride the
+            # tier-0 flush cursor
             kinds[idx] = T0_NONE
-            fdwrite_safe = False
+            if fn.import_module != "wasmedge":
+                fdwrite_safe = False
     return kinds, fdwrite_safe
 
 

@@ -545,6 +545,12 @@ def _gateway_parser() -> ArgumentParser:
                  Toggle("divergence-aware lane compaction on every "
                         "serving generation: PC-sorted lane regrouping "
                         "at launch boundaries"))
+    p.add_option(["suspend"],
+                 Toggle("guest suspend/resume via effect handlers: "
+                        "blocking hostcalls (poll_oneoff sleeps, "
+                        "wasmedge.await_event) park the session at "
+                        "zero resident cost until POST "
+                        "/v1/requests/<id>/wake or its timer"))
     p.add_option(["obs"],
                  Toggle("enable the flight recorder (gateway/<tenant> "
                         "spans, drain histograms; served at /metrics)"))
@@ -617,6 +623,8 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
             p._opts["resident-budget-bytes"].value
     if p._opts["compact"].value:
         conf.batch.compact = True
+    if p._opts["suspend"].value:
+        conf.effects.suspend = True
     if p._opts["obs"].value:
         conf.obs.enabled = True
 

@@ -420,6 +420,40 @@ class HvConfigure:
 
 
 @dataclasses.dataclass
+class EffectsConfigure:
+    """Knobs for the suspend/resume effect subsystem
+    (wasmedge_tpu/effects/, r23).
+
+    Off (the default) the serving stack runs the exact r22 path:
+    blocking hostcalls (`poll_oneoff` sleeps, `await_event`) are served
+    in place by the host layer and nothing ever parks, so behavior is
+    bit-identical by construction."""
+
+    # Master switch: lower blocking hostcalls into a PARKED effect —
+    # the lane serializes through the SwapStore at the next launch
+    # boundary (zero resident cost) and resumes on wake.  CLI:
+    # --effects.
+    suspend: bool = False
+    # Park a pure-clock poll_oneoff only when its minimum relative
+    # timeout is at least this many seconds; shorter sleeps are served
+    # in place (parking round-trip would dominate).
+    min_park_timeout_s: float = 0.0
+    # SwapStore spill directory for parked-session blobs.  None shares
+    # the hv store when hv is active, else keeps blobs in host memory —
+    # serve checkpoints embed them either way, so crash/resume does not
+    # depend on this knob.
+    swap_dir: Optional[str] = None
+    # Per-session stdout stream replay buffer cap in bytes (the
+    # gateway's GET /v1/requests/<id>/stream seam); oldest bytes fall
+    # off first once exceeded.
+    stream_buffer_bytes: int = 1 << 20
+
+    @property
+    def active(self) -> bool:
+        return bool(self.suspend)
+
+
+@dataclasses.dataclass
 class ImagestoreConfigure:
     """Knobs for the segmented-image / compile-cache / snapshot
     subsystem (wasmedge_tpu/imagestore/, r22).
@@ -492,6 +526,8 @@ class Configure:
     obs: ObsConfigure = dataclasses.field(default_factory=ObsConfigure)
     serve: ServeConfigure = dataclasses.field(default_factory=ServeConfigure)
     hv: HvConfigure = dataclasses.field(default_factory=HvConfigure)
+    effects: EffectsConfigure = dataclasses.field(
+        default_factory=EffectsConfigure)
     imagestore: ImagestoreConfigure = dataclasses.field(
         default_factory=ImagestoreConfigure)
     compiler: CompilerConfigure = dataclasses.field(default_factory=CompilerConfigure)

@@ -1,0 +1,35 @@
+"""Guest suspend/resume via effect handlers (r23).
+
+Blocking hostcalls — `poll_oneoff` pure-clock sleeps and the new
+`wasmedge.await_event` import — lower into a PARKED effect instead of
+blocking the serving thread: the lane rides back to the launch
+boundary under a dedicated trap sentinel (batch/image.py TRAP_PARKED),
+serializes through the hv SwapStore column path at zero resident cost,
+and the physical lane returns to the recycler.  A `ParkedSession`
+(request id, wake condition, swap key, stdout cursor) carries the
+suspended guest; wakes come from `POST /v1/requests/<id>/wake`
+(optional payload delivered into the guest's await_event buffer) or a
+deterministic timer wheel, and a woken session re-enters as a swapped
+vlane install — bit-identical to never having parked.
+
+Everything is gated on Configure.effects (off by default): the off
+configuration runs the exact pre-r23 serving path.
+"""
+
+from wasmedge_tpu.effects.hostfuncs import (
+    AWAIT_EVENT_MODULE,
+    AwaitEvent,
+    effects_import_object,
+)
+from wasmedge_tpu.effects.runtime import EffectsRuntime
+from wasmedge_tpu.effects.session import ParkedSession
+from wasmedge_tpu.effects.stream import StreamBuf
+
+__all__ = [
+    "AWAIT_EVENT_MODULE",
+    "AwaitEvent",
+    "EffectsRuntime",
+    "ParkedSession",
+    "StreamBuf",
+    "effects_import_object",
+]

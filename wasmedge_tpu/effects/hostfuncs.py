@@ -1,0 +1,47 @@
+"""The `wasmedge` host module: imports the effect subsystem lowers.
+
+`await_event(buf_ptr, buf_len, nwritten_ptr) -> errno` blocks the
+guest until an external wake (`POST /v1/requests/<id>/wake`) delivers
+a payload into `buf_ptr` (truncated to `buf_len`; the delivered length
+lands at `nwritten_ptr` as a u32).  Under an effects-enabled serving
+loop the call never executes host-side at all — the serve-round
+intercept (effects/runtime.py) either delivers a pending payload or
+parks the lane.  This body is the FALLBACK for every other context
+(scalar engine, effects-off serving, a module run outside a server):
+it completes immediately with Errno.AGAIN and zero bytes, so linking
+against the import never requires the subsystem to be on.
+"""
+
+from __future__ import annotations
+
+from wasmedge_tpu.host.wasi.wasi_abi import Errno
+from wasmedge_tpu.runtime.hostfunc import HostFunctionBase, ImportObject
+
+MASK32 = 0xFFFFFFFF
+
+# Import-module name guests link against: (import "wasmedge"
+# "await_event" (func ...)).
+AWAIT_EVENT_MODULE = "wasmedge"
+
+
+class AwaitEvent(HostFunctionBase):
+    """Fallback host body for `wasmedge.await_event` (see module doc)."""
+
+    def __init__(self):
+        super().__init__(["i32", "i32", "i32"], ["i32"],
+                         name="await_event")
+
+    def body(self, mem, buf_ptr, buf_len, nwritten_ptr):
+        if mem is not None:
+            mem.store(nwritten_ptr & MASK32, 4, 0)
+        return Errno.AGAIN
+
+
+def effects_import_object() -> ImportObject:
+    """The registrable `wasmedge` host module (one per instance, like
+    the WASI module — registered unconditionally so modules importing
+    await_event always link; the effect lowering itself stays gated on
+    Configure.effects)."""
+    obj = ImportObject(AWAIT_EVENT_MODULE)
+    obj.add_func("await_event", AwaitEvent())
+    return obj

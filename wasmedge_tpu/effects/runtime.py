@@ -1,0 +1,800 @@
+"""EffectsRuntime: the serving-side effect handler for suspended guests.
+
+One instance rides one BatchServer.  Two halves:
+
+  - the SERVE-ROUND INTERCEPT (`intercept`, called from
+    batch/hostcall.py serve_batch_state while the launch slice runs
+    off the server lock): classifies waiting hostcall lanes whose
+    target is a blocking call.  `wasmedge.await_event` either delivers
+    a pending wake payload into the guest's buffer (the exact bytes an
+    HTTP wake posted) or marks the lane TRAP_PARKED; a conforming
+    pure-clock `poll_oneoff` either synthesizes its single clock event
+    (timer already elapsed / zero timeout) or parks with a timer.
+    Delivery writes guest memory through the serve round's
+    PlaneMemoryCache and pushes the result cell through the same
+    stack-set path as a host-served call, so a woken run is
+    bit-identical to one where the payload was already waiting.
+
+  - the BOUNDARY PASSES (called by the server under its lock):
+    `park_boundary` serializes TRAP_PARKED lanes through the SwapStore
+    column path (hv/swapstore.py) and frees the physical lanes;
+    `process_wakes` drains queued HTTP wakes, fires due timers, and
+    expires timer-parked sessions past their deadline; `install_woken`
+    restores woken sessions onto free lanes through the shared
+    column-install pass (hv/manager.py install_lane_columns) — or, on
+    an hv server, woken sessions hand off into hv.waiting as swapped
+    virtual lanes and re-enter through the ordinary swap-in.
+
+Fault seams (testing/faults.py): `session_park` — a faulted park
+leaves the lane resident (trap returns to TRAP_HOSTCALL, the intercept
+re-marks it next round); `session_wake` — a faulted wake re-queues the
+wake (HTTP) or re-arms the timer without losing the session.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import struct
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from wasmedge_tpu.effects.session import ParkedSession
+from wasmedge_tpu.effects.stream import StreamBuf
+from wasmedge_tpu.hv.swapstore import (
+    SwapCorrupt,
+    SwapStore,
+    deserialize_lane,
+    serialize_lanes,
+)
+
+MASK32 = 0xFFFFFFFF
+
+# park-duration histogram bucket upper bounds (seconds)
+PARK_BUCKETS = (0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0, 600.0)
+
+
+class EffectsRuntime:
+    """Suspend/resume state machine for one BatchServer (see module
+    doc).  Boundary passes run under the owning server's lock; the
+    intercept and `wake()` run on other threads and synchronize on the
+    internal lock, which protects the wake queue / pending payloads /
+    parked table."""
+
+    def __init__(self, knobs, lanes: int, store: Optional[SwapStore] = None,
+                 faults=None, obs=None, record=None, clock=time.monotonic):
+        self.k = knobs
+        self.lanes = int(lanes)
+        self.store = store if store is not None \
+            else SwapStore(dir=knobs.swap_dir, faults=faults)
+        self.faults = faults
+        self.obs = obs
+        self._record = record or (lambda fault_class, exc: None)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        # lane -> request id snapshot, set by the server just before
+        # each launch (bindings are boundary-stable, so the intercept
+        # reads it without the server lock)
+        self.lane_rids: Dict[int, int] = {}
+        self.parked: Dict[int, ParkedSession] = {}
+        self.pending: Dict[int, deque] = {}    # rid -> wake payloads
+        self._wakes: deque = deque()           # queued (rid, payload)
+        self._elapsed: set = set()             # rids with a fired timer
+        self._timers: list = []                # heap (wake_at, seq, rid)
+        # rid -> (wake, wake_at) recorded by the intercept, consumed by
+        # park_boundary
+        self._pending_parks: Dict[int, tuple] = {}
+        # rid -> (deadline_left, parked_at) for sessions handed off to
+        # hv — note_installed() re-arms the deadline at swap-in
+        self._pending_install: Dict[int, tuple] = {}
+        self._install_jit = [None]
+        self.counters = {
+            "parks": 0, "resumes": 0, "delivered": 0,
+            "wakes_http": 0, "wakes_timer": 0,
+            "park_faults": 0, "wake_faults": 0, "corrupt": 0,
+        }
+        self._park_obs = [0, 0.0, [0] * (len(PARK_BUCKETS) + 1)]
+        self.streams: Dict[int, StreamBuf] = {}
+        self._closed_streams: deque = deque()  # FIFO retention pruning
+
+    # -- geometry ----------------------------------------------------------
+    def resize(self, lanes: int):
+        """Adopt a grown lane pool (live reshard): parked sessions are
+        keyed by request id and ride through; the install pass retraces
+        at the new shapes."""
+        self.lanes = int(lanes)
+        self._install_jit = [None]
+
+    # -- serve-round intercept ----------------------------------------------
+    def begin_launch(self, lane_rids: Dict[int, int]):
+        self.lane_rids = dict(lane_rids)
+
+    def intercept(self, engine, waiting, ks, slab_lo, slab_hi, fp, pc,
+                  opbase, sp, cache, new_trap, new_pc, stack_sets):
+        """Classify blocking hostcalls among the serve round's waiting
+        lanes; returns the set of lane indices consumed (parked or
+        completed here) — the normal host drain skips them."""
+        from wasmedge_tpu.batch.image import TRAP_PARKED
+        from wasmedge_tpu.host.wasi.wasi_abi import Errno
+
+        consumed = set()
+        if cache is None:
+            return consumed   # both calls need guest memory
+
+        def arg(lane, i):
+            base = int(fp[lane]) + i
+            lo = int(np.uint32(slab_lo[base, lane]))
+            hi = int(np.uint32(slab_hi[base, lane]))
+            return lo | (hi << 32)
+
+        def resume(lane, errno):
+            ob = int(opbase[lane])
+            stack_sets.append((
+                np.asarray([ob], np.int64)[None, :],
+                np.asarray([int(lane)], np.int64),
+                np.asarray([np.int32(np.uint32(errno & MASK32))],
+                           np.int32)[None, :],
+                np.asarray([np.int32(0)], np.int32)[None, :]))
+            sp[lane] = ob + 1
+            new_trap[lane] = 0
+            new_pc[lane] = pc[lane] + 1   # resume at the stub's RETURN
+
+        for k in np.unique(ks):
+            fi = engine.resolve_func(int(k))
+            name = getattr(getattr(fi, "host", None), "name", None)
+            if name not in ("await_event", "poll_oneoff"):
+                continue
+            for lane in waiting[ks == k]:
+                lane = int(lane)
+                rid = self.lane_rids.get(lane)
+                if rid is None:
+                    continue   # not server-managed: normal host serve
+                if name == "await_event":
+                    verdict = self._await_event(lane, rid, arg, cache,
+                                                resume, Errno)
+                else:
+                    verdict = self._poll_oneoff(lane, rid, arg, cache,
+                                                resume, Errno)
+                if verdict == "park":
+                    new_trap[lane] = TRAP_PARKED
+                    consumed.add(lane)
+                elif verdict == "done":
+                    consumed.add(lane)
+        return consumed
+
+    def _await_event(self, lane, rid, arg, cache, resume, Errno):
+        buf_ptr = arg(lane, 0) & MASK32
+        buf_len = arg(lane, 1) & MASK32
+        nwritten_ptr = arg(lane, 2) & MASK32
+        with self._lock:
+            q = self.pending.get(rid)
+            payload = q.popleft() if q else None
+            if payload is None:
+                # nothing to deliver: park until an external wake
+                self._pending_parks[rid] = ("http", None)
+                return "park"
+            if not q:
+                self.pending.pop(rid, None)
+        data = bytes(payload)[:buf_len]
+        if data:
+            cache.write_bytes(lane, buf_ptr, data)
+        cache.write_bytes(lane, nwritten_ptr,
+                          struct.pack("<I", len(data)))
+        self.counters["delivered"] += 1
+        resume(lane, int(Errno.SUCCESS))
+        return "done"
+
+    def _poll_oneoff(self, lane, rid, arg, cache, resume, Errno):
+        from wasmedge_tpu.host.wasi import wasi_abi as abi
+
+        in_ptr = arg(lane, 0) & MASK32
+        out_ptr = arg(lane, 1) & MASK32
+        nsubs = arg(lane, 2) & MASK32
+        nevents_ptr = arg(lane, 3) & MASK32
+        if nsubs == 0 or nsubs > 128:
+            return None   # host path handles (INVAL / oversized)
+        min_rel = None
+        first_userdata = None
+        for j in range(nsubs):
+            raw = cache.read_bytes(
+                lane, in_ptr + j * abi.SUBSCRIPTION_SIZE,
+                abi.SUBSCRIPTION_SIZE)
+            userdata = int.from_bytes(raw[0:8], "little")
+            tag = raw[8]
+            if tag != abi.Eventtype.CLOCK:
+                return None   # fd / unknown subscriptions: host path
+            clock_id = int.from_bytes(raw[16:20], "little")
+            timeout = int.from_bytes(raw[24:32], "little")
+            flags = int.from_bytes(raw[40:42], "little")
+            if flags & abi.Subclockflags.ABSTIME or clock_id > 3:
+                return None   # conservative: host path
+            if first_userdata is None:
+                first_userdata = userdata
+            min_rel = timeout if min_rel is None \
+                else min(min_rel, timeout)
+        with self._lock:
+            elapsed = rid in self._elapsed
+            if elapsed:
+                self._elapsed.discard(rid)
+        if elapsed or min_rel == 0:
+            # deliver exactly the host tail: ONE event for the first
+            # clock subscription in subscription order
+            ev = abi.pack_event(first_userdata, Errno.SUCCESS,
+                                abi.Eventtype.CLOCK)
+            cache.write_bytes(lane, out_ptr, ev)
+            cache.write_bytes(lane, nevents_ptr, struct.pack("<I", 1))
+            resume(lane, int(Errno.SUCCESS))
+            return "done"
+        rel_s = min_rel / 1e9
+        if rel_s < max(float(self.k.min_park_timeout_s), 0.0):
+            return None   # too short to be worth a park round-trip
+        with self._lock:
+            self._pending_parks[rid] = ("timer", self.clock() + rel_s)
+        return "park"
+
+    # -- boundary: park ------------------------------------------------------
+    def park_boundary(self, engine, state, bindings, recycler, free_cb):
+        """Serialize every TRAP_PARKED lane out through the SwapStore
+        and free its physical lane.  A faulted park (seam
+        `session_park`, a serialization error, or a store failure)
+        leaves the lane RESIDENT — its trap returns to TRAP_HOSTCALL
+        and the intercept re-marks it at the next boundary."""
+        import jax.numpy as jnp
+
+        from wasmedge_tpu.batch.image import TRAP_HOSTCALL, TRAP_PARKED
+
+        trap = np.asarray(state.trap)
+        lanes = [lane for lane in sorted(bindings)
+                 if trap[lane] == TRAP_PARKED]
+        if not lanes:
+            return state
+        now = self.clock()
+        survivors = []
+        for lane in lanes:
+            rid = bindings[lane].id
+            with self._lock:
+                info = self._pending_parks.pop(rid, ("http", None))
+            try:
+                if self.faults is not None:
+                    self.faults.fire("session_park", lane=int(lane),
+                                     id=rid)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self.counters["park_faults"] += 1
+                self._record("effects", e)
+                continue   # stays resident; retried next boundary
+            survivors.append((lane, rid, info))
+        # every TRAP_PARKED lane resumes from TRAP_HOSTCALL: a parked
+        # survivor's serialized column must re-enter the hostcall serve
+        # on install, and a faulted park retries the intercept
+        idx = jnp.asarray(np.asarray(lanes, np.int64))
+        state = state._replace(trap=state.trap.at[idx].set(TRAP_HOSTCALL))
+        if not survivors:
+            return state
+        cur = getattr(engine, "_stdout_cursor", None)
+        lanes_idx = [lane for lane, _, _ in survivors]
+        spos = [int(cur[0][lane]) if cur is not None else 0
+                for lane in lanes_idx]
+        try:
+            payloads = serialize_lanes(state, lanes_idx, self.lanes,
+                                       stdout_pos=spos)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            self.counters["park_faults"] += len(survivors)
+            self._record("effects", e)
+            return state   # whole batch stays resident; retried
+        parked_lanes = []
+        for (lane, rid, (wake, wake_at)), payload, sp in zip(
+                survivors, payloads, spos):
+            try:
+                key = self.store.put(payload)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self.counters["park_faults"] += 1
+                self._record("effects", e)
+                continue
+            req = bindings[lane]
+            deadline_left = None
+            if wake == "http" and req.deadline is not None:
+                # the deadline clock PAUSES while waiting on an
+                # explicit wake (ISSUE 19 satellite); timer sleeps
+                # keep their absolute deadline
+                deadline_left = max(req.deadline - now, 0.0)
+                req.deadline = None
+            ps = ParkedSession(req, key, sp, wake, wake_at=wake_at,
+                               deadline_left=deadline_left,
+                               parked_at=now)
+            with self._lock:
+                self.parked[rid] = ps
+                if wake == "timer" and wake_at is not None:
+                    heapq.heappush(self._timers,
+                                   (wake_at, next(self._seq), rid))
+                if self.pending.get(rid):
+                    # a wake landed while the park was in flight: the
+                    # session is install-ready immediately
+                    ps.woken = True
+            bindings.pop(lane, None)
+            free_cb(lane, req)
+            parked_lanes.append(lane)
+            self.counters["parks"] += 1
+            if self.obs is not None:
+                self.obs.instant("session_park", cat="effects",
+                                 track="effects", lane=int(lane),
+                                 id=rid, wake=wake,
+                                 nbytes=len(payload))
+        if parked_lanes:
+            state = recycler.park(state, parked_lanes)
+        return state
+
+    # -- boundary: wakes -----------------------------------------------------
+    def wake(self, rid: int, payload: Optional[bytes] = None):
+        """Queue an external wake (HTTP thread safe); the serving loop
+        applies it at the next boundary."""
+        with self._lock:
+            self._wakes.append((int(rid), payload))
+
+    def process_wakes(self, now: Optional[float] = None):
+        """Drain queued HTTP wakes, fire due timers, expire
+        timer-parked sessions past their deadline.  Returns
+        (ready, expired): `ready` = sessions newly install-ready,
+        `expired` = requests whose deadline lapsed while parked (the
+        caller rejects their futures and bumps its counters)."""
+        now = self.clock() if now is None else now
+        ready: List[ParkedSession] = []
+        expired = []
+        with self._lock:
+            n = len(self._wakes)
+            for _ in range(n):
+                rid, payload = self._wakes.popleft()
+                try:
+                    if self.faults is not None:
+                        self.faults.fire("session_wake", id=rid,
+                                         source="http")
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    self.counters["wake_faults"] += 1
+                    self._record("effects", e)
+                    # re-queued, not lost: retried next boundary
+                    self._wakes.append((rid, payload))
+                    continue
+                self.pending.setdefault(rid, deque()).append(
+                    b"" if payload is None else bytes(payload))
+                self.counters["wakes_http"] += 1
+                ps = self.parked.get(rid)
+                if ps is not None and not ps.woken:
+                    ps.woken = True
+                    ready.append(ps)
+            requeue = []
+            while self._timers and self._timers[0][0] <= now:
+                ent = heapq.heappop(self._timers)
+                rid = ent[2]
+                ps = self.parked.get(rid)
+                if ps is None or ps.woken or ps.wake != "timer":
+                    continue   # superseded (woken another way / gone)
+                try:
+                    if self.faults is not None:
+                        self.faults.fire("session_wake", id=rid,
+                                         source="timer")
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    self.counters["wake_faults"] += 1
+                    self._record("effects", e)
+                    requeue.append(ent)   # re-armed, not lost
+                    break
+                self._elapsed.add(rid)
+                ps.woken = True
+                self.counters["wakes_timer"] += 1
+                ready.append(ps)
+            for ent in requeue:
+                heapq.heappush(self._timers, ent)
+            for rid, ps in list(self.parked.items()):
+                if ps.woken or ps.wake != "timer":
+                    continue
+                d = ps.req.deadline
+                if d is not None and now > d:
+                    self.parked.pop(rid)
+                    self.store.release(ps.key)
+                    self._elapsed.discard(rid)
+                    expired.append(ps.req)
+        return ready, expired
+
+    def handoff_woken(self):
+        """Remove every install-ready session from the parked table for
+        hv re-entry (the caller seeds hv.waiting with swapped virtual
+        lanes; the store reference transfers with the key).  The
+        deadline re-arm + park-duration observation defer to
+        note_installed() at swap-in."""
+        out = []
+        with self._lock:
+            for rid in [r for r, ps in self.parked.items() if ps.woken]:
+                ps = self.parked.pop(rid)
+                self._pending_install[rid] = (ps.deadline_left,
+                                              ps.parked_at, ps.wake)
+                out.append(ps)
+        return out
+
+    # -- boundary: install ---------------------------------------------------
+    def install_woken(self, engine, state, free, bindings,
+                      install_cb=None):
+        """Restore woken sessions onto free physical lanes (the non-hv
+        path): fetch + verify + ONE shared column-install pass, stdout
+        cursor continuity, bindings update.  A corrupt store entry
+        rejects that one request machine-readably; any other failure
+        keeps the session woken and retries next boundary."""
+        from wasmedge_tpu.hv.manager import install_lane_columns
+
+        with self._lock:
+            ready = [ps for ps in self.parked.values() if ps.woken]
+        if not ready or not free:
+            return state
+        pairs = []
+        for ps in ready[:len(free)]:
+            pairs.append((heapq.heappop(free), ps))
+        rows = []
+        for lane, ps in pairs:
+            req = ps.req
+            try:
+                payload = self.store.get(ps.key)
+                cols, spos = deserialize_lane(payload)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except SwapCorrupt as e:
+                from wasmedge_tpu.serve.queue import ServeRejected
+
+                self.counters["corrupt"] += 1
+                self._record("effects", e)
+                with self._lock:
+                    self.parked.pop(req.id, None)
+                self.store.release(ps.key)
+                if not req.future.done:
+                    req.future._reject(ServeRejected(
+                        f"request {req.id} lost: parked session state "
+                        f"corrupt ({e.reason})"))
+                self.close_stream(req.id, error="session lost")
+                heapq.heappush(free, lane)
+                continue
+            except Exception as e:
+                self.counters["wake_faults"] += 1
+                self._record("effects", e)
+                heapq.heappush(free, lane)
+                continue
+            rows.append((lane, ps, cols, spos))
+        if not rows:
+            return state
+        try:
+            state = install_lane_columns(
+                state, self.lanes, [r[0] for r in rows],
+                [r[2] for r in rows], self._install_jit)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            self.counters["wake_faults"] += len(rows)
+            self._record("effects", e)
+            for lane, *_ in rows:
+                heapq.heappush(free, lane)
+            return state
+        cur = getattr(engine, "_stdout_cursor", None)
+        now = self.clock()
+        for lane, ps, cols, spos in rows:
+            req = ps.req
+            if cur is not None:
+                # continue the REQUEST's logical output stream on the
+                # new physical lane (same rule as an hv swap-in)
+                cur[0][lane] = spos
+                cur[1][lane] = spos
+            self.store.release(ps.key)
+            with self._lock:
+                self.parked.pop(req.id, None)
+            bindings[lane] = req
+            if ps.deadline_left is not None:
+                req.deadline = now + ps.deadline_left
+            self._observe_park(now - ps.parked_at)
+            self.counters["resumes"] += 1
+            if self.obs is not None:
+                self.obs.instant("session_wake", cat="effects",
+                                 track="effects", lane=int(lane),
+                                 id=req.id, wake=ps.wake)
+            if install_cb is not None:
+                install_cb(lane, req)
+        return state
+
+    def note_installed(self, req):
+        """hv-path install hook: re-arm a paused deadline + observe the
+        park duration when a handed-off session lands through swap-in."""
+        info = self._pending_install.pop(req.id, None)
+        if info is None:
+            return
+        deadline_left, parked_at, _wake = info
+        now = self.clock()
+        if deadline_left is not None:
+            req.deadline = now + deadline_left
+        self._observe_park(now - parked_at)
+        self.counters["resumes"] += 1
+
+    def _observe_park(self, seconds: float):
+        s = max(float(seconds), 0.0)
+        obs = self._park_obs
+        obs[0] += 1
+        obs[1] += s
+        for i, ub in enumerate(PARK_BUCKETS):
+            if s <= ub:
+                obs[2][i] += 1
+                break
+        else:
+            obs[2][-1] += 1
+
+    # -- cross-host migration (fleet/) ---------------------------------------
+    def export_parked(self, rid: int):
+        """Detach one parked session for migration: (entry, payload)
+        where `entry` is the journal record EXTENDED with the wake
+        condition and remaining-deadline seconds, `payload` the
+        SwapStore blob.  The payload reads BEFORE anything detaches —
+        an unreadable blob leaves the session exactly where it was."""
+        rid = int(rid)
+        with self._lock:
+            ps = self.parked.get(rid)
+            if ps is None:
+                raise KeyError(f"request {rid} is not a parked session")
+            key = ps.key
+        payload = self.store.get(key)   # SwapCorrupt raises HERE
+        now = self.clock()
+        with self._lock:
+            ps = self.parked.pop(rid, None)
+            if ps is None:   # raced another export
+                raise KeyError(f"request {rid} is not a parked session")
+            # queued-but-unprocessed wakes for this rid migrate with it
+            qw = [(b"" if p is None else bytes(p))
+                  for r, p in self._wakes if r == rid]
+            if qw:
+                self._wakes = deque((r, p) for r, p in self._wakes
+                                    if r != rid)
+            entry = ps.journal(now, list(self.pending.pop(rid, ()))
+                               + qw)
+            if ps.req.deadline is not None:
+                entry["deadline_s"] = max(ps.req.deadline - now, 0.001)
+            self._elapsed.discard(rid)
+            # a stale timer-heap entry is skipped by process_wakes
+            # (parked.get(rid) is None -> superseded)
+        self.store.release(key)
+        return entry, payload
+
+    def adopt_parked(self, entry: dict, payload: bytes, req):
+        """Install a migrated parked session under its ORIGINAL id:
+        the payload verifies against its content key (SwapStore.adopt)
+        and the wake condition re-arms from the entry — pending
+        payloads deliver, a remaining timer re-schedules, a session
+        exported mid-wake installs at the next boundary."""
+        self.store.adopt(entry["key"], bytes(payload))
+        now = self.clock()
+        with self._lock:
+            ps = ParkedSession.from_journal(entry, req, now)
+            self.parked[req.id] = ps
+            for hexp in entry.get("payloads", ()):
+                self.pending.setdefault(req.id, deque()).append(
+                    bytes.fromhex(hexp))
+            if ps.woken or self.pending.get(req.id):
+                ps.woken = True
+                if ps.wake == "timer":
+                    self._elapsed.add(req.id)
+            elif ps.wake == "timer" and ps.wake_at is not None:
+                heapq.heappush(self._timers,
+                               (ps.wake_at, next(self._seq), req.id))
+        return ps
+
+    # -- scheduling hints ----------------------------------------------------
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self.parked)
+
+    def parked_ids(self) -> tuple:
+        with self._lock:
+            return tuple(sorted(self.parked))
+
+    def parked_requests(self) -> List[object]:
+        with self._lock:
+            return [ps.req for ps in self.parked.values()]
+
+    def has_woken(self) -> bool:
+        with self._lock:
+            return any(ps.woken for ps in self.parked.values())
+
+    def parked_by_tenant(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        with self._lock:
+            for ps in self.parked.values():
+                out[ps.req.tenant] = out.get(ps.req.tenant, 0) + 1
+        return out
+
+    def runnable(self, now: Optional[float] = None) -> bool:
+        """True when a boundary pass would make progress right now
+        (queued wakes, a due timer, or an install-ready session) —
+        the server's idle wait keys off this plus next_deadline()."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            if self._wakes or self._pending_parks:
+                return True
+            if any(ps.woken for ps in self.parked.values()):
+                return True
+            return bool(self._timers) and self._timers[0][0] <= now
+
+    def next_deadline(self) -> Optional[float]:
+        """Earliest timer wake (monotonic stamp), or the earliest
+        parked-session deadline, whichever is sooner; None = purely
+        event-driven (the idle wait blocks on the condvar)."""
+        with self._lock:
+            out = self._timers[0][0] if self._timers else None
+            for ps in self.parked.values():
+                d = ps.req.deadline
+                if d is not None and (out is None or d < out):
+                    out = d
+            return out
+
+    # -- streams -------------------------------------------------------------
+    def stream_of(self, rid: int, create: bool = False
+                  ) -> Optional[StreamBuf]:
+        with self._lock:
+            buf = self.streams.get(int(rid))
+            if buf is None and create:
+                buf = StreamBuf(cap=int(self.k.stream_buffer_bytes))
+                self.streams[int(rid)] = buf
+            return buf
+
+    def stream_append(self, rid: int, pos: int, data: bytes):
+        self.stream_of(rid, create=True).append(pos, data)
+
+    def close_stream(self, rid: int, error: Optional[str] = None):
+        with self._lock:
+            buf = self.streams.get(int(rid))
+        if buf is None or buf.closed:
+            return
+        buf.close(error=error)
+        with self._lock:
+            # bounded retention of closed streams (late subscribers can
+            # still replay a resolved request's window)
+            self._closed_streams.append(int(rid))
+            while len(self._closed_streams) > 1024:
+                self.streams.pop(self._closed_streams.popleft(), None)
+
+    # -- checkpoint / restore ------------------------------------------------
+    def _queued_wake_payloads(self) -> Dict[int, list]:
+        """Queued-but-unprocessed HTTP wakes by rid (caller holds the
+        lock).  A wake 202'd between boundaries must ride the journal
+        exactly like an already-delivered pending payload — a crash in
+        that window must not strand the parked session."""
+        out: Dict[int, list] = {}
+        for rid, payload in self._wakes:
+            out.setdefault(rid, []).append(
+                b"" if payload is None else bytes(payload))
+        return out
+
+    def journal_entries(self) -> List[dict]:
+        now = self.clock()
+        with self._lock:
+            qw = self._queued_wake_payloads()
+            return [ps.journal(now, list(self.pending.get(rid, ()))
+                               + qw.get(rid, []))
+                    for rid, ps in self.parked.items()]
+
+    def snapshot_payload(self) -> List[tuple]:
+        """In-memory lineage payload: (req, journal-entry) pairs —
+        request OBJECTS so an in-process restore resolves the futures
+        callers already hold."""
+        now = self.clock()
+        with self._lock:
+            qw = self._queued_wake_payloads()
+            return [(ps.req, ps.journal(now,
+                                        list(self.pending.get(rid, ()))
+                                        + qw.get(rid, [])))
+                    for rid, ps in self.parked.items()]
+
+    def blob_arrays(self, record=None) -> Dict[str, np.ndarray]:
+        """Parked blobs as npz-ready uint8 arrays (checkpoint-embedded,
+        so a restore never depends on store retention)."""
+        out = {}
+        with self._lock:
+            sessions = list(self.parked.values())
+        for ps in sessions:
+            try:
+                payload = self.store.get(ps.key)
+            except SwapCorrupt as e:
+                (record or self._record)("effects", e)
+                continue
+            out[f"effblob_{ps.key}"] = np.frombuffer(payload, np.uint8)
+        return out
+
+    def restore(self, pairs, blobs: Dict[str, bytes],
+                covered_ids) -> List[object]:
+        """Reset the parked table to a snapshot's view.  `pairs` are
+        (req, journal-entry); `blobs` maps key -> payload bytes; ids in
+        `covered_ids` (resident bindings / hv virtual lanes) are
+        skipped — a request is never both resident and parked.  Returns
+        requests whose parked state could not be restored."""
+        now = self.clock()
+        lost = []
+        with self._lock:
+            for ps in self.parked.values():
+                self.store.release(ps.key)
+            self.parked.clear()
+            self._timers = []
+            self._elapsed.clear()
+            self._pending_parks.clear()
+            for req, entry in pairs:
+                if req.id in covered_ids or req.future.done:
+                    continue
+                key = entry["key"]
+                payload = blobs.get(key)
+                try:
+                    if payload is None:
+                        raise SwapCorrupt(key, "blob missing from "
+                                               "snapshot")
+                    self.store.adopt(key, bytes(payload))
+                except SwapCorrupt as e:
+                    self.counters["corrupt"] += 1
+                    self._record("effects", e)
+                    lost.append(req)
+                    continue
+                ps = ParkedSession.from_journal(entry, req, now)
+                self.parked[req.id] = ps
+                for hexp in entry.get("payloads", ()):
+                    self.pending.setdefault(req.id, deque()).append(
+                        bytes.fromhex(hexp))
+                if ps.woken or self.pending.get(req.id):
+                    ps.woken = True
+                    if ps.wake == "timer":
+                        self._elapsed.add(req.id)
+                elif ps.wake == "timer" and ps.wake_at is not None:
+                    heapq.heappush(self._timers,
+                                   (ps.wake_at, next(self._seq),
+                                    req.id))
+        return lost
+
+    def drop_all(self) -> List[object]:
+        """Shutdown / terminal-failure sweep: release every blob and
+        return the parked requests so the server can reject their
+        futures."""
+        out = []
+        with self._lock:
+            for ps in self.parked.values():
+                self.store.release(ps.key)
+                out.append(ps.req)
+            self.parked.clear()
+            self._timers = []
+            self._elapsed.clear()
+            self._wakes.clear()
+            self._pending_parks.clear()
+            self.pending.clear()
+        return out
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            parked = len(self.parked)
+            woken = sum(1 for ps in self.parked.values() if ps.woken)
+            timers = len(self._timers)
+            queued_wakes = len(self._wakes)
+        count, sum_s, buckets = self._park_obs
+        return {
+            "parked": parked,
+            "woken_pending": woken,
+            "timers": timers,
+            "queued_wakes": queued_wakes,
+            "store_entries": len(self.store),
+            "store_bytes": self.store.bytes_held,
+            "park_seconds": {
+                "count": count, "sum": sum_s,
+                "buckets": {("%g" % ub): buckets[i]
+                            for i, ub in enumerate(PARK_BUCKETS)},
+                "overflow": buckets[-1],
+            },
+            **self.counters,
+        }

@@ -1,0 +1,71 @@
+"""ParkedSession: one suspended request, off-device.
+
+The serializable record the durable journal carries (serve checkpoint
+`parked_sessions` entries + `effblob_<key>` extra arrays), mirroring
+hv's VirtualLane journal discipline: monotonic stamps are converted to
+REMAINING seconds at journal time and re-armed on restore, futures are
+process-local and never journaled, pending wake payloads ride as hex
+strings so a payload delivered just before a crash is not lost.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class ParkedSession:
+    """One admitted request suspended on a blocking effect, its lane
+    state parked in the SwapStore under a content key."""
+
+    __slots__ = ("req", "key", "stdout_pos", "wake", "wake_at",
+                 "deadline_left", "parked_at", "woken", "swaps")
+
+    def __init__(self, req, key: str, stdout_pos: int, wake: str,
+                 wake_at: Optional[float] = None,
+                 deadline_left: Optional[float] = None,
+                 parked_at: float = 0.0):
+        self.req = req
+        self.key = key
+        self.stdout_pos = int(stdout_pos)
+        self.wake = wake              # "http" | "timer"
+        self.wake_at = wake_at        # monotonic stamp (timer wakes)
+        # remaining deadline budget for an "http" park — the request's
+        # deadline clock PAUSES while waiting on an explicit wake and
+        # re-arms at install (ISSUE 19 satellite); timer parks keep
+        # their absolute deadline and are killed at the boundary when
+        # it lapses
+        self.deadline_left = deadline_left
+        self.parked_at = parked_at    # monotonic stamp (duration obs)
+        self.woken = False            # wake observed, install pending
+        self.swaps = 1
+
+    def journal(self, now: float, payloads: List[bytes]) -> dict:
+        """JSON-serializable checkpoint entry."""
+        return {
+            "id": self.req.id, "func": self.req.func_name,
+            "args": [int(a) for a in self.req.args],
+            "tenant": self.req.tenant,
+            "key": self.key, "stdout_pos": self.stdout_pos,
+            "wake": self.wake,
+            "wake_remaining": (max(self.wake_at - now, 0.0)
+                               if self.wake_at is not None else None),
+            "deadline_left": self.deadline_left,
+            "woken": bool(self.woken),
+            "payloads": [bytes(p).hex() for p in payloads],
+        }
+
+    @classmethod
+    def from_journal(cls, entry: dict, req, now: float
+                     ) -> "ParkedSession":
+        """Rebuild from a journal entry (`req` is the re-created or
+        reattached ServeRequest; timer deadlines re-arm from the
+        journaled remaining seconds)."""
+        wake_remaining = entry.get("wake_remaining")
+        ps = cls(req, entry["key"], int(entry.get("stdout_pos", 0)),
+                 entry.get("wake", "http"),
+                 wake_at=(now + float(wake_remaining)
+                          if wake_remaining is not None else None),
+                 deadline_left=entry.get("deadline_left"),
+                 parked_at=now)
+        ps.woken = bool(entry.get("woken", False))
+        return ps

@@ -1,0 +1,104 @@
+"""Per-request stdout stream buffers (the gateway /stream seam).
+
+Each request with the effects subsystem on gets a StreamBuf fed from
+the tier-0 stdout flush (batch/hostcall.py flush_stdout_buffers): the
+flush loop hands over each lane's FRESH record bytes together with
+their logical stream position, so chunks dedupe by position — a crash
+restore collapses the flush high-water mark and replays a window of
+output to the host fds (at-least-once there), but the stream buffer
+drops the overlap and subscribers see each logical byte once per
+connection.  Replay across RECONNECTS is offset-based: a subscriber
+passes the last offset it saw and reads forward; bytes older than the
+bounded window (EffectsConfigure.stream_buffer_bytes) are gone, and
+the read reports the gap instead of silently skipping.
+
+Thread model: the serving/launch thread appends, gateway handler
+threads block in read() — one Condition per buffer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+
+class StreamBuf:
+    """Bounded, offset-addressed byte window over one request's stdout
+    stream."""
+
+    def __init__(self, cap: int = 1 << 20):
+        self.cap = max(int(cap), 1)
+        self._cond = threading.Condition()
+        self._data = bytearray()
+        self._start = 0        # logical offset of _data[0]
+        self.closed = False
+        self.error: Optional[str] = None
+
+    @property
+    def end(self) -> int:
+        """Logical offset one past the last buffered byte."""
+        with self._cond:
+            return self._start + len(self._data)
+
+    def append(self, pos: int, data: bytes):
+        """Add `data` whose first byte sits at logical stream position
+        `pos`.  Overlap with already-buffered positions is a replay
+        (crash restore) and is dropped; a forward gap (bytes aged out
+        before ever reaching the buffer) cannot happen from the flush
+        seam, which always hands positions in order."""
+        if not data:
+            return
+        with self._cond:
+            end = self._start + len(self._data)
+            if pos < end:
+                skip = end - pos
+                if skip >= len(data):
+                    return
+                data = data[skip:]
+            self._data.extend(data)
+            over = len(self._data) - self.cap
+            if over > 0:
+                del self._data[:over]
+                self._start += over
+            self._cond.notify_all()
+
+    def close(self, error: Optional[str] = None):
+        """End of stream (request resolved / rejected).  `error` rides
+        to subscribers as the stream's terminal note."""
+        with self._cond:
+            self.closed = True
+            if error is not None:
+                self.error = error
+            self._cond.notify_all()
+
+    def read(self, offset: int, timeout: Optional[float] = None
+             ) -> Tuple[Optional[bytes], int, bool]:
+        """Block until bytes past `offset` exist (or the stream closes
+        / `timeout` lapses).  Returns (chunk, next_offset, closed);
+        chunk is None on a bare timeout.  An `offset` older than the
+        buffered window snaps forward to the window start — the caller
+        sees next_offset jump and can report the gap."""
+        with self._cond:
+            deadline = None
+            while True:
+                if offset < self._start:
+                    offset = self._start   # aged-out gap: snap forward
+                avail = self._start + len(self._data) - offset
+                if avail > 0:
+                    lo = offset - self._start
+                    chunk = bytes(self._data[lo:])
+                    return chunk, offset + len(chunk), self.closed
+                if self.closed:
+                    return b"", offset, True
+                if timeout is not None:
+                    import time as _t
+
+                    now = _t.monotonic()
+                    if deadline is None:
+                        deadline = now + timeout
+                    left = deadline - now
+                    if left <= 0:
+                        return None, offset, False
+                    self._cond.wait(timeout=left)
+                else:
+                    self._cond.wait()

@@ -283,6 +283,11 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 # answers 200 with the failing checks in the body
                 h = self.svc.health()
                 return self._reply(200 if h["ok"] else 503, h)
+            if url.path.startswith("/v1/requests/") \
+                    and url.path.endswith("/stream"):
+                # routed BEFORE _get_request: its id parse takes the
+                # LAST path segment, which here is "stream"
+                return self._stream_request(url)
             if url.path.startswith("/v1/requests/"):
                 return self._get_request(url.path)
             if url.path.startswith("/v1/fleet/"):
@@ -300,6 +305,9 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 return self._invoke(url)
             if url.path == "/v1/modules":
                 return self._register(url)
+            if url.path.startswith("/v1/requests/") \
+                    and url.path.endswith("/wake"):
+                return self._wake_request(url)
             if url.path.startswith("/v1/fleet/"):
                 return self._fleet_post(url.path)
             if url.path == "/v1/reshard":
@@ -476,6 +484,98 @@ class GatewayHandler(BaseHTTPRequestHandler):
                                      "request_id": req.id})
         code, out = result_response(req)
         return self._reply(code, out)
+
+    # -- durable sessions (wasmedge_tpu/effects/) --------------------------
+    def _rid_of(self, path: str) -> int:
+        """/v1/requests/<id>/<verb> -> id."""
+        parts = path.strip("/").split("/")
+        try:
+            return int(parts[2])
+        except (IndexError, ValueError):
+            raise ValueError(f"bad request id in {path!r}") from None
+
+    def _wake_request(self, url):
+        """POST /v1/requests/<id>/wake: deliver an external wake; the
+        raw body (may be empty) rides to the guest's await_event
+        return buffer.  202 — the wake applies at the next serving
+        boundary, at-least-once even when the id is not parked yet."""
+        rid = self._rid_of(url.path)
+        payload = self._read_body()
+        out = self.svc.wake(rid, payload if payload else None)
+        return self._reply(202, out)
+
+    def _stream_request(self, url):
+        """GET /v1/requests/<id>/stream: the request's stdout as a
+        chunked byte stream (default) or SSE (`?sse=1` / Accept:
+        text/event-stream).  `?offset=N` resumes after a reconnect —
+        each logical stdout byte is delivered once per connection;
+        replay after a crash restore is deduped by logical position at
+        the buffer, so scoping is at-least-once across a restore only
+        when the window aged out.  `?timeout=S` bounds the handler
+        (default 30s); the client reconnects from its last offset."""
+        import base64 as _b64
+        import time as _time
+
+        rid = self._rid_of(url.path)
+        q = parse_qs(url.query)
+        offset = int(q.get("offset", ["0"])[0])
+        timeout = float(q.get("timeout", ["30"])[0])
+        sse = q.get("sse", ["0"])[0] in ("1", "true") \
+            or "text/event-stream" in (self.headers.get("Accept") or "")
+        buf = self.svc.stream_of(rid)
+        if buf is None:
+            state, req = self.svc.request_state(rid)
+            if state == "ok":
+                # known request, no stream: effects off or no output
+                return self._reply(200, b"",
+                                   content_type="application/octet-stream")
+            raise KeyError(f"no stream for request {rid}")
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/event-stream" if sse
+                         else "application/octet-stream")
+        self.send_header("Cache-Control", "no-store")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("X-Stream-Offset", str(offset))
+        self.end_headers()
+
+        def chunk(b: bytes):
+            self.wfile.write(("%x\r\n" % len(b)).encode())
+            self.wfile.write(b)
+            self.wfile.write(b"\r\n")
+
+        deadline = _time.monotonic() + timeout
+        try:
+            while True:
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    break
+                data, nxt, closed = buf.read(offset,
+                                             timeout=min(left, 1.0))
+                if data:
+                    if sse:
+                        chunk(b"id: %d\ndata: %s\n\n"
+                              % (nxt, _b64.b64encode(data)))
+                    else:
+                        chunk(data)
+                    offset = nxt
+                elif data is None:
+                    # bare wait timeout: SSE keepalive, then re-read
+                    if sse:
+                        chunk(b": keepalive\n\n")
+                    continue
+                if closed and buf.end <= offset:
+                    if sse:
+                        err = buf.error
+                        chunk(b"event: end\ndata: %s\n\n"
+                              % json.dumps({"error": err}).encode())
+                    break
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass   # subscriber went away: nothing to clean up
+        self.close_connection = True
+        self.svc.count_http(200)
 
     def _register(self, url):
         q = parse_qs(url.query)

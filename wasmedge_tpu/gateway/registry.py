@@ -268,6 +268,14 @@ class ModuleRegistry:
                     e.os_fd = sink
                     sinks.append(sink)
         ex.register_import_object(store, wasi)
+        # the "wasmedge" effect-handler module registers alongside WASI
+        # — unconditionally, like WASI itself: modules importing
+        # await_event always LINK; the suspend lowering stays gated on
+        # Configure.effects (off, the fallback body completes with
+        # Errno.AGAIN immediately)
+        from wasmedge_tpu.effects import effects_import_object
+
+        ex.register_import_object(store, effects_import_object())
         return wasi, sinks
 
     # -- engine builder ----------------------------------------------------

@@ -1204,6 +1204,34 @@ class GatewayService:
             return "ok", req
         return ("pruned" if pruned else "unknown"), None
 
+    def wake(self, request_id: int,
+             payload: Optional[bytes] = None) -> dict:
+        """Deliver an external wake to a (possibly parked) request —
+        the POST /v1/requests/<id>/wake body rides to the guest's
+        await_event return buffer.  At-least-once: the wake queues
+        even when the id is not currently parked (it pre-delivers at
+        the request's next await_event), so a wake racing the park is
+        never lost."""
+        rid = int(request_id)
+        gen = self.current
+        if gen is None:
+            raise KeyError(f"no serving generation to wake request "
+                           f"{rid}")
+        state = gen.server.wake(rid, payload)
+        self.obs.instant("gateway_wake", cat="gateway",
+                         track="gateway", id=rid, state=state,
+                         nbytes=len(payload or b""))
+        return {"ok": True, "request_id": rid, "state": state}
+
+    def stream_of(self, request_id: int):
+        """The request's stdout StreamBuf (None when the effects
+        subsystem is off or no generation serves) — the
+        GET /v1/requests/<id>/stream handler blocks on it."""
+        gen = self.current
+        if gen is None:
+            return None
+        return gen.server.stream_of(int(request_id))
+
     def wait(self, req: GatewayRequest,
              timeout_s: Optional[float] = None) -> bool:
         """Block on the request's future (the sync-invoke path); the
@@ -1379,6 +1407,12 @@ class GatewayService:
             hv = gen.server.hv_stats()
             if hv is not None:
                 out["hv"] = hv
+            # parked-session occupancy (effects/) — absent when the
+            # suspend subsystem is off, so the default status body
+            # stays bit-identical to the pre-effects gateway
+            sessions = gen.server.session_stats()
+            if sessions is not None:
+                out["sessions"] = sessions
         if self.autoscale is not None:
             out["autoscale"] = self.autoscale.stats()
         if self.imagestore_enabled:
@@ -1414,6 +1448,7 @@ class GatewayService:
             gateway_counts=gateway_counts,
             shed_counts=shed_counts,
             hv_stats=gen.server.hv_stats() if gen else None,
+            session_stats=gen.server.session_stats() if gen else None,
             fleet_stats=self.fleet.stats()
             if self.fleet is not None else None,
             reshard_counts=reshard_counts or None,

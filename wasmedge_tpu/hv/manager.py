@@ -115,7 +115,7 @@ class LaneVirtualizer:
         self._resident_since: Dict[int, int] = {}
         self._last_retired = np.zeros(self.lanes, np.int64)
         self._last_trap = np.zeros(self.lanes, np.int64)
-        self._install_jit = None
+        self._install_jit = [None]
         # server-side install hook (counters/obs the server owns:
         # recycled_lanes, admission latency) — called as
         # install_cb(lane, req, first_install)
@@ -163,7 +163,7 @@ class LaneVirtualizer:
             [self._last_retired, np.zeros(grow, np.int64)])
         self._last_trap = np.concatenate(
             [self._last_trap, np.zeros(grow, np.int64)])
-        self._install_jit = None   # retrace at the new state shapes
+        self._install_jit = [None]  # retrace at the new state shapes
 
     # -- admission ---------------------------------------------------------
     def admitted(self, bindings) -> int:
@@ -545,52 +545,8 @@ class LaneVirtualizer:
             self.peak_resident_by_tenant[req.tenant] = n
 
     def _install_columns(self, state, lanes_list, cols_list):
-        """One jitted column-set pass restoring every serialized plane
-        at the given lanes (the swap-in half of the recycler's install
-        seam — same donation discipline and power-of-two index padding,
-        so at most log2(lanes)+1 variants compile per engine).  Pads
-        repeat lane 0 with lane 0's columns: duplicate index writes
-        carry identical values, so the pads are idempotent."""
-        import jax
-        import jax.numpy as jnp
-
-        if self._install_jit is None:
-            def install(state, idx, cols):
-                updates = {}
-                for name, col in cols.items():
-                    plane = getattr(state, name)
-                    if plane.ndim == 1:
-                        updates[name] = plane.at[idx].set(col)
-                    else:
-                        updates[name] = plane.at[:, idx].set(col)
-                return state._replace(**updates)
-
-            donate = (0,)
-            if jax.default_backend() == "cpu" and \
-                    getattr(jax.config, "jax_compilation_cache_dir",
-                            None):
-                donate = ()
-            self._install_jit = jax.jit(install, donate_argnums=donate)
-        n = len(lanes_list)
-        w = min(self.lanes, 1 << (n - 1).bit_length())
-        idx = np.full(w, lanes_list[0], np.int64)
-        idx[:n] = lanes_list
-        stacked = {}
-        for name in cols_list[0]:
-            cols = [np.asarray(c[name]) for c in cols_list]
-            cols = cols + [cols[0]] * (w - n)
-            # branch on the PLANE's rank, not the column's: serialized
-            # columns of 1-D planes arrive as shape (1,) (numpy's
-            # ascontiguousarray promotes 0-d scalars), which is
-            # indistinguishable from a depth-1 2-D plane's column
-            if getattr(state, name).ndim == 1:
-                stacked[name] = np.asarray(
-                    [c.reshape(()) for c in cols])          # (w,)
-            else:
-                stacked[name] = np.stack(cols, axis=-1)     # (D, w)
-        return self._install_jit(state, jnp.asarray(idx),
-                                 {k: jnp.asarray(a)
-                                  for k, a in stacked.items()})
+        return install_lane_columns(state, self.lanes, lanes_list,
+                                    cols_list, self._install_jit)
 
     # -- checkpoint / restore ----------------------------------------------
     def journal_entries(self) -> List[dict]:
@@ -685,3 +641,60 @@ class LaneVirtualizer:
             "store_bytes": self.store.bytes_held,
             **self.counters,
         }
+
+
+# ---------------------------------------------------------------------------
+# shared column-install pass (hv swap-in + effects/ session unpark)
+# ---------------------------------------------------------------------------
+def install_lane_columns(state, total_lanes: int, lanes_list, cols_list,
+                         jit_cache):
+    """One jitted column-set pass restoring every serialized plane at
+    the given lanes (the swap-in half of the recycler's install seam —
+    same donation discipline and power-of-two index padding, so at most
+    log2(lanes)+1 variants compile per engine).  Pads repeat lane 0
+    with lane 0's columns: duplicate index writes carry identical
+    values, so the pads are idempotent.
+
+    `jit_cache` is a single-slot list holding the compiled setter; the
+    owner clears it (sets [None]) when the state geometry changes
+    (reshard) so the pass retraces at the new shapes.  Shared with the
+    effects/ runtime: a parked session's unpark install is the exact
+    code path of an hv swap-in."""
+    import jax
+    import jax.numpy as jnp
+
+    if jit_cache[0] is None:
+        def install(state, idx, cols):
+            updates = {}
+            for name, col in cols.items():
+                plane = getattr(state, name)
+                if plane.ndim == 1:
+                    updates[name] = plane.at[idx].set(col)
+                else:
+                    updates[name] = plane.at[:, idx].set(col)
+            return state._replace(**updates)
+
+        donate = (0,)
+        if jax.default_backend() == "cpu" and \
+                getattr(jax.config, "jax_compilation_cache_dir", None):
+            donate = ()
+        jit_cache[0] = jax.jit(install, donate_argnums=donate)
+    n = len(lanes_list)
+    w = min(total_lanes, 1 << (n - 1).bit_length())
+    idx = np.full(w, lanes_list[0], np.int64)
+    idx[:n] = lanes_list
+    stacked = {}
+    for name in cols_list[0]:
+        cols = [np.asarray(c[name]) for c in cols_list]
+        cols = cols + [cols[0]] * (w - n)
+        # branch on the PLANE's rank, not the column's: serialized
+        # columns of 1-D planes arrive as shape (1,) (numpy's
+        # ascontiguousarray promotes 0-d scalars), which is
+        # indistinguishable from a depth-1 2-D plane's column
+        if getattr(state, name).ndim == 1:
+            stacked[name] = np.asarray(
+                [c.reshape(()) for c in cols])          # (w,)
+        else:
+            stacked[name] = np.stack(cols, axis=-1)     # (D, w)
+    return jit_cache[0](state, jnp.asarray(idx),
+                        {k: jnp.asarray(a) for k, a in stacked.items()})

@@ -63,7 +63,8 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                       fleet_stats=None, reshard_counts=None,
                       autoscale_actions=None,
                       compile_cache_counts=None,
-                      snapshot_counts=None) -> str:
+                      snapshot_counts=None,
+                      session_stats=None) -> str:
     """Render one metrics snapshot.  All sources optional: `recorder` a
     FlightRecorder, `stats` a common.statistics.Statistics, `hostcall_stats`
     an engine's pipeline counter dict, `failures` extra FailureRecords
@@ -84,7 +85,11 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
     `compile_cache_counts` the registry compile cache's counter dict
     and `snapshot_counts` the imagestore snapshot tally — both r22,
     passed only when Configure.imagestore is active, so a gateway
-    without the subsystem renders bit-identically to r21."""
+    without the subsystem renders bit-identically to r21.
+    `session_stats` an EffectsRuntime.stats() suspend/resume snapshot
+    (wasmedge_tpu/effects/) — r23, passed only when Configure.effects
+    is active, so a gateway without it renders bit-identically to
+    r22."""
     w = _Writer()
 
     if compile_cache_counts:
@@ -225,6 +230,60 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                 if hv_stats.get(kind):
                     w.sample("wasmedge_hv_swap_faults_total",
                              {"kind": kind}, int(hv_stats[kind]))
+
+    if session_stats:
+        w.head("wasmedge_sessions_parked", "gauge",
+               "Guest sessions suspended off-device awaiting an "
+               "external wake or a timer (wasmedge_tpu/effects/: "
+               "parked through the SwapStore, zero resident lanes).")
+        w.sample("wasmedge_sessions_parked", None,
+                 int(session_stats.get("parked", 0)))
+        w.head("wasmedge_session_wakes_total", "counter",
+               "Parked-session wakes by source (http = POST "
+               "/v1/requests/<id>/wake payload delivery, timer = "
+               "deterministic timer-wheel expiry).")
+        w.sample("wasmedge_session_wakes_total", {"source": "http"},
+                 int(session_stats.get("wakes_http", 0)))
+        w.sample("wasmedge_session_wakes_total", {"source": "timer"},
+                 int(session_stats.get("wakes_timer", 0)))
+        w.head("wasmedge_session_parks_total", "counter",
+               "Suspend transitions completed (lane serialized, "
+               "journaled, and freed at a launch boundary).")
+        w.sample("wasmedge_session_parks_total", None,
+                 int(session_stats.get("parks", 0)))
+        w.head("wasmedge_session_resumes_total", "counter",
+               "Woken sessions reinstalled onto a physical lane.")
+        w.sample("wasmedge_session_resumes_total", None,
+                 int(session_stats.get("resumes", 0)))
+        hist = session_stats.get("park_seconds")
+        if hist is not None:
+            w.head("wasmedge_session_park_seconds", "histogram",
+                   "Wall seconds each completed park spent suspended "
+                   "(park boundary to lane reinstall).")
+            cum = 0
+            for ub in sorted(hist.get("buckets", {}),
+                             key=lambda k: float(k)):
+                cum += int(hist["buckets"][ub])
+                w.sample("wasmedge_session_park_seconds_bucket",
+                         {"le": ub}, cum)
+            w.sample("wasmedge_session_park_seconds_bucket",
+                     {"le": "+Inf"}, int(hist.get("count", 0)))
+            w.sample("wasmedge_session_park_seconds_sum", None,
+                     float(hist.get("sum", 0.0)))
+            w.sample("wasmedge_session_park_seconds_count", None,
+                     int(hist.get("count", 0)))
+        if session_stats.get("park_faults") or \
+                session_stats.get("wake_faults") or \
+                session_stats.get("corrupt"):
+            w.head("wasmedge_session_faults_total", "counter",
+                   "Suspend-path operations that failed (faulted park "
+                   "left the lane resident and retried; faulted wake "
+                   "re-queued; corrupt store entries rejected).")
+            for kind in ("park_faults", "wake_faults", "corrupt"):
+                if session_stats.get(kind):
+                    w.sample("wasmedge_session_faults_total",
+                             {"kind": kind},
+                             int(session_stats[kind]))
 
     if gateway_counts is not None:
         w.head("wasmedge_gateway_restarts_total", "counter",
@@ -495,7 +554,8 @@ def export_prometheus(path, recorder=None, stats=None,
                       reshard_counts=None,
                       autoscale_actions=None,
                       compile_cache_counts=None,
-                      snapshot_counts=None) -> str:
+                      snapshot_counts=None,
+                      session_stats=None) -> str:
     """Render and write a metrics snapshot to `path` (or file-like)."""
     text = render_prometheus(recorder=recorder, stats=stats,
                              hostcall_stats=hostcall_stats,
@@ -509,7 +569,8 @@ def export_prometheus(path, recorder=None, stats=None,
                              reshard_counts=reshard_counts,
                              autoscale_actions=autoscale_actions,
                              compile_cache_counts=compile_cache_counts,
-                             snapshot_counts=snapshot_counts)
+                             snapshot_counts=snapshot_counts,
+                             session_stats=session_stats)
     if hasattr(path, "write"):
         path.write(text)
     else:

@@ -61,7 +61,7 @@ import numpy as np
 
 from wasmedge_tpu.common.errors import EngineFailure, ErrCode, WasmError
 from wasmedge_tpu.common.statistics import FailureRecord, record_failure
-from wasmedge_tpu.batch.image import TRAP_DONE
+from wasmedge_tpu.batch.image import TRAP_DONE, TRAP_PARKED
 from wasmedge_tpu.batch.lineage import Lineage
 from wasmedge_tpu.serve.queue import (
     DeadlineExceeded,
@@ -185,6 +185,26 @@ class BatchServer:
             # the infrastructure — counted like an in-flight kill so
             # the outcome counters keep reconciling with submitted
             self.hv.lost_cb = self._hv_on_lost
+        # guest suspend/resume (wasmedge_tpu/effects/): when
+        # Configure.effects is on, blocking hostcalls (await_event,
+        # pure-clock poll_oneoff) park their lanes through the
+        # SwapStore at the boundary and re-enter on an external wake or
+        # timer.  Off (the default) the engine never grows an _effects
+        # attribute and every path below matches the pre-effects server
+        # exactly.
+        self.effects = None
+        if getattr(self.conf, "effects", None) is not None \
+                and self.conf.effects.active:
+            from wasmedge_tpu.effects import EffectsRuntime
+
+            self.effects = EffectsRuntime(
+                self.conf.effects, self.lanes,
+                store=(self.hv.store if self.hv is not None else None),
+                faults=faults, obs=self.obs, record=self._record)
+            self.engine._effects = self.effects
+        # parked-table fingerprint at the last good checkpoint: park /
+        # wake changes are durable state even when total stands still
+        self._eff_snap_ids = None
         self.checkpoint_dir = checkpoint_dir or self.k.checkpoint_dir
         self.state = None
         self.total = 0
@@ -299,14 +319,18 @@ class BatchServer:
 
     # -- cross-host lane migration (fleet/, r16) ---------------------------
     def list_swapped(self) -> List[int]:
-        """Request ids currently parked as SWAPPED virtual lanes (hv):
-        the migratable set — their full lane state is already a
-        content-addressed SwapStore payload."""
+        """Request ids currently parked off-device with a SwapStore
+        payload: hv SWAPPED virtual lanes plus effects parked sessions
+        — the migratable set (their full lane state is already a
+        content-addressed blob)."""
         with self._lock:
-            if self.hv is None:
-                return []
-            return [rid for rid, v in self.hv.waiting.items()
-                    if v.key is not None]
+            out: List[int] = []
+            if self.hv is not None:
+                out = [rid for rid, v in self.hv.waiting.items()
+                       if v.key is not None]
+            if self.effects is not None:
+                out.extend(self.effects.parked_ids())
+            return out
 
     def export_vlane(self, request_id: int):
         """Detach one waiting virtual lane for cross-host migration:
@@ -317,9 +341,20 @@ class BatchServer:
         state is reproducible from func+args alone).  The request
         leaves this server's accounting as `migrated`; its future is
         NOT resolved — the caller (fleet/federation.py) keeps it and
-        resolves it from the receiving peer's outcome.  Raises
-        KeyError when the id is not a waiting virtual lane."""
+        resolves it from the receiving peer's outcome.  An effects
+        PARKED SESSION exports the same way, its entry carrying the
+        wake condition (pending payloads, remaining timer, paused
+        deadline) so the receiving host resumes it bit-identically.
+        Raises KeyError when the id is neither a waiting virtual lane
+        nor a parked session."""
         with self._lock:
+            if self.effects is not None \
+                    and int(request_id) in self.effects.parked_ids():
+                entry, payload = self.effects.export_parked(
+                    int(request_id))
+                self.counters["migrated"] = \
+                    self.counters.get("migrated", 0) + 1
+                return entry, payload
             if self.hv is None:
                 raise KeyError("lane virtualization is off: no "
                                "migratable virtual lanes")
@@ -374,6 +409,30 @@ class BatchServer:
             if payload is None or entry.get("key") is None:
                 # stateless: indistinguishable from a fresh re-queue
                 fut = None
+            elif entry.get("wake") is not None:
+                # a migrated PARKED SESSION (the entry carries its wake
+                # condition): verify + park under the ORIGINAL id; the
+                # wake routes here from now on
+                if self.effects is None:
+                    raise ValueError(
+                        "cannot adopt a parked session: the effects "
+                        "subsystem is off on this server")
+                now = time.monotonic()
+                req = ServeRequest(
+                    func, args, tenant=entry.get("tenant", "default"),
+                    deadline=(now + float(entry["deadline_s"]))
+                    if entry.get("deadline_s") is not None else None,
+                    t_submit=now, request_id=rid)
+                advance_request_ids(rid)
+                self.effects.adopt_parked(entry, payload, req)
+                if not requeue:
+                    self.counters["submitted"] += 1
+                    self.counters["admitted"] += 1
+                else:
+                    self.counters["migrated"] = \
+                        self.counters.get("migrated", 0) - 1
+                self._wake.notify_all()
+                return req.future
             elif self.hv is None:
                 raise ValueError(
                     "cannot adopt mid-run lane state: lane "
@@ -421,26 +480,48 @@ class BatchServer:
     @property
     def in_flight(self) -> int:
         """Admitted requests holding capacity: resident lanes plus (hv)
-        virtual lanes waiting off-device."""
+        virtual lanes waiting off-device plus parked sessions."""
         n = len(self._bindings)
         if self.hv is not None:
             n += len(self.hv.waiting)
+        if self.effects is not None:
+            n += self.effects.in_flight()
         return n
 
     def _has_work(self) -> bool:
+        """In-flight or queued work exists — drain() waits on this
+        (a parked session IS in-flight work, even while nothing about
+        it can move until its wake arrives)."""
         return bool(self._bindings or len(self.queue)
-                    or (self.hv is not None and self.hv.waiting))
+                    or (self.hv is not None and self.hv.waiting)
+                    or (self.effects is not None
+                        and self.effects.in_flight()))
+
+    def _runnable_work(self) -> bool:
+        """Work a round would actually advance — step()'s return value
+        and the background driver's idle gate.  Parked sessions count
+        only once a wake / due timer / pending park makes a boundary
+        pass productive; otherwise the driver sleeps instead of
+        burning no-op rounds."""
+        if self._bindings or len(self.queue) \
+                or (self.hv is not None and self.hv.waiting):
+            return True
+        return self.effects is not None and self.effects.runnable()
 
     def _flight_by_tenant(self) -> Dict[str, int]:
         """Per-tenant admitted counts for FairQueue quota accounting —
-        virtual lanes count too: an admitted-but-swapped request holds
-        its tenant's quota exactly like a resident one."""
+        virtual lanes and parked sessions count too: an admitted-but-
+        suspended request holds its tenant's quota exactly like a
+        resident one."""
         out: Dict[str, int] = {}
         for req in self._bindings.values():
             out[req.tenant] = out.get(req.tenant, 0) + 1
         if self.hv is not None:
             for v in self.hv.waiting.values():
                 out[v.req.tenant] = out.get(v.req.tenant, 0) + 1
+        if self.effects is not None:
+            for tenant, n in self.effects.parked_by_tenant().items():
+                out[tenant] = out.get(tenant, 0) + n
         return out
 
     def step(self) -> bool:
@@ -458,7 +539,7 @@ class BatchServer:
                 # (so a run_until_idle() polling alongside start()
                 # parks instead of busy-spinning) and report status
                 self._wake.wait(timeout=0.05)
-                return self._has_work()
+                return self._runnable_work()
             self._stepping = True
         try:
             return self._step_body()
@@ -481,11 +562,20 @@ class BatchServer:
         with self._lock:
             now = time.monotonic()
             self._expire_queued(now)
+            if self.effects is not None:
+                self._effects_boundary(now)
             admitted = self._admit(now)
             if self.hv is not None:
                 admitted += self._hv_boundary(now)
             if self._compactor is not None and self._bindings:
                 self._compact_round()
+            if self.effects is not None:
+                # lane -> request id snapshot for the launch slice's
+                # intercept (bindings are boundary-stable, so the
+                # off-lock serve rounds read it without this lock)
+                self.effects.begin_launch(
+                    {lane: req.id
+                     for lane, req in self._bindings.items()})
             run_from = (self.state, self.total) if self._bindings else None
             self._snap_stdout()   # pre-launch pairing for checkpoint()
             self._inflight = run_from is not None
@@ -534,14 +624,28 @@ class BatchServer:
                 self._enforce(now)
             self.counters["rounds"] += 1
             harvested = self._harvest()
+            if self.effects is not None and self._bindings \
+                    and self.state is not None:
+                # the park half of the suspend boundary: serialize
+                # every TRAP_PARKED lane out through the SwapStore and
+                # free its physical lane for the recycler
+                self.state = self.effects.park_boundary(
+                    self.engine, self.state, self._bindings,
+                    self.recycler, self._effects_on_free)
             self.obs.counter("serve_live_lanes", len(self._bindings),
                              track="serve")
             self.obs.counter("serve_queue_depth", len(self.queue),
                              track="serve")
+            if self.effects is not None:
+                self.obs.counter("serve_parked_sessions",
+                                 self.effects.in_flight(),
+                                 track="serve")
             self._maybe_checkpoint()
             if not (admitted or progressed or harvested) \
                     and not self._bindings and len(self.queue) \
-                    and not (self.hv is not None and self.hv.waiting):
+                    and not (self.hv is not None and self.hv.waiting) \
+                    and not (self.effects is not None
+                             and self.effects.in_flight()):
                 # possibly stalled — but a submit() racing the launch
                 # window lands in the queue AFTER this round's admit
                 # phase; re-try admission before declaring a stall so a
@@ -567,7 +671,7 @@ class BatchServer:
                         f"request {req.id} can never be admitted "
                         f"(tenant {req.tenant!r} admission-blocked)"))
                 return False
-            return self._has_work()
+            return self._runnable_work()
 
     def run_until_idle(self, max_rounds: Optional[int] = None) -> int:
         """Drive step() until no work remains; returns rounds executed."""
@@ -607,13 +711,17 @@ class BatchServer:
             with self._lock:
                 if self._stop:
                     return
-                if not self._has_work():
+                if not self._runnable_work():
+                    # nothing a round would advance (possibly parked
+                    # sessions waiting on an external wake): sleep on
+                    # the condvar — submit()/wake() notify it, and the
+                    # 50ms cap bounds timer-wake latency
                     self._wake.wait(timeout=0.05)
                     if self._stop:
                         return
                     # still nothing after the wait: don't burn an idle
                     # round (rounds counter, no-op checkpoint checks)
-                    if not self._has_work():
+                    if not self._runnable_work():
                         continue
             try:
                 self.step()
@@ -683,6 +791,15 @@ class BatchServer:
                     if not req.future.done:
                         self.counters["killed"] += 1
                     req.future._reject(err)
+            if self.effects is not None:
+                # parked sessions likewise: blobs release, futures
+                # reject, streams end so subscribers unblock
+                for req in self.effects.drop_all():
+                    if not req.future.done:
+                        self.counters["killed"] += 1
+                    req.future._reject(err)
+                    self.effects.close_stream(req.id,
+                                              error="server shut down")
             self._free = sorted(set(range(self.lanes)))
             for req in self.queue.pop_all():
                 self.counters["rejected"] += 1
@@ -833,6 +950,97 @@ class BatchServer:
                          unique_pcs=d.unique_pcs,
                          in_flight=len(self._bindings))
 
+    def _effects_boundary(self, now: float):
+        """Suspend/resume wake pass (under the lock, before admission):
+        drain queued HTTP wakes + due timers, kill timer-parked
+        sessions whose deadline lapsed, and route install-ready
+        sessions back toward a physical lane — as swapped virtual
+        lanes through hv.waiting on an hv server (the ordinary
+        boundary swap-in re-installs them), or directly through the
+        shared column-install pass otherwise."""
+        eff = self.effects
+        ready, expired = eff.process_wakes(now)
+        for req in expired:
+            # a parked session is ADMITTED work: its deadline kill
+            # counts like an in-flight kill, not a queued expiry
+            self.counters["killed"] += 1
+            req.future._reject(DeadlineExceeded(
+                f"request {req.id} exceeded its deadline while parked"))
+            eff.close_stream(req.id, error="deadline exceeded")
+        if self.hv is not None:
+            from wasmedge_tpu.hv.manager import VirtualLane
+
+            for ps in eff.handoff_woken():
+                v = VirtualLane(ps.req, key=ps.key,
+                                stdout_pos=ps.stdout_pos)
+                v.swaps = ps.swaps   # a swap-in continuation, not a
+                #                      fresh install (note_installed
+                #                      re-arms the paused deadline)
+                self.hv.waiting[ps.req.id] = v
+        elif eff.has_woken():
+            if self.state is None:
+                self.state = self._idle_state(0)
+            if self._free:
+                self.state = eff.install_woken(
+                    self.engine, self.state, self._free,
+                    self._bindings,
+                    install_cb=self._effects_on_install)
+
+    def _effects_on_free(self, lane: int, req):
+        """Park hook EffectsRuntime.park_boundary calls for every lane
+        it freed — returns the physical lane to the pool exactly like
+        a harvest does."""
+        heapq.heappush(self._free, lane)
+        if self.hv is not None:
+            self.hv.on_free(lane)
+
+    def _effects_on_install(self, lane: int, req):
+        """Install hook for a woken session landing on a lane (non-hv
+        path): a resume is a continuation, not a new occupancy — no
+        admission observation, but the lane is recycled-marked."""
+        self._served_before[lane] = True
+
+    def wake(self, request_id: int,
+             payload: Optional[bytes] = None) -> str:
+        """External wake for a request blocked in `await_event` (the
+        gateway's POST /v1/requests/<id>/wake): queues the payload and
+        nudges the serving loop.  Returns "parked" when the id is a
+        parked session right now, "pending" when it is otherwise in
+        flight (the payload pre-delivers at the request's next
+        await_event), "unknown" otherwise — the wake still queues
+        either way, so a wake racing the park is never lost."""
+        if self.effects is None:
+            raise WasmError(ErrCode.Terminated,
+                            "effects subsystem is off "
+                            "(Configure.effects.suspend)")
+        rid = int(request_id)
+        self.effects.wake(rid, payload)
+        with self._lock:
+            self._wake.notify_all()
+            if rid in self.effects.parked_ids():
+                return "parked"
+            if any(req.id == rid for req in self._bindings.values()) \
+                    or (self.hv is not None
+                        and rid in self.hv.waiting):
+                return "pending"
+            return "unknown"
+
+    def session_stats(self) -> Optional[dict]:
+        """Parked-session occupancy/counters snapshot (None when the
+        effects subsystem is off) — the /v1/status "sessions" block
+        and the wasmedge_session_* Prometheus series read this."""
+        if self.effects is None:
+            return None
+        return self.effects.stats()
+
+    def stream_of(self, request_id: int):
+        """The request's stdout StreamBuf (None when effects are off
+        or the request never produced output) — the gateway's
+        GET /v1/requests/<id>/stream reads it."""
+        if self.effects is None:
+            return None
+        return self.effects.stream_of(int(request_id))
+
     def _hv_on_install(self, lane: int, req, first: bool):
         """Install hook the LaneVirtualizer calls for every lane it
         (re)initializes — keeps the recycled_lanes counter and the
@@ -841,6 +1049,11 @@ class BatchServer:
         device lane): only those count as recycling and observe
         admission latency — a swap-in is a continuation, not a new
         occupancy (it has its own swaps_in counter)."""
+        if self.effects is not None:
+            # a handed-off parked session landing through swap-in:
+            # re-arm its paused deadline + observe the park duration
+            # (no-op for ordinary hv lanes)
+            self.effects.note_installed(req)
         if first:
             if self._served_before[lane]:
                 self.counters["recycled_lanes"] += 1
@@ -929,7 +1142,10 @@ class BatchServer:
         else:  # defensive: a harvest not preceded by _enforce this round
             trap = np.asarray(self.state.trap)
             retired = np.asarray(self.state.retired, np.int64)
-        done = [lane for lane in self._bindings if trap[lane] != 0]
+        # TRAP_PARKED lanes stopped but did not FINISH: they belong to
+        # the effects park boundary, not the harvest
+        done = [lane for lane in self._bindings
+                if trap[lane] != 0 and trap[lane] != TRAP_PARKED]
         if not done:
             return 0
         by_func: Dict[int, List[int]] = {}
@@ -963,6 +1179,10 @@ class BatchServer:
                     elif first:
                         self.counters["killed"] += 1
                     req.future._reject(exc)
+                if self.effects is not None:
+                    self.effects.close_stream(
+                        req.id, error=None if code == int(TRAP_DONE)
+                        else "request failed")
                 if first:
                     # install() resets the lane's retired plane, so this
                     # is the REQUEST's retired count (true-utilization
@@ -1073,6 +1293,10 @@ class BatchServer:
                         np.concatenate([cur[1], pad.copy()]))
                 if self.hv is not None:
                     self.hv.resize(new_lanes)
+                if self.effects is not None:
+                    # parked sessions are keyed by request id and ride
+                    # through; the install pass retraces at new shapes
+                    self.effects.resize(new_lanes)
                 if self._compactor is not None:
                     from wasmedge_tpu.batch.compact import LaneCompactor
 
@@ -1109,6 +1333,8 @@ class BatchServer:
                     (hv.lanes, hv.resident_cap, hv.virtual_cap,
                      hv.tenant_caps, hv._last_retired, hv._last_trap,
                      hv._install_jit) = hv_old
+                if self.effects is not None:
+                    self.effects.resize(old_lanes)
                 self.lanes = old_lanes
                 self._record("reshard", e)
                 raise
@@ -1165,10 +1391,16 @@ class BatchServer:
         if self.hv is not None:
             old_virtual = {rid: v.req
                            for rid, v in self.hv.waiting.items()}
+        old_parked: Dict[int, ServeRequest] = {}
+        if self.effects is not None:
+            old_parked = {req.id: req
+                          for req in self.effects.parked_requests()}
         state = total = None
         bindings: Dict[int, ServeRequest] = {}
         hv_triples: list = []
         blobs: Dict[str, bytes] = {}
+        eff_pairs: list = []
+        eff_blobs: Dict[str, bytes] = {}
         from wasmedge_tpu.batch import checkpoint
 
         def load(m):
@@ -1179,21 +1411,29 @@ class BatchServer:
             if isinstance(payload, dict) and "bindings" in payload:
                 b = dict(payload.get("bindings") or {})
                 triples = list(payload.get("hv") or [])
+                pairs = list(payload.get("effects") or [])
             else:   # pre-hv payload shape: the bindings dict itself
                 b = dict(payload)
                 triples = []
+                pairs = []
             bl = {}
             if any(k is not None for _, k, _ in triples):
                 raw = checkpoint.read_extra_arrays(m.path, "hvblob_")
                 bl = {name[len("hvblob_"):]: arr.tobytes()
                       for name, arr in raw.items()}
-            return st, tot, b, triples, bl
+            ebl = {}
+            if pairs:
+                raw = checkpoint.read_extra_arrays(m.path, "effblob_")
+                ebl = {name[len("effblob_"):]: arr.tobytes()
+                       for name, arr in raw.items()}
+            return st, tot, b, triples, bl, pairs, ebl
 
         got = self._lineage.walk_newest(
             load, lambda e, m: self._record("checkpoint", e,
                                             checkpoint=m.path))
         if got is not None:
-            state, total, bindings, hv_triples, blobs = got
+            (state, total, bindings, hv_triples, blobs,
+             eff_pairs, eff_blobs) = got
         if state is None:
             # no surviving snapshot: restore an all-idle state and send
             # EVERY in-flight request back to the head of the queue
@@ -1234,6 +1474,8 @@ class BatchServer:
             candidates[req.id] = req
         for rid, req in old_virtual.items():
             candidates[rid] = req
+        for rid, req in old_parked.items():
+            candidates[rid] = req
         if self.hv is not None:
             # the snapshot's virtual table is authoritative: swapped
             # blobs re-adopt from the npz-embedded copies; entries
@@ -1244,6 +1486,20 @@ class BatchServer:
             covered |= {v.req.id
                         for v in self.hv.waiting.values()}
             for req in lost:
+                candidates[req.id] = req
+        if self.effects is not None:
+            # the snapshot's parked table is authoritative too: parked
+            # blobs re-adopt from the npz-embedded copies, corrupt or
+            # missing entries come back as `lost` and re-run from
+            # scratch (at-least-once)
+            for req in self.effects.restore(eff_pairs, eff_blobs,
+                                            covered):
+                candidates[req.id] = req
+            covered |= set(self.effects.parked_ids())
+        elif eff_pairs:
+            # this process runs with effects OFF: journaled parked
+            # sessions re-queue as fresh requests rather than vanish
+            for req, _entry in eff_pairs:
                 candidates[req.id] = req
         requeue = sorted((req for req in candidates.values()
                           if req.id not in covered
@@ -1273,6 +1529,13 @@ class BatchServer:
                 if not req.future.done:
                     self.counters["killed"] += 1
                 req.future._reject(exc)
+        if self.effects is not None:
+            for req in self.effects.drop_all():
+                if not req.future.done:
+                    self.counters["killed"] += 1
+                req.future._reject(exc)
+                self.effects.close_stream(req.id,
+                                          error="server failed")
         for req in self.queue.pop_all():
             if not req.future.done:
                 self.counters["rejected"] += 1
@@ -1286,10 +1549,15 @@ class BatchServer:
             return
         # idle rounds don't advance total: re-snapshotting the same
         # step count would stack duplicate paths in the lineage and the
-        # prune pass would unlink the file it just wrote
+        # prune pass would unlink the file it just wrote.  EXCEPT when
+        # the parked-session table changed — a park/wake is durable
+        # state even at a standstill step count (same total -> same
+        # path, so Lineage.add replaces the member instead of stacking)
         newest = self._lineage.newest()
         if newest is not None and newest.steps == self.total:
-            return
+            if self.effects is None \
+                    or self.effects.parked_ids() == self._eff_snap_ids:
+                return
         self.checkpoint()
 
     def checkpoint(self) -> Optional[str]:
@@ -1336,6 +1604,19 @@ class BatchServer:
             extra = self.hv.blob_arrays()
             payload = {"bindings": dict(self._bindings),
                        "hv": self.hv.snapshot_payload()}
+        if self.effects is not None:
+            # parked sessions journal alongside the bindings, their
+            # blobs embed in the npz straight from the SwapStore —
+            # exactly the hv discipline: a restore never depends on
+            # store retention
+            invocation["parked_sessions"] = \
+                self.effects.journal_entries()
+            eff_extra = self.effects.blob_arrays()
+            if eff_extra:
+                extra = dict(extra or {}, **eff_extra)
+            if not (isinstance(payload, dict) and "bindings" in payload):
+                payload = {"bindings": dict(self._bindings), "hv": []}
+            payload["effects"] = self.effects.snapshot_payload()
         t0 = self.obs.now()
         try:
             if self.faults is not None:
@@ -1353,6 +1634,8 @@ class BatchServer:
             return None
         self.checkpoint_fail_streak = 0
         self.last_checkpoint_error = None
+        if self.effects is not None:
+            self._eff_snap_ids = self.effects.parked_ids()
         self.obs.span("checkpoint_save", t0, cat="serve", track="serve",
                       checkpoint=path, steps=int(self.total),
                       in_flight=len(self._bindings))
@@ -1379,14 +1662,15 @@ class BatchServer:
             state, total = checkpoint.load(m.path, self.engine)
             inv = checkpoint.read_meta(m.path).get("invocation", {})
             return (state, total, inv.get("serve_bindings", []),
-                    inv.get("hv_lanes", []))
+                    inv.get("hv_lanes", []),
+                    inv.get("parked_sessions", []))
 
         got = lin.walk_newest(
             load, lambda e, m: self._record("checkpoint", e,
                                             checkpoint=m.path))
         if got is None:
             return
-        state, total, journal, hv_journal = got
+        state, total, journal, hv_journal, eff_journal = got
         self.state, self.total = state, total
         self._snap_stdout()   # load() rewound the cursor in place
         from wasmedge_tpu.serve.queue import advance_request_ids
@@ -1398,6 +1682,7 @@ class BatchServer:
             self.adopted[req.id] = req.future
             advance_request_ids(req.id)
         self._adopt_hv(hv_journal, lin.members[-1].path)
+        self._adopt_effects(eff_journal, lin.members[-1].path)
         self._free = sorted(set(range(self.lanes))
                             - set(self._bindings))
         self._served_before[list(self._bindings)] = True
@@ -1413,6 +1698,9 @@ class BatchServer:
         if self.hv is not None:
             for v in self.hv.waiting.values():
                 byid[v.req.id] = v.req
+        if self.effects is not None:
+            for r in self.effects.parked_requests():
+                byid[r.id] = r
         survivors = []
         for m in lin.members[:-1]:
             try:
@@ -1420,6 +1708,7 @@ class BatchServer:
                     "invocation", {})
                 j2 = inv2.get("serve_bindings", [])
                 hv2 = inv2.get("hv_lanes", [])
+                eff2 = inv2.get("parked_sessions", [])
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
@@ -1440,13 +1729,30 @@ class BatchServer:
                     advance_request_ids(req2.id)
                 triples2.append((req2, e2.get("key"),
                                  int(e2.get("stdout_pos", 0))))
-            m.payload = {"bindings": snap2, "hv": triples2} \
-                if (self.hv is not None or triples2) else snap2
+            pairs2 = []
+            for e2 in eff2:
+                req2 = byid.get(int(e2["id"]))
+                if req2 is None:
+                    req2 = ServeRequest.from_journal(e2)
+                    advance_request_ids(req2.id)
+                pairs2.append((req2, e2))
+            if self.hv is not None or triples2 \
+                    or self.effects is not None or pairs2:
+                m.payload = {"bindings": snap2, "hv": triples2,
+                             "effects": pairs2}
+            else:
+                m.payload = snap2
             survivors.append(m)
         newest = lin.members[-1]
-        newest.payload = {"bindings": dict(self._bindings),
-                          "hv": self.hv.snapshot_payload()} \
-            if self.hv is not None else dict(self._bindings)
+        if self.hv is not None or self.effects is not None:
+            newest.payload = {
+                "bindings": dict(self._bindings),
+                "hv": (self.hv.snapshot_payload()
+                       if self.hv is not None else []),
+                "effects": (self.effects.snapshot_payload()
+                            if self.effects is not None else [])}
+        else:
+            newest.payload = dict(self._bindings)
         lin.members = survivors + [newest]
         lin.prune(self.k.keep_checkpoints)
         self.obs.instant("resume_adopted", cat="serve", track="serve",
@@ -1489,4 +1795,48 @@ class BatchServer:
                      for name, arr in raw.items()}
             covered = {r.id for r in self._bindings.values()}
             fallback.extend(self.hv.restore(triples, blobs, covered))
+        self.queue.push_front(sorted(fallback, key=lambda r: r.id))
+
+    def _adopt_effects(self, eff_journal, path: str):
+        """Cross-process adoption of the parked-session table: entries
+        re-seed the SwapStore from the snapshot-embedded effblob_
+        arrays; corrupt/missing blobs (and every entry when this
+        process runs with effects OFF) re-queue at the front as fresh
+        requests (at-least-once) — a journaled parked session is never
+        silently lost.  Adopted sessions get fresh futures like
+        bindings do, their wake condition (pending payloads, remaining
+        timer) re-armed from the journal — a wake posted before the
+        crash still resumes the session exactly once."""
+        if not eff_journal:
+            return
+        from wasmedge_tpu.batch import checkpoint
+        from wasmedge_tpu.serve.queue import advance_request_ids
+
+        pairs = []
+        fallback = []
+        for e in eff_journal:
+            req = ServeRequest.from_journal(e)
+            req.t_submit = time.monotonic()
+            advance_request_ids(req.id)
+            self.adopted[req.id] = req.future
+            if self.effects is None:
+                fallback.append(req)
+            else:
+                pairs.append((req, e))
+        if self.effects is not None:
+            try:
+                raw = checkpoint.read_extra_arrays(path, "effblob_")
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._record("checkpoint", e, checkpoint=path)
+                raw = {}
+            blobs = {name[len("effblob_"):]: arr.tobytes()
+                     for name, arr in raw.items()}
+            covered = {r.id for r in self._bindings.values()}
+            if self.hv is not None:
+                covered |= {v.req.id
+                            for v in self.hv.waiting.values()}
+            fallback.extend(self.effects.restore(pairs, blobs,
+                                                 covered))
         self.queue.push_front(sorted(fallback, key=lambda r: r.id))

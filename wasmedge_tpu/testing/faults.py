@@ -100,6 +100,22 @@ The elastic-fleet layer (r21) adds the churn seams:
   `churn_schedule()` composes these into the seeded join/leave/reshard
   weather `bench.py --elastic` arms.
 
+The effects layer (r23, wasmedge_tpu/effects/) adds the suspend/resume
+seams:
+  - `"session_park"`       in EffectsRuntime.park_boundary before a
+                           TRAP_PARKED lane serializes out (ctx: lane,
+                           id).  A faulted park leaves the lane
+                           RESIDENT — its trap returns to
+                           TRAP_HOSTCALL and the intercept re-marks it
+                           at the next boundary; no state moves.
+  - `"session_wake"`       in EffectsRuntime.process_wakes before a
+                           wake applies (ctx: id, source in {http,
+                           timer}).  A faulted HTTP wake RE-QUEUES
+                           (payload intact); a faulted timer wake
+                           re-arms the timer entry — either way the
+                           session is never lost and the wake applies
+                           at a later boundary.
+
 The imagestore layer (r22) adds the cold-start seams:
   - `"cache_read"`         in CompileCache.load before a persistent
                            compile-cache entry is consulted (ctx:
@@ -170,7 +186,8 @@ class Fault:
     #                            "peer_send" | "peer_recv" |
     #                            "peer_heartbeat" |
     #                            "membership_gossip" | "reshard_install" |
-    #                            "cache_read" | "snapshot_install"
+    #                            "cache_read" | "snapshot_install" |
+    #                            "session_park" | "session_wake"
     at: int = 0                # 0-based arrival index at that seam
     times: int = 1             # consecutive arrivals that fault
     lanes: Tuple[int, ...] = ()  # lane attribution (poison quarantine)
