@@ -3601,6 +3601,269 @@ def suspend_bench(smoke: bool = False) -> int:
     return 0 if ok else 1
 
 
+def integrity_bench(smoke: bool = False) -> int:
+    """`bench.py --integrity` / `--integrity-smoke`: the r24 silent-
+    data-corruption defense acceptance (wasmedge_tpu/integrity/ —
+    shadow-audit lanes, at-rest scrubbing, quarantine).
+
+    Smoke (CI guard, one JSON line, no artifact): ONE injected bit
+    flip per storage class — a BatchState lane plane, a SwapStore
+    payload, a checkpoint member, a compile-cache entry — and every
+    one is detected (audit divergence / scrub verdict), with the
+    final results bit-identical to an unflipped run.
+
+    Full (emits INTEGRITY_r24.json): the seeded `bitflip_campaign`
+    drives every class twice with distinct seeds/arrivals; every flip
+    must be detected AND repaired-or-masked (mirror heal, peer-replica
+    restore, quarantine + older-member resume, evict + fresh lower) —
+    zero silent corruptions — and the audited flagship stays within
+    10% of the audit-off throughput."""
+    import hashlib as _hashlib
+    import tempfile as _tempfile
+
+    from wasmedge_tpu.batch.engine import BatchEngine
+    from wasmedge_tpu.batch.supervisor import BatchSupervisor
+    from wasmedge_tpu.common.configure import Configure
+    from wasmedge_tpu.hv.swapstore import SwapStore
+    from wasmedge_tpu.imagestore.compilecache import CompileCache
+    from wasmedge_tpu.integrity import Scrubber
+    from wasmedge_tpu.testing.faults import (
+        BitFlip,
+        FaultInjector,
+        bitflip_campaign,
+        flip_bit_bytes,
+        flip_file,
+    )
+
+    lanes = 16
+
+    def _conf(audit=False, **integ):
+        c = Configure()
+        c.batch.steps_per_launch = 100
+        c.batch.rng_seed = 7
+        c.supervisor.backoff_base_s = 0.0
+        c.supervisor.checkpoint_every_steps = 200
+        c.integrity.audit = audit
+        if audit:
+            # detection legs audit every boundary at FULL width: the
+            # campaign's guarantee is "every flip detected", so the
+            # sampled subset must always contain the flipped lane
+            # (audit.py: full-width audits are positional, never skip)
+            c.integrity.audit_every = 1
+            c.integrity.audit_lanes = lanes
+        for k, v in integ.items():
+            setattr(c.integrity, k, v)
+        return c
+
+    def fib_sup(c, faults=None, ckpt_dir=None, resume=False):
+        inst, store = _instantiate_fib(c)
+        eng = BatchEngine(inst, store=store, conf=c, lanes=lanes)
+        return BatchSupervisor(eng, faults=faults,
+                               checkpoint_dir=ckpt_dir, resume=resume)
+
+    fib_args = [(np.arange(lanes) % 11).astype(np.int64)]
+    want = np.array([_fib(n % 11) for n in range(lanes)])
+
+    def plane_leg(seed, at, checks, tag):
+        """Audited run vs an injected lane-plane flip: detected (audit
+        divergence -> integrity FailureRecord) and masked (rollback +
+        re-execution, exact results)."""
+        inj = FaultInjector([], flips=[
+            BitFlip(point="corrupt_plane", at=at, seed=seed)])
+        d = _tempfile.mkdtemp(prefix="integrity-plane-")
+        sup = fib_sup(_conf(audit=True), faults=inj, ckpt_dir=d)
+        res = sup.run("fib", fib_args, max_steps=500_000)
+        stats = sup.engine._audit_hook.stats
+        checks[f"{tag}_flipped"] = inj.flipped == 1
+        checks[f"{tag}_detected"] = stats["divergence"] >= 1 and \
+            "integrity" in [f.fault_class for f in sup.failures]
+        checks[f"{tag}_masked"] = bool(
+            res.completed.all() and (res.results[0] == want).all())
+
+    def swap_leg(seed, checks, tag, both_copies=False):
+        """SwapStore rot: a bad memory copy heals from the disk
+        mirror; rot in BOTH copies repairs from a (peer-replica)
+        fetch closure — either way the payload reads back bit-exact."""
+        d = _tempfile.mkdtemp(prefix="integrity-swap-")
+        store = SwapStore(dir=d)
+        payload = np.random.RandomState(seed).bytes(4096)
+        key = store.put(payload)
+        replica = {key: payload}
+        store._mem[key] = flip_bit_bytes(store._mem[key], seed=seed)
+        if both_copies:
+            flip_file(store._path(key), seed=seed + 1)
+        scrub = Scrubber(
+            Configure().integrity,
+            swap_stores=lambda: [("swap", store, False)],
+            fetch_blob=replica.get)
+        delta = scrub.scrub_once()
+        checks[f"{tag}_detected"] = delta["corrupt"] == 1
+        checks[f"{tag}_repaired"] = delta["repaired"] == 1
+        checks[f"{tag}_bit_identical"] = store.get(key) == payload
+
+    def checkpoint_leg(seed, checks, tag):
+        """A rotted newest checkpoint member is quarantined by the
+        scrubber; a resume over the same lineage falls back to the
+        older member and completes bit-exact."""
+        d = _tempfile.mkdtemp(prefix="integrity-ckpt-")
+        sup = fib_sup(_conf(), ckpt_dir=d)
+        sup.run("fib", fib_args, max_steps=500_000)
+        members = sorted(_os.path.join(d, fn) for fn in _os.listdir(d)
+                         if fn.endswith(".npz"))
+        checks[f"{tag}_has_lineage"] = len(members) >= 1
+        flip_file(members[-1], seed=seed)
+        scrub = Scrubber(Configure().integrity,
+                         checkpoints=lambda: members)
+        delta = scrub.scrub_once()
+        checks[f"{tag}_detected"] = delta["quarantined_members"] == 1 \
+            and not _os.path.exists(members[-1])
+        sup2 = fib_sup(_conf(), ckpt_dir=d, resume=True)
+        res = sup2.run("fib", fib_args, max_steps=500_000)
+        checks[f"{tag}_masked"] = bool(
+            res.completed.all() and (res.results[0] == want).all())
+
+    def cache_leg(seed, checks, tag, peer_repair=False):
+        """A rotted WTIC entry is caught by the scrub verify; with a
+        peer replica it restores bit-exact, without one it is evicted
+        so the next load is a clean miss (fresh lower, never rot)."""
+        d = _tempfile.mkdtemp(prefix="integrity-cache-")
+        cc = CompileCache()
+        cc.enable(d)
+        payload = np.random.RandomState(seed + 1).bytes(2048)
+        sha = _hashlib.sha256(payload).hexdigest()
+        cc.store(sha, payload)
+        replica = {sha: cc.entry_bytes(sha)} if peer_repair else {}
+        flip_file(cc._path(sha), seed=seed)
+        with cc._lock:
+            cc._payloads.pop(sha, None)
+        checks[f"{tag}_detected"] = not cc.verify_entry(sha)
+        scrub = Scrubber(Configure().integrity,
+                         compile_cache=lambda: cc,
+                         fetch_cache_entry=replica.get)
+        delta = scrub.scrub_once()
+        if peer_repair:
+            checks[f"{tag}_repaired"] = delta["repaired"] == 1 and \
+                cc.load(sha) == payload
+        else:
+            checks[f"{tag}_evicted"] = delta["evicted"] == 1 and \
+                cc.load(sha) is None   # clean miss -> fresh lower
+
+    t0 = time.perf_counter()
+    checks = {}
+
+    if smoke:
+        plane_leg(seed=42, at=1, checks=checks, tag="plane")
+        swap_leg(seed=7, checks=checks, tag="swap")
+        checkpoint_leg(seed=13, checks=checks, tag="checkpoint")
+        cache_leg(seed=29, checks=checks, tag="cache")
+        ok = all(checks.values())
+        print(json.dumps({
+            "metric": "integrity_smoke_flip_per_class",
+            "value": 1 if ok else 0, "unit": "ok", "ok": ok,
+            **checks, "wall_s": round(time.perf_counter() - t0, 3)}))
+        return 0 if ok else 1
+
+    # ---- full: seeded campaign over every storage class ------------------
+    campaign = bitflip_campaign(seed=1234, n_per_class=2)
+    for f in campaign:
+        tag = f"{f['cls']}{f['index']}"
+        if f["cls"] == "plane":
+            plane_leg(seed=f["seed"], at=f["at"], checks=checks, tag=tag)
+        elif f["cls"] == "swap":
+            swap_leg(seed=f["seed"], checks=checks, tag=tag,
+                     both_copies=bool(f["index"] % 2))
+        elif f["cls"] == "checkpoint":
+            checkpoint_leg(seed=f["seed"], checks=checks, tag=tag)
+        elif f["cls"] == "cache":
+            cache_leg(seed=f["seed"], checks=checks, tag=tag,
+                      peer_repair=bool(f["index"] % 2))
+    detected = sum(1 for k, v in checks.items()
+                   if k.endswith("_detected") and v)
+    silent = sum(1 for k, v in checks.items()
+                 if k.endswith(("_detected", "_masked", "_repaired",
+                                "_evicted")) and not v)
+
+    # ---- integrity-off bit-identity + audit-on throughput ratio ----------
+    def timed_run(sup, reps=3):
+        sup.run("work", perf_args, max_steps=5_000_000)  # warm compile
+        best = float("inf")
+        for _ in range(reps):
+            t = time.perf_counter()
+            r = sup.run("work", perf_args, max_steps=5_000_000)
+            best = min(best, time.perf_counter() - t)
+        return best, r
+
+    # long runs over MANY boundaries, so the sampled audit cadence
+    # (~1/audit_every of boundaries, each replaying one slice at
+    # audit_lanes width) is what the ratio measures — not one audit
+    # landing in a three-launch run.  The summation module gives each
+    # lane tens of thousands of steps where fib tops out at hundreds.
+    def work_sup(audit=False):
+        from wasmedge_tpu.executor import Executor
+        from wasmedge_tpu.loader import Loader
+        from wasmedge_tpu.runtime.store import StoreManager
+        from wasmedge_tpu.testing.faults import build_selective_runaway
+        from wasmedge_tpu.validator import Validator
+
+        c = _conf()
+        c.batch.steps_per_launch = 400
+        c.integrity.audit = audit
+        mod = Validator(c).validate(
+            Loader(c).parse_module(build_selective_runaway()))
+        store = StoreManager()
+        inst = Executor(c).instantiate(store, mod)
+        eng = BatchEngine(inst, store=store, conf=c, lanes=lanes)
+        return BatchSupervisor(eng)
+
+    perf_ns = 6000 + 137 * np.arange(lanes)
+    perf_args = [perf_ns.astype(np.int64)]
+    perf_want = np.array([int(n) * (int(n) - 1) // 2 for n in perf_ns])
+    off_sup = work_sup()
+    off_s, off_res = timed_run(off_sup)
+    # flagship audit cadence: the DEFAULT sampled knobs (audit_every=16,
+    # audit_lanes=2), not the every-boundary setting the detection legs use
+    on_sup = work_sup(audit=True)
+    on_s, on_res = timed_run(on_sup)
+    ratio = on_s / off_s if off_s > 0 else float("inf")
+    on_stats = dict(on_sup.engine._audit_hook.stats)
+    checks["audit_sampled_nonzero"] = on_stats["audits"] >= 1
+    checks["integrity_off_no_hooks"] = \
+        getattr(off_sup.engine, "_audit_hook", None) is None and \
+        getattr(off_sup.engine, "_flip_hook", None) is None
+    checks["audit_on_bit_identical"] = bool(
+        (on_res.results[0] == off_res.results[0]).all()
+        and (on_res.results[0] == perf_want).all()
+        and (on_res.trap == off_res.trap).all()
+        and (on_res.retired == off_res.retired).all())
+    checks["audit_overhead_within_10pct"] = ratio <= 1.10
+
+    dt = time.perf_counter() - t0
+    ok = all(checks.values()) and silent == 0
+    out = {
+        "metric": "integrity_sdc_defense",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "ok": ok,
+        **checks,
+        "campaign_flips": len(campaign),
+        "campaign_detected": detected,
+        "silent_corruptions": silent,
+        "audit_off_s": round(off_s, 4),
+        "audit_on_s": round(on_s, 4),
+        "audit_boundaries": on_stats["boundaries"],
+        "audits_sampled": on_stats["audits"],
+        "audit_overhead_ratio": round(ratio, 4),
+        "wall_s": round(dt, 3),
+    }
+    from wasmedge_tpu.utils.bench_artifact import emit
+
+    emit(out, "INTEGRITY_r24.json")
+    print(f"# integrity flips={len(campaign)} detected={detected} "
+          f"silent={silent} audit_overhead={ratio:.3f} "
+          f"wall={dt:.1f}s", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main():
     eng = _build(LANES)
 
@@ -3724,4 +3987,8 @@ if __name__ == "__main__":
         sys.exit(suspend_bench(smoke=True))
     if "--suspend" in sys.argv[1:]:
         sys.exit(suspend_bench())
+    if "--integrity-smoke" in sys.argv[1:]:
+        sys.exit(integrity_bench(smoke=True))
+    if "--integrity" in sys.argv[1:]:
+        sys.exit(integrity_bench())
     main()

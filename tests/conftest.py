@@ -60,6 +60,10 @@ def pytest_configure(config):
         "markers", "effects: guest suspend/resume suite (parked "
         "sessions, external wake, streamed output; tier-1 fast, runs "
         "under -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "integrity: silent-corruption defense suite "
+        "(shadow-audit lanes, at-rest scrubbing, device quarantine; "
+        "tier-1 fast, runs under -m 'not slow')")
 
 
 def pytest_addoption(parser):

@@ -98,8 +98,15 @@ def test_checkpoint_save_is_atomic(tmp_path, monkeypatch):
         save(ckpt, eng, state2, total2)
     monkeypatch.setattr(os, "replace", real_replace)
     # previous snapshot intact and loadable; no temp litter left behind
+    # (the r24 integrity sidecar is durable output, not litter — and it
+    # must still describe the SURVIVING snapshot, not the torn save)
     assert ckpt.read_bytes() == good
-    assert [p.name for p in tmp_path.iterdir()] == ["c.ckpt"]
+    assert sorted(p.name for p in tmp_path.iterdir()) == \
+        ["c.ckpt", "c.ckpt.sha256"]
+    import hashlib
+
+    assert (tmp_path / "c.ckpt.sha256").read_bytes().decode() == \
+        hashlib.sha256(good).hexdigest()
     restored, rtotal = load(ckpt, make(build_fib()))
     assert rtotal == total
 

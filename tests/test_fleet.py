@@ -678,6 +678,106 @@ def test_parked_session_migrates_cross_host_and_wakes_bit_identically():
         gw_a.shutdown()
 
 
+def test_wake_forwards_to_parked_sessions_owner():
+    """Fleet-routed wakes (r24): POST /v1/requests/<id>/wake hitting a
+    member that does NOT hold the session forwards to the id's
+    rendezvous owner over the r16 routing table — any member is a
+    valid wake edge, and the forwarded wake resolves the session
+    bit-identically to a locally-delivered one."""
+    import struct
+
+    def conf():
+        c = _conf()
+        c.effects.suspend = True
+        return c
+
+    svc_a = GatewayService(conf=conf(), lanes=2, fleet=_fleet_cfg())
+    gw_a = Gateway(svc_a, port=0).start()
+    svc_a.register_module("awaitmod", wasm_bytes=_await_mod(),
+                          source="boot")
+    svc_b = GatewayService(
+        conf=conf(), lanes=2,
+        fleet=_fleet_cfg([f"{gw_a.host}:{gw_a.port}"]))
+    gw_b = Gateway(svc_b, port=0).start()
+    try:
+        svc_b.fleet.tick()   # learn manifest + replicate awaitmod
+        svc_b.fleet.tick()
+        payload = struct.pack("<I", 900)
+        # park sessions on A until one's id rendezvous-routes to A in
+        # B's view (ids are random draws; a handful suffices)
+        req = None
+        for _ in range(12):
+            r = svc_a._submit_local("wait", [5], module="awaitmod")
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if r.id in svc_a.current.server.list_swapped():
+                    break
+                time.sleep(0.01)
+            else:
+                raise TimeoutError("session never parked")
+            if rendezvous_owner(r.id, svc_b.fleet.members()) \
+                    == svc_a.fleet.self_id:
+                req = r
+                break
+            svc_a.wake(r.id, payload)   # resolve the unused draw
+            _drain(svc_a, [r], timeout_s=120.0)
+        assert req is not None, "no id routed to A in 12 draws " \
+                                "(improbable)"
+        # the wake lands on B's wire; B holds nothing for this id
+        st, doc, _ = rpc(gw_b, "POST",
+                         f"/v1/requests/{req.id}/wake", body=payload)
+        assert st == 202 and doc["ok"]
+        assert doc["state"] == "parked"   # the OWNER's resolution
+        assert doc["owner"] == svc_a.fleet.self_id
+        _drain(svc_a, [req], timeout_s=180.0)
+        assert req.future.result(0) == [905]
+        assert svc_b.fleet.counters["wakes_forwarded"] == 1
+        assert svc_a.fleet.counters["wakes_received"] == 1
+    finally:
+        gw_b.shutdown()
+        gw_a.shutdown()
+
+
+def test_wake_to_suspect_owner_is_retryable_503():
+    """A wake whose owner is SUSPECT refuses retryably (503 +
+    Retry-After, detail peer_suspect) instead of guessing: the wake is
+    still queued locally at-least-once, and the client retries once
+    the owner's probes recover."""
+    def conf():
+        c = _conf()
+        c.effects.suspend = True
+        return c
+
+    svc_a = GatewayService(conf=conf(), lanes=2, fleet=_fleet_cfg())
+    gw_a = Gateway(svc_a, port=0).start()
+    svc_b = GatewayService(
+        conf=conf(), lanes=2,
+        fleet=_fleet_cfg([f"{gw_a.host}:{gw_a.port}"]))
+    gw_b = Gateway(svc_b, port=0).start()
+    svc_b.register_module("awaitmod", wasm_bytes=_await_mod(),
+                          source="boot")
+    try:
+        fl = svc_b.fleet
+        pid = f"{gw_a.host}:{gw_a.port}"
+        fl.tick()                    # alive handshake
+        gw_a.kill()                  # A stops answering
+        fl.tick()
+        fl.tick()                    # 2 misses -> suspect (not dead)
+        assert fl.peer_states()[pid]["state"] == "suspect"
+        rid = next(k for k in range(10_000, 10_200)
+                   if rendezvous_owner(k, fl.members()) == pid)
+        st, doc, hdrs = rpc(gw_b, "POST",
+                            f"/v1/requests/{rid}/wake", body=b"")
+        assert st == 503
+        assert doc["err"]["retryable"] is True
+        assert doc["err"]["detail"] == "peer_suspect"
+        assert "Retry-After" in hdrs
+        assert fl.counters["suspect_rejections"] >= 1
+        assert fl.counters["wakes_forwarded"] == 0
+    finally:
+        gw_b.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # solo-mode fallback
 # ---------------------------------------------------------------------------

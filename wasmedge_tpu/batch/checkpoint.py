@@ -20,6 +20,7 @@ from __future__ import annotations
 import hashlib
 import io
 import json
+import os
 from typing import Tuple
 
 import numpy as np
@@ -132,6 +133,16 @@ def save(path, engine: BatchEngine, state: BatchState, total_steps: int,
         # truncated .npz at the target path for a later resume to trip
         # over (or clobber a previous good snapshot).
         atomic_write_bytes(path, data)
+        # r24 integrity sidecar: the at-rest scrubber re-verifies this
+        # digest on cadence and quarantines a rotted member BEFORE a
+        # recovery walk would load it.  Best-effort — the archive's own
+        # validation still backstops a missing sidecar.
+        try:
+            atomic_write_bytes(
+                os.fspath(path) + ".sha256",
+                hashlib.sha256(data).hexdigest().encode())
+        except OSError:
+            pass
 
 
 def read_meta(path) -> dict:

@@ -2430,6 +2430,15 @@ class BatchEngine:
         # arms this before a launch / a tier-1 serve so injected device
         # and host failures raise exactly where real ones would
         fault = getattr(self, "_fault_hook", None)
+        # shadow-audit seam (wasmedge_tpu/integrity/audit.py, r24):
+        # pre snapshots sampled lane columns before the launch donates
+        # the state, post replays the slice and compares bit-exact —
+        # a divergence raises out of this loop like a device failure
+        auditor = getattr(self, "_audit_hook", None)
+        # bit-flip seam (testing/faults.py BitFlip): corrupts the
+        # landed state BEFORE the audit's post-slice gather, modelling
+        # SDC the audit must catch rather than an error it is told of
+        flip = getattr(self, "_flip_hook", None)
         # cooperative cancellation (parallel/supervisor.py): when a mesh
         # run is doomed, sibling devices stop at their next launch
         # boundary instead of driving the slice to completion
@@ -2455,6 +2464,8 @@ class BatchEngine:
             # per-relaunch time base: host->device only, no round trip
             # (rides the launch as a non-donated argument)
             tt = jnp.asarray(t0_time_planes() if t0_active else dummy_time)
+            audit_tok = auditor.pre(self, state, tt) \
+                if auditor is not None else None
             if fault is not None:
                 fault("launch", total=total)
             t_launch = obs.now()
@@ -2462,6 +2473,11 @@ class BatchEngine:
                 else comp.chunk_fn(self)
             done_steps, state = run_chunk(state, tt)
             total += int(done_steps)
+            if flip is not None:
+                state = flip("corrupt_plane", state, lanes=self.lanes,
+                             total=total)
+            if audit_tok is not None:
+                auditor.post(self, audit_tok, state, int(done_steps))
             if comp is not None:
                 comp.note_launch(int(done_steps))
             trap_host = np.asarray(state.trap)

@@ -411,6 +411,17 @@ class BatchSupervisor:
             state, total = self._initial_state(), 0
         consecutive = 0
         fail_keys = {}
+        # shadow-audit lanes (wasmedge_tpu/integrity/, r24): armed once
+        # per tier — a divergence raises IntegrityDivergence out of the
+        # launch loop and lands in the same retry/restore path below
+        # with fault class "integrity"
+        integ = getattr(self.conf, "integrity", None)
+        if integ is not None and integ.audit \
+                and getattr(eng, "_audit_hook", None) is None:
+            from wasmedge_tpu.integrity import ShadowAuditor
+
+            eng._audit_hook = ShadowAuditor(integ, obs=self.obs,
+                                            faults=self.faults)
         # anchor the checkpoint cadence at the STARTING position (the
         # restored step on resume, else 0) so a resumed run neither
         # fires an immediate off-cadence save nor leaves the replayed
@@ -421,6 +432,8 @@ class BatchSupervisor:
             try:
                 if self.faults is not None:
                     eng._fault_hook = self.faults.fire
+                    if hasattr(self.faults, "flip"):
+                        eng._flip_hook = self.faults.flip
                 state, total = eng.run_from_state(state, total, target)
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -429,8 +442,9 @@ class BatchSupervisor:
                 consecutive += 1
                 point = getattr(e, "point", None) or "launch"
                 lanes = tuple(getattr(e, "lanes", ()) or ())
-                self._record("serve" if point == "serve" else "launch",
-                             e, lanes=lanes)
+                cls = "integrity" if point == "integrity" \
+                    else ("serve" if point == "serve" else "launch")
+                self._record(cls, e, lanes=lanes)
                 self.obs.instant("retry", cat="supervisor",
                                  track="supervisor", retry=self.retries,
                                  consecutive=consecutive, point=point)
@@ -450,6 +464,7 @@ class BatchSupervisor:
                 continue
             finally:
                 eng._fault_hook = None
+                eng._flip_hook = None
             consecutive = 0
             state = self._check_runaways(state)
             if not (np.asarray(state.trap) == 0).any() \

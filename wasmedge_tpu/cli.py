@@ -551,6 +551,17 @@ def _gateway_parser() -> ArgumentParser:
                         "wasmedge.await_event) park the session at "
                         "zero resident cost until POST "
                         "/v1/requests/<id>/wake or its timer"))
+    p.add_option(["audit"],
+                 Toggle("shadow-audit lanes: re-execute a seeded lane "
+                        "sample at launch boundaries and compare "
+                        "bit-exact; divergence rolls back, masks, and "
+                        "feeds the device-quarantine ladder"))
+    p.add_option(["scrub"],
+                 Option("at-rest integrity scrubbing every N seconds: "
+                        "re-verify swap blobs / checkpoint members / "
+                        "compile-cache entries, repair from mirror or "
+                        "fleet peer, else evict (0 = off)", "s",
+                        typ=float))
     p.add_option(["obs"],
                  Toggle("enable the flight recorder (gateway/<tenant> "
                         "spans, drain histograms; served at /metrics)"))
@@ -625,6 +636,11 @@ def gateway_command(argv: List[str], out=None, err=None) -> int:
         conf.batch.compact = True
     if p._opts["suspend"].value:
         conf.effects.suspend = True
+    if p._opts["audit"].value:
+        conf.integrity.audit = True
+    if p._opts["scrub"].seen and p._opts["scrub"].value > 0:
+        conf.integrity.scrub = True
+        conf.integrity.scrub_interval_s = p._opts["scrub"].value
     if p._opts["obs"].value:
         conf.obs.enabled = True
 

@@ -497,6 +497,56 @@ class ImagestoreConfigure:
 
 
 @dataclasses.dataclass
+class IntegrityConfigure:
+    """Knobs for the silent-data-corruption defense subsystem
+    (wasmedge_tpu/integrity/, r24).
+
+    Both legs default OFF: with neither the shadow auditor nor the
+    scrubber enabled no hook is installed anywhere on the launch path
+    and no background thread starts, so behavior is bit-identical to
+    r23 by construction."""
+
+    # Shadow-audit lanes: at seeded launch boundaries, export a small
+    # lane subset's pre-slice planes, re-execute the identical slice
+    # through a reference re-trace of the same step program at the
+    # sampled width, and compare the post-slice planes bit-exact.  A
+    # divergence raises an SDC incident (FailureRecord "integrity",
+    # rollback to the newest good checkpoint, per-device attribution).
+    # CLI: --integrity-audit.
+    audit: bool = False
+    # Seed for the boundary/lane sampler (deterministic given the seed
+    # and the boundary index).
+    audit_seed: int = 0
+    # Audit roughly one in this many launch boundaries (1 = every
+    # boundary; the sampler hashes seed+boundary so the audited set is
+    # stable, not periodic).
+    audit_every: int = 16
+    # Lanes sampled per audited boundary.
+    audit_lanes: int = 2
+    # Divergences attributed to one device before the quarantine
+    # ladder ejects it through the r21 reshard path.
+    quarantine_threshold: int = 3
+    # At-rest scrubber: re-verify sha256 over SwapStore entries
+    # (parked r23 sessions included), checkpoint lineage members, and
+    # WTIC compile-cache entries before a wake/restore needs them.
+    # CLI: --integrity-scrub.
+    scrub: bool = False
+    # Background scrub cadence in seconds; 0 disables the thread
+    # (scrub_once() stays callable — tests and the bench drive it
+    # manually).
+    scrub_interval_s: float = 0.0
+    # Repair a failed local copy from fleet peer replicas
+    # (GET /v1/fleet/cache/<sha> for compile-cache entries,
+    # GET /v1/fleet/blob/<key> for swap blobs) before falling back to
+    # evict + fresh-lower / init-replay.
+    scrub_repair: bool = True
+
+    @property
+    def active(self) -> bool:
+        return bool(self.audit or self.scrub)
+
+
+@dataclasses.dataclass
 class CompilerConfigure:
     """AOT-compiler knobs (reference: CompilerConfigure,
     include/common/configure.h:28-106).  The optimization level and
@@ -530,6 +580,8 @@ class Configure:
         default_factory=EffectsConfigure)
     imagestore: ImagestoreConfigure = dataclasses.field(
         default_factory=ImagestoreConfigure)
+    integrity: IntegrityConfigure = dataclasses.field(
+        default_factory=IntegrityConfigure)
     compiler: CompilerConfigure = dataclasses.field(default_factory=CompilerConfigure)
 
     def add_proposal(self, p: Proposal) -> "Configure":

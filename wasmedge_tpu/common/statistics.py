@@ -38,7 +38,10 @@ class FailureRecord:
     snapshot skipped in the lineage), "poison_lane" (lane set repeatedly
     faulting the kernel, demoted or terminated), "runaway" (lane past the
     per-lane step cap, terminated), "demote" (engine tier given up on),
-    or "scalar_rerun" (host-side error inside the scalar bottom rung).
+    "scalar_rerun" (host-side error inside the scalar bottom rung), or
+    "integrity" (r24 shadow-audit divergence: a device returned
+    wrong-but-plausible planes — silent data corruption detected,
+    rolled back, and re-executed).
     """
 
     fault_class: str

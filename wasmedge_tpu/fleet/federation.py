@@ -215,6 +215,8 @@ class FleetController:
             "migrations_out": 0, "migrations_in": 0,
             "replication_errors": 0, "suspect_rejections": 0,
             "joins": 0, "leaves": 0, "gossip_merges": 0,
+            "wakes_forwarded": 0, "wakes_received": 0,
+            "blob_repairs_served": 0,
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -683,6 +685,139 @@ class FleetController:
             return cc.entry_bytes(sha256)
         except KeyError:
             return None
+
+    # -- fleet-routed wakes + blob repair (r24) ----------------------------
+    def route_wake(self, request_id: int, payload) -> Optional[dict]:
+        """Forward a wake the local generation does not know to the
+        id's rendezvous owner (the r16 routing table).  Returns the
+        owner's resolution dict, or None when there is nothing to
+        forward to (inert fleet, self-owned id, unreachable owner) —
+        the caller's local "unknown" answer then stands, with the wake
+        queued at-least-once for a session that may still land here.
+        A SUSPECT owner raises PeerSuspect: the edge answers 503 +
+        Retry-After rather than guessing about a wake that may apply
+        the moment the owner's probes recover."""
+        if not self.started or not self.remote_available():
+            return None
+        rid = int(request_id)
+        owner = rendezvous_owner(rid, self.members())
+        if owner == self.self_id:
+            return None
+        with self._lock:
+            p = self.peers.get(owner)
+            if p is not None and p.state == "suspect":
+                self.counters["suspect_rejections"] += 1
+                raise PeerSuspect(owner, rid)
+        if p is None:
+            return None
+        import base64
+
+        body = {"id": rid, "edge": self.self_id}
+        if payload:
+            body["payload_b64"] = base64.b64encode(payload).decode()
+        try:
+            st, doc = self._client.request(p.peer_id, p.url, "POST",
+                                           "/v1/fleet/wake", body=body)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException:
+            return None
+        if st == 200 and isinstance(doc, dict) and doc.get("ok"):
+            with self._lock:
+                self.counters["wakes_forwarded"] += 1
+            self.svc.obs.instant("fleet_wake_forward", cat="fleet",
+                                 track="fleet", id=rid, owner=owner)
+            return {"ok": True, "request_id": rid,
+                    "state": doc.get("state", "forwarded"),
+                    "owner": owner}
+        return None
+
+    def on_wake(self, body: dict) -> dict:
+        """Inbound forwarded wake: apply locally (never re-forwarded —
+        the sender already resolved ownership, so a second hop could
+        only loop)."""
+        import base64
+
+        self._recv("wake", body.get("edge"))
+        rid = int(body["id"])
+        payload = base64.b64decode(body["payload_b64"]) \
+            if body.get("payload_b64") else None
+        out = self.svc.wake(rid, payload, _forward=False)
+        with self._lock:
+            self.counters["wakes_received"] += 1
+        return out
+
+    def blob_bytes(self, key: str) -> Optional[bytes]:
+        """Serve a content-addressed swap blob to a repairing peer
+        (GET /v1/fleet/blob/<key>).  Every local copy is VERIFIED
+        against the key before serving — corruption must never
+        propagate through the repair channel."""
+        gen = self.svc.current
+        stores = []
+        if gen is not None:
+            srv = gen.server
+            if srv.effects is not None:
+                stores.append(srv.effects.store)
+            if srv.hv is not None:
+                stores.append(srv.hv.store)
+        snap = getattr(self.svc, "snapshot_store", None)
+        if snap is not None:
+            stores.append(snap)
+        seen = set()
+        for store in stores:
+            if store is None or id(store) in seen:
+                continue
+            seen.add(id(store))
+            payload = store.peek(key)
+            if payload is not None:
+                with self._lock:
+                    self.counters["blob_repairs_served"] += 1
+                return payload
+        return None
+
+    def fetch_blob(self, key: str) -> Optional[bytes]:
+        """Repair channel for the at-rest scrubber: try every alive
+        peer for a verified replica of a content-addressed blob."""
+        if not self.started or not self.remote_available():
+            return None
+        with self._lock:
+            alive = [p for p in self.peers.values()
+                     if p.state == "alive" and not p.left]
+        for p in alive:
+            try:
+                st, data = self._client.request(
+                    p.peer_id, p.url, "GET",
+                    f"/v1/fleet/blob/{key}", raw=True)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException:
+                continue
+            if st == 200 and \
+                    hashlib.sha256(data).hexdigest() == str(key):
+                return bytes(data)
+        return None
+
+    def fetch_cache_entry(self, sha: str) -> Optional[bytes]:
+        """Repair channel for rotted compile-cache entries: a peer's
+        raw WTIC envelope (adopt_entry re-verifies the embedded digest
+        before it is trusted)."""
+        if not self.started or not self.remote_available():
+            return None
+        with self._lock:
+            alive = [p for p in self.peers.values()
+                     if p.state == "alive" and not p.left]
+        for p in alive:
+            try:
+                st, data = self._client.request(
+                    p.peer_id, p.url, "GET",
+                    f"/v1/fleet/cache/{sha}", raw=True)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException:
+                continue
+            if st == 200 and data:
+                return bytes(data)
+        return None
 
     # -- module replication ------------------------------------------------
     def _sync_modules(self):

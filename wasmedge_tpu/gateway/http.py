@@ -349,6 +349,16 @@ class GatewayHandler(BaseHTTPRequestHandler):
                 raise KeyError(f"no cache entry {sha[:12]}")
             return self._reply(200, data,
                                content_type="application/octet-stream")
+        if path.startswith("/v1/fleet/blob/"):
+            # at-rest scrub repair (r24): a verified replica of a
+            # content-addressed swap blob (parked session payloads)
+            key = path.rsplit("/", 1)[1]
+            fl._recv("blob", self.headers.get("X-Fleet-Peer"))
+            data = fl.blob_bytes(key)
+            if data is None:
+                raise KeyError(f"no blob {key[:12]}")
+            return self._reply(200, data,
+                               content_type="application/octet-stream")
         if path == "/v1/fleet/manifest":
             fl._recv("manifest", self.headers.get("X-Fleet-Peer"))
             return self._reply(200, fl._hello())
@@ -377,6 +387,11 @@ class GatewayHandler(BaseHTTPRequestHandler):
             return self._reply(200, fl.on_execute(doc))
         if path == "/v1/fleet/migrate":
             return self._reply(200, fl.on_migrate(doc))
+        if path == "/v1/fleet/wake":
+            # fleet-routed wake (r24): an edge member forwarded an
+            # external wake to this gateway as the id's rendezvous
+            # owner; applied locally, never re-forwarded
+            return self._reply(200, fl.on_wake(doc))
         if path == "/v1/fleet/migrate_out":
             # operator/bench trigger: ship one parked virtual lane
             return self._reply(200, fl.migrate_out(

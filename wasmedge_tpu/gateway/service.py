@@ -330,6 +330,33 @@ class GatewayService:
                 else AutoscaleConfig(**dict(autoscale))
             if acfg.enabled:
                 self.autoscale = AutoscaleController(self, acfg).start()
+        # integrity (r24): the at-rest scrubber re-verifies every
+        # content-addressed byte this gateway holds — parked-session
+        # swap blobs, compile-cache entries, checkpoint lineage
+        # members — repairing from fleet peer replicas where it can
+        # and evicting (forcing a fresh lower / older-member restore)
+        # where it cannot.  Default off: the scrubber object does not
+        # exist and no byte of behavior changes.
+        self.scrubber = None
+        integ = getattr(self.template, "integrity", None)
+        if integ is not None and integ.scrub:
+            from wasmedge_tpu.integrity import Scrubber
+
+            self.scrubber = Scrubber(
+                integ, obs=self.obs, faults=faults,
+                swap_stores=self._scrub_swap_stores,
+                checkpoints=self._scrub_checkpoints,
+                compile_cache=lambda: (
+                    self.registry.compile_cache
+                    if self.imagestore_enabled
+                    and self.registry.compile_cache.enabled else None),
+                fetch_blob=lambda key: (
+                    self.fleet.fetch_blob(key)
+                    if self.fleet is not None else None),
+                fetch_cache_entry=lambda sha: (
+                    self.fleet.fetch_cache_entry(sha)
+                    if self.fleet is not None else None))
+            self.scrubber.start()   # inert unless scrub_interval_s > 0
         self._health = HealthGate(self)
         if resume:
             if self.durable is None:
@@ -1205,19 +1232,36 @@ class GatewayService:
         return ("pruned" if pruned else "unknown"), None
 
     def wake(self, request_id: int,
-             payload: Optional[bytes] = None) -> dict:
+             payload: Optional[bytes] = None,
+             _forward: bool = True) -> dict:
         """Deliver an external wake to a (possibly parked) request —
         the POST /v1/requests/<id>/wake body rides to the guest's
         await_event return buffer.  At-least-once: the wake queues
         even when the id is not currently parked (it pre-delivers at
         the request's next await_event), so a wake racing the park is
-        never lost."""
+        never lost.
+
+        Fleet-routed (r24): when this member does not know the id and
+        a fleet is active, the wake forwards to the id's rendezvous
+        owner over the r16 routing table — any member is a valid edge
+        for POST /v1/requests/<id>/wake.  `_forward=False` marks an
+        already-forwarded arrival (FleetController.on_wake) so a
+        misrouted wake can never loop."""
         rid = int(request_id)
         gen = self.current
         if gen is None:
             raise KeyError(f"no serving generation to wake request "
                            f"{rid}")
         state = gen.server.wake(rid, payload)
+        if state == "unknown" and _forward and self.fleet is not None:
+            fwd = self.fleet.route_wake(rid, payload)
+            if fwd is not None:
+                self.obs.instant("gateway_wake", cat="gateway",
+                                 track="gateway", id=rid,
+                                 state="forwarded",
+                                 owner=fwd.get("owner"),
+                                 nbytes=len(payload or b""))
+                return fwd
         self.obs.instant("gateway_wake", cat="gateway",
                          track="gateway", id=rid, state=state,
                          nbytes=len(payload or b""))
@@ -1309,6 +1353,66 @@ class GatewayService:
         with self._lock:
             key = str(int(code))
             self.http_counts[key] = self.http_counts.get(key, 0) + 1
+
+    # -- integrity (r24) ---------------------------------------------------
+    def _scrub_swap_stores(self):
+        """(kind, store, evict_on_fail) triples for the scrubber.  The
+        hv/effects stores never evict: their get() already refuses rot
+        and checkpoints embed payload copies, so an unrepairable entry
+        is counted and left for the restore path to route around.  The
+        snapshot store DOES evict — a rotted pre-initialized snapshot
+        silently poisons every lane built from it, and eviction just
+        costs one init replay."""
+        out, seen = [], set()
+        gen = self.current
+        if gen is not None:
+            srv = gen.server
+            if srv.hv is not None and srv.hv.store is not None:
+                out.append(("hv", srv.hv.store, False))
+                seen.add(id(srv.hv.store))
+            if srv.effects is not None \
+                    and srv.effects.store is not None \
+                    and id(srv.effects.store) not in seen:
+                out.append(("effects", srv.effects.store, False))
+                seen.add(id(srv.effects.store))
+        if self.snapshot_store is not None \
+                and id(self.snapshot_store) not in seen:
+            out.append(("snapshot", self.snapshot_store, True))
+        return out
+
+    def _scrub_checkpoints(self):
+        """Checkpoint lineage member paths of the current generation
+        (real on-disk files only)."""
+        gen = self.current
+        if gen is None:
+            return []
+        with gen.server._lock:
+            members = list(gen.server._lineage.members)
+        return [m.path for m in members
+                if isinstance(m.path, (str, os.PathLike))
+                and os.path.isfile(m.path)]
+
+    def scrub_once(self) -> Optional[dict]:
+        """One synchronous at-rest scrub pass (the cadence thread runs
+        the same walk); None when the scrubber is off."""
+        if self.scrubber is None:
+            return None
+        return self.scrubber.scrub_once()
+
+    def integrity_stats(self) -> Optional[dict]:
+        """The /v1/status "integrity" block: shadow-audit verdicts +
+        device quarantine from the serving generation, scrub totals
+        from the gateway-wide scrubber.  None when the whole subsystem
+        is off — the default status body is bit-identical r23."""
+        out = {}
+        gen = self.current
+        if gen is not None:
+            audit = gen.server.integrity_stats()
+            if audit is not None:
+                out.update(audit)
+        if self.scrubber is not None:
+            out["scrub"] = self.scrubber.snapshot()
+        return out or None
 
     # -- introspection -----------------------------------------------------
     def reshard(self, n_devices: Optional[int] = None,
@@ -1425,6 +1529,11 @@ class GatewayService:
                 "snapshots": dict(self.snapshot_counts),
                 "lowered_count": self.registry.lowered_count,
             }
+        integ = self.integrity_stats()
+        if integ is not None:
+            # integrity telemetry (r24): absent unless audit/scrub is
+            # on, so the default status body stays bit-identical r23
+            out["integrity"] = integ
         out["health"] = self.health()
         return out
 
@@ -1457,7 +1566,8 @@ class GatewayService:
             compile_cache_counts=dict(self.registry.compile_cache.counts)
             if self.imagestore_enabled else None,
             snapshot_counts=dict(self.snapshot_counts)
-            if self.snapshot_store is not None else None)
+            if self.snapshot_store is not None else None,
+            integrity_stats=self.integrity_stats())
 
     # -- lifecycle ---------------------------------------------------------
     def shutdown(self, drain: bool = True,
@@ -1473,6 +1583,8 @@ class GatewayService:
                 gens = list(self._gens)
         if self.autoscale is not None:
             self.autoscale.stop()
+        if self.scrubber is not None:
+            self.scrubber.stop()
         if self.fleet is not None:
             self.fleet.stop()
         for g in gens:
@@ -1494,6 +1606,8 @@ class GatewayService:
             self._closed = True   # later registrations see it and stop
         if self.autoscale is not None:
             self.autoscale.stop()
+        if self.scrubber is not None:
+            self.scrubber.stop()
         if self.fleet is not None:
             # a killed process's heartbeats just STOP (no goodbye, no
             # final replication) — peers discover the death the honest

@@ -91,6 +91,17 @@ class SwapStore:
                 atomic_write_bytes(self._path(key), payload)
         self._refs[key] = self._refs.get(key, 0) + 1
         self.puts += 1
+        # r24 at-rest rot seam: an armed BitFlip corrupts the STORED
+        # copy (memory and disk mirror both) after the key is issued —
+        # get() detects on read; the integrity scrubber detects before
+        # a wake needs it and repairs from a mirror or a fleet peer
+        if self.faults is not None and hasattr(self.faults, "flip"):
+            rotted = self.faults.flip("corrupt_swap", self._mem[key],
+                                      key=key, nbytes=len(payload))
+            if rotted is not self._mem[key]:
+                self._mem[key] = rotted
+                if self.dir:
+                    atomic_write_bytes(self._path(key), rotted)
         return key
 
     def adopt(self, key: str, payload: bytes):
@@ -121,6 +132,90 @@ class SwapStore:
         if self.key_of(payload) != key:
             raise SwapCorrupt(key, "content hash mismatch")
         return payload
+
+    # -- at-rest scrubbing (wasmedge_tpu/integrity/scrub.py, r24) ----------
+    def scrub_keys(self):
+        """Every key the store currently claims to hold (memory plus
+        any disk mirrors) — the scrubber's walk set."""
+        keys = set(self._mem)
+        if self.dir:
+            try:
+                for fn in os.listdir(self.dir):
+                    if fn.endswith(".lane"):
+                        keys.add(fn[:-len(".lane")])
+            except OSError:
+                pass
+        return sorted(keys)
+
+    def scrub_verify(self, key: str):
+        """Verify both copies of one entry, healing a bad mirror from a
+        good one.  Returns (status, payload): "ok" both copies verify
+        (or the only copy does), "healed" one copy was corrupt and was
+        rewritten from the other, "corrupt" no copy verifies (payload
+        None — the caller repairs from a peer replica or gives up)."""
+        mem = self._mem.get(key)
+        mem_ok = mem is not None and self.key_of(mem) == key
+        disk = None
+        disk_ok = False
+        if self.dir:
+            try:
+                with open(self._path(key), "rb") as f:
+                    disk = f.read()
+                disk_ok = self.key_of(disk) == key
+            except OSError:
+                disk = None
+        good = mem if mem_ok else (disk if disk_ok else None)
+        if good is None:
+            return "corrupt", None
+        healed = False
+        if mem is not None and not mem_ok:
+            self._mem[key] = bytes(good)
+            healed = True
+        if self.dir and disk is not None and not disk_ok:
+            atomic_write_bytes(self._path(key), good)
+            healed = True
+        return ("healed" if healed else "ok"), good
+
+    def scrub_restore(self, key: str, payload: bytes) -> bool:
+        """Reinstall a repaired payload (e.g. fetched from a fleet
+        peer).  Verified against the key first; refcounts untouched —
+        the entry's owners never noticed the rot."""
+        if self.key_of(payload) != key:
+            return False
+        if key in self._mem:
+            self._mem[key] = bytes(payload)
+        if self.dir:
+            atomic_write_bytes(self._path(key), payload)
+        return True
+
+    def scrub_evict(self, key: str):
+        """Drop an unrepairable entry's copies (refcounts kept so a
+        later release stays a no-op): the next reader takes the
+        missing-entry path instead of trusting rot."""
+        self._mem.pop(key, None)
+        if self.dir:
+            try:
+                os.unlink(self._path(key))
+            except OSError:
+                pass
+
+    def peek(self, key: str) -> Optional[bytes]:
+        """Best-effort VERIFIED read for fleet replica serving: returns
+        the payload only when a local copy matches the key (corruption
+        must never propagate to a repairing peer), else None.  Does not
+        count as a get."""
+        for payload in (self._mem.get(key),):
+            if payload is not None and self.key_of(payload) == key:
+                return payload
+        if self.dir:
+            try:
+                with open(self._path(key), "rb") as f:
+                    payload = f.read()
+            except OSError:
+                return None
+            if self.key_of(payload) == key:
+                return payload
+        return None
 
     def release(self, key: str):
         """Drop one reference; the entry (and its disk mirror) goes

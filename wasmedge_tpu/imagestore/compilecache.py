@@ -149,6 +149,24 @@ class CompileCache:
             except OSError:
                 pass
         self.counts["stores"] += 1
+        # r24 at-rest rot seam: an armed BitFlip corrupts the STORED
+        # envelope (memory and disk mirror both) — load() detects via
+        # the embedded digest (miss, fresh lower); the scrubber detects
+        # early and repairs from a peer replica or evicts
+        if self.faults is not None and hasattr(self.faults, "flip"):
+            rotted = self.faults.flip("corrupt_cache", raw, sha=sha)
+            if rotted is not raw:
+                with self._lock:
+                    if self._payloads is not None:
+                        self._payloads[sha] = rotted
+                if self.dir:
+                    try:
+                        from wasmedge_tpu.utils.fsio import \
+                            atomic_write_bytes
+
+                        atomic_write_bytes(self._path(sha), rotted)
+                    except OSError:
+                        pass
 
     # -- fleet replication (r16 peer protocol) -----------------------------
     def entry_bytes(self, sha: str) -> bytes:
@@ -186,6 +204,30 @@ class CompileCache:
             except OSError:
                 pass
         return True
+
+    # -- at-rest scrubbing (wasmedge_tpu/integrity/scrub.py, r24) ----------
+    def verify_entry(self, sha: str) -> bool:
+        """True when a resident entry's envelope decodes and its
+        payload digest verifies (missing entries are vacuously absent,
+        not corrupt — the scrubber walks known_shas first)."""
+        try:
+            raw = self.entry_bytes(sha)
+        except KeyError:
+            return True
+        return self._decode(raw) is not None
+
+    def drop_entry(self, sha: str) -> None:
+        """Evict an unrepairable entry (memory + disk): the next load
+        is a clean miss and the registration lowers fresh — rot is
+        never served."""
+        with self._lock:
+            if self._payloads is not None:
+                self._payloads.pop(sha, None)
+        if self.dir:
+            try:
+                os.unlink(self._path(sha))
+            except OSError:
+                pass
 
     def known_shas(self) -> list:
         """Shas with a resident persistent-tier entry (fleet gossip)."""

@@ -1,0 +1,35 @@
+"""End-to-end integrity against silent data corruption (r24).
+
+Three legs:
+  - audit.py: shadow-audit lanes — at seeded launch boundaries a small
+    lane subset's pre-slice planes are exported, the identical slice is
+    re-executed through a reference re-trace of the same step program,
+    and the post-slice planes are compared bit-exact.  A divergence is
+    an SDC incident: FailureRecord("integrity"), rollback to the newest
+    good checkpoint, per-device attribution.
+  - quarantine.py: the divergence->eject ladder — repeated divergences
+    attributed to one device eject it through the r21 reshard path.
+  - scrub.py: the at-rest scrubber — a cadence-driven walk re-verifying
+    sha256 over SwapStore entries (parked r23 sessions included),
+    checkpoint lineage members, and r22 WTIC compile-cache entries
+    before a wake/restore needs them, repairing from mirrors or fleet
+    peer replicas, else evicting with a fresh-lower/init-replay
+    fallback.
+
+Integrity off (the default IntegrityConfigure) installs no hook and
+starts no thread: the serving stack runs the exact r23 path,
+bit-identical by construction.
+"""
+
+from wasmedge_tpu.integrity.audit import (AuditSampler, IntegrityDivergence,
+                                          ShadowAuditor)
+from wasmedge_tpu.integrity.quarantine import DeviceQuarantine
+from wasmedge_tpu.integrity.scrub import Scrubber
+
+__all__ = [
+    "AuditSampler",
+    "DeviceQuarantine",
+    "IntegrityDivergence",
+    "Scrubber",
+    "ShadowAuditor",
+]

@@ -1,0 +1,291 @@
+"""Shadow-audit lanes: launch-boundary re-execution of a sampled lane
+subset, compared bit-exact against the device's answer.
+
+At each launch boundary a deterministic seeded sampler decides whether
+to audit and which lanes; the auditor exports those lanes' pre-slice
+plane columns (before the launch donates the state), lets the launch
+run, re-executes the identical slice through a reference program, and
+compares the post-slice columns bit-exact.  The reference is NOT a
+different engine: it is the SAME `_make_step(img, cfg, k)` program
+re-traced at the sampled width `k` and driven for exactly the same
+number of loop iterations with the same per-launch time base — the
+construction lane compaction's narrowing rung already proved width-
+invariant (batch/engine.py _build_narrow_chunk).  Re-execution through
+the identical program means a transient device fault (an SDC bit flip
+in flight or at rest in HBM) cannot reproduce on the replay, so any
+bitwise mismatch is a divergence.
+
+A divergence raises `IntegrityDivergence` (point "integrity"): the
+supervisor/server recovery tier records a FailureRecord with fault
+class "integrity", rolls back to the newest good checkpoint, and
+re-executes — masking the corruption.  Every diverged lane is also
+attributed to the mesh device holding its shard, feeding the
+`DeviceQuarantine` ladder (quarantine.py).
+
+One caveat gates comparison: the tier-0 in-kernel RNG keys its stream
+by ABSOLUTE lane position (t0_rng_seq_hash over lane_iota), so a
+sampled lane replayed at a shifted position would legitimately draw
+different numbers.  When the sampled index set is not positional
+(idx[j] != j somewhere) AND any sampled lane consumed RNG during the
+slice, the audit records verdict "skipped_rng" instead of comparing —
+never a false divergence.  Full-width audits (the bench campaign) are
+always positional and never skip.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from wasmedge_tpu.obs.recorder import NULL_RECORDER
+
+# t0_ctr row indices (batch/tier0.py): clock / rng / fd_write / sys
+_T0_RNG_ROW = 1
+
+
+class IntegrityDivergence(RuntimeError):
+    """An audited lane's replayed planes differ bit-wise from the
+    device's — a silent-data-corruption incident.  `point` routes the
+    recovery tier to fault class "integrity"; `lanes` is EMPTY on
+    purpose (divergence is a device problem, not a poison input — the
+    whole batch retries from the newest good checkpoint), with the
+    diverged lane set carried separately for attribution/reporting."""
+
+    point = "integrity"
+    lanes = ()
+
+    def __init__(self, boundary: int, diverged_lanes, devices, planes,
+                 message: str = ""):
+        self.boundary = int(boundary)
+        self.diverged_lanes = tuple(int(x) for x in diverged_lanes)
+        self.devices = tuple(int(x) for x in devices)
+        self.planes = tuple(planes)
+        super().__init__(
+            message or "shadow audit divergence at boundary "
+            f"{self.boundary}: lanes={list(self.diverged_lanes)} "
+            f"planes={list(self.planes)} devices={list(self.devices)}")
+
+
+class AuditSampler:
+    """Deterministic boundary/lane sampler: hashing seed+boundary makes
+    the audited boundary set stable (not periodic — a periodic audit
+    would miss any corruption phase-locked to it) and the lane choice
+    reproducible.  Same seed, same schedule."""
+
+    def __init__(self, seed: int = 0, every: int = 16,
+                 lanes_per_audit: int = 2):
+        self.seed = int(seed)
+        self.every = max(int(every), 1)
+        self.lanes_per_audit = max(int(lanes_per_audit), 1)
+
+    def _hash(self, boundary: int) -> int:
+        h = hashlib.sha256(
+            f"audit|{self.seed}|{int(boundary)}".encode()).digest()
+        return int.from_bytes(h[:8], "big")
+
+    def pick(self, boundary: int, lanes: int):
+        """Sorted sampled lane indices for this boundary, or None when
+        the boundary is not audited."""
+        if lanes <= 0:
+            return None
+        h = self._hash(boundary)
+        if h % self.every != 0:
+            return None
+        k = min(self.lanes_per_audit, lanes)
+        rng = np.random.RandomState((h >> 16) & 0x7FFFFFFF)
+        idx = rng.choice(lanes, size=k, replace=False)
+        return np.sort(idx).astype(np.int64)
+
+
+class ShadowAuditor:
+    """Engine hook (`BatchEngine._audit_hook`): `pre` snapshots sampled
+    lane columns at a launch boundary, `post` replays and compares.
+    Reference chunk programs are cached per sampled width."""
+
+    def __init__(self, knobs, obs=None, faults=None, quarantine=None):
+        self.knobs = knobs
+        self.sampler = AuditSampler(knobs.audit_seed, knobs.audit_every,
+                                    knobs.audit_lanes)
+        self.quarantine = quarantine if quarantine is not None \
+            else _make_quarantine(knobs)
+        self.obs = obs if obs is not None else NULL_RECORDER
+        self.faults = faults
+        self.stats = {
+            "boundaries": 0,
+            "audits": 0,
+            "match": 0,
+            "divergence": 0,
+            "skipped_rng": 0,
+            "error": 0,
+        }
+        self._boundary = 0
+        self._ref_chunks = {}
+        self._gather_fn = None
+
+    def _gather(self, state, names, jidx):
+        """Sampled lane columns for `names`, as host arrays — ONE jitted
+        dispatch and ONE device_get, not a transfer per plane (the
+        per-plane form costs several launch-times per audit and is what
+        the within-10%-of-audit-off bar is lost to)."""
+        import jax
+
+        if self._gather_fn is None:
+            def g(planes, idx):
+                return {n: p[..., idx] for n, p in planes.items()}
+
+            self._gather_fn = jax.jit(g)
+        planes = {n: getattr(state, n) for n in names}
+        return jax.device_get(self._gather_fn(planes, jidx))
+
+    # -- engine seam -------------------------------------------------------
+    def pre(self, engine, state, tt):
+        """Called after the boundary rebalance, before the launch
+        donates `state`.  Returns an opaque token for `post`, or None
+        when this boundary is not audited."""
+        b = self._boundary
+        self._boundary += 1
+        self.stats["boundaries"] += 1
+        idx = self.sampler.pick(b, engine.lanes)
+        if idx is None:
+            return None
+        import jax.numpy as jnp
+
+        jidx = jnp.asarray(idx)
+        names = [name for name in state._fields
+                 if getattr(state, name) is not None
+                 and getattr(getattr(state, name), "ndim", 0)
+                 and getattr(state, name).shape[-1] == engine.lanes]
+        pre = self._gather(state, names, jidx)
+        return {"boundary": b, "idx": idx, "pre": pre,
+                "tt": np.asarray(tt)}
+
+    def post(self, engine, tok, state, done_steps: int):
+        """Called after the launch lands (and after any corrupt_plane
+        flip seam — the flip must be visible to the audit).  Raises
+        IntegrityDivergence on a bitwise mismatch."""
+        import jax.numpy as jnp
+
+        idx = tok["idx"]
+        if self.faults is not None:
+            try:
+                self.faults.fire("audit_compare", boundary=tok["boundary"],
+                                 lanes=len(idx))
+            except Exception:
+                # the audit INFRA failed, not the device: void this
+                # audit, keep serving
+                self.stats["error"] += 1
+                return
+        jidx = jnp.asarray(idx)
+        names = [name for name in tok["pre"]
+                 if getattr(state, name) is not None]
+        post = self._gather(state, names, jidx)
+        # tier-0 RNG keys by absolute lane position: a non-positional
+        # sample that consumed RNG this slice cannot be replayed
+        # faithfully — skip, never false-positive
+        positional = bool(np.array_equal(idx, np.arange(len(idx))))
+        if not positional and "t0_ctr" in post:
+            drew = post["t0_ctr"][_T0_RNG_ROW] \
+                - tok["pre"]["t0_ctr"][_T0_RNG_ROW]
+            if np.any(drew != 0):
+                self.stats["skipped_rng"] += 1
+                return
+        self.stats["audits"] += 1
+        t0 = self.obs.now()
+        ref = self._replay(engine, tok, state, int(done_steps))
+        import jax
+
+        ref_host = jax.device_get(
+            {name: getattr(ref, name) for name in post})
+        bad_planes = []
+        bad_lanes = set()
+        for name, dev in post.items():
+            r = ref_host[name]
+            neq = dev != r
+            if not np.any(neq):
+                continue
+            bad_planes.append(name)
+            lane_bad = np.any(
+                neq, axis=tuple(range(neq.ndim - 1))) if neq.ndim > 1 \
+                else neq
+            bad_lanes.update(int(idx[j]) for j in np.nonzero(lane_bad)[0])
+        if not bad_planes:
+            self.stats["match"] += 1
+            if self.obs.enabled:
+                self.obs.span("integrity_audit", t0, cat="integrity",
+                              lanes=len(idx), verdict="match")
+            return
+        self.stats["divergence"] += 1
+        n_dev = engine.mesh.devices.size if engine.mesh is not None else 1
+        devices = sorted({lane * n_dev // engine.lanes
+                          for lane in bad_lanes})
+        for d in devices:
+            self.quarantine.note(d)
+        self.obs.instant("integrity_divergence",
+                         boundary=tok["boundary"],
+                         lanes=sorted(bad_lanes), planes=bad_planes,
+                         devices=devices)
+        raise IntegrityDivergence(tok["boundary"], sorted(bad_lanes),
+                                  devices, bad_planes)
+
+    # -- reference replay --------------------------------------------------
+    def _ref_chunk(self, engine, width: int):
+        fn = self._ref_chunks.get(width)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from wasmedge_tpu.batch.engine import _make_step
+
+        step = _make_step(engine.img, engine.cfg, width,
+                          t0kinds=getattr(engine, "_t0kinds", None))
+
+        def run_ref(state, t0_time, nsteps):
+            # the iteration budget is the DEVICE's done_steps, not
+            # cfg.steps_per_launch: autotune may retune the chunk
+            # length between launches, and an early all-trapped exit
+            # must replay to the same iteration count
+            def cond(carry):
+                i, s = carry
+                return (i < nsteps) & jnp.any(s.trap == 0)
+
+            def body(carry):
+                i, s = carry
+                return i + 1, step(s, t0_time)
+
+            i, state = lax.while_loop(cond, body, (jnp.int32(0), state))
+            return i, state
+
+        fn = jax.jit(run_ref)
+        self._ref_chunks[width] = fn
+        return fn
+
+    def _replay(self, engine, tok, state, done_steps: int):
+        import jax.numpy as jnp
+
+        width = len(tok["idx"])
+        fn = self._ref_chunk(engine, width)
+        fields = {}
+        for name in state._fields:
+            p = getattr(state, name)
+            if p is None:
+                fields[name] = None
+            elif name in tok["pre"]:
+                fields[name] = jnp.asarray(tok["pre"][name])
+            else:
+                # laneless obs counter planes (op_hist/fu_ctr/tu_ctr):
+                # pure accumulators, never read by the step — zeros
+                # keep the replay's arithmetic identical and its
+                # counts discarded
+                fields[name] = jnp.zeros_like(p)
+        sub = type(state)(**fields)
+        _, ref = fn(sub, jnp.asarray(tok["tt"]), jnp.int32(done_steps))
+        return ref
+
+
+def _make_quarantine(knobs):
+    from wasmedge_tpu.integrity.quarantine import DeviceQuarantine
+
+    return DeviceQuarantine(getattr(knobs, "quarantine_threshold", 3))

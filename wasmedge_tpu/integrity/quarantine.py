@@ -1,0 +1,52 @@
+"""Device quarantine ladder: divergence attribution -> eject.
+
+Each audit divergence attributes the lanes that diverged to the mesh
+devices holding their shards (`note`).  Once one device accumulates
+`threshold` attributions it becomes an eject candidate; the serving
+layer's recovery path (serve/server.py _recover) drains candidates
+through `pending_ejects` and removes them from the mesh via the r21
+`reshard(devices=...)` path — the same machinery a planned scale-down
+uses, so every resident lane survives the eject.  Single-device
+engines have nowhere to eject to; candidates are counted but stay
+(`pending_ejects` filters them out when ejecting would empty the
+mesh — the caller passes the population)."""
+
+from __future__ import annotations
+
+import threading
+
+
+class DeviceQuarantine:
+    """Thread-safe divergence counter per device index."""
+
+    def __init__(self, threshold: int = 3):
+        self.threshold = max(int(threshold), 1)
+        self.counts = {}
+        self.ejected = set()
+        self._lock = threading.Lock()
+
+    def note(self, device: int) -> bool:
+        """Record one divergence attributed to `device`; True when the
+        ladder's threshold is now crossed."""
+        with self._lock:
+            d = int(device)
+            self.counts[d] = self.counts.get(d, 0) + 1
+            return self.counts[d] >= self.threshold and d not in self.ejected
+
+    def pending_ejects(self):
+        """Devices over threshold and not yet ejected."""
+        with self._lock:
+            return sorted(d for d, c in self.counts.items()
+                          if c >= self.threshold and d not in self.ejected)
+
+    def mark_ejected(self, device: int):
+        with self._lock:
+            self.ejected.add(int(device))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "threshold": self.threshold,
+                "counts": dict(self.counts),
+                "ejected": sorted(self.ejected),
+            }

@@ -1,0 +1,255 @@
+"""At-rest integrity scrubber: verify sha256 over every durable byte
+BEFORE a wake/restore needs it.
+
+Three walk targets, each already content-addressed or digest-framed by
+construction, so the scrubber adds no new format — it just reads what
+the write paths committed to:
+
+  - SwapStore entries (hv swap blobs, parked r23 session blobs,
+    imagestore snapshots): key == sha256(payload).  A corrupt copy
+    heals from its healthy mirror (memory vs disk) when one survives,
+    else repairs from a fleet peer replica (GET /v1/fleet/blob/<key>),
+    else — where a clean fallback exists (snapshot store: init-replay)
+    — evicts.  hv/effects blobs without a replica are left counted as
+    unrepairable: get() still refuses to serve them, and serve
+    checkpoints embed their payloads for restore.
+  - Checkpoint lineage members: checkpoint.save writes a `<path>.sha256`
+    sidecar; a mismatch quarantines the member (renamed `<path>.corrupt`)
+    so the recovery walk falls back to the next-older member instead of
+    tripping over rot mid-incident.  Members predating the sidecar are
+    backfilled on first scrub.
+  - WTIC compile-cache entries: the envelope's embedded digest is
+    re-verified; a corrupt entry repairs from a peer
+    (GET /v1/fleet/cache/<sha>) or is evicted — the next registration
+    lowers fresh, wrong code is never served.
+
+The `scrub_read` fault seam (testing/faults.py) models an unreadable
+local copy: an injected fault routes that entry down the same repair
+path a hash mismatch takes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+
+from wasmedge_tpu.obs.recorder import NULL_RECORDER
+
+
+def sidecar_path(path) -> str:
+    return os.fspath(path) + ".sha256"
+
+
+def file_sha256(path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _new_stats() -> dict:
+    return {
+        "scans": 0,
+        "entries": 0,
+        "corrupt": 0,
+        "repaired": 0,
+        "evicted": 0,
+        "unrepairable": 0,
+        "read_faults": 0,
+        "quarantined_members": 0,
+        "last_seconds": 0.0,
+    }
+
+
+class Scrubber:
+    """Cadence-driven at-rest verification walk.
+
+    Providers are callables resolved at scrub time (the gateway's
+    serving generation — and with it every store — can be swapped
+    between passes):
+      - `swap_stores() -> [(kind, store, evict_on_fail), ...]`
+      - `checkpoints() -> [member_path, ...]`
+      - `compile_cache() -> CompileCache | None`
+      - `fetch_blob(key) -> bytes | None` (fleet peer replica)
+      - `fetch_cache_entry(sha) -> bytes | None` (raw WTIC envelope)
+    """
+
+    def __init__(self, knobs, obs=None, faults=None, swap_stores=None,
+                 checkpoints=None, compile_cache=None, fetch_blob=None,
+                 fetch_cache_entry=None):
+        self.knobs = knobs
+        self.obs = obs if obs is not None else NULL_RECORDER
+        self.faults = faults
+        self.swap_stores = swap_stores or (lambda: ())
+        self.checkpoints = checkpoints or (lambda: ())
+        self.compile_cache = compile_cache or (lambda: None)
+        self.fetch_blob = fetch_blob
+        self.fetch_cache_entry = fetch_cache_entry
+        self.stats = _new_stats()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Arm the background cadence when scrub_interval_s > 0 (0 =
+        manual scrub_once() only — tests and the bench drive it)."""
+        interval = float(getattr(self.knobs, "scrub_interval_s", 0.0))
+        if interval <= 0 or self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.scrub_once()
+                except Exception:
+                    # the scrubber is a defense layer, never a crash
+                    # source; a failed pass retries next cadence
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="integrity-scrubber")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    # -- one pass ----------------------------------------------------------
+    def scrub_once(self) -> dict:
+        """Walk every target once; returns the pass's delta counts."""
+        with self._lock:
+            t0 = time.monotonic()
+            delta = _new_stats()
+            for kind, store, evict_on_fail in self.swap_stores() or ():
+                self._scrub_store(kind, store, evict_on_fail, delta)
+            for path in self.checkpoints() or ():
+                self._scrub_checkpoint(path, delta)
+            cc = self.compile_cache()
+            if cc is not None and getattr(cc, "enabled", False):
+                self._scrub_cache(cc, delta)
+            delta["last_seconds"] = time.monotonic() - t0
+            delta["scans"] = 1
+            for k, v in delta.items():
+                if k == "last_seconds":
+                    self.stats[k] = v
+                else:
+                    self.stats[k] += v
+            if self.obs.enabled:
+                self.obs.instant(
+                    "scrub_pass", cat="integrity",
+                    entries=delta["entries"], corrupt=delta["corrupt"],
+                    repaired=delta["repaired"], evicted=delta["evicted"],
+                    seconds=round(delta["last_seconds"], 6))
+            return delta
+
+    def _read_seam(self, kind: str, key, delta) -> bool:
+        """Fire scrub_read; False = injected unreadable local copy
+        (take the repair path)."""
+        if self.faults is None:
+            return True
+        from wasmedge_tpu.testing.faults import InjectedFault
+
+        try:
+            self.faults.fire("scrub_read", kind=kind, key=str(key))
+        except InjectedFault:
+            delta["read_faults"] += 1
+            return False
+        return True
+
+    def _scrub_store(self, kind, store, evict_on_fail, delta):
+        repair = bool(getattr(self.knobs, "scrub_repair", True))
+        for key in store.scrub_keys():
+            delta["entries"] += 1
+            readable = self._read_seam(kind, key, delta)
+            status, _ = store.scrub_verify(key) if readable \
+                else ("corrupt", None)
+            if status == "ok":
+                continue
+            delta["corrupt"] += 1
+            if status == "healed":
+                delta["repaired"] += 1
+                continue
+            data = self.fetch_blob(key) \
+                if (repair and self.fetch_blob is not None) else None
+            if data is not None and store.scrub_restore(key, data):
+                delta["repaired"] += 1
+            elif evict_on_fail:
+                store.scrub_evict(key)
+                delta["evicted"] += 1
+            else:
+                delta["unrepairable"] += 1
+
+    def _scrub_checkpoint(self, path, delta):
+        path = os.fspath(path)
+        side = sidecar_path(path)
+        if not os.path.exists(path):
+            # orphaned sidecar after a lineage prune
+            if os.path.exists(side):
+                try:
+                    os.unlink(side)
+                except OSError:
+                    pass
+            return
+        delta["entries"] += 1
+        if not self._read_seam("checkpoint", path, delta):
+            digest = None
+        else:
+            try:
+                digest = file_sha256(path)
+            except OSError:
+                digest = None
+        if not os.path.exists(side):
+            if digest is not None:
+                # pre-r24 member: adopt its current content as the
+                # baseline (rot before the first scrub is out of scope
+                # — checkpoint.load's archive validation still covers)
+                try:
+                    with open(side, "w") as f:
+                        f.write(digest)
+                except OSError:
+                    pass
+            return
+        try:
+            with open(side) as f:
+                want = f.read().strip()
+        except OSError:
+            return
+        if digest == want:
+            return
+        delta["corrupt"] += 1
+        delta["quarantined_members"] += 1
+        # quarantine the member: the recovery walk (lineage.walk_newest)
+        # falls back to the next-older member instead of loading rot
+        try:
+            os.replace(path, path + ".corrupt")
+            os.unlink(side)
+        except OSError:
+            pass
+        self.obs.instant("scrub_checkpoint_quarantined", cat="integrity",
+                         path=os.path.basename(path))
+
+    def _scrub_cache(self, cc, delta):
+        repair = bool(getattr(self.knobs, "scrub_repair", True))
+        for sha in cc.known_shas():
+            delta["entries"] += 1
+            readable = self._read_seam("cache", sha, delta)
+            if readable and cc.verify_entry(sha):
+                continue
+            delta["corrupt"] += 1
+            raw = self.fetch_cache_entry(sha) \
+                if (repair and self.fetch_cache_entry is not None) else None
+            if raw is not None and cc.adopt_entry(sha, raw):
+                delta["repaired"] += 1
+            else:
+                cc.drop_entry(sha)
+                delta["evicted"] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.stats)

@@ -64,7 +64,8 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                       autoscale_actions=None,
                       compile_cache_counts=None,
                       snapshot_counts=None,
-                      session_stats=None) -> str:
+                      session_stats=None,
+                      integrity_stats=None) -> str:
     """Render one metrics snapshot.  All sources optional: `recorder` a
     FlightRecorder, `stats` a common.statistics.Statistics, `hostcall_stats`
     an engine's pipeline counter dict, `failures` extra FailureRecords
@@ -89,7 +90,11 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
     `session_stats` an EffectsRuntime.stats() suspend/resume snapshot
     (wasmedge_tpu/effects/) — r23, passed only when Configure.effects
     is active, so a gateway without it renders bit-identically to
-    r22."""
+    r22.  `integrity_stats` a GatewayService.integrity_stats() block
+    ({"audit": ShadowAuditor.stats, "quarantine":
+    DeviceQuarantine.snapshot(), "scrub": Scrubber.snapshot()}, each
+    key optional) — r24, passed only when Configure.integrity is
+    active, so a gateway without it renders bit-identically to r23."""
     w = _Writer()
 
     if compile_cache_counts:
@@ -284,6 +289,50 @@ def render_prometheus(recorder=None, stats=None, hostcall_stats=None,
                     w.sample("wasmedge_session_faults_total",
                              {"kind": kind},
                              int(session_stats[kind]))
+
+    if integrity_stats:
+        audit = integrity_stats.get("audit")
+        if audit is not None:
+            w.head("wasmedge_integrity_audits_total", "counter",
+                   "Shadow-audit verdicts at launch boundaries "
+                   "(wasmedge_tpu/integrity: a seeded lane subset "
+                   "re-executed on the reference tier and compared "
+                   "bit-exact; divergence = silent data corruption "
+                   "detected, rolled back, and re-executed).")
+            for verdict in ("match", "divergence", "skipped_rng",
+                            "error"):
+                w.sample("wasmedge_integrity_audits_total",
+                         {"verdict": verdict},
+                         int(audit.get(verdict, 0)))
+        quar = integrity_stats.get("quarantine")
+        if quar is not None:
+            w.head("wasmedge_integrity_quarantined_devices", "gauge",
+                   "Devices ejected from the serving mesh after "
+                   "repeated audit-divergence attribution (integrity/"
+                   "quarantine.py ladder, ejection via live reshard).")
+            w.sample("wasmedge_integrity_quarantined_devices", None,
+                     len(quar.get("ejected", ())))
+        scrub = integrity_stats.get("scrub")
+        if scrub is not None:
+            w.head("wasmedge_integrity_scrub_entries_total", "counter",
+                   "At-rest scrub outcomes over content-addressed "
+                   "state (swap blobs, checkpoint members, compile-"
+                   "cache entries): entries walked, corruption found, "
+                   "repairs (mirror or fleet replica), evictions, "
+                   "unrepairable counts (integrity/scrub.py).")
+            for kind in ("entries", "corrupt", "repaired", "evicted",
+                         "unrepairable", "read_faults",
+                         "quarantined_members"):
+                w.sample("wasmedge_integrity_scrub_entries_total",
+                         {"kind": kind}, int(scrub.get(kind, 0)))
+            w.head("wasmedge_integrity_scrub_passes_total", "counter",
+                   "Completed at-rest scrub walks.")
+            w.sample("wasmedge_integrity_scrub_passes_total", None,
+                     int(scrub.get("scans", 0)))
+            w.head("wasmedge_integrity_scrub_last_seconds", "gauge",
+                   "Wall seconds the most recent scrub pass took.")
+            w.sample("wasmedge_integrity_scrub_last_seconds", None,
+                     float(scrub.get("last_seconds", 0.0)))
 
     if gateway_counts is not None:
         w.head("wasmedge_gateway_restarts_total", "counter",
@@ -555,7 +604,8 @@ def export_prometheus(path, recorder=None, stats=None,
                       autoscale_actions=None,
                       compile_cache_counts=None,
                       snapshot_counts=None,
-                      session_stats=None) -> str:
+                      session_stats=None,
+                      integrity_stats=None) -> str:
     """Render and write a metrics snapshot to `path` (or file-like)."""
     text = render_prometheus(recorder=recorder, stats=stats,
                              hostcall_stats=hostcall_stats,
@@ -570,7 +620,8 @@ def export_prometheus(path, recorder=None, stats=None,
                              autoscale_actions=autoscale_actions,
                              compile_cache_counts=compile_cache_counts,
                              snapshot_counts=snapshot_counts,
-                             session_stats=session_stats)
+                             session_stats=session_stats,
+                             integrity_stats=integrity_stats)
     if hasattr(path, "write"):
         path.write(text)
     else:

@@ -205,6 +205,21 @@ class BatchServer:
         # parked-table fingerprint at the last good checkpoint: park /
         # wake changes are durable state even when total stands still
         self._eff_snap_ids = None
+        # shadow-audit lanes (wasmedge_tpu/integrity/, r24): armed as
+        # the engine's _audit_hook for every launch slice _step_body
+        # drives.  A divergence raises out of the slice like a device
+        # failure, lands in _recover with fault class "integrity", and
+        # repeated attributions to one device eject it through the r21
+        # reshard path.  Off (the default) no hook exists anywhere on
+        # the launch path — bit-identical r23.
+        self.auditor = None
+        integ = getattr(self.conf, "integrity", None)
+        if integ is not None and integ.audit:
+            from wasmedge_tpu.integrity import ShadowAuditor
+
+            self.auditor = ShadowAuditor(integ, obs=self.obs,
+                                         faults=faults)
+            self.engine._audit_hook = self.auditor
         self.checkpoint_dir = checkpoint_dir or self.k.checkpoint_dir
         self.state = None
         self.total = 0
@@ -595,6 +610,8 @@ class BatchServer:
             try:
                 if self.faults is not None:
                     eng._fault_hook = self.faults.fire
+                    if hasattr(self.faults, "flip"):
+                        eng._flip_hook = self.faults.flip
                 launched = eng.run_from_state(run_from[0], run_from[1],
                                               run_from[1] + chunk)
             except (KeyboardInterrupt, SystemExit):
@@ -603,6 +620,7 @@ class BatchServer:
                 launch_err = e
             finally:
                 eng._fault_hook = None
+                eng._flip_hook = None
             t_launch = time.monotonic() - t0
         with self._lock:
             self._inflight = False
@@ -1377,7 +1395,9 @@ class BatchServer:
         self.retries += 1
         self._consecutive += 1
         point = getattr(exc, "point", None) or "launch"
-        self._record("serve" if point == "serve" else "launch", exc)
+        cls = "integrity" if point == "integrity" \
+            else ("serve" if point == "serve" else "launch")
+        self._record(cls, exc)
         self.obs.instant("retry", cat="serve", track="serve",
                          retry=self.retries,
                          consecutive=self._consecutive, point=str(point))
@@ -1514,6 +1534,67 @@ class BatchServer:
         from wasmedge_tpu.batch.supervisor import backoff_seconds
 
         self._pending_backoff = backoff_seconds(self.k, self._consecutive)
+        # SDC incident: after the rollback is complete, drain the
+        # divergence->eject ladder — the restored state re-executes the
+        # slice either way (masking a transient flip); a device past the
+        # quarantine threshold leaves the mesh before it can diverge
+        # again
+        if cls == "integrity":
+            self._quarantine_eject()
+
+    def _quarantine_eject(self):
+        """Eject devices past the quarantine threshold through the r21
+        reshard path (every resident lane survives — the same machinery
+        a planned scale-down uses).  Single-device engines have nowhere
+        to eject to: the candidate is marked (so the ladder stops
+        re-firing) and counted, and serving continues on the retry
+        ladder.  Attribution counts for surviving devices reset with
+        the mesh indices after an eject — conservative, never silent."""
+        aud = self.auditor
+        if aud is None:
+            return
+        q = aud.quarantine
+        pending = q.pending_ejects()
+        if not pending:
+            return
+        eng = self.engine
+        counted = 0
+        if eng.mesh is not None:
+            devs = list(eng.mesh.devices.flat)
+            bad = set(pending)
+            remaining = [d for i, d in enumerate(devs) if i not in bad]
+            if remaining:
+                try:
+                    self.reshard(devices=remaining)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception:
+                    # reshard records its own failure and rolls back
+                    # onto the old mesh; the ladder re-fires on the
+                    # next divergence
+                    return
+                for d in pending:
+                    q.mark_ejected(d)
+                counted = len(pending)
+            # an eject that would empty the mesh is refused: keep
+            # serving degraded, the retry ladder still masks incidents
+        else:
+            for d in pending:
+                q.mark_ejected(d)
+            counted = len(pending)
+        if counted:
+            self.counters["quarantined_devices"] = \
+                self.counters.get("quarantined_devices", 0) + counted
+            self.obs.instant("device_quarantined", cat="integrity",
+                             track="serve", devices=list(pending))
+
+    def integrity_stats(self):
+        """Audit/quarantine counters for /v1/status + Prometheus (None
+        when the auditor is off)."""
+        if self.auditor is None:
+            return None
+        return {"audit": dict(self.auditor.stats),
+                "quarantine": self.auditor.quarantine.snapshot()}
 
     def _fail(self, exc: BaseException):
         self.failed = exc

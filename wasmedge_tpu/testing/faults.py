@@ -132,6 +132,44 @@ The imagestore layer (r22) adds the cold-start seams:
                            admit through plain template init (the r21
                            path), bit-identical results, just colder.
 
+The integrity layer (r24, wasmedge_tpu/integrity/) adds the silent-
+corruption seams.  Unlike every seam above, the `corrupt_*` family is
+a BIT-FLIP seam driven by `FaultInjector.flip(point, obj, **ctx)` —
+it never raises; it returns `obj` with exactly one seeded bit flipped
+when an armed `BitFlip` covers the arrival, modelling SDC that the
+runtime must DETECT rather than an error it gets told about:
+  - `"corrupt_plane"`   in BatchEngine.run_from_state after a launch
+                        slice lands, before the shadow auditor's
+                        post-slice gather (ctx: total).  One bit of
+                        one lane column of one BatchState plane flips
+                        on device — the audit must catch it, roll
+                        back, and attribute the device.
+  - `"corrupt_swap"`    in SwapStore.put after the blob is stored
+                        (ctx: key, nbytes).  The AT-REST copy rots
+                        (memory and disk mirror both); `get` detects
+                        on read, the scrubber detects BEFORE a wake
+                        needs it and repairs from a healthy mirror or
+                        a fleet peer replica.
+  - `"corrupt_cache"`   in CompileCache.store after the entry lands
+                        (ctx: sha).  The stored WTIC envelope rots;
+                        `load` detects via the embedded digest (miss,
+                        fresh lower), the scrubber detects early and
+                        repairs from a peer or evicts.
+  Checkpoint-shard rot has no runtime seam — drive `flip_file(path)`
+  against a lineage member like `corrupt_checkpoint` does; the
+  scrubber's sha256 sidecar verification detects it.
+The raising seams that pair with the scrubber/auditor:
+  - `"audit_compare"`   in ShadowAuditor.post before the reference
+                        replay/compare (ctx: boundary, lanes).  An
+                        injected fault models the audit INFRA failing
+                        — the audit voids (counted as an error),
+                        execution continues; it is never reported as
+                        a divergence.
+  - `"scrub_read"`      in Scrubber before each entry's local read
+                        (ctx: kind, key).  An injected fault is an
+                        unreadable local copy: the scrubber goes down
+                        the same repair path a hash mismatch takes.
+
 Fault classes covered by the tier-1 suites (ISSUE 2 + ISSUE 5):
   - launch-time device error       Fault(point="launch", ...)
   - mid-serve host exception       Fault(point="serve", ...)
@@ -206,17 +244,117 @@ class Fault:
     match: Optional[dict] = None
 
 
+@dataclasses.dataclass
+class BitFlip:
+    """One armed bit flip: on arrivals [at, at + times) at a
+    `corrupt_*` seam, `FaultInjector.flip` returns the seam's object
+    with exactly one seeded bit flipped (it never raises).  For
+    `corrupt_plane` the object is a BatchState; `plane`/`lane`/`bit`
+    pin the target (None = seeded pick; the default plane pool avoids
+    control planes like trap/pc so the corruption is plausible data,
+    not an instant crash).  For byte seams the object is the stored
+    payload."""
+
+    point: str                   # "corrupt_plane" | "corrupt_swap" |
+    #                              "corrupt_cache"
+    at: int = 0
+    times: int = 1
+    seed: int = 0
+    plane: Optional[str] = None  # corrupt_plane: BatchState field name
+    lane: Optional[int] = None   # corrupt_plane: lane column
+    bit: Optional[int] = None    # bit index within the chosen byte
+    match: Optional[dict] = None  # same matched-counter contract as Fault
+
+
+# corrupt_plane's seeded pick draws from data planes: flipping pc/trap/
+# sp would typically crash the lane outright (a detected failure, not
+# SDC), while a rotted stack cell or memory word is exactly the wrong-
+# but-plausible result the shadow audit exists to catch.
+_FLIP_PLANE_POOL = ("stack_lo", "stack_hi", "mem", "glob_lo", "glob_hi")
+
+
+def flip_bit_bytes(data: bytes, seed: int = 0,
+                   bit: Optional[int] = None) -> bytes:
+    """Return `data` with one seeded bit flipped."""
+    buf = bytearray(data)
+    if not buf:
+        return bytes(buf)
+    rng = np.random.RandomState(int(seed) & 0x7FFFFFFF)
+    pos = int(rng.randint(len(buf)))
+    b = int(bit) if bit is not None else int(rng.randint(8))
+    buf[pos] ^= 1 << b
+    return bytes(buf)
+
+
+def flip_file(path, seed: int = 0, bit: Optional[int] = None):
+    """Flip one seeded bit of a file in place — at-rest rot for
+    checkpoint shards / cache entries.  Deliberately NOT atomic: rot
+    does not fsync."""
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(flip_bit_bytes(data, seed=seed, bit=bit))
+
+
+def _flip_batch_state(state, f: BitFlip, idx: int, ctx: dict):
+    """Flip one bit of one lane column of one plane; returns a new
+    state with that plane re-deviced (respecting its sharding)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState((int(f.seed) + idx) & 0x7FFFFFFF)
+    lanes = ctx.get("lanes")
+    if lanes is None:
+        lanes = int(np.asarray(state.pc).shape[-1])
+    names = [n for n in state._fields
+             if getattr(state, n) is not None
+             and getattr(getattr(state, n), "ndim", 0)
+             and getattr(state, n).shape[-1] == lanes]
+    if f.plane is not None:
+        name = f.plane
+        if name not in names:
+            return state
+    else:
+        pool = [n for n in _FLIP_PLANE_POOL if n in names] or names
+        name = pool[int(rng.randint(len(pool)))]
+    plane = getattr(state, name)
+    mirror = np.ascontiguousarray(np.asarray(plane)).copy()
+    lane = int(f.lane) if f.lane is not None else int(rng.randint(lanes))
+    sub = np.ascontiguousarray(mirror[..., lane]).reshape(-1)
+    raw = sub.view(np.uint8)
+    pos = int(rng.randint(raw.size))
+    bit = int(f.bit) if f.bit is not None else int(rng.randint(8))
+    raw[pos] ^= np.uint8(1 << bit)
+    mirror[..., lane] = sub.reshape(np.shape(mirror[..., lane]))
+    sharding = getattr(plane, "sharding", None)
+    if sharding is not None:
+        new = jax.device_put(mirror, sharding)
+    else:
+        new = jnp.asarray(mirror)
+    return state._replace(**{name: new})
+
+
 class FaultInjector:
     """Deterministic seam counter: `fire(point, **ctx)` raises when an
     armed fault covers this arrival.  `log` records every raised fault
     as (point, index) for assertions.  Thread-safe: the mesh drive fires
-    seams from concurrent per-device threads."""
+    seams from concurrent per-device threads.
 
-    def __init__(self, faults: Sequence[Fault]):
+    `flip(point, obj, **ctx)` is the r24 bit-flip sibling: it counts
+    arrivals at the `corrupt_*` seams and returns `obj` with one seeded
+    bit flipped when an armed `BitFlip` covers the arrival (unchanged
+    otherwise); `flip_log` records (point, index, ctx)."""
+
+    def __init__(self, faults: Sequence[Fault],
+                 flips: Sequence[BitFlip] = ()):
         self.faults = list(faults)
+        self.flips = list(flips)
         self.counts = {}
+        self.flip_counts = {}
         self.log = []
+        self.flip_log = []
         self._match_counts = {}
+        self._flip_match_counts = {}
         self._lock = threading.Lock()
 
     def fire(self, point: str, **ctx):
@@ -250,9 +388,47 @@ class FaultInjector:
         raise InjectedFault(point, idx, lanes=f.lanes,
                             message=f.message)
 
+    def flip(self, point: str, obj, **ctx):
+        """Bit-flip seam: return `obj` (bytes or a BatchState) with one
+        seeded bit flipped when an armed BitFlip covers this arrival,
+        else `obj` unchanged.  Never raises into the caller's path —
+        corruption is silent by definition."""
+        with self._lock:
+            i = self.flip_counts.get(point, 0)
+            self.flip_counts[point] = i + 1
+            hit = hit_idx = None
+            for fi, f in enumerate(self.flips):
+                if f.point != point:
+                    continue
+                if f.match is not None:
+                    if any(ctx.get(k) != v for k, v in f.match.items()):
+                        continue
+                    j = self._flip_match_counts.get(fi, 0)
+                    self._flip_match_counts[fi] = j + 1
+                    idx = j
+                else:
+                    idx = i
+                if not (f.at <= idx < f.at + f.times):
+                    continue
+                if hit is None:
+                    hit, hit_idx = f, idx
+            if hit is None:
+                return obj
+            self.flip_log.append((point, hit_idx, dict(ctx)))
+        if isinstance(obj, (bytes, bytearray)):
+            return flip_bit_bytes(bytes(obj), seed=hit.seed + hit_idx,
+                                  bit=hit.bit)
+        if hasattr(obj, "_fields") and hasattr(obj, "_replace"):
+            return _flip_batch_state(obj, hit, hit_idx, ctx)
+        return obj
+
     @property
     def fired(self) -> int:
         return len(self.log)
+
+    @property
+    def flipped(self) -> int:
+        return len(self.flip_log)
 
 
 def seeded_faults(seed: int, points: Sequence[str] = ("launch", "serve"),
@@ -365,6 +541,24 @@ def churn_schedule(seed: int, gossip_drops: int = 2,
         # arrival 2k faults, its retry (2k+1) goes through — mirrors
         # the gateway_chaos_schedule build/swap pairing
         out.append(Fault(point="reshard_install", at=2 * k))
+    return out
+
+
+def bitflip_campaign(seed: int, n_per_class: int = 2) -> list:
+    """The seeded SDC campaign `bench.py --integrity` drives: for each
+    storage class — resident BatchState plane, SwapStore/parked-session
+    blob, checkpoint shard, WTIC compile-cache entry — derive
+    `n_per_class` flip scenarios.  Every scenario must end DETECTED
+    (audit divergence or scrub/read hash mismatch) or REPAIRED/MASKED
+    with results bit-identical to the uncorrupted reference; a single
+    silent corruption fails the campaign.  Same seed, same flips."""
+    rng = np.random.RandomState(int(seed) & 0x7FFFFFFF)
+    out = []
+    for cls in ("plane", "swap", "checkpoint", "cache"):
+        for k in range(n_per_class):
+            out.append({"cls": cls, "seed": int(rng.randint(1 << 30)),
+                        "at": int(rng.randint(2)) if cls == "plane" else 0,
+                        "index": k})
     return out
 
 
